@@ -1,0 +1,53 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference snapshot: MrGo2008/Paddle @ Fluid 1.2/1.3-dev).
+
+Programs are Block/Op descriptions built from a fluid-style Python API
+(layers, append_backward autodiff, in-graph optimizers), lowered wholesale to
+XLA via JAX — `TPUPlace` is the first-class device, collectives ride ICI via
+jax.sharding instead of NCCL/gRPC.  See SURVEY.md at the repo root for the
+structural map to the reference.
+
+Typical use mirrors fluid:
+
+    import paddle_tpu as fluid
+    img = fluid.layers.data("img", [1, 28, 28])
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    loss_v, = exe.run(feed={...}, fetch_list=[loss])
+"""
+
+__version__ = "0.1.0"
+
+from . import ops as _ops  # registers all op lowerings  # noqa: F401
+
+from .core.framework import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    is_compiled_with_cuda,
+)
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .core.lod import LoDValue, create_lod_tensor  # noqa: F401
+from .core.executor import Executor  # noqa: F401
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
+
+from . import clip  # noqa: F401
+from . import initializer  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+# fluid-style direct names
+from .initializer import Constant, MSRA, Normal, TruncatedNormal, Uniform, Xavier  # noqa: F401

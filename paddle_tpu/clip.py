@@ -1,0 +1,165 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+GradientClipByGlobalNorm, set_gradient_clip)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "ErrorClipByValue",
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "append_gradient_clip_ops",
+    "error_clip_callback",
+]
+
+
+class BaseErrorClipAttr:
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max, self.min = float(max), float(min)
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(
+            type="clip", inputs={"X": [grad_name]}, outputs={"Out": [grad_name]},
+            attrs={"min": self.min, "max": self.max},
+        )
+
+
+def error_clip_callback(block, context):
+    pass
+
+
+class BaseGradientClipAttr:
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max, self.min = float(max), float(min)
+
+    def _create_operators(self, param, grad):
+        helper = LayerHelper("clip_grad")
+        new_grad = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            type="clip", inputs={"X": [grad]}, outputs={"Out": [new_grad]},
+            attrs={"min": self.min, "max": self.max},
+        )
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _create_operators(self, param, grad):
+        helper = LayerHelper("clip_grad_by_norm")
+        new_grad = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            type="clip_by_norm", inputs={"X": [grad]}, outputs={"Out": [new_grad]},
+            attrs={"max_norm": self.clip_norm},
+        )
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Scale all grads by clip_norm/max(global_norm, clip_norm)
+    (reference: clip.py GradientClipByGlobalNorm builds the same op chain)."""
+
+    def __init__(self, clip_norm, group_name: str = "default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        context[self.group_name].append(_square_sum(grad))
+        self.context = context
+
+    def _create_operators(self, param, grad):
+        from . import layers
+
+        group_scale_name = self.group_name + "_scale"
+        if group_scale_name not in self.context:
+            group_norm_sq = layers.sums(self.context[self.group_name])
+            group_norm = layers.ops.sqrt(group_norm_sq)
+            clip_var = layers.fill_constant(shape=[1], dtype=group_norm.dtype,
+                                            value=self.clip_norm)
+            scale_var = layers.elementwise_div(
+                x=clip_var,
+                y=layers.elementwise_max(x=clip_var, y=group_norm),
+            )
+            self.context[group_scale_name] = scale_var
+        new_grad = layers.elementwise_mul(x=grad, y=self.context[group_scale_name])
+        return param, new_grad
+
+
+def _square_sum(grad):
+    from . import layers
+
+    sq = layers.ops.square(grad)
+    return layers.reduce_sum(sq)
+
+
+_gradient_clip_attr: Optional[BaseGradientClipAttr] = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Set the clip strategy (reference: clip.py set_gradient_clip); with
+    param_list, attach per-param, else set the global default."""
+    global _gradient_clip_attr
+    if param_list:
+        for p in param_list:
+            if isinstance(p, str):
+                from .core.framework import default_main_program
+
+                p = default_main_program().global_block().var(p)
+            p.gradient_clip_attr = clip
+    else:
+        _gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grads: List[Tuple]):
+    context = {}
+    clips = []
+    for p, g in param_grads:
+        if g is None:
+            clips.append((p, g))
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None) or _gradient_clip_attr
+        if clip_attr is None:
+            clips.append((p, g))
+            continue
+        clip_attr._process_context(context, p, g)
+        clips.append((p, g, clip_attr))
+    res = []
+    for item in clips:
+        if len(item) == 2:
+            res.append(item)
+        else:
+            p, g, clip_attr = item
+            res.append(clip_attr._create_operators(p, g))
+    return res

@@ -1,0 +1,17 @@
+from .proto import DataType, VarType, ProgramDesc, BlockDesc, OpDesc, VarDesc  # noqa: F401
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    unique_name,
+)
+from .place import CPUPlace, CUDAPlace, CUDAPinnedPlace, Place, TPUPlace  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401
+from .lod import LoDValue, create_lod_tensor  # noqa: F401
+from .backward import append_backward, calc_gradient  # noqa: F401
+from .executor import Executor  # noqa: F401

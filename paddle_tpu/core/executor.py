@@ -1,0 +1,182 @@
+"""Executor: run a Program on a Place.
+
+Parity target: python/paddle/fluid/executor.py:256 (Executor.run :375) and
+the C++ serial interpreter it drives (paddle/fluid/framework/executor.cc:203).
+The reference interprets ops one-by-one against a Scope; here Executor.run
+lowers the whole main block to ONE jitted XLA computation via
+core.compiler.CompiledBlock (cached per (program, feeds, fetches) signature —
+mirroring the reference's program cache), feeds host arrays in, and writes
+updated persistable state (params, optimizer accumulators, the PRNG stream)
+back to the Scope.  Buffer donation on the state tuple gives the in-place
+param-update semantics of the reference's optimizer ops without mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import numpy as np
+
+from .compiler import CompiledBlock
+from .framework import Program, Variable, default_main_program
+from .lod import LoDValue
+from .place import CPUPlace, Place, TPUPlace
+from .proto import VarType, dtype_to_numpy
+from .scope import Scope, global_scope
+
+__all__ = ["Executor", "RNG_STATE_VAR"]
+
+RNG_STATE_VAR = "@rng_key@"
+
+
+def _as_feed_value(value, var_desc=None):
+    if isinstance(value, LoDValue):
+        return value
+    arr = np.asarray(value)
+    if var_desc is not None and var_desc.type == VarType.LOD_TENSOR:
+        want = dtype_to_numpy(var_desc.dtype)
+        try:
+            if arr.dtype != want:
+                arr = arr.astype(want)
+        except TypeError:
+            pass
+    return arr
+
+
+def _block_state_names(
+    program: Program, block_idx: int = 0, extra: Sequence[str] = ()
+) -> List[str]:
+    """All persistable vars a block touches (plus explicitly fetched ones) —
+    the cross-run state threaded through the jitted step."""
+    block = program.desc.block(block_idx)
+    names: Set[str] = set()
+    referenced: Set[str] = set(extra)
+    for op in block.ops:
+        referenced.update(op.input_arg_names())
+        referenced.update(op.output_arg_names())
+    for name, var in block.vars.items():
+        if var.persistable and name in referenced:
+            names.add(name)
+    return sorted(names)
+
+
+def _read_before_write(program: Program, state_names: Sequence[str], feed_names) -> Set[str]:
+    block = program.desc.block(0)
+    written: Set[str] = set(feed_names)
+    rbw: Set[str] = set()
+    states = set(state_names)
+    for op in block.ops:
+        for n in op.input_arg_names():
+            if n in states and n not in written:
+                rbw.add(n)
+        written.update(op.output_arg_names())
+    return rbw
+
+
+class Executor:
+    """Serial single-device executor (reference: executor.py:256)."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else CPUPlace()
+        self._cache: Dict[Tuple, CompiledBlock] = {}
+
+    def close(self) -> None:
+        self._cache.clear()
+
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ) -> List[Any]:
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+
+        feed_names = sorted(feed)
+        fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
+        state_names = _block_state_names(program, extra=fetch_names)
+
+        key = (
+            id(program),
+            len(program.desc.block(0).ops),
+            tuple(feed_names),
+            tuple(fetch_names),
+            tuple(state_names),
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = CompiledBlock(
+                program,
+                0,
+                feed_names,
+                fetch_names,
+                state_names,
+                donate_states=True,
+            )
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        block0 = program.desc.block(0)
+        feed_vals = tuple(
+            _as_feed_value(feed[n], block0.vars.get(n)) for n in feed_names
+        )
+
+        # check state availability; missing write-first states start as zeros
+        rbw = _read_before_write(program, state_names, feed_names)
+        state_vals = []
+        for n in state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if n in rbw:
+                    raise RuntimeError(
+                        f"persistable variable '{n}' is read before it is written "
+                        "but is not initialized in the scope; run the startup "
+                        "program first"
+                    )
+                vd = block0.vars[n]
+                shape = [d if d >= 0 else 1 for d in vd.shape] or [1]
+                v = np.zeros(shape, dtype=dtype_to_numpy(vd.dtype))
+            state_vals.append(v)
+
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+
+        with jax.default_device(self.place.jax_device()):
+            fetches, new_states, new_rng = compiled(feed_vals, tuple(state_vals), rng)
+
+        for n, v in zip(state_names, new_states):
+            if v is not None:
+                scope.set_var(n, v)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+
+        results = []
+        for name, val in zip(fetch_names, fetches):
+            results.append(self._convert_fetch(val, block0.vars.get(name), return_numpy))
+        return results
+
+    @staticmethod
+    def _convert_fetch(val, var_desc, return_numpy: bool):
+        if isinstance(val, LoDValue):
+            if return_numpy:
+                return LoDValue(np.asarray(val.data), np.asarray(val.lengths))
+            return val
+        if not return_numpy:
+            return val
+        arr = np.asarray(val)
+        if var_desc is not None:
+            want = dtype_to_numpy(var_desc.dtype)
+            try:
+                if np.dtype(want) != arr.dtype:
+                    arr = arr.astype(want)
+            except TypeError:
+                pass
+        return arr

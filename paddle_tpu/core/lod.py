@@ -1,0 +1,91 @@
+"""LoD (level-of-detail) variable-length sequence values.
+
+The reference's LoDTensor (paddle/fluid/framework/lod_tensor.h:43-57) stores
+ragged sequence batches as a dense buffer plus nested offset tables, and 26
+sequence ops shuffle those ragged layouts imperatively.  XLA wants static
+shapes, so the TPU-native representation is a *padded* dense tensor plus a
+per-sequence length vector (segment ids are derived where needed).  LoDValue
+is a JAX pytree, so it flows through jit/vjp unchanged; ops that ignore
+sequence structure just use `.data`.
+
+Offsets <-> lengths: reference LoD level [0, 2, 5, 9] == lengths [2, 3, 4].
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["LoDValue", "create_lod_tensor", "lod_to_lengths", "lengths_to_lod"]
+
+
+def lod_to_lengths(lod_level: Sequence[int]) -> List[int]:
+    return [lod_level[i + 1] - lod_level[i] for i in range(len(lod_level) - 1)]
+
+
+def lengths_to_lod(lengths: Sequence[int]) -> List[int]:
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + int(l))
+    return out
+
+
+@jax.tree_util.register_pytree_node_class
+class LoDValue:
+    """(padded data [num_seqs, max_len, ...], lengths [num_seqs]) pair."""
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return np.shape(self.data)
+
+    @property
+    def dtype(self):
+        return np.asarray(self.data).dtype
+
+    def lod(self) -> List[List[int]]:
+        return [lengths_to_lod(np.asarray(self.lengths).tolist())]
+
+    def __repr__(self):
+        return f"LoDValue(data={np.shape(self.data)}, lengths={np.shape(self.lengths)})"
+
+
+def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
+    """Build a runtime value from ragged python data
+    (reference: python/paddle/fluid/lod_tensor.py create_lod_tensor).
+
+    Accepts a list of per-sequence arrays (or a flat array + seq-lens) and
+    returns a LoDValue with right-padded data.
+    """
+    if recursive_seq_lens is None:
+        if isinstance(data, (list, tuple)):
+            seqs = [np.asarray(s) for s in data]
+        else:
+            return np.asarray(data)
+    else:
+        lens = list(recursive_seq_lens[-1])
+        flat = np.asarray(data)
+        seqs = []
+        off = 0
+        for l in lens:
+            seqs.append(flat[off : off + l])
+            off += l
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    max_len = int(lengths.max()) if len(seqs) else 0
+    feat_shape = seqs[0].shape[1:] if seqs else ()
+    out = np.zeros((len(seqs), max_len) + tuple(feat_shape), dtype=seqs[0].dtype if seqs else np.float32)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = s
+    return LoDValue(out, lengths)

@@ -1,0 +1,63 @@
+"""Device places (reference: paddle/fluid/platform/place.h).
+
+The reference models devices as a CPUPlace/CUDAPlace/CUDAPinnedPlace variant;
+here TPUPlace is the first-class device (the survey's north star: "this is
+where TPUPlace slots in", SURVEY §2.3).  A Place resolves to a JAX device;
+CUDAPlace is accepted for API compatibility and resolves to the default
+accelerator so reference scripts run unmodified.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace", "is_compiled_with_cuda"]
+
+
+class Place:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def jax_device(self):
+        devices = self._platform_devices()
+        return devices[self.device_id % len(devices)]
+
+    def _platform_devices(self):
+        return jax.devices()
+
+
+class CPUPlace(Place):
+    def _platform_devices(self):
+        return jax.devices("cpu")
+
+
+class TPUPlace(Place):
+    def _platform_devices(self):
+        for platform in ("tpu", "axon"):
+            try:
+                return jax.devices(platform)
+            except RuntimeError:
+                continue
+        return jax.devices()
+
+
+class CUDAPlace(TPUPlace):
+    """Compatibility alias: reference scripts using CUDAPlace get the default
+    accelerator (TPU when present)."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def is_compiled_with_cuda() -> bool:
+    return False

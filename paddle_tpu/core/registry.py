@@ -1,0 +1,113 @@
+"""Operator registry.
+
+TPU-native replacement for the reference's static-registrar op machinery
+(reference: paddle/fluid/framework/op_registry.h:196 REGISTER_OPERATOR,
+op_info.h OpInfoMap).  In the reference each op carries CPU/CUDA kernel
+bodies plus a C++ grad-desc maker; here an op is a *lowering rule* — a pure
+function from JAX arrays to JAX arrays that the block compiler inlines into
+one XLA computation — plus compile-time shape/dtype inference and an optional
+custom grad-desc maker.  Gradients usually need no per-op code at all: the
+compiler differentiates the forward lowering with jax.vjp (see
+paddle_tpu/core/compiler.py), which replaces the reference's per-op
+GradOpDescMaker kernels (grad_op_desc_maker.h:34).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["OpInfo", "OpRegistry", "register_op", "get_op_info"]
+
+GRAD_SUFFIX = "@GRAD"
+GRAD_OP_SUFFIX = "_grad"
+
+
+@dataclass
+class OpInfo:
+    type: str
+    # infer_shape(op: OpDesc, block: "Block") -> None; sets output VarDesc
+    # shape/dtype at graph-build time.
+    infer_shape: Optional[Callable] = None
+    # lower(ctx, ins: Dict[str, List[jax.Array]], attrs) -> Dict[str, List]
+    lower: Optional[Callable] = None
+    # Custom grad-desc maker: (op: OpDesc, block, grad_sub_block) ->
+    # (List[OpDesc], Dict[str, str] grad_to_var).  None => generic vjp grad op.
+    grad_maker: Optional[Callable] = None
+    # Ops with no gradient (metrics, fills, comparisons...).
+    no_grad: bool = False
+    # Slots that are differentiable inputs; None = all inputs.
+    diff_inputs: Optional[List[str]] = None
+    # If set, the op mutates state outside pure dataflow (optimizer ops,
+    # readers); the compiler keeps program order for these.
+    stateful: bool = False
+    # Marks ops whose lowering consumes the PRNG stream (dropout, *_random).
+    random: bool = False
+    # extra metadata
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class OpRegistry:
+    _ops: Dict[str, OpInfo] = {}
+
+    @classmethod
+    def register(cls, info: OpInfo) -> None:
+        if info.type in cls._ops:
+            raise ValueError(f"op '{info.type}' registered twice")
+        cls._ops[info.type] = info
+
+    @classmethod
+    def get(cls, op_type: str) -> OpInfo:
+        if op_type not in cls._ops:
+            raise KeyError(f"op '{op_type}' is not registered")
+        return cls._ops[op_type]
+
+    @classmethod
+    def has(cls, op_type: str) -> bool:
+        return op_type in cls._ops
+
+    @classmethod
+    def registered_ops(cls) -> List[str]:
+        return sorted(cls._ops)
+
+
+def register_op(
+    op_type: str,
+    *,
+    infer_shape: Optional[Callable] = None,
+    grad_maker: Optional[Callable] = None,
+    no_grad: bool = False,
+    diff_inputs: Optional[List[str]] = None,
+    stateful: bool = False,
+    random: bool = False,
+    **meta: Any,
+):
+    """Decorator registering `fn` as the lowering rule for `op_type`.
+
+    Usage:
+        @register_op("relu", infer_shape=same_shape("X", "Out"))
+        def _relu(ctx, ins, attrs):
+            return {"Out": [jax.nn.relu(ins["X"][0])]}
+    """
+
+    def deco(fn: Optional[Callable]):
+        OpRegistry.register(
+            OpInfo(
+                type=op_type,
+                infer_shape=infer_shape,
+                lower=fn,
+                grad_maker=grad_maker,
+                no_grad=no_grad,
+                diff_inputs=diff_inputs,
+                stateful=stateful,
+                random=random,
+                meta=meta,
+            )
+        )
+        return fn
+
+    return deco
+
+
+def get_op_info(op_type: str) -> OpInfo:
+    return OpRegistry.get(op_type)

@@ -1,0 +1,79 @@
+"""Scope: runtime name -> value map (reference: paddle/fluid/framework/scope.h:42).
+
+The reference's Scope owns C++ Variables holding LoDTensors on device; here a
+Scope maps variable names to host/device JAX arrays (or LoDValue pairs) plus
+auxiliary python state.  Parent-chain lookup and kid lifecycle follow the
+reference API (Var/FindVar/NewScope/DropKids).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+import contextlib
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.kids: List["Scope"] = []
+
+    def var(self, name: str) -> Any:
+        """Find-or-create (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str) -> Optional[Any]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value: Any) -> None:
+        self._vars[name] = value
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(parent=self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self.kids.clear()
+
+
+_global_scope = Scope()
+_current_scope = _global_scope
+
+
+def global_scope() -> Scope:
+    return _current_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _current_scope
+    prev, _current_scope = _current_scope, scope
+    try:
+        yield
+    finally:
+        _current_scope = prev

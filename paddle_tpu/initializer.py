@@ -1,0 +1,179 @@
+"""Initializers — append init ops to the startup program
+(reference: python/paddle/fluid/initializer.py: Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Bilinear initializer ops).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .core.framework import Block, Variable
+from .core.proto import DataType
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Uniform",
+    "Normal",
+    "TruncatedNormal",
+    "Xavier",
+    "MSRA",
+    "NumpyArrayInitializer",
+    "ConstantInitializer",
+    "UniformInitializer",
+    "NormalInitializer",
+    "XavierInitializer",
+    "MSRAInitializer",
+    "force_init_on_cpu",
+]
+
+
+def force_init_on_cpu() -> bool:
+    return False
+
+
+class Initializer:
+    def __call__(self, var: Variable, block: Block):
+        raise NotImplementedError
+
+    @staticmethod
+    def _fan_in_out(var: Variable):
+        shape = list(var.shape)
+        if len(shape) < 2:
+            return (shape[0] if shape else 1, shape[0] if shape else 1)
+        receptive = 1
+        for d in shape[2:]:
+            receptive *= d
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0, force_cpu: bool = False):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="fill_constant",
+            outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "dtype": int(var.dtype), "value": float(self.value)},
+        )
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, seed: int = 0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="uniform_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape), "dtype": int(var.dtype),
+                "min": self.low, "max": self.high, "seed": self.seed,
+            },
+        )
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape), "dtype": int(var.dtype),
+                "mean": self.loc, "std": self.scale, "seed": self.seed,
+            },
+        )
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="truncated_gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape), "dtype": int(var.dtype),
+                "mean": self.loc, "std": self.scale, "seed": self.seed,
+            },
+        )
+
+
+class XavierInitializer(Initializer):
+    """Glorot init (reference: initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None, seed: int = 0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = self._fan_in_out(var)
+        fan_in = self.fan_in if self.fan_in is not None else fi
+        fan_out = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fan_in + fan_out))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """He/Kaiming init (reference: initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, seed: int = 0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = self._fan_in_out(var)
+        fan_in = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fan_in)
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        return NormalInitializer(0.0, math.sqrt(2.0 / fan_in), self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        attrs = {"shape": list(self.value.shape), "dtype": int(var.dtype)}
+        if var.dtype in (DataType.INT32, DataType.INT64):
+            attrs["int32_values"] = self.value.astype(np.int64).reshape(-1).tolist()
+        else:
+            attrs["fp32_values"] = self.value.astype(np.float64).reshape(-1).tolist()
+        return block.append_op(type="assign_value", outputs={"Out": [var.name]}, attrs=attrs)
+
+
+class BilinearInitializer(Initializer):
+    """Bilinear upsample kernel init for conv_transpose."""
+
+    def __call__(self, var, block):
+        shape = list(var.shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        weight = np.zeros(shape, dtype=np.float32)
+        k = shape[3]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape[2:])):
+            x, y = i % k, i // k
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[:, :, y, x] = val
+        return NumpyArrayInitializer(weight)(var, block)
+
+
+# public aliases (reference exports short names)
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer

@@ -1,0 +1,37 @@
+"""fluid.layers equivalent: the public layer-function namespace."""
+
+from . import io, nn, ops, tensor  # noqa: F401
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+
+from ..core.framework import Variable
+from ..layer_helper import LayerHelper
+
+
+def mean(x, name=None):
+    """Mean over all elements -> scalar [1] (reference: operators/mean_op)."""
+    helper = LayerHelper("mean", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def elementwise_binary_dispatch(x, other, op_type):
+    """Back Variable.__add__/__mul__/...: scalar operands use scale ops,
+    Variable operands use elementwise ops."""
+    if isinstance(other, Variable):
+        return nn._elementwise(op_type, x, other)
+    val = float(other)
+    if op_type == "elementwise_add":
+        return tensor.scale(x, scale=1.0, bias=val)
+    if op_type == "elementwise_sub":
+        return tensor.scale(x, scale=1.0, bias=-val)
+    if op_type == "elementwise_mul":
+        return tensor.scale(x, scale=val)
+    if op_type == "elementwise_div":
+        return tensor.scale(x, scale=1.0 / val)
+    if op_type == "elementwise_pow":
+        return nn.pow(x, factor=val)
+    raise NotImplementedError(op_type)

@@ -1,0 +1,37 @@
+"""IO layers (reference: python/paddle/fluid/layers/io.py — data, py_reader,
+double_buffer...).  `data` declares a feed slot; reader layers live in
+paddle_tpu.reader and are wired here in later form."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.framework import Variable, default_main_program
+from ..core.proto import VarType
+
+__all__ = ["data"]
+
+
+def data(
+    name: str,
+    shape: Sequence[int],
+    append_batch_size: bool = True,
+    dtype="float32",
+    lod_level: int = 0,
+    type: VarType = VarType.LOD_TENSOR,
+    stop_gradient: bool = True,
+) -> Variable:
+    """Declare an input variable (reference: layers/io.py data).  With
+    append_batch_size a leading -1 batch dim is added, as in the reference."""
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper_block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        type=type,
+        stop_gradient=stop_gradient,
+    )

@@ -1,0 +1,101 @@
+"""Auto-generated activation layer fns (reference:
+python/paddle/fluid/layers/ops.py via layer_function_generator — one layer fn
+per registered activation op)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_ACTIVATIONS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softshrink",
+    "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin", "round",
+    "reciprocal", "log", "square", "softplus", "softsign", "hard_shrink",
+    "gelu", "erf", "sign", "tan", "acos", "asin", "atan", "sinh", "cosh",
+]
+
+__all__ = list(_ACTIVATIONS) + ["uniform_random", "gaussian_random",
+                                "gaussian_random_batch_size_like",
+                                "uniform_random_batch_size_like"]
+
+
+def _make_act(op_type):
+    def layer_fn(x, name=None):
+        helper = LayerHelper(op_type, input=x, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+
+    layer_fn.__name__ = op_type
+    layer_fn.__doc__ = f"{op_type} activation (op-generated layer fn)"
+    return layer_fn
+
+
+for _op in _ACTIVATIONS:
+    globals()[_op] = _make_act(_op)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    from ..core.proto import convert_dtype
+
+    helper = LayerHelper("uniform_random")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="uniform_random", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": int(dtype), "min": float(min),
+               "max": float(max), "seed": seed},
+    )
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    from ..core.proto import convert_dtype
+
+    helper = LayerHelper("gaussian_random")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gaussian_random", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": int(dtype), "mean": float(mean),
+               "std": float(std), "seed": seed},
+    )
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, seed=0, input_dim_idx=0,
+                                   output_dim_idx=0):
+    from ..core.proto import convert_dtype
+
+    helper = LayerHelper("uniform_random_batch_size_like", input=input)
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="uniform_random_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": int(dtype), "min": float(min),
+               "max": float(max), "seed": seed,
+               "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx},
+    )
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0, seed=0,
+                                    dtype="float32", input_dim_idx=0,
+                                    output_dim_idx=0):
+    # lowers through uniform's batch-size-like path with gaussian sampling
+    from ..core.proto import convert_dtype
+
+    helper = LayerHelper("gaussian_random_batch_size_like", input=input)
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gaussian_random_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": int(dtype), "mean": float(mean),
+               "std": float(std), "seed": seed,
+               "input_dim_idx": input_dim_idx, "output_dim_idx": output_dim_idx},
+    )
+    return out

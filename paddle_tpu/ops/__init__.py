@@ -1,0 +1,24 @@
+"""Operator library: importing this package registers every op lowering.
+
+The reference's equivalent is the static-registrar operator library
+paddle/fluid/operators/ (353 registered ops); here each module is a set of
+JAX lowering rules consumed by paddle_tpu.core.compiler.
+"""
+
+from . import (  # noqa: F401
+    activation_ops,
+    elementwise_ops,
+    loss_ops,
+    math_ops,
+    metric_ops,
+    nn_ops,
+    optimizer_ops,
+    reduce_ops,
+    tensor_ops,
+)
+
+from ..core.registry import OpRegistry
+
+
+def registered_ops():
+    return OpRegistry.registered_ops()

@@ -1,0 +1,85 @@
+"""Activation ops — the reference registers 39 of these via macros
+(paddle/fluid/operators/activation_op.cc:478-520, one CPU+CUDA functor pair
+each).  Here each is a one-line jnp lowering; XLA fuses them into adjacent
+matmuls/convs on the VPU, which also subsumes the reference's fused-activation
+ir passes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data, same_shape, wrap_lod
+
+
+def _unary(name, fn, extra_attrs=()):
+    @register_op(name, infer_shape=same_shape())
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        kw = {k: attrs[k] for k in extra_attrs if k in attrs}
+        return {"Out": [wrap_lod(x, _fn(data(x), **kw))]}
+
+    return _lower
+
+
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("exp", jnp.exp)
+_unary("relu", jax.nn.relu)
+_unary("gelu", jax.nn.gelu)
+_unary("tanh", jnp.tanh)
+_unary("tanh_shrink", lambda x: x - jnp.tanh(x))
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("abs", jnp.abs)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("round", jnp.round)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("log", jnp.log)
+_unary("square", jnp.square)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", jax.nn.soft_sign)
+_unary("softshrink", lambda x, lambda_=0.5: jnp.where(x > lambda_, x - lambda_, jnp.where(x < -lambda_, x + lambda_, 0.0)), ("lambda",))
+_unary("hard_shrink", lambda x, threshold=0.5: jnp.where(jnp.abs(x) > threshold, x, 0.0), ("threshold",))
+_unary("brelu", lambda x, t_min=0.0, t_max=24.0: jnp.clip(x, t_min, t_max), ("t_min", "t_max"))
+_unary("leaky_relu", lambda x, alpha=0.02: jnp.where(x >= 0, x, alpha * x), ("alpha",))
+_unary("soft_relu", lambda x, threshold=40.0: jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold))), ("threshold",))
+_unary("elu", lambda x, alpha=1.0: jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)), ("alpha",))
+_unary("relu6", lambda x, threshold=6.0: jnp.clip(x, 0.0, threshold), ("threshold",))
+_unary("pow", lambda x, factor=1.0: jnp.power(x, factor), ("factor",))
+_unary("stanh", lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(scale_a * x), ("scale_a", "scale_b"))
+_unary("hard_sigmoid", lambda x, slope=0.2, offset=0.5: jnp.clip(slope * x + offset, 0.0, 1.0), ("slope", "offset"))
+_unary("swish", lambda x, beta=1.0: x * jax.nn.sigmoid(beta * x), ("beta",))
+_unary("thresholded_relu", lambda x, threshold=1.0: jnp.where(x > threshold, x, 0.0), ("threshold",))
+_unary("logsumexp", lambda x: jax.nn.logsumexp(x))
+_unary("silu", jax.nn.silu)
+_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+_unary("erf", jax.lax.erf)
+_unary("sign", jnp.sign)
+_unary("tan", jnp.tan)
+_unary("acos", jnp.arccos)
+_unary("asin", jnp.arcsin)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+
+
+@register_op("prelu", infer_shape=same_shape())
+def _prelu(ctx, ins, attrs):
+    """Parametric relu with learnable Alpha (reference: operators/prelu_op.cc);
+    mode: all | channel | element."""
+    x = data(ins["X"][0])
+    alpha = data(ins["Alpha"][0])
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        a = jnp.reshape(alpha, ())
+    elif mode == "channel":
+        a = jnp.reshape(alpha, (1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = jnp.reshape(alpha, (1,) + x.shape[1:])
+    return {"Out": [jnp.where(x >= 0, x, a * x)]}

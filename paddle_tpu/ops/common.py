@@ -1,0 +1,122 @@
+"""Shared helpers for op lowering rules and compile-time shape inference."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType, OpDesc, VarDesc
+
+__all__ = [
+    "in_desc",
+    "set_output",
+    "same_shape",
+    "elemwise_shape",
+    "data",
+    "lengths",
+    "wrap_lod",
+    "broadcast_y",
+    "normalize_axis",
+]
+
+
+def in_desc(op: OpDesc, block, slot: str, idx: int = 0) -> Optional[VarDesc]:
+    names = op.input(slot)
+    if idx >= len(names) or not names[idx]:
+        return None
+    v = block._find_var_recursive(names[idx])
+    return v.desc if v is not None else None
+
+
+def set_output(
+    block,
+    op: OpDesc,
+    slot: str,
+    shape: Sequence[int],
+    dtype: DataType,
+    idx: int = 0,
+    lod_level: Optional[int] = None,
+):
+    names = op.output(slot)
+    if idx >= len(names) or not names[idx]:
+        return
+    name = names[idx]
+    if block.desc.has_var(name):
+        vd = block.desc.vars[name]
+        vd.shape = list(shape)
+        vd.dtype = DataType(dtype)
+        if lod_level is not None:
+            vd.lod_level = lod_level
+    else:
+        block.create_var(
+            name=name, shape=list(shape), dtype=DataType(dtype), lod_level=lod_level or 0
+        )
+
+
+def same_shape(in_slot: str = "X", out_slot: str = "Out"):
+    """infer_shape factory: Out mirrors X's shape/dtype/lod."""
+
+    def infer(op: OpDesc, block):
+        x = in_desc(op, block, in_slot)
+        if x is None:
+            return
+        set_output(block, op, out_slot, x.shape, x.dtype, lod_level=x.lod_level)
+
+    return infer
+
+
+def elemwise_shape(op: OpDesc, block):
+    x = in_desc(op, block, "X")
+    y = in_desc(op, block, "Y")
+    if x is None:
+        return
+    shape = list(x.shape)
+    if y is not None and len(y.shape) > len(shape):
+        shape = list(y.shape)
+    set_output(block, op, "Out", shape, x.dtype, lod_level=x.lod_level)
+
+
+# -- runtime value helpers ---------------------------------------------------
+def data(x):
+    """Dense view of a runtime value (LoDValue -> padded data)."""
+    return x.data if isinstance(x, LoDValue) else x
+
+
+def lengths(x):
+    return x.lengths if isinstance(x, LoDValue) else None
+
+
+def wrap_lod(template, value):
+    """Re-attach sequence lengths when the input carried them."""
+    if isinstance(template, LoDValue):
+        return LoDValue(value, template.lengths)
+    return value
+
+
+def normalize_axis(axis: int, rank: int) -> int:
+    return axis + rank if axis < 0 else axis
+
+
+def broadcast_y(x, y, axis: int):
+    """Fluid elementwise broadcasting (reference:
+    operators/elementwise/elementwise_op_function.h): Y's shape is a
+    contiguous sub-sequence of X's shape aligned at `axis` (-1 = align to the
+    trailing dims).  Reshape Y so numpy-style broadcasting applies."""
+    x_shape = jnp.shape(x)
+    y_shape = jnp.shape(y)
+    if x_shape == y_shape:
+        return y
+    if len(y_shape) > len(x_shape):
+        return y
+    # strip trailing 1s of y (fluid: [N,1] vs [N])
+    ys = list(y_shape)
+    while ys and ys[-1] == 1 and len(ys) > 1:
+        ys = ys[:-1]
+    axis = len(x_shape) - len(ys) if axis == -1 else axis
+    target = [1] * len(x_shape)
+    for i, d in enumerate(ys):
+        target[axis + i] = d
+    return jnp.reshape(y, target)

@@ -1,0 +1,33 @@
+"""Elementwise binary ops with Fluid broadcasting semantics.
+
+Reference: paddle/fluid/operators/elementwise/ (REGISTER_ELEMWISE_OP macro
+family) — Y broadcasts as a contiguous sub-shape of X anchored at `axis`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import broadcast_y, data, elemwise_shape, wrap_lod
+
+
+def _make(name, fn):
+    @register_op(name, infer_shape=elemwise_shape)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        yb = broadcast_y(data(x), data(y), attrs.get("axis", -1))
+        return {"Out": [wrap_lod(x, _fn(data(x), yb))]}
+
+    return _lower
+
+
+_make("elementwise_add", lambda x, y: x + y)
+_make("elementwise_sub", lambda x, y: x - y)
+_make("elementwise_mul", lambda x, y: x * y)
+_make("elementwise_div", lambda x, y: x / y)
+_make("elementwise_max", jnp.maximum)
+_make("elementwise_min", jnp.minimum)
+_make("elementwise_pow", jnp.power)
+_make("elementwise_mod", lambda x, y: jnp.mod(x, y))
+_make("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))

@@ -1,0 +1,204 @@
+"""Dense linear algebra + scalar math ops.
+
+Reference kernels: paddle/fluid/operators/{mul,matmul,scale,sum,cast,...}_op.*
+— each a CPU/CUDA kernel pair over cuBLAS/Eigen.  Here each op is one JAX
+lowering; matmuls hit the MXU directly and XLA fuses the surrounding
+elementwise work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.proto import DataType, dtype_to_numpy
+from ..core.registry import register_op
+from .common import data, in_desc, same_shape, set_output, wrap_lod
+
+
+def _flatten2(x, num_col_dims: int):
+    shape = x.shape
+    lead = 1
+    for d in shape[:num_col_dims]:
+        lead *= d
+    tail = 1
+    for d in shape[num_col_dims:]:
+        tail *= d
+    return jnp.reshape(x, (lead, tail))
+
+
+def _mul_infer(op, block):
+    x = in_desc(op, block, "X")
+    y = in_desc(op, block, "Y")
+    if x is None or y is None:
+        return
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    out_shape = list(x.shape[:xn]) + list(y.shape[yn:])
+    set_output(block, op, "Out", out_shape, x.dtype)
+
+
+@register_op("mul", infer_shape=_mul_infer)
+def _mul(ctx, ins, attrs):
+    """out = flatten2(X) @ flatten2(Y) (reference: operators/mul_op.cc)."""
+    x, y = data(ins["X"][0]), data(ins["Y"][0])
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten2(x, xn)
+    y2 = _flatten2(y, yn)
+    out = x2 @ y2
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [jnp.reshape(out, out_shape)]}
+
+
+def _matmul_infer(op, block):
+    x = in_desc(op, block, "X")
+    y = in_desc(op, block, "Y")
+    if x is None or y is None:
+        return
+    tx, ty = op.attr("transpose_X", False), op.attr("transpose_Y", False)
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) >= 2 and tx:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if len(ys) >= 2 and ty:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if len(xs) == 1 and len(ys) == 1:
+        out = [1]
+    elif len(xs) == 1:
+        out = ys[:-2] + ys[-1:]
+    elif len(ys) == 1:
+        out = xs[:-1]
+    else:
+        batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+        out = batch + [xs[-2], ys[-1]]
+    set_output(block, op, "Out", out, x.dtype)
+
+
+@register_op("matmul", infer_shape=_matmul_infer)
+def _matmul(ctx, ins, attrs):
+    """Batched matmul with optional transposes and scale
+    (reference: operators/matmul_op.cc)."""
+    x, y = data(ins["X"][0]), data(ins["Y"][0])
+    if attrs.get("transpose_X", False) and x.ndim >= 2:
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", False) and y.ndim >= 2:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("scale", infer_shape=same_shape())
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = data(x) * scale + bias
+    else:
+        out = (data(x) + bias) * scale
+    return {"Out": [wrap_lod(x, out)]}
+
+
+def _sum_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is not None:
+        set_output(block, op, "Out", x.shape, x.dtype, lod_level=x.lod_level)
+
+
+@register_op("sum", infer_shape=_sum_infer)
+def _sum(ctx, ins, attrs):
+    """Add N tensors (reference: operators/sum_op.cc; also the grad
+    accumulator inserted by append_backward)."""
+    xs = [data(v) for v in ins["X"] if v is not None]
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return {"Out": [wrap_lod(ins["X"][0], out)]}
+
+
+def _cast_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", x.shape, DataType(op.attr("out_dtype", int(DataType.FP32))), lod_level=x.lod_level)
+
+
+@register_op("cast", infer_shape=_cast_infer)
+def _cast(ctx, ins, attrs):
+    x = ins["X"][0]
+    np_dtype = dtype_to_numpy(DataType(attrs["out_dtype"]))
+    return {"Out": [wrap_lod(x, data(x).astype(np_dtype))]}
+
+
+@register_op("clip", infer_shape=same_shape())
+def _clip(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [wrap_lod(x, jnp.clip(data(x), attrs["min"], attrs["max"]))]}
+
+
+@register_op("clip_by_norm", infer_shape=same_shape())
+def _clip_by_norm(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale]}
+
+
+@register_op("squared_l2_norm", infer_shape=lambda op, block: set_output(block, op, "Out", [1], in_desc(op, block, "X").dtype))
+def _squared_l2_norm(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    return {"Out": [jnp.reshape(jnp.sum(x * x), (1,))]}
+
+
+@register_op("l1_norm", infer_shape=lambda op, block: set_output(block, op, "Out", [1], in_desc(op, block, "X").dtype))
+def _l1_norm(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    return {"Out": [jnp.reshape(jnp.sum(jnp.abs(x)), (1,))]}
+
+
+def _mean_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is not None:
+        set_output(block, op, "Out", [1], x.dtype)
+
+
+@register_op("mean", infer_shape=_mean_infer)
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.reshape(jnp.mean(data(ins["X"][0])), (1,))]}
+
+
+@register_op("cumsum", infer_shape=same_shape())
+def _cumsum(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+def _bilinear_infer(op, block):
+    x = in_desc(op, block, "X")
+    w = in_desc(op, block, "Weight")
+    if x is None or w is None:
+        return
+    set_output(block, op, "Out", [x.shape[0], w.shape[0]], x.dtype)
+
+
+@register_op("bilinear_tensor_product", infer_shape=_bilinear_infer)
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[b,k] = x[b,:] @ W[k] @ y[b,:] + bias
+    (reference: operators/bilinear_tensor_product_op.cc)."""
+    x, y, w = data(ins["X"][0]), data(ins["Y"][0]), data(ins["Weight"][0])
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + data(bias)
+    return {"Out": [out]}

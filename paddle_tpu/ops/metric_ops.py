@@ -1,0 +1,194 @@
+"""Metric + comparison/logical ops.
+
+Reference: paddle/fluid/operators/metrics/ (accuracy, auc,
+precision_recall), controlflow compare/logical ops, mean_iou.
+Metric state (AUC stat buffers) rides persistable vars through the graph,
+matching the reference's in-graph accumulator design.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, same_shape, set_output
+
+
+def _accuracy_infer(op, block):
+    x = in_desc(op, block, "Out")
+    if x is None:
+        return
+    set_output(block, op, "Accuracy", [1], DataType.FP32)
+    set_output(block, op, "Correct", [1], DataType.INT32)
+    set_output(block, op, "Total", [1], DataType.INT32)
+
+
+@register_op("accuracy", infer_shape=_accuracy_infer, no_grad=True)
+def _accuracy(ctx, ins, attrs):
+    """Top-k accuracy over top_k outputs (reference:
+    operators/metrics/accuracy_op.cc): Indices [N,k], Label [N,1]."""
+    idx = data(ins["Indices"][0])
+    label = data(ins["Label"][0]).reshape(-1, 1)
+    hit = jnp.any(idx == label, axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = idx.shape[0]
+    return {
+        "Accuracy": [jnp.reshape(correct.astype(jnp.float32) / total, (1,))],
+        "Correct": [jnp.reshape(correct, (1,))],
+        "Total": [jnp.full((1,), total, dtype=jnp.int32)],
+    }
+
+
+def _auc_infer(op, block):
+    set_output(block, op, "AUC", [1], DataType.FP64)
+    stat_pos = in_desc(op, block, "StatPos")
+    if stat_pos is not None:
+        set_output(block, op, "StatPosOut", stat_pos.shape, stat_pos.dtype)
+        neg = in_desc(op, block, "StatNeg")
+        set_output(block, op, "StatNegOut", neg.shape, neg.dtype)
+
+
+@register_op("auc", infer_shape=_auc_infer, no_grad=True, stateful=True)
+def _auc(ctx, ins, attrs):
+    """Streaming ROC-AUC with histogram stat buffers (reference:
+    operators/metrics/auc_op.cc)."""
+    preds = data(ins["Predict"][0])
+    label = data(ins["Label"][0]).reshape(-1)
+    stat_pos = data(ins["StatPos"][0]).astype(jnp.float32)
+    stat_neg = data(ins["StatNeg"][0]).astype(jnp.float32)
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_score = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
+    bucket = jnp.clip(
+        (pos_score * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(jnp.float32)
+    stat_pos = stat_pos + jnp.zeros_like(stat_pos).at[bucket].add(is_pos)
+    stat_neg = stat_neg + jnp.zeros_like(stat_neg).at[bucket].add(1.0 - is_pos)
+    # integrate trapezoid over descending thresholds
+    pos_cum = jnp.cumsum(stat_pos[::-1])
+    neg_cum = jnp.cumsum(stat_neg[::-1])
+    tot_pos = pos_cum[-1]
+    tot_neg = neg_cum[-1]
+    tpr = pos_cum / jnp.maximum(tot_pos, 1.0)
+    fpr = neg_cum / jnp.maximum(tot_neg, 1.0)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {
+        "AUC": [jnp.reshape(auc, (1,))],
+        "StatPosOut": [stat_pos.astype(jnp.int64)],
+        "StatNegOut": [stat_neg.astype(jnp.int64)],
+    }
+
+
+def _mean_iou_infer(op, block):
+    set_output(block, op, "OutMeanIou", [1], DataType.FP32)
+    x = in_desc(op, block, "Predictions")
+    n = op.attr("num_classes", 2)
+    set_output(block, op, "OutWrong", [n], DataType.INT32)
+    set_output(block, op, "OutCorrect", [n], DataType.INT32)
+
+
+@register_op("mean_iou", infer_shape=_mean_iou_infer, no_grad=True)
+def _mean_iou(ctx, ins, attrs):
+    pred = data(ins["Predictions"][0]).reshape(-1)
+    label = data(ins["Labels"][0]).reshape(-1)
+    n = attrs["num_classes"]
+    correct = jnp.zeros((n,), jnp.int32).at[jnp.where(pred == label, pred, n - 1)].add(
+        (pred == label).astype(jnp.int32)
+    )
+    wrong_pred = jnp.zeros((n,), jnp.int32).at[pred].add((pred != label).astype(jnp.int32))
+    wrong_lab = jnp.zeros((n,), jnp.int32).at[label].add((pred != label).astype(jnp.int32))
+    denom = correct + wrong_pred + wrong_lab
+    iou = jnp.where(denom > 0, correct / jnp.maximum(denom, 1), 0.0)
+    valid = (denom > 0).astype(jnp.float32)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {
+        "OutMeanIou": [jnp.reshape(mean_iou, (1,))],
+        "OutWrong": [wrong_pred + wrong_lab],
+        "OutCorrect": [correct],
+    }
+
+
+# -- comparisons / logicals (reference: operators/controlflow/compare_op.cc,
+#    logical_op.cc) ----------------------------------------------------------
+def _cmp_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", x.shape, DataType.BOOL)
+
+
+def _make_cmp(name, fn):
+    @register_op(name, infer_shape=_cmp_infer, no_grad=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(data(ins["X"][0]), data(ins["Y"][0]))]}
+
+
+_make_cmp("less_than", lambda x, y: x < y)
+_make_cmp("less_equal", lambda x, y: x <= y)
+_make_cmp("greater_than", lambda x, y: x > y)
+_make_cmp("greater_equal", lambda x, y: x >= y)
+_make_cmp("equal", lambda x, y: x == y)
+_make_cmp("not_equal", lambda x, y: x != y)
+_make_cmp("logical_and", jnp.logical_and)
+_make_cmp("logical_or", jnp.logical_or)
+_make_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", infer_shape=_cmp_infer, no_grad=True)
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(data(ins["X"][0]))]}
+
+
+def _edit_distance_infer(op, block):
+    set_output(block, op, "Out", [-1, 1], DataType.FP32)
+    set_output(block, op, "SequenceNum", [1], DataType.INT64)
+
+
+@register_op("edit_distance", infer_shape=_edit_distance_infer, no_grad=True)
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between hypothesis and reference sequences
+    (reference: operators/edit_distance_op.cc), vectorized DP over LoD pairs."""
+    from ..core.lod import LoDValue
+
+    hyp = ins["Hyps"][0]
+    ref = ins["Refs"][0]
+    if not isinstance(hyp, LoDValue) or not isinstance(ref, LoDValue):
+        raise ValueError("edit_distance expects LoD sequence inputs")
+    h, hl = hyp.data, hyp.lengths
+    r, rl = ref.data, ref.lengths
+    n = h.shape[0]
+
+    def per_pair(hrow, hlen, rrow, rlen):
+        max_h, max_r = hrow.shape[0], rrow.shape[0]
+        row0 = jnp.arange(max_r + 1, dtype=jnp.float32)
+
+        def step(prev, i):
+            cost_base = jnp.where(i < hlen, 1.0, 0.0)
+
+            def inner(carry, j):
+                left = carry
+                sub = prev[j] + jnp.where(
+                    (hrow[i] == rrow[j]) | (j >= rlen) | (i >= hlen), 0.0, 1.0
+                )
+                ins_c = left + jnp.where(j < rlen, cost_base, 0.0)
+                del_c = prev[j + 1] + cost_base
+                val = jnp.minimum(jnp.minimum(sub, ins_c), del_c)
+                return val, val
+
+            first = prev[0] + cost_base
+            _, rest = jax.lax.scan(inner, first, jnp.arange(max_r))
+            return jnp.concatenate([first[None], rest]), None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(max_h))
+        return final[rlen]
+
+    dists = jax.vmap(per_pair)(h, hl, r, rl)
+    if attrs.get("normalized", False):
+        dists = dists / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return {
+        "Out": [dists.reshape(-1, 1)],
+        "SequenceNum": [jnp.full((1,), n, dtype=jnp.int32)],
+    }

@@ -1,0 +1,516 @@
+"""Neural-net ops: convolution, pooling, normalization, softmax, dropout.
+
+Reference kernels: paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,
+group_norm,lrn}_op.* with cuDNN/MKLDNN variants.  On TPU the cuDNN layer has
+no equivalent: convs lower to lax.conv_general_dilated (MXU), everything
+else to fusible jnp — XLA owns algorithm choice and fusion.
+Layout is NCHW to match the reference's default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, same_shape, set_output, wrap_lod
+
+
+# -- conv --------------------------------------------------------------------
+def _conv_out_dim(size, k, pad, stride, dilation):
+    if size < 0:
+        return -1
+    eff = dilation * (k - 1) + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+def _conv2d_infer(op, block):
+    x = in_desc(op, block, "Input")
+    f = in_desc(op, block, "Filter")
+    if x is None or f is None:
+        return
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0])
+    dilations = op.attr("dilations", [1, 1])
+    n, _, h, w = x.shape
+    oc, _, kh, kw = f.shape
+    set_output(
+        block, op, "Output",
+        [n, oc,
+         _conv_out_dim(h, kh, paddings[0], strides[0], dilations[0]),
+         _conv_out_dim(w, kw, paddings[1], strides[1], dilations[1])],
+        x.dtype,
+    )
+
+
+def _conv2d_lower(ctx, ins, attrs):
+    x = data(ins["Input"][0])
+    f = data(ins["Filter"][0])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, f,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+register_op("conv2d", infer_shape=_conv2d_infer, diff_inputs=["Input", "Filter"])(_conv2d_lower)
+
+
+def _depthwise_infer(op, block):
+    _conv2d_infer(op, block)
+
+
+@register_op("depthwise_conv2d", infer_shape=_depthwise_infer, diff_inputs=["Input", "Filter"])
+def _depthwise_conv2d(ctx, ins, attrs):
+    """Reference: operators/conv_op.cc depthwise registration — groups equals
+    input channels; filter is [C*mult, 1, kh, kw]."""
+    x = data(ins["Input"][0])
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return _conv2d_lower(ctx, ins, attrs)
+
+
+def _conv2d_transpose_infer(op, block):
+    x = in_desc(op, block, "Input")
+    f = in_desc(op, block, "Filter")
+    if x is None or f is None:
+        return
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0])
+    dilations = op.attr("dilations", [1, 1])
+    n, _, h, w = x.shape
+    _, oc_per_g, kh, kw = f.shape
+    groups = op.attr("groups", 1) or 1
+
+    def out_dim(size, k, pad, stride, dil):
+        if size < 0:
+            return -1
+        return (size - 1) * stride - 2 * pad + dil * (k - 1) + 1
+
+    set_output(
+        block, op, "Output",
+        [n, oc_per_g * groups,
+         out_dim(h, kh, paddings[0], strides[0], dilations[0]),
+         out_dim(w, kw, paddings[1], strides[1], dilations[1])],
+        x.dtype,
+    )
+
+
+@register_op("conv2d_transpose", infer_shape=_conv2d_transpose_infer, diff_inputs=["Input", "Filter"])
+def _conv2d_transpose(ctx, ins, attrs):
+    """Gradient-of-conv as a forward op (reference:
+    operators/conv_transpose_op.cc).  Filter layout [in_c, out_c/g, kh, kw]."""
+    x = data(ins["Input"][0])
+    f = data(ins["Filter"][0])
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+
+    def one_group(xg, fg):
+        return jax.lax.conv_transpose(
+            xg, fg,
+            strides=strides,
+            padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        return {"Output": [one_group(x, f)]}
+    xs = jnp.split(x, groups, axis=1)
+    fs = jnp.split(f, groups, axis=0)
+    out = jnp.concatenate([one_group(xg, fg) for xg, fg in zip(xs, fs)], axis=1)
+    return {"Output": [out]}
+
+
+def _conv3d_infer(op, block):
+    x = in_desc(op, block, "Input")
+    f = in_desc(op, block, "Filter")
+    if x is None or f is None:
+        return
+    strides = op.attr("strides", [1, 1, 1])
+    paddings = op.attr("paddings", [0, 0, 0])
+    dilations = op.attr("dilations", [1, 1, 1])
+    n = x.shape[0]
+    oc = f.shape[0]
+    dims = [
+        _conv_out_dim(x.shape[i + 2], f.shape[i + 2], paddings[i], strides[i], dilations[i])
+        for i in range(3)
+    ]
+    set_output(block, op, "Output", [n, oc] + dims, x.dtype)
+
+
+@register_op("conv3d", infer_shape=_conv3d_infer, diff_inputs=["Input", "Filter"])
+def _conv3d(ctx, ins, attrs):
+    x = data(ins["Input"][0])
+    f = data(ins["Filter"][0])
+    strides = attrs.get("strides", [1, 1, 1])
+    paddings = attrs.get("paddings", [0, 0, 0])
+    dilations = attrs.get("dilations", [1, 1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, f,
+        window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+    )
+    return {"Output": [out]}
+
+
+# -- pooling -----------------------------------------------------------------
+def _pool_out_dim(size, k, pad, stride, ceil_mode):
+    if size < 0:
+        return -1
+    num = size + 2 * pad - k
+    if ceil_mode:
+        return -(-num // stride) + 1
+    return num // stride + 1
+
+
+def _pool2d_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    n, c, h, w = x.shape
+    if op.attr("global_pooling", False):
+        set_output(block, op, "Out", [n, c, 1, 1], x.dtype)
+        return
+    k = op.attr("ksize", [1, 1])
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    cm = op.attr("ceil_mode", False)
+    set_output(
+        block, op, "Out",
+        [n, c, _pool_out_dim(h, k[0], p[0], s[0], cm), _pool_out_dim(w, k[1], p[1], s[1], cm)],
+        x.dtype,
+    )
+
+
+def _pool(x, ksize, strides, paddings, pooling_type, exclusive, ceil_mode, spatial):
+    """Shared reduce_window pooling for 2d/3d."""
+    rank = x.ndim
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + (s - 1 if ceil_mode else 0)) for p, s in zip(paddings, strides)
+    )
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pads)
+    # avg pooling
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, pads)
+    if exclusive:
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_full, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    denom = 1.0
+    for k in ksize:
+        denom *= k
+    return summed / denom
+
+
+@register_op("pool2d", infer_shape=_pool2d_infer)
+def _pool2d(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    if attrs.get("global_pooling", False):
+        if attrs.get("pooling_type", "max") == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        return {"Out": [out]}
+    out = _pool(
+        x, attrs.get("ksize", [1, 1]), attrs.get("strides", [1, 1]),
+        attrs.get("paddings", [0, 0]), attrs.get("pooling_type", "max"),
+        attrs.get("exclusive", True), attrs.get("ceil_mode", False), 2,
+    )
+    return {"Out": [out]}
+
+
+def _pool3d_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    n, c = x.shape[:2]
+    if op.attr("global_pooling", False):
+        set_output(block, op, "Out", [n, c, 1, 1, 1], x.dtype)
+        return
+    k = op.attr("ksize", [1, 1, 1])
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    cm = op.attr("ceil_mode", False)
+    dims = [_pool_out_dim(x.shape[i + 2], k[i], p[i], s[i], cm) for i in range(3)]
+    set_output(block, op, "Out", [n, c] + dims, x.dtype)
+
+
+@register_op("pool3d", infer_shape=_pool3d_infer)
+def _pool3d(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if attrs.get("pooling_type", "max") == "max" else jnp.mean
+        return {"Out": [fn(x, axis=(2, 3, 4), keepdims=True)]}
+    out = _pool(
+        x, attrs.get("ksize", [1, 1, 1]), attrs.get("strides", [1, 1, 1]),
+        attrs.get("paddings", [0, 0, 0]), attrs.get("pooling_type", "max"),
+        attrs.get("exclusive", True), attrs.get("ceil_mode", False), 3,
+    )
+    return {"Out": [out]}
+
+
+@register_op("maxout", infer_shape=lambda op, block: set_output(block, op, "Out", [in_desc(op, block, "X").shape[0], in_desc(op, block, "X").shape[1] // op.attr("groups", 1)] + list(in_desc(op, block, "X").shape[2:]), in_desc(op, block, "X").dtype))
+def _maxout(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    g = attrs["groups"]
+    n, c = x.shape[:2]
+    out = jnp.max(jnp.reshape(x, (n, c // g, g) + x.shape[2:]), axis=2)
+    return {"Out": [out]}
+
+
+# -- normalization -----------------------------------------------------------
+def _batch_norm_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Y", x.shape, x.dtype)
+    c = x.shape[1] if op.attr("data_layout", "NCHW") == "NCHW" else x.shape[-1]
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        set_output(block, op, slot, [c], x.dtype)
+
+
+@register_op(
+    "batch_norm",
+    infer_shape=_batch_norm_infer,
+    diff_inputs=["X", "Scale", "Bias"],
+)
+def _batch_norm(ctx, ins, attrs):
+    """Reference: operators/batch_norm_op.cc.  Train mode normalizes with
+    batch statistics and emits updated moving stats (MeanOut/VarianceOut
+    alias the Mean/Variance state vars); test mode uses the moving stats."""
+    x = data(ins["X"][0])
+    scale = data(ins["Scale"][0])
+    bias = data(ins["Bias"][0])
+    mean = data(ins["Mean"][0])
+    var = data(ins["Variance"][0])
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    if is_test or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1.0 - momentum) * use_mean
+        new_var = momentum * var + (1.0 - momentum) * use_var
+        saved_mean, saved_var = use_mean, use_var
+
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [new_mean],
+        "VarianceOut": [new_var],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [inv],
+    }
+
+
+def _layer_norm_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Y", x.shape, x.dtype)
+    begin = op.attr("begin_norm_axis", 1)
+    lead = 1
+    ok = all(d >= 0 for d in x.shape[:begin])
+    for d in x.shape[:begin]:
+        lead *= d
+    set_output(block, op, "Mean", [lead if ok else -1], x.dtype)
+    set_output(block, op, "Variance", [lead if ok else -1], x.dtype)
+
+
+@register_op("layer_norm", infer_shape=_layer_norm_infer, diff_inputs=["X", "Scale", "Bias"])
+def _layer_norm(ctx, ins, attrs):
+    """Reference: operators/layer_norm_op.cc — normalize over dims >=
+    begin_norm_axis."""
+    x = data(ins["X"][0])
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    scale = ins.get("Scale", [None])[0]
+    bias = ins.get("Bias", [None])[0]
+    tail_shape = (1,) * begin + x.shape[begin:]
+    if scale is not None:
+        y = y * jnp.reshape(data(scale), tail_shape)
+    if bias is not None:
+        y = y + jnp.reshape(data(bias), tail_shape)
+    return {
+        "Y": [y],
+        "Mean": [jnp.reshape(mean, (-1,))],
+        "Variance": [jnp.reshape(var, (-1,))],
+    }
+
+
+def _group_norm_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Y", x.shape, x.dtype)
+    n, g = x.shape[0], op.attr("groups", 1)
+    set_output(block, op, "Mean", [n, g], x.dtype)
+    set_output(block, op, "Variance", [n, g], x.dtype)
+
+
+@register_op("group_norm", infer_shape=_group_norm_infer, diff_inputs=["X", "Scale", "Bias"])
+def _group_norm(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = jnp.reshape(x, (n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = jnp.reshape((xg - mean) * jax.lax.rsqrt(var + eps), x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    scale = ins.get("Scale", [None])[0]
+    bias = ins.get("Bias", [None])[0]
+    if scale is not None:
+        y = y * jnp.reshape(data(scale), bshape)
+    if bias is not None:
+        y = y + jnp.reshape(data(bias), bshape)
+    return {
+        "Y": [y],
+        "Mean": [jnp.reshape(mean, (n, g))],
+        "Variance": [jnp.reshape(var, (n, g))],
+    }
+
+
+def _norm_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", x.shape, x.dtype)
+    axis = op.attr("axis", -1)
+    rank = len(x.shape)
+    axis = axis + rank if axis < 0 else axis
+    shape = [1 if i == axis else d for i, d in enumerate(x.shape)]
+    set_output(block, op, "Norm", shape, x.dtype)
+
+
+@register_op("norm", infer_shape=_norm_infer, diff_inputs=["X"])
+def _norm(ctx, ins, attrs):
+    """L2-normalize along axis (reference: operators/norm_op.cc)."""
+    x = data(ins["X"][0])
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("lrn", infer_shape=same_shape())
+def _lrn(ctx, ins, attrs):
+    """Local response norm over channels (reference: operators/lrn_op.cc)."""
+    x = data(ins["X"][0])
+    n_size = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = x * x
+    half = n_size // 2
+    pads = [(0, 0), (half, n_size - 1 - half), (0, 0), (0, 0)]
+    summed = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, n_size, 1, 1), (1, 1, 1, 1), pads
+    )
+    return {"Out": [x / jnp.power(k + alpha * summed, beta)]}
+
+
+# -- softmax / dropout -------------------------------------------------------
+@register_op("softmax", infer_shape=same_shape())
+def _softmax(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [wrap_lod(x, jax.nn.softmax(data(x), axis=attrs.get("axis", -1)))]}
+
+
+def _dropout_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", x.shape, x.dtype, lod_level=x.lod_level)
+    set_output(block, op, "Mask", x.shape, DataType.UINT8)
+
+
+@register_op("dropout", infer_shape=_dropout_infer, diff_inputs=["X"], random=True)
+def _dropout(ctx, ins, attrs):
+    """Reference: operators/dropout_op.cc.  Implementations:
+    downgrade_in_infer (default; train keeps scale, infer multiplies by 1-p)
+    and upscale_in_train (train scales by 1/(1-p), infer is identity)."""
+    x = ins["X"][0]
+    xv = data(x)
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test:
+        out = xv if impl == "upscale_in_train" else xv * (1.0 - p)
+        return {"Out": [wrap_lod(x, out)], "Mask": [jnp.ones_like(xv, dtype=jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, np.shape(xv))
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, xv / max(1.0 - p, 1e-8), 0.0)
+    else:
+        out = jnp.where(keep, xv, 0.0)
+    return {"Out": [wrap_lod(x, out)], "Mask": [keep.astype(jnp.uint8)]}
+
+
+# -- interpolation -----------------------------------------------------------
+def _interp_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    set_output(block, op, "Out", [x.shape[0], x.shape[1], oh, ow], x.dtype)
+
+
+def _interp(ctx, ins, attrs, method):
+    x = data(ins["X"][0])
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    out_size = ins.get("OutSize", [None])[0]
+    if out_size is not None:
+        sz = np.asarray(out_size).reshape(-1)
+        oh, ow = int(sz[0]), int(sz[1])
+    n, c = x.shape[:2]
+    out = jax.image.resize(x, (n, c, oh, ow), method=method)
+    return {"Out": [out]}
+
+
+@register_op("bilinear_interp", infer_shape=_interp_infer, diff_inputs=["X"])
+def _bilinear_interp(ctx, ins, attrs):
+    return _interp(ctx, ins, attrs, "bilinear")
+
+
+@register_op("nearest_interp", infer_shape=_interp_infer, diff_inputs=["X"])
+def _nearest_interp(ctx, ins, attrs):
+    return _interp(ctx, ins, attrs, "nearest")

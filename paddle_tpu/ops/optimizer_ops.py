@@ -1,0 +1,232 @@
+"""Optimizer ops — parameter updates as ops *in the graph*, matching the
+reference's design (paddle/fluid/operators/optimizers/): sgd, momentum,
+lars_momentum, adam, adamax, adagrad, decayed_adagrad, proximal_adagrad,
+proximal_gd, adadelta, rmsprop, ftrl.
+
+Each op reads Param/Grad/accumulators and writes *Out slots whose var names
+alias the inputs; the compiler's env-by-name semantics plus XLA buffer
+donation reproduce the reference's in-place Scope updates without mutation.
+All are no_grad + stateful.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data, in_desc, set_output
+
+
+def _param_out_infer(op, block):
+    p = in_desc(op, block, "Param")
+    if p is None:
+        return
+    for slot in op.outputs:
+        ref = in_desc(op, block, slot.replace("Out", "")) or p
+        set_output(block, op, slot, ref.shape, ref.dtype)
+
+
+def _opt(name):
+    return register_op(name, infer_shape=_param_out_infer, no_grad=True, stateful=True)
+
+
+def _lr(ins):
+    return jnp.reshape(data(ins["LearningRate"][0]), ())
+
+
+@_opt("sgd")
+def _sgd(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    return {"ParamOut": [p - _lr(ins) * g]}
+
+
+@_opt("momentum")
+def _momentum(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    v = data(ins["Velocity"][0])
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@_opt("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    """Layer-wise adaptive rate scaling (reference:
+    operators/optimizers/lars_momentum_op.cc)."""
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    v = data(ins["Velocity"][0])
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 1e-3)
+    decay = attrs.get("lars_weight_decay", 5e-4)
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-12)
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@_opt("adam")
+def _adam(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    m = data(ins["Moment1"][0])
+    v = data(ins["Moment2"][0])
+    b1p = data(ins["Beta1Pow"][0])
+    b2p = data(ins["Beta2Pow"][0])
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - jnp.reshape(b2p, ())) / (1 - jnp.reshape(b1p, ()))
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {
+        "ParamOut": [p_new],
+        "Moment1Out": [m_new],
+        "Moment2Out": [v_new],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@_opt("adamax")
+def _adamax(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    m = data(ins["Moment"][0])
+    u = data(ins["InfNorm"][0])
+    b1p = data(ins["Beta1Pow"][0])
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = p - (lr / (1 - jnp.reshape(b1p, ()))) * m_new / (u_new + eps)
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [u_new]}
+
+
+@_opt("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    m = data(ins["Moment"][0])
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(ins)
+    m_new = m + g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)], "MomentOut": [m_new]}
+
+
+@_opt("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    m = data(ins["Moment"][0])
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(ins)
+    m_new = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)], "MomentOut": [m_new]}
+
+
+@_opt("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    m = data(ins["Moment"][0])
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    m_new = m + g * g
+    lr_t = lr / jnp.sqrt(m_new)
+    prox = p - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
+    return {"ParamOut": [p_new], "MomentOut": [m_new]}
+
+
+@_opt("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": [p_new]}
+
+
+@_opt("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    avg_sq_grad = data(ins["AvgSquaredGrad"][0])
+    avg_sq_update = data(ins["AvgSquaredUpdate"][0])
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg_new = rho * avg_sq_grad + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_update + eps) / (asg_new + eps)) * g
+    asu_new = rho * avg_sq_update + (1 - rho) * update * update
+    return {
+        "ParamOut": [p + update],
+        "AvgSquaredGradOut": [asg_new],
+        "AvgSquaredUpdateOut": [asu_new],
+    }
+
+
+@_opt("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    ms = data(ins["MeanSquare"][0])
+    mom = data(ins["Moment"][0])
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-10)
+    momentum = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    ms_new = rho * ms + (1 - rho) * g * g
+    if attrs.get("centered", False):
+        mg = data(ins["MeanGrad"][0])
+        mg_new = rho * mg + (1 - rho) * g
+        mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        return {
+            "ParamOut": [p - mom_new],
+            "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new],
+            "MeanGradOut": [mg_new],
+        }
+    mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new], "MomentOut": [mom_new]}
+
+
+@_opt("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p = data(ins["Param"][0])
+    g = data(ins["Grad"][0])
+    sq = data(ins["SquaredAccumulator"][0])
+    lin = data(ins["LinearAccumulator"][0])
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    sq_new = sq + g * g
+    sigma = (jnp.power(sq_new, -power) - jnp.power(sq, -power)) / lr
+    lin_new = lin + g - sigma * p
+    quad = jnp.power(sq_new, -power) / lr + 2 * l2
+    pre = jnp.sign(lin_new) * l1 - lin_new
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre / quad, jnp.zeros_like(p))
+    return {
+        "ParamOut": [p_new],
+        "SquaredAccumOut": [sq_new],
+        "LinearAccumOut": [lin_new],
+    }

@@ -1,0 +1,121 @@
+"""Reduction / sorting / arg ops.
+
+Reference: paddle/fluid/operators/reduce_ops/ (REGISTER_REDUCE_OP macro),
+arg_max/arg_min, argsort, top_k.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, set_output
+
+
+def _reduce_infer_factory():
+    def infer(op, block):
+        x = in_desc(op, block, "X")
+        if x is None:
+            return
+        dims = op.attr("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        keep = op.attr("keep_dim", False)
+        if op.attr("reduce_all", False):
+            shape = [1] * len(x.shape) if keep else [1]
+        else:
+            rank = len(x.shape)
+            dims = [d + rank if d < 0 else d for d in dims]
+            if keep:
+                shape = [1 if i in dims else d for i, d in enumerate(x.shape)]
+            else:
+                shape = [d for i, d in enumerate(x.shape) if i not in dims]
+                shape = shape or [1]
+        set_output(block, op, "Out", shape, x.dtype)
+
+    return infer
+
+
+def _make_reduce(name, fn):
+    @register_op(name, infer_shape=_reduce_infer_factory())
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = data(ins["X"][0])
+        dims = attrs.get("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        axis = None if attrs.get("reduce_all", False) else tuple(dims)
+        out = _fn(x, axis=axis, keepdims=attrs.get("keep_dim", False))
+        if out.ndim == 0:
+            out = jnp.reshape(out, (1,))
+        return {"Out": [out]}
+
+    return _lower
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_prod", jnp.prod)
+_make_reduce("reduce_all", lambda x, axis, keepdims: jnp.all(x, axis=axis, keepdims=keepdims))
+_make_reduce("reduce_any", lambda x, axis, keepdims: jnp.any(x, axis=axis, keepdims=keepdims))
+
+
+def _arg_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    axis = op.attr("axis", -1)
+    rank = len(x.shape)
+    axis = axis + rank if axis < 0 else axis
+    shape = [d for i, d in enumerate(x.shape) if i != axis] or [1]
+    set_output(block, op, "Out", shape, DataType.INT64)
+
+
+@register_op("arg_max", infer_shape=_arg_infer, no_grad=True)
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(data(ins["X"][0]), axis=attrs.get("axis", -1))]}
+
+
+@register_op("arg_min", infer_shape=_arg_infer, no_grad=True)
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(data(ins["X"][0]), axis=attrs.get("axis", -1))]}
+
+
+def _argsort_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", x.shape, x.dtype)
+    set_output(block, op, "Indices", x.shape, DataType.INT64)
+
+
+@register_op("argsort", infer_shape=_argsort_infer, no_grad=True)
+def _argsort(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)], "Indices": [idx]}
+
+
+def _topk_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    k = op.attr("k", 1)
+    shape = list(x.shape[:-1]) + [k]
+    set_output(block, op, "Out", shape, x.dtype)
+    set_output(block, op, "Indices", shape, DataType.INT64)
+
+
+@register_op("top_k", infer_shape=_topk_infer, diff_inputs=[])
+def _top_k(ctx, ins, attrs):
+    """Reference: operators/top_k_op.cc — values+indices along the last dim."""
+    x = data(ins["X"][0])
+    vals, idx = jax.lax.top_k(x, attrs.get("k", 1))
+    # declared INT64; with jax x64 disabled this materializes as int32 and
+    # the executor casts back to int64 at fetch time
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}

@@ -1,0 +1,69 @@
+"""Parameter attributes (reference: python/paddle/fluid/param_attr.py).
+
+TPU-native addition: `sharding` — a per-dim tuple of mesh-axis names (or
+None) consumed by ParallelExecutor/pjit for tensor-parallel layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer=None,
+        learning_rate: float = 1.0,
+        regularizer=None,
+        trainable: bool = True,
+        gradient_clip=None,
+        do_model_average: bool = False,
+        sharding: Optional[Sequence[Any]] = None,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+        self.do_model_average = do_model_average
+        self.sharding = list(sharding) if sharding is not None else None
+
+    @staticmethod
+    def _to_attr(arg) -> Optional["ParamAttr"]:
+        """Normalize user input: None/False/str/Initializer/ParamAttr
+        (reference: param_attr.py ParamAttr._to_attr)."""
+        if arg is None:
+            return ParamAttr()
+        if arg is False:
+            return None
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, (list, tuple)) and all(isinstance(a, ParamAttr) for a in arg):
+            return list(arg)
+        # assume an Initializer instance
+        return ParamAttr(initializer=arg)
+
+    def _to_kwargs(self, with_initializer: bool = False):
+        kwargs = {
+            "name": self.name,
+            "optimize_attr": {"learning_rate": self.learning_rate},
+            "regularizer": self.regularizer,
+            "trainable": self.trainable,
+            "gradient_clip_attr": self.gradient_clip,
+            "do_model_average": self.do_model_average,
+        }
+        if with_initializer:
+            kwargs["initializer"] = self.initializer
+        return kwargs
+
+
+class WeightNormParamAttr(ParamAttr):
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports, so sharding tests exercise a multi-chip mesh without TPU hardware
+(mirrors the reference's strategy of testing multi-device graphs on CPU
+places, e.g. broadcast_op_handle_test.cc)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test a fresh default main/startup program and scope."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core import scope as scope_mod
+
+    prev_main = framework.switch_main_program(fluid.Program())
+    prev_startup = framework.switch_startup_program(fluid.Program())
+    prev_scope = scope_mod._current_scope
+    scope_mod._current_scope = scope_mod.Scope()
+    yield
+    framework.switch_main_program(prev_main)
+    framework.switch_startup_program(prev_startup)
+    scope_mod._current_scope = prev_scope

@@ -1,0 +1,91 @@
+"""Executor end-to-end: lowering, feeds/fetches, persistable state, RNG.
+(reference analogue: book tests + executor tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fill_and_fetch():
+    out = fluid.layers.fill_constant([2, 3], "float32", 7.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(fetch_list=[out])
+    np.testing.assert_allclose(res, np.full((2, 3), 7.0, np.float32))
+
+
+def test_feed_forward_fc():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    (res,) = exe.run(feed={"x": xv}, fetch_list=[y])
+    assert res.shape == (5, 3)
+
+
+def test_startup_program_initializes_params():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    fluid.layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="fcw"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w = fluid.global_scope().find_var("fcw")
+    assert w is not None and np.asarray(w).shape == (4, 3)
+
+
+def test_uninitialized_param_raises():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(RuntimeError, match="not initialized"):
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)}, fetch_list=[y])
+
+
+def test_sgd_training_step_decreases_loss():
+    np.random.seed(0)
+    x = fluid.layers.data("x", [4], dtype="float32")
+    label = fluid.layers.data("label", [1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    sgd = fluid.optimizer.SGD(learning_rate=0.05)
+    sgd.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(16, 4).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32) + 0.3).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, losses[:3] + losses[-3:]
+
+
+def test_rng_stream_advances_between_runs():
+    out = fluid.layers.ops.uniform_random([4], min=0.0, max=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (a,) = exe.run(fetch_list=[out])
+    (b,) = exe.run(fetch_list=[out])
+    assert not np.allclose(a, b)
+
+
+def test_dropout_train_vs_test():
+    x = fluid.layers.data("x", [100], dtype="float32")
+    out = fluid.layers.dropout(x, dropout_prob=0.5)
+    prog = fluid.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 100), np.float32)
+    (train_out,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    assert (train_out == 0).any()
+    (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(test_out, xv * 0.5, rtol=1e-6)
+
+
+def test_fetch_param_value():
+    w = fluid.layers.create_parameter([3], "float32", name="pw",
+                                      default_initializer=fluid.initializer.Constant(2.0))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(fetch_list=["pw"])
+    np.testing.assert_allclose(res, [2.0, 2.0, 2.0])

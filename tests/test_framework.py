@@ -1,0 +1,84 @@
+"""Program/Block/Operator construction + shape inference + serde
+(reference analogue: framework unit tests like op_registry_test.cc and
+program-text assertions in test_dist_transpiler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.proto import DataType, ProgramDesc
+
+
+def test_program_build_and_infer_shapes():
+    img = fluid.layers.data("img", [784], dtype="float32")
+    hidden = fluid.layers.fc(img, size=128, act="relu")
+    pred = fluid.layers.fc(hidden, size=10, act="softmax")
+    assert tuple(hidden.shape) == (-1, 128)
+    assert tuple(pred.shape) == (-1, 10)
+    prog = fluid.default_main_program()
+    types = [op.type for op in prog.global_block().ops]
+    assert types == ["mul", "elementwise_add", "relu", "mul", "elementwise_add", "softmax"]
+    # params live in the global block and are persistable
+    params = prog.global_block().all_parameters()
+    assert len(params) == 4
+    assert all(p.persistable for p in params)
+
+
+def test_program_serde_roundtrip():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=3)
+    prog = fluid.default_main_program()
+    data = prog.desc.serialize_to_string()
+    clone = ProgramDesc.parse_from_string(data)
+    assert clone.num_blocks() == prog.desc.num_blocks()
+    assert [o.type for o in clone.block(0).ops] == [o.type for o in prog.desc.block(0).ops]
+    assert clone.block(0).vars[y.name].shape == list(y.shape)
+
+
+def test_program_clone_for_test_flips_dropout():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    d = fluid.layers.dropout(x, dropout_prob=0.5)
+    prog = fluid.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    drop_ops = [op for op in test_prog.desc.block(0).ops if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attrs["is_test"] is True
+    # original untouched
+    assert not prog.desc.block(0).ops[-1].attrs.get("is_test", False)
+
+
+def test_append_backward_creates_grad_ops():
+    x = fluid.layers.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(y)
+    params_grads = fluid.append_backward(loss)
+    assert len(params_grads) == 2  # weight + bias
+    prog = fluid.default_main_program()
+    types = [op.type for op in prog.desc.block(0).ops]
+    assert "mean_grad" in types
+    assert "mul_grad" in types
+    assert "elementwise_add_grad" in types
+    # grad vars exist with forward shapes
+    for p, g in params_grads:
+        assert tuple(g.shape) == tuple(p.shape)
+
+
+def test_grad_dedup_inserts_sum():
+    # x used by two branches -> d(x) produced twice -> sum op expected
+    x = fluid.layers.data("x", [4], dtype="float32", stop_gradient=False)
+    w = fluid.layers.create_parameter([4, 4], "float32", name="w")
+    h = fluid.layers.mul(x, w)
+    out = fluid.layers.elementwise_add(h, h)
+    loss = fluid.layers.mean(out)
+    fluid.append_backward(loss)
+    types = [op.type for op in fluid.default_main_program().desc.block(0).ops]
+    assert "sum" in types
+
+
+def test_unregistered_op_raises():
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var(name="z", shape=[1], dtype="float32")
+    block.append_op(type="bogus_op_name", outputs={"Out": ["z"]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(NotImplementedError):
+        exe.run(prog, fetch_list=["z"])
