@@ -43,6 +43,9 @@ from .core.backward import append_backward, calc_gradient  # noqa: F401
 from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
 
 from . import clip  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401
+from .parallel.executor import CompiledProgram  # noqa: F401
 from . import initializer  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -74,6 +74,59 @@ def _read_before_write(program: Program, state_names: Sequence[str], feed_names)
     return rbw
 
 
+class _RunPlan:
+    """Per-(program, feeds, fetches) run bookkeeping shared by the serial
+    Executor and ParallelExecutor, computed once and cached beside the
+    CompiledBlock: which persistable state threads through the step, and
+    which of it must already exist in the scope."""
+
+    def __init__(self, program: Program, feed_names, fetch_names):
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.state_names = _block_state_names(program, extra=fetch_names)
+        self.rbw = _read_before_write(program, self.state_names, self.feed_names)
+
+    def feed_values(self, feed, block0):
+        return tuple(
+            _as_feed_value(feed[n], block0.vars.get(n)) for n in self.feed_names
+        )
+
+    def state_values(self, scope: Scope, block0):
+        vals = []
+        for n in self.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if n in self.rbw:
+                    raise RuntimeError(
+                        f"persistable variable '{n}' is read before it is "
+                        "written but is not initialized in the scope; run the "
+                        "startup program first"
+                    )
+                vd = block0.vars[n]
+                shape = [d if d >= 0 else 1 for d in vd.shape] or [1]
+                v = np.zeros(shape, dtype=dtype_to_numpy(vd.dtype))
+            vals.append(v)
+        return tuple(vals)
+
+    def rng_value(self, scope: Scope, program: Program):
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(program.random_seed or 0)
+        return rng
+
+    def write_back(self, scope: Scope, new_states, new_rng) -> None:
+        for n, v in zip(self.state_names, new_states):
+            if v is not None:
+                scope.set_var(n, v)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+
+    def convert_fetches(self, fetches, block0, return_numpy: bool):
+        return [
+            Executor._convert_fetch(val, block0.vars.get(name), return_numpy)
+            for name, val in zip(self.fetch_names, fetches)
+        ]
+
+
 class Executor:
     """Serial single-device executor (reference: executor.py:256)."""
 
@@ -95,6 +148,11 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ) -> List[Any]:
+        # fluid idiom: exe.run(CompiledProgram(...).with_data_parallel(...), ...)
+        if program is not None and hasattr(program, "with_data_parallel"):
+            pe = program._executor_for_scope(scope or global_scope())
+            return pe.run(fetch_list=fetch_list, feed=feed, return_numpy=return_numpy)
+
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -102,66 +160,39 @@ class Executor:
 
         feed_names = sorted(feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
-        state_names = _block_state_names(program, extra=fetch_names)
 
         key = (
             id(program),
             len(program.desc.block(0).ops),
             tuple(feed_names),
             tuple(fetch_names),
-            tuple(state_names),
         )
-        compiled = self._cache.get(key) if use_program_cache else None
-        if compiled is None:
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            plan = _RunPlan(program, feed_names, fetch_names)
             compiled = CompiledBlock(
                 program,
                 0,
-                feed_names,
-                fetch_names,
-                state_names,
+                plan.feed_names,
+                plan.fetch_names,
+                plan.state_names,
                 donate_states=True,
             )
+            entry = (compiled, plan)
             if use_program_cache:
-                self._cache[key] = compiled
+                self._cache[key] = entry
+        compiled, plan = entry
 
         block0 = program.desc.block(0)
-        feed_vals = tuple(
-            _as_feed_value(feed[n], block0.vars.get(n)) for n in feed_names
-        )
-
-        # check state availability; missing write-first states start as zeros
-        rbw = _read_before_write(program, state_names, feed_names)
-        state_vals = []
-        for n in state_names:
-            v = scope.find_var(n)
-            if v is None:
-                if n in rbw:
-                    raise RuntimeError(
-                        f"persistable variable '{n}' is read before it is written "
-                        "but is not initialized in the scope; run the startup "
-                        "program first"
-                    )
-                vd = block0.vars[n]
-                shape = [d if d >= 0 else 1 for d in vd.shape] or [1]
-                v = np.zeros(shape, dtype=dtype_to_numpy(vd.dtype))
-            state_vals.append(v)
-
-        rng = scope.find_var(RNG_STATE_VAR)
-        if rng is None:
-            rng = jax.random.PRNGKey(program.random_seed or 0)
+        feed_vals = plan.feed_values(feed, block0)
+        state_vals = plan.state_values(scope, block0)
+        rng = plan.rng_value(scope, program)
 
         with jax.default_device(self.place.jax_device()):
-            fetches, new_states, new_rng = compiled(feed_vals, tuple(state_vals), rng)
+            fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 
-        for n, v in zip(state_names, new_states):
-            if v is not None:
-                scope.set_var(n, v)
-        scope.set_var(RNG_STATE_VAR, new_rng)
-
-        results = []
-        for name, val in zip(fetch_names, fetches):
-            results.append(self._convert_fetch(val, block0.vars.get(name), return_numpy))
-        return results
+        plan.write_back(scope, new_states, new_rng)
+        return plan.convert_fetches(fetches, block0, return_numpy)
 
     @staticmethod
     def _convert_fetch(val, var_desc, return_numpy: bool):

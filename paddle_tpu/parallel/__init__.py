@@ -1,0 +1,13 @@
+"""Parallel execution over a TPU device mesh.
+
+Replaces the reference's multi-device machinery — ParallelExecutor's SSA
+graph + NCCL op-handles (paddle/fluid/framework/parallel_executor.cc:191,
+details/all_reduce_op_handle.cc:48) and the transpiler's nccl2 mode — with
+SPMD over a `jax.sharding.Mesh`: shardings are annotations, XLA inserts the
+collectives over ICI/DCN, and one jitted program runs on every chip.
+"""
+
+from .mesh import DeviceMesh, make_mesh, default_mesh, mesh_guard  # noqa: F401
+from .strategy import BuildStrategy, ExecutionStrategy, ShardingStrategy  # noqa: F401
+from .executor import ParallelExecutor, CompiledProgram  # noqa: F401
+from .env import init_distributed, trainer_id, num_trainers  # noqa: F401

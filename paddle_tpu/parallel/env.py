@@ -1,0 +1,65 @@
+"""Multi-host coordination (reference: gen_nccl_id op + transpiler nccl2 mode,
+distribute_transpiler.py:213, platform/nccl_helper.h:120 rank math).
+
+The reference broadcast an ncclUniqueId over gRPC and computed global ranks
+as trainer_id * ngpu + i.  JAX replaces all of that with the coordination
+service: `jax.distributed.initialize` wires every host into one global
+device list, and meshes built from `jax.devices()` span the pod.  The
+PADDLE_* cluster env vars keep working as the spelling."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_distributed", "trainer_id", "num_trainers"]
+
+_initialized = False
+
+
+def trainer_id() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    # don't touch jax.process_index() unless needed: it initializes the
+    # backend, which must not happen before jax.distributed.initialize
+    return int(v) if v is not None else jax.process_index()
+
+
+def num_trainers() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    return int(v) if v is not None else jax.process_count()
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job.  Arguments default from the reference's
+    cluster env spelling (PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINER_ID,
+    benchmark/fluid/fluid_benchmark.py:63-101) when present."""
+    global _initialized
+    if _initialized:
+        return
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if coordinator_address is None and eps:
+        coordinator_address = eps.split(",")[0]
+    if coordinator_address is None:
+        _initialized = True  # single host
+        return
+    if num_processes is None:
+        v = os.environ.get("PADDLE_TRAINERS_NUM")
+        if v is not None:
+            num_processes = int(v)
+        elif eps:
+            num_processes = len(eps.split(","))
+    if process_id is None:
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        process_id = int(v) if v is not None else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True

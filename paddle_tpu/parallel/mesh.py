@@ -1,0 +1,150 @@
+"""Device mesh construction and the sharding vocabulary.
+
+The reference addresses devices as a flat list of places cloned into an SSA
+graph (multi_devices_graph_pass.cc:386); the TPU-native model is a named
+logical mesh over the chip slice.  Axis names used across the framework:
+
+    dp  - data parallel (batch dim)
+    tp  - tensor/model parallel (hidden dims)
+    pp  - pipeline parallel (layer stages)
+    sp  - sequence/context parallel (sequence dim, ring attention)
+    ep  - expert parallel
+
+A `DeviceMesh` wraps `jax.sharding.Mesh` and converts per-variable logical
+sharding specs (lists of axis names, stored on VarDesc.sharding) into
+`NamedSharding`s.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["DeviceMesh", "make_mesh", "default_mesh", "mesh_guard",
+           "AXIS_DP", "AXIS_TP", "AXIS_PP", "AXIS_SP", "AXIS_EP"]
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+
+class DeviceMesh:
+    """Named logical mesh over a set of JAX devices."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    @property
+    def axis_names(self):
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.mesh.shape)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values()))) if self.mesh.shape else 1
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape.get(name, 1)
+
+    def has_axis(self, name: str) -> bool:
+        return name in self.mesh.axis_names
+
+    # -- sharding construction ----------------------------------------------
+    def spec(self, logical: Optional[Sequence[Any]]) -> PartitionSpec:
+        """logical: per-dim entry of None / axis-name / tuple of axis names.
+        Axes absent from this mesh degrade to replication, so one program
+        text runs on any mesh shape (the reference re-transpiles instead)."""
+        if logical is None:
+            return PartitionSpec()
+        dims = []
+        for entry in logical:
+            if entry is None:
+                dims.append(None)
+            elif isinstance(entry, (list, tuple)):
+                present = tuple(a for a in entry if self.has_axis(a))
+                dims.append(present if present else None)
+            else:
+                dims.append(entry if self.has_axis(entry) else None)
+        while dims and dims[-1] is None:
+            dims.pop()
+        return PartitionSpec(*dims)
+
+    def sharding(self, logical: Optional[Sequence[Any]]) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(logical))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_sharding(self, batch_axis: str = AXIS_DP) -> NamedSharding:
+        """Default feed sharding: dim 0 over the data axis when present."""
+        if not self.has_axis(batch_axis):
+            return self.replicated()
+        return NamedSharding(self.mesh, PartitionSpec(batch_axis))
+
+    def __enter__(self):
+        self._cm = self.mesh
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __repr__(self):
+        return f"DeviceMesh({self.shape})"
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[Any]] = None,
+) -> DeviceMesh:
+    """Build a DeviceMesh.  `axes` maps axis name -> size; a -1 size (at most
+    one) absorbs all remaining devices.  Default: pure data parallel over all
+    local devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None or not axes:
+        axes = {AXIS_DP: n}
+    names = list(axes)
+    sizes = [int(s) for s in axes.values()]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return DeviceMesh(Mesh(dev_array, axis_names=tuple(names)))
+
+
+_default_mesh: Optional[DeviceMesh] = None
+
+
+def default_mesh() -> DeviceMesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: DeviceMesh):
+    global _default_mesh
+    prev, _default_mesh = _default_mesh, mesh
+    try:
+        yield mesh
+    finally:
+        _default_mesh = prev

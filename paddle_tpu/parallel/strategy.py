@@ -1,0 +1,77 @@
+"""Execution/build strategy knobs (reference: paddle/fluid/framework/details/
+build_strategy.h:34, execution_strategy.h:22).
+
+Most reference knobs configured the SSA executor (thread counts, scope drop
+cadence) or graph passes (fuse, memory-early-delete); under XLA those are
+compiler-owned, so they are accepted-and-ignored for script compatibility.
+The knobs that still mean something steer sharding:
+
+- `reduce_strategy`: AllReduce == keep params replicated (grads psum);
+  Reduce == shard optimizer state over dp (ZeRO-ish), beyond reference parity.
+- `gradient_scale_strategy`: kept for API parity; mean-type losses already
+  average over the *global* batch under SPMD, matching CoeffNumDevice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class ReduceStrategy:
+    AllReduce = 0
+    Reduce = 1
+
+
+class GradientScaleStrategy:
+    CoeffNumDevice = 0
+    One = 1
+    Customized = 2
+
+
+class ExecutorType:
+    Default = 0
+    Experimental = 1
+
+
+@dataclass
+class BuildStrategy:
+    ReduceStrategy = ReduceStrategy
+    GradientScaleStrategy = GradientScaleStrategy
+
+    reduce_strategy: int = ReduceStrategy.AllReduce
+    gradient_scale_strategy: int = GradientScaleStrategy.CoeffNumDevice
+    debug_graphviz_path: str = ""
+    enable_data_balance: bool = False
+    memory_early_delete: bool = False
+    enable_sequential_execution: bool = False
+    fuse_elewise_add_act_ops: bool = False
+    fuse_broadcast_op: bool = False
+    fuse_relu_depthwise_conv: bool = False
+    remove_unnecessary_lock: bool = True
+
+
+@dataclass
+class ExecutionStrategy:
+    ExecutorType = ExecutorType
+
+    num_threads: int = 0
+    use_cuda: bool = False
+    allow_op_delay: bool = False
+    num_iteration_per_drop_scope: int = 1
+    type: int = ExecutorType.Default
+    dry_run: bool = False
+
+
+@dataclass
+class ShardingStrategy:
+    """TPU-native extension: how to lay the program over the mesh.
+
+    `mesh_axes` names the mesh (axis -> size, -1 absorbs); per-variable
+    overrides come from Variable.sharding.  `shard_optimizer_state` shards
+    persistable optimizer accumulators over dp (set by Reduce strategy)."""
+
+    mesh_axes: Optional[Dict[str, int]] = None
+    batch_axis: str = "dp"
+    shard_optimizer_state: bool = False
+    param_shardings: Dict[str, Any] = field(default_factory=dict)

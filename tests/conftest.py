@@ -10,6 +10,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# A sitecustomize module may have registered an accelerator plugin before
+# this conftest ran (so the env var alone is too late); pin the platform
+# through jax.config, which wins as long as no backend is initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
