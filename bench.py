@@ -1,0 +1,93 @@
+"""Round benchmark: ResNet-50 training images/sec on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is the ratio to the reference's best published ResNet-50
+training throughput (81.69 img/s, MKL-DNN on 2x Xeon 6148 —
+benchmark/IntelOptimizedPaddle.md:43-47; the reference publishes no
+GPU/fluid-era ResNet-50 number, see BASELINE.md).
+
+Env knobs: BENCH_BS (default 64), BENCH_STEPS (default 10),
+BENCH_MODEL (resnet50 | transformer | lenet).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REF_RESNET50_IMG_S = 81.69  # IntelOptimizedPaddle.md:43-47 (bs=64, MKL-DNN)
+
+
+def main() -> None:
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    bs = int(os.environ.get("BENCH_BS", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    if model == "resnet50":
+        spec = models.resnet_imagenet(depth=50, class_num=1000)
+        unit = "images/sec"
+        items_per_step = bs
+        metric = "resnet50_train_images_per_sec_per_chip"
+        baseline = REF_RESNET50_IMG_S
+        lr = 0.1
+    elif model == "transformer":
+        cfg = models.TransformerConfig(
+            src_vocab_size=32000, trg_vocab_size=32000, max_length=256,
+        )
+        spec = models.transformer(cfg)
+        unit = "tokens/sec"
+        items_per_step = bs * cfg.max_length
+        metric = "transformer_train_tokens_per_sec_per_chip"
+        baseline = None  # no reference number exists (BASELINE.md)
+        lr = 1e-4
+    else:
+        spec = models.lenet5()
+        unit = "images/sec"
+        items_per_step = bs
+        metric = "mnist_train_images_per_sec_per_chip"
+        baseline = None
+        lr = 0.01
+
+    fluid.optimizer.MomentumOptimizer(
+        learning_rate=lr, momentum=0.9
+    ).minimize(spec.loss)
+
+    place = fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    batch = spec.synthetic_batch(bs)
+
+    # warmup: trigger compile + first run
+    for _ in range(2):
+        exe.run(feed=batch, fetch_list=[spec.loss])
+
+    t0 = time.perf_counter()
+    loss_v = None
+    for _ in range(steps):
+        (loss_v,) = exe.run(feed=batch, fetch_list=[spec.loss])
+    # fetch conversion already blocks on the result
+    dt = time.perf_counter() - t0
+
+    value = items_per_step * steps / dt
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else None,
+    }))
+    sys.stderr.write(
+        f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
+        f"final_loss={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -287,6 +287,9 @@ class CompiledBlock:
             new_states = tuple(env.get(n) for n in self.state_names)
             return fetches, new_states, ctx.key
 
+        # un-jitted closure, for callers that compose/jit at a higher level
+        self.raw_fn = fn
+
         jit_kwargs: Dict[str, Any] = {}
         if donate_states:
             jit_kwargs["donate_argnums"] = (1,)

@@ -1,0 +1,71 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py).
+
+This module currently carries the compare/logical layer fns; While /
+StaticRNN / DynamicRNN / IfElse land with the control-flow op lowerings.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal",
+    "greater_than", "greater_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+]
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type, input=x)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(
+        type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]}
+    )
+    return cond
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _compare("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _compare("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _compare("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", input=x)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype="bool")
+        out.stop_gradient = True
+    helper.append_op(type="logical_not", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out

@@ -385,19 +385,19 @@ def range(start, end, step, dtype):
     helper = LayerHelper("range")
     dtype = convert_dtype(dtype)
 
-    def _ensure_var(v):
+    # python-scalar bounds ride as attrs so the lowering sees static values
+    # (a Variable bound would be a tracer under jit, and the output length
+    # fixes an XLA shape); Variable bounds must be compile-time constants
+    attrs = {"dtype": int(dtype)}
+    inputs = {}
+    for slot, v in (("Start", start), ("End", end), ("Step", step)):
         if isinstance(v, Variable):
-            return v
-        return fill_constant([1], dtype, v)
+            inputs[slot] = [v]
+        else:
+            attrs[f"const_{slot.lower()}"] = float(v)
 
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
-    helper.append_op(
-        type="range",
-        inputs={"Start": [_ensure_var(start)], "End": [_ensure_var(end)],
-                "Step": [_ensure_var(step)]},
-        outputs={"Out": [out]},
-        attrs={"dtype": int(dtype)},
-    )
+    helper.append_op(type="range", inputs=inputs, outputs={"Out": [out]}, attrs=attrs)
     return out
 
 

@@ -1,0 +1,15 @@
+"""Model zoo mirroring the reference's benchmark configurations
+(reference: benchmark/fluid/models/ — mnist, resnet, vgg, machine
+translation / transformer, stacked_dynamic_lstm, se_resnext).
+
+Each builder constructs its graph into the CURRENT default main/startup
+programs (use fluid.program_guard to redirect) and returns a ModelSpec with
+the feed names, loss/metric variables, and a synthetic-batch generator for
+benchmarking without datasets.
+"""
+
+from .common import ModelSpec  # noqa: F401
+from .mnist import lenet5  # noqa: F401
+from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
+from .vgg import vgg16  # noqa: F401
+from .transformer import transformer, TransformerConfig  # noqa: F401

@@ -1,0 +1,44 @@
+"""MNIST LeNet-5 (reference config: benchmark/fluid/models/mnist.py,
+tests/book/test_recognize_digits.py): two conv+pool stages, a hidden FC,
+softmax classifier."""
+
+from __future__ import annotations
+
+import functools
+
+from .. import layers, nets
+from .common import ModelSpec, class_batch
+
+
+def lenet5(img=None, label=None, class_num: int = 10) -> ModelSpec:
+    if img is None:
+        img = layers.data("image", [1, 28, 28], dtype="float32")
+    if label is None:
+        label = layers.data("label", [1], dtype="int64")
+
+    conv1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20,
+        pool_size=2, pool_stride=2, act="relu",
+    )
+    conv2 = nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50,
+        pool_size=2, pool_stride=2, act="relu",
+    )
+    hidden = layers.fc(conv2, size=500, act="relu")
+    predict = layers.fc(hidden, size=class_num, act="softmax")
+
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+
+    return ModelSpec(
+        name="mnist_lenet5",
+        feed_names=[img.name, label.name],
+        loss=avg_cost,
+        metrics={"acc": acc},
+        synthetic_batch=functools.partial(
+            class_batch, img_shape=(1, 28, 28), num_classes=class_num,
+            img_name=img.name, label_name=label.name,
+        ),
+        extras={"predict": predict},
+    )

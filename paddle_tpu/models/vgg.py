@@ -1,0 +1,60 @@
+"""VGG-16 with batch norm (reference config: benchmark/fluid/models/vgg.py,
+tests/book image classification VGG)."""
+
+from __future__ import annotations
+
+import functools
+
+from .. import layers, nets
+from .common import ModelSpec, class_batch
+
+
+def vgg16(
+    img=None, label=None, class_num: int = 10, img_shape=(3, 32, 32)
+) -> ModelSpec:
+    if img is None:
+        img = layers.data("image", list(img_shape), dtype="float32")
+    if label is None:
+        label = layers.data("label", [1], dtype="int64")
+
+    def conv_block(input, num_filter, groups, dropouts):
+        return nets.img_conv_group(
+            input=input,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act="relu",
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type="max",
+        )
+
+    conv1 = conv_block(img, 64, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0])
+
+    drop = layers.dropout(x=conv5, dropout_prob=0.5)
+    fc1 = layers.fc(input=drop, size=512, act=None)
+    bn = layers.batch_norm(input=fc1, act="relu")
+    drop2 = layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = layers.fc(input=drop2, size=512, act=None)
+    predict = layers.fc(input=fc2, size=class_num, act="softmax")
+
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+
+    return ModelSpec(
+        name="vgg16",
+        feed_names=[img.name, label.name],
+        loss=avg_cost,
+        metrics={"acc": acc},
+        synthetic_batch=functools.partial(
+            class_batch, img_shape=tuple(img_shape), num_classes=class_num,
+            img_name=img.name, label_name=label.name,
+        ),
+        extras={"predict": predict},
+    )

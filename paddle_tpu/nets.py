@@ -1,0 +1,145 @@
+"""Composite network helpers (reference: python/paddle/fluid/nets.py —
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention).  Pure compositions of layers; no new ops."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import layers
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "glu",
+    "scaled_dot_product_attention",
+]
+
+
+def simple_img_conv_pool(
+    input,
+    num_filters: int,
+    filter_size,
+    pool_size,
+    pool_stride,
+    pool_padding=0,
+    pool_type: str = "max",
+    global_pooling: bool = False,
+    conv_stride=1,
+    conv_padding=0,
+    conv_dilation=1,
+    conv_groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    use_cudnn: bool = True,
+):
+    conv_out = layers.conv2d(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=conv_stride,
+        padding=conv_padding,
+        dilation=conv_dilation,
+        groups=conv_groups,
+        param_attr=param_attr,
+        bias_attr=bias_attr,
+        act=act,
+    )
+    return layers.pool2d(
+        input=conv_out,
+        pool_size=pool_size,
+        pool_type=pool_type,
+        pool_stride=pool_stride,
+        pool_padding=pool_padding,
+        global_pooling=global_pooling,
+    )
+
+
+def img_conv_group(
+    input,
+    conv_num_filter: Sequence[int],
+    pool_size,
+    conv_padding=1,
+    conv_filter_size=3,
+    conv_act: Optional[str] = None,
+    param_attr=None,
+    conv_with_batchnorm=False,
+    conv_batchnorm_drop_rate=0.0,
+    pool_stride=1,
+    pool_type: str = "max",
+    use_cudnn: bool = True,
+):
+    """Stacked conv (+ optional BN/dropout) block followed by one pool —
+    the VGG building block (reference: nets.py img_conv_group)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _expand(x):
+        return [x] * len(conv_num_filter) if not hasattr(x, "__len__") else list(x)
+
+    conv_padding = _expand(conv_padding)
+    conv_filter_size = _expand(conv_filter_size)
+    param_attr = _expand(param_attr) if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(conv_num_filter)
+    conv_with_batchnorm = _expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _expand(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            input=tmp,
+            num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i],
+            padding=conv_padding[i],
+            param_attr=param_attr[i],
+            act=local_conv_act,
+        )
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+
+    return layers.pool2d(
+        input=tmp, pool_size=pool_size, pool_type=pool_type, pool_stride=pool_stride
+    )
+
+
+def glu(input, dim: int = -1):
+    """Gated linear unit: split in half on `dim`, a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(
+    queries, keys, values, num_heads: int = 1, dropout_rate: float = 0.0
+):
+    """Multi-head scaled dot-product attention over [batch, seq, hidden]
+    tensors (reference: nets.py scaled_dot_product_attention)."""
+    if not (len(queries.shape) == len(keys.shape) == len(values.shape) == 3):
+        raise ValueError("inputs must be 3-D [batch, seq, hidden]")
+    head_dim = queries.shape[-1] // num_heads
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        reshaped = layers.reshape(x, shape=[0, 0, num_heads, head_dim])
+        return layers.transpose(reshaped, perm=[0, 2, 1, 3])
+
+    def _combine_heads(x):
+        if num_heads == 1:
+            return x
+        t = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(t, shape=[0, 0, t.shape[2] * t.shape[3]])
+
+    q, k, v = _split_heads(queries), _split_heads(keys), _split_heads(values)
+    scaled_q = layers.scale(q, scale=float(head_dim) ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    return _combine_heads(ctx)

@@ -7,6 +7,7 @@ JAX lowering rules consumed by paddle_tpu.core.compiler.
 
 from . import (  # noqa: F401
     activation_ops,
+    compare_ops,
     elementwise_ops,
     loss_ops,
     math_ops,

@@ -19,6 +19,7 @@ __all__ = [
     "lengths",
     "wrap_lod",
     "broadcast_y",
+    "broadcast_out_shape",
     "normalize_axis",
 ]
 
@@ -73,9 +74,12 @@ def elemwise_shape(op: OpDesc, block):
     y = in_desc(op, block, "Y")
     if x is None:
         return
-    shape = list(x.shape)
-    if y is not None and len(y.shape) > len(shape):
+    if y is not None and len(y.shape) == len(x.shape):
+        shape = broadcast_out_shape(x.shape, y.shape)
+    elif y is not None and len(y.shape) > len(x.shape):
         shape = list(y.shape)
+    else:
+        shape = list(x.shape)
     set_output(block, op, "Out", shape, x.dtype, lod_level=x.lod_level)
 
 
@@ -102,14 +106,15 @@ def normalize_axis(axis: int, rank: int) -> int:
 
 def broadcast_y(x, y, axis: int):
     """Fluid elementwise broadcasting (reference:
-    operators/elementwise/elementwise_op_function.h): Y's shape is a
+    operators/elementwise/elementwise_op_function.h): a lower-rank Y is a
     contiguous sub-sequence of X's shape aligned at `axis` (-1 = align to the
-    trailing dims).  Reshape Y so numpy-style broadcasting applies."""
+    trailing dims) — reshape it so numpy broadcasting applies.  Equal-rank
+    operands broadcast numpy-style untouched (e.g. [1,S] vs [S,1] -> [S,S];
+    reshaping those, as a sub-shape alignment would, silently corrupts
+    outer-product masks)."""
     x_shape = jnp.shape(x)
     y_shape = jnp.shape(y)
-    if x_shape == y_shape:
-        return y
-    if len(y_shape) > len(x_shape):
+    if len(y_shape) >= len(x_shape):
         return y
     # strip trailing 1s of y (fluid: [N,1] vs [N])
     ys = list(y_shape)
@@ -120,3 +125,25 @@ def broadcast_y(x, y, axis: int):
     for i, d in enumerate(ys):
         target[axis + i] = d
     return jnp.reshape(y, target)
+
+
+def broadcast_out_shape(x_shape, y_shape):
+    """Static result shape of broadcasting x with y (dims may be -1 for an
+    unknown batch: -1 broadcast with 1 or -1 stays -1, else the known dim)."""
+    if len(y_shape) > len(x_shape):
+        x_shape, y_shape = y_shape, x_shape
+    out = list(x_shape)
+    off = len(x_shape) - len(y_shape)
+    for i, dy in enumerate(y_shape):
+        dx = out[off + i]
+        if dx == dy:
+            continue
+        if dx == 1:
+            out[off + i] = dy
+        elif dy == 1:
+            continue
+        elif dx == -1 or dy == -1:
+            out[off + i] = -1
+        else:
+            out[off + i] = max(dx, dy)
+    return out

@@ -1,0 +1,46 @@
+"""Compare + logical ops (reference: paddle/fluid/operators/controlflow/
+compare_op.cc, logical_op.cc).  Outputs are bool tensors; Fluid broadcasting
+rules match elementwise ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import broadcast_out_shape, broadcast_y, data, in_desc, set_output, wrap_lod
+
+
+def _bool_out_shape(op, block):
+    x = in_desc(op, block, "X")
+    y = in_desc(op, block, "Y")
+    if x is None:
+        return
+    shape = broadcast_out_shape(x.shape, y.shape) if y is not None else list(x.shape)
+    set_output(block, op, "Out", shape, DataType.BOOL, lod_level=x.lod_level)
+
+
+def _make_compare(name, fn):
+    @register_op(name, infer_shape=_bool_out_shape, no_grad=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        yb = broadcast_y(data(x), data(y), attrs.get("axis", -1))
+        return {"Out": [wrap_lod(x, _fn(data(x), yb))]}
+
+    return _lower
+
+
+_make_compare("equal", lambda x, y: x == y)
+_make_compare("not_equal", lambda x, y: x != y)
+_make_compare("less_than", lambda x, y: x < y)
+_make_compare("less_equal", lambda x, y: x <= y)
+_make_compare("greater_than", lambda x, y: x > y)
+_make_compare("greater_equal", lambda x, y: x >= y)
+_make_compare("logical_and", jnp.logical_and)
+_make_compare("logical_or", jnp.logical_or)
+_make_compare("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", infer_shape=_bool_out_shape, no_grad=True)
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [wrap_lod(ins["X"][0], jnp.logical_not(data(ins["X"][0])))]}

@@ -111,35 +111,7 @@ def _mean_iou(ctx, ins, attrs):
     }
 
 
-# -- comparisons / logicals (reference: operators/controlflow/compare_op.cc,
-#    logical_op.cc) ----------------------------------------------------------
-def _cmp_infer(op, block):
-    x = in_desc(op, block, "X")
-    if x is None:
-        return
-    set_output(block, op, "Out", x.shape, DataType.BOOL)
-
-
-def _make_cmp(name, fn):
-    @register_op(name, infer_shape=_cmp_infer, no_grad=True)
-    def _lower(ctx, ins, attrs, _fn=fn):
-        return {"Out": [_fn(data(ins["X"][0]), data(ins["Y"][0]))]}
-
-
-_make_cmp("less_than", lambda x, y: x < y)
-_make_cmp("less_equal", lambda x, y: x <= y)
-_make_cmp("greater_than", lambda x, y: x > y)
-_make_cmp("greater_equal", lambda x, y: x >= y)
-_make_cmp("equal", lambda x, y: x == y)
-_make_cmp("not_equal", lambda x, y: x != y)
-_make_cmp("logical_and", jnp.logical_and)
-_make_cmp("logical_or", jnp.logical_or)
-_make_cmp("logical_xor", jnp.logical_xor)
-
-
-@register_op("logical_not", infer_shape=_cmp_infer, no_grad=True)
-def _logical_not(ctx, ins, attrs):
-    return {"Out": [jnp.logical_not(data(ins["X"][0]))]}
+# comparisons / logicals moved to compare_ops.py (broadcasting variants)
 
 
 def _edit_distance_infer(op, block):

@@ -644,22 +644,37 @@ def _space_to_depth(ctx, ins, attrs):
     return {"Out": [jnp.reshape(out, (n, c * b * b, h // b, w // b))]}
 
 
+def _range_static_len(op):
+    a = op.attrs
+    if all(f"const_{k}" in a for k in ("start", "end", "step")):
+        import math
+
+        return max(0, math.ceil((a["const_end"] - a["const_start"]) / a["const_step"]))
+    return -1
+
+
 def _range_infer(op, block):
-    set_output(block, op, "Out", [-1], DataType(op.attr("dtype", int(DataType.FP32))))
+    set_output(
+        block, op, "Out", [_range_static_len(op)],
+        DataType(op.attr("dtype", int(DataType.FP32))),
+    )
 
 
 @register_op("range", infer_shape=_range_infer, no_grad=True)
 def _range(ctx, ins, attrs):
-    try:
-        start = float(np.asarray(data(ins["Start"][0])).reshape(()))
-        end = float(np.asarray(data(ins["End"][0])).reshape(()))
-        step = float(np.asarray(data(ins["Step"][0])).reshape(()))
-    except Exception as e:
-        raise NotImplementedError(
-            "range requires compile-time-constant Start/End/Step: the output "
-            "length sets a static XLA shape, so data-dependent bounds cannot "
-            "be lowered"
-        ) from e
+    def bound(slot):
+        if f"const_{slot.lower()}" in attrs:
+            return attrs[f"const_{slot.lower()}"]
+        try:
+            return float(np.asarray(data(ins[slot][0])).reshape(()))
+        except Exception as e:
+            raise NotImplementedError(
+                "range requires compile-time-constant Start/End/Step: the "
+                "output length sets a static XLA shape, so data-dependent "
+                "bounds cannot be lowered"
+            ) from e
+
+    start, end, step = bound("Start"), bound("End"), bound("Step")
     dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
     return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
 
