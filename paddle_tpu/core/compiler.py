@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .framework import Block, Program
+from .lod import LoDValue
 from .proto import OpDesc, VarType, dtype_to_numpy
 from .registry import GRAD_OP_SUFFIX, GRAD_SUFFIX, OpRegistry
 
@@ -141,6 +142,47 @@ def _is_float(x) -> bool:
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
 
 
+def _float0_zeros(p):
+    return np.zeros(np.shape(p), dtype=jax.dtypes.float0)
+
+
+def _leaf_cotangent(primal, g):
+    """Cotangent for one array leaf: float0 for non-float primals, zeros when
+    no incoming grad, else the grad cast to the primal dtype."""
+    if not _is_float(primal):
+        return _float0_zeros(primal)
+    if g is None:
+        return jnp.zeros_like(primal)
+    return jnp.asarray(g, dtype=jnp.asarray(primal).dtype)
+
+
+def _make_cotangent(primal, g):
+    """Build a vjp cotangent matching `primal`'s pytree structure.  LoDValue
+    primals take the grad on .data (the incoming grad may be a bare array or
+    an LoDValue) and a float0 cotangent for the integer lengths."""
+    if isinstance(primal, LoDValue):
+        gdata = g.data if isinstance(g, LoDValue) else g
+        return LoDValue(
+            _leaf_cotangent(primal.data, gdata), _float0_zeros(primal.lengths)
+        )
+    return _leaf_cotangent(primal, g)
+
+
+def _sanitize_input_grad(g, primal):
+    """Normalize a vjp input-grad before it enters the env: float0 leaves
+    become zeros, and LoDValue grads re-adopt the primal's real lengths."""
+    if g is None:
+        return None
+    if isinstance(g, LoDValue):
+        gd = g.data
+        if getattr(gd, "dtype", None) == jax.dtypes.float0:
+            gd = jnp.zeros_like(primal.data)
+        return LoDValue(gd, primal.lengths)
+    if getattr(g, "dtype", None) == jax.dtypes.float0:
+        return jnp.zeros_like(primal)
+    return g
+
+
 def _lower_forward_op(ctx: LoweringContext, op: OpDesc, need_vjp: bool) -> None:
     info = OpRegistry.get(op.type)
     ins = _gather_inputs(ctx, op)
@@ -206,13 +248,7 @@ def _lower_grad_op(ctx: LoweringContext, op: OpDesc) -> None:
             g = None
             if pos < len(gnames) and gnames[pos]:
                 g = ctx.env.get(gnames[pos])
-            primal = primal_outs[i]
-            if not _is_float(primal):
-                cotangents[i] = np.zeros(np.shape(primal), dtype=jax.dtypes.float0)
-            elif g is None:
-                cotangents[i] = jnp.zeros_like(primal)
-            else:
-                cotangents[i] = jnp.asarray(g, dtype=jnp.asarray(primal).dtype)
+            cotangents[i] = _make_cotangent(primal_outs[i], g)
     in_grads = vjp_fn(tuple(cotangents))
 
     # scatter input grads to `<slot>@GRAD` output names
@@ -221,9 +257,7 @@ def _lower_grad_op(ctx: LoweringContext, op: OpDesc) -> None:
         for pos, i in enumerate(row):
             if i is None or pos >= len(out_names) or not out_names[pos]:
                 continue
-            g = in_grads[i]
-            if g is not None and getattr(g, "dtype", None) == jax.dtypes.float0:
-                g = jnp.zeros_like(primal_ins[i])
+            g = _sanitize_input_grad(in_grads[i], primal_ins[i])
             if g is not None:
                 ctx.env[out_names[pos]] = g
 

@@ -1,10 +1,11 @@
 """fluid.layers equivalent: the public layer-function namespace."""
 
-from . import control_flow, io, nn, ops, tensor  # noqa: F401
+from . import control_flow, io, nn, ops, sequence_nn, tensor  # noqa: F401
 from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .sequence_nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 
 from ..core.framework import Variable

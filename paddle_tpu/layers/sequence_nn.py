@@ -1,0 +1,386 @@
+"""Sequence + recurrent layer functions (fluid.layers parity).
+
+Reference: python/paddle/fluid/layers/nn.py — dynamic_lstm :443,
+dynamic_lstmp :577, dynamic_gru :727, gru_unit :830, sequence_conv :1799,
+sequence_pool :1983, sequence_first/last_step :2061/2084, sequence_softmax,
+sequence_expand(_as), sequence_reshape, sequence_slice, sequence_pad/unpad,
+sequence_mask, sequence_concat, sequence_enumerate, sequence_reverse,
+sequence_scatter, im2sequence, row_conv, lod_reset, lstm_unit (nets).
+Each builds the same op graph as the reference; kernels are the
+paddle_tpu.ops.sequence_ops / rnn_ops lowerings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
+    "lstm",
+    "sequence_conv", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_softmax", "sequence_expand",
+    "sequence_expand_as", "sequence_reshape", "sequence_slice",
+    "sequence_pad", "sequence_unpad", "sequence_mask", "sequence_concat",
+    "sequence_enumerate", "sequence_reverse", "sequence_scatter",
+    "sequence_erase", "im2sequence", "row_conv", "lod_reset",
+]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """LSTM over a variable-length sequence batch (reference: layers/nn.py
+    dynamic_lstm).  `input` must already be the 4H projection (use fc)."""
+    helper = LayerHelper("lstm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(helper.param_attr, shape=[hidden, size], dtype=dtype)
+    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+    bias = helper.create_parameter(helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": [hidden_out], "Cell": [cell],
+                 "BatchGate": [batch_gate], "BatchCellPreAct": [batch_cell_pre_act]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation},
+    )
+    return hidden_out, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference: layers/nn.py dynamic_lstmp)."""
+    helper = LayerHelper("lstmp", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(helper.param_attr, shape=[proj_size, size], dtype=dtype)
+    proj_weight = helper.create_parameter(helper.param_attr, shape=[hidden, proj_size], dtype=dtype)
+    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+    bias = helper.create_parameter(helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    projection = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias]},
+        outputs={"Projection": [projection], "Cell": [cell],
+                 "BatchGate": [batch_gate], "BatchCellPreAct": [batch_cell_pre_act]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation},
+    )
+    return projection, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    """GRU over a variable-length sequence batch (reference: layers/nn.py
+    dynamic_gru).  `input` must be the 3H projection."""
+    helper = LayerHelper("gru", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr, shape=[1, 3 * size],
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_reset = helper.create_variable_for_type_inference(dtype)
+    batch_hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="gru", inputs=inputs,
+        outputs={"Hidden": [hidden], "BatchGate": [batch_gate],
+                 "BatchResetHiddenPrev": [batch_reset], "BatchHidden": [batch_hidden]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "activation": candidate_activation},
+    )
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    """Single GRU step (reference: layers/nn.py gru_unit)."""
+    helper = LayerHelper("gru_unit", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = helper.input_dtype()
+    size = size // 3
+    weight = helper.create_parameter(helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr, shape=[1, 3 * size],
+                                   dtype=dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_prev = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    acts = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden],
+                "Weight": [weight], "Bias": [bias]},
+        outputs={"Gate": [gate], "ResetHiddenPrev": [reset_hidden_prev],
+                 "Hidden": [updated_hidden]},
+        attrs={"activation": acts[activation], "gate_activation": acts[gate_activation]},
+    )
+    return updated_hidden, reset_hidden_prev, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One fc + lstm_unit step (reference: layers/nn.py lstm_unit)."""
+    from .nn import fc
+    from .tensor import concat
+
+    size = cell_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=-1)
+    fc_out = fc(input=concat_in, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", input=x_t, name=name)
+    dtype = x_t.dtype
+    c = helper.create_variable_for_type_inference(dtype)
+    h = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [fc_out], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": forget_bias},
+    )
+    return h, c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Fused multi-layer LSTM over dense [T, N, D] input — the cuDNN-path
+    layer (reference: layers/nn.py lstm -> cudnn_lstm op)."""
+    helper = LayerHelper("cudnn_lstm", input=input, name=name)
+    dtype = input.dtype
+    in_size = input.shape[-1]
+    ndir = 2 if is_bidirec else 1
+    weight_size = 0
+    d = in_size
+    for _ in range(num_layers):
+        for _ in range(ndir):
+            weight_size += d * 4 * hidden_size + hidden_size * 4 * hidden_size + 4 * hidden_size
+        d = hidden_size * ndir
+    weight = helper.create_parameter(helper.param_attr, shape=[weight_size], dtype=dtype,
+                                     default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="cudnn_lstm",
+        inputs={"Input": [input], "InitH": [init_h], "InitC": [init_c], "W": [weight]},
+        outputs={"Out": [out], "last_h": [last_h], "last_c": [last_c]},
+        attrs={"max_len": max_len, "hidden_size": hidden_size,
+               "num_layers": num_layers, "is_bidirec": is_bidirec,
+               "dropout_prob": dropout_prob, "is_test": is_test, "seed": seed},
+    )
+    return out, last_h, last_c
+
+
+# -- sequence layers ---------------------------------------------------------
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(helper.param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [pre_bias]},
+        attrs={"contextStride": filter_stride,
+               "contextStart": -int(filter_size // 2),
+               "contextLength": filter_size},
+    )
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def _pool(input, pool_type, is_test=False):
+    helper = LayerHelper("sequence_pool", input=input)
+    dtype = helper.input_dtype()
+    pool_out = helper.create_variable_for_type_inference(dtype)
+    max_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input]},
+        outputs={"Out": [pool_out], "MaxIndex": [max_index]},
+        attrs={"pooltype": pool_type.upper(), "is_test": is_test},
+    )
+    return pool_out
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    return _pool(input, pool_type, is_test)
+
+
+def sequence_first_step(input):
+    return _pool(input, "FIRST")
+
+
+def sequence_last_step(input):
+    return _pool(input, "LAST")
+
+
+def _simple_seq_op(op_type, input, attrs=None, extra_inputs=None, dtype=None):
+    helper = LayerHelper(op_type, input=input)
+    out = helper.create_variable_for_type_inference(dtype or helper.input_dtype())
+    inputs = {"X": [input]}
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return _simple_seq_op("sequence_softmax", input)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    return _simple_seq_op("sequence_expand", x, attrs={"ref_level": ref_level},
+                          extra_inputs={"Y": [y]})
+
+
+def sequence_expand_as(x, y, name=None):
+    return _simple_seq_op("sequence_expand_as", x, extra_inputs={"Y": [y]})
+
+
+def sequence_reshape(input, new_dim):
+    return _simple_seq_op("sequence_reshape", input, attrs={"new_dim": new_dim})
+
+
+def sequence_slice(input, offset, length, name=None):
+    return _simple_seq_op("sequence_slice", input,
+                          extra_inputs={"Offset": [offset], "Length": [length]})
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", input=x)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", input=input)
+    inputs = helper.multiple_input()
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": inputs},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    return _simple_seq_op("sequence_enumerate", input,
+                          attrs={"win_size": win_size, "pad_value": pad_value})
+
+
+def sequence_erase(input, tokens, name=None):
+    return _simple_seq_op("sequence_erase", input, attrs={"tokens": tokens})
+
+
+def sequence_scatter(input, index, updates, name=None):
+    return _simple_seq_op("sequence_scatter", input,
+                          extra_inputs={"Ids": [index], "Updates": [updates]})
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad", input=x)
+    dtype = helper.input_dtype()
+    out = helper.create_variable_for_type_inference(dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="sequence_pad",
+        inputs={"X": [x], "PadValue": [pad_value]},
+        outputs={"Out": [out], "Length": [length]},
+        attrs={"padded_length": -1 if maxlen is None else maxlen},
+    )
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    return _simple_seq_op("sequence_unpad", x, extra_inputs={"Length": [length]})
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ..core.proto import DataType, numpy_to_dtype
+    import numpy as np
+
+    helper = LayerHelper("sequence_mask", input=x)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"maxlen": -1 if maxlen is None else maxlen,
+               "out_dtype": int(numpy_to_dtype(np.dtype(dtype)))},
+    )
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    from .nn import _pair
+
+    helper = LayerHelper("im2sequence", input=input)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    pads = padding if isinstance(padding, (list, tuple)) and len(padding) == 4 \
+        else list(_pair(padding)) * 2
+    helper.append_op(
+        type="im2sequence", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"kernels": list(_pair(filter_size)),
+               "strides": list(_pair(stride)), "paddings": list(pads)},
+    )
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", input=input, param_attr=param_attr, act=act)
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(helper.param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [filter_param]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset", input=x)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(type="lod_reset", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"target_lod": target_lod or []})
+    return out

@@ -13,3 +13,4 @@ from .mnist import lenet5  # noqa: F401
 from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
 from .vgg import vgg16  # noqa: F401
 from .transformer import transformer, TransformerConfig  # noqa: F401
+from .stacked_lstm import stacked_dynamic_lstm  # noqa: F401

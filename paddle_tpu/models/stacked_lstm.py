@@ -1,0 +1,73 @@
+"""Stacked dynamic-LSTM sentiment model (reference config:
+benchmark/fluid/models/stacked_dynamic_lstm.py — IMDB sentiment: embedding
+-> LSTM over a variable-length sequence batch -> last-step pool -> softmax).
+
+The reference hand-rolls its LSTM inside a DynamicRNN block; the framework's
+`dynamic_lstm` layer (one fused lax.scan) expresses the same recurrence
+TPU-natively, and `stacked_layers > 1` stacks them the way the
+understand_sentiment book test's stacked_lstm_net does
+(tests/book/test_understand_sentiment.py:64)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import numpy as np
+
+from .. import layers
+from .common import ModelSpec
+
+
+def seq_class_batch(
+    batch_size: int,
+    vocab_size: int,
+    max_len: int,
+    num_classes: int = 2,
+    seed: int = 0,
+) -> Dict[str, np.ndarray]:
+    from ..core.lod import create_lod_tensor
+
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(max(1, max_len // 2), max_len + 1, size=(batch_size,))
+    words = create_lod_tensor(
+        [rng.randint(0, vocab_size, size=(l, 1)).astype(np.int64) for l in lens]
+    )
+    labels = rng.randint(0, num_classes, size=(batch_size, 1)).astype(np.int64)
+    return {"words": words, "label": labels}
+
+
+def stacked_dynamic_lstm(
+    vocab_size: int = 5149,  # imdb.word_dict() size in the reference
+    emb_dim: int = 512,
+    lstm_size: int = 512,
+    stacked_layers: int = 1,
+    class_num: int = 2,
+    max_len: int = 100,
+) -> ModelSpec:
+    words = layers.data("words", shape=[1], dtype="int64", lod_level=1)
+    label = layers.data("label", shape=[1], dtype="int64")
+
+    sentence = layers.embedding(input=words, size=[vocab_size, emb_dim])
+    inp = layers.fc(input=sentence, size=lstm_size, act="tanh")
+    for _ in range(stacked_layers):
+        proj = layers.fc(input=inp, size=lstm_size * 4)
+        hidden, _cell = layers.dynamic_lstm(input=proj, size=lstm_size * 4)
+        inp = hidden
+
+    last = layers.sequence_pool(inp, "last")
+    logit = layers.fc(input=last, size=class_num, act="softmax")
+    cost = layers.cross_entropy(input=logit, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=logit, label=label)
+
+    return ModelSpec(
+        name="stacked_dynamic_lstm",
+        feed_names=[words.name, label.name],
+        loss=avg_cost,
+        metrics={"acc": acc},
+        synthetic_batch=functools.partial(
+            seq_class_batch, vocab_size=vocab_size, max_len=max_len,
+            num_classes=class_num,
+        ),
+    )

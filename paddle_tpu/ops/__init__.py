@@ -15,6 +15,8 @@ from . import (  # noqa: F401
     nn_ops,
     optimizer_ops,
     reduce_ops,
+    rnn_ops,
+    sequence_ops,
     tensor_ops,
 )
 

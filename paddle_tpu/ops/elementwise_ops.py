@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.lod import LoDValue
 from ..core.registry import register_op
 from .common import broadcast_y, data, elemwise_shape, wrap_lod
 
@@ -16,7 +17,12 @@ def _make(name, fn):
     @register_op(name, infer_shape=elemwise_shape)
     def _lower(ctx, ins, attrs, _fn=fn):
         x, y = ins["X"][0], ins["Y"][0]
-        yb = broadcast_y(data(x), data(y), attrs.get("axis", -1))
+        axis = attrs.get("axis", -1)
+        # a LoD X's padded value has an extra time dim vs its desc, so a
+        # desc-relative axis shifts right by one
+        if isinstance(x, LoDValue) and not isinstance(y, LoDValue) and axis >= 0:
+            axis += 1
+        yb = broadcast_y(data(x), data(y), axis)
         return {"Out": [wrap_lod(x, _fn(data(x), yb))]}
 
     return _lower

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.lod import LoDValue
 from ..core.proto import DataType, dtype_to_numpy
 from ..core.registry import register_op
 from .common import data, in_desc, same_shape, set_output, wrap_lod
@@ -41,15 +42,22 @@ def _mul_infer(op, block):
 
 @register_op("mul", infer_shape=_mul_infer)
 def _mul(ctx, ins, attrs):
-    """out = flatten2(X) @ flatten2(Y) (reference: operators/mul_op.cc)."""
-    x, y = data(ins["X"][0]), data(ins["Y"][0])
+    """out = flatten2(X) @ flatten2(Y) (reference: operators/mul_op.cc).
+
+    A LoD input's padded runtime value carries one extra leading time dim vs
+    its token-major desc ([-1, F] desc vs [N, T, F] value), so num_col_dims
+    shifts by one and the output keeps the sequence lengths."""
+    xv = ins["X"][0]
+    x, y = data(xv), data(ins["Y"][0])
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
+    if isinstance(xv, LoDValue):
+        xn += 1
     x2 = _flatten2(x, xn)
     y2 = _flatten2(y, yn)
     out = x2 @ y2
     out_shape = x.shape[:xn] + y.shape[yn:]
-    return {"Out": [jnp.reshape(out, out_shape)]}
+    return {"Out": [wrap_lod(xv, jnp.reshape(out, out_shape))]}
 
 
 def _matmul_infer(op, block):

@@ -1,0 +1,402 @@
+"""Recurrent ops: lstm / lstmp / gru / gru_unit / lstm_unit / cudnn_lstm.
+
+Reference kernels: paddle/fluid/operators/{lstm,lstmp,gru,gru_unit,lstm_unit,
+cudnn_lstm}_op.* over math/detail/{lstm,gru}_kernel.h.  The reference
+re-orders ragged batches by descending length (math/sequence2batch.h) and
+shrinks the active batch each step; the TPU lowering instead runs a
+`lax.scan` over the padded time axis with per-step validity masks — static
+shapes, one fused XLA while-loop, MXU-friendly [N, 4H] matmuls per step.
+
+Gate layouts (must match the reference numerics exactly):
+  lstm/lstmp 4H buffer = [c-candidate, input, forget, output]
+    (math/detail/lstm_cpu_kernel.h:44-47: value_in, value_ig, value_fg,
+     value_og), peephole bias is [b(4H), checkI, checkF, checkO]
+    (lstm_op.cc:75 enforces 7H).
+  lstm_unit 4H buffer = [i, f, o, g] with forget_bias on f
+    (lstm_unit_op.h:63-66).
+  gru/gru_unit 3H buffer = [update, reset, candidate]; h = (1-u)*h_prev +
+    u*c-tilde (math/detail/gru_kernel.h gru_finalOutput; gru_unit_op.h:99-113).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, lengths, set_output, wrap_lod
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name or "identity"]
+
+
+# gru_unit encodes activations as ints (gru_unit_op.h:34 GRUActivationType)
+_INT_ACTS = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+
+def _act_attr(v, default):
+    if v is None:
+        return _act(default)
+    if isinstance(v, int):
+        return _act(_INT_ACTS[v])
+    return _act(v)
+
+
+def _seq_reverse_valid(d, l):
+    """Reverse each row's first l_i tokens in place (pad slots untouched)."""
+    t = d.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < l[:, None], l[:, None] - 1 - ar, ar)
+    return jnp.take_along_axis(
+        d, idx.reshape(idx.shape + (1,) * (d.ndim - 2)).astype(jnp.int32), axis=1
+    )
+
+
+def _scan_time_major(step, carry, xs_nt, mask_nt):
+    """Run `step` over the time axis of [N, T, ...] inputs with [N, T] mask;
+    returns (final_carry, stacked [N, T, ...] pytree of per-step outputs)."""
+    xs_t = jax.tree_util.tree_map(lambda a: jnp.swapaxes(a, 0, 1), xs_nt)
+    mask_t = jnp.swapaxes(mask_nt, 0, 1)  # [T, N]
+
+    def body(c, inp):
+        x_t, m_t = inp
+        return step(c, x_t, m_t[:, None])
+
+    final, ys_t = jax.lax.scan(body, carry, (xs_t, mask_t))
+    ys = jax.tree_util.tree_map(lambda a: jnp.swapaxes(a, 0, 1), ys_t)
+    return final, ys
+
+
+# ---------------------------------------------------------------------------
+# lstm / lstmp
+# ---------------------------------------------------------------------------
+def _lstm_infer(op, block):
+    x = in_desc(op, block, "Input")
+    w = in_desc(op, block, "Weight")
+    if x is None or w is None:
+        return
+    h = w.shape[0]
+    set_output(block, op, "Hidden", [-1, h], x.dtype, lod_level=1)
+    set_output(block, op, "Cell", [-1, h], x.dtype, lod_level=1)
+    for slot in ("BatchGate", "BatchCellPreAct"):
+        if op.output(slot) and op.output(slot)[0]:
+            set_output(block, op, slot, [-1, 4 * h], x.dtype, lod_level=1)
+
+
+def _lstm_core(ctx, ins, attrs, proj_weight=None):
+    x = ins["Input"][0]
+    d = data(x)
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1], dtype=jnp.int32)
+    w = data(ins["Weight"][0])  # [H or P, 4H]
+    hid = w.shape[1] // 4
+    bias = data(ins["Bias"][0]) if ins.get("Bias") and ins["Bias"][0] is not None else None
+    use_peepholes = attrs.get("use_peepholes", True)
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(attrs.get("cell_activation", "tanh"))
+    act_cand = _act(attrs.get("candidate_activation", "tanh"))
+    act_proj = _act(attrs.get("proj_activation", "tanh"))
+
+    b4 = ci = cf = co = None
+    if bias is not None:
+        b = bias.reshape(-1)
+        b4 = b[: 4 * hid]
+        if use_peepholes and b.shape[0] >= 7 * hid:
+            ci = b[4 * hid : 5 * hid]
+            cf = b[5 * hid : 6 * hid]
+            co = b[6 * hid : 7 * hid]
+
+    if attrs.get("is_reverse", False):
+        d = _seq_reverse_valid(d, l)
+
+    n = d.shape[0]
+    h0 = data(ins["H0"][0]) if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros(
+        (n, proj_weight.shape[1] if proj_weight is not None else hid), d.dtype
+    )
+    c0 = data(ins["C0"][0]) if ins.get("C0") and ins["C0"][0] is not None else jnp.zeros((n, hid), d.dtype)
+
+    mask = jnp.arange(d.shape[1])[None, :] < l[:, None]
+
+    def step(carry, x_t, m):
+        h_prev, c_prev = carry
+        gates = x_t + h_prev @ w
+        if b4 is not None:
+            gates = gates + b4
+        g_in, g_i, g_f, g_o = jnp.split(gates, 4, axis=-1)
+        cand = act_cand(g_in)
+        i = act_gate(g_i + (c_prev * ci if ci is not None else 0.0))
+        f = act_gate(g_f + (c_prev * cf if cf is not None else 0.0))
+        c = cand * i + c_prev * f
+        o = act_gate(g_o + (c * co if co is not None else 0.0))
+        h = o * act_cell(c)
+        if proj_weight is not None:
+            h = act_proj(h @ proj_weight)
+        mf = m.astype(d.dtype)
+        h_new = h * mf + h_prev * (1 - mf)
+        c_new = c * mf + c_prev * (1 - mf)
+        gates_act = jnp.concatenate([cand, i, f, o], axis=-1)
+        return (h_new, c_new), (h * mf, c * mf, gates_act * mf, g_in * mf)
+
+    (_, _), (hs, cs, gates_seq, preact) = _scan_time_major(
+        step, (h0, c0), d, mask
+    )
+    if attrs.get("is_reverse", False):
+        hs = _seq_reverse_valid(hs, l)
+        cs = _seq_reverse_valid(cs, l)
+    return hs, cs, gates_seq, preact, l
+
+
+@register_op("lstm", infer_shape=_lstm_infer, diff_inputs=["Input", "Weight", "Bias", "H0", "C0"])
+def _lstm(ctx, ins, attrs):
+    """Sequence LSTM (reference: operators/lstm_op.cc)."""
+    hs, cs, gates, preact, l = _lstm_core(ctx, ins, attrs)
+    return {
+        "Hidden": [LoDValue(hs, l)],
+        "Cell": [LoDValue(cs, l)],
+        "BatchGate": [LoDValue(gates, l)],
+        "BatchCellPreAct": [LoDValue(preact, l)],
+    }
+
+
+def _lstmp_infer(op, block):
+    x = in_desc(op, block, "Input")
+    pw = in_desc(op, block, "ProjWeight")
+    w = in_desc(op, block, "Weight")
+    if x is None or pw is None or w is None:
+        return
+    set_output(block, op, "Projection", [-1, pw.shape[1]], x.dtype, lod_level=1)
+    set_output(block, op, "Cell", [-1, w.shape[1] // 4], x.dtype, lod_level=1)
+
+
+@register_op("lstmp", infer_shape=_lstmp_infer, diff_inputs=["Input", "Weight", "ProjWeight", "Bias", "H0", "C0"])
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference: operators/lstmp_op.cc)."""
+    pw = data(ins["ProjWeight"][0])
+    hs, cs, gates, preact, l = _lstm_core(ctx, ins, attrs, proj_weight=pw)
+    return {
+        "Projection": [LoDValue(hs, l)],
+        "Cell": [LoDValue(cs, l)],
+        "BatchGate": [LoDValue(gates, l)],
+        "BatchCellPreAct": [LoDValue(preact, l)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# gru
+# ---------------------------------------------------------------------------
+def _gru_infer(op, block):
+    x = in_desc(op, block, "Input")
+    w = in_desc(op, block, "Weight")
+    if x is None or w is None:
+        return
+    h = w.shape[0]
+    set_output(block, op, "Hidden", [-1, h], x.dtype, lod_level=1)
+    for slot in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
+        if op.output(slot) and op.output(slot)[0]:
+            width = 3 * h if slot == "BatchGate" else h
+            set_output(block, op, slot, [-1, width], x.dtype, lod_level=1)
+
+
+@register_op("gru", infer_shape=_gru_infer, diff_inputs=["Input", "Weight", "Bias", "H0"])
+def _gru(ctx, ins, attrs):
+    """Sequence GRU (reference: operators/gru_op.cc)."""
+    x = ins["Input"][0]
+    d = data(x)
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1], dtype=jnp.int32)
+    w = data(ins["Weight"][0])  # [H, 3H]
+    hid = w.shape[0]
+    w_ur = w[:, : 2 * hid]
+    w_c = w[:, 2 * hid :]
+    bias = data(ins["Bias"][0]).reshape(-1) if ins.get("Bias") and ins["Bias"][0] is not None else None
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_node = _act(attrs.get("activation", "tanh"))
+
+    if attrs.get("is_reverse", False):
+        d = _seq_reverse_valid(d, l)
+    n = d.shape[0]
+    h0 = data(ins["H0"][0]) if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((n, hid), d.dtype)
+    mask = jnp.arange(d.shape[1])[None, :] < l[:, None]
+
+    def step(h_prev, x_t, m):
+        g = x_t + (bias if bias is not None else 0.0)
+        ur = g[:, : 2 * hid] + h_prev @ w_ur
+        u = act_gate(ur[:, :hid])
+        r = act_gate(ur[:, hid:])
+        rh = r * h_prev
+        c = act_node(g[:, 2 * hid :] + rh @ w_c)
+        h = h_prev - u * h_prev + u * c
+        mf = m.astype(d.dtype)
+        h_new = h * mf + h_prev * (1 - mf)
+        gates = jnp.concatenate([u, r, c], axis=-1)
+        return h_new, (h * mf, rh * mf, gates * mf)
+
+    _, (hs, rhs, gates_seq) = _scan_time_major(step, h0, d, mask)
+    if attrs.get("is_reverse", False):
+        hs = _seq_reverse_valid(hs, l)
+    return {
+        "Hidden": [LoDValue(hs, l)],
+        "BatchGate": [LoDValue(gates_seq, l)],
+        "BatchResetHiddenPrev": [LoDValue(rhs, l)],
+        "BatchHidden": [LoDValue(hs, l)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# gru_unit / lstm_unit (single step)
+# ---------------------------------------------------------------------------
+def _gru_unit_infer(op, block):
+    hp = in_desc(op, block, "HiddenPrev")
+    if hp is None:
+        return
+    h = hp.shape[-1]
+    set_output(block, op, "Hidden", list(hp.shape), hp.dtype)
+    set_output(block, op, "Gate", list(hp.shape[:-1]) + [3 * h], hp.dtype)
+    set_output(block, op, "ResetHiddenPrev", list(hp.shape), hp.dtype)
+
+
+@register_op("gru_unit", infer_shape=_gru_unit_infer, diff_inputs=["Input", "HiddenPrev", "Weight", "Bias"])
+def _gru_unit(ctx, ins, attrs):
+    """One GRU step (reference: operators/gru_unit_op.h:99-113)."""
+    x = data(ins["Input"][0])
+    h_prev = data(ins["HiddenPrev"][0])
+    w = data(ins["Weight"][0])
+    hid = h_prev.shape[-1]
+    bias = data(ins["Bias"][0]).reshape(-1) if ins.get("Bias") and ins["Bias"][0] is not None else 0.0
+    act_gate = _act_attr(attrs.get("gate_activation", 1), "sigmoid")
+    act_node = _act_attr(attrs.get("activation", 2), "tanh")
+    g = x + bias
+    ur = g[:, : 2 * hid] + h_prev @ w[:, : 2 * hid]
+    u = act_gate(ur[:, :hid])
+    r = act_gate(ur[:, hid:])
+    rh = r * h_prev
+    c = act_node(g[:, 2 * hid :] + rh @ w[:, 2 * hid :])
+    h = h_prev - u * h_prev + u * c
+    return {
+        "Hidden": [h],
+        "Gate": [jnp.concatenate([u, r, c], axis=-1)],
+        "ResetHiddenPrev": [rh],
+    }
+
+
+def _lstm_unit_infer(op, block):
+    c = in_desc(op, block, "C_prev")
+    if c is None:
+        return
+    set_output(block, op, "C", list(c.shape), c.dtype)
+    set_output(block, op, "H", list(c.shape), c.dtype)
+
+
+@register_op("lstm_unit", infer_shape=_lstm_unit_infer, diff_inputs=["X", "C_prev"])
+def _lstm_unit(ctx, ins, attrs):
+    """One LSTM step, [i, f, o, g] gate order with forget_bias
+    (reference: operators/lstm_unit_op.h:63-71)."""
+    x = data(ins["X"][0])
+    c_prev = data(ins["C_prev"][0])
+    fb = attrs.get("forget_bias", 0.0)
+    d = c_prev.shape[-1]
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d : 2 * d] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * d : 3 * d])
+    g = jnp.tanh(x[:, 3 * d :])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+# ---------------------------------------------------------------------------
+# cudnn_lstm: dense multi-layer (bi)LSTM over padded [N, T, D]
+# ---------------------------------------------------------------------------
+def _cudnn_lstm_infer(op, block):
+    x = in_desc(op, block, "Input")
+    if x is None:
+        return
+    h = op.attr("hidden_size", 100)
+    bidi = 2 if op.attr("is_bidirec", False) else 1
+    set_output(block, op, "Out", list(x.shape[:-1]) + [h * bidi], x.dtype, lod_level=x.lod_level)
+    set_output(block, op, "last_h", [-1, h], x.dtype)
+    set_output(block, op, "last_c", [-1, h], x.dtype)
+
+
+@register_op("cudnn_lstm", infer_shape=_cudnn_lstm_infer, random=True,
+             diff_inputs=["Input", "W", "InitH", "InitC"])
+def _cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer (bi)LSTM over a dense [T, N, D] batch — TPU replacement
+    for the cuDNN fused path (reference: operators/cudnn_lstm_op.cu.cc).
+    The flat weight W packs, per layer and direction, [Wx (D_in x 4H),
+    Wh (H x 4H), b (4H)] in order; gate order matches cuDNN (i, f, g, o)."""
+    x = data(ins["Input"][0])  # reference feeds [T, N, D]
+    w = data(ins["W"][0]).reshape(-1)
+    hid = int(attrs.get("hidden_size", 100))
+    layers = int(attrs.get("num_layers", 1))
+    bidi = bool(attrs.get("is_bidirec", False))
+    dropout_prob = float(attrs.get("dropout_prob", 0.0))
+    ndir = 2 if bidi else 1
+    t, n = x.shape[0], x.shape[1]
+
+    init_h = data(ins["InitH"][0]) if ins.get("InitH") and ins["InitH"][0] is not None else None
+    init_c = data(ins["InitC"][0]) if ins.get("InitC") and ins["InitC"][0] is not None else None
+
+    def take(off, shape):
+        size = int(np.prod(shape))
+        return w[off : off + size].reshape(shape), off + size
+
+    def run_dir(seq, wx, wh, b, h0, c0, reverse):
+        if reverse:
+            seq = seq[::-1]
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            gates = x_t @ wx + h_prev @ wh + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), hs = jax.lax.scan(step, (h0, c0), seq)
+        if reverse:
+            hs = hs[::-1]
+        return hs, hT, cT
+
+    off = 0
+    inp = x
+    last_h, last_c = [], []
+    for layer in range(layers):
+        d_in = inp.shape[-1]
+        outs = []
+        for direction in range(ndir):
+            wx, off = take(off, (d_in, 4 * hid))
+            wh, off = take(off, (hid, 4 * hid))
+            b, off = take(off, (4 * hid,))
+            li = layer * ndir + direction
+            h0 = init_h[li] if init_h is not None else jnp.zeros((n, hid), x.dtype)
+            c0 = init_c[li] if init_c is not None else jnp.zeros((n, hid), x.dtype)
+            hs, hT, cT = run_dir(inp, wx, wh, b, h0, c0, reverse=(direction == 1))
+            outs.append(hs)
+            last_h.append(hT)
+            last_c.append(cT)
+        inp = jnp.concatenate(outs, axis=-1) if ndir == 2 else outs[0]
+        if dropout_prob > 0.0 and layer < layers - 1 and not ctx.is_test:
+            keep = 1.0 - dropout_prob
+            mask = jax.random.bernoulli(ctx.rng(), keep, inp.shape)
+            inp = jnp.where(mask, inp / keep, 0.0)
+    return {
+        "Out": [inp],
+        "last_h": [jnp.stack(last_h)],
+        "last_c": [jnp.stack(last_c)],
+    }

@@ -1,0 +1,557 @@
+"""Sequence (LoD) ops — segment-aware lowerings over padded LoDValues.
+
+Reference kernels: paddle/fluid/operators/sequence_ops/ (26 ops) plus
+lod_reset, im2sequence, row_conv — all of which shuffle ragged token-major
+buffers imperatively (operators/math/sequence2batch.h, sequence_pooling.cc).
+XLA wants static shapes, so here every sequence op works on the padded
+LoDValue layout (data [N, T, ...], lengths [N]) with masking; XLA fuses the
+masks into the surrounding compute, and there is no layout shuffle at all.
+
+Desc-level shapes stay token-major fluid style ([-1, F], lod_level=1) so
+programs print like the reference's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
+
+
+def _as_lod(x):
+    """(padded data [N, T, ...], lengths [N]) view of a runtime value.
+    Dense inputs are treated as N length-T sequences."""
+    d = data(x)
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1] if d.ndim > 1 else 1, dtype=jnp.int32)
+    return d, l
+
+
+def _time_mask(d, l):
+    """[N, T] bool validity mask."""
+    return jnp.arange(d.shape[1])[None, :] < l[:, None]
+
+
+def _fmask(d, l):
+    """mask broadcast over feature dims of d."""
+    m = _time_mask(d, l)
+    return m.reshape(m.shape + (1,) * (d.ndim - 2))
+
+
+# ---------------------------------------------------------------------------
+# sequence_pool + first/last step
+# ---------------------------------------------------------------------------
+def _seq_pool_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=0)
+    names = op.output("MaxIndex")
+    if names and names[0]:
+        set_output(block, op, "MaxIndex", list(x.shape), DataType.INT32, lod_level=0)
+
+
+@register_op("sequence_pool", infer_shape=_seq_pool_infer, diff_inputs=["X"])
+def _sequence_pool(ctx, ins, attrs):
+    """Pool each sequence to one vector (reference:
+    operators/sequence_ops/sequence_pool_op.cc, math/sequence_pooling.cc).
+    pooltype in {AVERAGE, SUM, SQRT, MAX, LAST, FIRST}."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _fmask(d, l)
+    lf = l.astype(d.dtype).reshape((-1,) + (1,) * (d.ndim - 2))
+    lf = jnp.maximum(lf, 1)
+    max_index = None
+    if ptype == "SUM":
+        out = jnp.sum(d * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(d * m, axis=1) / lf
+    elif ptype == "SQRT":
+        out = jnp.sum(d * m, axis=1) / jnp.sqrt(lf)
+    elif ptype == "MAX":
+        neg = jnp.full_like(d, -jnp.inf) if jnp.issubdtype(d.dtype, jnp.floating) else jnp.full_like(d, jnp.iinfo(d.dtype).min)
+        masked = jnp.where(m, d, neg)
+        out = jnp.max(masked, axis=1)
+        max_index = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        # all-pad rows pool to 0 like the reference's empty-seq behavior
+        out = jnp.where(l.reshape(lf.shape) > 0, out, jnp.zeros_like(out))
+    elif ptype == "LAST":
+        idx = jnp.maximum(l - 1, 0)
+        out = jnp.take_along_axis(
+            d, idx.reshape((-1, 1) + (1,) * (d.ndim - 2)).astype(jnp.int32), axis=1
+        )[:, 0]
+    elif ptype == "FIRST":
+        out = d[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool pooltype {ptype}")
+    outs = {"Out": [out]}
+    if max_index is not None:
+        outs["MaxIndex"] = [max_index]
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# sequence_softmax
+# ---------------------------------------------------------------------------
+@register_op("sequence_softmax", infer_shape=same_shape(), diff_inputs=["X"])
+def _sequence_softmax(ctx, ins, attrs):
+    """Softmax within each sequence over the time axis (reference:
+    operators/sequence_ops/sequence_softmax_op.cc)."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    squeeze = d.ndim == 2
+    v = d if squeeze else d
+    m = _fmask(d, l)
+    neg = jnp.where(m, v, -jnp.inf)
+    # softmax over time (axis=1), invalid slots exactly 0
+    mx = jnp.max(neg, axis=1, keepdims=True)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.exp(v - mx) * m.astype(v.dtype)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    out = e / jnp.maximum(s, 1e-30)
+    return {"Out": [wrap_lod(x, out)]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_expand / expand_as
+# ---------------------------------------------------------------------------
+def _seq_expand_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("sequence_expand", infer_shape=_seq_expand_infer, diff_inputs=["X"])
+def _sequence_expand(ctx, ins, attrs):
+    """Expand X to Y's sequence structure (reference:
+    operators/sequence_ops/sequence_expand_op.cc).  The padded lowering
+    supports the dominant use (a dense row — or a length-1 sequence — per
+    target sequence, broadcast over the target lengths); ragged
+    sequence-count expansion has no static-shape equivalent."""
+    x, y = ins["X"][0], ins["Y"][0]
+    yd, yl = _as_lod(y)
+    xd = data(x)
+    if isinstance(x, LoDValue):
+        if xd.shape[1] == 1:
+            xd = xd[:, 0]
+        else:
+            raise NotImplementedError(
+                "sequence_expand of multi-token sequences has data-dependent "
+                "output sequence counts; restructure with sequence_expand_as"
+            )
+    # xd: [N, F...] -> [N, Ty, F...], masked by y lengths
+    out = jnp.broadcast_to(
+        xd[:, None], (xd.shape[0], yd.shape[1]) + xd.shape[1:]
+    )
+    out = out * _fmask(out, yl).astype(out.dtype)
+    return {"Out": [LoDValue(out, yl)]}
+
+
+@register_op("sequence_expand_as", infer_shape=_seq_expand_infer, diff_inputs=["X"])
+def _sequence_expand_as(ctx, ins, attrs):
+    """Each row of X becomes a sequence of Y's length (reference:
+    operators/sequence_ops/sequence_expand_as_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    yd, yl = _as_lod(y)
+    xd = data(x)
+    if isinstance(x, LoDValue) and xd.shape[1] == 1:
+        xd = xd[:, 0]
+    out = jnp.broadcast_to(xd[:, None], (xd.shape[0], yd.shape[1]) + xd.shape[1:])
+    out = out * _fmask(out, yl).astype(out.dtype)
+    return {"Out": [LoDValue(out, yl)]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_concat
+# ---------------------------------------------------------------------------
+def _seq_concat_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("sequence_concat", infer_shape=_seq_concat_infer, diff_inputs=["X"])
+def _sequence_concat(ctx, ins, attrs):
+    """Concatenate sequences time-wise per row (reference:
+    operators/sequence_ops/sequence_concat_op.cc).  Each row's valid tokens
+    are packed back-to-back with vmapped dynamic_update_slice."""
+    vals = ins["X"]
+    ds, ls = zip(*(_as_lod(v) for v in vals))
+    n = ds[0].shape[0]
+    t_total = sum(d.shape[1] for d in ds)
+    feat = ds[0].shape[2:]
+    out = jnp.zeros((n, t_total) + feat, dtype=ds[0].dtype)
+    off = jnp.zeros((n,), dtype=jnp.int32)
+    for d, l in zip(ds, ls):
+        dm = d * _fmask(d, l).astype(d.dtype)
+        pad_t = t_total - d.shape[1]
+        dm_full = jnp.pad(dm, [(0, 0), (0, pad_t)] + [(0, 0)] * (dm.ndim - 2))
+        # shift row i's valid tokens right by off[i], then add; valid tokens
+        # never wrap because off[i] + l_i <= sum of time dims
+        out = out + jax.vmap(lambda row, o: jnp.roll(row, o, axis=0))(dm_full, off)
+        off = off + l.astype(jnp.int32)
+    return {"Out": [LoDValue(out, off)]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_pad / unpad / mask
+# ---------------------------------------------------------------------------
+def _seq_pad_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    plen = op.attr("padded_length", -1)
+    set_output(block, op, "Out", [-1 if plen in (-1, None) else plen] + list(x.shape[1:]), x.dtype, lod_level=0)
+    if op.output("Length") and op.output("Length")[0]:
+        set_output(block, op, "Length", [-1], DataType.INT64, lod_level=0)
+
+
+@register_op("sequence_pad", infer_shape=_seq_pad_infer, diff_inputs=["X"])
+def _sequence_pad(ctx, ins, attrs):
+    """LoDValue -> (dense padded, lengths) (reference:
+    operators/sequence_ops/sequence_pad_op.cc).  The padded layout is already
+    our native representation; this just fills the pad slots with PadValue."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    pad_value = data(ins["PadValue"][0]) if ins.get("PadValue") else jnp.asarray(0.0, d.dtype)
+    plen = attrs.get("padded_length", -1)
+    if plen not in (-1, None) and plen > d.shape[1]:
+        d = jnp.pad(d, [(0, 0), (0, plen - d.shape[1])] + [(0, 0)] * (d.ndim - 2))
+    m = _fmask(d, l).astype(bool)
+    out = jnp.where(m, d, jnp.broadcast_to(jnp.reshape(pad_value, (1,) * d.ndim if jnp.ndim(pad_value) == 0 else jnp.shape(pad_value)), d.shape))
+    return {"Out": [out], "Length": [l.astype(jnp.int64)]}
+
+
+def _seq_unpad_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", [-1] + list(x.shape[2:]), x.dtype, lod_level=1)
+
+
+@register_op("sequence_unpad", infer_shape=_seq_unpad_infer, diff_inputs=["X"])
+def _sequence_unpad(ctx, ins, attrs):
+    """(dense padded, lengths) -> LoDValue (reference:
+    operators/sequence_ops/sequence_unpad_op.cc)."""
+    d = data(ins["X"][0])
+    l = data(ins["Length"][0]).reshape(-1).astype(jnp.int32)
+    d = d * _fmask(d, l).astype(d.dtype)
+    return {"Out": [LoDValue(d, l)]}
+
+
+def _seq_mask_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    maxlen = op.attr("maxlen", -1)
+    set_output(
+        block, op, "Y", list(x.shape) + [maxlen if maxlen > 0 else -1],
+        DataType(op.attr("out_dtype", int(DataType.INT64))), lod_level=0,
+    )
+
+
+@register_op("sequence_mask", infer_shape=_seq_mask_infer, no_grad=True)
+def _sequence_mask(ctx, ins, attrs):
+    """lengths -> [*, maxlen] 0/1 mask (reference:
+    operators/sequence_ops/sequence_mask_op.cc)."""
+    from ..core.proto import dtype_to_numpy
+
+    x = ins["X"][0]
+    l = data(x)
+    if isinstance(x, LoDValue):
+        l = x.lengths
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen <= 0:
+        if isinstance(x, LoDValue):
+            maxlen = x.data.shape[1]  # the padded time dim is the natural bound
+        else:
+            raise NotImplementedError(
+                "sequence_mask with maxlen=-1 on a dense lengths tensor needs "
+                "a data-dependent shape; pass an explicit maxlen on TPU"
+            )
+    dtype = dtype_to_numpy(DataType(attrs.get("out_dtype", int(DataType.INT64))))
+    mask = (jnp.arange(maxlen) < l[..., None]).astype(dtype)
+    return {"Y": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# sequence_reshape / reverse / slice / erase / enumerate / scatter
+# ---------------------------------------------------------------------------
+def _seq_reshape_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", [-1, op.attr("new_dim", x.shape[-1])], x.dtype, lod_level=1)
+
+
+@register_op("sequence_reshape", infer_shape=_seq_reshape_infer, diff_inputs=["X"])
+def _sequence_reshape(ctx, ins, attrs):
+    """Re-chunk each sequence's flat features to width new_dim (reference:
+    operators/sequence_ops/sequence_reshape_op.cc).  Row-major padded rows
+    keep valid tokens contiguous, so a per-row reshape is exact when
+    (T*F) % new_dim == 0."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    new_dim = int(attrs["new_dim"])
+    n, t = d.shape[0], d.shape[1]
+    f = int(np.prod(d.shape[2:])) if d.ndim > 2 else 1
+    total = t * f
+    if total % new_dim != 0:
+        raise ValueError(f"sequence_reshape: T*F={total} not divisible by new_dim={new_dim}")
+    out = jnp.reshape(d, (n, total // new_dim, new_dim))
+    new_l = (l * f) // new_dim
+    return {"Out": [LoDValue(out, new_l)]}
+
+
+@register_op("sequence_reverse", infer_shape=same_shape("X", "Y"), diff_inputs=["X"])
+def _sequence_reverse(ctx, ins, attrs):
+    """Reverse valid tokens per sequence (reference:
+    operators/sequence_ops/sequence_reverse_op.h — output slot is Y)."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    t = d.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < l[:, None], l[:, None] - 1 - ar, ar)
+    out = jnp.take_along_axis(d, idx.reshape(idx.shape + (1,) * (d.ndim - 2)).astype(jnp.int32), axis=1)
+    return {"Y": [wrap_lod(x, out)]}
+
+
+def _seq_slice_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("sequence_slice", infer_shape=_seq_slice_infer, diff_inputs=["X"])
+def _sequence_slice(ctx, ins, attrs):
+    """Per-sequence (offset, length) window (reference:
+    operators/sequence_ops/sequence_slice_op.h)."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    off = data(ins["Offset"][0]).reshape(-1).astype(jnp.int32)
+    length = data(ins["Length"][0]).reshape(-1).astype(jnp.int32)
+    t = d.shape[1]
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.clip(off[:, None] + ar, 0, t - 1)
+    out = jnp.take_along_axis(d, idx.reshape(idx.shape + (1,) * (d.ndim - 2)), axis=1)
+    out = out * _fmask(out, length).astype(out.dtype)
+    return {"Out": [LoDValue(out, length)]}
+
+
+def _seq_erase_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("sequence_erase", infer_shape=_seq_erase_infer, no_grad=True)
+def _sequence_erase(ctx, ins, attrs):
+    """Drop tokens matching the given values, compacting left (reference:
+    operators/sequence_ops/sequence_erase_op.h)."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    tokens = jnp.asarray(list(attrs.get("tokens", [])), dtype=d.dtype).reshape(-1)
+    valid = _time_mask(d, l)
+    keep = valid & ~jnp.isin(d if d.ndim == 2 else d[..., 0], tokens)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    t = d.shape[1]
+
+    def compact(row, keep_row, pos_row):
+        tgt = jnp.where(keep_row, pos_row, t)  # dumped tokens go past the end
+        out_row = jnp.zeros((t + 1,) + row.shape[1:], dtype=row.dtype)
+        out_row = out_row.at[tgt].set(row * keep_row.reshape((-1,) + (1,) * (row.ndim - 1)).astype(row.dtype))
+        return out_row[:t]
+
+    out = jax.vmap(compact)(d, keep, pos)
+    return {"Out": [LoDValue(out, jnp.sum(keep, axis=1).astype(jnp.int32))]}
+
+
+def _seq_enum_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", [-1, op.attr("win_size", 2)], x.dtype, lod_level=1)
+
+
+@register_op("sequence_enumerate", infer_shape=_seq_enum_infer, no_grad=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of ids (reference:
+    operators/sequence_ops/sequence_enumerate_op.h)."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    if d.ndim == 3 and d.shape[-1] == 1:
+        d = d[..., 0]
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    t = d.shape[1]
+    ar = jnp.arange(t)[:, None] + jnp.arange(win)[None, :]  # [T, win]
+    padded = jnp.pad(d, [(0, 0), (0, win)], constant_values=pad)
+    out = padded[:, ar]  # [N, T, win]
+    in_range = (ar[None] < l[:, None, None])
+    out = jnp.where(in_range, out, pad)
+    return {"Out": [LoDValue(out, l)]}
+
+
+def _seq_scatter_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=x.lod_level)
+
+
+@register_op("sequence_scatter", infer_shape=_seq_scatter_infer, diff_inputs=["X", "Updates"])
+def _sequence_scatter(ctx, ins, attrs):
+    """Per-row scatter-add of Updates at Ids (reference:
+    operators/sequence_ops/sequence_scatter_op.cc — X row i receives
+    updates from sequence i)."""
+    xd = data(ins["X"][0])
+    ids, il = _as_lod(ins["Ids"][0])
+    upd, _ = _as_lod(ins["Updates"][0])
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    m = _time_mask(ids, il).astype(upd.dtype)
+    upd = upd * m.reshape(m.shape + (1,) * (upd.ndim - 2))
+
+    def row_scatter(xrow, idrow, updrow):
+        return xrow.at[idrow].add(updrow)
+
+    out = jax.vmap(row_scatter)(xd, ids.astype(jnp.int32), upd)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# lod_reset
+# ---------------------------------------------------------------------------
+@register_op("lod_reset", infer_shape=same_shape(), diff_inputs=["X"])
+def _lod_reset(ctx, ins, attrs):
+    """Attach/replace sequence lengths (reference: operators/lod_reset_op.cc).
+    In the padded world this re-labels the row lengths; the dominant use —
+    adopting another LoDValue's structure onto same-shaped data — is exact."""
+    x = ins["X"][0]
+    d = data(x)
+    y = ins.get("Y", [None])[0]
+    if y is not None:
+        if isinstance(y, LoDValue):
+            return {"Out": [LoDValue(d, y.lengths)]}
+        ly = data(y).reshape(-1)
+        # offsets -> lengths
+        l = (ly[1:] - ly[:-1]).astype(jnp.int32)
+        return {"Out": [LoDValue(d, l)]}
+    target = attrs.get("target_lod", [])
+    if not target:
+        return {"Out": [d]}
+    l = np.diff(np.asarray(target)).astype(np.int32)
+    if d.ndim >= 2 and d.shape[0] == len(l):
+        return {"Out": [LoDValue(d, jnp.asarray(l))]}
+    raise NotImplementedError(
+        "lod_reset that re-chunks a flat token buffer needs a ragged->padded "
+        "relayout; feed padded [num_seqs, T, ...] data instead"
+    )
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv / row_conv / im2sequence
+# ---------------------------------------------------------------------------
+def _seq_conv_infer(op, block):
+    x = in_desc(op, block, "Filter")
+    xin = in_desc(op, block, "X")
+    if x is None or xin is None:
+        return
+    set_output(block, op, "Out", [-1, x.shape[1]], xin.dtype, lod_level=1)
+
+
+@register_op("sequence_conv", infer_shape=_seq_conv_infer, diff_inputs=["X", "Filter"])
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (reference:
+    operators/sequence_ops/sequence_conv_op.cc, math/context_project.h):
+    im2col the [contextStart, contextStart+contextLength) window per step
+    (zero outside the sequence) then one matmul with the filter."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    filt = data(ins["Filter"][0])  # [context_length * F, out]
+    clen = int(attrs.get("contextLength", 3))
+    cstart = int(attrs.get("contextStart", -((clen - 1) // 2)))
+    n, t = d.shape[0], d.shape[1]
+    f = d.shape[2]
+    m = _fmask(d, l).astype(d.dtype)
+    dm = d * m
+    cols = []
+    for j in range(clen):
+        shift = cstart + j
+        rolled = jnp.roll(dm, -shift, axis=1)
+        ar = jnp.arange(t) + shift
+        ok = (ar >= 0) & (ar < t)
+        rolled = rolled * ok[None, :, None].astype(d.dtype)
+        # also mask against each sequence's own length
+        ok_seq = (ar[None, :] < l[:, None]) & (ar[None, :] >= 0)
+        rolled = rolled * ok_seq[..., None].astype(d.dtype)
+        cols.append(rolled)
+    ctx_feat = jnp.concatenate(cols, axis=-1)  # [N, T, clen*F]
+    out = jnp.einsum("ntf,fo->nto", ctx_feat, filt)
+    out = out * _time_mask(d, l)[..., None].astype(out.dtype)
+    return {"Out": [LoDValue(out, l)]}
+
+
+def _row_conv_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("row_conv", infer_shape=_row_conv_infer, diff_inputs=["X", "Filter"])
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference: operators/row_conv_op.cc):
+    out[t] = sum_j x[t+j] * w[j], j in [0, future_context]."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    w = data(ins["Filter"][0])  # [future_context + 1, F]
+    t = d.shape[1]
+    m = _fmask(d, l).astype(d.dtype)
+    dm = d * m
+    out = jnp.zeros_like(d)
+    for j in range(w.shape[0]):
+        shifted = jnp.roll(dm, -j, axis=1)
+        ok = (jnp.arange(t) + j < t)[None, :, None].astype(d.dtype)
+        ok_seq = ((jnp.arange(t)[None, :] + j) < l[:, None])[..., None].astype(d.dtype)
+        out = out + shifted * ok * ok_seq * w[j][None, None, :]
+    out = out * m
+    return {"Out": [wrap_lod(x, out)]}
+
+
+def _im2sequence_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    kh, kw = op.attr("kernels", [3, 3])
+    set_output(block, op, "Out", [-1, x.shape[1] * kh * kw], x.dtype, lod_level=1)
+
+
+@register_op("im2sequence", infer_shape=_im2sequence_infer, diff_inputs=["X"])
+def _im2sequence(ctx, ins, attrs):
+    """NCHW image -> sequence of flattened patches (reference:
+    operators/im2sequence_op.cc)."""
+    x = data(ins["X"][0])
+    kh, kw = attrs.get("kernels", [3, 3])
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])  # up, left, down, right
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        padding=[(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, OH, OW]
+    n, ckk = patches.shape[0], patches.shape[1]
+    out = jnp.transpose(patches.reshape(n, ckk, -1), (0, 2, 1))  # [N, OH*OW, C*kh*kw]
+    lengths = jnp.full((n,), out.shape[1], dtype=jnp.int32)
+    return {"Out": [LoDValue(out, lengths)]}

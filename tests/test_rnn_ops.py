@@ -1,0 +1,196 @@
+"""RNN op numerics: masked-scan lowerings vs per-sequence numpy recurrences
+(reference: unittests/test_lstm_op.py, test_gru_op.py — same equations,
+ragged layout)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import create_lod_tensor
+
+RNG = np.random.RandomState(3)
+LENS = [4, 2, 5]
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_seq(x4h, w, b4, peep, h0, c0):
+    """Reference recurrence (math/detail/lstm_kernel.h forward::lstm):
+    gate order [c-cand, i, f, o]."""
+    hid = w.shape[0]
+    ci, cf, co = peep if peep is not None else (None, None, None)
+    h, c = h0.copy(), c0.copy()
+    hs = []
+    for t in range(x4h.shape[0]):
+        g = x4h[t] + h @ w + b4
+        g_in, g_i, g_f, g_o = np.split(g, 4)
+        cand = np.tanh(g_in)
+        i = sigmoid(g_i + (c * ci if ci is not None else 0))
+        f = sigmoid(g_f + (c * cf if cf is not None else 0))
+        c = cand * i + c * f
+        o = sigmoid(g_o + (c * co if co is not None else 0))
+        h = o * np.tanh(c)
+        hs.append(h.copy())
+    return np.stack(hs)
+
+
+@pytest.mark.parametrize("use_peepholes", [True, False])
+def test_dynamic_lstm_matches_numpy(use_peepholes):
+    hid = 8
+    seqs = [RNG.randn(l, 4 * hid).astype(np.float32) * 0.5 for l in LENS]
+    x = fluid.layers.data("x", [4 * hid], dtype="float32", lod_level=1)
+    h, _c = fluid.layers.dynamic_lstm(
+        input=x, size=4 * hid, use_peepholes=use_peepholes,
+        param_attr=fluid.ParamAttr(name="lstm_w"),
+        bias_attr=fluid.ParamAttr(name="lstm_b"),
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"x": create_lod_tensor(seqs)}, fetch_list=[h])
+    w = np.asarray(fluid.global_scope().find_var("lstm_w"))
+    b = np.asarray(fluid.global_scope().find_var("lstm_b")).ravel()
+    b4 = b[: 4 * hid]
+    peep = (b[4 * hid:5 * hid], b[5 * hid:6 * hid], b[6 * hid:7 * hid]) if use_peepholes else None
+    for i, s in enumerate(seqs):
+        expect = np_lstm_seq(s, w, b4, peep, np.zeros(hid, np.float32), np.zeros(hid, np.float32))
+        np.testing.assert_allclose(res.data[i, : len(s)], expect, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(res.data[i, len(s):], 0.0, atol=1e-6)
+
+
+def test_dynamic_lstm_reverse():
+    hid = 4
+    seqs = [RNG.randn(l, 4 * hid).astype(np.float32) * 0.5 for l in [3, 5]]
+    x = fluid.layers.data("x", [4 * hid], dtype="float32", lod_level=1)
+    h, _ = fluid.layers.dynamic_lstm(
+        input=x, size=4 * hid, use_peepholes=False, is_reverse=True,
+        param_attr=fluid.ParamAttr(name="w"), bias_attr=fluid.ParamAttr(name="b"),
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"x": create_lod_tensor(seqs)}, fetch_list=[h])
+    w = np.asarray(fluid.global_scope().find_var("w"))
+    b4 = np.asarray(fluid.global_scope().find_var("b")).ravel()[: 4 * hid]
+    for i, s in enumerate(seqs):
+        fwd = np_lstm_seq(s[::-1], w, b4, None, np.zeros(hid, np.float32), np.zeros(hid, np.float32))
+        np.testing.assert_allclose(res.data[i, : len(s)], fwd[::-1], rtol=1e-4, atol=1e-5)
+
+
+def np_gru_seq(x3h, w, b, h0):
+    hid = w.shape[0]
+    h = h0.copy()
+    hs = []
+    for t in range(x3h.shape[0]):
+        g = x3h[t] + b
+        ur = g[: 2 * hid] + h @ w[:, : 2 * hid]
+        u, r = sigmoid(ur[:hid]), sigmoid(ur[hid:])
+        c = np.tanh(g[2 * hid:] + (r * h) @ w[:, 2 * hid:])
+        h = h - u * h + u * c
+        hs.append(h.copy())
+    return np.stack(hs)
+
+
+def test_dynamic_gru_matches_numpy():
+    hid = 6
+    seqs = [RNG.randn(l, 3 * hid).astype(np.float32) * 0.5 for l in LENS]
+    x = fluid.layers.data("x", [3 * hid], dtype="float32", lod_level=1)
+    h = fluid.layers.dynamic_gru(
+        input=x, size=hid,
+        param_attr=fluid.ParamAttr(name="gru_w"),
+        bias_attr=fluid.ParamAttr(name="gru_b"),
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"x": create_lod_tensor(seqs)}, fetch_list=[h])
+    w = np.asarray(fluid.global_scope().find_var("gru_w"))
+    b = np.asarray(fluid.global_scope().find_var("gru_b")).ravel()
+    for i, s in enumerate(seqs):
+        expect = np_gru_seq(s, w, b, np.zeros(hid, np.float32))
+        np.testing.assert_allclose(res.data[i, : len(s)], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_step():
+    hid = 5
+    x = fluid.layers.data("x", [3 * hid], dtype="float32")
+    hprev = fluid.layers.data("h", [hid], dtype="float32")
+    hnew, _rh, _g = fluid.layers.gru_unit(
+        input=x, hidden=hprev, size=3 * hid,
+        param_attr=fluid.ParamAttr(name="w"), bias_attr=fluid.ParamAttr(name="b"),
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = RNG.randn(3, 3 * hid).astype(np.float32)
+    hv = RNG.randn(3, hid).astype(np.float32)
+    (res,) = exe.run(feed={"x": xv, "h": hv}, fetch_list=[hnew])
+    w = np.asarray(fluid.global_scope().find_var("w"))
+    b = np.asarray(fluid.global_scope().find_var("b")).ravel()
+    for row in range(3):
+        g = xv[row] + b
+        ur = g[: 2 * hid] + hv[row] @ w[:, : 2 * hid]
+        u, r = sigmoid(ur[:hid]), sigmoid(ur[hid:])
+        c = np.tanh(g[2 * hid:] + (r * hv[row]) @ w[:, 2 * hid:])
+        expect = hv[row] - u * hv[row] + u * c
+        np.testing.assert_allclose(res[row], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unit_step():
+    hid = 4
+    x = fluid.layers.data("x", [8], dtype="float32")
+    hprev = fluid.layers.data("hp", [hid], dtype="float32")
+    cprev = fluid.layers.data("cp", [hid], dtype="float32")
+    h, c = fluid.layers.lstm_unit(x_t=x, hidden_t_prev=hprev, cell_t_prev=cprev,
+                                  forget_bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feeds = {
+        "x": RNG.randn(2, 8).astype(np.float32),
+        "hp": RNG.randn(2, hid).astype(np.float32),
+        "cp": RNG.randn(2, hid).astype(np.float32),
+    }
+    hv, cv = exe.run(feed=feeds, fetch_list=[h, c])
+    assert hv.shape == (2, hid) and cv.shape == (2, hid)
+    assert np.isfinite(hv).all() and np.isfinite(cv).all()
+
+
+def test_cudnn_lstm_layer():
+    t, n, d, hid = 6, 3, 5, 7
+    # dense [T, N, D] input: build with explicit shape
+    prog = fluid.default_main_program()
+    xv = prog.global_block().create_var(name="seq_in", shape=[t, n, d], dtype="float32",
+                                        stop_gradient=True)
+    init_h = prog.global_block().create_var(name="init_h", shape=[1, n, hid], dtype="float32",
+                                            stop_gradient=True)
+    init_c = prog.global_block().create_var(name="init_c", shape=[1, n, hid], dtype="float32",
+                                            stop_gradient=True)
+    out, lh, lc = fluid.layers.lstm(xv, init_h, init_c, max_len=t,
+                                    hidden_size=hid, num_layers=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feeds = {
+        "seq_in": RNG.randn(t, n, d).astype(np.float32),
+        "init_h": np.zeros((2, n, hid), np.float32),
+        "init_c": np.zeros((2, n, hid), np.float32),
+    }
+    o, h_last, c_last = exe.run(feed=feeds, fetch_list=[out, lh, lc])
+    assert o.shape == (t, n, hid)
+    assert h_last.shape == (2, n, hid)
+
+
+def test_stacked_dynamic_lstm_trains():
+    """Milestone: the stacked_dynamic_lstm benchmark model trains
+    (reference: benchmark/fluid/models/stacked_dynamic_lstm.py)."""
+    from paddle_tpu import models
+
+    spec = models.stacked_dynamic_lstm(
+        vocab_size=100, emb_dim=16, lstm_size=16, max_len=12
+    )
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    batch = spec.synthetic_batch(8)
+    losses = []
+    for _ in range(15):
+        (l,) = exe.run(feed=batch, fetch_list=[spec.loss])
+        losses.append(float(np.ravel(l)[0]))
+    assert losses[-1] < losses[0]

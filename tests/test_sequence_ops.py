@@ -1,0 +1,222 @@
+"""Sequence-op numerics vs numpy ragged references — the OpTest idea
+(reference: unittests/op_test.py + test_sequence_*.py): compute each op on a
+ragged python batch with numpy, compare against the padded lowering."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDValue, create_lod_tensor
+
+RNG = np.random.RandomState(7)
+LENS = [3, 5, 1, 4]
+
+
+def ragged(feat=(6,), lens=LENS, dtype=np.float32):
+    return [RNG.randn(l, *feat).astype(dtype) for l in lens]
+
+
+def run_fetch(build, feeds):
+    out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fetched = exe.run(feed=feeds, fetch_list=[out] if not isinstance(out, (list, tuple)) else out)
+    return fetched
+
+
+def lod_feed(seqs):
+    return create_lod_tensor(seqs)
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("sum", lambda s: s.sum(0)),
+    ("average", lambda s: s.mean(0)),
+    ("sqrt", lambda s: s.sum(0) / np.sqrt(len(s))),
+    ("max", lambda s: s.max(0)),
+    ("first", lambda s: s[0]),
+    ("last", lambda s: s[-1]),
+])
+def test_sequence_pool(ptype, ref):
+    seqs = ragged()
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_pool(
+            fluid.layers.data("x", [6], dtype="float32", lod_level=1), ptype
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    expect = np.stack([ref(s) for s in seqs])
+    np.testing.assert_allclose(np.asarray(res), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax():
+    seqs = ragged(feat=(1,))
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_softmax(
+            fluid.layers.data("x", [1], dtype="float32", lod_level=1)
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    res = res.data
+    for i, s in enumerate(seqs):
+        e = np.exp(s - s.max())
+        np.testing.assert_allclose(res[i, : len(s)], e / e.sum(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(res[i, len(s):], 0.0, atol=1e-7)
+
+
+def test_sequence_reverse():
+    seqs = ragged()
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_reverse(
+            fluid.layers.data("x", [6], dtype="float32", lod_level=1)
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    for i, s in enumerate(seqs):
+        np.testing.assert_allclose(res.data[i, : len(s)], s[::-1], rtol=1e-6)
+
+
+def test_sequence_concat():
+    a, b = ragged(feat=(4,)), ragged(feat=(4,), lens=[2, 1, 3, 2])
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_concat([
+            fluid.layers.data("a", [4], dtype="float32", lod_level=1),
+            fluid.layers.data("b", [4], dtype="float32", lod_level=1),
+        ]),
+        {"a": lod_feed(a), "b": lod_feed(b)},
+    )
+    for i in range(len(a)):
+        cat = np.concatenate([a[i], b[i]], axis=0)
+        np.testing.assert_allclose(res.data[i, : len(cat)], cat, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [5, 6, 4, 6])
+
+
+def test_sequence_expand_dense():
+    x = RNG.randn(4, 3).astype(np.float32)
+    yseqs = ragged(feat=(2,))
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_expand(
+            fluid.layers.data("x", [3], dtype="float32"),
+            fluid.layers.data("y", [2], dtype="float32", lod_level=1),
+        ),
+        {"x": x, "y": lod_feed(yseqs)},
+    )
+    for i, s in enumerate(yseqs):
+        np.testing.assert_allclose(res.data[i, : len(s)], np.tile(x[i], (len(s), 1)), rtol=1e-6)
+
+
+def test_sequence_pad_unpad_mask():
+    seqs = ragged(feat=(2,))
+    x = fluid.layers.data("x", [2], dtype="float32", lod_level=1)
+    pad_value = fluid.layers.fill_constant([1], "float32", 9.0)
+    out, length = fluid.layers.sequence_pad(x, pad_value)
+    mask = fluid.layers.sequence_mask(x, maxlen=5, dtype="float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    o, l, m = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[out, length, mask])
+    assert o.shape == (4, 5, 2)
+    np.testing.assert_array_equal(np.asarray(l).ravel(), LENS)
+    for i, s in enumerate(seqs):
+        np.testing.assert_allclose(o[i, : len(s)], s, rtol=1e-6)
+        np.testing.assert_allclose(o[i, len(s):], 9.0)
+        np.testing.assert_array_equal(m[i], (np.arange(5) < len(s)).astype(np.float32))
+
+
+def test_sequence_reshape():
+    seqs = [RNG.randn(l, 4).astype(np.float32) for l in [2, 4]]
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_reshape(
+            fluid.layers.data("x", [4], dtype="float32", lod_level=1), new_dim=2
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    for i, s in enumerate(seqs):
+        flat = s.reshape(-1, 2)
+        np.testing.assert_allclose(res.data[i, : len(flat)], flat, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [4, 8])
+
+
+def test_sequence_erase():
+    seqs = [np.array([[1], [2], [3], [2]], np.int64), np.array([[2], [2]], np.int64)]
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_erase(
+            fluid.layers.data("x", [1], dtype="int64", lod_level=1), tokens=[2]
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    np.testing.assert_array_equal(np.asarray(res.lengths), [2, 0])
+    np.testing.assert_array_equal(res.data[0, :2, 0], [1, 3])
+
+
+def test_sequence_enumerate():
+    seqs = [np.array([[1], [2], [3]], np.int64), np.array([[4], [5]], np.int64)]
+    (res,) = run_fetch(
+        lambda: fluid.layers.sequence_enumerate(
+            fluid.layers.data("x", [1], dtype="int64", lod_level=1),
+            win_size=2, pad_value=0,
+        ),
+        {"x": lod_feed(seqs)},
+    )
+    np.testing.assert_array_equal(res.data[0, :3], [[1, 2], [2, 3], [3, 0]])
+    np.testing.assert_array_equal(res.data[1, :2], [[4, 5], [5, 0]])
+
+
+def test_sequence_conv_matches_manual_window():
+    seqs = ragged(feat=(3,))
+    x = fluid.layers.data("x", [3], dtype="float32", lod_level=1)
+    out = fluid.layers.sequence_conv(
+        x, num_filters=4, filter_size=3,
+        param_attr=fluid.ParamAttr(name="sconv_w"),
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[out])
+    w = np.asarray(fluid.global_scope().find_var("sconv_w"))  # [9, 4]
+    for i, s in enumerate(seqs):
+        padded = np.concatenate([np.zeros((1, 3)), s, np.zeros((1, 3))], axis=0)
+        for t in range(len(s)):
+            win = padded[t : t + 3].reshape(-1).astype(np.float32)
+            np.testing.assert_allclose(res.data[i, t], win @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv():
+    seqs = ragged(feat=(3,), lens=[4, 2])
+    x = fluid.layers.data("x", [3], dtype="float32", lod_level=1)
+    out = fluid.layers.row_conv(
+        x, future_context_size=2, param_attr=fluid.ParamAttr(name="rc_w")
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[out])
+    w = np.asarray(fluid.global_scope().find_var("rc_w"))  # [3, 3]
+    for i, s in enumerate(seqs):
+        for t in range(len(s)):
+            exp = sum(s[t + j] * w[j] for j in range(3) if t + j < len(s))
+            np.testing.assert_allclose(res.data[i, t], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_im2sequence():
+    img = RNG.randn(2, 1, 4, 4).astype(np.float32)
+    (res,) = run_fetch(
+        lambda: fluid.layers.im2sequence(
+            fluid.layers.data("img", [1, 4, 4], dtype="float32"),
+            filter_size=2, stride=2,
+        ),
+        {"img": img},
+    )
+    assert res.data.shape == (2, 4, 4)
+    np.testing.assert_allclose(res.data[0, 0], img[0, 0, :2, :2].reshape(-1), rtol=1e-6)
+
+
+def test_sequence_ops_have_gradients():
+    """End-to-end: loss through sequence_conv+pool backprops and trains."""
+    seqs = ragged(feat=(3,))
+    x = fluid.layers.data("x", [3], dtype="float32", lod_level=1, stop_gradient=True)
+    conv = fluid.layers.sequence_conv(x, num_filters=4, filter_size=3)
+    pool = fluid.layers.sequence_pool(conv, "sum")
+    loss = fluid.layers.mean(pool)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    v0 = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[loss])[0]
+    for _ in range(5):
+        v = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[loss])[0]
+    assert float(np.ravel(v)[0]) != pytest.approx(float(np.ravel(v0)[0]))
