@@ -152,25 +152,53 @@ def _dedup_grad_outputs(
     if not dup:
         return grad_ops
 
-    renames: Dict[str, List[str]] = defaultdict(list)
-    last_producer: Dict[str, int] = {}
+    # SSA versioning in execution order.  Two producer kinds:
+    # * parallel contribution (forward var had several consumers) — summed
+    #   with the running total right after the producing op;
+    # * in-place flow-through (op consumes AND produces the same grad, e.g.
+    #   while_grad over a loop-carried var) — chained: the op reads the
+    #   current version and its output becomes the new current version.
+    version: Dict[str, int] = defaultdict(int)
+    cur: Dict[str, str] = {}
+
+    def fresh(n: str) -> str:
+        v = f"{n}@RENAME@{version[n]}"
+        version[n] += 1
+        return v
+
     out_ops: List[OpDesc] = []
     for op in grad_ops:
-        for slot, names in op.outputs.items():
+        orig_in = {x for row in op.inputs.values() for x in row}
+        for names in op.inputs.values():
             for j, n in enumerate(names):
-                if n in dup:
-                    new = f"{n}@RENAME@{len(renames[n])}"
-                    renames[n].append(new)
-                    names[j] = new
-                    last_producer[n] = len(out_ops)
+                if n in dup and n in cur:
+                    names[j] = cur[n]
+        pending_sums: List[OpDesc] = []
+        for names in op.outputs.values():
+            for j, n in enumerate(names):
+                if n not in dup:
+                    continue
+                v = fresh(n)
+                names[j] = v
+                if n in orig_in:
+                    cur[n] = v  # chain
+                elif n in cur:
+                    w = fresh(n)
+                    pending_sums.append(
+                        OpDesc(type="sum", inputs={"X": [cur[n], v]},
+                               outputs={"Out": [w]})
+                    )
+                    cur[n] = w
+                else:
+                    cur[n] = v
         out_ops.append(op)
+        out_ops.extend(pending_sums)
 
-    # insert sum ops (in reverse position order so indices stay valid)
-    for n, pos in sorted(last_producer.items(), key=lambda kv: -kv[1]):
-        sum_op = OpDesc(
-            type="sum", inputs={"X": renames[n]}, outputs={"Out": [n]}
+    # bind the final version to the canonical grad name
+    for n, v in cur.items():
+        out_ops.append(
+            OpDesc(type="assign", inputs={"X": [v]}, outputs={"Out": [n]})
         )
-        out_ops.insert(pos + 1, sum_op)
     return out_ops
 
 

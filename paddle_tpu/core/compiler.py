@@ -98,8 +98,33 @@ def _bind_outputs(ctx: LoweringContext, op: OpDesc, outs: Dict[str, Any]) -> Non
                 ctx.env[name] = val
 
 
+def _has_inexact_leaf(v) -> bool:
+    for leaf in jax.tree_util.tree_leaves(v):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+            return True
+        if isinstance(leaf, float):
+            return True
+    return False
+
+
+class _Const:
+    """Marker wrapping a non-differentiable input kept out of the vjp trace.
+
+    Integer/bool values (loop counters, conditions, rank tables, indices)
+    must stay *concrete* inside a differentiated lowering so trace-time
+    control flow (while unrolling, array indexing) still sees python ints;
+    lifting them into jax.vjp arguments would turn them into tracers."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+
 def _flatten_ins(ins: Dict[str, List[Any]]):
-    """Flatten dict-of-lists into (leaves, spec) keeping None placeholders."""
+    """Flatten dict-of-lists into (leaves, spec).  Differentiable (float)
+    values become vjp leaves; everything else rides along as a constant."""
     spec = []
     leaves = []
     for slot in sorted(ins):
@@ -107,16 +132,22 @@ def _flatten_ins(ins: Dict[str, List[Any]]):
         for v in ins[slot]:
             if v is None:
                 row.append(None)
-            else:
+            elif _has_inexact_leaf(v):
                 row.append(len(leaves))
                 leaves.append(v)
+            else:
+                row.append(_Const(v))
         spec.append((slot, row))
     return leaves, spec
 
 
 def _unflatten_ins(leaves, spec) -> Dict[str, List[Any]]:
     return {
-        slot: [None if i is None else leaves[i] for i in row] for slot, row in spec
+        slot: [
+            None if i is None else (i.v if isinstance(i, _Const) else leaves[i])
+            for i in row
+        ]
+        for slot, row in spec
     }
 
 
@@ -159,11 +190,19 @@ def _leaf_cotangent(primal, g):
 def _make_cotangent(primal, g):
     """Build a vjp cotangent matching `primal`'s pytree structure.  LoDValue
     primals take the grad on .data (the incoming grad may be a bare array or
-    an LoDValue) and a float0 cotangent for the integer lengths."""
+    an LoDValue) and a float0 cotangent for the integer lengths.  Tensor
+    arrays take per-step cotangents."""
     if isinstance(primal, LoDValue):
         gdata = g.data if isinstance(g, LoDValue) else g
         return LoDValue(
             _leaf_cotangent(primal.data, gdata), _float0_zeros(primal.lengths)
+        )
+    from .tensor_array import TensorArrayValue
+
+    if isinstance(primal, TensorArrayValue):
+        gs = g.steps if isinstance(g, TensorArrayValue) else [None] * len(primal)
+        return TensorArrayValue(
+            [_make_cotangent(p, gg) for p, gg in zip(primal.steps, gs)]
         )
     return _leaf_cotangent(primal, g)
 
@@ -178,9 +217,22 @@ def _sanitize_input_grad(g, primal):
         if getattr(gd, "dtype", None) == jax.dtypes.float0:
             gd = jnp.zeros_like(primal.data)
         return LoDValue(gd, primal.lengths)
+    from .tensor_array import TensorArrayValue
+
+    if isinstance(g, TensorArrayValue):
+        return TensorArrayValue(
+            [_sanitize_input_grad(gg, p) for gg, p in zip(g.steps, primal.steps)]
+        )
     if getattr(g, "dtype", None) == jax.dtypes.float0:
         return jnp.zeros_like(primal)
     return g
+
+
+def _all_concrete(ins: Dict[str, List[Any]]) -> bool:
+    for leaf in jax.tree_util.tree_leaves(ins):
+        if isinstance(leaf, jax.core.Tracer):
+            return False
+    return True
 
 
 def _lower_forward_op(ctx: LoweringContext, op: OpDesc, need_vjp: bool) -> None:
@@ -189,7 +241,17 @@ def _lower_forward_op(ctx: LoweringContext, op: OpDesc, need_vjp: bool) -> None:
     attrs = dict(op.attrs)
 
     if not need_vjp or info.no_grad:
-        outs = info.lower(ctx, ins, attrs)
+        # Constant folding: pure ops over concrete values evaluate at trace
+        # time (jax.ensure_compile_time_eval), so loop counters, conditions
+        # and sequence bookkeeping stay concrete and `while` ops can unroll
+        # with static trip counts (the reference pins these to CPU with
+        # force_cpu fill_constants; here they fold out of the program
+        # entirely).
+        if not info.random and not info.stateful and _all_concrete(ins):
+            with jax.ensure_compile_time_eval():
+                outs = info.lower(ctx, ins, attrs)
+        else:
+            outs = info.lower(ctx, ins, attrs)
         _bind_outputs(ctx, op, outs)
         return
 
@@ -256,6 +318,13 @@ def _lower_grad_op(ctx: LoweringContext, op: OpDesc) -> None:
         out_names = op.outputs.get(slot + GRAD_SUFFIX, [])
         for pos, i in enumerate(row):
             if i is None or pos >= len(out_names) or not out_names[pos]:
+                continue
+            if isinstance(i, _Const):
+                # non-differentiable input: a named grad slot still gets a
+                # zeros pytree so downstream accumulation stays well-formed
+                ctx.env[out_names[pos]] = jax.tree_util.tree_map(
+                    jnp.zeros_like, i.v
+                )
                 continue
             g = _sanitize_input_grad(in_grads[i], primal_ins[i])
             if g is not None:

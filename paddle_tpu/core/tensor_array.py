@@ -1,0 +1,61 @@
+"""LoDTensorArray runtime value.
+
+The reference's LOD_TENSOR_ARRAY (framework.proto:105 VarType, operators/
+controlflow/ read_from_array/write_to_array) is a mutable vector of
+LoDTensors living in a Scope.  The TPU-native value is a *functional*
+sequence of JAX values registered as a pytree: writes return a new array
+(copy-on-write over the step list), so it traces cleanly through jit and
+jax.vjp.  Step indices are concrete at trace time (control-flow trip counts
+are static under XLA), so reads/writes are plain list indexing, not
+dynamic-slice gymnastics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+__all__ = ["TensorArrayValue"]
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayValue:
+    """Immutable sequence of step values."""
+
+    def __init__(self, steps=None):
+        self.steps: List[Any] = list(steps) if steps is not None else []
+
+    def tree_flatten(self):
+        return tuple(self.steps), len(self.steps)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children))
+
+    def __len__(self):
+        return len(self.steps)
+
+    def read(self, i: int):
+        i = int(i)
+        if i >= len(self.steps):
+            raise IndexError(
+                f"read_from_array: index {i} out of range (len {len(self.steps)})"
+            )
+        return self.steps[i]
+
+    def write(self, i: int, value) -> "TensorArrayValue":
+        i = int(i)
+        steps = list(self.steps)
+        if i == len(steps):
+            steps.append(value)
+        elif i < len(steps):
+            steps[i] = value
+        else:
+            raise IndexError(
+                f"write_to_array: index {i} skips past end (len {len(steps)})"
+            )
+        return TensorArrayValue(steps)
+
+    def __repr__(self):
+        return f"TensorArrayValue(len={len(self.steps)})"

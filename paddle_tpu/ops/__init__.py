@@ -8,6 +8,7 @@ JAX lowering rules consumed by paddle_tpu.core.compiler.
 from . import (  # noqa: F401
     activation_ops,
     compare_ops,
+    control_flow_ops,
     elementwise_ops,
     loss_ops,
     math_ops,

@@ -1,0 +1,400 @@
+"""Control-flow op lowerings: while / conditional_block / tensor arrays.
+
+Reference kernels: paddle/fluid/operators/controlflow/ (while_op.cc,
+conditional_block_op.cc, tensor_array_read_write_op.cc), plus
+lod_rank_table_op.cc, max_sequence_len_op.cc, lod_tensor_to_array_op.cc,
+array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+split_lod_tensor_op.cc / merge_lod_tensor_op.cc.
+
+TPU-native design, replacing the reference's scope-per-step interpreter:
+
+* Trip counts of sequence loops are *static* under the padded LoDValue
+  layout (max_sequence_len == the padded time axis), so `while` lowers by
+  unrolling the sub-block at trace time whenever its condition is concrete
+  — XLA sees straight-line code it can fuse, and jax.vjp differentiates the
+  whole loop with zero bespoke grad code (the reference needs a 500-line
+  while_grad_op).  A lax.while_loop fallback covers traced conditions on
+  the no-grad path.
+* The reference's shrink_rnn_memory / rank-table reordering exists to skip
+  finished sequences — a dynamic-shape trick XLA can't use.  Here the full
+  padded batch runs every step and sequence lengths mask the results
+  downstream (array_to_lod_tensor restores the LoD view), trading a few
+  masked FLOPs for static shapes on the MXU.
+* conditional_block / split+merge_lod_tensor compute branches on the full
+  batch and select by mask (jnp.where), the standard SPMD if-conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from ..core.tensor_array import TensorArrayValue
+from .common import data, in_desc, lengths, same_shape, set_output
+
+
+class RankTableValue:
+    """Runtime value of a LOD_RANK_TABLE variable: per-sequence lengths plus
+    the static padded max length (a python int, so sequence-loop trip counts
+    stay concrete at trace time)."""
+
+    def __init__(self, seq_lengths, max_len: int):
+        self.lengths = seq_lengths
+        self.max_len = int(max_len)
+
+    def tree_flatten(self):
+        return (self.lengths,), self.max_len
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+
+jax.tree_util.register_pytree_node_class(RankTableValue)
+
+
+def _is_concrete(x) -> bool:
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _concrete_bool(x) -> bool:
+    return bool(np.asarray(x).reshape(-1)[0])
+
+
+# ---------------------------------------------------------------------------
+# tensor array read / write / length
+# ---------------------------------------------------------------------------
+def _array_write_infer(op, block):
+    # the array var's desc carries the *element* shape so read_from_array
+    # can propagate it
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    names = op.output("Out")
+    if names and names[0]:
+        from ..core.proto import VarType
+
+        v = block._find_var_recursive(names[0])
+        if v is not None:
+            # update wherever the array lives (it may be in a parent block
+            # while this write op sits inside a while sub-block)
+            v.desc.shape = list(x.shape)
+            v.desc.dtype = DataType(x.dtype)
+        else:
+            block.create_var(
+                name=names[0], shape=list(x.shape), dtype=x.dtype,
+                type=VarType.LOD_TENSOR_ARRAY,
+            )
+
+
+@register_op("write_to_array", infer_shape=_array_write_infer,
+             diff_inputs=["X", "Array"])
+def _write_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = ins["I"][0]
+    # reference semantics: Out is updated in place in the scope; here the
+    # prior value arrives via the optional Array input slot (copy-on-write)
+    prev = ins.get("Array", [None])[0]
+    base = prev if isinstance(prev, TensorArrayValue) else TensorArrayValue()
+    return {"Out": [base.write(int(np.asarray(i).reshape(-1)[0]), x)]}
+
+
+@register_op("read_from_array", infer_shape=same_shape("X", "Out"), diff_inputs=["X"])
+def _read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = ins["I"][0]
+    return {"Out": [arr.read(int(np.asarray(i).reshape(-1)[0]))]}
+
+
+@register_op("lod_array_length", no_grad=True)
+def _lod_array_length(ctx, ins, attrs):
+    # numpy (not jnp) so the length stays concrete under an outer jit trace
+    return {"Out": [np.asarray([len(ins["X"][0])], dtype=np.int64)]}
+
+
+@register_op("create_array", no_grad=True)
+def _create_array(ctx, ins, attrs):
+    return {"Out": [TensorArrayValue()]}
+
+
+def _unstack_array_infer(op, block):
+    x = in_desc(op, block, "X")
+    names = op.output("Out")
+    if names and names[0] and not block.desc.has_var(names[0]):
+        from ..core.proto import VarType
+
+        block.create_var(
+            name=names[0],
+            shape=list(x.shape[1:]) if x is not None else [],
+            dtype=x.dtype if x is not None else DataType.FP32,
+            type=VarType.LOD_TENSOR_ARRAY,
+        )
+
+
+@register_op("unstack_into_array", infer_shape=_unstack_array_infer,
+             diff_inputs=["X"])
+def _unstack_into_array(ctx, ins, attrs):
+    """Dense tensor -> tensor array of slices along `axis` (TPU-native helper
+    for StaticRNN; reference uses recurrent_op's in-kernel slicing)."""
+    x = data(ins["X"][0])
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Out": [TensorArrayValue(
+        [jnp.take(x, t, axis=axis) for t in range(n)]
+    )]}
+
+
+def _stack_array_infer(op, block):
+    pass
+
+
+@register_op("stack_from_array", infer_shape=_stack_array_infer,
+             diff_inputs=["X"])
+def _stack_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    return {"Out": [jnp.stack(list(arr.steps), axis=attrs.get("axis", 0))]}
+
+
+# ---------------------------------------------------------------------------
+# rank table / sequence-loop plumbing
+# ---------------------------------------------------------------------------
+def _rank_table_infer(op, block):
+    names = op.output("Out")
+    if names and names[0] and not block.desc.has_var(names[0]):
+        from ..core.proto import VarType
+
+        block.create_var(
+            name=names[0], shape=[], dtype=DataType.INT64, type=VarType.RAW
+        )
+
+
+@register_op("lod_rank_table", infer_shape=_rank_table_infer, no_grad=True)
+def _lod_rank_table(ctx, ins, attrs):
+    x = ins["X"][0]
+    d = data(x)
+    l = lengths(x)
+    max_len = d.shape[1] if d.ndim > 1 else 1
+    if l is None:
+        l = jnp.full((d.shape[0],), max_len, dtype=jnp.int32)
+    return {"Out": [RankTableValue(l, max_len)]}
+
+
+@register_op("max_sequence_len", no_grad=True)
+def _max_sequence_len(ctx, ins, attrs):
+    rt = ins["RankTable"][0]
+    # numpy + the static aux max_len -> concrete under trace -> while unrolls
+    return {"Out": [np.asarray([rt.max_len], dtype=np.int64)]}
+
+
+def _lod_to_array_infer(op, block):
+    # LoD desc shapes are token-major [-1, F]; a per-step element keeps the
+    # same desc shape, so the array desc mirrors X
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    names = op.output("Out")
+    if names and names[0]:
+        set_output(block, op, "Out", list(x.shape), x.dtype)
+
+
+@register_op("lod_tensor_to_array", infer_shape=_lod_to_array_infer, diff_inputs=["X"])
+def _lod_tensor_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    d = data(x)
+    # full-batch step slices; masking happens downstream via lengths
+    return {"Out": [TensorArrayValue([d[:, t] for t in range(d.shape[1])])]}
+
+
+def _array_to_lod_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=1)
+
+
+@register_op("array_to_lod_tensor", infer_shape=_array_to_lod_infer, diff_inputs=["X"])
+def _array_to_lod_tensor(ctx, ins, attrs):
+    arr = ins["X"][0]
+    rt = ins["RankTable"][0]
+    stacked = jnp.stack(list(arr.steps), axis=1)
+    return {"Out": [LoDValue(stacked, rt.lengths)]}
+
+
+@register_op("shrink_rnn_memory", infer_shape=same_shape("X", "Out"), diff_inputs=["X"])
+def _shrink_rnn_memory(ctx, ins, attrs):
+    # Reference shrinks the batch to sequences still alive at step I
+    # (shrink_rnn_memory_op.cc).  Static-shape equivalent: keep the full
+    # batch; downstream masking by lengths yields identical results.
+    return {"Out": [ins["X"][0]]}
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+def _while_infer(op, block):
+    pass
+
+
+@register_op("while", infer_shape=_while_infer, random=True)
+def _while(ctx, ins, attrs):
+    from ..core.compiler import LoweringContext, lower_op
+
+    sub_block = ctx.program.block(attrs["sub_block"])
+    x_names: List[str] = attrs["__x_names__"]
+    out_names: List[str] = attrs["__out_names__"]
+    cond_name: str = attrs["__cond_name__"]
+    max_unroll = attrs.get("max_unroll", 4096)
+
+    env: Dict[str, Any] = dict(zip(x_names, ins["X"]))
+    cond = ins["Condition"][0]
+    base_key = ctx.rng()
+
+    if _is_concrete(cond):
+        it = 0
+        while _concrete_bool(cond):
+            if it >= max_unroll:
+                raise RuntimeError(
+                    f"while op exceeded max_unroll={max_unroll} iterations"
+                )
+            inner = LoweringContext(
+                ctx.program, sub_block, env, jax.random.fold_in(base_key, it),
+                mesh=ctx.mesh, is_test=ctx.is_test,
+            )
+            for op in sub_block.desc.ops:
+                lower_op(inner, op, frozenset())
+            cond = env[cond_name]
+            if not _is_concrete(cond):
+                raise RuntimeError(
+                    "while condition became data-dependent mid-loop; give the "
+                    "loop a static trip count (padded max_sequence_len)"
+                )
+            it += 1
+        return {"Out": [env.get(n) for n in out_names]}
+
+    # Data-dependent condition: lax.while_loop over the carried vars.
+    # Reverse-mode autodiff cannot cross lax.while_loop, so this path serves
+    # inference/decode loops (e.g. beam search) only.
+    carry_names = list(dict.fromkeys(list(out_names) + [cond_name]))
+    env.setdefault(cond_name, cond)
+    missing = [n for n in carry_names if n not in env]
+    if missing:
+        raise RuntimeError(f"while carry vars missing initial values: {missing}")
+
+    def cond_fn(carry):
+        env_c = dict(zip(carry_names, carry))
+        return jnp.reshape(env_c[cond_name], ())
+
+    def body_fn(carry):
+        env_c = dict(env)
+        env_c.update(zip(carry_names, carry))
+        inner = LoweringContext(
+            ctx.program, sub_block, env_c, base_key,
+            mesh=ctx.mesh, is_test=ctx.is_test,
+        )
+        for op in sub_block.desc.ops:
+            lower_op(inner, op, frozenset())
+        return tuple(env_c[n] for n in carry_names)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, tuple(env[n] for n in carry_names))
+    env_f = dict(zip(carry_names, final))
+    return {"Out": [env_f.get(n) for n in out_names]}
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+@register_op("conditional_block")
+def _conditional_block(ctx, ins, attrs):
+    from ..core.compiler import LoweringContext, lower_op
+
+    sub_block = ctx.program.block(attrs["sub_block"])
+    x_names: List[str] = attrs["__x_names__"]
+    out_names: List[str] = attrs["__out_names__"]
+    is_scalar = attrs.get("is_scalar_condition", True)
+
+    cond = ins["Cond"][0]
+    env: Dict[str, Any] = dict(zip(x_names, ins["X"]))
+    prior = {n: env.get(n) for n in out_names}
+
+    if _is_concrete(cond) and is_scalar:
+        if not _concrete_bool(cond):
+            return {"Out": [prior.get(n) for n in out_names]}
+        inner = LoweringContext(
+            ctx.program, sub_block, env, ctx.rng(), mesh=ctx.mesh,
+            is_test=ctx.is_test,
+        )
+        for op in sub_block.desc.ops:
+            lower_op(inner, op, frozenset())
+        return {"Out": [env.get(n) for n in out_names]}
+
+    # traced condition: if-conversion — run the block, select outputs
+    inner = LoweringContext(
+        ctx.program, sub_block, env, ctx.rng(), mesh=ctx.mesh, is_test=ctx.is_test,
+    )
+    for op in sub_block.desc.ops:
+        lower_op(inner, op, frozenset())
+    flag = jnp.reshape(jnp.asarray(cond), (-1,))[0]
+    outs = []
+    for n in out_names:
+        new = env.get(n)
+        old = prior.get(n)
+        if old is None:
+            old = jax.tree_util.tree_map(jnp.zeros_like, new)
+        outs.append(
+            jax.tree_util.tree_map(lambda a, b: jnp.where(flag, a, b), new, old)
+        )
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# split / merge lod tensor (IfElse batch routing)
+# ---------------------------------------------------------------------------
+def _split_lod_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "OutTrue", list(x.shape), x.dtype, lod_level=x.lod_level)
+    set_output(block, op, "OutFalse", list(x.shape), x.dtype, lod_level=x.lod_level)
+
+
+@register_op("split_lod_tensor", infer_shape=_split_lod_infer, diff_inputs=["X"])
+def _split_lod_tensor(ctx, ins, attrs):
+    # Reference splits rows into two dense tensors (dynamic shapes).  Static
+    # equivalent: both branches see the full batch; merge_lod_tensor selects.
+    x = ins["X"][0]
+    return {"OutTrue": [x], "OutFalse": [x]}
+
+
+def _merge_lod_infer(op, block):
+    x = in_desc(op, block, "InTrue") or in_desc(op, block, "InFalse")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype, lod_level=x.lod_level)
+
+
+@register_op("merge_lod_tensor", infer_shape=_merge_lod_infer,
+             diff_inputs=["InTrue", "InFalse"])
+def _merge_lod_tensor(ctx, ins, attrs):
+    t = data(ins["InTrue"][0])
+    f = data(ins["InFalse"][0])
+    mask = data(ins["Mask"][0])
+    mask = jnp.reshape(mask, (mask.shape[0],) + (1,) * (t.ndim - 1)) != 0
+    return {"Out": [jnp.where(mask, t, f)]}
+
+
+# ---------------------------------------------------------------------------
+# print
+# ---------------------------------------------------------------------------
+@register_op("print", infer_shape=same_shape("In", "Out"), diff_inputs=["In"])
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    d = data(x)
+    msg = attrs.get("message", "") or ""
+    jax.debug.print(msg + " {}", d, ordered=False)
+    return {"Out": [x]}

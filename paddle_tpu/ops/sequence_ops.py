@@ -106,14 +106,12 @@ def _sequence_softmax(ctx, ins, attrs):
     operators/sequence_ops/sequence_softmax_op.cc)."""
     x = ins["X"][0]
     d, l = _as_lod(x)
-    squeeze = d.ndim == 2
-    v = d if squeeze else d
     m = _fmask(d, l)
-    neg = jnp.where(m, v, -jnp.inf)
+    neg = jnp.where(m, d, -jnp.inf)
     # softmax over time (axis=1), invalid slots exactly 0
     mx = jnp.max(neg, axis=1, keepdims=True)
     mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
-    e = jnp.exp(v - mx) * m.astype(v.dtype)
+    e = jnp.exp(d - mx) * m.astype(d.dtype)
     s = jnp.sum(e, axis=1, keepdims=True)
     out = e / jnp.maximum(s, 1e-30)
     return {"Out": [wrap_lod(x, out)]}
@@ -491,9 +489,8 @@ def _sequence_conv(ctx, ins, attrs):
         shift = cstart + j
         rolled = jnp.roll(dm, -shift, axis=1)
         ar = jnp.arange(t) + shift
-        ok = (ar >= 0) & (ar < t)
-        rolled = rolled * ok[None, :, None].astype(d.dtype)
-        # also mask against each sequence's own length
+        # mask against each sequence's own length (l <= t, so this also
+        # covers the padded-window bound)
         ok_seq = (ar[None, :] < l[:, None]) & (ar[None, :] >= 0)
         rolled = rolled * ok_seq[..., None].astype(d.dtype)
         cols.append(rolled)
@@ -523,9 +520,8 @@ def _row_conv(ctx, ins, attrs):
     out = jnp.zeros_like(d)
     for j in range(w.shape[0]):
         shifted = jnp.roll(dm, -j, axis=1)
-        ok = (jnp.arange(t) + j < t)[None, :, None].astype(d.dtype)
         ok_seq = ((jnp.arange(t)[None, :] + j) < l[:, None])[..., None].astype(d.dtype)
-        out = out + shifted * ok * ok_seq * w[j][None, None, :]
+        out = out + shifted * ok_seq * w[j][None, None, :]
     out = out * m
     return {"Out": [wrap_lod(x, out)]}
 

@@ -1,0 +1,180 @@
+"""Control flow: While, arrays, StaticRNN, DynamicRNN, IfElse, Switch.
+
+Mirrors reference tests: test_while_op.py, test_dyn_rnn.py,
+test_recurrent_op.py, test_ifelse*.py, test_switch.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _run(feed, fetch_list, startup=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch_list)
+
+
+def test_while_accumulate():
+    # sum 0..9 with a while loop (reference: test_while_op.py style)
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    ten = layers.fill_constant(shape=[1], dtype="int64", value=10)
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.less_than(x=i, y=ten)
+    w = layers.While(cond=cond)
+    with w.block():
+        acc2 = layers.cast(i, "float32")
+        layers.sums([acc, acc2], out=acc)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=ten, cond=cond)
+    (out,) = _run({}, [acc], startup=False)
+    assert float(np.ravel(out)[0]) == sum(range(10))
+
+
+def test_array_write_read():
+    x = layers.fill_constant(shape=[2, 3], dtype="float32", value=7.0)
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    arr = layers.array_write(x, i)
+    n = layers.array_length(arr)
+    y = layers.array_read(arr, i)
+    outs = _run({}, [y, n], startup=False)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((2, 3), 7.0))
+    assert int(np.ravel(outs[1])[0]) == 1
+
+
+def test_static_rnn_matches_numpy():
+    T, N, F, H = 4, 3, 5, 5
+    x = layers.data("x", [T, N, F], append_batch_size=False, dtype="float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        word = rnn.step_input(x)
+        prev = rnn.memory(shape=[-1, H], batch_ref=word, value=0.0)
+        hidden = layers.elementwise_add(word, prev)
+        rnn.update_memory(prev, hidden)
+        rnn.step_output(hidden)
+    out = rnn()
+    xv = np.random.RandomState(0).randn(T, N, F).astype("float32")
+    (got,) = _run({"x": xv}, [out], startup=False)
+    want = np.cumsum(xv, axis=0)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_dynamic_rnn_trains():
+    # cumulative-sum RNN over variable-length sequences; check loss + grads
+    H = 8
+    sent = layers.data("sent", [6], dtype="float32", lod_level=1)
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(sent)
+        prev = drnn.memory(shape=[H], value=0.0)
+        hidden = layers.fc(input=[word, prev], size=H, act="tanh")
+        drnn.update_memory(prev, hidden)
+        drnn.output(hidden)
+    last = layers.sequence_last_step(drnn())
+    loss = layers.mean(last)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    rng = np.random.RandomState(1)
+    feed_val = create_lod_tensor(
+        [rng.randn(3, 6).astype("float32"), rng.randn(5, 6).astype("float32")]
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(5):
+        (lv,) = exe.run(feed={"sent": feed_val}, fetch_list=[loss])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # SGD on mean(last) drives it down
+
+
+def test_dynamic_rnn_respects_lengths():
+    # identity RNN: output last step must be the true last element per row
+    sent = layers.data("sent", [2], dtype="float32", lod_level=1)
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(sent)
+        drnn.output(word)
+    last = layers.sequence_last_step(drnn())
+    s0 = np.array([[1, 1], [2, 2]], dtype="float32")
+    s1 = np.array([[3, 3], [4, 4], [5, 5], [6, 6]], dtype="float32")
+    feed_val = create_lod_tensor([s0, s1])
+    (got,) = _run({"sent": feed_val}, [last], startup=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.array([[2, 2], [6, 6]], dtype="float32")
+    )
+
+
+def test_ifelse_rowwise():
+    x = layers.data("x", [1], dtype="float32")
+    zero = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = layers.greater_than(x, zero)
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        d = ie.input(x)
+        ie.output(layers.scale(d, scale=2.0))
+    with ie.false_block():
+        d = ie.input(x)
+        ie.output(layers.scale(d, scale=-1.0))
+    (out,) = ie()
+    xv = np.array([[1.0], [-2.0], [3.0], [-4.0]], dtype="float32")
+    (got,) = _run({"x": xv}, [out], startup=False)
+    want = np.where(xv > 0, 2 * xv, -xv)
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_switch_piecewise():
+    # Switch picks the first true case (reference: test_switch.py)
+    step = layers.data("step", [1], append_batch_size=False, dtype="float32")
+    lr = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    b1 = layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+    b2 = layers.fill_constant(shape=[1], dtype="float32", value=20.0)
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, b1)):
+            layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=0.1), lr
+            )
+        with switch.case(layers.less_than(step, b2)):
+            layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=0.01), lr
+            )
+        with switch.default():
+            layers.assign(
+                layers.fill_constant(shape=[1], dtype="float32", value=0.001), lr
+            )
+    for sv, want in [(5.0, 0.1), (15.0, 0.01), (25.0, 0.001)]:
+        (got,) = _run(
+            {"step": np.array([sv], dtype="float32")}, [lr], startup=False
+        )
+        assert float(np.ravel(got)[0]) == pytest.approx(want)
+
+
+def test_while_grad_through_array():
+    # grads must flow through while + arrays into a parameter
+    x = layers.data("x", [4], dtype="float32")
+    proj = layers.fc(input=x, size=4, bias_attr=False)
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=3)
+    arr = layers.array_write(proj, i)
+    cond = layers.less_than(x=i, y=n)
+    w = layers.While(cond=cond)
+    with w.block():
+        prev = layers.array_read(arr, i)
+        nxt = layers.scale(prev, scale=0.5)
+        layers.increment(x=i, value=1, in_place=True)
+        layers.array_write(nxt, i, array=arr)
+        layers.less_than(x=i, y=n, cond=cond)
+    final = layers.array_read(arr, n)
+    # hack: read at index 3 == last write
+    loss = layers.mean(final)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+    xv = np.ones((2, 4), dtype="float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    l0 = float(np.ravel(np.asarray(exe.run(feed={"x": xv}, fetch_list=[loss])[0]))[0])
+    l1 = float(np.ravel(np.asarray(exe.run(feed={"x": xv}, fetch_list=[loss])[0]))[0])
+    assert l1 != l0  # parameter moved => grad reached the fc weight
