@@ -42,7 +42,11 @@ from .core.executor import Executor  # noqa: F401
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
 
+from . import average  # noqa: F401
 from . import clip  # noqa: F401
+from . import io  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401
 from .parallel.executor import CompiledProgram  # noqa: F401

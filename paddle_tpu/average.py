@@ -1,0 +1,37 @@
+"""WeightedAverage (reference: python/paddle/fluid/average.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, np.ndarray)) or np.isscalar(var)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            value = np.asarray(value)
+        if not np.isscalar(weight):
+            weight = float(np.ravel(np.asarray(weight))[0])
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError("WeightedAverage has no accumulated values")
+        return self.numerator / self.denominator

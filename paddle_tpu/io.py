@@ -1,0 +1,298 @@
+"""Checkpoint / model IO (reference: python/paddle/fluid/io.py).
+
+The reference saves by appending `save`/`load` ops (operators/save_op.cc)
+and running them through an executor; variables serialize as LoDTensor blobs
+with a version header.  TPU-native equivalent: checkpointing is a *host*
+concern — values are pulled from the Scope (device->host), written as numpy
+blobs, and restored by name.  The public API mirrors io.py:89-704:
+save/load_vars, save/load_params, save/load_persistables,
+save/load_inference_model.
+
+Layout on disk (dirname/):
+    <var_name>            one numpy .npy blob per var (save_vars default)
+    <filename>            single .npz when filename= given (save_combine)
+    __model__             program desc JSON (save_inference_model)
+    __lod__/<var_name>    sequence lengths sidecar for LoDValues
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .core.framework import Program, Variable, default_main_program
+from .core.lod import LoDValue
+from .core.proto import VarType
+from .core.scope import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "get_inference_program",
+]
+
+
+def is_persistable(var: Variable) -> bool:
+    """reference: io.py is_persistable — skips reader/raw vars."""
+    if var.desc.type in (VarType.RAW, VarType.READER, VarType.LOD_TENSOR_ARRAY):
+        return False
+    return bool(var.persistable)
+
+
+def is_parameter(var: Variable) -> bool:
+    from .core.framework import Parameter
+
+    return isinstance(var, Parameter)
+
+
+def _var_value(scope, name: str):
+    v = scope.find_var(name)
+    if v is None:
+        raise ValueError(f"variable '{name}' has no value in scope")
+    return v
+
+
+def _to_host(value):
+    if isinstance(value, LoDValue):
+        return np.asarray(value.data), np.asarray(value.lengths)
+    return np.asarray(value), None
+
+
+def save_vars(
+    executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence] = None,
+    predicate: Optional[Callable] = None,
+    filename: Optional[str] = None,
+) -> None:
+    """Save selected vars from the executor's scope (reference: io.py:89)."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [
+            v
+            for v in main_program.global_block().vars.values()
+            if predicate is None or predicate(v)
+        ]
+    names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+
+    os.makedirs(dirname, exist_ok=True)
+    scope = getattr(executor, "scope", None) or global_scope()
+    blobs = {}
+    lods = {}
+    for n in names:
+        data, lengths = _to_host(_var_value(scope, n))
+        blobs[n] = data
+        if lengths is not None:
+            lods[n] = lengths
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **blobs)
+    else:
+        for n, data in blobs.items():
+            np.save(os.path.join(dirname, n + ".npy"), data)
+    if lods:
+        lod_dir = os.path.join(dirname, "__lod__")
+        os.makedirs(lod_dir, exist_ok=True)
+        for n, lengths in lods.items():
+            np.save(os.path.join(lod_dir, n + ".npy"), lengths)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference: io.py save_params."""
+    return save_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:270 save_persistables."""
+    return save_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename,
+    )
+
+
+def load_vars(
+    executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence] = None,
+    predicate: Optional[Callable] = None,
+    filename: Optional[str] = None,
+) -> None:
+    """reference: io.py load_vars; values land directly in the scope."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [
+            v
+            for v in main_program.global_block().vars.values()
+            if predicate is None or predicate(v)
+        ]
+    names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+
+    scope = getattr(executor, "scope", None) or global_scope()
+    combined = None
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not os.path.exists(path):
+            path = path + ".npz"
+        combined = np.load(path)
+    lod_dir = os.path.join(dirname, "__lod__")
+    for n in names:
+        if combined is not None:
+            if n not in combined:
+                raise ValueError(f"variable '{n}' missing from {filename}")
+            data = combined[n]
+        else:
+            path = os.path.join(dirname, n + ".npy")
+            if not os.path.exists(path):
+                raise ValueError(f"no saved file for variable '{n}' in {dirname}")
+            data = np.load(path)
+        lod_path = os.path.join(lod_dir, n + ".npy")
+        if os.path.exists(lod_path):
+            scope.set_var(n, LoDValue(data, np.load(lod_path)))
+        else:
+            scope.set_var(n, data)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename,
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:490 load_persistables."""
+    return load_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename,
+    )
+
+
+# ---------------------------------------------------------------------------
+# program pruning + inference model export
+# ---------------------------------------------------------------------------
+def _prune_for_targets(
+    program: Program, feed_names: Sequence[str], target_names: Sequence[str]
+) -> Program:
+    """Backward-reachability prune of block 0, stopping at fed vars
+    (reference: framework/prune.cc via Program._prune).  Sub-blocks
+    referenced by kept ops survive whole."""
+    pruned = program.clone()
+    block = pruned.desc.block(0)
+    feeds = set(feed_names)
+    needed = set(target_names) - feeds
+    kept = []
+    for op in reversed(block.ops):
+        outs = set(op.output_arg_names())
+        if outs & needed:
+            kept.append(op)
+            for n in op.input_arg_names():
+                if n not in feeds:
+                    needed.add(n)
+    kept.reverse()
+    # drop feed/fetch ops from prior runs; the predictor re-injects its own
+    block.ops[:] = [op for op in kept if op.type not in ("feed", "fetch")]
+    return pruned
+
+
+def _referenced_persistables(program: Program) -> List[str]:
+    """Persistable vars block 0's ops actually touch (shared by
+    save_inference_model / load_inference_model)."""
+    block = program.desc.block(0)
+    referenced = set()
+    for op in block.ops:
+        referenced.update(op.input_arg_names())
+        referenced.update(op.output_arg_names())
+    return [
+        name
+        for name, vd in block.vars.items()
+        if vd.persistable and name in referenced
+        and vd.type not in (VarType.RAW, VarType.READER, VarType.LOD_TENSOR_ARRAY)
+    ]
+
+
+def get_inference_program(target_vars, main_program=None) -> Program:
+    """reference: io.py get_inference_program."""
+    main_program = main_program or default_main_program()
+    targets = [
+        t.name if isinstance(t, Variable) else str(t) for t in target_vars
+    ]
+    pruned = _prune_for_targets(main_program, [], targets)
+    return _for_test(pruned)
+
+
+def _for_test(program: Program) -> Program:
+    return program.clone(for_test=True)
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor,
+    main_program: Optional[Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    export_for_deployment: bool = True,
+) -> None:
+    """Prune to the inference graph + save params (reference: io.py:570)."""
+    main_program = main_program or default_main_program()
+    target_names = [
+        t.name if isinstance(t, Variable) else str(t) for t in target_vars
+    ]
+    inference_program = _for_test(
+        _prune_for_targets(main_program, feeded_var_names, target_names)
+    )
+
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": inference_program.desc.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "w") as f:
+        json.dump(model, f)
+
+    # save every persistable the pruned program still references
+    save_vars(
+        executor, dirname, main_program,
+        vars=_referenced_persistables(inference_program),
+        filename=params_filename,
+    )
+
+
+def load_inference_model(
+    dirname: str,
+    executor,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+):
+    """reference: io.py:704 — returns (program, feed_names, fetch_targets)."""
+    from .core.proto import ProgramDesc
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        model = json.load(f)
+    program = Program()
+    program.desc = ProgramDesc.from_dict(model["program"])
+    from .core.framework import Block
+
+    program.blocks = [Block(program, i) for i in range(program.desc.num_blocks())]
+    program.current_block_idx = 0
+
+    load_vars(
+        executor, dirname, program, vars=_referenced_persistables(program),
+        filename=params_filename,
+    )
+    fetch_targets = [
+        program.global_block().var(n) for n in model["fetch_names"]
+    ]
+    return program, model["feed_names"], fetch_targets

@@ -1,8 +1,17 @@
 """fluid.layers equivalent: the public layer-function namespace."""
 
-from . import control_flow, io, nn, ops, sequence_nn, tensor  # noqa: F401
+from . import (  # noqa: F401
+    control_flow,
+    io,
+    learning_rate_scheduler,
+    nn,
+    ops,
+    sequence_nn,
+    tensor,
+)
 from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .sequence_nn import *  # noqa: F401,F403

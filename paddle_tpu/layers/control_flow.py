@@ -322,8 +322,10 @@ class While:
         program = self.helper.main_program
         parent_block = program.current_block()
         sub_block = program._create_block()
-        yield
-        program._rollback()
+        try:
+            yield
+        finally:
+            program._rollback()
         x_names, out_names = _analyze_block_io(
             sub_block, include_read_outputs=False
         )
@@ -385,7 +387,11 @@ class StaticRNN:
         )
         self._sub_block = program._create_block()
         self._in_rnn = True
-        yield
+        try:
+            yield
+        except BaseException:
+            program._rollback()
+            raise
         self._in_rnn = False
         self._complete()
 
@@ -619,7 +625,11 @@ class DynamicRNN:
         )
         self._sub_block = program._create_block()
         self.status = DynamicRNN.IN_RNN
-        yield
+        try:
+            yield
+        except BaseException:
+            program._rollback()
+            raise
         self.status = DynamicRNN.AFTER_RNN
         self._complete()
 
@@ -790,8 +800,10 @@ def _block_guard(program, block):
     """Temporarily make `block` the program's current block."""
     saved = program.current_block_idx
     program.current_block_idx = block.idx
-    yield
-    program.current_block_idx = saved
+    try:
+        yield
+    finally:
+        program.current_block_idx = saved
 
 
 # ---------------------------------------------------------------------------
@@ -907,8 +919,10 @@ def _conditional_block_ctx(helper, cond):
     program = helper.main_program
     parent_block = program.current_block()
     sub_block = program._create_block()
-    yield
-    program._rollback()
+    try:
+        yield
+    finally:
+        program._rollback()
     x_names, out_names = _analyze_block_io(sub_block, include_read_outputs=True)
     parent_block.append_op(
         type="conditional_block",

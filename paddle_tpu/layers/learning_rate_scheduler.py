@@ -1,0 +1,257 @@
+"""In-graph learning-rate schedules
+(reference: python/paddle/fluid/layers/learning_rate_scheduler.py).
+
+Each schedule builds ops over an auto-incremented global step counter and
+returns a [1] float Variable, passed as `learning_rate=` to an Optimizer.
+As in the reference, the schedule is *part of the program* — under XLA it
+folds into the fused update computation, there is no host-side LR logic.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..core.framework import default_main_program, default_startup_program, unique_name
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from . import control_flow
+from . import ops as act_ops
+from . import tensor
+
+__all__ = [
+    "autoincreased_step_counter",
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+    "append_LARS",
+]
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 step counter, incremented at the top of every run
+    (reference: layers/nn.py autoincreased_step_counter)."""
+    counter_name = counter_name or "@STEP_COUNTER@"
+    main = default_main_program().global_block()
+    if main.desc.has_var(counter_name):
+        return main.var(counter_name)
+    counter = main.create_var(
+        name=counter_name, dtype="int64", shape=[1], persistable=True,
+        stop_gradient=True,
+    )
+    startup = default_startup_program().global_block()
+    sv = startup.create_var(
+        name=counter_name, dtype="int64", shape=[1], persistable=True
+    )
+    ConstantInitializer(float(begin - step))(sv, startup)
+    main._prepend_op(
+        type="increment", inputs={"X": [counter]}, outputs={"Out": [counter]},
+        attrs={"step": float(step)},
+    )
+    return counter
+
+
+def _decay_step_counter(begin=0):
+    return tensor.cast(
+        autoincreased_step_counter(
+            counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1
+        ),
+        "float32",
+    )
+
+
+def _const(value):
+    return tensor.fill_constant(shape=[1], dtype="float32", value=float(value))
+
+
+def _pow(x, y):
+    from . import nn
+
+    if not hasattr(y, "name"):
+        y = _const(y)
+    return nn._elementwise("elementwise_pow", x, y)
+
+
+def _div(x, y):
+    from . import nn
+
+    if not hasattr(y, "name"):
+        y = _const(y)
+    return nn._elementwise("elementwise_div", x, y)
+
+
+def noam_decay(d_model, warmup_steps):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (reference: learning_rate_scheduler.py noam_decay; Vaswani et al.)."""
+    step = _decay_step_counter(begin=1)
+    a = _pow(step, -0.5)
+    b = tensor.scale(step, scale=warmup_steps ** -1.5)
+    from . import nn
+
+    return tensor.scale(
+        nn._elementwise("elementwise_min", a, b), scale=d_model ** -0.5
+    )
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr = base * decay_rate ^ (step / decay_steps)."""
+    step = _decay_step_counter()
+    div = tensor.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = act_ops.floor(div)
+    return tensor.scale(_pow(_const(decay_rate), div), scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr = base * exp(-decay_rate * step / decay_steps)."""
+    step = _decay_step_counter()
+    div = tensor.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = act_ops.floor(div)
+    return tensor.scale(
+        act_ops.exp(tensor.scale(div, scale=-float(decay_rate))),
+        scale=float(learning_rate),
+    )
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """lr = base / (1 + decay_rate * step / decay_steps)."""
+    step = _decay_step_counter()
+    div = tensor.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = act_ops.floor(div)
+    denom = tensor.scale(div, scale=float(decay_rate), bias=1.0)
+    return _div(_const(learning_rate), denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    """lr = (base - end) * (1 - step/decay_steps)^power + end."""
+    from . import nn
+
+    step = _decay_step_counter()
+    if cycle:
+        div_res = act_ops.ceil(tensor.scale(step, scale=1.0 / decay_steps))
+        # at step 0 the ceil is 0; use 1 so the first cycle spans decay_steps
+        zero = _const(0.0)
+        eq = tensor.cast(control_flow.equal(step, zero), "float32")
+        div_res = nn._elementwise(
+            "elementwise_add", div_res, eq
+        )
+        decay_var = nn._elementwise(
+            "elementwise_mul", _const(decay_steps), div_res
+        )
+        frac = _div(step, decay_var)
+    else:
+        capped = nn._elementwise(
+            "elementwise_min", step, _const(decay_steps)
+        )
+        frac = tensor.scale(capped, scale=1.0 / decay_steps)
+    base = tensor.scale(frac, scale=-1.0, bias=1.0)
+    return tensor.scale(
+        _pow(base, power),
+        scale=float(learning_rate) - float(end_learning_rate),
+        bias=float(end_learning_rate),
+    )
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant schedule via Switch
+    (reference: learning_rate_scheduler.py piecewise_decay)."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    main = default_main_program().global_block()
+    lr_name = unique_name("learning_rate")
+    lr = main.create_var(
+        name=lr_name, shape=[1], dtype="float32", persistable=True,
+        stop_gradient=True,
+    )
+    startup = default_startup_program().global_block()
+    sv = startup.create_var(
+        name=lr_name, shape=[1], dtype="float32", persistable=True
+    )
+    ConstantInitializer(float(values[0]))(sv, startup)
+
+    step = _decay_step_counter()
+    with control_flow.Switch() as switch:
+        for i, bound in enumerate(boundaries):
+            with switch.case(control_flow.less_than(step, _const(bound))):
+                tensor.assign(_const(values[i]), lr)
+        with switch.default():
+            tensor.assign(_const(values[-1]), lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr = 0.5 * base * (1 + cos(pi * epoch / epochs))."""
+    step = _decay_step_counter()
+    epoch = act_ops.floor(tensor.scale(step, scale=1.0 / step_each_epoch))
+    inner = tensor.scale(epoch, scale=math.pi / epochs)
+    return tensor.scale(
+        act_ops.cos(inner), scale=0.5 * float(learning_rate), bias=1.0,
+        bias_after_scale=False,
+    )
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear ramp from start_lr to end_lr over warmup_steps, then the wrapped
+    schedule (reference: learning_rate_scheduler.py linear_lr_warmup)."""
+    main = default_main_program().global_block()
+    lr_name = unique_name("learning_rate_warmup")
+    lr = main.create_var(
+        name=lr_name, shape=[1], dtype="float32", persistable=True,
+        stop_gradient=True,
+    )
+    startup = default_startup_program().global_block()
+    sv = startup.create_var(
+        name=lr_name, shape=[1], dtype="float32", persistable=True
+    )
+    ConstantInitializer(float(start_lr))(sv, startup)
+
+    step = _decay_step_counter()
+    with control_flow.Switch() as switch:
+        with switch.case(control_flow.less_than(step, _const(warmup_steps))):
+            ramp = tensor.scale(
+                step, scale=(float(end_lr) - float(start_lr)) / warmup_steps,
+                bias=float(start_lr),
+            )
+            tensor.assign(ramp, lr)
+        with switch.default():
+            if hasattr(learning_rate, "name"):
+                tensor.assign(learning_rate, lr)
+            else:
+                tensor.assign(_const(learning_rate), lr)
+    return lr
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """LARS scaling of the LR per layer (reference:
+    learning_rate_scheduler.py append_LARS).  Kept for API parity; prefer
+    LarsMomentumOptimizer."""
+    from . import nn
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return nn._elementwise(
+            "elementwise_add",
+            grad_norm,
+            tensor.scale(param_norm, scale=float(weight_decay)),
+        )
+
+    outs = []
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(param, "optimize_attr") else 1.0
+        param_norm = act_ops.sqrt(tensor.reduce_sum(act_ops.square(param)))
+        grad_norm = act_ops.sqrt(tensor.reduce_sum(act_ops.square(grad)))
+        decayed = _balanced_weight(param_norm, grad_norm)
+        scaled = _div(
+            tensor.scale(param_norm, scale=float(param_lr)), decayed
+        )
+        outs.append(nn._elementwise("elementwise_mul", learning_rate, scaled))
+    return outs

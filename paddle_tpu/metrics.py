@@ -1,0 +1,273 @@
+"""Host-side metric accumulators (reference: python/paddle/fluid/metrics.py).
+
+In-graph metric *ops* (accuracy, auc, mean_iou...) live in
+paddle_tpu/ops/metric_ops.py; these classes accumulate fetched numpy values
+across batches on the host, mirroring the reference class-for-class.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MetricBase",
+    "CompositeMetric",
+    "Precision",
+    "Recall",
+    "Accuracy",
+    "ChunkEvaluator",
+    "EditDistance",
+    "DetectionMAP",
+    "Auc",
+]
+
+
+def _to_np(x):
+    return np.asarray(x)
+
+
+class MetricBase:
+    """reference: metrics.py MetricBase."""
+
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def __str__(self):
+        return self._name
+
+    def reset(self):
+        for attr, value in self.__dict__.items():
+            if attr.startswith("_"):
+                continue
+            if isinstance(value, int):
+                setattr(self, attr, 0)
+            elif isinstance(value, float):
+                setattr(self, attr, 0.0)
+            elif isinstance(value, (np.ndarray,)):
+                setattr(self, attr, np.zeros_like(value))
+            elif isinstance(value, (list,)):
+                setattr(self, attr, [])
+
+    def get_config(self):
+        return {
+            attr: value
+            for attr, value in self.__dict__.items()
+            if not attr.startswith("_")
+        }
+
+    def update(self, preds, labels):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """Bundle several metrics updated with the same (preds, labels)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise ValueError("add_metric expects a MetricBase instance")
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+
+class Precision(MetricBase):
+    """Binary precision over 0/1 preds (reference: metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).astype(np.int64).reshape(-1)
+        labels = _to_np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_np(preds)).astype(np.int64).reshape(-1)
+        labels = _to_np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        recall = self.tp + self.fn
+        return float(self.tp) / recall if recall != 0 else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running accuracy (update takes per-batch accuracy values,
+    as fetched from the in-graph accuracy op)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if weight < 0:
+            raise ValueError("weight must be non-negative")
+        self.value += float(np.ravel(_to_np(value))[0]) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy has accumulated no batches")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """F1 over chunk counts fetched from the chunk_eval op
+    (reference: metrics.py ChunkEvaluator)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.ravel(_to_np(num_infer_chunks))[0])
+        self.num_label_chunks += int(np.ravel(_to_np(num_label_chunks))[0])
+        self.num_correct_chunks += int(np.ravel(_to_np(num_correct_chunks))[0])
+
+    def eval(self):
+        precision = (
+            float(self.num_correct_chunks) / self.num_infer_chunks
+            if self.num_infer_chunks
+            else 0.0
+        )
+        recall = (
+            float(self.num_correct_chunks) / self.num_label_chunks
+            if self.num_label_chunks
+            else 0.0
+        )
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if self.num_correct_chunks
+            else 0.0
+        )
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate
+    (reference: metrics.py EditDistance)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = _to_np(distances).reshape(-1)
+        self.total_distance += float(np.sum(distances))
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(distances != 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance has accumulated no sequences")
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error
+
+
+class Auc(MetricBase):
+    """Batch-accumulated ROC AUC via threshold buckets
+    (reference: metrics.py Auc; matches the auc op's algorithm)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        bins = num_thresholds + 1
+        # non-underscore so MetricBase.reset zeroes them
+        self.stat_pos = np.zeros(bins, dtype=np.int64)
+        self.stat_neg = np.zeros(bins, dtype=np.int64)
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).reshape(-1).astype(bool)
+        pos_prob = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
+        idx = np.minimum(
+            (pos_prob * self._num_thresholds).astype(np.int64),
+            self._num_thresholds,
+        )
+        bins = self._num_thresholds + 1
+        self.stat_pos += np.bincount(idx[labels], minlength=bins)
+        self.stat_neg += np.bincount(idx[~labels], minlength=bins)
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def eval(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        idx = self._num_thresholds
+        while idx >= 0:
+            tot_pos_prev = tot_pos
+            tot_neg_prev = tot_neg
+            tot_pos += self.stat_pos[idx]
+            tot_neg += self.stat_neg[idx]
+            auc += self.trapezoid_area(
+                tot_neg, tot_neg_prev, tot_pos, tot_pos_prev
+            )
+            idx -= 1
+        return (
+            auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 else 0.0
+        )
+
+
+class DetectionMAP(MetricBase):
+    """Running mean of per-batch mAP values fetched from the detection_map op
+    (reference: metrics.py DetectionMAP)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.has_state = None
+
+    def get_map_var(self):
+        return self.has_state
+
+    def update(self, value, weight):
+        if not hasattr(self, "value"):
+            self.value = 0.0
+            self.weight = 0.0
+        self.value += float(np.ravel(_to_np(value))[0]) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if getattr(self, "weight", 0.0) == 0.0:
+            raise ValueError("DetectionMAP has accumulated no batches")
+        return self.value / self.weight

@@ -682,7 +682,9 @@ def _range(ctx, ins, attrs):
 @register_op("increment", infer_shape=same_shape())
 def _increment(ctx, ins, attrs):
     x = data(ins["X"][0])
-    return {"Out": [x + attrs.get("step", 1.0)]}
+    # keep the input dtype: int64 counters must not promote to float
+    step = np.asarray(attrs.get("step", 1.0)).astype(jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype)
+    return {"Out": [x + step]}
 
 
 @register_op("label_smooth", infer_shape=same_shape())

@@ -1,0 +1,107 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py over
+platform/profiler.cc + CUPTI device_tracer).
+
+TPU-native mapping: host/device timelines come from jax.profiler (XLA traces
+carry per-op device timing, the role CUPTI played), and the reference's
+RecordEvent push/pop annotation ranges map to jax.profiler.TraceAnnotation
+named scopes.  `profiler(...)` / start_profiler / stop_profiler keep the
+reference's API shape; traces are written in TensorBoard format to the
+given directory instead of the reference's profiler.proto + timeline.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = [
+    "cuda_profiler",
+    "reset_profiler",
+    "profiler",
+    "start_profiler",
+    "stop_profiler",
+    "record_event",
+]
+
+_state: Dict[str, object] = {"on": False, "dir": None}
+# host-side event aggregation (reference prints calls/total/min/max/ave)
+_events: Dict[str, List[float]] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII annotation range (reference: platform::RecordEvent).  Shows up in
+    the XLA trace as a named scope and in the host summary table."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _events[name].append(time.perf_counter() - t0)
+
+
+def reset_profiler():
+    """reference: profiler.py reset_profiler."""
+    _events.clear()
+
+
+def start_profiler(state="All", tracer_option=None, log_dir=None):
+    """reference: profiler.py start_profiler; state kept for API parity (XLA
+    traces always include both host and device activity)."""
+    import jax
+
+    if _state["on"]:
+        return
+    log_dir = log_dir or os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _state["on"] = True
+    _state["dir"] = log_dir
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """reference: profiler.py stop_profiler; prints the host event summary
+    (the reference's aggregated table) and finalizes the device trace."""
+    import jax
+
+    if not _state["on"]:
+        return
+    jax.profiler.stop_trace()
+    _state["on"] = False
+    if _events:
+        rows = []
+        for name, times in _events.items():
+            rows.append(
+                (name, len(times), sum(times), min(times), max(times),
+                 sum(times) / len(times))
+            )
+        key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}.get(
+            sorted_key or "total", 2
+        )
+        rows.sort(key=lambda r: -r[key_idx])
+        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Min(s)':>10}"
+              f"{'Max(s)':>10}{'Ave(s)':>10}")
+        for name, calls, tot, mn, mx, ave in rows:
+            print(f"{name:<40}{calls:>8}{tot:>12.6f}{mn:>10.6f}"
+                  f"{mx:>10.6f}{ave:>10.6f}")
+    print(f"[paddle_tpu.profiler] device trace written to {_state['dir']}")
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None, log_dir=None):
+    """Context manager (reference: profiler.py profiler)."""
+    start_profiler(state, log_dir=log_dir or profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """CUDA-specific in the reference; on TPU this is the same XLA trace."""
+    with profiler():
+        yield

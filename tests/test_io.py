@@ -1,0 +1,85 @@
+"""Checkpoint/IO round-trips (reference: test_io_save_load-style book tests,
+dist_save_load.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_and_train(steps=3):
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"))
+    loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 4).astype("float32")
+    yv = rng.randn(8, 1).astype("float32")
+    for _ in range(steps):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    return exe, pred, loss, xv, yv
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    exe, pred, loss, xv, yv = _build_and_train()
+    # eval through a pruned program so fetching pred does not step Adam
+    infer_prog = fluid.io.get_inference_program([pred])
+    (before,) = exe.run(program=infer_prog, feed={"x": xv}, fetch_list=[pred])
+    fluid.io.save_persistables(exe, str(tmp_path / "ckpt"))
+
+    # clobber the scope, reload, same predictions (incl. optimizer moments)
+    w = np.asarray(fluid.global_scope().find_var("w")).copy()
+    fluid.global_scope().set_var("w", np.zeros_like(w))
+    fluid.io.load_persistables(exe, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var("w")), w
+    )
+    (after,) = exe.run(program=infer_prog, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(before), rtol=1e-6)
+
+
+def test_save_load_combined_file(tmp_path):
+    exe, *_ = _build_and_train()
+    fluid.io.save_params(exe, str(tmp_path / "c"), filename="params")
+    w = np.asarray(fluid.global_scope().find_var("w")).copy()
+    fluid.global_scope().set_var("w", np.zeros_like(w))
+    fluid.io.load_params(exe, str(tmp_path / "c"), filename="params")
+    np.testing.assert_allclose(np.asarray(fluid.global_scope().find_var("w")), w)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    exe, pred, loss, xv, yv = _build_and_train()
+    infer_prog = fluid.io.get_inference_program([pred])
+    (before,) = exe.run(program=infer_prog, feed={"x": xv}, fetch_list=[pred])
+    fluid.io.save_inference_model(
+        str(tmp_path / "model"), ["x"], [pred], exe
+    )
+
+    # fresh program + scope, as a serving process would have
+    from paddle_tpu.core import framework, scope as scope_mod
+
+    framework.switch_main_program(fluid.Program())
+    framework.switch_startup_program(fluid.Program())
+    scope_mod._current_scope = scope_mod.Scope()
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    program, feed_names, fetch_targets = fluid.io.load_inference_model(
+        str(tmp_path / "model"), exe2
+    )
+    assert feed_names == ["x"]
+    (out,) = exe2.run(
+        program=program, feed={"x": xv}, fetch_list=fetch_targets
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(before), rtol=1e-6)
+
+
+def test_inference_prune_drops_training_ops(tmp_path):
+    exe, pred, loss, xv, yv = _build_and_train()
+    fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe)
+    program, _, _ = fluid.io.load_inference_model(str(tmp_path / "m"), exe)
+    types = {op.type for op in program.global_block().ops}
+    assert "adam" not in types
+    assert not any(t.endswith("_grad") for t in types)

@@ -1,0 +1,107 @@
+"""Learning-rate schedules (reference: test_learning_rate_scheduler.py):
+run N steps, compare the in-graph LR against a python reference."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run_schedule(lr_var, steps):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got = []
+    for _ in range(steps):
+        (v,) = exe.run(feed={}, fetch_list=[lr_var])
+        got.append(float(np.ravel(np.asarray(v))[0]))
+    return got
+
+
+def test_exponential_decay():
+    base, dsteps, rate = 1.0, 5, 0.5
+    lr = layers.exponential_decay(base, dsteps, rate, staircase=True)
+    got = _run_schedule(lr, 12)
+    want = [base * rate ** int(i // dsteps) for i in range(12)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_natural_exp_decay():
+    base, dsteps, rate = 0.5, 4, 0.3
+    lr = layers.natural_exp_decay(base, dsteps, rate)
+    got = _run_schedule(lr, 8)
+    want = [base * math.exp(-rate * i / dsteps) for i in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    base, dsteps, rate = 1.0, 2, 0.5
+    lr = layers.inverse_time_decay(base, dsteps, rate)
+    got = _run_schedule(lr, 6)
+    want = [base / (1 + rate * i / dsteps) for i in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_polynomial_decay():
+    base, dsteps, end, p = 1.0, 10, 0.1, 2.0
+    lr = layers.polynomial_decay(base, dsteps, end, p)
+    got = _run_schedule(lr, 14)
+    want = [
+        (base - end) * (1 - min(i, dsteps) / dsteps) ** p + end
+        for i in range(14)
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_piecewise_decay():
+    lr = layers.piecewise_decay([3, 6], [1.0, 0.5, 0.1])
+    got = _run_schedule(lr, 9)
+    want = [1.0 if i < 3 else 0.5 if i < 6 else 0.1 for i in range(9)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_noam_decay():
+    d_model, warmup = 64, 4
+    lr = layers.noam_decay(d_model, warmup)
+    got = _run_schedule(lr, 8)
+    want = [
+        d_model ** -0.5 * min((i + 1) ** -0.5, (i + 1) * warmup ** -1.5)
+        for i in range(8)
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cosine_decay():
+    base, per_epoch, epochs = 1.0, 3, 4
+    lr = layers.cosine_decay(base, per_epoch, epochs)
+    got = _run_schedule(lr, 9)
+    want = [
+        0.5 * base * (1 + math.cos(math.pi * (i // per_epoch) / epochs))
+        for i in range(9)
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_warmup_then_constant():
+    lr = layers.linear_lr_warmup(0.8, 4, 0.0, 0.4)
+    got = _run_schedule(lr, 7)
+    want = [0.0 + (0.4 - 0.0) / 4 * i if i < 4 else 0.8 for i in range(7)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_schedule_drives_optimizer():
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.fc(x, size=1)
+    loss = layers.mean(y)
+    lr = layers.exponential_decay(0.1, 10, 0.5)
+    fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.ones((2, 4), dtype="float32")
+    vals = [
+        float(np.ravel(np.asarray(exe.run(feed={"x": xv}, fetch_list=[loss])[0]))[0])
+        for _ in range(3)
+    ]
+    assert vals[0] != vals[1]  # training moved the params

@@ -1,0 +1,88 @@
+"""Host metric accumulators (reference: test_metrics.py + metric op tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.metrics import (
+    Accuracy,
+    Auc,
+    ChunkEvaluator,
+    CompositeMetric,
+    EditDistance,
+    Precision,
+    Recall,
+)
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([1, 1, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)
+    assert r.eval() == pytest.approx(2 / 3)
+
+
+def test_accuracy_weighted():
+    m = Accuracy()
+    m.update(0.5, 10)
+    m.update(1.0, 30)
+    assert m.eval() == pytest.approx((0.5 * 10 + 1.0 * 30) / 40)
+
+
+def test_chunk_evaluator():
+    m = ChunkEvaluator()
+    m.update(10, 8, 6)
+    precision, recall, f1 = m.eval()
+    assert precision == pytest.approx(0.6)
+    assert recall == pytest.approx(0.75)
+    assert f1 == pytest.approx(2 * 0.6 * 0.75 / (0.6 + 0.75))
+
+
+def test_edit_distance():
+    m = EditDistance()
+    m.update(np.array([0.0, 2.0, 1.0]), 3)
+    avg, err = m.eval()
+    assert avg == pytest.approx(1.0)
+    assert err == pytest.approx(2 / 3)
+
+
+def test_auc_perfect_classifier():
+    m = Auc()
+    preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+    labels = np.array([0, 0, 1, 1])
+    m.update(preds, labels)
+    assert m.eval() == pytest.approx(1.0)
+
+
+def test_composite():
+    c = CompositeMetric()
+    c.add_metric(Precision())
+    c.add_metric(Recall())
+    preds = np.array([1, 0, 1])
+    labels = np.array([1, 0, 0])
+    c.update(preds, labels)
+    prec, rec = c.eval()
+    assert prec == pytest.approx(0.5)
+    assert rec == pytest.approx(1.0)
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+
+    w = WeightedAverage()
+    w.add(2.0, 1.0)
+    w.add(4.0, 3.0)
+    assert w.eval() == pytest.approx((2 + 12) / 4)
+
+
+def test_record_event_and_summary(capsys):
+    # host-side annotation aggregation works without starting a device trace
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    with profiler.record_event("step"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    assert "step" in profiler._events
