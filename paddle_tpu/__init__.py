@@ -44,7 +44,12 @@ from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
 
 from . import average  # noqa: F401
 from . import clip  # noqa: F401
+from . import dataset  # noqa: F401
 from . import io  # noqa: F401
+from . import reader  # noqa: F401
+from . import recordio  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .reader import batch  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from . import parallel  # noqa: F401

@@ -150,10 +150,22 @@ class Executor:
     ) -> List[Any]:
         # fluid idiom: exe.run(CompiledProgram(...).with_data_parallel(...), ...)
         if program is not None and hasattr(program, "with_data_parallel"):
+            src = getattr(program, "program", None) or default_main_program()
+            if feed is None and getattr(src, "_py_readers", None):
+                feed = {}
+                for r in src._py_readers:
+                    feed.update(r._next_batch())
             pe = program._executor_for_scope(scope or global_scope())
             return pe.run(fetch_list=fetch_list, feed=feed, return_numpy=return_numpy)
 
         program = program or default_main_program()
+        if feed is None and getattr(program, "_py_readers", None):
+            # feed-less run: pull the next ready batch from the program's
+            # py_reader queues (reference: reader ops feeding from
+            # LoDTensorBlockingQueue, operators/reader/)
+            feed = {}
+            for r in program._py_readers:
+                feed.update(r._next_batch())
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         scope = scope or global_scope()

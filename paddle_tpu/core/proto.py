@@ -27,7 +27,13 @@ __all__ = [
     "OpDesc",
     "BlockDesc",
     "ProgramDesc",
+    "EOFException",
 ]
+
+
+class EOFException(Exception):
+    """A reader pass is exhausted (reference: paddle/fluid/framework/
+    reader.h EOFException surfaced as fluid.core.EOFException)."""
 
 
 class VarType(IntEnum):

@@ -1,0 +1,123 @@
+"""DataFeeder (reference: python/paddle/fluid/data_feeder.py:83).
+
+Converts reader minibatches — lists of per-sample tuples — into the feed
+dict the Executor consumes: dense numpy for lod_level-0 vars, padded
+LoDValue for sequence vars.  feed_parallel splits a batch across the
+data-parallel axis like the reference's multi-device feed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.framework import Variable, default_main_program
+from .core.lod import create_lod_tensor
+from .core.proto import dtype_to_numpy
+
+__all__ = ["DataFeeder"]
+
+
+def dense_batch(samples, shape, np_dtype):
+    """Stack lod_level-0 samples into one array, honoring trailing static
+    dims ([-1, ...] batch leading).  Shared with py_reader."""
+    arr = np.asarray(list(samples), dtype=np_dtype)
+    if shape and all(d > 0 for d in shape[1:]):
+        try:
+            arr = arr.reshape([-1] + [int(d) for d in shape[1:]])
+        except ValueError:
+            pass
+    return arr
+
+
+def lod_batch(samples, np_dtype):
+    """Pad variable-length samples into a LoDValue.  Shared with py_reader."""
+    return create_lod_tensor(
+        [np.asarray(s, dtype=np_dtype) for s in samples]
+    )
+
+
+class _DenseConverter:
+    def __init__(self, shape, dtype):
+        self.shape = [d for d in shape]
+        self.dtype = dtype
+        self.data: List[Any] = []
+
+    def feed(self, sample):
+        self.data.append(sample)
+
+    def done(self):
+        return dense_batch(self.data, self.shape, self.dtype)
+
+
+class _LoDConverter:
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.seqs: List[np.ndarray] = []
+
+    def feed(self, sample):
+        self.seqs.append(np.asarray(sample, dtype=self.dtype))
+
+    def done(self):
+        return create_lod_tensor(self.seqs)
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        program = program or default_main_program()
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        self.place = place
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            if not isinstance(v, Variable):
+                raise TypeError("feed_list holds Variables or var names")
+            self.feed_names.append(v.name)
+            self.feed_lod_level.append(v.lod_level)
+            self.feed_shapes.append(list(v.shape))
+            self.feed_dtypes.append(dtype_to_numpy(v.dtype))
+
+    def feed(self, iterable) -> Dict[str, Any]:
+        """One minibatch (iterable of per-sample tuples) -> feed dict."""
+        converters = []
+        for lod_level, shape, dtype in zip(
+            self.feed_lod_level, self.feed_shapes, self.feed_dtypes
+        ):
+            if lod_level == 0:
+                converters.append(_DenseConverter(shape, dtype))
+            else:
+                converters.append(_LoDConverter(dtype))
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                f"sample has {len(each_sample)} slots, feeder expects "
+                f"{len(converters)}"
+            )
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {
+            name: conv.done()
+            for name, conv in zip(self.feed_names, converters)
+        }
+
+    def feed_parallel(self, iterable: Sequence, num_places: Optional[int] = None):
+        """Split a batch into per-device feeds (reference:
+        data_feeder.py feed_parallel).  With pjit-style SPMD the global batch
+        is usually fed whole; this exists for API parity."""
+        if num_places is None or num_places <= 1:
+            return [self.feed(iterable)]
+        samples = list(iterable)
+        # spread the remainder across the first chunks so no sample drops
+        outs = []
+        base, extra = divmod(len(samples), num_places)
+        start = 0
+        for i in range(num_places):
+            size = base + (1 if i < extra else 0)
+            chunk = samples[start : start + size]
+            start += size
+            if chunk:
+                outs.append(self.feed(chunk))
+        return outs

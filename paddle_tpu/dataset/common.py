@@ -1,0 +1,45 @@
+"""Dataset plumbing (reference: python/paddle/dataset/common.py).
+
+The reference auto-downloads into ~/.cache/paddle/dataset.  This
+environment has no network egress, so every dataset module here generates a
+*deterministic synthetic* corpus with the real schema (shapes, dtypes, vocab
+sizes, label ranges) unless real files are already present in the cache dir.
+Set PADDLE_TPU_DATA_HOME to point at pre-downloaded real data.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "md5file", "data_path", "synthetic_rng"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"),
+)
+
+
+def md5file(fname: str) -> str:
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def data_path(module_name: str, *parts: str) -> str:
+    p = os.path.join(DATA_HOME, module_name, *parts)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    return p
+
+
+def synthetic_rng(name: str, split: str) -> np.random.RandomState:
+    """Deterministic per-(dataset, split) generator so train/test are stable
+    across runs and processes."""
+    seed = int(
+        hashlib.md5(f"{name}:{split}".encode()).hexdigest()[:8], 16
+    )
+    return np.random.RandomState(seed)

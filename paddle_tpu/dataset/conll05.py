@@ -1,0 +1,51 @@
+"""CoNLL-2005 semantic role labeling (reference: python/paddle/dataset/conll05.py).
+
+Samples: 8 aligned token-id sequences + BIO label-id sequence, the SRL
+DB-LSTM training tuple (word, ctx_n2..ctx_p2, verb, mark, label).
+"""
+
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["get_dict", "test", "train"]
+
+WORD_VOCAB = 44068
+LABEL_VOCAB = 3857
+PRED_VOCAB = 3162
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label_dict = {f"l{i}": i for i in range(LABEL_VOCAB)}
+    return word_dict, verb_dict, label_dict
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("conll05", split)
+        for _ in range(size):
+            n = int(rng.randint(5, 40))
+            word = [int(x) for x in rng.randint(0, WORD_VOCAB, size=n)]
+            ctx = [
+                [int(x) for x in rng.randint(0, WORD_VOCAB, size=n)]
+                for _ in range(5)
+            ]
+            verb = [int(rng.randint(0, PRED_VOCAB))] * n
+            mark = [int(x) for x in rng.randint(0, 2, size=n)]
+            label = [int(x) for x in rng.randint(0, LABEL_VOCAB, size=n)]
+            yield (word, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4], verb, mark,
+                   label)
+
+    return reader
+
+
+def train():
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test():
+    return _synthetic("test", TEST_SIZE)

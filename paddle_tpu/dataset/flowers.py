@@ -1,0 +1,37 @@
+"""Oxford 102 flowers (reference: python/paddle/dataset/flowers.py).
+
+Samples: (image float32[3*224*224], label int in [0, 102))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+TRAIN_SIZE = 256
+TEST_SIZE = 64
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("flowers", split)
+        for _ in range(size):
+            label = int(rng.randint(0, 102))
+            img = rng.rand(3 * 224 * 224).astype(np.float32)
+            yield img, label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic("test", TEST_SIZE)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic("valid", TEST_SIZE)

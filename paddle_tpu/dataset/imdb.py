@@ -1,0 +1,47 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py).
+
+Samples: (word-id sequence, label in {0, 1}).  word_dict maps token->id.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+VOCAB_SIZE = 5149  # reference IMDB vocab ends up ~5147 + <unk>
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def word_dict():
+    """token -> id; synthetic tokens w0..wN like the reference's dict shape."""
+    d = {f"w{i}": i for i in range(VOCAB_SIZE - 1)}
+    d["<unk>"] = VOCAB_SIZE - 1
+    return d
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("imdb", split)
+        for _ in range(size):
+            label = int(rng.randint(0, 2))
+            n = int(rng.randint(8, 64))
+            # positive reviews skew toward low word-ids
+            if label:
+                ids = rng.zipf(1.3, size=n) % (VOCAB_SIZE // 2)
+            else:
+                ids = VOCAB_SIZE // 2 + rng.zipf(1.3, size=n) % (VOCAB_SIZE // 2)
+            yield [int(i) for i in ids], label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test(word_idx=None):
+    return _synthetic("test", TEST_SIZE)

@@ -1,0 +1,43 @@
+"""PTB language-model n-grams (reference: python/paddle/dataset/imikolov.py).
+
+Samples: n-gram tuples of word ids (default n=5 windows), or sequence pairs
+in NGRAM/SEQ data types.
+"""
+
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["train", "test", "build_dict"]
+
+VOCAB = 2074  # reference PTB dict ~2073 + <unk>
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def build_dict(min_word_freq=50):
+    d = {f"w{i}": i for i in range(VOCAB - 1)}
+    d["<unk>"] = VOCAB - 1
+    return d
+
+
+def _synthetic(split, size, n):
+    def reader():
+        rng = common.synthetic_rng("imikolov", split)
+        for _ in range(size):
+            # markov-ish: neighboring ids correlate
+            base = int(rng.randint(0, VOCAB - n))
+            gram = [
+                (base + int(rng.randint(0, 5))) % VOCAB for _ in range(n)
+            ]
+            yield tuple(gram)
+
+    return reader
+
+
+def train(word_idx=None, n=5, data_type=None):
+    return _synthetic("train", TRAIN_SIZE, n)
+
+
+def test(word_idx=None, n=5, data_type=None):
+    return _synthetic("test", TEST_SIZE, n)

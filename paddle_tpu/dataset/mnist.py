@@ -1,0 +1,73 @@
+"""MNIST dataset (reference: python/paddle/dataset/mnist.py).
+
+Samples: (image float32[784] scaled to [-1, 1], label int64 in [0, 10)).
+Uses real IDX files from the cache dir when present; otherwise a
+deterministic synthetic set with the same schema (see common.py).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+TRAIN_SIZE = 8192  # synthetic split sizes (real: 60000/10000)
+TEST_SIZE = 2048
+
+
+def _real_files(split):
+    prefix = "train" if split == "train" else "t10k"
+    img = os.path.join(common.DATA_HOME, "mnist", f"{prefix}-images-idx3-ubyte.gz")
+    lab = os.path.join(common.DATA_HOME, "mnist", f"{prefix}-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lab):
+        return img, lab
+    return None
+
+
+def _reader_from_idx(img_path, lab_path):
+    def reader():
+        with gzip.open(img_path, "rb") as fi, gzip.open(lab_path, "rb") as fl:
+            fi.read(4)
+            n, rows, cols = struct.unpack(">III", fi.read(12))
+            fl.read(8)
+            for _ in range(n):
+                img = np.frombuffer(fi.read(rows * cols), dtype=np.uint8)
+                img = img.astype(np.float32) / 255.0 * 2.0 - 1.0
+                lab = struct.unpack("B", fl.read(1))[0]
+                yield img, int(lab)
+
+    return reader
+
+
+def _synthetic_reader(split, size):
+    def reader():
+        rng = common.synthetic_rng("mnist", split)
+        for _ in range(size):
+            label = int(rng.randint(0, 10))
+            # class-dependent mean so models can actually learn
+            img = rng.normal(
+                loc=(label - 4.5) / 10.0, scale=0.5, size=(784,)
+            ).astype(np.float32)
+            yield np.clip(img, -1.0, 1.0), label
+
+    return reader
+
+
+def train():
+    files = _real_files("train")
+    if files:
+        return _reader_from_idx(*files)
+    return _synthetic_reader("train", TRAIN_SIZE)
+
+
+def test():
+    files = _real_files("test")
+    if files:
+        return _reader_from_idx(*files)
+    return _synthetic_reader("test", TEST_SIZE)

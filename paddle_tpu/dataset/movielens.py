@@ -1,0 +1,57 @@
+"""MovieLens-1M recommender data (reference: python/paddle/dataset/movielens.py).
+
+Samples: (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, rating float).
+"""
+
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table"]
+
+_MAX_USER = 6040
+_MAX_MOVIE = 3952
+_MAX_JOB = 20
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def max_user_id():
+    return _MAX_USER
+
+
+def max_movie_id():
+    return _MAX_MOVIE
+
+
+def max_job_id():
+    return _MAX_JOB
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("movielens", split)
+        for _ in range(size):
+            uid = int(rng.randint(1, _MAX_USER + 1))
+            mid = int(rng.randint(1, _MAX_MOVIE + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _MAX_JOB + 1))
+            cats = [int(x) for x in rng.randint(0, 18, size=rng.randint(1, 4))]
+            title = [int(x) for x in rng.randint(0, 5000, size=rng.randint(1, 6))]
+            rating = float((uid * 7 + mid * 13) % 5 + 1)
+            yield uid, gender, age, job, mid, cats, title, rating
+
+    return reader
+
+
+def train():
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test():
+    return _synthetic("test", TEST_SIZE)

@@ -1,0 +1,42 @@
+"""UCI housing regression set (reference: python/paddle/dataset/uci_housing.py).
+
+Samples: (features float32[13] normalized, price float32[1]).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+# fixed ground-truth linear model for the synthetic corpus
+_W = np.linspace(-1.0, 1.0, 13).astype(np.float32)
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("uci_housing", split)
+        for _ in range(size):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ _W + 22.5 + rng.randn() * 0.5)
+            yield x, np.array([y], dtype=np.float32)
+
+    return reader
+
+
+def train():
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test():
+    return _synthetic("test", TEST_SIZE)

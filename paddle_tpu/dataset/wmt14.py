@@ -1,0 +1,17 @@
+"""WMT14 fr-en pairs (reference: python/paddle/dataset/wmt14.py).
+
+Same triple schema as wmt16: (src ids, trg in, trg out)."""
+
+from __future__ import annotations
+
+from . import wmt16
+
+__all__ = ["train", "test"]
+
+
+def train(dict_size=30000):
+    return wmt16.train(dict_size, dict_size)
+
+
+def test(dict_size=30000):
+    return wmt16.test(dict_size, dict_size)

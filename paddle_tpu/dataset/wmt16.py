@@ -1,0 +1,49 @@
+"""WMT16 en-de translation pairs (reference: python/paddle/dataset/wmt16.py).
+
+Samples: (src ids, trg ids with <s>, trg ids with <e>) — the transformer
+training triple.  Ids 0/1/2 are <s>/<e>/<unk> as in the reference.
+"""
+
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+START_ID, END_ID, UNK_ID = 0, 1, 2
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _synthetic(split, size, src_dict_size, trg_dict_size):
+    def reader():
+        rng = common.synthetic_rng("wmt16", split)
+        for _ in range(size):
+            n = int(rng.randint(4, 50))
+            src = [int(x) for x in rng.randint(3, src_dict_size, size=n)]
+            # target "translates" each source id deterministically
+            trg = [3 + (i * 7 + 11) % (trg_dict_size - 3) for i in src]
+            yield src, [START_ID] + trg, trg + [END_ID]
+
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _synthetic("train", TRAIN_SIZE, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _synthetic("test", TEST_SIZE, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _synthetic("val", TEST_SIZE, src_dict_size, trg_dict_size)

@@ -9,7 +9,9 @@ from typing import List, Optional, Sequence
 from ..core.framework import Variable, default_main_program
 from ..core.proto import VarType
 
-__all__ = ["data"]
+from .io_pyreader import EOFException, double_buffer, py_reader, read_file  # noqa: F401
+
+__all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException"]
 
 
 def data(

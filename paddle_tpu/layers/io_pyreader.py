@@ -1,0 +1,148 @@
+"""py_reader: async host input pipeline
+(reference: python/paddle/fluid/layers/io.py:485 py_reader over
+operators/reader/create_py_reader_op.cc + LoDTensorBlockingQueue).
+
+A background thread converts reader batches into ready feed dicts and
+pushes them into a bounded queue; `exe.run(feed=None)` pops the next batch.
+Double-buffering (the reference's separate decorator) is subsumed by JAX's
+async dispatch — the host thread stays ahead of the device by `capacity`
+batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.framework import default_main_program, unique_name
+from ..core.lod import create_lod_tensor
+from ..core.proto import EOFException, convert_dtype, dtype_to_numpy
+
+__all__ = ["py_reader", "read_file", "double_buffer", "EOFException"]
+
+
+class PyReader:
+    """Runtime half of a py_reader variable."""
+
+    def __init__(self, names, shapes, dtypes, lod_levels, capacity):
+        self._names = list(names)
+        self._shapes = [list(s) for s in shapes]
+        self._np_dtypes = [dtype_to_numpy(convert_dtype(d)) for d in dtypes]
+        self._lod_levels = list(lod_levels)
+        self._capacity = capacity
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._creator: Optional[Callable] = None
+        self._tensor_provider = False
+        self._end = object()
+
+    # -- decoration (reference: py_reader decorate_* methods) ---------------
+    def decorate_paddle_reader(self, reader_creator: Callable):
+        """reader yields per-sample tuples batched by paddle.batch."""
+        self._creator = reader_creator
+        self._tensor_provider = False
+
+    def decorate_tensor_provider(self, provider: Callable):
+        """provider yields ready per-slot arrays (one list per batch)."""
+        self._creator = provider
+        self._tensor_provider = True
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._creator is None:
+            raise RuntimeError(
+                "py_reader has no data source; call decorate_paddle_reader first"
+            )
+        self._queue = queue.Queue(maxsize=self._capacity)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        """Drain after EOF so the next start() begins a fresh pass."""
+        self._queue = None
+        self._thread = None
+
+    def _convert_batch(self, batch) -> dict:
+        from ..data_feeder import dense_batch, lod_batch
+
+        if self._tensor_provider:
+            return dict(zip(self._names, batch))
+        out = {}
+        slots = list(zip(*batch))  # per-slot sample lists
+        for name, shape, np_dtype, lod, slot in zip(
+            self._names, self._shapes, self._np_dtypes, self._lod_levels, slots
+        ):
+            if lod > 0:
+                out[name] = lod_batch(slot, np_dtype)
+            else:
+                out[name] = dense_batch(slot, shape, np_dtype)
+        return out
+
+    def _worker(self):
+        q = self._queue
+        try:
+            for batch in self._creator():
+                q.put(self._convert_batch(batch))
+            q.put(self._end)
+        except BaseException as e:  # surface reader errors to the consumer
+            q.put(e)
+
+    def _next_batch(self) -> dict:
+        if self._queue is None:
+            raise RuntimeError("py_reader not started; call reader.start()")
+        item = self._queue.get()
+        if item is self._end:
+            raise EOFException("py_reader pass finished; call reader.reset()")
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+
+def py_reader(
+    capacity: int,
+    shapes: Sequence[Sequence[int]],
+    dtypes: Sequence,
+    lod_levels: Optional[Sequence[int]] = None,
+    name: Optional[str] = None,
+    use_double_buffer: bool = True,
+):
+    """Create an async reader (reference: layers/io.py:485).  Returns a
+    reader handle; call read_file(reader) for the data Variables."""
+    lod_levels = list(lod_levels or [0] * len(shapes))
+    program = default_main_program()
+    block = program.global_block()
+
+    data_names = [unique_name(f"{name or 'py_reader'}_slot{i}")
+                  for i in range(len(shapes))]
+    data_vars = []
+    for dname, shape, dtype, lod in zip(data_names, shapes, dtypes, lod_levels):
+        v = block.create_var(
+            name=dname, shape=list(shape), dtype=dtype, lod_level=lod,
+            stop_gradient=True,
+        )
+        data_vars.append(v)
+
+    reader = PyReader(data_names, shapes, dtypes, lod_levels, capacity)
+    reader._data_vars = data_vars
+    reader.name = name or unique_name("py_reader")
+    if not hasattr(program, "_py_readers"):
+        program._py_readers = []
+    program._py_readers.append(reader)
+    return reader
+
+
+def read_file(reader) -> List:
+    """Data Variables of a py_reader (reference: layers/io.py read_file)."""
+    return list(reader._data_vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference: layers/io.py double_buffer.  JAX's async dispatch already
+    overlaps host feed with device compute, so this is the identity."""
+    return reader

@@ -1,0 +1,43 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference ships its runtime as C++ (paddle/fluid/...); here the compute
+path is XLA, and the native layer covers host-side IO: recordio serde (and,
+as it grows, the host data pipeline).  Libraries build once into this
+directory; callers must handle `load() is None` with a Python fallback
+(pybind11 is unavailable in this image, so the ABI is plain C via ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+def load(name: str) -> "ctypes.CDLL | None":
+    """Build (if needed) and dlopen native/<name>.cc -> lib<name>.so."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cc")
+        lib = os.path.join(_DIR, f"lib{name}.so")
+        try:
+            needs_build = os.path.exists(src) and (
+                not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)
+            )
+            if needs_build:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", lib],
+                    check=True, capture_output=True, timeout=120,
+                )
+            handle = ctypes.CDLL(lib)
+        except Exception:
+            handle = None
+        _CACHE[name] = handle
+        return handle

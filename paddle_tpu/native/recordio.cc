@@ -1,0 +1,171 @@
+// RecordIO: chunked record file with per-chunk CRC32
+// (reference: recordio/ — header.{h,cc} magic+checksum+compressor+len,
+// chunk.{h,cc} record framing, writer.cc / scanner.cc APIs).
+//
+// TPU-native rebuild notes: same chunked layout (so shards stream
+// sequentially from disk/NFS at full bandwidth on TPU hosts), CRC32
+// integrity per chunk, no compressor (XLA hosts are CPU-rich, datasets
+// are pre-encoded; the reference's snappy mode is a format flag we
+// reserve but do not emit).
+//
+// On-disk format, little-endian:
+//   chunk := magic:u32 (0x0CDB0CDB) | crc32:u32 | compressor:u32 (0=plain)
+//            | num_records:u32 | payload_len:u64 | payload
+//   payload := { rec_len:u32 | rec_bytes } * num_records
+//
+// Exposed as a C ABI for ctypes (pybind11 is not available in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x0CDB0CDBu;
+
+// CRC32 (IEEE), table-driven.
+uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint8_t> payload;
+  uint32_t num_records = 0;
+  uint32_t max_records = 0;
+
+  int flush_chunk() {
+    if (num_records == 0) return 0;
+    uint32_t crc = crc32_update(0, payload.data(), payload.size());
+    uint32_t compressor = 0;
+    uint64_t len = payload.size();
+    if (fwrite(&kMagic, 4, 1, f) != 1) return -1;
+    if (fwrite(&crc, 4, 1, f) != 1) return -1;
+    if (fwrite(&compressor, 4, 1, f) != 1) return -1;
+    if (fwrite(&num_records, 4, 1, f) != 1) return -1;
+    if (fwrite(&len, 8, 1, f) != 1) return -1;
+    if (len && fwrite(payload.data(), 1, len, f) != len) return -1;
+    payload.clear();
+    num_records = 0;
+    return 0;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<uint8_t> payload;
+  size_t pos = 0;
+  uint32_t remaining = 0;
+  std::vector<uint8_t> record;
+
+  // loads the next chunk; returns 0 ok, 1 eof, -1 corrupt
+  int load_chunk() {
+    uint32_t magic, crc, compressor, num;
+    uint64_t len;
+    if (fread(&magic, 4, 1, f) != 1) return 1;
+    if (magic != kMagic) return -1;
+    if (fread(&crc, 4, 1, f) != 1) return -1;
+    if (fread(&compressor, 4, 1, f) != 1) return -1;
+    if (fread(&num, 4, 1, f) != 1) return -1;
+    if (fread(&len, 8, 1, f) != 1) return -1;
+    // validate against the remaining file size so a corrupt length field
+    // reports corruption instead of throwing across the C ABI
+    long here = ftell(f);
+    if (here < 0) return -1;
+    if (fseek(f, 0, SEEK_END) != 0) return -1;
+    long end_pos = ftell(f);
+    if (fseek(f, here, SEEK_SET) != 0) return -1;
+    if (end_pos < here || len > static_cast<uint64_t>(end_pos - here)) return -1;
+    payload.resize(len);
+    if (len && fread(payload.data(), 1, len, f) != len) return -1;
+    if (crc32_update(0, payload.data(), payload.size()) != crc) return -1;
+    pos = 0;
+    remaining = num;
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, uint32_t max_chunk_records) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  w->max_records = max_chunk_records ? max_chunk_records : 1000;
+  return w;
+}
+
+int rio_writer_write(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint32_t rec_len = static_cast<uint32_t>(len);
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&rec_len);
+  w->payload.insert(w->payload.end(), p, p + 4);
+  w->payload.insert(w->payload.end(), data, data + len);
+  w->num_records++;
+  if (w->num_records >= w->max_records) return w->flush_chunk();
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  int rc = w->flush_chunk();
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Scanner* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// Returns record length and sets *out to an internal buffer valid until the
+// next call; -1 on EOF, -2 on corruption.
+int64_t rio_scanner_next(void* handle, const uint8_t** out) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  while (s->remaining == 0) {
+    int rc = s->load_chunk();
+    if (rc == 1) return -1;
+    if (rc == -1) return -2;
+  }
+  if (s->pos + 4 > s->payload.size()) return -2;
+  uint32_t rec_len;
+  memcpy(&rec_len, s->payload.data() + s->pos, 4);
+  s->pos += 4;
+  if (s->pos + rec_len > s->payload.size()) return -2;
+  s->record.assign(s->payload.begin() + s->pos,
+                   s->payload.begin() + s->pos + rec_len);
+  s->pos += rec_len;
+  s->remaining--;
+  *out = s->record.data();
+  return static_cast<int64_t>(rec_len);
+}
+
+void rio_scanner_close(void* handle) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,281 @@
+"""Reader creators & decorators (reference: python/paddle/reader/decorator.py
++ python/paddle/batch.py).
+
+A *reader* is a zero-arg callable returning an iterable of samples; a
+*reader creator* builds readers.  Decorators compose readers functionally —
+ported semantics-for-semantics (this layer is pure host Python; device work
+starts at DataFeeder/py_reader).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Any, Callable, Iterable, List
+
+__all__ = [
+    "cache",
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "firstn",
+    "xmap_readers",
+    "multiprocess_reader",
+    "batch",
+    "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader: Callable) -> Callable:
+    """Cache the first full pass in memory (reference: decorator.py cache)."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        for item in all_data:
+            yield item
+
+    return cached_reader
+
+
+def map_readers(func: Callable, *readers: Callable) -> Callable:
+    """Yield func applied across outputs of several readers
+    (reference: decorator.py:36 map_readers)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in map(func, *rs):
+            yield vals
+
+    return reader
+
+
+def shuffle(reader: Callable, buf_size: int) -> Callable:
+    """Buffered shuffle (reference: decorator.py shuffle)."""
+
+    def data_reader():
+        buf: List[Any] = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers: Callable) -> Callable:
+    """Concatenate readers (reference: decorator.py chain)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+def compose(*readers: Callable, **kwargs) -> Callable:
+    """Zip readers into joined samples (reference: decorator.py compose)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned"
+                    )
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader: Callable, size: int) -> Callable:
+    """Background-thread prefetch buffer (reference: decorator.py buffered)."""
+
+    class EndSignal:
+        pass
+
+    end = EndSignal()
+
+    def read_worker(r, q):
+        try:
+            for d in r:
+                q.put(d)
+            q.put(end)
+        except BaseException as e:  # surface reader errors to the consumer
+            q.put(e)
+
+    def data_reader():
+        r = reader()
+        q: queue.Queue = queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q), daemon=True)
+        t.start()
+        e = q.get()
+        while not isinstance(e, EndSignal):
+            if isinstance(e, BaseException):
+                raise e
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader: Callable, n: int) -> Callable:
+    """First n samples (reference: decorator.py firstn)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False) -> Callable:
+    """Parallel map over a reader with worker threads
+    (reference: decorator.py xmap_readers)."""
+
+    end = object()
+
+    def data_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feeder():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                out_q.put(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:
+                    out_q.put(e)
+                    out_q.put(end)
+                    return
+
+        threading.Thread(target=feeder, daemon=True).start()
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(process_num)
+        ]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                i, mapped = item
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                yield item[1]
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Merge several readers concurrently.  The reference forks processes;
+    here worker threads suffice (the GIL releases during numpy/jax work and
+    TPU hosts are fed from a single process)."""
+
+    end = object()
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            except BaseException as e:
+                q.put(e)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is end:
+                finished += 1
+            elif isinstance(sample, BaseException):
+                raise sample
+            else:
+                yield sample
+
+    return data_reader
+
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False) -> Callable:
+    """Group samples into minibatches (reference: python/paddle/batch.py)."""
+
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,177 @@
+"""RecordIO reader/writer (reference: recordio/ C++ lib +
+python/paddle/fluid/recordio_writer.py).
+
+Backed by the native C++ library (paddle_tpu/native/recordio.cc, built on
+first use); a pure-Python codec of the same on-disk format serves as
+fallback and as the cross-check in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+from typing import Iterator, List, Optional
+
+from . import native
+
+__all__ = ["RecordIOWriter", "RecordIOScanner", "write_recordio", "read_recordio"]
+
+_MAGIC = 0x0CDB0CDB
+
+
+def _lib():
+    lib = native.load("recordio")
+    if lib is not None and not getattr(lib, "_rio_ready", False):
+        lib.rio_writer_open.restype = ctypes.c_void_p
+        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.rio_writer_write.restype = ctypes.c_int
+        lib.rio_writer_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64
+        ]
+        lib.rio_writer_close.restype = ctypes.c_int
+        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_open.restype = ctypes.c_void_p
+        lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.rio_scanner_next.restype = ctypes.c_int64
+        lib.rio_scanner_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        ]
+        lib.rio_scanner_close.restype = None
+        lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib._rio_ready = True
+    return lib
+
+
+class RecordIOWriter:
+    """reference: recordio/writer.h Writer + recordio_writer.py."""
+
+    def __init__(self, path: str, max_chunk_records: int = 1000,
+                 force_python: bool = False):
+        self._path = path
+        self._max = max_chunk_records
+        self._lib = None if force_python else _lib()
+        if self._lib is not None:
+            self._h = self._lib.rio_writer_open(
+                path.encode(), max_chunk_records
+            )
+            if not self._h:
+                raise IOError(f"cannot open {path} for writing")
+        else:
+            self._f = open(path, "wb")
+            self._payload: List[bytes] = []
+
+    def write(self, record: bytes) -> None:
+        if isinstance(record, str):
+            record = record.encode()
+        if self._lib is not None:
+            rc = self._lib.rio_writer_write(self._h, record, len(record))
+            if rc != 0:
+                raise IOError("recordio write failed")
+            return
+        self._payload.append(record)
+        if len(self._payload) >= self._max:
+            self._flush_py()
+
+    def _flush_py(self):
+        if not self._payload:
+            return
+        payload = b"".join(
+            struct.pack("<I", len(r)) + r for r in self._payload
+        )
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._f.write(
+            struct.pack("<IIIIQ", _MAGIC, crc, 0, len(self._payload),
+                        len(payload))
+        )
+        self._f.write(payload)
+        self._payload = []
+
+    def close(self) -> None:
+        if self._lib is not None:
+            if self._lib.rio_writer_close(self._h) != 0:
+                raise IOError("recordio close failed")
+            self._h = None
+        else:
+            self._flush_py()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordIOScanner:
+    """reference: recordio/scanner.h Scanner."""
+
+    def __init__(self, path: str, force_python: bool = False):
+        self._path = path
+        self._lib = None if force_python else _lib()
+        if self._lib is not None:
+            self._h = self._lib.rio_scanner_open(path.encode())
+            if not self._h:
+                raise IOError(f"cannot open {path}")
+        else:
+            self._f = open(path, "rb")
+            self._pending: List[bytes] = []
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._lib is not None:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                n = self._lib.rio_scanner_next(self._h, ctypes.byref(out))
+                if n == -1:
+                    return
+                if n == -2:
+                    raise IOError(f"corrupt recordio chunk in {self._path}")
+                yield ctypes.string_at(out, n)
+        else:
+            while True:
+                if self._pending:
+                    yield self._pending.pop(0)
+                    continue
+                head = self._f.read(24)
+                if len(head) < 24:
+                    return
+                magic, crc, _comp, num, plen = struct.unpack("<IIIIQ", head)
+                if magic != _MAGIC:
+                    raise IOError("bad recordio magic")
+                payload = self._f.read(plen)
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    raise IOError("recordio crc mismatch")
+                pos = 0
+                for _ in range(num):
+                    (rlen,) = struct.unpack_from("<I", payload, pos)
+                    pos += 4
+                    self._pending.append(payload[pos : pos + rlen])
+                    pos += rlen
+
+    def close(self) -> None:
+        if self._lib is not None and self._h:
+            self._lib.rio_scanner_close(self._h)
+            self._h = None
+        elif self._lib is None:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_recordio(path: str, records, max_chunk_records: int = 1000) -> int:
+    n = 0
+    with RecordIOWriter(path, max_chunk_records) as w:
+        for r in records:
+            w.write(r)
+            n += 1
+    return n
+
+
+def read_recordio(path: str) -> Iterator[bytes]:
+    with RecordIOScanner(path) as s:
+        for r in s:
+            yield r

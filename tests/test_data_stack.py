@@ -1,0 +1,155 @@
+"""Data stack: reader decorators, datasets, DataFeeder, py_reader, recordio
+(reference: python/paddle/reader/tests, test_data_feeder.py,
+test_py_reader_push_pop.py, test_recordio_reader.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, reader as rdr
+from paddle_tpu.core.lod import LoDValue
+
+
+def _counting_reader(n):
+    def r():
+        for i in range(n):
+            yield i
+
+    return r
+
+
+def test_decorators_compose():
+    r = rdr.firstn(_counting_reader(100), 10)
+    assert list(r()) == list(range(10))
+    r = rdr.chain(_counting_reader(3), _counting_reader(2))
+    assert list(r()) == [0, 1, 2, 0, 1]
+    r = rdr.map_readers(lambda a, b: a + b, _counting_reader(3), _counting_reader(3))
+    assert list(r()) == [0, 2, 4]
+    r = rdr.compose(_counting_reader(3), _counting_reader(3))
+    assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+    r = rdr.buffered(_counting_reader(10), 4)
+    assert sorted(r()) == list(range(10))
+    r = rdr.shuffle(_counting_reader(10), 5)
+    assert sorted(r()) == list(range(10))
+    r = rdr.cache(_counting_reader(5))
+    assert list(r()) == list(r())  # second pass identical
+    r = rdr.xmap_readers(lambda x: x * 2, _counting_reader(10), 3, 4, order=True)
+    assert list(r()) == [2 * i for i in range(10)]
+
+
+def test_batch():
+    b = rdr.batch(_counting_reader(7), 3)
+    batches = list(b())
+    assert [len(x) for x in batches] == [3, 3, 1]
+    b = rdr.batch(_counting_reader(7), 3, drop_last=True)
+    assert [len(x) for x in list(b())] == [3, 3]
+
+
+def test_datasets_have_right_schema():
+    img, lab = next(fluid.dataset.mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= lab < 10
+    x, y = next(fluid.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    ids, sent = next(fluid.dataset.imdb.train()())
+    assert isinstance(ids, list) and sent in (0, 1)
+    src, tin, tout = next(fluid.dataset.wmt16.train(1000, 1000)())
+    assert tin[0] == 0 and tout[-1] == 1 and len(tin) == len(tout)
+
+
+def test_dataset_deterministic():
+    a = [lab for _, lab in rdr.firstn(fluid.dataset.mnist.train(), 20)()]
+    b = [lab for _, lab in rdr.firstn(fluid.dataset.mnist.train(), 20)()]
+    assert a == b
+
+
+def test_data_feeder_dense_and_lod():
+    x = layers.data("img", [4], dtype="float32")
+    s = layers.data("seq", [2], dtype="float32", lod_level=1)
+    feeder = fluid.DataFeeder(feed_list=[x, s], place=fluid.CPUPlace())
+    batch = [
+        (np.zeros(4, np.float32), np.ones((3, 2), np.float32)),
+        (np.ones(4, np.float32), np.ones((5, 2), np.float32)),
+    ]
+    feed = feeder.feed(batch)
+    assert feed["img"].shape == (2, 4)
+    assert isinstance(feed["seq"], LoDValue)
+    assert feed["seq"].data.shape == (2, 5, 2)
+    np.testing.assert_array_equal(np.asarray(feed["seq"].lengths), [3, 5])
+
+
+def test_py_reader_trains_to_eof():
+    r = layers.py_reader(
+        capacity=4, shapes=[[-1, 8], [-1, 1]], dtypes=["float32", "float32"]
+    )
+    x, y = layers.read_file(r)
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def source():
+        for _ in range(5):
+            yield [
+                (rng.randn(8).astype("float32"), rng.randn(1).astype("float32"))
+                for _ in range(4)
+            ]
+
+    r.decorate_paddle_reader(source)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r.start()
+    n = 0
+    while True:
+        try:
+            exe.run(feed=None, fetch_list=[loss])
+            n += 1
+        except fluid.core.EOFException:
+            r.reset()
+            break
+    assert n == 5
+
+
+def test_recordio_roundtrip_native_and_python(tmp_path):
+    from paddle_tpu import recordio
+
+    path = str(tmp_path / "data.recordio")
+    records = [bytes([i % 256]) * (i * 37 % 100 + 1) for i in range(257)]
+    recordio.write_recordio(path, records, max_chunk_records=64)
+    got = list(recordio.read_recordio(path))
+    assert got == records
+
+    # cross-check: the pure-python codec reads the native file and vice versa
+    py_path = str(tmp_path / "py.recordio")
+    with recordio.RecordIOWriter(py_path, 64, force_python=True) as w:
+        for rec in records:
+            w.write(rec)
+    with recordio.RecordIOScanner(py_path) as s:
+        assert list(s) == records
+    with recordio.RecordIOScanner(path, force_python=True) as s:
+        assert list(s) == records
+
+
+def test_recordio_native_built():
+    from paddle_tpu import native
+
+    assert native.load("recordio") is not None, "native recordio failed to build"
+
+
+def test_reader_over_recordio(tmp_path):
+    import pickle
+
+    from paddle_tpu import recordio
+
+    path = str(tmp_path / "samples.recordio")
+    samples = [(np.full(3, i, np.float32), i % 2) for i in range(10)]
+    recordio.write_recordio(path, (pickle.dumps(s) for s in samples))
+
+    def reader():
+        for rec in recordio.read_recordio(path):
+            yield pickle.loads(rec)
+
+    got = list(reader())
+    assert len(got) == 10
+    np.testing.assert_array_equal(got[3][0], np.full(3, 3, np.float32))
