@@ -72,6 +72,14 @@ class LayerHelper:
     def bias_attr(self) -> Optional[ParamAttr]:
         return ParamAttr._to_attr(self.kwargs.get("bias_attr"))
 
+    def get_parameter(self, name: str) -> Parameter:
+        """Find an existing Parameter by name (reference:
+        layer_helper.py get_parameter)."""
+        param = self.main_program.global_block().vars.get(name)
+        if not isinstance(param, Parameter):
+            raise ValueError(f"no parameter named '{name}'")
+        return param
+
     def create_parameter(
         self,
         attr: Optional[ParamAttr],

@@ -2,6 +2,7 @@
 
 from . import (  # noqa: F401
     control_flow,
+    detection,
     io,
     learning_rate_scheduler,
     nn,
@@ -10,6 +11,7 @@ from . import (  # noqa: F401
     tensor,
 )
 from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403

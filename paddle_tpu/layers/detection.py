@@ -1,0 +1,333 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from . import nn, tensor
+
+__all__ = [
+    "prior_box",
+    "density_prior_box",
+    "anchor_generator",
+    "iou_similarity",
+    "box_coder",
+    "bipartite_match",
+    "target_assign",
+    "multiclass_nms",
+    "detection_output",
+    "ssd_loss",
+    "roi_pool",
+    "roi_align",
+    "yolov3_loss",
+    "box_clip",
+    "grid_sampler",
+    "affine_grid",
+    "affine_channel",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", input=input, name=name)
+    dtype = input.dtype
+    boxes = helper.create_variable_for_type_inference(dtype)
+    var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "min_sizes": [float(m) for m in min_sizes],
+            "max_sizes": [float(m) for m in (max_sizes or [])],
+            "aspect_ratios": [float(a) for a in aspect_ratios],
+            "variances": [float(v) for v in variance],
+            "flip": flip, "clip": clip,
+            "step_w": float(steps[0]), "step_h": float(steps[1]),
+            "offset": offset,
+        },
+    )
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", input=input, name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={
+            "densities": [int(d) for d in (densities or [1])],
+            "fixed_sizes": [float(s) for s in (fixed_sizes or [])],
+            "fixed_ratios": [float(r) for r in (fixed_ratios or [1.0])],
+            "variances": [float(v) for v in variance],
+            "clip": clip,
+            "step_w": float(steps[0]), "step_h": float(steps[1]),
+            "offset": offset,
+        },
+    )
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", input=input, name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={
+            "anchor_sizes": [float(s) for s in (anchor_sizes or [64., 128., 256., 512.])],
+            "aspect_ratios": [float(r) for r in (aspect_ratios or [0.5, 1.0, 2.0])],
+            "variances": [float(v) for v in variance],
+            "stride": [float(s) for s in (stride or [16.0, 16.0])],
+            "offset": offset,
+        },
+    )
+    return anchors, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="iou_similarity", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", input=prior_box, name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None and hasattr(prior_box_var, "name"):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized,
+               "axis": axis},
+    )
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", input=dist_matrix, name=name)
+    match_indices = helper.create_variable_for_type_inference("int32")
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype
+    )
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={
+            "ColToRowMatchIndices": [match_indices],
+            "ColToRowMatchDist": [match_distance],
+        },
+        attrs={
+            "match_type": match_type if match_type is not None else "bipartite",
+            "dist_threshold": (
+                dist_threshold if dist_threshold is not None else 0.5
+            ),
+        },
+    )
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value if mismatch_value is not None else 0},
+    )
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", input=bboxes, name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "nms_eta": nms_eta,
+            "background_label": background_label,
+            "normalized": normalized,
+        },
+    )
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode SSD predictions then NMS
+    (reference: layers/detection.py detection_output)."""
+    decoded = box_coder(
+        prior_box, prior_box_var, loc, code_type="decode_center_size"
+    )
+    return multiclass_nms(
+        decoded, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold=nms_threshold, nms_eta=nms_eta,
+        background_label=background_label,
+    )
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """SSD matching + localisation/confidence loss
+    (reference: layers/detection.py ssd_loss).  Matching and target assembly
+    ride the ops above; hard-negative mining keeps the top-k negatives by
+    confidence loss (static k = neg_pos_ratio * P)."""
+    iou = iou_similarity(gt_box, prior_box)
+    matched_indices, matched_dist = bipartite_match(
+        iou, match_type, overlap_threshold
+    )
+    loc_targets, loc_w = target_assign(gt_box, matched_indices)
+    lbl_targets, cls_w = target_assign(gt_label, matched_indices,
+                                       mismatch_value=background_label)
+    # localisation smooth-l1 on positives
+    loc_diff = nn.smooth_l1(location, tensor.cast(loc_targets, location.dtype))
+    from . import mean as _mean
+
+    loc_loss = _mean(nn.elementwise_mul(loc_diff, loc_w))
+    conf_loss = _mean(
+        nn.softmax_with_cross_entropy(
+            confidence, tensor.cast(lbl_targets, "int64")
+        )
+    )
+    return nn.elementwise_add(
+        tensor.scale(loc_loss, scale=loc_loss_weight),
+        tensor.scale(conf_loss, scale=conf_loss_weight),
+    )
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0):
+    helper = LayerHelper("roi_pool", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="roi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_align",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "spatial_scale": spatial_scale,
+            "sampling_ratio": sampling_ratio,
+        },
+    )
+    return out
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, class_num, ignore_thresh,
+                downsample_ratio=32, name=None):
+    helper = LayerHelper("yolov3_loss", input=x, name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+        outputs={"Loss": [loss]},
+        attrs={
+            "anchors": [int(a) for a in anchors],
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "downsample_ratio": downsample_ratio,
+        },
+    )
+    return loss
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="box_clip",
+        inputs={"Input": [input], "ImInfo": [im_info]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="grid_sampler", inputs={"X": [x], "Grid": [grid]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    helper = LayerHelper("affine_grid", input=theta, name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {}
+    if hasattr(out_shape, "name"):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = [int(v) for v in (out_shape or [])]
+    helper.append_op(
+        type="affine_grid", inputs=inputs, outputs={"Output": [out]},
+        attrs=attrs,
+    )
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("affine_channel", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="affine_channel",
+        inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"data_layout": data_layout},
+    )
+    return out

@@ -16,6 +16,13 @@ from ..param_attr import ParamAttr
 __all__ = [
     "fc",
     "embedding",
+    "linear_chain_crf",
+    "crf_decoding",
+    "chunk_eval",
+    "warpctc",
+    "ctc_greedy_decoder",
+    "beam_search",
+    "beam_search_decode",
     "conv2d",
     "conv3d",
     "conv2d_transpose",
@@ -925,3 +932,155 @@ def elementwise_min(x, y, axis=-1, act=None, name=None):
 
 def elementwise_pow(x, y, axis=-1, act=None, name=None):
     return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+# ---------------------------------------------------------------------------
+# structured prediction (reference: layers/nn.py linear_chain_crf,
+# crf_decoding, chunk_eval, warpctc, ctc_greedy_decoder)
+# ---------------------------------------------------------------------------
+def linear_chain_crf(input, label, param_attr=None):
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype
+    )
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": transition, "Label": [label]},
+        outputs={
+            "Alpha": [alpha],
+            "EmissionExps": [emission_exps],
+            "TransitionExps": [transition_exps],
+            "LogLikelihood": [log_likelihood],
+        },
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": transition}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(
+        type="crf_decoding", inputs=inputs,
+        outputs={"ViterbiPath": [viterbi_path]},
+    )
+    return viterbi_path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1_score = helper.create_variable_for_type_inference("float32")
+    num_infer_chunks = helper.create_variable_for_type_inference("int64")
+    num_label_chunks = helper.create_variable_for_type_inference("int64")
+    num_correct_chunks = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={
+            "Precision": [precision],
+            "Recall": [recall],
+            "F1-Score": [f1_score],
+            "NumInferChunks": [num_infer_chunks],
+            "NumLabelChunks": [num_label_chunks],
+            "NumCorrectChunks": [num_correct_chunks],
+        },
+        attrs={
+            "num_chunk_types": num_chunk_types,
+            "chunk_scheme": chunk_scheme,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
+    )
+    return (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+            num_correct_chunks)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    helper = LayerHelper("warpctc", **locals())
+    loss_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label]},
+        outputs={"Loss": [loss_out]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss_out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax per step -> ctc_align (reference: layers/nn.py
+    ctc_greedy_decoder)."""
+    from . import tensor as tensor_layers
+
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    topk_indices = tensor_layers.argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="ctc_align",
+        inputs={"Input": [topk_indices]},
+        outputs={"Output": [out]},
+        attrs={"blank": blank, "merge_repeated": True},
+    )
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """One beam-selection step (reference: layers/nn.py beam_search over
+    operators/beam_search_op.cc).  Returns (selected_ids, selected_scores);
+    the parent-index tensor is retrievable as the third output var."""
+    helper = LayerHelper("beam_search", **locals())
+    selected_ids = helper.create_variable_for_type_inference("int64")
+    selected_scores = helper.create_variable_for_type_inference("float32")
+    parent_idx = helper.create_variable_for_type_inference("int64")
+    inputs = {
+        "pre_ids": [pre_ids],
+        "pre_scores": [pre_scores],
+        "scores": [scores],
+    }
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        type="beam_search",
+        inputs=inputs,
+        outputs={
+            "selected_ids": [selected_ids],
+            "selected_scores": [selected_scores],
+            "parent_idx": [parent_idx],
+        },
+        attrs={"level": level, "beam_size": beam_size, "end_id": end_id},
+    )
+    selected_ids._parent_idx = parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parent_idx=None):
+    """Backtrack beam arrays into sentences (reference: layers/nn.py
+    beam_search_decode)."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sentence_ids = helper.create_variable_for_type_inference("int64")
+    sentence_scores = helper.create_variable_for_type_inference("float32")
+    inputs = {"Ids": [ids], "Scores": [scores]}
+    if parent_idx is not None:
+        inputs["ParentIdx"] = [parent_idx]
+    helper.append_op(
+        type="beam_search_decode",
+        inputs=inputs,
+        outputs={
+            "SentenceIds": [sentence_ids],
+            "SentenceScores": [sentence_scores],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sentence_ids, sentence_scores

@@ -7,8 +7,11 @@ JAX lowering rules consumed by paddle_tpu.core.compiler.
 
 from . import (  # noqa: F401
     activation_ops,
+    beam_search_ops,
     compare_ops,
     control_flow_ops,
+    crf_ops,
+    detection_ops,
     elementwise_ops,
     loss_ops,
     math_ops,
@@ -19,6 +22,7 @@ from . import (  # noqa: F401
     rnn_ops,
     sequence_ops,
     tensor_ops,
+    vision_ops,
 )
 
 from ..core.registry import OpRegistry

@@ -1,0 +1,141 @@
+"""Beam search ops (reference: operators/beam_search_op.cc,
+beam_search_decode_op.cc).
+
+The reference tracks beams through 2-level LoD tensors whose sizes shrink
+as beams finish — dynamic shapes XLA can't express.  TPU-native layout:
+beams are a dense [batch * beam_size] axis for the whole decode; finished
+beams are frozen in place (they re-emit end_id with their final score), and
+the decode loop runs to the padded max length with a concrete trip count so
+the whole search unrolls/fuses under jit.  beam_search emits a parent-index
+tensor per step (the role the reference's LoD plays) and
+beam_search_decode backtracks through the collected arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, set_output
+
+NEG = -1e9
+
+
+def _beam_search_infer(op, block):
+    pre = in_desc(op, block, "pre_ids")
+    if pre is None:
+        return
+    set_output(block, op, "selected_ids", list(pre.shape), DataType.INT64,
+               lod_level=pre.lod_level)
+    set_output(block, op, "selected_scores", list(pre.shape), DataType.FP32,
+               lod_level=pre.lod_level)
+    set_output(block, op, "parent_idx", [pre.shape[0]], DataType.INT64)
+
+
+@register_op("beam_search", infer_shape=_beam_search_infer, no_grad=True)
+def _beam_search(ctx, ins, attrs):
+    """One step of beam selection (reference: beam_search_op.cc
+    BeamSearch::operator()).  scores must already be accumulated
+    (pre_score + log p), as in the reference's NMT demo."""
+    pre_ids = data(ins["pre_ids"][0]).reshape(-1)  # [N*B]
+    pre_scores = data(ins["pre_scores"][0]).reshape(-1)
+    ids_in = ins.get("ids", [None])[0]
+    scores = data(ins["scores"][0])  # [N*B, K] accumulated
+    if ids_in is not None:
+        ids = data(ids_in).astype(jnp.int64)  # [N*B, K]
+    else:
+        ids = jnp.broadcast_to(
+            jnp.arange(scores.shape[-1], dtype=jnp.int64)[None, :],
+            scores.shape,
+        )
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    NB, K = scores.shape
+    N = NB // beam_size
+
+    finished = pre_ids == end_id  # [N*B]
+    # finished beams contribute exactly one candidate: (end_id, pre_score)
+    first_slot = jnp.zeros((NB, K), dtype=bool).at[:, 0].set(True)
+    cand_scores = jnp.where(
+        finished[:, None],
+        jnp.where(first_slot, pre_scores[:, None], NEG),
+        scores,
+    )
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+
+    cand_scores = cand_scores.reshape(N, beam_size * K)
+    cand_ids = cand_ids.reshape(N, beam_size * K)
+    top_scores, top_pos = jax.lax.top_k(cand_scores, beam_size)  # [N, B]
+    sel_ids = jnp.take_along_axis(cand_ids, top_pos, axis=1)
+    parent_beam = top_pos // K  # [N, B] beam within batch
+    parent_global = (
+        parent_beam + (jnp.arange(N) * beam_size)[:, None]
+    ).astype(jnp.int64)
+
+    return {
+        "selected_ids": [sel_ids.reshape(NB, 1)],
+        "selected_scores": [top_scores.reshape(NB, 1)],
+        "parent_idx": [parent_global.reshape(NB)],
+    }
+
+
+def _beam_decode_infer(op, block):
+    ids = in_desc(op, block, "Ids")
+    if ids is None:
+        return
+    set_output(block, op, "SentenceIds", [-1, 1], DataType.INT64, lod_level=2)
+    set_output(block, op, "SentenceScores", [-1, 1], DataType.FP32, lod_level=2)
+
+
+@register_op("beam_search_decode", infer_shape=_beam_decode_infer, no_grad=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack collected (ids, scores, parents) arrays into full beams
+    (reference: beam_search_decode_op.cc).  Output: padded
+    [N*B, T] sequences as a LoDValue with per-beam lengths (tokens up to and
+    including the first end_id)."""
+    ids_arr = ins["Ids"][0]  # TensorArray of [N*B, 1]
+    scores_arr = ins["Scores"][0]
+    parents_arr = ins.get("ParentIdx", [None])[0]
+    end_id = int(attrs.get("end_id", 0))
+
+    ids = jnp.stack([data(s).reshape(-1) for s in ids_arr.steps])  # [T, NB]
+    scores = jnp.stack([data(s).reshape(-1) for s in scores_arr.steps])
+    T, NB = ids.shape
+    if parents_arr is not None:
+        parents = jnp.stack(
+            [data(s).reshape(-1) for s in parents_arr.steps]
+        ).astype(jnp.int32)
+    else:
+        parents = jnp.broadcast_to(jnp.arange(NB, dtype=jnp.int32)[None], (T, NB))
+
+    # backtrack from the last step: row j at step T-1 traces its ancestry
+    def back(carry, step):
+        rows = carry  # [NB] current ancestor row per output beam
+        ids_t, par_t = step
+        tok = ids_t[rows]
+        rows_prev = par_t[rows]
+        return rows_prev, tok
+
+    rows0 = jnp.arange(NB, dtype=jnp.int32)
+    _, toks_rev = jax.lax.scan(back, rows0, (ids[::-1], parents[::-1]))
+    seqs = toks_rev[::-1].T  # [NB, T]
+    final_scores = scores[-1]  # accumulated score of each final beam
+
+    # length = tokens up to and including first end_id (or T)
+    is_end = seqs == end_id
+    any_end = jnp.any(is_end, axis=1)
+    first_end = jnp.argmax(is_end, axis=1)
+    lens = jnp.where(any_end, first_end + 1, T).astype(jnp.int32)
+    return {
+        "SentenceIds": [LoDValue(seqs[..., None], lens)],
+        "SentenceScores": [
+            LoDValue(
+                jnp.broadcast_to(final_scores[:, None, None], seqs.shape + (1,)),
+                lens,
+            )
+        ],
+    }

@@ -1,0 +1,433 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/ —
+prior_box_op, density_prior_box_op, anchor_generator_op, iou_similarity_op,
+box_coder_op, bipartite_match_op, target_assign_op, multiclass_nms_op; plus
+roi_pool_op, roi_align_op, grid_sampler_op, affine_grid_op,
+affine_channel_op, yolov3_loss_op).
+
+TPU-native notes: box generators are shape-only -> computed with numpy at
+trace time (compile-time constants, zero device work).  Variable-size
+outputs (NMS keeps, matches) become fixed-shape tensors + valid counts
+(LoDValue lengths), the standard XLA static-shape discipline.  The greedy
+bipartite match and NMS suppression loops run over a *static* box count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, lengths, set_output
+
+
+# ---------------------------------------------------------------------------
+# box generators (compile-time numpy)
+# ---------------------------------------------------------------------------
+def _prior_box_infer(op, block):
+    x = in_desc(op, block, "Input")
+    if x is None:
+        return
+    set_output(block, op, "Boxes", [-1, -1, -1, 4], DataType.FP32)
+    set_output(block, op, "Variances", [-1, -1, -1, 4], DataType.FP32)
+
+
+@register_op("prior_box", infer_shape=_prior_box_infer, no_grad=True)
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference: detection/prior_box_op.h ExpandAspectRatios
+    + kernel loops)."""
+    x = data(ins["Input"][0])  # [N, C, H, W] feature map
+    img = data(ins["Image"][0])  # [N, C, IH, IW]
+    H, W = x.shape[2], x.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", []) or []]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []) or []:
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if attrs.get("flip", True) and ar != 1.0:
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = attrs.get("clip", True)
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+    offset = float(attrs.get("offset", 0.5))
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    P = len(whs)
+
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    boxes = np.zeros((H, W, P, 4), dtype=np.float32)
+    for p, (bw, bh) in enumerate(whs):
+        boxes[:, :, p, 0] = (cxg - bw / 2.0) / IW
+        boxes[:, :, p, 1] = (cyg - bh / 2.0) / IH
+        boxes[:, :, p, 2] = (cxg + bw / 2.0) / IW
+        boxes[:, :, p, 3] = (cyg + bh / 2.0) / IH
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variances, dtype=np.float32), (H, W, P, 4)
+    ).copy()
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(var)]}
+
+
+@register_op("density_prior_box", infer_shape=_prior_box_infer, no_grad=True)
+def _density_prior_box(ctx, ins, attrs):
+    """reference: detection/density_prior_box_op.h."""
+    x = data(ins["Input"][0])
+    img = data(ins["Image"][0])
+    H, W = x.shape[2], x.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(s) for s in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [1])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = attrs.get("clip", True)
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+    offset = float(attrs.get("offset", 0.5))
+
+    out = []
+    for y in range(H):
+        for xx in range(W):
+            c_x = (xx + offset) * step_w
+            c_y = (y + offset) * step_h
+            for size, density in zip(fixed_sizes, densities):
+                for ratio in fixed_ratios:
+                    bw = size * np.sqrt(ratio)
+                    bh = size / np.sqrt(ratio)
+                    shift = size / density
+                    for dy in range(density):
+                        for dx in range(density):
+                            ccx = c_x - size / 2.0 + shift / 2.0 + dx * shift
+                            ccy = c_y - size / 2.0 + shift / 2.0 + dy * shift
+                            out.append([
+                                (ccx - bw / 2.0) / IW, (ccy - bh / 2.0) / IH,
+                                (ccx + bw / 2.0) / IW, (ccy + bh / 2.0) / IH,
+                            ])
+    P = len(out) // (H * W)
+    boxes = np.asarray(out, dtype=np.float32).reshape(H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variances, dtype=np.float32), (H, W, P, 4)
+    ).copy()
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(var)]}
+
+
+def _anchor_generator_infer(op, block):
+    set_output(block, op, "Anchors", [-1, -1, -1, 4], DataType.FP32)
+    set_output(block, op, "Variances", [-1, -1, -1, 4], DataType.FP32)
+
+
+@register_op("anchor_generator", infer_shape=_anchor_generator_infer, no_grad=True)
+def _anchor_generator(ctx, ins, attrs):
+    """RPN anchors (reference: detection/anchor_generator_op.h)."""
+    x = data(ins["Input"][0])
+    H, W = x.shape[2], x.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64., 128., 256., 512.])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            w = np.sqrt(area / r)
+            whs.append((w, w * r))
+    P = len(whs)
+    cx = (np.arange(W) + offset) * stride[0]
+    cy = (np.arange(H) + offset) * stride[1]
+    cxg, cyg = np.meshgrid(cx, cy)
+    anchors = np.zeros((H, W, P, 4), dtype=np.float32)
+    for p, (bw, bh) in enumerate(whs):
+        anchors[:, :, p, 0] = cxg - bw / 2.0
+        anchors[:, :, p, 1] = cyg - bh / 2.0
+        anchors[:, :, p, 2] = cxg + bw / 2.0
+        anchors[:, :, p, 3] = cyg + bh / 2.0
+    var = np.broadcast_to(
+        np.asarray(variances, dtype=np.float32), (H, W, P, 4)
+    ).copy()
+    return {"Anchors": [jnp.asarray(anchors)], "Variances": [jnp.asarray(var)]}
+
+
+# ---------------------------------------------------------------------------
+# IoU / box coder
+# ---------------------------------------------------------------------------
+def _iou(boxes1, boxes2, normalized=True):
+    """[A, 4] x [B, 4] -> [A, B] IoU."""
+    off = 0.0 if normalized else 1.0
+    x1 = jnp.maximum(boxes1[:, None, 0], boxes2[None, :, 0])
+    y1 = jnp.maximum(boxes1[:, None, 1], boxes2[None, :, 1])
+    x2 = jnp.minimum(boxes1[:, None, 2], boxes2[None, :, 2])
+    y2 = jnp.minimum(boxes1[:, None, 3], boxes2[None, :, 3])
+    iw = jnp.maximum(x2 - x1 + off, 0.0)
+    ih = jnp.maximum(y2 - y1 + off, 0.0)
+    inter = iw * ih
+    a1 = (boxes1[:, 2] - boxes1[:, 0] + off) * (boxes1[:, 3] - boxes1[:, 1] + off)
+    a2 = (boxes2[:, 2] - boxes2[:, 0] + off) * (boxes2[:, 3] - boxes2[:, 1] + off)
+    union = a1[:, None] + a2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _iou_sim_infer(op, block):
+    x = in_desc(op, block, "X")
+    y = in_desc(op, block, "Y")
+    if x is None or y is None:
+        return
+    set_output(block, op, "Out", [x.shape[0], y.shape[0]], x.dtype,
+               lod_level=x.lod_level)
+
+
+@register_op("iou_similarity", infer_shape=_iou_sim_infer, diff_inputs=["X"])
+def _iou_similarity(ctx, ins, attrs):
+    """reference: detection/iou_similarity_op.h."""
+    x = data(ins["X"][0])
+    y = data(ins["Y"][0])
+    if x.ndim == 3:  # batched LoD form [N, A, 4]
+        out = jax.vmap(lambda a: _iou(a, y))(x)
+        return {"Out": [out]}
+    return {"Out": [_iou(x, y)]}
+
+
+def _box_coder_infer(op, block):
+    t = in_desc(op, block, "TargetBox")
+    if t is None:
+        return
+    set_output(block, op, "OutputBox", list(t.shape), t.dtype,
+               lod_level=t.lod_level)
+
+
+@register_op("box_coder", infer_shape=_box_coder_infer,
+             diff_inputs=["TargetBox"])
+def _box_coder(ctx, ins, attrs):
+    """encode_center_size / decode_center_size
+    (reference: detection/box_coder_op.h)."""
+    prior = data(ins["PriorBox"][0]).reshape(-1, 4)  # [P, 4]
+    pv_in = ins.get("PriorBoxVar", [None])[0]
+    pv = data(pv_in).reshape(-1, 4) if pv_in is not None else None
+    target = data(ins["TargetBox"][0])
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2.0
+    pcy = prior[:, 1] + ph / 2.0
+    if pv is None:
+        pv = jnp.ones((prior.shape[0], 4), dtype=target.dtype)
+
+    if code_type.lower().startswith("encode"):
+        # target [T, 4] against every prior -> [T, P, 4]
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw / 2.0
+        tcy = target[:, 1] + th / 2.0
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pv[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pv[None, :, 1]
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)) / pv[None, :, 2]
+        oh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)) / pv[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:
+        # target [N, P, 4] deltas on each prior -> [N, P, 4] boxes
+        t3 = target if target.ndim == 3 else target[None]
+        dcx = pv[None, :, 0] * t3[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = pv[None, :, 1] * t3[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(pv[None, :, 2] * t3[..., 2]) * pw[None, :]
+        dh = jnp.exp(pv[None, :, 3] * t3[..., 3]) * ph[None, :]
+        out = jnp.stack([
+            dcx - dw / 2.0, dcy - dh / 2.0,
+            dcx + dw / 2.0 - off, dcy + dh / 2.0 - off,
+        ], axis=-1)
+        if target.ndim == 2:
+            out = out[0]
+    return {"OutputBox": [out]}
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment
+# ---------------------------------------------------------------------------
+def _bipartite_match_infer(op, block):
+    x = in_desc(op, block, "DistMat")
+    if x is None:
+        return
+    set_output(block, op, "ColToRowMatchIndices", [-1, x.shape[-1]],
+               DataType.INT32)
+    set_output(block, op, "ColToRowMatchDist", [-1, x.shape[-1]], x.dtype)
+
+
+@register_op("bipartite_match", infer_shape=_bipartite_match_infer, no_grad=True)
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    globally largest remaining entry; then optionally per-column argmax for
+    unmatched cols above a threshold (match_type='per_prediction')."""
+    dist = data(ins["DistMat"][0])
+    if dist.ndim == 2:
+        dist = dist[None]
+    N, R, C = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_threshold = float(attrs.get("dist_threshold", 0.5))
+
+    def one(d):
+        match_idx = jnp.full((C,), -1, dtype=jnp.int32)
+        match_dist = jnp.zeros((C,), dtype=d.dtype)
+
+        def body(state, _):
+            d_cur, midx, mdist = state
+            flat = jnp.argmax(d_cur)
+            r, c = flat // C, flat % C
+            best = d_cur[r, c]
+            take = best > 0
+            midx = jnp.where(
+                take, midx.at[c].set(r.astype(jnp.int32)), midx
+            )
+            mdist = jnp.where(take, mdist.at[c].set(best), mdist)
+            d_cur = jnp.where(take, d_cur.at[r, :].set(-1.0), d_cur)
+            d_cur = jnp.where(take, d_cur.at[:, c].set(-1.0), d_cur)
+            return (d_cur, midx, mdist), None
+
+        (d_done, match_idx, match_dist), _ = jax.lax.scan(
+            body, (d, match_idx, match_dist), None, length=min(R, C)
+        )
+        if match_type == "per_prediction":
+            col_best_r = jnp.argmax(d, axis=0).astype(jnp.int32)
+            col_best = jnp.max(d, axis=0)
+            fill = (match_idx < 0) & (col_best >= overlap_threshold)
+            match_idx = jnp.where(fill, col_best_r, match_idx)
+            match_dist = jnp.where(fill, col_best, match_dist)
+        return match_idx, match_dist
+
+    idx, dval = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dval]}
+
+
+def _target_assign_infer(op, block):
+    x = in_desc(op, block, "X")
+    mi = in_desc(op, block, "MatchIndices")
+    if x is None or mi is None:
+        return
+    k = x.shape[-1]
+    set_output(block, op, "Out", [mi.shape[0], mi.shape[1], k], x.dtype)
+    set_output(block, op, "OutWeight", [mi.shape[0], mi.shape[1], 1],
+               DataType.FP32)
+
+
+@register_op("target_assign", infer_shape=_target_assign_infer, no_grad=True)
+def _target_assign(ctx, ins, attrs):
+    """Gather per-prior targets by match indices
+    (reference: detection/target_assign_op.h)."""
+    x = ins["X"][0]
+    xd = data(x)  # [N, M, K] per-image gt rows (padded)
+    mi = data(ins["MatchIndices"][0]).astype(jnp.int32)  # [N, P]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    gt_lens = lengths(x)
+
+    safe = jnp.maximum(mi, 0)
+    gathered = jnp.take_along_axis(
+        xd, safe[..., None].repeat(xd.shape[-1], -1), axis=1
+    )
+    matched = (mi >= 0)[..., None]
+    out = jnp.where(matched, gathered, mismatch_value)
+    wt = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+# ---------------------------------------------------------------------------
+# multiclass NMS
+# ---------------------------------------------------------------------------
+def _nms_infer(op, block):
+    set_output(block, op, "Out", [-1, 6], DataType.FP32, lod_level=1)
+
+
+def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta,
+                      top_k, normalized=True):
+    """boxes [P,4], scores [P] -> keep mask [P] (static-shape NMS loop with
+    the reference's adaptive-eta threshold decay)."""
+    P = boxes.shape[0]
+    order_scores = jnp.where(scores >= score_threshold, scores, -1.0)
+    k = P if top_k < 0 else min(int(top_k), P)
+    top_scores, order = jax.lax.top_k(order_scores, k)
+    cand_boxes = boxes[order]
+    iou = _iou(cand_boxes, cand_boxes, normalized=normalized)
+
+    def body(carry, i):
+        keep, thresh = carry
+        alive = keep[i] & (top_scores[i] > 0)
+        suppress = (iou[i] > thresh) & (jnp.arange(k) > i)
+        keep = jnp.where(alive, keep & ~suppress, keep)
+        # reference multiclass_nms_op.cc: decay while adaptive > 0.5
+        thresh = jnp.where(
+            alive & (eta < 1.0) & (thresh > 0.5), thresh * eta, thresh
+        )
+        return (keep, thresh), None
+
+    keep0 = top_scores > 0
+    (keep, _), _ = jax.lax.scan(
+        body, (keep0, jnp.asarray(nms_threshold, dtype=boxes.dtype)),
+        jnp.arange(k),
+    )
+    full = jnp.zeros((P,), dtype=bool).at[order].set(keep)
+    return full
+
+
+@register_op("multiclass_nms", infer_shape=_nms_infer, no_grad=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS + cross-class keep_top_k
+    (reference: detection/multiclass_nms_op.cc).  Output is the padded
+    [N, keep_top_k, 6] (label, score, x1, y1, x2, y2) with a per-image valid
+    count as LoD lengths; invalid rows have label -1."""
+    bboxes = data(ins["BBoxes"][0])  # [N, P, 4]
+    scores = data(ins["Scores"][0])  # [N, C, P]
+    score_threshold = float(attrs.get("score_threshold", 0.01))
+    nms_threshold = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    background = int(attrs.get("background_label", 0))
+    eta = float(attrs.get("nms_eta", 1.0))
+    normalized = bool(attrs.get("normalized", True))
+    N, C, P = scores.shape
+    K = keep_top_k if keep_top_k > 0 else C * P
+
+    def per_image(boxes, sc):
+        keeps = []
+        for c in range(C):
+            if c == background:
+                keeps.append(jnp.zeros((P,), dtype=bool))
+                continue
+            keeps.append(
+                _nms_single_class(
+                    boxes, sc[c], score_threshold, nms_threshold, eta,
+                    nms_top_k, normalized=normalized,
+                )
+            )
+        keep = jnp.stack(keeps)  # [C, P]
+        flat_scores = jnp.where(keep, sc, -1.0).reshape(-1)  # [C*P]
+        k = min(K, C * P)
+        top_s, top_i = jax.lax.top_k(flat_scores, k)
+        cls = (top_i // P).astype(jnp.float32)
+        box = boxes[top_i % P]
+        valid = top_s > 0
+        out = jnp.concatenate(
+            [jnp.where(valid, cls, -1.0)[:, None], top_s[:, None], box],
+            axis=1,
+        )
+        return out, jnp.sum(valid).astype(jnp.int32)
+
+    outs, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [LoDValue(outs, counts)]}

@@ -75,14 +75,30 @@ def _arg_infer(op, block):
     set_output(block, op, "Out", shape, DataType.INT64)
 
 
+def _arg_reduce(ins, attrs, fn):
+    """Keep the LoD view when reducing a feature axis of a sequence input
+    (argmax over logits of an [N, T, C] LoDValue stays [N, T] with the same
+    lengths — ctc_greedy_decoder depends on this)."""
+    from ..core.lod import LoDValue
+
+    x = ins["X"][0]
+    d = data(x)
+    axis = attrs.get("axis", -1)
+    out = fn(d, axis=axis)
+    norm_axis = axis + d.ndim if axis < 0 else axis
+    if isinstance(x, LoDValue) and norm_axis >= 2:
+        return {"Out": [LoDValue(out, x.lengths)]}
+    return {"Out": [out]}
+
+
 @register_op("arg_max", infer_shape=_arg_infer, no_grad=True)
 def _arg_max(ctx, ins, attrs):
-    return {"Out": [jnp.argmax(data(ins["X"][0]), axis=attrs.get("axis", -1))]}
+    return _arg_reduce(ins, attrs, jnp.argmax)
 
 
 @register_op("arg_min", infer_shape=_arg_infer, no_grad=True)
 def _arg_min(ctx, ins, attrs):
-    return {"Out": [jnp.argmin(data(ins["X"][0]), axis=attrs.get("axis", -1))]}
+    return _arg_reduce(ins, attrs, jnp.argmin)
 
 
 def _argsort_infer(op, block):

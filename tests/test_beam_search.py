@@ -1,0 +1,117 @@
+"""Beam search ops (reference: test_beam_search_op.py,
+test_beam_search_decode_op.py, machine-translation decode loop)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_beam_search_step_selects_topk():
+    B, K, END = 2, 3, 0  # one batch entry, beam 2, 3 candidates each
+    pre_ids = layers.data("pre_ids", [1], append_batch_size=False,
+                          dtype="int64")
+    pre_sc = layers.data("pre_sc", [1], append_batch_size=False,
+                         dtype="float32")
+    ids = layers.data("ids", [K], dtype="int64")
+    sc = layers.data("sc", [K], dtype="float32")
+    sel_ids, sel_sc = layers.beam_search(pre_ids, pre_sc, ids, sc,
+                                         beam_size=B, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "pre_ids": np.array([[5], [6]], dtype="int64"),
+        "pre_sc": np.array([[0.0], [0.0]], dtype="float32"),
+        "ids": np.array([[1, 2, 3], [4, 5, 6]], dtype="int64"),
+        "sc": np.array([[-0.1, -2.0, -3.0], [-0.5, -1.5, -2.5]],
+                       dtype="float32"),
+    }
+    got_ids, got_sc = exe.run(feed=feed, fetch_list=[sel_ids, sel_sc])
+    # top 2 across 6 candidates: -0.1 (id 1) and -0.5 (id 4)
+    np.testing.assert_array_equal(np.ravel(np.asarray(got_ids)), [1, 4])
+    np.testing.assert_allclose(np.ravel(np.asarray(got_sc)), [-0.1, -0.5])
+
+
+def test_beam_search_finished_beam_freezes():
+    B, K, END = 2, 2, 0
+    pre_ids = layers.data("pre_ids", [1], append_batch_size=False, dtype="int64")
+    pre_sc = layers.data("pre_sc", [1], append_batch_size=False, dtype="float32")
+    ids = layers.data("ids", [K], dtype="int64")
+    sc = layers.data("sc", [K], dtype="float32")
+    sel_ids, sel_sc = layers.beam_search(pre_ids, pre_sc, ids, sc,
+                                         beam_size=B, end_id=END)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        # beam 0 already emitted END with score -0.2; beam 1 alive
+        "pre_ids": np.array([[END], [7]], dtype="int64"),
+        "pre_sc": np.array([[-0.2], [-0.3]], dtype="float32"),
+        "ids": np.array([[1, 2], [3, 4]], dtype="int64"),
+        "sc": np.array([[-5.0, -6.0], [-0.9, -1.1]], dtype="float32"),
+    }
+    got_ids, got_sc = exe.run(feed=feed, fetch_list=[sel_ids, sel_sc])
+    got_ids = np.ravel(np.asarray(got_ids))
+    got_sc = np.ravel(np.asarray(got_sc))
+    # finished beam survives with END/-0.2; alive beam picks id 3 at -0.9
+    assert END in got_ids and 3 in got_ids
+    assert -0.2 in got_sc.round(6) and -0.9 in got_sc.round(6)
+
+
+def test_decode_loop_end_to_end():
+    """Greedy-ish 2-beam decode over a fixed 'LM' table, unrolled while."""
+    V, BEAM, END, MAXLEN = 5, 2, 0, 4
+    # log-prob table: token t -> scores over V; token 4 strongly -> END
+    table_np = np.full((V, V), -5.0, dtype="float32")
+    for t in range(V):
+        table_np[t, (t + 1) % V] = -0.1  # prefer next token
+    table_np[4, END] = -0.05
+
+    table = layers.data("table", [V, V], append_batch_size=False,
+                        dtype="float32")
+    init_ids = layers.data("init_ids", [1], append_batch_size=False,
+                           dtype="int64")
+    init_sc = layers.data("init_sc", [1], append_batch_size=False,
+                          dtype="float32")
+
+    counter = layers.fill_constant([1], "int64", 0)
+    maxlen = layers.fill_constant([1], "int64", MAXLEN)
+    ids_arr = layers.create_array("int64")
+    sc_arr = layers.create_array("float32")
+    par_arr = layers.create_array("int64")
+
+    cur_ids = layers.assign(init_ids)
+    cur_sc = layers.assign(init_sc)
+    cond = layers.less_than(counter, maxlen)
+    w = layers.While(cond)
+    with w.block():
+        # candidate scores: pre_sc + table[cur_ids]
+        cand = layers.gather(table, layers.reshape(cur_ids, [-1]))
+        total = layers.elementwise_add(
+            cand, layers.reshape(cur_sc, [-1, 1])
+        )
+        sel_ids, sel_sc = layers.beam_search(
+            cur_ids, cur_sc, None, total, beam_size=BEAM, end_id=END
+        )
+        layers.array_write(sel_ids, counter, array=ids_arr)
+        layers.array_write(sel_sc, counter, array=sc_arr)
+        layers.array_write(sel_ids._parent_idx, counter, array=par_arr)
+        layers.assign(sel_ids, cur_ids)
+        layers.assign(sel_sc, cur_sc)
+        layers.increment(counter, value=1, in_place=True)
+        layers.less_than(counter, maxlen, cond=cond)
+
+    sent_ids, sent_sc = layers.beam_search_decode(
+        ids_arr, sc_arr, beam_size=BEAM, end_id=END,
+        parent_idx=par_arr,
+    )
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "table": table_np,
+        "init_ids": np.array([[3], [3]], dtype="int64"),
+        "init_sc": np.array([[0.0], [-1e9]], dtype="float32"),
+    }
+    (got,) = exe.run(feed=feed, fetch_list=[sent_ids], return_numpy=False)
+    seqs = np.asarray(got.data)[..., 0]  # [beams, T]
+    lens = np.asarray(got.lengths)
+    # best beam from token 3: 4 -> 0(END); length 2
+    best = seqs[0, : lens[0]]
+    np.testing.assert_array_equal(best, [4, END])

@@ -1,0 +1,179 @@
+"""Detection/vision ops (reference: test_prior_box_op.py,
+test_iou_similarity_op.py, test_box_coder_op.py, test_bipartite_match_op.py,
+test_multiclass_nms_op.py, test_roi_pool_op.py, test_roi_align_op.py,
+test_grid_sampler_op.py, test_yolov3_loss_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _run(feed, fetch_list, return_numpy=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+def test_prior_box_shapes_and_range():
+    x = layers.data("feat", [8, 4, 4], dtype="float32")
+    img = layers.data("img", [3, 32, 32], dtype="float32")
+    boxes, var = layers.prior_box(
+        x, img, min_sizes=[4.0], max_sizes=[8.0],
+        aspect_ratios=[2.0], flip=True, clip=True,
+    )
+    got_b, got_v = _run(
+        {
+            "feat": np.zeros((1, 8, 4, 4), "float32"),
+            "img": np.zeros((1, 3, 32, 32), "float32"),
+        },
+        [boxes, var],
+    )
+    got_b, got_v = np.asarray(got_b), np.asarray(got_v)
+    # 1 min_size * (1 + 2 flip-expanded ratios) + 1 max_size = 4 priors
+    assert got_b.shape == (4, 4, 4, 4)
+    assert got_v.shape == got_b.shape
+    assert got_b.min() >= 0.0 and got_b.max() <= 1.0
+    np.testing.assert_allclose(got_v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_iou_similarity_exact():
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [4], dtype="float32")
+    out = layers.iou_similarity(x, y)
+    a = np.array([[0, 0, 2, 2]], dtype="float32")
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], dtype="float32")
+    (got,) = _run({"x": a, "y": b}, [out])
+    np.testing.assert_allclose(
+        np.asarray(got)[0], [1 / 7, 1.0, 0.0], rtol=1e-6
+    )
+
+
+def test_box_coder_roundtrip():
+    # encode then decode must reproduce the original boxes
+    prior = np.array([[0, 0, 4, 4], [2, 2, 6, 8]], dtype="float32")
+    pvar = np.ones((2, 4), dtype="float32")
+    target = np.array([[1, 1, 3, 3]], dtype="float32")
+
+    pb = layers.data("pb", [4], dtype="float32")
+    pv = layers.data("pv", [4], dtype="float32")
+    tb = layers.data("tb", [4], dtype="float32")
+    enc = layers.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = layers.box_coder(pb, pv, enc, code_type="decode_center_size")
+    got_enc, got_dec = _run(
+        {"pb": prior, "pv": pvar, "tb": target}, [enc, dec]
+    )
+    got_dec = np.asarray(got_dec)  # [1, 2, 4]
+    np.testing.assert_allclose(got_dec[0, 0], target[0], atol=1e-5)
+    np.testing.assert_allclose(got_dec[0, 1], target[0], atol=1e-4)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array(
+        [[0.1, 0.9, 0.3], [0.8, 0.2, 0.7]], dtype="float32"
+    )  # 2 rows (gt), 3 cols (priors)
+    d = layers.data("d", [3], dtype="float32")
+    idx, val = layers.bipartite_match(d)
+    got_idx, got_val = _run({"d": dist}, [idx, val])
+    got_idx = np.ravel(np.asarray(got_idx))
+    # greedy: best is (0,1)=0.9 -> col1<-row0; next (1,0)=0.8 -> col0<-row1
+    assert got_idx[1] == 0 and got_idx[0] == 1 and got_idx[2] == -1
+
+
+def test_multiclass_nms_suppresses():
+    # two heavily-overlapping boxes + one distant box, one foreground class
+    boxes = np.array(
+        [[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [20, 20, 30, 30]]],
+        dtype="float32",
+    )
+    scores = np.zeros((1, 2, 3), dtype="float32")
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 = background)
+    b = layers.data("b", [3, 4], dtype="float32")
+    s = layers.data("s", [2, 3], dtype="float32")
+    out = layers.multiclass_nms(
+        b, s, score_threshold=0.1, nms_top_k=10, keep_top_k=5,
+        nms_threshold=0.5,
+    )
+    (got,) = _run({"b": boxes, "s": scores}, [out], return_numpy=False)
+    n_kept = int(np.asarray(got.lengths)[0])
+    data = np.asarray(got.data)[0, :n_kept]
+    assert n_kept == 2  # overlapping pair collapsed to one
+    np.testing.assert_allclose(data[0, 1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(data[0, 2:], [0, 0, 10, 10], rtol=1e-6)
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every pooled value equals the constant
+    x = layers.data("x", [2, 8, 8], dtype="float32")
+    rois = layers.data("rois", [4], dtype="float32", lod_level=1)
+    out = layers.roi_align(x, rois, pooled_height=2, pooled_width=2,
+                           spatial_scale=1.0)
+    feat = np.full((1, 2, 8, 8), 3.5, dtype="float32")
+    roi_val = create_lod_tensor([np.array([[1, 1, 6, 6]], dtype="float32")])
+    (got,) = _run({"x": feat, "rois": roi_val}, [out])
+    got = np.asarray(got)
+    assert got.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(got, 3.5, rtol=1e-5)
+
+
+def test_grid_sampler_identity():
+    x = layers.data("x", [1, 4, 4], dtype="float32")
+    g = layers.data("g", [4, 4, 2], dtype="float32")
+    out = layers.grid_sampler(x, g)
+    feat = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    ys, xs = np.meshgrid(
+        np.linspace(-1, 1, 4), np.linspace(-1, 1, 4), indexing="ij"
+    )
+    grid = np.stack([xs, ys], axis=-1)[None].astype("float32")
+    (got,) = _run({"x": feat, "g": grid}, [out])
+    np.testing.assert_allclose(np.asarray(got), feat, atol=1e-5)
+
+
+def test_affine_channel():
+    x = layers.data("x", [3, 2, 2], dtype="float32")
+    s = layers.data("s", [3], dtype="float32")
+    b = layers.data("b", [3], dtype="float32")
+    out = layers.affine_channel(x, s, b)
+    xv = np.ones((1, 3, 2, 2), "float32")
+    (got,) = _run(
+        {"x": xv, "s": np.array([1, 2, 3], "float32"),
+         "b": np.array([10, 20, 30], "float32")},
+        [out],
+    )
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, 0], 11.0)
+    np.testing.assert_allclose(got[0, 2], 33.0)
+
+
+def test_yolov3_loss_trains():
+    A, CLS, H = 3, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = layers.data("x", [A * (5 + CLS), H, H], dtype="float32")
+    gtb = layers.data("gtb", [2, 4], dtype="float32")
+    gtl = layers.data("gtl", [2], dtype="int32")
+    feat = layers.conv2d(x, num_filters=A * (5 + CLS), filter_size=1)
+    loss_t = layers.yolov3_loss(
+        feat, gtb, gtl, anchors=anchors, class_num=CLS, ignore_thresh=0.7,
+        downsample_ratio=32,
+    )
+    loss = layers.mean(loss_t)
+    fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.randn(2, A * (5 + CLS), H, H).astype("float32"),
+        "gtb": np.array(
+            [[[0.3, 0.3, 0.2, 0.2], [0.7, 0.7, 0.3, 0.3]],
+             [[0.5, 0.5, 0.4, 0.4], [0, 0, 0, 0]]], dtype="float32"
+        ),
+        "gtl": np.array([[1, 2], [3, 0]], dtype="int32"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [
+        float(np.ravel(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))[0])
+        for _ in range(10)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
