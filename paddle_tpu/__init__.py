@@ -44,6 +44,13 @@ from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
 
 from . import average  # noqa: F401
 from . import clip  # noqa: F401
+from . import contrib  # noqa: F401
+from . import inference  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .transpiler import memory_optimize, release_memory  # noqa: F401
+from .async_executor import AsyncExecutor  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dataset  # noqa: F401
 from . import io  # noqa: F401
 from . import reader  # noqa: F401

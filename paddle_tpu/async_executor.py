@@ -1,0 +1,162 @@
+"""AsyncExecutor: many-thread file-sharded training
+(reference: python/paddle/fluid/async_executor.py over
+paddle/fluid/framework/async_executor.cc + executor_thread_worker.cc +
+MultiSlotDataFeed data_feed.cc).
+
+The reference runs N C++ threads, each popping files from a shared list,
+parsing the MultiSlot text format and running the program Hogwild-style
+over a shared scope.  Here each worker thread owns an Executor over the
+shared scope; XLA compute releases the GIL so workers overlap, and scope
+write-back is last-writer-wins per variable — the same Hogwild semantics.
+Sparse CTR-style slots feed as padded LoDValues.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.framework import Program, default_main_program
+from .core.lod import create_lod_tensor
+from .core.place import CPUPlace, Place
+from .core.scope import Scope, global_scope
+from .data_feed_desc import DataFeedDesc
+
+__all__ = ["AsyncExecutor"]
+
+
+def _parse_multislot_line(line: str, slots):
+    """One MultiSlot text line: for each slot, '<n> v1 ... vn'
+    (reference: data_feed.cc MultiSlotDataFeed::ParseOneInstance).  ALL
+    slots are parsed in file order — unused ones are skipped after reading,
+    like the reference — and truncated lines are rejected."""
+    toks = line.split()
+    pos = 0
+    out = []
+    for s in slots:
+        if pos >= len(toks):
+            raise ValueError(f"truncated MultiSlot line at slot {s.name}")
+        n = int(toks[pos])
+        pos += 1
+        if pos + n > len(toks):
+            raise ValueError(
+                f"slot {s.name} declares {n} values but the line has "
+                f"{len(toks) - pos} left"
+            )
+        vals = toks[pos : pos + n]
+        pos += n
+        if not s.is_used:
+            out.append(None)
+        elif s.type.startswith("float"):
+            out.append(np.asarray([float(v) for v in vals], dtype=np.float32))
+        else:
+            out.append(np.asarray([int(v) for v in vals], dtype=np.int64))
+    return out
+
+
+class AsyncExecutor:
+    """reference: async_executor.py AsyncExecutor (RunFromFile surface)."""
+
+    def __init__(self, place: Optional[Place] = None, run_mode: str = ""):
+        self.place = place or CPUPlace()
+        self.scope = global_scope()
+
+    def run(
+        self,
+        program: Optional[Program],
+        data_feed: DataFeedDesc,
+        filelist: Sequence[str],
+        thread_num: int,
+        fetch: Sequence,
+        mode: str = "",
+        debug: bool = False,
+    ) -> None:
+        program = program or default_main_program()
+        if thread_num <= 0:
+            raise ValueError("thread_num must be positive")
+        fetch_names = [
+            v.name if hasattr(v, "name") else str(v) for v in (fetch or [])
+        ]
+        block0 = program.global_block()
+        all_slots = list(data_feed.slots)
+        used_idx = [i for i, s in enumerate(all_slots) if s.is_used]
+        used = [all_slots[i] for i in used_idx]
+
+        files: queue.Queue = queue.Queue()
+        for f in filelist:
+            files.put(f)
+        errors: List[BaseException] = []
+
+        def feed_from(slot_rows):
+            feed = {}
+            for i, s in zip(used_idx, used):
+                col = [row[i] for row in slot_rows]
+                v = block0.vars.get(s.name)
+                lod = v.lod_level if v is not None else (0 if s.is_dense else 1)
+                if lod > 0:
+                    feed[s.name] = create_lod_tensor(
+                        [c[:, None] if c.ndim == 1 else c for c in col]
+                    )
+                else:
+                    feed[s.name] = np.stack(col)
+            return feed
+
+        def worker():
+            exe = Executor(self.place, donate_states=False)
+            try:
+                while True:
+                    try:
+                        path = files.get_nowait()
+                    except queue.Empty:
+                        return
+                    batch = []
+                    with open(path) as f:
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            batch.append(
+                                _parse_multislot_line(line, all_slots)
+                            )
+                            if len(batch) == data_feed.batch_size:
+                                vals = exe.run(
+                                    program=program,
+                                    feed=feed_from(batch),
+                                    fetch_list=fetch_names,
+                                )
+                                if debug and fetch_names:
+                                    print(
+                                        f"[async_executor] {path}: "
+                                        + ", ".join(
+                                            f"{n}={np.ravel(np.asarray(v))[0]:.6f}"
+                                            for n, v in zip(fetch_names, vals)
+                                        )
+                                    )
+                                batch = []
+                    if batch:
+                        exe.run(program=program, feed=feed_from(batch),
+                                fetch_list=fetch_names)
+            except BaseException as e:  # propagate to the caller
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(thread_num)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    # reference API parity (PSLIB distributed hooks are Baidu-internal)
+    def config_distributed_nodes(self):
+        raise NotImplementedError(
+            "PSLIB downpour mode is replaced by mesh-sharded training; "
+            "use ParallelExecutor with a sharded embedding table"
+        )

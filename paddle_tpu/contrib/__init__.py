@@ -1,0 +1,5 @@
+"""Contrib (reference: python/paddle/fluid/contrib/): quantize transpiler,
+memory-usage estimate, beam-search decoder."""
+
+from . import quantize  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401

@@ -1,0 +1,30 @@
+"""Estimate training memory usage
+(reference: python/paddle/fluid/contrib/memory_usage_calc.py)."""
+
+from __future__ import annotations
+
+from ..core.framework import Program, default_main_program
+from ..core.proto import DataType
+
+__all__ = ["memory_usage"]
+
+_DTYPE_BYTES = {
+    DataType.FP64: 8, DataType.FP32: 4, DataType.FP16: 2, DataType.BF16: 2,
+    DataType.INT64: 8, DataType.INT32: 4, DataType.INT16: 2,
+    DataType.BOOL: 1, DataType.UINT8: 1, DataType.INT8: 1,
+}
+
+
+def memory_usage(program: Program = None, batch_size: int = 1):
+    """Rough lower bound: sum of var sizes with -1 dims filled by
+    batch_size.  Returns (min_bytes, max_bytes) like the reference's
+    heuristic band."""
+    program = program or default_main_program()
+    total = 0
+    for block_idx in range(program.desc.num_blocks()):
+        for vd in program.desc.block(block_idx).vars.values():
+            numel = 1
+            for d in vd.shape:
+                numel *= batch_size if d < 0 else max(int(d), 1)
+            total += numel * _DTYPE_BYTES.get(DataType(vd.dtype), 4)
+    return total, int(total * 1.5)

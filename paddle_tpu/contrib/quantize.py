@@ -1,0 +1,101 @@
+"""QuantizeTranspiler (reference:
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py).
+
+Rewrites a training program for quantization-aware training: inserts
+fake_quantize ops on the inputs/weights of mul / conv2d / depthwise_conv2d
+ops.  The straight-through estimator lives in the op lowerings
+(paddle_tpu/ops/quant_ops.py), so the rewritten program trains directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.framework import Program, default_main_program, unique_name
+from ..core.proto import OpDesc
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = {"mul", "matmul", "conv2d", "depthwise_conv2d"}
+
+
+class QuantizeTranspiler:
+    def __init__(
+        self,
+        weight_bits: int = 8,
+        activation_bits: int = 8,
+        activation_quantize_type: str = "abs_max",
+        weight_quantize_type: str = "abs_max",
+        window_size: int = 10000,
+    ):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        if activation_quantize_type not in ("abs_max", "range_abs_max"):
+            raise ValueError(
+                f"unknown activation_quantize_type {activation_quantize_type}"
+            )
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size
+
+    def training_transpile(
+        self,
+        program: Optional[Program] = None,
+        startup_program: Optional[Program] = None,
+    ) -> None:
+        """Insert fake-quant ops before every quantizable op's float inputs
+        (reference: quantize_transpiler.py training_transpile)."""
+        program = program or default_main_program()
+        block = program.global_block()
+        desc = block.desc
+        quantized: dict = {}
+
+        new_ops = []
+        for op in desc.ops:
+            if op.type in _QUANTIZABLE and not op.attr("__skip_quant__", False):
+                for slot in ("X", "Y", "Input", "Filter"):
+                    names = op.input(slot)
+                    if not names:
+                        continue
+                    n = names[0]
+                    if n.endswith("@GRAD"):
+                        continue
+                    if n not in quantized:
+                        qname = unique_name(n + ".quantized")
+                        sname = unique_name(n + ".scale")
+                        v = block._find_var_recursive(n)
+                        if v is None:
+                            continue
+                        block.create_var(
+                            name=qname, shape=list(v.shape), dtype=v.dtype
+                        )
+                        block.create_var(name=sname, shape=[1], dtype=v.dtype)
+                        is_weight = slot in ("Y", "Filter")
+                        qtype = (
+                            "fake_quantize_abs_max"
+                            if is_weight
+                            or self.activation_quantize_type == "abs_max"
+                            else "fake_quantize_range_abs_max"
+                        )
+                        q = OpDesc(
+                            type=qtype,
+                            inputs={"X": [n]},
+                            outputs={"Out": [qname], "OutScale": [sname]},
+                        )
+                        q.attrs["bit_length"] = (
+                            self.weight_bits if is_weight
+                            else self.activation_bits
+                        )
+                        if qtype == "fake_quantize_range_abs_max":
+                            q.attrs["window_size"] = self.window_size
+                        new_ops.append(q)
+                        quantized[n] = qname
+                    op.inputs[slot] = [quantized[n]] + list(names[1:])
+            new_ops.append(op)
+        desc.ops[:] = new_ops
+
+    def freeze_program(self, program: Optional[Program] = None, place=None,
+                       scope=None) -> None:
+        """reference: quantize_transpiler.py freeze_program — converts fake
+        quant to real int8 for deployment.  Under XLA the quantized graph
+        already runs fused; freezing is a no-op retained for API parity."""
+        return None

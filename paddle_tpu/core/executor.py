@@ -130,8 +130,11 @@ class _RunPlan:
 class Executor:
     """Serial single-device executor (reference: executor.py:256)."""
 
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None, donate_states: bool = True):
+        # donate_states=False keeps state buffers alive across concurrent
+        # runs sharing one scope (AsyncExecutor Hogwild threads)
         self.place = place if place is not None else CPUPlace()
+        self.donate_states = donate_states
         self._cache: Dict[Tuple, CompiledBlock] = {}
 
     def close(self) -> None:
@@ -188,7 +191,7 @@ class Executor:
                 plan.feed_names,
                 plan.fetch_names,
                 plan.state_names,
-                donate_states=True,
+                donate_states=self.donate_states,
             )
             entry = (compiled, plan)
             if use_program_cache:

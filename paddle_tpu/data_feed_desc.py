@@ -1,0 +1,87 @@
+"""DataFeedDesc (reference: python/paddle/fluid/data_feed_desc.py over
+paddle/fluid/framework/data_feed.proto:26).
+
+Parses the protobuf-text data-feed description used by AsyncExecutor's
+MultiSlot format.  Only the fields the MultiSlot feed consumes are
+understood (name, batch_size, multi_slot_desc.slots)."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List
+
+__all__ = ["DataFeedDesc", "SlotDesc"]
+
+
+@dataclass
+class SlotDesc:
+    name: str
+    type: str = "uint64"  # "uint64" (sparse ids) | "float"
+    is_dense: bool = False
+    is_used: bool = True
+
+
+@dataclass
+class DataFeedDesc:
+    """Construct from protobuf-text (reference: data_feed_desc.py parses with
+    google.protobuf.text_format)."""
+
+    proto_desc: str = ""
+    name: str = "MultiSlotDataFeed"
+    batch_size: int = 1
+    slots: List[SlotDesc] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.proto_desc:
+            self._parse(self.proto_desc)
+
+    def _parse(self, text: str) -> None:
+        m = re.search(r'name:\s*"([^"]+)"', text)
+        if m:
+            self.name = m.group(1)
+        m = re.search(r"batch_size:\s*(\d+)", text)
+        if m:
+            self.batch_size = int(m.group(1))
+        self.slots = []
+        for sm in re.finditer(r"slots?\s*\{([^}]*)\}", text):
+            body = sm.group(1)
+            nm = re.search(r'name:\s*"([^"]+)"', body)
+            tp = re.search(r'type:\s*"([^"]+)"', body)
+            dense = re.search(r"is_dense:\s*(true|false)", body)
+            used = re.search(r"is_used:\s*(true|false)", body)
+            self.slots.append(
+                SlotDesc(
+                    name=nm.group(1) if nm else "",
+                    type=tp.group(1) if tp else "uint64",
+                    is_dense=bool(dense and dense.group(1) == "true"),
+                    is_used=not used or used.group(1) == "true",
+                )
+            )
+
+    # reference API surface
+    def set_batch_size(self, batch_size: int) -> None:
+        self.batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name) -> None:
+        names = set(dense_slots_name)
+        for s in self.slots:
+            if s.name in names:
+                s.is_dense = True
+
+    def set_use_slots(self, use_slots_name) -> None:
+        names = set(use_slots_name)
+        for s in self.slots:
+            s.is_used = s.name in names
+
+    def desc(self) -> str:
+        lines = [f'name: "{self.name}"', f"batch_size: {self.batch_size}",
+                 "multi_slot_desc {"]
+        for s in self.slots:
+            lines.append(
+                f'  slots {{ name: "{s.name}" type: "{s.type}" '
+                f"is_dense: {str(s.is_dense).lower()} "
+                f"is_used: {str(s.is_used).lower()} }}"
+            )
+        lines.append("}")
+        return "\n".join(lines)

@@ -1,0 +1,145 @@
+"""Inference stack (reference: paddle/fluid/inference/ —
+NativePaddlePredictor api/api_impl.cc:131, AnalysisPredictor
+api/analysis_predictor.h:42, C API paddle_api.h).
+
+TPU-native design: a predictor owns a private Scope + the pruned inference
+Program and compiles it ONCE into an XLA executable (the role of the
+reference's Analyzer + IR fuse passes + TensorRT subgraphs is played
+entirely by XLA compilation).  The AnalysisPredictor/NativePredictor split
+collapses — `create_paddle_predictor` returns the same class with the
+config's switches recorded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.executor import Executor
+from ..core.framework import Program
+from ..core.place import CPUPlace, TPUPlace
+from ..core.scope import Scope
+
+__all__ = [
+    "NativeConfig",
+    "AnalysisConfig",
+    "PaddleTensor",
+    "create_paddle_predictor",
+    "PaddlePredictor",
+]
+
+
+@dataclasses.dataclass
+class NativeConfig:
+    """reference: paddle_api.h NativeConfig."""
+
+    model_dir: str = ""
+    prog_file: str = ""
+    param_file: str = ""
+    use_gpu: bool = False  # accepted for parity; device is TPU/CPU
+    device: int = 0
+    fraction_of_gpu_memory: float = -1.0
+
+
+@dataclasses.dataclass
+class AnalysisConfig(NativeConfig):
+    """reference: paddle_api.h AnalysisConfig.  The ir-pass/TensorRT knobs
+    are accepted and recorded; XLA owns all fusion."""
+
+    enable_ir_optim: bool = True
+    use_feed_fetch_ops: bool = False
+    specify_input_name: bool = True
+    _use_tensorrt: bool = False
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._use_tensorrt = True  # XLA compiles the whole graph anyway
+
+    def switch_ir_optim(self, flag: bool = True):
+        self.enable_ir_optim = flag
+
+    def disable_gpu(self):
+        self.use_gpu = False
+
+
+@dataclasses.dataclass
+class PaddleTensor:
+    """reference: paddle_api.h PaddleTensor :87."""
+
+    name: str = ""
+    data: Any = None
+    shape: Optional[List[int]] = None
+    lod: Optional[List[List[int]]] = None
+
+    @property
+    def dtype(self):
+        return np.asarray(self.data).dtype
+
+
+class PaddlePredictor:
+    """reference: api_impl.cc NativePaddlePredictor +
+    analysis_predictor.cc AnalysisPredictor (Run at :169)."""
+
+    def __init__(self, config: NativeConfig):
+        import jax
+
+        self.config = config
+        self.place = CPUPlace() if jax.default_backend() == "cpu" else TPUPlace()
+        self.scope = Scope()
+        self.executor = Executor(self.place, donate_states=False)
+        from .. import io as fluid_io
+
+        class _ScopedExe:
+            scope = self.scope
+
+        model_dir = config.model_dir
+        self.program, self.feed_names, self.fetch_targets = (
+            fluid_io.load_inference_model(
+                model_dir, _ScopedExe,
+                model_filename=config.prog_file or None,
+                params_filename=config.param_file or None,
+            )
+        )
+        self._fetch_names = [t.name for t in self.fetch_targets]
+
+    # -- reference PaddleTensor API ------------------------------------
+    def run(self, inputs: Sequence[PaddleTensor], batch_size: int = -1):
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name or self.feed_names[i]
+            data = np.asarray(t.data)
+            if t.shape:
+                data = data.reshape(t.shape)
+            feed[name] = data
+        outs = self.executor.run(
+            program=self.program, feed=feed, fetch_list=self._fetch_names,
+            scope=self.scope,
+        )
+        return [
+            PaddleTensor(name=n, data=np.asarray(v), shape=list(np.shape(v)))
+            for n, v in zip(self._fetch_names, outs)
+        ]
+
+    # -- ZeroCopy-style API (reference: analysis_predictor ZeroCopyTensor)
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def run_dict(self, feed: Dict[str, Any]) -> List[Any]:
+        return self.executor.run(
+            program=self.program, feed=feed, fetch_list=self._fetch_names,
+            scope=self.scope,
+        )
+
+    def clone(self) -> "PaddlePredictor":
+        """reference: PaddlePredictor::Clone — shares nothing mutable; the
+        XLA executable cache is per-Executor."""
+        return create_paddle_predictor(self.config)
+
+
+def create_paddle_predictor(config: NativeConfig) -> PaddlePredictor:
+    """reference: CreatePaddlePredictor<ConfigT> (analysis_predictor.cc:552)."""
+    return PaddlePredictor(config)

@@ -205,30 +205,60 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              mining_type="max_negative", normalize=True,
              sample_size=None):
     """SSD matching + localisation/confidence loss
-    (reference: layers/detection.py ssd_loss).  Matching and target assembly
-    ride the ops above; hard-negative mining keeps the top-k negatives by
-    confidence loss (static k = neg_pos_ratio * P)."""
+    (reference: layers/detection.py ssd_loss): per-prediction matching,
+    box_coder-encoded localisation targets, and max_negative hard mining
+    keeping neg_pos_ratio * num_pos negatives by confidence loss."""
+    if mining_type != "max_negative":
+        raise ValueError("only mining_type='max_negative' is supported")
     iou = iou_similarity(gt_box, prior_box)
     matched_indices, matched_dist = bipartite_match(
         iou, match_type, overlap_threshold
     )
-    loc_targets, loc_w = target_assign(gt_box, matched_indices)
+    # per-prior matched gt boxes, encoded as regression offsets (axis=1:
+    # row-aligned against each prior)
+    matched_boxes, loc_w = target_assign(gt_box, matched_indices)
+    loc_targets = box_coder(
+        prior_box, prior_box_var, tensor.cast(matched_boxes, location.dtype),
+        code_type="encode_center_size", axis=1,
+    )
     lbl_targets, cls_w = target_assign(gt_label, matched_indices,
                                        mismatch_value=background_label)
-    # localisation smooth-l1 on positives
-    loc_diff = nn.smooth_l1(location, tensor.cast(loc_targets, location.dtype))
-    from . import mean as _mean
 
-    loc_loss = _mean(nn.elementwise_mul(loc_diff, loc_w))
-    conf_loss = _mean(
-        nn.softmax_with_cross_entropy(
-            confidence, tensor.cast(lbl_targets, "int64")
+    conf_loss_all = nn.softmax_with_cross_entropy(
+        confidence, tensor.cast(lbl_targets, "int64")
+    )  # [N, P, 1]
+    helper = LayerHelper("mine_hard_examples")
+    neg_mask = helper.create_variable_for_type_inference("float32")
+    updated = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": [conf_loss_all], "MatchIndices": [matched_indices]},
+        outputs={"NegMask": [neg_mask], "UpdatedMatchIndices": [updated]},
+        attrs={
+            "neg_pos_ratio": float(neg_pos_ratio),
+            "neg_dist_threshold": float(neg_overlap),
+            "mining_type": mining_type,
+            "sample_size": int(sample_size) if sample_size else 0,
+        },
+    )
+
+    loc_loss = tensor.reduce_sum(
+        nn.smooth_l1(
+            location, loc_targets, inside_weight=loc_w, outside_weight=None
         )
     )
-    return nn.elementwise_add(
+    conf_w = nn.elementwise_add(cls_w, neg_mask)
+    conf_loss = tensor.reduce_sum(nn.elementwise_mul(conf_loss_all, conf_w))
+    total = nn.elementwise_add(
         tensor.scale(loc_loss, scale=loc_loss_weight),
         tensor.scale(conf_loss, scale=conf_loss_weight),
     )
+    if normalize:
+        num_pos = tensor.reduce_sum(loc_w)
+        total = nn.elementwise_div(
+            total, tensor.scale(num_pos, scale=1.0, bias=1e-6)
+        )
+    return total
 
 
 def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0):

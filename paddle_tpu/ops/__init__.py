@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     metric_ops,
     nn_ops,
     optimizer_ops,
+    quant_ops,
     reduce_ops,
     rnn_ops,
     sequence_ops,

@@ -223,6 +223,7 @@ def _box_coder(ctx, ins, attrs):
     target = data(ins["TargetBox"][0])
     code_type = attrs.get("code_type", "encode_center_size")
     normalized = attrs.get("box_normalized", True)
+    axis = int(attrs.get("axis", 0))
     off = 0.0 if normalized else 1.0
 
     pw = prior[:, 2] - prior[:, 0] + off
@@ -231,6 +232,19 @@ def _box_coder(ctx, ins, attrs):
     pcy = prior[:, 1] + ph / 2.0
     if pv is None:
         pv = jnp.ones((prior.shape[0], 4), dtype=target.dtype)
+
+    if code_type.lower().startswith("encode") and axis == 1:
+        # row-aligned encode (reference axis=1): target[..., p, 4] pairs
+        # elementwise with prior p — SSD per-prior matched-gt targets
+        tw = target[..., 2] - target[..., 0] + off
+        th = target[..., 3] - target[..., 1] + off
+        tcx = target[..., 0] + tw / 2.0
+        tcy = target[..., 1] + th / 2.0
+        ox = (tcx - pcx) / pw / pv[..., 0]
+        oy = (tcy - pcy) / ph / pv[..., 1]
+        ow = jnp.log(jnp.maximum(tw / pw, 1e-10)) / pv[..., 2]
+        oh = jnp.log(jnp.maximum(th / ph, 1e-10)) / pv[..., 3]
+        return {"OutputBox": [jnp.stack([ox, oy, ow, oh], axis=-1)]}
 
     if code_type.lower().startswith("encode"):
         # target [T, 4] against every prior -> [T, P, 4]
@@ -257,6 +271,51 @@ def _box_coder(ctx, ins, attrs):
         if target.ndim == 2:
             out = out[0]
     return {"OutputBox": [out]}
+
+
+def _mine_hard_infer(op, block):
+    x = in_desc(op, block, "ClsLoss")
+    if x is None:
+        return
+    set_output(block, op, "NegMask", list(x.shape), DataType.FP32)
+    set_output(block, op, "UpdatedMatchIndices", list(x.shape), DataType.INT32)
+
+
+@register_op("mine_hard_examples", infer_shape=_mine_hard_infer, no_grad=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """Hard-negative mining (reference: detection/mine_hard_examples_op.cc,
+    max_negative mode): per image keep the neg_pos_ratio * num_pos
+    highest-loss negatives.  The reference returns NegIndices (variable
+    size); the static-shape output is a [N, P] 0/1 mask."""
+    cls_loss = data(ins["ClsLoss"][0])
+    if cls_loss.ndim == 3:
+        cls_loss = cls_loss[..., 0]
+    match = data(ins["MatchIndices"][0]).astype(jnp.int32)  # [N, P]
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    sample_size = int(attrs.get("sample_size", 0))
+    N, P = cls_loss.shape
+
+    is_neg = match < 0
+    num_pos = jnp.sum(~is_neg, axis=1)  # [N]
+    k = jnp.minimum(
+        (neg_pos_ratio * num_pos).astype(jnp.int32)
+        if sample_size <= 0
+        else jnp.full_like(num_pos, sample_size),
+        P,
+    )
+    neg_loss = jnp.where(is_neg, cls_loss, -jnp.inf)
+    sorted_desc = -jnp.sort(-neg_loss, axis=1)  # [N, P] descending
+    # threshold = loss of the k-th hardest negative (k>=1), else +inf
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.maximum(k - 1, 0)[:, None], axis=1
+    )[:, 0]
+    thresh = jnp.where(k > 0, kth, jnp.inf)
+    neg_mask = (is_neg & (neg_loss >= thresh[:, None])).astype(jnp.float32)
+    return {
+        # [N, P, 1] to align with target_assign's OutWeight
+        "NegMask": [neg_mask[..., None]],
+        "UpdatedMatchIndices": [jnp.where(neg_mask > 0, -1, match)],
+    }
 
 
 # ---------------------------------------------------------------------------

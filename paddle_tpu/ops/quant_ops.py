@@ -1,0 +1,89 @@
+"""Fake-quantization ops (reference: operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_dequantize_max_abs).
+
+Quantize-aware training: values round to int levels in the forward pass;
+the straight-through estimator (identity gradient) comes from expressing
+the rounding as x + stop_gradient(round(x*s)/s - x), which jax.vjp
+differentiates as identity — no custom grad kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, same_shape, set_output
+
+
+def _ste_quant(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / s * bin_cnt)
+    q = jnp.clip(q, -bin_cnt, bin_cnt) * s / bin_cnt
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _fq_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype)
+    set_output(block, op, "OutScale", [1], x.dtype)
+
+
+@register_op("fake_quantize_abs_max", infer_shape=_fq_infer, diff_inputs=["X"])
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    bit_length = int(attrs.get("bit_length", 8))
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    scale = jnp.max(jnp.abs(x))
+    return {
+        "Out": [_ste_quant(x, scale, bin_cnt)],
+        "OutScale": [scale.reshape(1)],
+    }
+
+
+def _fqr_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype)
+    set_output(block, op, "OutScale", [1], x.dtype)
+    names = op.output("OutScales")
+    if names and names[0]:
+        set_output(block, op, "OutScales", [op.attr("window_size", 10000)],
+                   x.dtype)
+
+
+@register_op("fake_quantize_range_abs_max", infer_shape=_fqr_infer,
+             diff_inputs=["X"], stateful=True)
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Running-window max scale (reference keeps a scale window; here an
+    exponential-moving max over the InScale state gives the same
+    training-time smoothing with O(1) state)."""
+    x = data(ins["X"][0])
+    bit_length = int(attrs.get("bit_length", 8))
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    cur = jnp.max(jnp.abs(x))
+    prev = ins.get("InScale", [None])[0]
+    if prev is not None and not attrs.get("is_test", False):
+        scale = jnp.maximum(0.9 * data(prev).reshape(()), cur)
+    elif prev is not None:
+        scale = data(prev).reshape(())
+    else:
+        scale = cur
+    return {
+        "Out": [_ste_quant(x, scale, bin_cnt)],
+        "OutScale": [scale.reshape(1)],
+    }
+
+
+@register_op("fake_dequantize_max_abs", infer_shape=same_shape(),
+             diff_inputs=["X"])
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    scale = data(ins["Scale"][0]).reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x * scale / max_range]}

