@@ -40,6 +40,7 @@ def main() -> None:
     elif model == "transformer":
         cfg = models.TransformerConfig(
             src_vocab_size=32000, trg_vocab_size=32000, max_length=256,
+            use_flash_attention=os.environ.get("BENCH_FLASH", "1") != "0",
         )
         spec = models.transformer(cfg)
         unit = "tokens/sec"

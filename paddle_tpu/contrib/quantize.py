@@ -61,14 +61,12 @@ class QuantizeTranspiler:
                         continue
                     if n not in quantized:
                         qname = unique_name(n + ".quantized")
-                        sname = unique_name(n + ".scale")
                         v = block._find_var_recursive(n)
                         if v is None:
                             continue
                         block.create_var(
                             name=qname, shape=list(v.shape), dtype=v.dtype
                         )
-                        block.create_var(name=sname, shape=[1], dtype=v.dtype)
                         is_weight = slot in ("Y", "Filter")
                         qtype = (
                             "fake_quantize_abs_max"
@@ -79,19 +77,48 @@ class QuantizeTranspiler:
                         q = OpDesc(
                             type=qtype,
                             inputs={"X": [n]},
-                            outputs={"Out": [qname], "OutScale": [sname]},
+                            outputs={"Out": [qname]},
                         )
+                        if qtype == "fake_quantize_range_abs_max":
+                            # running-max state: a persistable scale var fed
+                            # back through InScale each step (the reference's
+                            # scale window, O(1)-state form)
+                            sname = unique_name(n + ".scale")
+                            block.create_var(
+                                name=sname, shape=[1], dtype=v.dtype,
+                                persistable=True,
+                            )
+                            self._init_scale_var(startup_program, sname)
+                            q.inputs["InScale"] = [sname]
+                            q.outputs["OutScale"] = [sname]
+                            q.attrs["window_size"] = self.window_size
+                        else:
+                            sname = unique_name(n + ".scale")
+                            block.create_var(name=sname, shape=[1], dtype=v.dtype)
+                            q.outputs["OutScale"] = [sname]
                         q.attrs["bit_length"] = (
                             self.weight_bits if is_weight
                             else self.activation_bits
                         )
-                        if qtype == "fake_quantize_range_abs_max":
-                            q.attrs["window_size"] = self.window_size
                         new_ops.append(q)
                         quantized[n] = qname
                     op.inputs[slot] = [quantized[n]] + list(names[1:])
             new_ops.append(op)
         desc.ops[:] = new_ops
+
+    @staticmethod
+    def _init_scale_var(startup_program: Optional[Program], name: str) -> None:
+        from ..core.framework import default_startup_program
+
+        startup = startup_program or default_startup_program()
+        sb = startup.global_block()
+        sv = sb.create_var(name=name, shape=[1], dtype="float32",
+                           persistable=True)
+        sb.append_op(
+            type="fill_constant", inputs={}, outputs={"Out": [sv]},
+            attrs={"shape": [1], "dtype": 5, "value": 0.0,
+                   "force_cpu": False},
+        )
 
     def freeze_program(self, program: Optional[Program] = None, place=None,
                        scope=None) -> None:

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for ops where plain XLA fusion leaves performance on
+the table.  Each kernel ships with a pure-jax fallback (used automatically
+off-TPU and under grad recompute), so the op surface is portable."""
+
+from .flash_attention import flash_attention  # noqa: F401

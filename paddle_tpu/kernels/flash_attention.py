@@ -1,0 +1,201 @@
+"""Flash attention for TPU (Pallas).
+
+The reference computes attention as separate matmul/softmax/matmul ops
+(python/paddle/fluid/nets.py scaled_dot_product_attention), materializing
+the [Sq, Sk] score matrix in HBM.  This kernel streams K/V blocks through
+VMEM with the online-softmax recurrence (Dao et al., FlashAttention), so
+HBM traffic stays O(S*D) and the MXU sees back-to-back block matmuls.
+
+Forward runs the Pallas kernel on TPU (pure-jax fallback elsewhere);
+backward recomputes attention with jax ops under the standard
+custom-vjp-with-recompute pattern — XLA's fusion is strong on the backward
+graph, and recompute keeps memory at flash levels.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, causal, scale, bias=None, k_lengths=None):
+    """Pure-jax attention (fallback + backward recompute).
+    q: [B, H, Sq, D], k/v: [B, H, Sk, D], k_lengths: [B] valid key counts."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias
+    if k_lengths is not None:
+        kmask = jnp.arange(scores.shape[-1])[None, :] < k_lengths[:, None]
+        scores = jnp.where(kmask[:, None, None, :], scores, NEG_INF)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (padded queries) produce zeros, not uniform weights
+    all_masked = jnp.max(scores, axis=-1, keepdims=True) <= NEG_INF / 2
+    weights = jnp.where(all_masked, 0.0, weights)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, causal, scale, block_q, block_k, seq_k, causal_offset):
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks); K innermost so the
+    online-softmax state lives in VMEM scratch across K steps.  klen_ref
+    (SMEM) holds this batch row's valid key count (key-padding mask)."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # [block_q, D]
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = k_pos < jnp.minimum(seq_k, klen_ref[0].astype(jnp.int32))
+    if causal:
+        # bottom-right alignment (matches jnp.tril(k=Sk-Sq)): with cached
+        # keys (Sk > Sq) a query at row i sees keys up to i + Sk - Sq
+        mask &= k_pos <= q_pos + causal_offset
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:]  # [block_q, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_new = correction * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * correction + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
+                  interpret=False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    # pad sequence dims to block multiples (masked in-kernel)
+    pq = (bq - Sq % bq) % bq
+    pk = (bk - Sk % bk) % bk
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    qf = q.reshape(B * H, q.shape[2], D)
+    kf = k.reshape(B * H, k.shape[2], D)
+    vf = v.reshape(B * H, v.shape[2], D)
+    klen_bh = jnp.repeat(klen, H)  # [B*H] valid key counts
+    grid = (B * H, qf.shape[1] // bq, kf.shape[1] // bk)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, causal=causal, scale=scale, block_q=bq,
+            block_k=bk, seq_k=Sk, causal_offset=Sk - Sq,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(klen_bh, qf, kf, vf)
+    out = out.reshape(B, H, out.shape[1], D)
+    if pq:
+        out = out[:, :, :Sq]
+    return out
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, klen, causal, scale, force):
+    # klen rides as float32 so custom_vjp treats it uniformly (zero grad)
+    if force == "pallas" or (force == "auto" and _on_tpu()):
+        return _pallas_flash(q, k, v, klen, causal, scale)
+    if force == "interpret":
+        return _pallas_flash(q, k, v, klen, causal, scale, interpret=True)
+    return _reference_attention(
+        q, k, v, causal, scale, k_lengths=klen.astype(jnp.int32)
+    )
+
+
+def _flash_fwd(q, k, v, klen, causal, scale, force):
+    return _flash(q, k, v, klen, causal, scale, force), (q, k, v, klen)
+
+
+def _flash_bwd(causal, scale, force, res, g):
+    q, k, v, klen = res
+    # recompute-backward: differentiate the reference formulation
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(
+            q_, k_, v_, causal, scale, k_lengths=klen.astype(jnp.int32)
+        ),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(klen)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, k_lengths=None,
+                    force="auto"):
+    """q/k/v: [B, H, S, D].  k_lengths: optional [B] valid key counts
+    (key-padding mask).
+
+    force: "auto" (pallas on TPU, jax elsewhere), "pallas", "interpret"
+    (pallas interpreter — CPU testing), or "jax"."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if k_lengths is None:
+        klen = jnp.full((q.shape[0],), k.shape[2], dtype=jnp.float32)
+    else:
+        klen = jnp.asarray(k_lengths, dtype=jnp.float32).reshape(-1)
+    return _flash(q, k, v, klen, causal, float(scale), force)

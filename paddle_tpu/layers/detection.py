@@ -232,7 +232,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     updated = helper.create_variable_for_type_inference("int32")
     helper.append_op(
         type="mine_hard_examples",
-        inputs={"ClsLoss": [conf_loss_all], "MatchIndices": [matched_indices]},
+        inputs={"ClsLoss": [conf_loss_all], "MatchIndices": [matched_indices],
+                "MatchDist": [matched_dist]},
         outputs={"NegMask": [neg_mask], "UpdatedMatchIndices": [updated]},
         attrs={
             "neg_pos_ratio": float(neg_pos_ratio),

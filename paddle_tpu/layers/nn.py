@@ -23,6 +23,7 @@ __all__ = [
     "ctc_greedy_decoder",
     "beam_search",
     "beam_search_decode",
+    "fused_attention",
     "conv2d",
     "conv3d",
     "conv2d_transpose",
@@ -1084,3 +1085,20 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None,
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return sentence_ids, sentence_scores
+
+
+def fused_attention(q, k, v, causal=False, scale=None, k_lengths=None,
+                    name=None):
+    """Flash-attention in one op: q/k/v [B, H, S, D], optional [B] valid key
+    counts instead of an additive bias (TPU-native; see
+    paddle_tpu/kernels/flash_attention.py)."""
+    helper = LayerHelper("fused_attention", input=q, name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if k_lengths is not None:
+        inputs["KLengths"] = [k_lengths]
+    helper.append_op(
+        type="fused_attention", inputs=inputs, outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": float(scale) if scale else 0.0},
+    )
+    return out

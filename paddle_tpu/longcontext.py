@@ -1,0 +1,105 @@
+"""Long-context sequence/context parallelism: ring attention over a mesh
+axis.
+
+The reference (2018-era) bounds sequence length by one device's memory
+(SURVEY.md section 5 "long-context: absent").  This module exceeds reference
+capability: sequences shard over the mesh's `sp` axis, each device holds
+S/P tokens, and attention runs as a P-step ring — queries stay put while
+K/V blocks rotate via lax.ppermute over ICI, merged with the online-softmax
+recurrence (Liu et al., Ring Attention; blockwise formulation as in the
+scaling-book collective-matmul recipe).  Peak memory per chip is
+O(S/P * D), and the K/V transfer overlaps the current block's compute under
+XLA's async collectives.
+
+Use inside shard_map (sequence_parallel_attention wraps this), composing
+with data parallelism on other mesh axes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "sequence_parallel_attention"]
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention over a sequence sharded on `axis_name`.
+
+    q/k/v: LOCAL shards [B, H, S_local, D]; must be called under shard_map
+    (or pmap) with `axis_name` bound.  Returns the local output shard.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    p = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+
+    q_pos = my * S + jnp.arange(S)  # global positions of local queries
+
+    def step(carry, i):
+        acc, m, l, k_cur, v_cur = carry
+        # k_cur currently holds the shard that started on device (my - i)
+        src = (my - i) % p
+        k_pos = src * S + jnp.arange(S)
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        mask = jnp.ones((S, S), dtype=bool)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # guard all-masked rows (fully-future blocks under causal)
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        pexp = jnp.exp(s - m_safe)
+        pexp = jnp.where(mask, pexp, 0.0)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+        l_new = corr * l + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", pexp.astype(v_cur.dtype), v_cur
+        )
+        # rotate K/V shards around the ring (overlaps with next compute)
+        perm = [(j, (j + 1) % p) for j in range(p)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc_new, m_new, l_new, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    m0 = jnp.full((B, H, S, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), dtype=jnp.float32)
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(p)
+    )
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
+                                causal: bool = False,
+                                scale: Optional[float] = None,
+                                batch_axis: Optional[str] = "dp"):
+    """Global-view wrapper: q/k/v [B, H, S, D] with S sharded on `axis`
+    (and optionally B on `batch_axis`); runs ring_attention via shard_map."""
+    from jax import shard_map
+
+    jmesh = getattr(mesh, "mesh", mesh)  # DeviceMesh or raw jax Mesh
+    axis_names = jmesh.axis_names
+    b = batch_axis if batch_axis in axis_names else None
+    spec = P(b, None, axis, None)
+
+    fn = functools.partial(
+        ring_attention, axis_name=axis, causal=causal, scale=scale
+    )
+    return shard_map(
+        fn, mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

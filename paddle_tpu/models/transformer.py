@@ -47,6 +47,9 @@ class TransformerConfig:
     # parallelism: mesh axes the weights/activations are annotated for
     tp_axis: str = "tp"
     shard_weights: bool = True
+    # fuse attention into one flash-kernel op (pallas on TPU); key padding
+    # rides as lengths, no [Sq, Sk] bias tensor is materialized
+    use_flash_attention: bool = False
 
 
 def _sinusoid_table(max_len: int, d_model: int) -> np.ndarray:
@@ -80,9 +83,12 @@ class _Builder:
             out = layers.relu(out)
         return out
 
-    def mha(self, q_in, kv_in, bias, name):
+    def mha(self, q_in, kv_in, bias, name, k_lengths=None, causal=False):
         """Multi-head attention.  q_in/kv_in: [B, S, D]; bias: additive
-        attention bias broadcastable to [B, H, Sq, Sk]."""
+        attention bias broadcastable to [B, H, Sq, Sk].  With
+        cfg.use_flash_attention and k_lengths given, the bias tensor is
+        bypassed: one fused_attention op (pallas flash kernel) gets the
+        causal flag + per-row key counts instead."""
         cfg = self.cfg
         d, h = cfg.d_model, cfg.n_head
         dh = d // h
@@ -97,13 +103,23 @@ class _Builder:
             return layers.transpose(x, perm=[0, 2, 1, 3])  # [B, H, S, dh]
 
         q, k, v = split_heads(q), split_heads(k), split_heads(v)
-        q = layers.scale(q, scale=dh ** -0.5)
-        scores = layers.matmul(q, k, transpose_y=True)  # [B, H, Sq, Sk]
-        scores = layers.elementwise_add(scores, bias)
-        weights = layers.softmax(scores)
-        if cfg.dropout:
-            weights = layers.dropout(weights, dropout_prob=cfg.dropout)
-        ctx = layers.matmul(weights, v)  # [B, H, Sq, dh]
+        if cfg.use_flash_attention and k_lengths is not None:
+            ctx = layers.fused_attention(
+                q, k, v, causal=causal, k_lengths=k_lengths
+            )
+            if cfg.dropout:
+                # the flash kernel does not expose attention weights, so
+                # regularization moves to the attention output (the common
+                # flash-attention approximation of weight dropout)
+                ctx = layers.dropout(ctx, dropout_prob=cfg.dropout)
+        else:
+            q = layers.scale(q, scale=dh ** -0.5)
+            scores = layers.matmul(q, k, transpose_y=True)  # [B, H, Sq, Sk]
+            scores = layers.elementwise_add(scores, bias)
+            weights = layers.softmax(scores)
+            if cfg.dropout:
+                weights = layers.dropout(weights, dropout_prob=cfg.dropout)
+            ctx = layers.matmul(weights, v)  # [B, H, Sq, dh]
         ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
         ctx = layers.reshape(ctx, shape=[0, 0, d])
         return self.linear(ctx, d, d, f"{name}_o", shard=[tp, None])
@@ -165,6 +181,15 @@ class _Builder:
         bias = layers.scale(is_pad, scale=-1e9)
         return layers.unsqueeze(layers.unsqueeze(bias, axes=[1]), axes=[1])
 
+    def seq_lengths(self, words):
+        """[B] count of non-pad tokens (key-padding lengths for flash)."""
+        pad = layers.fill_constant_batch_size_like(
+            words, shape=[-1, words.shape[1]], dtype="int64",
+            value=self.cfg.pad_idx,
+        )
+        not_pad = layers.cast(layers.not_equal(words, pad), "int32")
+        return layers.reduce_sum(not_pad, dim=1)
+
     def causal_bias(self, seq_len):
         """[1, 1, S, S] additive bias: -1e9 above the diagonal."""
         r = layers.range(0, seq_len, 1, "float32")
@@ -192,15 +217,19 @@ def transformer(
 
     b = _Builder(cfg)
 
-    src_bias = b.pad_bias(src_word)                       # enc self-attn
-    trg_bias = layers.elementwise_add(                    # dec self-attn
+    flash = cfg.use_flash_attention
+    src_bias = None if flash else b.pad_bias(src_word)    # enc self-attn
+    trg_bias = None if flash else layers.elementwise_add(  # dec self-attn
         b.pad_bias(trg_word), b.causal_bias(S)
     )
+    src_len = b.seq_lengths(src_word) if flash else None
+    trg_len = b.seq_lengths(trg_word) if flash else None
 
     # encoder
     enc = b.embed(src_word, cfg.src_vocab_size, "src")
     for i in range(cfg.n_layer):
-        attn = b.mha(enc, enc, src_bias, f"enc_l{i}_attn")
+        attn = b.mha(enc, enc, src_bias, f"enc_l{i}_attn",
+                     k_lengths=src_len)
         enc = b.sublayer(enc, attn, f"enc_l{i}_attn")
         ff = b.ffn(enc, f"enc_l{i}_ffn")
         enc = b.sublayer(enc, ff, f"enc_l{i}_ffn")
@@ -208,9 +237,11 @@ def transformer(
     # decoder
     dec = b.embed(trg_word, cfg.trg_vocab_size, "trg")
     for i in range(cfg.n_layer):
-        self_attn = b.mha(dec, dec, trg_bias, f"dec_l{i}_self")
+        self_attn = b.mha(dec, dec, trg_bias, f"dec_l{i}_self",
+                          k_lengths=trg_len, causal=True)
         dec = b.sublayer(dec, self_attn, f"dec_l{i}_self")
-        cross = b.mha(dec, enc, src_bias, f"dec_l{i}_cross")
+        cross = b.mha(dec, enc, src_bias, f"dec_l{i}_cross",
+                      k_lengths=src_len)
         dec = b.sublayer(dec, cross, f"dec_l{i}_cross")
         ff = b.ffn(dec, f"dec_l{i}_ffn")
         dec = b.sublayer(dec, ff, f"dec_l{i}_ffn")

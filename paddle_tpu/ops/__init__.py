@@ -7,6 +7,7 @@ JAX lowering rules consumed by paddle_tpu.core.compiler.
 
 from . import (  # noqa: F401
     activation_ops,
+    attention_ops,
     beam_search_ops,
     compare_ops,
     control_flow_ops,

@@ -1,0 +1,43 @@
+"""Fused attention op backed by the Pallas flash kernel.
+
+TPU-native addition (the reference composes attention from matmul/softmax
+ops, python/paddle/fluid/nets.py scaled_dot_product_attention).  One op =
+one flash kernel on TPU; key-padding comes in as lengths instead of an
+additive [Sq, Sk] bias tensor, so nothing score-shaped ever hits HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import data, in_desc, set_output
+
+
+def _fused_attn_infer(op, block):
+    q = in_desc(op, block, "Q")
+    if q is None:
+        return
+    set_output(block, op, "Out", list(q.shape), q.dtype)
+
+
+@register_op("fused_attention", infer_shape=_fused_attn_infer,
+             diff_inputs=["Q", "K", "V"])
+def _fused_attention(ctx, ins, attrs):
+    from ..kernels import flash_attention
+
+    q = data(ins["Q"][0])  # [B, H, Sq, D]
+    k = data(ins["K"][0])
+    v = data(ins["V"][0])
+    klen_in = ins.get("KLengths", [None])[0]
+    klen = data(klen_in).reshape(-1) if klen_in is not None else None
+    return {
+        "Out": [
+            flash_attention(
+                q, k, v,
+                causal=bool(attrs.get("causal", False)),
+                scale=attrs.get("scale") or None,
+                k_lengths=klen,
+            )
+        ]
+    }

@@ -292,11 +292,16 @@ def _mine_hard_examples(ctx, ins, attrs):
         cls_loss = cls_loss[..., 0]
     match = data(ins["MatchIndices"][0]).astype(jnp.int32)  # [N, P]
     neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(attrs.get("neg_dist_threshold", 0.5))
     sample_size = int(attrs.get("sample_size", 0))
     N, P = cls_loss.shape
 
     is_neg = match < 0
-    num_pos = jnp.sum(~is_neg, axis=1)  # [N]
+    dist_in = ins.get("MatchDist", [None])[0]
+    if dist_in is not None:
+        # near-positives (high IoU with some gt) are not negative candidates
+        is_neg &= data(dist_in) < neg_dist_threshold
+    num_pos = jnp.sum(match >= 0, axis=1)  # [N]
     k = jnp.minimum(
         (neg_pos_ratio * num_pos).astype(jnp.int32)
         if sample_size <= 0
