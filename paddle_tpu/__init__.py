@@ -43,8 +43,11 @@ from .core.backward import append_backward, calc_gradient  # noqa: F401
 from .core import proto as core  # noqa: F401  (fluid.core-ish alias)
 
 from . import average  # noqa: F401
+from . import debugger  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import clip  # noqa: F401
 from . import contrib  # noqa: F401
+from . import imperative  # noqa: F401
 from . import inference  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401

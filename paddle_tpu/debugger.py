@@ -1,0 +1,60 @@
+"""Program debugging/printing utilities
+(reference: python/paddle/fluid/debugger.py — draw_block_graphviz,
+repr_program in text form)."""
+
+from __future__ import annotations
+
+from .core.framework import Program
+
+__all__ = ["pprint_program_codes", "pprint_block_codes", "draw_block_graphviz"]
+
+
+def pprint_block_codes(block_desc, show_backward=False) -> str:
+    """Text rendering of one block's ops and vars
+    (reference: debugger.py pprint_block_codes)."""
+    lines = [f"block {block_desc.idx} (parent {block_desc.parent_idx}):"]
+    for name, vd in sorted(block_desc.vars.items()):
+        if not show_backward and "@GRAD" in name:
+            continue
+        lines.append(
+            f"  var {name}: shape={list(vd.shape)} dtype={vd.dtype!s} "
+            f"persistable={vd.persistable}"
+        )
+    for op in block_desc.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        ins = ", ".join(
+            f"{k}={v}" for k, v in sorted(op.inputs.items()) if v
+        )
+        outs = ", ".join(
+            f"{k}={v}" for k, v in sorted(op.outputs.items()) if v
+        )
+        lines.append(f"  {op.type}({ins}) -> {outs}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program, show_backward=False) -> str:
+    return "\n".join(
+        pprint_block_codes(program.desc.block(i), show_backward)
+        for i in range(program.desc.num_blocks())
+    )
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
+    """Emit a graphviz dot file of the op/var graph
+    (reference: debugger.py draw_block_graphviz)."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=LR;"]
+    desc = getattr(block, "desc", block)
+    for i, op in enumerate(desc.ops):
+        color = ' style=filled fillcolor="lightblue"' if op.type in highlights else ""
+        lines.append(f'  op{i} [label="{op.type}" shape=box{color}];')
+        for n in op.input_arg_names():
+            lines.append(f'  "{n}" -> op{i};')
+        for n in op.output_arg_names():
+            lines.append(f'  op{i} -> "{n}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

@@ -1,0 +1,109 @@
+"""Evaluator classes (reference: python/paddle/fluid/evaluator.py —
+deprecated in the reference in favor of fluid.metrics, kept for API parity).
+
+Each evaluator owns in-graph state vars updated per batch plus an eval()
+that reads them back from the scope."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .core.framework import default_main_program, unique_name
+from .core.scope import global_scope
+from .initializer import ConstantInitializer
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    """reference: evaluator.py Evaluator."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper_name = unique_name(name)
+
+    def reset(self, executor, reset_program=None):
+        scope = getattr(executor, "scope", None) or global_scope()
+        for var in self.states:
+            v = scope.find_var(var.name)
+            if v is not None:
+                scope.set_var(var.name, np.zeros_like(np.asarray(v)))
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def _create_state(self, suffix, dtype, shape):
+        from .core.framework import default_startup_program
+
+        name = unique_name(f"{self.helper_name}_{suffix}")
+        main = default_main_program().global_block()
+        state = main.create_var(
+            name=name, shape=list(shape), dtype=dtype, persistable=True
+        )
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(
+            name=name, shape=list(shape), dtype=dtype, persistable=True
+        )
+        ConstantInitializer(0.0)(sv, startup)
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulating chunk F1 (reference: evaluator.py ChunkEvaluator)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        (precision, recall, f1, num_infer, num_label, num_correct) = (
+            layers.chunk_eval(
+                input=input, label=label, chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types,
+            )
+        )
+        self.num_infer_chunks = self._create_state("num_infer", "int64", [1])
+        self.num_label_chunks = self._create_state("num_label", "int64", [1])
+        self.num_correct_chunks = self._create_state("num_correct", "int64", [1])
+        layers.sums([self.num_infer_chunks, num_infer],
+                    out=self.num_infer_chunks)
+        layers.sums([self.num_label_chunks, num_label],
+                    out=self.num_label_chunks)
+        layers.sums([self.num_correct_chunks, num_correct],
+                    out=self.num_correct_chunks)
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        scope = getattr(executor, "scope", None) or global_scope()
+        ni = float(np.ravel(np.asarray(scope.find_var(self.num_infer_chunks.name)))[0])
+        nl = float(np.ravel(np.asarray(scope.find_var(self.num_label_chunks.name)))[0])
+        nc = float(np.ravel(np.asarray(scope.find_var(self.num_correct_chunks.name)))[0])
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+        return np.array(precision), np.array(recall), np.array(f1)
+
+
+class EditDistance(Evaluator):
+    """Accumulating edit distance (reference: evaluator.py EditDistance)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens
+        )
+        self.total_distance = self._create_state("total", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        batch_total = layers.reduce_sum(distances)
+        layers.sums([self.total_distance, batch_total],
+                    out=self.total_distance)
+        layers.sums([self.seq_num, seq_num], out=self.seq_num)
+        self.metrics = [distances]
+
+    def eval(self, executor, eval_program=None):
+        scope = getattr(executor, "scope", None) or global_scope()
+        total = float(np.ravel(np.asarray(scope.find_var(self.total_distance.name)))[0])
+        n = float(np.ravel(np.asarray(scope.find_var(self.seq_num.name)))[0])
+        return np.array(total / n if n else 0.0)

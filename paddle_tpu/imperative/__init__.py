@@ -1,0 +1,182 @@
+"""Imperative (early-dygraph) mode
+(reference: python/paddle/fluid/imperative/ — base.py guard/to_variable,
+layers.py PyLayer; C++ tracer paddle/fluid/imperative/tracer.h:53).
+
+The reference traces ops eagerly into per-op grad chains (OpBase/VarBase
+with a runtime autograd tape).  JAX *is* an eager tensor library with
+autodiff, so the TPU-native shim is thin: VarBase wraps a jax array and a
+backward tape built from jax.vjp closures; PyLayer.forward runs jnp ops
+directly.  `guard()` flips layers into eager mode is not needed — dygraph
+code calls to_variable / PyLayer explicitly, as 1.3-era users did.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["enabled", "guard", "to_variable", "VarBase", "PyLayer"]
+
+_tracer_enabled = False
+
+
+def enabled() -> bool:
+    """reference: imperative/base.py enabled."""
+    return _tracer_enabled
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference: imperative/base.py guard."""
+    global _tracer_enabled
+    prev = _tracer_enabled
+    _tracer_enabled = True
+    try:
+        yield
+    finally:
+        _tracer_enabled = prev
+
+
+class VarBase:
+    """Eager tensor with a grad slot (reference: imperative/layer.h VarBase).
+
+    The tape is a list of (vjp_fn, inputs) links; backward() seeds the
+    cotangent and walks it in reverse."""
+
+    def __init__(self, value, stop_gradient: bool = False):
+        self._value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        # (vjp_fn, parent VarBases) that produced this var, if any
+        self._producer = None
+
+    # -- numpy/JAX interop ------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def _grad_ivar(self):
+        return self._grad
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self):
+        """Reverse-walk the producer chain from this var
+        (reference: VarBase::RunBackward)."""
+        if self._value.size != 1:
+            raise ValueError("backward() needs a scalar loss")
+        topo: List[VarBase] = []
+        seen = set()
+
+        def visit(v: "VarBase"):
+            if id(v) in seen or v._producer is None:
+                return
+            seen.add(id(v))
+            for p in v._producer[1]:
+                visit(p)
+            topo.append(v)
+
+        visit(self)
+        self._grad = jnp.ones_like(self._value)
+        for v in reversed(topo):
+            vjp_fn, parents = v._producer
+            if v._grad is None:
+                continue
+            parent_grads = vjp_fn(v._grad)
+            for p, g in zip(parents, parent_grads):
+                if p.stop_gradient:
+                    continue
+                p._grad = g if p._grad is None else p._grad + g
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def __repr__(self):
+        return f"VarBase(shape={self.shape}, dtype={self.dtype})"
+
+
+def to_variable(value, block=None, name=None) -> VarBase:
+    """reference: imperative/base.py to_variable."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value))
+
+
+def _record(fn, *parents: VarBase) -> VarBase:
+    """Run fn eagerly over parent values; record the vjp on the tape."""
+    vals = [p._value for p in parents]
+    out_val, vjp_fn = jax.vjp(fn, *vals)
+    out = VarBase(out_val)
+    out._producer = (vjp_fn, list(parents))
+    return out
+
+
+class PyLayer:
+    """reference: imperative/layers.py PyLayer — subclass and implement
+    forward(*inputs) with jnp ops; gradients come from jax.vjp over it."""
+
+    def __init__(self):
+        self._parameters: List[VarBase] = []
+
+    def parameters(self) -> List[VarBase]:
+        return list(self._parameters)
+
+    def create_parameter(self, shape, dtype="float32", init=None) -> VarBase:
+        if init is not None:
+            value = np.asarray(init, dtype=dtype)
+        else:
+            fan_in = int(np.prod(shape[:-1])) or 1
+            rng = np.random.RandomState(len(self._parameters))
+            value = rng.uniform(
+                -1.0 / np.sqrt(fan_in), 1.0 / np.sqrt(fan_in), size=shape
+            ).astype(dtype)
+        p = VarBase(value)
+        self._parameters.append(p)
+        return p
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        vars_in = [to_variable(v) for v in inputs]
+        parents = vars_in + self._parameters
+
+        def fn(*vals):
+            n = len(vars_in)
+            holder_in = vals[:n]
+            holder_p = vals[n:]
+            return self._forward_values(holder_in, holder_p)
+
+        return _record(fn, *parents)
+
+    def _forward_values(self, input_vals, param_vals):
+        """Default: call forward() with raw jax arrays, temporarily
+        substituting parameter values (so forward can read self-created
+        parameters through ._value)."""
+        saved = [p._value for p in self._parameters]
+        try:
+            for p, v in zip(self._parameters, param_vals):
+                p._value = v
+            out = self.forward(*input_vals)
+        finally:
+            for p, v in zip(self._parameters, saved):
+                p._value = v
+        return out._value if isinstance(out, VarBase) else out

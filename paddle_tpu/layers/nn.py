@@ -24,6 +24,7 @@ __all__ = [
     "beam_search",
     "beam_search_decode",
     "fused_attention",
+    "edit_distance",
     "conv2d",
     "conv3d",
     "conv2d_transpose",
@@ -1102,3 +1103,20 @@ def fused_attention(q, k, v, causal=False, scale=None, k_lengths=None,
         attrs={"causal": causal, "scale": float(scale) if scale else 0.0},
     )
     return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance per sequence pair + batch sequence count
+    (reference: layers/nn.py edit_distance over edit_distance_op.cc)."""
+    helper = LayerHelper("edit_distance", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized,
+               "ignored_tokens": ignored_tokens or []},
+    )
+    return out, seq_num

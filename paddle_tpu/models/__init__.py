@@ -14,3 +14,5 @@ from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
 from .vgg import vgg16  # noqa: F401
 from .transformer import transformer, TransformerConfig  # noqa: F401
 from .stacked_lstm import stacked_dynamic_lstm  # noqa: F401
+from .machine_translation import machine_translation  # noqa: F401
+from .se_resnext import se_resnext  # noqa: F401

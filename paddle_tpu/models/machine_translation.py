@@ -1,0 +1,109 @@
+"""Seq2seq attention NMT (reference: benchmark/fluid/models/
+machine_translation.py — GRU encoder-decoder with Bahdanau-style attention
+over WMT data, trained with DynamicRNN; decode via beam search).
+
+TPU-native: the encoder uses the fused `gru` sequence op; the decoder is a
+DynamicRNN whose per-step attention runs over the padded encoder states
+with length masks (same math, static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+from .common import ModelSpec
+
+__all__ = ["machine_translation"]
+
+
+def _attention(dec_state, enc_states, enc_proj, d):
+    """Bahdanau concat attention (reference: machine_translation.py
+    simple_attention): score = v . tanh(W_enc h_enc + W_dec s)."""
+    dec_proj = layers.fc(dec_state, size=d, bias_attr=False)  # [B, d]
+    # broadcast the decoder projection over the time axis of the LoD states
+    mix = layers.tanh(
+        layers.elementwise_add(
+            enc_proj, layers.unsqueeze(dec_proj, axes=[1])
+        )
+    )
+    e = layers.fc(mix, size=1, bias_attr=False)  # LoD [B, S, 1]
+    w = layers.sequence_softmax(e)  # softmax over time, masked by lengths
+    scaled = layers.elementwise_mul(enc_states, w)  # broadcast last dim
+    return layers.sequence_pool(scaled, "sum")  # [B, 2E]
+
+
+def machine_translation(
+    dict_size: int = 10000,
+    embedding_dim: int = 512,
+    encoder_size: int = 512,
+    decoder_size: int = 512,
+    max_length: int = 50,
+    beam_size: int = 3,
+) -> ModelSpec:
+    src = layers.data("src_word_id", [1], dtype="int64", lod_level=1)
+    trg = layers.data("target_sequence", [1], dtype="int64", lod_level=1)
+    lbl = layers.data("label_sequence", [1], dtype="int64", lod_level=1)
+
+    # encoder: embed -> fc -> bigru (fwd + reversed)
+    src_emb = layers.embedding(
+        src, size=[dict_size, embedding_dim],
+        param_attr=ParamAttr(name="src_emb"),
+    )
+    enc_in = layers.fc(src_emb, size=encoder_size * 3, bias_attr=False)
+    enc_fwd = layers.dynamic_gru(enc_in, size=encoder_size)
+    enc_bwd = layers.dynamic_gru(enc_in, size=encoder_size, is_reverse=True)
+    enc_states = layers.concat([enc_fwd, enc_bwd], axis=-1)  # [B, S, 2E]
+    enc_last = layers.sequence_last_step(enc_fwd)
+
+    enc_proj = layers.fc(enc_states, size=decoder_size, bias_attr=False)
+
+    # decoder with per-step attention
+    trg_emb = layers.embedding(
+        trg, size=[dict_size, embedding_dim],
+        param_attr=ParamAttr(name="trg_emb"),
+    )
+    init_state = layers.fc(enc_last, size=decoder_size, act="tanh")
+
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(trg_emb)
+        prev = drnn.memory(init=init_state)
+        enc_s = drnn.static_input(enc_states)
+        enc_p = drnn.static_input(enc_proj)
+        ctx = _attention(prev, enc_s, enc_p, decoder_size)
+        inp = layers.concat([word, ctx], axis=-1)
+        h = layers.fc(input=[inp, prev], size=decoder_size, act="tanh")
+        drnn.update_memory(prev, h)
+        out = layers.fc(h, size=dict_size, act="softmax")
+        drnn.output(out)
+    probs = drnn()
+
+    cost = layers.cross_entropy(input=probs, label=lbl)
+    loss = layers.mean(layers.sequence_pool(cost, "sum"))
+
+    def synthetic_batch(batch_size: int, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        from ..core.lod import create_lod_tensor
+
+        lens = rng.randint(4, 12, size=batch_size)
+        mk = lambda l: rng.randint(1, dict_size, size=(l, 1)).astype("int64")
+        srcs = [mk(l) for l in lens]
+        trgs = [mk(l) for l in lens]
+        lbls = [np.roll(t, -1, axis=0) for t in trgs]
+        return {
+            "src_word_id": create_lod_tensor(srcs),
+            "target_sequence": create_lod_tensor(trgs),
+            "label_sequence": create_lod_tensor(lbls),
+        }
+
+    return ModelSpec(
+        name="machine_translation",
+        feed_names=["src_word_id", "target_sequence", "label_sequence"],
+        loss=loss,
+        synthetic_batch=synthetic_batch,
+        extras={"beam_size": beam_size, "max_length": max_length},
+    )

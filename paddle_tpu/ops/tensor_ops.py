@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.lod import LoDValue
 from ..core.proto import DataType, dtype_to_numpy
 from ..core.registry import register_op
 from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
@@ -330,8 +331,17 @@ def _concat_infer(op, block):
 
 @register_op("concat", infer_shape=_concat_infer)
 def _concat(ctx, ins, attrs):
-    xs = [data(v) for v in ins["X"] if v is not None]
-    return {"Out": [jnp.concatenate(xs, axis=attrs.get("axis", 0))]}
+    vals = [v for v in ins["X"] if v is not None]
+    xs = [data(v) for v in vals]
+    axis = attrs.get("axis", 0)
+    out = jnp.concatenate(xs, axis=axis)
+    # feature-axis concat of sequence inputs keeps the LoD view
+    norm_axis = axis + xs[0].ndim if axis < 0 else axis
+    if norm_axis >= 2:
+        for v in vals:
+            if isinstance(v, LoDValue):
+                return {"Out": [LoDValue(out, v.lengths)]}
+    return {"Out": [out]}
 
 
 def _split_infer(op, block):

@@ -1,0 +1,228 @@
+"""OpTest harness (reference: python/paddle/fluid/tests/unittests/op_test.py:132).
+
+Subclasses declare `op_type`, `inputs`, `attrs`, and reference `outputs`
+(numpy); `check_output` runs the single-op program and compares, and
+`check_grad` compares program-built analytic gradients (append_backward ->
+jax.vjp under the hood) against central finite differences — the same
+contract as the reference's get_numeric_gradient (op_test.py:48).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program
+from paddle_tpu.core.lod import LoDValue, create_lod_tensor
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: Dict = {}
+    attrs: Dict = {}
+    outputs: Dict = {}
+
+    # ------------------------------------------------------------------
+    def _norm_value(self, v):
+        """Accept np arrays, (array, lod) tuples, or lists of sequences."""
+        if isinstance(v, tuple) and len(v) == 2:  # (flat_data, [lengths])
+            return create_lod_tensor(v[0], [v[1]])
+        return np.asarray(v)
+
+    def _build(self):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            block = prog.global_block()
+            feed = {}
+            in_names: Dict[str, List[str]] = {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, v in enumerate(vals):
+                    name = f"{slot.lower()}_{i}"
+                    rv = self._norm_value(v)
+                    if isinstance(rv, LoDValue):
+                        shape = [-1] + list(np.shape(rv.data)[2:])
+                        lod_level = 1
+                    else:
+                        shape = list(np.shape(rv))
+                        lod_level = 0
+                    block.create_var(
+                        name=name, shape=shape, dtype=rv.dtype if not isinstance(rv, LoDValue) else rv.data.dtype,
+                        lod_level=lod_level, stop_gradient=False,
+                    )
+                    feed[name] = rv
+                    names.append(name)
+                in_names[slot] = names
+            out_names: Dict[str, List[str]] = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = [f"out_{slot.lower()}_{i}" for i in range(len(vals))]
+                out_names[slot] = names
+            block.append_op(
+                type=self.op_type,
+                inputs=in_names,
+                outputs=out_names,
+                attrs=dict(self.attrs),
+            )
+        return prog, startup, feed, in_names, out_names
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        prog, startup, feed, _, out_names = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.program_guard(prog, startup):
+            fetch = [n for ns in out_names.values() for n in ns]
+            got = exe.run(program=prog, feed=feed, fetch_list=fetch,
+                          return_numpy=False)
+        i = 0
+        for slot, val in self.outputs.items():
+            vals = val if isinstance(val, list) else [val]
+            for want in vals:
+                want = self._norm_value(want)
+                g = got[i]
+                i += 1
+                if want is None:
+                    continue
+                gd = np.asarray(g.data if isinstance(g, LoDValue) else g)
+                wd = np.asarray(
+                    want.data if isinstance(want, LoDValue) else want
+                )
+                np.testing.assert_allclose(
+                    gd.astype(np.float64), wd.astype(np.float64),
+                    atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot} mismatch",
+                )
+
+    # ------------------------------------------------------------------
+    def _run_loss(self, feed, prog, loss_name, extra_fetch=()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = exe.run(program=prog, feed=feed,
+                       fetch_list=[loss_name, *extra_fetch])
+        return outs
+
+    def check_grad(
+        self,
+        inputs_to_check: Sequence[str],
+        output_names,
+        max_relative_error: float = 0.005,
+        numeric_grad_delta: float = 1e-3,
+        no_grad_set=None,
+    ):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        prog, startup, feed, in_names, out_names = self._build()
+        cot_rng = np.random.RandomState(12345)
+        # forward once to learn the runtime output shapes (desc shapes may
+        # carry -1 batch dims)
+        exe0 = fluid.Executor(fluid.CPUPlace())
+        all_out = [n for ns in out_names.values() for n in ns]
+        fwd_vals = exe0.run(program=prog, feed=feed, fetch_list=all_out,
+                            return_numpy=False)
+        runtime_shape = {
+            n: np.shape(v.data if isinstance(v, LoDValue) else v)
+            for n, v in zip(all_out, fwd_vals)
+        }
+        with fluid.program_guard(prog, startup):
+            block = prog.global_block()
+            # scalar loss = sum(output * random_cotangent) so grads are
+            # well-conditioned even for constant-sum outputs (softmax);
+            # mirrors the reference's user_defined_grad_outputs
+            parts = []
+            for slot, slot_names in out_names.items():
+                for n in slot_names:
+                    if not (
+                        slot in output_names
+                        or n in output_names
+                        or len(out_names) == 1
+                    ):
+                        continue
+                    v = block.var(n)
+                    wname = n + "@COT"
+                    w = cot_rng.uniform(
+                        0.5, 1.5, size=runtime_shape[n]
+                    ).astype("float32")
+                    block.create_var(
+                        name=wname, shape=list(w.shape), dtype="float32",
+                        stop_gradient=True,
+                    )
+                    feed[wname] = w
+                    parts.append(
+                        fluid.layers.reduce_sum(
+                            fluid.layers.elementwise_mul(
+                                v, block.var(wname)
+                            )
+                        )
+                    )
+            total = parts[0]
+            for p in parts[1:]:
+                total = fluid.layers.elementwise_add(total, p)
+            loss = fluid.layers.scale(total, scale=1.0)
+            fluid.append_backward(loss)
+
+        # analytic grads for the checked inputs
+        check_names = []
+        for slot in inputs_to_check:
+            check_names.extend(in_names[slot])
+        grad_names = [n + "@GRAD" for n in check_names]
+        exe = fluid.Executor(fluid.CPUPlace())
+        analytic = exe.run(program=prog, feed=feed, fetch_list=grad_names,
+                           return_numpy=False)
+        analytic = [
+            np.asarray(a.data if isinstance(a, LoDValue) else a)
+            for a in analytic
+        ]
+
+        # numeric grads by central differences on the same loss
+        def loss_value(cur_feed):
+            (lv,) = exe.run(program=prog, feed=cur_feed, fetch_list=[loss])
+            return float(np.ravel(np.asarray(lv))[0])
+
+        for name, ana in zip(check_names, analytic):
+            base = feed[name]
+            if isinstance(base, LoDValue):
+                arr = np.array(base.data, dtype=np.float64)
+                rebuild = lambda a: LoDValue(
+                    a.astype(np.asarray(base.data).dtype), base.lengths
+                )
+                valid_mask = (
+                    np.arange(arr.shape[1])[None, :, None]
+                    < np.asarray(base.lengths)[:, None, None]
+                )
+            else:
+                arr = np.array(base, dtype=np.float64)
+                rebuild = lambda a: a.astype(np.asarray(base).dtype)
+                valid_mask = np.ones_like(arr, dtype=bool)
+            num = np.zeros_like(arr)
+            flat = arr.reshape(-1)
+            mask_flat = np.broadcast_to(valid_mask, arr.shape).reshape(-1)
+            for i in range(flat.size):
+                if not mask_flat[i]:
+                    continue
+                orig = flat[i]
+                flat[i] = orig + numeric_grad_delta
+                feed_p = dict(feed)
+                feed_p[name] = rebuild(arr)
+                up = loss_value(feed_p)
+                flat[i] = orig - numeric_grad_delta
+                feed_p[name] = rebuild(arr)
+                down = loss_value(feed_p)
+                flat[i] = orig
+                num.reshape(-1)[i] = (up - down) / (2 * numeric_grad_delta)
+            feed[name] = rebuild(arr)
+
+            ana_m = np.where(
+                np.broadcast_to(valid_mask, ana.shape), ana, 0.0
+            )
+            denom = np.maximum(
+                np.maximum(np.abs(ana_m), np.abs(num)).max(), 1e-3
+            )
+            rel = np.abs(ana_m - num).max() / denom
+            assert rel <= max_relative_error, (
+                f"{self.op_type} grad wrt {name}: max relative error "
+                f"{rel:.5f} > {max_relative_error}\nanalytic:\n{ana_m}\n"
+                f"numeric:\n{num}"
+            )

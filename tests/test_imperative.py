@@ -1,0 +1,72 @@
+"""Imperative (dygraph) mode (reference: test_imperative.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.imperative import PyLayer, guard, to_variable
+
+
+def test_to_variable_and_numpy():
+    with guard():
+        v = to_variable(np.ones((2, 3), dtype="float32"))
+        np.testing.assert_array_equal(v.numpy(), np.ones((2, 3)))
+        assert fluid.imperative.enabled()
+    assert not fluid.imperative.enabled()
+
+
+class MyLayer(PyLayer):
+    """reference: test_imperative.py MyLayer (relu -> elementwise_mul -> sum)."""
+
+    def forward(self, x):
+        x = jnp.maximum(x, 0.0)
+        return jnp.sum(x * x)
+
+
+def test_pylayer_forward_backward():
+    npx = np.array([[1.0, -1.0], [2.0, 3.0]], dtype="float32")
+    with guard():
+        layer = MyLayer()
+        x = to_variable(npx)
+        out = layer(x)
+        out.backward()
+        g = x.gradient
+    relu = np.maximum(npx, 0)
+    want = 2 * relu * (npx > 0)
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.sum(relu * relu))
+    np.testing.assert_allclose(g, want)
+
+
+class Linear(PyLayer):
+    def __init__(self, d_in, d_out):
+        super().__init__()
+        self.w = self.create_parameter([d_in, d_out])
+        self.b = self.create_parameter([d_out], init=np.zeros(d_out, "float32"))
+
+    def forward(self, x):
+        return x @ self.w._value + self.b._value
+
+
+def test_pylayer_sgd_training():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 4).astype("float32")
+    yv = (xv @ np.array([[1.0], [2.0], [-1.0], [0.5]], "float32"))
+    with guard():
+        model = Linear(4, 1)
+        losses = []
+        for _ in range(50):
+            x = to_variable(xv)
+            pred = model(x)
+
+            def loss_of(p):
+                return jnp.mean((p - yv) ** 2)
+
+            from paddle_tpu.imperative import _record
+
+            loss = _record(loss_of, pred)
+            loss.backward()
+            for p in model.parameters():
+                p._value = p._value - 0.1 * p._grad
+                p.clear_gradient()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.05
