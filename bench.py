@@ -1,13 +1,24 @@
-"""Round benchmark: ResNet-50 training images/sec on the available chip.
+"""Round benchmark: ResNet-50 train images/sec AND Transformer train
+tokens/sec on the available chip, in one run.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is the ratio to the reference's best published ResNet-50
-training throughput (81.69 img/s, MKL-DNN on 2x Xeon 6148 —
+Prints ONE JSON line.  Top-level metric/value/unit/vs_baseline are the
+ResNet-50 numbers (vs the reference's best published ResNet-50 training
+throughput: 81.69 img/s, MKL-DNN on 2x Xeon 6148 —
 benchmark/IntelOptimizedPaddle.md:43-47; the reference publishes no
-GPU/fluid-era ResNet-50 number, see BASELINE.md).
+GPU/fluid-era ResNet-50 number, see BASELINE.md).  "extra_metrics" carries
+the Transformer tokens/sec and per-model MFU estimates from analytic FLOPs.
 
-Env knobs: BENCH_BS (default 64), BENCH_STEPS (default 10),
-BENCH_MODEL (resnet50 | transformer | lenet).
+The step loop is fully pipelined: feeds are numpy, the Executor device_puts
+them asynchronously, fetches stay on device (return_numpy=False) so nothing
+blocks until the final block_until_ready — the reference gets the same
+overlap from its double-buffer reader ops
+(operators/reader/create_double_buffer_reader_op.cc).
+
+Env knobs: BENCH_BS (resnet bs, default 64), BENCH_TRANSFORMER_BS (default
+16), BENCH_STEPS (default 20), BENCH_MODELS (comma list, default
+"resnet50,transformer"), BENCH_AMP (default "1": bf16 matmul/conv compute),
+BENCH_FLASH (default "1"), BENCH_PEAK_TFLOPS (chip peak for MFU, default
+197 = v5e bf16).
 """
 
 from __future__ import annotations
@@ -21,23 +32,41 @@ import numpy as np
 
 REF_RESNET50_IMG_S = 81.69  # IntelOptimizedPaddle.md:43-47 (bs=64, MKL-DNN)
 
+# training FLOPs ~= 3x forward (fwd + 2x bwd)
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9  # 224x224, standard count
 
-def main() -> None:
+
+def _transformer_train_flops_per_token(cfg) -> float:
+    d, di, L, S = cfg.d_model, cfg.d_inner, cfg.n_layer, cfg.max_length
+    matmul_params = (
+        L * (4 * d * d + 2 * d * di)        # encoder: self-attn + ffn
+        + L * (8 * d * d + 2 * d * di)      # decoder: self+cross attn + ffn
+        + d * cfg.trg_vocab_size            # output projection
+    )
+    # attention score/value matmuls: ~4*S*d fwd per token per attn block,
+    # 3 blocks per (enc,dec) layer pair; x3 for training
+    attn = 3 * 4 * S * d * 3 * L
+    return 6 * matmul_params + attn
+
+
+def run_model(model: str, steps: int, peak_flops: float) -> dict:
+    import jax
     import paddle_tpu as fluid
     from paddle_tpu import models
 
-    model = os.environ.get("BENCH_MODEL", "resnet50")
-    bs = int(os.environ.get("BENCH_BS", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    fluid.reset_default_env()
 
     if model == "resnet50":
+        bs = int(os.environ.get("BENCH_BS", "64"))
         spec = models.resnet_imagenet(depth=50, class_num=1000)
         unit = "images/sec"
         items_per_step = bs
         metric = "resnet50_train_images_per_sec_per_chip"
         baseline = REF_RESNET50_IMG_S
+        flops_per_item = RESNET50_TRAIN_FLOPS_PER_IMG
         lr = 0.1
     elif model == "transformer":
+        bs = int(os.environ.get("BENCH_TRANSFORMER_BS", "16"))
         cfg = models.TransformerConfig(
             src_vocab_size=32000, trg_vocab_size=32000, max_length=256,
             use_flash_attention=os.environ.get("BENCH_FLASH", "1") != "0",
@@ -47,14 +76,20 @@ def main() -> None:
         items_per_step = bs * cfg.max_length
         metric = "transformer_train_tokens_per_sec_per_chip"
         baseline = None  # no reference number exists (BASELINE.md)
+        flops_per_item = _transformer_train_flops_per_token(cfg)
         lr = 1e-4
-    else:
+    elif model == "lenet":
+        bs = int(os.environ.get("BENCH_BS", "64"))
         spec = models.lenet5()
         unit = "images/sec"
         items_per_step = bs
         metric = "mnist_train_images_per_sec_per_chip"
         baseline = None
+        flops_per_item = 3 * 5e6
         lr = 0.01
+    else:
+        raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
+                         "(expected resnet50|transformer|lenet)")
 
     fluid.optimizer.MomentumOptimizer(
         learning_rate=lr, momentum=0.9
@@ -64,30 +99,52 @@ def main() -> None:
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
 
-    batch = spec.synthetic_batch(bs)
+    batches = [spec.synthetic_batch(bs, seed=i) for i in range(4)]
 
-    # warmup: trigger compile + first run
-    for _ in range(2):
-        exe.run(feed=batch, fetch_list=[spec.loss])
+    # warmup: trigger compile + first runs
+    for i in range(2):
+        exe.run(feed=batches[i % 4], fetch_list=[spec.loss],
+                return_numpy=False)
 
     t0 = time.perf_counter()
     loss_v = None
-    for _ in range(steps):
-        (loss_v,) = exe.run(feed=batch, fetch_list=[spec.loss])
-    # fetch conversion already blocks on the result
+    for i in range(steps):
+        (loss_v,) = exe.run(feed=batches[i % 4], fetch_list=[spec.loss],
+                            return_numpy=False)
+    jax.block_until_ready(loss_v)
     dt = time.perf_counter() - t0
 
     value = items_per_step * steps / dt
-    print(json.dumps({
+    mfu = value * flops_per_item / peak_flops
+    sys.stderr.write(
+        f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
+        f"mfu={mfu:.3f} final_loss={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
+    )
+    return {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else None,
-    }))
-    sys.stderr.write(
-        f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
-        f"final_loss={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
-    )
+        "mfu": round(mfu, 4),
+    }
+
+
+def main() -> None:
+    if os.environ.get("BENCH_AMP", "1") != "0":
+        import paddle_tpu as fluid
+        fluid.enable_amp("bfloat16")
+    peak_flops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    names = os.environ.get("BENCH_MODELS", "resnet50,transformer").split(",")
+
+    names = [m.strip() for m in names if m.strip()]
+    if not names:
+        raise SystemExit("BENCH_MODELS is empty")
+    results = [run_model(m, steps, peak_flops) for m in names]
+    primary = dict(results[0])
+    if len(results) > 1:
+        primary["extra_metrics"] = results[1:]
+    print(json.dumps(primary))
 
 
 if __name__ == "__main__":

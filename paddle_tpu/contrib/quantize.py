@@ -105,6 +105,27 @@ class QuantizeTranspiler:
                     op.inputs[slot] = [quantized[n]] + list(names[1:])
             new_ops.append(op)
         desc.ops[:] = new_ops
+        self._transpile_backward(desc, quantized)
+        program.desc.bump()  # in-place rewrite: invalidate compiled caches
+
+    @staticmethod
+    def _transpile_backward(desc, quantized: dict) -> None:
+        """Rename matching *_grad op inputs to the quantized var names
+        (reference: quantize_transpiler.py _transpile_backward).
+
+        Under this compiler the rename is belt-and-braces: grad ops replay
+        the forward op's jax.vjp closure (core/compiler.py _lower_grad_op),
+        which was traced AFTER the forward inputs were renamed, so gradients
+        already differentiate through the quantized forward (straight-through
+        on the fake_quantize boundary).  The rename keeps the program desc
+        consistent with what actually executes, for tools that read it."""
+        for op in desc.ops:
+            if not op.type.endswith("_grad"):
+                continue
+            for slot in ("X", "Y", "Input", "Filter"):
+                names = op.inputs.get(slot)
+                if names and names[0] in quantized:
+                    op.inputs[slot] = [quantized[names[0]]] + list(names[1:])
 
     @staticmethod
     def _init_scale_var(startup_program: Optional[Program], name: str) -> None:

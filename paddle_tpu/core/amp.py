@@ -1,0 +1,69 @@
+"""Mixed-precision (AMP) policy: bf16 compute on the MXU, fp32 everywhere else.
+
+The reference's float16 story is a software half type plus cuDNN math-mode
+selection (paddle/fluid/platform/float16.h:1); on TPU the equivalent is
+feeding the MXU bf16 operands.  Params, optimizer state, and all non-matmul
+math stay fp32 (master weights); only the inputs of matmul/conv lowerings
+are cast, and the op output is cast straight back to fp32 (the MXU always
+accumulates in fp32 internally; only the final output rounds through bf16).
+Gradients flow through the casts via jax.vjp — the backward convs/matmuls
+run in bf16 too, and the resulting param grads come back fp32.  Loss
+scaling is unnecessary (bf16 shares fp32's exponent range).
+
+The policy is read at trace time; executors include `state_key()` in their
+compiled-program cache keys so flipping the policy recompiles.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["enable_amp", "disable_amp", "amp_dtype", "state_key",
+           "mxu_operands", "mxu_output"]
+
+_POLICY = {"dtype": None}
+
+
+def enable_amp(dtype: str = "bfloat16") -> None:
+    """Turn on mixed precision: matmul/conv compute in `dtype`."""
+    _POLICY["dtype"] = jnp.dtype(dtype)
+
+
+def disable_amp() -> None:
+    _POLICY["dtype"] = None
+
+
+def amp_dtype():
+    return _POLICY["dtype"]
+
+
+def state_key():
+    """Hashable policy fingerprint for compiled-program cache keys."""
+    d = _POLICY["dtype"]
+    return str(d) if d is not None else None
+
+
+def mxu_operands(*arrays):
+    """Cast fp32 MXU operands to the AMP compute dtype (no-op when off or
+    for non-fp32 inputs, e.g. integer or already-reduced-precision data)."""
+    d = _POLICY["dtype"]
+    if d is None:
+        return arrays
+    return tuple(
+        a.astype(d) if getattr(a, "dtype", None) == jnp.float32 else a
+        for a in arrays
+    )
+
+
+def mxu_output(out, *orig_operands):
+    """Cast a matmul/conv result back to fp32 when AMP downcast its
+    operands, so the surrounding graph (norms, losses, optimizer) stays
+    full-precision.  Pass the ORIGINAL (pre-mxu_operands) operands: the
+    upcast happens only if AMP actually rewrote one — a natively-bf16
+    model's matmul outputs stay bf16, matching its descs."""
+    d = _POLICY["dtype"]
+    if d is None or getattr(out, "dtype", None) != d:
+        return out
+    if any(getattr(a, "dtype", None) == jnp.float32 for a in orig_operands):
+        return out.astype(jnp.float32)
+    return out

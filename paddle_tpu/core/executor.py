@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import jax
 import numpy as np
 
+from . import amp
 from .compiler import CompiledBlock
 from .framework import Program, Variable, default_main_program
 from .lod import LoDValue
@@ -32,6 +33,10 @@ RNG_STATE_VAR = "@rng_key@"
 
 def _as_feed_value(value, var_desc=None):
     if isinstance(value, LoDValue):
+        return value
+    if isinstance(value, jax.Array):
+        # already on device: pass through untouched (np.asarray would force a
+        # blocking device->host copy and re-upload — the round 1 bench bug)
         return value
     arr = np.asarray(value)
     if var_desc is not None and var_desc.type == VarType.LOD_TENSOR:
@@ -176,13 +181,20 @@ class Executor:
         feed_names = sorted(feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
-        key = (
-            id(program),
-            len(program.desc.block(0).ops),
-            tuple(feed_names),
-            tuple(fetch_names),
-        )
+        # The cache maps (feeds, fetches, amp policy) -> (desc fingerprint,
+        # compiled, plan) and revalidates the fingerprint on every hit: an
+        # in-place desc mutation (transpiler rewrite, attr edit) or a
+        # different program with the same signature recompiles AND replaces
+        # the stale entry, so a mutate-run loop can't grow the cache.
+        # (The reference keys on the Program object, executor.py
+        # _get_program_cache — unsound here because descs mutate in place.)
+        # id(program) keeps alternating train/test programs from thrashing
+        # one slot; the fingerprint check makes id reuse after GC harmless
+        fp = program.desc.fingerprint()
+        key = (id(program), tuple(feed_names), tuple(fetch_names), amp.state_key())
         entry = self._cache.get(key) if use_program_cache else None
+        if entry is not None and entry[0] != fp:
+            entry = None
         if entry is None:
             plan = _RunPlan(program, feed_names, fetch_names)
             compiled = CompiledBlock(
@@ -193,17 +205,25 @@ class Executor:
                 plan.state_names,
                 donate_states=self.donate_states,
             )
-            entry = (compiled, plan)
+            entry = (fp, compiled, plan)
             if use_program_cache:
                 self._cache[key] = entry
-        compiled, plan = entry
+        _, compiled, plan = entry
 
         block0 = program.desc.block(0)
         feed_vals = plan.feed_values(feed, block0)
         state_vals = plan.state_values(scope, block0)
         rng = plan.rng_value(scope, program)
 
-        with jax.default_device(self.place.jax_device()):
+        # explicit async host->device transfer: device_put enqueues the copy
+        # and returns immediately, so step N's compute overlaps batch N+1's
+        # transfer (the reference gets this from double-buffer reader ops,
+        # operators/reader/create_double_buffer_reader_op.cc; here JAX's
+        # async dispatch provides the overlap once the transfer is nonblocking)
+        device = self.place.jax_device()
+        feed_vals = jax.device_put(feed_vals, device)
+
+        with jax.default_device(device):
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 
         plan.write_back(scope, new_states, new_rng)

@@ -282,6 +282,8 @@ class Operator:
 
     def _set_attr(self, name: str, val):
         self.desc.attrs[name] = val
+        # invalidate compiled-program caches keyed on the desc fingerprint
+        self.block.program.desc.bump()
 
     def all_attrs(self):
         return dict(self.desc.attrs)
@@ -462,6 +464,7 @@ class Program:
                 for opdesc in block.desc.ops:
                     if "is_test" in opdesc.attrs or opdesc.type in ("dropout", "batch_norm"):
                         opdesc.attrs["is_test"] = True
+            p.desc.bump()
         p._sync_params(self)
         return p
 
@@ -524,6 +527,17 @@ def switch_startup_program(program: Program) -> Program:
     global _startup_program
     prev, _startup_program = _startup_program, program
     return prev
+
+
+def reset_default_env() -> None:
+    """Fresh default main/startup programs and a fresh global scope — the
+    'start a new model from scratch in this process' idiom used by benches,
+    the driver entry points, and tests."""
+    from . import scope as scope_mod
+
+    switch_main_program(Program())
+    switch_startup_program(Program())
+    scope_mod._current_scope = scope_mod.Scope()
 
 
 @contextlib.contextmanager

@@ -13,6 +13,7 @@ protobuf wire compatibility.  Shape/dtype inference runs at graph-build time
 from __future__ import annotations
 
 import copy
+import hashlib
 import json
 from dataclasses import dataclass, field
 from enum import IntEnum
@@ -293,6 +294,13 @@ class ProgramDesc:
 
     blocks: List[BlockDesc] = field(default_factory=lambda: [BlockDesc(idx=0)])
     version: int = 1
+    # bumped by framework-layer mutators for in-place edits that don't change
+    # op/var counts (attr edits, transpiler rewrites); lets fingerprint() memoize
+    _mod_count: int = field(default=0, repr=False, compare=False)
+
+    def bump(self) -> None:
+        """Record an in-place mutation (invalidates the fingerprint memo)."""
+        self._mod_count += 1
 
     def block(self, idx: int) -> BlockDesc:
         return self.blocks[idx]
@@ -307,6 +315,45 @@ class ProgramDesc:
 
     def clone(self) -> "ProgramDesc":
         return copy.deepcopy(self)
+
+    def fingerprint(self) -> bytes:
+        """Content hash over every block's ops and var descs.  Executors key
+        their compiled-program caches on this (plus feed/fetch names) so an
+        in-place desc mutation — a transpiler rewriting an op's inputs, an
+        attr edit — always triggers recompilation.  The reference caches on
+        the Program object itself (executor.py Executor._get_program_cache),
+        which is only sound because it re-builds descs on every transpile;
+        here descs are mutable in place, so identity isn't enough.
+
+        Memoized on (mod-count, per-block op/var counts): recomputed only
+        when the program grows or a mutator called bump().  Direct raw-desc
+        edits must call bump() themselves."""
+        memo_key = (
+            self._mod_count,
+            tuple((len(b.ops), len(b.vars)) for b in self.blocks),
+        )
+        cached = getattr(self, "_fp_cache", None)
+        if cached is not None and cached[0] == memo_key:
+            return cached[1]
+        h = hashlib.blake2b(digest_size=16)
+        for b in self.blocks:
+            h.update(b"B%d,%d" % (b.idx, b.forward_block_idx))
+            for op in b.ops:
+                h.update(op.type.encode())
+                h.update(repr(sorted(op.inputs.items())).encode())
+                h.update(repr(sorted(op.outputs.items())).encode())
+                h.update(
+                    repr(sorted((k, repr(v)) for k, v in op.attrs.items())).encode()
+                )
+            for name in sorted(b.vars):
+                v = b.vars[name]
+                h.update(
+                    repr((name, int(v.type), v.shape, int(v.dtype), v.lod_level,
+                          v.persistable, v.sharding)).encode()
+                )
+        digest = h.digest()
+        self._fp_cache = (memo_key, digest)
+        return digest
 
     # -- serde ---------------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -38,6 +39,7 @@ class PyReader:
         self._creator: Optional[Callable] = None
         self._tensor_provider = False
         self._end = object()
+        self._stop_event: Optional[threading.Event] = None
 
     # -- decoration (reference: py_reader decorate_* methods) ---------------
     def decorate_paddle_reader(self, reader_creator: Callable):
@@ -60,13 +62,36 @@ class PyReader:
                 "py_reader has no data source; call decorate_paddle_reader first"
             )
         self._queue = queue.Queue(maxsize=self._capacity)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._stop_event = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop_event),
+            daemon=True,
+        )
         self._thread.start()
 
     def reset(self):
-        """Drain after EOF so the next start() begins a fresh pass."""
+        """Stop the worker and drop the queue so the next start() begins a
+        fresh pass.  Signals the thread and drains its queue so a mid-pass
+        reset doesn't leave a worker blocked on the abandoned bounded queue,
+        silently consuming samples from a shared/stateful reader."""
+        thread, q, stop = self._thread, self._queue, self._stop_event
         self._queue = None
         self._thread = None
+        self._stop_event = None
+        if stop is not None:
+            stop.set()
+        if thread is not None and thread.is_alive():
+            # unblock a worker stuck in q.put(...) on the full queue; bound
+            # the wait — a creator blocked inside next() (e.g. a network
+            # source) can't observe the stop event until it yields, and
+            # reset() must not hang on it (the daemon thread exits at its
+            # next yield without pushing the item)
+            deadline = time.monotonic() + 2.0
+            while thread.is_alive() and time.monotonic() < deadline:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    thread.join(timeout=0.05)
 
     def _convert_batch(self, batch) -> dict:
         from ..data_feeder import dense_batch, lod_batch
@@ -84,14 +109,24 @@ class PyReader:
                 out[name] = dense_batch(slot, shape, np_dtype)
         return out
 
-    def _worker(self):
-        q = self._queue
+    def _worker(self, q, stop):
         try:
             for batch in self._creator():
-                q.put(self._convert_batch(batch))
+                if stop.is_set():
+                    return
+                item = self._convert_batch(batch)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
             q.put(self._end)
         except BaseException as e:  # surface reader errors to the consumer
-            q.put(e)
+            if not stop.is_set():
+                q.put(e)
 
     def _next_batch(self) -> dict:
         if self._queue is None:

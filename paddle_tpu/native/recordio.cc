@@ -2,11 +2,15 @@
 // (reference: recordio/ — header.{h,cc} magic+checksum+compressor+len,
 // chunk.{h,cc} record framing, writer.cc / scanner.cc APIs).
 //
-// TPU-native rebuild notes: same chunked layout (so shards stream
-// sequentially from disk/NFS at full bandwidth on TPU hosts), CRC32
-// integrity per chunk, no compressor (XLA hosts are CPU-rich, datasets
-// are pre-encoded; the reference's snappy mode is a format flag we
-// reserve but do not emit).
+// TPU-native rebuild notes: this is a NEW on-disk format, deliberately NOT
+// wire-compatible with the reference's (magic 0x0CDB0CDB here vs the
+// reference's kMagicNumber 0x01020304, and the header carries
+// num_records:u32 + payload_len:u64 instead of checksum/compressor/len
+// framing) — files written by the upstream framework cannot be read and
+// vice versa.  It keeps the reference's *design*: chunked sequential
+// layout (so shards stream from disk/NFS at full bandwidth on TPU hosts),
+// CRC32 integrity per chunk, a compressor field (0=plain is the only
+// value emitted; snappy is a reserved flag).
 //
 // On-disk format, little-endian:
 //   chunk := magic:u32 (0x0CDB0CDB) | crc32:u32 | compressor:u32 (0=plain)
@@ -115,6 +119,7 @@ void* rio_writer_open(const char* path, uint32_t max_chunk_records) {
 
 int rio_writer_write(void* handle, const uint8_t* data, uint64_t len) {
   Writer* w = static_cast<Writer*>(handle);
+  if (len > UINT32_MAX) return -1;  // rec_len frame is u32; refuse, don't truncate
   uint32_t rec_len = static_cast<uint32_t>(len);
   const uint8_t* p = reinterpret_cast<const uint8_t*>(&rec_len);
   w->payload.insert(w->payload.end(), p, p + 4);

@@ -381,11 +381,18 @@ def _merge_lod_infer(op, block):
 @register_op("merge_lod_tensor", infer_shape=_merge_lod_infer,
              diff_inputs=["InTrue", "InFalse"])
 def _merge_lod_tensor(ctx, ins, attrs):
-    t = data(ins["InTrue"][0])
-    f = data(ins["InFalse"][0])
+    tv, fv = ins["InTrue"][0], ins["InFalse"][0]
+    t, f = data(tv), data(fv)
     mask = data(ins["Mask"][0])
     mask = jnp.reshape(mask, (mask.shape[0],) + (1,) * (t.ndim - 1)) != 0
-    return {"Out": [jnp.where(mask, t, f)]}
+    out = jnp.where(mask, t, f)
+    # preserve sequence lengths (reference merge_lod_tensor_op sets the
+    # output LoD); under full-batch if-conversion both branches carry the
+    # same lengths, so adopt either side's
+    src = tv if isinstance(tv, LoDValue) else fv
+    if isinstance(src, LoDValue):
+        out = LoDValue(out, src.lengths)
+    return {"Out": [out]}
 
 
 # ---------------------------------------------------------------------------

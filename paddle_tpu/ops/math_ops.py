@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import amp
 from ..core.lod import LoDValue
 from ..core.proto import DataType, dtype_to_numpy
 from ..core.registry import register_op
@@ -55,7 +56,8 @@ def _mul(ctx, ins, attrs):
         xn += 1
     x2 = _flatten2(x, xn)
     y2 = _flatten2(y, yn)
-    out = x2 @ y2
+    x2c, y2c = amp.mxu_operands(x2, y2)
+    out = amp.mxu_output(jnp.matmul(x2c, y2c), x2, y2)
     out_shape = x.shape[:xn] + y.shape[yn:]
     return {"Out": [wrap_lod(xv, jnp.reshape(out, out_shape))]}
 
@@ -92,7 +94,8 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if attrs.get("transpose_Y", False) and y.ndim >= 2:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    xc, yc = amp.mxu_operands(x, y)
+    out = amp.mxu_output(jnp.matmul(xc, yc), x, y)
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

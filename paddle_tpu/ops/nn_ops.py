@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import amp
 from ..core.proto import DataType
 from ..core.registry import register_op
 from .common import data, in_desc, same_shape, set_output, wrap_lod
@@ -52,15 +53,16 @@ def _conv2d_lower(ctx, ins, attrs):
     paddings = attrs.get("paddings", [0, 0])
     dilations = attrs.get("dilations", [1, 1])
     groups = attrs.get("groups", 1) or 1
+    xc, fc = amp.mxu_operands(x, f)
     out = jax.lax.conv_general_dilated(
-        x, f,
+        xc, fc,
         window_strides=strides,
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
     )
-    return {"Output": [out]}
+    return {"Output": [amp.mxu_output(out, x, f)]}
 
 
 register_op("conv2d", infer_shape=_conv2d_infer, diff_inputs=["Input", "Filter"])(_conv2d_lower)
@@ -118,14 +120,15 @@ def _conv2d_transpose(ctx, ins, attrs):
     groups = attrs.get("groups", 1) or 1
 
     def one_group(xg, fg):
-        return jax.lax.conv_transpose(
-            xg, fg,
+        xgc, fgc = amp.mxu_operands(xg, fg)
+        return amp.mxu_output(jax.lax.conv_transpose(
+            xgc, fgc,
             strides=strides,
             padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
             rhs_dilation=dilations,
             dimension_numbers=("NCHW", "IOHW", "NCHW"),
             transpose_kernel=True,
-        )
+        ), xg, fg)
 
     if groups == 1:
         return {"Output": [one_group(x, f)]}
@@ -159,15 +162,16 @@ def _conv3d(ctx, ins, attrs):
     strides = attrs.get("strides", [1, 1, 1])
     paddings = attrs.get("paddings", [0, 0, 0])
     dilations = attrs.get("dilations", [1, 1, 1])
+    xc, fc = amp.mxu_operands(x, f)
     out = jax.lax.conv_general_dilated(
-        x, f,
+        xc, fc,
         window_strides=strides,
         padding=[(p, p) for p in paddings],
         rhs_dilation=dilations,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=attrs.get("groups", 1) or 1,
     )
-    return {"Output": [out]}
+    return {"Output": [amp.mxu_output(out, x, f)]}
 
 
 # -- pooling -----------------------------------------------------------------

@@ -165,14 +165,20 @@ class ParallelExecutor:
         feed_names = sorted(feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
-        key = (tuple(feed_names), tuple(fetch_names),
-               len(self.program.desc.block(0).ops))
+        # fingerprint-validated cache: an in-place desc mutation recompiles
+        # and replaces the stale entry (see core/executor.py for rationale)
+        from ..core import amp
+
+        fp = self.program.desc.fingerprint()
+        key = (tuple(feed_names), tuple(fetch_names), amp.state_key())
         entry = self._cache.get(key)
+        if entry is not None and entry[0] != fp:
+            entry = None
         if entry is None:
             plan = _RunPlan(self.program, feed_names, fetch_names)
-            entry = (self._compile(plan), plan)
+            entry = (fp, self._compile(plan), plan)
             self._cache[key] = entry
-        compiled, plan = entry
+        _, compiled, plan = entry
 
         block0 = self.program.desc.block(0)
         feed_vals = plan.feed_values(feed, block0)

@@ -4,6 +4,12 @@ python/paddle/fluid/recordio_writer.py).
 Backed by the native C++ library (paddle_tpu/native/recordio.cc, built on
 first use); a pure-Python codec of the same on-disk format serves as
 fallback and as the cross-check in tests.
+
+NOTE: the on-disk format is a NEW design (magic 0x0CDB0CDB, header
+num_records:u32 + payload_len:u64) and is NOT wire-compatible with the
+reference's recordio files (kMagicNumber 0x01020304, per-record
+checksum/compressor/len framing).  Files written by the upstream framework
+cannot be read here; convert via the upstream reader if needed.
 """
 
 from __future__ import annotations

@@ -76,6 +76,50 @@ def test_quantize_transpiler_inserts_and_trains():
     assert losses[-1] < losses[0] * 0.5  # STE gradients flow
 
 
+def test_qat_gradients_match_quantized_forward():
+    """The ADVICE round-1 finding: backward must differentiate the QUANTIZED
+    network.  Grad ops replay the forward op's vjp, which is traced after
+    training_transpile renamed the forward inputs — so W@GRAD must equal the
+    analytic gradient of the quantized forward (x_q^T g via the STE), and
+    must differ from the unquantized network's gradient at coarse bits."""
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+    x = layers.data("x", [8], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False, param_attr="qat_w")
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    # lr=0: the sgd op runs but leaves W unchanged, so the manual expectation
+    # below sees the same W the step used
+    fluid.optimizer.SGDOptimizer(learning_rate=0.0).minimize(loss)
+    QuantizeTranspiler(weight_bits=4, activation_bits=4).training_transpile()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(7)
+    xv = rng.randn(16, 8).astype("float32") * 3.0
+    yv = rng.randn(16, 1).astype("float32")
+    w = np.asarray(fluid.global_scope().find_var("qat_w"))
+
+    got = np.asarray(
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=["qat_w@GRAD"])[0]
+    )
+
+    def quant(v, bits):
+        bin_cnt = (1 << (bits - 1)) - 1
+        s = max(np.abs(v).max(), 1e-8)
+        return np.clip(np.round(v / s * bin_cnt), -bin_cnt, bin_cnt) * s / bin_cnt
+
+    xq, wq = quant(xv, 4), quant(w, 4)
+    g_out = 2.0 * (xq @ wq - yv) / yv.size
+    expected_quant = xq.T @ g_out
+    g_out_fp = 2.0 * (xv @ w - yv) / yv.size
+    expected_fp = xv.T @ g_out_fp
+
+    np.testing.assert_allclose(got, expected_quant, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(got, expected_fp, rtol=1e-3, atol=1e-4)
+
+
 def test_fake_quant_levels():
     # quantized output has at most 2^bits-1 distinct levels
     x = layers.data("x", [32], dtype="float32")
