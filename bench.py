@@ -78,6 +78,18 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         baseline = None  # no reference number exists (BASELINE.md)
         flops_per_item = _transformer_train_flops_per_token(cfg)
         lr = 1e-4
+    elif model == "deepfm":
+        bs = int(os.environ.get("BENCH_DEEPFM_BS", "512"))
+        vocab = int(os.environ.get("BENCH_DEEPFM_VOCAB", "1000000"))
+        spec = models.deepfm(num_fields=26, vocab_size=vocab, embed_dim=10)
+        unit = "examples/sec"
+        items_per_step = bs
+        metric = "deepfm_ctr_train_examples_per_sec_per_chip"
+        baseline = None  # no reference number exists (BASELINE.md)
+        # dominated by the DNN matmuls: fwd ~2*sum(in*out) per example
+        dnn_flops = 2 * (26 * 10 * 400 + 400 * 400 * 2 + 400)
+        flops_per_item = 3 * dnn_flops
+        lr = 1e-3
     elif model == "lenet":
         bs = int(os.environ.get("BENCH_BS", "64"))
         spec = models.lenet5()
@@ -89,11 +101,18 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         lr = 0.01
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
-                         "(expected resnet50|transformer|lenet)")
+                         "(expected resnet50|transformer|deepfm|lenet)")
 
-    fluid.optimizer.MomentumOptimizer(
-        learning_rate=lr, momentum=0.9
-    ).minimize(spec.loss)
+    if model == "deepfm":
+        # lazy sparse adam over the 1e6-row tables: only touched rows
+        # update, so the step never sweeps the vocab (the SelectedRows path)
+        fluid.optimizer.AdamOptimizer(
+            learning_rate=lr, lazy_mode=True
+        ).minimize(spec.loss)
+    else:
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=lr, momentum=0.9
+        ).minimize(spec.loss)
 
     place = fluid.TPUPlace()
     exe = fluid.Executor(place)
@@ -135,7 +154,9 @@ def main() -> None:
         fluid.enable_amp("bfloat16")
     peak_flops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    names = os.environ.get("BENCH_MODELS", "resnet50,transformer").split(",")
+    names = os.environ.get(
+        "BENCH_MODELS", "resnet50,transformer,deepfm"
+    ).split(",")
 
     names = [m.strip() for m in names if m.strip()]
     if not names:

@@ -231,6 +231,10 @@ class Executor:
 
     @staticmethod
     def _convert_fetch(val, var_desc, return_numpy: bool):
+        from .selected_rows import SelectedRowsValue
+
+        if isinstance(val, SelectedRowsValue):
+            return val.to_numpy() if return_numpy else val
         if isinstance(val, LoDValue):
             if return_numpy:
                 return LoDValue(np.asarray(val.data), np.asarray(val.lengths))

@@ -1,0 +1,102 @@
+"""SelectedRowsValue: sparse row-set gradients, the TPU-native equivalent of
+the reference's SelectedRows (paddle/fluid/framework/selected_rows.h:32).
+
+The reference's lookup_table emits SelectedRows grads
+(operators/lookup_table_op.cc:80) so a [V, D] embedding gradient is a small
+(ids, rows) pair, and sparse optimizer kernels update only the touched rows
+(operators/optimizers/adam_op.h:470).  XLA needs static shapes, so the
+TPU-native encoding is:
+
+  ids:  [N] int32 row indices — may contain duplicates, and the sentinel
+        value `height` (one past the last row) marks dead slots
+  rows: [N, D] row values (zeros in dead slots)
+
+N is the static number of looked-up ids in the batch; V never appears in
+any runtime buffer.  Dead/sentinel slots cooperate with XLA scatter/gather
+out-of-bounds modes: scatters use mode='drop' (sentinel updates vanish) and
+gathers use mode='fill' (sentinel reads produce zeros), so every consumer
+is branch-free and jit-stable.
+
+merge() deduplicates ids (the reference's merge_selected_rows /
+scatter::MergeAdd) with a sort + segment-sum; slots freed by merging become
+sentinel slots.  This is what makes per-row optimizer moment updates
+correct when a batch repeats an id.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRowsValue"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRowsValue:
+    __slots__ = ("ids", "rows", "height")
+
+    def __init__(self, ids, rows, height: int):
+        self.ids = ids
+        self.rows = rows
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.ids, self.rows), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        ids, rows = children
+        return cls(ids, rows, height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.rows.shape[1:])
+
+    def __repr__(self):
+        return (f"SelectedRowsValue(n={self.rows.shape[0]}, "
+                f"height={self.height}, dim={self.rows.shape[1:]})")
+
+    def to_dense(self):
+        """Materialize the full [height, D] gradient (scatter-add; duplicate
+        ids accumulate, sentinel slots drop)."""
+        out = jnp.zeros((self.height,) + tuple(self.rows.shape[1:]),
+                        dtype=self.rows.dtype)
+        return out.at[self.ids].add(self.rows, mode="drop")
+
+    def merge(self) -> "SelectedRowsValue":
+        """Sum rows with equal ids (reference: merge_selected_rows op /
+        math::scatter::MergeAdd).  Static-shape: the result still has N
+        slots; freed slots hold the sentinel id `height` with zero rows."""
+        ids, rows = self.ids, self.rows
+        n = ids.shape[0]
+        order = jnp.argsort(ids)
+        sid = jnp.take(ids, order)
+        srow = jnp.take(rows, order, axis=0)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), sid[1:] != sid[:-1]]
+        )
+        seg = jnp.cumsum(is_start) - 1  # [N] segment index per sorted slot
+        merged_rows = jax.ops.segment_sum(srow, seg, num_segments=n)
+        merged_ids = jnp.full((n,), self.height, dtype=ids.dtype)
+        # all slots of a segment write the segment's id to the same position
+        merged_ids = merged_ids.at[seg].set(sid)
+        return SelectedRowsValue(merged_ids, merged_rows, self.height)
+
+    def concat(self, other: "SelectedRowsValue") -> "SelectedRowsValue":
+        """Stack two sparse grads over the same table (the `sum` op's
+        sparse+sparse case — reference sum_op SelectedRows branch)."""
+        if self.height != other.height:
+            raise ValueError(
+                f"height mismatch {self.height} vs {other.height}"
+            )
+        return SelectedRowsValue(
+            jnp.concatenate([self.ids, other.ids]),
+            jnp.concatenate([self.rows, other.rows], axis=0),
+            self.height,
+        )
+
+    def to_numpy(self) -> "SelectedRowsValue":
+        return SelectedRowsValue(
+            np.asarray(self.ids), np.asarray(self.rows), self.height
+        )

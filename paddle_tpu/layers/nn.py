@@ -144,8 +144,12 @@ def embedding(
     name: Optional[str] = None,
 ):
     """Embedding lookup (reference: layers/nn.py embedding -> lookup_table).
-    is_sparse/is_distributed are accepted for API parity; on TPU the gradient
-    is a dense scatter-add and sharded tables go through paddle_tpu.parallel."""
+    is_sparse=True emits SelectedRows sparse gradients — (ids, rows) pairs
+    whose size is the batch's id count, never the vocab (matches
+    operators/lookup_table_op.cc:80).  sgd/adagrad apply them row-wise;
+    adam/momentum stay dense-equivalent by default (their moments decay
+    even at zero grad) and update only touched rows under
+    Adam(lazy_mode=True).  Sharded tables go through paddle_tpu.parallel."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name)
     w = helper.create_parameter(
         helper.param_attr, shape=list(size), dtype=dtype,

@@ -16,3 +16,4 @@ from .transformer import transformer, TransformerConfig  # noqa: F401
 from .stacked_lstm import stacked_dynamic_lstm  # noqa: F401
 from .machine_translation import machine_translation  # noqa: F401
 from .se_resnext import se_resnext  # noqa: F401
+from .deepfm import deepfm  # noqa: F401

@@ -85,8 +85,17 @@ def elemwise_shape(op: OpDesc, block):
 
 # -- runtime value helpers ---------------------------------------------------
 def data(x):
-    """Dense view of a runtime value (LoDValue -> padded data)."""
-    return x.data if isinstance(x, LoDValue) else x
+    """Dense view of a runtime value (LoDValue -> padded data,
+    SelectedRowsValue -> materialized dense grad).  Sparse-aware consumers
+    (optimizer ops, sum) check for SelectedRowsValue BEFORE calling this;
+    everything else (clip, regularizer, ...) gets a correct dense fallback."""
+    from ..core.selected_rows import SelectedRowsValue
+
+    if isinstance(x, LoDValue):
+        return x.data
+    if isinstance(x, SelectedRowsValue):
+        return x.to_dense()
+    return x
 
 
 def lengths(x):

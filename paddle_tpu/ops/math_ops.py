@@ -123,8 +123,18 @@ def _sum_infer(op, block):
 @register_op("sum", infer_shape=_sum_infer)
 def _sum(ctx, ins, attrs):
     """Add N tensors (reference: operators/sum_op.cc; also the grad
-    accumulator inserted by append_backward)."""
-    xs = [data(v) for v in ins["X"] if v is not None]
+    accumulator inserted by append_backward).  All-SelectedRows inputs stay
+    sparse (row concat, the reference sum_op SelectedRows branch); a mix of
+    sparse and dense densifies."""
+    from ..core.selected_rows import SelectedRowsValue
+
+    vals = [v for v in ins["X"] if v is not None]
+    if vals and all(isinstance(v, SelectedRowsValue) for v in vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out.concat(v)
+        return {"Out": [out]}
+    xs = [data(v) for v in vals]
     out = xs[0]
     for v in xs[1:]:
         out = out + v

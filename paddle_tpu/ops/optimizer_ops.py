@@ -14,7 +14,40 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRowsValue
 from .common import data, in_desc, set_output
+
+
+def _sparse_grad(ins, attrs=None, lazy_matters=False):
+    """The (merged) SelectedRowsValue grad, or None to take the dense path.
+    Sparse optimizer kernels in the reference live beside the dense ones
+    (e.g. operators/optimizers/adam_op.h:470 SparseAdamFunctor); here each
+    lowering branches on the runtime grad type.  merge() dedups repeated
+    ids so per-row moment updates apply exactly once per row.
+
+    For optimizers whose moments decay even at zero gradient (adam,
+    momentum), a row-wise update is only dense-equivalent in 'lazy mode'
+    (untouched rows frozen, TF LazyAdam-style).  The reference's sparse
+    functor sweeps every row, so dense-equivalence is the default: unless
+    attrs['lazy_mode'] is set, such optimizers densify the grad (data()
+    does the scatter) and take the ordinary path.  sgd/adagrad updates are
+    identically zero at zero grad, so they are always row-wise."""
+    g = ins["Grad"][0]
+    if not isinstance(g, SelectedRowsValue):
+        return None
+    if lazy_matters and not (attrs or {}).get("lazy_mode", False):
+        return None
+    return g.merge()
+
+
+def _row_update(table, ids, new_rows):
+    """Scatter whole rows; sentinel ids (== height) drop."""
+    return table.at[ids].set(new_rows, mode="drop")
+
+
+def _row_gather(table, ids):
+    """Gather rows; sentinel ids read zeros."""
+    return table.at[ids].get(mode="fill", fill_value=0)
 
 
 def _param_out_infer(op, block):
@@ -37,17 +70,36 @@ def _lr(ins):
 @_opt("sgd")
 def _sgd(ctx, ins, attrs):
     p = data(ins["Param"][0])
-    g = data(ins["Grad"][0])
-    return {"ParamOut": [p - _lr(ins) * g]}
+    g = ins["Grad"][0]
+    if isinstance(g, SelectedRowsValue):
+        # duplicates accumulate in the scatter-add, so no merge is needed
+        # (reference: sgd_op.h SelectedRows kernel)
+        return {"ParamOut": [p.at[g.ids].add(-_lr(ins) * g.rows, mode="drop")]}
+    return {"ParamOut": [p - _lr(ins) * data(g)]}
 
 
 @_opt("momentum")
 def _momentum(ctx, ins, attrs):
     p = data(ins["Param"][0])
-    g = data(ins["Grad"][0])
     v = data(ins["Velocity"][0])
     mu = attrs.get("mu", 0.9)
     lr = _lr(ins)
+    srv = _sparse_grad(ins, attrs, lazy_matters=True)
+    if srv is not None:
+        # lazy mode (opt-in): touched velocity/param rows only; untouched
+        # rows keep their velocity undecayed
+        gr = srv.rows
+        vr = _row_gather(v, srv.ids)
+        v_new_r = mu * vr + gr
+        if attrs.get("use_nesterov", False):
+            delta = (gr + mu * v_new_r) * lr
+        else:
+            delta = lr * v_new_r
+        return {
+            "ParamOut": [p.at[srv.ids].add(-delta, mode="drop")],
+            "VelocityOut": [_row_update(v, srv.ids, v_new_r)],
+        }
+    g = data(ins["Grad"][0])
     v_new = mu * v + g
     if attrs.get("use_nesterov", False):
         p_new = p - (g + mu * v_new) * lr
@@ -77,7 +129,6 @@ def _lars_momentum(ctx, ins, attrs):
 @_opt("adam")
 def _adam(ctx, ins, attrs):
     p = data(ins["Param"][0])
-    g = data(ins["Grad"][0])
     m = data(ins["Moment1"][0])
     v = data(ins["Moment2"][0])
     b1p = data(ins["Beta1Pow"][0])
@@ -86,9 +137,30 @@ def _adam(ctx, ins, attrs):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins)
+    lr_t = lr * jnp.sqrt(1 - jnp.reshape(b2p, ())) / (1 - jnp.reshape(b1p, ()))
+    srv = _sparse_grad(ins, attrs, lazy_matters=True)
+    if srv is not None:
+        # lazy sparse adam (opt-in via lazy_mode, TF LazyAdam semantics):
+        # moments/param update only on touched rows; beta pows still
+        # advance.  Without lazy_mode the grad densifies so untouched rows
+        # decay exactly like the dense path (reference adam_op.h:470
+        # SparseAdamFunctor sweeps every row)
+        gr = srv.rows
+        mr = _row_gather(m, srv.ids)
+        vr = _row_gather(v, srv.ids)
+        m_new_r = b1 * mr + (1 - b1) * gr
+        v_new_r = b2 * vr + (1 - b2) * gr * gr
+        delta = lr_t * m_new_r / (jnp.sqrt(v_new_r) + eps)
+        return {
+            "ParamOut": [p.at[srv.ids].add(-delta, mode="drop")],
+            "Moment1Out": [_row_update(m, srv.ids, m_new_r)],
+            "Moment2Out": [_row_update(v, srv.ids, v_new_r)],
+            "Beta1PowOut": [b1p * b1],
+            "Beta2PowOut": [b2p * b2],
+        }
+    g = data(ins["Grad"][0])
     m_new = b1 * m + (1 - b1) * g
     v_new = b2 * v + (1 - b2) * g * g
-    lr_t = lr * jnp.sqrt(1 - jnp.reshape(b2p, ())) / (1 - jnp.reshape(b1p, ()))
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     return {
         "ParamOut": [p_new],
@@ -119,10 +191,20 @@ def _adamax(ctx, ins, attrs):
 @_opt("adagrad")
 def _adagrad(ctx, ins, attrs):
     p = data(ins["Param"][0])
-    g = data(ins["Grad"][0])
     m = data(ins["Moment"][0])
     eps = attrs.get("epsilon", 1e-6)
     lr = _lr(ins)
+    srv = _sparse_grad(ins)
+    if srv is not None:
+        gr = srv.rows
+        mr = _row_gather(m, srv.ids)
+        m_new_r = mr + gr * gr
+        delta = lr * gr / (jnp.sqrt(m_new_r) + eps)
+        return {
+            "ParamOut": [p.at[srv.ids].add(-delta, mode="drop")],
+            "MomentOut": [_row_update(m, srv.ids, m_new_r)],
+        }
+    g = data(ins["Grad"][0])
     m_new = m + g * g
     return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)], "MomentOut": [m_new]}
 

@@ -15,6 +15,7 @@ import numpy as np
 from ..core.lod import LoDValue
 from ..core.proto import DataType, dtype_to_numpy
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRowsValue
 from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
 
 
@@ -593,10 +594,7 @@ def _lookup_infer(op, block):
 
 @register_op("lookup_table", infer_shape=_lookup_infer, diff_inputs=["W"])
 def _lookup_table(ctx, ins, attrs):
-    """Embedding lookup (reference: operators/lookup_table_op.cc).  The
-    reference emits SelectedRows sparse gradients for the pserver path; on
-    TPU the vjp produces a dense scatter-add which XLA lowers efficiently —
-    sharded tables use the all_to_all path in paddle_tpu.parallel."""
+    """Embedding lookup (reference: operators/lookup_table_op.cc)."""
     w = data(ins["W"][0])
     ids = data(ins["Ids"][0])
     squeeze_last = ids.ndim >= 1 and ids.shape[-1] == 1
@@ -608,6 +606,37 @@ def _lookup_table(ctx, ins, attrs):
         mask = (ids != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)
     return {"Out": [wrap_lod(ins["Ids"][0], out)]}
+
+
+@register_op("lookup_table_grad", no_grad=True)
+def _lookup_table_grad(ctx, ins, attrs):
+    """Custom grad rule for lookup_table (replaces the vjp replay).
+
+    The reference emits SelectedRows sparse grads
+    (operators/lookup_table_op.cc:80 + framework/selected_rows.h:32) so a
+    [V, D] table gradient is (ids, rows), not a dense table — essential at
+    CTR vocab sizes.  With is_sparse=True this returns a SelectedRowsValue
+    ([N] ids + [N, D] rows, V absent from every runtime buffer); sparse
+    optimizer lowerings (ops/optimizer_ops.py) then update only the touched
+    rows.  With is_sparse=False it scatter-adds into a dense table grad,
+    identical to the vjp of jnp.take."""
+    w_desc = ins["W"][0]
+    og = data(ins["Out@GRAD"][0])
+    ids = data(ins["Ids"][0])
+    if ids.ndim >= 1 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    height, dim = data(w_desc).shape
+    ids_flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    rows = jnp.reshape(og, (-1, dim))
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        # grads at the padding id are dropped, as in the forward mask;
+        # pointing them at the sentinel makes the scatter drop them
+        ids_flat = jnp.where(ids_flat == padding_idx, height, ids_flat)
+    srv = SelectedRowsValue(ids_flat, rows, height)
+    if attrs.get("is_sparse", False):
+        return {"W@GRAD": [srv]}
+    return {"W@GRAD": [srv.to_dense()]}
 
 
 @register_op("multiplex", infer_shape=lambda op, block: set_output(block, op, "Out", in_desc(op, block, "X").shape, in_desc(op, block, "X").dtype), diff_inputs=["X"])

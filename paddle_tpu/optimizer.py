@@ -292,6 +292,9 @@ class AdamOptimizer(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # lazy_mode: sparse grads update only touched rows (TF LazyAdam
+        # semantics); off by default for dense-equivalence
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -321,7 +324,7 @@ class AdamOptimizer(Optimizer):
                 "Beta1PowOut": [b1p], "Beta2PowOut": [b2p],
             },
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon},
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode},
         )
 
 
