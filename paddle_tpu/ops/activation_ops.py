@@ -18,7 +18,13 @@ def _unary(name, fn, extra_attrs=()):
     @register_op(name, infer_shape=same_shape())
     def _lower(ctx, ins, attrs, _fn=fn):
         x = ins["X"][0]
-        kw = {k: attrs[k] for k in extra_attrs if k in attrs}
+        # attr names that collide with python keywords ("lambda") map to a
+        # trailing-underscore parameter
+        kw = {
+            (k + "_" if k in ("lambda",) else k): attrs[k]
+            for k in extra_attrs
+            if k in attrs
+        }
         return {"Out": [wrap_lod(x, _fn(data(x), **kw))]}
 
     return _lower
@@ -28,7 +34,8 @@ _unary("sigmoid", jax.nn.sigmoid)
 _unary("logsigmoid", jax.nn.log_sigmoid)
 _unary("exp", jnp.exp)
 _unary("relu", jax.nn.relu)
-_unary("gelu", jax.nn.gelu)
+# exact erf form, matching the reference's gelu_op (not the tanh approx)
+_unary("gelu", lambda x: jax.nn.gelu(x, approximate=False))
 _unary("tanh", jnp.tanh)
 _unary("tanh_shrink", lambda x: x - jnp.tanh(x))
 _unary("sqrt", jnp.sqrt)

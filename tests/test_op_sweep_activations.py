@@ -1,0 +1,128 @@
+"""Per-op numeric sweep of the activation family (reference: the
+test_activation_op.py corpus driven by op_test.py; op macros at
+operators/activation_op.cc:478-520).  Each case checks the op output
+against an independently written numpy reference and its analytic gradient
+against central finite differences."""
+
+import math
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _np_erf(x):
+    return np.vectorize(math.erf)(x)
+
+
+def _rand(shape, lo=-2.0, hi=2.0, seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.uniform(lo, hi, size=shape)).astype("float32")
+
+
+def _away_from(x, points, eps=0.05):
+    """Nudge samples away from non-differentiable kinks."""
+    for p in points:
+        close = np.abs(x - p) < eps
+        x = np.where(close, p + np.sign(x - p + 1e-9) * eps * 2, x)
+    return x.astype("float32")
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+# op -> (input, attrs, numpy reference, check_grad?)
+CASES = {
+    "sigmoid": (_rand((3, 8)), {}, _np_sigmoid, True),
+    "logsigmoid": (_rand((3, 8)), {}, lambda x: np.log(_np_sigmoid(x)), True),
+    "exp": (_rand((3, 8)), {}, np.exp, True),
+    "relu": (_away_from(_rand((3, 8)), [0]), {}, lambda x: np.maximum(x, 0), True),
+    "gelu": (_rand((3, 8)), {},
+             lambda x: 0.5 * x * (1 + _np_erf(x / np.sqrt(2))), True),
+    "tanh": (_rand((3, 8)), {}, np.tanh, True),
+    "tanh_shrink": (_rand((3, 8)), {}, lambda x: x - np.tanh(x), True),
+    "sqrt": (_rand((3, 8), 0.5, 2.0), {}, np.sqrt, True),
+    "rsqrt": (_rand((3, 8), 0.5, 2.0), {}, lambda x: 1 / np.sqrt(x), True),
+    "abs": (_away_from(_rand((3, 8)), [0]), {}, np.abs, True),
+    "ceil": (_away_from(_rand((3, 8)), [-1, 0, 1]), {}, np.ceil, False),
+    "floor": (_away_from(_rand((3, 8)), [-1, 0, 1]), {}, np.floor, False),
+    "cos": (_rand((3, 8)), {}, np.cos, True),
+    "sin": (_rand((3, 8)), {}, np.sin, True),
+    "round": (_away_from(_rand((3, 8)), [-0.5, 0.5]), {}, np.round, False),
+    "reciprocal": (_rand((3, 8), 0.5, 2.0), {}, lambda x: 1 / x, True),
+    "log": (_rand((3, 8), 0.5, 2.0), {}, np.log, True),
+    "square": (_rand((3, 8)), {}, np.square, True),
+    "softplus": (_rand((3, 8)), {}, _np_softplus, True),
+    "softsign": (_rand((3, 8)), {}, lambda x: x / (1 + np.abs(x)), True),
+    "softshrink": (
+        _away_from(_rand((3, 8)), [-0.5, 0.5]), {"lambda": 0.5},
+        lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)),
+        True),
+    "hard_shrink": (
+        _away_from(_rand((3, 8)), [-0.5, 0.5]), {"threshold": 0.5},
+        lambda x: np.where(np.abs(x) > 0.5, x, 0.0), True),
+    "brelu": (
+        _away_from(_rand((3, 8), -3, 3), [-1.0, 2.0]),
+        {"t_min": -1.0, "t_max": 2.0},
+        lambda x: np.clip(x, -1.0, 2.0), True),
+    "leaky_relu": (
+        _away_from(_rand((3, 8)), [0]), {"alpha": 0.1},
+        lambda x: np.where(x >= 0, x, 0.1 * x), True),
+    "soft_relu": (
+        _rand((3, 8)), {"threshold": 40.0},
+        lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0))), True),
+    "elu": (
+        _away_from(_rand((3, 8)), [0]), {"alpha": 0.8},
+        lambda x: np.where(x > 0, x, 0.8 * (np.exp(x) - 1)), True),
+    "relu6": (
+        _away_from(_rand((3, 8), -2, 8), [0.0, 6.0]), {"threshold": 6.0},
+        lambda x: np.clip(x, 0, 6.0), True),
+    "pow": (_rand((3, 8), 0.3, 2.0), {"factor": 2.5},
+            lambda x: np.power(x, 2.5), True),
+    "stanh": (
+        _rand((3, 8)), {"scale_a": 0.67, "scale_b": 1.7159},
+        lambda x: 1.7159 * np.tanh(0.67 * x), True),
+    "hard_sigmoid": (
+        _away_from(_rand((3, 8), -4, 4), [-2.5, 2.5]),
+        {"slope": 0.2, "offset": 0.5},
+        lambda x: np.clip(0.2 * x + 0.5, 0, 1), True),
+    "swish": (_rand((3, 8)), {"beta": 1.5},
+              lambda x: x * _np_sigmoid(1.5 * x), True),
+    "thresholded_relu": (
+        _away_from(_rand((3, 8)), [1.0]), {"threshold": 1.0},
+        lambda x: np.where(x > 1.0, x, 0.0), True),
+    "silu": (_rand((3, 8)), {}, lambda x: x * _np_sigmoid(x), True),
+    "mish": (_rand((3, 8)), {},
+             lambda x: x * np.tanh(_np_softplus(x)), True),
+    "sign": (_away_from(_rand((3, 8)), [0]), {}, np.sign, False),
+    "tan": (_rand((3, 8), -1.0, 1.0), {}, np.tan, True),
+    "acos": (_rand((3, 8), -0.9, 0.9), {}, np.arccos, True),
+    "asin": (_rand((3, 8), -0.9, 0.9), {}, np.arcsin, True),
+    "atan": (_rand((3, 8)), {}, np.arctan, True),
+    "sinh": (_rand((3, 8)), {}, np.sinh, True),
+    "cosh": (_rand((3, 8)), {}, np.cosh, True),
+    "erf": (_rand((3, 8)), {}, _np_erf, True),
+}
+
+
+@pytest.mark.parametrize("op", sorted(CASES))
+def test_activation(op):
+    x, attrs, ref, do_grad = CASES[op]
+    want = None if ref is None else ref(x.astype(np.float64))
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = attrs
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    if do_grad:
+        t.check_grad(["X"], "Out", max_relative_error=0.01)

@@ -1,0 +1,124 @@
+"""Per-op sweep: elementwise/broadcast family (reference:
+test_elementwise_*_op.py over operators/elementwise/, REGISTER_ELEMWISE_OP
+macros) including the axis broadcast rule, plus compare/logical ops."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, lo=-2.0, hi=2.0, seed=3):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+ELEMENTWISE = {
+    "elementwise_add": (lambda x, y: x + y, (-2, 2), True),
+    "elementwise_sub": (lambda x, y: x - y, (-2, 2), True),
+    "elementwise_mul": (lambda x, y: x * y, (-2, 2), True),
+    "elementwise_div": (lambda x, y: x / y, (0.5, 2.0), True),
+    "elementwise_max": (np.maximum, (-2, 2), True),
+    "elementwise_min": (np.minimum, (-2, 2), True),
+    "elementwise_pow": (np.power, (0.5, 2.0), True),
+    "elementwise_mod": (np.fmod, (1.0, 5.0), False),
+    "elementwise_floordiv": (lambda x, y: np.floor_divide(x, y), (1.0, 5.0), False),
+}
+
+
+@pytest.mark.parametrize("op", sorted(ELEMENTWISE))
+def test_elementwise_same_shape(op):
+    ref, (lo, hi), do_grad = ELEMENTWISE[op]
+    x = _rand((3, 8), lo, hi, seed=1)
+    y = _rand((3, 8), lo, hi, seed=2)
+    if op in ("elementwise_max", "elementwise_min"):
+        # keep |x-y| away from 0 so the max/min subgradient is unambiguous
+        y = np.where(np.abs(x - y) < 0.1, y + 0.3, y).astype("float32")
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": ref(x.astype(np.float64), y.astype(np.float64)).astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    if do_grad:
+        t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("op", ["elementwise_add", "elementwise_mul"])
+def test_elementwise_broadcast_axis(op):
+    """Y broadcasts along `axis` (reference broadcast rule: Y's shape must
+    match a contiguous run of X's dims starting at axis)."""
+    ref = {"elementwise_add": lambda x, y: x + y,
+           "elementwise_mul": lambda x, y: x * y}[op]
+    x = _rand((2, 3, 4, 5), seed=4)
+    y = _rand((3, 4), seed=5)
+    want = ref(x, y.reshape(1, 3, 4, 1))
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": want}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+COMPARE = {
+    "equal": np.equal,
+    "not_equal": np.not_equal,
+    "less_than": np.less,
+    "less_equal": np.less_equal,
+    "greater_than": np.greater,
+    "greater_equal": np.greater_equal,
+}
+
+
+@pytest.mark.parametrize("op", sorted(COMPARE))
+def test_compare(op):
+    x = np.array([[1, 2, 3], [4, 5, 6]], dtype="float32")
+    y = np.array([[1, 3, 2], [4, 4, 7]], dtype="float32")
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": COMPARE[op](x, y)}
+    t.check_output()
+
+
+LOGICAL = {
+    "logical_and": np.logical_and,
+    "logical_or": np.logical_or,
+    "logical_xor": np.logical_xor,
+}
+
+
+@pytest.mark.parametrize("op", sorted(LOGICAL))
+def test_logical(op):
+    rng = np.random.RandomState(0)
+    x = rng.rand(3, 4) > 0.5
+    y = rng.rand(3, 4) > 0.5
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": LOGICAL[op](x, y)}
+    t.check_output()
+
+
+def test_logical_not():
+    x = np.random.RandomState(0).rand(3, 4) > 0.5
+
+    class T(OpTest):
+        op_type = "logical_not"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.outputs = {"Out": np.logical_not(x)}
+    t.check_output()

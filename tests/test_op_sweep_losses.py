@@ -1,0 +1,236 @@
+"""Per-op sweep: loss family (reference: test_cross_entropy_op.py,
+test_sigmoid_cross_entropy_with_logits_op.py, test_huber_loss_op.py, ... over
+operators/*_loss_op.cc and cross-entropy kernels)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _rand(shape, seed=0, lo=-2.0, hi=2.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+def test_cross_entropy_hard_label():
+    probs = _softmax(_rand((4, 6), seed=1)).astype("float32")
+    label = np.array([[1], [0], [5], [2]], dtype="int64")
+    want = -np.log(np.take_along_axis(probs.astype(np.float64), label, axis=1) + 1e-12)
+
+    class T(OpTest):
+        op_type = "cross_entropy"
+
+    t = T()
+    t.inputs = {"X": probs, "Label": label}
+    t.outputs = {"Y": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Y", max_relative_error=0.01)
+
+
+def test_cross_entropy_soft_label():
+    probs = _softmax(_rand((4, 6), seed=2)).astype("float32")
+    soft = _softmax(_rand((4, 6), seed=3)).astype("float32")
+    want = -(soft.astype(np.float64) * np.log(probs.astype(np.float64) + 1e-12)).sum(
+        axis=1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "cross_entropy"
+
+    t = T()
+    t.inputs = {"X": probs, "Label": soft}
+    t.attrs = {"soft_label": True}
+    t.outputs = {"Y": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_softmax_with_cross_entropy():
+    logits = _rand((5, 7), seed=4)
+    label = np.array([[0], [3], [6], [2], [2]], dtype="int64")
+    sm = _softmax(logits.astype(np.float64))
+    want = -np.log(np.take_along_axis(sm, label, axis=1))
+
+    class T(OpTest):
+        op_type = "softmax_with_cross_entropy"
+
+    t = T()
+    t.inputs = {"Logits": logits, "Label": label}
+    t.outputs = {"Softmax": sm.astype("float32"), "Loss": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Logits"], "Loss", max_relative_error=0.01)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = _rand((4, 5), seed=5)
+    label = np.random.RandomState(6).randint(0, 2, (4, 5)).astype("float32")
+    xd = x.astype(np.float64)
+    want = np.maximum(xd, 0) - xd * label + np.log1p(np.exp(-np.abs(xd)))
+
+    class T(OpTest):
+        op_type = "sigmoid_cross_entropy_with_logits"
+
+    t = T()
+    t.inputs = {"X": x, "Label": label}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_bpr_loss():
+    x = _rand((4, 6), seed=7)
+    label = np.array([[1], [0], [5], [2]], dtype="int64")
+    xd = x.astype(np.float64)
+    pos = np.take_along_axis(xd, label, axis=1)
+    want = np.mean(np.log1p(np.exp(xd - pos)), axis=1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "bpr_loss"
+
+    t = T()
+    t.inputs = {"X": x, "Label": label}
+    t.outputs = {"Y": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Y", max_relative_error=0.01)
+
+
+def test_hinge_loss():
+    logits = _rand((6, 1), seed=8)
+    labels = np.random.RandomState(9).randint(0, 2, (6, 1)).astype("float32")
+    want = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits.astype(np.float64))
+
+    class T(OpTest):
+        op_type = "hinge_loss"
+
+    t = T()
+    t.inputs = {"Logits": logits, "Labels": labels}
+    t.outputs = {"Loss": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_huber_loss():
+    x = _rand((8, 1), seed=10)
+    y = _rand((8, 1), seed=11)
+    delta = 0.8
+    r = (y - x).astype(np.float64)
+    want = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                    delta * (np.abs(r) - 0.5 * delta))
+
+    class T(OpTest):
+        op_type = "huber_loss"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"delta": delta}
+    t.outputs = {"Out": want.astype("float32"), "Residual": r.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_log_loss():
+    p = _rand((6, 1), seed=12, lo=0.1, hi=0.9)
+    label = np.random.RandomState(13).randint(0, 2, (6, 1)).astype("float32")
+    eps = 1e-4
+    pd = p.astype(np.float64)
+    want = -label * np.log(pd + eps) - (1 - label) * np.log(1 - pd + eps)
+
+    class T(OpTest):
+        op_type = "log_loss"
+
+    t = T()
+    t.inputs = {"Predicted": p, "Labels": label}
+    t.attrs = {"epsilon": eps}
+    t.outputs = {"Loss": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Predicted"], "Loss", max_relative_error=0.01)
+
+
+def test_rank_loss():
+    left = _rand((5, 1), seed=14)
+    right = _rand((5, 1), seed=15)
+    label = np.random.RandomState(16).randint(0, 2, (5, 1)).astype("float32")
+    d = (left - right).astype(np.float64)
+    want = np.log1p(np.exp(d)) - label * d
+
+    class T(OpTest):
+        op_type = "rank_loss"
+
+    t = T()
+    t.inputs = {"Left": left, "Right": right, "Label": label}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Left", "Right"], "Out", max_relative_error=0.01)
+
+
+def test_margin_rank_loss():
+    x1 = _rand((5, 1), seed=17)
+    x2 = _rand((5, 1), seed=18)
+    label = (np.random.RandomState(19).randint(0, 2, (5, 1)) * 2 - 1).astype("float32")
+    margin = 0.1
+    want = np.maximum(0.0, -label * (x1 - x2).astype(np.float64) + margin)
+
+    class T(OpTest):
+        op_type = "margin_rank_loss"
+
+    t = T()
+    t.inputs = {"X1": x1, "X2": x2, "Label": label}
+    t.attrs = {"margin": margin}
+    t.outputs = {"Out": want.astype("float32"),
+                 "Activated": (want > 0).astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_smooth_l1_loss():
+    x = _rand((4, 6), seed=20)
+    y = _rand((4, 6), seed=21)
+    sigma = 1.5
+    s2 = sigma * sigma
+    d = (x - y).astype(np.float64)
+    elem = np.where(np.abs(d) < 1.0 / s2, 0.5 * s2 * d * d,
+                    np.abs(d) - 0.5 / s2)
+    want = elem.sum(axis=1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "smooth_l1_loss"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"sigma": sigma}
+    t.outputs = {"Out": want.astype("float32"), "Diff": d.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_squared_l2_distance():
+    x = _rand((4, 6), seed=22)
+    y = _rand((4, 6), seed=23)
+    sub = (x - y).astype(np.float64)
+    want = (sub ** 2).sum(axis=1, keepdims=True)
+
+    class T(OpTest):
+        op_type = "squared_l2_distance"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": want.astype("float32"),
+                 "sub_result": sub.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_label_smooth():
+    x = _softmax(_rand((4, 5), seed=24)).astype("float32")
+    eps = 0.1
+    want = (1 - eps) * x.astype(np.float64) + eps / 5
+
+    class T(OpTest):
+        op_type = "label_smooth"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"epsilon": eps}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)

@@ -1,0 +1,216 @@
+"""Per-op sweep: normalization + clip family (reference:
+test_batch_norm_op.py, test_group_norm_op.py, test_norm_op.py,
+test_clip_op.py, test_l1_norm_op.py over operators/*norm*_op.cc)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=0, lo=-2.0, hi=2.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+def test_batch_norm_train():
+    x = _rand((4, 3, 5, 5), seed=1)
+    scale = _rand((3,), seed=2, lo=0.5, hi=1.5)
+    bias = _rand((3,), seed=3)
+    mean0 = np.zeros(3, "float32")
+    var0 = np.ones(3, "float32")
+    eps, momentum = 1e-5, 0.9
+
+    xd = x.astype(np.float64)
+    m = xd.mean(axis=(0, 2, 3))
+    v = xd.var(axis=(0, 2, 3))
+    y = (xd - m.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + eps)
+    y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+
+    class T(OpTest):
+        op_type = "batch_norm"
+
+    t = T()
+    t.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                "Mean": mean0, "Variance": var0}
+    t.attrs = {"epsilon": eps, "momentum": momentum}
+    t.outputs = {
+        "Y": y.astype("float32"),
+        "MeanOut": (momentum * mean0 + (1 - momentum) * m).astype("float32"),
+        "VarianceOut": (momentum * var0 + (1 - momentum) * v).astype("float32"),
+        "SavedMean": m.astype("float32"),
+        "SavedVariance": (1.0 / np.sqrt(v + eps)).astype("float32"),
+    }
+    t.check_output(atol=2e-4, rtol=2e-4)
+    # fp32 variance chain: the reference's test_batch_norm_op also runs at
+    # max_relative_error=0.05
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.05)
+
+
+def test_batch_norm_test_mode():
+    x = _rand((4, 3, 5, 5), seed=4)
+    scale = _rand((3,), seed=5, lo=0.5, hi=1.5)
+    bias = _rand((3,), seed=6)
+    mean = _rand((3,), seed=7, lo=-0.5, hi=0.5)
+    var = _rand((3,), seed=8, lo=0.5, hi=1.5)
+    eps = 1e-5
+    xd = x.astype(np.float64)
+    y = (xd - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1).astype(np.float64) + eps)
+    y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+
+    class T(OpTest):
+        op_type = "batch_norm"
+
+    t = T()
+    t.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                "Mean": mean, "Variance": var}
+    t.attrs = {"epsilon": eps, "is_test": True}
+    t.outputs = {"Y": y.astype("float32")}
+    t.check_output(atol=2e-4, rtol=2e-4)
+
+
+def test_layer_norm():
+    x = _rand((4, 3, 6), seed=9)
+    scale = _rand((18,), seed=10, lo=0.5, hi=1.5)
+    bias = _rand((18,), seed=11)
+    eps = 1e-5
+    xd = x.astype(np.float64).reshape(4, -1)
+    m = xd.mean(axis=1, keepdims=True)
+    v = xd.var(axis=1, keepdims=True)
+    y = ((xd - m) / np.sqrt(v + eps) * scale + bias).reshape(x.shape)
+
+    class T(OpTest):
+        op_type = "layer_norm"
+
+    t = T()
+    t.inputs = {"X": x, "Scale": scale, "Bias": bias}
+    t.attrs = {"begin_norm_axis": 1, "epsilon": eps}
+    t.outputs = {"Y": y.astype("float32"),
+                 "Mean": m.ravel().astype("float32"),
+                 "Variance": v.ravel().astype("float32")}
+    t.check_output(atol=2e-4, rtol=2e-4)
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+def test_group_norm():
+    x = _rand((2, 6, 4, 4), seed=12)
+    scale = _rand((6,), seed=13, lo=0.5, hi=1.5)
+    bias = _rand((6,), seed=14)
+    g, eps = 3, 1e-5
+    xd = x.astype(np.float64).reshape(2, g, 2, 4, 4)
+    m = xd.mean(axis=(2, 3, 4), keepdims=True)
+    v = xd.var(axis=(2, 3, 4), keepdims=True)
+    y = ((xd - m) / np.sqrt(v + eps)).reshape(x.shape)
+    y = y * scale.reshape(1, 6, 1, 1) + bias.reshape(1, 6, 1, 1)
+
+    class T(OpTest):
+        op_type = "group_norm"
+
+    t = T()
+    t.inputs = {"X": x, "Scale": scale, "Bias": bias}
+    t.attrs = {"groups": g, "epsilon": eps}
+    t.outputs = {"Y": y.astype("float32"),
+                 "Mean": m.reshape(2, g).astype("float32"),
+                 "Variance": v.reshape(2, g).astype("float32")}
+    t.check_output(atol=2e-4, rtol=2e-4)
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+def test_norm_l2_normalize():
+    x = _rand((3, 5), seed=15, lo=0.5, hi=2.0)
+    eps = 1e-10
+    xd = x.astype(np.float64)
+    n = np.sqrt((xd * xd).sum(axis=1, keepdims=True) + eps)
+
+    class T(OpTest):
+        op_type = "norm"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1, "epsilon": eps}
+    t.outputs = {"Out": (xd / n).astype("float32"), "Norm": n.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_lrn():
+    x = _rand((2, 8, 3, 3), seed=16, lo=0.1, hi=1.0)
+    n, k, alpha, beta = 5, 1.0, 1e-4, 0.75
+    xd = x.astype(np.float64)
+    sq = np.zeros_like(xd)
+    C = 8
+    for c in range(C):
+        lo = max(0, c - n // 2)
+        hi = min(C, c + n // 2 + 1)
+        sq[:, c] = (xd[:, lo:hi] ** 2).sum(axis=1)
+    want = xd / np.power(k + alpha * sq, beta)
+
+    class T(OpTest):
+        op_type = "lrn"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-4, rtol=2e-4)
+
+
+def test_clip():
+    x = _rand((4, 5), seed=17)
+    # keep away from the clip boundaries so the subgradient is unambiguous
+    x = np.where(np.abs(np.abs(x) - 1.0) < 0.05, x * 1.2, x).astype("float32")
+    want = np.clip(x, -1.0, 1.0)
+
+    class T(OpTest):
+        op_type = "clip"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"min": -1.0, "max": 1.0}
+    t.outputs = {"Out": want}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_clip_by_norm():
+    x = _rand((4, 5), seed=18)
+    max_norm = 1.0
+    nrm = np.sqrt((x.astype(np.float64) ** 2).sum())
+    want = x * (max_norm / max(nrm, max_norm))
+
+    class T(OpTest):
+        op_type = "clip_by_norm"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"max_norm": max_norm}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_l1_norm():
+    x = _rand((3, 4), seed=19)
+    x = np.where(np.abs(x) < 0.05, x + 0.2, x).astype("float32")
+
+    class T(OpTest):
+        op_type = "l1_norm"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.outputs = {"Out": np.array([np.abs(x).sum()], dtype="float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_squared_l2_norm():
+    x = _rand((3, 4), seed=20)
+
+    class T(OpTest):
+        op_type = "squared_l2_norm"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.outputs = {"Out": np.array([(x.astype(np.float64) ** 2).sum()],
+                                 dtype="float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)

@@ -1,0 +1,206 @@
+"""Per-op sweep: optimizer update math vs independent numpy references
+(reference: test_sgd_op.py, test_adam_op.py, ... over
+operators/optimizers/*_op.cc — optimizers are ops in the graph)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+P = _rand((4, 6), 1)
+G = _rand((4, 6), 2)
+LR = np.array([0.1], dtype="float32")
+
+
+def _run(op_type, inputs, attrs, outputs, atol=1e-5):
+    class T(OpTest):
+        pass
+
+    t = T()
+    T.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    t.check_output(atol=atol, rtol=1e-5)
+
+
+def test_sgd():
+    _run("sgd", {"Param": P, "Grad": G, "LearningRate": LR}, {},
+         {"ParamOut": P - 0.1 * G})
+
+
+def test_momentum():
+    v = _rand((4, 6), 3)
+    mu = 0.9
+    v_new = mu * v + G
+    _run("momentum",
+         {"Param": P, "Grad": G, "Velocity": v, "LearningRate": LR},
+         {"mu": mu},
+         {"ParamOut": P - 0.1 * v_new, "VelocityOut": v_new})
+
+
+def test_momentum_nesterov():
+    v = _rand((4, 6), 3)
+    mu = 0.9
+    v_new = mu * v + G
+    _run("momentum",
+         {"Param": P, "Grad": G, "Velocity": v, "LearningRate": LR},
+         {"mu": mu, "use_nesterov": True},
+         {"ParamOut": P - 0.1 * (G + mu * v_new), "VelocityOut": v_new})
+
+
+def test_adam():
+    m = _rand((4, 6), 4)
+    v = np.abs(_rand((4, 6), 5))
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1 ** 3], dtype="float32")
+    b2p = np.array([b2 ** 3], dtype="float32")
+    m_new = b1 * m + (1 - b1) * G
+    v_new = b2 * v + (1 - b2) * G * G
+    lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+    _run("adam",
+         {"Param": P, "Grad": G, "Moment1": m, "Moment2": v,
+          "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": LR},
+         {"beta1": b1, "beta2": b2, "epsilon": eps},
+         {"ParamOut": P - lr_t * m_new / (np.sqrt(v_new) + eps),
+          "Moment1Out": m_new, "Moment2Out": v_new,
+          "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2})
+
+
+def test_adamax():
+    m = _rand((4, 6), 6)
+    u = np.abs(_rand((4, 6), 7)) + 0.1
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.array([b1 ** 2], dtype="float32")
+    m_new = b1 * m + (1 - b1) * G
+    u_new = np.maximum(b2 * u, np.abs(G))
+    _run("adamax",
+         {"Param": P, "Grad": G, "Moment": m, "InfNorm": u,
+          "Beta1Pow": b1p, "LearningRate": LR},
+         {"beta1": b1, "beta2": b2, "epsilon": eps},
+         {"ParamOut": P - (0.1 / (1 - b1p)) * m_new / (u_new + eps),
+          "MomentOut": m_new, "InfNormOut": u_new})
+
+
+def test_adagrad():
+    m = np.abs(_rand((4, 6), 8))
+    eps = 1e-6
+    m_new = m + G * G
+    _run("adagrad",
+         {"Param": P, "Grad": G, "Moment": m, "LearningRate": LR},
+         {"epsilon": eps},
+         {"ParamOut": P - 0.1 * G / (np.sqrt(m_new) + eps), "MomentOut": m_new})
+
+
+def test_decayed_adagrad():
+    m = np.abs(_rand((4, 6), 9))
+    decay, eps = 0.95, 1e-6
+    m_new = decay * m + (1 - decay) * G * G
+    _run("decayed_adagrad",
+         {"Param": P, "Grad": G, "Moment": m, "LearningRate": LR},
+         {"decay": decay, "epsilon": eps},
+         {"ParamOut": P - 0.1 * G / (np.sqrt(m_new) + eps), "MomentOut": m_new})
+
+
+def test_proximal_adagrad():
+    m = np.abs(_rand((4, 6), 10)) + 0.1
+    l1, l2 = 0.01, 0.02
+    m_new = m + G * G
+    lr_t = 0.1 / np.sqrt(m_new)
+    prox = P - lr_t * G
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * l1, 0) / (1 + lr_t * l2)
+    _run("proximal_adagrad",
+         {"Param": P, "Grad": G, "Moment": m, "LearningRate": LR},
+         {"l1": l1, "l2": l2},
+         {"ParamOut": want, "MomentOut": m_new})
+
+
+def test_proximal_gd():
+    l1, l2 = 0.01, 0.02
+    prox = P - 0.1 * G
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    _run("proximal_gd",
+         {"Param": P, "Grad": G, "LearningRate": LR},
+         {"l1": l1, "l2": l2}, {"ParamOut": want})
+
+
+def test_adadelta():
+    asg = np.abs(_rand((4, 6), 11))
+    asu = np.abs(_rand((4, 6), 12))
+    rho, eps = 0.95, 1e-6
+    asg_new = rho * asg + (1 - rho) * G * G
+    update = -np.sqrt((asu + eps) / (asg_new + eps)) * G
+    asu_new = rho * asu + (1 - rho) * update * update
+    _run("adadelta",
+         {"Param": P, "Grad": G, "AvgSquaredGrad": asg,
+          "AvgSquaredUpdate": asu, "LearningRate": LR},
+         {"rho": rho, "epsilon": eps},
+         {"ParamOut": P + update, "AvgSquaredGradOut": asg_new,
+          "AvgSquaredUpdateOut": asu_new})
+
+
+def test_rmsprop():
+    ms = np.abs(_rand((4, 6), 13)) + 0.1
+    mom = _rand((4, 6), 14)
+    rho, eps, momentum = 0.9, 1e-10, 0.5
+    ms_new = rho * ms + (1 - rho) * G * G
+    mom_new = momentum * mom + 0.1 * G / np.sqrt(ms_new + eps)
+    _run("rmsprop",
+         {"Param": P, "Grad": G, "MeanSquare": ms, "Moment": mom,
+          "LearningRate": LR},
+         {"decay": rho, "epsilon": eps, "momentum": momentum},
+         {"ParamOut": P - mom_new, "MeanSquareOut": ms_new,
+          "MomentOut": mom_new}, atol=1e-4)
+
+
+def test_rmsprop_centered():
+    ms = np.abs(_rand((4, 6), 13)) + 0.5
+    mom = _rand((4, 6), 14)
+    mg = _rand((4, 6), 15) * 0.1
+    rho, eps, momentum = 0.9, 1e-10, 0.5
+    ms_new = rho * ms + (1 - rho) * G * G
+    mg_new = rho * mg + (1 - rho) * G
+    mom_new = momentum * mom + 0.1 * G / np.sqrt(ms_new - mg_new ** 2 + eps)
+    _run("rmsprop",
+         {"Param": P, "Grad": G, "MeanSquare": ms, "Moment": mom,
+          "MeanGrad": mg, "LearningRate": LR},
+         {"decay": rho, "epsilon": eps, "momentum": momentum, "centered": True},
+         {"ParamOut": P - mom_new, "MeanSquareOut": ms_new,
+          "MomentOut": mom_new, "MeanGradOut": mg_new}, atol=1e-4)
+
+
+def test_ftrl():
+    sq = np.abs(_rand((4, 6), 16)) + 0.1
+    lin = _rand((4, 6), 17)
+    l1, l2, power = 0.1, 0.2, -0.5
+    sq_new = sq + G * G
+    sigma = (sq_new ** 0.5 - sq ** 0.5) / 0.1
+    lin_new = lin + G - sigma * P
+    quad = sq_new ** 0.5 / 0.1 + 2 * l2
+    pre = np.sign(lin_new) * l1 - lin_new
+    want = np.where(np.abs(lin_new) > l1, pre / quad, np.zeros_like(P))
+    _run("ftrl",
+         {"Param": P, "Grad": G, "SquaredAccumulator": sq,
+          "LinearAccumulator": lin, "LearningRate": LR},
+         {"l1": l1, "l2": l2, "lr_power": power},
+         {"ParamOut": want, "SquaredAccumOut": sq_new,
+          "LinearAccumOut": lin_new}, atol=1e-4)
+
+
+def test_lars_momentum():
+    v = _rand((4, 6), 18)
+    mu, coeff, decay = 0.9, 1e-3, 5e-4
+    p_norm = np.sqrt((P.astype(np.float64) ** 2).sum())
+    g_norm = np.sqrt((G.astype(np.float64) ** 2).sum())
+    local_lr = 0.1 * coeff * p_norm / (g_norm + decay * p_norm + 1e-12)
+    v_new = mu * v + local_lr * (G + decay * P)
+    _run("lars_momentum",
+         {"Param": P, "Grad": G, "Velocity": v, "LearningRate": LR},
+         {"mu": mu, "lars_coeff": coeff, "lars_weight_decay": decay},
+         {"ParamOut": (P - v_new).astype("float32"),
+          "VelocityOut": v_new.astype("float32")}, atol=1e-4)

@@ -1,0 +1,153 @@
+"""Per-op sweep: reductions and ranking ops (reference: test_reduce_op.py,
+test_cumsum_op.py, test_top_k_op.py, test_argsort_op.py over
+operators/reduce_ops/ REGISTER_REDUCE_OP + cum_op + top_k_op)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=11, lo=0.5, hi=2.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+REDUCE = {
+    "reduce_sum": (np.sum, True),
+    "reduce_mean": (np.mean, True),
+    "reduce_max": (np.max, False),  # subgradient at ties
+    "reduce_min": (np.min, False),
+    "reduce_prod": (np.prod, True),
+}
+
+
+@pytest.mark.parametrize("op", sorted(REDUCE))
+@pytest.mark.parametrize("dim,keep_dim", [([1], False), ([0], True), ([0, 2], False)])
+def test_reduce(op, dim, keep_dim):
+    ref, do_grad = REDUCE[op]
+    x = _rand((2, 3, 4))
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"dim": dim, "keep_dim": keep_dim}
+    t.outputs = {"Out": ref(x.astype(np.float64), axis=tuple(dim),
+                            keepdims=keep_dim).astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    if do_grad:
+        t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("op,ref", [("reduce_all", np.all), ("reduce_any", np.any)])
+def test_reduce_bool(op, ref):
+    x = np.random.RandomState(1).rand(2, 3, 4) > 0.4
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"dim": [1], "keep_dim": False}
+    t.outputs = {"Out": ref(x, axis=1)}
+    t.check_output()
+
+
+def test_reduce_all_dims_to_scalar():
+    x = _rand((2, 3))
+
+    class T(OpTest):
+        op_type = "reduce_sum"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"dim": [], "reduce_all": True}
+    t.outputs = {"Out": np.array([x.sum()], dtype="float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_cumsum():
+    x = _rand((3, 5), lo=-1, hi=1)
+
+    class T(OpTest):
+        op_type = "cumsum"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": np.cumsum(x.astype(np.float64), axis=1).astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_cumsum_exclusive_reverse():
+    x = _rand((3, 5), lo=-1, hi=1, seed=12)
+    ref = np.cumsum(x[:, ::-1], axis=1)[:, ::-1] - x  # reverse exclusive
+
+    class T(OpTest):
+        op_type = "cumsum"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1, "exclusive": True, "reverse": True}
+    t.outputs = {"Out": ref.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_top_k():
+    x = _rand((3, 10), lo=-5, hi=5, seed=13)
+    k = 4
+    idx = np.argsort(-x, axis=1, kind="stable")[:, :k]
+    val = np.take_along_axis(x, idx, axis=1)
+
+    class T(OpTest):
+        op_type = "top_k"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"k": k}
+    t.outputs = {"Out": val, "Indices": idx.astype("int64")}
+    t.check_output()
+
+
+def test_argsort():
+    x = _rand((3, 6), lo=-5, hi=5, seed=14)
+    idx = np.argsort(x, axis=1, kind="stable")
+    val = np.take_along_axis(x, idx, axis=1)
+
+    class T(OpTest):
+        op_type = "argsort"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": val, "Indices": idx.astype("int64")}
+    t.check_output()
+
+
+@pytest.mark.parametrize("op,ref", [("arg_max", np.argmax), ("arg_min", np.argmin)])
+def test_arg_extreme(op, ref):
+    x = _rand((4, 7), lo=-5, hi=5, seed=15)
+
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": ref(x, axis=1).astype("int64")}
+    t.check_output()
+
+
+def test_logsumexp_full():
+    x = _rand((3, 4), lo=-2, hi=2, seed=16)
+
+    class T(OpTest):
+        op_type = "logsumexp"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.outputs = {"Out": np.array(
+        np.log(np.sum(np.exp(x.astype(np.float64)))), dtype="float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
