@@ -80,6 +80,12 @@ __all__ = [
     "elementwise_max",
     "elementwise_min",
     "elementwise_pow",
+    "cos_sim",
+    "selu",
+    "random_crop",
+    "hash",
+    "add_position_encoding",
+    "similarity_focus",
 ]
 
 
@@ -1124,3 +1130,86 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
                "ignored_tokens": ignored_tokens or []},
     )
     return out, seq_num
+
+
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (reference: layers/nn.py cos_sim over
+    operators/cos_sim_op.cc); Y may be [1, D] to broadcast."""
+    helper = LayerHelper("cos_sim", **locals())
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(
+        type="cos_sim", inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
+    )
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    """Scaled ELU (reference: layers/nn.py selu over operators/selu_op.cc)."""
+    helper = LayerHelper("selu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    if alpha is not None:
+        attrs["alpha"] = float(alpha)
+    helper.append_op(type="selu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """Random per-instance crop of the trailing dims to `shape`
+    (reference: layers/nn.py random_crop over operators/random_crop_op.h)."""
+    helper = LayerHelper("random_crop", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    outputs = {"Out": [out]}
+    if seed is not None:
+        inputs["Seed"] = [seed]
+        outputs["SeedOut"] = [
+            helper.create_variable_for_type_inference("int64")
+        ]
+    helper.append_op(type="random_crop", inputs=inputs, outputs=outputs,
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Hash int rows into [N, num_hash, 1] int64 buckets
+    (reference: layers/nn.py hash over operators/hash_op.h)."""
+    helper = LayerHelper("hash", input=input, name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="hash", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"num_hash": num_hash, "mod_by": hash_size},
+    )
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """alpha*x + beta*sinusoid(pos) (reference: layers/nn.py
+    add_position_encoding over operators/add_position_encoding_op.h)."""
+    helper = LayerHelper("add_position_encoding", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="add_position_encoding", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"alpha": float(alpha), "beta": float(beta)},
+    )
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus 0/1 mask (reference: layers/nn.py similarity_focus
+    over operators/similarity_focus_op.h)."""
+    helper = LayerHelper("similarity_focus", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="similarity_focus", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"axis": int(axis), "indexes": [int(i) for i in indexes]},
+    )
+    return out

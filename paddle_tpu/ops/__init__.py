@@ -17,6 +17,7 @@ from . import (  # noqa: F401
     loss_ops,
     math_ops,
     metric_ops,
+    misc_ops,
     nn_ops,
     optimizer_ops,
     quant_ops,

@@ -74,6 +74,10 @@ _unary("asin", jnp.arcsin)
 _unary("atan", jnp.arctan)
 _unary("sinh", jnp.sinh)
 _unary("cosh", jnp.cosh)
+# reference selu_op.cc defaults (Klambauer et al. 2017 constants)
+_unary("selu", lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+       scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)),
+       ("scale", "alpha"))
 
 
 @register_op("prelu", infer_shape=same_shape())

@@ -1,0 +1,328 @@
+"""Miscellaneous ops completing the reference forward-op inventory
+(reference: paddle/fluid/operators/ — cos_sim_op.cc, selu_op.cc,
+modified_huber_loss_op.cc, add_position_encoding_op.cc, conv_shift_op.cc,
+similarity_focus_op.cc, random_crop_op.cc, hash_op.cc, minus_op.cc,
+fill_op.cc).
+
+TPU-native notes: everything is a pure jnp lowering differentiated by
+jax.vjp — the reference's hand-written grad kernels (e.g.
+modified_huber_loss_op.h ModifiedHuberLossBackward) are free here.  The
+greedy row/column tagging of similarity_focus becomes a lax.scan over a
+statically-sorted order, like bipartite_match.  hash replaces xxhash with a
+splitmix64-style integer mix: same contract (deterministic 64-bit hash of
+the row, per-hash-index seed, mod mod_by), different bit pattern — files
+hashed by the reference C++ op are not reproduced bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, set_output, same_shape, wrap_lod
+
+
+# ---------------------------------------------------------------------------
+# cos_sim
+# ---------------------------------------------------------------------------
+def _cos_sim_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    y = in_desc(op, block, "Y")
+    set_output(block, op, "Out", [x.shape[0], 1], x.dtype,
+               lod_level=x.lod_level)
+    set_output(block, op, "XNorm", [x.shape[0], 1], x.dtype)
+    if y is not None:
+        set_output(block, op, "YNorm", [y.shape[0], 1], x.dtype)
+
+
+@register_op("cos_sim", infer_shape=_cos_sim_infer, diff_inputs=["X", "Y"])
+def _cos_sim(ctx, ins, attrs):
+    """Row-wise cosine similarity; Y is [N, D] or a broadcast [1, D]
+    (reference: operators/cos_sim_op.h CosSimFunctor)."""
+    x = data(ins["X"][0])
+    y = data(ins["Y"][0])
+    xf = x.reshape(x.shape[0], -1)
+    yf = y.reshape(y.shape[0], -1)
+    xn = jnp.sqrt(jnp.sum(xf * xf, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(yf * yf, axis=1, keepdims=True))
+    dot = jnp.sum(xf * yf, axis=1, keepdims=True)  # broadcasts [1,D] y
+    out = dot / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [wrap_lod(ins["X"][0], out)], "XNorm": [xn], "YNorm": [yn]}
+
+
+# ---------------------------------------------------------------------------
+# minus / fill
+# ---------------------------------------------------------------------------
+@register_op("minus", infer_shape=same_shape("X", "Out"),
+             diff_inputs=["X", "Y"])
+def _minus(ctx, ins, attrs):
+    """Out = X - Y (reference: operators/minus_op.cc)."""
+    x = ins["X"][0]
+    return {"Out": [wrap_lod(x, data(x) - data(ins["Y"][0]))]}
+
+
+def _fill_infer(op, block):
+    shape = op.attr("shape", [])
+    dtype = DataType(op.attr("dtype", DataType.FP32))
+    set_output(block, op, "Out", list(shape), dtype)
+
+
+@register_op("fill", infer_shape=_fill_infer, no_grad=True)
+def _fill(ctx, ins, attrs):
+    """Fill Out with the literal attr data (reference: operators/fill_op.cc
+    — the value list arrives as fp32 and is cast to `dtype`)."""
+    from ..core.proto import dtype_to_numpy
+
+    shape = [int(s) for s in attrs["shape"]]
+    dt = dtype_to_numpy(DataType(attrs.get("dtype", DataType.FP32)))
+    vals = np.asarray(attrs.get("value", []), dtype=np.float64)
+    return {"Out": [jnp.asarray(vals.reshape(shape).astype(dt))]}
+
+
+# ---------------------------------------------------------------------------
+# modified_huber_loss
+# ---------------------------------------------------------------------------
+def _mhl_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "IntermediateVal", x.shape, x.dtype)
+    set_output(block, op, "Out", x.shape, x.dtype)
+
+
+@register_op("modified_huber_loss", infer_shape=_mhl_infer, diff_inputs=["X"])
+def _modified_huber_loss(ctx, ins, attrs):
+    """Binary classification loss on labels {0,1}
+    (reference: operators/modified_huber_loss_op.h ModifiedHuberLossForward):
+        inter = (2y - 1) * x
+        loss  = -4*inter          if inter < -1
+                (1 - inter)^2     if -1 <= inter < 1
+                0                 otherwise
+    """
+    x = data(ins["X"][0])
+    y = data(ins["Y"][0]).astype(x.dtype)
+    inter = (2.0 * y - 1.0) * x
+    loss = jnp.where(
+        inter < -1.0, -4.0 * inter,
+        jnp.where(inter < 1.0, jnp.square(1.0 - inter), 0.0),
+    )
+    return {"IntermediateVal": [inter], "Out": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# selu lives in activation_ops (registered there to keep the family together)
+# ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# add_position_encoding
+# ---------------------------------------------------------------------------
+@register_op("add_position_encoding", infer_shape=same_shape("X", "Out"),
+             diff_inputs=["X"])
+def _add_position_encoding(ctx, ins, attrs):
+    """out = alpha*x + beta*sincos(pos) (reference:
+    operators/add_position_encoding_op.h).  X is [N, L, D] dense or a
+    1-level LoD [sumL, D]; the sinusoid table matches the reference exactly:
+    val(j, k) = j / 10000^(k / (half-1)), first half sin, second half cos.
+    Positions restart at 0 for every sequence (padded rows get whatever the
+    sinusoid says — they're masked downstream by the sequence lengths)."""
+    from ..core.lod import LoDValue
+
+    x = ins["X"][0]
+    xv = data(x)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if isinstance(x, LoDValue):
+        # padded [N, L, D]: every sequence starts at position 0 already
+        pass
+    D = xv.shape[-1]
+    L = xv.shape[-2]
+    half = D // 2
+    pos = jnp.arange(L, dtype=xv.dtype)[:, None]  # [L, 1]
+    k = jnp.arange(half, dtype=xv.dtype)[None, :]  # [1, half]
+    denom = 10000.0 ** (k / max(half - 1, 1))
+    val = pos / denom  # [L, half]
+    enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=-1)  # [L, D]
+    out = alpha * xv + beta * enc.astype(xv.dtype)
+    return {"Out": [wrap_lod(x, out)]}
+
+
+# ---------------------------------------------------------------------------
+# conv_shift
+# ---------------------------------------------------------------------------
+@register_op("conv_shift", infer_shape=same_shape("X", "Out"),
+             diff_inputs=["X", "Y"])
+def _conv_shift(ctx, ins, attrs):
+    """Circular convolution (reference: operators/conv_shift_op.cc):
+    Out[b, j] = sum_k X[b, (j + k - (N-1)/2) mod M] * Y[b, k], N odd, N<=M.
+    Lowered as a gather of the N shifted views of X — a [N, B, M] stack
+    contracted against Y, which XLA fuses into one pass."""
+    x = data(ins["X"][0])  # [B, M]
+    y = data(ins["Y"][0])  # [B, N]
+    M = x.shape[1]
+    N = y.shape[1]
+    half = (N - 1) // 2
+    shifted = jnp.stack(
+        [jnp.roll(x, shift=half - k, axis=1) for k in range(N)], axis=0
+    )  # [N, B, M]; roll(-s) aligns X[b, j+s] at j
+    out = jnp.einsum("nbm,bn->bm", shifted, y)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# similarity_focus
+# ---------------------------------------------------------------------------
+@register_op("similarity_focus", infer_shape=same_shape("X", "Out"),
+             no_grad=True)
+def _similarity_focus(ctx, ins, attrs):
+    """Similarity-focus mask (reference: operators/similarity_focus_op.h):
+    for each attr index along `axis`, sort that slice's positions by value
+    descending, greedily keep positions whose row AND column are both
+    untagged (until min(rows, cols) kept), and set the mask 1 at the kept
+    positions across the whole `axis` dimension.  The greedy tag loop is a
+    lax.scan over the statically-sorted order."""
+    x = data(ins["X"][0])  # [B, d1, d2, d3]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus: axis must be 1..3, got {axis}")
+    B = x.shape[0]
+
+    # move `axis` to the front: slice [B, other1, other2] per index
+    perm = [0, axis] + [i for i in (1, 2, 3) if i != axis]
+    xt = jnp.transpose(x, perm)  # [B, d_axis, R, C]
+    R, C = xt.shape[2], xt.shape[3]
+    limit = min(R, C)
+
+    def one_slice(sl):  # [R, C] -> 0/1 keep mask [R, C]
+        flat = sl.reshape(-1)
+        order = jnp.argsort(-flat)  # descending, static shape
+
+        def body(carry, idx):
+            rows, cols, kept, out = carry
+            r, c = idx // C, idx % C
+            take = (~rows[r]) & (~cols[c]) & (kept < limit)
+            rows = rows.at[r].set(rows[r] | take)
+            cols = cols.at[c].set(cols[c] | take)
+            out = jnp.where(take, out.at[r, c].set(1.0), out)
+            return (rows, cols, kept + take.astype(jnp.int32), out), None
+
+        init = (
+            jnp.zeros((R,), dtype=bool), jnp.zeros((C,), dtype=bool),
+            jnp.asarray(0, jnp.int32), jnp.zeros((R, C), dtype=x.dtype),
+        )
+        (_, _, _, out), _ = jax.lax.scan(body, init, order)
+        return out
+
+    masks = []
+    for idx in indexes:
+        masks.append(jax.vmap(one_slice)(xt[:, idx]))  # [B, R, C]
+    mask = masks[0]
+    for m in masks[1:]:
+        mask = jnp.maximum(mask, m)
+    # broadcast across the axis dim and undo the transpose
+    full = jnp.broadcast_to(mask[:, None], xt.shape)
+    inv = np.argsort(perm)
+    return {"Out": [jnp.transpose(full, inv)]}
+
+
+# ---------------------------------------------------------------------------
+# random_crop
+# ---------------------------------------------------------------------------
+def _random_crop_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    shape = [int(s) for s in op.attr("shape", [])]
+    batch_dims = list(x.shape[: len(x.shape) - len(shape)])
+    set_output(block, op, "Out", batch_dims + shape, x.dtype)
+    seed = in_desc(op, block, "Seed")
+    if seed is not None:
+        set_output(block, op, "SeedOut", list(seed.shape), seed.dtype)
+
+
+@register_op("random_crop", infer_shape=_random_crop_infer, no_grad=True,
+             random=True, stateful=True)
+def _random_crop(ctx, ins, attrs):
+    """Per-instance random crop of the trailing dims to attr `shape`
+    (reference: operators/random_crop_op.h RandomCropFunctor).  Offsets come
+    from the program PRNG stream folded with the Seed input, and SeedOut
+    carries a successor seed — same contract as the reference's engine
+    discard, different bit stream."""
+    x = data(ins["X"][0])
+    crop_shape = [int(s) for s in attrs["shape"]]
+    n_inst = len(crop_shape)
+    batch_shape = x.shape[: x.ndim - n_inst]
+    inst_shape = x.shape[x.ndim - n_inst:]
+
+    seed_in = ins.get("Seed", [None])[0]
+    key = ctx.rng()
+    if seed_in is not None:
+        key = jax.random.fold_in(key, jnp.asarray(seed_in).reshape(-1)[0].astype(jnp.int32))
+
+    nb = 1
+    for d in batch_shape:
+        nb *= d
+    xf = x.reshape((nb,) + tuple(inst_shape))
+    maxoff = jnp.asarray(
+        [inst_shape[i] - crop_shape[i] for i in range(n_inst)], jnp.int32
+    )
+    offs = jax.random.randint(
+        key, (nb, n_inst), 0, jnp.maximum(maxoff, 0) + 1, dtype=jnp.int32
+    )
+
+    def one(inst, off):
+        return jax.lax.dynamic_slice(inst, tuple(off), tuple(crop_shape))
+
+    out = jax.vmap(one)(xf, offs).reshape(tuple(batch_shape) + tuple(crop_shape))
+    res = {"Out": [out]}
+    if seed_in is not None:
+        res["SeedOut"] = [jnp.asarray(seed_in).reshape(-1)[:1] + 1]
+    return res
+
+
+# ---------------------------------------------------------------------------
+# hash
+# ---------------------------------------------------------------------------
+def _hash_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    num_hash = op.attr("num_hash", 1)
+    set_output(block, op, "Out", [x.shape[0], num_hash, 1], DataType.INT64,
+               lod_level=x.lod_level)
+
+
+@register_op("hash", infer_shape=_hash_infer, no_grad=True)
+def _hash(ctx, ins, attrs):
+    """Row hashing for sparse features (reference: operators/hash_op.h —
+    XXH64(row_bytes, seed=ihash) % mod_by).  Here: a splitmix64-style mix of
+    the row's ids folded with the hash index; deterministic and well-mixed
+    but not xxhash-bit-compatible (documented in the module docstring)."""
+    x = ins["X"][0]
+    xv = data(x)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    rows = xv.reshape(xv.shape[0], -1).astype(jnp.uint32)
+
+    def mix64(h, v):
+        h = (h ^ (v + jnp.uint32(0x9E3779B9))) * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        return h * jnp.uint32(0xC2B2AE35)
+
+    outs = []
+    for ih in range(num_hash):
+        h = jnp.full(
+            (rows.shape[0],), jnp.uint32((ih * 2654435761 + 1) % (1 << 32))
+        )
+        for j in range(rows.shape[1]):
+            h = mix64(h, rows[:, j])
+        h = h ^ (h >> 16)
+        outs.append((h.astype(jnp.int64) % mod_by))
+    out = jnp.stack(outs, axis=1)[..., None]  # [N, num_hash, 1]
+    return {"Out": [wrap_lod(x, out)]}

@@ -1,0 +1,246 @@
+"""Per-op sweep: misc family (reference: test_cos_sim_op.py, test_selu_op.py,
+test_modified_huber_loss_op.py, test_add_position_encoding_op.py,
+test_conv_shift_op.py, test_similarity_focus_op.py, test_random_crop_op.py,
+test_hash_op.py, test_minus_op.py, test_fill_op.py over the matching
+operators/*.cc)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _rand(shape, seed=0, lo=-2.0, hi=2.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+def test_cos_sim():
+    x = _rand((5, 8), seed=1)
+    y = _rand((5, 8), seed=2)
+    xd, yd = x.astype(np.float64), y.astype(np.float64)
+    xn = np.sqrt((xd * xd).sum(1, keepdims=True))
+    yn = np.sqrt((yd * yd).sum(1, keepdims=True))
+    want = (xd * yd).sum(1, keepdims=True) / (xn * yn)
+
+    class T(OpTest):
+        op_type = "cos_sim"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": want.astype("float32"), "XNorm": xn.astype("float32"),
+                 "YNorm": yn.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_cos_sim_broadcast_y():
+    x = _rand((6, 4), seed=3)
+    y = _rand((1, 4), seed=4)
+    xd, yd = x.astype(np.float64), y.astype(np.float64)
+    xn = np.sqrt((xd * xd).sum(1, keepdims=True))
+    yn = np.sqrt((yd * yd).sum(1, keepdims=True))
+    want = (xd * yd).sum(1, keepdims=True) / (xn * yn)
+
+    class T(OpTest):
+        op_type = "cos_sim"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": want.astype("float32"), "XNorm": xn.astype("float32"),
+                 "YNorm": yn.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_minus():
+    x, y = _rand((3, 4), seed=5), _rand((3, 4), seed=6)
+
+    class T(OpTest):
+        op_type = "minus"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": x - y}
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_fill():
+    vals = list(range(6))
+
+    class T(OpTest):
+        op_type = "fill"
+
+    t = T()
+    t.inputs = {}
+    t.attrs = {"shape": [2, 3], "value": [float(v) for v in vals],
+               "dtype": int(fluid.core.DataType.INT32)}
+    t.outputs = {"Out": np.arange(6, dtype="int32").reshape(2, 3)}
+    t.check_output()
+
+
+def test_selu():
+    x = _rand((4, 5), seed=7)
+    x = np.where(np.abs(x) < 0.05, 0.5, x).astype("float32")  # avoid the kink
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    xd = x.astype(np.float64)
+    want = scale * np.where(xd > 0, xd, alpha * (np.exp(xd) - 1.0))
+
+    class T(OpTest):
+        op_type = "selu"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_modified_huber_loss():
+    x = _rand((8, 1), seed=8)
+    y = np.random.RandomState(9).randint(0, 2, (8, 1)).astype("float32")
+    inter = (2.0 * y - 1.0) * x
+    want = np.where(inter < -1.0, -4.0 * inter,
+                    np.where(inter < 1.0, (1.0 - inter) ** 2, 0.0))
+
+    class T(OpTest):
+        op_type = "modified_huber_loss"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"IntermediateVal": inter, "Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_add_position_encoding():
+    n, l, d = 2, 5, 8
+    x = _rand((n, l, d), seed=10)
+    alpha, beta = 0.7, 1.3
+    half = d // 2
+    pos = np.arange(l, dtype=np.float64)[:, None]
+    k = np.arange(half, dtype=np.float64)[None, :]
+    val = pos / np.power(10000.0, k / (half - 1))
+    enc = np.concatenate([np.sin(val), np.cos(val)], axis=-1)
+    want = alpha * x.astype(np.float64) + beta * enc[None]
+
+    class T(OpTest):
+        op_type = "add_position_encoding"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"alpha": alpha, "beta": beta}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_conv_shift():
+    b, m, n = 3, 7, 3
+    x = _rand((b, m), seed=11)
+    y = _rand((b, n), seed=12)
+    half = (n - 1) // 2
+    want = np.zeros((b, m), dtype=np.float64)
+    for i in range(b):
+        for j in range(m):
+            for k in range(n):
+                want[i, j] += x[i, (j + k - half) % m] * y[i, k]
+
+    class T(OpTest):
+        op_type = "conv_shift"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.outputs = {"Out": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def _similarity_focus_ref(x, axis, indexes):
+    """Direct port of the reference greedy algorithm (similarity_focus_op.h)."""
+    out = np.zeros_like(x)
+    b, d1, d2, d3 = x.shape
+    for i in range(b):
+        for index in indexes:
+            if axis == 1:
+                sl = x[i, index]  # [d2, d3]
+                order = np.argsort(-sl.ravel(), kind="stable")
+                tag2 = np.zeros(d2, bool)
+                tag3 = np.zeros(d3, bool)
+                cnt = 0
+                for flat in order:
+                    r, c = flat // d3, flat % d3
+                    if tag2[r] or tag3[c]:
+                        continue
+                    tag2[r] = tag3[c] = True
+                    out[i, :, r, c] = 1
+                    cnt += 1
+                    if cnt == min(d2, d3):
+                        break
+    return out
+
+
+def test_similarity_focus():
+    x = _rand((2, 3, 4, 5), seed=13, lo=0.0, hi=1.0)
+    want = _similarity_focus_ref(x, 1, [0, 2])
+
+    class T(OpTest):
+        op_type = "similarity_focus"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"axis": 1, "indexes": [0, 2]}
+    t.outputs = {"Out": want}
+    t.check_output()
+
+
+def test_random_crop():
+    x = _rand((4, 3, 10, 10), seed=14)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name="x", shape=[3, 10, 10], dtype="float32")
+        out = fluid.layers.random_crop(xv, shape=[3, 6, 6])
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(program=prog, feed={"x": x}, fetch_list=[out])
+    assert got.shape == (4, 3, 6, 6)
+    # every cropped instance must be a contiguous window of the input
+    for i in range(4):
+        found = False
+        for oy in range(5):
+            for ox in range(5):
+                if np.array_equal(got[i], x[i, :, oy:oy + 6, ox:ox + 6]):
+                    found = True
+        assert found, f"instance {i} is not a window of the input"
+
+
+def test_hash():
+    ids = np.random.RandomState(15).randint(0, 100, (6, 2)).astype("int64")
+
+    class T(OpTest):
+        op_type = "hash"
+
+    t = T()
+    t.inputs = {"X": ids}
+    t.attrs = {"num_hash": 4, "mod_by": 10000}
+    t.outputs = {"Out": np.zeros((6, 4, 1), dtype="int64")}  # shape only
+    prog, startup, feed, _, out_names = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.program_guard(prog, startup):
+        (got,) = exe.run(program=prog, feed=feed,
+                         fetch_list=[out_names["Out"][0]])
+    assert got.shape == (6, 4, 1)
+    assert got.min() >= 0 and got.max() < 10000
+    # deterministic
+    with fluid.program_guard(prog, startup):
+        (again,) = exe.run(program=prog, feed=feed,
+                           fetch_list=[out_names["Out"][0]])
+    np.testing.assert_array_equal(got, again)
+    # equal rows hash equal, different rows (whp) differ
+    ids2 = ids.copy()
+    ids2[0] = ids[1]
+    feed2 = dict(feed)
+    feed2[list(feed)[0]] = ids2
+    with fluid.program_guard(prog, startup):
+        (got2,) = exe.run(program=prog, feed=feed2,
+                          fetch_list=[out_names["Out"][0]])
+    np.testing.assert_array_equal(got2[0], got2[1])
