@@ -86,6 +86,11 @@ __all__ = [
     "hash",
     "add_position_encoding",
     "similarity_focus",
+    "adaptive_pool2d",
+    "adaptive_pool3d",
+    "conv3d_transpose",
+    "unpool",
+    "spp",
 ]
 
 
@@ -1211,5 +1216,131 @@ def similarity_focus(input, axis, indexes, name=None):
         type="similarity_focus", inputs={"X": [input]},
         outputs={"Out": [out]},
         attrs={"axis": int(axis), "indexes": [int(i) for i in indexes]},
+    )
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Adaptive pooling to a fixed output grid (reference: layers/nn.py
+    adaptive_pool2d over pool_op.cc's `adaptive` attr; require_index=True
+    uses max_pool2d_with_index and also returns the argmax mask)."""
+    helper = LayerHelper("adaptive_pool2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {
+        "pooling_type": pool_type,
+        "ksize": _pair(pool_size),
+        "adaptive": True,
+    }
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index needs pool_type='max'")
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op(
+            type="max_pool2d_with_index", inputs={"X": [input]},
+            outputs={"Out": [out], "Mask": [mask]}, attrs=attrs,
+        )
+        return out, mask
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """3-D adaptive pooling (see adaptive_pool2d)."""
+    helper = LayerHelper("adaptive_pool3d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {
+        "pooling_type": pool_type,
+        "ksize": _pair(pool_size, 3),
+        "adaptive": True,
+    }
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index needs pool_type='max'")
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op(
+            type="max_pool3d_with_index", inputs={"X": [input]},
+            outputs={"Out": [out], "Mask": [mask]}, attrs=attrs,
+        )
+        return out, mask
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """3-D transposed convolution (reference: layers/nn.py conv3d_transpose
+    over conv_transpose_op.cc:358)."""
+    helper = LayerHelper("conv3d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr, act=act,
+                         name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("filter_size or output_size required")
+        output_size = _pair(output_size, 3)
+        filter_size = [
+            output_size[i] - (input.shape[i + 2] - 1) * stride[i]
+            + 2 * padding[i]
+            for i in range(3)
+        ]
+    else:
+        filter_size = _pair(filter_size, 3)
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[num_channels, num_filters // groups] + filter_size,
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups},
+    )
+    pre_act = out
+    if helper.bias_attr is not None:
+        b = helper.create_parameter(helper.bias_attr, shape=[num_filters],
+                                    dtype=dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [pre_act]}, attrs={"axis": 1})
+    return helper.append_activation(pre_act)
+
+
+def unpool(input, indices, ksize, strides=1, paddings=0, name=None):
+    """Max-unpooling with indices from adaptive_pool2d(require_index=True) or
+    max_pool2d_with_index (reference: operators/unpool_op.cc)."""
+    helper = LayerHelper("unpool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="unpool",
+        inputs={"X": [input], "Indices": [indices]},
+        outputs={"Out": [out]},
+        attrs={"unpooling_type": "max", "ksize": _pair(ksize),
+               "strides": _pair(strides), "paddings": _pair(paddings)},
+    )
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    """Spatial pyramid pooling (reference: operators/spp_op.cc)."""
+    helper = LayerHelper("spp", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="spp", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pyramid_height": int(pyramid_height),
+               "pooling_type": pool_type},
     )
     return out

@@ -108,33 +108,54 @@ def _conv2d_transpose_infer(op, block):
     )
 
 
+def _conv_transpose_lower(x, f, strides, paddings, dilations, groups, nd):
+    """Transposed conv as the classic fractionally-strided conv:
+    lhs_dilation=strides, per-dim padding d*(k-1)-p, spatially-flipped
+    kernel.  Matches the reference scatter semantics exactly for every
+    (stride, pad, dilation) combination — verified against a direct scatter
+    reference (jax.lax.conv_transpose's own padding convention differs from
+    the reference's output-size formula (in-1)*s - 2p + d*(k-1) + 1).
+    Paddle filter layout [in_c, out_c/g, k...] is spec I-O-spatial."""
+    spatial = tuple(range(2, 2 + nd))
+    k = f.shape[2:]
+    pads = [
+        (dilations[i] * (k[i] - 1) - paddings[i],) * 2 for i in range(nd)
+    ]
+    spec = ("NC" + "DHW"[-nd:], "IO" + "DHW"[-nd:], "NC" + "DHW"[-nd:])
+
+    def one_group(xg, fg):
+        xgc, fgc = amp.mxu_operands(xg, jnp.flip(fg, spatial))
+        return amp.mxu_output(jax.lax.conv_general_dilated(
+            xgc, fgc,
+            window_strides=(1,) * nd,
+            padding=pads,
+            lhs_dilation=strides,
+            rhs_dilation=dilations,
+            dimension_numbers=spec,
+        ), xg, fg)
+
+    if groups == 1:
+        return one_group(x, f)
+    xs = jnp.split(x, groups, axis=1)
+    fs = jnp.split(f, groups, axis=0)
+    return jnp.concatenate(
+        [one_group(xg, fg) for xg, fg in zip(xs, fs)], axis=1
+    )
+
+
 @register_op("conv2d_transpose", infer_shape=_conv2d_transpose_infer, diff_inputs=["Input", "Filter"])
 def _conv2d_transpose(ctx, ins, attrs):
     """Gradient-of-conv as a forward op (reference:
     operators/conv_transpose_op.cc).  Filter layout [in_c, out_c/g, kh, kw]."""
     x = data(ins["Input"][0])
     f = data(ins["Filter"][0])
-    strides = attrs.get("strides", [1, 1])
-    paddings = attrs.get("paddings", [0, 0])
-    dilations = attrs.get("dilations", [1, 1])
-    groups = attrs.get("groups", 1) or 1
-
-    def one_group(xg, fg):
-        xgc, fgc = amp.mxu_operands(xg, fg)
-        return amp.mxu_output(jax.lax.conv_transpose(
-            xgc, fgc,
-            strides=strides,
-            padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
-            rhs_dilation=dilations,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
-            transpose_kernel=True,
-        ), xg, fg)
-
-    if groups == 1:
-        return {"Output": [one_group(x, f)]}
-    xs = jnp.split(x, groups, axis=1)
-    fs = jnp.split(f, groups, axis=0)
-    out = jnp.concatenate([one_group(xg, fg) for xg, fg in zip(xs, fs)], axis=1)
+    out = _conv_transpose_lower(
+        x, f,
+        [int(s) for s in attrs.get("strides", [1, 1])],
+        [int(p) for p in attrs.get("paddings", [0, 0])],
+        [int(d) for d in attrs.get("dilations", [1, 1])],
+        attrs.get("groups", 1) or 1, 2,
+    )
     return {"Output": [out]}
 
 
@@ -192,6 +213,10 @@ def _pool2d_infer(op, block):
     if op.attr("global_pooling", False):
         set_output(block, op, "Out", [n, c, 1, 1], x.dtype)
         return
+    if op.attr("adaptive", False):
+        k = op.attr("ksize", [1, 1])
+        set_output(block, op, "Out", [n, c, k[0], k[1]], x.dtype)
+        return
     k = op.attr("ksize", [1, 1])
     s = op.attr("strides", [1, 1])
     p = op.attr("paddings", [0, 0])
@@ -235,6 +260,8 @@ def _pool2d(ctx, ins, attrs):
         else:
             out = jnp.mean(x, axis=(2, 3), keepdims=True)
         return {"Out": [out]}
+    if attrs.get("adaptive", False):
+        return _pool2d_adaptive(ctx, ins, attrs)
     out = _pool(
         x, attrs.get("ksize", [1, 1]), attrs.get("strides", [1, 1]),
         attrs.get("paddings", [0, 0]), attrs.get("pooling_type", "max"),
@@ -251,6 +278,10 @@ def _pool3d_infer(op, block):
     if op.attr("global_pooling", False):
         set_output(block, op, "Out", [n, c, 1, 1, 1], x.dtype)
         return
+    if op.attr("adaptive", False):
+        k = op.attr("ksize", [1, 1, 1])
+        set_output(block, op, "Out", [n, c, k[0], k[1], k[2]], x.dtype)
+        return
     k = op.attr("ksize", [1, 1, 1])
     s = op.attr("strides", [1, 1, 1])
     p = op.attr("paddings", [0, 0, 0])
@@ -265,6 +296,8 @@ def _pool3d(ctx, ins, attrs):
     if attrs.get("global_pooling", False):
         fn = jnp.max if attrs.get("pooling_type", "max") == "max" else jnp.mean
         return {"Out": [fn(x, axis=(2, 3, 4), keepdims=True)]}
+    if attrs.get("adaptive", False):
+        return _pool3d_adaptive(ctx, ins, attrs)
     out = _pool(
         x, attrs.get("ksize", [1, 1, 1]), attrs.get("strides", [1, 1, 1]),
         attrs.get("paddings", [0, 0, 0]), attrs.get("pooling_type", "max"),
@@ -518,3 +551,322 @@ def _bilinear_interp(ctx, ins, attrs):
 @register_op("nearest_interp", infer_shape=_interp_infer, diff_inputs=["X"])
 def _nearest_interp(ctx, ins, attrs):
     return _interp(ctx, ins, attrs, "nearest")
+
+
+# -- pooling variants (indexed / adaptive / unpool / spp) --------------------
+def _adaptive_bounds(size, bins):
+    """Reference math/pooling.h AdaptiveStartIndex/AdaptiveEndIndex:
+    start = floor(i*size/bins), end = ceil((i+1)*size/bins).  size and bins
+    are static, so every slice bound below is a compile-time constant."""
+    return [
+        (int(np.floor(i * size / bins)), int(np.ceil((i + 1) * size / bins)))
+        for i in range(bins)
+    ]
+
+
+def _adaptive_pool(x, bins, pooling_type, spatial):
+    """Adaptive pooling over the trailing `spatial` dims; bins per dim are
+    static so this unrolls into bins^spatial static slices (bins are small —
+    XLA fuses the gathers into one pass)."""
+    red = jnp.max if pooling_type == "max" else jnp.mean
+    dims = x.shape[-spatial:]
+    bounds = [_adaptive_bounds(d, b) for d, b in zip(dims, bins)]
+
+    if spatial == 2:
+        rows = []
+        for s0, e0 in bounds[0]:
+            cols = [
+                red(x[..., s0:e0, s1:e1], axis=(-2, -1))
+                for s1, e1 in bounds[1]
+            ]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    rows = []
+    for s0, e0 in bounds[0]:
+        mids = []
+        for s1, e1 in bounds[1]:
+            cols = [
+                red(x[..., s0:e0, s1:e1, s2:e2], axis=(-3, -2, -1))
+                for s2, e2 in bounds[2]
+            ]
+            mids.append(jnp.stack(cols, axis=-1))
+        rows.append(jnp.stack(mids, axis=-2))
+    return jnp.stack(rows, axis=-3)
+
+
+def _pool2d_adaptive(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    out = _adaptive_pool(
+        x, [int(k) for k in attrs["ksize"]],
+        attrs.get("pooling_type", "max"), 2,
+    )
+    return {"Out": [out]}
+
+
+def _pool3d_adaptive(ctx, ins, attrs):
+    x = data(ins["X"][0])
+    out = _adaptive_pool(
+        x, [int(k) for k in attrs["ksize"]],
+        attrs.get("pooling_type", "max"), 3,
+    )
+    return {"Out": [out]}
+
+
+def _pool_with_index_infer(spatial):
+    def infer(op, block):
+        x = in_desc(op, block, "X")
+        if x is None:
+            return
+        n, c = x.shape[:2]
+        if op.attr("adaptive", False) or op.attr("global_pooling", False):
+            dims = (
+                [1] * spatial
+                if op.attr("global_pooling", False)
+                else [int(k) for k in op.attr("ksize")]
+            )
+        else:
+            k = op.attr("ksize", [1] * spatial)
+            s = op.attr("strides", [1] * spatial)
+            p = op.attr("paddings", [0] * spatial)
+            dims = [
+                _pool_out_dim(x.shape[i + 2], k[i], p[i], s[i], False)
+                for i in range(spatial)
+            ]
+        set_output(block, op, "Out", [n, c] + dims, x.dtype)
+        set_output(block, op, "Mask", [n, c] + dims, DataType.INT32)
+    return infer
+
+
+def _max_pool_with_index(ctx, ins, attrs, spatial):
+    """Max pooling that also emits the argmax's flat index within the input
+    feature map (reference: math/pooling.h MaxPool2dWithIndexFunctor —
+    index = h*W + w of the winning input element).  Lowered as
+    patch-extraction + argmax; the value path is take_along_axis over
+    patches so the grad scatters to the argmax positions exactly like the
+    reference's backward kernel."""
+    x = data(ins["X"][0])
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[-spatial:])
+        strides = ksize
+        paddings = [0] * spatial
+        adaptive = False
+    else:
+        ksize = [int(k) for k in attrs["ksize"]]
+        strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
+        paddings = [int(p) for p in attrs.get("paddings", [0] * spatial)]
+        adaptive = bool(attrs.get("adaptive", False))
+    N, C = x.shape[:2]
+    in_dims = x.shape[2:]
+
+    # flat input index grid, same spatial shape as x
+    flat = np.arange(int(np.prod(in_dims)), dtype=np.float32).reshape(in_dims)
+    idx = jnp.broadcast_to(jnp.asarray(flat), x.shape)
+
+    if adaptive:
+        bins = ksize
+        bounds = [_adaptive_bounds(d, b) for d, b in zip(in_dims, bins)]
+
+        def cell(slices):
+            xs = x[(...,) + slices]
+            red_axes = tuple(range(-spatial, 0))
+            flatc = xs.reshape(xs.shape[: x.ndim - spatial] + (-1,))
+            am = jnp.argmax(flatc, axis=-1)
+            vals = jnp.take_along_axis(flatc, am[..., None], axis=-1)[..., 0]
+            idxc = idx[(...,) + slices].reshape(flatc.shape)
+            ids = jnp.take_along_axis(idxc, am[..., None], axis=-1)[..., 0]
+            return vals, ids
+
+        if spatial == 2:
+            vs, is_ = [], []
+            for s0, e0 in bounds[0]:
+                vrow, irow = [], []
+                for s1, e1 in bounds[1]:
+                    v, i = cell((slice(s0, e0), slice(s1, e1)))
+                    vrow.append(v)
+                    irow.append(i)
+                vs.append(jnp.stack(vrow, axis=-1))
+                is_.append(jnp.stack(irow, axis=-1))
+            out = jnp.stack(vs, axis=-2)
+            mask = jnp.stack(is_, axis=-2)
+        else:
+            vs, is_ = [], []
+            for s0, e0 in bounds[0]:
+                vmid, imid = [], []
+                for s1, e1 in bounds[1]:
+                    vrow, irow = [], []
+                    for s2, e2 in bounds[2]:
+                        v, i = cell(
+                            (slice(s0, e0), slice(s1, e1), slice(s2, e2))
+                        )
+                        vrow.append(v)
+                        irow.append(i)
+                    vmid.append(jnp.stack(vrow, axis=-1))
+                    imid.append(jnp.stack(irow, axis=-1))
+                vs.append(jnp.stack(vmid, axis=-2))
+                is_.append(jnp.stack(imid, axis=-2))
+            out = jnp.stack(vs, axis=-3)
+            mask = jnp.stack(is_, axis=-3)
+        return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+    # strided case: extract patches, argmax within each
+    pad_full = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    xp = jnp.pad(x, pad_full, constant_values=-np.inf)
+    ip = jnp.pad(idx, pad_full, constant_values=-1.0)
+
+    K = int(np.prod(ksize))
+    # gather all K shifted strided views: [K, N, C, *out_dims]
+    out_dims = [
+        (x.shape[2 + i] + 2 * paddings[i] - ksize[i]) // strides[i] + 1
+        for i in range(spatial)
+    ]
+
+    def shifted(arr, offs):
+        sl = [slice(None), slice(None)]
+        for i in range(spatial):
+            sl.append(
+                slice(offs[i], offs[i] + (out_dims[i] - 1) * strides[i] + 1,
+                      strides[i])
+            )
+        return arr[tuple(sl)]
+
+    offsets = list(np.ndindex(*ksize))
+    vals = jnp.stack([shifted(xp, o) for o in offsets])  # [K, N, C, ...]
+    idxs = jnp.stack([shifted(ip, o) for o in offsets])
+    am = jnp.argmax(vals, axis=0)  # [N, C, ...]
+    out = jnp.take_along_axis(vals, am[None], axis=0)[0]
+    mask = jnp.take_along_axis(idxs, am[None], axis=0)[0]
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("max_pool2d_with_index",
+             infer_shape=_pool_with_index_infer(2), diff_inputs=["X"])
+def _max_pool2d_with_index(ctx, ins, attrs):
+    return _max_pool_with_index(ctx, ins, attrs, 2)
+
+
+@register_op("max_pool3d_with_index",
+             infer_shape=_pool_with_index_infer(3), diff_inputs=["X"])
+def _max_pool3d_with_index(ctx, ins, attrs):
+    return _max_pool_with_index(ctx, ins, attrs, 3)
+
+
+def _unpool_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    k = op.attr("ksize", [1, 1])
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    n, c, h, w = x.shape
+    dims = [
+        (h - 1) * s[0] - 2 * p[0] + k[0] if h > 0 else -1,
+        (w - 1) * s[1] - 2 * p[1] + k[1] if w > 0 else -1,
+    ]
+    set_output(block, op, "Out", [n, c] + dims, x.dtype)
+
+
+@register_op("unpool", infer_shape=_unpool_infer, diff_inputs=["X"])
+def _unpool(ctx, ins, attrs):
+    """Max-unpooling: scatter X into a zero output at the positions recorded
+    by max_pool2d_with_index's Mask (reference: math/unpooling.h
+    Unpool2dMaxFunctor — indices are flat within the output H*W)."""
+    x = data(ins["X"][0])  # [N, C, H, W]
+    indices = data(ins["Indices"][0]).astype(jnp.int32)
+    k = [int(v) for v in attrs.get("ksize", [1, 1])]
+    s = [int(v) for v in attrs.get("strides", [1, 1])]
+    p = [int(v) for v in attrs.get("paddings", [0, 0])]
+    N, C, H, W = x.shape
+    OH = (H - 1) * s[0] - 2 * p[0] + k[0]
+    OW = (W - 1) * s[1] - 2 * p[1] + k[1]
+
+    xf = x.reshape(N, C, H * W)
+    inf = indices.reshape(N, C, H * W)
+    out = jnp.zeros((N, C, OH * OW), dtype=x.dtype)
+    n_ix = jnp.arange(N)[:, None, None]
+    c_ix = jnp.arange(C)[None, :, None]
+    out = out.at[n_ix, c_ix, inf].set(xf)
+    return {"Out": [out.reshape(N, C, OH, OW)]}
+
+
+def _spp_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    ph = op.attr("pyramid_height", 1)
+    total = sum(4 ** p for p in range(ph))
+    set_output(block, op, "Out", [x.shape[0], x.shape[1] * total], x.dtype)
+
+
+@register_op("spp", infer_shape=_spp_infer, diff_inputs=["X"])
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference: operators/spp_op.h): level p pools
+    to a 2^p x 2^p grid with kernel=ceil(in/bins), stride=kernel,
+    pad=(kernel*bins-in+1)/2, then flattens and concatenates all levels."""
+    x = data(ins["X"][0])
+    ph = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    N, C, H, W = x.shape
+    outs = []
+    for pl in range(ph):
+        bins = 2 ** pl
+        kh = int(np.ceil(H / bins))
+        kw = int(np.ceil(W / bins))
+        pad_h = (kh * bins - H + 1) // 2
+        pad_w = (kw * bins - W + 1) // 2
+        lvl = _pool(
+            x, [kh, kw], [kh, kw], [pad_h, pad_w], ptype,
+            exclusive=False, ceil_mode=False, spatial=2,
+        )
+        outs.append(lvl.reshape(N, C * bins * bins))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+def _conv3d_transpose_infer(op, block):
+    x = in_desc(op, block, "Input")
+    f = in_desc(op, block, "Filter")
+    if x is None or f is None:
+        return
+    strides = op.attr("strides", [1, 1, 1])
+    paddings = op.attr("paddings", [0, 0, 0])
+    dilations = op.attr("dilations", [1, 1, 1])
+    groups = op.attr("groups", 1) or 1
+    n = x.shape[0]
+    oc_per_g = f.shape[1]
+
+    def out_dim(size, k, pad, stride, dil):
+        if size < 0:
+            return -1
+        return (size - 1) * stride - 2 * pad + dil * (k - 1) + 1
+
+    dims = [
+        out_dim(x.shape[i + 2], f.shape[i + 2], paddings[i], strides[i],
+                dilations[i])
+        for i in range(3)
+    ]
+    set_output(block, op, "Output", [n, oc_per_g * groups] + dims, x.dtype)
+
+
+@register_op("conv3d_transpose", infer_shape=_conv3d_transpose_infer,
+             diff_inputs=["Input", "Filter"])
+def _conv3d_transpose(ctx, ins, attrs):
+    """3-D transposed conv (reference: operators/conv_transpose_op.cc:358
+    Conv3DTransposeOpMaker).  Filter layout [in_c, out_c/g, kd, kh, kw]."""
+    x = data(ins["Input"][0])
+    f = data(ins["Filter"][0])
+    out = _conv_transpose_lower(
+        x, f,
+        [int(s) for s in attrs.get("strides", [1, 1, 1])],
+        [int(p) for p in attrs.get("paddings", [0, 0, 0])],
+        [int(d) for d in attrs.get("dilations", [1, 1, 1])],
+        attrs.get("groups", 1) or 1, 3,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose",
+             infer_shape=_conv2d_transpose_infer,
+             diff_inputs=["Input", "Filter"])
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    """Depthwise transposed conv (reference: conv_transpose_op.cc registers
+    it as conv2d_transpose with groups == channels)."""
+    return _conv2d_transpose(ctx, ins, attrs)

@@ -1,0 +1,249 @@
+"""Per-op sweep: pooling variants (reference: test_pool_max_op.py,
+test_unpool_op.py, test_spp_op.py, test_adaptive_pool2d/3d in
+test_pool2d_op.py, test_conv3d_transpose_op.py over pool_with_index_op.cc,
+unpool_op.cc, spp_op.cc, pool_op.cc `adaptive`, conv_transpose_op.cc:358)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+
+
+def _max_pool_with_index_ref(x, ksize, strides, paddings):
+    n, c, h, w = x.shape
+    oh = (h + 2 * paddings[0] - ksize[0]) // strides[0] + 1
+    ow = (w + 2 * paddings[1] - ksize[1]) // strides[1] + 1
+    out = np.zeros((n, c, oh, ow), dtype=x.dtype)
+    mask = np.zeros((n, c, oh, ow), dtype=np.int32)
+    for i in range(oh):
+        for j in range(ow):
+            hs = i * strides[0] - paddings[0]
+            ws = j * strides[1] - paddings[1]
+            best = np.full((n, c), -np.inf, dtype=np.float64)
+            bidx = np.zeros((n, c), dtype=np.int64)
+            for dh in range(ksize[0]):
+                for dw in range(ksize[1]):
+                    hh, ww = hs + dh, ws + dw
+                    if 0 <= hh < h and 0 <= ww < w:
+                        v = x[:, :, hh, ww]
+                        upd = v > best
+                        best = np.where(upd, v, best)
+                        bidx = np.where(upd, hh * w + ww, bidx)
+            out[:, :, i, j] = best
+            mask[:, :, i, j] = bidx
+    return out, mask
+
+
+def test_max_pool2d_with_index():
+    x = _rand((2, 3, 7, 7), seed=1)
+    want, wmask = _max_pool_with_index_ref(x, [3, 3], [2, 2], [1, 1])
+
+    class T(OpTest):
+        op_type = "max_pool2d_with_index"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1]}
+    t.outputs = {"Out": want, "Mask": wmask}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_max_pool3d_with_index():
+    x = _rand((2, 2, 6, 6, 6), seed=2)
+
+    class T(OpTest):
+        op_type = "max_pool3d_with_index"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+               "paddings": [0, 0, 0]}
+    # reference by reshape trick: non-overlapping windows
+    xr = x.reshape(2, 2, 3, 2, 3, 2, 3, 2)
+    want = xr.max(axis=(3, 5, 7))
+    t.outputs = {"Out": want,
+                 "Mask": np.zeros_like(want, dtype=np.int32)}
+    prog, startup, feed, _, out_names = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.program_guard(prog, startup):
+        got, mask = exe.run(program=prog, feed=feed,
+                            fetch_list=[out_names["Out"][0],
+                                        out_names["Mask"][0]])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # mask decodes back to the max value
+    flat = x.reshape(2, 2, -1)
+    picked = np.take_along_axis(flat, mask.reshape(2, 2, -1), axis=2)
+    np.testing.assert_allclose(picked.reshape(want.shape), want, rtol=1e-5)
+
+
+def test_adaptive_pool2d():
+    x = _rand((2, 3, 7, 5), seed=3)
+    bins = [3, 2]
+    want = np.zeros((2, 3, 3, 2), dtype="float32")
+    for i in range(bins[0]):
+        for j in range(bins[1]):
+            h0, h1 = int(np.floor(i * 7 / 3)), int(np.ceil((i + 1) * 7 / 3))
+            w0, w1 = int(np.floor(j * 5 / 2)), int(np.ceil((j + 1) * 5 / 2))
+            want[:, :, i, j] = x[:, :, h0:h1, w0:w1].mean(axis=(2, 3))
+
+    class T(OpTest):
+        op_type = "pool2d"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": bins, "pooling_type": "avg", "adaptive": True}
+    t.outputs = {"Out": want}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_adaptive_pool3d_max():
+    x = _rand((1, 2, 5, 5, 5), seed=4)
+    bins = [2, 2, 2]
+    want = np.zeros((1, 2, 2, 2, 2), dtype="float32")
+    for i in range(2):
+        for j in range(2):
+            for k in range(2):
+                s = [int(np.floor(d * 5 / 2)) for d in (i, j, k)]
+                e = [int(np.ceil((d + 1) * 5 / 2)) for d in (i, j, k)]
+                want[:, :, i, j, k] = x[:, :, s[0]:e[0], s[1]:e[1],
+                                        s[2]:e[2]].max(axis=(2, 3, 4))
+
+    class T(OpTest):
+        op_type = "pool3d"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"ksize": bins, "pooling_type": "max", "adaptive": True}
+    t.outputs = {"Out": want}
+    t.check_output()
+
+
+def test_unpool_roundtrip():
+    x = _rand((2, 3, 8, 8), seed=5)
+    pooled, mask = _max_pool_with_index_ref(x, [2, 2], [2, 2], [0, 0])
+
+    class T(OpTest):
+        op_type = "unpool"
+
+    t = T()
+    t.inputs = {"X": pooled, "Indices": mask}
+    t.attrs = {"unpooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0]}
+    want = np.zeros_like(x)
+    n_ix, c_ix = np.meshgrid(range(2), range(3), indexing="ij")
+    for i in range(pooled.shape[2]):
+        for j in range(pooled.shape[3]):
+            flat = mask[:, :, i, j]
+            want.reshape(2, 3, -1)[n_ix, c_ix, flat] = pooled[:, :, i, j]
+    t.outputs = {"Out": want}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_spp():
+    x = _rand((2, 3, 7, 7), seed=6)
+    ph = 3
+
+    class T(OpTest):
+        op_type = "spp"
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = {"pyramid_height": ph, "pooling_type": "max"}
+    total = sum(4 ** p for p in range(ph))
+    t.outputs = {"Out": np.zeros((2, 3 * total), dtype="float32")}
+    prog, startup, feed, _, out_names = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.program_guard(prog, startup):
+        (got,) = exe.run(program=prog, feed=feed,
+                         fetch_list=[out_names["Out"][0]])
+    assert got.shape == (2, 3 * total)
+    # level 0 is global max pool
+    np.testing.assert_allclose(got[:, :3], x.max(axis=(2, 3)), rtol=1e-5)
+    # level 1: 2x2 grid, kernel=ceil(7/2)=4, stride=4, pad=(4*2-7+1)/2=1
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                constant_values=-np.inf)
+    lvl1 = np.zeros((2, 3, 2, 2), dtype="float32")
+    for i in range(2):
+        for j in range(2):
+            lvl1[:, :, i, j] = xp[:, :, i * 4:i * 4 + 4,
+                                  j * 4:j * 4 + 4].max(axis=(2, 3))
+    np.testing.assert_allclose(got[:, 3:15], lvl1.reshape(2, 12), rtol=1e-5)
+
+
+def test_conv3d_transpose():
+    x = _rand((1, 2, 3, 3, 3), seed=7)
+    f = _rand((2, 3, 2, 2, 2), seed=8)  # [in_c, out_c, kd, kh, kw]
+    # upsample-by-scatter reference: stride 2, no pad -> (3-1)*2 + 2 = 6
+    want = np.zeros((1, 3, 6, 6, 6), dtype=np.float64)
+    for d in range(3):
+        for h in range(3):
+            for w in range(3):
+                for kd in range(2):
+                    for kh in range(2):
+                        for kw in range(2):
+                            contrib = np.einsum(
+                                "i,io->o", x[0, :, d, h, w].astype(np.float64),
+                                f[:, :, kd, kh, kw].astype(np.float64))
+                            want[0, :, d * 2 + kd, h * 2 + kh, w * 2 + kw] += contrib
+
+    class T(OpTest):
+        op_type = "conv3d_transpose"
+
+    t = T()
+    t.inputs = {"Input": x, "Filter": f}
+    t.attrs = {"strides": [2, 2, 2], "paddings": [0, 0, 0],
+               "dilations": [1, 1, 1]}
+    t.outputs = {"Output": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+def test_depthwise_conv2d_transpose():
+    x = _rand((1, 3, 4, 4), seed=9)
+    f = _rand((3, 1, 2, 2), seed=10)  # groups=3: [in_c, out/g, kh, kw]
+    want = np.zeros((1, 3, 8, 8), dtype=np.float64)
+    for c in range(3):
+        for h in range(4):
+            for w in range(4):
+                for kh in range(2):
+                    for kw in range(2):
+                        want[0, c, h * 2 + kh, w * 2 + kw] += (
+                            float(x[0, c, h, w]) * float(f[c, 0, kh, kw]))
+
+    class T(OpTest):
+        op_type = "depthwise_conv2d_transpose"
+
+    t = T()
+    t.inputs = {"Input": x, "Filter": f}
+    t.attrs = {"strides": [2, 2], "paddings": [0, 0], "dilations": [1, 1],
+               "groups": 3}
+    t.outputs = {"Output": want.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_adaptive_pool2d_layer_with_index():
+    x = _rand((2, 3, 6, 6), seed=11)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name="x", shape=[3, 6, 6], dtype="float32")
+        out, mask = fluid.layers.adaptive_pool2d(xv, [3, 3], "max",
+                                                 require_index=True)
+        up = fluid.layers.unpool(out, mask, ksize=[2, 2], strides=[2, 2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, gmask, gup = exe.run(program=prog, feed={"x": x},
+                              fetch_list=[out, mask, up])
+    # 6/3 = 2: exact reshape windows
+    want = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert gup.shape == (2, 3, 6, 6)
+    # unpooled scatters each max back to its argmax position
+    np.testing.assert_allclose(gup.sum(axis=(2, 3)), want.sum(axis=(2, 3)),
+                               rtol=1e-5)
