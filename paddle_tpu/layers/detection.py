@@ -25,6 +25,13 @@ __all__ = [
     "grid_sampler",
     "affine_grid",
     "affine_channel",
+    "generate_proposals",
+    "rpn_target_assign",
+    "generate_proposal_labels",
+    "psroi_pool",
+    "roi_perspective_transform",
+    "polygon_box_transform",
+    "detection_map",
 ]
 
 
@@ -362,3 +369,177 @@ def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
         attrs={"data_layout": data_layout},
     )
     return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposal generation (reference: layers/detection.py
+    generate_proposals over detection/generate_proposals_op.cc).  Returns
+    (rpn_rois, rpn_roi_probs), padded [N, post_nms_top_n, .] LoD values."""
+    helper = LayerHelper("generate_proposals", input=scores, name=name)
+    rpn_rois = helper.create_variable_for_type_inference(bbox_deltas.dtype)
+    rpn_roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rpn_rois], "RpnRoiProbs": [rpn_roi_probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+    )
+    rpn_rois.stop_gradient = True
+    rpn_roi_probs.stop_gradient = True
+    return rpn_rois, rpn_roi_probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN training targets (reference: layers/detection.py
+    rpn_target_assign).  Returns (predicted_cls_logits, predicted_bbox_pred,
+    target_label, target_bbox, bbox_inside_weight); static
+    rpn_batch_size_per_im rows per image, fg shortfalls zero-weighted."""
+    from .tensor import gather as _gather, reshape as _reshape
+
+    helper = LayerHelper("rpn_target_assign", input=anchor_box)
+    loc_index = helper.create_variable_for_type_inference("int32")
+    score_index = helper.create_variable_for_type_inference("int32")
+    target_label = helper.create_variable_for_type_inference("int32")
+    target_bbox = helper.create_variable_for_type_inference(anchor_box.dtype)
+    bbox_inside_weight = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [bbox_inside_weight]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "use_random": use_random},
+    )
+    for v in (loc_index, score_index, target_label, target_bbox,
+              bbox_inside_weight):
+        v.stop_gradient = True
+    cls_flat = _reshape(cls_logits, shape=(-1, 1))
+    bbox_flat = _reshape(bbox_pred, shape=(-1, 4))
+    predicted_cls_logits = _gather(cls_flat, score_index)
+    predicted_bbox_pred = _gather(bbox_flat, loc_index)
+    return (predicted_cls_logits, predicted_bbox_pred, target_label,
+            target_bbox, bbox_inside_weight)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """Fast-RCNN RoI sampling (reference: layers/detection.py
+    generate_proposal_labels)."""
+    helper = LayerHelper("generate_proposal_labels", input=rpn_rois)
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels_int32 = helper.create_variable_for_type_inference("int32")
+    bbox_targets = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    bbox_inside_weights = helper.create_variable_for_type_inference(
+        rpn_rois.dtype)
+    bbox_outside_weights = helper.create_variable_for_type_inference(
+        rpn_rois.dtype)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels_int32],
+                 "BboxTargets": [bbox_targets],
+                 "BboxInsideWeights": [bbox_inside_weights],
+                 "BboxOutsideWeights": [bbox_outside_weights]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums or 81, "use_random": use_random},
+    )
+    return (rois, labels_int32, bbox_targets, bbox_inside_weights,
+            bbox_outside_weights)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """Position-sensitive RoI pooling (reference: layers/nn.py psroi_pool
+    over operators/psroi_pool_op.cc)."""
+    helper = LayerHelper("psroi_pool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="psroi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width},
+    )
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """Perspective-warp quad RoIs (reference: layers/detection.py
+    roi_perspective_transform)."""
+    helper = LayerHelper("roi_perspective_transform", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale},
+    )
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry map transform (reference: layers/detection.py
+    polygon_box_transform)."""
+    helper = LayerHelper("polygon_box_transform", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="polygon_box_transform",
+        inputs={"Input": [input]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """Batch mAP (reference: layers/detection.py detection_map over
+    operators/detection_map_op.cc; streaming accumulation lives in the
+    host-side evaluator here)."""
+    helper = LayerHelper("detection_map", input=detect_res, name=name)
+    m = helper.create_variable_for_type_inference("float32")
+    accum_pos = helper.create_variable_for_type_inference("int32")
+    accum_tp = helper.create_variable_for_type_inference("float32")
+    accum_fp = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [m], "AccumPosCount": [accum_pos],
+                 "AccumTruePos": [accum_tp], "AccumFalsePos": [accum_fp]},
+        attrs={"overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version, "class_num": class_num,
+               "background_label": background_label},
+    )
+    return m

@@ -1287,10 +1287,11 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     if filter_size is None:
         if output_size is None:
             raise ValueError("filter_size or output_size required")
+        # invert out = (in-1)*stride - 2*pad + dilation*(k-1) + 1 for k
         output_size = _pair(output_size, 3)
         filter_size = [
-            output_size[i] - (input.shape[i + 2] - 1) * stride[i]
-            + 2 * padding[i]
+            (output_size[i] - (input.shape[i + 2] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1
             for i in range(3)
         ]
     else:

@@ -20,6 +20,7 @@ from . import (  # noqa: F401
     misc_ops,
     nn_ops,
     optimizer_ops,
+    proposal_ops,
     quant_ops,
     reduce_ops,
     rnn_ops,

@@ -658,8 +658,9 @@ def _max_pool_with_index(ctx, ins, attrs, spatial):
     N, C = x.shape[:2]
     in_dims = x.shape[2:]
 
-    # flat input index grid, same spatial shape as x
-    flat = np.arange(int(np.prod(in_dims)), dtype=np.float32).reshape(in_dims)
+    # flat input index grid, same spatial shape as x (int32: a float grid
+    # loses exactness above 2^24 on large feature maps)
+    flat = np.arange(int(np.prod(in_dims)), dtype=np.int32).reshape(in_dims)
     idx = jnp.broadcast_to(jnp.asarray(flat), x.shape)
 
     if adaptive:
@@ -711,7 +712,7 @@ def _max_pool_with_index(ctx, ins, attrs, spatial):
     # strided case: extract patches, argmax within each
     pad_full = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
     xp = jnp.pad(x, pad_full, constant_values=-np.inf)
-    ip = jnp.pad(idx, pad_full, constant_values=-1.0)
+    ip = jnp.pad(idx, pad_full, constant_values=-1)
 
     K = int(np.prod(ksize))
     # gather all K shifted strided views: [K, N, C, *out_dims]

@@ -1,0 +1,589 @@
+"""Faster-RCNN proposal pipeline + detection metrics (reference:
+paddle/fluid/operators/detection/ — generate_proposals_op.cc,
+rpn_target_assign_op.cc, generate_proposal_labels_op.cc,
+polygon_box_transform_op.cc; plus operators/detection_map_op.cc).
+
+TPU-native redesign: every variable-size output (kept proposals, sampled
+fg/bg anchors, sampled RoIs) becomes a fixed-capacity tensor + valid counts
+(LoDValue lengths) or explicit zero weights — the XLA static-shape
+discipline the rest of the detection family already follows
+(see detection_ops.py multiclass_nms).  Sampling subsets are chosen with
+top-k over randomly-perturbed priorities instead of the reference's
+std::shuffle: same distribution, trace-stable shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType
+from ..core.registry import register_op
+from .common import data, in_desc, lengths, set_output
+from .detection_ops import _iou, _nms_single_class
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals
+# ---------------------------------------------------------------------------
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))  # generate_proposals_op.cc:72
+
+
+def _decode_proposals(anchors, deltas, variances):
+    """BoxCoder from generate_proposals_op.cc:75 — +1-offset widths, -1 on
+    the decoded corner."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is None:
+        variances = jnp.ones_like(anchors)
+    cx = variances[:, 0] * deltas[:, 0] * aw + acx
+    cy = variances[:, 1] * deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(variances[:, 2] * deltas[:, 2], _BBOX_CLIP)) * aw
+    h = jnp.exp(jnp.minimum(variances[:, 3] * deltas[:, 3], _BBOX_CLIP)) * ah
+    return jnp.stack(
+        [cx - w / 2.0, cy - h / 2.0, cx + w / 2.0 - 1.0, cy + h / 2.0 - 1.0],
+        axis=1,
+    )
+
+
+def _clip_boxes(boxes, im_h, im_w):
+    """ClipTiledBoxes (generate_proposals_op.cc:137)."""
+    return jnp.stack(
+        [
+            jnp.clip(boxes[:, 0], 0.0, im_w - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, im_h - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, im_w - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, im_h - 1.0),
+        ],
+        axis=1,
+    )
+
+
+def _generate_proposals_infer(op, block):
+    post_n = op.attr("post_nms_topN", 1000)
+    set_output(block, op, "RpnRois", [-1, post_n, 4], DataType.FP32,
+               lod_level=1)
+    set_output(block, op, "RpnRoiProbs", [-1, post_n, 1], DataType.FP32,
+               lod_level=1)
+
+
+@register_op("generate_proposals", infer_shape=_generate_proposals_infer,
+             no_grad=True)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference:
+    detection/generate_proposals_op.cc ProposalForOneImage): decode deltas
+    on anchors, clip to image, drop boxes below min_size (score -> -inf),
+    keep pre_nms_topN by score, greedy NMS, keep post_nms_topN.  Outputs are
+    padded [N, post_nms_topN, .] with per-image valid counts."""
+    scores = data(ins["Scores"][0])        # [N, A, H, W]
+    deltas = data(ins["BboxDeltas"][0])    # [N, 4A, H, W]
+    im_info = data(ins["ImInfo"][0])       # [N, 3] (h, w, scale)
+    anchors = data(ins["Anchors"][0]).reshape(-1, 4)    # [H*W*A, 4]
+    var_in = ins.get("Variances", [None])[0]
+    variances = (
+        data(var_in).reshape(-1, 4) if var_in is not None else None
+    )
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = max(float(attrs.get("min_size", 0.1)), 1.0)
+    eta = float(attrs.get("eta", 1.0))
+    N, A = scores.shape[0], scores.shape[1]
+    M = anchors.shape[0]
+
+    def one_image(sc, dl, info):
+        # (A,H,W)->(H,W,A)->flat, (4A,H,W)->(H,W,A,4)->flat: the reference's
+        # transpose({2,3,1}) ordering (generate_proposals_op.cc:341)
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)           # [M]
+        d = jnp.transpose(dl, (1, 2, 0)).reshape(M, 4)
+        boxes = _decode_proposals(anchors, d, variances)
+        boxes = _clip_boxes(boxes, info[0], info[1])
+        # FilterBoxes (generate_proposals_op.cc:160)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ws_orig = (boxes[:, 2] - boxes[:, 0]) / info[2] + 1.0
+        hs_orig = (boxes[:, 3] - boxes[:, 1]) / info[2] + 1.0
+        cx = boxes[:, 0] + ws / 2.0
+        cy = boxes[:, 1] + hs / 2.0
+        keep = (
+            (ws_orig >= min_size) & (hs_orig >= min_size)
+            & (cx <= info[1]) & (cy <= info[0])
+        )
+        s = jnp.where(keep, s, -jnp.inf)
+
+        k = min(pre_n if pre_n > 0 else M, M)
+        top_s, top_i = jax.lax.top_k(s, k)
+        cand = boxes[top_i]
+        nms_keep = _nms_single_class(
+            cand, jnp.where(jnp.isfinite(top_s), top_s, -1.0),
+            score_threshold=-jnp.inf, nms_threshold=nms_thresh, eta=eta,
+            top_k=-1, normalized=False,
+        )
+        kept_s = jnp.where(nms_keep & jnp.isfinite(top_s), top_s, -jnp.inf)
+        kp = min(post_n, k)
+        fin_s, fin_i = jax.lax.top_k(kept_s, kp)
+        out_boxes = cand[fin_i]
+        valid = jnp.isfinite(fin_s)
+        count = jnp.sum(valid).astype(jnp.int32)
+        out_boxes = jnp.where(valid[:, None], out_boxes, 0.0)
+        out_s = jnp.where(valid, fin_s, 0.0)
+        if kp < post_n:
+            out_boxes = jnp.pad(out_boxes, ((0, post_n - kp), (0, 0)))
+            out_s = jnp.pad(out_s, (0, post_n - kp))
+        return out_boxes, out_s[:, None], count
+
+    rois, probs, counts = jax.vmap(one_image)(scores, deltas, im_info)
+    return {
+        "RpnRois": [LoDValue(rois, counts)],
+        "RpnRoiProbs": [LoDValue(probs, counts)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign
+# ---------------------------------------------------------------------------
+def _rpn_target_assign_infer(op, block):
+    set_output(block, op, "LocationIndex", [-1], DataType.INT32)
+    set_output(block, op, "ScoreIndex", [-1], DataType.INT32)
+    set_output(block, op, "TargetLabel", [-1, 1], DataType.INT32)
+    set_output(block, op, "TargetBBox", [-1, 4], DataType.FP32)
+    set_output(block, op, "BBoxInsideWeight", [-1, 4], DataType.FP32)
+
+
+def _box_to_delta(rois, gts, weights=None):
+    """Encode gt boxes against rois (reference: bbox_util.h BoxToDelta,
+    +1-offset widths)."""
+    rw = rois[:, 2] - rois[:, 0] + 1.0
+    rh = rois[:, 3] - rois[:, 1] + 1.0
+    rcx = rois[:, 0] + rw * 0.5
+    rcy = rois[:, 1] + rh * 0.5
+    gw = gts[:, 2] - gts[:, 0] + 1.0
+    gh = gts[:, 3] - gts[:, 1] + 1.0
+    gcx = gts[:, 0] + gw * 0.5
+    gcy = gts[:, 1] + gh * 0.5
+    d = jnp.stack([
+        (gcx - rcx) / rw,
+        (gcy - rcy) / rh,
+        jnp.log(jnp.maximum(gw / rw, 1e-10)),
+        jnp.log(jnp.maximum(gh / rh, 1e-10)),
+    ], axis=1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, dtype=d.dtype)[None, :]
+    return d
+
+
+def _sample_mask(priority, eligible, k, key):
+    """Pick up to k eligible entries: top-k over priorities (+U(0,1) jitter
+    when a key is given — the trace-stable stand-in for std::shuffle).
+    Returns a bool mask."""
+    M = priority.shape[0]
+    p = jnp.where(eligible, priority, -jnp.inf)
+    if key is not None:
+        p = p + jax.random.uniform(key, (M,))
+    _, idx = jax.lax.top_k(p, min(k, M))
+    mask = jnp.zeros((M,), dtype=bool).at[idx].set(True)
+    # top_k returns k entries even if fewer eligible: mask back
+    return mask & eligible
+
+
+@register_op("rpn_target_assign", infer_shape=_rpn_target_assign_infer,
+             no_grad=True, random=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """RPN training targets (reference: detection/rpn_target_assign_op.cc):
+    per image, anchors straddling the image border are dropped; positives
+    are (a) the best anchor per gt and (b) anchors with IoU >
+    rpn_positive_overlap; negatives IoU < rpn_negative_overlap; sample
+    rpn_batch_size_per_im anchors with at most rpn_fg_fraction foreground.
+
+    Static-shape contract: exactly S = rpn_batch_size_per_im rows per image.
+    Rows are real sampled anchors (bg fills whatever fg doesn't use);
+    fg shortfalls get BBoxInsideWeight 0 (the reference's fake-fg rows,
+    rpn_target_assign_op.cc bbox_inside_weight zeroing) so the location
+    loss is unaffected.  LocationIndex/ScoreIndex are flat indices into the
+    [N*A] anchor grid, matching the reference's gather contract."""
+    anchors = data(ins["Anchor"][0])              # [A, 4]
+    gt = ins["GtBoxes"][0]
+    gt_boxes = data(gt)                            # [N, G, 4]
+    if gt_boxes.ndim == 2:
+        gt_boxes = gt_boxes[None]
+    gt_lens = lengths(gt)
+    N, G = gt_boxes.shape[0], gt_boxes.shape[1]
+    if gt_lens is None:
+        gt_lens = jnp.full((N,), G, dtype=jnp.int32)
+    crowd_in = ins.get("IsCrowd", [None])[0]
+    is_crowd = (
+        data(crowd_in).reshape(N, -1).astype(bool)
+        if crowd_in is not None else jnp.zeros((N, G), dtype=bool)
+    )
+    im_info = data(ins["ImInfo"][0])               # [N, 3]
+    S = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    pos_th = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_th = float(attrs.get("rpn_negative_overlap", 0.3))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    use_random = bool(attrs.get("use_random", True))
+    A = anchors.shape[0]
+    fg_cap = int(fg_frac * S)
+
+    keys = (
+        jax.random.split(ctx.rng(), N) if use_random else [None] * N
+    )
+
+    def one_image(gtb, gtl, crowd, info, key):
+        inside = (
+            (anchors[:, 0] >= -straddle)
+            & (anchors[:, 1] >= -straddle)
+            & (anchors[:, 2] < info[1] + straddle)
+            & (anchors[:, 3] < info[0] + straddle)
+        ) if straddle >= 0 else jnp.ones((A,), dtype=bool)
+        gt_valid = (jnp.arange(G) < gtl) & ~crowd
+        iou = _iou(anchors, gtb, normalized=False)  # [A, G]
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        iou = jnp.where(inside[:, None], iou, -1.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        # (i) best anchor per gt: an anchor whose IoU equals some gt's max
+        gt_best = jnp.max(iou, axis=0)  # [G]
+        is_best = jnp.any(
+            (iou >= gt_best[None, :] - 1e-9) & (iou > 0) & gt_valid[None, :],
+            axis=1,
+        )
+        fg_cand = inside & (is_best | (max_iou >= pos_th))
+        bg_cand = inside & (max_iou < neg_th) & (max_iou >= 0) & ~fg_cand
+
+        k1, k2 = (
+            jax.random.split(key) if key is not None else (None, None)
+        )
+        fg_mask = _sample_mask(jnp.zeros((A,)), fg_cand, fg_cap, k1)
+        # one ranked draw of S rows: selected fg first (priority 3), then
+        # bg candidates (1), then a finite fallback tier of remaining
+        # inside anchors (never reached when bg candidates >= S, the
+        # overwhelmingly common case) — replaces the reference's two
+        # std::shuffle passes with a static top_k
+        jit = (
+            jax.random.uniform(k2, (A,)) if k2 is not None
+            else jnp.zeros((A,))
+        )
+        prio = jnp.where(
+            fg_mask, 3.0,
+            jnp.where(
+                bg_cand, 1.0,
+                jnp.where(inside & ~fg_cand, -10.0 - max_iou, -jnp.inf),
+            ),
+        ) + jit
+        _, rows = jax.lax.top_k(prio, S)
+        row_is_fg = fg_mask[rows]
+        labels = row_is_fg.astype(jnp.int32)
+        tgt = _box_to_delta(anchors[rows], gtb[argmax_gt[rows]])
+        tgt = jnp.where(row_is_fg[:, None], tgt, 0.0)
+        w_in = jnp.where(row_is_fg[:, None], 1.0, 0.0) * jnp.ones((S, 4))
+        return rows, labels, tgt, w_in
+
+    outs = [
+        one_image(gt_boxes[i], gt_lens[i], is_crowd[i], im_info[i],
+                  keys[i] if use_random else None)
+        for i in range(N)
+    ]
+    rows = jnp.concatenate(
+        [o[0] + i * A for i, o in enumerate(outs)]
+    ).astype(jnp.int32)
+    labels = jnp.concatenate([o[1] for o in outs])[:, None]
+    tgt = jnp.concatenate([o[2] for o in outs])
+    w_in = jnp.concatenate([o[3] for o in outs])
+    return {
+        "LocationIndex": [rows],
+        "ScoreIndex": [rows],
+        "TargetLabel": [labels],
+        "TargetBBox": [tgt],
+        "BBoxInsideWeight": [w_in],
+    }
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels
+# ---------------------------------------------------------------------------
+def _gpl_infer(op, block):
+    class_nums = op.attr("class_nums", 81)
+    set_output(block, op, "Rois", [-1, 4], DataType.FP32, lod_level=1)
+    set_output(block, op, "LabelsInt32", [-1, 1], DataType.INT32)
+    set_output(block, op, "BboxTargets", [-1, 4 * class_nums], DataType.FP32)
+    set_output(block, op, "BboxInsideWeights", [-1, 4 * class_nums],
+               DataType.FP32)
+    set_output(block, op, "BboxOutsideWeights", [-1, 4 * class_nums],
+               DataType.FP32)
+
+
+@register_op("generate_proposal_labels", infer_shape=_gpl_infer,
+             no_grad=True, random=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Fast-RCNN RoI sampling (reference:
+    detection/generate_proposal_labels_op.cc SampleRoisForOneImage): gt
+    boxes join the candidate RoIs; IoU >= fg_thresh -> foreground (capped
+    at fg_fraction*batch_size_per_im), bg_thresh_lo <= IoU < bg_thresh_hi
+    -> background; per-class bbox regression targets at the label's 4-col
+    slot.  Static contract: exactly batch_size_per_im rows per image,
+    shortfalls carry zero inside/outside weights and label 0."""
+    rois_in = ins["RpnRois"][0]
+    rois = data(rois_in)                       # [N, R, 4]
+    if rois.ndim == 2:
+        rois = rois[None]
+    roi_lens = lengths(rois_in)
+    N, R = rois.shape[0], rois.shape[1]
+    if roi_lens is None:
+        roi_lens = jnp.full((N,), R, dtype=jnp.int32)
+    gt_classes = data(ins["GtClasses"][0]).reshape(N, -1)   # [N, G]
+    is_crowd = data(ins["IsCrowd"][0]).reshape(N, -1).astype(bool)
+    gtb_in = ins["GtBoxes"][0]
+    gt_boxes = data(gtb_in)
+    if gt_boxes.ndim == 2:
+        gt_boxes = gt_boxes[None]
+    gt_lens = lengths(gtb_in)
+    G = gt_boxes.shape[1]
+    if gt_lens is None:
+        gt_lens = jnp.full((N,), G, dtype=jnp.int32)
+    im_info = data(ins["ImInfo"][0])
+
+    S = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_th = float(attrs.get("fg_thresh", 0.25))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    reg_w = [float(w) for w in attrs.get("bbox_reg_weights",
+                                         [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    fg_cap = int(np.round(fg_frac * S))
+    C = R + G  # candidates: rois + gt boxes
+
+    keys = jax.random.split(ctx.rng(), N) if use_random else [None] * N
+
+    def one_image(img_rois, rl, gtb, gl, gtc, crowd, key):
+        cand = jnp.concatenate([img_rois, gtb], axis=0)      # [C, 4]
+        cand_valid = jnp.concatenate(
+            [jnp.arange(R) < rl, jnp.arange(G) < gl]
+        )
+        gt_valid = (jnp.arange(G) < gl) & ~crowd
+        iou = _iou(cand, gtb, normalized=False)
+        iou = jnp.where(gt_valid[None, :] & cand_valid[:, None], iou, -1.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+
+        fg_cand = cand_valid & (max_iou >= fg_th)
+        bg_cand = cand_valid & (max_iou < bg_hi) & (max_iou >= bg_lo)
+        k1, k2 = jax.random.split(key) if key is not None else (None, None)
+        fg_mask = _sample_mask(jnp.zeros((C,)), fg_cand, fg_cap, k1)
+        # ranked draw (see rpn_target_assign): sampled fg > bg candidates >
+        # fallback tier of any other valid candidate (label 0, weight 0)
+        jit = (
+            jax.random.uniform(k2, (C,)) if k2 is not None
+            else jnp.zeros((C,))
+        )
+        prio = jnp.where(
+            fg_mask, 3.0,
+            jnp.where(
+                bg_cand & ~fg_mask, 1.0,
+                jnp.where(cand_valid & ~fg_mask, -10.0, -jnp.inf),
+            ),
+        ) + jit
+        _, rows = jax.lax.top_k(prio, S)
+        row_is_fg = fg_mask[rows]
+
+        out_rois = cand[rows]
+        label = jnp.where(
+            row_is_fg, gtc[argmax_gt[rows]].astype(jnp.int32), 0
+        )
+        deltas = _box_to_delta(out_rois, gtb[argmax_gt[rows]], reg_w)
+        # scatter per-class: slot 4*label..4*label+4
+        tgt = jnp.zeros((S, class_nums, 4))
+        w = jnp.zeros((S, class_nums, 4))
+        lab_idx = jnp.clip(label, 0, class_nums - 1)
+        tgt = tgt.at[jnp.arange(S), lab_idx].set(
+            jnp.where(row_is_fg[:, None], deltas, 0.0)
+        )
+        w = w.at[jnp.arange(S), lab_idx].set(
+            jnp.where(row_is_fg[:, None], 1.0, 0.0)
+        )
+        return out_rois, label, tgt.reshape(S, -1), w.reshape(S, -1)
+
+    outs = [
+        one_image(rois[i], roi_lens[i], gt_boxes[i], gt_lens[i],
+                  gt_classes[i], is_crowd[i],
+                  keys[i] if use_random else None)
+        for i in range(N)
+    ]
+    out_rois = jnp.stack([o[0] for o in outs])          # [N, S, 4]
+    counts = jnp.full((N,), S, dtype=jnp.int32)
+    labels = jnp.concatenate([o[1] for o in outs])[:, None]
+    tgts = jnp.concatenate([o[2] for o in outs])
+    ws = jnp.concatenate([o[3] for o in outs])
+    return {
+        "Rois": [LoDValue(out_rois, counts)],
+        "LabelsInt32": [labels],
+        "BboxTargets": [tgts],
+        "BboxInsideWeights": [ws],
+        "BboxOutsideWeights": [ws],
+    }
+
+
+# ---------------------------------------------------------------------------
+# polygon_box_transform
+# ---------------------------------------------------------------------------
+def _pbt_infer(op, block):
+    x = in_desc(op, block, "Input")
+    if x is None:
+        return
+    set_output(block, op, "Output", x.shape, x.dtype)
+
+
+@register_op("polygon_box_transform", infer_shape=_pbt_infer, no_grad=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """EAST geometry-map to corner-coordinate transform (reference:
+    detection/polygon_box_transform_op.cc): even channels produce
+    4*w - in, odd channels 4*h - in."""
+    x = data(ins["Input"][0])  # [N, geo_c, H, W]
+    N, C, H, W = x.shape
+    wgrid = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4.0
+    hgrid = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = jnp.arange(C)[None, :, None, None] % 2 == 0
+    out = jnp.where(even, wgrid - x, hgrid - x)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# detection_map
+# ---------------------------------------------------------------------------
+def _detection_map_infer(op, block):
+    set_output(block, op, "MAP", [1], DataType.FP32)
+    set_output(block, op, "AccumPosCount", [-1, 1], DataType.INT32)
+    set_output(block, op, "AccumTruePos", [-1, 2], DataType.FP32)
+    set_output(block, op, "AccumFalsePos", [-1, 2], DataType.FP32)
+
+
+@register_op("detection_map", infer_shape=_detection_map_infer, no_grad=True)
+def _detection_map(ctx, ins, attrs):
+    """Mean average precision over a batch of detections (reference:
+    operators/detection_map_op.h): per class, detections sorted by score
+    greedily match unclaimed gt with IoU > overlap_threshold; AP by 11-point
+    interpolation or integral.  The streaming-state inputs
+    (PosCount/TruePos/FalsePos) of the reference are not modelled — this
+    computes the batch mAP directly (the repo's evaluator accumulates on
+    host); Accum* outputs are emitted as empty-contract placeholders."""
+    det_in = ins["DetectRes"][0]
+    det = data(det_in)          # [N, D, 6] label, score, x1, y1, x2, y2
+    if det.ndim == 2:
+        det = det[None]
+    det_lens = lengths(det_in)
+    N, D = det.shape[0], det.shape[1]
+    if det_lens is None:
+        det_lens = jnp.full((N,), D, dtype=jnp.int32)
+    lab_in = ins["Label"][0]
+    lab = data(lab_in)
+    if lab.ndim == 2:
+        lab = lab[None]
+    lab_lens = lengths(lab_in)
+    G = lab.shape[1]
+    if lab_lens is None:
+        lab_lens = jnp.full((N,), G, dtype=jnp.int32)
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs.get("class_num", 21))
+    background = int(attrs.get("background_label", 0))
+
+    # label rows: [label, difficult, x1, y1, x2, y2] (6 cols) or
+    # [label, x1, y1, x2, y2] (5 cols, nothing difficult)
+    has_diff = lab.shape[-1] == 6
+    g_label = lab[..., 0].astype(jnp.int32)
+    g_diff = lab[..., 1].astype(bool) if has_diff else jnp.zeros(
+        (N, G), dtype=bool)
+    g_box = lab[..., 2:6] if has_diff else lab[..., 1:5]
+    g_valid = jnp.arange(G)[None, :] < lab_lens[:, None]
+    if not evaluate_difficult:
+        g_count_valid = g_valid & ~g_diff
+    else:
+        g_count_valid = g_valid
+
+    d_label = det[..., 0].astype(jnp.int32)
+    d_score = det[..., 1]
+    d_box = det[..., 2:6]
+    d_valid = jnp.arange(D)[None, :] < det_lens[:, None]
+
+    # class-independent IoU, computed ONCE (not per class): [N, D, G]
+    iou_all = jax.vmap(lambda db, gb: _iou(db, gb, normalized=True))(
+        d_box, g_box)
+
+    def image_tp_fp(iou0, ds, dl, dv, gl, gdiff, gv, cls):
+        """Greedy match one image's class-c detections in score order.
+        Matching runs against ALL valid gts of the class — including
+        difficult ones (detection_map_op.h): a detection matching a
+        difficult gt is neither tp nor fp when evaluate_difficult=False."""
+        dmask = dv & (dl == cls)
+        gmask = gv & (gl == cls)
+        iou = jnp.where(gmask[None, :], iou0, -1.0)
+        order = jnp.argsort(-jnp.where(dmask, ds, -jnp.inf))
+
+        def body(claimed, di):
+            act = dmask[di]
+            best_g = jnp.argmax(iou[di])
+            best = iou[di, best_g]
+            hit = act & (best > overlap_t)
+            difficult = hit & gdiff[best_g]
+            skip = difficult & (not evaluate_difficult)
+            fresh = hit & ~claimed[best_g] & ~skip
+            claimed = jnp.where(fresh, claimed.at[best_g].set(True), claimed)
+            tp = fresh
+            fp = act & ~fresh & ~skip
+            return claimed, (di, tp, fp)
+
+        claimed0 = jnp.zeros((G,), dtype=bool)
+        _, (dis, tps, fps) = jax.lax.scan(body, claimed0, order)
+        tp_flat = jnp.zeros((D,), dtype=bool).at[dis].set(tps)
+        fp_flat = jnp.zeros((D,), dtype=bool).at[dis].set(fps)
+        return tp_flat, fp_flat
+
+    aps = []
+    ap_valid = []
+    for cls in range(class_num):
+        if cls == background:
+            continue
+        tps, fps = jax.vmap(
+            lambda iou0, ds, dl, dv, glb, gdf, gv: image_tp_fp(
+                iou0, ds, dl, dv, glb, gdf, gv, cls)
+        )(iou_all, d_score, d_label, d_valid, g_label, g_diff, g_valid)
+        n_pos = jnp.sum(g_count_valid & (g_label == cls))
+        # global score order across the batch
+        flat_s = jnp.where((d_label == cls) & d_valid, d_score,
+                           -jnp.inf).reshape(-1)
+        order = jnp.argsort(-flat_s)
+        tp_o = tps.reshape(-1)[order]
+        fp_o = fps.reshape(-1)[order]
+        ctp = jnp.cumsum(tp_o)
+        cfp = jnp.cumsum(fp_o)
+        active = jnp.isfinite(flat_s[order]) & (tp_o | fp_o)
+        prec = ctp / jnp.maximum(ctp + cfp, 1)
+        rec = ctp / jnp.maximum(n_pos, 1)
+        if ap_type == "11point":
+            pts = []
+            for t in np.arange(0.0, 1.01, 0.1):
+                m = active & (rec >= t)
+                pts.append(jnp.max(jnp.where(m, prec, 0.0)))
+            ap = jnp.mean(jnp.stack(pts))
+        else:
+            drec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), rec]))
+            ap = jnp.sum(jnp.where(active, prec * drec, 0.0))
+        aps.append(jnp.where(n_pos > 0, ap, 0.0))
+        ap_valid.append((n_pos > 0).astype(jnp.float32))
+
+    ap_sum = sum(aps)
+    n_cls = sum(ap_valid)
+    m_ap = jnp.where(n_cls > 0, ap_sum / jnp.maximum(n_cls, 1.0), 0.0)
+    return {
+        "MAP": [m_ap.reshape(1).astype(jnp.float32)],
+        "AccumPosCount": [jnp.zeros((1, 1), dtype=jnp.int32)],
+        "AccumTruePos": [jnp.zeros((1, 2), dtype=jnp.float32)],
+        "AccumFalsePos": [jnp.zeros((1, 2), dtype=jnp.float32)],
+    }

@@ -372,3 +372,185 @@ def _yolov3_loss(ctx, ins, attrs):
 def _bce(p, t):
     p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
     return -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+
+
+# ---------------------------------------------------------------------------
+# psroi_pool
+# ---------------------------------------------------------------------------
+def _psroi_pool_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    oc = op.attr("output_channels", 1)
+    set_output(block, op, "Out", [-1, oc, ph, pw], x.dtype)
+
+
+@register_op("psroi_pool", infer_shape=_psroi_pool_infer, diff_inputs=["X"])
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive RoI average pooling (reference: psroi_pool_op.h):
+    output channel c's bin (i, j) averages input channel
+    (c*ph + i)*pw + j over the bin's region.  Bin bounds are data-dependent,
+    so each bin is a masked mean over the full H x W map — O(HW) per bin but
+    fully static and MXU/VPU-fusible."""
+    x = data(ins["X"][0])  # [N, C_in, H, W], C_in = oc*ph*pw
+    rois, valid, _ = _rois_batched(ins["ROIs"][0], x.shape[0])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    oc = int(attrs.get("output_channels", 1))
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    N, C_in, H, W = x.shape
+    R = rois.shape[1]
+    hg = jnp.arange(H, dtype=x.dtype)
+    wg = jnp.arange(W, dtype=x.dtype)
+
+    def one_roi(feat, roi):
+        # psroi_pool_op.h: rounded roi corners, +1 on the end corner
+        x1 = jnp.round(roi[0]) * spatial_scale
+        y1 = jnp.round(roi[1]) * spatial_scale
+        x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        i = jnp.arange(ph, dtype=x.dtype)
+        j = jnp.arange(pw, dtype=x.dtype)
+        hstart = jnp.clip(jnp.floor(i * bin_h + y1), 0, H)      # [ph]
+        hend = jnp.clip(jnp.ceil((i + 1) * bin_h + y1), 0, H)
+        wstart = jnp.clip(jnp.floor(j * bin_w + x1), 0, W)
+        wend = jnp.clip(jnp.ceil((j + 1) * bin_w + x1), 0, W)
+        hmask = (
+            (hg[None, :] >= hstart[:, None]) & (hg[None, :] < hend[:, None])
+        ).astype(x.dtype)  # [ph, H]
+        wmask = (
+            (wg[None, :] >= wstart[:, None]) & (wg[None, :] < wend[:, None])
+        ).astype(x.dtype)  # [pw, W]
+        # feat regrouped: [oc, ph, pw, H, W]
+        fr = feat.reshape(oc, ph, pw, H, W)
+        sums = jnp.einsum("cijhw,ih,jw->cij", fr, hmask, wmask)
+        counts = (
+            jnp.sum(hmask, axis=1)[:, None] * jnp.sum(wmask, axis=1)[None, :]
+        )
+        return jnp.where(counts[None] > 0, sums / jnp.maximum(counts, 1.0),
+                         0.0)
+
+    def per_image(feat, img_rois):
+        return jax.vmap(lambda r: one_roi(feat, r))(img_rois)
+
+    out = jax.vmap(per_image)(x, rois)  # [N, R, oc, ph, pw]
+    out = out * valid[..., None, None, None]
+    return {"Out": [out.reshape(N * R, oc, ph, pw)]}
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform
+# ---------------------------------------------------------------------------
+def _roi_perspective_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    th = op.attr("transformed_height", 1)
+    tw = op.attr("transformed_width", 1)
+    set_output(block, op, "Out", [-1, x.shape[1], th, tw], x.dtype)
+
+
+def _in_quad(px, py, qx, qy):
+    """Ray-crossing point-in-quadrilateral test, with the reference's
+    on-edge tolerance (roi_perspective_transform_op.cc in_quad: a point
+    within 1e-4 of any edge segment counts as inside)."""
+    inside = jnp.zeros(jnp.shape(px), dtype=bool)
+    on_edge = jnp.zeros(jnp.shape(px), dtype=bool)
+    for i in range(4):
+        xs, ys = qx[i], qy[i]
+        xe, ye = qx[(i + 1) % 4], qy[(i + 1) % 4]
+        # point-to-segment distance for the boundary tolerance
+        dx, dy = xe - xs, ye - ys
+        seg2 = dx * dx + dy * dy
+        t = jnp.clip(
+            ((px - xs) * dx + (py - ys) * dy) / jnp.maximum(seg2, 1e-12),
+            0.0, 1.0,
+        )
+        dist2 = (px - (xs + t * dx)) ** 2 + (py - (ys + t * dy)) ** 2
+        on_edge = on_edge | (dist2 < 1e-6)
+        flat = jnp.abs(ys - ye) < 1e-4
+        in_y = (py >= jnp.minimum(ys, ye) - 1e-4) & (
+            py <= jnp.maximum(ys, ye) + 1e-4
+        )
+        ix = (py - ys) * (xe - xs) / jnp.where(flat, 1.0, ye - ys) + xs
+        cross = (~flat) & in_y & (ix > px)
+        inside = inside ^ cross
+    return inside | on_edge
+
+
+@register_op("roi_perspective_transform",
+             infer_shape=_roi_perspective_infer, diff_inputs=["X"])
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quadrilateral RoIs to a rectangle (reference:
+    detection/roi_perspective_transform_op.cc): per RoI of 8 coords
+    (x0,y0..x3,y3), build the 3x3 homography from the output rect to the
+    quad (get_transform_matrix), bilinear-sample inside the quad, zero
+    outside."""
+    x = data(ins["X"][0])  # [N, C, H, W]
+    rois, valid, _ = _rois_batched(ins["ROIs"][0], x.shape[0])  # [N, R, 8]
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[1]
+
+    def one_roi(feat, roi):
+        qx = [roi[2 * k] * spatial_scale for k in range(4)]
+        qy = [roi[2 * k + 1] * spatial_scale for k in range(4)]
+        x0, x1, x2, x3 = qx
+        y0, y1, y2, y3 = qy
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        # reference clamps: normalized sizes never below 2, so the (n-1)
+        # divisors below are always >= 1 (roi_perspective_transform_op.cc
+        # get_transform_matrix)
+        nh = float(max(th, 2))
+        nw = jnp.clip(
+            jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-6)) + 1.0,
+            2.0, float(max(tw, 2)),
+        )
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1
+        den = jnp.where(jnp.abs(den) < 1e-10, 1e-10, den)
+        a31 = (dx3 * dy2 - dx2 * dy3) / den / jnp.maximum(nw - 1, 1e-6)
+        a32 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        a21 = (y1 - y0 + a31 * (nw - 1) * y1) / jnp.maximum(nw - 1, 1e-6)
+        a22 = (y3 - y0 + a32 * (nh - 1) * y3) / (nh - 1)
+        a11 = (x1 - x0 + a31 * (nw - 1) * x1) / jnp.maximum(nw - 1, 1e-6)
+        a12 = (x3 - x0 + a32 * (nh - 1) * x3) / (nh - 1)
+
+        ow, oh = jnp.meshgrid(
+            jnp.arange(tw, dtype=x.dtype), jnp.arange(th, dtype=x.dtype)
+        )  # [th, tw]
+        u = a11 * ow + a12 * oh + x0
+        v = a21 * ow + a22 * oh + y0
+        w_ = a31 * ow + a32 * oh + 1.0
+        in_w = u / jnp.where(jnp.abs(w_) < 1e-10, 1e-10, w_)
+        in_h = v / jnp.where(jnp.abs(w_) < 1e-10, 1e-10, w_)
+        ok = (
+            _in_quad(in_w, in_h, qx, qy)
+            & (in_w >= -0.5) & (in_w <= W - 0.5)
+            & (in_h >= -0.5) & (in_h <= H - 0.5)
+        )
+        vals = _bilinear_sample(
+            feat, jnp.clip(in_h, 0, H - 1), jnp.clip(in_w, 0, W - 1)
+        )  # [C, th, tw]
+        return vals * ok[None]
+
+    def per_image(feat, img_rois):
+        return jax.vmap(lambda r: one_roi(feat, r))(img_rois)
+
+    out = jax.vmap(per_image)(x, rois)  # [N, R, C, th, tw]
+    out = out * valid[..., None, None, None]
+    return {"Out": [out.reshape(N * R, C, th, tw)]}

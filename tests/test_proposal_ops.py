@@ -1,0 +1,301 @@
+"""Proposal/RPN family tests (reference: test_generate_proposals.py,
+test_rpn_target_assign_op.py, test_generate_proposal_labels.py,
+test_psroi_pool_op.py, test_polygon_box_transform.py,
+test_roi_perspective_transform_op.py, test_detection_map_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDValue, create_lod_tensor
+
+
+def _run_op(op_type, inputs, attrs, out_slots):
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        feed = {}
+        in_names = {}
+        for slot, v in inputs.items():
+            name = slot.lower()
+            if isinstance(v, LoDValue):
+                shape = list(np.shape(v.data))
+                dtype = v.data.dtype
+                lod_level = 1
+            else:
+                v = np.asarray(v)
+                shape = list(v.shape)
+                dtype = v.dtype
+                lod_level = 0
+            block.create_var(name=name, shape=shape, dtype=dtype,
+                             lod_level=lod_level)
+            feed[name] = v
+            in_names[slot] = [name]
+        out_names = {s: [f"out_{s.lower()}"] for s in out_slots}
+        block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.program_guard(prog, startup):
+        fetch = [n for ns in out_names.values() for n in ns]
+        got = exe.run(program=prog, feed=feed, fetch_list=fetch,
+                      return_numpy=False)
+    return dict(zip(out_slots, got))
+
+
+def test_polygon_box_transform():
+    x = np.random.RandomState(0).randn(2, 8, 3, 4).astype("float32")
+    res = _run_op("polygon_box_transform", {"Input": x}, {}, ["Output"])
+    out = np.asarray(res["Output"])
+    want = np.zeros_like(x)
+    for c in range(8):
+        for h in range(3):
+            for w in range(4):
+                if c % 2 == 0:
+                    want[:, c, h, w] = 4.0 * w - x[:, c, h, w]
+                else:
+                    want[:, c, h, w] = 4.0 * h - x[:, c, h, w]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_generate_proposals_basic():
+    """Two anchors on a 1x1 map: zero deltas keep the anchors; NMS keeps the
+    higher-score one when they overlap fully."""
+    H = W = 1
+    A = 2
+    scores = np.array([[[[0.9]], [[0.8]]]], dtype="float32")  # [1, A, 1, 1]
+    deltas = np.zeros((1, 4 * A, H, W), dtype="float32")
+    anchors = np.array(
+        [[[[0, 0, 9, 9], [0, 0, 9, 9]]]], dtype="float32"
+    )  # [H, W, A, 4] identical -> IoU 1
+    variances = np.ones((H, W, A, 4), dtype="float32")
+    im_info = np.array([[20.0, 20.0, 1.0]], dtype="float32")
+    res = _run_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"pre_nms_topN": 10, "post_nms_topN": 5, "nms_thresh": 0.5,
+         "min_size": 1.0, "eta": 1.0},
+        ["RpnRois", "RpnRoiProbs"],
+    )
+    rois = res["RpnRois"]
+    assert isinstance(rois, LoDValue)
+    counts = np.asarray(rois.lengths)
+    assert counts[0] == 1, f"NMS should keep 1 of 2 identical boxes, {counts}"
+    np.testing.assert_allclose(
+        np.asarray(rois.data)[0, 0], [0, 0, 9, 9], atol=1e-4)
+    probs = np.asarray(res["RpnRoiProbs"].data)
+    np.testing.assert_allclose(probs[0, 0, 0], 0.9, atol=1e-5)
+
+
+def test_generate_proposals_min_size_filter():
+    """A degenerate (tiny) anchor is filtered by min_size."""
+    H = W = 1
+    A = 2
+    scores = np.array([[[[0.9]], [[0.95]]]], dtype="float32")
+    deltas = np.zeros((1, 4 * A, H, W), dtype="float32")
+    anchors = np.array(
+        [[[[0, 0, 9, 9], [5, 5, 5.5, 5.5]]]], dtype="float32"
+    )
+    variances = np.ones((H, W, A, 4), dtype="float32")
+    im_info = np.array([[20.0, 20.0, 1.0]], dtype="float32")
+    res = _run_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"pre_nms_topN": 10, "post_nms_topN": 5, "nms_thresh": 0.5,
+         "min_size": 3.0, "eta": 1.0},
+        ["RpnRois", "RpnRoiProbs"],
+    )
+    counts = np.asarray(res["RpnRois"].lengths)
+    assert counts[0] == 1
+    np.testing.assert_allclose(
+        np.asarray(res["RpnRois"].data)[0, 0], [0, 0, 9, 9], atol=1e-4)
+
+
+def test_rpn_target_assign_static():
+    """4 anchors, 1 gt: the overlapping anchor goes fg, others bg; output is
+    exactly S rows with fg first."""
+    anchors = np.array(
+        [[0, 0, 9, 9], [20, 20, 29, 29], [40, 40, 49, 49], [0, 20, 9, 29]],
+        dtype="float32",
+    )
+    gt = create_lod_tensor(
+        np.array([[0, 0, 9, 9]], dtype="float32"), [[1]])
+    crowd = create_lod_tensor(np.zeros((1, 1), dtype="float32"), [[1]])
+    im_info = np.array([[60.0, 60.0, 1.0]], dtype="float32")
+    res = _run_op(
+        "rpn_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt, "IsCrowd": crowd,
+         "ImInfo": im_info},
+        {"rpn_batch_size_per_im": 4, "rpn_straddle_thresh": 0.0,
+         "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+         "rpn_fg_fraction": 0.5, "use_random": False},
+        ["LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+         "BBoxInsideWeight"],
+    )
+    loc = np.asarray(res["LocationIndex"])
+    label = np.asarray(res["TargetLabel"]).ravel()
+    w = np.asarray(res["BBoxInsideWeight"])
+    tgt = np.asarray(res["TargetBBox"])
+    assert loc.shape == (4,)
+    assert label[0] == 1 and label[1:].sum() == 0
+    assert loc[0] == 0  # anchor 0 is the only fg
+    np.testing.assert_allclose(w[0], 1.0)
+    np.testing.assert_allclose(w[1:], 0.0)
+    # perfect overlap -> zero regression target
+    np.testing.assert_allclose(tgt[0], 0.0, atol=1e-5)
+
+
+def test_generate_proposal_labels_static():
+    rois = create_lod_tensor(
+        np.array([[0, 0, 9, 9], [30, 30, 39, 39], [0, 0, 8, 8]],
+                 dtype="float32"),
+        [[3]],
+    )
+    gt_classes = create_lod_tensor(
+        np.array([[3]], dtype="float32"), [[1]])
+    crowd = create_lod_tensor(np.zeros((1, 1), dtype="float32"), [[1]])
+    gt_boxes = create_lod_tensor(
+        np.array([[0, 0, 9, 9]], dtype="float32"), [[1]])
+    im_info = np.array([[60.0, 60.0, 1.0]], dtype="float32")
+    S = 4
+    res = _run_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gt_classes, "IsCrowd": crowd,
+         "GtBoxes": gt_boxes, "ImInfo": im_info},
+        {"batch_size_per_im": S, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+         "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2], "class_nums": 5,
+         "use_random": False},
+        ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+         "BboxOutsideWeights"],
+    )
+    out_rois = np.asarray(res["Rois"].data)
+    labels = np.asarray(res["LabelsInt32"]).ravel()
+    win = np.asarray(res["BboxInsideWeights"])
+    assert out_rois.shape == (1, S, 4)
+    # fg candidates: roi0 (IoU 1), roi2 (IoU ~0.81), gt itself (IoU 1) ->
+    # capped at fg_fraction*S = 2
+    assert (labels == 3).sum() == 2
+    # fg rows carry per-class weights at class-3 slot
+    fg_rows = np.where(labels == 3)[0]
+    for r in fg_rows:
+        assert win[r, 12:16].sum() == 4.0
+        assert win[r, :12].sum() == 0.0 and win[r, 16:].sum() == 0.0
+
+
+def test_psroi_pool():
+    oc, ph, pw = 2, 2, 2
+    x = np.arange(1 * oc * ph * pw * 4 * 4, dtype="float32").reshape(
+        1, oc * ph * pw, 4, 4)
+    rois = create_lod_tensor(
+        np.array([[0, 0, 3, 3]], dtype="float32"), [[1]])
+    res = _run_op(
+        "psroi_pool", {"X": x, "ROIs": rois},
+        {"output_channels": oc, "pooled_height": ph, "pooled_width": pw,
+         "spatial_scale": 1.0},
+        ["Out"],
+    )
+    out = np.asarray(res["Out"])
+    assert out.shape == (1, oc, ph, pw)
+    # bin (i,j) of output channel c averages channel (c*ph+i)*pw+j over the
+    # bin region: roi = whole 4x4 map -> bins are 2x2 quadrants
+    for c in range(oc):
+        for i in range(ph):
+            for j in range(pw):
+                chan = (c * ph + i) * pw + j
+                patch = x[0, chan, i * 2:(i + 1) * 2, j * 2:(j + 1) * 2]
+                np.testing.assert_allclose(out[0, c, i, j], patch.mean(),
+                                           rtol=1e-5)
+
+
+def test_roi_perspective_transform_identity():
+    """An axis-aligned square RoI warps to itself (identity homography)."""
+    H = W = 6
+    x = np.random.RandomState(1).rand(1, 1, H, W).astype("float32")
+    th = tw = 4
+    # square quad covering [1, 4] x [1, 4], corners clockwise from top-left
+    rois = create_lod_tensor(
+        np.array([[1, 1, 4, 1, 4, 4, 1, 4]], dtype="float32"), [[1]])
+    res = _run_op(
+        "roi_perspective_transform", {"X": x, "ROIs": rois},
+        {"transformed_height": th, "transformed_width": tw,
+         "spatial_scale": 1.0},
+        ["Out"],
+    )
+    out = np.asarray(res["Out"])
+    assert out.shape == (1, 1, th, tw)
+    # output grid maps linearly onto [1,4]^2: out[i,j] = x[1+i, 1+j]
+    np.testing.assert_allclose(out[0, 0], x[0, 0, 1:5, 1:5], atol=1e-4)
+
+
+def test_detection_map_perfect_and_half():
+    # image 0: one gt of class 1, one perfect detection -> AP 1
+    # image 1: one gt of class 1, detection misses -> adds a FP + missed gt
+    det = create_lod_tensor(
+        np.array([
+            [1, 0.9, 10, 10, 20, 20],
+            [1, 0.8, 50, 50, 60, 60],
+        ], dtype="float32"),
+        [[1, 1]],
+    )
+    gt = create_lod_tensor(
+        np.array([
+            [1, 0, 10, 10, 20, 20],
+            [1, 0, 0, 0, 5, 5],
+        ], dtype="float32"),
+        [[1, 1]],
+    )
+    res = _run_op(
+        "detection_map", {"DetectRes": det, "Label": gt},
+        {"overlap_threshold": 0.5, "class_num": 2, "background_label": 0,
+         "ap_type": "integral", "evaluate_difficult": True},
+        ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
+    )
+    m = float(np.asarray(res["MAP"])[0])
+    # integral AP: dets sorted (0.9 tp, 0.8 fp), npos=2:
+    # rec 0.5 @ prec 1, then prec 0.5 no rec gain -> AP = 0.5
+    np.testing.assert_allclose(m, 0.5, atol=1e-5)
+
+
+def test_detection_map_11point():
+    det = create_lod_tensor(
+        np.array([[1, 0.9, 10, 10, 20, 20]], dtype="float32"), [[1]])
+    gt = create_lod_tensor(
+        np.array([[1, 0, 10, 10, 20, 20]], dtype="float32"), [[1]])
+    res = _run_op(
+        "detection_map", {"DetectRes": det, "Label": gt},
+        {"overlap_threshold": 0.5, "class_num": 2, "background_label": 0,
+         "ap_type": "11point", "evaluate_difficult": True},
+        ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
+    )
+    np.testing.assert_allclose(float(np.asarray(res["MAP"])[0]), 1.0,
+                               atol=1e-5)
+
+
+def test_detection_map_difficult_ignored():
+    """A detection matching a difficult gt is neither tp nor fp when
+    evaluate_difficult=False; the difficult gt doesn't count toward npos."""
+    det = create_lod_tensor(
+        np.array([
+            [1, 0.9, 10, 10, 20, 20],   # matches the difficult gt
+            [1, 0.8, 50, 50, 60, 60],   # matches the normal gt
+        ], dtype="float32"),
+        [[2]],
+    )
+    gt = create_lod_tensor(
+        np.array([
+            [1, 1, 10, 10, 20, 20],     # difficult
+            [1, 0, 50, 50, 60, 60],     # normal
+        ], dtype="float32"),
+        [[2]],
+    )
+    res = _run_op(
+        "detection_map", {"DetectRes": det, "Label": gt},
+        {"overlap_threshold": 0.5, "class_num": 2, "background_label": 0,
+         "ap_type": "integral", "evaluate_difficult": False},
+        ["MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"],
+    )
+    # npos=1 (difficult excluded); det0 ignored, det1 tp -> AP = 1
+    np.testing.assert_allclose(float(np.asarray(res["MAP"])[0]), 1.0,
+                               atol=1e-5)
