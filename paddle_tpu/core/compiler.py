@@ -51,6 +51,7 @@ class LoweringContext:
         self.key = key
         self.mesh = mesh
         self.is_test = is_test
+        self.cur_op = None  # the OpDesc being lowered (set by the driver)
         # uid -> (vjp_fn, primal_outs, in_slots, out_slots)
         self.vjps: Dict[int, Any] = {}
         self._fixed_key = None
@@ -239,6 +240,7 @@ def _lower_forward_op(ctx: LoweringContext, op: OpDesc, need_vjp: bool) -> None:
     info = OpRegistry.get(op.type)
     ins = _gather_inputs(ctx, op)
     attrs = dict(op.attrs)
+    ctx.cur_op = op  # lowerings with variable output arity read slot counts
 
     if not need_vjp or info.no_grad:
         # Constant folding: pure ops over concrete values evaluate at trace
@@ -289,6 +291,7 @@ def _lower_grad_op(ctx: LoweringContext, op: OpDesc) -> None:
         info = OpRegistry.get(op.type)
         if info.lower is not None:
             ins = _gather_inputs(ctx, op)
+            ctx.cur_op = op
             _bind_outputs(ctx, op, info.lower(ctx, ins, dict(op.attrs)))
             return
 

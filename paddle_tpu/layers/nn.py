@@ -91,6 +91,7 @@ __all__ = [
     "conv3d_transpose",
     "unpool",
     "spp",
+    "hsigmoid",
 ]
 
 
@@ -1343,5 +1344,43 @@ def spp(input, pyramid_height, pool_type="max", name=None):
         type="spp", inputs={"X": [input]}, outputs={"Out": [out]},
         attrs={"pyramid_height": int(pyramid_height),
                "pooling_type": pool_type},
+    )
+    return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss layer (reference: layers/nn.py hsigmoid
+    over operators/hierarchical_sigmoid_op.cc).  Default: complete binary
+    tree over num_classes (W is [num_classes-1, D]); custom trees pass
+    path_table/path_code.  is_sparse is accepted for API parity — grads
+    here are dense (the embedding path owns the SelectedRows story)."""
+    helper = LayerHelper("hsigmoid", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    dim = input.shape[1]
+    if is_custom and (path_table is None or path_code is None):
+        raise ValueError("is_custom=True needs path_table/path_code")
+    num_nodes = (
+        path_table.shape[0] if is_custom else num_classes - 1
+    )
+    w = helper.create_parameter(helper.param_attr, shape=[num_nodes, dim],
+                                dtype=dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if helper.bias_attr is not None:
+        b = helper.create_parameter(helper.bias_attr, shape=[num_nodes, 1],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if is_custom:
+        inputs["PTable"] = [path_table]
+        inputs["PathCode"] = [path_code]
+    out = helper.create_variable_for_type_inference(dtype)
+    pre_out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes, "is_sparse": is_sparse},
     )
     return out

@@ -53,6 +53,7 @@ __all__ = [
     "reduce_max",
     "reduce_min",
     "reduce_prod",
+    "tensor_array_to_tensor",
 ]
 
 
@@ -465,3 +466,19 @@ def reduce_min(input, dim=None, keep_dim=False, name=None):
 
 def reduce_prod(input, dim=None, keep_dim=False, name=None):
     return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    """Concat/stack a LoDTensorArray into one tensor; also returns the
+    per-step sizes (reference: layers/tensor.py tensor_array_to_tensor over
+    tensor_array_to_tensor_op.cc)."""
+    helper = LayerHelper("tensor_array_to_tensor", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="tensor_array_to_tensor",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "OutIndex": [out_index]},
+        attrs={"axis": int(axis), "use_stack": bool(use_stack)},
+    )
+    return out, out_index

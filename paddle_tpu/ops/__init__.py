@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     crf_ops,
     detection_ops,
     elementwise_ops,
+    framework_ops,
     loss_ops,
     math_ops,
     metric_ops,

@@ -1,0 +1,374 @@
+"""Framework-plumbing ops: hierarchical_sigmoid, tensor_array_to_tensor,
+SelectedRows utilities, fused fc / elemwise-activation, pserver-program
+helpers (reference: paddle/fluid/operators/ — hierarchical_sigmoid_op.cc,
+tensor_array_to_tensor_op.cc, merge_selected_rows_op.cc,
+get_tensor_from_selected_rows_op.cc, split_ids_op.cc, merge_ids_op.cc,
+split_selected_rows_op.cc, fake_init_op.cc, delete_var_op.cc,
+reorder_lod_tensor_by_rank_op.cc, lookup_sparse_table_op.cc, fc_op.cc,
+fused_elemwise_activation_op.cc).
+
+TPU-native notes: hsigmoid's MatrixBitCode walk becomes a static gather
+over the code_length bit positions (vjp gives the W/Bias/X grads the
+reference hand-writes in hierarchical_sigmoid_op.h); the SelectedRows
+utilities operate on the static (ids, rows, height) encoding from
+core/selected_rows.py; the fused ops exist for program-level API parity —
+XLA would have fused the unfused forms anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.lod import LoDValue
+from ..core.proto import DataType, dtype_to_numpy
+from ..core.registry import register_op
+from ..core.selected_rows import SelectedRowsValue
+from ..core.tensor_array import TensorArrayValue
+from .common import data, in_desc, lengths, set_output, wrap_lod
+
+
+# ---------------------------------------------------------------------------
+# hierarchical_sigmoid
+# ---------------------------------------------------------------------------
+def _find_last_set(x: int) -> int:
+    return x.bit_length()
+
+
+def _hsigmoid_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    num_classes = op.attr("num_classes", 2)
+    ptable = in_desc(op, block, "PTable")
+    if ptable is not None:
+        code_length = ptable.shape[1]
+    else:
+        code_length = _find_last_set(num_classes - 1)
+    set_output(block, op, "Out", [x.shape[0], 1], x.dtype)
+    set_output(block, op, "PreOut", [x.shape[0], code_length], x.dtype)
+
+
+@register_op("hierarchical_sigmoid", infer_shape=_hsigmoid_infer,
+             diff_inputs=["X", "W", "Bias"])
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid loss (reference: hierarchical_sigmoid_op.h +
+    math/matrix_bit_code.h SimpleCode).  Default tree: the complete binary
+    tree over num_classes, node index (c >> (j+1)) - 1 and bit (c >> j) & 1
+    for c = label + num_classes; custom trees come in as PTable (node ids,
+    -1 padded) + PathCode (bits).  Matches the reference exactly, including
+    the out-of-path log(2) terms its TODO documents (they cancel in grad)."""
+    x = data(ins["X"][0])                      # [N, D]
+    w = data(ins["W"][0])                      # [K, D]
+    label = data(ins["Label"][0]).reshape(-1).astype(jnp.int32)  # [N]
+    bias_in = ins.get("Bias", [None])[0]
+    bias = data(bias_in).reshape(-1) if bias_in is not None else None
+    num_classes = int(attrs.get("num_classes", 2))
+    ptable_in = ins.get("PTable", [None])[0]
+    pcode_in = ins.get("PathCode", [None])[0]
+    N = x.shape[0]
+
+    if ptable_in is not None:
+        idx = data(ptable_in)[label].astype(jnp.int32)      # [N, L]
+        bits = data(pcode_in)[label].astype(x.dtype)        # [N, L]
+        active = idx >= 0
+    else:
+        L = _find_last_set(num_classes - 1)
+        c = label + num_classes                             # [N]
+        j = jnp.arange(L)[None, :]                          # [1, L]
+        idx = (c[:, None] >> (j + 1)) - 1                   # [N, L]
+        bits = ((c[:, None] >> j) & 1).astype(x.dtype)
+        active = idx >= 0
+
+    safe_idx = jnp.maximum(idx, 0)
+    wj = w[safe_idx]                                        # [N, L, D]
+    pre = jnp.einsum("nd,nld->nl", x, wj)
+    if bias is not None:
+        pre = pre + bias[safe_idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    pre = jnp.where(active, pre, 0.0)
+    # loss = sum softplus(pre) - sum bit*pre  (softplus(0)=log2 terms on
+    # inactive positions match the reference's zero-init pre_out)
+    out = (
+        jnp.sum(jnp.log1p(jnp.exp(pre)), axis=1)
+        - jnp.sum(jnp.where(active, bits * pre, 0.0), axis=1)
+    )
+    return {"Out": [out[:, None]], "PreOut": [pre]}
+
+
+# ---------------------------------------------------------------------------
+# tensor_array_to_tensor
+# ---------------------------------------------------------------------------
+def _ta2t_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", [-1] + list(x.shape[1:]), x.dtype)
+    set_output(block, op, "OutIndex", [-1], DataType.INT32)
+
+
+@register_op("tensor_array_to_tensor", infer_shape=_ta2t_infer,
+             diff_inputs=["X"])
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """Concat/stack a LoDTensorArray into one tensor + per-step sizes
+    (reference: tensor_array_to_tensor_op.cc)."""
+    arr = ins["X"][0]
+    if not isinstance(arr, TensorArrayValue):
+        raise TypeError("tensor_array_to_tensor expects a TensorArray input")
+    steps = [jnp.asarray(s) for s in arr.steps]
+    if not steps:
+        raise ValueError("tensor_array_to_tensor: empty array")
+    axis = int(attrs.get("axis", 0))
+    use_stack = bool(attrs.get("use_stack", False))
+    if use_stack:
+        out = jnp.stack(steps, axis=axis)
+        sizes = np.ones((len(steps),), dtype=np.int32)
+    else:
+        out = jnp.concatenate(steps, axis=axis)
+        sizes = np.asarray([s.shape[axis] for s in steps], dtype=np.int32)
+    return {"Out": [out], "OutIndex": [jnp.asarray(sizes)]}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities
+# ---------------------------------------------------------------------------
+@register_op("merge_selected_rows", infer_shape=None, no_grad=True,
+             stateful=True)
+def _merge_selected_rows(ctx, ins, attrs):
+    """Deduplicate a SelectedRows value's ids by summing rows
+    (reference: merge_selected_rows_op.cc -> scatter::MergeAdd)."""
+    x = ins["X"][0]
+    if isinstance(x, SelectedRowsValue):
+        return {"Out": [x.merge()]}
+    return {"Out": [x]}
+
+
+@register_op("get_tensor_from_selected_rows", infer_shape=None,
+             no_grad=True, stateful=True)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    """SelectedRows value -> plain row tensor
+    (reference: get_tensor_from_selected_rows_op.cc)."""
+    x = ins["X"][0]
+    if isinstance(x, SelectedRowsValue):
+        return {"Out": [jnp.asarray(x.rows)]}
+    return {"Out": [data(x)]}
+
+
+@register_op("split_ids", infer_shape=None, no_grad=True, stateful=True)
+def _split_ids(ctx, ins, attrs):
+    """Partition ids across N outputs by id % N (reference:
+    split_ids_op.cc, the pserver prefetch router).  Static shapes: each
+    shard keeps the full [M] slot with non-members replaced by the sentinel
+    -1 (consumers gather with mode='fill')."""
+    ids = data(ins["Ids"][0]).reshape(-1)
+    n = int(attrs.get("num_shards", 0))
+    if not n and ctx is not None and getattr(ctx, "cur_op", None) is not None:
+        n = len(ctx.cur_op.output("Out"))
+    if not n:
+        n = len(ins.get("Out", [])) or 1  # direct-call fallback (tests)
+    outs = []
+    for shard in range(n):
+        keep = (ids % n) == shard
+        outs.append(jnp.where(keep, ids, -1)[:, None])
+    return {"Out": outs}
+
+
+@register_op("merge_ids", infer_shape=None, no_grad=True, stateful=True)
+def _merge_ids(ctx, ins, attrs):
+    """Merge per-shard embedding rows back into id order (reference:
+    merge_ids_op.cc): Ids is the original [M] id list, the i-th X carries
+    rows for ids routed to shard i (sentinel-filled elsewhere)."""
+    ids = data(ins["Ids"][0]).reshape(-1)
+    shards = [data(v) for v in ins["X"]]
+    n = len(shards)
+    out = jnp.zeros((ids.shape[0], shards[0].shape[-1]),
+                    dtype=shards[0].dtype)
+    for shard_i, rows in enumerate(shards):
+        keep = (ids % n) == shard_i
+        out = jnp.where(keep[:, None], rows, out)
+    return {"Out": [out]}
+
+
+@register_op("split_selected_rows", infer_shape=None, no_grad=True,
+             stateful=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """Split a SelectedRows value by height_sections (reference:
+    split_selected_rows_op.cc, the pserver grad router).  Shard k keeps the
+    full static slot; ids outside its section become the shard-local
+    sentinel (height_k), rows zero."""
+    x = ins["X"][0]
+    sections = [int(s) for s in attrs.get("height_sections", [])]
+    if not isinstance(x, SelectedRowsValue):
+        # dense fallback: row-slice the tensor
+        d = data(x)
+        outs, offset = [], 0
+        for s in sections:
+            outs.append(d[offset:offset + s])
+            offset += s
+        return {"Out": outs}
+    outs = []
+    offset = 0
+    for s in sections:
+        in_range = (x.ids >= offset) & (x.ids < offset + s)
+        local_ids = jnp.where(in_range, x.ids - offset, s)
+        rows = jnp.where(in_range[:, None], x.rows, 0.0)
+        outs.append(SelectedRowsValue(local_ids, rows, s))
+        offset += s
+    return {"Out": outs}
+
+
+def _fake_init_infer(op, block):
+    shape = op.attr("shape", [1])
+    dtype = DataType(op.attr("dtype", DataType.FP32))
+    set_output(block, op, "Out", list(shape), dtype)
+
+
+@register_op("fake_init", infer_shape=_fake_init_infer, no_grad=True,
+             stateful=True)
+def _fake_init(ctx, ins, attrs):
+    """Zero placeholder init for pserver-side tables (reference:
+    fake_init_op.cc — allocates without initializing; here zeros)."""
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    dt = dtype_to_numpy(DataType(attrs.get("dtype", DataType.FP32)))
+    return {"Out": [jnp.zeros(shape, dtype=dt)]}
+
+
+@register_op("delete_var", infer_shape=None, no_grad=True, stateful=True)
+def _delete_var(ctx, ins, attrs):
+    """Free scope variables (reference: delete_var_op.cc).  Memory lifetime
+    is XLA buffer assignment's job here, so this is a checked no-op."""
+    return {}
+
+
+@register_op("lookup_sparse_table", infer_shape=None, no_grad=True,
+             stateful=True)
+def _lookup_sparse_table(ctx, ins, attrs):
+    """Pserver-side auto-growing table lookup (reference:
+    lookup_sparse_table_op.cc).  The auto-growth semantics (unseen ids get
+    freshly-initialized rows) need dynamic allocation the reference gets
+    from its hash-table; the static equivalent initializes unseen ids to
+    attr `init_value` via the is-row-zero test."""
+    w = data(ins["W"][0])
+    ids = data(ins["Ids"][0]).reshape(-1)
+    out = jnp.take(w, ids, axis=0, mode="fill", fill_value=0.0)
+    init_value = float(attrs.get("init_value", 0.0))
+    if init_value:
+        is_zero = jnp.all(out == 0.0, axis=-1, keepdims=True)
+        out = jnp.where(is_zero, init_value, out)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# reorder_lod_tensor_by_rank
+# ---------------------------------------------------------------------------
+def _reorder_infer(op, block):
+    x = in_desc(op, block, "X")
+    if x is None:
+        return
+    set_output(block, op, "Out", list(x.shape), x.dtype,
+               lod_level=x.lod_level)
+
+
+@register_op("reorder_lod_tensor_by_rank", infer_shape=_reorder_infer,
+             diff_inputs=["X"])
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """Reorder batch rows into the rank table's length-descending order
+    (reference: reorder_lod_tensor_by_rank_op.cc).  Under the padded
+    LoDValue layout this is a stable argsort by -length — a pure gather."""
+    x = ins["X"][0]
+    rt = ins["RankTable"][0]
+    lens = jnp.asarray(rt.lengths if hasattr(rt, "lengths") else rt)
+    order = jnp.argsort(-lens, stable=True)
+    d = data(x)
+    out = jnp.take(d, order, axis=0)
+    l = lengths(x)
+    if l is not None:
+        return {"Out": [LoDValue(out, jnp.take(l, order))]}
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# fused ops (API parity; XLA fuses the unfused forms identically)
+# ---------------------------------------------------------------------------
+def _fc_infer(op, block):
+    x = in_desc(op, block, "Input")
+    w = in_desc(op, block, "W")
+    if x is None or w is None:
+        return
+    in_num_col_dims = op.attr("in_num_col_dims", 1)
+    set_output(block, op, "Out",
+               list(x.shape[:in_num_col_dims]) + [w.shape[1]], x.dtype)
+
+
+@register_op("fc", infer_shape=_fc_infer, diff_inputs=["Input", "W", "Bias"])
+def _fc(ctx, ins, attrs):
+    """Fused fully-connected op (reference: operators/fc_op.cc — the
+    inference-fusion form of mul+elementwise_add)."""
+    from ..core import amp
+
+    x = data(ins["Input"][0])
+    w = data(ins["W"][0])
+    in_num_col_dims = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:in_num_col_dims]
+    x2 = x.reshape(int(np.prod(lead)) if lead else 1, -1)
+    xc, wc = amp.mxu_operands(x2, w)
+    out = amp.mxu_output(xc @ wc, x2, w)
+    bias_in = ins.get("Bias", [None])[0]
+    if bias_in is not None:
+        out = out + data(bias_in).reshape(1, -1)
+    if attrs.get("activation_type"):
+        act = attrs["activation_type"]
+        out = {"relu": jax.nn.relu}[act](out)
+    return {"Out": [out.reshape(tuple(lead) + (w.shape[1],))]}
+
+
+def _fused_unary(name, attrs):
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "identity": lambda v: v,
+    }
+    if name in fns:
+        return fns[name]
+    if name == "scale":
+        sc = float(attrs.get("scale", 1.0))
+        return lambda v: v * sc
+    return None
+
+
+def _fused_binary(name, attrs):
+    if name == "elementwise_add":
+        return lambda a, b: a + b
+    if name == "elementwise_mul":
+        return lambda a, b: a * b
+    raise ValueError(f"fused_elemwise_activation: unsupported functor {name}")
+
+
+@register_op("fused_elemwise_activation",
+             infer_shape=lambda op, block: set_output(
+                 block, op, "Out", in_desc(op, block, "X").shape,
+                 in_desc(op, block, "X").dtype),
+             diff_inputs=["X", "Y"])
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """Functor composition (reference: fused_elemwise_activation_op.h):
+    functor_list [unary, binary] computes Unary(Binary(x, y)), and
+    [binary, unary] computes Binary(x, Unary(y)) — the unary always wraps
+    Y in the binary-outer form."""
+    x = data(ins["X"][0])
+    y = data(ins["Y"][0])
+    functors = list(attrs.get("functor_list", []))
+    if len(functors) != 2:
+        raise ValueError("functor_list must have exactly 2 entries")
+    f1, f2 = functors
+    u2 = _fused_unary(f2, attrs)
+    if u2 is not None:      # Binary(x, Unary(y))
+        out = _fused_binary(f1, attrs)(x, u2(y))
+    else:                   # Unary(Binary(x, y))
+        u1 = _fused_unary(f1, attrs)
+        if u1 is None:
+            raise ValueError(
+                f"functor_list {functors}: one entry must be unary")
+        out = u1(_fused_binary(f2, attrs)(x, y))
+    return {"Out": [out]}

@@ -176,6 +176,19 @@ def _box_to_delta(rois, gts, weights=None):
     return d
 
 
+def _topk_pad(prio, k):
+    """(indices, real) of length k even when prio has fewer entries — short
+    pools tile their picks and mark the tiled slots real=False so callers
+    zero their labels/weights."""
+    m = prio.shape[0]
+    if m >= k:
+        _, idx = jax.lax.top_k(prio, k)
+        return idx, jnp.ones((k,), dtype=bool)
+    _, idx = jax.lax.top_k(prio, m)
+    reps = -(-k // m)
+    return jnp.tile(idx, reps)[:k], jnp.arange(k) < m
+
+
 def _sample_mask(priority, eligible, k, key):
     """Pick up to k eligible entries: top-k over priorities (+U(0,1) jitter
     when a key is given — the trace-stable stand-in for std::shuffle).
@@ -275,8 +288,8 @@ def _rpn_target_assign(ctx, ins, attrs):
                 jnp.where(inside & ~fg_cand, -10.0 - max_iou, -jnp.inf),
             ),
         ) + jit
-        _, rows = jax.lax.top_k(prio, S)
-        row_is_fg = fg_mask[rows]
+        rows, real = _topk_pad(prio, S)
+        row_is_fg = fg_mask[rows] & real
         labels = row_is_fg.astype(jnp.int32)
         tgt = _box_to_delta(anchors[rows], gtb[argmax_gt[rows]])
         tgt = jnp.where(row_is_fg[:, None], tgt, 0.0)
@@ -361,7 +374,11 @@ def _generate_proposal_labels(ctx, ins, attrs):
 
     keys = jax.random.split(ctx.rng(), N) if use_random else [None] * N
 
-    def one_image(img_rois, rl, gtb, gl, gtc, crowd, key):
+    def one_image(img_rois, rl, gtb, gl, gtc, crowd, im_scale, key):
+        # rois arrive in scaled-image coords, gt in original coords:
+        # divide rois by im_scale before matching, multiply the sampled
+        # rois back (generate_proposal_labels_op.cc:237, :282)
+        img_rois = img_rois / im_scale
         cand = jnp.concatenate([img_rois, gtb], axis=0)      # [C, 4]
         cand_valid = jnp.concatenate(
             [jnp.arange(R) < rl, jnp.arange(G) < gl]
@@ -389,8 +406,8 @@ def _generate_proposal_labels(ctx, ins, attrs):
                 jnp.where(cand_valid & ~fg_mask, -10.0, -jnp.inf),
             ),
         ) + jit
-        _, rows = jax.lax.top_k(prio, S)
-        row_is_fg = fg_mask[rows]
+        rows, real = _topk_pad(prio, S)
+        row_is_fg = fg_mask[rows] & real
 
         out_rois = cand[rows]
         label = jnp.where(
@@ -407,11 +424,12 @@ def _generate_proposal_labels(ctx, ins, attrs):
         w = w.at[jnp.arange(S), lab_idx].set(
             jnp.where(row_is_fg[:, None], 1.0, 0.0)
         )
-        return out_rois, label, tgt.reshape(S, -1), w.reshape(S, -1)
+        return (out_rois * im_scale, label, tgt.reshape(S, -1),
+                w.reshape(S, -1))
 
     outs = [
         one_image(rois[i], roi_lens[i], gt_boxes[i], gt_lens[i],
-                  gt_classes[i], is_crowd[i],
+                  gt_classes[i], is_crowd[i], im_info[i, 2],
                   keys[i] if use_random else None)
         for i in range(N)
     ]
@@ -545,11 +563,10 @@ def _detection_map(ctx, ins, attrs):
         fp_flat = jnp.zeros((D,), dtype=bool).at[dis].set(fps)
         return tp_flat, fp_flat
 
-    aps = []
-    ap_valid = []
-    for cls in range(class_num):
-        if cls == background:
-            continue
+    def per_class(cls):
+        """AP for one (traced) class id — vmapped over all classes so the
+        XLA program holds ONE instance of the match/sort pipeline, not
+        class_num unrolled copies."""
         tps, fps = jax.vmap(
             lambda iou0, ds, dl, dv, glb, gdf, gv: image_tp_fp(
                 iou0, ds, dl, dv, glb, gdf, gv, cls)
@@ -567,20 +584,20 @@ def _detection_map(ctx, ins, attrs):
         prec = ctp / jnp.maximum(ctp + cfp, 1)
         rec = ctp / jnp.maximum(n_pos, 1)
         if ap_type == "11point":
-            pts = []
-            for t in np.arange(0.0, 1.01, 0.1):
-                m = active & (rec >= t)
-                pts.append(jnp.max(jnp.where(m, prec, 0.0)))
+            pts = [
+                jnp.max(jnp.where(active & (rec >= t), prec, 0.0))
+                for t in np.arange(0.0, 1.01, 0.1)
+            ]
             ap = jnp.mean(jnp.stack(pts))
         else:
             drec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), rec]))
             ap = jnp.sum(jnp.where(active, prec * drec, 0.0))
-        aps.append(jnp.where(n_pos > 0, ap, 0.0))
-        ap_valid.append((n_pos > 0).astype(jnp.float32))
+        counted = (cls != background) & (n_pos > 0)
+        return jnp.where(counted, ap, 0.0), counted.astype(jnp.float32)
 
-    ap_sum = sum(aps)
-    n_cls = sum(ap_valid)
-    m_ap = jnp.where(n_cls > 0, ap_sum / jnp.maximum(n_cls, 1.0), 0.0)
+    aps, counted = jax.vmap(per_class)(jnp.arange(class_num))
+    n_cls = jnp.sum(counted)
+    m_ap = jnp.where(n_cls > 0, jnp.sum(aps) / jnp.maximum(n_cls, 1.0), 0.0)
     return {
         "MAP": [m_ap.reshape(1).astype(jnp.float32)],
         "AccumPosCount": [jnp.zeros((1, 1), dtype=jnp.int32)],

@@ -1,0 +1,276 @@
+"""Framework-plumbing op tests (reference: test_hsigmoid_op.py,
+test_tensor_array_to_tensor.py, test_merge_selectedrows_op.py,
+test_get_tensor_from_selected_rows_op.py, test_split_ids_op.py,
+test_merge_ids_op.py, test_split_selected_rows_op.py,
+test_reorder_lod_tensor.py, test_fc_op.py,
+test_fused_elemwise_activation_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import create_lod_tensor
+from paddle_tpu.core.selected_rows import SelectedRowsValue
+from paddle_tpu.ops import framework_ops as F
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# hierarchical_sigmoid
+# ---------------------------------------------------------------------------
+def _hsigmoid_ref(x, w, label, bias, num_classes):
+    """Direct port of the bit-code walk (matrix_bit_code.h SimpleCode)."""
+    N, D = x.shape
+    L = int(num_classes - 1).bit_length()
+    pre = np.zeros((N, L), dtype=np.float64)
+    out = np.zeros((N,), dtype=np.float64)
+    for i in range(N):
+        c = int(label[i]) + num_classes
+        length = c.bit_length() - 1
+        for j in range(length):
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            v = float(x[i].astype(np.float64) @ w[idx].astype(np.float64))
+            if bias is not None:
+                v += float(bias[idx])
+            v = np.clip(v, -40.0, 40.0)
+            pre[i, j] = v
+        # softplus over ALL L positions (out-of-path zeros add log 2,
+        # matching the reference's zero-init pre_out)
+        out[i] = np.log1p(np.exp(pre[i])).sum() - sum(
+            ((c >> j) & 1) * pre[i, j] for j in range(length)
+        )
+    return pre, out
+
+
+def test_hierarchical_sigmoid_output_and_grad():
+    num_classes = 6
+    x = _rand((4, 5), seed=1)
+    w = _rand((num_classes - 1, 5), seed=2)
+    bias = _rand((num_classes - 1, 1), seed=3)
+    label = np.array([[0], [2], [4], [5]], dtype="int64")
+    pre, out = _hsigmoid_ref(x, w, label.ravel(), bias.ravel(), num_classes)
+
+    class T(OpTest):
+        op_type = "hierarchical_sigmoid"
+
+    t = T()
+    t.inputs = {"X": x, "W": w, "Label": label, "Bias": bias}
+    t.attrs = {"num_classes": num_classes}
+    t.outputs = {"Out": out[:, None].astype("float32"),
+                 "PreOut": pre.astype("float32")}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "W", "Bias"], "Out", max_relative_error=0.02)
+
+
+def test_hsigmoid_layer_trains():
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    cost = fluid.layers.hsigmoid(x, y, num_classes=10)
+    loss = fluid.layers.reduce_mean(cost)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 8).astype("float32")
+    ys = rng.randint(0, 10, (16, 1)).astype("int64")
+    losses = [
+        float(np.ravel(exe.run(feed={"x": xs, "y": ys},
+                               fetch_list=[loss])[0])[0])
+        for _ in range(25)
+    ]
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# tensor_array_to_tensor
+# ---------------------------------------------------------------------------
+def test_tensor_array_to_tensor():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        arr = fluid.layers.create_array("float32")
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        fluid.layers.array_write(x, i0, array=arr)
+        fluid.layers.array_write(x, i1, array=arr)
+        out, idx = fluid.layers.tensor_array_to_tensor(arr, axis=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = _rand((2, 3), seed=4)
+    got, gidx = exe.run(program=prog, feed={"x": xs},
+                        fetch_list=[out, idx])
+    np.testing.assert_allclose(got, np.concatenate([xs, xs], axis=0),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(gidx, [2, 2])
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities (direct lowering tests: these values only arise
+# inside compiled programs, from sparse grads)
+# ---------------------------------------------------------------------------
+def test_merge_selected_rows():
+    ids = np.array([1, 3, 1, 7], dtype=np.int32)
+    rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+    sr = SelectedRowsValue(ids, rows, height=10)
+    (merged,) = F._merge_selected_rows(None, {"X": [sr]}, {})["Out"]
+    dense = np.asarray(merged.to_dense())
+    want = np.zeros((10, 2), dtype=np.float32)
+    for i, r in zip(ids, rows):
+        want[i] += r
+    np.testing.assert_allclose(dense, want, rtol=1e-6)
+
+
+def test_get_tensor_from_selected_rows():
+    ids = np.array([2, 5], dtype=np.int32)
+    rows = _rand((2, 3), seed=5)
+    sr = SelectedRowsValue(ids, rows, height=8)
+    (t,) = F._get_tensor_from_selected_rows(None, {"X": [sr]}, {})["Out"]
+    np.testing.assert_allclose(np.asarray(t), rows, rtol=1e-6)
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([0, 1, 2, 3, 4, 5], dtype=np.int64)
+    shards = F._split_ids(None, {"Ids": [ids], "Out": [None, None]},
+                          {"num_shards": 2})["Out"]
+    assert len(shards) == 2
+    s0 = np.asarray(shards[0]).ravel()
+    np.testing.assert_array_equal(s0, [0, -1, 2, -1, 4, -1])
+    # rows per shard: gather a fake table at each shard's ids
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    xs = []
+    for s in shards:
+        sid = np.asarray(s).ravel()
+        r = np.where(sid[:, None] >= 0, table[np.maximum(sid, 0)], 0.0)
+        xs.append(r.astype(np.float32))
+    (merged,) = F._merge_ids(
+        None, {"Ids": [ids], "X": xs}, {})["Out"]
+    np.testing.assert_allclose(np.asarray(merged), table, rtol=1e-6)
+
+
+def test_split_selected_rows():
+    ids = np.array([1, 4, 7], dtype=np.int32)
+    rows = _rand((3, 2), seed=6)
+    sr = SelectedRowsValue(ids, rows, height=10)
+    outs = F._split_selected_rows(
+        None, {"X": [sr]}, {"height_sections": [5, 5]})["Out"]
+    d0 = np.asarray(outs[0].to_dense())
+    d1 = np.asarray(outs[1].to_dense())
+    want0 = np.zeros((5, 2), dtype=np.float32)
+    want0[1] = rows[0]
+    want0[4] = rows[1]
+    want1 = np.zeros((5, 2), dtype=np.float32)
+    want1[2] = rows[2]
+    np.testing.assert_allclose(d0, want0, rtol=1e-6)
+    np.testing.assert_allclose(d1, want1, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# reorder_lod_tensor_by_rank
+# ---------------------------------------------------------------------------
+def test_reorder_lod_tensor_by_rank():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.control_flow.lod_rank_table(x)
+        block = prog.global_block()
+        out = block.create_var(name="reordered", shape=x.shape,
+                               dtype=x.dtype, lod_level=1)
+        block.append_op(
+            type="reorder_lod_tensor_by_rank",
+            inputs={"X": [x], "RankTable": [table]},
+            outputs={"Out": [out]},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    # 3 sequences of lengths 1, 3, 2 -> rank order is seq1, seq2, seq0
+    flat = np.arange(12, dtype="float32").reshape(6, 2)
+    lod = create_lod_tensor(flat, [[1, 3, 2]])
+    (got,) = exe.run(program=prog, feed={"x": lod}, fetch_list=[out],
+                     return_numpy=False)
+    lens = np.asarray(got.lengths)
+    np.testing.assert_array_equal(lens, [3, 2, 1])
+    padded = np.asarray(got.data)
+    src = np.asarray(lod.data)
+    np.testing.assert_allclose(padded[0], src[1], rtol=1e-6)
+    np.testing.assert_allclose(padded[1], src[2], rtol=1e-6)
+    np.testing.assert_allclose(padded[2], src[0], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused ops
+# ---------------------------------------------------------------------------
+def test_fc_op():
+    x = _rand((4, 6), seed=7)
+    w = _rand((6, 3), seed=8)
+    b = _rand((3,), seed=9)
+
+    class T(OpTest):
+        op_type = "fc"
+
+    t = T()
+    t.inputs = {"Input": x, "W": w, "Bias": b}
+    t.attrs = {"in_num_col_dims": 1}
+    t.outputs = {"Out": x @ w + b}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Input", "W"], "Out", max_relative_error=0.02)
+
+
+def test_fused_elemwise_activation():
+    x = _rand((3, 4), seed=10)
+    y = _rand((3, 4), seed=11)
+
+    class T(OpTest):
+        op_type = "fused_elemwise_activation"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"functor_list": ["relu", "elementwise_add"]}
+    t.outputs = {"Out": np.maximum(x + y, 0.0)}
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_fake_init():
+    class T(OpTest):
+        op_type = "fake_init"
+
+    t = T()
+    t.inputs = {}
+    t.attrs = {"shape": [2, 3], "dtype": int(fluid.core.DataType.FP32)}
+    t.outputs = {"Out": np.zeros((2, 3), dtype="float32")}
+    t.check_output()
+
+
+def test_fused_elemwise_binary_outer():
+    """[binary, unary] form computes Binary(x, Unary(y)), unary on Y."""
+    x = _rand((3, 4), seed=12)
+    y = _rand((3, 4), seed=13)
+
+    class T(OpTest):
+        op_type = "fused_elemwise_activation"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"functor_list": ["elementwise_add", "relu"]}
+    t.outputs = {"Out": x + np.maximum(y, 0.0)}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_fused_elemwise_scale_is_unary():
+    x = _rand((3, 4), seed=14)
+    y = _rand((3, 4), seed=15)
+
+    class T(OpTest):
+        op_type = "fused_elemwise_activation"
+
+    t = T()
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"functor_list": ["elementwise_add", "scale"], "scale": 2.0}
+    t.outputs = {"Out": x + 2.0 * y}
+    t.check_output(atol=2e-5, rtol=2e-5)

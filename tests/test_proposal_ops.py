@@ -299,3 +299,35 @@ def test_detection_map_difficult_ignored():
     # npos=1 (difficult excluded); det0 ignored, det1 tp -> AP = 1
     np.testing.assert_allclose(float(np.asarray(res["MAP"])[0]), 1.0,
                                atol=1e-5)
+
+
+def test_generate_proposal_labels_im_scale():
+    """RoIs in scaled coords, gt in original coords: with im_scale=2 the
+    roi [0,0,18,18] maps onto gt [0,0,9,9]; output rois return scaled."""
+    rois = create_lod_tensor(
+        np.array([[0, 0, 18, 18], [60, 60, 78, 78]], dtype="float32"),
+        [[2]],
+    )
+    gt_classes = create_lod_tensor(np.array([[2]], dtype="float32"), [[1]])
+    crowd = create_lod_tensor(np.zeros((1, 1), dtype="float32"), [[1]])
+    gt_boxes = create_lod_tensor(
+        np.array([[0, 0, 9, 9]], dtype="float32"), [[1]])
+    im_info = np.array([[120.0, 120.0, 2.0]], dtype="float32")
+    res = _run_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gt_classes, "IsCrowd": crowd,
+         "GtBoxes": gt_boxes, "ImInfo": im_info},
+        {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+         "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2], "class_nums": 5,
+         "use_random": False},
+        ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+         "BboxOutsideWeights"],
+    )
+    labels = np.asarray(res["LabelsInt32"]).ravel()
+    # roi0/im_scale == [0,0,9,9] == gt (IoU 1) and the gt itself -> 2 fg
+    assert (labels == 2).sum() == 2
+    out_rois = np.asarray(res["Rois"].data)[0]
+    fg_rows = np.where(labels == 2)[0]
+    for r in fg_rows:
+        np.testing.assert_allclose(out_rois[r], [0, 0, 18, 18], atol=1e-4)
