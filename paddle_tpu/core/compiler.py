@@ -198,10 +198,16 @@ def _make_cotangent(primal, g):
         return LoDValue(
             _leaf_cotangent(primal.data, gdata), _float0_zeros(primal.lengths)
         )
-    from .tensor_array import TensorArrayValue
+    from .tensor_array import StackedTensorArray, TensorArrayValue
 
+    if isinstance(primal, StackedTensorArray):
+        gbuf = g.buffer if isinstance(g, StackedTensorArray) else None
+        return StackedTensorArray(
+            _leaf_cotangent(primal.buffer, gbuf), primal.length
+        )
     if isinstance(primal, TensorArrayValue):
-        gs = g.steps if isinstance(g, TensorArrayValue) else [None] * len(primal)
+        gs = g.steps if isinstance(g, (TensorArrayValue, StackedTensorArray)) \
+            else [None] * len(primal)
         return TensorArrayValue(
             [_make_cotangent(p, gg) for p, gg in zip(primal.steps, gs)]
         )
@@ -218,8 +224,13 @@ def _sanitize_input_grad(g, primal):
         if getattr(gd, "dtype", None) == jax.dtypes.float0:
             gd = jnp.zeros_like(primal.data)
         return LoDValue(gd, primal.lengths)
-    from .tensor_array import TensorArrayValue
+    from .tensor_array import StackedTensorArray, TensorArrayValue
 
+    if isinstance(g, StackedTensorArray):
+        gb = g.buffer
+        if getattr(gb, "dtype", None) == jax.dtypes.float0:
+            gb = jnp.zeros_like(primal.buffer)
+        return StackedTensorArray(gb, g.length)
     if isinstance(g, TensorArrayValue):
         return TensorArrayValue(
             [_sanitize_input_grad(gg, p) for gg, p in zip(g.steps, primal.steps)]

@@ -15,8 +15,9 @@ from __future__ import annotations
 from typing import Any, List
 
 import jax
+import jax.numpy as jnp
 
-__all__ = ["TensorArrayValue"]
+__all__ = ["TensorArrayValue", "StackedTensorArray"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -59,3 +60,64 @@ class TensorArrayValue:
 
     def __repr__(self):
         return f"TensorArrayValue(len={len(self.steps)})"
+
+
+@jax.tree_util.register_pytree_node_class
+class StackedTensorArray:
+    """Tensor array as one [L, ...] buffer, for use INSIDE a lax.scan body
+    where the step index is a traced value (the scan-lowered `while` path,
+    ops/control_flow_ops.py).  Reads are dynamic-index gathers and writes
+    are functional .at[i].set scatters — both shape-stable, which is what
+    lets the loop body compile once instead of unrolling.  `length` is the
+    static number of steps that will be live when the loop finishes (known
+    from the concrete trip-count simulation), so conversion back to
+    TensorArrayValue slices exactly the written prefix."""
+
+    def __init__(self, buffer, length: int):
+        self.buffer = buffer
+        self.length = int(length)
+
+    def tree_flatten(self):
+        return (self.buffer,), self.length
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux)
+
+    def __len__(self):
+        return self.length
+
+    def read(self, i):
+        if not isinstance(i, jax.core.Tracer):
+            ii = int(jnp.reshape(jnp.asarray(i), ()))
+            if ii >= self.length:
+                raise IndexError(
+                    f"read_from_array: index {ii} out of range "
+                    f"(len {self.length})"
+                )
+            return self.buffer[ii]
+        # traced index: clamp to the written range (out-of-range traced
+        # reads cannot raise; the concrete simulation guarded the indices)
+        idx = jnp.clip(jnp.reshape(jnp.asarray(i), ()), 0, self.length - 1)
+        return jnp.take(self.buffer, idx, axis=0)
+
+    def write(self, i, value) -> "StackedTensorArray":
+        idx = jnp.reshape(jnp.asarray(i), ())
+        return StackedTensorArray(
+            self.buffer.at[idx].set(value), self.length
+        )
+
+    def to_steps(self) -> "TensorArrayValue":
+        return TensorArrayValue(self.steps)
+
+    @property
+    def steps(self):
+        """Per-step view for consumers written against TensorArrayValue.
+        Bulk consumers (array_to_lod_tensor, stack_from_array) special-case
+        the stacked buffer instead — this sliced view costs one gather per
+        step, which defeats the point of the scan lowering."""
+        return [self.buffer[t] for t in range(self.length)]
+
+    def __repr__(self):
+        return (f"StackedTensorArray(L={self.buffer.shape[0]}, "
+                f"len={self.length})")

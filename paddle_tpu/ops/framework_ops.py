@@ -113,7 +113,21 @@ def _ta2t_infer(op, block):
 def _tensor_array_to_tensor(ctx, ins, attrs):
     """Concat/stack a LoDTensorArray into one tensor + per-step sizes
     (reference: tensor_array_to_tensor_op.cc)."""
+    from ..core.tensor_array import StackedTensorArray
+
     arr = ins["X"][0]
+    if isinstance(arr, StackedTensorArray):  # scan-lowered while output
+        axis = int(attrs.get("axis", 0))
+        buf = arr.buffer[: arr.length]
+        if attrs.get("use_stack", False):
+            out = jnp.moveaxis(buf, 0, axis)
+            sizes = np.ones((arr.length,), dtype=np.int32)
+        else:
+            out = jnp.concatenate([buf[t] for t in range(arr.length)],
+                                  axis=axis)
+            sizes = np.full((arr.length,), buf.shape[axis + 1],
+                            dtype=np.int32)
+        return {"Out": [out], "OutIndex": [jnp.asarray(sizes)]}
     if not isinstance(arr, TensorArrayValue):
         raise TypeError("tensor_array_to_tensor expects a TensorArray input")
     steps = [jnp.asarray(s) for s in arr.steps]

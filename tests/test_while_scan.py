@@ -1,0 +1,156 @@
+"""Scan-lowered while loops (VERDICT r1 weak #6): long static-trip-count
+while bodies compile as ONE lax.scan step instead of T unrolled copies.
+Parity is checked against the unroll path (scan_threshold attr) and against
+numpy; a wall-clock budget guards the compile-time win at seq-len 100."""
+
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _dynamic_rnn_program(hidden=8, feat=5, scan_threshold=None):
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        sent = layers.data(name="x", shape=[feat], dtype="float32",
+                           lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sent)
+            prev = drnn.memory(shape=[hidden], value=0.0)
+            cat = layers.concat([word, prev], axis=1)
+            h = layers.fc(cat, hidden, act="tanh",
+                          param_attr=fluid.ParamAttr(name="w"),
+                          bias_attr=fluid.ParamAttr(name="b"))
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()
+        last = layers.sequence_pool(out, "last")
+        loss = layers.reduce_mean(last)
+    if scan_threshold is not None:
+        for op in prog.global_block().desc.ops:
+            if op.type == "while":
+                op.attrs["scan_threshold"] = scan_threshold
+    return prog, startup, sent, loss
+
+
+def _numpy_rnn(flat, lens, w, b, hidden):
+    """Reference: h_t = tanh([x_t, h_{t-1}] @ w + b), per sequence."""
+    outs = []
+    off = 0
+    for L in lens:
+        h = np.zeros((hidden,), dtype=np.float64)
+        for t in range(L):
+            x = flat[off + t].astype(np.float64)
+            h = np.tanh(np.concatenate([x, h]) @ w.astype(np.float64)
+                        + b.astype(np.float64))
+        outs.append(h)
+        off += L
+    return np.stack(outs)
+
+
+def test_dynamic_rnn_scan_matches_unroll_and_numpy():
+    hidden, feat = 8, 5
+    lens = [23, 40, 17]  # max 40 > threshold -> scan path
+    total = sum(lens)
+    rng = np.random.RandomState(0)
+    flat = rng.randn(total, feat).astype("float32")
+    lod = create_lod_tensor(flat, [lens])
+    w = rng.randn(feat + hidden, hidden).astype("float32") * 0.3
+    b = rng.randn(hidden).astype("float32") * 0.1
+
+    results = {}
+    for name, thresh in (("scan", 16), ("unroll", 10_000)):
+        fluid.reset_default_env()
+        prog, startup, _, loss = _dynamic_rnn_program(hidden, feat,
+                                                      scan_threshold=thresh)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.program_guard(prog, startup):
+            exe.run(program=startup)
+            scope = fluid.global_scope()
+            scope.set_var("w", w)
+            scope.set_var("b", b)
+            (lv,) = exe.run(program=prog, feed={"x": lod},
+                            fetch_list=[loss])
+        results[name] = float(np.ravel(lv)[0])
+
+    want = _numpy_rnn(flat, lens, w, b, hidden).mean()
+    np.testing.assert_allclose(results["scan"], results["unroll"], rtol=1e-5)
+    np.testing.assert_allclose(results["scan"], want, rtol=1e-4)
+
+
+def test_dynamic_rnn_scan_trains():
+    """Gradients flow through the scan-lowered while (jax.vjp over scan)."""
+    fluid.reset_default_env()
+    hidden, feat = 6, 4
+    lens = [30, 25]
+    rng = np.random.RandomState(1)
+    flat = rng.randn(sum(lens), feat).astype("float32")
+    lod = create_lod_tensor(flat, [lens])
+
+    sent = layers.data(name="x", shape=[feat], dtype="float32", lod_level=1)
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(sent)
+        prev = drnn.memory(shape=[hidden], value=0.0)
+        h = layers.fc(layers.concat([word, prev], axis=1), hidden,
+                      act="tanh")
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn()
+    last = layers.sequence_pool(out, "last")
+    loss = layers.reduce_mean(layers.square(last))
+    fluid.optimizer.SGD(0.5).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [
+        float(np.ravel(exe.run(feed={"x": lod}, fetch_list=[loss])[0])[0])
+        for _ in range(12)
+    ]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_long_sequence_compiles_fast():
+    """Seq-len 400 must trace+compile via scan in bounded time; a 400x
+    unrolled HLO would not fit this budget."""
+    fluid.reset_default_env()
+    hidden, feat, T = 16, 8, 400
+    rng = np.random.RandomState(2)
+    flat = rng.randn(T, feat).astype("float32")
+    lod = create_lod_tensor(flat, [[T]])
+
+    prog, startup, _, loss = _dynamic_rnn_program(hidden, feat)
+    exe = fluid.Executor(fluid.CPUPlace())
+    t0 = time.perf_counter()
+    with fluid.program_guard(prog, startup):
+        exe.run(program=startup)
+        (lv,) = exe.run(program=prog, feed={"x": lod}, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(float(np.ravel(lv)[0]))
+    assert dt < 60.0, f"seq-len {T} took {dt:.1f}s — is the loop unrolling?"
+
+
+def test_while_scan_written_not_read_output():
+    """A parent var assigned every iteration but never read in-loop must
+    surface its final value through the scan path (review finding r2)."""
+    fluid.reset_default_env()
+    i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = layers.fill_constant(shape=[1], dtype="int64", value=40)
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    last = layers.fill_constant(shape=[1, 3], dtype="float32", value=0.0)
+    cond = layers.less_than(x=i, y=n)
+    w = layers.While(cond=cond)
+    with w.block():
+        scaled = layers.scale(x, scale=2.0)
+        layers.assign(scaled, output=last)  # write-only from loop's view
+        layers.increment(x=i, value=1, in_place=True)
+        layers.less_than(x=i, y=n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+    (got,) = exe.run(feed={"x": xs}, fetch_list=[last])
+    np.testing.assert_allclose(got, xs * 2.0, rtol=1e-6)
