@@ -28,7 +28,13 @@ class Place:
         return f"{type(self).__name__}({self.device_id})"
 
     def jax_device(self):
-        devices = self._platform_devices()
+        # process-LOCAL devices: under jax.distributed the global list leads
+        # with other processes' (non-addressable) devices, and a Place must
+        # resolve to one this host can feed (test_multihost.py)
+        devices = [
+            d for d in self._platform_devices()
+            if d.process_index == jax.process_index()
+        ] or self._platform_devices()
         return devices[self.device_id % len(devices)]
 
     def _platform_devices(self):

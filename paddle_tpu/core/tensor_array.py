@@ -102,6 +102,21 @@ class StackedTensorArray:
         return jnp.take(self.buffer, idx, axis=0)
 
     def write(self, i, value) -> "StackedTensorArray":
+        if not isinstance(i, jax.core.Tracer):
+            ii = int(jnp.reshape(jnp.asarray(i), ()))
+            if ii > self.length:
+                raise IndexError(
+                    f"write_to_array: index {ii} skips past end "
+                    f"(len {self.length})"
+                )
+            if ii == self.length:  # append, growing the buffer if full
+                buf = self.buffer
+                if ii == buf.shape[0]:
+                    buf = jnp.concatenate([buf, buf[-1:]], axis=0)
+                return StackedTensorArray(buf.at[ii].set(value),
+                                          self.length + 1)
+            return StackedTensorArray(self.buffer.at[ii].set(value),
+                                      self.length)
         idx = jnp.reshape(jnp.asarray(i), ())
         return StackedTensorArray(
             self.buffer.at[idx].set(value), self.length

@@ -125,7 +125,7 @@ def _tensor_array_to_tensor(ctx, ins, attrs):
         else:
             out = jnp.concatenate([buf[t] for t in range(arr.length)],
                                   axis=axis)
-            sizes = np.full((arr.length,), buf.shape[axis + 1],
+            sizes = np.full((arr.length,), buf.shape[1:][axis],
                             dtype=np.int32)
         return {"Out": [out], "OutIndex": [jnp.asarray(sizes)]}
     if not isinstance(arr, TensorArrayValue):

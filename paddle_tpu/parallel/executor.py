@@ -185,6 +185,16 @@ class ParallelExecutor:
         state_vals = plan.state_values(self.scope, block0)
         rng = plan.rng_value(self.scope, self.program)
 
+        from .multihost import global_feed_value, is_multiprocess
+
+        if is_multiprocess(self.mesh):
+            # each process feeds ITS batch shard; jax assembles the global
+            # array (reference: per-trainer reader shards under nccl2)
+            feed_vals = tuple(
+                global_feed_value(self._feed_sharding(n, block0), v)
+                for n, v in zip(plan.feed_names, feed_vals)
+            )
+
         with self.mesh.mesh:
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 

@@ -1,0 +1,44 @@
+"""Multi-host data plumbing (reference: the nccl2 transpiler mode +
+test_dist_base.py's localhost subprocess clusters).
+
+After `parallel.env.init_distributed()` every host sees the pod-wide device
+list, and a mesh built from `jax.devices()` spans processes.  What remains
+is feeding: each process holds only ITS batch shard, so dp-sharded feeds go
+through `jax.make_array_from_process_local_data` (each process contributes
+its local rows), while replicated values (parameters, fetches) are the same
+bytes on every host and flow through jit's sharding-annotated parameters.
+The reference's equivalent machinery is the per-trainer reader shard plus
+ncclAllReduce over the trainer ranks."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core.lod import LoDValue
+
+__all__ = ["is_multiprocess", "global_feed_value"]
+
+
+def is_multiprocess(mesh) -> bool:
+    """True when `mesh` spans more than one jax process."""
+    procs = {d.process_index for d in mesh.mesh.devices.flat}
+    return len(procs) > 1
+
+
+def _from_local(sharding, arr) -> jax.Array:
+    arr = np.asarray(arr)
+    return jax.make_array_from_process_local_data(sharding, arr)
+
+
+def global_feed_value(sharding, value) -> Any:
+    """Per-process batch shard -> global sharded jax.Array (LoD-aware)."""
+    if isinstance(value, LoDValue):
+        return LoDValue(
+            _from_local(sharding, value.data),
+            _from_local(sharding, np.asarray(value.lengths)),
+        )
+    return _from_local(sharding, value)
+

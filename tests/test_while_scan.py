@@ -154,3 +154,21 @@ def test_while_scan_written_not_read_output():
     xs = np.array([[1.0, 2.0, 3.0]], dtype="float32")
     (got,) = exe.run(feed={"x": xs}, fetch_list=[last])
     np.testing.assert_allclose(got, xs * 2.0, rtol=1e-6)
+
+
+def test_stacked_array_append_after_scan():
+    """write_to_array at index == length on a scan-produced array appends
+    (parity with TensorArrayValue.write); skipping past the end raises."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor_array import StackedTensorArray
+
+    arr = StackedTensorArray(jnp.arange(6.0).reshape(3, 2), 3)
+    grown = arr.write(3, jnp.array([9.0, 9.0]))
+    assert len(grown) == 4
+    np.testing.assert_allclose(np.asarray(grown.read(3)), [9.0, 9.0])
+    np.testing.assert_allclose(np.asarray(grown.read(0)), [0.0, 1.0])
+    try:
+        arr.write(5, jnp.zeros(2))
+        raise AssertionError("expected IndexError")
+    except IndexError:
+        pass
