@@ -1,0 +1,89 @@
+"""Global flags tier (reference: python/paddle/fluid/__init__.py:125
+__bootstrap__ reading gflags from the environment, e.g. FLAGS_check_nan_inf,
+FLAGS_cpu_deterministic, FLAGS_benchmark; framework/operator.cc:777 consumes
+check_nan_inf after every op run).
+
+TPU-native shape: flags are plain Python state seeded from `FLAGS_*` env
+vars at import, mutable via set_flags()/get_flags() (the modern public
+spelling).  check_nan_inf is consumed by the executors as a post-step scan
+of fetches and persistable state (the per-op granularity of the reference
+would force a host sync between ops — against the one-XLA-program design;
+the post-step scan still names the first offending variable).
+cpu_deterministic is satisfied by construction — lowerings use counter-based
+jax PRNG keys and XLA reductions are run-to-run deterministic on TPU — so
+setting it only pins the default program seed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["get_flags", "set_flags", "flag"]
+
+_DEFS: Dict[str, Any] = {
+    # debugging
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    # determinism
+    "FLAGS_cpu_deterministic": False,
+    # accepted for reference-script compatibility; memory/threads are
+    # XLA/jax concerns here (documented no-ops)
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_eager_delete_tensor_gb": -1.0,
+    "FLAGS_init_allocated_mem": False,
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_use_pinned_memory": True,
+}
+
+_VALUES: Dict[str, Any] = {}
+
+
+def _coerce(default: Any, raw: str) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def _bootstrap() -> None:
+    for name, default in _DEFS.items():
+        raw = os.environ.get(name)
+        _VALUES[name] = default if raw is None else _coerce(default, raw)
+
+
+_bootstrap()
+
+
+def _canon(name: str) -> str:
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+def flag(name: str) -> Any:
+    """Read one flag (accepts 'check_nan_inf' or 'FLAGS_check_nan_inf')."""
+    return _VALUES[_canon(name)]
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """reference parity: paddle.get_flags."""
+    if names is None:
+        return dict(_VALUES)
+    if isinstance(names, str):
+        names = [names]
+    return {_canon(n): _VALUES[_canon(n)] for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """reference parity: paddle.set_flags({'FLAGS_check_nan_inf': True})."""
+    for name, value in flags.items():
+        cname = _canon(name)
+        if cname not in _DEFS:
+            raise KeyError(f"unknown flag {name!r}")
+        default = _DEFS[cname]
+        _VALUES[cname] = (
+            _coerce(default, value) if isinstance(value, str)
+            else type(default)(value)
+        )

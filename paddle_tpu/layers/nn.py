@@ -80,6 +80,8 @@ __all__ = [
     "elementwise_max",
     "elementwise_min",
     "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
     "cos_sim",
     "selu",
     "random_crop",
@@ -950,6 +952,14 @@ def elementwise_min(x, y, axis=-1, act=None, name=None):
 
 def elementwise_pow(x, y, axis=-1, act=None, name=None):
     return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
 
 
 # ---------------------------------------------------------------------------

@@ -35,5 +35,12 @@ _make("elementwise_div", lambda x, y: x / y)
 _make("elementwise_max", jnp.maximum)
 _make("elementwise_min", jnp.minimum)
 _make("elementwise_pow", jnp.power)
-_make("elementwise_mod", lambda x, y: jnp.mod(x, y))
-_make("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
+# C++ truncated semantics (sign of the dividend), matching the reference's
+# % / fmod kernels — NOT python/numpy floored mod
+_make("elementwise_mod", lambda x, y: jnp.fmod(x, y))
+_make(
+    "elementwise_floordiv",
+    lambda x, y: jnp.trunc(jnp.true_divide(x, y)).astype(
+        jnp.result_type(x, y)
+    ),
+)

@@ -6,7 +6,7 @@ _create_optimization_pass appending one optimizer *op* per parameter).
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from .clip import append_gradient_clip_ops, error_clip_callback
 from .core.backward import append_backward
@@ -20,6 +20,7 @@ from .core.framework import (
     unique_name,
 )
 from .core.proto import DataType
+from .core.scope import global_scope
 from .initializer import ConstantInitializer
 from .layer_helper import LayerHelper
 from .regularizer import append_regularization_ops
@@ -47,7 +48,25 @@ __all__ = [
     "Ftrl",
     "FtrlOptimizer",
     "ModelAverage",
+    "ProximalGDOptimizer",
+    "ProximalAdagradOptimizer",
+    "ProximalGD",
+    "ProximalAdagrad",
+    "GradientMergeOptimizer",
 ]
+
+
+def _create_persistable_zeros(name, shape, dtype):
+    """Persistable main-program var zero-initialized by the startup program
+    (shared by ModelAverage / GradientMergeOptimizer accumulators)."""
+    gblock = default_main_program().global_block()
+    sblock = default_startup_program().global_block()
+    v = gblock.create_var(name=name, shape=list(shape), dtype=dtype,
+                          persistable=True, stop_gradient=True)
+    sv = sblock.create_var(name=name, shape=list(shape), dtype=dtype,
+                           persistable=True)
+    ConstantInitializer(0.0)(sv, sblock)
+    return v
 
 
 class Optimizer:
@@ -129,7 +148,9 @@ class Optimizer:
     # -- driver --------------------------------------------------------------
     def _create_optimization_pass(self, parameters_and_grads, loss, startup_program):
         program = loss.block.program
-        block = loss.block
+        # current block, not loss.block: a wrapping optimizer (GradientMerge)
+        # places the apply ops inside a conditional sub-block
+        block = program.current_block()
         self.helper = LayerHelper(self.__class__.__name__)
         self._create_global_learning_rate()
         self._create_accumulators(block, [p for p, g in parameters_and_grads if g is not None])
@@ -474,9 +495,15 @@ class FtrlOptimizer(Optimizer):
 
 
 class ModelAverage(Optimizer):
-    """Sliding-window parameter averaging (reference: optimizer.py:1373).
-    Round-1 stub: apply/restore are identity context managers; accumulation
-    lands with the full EMA support."""
+    """Parameter averaging for evaluation (reference: optimizer.py:1373 +
+    operators/average_accumulates_op.cc).  Construct AFTER minimize():
+    in-graph ops accumulate a running sum of every parameter each training
+    step; apply() swaps parameters for their accumulated average inside a
+    context manager and restore() puts the trained values back.  The
+    reference's three-tier sliding window (sum_1/2/3 rotated at
+    max_average_window) is collapsed to a single running sum — windowing
+    controls staleness on billion-step CTR jobs and can land later; the
+    apply/restore contract and the average math are the reference's."""
 
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, regularization=None, name=None):
@@ -484,18 +511,86 @@ class ModelAverage(Optimizer):
         self.average_window = average_window_rate
         self.min_average_window = min_average_window
         self.max_average_window = max_average_window
+        self._param_sums: Dict[str, str] = {}
+        self._restore_vals: Dict[str, Any] = {}
+        self._cnt_name: Optional[str] = None
+
+        from . import layers
+
+        program = default_main_program()
+        gblock = program.global_block()
+        params = [
+            v for v in gblock.vars.values() if isinstance(v, Parameter)
+        ]
+        if not params:
+            return
+
+        # int64 counter: a fp32 counter saturates at 2^24 steps
+        self._cnt_name = unique_name("model_average_cnt")
+        cnt = _create_persistable_zeros(self._cnt_name, [1], "int64")
+        one = layers.fill_constant([1], "int64", 1)
+        layers.sums([cnt, one], out=cnt)
+        for p in params:
+            sum_name = unique_name(p.name + "_avg_sum")
+            sv = _create_persistable_zeros(sum_name, p.shape, p.dtype)
+            layers.sums([sv, p], out=sv)
+            self._param_sums[p.name] = sum_name
+
+    def _swap_in_averages(self, scope) -> None:
+        import numpy as _np
+
+        if self._cnt_name is None:  # constructed with no Parameters
+            return
+        if self._restore_vals:
+            raise RuntimeError(
+                "ModelAverage.apply() re-entered without restore(); the "
+                "trained parameters would be lost"
+            )
+        cnt_v = scope.find_var(self._cnt_name)
+        cnt = float(_np.ravel(_np.asarray(cnt_v))[0]) if cnt_v is not None else 0.0
+        if cnt <= 0:
+            return
+        # snapshot the accumulators too: running the program during apply()
+        # (evaluation) executes the accumulation ops against the AVERAGED
+        # params, which must not pollute the running sums after restore().
+        # Host copies, not device handles — the eval step DONATES the live
+        # state buffers (executor donate_argnums), deleting them.
+        self._restore_vals["@cnt@"] = _np.asarray(cnt_v).copy()
+        for p_name, sum_name in self._param_sums.items():
+            sum_v = scope.find_var(sum_name)
+            cur = scope.find_var(p_name)
+            if sum_v is None or cur is None:
+                continue
+            self._restore_vals[p_name] = _np.asarray(cur).copy()
+            self._restore_vals["@sum@" + p_name] = _np.asarray(sum_v).copy()
+            scope.set_var(p_name, _np.asarray(sum_v) / cnt)
 
     def apply(self, executor, need_restore=True):
         import contextlib
 
-        @contextlib.contextmanager
-        def _noop():
-            yield
+        scope = getattr(executor, "scope", None) or global_scope()
 
-        return _noop()
+        @contextlib.contextmanager
+        def _ctx():
+            self._swap_in_averages(scope)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
 
     def restore(self, executor):
-        pass
+        scope = getattr(executor, "scope", None) or global_scope()
+        for key, val in self._restore_vals.items():
+            if key == "@cnt@":
+                scope.set_var(self._cnt_name, val)
+            elif key.startswith("@sum@"):
+                scope.set_var(self._param_sums[key[len("@sum@"):]], val)
+            else:
+                scope.set_var(key, val)
+        self._restore_vals.clear()
 
 
 SGD = SGDOptimizer
@@ -508,3 +603,131 @@ Adamax = AdamaxOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent with l1/l2 (reference: optimizer.py
+    ProximalGDOptimizer over operators/optimizers/proximal_gd_op.cc)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "proximal_gd"
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="proximal_gd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param]},
+            attrs={"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Proximal adagrad (reference: optimizer.py ProximalAdagradOptimizer
+    over operators/optimizers/proximal_adagrad_op.cc)."""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "proximal_adagrad"
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type="proximal_adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [moment],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"l1": self._l1, "l2": self._l2},
+        )
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k steps (reference: the multi_batch_merge
+    pass, reader/ctr use — VERDICT row 28).  Gradients accumulate into
+    persistable buffers every step; every k-th step a conditional block
+    applies the inner optimizer on the (optionally averaged) merged grad
+    and zeroes the buffers.  The conditional lowers via if-conversion
+    (ops/control_flow_ops.py conditional_block): inner updates compute every
+    step and select by the apply mask, so optimizer moments advance only on
+    apply steps — semantics identical to running the inner optimizer on the
+    k-batch gradient."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from . import layers
+        from .layers.control_flow import _conditional_block_ctx, equal
+
+        if self.k_steps == 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+
+        inner = self.inner_optimizer
+        program = loss.block.program
+        startup = startup_program or default_startup_program()
+        with program_guard(program, startup):
+            params_grads = inner.backward(
+                loss, startup_program, parameter_list, no_grad_set)
+
+            # int64 step counter: fp32 saturates at 2^24 steps
+            step = _create_persistable_zeros(
+                unique_name("grad_merge_step"), [1], "int64")
+            one = layers.fill_constant([1], "int64", 1)
+            k = layers.fill_constant([1], "int64", self.k_steps)
+            layers.sums([step, one], out=step)
+            rem = layers.elementwise_mod(step, k)
+            zero = layers.fill_constant([1], "int64", 0)
+            cond = equal(rem, zero)
+
+            merged = []
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                acc = _create_persistable_zeros(
+                    unique_name(p.name + "_grad_merge"), p.shape, p.dtype)
+                layers.sums([acc, g], out=acc)
+                merged.append((p, acc))
+
+            import contextlib
+
+            helper = LayerHelper("gradient_merge")
+            apply_block = contextlib.contextmanager(_conditional_block_ctx)
+            with apply_block(helper, cond):
+                apply_pgs = []
+                for p, acc in merged:
+                    g = (
+                        layers.scale(acc, scale=1.0 / self.k_steps)
+                        if self.avg else acc
+                    )
+                    apply_pgs.append((p, g))
+                optimize_ops = inner.apply_gradients(apply_pgs, loss)
+                for _, acc in merged:
+                    zeros = layers.fill_constant(
+                        [d for d in acc.shape], acc.dtype, 0.0)
+                    layers.assign(zeros, output=acc)
+        return optimize_ops, params_grads
+
+
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

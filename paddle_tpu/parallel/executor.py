@@ -199,6 +199,9 @@ class ParallelExecutor:
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 
         plan.write_back(self.scope, new_states, new_rng)
+        from ..core.executor import _check_nan_inf
+
+        _check_nan_inf(plan, fetches, new_states)
         return plan.convert_fetches(fetches, block0, return_numpy)
 
     def drop_local_exe_scopes(self):  # reference API; scopes are XLA-owned

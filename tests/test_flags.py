@@ -1,0 +1,85 @@
+"""Flags tier + FLAGS_check_nan_inf (reference:
+python/paddle/fluid/__init__.py:125 __bootstrap__ env gflags;
+framework/operator.cc:777 nan/inf checking)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_get_set_flags():
+    flags = fluid.get_flags()
+    assert "FLAGS_check_nan_inf" in flags
+    assert flags["FLAGS_check_nan_inf"] is False
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert fluid.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is True
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(KeyError):
+        fluid.set_flags({"FLAGS_no_such_flag": 1})
+
+
+def test_check_nan_inf_catches_diverged_step():
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    # log of a negative number -> nan in the fetch
+    out = fluid.layers.reduce_mean(fluid.layers.log(x))
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = np.full((2, 4), -1.0, dtype="float32")
+
+    # flag off: nan flows through silently (reference default)
+    (lv,) = exe.run(feed={"x": bad}, fetch_list=[out])
+    assert np.isnan(lv).all()
+
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="nan/inf"):
+            exe.run(feed={"x": bad}, fetch_list=[out])
+        # clean inputs pass the check
+        good = np.full((2, 4), 2.0, dtype="float32")
+        (lv,) = exe.run(feed={"x": good}, fetch_list=[out])
+        np.testing.assert_allclose(lv, np.log(2.0), rtol=1e-6)
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_names_state_var():
+    """A diverging training step (lr too big -> inf weights) is caught and
+    the error names a variable."""
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(1e30).minimize(loss)  # guaranteed blow-up
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype("float32") * 10,
+            "y": rng.randn(8, 1).astype("float32")}
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="FLAGS_check_nan_inf"):
+            for _ in range(3):
+                exe.run(feed=feed, fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_env_bootstrap(monkeypatch):
+    import importlib
+    from paddle_tpu import flags as flagmod
+
+    monkeypatch.setenv("FLAGS_check_nan_inf", "1")
+    monkeypatch.setenv("FLAGS_paddle_num_threads", "4")
+    try:
+        flagmod._bootstrap()
+        assert flagmod.flag("check_nan_inf") is True
+        assert flagmod.flag("paddle_num_threads") == 4
+    finally:
+        monkeypatch.delenv("FLAGS_check_nan_inf")
+        monkeypatch.delenv("FLAGS_paddle_num_threads")
+        flagmod._bootstrap()

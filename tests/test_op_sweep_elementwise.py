@@ -122,3 +122,23 @@ def test_logical_not():
     t.inputs = {"X": x}
     t.outputs = {"Out": np.logical_not(x)}
     t.check_output()
+
+
+def test_mod_floordiv_truncated_semantics():
+    """Reference C++ semantics: sign of the DIVIDEND (trunc), not numpy's
+    floored mod (review finding r2)."""
+    import paddle_tpu as fluid
+
+    x = np.array([[-3.0, 3.0, -7.0, 7.0]], dtype="float32")
+    y = np.array([[2.0, 2.0, -2.0, -2.0]], dtype="float32")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        m = fluid.layers.elementwise_mod(xv, yv)
+        d = fluid.layers.elementwise_floordiv(xv, yv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    gm, gd = exe.run(program=prog, feed={"x": x, "y": y},
+                     fetch_list=[m, d])
+    np.testing.assert_allclose(gm, np.fmod(x, y), rtol=1e-6)
+    np.testing.assert_allclose(gd, np.trunc(x / y), rtol=1e-6)

@@ -1,0 +1,145 @@
+"""Proximal optimizer classes, GradientMergeOptimizer, ModelAverage
+(reference: optimizer.py ProximalGDOptimizer/ProximalAdagradOptimizer,
+the multi_batch_merge pass, optimizer.py:1373 ModelAverage)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _regression_problem(seed=0):
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(16, 4).astype("float32")
+    ys = (xs @ rng.randn(4, 1) + 0.1).astype("float32")
+    return loss, xs, ys
+
+
+def _train(loss, xs, ys, steps=30):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for i in range(steps):
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.ravel(lv)[0]))
+    return exe, losses
+
+
+def test_proximal_gd_trains():
+    loss, xs, ys = _regression_problem(1)
+    fluid.optimizer.ProximalGDOptimizer(0.05, l1=1e-4, l2=1e-4).minimize(loss)
+    _, losses = _train(loss, xs, ys)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_proximal_adagrad_trains():
+    loss, xs, ys = _regression_problem(2)
+    fluid.optimizer.ProximalAdagradOptimizer(
+        0.1, l1=1e-4, l2=1e-4).minimize(loss)
+    _, losses = _train(loss, xs, ys)
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_gradient_merge_matches_big_batch_sgd():
+    """k accumulation steps on batch shards == one SGD step on the merged
+    batch (averaged grads): final params must match to fp tolerance."""
+    k = 4
+    rng = np.random.RandomState(3)
+    xs = rng.randn(8, 4).astype("float32")
+    ys = (xs @ rng.randn(4, 1)).astype("float32")
+    shards = [(xs[i::k], ys[i::k]) for i in range(k)]
+
+    def params(prog):
+        from paddle_tpu.core.framework import Parameter
+
+        scope = fluid.global_scope()
+        return {
+            n: np.asarray(scope.find_var(n))
+            for n, v in prog.global_block().vars.items()
+            if isinstance(v, Parameter)
+        }
+
+    # merged: k shard-steps per apply, 2 applies
+    loss, _, _ = _regression_problem(3)
+    inner = fluid.optimizer.SGD(0.1)
+    fluid.optimizer.GradientMergeOptimizer(inner, k_steps=k).minimize(loss)
+    prog1 = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    w0 = params(prog1)
+    for _ in range(2):
+        for sx, sy in shards:
+            exe.run(feed={"x": sx, "y": sy}, fetch_list=[loss])
+    merged_params = params(prog1)
+
+    # reference: big-batch SGD with lr scaled by shard/batch loss weighting:
+    # mean-loss over shard then averaged over k == mean-loss over the full
+    # batch (equal shard sizes), so plain SGD(0.1) on the full batch matches
+    loss2, _, _ = _regression_problem(3)
+    fluid.optimizer.SGD(0.1).minimize(loss2)
+    prog2 = fluid.default_main_program()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())
+    # identical init: copy program-1 params onto program-2's (sorted pairing)
+    p2names = sorted(params(prog2), key=lambda n: n.split(".")[-1])
+    for (n1, v), n2 in zip(sorted(w0.items(),
+                                  key=lambda kv: kv[0].split(".")[-1]),
+                           p2names):
+        fluid.global_scope().set_var(n2, v)
+    for _ in range(2):
+        exe2.run(feed={"x": xs, "y": ys}, fetch_list=[loss2])
+    ref_params = params(prog2)
+
+    # param names differ between programs (session-wide unique_name
+    # counter); compare in sorted-suffix order (.w vs .b)
+    mk = sorted(merged_params, key=lambda n: n.split(".")[-1])
+    rk = sorted(ref_params, key=lambda n: n.split(".")[-1])
+    assert len(mk) == len(rk) == 2
+    for a, b in zip(mk, rk):
+        np.testing.assert_allclose(
+            merged_params[a], ref_params[b], rtol=2e-4, atol=2e-5,
+            err_msg=f"{a} vs {b}")
+
+
+def test_model_average_apply_restore():
+    loss, xs, ys = _regression_problem(4)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(0.15)
+    exe, losses = _train(loss, xs, ys, steps=20)
+    scope = fluid.global_scope()
+    pname = [n for n in scope.local_var_names()
+             if n.startswith("fc_") and ".w" in n][0]
+    trained = np.asarray(scope.find_var(pname)).copy()
+    with ma.apply(exe):
+        averaged = np.asarray(scope.find_var(pname))
+        assert not np.allclose(averaged, trained)  # swapped in
+        # eval still runs with averaged weights
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert np.isfinite(float(np.ravel(lv)[0]))
+    restored = np.asarray(scope.find_var(pname))
+    np.testing.assert_array_equal(restored, trained)
+
+
+def test_model_average_reenter_guard_and_accumulator_snapshot():
+    loss, xs, ys = _regression_problem(5)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(0.15)
+    exe, _ = _train(loss, xs, ys, steps=5)
+    scope = fluid.global_scope()
+    sums_before = {
+        sn: np.asarray(scope.find_var(sn)).copy()
+        for sn in ma._param_sums.values()
+    }
+    with ma.apply(exe):
+        # eval runs the program (accumulation ops execute) but must not
+        # pollute the running sums after restore
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="re-entered"):
+            ma._swap_in_averages(scope)
+    for sn, want in sums_before.items():
+        np.testing.assert_array_equal(np.asarray(scope.find_var(sn)), want)
