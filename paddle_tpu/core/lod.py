@@ -34,18 +34,30 @@ def lengths_to_lod(lengths: Sequence[int]) -> List[int]:
 
 @jax.tree_util.register_pytree_node_class
 class LoDValue:
-    """(padded data [num_seqs, max_len, ...], lengths [num_seqs]) pair."""
+    """(padded data [num_seqs, max_len, ...], lengths [num_seqs]) pair.
 
-    def __init__(self, data, lengths):
+    N-level nesting (reference lod_tensor.h stores a vector of offset
+    tables): deeper levels ride in `sub_lengths`, a tuple of per-level
+    count arrays.  A 2-level batch of paragraphs>sentences>words pads to
+    data [N, L1, L2, F] with lengths [N] (= sentences per paragraph) and
+    sub_lengths = ([N, L1],) (= words per sentence).  Most sequence ops
+    consume 1-level values; `flatten_level()` peels the outermost level
+    into the batch dim, the padded mirror of the reference ops' "operate
+    on the last LoD level" convention."""
+
+    def __init__(self, data, lengths, sub_lengths=()):
         self.data = data
         self.lengths = lengths
+        self.sub_lengths = tuple(sub_lengths)
 
     def tree_flatten(self):
-        return (self.data, self.lengths), None
+        return (self.data, self.lengths) + self.sub_lengths, len(
+            self.sub_lengths
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(children[0], children[1], tuple(children[2:]))
 
     @property
     def shape(self):
@@ -55,11 +67,51 @@ class LoDValue:
     def dtype(self):
         return np.asarray(self.data).dtype
 
+    @property
+    def lod_level(self) -> int:
+        return 1 + len(self.sub_lengths)
+
     def lod(self) -> List[List[int]]:
-        return [lengths_to_lod(np.asarray(self.lengths).tolist())]
+        """Offset tables per level, the reference's recursive LoD.  Walks
+        the padded grids by valid index tuple, so it is exact at any
+        nesting depth (padding slots never contribute)."""
+        lengths = np.asarray(self.lengths).reshape(-1)
+        levels = [lengths_to_lod(lengths.tolist())]
+        # (grid index tuple, child count) pairs for the current level
+        slots = [((i,), int(c)) for i, c in enumerate(lengths)]
+        for sub in self.sub_lengths:
+            sub = np.asarray(sub)
+            flat: List[int] = []
+            next_slots = []
+            for idx, c in slots:
+                for j in range(c):
+                    cnt = int(sub[idx + (j,)])
+                    flat.append(cnt)
+                    next_slots.append((idx + (j,), cnt))
+            levels.append(lengths_to_lod(flat))
+            slots = next_slots
+        return levels
+
+    def flatten_level(self) -> "LoDValue":
+        """Peel the outermost level: [N, L1, L2, F] 2-level -> 1-level
+        [N*L1, L2, F] over the inner sequences (padding slots get length
+        0, so masks stay correct)."""
+        if not self.sub_lengths:
+            raise ValueError("flatten_level needs lod_level >= 2")
+        d = np.asarray(self.data) if not hasattr(self.data, "at") else self.data
+        N, L1 = d.shape[0], d.shape[1]
+        flat = d.reshape((N * L1,) + tuple(d.shape[2:]))
+        outer = np.asarray(self.lengths).reshape(-1)
+        sub = np.asarray(self.sub_lengths[0]).reshape(N, L1)
+        valid = np.arange(L1)[None, :] < outer[:, None]
+        inner = np.where(valid, sub, 0).reshape(-1).astype(np.int32)
+        return LoDValue(flat, inner, self.sub_lengths[1:])
 
     def __repr__(self):
-        return f"LoDValue(data={np.shape(self.data)}, lengths={np.shape(self.lengths)})"
+        return (
+            f"LoDValue(data={np.shape(self.data)}, "
+            f"lengths={np.shape(self.lengths)}, level={self.lod_level})"
+        )
 
 
 def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
@@ -75,6 +127,8 @@ def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
         else:
             return np.asarray(data)
     else:
+        if len(recursive_seq_lens) >= 2:
+            return _create_nested(data, recursive_seq_lens)
         lens = list(recursive_seq_lens[-1])
         flat = np.asarray(data)
         seqs = []
@@ -89,3 +143,40 @@ def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
     for i, s in enumerate(seqs):
         out[i, : len(s)] = s
     return LoDValue(out, lengths)
+
+
+def _create_nested(data, recursive_seq_lens) -> LoDValue:
+    """2-level (paragraph > sentence > token) padded construction; deeper
+    nesting recurses on the same shape."""
+    if len(recursive_seq_lens) > 2:
+        raise NotImplementedError(
+            "create_lod_tensor supports up to 2 LoD levels"
+        )
+    outer, inner = (list(l) for l in recursive_seq_lens)
+    if sum(outer) != len(inner):
+        raise ValueError(
+            f"level-0 counts sum to {sum(outer)} but level 1 has "
+            f"{len(inner)} entries"
+        )
+    flat = np.asarray(data)
+    if flat.shape[0] != sum(inner):
+        raise ValueError(
+            f"data has {flat.shape[0]} rows but level-1 lengths sum to "
+            f"{sum(inner)}"
+        )
+    N = len(outer)
+    L1 = max(outer) if outer else 0
+    L2 = max(inner) if inner else 0
+    feat = tuple(flat.shape[1:])
+    out = np.zeros((N, L1, L2) + feat, dtype=flat.dtype)
+    sub = np.zeros((N, L1), dtype=np.int32)
+    tok = 0
+    sent = 0
+    for i, n_sent in enumerate(outer):
+        for j in range(n_sent):
+            n_tok = inner[sent]
+            out[i, j, :n_tok] = flat[tok: tok + n_tok]
+            sub[i, j] = n_tok
+            tok += n_tok
+            sent += 1
+    return LoDValue(out, np.asarray(outer, dtype=np.int32), (sub,))

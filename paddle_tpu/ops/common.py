@@ -103,9 +103,9 @@ def lengths(x):
 
 
 def wrap_lod(template, value):
-    """Re-attach sequence lengths when the input carried them."""
+    """Re-attach sequence lengths (all levels) when the input carried them."""
     if isinstance(template, LoDValue):
-        return LoDValue(value, template.lengths)
+        return LoDValue(value, template.lengths, template.sub_lengths)
     return value
 
 

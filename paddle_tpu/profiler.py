@@ -29,23 +29,32 @@ __all__ = [
 _state: Dict[str, object] = {"on": False, "dir": None}
 # host-side event aggregation (reference prints calls/total/min/max/ave)
 _events: Dict[str, List[float]] = defaultdict(list)
+# (name, start_s, end_s, thread_id) spans for the chrome-trace timeline
+_trace: List[tuple] = []
 
 
 @contextlib.contextmanager
 def record_event(name: str):
     """RAII annotation range (reference: platform::RecordEvent).  Shows up in
-    the XLA trace as a named scope and in the host summary table."""
+    the XLA trace as a named scope, the host summary table, and the
+    timeline export."""
+    import threading
+
     import jax
 
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _events[name].append(time.perf_counter() - t0)
+    t1 = time.perf_counter()
+    _events[name].append(t1 - t0)
+    if _state["on"]:  # span collection only while profiling (bounded)
+        _trace.append((name, t0, t1, threading.get_ident()))
 
 
 def reset_profiler():
     """reference: profiler.py reset_profiler."""
     _events.clear()
+    _trace.clear()
 
 
 def start_profiler(state="All", tracer_option=None, log_dir=None):

@@ -1,0 +1,50 @@
+"""Chrome-trace timeline export (reference: tools/timeline.py, which parses
+profiler protobufs into chrome://tracing JSON; here record_event spans are
+captured directly and written in the same trace-event format, and the
+device-side timeline comes from jax.profiler's TensorBoard trace)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from . import profiler as _profiler
+
+__all__ = ["Timeline", "export_chrome_trace"]
+
+
+def export_chrome_trace(path: str, pid: int = 0) -> int:
+    """Write the record_event spans collected since reset_profiler() as a
+    chrome://tracing / Perfetto-loadable JSON file.  Returns the number of
+    events written."""
+    events = []
+    tids = {}
+    for name, t0, t1, tid in _profiler._trace:
+        tids.setdefault(tid, len(tids))
+        events.append({
+            "name": name,
+            "ph": "X",                       # complete event
+            "ts": t0 * 1e6,                  # microseconds
+            "dur": (t1 - t0) * 1e6,
+            "pid": pid,
+            "tid": tids[tid],
+            "cat": "host",
+        })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)
+
+
+class Timeline:
+    """reference tools/timeline.py CLI shape: Timeline(profile_dict or
+    None).generate_chrome_trace_file(path)."""
+
+    def __init__(self, parsed_profile=None):
+        self._profile = parsed_profile
+
+    def generate_chrome_trace_file(self, path: str) -> int:
+        return export_chrome_trace(path)

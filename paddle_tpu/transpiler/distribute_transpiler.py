@@ -44,6 +44,10 @@ class DistributeTranspilerConfig:
     slice_var_up: bool = True
     split_method: type = RoundRobin
     min_block_size: int = 8192
+    # DC-ASGD (reference: distribute_transpiler.py:141 enable_dc_asgd —
+    # delay-compensated async SGD on the pserver optimize block)
+    enable_dc_asgd: bool = False
+    dc_asgd_lambda: float = 0.04
     # TPU-native extras
     mode: str = "pserver"  # "pserver" | "nccl2" | "collective"
 
@@ -172,7 +176,7 @@ class DistributeTranspiler:
                 if params and params[0] in owned:
                     import copy
 
-                    dst.desc.ops.append(copy.deepcopy(op))
+                    op_copy = copy.deepcopy(op)
                     for n in op.input_arg_names() + op.output_arg_names():
                         if src_block.has_var(n) and not dst.desc.has_var(n):
                             vd = src_block.vars[n]
@@ -180,7 +184,70 @@ class DistributeTranspiler:
                                 name=n, shape=list(vd.shape), dtype=vd.dtype,
                                 persistable=True,
                             )
+                    if self.config.enable_dc_asgd:
+                        self._append_dc_asgd(dst, op_copy)
+                    else:
+                        dst.desc.ops.append(op_copy)
         return prog
+
+    def _append_dc_asgd(self, dst, opt_op) -> None:
+        """Delay compensation (reference: distribute_transpiler.py:869
+        _append_dc_asgd_ops): the stale gradient is corrected with the
+        Taylor term  g_dc = g + lambda * g * g * (param - param_bak)  and
+        param_bak snapshots the post-update param for the next round.
+        Appends the correction ops, the rewired optimizer op, and the
+        snapshot to `dst`."""
+        param = opt_op.input("Param")[0]
+        grad = opt_op.input("Grad")[0]
+        pd = dst.vars[param].desc if hasattr(dst.vars[param], "desc") else dst.vars[param]
+        shape, dtype = list(pd.shape), pd.dtype
+        bak = param + "@BAK"
+        if not dst.desc.has_var(bak):
+            dst.create_var(name=bak, shape=shape, dtype=dtype,
+                           persistable=True)
+
+        def tmp(suffix):
+            n = f"{grad}@DC.{suffix}"
+            if not dst.desc.has_var(n):
+                dst.create_var(name=n, shape=shape, dtype=dtype)
+            return n
+
+        gg = tmp("gg")
+        diff = tmp("diff")
+        corr = tmp("corr")
+        scaled = tmp("scaled")
+        g_dc = f"{grad}@DC"
+        if not dst.desc.has_var(g_dc):
+            dst.create_var(name=g_dc, shape=shape, dtype=dtype)
+        from ..core.proto import OpDesc
+
+        ops = [
+            OpDesc(type="elementwise_mul",
+                   inputs={"X": [grad], "Y": [grad]}, outputs={"Out": [gg]},
+                   attrs={"axis": -1}),
+            OpDesc(type="elementwise_sub",
+                   inputs={"X": [param], "Y": [bak]},
+                   outputs={"Out": [diff]}, attrs={"axis": -1}),
+            OpDesc(type="elementwise_mul",
+                   inputs={"X": [gg], "Y": [diff]},
+                   outputs={"Out": [corr]}, attrs={"axis": -1}),
+            OpDesc(type="scale", inputs={"X": [corr]},
+                   outputs={"Out": [scaled]},
+                   attrs={"scale": float(self.config.dc_asgd_lambda)}),
+            OpDesc(type="elementwise_add",
+                   inputs={"X": [grad], "Y": [scaled]},
+                   outputs={"Out": [g_dc]}, attrs={"axis": -1}),
+        ]
+        dst.desc.ops.extend(ops)
+        # the optimizer consumes the compensated gradient
+        opt_op.inputs["Grad"] = [g_dc]
+        dst.desc.ops.append(opt_op)
+        # snapshot the updated param for the next delay window
+        dst.desc.ops.append(
+            OpDesc(type="assign", inputs={"X": [param]},
+                   outputs={"Out": [bak]})
+        )
+
 
     def get_pserver_programs(self, endpoint: str):
         prog = self.get_pserver_program(endpoint)
@@ -215,4 +282,19 @@ class DistributeTranspiler:
                             name=n, shape=list(vd.shape), dtype=vd.dtype,
                             persistable=True,
                         )
+        # DC-ASGD baks start from the param's initial value (reference
+        # initializes param_bak alongside the param on the pserver)
+        from ..core.proto import OpDesc
+
+        for n in sorted(needed):
+            if n.endswith("@BAK") and not dst.desc.has_var(n):
+                param = n[: -len("@BAK")]
+                if dst.desc.has_var(param):
+                    vd = dst.desc.vars[param]
+                    dst.create_var(name=n, shape=list(vd.shape),
+                                   dtype=vd.dtype, persistable=True)
+                    dst.desc.ops.append(
+                        OpDesc(type="assign", inputs={"X": [param]},
+                               outputs={"Out": [n]})
+                    )
         return prog

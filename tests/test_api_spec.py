@@ -1,0 +1,32 @@
+"""API freeze check (reference: the API.spec diff gate in the reference's
+CI, tools/print_signatures.py)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_public_api_matches_spec():
+    spec_path = os.path.join(REPO, "API.spec")
+    assert os.path.exists(spec_path), (
+        "API.spec missing; run python tools/print_signatures.py --update")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "print_signatures.py")],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ).stdout
+    with open(spec_path) as f:
+        frozen = f.read()
+    if out != frozen:
+        import difflib
+
+        diff = "\n".join(difflib.unified_diff(
+            frozen.splitlines(), out.splitlines(),
+            "API.spec", "current", lineterm="", n=0,
+        ))
+        raise AssertionError(
+            "public API changed without updating API.spec "
+            "(python tools/print_signatures.py --update):\n" + diff[:4000]
+        )

@@ -175,3 +175,110 @@ multi_slot_desc {
         fluid.default_main_program().global_block().all_parameters()[0].name
     ))
     assert np.abs(tbl).sum() > 0
+
+
+def test_dc_asgd_pserver_program():
+    """enable_dc_asgd rewrites the pserver optimize block with delay
+    compensation: g_dc = g + lambda*g*g*(param - param_bak)
+    (reference: distribute_transpiler.py:869 _append_dc_asgd_ops)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler import (
+        DistributeTranspiler, DistributeTranspilerConfig,
+    )
+
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.enable_dc_asgd = True
+    cfg.slice_var_up = False
+    lam = cfg.dc_asgd_lambda
+    t = DistributeTranspiler(config=cfg)
+    eps = ["127.0.0.1:6170"]
+    t.transpile(trainer_id=0, pservers=",".join(eps), trainers=1)
+    prog = t.get_pserver_program(eps[0])
+    types = [op.type for op in prog.desc.block(0).ops]
+    assert "elementwise_mul" in types and "assign" in types
+    assert "sgd" in types
+
+    # execute the pserver block: feed param/grad/lr, check the DC update
+    rng = np.random.RandomState(0)
+    block = prog.desc.block(0)
+    scope = fluid.global_scope().new_scope()
+    inits = {}
+    for op in block.ops:
+        if op.type != "sgd":
+            continue
+        pn = op.input("Param")[0]
+        gn = pn + "@GRAD"  # grads feed the DC chain under their source name
+        shape = [abs(d) for d in block.vars[pn].shape]
+        inits[pn] = rng.randn(*shape).astype("float32")
+        inits[pn + "@BAK"] = rng.randn(*shape).astype("float32")
+        scope.set_var(op.input("LearningRate")[0],
+                      np.array([0.1], dtype="float32"))
+    # DC chains read the original grad names: find them from the mul ops
+    for op in block.ops:
+        if op.type == "elementwise_mul" and op.input("X") == op.input("Y"):
+            gn = op.input("X")[0]
+            shape = [abs(d) for d in block.vars[gn].shape]
+            inits[gn] = rng.randn(*shape).astype("float32")
+    for n, v in inits.items():
+        scope.set_var(n, v)
+    sgd_op = [op for op in block.ops if op.type == "sgd"][0]
+    pname = sgd_op.input("Param")[0]
+    gname = [op for op in block.ops
+             if op.type == "elementwise_mul"
+             and op.output("Out")[0].startswith(pname)][0].input("X")[0]
+    p0, g0, bak0 = inits[pname], inits[gname], inits[pname + "@BAK"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(program=prog, feed={}, fetch_list=[])
+    g_dc = g0 + lam * g0 * g0 * (p0 - bak0)
+    want = p0 - 0.1 * g_dc
+    np.testing.assert_allclose(np.asarray(scope.find_var(pname)), want,
+                               rtol=1e-5)
+    # param_bak snapshots the updated param
+    np.testing.assert_allclose(np.asarray(scope.find_var(pname + "@BAK")),
+                               want, rtol=1e-5)
+
+
+def test_dc_asgd_startup_initializes_bak():
+    """The public get_pserver_programs() pair runs out of the box: startup
+    initializes param@BAK from the param (review finding r2)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler import (
+        DistributeTranspiler, DistributeTranspilerConfig,
+    )
+
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.enable_dc_asgd = True
+    cfg.slice_var_up = False
+    t = DistributeTranspiler(config=cfg)
+    ep = "127.0.0.1:6170"
+    t.transpile(trainer_id=0, pservers=ep, trainers=1)
+    prog, startup = t.get_pserver_programs(ep)
+
+    scope = fluid.global_scope().new_scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(program=startup)
+        # grads arrive from trainers; zero grads -> params unchanged
+        for op in prog.desc.block(0).ops:
+            if op.type == "elementwise_mul" and op.input("X") == op.input("Y"):
+                gn = op.input("X")[0]
+                shape = [abs(d) for d in prog.desc.block(0).vars[gn].shape]
+                scope.set_var(gn, np.zeros(shape, dtype="float32"))
+        exe.run(program=prog, feed={}, fetch_list=[])

@@ -1,0 +1,71 @@
+"""Multi-level LoD (reference: framework/lod_tensor.h nested offset tables,
+python/paddle/fluid/lod_tensor.py create_lod_tensor 2-level examples)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDValue, create_lod_tensor
+
+
+def test_two_level_construction_and_lod():
+    # 2 paragraphs: [2, 3] sentences; sentence word counts [2, 2, 1, 3, 2]
+    flat = np.arange(10, dtype="float32").reshape(10, 1)
+    v = create_lod_tensor(flat, [[2, 3], [2, 2, 1, 3, 2]])
+    assert isinstance(v, LoDValue)
+    assert v.lod_level == 2
+    assert v.data.shape == (2, 3, 3, 1)  # N=2, L1=3, L2=3
+    # reference offset convention
+    assert v.lod() == [[0, 2, 5], [0, 2, 4, 5, 8, 10]]
+    # padded placement: paragraph 1, sentence 2 holds tokens [8, 9]
+    np.testing.assert_allclose(v.data[1, 2, :2, 0], [8.0, 9.0])
+    assert v.data[0, 2].sum() == 0  # padding sentence in paragraph 0
+
+
+def test_flatten_level_roundtrip():
+    flat = np.arange(20, dtype="float32").reshape(10, 2)
+    v = create_lod_tensor(flat, [[2, 3], [2, 2, 1, 3, 2]])
+    inner = v.flatten_level()
+    assert inner.lod_level == 1
+    assert inner.data.shape == (6, 3, 2)  # N*L1 inner sequences
+    np.testing.assert_array_equal(
+        np.asarray(inner.lengths), [2, 2, 0, 1, 3, 2])  # pad slot len 0
+    # inner sequence contents survive
+    np.testing.assert_allclose(
+        np.asarray(inner.data)[0, :2], flat[:2])
+    np.testing.assert_allclose(
+        np.asarray(inner.data)[4, :3], flat[5:8])
+
+
+def test_two_level_feeds_through_executor():
+    """A 2-level value flows through feed -> op -> fetch as a pytree."""
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32", lod_level=2)
+    y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    flat = np.arange(10, dtype="float32").reshape(10, 1)
+    v = create_lod_tensor(flat, [[2, 3], [2, 2, 1, 3, 2]])
+    (got,) = exe.run(feed={"x": v}, fetch_list=[y], return_numpy=False)
+    np.testing.assert_allclose(np.asarray(got.data), np.asarray(v.data) * 2)
+    assert got.lod_level == 2  # nested lengths survive the op
+    assert got.lod() == v.lod()
+
+
+def test_three_level_lod_offsets():
+    """lod() is exact at depth 3 (review finding r2)."""
+    # 2 tops with [2, 1] mids; mids have [2, 1, 2] bottoms;
+    # bottoms have [1, 2, 3, 1, 1] tokens
+    lengths = np.array([2, 1], dtype=np.int32)
+    sub1 = np.zeros((2, 2), dtype=np.int32)
+    sub1[0, 0], sub1[0, 1], sub1[1, 0] = 2, 1, 2
+    sub2 = np.zeros((2, 2, 2), dtype=np.int32)
+    sub2[0, 0, 0], sub2[0, 0, 1] = 1, 2
+    sub2[0, 1, 0] = 3
+    sub2[1, 0, 0], sub2[1, 0, 1] = 1, 1
+    data = np.zeros((2, 2, 2, 3, 1), dtype="float32")
+    v = LoDValue(data, lengths, (sub1, sub2))
+    assert v.lod_level == 3
+    assert v.lod() == [
+        [0, 2, 3],
+        [0, 2, 3, 5],
+        [0, 1, 3, 6, 7, 8],
+    ]

@@ -1,0 +1,43 @@
+"""Timeline export (reference: tools/timeline.py chrome-trace generation)."""
+
+import json
+import os
+import tempfile
+import time
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler, timeline
+
+
+def test_chrome_trace_export():
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    with profiler.record_event("step"):
+        with profiler.record_event("forward"):
+            time.sleep(0.002)
+        with profiler.record_event("backward"):
+            time.sleep(0.001)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "trace.json")
+        n = timeline.export_chrome_trace(path)
+        assert n == 3
+        with open(path) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert names == {"step", "forward", "backward"}
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] > 0
+        # nesting: forward is contained within step
+        by = {e["name"]: e for e in doc["traceEvents"]}
+        assert by["step"]["ts"] <= by["forward"]["ts"]
+        assert (by["forward"]["ts"] + by["forward"]["dur"]
+                <= by["step"]["ts"] + by["step"]["dur"] + 1)
+    profiler.stop_profiler()
+    profiler.reset_profiler()
+
+
+def test_trace_not_collected_when_profiler_off():
+    profiler.reset_profiler()
+    with profiler.record_event("untraced"):
+        pass
+    assert len(profiler._trace) == 0

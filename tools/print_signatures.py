@@ -1,0 +1,87 @@
+"""Dump the public API surface as a stable spec (reference:
+tools/print_signatures.py + the paddle/fluid/API.spec freeze check in CI).
+
+Usage:
+    python tools/print_signatures.py            # print spec to stdout
+    python tools/print_signatures.py --update   # rewrite API.spec
+
+The committed API.spec is the freeze: tests/test_api_spec.py fails when the
+public surface changes without updating the spec, the same contract the
+reference enforces on PRs."""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.io",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.metrics",
+    "paddle_tpu.profiler",
+    "paddle_tpu.timeline",
+    "paddle_tpu.flags",
+    "paddle_tpu.parallel",
+    "paddle_tpu.inference",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.reader",
+    "paddle_tpu.contrib",
+]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def collect() -> list:
+    import importlib
+
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        public = getattr(mod, "__all__", None)
+        if public is None:
+            public = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(public)):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{modname}.{name} class{_sig(obj.__init__)}")
+                for mname, m in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not callable(m):
+                        continue
+                    lines.append(f"{modname}.{name}.{mname} {_sig(m)}")
+            elif callable(obj):
+                lines.append(f"{modname}.{name} {_sig(obj)}")
+            elif inspect.ismodule(obj):
+                continue
+            else:
+                lines.append(f"{modname}.{name} <value>")
+    return lines
+
+
+def main() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if "--update" in sys.argv:
+        with open(os.path.join(repo, "API.spec"), "w") as f:
+            f.write(text)
+        print(f"API.spec updated: {len(lines)} entries")
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()
