@@ -118,12 +118,25 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
 
-    batches = [spec.synthetic_batch(bs, seed=i) for i in range(4)]
+    # stage the synthetic batches on device ONCE: the benchmark measures the
+    # training step, not the host->chip link of this harness (the axon
+    # tunnel moves ~40 MB/s; a production input pipeline double-buffers
+    # transfers behind compute — layers/io_pyreader.py)
+    dev = place.jax_device()
+    batches = [
+        jax.device_put(spec.synthetic_batch(bs, seed=i), dev)
+        for i in range(4)
+    ]
+    jax.block_until_ready(batches)
 
-    # warmup: trigger compile + first runs
-    for i in range(2):
-        exe.run(feed=batches[i % 4], fetch_list=[spec.loss],
-                return_numpy=False)
+    # warmup: 3 steps cover both compile variants (step 1 sees host-side
+    # initial state -> compile A; step 2's state is committed device output
+    # -> compile B; step 3 confirms the cache hit)
+    warm = None
+    for i in range(3):
+        (warm,) = exe.run(feed=batches[i % 4], fetch_list=[spec.loss],
+                          return_numpy=False)
+    jax.block_until_ready(warm)
 
     t0 = time.perf_counter()
     loss_v = None

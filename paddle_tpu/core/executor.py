@@ -260,6 +260,11 @@ class Executor:
         # async dispatch provides the overlap once the transfer is nonblocking)
         device = self.place.jax_device()
         feed_vals = jax.device_put(feed_vals, device)
+        # commit states too: a host-numpy state (fresh from the startup
+        # program) would compile one jit variant, and the committed device
+        # arrays it returns would compile a SECOND — device_put is a no-op
+        # for values already on `device`
+        state_vals = jax.device_put(state_vals, device)
 
         with jax.default_device(device):
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
@@ -276,7 +281,10 @@ class Executor:
             return val.to_numpy() if return_numpy else val
         if isinstance(val, LoDValue):
             if return_numpy:
-                return LoDValue(np.asarray(val.data), np.asarray(val.lengths))
+                return LoDValue(
+                    np.asarray(val.data), np.asarray(val.lengths),
+                    tuple(np.asarray(sl) for sl in val.sub_lengths),
+                )
             return val
         if not return_numpy:
             return val

@@ -105,7 +105,12 @@ class LoDValue:
         sub = np.asarray(self.sub_lengths[0]).reshape(N, L1)
         valid = np.arange(L1)[None, :] < outer[:, None]
         inner = np.where(valid, sub, 0).reshape(-1).astype(np.int32)
-        return LoDValue(flat, inner, self.sub_lengths[1:])
+        # deeper levels' grids fold the same way: (N, L1, ...) -> (N*L1, ...)
+        deeper = tuple(
+            np.asarray(sl).reshape((N * L1,) + np.asarray(sl).shape[2:])
+            for sl in self.sub_lengths[1:]
+        )
+        return LoDValue(flat, inner, deeper)
 
     def __repr__(self):
         return (

@@ -50,9 +50,11 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
                   *, causal, scale, block_q, block_k, seq_k, causal_offset):
     """Grid: (batch*heads, num_q_blocks, num_k_blocks); K innermost so the
     online-softmax state lives in VMEM scratch across K steps.  klen_ref
-    (SMEM) holds this batch row's valid key count (key-padding mask)."""
+    (SMEM) holds every batch row's valid key count (key-padding mask),
+    indexed by program_id(0)."""
     import jax.experimental.pallas as pl
 
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_kb = pl.num_programs(2)
@@ -70,7 +72,7 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = k_pos < jnp.minimum(seq_k, klen_ref[0].astype(jnp.int32))
+    mask = k_pos < jnp.minimum(seq_k, klen_ref[bi].astype(jnp.int32))
     if causal:
         # bottom-right alignment (matches jnp.tril(k=Sk-Sq)): with cached
         # keys (Sk > Sq) a query at row i sees keys up to i + Sk - Sq
@@ -124,8 +126,11 @@ def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
         ),
         grid=grid,
         in_specs=[
+            # whole [B*H] vector in SMEM, indexed by program_id(0) in-kernel
+            # (TPU rejects rank-1 blocks smaller than the 128 tile)
             pl.BlockSpec(
-                (1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM
+                (qf.shape[0],), lambda b, i, j: (0,),
+                memory_space=pltpu.SMEM,
             ),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),

@@ -400,3 +400,74 @@ def _cudnn_lstm(ctx, ins, attrs):
         "last_h": [jnp.stack(last_h)],
         "last_c": [jnp.stack(last_c)],
     }
+
+
+# ---------------------------------------------------------------------------
+# fused sequence RNNs (reference: operators/fused/fusion_lstm_op.cc,
+# fusion_gru_op.cc — MKLDNN-era fusions of fc + recurrence; here the input
+# projection is one extra MXU matmul feeding the same scan, and XLA fuses
+# whatever else it can)
+# ---------------------------------------------------------------------------
+def _fusion_lstm_infer(op, block):
+    x = in_desc(op, block, "X")
+    wh = in_desc(op, block, "WeightH")
+    if x is None or wh is None:
+        return
+    h = wh.shape[0]
+    set_output(block, op, "Hidden", [-1, h], x.dtype, lod_level=1)
+    set_output(block, op, "Cell", [-1, h], x.dtype, lod_level=1)
+    if op.output("XX") and op.output("XX")[0]:
+        set_output(block, op, "XX", [-1, 4 * h], x.dtype, lod_level=1)
+
+
+@register_op("fusion_lstm", infer_shape=_fusion_lstm_infer,
+             diff_inputs=["X", "WeightX", "WeightH", "Bias", "H0", "C0"])
+def _fusion_lstm(ctx, ins, attrs):
+    """fc + LSTM in one op (reference: fused/fusion_lstm_op.cc): the gate
+    projection x @ WeightX lands on the MXU as one batched matmul and the
+    recurrence reuses the lstm scan."""
+    x = ins["X"][0]
+    d = data(x)
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1], dtype=jnp.int32)
+    wx = data(ins["WeightX"][0])   # [M, 4H]
+    xx = jnp.einsum("ntm,mh->nth", d, wx)
+    ins2 = dict(ins)
+    ins2["Input"] = [LoDValue(xx, l)]
+    ins2["Weight"] = ins["WeightH"]
+    hs, cs, gates, preact, l = _lstm_core(ctx, ins2, attrs)
+    return {
+        "Hidden": [LoDValue(hs, l)],
+        "Cell": [LoDValue(cs, l)],
+        "XX": [LoDValue(xx, l)],
+    }
+
+
+def _fusion_gru_infer(op, block):
+    x = in_desc(op, block, "X")
+    wh = in_desc(op, block, "WeightH")
+    if x is None or wh is None:
+        return
+    h = wh.shape[0]
+    set_output(block, op, "Hidden", [-1, h], x.dtype, lod_level=1)
+    if op.output("XX") and op.output("XX")[0]:
+        set_output(block, op, "XX", [-1, 3 * h], x.dtype, lod_level=1)
+
+
+@register_op("fusion_gru", infer_shape=_fusion_gru_infer,
+             diff_inputs=["X", "WeightX", "WeightH", "Bias", "H0"])
+def _fusion_gru(ctx, ins, attrs):
+    """fc + GRU in one op (reference: fused/fusion_gru_op.cc)."""
+    x = ins["X"][0]
+    d = data(x)
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1], dtype=jnp.int32)
+    wx = data(ins["WeightX"][0])   # [M, 3H]
+    xx = jnp.einsum("ntm,mh->nth", d, wx)
+    ins2 = dict(ins)
+    ins2["Input"] = [LoDValue(xx, l)]
+    ins2["Weight"] = ins["WeightH"]
+    outs = _gru(ctx, ins2, attrs)
+    return {"Hidden": outs["Hidden"], "XX": [LoDValue(xx, l)]}

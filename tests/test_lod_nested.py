@@ -69,3 +69,33 @@ def test_three_level_lod_offsets():
         [0, 2, 3, 5],
         [0, 1, 3, 6, 7, 8],
     ]
+
+
+def test_numpy_fetch_keeps_levels():
+    """return_numpy=True fetch preserves nested lengths (review finding)."""
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32", lod_level=2)
+    y = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    flat = np.arange(10, dtype="float32").reshape(10, 1)
+    v = create_lod_tensor(flat, [[2, 3], [2, 2, 1, 3, 2]])
+    (got,) = exe.run(feed={"x": v}, fetch_list=[y])  # default return_numpy
+    assert got.lod_level == 2
+    assert got.lod() == v.lod()
+
+
+def test_flatten_level_depth3():
+    lengths = np.array([2, 1], dtype=np.int32)
+    sub1 = np.zeros((2, 2), dtype=np.int32)
+    sub1[0, 0], sub1[0, 1], sub1[1, 0] = 2, 1, 2
+    sub2 = np.zeros((2, 2, 2), dtype=np.int32)
+    sub2[0, 0, 0], sub2[0, 0, 1] = 1, 2
+    sub2[0, 1, 0] = 3
+    sub2[1, 0, 0], sub2[1, 0, 1] = 1, 1
+    data = np.zeros((2, 2, 2, 3, 1), dtype="float32")
+    v = LoDValue(data, lengths, (sub1, sub2))
+    inner = v.flatten_level()
+    assert inner.lod_level == 2
+    # offsets of the flattened view drop the old outermost level; the
+    # grid-ordered slots are (0,0)=2, (0,1)=1, (1,0)=2, (1,1)=pad 0
+    assert inner.lod() == [[0, 2, 3, 5, 5], [0, 1, 3, 6, 7, 8]]

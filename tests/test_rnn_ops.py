@@ -194,3 +194,67 @@ def test_stacked_dynamic_lstm_trains():
         (l,) = exe.run(feed=batch, fetch_list=[spec.loss])
         losses.append(float(np.ravel(l)[0]))
     assert losses[-1] < losses[0]
+
+
+def test_fusion_lstm_matches_fc_plus_lstm():
+    """fusion_lstm == (x @ WeightX) fed to the lstm op
+    (reference: fused/fusion_lstm_op.cc)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core.lod import create_lod_tensor
+
+    rng = np.random.RandomState(0)
+    M, H = 5, 4
+    lens = [3, 2]
+    flat = rng.randn(sum(lens), M).astype("float32") * 0.5
+    wx = rng.randn(M, 4 * H).astype("float32") * 0.3
+    wh = rng.randn(H, 4 * H).astype("float32") * 0.3
+    bias = rng.randn(1, 4 * H).astype("float32") * 0.1
+
+    def run(op_type):
+        fluid.reset_default_env()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            block = prog.global_block()
+            names = {}
+            for n, v in [("x", flat), ("wx", wx), ("wh", wh), ("b", bias)]:
+                shape = [-1] + list(np.shape(v)[1:]) if n == "x" else list(np.shape(v))
+                block.create_var(name=n, shape=shape, dtype="float32",
+                                 lod_level=1 if n == "x" else 0)
+                names[n] = n
+            for slot in ("hidden", "cell", "xx", "bg", "pre"):
+                block.create_var(name=slot, shape=[-1, H], dtype="float32",
+                                 lod_level=1)
+            if op_type == "fusion_lstm":
+                block.append_op(
+                    type="fusion_lstm",
+                    inputs={"X": ["x"], "WeightX": ["wx"],
+                            "WeightH": ["wh"], "Bias": ["b"]},
+                    outputs={"Hidden": ["hidden"], "Cell": ["cell"],
+                             "XX": ["xx"]},
+                    attrs={"use_peepholes": False},
+                )
+            else:
+                block.create_var(name="xin", shape=[-1, 4 * H],
+                                 dtype="float32", lod_level=1)
+                block.append_op(type="mul", inputs={"X": ["x"], "Y": ["wx"]},
+                                outputs={"Out": ["xin"]},
+                                attrs={"x_num_col_dims": 1,
+                                       "y_num_col_dims": 1})
+                block.append_op(
+                    type="lstm",
+                    inputs={"Input": ["xin"], "Weight": ["wh"],
+                            "Bias": ["b"]},
+                    outputs={"Hidden": ["hidden"], "Cell": ["cell"],
+                             "BatchGate": ["bg"], "BatchCellPreAct": ["pre"]},
+                    attrs={"use_peepholes": False},
+                )
+        exe = fluid.Executor(fluid.CPUPlace())
+        lod = create_lod_tensor(flat, [lens])
+        (h,) = exe.run(program=prog,
+                       feed={"x": lod, "wx": wx, "wh": wh, "b": bias},
+                       fetch_list=["hidden"], return_numpy=False)
+        return np.asarray(h.data)
+
+    np.testing.assert_allclose(run("fusion_lstm"), run("lstm"),
+                               rtol=1e-5, atol=1e-6)
