@@ -14,7 +14,7 @@ blocks until the final block_until_ready — the reference gets the same
 overlap from its double-buffer reader ops
 (operators/reader/create_double_buffer_reader_op.cc).
 
-Env knobs: BENCH_BS (resnet bs, default 64), BENCH_TRANSFORMER_BS (default
+Env knobs: BENCH_BS (resnet bs, default 128), BENCH_TRANSFORMER_BS (default
 16), BENCH_STEPS (default 20), BENCH_MODELS (comma list, default
 "resnet50,transformer"), BENCH_AMP (default "1": bf16 matmul/conv compute),
 BENCH_FLASH (default "1"), BENCH_PEAK_TFLOPS (chip peak for MFU, default
@@ -57,7 +57,7 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     fluid.reset_default_env()
 
     if model == "resnet50":
-        bs = int(os.environ.get("BENCH_BS", "64"))
+        bs = int(os.environ.get("BENCH_BS", "128"))  # chip sweet spot
         spec = models.resnet_imagenet(depth=50, class_num=1000)
         unit = "images/sec"
         items_per_step = bs

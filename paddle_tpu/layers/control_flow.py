@@ -27,6 +27,7 @@ __all__ = [
     "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "shrink_memory", "split_lod_tensor",
     "merge_lod_tensor", "Print", "is_empty",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -935,3 +936,16 @@ def _conditional_block_ctx(helper, cond):
             "__out_names__": out_names,
         },
     )
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder batch rows into rank-table order (reference:
+    layers/control_flow.py reorder_lod_tensor_by_rank)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out

@@ -32,6 +32,7 @@ __all__ = [
     "roi_perspective_transform",
     "polygon_box_transform",
     "detection_map",
+    "multi_box_head",
 ]
 
 
@@ -52,6 +53,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
             "max_sizes": [float(m) for m in (max_sizes or [])],
             "aspect_ratios": [float(a) for a in aspect_ratios],
             "variances": [float(v) for v in variance],
+            "min_max_aspect_ratios_order": bool(min_max_aspect_ratios_order),
             "flip": flip, "clip": clip,
             "step_w": float(steps[0]), "step_h": float(steps[1]),
             "offset": offset,
@@ -543,3 +545,91 @@ def detection_map(detect_res, label, class_num, background_label=0,
                "background_label": background_label},
     )
     return m
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-scale detection head (reference: layers/detection.py
+    multi_box_head): per feature map, prior boxes plus 3x3/1x1 conv heads
+    for box regression and class confidences; outputs concatenated
+    (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4], vars [P, 4])."""
+    from . import nn as _nn
+    from .tensor import concat as _concat, reshape as _reshape
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes = []
+        max_sizes = []
+        if n_layer > 1:
+            step = int(
+                (max_ratio - min_ratio) / (n_layer - 2)) if n_layer > 2 else 0
+            min_sizes = [base_size * 0.1]
+            max_sizes = [base_size * 0.2]
+            for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+                min_sizes.append(base_size * ratio / 100.0)
+                max_sizes.append(base_size * (ratio + step) / 100.0)
+            min_sizes = min_sizes[:n_layer]
+            max_sizes = max_sizes[:n_layer]
+        else:
+            min_sizes = [base_size * 0.2]
+            max_sizes = [base_size * 0.5]
+
+    from .tensor import transpose as _transpose
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_s = min_sizes[i]
+        max_s = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(
+            aspect_ratios[i], (list, tuple)) else [aspect_ratios[i]]
+        sw = steps[i] if steps else (step_w[i] if step_w else 0.0)
+        sh = steps[i] if steps else (step_h[i] if step_h else 0.0)
+        min_list = list(min_s) if isinstance(min_s, (list, tuple)) else [min_s]
+        max_list = (
+            (list(max_s) if isinstance(max_s, (list, tuple)) else [max_s])
+            if max_s is not None else []
+        )
+        box, var = prior_box(
+            inp, image, min_sizes=min_list,
+            max_sizes=max_list or None,
+            aspect_ratios=ar, variance=list(variance), flip=flip,
+            clip=clip, steps=[sw, sh], offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order,
+        )
+        # priors per cell: EXACTLY the kernel's expansion — dedup'd aspect
+        # ratios (1.0 always present, flip adds reciprocals) x min sizes,
+        # plus one per (min, max) pair (ops/detection_ops.py _prior_box)
+        ars = [1.0]
+        for a in ar:
+            a = float(a)
+            if any(abs(a - e) < 1e-6 for e in ars):
+                continue
+            ars.append(a)
+            if flip and abs(a - 1.0) > 1e-6:
+                ars.append(1.0 / a)
+        num_priors = len(min_list) * len(ars) + len(max_list)
+
+        loc = _nn.conv2d(inp, num_priors * 4, kernel_size, padding=pad,
+                         stride=stride)
+        conf = _nn.conv2d(inp, num_priors * num_classes, kernel_size,
+                          padding=pad, stride=stride)
+        # [N, C', H, W] -> [N, H*W*priors, 4 or num_classes]
+        loc = _reshape(_transpose(loc, perm=[0, 2, 3, 1]),
+                       shape=(0, -1, 4))
+        conf = _reshape(_transpose(conf, perm=[0, 2, 3, 1]),
+                        shape=(0, -1, num_classes))
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(_reshape(box, shape=(-1, 4)))
+        vars_all.append(_reshape(var, shape=(-1, 4)))
+
+    mbox_locs = _concat(locs, axis=1)
+    mbox_confs = _concat(confs, axis=1)
+    boxes = _concat(boxes_all, axis=0)
+    variances = _concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -11,7 +11,7 @@ from ..core.proto import VarType
 
 from .io_pyreader import EOFException, double_buffer, py_reader, read_file  # noqa: F401
 
-__all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException"]
+__all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException", "shuffle", "batch", "create_py_reader_by_data"]
 
 
 def data(
@@ -36,4 +36,34 @@ def data(
         lod_level=lod_level,
         type=type,
         stop_gradient=stop_gradient,
+    )
+
+
+def shuffle(reader, buffer_size):
+    """reference: layers/io.py shuffle — in this framework readers are
+    python callables, so this delegates to the reader-decorator stack."""
+    from ..reader import shuffle as _shuffle
+
+    return _shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    """reference: layers/io.py batch (see shuffle)."""
+    from ..reader import batch as _batch
+
+    return _batch(reader, batch_size)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """py_reader bound to existing data vars (reference: layers/io.py
+    create_py_reader_by_data) — same queue-fed reader as py_reader with
+    shapes/dtypes taken from feed_list."""
+    shapes = [list(v.shape) for v in feed_list]
+    dtypes = [v.dtype for v in feed_list]
+    lod_levels = [getattr(v, "lod_level", 0) or 0 for v in feed_list]
+    return py_reader(
+        capacity=capacity, shapes=shapes, dtypes=dtypes,
+        lod_levels=lod_levels, name=name,
+        use_double_buffer=use_double_buffer,
     )

@@ -94,6 +94,16 @@ __all__ = [
     "unpool",
     "spp",
     "hsigmoid",
+    "rank_loss",
+    "margin_rank_loss",
+    "bpr_loss",
+    "dice_loss",
+    "bilinear_tensor_product",
+    "multiplex",
+    "sampling_id",
+    "space_to_depth",
+    "crop",
+    "image_resize_short",
 ]
 
 
@@ -1394,3 +1404,156 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
         attrs={"num_classes": num_classes, "is_sparse": is_sparse},
     )
     return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference: layers/nn.py rank_loss over
+    operators/rank_loss_op.cc)."""
+    helper = LayerHelper("rank_loss", **locals())
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        type="rank_loss",
+        inputs={"Label": [label], "Left": [left], "Right": [right]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """Margin ranking loss (reference: layers/nn.py margin_rank_loss)."""
+    helper = LayerHelper("margin_rank_loss", **locals())
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        type="margin_rank_loss",
+        inputs={"Label": [label], "X1": [left], "X2": [right]},
+        outputs={"Out": [out], "Activated": [act]},
+        attrs={"margin": float(margin)},
+    )
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss (reference: layers/nn.py bpr_loss
+    over operators/bpr_loss_op.cc)."""
+    helper = LayerHelper("bpr_loss", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="bpr_loss", inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+    )
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Dice coefficient loss, 1 - 2|X*Y|/(|X|+|Y|) (reference:
+    layers/nn.py dice_loss — a pure composition of elementwise/reduce
+    layers, same here)."""
+    from ..layers import one_hot, reduce_mean, reduce_sum, scale
+
+    # label arrives [N, 1] (fluid id-column convention); one_hot folds it
+    label_oh = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label_oh), dim=reduce_dims)
+    denom = elementwise_add(
+        reduce_sum(input, dim=reduce_dims),
+        reduce_sum(label_oh, dim=reduce_dims),
+    )
+    # epsilon on the DENOMINATOR only (reference dice_loss): an empty
+    # ground-truth mask yields loss 1, not 0
+    frac = elementwise_div(
+        scale(inse, scale=2.0),
+        scale(denom, scale=1.0, bias=float(epsilon)),
+    )
+    return reduce_mean(scale(frac, scale=-1.0, bias=1.0))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x W_k y + b (reference: layers/nn.py bilinear_tensor_product
+    over operators/bilinear_tensor_product_op.cc)."""
+    helper = LayerHelper("bilinear_tensor_product", input=x,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = x.dtype
+    w = helper.create_parameter(
+        helper.param_attr, shape=[size, x.shape[1], y.shape[1]], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr is not None:
+        b = helper.create_parameter(helper.bias_attr, shape=[1, size],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors (reference: layers/nn.py
+    multiplex over operators/multiplex_op.cc)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        type="multiplex",
+        inputs={"X": list(inputs), "Ids": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32", name=None):
+    """Sample a category index per row from a probability matrix
+    (reference: layers/nn.py sampling_id)."""
+    helper = LayerHelper("sampling_id", input=x, name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"min": float(min), "max": float(max), "seed": seed},
+    )
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Rearrange spatial blocks into channels (reference: layers/nn.py
+    space_to_depth over operators/space_to_depth_op.cc)."""
+    helper = LayerHelper("space_to_depth", input=x, name=name)
+    n, c, h, w = x.shape
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="space_to_depth", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"blocksize": int(blocksize)},
+    )
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (reference: layers/nn.py crop over operators/crop_op.cc)."""
+    helper = LayerHelper("crop", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if shape is None:
+        shape = list(x.shape)
+    if hasattr(shape, "dtype"):  # Variable reference form: use its shape
+        shape = list(shape.shape)
+    if offsets is None:
+        offsets = [0] * len(shape)
+    helper.append_op(
+        type="crop", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"shape": [int(s) for s in shape],
+               "offsets": [int(o) for o in offsets]},
+    )
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect
+    (reference: layers/nn.py image_resize_short)."""
+    in_shape = list(input.shape)
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[1 - short_idx] = int(
+        round(hw[1 - short_idx] * (out_short_len / float(hw[short_idx])))
+    )
+    return image_resize(input, out_shape=out_shape, resample=resample)

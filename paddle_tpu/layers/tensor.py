@@ -54,6 +54,10 @@ __all__ = [
     "reduce_min",
     "reduce_prod",
     "tensor_array_to_tensor",
+    "sum",
+    "merge_selected_rows",
+    "get_tensor_from_selected_rows",
+    "load",
 ]
 
 
@@ -482,3 +486,42 @@ def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
         attrs={"axis": int(axis), "use_stack": bool(use_stack)},
     )
     return out, out_index
+
+
+def sum(x):
+    """Elementwise sum of a list of tensors (reference: layers/tensor.py
+    sum over operators/sum_op.cc); single-tensor input passes through the
+    same op for API parity."""
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def merge_selected_rows(x, name=None):
+    """Dedup a SelectedRows value's rows by id-sum (reference:
+    layers/nn.py merge_selected_rows over merge_selected_rows_op.cc)."""
+    helper = LayerHelper("merge_selected_rows", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows -> dense row tensor (reference: layers/nn.py
+    get_tensor_from_selected_rows)."""
+    helper = LayerHelper("get_tensor_from_selected_rows", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="get_tensor_from_selected_rows",
+                     inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def load(out, file_path, load_as_fp16=False):
+    """Load a saved blob into `out` at run time (reference:
+    layers/tensor.py load over operators/load_op.cc; the blob is the .npy
+    written by io.save_vars)."""
+    helper = LayerHelper("load", input=out)
+    helper.append_op(
+        type="load", inputs={}, outputs={"Out": [out]},
+        attrs={"file_path": file_path, "load_as_fp16": bool(load_as_fp16)},
+    )
+    return out

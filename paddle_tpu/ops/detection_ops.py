@@ -57,13 +57,25 @@ def _prior_box(ctx, ins, attrs):
     step_h = float(attrs.get("step_h", 0.0)) or IH / H
     offset = float(attrs.get("offset", 0.5))
 
+    mm_order = attrs.get("min_max_aspect_ratios_order", False)
     whs = []
     for ms in min_sizes:
-        for ar in ars:
-            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
-        if max_sizes:
-            mx = max_sizes[min_sizes.index(ms)]
-            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        if mm_order:
+            # reference prior_box kernel option: [min, max, other ars...]
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
     P = len(whs)
 
     cx = (np.arange(W) + offset) * step_w

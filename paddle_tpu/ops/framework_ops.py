@@ -386,3 +386,28 @@ def _fused_elemwise_activation(ctx, ins, attrs):
                 f"functor_list {functors}: one entry must be unary")
         out = u1(_fused_binary(f2, attrs)(x, y))
     return {"Out": [out]}
+
+
+def _load_infer(op, block):
+    # target var keeps its declared desc, except load_as_fp16 retypes it
+    if op.attr("load_as_fp16", False):
+        names = op.output("Out")
+        if names and names[0]:
+            v = block._find_var_recursive(names[0])
+            if v is not None:
+                v.desc.dtype = DataType.FP16
+
+
+@register_op("load", infer_shape=_load_infer, no_grad=True, stateful=True)
+def _load_op(ctx, ins, attrs):
+    """Load a .npy blob written by io.save_vars (reference:
+    operators/load_op.cc reads the LoDTensor wire format; the on-disk
+    format here is the numpy blob io.py writes).  The path is a static
+    attr, so the read folds into the program as a constant."""
+    path = attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    arr = np.load(path)
+    if attrs.get("load_as_fp16"):
+        arr = arr.astype(np.float16)
+    return {"Out": [jnp.asarray(arr)]}

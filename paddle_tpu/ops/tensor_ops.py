@@ -570,7 +570,19 @@ def _one_hot_infer(op, block):
 @register_op("one_hot", infer_shape=_one_hot_infer, no_grad=True)
 def _one_hot(ctx, ins, attrs):
     x = data(ins["X"][0])
-    if x.ndim and x.shape[-1] == 1:
+    # squeeze the fluid [N, 1] id column — decided by the DESC rank, not the
+    # runtime shape (a [N] input with N == 1 must not collapse to a scalar)
+    desc_rank = None
+    op = getattr(ctx, "cur_op", None) if ctx is not None else None
+    if op is not None:
+        names = op.input("X")
+        v = ctx.block._find_var_recursive(names[0]) if names else None
+        if v is not None and v.desc.shape:
+            desc_rank = len(v.desc.shape)
+    squeeze = (
+        x.ndim == desc_rank if desc_rank is not None else x.ndim > 1
+    ) and x.ndim and x.shape[-1] == 1 and (desc_rank or 2) > 1
+    if squeeze:
         x = jnp.squeeze(x, axis=-1)
     return {"Out": [jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)]}
 
@@ -660,7 +672,11 @@ def _crop(ctx, ins, attrs):
     x = data(ins["X"][0])
     offsets = attrs.get("offsets", [0] * x.ndim)
     shape = attrs.get("shape", list(x.shape))
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    # -1 keeps the full extent from the offset (desc batch dims are -1)
+    idx = tuple(
+        slice(o, None) if s < 0 else slice(o, o + s)
+        for o, s in zip(offsets, shape)
+    )
     return {"Out": [x[idx]]}
 
 

@@ -1,0 +1,98 @@
+"""Tail layer-API coverage (reference: layers/nn.py rank_loss, dice_loss,
+multiplex, space_to_depth, bilinear_tensor_product; layers/detection.py
+multi_box_head; layers/tensor.py sum/load; layers/io.py shuffle/batch)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_multi_box_head_prior_channel_agreement():
+    fluid.reset_default_env()
+    img = fluid.layers.data(name="img", shape=[3, 64, 64], dtype="float32")
+    f1 = fluid.layers.conv2d(img, 8, 3, stride=4, padding=1)
+    f2 = fluid.layers.conv2d(f1, 8, 3, stride=2, padding=1)
+    locs, confs, boxes, variances = fluid.layers.multi_box_head(
+        [f1, f2], img, base_size=64, num_classes=5,
+        aspect_ratios=[[2.0], [2.0, 3.0]], min_ratio=20, max_ratio=90,
+        steps=[4.0, 8.0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32")
+    lv, cv, bv, vv = exe.run(feed={"img": x},
+                             fetch_list=[locs, confs, boxes, variances])
+    assert lv.shape == (2, bv.shape[0], 4)
+    assert cv.shape == (2, bv.shape[0], 5)
+    assert vv.shape == bv.shape
+
+
+def test_multi_box_head_min_max_order_and_reciprocal_ars():
+    """Reciprocal aspect-ratio pairs dedupe in the kernel; the head's conv
+    channel count must agree (review finding r2)."""
+    fluid.reset_default_env()
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    f1 = fluid.layers.conv2d(img, 4, 3, stride=4, padding=1)
+    locs, confs, boxes, _ = fluid.layers.multi_box_head(
+        [f1], img, base_size=32, num_classes=3,
+        aspect_ratios=[[2.0, 0.5]], min_sizes=[10.0], max_sizes=[20.0],
+        steps=[4.0], min_max_aspect_ratios_order=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(1).rand(1, 3, 32, 32).astype("float32")
+    lv, bv = exe.run(feed={"img": x}, fetch_list=[locs, boxes])
+    assert lv.shape[1] == bv.shape[0]
+
+
+def test_crop_keeps_batch_dim():
+    """-1 dims in the crop shape keep the full extent (review finding)."""
+    fluid.reset_default_env()
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    out = fluid.layers.crop(x, shape=[-1, 2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.arange(12, dtype="float32").reshape(3, 4)
+    (got,) = exe.run(feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(got, xs[:, :2])  # all 3 rows survive
+
+
+def test_dice_loss_empty_mask_is_maximal():
+    fluid.reset_default_env()
+    p = fluid.layers.data(name="p", shape=[3], dtype="float32")
+    lab = fluid.layers.data(name="l", shape=[1], dtype="int64")
+    loss = fluid.layers.dice_loss(p, lab)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # prediction puts no mass on the labeled class -> dice -> loss 1
+    probs = np.array([[1.0, 0.0, 0.0]], dtype="float32")
+    (got,) = exe.run(feed={"p": probs,
+                           "l": np.array([[2]], dtype="int64")},
+                     fetch_list=[loss])
+    np.testing.assert_allclose(got, 1.0, atol=1e-4)
+
+
+def test_sum_and_load_roundtrip():
+    fluid.reset_default_env()
+    with tempfile.TemporaryDirectory() as d:
+        np.save(os.path.join(d, "w.npy"),
+                np.arange(6, dtype="float32").reshape(2, 3))
+        prog = fluid.default_main_program()
+        w = prog.global_block().create_var(
+            name="w", shape=[2, 3], dtype="float32", persistable=True)
+        fluid.layers.load(w, os.path.join(d, "w"))
+        total = fluid.layers.sum([w, w])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (got,) = exe.run(feed={}, fetch_list=[total])
+        np.testing.assert_allclose(got,
+                                   np.arange(6).reshape(2, 3) * 2.0)
+
+
+def test_reader_aliases():
+    def rd():
+        for i in range(10):
+            yield (np.full((2,), i, dtype="float32"),)
+
+    batched = fluid.layers.batch(fluid.layers.shuffle(rd, 4), 2)
+    out = list(batched())
+    assert len(out) == 5
+    assert len(out[0]) == 2  # batch of 2 samples
