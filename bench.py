@@ -90,6 +90,20 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         dnn_flops = 2 * (26 * 10 * 400 + 400 * 400 * 2 + 400)
         flops_per_item = 3 * dnn_flops
         lr = 1e-3
+    elif model == "lstm":
+        # BASELINE.md "LSTM text-cls (2xlstm+fc)" IMDB config: bs=64,
+        # h=512, seq len 100 (benchmark/README.md:112-127; the published
+        # table mixes units, so no vs_baseline ratio is claimed)
+        bs = int(os.environ.get("BENCH_LSTM_BS", "64"))
+        spec = models.stacked_dynamic_lstm(lstm_size=512, stacked_layers=2)
+        unit = "examples/sec"
+        items_per_step = bs
+        metric = "lstm_textcls_train_examples_per_sec_per_chip"
+        baseline = None
+        # per token per layer: fc projection (h->4h) AND recurrent matmul
+        # (h->4h) = 16*h^2 MACs; 2 layers + the input fc; x3 for training
+        flops_per_item = 3 * 100 * (2 * 2 * 16 * 512 * 512 + 2 * 512 * 512)
+        lr = 0.01
     elif model == "lenet":
         bs = int(os.environ.get("BENCH_BS", "64"))
         spec = models.lenet5()
@@ -101,7 +115,7 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         lr = 0.01
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
-                         "(expected resnet50|transformer|deepfm|lenet)")
+                         "(expected resnet50|transformer|deepfm|lstm|lenet)")
 
     if model == "deepfm":
         # lazy sparse adam over the 1e6-row tables: only touched rows

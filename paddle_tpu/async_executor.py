@@ -29,6 +29,128 @@ from .data_feed_desc import DataFeedDesc
 __all__ = ["AsyncExecutor"]
 
 
+def _rows_from_handle(lib, h, slots):
+    """Unpack a parsed-chunk handle into per-line rows of numpy views."""
+    import ctypes
+
+    L = lib.ms_num_lines(h)
+    n = len(slots)
+    cols = []
+    for i, s in enumerate(slots):
+        lens = np.empty(L, dtype=np.int32)
+        if L:
+            lib.ms_slot_lens(
+                h, i, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+            )
+        total = lib.ms_slot_total(h, i)
+        if s.type.startswith("float"):
+            vals = np.empty(total, dtype=np.float32)
+            if total:
+                lib.ms_slot_values_f(
+                    h, i,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                )
+        else:
+            vals = np.empty(total, dtype=np.int64)
+            if total:
+                lib.ms_slot_values_i(
+                    h, i,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                )
+        offs = np.zeros(L + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        cols.append((vals, offs))
+    for r in range(L):
+        yield [
+            cols[i][0][cols[i][1][r]: cols[i][1][r + 1]]
+            if slots[i].is_used else None
+            for i in range(n)
+        ]
+
+
+_MS_CHUNK_BYTES = 8 << 20  # per-worker parse granularity (bounds memory)
+
+
+def _parse_multislot_file(path: str, slots):
+    """Stream a MultiSlot file as per-line rows.  Chunks of whole lines go
+    through the native C++ parser (native/multislot.cc, the reference's
+    MultiSlotDataFeed::ParseOneInstance role) so worker memory stays
+    O(chunk), not O(file); falls back to the per-line Python parser when
+    the native lib doesn't build."""
+    import ctypes
+
+    from . import native
+
+    lib = native.load("multislot")
+    if lib is None:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield _parse_multislot_line(line, slots)
+        return
+
+    lib.ms_parse_buffer.restype = ctypes.c_void_p
+    lib.ms_parse_buffer.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+    ]
+    for fn, res, args in (
+        ("ms_error", ctypes.c_long, [ctypes.c_void_p]),
+        ("ms_num_lines", ctypes.c_long, [ctypes.c_void_p]),
+        ("ms_slot_total", ctypes.c_long, [ctypes.c_void_p, ctypes.c_int]),
+    ):
+        getattr(lib, fn).restype = res
+        getattr(lib, fn).argtypes = args
+    lib.ms_free.argtypes = [ctypes.c_void_p]
+    lib.ms_slot_lens.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ms_slot_values_f.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.ms_slot_values_i.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+    ]
+
+    n = len(slots)
+    types = (ctypes.c_int * n)(
+        *[0 if s.type.startswith("float") else 1 for s in slots]
+    )
+    lineno = 0
+    with open(path, "rb") as f:
+        tail = b""
+        while True:
+            chunk = f.read(_MS_CHUNK_BYTES)
+            data = tail + chunk
+            if not data:
+                break
+            if chunk:
+                # cut at the last newline; the remainder carries over
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    tail = data
+                    continue
+                data, tail = data[: cut + 1], data[cut + 1:]
+            else:
+                tail = b""
+            h = lib.ms_parse_buffer(data, len(data), n, types, lineno)
+            if not h:
+                raise IOError(f"MultiSlot parse failed for {path!r}")
+            try:
+                err = lib.ms_error(h)
+                if err:
+                    raise ValueError(
+                        f"malformed MultiSlot line {err} in {path!r}"
+                    )
+                yield from _rows_from_handle(lib, h, slots)
+            finally:
+                lib.ms_free(h)
+            lineno += data.count(b"\n")
+            if not chunk:
+                break
+
+
 def _parse_multislot_line(line: str, slots):
     """One MultiSlot text line: for each slot, '<n> v1 ... vn'
     (reference: data_feed.cc MultiSlotDataFeed::ParseOneInstance).  ALL
@@ -114,29 +236,23 @@ class AsyncExecutor:
                     except queue.Empty:
                         return
                     batch = []
-                    with open(path) as f:
-                        for line in f:
-                            line = line.strip()
-                            if not line:
-                                continue
-                            batch.append(
-                                _parse_multislot_line(line, all_slots)
+                    for row in _parse_multislot_file(path, all_slots):
+                        batch.append(row)
+                        if len(batch) == data_feed.batch_size:
+                            vals = exe.run(
+                                program=program,
+                                feed=feed_from(batch),
+                                fetch_list=fetch_names,
                             )
-                            if len(batch) == data_feed.batch_size:
-                                vals = exe.run(
-                                    program=program,
-                                    feed=feed_from(batch),
-                                    fetch_list=fetch_names,
-                                )
-                                if debug and fetch_names:
-                                    print(
-                                        f"[async_executor] {path}: "
-                                        + ", ".join(
-                                            f"{n}={np.ravel(np.asarray(v))[0]:.6f}"
-                                            for n, v in zip(fetch_names, vals)
-                                        )
+                            if debug and fetch_names:
+                                print(
+                                    f"[async_executor] {path}: "
+                                    + ", ".join(
+                                        f"{n}={np.ravel(np.asarray(v))[0]:.6f}"
+                                        for n, v in zip(fetch_names, vals)
                                     )
-                                batch = []
+                                )
+                            batch = []
                     if batch:
                         exe.run(program=program, feed=feed_from(batch),
                                 fetch_list=fetch_names)

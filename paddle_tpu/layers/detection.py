@@ -566,8 +566,12 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         min_sizes = []
         max_sizes = []
         if n_layer > 1:
-            step = int(
-                (max_ratio - min_ratio) / (n_layer - 2)) if n_layer > 2 else 0
+            # reference schedule: ratios step evenly from min to max; with
+            # only 2 layers the single interval spans the whole range
+            step = (
+                int((max_ratio - min_ratio) / (n_layer - 2))
+                if n_layer > 2 else (max_ratio - min_ratio)
+            )
             min_sizes = [base_size * 0.1]
             max_sizes = [base_size * 0.2]
             for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
@@ -601,18 +605,14 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             clip=clip, steps=[sw, sh], offset=offset,
             min_max_aspect_ratios_order=min_max_aspect_ratios_order,
         )
-        # priors per cell: EXACTLY the kernel's expansion — dedup'd aspect
-        # ratios (1.0 always present, flip adds reciprocals) x min sizes,
-        # plus one per (min, max) pair (ops/detection_ops.py _prior_box)
-        ars = [1.0]
-        for a in ar:
-            a = float(a)
-            if any(abs(a - e) < 1e-6 for e in ars):
-                continue
-            ars.append(a)
-            if flip and abs(a - 1.0) > 1e-6:
-                ars.append(1.0 / a)
-        num_priors = len(min_list) * len(ars) + len(max_list)
+        # priors per cell: the kernel's own expansion (shared helper, so
+        # the conv-head channel count can never drift from the kernel)
+        from ..ops.detection_ops import expand_aspect_ratios
+
+        num_priors = (
+            len(min_list) * len(expand_aspect_ratios(ar, flip))
+            + len(max_list)
+        )
 
         loc = _nn.conv2d(inp, num_priors * 4, kernel_size, padding=pad,
                          stride=stride)

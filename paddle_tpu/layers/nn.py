@@ -1511,6 +1511,10 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32", name=None):
         type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
         attrs={"min": float(min), "max": float(max), "seed": seed},
     )
+    if dtype not in ("int64", DataType.INT64):
+        from .tensor import cast
+
+        return cast(out, dtype)
     return out
 
 

@@ -26,6 +26,23 @@ from .common import data, in_desc, lengths, set_output
 # ---------------------------------------------------------------------------
 # box generators (compile-time numpy)
 # ---------------------------------------------------------------------------
+def expand_aspect_ratios(aspect_ratios, flip):
+    """The prior_box kernel's ratio expansion (reference:
+    detection/prior_box_op.h ExpandAspectRatios): 1.0 always present,
+    near-duplicates dropped, flip adds reciprocals.  Shared with
+    layers/detection.py multi_box_head so conv-head channel counts can
+    never drift from the kernel's prior count."""
+    ars = [1.0]
+    for ar in aspect_ratios or []:
+        ar = float(ar)
+        if any(abs(ar - e) < 1e-6 for e in ars):
+            continue
+        ars.append(ar)
+        if flip and abs(ar - 1.0) > 1e-6:
+            ars.append(1.0 / ar)
+    return ars
+
+
 def _prior_box_infer(op, block):
     x = in_desc(op, block, "Input")
     if x is None:
@@ -44,13 +61,8 @@ def _prior_box(ctx, ins, attrs):
     IH, IW = img.shape[2], img.shape[3]
     min_sizes = [float(s) for s in attrs["min_sizes"]]
     max_sizes = [float(s) for s in attrs.get("max_sizes", []) or []]
-    ars = [1.0]
-    for ar in attrs.get("aspect_ratios", []) or []:
-        ar = float(ar)
-        if not any(abs(ar - e) < 1e-6 for e in ars):
-            ars.append(ar)
-            if attrs.get("flip", True) and ar != 1.0:
-                ars.append(1.0 / ar)
+    ars = expand_aspect_ratios(attrs.get("aspect_ratios", []),
+                               attrs.get("flip", True))
     variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
     clip = attrs.get("clip", True)
     step_w = float(attrs.get("step_w", 0.0)) or IW / W

@@ -153,3 +153,48 @@ def test_reader_over_recordio(tmp_path):
     got = list(reader())
     assert len(got) == 10
     np.testing.assert_array_equal(got[3][0], np.full(3, 3, np.float32))
+
+
+def test_native_multislot_parser_matches_python():
+    """native/multislot.cc parses identically to the Python fallback
+    (reference: data_feed.cc MultiSlotDataFeed::ParseOneInstance)."""
+    import tempfile
+
+    import numpy as np
+    from paddle_tpu import native
+    from paddle_tpu.async_executor import (
+        _parse_multislot_file, _parse_multislot_line,
+    )
+    from paddle_tpu.data_feed_desc import SlotDesc as Slot
+
+    slots = [
+        Slot(name="ids", type="uint64", is_dense=False, is_used=True),
+        Slot(name="w", type="float", is_dense=False, is_used=True),
+        Slot(name="skip", type="uint64", is_dense=False, is_used=False),
+    ]
+    lines = [
+        "3 1 2 3 2 0.5 -1.5 1 9",
+        "1 7 1 2.25 2 4 5",
+    ]
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("\n".join(lines) + "\n\n")  # trailing blank line
+        path = f.name
+    rows = list(_parse_multislot_file(path, slots))
+    want = [_parse_multislot_line(l, slots) for l in lines]
+    assert len(rows) == 2
+    for got_row, want_row in zip(rows, want):
+        for g, w in zip(got_row, want_row):
+            if w is None:
+                continue  # unused slot
+            np.testing.assert_array_equal(np.asarray(g), w)
+    assert native.load("multislot") is not None, "native parser didn't build"
+
+    # malformed line reports its number
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("3 1 2 3 2 0.5 1.5 1 9\n2 1\n")
+        bad = f.name
+    try:
+        list(_parse_multislot_file(bad, slots))
+        raise AssertionError("expected parse error")
+    except ValueError as e:
+        assert "line 2" in str(e)
