@@ -37,6 +37,9 @@ def _rows_from_handle(lib, h, slots):
     n = len(slots)
     cols = []
     for i, s in enumerate(slots):
+        if not s.is_used:
+            cols.append(None)  # never copied out of the C++ handle
+            continue
         lens = np.empty(L, dtype=np.int32)
         if L:
             lib.ms_slot_lens(
@@ -63,7 +66,7 @@ def _rows_from_handle(lib, h, slots):
     for r in range(L):
         yield [
             cols[i][0][cols[i][1][r]: cols[i][1][r + 1]]
-            if slots[i].is_used else None
+            if cols[i] is not None else None
             for i in range(n)
         ]
 
@@ -176,7 +179,12 @@ def _parse_multislot_line(line: str, slots):
         elif s.type.startswith("float"):
             out.append(np.asarray([float(v) for v in vals], dtype=np.float32))
         else:
-            out.append(np.asarray([int(v) for v in vals], dtype=np.int64))
+            # uint64 sparse ids: keep the bit pattern in int64 like the
+            # native parser (hashed features exceed 2^63)
+            out.append(
+                np.asarray([int(v) for v in vals], dtype=np.uint64)
+                .view(np.int64)
+            )
     return out
 
 

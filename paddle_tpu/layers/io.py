@@ -11,7 +11,7 @@ from ..core.proto import VarType
 
 from .io_pyreader import EOFException, double_buffer, py_reader, read_file  # noqa: F401
 
-__all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException", "shuffle", "batch", "create_py_reader_by_data"]
+__all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException", "shuffle", "batch", "create_py_reader_by_data", "random_data_generator", "open_files", "Preprocessor"]
 
 
 def data(
@@ -67,3 +67,81 @@ def create_py_reader_by_data(capacity, feed_list, name=None,
         lod_levels=lod_levels, name=name,
         use_double_buffer=use_double_buffer,
     )
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """Random data source for reader benchmarks (reference: layers/io.py
+    random_data_generator over create_random_data_generator_op).  Returns a
+    python reader yielding uniform tensors of the given shapes."""
+    import numpy as np
+
+    fixed = [[abs(d) for d in s] for s in shapes]
+
+    def reader():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(
+                rng.uniform(low, high, s).astype("float32") for s in fixed
+            )
+
+    return reader
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, is_test=None):
+    """Read recordio files as a python reader (reference: layers/io.py
+    open_files over open_files_op; files are the recordio format written by
+    paddle_tpu.recordio, records are np.savez archives of the slots)."""
+    import io as _io
+
+    import numpy as np
+
+    from ..recordio import RecordIOScanner
+
+    n_slots = len(shapes)
+
+    def reader():
+        for _ in range(pass_num):
+            for fn in filenames:
+                with RecordIOScanner(fn) as sc:
+                    for rec in sc:
+                        with np.load(_io.BytesIO(rec),
+                                     allow_pickle=False) as z:
+                            # archive order == np.savez argument order;
+                            # sorting would scramble slots by key name
+                            keys = list(z.files)
+                            if len(keys) != n_slots:
+                                raise ValueError(
+                                    f"record in {fn!r} has {len(keys)} "
+                                    f"arrays but {n_slots} slots declared"
+                                )
+                            yield tuple(z[k] for k in keys)
+
+    return reader
+
+
+class Preprocessor:
+    """Reader-pipeline transform (reference: layers/io.py Preprocessor):
+    wraps a python reader; the block body is a sample-mapping function.
+    The instance itself is the new reader callable:
+
+        p = Preprocessor(reader)
+        @p.block
+        def _map(*slots): return transformed_slots
+        for sample in p(): ...
+    """
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._fn = None
+
+    def block(self, fn):
+        self._fn = fn
+        return fn
+
+    def __call__(self):
+        if self._fn is None:
+            raise RuntimeError("Preprocessor.block was never set")
+        for sample in self._reader():
+            out = self._fn(*sample)
+            yield out if isinstance(out, tuple) else (out,)

@@ -4,8 +4,10 @@
 // fallback in async_executor.py is ~30x slower on wide CTR lines).
 //
 // Plain-C ABI for ctypes (pybind11 unavailable in this image):
-//   ms_parse_file(path, num_slots, slot_types) -> handle (NULL on IO error)
-//     slot_types[i]: 0 = float slot, 1 = int64 slot
+//   ms_parse_buffer(data, len, num_slots, slot_types, lineno_base)
+//     -> handle; data is a span of whole text lines (the Python side
+//     streams the file in line-aligned chunks, bounding worker memory)
+//     slot_types[i]: 0 = float slot, 1 = uint64 id slot
 //   ms_error(h)        -> 0 ok, else 1-based line number of the parse error
 //   ms_num_lines(h)    -> parsed instance count
 //   ms_slot_total(h,s) -> total value count of slot s across all lines
@@ -20,6 +22,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <cerrno>
 #include <vector>
 
 namespace {
@@ -62,9 +65,13 @@ bool parse_line(const char* p, MsFile* h, int num_slots) {
         if (end == p) return false;
         slot.fvals.push_back(val);
       } else {
-        long long val = std::strtoll(p, &end, 10);
-        if (end == p) return false;
-        slot.ivals.push_back(val);
+        // uint64 sparse ids (hashed features exceed 2^63): parse unsigned
+        // with a range check and store the bit pattern in int64 — numpy
+        // views the same 8 bytes, so id identity is preserved
+        errno = 0;
+        unsigned long long val = std::strtoull(p, &end, 10);
+        if (end == p || errno == ERANGE) return false;
+        slot.ivals.push_back(static_cast<long long>(val));
       }
       p = end;
     }
@@ -73,70 +80,40 @@ bool parse_line(const char* p, MsFile* h, int num_slots) {
   return true;
 }
 
-MsFile* parse_lines(FILE* f, const char* buf, long buflen, int num_slots,
-                    const int* slot_types, long lineno_base) {
+}  // namespace
+
+extern "C" {
+
+// Parse an in-memory span of whole text lines (lines separated by \n; the
+// buffer need not end with one).  lineno_base offsets reported error lines
+// so chunked callers get file-absolute numbers.
+MsFile* ms_parse_buffer(const char* buf, long len, int num_slots,
+                        const int* slot_types, long lineno_base) {
   MsFile* h = new MsFile();
   h->slots.resize(num_slots);
   for (int i = 0; i < num_slots; ++i) h->slots[i].type = slot_types[i];
   long lineno = lineno_base;
-  if (f != nullptr) {
-    char* line = nullptr;
-    size_t cap = 0;
-    while (getline(&line, &cap, f) != -1) {
-      ++lineno;
-      const char* p = skip_ws(line);
-      if (*p == '\n' || *p == '\0') continue;  // blank line
+  const char* cur = buf;
+  const char* bufend = buf + len;
+  std::vector<char> scratch;
+  while (cur < bufend) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(cur, '\n', bufend - cur));
+    const char* stop = nl ? nl : bufend;
+    ++lineno;
+    scratch.assign(cur, stop);
+    scratch.push_back('\0');
+    const char* p = skip_ws(scratch.data());
+    if (*p != '\0') {
       if (!parse_line(p, h, num_slots)) {
         h->error_line = lineno;
         break;
       }
       ++h->num_lines;
     }
-    std::free(line);
-  } else {
-    // buffer mode: lines separated by \n, buffer need not end with one
-    const char* cur = buf;
-    const char* bufend = buf + buflen;
-    std::vector<char> scratch;
-    while (cur < bufend) {
-      const char* nl = static_cast<const char*>(
-          std::memchr(cur, '\n', bufend - cur));
-      const char* stop = nl ? nl : bufend;
-      ++lineno;
-      scratch.assign(cur, stop);
-      scratch.push_back('\0');
-      const char* p = skip_ws(scratch.data());
-      if (*p != '\0') {
-        if (!parse_line(p, h, num_slots)) {
-          h->error_line = lineno;
-          break;
-        }
-        ++h->num_lines;
-      }
-      cur = nl ? nl + 1 : bufend;
-    }
+    cur = nl ? nl + 1 : bufend;
   }
   return h;
-}
-
-}  // namespace
-
-extern "C" {
-
-MsFile* ms_parse_file(const char* path, int num_slots,
-                      const int* slot_types) {
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return nullptr;
-  MsFile* h = parse_lines(f, nullptr, 0, num_slots, slot_types, 0);
-  std::fclose(f);
-  return h;
-}
-
-// Chunked entry: parse an in-memory span of whole lines (the Python side
-// streams the file in line-aligned chunks, bounding worker memory).
-MsFile* ms_parse_buffer(const char* buf, long len, int num_slots,
-                        const int* slot_types, long lineno_base) {
-  return parse_lines(nullptr, buf, len, num_slots, slot_types, lineno_base);
 }
 
 long ms_error(MsFile* h) { return h ? h->error_line : -1; }

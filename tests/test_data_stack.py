@@ -198,3 +198,48 @@ def test_native_multislot_parser_matches_python():
         raise AssertionError("expected parse error")
     except ValueError as e:
         assert "line 2" in str(e)
+
+
+def test_open_files_and_preprocessor():
+    """open_files reads recordio'd npz records; Preprocessor maps samples
+    (reference: layers/io.py open_files / Preprocessor)."""
+    import io
+    import os
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.recordio import RecordIOWriter
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "a.recordio")
+        with RecordIOWriter(path) as w:
+            for i in range(3):
+                buf = io.BytesIO()
+                np.savez(buf, x=np.full((2,), i, dtype="float32"),
+                         y=np.array([i], dtype="int64"))
+                w.write(buf.getvalue())
+        rd = fluid.layers.open_files([path], shapes=[[2], [1]],
+                                     lod_levels=[0, 0],
+                                     dtypes=["float32", "int64"])
+        rows = list(rd())
+        assert len(rows) == 3
+        np.testing.assert_allclose(rows[2][0], [2.0, 2.0])
+
+        p = fluid.layers.Preprocessor(rd)
+
+        @p.block
+        def _map(x, y):
+            return x * 2.0, y
+
+        rows2 = list(p())
+        np.testing.assert_allclose(rows2[1][0], rows[1][0] * 2.0)
+
+
+def test_random_data_generator():
+    import paddle_tpu as fluid
+
+    r = fluid.layers.random_data_generator(0.0, 1.0, [[2, 3], [1]])
+    s = next(r())
+    assert s[0].shape == (2, 3) and s[1].shape == (1,)
+    assert (s[0] >= 0).all() and (s[0] <= 1).all()
