@@ -56,6 +56,8 @@ from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .transpiler import memory_optimize, release_memory  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribute_lookup_table  # noqa: F401
 from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dataset  # noqa: F401
 from . import io  # noqa: F401

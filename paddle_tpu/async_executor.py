@@ -194,6 +194,15 @@ class AsyncExecutor:
     def __init__(self, place: Optional[Place] = None, run_mode: str = ""):
         self.place = place or CPUPlace()
         self.scope = global_scope()
+        # downpour mode state (reference: async_executor.py pslib hooks)
+        self._instance = None
+        self._ps = None
+        self._dist_desc = None
+        self._worker_program = None
+        self._emb_map = []
+        self._dense_params: List[str] = []
+        self._dense_grads: List[str] = []
+        self._window = 1
 
     def run(
         self,
@@ -235,8 +244,24 @@ class AsyncExecutor:
                     feed[s.name] = np.stack(col)
             return feed
 
+        downpour = self._dist_desc is not None
+        if downpour and program is not self._downpour_main:
+            raise ValueError(
+                "downpour mode executes the worker program derived at "
+                "init_worker time; pass the same main program (or call "
+                "init_worker again with the new one)"
+            )
+
+        def run_batch(exe, feed, counter):
+            if downpour:
+                return self._downpour_step(exe, feed, fetch_names, counter)
+            return exe.run(
+                program=program, feed=feed, fetch_list=fetch_names
+            )
+
         def worker():
             exe = Executor(self.place, donate_states=False)
+            counter = [0]
             try:
                 while True:
                     try:
@@ -247,11 +272,7 @@ class AsyncExecutor:
                     for row in _parse_multislot_file(path, all_slots):
                         batch.append(row)
                         if len(batch) == data_feed.batch_size:
-                            vals = exe.run(
-                                program=program,
-                                feed=feed_from(batch),
-                                fetch_list=fetch_names,
-                            )
+                            vals = run_batch(exe, feed_from(batch), counter)
                             if debug and fetch_names:
                                 print(
                                     f"[async_executor] {path}: "
@@ -262,8 +283,7 @@ class AsyncExecutor:
                                 )
                             batch = []
                     if batch:
-                        exe.run(program=program, feed=feed_from(batch),
-                                fetch_list=fetch_names)
+                        run_batch(exe, feed_from(batch), counter)
             except BaseException as e:  # propagate to the caller
                 errors.append(e)
 
@@ -278,9 +298,230 @@ class AsyncExecutor:
         if errors:
             raise errors[0]
 
-    # reference API parity (PSLIB distributed hooks are Baidu-internal)
+    # ------------------------------------------------------------------
+    # Downpour (async parameter server) mode.
+    # reference: async_executor.py config_distributed_nodes/init_server/
+    # init_worker/init_model/save_model over Baidu's closed PSLIB; here the
+    # server is the open in-process PS core (distributed/ps_core.py), so
+    # the hooks actually train instead of requiring external infra.
+    # ------------------------------------------------------------------
+    def get_instance(self):
+        if self._instance is None:
+            raise ValueError("call config_distributed_nodes first")
+        return self._instance
+
     def config_distributed_nodes(self):
-        raise NotImplementedError(
-            "PSLIB downpour mode is replaced by mesh-sharded training; "
-            "use ParallelExecutor with a sharded embedding table"
+        from .distributed.ps_instance import PaddlePSInstance
+
+        self._instance = PaddlePSInstance(server_worker_mode=1,
+                                          proc_per_node=2)
+        return self._instance
+
+    def init_server(self, dist_desc):
+        """Build the PS tables from the server desc
+        (dist_desc = ps_param returned by DownpourSGD.minimize)."""
+        from .distributed.ps_core import PSCore
+
+        self._ps = PSCore.from_server_desc(dist_desc["server_param"])
+        return self._ps
+
+    def init_worker(self, dist_desc, startup_program=None, program=None,
+                    ps=None):
+        """Prepare the worker: strip the distributed lookup ops (and the
+        table's init op) out of a cloned program, record the id->embedding
+        plumbing and the dense param/grad lists.  `ps` lets a worker point
+        at another process's PSCore; defaults to this executor's."""
+        from .core.framework import default_startup_program
+
+        if ps is not None:
+            self._ps = ps
+        if self._ps is None:
+            raise ValueError("no PS core: call init_server or pass ps=")
+        self._dist_desc = dist_desc
+        self._window = int(dist_desc.get("window", 1))
+        table_name = dist_desc["table_name"]
+
+        main = program or default_main_program()
+        self._downpour_main = main
+        wp = main.clone()
+        bdesc = wp.global_block().desc  # clone's authoritative op list
+        emb_map = []
+        for i in reversed(range(len(bdesc.ops))):
+            op = bdesc.ops[i]
+            if (op.type == "lookup_table"
+                    and op.input("W")[0] == table_name):
+                out = op.output("Out")[0]
+                emb_map.append((
+                    op.input("Ids")[0], out, out + "@GRAD",
+                ))
+                del bdesc.ops[i]
+            elif (op.type == "lookup_table_grad"
+                    and op.input("W")[0] == table_name):
+                del bdesc.ops[i]
+        wp.desc.bump()
+        emb_map.reverse()
+        if not emb_map:
+            raise ValueError(
+                f"no lookup_table op on distributed table '{table_name}'"
+            )
+        self._emb_map = emb_map
+        self._worker_program = wp
+
+        # the table itself must never materialize on workers: drop its
+        # initializer from the startup program (reference worker skips
+        # param init for distributed tables via fake_init)
+        sp = startup_program or default_startup_program()
+        sblock = sp.global_block()
+        removed = []  # (index, Operator) to restore on stop()
+        for i in reversed(range(len(sblock.ops))):
+            if table_name in sblock.ops[i].output_arg_names:
+                removed.append((i, sblock.ops[i]))
+                sblock._remove_op(i)
+        sp.desc.bump()
+        # a repeated init_worker (e.g. to re-point ps= or change window)
+        # finds nothing left to strip; keep the originally saved ops so
+        # stop() can still restore them
+        prev = getattr(self, "_stripped_startup", None)
+        merged = list(reversed(removed))
+        if prev is not None and prev[0] is sp:
+            merged = prev[1] + merged
+        self._stripped_startup = (sp, merged)
+
+        trainer = dist_desc["trainer_param"]
+        dense = trainer["dense_table"][0] if trainer["dense_table"] else None
+        self._dense_params = list(dense["dense_variable_name"]) if dense else []
+        self._dense_grads = (
+            list(dense["dense_gradient_variable_name"]) if dense else []
         )
+
+    def init_model(self):
+        """Seed the dense table from this worker's startup-initialized
+        params (reference: init_model — worker 0 pushes initial params)."""
+        if not self._dense_params:
+            return
+        from .distributed.downpour import DENSE_TABLE_ID
+
+        vals = []
+        for name in self._dense_params:
+            v = self.scope.find_var(name)
+            if v is None:
+                raise ValueError(f"param '{name}' not in scope; run the "
+                                 "startup program first")
+            vals.append(np.ravel(np.asarray(v)))
+        self._ps.dense(DENSE_TABLE_ID).init(np.concatenate(vals))
+
+    def save_model(self, save_path: str):
+        """Checkpoint the PS tables (reference: save_model RPC)."""
+        if self._ps is None:
+            raise ValueError("no PS core to save")
+        self._ps.save(save_path)
+
+    def stop(self):
+        """Leave downpour mode: put the table's init op back into the
+        startup program (init_worker stripped it in place) and drop the
+        worker plumbing, so later non-downpour runs see the original
+        program semantics."""
+        sp_removed = getattr(self, "_stripped_startup", None)
+        if sp_removed is not None:
+            sp, removed = sp_removed
+            sblock = sp.global_block()
+            for i, op in removed:  # ascending order restores positions
+                sblock.ops.insert(i, op)
+                sblock.desc.ops.insert(i, op.desc)
+            sp.desc.bump()
+            self._stripped_startup = None
+        self._dist_desc = None
+        self._worker_program = None
+        self._emb_map = []
+        self._dense_params = []
+        self._dense_grads = []
+
+    def _pull_dense_into_scope(self):
+        from .distributed.downpour import DENSE_TABLE_ID
+
+        table = self._ps.dense(DENSE_TABLE_ID)
+        if not table.initialized:
+            raise RuntimeError(
+                "dense table is uninitialized: call init_model() after the "
+                "startup program (or load a PS checkpoint) before run() — "
+                "otherwise dense params never train (the worker program has "
+                "no local optimizer ops)"
+            )
+        flat = table.pull()
+        block = self._worker_program.global_block()
+        off = 0
+        for name in self._dense_params:
+            shape = [int(d) for d in block.var(name).shape]
+            n = int(np.prod(shape)) if shape else 1
+            self.scope.set_var(
+                name, flat[off:off + n].reshape(shape).astype(np.float32)
+            )
+            off += n
+
+    def _downpour_step(self, exe, feed, fetch_names, counter):
+        """One worker batch: pull sparse rows for every distributed lookup,
+        feed the embeddings, run forward+backward, push sparse and dense
+        grads; refresh dense params from the server every `window` batches
+        (reference: executor_thread_worker.cc downpour pull/push cadence)."""
+        from .core.lod import LoDValue
+        from .distributed.downpour import DENSE_TABLE_ID, SPARSE_TABLE_ID
+
+        sparse = self._ps.sparse(SPARSE_TABLE_ID)
+        pushes = []  # (flat_ids, keep_mask) per lookup, for the push phase
+        for ids_name, out_name, _ in self._emb_map:
+            ids_val = feed[ids_name]
+            if isinstance(ids_val, LoDValue):
+                data = np.asarray(ids_val.data)
+                lengths = np.asarray(ids_val.lengths)
+            else:
+                data = np.asarray(ids_val)
+                lengths = None
+            if data.ndim >= 1 and data.shape[-1] == 1:
+                core_shape = data.shape[:-1]
+            else:
+                core_shape = data.shape
+            flat = data.reshape(-1)
+            if lengths is not None:
+                # only pull real positions: pulling padded slots would
+                # lazily materialize a phantom row for the pad id (0) that
+                # the model never saw; padding stays zero, matching the
+                # forward's padding mask, and push skips it too
+                pos = np.arange(data.shape[1])
+                mask = (pos[None, :] < lengths[:, None]).reshape(-1)
+                rows = np.zeros((flat.size, sparse.dim), np.float32)
+                rows[mask] = sparse.pull(flat[mask])
+                out = rows.reshape(core_shape + (sparse.dim,))
+                feed[out_name] = LoDValue(out, lengths)
+                pushes.append((flat, mask))
+            else:
+                rows = sparse.pull(flat)
+                feed[out_name] = rows.reshape(core_shape + (sparse.dim,))
+                pushes.append((flat, None))
+
+        if counter[0] % self._window == 0:
+            self._pull_dense_into_scope()
+        counter[0] += 1
+
+        emb_grad_names = [g for _, _, g in self._emb_map]
+        vals = exe.run(
+            program=self._worker_program,
+            feed=feed,
+            fetch_list=list(fetch_names) + emb_grad_names + self._dense_grads,
+        )
+        n_f, n_e = len(fetch_names), len(emb_grad_names)
+        emb_grads = vals[n_f:n_f + n_e]
+        dense_grads = vals[n_f + n_e:]
+
+        for (flat, mask), g in zip(pushes, emb_grads):
+            gd = np.asarray(g.data if isinstance(g, LoDValue) else g)
+            gflat = gd.reshape(-1, sparse.dim)
+            if mask is not None:
+                sparse.push(flat[mask], gflat[mask])
+            else:
+                sparse.push(flat, gflat)
+
+        if dense_grads:
+            self._ps.dense(DENSE_TABLE_ID).push(
+                np.concatenate([np.ravel(np.asarray(g)) for g in dense_grads])
+            )
+        return vals[:n_f]

@@ -1,0 +1,65 @@
+"""Locate the (single) distributed lookup table in a program.
+
+reference: python/paddle/fluid/distribute_lookup_table.py — the downpour /
+pserver sparse path needs to know which embedding table is remote so its
+lookup ops can be skipped on workers and served by pull/push RPCs.  Here
+the "RPC" is the in-process PS core (paddle_tpu/distributed/ps_core.py) or
+a mesh-sharded table (paddle_tpu/parallel), but the program analysis is
+identical: find lookup_table ops whose `is_distributed` attr is set.
+"""
+
+from __future__ import annotations
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+__all__ = [
+    "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+
+def _dist_lookup_ops(program):
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.attr("is_distributed", False):
+            yield op
+
+
+def find_distributed_lookup_table(program):
+    """Return the name of the distributed table, or None.
+
+    The reference supports exactly one distributed table per program
+    (distribute_lookup_table.py find_distributed_lookup_table) and asserts
+    every distributed lookup shares it; same contract here.
+    """
+    table_name = None
+    for op in _dist_lookup_ops(program):
+        w = op.input("W")[0]
+        if table_name is None:
+            table_name = w
+        elif table_name != w:
+            raise ValueError(
+                "all distributed lookup_table ops must share one table; "
+                f"found both '{table_name}' and '{w}'"
+            )
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Id variables feeding the distributed table's lookups."""
+    local_vars = program.current_block().vars
+    inputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.input("W")[0] == table_name:
+            inputs.extend(local_vars[name] for name in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Embedding output variables of the distributed table's lookups."""
+    local_vars = program.current_block().vars
+    outputs = []
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and op.input("W")[0] == table_name:
+            outputs.extend(local_vars[name] for name in op.output("Out"))
+    return outputs

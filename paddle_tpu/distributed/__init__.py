@@ -1,0 +1,34 @@
+"""Downpour-style async parameter-server client
+(reference: python/paddle/fluid/distributed/ — downpour.py DownpourSGD,
+node.py DownpourServer/DownpourWorker, ps_instance.py, helper.py).
+
+The reference builds pslib protobuf descs and hands them to Baidu's
+closed-source PSLIB brpc server.  The TPU-native rebuild keeps the same
+client API and desc structure but backs it with an open, in-process PS
+core (ps_core.py): sparse tables apply adagrad row updates under the
+DownpourFeatureValueAccessor semantics, dense tables apply adam — so
+`AsyncExecutor` Hogwild workers can actually train against it (see
+async_executor.py init_server/init_worker), instead of the hooks being
+dead ends.  Mesh-sharded synchronous embeddings remain the first-class
+TPU path (paddle_tpu/parallel); downpour is the async-PS parity mode.
+"""
+
+from .downpour import DownpourSGD
+from .node import DownpourServer, DownpourWorker, Server, Worker
+from .ps_core import DenseTable, PSCore, SparseTable
+from .ps_instance import PaddlePSInstance
+from .helper import FileSystem, MPIHelper
+
+__all__ = [
+    "DownpourSGD",
+    "DownpourServer",
+    "DownpourWorker",
+    "Server",
+    "Worker",
+    "PSCore",
+    "SparseTable",
+    "DenseTable",
+    "PaddlePSInstance",
+    "FileSystem",
+    "MPIHelper",
+]

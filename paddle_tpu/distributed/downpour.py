@@ -1,0 +1,109 @@
+"""DownpourSGD distributed optimizer
+(reference: python/paddle/fluid/distributed/downpour.py:24 DownpourSGD —
+Large Scale Distributed Deep Networks' Downpour SGD).
+
+minimize() appends backward only (no local optimizer ops: updates happen
+on the server), maps the program's distributed lookup table to sparse
+table 0 and every other param to dense table 1, and returns
+[ps_param, worker_skipped_ops] exactly like the reference — the skipped
+ops are the distributed lookup_table ops (and their grad ops) that
+workers must not run, because the embedding rows live on the server and
+arrive via pull_sparse (see async_executor.AsyncExecutor.run with
+init_worker applied).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.backward import append_backward
+from ..distribute_lookup_table import (
+    find_distributed_lookup_table,
+    find_distributed_lookup_table_inputs,
+    find_distributed_lookup_table_outputs,
+)
+from .node import DownpourServer, DownpourWorker
+
+__all__ = ["DownpourSGD"]
+
+SPARSE_TABLE_ID = 0
+DENSE_TABLE_ID = 1
+
+
+class DownpourSGD:
+    """Async downpour SGD: sparse adagrad on the embedding table, dense
+    adam on the rest, applied server-side.
+
+    Args:
+        learning_rate: sparse-table learning rate.
+        window: batches between dense pull/push round-trips
+            (communication strategy; reference DownpourWorker.window).
+    """
+
+    def __init__(self, learning_rate: float = 0.001, window: int = 1):
+        self.learning_rate_ = learning_rate
+        self.window_ = window
+        self.type = "downpour"
+
+    def minimize(
+        self,
+        loss,
+        startup_program=None,
+        parameter_list: Optional[List[str]] = None,
+        no_grad_set=None,
+    ):
+        """Append backward and build server/worker descs.
+
+        Returns:
+            [ps_param, worker_skipped_ops]: ps_param is a dict with
+            "server_param"/"trainer_param" descs (the reference's
+            PSParameter protobuf); worker_skipped_ops are op types the
+            worker executor must skip (reference returns
+            ["lookup_table", "lookup_table_grad"]).
+        """
+        params_grads = sorted(
+            append_backward(loss, parameter_list, no_grad_set),
+            key=lambda x: x[0].name,
+        )
+        program = loss.block.program
+        table_name = find_distributed_lookup_table(program)
+        if table_name is None:
+            raise ValueError(
+                "DownpourSGD needs a distributed embedding: mark one with "
+                "fluid.layers.embedding(..., is_distributed=True)"
+            )
+        prefetch_slots = find_distributed_lookup_table_inputs(
+            program, table_name
+        )
+        prefetch_slots_emb = find_distributed_lookup_table_outputs(
+            program, table_name
+        )
+
+        server = DownpourServer()
+        worker = DownpourWorker(self.window_)
+        server.add_sparse_table(
+            SPARSE_TABLE_ID, self.learning_rate_,
+            prefetch_slots, prefetch_slots_emb,
+        )
+        server.add_dense_table(
+            DENSE_TABLE_ID, self.learning_rate_,
+            [p for p, _ in params_grads if p.name != table_name],
+            [g for p, g in params_grads if p.name != table_name],
+        )
+        worker.add_sparse_table(
+            SPARSE_TABLE_ID, self.learning_rate_,
+            prefetch_slots, prefetch_slots_emb,
+        )
+        worker.add_dense_table(
+            DENSE_TABLE_ID, self.learning_rate_,
+            [p for p, _ in params_grads if p.name != table_name],
+            [g for p, g in params_grads if p.name != table_name],
+        )
+        ps_param = {
+            "server_param": server.get_desc(),
+            "trainer_param": worker.get_desc(),
+            "table_name": table_name,
+            "window": self.window_,
+        }
+        worker_skipped_ops = ["lookup_table", "lookup_table_grad"]
+        return [ps_param, worker_skipped_ops]

@@ -1,0 +1,74 @@
+"""Cluster helpers for downpour mode
+(reference: python/paddle/fluid/distributed/helper.py — FileSystem desc
+builder + MPIHelper over mpi4py).
+
+MPI is not the TPU-pod launch model; rank/size resolve from the same
+PADDLE_* / JAX env the rest of paddle_tpu.parallel uses, so PSInstance
+role math works unchanged in tests (env-injected ranks) and under real
+multi-process launches (jax.distributed).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+__all__ = ["FileSystem", "MPIHelper"]
+
+
+class FileSystem:
+    """Filesystem desc for dataset/model storage (reference: helper.py
+    FileSystem builds a pslib FsClientParameter).  hdfs/afs URIs are
+    carried as config; local paths work directly."""
+
+    def __init__(self, fs_type: str = "afs", uri: str = "afs://xx",
+                 user: str = None, passwd: str = None, hadoop_bin: str = ""):
+        if fs_type not in ("afs", "hdfs", "local"):
+            raise ValueError(f"unknown fs_type {fs_type!r}")
+        self.fs_client = {
+            "fs_type": fs_type,
+            "uri": uri,
+            "user": user,
+            "passwd": passwd,
+            "hadoop_bin": hadoop_bin,
+        }
+
+    def get_desc(self) -> dict:
+        return self.fs_client
+
+
+class MPIHelper:
+    """Rank/size/host discovery (reference: helper.py MPIHelper wraps
+    MPI.COMM_WORLD).  Resolution order: PADDLE_TRAINER_ID/PADDLE_TRAINERS
+    env (the fluid cluster convention, fluid_benchmark.py:63), then
+    OMPI/PMI env if launched under mpirun, then single-process."""
+
+    def __init__(self):
+        env = os.environ
+        if "PADDLE_TRAINER_ID" in env:
+            self._rank = int(env["PADDLE_TRAINER_ID"])
+            self._size = int(env.get("PADDLE_TRAINERS", "1"))
+        elif "OMPI_COMM_WORLD_RANK" in env:
+            self._rank = int(env["OMPI_COMM_WORLD_RANK"])
+            self._size = int(env.get("OMPI_COMM_WORLD_SIZE", "1"))
+        elif "PMI_RANK" in env:
+            self._rank = int(env["PMI_RANK"])
+            self._size = int(env.get("PMI_SIZE", "1"))
+        else:
+            self._rank = 0
+            self._size = 1
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_size(self) -> int:
+        return self._size
+
+    def get_ip(self) -> str:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    def get_hostname(self) -> str:
+        return socket.gethostname()

@@ -1,0 +1,147 @@
+"""Server/worker desc builders for downpour training
+(reference: python/paddle/fluid/distributed/node.py).
+
+The reference fills pslib protobuf messages (ServerParameter /
+DownpourTrainerParameter).  Here the descs are plain nested dicts with the
+same field names, so they serialize to JSON, diff cleanly in tests, and
+feed the in-process PS core (ps_core.PSCore.from_server_desc) directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+__all__ = ["Server", "Worker", "DownpourServer", "DownpourWorker"]
+
+PS_SPARSE_TABLE = 0
+PS_DENSE_TABLE = 1
+
+
+class Server:
+    """Base server desc builder."""
+
+
+class Worker:
+    """Base worker desc builder."""
+
+
+class DownpourServer(Server):
+    """Builds the server-side table desc
+    (reference: node.py DownpourServer — service_param + per-table
+    accessor configs).  The service knobs that named brpc classes in the
+    reference name the in-process core here."""
+
+    def __init__(self):
+        self.server_ = {
+            "downpour_server_param": {
+                "service_param": {
+                    "start_server_port": 0,
+                    "server_class": "InProcessPsServer",
+                    "client_class": "InProcessPsClient",
+                    "service_class": "DownpourPsService",
+                    "server_thread_num": 12,
+                },
+                "downpour_table_param": [],
+            }
+        }
+
+    def _tables(self) -> List[dict]:
+        return self.server_["downpour_server_param"]["downpour_table_param"]
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_var):
+        """Sparse embedding table: rows created on first pull, updated by
+        row-wise adagrad (reference accessor: DownpourFeatureValueAccessor
+        with sparse_sgd_param)."""
+        dim = None
+        for v in slot_value_var:
+            if getattr(v, "shape", None):
+                dim = int(v.shape[-1])
+                break
+        self._tables().append({
+            "table_id": int(table_id),
+            "table_class": "DownpourSparseTable",
+            "type": PS_SPARSE_TABLE,
+            "accessor": {
+                "accessor_class": "DownpourFeatureValueAccessor",
+                "embedx_dim": dim if dim is not None else 8,
+                "fea_dim": dim if dim is not None else 11,
+                "sparse_sgd_param": {
+                    "learning_rate": float(learning_rate),
+                    "initial_g2sum": 3.0,
+                    "initial_range": 1e-4,
+                    "weight_bounds": [-10.0, 10.0],
+                },
+            },
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_var, grad_var):
+        """Dense table: all non-embedding params flattened into one vector,
+        updated by adam (reference accessor: DownpourDenseValueAccessor
+        dense_sgd_param.adam)."""
+        fea_dim = 0
+        for p in param_var:
+            n = 1
+            for d in p.shape:
+                n *= int(d)
+            fea_dim += n
+        self._tables().append({
+            "table_id": int(table_id),
+            "table_class": "DownpourDenseTable",
+            "type": PS_DENSE_TABLE,
+            "accessor": {
+                "accessor_class": "DownpourDenseValueAccessor",
+                "fea_dim": fea_dim,
+                "dense_sgd_param": {
+                    "name": "adam",
+                    "adam": {
+                        "learning_rate": float(learning_rate),
+                        "avg_decay_rate": 0.999993,
+                        "ada_decay_rate": 0.9999,
+                        "ada_epsilon": 1e-8,
+                        "mom_decay_rate": 0.99,
+                    },
+                },
+            },
+        })
+
+    def get_desc(self) -> dict:
+        return self.server_
+
+
+class DownpourWorker(Worker):
+    """Builds the trainer-side desc: which vars ride which table
+    (reference: node.py DownpourWorker — slot_key/slot_value/slot_gradient
+    for sparse, dense_variable_name for dense)."""
+
+    def __init__(self, window: int):
+        self.window = window
+        self.worker_ = {"sparse_table": [], "dense_table": []}
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_vars):
+        self.worker_["sparse_table"].append({
+            "table_id": int(table_id),
+            "slot_key": [v.name for v in slot_key_vars],
+            "slot_value": [v.name for v in slot_value_vars],
+            "slot_gradient": [v.name + "@GRAD" for v in slot_value_vars],
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_vars, grad_vars):
+        # the caller excludes the distributed table by exact name
+        # (downpour.py); every other param — including local embeddings —
+        # must ride the dense table or nothing ever updates it
+        self.worker_["dense_table"].append({
+            "table_id": int(table_id),
+            "dense_variable_name": [p.name for p in param_vars],
+            "dense_gradient_variable_name": [g.name for g in grad_vars],
+        })
+
+    def get_desc(self) -> dict:
+        return self.worker_
+
+
+def desc_to_text(desc: dict) -> str:
+    """Stable text form of a desc (stands in for protobuf text_format)."""
+    return json.dumps(desc, indent=2, sort_keys=True)

@@ -1,0 +1,248 @@
+"""In-process downpour parameter server
+(reference role: PSLIB's DownpourBrpcPsServer — closed-source in the
+reference; node.py only builds its config.  This module is the open,
+executable stand-in: the accessor semantics the configs describe, applied
+to host-resident numpy state behind per-table locks so Hogwild
+AsyncExecutor workers can pull/push concurrently).
+
+Sparse tables (DownpourFeatureValueAccessor): vocab rows materialize
+lazily on first pull (uniform(-initial_range, initial_range), g2sum =
+initial_g2sum) and update by row-wise adagrad with weight bounds — the
+whole table never exists as one dense array, which is the point of the
+reference's SelectedRows/pserver path (operators/lookup_table_op.cc:80).
+
+Dense tables (DownpourDenseValueAccessor): the model's non-embedding
+params flattened to one vector, updated by adam with the desc's decay
+rates.  Workers push grads every batch and pull fresh params every
+`window` batches (DownpourWorker.window).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "PSCore"]
+
+
+class SparseTable:
+    """Lazy row-materializing embedding table with adagrad updates."""
+
+    def __init__(self, dim: int, learning_rate: float = 0.05,
+                 initial_g2sum: float = 3.0, initial_range: float = 1e-4,
+                 weight_bounds: Sequence[float] = (-10.0, 10.0),
+                 seed: int = 0):
+        self.dim = int(dim)
+        self.lr = float(learning_rate)
+        self.initial_g2sum = float(initial_g2sum)
+        self.initial_range = float(initial_range)
+        self.lo, self.hi = (float(weight_bounds[0]), float(weight_bounds[1]))
+        self._rows: Dict[int, np.ndarray] = {}
+        self._g2sum: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @staticmethod
+    def _canonical_ids(ids) -> np.ndarray:
+        """Keys are the 64-bit pattern as a non-negative int: hashed uint64
+        feature ids ride int64 arrays as a bit-pattern view (see
+        async_executor MultiSlot parsing), so an id may arrive negative
+        from one caller and >= 2**63 from another — canonicalizing keeps
+        them one row and keeps state_dict()'s uint64 id vector exact."""
+        ids = np.asarray(ids).reshape(-1)
+        if ids.dtype == object:
+            ids = np.array([int(i) & 0xFFFFFFFFFFFFFFFF for i in ids],
+                           dtype=np.uint64)
+        return ids.astype(np.uint64)  # int64 -> uint64 keeps the bit pattern
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """[N] ids -> [N, dim] rows; unseen ids materialize."""
+        ids = self._canonical_ids(ids)
+        out = np.empty((ids.size, self.dim), dtype=np.float32)
+        with self._lock:
+            for i, fid in enumerate(ids):
+                fid = int(fid)
+                row = self._rows.get(fid)
+                if row is None:
+                    row = self._rng.uniform(
+                        -self.initial_range, self.initial_range, self.dim
+                    ).astype(np.float32)
+                    self._rows[fid] = row
+                    self._g2sum[fid] = np.full(
+                        self.dim, self.initial_g2sum, np.float32
+                    )
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Row-wise adagrad: g2sum += g*g; w -= lr * g / sqrt(g2sum);
+        duplicate ids in one push accumulate first (segment-sum), matching
+        the reference's sparse-kernel merge of repeated rows."""
+        ids = self._canonical_ids(ids)
+        grads = np.asarray(grads, dtype=np.float32).reshape(ids.size, self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), dtype=np.float32)
+        np.add.at(merged, inv, grads)
+        with self._lock:
+            for fid, g in zip(uniq, merged):
+                fid = int(fid)
+                if fid not in self._rows:
+                    # push for a never-pulled id: materialize (a worker may
+                    # have pulled from another server replica; be lenient)
+                    self._rows[fid] = self._rng.uniform(
+                        -self.initial_range, self.initial_range, self.dim
+                    ).astype(np.float32)
+                    self._g2sum[fid] = np.full(
+                        self.dim, self.initial_g2sum, np.float32
+                    )
+                g2 = self._g2sum[fid]
+                g2 += g * g
+                w = self._rows[fid]
+                w -= self.lr * g / np.sqrt(g2 + 1e-12)
+                np.clip(w, self.lo, self.hi, out=w)
+
+    def rows(self) -> Dict[int, np.ndarray]:
+        with self._lock:
+            return {k: v.copy() for k, v in self._rows.items()}
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            ids = np.fromiter(self._rows, dtype=np.uint64,
+                              count=len(self._rows))
+            return {
+                "ids": ids,
+                "rows": np.stack([self._rows[int(i)] for i in ids])
+                if ids.size else np.zeros((0, self.dim), np.float32),
+                "g2sum": np.stack([self._g2sum[int(i)] for i in ids])
+                if ids.size else np.zeros((0, self.dim), np.float32),
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        ids = self._canonical_ids(state["ids"])
+        with self._lock:
+            self._rows = {
+                int(i): np.array(r, np.float32)
+                for i, r in zip(ids, state["rows"])
+            }
+            self._g2sum = {
+                int(i): np.array(g, np.float32)
+                for i, g in zip(ids, state["g2sum"])
+            }
+
+
+class DenseTable:
+    """Flat parameter vector with adam updates."""
+
+    def __init__(self, dim: int, learning_rate: float = 5e-6,
+                 mom_decay_rate: float = 0.99, ada_decay_rate: float = 0.9999,
+                 ada_epsilon: float = 1e-8):
+        self.dim = int(dim)
+        self.lr = float(learning_rate)
+        self.beta1 = float(mom_decay_rate)
+        self.beta2 = float(ada_decay_rate)
+        self.eps = float(ada_epsilon)
+        self.w = np.zeros(self.dim, np.float32)
+        self.mom = np.zeros(self.dim, np.float32)
+        self.ada = np.zeros(self.dim, np.float32)
+        self._initialized = False
+        self._lock = threading.Lock()
+
+    def init(self, values: np.ndarray) -> None:
+        """Seed the table from a worker's startup-initialized params
+        (reference: AsyncExecutor.init_model pushes worker 0's params)."""
+        with self._lock:
+            self.w = np.asarray(values, np.float32).reshape(self.dim).copy()
+            self._initialized = True
+
+    @property
+    def initialized(self) -> bool:
+        return self._initialized
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.w.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        g = np.asarray(grad, np.float32).reshape(self.dim)
+        with self._lock:
+            self.mom = self.beta1 * self.mom + (1.0 - self.beta1) * g
+            self.ada = self.beta2 * self.ada + (1.0 - self.beta2) * g * g
+            self.w -= self.lr * self.mom / (np.sqrt(self.ada) + self.eps)
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"w": self.w.copy(), "mom": self.mom.copy(),
+                    "ada": self.ada.copy()}
+
+    def load_state_dict(self, state: dict) -> None:
+        with self._lock:
+            self.w = np.array(state["w"], np.float32)
+            self.mom = np.array(state["mom"], np.float32)
+            self.ada = np.array(state["ada"], np.float32)
+            self._initialized = True
+
+
+class PSCore:
+    """The server: table_id -> table, built from a DownpourServer desc."""
+
+    def __init__(self):
+        self.tables: Dict[int, object] = {}
+
+    @classmethod
+    def from_server_desc(cls, server_desc: dict) -> "PSCore":
+        core = cls()
+        params = server_desc["downpour_server_param"]["downpour_table_param"]
+        for t in params:
+            acc = t["accessor"]
+            if t["table_class"] == "DownpourSparseTable":
+                sgd = acc["sparse_sgd_param"]
+                core.tables[t["table_id"]] = SparseTable(
+                    dim=acc["embedx_dim"],
+                    learning_rate=sgd["learning_rate"],
+                    initial_g2sum=sgd["initial_g2sum"],
+                    initial_range=sgd["initial_range"],
+                    weight_bounds=sgd["weight_bounds"],
+                )
+            else:
+                adam = acc["dense_sgd_param"]["adam"]
+                core.tables[t["table_id"]] = DenseTable(
+                    dim=acc["fea_dim"],
+                    learning_rate=adam["learning_rate"],
+                    mom_decay_rate=adam["mom_decay_rate"],
+                    ada_decay_rate=adam["ada_decay_rate"],
+                    ada_epsilon=adam["ada_epsilon"],
+                )
+        return core
+
+    def sparse(self, table_id: int) -> SparseTable:
+        t = self.tables[table_id]
+        assert isinstance(t, SparseTable), f"table {table_id} is not sparse"
+        return t
+
+    def dense(self, table_id: int) -> DenseTable:
+        t = self.tables[table_id]
+        assert isinstance(t, DenseTable), f"table {table_id} is not dense"
+        return t
+
+    def save(self, path: str) -> None:
+        """Checkpoint all tables to one .npz (reference: pserver periodic
+        checkpoint, go/pserver/service.go:346 / PSLIB save_model)."""
+        blobs = {}
+        for tid, t in self.tables.items():
+            for k, v in t.state_dict().items():
+                blobs[f"t{tid}.{k}"] = v
+        np.savez(path, **blobs)
+
+    def load(self, path: str) -> None:
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        for tid, t in self.tables.items():
+            keys = [k for k in data.files if k.startswith(f"t{tid}.")]
+            if keys:
+                t.load_state_dict(
+                    {k.split(".", 1)[1]: data[k] for k in keys}
+                )

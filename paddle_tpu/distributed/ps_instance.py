@@ -1,0 +1,107 @@
+"""Process-role assignment for downpour clusters
+(reference: python/paddle/fluid/distributed/ps_instance.py
+PaddlePSInstance — splits MPI ranks into pserver and worker halves).
+
+Same role math as the reference: with server_worker_mode=0 the first
+half of ranks are servers; with mode=1 ranks alternate server/worker
+within each node (proc_per_node processes per host).  The comm splitting
+the reference does with MPI sub-communicators reduces here to index
+arithmetic — barriers/gather in-process are no-ops for size-1 and raise
+for real multi-process use (launch via jax.distributed instead).
+"""
+
+from __future__ import annotations
+
+from .helper import MPIHelper
+
+__all__ = ["PaddlePSInstance"]
+
+IDLE = -1
+SERVER = 0
+WORKER = 1
+
+
+class PaddlePSInstance:
+    def __init__(self, server_worker_mode: int = 1, proc_per_node: int = 2):
+        self.dh = MPIHelper()
+        self._rankid = self.dh.get_rank()
+        self._server_worker_mode = server_worker_mode
+        self._proc_per_node = proc_per_node
+        # MPIHelper.get_size() is the TOTAL process count (the PADDLE_TRAINERS
+        # convention) — unlike the reference, which launches one MPI rank per
+        # node and multiplies by proc_per_node
+        self._procs = self.dh.get_size()
+        self._nodes = max(1, self._procs // proc_per_node)
+
+        self._worker_num = self._procs // 2
+        self._server_num = self._procs // 2
+        self._total_server_worker = self._worker_num + self._server_num
+        self._node_type = IDLE
+        self._set_nodetype()
+
+    def _set_nodetype(self) -> None:
+        if self._server_worker_mode == 0:
+            # block split: servers first, then workers
+            if self._rankid < self._server_num:
+                self._node_type = SERVER
+            elif self._rankid < self._total_server_worker:
+                self._node_type = WORKER
+        elif self._server_worker_mode == 1:
+            # interleaved within each node: even local index = server
+            if self._rankid < self._total_server_worker:
+                local = self._rankid % self._proc_per_node
+                self._node_type = SERVER if local % 2 == 0 else WORKER
+        # else IDLE
+
+    def get_node_cnt(self) -> int:
+        return self._nodes
+
+    def get_worker_num(self) -> int:
+        return self._worker_num
+
+    def get_server_num(self) -> int:
+        return self._server_num
+
+    def get_worker_index(self) -> int:
+        if self._server_worker_mode == 0:
+            return self._rankid - self._server_num
+        # interleaved: workers are the odd local indices on each node
+        node = self._rankid // self._proc_per_node
+        local = self._rankid % self._proc_per_node
+        return node * (self._proc_per_node // 2) + local // 2
+
+    def get_server_index(self) -> int:
+        if self._server_worker_mode == 0:
+            return self._rankid
+        node = self._rankid // self._proc_per_node
+        local = self._rankid % self._proc_per_node
+        return node * (self._proc_per_node // 2) + local // 2
+
+    def is_worker(self) -> bool:
+        return self._node_type == WORKER
+
+    def is_server(self) -> bool:
+        return self._node_type == SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self.get_worker_index() == 0
+
+    def set_ip(self, ip: str) -> None:
+        self._ip = ip
+
+    def gather_ips(self):
+        if self.dh.get_size() > 1:
+            raise NotImplementedError(
+                "multi-process downpour uses jax.distributed coordination; "
+                "see paddle_tpu/parallel/env.py"
+            )
+        return [self.dh.get_ip()]
+
+    def barrier_all(self) -> None:
+        if self.dh.get_size() > 1:
+            raise NotImplementedError(
+                "multi-process downpour uses jax.distributed coordination"
+            )
+
+    def finalize(self) -> None:
+        pass
