@@ -378,14 +378,17 @@ class AsyncExecutor:
                 removed.append((i, sblock.ops[i]))
                 sblock._remove_op(i)
         sp.desc.bump()
-        # a repeated init_worker (e.g. to re-point ps= or change window)
-        # finds nothing left to strip; keep the originally saved ops so
-        # stop() can still restore them
-        prev = getattr(self, "_stripped_startup", None)
-        merged = list(reversed(removed))
-        if prev is not None and prev[0] is sp:
-            merged = prev[1] + merged
-        self._stripped_startup = (sp, merged)
+        # a repeated init_worker (e.g. to re-point ps=, change window, or
+        # switch startup programs) finds nothing left to strip in an
+        # already-stripped program; keep every program's saved ops so
+        # stop() can restore them all
+        if not hasattr(self, "_stripped_startups"):
+            self._stripped_startups = {}
+        key = id(sp)
+        prev_sp, prev_ops = self._stripped_startups.get(key, (sp, []))
+        self._stripped_startups[key] = (
+            sp, prev_ops + list(reversed(removed))
+        )
 
         trainer = dist_desc["trainer_param"]
         dense = trainer["dense_table"][0] if trainer["dense_table"] else None
@@ -421,15 +424,13 @@ class AsyncExecutor:
         startup program (init_worker stripped it in place) and drop the
         worker plumbing, so later non-downpour runs see the original
         program semantics."""
-        sp_removed = getattr(self, "_stripped_startup", None)
-        if sp_removed is not None:
-            sp, removed = sp_removed
+        for sp, removed in getattr(self, "_stripped_startups", {}).values():
             sblock = sp.global_block()
             for i, op in removed:  # ascending order restores positions
                 sblock.ops.insert(i, op)
                 sblock.desc.ops.insert(i, op.desc)
             sp.desc.bump()
-            self._stripped_startup = None
+        self._stripped_startups = {}
         self._dist_desc = None
         self._worker_program = None
         self._emb_map = []

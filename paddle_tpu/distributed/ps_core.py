@@ -154,9 +154,13 @@ class DenseTable:
 
     def init(self, values: np.ndarray) -> None:
         """Seed the table from a worker's startup-initialized params
-        (reference: AsyncExecutor.init_model pushes worker 0's params)."""
+        (reference: AsyncExecutor.init_model pushes worker 0's params).
+        Re-seeding also resets the adam state — stale momentum must not
+        step freshly initialized weights."""
         with self._lock:
             self.w = np.asarray(values, np.float32).reshape(self.dim).copy()
+            self.mom = np.zeros(self.dim, np.float32)
+            self.ada = np.zeros(self.dim, np.float32)
             self._initialized = True
 
     @property

@@ -23,6 +23,14 @@ WORKER = 1
 
 class PaddlePSInstance:
     def __init__(self, server_worker_mode: int = 1, proc_per_node: int = 2):
+        if server_worker_mode == 1 and proc_per_node % 2 != 0:
+            # interleaved mode pairs a server with a worker on each node;
+            # an odd count would assign more servers than get_server_num()
+            # reports and collide shard indices
+            raise ValueError(
+                "server_worker_mode=1 needs an even proc_per_node, got "
+                f"{proc_per_node}"
+            )
         self.dh = MPIHelper()
         self._rankid = self.dh.get_rank()
         self._server_worker_mode = server_worker_mode

@@ -1,0 +1,39 @@
+"""Failure detection / elastic training
+(reference: the Go cloud stack — go/master/service.go task queue with
+timeout re-dispatch + snapshot/recover, go/pserver etcd registration and
+periodic checkpoints; Fluid itself has only RPC deadlines).
+
+The TPU-native design is checkpoint-restart elasticity: a master leases
+dataset tasks to stateless workers and re-dispatches them when a lease
+times out (worker died); all persistent state — master queue snapshot,
+model params, PS tables — checkpoints to a store so any process can be
+killed and restarted without losing the pass.  On a TPU pod the "worker"
+is a whole slice process group; slice-aware restart reduces to the same
+protocol with the mesh re-built at startup (parallel/env.py).
+"""
+
+from .master import (
+    AllTasksFailedError,
+    FileStore,
+    InMemStore,
+    MasterService,
+    NoMoreAvailableError,
+    PassAfterError,
+    PassBeforeError,
+    Task,
+    partition,
+)
+from .trainer import ElasticTrainer
+
+__all__ = [
+    "MasterService",
+    "Task",
+    "partition",
+    "InMemStore",
+    "FileStore",
+    "ElasticTrainer",
+    "PassBeforeError",
+    "PassAfterError",
+    "NoMoreAvailableError",
+    "AllTasksFailedError",
+]

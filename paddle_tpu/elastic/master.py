@@ -1,0 +1,281 @@
+"""Elastic master: dataset task queue with failure detection
+(reference: go/master/service.go — Service.GetTask :366 leases a task and
+arms a timeout, processFailedTask :311 re-queues it up to failureMax,
+TaskFinished :410 rolls the pass over, snapshot/recover :166-229 persist
+the queue state to etcd).
+
+Differences from the Go original, by design:
+- The store is pluggable (in-memory for tests, a file for single-host,
+  anything with save/load for cluster use); etcd is not assumed.
+- Tasks carry file paths + a chunk index range instead of recordio chunk
+  descriptors; any sharded dataset works.
+- Timeout checks run on threading.Timer (the Go version's AfterFunc) and
+  liveness is lease-based: a worker that dies simply never finishes its
+  task, and the lease expiry re-queues it.  An explicit heartbeat
+  registry is layered on top for faster detection (the pserver etcd
+  registration role, go/pserver/etcd_client.go).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import gzip
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "Task", "partition", "MasterService", "InMemStore", "FileStore",
+    "PassBeforeError", "PassAfterError", "NoMoreAvailableError",
+    "AllTasksFailedError",
+]
+
+
+class PassBeforeError(Exception):
+    """Client's pass count is behind the master's (ErrPassBefore)."""
+
+
+class PassAfterError(Exception):
+    """Client ran ahead of the master's pass (ErrPassAfter) — retry later."""
+
+
+class NoMoreAvailableError(Exception):
+    """All tasks of this pass are leased or done (ErrNoMoreAvailable)."""
+
+
+class AllTasksFailedError(Exception):
+    """Every task failed permanently this pass (ErrAllTaskFailed)."""
+
+
+@dataclass
+class Task:
+    id: int
+    chunks: List[str]
+    epoch: int = 0  # bumped on every (re-)dispatch; stale reports ignored
+
+
+@dataclass
+class _TaskEntry:
+    task: Task
+    num_failure: int = 0
+
+
+@dataclass
+class _MasterState:
+    todo: List[_TaskEntry] = field(default_factory=list)
+    pending: Dict[int, _TaskEntry] = field(default_factory=dict)
+    done: List[_TaskEntry] = field(default_factory=list)
+    failed: List[_TaskEntry] = field(default_factory=list)
+    cur_pass: int = 0
+
+
+def partition(chunks: Sequence[str], chunks_per_task: int) -> List[_TaskEntry]:
+    """Group chunks into tasks (reference: service.go partition :106)."""
+    if chunks_per_task <= 0:
+        chunks_per_task = 1
+    entries: List[_TaskEntry] = []
+    for i in range(0, len(chunks), chunks_per_task):
+        entries.append(_TaskEntry(
+            task=Task(id=len(entries), chunks=list(chunks[i:i + chunks_per_task]))
+        ))
+    return entries
+
+
+class InMemStore:
+    """The Go test double (go/master/inmem_store.go)."""
+
+    def __init__(self):
+        self._buf: Optional[bytes] = None
+        self._lock = threading.Lock()
+
+    def save(self, state: bytes) -> None:
+        with self._lock:
+            self._buf = state
+
+    def load(self) -> Optional[bytes]:
+        with self._lock:
+            return self._buf
+
+
+class FileStore:
+    """Snapshot to a local file (the etcd role for single-host jobs)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def save(self, state: bytes) -> None:
+        import os
+
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(state)
+            os.replace(tmp, self.path)  # atomic: a crash never half-writes
+
+    def load(self) -> Optional[bytes]:
+        with self._lock:
+            try:
+                with open(self.path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                return None
+
+
+class MasterService:
+    """Task-queue master with lease timeouts and snapshot/recover."""
+
+    def __init__(self, store, chunks_per_task: int = 1,
+                 timeout_dur: float = 60.0, failure_max: int = 3):
+        self.chunks_per_task = chunks_per_task
+        self.timeout_dur = timeout_dur
+        self.failure_max = failure_max
+        self.store = store
+        self._mu = threading.Lock()
+        self._state = _MasterState()
+        self._init_done = False
+        self._timers: List[threading.Timer] = []
+        self._heartbeats: Dict[str, float] = {}
+        if self._recover():
+            self._init_done = True
+
+    # -- persistence ---------------------------------------------------
+    def _snapshot_locked(self) -> None:
+        buf = gzip.compress(pickle.dumps(self._state))
+        self.store.save(buf)
+
+    def _recover(self) -> bool:
+        raw = self.store.load()
+        if raw is None:
+            return False
+        self._state = pickle.loads(gzip.decompress(raw))
+        # re-arm timeout checks for tasks that were leased when the
+        # previous master died (service.go recover :196)
+        for entry in self._state.pending.values():
+            self._arm_timeout(entry.task.id, entry.task.epoch)
+        return True
+
+    def _arm_timeout(self, task_id: int, epoch: int) -> None:
+        t = threading.Timer(
+            self.timeout_dur, self._check_timeout, args=(task_id, epoch)
+        )
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    def _check_timeout(self, task_id: int, epoch: int) -> None:
+        with self._mu:
+            entry = self._state.pending.get(task_id)
+            if entry is None:
+                return
+            self._process_failed_locked(entry, epoch)
+
+    # -- dataset -------------------------------------------------------
+    def set_dataset(self, glob_paths: Sequence[str]) -> None:
+        """Partition matching files into tasks.  Only the first call is
+        honored — every trainer calls this (service.go SetDataset :275)."""
+        if not glob_paths:
+            raise ValueError("no dataset specified")
+        with self._mu:
+            if self._init_done:
+                return
+            paths: List[str] = []
+            for g in glob_paths:
+                paths.extend(sorted(globlib.glob(g)))
+            if not paths:
+                raise ValueError("no valid dataset specified")
+            self._state.todo = partition(paths, self.chunks_per_task)
+            self._snapshot_locked()
+            self._init_done = True
+
+    # -- task protocol -------------------------------------------------
+    def get_task(self, pass_id: int) -> Task:
+        """Lease the next task (service.go GetTask :366).  Raises
+        PassBefore/PassAfter for pass skew, NoMoreAvailable when the pass
+        is draining, AllTasksFailed when nothing survived."""
+        with self._mu:
+            if not self._init_done:
+                raise NoMoreAvailableError("dataset not set")
+            st = self._state
+            if pass_id < st.cur_pass:
+                raise PassBeforeError(f"{pass_id} < master {st.cur_pass}")
+            if pass_id > st.cur_pass:
+                raise PassAfterError(f"{pass_id} > master {st.cur_pass}")
+            if not st.todo:
+                if not st.done and not st.pending:
+                    raise AllTasksFailedError()
+                raise NoMoreAvailableError()
+            entry = st.todo.pop(0)
+            entry.task.epoch += 1
+            st.pending[entry.task.id] = entry
+            self._snapshot_locked()
+            self._arm_timeout(entry.task.id, entry.task.epoch)
+            return Task(entry.task.id, list(entry.task.chunks),
+                        entry.task.epoch)
+
+    def task_finished(self, task_id: int) -> None:
+        """Report success; rolls the pass when the queue drains
+        (service.go TaskFinished :410)."""
+        with self._mu:
+            st = self._state
+            entry = st.pending.pop(task_id, None)
+            if entry is None:
+                return  # stale report (already timed out and re-queued)
+            entry.num_failure = 0
+            st.done.append(entry)
+            if not st.todo and not st.pending:
+                st.cur_pass += 1
+                st.todo = st.done + st.failed
+                st.done = []
+                st.failed = []
+            self._snapshot_locked()
+
+    def task_failed(self, task_id: int, epoch: int) -> None:
+        """Report failure; re-queues up to failure_max then discards
+        (service.go TaskFailed :452 -> processFailedTask :311)."""
+        with self._mu:
+            entry = self._state.pending.get(task_id)
+            if entry is None:
+                return
+            self._process_failed_locked(entry, epoch)
+
+    def _process_failed_locked(self, entry: _TaskEntry, epoch: int) -> None:
+        if entry.task.epoch != epoch:
+            return  # this lease was already re-dispatched; stale check
+        self._state.pending.pop(entry.task.id, None)
+        entry.num_failure += 1
+        if entry.num_failure > self.failure_max:
+            self._state.failed.append(entry)
+        else:
+            self._state.todo.append(entry)
+        self._snapshot_locked()
+
+    # -- liveness ------------------------------------------------------
+    def heartbeat(self, worker_id: str) -> None:
+        """Optional fast failure detection on top of lease expiry
+        (the pserver etcd-registration role)."""
+        with self._mu:
+            self._heartbeats[worker_id] = time.monotonic()
+
+    def dead_workers(self, max_silence: float) -> List[str]:
+        now = time.monotonic()
+        with self._mu:
+            return [w for w, t in self._heartbeats.items()
+                    if now - t > max_silence]
+
+    # -- introspection -------------------------------------------------
+    def counts(self) -> dict:
+        with self._mu:
+            st = self._state
+            return {
+                "todo": len(st.todo), "pending": len(st.pending),
+                "done": len(st.done), "failed": len(st.failed),
+                "cur_pass": st.cur_pass,
+            }
+
+    def shutdown(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        self._timers = []

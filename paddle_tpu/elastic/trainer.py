@@ -1,0 +1,157 @@
+"""Checkpoint-restart elastic trainer
+(reference roles: go/pserver periodic checkpoint + LoadCheckpoint
+(service.go:346/:175) and the stateless v2 trainer pulling tasks from the
+master; Fluid-side persistence via io.py save/load_persistables).
+
+A worker is stateless between tasks: it leases a task from the
+MasterService, trains over the task's chunks, reports completion, and
+checkpoints params + its pass cursor.  Kill it at any point and a
+restarted worker recovers the params from the checkpoint and the queue
+from the master's snapshot — the leased task's timeout re-dispatches it.
+That is the whole elasticity contract: add/remove workers freely, each
+one runs this same loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Iterable, Optional
+
+from .. import io as fluid_io
+from ..core.framework import (
+    Program,
+    default_main_program,
+    default_startup_program,
+)
+from .master import (
+    AllTasksFailedError,
+    MasterService,
+    NoMoreAvailableError,
+    PassAfterError,
+    PassBeforeError,
+)
+
+__all__ = ["ElasticTrainer"]
+
+_META = "elastic_meta.json"
+
+
+class ElasticTrainer:
+    """Pull tasks, train, checkpoint; resume transparently after a crash.
+
+    Args:
+        master: the MasterService (or an RPC proxy with the same surface).
+        executor: a fluid Executor.
+        feed_fn: chunk path -> iterable of feed dicts (one per batch).
+        fetch_list: vars fetched every step (first is reported as loss).
+        checkpoint_dir: where params + the pass cursor persist.
+        num_passes: total passes over the dataset.
+        program / startup_program: default to the global programs.
+    """
+
+    def __init__(self, master: MasterService, executor, feed_fn: Callable,
+                 fetch_list, checkpoint_dir: str, num_passes: int = 1,
+                 program: Optional[Program] = None,
+                 startup_program: Optional[Program] = None,
+                 worker_id: str = "worker-0",
+                 idle_wait: float = 0.05):
+        self.master = master
+        self.exe = executor
+        self.feed_fn = feed_fn
+        self.fetch_list = fetch_list
+        self.ckpt_dir = checkpoint_dir
+        self.num_passes = num_passes
+        self.program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.worker_id = worker_id
+        self.idle_wait = idle_wait
+        self.pass_id = 0
+        self.tasks_done = 0
+        self.last_loss: Optional[float] = None
+
+    # -- persistence ---------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.ckpt_dir, _META)
+
+    def _checkpoint(self) -> None:
+        fluid_io.save_persistables(self.exe, self.ckpt_dir,
+                                   main_program=self.program)
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pass_id": self.pass_id,
+                       "tasks_done": self.tasks_done}, f)
+        os.replace(tmp, self._meta_path())
+
+    def _resume(self) -> bool:
+        if not os.path.exists(self._meta_path()):
+            return False
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        fluid_io.load_persistables(self.exe, self.ckpt_dir,
+                                   main_program=self.program)
+        self.pass_id = int(meta["pass_id"])
+        self.tasks_done = int(meta.get("tasks_done", 0))
+        return True
+
+    # -- the loop ------------------------------------------------------
+    def train(self) -> None:
+        """Run until num_passes complete.  Safe to call on a fresh
+        process after a crash: params and the pass cursor come back from
+        the checkpoint, unfinished work from the master's lease expiry."""
+        if not self._resume():
+            self.exe.run(self.startup_program)
+        while self.pass_id < self.num_passes:
+            try:
+                task = self.master.get_task(self.pass_id)
+            except PassBeforeError:
+                # master rolled the pass past us (a checkpoint older than
+                # the queue snapshot): catch up
+                self.pass_id = self.master.counts()["cur_pass"]
+                continue
+            except PassAfterError:
+                time.sleep(self.idle_wait)
+                continue
+            except NoMoreAvailableError:
+                # pass draining: other workers hold the pending tasks (or
+                # the master just rolled over)
+                cur = self.master.counts()["cur_pass"]
+                if cur > self.pass_id:
+                    self.pass_id = cur
+                    continue
+                if cur >= self.num_passes:
+                    return
+                time.sleep(self.idle_wait)
+                continue
+            except AllTasksFailedError:
+                raise RuntimeError(
+                    f"pass {self.pass_id}: every task failed "
+                    f"{self.master.failure_max}+ times; giving up"
+                )
+            try:
+                for chunk in task.chunks:
+                    for feed in self.feed_fn(chunk):
+                        vals = self.exe.run(
+                            program=self.program, feed=feed,
+                            fetch_list=self.fetch_list,
+                        )
+                        if vals:
+                            import numpy as np
+
+                            self.last_loss = float(
+                                np.ravel(np.asarray(vals[0]))[0]
+                            )
+            except Exception:
+                # report and surface: the master re-queues immediately
+                # instead of waiting for the lease to expire
+                self.master.task_failed(task.id, task.epoch)
+                raise
+            self.master.task_finished(task.id)
+            self.tasks_done += 1
+            self.master.heartbeat(self.worker_id)
+            # master may have rolled the pass on our report
+            cur = self.master.counts()["cur_pass"]
+            if cur > self.pass_id:
+                self.pass_id = cur
+            self._checkpoint()

@@ -1,0 +1,250 @@
+"""Elastic master + checkpoint-restart trainer
+(reference semantics: go/master/service.go task leases with timeout
+re-dispatch, failureMax discard, pass rollover, snapshot/recover;
+go/master/service_internal_test.go + client tests)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.elastic import (
+    AllTasksFailedError,
+    ElasticTrainer,
+    FileStore,
+    InMemStore,
+    MasterService,
+    NoMoreAvailableError,
+    partition,
+)
+
+
+def _touch(tmp_path, names):
+    paths = []
+    for n in names:
+        p = tmp_path / n
+        p.write_text("x")
+        paths.append(str(p))
+    return paths
+
+
+def test_partition_groups_chunks():
+    entries = partition(["a", "b", "c", "d", "e"], 2)
+    assert [e.task.chunks for e in entries] == [["a", "b"], ["c", "d"], ["e"]]
+    assert partition(["a"], 0)[0].task.chunks == ["a"]  # <=0 -> 1
+
+
+def test_lease_timeout_redispatches(tmp_path):
+    """A worker that dies mid-task never reports; the lease expires and
+    the task returns to todo with num_failure bumped (processFailedTask)."""
+    _touch(tmp_path, ["f0", "f1"])
+    m = MasterService(InMemStore(), chunks_per_task=1,
+                      timeout_dur=0.1, failure_max=3)
+    m.set_dataset([str(tmp_path / "f*")])
+    t = m.get_task(0)
+    assert m.counts()["pending"] == 1
+    time.sleep(0.3)  # lease expires; no finish report
+    c = m.counts()
+    assert c["pending"] == 0 and c["todo"] == 2
+    # the timed-out task is dispatchable again with a new epoch
+    seen = {m.get_task(0).id, m.get_task(0).id}
+    assert t.id in seen
+    m.shutdown()
+
+
+def test_failure_max_discards_then_all_failed(tmp_path):
+    _touch(tmp_path, ["f0"])
+    m = MasterService(InMemStore(), timeout_dur=60, failure_max=1)
+    m.set_dataset([str(tmp_path / "f0")])
+    for _ in range(2):  # failure_max=1 -> second failure discards
+        t = m.get_task(0)
+        m.task_failed(t.id, t.epoch)
+    assert m.counts()["failed"] == 1 and m.counts()["todo"] == 0
+    with pytest.raises(AllTasksFailedError):
+        m.get_task(0)
+    m.shutdown()
+
+
+def test_stale_failure_report_ignored(tmp_path):
+    """A failure report carrying an old epoch (the task was already
+    re-dispatched) must not double-punish (service.go epoch check)."""
+    _touch(tmp_path, ["f0"])
+    m = MasterService(InMemStore(), timeout_dur=60, failure_max=3)
+    m.set_dataset([str(tmp_path / "f0")])
+    t1 = m.get_task(0)
+    m.task_failed(t1.id, t1.epoch)  # re-queued, failure=1
+    t2 = m.get_task(0)  # epoch bumped
+    m.task_failed(t1.id, t1.epoch)  # stale: epoch mismatch -> ignored
+    assert m.counts()["pending"] == 1
+    m.task_finished(t2.id)
+    m.shutdown()
+
+
+def test_pass_rollover_and_skew(tmp_path):
+    from paddle_tpu.elastic import PassAfterError, PassBeforeError
+
+    _touch(tmp_path, ["f0", "f1"])
+    m = MasterService(InMemStore(), timeout_dur=60)
+    m.set_dataset([str(tmp_path / "f*")])
+    with pytest.raises(PassAfterError):
+        m.get_task(1)  # client ahead
+    for _ in range(2):
+        t = m.get_task(0)
+        m.task_finished(t.id)
+    assert m.counts() == {"todo": 2, "pending": 0, "done": 0, "failed": 0,
+                          "cur_pass": 1}
+    with pytest.raises(PassBeforeError):
+        m.get_task(0)  # client behind after rollover
+    assert m.get_task(1).id in (0, 1)
+    m.shutdown()
+
+
+def test_snapshot_recover_rearms_pending(tmp_path):
+    """Kill the master mid-lease; a new master over the same store
+    recovers the queue and the leased task times out back to todo
+    (service.go recover :196)."""
+    _touch(tmp_path, ["f0", "f1"])
+    store = FileStore(str(tmp_path / "snap.bin"))
+    m1 = MasterService(store, timeout_dur=0.15, failure_max=3)
+    m1.set_dataset([str(tmp_path / "f*")])
+    t = m1.get_task(0)
+    m1.shutdown()  # "crash": cancels timers, state only in the store
+    del m1
+
+    m2 = MasterService(store, timeout_dur=0.15, failure_max=3)
+    c = m2.counts()
+    assert c["pending"] == 1 and c["todo"] == 1  # recovered mid-lease
+    time.sleep(0.4)  # recovered lease expires
+    assert m2.counts()["todo"] == 2
+    ids = {m2.get_task(0).id, m2.get_task(0).id}
+    assert t.id in ids
+    m2.shutdown()
+
+
+def test_heartbeat_dead_worker_detection(tmp_path):
+    m = MasterService(InMemStore(), timeout_dur=60)
+    m.heartbeat("w0")
+    m.heartbeat("w1")
+    time.sleep(0.12)
+    m.heartbeat("w1")
+    assert m.dead_workers(max_silence=0.1) == ["w0"]
+    m.shutdown()
+
+
+def _linreg_program():
+    """y = 2x - 1 regression; returns (loss, w_name)."""
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="ew"))
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.3).minimize(loss)
+    return loss
+
+
+def _write_linreg_chunks(tmp_path, n_files=4, rows=64):
+    rng = np.random.RandomState(0)
+    for i in range(n_files):
+        xs = rng.uniform(-1, 1, size=rows).astype(np.float32)
+        np.save(str(tmp_path / f"chunk{i}.npy"), xs)
+    return str(tmp_path / "chunk*.npy")
+
+
+def _feed_fn(chunk):
+    xs = np.load(chunk)
+    for i in range(0, len(xs), 16):
+        xb = xs[i:i + 16].reshape(-1, 1)
+        yield {"x": xb, "y": (2.0 * xb - 1.0).astype(np.float32)}
+
+
+def test_elastic_trainer_crash_resume(tmp_path):
+    """Worker crashes mid-pass; a fresh worker (new process in real life)
+    resumes from the checkpoint + master snapshot and finishes all passes
+    with a converged model.  This is the checkpoint-restart elasticity
+    SURVEY §5 maps the Go stack to."""
+    fluid.reset_default_env()
+    loss = _linreg_program()
+    glob_pat = _write_linreg_chunks(tmp_path)
+    store = FileStore(str(tmp_path / "master.snap"))
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+
+    m = MasterService(store, chunks_per_task=1, timeout_dur=0.2,
+                      failure_max=5)
+    m.set_dataset([glob_pat])
+
+    crash_after = [2]  # tasks before the simulated crash
+
+    def crashing_feed(chunk):
+        if crash_after[0] == 0:
+            raise RuntimeError("simulated worker crash")
+        crash_after[0] -= 1
+        yield from _feed_fn(chunk)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    t1 = ElasticTrainer(m, exe, crashing_feed, [loss], ckpt, num_passes=3)
+    with pytest.raises(RuntimeError, match="simulated"):
+        t1.train()
+    assert t1.tasks_done == 2
+    m.shutdown()
+
+    # restart: new master over the same snapshot store, new trainer over
+    # the same checkpoint dir (same process here; same protocol anyway)
+    m2 = MasterService(store, chunks_per_task=1, timeout_dur=0.2,
+                       failure_max=5)
+    t2 = ElasticTrainer(m2, exe, _feed_fn, [loss], ckpt, num_passes=3)
+    t2.train()
+    assert t2.pass_id == 3
+    assert m2.counts()["cur_pass"] == 3
+    w = np.ravel(np.asarray(fluid.global_scope().find_var("ew")))[0]
+    assert abs(w - 2.0) < 0.2, f"did not converge: w={w}"
+    m2.shutdown()
+
+
+def test_elastic_two_workers_share_queue(tmp_path):
+    """Two worker threads drain one master; every task runs exactly once
+    per pass (the Go client pattern, one shared service)."""
+    fluid.reset_default_env()
+    loss = _linreg_program()
+    glob_pat = _write_linreg_chunks(tmp_path, n_files=6)
+    m = MasterService(InMemStore(), chunks_per_task=1, timeout_dur=5.0)
+    m.set_dataset([glob_pat])
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+
+    done = []
+    lock = threading.Lock()
+
+    def worker(wid):
+        # Hogwild rule (async_executor.py worker): each thread gets its own
+        # Executor with donation off — donated state buffers would be
+        # freed under the other thread's feet
+        exe = fluid.Executor(fluid.CPUPlace(), donate_states=False)
+        my_pass = 0
+        while True:
+            try:
+                task = m.get_task(my_pass)
+            except NoMoreAvailableError:
+                if m.counts()["cur_pass"] > my_pass:
+                    return
+                time.sleep(0.01)
+                continue
+            except Exception:
+                return
+            for chunk in task.chunks:
+                for feed in _feed_fn(chunk):
+                    exe.run(program=fluid.default_main_program(), feed=feed,
+                            fetch_list=[loss])
+            m.task_finished(task.id)
+            with lock:
+                done.append((wid, task.id))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert sorted(t_id for _, t_id in done) == list(range(6))
+    assert m.counts()["cur_pass"] == 1
+    m.shutdown()
