@@ -1,5 +1,20 @@
 """Contrib (reference: python/paddle/fluid/contrib/): quantize transpiler,
-memory-usage estimate, beam-search decoder."""
+memory-usage estimate, op census, CTR reader, beam-search decoder,
+high-level Trainer/Inferencer, HDFS + lookup-table utilities."""
 
 from . import quantize  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from . import reader  # noqa: F401
+from . import utils  # noqa: F401
+from . import decoder  # noqa: F401
+from .decoder import BeamSearchDecoder, InitState, StateCell, TrainingDecoder  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+)
+from .inferencer import Inferencer  # noqa: F401

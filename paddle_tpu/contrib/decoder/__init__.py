@@ -1,0 +1,11 @@
+"""Beam-search decoder machinery
+(reference: python/paddle/fluid/contrib/decoder/beam_search_decoder.py)."""
+
+from .beam_search_decoder import (
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]

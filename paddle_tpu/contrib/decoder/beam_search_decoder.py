@@ -1,0 +1,465 @@
+"""StateCell / TrainingDecoder / BeamSearchDecoder
+(reference: python/paddle/fluid/contrib/decoder/beam_search_decoder.py —
+the seq2seq decoder API the MT demos use: a StateCell describes one RNN
+step as a state-update function; TrainingDecoder runs it under DynamicRNN
+with teacher forcing; BeamSearchDecoder runs it under a While loop doing
+beam search at inference).
+
+TPU-native representation: the reference tracks beams through LoD lineage
+(sequence_expand before the step, LoD backtrace in beam_search_decode).
+Here beams are dense rows [beam_size, ...] with explicit parent pointers
+(ops/beam_search_ops.py) — finished beams freeze in place, states are
+re-ordered after selection by a gather on the parent index, and the loop
+always runs to max_len (XLA-friendly static control flow; the decode trims
+at end_id).  The user-facing API is unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+from ... import layers
+from ...core.framework import Variable, default_main_program
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class _DecoderType:
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState:
+    """Initial value of one decoder state
+    (reference: beam_search_decoder.py:43): either an explicit `init`
+    Variable (e.g. the encoder's last hidden) or a (shape, value) fill
+    boot-strapped from `init_boot`'s batch."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the init batch size"
+            )
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype
+            )
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """One decoder step as a pure state update
+    (reference: beam_search_decoder.py:159).
+
+    states: {name: InitState}; inputs: {name: Variable or None} (None =
+    bound later, e.g. the step input under TrainingDecoder or the previous
+    word's embedding under BeamSearchDecoder); out_state: which state is
+    the step output.  The update itself is the @state_cell.state_updater
+    function, which reads get_input/get_state and calls set_state.
+    """
+
+    def __init__(self, inputs: Dict[str, Optional[Variable]],
+                 states: Dict[str, InitState], out_state: str,
+                 name: Optional[str] = None):
+        self._inputs = dict(inputs)
+        self._states = dict(states)
+        self._state_names = list(states)
+        self._out_state_name = out_state
+        self._cur_states: Dict[str, Variable] = {}
+        self._cur_inputs: Dict[str, Variable] = {}
+        self._state_updater = None
+        self._decoder_obj = None
+        self._states_ready = False
+
+    # -- decoder attach/detach (reference: _enter_decoder/_leave_decoder)
+    def _enter_decoder(self, decoder_obj):
+        if self._decoder_obj is not None:
+            raise ValueError("StateCell is already inside a decoder")
+        self._decoder_obj = decoder_obj
+        self._cur_states = {}
+        self._states_ready = False
+
+    def _leave_decoder(self, decoder_obj):
+        if self._decoder_obj is not decoder_obj:
+            raise ValueError("leaving a decoder this StateCell never entered")
+        self._decoder_obj = None
+        self._states_ready = False
+
+    def _ensure_states(self):
+        """Lazily materialize per-decoder state carriers on first access
+        (reference: the lazy _switch_decoder), so TrainingDecoder memories
+        are created after the user's step_input established the batch."""
+        if self._states_ready:
+            return
+        d = self._decoder_obj
+        if d is None:
+            raise ValueError("StateCell must be used inside a decoder block")
+        if d.type == _DecoderType.TRAINING:
+            drnn = d.dynamic_rnn
+            for name, init in self._states.items():
+                self._cur_states[name] = drnn.memory(
+                    init=init.value, need_reorder=init.need_reorder
+                )
+        else:  # BEAM_SEARCH: decoder owns array-backed carries
+            for name, init in self._states.items():
+                self._cur_states[name] = d._make_state_carry(name, init.value)
+        self._states_ready = True
+
+    # -- accessors (reference API) -------------------------------------
+    def get_state(self, state_name: str) -> Variable:
+        self._ensure_states()
+        if state_name not in self._cur_states:
+            raise ValueError(f"unknown state '{state_name}'")
+        return self._cur_states[state_name]
+
+    def get_input(self, input_name: str) -> Variable:
+        if input_name not in self._cur_inputs:
+            raise ValueError(f"input '{input_name}' not provided yet")
+        return self._cur_inputs[input_name]
+
+    def set_state(self, state_name: str, state_value: Variable) -> None:
+        self._ensure_states()
+        if state_name not in self._states:
+            raise ValueError(f"unknown state '{state_name}'")
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        """Decorator registering the step function
+        (reference: beam_search_decoder.py:314)."""
+        self._state_updater = updater
+
+        def _decorator(cell):
+            if cell is not self:
+                raise TypeError("updater bound to a different StateCell")
+            updater(cell)
+
+        return _decorator
+
+    def compute_state(self, inputs: Dict[str, Variable]) -> None:
+        """Bind this step's inputs and run the updater
+        (reference: beam_search_decoder.py:335)."""
+        self._ensure_states()
+        if self._state_updater is None:
+            raise ValueError("no state_updater registered")
+        self._cur_inputs = dict(self._inputs)
+        for name, v in inputs.items():
+            if name not in self._inputs:
+                raise ValueError(f"unknown input '{name}'")
+            self._cur_inputs[name] = v
+        self._prev_states = {
+            n: self._cur_states[n] for n in self._state_names
+        }
+        self._state_updater(self)
+
+    def update_states(self) -> None:
+        """Commit the step's states to the carrier
+        (reference: beam_search_decoder.py:360).  Training: DynamicRNN
+        update_memory; beam search: the decoder re-orders by beam parent
+        and writes the carry itself after selection."""
+        d = self._decoder_obj
+        if d is None:
+            raise ValueError("update_states outside a decoder block")
+        if d.type == _DecoderType.TRAINING:
+            for name in self._state_names:
+                prev, cur = self._prev_states[name], self._cur_states[name]
+                if prev is not cur:
+                    d.dynamic_rnn.update_memory(prev, cur)
+
+    def out_state(self) -> Variable:
+        return self._cur_states[self._out_state_name]
+
+
+class TrainingDecoder:
+    """Teacher-forced decoding under DynamicRNN
+    (reference: beam_search_decoder.py:384)."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell: StateCell, name: Optional[str] = None):
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._dynamic_rnn = layers.DynamicRNN()
+        self._type = _DecoderType.TRAINING
+        self._status = TrainingDecoder.BEFORE_DECODER
+
+    @property
+    def state_cell(self) -> StateCell:
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._dynamic_rnn
+
+    @property
+    def type(self):
+        return self._type
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError("block() can only be invoked once")
+        self._status = TrainingDecoder.IN_DECODER
+        with self._dynamic_rnn.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def step_input(self, x):
+        self._assert_in_decoder_block("step_input")
+        return self._dynamic_rnn.step_input(x)
+
+    def static_input(self, x):
+        self._assert_in_decoder_block("static_input")
+        return self._dynamic_rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block("output")
+        self._dynamic_rnn.output(*outputs)
+
+    def __call__(self, *args, **kwargs):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError(
+                "output is only visible after the decoder block closes"
+            )
+        return self._dynamic_rnn(*args, **kwargs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError(
+                f"{method} must be invoked inside the decoder block"
+            )
+
+
+class BeamSearchDecoder:
+    """Beam-search inference under a While loop
+    (reference: beam_search_decoder.py:523).  decode() wires the default
+    step — embed previous ids, run the StateCell, softmax over the target
+    dict, beam-select — and __call__() returns the back-traced
+    (translation_ids, translation_scores)."""
+
+    BEFORE_BEAM_SEARCH_DECODER = 0
+    IN_BEAM_SEARCH_DECODER = 1
+    AFTER_BEAM_SEARCH_DECODER = 2
+
+    def __init__(self, state_cell: StateCell, init_ids, init_scores,
+                 target_dict_dim: int, word_dim: int,
+                 input_var_dict: Optional[dict] = None, topk_size: int = 50,
+                 sparse_emb: bool = True, max_len: int = 100,
+                 beam_size: int = 1, end_id: int = 1,
+                 name: Optional[str] = None):
+        self._type = _DecoderType.BEAM_SEARCH
+        self._counter = layers.fill_constant([1], "int64", 0)
+        self._max_len = layers.fill_constant([1], "int64", max_len)
+        self._cond = layers.less_than(self._counter, self._max_len)
+        self._while_op = layers.While(self._cond)
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
+        self._zero_idx = layers.fill_constant([1], "int64", 0)
+        self._array_dict = {}     # read-var name -> carry var
+        self._state_carries = {}  # state name -> carry var
+        self._ids_array = None
+        self._scores_array = None
+        self._parents_array = None
+        self._beam_size = beam_size
+        self._end_id = end_id
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._topk_size = topk_size
+        self._sparse_emb = sparse_emb
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def state_cell(self) -> StateCell:
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @contextlib.contextmanager
+    def _in_parent_block(self):
+        """Append init ops to the block surrounding the While sub-block
+        (reference: _parent_block + parent_block.append_op)."""
+        program = default_main_program()
+        sub_idx = program.current_block_idx
+        parent_idx = program.current_block().parent_idx
+        if parent_idx < 0:
+            raise ValueError("decoder block has no parent")
+        program.current_block_idx = parent_idx
+        try:
+            yield
+        finally:
+            program.current_block_idx = sub_idx
+
+    @contextlib.contextmanager
+    def block(self):
+        """One beam step (reference: beam_search_decoder.py:617).  The
+        counter advances and the loop condition refreshes when the block
+        closes."""
+        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
+            raise ValueError("block() can only be invoked once")
+        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        with self._while_op.block():
+            yield
+            layers.increment(self._counter, value=1, in_place=True)
+            layers.less_than(self._counter, self._max_len, cond=self._cond)
+        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def early_stop(self):
+        """Break the generation loop (reference: early_stop)."""
+        self._assert_in_decoder_block("early_stop")
+        false = layers.fill_constant([1], "bool", 0)
+        layers.assign(false, self._cond)
+
+    def _make_carry(self, init) -> Variable:
+        """A loop-carried var initialized in the parent block."""
+        with self._in_parent_block():
+            return layers.assign(init)
+
+    def _make_state_carry(self, name: str, init) -> Variable:
+        carry = self._make_carry(init)
+        self._state_carries[name] = carry
+        return carry
+
+    def read_array(self, init, is_ids: bool = False,
+                   is_scores: bool = False) -> Variable:
+        """Previous step's value of a loop-carried variable
+        (reference: read_array — array semantics collapse to a dense
+        carry here; ids/scores additionally record per-step selections
+        for the final backtrace)."""
+        self._assert_in_decoder_block("read_array")
+        if is_ids and is_scores:
+            raise ValueError("a variable cannot be both ids and scores")
+        if not isinstance(init, Variable):
+            raise TypeError("`init` must be a Variable")
+        carry = self._make_carry(init)
+        if is_ids:
+            with self._in_parent_block():
+                self._ids_array = layers.create_array(init.dtype)
+                self._parents_array = layers.create_array("int64")
+        elif is_scores:
+            with self._in_parent_block():
+                self._scores_array = layers.create_array(init.dtype)
+        read_value = layers.assign(carry)
+        self._array_dict[read_value.name] = carry
+        return read_value
+
+    def update_array(self, array, value):
+        """Store this step's value into the carry read by read_array
+        (reference: update_array)."""
+        self._assert_in_decoder_block("update_array")
+        carry = self._array_dict.get(array.name)
+        if carry is None:
+            raise ValueError("invoke read_array before update_array")
+        layers.assign(value, carry)
+
+    def decode(self):
+        """The default beam step (reference: decode :653).  Override for
+        custom decoding."""
+        with self.block():
+            prev_ids = self.read_array(init=self._init_ids, is_ids=True)
+            prev_scores = self.read_array(
+                init=self._init_scores, is_scores=True
+            )
+            prev_ids_embedding = layers.embedding(
+                input=prev_ids,
+                size=[self._target_dict_dim, self._word_dim],
+                dtype="float32",
+                is_sparse=self._sparse_emb,
+            )
+
+            feed_dict = {}
+            update_dict = {}
+            for name, init_var in self._input_var_dict.items():
+                if name not in self._state_cell._inputs:
+                    raise ValueError(
+                        f"variable '{name}' not found in StateCell"
+                    )
+                read_var = self.read_array(init=init_var)
+                update_dict[name] = read_var
+                feed_dict[name] = read_var
+
+            for input_name in self._state_cell._inputs:
+                if input_name not in feed_dict:
+                    feed_dict[input_name] = prev_ids_embedding
+
+            self.state_cell.compute_state(inputs=feed_dict)
+            current_state = self.state_cell.out_state()
+            scores = layers.fc(
+                current_state, size=self._target_dict_dim, act="softmax"
+            )
+            topk_scores, topk_indices = layers.topk(
+                scores, k=min(self._topk_size, self._target_dict_dim)
+            )
+            accu_scores = layers.elementwise_add(
+                layers.log(topk_scores),
+                layers.reshape(prev_scores, [-1, 1]),
+            )
+            selected_ids, selected_scores = layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu_scores,
+                self._beam_size, end_id=self._end_id,
+            )
+            parent = selected_ids._parent_idx
+
+            # record this step for the final backtrace, then re-order every
+            # carried state by beam lineage (the dense equivalent of the
+            # reference's sequence_expand-by-LoD)
+            layers.array_write(selected_ids, self._counter,
+                               array=self._ids_array)
+            layers.array_write(selected_scores, self._counter,
+                               array=self._scores_array)
+            layers.array_write(parent, self._counter,
+                               array=self._parents_array)
+
+            self.state_cell.update_states()
+            for name in self._state_cell._state_names:
+                new_state = self._state_cell.get_state(name)
+                layers.assign(
+                    layers.gather(new_state, parent),
+                    self._state_carries[name],
+                )
+            self.update_array(prev_ids, selected_ids)
+            self.update_array(prev_scores, selected_scores)
+            for name, read_var in update_dict.items():
+                self.update_array(read_var, feed_dict[name])
+
+    def __call__(self):
+        """Back-trace the beams (reference: __call__ :802)."""
+        if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
+            raise ValueError(
+                "decode result is only visible outside the block"
+            )
+        return layers.beam_search_decode(
+            self._ids_array, self._scores_array,
+            beam_size=self._beam_size, end_id=self._end_id,
+            parent_idx=self._parents_array,
+        )
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != BeamSearchDecoder.IN_BEAM_SEARCH_DECODER:
+            raise ValueError(
+                f"{method} must be invoked inside the decoder block"
+            )

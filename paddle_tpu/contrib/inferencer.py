@@ -1,0 +1,64 @@
+"""High-level Inferencer API
+(reference: python/paddle/fluid/contrib/inferencer.py — builds the
+inference program from a callback, loads params, and runs feeds through a
+private scope)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import io as fluid_io
+from ..core.executor import Executor
+from ..core.framework import Program, program_guard, unique_name_guard
+from ..core.scope import Scope, scope_guard
+from .trainer import check_and_get_place
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    """reference: inferencer.py:31.
+
+    Args:
+        infer_func: callback building the inference graph; returns the
+            prediction Variable(s).
+        param_path: directory save_params/save_persistables wrote.
+        place: CPUPlace/TPUPlace; defaults to TPU when available.
+        parallel: accepted for API parity; XLA owns intra-chip parallelism.
+    """
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel: bool = False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+
+        self.startup_program = Program()
+        self.inference_program = Program()
+        with program_guard(self.inference_program, self.startup_program), \
+                unique_name_guard():
+            outs = infer_func()
+            self.predict_vars = (list(outs) if isinstance(outs, (list, tuple))
+                                 else [outs])
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+        self.exe = Executor(self.place, donate_states=False)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            fluid_io.load_persistables(
+                self.exe, param_path, main_program=self.inference_program)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        """inputs: {var name: numpy array} (reference: inferencer.py:80)."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}"
+            )
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                program=self.inference_program, feed=inputs,
+                fetch_list=self.predict_vars,
+                return_numpy=return_numpy,
+            )
+        return results

@@ -1,0 +1,45 @@
+"""Op frequency statistics
+(reference: python/paddle/fluid/contrib/op_frequence.py op_freq_statistic —
+counts single ops and adjacent op pairs across a program, for deciding
+which fusions matter).  On TPU, XLA does the fusing, but the census is
+still the tool for spotting hot op sequences worth a Pallas kernel.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..core.framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program: Program):
+    """Return (single-op counts, adjacent-pair counts), both ordered by
+    descending frequency (reference: op_frequence.py:20)."""
+    if not isinstance(program, Program):
+        raise TypeError(f"expected a Program, got {type(program)!r}")
+
+    uni_op_freq: dict = OrderedDict()
+    adj_2_op_freq: dict = OrderedDict()
+    op_in_ops = {}  # output var -> op type producing it
+
+    for block in program.blocks:
+        for op in block.desc.ops:
+            uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+            # count producer->consumer adjacency through each input var
+            for name in op.input_arg_names():
+                prev = op_in_ops.get(name)
+                if prev is not None:
+                    key = f"{prev},{op.type}"
+                    adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+            for name in op.output_arg_names():
+                op_in_ops[name] = op.type
+
+    uni = OrderedDict(
+        sorted(uni_op_freq.items(), key=lambda kv: kv[1], reverse=True)
+    )
+    adj = OrderedDict(
+        sorted(adj_2_op_freq.items(), key=lambda kv: kv[1], reverse=True)
+    )
+    return uni, adj

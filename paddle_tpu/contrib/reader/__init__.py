@@ -1,0 +1,5 @@
+"""contrib readers (reference: python/paddle/fluid/contrib/reader/)."""
+
+from .ctr_reader import ctr_reader
+
+__all__ = ["ctr_reader"]

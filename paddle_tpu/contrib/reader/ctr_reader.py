@@ -1,0 +1,163 @@
+"""CTR file reader
+(reference: python/paddle/fluid/contrib/reader/ctr_reader.py over the C++
+create_ctr_reader op — thread_num workers stream svm-format CTR files
+into a blocking queue that `read` ops pop).
+
+TPU-native: the same multi-threaded file fan-out feeds the py_reader
+queue machinery (layers/io_pyreader.py) — workers parse
+`label slot:feasign ...` lines, batch them per slot, and the executor
+pops ready feed dicts; start()/reset() follow the reference contract.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Sequence
+
+import numpy as np
+
+from ...core.framework import default_main_program
+from ...core.lod import create_lod_tensor
+from ...layers.io_pyreader import PyReader
+
+__all__ = ["ctr_reader"]
+
+
+def _parse_ctr_line(line: str, slots: Sequence[str]):
+    """`label slot_name:feasign slot_name:feasign ...` -> (label, per-slot
+    id lists); absent slots get [0] like the C++ reader's padding."""
+    toks = line.split()
+    label = int(toks[0])
+    by_slot = {s: [] for s in slots}
+    for t in toks[1:]:
+        if ":" not in t:
+            continue
+        slot, feasign = t.rsplit(":", 1)
+        if slot in by_slot:
+            by_slot[slot].append(int(feasign))
+    return label, [by_slot[s] or [0] for s in slots]
+
+
+class _CTRReader(PyReader):
+    """PyReader whose worker pool streams CTR files instead of a
+    user generator."""
+
+    def __init__(self, names, lod_levels, capacity, thread_num, batch_size,
+                 file_list, slots):
+        shapes = [[-1, 1]] * len(names)
+        dtypes = ["int64"] * len(names)
+        super().__init__(names, shapes, dtypes, lod_levels, capacity)
+        self._thread_num = thread_num
+        self._batch_size = batch_size
+        self._file_list = list(file_list)
+        self._slots = list(slots)
+
+    def start(self):
+        self._queue = queue.Queue(self._capacity)
+        self._stop_event = threading.Event()
+        files: queue.Queue = queue.Queue()
+        for f in self._file_list:
+            files.put(f)
+        self._pending_lock = threading.Lock()
+
+        def put_checked(q, stop, item) -> bool:
+            """Bounded put that stays responsive to reset(): never block
+            indefinitely on a queue nobody drains."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            q, stop = self._queue, self._stop_event
+            try:
+                while not stop.is_set():
+                    try:
+                        path = files.get_nowait()
+                    except queue.Empty:
+                        return
+                    batch = []
+                    with open(path) as f:
+                        for line in f:
+                            if stop.is_set():
+                                return
+                            line = line.strip()
+                            if not line:
+                                continue
+                            batch.append(
+                                _parse_ctr_line(line, self._slots)
+                            )
+                            if len(batch) == self._batch_size:
+                                if not put_checked(
+                                        q, stop, self._to_feed(batch)):
+                                    return
+                                batch = []
+                    if batch:
+                        if not put_checked(q, stop, self._to_feed(batch)):
+                            return
+            finally:
+                with self._pending_lock:
+                    self._pending -= 1
+                    if self._pending <= 0:
+                        q.put(self._end)  # end-of-pass sentinel
+
+        self._thread = None  # base-class slot unused; we own a pool
+        self._pool = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self._thread_num)
+        ]
+        # each worker decrements pending exactly once on exit; the last one
+        # out emits the end-of-pass sentinel
+        self._pending = len(self._pool)
+        for t in self._pool:
+            t.start()
+
+    def _to_feed(self, batch):
+        label = np.array([[b[0]] for b in batch], dtype=np.int64)
+        feed = {self._names[0]: label}
+        for i, name in enumerate(self._names[1:]):
+            rows = [np.asarray(b[1][i], dtype=np.int64)[:, None]
+                    for b in batch]
+            feed[name] = create_lod_tensor(rows)
+        return feed
+
+    def reset(self):
+        stop = getattr(self, "_stop_event", None)
+        if stop is not None:
+            stop.set()
+        q = self._queue
+        self._queue = None
+        if q is not None:
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in getattr(self, "_pool", []):
+            t.join(timeout=5.0)
+        self._pool = []
+
+
+def ctr_reader(feed_data, capacity: int, thread_num: int, batch_size: int,
+               file_list: Sequence[str], slots: Sequence[str], name=None):
+    """Create a CTR reader feeding `feed_data` vars: feed_data[0] is the
+    int64 label [N,1], the rest are lod-level-1 id vars, one per slot
+    (reference: ctr_reader.py:47).  Returns the reader; call start() per
+    pass, executor pops batches on feed=None runs."""
+    if len(feed_data) != len(slots) + 1:
+        raise ValueError(
+            f"feed_data must be [label] + one var per slot: "
+            f"{len(feed_data)} vars vs {len(slots)} slots"
+        )
+    names = [v.name for v in feed_data]
+    lod_levels = [getattr(v, "lod_level", 0) for v in feed_data]
+    reader = _CTRReader(names, lod_levels, capacity, thread_num, batch_size,
+                        file_list, slots)
+    program = default_main_program()
+    program._py_readers = getattr(program, "_py_readers", [])
+    program._py_readers.append(reader)
+    return reader

@@ -1,0 +1,18 @@
+"""contrib utilities (reference: python/paddle/fluid/contrib/utils/)."""
+
+from . import hdfs_utils  # noqa: F401
+from . import lookup_table_utils  # noqa: F401
+from .hdfs_utils import HDFSClient, multi_download
+from .lookup_table_utils import (
+    convert_dist_to_sparse_program,
+    load_persistables_for_increment,
+    load_persistables_for_inference,
+)
+
+__all__ = [
+    "HDFSClient",
+    "multi_download",
+    "convert_dist_to_sparse_program",
+    "load_persistables_for_increment",
+    "load_persistables_for_inference",
+]

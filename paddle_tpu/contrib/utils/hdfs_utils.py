@@ -1,0 +1,161 @@
+"""HDFS helpers (reference: python/paddle/fluid/contrib/utils/hdfs_utils.py
+— shells out to the hadoop binary for ls/put/get/mv/rm, plus a
+multi-process downloader).
+
+Same contract: every operation execs `<hadoop_bin> fs` with the configured
+name-node; without a hadoop binary the client raises a clear error at
+call time (construction stays cheap so configs can be built anywhere).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+class HDFSClient:
+    def __init__(self, hadoop_home: str, configs: Dict[str, str]):
+        self.pre_commands: List[str] = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.pre_commands.append(hadoop_bin)
+        dfs = "fs"
+        self.pre_commands.append(dfs)
+        for k, v in configs.items():
+            self.pre_commands.extend(["-D", f"{k}={v}"])
+        self._hadoop_bin = hadoop_bin
+
+    def _run(self, args: List[str], retry_times: int = 5) -> (int, str):
+        if not (os.path.exists(self._hadoop_bin)
+                or shutil.which(self._hadoop_bin)):
+            raise RuntimeError(
+                f"hadoop binary not found at {self._hadoop_bin!r}; HDFS "
+                "operations need a hadoop install (zero-egress environments "
+                "should use local paths instead)"
+            )
+        cmd = self.pre_commands + args
+        last = ""
+        for _ in range(max(1, retry_times)):
+            proc = subprocess.run(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            last = proc.stdout
+            if proc.returncode == 0:
+                return 0, last
+        return 1, last
+
+    def is_exist(self, hdfs_path: str) -> bool:
+        rc, _ = self._run(["-test", "-e", hdfs_path], retry_times=1)
+        return rc == 0
+
+    def is_dir(self, hdfs_path: str) -> bool:
+        rc, _ = self._run(["-test", "-d", hdfs_path], retry_times=1)
+        return rc == 0
+
+    def delete(self, hdfs_path: str) -> bool:
+        rc, _ = self._run(["-rm", "-r", "-skipTrash", hdfs_path])
+        return rc == 0
+
+    def rename(self, src: str, dst: str, overwrite: bool = False) -> bool:
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        rc, _ = self._run(["-mv", src, dst])
+        return rc == 0
+
+    def makedirs(self, hdfs_path: str) -> bool:
+        rc, _ = self._run(["-mkdir", "-p", hdfs_path])
+        return rc == 0
+
+    def ls(self, hdfs_path: str) -> List[str]:
+        rc, out = self._run(["-ls", hdfs_path])
+        if rc != 0:
+            return []
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def lsr(self, hdfs_path: str) -> List[str]:
+        """Recursive listing of FILES only (directory rows start with a
+        'd' permission flag and would -get recursively if kept)."""
+        rc, out = self._run(["-ls", "-R", hdfs_path])
+        if rc != 0:
+            return []
+        files = []
+        for ln in out.splitlines():
+            parts = ln.split()
+            if len(parts) >= 8 and not parts[0].startswith("d"):
+                files.append(parts[-1])
+        return files
+
+    def upload(self, hdfs_path: str, local_path: str,
+               overwrite: bool = False, retry_times: int = 5) -> bool:
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        rc, _ = self._run(["-put", local_path, hdfs_path], retry_times)
+        return rc == 0
+
+    def download(self, hdfs_path: str, local_path: str,
+                 overwrite: bool = False, unzip: bool = False) -> bool:
+        if overwrite and os.path.exists(local_path):
+            if os.path.isdir(local_path):
+                shutil.rmtree(local_path)
+            else:
+                os.remove(local_path)
+        rc, _ = self._run(["-get", hdfs_path, local_path])
+        return rc == 0
+
+
+def multi_download(client: HDFSClient, hdfs_path: str, local_path: str,
+                   trainer_id: int, trainers: int,
+                   multi_processes: int = 5) -> List[str]:
+    """Download this trainer's shard of the files under hdfs_path
+    (reference: hdfs_utils.py multi_download — file i goes to trainer
+    i % trainers), using a small process pool."""
+    from multiprocessing.pool import ThreadPool
+
+    files = client.lsr(hdfs_path)
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+    prefix = hdfs_path.rstrip("/") + "/"
+
+    def fetch(f):
+        # keep the sub-directory structure: same-named files in different
+        # dirs must not collapse onto one basename
+        rel = f[len(prefix):] if f.startswith(prefix) else os.path.basename(f)
+        dst = os.path.join(local_path, rel)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        client.download(f, dst)
+        return dst
+
+    with ThreadPool(max(1, multi_processes)) as pool:
+        return list(pool.map(fetch, mine))
+
+
+def multi_upload(client: HDFSClient, hdfs_path: str, local_path: str,
+                 multi_processes: int = 5, overwrite: bool = False):
+    """Upload every file under local_path with a small process pool."""
+    from multiprocessing.pool import ThreadPool
+
+    todo = []
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            todo.append(os.path.join(root, n))
+    client.makedirs(hdfs_path)
+
+    def put(f):
+        rel = os.path.relpath(f, local_path)  # preserve sub-dirs (shards!)
+        dst = os.path.join(hdfs_path, rel)
+        d = os.path.dirname(dst)
+        if d and d != hdfs_path:
+            client.makedirs(d)
+        client.upload(dst, f, overwrite=overwrite)
+
+    with ThreadPool(max(1, multi_processes)) as pool:
+        list(pool.map(put, todo))

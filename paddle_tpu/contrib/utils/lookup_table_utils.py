@@ -1,0 +1,100 @@
+"""Distributed lookup-table program surgery + checkpoint loading
+(reference: python/paddle/fluid/contrib/utils/lookup_table_utils.py —
+convert_dist_to_sparse_program rewrites the pserver-prefetch lookup into
+lookup_sparse_table for single-machine incremental training;
+load_persistable_vars restores a trained model whose embedding lives in
+per-pserver shard files).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ... import io as fluid_io
+from ...core.framework import Program
+
+__all__ = [
+    "convert_dist_to_sparse_program",
+    "load_persistables_for_increment",
+    "load_persistables_for_inference",
+]
+
+_LOOKUP = "lookup_table"
+
+
+def convert_dist_to_sparse_program(program: Program) -> Program:
+    """Clone the program with every distributed lookup_table rewritten to
+    the auto-growth lookup_sparse_table op, so a model trained against a
+    parameter server keeps training on one machine without materializing
+    the dense vocab (reference: lookup_table_utils.py:83)."""
+    out = program.clone()
+    block = out.global_block().desc
+    changed = False
+    for op in block.ops:
+        if op.type == _LOOKUP and op.attr("is_distributed", False):
+            op.type = "lookup_sparse_table"
+            op.attrs["is_distributed"] = False
+            op.attrs.setdefault("auto_grown_table", True)
+            changed = True
+    if not changed:
+        raise ValueError(
+            "no distributed lookup_table op in the program; nothing to "
+            "convert (mark the embedding with is_distributed=True)"
+        )
+    out.desc.bump()
+    return out
+
+
+def _load_table_shards(executor, dirname: str, table_name: str,
+                       program: Program) -> None:
+    """Concatenate per-pserver table shard files `<table>.block<N>` into
+    the scope var (reference: _load_lookup_table_vars — each pserver saved
+    its slice; reassembly is row-order concat)."""
+    import numpy as np
+
+    from ...core.scope import global_scope
+
+    def block_no(fname: str) -> int:
+        stem = fname[:-4] if fname.endswith(".npy") else fname
+        return int(stem.rsplit("block", 1)[-1]) if "block" in stem else -1
+
+    shards = sorted(
+        (f for f in os.listdir(dirname)
+         if f in (table_name, table_name + ".npy")
+         or f.startswith(table_name + ".block")),
+        key=block_no,
+    )
+    if not shards:
+        raise FileNotFoundError(
+            f"no shard files for table '{table_name}' under {dirname!r}"
+        )
+    parts = [np.load(os.path.join(dirname, f), allow_pickle=False)
+             for f in shards]
+    global_scope().set_var(table_name, np.concatenate(parts, axis=0))
+
+
+def load_persistables_for_increment(dirname: str, executor, program: Program,
+                                    lookup_table_var,
+                                    lookup_table_var_path: Optional[str] = None):
+    """Load a dist-trained checkpoint to continue training locally: dense
+    persistables via the normal loader, the big table from its shard files
+    (reference: lookup_table_utils.py load_persistables_for_increment)."""
+    table_name = (lookup_table_var if isinstance(lookup_table_var, str)
+                  else lookup_table_var.name)
+    fluid_io.load_vars(
+        executor, dirname, main_program=program,
+        predicate=lambda v: fluid_io.is_persistable(v)
+        and v.name != table_name,
+    )
+    _load_table_shards(executor, lookup_table_var_path or dirname,
+                       table_name, program)
+
+
+def load_persistables_for_inference(dirname: str, executor, program: Program,
+                                    lookup_table_var_name: str):
+    """Same reassembly for an inference program
+    (reference: lookup_table_utils.py load_persistables_for_inference)."""
+    load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var_name)
+    return program

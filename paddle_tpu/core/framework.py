@@ -46,6 +46,7 @@ __all__ = [
     "switch_main_program",
     "switch_startup_program",
     "unique_name",
+    "unique_name_guard",
     "grad_var_name",
 ]
 
@@ -68,6 +69,21 @@ _name_generator = _UniqueNameGenerator()
 
 def unique_name(key: str) -> str:
     return _name_generator(key)
+
+
+@contextlib.contextmanager
+def unique_name_guard():
+    """Fresh name counters inside the context
+    (reference: unique_name.py guard) — two programs built under separate
+    guards get identical auto-generated parameter names, which is what
+    lets an inference program reload a training program's checkpoint."""
+    global _name_generator
+    saved = _name_generator
+    _name_generator = _UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        _name_generator = saved
 
 
 def grad_var_name(name: str) -> str:

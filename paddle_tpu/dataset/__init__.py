@@ -1,9 +1,10 @@
 """Built-in datasets (reference: python/paddle/dataset/).
 
 All modules fall back to deterministic synthetic corpora with the real
-schema when the cache has no real data — see common.py.  Inventory parity:
-mnist, cifar, uci_housing, imdb, imikolov, wmt16 (+ movielens, conll05,
-wmt14, flowers as synthetic schemas).
+schema when the cache has no real data — see common.py.  Inventory parity
+with the reference package: mnist, cifar, flowers, imdb, imikolov,
+movielens, mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16, conll05,
+plus the image preprocessing helpers.
 """
 
 from . import (  # noqa: F401
@@ -11,16 +12,21 @@ from . import (  # noqa: F401
     common,
     conll05,
     flowers,
+    image,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
+    sentiment,
     uci_housing,
+    voc2012,
     wmt14,
     wmt16,
 )
 
 __all__ = [
     "mnist", "cifar", "uci_housing", "imdb", "imikolov", "wmt14", "wmt16",
-    "movielens", "conll05", "flowers", "common",
+    "movielens", "conll05", "flowers", "mq2007", "sentiment", "voc2012",
+    "image", "common",
 ]

@@ -1,0 +1,189 @@
+"""Image preprocessing utilities
+(reference: python/paddle/dataset/image.py — cv2-backed load / resize /
+crop / flip / transform helpers feeding the vision configs).
+
+Pure-numpy implementations (bilinear resize, HWC<->CHW, crops, flips) so
+no cv2 dependency; images are float32/uint8 HWC arrays.  cv2, when
+installed, is used only for decoding compressed files in load_image.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "load_image", "load_image_bytes", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def _resize_bilinear(im: np.ndarray, h: int, w: int) -> np.ndarray:
+    """[H,W,C] or [H,W] bilinear resize, numpy only."""
+    in_h, in_w = im.shape[:2]
+    if (in_h, in_w) == (h, w):
+        return im
+    ys = (np.arange(h) + 0.5) * in_h / h - 0.5
+    xs = (np.arange(w) + 0.5) * in_w / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, in_h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, in_w - 1)
+    y1 = np.clip(y0 + 1, 0, in_h - 1)
+    x1 = np.clip(x0 + 1, 0, in_w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)
+    wx = np.clip(xs - x0, 0.0, 1.0)
+    im_f = im.astype(np.float32)
+    top = (im_f[y0][:, x0] * (1 - wx)[None, :, None]
+           + im_f[y0][:, x1] * wx[None, :, None]) \
+        if im.ndim == 3 else (im_f[y0][:, x0] * (1 - wx)
+                              + im_f[y0][:, x1] * wx)
+    bot = (im_f[y1][:, x0] * (1 - wx)[None, :, None]
+           + im_f[y1][:, x1] * wx[None, :, None]) \
+        if im.ndim == 3 else (im_f[y1][:, x0] * (1 - wx)
+                              + im_f[y1][:, x1] * wx)
+    wy_b = wy[:, None, None] if im.ndim == 3 else wy[:, None]
+    out = top * (1 - wy_b) + bot * wy_b
+    if im.dtype == np.uint8:
+        out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return out
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode an encoded image from bytes (needs cv2); .npy bytes decode
+    without it."""
+    if data[:6] == b"\x93NUMPY":
+        import io
+
+        im = np.load(io.BytesIO(data), allow_pickle=False)
+    else:
+        try:
+            import cv2  # gated: not in the base environment
+        except ImportError as e:
+            raise ImportError(
+                "decoding compressed images needs cv2; store .npy arrays "
+                "or install opencv"
+            ) from e
+        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+        im = cv2.imdecode(np.frombuffer(data, dtype="uint8"), flag)
+    return _color_convert(im, is_color)
+
+
+def _color_convert(im: np.ndarray, is_color: bool) -> np.ndarray:
+    if is_color and im.ndim == 2:
+        im = np.repeat(im[:, :, None], 3, axis=2)
+    if not is_color and im.ndim == 3:
+        im = im.mean(axis=2).astype(im.dtype)
+    return im
+
+
+def load_image(file: str, is_color: bool = True) -> np.ndarray:
+    """Load an image file as HWC (color) or HW (gray).  .npy loads
+    directly; compressed formats go through cv2 when available."""
+    if file.endswith(".npy"):
+        return _color_convert(np.load(file), is_color)
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the shorter edge equals `size`, keeping aspect ratio."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize_bilinear(im, size, max(1, int(round(w * size / h))))
+    return _resize_bilinear(im, max(1, int(round(h * size / w))), size)
+
+
+def to_chw(im: np.ndarray, order: Sequence[int] = (2, 0, 1)) -> np.ndarray:
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h0, w0 = (h - size) // 2, (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, h - size + 1)
+    w0 = np.random.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray, is_color: bool = True) -> np.ndarray:
+    return im[:, ::-1, :] if (is_color and im.ndim == 3) else im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean=None) -> np.ndarray:
+    """resize_short -> (random|center) crop -> (train) random flip ->
+    CHW float32 -> mean subtract (reference: image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True,
+                       mean=None) -> np.ndarray:
+    return simple_transform(
+        load_image(filename, is_color), resize_size, crop_size, is_train,
+        is_color, mean,
+    )
+
+
+def batch_images_from_tar(data_file: str, dataset_name: str,
+                          img2label: dict, num_per_batch: int = 1024) -> str:
+    """Pre-batch a tar of images into pickled (data, label) batches
+    (reference: image.py batch_images_from_tar); returns the batch-list
+    file path."""
+    import pickle
+
+    out_path = data_file + "_batch"
+    meta_file = os.path.join(out_path, "batch_names.txt")
+    if os.path.exists(meta_file):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, names = [], [], []
+    n = 0
+    with tarfile.open(data_file) as tf:
+        for member in tf.getmembers():
+            if member.name not in img2label:
+                continue
+            f = tf.extractfile(member)
+            data.append(f.read())
+            labels.append(img2label[member.name])
+            if len(data) == num_per_batch:
+                name = os.path.join(out_path, f"batch_{n}")
+                with open(name, "wb") as out:
+                    pickle.dump({"data": data, "label": labels}, out,
+                                protocol=2)
+                names.append(name)
+                data, labels = [], []
+                n += 1
+    if data:
+        name = os.path.join(out_path, f"batch_{n}")
+        with open(name, "wb") as out:
+            pickle.dump({"data": data, "label": labels}, out, protocol=2)
+        names.append(name)
+    with open(meta_file, "w") as f:
+        f.write("\n".join(names) + "\n")
+    return meta_file

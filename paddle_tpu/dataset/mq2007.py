@@ -1,0 +1,221 @@
+"""MQ2007 learning-to-rank set (LETOR 4.0)
+(reference: python/paddle/dataset/mq2007.py — parses the LETOR text format
+into per-query lists and yields them in pointwise / pairwise / listwise
+form).
+
+The parser and Query/QueryList structures mirror the reference contract;
+zero-egress, the corpus itself is a deterministic synthetic LETOR file
+written into the cache dir (so the real text parser is exercised), with
+46 features and {0,1,2} relevance like the original.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import total_ordering
+from typing import List, Optional
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "Query", "QueryList",
+           "gen_point", "gen_pair", "gen_list", "gen_plain_txt"]
+
+FEATURE_DIM = 46
+N_QUERIES_TRAIN = 120
+N_QUERIES_TEST = 40
+
+
+@total_ordering
+class Query:
+    """One judged document: relevance, query id, 46 features
+    (reference: mq2007.py Query — parses 'rel qid:N 1:f ... #docid = D')."""
+
+    def __init__(self, query_id: int = -1, relevance_score: int = -1,
+                 feature_vector: Optional[List[float]] = None,
+                 description: str = ""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector or []
+        self.description = description
+
+    def __str__(self):
+        feats = " ".join(
+            f"{i + 1}:{f}" for i, f in enumerate(self.feature_vector)
+        )
+        return f"{self.relevance_score} qid:{self.query_id} {feats}"
+
+    __repr__ = __str__
+
+    def __eq__(self, other):
+        return self.relevance_score == other.relevance_score
+
+    def __lt__(self, other):
+        return self.relevance_score < other.relevance_score
+
+    @classmethod
+    def _parse_one_line(cls, line: str, fill_missing: float = -1.0):
+        comment = ""
+        if "#" in line:
+            line, comment = line.split("#", 1)
+        toks = line.split()
+        rel = int(toks[0])
+        qid = int(toks[1].split(":")[1])
+        feats = [fill_missing] * FEATURE_DIM
+        for t in toks[2:]:
+            idx, val = t.split(":")
+            feats[int(idx) - 1] = float(val)
+        return cls(qid, rel, feats, comment.strip())
+
+
+class QueryList:
+    """All judged documents of one query (reference: mq2007.py QueryList)."""
+
+    def __init__(self, querylist: Optional[List[Query]] = None):
+        self.querylist = querylist or []
+        self.query_id = self.querylist[0].query_id if self.querylist else -1
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda q: q.relevance_score, reverse=True)
+
+    def _add_query(self, query: Query):
+        if self.query_id == -1:
+            self.query_id = query.query_id
+        elif query.query_id != self.query_id:
+            raise ValueError(
+                f"query id mismatch: {query.query_id} vs {self.query_id}"
+            )
+        self.querylist.append(query)
+
+
+# -- generators over one QueryList (reference API) ----------------------
+def gen_plain_txt(querylist):
+    """yield (query_id, relevance, feature_vector) per doc."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield querylist.query_id, q.relevance_score, np.array(
+            q.feature_vector)
+
+
+def gen_point(querylist):
+    """pointwise: yield (relevance, feature_vector) per doc."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    for q in querylist:
+        yield q.relevance_score, np.array(q.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """pairwise: yield (label=1, better_doc, worse_doc) for each ordered
+    pair with distinct relevance (reference emits label 1 with the higher
+    doc first)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    qs = sorted(querylist, key=lambda q: q.relevance_score, reverse=True)
+    for i, hi in enumerate(qs):
+        for lo in qs[i + 1:]:
+            if hi.relevance_score > lo.relevance_score:
+                yield (np.array([1.0]), np.array(hi.feature_vector),
+                       np.array(lo.feature_vector))
+                if partial_order != "full":
+                    break  # one pair per doc — but only once one exists
+
+
+def gen_list(querylist):
+    """listwise: yield (relevance array, feature matrix) per query."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    rels = np.array([q.relevance_score for q in querylist])
+    feats = np.array([q.feature_vector for q in querylist])
+    yield rels, feats
+
+
+def query_filter(querylists):
+    """Drop queries where every judgment is identical — no ranking signal
+    (reference: mq2007.py query_filter)."""
+    out = []
+    for ql in querylists:
+        rels = {q.relevance_score for q in ql}
+        if len(rels) > 1:
+            out.append(ql)
+    return out
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1.0):
+    """Parse a LETOR text file into QueryLists."""
+    by_qid = {}
+    order = []
+    with open(filepath) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            q = Query._parse_one_line(line, fill_missing)
+            if q.query_id not in by_qid:
+                by_qid[q.query_id] = QueryList()
+                order.append(q.query_id)
+            by_qid[q.query_id]._add_query(q)
+    return [by_qid[qid] for qid in order]
+
+
+def _synthesize(split: str, n_queries: int) -> str:
+    """Write a deterministic LETOR-format file into the cache dir; the
+    relevance is a noisy linear function of the features so rankers can
+    learn."""
+    path = common.data_path("mq2007", f"{split}.txt")
+    if not os.path.exists(path):
+        rng = common.synthetic_rng("mq2007", split)
+        w = np.linspace(-1, 1, FEATURE_DIM)
+        with open(path, "w") as f:
+            for qid in range(1, n_queries + 1):
+                n_docs = int(rng.randint(5, 20))
+                for d in range(n_docs):
+                    x = rng.rand(FEATURE_DIM)
+                    score = float(x @ w) + rng.randn() * 0.1
+                    rel = int(np.clip(np.floor((score + 1.5) / 1.0), 0, 2))
+                    feats = " ".join(
+                        f"{i + 1}:{x[i]:.6f}" for i in range(FEATURE_DIM)
+                    )
+                    f.write(
+                        f"{rel} qid:{qid} {feats} #docid = "
+                        f"GX-{qid:03d}-{d:02d}\n"
+                    )
+    return path
+
+
+def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1.0):
+    querylists = query_filter(
+        load_from_text(filepath, shuffle=shuffle, fill_missing=fill_missing)
+    )
+    for ql in querylists:
+        if format == "plain_txt":
+            yield from gen_plain_txt(ql)
+        elif format == "pointwise":
+            yield from gen_point(ql)
+        elif format == "pairwise":
+            yield from gen_pair(ql)
+        elif format == "listwise":
+            yield from gen_list(ql)
+        else:
+            raise ValueError(f"unknown format {format!r}")
+
+
+def train(format="pairwise", shuffle=False, fill_missing=-1.0):
+    path = _synthesize("train", N_QUERIES_TRAIN)
+    return lambda: __reader__(path, format, shuffle, fill_missing)
+
+
+def test(format="pairwise", shuffle=False, fill_missing=-1.0):
+    path = _synthesize("test", N_QUERIES_TEST)
+    return lambda: __reader__(path, format, shuffle, fill_missing)

@@ -1,0 +1,60 @@
+"""Movie-review sentiment corpus
+(reference: python/paddle/dataset/sentiment.py over NLTK movie_reviews:
+get_word_dict() builds a frequency-sorted vocab, train/test yield
+(word-id list, 0/1 polarity)).
+
+Zero-egress: a deterministic synthetic corpus with the real schema — a
+frequency-ranked word dict and variable-length id sequences whose word
+distribution differs by polarity (so models can actually learn).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_word_dict"]
+
+VOCAB_SIZE = 5147  # reference vocab is movie_reviews-derived; fixed here
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_word_dict = None
+
+
+def get_word_dict():
+    """word -> id, ids assigned by descending corpus frequency
+    (reference: sentiment.py get_word_dict)."""
+    global _word_dict
+    if _word_dict is None:
+        _word_dict = {f"w{i:05d}": i for i in range(VOCAB_SIZE)}
+    return _word_dict
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("sentiment", split)
+        # Zipf-ish draw; polarity shifts the head of the distribution
+        base = 1.0 / (np.arange(1, VOCAB_SIZE + 1) ** 1.1)
+        for _ in range(size):
+            label = int(rng.randint(2))
+            p = base.copy()
+            # positive docs over-sample one band of words, negative another
+            band = slice(100, 400) if label else slice(400, 700)
+            p[band] *= 8.0
+            p /= p.sum()
+            n = int(rng.randint(20, 200))
+            words = rng.choice(VOCAB_SIZE, size=n, p=p).astype(np.int64)
+            yield list(map(int, words)), label
+
+    return reader
+
+
+def train():
+    """reader: (word-id list, label in {0,1})."""
+    return _synthetic("train", NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _synthetic("test", NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -1,0 +1,62 @@
+"""Pascal VOC2012 segmentation set
+(reference: python/paddle/dataset/voc2012.py — train/test/val readers over
+the VOCtrainval tarball, yielding (image, segmentation label) pairs).
+
+Zero-egress: yields a deterministic synthetic corpus with the real schema —
+RGB image float32 [3, H, W] and label int32 [H, W] with the 21 VOC classes
+(0 = background, 255 = void border) — unless real data is present under
+PADDLE_TPU_DATA_HOME (see dataset/common.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+N_CLASSES = 21
+VOID = 255
+TRAIN_SIZE = 128
+TEST_SIZE = 32
+VAL_SIZE = 32
+
+
+def _synthetic(split, size):
+    def reader():
+        rng = common.synthetic_rng("voc2012", split)
+        for _ in range(size):
+            h = int(rng.choice([96, 128, 160]))
+            w = int(rng.choice([96, 128, 160]))
+            label = np.zeros((h, w), dtype=np.int32)
+            img = rng.rand(3, h, w).astype(np.float32) * 0.1
+            # a few rectangular "objects", each a class with a void border
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, N_CLASSES))
+                y0, x0 = int(rng.randint(h // 2)), int(rng.randint(w // 2))
+                y1 = y0 + int(rng.randint(8, h - y0))
+                x1 = x0 + int(rng.randint(8, w - x0))
+                label[y0:y1, x0:x1] = cls
+                if y1 - y0 > 4 and x1 - x0 > 4:
+                    label[y0, x0:x1] = VOID
+                    label[y1 - 1, x0:x1] = VOID
+                img[:, y0:y1, x0:x1] += (
+                    rng.rand(3, 1, 1).astype(np.float32) * 0.8
+                )
+            yield img, label
+
+    return reader
+
+
+def train():
+    """reader: (image float32 [3,H,W], label int32 [H,W])."""
+    return _synthetic("train", TRAIN_SIZE)
+
+
+def test():
+    return _synthetic("test", TEST_SIZE)
+
+
+def val():
+    return _synthetic("val", VAL_SIZE)

@@ -163,6 +163,9 @@ class MasterService:
         )
         t.daemon = True
         t.start()
+        # prune fired timers so a long job doesn't accumulate one dead
+        # Timer object per lease
+        self._timers = [x for x in self._timers if x.is_alive()]
         self._timers.append(t)
 
     def _check_timeout(self, task_id: int, epoch: int) -> None:
@@ -225,11 +228,7 @@ class MasterService:
                 return  # stale report (already timed out and re-queued)
             entry.num_failure = 0
             st.done.append(entry)
-            if not st.todo and not st.pending:
-                st.cur_pass += 1
-                st.todo = st.done + st.failed
-                st.done = []
-                st.failed = []
+            self._maybe_rollover_locked()
             self._snapshot_locked()
 
     def task_failed(self, task_id: int, epoch: int) -> None:
@@ -248,9 +247,25 @@ class MasterService:
         entry.num_failure += 1
         if entry.num_failure > self.failure_max:
             self._state.failed.append(entry)
+            # the discarded task may have been the last outstanding work of
+            # this pass — roll over, or workers idle-loop forever
+            self._maybe_rollover_locked()
         else:
             self._state.todo.append(entry)
         self._snapshot_locked()
+
+    def _maybe_rollover_locked(self) -> None:
+        """Advance the pass when nothing is left to lease or report; failed
+        tasks get another shot next pass (service.go TaskFinished :438).
+        If *everything* failed, leave the state as-is so get_task raises
+        AllTasksFailedError instead of silently spinning passes."""
+        st = self._state
+        if st.todo or st.pending or not st.done:
+            return
+        st.cur_pass += 1
+        st.todo = st.done + st.failed
+        st.done = []
+        st.failed = []
 
     # -- liveness ------------------------------------------------------
     def heartbeat(self, worker_id: str) -> None:

@@ -147,11 +147,14 @@ class ElasticTrainer:
                 # instead of waiting for the lease to expire
                 self.master.task_failed(task.id, task.epoch)
                 raise
-            self.master.task_finished(task.id)
+            # checkpoint BEFORE reporting: a crash between the two means the
+            # lease expires and the task re-runs (at-least-once); the other
+            # order would mark it done with its updates lost
             self.tasks_done += 1
+            self._checkpoint()
+            self.master.task_finished(task.id)
             self.master.heartbeat(self.worker_id)
             # master may have rolled the pass on our report
             cur = self.master.counts()["cur_pass"]
             if cur > self.pass_id:
                 self.pass_id = cur
-            self._checkpoint()

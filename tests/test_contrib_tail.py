@@ -1,0 +1,352 @@
+"""Dataset tail (voc2012/sentiment/mq2007/image) + contrib tail
+(op_frequence, ctr_reader, Trainer/Inferencer, lookup_table_utils,
+StateCell/TrainingDecoder/BeamSearchDecoder)
+(reference: python/paddle/dataset/tests, contrib/tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+# -- datasets ----------------------------------------------------------
+def test_voc2012_schema():
+    from paddle_tpu.dataset import voc2012
+
+    img, label = next(voc2012.train()())
+    assert img.dtype == np.float32 and img.ndim == 3 and img.shape[0] == 3
+    assert label.dtype == np.int32 and label.shape == img.shape[1:]
+    classes = set(np.unique(label)) - {255}
+    assert classes <= set(range(21))
+
+
+def test_sentiment_schema_and_signal():
+    from paddle_tpu.dataset import sentiment
+
+    wd = sentiment.get_word_dict()
+    assert len(wd) == sentiment.VOCAB_SIZE
+    pos_hits = neg_hits = 0
+    for words, label in list(sentiment.train()())[:200]:
+        assert all(0 <= w < sentiment.VOCAB_SIZE for w in words)
+        band = np.sum([100 <= w < 400 for w in words])
+        if label == 1:
+            pos_hits += band
+        else:
+            neg_hits += band
+    assert pos_hits > neg_hits  # the polarity signal exists
+
+
+def test_mq2007_formats():
+    from paddle_tpu.dataset import mq2007
+
+    rel, feat = next(mq2007.train(format="pointwise")())
+    assert feat.shape == (mq2007.FEATURE_DIM,) and rel in (0, 1, 2)
+
+    label, hi, lo = next(mq2007.train(format="pairwise")())
+    assert label == 1.0 and hi.shape == lo.shape == (mq2007.FEATURE_DIM,)
+
+    rels, feats = next(mq2007.train(format="listwise")())
+    assert feats.shape == (len(rels), mq2007.FEATURE_DIM)
+
+    qid, rel, feat = next(mq2007.train(format="plain_txt")())
+    assert isinstance(qid, int)
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image as img_util
+
+    im = (np.random.RandomState(0).rand(48, 64, 3) * 255).astype(np.uint8)
+    r = img_util.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[2] == 3
+    c = img_util.center_crop(r, 24)
+    assert c.shape[:2] == (24, 24)
+    f = img_util.left_right_flip(c)
+    np.testing.assert_array_equal(f, c[:, ::-1, :])
+    out = img_util.simple_transform(im, 36, 24, is_train=False,
+                                    mean=[127.0, 127.0, 127.0])
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    # .npy round trip through load_image
+    import tempfile
+
+    p = os.path.join(tempfile.mkdtemp(), "im.npy")
+    np.save(p, im)
+    np.testing.assert_array_equal(img_util.load_image(p), im)
+
+
+# -- op census ---------------------------------------------------------
+def test_op_freq_statistic():
+    fluid.reset_default_env()
+    x = layers.data("x", [4])
+    h = layers.fc(x, 8, act="relu")
+    out = layers.fc(h, 1)
+    loss = layers.reduce_mean(layers.square(out))
+    uni, adj = fluid.contrib.op_freq_statistic(fluid.default_main_program())
+    assert uni["mul"] == 2  # two fc layers
+    assert any(k.startswith("relu,") or k.endswith(",relu") for k in adj)
+
+
+# -- ctr_reader --------------------------------------------------------
+def test_ctr_reader_feeds_program(tmp_path):
+    from paddle_tpu.contrib.reader import ctr_reader
+
+    fluid.reset_default_env()
+    rng = np.random.RandomState(0)
+    files = []
+    for fi in range(2):
+        p = str(tmp_path / f"ctr{fi}.txt")
+        with open(p, "w") as f:
+            for _ in range(40):
+                sid = rng.randint(50)
+                f.write(f"{rng.randint(2)} slot_a:{sid} "
+                        f"slot_b:{rng.randint(50)}\n")
+        files.append(p)
+
+    label = layers.data("label", [1], dtype="int64")
+    a = layers.data("a_ids", [1], dtype="int64", lod_level=1)
+    b = layers.data("b_ids", [1], dtype="int64", lod_level=1)
+    reader = ctr_reader(
+        feed_data=[label, a, b], capacity=8, thread_num=2, batch_size=10,
+        file_list=files, slots=["slot_a", "slot_b"],
+    )
+    emb_a = layers.embedding(a, size=[50, 8])
+    emb_b = layers.embedding(b, size=[50, 8])
+    feat = layers.concat(
+        [layers.sequence_pool(emb_a, "sum"),
+         layers.sequence_pool(emb_b, "sum")], axis=1)
+    pred = layers.fc(feat, 1)
+    loss = layers.reduce_mean(layers.square(pred))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    n = 0
+    while True:
+        try:
+            exe.run(feed=None, fetch_list=[loss])
+            n += 1
+        except fluid.core.EOFException:
+            reader.reset()
+            break
+    assert n == 8  # 2 files x 40 lines / batch 10
+
+
+# -- Trainer / Inferencer ---------------------------------------------
+def _reg_train_func():
+    x = layers.data("x", [1], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    return [loss]
+
+
+def _reg_infer_func():
+    x = layers.data("x", [1], dtype="float32")
+    return layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+
+
+def _reg_reader():
+    rng = np.random.RandomState(0)
+    for _ in range(12):
+        xb = rng.uniform(-1, 1, (16, 1)).astype(np.float32)
+        yield [(xb[i], 2.0 * xb[i] + 1.0) for i in range(16)]
+
+
+def test_trainer_and_inferencer(tmp_path):
+    from paddle_tpu.contrib import (
+        BeginEpochEvent, CheckpointConfig, EndStepEvent, Inferencer, Trainer,
+    )
+
+    fluid.reset_default_env()
+    events = {"epochs": 0, "losses": []}
+
+    def handler(ev):
+        if isinstance(ev, BeginEpochEvent):
+            events["epochs"] += 1
+        elif isinstance(ev, EndStepEvent):
+            events["losses"].append(float(np.ravel(
+                np.asarray(ev.metrics[0]))[0]))
+
+    ckpt = CheckpointConfig(str(tmp_path / "tck"), step_interval=5)
+    trainer = Trainer(
+        train_func=_reg_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.3),
+        place=fluid.CPUPlace(), checkpoint_config=ckpt,
+    )
+    trainer.train(num_epochs=3, event_handler=handler, reader=_reg_reader,
+                  feed_order=["x", "y"])
+    assert events["epochs"] == 3
+    assert events["losses"][-1] < events["losses"][0] * 0.1
+    # checkpoints exist with success markers
+    serials = [n for n in os.listdir(str(tmp_path / "tck")) if n.isdigit()]
+    assert serials
+
+    test_metrics = trainer.test(reader=_reg_reader, feed_order=["x", "y"])
+    assert test_metrics[0] < 0.05
+
+    params = str(tmp_path / "params")
+    trainer.save_params(params)
+
+    inf = Inferencer(_reg_infer_func, params, place=fluid.CPUPlace())
+    out = inf.infer({"x": np.array([[0.5]], dtype=np.float32)})
+    assert abs(float(np.ravel(np.asarray(out[0]))[0]) - 2.0) < 0.3
+
+    # a fresh Trainer resumes epoch counter from the checkpoint
+    fluid.reset_default_env()
+    t2 = Trainer(
+        train_func=_reg_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.3),
+        place=fluid.CPUPlace(),
+        checkpoint_config=CheckpointConfig(str(tmp_path / "tck")),
+    )
+    assert t2.checkpoint_cfg.epoch_id == 2
+
+
+# -- lookup_table_utils ------------------------------------------------
+def test_lookup_table_utils(tmp_path):
+    from paddle_tpu.contrib.utils import (
+        convert_dist_to_sparse_program,
+        load_persistables_for_increment,
+    )
+
+    fluid.reset_default_env()
+    ids = layers.data("ids", [1], dtype="int64")
+    emb = layers.embedding(ids, size=[40, 4], is_distributed=True,
+                           param_attr=fluid.ParamAttr(name="big_table"))
+    pred = layers.fc(emb, 1, param_attr=fluid.ParamAttr(name="w1"))
+    loss = layers.reduce_mean(layers.square(pred))
+
+    prog = fluid.default_main_program()
+    sparse = convert_dist_to_sparse_program(prog)
+    types = [op.type for op in sparse.global_block().desc.ops]
+    assert "lookup_sparse_table" in types and "lookup_table" not in types
+
+    # shard reassembly: table saved as two row-slices
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "inc")
+    os.makedirs(d)
+    full = np.arange(160, dtype=np.float32).reshape(40, 4)
+    np.save(os.path.join(d, "big_table.block0.npy"), full[:25])
+    np.save(os.path.join(d, "big_table.block1.npy"), full[25:])
+    # dense persistables saved the normal way (pserver path: table rides
+    # shard files, everything else a regular checkpoint)
+    fluid.io.save_vars(
+        exe, d, main_program=prog,
+        predicate=lambda v: fluid.io.is_persistable(v)
+        and v.name != "big_table",
+    )
+    load_persistables_for_increment(d, exe, prog, "big_table")
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_var("big_table")), full
+    )
+
+
+# -- StateCell / decoders ----------------------------------------------
+V, EMB, HID, END = 12, 8, 16, 1
+
+
+def _build_state_cell():
+    from paddle_tpu.contrib.decoder import InitState, StateCell
+
+    enc_final = layers.data("enc_final", [HID], dtype="float32")
+    h_init = InitState(init=enc_final)
+    cell = StateCell(
+        inputs={"x": None}, states={"h": h_init}, out_state="h"
+    )
+
+    @cell.state_updater
+    def updater(state_cell):
+        x = state_cell.get_input("x")
+        h = state_cell.get_state("h")
+        new_h = layers.fc(
+            layers.concat([x, h], axis=1), size=HID, act="tanh",
+            param_attr=fluid.ParamAttr(name="cell_w"),
+            bias_attr=fluid.ParamAttr(name="cell_b"),
+        )
+        state_cell.set_state("h", new_h)
+
+    return cell
+
+
+def test_training_decoder_trains():
+    from paddle_tpu.contrib.decoder import TrainingDecoder
+
+    fluid.reset_default_env()
+    cell = _build_state_cell()
+    trg = layers.data("trg", [1], dtype="int64", lod_level=1)
+    trg_emb = layers.embedding(trg, size=[V, EMB],
+                               param_attr=fluid.ParamAttr(name="trg_emb"))
+    decoder = TrainingDecoder(cell)
+    with decoder.block():
+        cur = decoder.step_input(trg_emb)
+        decoder.state_cell.compute_state(inputs={"x": cur})
+        out = layers.fc(decoder.state_cell.out_state(), size=V,
+                        act="softmax",
+                        param_attr=fluid.ParamAttr(name="out_w"))
+        decoder.state_cell.update_states()
+        decoder.output(out)
+    probs = decoder()
+    label = layers.data("label", [1], dtype="int64", lod_level=1)
+    cost = layers.cross_entropy(probs, label)
+    loss = layers.mean(layers.sequence_pool(cost, "sum"))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+
+    def batch():
+        # deterministic task: emit the current input token (learnable to
+        # ~zero loss through the embedding alone; the state just rides)
+        seqs = [rng.randint(2, V, size=(rng.randint(3, 6), 1))
+                for _ in range(8)]
+        return {
+            "trg": fluid.create_lod_tensor([s.astype(np.int64) for s in seqs]),
+            "label": fluid.create_lod_tensor(
+                [s.astype(np.int64) for s in seqs]),
+            "enc_final": rng.randn(8, HID).astype(np.float32) * 0.1,
+        }
+
+    losses = []
+    for i in range(60):
+        (lv,) = exe.run(feed=batch(), fetch_list=[loss])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+    assert last < first * 0.5, f"decoder did not learn: {first} -> {last}"
+
+
+def test_beam_search_decoder_decodes():
+    from paddle_tpu.contrib.decoder import BeamSearchDecoder
+
+    fluid.reset_default_env()
+    BEAM = 2
+    cell = _build_state_cell()
+    init_ids = layers.data("init_ids", [BEAM, 1], append_batch_size=False,
+                           dtype="int64")
+    init_scores = layers.data("init_scores", [BEAM, 1],
+                              append_batch_size=False, dtype="float32")
+    decoder = BeamSearchDecoder(
+        state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+        target_dict_dim=V, word_dim=EMB, topk_size=V, sparse_emb=False,
+        max_len=5, beam_size=2, end_id=END,
+    )
+    decoder.decode()
+    ids, scores = decoder()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {
+        "init_ids": np.full((BEAM, 1), 2, dtype=np.int64),
+        "init_scores": np.array([[0.0], [-1e9]], dtype=np.float32),
+        "enc_final": np.random.RandomState(0).randn(BEAM, HID)
+        .astype(np.float32) * 0.1,
+    }
+    (got_ids,) = exe.run(feed=feed, fetch_list=[ids], return_numpy=False)
+    seqs = np.asarray(got_ids.data)
+    lens = np.asarray(got_ids.lengths)
+    assert seqs.ndim >= 2 and lens.shape[0] == BEAM
+    assert lens.max() <= 5 + 1  # max_len steps (+ possible end token)
+    assert np.all((seqs >= 0) & (seqs < V))

@@ -102,6 +102,22 @@ def test_pass_rollover_and_skew(tmp_path):
     m.shutdown()
 
 
+def test_rollover_when_last_task_fails_permanently(tmp_path):
+    """A permanent failure of the pass's last outstanding task must roll
+    the pass (with the failed task re-queued for the next one) — not
+    strand workers in NoMoreAvailable forever."""
+    _touch(tmp_path, ["f0", "f1"])
+    m = MasterService(InMemStore(), timeout_dur=60, failure_max=0)
+    m.set_dataset([str(tmp_path / "f*")])
+    tA = m.get_task(0)
+    m.task_finished(tA.id)
+    tB = m.get_task(0)
+    m.task_failed(tB.id, tB.epoch)  # failure_max=0 -> discarded
+    c = m.counts()
+    assert c["cur_pass"] == 1 and c["todo"] == 2 and c["failed"] == 0
+    m.shutdown()
+
+
 def test_snapshot_recover_rearms_pending(tmp_path):
     """Kill the master mid-lease; a new master over the same store
     recovers the queue and the leased task times out back to todo
