@@ -99,6 +99,12 @@ class _CTRReader(PyReader):
                     if batch:
                         if not put_checked(q, stop, self._to_feed(batch)):
                             return
+            except BaseException as e:
+                # surface IO/parse errors to the consumer instead of dying
+                # silently into a clean-looking EOF (base PyReader._worker
+                # does the same)
+                if not stop.is_set():
+                    q.put(e)
             finally:
                 with self._pending_lock:
                     self._pending -= 1

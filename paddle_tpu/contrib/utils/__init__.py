@@ -2,7 +2,7 @@
 
 from . import hdfs_utils  # noqa: F401
 from . import lookup_table_utils  # noqa: F401
-from .hdfs_utils import HDFSClient, multi_download
+from .hdfs_utils import HDFSClient, multi_download, multi_upload
 from .lookup_table_utils import (
     convert_dist_to_sparse_program,
     load_persistables_for_increment,
@@ -12,6 +12,7 @@ from .lookup_table_utils import (
 __all__ = [
     "HDFSClient",
     "multi_download",
+    "multi_upload",
     "convert_dist_to_sparse_program",
     "load_persistables_for_increment",
     "load_persistables_for_inference",

@@ -198,6 +198,8 @@ def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1.0):
     querylists = query_filter(
         load_from_text(filepath, shuffle=shuffle, fill_missing=fill_missing)
     )
+    if shuffle:
+        common.synthetic_rng("mq2007", "shuffle").shuffle(querylists)
     for ql in querylists:
         if format == "plain_txt":
             yield from gen_plain_txt(ql)

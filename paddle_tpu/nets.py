@@ -11,9 +11,31 @@ from . import layers
 __all__ = [
     "simple_img_conv_pool",
     "img_conv_group",
+    "sequence_conv_pool",
     "glu",
     "scaled_dot_product_attention",
 ]
+
+
+def sequence_conv_pool(
+    input,
+    num_filters: int,
+    filter_size,
+    param_attr=None,
+    act: str = "sigmoid",
+    pool_type: str = "max",
+):
+    """sequence_conv followed by sequence_pool over a LoD input
+    (reference: nets.py sequence_conv_pool — the text-conv block the
+    sentiment book model uses)."""
+    conv_out = layers.sequence_conv(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        param_attr=param_attr,
+        act=act,
+    )
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
 
 
 def simple_img_conv_pool(

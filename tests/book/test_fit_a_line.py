@@ -1,0 +1,47 @@
+"""fit_a_line: linear regression on uci_housing
+(reference: python/paddle/fluid/tests/book/test_fit_a_line.py — train
+until loss drops, then save_inference_model + reload + infer)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import uci_housing
+
+
+def test_fit_a_line(tmp_path):
+    fluid.reset_default_env()
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = layers.fc(input=x, size=1, act=None)
+    cost = layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+
+    train_reader = fluid.batch(uci_housing.train(), batch_size=20)
+    first = last = None
+    for epoch in range(4):
+        for data in train_reader():
+            (loss_v,) = exe.run(feed=feeder.feed(data),
+                                fetch_list=[avg_cost])
+            last = float(np.ravel(np.asarray(loss_v))[0])
+            if first is None:
+                first = last
+    assert last < first * 0.25, f"{first} -> {last}"
+
+    # inference round trip (reference: save/load_inference_model)
+    path = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(path, ["x"], [y_predict], exe)
+    infer_prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+        path, exe)
+    assert feed_names == ["x"]
+    xb, yb = next(uci_housing.test()())
+    (pred,) = exe.run(program=infer_prog, feed={"x": xb[None, :]},
+                      fetch_list=fetch_targets)
+    assert np.isfinite(float(np.ravel(np.asarray(pred))[0]))

@@ -1,0 +1,48 @@
+"""image_classification: small VGG on cifar10
+(reference: book/test_image_classification.py vgg16_bn_drop on cifar;
+shrunk to one conv group for test budget)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.dataset import cifar
+
+
+def small_vgg(input):
+    g = nets.img_conv_group(
+        input=input, conv_num_filter=[16, 16], pool_size=2,
+        conv_padding=1, conv_filter_size=3, conv_act="relu",
+        conv_with_batchnorm=True, pool_stride=2, pool_type="max")
+    fc1 = layers.fc(input=g, size=64, act=None)
+    bn = layers.batch_norm(input=fc1, act="relu")
+    return layers.fc(input=bn, size=10, act="softmax")
+
+
+def test_image_classification_vgg():
+    fluid.reset_default_env()
+    images = layers.data(name="pixel", shape=[3, 32, 32], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = small_vgg(images)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed(batch):
+        xs = np.stack([s[0].reshape(3, 32, 32) for s in batch])
+        ys = np.array([[s[1]] for s in batch], dtype=np.int64)
+        return {"pixel": xs.astype(np.float32), "label": ys}
+
+    reader = fluid.batch(cifar.train10(), batch_size=32)
+    losses = []
+    for i, data in enumerate(reader()):
+        (loss_v,) = exe.run(feed=feed(data), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(np.asarray(loss_v))[0]))
+        if i >= 25:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
+        f"{np.mean(losses[:5])} -> {np.mean(losses[-5:])}")

@@ -1,0 +1,95 @@
+"""label_semantic_roles: SRL tagger with a linear-chain CRF head on
+conll05 (reference: book/test_label_semantic_roles.py — word+context
+embeddings -> hidden -> linear_chain_crf, decoded with crf_decoding)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import conll05
+
+EMB = 16
+HID = 32
+
+
+def test_label_semantic_roles():
+    fluid.reset_default_env()
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    word_dict_len = len(word_dict)
+    # the reference's BIO tag space is ~60 labels; our synthetic conll05
+    # emits ids over the full label vocab, so fold them into a small tag
+    # space — a [V,V] CRF transition over thousands of tags is not the
+    # book model and only slows the test
+    label_dict_len = 32
+    pred_len = len(verb_dict)
+    PAD_LEN = 40  # fixed padded length: varying batch max would recompile
+
+    word = layers.data(name="word_data", shape=[1], dtype="int64",
+                       lod_level=1)
+    predicate = layers.data(name="verb_data", shape=[1], dtype="int64",
+                            lod_level=1)
+    target = layers.data(name="target", shape=[1], dtype="int64",
+                         lod_level=1)
+
+    word_emb = layers.embedding(word, size=[word_dict_len, EMB])
+    pred_emb = layers.embedding(predicate, size=[pred_len, EMB])
+    feat = layers.concat([word_emb, pred_emb], axis=-1)
+    hidden = layers.fc(feat, size=HID, act="tanh")
+    feature_out = layers.fc(hidden, size=label_dict_len)
+
+    crf_cost = layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = layers.mean(crf_cost)
+    fluid.optimizer.SGD(learning_rate=0.3).minimize(avg_cost)
+
+    crf_decode = layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def lod_fixed(seqs):
+        v = fluid.create_lod_tensor(seqs)
+        data = np.asarray(v.data)
+        if data.shape[1] < PAD_LEN:
+            pad = np.zeros((data.shape[0], PAD_LEN - data.shape[1])
+                           + data.shape[2:], dtype=data.dtype)
+            data = np.concatenate([data, pad], axis=1)
+        return fluid.LoDValue(data, v.lengths)
+
+    def feed(batch):
+        batch = [s for s in batch if len(s[0]) <= PAD_LEN]
+        words = [np.asarray(s[0], dtype=np.int64)[:, None] for s in batch]
+        verbs = [np.asarray(s[6], dtype=np.int64)[:, None] for s in batch]
+        tags = [np.asarray(s[8], dtype=np.int64)[:, None] % label_dict_len
+                for s in batch]
+        return {
+            "word_data": lod_fixed(words),
+            "verb_data": lod_fixed(verbs),
+            "target": lod_fixed(tags),
+        }
+
+    # fixed batch set, multiple epochs: per-batch CRF loss scales with
+    # sequence lengths, so compare the same data epoch over epoch
+    reader = fluid.batch(conll05.test(), batch_size=8)
+    batches = []
+    for i, batch in enumerate(reader()):
+        batches.append(batch)
+        if i >= 5:
+            break
+    epoch_means = []
+    for _ in range(5):
+        ls = []
+        for batch in batches:
+            (lv,) = exe.run(feed=feed(batch), fetch_list=[avg_cost])
+            ls.append(float(np.ravel(np.asarray(lv))[0]))
+        epoch_means.append(np.mean(ls))
+    assert epoch_means[-1] < epoch_means[0] * 0.9, (
+        f"CRF loss did not drop: {epoch_means}")
+
+    # viterbi decode emits one tag per token within the label vocab
+    (decoded,) = exe.run(feed=feed(batches[0]),
+                         fetch_list=[crf_decode], return_numpy=False)
+    tags = np.asarray(decoded.data if hasattr(decoded, "data") else decoded)
+    assert tags.min() >= 0 and tags.max() < label_dict_len

@@ -1,0 +1,28 @@
+"""machine_translation: attention seq2seq on wmt16, trained + beam decode
+(reference: book/test_machine_translation.py over the models; decode via
+the contrib beam-search machinery)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+def test_machine_translation_trains_and_decodes():
+    fluid.reset_default_env()
+    spec = models.machine_translation(
+        dict_size=80, embedding_dim=16,
+        encoder_size=24, decoder_size=24, beam_size=2, max_length=8,
+    )
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(spec.loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for i in range(25):
+        batch = spec.synthetic_batch(8, seed=i)
+        (lv,) = exe.run(feed=batch, fetch_list=[spec.loss])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
+        f"{np.mean(losses[:5])} -> {np.mean(losses[-5:])}")

@@ -1,0 +1,62 @@
+"""recognize_digits: LeNet-ish conv net on mnist
+(reference: book/test_recognize_digits.py conv_net — two conv-pool
+stacks, softmax head, accuracy metric, inference round trip)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.dataset import mnist
+
+
+def conv_net(img, label):
+    conv_pool_1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = layers.fc(input=conv_pool_2, size=10, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def test_recognize_digits_conv(tmp_path):
+    fluid.reset_default_env()
+    img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    prediction, avg_cost, acc = conv_net(img, label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed(batch):
+        xs = np.stack([s[0].reshape(1, 28, 28) for s in batch])
+        ys = np.array([[s[1]] for s in batch], dtype=np.int64)
+        return {"img": xs.astype(np.float32), "label": ys}
+
+    reader = fluid.batch(mnist.train(), batch_size=32)
+    losses, accs = [], []
+    for i, data in enumerate(reader()):
+        loss_v, acc_v = exe.run(feed=feed(data), fetch_list=[avg_cost, acc])
+        losses.append(float(np.ravel(np.asarray(loss_v))[0]))
+        accs.append(float(np.ravel(np.asarray(acc_v))[0]))
+        if i >= 40:
+            break
+    assert np.mean(accs[-5:]) > np.mean(accs[:5]) + 0.2, (
+        f"accuracy did not improve: {np.mean(accs[:5])} -> "
+        f"{np.mean(accs[-5:])}")
+
+    path = str(tmp_path / "digits.model")
+    fluid.io.save_inference_model(path, ["img"], [prediction], exe)
+    prog, names, targets = fluid.io.load_inference_model(path, exe)
+    sample = next(mnist.test()())
+    (probs,) = exe.run(
+        program=prog,
+        feed={"img": sample[0].reshape(1, 1, 28, 28).astype(np.float32)},
+        fetch_list=targets)
+    probs = np.ravel(np.asarray(probs))
+    assert probs.shape == (10,) and abs(probs.sum() - 1.0) < 1e-3

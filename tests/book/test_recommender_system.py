@@ -1,0 +1,88 @@
+"""recommender_system: user/movie twin towers + cos_sim rating regression
+on movielens (reference: book/test_recommender_system.py — id embeddings
+fused per side, scaled cosine similarity as the predicted rating)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import movielens
+
+EMB = 16
+
+
+def get_usr_combined_features():
+    usr_id = layers.data(name="user_id", shape=[1], dtype="int64")
+    gender = layers.data(name="gender_id", shape=[1], dtype="int64")
+    age = layers.data(name="age_id", shape=[1], dtype="int64")
+    job = layers.data(name="job_id", shape=[1], dtype="int64")
+    parts = [
+        layers.fc(layers.embedding(usr_id,
+                                   size=[movielens.max_user_id() + 1, EMB]),
+                  size=EMB),
+        layers.fc(layers.embedding(gender, size=[2, EMB]), size=EMB),
+        layers.fc(layers.embedding(age, size=[8, EMB]), size=EMB),
+        layers.fc(layers.embedding(job,
+                                   size=[movielens.max_job_id() + 1, EMB]),
+                  size=EMB),
+    ]
+    return layers.fc(layers.concat(parts, axis=1), size=32, act="tanh")
+
+
+def get_mov_combined_features():
+    mov_id = layers.data(name="movie_id", shape=[1], dtype="int64")
+    category = layers.data(name="category_id", shape=[1], dtype="int64",
+                           lod_level=1)
+    title = layers.data(name="movie_title", shape=[1], dtype="int64",
+                        lod_level=1)
+    parts = [
+        layers.fc(layers.embedding(mov_id,
+                                   size=[movielens.max_movie_id() + 1, EMB]),
+                  size=EMB),
+        layers.sequence_pool(layers.embedding(category, size=[64, EMB]),
+                             pool_type="sum"),
+        layers.sequence_pool(layers.embedding(title, size=[512, EMB]),
+                             pool_type="sum"),
+    ]
+    return layers.fc(layers.concat(parts, axis=1), size=32, act="tanh")
+
+
+def test_recommender_system():
+    fluid.reset_default_env()
+    usr = get_usr_combined_features()
+    mov = get_mov_combined_features()
+    inference = layers.cos_sim(X=usr, Y=mov)
+    scale_infer = layers.scale(x=inference, scale=5.0)
+    label = layers.data(name="score", shape=[1], dtype="float32")
+    avg_cost = layers.mean(layers.square_error_cost(scale_infer, label))
+    fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed(batch):
+        def col(i):
+            return np.array([[int(s[i])] for s in batch], dtype=np.int64)
+
+        cats = [np.asarray(s[5], dtype=np.int64)[:, None] % 64
+                for s in batch]
+        titles = [np.asarray(s[6], dtype=np.int64)[:, None] % 512
+                  for s in batch]
+        return {
+            "user_id": col(0), "gender_id": col(1), "age_id": col(2),
+            "job_id": col(3), "movie_id": col(4),
+            "category_id": fluid.create_lod_tensor(cats),
+            "movie_title": fluid.create_lod_tensor(titles),
+            "score": np.array([[float(s[7])] for s in batch],
+                              dtype=np.float32),
+        }
+
+    reader = fluid.batch(movielens.train(), batch_size=32)
+    losses = []
+    for i, batch in enumerate(reader()):
+        (lv,) = exe.run(feed=feed(batch), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+        if i >= 30:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
+        f"{np.mean(losses[:5])} -> {np.mean(losses[-5:])}")

@@ -1,0 +1,68 @@
+"""understand_sentiment: sequence-conv and dynamic-LSTM text classifiers
+on imdb (reference: book/test_understand_sentiment.py convolution_net /
+stacked_lstm_net)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.dataset import imdb
+
+EMB = 16
+HID = 16
+CLASS = 2
+
+
+def convolution_net(data, label, input_dim):
+    emb = layers.embedding(input=data, size=[input_dim, EMB])
+    conv_3 = nets.sequence_conv_pool(
+        input=emb, num_filters=HID, filter_size=3, act="tanh",
+        pool_type="sqrt")
+    prediction = layers.fc(input=conv_3, size=CLASS, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    return layers.mean(cost), layers.accuracy(prediction, label)
+
+
+def stacked_lstm_net(data, label, input_dim):
+    emb = layers.embedding(input=data, size=[input_dim, EMB])
+    fc1 = layers.fc(input=emb, size=HID * 4)
+    lstm1, _ = layers.dynamic_lstm(input=fc1, size=HID * 4)
+    pooled = layers.sequence_pool(input=lstm1, pool_type="max")
+    prediction = layers.fc(input=pooled, size=CLASS, act="softmax")
+    cost = layers.cross_entropy(input=prediction, label=label)
+    return layers.mean(cost), layers.accuracy(prediction, label)
+
+
+def _train(net_fn, steps=25):
+    fluid.reset_default_env()
+    word_dict = imdb.word_dict()
+    data = layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, acc = net_fn(data, label, len(word_dict))
+    fluid.optimizer.Adagrad(learning_rate=0.05).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed(batch):
+        seqs = [np.asarray(s[0], dtype=np.int64)[:, None] for s in batch]
+        ys = np.array([[s[1]] for s in batch], dtype=np.int64)
+        return {"words": fluid.create_lod_tensor(seqs), "label": ys}
+
+    reader = fluid.batch(imdb.train(word_dict), batch_size=16)
+    losses = []
+    for i, batch in enumerate(reader()):
+        (lv,) = exe.run(feed=feed(batch), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+        if i >= steps:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
+        f"{np.mean(losses[:5])} -> {np.mean(losses[-5:])}")
+
+
+def test_understand_sentiment_conv():
+    _train(convolution_net)
+
+
+def test_understand_sentiment_stacked_lstm():
+    _train(stacked_lstm_net)

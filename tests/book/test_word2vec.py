@@ -1,0 +1,59 @@
+"""word2vec: N-gram language model on imikolov
+(reference: book/test_word2vec.py — 4 context words, shared embedding,
+concat -> hidden -> softmax)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import imikolov
+
+EMB_SIZE = 16
+HIDDEN_SIZE = 32
+N = 5
+
+
+def test_word2vec():
+    fluid.reset_default_env()
+    word_dict = imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    words = [layers.data(name=f"word_{i}", shape=[1], dtype="int64")
+             for i in range(N - 1)]
+    next_word = layers.data(name="next_word", shape=[1], dtype="int64")
+
+    embs = [
+        layers.embedding(
+            input=w, size=[dict_size, EMB_SIZE],
+            param_attr=fluid.ParamAttr(name="shared_w"),
+        )
+        for w in words
+    ]
+    concat = layers.concat(input=embs, axis=1)
+    hidden1 = layers.fc(input=concat, size=HIDDEN_SIZE, act="sigmoid")
+    predict = layers.fc(input=hidden1, size=dict_size, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=next_word)
+    avg_cost = layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed(batch):
+        arr = np.array(batch, dtype=np.int64)  # [B, 5]
+        out = {f"word_{i}": arr[:, i:i + 1] for i in range(N - 1)}
+        out["next_word"] = arr[:, N - 1:N]
+        return out
+
+    reader = fluid.batch(imikolov.train(word_dict, N), batch_size=32)
+    losses = []
+    for i, batch in enumerate(reader()):
+        (lv,) = exe.run(feed=feed(batch), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(np.asarray(lv))[0]))
+        if i >= 30:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
+        f"{np.mean(losses[:5])} -> {np.mean(losses[-5:])}")
+    # the shared embedding table actually exists once
+    tbl = np.asarray(fluid.global_scope().find_var("shared_w"))
+    assert tbl.shape == (dict_size, EMB_SIZE)
