@@ -58,6 +58,9 @@ from .transpiler import memory_optimize, release_memory  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
 from . import distributed  # noqa: F401
 from . import elastic  # noqa: F401
+from . import net_drawer  # noqa: F401
+from .core import enforce  # noqa: F401
+from .core.enforce import EnforceNotMet  # noqa: F401
 from . import distribute_lookup_table  # noqa: F401
 from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dataset  # noqa: F401

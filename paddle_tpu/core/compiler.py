@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .enforce import op_error_context
 from .framework import Block, Program
 from .lod import LoDValue
 from .proto import OpDesc, VarType, dtype_to_numpy
@@ -348,13 +349,17 @@ def _lower_grad_op(ctx: LoweringContext, op: OpDesc) -> None:
 def lower_op(ctx: LoweringContext, op: OpDesc, need_vjp_uids) -> None:
     if op.type in _SKIP_OPS:
         return
-    if op.type.endswith(GRAD_OP_SUFFIX) and "__fwd_op_uid__" in op.attrs:
-        _lower_grad_op(ctx, op)
-        return
-    if not OpRegistry.has(op.type):
+    is_grad = op.type.endswith(GRAD_OP_SUFFIX) and "__fwd_op_uid__" in op.attrs
+    if not is_grad and not OpRegistry.has(op.type):
+        # outside the context wrapper: "no lowering rule" keeps its
+        # NotImplementedError contract for feature probing
         raise NotImplementedError(f"op '{op.type}' has no TPU lowering rule")
-    uid = op.attrs.get("__op_uid__")
-    _lower_forward_op(ctx, op, need_vjp=uid in need_vjp_uids)
+    with op_error_context(op):
+        if is_grad:
+            _lower_grad_op(ctx, op)
+            return
+        uid = op.attrs.get("__op_uid__")
+        _lower_forward_op(ctx, op, need_vjp=uid in need_vjp_uids)
 
 
 def collect_needed_vjps(block: Block) -> set:

@@ -1,0 +1,113 @@
+"""Structured error layer
+(reference: paddle/fluid/platform/enforce.h — PADDLE_ENFORCE* macros
+raising EnforceNotMet with a captured call stack and accumulated context).
+
+Python already carries tracebacks, so the value here is the *operator
+context*: when a lowering or shape-inference rule fails deep inside XLA
+tracing, the user sees which op (type, inputs, outputs, attrs) of which
+block was being lowered, like the reference's "Operator ... raised"
+wrapping (framework/operator.cc RunImpl catch-block).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "EnforceNotMet",
+    "enforce",
+    "enforce_eq",
+    "enforce_gt",
+    "enforce_ge",
+    "enforce_not_none",
+    "op_error_context",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """reference: enforce.h EnforceNotMet — an error plus the operator /
+    framework context frames collected while unwinding."""
+
+    def __init__(self, message: str, *, op_type: Optional[str] = None):
+        super().__init__(message)
+        self.op_type = op_type
+        self.contexts = []
+
+    def add_context(self, ctx: str) -> "EnforceNotMet":
+        self.contexts.append(ctx)
+        return self
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        if self.contexts:
+            base += "\n" + "\n".join(f"  [context] {c}" for c in self.contexts)
+        return base
+
+
+def enforce(cond: Any, msg: str = "enforce failed", **kwargs) -> None:
+    """PADDLE_ENFORCE(cond, msg)."""
+    if not cond:
+        raise EnforceNotMet(msg.format(**kwargs) if kwargs else msg)
+
+
+def enforce_not_none(value: Any, msg: str = "value must not be None"):
+    """PADDLE_ENFORCE_NOT_NULL."""
+    if value is None:
+        raise EnforceNotMet(msg)
+    return value
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    """PADDLE_ENFORCE_EQ."""
+    if a != b:
+        raise EnforceNotMet(f"expected {a!r} == {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_gt(a: Any, b: Any, msg: str = "") -> None:
+    if not a > b:
+        raise EnforceNotMet(f"expected {a!r} > {b!r}" + (f": {msg}" if msg else ""))
+
+
+def enforce_ge(a: Any, b: Any, msg: str = "") -> None:
+    if not a >= b:
+        raise EnforceNotMet(f"expected {a!r} >= {b!r}" + (f": {msg}" if msg else ""))
+
+
+def _describe_op(op) -> str:
+    ins = {k: v for k, v in op.inputs.items()}
+    outs = {k: v for k, v in op.outputs.items()}
+    attrs = {
+        k: v for k, v in op.attrs.items()
+        if not k.startswith("__")
+        and (not isinstance(v, (list, dict))
+             or (isinstance(v, list) and len(v) <= 8))
+    }
+    return f"op '{op.type}' (inputs={ins}, outputs={outs}, attrs={attrs})"
+
+
+class op_error_context:
+    """Wrap exceptions escaping an op's lowering with the op description
+    (the reference wraps kernel exceptions with the op DebugString at
+    operator.cc:704's catch)."""
+
+    def __init__(self, op):
+        self.op = op
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None:
+            return False
+        if not isinstance(exc, Exception):
+            return False  # never swallow KeyboardInterrupt/SystemExit
+        ctx = f"while lowering {_describe_op(self.op)}"
+        if isinstance(exc, EnforceNotMet):
+            exc.add_context(ctx)
+            return False
+        if isinstance(exc, NotImplementedError):
+            return False  # op-support probing contract stays intact
+        # re-raise as EnforceNotMet carrying both messages and the chain
+        raise EnforceNotMet(
+            f"{type(exc).__name__}: {exc}", op_type=self.op.type
+        ).add_context(ctx) from exc

@@ -153,7 +153,8 @@ def query_filter(querylists):
 
 
 def load_from_text(filepath, shuffle=False, fill_missing=-1.0):
-    """Parse a LETOR text file into QueryLists."""
+    """Parse a LETOR text file into QueryLists; shuffle=True randomizes
+    the query order (reference: mq2007.py load_from_text)."""
     by_qid = {}
     order = []
     with open(filepath) as f:
@@ -166,7 +167,10 @@ def load_from_text(filepath, shuffle=False, fill_missing=-1.0):
                 by_qid[q.query_id] = QueryList()
                 order.append(q.query_id)
             by_qid[q.query_id]._add_query(q)
-    return [by_qid[qid] for qid in order]
+    out = [by_qid[qid] for qid in order]
+    if shuffle:
+        common.synthetic_rng("mq2007", "shuffle").shuffle(out)
+    return out
 
 
 def _synthesize(split: str, n_queries: int) -> str:
@@ -198,8 +202,6 @@ def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1.0):
     querylists = query_filter(
         load_from_text(filepath, shuffle=shuffle, fill_missing=fill_missing)
     )
-    if shuffle:
-        common.synthetic_rng("mq2007", "shuffle").shuffle(querylists)
     for ql in querylists:
         if format == "plain_txt":
             yield from gen_plain_txt(ql)

@@ -1,0 +1,69 @@
+"""Program graph visualization
+(reference: python/paddle/fluid/net_drawer.py — draws ops/vars of a
+program as a Graphviz digraph).  Emits DOT text directly so no graphviz
+python package is needed; feed the output to `dot -Tpng`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .core.framework import Program, default_main_program, default_startup_program
+
+__all__ = ["draw_graph", "parse_graph"]
+
+OP_STYLE = 'shape=box, style="rounded,filled", fillcolor="#b5d3ff"'
+VAR_STYLE = 'shape=oval, style=filled, fillcolor="#dddddd"'
+PARAM_STYLE = 'shape=oval, style=filled, fillcolor="#c8f7c5"'
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def parse_graph(program: Program, graph: list, var_dict: dict,
+                name_prefix: str = "", params: Optional[set] = None) -> None:
+    """Append DOT lines for one program (reference: net_drawer.parse_graph)."""
+    block = program.global_block()
+    if params is None:
+        params = {p.name for p in block.all_parameters()}
+    for name in block.desc.vars:
+        if name in var_dict:
+            continue
+        var_dict[name] = f'var_{len(var_dict)}'
+        style = PARAM_STYLE if name in params else VAR_STYLE
+        graph.append(f'  {var_dict[name]} [label="{_esc(name)}", {style}];')
+    for i, op in enumerate(block.desc.ops):
+        op_id = f"op_{name_prefix}{i}"
+        graph.append(f'  {op_id} [label="{_esc(op.type)}", {OP_STYLE}];')
+        for n in op.input_arg_names():
+            if n in var_dict:
+                graph.append(f"  {var_dict[n]} -> {op_id};")
+        for n in op.output_arg_names():
+            if n in var_dict:
+                graph.append(f"  {op_id} -> {var_dict[n]};")
+
+
+def draw_graph(startup_program: Optional[Program] = None,
+               main_program: Optional[Program] = None,
+               name: str = "network", path: Optional[str] = None) -> str:
+    """Render both programs into one DOT digraph; returns the DOT text and
+    writes it to `path` when given (reference: net_drawer.draw_graph)."""
+    startup_program = startup_program or default_startup_program()
+    main_program = main_program or default_main_program()
+    graph = [f'digraph "{_esc(name)}" {{', "  rankdir=TB;"]
+    var_dict: dict = {}
+    # params are registered on the MAIN program; the startup program sees
+    # the same names first (it initializes them), so share the set
+    params = {p.name for p in main_program.global_block().all_parameters()}
+    parse_graph(startup_program, graph, var_dict, name_prefix="s",
+                params=params)
+    parse_graph(main_program, graph, var_dict, name_prefix="m",
+                params=params)
+    graph.append("}")
+    dot = "\n".join(graph)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

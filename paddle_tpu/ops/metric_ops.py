@@ -131,6 +131,11 @@ def _edit_distance(ctx, ins, attrs):
         raise ValueError("edit_distance expects LoD sequence inputs")
     h, hl = hyp.data, hyp.lengths
     r, rl = ref.data, ref.lengths
+    # tokens ride [N, T, 1]; the DP compares scalars
+    if h.ndim == 3 and h.shape[-1] == 1:
+        h = h[..., 0]
+    if r.ndim == 3 and r.shape[-1] == 1:
+        r = r[..., 0]
     n = h.shape[0]
 
     def per_pair(hrow, hlen, rrow, rlen):
@@ -143,7 +148,7 @@ def _edit_distance(ctx, ins, attrs):
             def inner(carry, j):
                 left = carry
                 sub = prev[j] + jnp.where(
-                    (hrow[i] == rrow[j]) | (j >= rlen) | (i >= hlen), 0.0, 1.0
+                    (hrow[i] == rrow[j]) | (j >= rlen), 0.0, 1.0
                 )
                 ins_c = left + jnp.where(j < rlen, cost_base, 0.0)
                 del_c = prev[j + 1] + cost_base
@@ -152,7 +157,10 @@ def _edit_distance(ctx, ins, attrs):
 
             first = prev[0] + cost_base
             _, rest = jax.lax.scan(inner, first, jnp.arange(max_r))
-            return jnp.concatenate([first[None], rest]), None
+            new_row = jnp.concatenate([first[None], rest])
+            # beyond the hypothesis length the row must stay frozen —
+            # zero-cost steps would otherwise smear neighboring minima
+            return jnp.where(i < hlen, new_row, prev), None
 
         final, _ = jax.lax.scan(step, row0, jnp.arange(max_h))
         return final[rlen]

@@ -434,8 +434,15 @@ def _sequence_scatter(ctx, ins, attrs):
 @register_op("lod_reset", infer_shape=same_shape(), diff_inputs=["X"])
 def _lod_reset(ctx, ins, attrs):
     """Attach/replace sequence lengths (reference: operators/lod_reset_op.cc).
-    In the padded world this re-labels the row lengths; the dominant use —
-    adopting another LoDValue's structure onto same-shaped data — is exact."""
+
+    The reference relabels a FLAT token buffer's offset table; in the
+    padded world that is a re-chunk.  With a static `target_lod` attr the
+    re-chunk is exact (gather below).  With a runtime `Y` the new lengths
+    are traced, so the output's padded extent can't be derived — the Y
+    path RELABELS the existing rows instead, which matches the reference
+    only when X's rows are already laid out per Y's chunking (the dominant
+    use: adopting a sibling tensor's structure onto aligned data).  For a
+    genuine runtime re-chunk, go through sequence_unpad + sequence_pad."""
     x = ins["X"][0]
     d = data(x)
     y = ins.get("Y", [None])[0]
@@ -449,13 +456,54 @@ def _lod_reset(ctx, ins, attrs):
     target = attrs.get("target_lod", [])
     if not target:
         return {"Out": [d]}
-    l = np.diff(np.asarray(target)).astype(np.int32)
-    if d.ndim >= 2 and d.shape[0] == len(l):
-        return {"Out": [LoDValue(d, jnp.asarray(l))]}
-    raise NotImplementedError(
-        "lod_reset that re-chunks a flat token buffer needs a ragged->padded "
-        "relayout; feed padded [num_seqs, T, ...] data instead"
+    t = np.asarray(target)
+    # reference passes level-0 OFFSETS ([0, 2, 6]) — validate, don't guess
+    if t[0] != 0 or np.any(np.diff(t) < 0):
+        raise ValueError(
+            f"lod_reset target_lod must be non-decreasing offsets starting "
+            f"at 0 (reference lod_reset_op contract), got {t.tolist()}"
+        )
+    new_l = np.diff(t).astype(np.int32)
+    if not isinstance(x, LoDValue):
+        if d.ndim >= 2 and d.shape[0] == len(new_l):
+            return {"Out": [LoDValue(d, jnp.asarray(new_l))]}
+        raise ValueError(
+            f"lod_reset: dense input with {d.shape[0]} rows cannot take "
+            f"{len(new_l)} sequence lengths"
+        )
+    # padded -> padded re-chunk: the target offsets are static, so each
+    # output (seq, pos) maps to one global token index; locate it in the
+    # input's (traced) offsets with a searchsorted gather
+    n_out = len(new_l)
+    t_out = int(new_l.max()) if n_out else 0
+    new_off = np.concatenate([[0], np.cumsum(new_l)])
+    in_l = jnp.asarray(x.lengths).astype(jnp.int32)
+    in_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(in_l)]
     )
+    gidx = new_off[:-1, None] + np.arange(t_out)[None, :]  # [n_out, t_out]
+    gidx_j = jnp.asarray(gidx, jnp.int32)
+    seq = jnp.clip(
+        jnp.searchsorted(in_off, gidx_j.reshape(-1), side="right") - 1,
+        0, d.shape[0] - 1,
+    )
+    pos = jnp.clip(gidx_j.reshape(-1) - in_off[seq], 0, d.shape[1] - 1)
+    rows = d[seq, pos].reshape((n_out, t_out) + d.shape[2:])
+    valid = jnp.asarray(
+        np.arange(t_out)[None, :] < new_l[:, None]
+    )
+    rows = rows * valid.reshape(
+        valid.shape + (1,) * (rows.ndim - 2)
+    ).astype(rows.dtype)
+    # the reference enforces last offset == total tokens; input lengths are
+    # traced here, so poison the output when they disagree instead of
+    # silently presenting padding as data (NaN for floats; a check_nan_inf
+    # run or the loss surfaces it immediately)
+    total_ok = jnp.sum(in_l) == int(new_off[-1])
+    if jnp.issubdtype(rows.dtype, jnp.floating):
+        rows = jnp.where(total_ok, rows, jnp.nan)
+    out_l = jnp.where(total_ok, jnp.asarray(new_l), -1)
+    return {"Out": [LoDValue(rows, out_l)]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,80 @@
+"""Enforce layer, net_drawer, diff_api tooling
+(reference: platform/enforce.h EnforceNotMet semantics, net_drawer.py,
+tools/diff_api.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.enforce import (
+    EnforceNotMet,
+    enforce,
+    enforce_eq,
+    enforce_ge,
+    enforce_gt,
+    enforce_not_none,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_enforce_helpers():
+    enforce(True)
+    enforce_eq(3, 3)
+    enforce_gt(4, 3)
+    enforce_ge(3, 3)
+    assert enforce_not_none(5) == 5
+    with pytest.raises(EnforceNotMet, match="must be positive"):
+        enforce(False, "dim {d} must be positive", d=-1)
+    with pytest.raises(EnforceNotMet, match="== "):
+        enforce_eq(1, 2, "shape mismatch")
+    with pytest.raises(EnforceNotMet):
+        enforce_not_none(None)
+
+
+def test_lowering_error_carries_op_context():
+    """A broken op body surfaces as EnforceNotMet naming the op (the
+    reference wraps kernel errors with the op DebugString,
+    operator.cc:704)."""
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [6], dtype="float32")
+    # elementwise_add with incompatible shapes survives graph build (both
+    # rank-1 descs) but fails at lowering time inside jax
+    out = layers.elementwise_add(x, y)
+    loss = layers.reduce_mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(EnforceNotMet) as ei:
+        exe.run(feed={"x": np.zeros((2, 4), np.float32),
+                      "y": np.zeros((2, 6), np.float32)},
+                fetch_list=[loss])
+    msg = str(ei.value)
+    assert "elementwise_add" in msg and "[context]" in msg
+
+
+def test_net_drawer_emits_dot(tmp_path):
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    h = layers.fc(x, 8, act="relu")
+    layers.reduce_mean(h)
+    path = str(tmp_path / "g.dot")
+    dot = fluid.net_drawer.draw_graph(path=path)
+    assert dot.startswith("digraph")
+    assert '"mul"' in dot and '"relu"' in dot
+    assert os.path.exists(path)
+    # params get the param style fill
+    assert dot.count("#c8f7c5") >= 1
+
+
+def test_diff_api_tool_matches():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diff_api.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr

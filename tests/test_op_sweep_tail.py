@@ -1,0 +1,256 @@
+"""Per-op sweep: previously untested tail
+(reference: test_matmul_op.py, test_transpose_op.py, test_reshape_op.py,
+test_squeeze_op.py / test_unsqueeze_op.py, test_prelu_op.py,
+test_maxout_op.py, test_bilinear_tensor_product_op.py,
+test_conv2d_transpose_op.py, test_bilinear_interp_op.py,
+test_nearest_interp_op.py, test_mean_iou_op.py, test_edit_distance_op.py,
+test_fake_quantize_op.py, test_fake_dequantize_op.py, test_auc_op.py,
+test_assign_value_op.py, test_lod_reset_op.py, test_isfinite_op.py,
+test_uniform_random_op.py, test_gaussian_random_op.py over the matching
+operators/*.cc)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _rand(shape, seed=0, lo=-2.0, hi=2.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    class T(OpTest):
+        pass
+
+    T.op_type = op_type
+    t = T()
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_matmul_plain_and_transposed():
+    x, y = _rand((3, 4), 1), _rand((4, 5), 2)
+    t = _t("matmul", {"X": x, "Y": y}, {"Out": x @ y})
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+    xt = _rand((4, 3), 3)
+    t = _t("matmul", {"X": xt, "Y": y}, {"Out": xt.T @ y},
+           {"transpose_X": True})
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+    # batched with alpha
+    xb, yb = _rand((2, 3, 4), 4), _rand((2, 4, 5), 5)
+    t = _t("matmul", {"X": xb, "Y": yb}, {"Out": 0.5 * (xb @ yb)},
+           {"alpha": 0.5})
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_transpose2():
+    x = _rand((2, 3, 4), 6)
+    t = _t("transpose2", {"X": x}, {"Out": x.transpose(2, 0, 1)},
+           {"axis": [2, 0, 1]})
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_reshape_squeeze_unsqueeze_flatten2():
+    x = _rand((2, 3, 4), 7)
+    t = _t("reshape2", {"X": x}, {"Out": x.reshape(6, 4)},
+           {"shape": [6, 4]})
+    t.check_output()
+
+    xs = _rand((3, 1, 4), 8)
+    t = _t("squeeze2", {"X": xs}, {"Out": xs.reshape(3, 4)},
+           {"axes": [1]})
+    t.check_output()
+
+    t = _t("unsqueeze2", {"X": x}, {"Out": x[:, None]},
+           {"axes": [1]})
+    t.check_output()
+
+    t = _t("flatten2", {"X": x}, {"Out": x.reshape(2, 12)},
+           {"axis": 1})
+    t.check_output()
+
+
+def test_prelu_modes():
+    x = _rand((3, 4, 5), 9)
+    alpha_all = np.array([0.25], dtype="float32")
+    want = np.where(x > 0, x, 0.25 * x)
+    t = _t("prelu", {"X": x, "Alpha": alpha_all}, {"Out": want},
+           {"mode": "all"})
+    t.check_output()
+    t.check_grad(["X", "Alpha"], "Out", max_relative_error=0.03)
+
+    alpha_c = _rand((4,), 10, 0.1, 0.9)
+    want = np.where(x > 0, x, alpha_c[None, :, None] * x)
+    t = _t("prelu", {"X": x, "Alpha": alpha_c}, {"Out": want},
+           {"mode": "channel"})
+    t.check_output()
+
+
+def test_maxout():
+    x = _rand((2, 6, 3, 3), 11)
+    want = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    t = _t("maxout", {"X": x}, {"Out": want}, {"groups": 2})
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_bilinear_tensor_product():
+    x, y = _rand((4, 3), 12), _rand((4, 5), 13)
+    w = _rand((6, 3, 5), 14)
+    bias = _rand((1, 6), 15)
+    want = np.einsum("bi,kij,bj->bk", x, w, y) + bias
+    t = _t("bilinear_tensor_product",
+           {"X": x, "Y": y, "Weight": w, "Bias": bias}, {"Out": want})
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["X", "Y", "Weight"], "Out", max_relative_error=0.03)
+
+
+def test_conv2d_transpose_matches_scatter():
+    # stride-2 transpose conv == scatter-add of input-scaled kernels
+    x = _rand((1, 2, 3, 3), 16)
+    f = _rand((2, 3, 2, 2), 17)  # [Cin, Cout, H, W]
+    stride = 2
+    out = np.zeros((1, 3, 3 * stride - stride + 2, 3 * stride - stride + 2),
+                   dtype="float32")
+    for i in range(3):
+        for j in range(3):
+            patch = np.einsum("c,cokl->okl", x[0, :, i, j], f)
+            out[0, :, i * stride:i * stride + 2,
+                j * stride:j * stride + 2] += patch
+    t = _t("conv2d_transpose", {"Input": x, "Filter": f},
+           {"Output": out}, {"strides": [2, 2], "paddings": [0, 0]})
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_depthwise_conv2d():
+    x = _rand((1, 3, 5, 5), 18)
+    f = _rand((3, 1, 3, 3), 19)
+    want = np.zeros((1, 3, 3, 3), dtype="float32")
+    for c in range(3):
+        for i in range(3):
+            for j in range(3):
+                want[0, c, i, j] = (x[0, c, i:i + 3, j:j + 3]
+                                    * f[c, 0]).sum()
+    t = _t("depthwise_conv2d", {"Input": x, "Filter": f},
+           {"Output": want},
+           {"strides": [1, 1], "paddings": [0, 0], "groups": 3})
+    t.check_output(atol=2e-4, rtol=2e-4)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_nearest_interp():
+    x = _rand((1, 2, 2, 2), 20)
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    t = _t("nearest_interp", {"X": x}, {"Out": want},
+           {"out_h": 4, "out_w": 4})
+    t.check_output()
+
+
+def test_bilinear_interp_preserves_constant():
+    x = np.full((1, 1, 3, 3), 2.5, dtype="float32")
+    want = np.full((1, 1, 6, 6), 2.5, dtype="float32")
+    t = _t("bilinear_interp", {"X": x}, {"Out": want},
+           {"out_h": 6, "out_w": 6})
+    t.check_output(atol=1e-5)
+    xg = _rand((1, 1, 4, 4), 21)
+    want = np.asarray(
+        __import__("jax").image.resize(xg, (1, 1, 8, 8), "bilinear"))
+    t = _t("bilinear_interp", {"X": xg}, {"Out": want},
+           {"out_h": 8, "out_w": 8})
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 2, 2, 1], dtype="int64")
+    label = np.array([0, 1, 1, 2, 2], dtype="int64")
+    # per class: c0 1/1; c1 1/3; c2 1/3 -> mean = (1 + 1/3 + 1/3)/3
+    want_iou = np.array([(1.0 + 1 / 3 + 1 / 3) / 3], dtype="float32")
+    t = _t("mean_iou", {"Predictions": pred, "Labels": label},
+           {"OutMeanIou": want_iou,
+            "OutWrong": np.array([0, 2, 2], dtype="int32"),
+            "OutCorrect": np.array([1, 1, 1], dtype="int32")},
+           {"num_classes": 3})
+    t.check_output(atol=1e-6)
+
+
+def test_edit_distance():
+    # LoD pairs as (flat_data, lengths): "123"/"13" and "45"/"456"
+    hyps = (np.array([[1], [2], [3], [4], [5]], dtype="int64"), [3, 2])
+    refs = (np.array([[1], [3], [4], [5], [6]], dtype="int64"), [2, 3])
+    t = _t("edit_distance", {"Hyps": hyps, "Refs": refs},
+           {"Out": np.array([[1.0], [1.0]], dtype="float32"),
+            "SequenceNum": np.array([2], dtype="int64")})
+    t.check_output()
+
+
+def test_fake_quantize_dequantize_range_abs_max():
+    fluid.reset_default_env()
+    x = _rand((4, 4), 22)
+    scale = float(np.abs(x).max())
+    levels = 127.0
+    # fake-quant emits DEQUANTIZED values (round to the grid, scale back)
+    want = np.round(x / scale * levels).clip(-levels, levels) \
+        * scale / levels
+    t = _t("fake_quantize_range_abs_max",
+           {"X": x, "InScale": np.array([0.0], dtype="float32")},
+           {"Out": want.astype("float32"),
+            "OutScale": np.array([scale], dtype="float32")},
+           {"bit_length": 8, "is_test": False})
+    t.check_output(atol=1e-4)
+
+    q = np.round(x / scale * levels).astype("float32")
+    t = _t("fake_dequantize_max_abs",
+           {"X": q, "Scale": np.array([scale], dtype="float32")},
+           {"Out": q * scale / 127.0}, {"max_range": 127.0})
+    t.check_output(atol=1e-5)
+
+
+def test_assign_value():
+    vals = np.arange(6, dtype="float32").reshape(2, 3)
+    t = _t("assign_value", {},
+           {"Out": vals},
+           {"shape": [2, 3], "fp32_values": vals.reshape(-1).tolist(),
+            "dtype": int(fluid.core.DataType.FP32)})
+    t.check_output()
+
+
+def test_isfinite_family():
+    x = np.array([1.0, np.inf, -np.inf, np.nan, 2.0], dtype="float32")
+    t = _t("isfinite", {"X": x},
+           {"Out": np.array([False], dtype=bool)})
+    t.check_output()
+    t = _t("isinf", {"X": x}, {"Out": np.array([True], dtype=bool)})
+    t.check_output()
+    t = _t("isnan", {"X": x}, {"Out": np.array([True], dtype=bool)})
+    t.check_output()
+
+
+def test_lod_reset_with_target_lengths():
+    flat = np.arange(1.0, 7.0, dtype="float32")[:, None]
+    # re-slice the 6 rows [3, 3] -> [2, 4]
+    t = _t("lod_reset", {"X": (flat, [3, 3])},
+           {"Out": (flat, [2, 4])},
+           {"target_lod": [2, 4]})
+    t.check_output()
+
+
+def test_uniform_and_gaussian_random_statistics():
+    fluid.reset_default_env()
+    from paddle_tpu import layers
+
+    u = layers.uniform_random([2000], min=-1.0, max=3.0)
+    g = layers.gaussian_random([2000], mean=1.0, std=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    uv, gv = exe.run(fetch_list=[u, g])
+    uv, gv = np.asarray(uv), np.asarray(gv)
+    assert uv.min() >= -1.0 and uv.max() <= 3.0
+    assert abs(uv.mean() - 1.0) < 0.15
+    assert abs(gv.mean() - 1.0) < 0.2 and abs(gv.std() - 2.0) < 0.25

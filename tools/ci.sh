@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# CI driver (reference role: paddle/scripts/paddle_build.sh — cmake_gen /
+# run_test / api-spec gate, shrunk to this repo's pure-python + ctypes
+# build).  Stages:
+#   native   - build the C++ helpers (recordio, multislot) via make
+#   test     - full pytest suite on an 8-device virtual CPU mesh
+#   api      - API.spec freeze gate (tools/diff_api.py)
+#   bench    - one smoke bench step (tiny shapes, CPU)
+# Run all stages:  tools/ci.sh        One stage:  tools/ci.sh test
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+stage="${1:-all}"
+
+run_native() {
+  echo "== native build =="
+  # the libs build on demand with g++ (paddle_tpu/native/__init__.py);
+  # force a rebuild here so CI catches C++ regressions
+  rm -f paddle_tpu/native/*.so
+  python - <<'PY'
+from paddle_tpu import native
+for name in ("recordio", "multislot"):
+    lib = native.load(name)
+    assert lib is not None, f"native {name} failed to build"
+    print(f"built lib{name}.so")
+PY
+}
+
+run_test() {
+  echo "== pytest =="
+  python -m pytest tests/ -q -x
+}
+
+run_api() {
+  echo "== API freeze =="
+  python tools/diff_api.py
+}
+
+run_bench() {
+  echo "== bench smoke =="
+  BENCH_BS=8 BENCH_STEPS=3 BENCH_TRANSFORMER_BS=2 BENCH_DEEPFM_BS=32 \
+    BENCH_DEEPFM_VOCAB=1000 BENCH_LSTM_BS=4 python bench.py
+}
+
+case "$stage" in
+  native) run_native ;;
+  test)   run_test ;;
+  api)    run_api ;;
+  bench)  run_bench ;;
+  all)    run_native; run_api; run_test; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|bench|all)"; exit 2 ;;
+esac
+echo "CI OK ($stage)"

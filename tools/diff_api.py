@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""API-freeze diff gate
+(reference: tools/diff_api.py — CI fails with a readable diff when the
+public API changed without updating API.spec).
+
+Usage:
+    python tools/diff_api.py              # exit 1 + diff when drifted
+    python tools/print_signatures.py --update   # accept the change
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    spec_path = os.path.join(REPO, "API.spec")
+    if not os.path.exists(spec_path):
+        print("API.spec missing; run: python tools/print_signatures.py "
+              "--update", file=sys.stderr)
+        return 1
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "print_signatures.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        print("print_signatures.py failed:\n" + proc.stderr, file=sys.stderr)
+        return 1
+    current = proc.stdout
+    with open(spec_path) as f:
+        frozen = f.read()
+    if current == frozen:
+        print("API surface matches API.spec")
+        return 0
+    diff = "\n".join(difflib.unified_diff(
+        frozen.splitlines(), current.splitlines(),
+        "API.spec", "current", lineterm=""))
+    print(diff)
+    print(
+        "\nPublic API changed. If intentional, run:\n"
+        "    python tools/print_signatures.py --update\n"
+        "and commit the new API.spec (the reference gates this on review "
+        "approval).", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
