@@ -235,11 +235,20 @@ def test_isfinite_family():
 
 def test_lod_reset_with_target_lengths():
     flat = np.arange(1.0, 7.0, dtype="float32")[:, None]
-    # re-slice the 6 rows [3, 3] -> [2, 4]
+    # re-slice the 6 tokens [3, 3] -> [2, 4]; target_lod is OFFSETS
     t = _t("lod_reset", {"X": (flat, [3, 3])},
            {"Out": (flat, [2, 4])},
-           {"target_lod": [2, 4]})
+           {"target_lod": [0, 2, 6]})
     t.check_output()
+
+    # non-offset target_lod is rejected, not guessed at
+    import pytest
+    from paddle_tpu.core.enforce import EnforceNotMet
+
+    bad = _t("lod_reset", {"X": (flat, [3, 3])}, {"Out": (flat, [2, 4])},
+             {"target_lod": [2, 4]})
+    with pytest.raises(EnforceNotMet, match="offsets"):
+        bad.check_output()
 
 
 def test_uniform_and_gaussian_random_statistics():
