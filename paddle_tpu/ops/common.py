@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,7 +22,17 @@ __all__ = [
     "broadcast_y",
     "broadcast_out_shape",
     "normalize_axis",
+    "ACTS",
 ]
+
+# The four activations the fused/RNN op attrs accept (reference:
+# math/detail/activation_functions.h ActivationType).
+ACTS = {
+    "identity": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+}
 
 
 def in_desc(op: OpDesc, block, slot: str, idx: int = 0) -> Optional[VarDesc]:

@@ -411,3 +411,144 @@ def _load_op(ctx, ins, attrs):
     if attrs.get("load_as_fp16"):
         arr = arr.astype(np.float16)
     return {"Out": [jnp.asarray(arr)]}
+
+
+def _ttfc_infer(op, block):
+    xs = [block._find_var_recursive(n) for n in op.input("X")]
+    xs = [v.desc for v in xs if v is not None]
+    if not xs or any(any(s < 0 for s in d.shape) for d in xs):
+        return
+    trans = op.attr("trans_axis", list(range(len(xs[0].shape))))
+    flat = op.attr("flatten_axis", 1)
+    cat = op.attr("concat_axis", 1)
+    shapes = []
+    for d in xs:
+        t = [d.shape[a] for a in trans]
+        shapes.append([int(np.prod(t[:flat] or [1])), int(np.prod(t[flat:] or [1]))])
+    out = list(shapes[0])
+    out[cat] = sum(s[cat] for s in shapes)
+    set_output(block, op, "Out", out, xs[0].dtype)
+
+
+@register_op("fusion_transpose_flatten_concat", infer_shape=_ttfc_infer,
+             diff_inputs=["X"])
+def _fusion_transpose_flatten_concat(ctx, ins, attrs):
+    """transpose + flatten-to-2D + concat over a list of tensors in one op
+    (reference: operators/fused/fusion_transpose_flatten_concat_op.cc)."""
+    ndim = data(ins["X"][0]).ndim
+    trans = attrs.get("trans_axis", list(range(ndim)))
+    flat = int(attrs.get("flatten_axis", 1))
+    cat = int(attrs.get("concat_axis", 1))
+    outs = []
+    for v in ins["X"]:
+        d = jnp.transpose(data(v), trans)
+        lead = int(np.prod(d.shape[:flat] or (1,)))
+        outs.append(d.reshape(lead, -1))
+    return {"Out": [jnp.concatenate(outs, axis=cat)]}
+
+
+# ---------------------------------------------------------------------------
+# in-graph checkpoint ops: save / save_combine / load_combine (reference:
+# operators/save_op.cc, save_combine_op.cc, load_combine_op.cc — io.py's
+# host-side save path is the fast default; these exist so reference-style
+# programs that embed save/load ops execute as written).  The write happens
+# at RUN time through an ordered io_callback, not at trace time.
+# ---------------------------------------------------------------------------
+def _save_blob(path_npy, overwrite, arr):
+    import os as _os
+
+    if not overwrite and _os.path.exists(path_npy):
+        raise RuntimeError(
+            f"save op: '{path_npy}' exists and overwrite=False")
+    d = _os.path.dirname(path_npy)
+    if d:
+        _os.makedirs(d, exist_ok=True)
+    np.save(path_npy, np.asarray(arr))
+
+
+@register_op("save", infer_shape=None, no_grad=True, stateful=True)
+def _save_op(ctx, ins, attrs):
+    """Serialize one var to the .npy blob format io.load_vars/the load op
+    reads (reference: operators/save_op.cc writes the LoDTensor wire
+    format)."""
+    from jax.experimental import io_callback
+    from functools import partial
+
+    path = attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    x = data(ins["X"][0])
+    if attrs.get("save_as_fp16"):
+        x = x.astype(jnp.float16)
+    io_callback(
+        partial(_save_blob, path, attrs.get("overwrite", True)),
+        None, x, ordered=True,
+    )
+    return {}
+
+
+@register_op("save_combine", infer_shape=None, no_grad=True, stateful=True)
+def _save_combine_op(ctx, ins, attrs):
+    """Serialize N vars into one .npz (reference: save_combine_op.cc packs
+    LoDTensors back-to-back in one file; io.py's filename= format)."""
+    from jax.experimental import io_callback
+
+    path = attrs["file_path"]
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    names = list(attrs.get("var_names", []) or [])
+    vals = [data(v) for v in ins["X"]]
+    if len(names) != len(vals):
+        names = [f"var_{i}" for i in range(len(vals))]
+    if attrs.get("save_as_fp16"):
+        vals = [v.astype(jnp.float16) for v in vals]
+
+    def _write(*arrs):
+        import os as _os
+
+        d = _os.path.dirname(path)
+        if d:
+            _os.makedirs(d, exist_ok=True)
+        np.savez(path, **{n: np.asarray(a) for n, a in zip(names, arrs)})
+
+    io_callback(_write, None, *vals, ordered=True)
+    return {}
+
+
+def _load_combine_infer(op, block):
+    return None
+
+
+@register_op("load_combine", infer_shape=_load_combine_infer, no_grad=True,
+             stateful=True)
+def _load_combine_op(ctx, ins, attrs):
+    """Load N vars from one .npz written by save_combine / io.save_vars
+    filename= (reference: load_combine_op.cc).  Static path => the read
+    folds into the program as constants, like the load op."""
+    path = attrs["file_path"]
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    names = list(attrs.get("var_names", []) or [])
+    with np.load(path) as z:
+        keys = names if names else list(z.files)
+        arrs = [z[k] for k in keys]
+    if attrs.get("load_as_fp16"):
+        arrs = [a.astype(np.float16) for a in arrs]
+    return {"Out": [jnp.asarray(a) for a in arrs]}
+
+
+@register_op("get_places", infer_shape=None, no_grad=True)
+def _get_places(ctx, ins, attrs):
+    """Device-count probe (reference: operators/controlflow/get_places_op.cc
+    fills a vector<Place>).  Devices aren't graph values under XLA; the
+    lowering emits the device *count* visible to this process, which is
+    what ParallelDo-era consumers divided work by."""
+    import jax as _jax
+
+    want = int(attrs.get("device_count", 0) or 0)
+    dtype = attrs.get("device_type", "CPU")
+    n = len(_jax.devices())
+    if want:
+        n = min(want, n)
+    del dtype  # CPU/CUDA distinction collapses to the jax platform
+    return {"Out": [jnp.arange(n, dtype=jnp.int32)]}

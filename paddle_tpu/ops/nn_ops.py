@@ -871,3 +871,37 @@ def _depthwise_conv2d_transpose(ctx, ins, attrs):
     """Depthwise transposed conv (reference: conv_transpose_op.cc registers
     it as conv2d_transpose with groups == channels)."""
     return _conv2d_transpose(ctx, ins, attrs)
+
+
+def _conv2d_fusion_infer(op, block):
+    _conv2d_infer(op, block)
+
+
+@register_op("conv2d_fusion", infer_shape=_conv2d_fusion_infer,
+             diff_inputs=["Input", "Filter", "Bias", "ResidualData"])
+def _conv2d_fusion(ctx, ins, attrs):
+    """y = act(conv(x) + residual + bias) in one op (reference:
+    operators/conv_fusion_op.cc — a cuDNN fused-conv binding; on TPU the
+    same composition is what XLA fuses anyway, the op just keeps program
+    parity with the reference's fuse passes)."""
+    if attrs.get("split_channels"):
+        raise NotImplementedError(
+            "conv2d_fusion split_channels (multi-output split) is not "
+            "lowered; emit a separate split op")
+    out = data(_conv2d_lower(ctx, ins, attrs)["Output"][0])
+    if ins.get("ResidualData") and ins["ResidualData"]:
+        out = out + data(ins["ResidualData"][0])
+    if ins.get("Bias") and ins["Bias"]:
+        out = out + data(ins["Bias"][0]).reshape(1, -1, 1, 1)
+    act = attrs.get("activation", "relu") or "identity"
+    acts = {
+        "identity": lambda x: x,
+        "relu": jax.nn.relu,
+        "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+        "relux": lambda x: jnp.clip(x, 0.0, attrs.get("alpha", 6.0)),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+    }
+    if act not in acts:
+        raise NotImplementedError(f"conv2d_fusion activation '{act}'")
+    return {"Output": [acts[act](out)]}

@@ -312,3 +312,64 @@ def _ftrl(ctx, ins, attrs):
         "SquaredAccumOut": [sq_new],
         "LinearAccumOut": [lin_new],
     }
+
+
+def _avg_acc_infer(op, block):
+    pairs = [("in_sum_1", "out_sum_1"), ("in_sum_2", "out_sum_2"),
+             ("in_sum_3", "out_sum_3"),
+             ("in_num_accumulates", "out_num_accumulates"),
+             ("in_old_num_accumulates", "out_old_num_accumulates"),
+             ("in_num_updates", "out_num_updates")]
+    for src, dst in pairs:
+        d = in_desc(op, block, src)
+        if d is not None:
+            set_output(block, op, dst, list(d.shape), d.dtype)
+
+
+@register_op("average_accumulates", infer_shape=_avg_acc_infer,
+             no_grad=True, stateful=True)
+def _average_accumulates(ctx, ins, attrs):
+    """ModelAverage's three-tier windowed parameter sum (reference:
+    operators/average_accumulates_op.h).  sum_1 accumulates every step;
+    every 16384 updates it drains into sum_2 (precision); when the window
+    outgrows max(min_average_window, min(max_average_window,
+    num_updates*average_window)) both drain into sum_3 and the window
+    restarts.  Branches become jnp.where so one XLA program covers every
+    step."""
+    k_max = 16384
+    param = data(ins["param"][0])
+    s1 = data(ins["in_sum_1"][0])
+    s2 = data(ins["in_sum_2"][0])
+    s3 = data(ins["in_sum_3"][0])
+    num_acc = data(ins["in_num_accumulates"][0]).reshape(()).astype(jnp.int32)
+    old_acc = data(ins["in_old_num_accumulates"][0]).reshape(()).astype(jnp.int32)
+    num_upd = data(ins["in_num_updates"][0]).reshape(()).astype(jnp.int32)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + param
+
+    drain12 = (num_upd % k_max) == 0
+    s2 = jnp.where(drain12, s2 + s1, s2)
+    s1 = jnp.where(drain12, jnp.zeros_like(s1), s1)
+
+    window = jnp.minimum(
+        jnp.asarray(attrs.get("max_average_window", 2 ** 31 - 1), jnp.float32),
+        num_upd.astype(jnp.float32) * attrs.get("average_window", 0.0),
+    )
+    close = (num_acc >= attrs.get("min_average_window", 10000)) & (
+        num_acc.astype(jnp.float32) >= window)
+    s3 = jnp.where(close, s1 + s2, s3)
+    s1 = jnp.where(close, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(close, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(close, num_acc, old_acc)
+    num_acc = jnp.where(close, jnp.zeros_like(num_acc), num_acc)
+
+    shp = data(ins["in_num_accumulates"][0]).shape
+    dt = data(ins["in_num_accumulates"][0]).dtype
+    return {
+        "out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+        "out_num_accumulates": [num_acc.astype(dt).reshape(shp)],
+        "out_old_num_accumulates": [old_acc.astype(dt).reshape(shp)],
+        "out_num_updates": [num_upd.astype(dt).reshape(shp)],
+    }

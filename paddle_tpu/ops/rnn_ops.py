@@ -29,18 +29,10 @@ import numpy as np
 from ..core.lod import LoDValue
 from ..core.proto import DataType
 from ..core.registry import register_op
-from .common import data, in_desc, lengths, set_output, wrap_lod
-
-_ACTS = {
-    "sigmoid": jax.nn.sigmoid,
-    "tanh": jnp.tanh,
-    "relu": jax.nn.relu,
-    "identity": lambda x: x,
-}
-
+from .common import ACTS, data, in_desc, lengths, set_output, wrap_lod
 
 def _act(name):
-    return _ACTS[name or "identity"]
+    return ACTS[name or "identity"]
 
 
 # gru_unit encodes activations as ints (gru_unit_op.h:34 GRUActivationType)
@@ -471,3 +463,138 @@ def _fusion_gru(ctx, ins, attrs):
     ins2["Weight"] = ins["WeightH"]
     outs = _gru(ctx, ins2, attrs)
     return {"Hidden": outs["Hidden"], "XX": [LoDValue(xx, l)]}
+
+
+def _fused_emb_fc_lstm_infer(op, block):
+    emb = in_desc(op, block, "Embeddings")
+    ids = in_desc(op, block, "Ids")
+    if emb is None or ids is None:
+        return
+    h = emb.shape[1] // 4
+    set_output(block, op, "Hidden", [-1, h], emb.dtype, lod_level=1)
+    set_output(block, op, "Cell", [-1, h], emb.dtype, lod_level=1)
+    if op.output("XX") and op.output("XX")[0]:
+        set_output(block, op, "XX", [-1, 4 * h], emb.dtype, lod_level=1)
+
+
+@register_op("fused_embedding_fc_lstm", infer_shape=_fused_emb_fc_lstm_infer,
+             diff_inputs=["Embeddings", "WeightH", "Bias", "H0", "C0"])
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """Embedding lookup + fc + LSTM in one op (reference:
+    operators/fused/fused_embedding_fc_lstm_op.cc): Embeddings is the
+    [vocab, 4H] table pre-multiplied with the gate projection, so the
+    input half of the gates is a pure gather; the recurrence reuses the
+    lstm scan (gate order [c-candidate, i, f, o], fusion_lstm_op.h)."""
+    ids_v = ins["Ids"][0]
+    ids = data(ids_v)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]                       # [N, T]
+    l = lengths(ids_v)
+    if l is None:
+        l = jnp.full((ids.shape[0],), ids.shape[1], dtype=jnp.int32)
+    emb = data(ins["Embeddings"][0])            # [V, 4H]
+    xx = emb[ids.astype(jnp.int32)]             # [N, T, 4H]
+    ins2 = dict(ins)
+    ins2["Input"] = [LoDValue(xx, l)]
+    ins2["Weight"] = ins["WeightH"]
+    hs, cs, gates, preact, l = _lstm_core(ctx, ins2, attrs)
+    return {
+        "Hidden": [LoDValue(hs, l)],
+        "Cell": [LoDValue(cs, l)],
+        "XX": [LoDValue(xx, l)],
+    }
+
+
+def _attention_lstm_infer(op, block):
+    x = in_desc(op, block, "X")
+    w = in_desc(op, block, "LSTMWeight")
+    if x is None or w is None:
+        return
+    d = w.shape[1] // 4
+    set_output(block, op, "Hidden", [-1, d], x.dtype, lod_level=1)
+    set_output(block, op, "Cell", [-1, d], x.dtype, lod_level=1)
+    for slot, width in (("AttentionedX", 1), ("AttentionFCOut", 1),
+                        ("LSTMX", x.shape[-1]), ("LSTMOUT", 4 * d)):
+        if op.output(slot) and op.output(slot)[0]:
+            set_output(block, op, slot, [-1, width], x.dtype, lod_level=0)
+
+
+@register_op("attention_lstm", infer_shape=_attention_lstm_infer,
+             diff_inputs=["X", "AttentionWeight", "AttentionBias",
+                          "AttentionScalar", "AttentionScalarBias",
+                          "LSTMWeight", "LSTMBias", "H0", "C0"])
+def _attention_lstm(ctx, ins, attrs):
+    """Attention LSTM (reference: operators/attention_lstm_op.cc).  Per
+    step: score every token with relu(x@w_x + c_prev@w_c [, *scalar +
+    scalar_bias relu'd again]), softmax over the sequence, sum-pool the
+    attended tokens into lstm_x, then one LSTM step whose 4D gate buffer
+    is ordered [forget, input, output, candidate] (the reference doc's
+    concat[forget, input, output, tilde]; note this differs from lstm_op's
+    [c, i, f, o]).  LSTMWeight rows are [hidden (D), input (M)]."""
+    x = ins["X"][0]
+    d = data(x)                                  # [N, T, M]
+    l = lengths(x)
+    if l is None:
+        l = jnp.full((d.shape[0],), d.shape[1], dtype=jnp.int32)
+    n, t, m = d.shape
+    aw = data(ins["AttentionWeight"][0]).reshape(-1)   # [(M+D)]
+    ab = (data(ins["AttentionBias"][0]).reshape(())
+          if ins.get("AttentionBias") and ins["AttentionBias"] else None)
+    a_scal = (data(ins["AttentionScalar"][0]).reshape(())
+              if ins.get("AttentionScalar") and ins["AttentionScalar"] else None)
+    a_scal_b = (data(ins["AttentionScalarBias"][0]).reshape(())
+                if ins.get("AttentionScalarBias") and ins["AttentionScalarBias"] else None)
+    lw = data(ins["LSTMWeight"][0])              # [(D+M), 4D]
+    lb = data(ins["LSTMBias"][0]).reshape(-1)    # [4D]
+    dim = lw.shape[1] // 4
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(attrs.get("cell_activation", "tanh"))
+    act_cand = _act(attrs.get("candidate_activation", "tanh"))
+
+    # x(T x M) @ atten_w[:M] (+ bias, relu'd later with the cell part)
+    atted_x = jnp.einsum("ntm,m->nt", d, aw[:m])
+    if ab is not None:
+        atted_x = atted_x + ab
+    mask = jnp.arange(t)[None, :] < l[:, None]   # [N, T]
+    h0 = (data(ins["H0"][0]) if ins.get("H0") and ins["H0"]
+          else jnp.zeros((n, dim), d.dtype))
+    c0 = data(ins["C0"][0])                      # required by the reference
+
+    def step(carry, _x_t, step_mask):
+        h_prev, c_prev = carry
+        # 1. attention: score depends on the previous cell state
+        pcb = c_prev @ aw[m:]                    # [N]
+        score = jax.nn.relu(atted_x + pcb[:, None])
+        if a_scal is not None:
+            score = score * a_scal
+            if a_scal_b is not None:
+                score = score + a_scal_b
+            score = jax.nn.relu(score)
+        score = jnp.where(mask, score, -jnp.inf)
+        alpha = jax.nn.softmax(score, axis=1)    # [N, T]
+        lstm_x = jnp.einsum("nt,ntm->nm", alpha, d)
+        # 2. LSTM step, [f, i, o, cand] gate order
+        gates = lstm_x @ lw[dim:] + h_prev @ lw[:dim] + lb
+        f = act_gate(gates[:, :dim])
+        i = act_gate(gates[:, dim:2 * dim])
+        o = act_gate(gates[:, 2 * dim:3 * dim])
+        cand = act_cand(gates[:, 3 * dim:])
+        c = f * c_prev + i * cand
+        h = o * act_cell(c)
+        mf = step_mask.astype(d.dtype)       # [N, 1]
+        h_new = h * mf + h_prev * (1 - mf)
+        c_new = c * mf + c_prev * (1 - mf)
+        return (h_new, c_new), (h * mf, c * mf, alpha * mf, lstm_x * mf,
+                                gates * mf)
+
+    (_, _), (hs, cs, alphas, lstm_xs, lstm_outs) = _scan_time_major(
+        step, (h0, c0), jnp.zeros((n, t, 0), d.dtype), mask
+    )
+    return {
+        "Hidden": [LoDValue(hs, l)],
+        "Cell": [LoDValue(cs, l)],
+        "AttentionedX": [atted_x.reshape(n * t, 1)],
+        "AttentionFCOut": [alphas[:, -1].reshape(-1, 1)],
+        "LSTMX": [lstm_xs[:, -1]],
+        "LSTMOUT": [lstm_outs[:, -1]],
+    }

@@ -20,7 +20,7 @@ import numpy as np
 from ..core.lod import LoDValue
 from ..core.proto import DataType
 from ..core.registry import register_op
-from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
+from .common import ACTS, data, in_desc, lengths, same_shape, set_output, wrap_lod
 
 
 def _as_lod(x):
@@ -517,19 +517,10 @@ def _seq_conv_infer(op, block):
     set_output(block, op, "Out", [-1, x.shape[1]], xin.dtype, lod_level=1)
 
 
-@register_op("sequence_conv", infer_shape=_seq_conv_infer, diff_inputs=["X", "Filter"])
-def _sequence_conv(ctx, ins, attrs):
-    """Context-window convolution over time (reference:
-    operators/sequence_ops/sequence_conv_op.cc, math/context_project.h):
-    im2col the [contextStart, contextStart+contextLength) window per step
-    (zero outside the sequence) then one matmul with the filter."""
-    x = ins["X"][0]
-    d, l = _as_lod(x)
-    filt = data(ins["Filter"][0])  # [context_length * F, out]
-    clen = int(attrs.get("contextLength", 3))
-    cstart = int(attrs.get("contextStart", -((clen - 1) // 2)))
-    n, t = d.shape[0], d.shape[1]
-    f = d.shape[2]
+def _context_window(d, l, clen, cstart):
+    """im2col over the time axis: gather the [cstart, cstart+clen) context
+    window per step, zero outside the sequence (math/context_project.h)."""
+    t = d.shape[1]
     m = _fmask(d, l).astype(d.dtype)
     dm = d * m
     cols = []
@@ -542,9 +533,25 @@ def _sequence_conv(ctx, ins, attrs):
         ok_seq = (ar[None, :] < l[:, None]) & (ar[None, :] >= 0)
         rolled = rolled * ok_seq[..., None].astype(d.dtype)
         cols.append(rolled)
-    ctx_feat = jnp.concatenate(cols, axis=-1)  # [N, T, clen*F]
+    out = jnp.concatenate(cols, axis=-1)  # [N, T, clen*F]
+    # zero the padded target rows too (roll wraps valid data into them)
+    return out * _time_mask(d, l)[..., None].astype(d.dtype)
+
+
+@register_op("sequence_conv", infer_shape=_seq_conv_infer, diff_inputs=["X", "Filter"])
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution over time (reference:
+    operators/sequence_ops/sequence_conv_op.cc, math/context_project.h):
+    im2col the [contextStart, contextStart+contextLength) window per step
+    (zero outside the sequence) then one matmul with the filter."""
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    filt = data(ins["Filter"][0])  # [context_length * F, out]
+    clen = int(attrs.get("contextLength", 3))
+    cstart = int(attrs.get("contextStart", -((clen - 1) // 2)))
+    ctx_feat = _context_window(d, l, clen, cstart)
+    # padded rows of ctx_feat are already zero, so the matmul output is too
     out = jnp.einsum("ntf,fo->nto", ctx_feat, filt)
-    out = out * _time_mask(d, l)[..., None].astype(out.dtype)
     return {"Out": [LoDValue(out, l)]}
 
 
@@ -599,3 +606,80 @@ def _im2sequence(ctx, ins, attrs):
     out = jnp.transpose(patches.reshape(n, ckk, -1), (0, 2, 1))  # [N, OH*OW, C*kh*kw]
     lengths = jnp.full((n,), out.shape[1], dtype=jnp.int32)
     return {"Out": [LoDValue(out, lengths)]}
+
+
+# ---------------------------------------------------------------------------
+# fused sequence ops (reference: operators/fused/ — MKLDNN-era fusions; on
+# TPU each is a handful of XLA-fusable primitives around one MXU matmul)
+# ---------------------------------------------------------------------------
+def _seqconv_eltadd_relu_infer(op, block):
+    x = in_desc(op, block, "X")
+    f = in_desc(op, block, "Filter")
+    if x is None or f is None:
+        return
+    set_output(block, op, "Out", [-1, f.shape[1]], x.dtype, lod_level=1)
+    if op.output("ColMat") and op.output("ColMat")[0]:
+        set_output(block, op, "ColMat", [-1, f.shape[0]], x.dtype, lod_level=0)
+
+
+@register_op("fusion_seqconv_eltadd_relu",
+             infer_shape=_seqconv_eltadd_relu_infer,
+             diff_inputs=["X", "Filter", "Bias"])
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    """relu(sequence_conv(X, Filter) + Bias) in one op (reference:
+    operators/fused/fusion_seqconv_eltadd_relu_op.cc; contextStride must
+    be 1).  ColMat is the im2col intermediate the reference exposes."""
+    if int(attrs.get("contextStride", 1)) != 1:
+        raise ValueError("fusion_seqconv_eltadd_relu supports contextStride=1 only")
+    x = ins["X"][0]
+    d, l = _as_lod(x)
+    filt = data(ins["Filter"][0])          # [clen*F, out]
+    bias = data(ins["Bias"][0]).reshape(-1)  # [out]
+    clen = int(attrs.get("contextLength", 3))
+    cstart = int(attrs.get("contextStart", 0))
+    ctx_feat = _context_window(d, l, clen, cstart)
+    out = jax.nn.relu(jnp.einsum("ntf,fo->nto", ctx_feat, filt) + bias)
+    out = out * _time_mask(d, l)[..., None].astype(out.dtype)
+    return {"Out": [LoDValue(out, l)], "ColMat": [LoDValue(ctx_feat, l)]}
+
+
+def _seqexpand_concat_fc_infer(op, block):
+    x = in_desc(op, block, "X")
+    w = in_desc(op, block, "FCWeight")
+    if x is None or w is None:
+        return
+    set_output(block, op, "Out", [-1, w.shape[1]], x.dtype, lod_level=1)
+    if op.output("FCOut") and op.output("FCOut")[0]:
+        set_output(block, op, "FCOut", [-1, w.shape[1]], x.dtype, lod_level=0)
+
+
+@register_op("fusion_seqexpand_concat_fc",
+             infer_shape=_seqexpand_concat_fc_infer,
+             diff_inputs=["X", "FCWeight", "FCBias"])
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """sequence_expand(ref_level=0) + concat(axis=1) + fc in one op
+    (reference: operators/fused/fusion_seqexpand_concat_fc_op.cc): X[0] is
+    the LoD sequence [N, T, M0]; X[1:] are one row per sequence [N, Mi]
+    broadcast over time.  out_t = act(x0_t @ W[:M0] + [x1_i, ...] @ W[M0:]
+    + b); the per-sequence half (the reference's FCOut scratch) is computed
+    once per sequence, not per token."""
+    xs = ins["X"]
+    x0 = xs[0]
+    d, l = _as_lod(x0)
+    w = data(ins["FCWeight"][0])           # [M0+M1+..., D]
+    m0 = d.shape[-1]
+    tok = jnp.einsum("ntm,md->ntd", d, w[:m0])
+    rest = [data(v).reshape(d.shape[0], -1) for v in xs[1:]]
+    fc_out = None
+    if rest:
+        cat = jnp.concatenate(rest, axis=-1)  # [N, M1+M2+...]
+        fc_out = cat @ w[m0:]                 # [N, D]
+        tok = tok + fc_out[:, None, :]
+    if ins.get("FCBias") and ins["FCBias"]:
+        tok = tok + data(ins["FCBias"][0]).reshape(-1)
+    act = ACTS[attrs.get("fc_activation", "identity") or "identity"]
+    out = act(tok) * _time_mask(d, l)[..., None].astype(d.dtype)
+    outs = {"Out": [LoDValue(out, l)]}
+    if fc_out is not None:
+        outs["FCOut"] = [fc_out]
+    return outs
