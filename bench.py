@@ -115,16 +115,37 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         baseline = None
         flops_per_item = 3 * 5e6
         lr = 0.01
+    elif model in ("vgg19", "vgg19_infer"):
+        # IntelOptimizedPaddle.md:33-38/74-79: train bs=64 28.46 img/s,
+        # infer bs=1 75.07 img/s (MKL-DNN, 2x Xeon 6148, ImageNet shapes)
+        infer = model.endswith("_infer")
+        bs = int(os.environ.get("BENCH_VGG_BS", "1" if infer else "64"))
+        spec = models.vgg19()
+        unit = "images/sec"
+        items_per_step = bs
+        metric = ("vgg19_infer_images_per_sec_per_chip" if infer
+                  else "vgg19_train_images_per_sec_per_chip")
+        baseline = 75.07 if infer else 28.46
+        flops_per_item = 19.6e9 if infer else 3 * 19.6e9
+        lr = 0.01
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
-                         "(expected resnet50|transformer|deepfm|lstm|lenet)")
+                         "(expected resnet50|transformer|deepfm|lstm|lenet|"
+                         "vgg19|vgg19_infer)")
 
+    run_program = None
+    fetch_var = spec.loss
     if model == "deepfm":
         # lazy sparse adam over the 1e6-row tables: only touched rows
         # update, so the step never sweeps the vocab (the SelectedRows path)
         fluid.optimizer.AdamOptimizer(
             learning_rate=lr, lazy_mode=True
         ).minimize(spec.loss)
+    elif model.endswith("_infer"):
+        # inference: no optimizer; dropout/batch_norm switch to test mode
+        # (the predictor API wraps this same clone, inference/__init__.py)
+        run_program = fluid.default_main_program().clone(for_test=True)
+        fetch_var = spec.extras["predict"]
     else:
         fluid.optimizer.MomentumOptimizer(
             learning_rate=lr, momentum=0.9
@@ -162,15 +183,16 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     # committed-state jit variant also compiles before timing starts
     warm = None
     for i in range(len(batches) + 1):
-        (warm,) = exe.run(feed=batches[i % len(batches)],
-                          fetch_list=[spec.loss], return_numpy=False)
+        (warm,) = exe.run(program=run_program,
+                          feed=batches[i % len(batches)],
+                          fetch_list=[fetch_var], return_numpy=False)
     jax.block_until_ready(warm)
 
     t0 = time.perf_counter()
     loss_v = None
     for i in range(steps):
-        (loss_v,) = exe.run(feed=batches[i % 4], fetch_list=[spec.loss],
-                            return_numpy=False)
+        (loss_v,) = exe.run(program=run_program, feed=batches[i % 4],
+                            fetch_list=[fetch_var], return_numpy=False)
     jax.block_until_ready(loss_v)
     dt = time.perf_counter() - t0
 

@@ -67,3 +67,22 @@ def test_chunk_evaluator_accumulates():
         )
     p, r, f1 = ev.eval(exe)
     assert float(p) == 1.0 and float(r) == 1.0 and float(f1) == 1.0
+
+
+def test_vgg19_builds_and_infers():
+    """VGG-19 (IntelOptimizedPaddle.md benchmark model): depth-19 block
+    layout builds, and the for_test clone runs a forward pass."""
+    spec = models.vgg19(class_num=10, img_shape=(3, 32, 32))
+    # 19 = 16 convs + 3 fc; count conv2d ops in the program
+    prog = fluid.default_main_program()
+    n_convs = sum(1 for op in prog.global_block().ops if op.type == "conv2d")
+    assert n_convs == 16
+    test_prog = prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    batch = spec.synthetic_batch(2)
+    (pred,) = exe.run(program=test_prog, feed=batch,
+                      fetch_list=[spec.extras["predict"]])
+    pred = np.asarray(pred)
+    assert pred.shape == (2, 10)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-4)
