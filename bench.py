@@ -115,11 +115,33 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         baseline = None
         flops_per_item = 3 * 5e6
         lr = 0.01
+    elif model == "alexnet":
+        # IntelOptimizedPaddle.md:61-66: train bs=64 399.00 img/s (MKL-DNN)
+        bs = int(os.environ.get("BENCH_ALEXNET_BS", "64"))
+        spec = models.alexnet()
+        unit = "images/sec"
+        items_per_step = bs
+        metric = "alexnet_train_images_per_sec_per_chip"
+        baseline = 399.00
+        flops_per_item = 3 * 1.4e9  # fwd ~0.7 GMAC @227
+        lr = 0.01
+    elif model == "googlenet":
+        # IntelOptimizedPaddle.md:52-56: train bs=64 250.46 img/s (MKL-DNN)
+        bs = int(os.environ.get("BENCH_GOOGLENET_BS", "64"))
+        spec = models.googlenet()
+        unit = "images/sec"
+        items_per_step = bs
+        metric = "googlenet_train_images_per_sec_per_chip"
+        baseline = 250.46
+        flops_per_item = 3 * 3.0e9  # fwd ~1.5 GMAC @224
+        lr = 0.01
     elif model in ("vgg19", "vgg19_infer"):
         # IntelOptimizedPaddle.md:33-38/74-79: train bs=64 28.46 img/s,
         # infer bs=1 75.07 img/s (MKL-DNN, 2x Xeon 6148, ImageNet shapes)
         infer = model.endswith("_infer")
-        bs = int(os.environ.get("BENCH_VGG_BS", "1" if infer else "64"))
+        bs = int(os.environ.get(
+            "BENCH_VGG_INFER_BS" if infer else "BENCH_VGG_BS",
+            "1" if infer else "64"))
         spec = models.vgg19()
         unit = "images/sec"
         items_per_step = bs
@@ -131,7 +153,7 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
                          "(expected resnet50|transformer|deepfm|lstm|lenet|"
-                         "vgg19|vgg19_infer)")
+                         "alexnet|googlenet|vgg19|vgg19_infer)")
 
     run_program = None
     fetch_var = spec.loss
@@ -198,9 +220,10 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
 
     value = items_per_step * steps / dt
     mfu = value * flops_per_item / peak_flops
+    tag = "final_fetch" if model.endswith("_infer") else "final_loss"
     sys.stderr.write(
         f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
-        f"mfu={mfu:.3f} final_loss={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
+        f"mfu={mfu:.3f} {tag}={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
     )
     return {
         "metric": metric,

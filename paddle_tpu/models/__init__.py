@@ -11,6 +11,8 @@ benchmarking without datasets.
 from .common import ModelSpec  # noqa: F401
 from .mnist import lenet5  # noqa: F401
 from .resnet import resnet_cifar10, resnet_imagenet  # noqa: F401
+from .alexnet import alexnet  # noqa: F401
+from .googlenet import googlenet  # noqa: F401
 from .vgg import vgg16, vgg19  # noqa: F401
 from .transformer import transformer, TransformerConfig  # noqa: F401
 from .stacked_lstm import stacked_dynamic_lstm  # noqa: F401

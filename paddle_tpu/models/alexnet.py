@@ -1,0 +1,60 @@
+"""AlexNet — the benchmark/paddle/image/alexnet.py config (conv11s4-96 +
+LRN, conv5-256 + LRN, conv3-384 x2, conv3-256, three maxpools, fc4096 x2
+with dropout, softmax-1000; published baseline: 399 img/s train bs=64 on
+2x Xeon 6148, benchmark/IntelOptimizedPaddle.md:61-66)."""
+
+from __future__ import annotations
+
+import functools
+
+from .. import layers
+from .common import ModelSpec, class_batch
+
+
+def alexnet(
+    img=None, label=None, class_num: int = 1000, img_shape=(3, 227, 227)
+) -> ModelSpec:
+    if img is None:
+        img = layers.data("image", list(img_shape), dtype="float32")
+    if label is None:
+        label = layers.data("label", [1], dtype="int64")
+
+    c1 = layers.conv2d(img, num_filters=96, filter_size=11, stride=4,
+                       padding=1, act="relu")
+    c1 = layers.lrn(c1, n=5, alpha=1e-4, beta=0.75)
+    p1 = layers.pool2d(c1, pool_size=3, pool_stride=2, pool_type="max")
+
+    c2 = layers.conv2d(p1, num_filters=256, filter_size=5, padding=2,
+                       act="relu")
+    c2 = layers.lrn(c2, n=5, alpha=1e-4, beta=0.75)
+    p2 = layers.pool2d(c2, pool_size=3, pool_stride=2, pool_type="max")
+
+    c3 = layers.conv2d(p2, num_filters=384, filter_size=3, padding=1,
+                       act="relu")
+    c4 = layers.conv2d(c3, num_filters=384, filter_size=3, padding=1,
+                       act="relu")
+    c5 = layers.conv2d(c4, num_filters=256, filter_size=3, padding=1,
+                       act="relu")
+    p5 = layers.pool2d(c5, pool_size=3, pool_stride=2, pool_type="max")
+
+    fc6 = layers.fc(p5, size=4096, act="relu")
+    fc6 = layers.dropout(fc6, dropout_prob=0.5)
+    fc7 = layers.fc(fc6, size=4096, act="relu")
+    fc7 = layers.dropout(fc7, dropout_prob=0.5)
+    predict = layers.fc(fc7, size=class_num, act="softmax")
+
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+
+    return ModelSpec(
+        name="alexnet",
+        feed_names=[img.name, label.name],
+        loss=avg_cost,
+        metrics={"acc": acc},
+        synthetic_batch=functools.partial(
+            class_batch, img_shape=tuple(img_shape), num_classes=class_num,
+            img_name=img.name, label_name=label.name,
+        ),
+        extras={"predict": predict},
+    )

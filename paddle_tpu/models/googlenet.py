@@ -1,0 +1,77 @@
+"""GoogLeNet (Inception v1) — the benchmark/paddle/image/googlenet.py
+config: stem conv7s2 + two convs, 9 inception modules, global 7x7 avg
+pool, softmax-1000.  Aux towers are dropped exactly as the reference
+benchmark drops them ("We remove loss1 and loss2 ... when testing
+benchmark", googlenet.py:221).  Published baseline: 250.46 img/s train
+bs=64 on 2x Xeon 6148 (benchmark/IntelOptimizedPaddle.md:52-56)."""
+
+from __future__ import annotations
+
+import functools
+
+from .. import layers
+from .common import ModelSpec, class_batch
+
+
+def _inception(x, f1, f3r, f3, f5r, f5, proj):
+    b1 = layers.conv2d(x, num_filters=f1, filter_size=1, act="relu")
+    b3 = layers.conv2d(x, num_filters=f3r, filter_size=1, act="relu")
+    b3 = layers.conv2d(b3, num_filters=f3, filter_size=3, padding=1,
+                       act="relu")
+    b5 = layers.conv2d(x, num_filters=f5r, filter_size=1, act="relu")
+    b5 = layers.conv2d(b5, num_filters=f5, filter_size=5, padding=2,
+                       act="relu")
+    bp = layers.pool2d(x, pool_size=3, pool_stride=1, pool_padding=1,
+                       pool_type="max")
+    bp = layers.conv2d(bp, num_filters=proj, filter_size=1, act="relu")
+    return layers.concat([b1, b3, b5, bp], axis=1)
+
+
+def googlenet(
+    img=None, label=None, class_num: int = 1000, img_shape=(3, 224, 224)
+) -> ModelSpec:
+    if img is None:
+        img = layers.data("image", list(img_shape), dtype="float32")
+    if label is None:
+        label = layers.data("label", [1], dtype="int64")
+
+    x = layers.conv2d(img, num_filters=64, filter_size=7, stride=2,
+                      padding=3, act="relu")
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_type="max")
+    x = layers.conv2d(x, num_filters=64, filter_size=1, act="relu")
+    x = layers.conv2d(x, num_filters=192, filter_size=3, padding=1,
+                      act="relu")
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_type="max")
+
+    x = _inception(x, 64, 96, 128, 16, 32, 32)      # 3a -> 256
+    x = _inception(x, 128, 128, 192, 32, 96, 64)    # 3b -> 480
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_type="max")
+
+    x = _inception(x, 192, 96, 208, 16, 48, 64)     # 4a -> 512
+    x = _inception(x, 160, 112, 224, 24, 64, 64)    # 4b
+    x = _inception(x, 128, 128, 256, 24, 64, 64)    # 4c
+    x = _inception(x, 112, 144, 288, 32, 64, 64)    # 4d -> 528
+    x = _inception(x, 256, 160, 320, 32, 128, 128)  # 4e -> 832
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_type="max")
+
+    x = _inception(x, 256, 160, 320, 32, 128, 128)  # 5a
+    x = _inception(x, 384, 192, 384, 48, 128, 128)  # 5b -> 1024
+    x = layers.pool2d(x, pool_size=7, pool_stride=7, pool_type="avg")
+    x = layers.dropout(x, dropout_prob=0.4)
+
+    predict = layers.fc(x, size=class_num, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+
+    return ModelSpec(
+        name="googlenet",
+        feed_names=[img.name, label.name],
+        loss=avg_cost,
+        metrics={"acc": acc},
+        synthetic_batch=functools.partial(
+            class_batch, img_shape=tuple(img_shape), num_classes=class_num,
+            img_name=img.name, label_name=label.name,
+        ),
+        extras={"predict": predict},
+    )

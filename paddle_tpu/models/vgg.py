@@ -69,10 +69,8 @@ def vgg19(img=None, label=None, class_num: int = 1000,
     """The IntelOptimizedPaddle.md VGG-19 benchmark config (ImageNet
     shapes; train bs=64 28.46 img/s, infer bs=1 75.07 img/s on 2x Xeon
     6148 are the published baselines)."""
+    import dataclasses
+
     spec = vgg16(img, label, class_num=class_num, img_shape=img_shape,
                  depth=19)
-    return ModelSpec(
-        name="vgg19", feed_names=spec.feed_names, loss=spec.loss,
-        metrics=spec.metrics, synthetic_batch=spec.synthetic_batch,
-        extras=spec.extras,
-    )
+    return dataclasses.replace(spec, name="vgg19")

@@ -86,3 +86,21 @@ def test_vgg19_builds_and_infers():
     pred = np.asarray(pred)
     assert pred.shape == (2, 10)
     np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_alexnet_googlenet_forward():
+    """AlexNet + GoogLeNet (benchmark/paddle/image/{alexnet,googlenet}.py
+    configs) build at benchmark shapes and produce valid softmax output."""
+    for builder in (models.alexnet, models.googlenet):
+        fluid.reset_default_env()
+        spec = builder(class_num=10)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        batch = spec.synthetic_batch(2)
+        (pred,) = exe.run(program=test_prog, feed=batch,
+                          fetch_list=[spec.extras["predict"]])
+        pred = np.asarray(pred)
+        assert pred.shape == (2, 10), spec.name
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-4,
+                                   err_msg=spec.name)
