@@ -18,29 +18,72 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["enable_amp", "disable_amp", "amp_dtype", "state_key",
-           "mxu_operands", "mxu_output"]
+__all__ = ["enable_amp", "disable_amp", "amp_dtype", "keep_output",
+           "state_key", "mxu_operands", "mxu_output", "stats_dtype",
+           "match_kept"]
 
-_POLICY = {"dtype": None}
+_POLICY = {"dtype": None, "keep": False}
 
 
-def enable_amp(dtype: str = "bfloat16") -> None:
-    """Turn on mixed precision: matmul/conv compute in `dtype`."""
+def enable_amp(dtype: str = "bfloat16", keep_output: bool = False) -> None:
+    """Turn on mixed precision: matmul/conv compute in `dtype`.
+
+    keep_output=True is the aggressive tier: matmul/conv outputs STAY in
+    the compute dtype, so the elementwise chains between them (batch_norm
+    apply, relu, residual adds, pooling) read and write half-width
+    activations — ResNet-style models are HBM-bandwidth bound there.
+    Normalization statistics and losses still accumulate in fp32 (the
+    lowerings upcast internally via stats_dtype()), and params/optimizer
+    state remain fp32 master weights either way."""
     _POLICY["dtype"] = jnp.dtype(dtype)
+    _POLICY["keep"] = bool(keep_output)
 
 
 def disable_amp() -> None:
     _POLICY["dtype"] = None
+    _POLICY["keep"] = False
 
 
 def amp_dtype():
     return _POLICY["dtype"]
 
 
+def keep_output() -> bool:
+    return _POLICY["keep"]
+
+
+def stats_dtype(x):
+    """The dtype reductions (norm statistics, softmax, loss sums) should
+    accumulate in for activations of x's dtype: fp32 for any half-width
+    input, x.dtype otherwise."""
+    if getattr(x, "dtype", None) in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return x.dtype
+
+
+def match_kept(x, y):
+    """In keep_output mode, a binary elementwise op over a half-width
+    activation and an fp32 array (the fc/conv bias add, residual scales)
+    must NOT let numpy promotion upcast the result back to fp32 — that
+    would silently re-widen the whole activation chain.  Cast the fp32
+    side down; outside keep mode return the pair untouched."""
+    if not _POLICY["keep"]:
+        return x, y
+    half = (jnp.bfloat16, jnp.float16)
+    xd, yd = getattr(x, "dtype", None), getattr(y, "dtype", None)
+    if xd in half and yd == jnp.float32:
+        return x, y.astype(xd)
+    if yd in half and xd == jnp.float32:
+        return x.astype(yd), y
+    return x, y
+
+
 def state_key():
     """Hashable policy fingerprint for compiled-program cache keys."""
     d = _POLICY["dtype"]
-    return str(d) if d is not None else None
+    if d is None:
+        return None
+    return (str(d), _POLICY["keep"])
 
 
 def mxu_operands(*arrays):
@@ -63,6 +106,8 @@ def mxu_output(out, *orig_operands):
     model's matmul outputs stay bf16, matching its descs."""
     d = _POLICY["dtype"]
     if d is None or getattr(out, "dtype", None) != d:
+        return out
+    if _POLICY["keep"]:
         return out
     if any(getattr(a, "dtype", None) == jnp.float32 for a in orig_operands):
         return out.astype(jnp.float32)

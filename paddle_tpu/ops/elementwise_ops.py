@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core import amp
 from ..core.lod import LoDValue
 from ..core.registry import register_op
 from .common import broadcast_y, data, elemwise_shape, wrap_lod
@@ -23,7 +24,10 @@ def _make(name, fn):
         if isinstance(x, LoDValue) and not isinstance(y, LoDValue) and axis >= 0:
             axis += 1
         yb = broadcast_y(data(x), data(y), axis)
-        return {"Out": [wrap_lod(x, _fn(data(x), yb))]}
+        # amp keep_output: an fp32 bias/scale must not re-widen a bf16
+        # activation chain through numpy promotion
+        xd, yb = amp.match_kept(data(x), yb)
+        return {"Out": [wrap_lod(x, _fn(xd, yb))]}
 
     return _lower
 

@@ -330,7 +330,8 @@ def _fc(ctx, ins, attrs):
     out = amp.mxu_output(xc @ wc, x2, w)
     bias_in = ins.get("Bias", [None])[0]
     if bias_in is not None:
-        out = out + data(bias_in).reshape(1, -1)
+        out, b = amp.match_kept(out, data(bias_in).reshape(1, -1))
+        out = out + b
     if attrs.get("activation_type"):
         act = attrs["activation_type"]
         out = {"relu": jax.nn.relu}[act](out)

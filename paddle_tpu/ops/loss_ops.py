@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import amp
 from ..core.proto import DataType
 from ..core.registry import register_op
 from .common import data, in_desc, same_shape, set_output, wrap_lod
@@ -39,7 +40,10 @@ def _cross_entropy_infer(op, block):
 def _cross_entropy(ctx, ins, attrs):
     """-log(prob[label]) over *probabilities* (reference:
     operators/cross_entropy_op.cc; soft_label supported)."""
-    x = data(ins["X"][0])
+    x0 = data(ins["X"][0])
+    # the log and its reduction run fp32 for half-width probabilities
+    # (amp keep_output; eps=1e-12 is below bf16 resolution)
+    x = x0.astype(amp.stats_dtype(x0))
     label = data(ins["Label"][0])
     eps = 1e-12
     if attrs.get("soft_label", False):
@@ -50,7 +54,7 @@ def _cross_entropy(ctx, ins, attrs):
         ignore = attrs.get("ignore_index", -100)
         mask = (lab != ignore)[..., None]
         loss = jnp.where(mask, loss, 0.0)
-    return {"Y": [wrap_lod(ins["X"][0], loss)]}
+    return {"Y": [wrap_lod(ins["X"][0], loss.astype(x0.dtype))]}
 
 
 def _swce_infer(op, block):
@@ -67,7 +71,8 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     operators/softmax_with_cross_entropy_op.cc)."""
     logits = data(ins["Logits"][0])
     label = data(ins["Label"][0])
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # bf16 logits (amp keep_output) reduce in fp32
+    logp = jax.nn.log_softmax(logits.astype(amp.stats_dtype(logits)), axis=-1)
     softmax = jnp.exp(logp)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
@@ -79,7 +84,9 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
         loss = -picked
         ignore = attrs.get("ignore_index", -100)
         loss = jnp.where((lab != ignore)[..., None], loss, 0.0)
-    return {"Softmax": [softmax], "Loss": [loss]}
+    # outputs keep the logits' dtype (the fp32 math above is internal)
+    return {"Softmax": [softmax.astype(logits.dtype)],
+            "Loss": [loss.astype(logits.dtype)]}
 
 
 @register_op("sigmoid_cross_entropy_with_logits", infer_shape=same_shape(), diff_inputs=["X"])

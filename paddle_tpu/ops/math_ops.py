@@ -190,7 +190,11 @@ def _mean_infer(op, block):
 
 @register_op("mean", infer_shape=_mean_infer)
 def _mean(ctx, ins, attrs):
-    return {"Out": [jnp.reshape(jnp.mean(data(ins["X"][0])), (1,))]}
+    # half-width inputs (amp keep_output) accumulate in fp32; the output
+    # rounds back to the input dtype
+    d = data(ins["X"][0])
+    out = jnp.mean(d.astype(amp.stats_dtype(d))).astype(d.dtype)
+    return {"Out": [jnp.reshape(out, (1,))]}
 
 
 @register_op("cumsum", infer_shape=same_shape())

@@ -355,20 +355,28 @@ def _batch_norm(ctx, ins, attrs):
         saved_mean = mean
         saved_var = var
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        # statistics always accumulate in fp32, even for bf16 activations
+        # (amp keep_output mode); the moving-stat state vars are fp32
+        xs = x.astype(amp.stats_dtype(x))
+        use_mean = jnp.mean(xs, axis=axes)
+        use_var = jnp.var(xs, axis=axes)
         new_mean = momentum * mean + (1.0 - momentum) * use_mean
         new_var = momentum * var + (1.0 - momentum) * use_var
         saved_mean, saved_var = use_mean, use_var
 
     inv = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    # the normalize+affine runs in fp32 inside the fusion but the HBM
+    # write of y matches x's dtype (bf16 in keep_output mode)
+    y = (
+        x.astype(inv.dtype) - use_mean.reshape(bshape)
+    ) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    y = y.astype(x.dtype)
     return {
         "Y": [y],
         "MeanOut": [new_mean],
         "VarianceOut": [new_var],
-        "SavedMean": [saved_mean],
-        "SavedVariance": [inv],
+        "SavedMean": [saved_mean.astype(x.dtype)],
+        "SavedVariance": [inv.astype(x.dtype)],
     }
 
 
@@ -394,9 +402,12 @@ def _layer_norm(ctx, ins, attrs):
     begin = attrs.get("begin_norm_axis", 1)
     eps = attrs.get("epsilon", 1e-5)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # stats in fp32 even for bf16 activations (amp keep_output mode); the
+    # HBM write of Y matches x's dtype
+    xs = x.astype(amp.stats_dtype(x))
+    mean = jnp.mean(xs, axis=axes, keepdims=True)
+    var = jnp.var(xs, axis=axes, keepdims=True)
+    y = (xs - mean) * jax.lax.rsqrt(var + eps)
     scale = ins.get("Scale", [None])[0]
     bias = ins.get("Bias", [None])[0]
     tail_shape = (1,) * begin + x.shape[begin:]
@@ -405,9 +416,9 @@ def _layer_norm(ctx, ins, attrs):
     if bias is not None:
         y = y + jnp.reshape(data(bias), tail_shape)
     return {
-        "Y": [y],
-        "Mean": [jnp.reshape(mean, (-1,))],
-        "Variance": [jnp.reshape(var, (-1,))],
+        "Y": [y.astype(x.dtype)],
+        "Mean": [jnp.reshape(mean, (-1,)).astype(x.dtype)],
+        "Variance": [jnp.reshape(var, (-1,)).astype(x.dtype)],
     }
 
 
@@ -427,7 +438,8 @@ def _group_norm(ctx, ins, attrs):
     g = attrs.get("groups", 1)
     eps = attrs.get("epsilon", 1e-5)
     n, c = x.shape[:2]
-    xg = jnp.reshape(x, (n, g, c // g) + x.shape[2:])
+    xg = jnp.reshape(x.astype(amp.stats_dtype(x)),
+                     (n, g, c // g) + x.shape[2:])
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
@@ -440,9 +452,9 @@ def _group_norm(ctx, ins, attrs):
     if bias is not None:
         y = y + jnp.reshape(data(bias), bshape)
     return {
-        "Y": [y],
-        "Mean": [jnp.reshape(mean, (n, g))],
-        "Variance": [jnp.reshape(var, (n, g))],
+        "Y": [y.astype(x.dtype)],
+        "Mean": [jnp.reshape(mean, (n, g)).astype(x.dtype)],
+        "Variance": [jnp.reshape(var, (n, g)).astype(x.dtype)],
     }
 
 
@@ -489,7 +501,12 @@ def _lrn(ctx, ins, attrs):
 @register_op("softmax", infer_shape=same_shape())
 def _softmax(ctx, ins, attrs):
     x = ins["X"][0]
-    return {"Out": [wrap_lod(x, jax.nn.softmax(data(x), axis=attrs.get("axis", -1)))]}
+    d = data(x)
+    # bf16 logits (amp keep_output) exponentiate in fp32; the output
+    # dtype still matches the input's desc
+    out = jax.nn.softmax(d.astype(amp.stats_dtype(d)),
+                         axis=attrs.get("axis", -1)).astype(d.dtype)
+    return {"Out": [wrap_lod(x, out)]}
 
 
 def _dropout_infer(op, block):
@@ -890,9 +907,11 @@ def _conv2d_fusion(ctx, ins, attrs):
             "lowered; emit a separate split op")
     out = data(_conv2d_lower(ctx, ins, attrs)["Output"][0])
     if ins.get("ResidualData") and ins["ResidualData"]:
-        out = out + data(ins["ResidualData"][0])
+        out, r = amp.match_kept(out, data(ins["ResidualData"][0]))
+        out = out + r
     if ins.get("Bias") and ins["Bias"]:
-        out = out + data(ins["Bias"][0]).reshape(1, -1, 1, 1)
+        out, b = amp.match_kept(out, data(ins["Bias"][0]).reshape(1, -1, 1, 1))
+        out = out + b
     act = attrs.get("activation", "relu") or "identity"
     acts = {
         "identity": lambda x: x,

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import amp
 from ..core.proto import DataType
 from ..core.registry import register_op
 from .common import data, in_desc, set_output
@@ -39,7 +40,7 @@ def _reduce_infer_factory():
     return infer
 
 
-def _make_reduce(name, fn):
+def _make_reduce(name, fn, accumulates=False):
     @register_op(name, infer_shape=_reduce_infer_factory())
     def _lower(ctx, ins, attrs, _fn=fn):
         x = data(ins["X"][0])
@@ -47,7 +48,14 @@ def _make_reduce(name, fn):
         if isinstance(dims, int):
             dims = [dims]
         axis = None if attrs.get("reduce_all", False) else tuple(dims)
-        out = _fn(x, axis=axis, keepdims=attrs.get("keep_dim", False))
+        xa = x
+        if accumulates:
+            # sum/mean over half-width inputs (amp keep_output) accumulate
+            # in fp32; the output rounds back to the input dtype
+            xa = x.astype(amp.stats_dtype(x))
+        out = _fn(xa, axis=axis, keepdims=attrs.get("keep_dim", False))
+        if accumulates:
+            out = out.astype(x.dtype)
         if out.ndim == 0:
             out = jnp.reshape(out, (1,))
         return {"Out": [out]}
@@ -55,8 +63,8 @@ def _make_reduce(name, fn):
     return _lower
 
 
-_make_reduce("reduce_sum", jnp.sum)
-_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_sum", jnp.sum, accumulates=True)
+_make_reduce("reduce_mean", jnp.mean, accumulates=True)
 _make_reduce("reduce_max", jnp.max)
 _make_reduce("reduce_min", jnp.min)
 _make_reduce("reduce_prod", jnp.prod)

@@ -154,3 +154,98 @@ def test_amp_bf16_parity_and_dtype():
     # bf16 has ~3 decimal digits; training for 5 steps stays close
     np.testing.assert_allclose(amp_losses, ref_losses, rtol=0.05, atol=0.05)
     assert amp_losses[-1] < amp_losses[0]  # still learns
+
+
+def test_amp_keep_output_conv_bn_parity():
+    """Aggressive AMP (keep_output=True): activations stay bf16 through the
+    conv->bn->relu chain, BN stats accumulate fp32, master weights fp32;
+    training stays close to the fp32 run."""
+    import paddle_tpu.layers as layers
+
+    def build_and_run():
+        fluid.reset_default_env()
+        img = layers.data("img", [3, 8, 8], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)
+        b = layers.batch_norm(c, act="relu")
+        p = layers.pool2d(b, pool_size=8, pool_type="avg")
+        pred = layers.fc(p, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(3)
+        xv = rng.rand(8, 3, 8, 8).astype("float32")
+        yv = rng.randint(0, 4, (8, 1)).astype("int64")
+        losses = [
+            float(np.ravel(np.asarray(
+                exe.run(feed={"img": xv, "y": yv}, fetch_list=[loss])[0]
+            ))[0])
+            for _ in range(6)
+        ]
+        params = fluid.default_main_program().global_block().all_parameters()
+        pvals = {
+            p.name: np.asarray(fluid.global_scope().find_var(p.name))
+            for p in params
+        }
+        (act_v,) = exe.run(feed={"img": xv, "y": yv}, fetch_list=[b],
+                           return_numpy=False)
+        return losses, pvals, str(np.asarray(act_v).dtype)
+
+    ref_losses, ref_p, ref_dt = build_and_run()
+    assert ref_dt == "float32"
+    fluid.enable_amp("bfloat16", keep_output=True)
+    try:
+        amp_losses, amp_p, amp_dt = build_and_run()
+    finally:
+        fluid.disable_amp()
+
+    # the batch_norm output really is half-width — keep_output is not a
+    # silent no-op (the conv bias add must not re-widen the chain)
+    assert amp_dt == "bfloat16"
+    for name, v in amp_p.items():
+        assert v.dtype == np.float32, name  # master weights stay fp32
+    np.testing.assert_allclose(amp_losses, ref_losses, rtol=0.08, atol=0.08)
+    assert amp_losses[-1] < amp_losses[0]
+
+
+def test_amp_keep_output_layer_norm_parity():
+    """keep_output AMP through the matmul->layer_norm chain (the
+    transformer block pattern): fp32 stats, bf16 activation writes."""
+    import paddle_tpu.layers as layers
+
+    def build_and_run():
+        fluid.reset_default_env()
+        x = layers.data("x", [16], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, size=32)
+        h = layers.layer_norm(h)
+        h = layers.fc(h, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(11)
+        xv = rng.randn(8, 16).astype("float32")
+        yv = rng.randn(8, 1).astype("float32")
+        losses = [
+            float(np.ravel(np.asarray(
+                exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+            ))[0])
+            for _ in range(6)
+        ]
+        (hn,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[h],
+                        return_numpy=False)
+        return losses, str(np.asarray(hn).dtype)
+
+    ref, ref_dt = build_and_run()
+    assert ref_dt == "float32"
+    fluid.enable_amp("bfloat16", keep_output=True)
+    try:
+        got, got_dt = build_and_run()
+    finally:
+        fluid.disable_amp()
+    assert got_dt == "bfloat16"  # the post-norm activation stays half-width
+    np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.08)
+    assert got[-1] < got[0]
