@@ -27,6 +27,7 @@ from .core.framework import (  # noqa: F401
     default_main_program,
     default_startup_program,
     program_guard,
+    recompute_scope,
     reset_default_env,
 )
 from .core.place import (  # noqa: F401

@@ -285,6 +285,11 @@ def _lower_forward_op(ctx: LoweringContext, op: OpDesc, need_vjp: bool) -> None:
             out_spec_holder.append(out_spec)
         return tuple(out_leaves)
 
+    if attrs.get("@recompute@"):
+        # rematerialization (framework.recompute_scope): backward re-runs
+        # this op's lowering from its inputs instead of keeping internal
+        # activations resident — jax.checkpoint drops the residuals
+        fwd = jax.checkpoint(fwd)
     primal_outs, vjp_fn = jax.vjp(fwd, *leaves)
     out_spec = out_spec_holder[0]
     outs = {

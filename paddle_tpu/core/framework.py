@@ -48,6 +48,7 @@ __all__ = [
     "unique_name",
     "unique_name_guard",
     "grad_var_name",
+    "recompute_scope",
 ]
 
 
@@ -393,6 +394,9 @@ class Block:
     ) -> Operator:
         desc = OpDesc(type=type)
         self.desc.ops.append(desc)
+        if _RECOMPUTE_DEPTH[0] > 0:
+            attrs = dict(attrs or {})
+            attrs["@recompute@"] = True
         op = Operator(self, desc, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         return op
@@ -568,3 +572,33 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
         switch_main_program(prev_main)
         if prev_startup is not None:
             switch_startup_program(prev_startup)
+
+
+# ---------------------------------------------------------------------------
+# rematerialization (TPU-native; no 2018 reference analogue — later Paddle
+# grew RecomputeOptimizer for the same memory/FLOPs trade)
+# ---------------------------------------------------------------------------
+_RECOMPUTE_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def recompute_scope():
+    """Ops appended inside this scope carry the @recompute@ attr: the
+    compiler wraps each one's forward lowering in jax.checkpoint, so
+    backward re-runs the op from its inputs instead of keeping its
+    residuals.
+
+    The remat boundary is PER OP.  That drops op-INTERNAL state — which
+    is where the memory is for composite lowerings: fused_attention's
+    [B, H, S, S] probability matrix, lstm/gru scan per-step gates, a
+    while sub-block's carried intermediates.  Activations at op
+    boundaries (one op's output feeding the next) remain resident either
+    way, so tagging a chain of primitive ops (mul, softmax, add as
+    separate ops) costs recompute FLOPs without saving memory.  No 2018
+    reference analogue; later Paddle's RecomputeOptimizer trades the
+    same way at segment granularity."""
+    _RECOMPUTE_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _RECOMPUTE_DEPTH[0] -= 1

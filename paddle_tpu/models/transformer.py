@@ -19,12 +19,14 @@ TPU-first design points:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Dict, Optional
 
 import numpy as np
 
 from .. import layers
+from ..core.framework import recompute_scope
 from ..param_attr import ParamAttr
 from ..initializer import NumpyArrayInitializer
 from .common import ModelSpec
@@ -50,6 +52,13 @@ class TransformerConfig:
     # fuse attention into one flash-kernel op (pallas on TPU); key padding
     # rides as lengths, no [Sq, Sk] bias tensor is materialized
     use_flash_attention: bool = False
+    # rematerialize the ops of each encoder/decoder layer in backward
+    # (fluid.recompute_scope; per-op jax.checkpoint boundaries).  Matters
+    # for the fused_attention composite op — its internal [B, H, Sq, Sk]
+    # probability matrix is recomputed instead of stored — so pair it
+    # with use_flash_attention; a chain of primitive ops keeps its
+    # op-boundary activations resident either way.
+    use_recompute: bool = False
 
 
 def _sinusoid_table(max_len: int, d_model: int) -> np.ndarray:
@@ -225,26 +234,31 @@ def transformer(
     src_len = b.seq_lengths(src_word) if flash else None
     trg_len = b.seq_lengths(trg_word) if flash else None
 
+    layer_scope = (recompute_scope if cfg.use_recompute
+                   else contextlib.nullcontext)
+
     # encoder
     enc = b.embed(src_word, cfg.src_vocab_size, "src")
     for i in range(cfg.n_layer):
-        attn = b.mha(enc, enc, src_bias, f"enc_l{i}_attn",
-                     k_lengths=src_len)
-        enc = b.sublayer(enc, attn, f"enc_l{i}_attn")
-        ff = b.ffn(enc, f"enc_l{i}_ffn")
-        enc = b.sublayer(enc, ff, f"enc_l{i}_ffn")
+        with layer_scope():
+            attn = b.mha(enc, enc, src_bias, f"enc_l{i}_attn",
+                         k_lengths=src_len)
+            enc = b.sublayer(enc, attn, f"enc_l{i}_attn")
+            ff = b.ffn(enc, f"enc_l{i}_ffn")
+            enc = b.sublayer(enc, ff, f"enc_l{i}_ffn")
 
     # decoder
     dec = b.embed(trg_word, cfg.trg_vocab_size, "trg")
     for i in range(cfg.n_layer):
-        self_attn = b.mha(dec, dec, trg_bias, f"dec_l{i}_self",
-                          k_lengths=trg_len, causal=True)
-        dec = b.sublayer(dec, self_attn, f"dec_l{i}_self")
-        cross = b.mha(dec, enc, src_bias, f"dec_l{i}_cross",
-                      k_lengths=src_len)
-        dec = b.sublayer(dec, cross, f"dec_l{i}_cross")
-        ff = b.ffn(dec, f"dec_l{i}_ffn")
-        dec = b.sublayer(dec, ff, f"dec_l{i}_ffn")
+        with layer_scope():
+            self_attn = b.mha(dec, dec, trg_bias, f"dec_l{i}_self",
+                              k_lengths=trg_len, causal=True)
+            dec = b.sublayer(dec, self_attn, f"dec_l{i}_self")
+            cross = b.mha(dec, enc, src_bias, f"dec_l{i}_cross",
+                          k_lengths=src_len)
+            dec = b.sublayer(dec, cross, f"dec_l{i}_cross")
+            ff = b.ffn(dec, f"dec_l{i}_ffn")
+            dec = b.sublayer(dec, ff, f"dec_l{i}_ffn")
 
     logits = b.linear(dec, cfg.d_model, cfg.trg_vocab_size, "project",
                       shard=[None, cfg.tp_axis], bias=False)

@@ -553,3 +553,39 @@ def _get_places(ctx, ins, attrs):
         n = min(want, n)
     del dtype  # CPU/CUDA distinction collapses to the jax platform
     return {"Out": [jnp.arange(n, dtype=jnp.int32)]}
+
+
+def _ref_by_trainer_id_infer(op, block):
+    xs = op.input("X")
+    if not xs:
+        return
+    v = block._find_var_recursive(xs[0])
+    if v is not None:
+        set_output(block, op, "Out", list(v.desc.shape), v.desc.dtype)
+
+
+@register_op("ref_by_trainer_id", infer_shape=_ref_by_trainer_id_infer,
+             diff_inputs=["X"])
+def _ref_by_trainer_id(ctx, ins, attrs):
+    """Out = X[trainer_id] (reference: distributed_ops/
+    ref_by_trainer_id_op.h — the DC-ASGD pserver picks the per-trainer
+    backup param).  The runtime scalar select is one XLA dynamic_slice of
+    the stacked inputs (clamped in range, matching the reference's
+    ENFORCE_LT contract on valid ids)."""
+    xs = [data(v) for v in ins["X"]]
+    tid = data(ins["TrainerId"][0]).reshape(()).astype(jnp.int32)
+    return {"Out": [jnp.stack(xs)[tid]]}
+
+
+def _register_split_byref():
+    """Row-wise split into sections (reference: distributed_ops/
+    split_byref_op.cc — zero-copy row slices feeding per-pserver sends;
+    XLA slices are views under buffer assignment, same effect).  Same
+    math as the split op at axis 0, so the lowerings are shared."""
+    from .tensor_ops import _split, _split_infer
+
+    register_op("split_byref", infer_shape=_split_infer,
+                diff_inputs=["X"])(_split)
+
+
+_register_split_byref()

@@ -318,3 +318,48 @@ def test_get_places():
         exe = fluid.Executor(fluid.CPUPlace())
         (got,) = exe.run(program=prog, feed={}, fetch_list=["places"])
     assert len(np.asarray(got)) == min(2, len(jax.devices()))
+
+
+def test_ref_by_trainer_id():
+    xs = [_rand((3, 2), s) for s in (30, 31, 32)]
+    tid = np.array([2], dtype="int64")
+    t = _t("ref_by_trainer_id", {"X": xs, "TrainerId": tid},
+           {"Out": xs[2]})
+    t.check_output()
+
+
+def test_split_byref():
+    x = _rand((7, 3), 33)
+    t = _t("split_byref", {"X": x},
+           {"Out": [x[:2], x[2:5], x[5:]]},
+           {"sections": [2, 3, 2]})
+    t.check_output()
+
+
+def test_attention_lstm_grads():
+    """Numeric-grad check through the per-step attention + LSTM scan (the
+    reference registers DefaultGradOpDescMaker for attention_lstm; here
+    the grad falls out of jax.vjp through the scan)."""
+    lens = [3, 2]
+    m, d = 2, 2
+    n = len(lens)
+    flat = _rand((sum(lens), m), 40)
+    aw = _rand((m + d, 1), 41)
+    ab = _rand((1, 1), 42)
+    lw = _rand((d + m, 4 * d), 43)
+    lb = _rand((1, 4 * d), 44)
+    c0 = np.zeros((n, d), "float32")
+    hs, cs = _attention_lstm_ref(
+        _pad(flat, lens, (m,)), lens, aw[:, 0], ab[0, 0], None, None,
+        lw, lb[0])
+    t_ = _t("attention_lstm",
+            {"X": (flat, lens), "C0": c0, "AttentionWeight": aw,
+             "AttentionBias": ab, "LSTMWeight": lw, "LSTMBias": lb},
+            {"Hidden": (np.concatenate([hs[i, :lens[i]] for i in range(n)]),
+                        lens),
+             "Cell": (np.concatenate([cs[i, :lens[i]] for i in range(n)]),
+                      lens)},
+            {})
+    t_.check_output(atol=2e-5, rtol=2e-5)
+    t_.check_grad(["X", "AttentionWeight", "LSTMWeight", "LSTMBias"],
+                  "Hidden", max_relative_error=0.05)

@@ -1,0 +1,102 @@
+"""Rematerialization: fluid.recompute_scope tags ops whose backward
+re-runs the forward lowering (jax.checkpoint) instead of keeping internal
+activations.  TPU-native memory feature; later Paddle's RecomputeOptimizer
+plays the same role."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build(recompute):
+    import contextlib
+
+    fluid.reset_default_env()
+    x = layers.data("x", [16], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=64, act="relu")
+    cm = fluid.recompute_scope() if recompute else contextlib.nullcontext()
+    with cm:
+        h = layers.fc(h, size=64, act="tanh")
+        h = layers.fc(h, size=32, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _run(loss, steps=5):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(5)
+    xv = rng.randn(8, 16).astype("float32")
+    yv = rng.randn(8, 1).astype("float32")
+    return [
+        float(np.ravel(np.asarray(
+            exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+        ))[0])
+        for _ in range(steps)
+    ]
+
+
+def test_recompute_scope_matches_plain_training():
+    ref = _run(_build(recompute=False))
+    got = _run(_build(recompute=True))
+    # recompute changes memory scheduling, not math
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert got[-1] < got[0]
+
+
+def test_recompute_attr_reaches_compiled_program():
+    """The tagged ops carry @recompute@ and the lowered computation really
+    contains remat regions (jax.checkpoint made it into the trace)."""
+    import jax
+
+    from paddle_tpu.core.compiler import CompiledBlock
+    from paddle_tpu.core.executor import _RunPlan
+
+    loss = _build(recompute=True)
+    prog = fluid.default_main_program()
+    tagged = [op.type for op in prog.desc.block(0).ops
+              if op.attrs.get("@recompute@")]
+    assert "mul" in tagged  # the fc matmuls inside the scope
+
+    plan = _RunPlan(prog, ["x", "y"], [loss.name])
+    compiled = CompiledBlock(
+        prog, 0, plan.feed_names, plan.fetch_names, plan.state_names,
+        donate_states=False,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    block0 = prog.desc.block(0)
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.randn(8, 16).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+    feed_vals = plan.feed_values(feed, block0)
+    state_vals = plan.state_values(fluid.global_scope(), block0)
+    jaxpr = jax.make_jaxpr(compiled.raw_fn)(
+        feed_vals, state_vals, jax.random.PRNGKey(0))
+    assert "remat" in str(jaxpr)
+
+
+def test_transformer_recompute_trains():
+    from paddle_tpu import models
+
+    fluid.reset_default_env()
+    spec = models.transformer(models.TransformerConfig(
+        src_vocab_size=64, trg_vocab_size=64, max_length=8, n_layer=2,
+        n_head=2, d_model=16, d_inner=32, dropout=0.0, use_recompute=True,
+    ))
+    prog = fluid.default_main_program()
+    assert any(op.attrs.get("@recompute@") for op in prog.desc.block(0).ops)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    batch = spec.synthetic_batch(4)
+    l0 = float(np.ravel(np.asarray(
+        exe.run(feed=batch, fetch_list=[spec.loss])[0]))[0])
+    for _ in range(4):
+        (lv,) = exe.run(feed=batch, fetch_list=[spec.loss])
+    l1 = float(np.ravel(np.asarray(lv))[0])
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
