@@ -16,7 +16,9 @@ from ..core.proto import OpDesc
 
 __all__ = ["QuantizeTranspiler"]
 
-_QUANTIZABLE = {"mul", "matmul", "conv2d", "depthwise_conv2d"}
+# reference: quantize_transpiler.py:32 _QUANTIZABLE_OP_TYPES (matmul is
+# NOT quantized there either; every member has a freeze_program int8 form)
+_QUANTIZABLE = {"mul", "conv2d", "depthwise_conv2d"}
 
 
 class QuantizeTranspiler:
@@ -143,7 +145,102 @@ class QuantizeTranspiler:
 
     def freeze_program(self, program: Optional[Program] = None, place=None,
                        scope=None) -> None:
-        """reference: quantize_transpiler.py freeze_program — converts fake
-        quant to real int8 for deployment.  Under XLA the quantized graph
-        already runs fused; freezing is a no-op retained for API parity."""
-        return None
+        """reference: quantize_transpiler.py freeze_program — convert the
+        QAT program to REAL int8 inference.  Weight tables are quantized
+        offline into int8 scope vars; each quantized mul/conv2d becomes a
+        mul_int8/conv2d_int8 op whose dot runs int8xint8 -> int32 on the
+        MXU with one fp32 rescale; the fake_quantize ops disappear.
+        Activation scales: range_abs_max ops donate their trained running
+        scale (wired as XScale); abs_max activations quantize dynamically
+        at runtime inside the int8 op.
+
+        Call on an inference program (clone(for_test=True) of the
+        QAT-transpiled program) with the trained scope."""
+        import numpy as np
+
+        from ..core.scope import global_scope
+
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        block = program.global_block()
+        desc = block.desc
+        bin_cnt = (1 << (self.weight_bits - 1)) - 1
+
+        # map: quantized-output name -> its fake_quantize producer op
+        producers = {}
+        for op in desc.ops:
+            if op.type.startswith("fake_quantize"):
+                producers[op.output("Out")[0]] = op
+
+        _INT8 = {"mul": ("mul_int8", "X", "Y"),
+                 "conv2d": ("conv2d_int8", "Input", "Filter"),
+                 "depthwise_conv2d": ("conv2d_int8", "Input", "Filter")}
+
+        used_fq: set = set()
+        for op in desc.ops:
+            if op.type not in _INT8:
+                continue
+            new_type, x_slot, w_slot = _INT8[op.type]
+            xq_names = op.inputs.get(x_slot)
+            wq_names = op.inputs.get(w_slot)
+            if not xq_names or not wq_names:
+                continue
+            xq, wq = xq_names[0], wq_names[0]
+            if xq not in producers or wq not in producers:
+                continue  # not a QAT-rewritten op
+            x_fq, w_fq = producers[xq], producers[wq]
+
+            # 1. weight: quantize the trained fp32 table offline
+            w_name = w_fq.input("X")[0]
+            w_val = scope.find_var(w_name)
+            if w_val is None:
+                raise RuntimeError(
+                    f"freeze_program: weight '{w_name}' not in scope — run "
+                    "the startup program / load the checkpoint first")
+            w_np = np.asarray(w_val, dtype=np.float32)
+            sw = float(np.max(np.abs(w_np))) or 1e-8
+            w_i8 = np.clip(np.round(w_np / sw * bin_cnt), -bin_cnt,
+                           bin_cnt).astype(np.int8)
+            i8_name = w_name + ".int8"
+            sw_name = w_name + ".wscale"
+            block.create_var(name=i8_name, shape=list(w_np.shape),
+                             dtype="int8", persistable=True)
+            block.create_var(name=sw_name, shape=[1], dtype="float32",
+                             persistable=True)
+            scope.set_var(i8_name, w_i8)
+            scope.set_var(sw_name, np.asarray([sw], np.float32))
+
+            # 2. rewire: original float activation in, int8 weight in
+            if op.type == "depthwise_conv2d":
+                # the depthwise lowering injects groups = input channels
+                # at run time (nn_ops.py); the generic conv2d_int8 lowering
+                # reads the attr, so pin it from the input desc
+                x_desc = block._find_var_recursive(x_fq.input("X")[0])
+                if x_desc is not None:
+                    op.attrs["groups"] = int(x_desc.shape[1])
+            op.type = new_type
+            op.inputs[x_slot] = [x_fq.input("X")[0]]
+            op.inputs[w_slot] = [i8_name]
+            op.inputs["WScale"] = [sw_name]
+            if x_fq.type == "fake_quantize_range_abs_max":
+                # trained running scale (persistable InScale state var)
+                op.inputs["XScale"] = [x_fq.input("InScale")[0]]
+            op.attrs["bit_length"] = self.activation_bits
+            op.attrs["weight_bits"] = self.weight_bits
+            used_fq.add(id(x_fq))
+            used_fq.add(id(w_fq))
+
+        if used_fq:
+            # drop a fake_quantize op only when nothing still reads its
+            # output (a shared .quantized var may feed an unfrozen consumer)
+            still_read: set = set()
+            for op in desc.ops:
+                if id(op) in used_fq:
+                    continue
+                for names in op.inputs.values():
+                    still_read.update(names)
+            desc.ops[:] = [
+                op for op in desc.ops
+                if id(op) not in used_fq or op.output("Out")[0] in still_read
+            ]
+            program.desc.bump()

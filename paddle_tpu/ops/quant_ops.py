@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..core.proto import DataType
 from ..core.registry import register_op
-from .common import data, in_desc, same_shape, set_output
+from .common import data, in_desc, same_shape, set_output, wrap_lod
 
 
 def _ste_quant(x, scale, bin_cnt):
@@ -87,3 +87,99 @@ def _fake_dequantize_max_abs(ctx, ins, attrs):
     scale = data(ins["Scale"][0]).reshape(())
     max_range = float(attrs.get("max_range", 127.0))
     return {"Out": [x * scale / max_range]}
+
+
+# ---------------------------------------------------------------------------
+# frozen int8 inference ops (TPU-native: the MXU multiplies int8 operands
+# with int32 accumulation, so the frozen graph runs genuinely quantized —
+# the role of the reference's freeze_program + TensorRT int8 path)
+# ---------------------------------------------------------------------------
+def _int8_quantize(x, bin_cnt, scale=None):
+    """int8-quantize an activation: with `scale` (a frozen running scale)
+    use it, else abs_max at runtime.  Returns (int8 values, scale)."""
+    sx = (jnp.maximum(scale.reshape(()), 1e-8) if scale is not None
+          else jnp.maximum(jnp.max(jnp.abs(x)), 1e-8))
+    q = jnp.clip(jnp.round(x / sx * bin_cnt), -bin_cnt, bin_cnt)
+    return q.astype(jnp.int8), sx
+
+
+def _int8_bins(attrs):
+    """(activation bin count, weight bin count) — the weight table was
+    quantized with weight_bits by freeze_program, which may differ from
+    the activation bit_length."""
+    bin_a = (1 << (int(attrs.get("bit_length", 8)) - 1)) - 1
+    bin_w = (1 << (int(attrs.get("weight_bits",
+                                 attrs.get("bit_length", 8))) - 1)) - 1
+    return bin_a, bin_w
+
+
+def _mul_int8_infer(op, block):
+    x = in_desc(op, block, "X")
+    w = in_desc(op, block, "Y")
+    if x is None or w is None:
+        return
+    xn = op.attr("x_num_col_dims", 1)
+    set_output(block, op, "Out", list(x.shape[:xn]) + [w.shape[1]],
+               DataType.FP32, lod_level=x.lod_level)
+
+
+@register_op("mul_int8", infer_shape=_mul_int8_infer, no_grad=True)
+def _mul_int8(ctx, ins, attrs):
+    """X(fp32) @ W(int8): X is quantized at runtime (abs_max; or with the
+    frozen running scale when XScale is wired), the dot accumulates int32
+    on the MXU, and one fp32 rescale de-quantizes the result.  Same
+    x_num_col_dims / LoD semantics as the mul op it replaces."""
+    from ..core.lod import LoDValue
+
+    xv = ins["X"][0]
+    x = data(xv)
+    w = data(ins["Y"][0])                      # int8 [K, N]
+    sw = data(ins["WScale"][0]).reshape(())
+    bin_a, bin_w = _int8_bins(attrs)
+    xn = int(attrs.get("x_num_col_dims", 1))
+    if isinstance(xv, LoDValue):
+        xn += 1
+    lead = x.shape[:xn]
+    x2 = x.reshape(-1, w.shape[0])
+    xs_in = ins.get("XScale", [None])[0]
+    xq, sx = _int8_quantize(
+        x2, bin_a, None if xs_in is None else data(xs_in))
+    acc = jax.lax.dot_general(
+        xq, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * (sx * sw / float(bin_a * bin_w))
+    return {"Out": [wrap_lod(xv, out.reshape(lead + (w.shape[1],)))]}
+
+
+def _conv2d_int8_infer(op, block):
+    from .nn_ops import _conv2d_infer
+
+    _conv2d_infer(op, block)
+
+
+@register_op("conv2d_int8", infer_shape=_conv2d_int8_infer, no_grad=True)
+def _conv2d_int8(ctx, ins, attrs):
+    """conv2d with int8 filter + runtime-quantized int8 input, int32
+    accumulation, fp32 rescale (see mul_int8)."""
+    x = data(ins["Input"][0])
+    f = data(ins["Filter"][0])                 # int8 OIHW
+    sw = data(ins["WScale"][0]).reshape(())
+    bin_a, bin_w = _int8_bins(attrs)
+    xs_in = ins.get("XScale", [None])[0]
+    xq, sx = _int8_quantize(
+        x, bin_a, None if xs_in is None else data(xs_in))
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    acc = jax.lax.conv_general_dilated(
+        xq, f,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * (sx * sw / float(bin_a * bin_w))
+    return {"Output": [out]}
