@@ -135,17 +135,19 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         baseline = 250.46
         flops_per_item = 3 * 3.0e9  # fwd ~1.5 GMAC @224
         lr = 0.01
-    elif model in ("vgg19", "vgg19_infer"):
+    elif model in ("vgg19", "vgg19_infer", "vgg19_infer_int8"):
         # IntelOptimizedPaddle.md:33-38/74-79: train bs=64 28.46 img/s,
-        # infer bs=1 75.07 img/s (MKL-DNN, 2x Xeon 6148, ImageNet shapes)
-        infer = model.endswith("_infer")
+        # infer bs=1 75.07 img/s (MKL-DNN, 2x Xeon 6148, ImageNet shapes).
+        # _int8: same infer config through QuantizeTranspiler.freeze_program
+        # (mul_int8/conv2d_int8 ops — the MXU's int8 path).
+        infer = "_infer" in model
         bs = int(os.environ.get(
             "BENCH_VGG_INFER_BS" if infer else "BENCH_VGG_BS",
             "1" if infer else "64"))
         spec = models.vgg19()
         unit = "images/sec"
         items_per_step = bs
-        metric = ("vgg19_infer_images_per_sec_per_chip" if infer
+        metric = (model + "_images_per_sec_per_chip" if infer
                   else "vgg19_train_images_per_sec_per_chip")
         baseline = 75.07 if infer else 28.46
         flops_per_item = 19.6e9 if infer else 3 * 19.6e9
@@ -153,7 +155,7 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
                          "(expected resnet50|transformer|deepfm|lstm|lenet|"
-                         "alexnet|googlenet|vgg19|vgg19_infer)")
+                         "alexnet|googlenet|vgg19|vgg19_infer|vgg19_infer_int8)")
 
     run_program = None
     fetch_var = spec.loss
@@ -163,9 +165,14 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
         fluid.optimizer.AdamOptimizer(
             learning_rate=lr, lazy_mode=True
         ).minimize(spec.loss)
-    elif model.endswith("_infer"):
+    elif "_infer" in model:
         # inference: no optimizer; dropout/batch_norm switch to test mode
         # (the predictor API wraps this same clone, inference/__init__.py)
+        if model.endswith("_int8"):
+            from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+            qt = QuantizeTranspiler()
+            qt.training_transpile()
         run_program = fluid.default_main_program().clone(for_test=True)
         fetch_var = spec.extras["predict"]
     else:
@@ -176,6 +183,10 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     place = fluid.TPUPlace()
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
+    if model.endswith("_int8"):
+        # weights are in scope now; quantize them offline and rewrite the
+        # inference clone to the int8 ops
+        qt.freeze_program(run_program)
 
     # stage the synthetic batches on device ONCE: the benchmark measures the
     # training step, not the host->chip link of this harness (the axon
@@ -219,8 +230,12 @@ def run_model(model: str, steps: int, peak_flops: float) -> dict:
     dt = time.perf_counter() - t0
 
     value = items_per_step * steps / dt
+    if model.endswith("_int8"):
+        # the frozen graph runs on the int8 MXU path, whose peak is ~2x
+        # the bf16 peak the BENCH_PEAK_TFLOPS knob describes
+        peak_flops = peak_flops * 2
     mfu = value * flops_per_item / peak_flops
-    tag = "final_fetch" if model.endswith("_infer") else "final_loss"
+    tag = "final_fetch" if "_infer" in model else "final_loss"
     sys.stderr.write(
         f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
         f"mfu={mfu:.3f} {tag}={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"

@@ -496,14 +496,17 @@ class FtrlOptimizer(Optimizer):
 
 class ModelAverage(Optimizer):
     """Parameter averaging for evaluation (reference: optimizer.py:1373 +
-    operators/average_accumulates_op.cc).  Construct AFTER minimize():
-    in-graph ops accumulate a running sum of every parameter each training
-    step; apply() swaps parameters for their accumulated average inside a
-    context manager and restore() puts the trained values back.  The
-    reference's three-tier sliding window (sum_1/2/3 rotated at
-    max_average_window) is collapsed to a single running sum — windowing
-    controls staleness on billion-step CTR jobs and can land later; the
-    apply/restore contract and the average math are the reference's."""
+    operators/average_accumulates_op.cc).  Construct AFTER minimize(): one
+    average_accumulates op per parameter maintains the reference's
+    three-tier sliding window (sum_1 every step, drained into sum_2 every
+    16384 updates for precision, both rotated into sum_3 when the window
+    outgrows min(max_average_window, num_updates*average_window_rate)).
+    apply() swaps parameters for (sum_1+sum_2+sum_3)/(num_accumulates +
+    old_num_accumulates) inside a context manager; restore() puts the
+    trained values back."""
+
+    _ACC_SUMS = ("sum_1", "sum_2", "sum_3")
+    _ACC_COUNTS = ("num_accumulates", "old_num_accumulates", "num_updates")
 
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, regularization=None, name=None):
@@ -511,59 +514,74 @@ class ModelAverage(Optimizer):
         self.average_window = average_window_rate
         self.min_average_window = min_average_window
         self.max_average_window = max_average_window
+        # param -> {acc role -> var name}; _param_sums keeps the historical
+        # "one sum var per param" view (sum_1) for tools/tests
+        self._param_accs: Dict[str, Dict[str, str]] = {}
         self._param_sums: Dict[str, str] = {}
         self._restore_vals: Dict[str, Any] = {}
-        self._cnt_name: Optional[str] = None
-
-        from . import layers
 
         program = default_main_program()
         gblock = program.global_block()
         params = [
             v for v in gblock.vars.values() if isinstance(v, Parameter)
         ]
-        if not params:
-            return
 
-        # int64 counter: a fp32 counter saturates at 2^24 steps
-        self._cnt_name = unique_name("model_average_cnt")
-        cnt = _create_persistable_zeros(self._cnt_name, [1], "int64")
-        one = layers.fill_constant([1], "int64", 1)
-        layers.sums([cnt, one], out=cnt)
         for p in params:
-            sum_name = unique_name(p.name + "_avg_sum")
-            sv = _create_persistable_zeros(sum_name, p.shape, p.dtype)
-            layers.sums([sv, p], out=sv)
-            self._param_sums[p.name] = sum_name
+            accs: Dict[str, str] = {}
+            for role in self._ACC_SUMS:
+                accs[role] = unique_name(f"{p.name}_avg_{role}")
+                _create_persistable_zeros(accs[role], p.shape, p.dtype)
+            for role in self._ACC_COUNTS:
+                # int64: a fp32 counter saturates at 2^24 steps
+                accs[role] = unique_name(f"{p.name}_avg_{role}")
+                _create_persistable_zeros(accs[role], [1], "int64")
+            gblock.append_op(
+                type="average_accumulates",
+                inputs={"param": [p.name],
+                        **{f"in_{r}": [accs[r]]
+                           for r in self._ACC_SUMS + self._ACC_COUNTS}},
+                outputs={f"out_{r}": [accs[r]]
+                         for r in self._ACC_SUMS + self._ACC_COUNTS},
+                attrs={"average_window": float(self.average_window),
+                       "min_average_window": int(self.min_average_window),
+                       "max_average_window": int(self.max_average_window)},
+            )
+            self._param_accs[p.name] = accs
+            self._param_sums[p.name] = accs["sum_1"]
 
     def _swap_in_averages(self, scope) -> None:
         import numpy as _np
 
-        if self._cnt_name is None:  # constructed with no Parameters
-            return
         if self._restore_vals:
             raise RuntimeError(
                 "ModelAverage.apply() re-entered without restore(); the "
                 "trained parameters would be lost"
             )
-        cnt_v = scope.find_var(self._cnt_name)
-        cnt = float(_np.ravel(_np.asarray(cnt_v))[0]) if cnt_v is not None else 0.0
-        if cnt <= 0:
-            return
-        # snapshot the accumulators too: running the program during apply()
-        # (evaluation) executes the accumulation ops against the AVERAGED
-        # params, which must not pollute the running sums after restore().
-        # Host copies, not device handles — the eval step DONATES the live
-        # state buffers (executor donate_argnums), deleting them.
-        self._restore_vals["@cnt@"] = _np.asarray(cnt_v).copy()
-        for p_name, sum_name in self._param_sums.items():
-            sum_v = scope.find_var(sum_name)
+        for p_name, accs in self._param_accs.items():
+            vals = {r: scope.find_var(n) for r, n in accs.items()}
             cur = scope.find_var(p_name)
-            if sum_v is None or cur is None:
+            if cur is None or any(v is None for v in vals.values()):
                 continue
+            total = sum(
+                float(_np.ravel(_np.asarray(vals[r]))[0])
+                for r in ("num_accumulates", "old_num_accumulates")
+            )
+            if total <= 0:
+                continue
+            # snapshot the param AND every accumulator: running the program
+            # during apply() (evaluation) executes the accumulation ops
+            # against the AVERAGED params, which must not pollute the
+            # window after restore().  Host copies, not device handles —
+            # the eval step DONATES the live state buffers.
             self._restore_vals[p_name] = _np.asarray(cur).copy()
-            self._restore_vals["@sum@" + p_name] = _np.asarray(sum_v).copy()
-            scope.set_var(p_name, _np.asarray(sum_v) / cnt)
+            for r, n in accs.items():
+                self._restore_vals[n] = _np.asarray(vals[r]).copy()
+            avg = (
+                _np.asarray(vals["sum_1"])
+                + _np.asarray(vals["sum_2"])
+                + _np.asarray(vals["sum_3"])
+            ) / total
+            scope.set_var(p_name, avg.astype(_np.asarray(cur).dtype))
 
     def apply(self, executor, need_restore=True):
         import contextlib
@@ -584,12 +602,7 @@ class ModelAverage(Optimizer):
     def restore(self, executor):
         scope = getattr(executor, "scope", None) or global_scope()
         for key, val in self._restore_vals.items():
-            if key == "@cnt@":
-                scope.set_var(self._cnt_name, val)
-            elif key.startswith("@sum@"):
-                scope.set_var(self._param_sums[key[len("@sum@"):]], val)
-            else:
-                scope.set_var(key, val)
+            scope.set_var(key, val)
         self._restore_vals.clear()
 
 

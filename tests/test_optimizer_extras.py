@@ -143,3 +143,21 @@ def test_model_average_reenter_guard_and_accumulator_snapshot():
             ma._swap_in_averages(scope)
     for sn, want in sums_before.items():
         np.testing.assert_array_equal(np.asarray(scope.find_var(sn)), want)
+
+
+def test_model_average_three_tier_window_rotates():
+    """Small window: the average must cover only the current window (sum_3
+    rotation, average_accumulates_op.h), not all history."""
+    loss, xs, ys = _regression_problem(6)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    ma = fluid.optimizer.ModelAverage(
+        1.0, min_average_window=2, max_average_window=3)
+    exe, _ = _train(loss, xs, ys, steps=7)
+    scope = fluid.global_scope()
+    accs = next(iter(ma._param_accs.values()))
+    ona = int(np.ravel(np.asarray(scope.find_var(accs["old_num_accumulates"])))[0])
+    nu = int(np.ravel(np.asarray(scope.find_var(accs["num_updates"])))[0])
+    assert nu == 7
+    assert 0 < ona <= 3  # the window closed at least once and is bounded
+    with ma.apply(exe):
+        pass  # swap + restore round-trips with the tiered sums
