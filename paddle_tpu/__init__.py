@@ -54,6 +54,12 @@ from . import contrib  # noqa: F401
 from . import imperative  # noqa: F401
 from . import inference  # noqa: F401
 from . import transpiler  # noqa: F401
+from . import nets  # noqa: F401
+from . import learning_rate_decay  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import recordio as recordio_writer  # noqa: F401
+from .core import backward  # noqa: F401
+from .tensor_shim import LoDTensor, LoDTensorArray, Tensor  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .transpiler import memory_optimize, release_memory  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401

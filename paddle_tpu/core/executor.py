@@ -32,6 +32,8 @@ RNG_STATE_VAR = "@rng_key@"
 
 
 def _as_feed_value(value, var_desc=None):
+    if hasattr(value, "_as_feed"):  # fluid.Tensor / fluid.LoDTensor shim
+        value = value._as_feed()
     if isinstance(value, LoDValue):
         return value
     if isinstance(value, jax.Array):

@@ -56,11 +56,15 @@ __all__ = [
 # unique name generator (reference: python/paddle/fluid/unique_name.py)
 # ---------------------------------------------------------------------------
 class _UniqueNameGenerator:
-    def __init__(self):
+    """reference: unique_name.py UniqueNameGenerator (optional prefix on
+    every generated name)."""
+
+    def __init__(self, prefix: str = ""):
         self.ids = defaultdict(int)
+        self.prefix = prefix or ""
 
     def __call__(self, key: str) -> str:
-        name = f"{key}_{self.ids[key]}"
+        name = f"{self.prefix}{key}_{self.ids[key]}"
         self.ids[key] += 1
         return name
 
@@ -72,19 +76,33 @@ def unique_name(key: str) -> str:
     return _name_generator(key)
 
 
-@contextlib.contextmanager
-def unique_name_guard():
-    """Fresh name counters inside the context
-    (reference: unique_name.py guard) — two programs built under separate
-    guards get identical auto-generated parameter names, which is what
-    lets an inference program reload a training program's checkpoint."""
+def unique_name_switch(new_generator=None):
+    """Swap the global name generator, returning the old one
+    (reference: unique_name.py switch)."""
     global _name_generator
-    saved = _name_generator
-    _name_generator = _UniqueNameGenerator()
+    old = _name_generator
+    _name_generator = (
+        new_generator if new_generator is not None else _UniqueNameGenerator()
+    )
+    return old
+
+
+@contextlib.contextmanager
+def unique_name_guard(new_generator=None):
+    """Fresh name counters inside the context
+    (reference: unique_name.py guard; a str argument becomes the prefix of
+    every generated name) — two programs built under separate guards get
+    identical auto-generated parameter names, which is what lets an
+    inference program reload a training program's checkpoint."""
+    if isinstance(new_generator, (str, bytes)):
+        prefix = (new_generator.decode()
+                  if isinstance(new_generator, bytes) else new_generator)
+        new_generator = _UniqueNameGenerator(prefix)
+    saved = unique_name_switch(new_generator)
     try:
         yield
     finally:
-        _name_generator = saved
+        unique_name_switch(saved)
 
 
 def grad_var_name(name: str) -> str:

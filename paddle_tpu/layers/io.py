@@ -91,14 +91,43 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
                buffer_size=None, pass_num=1, is_test=None):
     """Read recordio files as a python reader (reference: layers/io.py
     open_files over open_files_op; files are the recordio format written by
-    paddle_tpu.recordio, records are np.savez archives of the slots)."""
+    paddle_tpu.recordio, records are np.savez archives of the slots).
+    '<slot>__lodK__' sidecar entries (convert_reader_to_recordio_file's
+    LoD encoding) fold back into LoDValues."""
     import io as _io
+    import re as _re
 
     import numpy as np
 
+    from ..core.lod import LoDValue
     from ..recordio import RecordIOScanner
 
     n_slots = len(shapes)
+    _lod_key = _re.compile(r"^(.*)__lod(\d+)__$")
+
+    def _fold(z):
+        # archive order == np.savez argument order; sorting would
+        # scramble slots by key name
+        base_keys = [k for k in z.files if not _lod_key.match(k)]
+        if len(base_keys) != n_slots:
+            raise ValueError(
+                f"record has {len(base_keys)} arrays but {n_slots} "
+                "slots declared"
+            )
+        out = []
+        for k in base_keys:
+            levels = sorted(
+                (int(m.group(2)), z[name])
+                for name in z.files
+                for m in (_lod_key.match(name),)
+                if m is not None and m.group(1) == k
+            )
+            if levels:
+                lens = [v for _, v in levels]
+                out.append(LoDValue(z[k], lens[0], tuple(lens[1:])))
+            else:
+                out.append(z[k])
+        return tuple(out)
 
     def reader():
         for _ in range(pass_num):
@@ -107,15 +136,7 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
                     for rec in sc:
                         with np.load(_io.BytesIO(rec),
                                      allow_pickle=False) as z:
-                            # archive order == np.savez argument order;
-                            # sorting would scramble slots by key name
-                            keys = list(z.files)
-                            if len(keys) != n_slots:
-                                raise ValueError(
-                                    f"record in {fn!r} has {len(keys)} "
-                                    f"arrays but {n_slots} slots declared"
-                                )
-                            yield tuple(z[k] for k in keys)
+                            yield _fold(z)
 
     return reader
 

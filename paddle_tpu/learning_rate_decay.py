@@ -1,0 +1,9 @@
+"""fluid.learning_rate_decay module parity (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py was re-exported as
+fluid.learning_rate_decay in the 1.x line): the decay schedules as graph
+ops over the global step counter."""
+
+from __future__ import annotations
+
+from .layers.learning_rate_scheduler import *  # noqa: F401,F403
+from .layers.learning_rate_scheduler import __all__  # noqa: F401

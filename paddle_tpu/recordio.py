@@ -21,7 +21,9 @@ from typing import Iterator, List, Optional
 
 from . import native
 
-__all__ = ["RecordIOWriter", "RecordIOScanner", "write_recordio", "read_recordio"]
+__all__ = ["RecordIOWriter", "RecordIOScanner", "write_recordio",
+           "read_recordio", "convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files"]
 
 _MAGIC = 0x0CDB0CDB
 
@@ -181,3 +183,75 @@ def read_recordio(path: str) -> Iterator[bytes]:
     with RecordIOScanner(path) as s:
         for r in s:
             yield r
+
+
+def convert_reader_to_recordio_file(
+    filename,
+    reader_creator,
+    feeder,
+    compressor=None,
+    max_num_records=1000,
+    feed_order=None,
+) -> int:
+    """Serialize a python reader's batches into one recordio file
+    (reference: recordio_writer.py convert_reader_to_recordio_file).  Each
+    record is the np.savez archive layers.open_files reads back; a LoD
+    slot appends one '<slot>__lodK__' entry per nesting level (lengths,
+    then each sub_lengths grid), which open_files folds back into a
+    LoDValue.  `compressor` is accepted for signature parity (this format
+    stores raw npz; the chunk layer owns framing)."""
+    import io as _io
+
+    import numpy as np
+
+    from .core.lod import LoDValue
+
+    if feed_order is None:
+        feed_order = feeder.feed_names
+    counter = 0
+    with RecordIOWriter(filename, max_chunk_records=max_num_records) as w:
+        for batch in reader_creator():
+            res = feeder.feed(batch)
+            arrs = {}
+            for name in feed_order:
+                v = res[name]
+                if isinstance(v, LoDValue):
+                    arrs[name] = np.asarray(v.data)
+                    for k, lens in enumerate(
+                        (v.lengths,) + tuple(v.sub_lengths)
+                    ):
+                        arrs[f"{name}__lod{k}__"] = np.asarray(lens)
+                else:
+                    arrs[name] = np.asarray(v)
+            buf = _io.BytesIO()
+            np.savez(buf, **arrs)
+            w.write(buf.getvalue())
+            counter += 1
+    return counter
+
+
+def convert_reader_to_recordio_files(
+    filename,
+    batch_per_file,
+    reader_creator,
+    feeder,
+    compressor=None,
+    max_num_records=1000,
+    feed_order=None,
+) -> int:
+    """Split the stream across many recordio files, batch_per_file records
+    each (reference: recordio_writer.py convert_reader_to_recordio_files;
+    file names get -00000 style suffixes)."""
+    import itertools
+
+    total = 0
+    it = iter(reader_creator())
+    for idx in itertools.count():
+        chunk = list(itertools.islice(it, batch_per_file))
+        if not chunk:
+            break
+        total += convert_reader_to_recordio_file(
+            f"{filename}-{idx:05d}", lambda c=chunk: iter(c), feeder,
+            compressor, max_num_records, feed_order,
+        )
+    return total

@@ -1,0 +1,83 @@
+"""fluid.Tensor / fluid.LoDTensor / fluid.LoDTensorArray construction
+parity (reference: pybind exposes the C++ Tensor/LoDTensor classes with
+set()/set_lod()/shape(); user code builds feeds with them).  These shims
+hold host numpy data; the executor's feed path converts a LoDTensor with
+a LoD into the padded LoDValue runtime form."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core.lod import LoDValue, create_lod_tensor
+
+__all__ = ["Tensor", "LoDTensor", "LoDTensorArray"]
+
+
+class Tensor:
+    """Host tensor (reference: framework/tensor.h via pybind Tensor)."""
+
+    def __init__(self):
+        self._array: Optional[np.ndarray] = None
+
+    def set(self, array, place=None) -> None:
+        self._array = np.asarray(array)
+
+    def shape(self) -> List[int]:
+        return list(np.shape(self._array))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    def _as_feed(self):
+        if self._array is None:
+            raise ValueError("Tensor.set() was never called")
+        return self._array
+
+
+class LoDTensor(Tensor):
+    """Host LoD tensor (reference: framework/lod_tensor.h; lod() is
+    offset-form, recursive_sequence_lengths() is length-form)."""
+
+    def __init__(self):
+        super().__init__()
+        self._rsl: List[List[int]] = []
+
+    # -- offset-form (reference lod()) ----------------------------------
+    def set_lod(self, lod: Sequence[Sequence[int]]) -> None:
+        self._rsl = [
+            [level[i + 1] - level[i] for i in range(len(level) - 1)]
+            for level in lod
+        ]
+
+    def lod(self) -> List[List[int]]:
+        out = []
+        for lens in self._rsl:
+            level = [0]
+            for l in lens:
+                level.append(level[-1] + l)
+            out.append(level)
+        return out
+
+    # -- length-form ----------------------------------------------------
+    def set_recursive_sequence_lengths(self, rsl) -> None:
+        self._rsl = [list(level) for level in rsl]
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(level) for level in self._rsl]
+
+    def _as_feed(self):
+        arr = super()._as_feed()
+        if not self._rsl:
+            return arr
+        return create_lod_tensor(arr, self._rsl)
+
+
+class LoDTensorArray(list):
+    """Host tensor array (reference: LOD_TENSOR_ARRAY variables; a plain
+    list of LoDTensor/arrays on this side)."""
+
+    def append(self, value):  # keep LoDTensor/ndarray entries as-is
+        super().append(value)

@@ -1,0 +1,13 @@
+"""fluid.unique_name module parity (reference:
+python/paddle/fluid/unique_name.py — generate/guard/switch over a global
+name counter, with optional prefixed generators; the counter itself lives
+in core/framework.py)."""
+
+from __future__ import annotations
+
+from .core.framework import _UniqueNameGenerator as UniqueNameGenerator  # noqa: F401
+from .core.framework import unique_name as generate  # noqa: F401
+from .core.framework import unique_name_guard as guard  # noqa: F401
+from .core.framework import unique_name_switch as switch  # noqa: F401
+
+__all__ = ["generate", "guard", "switch", "UniqueNameGenerator"]

@@ -30,3 +30,37 @@ def test_public_api_matches_spec():
             "public API changed without updating API.spec "
             "(python tools/print_signatures.py --update):\n" + diff[:4000]
         )
+
+
+def test_fluid_top_level_name_parity():
+    """Every name the reference's fluid/__init__.py __all__ declares
+    resolves on paddle_tpu (python/paddle/fluid/__init__.py:40)."""
+    import paddle_tpu
+
+    for n in ["io", "initializer", "layers", "contrib", "imperative",
+              "transpiler", "nets", "optimizer", "learning_rate_decay",
+              "backward", "LoDTensor", "LoDTensorArray", "CPUPlace",
+              "CUDAPlace", "CUDAPinnedPlace", "Tensor", "ParamAttr",
+              "WeightNormParamAttr", "DataFeeder", "clip", "profiler",
+              "unique_name", "recordio_writer", "Scope"]:
+        assert hasattr(paddle_tpu, n), n
+
+
+def test_lod_tensor_shim_feeds_executor():
+    """fluid.LoDTensor() with set()/set_lod() feeds a sequence op like the
+    reference's pybind LoDTensor."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [3], dtype="float32", lod_level=1)
+    pooled = layers.sequence_pool(x, pool_type="sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    t = fluid.LoDTensor()
+    flat = np.arange(15, dtype="float32").reshape(5, 3)
+    t.set(flat)
+    t.set_lod([[0, 2, 5]])
+    (got,) = exe.run(feed={"x": t}, fetch_list=[pooled])
+    want = np.stack([flat[:2].sum(0), flat[2:].sum(0)])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)

@@ -243,3 +243,86 @@ def test_random_data_generator():
     s = next(r())
     assert s[0].shape == (2, 3) and s[1].shape == (1,)
     assert (s[0] >= 0).all() and (s[0] <= 1).all()
+
+
+def test_convert_reader_to_recordio_file_roundtrip(tmp_path):
+    """fluid.recordio_writer.convert_reader_to_recordio_file writes the
+    npz-record format layers.open_files reads back (reference:
+    recordio_writer.py:34)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    path = str(tmp_path / "batches.recordio")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        img = layers.data("img", [4], dtype="float32")
+        lbl = layers.data("lbl", [1], dtype="int64")
+        feeder = fluid.DataFeeder(feed_list=[img, lbl],
+                                  place=fluid.CPUPlace())
+
+    rng = np.random.RandomState(0)
+    batches = [
+        [(rng.rand(4).astype("float32"), np.array([i], "int64"))
+         for i in range(3)]
+        for _ in range(5)
+    ]
+    n = fluid.recordio_writer.convert_reader_to_recordio_file(
+        path, lambda: iter(batches), feeder)
+    assert n == 5
+
+    reader = layers.open_files(
+        [path], shapes=[[-1, 4], [-1, 1]], lod_levels=[0, 0],
+        dtypes=["float32", "int64"])
+    got = list(reader())
+    assert len(got) == 5
+    np.testing.assert_allclose(
+        got[0][0], np.stack([s[0] for s in batches[0]]), rtol=1e-6)
+
+
+def test_convert_recordio_lod_roundtrip(tmp_path):
+    """LoD slots written by convert_reader_to_recordio_file fold back into
+    LoDValues through layers.open_files (the __lodK__ sidecar entries)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.lod import LoDValue
+
+    path = str(tmp_path / "seqs.recordio")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        seq = layers.data("seq", [2], dtype="float32", lod_level=1)
+        lbl = layers.data("lbl", [1], dtype="int64")
+        feeder = fluid.DataFeeder(feed_list=[seq, lbl],
+                                  place=fluid.CPUPlace())
+
+    rng = np.random.RandomState(1)
+    batches = [
+        [(rng.rand(lens, 2).astype("float32"), np.array([i], "int64"))
+         for i, lens in enumerate((2, 4, 1))]
+        for _ in range(3)
+    ]
+    n = fluid.recordio_writer.convert_reader_to_recordio_file(
+        path, lambda: iter(batches), feeder)
+    assert n == 3
+
+    reader = layers.open_files(
+        [path], shapes=[[-1, 2], [-1, 1]], lod_levels=[1, 0],
+        dtypes=["float32", "int64"])
+    got = list(reader())
+    assert len(got) == 3
+    first_seq = got[0][0]
+    assert isinstance(first_seq, LoDValue)
+    np.testing.assert_array_equal(np.asarray(first_seq.lengths), [2, 4, 1])
+    np.testing.assert_allclose(
+        np.asarray(first_seq.data)[1, :4], batches[0][1][0], rtol=1e-6)
+
+
+def test_unique_name_switch_and_prefixed_guard():
+    import paddle_tpu as fluid
+
+    with fluid.unique_name.guard("pre_"):
+        assert fluid.unique_name.generate("k").startswith("pre_k_")
+    old = fluid.unique_name.switch()
+    try:
+        assert fluid.unique_name.generate("k") == "k_0"
+    finally:
+        fluid.unique_name.switch(old)
