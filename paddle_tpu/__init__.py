@@ -26,6 +26,7 @@ from .core.framework import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    name_scope,
     program_guard,
     recompute_scope,
     reset_default_env,

@@ -49,6 +49,7 @@ __all__ = [
     "unique_name_guard",
     "grad_var_name",
     "recompute_scope",
+    "name_scope",
 ]
 
 
@@ -415,6 +416,10 @@ class Block:
         if _RECOMPUTE_DEPTH[0] > 0:
             attrs = dict(attrs or {})
             attrs["@recompute@"] = True
+        scope_path = _current_name_scope()
+        if scope_path:
+            attrs = dict(attrs or {})
+            attrs["op_namescope"] = "/" + scope_path + "/"
         op = Operator(self, desc, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         return op
@@ -590,6 +595,30 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
         switch_main_program(prev_main)
         if prev_startup is not None:
             switch_startup_program(prev_startup)
+
+
+# ---------------------------------------------------------------------------
+# name_scope (reference: framework.py name_scope — a debug-name hierarchy;
+# ops appended inside carry the 'op_namescope' attr the reference's
+# op_proto_maker attaches, consumed by the debugger/graphviz tools)
+# ---------------------------------------------------------------------------
+_NAME_SCOPE_STACK: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix: Optional[str] = None):
+    """Annotate ops built inside with a hierarchical debug name
+    (reference: framework.py name_scope; purely observational — no effect
+    on execution)."""
+    _NAME_SCOPE_STACK.append(prefix or "")
+    try:
+        yield
+    finally:
+        _NAME_SCOPE_STACK.pop()
+
+
+def _current_name_scope() -> str:
+    return "/".join(s for s in _NAME_SCOPE_STACK if s)
 
 
 # ---------------------------------------------------------------------------

@@ -94,6 +94,12 @@ class LayerHelper:
             return None
         if not isinstance(attr, ParamAttr):
             attr = ParamAttr._to_attr(attr)
+        from .param_attr import WeightNormParamAttr
+
+        if isinstance(attr, WeightNormParamAttr) and not is_bias:
+            return self._create_weight_normalize(
+                attr, shape, dtype, default_initializer
+            )
         init = attr.initializer or default_initializer
         if init is None:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
@@ -113,6 +119,62 @@ class LayerHelper:
         if attr.sharding is not None:
             param.sharding = attr.sharding
         return param
+
+    def _create_weight_normalize(self, attr, shape, dtype,
+                                 default_initializer=None) -> Variable:
+        """Weight normalization (reference: layer_helper.py
+        _create_weight_normalize; Salimans & Kingma 2016): the trainable
+        parameters are the direction v and per-`dim` magnitudes g; the
+        layer consumes w = g * v / ||v||, recomputed each step in the
+        main program.  g initializes to ||v_0|| in the startup program so
+        training starts at the conventional parameterization."""
+        dim = attr.dim
+        base = attr.name or unique_name(f"{self.name}.w")
+
+        def derived_attr(suffix, initializer, sharding):
+            # carry EVERY per-parameter setting of the user's attr (clip,
+            # model-average, sharding included) onto v and g
+            return ParamAttr(
+                name=base + suffix, initializer=initializer,
+                learning_rate=attr.learning_rate,
+                regularizer=attr.regularizer, trainable=attr.trainable,
+                gradient_clip=attr.gradient_clip,
+                do_model_average=attr.do_model_average,
+                sharding=sharding,
+            )
+
+        v = self.create_parameter(
+            derived_attr(".w_v", attr.initializer, attr.sharding), shape,
+            dtype, default_initializer=default_initializer,
+        )
+        k = 1 if dim is None else int(shape[dim])
+        # g is rank-1 over the kept dim: its sharding is that dim's axis
+        g_sharding = (
+            [attr.sharding[dim]]
+            if attr.sharding is not None and dim is not None else None
+        )
+        g = self.create_parameter(
+            derived_attr(".w_g", None, g_sharding), [k], dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+
+        # startup: overwrite g's placeholder init with ||v_0||
+        startup = self.startup_program.global_block()
+        counter = [0]
+
+        def sname(tag):
+            counter[0] += 1
+            return f"{base}.{tag}.init{counter[0]}"
+
+        _norm_except_dim_ops(startup, v.name, g.name, shape, dim, dtype,
+                             sname, keep_dim=False)
+
+        # main program: w = v * g / ||v||
+        main = self.main_program.global_block()
+        w = main.create_var(name=base, shape=list(shape), dtype=dtype)
+        _weight_norm_ops(main, v.name, g.name, w.name, shape, dim, dtype,
+                         lambda tag: unique_name(f"{base}.{tag}"))
+        return w
 
     # -- outputs -------------------------------------------------------------
     def create_variable_for_type_inference(self, dtype, stop_gradient: bool = False) -> Variable:
@@ -168,3 +230,53 @@ class LayerHelper:
             type=act_type, inputs={"X": [input_var]}, outputs={"Out": [out]}, attrs=act
         )
         return out
+
+
+def _norm_except_dim_ops(block, v_name, out_name, shape, dim, dtype,
+                         name_fn, keep_dim):
+    """Append ||v|| over every axis except `dim` (the reference's
+    __norm_except_dim: square -> reduce_sum -> sqrt) writing `out_name`."""
+    rank = len(shape)
+    axes = [i for i in range(rank) if dim is None or i != dim]
+    if keep_dim:
+        out_shape = [1] * rank
+        if dim is not None:
+            out_shape[dim] = int(shape[dim])
+    else:
+        out_shape = [1] if dim is None else [int(shape[dim])]
+    sq = block.create_var(name=name_fn("weight_norm_sq"), shape=list(shape),
+                          dtype=dtype)
+    block.append_op(type="square", inputs={"X": [v_name]},
+                    outputs={"Out": [sq]})
+    ssum = block.create_var(name=name_fn("weight_norm_sum"),
+                            shape=out_shape, dtype=dtype)
+    block.append_op(type="reduce_sum", inputs={"X": [sq]},
+                    outputs={"Out": [ssum]},
+                    attrs={"dim": axes, "keep_dim": keep_dim,
+                           "reduce_all": dim is None})
+    block.append_op(type="sqrt", inputs={"X": [ssum]},
+                    outputs={"Out": [out_name]})
+    return out_shape
+
+
+def _weight_norm_ops(block, v_name, g_name, out_name, shape, dim, dtype,
+                     name_fn):
+    """Append w = v * g / ||v||  ops to `block` (norm over every axis
+    except `dim`, the reference's __norm_except_dim)."""
+    norm = block.create_var(name=name_fn("weight_norm_norm"), dtype=dtype)
+    bshape = _norm_except_dim_ops(block, v_name, norm.name, shape, dim,
+                                  dtype, name_fn, keep_dim=True)
+
+    def tmp(tag):
+        return block.create_var(name=name_fn(tag), shape=list(bshape),
+                                dtype=dtype)
+
+    g2 = tmp("weight_norm_g_reshaped")
+    block.append_op(type="reshape", inputs={"X": [g_name]},
+                    outputs={"Out": [g2]}, attrs={"shape": bshape})
+    scale = tmp("weight_norm_scale")
+    block.append_op(type="elementwise_div", inputs={"X": [g2], "Y": [norm]},
+                    outputs={"Out": [scale]}, attrs={"axis": -1})
+    block.append_op(type="elementwise_mul",
+                    inputs={"X": [v_name], "Y": [scale]},
+                    outputs={"Out": [out_name]}, attrs={"axis": -1})

@@ -105,14 +105,14 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
     n_slots = len(shapes)
     _lod_key = _re.compile(r"^(.*)__lod(\d+)__$")
 
-    def _fold(z):
+    def _fold(z, fn):
         # archive order == np.savez argument order; sorting would
         # scramble slots by key name
         base_keys = [k for k in z.files if not _lod_key.match(k)]
         if len(base_keys) != n_slots:
             raise ValueError(
-                f"record has {len(base_keys)} arrays but {n_slots} "
-                "slots declared"
+                f"record in {fn!r} has {len(base_keys)} arrays but "
+                f"{n_slots} slots declared"
             )
         out = []
         for k in base_keys:
@@ -136,7 +136,7 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
                     for rec in sc:
                         with np.load(_io.BytesIO(rec),
                                      allow_pickle=False) as z:
-                            yield _fold(z)
+                            yield _fold(z, fn)
 
     return reader
 

@@ -64,3 +64,27 @@ def test_lod_tensor_shim_feeds_executor():
     (got,) = exe.run(feed={"x": t}, fetch_list=[pooled])
     want = np.stack([flat[:2].sum(0), flat[2:].sum(0)])
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_name_scope_annotates_ops():
+    """fluid.name_scope (framework.py name_scope) attaches the reference's
+    op_namescope debug attr; execution is unaffected."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [2], dtype="float32")
+    with fluid.name_scope("encoder"):
+        with fluid.name_scope("l0"):
+            h = layers.fc(x, size=2)
+    out = layers.fc(h, size=1)
+    ops = fluid.default_main_program().desc.block(0).ops
+    scoped = [op.attrs.get("op_namescope") for op in ops
+              if op.attrs.get("op_namescope")]
+    assert "/encoder/l0/" in scoped
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (got,) = exe.run(feed={"x": np.ones((2, 2), "float32")},
+                     fetch_list=[out])
+    assert np.isfinite(np.asarray(got)).all()

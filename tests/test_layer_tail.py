@@ -96,3 +96,46 @@ def test_reader_aliases():
     out = list(batched())
     assert len(out) == 5
     assert len(out[0]) == 2  # batch of 2 samples
+
+
+def test_weight_norm_param_attr_reparameterizes():
+    """WeightNormParamAttr creates v (direction) + g (magnitude) params
+    with w = g * v / ||v|| recomputed each step (reference:
+    layer_helper.py _create_weight_normalize; Salimans & Kingma 2016);
+    g initializes to ||v_0|| over the non-dim axes."""
+    from paddle_tpu import layers
+
+    x = layers.data("x", [6], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=4,
+                  param_attr=fluid.WeightNormParamAttr(dim=1, name="wn"),
+                  bias_attr=False)
+    out = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(out, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+
+    params = {p.name for p in
+              fluid.default_main_program().global_block().all_parameters()}
+    assert "wn.w_v" in params and "wn.w_g" in params
+    assert "wn" not in params  # w is a computed var, not a Parameter
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    v = np.asarray(scope.find_var("wn.w_v"))
+    g = np.asarray(scope.find_var("wn.w_g"))
+    np.testing.assert_allclose(g, np.sqrt((v ** 2).sum(axis=0)), rtol=1e-5)
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 6).astype("float32")
+    yv = rng.randn(8, 1).astype("float32")
+    losses = [
+        float(np.ravel(np.asarray(
+            exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]))[0])
+        for _ in range(6)
+    ]
+    assert losses[-1] < losses[0]
+    # the magnitude parameter really trains (pure v-only training would
+    # leave the startup ||v_0|| untouched)
+    g_after = np.asarray(scope.find_var("wn.w_g"))
+    assert not np.allclose(g_after, g)
