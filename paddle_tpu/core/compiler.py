@@ -359,7 +359,12 @@ def lower_op(ctx: LoweringContext, op: OpDesc, need_vjp_uids) -> None:
         # outside the context wrapper: "no lowering rule" keeps its
         # NotImplementedError contract for feature probing
         raise NotImplementedError(f"op '{op.type}' has no TPU lowering rule")
-    with op_error_context(op):
+    # fluid op names (plus any fluid.name_scope annotation) become XLA
+    # metadata scopes, so profiler traces map back to program ops — the
+    # reference's RecordEvent-per-op/SetCurAnnotation story (profiler.h,
+    # device_tracer.h) at the HLO level
+    trace_name = op.attrs.get("op_namescope", "") + op.type
+    with op_error_context(op), jax.named_scope(trace_name):
         if is_grad:
             _lower_grad_op(ctx, op)
             return

@@ -581,6 +581,7 @@ def reset_default_env() -> None:
     switch_main_program(Program())
     switch_startup_program(Program())
     scope_mod._current_scope = scope_mod.Scope()
+    _NAME_SCOPE_COUNTS.clear()
 
 
 @contextlib.contextmanager
@@ -603,14 +604,27 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
 # op_proto_maker attaches, consumed by the debugger/graphviz tools)
 # ---------------------------------------------------------------------------
 _NAME_SCOPE_STACK: List[str] = []
+# per parent path: how often each child name was opened (the reference
+# suffixes repeated sibling scopes: block, block_1, block_2, ...)
+_NAME_SCOPE_COUNTS: Dict[tuple, Dict[str, int]] = defaultdict(
+    lambda: defaultdict(int)
+)
 
 
 @contextlib.contextmanager
 def name_scope(prefix: Optional[str] = None):
     """Annotate ops built inside with a hierarchical debug name
     (reference: framework.py name_scope; purely observational — no effect
-    on execution)."""
-    _NAME_SCOPE_STACK.append(prefix or "")
+    on execution).  Repeated sibling names auto-suffix like the
+    reference's NameScope.child: block, block_1, ..."""
+    prefix = prefix or ""
+    parent = tuple(_NAME_SCOPE_STACK)
+    if prefix:
+        seen = _NAME_SCOPE_COUNTS[parent][prefix]
+        _NAME_SCOPE_COUNTS[parent][prefix] += 1
+        if seen:
+            prefix = f"{prefix}_{seen}"
+    _NAME_SCOPE_STACK.append(prefix)
     try:
         yield
     finally:

@@ -16,7 +16,7 @@ from .core.framework import (
 )
 from .core.proto import DataType
 from .initializer import ConstantInitializer, XavierInitializer
-from .param_attr import ParamAttr
+from .param_attr import ParamAttr, WeightNormParamAttr
 
 __all__ = ["LayerHelper"]
 
@@ -94,8 +94,6 @@ class LayerHelper:
             return None
         if not isinstance(attr, ParamAttr):
             attr = ParamAttr._to_attr(attr)
-        from .param_attr import WeightNormParamAttr
-
         if isinstance(attr, WeightNormParamAttr) and not is_bias:
             return self._create_weight_normalize(
                 attr, shape, dtype, default_initializer
@@ -129,6 +127,8 @@ class LayerHelper:
         main program.  g initializes to ||v_0|| in the startup program so
         training starts at the conventional parameterization."""
         dim = attr.dim
+        if dim is not None:
+            dim = int(dim) % len(shape)  # accept negative dims
         base = attr.name or unique_name(f"{self.name}.w")
 
         def derived_attr(suffix, initializer, sharding):

@@ -88,3 +88,36 @@ def test_name_scope_annotates_ops():
     (got,) = exe.run(feed={"x": np.ones((2, 2), "float32")},
                      fetch_list=[out])
     assert np.isfinite(np.asarray(got)).all()
+
+
+def test_name_scope_suffixes_repeated_siblings():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [2], dtype="float32")
+    seen = []
+    for _ in range(2):
+        with fluid.name_scope("block"):
+            h = layers.fc(x, size=2)
+            ops = fluid.default_main_program().desc.block(0).ops
+            seen.append(ops[-1].attrs.get("op_namescope"))
+    assert seen[0] == "/block/" and seen[1] == "/block_1/"
+
+
+def test_weight_norm_negative_dim():
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [6], dtype="float32")
+    h = layers.fc(x, size=4,
+                  param_attr=fluid.WeightNormParamAttr(dim=-1, name="wn2"),
+                  bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    g = np.asarray(fluid.global_scope().find_var("wn2.w_g"))
+    assert g.shape == (4,)  # dim=-1 == last axis, per-column norms
+    (got,) = exe.run(feed={"x": np.ones((2, 6), "float32")},
+                     fetch_list=[h])
+    assert np.isfinite(np.asarray(got)).all()

@@ -78,3 +78,36 @@ def test_diff_api_tool_matches():
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_op_names_reach_hlo_metadata():
+    """Lowered programs carry fluid op types (and name_scope annotations)
+    as jax named_scopes, so profiler traces map back to program ops (the
+    reference's per-op RecordEvent/SetCurAnnotation linkage, profiler.h +
+    device_tracer.h:102)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.compiler import CompiledBlock
+    from paddle_tpu.core.executor import _RunPlan
+
+    x = layers.data("x", [2], dtype="float32")
+    with fluid.name_scope("enc"):
+        h = layers.fc(x, size=2, act="relu")
+    loss = layers.mean(h)
+
+    prog = fluid.default_main_program()
+    plan = _RunPlan(prog, ["x"], [loss.name])
+    cb = CompiledBlock(prog, 0, plan.feed_names, plan.fetch_names,
+                       plan.state_names, donate_states=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    blk = prog.desc.block(0)
+    txt = jax.jit(cb.raw_fn).lower(
+        plan.feed_values({"x": np.ones((2, 2), "float32")}, blk),
+        plan.state_values(fluid.global_scope(), blk),
+        jax.random.PRNGKey(0),
+    ).as_text(debug_info=True)
+    assert "enc/mul" in txt or "enc/relu" in txt

@@ -229,6 +229,7 @@ def test_fusion_transpose_flatten_concat():
            {"trans_axis": trans, "flatten_axis": flat_axis,
             "concat_axis": 1})
     t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
 
 
 def test_average_accumulates_window_rotation():
