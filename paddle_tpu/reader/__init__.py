@@ -29,6 +29,8 @@ __all__ = [
     "ComposeNotAligned",
 ]
 
+from . import creator  # noqa: E402,F401
+
 
 class ComposeNotAligned(ValueError):
     pass

@@ -1,0 +1,60 @@
+"""Reader creators (reference: python/paddle/reader/creator.py —
+np_array, text_file, recordio)."""
+
+from __future__ import annotations
+
+import glob as _glob
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Yield elements of a numpy vector / rows of a matrix / sub-planes of
+    a higher-rank array (reference: creator.py np_array)."""
+
+    def reader():
+        if x.ndim < 1:
+            # (the reference falls through here and crashes iterating a
+            # 0-d array; yield-and-stop is the documented behavior)
+            yield x
+            return
+        for e in x:
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    """Yield a text file line by line, trailing newline stripped
+    (reference: creator.py text_file)."""
+
+    def reader():
+        with open(path, "r") as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield raw records from recordio files — a comma-separated string
+    (glob patterns supported) or a list of paths (reference:
+    creator.py recordio over the recordio package; here the native
+    chunked reader in paddle_tpu.recordio)."""
+    from . import buffered
+    from ..recordio import RecordIOScanner
+
+    def reader():
+        if isinstance(paths, str):
+            path_list = [
+                p for pat in paths.split(",") for p in
+                (sorted(_glob.glob(pat)) or [pat])
+            ]
+        else:
+            path_list = list(paths)
+        for fn in path_list:
+            with RecordIOScanner(fn) as sc:
+                for rec in sc:
+                    yield rec
+
+    return buffered(reader, buf_size)

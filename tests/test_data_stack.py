@@ -326,3 +326,20 @@ def test_unique_name_switch_and_prefixed_guard():
         assert fluid.unique_name.generate("k") == "k_0"
     finally:
         fluid.unique_name.switch(old)
+
+
+def test_reader_creators(tmp_path):
+    """reader.creator np_array / text_file / recordio (reference:
+    python/paddle/reader/creator.py)."""
+    from paddle_tpu import reader as rdr
+    from paddle_tpu.recordio import write_recordio
+
+    assert [int(v) for v in rdr.creator.np_array(np.arange(3))()] == [0, 1, 2]
+
+    p = tmp_path / "t.txt"
+    p.write_text("a\nb\n")
+    assert list(rdr.creator.text_file(str(p))()) == ["a", "b"]
+
+    rp = str(tmp_path / "r.recordio")
+    write_recordio(rp, [b"one", b"two"])
+    assert list(rdr.creator.recordio(rp)()) == [b"one", b"two"]
