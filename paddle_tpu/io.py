@@ -33,7 +33,7 @@ __all__ = [
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
     "get_inference_program",
-    "save_sharded", "load_sharded",
+    "save_sharded", "load_sharded", "AsyncCheckpoint",
 ]
 
 
@@ -302,12 +302,31 @@ def load_inference_model(
 # ---------------------------------------------------------------------------
 # sharded (per-process) checkpointing
 # ---------------------------------------------------------------------------
+class AsyncCheckpoint:
+    """Handle for an in-flight save_sharded(asynchronous=True) write.  The
+    device->host snapshot happened before the call returned; wait() joins
+    the disk write and re-raises any IO error."""
+
+    def __init__(self, thread, exc_box):
+        self._thread = thread
+        self._exc_box = exc_box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self) -> None:
+        self._thread.join()
+        if self._exc_box:
+            raise self._exc_box[0]
+
+
 def save_sharded(
     dirname: str,
     main_program: Optional[Program] = None,
     scope=None,
     predicate: Optional[Callable] = None,
-) -> None:
+    asynchronous: bool = False,
+):
     """Per-process sharded checkpoint (reference analogue: the per-pserver
     parameter slices of distribute_transpiler.py:990; modern shape:
     tensorstore-style per-host shard files).
@@ -317,7 +336,14 @@ def save_sharded(
     index slices recorded alongside, plus (process 0) a `meta.json` of
     global shapes/dtypes.  No host ever materializes a full pod-scale
     tensor.  Works identically for single-process runs (every shard is
-    addressable)."""
+    addressable).
+
+    asynchronous=True snapshots device state to host synchronously, then
+    writes the files on a background thread and returns an AsyncCheckpoint
+    — training continues (and may donate/overwrite the live buffers)
+    while the checkpoint persists.  Multi-process runs ignore the flag
+    and write synchronously: the completion barrier is a collective,
+    which must not run off the main thread."""
     import jax
 
     main_program = main_program or default_main_program()
@@ -331,6 +357,16 @@ def save_sharded(
 
     os.makedirs(dirname, exist_ok=True)
     pid = jax.process_index()
+
+    if asynchronous:
+        # force a real host copy: np.asarray of a jax.Array can be a
+        # zero-copy view on CPU backends, and the next training step may
+        # donate/overwrite the live buffer while the background thread
+        # still reads it
+        def _snap(a):
+            return np.array(a, copy=True)
+    else:
+        _snap = np.asarray
     blobs = {}
     index = {}
     meta = {}
@@ -364,7 +400,7 @@ def save_sharded(
                     continue
                 seen.add(key)
                 slot = f"{n}@@{len(seen) - 1}"
-                blobs[slot] = np.asarray(s.data)
+                blobs[slot] = _snap(s.data)
                 index[slot] = {
                     "var": n,
                     "index": [
@@ -372,20 +408,66 @@ def save_sharded(
                     ],
                 }
         else:
-            blobs[f"{n}@@0"] = np.asarray(arr)
+            blobs[f"{n}@@0"] = _snap(arr)
             index[f"{n}@@0"] = {"var": n, "index": None}
-    np.savez(os.path.join(dirname, f"shard_{pid}.npz"), **blobs)
-    with open(os.path.join(dirname, f"index_{pid}.json"), "w") as f:
-        json.dump(index, f)
+    def _write():
+        np.savez(os.path.join(dirname, f"shard_{pid}.npz"), **blobs)
+        with open(os.path.join(dirname, f"index_{pid}.json"), "w") as f:
+            json.dump(index, f)
+
+    def _finish():
+        if pid == 0:
+            # write-then-rename: a crashed/killed writer never leaves a
+            # meta.json marking a truncated checkpoint complete (and an
+            # overwritten dir's STALE meta.json is replaced atomically)
+            tmp = os.path.join(dirname, ".meta.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, os.path.join(dirname, "meta.json"))
+
+    if asynchronous and jax.process_count() == 1:
+        import atexit
+        import threading
+
+        # an existing meta.json would mark the dir complete while the new
+        # shard files are still being written over the old ones
+        try:
+            os.remove(os.path.join(dirname, "meta.json"))
+        except FileNotFoundError:
+            pass
+        exc_box: list = []
+
+        def _bg():
+            try:
+                _write()
+                _finish()
+            except BaseException as e:  # surfaced by AsyncCheckpoint.wait
+                exc_box.append(e)
+
+        t = threading.Thread(target=_bg, name="save_sharded", daemon=True)
+        # never let interpreter exit kill a checkpoint mid-write
+        atexit.register(t.join)
+        t.start()
+        return AsyncCheckpoint(t, exc_box)
+
+    _write()
     if jax.process_count() > 1:
         # all shard files durable before meta.json marks the checkpoint
         # complete (and before any process returns to its caller)
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("save_sharded")
-    if pid == 0:
-        with open(os.path.join(dirname, "meta.json"), "w") as f:
-            json.dump(meta, f)
+    _finish()
+    if asynchronous:
+        # multi-process fallback wrote synchronously; hand back a
+        # completed handle so caller code stays uniform across scales
+        import threading
+
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        return AsyncCheckpoint(t, [])
+    return None
 
 
 def load_sharded(

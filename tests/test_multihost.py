@@ -193,3 +193,41 @@ def test_two_process_data_parallel():
         got = scope2.find_var(w)
         assert got is not None and list(np.shape(got)) == meta[w]["shape"]
 """worker stdout is attached on failure for debuggability."""
+
+
+def test_async_sharded_checkpoint(tmp_path):
+    """save_sharded(asynchronous=True): device state snapshots before the
+    call returns, files write on a background thread, and later scope
+    mutations (donated/overwritten buffers) don't leak into the
+    checkpoint."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    pred = layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="acp_w"),
+                     bias_attr=False)
+    loss = layers.mean(pred)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    snap = np.asarray(scope.find_var("acp_w")).copy()
+
+    d = str(tmp_path / "ckpt")
+    handle = fluid.io.save_sharded(d, asynchronous=True)
+    assert handle is not None
+    # mutate AFTER the async save: a training step replaces the param
+    exe.run(feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+    handle.wait()
+    assert handle.done()
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="acp_w"),
+              bias_attr=False)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())
+    fluid.io.load_sharded(d)
+    got = np.asarray(fluid.global_scope().find_var("acp_w"))
+    np.testing.assert_array_equal(got, snap)
