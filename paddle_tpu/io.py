@@ -302,6 +302,28 @@ def load_inference_model(
 # ---------------------------------------------------------------------------
 # sharded (per-process) checkpointing
 # ---------------------------------------------------------------------------
+# one writer thread per checkpoint dirname; a new async save joins the
+# previous one before touching the directory
+_inflight_saves: dict = {}
+_save_atexit_registered = False
+
+
+def _ensure_save_atexit():
+    # one process-wide hook (not one per save): interpreter exit joins
+    # every pending checkpoint write
+    global _save_atexit_registered
+    if _save_atexit_registered:
+        return
+    import atexit
+
+    def _join_all():
+        for t in list(_inflight_saves.values()):
+            t.join()
+
+    atexit.register(_join_all)
+    _save_atexit_registered = True
+
+
 class AsyncCheckpoint:
     """Handle for an in-flight save_sharded(asynchronous=True) write.  The
     device->host snapshot happened before the call returned; wait() joins
@@ -358,6 +380,14 @@ def save_sharded(
     os.makedirs(dirname, exist_ok=True)
     pid = jax.process_index()
 
+    # any earlier async save to this dirname must finish before we touch
+    # the directory (sync path included): the old writer could otherwise
+    # overwrite our shards or install its stale meta.json over them
+    key = os.path.abspath(dirname)
+    prev = _inflight_saves.pop(key, None)
+    if prev is not None:
+        prev.join()
+
     if asynchronous:
         # force a real host copy: np.asarray of a jax.Array can be a
         # zero-copy view on CPU backends, and the next training step may
@@ -393,12 +423,12 @@ def save_sharded(
             # dedup replicated shards: keep one per distinct index
             seen = set()
             for s in shards:
-                key = tuple(
+                idx_key = tuple(
                     (sl.start, sl.stop, sl.step) for sl in s.index
                 )
-                if key in seen:
+                if idx_key in seen:
                     continue
-                seen.add(key)
+                seen.add(idx_key)
                 slot = f"{n}@@{len(seen) - 1}"
                 blobs[slot] = _snap(s.data)
                 index[slot] = {
@@ -426,7 +456,6 @@ def save_sharded(
             os.replace(tmp, os.path.join(dirname, "meta.json"))
 
     if asynchronous and jax.process_count() == 1:
-        import atexit
         import threading
 
         # an existing meta.json would mark the dir complete while the new
@@ -443,10 +472,14 @@ def save_sharded(
                 _finish()
             except BaseException as e:  # surfaced by AsyncCheckpoint.wait
                 exc_box.append(e)
+            finally:
+                # self-prune, unless a newer save already took the slot
+                if _inflight_saves.get(key) is t:
+                    _inflight_saves.pop(key, None)
 
         t = threading.Thread(target=_bg, name="save_sharded", daemon=True)
-        # never let interpreter exit kill a checkpoint mid-write
-        atexit.register(t.join)
+        _inflight_saves[key] = t
+        _ensure_save_atexit()
         t.start()
         return AsyncCheckpoint(t, exc_box)
 

@@ -499,7 +499,13 @@ def _save_combine_op(ctx, ins, attrs):
         path = path + ".npz"
     names = list(attrs.get("var_names", []) or [])
     vals = [data(v) for v in ins["X"]]
-    if len(names) != len(vals):
+    if names and len(names) != len(vals):
+        # a silent var_i fallback would write an archive a names-specified
+        # load_combine cannot read, losing the declared mapping
+        raise ValueError(
+            f"save_combine: var_names has {len(names)} entries for "
+            f"{len(vals)} inputs")
+    if not names:
         names = [f"var_{i}" for i in range(len(vals))]
     if attrs.get("save_as_fp16"):
         vals = [v.astype(jnp.float16) for v in vals]

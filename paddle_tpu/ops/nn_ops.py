@@ -906,10 +906,10 @@ def _conv2d_fusion(ctx, ins, attrs):
             "conv2d_fusion split_channels (multi-output split) is not "
             "lowered; emit a separate split op")
     out = data(_conv2d_lower(ctx, ins, attrs)["Output"][0])
-    if ins.get("ResidualData") and ins["ResidualData"]:
+    if ins.get("ResidualData") and ins["ResidualData"][0] is not None:
         out, r = amp.match_kept(out, data(ins["ResidualData"][0]))
         out = out + r
-    if ins.get("Bias") and ins["Bias"]:
+    if ins.get("Bias") and ins["Bias"][0] is not None:
         out, b = amp.match_kept(out, data(ins["Bias"][0]).reshape(1, -1, 1, 1))
         out = out + b
     act = attrs.get("activation", "relu") or "identity"

@@ -338,38 +338,48 @@ def _average_accumulates(ctx, ins, attrs):
     step."""
     k_max = 16384
     param = data(ins["param"][0])
-    s1 = data(ins["in_sum_1"][0])
-    s2 = data(ins["in_sum_2"][0])
-    s3 = data(ins["in_sum_3"][0])
-    num_acc = data(ins["in_num_accumulates"][0]).reshape(()).astype(jnp.int32)
-    old_acc = data(ins["in_old_num_accumulates"][0]).reshape(()).astype(jnp.int32)
-    num_upd = data(ins["in_num_updates"][0]).reshape(()).astype(jnp.int32)
+    s1_in = data(ins["in_sum_1"][0])
+    s2_in = data(ins["in_sum_2"][0])
+    s3_in = data(ins["in_sum_3"][0])
+    # counters keep their stored integer dtype (int64 descs stay as wide
+    # as the runtime allows; see core/dtypes int64 policy)
+    num_acc = data(ins["in_num_accumulates"][0]).reshape(())
+    old_acc = data(ins["in_old_num_accumulates"][0]).reshape(())
+    num_upd = data(ins["in_num_updates"][0]).reshape(())
+    ctr_dt = num_acc.dtype
 
     num_upd = num_upd + 1
     num_acc = num_acc + 1
-    s1 = s1 + param
+    # the reference kernel's in_/out_ slots alias the SAME variables
+    # (ModelAverage wires sum_1 as both in_sum_1 and out_sum_1,
+    # optimizer.py:1490-1507), so "out_sum_2 = in_sum_2 + in_sum_1" reads
+    # the post-update sum_1 through the alias: rotations drain the
+    # post-update sums and no step's param is ever dropped
+    s1 = s1_in + param
 
     drain12 = (num_upd % k_max) == 0
-    s2 = jnp.where(drain12, s2 + s1, s2)
+    s2 = jnp.where(drain12, s2_in + s1, s2_in)
     s1 = jnp.where(drain12, jnp.zeros_like(s1), s1)
 
+    # std::min<int64_t>(max_window, num_updates * average_window)
+    # truncates the float product to integer before comparing
     window = jnp.minimum(
-        jnp.asarray(attrs.get("max_average_window", 2 ** 31 - 1), jnp.float32),
-        num_upd.astype(jnp.float32) * attrs.get("average_window", 0.0),
+        jnp.asarray(attrs.get("max_average_window", 2 ** 31 - 1), ctr_dt),
+        (num_upd.astype(jnp.float32)
+         * attrs.get("average_window", 0.0)).astype(ctr_dt),
     )
     close = (num_acc >= attrs.get("min_average_window", 10000)) & (
-        num_acc.astype(jnp.float32) >= window)
-    s3 = jnp.where(close, s1 + s2, s3)
+        num_acc >= window)
+    s3 = jnp.where(close, s1 + s2, s3_in)
     s1 = jnp.where(close, jnp.zeros_like(s1), s1)
     s2 = jnp.where(close, jnp.zeros_like(s2), s2)
     old_acc = jnp.where(close, num_acc, old_acc)
     num_acc = jnp.where(close, jnp.zeros_like(num_acc), num_acc)
 
     shp = data(ins["in_num_accumulates"][0]).shape
-    dt = data(ins["in_num_accumulates"][0]).dtype
     return {
         "out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
-        "out_num_accumulates": [num_acc.astype(dt).reshape(shp)],
-        "out_old_num_accumulates": [old_acc.astype(dt).reshape(shp)],
-        "out_num_updates": [num_upd.astype(dt).reshape(shp)],
+        "out_num_accumulates": [num_acc.reshape(shp)],
+        "out_old_num_accumulates": [old_acc.reshape(shp)],
+        "out_num_updates": [num_upd.reshape(shp)],
     }

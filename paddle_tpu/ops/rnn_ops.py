@@ -539,11 +539,14 @@ def _attention_lstm(ctx, ins, attrs):
     n, t, m = d.shape
     aw = data(ins["AttentionWeight"][0]).reshape(-1)   # [(M+D)]
     ab = (data(ins["AttentionBias"][0]).reshape(())
-          if ins.get("AttentionBias") and ins["AttentionBias"] else None)
+          if ins.get("AttentionBias") and ins["AttentionBias"][0] is not None
+          else None)
     a_scal = (data(ins["AttentionScalar"][0]).reshape(())
-              if ins.get("AttentionScalar") and ins["AttentionScalar"] else None)
+              if ins.get("AttentionScalar")
+              and ins["AttentionScalar"][0] is not None else None)
     a_scal_b = (data(ins["AttentionScalarBias"][0]).reshape(())
-                if ins.get("AttentionScalarBias") and ins["AttentionScalarBias"] else None)
+                if ins.get("AttentionScalarBias")
+                and ins["AttentionScalarBias"][0] is not None else None)
     lw = data(ins["LSTMWeight"][0])              # [(D+M), 4D]
     lb = data(ins["LSTMBias"][0]).reshape(-1)    # [4D]
     dim = lw.shape[1] // 4
@@ -556,7 +559,8 @@ def _attention_lstm(ctx, ins, attrs):
     if ab is not None:
         atted_x = atted_x + ab
     mask = jnp.arange(t)[None, :] < l[:, None]   # [N, T]
-    h0 = (data(ins["H0"][0]) if ins.get("H0") and ins["H0"]
+    h0 = (data(ins["H0"][0])
+          if ins.get("H0") and ins["H0"][0] is not None
           else jnp.zeros((n, dim), d.dtype))
     c0 = data(ins["C0"][0])                      # required by the reference
 
@@ -572,6 +576,9 @@ def _attention_lstm(ctx, ins, attrs):
             score = jax.nn.relu(score)
         score = jnp.where(mask, score, -jnp.inf)
         alpha = jax.nn.softmax(score, axis=1)    # [N, T]
+        # a zero-length row has an all -inf score -> softmax NaN; zero it
+        # (the mf masking below cannot scrub it: NaN * 0 = NaN)
+        alpha = jnp.where(mask.any(axis=1, keepdims=True), alpha, 0.0)
         lstm_x = jnp.einsum("nt,ntm->nm", alpha, d)
         # 2. LSTM step, [f, i, o, cand] gate order
         gates = lstm_x @ lw[dim:] + h_prev @ lw[:dim] + lb

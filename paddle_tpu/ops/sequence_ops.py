@@ -675,7 +675,7 @@ def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
         cat = jnp.concatenate(rest, axis=-1)  # [N, M1+M2+...]
         fc_out = cat @ w[m0:]                 # [N, D]
         tok = tok + fc_out[:, None, :]
-    if ins.get("FCBias") and ins["FCBias"]:
+    if ins.get("FCBias") and ins["FCBias"][0] is not None:
         tok = tok + data(ins["FCBias"][0]).reshape(-1)
     act = ACTS[attrs.get("fc_activation", "identity") or "identity"]
     out = act(tok) * _time_mask(d, l)[..., None].astype(d.dtype)

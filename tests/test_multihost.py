@@ -231,3 +231,47 @@ def test_async_sharded_checkpoint(tmp_path):
     fluid.io.load_sharded(d)
     got = np.asarray(fluid.global_scope().find_var("acp_w"))
     np.testing.assert_array_equal(got, snap)
+
+
+def test_async_checkpoint_overlapping_saves(tmp_path):
+    """Two async saves to the same dirname serialize: the second joins the
+    first's writer before touching the directory, so the final meta.json
+    and shards all belong to the newest save (no stale-meta race)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.io import _inflight_saves
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    pred = layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="ov_w"),
+                     bias_attr=False)
+    loss = layers.mean(pred)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+
+    d = str(tmp_path / "ckpt")
+    h1 = fluid.io.save_sharded(d, asynchronous=True)
+    exe.run(feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+    h2 = fluid.io.save_sharded(d, asynchronous=True)
+    # the second save must have joined the first before starting
+    assert h1.done()
+    exe.run(feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+    snap2 = np.asarray(scope.find_var("ov_w")).copy()
+    # a SYNC save to the same dir also joins the in-flight async writer
+    fluid.io.save_sharded(d)
+    h2.wait()
+    assert h2.done()
+    # finished writers self-prune from the in-flight registry
+    assert os.path.abspath(d) not in _inflight_saves
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4], dtype="float32")
+    layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="ov_w"),
+              bias_attr=False)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())
+    fluid.io.load_sharded(d)
+    got = np.asarray(fluid.global_scope().find_var("ov_w"))
+    np.testing.assert_array_equal(got, snap2)

@@ -255,6 +255,10 @@ def test_average_accumulates_window_rotation():
     t.check_output()
 
     one = np.array([1], "int64")
+    # the close rotates the POST-update sums: the reference kernel's
+    # in_/out_ slots alias the same variables, so its
+    # "out_sum_3 = in_sum_1 + in_sum_2" reads sum_1 + param through
+    # the alias (average_accumulates_op.h with optimizer.py:1490 wiring)
     t = _t("average_accumulates",
            {"param": p, "in_sum_1": p.copy(), "in_sum_2": s2, "in_sum_3": s3,
             "in_num_accumulates": one, "in_old_num_accumulates": zero,
