@@ -43,6 +43,7 @@ from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .core.lod import LoDValue, create_lod_tensor  # noqa: F401
 from .core.executor import Executor  # noqa: F401
 from .core.amp import enable_amp, disable_amp, amp_dtype  # noqa: F401
+from .core.dtypes import enable_x64, x64_enabled, x64_scope  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 from .core import proto as core  # noqa: F401  (fluid.core-ish alias)

@@ -1,0 +1,106 @@
+"""Integer/float width policy — the honest int64 contract.
+
+The reference's default integer dtype is int64: lookup ids, labels, and
+counters are all INT64 VarDescs (reference: operators/lookup_table_op.cc:80
+expects int64 ids).  TPUs are int32-native, and jax canonicalizes 64-bit
+dtypes down to 32-bit unless x64 mode is on — by default with a noisy
+UserWarning and silent value truncation.
+
+paddle_tpu replaces warn-and-truncate with an explicit two-mode contract:
+
+* **default (x64 off)** — INT64/FP64 descs *materialize* as int32/float32
+  on device (the TPU-native widths).  The host feed boundary range-checks
+  every int64 feed: a value outside int32 range raises OverflowError
+  naming the variable instead of corrupting ids.  In-graph array creation
+  goes through :func:`dtype_to_runtime` / :func:`wide_int`, so jax never
+  emits a truncation warning.  Fetches cast back to the declared dtype, so
+  user-visible numpy keeps the reference's int64.
+* **enable_x64(True)** — 64-bit descs are honored end-to-end, for e.g.
+  hash/CTR id spaces past 2**31.  bf16/f32 MXU compute is unaffected:
+  float dtypes are pinned per-desc by every lowering, and FP32 descs stay
+  fp32 either way.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+_I32_MIN = -(2 ** 31)
+_I32_MAX = 2 ** 31 - 1
+
+# declared 64-bit -> device 32-bit when x64 is off
+_NARROW = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+def x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def enable_x64(on: bool = True) -> None:
+    """Honor 64-bit VarDesc dtypes on device (ids/labels past 2**31).
+    Flipping this invalidates jit caches; call it before building
+    executors."""
+    import jax
+
+    jax.config.update("jax_enable_x64", bool(on))
+
+
+@contextlib.contextmanager
+def x64_scope(on: bool = True):
+    import jax
+
+    prev = bool(jax.config.jax_enable_x64)
+    jax.config.update("jax_enable_x64", bool(on))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def runtime_np_dtype(np_dtype) -> np.dtype:
+    """The dtype a declared desc dtype actually materializes as on device."""
+    dt = np.dtype(np_dtype)
+    if x64_enabled():
+        return dt
+    return _NARROW.get(dt, dt)
+
+
+def wide_int():
+    """The widest integer dtype the runtime carries — int64 under x64,
+    otherwise int32.  Use for in-graph casts of index/count outputs whose
+    desc says INT64; the executor's fetch path restores the declared numpy
+    dtype at the host boundary."""
+    import jax.numpy as jnp
+
+    return jnp.int64 if x64_enabled() else jnp.int32
+
+
+def checked_feed_cast(arr: np.ndarray, want, name: str = "?") -> np.ndarray:
+    """Cast a host feed to the device dtype for its declared desc dtype.
+
+    Under the narrow (default) policy, an int64-declared feed holding
+    values outside int32 range raises OverflowError naming the variable —
+    never a silent truncation.  (Float narrowing is a precision change,
+    not corruption, and passes through.)"""
+    want = np.dtype(want)
+    rt = runtime_np_dtype(want)
+    if rt != want and np.issubdtype(want, np.integer) and arr.size:
+        lo, hi = int(arr.min()), int(arr.max())
+        if lo < _I32_MIN or hi > _I32_MAX:
+            raise OverflowError(
+                f"feed '{name}': int64 value out of int32 range "
+                f"(min={lo}, max={hi}); the runtime narrows INT64 to int32 "
+                "unless x64 is enabled — call "
+                "paddle_tpu.enable_x64() for ids/labels past 2**31"
+            )
+    if arr.dtype != rt:
+        arr = arr.astype(rt)
+    return arr

@@ -23,7 +23,8 @@ from .compiler import CompiledBlock
 from .framework import Program, Variable, default_main_program
 from .lod import LoDValue
 from .place import CPUPlace, Place, TPUPlace
-from .proto import VarType, dtype_to_numpy
+from .dtypes import checked_feed_cast
+from .proto import VarType, dtype_to_numpy, dtype_to_runtime
 from .scope import Scope, global_scope
 
 __all__ = ["Executor", "RNG_STATE_VAR"]
@@ -35,6 +36,14 @@ def _as_feed_value(value, var_desc=None):
     if hasattr(value, "_as_feed"):  # fluid.Tensor / fluid.LoDTensor shim
         value = value._as_feed()
     if isinstance(value, LoDValue):
+        if var_desc is not None and isinstance(value.data, np.ndarray):
+            want = dtype_to_numpy(var_desc.dtype)
+            try:
+                cast = checked_feed_cast(value.data, want, var_desc.name)
+            except TypeError:
+                cast = value.data
+            if cast is not value.data:
+                value = LoDValue(cast, value.lengths, value.sub_lengths)
         return value
     if isinstance(value, jax.Array):
         # already on device: pass through untouched (np.asarray would force a
@@ -44,8 +53,9 @@ def _as_feed_value(value, var_desc=None):
     if var_desc is not None and var_desc.type == VarType.LOD_TENSOR:
         want = dtype_to_numpy(var_desc.dtype)
         try:
-            if arr.dtype != want:
-                arr = arr.astype(want)
+            # range-checked narrow of int64 feeds (OverflowError past
+            # 2**31 unless x64 is on — core/dtypes.py policy)
+            arr = checked_feed_cast(arr, want, var_desc.name)
         except TypeError:
             pass
     return arr
@@ -111,7 +121,7 @@ class _RunPlan:
                     )
                 vd = block0.vars[n]
                 shape = [d if d >= 0 else 1 for d in vd.shape] or [1]
-                v = np.zeros(shape, dtype=dtype_to_numpy(vd.dtype))
+                v = np.zeros(shape, dtype=dtype_to_runtime(vd.dtype))
             vals.append(v)
         return tuple(vals)
 

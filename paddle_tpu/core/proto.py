@@ -89,6 +89,19 @@ def dtype_to_numpy(dtype: "DataType"):
     return _NP_BY_DTYPE[DataType(dtype)]
 
 
+def dtype_to_runtime(dtype: "DataType"):
+    """Device-side dtype for a declared desc dtype: 64-bit widths narrow
+    to 32-bit unless x64 is enabled (core/dtypes.py policy).  Lowerings
+    that CREATE arrays use this; the fetch path uses dtype_to_numpy to
+    restore the declared dtype at the host boundary."""
+    np_dt = dtype_to_numpy(dtype)
+    if np_dt is not None and not isinstance(np_dt, np.dtype):
+        return np_dt  # bfloat16: jax scalar type, never narrowed
+    from .dtypes import runtime_np_dtype
+
+    return runtime_np_dtype(np_dt)
+
+
 def numpy_to_dtype(np_dtype) -> "DataType":
     name = np.dtype(np_dtype).name if not _is_bf16(np_dtype) else "bfloat16"
     table = {

@@ -1,0 +1,258 @@
+"""Cross-process transport for the Downpour parameter server.
+
+The reference runs pservers as real processes behind a gRPC/BRPC var
+transport (operators/distributed/grpc_client.h:175, grpc_server.cc;
+trainer/pserver processes forked by
+python/paddle/fluid/tests/unittests/test_dist_base.py:212).  Dense data
+parallelism in this framework rides XLA collectives instead, so the only
+cross-process PS traffic left is the async Downpour plane: sparse row
+pull/push and windowed dense pull/push.  This module is that transport —
+a length-prefixed binary protocol over TCP (JSON header + raw ndarray
+payloads, no pickle), serving a `ps_core.PSCore` to `RemotePS` clients
+that plug into `AsyncExecutor.init_worker(ps=...)` unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PSServer", "RemotePS", "serve_ps"]
+
+_MAGIC = b"PSR1"
+
+
+def _send_msg(sock: socket.socket, header: dict,
+              arrays: Optional[Dict[str, np.ndarray]] = None) -> None:
+    arrays = arrays or {}
+    meta = dict(header)
+    meta["__arrays__"] = {
+        k: {"dtype": str(a.dtype), "shape": list(a.shape)}
+        for k, a in arrays.items()
+    }
+    hbytes = json.dumps(meta).encode()
+    parts = [_MAGIC, struct.pack(">I", len(hbytes)), hbytes]
+    for k in meta["__arrays__"]:
+        buf = np.ascontiguousarray(arrays[k]).tobytes()
+        parts.append(struct.pack(">Q", len(buf)))
+        parts.append(buf)
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("PS peer closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, Dict[str, np.ndarray]]:
+    magic = _recv_exact(sock, 4)
+    if magic != _MAGIC:
+        raise ConnectionError(f"bad PS frame magic {magic!r}")
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    meta = json.loads(_recv_exact(sock, hlen).decode())
+    arrays = {}
+    for k, spec in meta.pop("__arrays__", {}).items():
+        (blen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        arrays[k] = np.frombuffer(
+            _recv_exact(sock, blen), dtype=np.dtype(spec["dtype"])
+        ).reshape(spec["shape"])
+    return meta, arrays
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # one connection, many requests
+        core = self.server.ps_core
+        lock = self.server.ps_lock
+        while True:
+            try:
+                req, arrays = _recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            cmd = req.get("cmd")
+            stop = False
+            try:
+                # table ops serialize on the lock; the socket write happens
+                # OUTSIDE it so one client's slow drain doesn't stall the
+                # others (per-table Hogwild batching stays client-side)
+                with lock:
+                    if cmd == "pull_sparse":
+                        reply = ({"ok": True}, {
+                            "rows": core.sparse(req["table"]).pull(
+                                arrays["ids"])})
+                    elif cmd == "push_sparse":
+                        core.sparse(req["table"]).push(
+                            arrays["ids"], arrays["grads"])
+                        reply = ({"ok": True}, None)
+                    elif cmd == "sparse_len":
+                        reply = ({"ok": True,
+                                  "len": len(core.sparse(req["table"]))},
+                                 None)
+                    elif cmd == "sparse_dim":
+                        reply = ({"ok": True,
+                                  "dim": int(core.sparse(req["table"]).dim)},
+                                 None)
+                    elif cmd == "pull_dense":
+                        reply = ({"ok": True},
+                                 {"flat": core.dense(req["table"]).pull()})
+                    elif cmd == "push_dense":
+                        core.dense(req["table"]).push(arrays["grad"])
+                        reply = ({"ok": True}, None)
+                    elif cmd == "init_dense":
+                        t = core.dense(req["table"])
+                        if not t.initialized:  # first worker wins
+                            t.init(arrays["values"])
+                        reply = ({"ok": True}, None)
+                    elif cmd == "dense_initialized":
+                        reply = ({"ok": True, "initialized": bool(
+                            core.dense(req["table"]).initialized)}, None)
+                    elif cmd == "save":
+                        core.save(req["path"])
+                        reply = ({"ok": True}, None)
+                    elif cmd == "shutdown":
+                        reply = ({"ok": True}, None)
+                        stop = True
+                    else:
+                        reply = ({"ok": False,
+                                  "error": f"unknown cmd {cmd!r}"}, None)
+            except Exception as e:  # surface server-side errors to client
+                reply = ({"ok": False, "error": str(e)}, None)
+            _send_msg(self.request, reply[0], reply[1])
+            if stop:
+                threading.Thread(
+                    target=self.server.shutdown, daemon=True
+                ).start()
+                return
+
+
+class PSServer(socketserver.ThreadingTCPServer):
+    """Serve a PSCore over TCP.  One thread per client connection; table
+    mutations serialize on one lock (the Hogwild batching happens
+    client-side, as in the reference's per-request server handlers)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, core, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.ps_core = core
+        self.ps_lock = threading.Lock()
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self.server_address
+        return f"{h}:{p}"
+
+
+def serve_ps(core, host: str = "127.0.0.1", port: int = 0) -> PSServer:
+    """Start serving `core` on a background thread; returns the server
+    (use .endpoint for clients, .shutdown() to stop)."""
+    srv = PSServer(core, host, port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._lock = threading.Lock()
+
+    def call(self, header: dict, arrays=None) -> Tuple[dict, dict]:
+        with self._lock:
+            _send_msg(self._sock, header, arrays)
+            resp, resp_arrays = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"PS server error: {resp.get('error')}")
+        return resp, resp_arrays
+
+
+class _RemoteSparse:
+    def __init__(self, conn: _Conn, table_id: int):
+        self._c = conn
+        self._t = table_id
+        self._dim: Optional[int] = None
+
+    @property
+    def dim(self) -> int:
+        if self._dim is None:
+            resp, _ = self._c.call({"cmd": "sparse_dim", "table": self._t})
+            self._dim = int(resp["dim"])
+        return self._dim
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1))
+        _, arrays = self._c.call(
+            {"cmd": "pull_sparse", "table": self._t}, {"ids": ids})
+        return arrays["rows"]
+
+    def push(self, ids, grads) -> None:
+        self._c.call(
+            {"cmd": "push_sparse", "table": self._t},
+            {"ids": np.ascontiguousarray(np.asarray(ids).reshape(-1)),
+             "grads": np.ascontiguousarray(grads)})
+
+    def __len__(self) -> int:
+        resp, _ = self._c.call({"cmd": "sparse_len", "table": self._t})
+        return int(resp["len"])
+
+
+class _RemoteDense:
+    def __init__(self, conn: _Conn, table_id: int):
+        self._c = conn
+        self._t = table_id
+
+    def pull(self) -> np.ndarray:
+        _, arrays = self._c.call({"cmd": "pull_dense", "table": self._t})
+        return arrays["flat"]
+
+    def push(self, grad) -> None:
+        self._c.call({"cmd": "push_dense", "table": self._t},
+                     {"grad": np.ascontiguousarray(grad)})
+
+    def init(self, values) -> None:
+        self._c.call({"cmd": "init_dense", "table": self._t},
+                     {"values": np.ascontiguousarray(values)})
+
+    @property
+    def initialized(self) -> bool:
+        resp, _ = self._c.call(
+            {"cmd": "dense_initialized", "table": self._t})
+        return bool(resp["initialized"])
+
+
+class RemotePS:
+    """Client-side PSCore facade: drop-in for
+    AsyncExecutor.init_worker(ps=...) across process boundaries."""
+
+    def __init__(self, endpoint: str):
+        self._conn = _Conn(endpoint)
+        self._sparse: Dict[int, _RemoteSparse] = {}
+        self._dense: Dict[int, _RemoteDense] = {}
+
+    def sparse(self, table_id: int) -> _RemoteSparse:
+        if table_id not in self._sparse:
+            self._sparse[table_id] = _RemoteSparse(self._conn, table_id)
+        return self._sparse[table_id]
+
+    def dense(self, table_id: int) -> _RemoteDense:
+        if table_id not in self._dense:
+            self._dense[table_id] = _RemoteDense(self._conn, table_id)
+        return self._dense[table_id]
+
+    def save(self, path: str) -> None:
+        self._conn.call({"cmd": "save", "path": path})
+
+    def shutdown_server(self) -> None:
+        self._conn.call({"cmd": "shutdown"})

@@ -34,6 +34,10 @@ _DEFS: Dict[str, Any] = {
     "FLAGS_init_allocated_mem": False,
     "FLAGS_paddle_num_threads": 1,
     "FLAGS_use_pinned_memory": True,
+    # internal conv compute layout: "NCHW" (reference default) or "NHWC"
+    # (TPU-preferred — convs lower with NHWC dimension_numbers behind
+    # boundary transposes that XLA cancels between chained convs)
+    "FLAGS_conv_layout": "NCHW",
 }
 
 _VALUES: Dict[str, Any] = {}
@@ -76,6 +80,13 @@ def get_flags(names=None) -> Dict[str, Any]:
     return {_canon(n): _VALUES[_canon(n)] for n in names}
 
 
+# flags restricted to an exact value set (a typo'd value would otherwise
+# silently select the default branch at the use site)
+_CHOICES: Dict[str, tuple] = {
+    "FLAGS_conv_layout": ("NCHW", "NHWC"),
+}
+
+
 def set_flags(flags: Dict[str, Any]) -> None:
     """reference parity: paddle.set_flags({'FLAGS_check_nan_inf': True})."""
     for name, value in flags.items():
@@ -83,7 +94,11 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if cname not in _DEFS:
             raise KeyError(f"unknown flag {name!r}")
         default = _DEFS[cname]
-        _VALUES[cname] = (
+        coerced = (
             _coerce(default, value) if isinstance(value, str)
             else type(default)(value)
         )
+        if cname in _CHOICES and coerced not in _CHOICES[cname]:
+            raise ValueError(
+                f"{cname} must be one of {_CHOICES[cname]}, got {coerced!r}")
+        _VALUES[cname] = coerced

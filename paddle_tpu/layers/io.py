@@ -9,7 +9,13 @@ from typing import List, Optional, Sequence
 from ..core.framework import Variable, default_main_program
 from ..core.proto import VarType
 
-from .io_pyreader import EOFException, double_buffer, py_reader, read_file  # noqa: F401
+from .io_pyreader import (  # noqa: F401
+    EOFException,
+    Preprocessor,
+    double_buffer,
+    py_reader,
+    read_file,
+)
 
 __all__ = ["data", "py_reader", "read_file", "double_buffer", "EOFException", "shuffle", "batch", "create_py_reader_by_data", "random_data_generator", "open_files", "Preprocessor"]
 
@@ -141,28 +147,3 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
     return reader
 
 
-class Preprocessor:
-    """Reader-pipeline transform (reference: layers/io.py Preprocessor):
-    wraps a python reader; the block body is a sample-mapping function.
-    The instance itself is the new reader callable:
-
-        p = Preprocessor(reader)
-        @p.block
-        def _map(*slots): return transformed_slots
-        for sample in p(): ...
-    """
-
-    def __init__(self, reader, name=None):
-        self._reader = reader
-        self._fn = None
-
-    def block(self, fn):
-        self._fn = fn
-        return fn
-
-    def __call__(self):
-        if self._fn is None:
-            raise RuntimeError("Preprocessor.block was never set")
-        for sample in self._reader():
-            out = self._fn(*sample)
-            yield out if isinstance(out, tuple) else (out,)

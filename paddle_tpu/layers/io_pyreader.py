@@ -22,7 +22,8 @@ from ..core.framework import default_main_program, unique_name
 from ..core.lod import create_lod_tensor
 from ..core.proto import EOFException, convert_dtype, dtype_to_numpy
 
-__all__ = ["py_reader", "read_file", "double_buffer", "EOFException"]
+__all__ = ["py_reader", "read_file", "double_buffer", "EOFException",
+           "Preprocessor"]
 
 
 class PyReader:
@@ -181,3 +182,193 @@ def double_buffer(reader, place=None, name=None):
     """reference: layers/io.py double_buffer.  JAX's async dispatch already
     overlaps host feed with device compute, so this is the identity."""
     return reader
+
+
+class _PreprocessedReader(PyReader):
+    """A PyReader decorated with a compiled per-batch transform
+    (reference: operators/reader/create_custom_reader_op.cc CustomReader —
+    its ReadNextImpl runs the sub-block through a CPU executor per batch;
+    here the sub-block is jitted once and applied in the worker thread,
+    overlapping with device compute)."""
+
+    def __init__(self, underlying, names, shapes, dtypes, lod_levels,
+                 transform):
+        super().__init__(names, shapes, dtypes, lod_levels,
+                         underlying._capacity)
+        self._underlying = underlying
+        self._transform = transform
+
+    def start(self):
+        # late-bind the data source: the user decorates the UNDERLYING
+        # reader, possibly after the Preprocessor was built
+        self._creator = self._underlying._creator
+        self._tensor_provider = self._underlying._tensor_provider
+        super().start()
+
+    def decorate_paddle_reader(self, reader_creator):
+        self._underlying.decorate_paddle_reader(reader_creator)
+
+    def decorate_tensor_provider(self, provider):
+        self._underlying.decorate_tensor_provider(provider)
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_tensor_provider
+
+    def _convert_batch(self, batch) -> dict:
+        # batch the SOURCE slots with the underlying reader's metadata,
+        # then run the compiled sub-block
+        src = self._underlying._convert_batch(batch)
+        return self._transform(src)
+
+
+class Preprocessor:
+    """In-pipeline data preprocessing block (reference: layers/io.py:1080
+    Preprocessor over operators/reader/create_custom_reader_op.cc).
+
+        preprocessor = fluid.layers.Preprocessor(reader=reader)
+        with preprocessor.block():
+            img, lbl = preprocessor.inputs()
+            preprocessor.outputs(img / 2, lbl + 1)
+        out_vars = fluid.layers.read_file(preprocessor())
+
+    The reference interprets the sub-block per batch on a CPU executor
+    inside the decorated reader; here the sub-block lowers ONCE to a
+    jitted XLA fn the reader worker applies to every batch — identical
+    dataflow, compiled execution."""
+
+    BEFORE_SUB_BLOCK = 0
+    IN_SUB_BLOCK = 1
+    AFTER_SUB_BLOCK = 2
+
+    def __init__(self, reader, name=None):
+        self.underlying_reader = reader
+        self.main_prog = default_main_program()
+        self.sub_block = None
+        self.source_var_names = None
+        self.sink_var_names = None
+        self._name = name
+        self._map_fn = None  # legacy plain-python-reader mapping mode
+        self.status = Preprocessor.BEFORE_SUB_BLOCK
+
+    def _is_completed(self):
+        return (self.sub_block is not None and self.source_var_names
+                and self.sink_var_names)
+
+    def block(self, fn=None):
+        # legacy convenience: @p.block over a plain python reader maps
+        # samples host-side (no program sub-block involved)
+        if fn is not None and callable(fn):
+            self._map_fn = fn
+            return fn
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self.status = Preprocessor.IN_SUB_BLOCK
+            self.sub_block = self.main_prog._create_block()
+            try:
+                yield
+            finally:
+                # roll back even when the body raises — otherwise every
+                # later layer call lands in the orphaned sub-block
+                self.main_prog._rollback()
+                self.status = Preprocessor.AFTER_SUB_BLOCK
+            if not self._is_completed():
+                raise RuntimeError(
+                    "The definition of preprocessor is incomplete! Set "
+                    "input and output variables via inputs()/outputs() "
+                    "inside the sub-block.")
+
+        return _ctx()
+
+    def inputs(self):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.inputs() can only be invoked inside the "
+                "sub-block.")
+        u = self.underlying_reader
+        self.source_var_names = [
+            unique_name("preprocessor_source") for _ in u._names
+        ]
+        block = self.main_prog.current_block()
+        src_vars = []
+        for vname, shape, np_dtype, lod in zip(
+            self.source_var_names, u._shapes, u._np_dtypes, u._lod_levels
+        ):
+            src_vars.append(block.create_var(
+                name=vname, shape=list(shape), dtype=np.dtype(np_dtype).name,
+                lod_level=lod, stop_gradient=True,
+            ))
+        return src_vars
+
+    def outputs(self, *outs):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.outputs() can only be invoked inside the "
+                "sub-block.")
+        self.sink_var_names = [v.name for v in outs]
+        self._sink_vars = list(outs)
+
+    def __call__(self):
+        if self._map_fn is not None:
+            map_fn, rd = self._map_fn, self.underlying_reader
+
+            def _mapped():
+                for sample in rd():
+                    out = map_fn(*sample)
+                    yield out if isinstance(out, tuple) else (out,)
+
+            return _mapped()
+        if not self._is_completed():
+            raise RuntimeError(
+                "Preprocessor not complete: define the sub-block first.")
+        from ..core.compiler import CompiledBlock
+
+        compiled = CompiledBlock(
+            self.main_prog, self.sub_block.idx,
+            feed_names=self.source_var_names,
+            fetch_names=self.sink_var_names,
+            state_names=[], donate_states=False,
+        )
+        # the underlying reader batches under ITS slot names; the
+        # sub-block's source vars correspond positionally
+        slot_names = list(self.underlying_reader._names)
+        seed_box = [0]
+
+        def transform(src: dict) -> dict:
+            import jax
+
+            key = jax.random.PRNGKey(seed_box[0])
+            seed_box[0] += 1
+            vals = tuple(src[n] for n in slot_names)
+            fetches, _, _ = compiled(vals, (), key)
+            return dict(zip(out_names, fetches))
+
+        u = self.underlying_reader
+        out_names = [unique_name(f"{self._name or 'custom_reader'}_slot{i}")
+                     for i in range(len(self._sink_vars))]
+        block = self.main_prog.current_block()
+        out_vars = []
+        shapes, dtypes, lods = [], [], []
+        for oname, sv in zip(out_names, self._sink_vars):
+            out_vars.append(block.create_var(
+                name=oname, shape=list(sv.shape), dtype=sv.dtype,
+                lod_level=sv.lod_level, stop_gradient=True,
+            ))
+            shapes.append(list(sv.shape))
+            dtypes.append(sv.dtype)
+            lods.append(sv.lod_level)
+
+        new_reader = _PreprocessedReader(
+            u, out_names, shapes, dtypes, lods, transform)
+        new_reader._data_vars = out_vars
+        new_reader.name = self._name or unique_name("create_custom_reader")
+        if not hasattr(self.main_prog, "_py_readers"):
+            self.main_prog._py_readers = []
+        # the decorated reader SUBSUMES the underlying one (reference
+        # DecoratedReader semantics): only the outer reader feeds the
+        # program
+        if u in self.main_prog._py_readers:
+            self.main_prog._py_readers.remove(u)
+        self.main_prog._py_readers.append(new_reader)
+        return new_reader

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import wide_int
 from ..core.lod import LoDValue
 from ..core.proto import DataType
 from ..core.registry import register_op
@@ -46,10 +47,10 @@ def _beam_search(ctx, ins, attrs):
     ids_in = ins.get("ids", [None])[0]
     scores = data(ins["scores"][0])  # [N*B, K] accumulated
     if ids_in is not None:
-        ids = data(ids_in).astype(jnp.int64)  # [N*B, K]
+        ids = data(ids_in).astype(wide_int())  # [N*B, K]
     else:
         ids = jnp.broadcast_to(
-            jnp.arange(scores.shape[-1], dtype=jnp.int64)[None, :],
+            jnp.arange(scores.shape[-1], dtype=wide_int())[None, :],
             scores.shape,
         )
     beam_size = int(attrs["beam_size"])
@@ -74,7 +75,7 @@ def _beam_search(ctx, ins, attrs):
     parent_beam = top_pos // K  # [N, B] beam within batch
     parent_global = (
         parent_beam + (jnp.arange(N) * beam_size)[:, None]
-    ).astype(jnp.int64)
+    ).astype(wide_int())
 
     return {
         "selected_ids": [sel_ids.reshape(NB, 1)],

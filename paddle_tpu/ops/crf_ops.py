@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import wide_int
 from ..core.lod import LoDValue
 from ..core.proto import DataType
 from ..core.registry import register_op
@@ -157,7 +158,7 @@ def _crf_decoding(ctx, ins, attrs):
         (backptrs[::-1], jnp.moveaxis(mask[:, 1:], 1, 0)[::-1]),
     )
     path = jnp.concatenate([tag0[:, None], tags[::-1].T], axis=1)  # [N, T]
-    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    path = jnp.where(mask, path, 0).astype(wide_int())
 
     label = ins.get("Label", [None])[0]
     if label is not None:
@@ -165,7 +166,7 @@ def _crf_decoding(ctx, ins, attrs):
         lab, _ = _as_lod3(label)
         if lab.ndim == 3:
             lab = lab[..., 0]
-        path = (path == lab.astype(jnp.int64)).astype(jnp.int64) * mask
+        path = (path == lab.astype(wide_int())).astype(wide_int()) * mask
     return {"ViterbiPath": [LoDValue(path[..., None], l)]}
 
 
@@ -281,9 +282,9 @@ def _chunk_eval(ctx, ins, attrs):
         "Precision": [one(precision, jnp.float32)],
         "Recall": [one(recall, jnp.float32)],
         "F1-Score": [one(f1, jnp.float32)],
-        "NumInferChunks": [one(num_inf, jnp.int64)],
-        "NumLabelChunks": [one(num_lab, jnp.int64)],
-        "NumCorrectChunks": [one(num_correct, jnp.int64)],
+        "NumInferChunks": [one(num_inf, wide_int())],
+        "NumLabelChunks": [one(num_lab, wide_int())],
+        "NumCorrectChunks": [one(num_correct, wide_int())],
     }
 
 
@@ -394,7 +395,7 @@ def _ctc_align(ctx, ins, attrs):
     pos = jnp.cumsum(keep, axis=1) - 1
     out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
     rows = jnp.arange(N)[:, None].repeat(T, 1)
-    out = jnp.zeros((N, T), dtype=jnp.int64).at[
+    out = jnp.zeros((N, T), dtype=wide_int()).at[
         rows, jnp.clip(pos, 0, T - 1)
-    ].max(jnp.where(keep, x, 0).astype(jnp.int64))
+    ].max(jnp.where(keep, x, 0).astype(wide_int()))
     return {"Output": [LoDValue(out[..., None], out_len)]}

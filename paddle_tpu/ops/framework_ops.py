@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDValue
-from ..core.proto import DataType, dtype_to_numpy
+from ..core.proto import DataType, dtype_to_runtime
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRowsValue
 from ..core.tensor_array import TensorArrayValue
@@ -244,7 +244,7 @@ def _fake_init(ctx, ins, attrs):
     """Zero placeholder init for pserver-side tables (reference:
     fake_init_op.cc — allocates without initializing; here zeros)."""
     shape = [int(s) for s in attrs.get("shape", [1])]
-    dt = dtype_to_numpy(DataType(attrs.get("dtype", DataType.FP32)))
+    dt = dtype_to_runtime(DataType(attrs.get("dtype", DataType.FP32)))
     return {"Out": [jnp.zeros(shape, dtype=dt)]}
 
 

@@ -14,7 +14,7 @@ import numpy as np
 
 from ..core import amp
 from ..core.lod import LoDValue
-from ..core.proto import DataType, dtype_to_numpy
+from ..core.proto import DataType, dtype_to_runtime
 from ..core.registry import register_op
 from .common import data, in_desc, same_shape, set_output, wrap_lod
 
@@ -151,7 +151,7 @@ def _cast_infer(op, block):
 @register_op("cast", infer_shape=_cast_infer)
 def _cast(ctx, ins, attrs):
     x = ins["X"][0]
-    np_dtype = dtype_to_numpy(DataType(attrs["out_dtype"]))
+    np_dtype = dtype_to_runtime(DataType(attrs["out_dtype"]))
     return {"Out": [wrap_lod(x, data(x).astype(np_dtype))]}
 
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import wide_int
 from ..core.proto import DataType
 from ..core.registry import register_op
 from .common import data, in_desc, set_output, same_shape, wrap_lod
@@ -76,10 +77,10 @@ def _fill_infer(op, block):
 def _fill(ctx, ins, attrs):
     """Fill Out with the literal attr data (reference: operators/fill_op.cc
     — the value list arrives as fp32 and is cast to `dtype`)."""
-    from ..core.proto import dtype_to_numpy
+    from ..core.proto import dtype_to_runtime
 
     shape = [int(s) for s in attrs["shape"]]
-    dt = dtype_to_numpy(DataType(attrs.get("dtype", DataType.FP32)))
+    dt = dtype_to_runtime(DataType(attrs.get("dtype", DataType.FP32)))
     vals = np.asarray(attrs.get("value", []), dtype=np.float64)
     return {"Out": [jnp.asarray(vals.reshape(shape).astype(dt))]}
 
@@ -308,7 +309,16 @@ def _hash(ctx, ins, attrs):
     xv = data(x)
     num_hash = int(attrs.get("num_hash", 1))
     mod_by = int(attrs.get("mod_by", 1))
-    rows = xv.reshape(xv.shape[0], -1).astype(jnp.uint32)
+    flat = xv.reshape(xv.shape[0], -1)
+    if flat.dtype.itemsize == 8:
+        # 64-bit ids (x64 mode): mix both 32-bit halves so ids past 2**31
+        # differing only in high bits hash differently
+        u = flat.astype(jnp.uint64)
+        rows = jnp.concatenate(
+            [(u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+             (u >> jnp.uint64(32)).astype(jnp.uint32)], axis=1)
+    else:
+        rows = flat.astype(jnp.uint32)
 
     def mix64(h, v):
         h = (h ^ (v + jnp.uint32(0x9E3779B9))) * jnp.uint32(0x85EBCA6B)
@@ -323,6 +333,6 @@ def _hash(ctx, ins, attrs):
         for j in range(rows.shape[1]):
             h = mix64(h, rows[:, j])
         h = h ^ (h >> 16)
-        outs.append((h.astype(jnp.int64) % mod_by))
+        outs.append((h.astype(wide_int()) % mod_by))
     out = jnp.stack(outs, axis=1)[..., None]  # [N, num_hash, 1]
     return {"Out": [wrap_lod(x, out)]}

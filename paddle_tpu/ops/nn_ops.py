@@ -47,6 +47,8 @@ def _conv2d_infer(op, block):
 
 
 def _conv2d_lower(ctx, ins, attrs):
+    from ..flags import flag
+
     x = data(ins["Input"][0])
     f = data(ins["Filter"][0])
     strides = attrs.get("strides", [1, 1])
@@ -54,14 +56,30 @@ def _conv2d_lower(ctx, ins, attrs):
     dilations = attrs.get("dilations", [1, 1])
     groups = attrs.get("groups", 1) or 1
     xc, fc = amp.mxu_operands(x, f)
-    out = jax.lax.conv_general_dilated(
-        xc, fc,
-        window_strides=strides,
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups,
-    )
+    if flag("conv_layout") == "NHWC":
+        # TPU-preferred internal layout: compute in NHWC behind boundary
+        # transposes.  Between chained conv/BN/relu blocks XLA cancels the
+        # back-to-back transposes, so the network body runs NHWC end to
+        # end while the program-level contract stays NCHW.
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(xc, (0, 2, 3, 1)),
+            jnp.transpose(fc, (2, 3, 1, 0)),
+            window_strides=strides,
+            padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+            rhs_dilation=dilations,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+        )
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    else:
+        out = jax.lax.conv_general_dilated(
+            xc, fc,
+            window_strides=strides,
+            padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups,
+        )
     return {"Output": [amp.mxu_output(out, x, f)]}
 
 

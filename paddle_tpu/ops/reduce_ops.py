@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import wide_int
 from ..core import amp
 from ..core.proto import DataType
 from ..core.registry import register_op
@@ -142,4 +143,4 @@ def _top_k(ctx, ins, attrs):
     vals, idx = jax.lax.top_k(x, attrs.get("k", 1))
     # declared INT64; with jax x64 disabled this materializes as int32 and
     # the executor casts back to int64 at fetch time
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(wide_int())]}

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import wide_int
 from ..core.lod import LoDValue
 from ..core.proto import DataType
 from ..core.registry import register_op
@@ -226,7 +227,7 @@ def _sequence_pad(ctx, ins, attrs):
         d = jnp.pad(d, [(0, 0), (0, plen - d.shape[1])] + [(0, 0)] * (d.ndim - 2))
     m = _fmask(d, l).astype(bool)
     out = jnp.where(m, d, jnp.broadcast_to(jnp.reshape(pad_value, (1,) * d.ndim if jnp.ndim(pad_value) == 0 else jnp.shape(pad_value)), d.shape))
-    return {"Out": [out], "Length": [l.astype(jnp.int64)]}
+    return {"Out": [out], "Length": [l.astype(wide_int())]}
 
 
 def _seq_unpad_infer(op, block):
@@ -261,7 +262,7 @@ def _seq_mask_infer(op, block):
 def _sequence_mask(ctx, ins, attrs):
     """lengths -> [*, maxlen] 0/1 mask (reference:
     operators/sequence_ops/sequence_mask_op.cc)."""
-    from ..core.proto import dtype_to_numpy
+    from ..core.proto import dtype_to_runtime
 
     x = ins["X"][0]
     l = data(x)
@@ -276,7 +277,7 @@ def _sequence_mask(ctx, ins, attrs):
                 "sequence_mask with maxlen=-1 on a dense lengths tensor needs "
                 "a data-dependent shape; pass an explicit maxlen on TPU"
             )
-    dtype = dtype_to_numpy(DataType(attrs.get("out_dtype", int(DataType.INT64))))
+    dtype = dtype_to_runtime(DataType(attrs.get("out_dtype", int(DataType.INT64))))
     mask = (jnp.arange(maxlen) < l[..., None]).astype(dtype)
     return {"Y": [mask]}
 

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.lod import LoDValue
-from ..core.proto import DataType, dtype_to_numpy
+from ..core.proto import DataType, dtype_to_runtime
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRowsValue
 from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
@@ -29,7 +29,7 @@ def _fill_constant_infer(op, block):
 
 @register_op("fill_constant", infer_shape=_fill_constant_infer, no_grad=True)
 def _fill_constant(ctx, ins, attrs):
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     shape = [int(d) for d in attrs.get("shape", [1])]
     return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
 
@@ -65,7 +65,7 @@ def _fill_constant_batch_size_like(ctx, ins, attrs):
     x = data(ins["Input"][0])
     shape = [int(d) for d in attrs.get("shape", [1])]
     shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
 
 
@@ -91,7 +91,7 @@ def _assign_value(ctx, ins, attrs):
         or attrs.get("values")
         or []
     )
-    arr = jnp.asarray(np.asarray(vals, dtype=dtype_to_numpy(dtype)).reshape(attrs["shape"]))
+    arr = jnp.asarray(np.asarray(vals, dtype=dtype_to_runtime(dtype)).reshape(attrs["shape"]))
     return {"Out": [arr]}
 
 
@@ -105,7 +105,7 @@ def _random_infer(op, block):
 
 @register_op("uniform_random", infer_shape=_random_infer, no_grad=True, random=True)
 def _uniform_random(ctx, ins, attrs):
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     shape = [int(d) for d in attrs["shape"]]
     out = jax.random.uniform(
         ctx.rng(), shape, dtype=dtype,
@@ -119,7 +119,7 @@ def _uniform_random_bsl(ctx, ins, attrs):
     x = data(ins["Input"][0])
     shape = [int(d) for d in attrs["shape"]]
     shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     out = jax.random.uniform(
         ctx.rng(), shape, dtype=dtype,
         minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0),
@@ -129,7 +129,7 @@ def _uniform_random_bsl(ctx, ins, attrs):
 
 @register_op("gaussian_random", infer_shape=_random_infer, no_grad=True, random=True)
 def _gaussian_random(ctx, ins, attrs):
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     shape = [int(d) for d in attrs["shape"]]
     out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
         ctx.rng(), shape, dtype=dtype
@@ -139,7 +139,7 @@ def _gaussian_random(ctx, ins, attrs):
 
 @register_op("truncated_gaussian_random", infer_shape=_random_infer, no_grad=True, random=True)
 def _truncated_gaussian_random(ctx, ins, attrs):
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     shape = [int(d) for d in attrs["shape"]]
     out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
         ctx.rng(), -2.0, 2.0, shape, dtype=dtype
@@ -638,7 +638,11 @@ def _lookup_table_grad(ctx, ins, attrs):
     if ids.ndim >= 1 and ids.shape[-1] == 1:
         ids = jnp.squeeze(ids, axis=-1)
     height, dim = data(w_desc).shape
-    ids_flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    ids_flat = jnp.reshape(ids, (-1,))
+    if ids_flat.dtype.itemsize <= 4:
+        ids_flat = ids_flat.astype(jnp.int32)
+    # 64-bit ids (x64 mode) keep their width: the scatter target height
+    # may exceed 2**31 for hashed/CTR id spaces
     rows = jnp.reshape(og, (-1, dim))
     padding_idx = attrs.get("padding_idx", -1)
     if padding_idx is not None and padding_idx >= 0:
@@ -730,7 +734,7 @@ def _range(ctx, ins, attrs):
             ) from e
 
     start, end, step = bound("Start"), bound("End"), bound("Step")
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
 
 
@@ -765,7 +769,7 @@ def _gaussian_random_bsl(ctx, ins, attrs):
     x = data(ins["Input"][0])
     shape = [int(d) for d in attrs["shape"]]
     shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
-    dtype = dtype_to_numpy(DataType(attrs.get("dtype", int(DataType.FP32))))
+    dtype = dtype_to_runtime(DataType(attrs.get("dtype", int(DataType.FP32))))
     out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
         ctx.rng(), shape, dtype=dtype
     )

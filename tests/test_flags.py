@@ -83,3 +83,33 @@ def test_env_bootstrap(monkeypatch):
         monkeypatch.delenv("FLAGS_check_nan_inf")
         monkeypatch.delenv("FLAGS_paddle_num_threads")
         flagmod._bootstrap()
+
+
+def test_conv_layout_nhwc_parity():
+    """FLAGS_conv_layout=NHWC computes the same conv2d (internal layout
+    only; program contract stays NCHW)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = np.random.RandomState(0).randn(2, 3, 16, 16).astype("float32")
+
+    outs = {}
+    for layout in ("NCHW", "NHWC"):
+        fluid.set_flags({"FLAGS_conv_layout": layout})
+        try:
+            fluid.reset_default_env()
+            img = layers.data("img", [3, 16, 16])
+            y = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                              groups=1,
+                              param_attr=fluid.ParamAttr(
+                                  name=f"w_{layout}",
+                                  initializer=fluid.initializer.Constant(0.1)))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            out, = exe.run(feed={"img": x}, fetch_list=[y])
+            outs[layout] = np.asarray(out)
+        finally:
+            fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
+    np.testing.assert_allclose(outs["NCHW"], outs["NHWC"],
+                               rtol=1e-5, atol=1e-5)

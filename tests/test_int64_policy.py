@@ -1,0 +1,127 @@
+"""The honest int64 contract (core/dtypes.py).
+
+The reference's default integer dtype is int64 (lookup_table ids at
+operators/lookup_table_op.cc:80, labels everywhere).  paddle_tpu narrows
+INT64 descs to int32 on device by default (TPU-native) behind a checked
+feed boundary, and honors true int64 end-to-end under enable_x64 — never
+jax's silent warn-and-truncate."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _embedding_program(vocab, dim):
+    ids = layers.data("ids", [1], dtype="int64", lod_level=0)
+    emb = layers.embedding(ids, size=[vocab, dim],
+                           param_attr=fluid.ParamAttr(name="i64_emb"))
+    return ids, emb
+
+
+def test_int64_feed_in_range_is_silent_and_correct():
+    _, emb = _embedding_program(100, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    table = np.asarray(fluid.global_scope().find_var("i64_emb"))
+    ids = np.array([[3], [77], [0]], dtype=np.int64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any truncation warning fails
+        out, = exe.run(feed={"ids": ids}, fetch_list=[emb])
+    np.testing.assert_allclose(np.asarray(out), table[ids.reshape(-1)],
+                               rtol=1e-6)
+
+
+def test_int64_feed_out_of_range_raises():
+    _, emb = _embedding_program(100, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    big = np.array([[2 ** 31 + 5]], dtype=np.int64)
+    with pytest.raises(OverflowError, match="ids.*enable_x64"):
+        exe.run(feed={"ids": big}, fetch_list=[emb])
+
+
+def test_int64_fetch_restores_declared_dtype():
+    x = layers.data("x", [8], dtype="float32")
+    idx = layers.argmax(x, axis=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(feed={"x": np.random.rand(2, 8).astype("float32")},
+                   fetch_list=[idx])
+    assert np.asarray(out).dtype == np.int64
+
+
+def test_x64_lookup_and_hash_past_2_31():
+    """Under enable_x64, ids past 2**31 flow through hash -> lookup_table
+    and land on the correct rows (VERDICT r2 done-criterion)."""
+    with fluid.x64_scope(True):
+        fluid.reset_default_env()
+        vocab = 50
+        ids = layers.data("ids", [1], dtype="int64")
+        # hash folds the 64-bit id space into [0, vocab)
+        hashed = layers.hash(ids, hash_size=vocab)
+        emb = layers.embedding(hashed, size=[vocab, 3],
+                               param_attr=fluid.ParamAttr(name="x64_emb"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        table = np.asarray(fluid.global_scope().find_var("x64_emb"))
+
+        big = np.array([[2 ** 31 + 12345], [2 ** 40 + 7], [3]],
+                       dtype=np.int64)
+        h, out = exe.run(feed={"ids": big}, fetch_list=[hashed, emb])
+        h = np.asarray(h).reshape(-1)
+        assert h.dtype == np.int64
+        assert ((0 <= h) & (h < vocab)).all()
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(3, 3), table[h], rtol=1e-6)
+        # high bits matter: two ids differing only in the high 32 bits
+        # must mix differently (the hash folds both halves)
+        a = np.array([[5]], dtype=np.int64)
+        b = np.array([[5 + 2 ** 32]], dtype=np.int64)
+        ha, = exe.run(feed={"ids": a}, fetch_list=[hashed])
+        hb, = exe.run(feed={"ids": b}, fetch_list=[hashed])
+        assert int(np.asarray(ha).reshape(())) != int(
+            np.asarray(hb).reshape(()))
+
+
+def test_x64_sgd_training_step_still_converges():
+    """x64 mode must not break the float path (stays fp32 per desc)."""
+    with fluid.x64_scope(True):
+        fluid.reset_default_env()
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 1).astype("float32")
+        first = last = None
+        for _ in range(20):
+            xb = rng.randn(8, 4).astype("float32")
+            lv, = exe.run(feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+            lv = float(np.asarray(lv).reshape(()))
+            first = lv if first is None else first
+            last = lv
+        assert last < first
+
+
+def test_training_step_emits_no_truncation_warnings():
+    """An int64-label classification step runs warning-free (the r2
+    dryrun/suite tail was full of jax truncation warnings)."""
+    fluid.reset_default_env()
+    img = layers.data("img", [16], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    logits = layers.fc(img, size=5)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        exe.run(feed={"img": np.random.rand(4, 16).astype("float32"),
+                      "label": np.array([[0], [1], [2], [3]], np.int64)},
+                fetch_list=[loss])

@@ -1,0 +1,173 @@
+"""Per-op sweep: the last two metric ops (reference:
+operators/positive_negative_pair_op.h,
+operators/metrics/precision_recall_op.h).  Numpy references below are
+written independently from the reference kernels' documented semantics."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _pnp_ref(score, label, query, weight=None, column=-1,
+             acc=(0.0, 0.0, 0.0)):
+    n, width = score.shape
+    col = column if column >= 0 else column + width
+    s = score[:, col]
+    lab = label.reshape(-1)
+    q = query.reshape(-1)
+    w = np.ones(n) if weight is None else weight.reshape(-1)
+    pos, neg, neu = acc
+    for i in range(n):
+        for j in range(i + 1, n):
+            if q[i] != q[j] or lab[i] == lab[j]:
+                continue
+            pw = 0.5 * (w[i] + w[j])
+            if s[i] == s[j]:
+                neu += pw
+            if (s[i] - s[j]) * (lab[i] - lab[j]) > 0:
+                pos += pw
+            else:
+                neg += pw  # equal scores fall here too, like the reference
+    return (np.array([pos], "float32"), np.array([neg], "float32"),
+            np.array([neu], "float32"))
+
+
+def test_positive_negative_pair():
+    r = np.random.RandomState(7)
+    n = 12
+    score = r.uniform(0, 1, (n, 1)).astype("float32")
+    label = r.randint(0, 3, (n, 1)).astype("float32")
+    query = np.array([k // 4 for k in range(n)], dtype="int64").reshape(n, 1)
+    # a few deliberate score ties inside one query group
+    score[1] = score[2]
+    pos, neg, neu = _pnp_ref(score, label, query)
+
+    class T(OpTest):
+        op_type = "positive_negative_pair"
+
+    t = T()
+    t.inputs = {"Score": score, "Label": label, "QueryID": query}
+    t.outputs = {"PositivePair": pos, "NegativePair": neg,
+                 "NeutralPair": neu}
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_positive_negative_pair_weighted_accumulated():
+    r = np.random.RandomState(8)
+    n = 10
+    score = r.uniform(0, 1, (n, 3)).astype("float32")
+    label = r.randint(0, 2, (n, 1)).astype("float32")
+    query = r.randint(0, 3, (n, 1)).astype("int64")
+    weight = r.uniform(0.5, 1.5, (n, 1)).astype("float32")
+    acc = (2.0, 1.0, 0.5)
+    pos, neg, neu = _pnp_ref(score, label, query, weight, column=1, acc=acc)
+
+    class T(OpTest):
+        op_type = "positive_negative_pair"
+
+    t = T()
+    t.inputs = {"Score": score, "Label": label, "QueryID": query,
+                "Weight": weight,
+                "AccumulatePositivePair": np.array([acc[0]], "float32"),
+                "AccumulateNegativePair": np.array([acc[1]], "float32"),
+                "AccumulateNeutralPair": np.array([acc[2]], "float32")}
+    t.attrs = {"column": 1}
+    t.outputs = {"PositivePair": pos, "NegativePair": neg,
+                 "NeutralPair": neu}
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def _pr_states(idx, label, weight, cls):
+    states = np.zeros((cls, 4), "float64")  # TP FP TN FN
+    for i in range(idx.shape[0]):
+        c, l, w = int(idx[i, 0]), int(label[i, 0]), float(weight[i, 0])
+        if c == l:
+            states[c, 0] += w
+            states[:, 2] += w
+            states[c, 2] -= w
+        else:
+            states[l, 3] += w
+            states[c, 1] += w
+            states[:, 2] += w
+            states[c, 2] -= w
+            states[l, 2] -= w
+    return states
+
+
+def _pr_metrics(states):
+    def ratio(a, b):
+        return a / (a + b) if (a > 0 or b > 0) else 1.0
+
+    def f1(p, r):
+        return 2 * p * r / (p + r) if (p > 0 or r > 0) else 0.0
+
+    prec = [ratio(s[0], s[1]) for s in states]
+    rec = [ratio(s[0], s[3]) for s in states]
+    mp, mr = np.mean(prec), np.mean(rec)
+    tp, fp, fn = states[:, 0].sum(), states[:, 1].sum(), states[:, 3].sum()
+    up, ur = ratio(tp, fp), ratio(tp, fn)
+    return np.array([mp, mr, f1(mp, mr), up, ur, f1(up, ur)], "float32")
+
+
+def test_precision_recall():
+    r = np.random.RandomState(9)
+    n, cls = 20, 4
+    idx = r.randint(0, cls, (n, 1)).astype("int32")
+    label = r.randint(0, cls, (n, 1)).astype("int32")
+    weight = r.uniform(0.2, 1.8, (n, 1)).astype("float32")
+    states = _pr_states(idx, label, weight, cls)
+
+    class T(OpTest):
+        op_type = "precision_recall"
+
+    t = T()
+    t.inputs = {"Indices": idx, "Labels": label, "Weights": weight}
+    t.attrs = {"class_number": cls}
+    t.outputs = {"BatchMetrics": _pr_metrics(states),
+                 "AccumMetrics": _pr_metrics(states),
+                 "AccumStatesInfo": states.astype("float32")}
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_precision_recall_accumulating():
+    r = np.random.RandomState(10)
+    n, cls = 15, 3
+    idx = r.randint(0, cls, (n, 1)).astype("int32")
+    label = r.randint(0, cls, (n, 1)).astype("int32")
+    weight = np.ones((n, 1), "float32")
+    prev = r.uniform(0, 5, (cls, 4)).astype("float32")
+    batch = _pr_states(idx, label, weight, cls)
+    accum = batch + prev.astype("float64")
+
+    class T(OpTest):
+        op_type = "precision_recall"
+
+    t = T()
+    t.inputs = {"Indices": idx, "Labels": label, "Weights": weight,
+                "StatesInfo": prev}
+    t.attrs = {"class_number": cls}
+    t.outputs = {"BatchMetrics": _pr_metrics(batch),
+                 "AccumMetrics": _pr_metrics(accum),
+                 "AccumStatesInfo": accum.astype("float32")}
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_precision_recall_empty_class_defaults():
+    """A class with no samples keeps the reference's precision=recall=1
+    convention (affects the macro average)."""
+    idx = np.array([[0], [0], [1]], "int32")
+    label = np.array([[0], [1], [1]], "int32")
+    weight = np.ones((3, 1), "float32")
+    cls = 3  # class 2 never appears
+    states = _pr_states(idx, label, weight, cls)
+
+    class T(OpTest):
+        op_type = "precision_recall"
+
+    t = T()
+    t.inputs = {"Indices": idx, "Labels": label, "Weights": weight}
+    t.attrs = {"class_number": cls}
+    t.outputs = {"BatchMetrics": _pr_metrics(states),
+                 "AccumMetrics": _pr_metrics(states),
+                 "AccumStatesInfo": states.astype("float32")}
+    t.check_output(atol=1e-5, rtol=1e-5)
