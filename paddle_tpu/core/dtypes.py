@@ -93,13 +93,17 @@ def checked_feed_cast(arr: np.ndarray, want, name: str = "?") -> np.ndarray:
     want = np.dtype(want)
     rt = runtime_np_dtype(want)
     if rt != want and np.issubdtype(want, np.integer) and arr.size:
+        # bound by the NARROWED dtype's own range (uint64 feeds narrow to
+        # uint32, whose range is not int32's)
+        info = np.iinfo(rt)
         lo, hi = int(arr.min()), int(arr.max())
-        if lo < _I32_MIN or hi > _I32_MAX:
+        if lo < info.min or hi > info.max:
             raise OverflowError(
-                f"feed '{name}': int64 value out of int32 range "
-                f"(min={lo}, max={hi}); the runtime narrows INT64 to int32 "
+                f"feed '{name}': {want.name} value out of {rt.name} range "
+                f"(min={lo}, max={hi}); the runtime narrows 64-bit ints "
                 "unless x64 is enabled — call "
-                "paddle_tpu.enable_x64() for ids/labels past 2**31"
+                "paddle_tpu.enable_x64() for ids/labels past the 32-bit "
+                "range"
             )
     if arr.dtype != rt:
         arr = arr.astype(rt)

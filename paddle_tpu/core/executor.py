@@ -293,8 +293,18 @@ class Executor:
             return val.to_numpy() if return_numpy else val
         if isinstance(val, LoDValue):
             if return_numpy:
+                d = np.asarray(val.data)
+                # restore the declared dtype (int64 descs materialize as
+                # int32 on device under the default width policy)
+                if var_desc is not None:
+                    want = dtype_to_numpy(var_desc.dtype)
+                    try:
+                        if np.dtype(want) != d.dtype:
+                            d = d.astype(want)
+                    except TypeError:
+                        pass
                 return LoDValue(
-                    np.asarray(val.data), np.asarray(val.lengths),
+                    d, np.asarray(val.lengths),
                     tuple(np.asarray(sl) for sl in val.sub_lengths),
                 )
             return val

@@ -164,15 +164,31 @@ def serve_ps(core, host: str = "127.0.0.1", port: int = 0) -> PSServer:
 
 
 class _Conn:
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, timeout: float = 600.0):
         host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = socket.create_connection(
+            self._addr, timeout=timeout)
         self._lock = threading.Lock()
 
     def call(self, header: dict, arrays=None) -> Tuple[dict, dict]:
         with self._lock:
-            _send_msg(self._sock, header, arrays)
-            resp, resp_arrays = _recv_msg(self._sock)
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+            try:
+                _send_msg(self._sock, header, arrays)
+                resp, resp_arrays = _recv_msg(self._sock)
+            except BaseException:
+                # any failure between send and recv leaves the stream
+                # desynced (the old reply could satisfy the NEXT call) —
+                # drop the connection so the next call starts clean
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
         if not resp.get("ok"):
             raise RuntimeError(f"PS server error: {resp.get('error')}")
         return resp, resp_arrays

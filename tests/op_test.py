@@ -26,7 +26,11 @@ class OpTest:
 
     # ------------------------------------------------------------------
     def _norm_value(self, v):
-        """Accept np arrays, (array, lod) tuples, or lists of sequences."""
+        """Accept np arrays, (array, lod) tuples, or lists of sequences.
+        None means "declared but unchecked" (matches the reference's
+        no_check_set)."""
+        if v is None:
+            return None
         if isinstance(v, tuple) and len(v) == 2:  # (flat_data, [lengths])
             return create_lod_tensor(v[0], [v[1]])
         return np.asarray(v)

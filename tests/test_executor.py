@@ -57,7 +57,7 @@ def test_sgd_training_step_decreases_loss():
     losses = []
     for _ in range(30):
         (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
-        losses.append(float(lv))
+        losses.append(float(np.ravel(lv)[0]))
     assert losses[-1] < losses[0] * 0.3, losses[:3] + losses[-3:]
 
 

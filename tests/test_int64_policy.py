@@ -125,3 +125,29 @@ def test_training_step_emits_no_truncation_warnings():
         exe.run(feed={"img": np.random.rand(4, 16).astype("float32"),
                       "label": np.array([[0], [1], [2], [3]], np.int64)},
                 fetch_list=[loss])
+
+
+def test_lod_fetch_restores_declared_dtype():
+    """LoD-carrying outputs also restore the declared INT64 at fetch
+    (e.g. crf_decoding's ViterbiPath materializes int32 on device)."""
+    from paddle_tpu.core.lod import create_lod_tensor
+
+    fluid.reset_default_env()
+    x = layers.data("x", [1], dtype="int64", lod_level=1)
+    out = layers.sequence_reverse(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = create_lod_tensor(np.array([[1], [2], [3]], np.int64), [[2, 1]])
+    (res,) = exe.run(feed={"x": feed}, fetch_list=[out],
+                     return_numpy=True)
+    assert np.asarray(res.data).dtype == np.int64
+
+
+def test_uint64_feed_uses_uint32_bounds():
+    """A uint64 feed narrows to uint32: values in [2**31, 2**32) pass."""
+    from paddle_tpu.core.dtypes import checked_feed_cast
+
+    ok = checked_feed_cast(np.array([3_000_000_000], np.uint64),
+                           np.uint64, "slot")
+    assert ok.dtype == np.uint32 and int(ok[0]) == 3_000_000_000
+    with pytest.raises(OverflowError, match="uint32"):
+        checked_feed_cast(np.array([2 ** 33], np.uint64), np.uint64, "slot")

@@ -1,0 +1,411 @@
+"""Per-op numeric sweep, round 3: the remaining untested tail — detection
+(anchor_generator, density_prior_box, box_clip, target_assign,
+mine_hard_examples, roi_pool, affine_grid), conv3d, auc, nce, the
+sequence_slice/scatter/expand_as/unpad window ops, and statistical checks
+for the random generators.  All numpy references written independently
+from the reference kernels' documented semantics."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _rand(shape, seed, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype(
+        "float32")
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    class T(OpTest):
+        pass
+
+    T.op_type = op_type
+    t = T()
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+# ---------------------------------------------------------------------------
+# anchor_generator (detection/anchor_generator_op.h)
+# ---------------------------------------------------------------------------
+def test_anchor_generator():
+    H, W = 3, 4
+    x = _rand((1, 8, H, W), seed=1)
+    sizes, ratios = [32.0, 64.0], [0.5, 1.0]
+    stride, offset = [16.0, 16.0], 0.5
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            w = np.sqrt(s * s / r)
+            whs.append((w, w * r))
+    want = np.zeros((H, W, len(whs), 4), "float32")
+    for j in range(H):
+        for i in range(W):
+            cx, cy = (i + offset) * stride[0], (j + offset) * stride[1]
+            for p, (bw, bh) in enumerate(whs):
+                want[j, i, p] = [cx - bw / 2, cy - bh / 2,
+                                 cx + bw / 2, cy + bh / 2]
+    var = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], "float32"),
+                  (H, W, len(whs), 1))
+    t = _t("anchor_generator", {"Input": x},
+           {"Anchors": want, "Variances": var},
+           {"anchor_sizes": sizes, "aspect_ratios": ratios,
+            "stride": stride, "offset": offset,
+            "variances": [0.1, 0.1, 0.2, 0.2]})
+    t.check_output(atol=1e-4, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# density_prior_box (detection/density_prior_box_op.h)
+# ---------------------------------------------------------------------------
+def test_density_prior_box():
+    H, W, IH, IW = 2, 2, 32, 32
+    x = _rand((1, 4, H, W), seed=2)
+    img = _rand((1, 3, IH, IW), seed=3)
+    fixed_sizes, densities = [8.0], [2]
+    fixed_ratios = [1.0]
+    step = IW / W
+    boxes = []
+    for j in range(H):
+        for i in range(W):
+            cx, cy = (i + 0.5) * step, (j + 0.5) * step
+            for size, density in zip(fixed_sizes, densities):
+                for ratio in fixed_ratios:
+                    bw, bh = size * np.sqrt(ratio), size / np.sqrt(ratio)
+                    shift = size / density
+                    for dy in range(density):
+                        for dx in range(density):
+                            ccx = cx - size / 2 + shift / 2 + dx * shift
+                            ccy = cy - size / 2 + shift / 2 + dy * shift
+                            boxes.append([
+                                (ccx - bw / 2) / IW, (ccy - bh / 2) / IH,
+                                (ccx + bw / 2) / IW, (ccy + bh / 2) / IH])
+    P = len(boxes) // (H * W)
+    want = np.clip(np.asarray(boxes, "float32").reshape(H, W, P, 4), 0, 1)
+    var = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], "float32"), (H, W, P, 1))
+    t = _t("density_prior_box", {"Input": x, "Image": img},
+           {"Boxes": want, "Variances": var},
+           {"fixed_sizes": fixed_sizes, "fixed_ratios": fixed_ratios,
+            "densities": densities, "clip": True,
+            "variances": [0.1, 0.1, 0.2, 0.2]})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# box_clip (detection/box_clip_op.h): clip to [0, im-1]
+# ---------------------------------------------------------------------------
+def test_box_clip():
+    boxes = np.array([[[-5.0, 2.0, 40.0, 50.0], [1.0, -3.0, 10.0, 12.0]]],
+                     "float32")  # [1, 2, 4]
+    im_info = np.array([[20.0, 30.0, 1.0]], "float32")  # h=20, w=30
+    want = np.array([[[0.0, 2.0, 29.0, 19.0], [1.0, 0.0, 10.0, 12.0]]],
+                    "float32")
+    t = _t("box_clip", {"Input": boxes, "ImInfo": im_info},
+           {"Output": want})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# target_assign (detection/target_assign_op.h)
+# ---------------------------------------------------------------------------
+def test_target_assign():
+    # per-image gt rows, padded [N=2, M=3, K=4]
+    x = _rand((2, 3, 4), seed=4)
+    mi = np.array([[0, -1, 2, 1], [1, 1, -1, 0]], "int32")  # [N, P=4]
+    want = np.zeros((2, 4, 4), "float32")
+    wt = np.zeros((2, 4, 1), "float32")
+    for n in range(2):
+        for p in range(4):
+            if mi[n, p] >= 0:
+                want[n, p] = x[n, mi[n, p]]
+                wt[n, p, 0] = 1.0
+    t = _t("target_assign", {"X": x, "MatchIndices": mi},
+           {"Out": want, "OutWeight": wt}, {"mismatch_value": 0})
+    t.check_output(atol=1e-6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples (detection/mine_hard_examples_op.cc, max_negative)
+# ---------------------------------------------------------------------------
+def test_mine_hard_examples():
+    cls_loss = np.array([[0.9, 0.1, 0.8, 0.3, 0.5]], "float32")
+    match = np.array([[2, -1, -1, -1, -1]], "int32")  # 1 positive
+    # neg_pos_ratio=3 -> keep 3 hardest negatives: losses 0.8, 0.5, 0.3
+    want_mask = np.array([[0, 0, 1, 1, 1]], "float32")[..., None]
+    want_match = np.array([[2, -1, -1, -1, -1]], "int32")
+    t = _t("mine_hard_examples",
+           {"ClsLoss": cls_loss, "MatchIndices": match},
+           {"NegMask": want_mask, "UpdatedMatchIndices": want_match},
+           {"neg_pos_ratio": 3.0, "mining_type": "max_negative"})
+    t.check_output(atol=1e-6, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# roi_pool (roi_pool_op.h) — integer-aligned RoI so the sample grid hits
+# every cell and max matches the exact bin walk
+# ---------------------------------------------------------------------------
+def test_roi_pool_aligned():
+    H = W = 8
+    feat = np.arange(H * W, dtype="float32").reshape(1, 1, H, W)
+    rois = (np.array([[0.0, 0.0, 3.0, 3.0]], "float32"), [1])  # LoD rois
+    # roi 0..3 inclusive -> 4x4 region, pooled 2x2 -> bins of 2x2 px
+    region = feat[0, 0, :4, :4]
+    want = np.array([[[[region[:2, :2].max(), region[:2, 2:].max()],
+                       [region[2:, :2].max(), region[2:, 2:].max()]]]],
+                    "float32")
+    t = _t("roi_pool", {"X": feat, "ROIs": rois}, {"Out": want},
+           {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# affine_grid (affine_grid_op.h): theta [N,2,3] -> sampling grid [N,H,W,2]
+# ---------------------------------------------------------------------------
+def test_affine_grid_identity():
+    N, H, W = 1, 3, 4
+    theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], "float32")
+    xs = np.linspace(-1, 1, W)
+    ys = np.linspace(-1, 1, H)
+    want = np.zeros((N, H, W, 2), "float32")
+    for j in range(H):
+        for i in range(W):
+            want[0, j, i] = [xs[i], ys[j]]
+    t = _t("affine_grid", {"Theta": theta}, {"Output": want},
+           {"output_shape": [N, 1, H, W]})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# conv3d: direct numpy loop reference
+# ---------------------------------------------------------------------------
+def test_conv3d_numeric():
+    x = _rand((1, 2, 4, 4, 4), seed=6)
+    f = _rand((3, 2, 2, 2, 2), seed=7)
+    xd, fd = x.astype("float64"), f.astype("float64")
+    want = np.zeros((1, 3, 3, 3, 3))
+    for oc in range(3):
+        for d in range(3):
+            for i in range(3):
+                for j in range(3):
+                    want[0, oc, d, i, j] = np.sum(
+                        xd[0, :, d:d + 2, i:i + 2, j:j + 2] * fd[oc])
+    t = _t("conv3d", {"Input": x, "Filter": f},
+           {"Output": want.astype("float32")},
+           {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1]})
+    t.check_output(atol=2e-5, rtol=2e-5)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+# ---------------------------------------------------------------------------
+# auc op (metrics/auc_op.cc): histogram AUC vs exact rank AUC
+# ---------------------------------------------------------------------------
+def test_auc_op_numeric():
+    r = np.random.RandomState(8)
+    n = 200
+    scores = r.uniform(0, 1, n).astype("float32")
+    labels = (scores + r.normal(0, 0.3, n) > 0.5).astype("int64")
+    preds = np.stack([1 - scores, scores], axis=1).astype("float32")
+    buckets = 4095
+    stat = np.zeros(buckets + 1, "int64")
+
+    # exact AUC over the histogram discretization
+    pos_h = np.zeros(buckets + 1)
+    neg_h = np.zeros(buckets + 1)
+    for s, l in zip(scores, labels):
+        b = min(int(s * buckets), buckets)
+        (pos_h if l else neg_h)[b] += 1
+    pos_cum = np.cumsum(pos_h[::-1])
+    neg_cum = np.cumsum(neg_h[::-1])
+    tpr = pos_cum / max(pos_cum[-1], 1)
+    fpr = neg_cum / max(neg_cum[-1], 1)
+    want_auc = np.trapezoid(tpr, fpr)
+
+    t = _t("auc",
+           {"Predict": preds, "Label": labels.reshape(-1, 1),
+            "StatPos": stat, "StatNeg": stat.copy()},
+           {"AUC": np.array([want_auc], "float32"),
+            "StatPosOut": None, "StatNegOut": None},
+           {"num_thresholds": buckets})
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# nce (nce_op.cc): recompute the cost from the op's OWN sampled labels
+# ---------------------------------------------------------------------------
+def test_nce_consistent_with_samples():
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    n, d, v, k = 4, 6, 20, 5
+    x = layers.data("x", [d])
+    lbl = layers.data("lbl", [1], dtype="int64")
+    cost = layers.nce(input=x, label=lbl, num_total_classes=v,
+                      num_neg_samples=k,
+                      param_attr=fluid.ParamAttr(name="nce_w"),
+                      bias_attr=fluid.ParamAttr(name="nce_b"))
+    prog = fluid.default_main_program()
+    op = [o for o in prog.global_block().ops if o.type == "nce"][0]
+    logits_name = op.output("SampleLogits")[0]
+    samples_name = op.output("SampleLabels")[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = _rand((n, d), seed=9)
+    lv = np.random.RandomState(10).randint(0, v, (n, 1)).astype("int64")
+    c, lg, smp = exe.run(feed={"x": xv, "lbl": lv},
+                         fetch_list=[cost, logits_name, samples_name])
+    w = np.asarray(fluid.global_scope().find_var("nce_w"))
+    b = np.asarray(fluid.global_scope().find_var("nce_b")).reshape(-1)
+    smp = np.asarray(smp)
+    want_logits = np.einsum("nd,ntd->nt", xv, w[smp]) + b[smp]
+    np.testing.assert_allclose(np.asarray(lg), want_logits, rtol=1e-4,
+                               atol=1e-4)
+    p = 1 / (1 + np.exp(-(want_logits - np.log(k / v))))
+    lab01 = np.concatenate([np.ones((n, 1)), np.zeros((n, k))], axis=1)
+    want_cost = -(lab01 * np.log(p + 1e-12)
+                  + (1 - lab01) * np.log(1 - p + 1e-12)).sum(
+        axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(c), want_cost, rtol=1e-4,
+                               atol=1e-4)
+    assert smp.shape == (n, 1 + k) and (smp[:, 0:1] == lv).all()
+
+
+# ---------------------------------------------------------------------------
+# sequence window tail: slice / scatter / expand_as / unpad
+# ---------------------------------------------------------------------------
+def test_sequence_slice_numeric():
+    from paddle_tpu import layers
+    from paddle_tpu.core.lod import create_lod_tensor
+
+    fluid.reset_default_env()
+    seqs = [np.arange(10, dtype="float32").reshape(5, 2),
+            np.arange(100, 108, dtype="float32").reshape(4, 2)]
+    x = layers.data("x", [2], dtype="float32", lod_level=1)
+    off = layers.data("off", [1], dtype="int64")
+    length = layers.data("length", [1], dtype="int64")
+    out = layers.sequence_slice(x, off, length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(
+        feed={"x": create_lod_tensor(np.concatenate(seqs), [[5, 4]]),
+              "off": np.array([[1], [2]], "int64"),
+              "length": np.array([[3], [2]], "int64")},
+        fetch_list=[out], return_numpy=False)
+    np.testing.assert_allclose(np.asarray(res.data)[0, :3], seqs[0][1:4],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.data)[1, :2], seqs[1][2:4],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [3, 2])
+
+
+def test_sequence_scatter_numeric():
+    x = np.zeros((2, 6), "float32")
+    ids = (np.array([[1], [4], [0], [5]], "int64"), [2, 2])
+    upd = (np.array([2.0, 3.0, 5.0, 7.0], "float32"), [2, 2])
+    want = np.zeros((2, 6), "float32")
+    want[0, 1], want[0, 4] = 2.0, 3.0
+    want[1, 0], want[1, 5] = 5.0, 7.0
+    t = _t("sequence_scatter", {"X": x, "Ids": ids, "Updates": upd},
+           {"Out": want})
+    t.check_output(atol=1e-6, rtol=1e-6)
+
+
+def test_sequence_expand_as_numeric():
+    from paddle_tpu import layers
+    from paddle_tpu.core.lod import create_lod_tensor
+
+    fluid.reset_default_env()
+    x = layers.data("x", [3], dtype="float32")
+    y = layers.data("y", [1], dtype="float32", lod_level=1)
+    out = layers.sequence_expand_as(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = _rand((2, 3), seed=11)
+    yv = create_lod_tensor(np.zeros((5, 1), "float32"), [[3, 2]])
+    (res,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[out],
+                     return_numpy=False)
+    # row i of x repeats len(y_i) times
+    np.testing.assert_allclose(np.asarray(res.data)[0, :3],
+                               np.tile(xv[0], (3, 1)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.data)[1, :2],
+                               np.tile(xv[1], (2, 1)), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [3, 2])
+
+
+def test_sequence_unpad_numeric():
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 3], dtype="float32", append_batch_size=False)
+    length = layers.data("len", [1], dtype="int64")
+    out = layers.sequence_unpad(x, length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = _rand((2, 4, 3), seed=12)
+    lv = np.array([[3], [2]], "int64")
+    (res,) = exe.run(feed={"x": xv, "len": lv}, fetch_list=[out],
+                     return_numpy=False)
+    np.testing.assert_allclose(np.asarray(res.data)[0, :3], xv[0, :3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.data)[1, :2], xv[1, :2],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [3, 2])
+
+
+# ---------------------------------------------------------------------------
+# random generators: statistical bounds
+# ---------------------------------------------------------------------------
+def test_truncated_gaussian_random_stats():
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    v = fluid.default_main_program().global_block().create_var(
+        name="tg", shape=[4000], dtype="float32")
+    fluid.default_main_program().global_block().append_op(
+        type="truncated_gaussian_random", inputs={},
+        outputs={"Out": ["tg"]},
+        attrs={"shape": [4000], "mean": 0.0, "std": 1.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(feed={}, fetch_list=["tg"])
+    out = np.asarray(out)
+    assert np.abs(out).max() <= 2.0 + 1e-5  # truncation at 2 std
+    assert abs(out.mean()) < 0.1
+    assert 0.5 < out.std() < 1.0  # truncated normal std ~ 0.88
+
+
+def test_batch_size_like_randoms():
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    ref = layers.data("ref", [7], dtype="float32")
+    block = fluid.default_main_program().global_block()
+    for name, op, attrs in (
+        ("u", "uniform_random_batch_size_like",
+         {"shape": [-1, 5], "min": -1.0, "max": 1.0}),
+        ("g", "gaussian_random_batch_size_like",
+         {"shape": [-1, 5], "mean": 0.0, "std": 1.0}),
+    ):
+        block.create_var(name=name, shape=[-1, 5], dtype="float32")
+        block.append_op(type=op, inputs={"Input": [ref.name]},
+                        outputs={"Out": [name]}, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    u, g = exe.run(feed={"ref": np.zeros((6, 7), "float32")},
+                   fetch_list=["u", "g"])
+    assert np.shape(u) == (6, 5) and np.shape(g) == (6, 5)
+    assert (np.asarray(u) >= -1).all() and (np.asarray(u) <= 1).all()
+    assert np.asarray(g).std() > 0.3
+
+
+def test_sampling_id_distribution():
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    probs = layers.data("p", [4], dtype="float32")
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="sid", shape=[-1], dtype="int64")
+    block.append_op(type="sampling_id", inputs={"X": [probs.name]},
+                    outputs={"Out": ["sid"]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    p = np.tile(np.array([[0.0, 0.0, 1.0, 0.0]], "float32"), (32, 1))
+    (out,) = exe.run(feed={"p": p}, fetch_list=["sid"])
+    assert (np.asarray(out).reshape(-1) == 2).all()  # degenerate dist

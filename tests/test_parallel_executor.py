@@ -47,7 +47,7 @@ def test_parallel_matches_serial():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     serial_losses = [
-        float(exe.run(feed={"x": x, "label": y}, fetch_list=[loss])[0])
+        float(np.ravel(exe.run(feed={"x": x, "label": y}, fetch_list=[loss])[0])[0])
         for _ in range(5)
     ]
     serial_scope = fluid.global_scope()
@@ -65,7 +65,7 @@ def test_parallel_matches_serial():
     exe2.run(fluid.default_startup_program())
     pe = fluid.ParallelExecutor(loss_name=loss2.name, mesh=make_mesh({"dp": 8}))
     par_losses = [
-        float(pe.run(fetch_list=[loss2], feed={"x": x, "label": y})[0])
+        float(np.ravel(pe.run(fetch_list=[loss2], feed={"x": x, "label": y})[0])[0])
         for _ in range(5)
     ]
     w_par = np.asarray(fluid.global_scope().find_var("w1"))
@@ -98,7 +98,7 @@ def test_tensor_parallel_sharded_param():
     exe.run(fluid.default_startup_program())
     pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=make_mesh({"dp": 2, "tp": 4}))
     losses = [
-        float(pe.run(fetch_list=[loss], feed={"x": x, "label": y})[0])
+        float(np.ravel(pe.run(fetch_list=[loss], feed={"x": x, "label": y})[0])[0])
         for _ in range(3)
     ]
     assert losses[-1] < losses[0]

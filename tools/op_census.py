@@ -25,12 +25,24 @@ MACRO_ARTIFACTS = {"op_name", "op_type"}
 
 
 def reference_op_names():
-    out = subprocess.run(
+    import os
+
+    if not os.path.isdir(REFERENCE_OPS_DIR):
+        raise SystemExit(
+            f"reference tree not found at {REFERENCE_OPS_DIR} — the census "
+            "cannot produce a meaningful diff (refusing a vacuous pass)")
+    proc = subprocess.run(
         ["grep", "-rhoE", r"REGISTER_OPERATOR\(\s*[a-z0-9_]+",
          REFERENCE_OPS_DIR],
         capture_output=True, text=True,
-    ).stdout
-    return {line.split("(")[-1].strip() for line in out.splitlines()}
+    )
+    names = {line.split("(")[-1].strip()
+             for line in proc.stdout.splitlines()}
+    if proc.returncode != 0 or not names:
+        raise SystemExit(
+            f"grep over {REFERENCE_OPS_DIR} failed (rc={proc.returncode}) "
+            "or matched nothing — refusing a vacuous pass")
+    return names
 
 
 def main():
