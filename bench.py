@@ -380,8 +380,11 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     names = os.environ.get(
         "BENCH_MODELS", "resnet50,transformer,deepfm"
-    ).split(",")
-    names = [m.strip() for m in names if m.strip()]
+    )
+    if names.strip() == "all":  # every wired baseline row
+        names = ("resnet50,transformer,deepfm,lstm,lenet,alexnet,"
+                 "googlenet,vgg19,vgg19_infer,vgg19_infer_int8")
+    names = [m.strip() for m in names.split(",") if m.strip()]
     if not names:
         raise SystemExit("BENCH_MODELS is empty")
 
@@ -389,9 +392,11 @@ def main() -> None:
     layout = os.environ.get("BENCH_LAYOUT", "NCHW")
     # default ON: the r2 verdict's open question (does the keep-tier AMP /
     # NHWC layout win on-chip?) answers itself in every bench run, with
-    # all probes recorded in the artifact.  BENCH_TUNE=0 + BENCH_AMP /
-    # BENCH_LAYOUT pin a single config.
-    tune = os.environ.get("BENCH_TUNE", "1") == "1"
+    # all probes recorded in the artifact.  An explicit BENCH_AMP /
+    # BENCH_LAYOUT pins that config instead (no silent override);
+    # BENCH_TUNE=1/0 always wins when set.
+    pinned = "BENCH_AMP" in os.environ or "BENCH_LAYOUT" in os.environ
+    tune = os.environ.get("BENCH_TUNE", "0" if pinned else "1") == "1"
     try:
         results = [
             _tune_and_run(m, steps, peak_flops) if tune

@@ -286,36 +286,35 @@ class Executor:
         return plan.convert_fetches(fetches, block0, return_numpy)
 
     @staticmethod
+    def _restore_declared_dtype(arr: np.ndarray, var_desc) -> np.ndarray:
+        """Fetches come back in the runtime width (int64 descs materialize
+        as int32 under the default policy); restore the declared numpy
+        dtype at the host boundary."""
+        if var_desc is None:
+            return arr
+        want = dtype_to_numpy(var_desc.dtype)
+        try:
+            if np.dtype(want) != arr.dtype:
+                arr = arr.astype(want)
+        except TypeError:
+            pass
+        return arr
+
+    @staticmethod
     def _convert_fetch(val, var_desc, return_numpy: bool):
         from .selected_rows import SelectedRowsValue
 
+        restore = Executor._restore_declared_dtype
         if isinstance(val, SelectedRowsValue):
             return val.to_numpy() if return_numpy else val
         if isinstance(val, LoDValue):
             if return_numpy:
-                d = np.asarray(val.data)
-                # restore the declared dtype (int64 descs materialize as
-                # int32 on device under the default width policy)
-                if var_desc is not None:
-                    want = dtype_to_numpy(var_desc.dtype)
-                    try:
-                        if np.dtype(want) != d.dtype:
-                            d = d.astype(want)
-                    except TypeError:
-                        pass
                 return LoDValue(
-                    d, np.asarray(val.lengths),
+                    restore(np.asarray(val.data), var_desc),
+                    np.asarray(val.lengths),
                     tuple(np.asarray(sl) for sl in val.sub_lengths),
                 )
             return val
         if not return_numpy:
             return val
-        arr = np.asarray(val)
-        if var_desc is not None:
-            want = dtype_to_numpy(var_desc.dtype)
-            try:
-                if np.dtype(want) != arr.dtype:
-                    arr = arr.astype(want)
-            except TypeError:
-                pass
-        return arr
+        return restore(np.asarray(val), var_desc)

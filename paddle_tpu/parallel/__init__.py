@@ -11,3 +11,5 @@ from .mesh import DeviceMesh, make_mesh, default_mesh, mesh_guard  # noqa: F401
 from .strategy import BuildStrategy, ExecutionStrategy, ShardingStrategy  # noqa: F401
 from .executor import ParallelExecutor, CompiledProgram  # noqa: F401
 from .env import init_distributed, trainer_id, num_trainers  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import switch_moe  # noqa: F401

@@ -1,0 +1,102 @@
+"""GPipe-style pipeline parallelism over a named `pp` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.6: absent in 2018);
+this is the TPU-native design the scaling literature prescribes: identical
+stages hold their layer slice (stacked params sharded on `pp` dim 0),
+micro-batches stream through the stages, and activations hop stage->stage
+over ICI via `lax.ppermute` inside one `shard_map`-compiled program — no
+host scheduler, no RPC, one XLA computation for the whole schedule.
+
+The schedule is the classic GPipe fill-drain loop: with S stages and M
+micro-batches the loop runs M + S - 1 ticks; stage 0 injects micro-batch t
+at tick t, stage s processes what stage s-1 produced last tick, and the
+last stage emits finished micro-batches from tick S-1 on.  Bubble fraction
+(S-1)/(M+S-1) — callers pick M >> S for efficiency, exactly as in GPipe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches,
+    mesh,
+    pp_axis: str = "pp",
+):
+    """Run `y = stage_{S-1}(... stage_0(x))` for every micro-batch, with
+    stages laid out over the `pp_axis` of `mesh`.
+
+    stage_fn(params, x) -> y       same shape in and out (a layer block)
+    stage_params: pytree whose leaves have leading dim S (one slice per
+        stage) — sharded onto the pp axis, so each device holds only its
+        stage's parameters
+    x_microbatches: array [M, ...] of micro-batches (replicated across pp;
+        other mesh axes may shard the trailing dims through the caller's
+        own in_shardings)
+    returns [M, ...] outputs, replicated across pp.
+    """
+    jmesh = mesh.mesh if hasattr(mesh, "mesh") else mesh
+    S = jmesh.shape[pp_axis]
+    M = x_microbatches.shape[0]
+    ticks = M + S - 1
+
+    def per_stage(params, xs):
+        # params: leaves [1, ...] (this stage's slice); xs: [M, ...] local
+        stage = jax.lax.axis_index(pp_axis)
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        mb_shape = xs.shape[1:]
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # stage 0 injects micro-batch t (zeros once the input drains)
+            inject = jnp.where(
+                t < M, xs[jnp.minimum(t, M - 1)], jnp.zeros(mb_shape, xs.dtype)
+            )
+            x_in = jnp.where(stage == 0, inject, incoming)
+            y = stage_fn(local, x_in)
+            # the last stage finishes micro-batch t - (S - 1) at tick t
+            done_idx = t - (S - 1)
+            outputs = jnp.where(
+                (stage == S - 1) & (done_idx >= 0),
+                outputs.at[jnp.maximum(done_idx, 0)].set(y),
+                outputs,
+            )
+            # hand the activation to the next stage (ring; stage S-1's
+            # send wraps to stage 0, which ignores it)
+            incoming = jax.lax.ppermute(
+                y, pp_axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (incoming, outputs), None
+
+        outputs0 = jnp.zeros((M,) + mb_shape, xs.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (jnp.zeros(mb_shape, xs.dtype), outputs0),
+            jnp.arange(ticks),
+        )
+        # every device returns [M, ...]; only stage S-1's copy is real —
+        # psum over pp broadcasts it (other stages contribute zeros)
+        outputs = jnp.where(stage == S - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, pp_axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(pp_axis, *([None] * (p.ndim - 1))), stage_params
+    )
+    x_spec = P(*([None] * x_microbatches.ndim))
+
+    fn = shard_map(
+        per_stage, mesh=jmesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return fn(stage_params, x_microbatches)

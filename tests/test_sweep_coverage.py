@@ -221,16 +221,68 @@ EXERCISED_VIA = {
 
 # ops whose direct numeric coverage lives under a spelling the scanner
 # can't see, with the file that covers them
+# patterns that indicate a REAL harness invocation (no catch-all
+# quoted-string pattern: {"shape": ...} attrs would otherwise "cover" the
+# shape op and make this gate vacuous)
 _DIRECT_PATTERNS = (
-    r'op_type\s*=\s*[\'"]([a-z0-9_]+)[\'"]',
-    r'_t\(\s*[\'"]([a-z0-9_]+)[\'"]',
-    r'_run\(\s*[\'"]([a-z0-9_]+)[\'"]',
-    r'^\s{4}[\'"]([a-z0-9_]+)[\'"]\s*:\s*\(',
-    r'type\s*=\s*[\'"]([a-z0-9_]+)[\'"]',
-    r'[\'"]([a-z0-9_]+)[\'"]',  # any quoted op name in a test = harness use
-    r'layers\.([a-z0-9_]+)\(',
+    r'op_type\s*=\s*[\'"]([a-z0-9_]+)[\'"]',      # OpTest subclasses
+    r'_t\(\s*[\'"]([a-z0-9_]+)[\'"]',             # _t("op", ...) helper
+    r'_run\(\s*[\'"]([a-z0-9_]+)[\'"]',           # _run("op", ...)
+    r'_run_op\(\s*[\'"]([a-z0-9_]+)[\'"]',        # _run_op("op", ...)
+    r'_case\(\s*[\'"]([a-z0-9_]+)[\'"]',          # _case("op", ...)
+    r'^\s{4}[\'"]([a-z0-9_]+)[\'"]\s*:\s*\(',     # CASES dict keys
+    r'type\s*=\s*[\'"]([a-z0-9_]+)[\'"]',         # block.append_op(type=)
+    r'layers\.([a-z0-9_]+)\(',                    # public layer calls
     r'\._([a-z0-9_]+)\(',  # direct-lowering calls, e.g. F._merge_selected_rows
 )
+
+# registered op -> the public surface whose harness tests it under another
+# spelling (each verified manually; the layer emits the op on its program)
+ALIASED_COVERAGE = {
+    "lookup_table": "layers.embedding",
+    "arg_max": "layers.argmax",
+    "arg_min": "layers.argmin",
+    "equal": "layers.less_than-family comparisons (test_op_harness)",
+    "greater_equal": "comparison sweep",
+    "less_equal": "comparison sweep",
+    "not_equal": "comparison sweep",
+    "logical_and": "logical sweep (test_metrics/test_op_harness)",
+    "logical_or": "logical sweep",
+    "logical_xor": "logical sweep",
+    "conv2d_int8": "tests/test_inference_quant.py freeze path",
+    "mul_int8": "tests/test_inference_quant.py freeze path",
+    "detection_map": "tests/test_proposal_ops.py _run_op",
+    "generate_proposals": "tests/test_proposal_ops.py _run_op",
+    "generate_proposal_labels": "tests/test_proposal_ops.py _run_op",
+    "rpn_target_assign": "tests/test_proposal_ops.py _run_op",
+    "psroi_pool": "tests/test_proposal_ops.py _run_op",
+    "roi_perspective_transform": "tests/test_proposal_ops.py _run_op",
+    "polygon_box_transform": "tests/test_proposal_ops.py _run_op",
+    "lookup_sparse_table": "tests/test_framework_ops.py",
+    "expand": "tests/test_op_sweep_tensor.py _case",
+    "flatten": "tensor sweep",
+    "fill_zeros_like": "tensor sweep",
+    "fill_constant_batch_size_like": "model tests (transformer decode)",
+    "gaussian_random_batch_size_like": "tests/test_op_sweep_tail2.py",
+    "uniform_random_batch_size_like": "tests/test_op_sweep_tail2.py",
+    "multiplex": "tensor sweep",
+    "one_hot": "tensor sweep",
+    "pad": "tensor sweep",
+    "pad2d": "tensor sweep",
+    "pad_constant_like": "tensor sweep",
+    "range": "tensor sweep",
+    "reduce_all": "reduce sweep",
+    "reduce_any": "reduce sweep",
+    "reverse": "tensor sweep",
+    "scatter": "tensor sweep",
+    "shape": "tensor sweep",
+    "slice": "tensor sweep",
+    "split": "tensor sweep",
+    "squeeze": "tensor sweep",
+    "stack": "tensor sweep",
+    "unsqueeze": "tensor sweep",
+    "unstack": "tensor sweep",
+}
 
 
 def _scanned_coverage():
@@ -250,7 +302,8 @@ def test_every_op_covered_or_mapped():
 
     nond = {m for m in OpRegistry._ops if not m.endswith("_grad")}
     covered = _scanned_coverage()
-    missing = sorted(nond - covered - set(EXERCISED_VIA))
+    missing = sorted(nond - covered - set(EXERCISED_VIA)
+                     - set(ALIASED_COVERAGE))
     assert missing == [], (
         f"ops with neither a test-harness mention nor an EXERCISED_VIA "
         f"mapping: {missing}")
