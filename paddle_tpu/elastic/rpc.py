@@ -1,0 +1,180 @@
+"""Cross-process transport for the elastic master.
+
+The reference's Go master serves trainers over net/rpc with etcd state
+(go/master/service.go:89; trainers call GetTask/TaskFinished/TaskFailed
+remotely).  This is the same plane for `elastic.MasterService`: a
+line-delimited JSON protocol over TCP (tasks are plain id/chunks/epoch
+records — no arrays, no pickle), with master-side exceptions re-raised by
+name on the client so worker code is identical in- and cross-process.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from .master import (
+    AllTasksFailedError,
+    NoMoreAvailableError,
+    PassAfterError,
+    PassBeforeError,
+    Task,
+)
+
+__all__ = ["MasterServer", "RemoteMaster", "serve_master"]
+
+_ERRORS = {
+    "PassBeforeError": PassBeforeError,
+    "PassAfterError": PassAfterError,
+    "NoMoreAvailableError": NoMoreAvailableError,
+    "AllTasksFailedError": AllTasksFailedError,
+}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        svc = self.server.master_service
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line.decode())
+                cmd = req.get("cmd")
+                if cmd == "get_task":
+                    t = svc.get_task(int(req["pass_id"]))
+                    resp = {"ok": True, "task": {
+                        "id": t.id, "chunks": list(t.chunks),
+                        "epoch": t.epoch}}
+                elif cmd == "task_finished":
+                    svc.task_finished(int(req["task_id"]))
+                    resp = {"ok": True}
+                elif cmd == "task_failed":
+                    svc.task_failed(int(req["task_id"]), int(req["epoch"]))
+                    resp = {"ok": True}
+                elif cmd == "heartbeat":
+                    svc.heartbeat(str(req["worker_id"]))
+                    resp = {"ok": True}
+                elif cmd == "set_dataset":
+                    svc.set_dataset(list(req["globs"]))
+                    resp = {"ok": True}
+                elif cmd == "counts":
+                    resp = {"ok": True, "counts": svc.counts()}
+                elif cmd == "config":
+                    resp = {"ok": True,
+                            "failure_max": svc.failure_max,
+                            "chunks_per_task": svc.chunks_per_task}
+                elif cmd == "dead_workers":
+                    resp = {"ok": True, "workers": svc.dead_workers(
+                        float(req["max_silence"]))}
+                elif cmd == "shutdown":
+                    resp = {"ok": True}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True).start()
+                    return
+                else:
+                    resp = {"ok": False, "error": "ValueError",
+                            "message": f"unknown cmd {cmd!r}"}
+            except tuple(_ERRORS.values()) as e:
+                resp = {"ok": False, "error": type(e).__name__,
+                        "message": str(e)}
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                resp = {"ok": False, "error": "RuntimeError",
+                        "message": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+
+
+class MasterServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.master_service = service
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self.server_address
+        return f"{h}:{p}"
+
+
+def serve_master(service, host: str = "127.0.0.1", port: int = 0):
+    srv = MasterServer(service, host, port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class RemoteMaster:
+    """Client-side MasterService facade — same methods, same exceptions."""
+
+    def __init__(self, endpoint: str, timeout: float = 120.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                self._rfile = self._sock.makefile("rb")
+            try:
+                self._sock.sendall((json.dumps(req) + "\n").encode())
+                line = self._rfile.readline()
+                if not line:
+                    raise ConnectionError("master closed the connection")
+            except BaseException:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                    self._rfile = None
+                raise
+        resp = json.loads(line.decode())
+        if not resp.get("ok"):
+            exc = _ERRORS.get(resp.get("error"), RuntimeError)
+            raise exc(resp.get("message", ""))
+        return resp
+
+    def set_dataset(self, globs) -> None:
+        self._call({"cmd": "set_dataset", "globs": list(globs)})
+
+    def get_task(self, pass_id: int) -> Task:
+        t = self._call({"cmd": "get_task", "pass_id": pass_id})["task"]
+        return Task(t["id"], list(t["chunks"]), t["epoch"])
+
+    def task_finished(self, task_id: int) -> None:
+        self._call({"cmd": "task_finished", "task_id": task_id})
+
+    def task_failed(self, task_id: int, epoch: int) -> None:
+        self._call({"cmd": "task_failed", "task_id": task_id,
+                    "epoch": epoch})
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._call({"cmd": "heartbeat", "worker_id": worker_id})
+
+    def dead_workers(self, max_silence: float):
+        return self._call({"cmd": "dead_workers",
+                           "max_silence": max_silence})["workers"]
+
+    def counts(self) -> dict:
+        return self._call({"cmd": "counts"})["counts"]
+
+    @property
+    def failure_max(self) -> int:
+        # ElasticTrainer reads master.failure_max for its give-up message
+        if not hasattr(self, "_failure_max"):
+            self._failure_max = int(
+                self._call({"cmd": "config"})["failure_max"])
+        return self._failure_max
+
+    def shutdown_server(self) -> None:
+        self._call({"cmd": "shutdown"})

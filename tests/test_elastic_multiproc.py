@@ -1,0 +1,132 @@
+"""Cross-process elastic master: the task queue serves REAL worker
+subprocesses over the elastic.rpc transport; one worker crashes mid-task
+and the master's lease timeout re-queues its work (reference:
+go/master/service.go timeout/failure re-queue :313-341, exercised by the
+Go tests through a real RPC client)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SERVER = '''
+import sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.elastic.master import InMemStore, MasterService
+from paddle_tpu.elastic.rpc import serve_master
+
+port = int(sys.argv[1])
+globs = sys.argv[2]
+svc = MasterService(InMemStore(), chunks_per_task=1, timeout_dur=2.0,
+                    failure_max=3)
+svc.set_dataset([globs])
+srv = serve_master(svc, port=port)
+print("SERVING", srv.endpoint, flush=True)
+while True:
+    time.sleep(0.2)
+'''
+
+_WORKER = '''
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.elastic.master import (NoMoreAvailableError,
+    PassBeforeError)
+from paddle_tpu.elastic.rpc import RemoteMaster
+
+endpoint, out_path, crash_after = sys.argv[1], sys.argv[2], int(sys.argv[3])
+m = RemoteMaster(endpoint)
+done = []
+n = 0
+while True:
+    try:
+        task = m.get_task(0)
+    except NoMoreAvailableError:
+        # pass still draining (another worker's lease may yet expire and
+        # re-queue) — wait and retry, like ElasticTrainer does
+        time.sleep(0.3)
+        continue
+    except PassBeforeError:
+        break  # the pass rolled over: nothing left for us
+    n += 1
+    if crash_after and n >= crash_after:
+        # simulate a crash: exit WITHOUT reporting; the lease must expire
+        print("CRASHING with task", task.id, flush=True)
+        os._exit(17)
+    m.heartbeat(out_path)
+    time.sleep(0.1)  # "process" the chunk
+    done.append(sorted(task.chunks))
+    m.task_finished(task.id)
+open(out_path, "w").write(json.dumps(done))
+print("WORKER DONE", len(done), flush=True)
+'''
+
+
+def test_elastic_master_cross_process_crash_requeue(tmp_path):
+    # 6 one-chunk tasks
+    for i in range(6):
+        (tmp_path / f"chunk-{i}.dat").write_text("x")
+    server_py = str(tmp_path / "server.py")
+    worker_py = str(tmp_path / "worker.py")
+    open(server_py, "w").write(_SERVER.format(repo=REPO))
+    open(worker_py, "w").write(_WORKER.format(repo=REPO))
+
+    env = {**os.environ}
+    server = subprocess.Popen(
+        [sys.executable, server_py, "0",
+         str(tmp_path / "chunk-*.dat")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        line = server.stdout.readline()
+        assert "SERVING" in line, line
+        endpoint = line.split()[1]
+
+        # worker A crashes after leasing its 2nd task; worker B survives
+        out_a = str(tmp_path / "a.json")
+        out_b = str(tmp_path / "b.json")
+        wa = subprocess.Popen(
+            [sys.executable, worker_py, endpoint, out_a, "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        oa, _ = wa.communicate(timeout=120)
+        assert wa.returncode == 17 and "CRASHING" in oa, oa
+
+        wb = subprocess.Popen(
+            [sys.executable, worker_py, endpoint, out_b, "0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        ob, _ = wb.communicate(timeout=120)
+        assert wb.returncode == 0, ob
+
+        done_b = json.loads(open(out_b).read())
+        # every chunk processed exactly once across the pass, INCLUDING
+        # the crashed worker's re-queued lease (worker A finished 1
+        # before crashing with the 2nd)
+        all_chunks = sorted(c for t in done_b for c in t)
+        assert len(done_b) == 5, (len(done_b), done_b)
+        crashed = [c for c in map(str, tmp_path.glob("chunk-*.dat"))
+                   if c not in all_chunks]
+        assert len(crashed) == 1  # only worker A's FIRST (finished) task
+    finally:
+        server.kill()
+        server.wait()
+
+
+def test_remote_master_exposes_failure_max():
+    """ElasticTrainer reads master.failure_max for its give-up message —
+    the RPC proxy must expose it too."""
+    from paddle_tpu.elastic.master import InMemStore, MasterService
+    from paddle_tpu.elastic.rpc import RemoteMaster, serve_master
+
+    svc = MasterService(InMemStore(), failure_max=7)
+    srv = serve_master(svc, port=0)
+    try:
+        m = RemoteMaster(srv.endpoint)
+        assert m.failure_max == 7
+    finally:
+        srv.shutdown()
