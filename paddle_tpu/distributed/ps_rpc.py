@@ -130,9 +130,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply = ({"ok": False, "error": str(e)}, None)
             _send_msg(self.request, reply[0], reply[1])
             if stop:
-                threading.Thread(
-                    target=self.server.shutdown, daemon=True
-                ).start()
+                def _stop(srv=self.server):
+                    srv.shutdown()
+                    srv.server_close()  # release the listening fd
+
+                threading.Thread(target=_stop, daemon=True).start()
                 return
 
 

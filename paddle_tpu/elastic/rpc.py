@@ -74,8 +74,12 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = {"ok": True}
                     self.wfile.write(
                         (json.dumps(resp) + "\n").encode())
-                    threading.Thread(
-                        target=self.server.shutdown, daemon=True).start()
+
+                    def _stop(srv=self.server):
+                        srv.shutdown()
+                        srv.server_close()  # release the listening fd
+
+                    threading.Thread(target=_stop, daemon=True).start()
                     return
                 else:
                     resp = {"ok": False, "error": "ValueError",
@@ -178,3 +182,13 @@ class RemoteMaster:
 
     def shutdown_server(self) -> None:
         self._call({"cmd": "shutdown"})
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                    self._rfile = None

@@ -22,6 +22,7 @@ __all__ = [
     "broadcast_y",
     "broadcast_out_shape",
     "normalize_axis",
+    "lod_padded_axis",
     "ACTS",
 ]
 
@@ -118,6 +119,17 @@ def wrap_lod(template, value):
     if isinstance(template, LoDValue):
         return LoDValue(value, template.lengths, template.sub_lengths)
     return value
+
+
+def lod_padded_axis(axis: int, lod_level: int, padded_ndim: int) -> int:
+    """Map a desc-level axis — addressed over the reference's UNPADDED
+    [sum(T), F...] layout — onto the padded [N, T1..Tlod, F...] layout.
+
+    Desc rank = padded_ndim - lod_level; axis 0 is the row axis, every
+    feature axis (>= 1) shifts right past the lod_level time dims."""
+    desc_rank = padded_ndim - lod_level
+    norm = axis + desc_rank if axis < 0 else axis
+    return norm + lod_level if norm >= 1 else norm
 
 
 def normalize_axis(axis: int, rank: int) -> int:

@@ -16,7 +16,8 @@ from ..core.lod import LoDValue
 from ..core.proto import DataType, dtype_to_runtime
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRowsValue
-from .common import data, in_desc, lengths, same_shape, set_output, wrap_lod
+from .common import (data, in_desc, lengths, lod_padded_axis, same_shape,
+                     set_output, wrap_lod)
 
 
 # -- fills -------------------------------------------------------------------
@@ -327,7 +328,9 @@ def _concat_infer(op, block):
             break
         tot += d
     shape[axis] = tot
-    set_output(block, op, "Out", shape, xs[0].dtype)
+    # a feature-axis concat of sequence inputs stays a sequence
+    lod = xs[0].lod_level if axis >= 1 else 0
+    set_output(block, op, "Out", shape, xs[0].dtype, lod_level=lod)
 
 
 @register_op("concat", infer_shape=_concat_infer)
@@ -335,13 +338,19 @@ def _concat(ctx, ins, attrs):
     vals = [v for v in ins["X"] if v is not None]
     xs = [data(v) for v in vals]
     axis = attrs.get("axis", 0)
+    lod_in = next((v for v in vals if isinstance(v, LoDValue)), None)
+    if lod_in is not None:
+        # the desc-level axis addresses the reference's unpadded
+        # [sum(T), F...] layout; feature axes shift right past the time
+        # dims on padded data (lod_padded_axis handles N-level nesting)
+        level = 1 + len(lod_in.sub_lengths)
+        p_axis = lod_padded_axis(axis, level, xs[0].ndim)
+        out = jnp.concatenate(xs, axis=p_axis)
+        if p_axis >= 1:
+            return {"Out": [LoDValue(out, lod_in.lengths,
+                                     lod_in.sub_lengths)]}
+        return {"Out": [out]}
     out = jnp.concatenate(xs, axis=axis)
-    # feature-axis concat of sequence inputs keeps the LoD view
-    norm_axis = axis + xs[0].ndim if axis < 0 else axis
-    if norm_axis >= 2:
-        for v in vals:
-            if isinstance(v, LoDValue):
-                return {"Out": [LoDValue(out, v.lengths)]}
     return {"Out": [out]}
 
 
@@ -355,25 +364,35 @@ def _split_infer(op, block):
     num = op.attr("num", 0)
     sections = op.attr("sections", [])
     outs = op.output("Out")
+    # feature-axis splits of a sequence stay sequences (see _concat_infer)
+    lod = x.lod_level if axis >= 1 else 0
     for i in range(len(outs)):
         shape = list(x.shape)
         if sections:
             shape[axis] = sections[i]
         elif num:
             shape[axis] = x.shape[axis] // num if x.shape[axis] >= 0 else -1
-        set_output(block, op, "Out", shape, x.dtype, idx=i)
+        set_output(block, op, "Out", shape, x.dtype, idx=i, lod_level=lod)
 
 
 @register_op("split", infer_shape=_split_infer)
 def _split(ctx, ins, attrs):
-    x = data(ins["X"][0])
+    xv = ins["X"][0]
+    x = data(xv)
     axis = attrs.get("axis", 0)
+    lod = isinstance(xv, LoDValue)
+    if lod:
+        # same desc-axis -> padded-axis remap as _concat
+        level = 1 + len(xv.sub_lengths)
+        axis = lod_padded_axis(axis, level, x.ndim)
     sections = attrs.get("sections", [])
     if sections:
         idx = np.cumsum(sections)[:-1].tolist()
         outs = jnp.split(x, idx, axis=axis)
     else:
         outs = jnp.split(x, attrs.get("num", 1), axis=axis)
+    if lod and axis >= 1:
+        outs = [LoDValue(o, xv.lengths, xv.sub_lengths) for o in outs]
     return {"Out": list(outs)}
 
 

@@ -220,3 +220,72 @@ def test_sequence_ops_have_gradients():
     for _ in range(5):
         v = exe.run(feed={"x": lod_feed(seqs)}, fetch_list=[loss])[0]
     assert float(np.ravel(v)[0]) != pytest.approx(float(np.ravel(v0)[0]))
+
+
+def test_concat_split_feature_axis_on_lod():
+    """concat/split with axis=1 on LoD inputs address the reference's
+    unpadded [sum(T), F] layout — the FEATURE axis, not padded time
+    (reference: concat_op with LoD inputs; the bi-LSTM encoder pattern in
+    book/test_rnn_encoder_decoder.py)."""
+    seqs_a = [np.random.RandomState(1).rand(3, 4).astype("float32"),
+              np.random.RandomState(2).rand(2, 4).astype("float32")]
+    seqs_b = [np.random.RandomState(3).rand(3, 6).astype("float32"),
+              np.random.RandomState(4).rand(2, 6).astype("float32")]
+    a = fluid.layers.data("ca", [4], dtype="float32", lod_level=1)
+    b = fluid.layers.data("cb", [6], dtype="float32", lod_level=1)
+    cat = fluid.layers.concat([a, b], axis=1)
+    assert cat.lod_level == 1 and cat.shape[-1] == 10
+    back_a, back_b = fluid.layers.split(cat, [4, 6], dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"ca": create_lod_tensor(np.concatenate(seqs_a), [[3, 2]]),
+            "cb": create_lod_tensor(np.concatenate(seqs_b), [[3, 2]])}
+    c, ra, rb = exe.run(feed=feed, fetch_list=[cat, back_a, back_b],
+                        return_numpy=False)
+    for i, (sa, sb) in enumerate(zip(seqs_a, seqs_b)):
+        np.testing.assert_allclose(
+            np.asarray(c.data)[i, : len(sa)],
+            np.concatenate([sa, sb], axis=1), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ra.data)[i, : len(sa)], sa,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(rb.data)[i, : len(sb)], sb,
+                                   rtol=1e-6)
+
+
+def test_concat_feature_axis_two_level_lod():
+    """N-level LoD: desc axis 1 is still the FEATURE axis for a 2-level
+    sequence padded to [N, L1, L2, F] (lod_padded_axis handles nesting);
+    sub_lengths survive the round trip."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import OpRegistry
+
+    lower = OpRegistry._ops["concat"].lower
+    d = jnp.arange(2 * 3 * 2 * 4, dtype=jnp.float32).reshape(2, 3, 2, 4)
+    two_level = LoDValue(d, jnp.asarray([3, 2]),
+                         (jnp.asarray([[2, 1, 2], [1, 2, 0]]),))
+    out = lower(None, {"X": [two_level, two_level]}, {"axis": 1})["Out"][0]
+    assert isinstance(out, LoDValue)
+    assert out.data.shape == (2, 3, 2, 8)  # feature axis doubled
+    assert len(out.sub_lengths) == 1  # nesting preserved
+
+    split = OpRegistry._ops["split"].lower
+    parts = split(None, {"X": [out]}, {"axis": 1, "num": 2})["Out"]
+    assert all(isinstance(p, LoDValue) and p.data.shape == (2, 3, 2, 4)
+               for p in parts)
+    np.testing.assert_allclose(np.asarray(parts[0].data), np.asarray(d))
+
+
+def test_split_negative_axis_on_lod_uses_desc_rank():
+    """split(dim=-1) on a LoD input addresses the unpadded layout's last
+    (feature) axis, not the padded array's."""
+    x = fluid.layers.data("nsx", [6], dtype="float32", lod_level=1)
+    a, b = fluid.layers.split(x, 2, dim=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.random.RandomState(7).rand(3, 6).astype("float32")]
+    ra, rb = exe.run(
+        feed={"nsx": create_lod_tensor(seqs[0], [[3]])},
+        fetch_list=[a, b], return_numpy=False)
+    np.testing.assert_allclose(np.asarray(ra.data)[0, :3], seqs[0][:, :3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rb.data)[0, :3], seqs[0][:, 3:],
+                               rtol=1e-6)
