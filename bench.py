@@ -332,9 +332,19 @@ def _tune_and_run(model: str, steps: int, peak_flops: float) -> dict:
     if model in CONV_MODELS:
         combos += [("1", "NHWC"), ("keep", "NHWC")]
     probe_steps = int(os.environ.get("BENCH_TUNE_STEPS", "5"))
+    # wall-clock budget for probing (each probe pays a fresh compile);
+    # when exceeded, remaining combos are skipped and the best-so-far
+    # runs — the first combo is the default config, so a tight budget
+    # degrades to the untuned behavior, never to a dead artifact
+    budget = float(os.environ.get("BENCH_TUNE_BUDGET_S", "600"))
+    t0 = time.perf_counter()
     probes = {}
     best, best_v = combos[0], -1.0
     for amp, layout in combos:
+        if probes and time.perf_counter() - t0 > budget:
+            probes["(budget_exhausted)"] = round(
+                time.perf_counter() - t0, 1)
+            break
         r = run_model(model, probe_steps, peak_flops, amp=amp, layout=layout)
         probes[f"amp={amp},layout={layout}"] = r["value"]
         if r["value"] > best_v:

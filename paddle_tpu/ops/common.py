@@ -23,6 +23,8 @@ __all__ = [
     "broadcast_out_shape",
     "normalize_axis",
     "lod_padded_axis",
+    "time_mask",
+    "feature_mask",
     "ACTS",
 ]
 
@@ -119,6 +121,18 @@ def wrap_lod(template, value):
     if isinstance(template, LoDValue):
         return LoDValue(value, template.lengths, template.sub_lengths)
     return value
+
+
+def time_mask(d, lengths):
+    """[N, T] bool validity mask for 1-level padded sequence data."""
+    lens = jnp.asarray(lengths).reshape(-1)
+    return jnp.arange(d.shape[1])[None, :] < lens[:, None]
+
+
+def feature_mask(d, lengths):
+    """time_mask broadcast over the feature dims of d."""
+    m = time_mask(d, lengths)
+    return m.reshape(m.shape + (1,) * (d.ndim - 2))
 
 
 def lod_padded_axis(axis: int, lod_level: int, padded_ndim: int) -> int:

@@ -41,20 +41,81 @@ def _reduce_infer_factory():
     return infer
 
 
+def _mask_fill(name, dtype):
+    """Identity element for masked reductions, in the input's dtype."""
+    if name in ("reduce_sum", "reduce_mean"):
+        return jnp.zeros((), dtype)
+    if name == "reduce_prod":
+        return jnp.ones((), dtype)
+    if name == "reduce_all":
+        return jnp.asarray(True)
+    if name == "reduce_any":
+        return jnp.asarray(False)
+    # max/min: dtype-aware extremes (inf cannot cast to integers)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.min if name == "reduce_max" else info.max,
+                           dtype)
+    return jnp.asarray(-jnp.inf if name == "reduce_max" else jnp.inf,
+                       dtype)
+
+
 def _make_reduce(name, fn, accumulates=False):
     @register_op(name, infer_shape=_reduce_infer_factory())
-    def _lower(ctx, ins, attrs, _fn=fn):
-        x = data(ins["X"][0])
+    def _lower(ctx, ins, attrs, _fn=fn, _name=name):
+        from ..core.lod import LoDValue
+        from .common import feature_mask, lod_padded_axis, wrap_lod
+
+        xv = ins["X"][0]
+        x = data(xv)
         dims = attrs.get("dim", [0])
         if isinstance(dims, int):
             dims = [dims]
-        axis = None if attrs.get("reduce_all", False) else tuple(dims)
+        reduce_all = attrs.get("reduce_all", False)
+        keep = attrs.get("keep_dim", False)
+        if isinstance(xv, LoDValue):
+            if xv.sub_lengths:
+                raise NotImplementedError(
+                    f"{_name} on multi-level LoD inputs is not supported; "
+                    "flatten_level() the value first")
+            # desc-level dims address the unpadded [sum(T), F...] layout
+            # (same contract as concat/split); padded slots must not
+            # contribute, so mask with the reduction's identity.  Desc
+            # axis 0 (the row axis) spans BOTH padded axes (N, T).
+            p_dims = set()
+            for d in dims:
+                p = lod_padded_axis(d, 1, x.ndim)
+                p_dims.update((0, 1) if p == 0 else (p,))
+            p_dims = tuple(sorted(p_dims))
+            mask = feature_mask(x, xv.lengths)
+            xm = jnp.where(mask, x, _mask_fill(_name, x.dtype))
+            axis = None if reduce_all else p_dims
+            xa = xm.astype(amp.stats_dtype(xm)) if accumulates else xm
+            if _name == "reduce_mean":
+                # divide by the TRUE element count, not the padded one
+                s = jnp.sum(xa, axis=axis, keepdims=keep)
+                cnt = jnp.sum(
+                    jnp.broadcast_to(mask, x.shape).astype(xa.dtype),
+                    axis=axis, keepdims=keep)
+                # rows beyond a sequence's length contribute 0/0 -> guard
+                out = s / jnp.maximum(cnt, 1)
+            else:
+                out = _fn(xa, axis=axis, keepdims=keep)
+            if accumulates:
+                out = out.astype(x.dtype)
+            if out.ndim == 0:
+                return {"Out": [jnp.reshape(out, (1,))]}
+            # reducing only feature axes keeps the sequence view
+            if not reduce_all and all(d >= 2 for d in p_dims):
+                return {"Out": [wrap_lod(xv, out)]}
+            return {"Out": [out]}
+        axis = None if reduce_all else tuple(dims)
         xa = x
         if accumulates:
             # sum/mean over half-width inputs (amp keep_output) accumulate
             # in fp32; the output rounds back to the input dtype
             xa = x.astype(amp.stats_dtype(x))
-        out = _fn(xa, axis=axis, keepdims=attrs.get("keep_dim", False))
+        out = _fn(xa, axis=axis, keepdims=keep)
         if accumulates:
             out = out.astype(x.dtype)
         if out.ndim == 0:
@@ -87,17 +148,34 @@ def _arg_infer(op, block):
 def _arg_reduce(ins, attrs, fn):
     """Keep the LoD view when reducing a feature axis of a sequence input
     (argmax over logits of an [N, T, C] LoDValue stays [N, T] with the same
-    lengths — ctc_greedy_decoder depends on this)."""
+    lengths — ctc_greedy_decoder depends on this).  Desc-level axes
+    address the unpadded [sum(T), F...] layout, like concat/split: axis 0
+    argmaxes over every valid row and returns UNPADDED row indices."""
     from ..core.lod import LoDValue
+    from .common import feature_mask, lod_padded_axis, wrap_lod
 
     x = ins["X"][0]
     d = data(x)
     axis = attrs.get("axis", -1)
-    out = fn(d, axis=axis)
-    norm_axis = axis + d.ndim if axis < 0 else axis
-    if isinstance(x, LoDValue) and norm_axis >= 2:
-        return {"Out": [LoDValue(out, x.lengths)]}
-    return {"Out": [out]}
+    if isinstance(x, LoDValue):
+        if x.sub_lengths:
+            raise NotImplementedError(
+                "arg reduce on multi-level LoD inputs is not supported")
+        p_axis = lod_padded_axis(axis, 1, d.ndim)
+        if p_axis == 0:
+            n, t = d.shape[0], d.shape[1]
+            mask = feature_mask(d, x.lengths)
+            is_max = fn is jnp.argmax
+            fill = _mask_fill("reduce_max" if is_max else "reduce_min",
+                              d.dtype)
+            flat = jnp.where(mask, d, fill).reshape((n * t,) + d.shape[2:])
+            p = fn(flat, axis=0)                      # padded flat index
+            lens = jnp.asarray(x.lengths).reshape(-1)
+            offsets = jnp.cumsum(lens) - lens         # row base per seq
+            return {"Out": [offsets[p // t] + p % t]}  # unpadded row idx
+        out = fn(d, axis=p_axis)
+        return {"Out": [wrap_lod(x, out)]}
+    return {"Out": [fn(d, axis=axis)]}
 
 
 @register_op("arg_max", infer_shape=_arg_infer, no_grad=True)

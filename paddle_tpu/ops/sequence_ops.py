@@ -35,14 +35,17 @@ def _as_lod(x):
 
 
 def _time_mask(d, l):
-    """[N, T] bool validity mask."""
-    return jnp.arange(d.shape[1])[None, :] < l[:, None]
+    """[N, T] bool validity mask (shared impl: common.time_mask)."""
+    from .common import time_mask
+
+    return time_mask(d, l)
 
 
 def _fmask(d, l):
-    """mask broadcast over feature dims of d."""
-    m = _time_mask(d, l)
-    return m.reshape(m.shape + (1,) * (d.ndim - 2))
+    """mask broadcast over feature dims of d (common.feature_mask)."""
+    from .common import feature_mask
+
+    return feature_mask(d, l)
 
 
 # ---------------------------------------------------------------------------

@@ -328,9 +328,10 @@ def _concat_infer(op, block):
             break
         tot += d
     shape[axis] = tot
-    # a feature-axis concat of sequence inputs stays a sequence
-    lod = xs[0].lod_level if axis >= 1 else 0
-    set_output(block, op, "Out", shape, xs[0].dtype, lod_level=lod)
+    # sequences stay sequences: feature-axis concat keeps the lod view,
+    # and axis-0 row concat merges batches of sequences
+    set_output(block, op, "Out", shape, xs[0].dtype,
+               lod_level=xs[0].lod_level)
 
 
 @register_op("concat", infer_shape=_concat_infer)
@@ -345,11 +346,28 @@ def _concat(ctx, ins, attrs):
         # dims on padded data (lod_padded_axis handles N-level nesting)
         level = 1 + len(lod_in.sub_lengths)
         p_axis = lod_padded_axis(axis, level, xs[0].ndim)
+        if p_axis == 0:
+            # row concat: the reference appends the sequences of every
+            # input into one batch (concatenated lod).  Pad to a common
+            # time extent, stack along N, merge the lengths.
+            if level != 1 or not all(
+                isinstance(v, LoDValue) for v in vals
+            ):
+                raise NotImplementedError(
+                    "concat(axis=0) on LoD inputs supports 1-level "
+                    "sequences only")
+            tmax = max(d.shape[1] for d in xs)
+            padded = [
+                jnp.pad(d, [(0, 0), (0, tmax - d.shape[1])]
+                        + [(0, 0)] * (d.ndim - 2))
+                for d in xs
+            ]
+            out = jnp.concatenate(padded, axis=0)
+            lens = jnp.concatenate(
+                [jnp.asarray(v.lengths).reshape(-1) for v in vals])
+            return {"Out": [LoDValue(out, lens)]}
         out = jnp.concatenate(xs, axis=p_axis)
-        if p_axis >= 1:
-            return {"Out": [LoDValue(out, lod_in.lengths,
-                                     lod_in.sub_lengths)]}
-        return {"Out": [out]}
+        return {"Out": [wrap_lod(lod_in, out)]}
     out = jnp.concatenate(xs, axis=axis)
     return {"Out": [out]}
 
@@ -392,7 +410,7 @@ def _split(ctx, ins, attrs):
     else:
         outs = jnp.split(x, attrs.get("num", 1), axis=axis)
     if lod and axis >= 1:
-        outs = [LoDValue(o, xv.lengths, xv.sub_lengths) for o in outs]
+        outs = [wrap_lod(xv, o) for o in outs]
     return {"Out": list(outs)}
 
 

@@ -289,3 +289,87 @@ def test_split_negative_axis_on_lod_uses_desc_rank():
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(rb.data)[0, :3], seqs[0][:, 3:],
                                rtol=1e-6)
+
+
+def test_concat_axis0_row_concat_on_lod():
+    """concat(axis=0) on LoD inputs appends the sequence batches
+    (concatenated lod, like the reference's LoD concat)."""
+    a = fluid.layers.data("r0a", [3], dtype="float32", lod_level=1)
+    b = fluid.layers.data("r0b", [3], dtype="float32", lod_level=1)
+    cat = fluid.layers.concat([a, b], axis=0)
+    assert cat.lod_level == 1
+    sa = [np.full((2, 3), 1.0, "float32"), np.full((4, 3), 2.0, "float32")]
+    sb = [np.full((1, 3), 3.0, "float32")]
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(
+        feed={"r0a": create_lod_tensor(np.concatenate(sa), [[2, 4]]),
+              "r0b": create_lod_tensor(np.concatenate(sb), [[1]])},
+        fetch_list=[cat], return_numpy=False)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [2, 4, 1])
+    np.testing.assert_allclose(np.asarray(res.data)[0, :2], sa[0])
+    np.testing.assert_allclose(np.asarray(res.data)[1, :4], sa[1])
+    np.testing.assert_allclose(np.asarray(res.data)[2, :1], sb[0])
+
+
+def test_argmax_feature_axis_on_lod_keeps_lengths():
+    """arg_max over the feature axis of a sequence keeps the LoD view
+    (desc-level axis semantics shared with concat/split)."""
+    x = fluid.layers.data("amx", [5], dtype="float32", lod_level=1)
+    idx = fluid.layers.argmax(x, axis=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    seq = np.random.RandomState(3).rand(4, 5).astype("float32")
+    (res,) = exe.run(feed={"amx": create_lod_tensor(seq, [[4]])},
+                     fetch_list=[idx], return_numpy=False)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [4])
+    np.testing.assert_array_equal(np.asarray(res.data)[0, :4],
+                                  seq.argmax(axis=1))
+
+
+def test_reduce_on_lod_ignores_padding():
+    """reduce_mean / reduce_sum on a sequence input address the unpadded
+    [sum(T), F] layout: padded slots never contribute, and reduce_all
+    means over the TRUE element count."""
+    seqs = [np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], "float32"),
+            np.array([[10.0, 20.0]], "float32")]
+    x = fluid.layers.data("rm", [2], dtype="float32", lod_level=1)
+    total_mean = fluid.layers.reduce_mean(x)  # reduce_all
+    feat_sum = fluid.layers.reduce_sum(x, dim=1)  # feature axis
+    exe = fluid.Executor(fluid.CPUPlace())
+    m, s = exe.run(
+        feed={"rm": create_lod_tensor(np.concatenate(seqs), [[3, 1]])},
+        fetch_list=[total_mean, feat_sum], return_numpy=False)
+    flat = np.concatenate(seqs)
+    np.testing.assert_allclose(float(np.ravel(np.asarray(m))[0]),
+                               flat.mean(), rtol=1e-6)
+    s = np.asarray(s.data if hasattr(s, "data") else s)
+    np.testing.assert_allclose(s[0, :3], flat[:3].sum(axis=1), rtol=1e-6)
+    np.testing.assert_allclose(s[1, :1], flat[3:].sum(axis=1), rtol=1e-6)
+
+
+def test_reduce_and_argmax_desc_axis0_on_lod():
+    """Desc axis 0 on a 1-level sequence spans the unpadded rows: reduce
+    collapses both padded axes; argmax returns UNPADDED row indices; int
+    max/min use dtype-aware identities."""
+    seqs = [np.array([[1.0, -5.0], [2.0, 7.0]], "float32"),
+            np.array([[9.0, 0.0]], "float32")]
+    flat = np.concatenate(seqs)  # rows 0,1 (seq 0) + row 2 (seq 1)
+    x = fluid.layers.data("ra0", [2], dtype="float32", lod_level=1)
+    s0 = fluid.layers.reduce_sum(x, dim=0)
+    am = fluid.layers.argmax(x, axis=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    s, a = exe.run(
+        feed={"ra0": create_lod_tensor(flat, [[2, 1]])},
+        fetch_list=[s0, am])
+    np.testing.assert_allclose(np.asarray(s), flat.sum(axis=0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(a), flat.argmax(axis=0))
+
+    # integer reduce_max over a sequence: no inf-cast crash, pads ignored
+    fluid.reset_default_env()
+    ids = fluid.layers.data("ri0", [1], dtype="int64", lod_level=1)
+    mx = fluid.layers.reduce_max(ids, dim=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(
+        feed={"ri0": create_lod_tensor(
+            np.array([[3], [9], [4]], "int64"), [[2, 1]])},
+        fetch_list=[mx])
+    assert int(np.ravel(got)[0]) == 9
