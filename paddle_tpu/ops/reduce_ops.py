@@ -26,6 +26,7 @@ def _reduce_infer_factory():
         if isinstance(dims, int):
             dims = [dims]
         keep = op.attr("keep_dim", False)
+        lod = 0
         if op.attr("reduce_all", False):
             shape = [1] * len(x.shape) if keep else [1]
         else:
@@ -36,7 +37,10 @@ def _reduce_infer_factory():
             else:
                 shape = [d for i, d in enumerate(x.shape) if i not in dims]
                 shape = shape or [1]
-        set_output(block, op, "Out", shape, x.dtype)
+            # reducing only feature axes keeps the sequence view
+            if all(d >= 1 for d in dims):
+                lod = x.lod_level
+        set_output(block, op, "Out", shape, x.dtype, lod_level=lod)
 
     return infer
 
@@ -101,6 +105,10 @@ def _make_reduce(name, fn, accumulates=False):
                 out = s / jnp.maximum(cnt, 1)
             else:
                 out = _fn(xa, axis=axis, keepdims=keep)
+            if keep and (reduce_all or 0 in p_dims):
+                # desc axis 0 spans two padded axes; the declared shape
+                # keeps only ONE row dim
+                out = jnp.squeeze(out, axis=0)
             if accumulates:
                 out = out.astype(x.dtype)
             if out.ndim == 0:

@@ -34,18 +34,8 @@ def _as_lod(x):
     return d, l
 
 
-def _time_mask(d, l):
-    """[N, T] bool validity mask (shared impl: common.time_mask)."""
-    from .common import time_mask
-
-    return time_mask(d, l)
-
-
-def _fmask(d, l):
-    """mask broadcast over feature dims of d (common.feature_mask)."""
-    from .common import feature_mask
-
-    return feature_mask(d, l)
+from .common import feature_mask as _fmask  # noqa: E402
+from .common import time_mask as _time_mask  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -185,16 +185,49 @@ def _reshape_infer(op, block):
         shape = _resolve_reshape(shape, target)
     else:
         shape = [shape[i] if d == 0 else d for i, d in enumerate(target)]
-    set_output(block, op, "Out", shape, x.dtype)
+    # row-preserving feature reshapes keep the sequence view (the only
+    # LoD case the lowering supports)
+    lod = x.lod_level if (target and target[0] in (-1, 0)) else 0
+    set_output(block, op, "Out", shape, x.dtype, lod_level=lod)
     if op.output("XShape"):
         set_output(block, op, "XShape", [0] + list(x.shape), x.dtype)
 
 
 def _reshape_lower(ctx, ins, attrs):
-    x = data(ins["X"][0])
-    shape = _resolve_reshape(x.shape, list(attrs["shape"]))
-    out = {"Out": [jnp.reshape(x, shape)]}
-    return out
+    xv = ins["X"][0]
+    x = data(xv)
+    target = list(attrs["shape"])
+    if isinstance(xv, LoDValue):
+        # the desc-level target addresses the unpadded [sum(T), F...]
+        # layout; a padded flat reshape would interleave pad slots into
+        # the output.  Row-preserving feature reshapes ([-1/0, F'...])
+        # keep the sequence view; anything that re-chunks rows has no
+        # padded equivalent.
+        if xv.sub_lengths:
+            raise NotImplementedError(
+                "reshape on multi-level LoD inputs is not supported")
+        feat = x.shape[2:]
+        feat_total = int(np.prod(feat)) if feat else 1
+        if target and target[0] in (-1, 0):
+            new_feat = []
+            for i, d in enumerate(target[1:], start=1):
+                # 0 copies the input dim at the same desc position
+                # (unpadded dim i = padded dim i + 1)
+                new_feat.append(int(x.shape[i + 1]) if d == 0 else int(d))
+            if -1 in new_feat:
+                known = 1
+                for d in new_feat:
+                    if d != -1:
+                        known *= d
+                new_feat[new_feat.index(-1)] = feat_total // max(known, 1)
+            if int(np.prod(new_feat or [1])) == feat_total:
+                out = jnp.reshape(x, x.shape[:2] + tuple(new_feat))
+                return {"Out": [wrap_lod(xv, out)]}
+        raise NotImplementedError(
+            f"reshape of a sequence to {target} re-chunks its rows; use "
+            "sequence_reshape for row re-chunking or sequence_unpad first")
+    shape = _resolve_reshape(x.shape, target)
+    return {"Out": [jnp.reshape(x, shape)]}
 
 
 register_op("reshape", infer_shape=_reshape_infer, diff_inputs=["X"])(_reshape_lower)

@@ -373,3 +373,42 @@ def test_reduce_and_argmax_desc_axis0_on_lod():
             np.array([[3], [9], [4]], "int64"), [[2, 1]])},
         fetch_list=[mx])
     assert int(np.ravel(got)[0]) == 9
+
+
+def test_reshape_on_lod_is_featurewise_or_loud():
+    """reshape on a sequence addresses the unpadded layout: [-1, F']
+    feature reshapes keep lengths and never mix pad slots in; row
+    re-chunking raises instead of silently corrupting."""
+    x = fluid.layers.data("rs", [6], dtype="float32", lod_level=1)
+    y = fluid.layers.reshape(x, shape=[-1, 2, 3])
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.arange(12, dtype="float32").reshape(2, 6),
+            np.arange(100, 106, dtype="float32").reshape(1, 6)]
+    res, = exe.run(
+        feed={"rs": create_lod_tensor(np.concatenate(seqs), [[2, 1]])},
+        fetch_list=[y], return_numpy=False)
+    np.testing.assert_array_equal(np.asarray(res.lengths), [2, 1])
+    np.testing.assert_allclose(np.asarray(res.data)[0, :2],
+                               seqs[0].reshape(2, 2, 3))
+    np.testing.assert_allclose(np.asarray(res.data)[1, :1],
+                               seqs[1].reshape(1, 2, 3))
+
+    fluid.reset_default_env()
+    x2 = fluid.layers.data("rs2", [6], dtype="float32", lod_level=1)
+    bad = fluid.layers.reshape(x2, shape=[-1, 4])  # re-chunks rows
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(Exception, match="sequence_reshape|re-chunks"):
+        exe2.run(feed={"rs2": create_lod_tensor(
+            np.ones((3, 6), "float32"), [[3]])}, fetch_list=[bad])
+
+
+def test_reduce_keep_dim_axis0_on_lod_shape():
+    """keep_dim with desc axis 0 keeps ONE row dim, matching the declared
+    (unpadded-layout) shape."""
+    x = fluid.layers.data("kd", [3], dtype="float32", lod_level=1)
+    s = fluid.layers.reduce_sum(x, dim=0, keep_dim=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(feed={"kd": create_lod_tensor(
+        np.ones((4, 3), "float32"), [[2, 2]])}, fetch_list=[s])
+    assert np.shape(got) == (1, 3), np.shape(got)
+    np.testing.assert_allclose(np.asarray(got)[0], [4.0, 4.0, 4.0])
