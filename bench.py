@@ -24,7 +24,11 @@ BENCH_TUNE (default 1: probe amp-tier x conv-layout combos on a few steps
 per model and pick the fastest for the timed run, recording every probe in
 "tuned"; 0 pins the BENCH_AMP/BENCH_LAYOUT config),
 BENCH_DATA=pyreader (feed through the py_reader worker-thread pipeline
-instead of pre-staged device arrays — proves the data stack keeps up).
+instead of pre-staged device arrays — proves the data stack keeps up),
+BENCH_UNROLL (default 0; K>=2 = run K training steps per device dispatch
+via Executor.run_steps' lax.scan driver, amortizing per-call host/relay
+latency — the AsyncExecutor whole-pass-per-call analogue; training
+models with dense feeds only).
 
 On backend failure the output is STILL one parseable JSON line:
 {"metric": "error", "error": "backend_unavailable", ...} plus a CPU-smoke
@@ -97,6 +101,7 @@ def run_model(model: str, steps: int, peak_flops: float,
         cfg = models.TransformerConfig(
             src_vocab_size=32000, trg_vocab_size=32000, max_length=256,
             use_flash_attention=os.environ.get("BENCH_FLASH", "1") != "0",
+            fuse_qkv=os.environ.get("BENCH_FUSE_QKV", "1") != "0",
         )
         spec = models.transformer(cfg)
         unit = "tokens/sec"
@@ -285,19 +290,48 @@ def run_model(model: str, steps: int, peak_flops: float,
     def step_feed(i):
         return None if use_pyreader else batches[i % len(batches)]
 
-    warm = None
-    for i in range(len(batches) + 1):
-        (warm,) = exe.run(program=run_program, feed=step_feed(i),
-                          fetch_list=[fetch_var], return_numpy=False)
-    jax.block_until_ready(warm)
+    unroll = int(os.environ.get("BENCH_UNROLL", "0"))
+    use_unroll = (
+        unroll >= 2 and run_program is None and not use_pyreader
+        and not any(isinstance(v, LoDValue) for v in batches_np[0].values())
+    )
+    if unroll >= 2 and not use_unroll:
+        sys.stderr.write(
+            f"# {model}: BENCH_UNROLL unsupported here (inference/pyreader/"
+            "LoD) — falling back to per-step dispatch\n")
+    if use_unroll:
+        # K steps per dispatch: lax.scan over the staged batches (the
+        # already-device arrays — feeding batches_np would re-upload them
+        # inside the timed region).  Warmup compiles the scanned program;
+        # the timed region is whole run_steps calls, so per-dispatch
+        # latency is paid steps/K times
+        steps = max(unroll, (steps // unroll) * unroll)
+        feed_list = batches
+        (warm,) = exe.run_steps(feed_list=feed_list, fetch_list=[fetch_var],
+                                steps=unroll, return_numpy=False)
+        jax.block_until_ready(warm)
+        t0 = time.perf_counter()
+        loss_v = None
+        for _ in range(steps // unroll):
+            (loss_v,) = exe.run_steps(
+                feed_list=feed_list, fetch_list=[fetch_var],
+                steps=unroll, return_numpy=False)
+        jax.block_until_ready(loss_v)
+        dt = time.perf_counter() - t0
+    else:
+        warm = None
+        for i in range(len(batches) + 1):
+            (warm,) = exe.run(program=run_program, feed=step_feed(i),
+                              fetch_list=[fetch_var], return_numpy=False)
+        jax.block_until_ready(warm)
 
-    t0 = time.perf_counter()
-    loss_v = None
-    for i in range(steps):
-        (loss_v,) = exe.run(program=run_program, feed=step_feed(i),
-                            fetch_list=[fetch_var], return_numpy=False)
-    jax.block_until_ready(loss_v)
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loss_v = None
+        for i in range(steps):
+            (loss_v,) = exe.run(program=run_program, feed=step_feed(i),
+                                fetch_list=[fetch_var], return_numpy=False)
+        jax.block_until_ready(loss_v)
+        dt = time.perf_counter() - t0
     if reader is not None:
         reader.reset()
 
@@ -321,6 +355,7 @@ def run_model(model: str, steps: int, peak_flops: float,
         # which input path actually ran (pyreader silently falls back for
         # inference programs / LoD batches)
         "data": "pyreader" if use_pyreader else "staged",
+        "unroll": unroll if use_unroll else 1,
     }
 
 

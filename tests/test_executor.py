@@ -249,3 +249,83 @@ def test_amp_keep_output_layer_norm_parity():
     assert got_dt == "bfloat16"  # the post-norm activation stays half-width
     np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.08)
     assert got[-1] < got[0]
+
+
+def test_run_steps_matches_stepwise_run():
+    """run_steps (K iterations in one lax.scan dispatch) must reproduce the
+    step-by-step Executor.run trajectory exactly: same params, same loss,
+    same RNG advancement."""
+    x = fluid.layers.data("x", [4], dtype="float32")
+    label = fluid.layers.data("label", [1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="rs_w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(7)
+    feeds = [
+        {"x": rng.rand(8, 4).astype(np.float32),
+         "label": rng.rand(8, 1).astype(np.float32)}
+        for _ in range(3)
+    ]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    snapshot = {
+        n: np.asarray(scope.find_var(n)).copy()
+        for n in scope.local_var_names()
+        if scope.find_var(n) is not None
+    }
+    serial_losses = []
+    for i in range(7):  # 7 % 3 != 0: exercises batch cycling
+        (lv,) = exe.run(feed=feeds[i % 3], fetch_list=[loss])
+        serial_losses.append(float(np.ravel(lv)[0]))
+    w_serial = np.asarray(scope.find_var("rs_w")).copy()
+
+    # reset ALL post-startup state (params incl. the fc bias) and the rng
+    # stream, rerun as one scanned dispatch
+    for n in list(scope.local_var_names()):
+        if n in snapshot:
+            scope.set_var(n, snapshot[n])
+        else:
+            scope.erase(n)
+    (lv,) = exe.run_steps(feed_list=feeds, fetch_list=[loss], steps=7)
+    np.testing.assert_allclose(
+        float(np.ravel(lv)[0]), serial_losses[-1], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var("rs_w")), w_serial, rtol=1e-6)
+
+
+def test_run_steps_advances_rng():
+    out = fluid.layers.ops.uniform_random([4], min=0.0, max=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (a,) = exe.run_steps(feed_list=[{}], fetch_list=[out], steps=2)
+    (b,) = exe.run_steps(feed_list=[{}], fetch_list=[out], steps=2)
+    assert not np.allclose(a, b)
+
+
+def test_run_steps_rejects_lod():
+    from paddle_tpu.core.lod import LoDValue
+
+    x = fluid.layers.data("x", [4], dtype="float32", lod_level=1)
+    y = fluid.layers.sequence_pool(x, "sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    lv = LoDValue(np.zeros((3, 4), np.float32), np.array([2, 1]))
+    with pytest.raises(TypeError, match="LoD"):
+        exe.run_steps(feed_list=[{"x": lv}], fetch_list=[y], steps=1)
+
+
+def test_run_steps_mutable_feed_not_stale():
+    """In-place mutation of a reused numpy feed buffer must reach the device
+    on the next run_steps call (the feeds-stack cache only applies to
+    immutable jax.Array feeds)."""
+    x = fluid.layers.data("x", [2], dtype="float32")
+    out = fluid.layers.reduce_mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": np.ones((2, 2), np.float32)}
+    (a,) = exe.run_steps(feed_list=[feed], fetch_list=[out], steps=1)
+    feed["x"][:] = 5.0  # standard refill-the-buffer loading pattern
+    (b,) = exe.run_steps(feed_list=[feed], fetch_list=[out], steps=1)
+    np.testing.assert_allclose(np.ravel(a)[0], 1.0)
+    np.testing.assert_allclose(np.ravel(b)[0], 5.0)

@@ -70,6 +70,46 @@ def test_transformer_decoder_is_causal():
     assert np.abs(base[:, 5:, :] - pert[:, 5:, :]).max() > 0
 
 
+def test_transformer_fuse_qkv_parity():
+    """fuse_qkv=True (one [d,3d] qkv matmul / [d,2d] kv matmul) must be
+    numerically identical to the three separate projections: build both,
+    stitch the unfused weights into the fused layout, compare logits."""
+    kw = dict(src_vocab_size=32, trg_vocab_size=32, max_length=8,
+              n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    spec_u = models.transformer(models.TransformerConfig(fuse_qkv=False, **kw))
+    exe.run(fluid.default_startup_program())
+    batch = spec_u.synthetic_batch(2)
+    (base,) = exe.run(feed=batch, fetch_list=[spec_u.extras["logits"]])
+    scope_u = fluid.global_scope()
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope_f = fluid.Scope()
+    with fluid.scope_guard(scope_f), fluid.program_guard(main, startup):
+        spec_f = models.transformer(models.TransformerConfig(fuse_qkv=True, **kw))
+        exe.run(startup)
+        # copy shared-name params; stitch q/k/v -> qkv and k/v -> kv
+        for name in scope_f.local_var_names():
+            if scope_u.has_var(name) and scope_u.find_var(name) is not None:
+                scope_f.set_var(name, np.asarray(scope_u.find_var(name)))
+        for name in list(scope_f.local_var_names()):
+            for fused, parts in (("_qkv", "qkv"), ("_kv", "kv")):
+                if name.endswith(f"{fused}_w"):
+                    stem = name[: -len(f"{fused}_w")]
+                    scope_f.set_var(name, np.concatenate(
+                        [np.asarray(scope_u.find_var(f"{stem}_{p}_w"))
+                         for p in parts], axis=1))
+                elif name.endswith(f"{fused}_b"):
+                    stem = name[: -len(f"{fused}_b")]
+                    scope_f.set_var(name, np.concatenate(
+                        [np.asarray(scope_u.find_var(f"{stem}_{p}_b"))
+                         for p in parts], axis=0))
+        (fused,) = exe.run(program=main, feed=batch,
+                           fetch_list=[spec_f.extras["logits"]])
+    np.testing.assert_allclose(base, fused, rtol=1e-5, atol=1e-5)
+
+
 def test_transformer_masks_ignore_pad():
     """Loss is averaged over non-pad tokens only: doubling padding must not
     change a zero-dropout model's loss scale wildly (sanity on masking)."""
