@@ -67,6 +67,16 @@ CONV_MODELS = {"resnet50", "lenet", "alexnet", "googlenet", "vgg19",
                "vgg19_infer", "vgg19_infer_int8"}
 
 
+def _maybe_trace(logdir):
+    if logdir:
+        import jax
+
+        return jax.profiler.trace(logdir)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 def _apply_config(amp: str, layout: str) -> None:
     import paddle_tpu as fluid
 
@@ -78,7 +88,11 @@ def _apply_config(amp: str, layout: str) -> None:
 
 
 def run_model(model: str, steps: int, peak_flops: float,
-              amp: str = "1", layout: str = "NCHW") -> dict:
+              amp: str = "1", layout: str = "NCHW",
+              profile_logdir: str | None = None) -> dict:
+    """profile_logdir: wrap ONLY the timed steady-state loop in
+    jax.profiler.trace (startup/compile/warmup excluded), so per-op device
+    totals divide cleanly by `steps` (tools/tpu_profile.py)."""
     import jax
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -299,6 +313,13 @@ def run_model(model: str, steps: int, peak_flops: float,
         sys.stderr.write(
             f"# {model}: BENCH_UNROLL unsupported here (inference/pyreader/"
             "LoD) — falling back to per-step dispatch\n")
+    if use_unroll and unroll % len(batches):
+        # the scan index restarts at 0 every dispatch; a non-multiple of
+        # the staged-batch count would starve the tail batches entirely
+        unroll += len(batches) - unroll % len(batches)
+        sys.stderr.write(
+            f"# {model}: BENCH_UNROLL rounded up to {unroll} "
+            f"(multiple of {len(batches)} staged batches)\n")
     if use_unroll:
         # K steps per dispatch: lax.scan over the staged batches (the
         # already-device arrays — feeding batches_np would re-upload them
@@ -310,14 +331,15 @@ def run_model(model: str, steps: int, peak_flops: float,
         (warm,) = exe.run_steps(feed_list=feed_list, fetch_list=[fetch_var],
                                 steps=unroll, return_numpy=False)
         jax.block_until_ready(warm)
-        t0 = time.perf_counter()
-        loss_v = None
-        for _ in range(steps // unroll):
-            (loss_v,) = exe.run_steps(
-                feed_list=feed_list, fetch_list=[fetch_var],
-                steps=unroll, return_numpy=False)
-        jax.block_until_ready(loss_v)
-        dt = time.perf_counter() - t0
+        with _maybe_trace(profile_logdir):
+            t0 = time.perf_counter()
+            loss_v = None
+            for _ in range(steps // unroll):
+                (loss_v,) = exe.run_steps(
+                    feed_list=feed_list, fetch_list=[fetch_var],
+                    steps=unroll, return_numpy=False)
+            jax.block_until_ready(loss_v)
+            dt = time.perf_counter() - t0
     else:
         warm = None
         for i in range(len(batches) + 1):
@@ -325,13 +347,14 @@ def run_model(model: str, steps: int, peak_flops: float,
                               fetch_list=[fetch_var], return_numpy=False)
         jax.block_until_ready(warm)
 
-        t0 = time.perf_counter()
-        loss_v = None
-        for i in range(steps):
-            (loss_v,) = exe.run(program=run_program, feed=step_feed(i),
-                                fetch_list=[fetch_var], return_numpy=False)
-        jax.block_until_ready(loss_v)
-        dt = time.perf_counter() - t0
+        with _maybe_trace(profile_logdir):
+            t0 = time.perf_counter()
+            loss_v = None
+            for i in range(steps):
+                (loss_v,) = exe.run(program=run_program, feed=step_feed(i),
+                                    fetch_list=[fetch_var], return_numpy=False)
+            jax.block_until_ready(loss_v)
+            dt = time.perf_counter() - t0
     if reader is not None:
         reader.reset()
 

@@ -117,16 +117,21 @@ class _Builder:
         # fused projections keep the UNFUSED per-projection Xavier scale
         # (fan_in=d, fan_out=d): the default would read fan_out=3d/2d off
         # the fused shape and shrink init ~1.4x, changing from-scratch
-        # training vs the separate projections
+        # training vs the separate projections.  The fused weight carries
+        # NO tp annotation: a [None, tp] column split of the block-wise
+        # q|k|v concat puts shard cuts mid-projection (tp=2 cuts k at
+        # 1.5d), so the logical split(3) would cross shard boundaries and
+        # force per-layer resharding — under tensor parallelism prefer
+        # fuse_qkv=False, whose per-projection column splits stay local.
         proj_init = XavierInitializer(fan_in=d, fan_out=d)
         if cfg.fuse_qkv and q_in is kv_in:
             qkv = self.linear(q_in, d, 3 * d, f"{name}_qkv",
-                              shard=[None, tp], initializer=proj_init)
+                              initializer=proj_init)
             q, k, v = layers.split(qkv, num_or_sections=3, dim=-1)
         elif cfg.fuse_qkv:
             q = self.linear(q_in, d, d, f"{name}_q", shard=[None, tp])
             kv = self.linear(kv_in, d, 2 * d, f"{name}_kv",
-                             shard=[None, tp], initializer=proj_init)
+                             initializer=proj_init)
             k, v = layers.split(kv, num_or_sections=2, dim=-1)
         else:
             q = self.linear(q_in, d, d, f"{name}_q", shard=[None, tp])

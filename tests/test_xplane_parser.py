@@ -1,0 +1,72 @@
+"""tools/xplane.py: minimal protobuf wire-format reader for profiler dumps.
+The fixture hand-encodes a tiny XSpace so the parser is pinned to the wire
+format, not to any installed protobuf."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from xplane import device_op_times, parse_xspace  # noqa: E402
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _field(num, wt, payload):
+    tag = _varint(num << 3 | wt)
+    if wt == 2:
+        return tag + _varint(len(payload)) + payload
+    return tag + _varint(payload)
+
+
+def _xspace():
+    # event: metadata_id=7, duration_ps=2_000_000 (2 us)
+    ev1 = _field(1, 0, 7) + _field(3, 0, 2_000_000)
+    ev2 = _field(1, 0, 9) + _field(3, 0, 1_000_000)
+    line_ops = (_field(2, 2, b"XLA Ops")
+                + _field(4, 2, ev1) + _field(4, 2, ev1) + _field(4, 2, ev2))
+    line_steps = _field(2, 2, b"Steps") + _field(4, 2, ev2)
+    meta7 = _field(1, 0, 7) + _field(2, 2, b"fusion.1")
+    meta9 = _field(1, 0, 9) + _field(2, 2, b"convolution.3")
+    entry7 = _field(1, 0, 7) + _field(2, 2, meta7)
+    entry9 = _field(1, 0, 9) + _field(2, 2, meta9)
+    plane = (_field(2, 2, b"/device:TPU:0")
+             + _field(3, 2, line_ops) + _field(3, 2, line_steps)
+             + _field(4, 2, entry7) + _field(4, 2, entry9))
+    host = _field(2, 2, b"/host:CPU") + _field(3, 2, line_steps)
+    return _field(1, 2, plane) + _field(1, 2, host)
+
+
+def test_parse_xspace_structure():
+    planes = parse_xspace(_xspace())
+    assert [p["name"] for p in planes] == ["/device:TPU:0", "/host:CPU"]
+    tpu = planes[0]
+    assert tpu["event_metadata"] == {7: "fusion.1", 9: "convolution.3"}
+    assert [name for name, _ in tpu["lines"]] == ["XLA Ops", "Steps"]
+
+
+def test_device_op_times_aggregates_ops_line_only():
+    totals = device_op_times(_xspace())
+    # two fusion.1 events at 2us + one convolution.3 at 1us; the Steps line
+    # and the host plane must not contribute
+    np.testing.assert_allclose(totals["fusion.1"], 4.0)
+    np.testing.assert_allclose(totals["convolution.3"], 1.0)
+    assert set(totals) == {"fusion.1", "convolution.3"}
+
+
+def test_device_op_times_host_fallback():
+    host_only = _field(1, 2, _field(2, 2, b"/host:CPU") + _field(
+        3, 2, _field(2, 2, b"python") + _field(
+            4, 2, _field(1, 0, 1) + _field(3, 0, 5_000_000))))
+    totals = device_op_times(host_only)
+    assert sum(totals.values()) == 5.0
