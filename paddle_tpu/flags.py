@@ -38,6 +38,13 @@ _DEFS: Dict[str, Any] = {
     # (TPU-preferred — convs lower with NHWC dimension_numbers behind
     # boundary transposes that XLA cancels between chained convs)
     "FLAGS_conv_layout": "NCHW",
+    # flash-attention backward implementation: "jax" (recompute the
+    # reference formulation under jax.vjp — XLA fuses it well) or
+    # "pallas" (FlashAttention-2 dq/dkv kernels; O(S*D) HBM in backward).
+    # Default jax: the axon relay's remote-compile service has failed on
+    # full-model pallas-backward compiles (round 3); on a directly
+    # attached TPU host flip to "pallas" for long sequences
+    "FLAGS_flash_bwd": "jax",
 }
 
 _VALUES: Dict[str, Any] = {}
@@ -84,6 +91,7 @@ def get_flags(names=None) -> Dict[str, Any]:
 # silently select the default branch at the use site)
 _CHOICES: Dict[str, tuple] = {
     "FLAGS_conv_layout": ("NCHW", "NHWC"),
+    "FLAGS_flash_bwd": ("jax", "pallas"),
 }
 
 

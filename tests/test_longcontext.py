@@ -160,9 +160,92 @@ def test_flash_causal_cross_length():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_flash_empty_sequence_is_zero():
+@pytest.mark.parametrize("force", ["jax", "interpret"])
+def test_flash_empty_sequence_is_zero(force):
+    """Both backends must agree: a zero-length row attends to nothing and
+    outputs zeros (the pallas kernel's running-max floor guards this — an
+    m floor of NEG_INF would make masked p = exp(0) = 1 and average V)."""
     rng = np.random.default_rng(7)
     q, k, v = _rand_qkv(rng, B=2, H=1, S=8, D=4)
-    out = flash_attention(q, k, v, k_lengths=jnp.asarray([0, 8]), force="jax")
+    out = flash_attention(q, k, v, k_lengths=jnp.asarray([0, 8]), force=force)
     np.testing.assert_allclose(np.asarray(out)[0], 0.0)
     assert np.abs(np.asarray(out)[1]).sum() > 0
+
+
+# -- pallas backward kernels (round 3: dq/dkv kernels replace the dense
+#    recompute backward) -------------------------------------------------
+
+def _grad_pair(q, k, v, causal=False, k_lengths=None, Dh=None):
+    """(pallas-interpret grads, jax-reference grads) for sum(out * w)."""
+    Dh = Dh or q.shape[-1]
+    scale = 1.0 / math.sqrt(Dh)
+    w = jnp.asarray(
+        np.random.default_rng(99).standard_normal(q.shape[:3] + (q.shape[-1],))
+        .astype("float32"))
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, k_lengths=k_lengths,
+                              force="interpret")
+        return jnp.sum(out * w)
+
+    def loss_ref(q, k, v):
+        kl = (jnp.asarray(k_lengths, jnp.int32)
+              if k_lengths is not None else None)
+        out = _reference_attention(q, k, v, causal, scale, k_lengths=kl)
+        return jnp.sum(out * w)
+
+    return jax.grad(loss_flash, (0, 1, 2))(q, k, v), \
+        jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+
+
+def _assert_grads_close(got, want, atol=2e-4):
+    for g, r, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=atol,
+            err_msg=f"d{name} mismatch")
+
+
+def test_flash_bwd_matches_reference():
+    rng = np.random.default_rng(5)
+    q, k, v = _rand_qkv(rng, S=64, D=16)
+    _assert_grads_close(*_grad_pair(q, k, v))
+
+
+def test_flash_bwd_causal_padded_seq():
+    rng = np.random.default_rng(6)
+    # S=80 is not a block multiple: exercises padded q rows (zero dO) and
+    # padded k columns in the backward kernels
+    q, k, v = _rand_qkv(rng, S=80, D=16)
+    _assert_grads_close(*_grad_pair(q, k, v, causal=True))
+
+
+def test_flash_bwd_key_padding():
+    rng = np.random.default_rng(7)
+    q, k, v = _rand_qkv(rng, B=3, S=64, D=8)
+    lens = np.array([64, 17, 1], np.int32)
+    got, want = _grad_pair(q, k, v, k_lengths=lens)
+    _assert_grads_close(got, want)
+    # keys past each row's length must receive exactly zero grad
+    for b, n in enumerate(lens):
+        if n < q.shape[2]:
+            assert np.abs(np.asarray(got[1])[b, :, n:]).max() == 0
+            assert np.abs(np.asarray(got[2])[b, :, n:]).max() == 0
+
+
+def test_flash_bwd_cross_attention_lengths():
+    rng = np.random.default_rng(8)
+    q, k, v = _rand_qkv(rng, S=32, Sk=96, D=16)
+    _assert_grads_close(*_grad_pair(q, k, v, causal=True))
+
+
+def test_flash_bwd_bf16_inputs():
+    rng = np.random.default_rng(9)
+    q, k, v = _rand_qkv(rng, S=64, D=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got, _ = _grad_pair(qb, kb, vb)
+    _, want = _grad_pair(q, k, v)
+    for g, r, name in zip(got, want, "qkv"):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g, dtype=np.float32), np.asarray(r), atol=0.15,
+            rtol=0.1, err_msg=f"d{name} bf16 drift")

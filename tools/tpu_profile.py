@@ -44,7 +44,8 @@ def _device_op_times_from_logdir(logdir: str) -> dict:
     with open(_find_xplane(logdir), "rb") as f:
         data = f.read()
     ops = device_op_times(data)
-    async_ops = device_op_times(data, line_name="Async XLA Ops")
+    async_ops = device_op_times(data, line_name="Async XLA Ops",
+                                strict_line=True)
     if async_ops:
         sys.stderr.write(
             "# async (DMA) device time, overlaps compute: "
@@ -53,12 +54,14 @@ def _device_op_times_from_logdir(logdir: str) -> dict:
 
 
 CATEGORIES = (
-    ("conv", ("conv",)),
-    ("matmul", ("dot", "fusion.convert", "gemm")),
+    # order matters: first match wins ("convolution" before the generic
+    # "fusion" bucket; plain "conv" would swallow convert_* fusions)
+    ("conv", ("convolution", "conv2d", "conv3d")),
+    ("matmul", ("dot", "gemm")),
     ("allreduce/collective", ("all-reduce", "all-gather", "collective")),
     ("transpose/copy", ("transpose", "copy", "bitcast")),
     ("reduce", ("reduce",)),
-    ("fusion/elementwise", ("fusion", "add", "multiply", "select")),
+    ("fusion/elementwise", ("fusion", "add", "multiply", "select", "jvp")),
 )
 
 

@@ -124,13 +124,16 @@ def device_op_times(
     data: bytes,
     device_tokens: Tuple[str, ...] = ("tpu", "axon", "/device", "gpu"),
     line_name: str = "XLA Ops",
+    strict_line: bool = False,
 ) -> Dict[str, float]:
     """Sum event durations (microseconds) per op name over device planes.
 
     Only the per-op line (default 'XLA Ops') is aggregated — the 'Steps'
     line counts wall-clock between dispatches and 'XLA Modules' double-counts
-    whole executables.  Falls back to every line of the device plane when
-    the named line is absent, and to all planes when no device plane
+    whole executables.  When the named line is absent a plane falls back to
+    all of its lines UNLESS strict_line is set (callers asking for a
+    specific line, e.g. 'Async XLA Ops', must get {} rather than a
+    fabricated total).  Falls back to all planes when no device plane
     matches (pure CPU traces name their plane '/host:CPU')."""
     planes = parse_xspace(data)
     chosen = [
@@ -144,6 +147,8 @@ def device_op_times(
         meta = plane["event_metadata"]
         lines = [le for le in plane["lines"] if le[0] == line_name]
         if not lines:
+            if strict_line:
+                continue
             lines = plane["lines"]
         for _, events in lines:
             for mid, dur_ps in events:
