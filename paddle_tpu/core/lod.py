@@ -119,6 +119,61 @@ class LoDValue:
         )
 
 
+def _pack_native_flat(flat, lengths, max_len, feat_shape, dtype):
+    """Single-memcpy-pass variant for the flat-buffer + seq-lens input:
+    one contiguous source, no per-row pointer table."""
+    import ctypes
+
+    from .. import native
+
+    lib = native.load("lodpack")
+    if lib is None or dtype.hasobject:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=dtype)
+    n = len(lengths)
+    feat = int(np.prod(feat_shape, dtype=np.int64)) if feat_shape else 1
+    out = np.empty((n, max_len) + tuple(feat_shape), dtype=dtype)
+    rc = lib.lp_pack_flat(
+        flat.ctypes.data_as(ctypes.c_char_p), ctypes.c_long(dtype.itemsize),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ctypes.c_long(n), ctypes.c_long(feat), ctypes.c_long(max_len),
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out if rc == 0 else None
+
+
+def _pack_native(seqs, lengths, max_len, feat_shape, dtype):
+    """memcpy-pack ragged rows into padded [N, maxT, F] via the native
+    library (reference analogue: operators/math/sequence_padding.cc does
+    this layout shuffle in C++).  Returns None when the native library is
+    unavailable or the inputs aren't native-friendly (object dtypes,
+    non-contiguous rows)."""
+    import ctypes
+
+    from .. import native
+
+    lib = native.load("lodpack")
+    if lib is None or dtype.hasobject:
+        return None
+    rows = []
+    for s in seqs:
+        s = np.ascontiguousarray(s, dtype=dtype)
+        rows.append(s)
+    n = len(rows)
+    feat = int(np.prod(feat_shape, dtype=np.int64)) if feat_shape else 1
+    out = np.empty((n, max_len) + tuple(feat_shape), dtype=dtype)
+    ptrs = (ctypes.c_char_p * n)(
+        *[ctypes.cast(r.ctypes.data, ctypes.c_char_p) for r in rows]
+    )
+    rc = lib.lp_pack_rows(
+        ptrs, ctypes.c_long(dtype.itemsize),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ctypes.c_long(n), ctypes.c_long(feat), ctypes.c_long(max_len),
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out if rc == 0 else None
+
+
 def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
     """Build a runtime value from ragged python data
     (reference: python/paddle/fluid/lod_tensor.py create_lod_tensor).
@@ -136,6 +191,15 @@ def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
             return _create_nested(data, recursive_seq_lens)
         lens = list(recursive_seq_lens[-1])
         flat = np.asarray(data)
+        if lens:
+            # flat contiguous source: one native memcpy pass, no slicing
+            lengths = np.asarray(lens, dtype=np.int32)
+            max_len = int(lengths.max())
+            packed = _pack_native_flat(
+                flat, lengths, max_len, flat.shape[1:], flat.dtype
+            )
+            if packed is not None:
+                return LoDValue(packed, lengths)
         seqs = []
         off = 0
         for l in lens:
@@ -144,7 +208,12 @@ def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
     lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
     max_len = int(lengths.max()) if len(seqs) else 0
     feat_shape = seqs[0].shape[1:] if seqs else ()
-    out = np.zeros((len(seqs), max_len) + tuple(feat_shape), dtype=seqs[0].dtype if seqs else np.float32)
+    dtype = seqs[0].dtype if seqs else np.dtype(np.float32)
+    if seqs and all(s.shape[1:] == feat_shape for s in seqs):
+        packed = _pack_native(seqs, lengths, max_len, feat_shape, dtype)
+        if packed is not None:
+            return LoDValue(packed, lengths)
+    out = np.zeros((len(seqs), max_len) + tuple(feat_shape), dtype=dtype)
     for i, s in enumerate(seqs):
         out[i, : len(s)] = s
     return LoDValue(out, lengths)

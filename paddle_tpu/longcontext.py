@@ -51,28 +51,49 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         src = (my - i) % p
         k_pos = src * S + jnp.arange(S)
 
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
-        mask = jnp.ones((S, S), dtype=bool)
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask, s, NEG_INF)
+        def attend(acc, m, l):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+            mask = jnp.ones((S, S), dtype=bool)
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
 
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        # guard all-masked rows (fully-future blocks under causal)
-        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        pexp = jnp.exp(s - m_safe)
-        pexp = jnp.where(mask, pexp, 0.0)
-        corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
-        l_new = corr * l + jnp.sum(pexp, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", pexp.astype(v_cur.dtype), v_cur
-        )
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            # guard all-masked rows (the partially-future diagonal block's
+            # padded rows under causal)
+            m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+            pexp = jnp.exp(s - m_safe)
+            pexp = jnp.where(mask, pexp, 0.0)
+            corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+            l_new = corr * l + jnp.sum(pexp, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", pexp.astype(v_cur.dtype), v_cur
+            )
+            return acc_new, m_new, l_new
+
+        if causal:
+            # an entirely-future K/V shard (src > my: every key position
+            # exceeds every local query position) contributes nothing —
+            # skip its matmuls instead of computing a fully-masked block.
+            # lax.cond keeps this differentiable.  NOTE: with contiguous
+            # sequence sharding this halves aggregate FLOPs/energy but
+            # NOT wall-clock — the ring is lockstep and device p-1
+            # attends at every step, so latency stays gated by the
+            # busiest device.  A latency win needs load-balanced
+            # (zigzag/striped) sharding; the rotation below still runs
+            # every step so the ring stays in sync.
+            acc, m, l = jax.lax.cond(
+                src > my, lambda a, mm, ll: (a, mm, ll), attend, acc, m, l
+            )
+        else:
+            acc, m, l = attend(acc, m, l)
+
         # rotate K/V shards around the ring (overlaps with next compute)
         perm = [(j, (j + 1) % p) for j in range(p)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (acc_new, m_new, l_new, k_nxt, v_nxt), None
+        return (acc, m, l, k_nxt, v_nxt), None
 
     acc0 = jnp.zeros(q.shape, dtype=jnp.float32)
     m0 = jnp.full((B, H, S, 1), NEG_INF, dtype=jnp.float32)

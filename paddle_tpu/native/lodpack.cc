@@ -1,0 +1,59 @@
+// Native LoD batch packer (reference analogue: the sequence-layout
+// shufflers in paddle/fluid/operators/math/sequence_padding.cc — the
+// reference packs ragged sequence batches into padded layouts in C++;
+// here the host-side pack feeds the padded [N, maxT, F] LoDValue the XLA
+// program consumes).
+//
+// Plain-C ABI for ctypes (pybind11 unavailable in this image):
+//   lp_pack_flat(src, elem_size, lens, n, feat, max_len, dst)
+//     src: concatenated rows, row i occupying lens[i]*feat elements;
+//     dst: pre-allocated n*max_len*feat*elem_size bytes; the function
+//     copies each row to its padded slot and zeroes the padding tail.
+//   lp_pack_rows(srcs, elem_size, lens, n, feat, max_len, dst)
+//     srcs: array of n row pointers (non-contiguous inputs).
+// Both return 0 on success, nonzero on bad arguments.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+int lp_pack_flat(const char* src, long elem_size, const int* lens, long n,
+                 long feat, long max_len, char* dst) {
+  if (!src || !dst || !lens || elem_size <= 0 || n < 0 || feat <= 0 ||
+      max_len < 0) {
+    return 1;
+  }
+  const long row_bytes = max_len * feat * elem_size;
+  long off = 0;
+  for (long i = 0; i < n; ++i) {
+    const long len = lens[i];
+    if (len < 0 || len > max_len) return 2;
+    const long used = len * feat * elem_size;
+    char* out = dst + i * row_bytes;
+    std::memcpy(out, src + off, used);
+    std::memset(out + used, 0, row_bytes - used);
+    off += used;
+  }
+  return 0;
+}
+
+int lp_pack_rows(const char* const* srcs, long elem_size, const int* lens,
+                 long n, long feat, long max_len, char* dst) {
+  if (!srcs || !dst || !lens || elem_size <= 0 || n < 0 || feat <= 0 ||
+      max_len < 0) {
+    return 1;
+  }
+  const long row_bytes = max_len * feat * elem_size;
+  for (long i = 0; i < n; ++i) {
+    const long len = lens[i];
+    if (len < 0 || len > max_len || !srcs[i]) return 2;
+    const long used = len * feat * elem_size;
+    char* out = dst + i * row_bytes;
+    std::memcpy(out, srcs[i], used);
+    std::memset(out + used, 0, row_bytes - used);
+  }
+  return 0;
+}
+
+}  // extern "C"

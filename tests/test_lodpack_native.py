@@ -1,0 +1,98 @@
+"""Native LoD packer (native/lodpack.cc): identical output to the Python
+pack loop, across dtypes/feature shapes, plus direct ABI checks."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.core.lod import LoDValue, create_lod_tensor, _pack_native
+
+
+def _python_pack(seqs):
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    max_len = int(lengths.max())
+    feat = seqs[0].shape[1:]
+    out = np.zeros((len(seqs), max_len) + feat, dtype=seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = s
+    return out, lengths
+
+
+@pytest.mark.parametrize("dtype,feat", [
+    ("float32", (8,)), ("int64", ()), ("float64", (3, 4)), ("uint8", (2,)),
+])
+def test_native_pack_matches_python(dtype, feat):
+    lib = native.load("lodpack")
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    seqs = [
+        rng.standard_normal((l,) + feat).astype(dtype)
+        for l in [3, 1, 7, 5, 2]
+    ]
+    want, lengths = _python_pack(seqs)
+    got = _pack_native(seqs, lengths, int(lengths.max()), feat,
+                       np.dtype(dtype))
+    assert got is not None
+    np.testing.assert_array_equal(got, want)
+
+
+def test_create_lod_tensor_uses_pack(monkeypatch):
+    if native.load("lodpack") is None:
+        pytest.skip("native toolchain unavailable")
+    # prove the NATIVE path produced the result: poison the numpy fallback
+    # (sys.modules lookup: the fluid-parity alias of paddle_tpu.core breaks
+    # attribute-style `import paddle_tpu.core.lod as ...`)
+    import sys as _sys
+
+    lod_mod = _sys.modules["paddle_tpu.core.lod"]
+
+    calls = {"native": 0}
+    real = lod_mod._pack_native
+
+    def counting(*a, **k):
+        r = real(*a, **k)
+        assert r is not None, "native pack unexpectedly fell back"
+        calls["native"] += 1
+        return r
+
+    monkeypatch.setattr(lod_mod, "_pack_native", counting)
+    seqs = [np.arange(6, dtype=np.float32).reshape(3, 2),
+            np.arange(2, dtype=np.float32).reshape(1, 2)]
+    v = create_lod_tensor(seqs)
+    assert calls["native"] == 1
+    assert isinstance(v, LoDValue)
+    np.testing.assert_array_equal(v.lengths, [3, 1])
+    np.testing.assert_array_equal(v.data[0], seqs[0])
+    np.testing.assert_array_equal(v.data[1, :1], seqs[1])
+    np.testing.assert_array_equal(v.data[1, 1:], 0)
+
+
+def test_flat_path_uses_single_pass_pack():
+    if native.load("lodpack") is None:
+        pytest.skip("native toolchain unavailable")
+    flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+    v = create_lod_tensor(flat, recursive_seq_lens=[[4, 2]])
+    assert isinstance(v, LoDValue)
+    np.testing.assert_array_equal(v.lengths, [4, 2])
+    np.testing.assert_array_equal(v.data[0], flat[:4])
+    np.testing.assert_array_equal(v.data[1, :2], flat[4:6])
+    np.testing.assert_array_equal(v.data[1, 2:], 0)
+
+
+def test_flat_abi_bad_lengths_rejected():
+    lib = native.load("lodpack")
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    src = np.arange(4, dtype=np.float32)
+    lens = np.asarray([5], dtype=np.int32)  # exceeds max_len
+    dst = np.zeros((1, 4), dtype=np.float32)
+    rc = lib.lp_pack_flat(
+        src.ctypes.data_as(ctypes.c_char_p), ctypes.c_long(4),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ctypes.c_long(1), ctypes.c_long(1), ctypes.c_long(4),
+        dst.ctypes.data_as(ctypes.c_char_p),
+    )
+    assert rc != 0
