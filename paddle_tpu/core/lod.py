@@ -191,6 +191,12 @@ def create_lod_tensor(data: Any, recursive_seq_lens=None, place=None) -> Any:
             return _create_nested(data, recursive_seq_lens)
         lens = list(recursive_seq_lens[-1])
         flat = np.asarray(data)
+        if sum(lens) != flat.shape[0]:
+            raise ValueError(
+                f"recursive_seq_lens sums to {sum(lens)} rows but data has "
+                f"{flat.shape[0]} (reference lod_tensor.py validates this; "
+                "the native packer would read out of bounds)"
+            )
         if lens:
             # flat contiguous source: one native memcpy pass, no slicing
             lengths = np.asarray(lens, dtype=np.int32)

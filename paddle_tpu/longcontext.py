@@ -25,9 +25,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "sequence_parallel_attention"]
+__all__ = ["ring_attention", "sequence_parallel_attention",
+           "zigzag_permutation", "zigzag_ring_attention",
+           "zigzag_sequence_parallel_attention"]
 
 NEG_INF = -1e30
+
+
+def _softmax_merge(state, s, vals, mask):
+    """One online-softmax merge: fold score block `s` (masked by `mask`)
+    and its values into the running (acc, m, l).  Shared by both ring
+    variants — the NEG_INF/2 all-masked-row guard is numerically delicate
+    and must stay in exactly one place."""
+    acc, m, l = state
+    s = jnp.where(mask, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    pexp = jnp.where(mask, jnp.exp(s - m_safe), 0.0)
+    corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+    l_new = corr * l + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "bhqk,bhkd->bhqd", pexp.astype(vals.dtype), vals
+    )
+    return acc_new, m_new, l_new
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -56,21 +77,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             mask = jnp.ones((S, S), dtype=bool)
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask, s, NEG_INF)
-
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m, m_cur)
-            # guard all-masked rows (the partially-future diagonal block's
-            # padded rows under causal)
-            m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-            pexp = jnp.exp(s - m_safe)
-            pexp = jnp.where(mask, pexp, 0.0)
-            corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
-            l_new = corr * l + jnp.sum(pexp, axis=-1, keepdims=True)
-            acc_new = acc * corr + jnp.einsum(
-                "bhqk,bhkd->bhqd", pexp.astype(v_cur.dtype), v_cur
-            )
-            return acc_new, m_new, l_new
+            return _softmax_merge((acc, m, l), s, v_cur, mask)
 
         if causal:
             # an entirely-future K/V shard (src > my: every key position
@@ -124,3 +131,119 @@ def sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
         fn, mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+# -- zigzag (load-balanced) causal context parallelism ----------------------
+#
+# With contiguous sharding, causal ring attention is imbalanced: device 0's
+# queries see almost nothing, device P-1's see everything, and since the
+# ring is lockstep, latency is gated by the busiest device (the plain
+# ring_attention's skip only saves energy).  Zigzag sharding (as used by
+# modern context-parallel trainers) splits the sequence into 2P chunks and
+# gives device d the PAIR (d, 2P-1-d) — one early and one late chunk — so
+# every device owns the same amount of visible causal work, and skipping
+# hidden chunk-pairs turns the saved FLOPs into saved wall-clock.
+
+def zigzag_permutation(seq_len: int, p: int):
+    """(perm, inv) index arrays: `x[..., perm, :]` lays a [S] sequence out
+    so P equal shards each hold chunks (d, 2P-1-d); `inv` undoes it."""
+    import numpy as np
+
+    if seq_len % (2 * p):
+        raise ValueError(f"seq_len {seq_len} must divide into 2p={2*p} chunks")
+    c = seq_len // (2 * p)
+    chunks = np.arange(seq_len).reshape(2 * p, c)
+    perm = np.concatenate(
+        [np.concatenate([chunks[d], chunks[2 * p - 1 - d]]) for d in range(p)]
+    )
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return perm, inv
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str,
+                          scale: Optional[float] = None):
+    """Causal attention over a ZIGZAG-sharded sequence (call under
+    shard_map).  q/k/v: local shards [B, H, 2C, D] holding global chunks
+    (my, 2P-1-my).  Per ring step the four local-q-chunk x incoming-k-chunk
+    sub-blocks are computed only when visible (full or diagonal), which is
+    balanced across devices by construction."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    p = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, H, S2, D = q.shape
+    C = S2 // 2
+
+    q_chunks = (q[:, :, :C], q[:, :, C:])
+    q_chunk_ids = (my, 2 * p - 1 - my)
+    pos = jnp.arange(C)
+
+    def sub_block(state, qi, qc_id, k_half, v_half, kc_id):
+        """Merge one C x C sub-block if visible: kc_id < qc_id -> full,
+        == -> causal diagonal, > -> hidden (skip)."""
+        qq = q_chunks[qi]
+
+        def visible(st):
+            s = jnp.einsum("bhqd,bhkd->bhqk", qq, k_half) * scale
+            # full block when strictly earlier, diagonal when equal
+            mask = (kc_id < qc_id) | (pos[:, None] >= pos[None, :])
+            return _softmax_merge(st, s, v_half, mask)
+
+        return jax.lax.cond(kc_id <= qc_id, visible, lambda st: st, state)
+
+    def step(carry, i):
+        st0, st1, k_cur, v_cur = carry
+        src = (my - i) % p
+        k_chunk_ids = (src, 2 * p - 1 - src)
+        halves = ((k_cur[:, :, :C], v_cur[:, :, :C]),
+                  (k_cur[:, :, C:], v_cur[:, :, C:]))
+        for kh, (k_half, v_half) in enumerate(halves):
+            st0 = sub_block(st0, 0, q_chunk_ids[0], k_half, v_half,
+                            k_chunk_ids[kh])
+            st1 = sub_block(st1, 1, q_chunk_ids[1], k_half, v_half,
+                            k_chunk_ids[kh])
+        perm = [(j, (j + 1) % p) for j in range(p)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (st0, st1, k_nxt, v_nxt), None
+
+    def init():
+        shape = (B, H, C, 1)
+        return (jnp.zeros((B, H, C, D), jnp.float32),
+                jnp.full(shape, NEG_INF, jnp.float32),
+                jnp.zeros(shape, jnp.float32))
+
+    (st0, st1, _, _), _ = jax.lax.scan(
+        step, (init(), init(), k, v), jnp.arange(p)
+    )
+
+    def fin(st):
+        acc, _, l = st
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    return jnp.concatenate([fin(st0), fin(st1)], axis=2)
+
+
+def zigzag_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
+                                       scale: Optional[float] = None,
+                                       batch_axis: Optional[str] = "dp"):
+    """Global-view causal attention with zigzag load balancing: permutes
+    the sequence into zigzag layout, runs zigzag_ring_attention under
+    shard_map over `axis`, and un-permutes the output."""
+    from jax import shard_map
+
+    jmesh = getattr(mesh, "mesh", mesh)
+    p = jmesh.shape[axis]
+    S = q.shape[2]
+    perm, inv = zigzag_permutation(S, p)
+    axis_names = jmesh.axis_names
+    b = batch_axis if batch_axis in axis_names else None
+    spec = P(b, None, axis, None)
+
+    fn = functools.partial(zigzag_ring_attention, axis_name=axis, scale=scale)
+    out = shard_map(
+        fn, mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q[:, :, perm], k[:, :, perm], v[:, :, perm])
+    return out[:, :, inv]

@@ -96,3 +96,12 @@ def test_flat_abi_bad_lengths_rejected():
         dst.ctypes.data_as(ctypes.c_char_p),
     )
     assert rc != 0
+
+
+def test_flat_lens_mismatch_raises():
+    """sum(recursive_seq_lens) must match the data row count — the native
+    packer would otherwise memcpy past the source buffer (reference
+    lod_tensor.py validates the same invariant)."""
+    flat = np.arange(8, dtype=np.float32).reshape(4, 2)
+    with pytest.raises(ValueError, match="sums to 6"):
+        create_lod_tensor(flat, recursive_seq_lens=[[3, 3]])

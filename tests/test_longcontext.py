@@ -249,3 +249,54 @@ def test_flash_bwd_bf16_inputs():
         np.testing.assert_allclose(
             np.asarray(g, dtype=np.float32), np.asarray(r), atol=0.15,
             rtol=0.1, err_msg=f"d{name} bf16 drift")
+
+
+# -- zigzag (load-balanced) causal context parallelism --------------------
+
+def test_zigzag_permutation_roundtrip():
+    from paddle_tpu.longcontext import zigzag_permutation
+
+    perm, inv = zigzag_permutation(16, 4)
+    x = np.arange(16)
+    np.testing.assert_array_equal(x[perm][inv], x)
+    # device 0 holds chunks 0 and 7, device 3 holds chunks 3 and 4
+    np.testing.assert_array_equal(perm[:4], [0, 1, 14, 15])
+    np.testing.assert_array_equal(perm[-4:], [6, 7, 8, 9])
+
+
+def test_zigzag_ring_matches_full_causal():
+    from paddle_tpu.longcontext import zigzag_sequence_parallel_attention
+    from paddle_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(3)
+    q, k, v = _rand_qkv(rng, B=2, H=2, S=32, D=8)
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    got = zigzag_sequence_parallel_attention(mesh, q, k, v, batch_axis=None)
+    want = _reference_attention(q, k, v, True, 1 / math.sqrt(8))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_ring_grads():
+    from paddle_tpu.longcontext import zigzag_sequence_parallel_attention
+    from paddle_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, B=1, H=2, S=16, D=4)
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    w = jnp.asarray(rng.standard_normal(q.shape).astype("float32"))
+
+    def loss_z(q, k, v):
+        return jnp.sum(
+            zigzag_sequence_parallel_attention(mesh, q, k, v,
+                                               batch_axis=None) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _reference_attention(q, k, v, True, 1 / math.sqrt(4)) * w)
+
+    gz = jax.grad(loss_z, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b, name in zip(gz, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   err_msg=f"d{name}")
