@@ -329,3 +329,47 @@ def test_run_steps_mutable_feed_not_stale():
     (b,) = exe.run_steps(feed_list=[feed], fetch_list=[out], steps=1)
     np.testing.assert_allclose(np.ravel(a)[0], 1.0)
     np.testing.assert_allclose(np.ravel(b)[0], 5.0)
+
+
+def test_run_steps_with_scheduler_and_dropout():
+    """run_steps must advance in-graph LR-decay state and the dropout RNG
+    stream exactly like per-step run(): the scan carries every persistable
+    (incl. the scheduler's global step) plus the PRNG key."""
+    import paddle_tpu.layers as layers
+
+    x = fluid.layers.data("x", [8], dtype="float32")
+    y = fluid.layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    lr = fluid.layers.exponential_decay(
+        learning_rate=0.1, decay_steps=2, decay_rate=0.5, staircase=True)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+
+    rng = np.random.RandomState(11)
+    feeds = [{"x": rng.rand(4, 8).astype(np.float32),
+              "y": rng.rand(4, 1).astype(np.float32)} for _ in range(2)]
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    snap = {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.local_var_names()
+            if scope.find_var(n) is not None}
+    for i in range(6):
+        exe.run(feed=feeds[i % 2], fetch_list=[loss])
+    params_serial = {
+        n: np.asarray(scope.find_var(n)).copy() for n in snap
+    }
+
+    for n in list(scope.local_var_names()):
+        if n in snap:
+            scope.set_var(n, snap[n])
+        else:
+            scope.erase(n)
+    exe.run_steps(feed_list=feeds, fetch_list=[loss], steps=6)
+    for n, want in params_serial.items():
+        got = np.asarray(scope.find_var(n))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"state {n} diverged")
