@@ -64,7 +64,7 @@ def _transformer_train_flops_per_token(cfg) -> float:
 
 
 CONV_MODELS = {"resnet50", "lenet", "alexnet", "googlenet", "vgg19",
-               "vgg19_infer", "vgg19_infer_int8"}
+               "vgg19_infer", "vgg19_infer_int8", "se_resnext"}
 
 
 def _maybe_trace(logdir):
@@ -153,6 +153,28 @@ def run_model(model: str, steps: int, peak_flops: float,
         # drawn per example), not assumed = max_len.
         flops_per_item = None  # filled in after batches are staged
         lr = 0.01
+    elif model == "se_resnext":
+        # benchmark/fluid se_resnext config (SE-ResNeXt-50 32x4d); the
+        # reference publishes no absolute number for it (BASELINE.md)
+        bs = int(os.environ.get("BENCH_SE_RESNEXT_BS", "128"))
+        spec = models.se_resnext()
+        unit = "images/sec"
+        items_per_step = bs
+        metric = "se_resnext50_train_images_per_sec_per_chip"
+        baseline = None
+        flops_per_item = 3 * 4.3e9  # fwd ~4.3 GFLOP @224 (SE adds ~5%)
+        lr = 0.1
+    elif model == "machine_translation":
+        # benchmark/fluid machine_translation config: attention seq2seq
+        # over ragged LoD batches (dynamic_gru encoder, per-step attention)
+        bs = int(os.environ.get("BENCH_MT_BS", "64"))
+        spec = models.machine_translation()
+        unit = "examples/sec"
+        items_per_step = bs
+        metric = "machine_translation_train_examples_per_sec_per_chip"
+        baseline = None
+        flops_per_item = None  # follows the real token count, like lstm
+        lr = 0.01
     elif model == "lenet":
         bs = int(os.environ.get("BENCH_BS", "64"))
         spec = models.lenet5()
@@ -202,7 +224,8 @@ def run_model(model: str, steps: int, peak_flops: float,
     else:
         raise SystemExit(f"unknown BENCH_MODELS entry {model!r} "
                          "(expected resnet50|transformer|deepfm|lstm|lenet|"
-                         "alexnet|googlenet|vgg19|vgg19_infer|vgg19_infer_int8)")
+                         "alexnet|googlenet|vgg19|vgg19_infer|"
+                         "vgg19_infer_int8|se_resnext|machine_translation)")
 
     run_program = None
     fetch_var = spec.loss
@@ -287,7 +310,7 @@ def run_model(model: str, steps: int, peak_flops: float,
         batches = [jax.device_put(b, dev) for b in batches_np]
         jax.block_until_ready(batches)
 
-    if flops_per_item is None:  # lstm: flops follow the REAL token count
+    if flops_per_item is None:  # ragged models: flops follow REAL tokens
         from paddle_tpu.core.lod import LoDValue
 
         tokens = [
@@ -295,9 +318,17 @@ def run_model(model: str, steps: int, peak_flops: float,
             for b in batches for v in b.values() if isinstance(v, LoDValue)
         ]
         avg_tokens = (sum(tokens) / len(batches)) / bs if tokens else 100.0
-        flops_per_item = (
-            3 * avg_tokens * (2 * 2 * 16 * 512 * 512 + 2 * 512 * 512)
-        )
+        if model == "machine_translation":
+            # three LoD streams (src/trg/lbl) were summed: per-stream avg
+            avg_pairs = avg_tokens / 3.0
+            # fwd/token-pair: encoder (in-fc 512->1536, bigru 2x3x512^2,
+            # proj 1024->512) ~5.7 MFLOP + decoder (out-proj 512->10000
+            # dominates, gru+attention) ~12 MFLOP; x3 for training
+            flops_per_item = 3 * avg_pairs * (5.7e6 + 12.0e6)
+        else:  # stacked lstm
+            flops_per_item = (
+                3 * avg_tokens * (2 * 2 * 16 * 512 * 512 + 2 * 512 * 512)
+            )
 
     # warmup: one pass over EVERY staged batch (variable-length batches
     # each have their own XLA shape) plus one extra step so the
@@ -494,9 +525,10 @@ def main() -> None:
     names = os.environ.get(
         "BENCH_MODELS", "resnet50,transformer,deepfm"
     )
-    if names.strip() == "all":  # every wired baseline row
+    if names.strip() == "all":  # every wired baseline/benchmark-fluid row
         names = ("resnet50,transformer,deepfm,lstm,lenet,alexnet,"
-                 "googlenet,vgg19,vgg19_infer,vgg19_infer_int8")
+                 "googlenet,vgg19,vgg19_infer,vgg19_infer_int8,"
+                 "se_resnext,machine_translation")
     names = [m.strip() for m in names.split(",") if m.strip()]
     if not names:
         raise SystemExit("BENCH_MODELS is empty")
