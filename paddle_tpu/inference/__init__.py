@@ -22,12 +22,19 @@ from ..core.framework import Program
 from ..core.place import CPUPlace, TPUPlace
 from ..core.scope import Scope
 
+from .aot import (  # noqa: F401
+    load_compiled_inference_model,
+    save_compiled_inference_model,
+)
+
 __all__ = [
     "NativeConfig",
     "AnalysisConfig",
     "PaddleTensor",
     "create_paddle_predictor",
     "PaddlePredictor",
+    "save_compiled_inference_model",
+    "load_compiled_inference_model",
 ]
 
 

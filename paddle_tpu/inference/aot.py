@@ -1,0 +1,195 @@
+"""Ahead-of-time compiled inference artifacts (jax.export / StableHLO).
+
+The reference deploys by pairing `save_inference_model` with a C++
+inference engine that re-optimizes the program at load time
+(inference/analysis/analyzer.h:48, TensorRT subgraphs).  The TPU-native
+equivalent exports the pruned inference program as ONE serialized StableHLO
+computation with the parameters baked in as constants: the artifact is
+self-contained (no Python model code, no scope, no recompilation beyond
+XLA's AOT step at load) and runs on any jax backend that satisfies the
+recorded lowering platforms.
+
+The batch dimension is exported SYMBOLICALLY when possible (jax shape
+polymorphism), so one artifact serves any batch size; if the program
+doesn't support a polymorphic batch (shape-dependent ops), export falls
+back to a concrete batch of 1 and records the shapes AND the reason in
+meta.json; the loader then validates feed shapes up front.
+
+    save_compiled_inference_model(dirname, feed_names, [pred], exe)
+    predict = load_compiled_inference_model(dirname)
+    out, = predict({"image": batch})
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["save_compiled_inference_model", "load_compiled_inference_model"]
+
+_ARTIFACT = "model.stablehlo"
+_META = "meta.json"
+
+
+def save_compiled_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor=None,
+    main_program=None,
+    scope=None,
+) -> List[str]:
+    """Export the pruned inference program (params frozen from the scope)
+    as a serialized StableHLO artifact.  Returns the fetch names.
+
+    Mirrors save_inference_model's signature (reference: io.py:570); the
+    executor argument is accepted for parity and unused (compilation
+    replaces execution here)."""
+    import jax
+    from jax import export as jexport
+
+    from ..core.executor import _RunPlan
+    from ..core.compiler import CompiledBlock
+    from ..core.framework import Variable, default_main_program
+    from ..core.lod import LoDValue
+    from ..core.proto import dtype_to_runtime
+    from ..core.scope import global_scope
+    from ..io import _for_test, _prune_for_targets
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    feed_names = sorted(feeded_var_names)
+    fetch_names = [
+        t.name if isinstance(t, Variable) else str(t) for t in target_vars
+    ]
+    pruned = _for_test(_prune_for_targets(program, feed_names, fetch_names))
+
+    plan = _RunPlan(pruned, feed_names, fetch_names)
+    compiled = CompiledBlock(
+        pruned, 0, plan.feed_names, plan.fetch_names, plan.state_names,
+        donate_states=False,
+    )
+    block0 = pruned.desc.block(0)
+    state_vals = []
+    for v in plan.state_values(scope, block0):
+        if isinstance(v, LoDValue):
+            raise TypeError(
+                "compiled export supports dense persistable state only"
+            )
+        state_vals.append(np.asarray(v))
+    state_vals = tuple(state_vals)
+    key = jax.random.PRNGKey(0)  # test-mode program: key is never consumed
+
+    def serve(*feeds):
+        fetches, _, _ = compiled.raw_fn(feeds, state_vals, key)
+        return tuple(fetches)
+
+    # ONE shared batch symbol across every feed: per-feed symbolic_shape
+    # calls would create distinct symbolic scopes, and jax rejects mixing
+    # scopes — multi-feed models would silently lose the symbolic batch
+    (b_sym,) = jexport.symbolic_shape("b")
+    specs_sym: List[Any] = []
+    specs_static: List[Any] = []
+    feed_meta = []
+    for n in plan.feed_names:
+        vd = block0.vars.get(n)
+        if vd is None or vd.lod_level:
+            raise TypeError(
+                f"feed '{n}' is missing or ragged (LoD); compiled export "
+                "supports dense feeds only"
+            )
+        shape = list(vd.shape)
+        if any(d < 0 for d in shape[1:]):
+            raise ValueError(
+                f"feed '{n}' has non-leading dynamic dims {shape}; only the "
+                "batch dimension may be symbolic"
+            )
+        np_dtype = np.dtype(dtype_to_runtime(vd.dtype))
+        lead_sym = b_sym if shape and shape[0] < 0 else (
+            shape[0] if shape else 1)
+        lead_static = 1 if not shape or shape[0] < 0 else shape[0]
+        specs_sym.append(
+            jax.ShapeDtypeStruct(tuple([lead_sym] + shape[1:]), np_dtype)
+        )
+        specs_static.append(
+            jax.ShapeDtypeStruct(tuple([lead_static] + shape[1:]), np_dtype)
+        )
+        feed_meta.append({
+            "name": n, "shape": shape, "dtype": np_dtype.name,
+        })
+
+    batch = "symbolic"
+    symbolic_error = None
+    try:
+        exported = jexport.export(jax.jit(serve))(*specs_sym)
+    except Exception as e:  # noqa: BLE001 — reason is recorded in meta
+        # shape polymorphism unsupported somewhere in the program: fall
+        # back to a concrete batch of 1 and record both the fallback and
+        # why (an always-static artifact with no cause is undebuggable)
+        batch = "static"
+        symbolic_error = f"{type(e).__name__}: {e}"[:500]
+        exported = jexport.export(jax.jit(serve))(*specs_static)
+    exported_shapes = None
+    if batch == "static":
+        exported_shapes = [
+            [int(d) for d in spec.shape] for spec in specs_static
+        ]
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _ARTIFACT), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, _META), "w") as f:
+        json.dump({
+            "feeds": feed_meta,
+            "fetch_names": plan.fetch_names,
+            "batch": batch,
+            "symbolic_error": symbolic_error,
+            "exported_shapes": exported_shapes,
+            "platforms": list(exported.platforms),
+        }, f, indent=1)
+    return list(plan.fetch_names)
+
+
+def load_compiled_inference_model(
+    dirname: str,
+) -> Callable[[Dict[str, Any]], List[np.ndarray]]:
+    """Load a saved artifact; returns predict(feed_dict) -> [np arrays].
+
+    The returned callable also exposes .feed_names / .fetch_names /
+    .meta."""
+    from jax import export as jexport
+
+    with open(os.path.join(dirname, _META)) as f:
+        meta = json.load(f)
+    with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    feed_names = [fm["name"] for fm in meta["feeds"]]
+    dtypes = {fm["name"]: np.dtype(fm["dtype"]) for fm in meta["feeds"]}
+
+    exported_shapes = meta.get("exported_shapes")
+
+    def predict(feed: Dict[str, Any]) -> List[np.ndarray]:
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"feed is missing {missing}")
+        args = [np.ascontiguousarray(feed[n], dtype=dtypes[n])
+                for n in feed_names]
+        if exported_shapes is not None:  # static artifact: validate early
+            for n, a, want in zip(feed_names, args, exported_shapes):
+                if list(a.shape) != want:
+                    raise ValueError(
+                        f"feed '{n}' has shape {list(a.shape)} but this "
+                        f"artifact was exported for the STATIC shape {want} "
+                        f"(symbolic batch unavailable: "
+                        f"{meta.get('symbolic_error')})"
+                    )
+        outs = exported.call(*args)
+        return [np.asarray(o) for o in outs]
+
+    predict.feed_names = feed_names
+    predict.fetch_names = list(meta["fetch_names"])
+    predict.meta = meta
+    return predict

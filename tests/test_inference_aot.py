@@ -1,0 +1,116 @@
+"""AOT compiled inference artifacts (inference/aot.py): StableHLO export
+round-trip, symbolic batch, parity with the live executor."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import (
+    load_compiled_inference_model,
+    save_compiled_inference_model,
+)
+
+
+def _build_small_cnn():
+    img = layers.data("image", [1, 8, 8], dtype="float32")
+    c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+    b = layers.batch_norm(c, act="relu")
+    p = layers.pool2d(b, pool_size=8, pool_type="avg")
+    pred = layers.fc(p, size=3, act="softmax")
+    return img, pred
+
+
+def test_aot_roundtrip_matches_executor(tmp_path):
+    img, pred = _build_small_cnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    fetch = save_compiled_inference_model(
+        str(tmp_path), ["image"], [pred], exe)
+    assert fetch == [pred.name]
+
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 1, 8, 8).astype(np.float32)
+    (want,) = exe.run(test_prog, feed={"image": xv}, fetch_list=[pred])
+
+    predict = load_compiled_inference_model(str(tmp_path))
+    assert predict.feed_names == ["image"]
+    (got,) = predict({"image": xv})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_aot_symbolic_batch_serves_any_size(tmp_path):
+    img, pred = _build_small_cnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    save_compiled_inference_model(str(tmp_path), ["image"], [pred], exe)
+    predict = load_compiled_inference_model(str(tmp_path))
+    if predict.meta["batch"] != "symbolic":
+        pytest.skip("program fell back to static batch")
+    for bs in (1, 5):
+        (out,) = predict({"image": np.zeros((bs, 1, 8, 8), np.float32)})
+        assert out.shape[0] == bs
+
+
+def test_aot_rejects_missing_feed(tmp_path):
+    img, pred = _build_small_cnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    save_compiled_inference_model(str(tmp_path), ["image"], [pred], exe)
+    predict = load_compiled_inference_model(str(tmp_path))
+    with pytest.raises(KeyError, match="image"):
+        predict({})
+
+
+def test_aot_multi_feed_symbolic_batch(tmp_path):
+    """Two dynamic-batch feeds must share ONE symbolic scope — per-feed
+    scopes made every multi-input model silently fall back to static."""
+    a = layers.data("a", [4], dtype="float32")
+    b = layers.data("b", [4], dtype="float32")
+    out = layers.fc(layers.concat([a, b], axis=1), size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    save_compiled_inference_model(str(tmp_path), ["a", "b"], [out], exe)
+    predict = load_compiled_inference_model(str(tmp_path))
+    assert predict.meta["batch"] == "symbolic", predict.meta["symbolic_error"]
+    for bs in (2, 7):
+        (o,) = predict({"a": np.ones((bs, 4), np.float32),
+                        "b": np.ones((bs, 4), np.float32)})
+        assert o.shape == (bs, 2)
+
+
+def test_aot_static_artifact_validates_shapes(tmp_path, monkeypatch):
+    """A static-fallback artifact must reject mismatched batch with a
+    clear message, not a deep jax shape error."""
+    import paddle_tpu.inference.aot as aot_mod
+    from jax import export as jexport
+
+    img, pred = _build_small_cnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    real = jexport.export
+    calls = {"n": 0}
+
+    def flaky_export(fn, **kw):
+        wrapped = real(fn, **kw)
+
+        def call(*specs):
+            calls["n"] += 1
+            if calls["n"] == 1:  # poison the symbolic attempt
+                raise ValueError("synthetic: polymorphism unsupported")
+            return wrapped(*specs)
+
+        return call
+
+    monkeypatch.setattr(jexport, "export", flaky_export)
+    save_compiled_inference_model(str(tmp_path), ["image"], [pred], exe)
+    predict = load_compiled_inference_model(str(tmp_path))
+    assert predict.meta["batch"] == "static"
+    assert "synthetic" in predict.meta["symbolic_error"]
+    with pytest.raises(ValueError, match="STATIC shape"):
+        predict({"image": np.zeros((4, 1, 8, 8), np.float32)})
+    (out,) = predict({"image": np.zeros((1, 1, 8, 8), np.float32)})
+    assert out.shape == (1, 3)
