@@ -27,7 +27,8 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = ["ring_attention", "sequence_parallel_attention",
            "zigzag_permutation", "zigzag_ring_attention",
-           "zigzag_sequence_parallel_attention"]
+           "zigzag_sequence_parallel_attention",
+           "ulysses_attention", "ulysses_sequence_parallel_attention"]
 
 NEG_INF = -1e30
 
@@ -247,3 +248,77 @@ def zigzag_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
         check_vma=False,
     )(q[:, :, perm], k[:, :, perm], v[:, :, perm])
     return out[:, :, inv]
+
+
+# -- all-to-all (Ulysses-style) sequence parallelism -------------------------
+#
+# The ring moves K/V around the mesh P times; the all-to-all variant moves
+# the DATA LAYOUT instead: one all_to_all re-shards q/k/v from
+# sequence-sharded [B, H, S/P, D] to head-sharded [B, H/P, S, D], each
+# device runs ordinary full-sequence attention for its H/P heads, and a
+# second all_to_all restores sequence sharding.  Two collectives total
+# (vs P ppermute hops), at the cost of requiring H % P == 0 and holding the
+# full sequence for H/P heads (peak memory O(S * D * H/P) per chip vs the
+# ring's O(S/P * D * H)).  Pick per workload: many-head models with moderate
+# S favour all-to-all; extreme S favours the ring.
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all sequence-parallel attention over `axis_name` (call under
+    shard_map).  q/k/v: LOCAL sequence shards [B, H, S_local, D] with the
+    GLOBAL head count H divisible by the axis size.  Returns the local
+    output shard [B, H, S_local, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    p = jax.lax.psum(1, axis_name)  # static axis size under shard_map
+    if q.shape[1] % p:
+        raise ValueError(
+            f"ulysses attention needs heads {q.shape[1]} divisible by the "
+            f"'{axis_name}' axis size {p}; use ring_attention otherwise")
+
+    def to_heads(x):
+        # [B, H, S/P, D] -> [B, H/P, S, D]: split the head axis across the
+        # mesh, concatenate the gathered sequence chunks.
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vh.dtype), vh)
+    return to_seq(out).astype(q.dtype)
+
+
+def ulysses_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
+                                        causal: bool = False,
+                                        scale: Optional[float] = None,
+                                        batch_axis: Optional[str] = "dp"):
+    """Global-view wrapper: q/k/v [B, H, S, D] with S sharded on `axis`;
+    re-shards to heads via all_to_all, computes full attention per head
+    group, and restores sequence sharding.  Requires H % mesh[axis] == 0."""
+    from jax import shard_map
+
+    jmesh = getattr(mesh, "mesh", mesh)
+    p = jmesh.shape[axis]
+    if q.shape[1] % p:
+        raise ValueError(
+            f"ulysses attention needs heads {q.shape[1]} divisible by the "
+            f"'{axis}' axis size {p}; use ring_attention otherwise")
+    axis_names = jmesh.axis_names
+    b = batch_axis if batch_axis in axis_names else None
+    spec = P(b, None, axis, None)
+
+    fn = functools.partial(ulysses_attention, axis_name=axis, causal=causal,
+                           scale=scale)
+    return shard_map(
+        fn, mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

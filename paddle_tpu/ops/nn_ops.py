@@ -246,14 +246,21 @@ def _pool2d_infer(op, block):
     )
 
 
-def _pool(x, ksize, strides, paddings, pooling_type, exclusive, ceil_mode, spatial):
-    """Shared reduce_window pooling for 2d/3d."""
-    rank = x.ndim
-    window = (1, 1) + tuple(ksize)
-    strides_full = (1, 1) + tuple(strides)
-    pads = ((0, 0), (0, 0)) + tuple(
+def _pool(x, ksize, strides, paddings, pooling_type, exclusive, ceil_mode, spatial,
+          nhwc=False):
+    """Shared reduce_window pooling for 2d/3d.  nhwc=True pools a
+    channels-last operand (window over the middle spatial dims)."""
+    spatial_pads = tuple(
         (p, p + (s - 1 if ceil_mode else 0)) for p, s in zip(paddings, strides)
     )
+    if nhwc:
+        window = (1,) + tuple(ksize) + (1,)
+        strides_full = (1,) + tuple(strides) + (1,)
+        pads = ((0, 0),) + spatial_pads + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(ksize)
+        strides_full = (1, 1) + tuple(strides)
+        pads = ((0, 0), (0, 0)) + spatial_pads
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, pads)
@@ -271,6 +278,8 @@ def _pool(x, ksize, strides, paddings, pooling_type, exclusive, ceil_mode, spati
 
 @register_op("pool2d", infer_shape=_pool2d_infer)
 def _pool2d(ctx, ins, attrs):
+    from ..flags import flag
+
     x = data(ins["X"][0])
     if attrs.get("global_pooling", False):
         if attrs.get("pooling_type", "max") == "max":
@@ -280,11 +289,22 @@ def _pool2d(ctx, ins, attrs):
         return {"Out": [out]}
     if attrs.get("adaptive", False):
         return _pool2d_adaptive(ctx, ins, attrs)
-    out = _pool(
-        x, attrs.get("ksize", [1, 1]), attrs.get("strides", [1, 1]),
+    pool_args = (
+        attrs.get("ksize", [1, 1]), attrs.get("strides", [1, 1]),
         attrs.get("paddings", [0, 0]), attrs.get("pooling_type", "max"),
-        attrs.get("exclusive", True), attrs.get("ceil_mode", False), 2,
+        attrs.get("exclusive", True), attrs.get("ceil_mode", False),
     )
+    if flag("conv_layout") == "NHWC":
+        # Pool in NHWC behind boundary transposes so the whole conv/BN/pool
+        # body stays NHWC internally: XLA cancels these against the
+        # neighbouring conv transposes, where an NCHW reduce_window between
+        # NHWC convs would force real relayouts (fwd and in the
+        # select-and-scatter backward).
+        out = jnp.transpose(
+            _pool(jnp.transpose(x, (0, 2, 3, 1)), *pool_args, 2, nhwc=True),
+            (0, 3, 1, 2))
+    else:
+        out = _pool(x, *pool_args, 2)
     return {"Out": [out]}
 
 

@@ -113,3 +113,42 @@ def test_conv_layout_nhwc_parity():
             fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
     np.testing.assert_allclose(outs["NCHW"], outs["NHWC"],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_conv_layout_nhwc_pool_parity():
+    """Under FLAGS_conv_layout=NHWC pool2d also pools channels-last behind
+    boundary transposes; the conv->maxpool->avgpool chain (fwd AND the
+    select-and-scatter backward, via one SGD step) matches NCHW."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = np.random.RandomState(1).randn(2, 3, 16, 16).astype("float32")
+
+    results = {}
+    for layout in ("NCHW", "NHWC"):
+        fluid.set_flags({"FLAGS_conv_layout": layout})
+        try:
+            fluid.reset_default_env()
+            img = layers.data("img", [3, 16, 16])
+            y = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                              param_attr=fluid.ParamAttr(
+                                  name=f"wp_{layout}",
+                                  initializer=fluid.initializer.Constant(0.1)))
+            y = layers.pool2d(y, pool_size=3, pool_type="max", pool_stride=2,
+                              pool_padding=1, ceil_mode=True)
+            y = layers.pool2d(y, pool_size=2, pool_type="avg", pool_stride=2,
+                              exclusive=True)
+            loss = layers.reduce_mean(y)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            out, = exe.run(feed={"img": x}, fetch_list=[y])
+            w, = exe.run(feed={"img": x}, fetch_list=[f"wp_{layout}"])
+            results[layout] = (np.asarray(out), np.asarray(w))
+        finally:
+            fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
+    np.testing.assert_allclose(results["NCHW"][0], results["NHWC"][0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(results["NCHW"][1], results["NHWC"][1],
+                               rtol=1e-5, atol=1e-5)

@@ -300,3 +300,83 @@ def test_zigzag_ring_grads():
     for a, b, name in zip(gz, gr, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
                                    err_msg=f"d{name}")
+
+
+# -- ulysses (all-to-all) sequence parallelism -------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    rng = np.random.default_rng(11)
+    B, H, S, D = 2, 4, 32, 8  # H=4 divisible by the 4-way sp axis
+    q, k, v = _rand_qkv(rng, B=B, H=H, S=S, D=D)
+
+    want = _reference_attention(q, k, v, causal, 1 / math.sqrt(D))
+    with mesh:
+        got = ulysses_sequence_parallel_attention(
+            mesh, q, k, v, axis="sp", causal=causal, batch_axis=None
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_attention_with_dp_axis():
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, axis_names=("dp", "sp"))
+    rng = np.random.default_rng(12)
+    q, k, v = _rand_qkv(rng, B=4, H=4, S=16, D=8)
+    want = _reference_attention(q, k, v, True, 1 / math.sqrt(8))
+    with mesh:
+        got = ulysses_sequence_parallel_attention(
+            mesh, q, k, v, axis="sp", causal=True, batch_axis="dp"
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_attention_grads():
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    rng = np.random.default_rng(13)
+    q, k, v = _rand_qkv(rng, B=1, H=4, S=16, D=4)
+
+    def loss(q, k, v):
+        with mesh:
+            out = ulysses_sequence_parallel_attention(
+                mesh, q, k, v, axis="sp", causal=True, batch_axis=None
+            )
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            _reference_attention(q, k, v, True, 1 / math.sqrt(4)) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ulysses_attention_head_divisibility_error():
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    rng = np.random.default_rng(14)
+    q, k, v = _rand_qkv(rng, B=1, H=3, S=16, D=4)  # 3 heads, 4-way axis
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_sequence_parallel_attention(mesh, q, k, v, axis="sp")
