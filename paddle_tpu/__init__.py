@@ -63,6 +63,7 @@ from . import recordio as recordio_writer  # noqa: F401
 from .core import backward  # noqa: F401
 from .tensor_shim import LoDTensor, LoDTensorArray, Tensor  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .transpiler import InferenceTranspiler  # noqa: F401
 from .transpiler import memory_optimize, release_memory  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
 from . import distributed  # noqa: F401

@@ -281,6 +281,7 @@ class Operator:
         inputs: Optional[Dict[str, Any]] = None,
         outputs: Optional[Dict[str, Any]] = None,
         attrs: Optional[Dict[str, Any]] = None,
+        infer: bool = True,
     ):
         self.block = block
         self.desc = desc
@@ -290,7 +291,7 @@ class Operator:
             desc.outputs = {k: _var_name_list(v) for k, v in outputs.items() if v is not None}
         if attrs:
             desc.attrs.update({k: v for k, v in attrs.items() if v is not None})
-        if OpRegistry.has(desc.type):
+        if infer and OpRegistry.has(desc.type):
             info = OpRegistry.get(desc.type)
             if info.infer_shape is not None:
                 info.infer_shape(desc, block)
@@ -348,7 +349,16 @@ class Block:
         self.program = program
         self.desc: BlockDesc = program.desc.block(idx)
         self.vars: Dict[str, Variable] = {}
-        self.ops: List[Operator] = []
+        # rebuild wrappers for descs that already carry ops (clone / prune /
+        # deserialized programs) so block.ops reflects the desc — the
+        # reference keeps the two in sync the same way (framework.py
+        # Program._copy_: each OpDesc gets an Operator shell).  infer=False:
+        # output shapes are already in the desc, and during Program.clone
+        # sibling blocks aren't rebuilt yet so cross-block lookups would
+        # resolve against a stale blocks list
+        self.ops: List[Operator] = [
+            Operator(self, d, infer=False) for d in self.desc.ops
+        ]
 
     @property
     def idx(self) -> int:

@@ -255,19 +255,22 @@ def zigzag_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
 # The ring moves K/V around the mesh P times; the all-to-all variant moves
 # the DATA LAYOUT instead: one all_to_all re-shards q/k/v from
 # sequence-sharded [B, H, S/P, D] to head-sharded [B, H/P, S, D], each
-# device runs ordinary full-sequence attention for its H/P heads, and a
-# second all_to_all restores sequence sharding.  Two collectives total
-# (vs P ppermute hops), at the cost of requiring H % P == 0 and holding the
-# full sequence for H/P heads (peak memory O(S * D * H/P) per chip vs the
-# ring's O(S/P * D * H)).  Pick per workload: many-head models with moderate
-# S favour all-to-all; extreme S favours the ring.
+# device runs full-sequence attention for its H/P heads BLOCKWISE over keys
+# (online softmax, block_k keys at a time), and a second all_to_all restores
+# sequence sharding.  Two collectives total (vs P ppermute hops), at the
+# cost of requiring H % P == 0 and holding q/k/v/o for the full sequence:
+# peak memory O(S * D * H/P + S * block_k * H/P) per chip vs the ring's
+# O(S/P * D * H) — the S x S score matrix is never materialized.  Pick per
+# workload: many-head models with moderate S favour all-to-all; extreme S
+# (where even O(S * D * H/P) activations overflow) favours the ring.
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None, block_k: int = 1024):
     """All-to-all sequence-parallel attention over `axis_name` (call under
     shard_map).  q/k/v: LOCAL sequence shards [B, H, S_local, D] with the
     GLOBAL head count H divisible by the axis size.  Returns the local
-    output shard [B, H, S_local, D]."""
+    output shard [B, H, S_local, D].  `block_k` bounds the score-matrix
+    working set ([.., S, block_k] per step)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     p = jax.lax.psum(1, axis_name)  # static axis size under shard_map
@@ -287,20 +290,46 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                                   tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-    if causal:
-        S = s.shape[-1]
-        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
-        s = jnp.where(mask, s, NEG_INF)
-    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vh.dtype), vh)
+    out = _blockwise_attention(qh, kh, vh, scale, causal, block_k)
     return to_seq(out).astype(q.dtype)
+
+
+def _blockwise_attention(q, k, v, scale, causal, block_k):
+    """Single-device attention with the online-softmax merge applied over
+    key blocks of size `block_k` — O(S * block_k) score working set instead
+    of the dense S x S matrix.  Shapes [B, H, S, D] (full sequence)."""
+    B, H, S, D = q.shape
+    bk = max(1, min(block_k, S))
+    nblocks = -(-S // bk)
+    pad = nblocks * bk - S
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    q_pos = jnp.arange(S)
+
+    def step(state, i):
+        ks = jax.lax.dynamic_slice_in_dim(kp, i * bk, bk, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vp, i * bk, bk, axis=2)
+        k_pos = i * bk + jnp.arange(bk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, ks).astype(jnp.float32) * scale
+        mask = k_pos[None, :] < S  # padded key slots never contribute
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (S, bk))
+        return _softmax_merge(state, s, vs, mask), None
+
+    acc0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    m0 = jnp.full((B, H, S, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), dtype=jnp.float32)
+    (acc, _, l), _ = jax.lax.scan(step, (acc0, m0, l0), jnp.arange(nblocks))
+    return acc / jnp.maximum(l, 1e-30)
 
 
 def ulysses_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
                                         causal: bool = False,
                                         scale: Optional[float] = None,
-                                        batch_axis: Optional[str] = "dp"):
+                                        batch_axis: Optional[str] = "dp",
+                                        block_k: int = 1024):
     """Global-view wrapper: q/k/v [B, H, S, D] with S sharded on `axis`;
     re-shards to heads via all_to_all, computes full attention per head
     group, and restores sequence sharding.  Requires H % mesh[axis] == 0."""
@@ -317,7 +346,7 @@ def ulysses_sequence_parallel_attention(mesh, q, k, v, axis: str = "sp",
     spec = P(b, None, axis, None)
 
     fn = functools.partial(ulysses_attention, axis_name=axis, causal=causal,
-                           scale=scale)
+                           scale=scale, block_k=block_k)
     return shard_map(
         fn, mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

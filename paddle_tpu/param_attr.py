@@ -36,7 +36,9 @@ class ParamAttr:
     def _to_attr(arg) -> Optional["ParamAttr"]:
         """Normalize user input: None/False/str/Initializer/ParamAttr
         (reference: param_attr.py ParamAttr._to_attr)."""
-        if arg is None:
+        if arg is None or arg is True:
+            # reference: param_attr.py:148 — bool True selects the default
+            # ParamAttr, False disables the parameter (e.g. bias_attr=False)
             return ParamAttr()
         if arg is False:
             return None

@@ -6,8 +6,10 @@
   reuse (memory_optimization_transpiler.py).  XLA's buffer assignment owns
   memory reuse end-to-end, so these validate args and return unchanged
   programs (kept for API parity).
-* InferenceTranspiler — the reference folds BN/scale into conv weights
-  (inference_transpiler.py); XLA's fusion subsumes it, identity here.
+* InferenceTranspiler — real conv+batch_norm fold (see
+  inference_transpiler.py in this package); the reference's MKLDNN-only
+  relu/eltwise fusion passes stay absent because XLA fuses those epilogues
+  itself.
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ from .distribute_transpiler import (  # noqa: F401
     DistributeTranspilerConfig,
     slice_variable,
 )
+from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
 
 __all__ = [
@@ -43,10 +46,3 @@ def release_memory(input_program, skip_opt_set=None):
     return None
 
 
-class InferenceTranspiler:
-    """reference: inference_transpiler.py InferenceTranspiler."""
-
-    def transpile(self, program, place, scope=None):
-        # conv+bn folding, relu fusion etc. are XLA fusions; the program is
-        # already inference-shaped after Program.clone(for_test=True)
-        return None

@@ -1,0 +1,118 @@
+"""InferenceTranspiler conv+batch_norm fold
+(reference: transpiler/inference_transpiler.py:300 _fuse_batch_norm,
+test analogue: the reference exercises the fold through
+test_inference_model_io / book image-classification inference runs).
+
+Trains a small convnet a few steps so the BN moving statistics are
+non-trivial, then checks the folded inference program (a) no longer
+contains batch_norm ops, (b) produces the same outputs, and (c) keeps
+residual-style multi-consumer conv outputs unfused."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _train_convnet(steps=3, with_bias=False, branchy=False):
+    x = layers.data("x", [3, 8, 8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    bias_attr = True if with_bias else False
+    c1 = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=bias_attr)
+    b1 = layers.batch_norm(c1)
+    h = layers.relu(b1)
+    if branchy:
+        # conv output consumed by BN *and* a residual add: must not fold
+        c2 = layers.conv2d(h, num_filters=4, filter_size=3, padding=1,
+                           bias_attr=False)
+        b2 = layers.batch_norm(c2)
+        h = layers.elementwise_add(layers.relu(b2), c2)
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    xv = rng.randn(4, 3, 8, 8).astype("float32")
+    yv = rng.randint(0, 3, size=(4, 1)).astype("int64")
+    for _ in range(steps):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    return exe, pred, xv
+
+
+def _bn_count(program):
+    return sum(op.type == "batch_norm" for op in program.global_block().ops)
+
+
+def _run_fold_case(with_bias):
+    exe, pred, xv = _train_convnet(with_bias=with_bias)
+    infer = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+
+    assert _bn_count(infer) == 1
+    t = fluid.InferenceTranspiler()
+    t.transpile(infer, fluid.CPUPlace())
+    assert _bn_count(infer) == 0
+    # the fold leaves one channel-bias add where the bn used to be (the fc
+    # layer contributes its own bias add; only the conv-side one matters)
+    conv_out = next(op for op in infer.global_block().ops
+                    if op.type == "conv2d").output("Output")[0]
+    adds = [op for op in infer.global_block().ops
+            if op.type == "elementwise_add" and conv_out in op.input("X")]
+    assert len(adds) == 1 and adds[0].attr("axis") == 1
+
+    (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fold_conv_without_bias():
+    _run_fold_case(with_bias=False)
+
+
+def test_fold_conv_with_bias():
+    _run_fold_case(with_bias=True)
+
+
+def test_multi_consumer_conv_not_folded():
+    exe, pred, xv = _train_convnet(branchy=True)
+    infer = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+
+    assert _bn_count(infer) == 2
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    # first conv folds; the residual conv (two consumers) must survive
+    assert _bn_count(infer) == 1
+
+    (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unused_bn_params_pruned_from_desc():
+    exe, pred, xv = _train_convnet()
+    infer = fluid.io.get_inference_program([pred])
+    block = infer.global_block()
+    bn_op = next(op for op in block.ops if op.type == "batch_norm")
+    stat_vars = [bn_op.input("Scale")[0], bn_op.input("Mean")[0],
+                 bn_op.input("Variance")[0]]
+    for name in stat_vars:
+        assert block.desc.has_var(name)
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    for name in stat_vars:
+        assert not block.desc.has_var(name)
+
+
+def test_protected_fetch_target_not_folded():
+    """A conv output that is itself a fetch target must keep its values:
+    passing it via protected_vars disqualifies the fold."""
+    exe, pred, xv = _train_convnet()
+    infer = fluid.io.get_inference_program([pred])
+    conv_out = next(op for op in infer.global_block().ops
+                    if op.type == "conv2d").output("Output")[0]
+    fluid.InferenceTranspiler().transpile(
+        infer, fluid.CPUPlace(), protected_vars=[conv_out])
+    assert _bn_count(infer) == 1  # fold skipped

@@ -380,3 +380,55 @@ def test_ulysses_attention_head_divisibility_error():
     q, k, v = _rand_qkv(rng, B=1, H=3, S=16, D=4)  # 3 heads, 4-way axis
     with pytest.raises(ValueError, match="divisible"):
         ulysses_sequence_parallel_attention(mesh, q, k, v, axis="sp")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_blockwise_key_blocks(causal):
+    """block_k smaller than (and not dividing) the sequence exercises the
+    online-softmax block loop and the padded final key block."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    rng = np.random.default_rng(14)
+    B, H, S, D = 2, 4, 24, 8  # S=24 with block_k=7 -> 4 blocks, 4 pad slots
+    q, k, v = _rand_qkv(rng, B=B, H=H, S=S, D=D)
+
+    want = _reference_attention(q, k, v, causal, 1 / math.sqrt(D))
+    with mesh:
+        got = ulysses_sequence_parallel_attention(
+            mesh, q, k, v, axis="sp", causal=causal, batch_axis=None,
+            block_k=7,
+        )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_blockwise_grads():
+    from jax.sharding import Mesh
+
+    from paddle_tpu.longcontext import ulysses_sequence_parallel_attention
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, axis_names=("sp",))
+    rng = np.random.default_rng(15)
+    q, k, v = _rand_qkv(rng, B=1, H=4, S=16, D=4)
+
+    def loss(q, k, v):
+        with mesh:
+            out = ulysses_sequence_parallel_attention(
+                mesh, q, k, v, axis="sp", causal=True, batch_axis=None,
+                block_k=5,
+            )
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            _reference_attention(q, k, v, True, 1 / math.sqrt(4)) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
