@@ -418,14 +418,19 @@ def _tune_and_run(model: str, steps: int, peak_flops: float) -> dict:
     """Probe amp-tier x conv-layout combos on a few steps, then run the
     full measurement with the winner.  Every probe is recorded so the
     round artifact keeps the comparison (VERDICT r2 task 1)."""
-    combos = [("1", "NCHW"), ("keep", "NCHW")]
+    # probe the historically-winning config FIRST (r3 on-chip sweep: keep
+    # tier beat conservative AMP on every model, NHWC beat NCHW for convs)
+    # so a budget expiry or a hung later probe still leaves the best-known
+    # config measured and picked
     if model in CONV_MODELS:
-        combos += [("1", "NHWC"), ("keep", "NHWC")]
+        combos = [("keep", "NHWC"), ("keep", "NCHW"),
+                  ("1", "NHWC"), ("1", "NCHW")]
+    else:
+        combos = [("keep", "NCHW"), ("1", "NCHW")]
     probe_steps = int(os.environ.get("BENCH_TUNE_STEPS", "5"))
     # wall-clock budget for probing (each probe pays a fresh compile);
-    # when exceeded, remaining combos are skipped and the best-so-far
-    # runs — the first combo is the default config, so a tight budget
-    # degrades to the untuned behavior, never to a dead artifact
+    # when exceeded, remaining combos are skipped and the best PROBED
+    # config runs — never a dead artifact
     budget = float(os.environ.get("BENCH_TUNE_BUDGET_S", "600"))
     t0 = time.perf_counter()
     probes = {}
@@ -520,6 +525,21 @@ def _arm_deadline(state: dict) -> None:
 
 
 def main() -> None:
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
+        # persistent executable cache: tune probes, the final timed run and
+        # repeated driver invocations share compiles across processes.  If
+        # the backend's PJRT plugin can't serialize executables jax logs
+        # and skips caching — never fatal.
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/jax_bench_cache"),
+            )
+        except Exception:
+            pass
     peak_flops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     names = os.environ.get(

@@ -74,6 +74,7 @@ from .core.enforce import EnforceNotMet  # noqa: F401
 from . import distribute_lookup_table  # noqa: F401
 from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dataset  # noqa: F401
+from . import executor  # noqa: F401
 from . import io  # noqa: F401
 from . import reader  # noqa: F401
 from . import recordio  # noqa: F401

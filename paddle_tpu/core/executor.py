@@ -484,3 +484,33 @@ class Executor:
         if not return_numpy:
             return val
         return restore(np.asarray(val), var_desc)
+
+
+def as_numpy(value):
+    """reference: executor.py:66 as_numpy — convert a fetched value (array,
+    LoDTensor shim, or LoDValue) to numpy.  Values carrying LoD raise, as
+    the reference does, because offsets would be lost silently."""
+    if isinstance(value, (list, tuple)):
+        return [as_numpy(v) for v in value]
+    lod = getattr(value, "lod", None)
+    if isinstance(value, LoDValue) or (callable(lod) and lod()):
+        raise RuntimeError(
+            "Some of your fetched tensors hold LoD information. They can "
+            "not be completely cast to Python ndarray. Please set the "
+            "parameter 'return_numpy' as 'False' to return LoDTensor itself "
+            "directly.")
+    return np.asarray(value)
+
+
+def _fetch_var(name, scope=None, return_numpy=True):
+    """reference: executor.py:174 _fetch_var — read one (typically
+    persistable) variable's current value straight from a scope."""
+    assert isinstance(name, str)
+    if scope is None:
+        scope = global_scope()
+    val = scope.find_var(name)
+    assert val is not None, (
+        "Cannot find " + name + " in scope. Perhaps you need to make the"
+        " variable persistable by using var.persistable = True in your"
+        " program.")
+    return Executor._convert_fetch(val, None, return_numpy)

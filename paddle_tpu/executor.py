@@ -1,0 +1,8 @@
+"""fluid.executor module facade (reference: python/paddle/fluid/executor.py
+exposes Executor, global_scope/scope_guard, as_numpy and _fetch_var from one
+module; user code imports them from `fluid.executor`)."""
+
+from .core.executor import Executor, as_numpy, _fetch_var  # noqa: F401
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+
+__all__ = ["Executor", "as_numpy", "global_scope", "scope_guard"]

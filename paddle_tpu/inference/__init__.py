@@ -52,8 +52,11 @@ class NativeConfig:
 
 @dataclasses.dataclass
 class AnalysisConfig(NativeConfig):
-    """reference: paddle_api.h AnalysisConfig.  The ir-pass/TensorRT knobs
-    are accepted and recorded; XLA owns all fusion."""
+    """reference: paddle_api.h AnalysisConfig.  enable_ir_optim runs the
+    host-side conv+BN weight fold (InferenceTranspiler) at predictor build
+    — the TPU analogue of the reference's Analyzer ir-pass pipeline
+    (analysis_predictor.cc OptimizeInferenceProgram); elementwise/relu
+    fusions stay with XLA.  The TensorRT knobs are accepted and recorded."""
 
     enable_ir_optim: bool = True
     use_feed_fetch_ops: bool = False
@@ -109,6 +112,16 @@ class PaddlePredictor:
             )
         )
         self._fetch_names = [t.name for t in self.fetch_targets]
+
+        if getattr(config, "enable_ir_optim", False):
+            from ..transpiler import InferenceTranspiler
+
+            # fetch targets are protected: folding rewrites conv outputs'
+            # values, which is only sound for internal intermediates
+            InferenceTranspiler().transpile(
+                self.program, self.place, scope=self.scope,
+                protected_vars=self._fetch_names,
+            )
 
     # -- reference PaddleTensor API ------------------------------------
     def run(self, inputs: Sequence[PaddleTensor], batch_size: int = -1):

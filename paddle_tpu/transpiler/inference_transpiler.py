@@ -106,17 +106,28 @@ class InferenceTranspiler:
             conv_out = op.output("Output")[0]
             if conv_out in protected:
                 continue
+            # the fold rewrites the Filter's (and the adopted bias var's)
+            # VALUE in the scope, so a parameter shared with any other op
+            # (weight-tied convs, a Bias shared across batch_norms) must
+            # disqualify the fold — each fold would scale the shared
+            # tensor again, silently corrupting the other reader
+            if len(all_consumers(op.input("Filter")[0])) != 1:
+                continue
             consumers = all_consumers(conv_out)
             if len(consumers) != 1 or consumers[0][0] is None:
                 continue
             j, nxt = consumers[0]
             if nxt.type == "batch_norm" and nxt.input("X") == [conv_out]:
+                if len(all_consumers(nxt.input("Bias")[0])) != 1:
+                    continue  # bn Bias shared with another op
                 self._fold(block, scope, op, bn_idx=j, bias_op=None)
                 continue
             if nxt.type == "elementwise_add" and nxt.attr("axis", -1) == 1:
                 bias_name = nxt.input("Y")[0]
                 if not self._is_channel_bias(block, bias_name):
                     continue
+                if len(all_consumers(bias_name)) != 1:
+                    continue  # conv bias shared with another op
                 add_out = nxt.output("Out")[0]
                 if add_out in protected:
                     continue

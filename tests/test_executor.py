@@ -373,3 +373,27 @@ def test_run_steps_with_scheduler_and_dropout():
         got = np.asarray(scope.find_var(n))
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
                                    err_msg=f"state {n} diverged")
+
+
+def test_fetch_var_reads_persistable():
+    """reference: test_fetch_var.py — _fetch_var reads a persistable var's
+    current value straight from the scope."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    val = np.array([1, 3, 5]).astype("int32")
+    x = layers.create_tensor(dtype="int32", persistable=True, name="x")
+    layers.assign(input=val, output=x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_main_program(), feed={}, fetch_list=[])
+    got = fluid.executor._fetch_var("x")
+    np.testing.assert_array_equal(got, val)
+
+    # module facade parity: as_numpy refuses LoD-carrying values
+    from paddle_tpu.core.lod import LoDValue
+    lv = LoDValue(np.zeros((3, 2), "float32"), np.array([2, 1]), ())
+    try:
+        fluid.executor.as_numpy(lv)
+        raise AssertionError("expected RuntimeError for LoD value")
+    except RuntimeError:
+        pass

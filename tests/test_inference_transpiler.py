@@ -116,3 +116,58 @@ def test_protected_fetch_target_not_folded():
     fluid.InferenceTranspiler().transpile(
         infer, fluid.CPUPlace(), protected_vars=[conv_out])
     assert _bn_count(infer) == 1  # fold skipped
+
+
+def test_analysis_predictor_applies_fold(tmp_path):
+    """AnalysisPredictor with enable_ir_optim folds BN at build time and
+    still matches the unoptimized NativePredictor (reference analogue:
+    AnalysisPredictor::OptimizeInferenceProgram)."""
+    from paddle_tpu.inference import (AnalysisConfig, NativeConfig,
+                                      create_paddle_predictor)
+
+    exe, pred, xv = _train_convnet()
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+
+    native = create_paddle_predictor(NativeConfig(model_dir=d))
+    (ref,) = native.run_dict({"x": xv})
+    assert _bn_count(native.program) == 1
+
+    analysis = create_paddle_predictor(AnalysisConfig(model_dir=d))
+    assert _bn_count(analysis.program) == 0
+    (out,) = analysis.run_dict({"x": xv})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weight_shared_filter_not_folded():
+    """Two convs sharing one Filter parameter, each followed by its own BN:
+    folding either would rescale the shared tensor twice — both must be
+    skipped."""
+    x = layers.data("x", [3, 8, 8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    shared = fluid.ParamAttr(name="shared_w")
+    c1 = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=False, param_attr=shared)
+    c2 = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                       bias_attr=False, param_attr=shared)
+    h = layers.elementwise_add(layers.batch_norm(c1), layers.batch_norm(c2))
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(9)
+    xv = rng.randn(4, 3, 8, 8).astype("float32")
+    yv = rng.randint(0, 3, size=(4, 1)).astype("int64")
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+    infer = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    assert _bn_count(infer) == 2  # neither fold may run
+    (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)

@@ -1,0 +1,281 @@
+"""Differential verification of the MXU-critical op lowerings against
+torch (CPU) as a second INDEPENDENT reference implementation.
+
+The numeric sweeps (tests/test_op_sweep_*.py) check each op against a
+hand-written numpy reference; these tests cross-check the heavyweight
+fwd+bwd paths — conv2d (plain / strided / grouped / dilated), pool2d,
+batch_norm (train and eval), layer_norm, and softmax_with_cross_entropy —
+against torch.nn.functional, catching any bias shared between our lowering
+and our numpy references (reference analogues: test_conv2d_op.py,
+test_batch_norm_op.py etc., which trusted the C++ CPU kernel the same way).
+
+Everything runs through the full Program -> compiler -> Executor path, not
+direct jnp calls: parameters are overwritten in the scope post-startup, and
+gradients come from append_backward, so autodiff is exercised too.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.backward import append_backward
+
+
+def _run_program(feeds, fetch, param_overrides=None, grad_of=None):
+    """Build already happened in the caller's default program; run startup,
+    override params, run main fetching `fetch` (+ gradients of grad_of)."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.executor.global_scope()
+    for name, val in (param_overrides or {}).items():
+        scope.set_var(name, np.asarray(val))
+    outs = exe.run(feed=feeds, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+def test_conv2d_forward_and_grads_vs_torch():
+    rng = np.random.RandomState(0)
+    for stride, padding, groups, dilation in [
+        (1, 1, 1, 1), (2, 0, 1, 1), (1, 2, 2, 1), (1, 2, 1, 2),
+    ]:
+        fluid.reset_default_env()
+        N, C, H, W = 2, 4, 9, 9
+        K, ks = 6, 3
+        xv = rng.randn(N, C, H, W).astype("float32")
+        wv = rng.randn(K, C // groups, ks, ks).astype("float32")
+
+        x = layers.data("x", [C, H, W], dtype="float32")
+        x.stop_gradient = False
+        out = layers.conv2d(x, num_filters=K, filter_size=ks, stride=stride,
+                            padding=padding, groups=groups, dilation=dilation,
+                            bias_attr=False)
+        loss = layers.reduce_sum(layers.square(out))
+        pmap = append_backward(loss)
+        w_name = next(p.name for p, _ in pmap)
+        grads = [f"{w_name}@GRAD", f"{x.name}@GRAD"]
+        got, gw, gx = _run_program(
+            {"x": xv}, [out, *grads], param_overrides={w_name: wv},
+        )
+
+        xt = torch.tensor(xv, requires_grad=True)
+        wt = torch.tensor(wv, requires_grad=True)
+        ot = torch.nn.functional.conv2d(
+            xt, wt, stride=stride, padding=padding, groups=groups,
+            dilation=dilation)
+        (ot ** 2).sum().backward()
+        cfg = f"s={stride},p={padding},g={groups},d={dilation}"
+        np.testing.assert_allclose(got, ot.detach().numpy(), rtol=2e-4,
+                                   atol=2e-4, err_msg=cfg)
+        np.testing.assert_allclose(gw, wt.grad.numpy(), rtol=2e-3,
+                                   atol=2e-3, err_msg=cfg + " dW")
+        np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=2e-3,
+                                   atol=2e-3, err_msg=cfg + " dX")
+
+
+def test_pool2d_forward_and_grad_vs_torch():
+    rng = np.random.RandomState(1)
+    N, C, H, W = 2, 3, 8, 8
+    xv = rng.randn(N, C, H, W).astype("float32")
+    for ptype, exclusive in [("max", True), ("avg", True), ("avg", False)]:
+        fluid.reset_default_env()
+        x = layers.data("x", [C, H, W], dtype="float32")
+        x.stop_gradient = False
+        out = layers.pool2d(x, pool_size=3, pool_type=ptype, pool_stride=2,
+                            pool_padding=1, exclusive=exclusive)
+        loss = layers.reduce_sum(layers.square(out))
+        append_backward(loss)
+        got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"])
+
+        xt = torch.tensor(xv, requires_grad=True)
+        if ptype == "max":
+            ot = torch.nn.functional.max_pool2d(xt, 3, stride=2, padding=1)
+        else:
+            # fluid exclusive=True == torch count_include_pad=False
+            ot = torch.nn.functional.avg_pool2d(
+                xt, 3, stride=2, padding=1, count_include_pad=not exclusive)
+        (ot ** 2).sum().backward()
+        cfg = f"{ptype},excl={exclusive}"
+        np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-5,
+                                   atol=1e-5, err_msg=cfg)
+        np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4,
+                                   atol=1e-4, err_msg=cfg + " dX")
+
+
+@pytest.mark.parametrize("is_test", [False, True])
+def test_batch_norm_vs_torch(is_test):
+    rng = np.random.RandomState(2)
+    N, C, H, W = 4, 5, 6, 6
+    xv = rng.randn(N, C, H, W).astype("float32")
+    scale = rng.rand(C).astype("float32") + 0.5
+    bias = rng.randn(C).astype("float32")
+    r_mean = rng.randn(C).astype("float32")
+    r_var = rng.rand(C).astype("float32") + 0.5
+
+    fluid.reset_default_env()
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.batch_norm(x, is_test=is_test, momentum=0.9, epsilon=1e-5)
+    bn_op = next(op for op in fluid.default_main_program().global_block().ops
+                 if op.type == "batch_norm")
+    names = {s: bn_op.input(s)[0] for s in ("Scale", "Bias", "Mean", "Variance")}
+    overrides = {names["Scale"]: scale, names["Bias"]: bias,
+                 names["Mean"]: r_mean, names["Variance"]: r_var}
+    fetch = [out]
+    if not is_test:
+        loss = layers.reduce_sum(layers.square(out))
+        append_backward(loss)
+        fetch += [f"{x.name}@GRAD"]
+    outs = _run_program({"x": xv}, fetch, param_overrides=overrides)
+
+    xt = torch.tensor(xv, requires_grad=not is_test)
+    ot = torch.nn.functional.batch_norm(
+        xt, torch.tensor(r_mean), torch.tensor(r_var),
+        weight=torch.tensor(scale), bias=torch.tensor(bias),
+        training=not is_test, momentum=0.1, eps=1e-5)
+    np.testing.assert_allclose(outs[0], ot.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+    if not is_test:
+        (ot ** 2).sum().backward()
+        np.testing.assert_allclose(outs[1], xt.grad.numpy(), rtol=1e-3,
+                                   atol=1e-3)
+
+
+def test_layer_norm_vs_torch():
+    rng = np.random.RandomState(3)
+    N, D = 4, 12
+    xv = rng.randn(N, D).astype("float32")
+    scale = rng.rand(D).astype("float32") + 0.5
+    bias = rng.randn(D).astype("float32")
+
+    x = layers.data("x", [D], dtype="float32")
+    x.stop_gradient = False
+    out = layers.layer_norm(x, begin_norm_axis=1, epsilon=1e-5)
+    ln_op = next(op for op in fluid.default_main_program().global_block().ops
+                 if op.type == "layer_norm")
+    overrides = {ln_op.input("Scale")[0]: scale, ln_op.input("Bias")[0]: bias}
+    loss = layers.reduce_sum(layers.square(out))
+    append_backward(loss)
+    got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"],
+                           param_overrides=overrides)
+
+    xt = torch.tensor(xv, requires_grad=True)
+    ot = torch.nn.functional.layer_norm(
+        xt, (D,), weight=torch.tensor(scale), bias=torch.tensor(bias),
+        eps=1e-5)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_softmax_with_cross_entropy_vs_torch():
+    rng = np.random.RandomState(4)
+    N, K = 8, 10
+    xv = (rng.randn(N, K) * 3).astype("float32")
+    yv = rng.randint(0, K, (N, 1)).astype("int64")
+
+    x = layers.data("x", [K], dtype="float32")
+    x.stop_gradient = False
+    y = layers.data("y", [1], dtype="int64")
+    loss_vec = layers.softmax_with_cross_entropy(x, y)
+    loss = layers.reduce_mean(loss_vec)
+    append_backward(loss)
+    got, gx = _run_program({"x": xv, "y": yv}, [loss_vec, f"{x.name}@GRAD"])
+
+    xt = torch.tensor(xv, requires_grad=True)
+    lt = torch.nn.functional.cross_entropy(
+        xt, torch.tensor(yv.reshape(-1)), reduction="none")
+    lt.mean().backward()
+    np.testing.assert_allclose(got.reshape(-1), lt.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_transpose_vs_torch():
+    rng = np.random.RandomState(5)
+    N, C, H, W = 2, 4, 7, 7
+    K, ks = 3, 3
+    xv = rng.randn(N, C, H, W).astype("float32")
+    wv = rng.randn(C, K, ks, ks).astype("float32")  # fluid/torch: [Cin, Cout, kh, kw]
+
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.conv2d_transpose(x, num_filters=K, filter_size=ks, stride=2,
+                                  padding=1, bias_attr=False)
+    loss = layers.reduce_sum(layers.square(out))
+    pmap = append_backward(loss)
+    w_name = next(p.name for p, _ in pmap)
+    got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"],
+                           param_overrides={w_name: wv})
+
+    xt = torch.tensor(xv, requires_grad=True)
+    ot = torch.nn.functional.conv_transpose2d(
+        xt, torch.tensor(wv), stride=2, padding=1)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=2e-3, atol=2e-3)
+
+
+def test_dynamic_lstm_vs_torch():
+    """The `lstm` op against torch.nn.LSTM: fluid feeds pre-projected 4H
+    inputs with gate blocks ordered [c, i, f, o]; torch stacks [i, f, g, o].
+    W_ih is set to the block permutation so torch consumes the same
+    projections (reference gate order: operators/lstm_op.cc kernel;
+    peepholes off, which torch has no equivalent for)."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(6)
+    hid = 5
+    lens = [4, 2, 3]  # variable-length batch exercises the LoD mask path
+    seqs = [rng.randn(t, 4 * hid).astype("float32") for t in lens]
+    flat = np.concatenate(seqs, axis=0)
+    w = (rng.randn(hid, 4 * hid) * 0.5).astype("float32")
+    b = (rng.randn(1, 4 * hid) * 0.5).astype("float32")
+
+    # fluid block order [c, i, f, o] -> torch row order [i, f, g(c), o]
+    perm = np.r_[hid:2 * hid, 2 * hid:3 * hid, 0:hid, 3 * hid:4 * hid]
+    lstm = torch.nn.LSTM(input_size=4 * hid, hidden_size=hid)
+    with torch.no_grad():
+        lstm.weight_ih_l0.copy_(torch.tensor(np.eye(4 * hid, dtype="float32")[perm]))
+        lstm.weight_hh_l0.copy_(torch.tensor(w.T[perm]))
+        lstm.bias_ih_l0.copy_(torch.tensor(b.reshape(-1)[perm]))
+        lstm.bias_hh_l0.zero_()
+
+    want_h, want_c = [], []
+    for s in seqs:
+        with torch.no_grad():
+            h_seq, (h_T, c_T) = lstm(torch.tensor(s).unsqueeze(1))
+        want_h.append(h_seq.squeeze(1).numpy())
+        # torch only exposes the final cell state; recompute the per-step
+        # cells by stepping the cell manually for the Cell output check
+        cell = torch.nn.LSTMCell(4 * hid, hid)
+        with torch.no_grad():
+            cell.weight_ih.copy_(lstm.weight_ih_l0)
+            cell.weight_hh.copy_(lstm.weight_hh_l0)
+            cell.bias_ih.copy_(lstm.bias_ih_l0)
+            cell.bias_hh.copy_(lstm.bias_hh_l0)
+            hx = torch.zeros(1, hid)
+            cx = torch.zeros(1, hid)
+            cs = []
+            for t in range(s.shape[0]):
+                hx, cx = cell(torch.tensor(s[t:t + 1]), (hx, cx))
+                cs.append(cx.numpy()[0])
+        want_c.append(np.stack(cs))
+        np.testing.assert_allclose(hx.numpy(), h_T.squeeze(0).numpy(),
+                                   atol=1e-6)  # cell replay sanity
+
+    class T(OpTest):
+        op_type = "lstm"
+
+    t = T()
+    t.inputs = {"Input": (flat, lens), "Weight": w, "Bias": b}
+    t.attrs = {"use_peepholes": False}
+    t.outputs = {
+        "Hidden": (np.concatenate(want_h), lens),
+        "Cell": (np.concatenate(want_c), lens),
+        "BatchGate": None,
+        "BatchCellPreAct": None,
+    }
+    t.check_output(atol=2e-5, rtol=2e-5)
