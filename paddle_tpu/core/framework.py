@@ -592,6 +592,7 @@ def reset_default_env() -> None:
     switch_startup_program(Program())
     scope_mod._current_scope = scope_mod.Scope()
     _NAME_SCOPE_COUNTS.clear()
+    unique_name_switch()  # fresh name counters: fc_0, conv2d_0, ... again
 
 
 @contextlib.contextmanager

@@ -587,14 +587,44 @@ def _interp_infer(op, block):
 
 
 def _interp(ctx, ins, attrs, method):
+    """Reference: operators/interpolate_op.h:171 — ratio = (in-1)/(out-1)
+    (align-corners sampling; the snapshot predates the align_corners attr),
+    bilinear lerps the floor/ceil neighbours, nearest rounds ratio*k+0.5.
+    jax.image.resize is NOT equivalent (half-pixel centers), so the
+    gathers are explicit."""
     x = data(ins["X"][0])
     oh, ow = attrs.get("out_h"), attrs.get("out_w")
     out_size = ins.get("OutSize", [None])[0]
     if out_size is not None:
         sz = np.asarray(out_size).reshape(-1)
         oh, ow = int(sz[0]), int(sz[1])
-    n, c = x.shape[:2]
-    out = jax.image.resize(x, (n, c, oh, ow), method=method)
+    ih, iw = x.shape[2], x.shape[3]
+
+    def ratio(i, o):
+        return (i - 1) / (o - 1) if o > 1 else 0.0
+
+    rh, rw = ratio(ih, oh), ratio(iw, ow)
+    if method == "nearest":
+        idx_h = np.floor(rh * np.arange(oh) + 0.5).astype(np.int32)
+        idx_w = np.floor(rw * np.arange(ow) + 0.5).astype(np.int32)
+        out = x[:, :, idx_h.clip(0, ih - 1)][:, :, :, idx_w.clip(0, iw - 1)]
+        return {"Out": [out]}
+
+    src_h = rh * np.arange(oh)
+    src_w = rw * np.arange(ow)
+    lo_h = np.floor(src_h).astype(np.int32).clip(0, ih - 1)
+    lo_w = np.floor(src_w).astype(np.int32).clip(0, iw - 1)
+    hi_h = np.minimum(lo_h + 1, ih - 1)
+    hi_w = np.minimum(lo_w + 1, iw - 1)
+    wh = jnp.asarray((src_h - lo_h).astype(np.float32)).reshape(1, 1, -1, 1)
+    ww = jnp.asarray((src_w - lo_w).astype(np.float32)).reshape(1, 1, 1, -1)
+    tl = x[:, :, lo_h][:, :, :, lo_w]
+    tr = x[:, :, lo_h][:, :, :, hi_w]
+    bl = x[:, :, hi_h][:, :, :, lo_w]
+    br = x[:, :, hi_h][:, :, :, hi_w]
+    top = tl * (1.0 - ww) + tr * ww
+    bot = bl * (1.0 - ww) + br * ww
+    out = (top * (1.0 - wh) + bot * wh).astype(x.dtype)
     return {"Out": [out]}
 
 

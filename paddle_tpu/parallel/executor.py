@@ -180,12 +180,18 @@ class ParallelExecutor:
             self._cache[key] = entry
         _, compiled, plan = entry
 
+        from .multihost import global_feed_value, is_multiprocess
+
         block0 = self.program.desc.block(0)
         feed_vals = plan.feed_values(feed, block0)
+        if not is_multiprocess(self.mesh):
+            # multihost feeds are per-process shards assembled into the
+            # global array below — their local dim 0 is a fraction of the
+            # dp axis, so the single-process divisibility contract does
+            # not apply
+            self._check_batch_divisible(plan.feed_names, feed_vals, block0)
         state_vals = plan.state_values(self.scope, block0)
         rng = plan.rng_value(self.scope, self.program)
-
-        from .multihost import global_feed_value, is_multiprocess
 
         if is_multiprocess(self.mesh):
             # each process feeds ITS batch shard; jax assembles the global
@@ -203,6 +209,34 @@ class ParallelExecutor:
 
         _check_nan_inf(plan, fetches, new_states)
         return plan.convert_fetches(fetches, block0, return_numpy)
+
+    def _check_batch_divisible(self, feed_names, feed_vals, block0) -> None:
+        """A batch-sharded feed whose dim 0 isn't divisible by the dp axis
+        would die inside pjit with a sharding ValueError; raise the
+        framework-level message first.  The reference redistributed uneven
+        tail batches at run time (data_balance_op_handle.cc) because its
+        per-device graphs took ragged sizes; XLA's static shapes make the
+        even-batch contract explicit instead — pad or trim the tail batch
+        (reader decorators `batch(..., drop_last=True)` do this)."""
+        axis = self.sharding_strategy.batch_axis
+        dp = self.mesh.axis_size(axis) if axis else 1
+        if dp <= 1:
+            return
+        for name, val in zip(feed_names, feed_vals):
+            sh = self._feed_sharding(name, block0)
+            spec = getattr(sh, "spec", None)
+            if not spec or spec[0] != axis:
+                continue
+            data = getattr(val, "data", val)
+            n = np.shape(data)[0] if np.ndim(data) else 0
+            if n % dp:
+                raise ValueError(
+                    f"feed '{name}' batch size {n} is not divisible by the "
+                    f"'{axis}' mesh axis ({dp} devices); SPMD batch "
+                    f"sharding needs equal per-device shards — pad or drop "
+                    f"the tail batch (e.g. paddle_tpu.reader decorators "
+                    f"batch(..., drop_last=True))"
+                )
 
     def drop_local_exe_scopes(self):  # reference API; scopes are XLA-owned
         pass

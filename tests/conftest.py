@@ -32,7 +32,12 @@ def _fresh_programs():
     prev_startup = framework.switch_startup_program(fluid.Program())
     prev_scope = scope_mod._current_scope
     scope_mod._current_scope = scope_mod.Scope()
+    # fresh name counters too: generated names (fc_0.w_0, ...) must not
+    # depend on how many layers earlier tests built — string-sorted name
+    # lookups go wrong once a counter crosses 10 (fc_10 < fc_9)
+    prev_names = framework.unique_name_switch()
     yield
+    framework.unique_name_switch(prev_names)
     framework.switch_main_program(prev_main)
     framework.switch_startup_program(prev_startup)
     scope_mod._current_scope = prev_scope

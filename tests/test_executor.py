@@ -397,3 +397,33 @@ def test_fetch_var_reads_persistable():
         raise AssertionError("expected RuntimeError for LoD value")
     except RuntimeError:
         pass
+
+
+def test_seeded_training_is_deterministic():
+    """Same program.random_seed => bitwise-identical init, dropout stream,
+    and loss trajectory across two from-scratch runs (the reference's
+    FLAGS_cpu_deterministic / random_seed contract)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    def run_once():
+        fluid.reset_default_env()
+        fluid.default_main_program().random_seed = 42
+        fluid.default_startup_program().random_seed = 42
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.dropout(layers.fc(x, size=16, act="relu"),
+                           dropout_prob=0.3)
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        xv = rng.randn(16, 8).astype("float32")
+        yv = rng.randn(16, 1).astype("float32")
+        return [np.asarray(exe.run(feed={"x": xv, "y": yv},
+                                   fetch_list=[loss])[0]).item()
+                for _ in range(4)]
+
+    assert run_once() == run_once()

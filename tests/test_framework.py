@@ -82,3 +82,28 @@ def test_unregistered_op_raises():
     exe = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(NotImplementedError):
         exe.run(prog, fetch_list=["z"])
+
+
+def test_shared_parameter_gradient_accumulates():
+    """A parameter consumed by two ops gets the SUM of both uses' grads
+    (reference: backward.py _addup_repetitive_outputs_).  loss = x*W*W with
+    x=2, W=3: dL/dW = 2*x*W = 12."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.backward import append_backward
+
+    x = layers.data("x", [1], dtype="float32")
+    x.stop_gradient = False
+    shared = fluid.ParamAttr(name="W_shared_grad_test")
+    h = layers.fc(x, size=1, param_attr=shared, bias_attr=False)
+    out = layers.fc(h, size=1, param_attr=shared, bias_attr=False)
+    loss = layers.reduce_sum(out)
+    append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.executor.global_scope().set_var(
+        "W_shared_grad_test", np.array([[3.0]], dtype="float32"))
+    outs = exe.run(feed={"x": np.array([[2.0]], dtype="float32")},
+                   fetch_list=[loss, "W_shared_grad_test@GRAD"])
+    np.testing.assert_allclose(np.asarray(outs[0]), [18.0])
+    np.testing.assert_allclose(np.asarray(outs[1]), [[12.0]])

@@ -161,10 +161,30 @@ def test_bilinear_interp_preserves_constant():
            {"out_h": 6, "out_w": 6})
     t.check_output(atol=1e-5)
     xg = _rand((1, 1, 4, 4), 21)
-    want = np.asarray(
-        __import__("jax").image.resize(xg, (1, 1, 8, 8), "bilinear"))
+    # independent align-corners reference (interpolate_op.h:171 ratio math)
+    def _ref_bilinear(x, oh, ow):
+        _, _, ih, iw = x.shape
+        rh = (ih - 1) / (oh - 1)
+        rw = (iw - 1) / (ow - 1)
+        out = np.zeros(x.shape[:2] + (oh, ow), dtype=np.float64)
+        for k in range(oh):
+            for l in range(ow):
+                sh, sw = rh * k, rw * l
+                h0, w0 = int(sh), int(sw)
+                h1, w1 = min(h0 + 1, ih - 1), min(w0 + 1, iw - 1)
+                dh, dw = sh - h0, sw - w0
+                out[..., k, l] = (
+                    x[..., h0, w0] * (1 - dh) * (1 - dw)
+                    + x[..., h0, w1] * (1 - dh) * dw
+                    + x[..., h1, w0] * dh * (1 - dw)
+                    + x[..., h1, w1] * dh * dw
+                )
+        return out.astype("float32")
+
+    want = _ref_bilinear(xg, 8, 8)
     t = _t("bilinear_interp", {"X": xg}, {"Out": want},
            {"out_h": 8, "out_w": 8})
+    t.check_output(atol=1e-5, rtol=1e-5)
     t.check_grad(["X"], "Out", max_relative_error=0.03)
 
 

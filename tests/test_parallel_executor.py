@@ -3,6 +3,7 @@
 unittests/parallel_executor_test_base.py, test_parallel_executor_mnist.py)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.parallel import make_mesh
@@ -102,3 +103,26 @@ def test_tensor_parallel_sharded_param():
         for _ in range(3)
     ]
     assert losses[-1] < losses[0]
+
+
+def test_indivisible_batch_raises_clear_error():
+    """A 10-row batch over an 8-way dp mesh must fail with the framework's
+    even-shard message, not a raw pjit sharding ValueError (reference
+    analogue: data_balance redistributing uneven tail batches,
+    details/data_balance_op_handle.cc)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name)
+    rng = np.random.RandomState(0)
+    with pytest.raises(ValueError, match="not divisible by the 'dp' mesh"):
+        pe.run(feed={"x": rng.randn(10, 4).astype("float32"),
+                     "y": rng.randn(10, 1).astype("float32")},
+               fetch_list=[loss.name])

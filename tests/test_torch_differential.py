@@ -279,3 +279,90 @@ def test_dynamic_lstm_vs_torch():
         "BatchCellPreAct": None,
     }
     t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_bilinear_interp_vs_torch():
+    """bilinear_interp uses the reference's (in-1)/(out-1) align-corners
+    ratio (interpolate_op.h:171) == torch align_corners=True.  Covers both
+    up- and down-sampling and gradients."""
+    rng = np.random.RandomState(7)
+    for ih, iw, oh, ow in [(4, 4, 9, 7), (9, 7, 4, 5)]:
+        fluid.reset_default_env()
+        xv = rng.randn(2, 3, ih, iw).astype("float32")
+        x = layers.data("x", [3, ih, iw], dtype="float32")
+        x.stop_gradient = False
+        out = layers.resize_bilinear(x, out_shape=[oh, ow])
+        loss = layers.reduce_sum(layers.square(out))
+        append_backward(loss)
+        got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"])
+
+        xt = torch.tensor(xv, requires_grad=True)
+        ot = torch.nn.functional.interpolate(
+            xt, size=(oh, ow), mode="bilinear", align_corners=True)
+        (ot ** 2).sum().backward()
+        cfg = f"{ih}x{iw}->{oh}x{ow}"
+        np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-5,
+                                   atol=1e-5, err_msg=cfg)
+        np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4,
+                                   atol=1e-4, err_msg=cfg + " dX")
+
+
+def test_nearest_interp_vs_torch_ref():
+    """nearest_interp rounds ratio*k+0.5 with the align-corners ratio
+    (interpolate_op.h:33).  torch's nearest uses floor(k*in/out) — a
+    DIFFERENT convention — so the reference here is the op kernel's own
+    formula, checked exactly."""
+    rng = np.random.RandomState(8)
+    ih, iw, oh, ow = 5, 4, 8, 9
+    xv = rng.randn(2, 3, ih, iw).astype("float32")
+    x = layers.data("x", [3, ih, iw], dtype="float32")
+    out = layers.resize_nearest(x, out_shape=[oh, ow])
+    (got,) = _run_program({"x": xv}, [out])
+
+    # hand-derived from interpolate_op.h:33 floor(ratio*k + 0.5) with
+    # ratio_h = 4/7, ratio_w = 3/8 — literals, so the test stays
+    # independent of any formula shared with the implementation
+    idx_h = np.array([0, 1, 1, 2, 2, 3, 3, 4])
+    idx_w = np.array([0, 0, 1, 1, 2, 2, 2, 3, 3])
+    assert np.array_equal(
+        np.floor((ih - 1) / (oh - 1) * np.arange(oh) + 0.5).astype(int),
+        idx_h)
+    want = xv[:, :, idx_h][:, :, :, idx_w]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_affine_grid_and_grid_sampler_vs_torch():
+    """affine_grid (linspace(-1,1)) + grid_sampler ((g+1)(size-1)/2
+    unnormalize, zero padding) both follow the reference's align-corners
+    convention == torch {affine_grid, grid_sample}(align_corners=True).
+    Theta deliberately pushes part of the grid out of bounds."""
+    rng = np.random.RandomState(9)
+    N, C, H, W = 2, 3, 6, 5
+    xv = rng.randn(N, C, H, W).astype("float32")
+    theta_v = (np.tile(np.array([[1.2, 0.1, 0.2], [-0.1, 0.9, -0.3]],
+                                dtype="float32"), (N, 1, 1))
+               + rng.randn(N, 2, 3).astype("float32") * 0.05)
+
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    theta = layers.data("theta", [2, 3], dtype="float32")
+    theta.stop_gradient = False
+    grid = layers.affine_grid(theta, out_shape=[N, C, H, W])
+    out = layers.grid_sampler(x, grid)
+    loss = layers.reduce_sum(layers.square(out))
+    append_backward(loss)
+    got, gx, gt = _run_program(
+        {"x": xv, "theta": theta_v},
+        [out, f"{x.name}@GRAD", f"{theta.name}@GRAD"])
+
+    xt = torch.tensor(xv, requires_grad=True)
+    tt = torch.tensor(theta_v, requires_grad=True)
+    gridt = torch.nn.functional.affine_grid(
+        tt, (N, C, H, W), align_corners=True)
+    ot = torch.nn.functional.grid_sample(
+        xt, gridt, mode="bilinear", padding_mode="zeros", align_corners=True)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(gt, tt.grad.numpy(), rtol=1e-3, atol=1e-3)
