@@ -618,12 +618,9 @@ def _interp(ctx, ins, attrs, method):
     hi_w = np.minimum(lo_w + 1, iw - 1)
     wh = jnp.asarray((src_h - lo_h).astype(np.float32)).reshape(1, 1, -1, 1)
     ww = jnp.asarray((src_w - lo_w).astype(np.float32)).reshape(1, 1, 1, -1)
-    tl = x[:, :, lo_h][:, :, :, lo_w]
-    tr = x[:, :, lo_h][:, :, :, hi_w]
-    bl = x[:, :, hi_h][:, :, :, lo_w]
-    br = x[:, :, hi_h][:, :, :, hi_w]
-    top = tl * (1.0 - ww) + tr * ww
-    bot = bl * (1.0 - ww) + br * ww
+    xlo, xhi = x[:, :, lo_h], x[:, :, hi_h]
+    top = xlo[:, :, :, lo_w] * (1.0 - ww) + xlo[:, :, :, hi_w] * ww
+    bot = xhi[:, :, :, lo_w] * (1.0 - ww) + xhi[:, :, :, hi_w] * ww
     out = (top * (1.0 - wh) + bot * wh).astype(x.dtype)
     return {"Out": [out]}
 

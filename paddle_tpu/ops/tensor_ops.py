@@ -760,17 +760,35 @@ def _space_to_depth_infer(op, block):
         return
     b = op.attr("blocksize", 1)
     n, c, h, w = x.shape
+    if c > 0 and c % (b * b):
+        # reference InferShape enforce (space_to_depth_op.cc:41): the
+        # reorg kernel scatters with depth-to-space indexing, so input
+        # channels must be divisible by blocksize^2 even in the
+        # space-to-depth direction
+        raise ValueError(
+            f"space_to_depth: input channels {c} must be divisible by "
+            f"blocksize^2 ({b * b})")
     set_output(block, op, "Out", [n, c * b * b, h // b if h > 0 else -1, w // b if w > 0 else -1], x.dtype)
 
 
 @register_op("space_to_depth", infer_shape=_space_to_depth_infer)
 def _space_to_depth(ctx, ins, attrs):
+    """Darknet-reorg layout compatibility (reference:
+    operators/space_to_depth_op.h:40-56): the kernel writes the input
+    through DEPTH-TO-SPACE scatter indexing — channel k decomposes as
+    (offset, c2) with h2 = j*bs + offset/bs, w2 = i*bs + offset%bs into a
+    [N, C/bs^2, H*bs, W*bs] view — and the Out buffer is then READ with
+    the declared [N, C*bs^2, H/bs, W/bs] shape.  YOLO-era models were
+    trained against exactly this scramble, so it is the contract; a
+    textbook block-to-channel space_to_depth does NOT match."""
     x = data(ins["X"][0])
     b = attrs["blocksize"]
     n, c, h, w = x.shape
-    out = jnp.reshape(x, (n, c, h // b, b, w // b, b))
-    out = jnp.transpose(out, (0, 3, 5, 1, 2, 4))
-    return {"Out": [jnp.reshape(out, (n, c * b * b, h // b, w // b))]}
+    out_c = c // (b * b)
+    y = jnp.reshape(x, (n, b, b, out_c, h, w))       # k = (oy, ox, c2)
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))         # [n, c2, h, oy, w, ox]
+    y = jnp.reshape(y, (n, out_c, h * b, w * b))     # depth-to-space image
+    return {"Out": [jnp.reshape(y, (n, c * b * b, h // b, w // b))]}
 
 
 def _range_static_len(op):

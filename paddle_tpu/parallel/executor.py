@@ -225,14 +225,22 @@ class ParallelExecutor:
         for name, val in zip(feed_names, feed_vals):
             sh = self._feed_sharding(name, block0)
             spec = getattr(sh, "spec", None)
-            if not spec or spec[0] != axis:
+            if not spec or spec[0] is None:
                 continue
+            # dim 0 may be sharded over one axis or a tuple of axes
+            # (e.g. [("dp", "sp"), ...]); the divisor is their product
+            dim0 = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            if axis not in dim0:
+                continue
+            div = 1
+            for a in dim0:
+                div *= self.mesh.axis_size(a)
             data = getattr(val, "data", val)
             n = np.shape(data)[0] if np.ndim(data) else 0
-            if n % dp:
+            if n % div:
                 raise ValueError(
-                    f"feed '{name}' batch size {n} is not divisible by the "
-                    f"'{axis}' mesh axis ({dp} devices); SPMD batch "
+                    f"feed '{name}' batch size {n} is not divisible by its "
+                    f"dim-0 mesh axes {dim0} ({div} shards); SPMD batch "
                     f"sharding needs equal per-device shards — pad or drop "
                     f"the tail batch (e.g. paddle_tpu.reader decorators "
                     f"batch(..., drop_last=True))"

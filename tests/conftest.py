@@ -35,9 +35,8 @@ def _fresh_programs():
     # fresh name counters too: generated names (fc_0.w_0, ...) must not
     # depend on how many layers earlier tests built — string-sorted name
     # lookups go wrong once a counter crosses 10 (fc_10 < fc_9)
-    prev_names = framework.unique_name_switch()
-    yield
-    framework.unique_name_switch(prev_names)
+    with framework.unique_name_guard():
+        yield
     framework.switch_main_program(prev_main)
     framework.switch_startup_program(prev_startup)
     scope_mod._current_scope = prev_scope

@@ -180,25 +180,37 @@ def test_crop():
 
 
 def test_space_to_depth():
-    x = _rand((1, 2, 4, 4), 35)
-    b = 2
-    want = x.reshape(1, 2, 2, b, 2, b).transpose(0, 3, 5, 1, 2, 4).reshape(1, 8, 2, 2)
+    """Expectation emulates the reference reorg kernel index math
+    (space_to_depth_op.h:40-56) element by element — NOT a
+    reshape/transpose formula that could share a bias with the
+    lowering.  C must divide blocksize^2 (space_to_depth_op.cc:41)."""
+    bs = 2
+    B, C, H, W = 1, 4, 4, 4
+    x = _rand((B, C, H, W), 35)
+    out_flat = np.zeros(B * C * H * W, dtype=x.dtype)
+    out_c = C // (bs * bs)
+    xf = x.ravel()
+    for in_index in range(x.size):
+        b = in_index // (C * H * W)
+        k = (in_index % (C * H * W)) // (H * W)
+        j = ((in_index % (C * H * W)) % (H * W)) // W
+        i = ((in_index % (C * H * W)) % (H * W)) % W
+        c2 = k % out_c
+        off = k // out_c
+        w2 = i * bs + off % bs
+        h2 = j * bs + off // bs
+        out_flat[w2 + W * bs * (h2 + H * bs * (c2 + out_c * b))] = xf[in_index]
+    want = out_flat.reshape(B, C * bs * bs, H // bs, W // bs)
 
     class T(OpTest):
         op_type = "space_to_depth"
 
     t = T()
     t.inputs = {"X": x}
-    t.attrs = {"blocksize": b}
+    t.attrs = {"blocksize": bs}
     t.outputs = {"Out": want}
-    try:
-        t.check_output()
-    except AssertionError:
-        # layout convention may interleave channel-major; accept the
-        # alternative standard ordering
-        want2 = x.reshape(1, 2, 2, b, 2, b).transpose(0, 1, 3, 5, 2, 4).reshape(1, 8, 2, 2)
-        t.outputs = {"Out": want2}
-        t.check_output()
+    t.check_output()
+    t.check_grad(["X"], "Out")
 
 
 def test_range():

@@ -122,7 +122,7 @@ def test_indivisible_batch_raises_clear_error():
     exe.run(fluid.default_startup_program())
     pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name)
     rng = np.random.RandomState(0)
-    with pytest.raises(ValueError, match="not divisible by the 'dp' mesh"):
+    with pytest.raises(ValueError, match="not divisible by its dim-0 mesh axes"):
         pe.run(feed={"x": rng.randn(10, 4).astype("float32"),
                      "y": rng.randn(10, 1).astype("float32")},
                fetch_list=[loss.name])

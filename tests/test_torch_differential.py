@@ -366,3 +366,113 @@ def test_affine_grid_and_grid_sampler_vs_torch():
                                atol=1e-4)
     np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(gt, tt.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_lrn_vs_torch():
+    """fluid's lrn uses alpha directly (lrn_op.h:37: k + alpha*SUM(x^2));
+    torch LocalResponseNorm divides alpha by the window size n — so
+    torch(alpha=n*a) must equal fluid(alpha=a).  The scaling trap this
+    encodes is exactly the kind of shared-bias bug the numpy sweeps can't
+    see."""
+    rng = np.random.RandomState(10)
+    N, C, H, W = 2, 7, 5, 5
+    n, k, a, beta = 5, 1.0, 1e-2, 0.75
+    xv = rng.randn(N, C, H, W).astype("float32")
+
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.lrn(x, n=n, k=k, alpha=a, beta=beta)
+    loss = layers.reduce_sum(layers.square(out))
+    append_backward(loss)
+    got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"])
+
+    xt = torch.tensor(xv, requires_grad=True)
+    ot = torch.nn.functional.local_response_norm(
+        xt, size=n, alpha=a * n, beta=beta, k=k)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_vs_torch():
+    rng = np.random.RandomState(11)
+    N, C, D, H, W = 2, 3, 5, 6, 6
+    K, ks = 4, 3
+    xv = rng.randn(N, C, D, H, W).astype("float32")
+    wv = rng.randn(K, C, ks, ks, ks).astype("float32")
+
+    x = layers.data("x", [C, D, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.conv3d(x, num_filters=K, filter_size=ks, stride=2,
+                        padding=1, bias_attr=False)
+    loss = layers.reduce_sum(layers.square(out))
+    pmap = append_backward(loss)
+    w_name = next(p.name for p, _ in pmap)
+    got, gw, gx = _run_program(
+        {"x": xv}, [out, f"{w_name}@GRAD", f"{x.name}@GRAD"],
+        param_overrides={w_name: wv})
+
+    xt = torch.tensor(xv, requires_grad=True)
+    wt = torch.tensor(wv, requires_grad=True)
+    ot = torch.nn.functional.conv3d(xt, wt, stride=2, padding=1)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(gw, wt.grad.numpy(), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=2e-3, atol=2e-3)
+
+
+def test_group_norm_vs_torch():
+    rng = np.random.RandomState(12)
+    N, C, H, W = 2, 8, 5, 5
+    G = 4
+    xv = rng.randn(N, C, H, W).astype("float32")
+    scale = rng.rand(C).astype("float32") + 0.5
+    bias = rng.randn(C).astype("float32")
+
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.group_norm(x, groups=G, epsilon=1e-5)
+    gn_op = next(op for op in fluid.default_main_program().global_block().ops
+                 if op.type == "group_norm")
+    overrides = {gn_op.input("Scale")[0]: scale,
+                 gn_op.input("Bias")[0]: bias}
+    loss = layers.reduce_sum(layers.square(out))
+    append_backward(loss)
+    got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"],
+                           param_overrides=overrides)
+
+    xt = torch.tensor(xv, requires_grad=True)
+    ot = torch.nn.functional.group_norm(
+        xt, G, weight=torch.tensor(scale), bias=torch.tensor(bias),
+        eps=1e-5)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_prelu_channel_vs_torch():
+    rng = np.random.RandomState(13)
+    N, C, H, W = 2, 4, 5, 5
+    xv = rng.randn(N, C, H, W).astype("float32")
+    alpha = (rng.rand(C) * 0.5).astype("float32")
+
+    x = layers.data("x", [C, H, W], dtype="float32")
+    x.stop_gradient = False
+    out = layers.prelu(x, mode="channel")
+    pr_op = next(op for op in fluid.default_main_program().global_block().ops
+                 if op.type == "prelu")
+    overrides = {pr_op.input("Alpha")[0]: alpha.reshape(1, C, 1, 1)}
+    loss = layers.reduce_sum(layers.square(out))
+    append_backward(loss)
+    got, gx = _run_program({"x": xv}, [out, f"{x.name}@GRAD"],
+                           param_overrides=overrides)
+
+    xt = torch.tensor(xv, requires_grad=True)
+    ot = torch.nn.functional.prelu(xt, torch.tensor(alpha))
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-4)
