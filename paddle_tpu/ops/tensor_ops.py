@@ -768,6 +768,11 @@ def _space_to_depth_infer(op, block):
         raise ValueError(
             f"space_to_depth: input channels {c} must be divisible by "
             f"blocksize^2 ({b * b})")
+    if (h > 0 and h % b) or (w > 0 and w % b):
+        # companion enforces, space_to_depth_op.cc:44-49
+        raise ValueError(
+            f"space_to_depth: input H/W ({h}x{w}) must be divisible by "
+            f"blocksize ({b})")
     set_output(block, op, "Out", [n, c * b * b, h // b if h > 0 else -1, w // b if w > 0 else -1], x.dtype)
 
 

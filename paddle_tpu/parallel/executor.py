@@ -211,30 +211,27 @@ class ParallelExecutor:
         return plan.convert_fetches(fetches, block0, return_numpy)
 
     def _check_batch_divisible(self, feed_names, feed_vals, block0) -> None:
-        """A batch-sharded feed whose dim 0 isn't divisible by the dp axis
-        would die inside pjit with a sharding ValueError; raise the
-        framework-level message first.  The reference redistributed uneven
-        tail batches at run time (data_balance_op_handle.cc) because its
-        per-device graphs took ragged sizes; XLA's static shapes make the
-        even-batch contract explicit instead — pad or trim the tail batch
-        (reader decorators `batch(..., drop_last=True)` do this)."""
-        axis = self.sharding_strategy.batch_axis
-        dp = self.mesh.axis_size(axis) if axis else 1
-        if dp <= 1:
-            return
+        """A dim-0-sharded feed whose batch isn't divisible by its mesh
+        axes would die inside pjit with a sharding ValueError; raise the
+        framework-level message first.  Applies to ANY dim-0 sharding (dp,
+        sp, or a ("dp", "sp") tuple — the divisor is the product of those
+        axis sizes), not just the configured batch axis.  The reference
+        redistributed uneven tail batches at run time
+        (data_balance_op_handle.cc) because its per-device graphs took
+        ragged sizes; XLA's static shapes make the even-batch contract
+        explicit instead — pad or trim the tail batch (reader decorators
+        `batch(..., drop_last=True)` do this)."""
         for name, val in zip(feed_names, feed_vals):
             sh = self._feed_sharding(name, block0)
             spec = getattr(sh, "spec", None)
             if not spec or spec[0] is None:
                 continue
-            # dim 0 may be sharded over one axis or a tuple of axes
-            # (e.g. [("dp", "sp"), ...]); the divisor is their product
             dim0 = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
-            if axis not in dim0:
-                continue
             div = 1
             for a in dim0:
                 div *= self.mesh.axis_size(a)
+            if div <= 1:
+                continue
             data = getattr(val, "data", val)
             n = np.shape(data)[0] if np.ndim(data) else 0
             if n % div:

@@ -2,12 +2,18 @@
 torch (CPU) as a second INDEPENDENT reference implementation.
 
 The numeric sweeps (tests/test_op_sweep_*.py) check each op against a
-hand-written numpy reference; these tests cross-check the heavyweight
-fwd+bwd paths — conv2d (plain / strided / grouped / dilated), pool2d,
-batch_norm (train and eval), layer_norm, and softmax_with_cross_entropy —
-against torch.nn.functional, catching any bias shared between our lowering
-and our numpy references (reference analogues: test_conv2d_op.py,
-test_batch_norm_op.py etc., which trusted the C++ CPU kernel the same way).
+hand-written numpy reference; these tests cross-check the heavyweight and
+convention-sensitive fwd+bwd paths — conv2d/conv3d/conv2d_transpose
+(strided/grouped/dilated), pool2d (incl. exclusive-avg and adaptive),
+batch_norm (train and eval), layer_norm, group_norm, lrn (the alpha/n
+scaling trap), prelu, softmax_with_cross_entropy, smooth_l1 (sigma vs
+beta), bilinear/nearest interp (align-corners), affine_grid+grid_sampler,
+embedding padding_idx, sequence_conv-as-conv1d, warpctc-vs-ctc_loss, and
+the lstm op under gate-order mapping — against torch, catching any bias
+shared between our lowering and our own numpy references (reference
+analogues: test_conv2d_op.py etc., which trusted the C++ CPU kernel the
+same way).  This tier has already caught two real convention bugs:
+half-pixel vs align-corners interp, and the space_to_depth reorg layout.
 
 Everything runs through the full Program -> compiler -> Executor path, not
 direct jnp calls: parameters are overwritten in the scope post-startup, and
@@ -474,5 +480,184 @@ def test_prelu_channel_vs_torch():
     ot = torch.nn.functional.prelu(xt, torch.tensor(alpha))
     (ot ** 2).sum().backward()
     np.testing.assert_allclose(got, ot.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_vs_torch_ctc_loss():
+    """warpctc (log-space alpha scan) against torch.nn.functional.ctc_loss
+    with reduction='none': per-sequence -log p(l|x) must agree on a ragged
+    batch, and the analytic gradient wrt raw logits must match torch's
+    autograd through log_softmax -> ctc_loss."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(14)
+    C = 6          # classes incl. blank 0
+    t_lens = [7, 5, 6]
+    l_lens = [3, 2, 1]
+    logits = [rng.randn(t, C).astype("float32") for t in t_lens]
+    labels = [rng.randint(1, C, (l, 1)).astype("int64") for l in l_lens]
+
+    lp = [torch.tensor(x, requires_grad=True) for x in logits]
+    losses, grads = [], []
+    for x, y in zip(lp, labels):
+        log_probs = torch.nn.functional.log_softmax(x, dim=-1)
+        loss = torch.nn.functional.ctc_loss(
+            log_probs.unsqueeze(1), torch.tensor(y.reshape(1, -1)),
+            input_lengths=torch.tensor([x.shape[0]]),
+            target_lengths=torch.tensor([y.shape[0]]),
+            blank=0, reduction="none", zero_infinity=False)
+        loss.backward()
+        losses.append(float(loss))
+        grads.append(x.grad.numpy())
+    want_loss = np.array(losses, dtype="float32").reshape(-1, 1)
+
+    class T(OpTest):
+        op_type = "warpctc"
+
+    t = T()
+    t.inputs = {"Logits": (np.concatenate(logits), t_lens),
+                "Label": (np.concatenate(labels), l_lens)}
+    t.attrs = {"blank": 0, "norm_by_times": False}
+    t.outputs = {"Loss": want_loss}
+    t.check_output(atol=2e-4, rtol=2e-4)
+
+    # analytic dLogits vs torch, via the executor path with a grad fetch
+    prog, startup, feed, in_names, out_names = t._build()
+    with fluid.program_guard(prog, startup):
+        loss_name = out_names["Loss"][0]
+        total = layers.reduce_sum(prog.global_block().var(loss_name))
+        append_backward(total)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (g,) = exe.run(program=prog, feed=feed,
+                       fetch_list=[in_names["Logits"][0] + "@GRAD"],
+                       return_numpy=False)
+    got_grad = np.asarray(g.data if hasattr(g, "data") else g)
+    want_grad = np.concatenate(grads)
+    # got_grad is the padded [N, maxT, C] layout; flatten valid rows
+    if got_grad.ndim == 3:
+        got_grad = np.concatenate(
+            [got_grad[i, :t] for i, t in enumerate(t_lens)])
+    np.testing.assert_allclose(got_grad, want_grad, rtol=2e-3, atol=2e-4)
+
+
+def test_embedding_padding_idx_vs_torch():
+    """lookup_table with padding_idx: the padded row reads ZEROS at run
+    time (lookup_table_op.h memsets the output row — stronger than torch,
+    which only zeroes the gradient) and receives zero gradient.  Zeroing
+    the torch table's pad row makes the two semantics coincide, so torch
+    still cross-checks the gather and the grad-exclusion."""
+    rng = np.random.RandomState(15)
+    V, D = 12, 6
+    pad = 3
+    ids = np.array([[1], [3], [5], [3], [0], [11]], dtype="int64")
+    table = rng.randn(V, D).astype("float32")
+    table[pad] = 0.0  # align torch's weaker convention with the reference
+
+    x = layers.data("ids", [1], dtype="int64")
+    emb = layers.embedding(x, size=[V, D], padding_idx=pad)
+    w_name = next(op for op in
+                  fluid.default_main_program().global_block().ops
+                  if op.type == "lookup_table").input("W")[0]
+    loss = layers.reduce_sum(layers.square(emb))
+    append_backward(loss)
+    got, gw = _run_program({"ids": ids}, [emb, f"{w_name}@GRAD"],
+                           param_overrides={w_name: table})
+
+    wt = torch.tensor(table, requires_grad=True)
+    ot = torch.nn.functional.embedding(
+        torch.tensor(ids.reshape(-1)), wt, padding_idx=pad)
+    (ot ** 2).sum().backward()
+    np.testing.assert_allclose(got.reshape(-1, D), ot.detach().numpy(),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(gw, wt.grad.numpy(), rtol=1e-5, atol=1e-6)
+    assert np.all(gw[pad] == 0)
+
+
+def test_sequence_conv_vs_torch_conv1d():
+    """sequence_conv with context_start=-(k-1)/2 on equal-length sequences
+    == 1D convolution with zero padding (sequence_conv_op math via the
+    im2col-style context window)."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(16)
+    T, Din, Dout, k = 6, 4, 5, 3
+    lens = [T, T]
+    flat = rng.randn(sum(lens), Din).astype("float32")
+    # fluid filter: [k*Din, Dout], rows ordered context-position-major
+    w = rng.randn(k * Din, Dout).astype("float32")
+
+    xt = torch.tensor(
+        np.stack([flat[:T], flat[T:]]).transpose(0, 2, 1),
+        requires_grad=False)  # [N, Din, T]
+    # torch conv1d weight [Dout, Din, k]: fluid's rows are
+    # [ctx0*Din..., ctx1*Din..., ctx2*Din...] -> permute accordingly
+    wt = torch.tensor(
+        w.reshape(k, Din, Dout).transpose(2, 1, 0).copy())
+    ot = torch.nn.functional.conv1d(xt, wt, padding=(k - 1) // 2)
+    want_flat = np.concatenate(
+        [o.T for o in ot.detach().numpy()]).astype("float32")
+
+    class Tst(OpTest):
+        op_type = "sequence_conv"
+
+    t = Tst()
+    t.inputs = {"X": (flat, lens), "Filter": w}
+    t.attrs = {"contextLength": k, "contextStart": -(k - 1) // 2,
+               "contextStride": 1}
+    t.outputs = {"Out": (want_flat, lens)}
+    t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_adaptive_pool2d_vs_torch():
+    """adaptive avg/max pooling bin bounds (math/pooling.h floor/ceil
+    Adaptive{Start,End}Index) == torch adaptive_{avg,max}_pool2d.  The
+    snapshot's Python layer doesn't expose adaptive (the C++ op grew the
+    attr first, pool_op.cc:194), so this drives the op directly."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(17)
+    N, C, H, W = 2, 3, 7, 11
+    xv = rng.randn(N, C, H, W).astype("float32")
+    for ptype in ("avg", "max"):
+        fn = (torch.nn.functional.adaptive_avg_pool2d if ptype == "avg"
+              else torch.nn.functional.adaptive_max_pool2d)
+        want = fn(torch.tensor(xv), (3, 4)).numpy()
+
+        class T(OpTest):
+            op_type = "pool2d"
+
+        t = T()
+        t.inputs = {"X": xv}
+        t.attrs = {"pooling_type": ptype, "ksize": [3, 4], "adaptive": True,
+                   "strides": [1, 1], "paddings": [0, 0]}
+        t.outputs = {"Out": want}
+        t.check_output(atol=1e-5, rtol=1e-5)
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_smooth_l1_vs_torch():
+    """fluid smooth_l1(sigma) == torch smooth_l1_loss(beta=1/sigma^2)
+    summed over the trailing dim (smooth_l1_loss_op.h)."""
+    rng = np.random.RandomState(18)
+    N, D = 6, 5
+    sigma = 2.0
+    xv = rng.randn(N, D).astype("float32")
+    yv = rng.randn(N, D).astype("float32")
+
+    x = layers.data("x", [D], dtype="float32")
+    x.stop_gradient = False
+    y = layers.data("y", [D], dtype="float32")
+    out = layers.smooth_l1(x, y, sigma=sigma)
+    loss = layers.reduce_sum(out)
+    append_backward(loss)
+    got, gx = _run_program({"x": xv, "y": yv}, [out, f"{x.name}@GRAD"])
+
+    xt = torch.tensor(xv, requires_grad=True)
+    lt = torch.nn.functional.smooth_l1_loss(
+        xt, torch.tensor(yv), beta=1.0 / sigma ** 2,
+        reduction="none").sum(dim=1, keepdim=True)
+    lt.sum().backward()
+    np.testing.assert_allclose(got, lt.detach().numpy(), rtol=1e-5,
                                atol=1e-5)
     np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-4)
