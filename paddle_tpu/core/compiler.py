@@ -380,6 +380,30 @@ def collect_needed_vjps(block: Block) -> set:
     }
 
 
+_compile_cache_applied = False
+
+
+def _maybe_enable_compile_cache() -> None:
+    """Apply FLAGS_compile_cache_dir once: point jax's persistent
+    executable cache at the directory so identical programs skip
+    recompilation across processes (relay compiles cost minutes).  A
+    backend that can't serialize executables makes jax log and skip —
+    never fatal."""
+    global _compile_cache_applied
+    if _compile_cache_applied:
+        return
+    from .. import flags
+
+    cache_dir = flags.flag("compile_cache_dir")
+    if not cache_dir:
+        return  # not latched: a later set_flags can still enable it
+    _compile_cache_applied = True
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:
+        pass
+
+
 class CompiledBlock:
     """A block lowered to one jitted callable.
 
@@ -405,6 +429,7 @@ class CompiledBlock:
         self.fetch_names = list(fetch_names)
         self.state_names = list(state_names)
         self.mesh = mesh
+        _maybe_enable_compile_cache()
         block = self.block
         need_vjps = collect_needed_vjps(block)
 

@@ -45,6 +45,13 @@ _DEFS: Dict[str, Any] = {
     # full-model pallas-backward compiles (round 3); on a directly
     # attached TPU host flip to "pallas" for long sequences
     "FLAGS_flash_bwd": "jax",
+    # persistent XLA executable cache directory ("" = disabled): repeated
+    # runs of the same program skip compilation entirely — first compiles
+    # through the TPU relay cost minutes, so benches/drivers set this.
+    # Applied lazily at the first block compile (core/compiler.py); a
+    # backend whose PJRT plugin can't serialize executables logs and
+    # continues uncached
+    "FLAGS_compile_cache_dir": "",
 }
 
 _VALUES: Dict[str, Any] = {}

@@ -221,6 +221,8 @@ class ParallelExecutor:
         ragged sizes; XLA's static shapes make the even-batch contract
         explicit instead — pad or trim the tail batch (reader decorators
         `batch(..., drop_last=True)` do this)."""
+        if self.mesh.num_devices <= 1:
+            return  # no axis can shard dim 0; skip the per-feed pass
         for name, val in zip(feed_names, feed_vals):
             sh = self._feed_sharding(name, block0)
             spec = getattr(sh, "spec", None)

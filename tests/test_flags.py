@@ -152,3 +152,30 @@ def test_conv_layout_nhwc_pool_parity():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(results["NCHW"][1], results["NHWC"][1],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_compile_cache_dir_flag_applies(tmp_path, monkeypatch):
+    """FLAGS_compile_cache_dir points jax's persistent executable cache at
+    the directory on first block compile (tiny compiles may fall under
+    jax's min-compile-time threshold, so the assertion is on the applied
+    config, not on cache files)."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as fl
+    from paddle_tpu.core import compiler
+    from paddle_tpu import layers
+
+    prev = jax.config.jax_compilation_cache_dir
+    monkeypatch.setattr(compiler, "_compile_cache_applied", False)
+    fl.set_flags({"FLAGS_compile_cache_dir": str(tmp_path)})
+    try:
+        x = layers.data("x", [2], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=2))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        exe.run(feed={"x": np.zeros((2, 2), "float32")}, fetch_list=[loss])
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    finally:
+        fl.set_flags({"FLAGS_compile_cache_dir": ""})
+        jax.config.update("jax_compilation_cache_dir", prev)
