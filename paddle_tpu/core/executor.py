@@ -182,6 +182,87 @@ def _check_nan_inf(plan, fetches, new_states) -> None:
             )
 
 
+def scan_multi_fn(body, n_batches, steps):
+    """Multi-step scan closure shared by Executor.run_steps and
+    ParallelExecutor.run_steps: step i feeds batch i % n_batches; the
+    LAST step's fetches ride in the carry (not scan ys — stacking
+    steps x fetch would hold every step's outputs in HBM); fetch shapes
+    come from eval_shape, no extra compilation."""
+
+    def multi(feeds_stack, state_vals, rng):
+        def take(i):
+            return tuple(
+                jax.lax.dynamic_index_in_dim(f, i % n_batches, keepdims=False)
+                for f in feeds_stack
+            )
+
+        def step(carry, i):
+            states, k, _ = carry
+            fetches, states, k = body(take(i), states, k)
+            return (states, k, fetches), None
+
+        fetch_shapes = jax.eval_shape(
+            body, take(jax.numpy.int32(0)), state_vals, rng
+        )[0]
+        init_fetch = tuple(
+            jax.numpy.zeros(s.shape, s.dtype) for s in fetch_shapes
+        )
+        (states, k, last), _ = jax.lax.scan(
+            step, (state_vals, rng, init_fetch),
+            np.arange(steps, dtype=np.int32),
+        )
+        return last, states, k
+
+    return multi
+
+
+def stacked_feeds(cache, stack_key, fp, plan, feed_list, block0, put):
+    """Stack per-step feeds into [K, ...] device arrays, with an
+    identity-keyed cache: repeated calls with the SAME feed objects (a
+    training loop cycling one staged list) reuse the stacked copy instead
+    of paying conversion + stack + transfer per call.  Only immutable
+    feeds (jax.Array) are cacheable — a host-numpy buffer can be refilled
+    in place between calls, which would silently replay stale data.  The
+    cache pins the array OBJECTS themselves and revalidates by identity
+    (not raw id() values, which CPython can recycle)."""
+    cacheable = all(
+        isinstance(feed[n], jax.Array)
+        for feed in feed_list for n in plan.feed_names
+    )
+    feed_arrays = tuple(
+        tuple(feed[n] for n in plan.feed_names) for feed in feed_list
+    )
+    cached = cache.get(stack_key) if cacheable else None
+    if (
+        cached is not None
+        and cached[0] == fp
+        and len(cached[2]) == len(feed_arrays)
+        and all(
+            a is b
+            for row_a, row_b in zip(cached[2], feed_arrays)
+            for a, b in zip(row_a, row_b)
+        )
+    ):
+        return cached[1]
+    batches = []
+    for feed in feed_list:
+        vals = plan.feed_values(feed, block0)
+        for n, v in zip(plan.feed_names, vals):
+            if isinstance(v, LoDValue):
+                raise TypeError(
+                    f"run_steps cannot scan LoD feed '{n}'; run per step "
+                    "for ragged batches"
+                )
+        batches.append(vals)
+    feeds_stack = put(tuple(
+        jax.numpy.stack([b[i] for b in batches])
+        for i in range(len(plan.feed_names))
+    ))
+    if cacheable:
+        cache[stack_key] = (fp, feeds_stack, feed_arrays)
+    return feeds_stack
+
+
 class Executor:
     """Serial single-device executor (reference: executor.py:256)."""
 
@@ -351,95 +432,19 @@ class Executor:
                 program, 0, plan.feed_names, plan.fetch_names,
                 plan.state_names, donate_states=False,
             )
-            n_batches = len(feed_list)
-            body = compiled.raw_fn
-
-            def multi(feeds_stack, state_vals, rng):
-                def take(i):
-                    return tuple(
-                        jax.lax.dynamic_index_in_dim(
-                            f, i % n_batches, keepdims=False
-                        )
-                        for f in feeds_stack
-                    )
-
-                def step(carry, i):
-                    states, k, _ = carry
-                    fetches, states, k = body(take(i), states, k)
-                    return (states, k, fetches), None
-
-                # last-step fetches ride in the carry (not scan ys: stacking
-                # steps x fetch would hold every step's outputs in HBM);
-                # shapes come from eval_shape, no extra compilation
-                fetch_shapes = jax.eval_shape(
-                    body, take(jax.numpy.int32(0)), state_vals, rng
-                )[0]
-                init_fetch = tuple(
-                    jax.numpy.zeros(s.shape, s.dtype) for s in fetch_shapes
-                )
-                (states, k, last), _ = jax.lax.scan(
-                    step, (state_vals, rng, init_fetch),
-                    np.arange(steps, dtype=np.int32),
-                )
-                return last, states, k
-
             fn = jax.jit(
-                multi,
+                scan_multi_fn(compiled.raw_fn, len(feed_list), steps),
                 donate_argnums=(1,) if self.donate_states else (),
             )
             entry = (fp, (compiled, fn), plan)
             self._cache[key] = entry
         _, (compiled, fn), plan = entry
 
-        # repeated calls with the SAME feed objects (a training loop cycling
-        # one staged list) reuse the stacked device copy instead of paying
-        # conversion + stack + transfer per call.  Only immutable feeds
-        # (jax.Array) are cacheable: a host-numpy buffer can be refilled
-        # in place between calls, which would silently replay stale data.
-        # The cache pins the array OBJECTS themselves and revalidates by
-        # identity against them (not raw id() values, which CPython can
-        # recycle once an old array is dropped).
         device = self.place.jax_device()
-        stack_key = key + ("feeds",)
-        cacheable = all(
-            isinstance(feed[n], jax.Array)
-            for feed in feed_list for n in plan.feed_names
+        feeds_stack = stacked_feeds(
+            self._cache, key + ("feeds",), fp, plan, feed_list, block0,
+            lambda t: jax.device_put(t, device),
         )
-        feed_arrays = tuple(
-            tuple(feed[n] for n in plan.feed_names) for feed in feed_list
-        )
-        cached = self._cache.get(stack_key) if cacheable else None
-        if (
-            cached is not None
-            and cached[0] == fp
-            and len(cached[2]) == len(feed_arrays)
-            and all(
-                a is b
-                for row_a, row_b in zip(cached[2], feed_arrays)
-                for a, b in zip(row_a, row_b)
-            )
-        ):
-            feeds_stack = cached[1]
-        else:
-            batches = []
-            for feed in feed_list:
-                vals = plan.feed_values(feed, block0)
-                for n, v in zip(plan.feed_names, vals):
-                    if isinstance(v, LoDValue):
-                        raise TypeError(
-                            f"run_steps cannot scan LoD feed '{n}'; use "
-                            "Executor.run per step for ragged batches"
-                        )
-                batches.append(vals)
-            feeds_stack = jax.device_put(
-                tuple(
-                    jax.numpy.stack([b[i] for b in batches])
-                    for i in range(len(plan.feed_names))
-                ),
-                device,
-            )
-            if cacheable:
-                self._cache[stack_key] = (fp, feeds_stack, feed_arrays)
         state_vals = plan.state_values(scope, block0)
         rng = plan.rng_value(scope, program)
 
