@@ -22,7 +22,7 @@ run_native() {
   rm -f paddle_tpu/native/*.so
   python - <<'PY'
 from paddle_tpu import native
-for name in ("recordio", "multislot"):
+for name in ("recordio", "multislot", "lodpack"):
     lib = native.load(name)
     assert lib is not None, f"native {name} failed to build"
     print(f"built lib{name}.so")
