@@ -661,3 +661,58 @@ def test_smooth_l1_vs_torch():
     np.testing.assert_allclose(got, lt.detach().numpy(), rtol=1e-5,
                                atol=1e-5)
     np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("name,torch_fn,attrs", [
+    ("gelu", lambda x: torch.nn.functional.gelu(x, approximate="none"), {}),
+    ("softplus", lambda x: torch.nn.functional.softplus(x), {}),
+    ("elu", lambda x: torch.nn.functional.elu(x, alpha=1.0), {}),
+    ("softsign", torch.nn.functional.softsign, {}),
+    ("tanh_shrink", torch.nn.functional.tanhshrink, {}),
+    ("softshrink", lambda x: torch.nn.functional.softshrink(x, lambd=0.4),
+     {"lambda": 0.4}),
+    ("hard_shrink", lambda x: torch.nn.functional.hardshrink(x, lambd=0.4),
+     {"threshold": 0.4}),
+    ("leaky_relu", lambda x: torch.nn.functional.leaky_relu(x, 0.1),
+     {"alpha": 0.1}),
+    ("relu6", torch.nn.functional.relu6, {}),
+    ("selu", torch.nn.functional.selu, {}),
+])
+def test_activation_vs_torch(name, torch_fn, attrs):
+    """Convention-sensitive activations (gelu erf-vs-tanh, shrink
+    thresholds, selu's alpha/scale constants) vs torch, fwd + grad,
+    through the op path."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(19)
+    x = (rng.randn(4, 7) * 2).astype("float32")
+    # keep points away from the kink of piecewise activations so numeric
+    # grads (check_grad) and torch agree
+    for kink in ((0.4, -0.4) if "shrink" in name else (0.0,)):
+        x[np.abs(x - kink) < 0.05] += 0.1
+
+    xt = torch.tensor(x, requires_grad=True)
+    ot = torch_fn(xt)
+    ot.sum().backward()
+
+    class T(OpTest):
+        op_type = name
+
+    t = T()
+    t.inputs = {"X": x}
+    t.attrs = dict(attrs)
+    t.outputs = {"Out": ot.detach().numpy()}
+    t.check_output(atol=1e-5, rtol=1e-5)
+    # analytic dX through the program path vs torch autograd (check_grad
+    # would only compare our analytic grad against our own FD)
+    prog, startup, feed, in_names, out_names = t._build()
+    with fluid.program_guard(prog, startup):
+        total = layers.reduce_sum(
+            prog.global_block().var(out_names["Out"][0]))
+        append_backward(total)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (g,) = exe.run(program=prog, feed=feed,
+                       fetch_list=[in_names["X"][0] + "@GRAD"])
+    np.testing.assert_allclose(np.asarray(g), xt.grad.numpy(), rtol=1e-4,
+                               atol=1e-5, err_msg=name + " dX")
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
