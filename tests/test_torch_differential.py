@@ -716,3 +716,75 @@ def test_activation_vs_torch(name, torch_fn, attrs):
     np.testing.assert_allclose(np.asarray(g), xt.grad.numpy(), rtol=1e-4,
                                atol=1e-5, err_msg=name + " dX")
     t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("name", [
+    "sgd", "momentum", "nesterov", "adam", "adagrad", "rmsprop", "adadelta",
+])
+def test_optimizer_trajectory_vs_torch(name):
+    """Five coupled training steps of a linear regression, our in-graph
+    optimizer ops vs torch.optim on the identical model: catches
+    convention bias (bias-correction form, eps placement, velocity
+    scaling) the numpy sweeps could share.  Known benign formulation
+    deltas (fluid's epsilon-hat adam, rmsprop's eps-inside-sqrt) stay
+    under the tolerance at these scales."""
+    rng = np.random.RandomState(20)
+    D = 6
+    w0 = rng.randn(D, 1).astype("float32") * 0.5
+    feeds = [(rng.randn(8, D).astype("float32"),
+              rng.randn(8, 1).astype("float32")) for _ in range(5)]
+
+    x = layers.data("x", [D], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt = {
+        "sgd": lambda: fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+        "momentum": lambda: fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9),
+        "nesterov": lambda: fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, use_nesterov=True),
+        "adam": lambda: fluid.optimizer.AdamOptimizer(
+            learning_rate=0.05, beta1=0.9, beta2=0.999, epsilon=1e-8),
+        "adagrad": lambda: fluid.optimizer.AdagradOptimizer(
+            learning_rate=0.1, epsilon=1e-10),
+        "rmsprop": lambda: fluid.optimizer.RMSPropOptimizer(
+            learning_rate=0.05, rho=0.9, epsilon=1e-6, momentum=0.9),
+        "adadelta": lambda: fluid.optimizer.AdadeltaOptimizer(
+            learning_rate=1.0, epsilon=1e-6, rho=0.95),
+    }[name]()
+    opt.minimize(loss)
+    w_name = next(op for op in
+                  fluid.default_main_program().global_block().ops
+                  if op.type == "mul").input("Y")[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var(w_name, w0.copy())
+    for xv, yv in feeds:
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find_var(w_name))
+
+    lin = torch.nn.Linear(D, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.copy_(torch.tensor(w0.T))
+    topt = {
+        "sgd": lambda p: torch.optim.SGD(p, lr=0.1),
+        "momentum": lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9),
+        "nesterov": lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9,
+                                              nesterov=True),
+        "adam": lambda p: torch.optim.Adam(p, lr=0.05, betas=(0.9, 0.999),
+                                           eps=1e-8),
+        "adagrad": lambda p: torch.optim.Adagrad(p, lr=0.1, eps=1e-10),
+        "rmsprop": lambda p: torch.optim.RMSprop(p, lr=0.05, alpha=0.9,
+                                                 eps=1e-6, momentum=0.9),
+        "adadelta": lambda p: torch.optim.Adadelta(p, lr=1.0, rho=0.95,
+                                                   eps=1e-6),
+    }[name](lin.parameters())
+    for xv, yv in feeds:
+        topt.zero_grad()
+        out = lin(torch.tensor(xv))
+        tl = ((out - torch.tensor(yv)) ** 2).mean()
+        tl.backward()
+        topt.step()
+    want = lin.weight.detach().numpy().T
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
