@@ -788,3 +788,99 @@ def test_optimizer_trajectory_vs_torch(name):
         topt.step()
     want = lin.weight.detach().numpy().T
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_loss_family_vs_torch():
+    """sigmoid_cross_entropy_with_logits == torch BCE-with-logits;
+    huber_loss(delta) == torch huber_loss(delta) on residual y-x;
+    margin_rank_loss == torch margin_ranking_loss; cos_sim == torch
+    cosine_similarity.  Each fwd + analytic dX vs torch autograd."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(21)
+    N, D = 6, 5
+
+    def run_op(op_type, inputs, attrs, outputs=("Out",), out_slot="Out",
+               grad_of="X"):
+        class T(OpTest):
+            pass
+        T.op_type = op_type
+        t = T()
+        t.inputs = inputs
+        t.attrs = attrs
+        t.outputs = {slot: None for slot in outputs}
+        prog, startup, feed, in_names, out_names = t._build()
+        for slot in ("Label",):  # supervision inputs take no gradient
+            for n in in_names.get(slot, []):
+                prog.global_block().var(n).stop_gradient = True
+        with fluid.program_guard(prog, startup):
+            total = layers.reduce_sum(
+                prog.global_block().var(out_names[out_slot][0]))
+            append_backward(total)
+            exe = fluid.Executor(fluid.CPUPlace())
+            outs = exe.run(
+                program=prog, feed=feed,
+                fetch_list=[out_names[out_slot][0],
+                            in_names[grad_of][0] + "@GRAD"])
+        return [np.asarray(o) for o in outs]
+
+    xv = (rng.randn(N, D) * 2).astype("float32")
+    lv = rng.rand(N, D).astype("float32")
+    got, gx = run_op("sigmoid_cross_entropy_with_logits",
+                     {"X": xv, "Label": lv}, {})
+    xt = torch.tensor(xv, requires_grad=True)
+    want = torch.nn.functional.binary_cross_entropy_with_logits(
+        xt, torch.tensor(lv), reduction="none")
+    want.sum().backward()
+    np.testing.assert_allclose(got, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6, err_msg="sigmoid_ce")
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-6,
+                               err_msg="sigmoid_ce dX")
+
+    # huber_loss: fluid residual = Y - X, delta attr; torch(input=x,
+    # target=y, delta) is symmetric in |y-x| so they coincide
+    yv = (rng.randn(N, 1)).astype("float32")
+    xv2 = (rng.randn(N, 1)).astype("float32")
+    got, gx = run_op("huber_loss", {"X": xv2, "Y": yv}, {"delta": 0.7},
+                     outputs=("Out", "Residual"))
+    xt = torch.tensor(xv2, requires_grad=True)
+    want = torch.nn.functional.huber_loss(
+        xt, torch.tensor(yv), delta=0.7, reduction="none")
+    want.sum().backward()
+    np.testing.assert_allclose(got.reshape(-1), want.detach().numpy()
+                               .reshape(-1), rtol=1e-5, atol=1e-6,
+                               err_msg="huber")
+    np.testing.assert_allclose(gx, xt.grad.numpy(), rtol=1e-4, atol=1e-6,
+                               err_msg="huber dX")
+
+    # margin_rank_loss: out = max(0, -label*(x1-x2) + margin)
+    x1 = rng.randn(N, 1).astype("float32")
+    x2 = rng.randn(N, 1).astype("float32")
+    lab = np.where(rng.rand(N, 1) > 0.5, 1.0, -1.0).astype("float32")
+    got, g1 = run_op("margin_rank_loss",
+                     {"X1": x1, "X2": x2, "Label": lab}, {"margin": 0.3},
+                     outputs=("Out", "Activated"), grad_of="X1")
+    t1 = torch.tensor(x1, requires_grad=True)
+    want = torch.nn.functional.margin_ranking_loss(
+        t1, torch.tensor(x2), torch.tensor(lab), margin=0.3,
+        reduction="none")
+    want.sum().backward()
+    np.testing.assert_allclose(got.reshape(-1),
+                               want.detach().numpy().reshape(-1),
+                               rtol=1e-5, atol=1e-6, err_msg="margin_rank")
+    np.testing.assert_allclose(g1, t1.grad.numpy(), rtol=1e-4, atol=1e-6,
+                               err_msg="margin_rank dX1")
+
+    # cos_sim (row-wise cosine similarity)
+    xa = rng.randn(N, D).astype("float32")
+    xb = rng.randn(N, D).astype("float32")
+    got, gx = run_op("cos_sim", {"X": xa, "Y": xb}, {},
+                     outputs=("Out", "XNorm", "YNorm"))
+    ta = torch.tensor(xa, requires_grad=True)
+    want = torch.nn.functional.cosine_similarity(ta, torch.tensor(xb),
+                                                 dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(got.reshape(-1), want.detach().numpy(),
+                               rtol=1e-5, atol=1e-6, err_msg="cos_sim")
+    np.testing.assert_allclose(gx, ta.grad.numpy(), rtol=1e-4, atol=1e-6,
+                               err_msg="cos_sim dX")
