@@ -1,0 +1,135 @@
+"""Numerical-stability checks at extreme inputs: the log-sum-exp family
+must not overflow for large logits, normalizers must survive
+zero-variance rows, and the CTC alpha scan must stay finite on long
+sequences (reference analogues: the C++ kernels' max-subtraction in
+softmax functors, math/cross_entropy.h TolerableValue clamping)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.backward import append_backward
+
+
+def _finite(*arrays):
+    for a in arrays:
+        assert np.all(np.isfinite(np.asarray(a))), a
+
+
+def test_softmax_ce_large_logits_shift_invariant():
+    """softmax_with_cross_entropy at logits ~1e4 is finite and equals the
+    shifted computation (max-subtraction invariance)."""
+    rng = np.random.RandomState(0)
+    # eighths are exactly representable even after the +1e4 shift, so the
+    # shifted logits carry identical information (a raw randn would be
+    # rounded at the 1e4 scale and change the task itself)
+    base = (np.round(rng.randn(4, 6) * 8) / 8).astype("float32")
+    yv = rng.randint(0, 6, (4, 1)).astype("int64")
+
+    def run(logits):
+        fluid.reset_default_env()
+        x = layers.data("x", [6], dtype="float32")
+        x.stop_gradient = False
+        y = layers.data("y", [1], dtype="int64")
+        loss = layers.softmax_with_cross_entropy(x, y)
+        append_backward(layers.reduce_sum(loss))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        out, g = exe.run(feed={"x": logits, "y": yv},
+                         fetch_list=[loss, f"{x.name}@GRAD"])
+        return np.asarray(out), np.asarray(g)
+
+    small, gs = run(base)
+    big, gb = run(base + 1e4)
+    _finite(small, big, gs, gb)
+    np.testing.assert_allclose(small, big, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gs, gb, rtol=1e-3, atol=1e-5)
+
+
+def test_sigmoid_ce_saturated_logits_finite():
+    """sigmoid_cross_entropy_with_logits at +-50 must not produce inf
+    (the naive log(sigmoid) would); grads saturate to 0/1 cleanly."""
+    x = layers.data("x", [4], dtype="float32")
+    x.stop_gradient = False
+    lab = layers.data("lab", [4], dtype="float32")
+    loss = layers.sigmoid_cross_entropy_with_logits(x, lab)
+    append_backward(layers.reduce_sum(loss))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.array([[50.0, -50.0, 30.0, -30.0]], dtype="float32")
+    lv = np.array([[1.0, 0.0, 0.0, 1.0]], dtype="float32")
+    out, g = exe.run(feed={"x": xv, "lab": lv},
+                     fetch_list=[loss, f"{x.name}@GRAD"])
+    _finite(out, g)
+    # matched-sign entries have ~0 loss; mismatched ~|logit|
+    np.testing.assert_allclose(np.asarray(out)[0, :2], [0.0, 0.0],
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out)[0, 2:], [30.0, 30.0],
+                               rtol=1e-5)
+
+
+def test_norms_zero_variance_rows_finite():
+    """layer_norm and batch_norm on constant inputs (zero variance) stay
+    finite fwd and bwd (epsilon guards)."""
+    x = layers.data("x", [5], dtype="float32")
+    x.stop_gradient = False
+    ln = layers.layer_norm(x, begin_norm_axis=1)
+    loss = layers.reduce_sum(layers.square(ln))
+    append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.full((3, 5), 2.5, dtype="float32")
+    out, g = exe.run(feed={"x": xv}, fetch_list=[ln, f"{x.name}@GRAD"])
+    _finite(out, g)
+
+    fluid.reset_default_env()
+    x = layers.data("x", [2, 4, 4], dtype="float32")
+    x.stop_gradient = False
+    bn = layers.batch_norm(x)
+    loss = layers.reduce_sum(layers.square(bn))
+    append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.zeros((2, 2, 4, 4), dtype="float32")
+    out, g = exe.run(feed={"x": xv}, fetch_list=[bn, f"{x.name}@GRAD"])
+    _finite(out, g)
+
+
+def test_warpctc_long_sequence_finite():
+    """CTC alpha scan over T=200 stays finite in log space (a prob-space
+    DP would underflow at ~1e-308 long before this)."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(1)
+    T, C, L = 200, 8, 20
+    logits = rng.randn(T, C).astype("float32")
+    labels = rng.randint(1, C, (L, 1)).astype("int64")
+
+    class Tst(OpTest):
+        op_type = "warpctc"
+
+    t = Tst()
+    t.inputs = {"Logits": (logits, [T]), "Label": (labels, [L])}
+    t.attrs = {"blank": 0, "norm_by_times": False}
+    t.outputs = {"Loss": None}
+    prog, startup, feed, in_names, out_names = t._build()
+    with fluid.program_guard(prog, startup):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (loss,) = exe.run(program=prog, feed=feed,
+                          fetch_list=[out_names["Loss"][0]])
+    _finite(loss)
+    assert float(np.asarray(loss).ravel()[0]) > 0
+
+
+def test_exp_overflow_activations_finite_grad():
+    """softplus/sigmoid/tanh grads at +-80 are finite (naive exp(x)
+    overflows fp32 at ~88)."""
+    x = layers.data("x", [3], dtype="float32")
+    x.stop_gradient = False
+    out = layers.softplus(x) + layers.sigmoid(x) + layers.tanh(x)
+    append_backward(layers.reduce_sum(out))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.array([[80.0, -80.0, 0.0]], dtype="float32")
+    o, g = exe.run(feed={"x": xv}, fetch_list=[out, f"{x.name}@GRAD"])
+    _finite(o, g)
