@@ -414,29 +414,55 @@ def run_model(model: str, steps: int, peak_flops: float,
     }
 
 
-def _tune_and_run(model: str, steps: int, peak_flops: float) -> dict:
-    """Probe amp-tier x conv-layout combos on a few steps, then run the
-    full measurement with the winner.  Every probe is recorded so the
-    round artifact keeps the comparison (VERDICT r2 task 1)."""
-    # probe the historically-winning config FIRST (r3 on-chip sweep: keep
-    # tier beat conservative AMP on every model, NHWC beat NCHW for convs)
-    # so a budget expiry or a hung later probe still leaves the best-known
-    # config measured and picked
-    if model in CONV_MODELS:
-        combos = [("keep", "NHWC"), ("keep", "NCHW"),
-                  ("1", "NHWC"), ("1", "NCHW")]
-    else:
-        combos = [("keep", "NCHW"), ("1", "NCHW")]
+def _tune_and_run(model: str, steps: int, peak_flops: float,
+                  state: dict) -> dict:
+    """Measure FIRST, tune second: the full timed run happens immediately
+    on the safest historically-strong config (keep-tier AMP, NCHW — the
+    combination that has compiled reliably through the relay) and is
+    recorded into `state["results"]` before any probe runs, so a probe
+    compile that hangs the backend can no longer lose the model's number
+    (the 2026-07-31 relay wedge hit exactly that: three probes done, the
+    fourth hung, the deadline fired with nothing banked).  Probes for the
+    other amp-tier x conv-layout combos then run within the budget; if one
+    beats the banked number by >3% the timed run re-runs with it and the
+    recorded result is replaced in place.  Every probe is recorded in the
+    artifact's "tuned" field (VERDICT r2 task 1)."""
+    primary = ("keep", "NCHW")
     probe_steps = int(os.environ.get("BENCH_TUNE_STEPS", "5"))
-    # wall-clock budget for probing (each probe pays a fresh compile);
-    # when exceeded, remaining combos are skipped and the best PROBED
-    # config runs — never a dead artifact
+    result = run_model(model, steps, peak_flops, amp=primary[0],
+                       layout=primary[1])
+    probes = {f"amp={primary[0]},layout={primary[1]} (timed)":
+              result["value"]}
+    result["tuned"] = {
+        "probes": dict(probes),
+        "picked": f"amp={primary[0]},layout={primary[1]}",
+        "probe_steps": probe_steps,
+    }
+
+    def bank(r):
+        # the watchdog json.dumps's state["results"] concurrently: bank
+        # an isolated deep copy and only ever REPLACE the slot (atomic
+        # item assignment), never mutate a banked dict in place
+        return json.loads(json.dumps(r))
+
+    state["results"].append(bank(result))
+    slot = len(state["results"]) - 1
+
+    if model in CONV_MODELS:
+        combos = [("keep", "NHWC"), ("1", "NHWC"), ("1", "NCHW")]
+    else:
+        combos = [("1", "NCHW")]
     budget = float(os.environ.get("BENCH_TUNE_BUDGET_S", "600"))
     t0 = time.perf_counter()
-    probes = {}
-    best, best_v = combos[0], -1.0
+    # probe the primary too (executor cache makes this nearly free) so the
+    # rerun decision compares probe-to-probe, not a 5-step probe against
+    # the full-length run's throughput
+    r0 = run_model(model, probe_steps, peak_flops, amp=primary[0],
+                   layout=primary[1])
+    probes[f"amp={primary[0]},layout={primary[1]}"] = r0["value"]
+    best, best_v = primary, r0["value"]
     for amp, layout in combos:
-        if probes and time.perf_counter() - t0 > budget:
+        if time.perf_counter() - t0 > budget:
             probes["(budget_exhausted)"] = round(
                 time.perf_counter() - t0, 1)
             break
@@ -444,12 +470,22 @@ def _tune_and_run(model: str, steps: int, peak_flops: float) -> dict:
         probes[f"amp={amp},layout={layout}"] = r["value"]
         if r["value"] > best_v:
             best, best_v = (amp, layout), r["value"]
-    result = run_model(model, steps, peak_flops, amp=best[0], layout=best[1])
-    result["tuned"] = {
-        "probes": probes,
-        "picked": f"amp={best[0]},layout={best[1]}",
-        "probe_steps": probe_steps,
-    }
+    result["tuned"]["probes"] = dict(probes)
+    state["results"][slot] = bank(result)
+    if best != primary and best_v > r0["value"] * 1.03:
+        rerun = run_model(model, steps, peak_flops, amp=best[0],
+                          layout=best[1])
+        if rerun["value"] > result["value"]:
+            rerun["tuned"] = dict(
+                result["tuned"],
+                picked=f"amp={best[0]},layout={best[1]}",
+            )
+            result = rerun
+        else:
+            probes[f"amp={best[0]},layout={best[1]} (timed, slower)"] = (
+                rerun["value"])
+            result["tuned"]["probes"] = dict(probes)
+        state["results"][slot] = bank(result)
     return result
 
 
@@ -568,9 +604,11 @@ def main() -> None:
     _arm_deadline(state)
     try:
         for m in names:
-            r = (_tune_and_run(m, steps, peak_flops) if tune
-                 else run_model(m, steps, peak_flops, amp=amp, layout=layout))
-            state["results"].append(r)
+            if tune:
+                _tune_and_run(m, steps, peak_flops, state)  # self-records
+            else:
+                state["results"].append(
+                    run_model(m, steps, peak_flops, amp=amp, layout=layout))
         results = state["results"]
         primary = dict(results[0])
         if len(results) > 1:
