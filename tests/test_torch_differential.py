@@ -884,3 +884,55 @@ def test_loss_family_vs_torch():
                                rtol=1e-5, atol=1e-6, err_msg="cos_sim")
     np.testing.assert_allclose(gx, ta.grad.numpy(), rtol=1e-4, atol=1e-6,
                                err_msg="cos_sim dX")
+
+
+def test_global_norm_clip_trajectory_vs_torch():
+    """GradientClipByGlobalNorm + SGD over 4 steps vs torch
+    clip_grad_norm_ + SGD: the global norm spans BOTH parameters and the
+    clip factor is clip_norm/max(g_norm, clip_norm).  clip_norm=0.05 is
+    small enough that clipping is active every step."""
+    rng = np.random.RandomState(22)
+    D = 6
+    w0 = rng.randn(D, 4).astype("float32") * 0.5
+    v0 = rng.randn(4, 1).astype("float32") * 0.5
+    feeds = [(rng.randn(8, D).astype("float32"),
+              rng.randn(8, 1).astype("float32")) for _ in range(4)]
+
+    x = layers.data("x", [D], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    h = layers.fc(x, size=4, bias_attr=False)
+    pred = layers.fc(h, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.clip.set_gradient_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=0.05))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    mul_ops = [op for op in fluid.default_main_program().global_block().ops
+               if op.type == "mul"]
+    w_name, v_name = (op.input("Y")[0] for op in mul_ops[:2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var(w_name, w0.copy())
+    fluid.global_scope().set_var(v_name, v0.copy())
+    for xv, yv in feeds:
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    got_w = np.asarray(fluid.global_scope().find_var(w_name))
+    got_v = np.asarray(fluid.global_scope().find_var(v_name))
+
+    l1 = torch.nn.Linear(D, 4, bias=False)
+    l2 = torch.nn.Linear(4, 1, bias=False)
+    with torch.no_grad():
+        l1.weight.copy_(torch.tensor(w0.T))
+        l2.weight.copy_(torch.tensor(v0.T))
+    opt = torch.optim.SGD(list(l1.parameters()) + list(l2.parameters()),
+                          lr=0.1)
+    for xv, yv in feeds:
+        opt.zero_grad()
+        out = l2(l1(torch.tensor(xv)))
+        ((out - torch.tensor(yv)) ** 2).mean().backward()
+        torch.nn.utils.clip_grad_norm_(
+            list(l1.parameters()) + list(l2.parameters()), 0.05)
+        opt.step()
+    np.testing.assert_allclose(got_w, l1.weight.detach().numpy().T,
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got_v, l2.weight.detach().numpy().T,
+                               rtol=1e-4, atol=1e-6)
