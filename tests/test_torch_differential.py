@@ -936,3 +936,41 @@ def test_global_norm_clip_trajectory_vs_torch():
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(got_v, l2.weight.detach().numpy().T,
                                rtol=1e-4, atol=1e-6)
+
+
+def test_l2_regularizer_trajectory_vs_torch_weight_decay():
+    """L2DecayRegularizer(coeff) appends coeff*param to the gradient ==
+    torch SGD(weight_decay=coeff); four coupled steps must match."""
+    rng = np.random.RandomState(23)
+    D = 5
+    w0 = rng.randn(D, 1).astype("float32")
+    feeds = [(rng.randn(8, D).astype("float32"),
+              rng.randn(8, 1).astype("float32")) for _ in range(4)]
+
+    x = layers.data("x", [D], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False,
+                     param_attr=fluid.ParamAttr(
+                         regularizer=fluid.regularizer.L2Decay(0.1)))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    w_name = next(op for op in
+                  fluid.default_main_program().global_block().ops
+                  if op.type == "mul").input("Y")[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var(w_name, w0.copy())
+    for xv, yv in feeds:
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+    got = np.asarray(fluid.global_scope().find_var(w_name))
+
+    lin = torch.nn.Linear(D, 1, bias=False)
+    with torch.no_grad():
+        lin.weight.copy_(torch.tensor(w0.T))
+    opt = torch.optim.SGD(lin.parameters(), lr=0.1, weight_decay=0.1)
+    for xv, yv in feeds:
+        opt.zero_grad()
+        ((lin(torch.tensor(xv)) - torch.tensor(yv)) ** 2).mean().backward()
+        opt.step()
+    np.testing.assert_allclose(got, lin.weight.detach().numpy().T,
+                               rtol=1e-5, atol=1e-6)
