@@ -4,7 +4,7 @@ GradientClipByGlobalNorm, set_gradient_clip)."""
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from .layer_helper import LayerHelper
 
@@ -124,22 +124,25 @@ def _square_sum(grad):
     return layers.reduce_sum(sq)
 
 
-_gradient_clip_attr: Optional[BaseGradientClipAttr] = None
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    """Set the clip strategy (reference: clip.py set_gradient_clip); with
-    param_list, attach per-param, else set the global default."""
-    global _gradient_clip_attr
-    if param_list:
-        for p in param_list:
-            if isinstance(p, str):
-                from .core.framework import default_main_program
+    """Attach the clip strategy to parameters (reference: clip.py:304
+    set_gradient_clip — param_list None means every parameter currently in
+    the program; the attr lives ON the parameters, never in module state,
+    so one program's clip cannot leak into the next)."""
+    if not isinstance(clip, BaseGradientClipAttr):
+        raise TypeError(
+            "'clip' should be an instance of BaseGradientClipAttr's "
+            "derived class")
+    from .core.framework import default_main_program
 
-                p = default_main_program().global_block().var(p)
-            p.gradient_clip_attr = clip
-    else:
-        _gradient_clip_attr = clip
+    if program is None:
+        program = default_main_program()
+    if param_list is None:
+        param_list = program.global_block().all_parameters()
+    for p in param_list:
+        if isinstance(p, str):
+            p = program.global_block().var(p)
+        p.gradient_clip_attr = clip
 
 
 def append_gradient_clip_ops(param_grads: List[Tuple]):
@@ -149,7 +152,7 @@ def append_gradient_clip_ops(param_grads: List[Tuple]):
         if g is None:
             clips.append((p, g))
             continue
-        clip_attr = getattr(p, "gradient_clip_attr", None) or _gradient_clip_attr
+        clip_attr = getattr(p, "gradient_clip_attr", None)
         if clip_attr is None:
             clips.append((p, g))
             continue
