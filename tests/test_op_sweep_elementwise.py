@@ -142,3 +142,45 @@ def test_mod_floordiv_truncated_semantics():
                      fetch_list=[m, d])
     np.testing.assert_allclose(gm, np.fmod(x, y), rtol=1e-6)
     np.testing.assert_allclose(gd, np.trunc(x / y), rtol=1e-6)
+
+
+def test_broadcast_axis_fuzz():
+    """Seeded fuzz of the reference's axis-based broadcasting
+    (elementwise_op.h: Y's shape must match a contiguous slice of X's
+    dims starting at `axis`; trailing X dims broadcast): random ranks,
+    slice positions, and ops, checked against explicit numpy expansion."""
+    rng = np.random.RandomState(42)
+    ops = {
+        "elementwise_add": np.add,
+        "elementwise_sub": np.subtract,
+        "elementwise_mul": np.multiply,
+        "elementwise_div": np.divide,
+        "elementwise_max": np.maximum,
+        "elementwise_min": np.minimum,
+    }
+    for trial in range(30):
+        xrank = rng.randint(2, 5)
+        xshape = tuple(rng.randint(1, 5) for _ in range(xrank))
+        ylen = rng.randint(1, xrank + 1)
+        axis = rng.randint(0, xrank - ylen + 1)
+        yshape = xshape[axis:axis + ylen]
+        x = rng.randn(*xshape).astype("float32")
+        y = (rng.randn(*yshape).astype("float32") + 3.0)  # div-safe
+        name = list(ops)[trial % len(ops)]
+
+        expanded = y.reshape(yshape + (1,) * (xrank - axis - ylen))
+        want = ops[name](x, expanded)
+
+        class T(OpTest):
+            op_type = name
+
+        t = T()
+        t.inputs = {"X": x, "Y": y}
+        t.attrs = {"axis": axis}
+        t.outputs = {"Out": want}
+        try:
+            t.check_output(atol=1e-5, rtol=1e-5)
+        except Exception as e:  # pragma: no cover - diagnostic context
+            raise AssertionError(
+                f"trial {trial}: {name} x{xshape} y{yshape} axis={axis}"
+            ) from e
