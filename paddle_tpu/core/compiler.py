@@ -380,24 +380,39 @@ def collect_needed_vjps(block: Block) -> set:
     }
 
 
-_compile_cache_applied = False
+_compile_cache_applied_dir: str | None = None
+_compile_cache_prior: object = None  # jax config value before first apply
 
 
 def _maybe_enable_compile_cache() -> None:
-    """Apply FLAGS_compile_cache_dir once: point jax's persistent
-    executable cache at the directory so identical programs skip
-    recompilation across processes (relay compiles cost minutes).  A
-    backend that can't serialize executables makes jax log and skip —
-    never fatal."""
-    global _compile_cache_applied
-    if _compile_cache_applied:
-        return
+    """Apply FLAGS_compile_cache_dir: point jax's persistent executable
+    cache at the directory so identical programs skip recompilation across
+    processes (relay compiles cost minutes).  Tracks the APPLIED directory
+    (not a latch) so a later set_flags pointing somewhere else re-applies,
+    and clearing the flag restores whatever jax config the user had BEFORE
+    the first apply (ADVICE r3).  A backend that can't serialize
+    executables makes jax log and skip — never fatal."""
+    global _compile_cache_applied_dir, _compile_cache_prior
     from .. import flags
 
     cache_dir = flags.flag("compile_cache_dir")
     if not cache_dir:
-        return  # not latched: a later set_flags can still enable it
-    _compile_cache_applied = True
+        if _compile_cache_applied_dir is not None:
+            # the flag was cleared after being applied: fall back to the
+            # user's own pre-apply jax setting (often None = disabled;
+            # cold-compile measurements depend on this)
+            _compile_cache_applied_dir = None
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  _compile_cache_prior)
+            except Exception:
+                pass
+        return
+    if str(cache_dir) == _compile_cache_applied_dir:
+        return
+    if _compile_cache_applied_dir is None:
+        _compile_cache_prior = jax.config.jax_compilation_cache_dir
+    _compile_cache_applied_dir = str(cache_dir)
     try:
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     except Exception:

@@ -48,7 +48,8 @@ _DEFS: Dict[str, Any] = {
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.
-    # Applied lazily at the first block compile (core/compiler.py); a
+    # Applied immediately by set_flags (and re-checked at each fresh block
+    # compile, core/compiler.py); setting "" disables the cache again.  A
     # backend whose PJRT plugin can't serialize executables logs and
     # continues uncached
     "FLAGS_compile_cache_dir": "",
@@ -103,7 +104,12 @@ _CHOICES: Dict[str, tuple] = {
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
-    """reference parity: paddle.set_flags({'FLAGS_check_nan_inf': True})."""
+    """reference parity: paddle.set_flags({'FLAGS_check_nan_inf': True}).
+
+    Validates the WHOLE dict before committing any value or side effect:
+    a typo in one flag must not leave a partial update (or an already-
+    redirected compile cache) behind the raised error."""
+    staged: Dict[str, Any] = {}
     for name, value in flags.items():
         cname = _canon(name)
         if cname not in _DEFS:
@@ -116,4 +122,12 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if cname in _CHOICES and coerced not in _CHOICES[cname]:
             raise ValueError(
                 f"{cname} must be one of {_CHOICES[cname]}, got {coerced!r}")
-        _VALUES[cname] = coerced
+        staged[cname] = coerced
+    _VALUES.update(staged)
+    if "FLAGS_compile_cache_dir" in staged:
+        # apply immediately: the compile-path hook only fires on cache
+        # misses, so a redirect between two cached runs would otherwise
+        # be ignored until the next fresh compile (ADVICE r3)
+        from .core import compiler
+
+        compiler._maybe_enable_compile_cache()

@@ -2,9 +2,12 @@
 inference_transpiler.py:24 InferenceTranspiler).
 
 The reference folds a trained batch_norm into the preceding conv2d by
-rewriting the conv filter and bias host-side (``_fuse_batch_norm``
-inference_transpiler.py:300), then flips every op into test mode
-(``_is_test_pass`` :78).  The MKLDNN-only passes (conv+relu, conv+eltwise,
+computing folded filter/bias host-side and writing them into NEW
+``<name>_fuse_bn`` variables, renaming the op inputs (``_fuse_batch_norm``
+inference_transpiler.py:300, ``_fuse_param`` :416) — the original
+parameters survive untouched, so transpiling an inference clone against
+the shared global scope while the training program is live is safe.  It
+then flips every op into test mode (``_is_test_pass`` :78).  The MKLDNN-only passes (conv+relu, conv+eltwise,
 bn+relu fusion, :108-:298) have no equivalent here: XLA fuses elementwise
 epilogues into the conv at compile time, so those rewrites would change
 nothing on TPU.
@@ -106,28 +109,17 @@ class InferenceTranspiler:
             conv_out = op.output("Output")[0]
             if conv_out in protected:
                 continue
-            # the fold rewrites the Filter's (and the adopted bias var's)
-            # VALUE in the scope, so a parameter shared with any other op
-            # (weight-tied convs, a Bias shared across batch_norms) must
-            # disqualify the fold — each fold would scale the shared
-            # tensor again, silently corrupting the other reader
-            if len(all_consumers(op.input("Filter")[0])) != 1:
-                continue
             consumers = all_consumers(conv_out)
             if len(consumers) != 1 or consumers[0][0] is None:
                 continue
             j, nxt = consumers[0]
             if nxt.type == "batch_norm" and nxt.input("X") == [conv_out]:
-                if len(all_consumers(nxt.input("Bias")[0])) != 1:
-                    continue  # bn Bias shared with another op
                 self._fold(block, scope, op, bn_idx=j, bias_op=None)
                 continue
             if nxt.type == "elementwise_add" and nxt.attr("axis", -1) == 1:
                 bias_name = nxt.input("Y")[0]
                 if not self._is_channel_bias(block, bias_name):
                     continue
-                if len(all_consumers(bias_name)) != 1:
-                    continue  # conv bias shared with another op
                 add_out = nxt.output("Out")[0]
                 if add_out in protected:
                     continue
@@ -156,6 +148,38 @@ class InferenceTranspiler:
                 f"scope — run the startup program (and load params) first")
         return np.asarray(val)
 
+    @staticmethod
+    def _fused_copy(block, scope, src_name, value, shape):
+        """Write `value` into a NEW persistable var `<src>_fuse_bn` (unique-
+        suffixed if a previous fold already claimed the name, e.g. two convs
+        sharing one filter) and return its name.  The reference does exactly
+        this in _fuse_param (inference_transpiler.py:435 new_param_name =
+        old_param_name + '_fuse_bn'): the ORIGINAL parameter survives
+        untouched, so transpiling an inference clone against the shared
+        global scope while the training program is live cannot corrupt
+        training, and save_persistables on the training program still writes
+        the true weights."""
+        import dataclasses
+
+        name = src_name + "_fuse_bn"
+        n = 2
+        while block.desc.has_var(name) or scope.find_var(name) is not None:
+            name = f"{src_name}_fuse_bn_{n}"
+            n += 1
+        src_desc = block.desc.vars.get(src_name)
+        if src_desc is None:
+            # a runnable conv/add always carries its param descs; a missing
+            # one is desc corruption — fail loudly rather than fabricate a
+            # default-FP32 desc that would disagree with the scope value
+            raise ValueError(
+                f"InferenceTranspiler: parameter '{src_name}' has no "
+                f"VarDesc in the program — cannot fold")
+        desc = dataclasses.replace(
+            src_desc, name=name, shape=list(shape), persistable=True)
+        block.desc.vars[name] = desc
+        scope.set_var(name, value)
+        return name
+
     def _fold(self, block, scope, conv_op, bn_idx, bias_op):
         bn = block.ops[bn_idx]
         w_name = conv_op.input("Filter")[0]
@@ -170,21 +194,24 @@ class InferenceTranspiler:
         # filter is [Cout, Cin/groups, kh, kw]: channel axis 0 for any groups
         alpha = scale / np.sqrt(var + eps)
         w_new = (w.astype(np.float64) * alpha.reshape((-1,) + (1,) * (w.ndim - 1)))
-        scope.set_var(w_name, w_new.astype(w.dtype))
+        conv_op.desc.inputs["Filter"] = [self._fused_copy(
+            block, scope, w_name, w_new.astype(w.dtype), w.shape)]
 
-        bias_name = bn.input("Bias")[0]
         if bias_op is not None:
             old_bias = self._scope_array(scope, bias_op.input("Y")[0])
             b_new = (old_bias.astype(np.float64) - mean) * alpha + beta
-            bias_name = bias_op.input("Y")[0]
-            scope.set_var(bias_name, b_new.astype(old_bias.dtype))
+            bias_op.desc.inputs["Y"] = [self._fused_copy(
+                block, scope, bias_op.input("Y")[0],
+                b_new.astype(old_bias.dtype), old_bias.shape)]
             # redirect the existing add's output to the bn output so
             # downstream consumers are untouched
             bias_op.desc.outputs["Out"] = [bn.output("Y")[0]]
             block._remove_op(bn_idx)
         else:
             b_new = (0.0 - mean) * alpha + beta
-            scope.set_var(bias_name, b_new.astype(beta_raw.dtype))
+            bias_name = self._fused_copy(
+                block, scope, bn.input("Bias")[0],
+                b_new.astype(beta_raw.dtype), beta.shape)
             conv_out = conv_op.output("Output")[0]
             bn_y = bn.output("Y")[0]
             block._remove_op(bn_idx)

@@ -167,7 +167,7 @@ def test_compile_cache_dir_flag_applies(tmp_path, monkeypatch):
     from paddle_tpu import layers
 
     prev = jax.config.jax_compilation_cache_dir
-    monkeypatch.setattr(compiler, "_compile_cache_applied", False)
+    monkeypatch.setattr(compiler, "_compile_cache_applied_dir", None)
     fl.set_flags({"FLAGS_compile_cache_dir": str(tmp_path)})
     try:
         x = layers.data("x", [2], dtype="float32")
@@ -176,6 +176,26 @@ def test_compile_cache_dir_flag_applies(tmp_path, monkeypatch):
         exe.run(fluid.default_startup_program())
         exe.run(feed={"x": np.zeros((2, 2), "float32")}, fetch_list=[loss])
         assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+
+        # pointing the flag at a NEW directory re-applies (ADVICE r3: the
+        # old latch silently ignored every later set_flags)
+        other = tmp_path / "second"
+        fl.set_flags({"FLAGS_compile_cache_dir": str(other)})
+        exe.run(feed={"x": np.zeros((2, 2), "float32")}, fetch_list=[loss])
+        assert jax.config.jax_compilation_cache_dir == str(other)
+
+        # clearing the flag restores the user's own pre-apply jax setting
+        # (None here = disabled; cold-compile measurements depend on this)
+        fl.set_flags({"FLAGS_compile_cache_dir": ""})
+        assert jax.config.jax_compilation_cache_dir == prev
+
+        # a typo'd flag elsewhere in the dict must not half-apply: the
+        # cache stays untouched when validation fails
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            fl.set_flags({"FLAGS_compile_cache_dir": str(tmp_path),
+                          "FLAGS_conv_layout": "NHCW"})
+        assert jax.config.jax_compilation_cache_dir == prev
     finally:
         fl.set_flags({"FLAGS_compile_cache_dir": ""})
         jax.config.update("jax_compilation_cache_dir", prev)

@@ -140,10 +140,43 @@ def test_analysis_predictor_applies_fold(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_weight_shared_filter_not_folded():
+def test_originals_survive_fold_for_live_training():
+    """The reference's documented usage: transpile an inference clone()
+    against the SHARED global scope while the training program is still
+    live (reference _fuse_param writes '<name>_fuse_bn' copies,
+    inference_transpiler.py:435).  The original Filter/Bias values must
+    survive untouched so continued training and save_persistables see the
+    true weights."""
+    exe, pred, xv = _train_convnet(with_bias=True)
+    infer = fluid.io.get_inference_program([pred])
+    block = infer.global_block()
+    conv = next(op for op in block.ops if op.type == "conv2d")
+    w_name = conv.input("Filter")[0]
+    scope = fluid.global_scope()
+    w_before = np.array(np.asarray(scope.find_var(w_name)))
+
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+
+    # conv now reads a renamed persistable copy; the original is untouched
+    new_w = conv.input("Filter")[0]
+    assert new_w == w_name + "_fuse_bn"
+    assert block.desc.has_var(new_w) and block.desc.vars[new_w].persistable
+    np.testing.assert_array_equal(
+        np.asarray(scope.find_var(w_name)), w_before)
+    assert not np.array_equal(np.asarray(scope.find_var(new_w)), w_before)
+
+    # training on the ORIGINAL program still runs and moves the true weights
+    y = np.zeros((4, 1), dtype="int64")
+    exe.run(feed={"x": xv, "y": y},
+            fetch_list=[fluid.default_main_program().global_block().ops[-1]
+                        .output("ParamOut")[0]])
+
+
+def test_weight_shared_filter_folds_safely():
     """Two convs sharing one Filter parameter, each followed by its own BN:
-    folding either would rescale the shared tensor twice — both must be
-    skipped."""
+    with copy-based folding each conv gets its OWN '<w>_fuse_bn' copy
+    (unique-suffixed on collision), the shared original is never scaled,
+    and both folds run."""
     x = layers.data("x", [3, 8, 8], dtype="float32")
     y = layers.data("y", [1], dtype="int64")
     shared = fluid.ParamAttr(name="shared_w")
@@ -166,8 +199,15 @@ def test_weight_shared_filter_not_folded():
 
     infer = fluid.io.get_inference_program([pred])
     (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    shared_before = np.array(
+        np.asarray(fluid.global_scope().find_var("shared_w")))
     fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
-    assert _bn_count(infer) == 2  # neither fold may run
+    assert _bn_count(infer) == 0  # both fold, each into its own copy
+    convs = [op for op in infer.global_block().ops if op.type == "conv2d"]
+    names = sorted(op.input("Filter")[0] for op in convs)
+    assert names == ["shared_w_fuse_bn", "shared_w_fuse_bn_2"]
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_var("shared_w")), shared_before)
     (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=1e-6, atol=1e-7)
+                               rtol=1e-5, atol=1e-6)

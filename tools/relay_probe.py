@@ -1,0 +1,49 @@
+"""Relay-health probe: try ONE tiny jit on the axon TPU backend with a
+hard deadline, in a clean subprocess (a wedged relay hangs init ~25 min
+server-side; the subprocess + timeout keeps the probe bounded).
+
+Exit 0 = relay alive (prints the measured tiny-jit wall time),
+exit 1 = wedged/timeout.  Used by bench.py's pre-probe and by the
+round-4 background watch loop (tools/relay_watch.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+PROBE_SRC = r"""
+import time
+import jax
+import jax.numpy as jnp
+t0 = time.perf_counter()
+x = jnp.ones((128, 128), jnp.bfloat16)
+y = jax.jit(lambda a: a @ a)(x)
+jax.block_until_ready(y)
+print(f"PROBE_OK {time.perf_counter() - t0:.1f}s", flush=True)
+"""
+
+
+def probe(timeout_s: float = 600.0) -> bool:
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"probe TIMEOUT after {timeout_s:.0f}s", flush=True)
+        return False
+    ok = out.returncode == 0 and "PROBE_OK" in out.stdout
+    tail = (out.stdout + out.stderr).strip().splitlines()
+    print(f"probe rc={out.returncode} wall={time.perf_counter() - t0:.1f}s "
+          f"{tail[-1] if tail else ''}", flush=True)
+    return ok
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
+    sys.exit(0 if probe(t) else 1)
