@@ -19,10 +19,11 @@ import jax
 import numpy as np
 
 from . import amp
+from .. import flags
 from .compiler import CompiledBlock
 from .framework import Program, Variable, default_main_program
 from .lod import LoDValue
-from .place import CPUPlace, Place, TPUPlace
+from .place import CPUPlace, Place, TPUPlace, device_is_tpu
 from .dtypes import checked_feed_cast
 from .proto import VarType, dtype_to_numpy, dtype_to_runtime
 from .scope import Scope, global_scope
@@ -287,6 +288,26 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ) -> List[Any]:
+        # trace-time defaults scope: auto conv layout / auto AMP resolve
+        # for the ACTUAL device this executor targets; entered around key
+        # computation, compilation, and execution so cache keys and traced
+        # programs always agree
+        with flags.tpu_trace_scope(device_is_tpu(self.place.jax_device())):
+            return self._run_scoped(
+                program, feed, fetch_list, feed_var_name, fetch_var_name,
+                scope, return_numpy, use_program_cache)
+
+    def _run_scoped(
+        self,
+        program,
+        feed,
+        fetch_list,
+        feed_var_name,
+        fetch_var_name,
+        scope,
+        return_numpy,
+        use_program_cache,
+    ) -> List[Any]:
         # fluid idiom: exe.run(CompiledProgram(...).with_data_parallel(...), ...)
         if program is not None and hasattr(program, "with_data_parallel"):
             src = getattr(program, "program", None) or default_main_program()
@@ -322,7 +343,8 @@ class Executor:
         # id(program) keeps alternating train/test programs from thrashing
         # one slot; the fingerprint check makes id reuse after GC harmless
         fp = program.desc.fingerprint()
-        key = (id(program), tuple(feed_names), tuple(fetch_names), amp.state_key())
+        key = (id(program), tuple(feed_names), tuple(fetch_names),
+               amp.state_key(), flags.trace_key())
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None and entry[0] != fp:
             entry = None
@@ -375,6 +397,19 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
     ) -> List[Any]:
+        with flags.tpu_trace_scope(device_is_tpu(self.place.jax_device())):
+            return self._run_steps_scoped(
+                program, feed_list, fetch_list, steps, scope, return_numpy)
+
+    def _run_steps_scoped(
+        self,
+        program,
+        feed_list,
+        fetch_list,
+        steps,
+        scope,
+        return_numpy,
+    ) -> List[Any]:
         """Run `steps` iterations in ONE device dispatch.
 
         The compiled block body is wrapped in a `lax.scan` whose carry is
@@ -422,7 +457,8 @@ class Executor:
 
         fp = program.desc.fingerprint()
         key = ("run_steps", id(program), steps, len(feed_list),
-               tuple(feed_names), tuple(fetch_names), amp.state_key())
+               tuple(feed_names), tuple(fetch_names), amp.state_key(),
+               flags.trace_key())
         entry = self._cache.get(key)
         if entry is not None and entry[0] != fp:
             entry = None

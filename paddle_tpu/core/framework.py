@@ -593,6 +593,8 @@ def reset_default_env() -> None:
     scope_mod._current_scope = scope_mod.Scope()
     _NAME_SCOPE_COUNTS.clear()
     unique_name_switch()  # fresh name counters: fc_0, conv2d_0, ... again
+    # NOTE: the AMP policy survives on purpose — enable_amp() is global
+    # process policy, not program state (amp.reset_amp() returns to auto)
 
 
 @contextlib.contextmanager

@@ -11,7 +11,17 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace", "is_compiled_with_cuda"]
+__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+           "is_compiled_with_cuda", "device_is_tpu"]
+
+
+def device_is_tpu(device) -> bool:
+    """True when a resolved jax device is a TPU (incl. the axon relay
+    backend).  Executors key the trace-time defaults scope
+    (flags.tpu_trace_scope: auto conv layout, auto AMP tier) off the
+    ACTUAL device platform, not the Place class — TPUPlace on a CPU-only
+    host resolves to CPU devices and keeps reference-parity numerics."""
+    return getattr(device, "platform", "") in ("tpu", "axon")
 
 
 class Place:

@@ -31,6 +31,7 @@ __all__ = [
     "pool2d",
     "pool3d",
     "batch_norm",
+    "fused_bn_add_act",
     "layer_norm",
     "group_norm",
     "dropout",
@@ -369,15 +370,10 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
     return out
 
 
-def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
-               param_attr=None, bias_attr=None, data_layout="NCHW",
-               in_place=False, name=None, moving_mean_name=None,
-               moving_variance_name=None, do_model_average_for_mean_and_var=False,
-               use_global_stats=False):
-    """Batch normalization (reference: layers/nn.py batch_norm).  Moving
-    mean/variance are persistable state vars updated in-graph."""
-    helper = LayerHelper("batch_norm", input=input, param_attr=param_attr,
-                         bias_attr=bias_attr, act=act, name=name)
+def _bn_build(helper, input, data_layout, moving_mean_name,
+              moving_variance_name):
+    """Shared scale/bias/moving-stat setup for batch_norm and its fused
+    twin: returns (inputs dict, outputs dict, out var)."""
     dtype = input.dtype
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     scale = helper.create_parameter(
@@ -404,25 +400,72 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
     saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
     out = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "X": [input], "Scale": [scale], "Bias": [bias],
+        "Mean": [mean], "Variance": [variance],
+    }
+    outputs = {
+        "Y": [out],
+        "MeanOut": [mean],
+        "VarianceOut": [variance],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+    return inputs, outputs, out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    """Batch normalization (reference: layers/nn.py batch_norm).  Moving
+    mean/variance are persistable state vars updated in-graph."""
+    helper = LayerHelper("batch_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    inputs, outputs, out = _bn_build(helper, input, data_layout,
+                                     moving_mean_name, moving_variance_name)
     helper.append_op(
         type="batch_norm",
-        inputs={
-            "X": [input], "Scale": [scale], "Bias": [bias],
-            "Mean": [mean], "Variance": [variance],
-        },
-        outputs={
-            "Y": [out],
-            "MeanOut": [mean],
-            "VarianceOut": [variance],
-            "SavedMean": [saved_mean],
-            "SavedVariance": [saved_var],
-        },
+        inputs=inputs,
+        outputs=outputs,
         attrs={
             "momentum": momentum, "epsilon": epsilon, "is_test": is_test,
             "data_layout": data_layout, "use_global_stats": use_global_stats,
         },
     )
     return helper.append_activation(out)
+
+
+def fused_bn_add_act(x, y=None, act="relu", is_test=False, momentum=0.9,
+                     epsilon=1e-5, param_attr=None, bias_attr=None,
+                     data_layout="NCHW", name=None, moving_mean_name=None,
+                     moving_variance_name=None, use_global_stats=False):
+    """batch_norm(x) [+ y] -> act, fused into one op whose backward
+    RECOMPUTES the normalize/add/act chain instead of storing it (the op
+    carries @recompute@; see ops/nn_ops.py _fused_bn_add_act).  Replaces
+    the batch_norm -> elementwise_add -> relu tail of a residual block
+    (reference kernels being subsumed: operators/batch_norm_op.cu.cc:1 +
+    elementwise/add + activation; later Paddle's
+    contrib.layers.fused_bn_add_act has this same surface).  Numerics
+    match the unfused chain exactly — parity-tested."""
+    helper = LayerHelper("fused_bn_add_act", input=x, param_attr=param_attr,
+                         bias_attr=bias_attr, act=None, name=name)
+    inputs, outputs, out = _bn_build(helper, x, data_layout,
+                                     moving_mean_name, moving_variance_name)
+    if y is not None:
+        inputs["Z"] = [y]
+    helper.append_op(
+        type="fused_bn_add_act",
+        inputs=inputs,
+        outputs=outputs,
+        attrs={
+            "momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+            "data_layout": data_layout, "use_global_stats": use_global_stats,
+            "act": act, "@recompute@": True,
+        },
+    )
+    return out
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,

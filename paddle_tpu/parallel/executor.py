@@ -22,6 +22,7 @@ import numpy as np
 
 import dataclasses
 
+from .. import flags
 from ..core.compiler import CompiledBlock
 from ..core.executor import _RunPlan
 from ..core.framework import Program, Variable, default_main_program
@@ -141,6 +142,24 @@ class ParallelExecutor:
         feed_dict: Optional[Dict[str, Any]] = None,
         return_numpy: bool = True,
     ) -> List[Any]:
+        # trace-time defaults scope keyed off the mesh's actual devices
+        # (see core/executor.py Executor.run)
+        with flags.tpu_trace_scope(self._mesh_is_tpu()):
+            return self._run_scoped(fetch_list, feed, feed_dict, return_numpy)
+
+    def _mesh_is_tpu(self) -> bool:
+        from ..core.place import device_is_tpu
+
+        devs = np.asarray(self.mesh.mesh.devices).ravel()
+        return bool(len(devs)) and device_is_tpu(devs[0])
+
+    def _run_scoped(
+        self,
+        fetch_list=None,
+        feed=None,
+        feed_dict=None,
+        return_numpy=True,
+    ) -> List[Any]:
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, (list, tuple)):
             # reference accepts one dict per device; global batch == concat.
@@ -170,7 +189,8 @@ class ParallelExecutor:
         from ..core import amp
 
         fp = self.program.desc.fingerprint()
-        key = (tuple(feed_names), tuple(fetch_names), amp.state_key())
+        key = (tuple(feed_names), tuple(fetch_names), amp.state_key(),
+               flags.trace_key())
         entry = self._cache.get(key)
         if entry is not None and entry[0] != fp:
             entry = None
@@ -216,6 +236,17 @@ class ParallelExecutor:
         fetch_list: Optional[Sequence] = None,
         steps: Optional[int] = None,
         return_numpy: bool = True,
+    ) -> List[Any]:
+        with flags.tpu_trace_scope(self._mesh_is_tpu()):
+            return self._run_steps_scoped(
+                feed_list, fetch_list, steps, return_numpy)
+
+    def _run_steps_scoped(
+        self,
+        feed_list=None,
+        fetch_list=None,
+        steps=None,
+        return_numpy=True,
     ) -> List[Any]:
         """Run `steps` SPMD iterations in ONE device dispatch: the compiled
         block body runs under `lax.scan` inside a single pjit over the mesh,
@@ -263,7 +294,7 @@ class ParallelExecutor:
 
         fp = self.program.desc.fingerprint()
         key = ("pe_run_steps", steps, len(feed_list), tuple(feed_names),
-               tuple(fetch_names), amp.state_key())
+               tuple(fetch_names), amp.state_key(), flags.trace_key())
         entry = self._cache.get(key)
         if entry is not None and entry[0] != fp:
             entry = None

@@ -110,7 +110,7 @@ def test_conv_layout_nhwc_parity():
             out, = exe.run(feed={"img": x}, fetch_list=[y])
             outs[layout] = np.asarray(out)
         finally:
-            fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
+            fluid.set_flags({"FLAGS_conv_layout": "auto"})
     np.testing.assert_allclose(outs["NCHW"], outs["NHWC"],
                                rtol=1e-5, atol=1e-5)
 
@@ -147,7 +147,7 @@ def test_conv_layout_nhwc_pool_parity():
             w, = exe.run(feed={"img": x}, fetch_list=[f"wp_{layout}"])
             results[layout] = (np.asarray(out), np.asarray(w))
         finally:
-            fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
+            fluid.set_flags({"FLAGS_conv_layout": "auto"})
     np.testing.assert_allclose(results["NCHW"][0], results["NHWC"][0],
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(results["NCHW"][1], results["NHWC"][1],
@@ -199,3 +199,70 @@ def test_compile_cache_dir_flag_applies(tmp_path, monkeypatch):
     finally:
         fl.set_flags({"FLAGS_compile_cache_dir": ""})
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_auto_defaults_resolve_by_device_scope():
+    """FLAGS_conv_layout defaults to "auto": NCHW outside a TPU trace
+    scope (reference parity), NHWC inside one; un-set AMP resolves to
+    keep-tier bf16 only inside the scope.  Explicit settings win over
+    auto in both directions (VERDICT r3 item 5)."""
+    from paddle_tpu import flags as fl
+    from paddle_tpu.core import amp
+
+    fluid.set_flags({"FLAGS_conv_layout": "auto"})  # the shipped default
+    amp.reset_amp()  # clear any explicit policy left by earlier tests
+    assert fl.conv_layout() == "NCHW"
+    assert amp.state_key() is None
+    with fl.tpu_trace_scope(True):
+        assert fl.conv_layout() == "NHWC"
+        assert amp.state_key() == ("bfloat16", True)
+        assert fl.trace_key()[0] == "NHWC"
+
+        # explicit pins win inside the scope
+        fluid.set_flags({"FLAGS_conv_layout": "NCHW"})
+        fluid.disable_amp()
+        try:
+            assert fl.conv_layout() == "NCHW"
+            assert amp.state_key() is None
+        finally:
+            fluid.set_flags({"FLAGS_conv_layout": "auto"})
+            amp.reset_amp()
+    # back outside: auto resolves to parity defaults again
+    assert fl.conv_layout() == "NCHW"
+    assert amp.state_key() is None
+
+
+def test_tpu_place_gets_tuned_defaults(monkeypatch):
+    """A fresh Executor run against a TPU device picks keep-tier bf16 +
+    NHWC with NO env vars or enable_amp calls: conv activations come back
+    bfloat16 while params/loss stay fp32 master precision.  (The device
+    check is monkeypatched — the suite runs on the CPU backend.)"""
+    from paddle_tpu import layers
+    from paddle_tpu.core import amp, executor as exec_mod
+
+    amp.reset_amp()
+    monkeypatch.setattr(exec_mod, "device_is_tpu", lambda d: True)
+    fluid.reset_default_env()
+    x = layers.data("x", [3, 8, 8], dtype="float32")
+    c = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+    loss = layers.reduce_mean(c)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(3).randn(2, 3, 8, 8).astype("float32")
+    w_name = next(op for op in fluid.default_main_program()
+                  .global_block().ops
+                  if op.type == "conv2d").input("Filter")[0]
+    cv, wv = exe.run(feed={"x": xv}, fetch_list=[c, w_name],
+                     return_numpy=False)
+    import jax.numpy as jnp
+
+    assert jnp.asarray(cv).dtype == jnp.bfloat16  # keep-tier activations
+    assert jnp.asarray(wv).dtype == jnp.float32   # fp32 master weights
+
+    # the same program on a non-TPU device stays fp32 (fresh executor;
+    # the cache key includes the resolved policy so no stale reuse)
+    monkeypatch.setattr(exec_mod, "device_is_tpu", lambda d: False)
+    cv2, _ = exe.run(feed={"x": xv}, fetch_list=[c, loss],
+                     return_numpy=False)
+    assert jnp.asarray(cv2).dtype == jnp.float32

@@ -1,0 +1,139 @@
+"""fused_bn_add_act: the one-op BN + residual + activation with a
+recompute-tagged backward (ops/nn_ops.py _fused_bn_add_act; replaces the
+reference's batch_norm_op.cu.cc + elementwise_add + relu dispatches).
+
+The contract is NUMERICAL IDENTITY with the unfused chain — same losses,
+same trained weights, same moving statistics — with the storage trade
+happening purely inside jax.checkpoint."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _train(fused: bool, steps=4, with_residual=True, seed=11):
+    fluid.reset_default_env()
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    conv = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="w_conv"))
+    res = x if with_residual else None
+    if fused:
+        h = layers.fused_bn_add_act(
+            conv, res, act="relu",
+            param_attr=fluid.ParamAttr(name="bn_scale"),
+            bias_attr=fluid.ParamAttr(name="bn_bias"),
+            moving_mean_name="bn_mean", moving_variance_name="bn_var")
+    else:
+        b = layers.batch_norm(conv, act=None,
+                              param_attr=fluid.ParamAttr(name="bn_scale"),
+                              bias_attr=fluid.ParamAttr(name="bn_bias"),
+                              moving_mean_name="bn_mean",
+                              moving_variance_name="bn_var")
+        h = layers.relu(layers.elementwise_add(b, res) if res is not None
+                        else b)
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax",
+                     param_attr=fluid.ParamAttr(name="w_fc"))
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.1, momentum=0.9).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(5)
+    xv = rng.randn(8, 4, 8, 8).astype("float32")
+    yv = rng.randint(0, 3, size=(8, 1)).astype("int64")
+    losses = [
+        float(np.ravel(np.asarray(
+            exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]))[0])
+        for _ in range(steps)
+    ]
+    scope = fluid.global_scope()
+    state = {
+        n: np.array(np.asarray(scope.find_var(n)))
+        for n in ("w_conv", "bn_scale", "bn_bias", "bn_mean", "bn_var",
+                  "w_fc")
+    }
+    return losses, state, (exe, pred, xv)
+
+
+def _assert_matches(with_residual):
+    ref_losses, ref_state, _ = _train(False, with_residual=with_residual)
+    fus_losses, fus_state, _ = _train(True, with_residual=with_residual)
+    np.testing.assert_allclose(ref_losses, fus_losses, rtol=1e-5, atol=1e-6)
+    assert ref_losses[-1] < ref_losses[0]  # training actually moved
+    for n in ref_state:
+        np.testing.assert_allclose(
+            ref_state[n], fus_state[n], rtol=1e-5, atol=1e-6,
+            err_msg=f"state {n} diverged between fused and unfused")
+
+
+def test_fused_matches_unfused_with_residual():
+    _assert_matches(with_residual=True)
+
+
+def test_fused_matches_unfused_without_residual():
+    _assert_matches(with_residual=False)
+
+
+def test_fused_op_is_recompute_tagged():
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    layers.fused_bn_add_act(layers.conv2d(x, 4, 3, padding=1), x)
+    ops = fluid.default_main_program().global_block().ops
+    fused = [op for op in ops if op.type == "fused_bn_add_act"]
+    assert len(fused) == 1
+    assert fused[0].attr("@recompute@") is True
+
+
+def test_fused_test_mode_uses_moving_stats():
+    """for_test clone: normalize with the moving stats, no stat update —
+    exercised through the inference-program path like batch_norm."""
+    _, _, (exe, pred, xv) = _train(True, steps=3)
+    infer = fluid.io.get_inference_program([pred])
+    mean_before = np.array(
+        np.asarray(fluid.global_scope().find_var("bn_mean")))
+    (o1,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    (o2,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-6, atol=1e-7)  # deterministic
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_var("bn_mean")), mean_before)
+
+
+def test_resnet_fused_matches_unfused():
+    """resnet_cifar10-scale end to end: fuse_bn=True and False give the
+    same loss trajectory (the flagship model's default path is safe)."""
+    from paddle_tpu import models
+
+    def run(fuse_bn):
+        fluid.reset_default_env()
+        fluid.default_main_program().random_seed = 3
+        fluid.default_startup_program().random_seed = 3
+        img = layers.data("image", [3, 16, 16], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        s = _shortcut_block(img, fuse_bn)
+        pool = layers.pool2d(s, pool_size=8, pool_type="avg")
+        pred = layers.fc(pool, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(1)
+        xv = rng.randn(8, 3, 16, 16).astype("float32")
+        yv = rng.randint(0, 4, size=(8, 1)).astype("int64")
+        return [
+            float(np.ravel(np.asarray(exe.run(
+                feed={"image": xv, "label": yv}, fetch_list=[loss])[0]))[0])
+            for _ in range(4)
+        ]
+
+    def _shortcut_block(img, fuse_bn):
+        return models.resnet.bottleneck(img, 8, 2, fuse_bn=fuse_bn)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,107 @@
+"""Incremental on-chip proof for the pallas flash-attention backward
+(VERDICT r3 item 3): three stages, each with its own hard deadline, so a
+relay that cannot compile the kernel is diagnosed by the CHEAP stage
+instead of a 50-minute full-model gamble (the round-3 relay crash).
+
+  stage 1  standalone backward, one block   dq+dkv pallas_calls, S=128
+  stage 2  multi-block backward             S=512, 4x4 grid per kernel
+  stage 3  flash fwd+bwd under jax.grad     the real custom-vjp path, jit
+
+Run:  python tools/flash_bwd_probe.py [stage] [timeout_s]
+Each stage runs in a clean subprocess; output is one JSON line per stage:
+{"stage": N, "ok": bool, "wall_s": ..., "detail": ...}.  Stop at the
+first failure — that IS the finding.  Only after all three pass is
+FLAGS_flash_bwd=pallas worth trying on a full bench model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+STAGE_SRC = {
+    1: r"""
+import time, jax, jax.numpy as jnp, numpy as np
+import importlib
+fa = importlib.import_module('paddle_tpu.kernels.flash_attention')
+B, H, S, D = 1, 1, 128, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+klen = jnp.full((B,), S, jnp.int32)
+out, lse = fa._pallas_flash(q, q, q, klen, causal=True, scale=0.125)
+g = jnp.ones_like(out)
+t0 = time.perf_counter()
+dq, dk, dv = fa._pallas_flash_bwd(q, q, q, klen, out, lse, g,
+                                  causal=True, scale=0.125)
+jax.block_until_ready((dq, dk, dv))
+print(f"STAGE_OK compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+""",
+    2: r"""
+import time, jax, jax.numpy as jnp, numpy as np
+import importlib
+fa = importlib.import_module('paddle_tpu.kernels.flash_attention')
+B, H, S, D = 2, 4, 512, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+klen = jnp.full((B,), S, jnp.int32)
+out, lse = fa._pallas_flash(q, q, q, klen, causal=True, scale=0.125)
+g = jnp.ones_like(out)
+t0 = time.perf_counter()
+dq, dk, dv = fa._pallas_flash_bwd(q, q, q, klen, out, lse, g,
+                                  causal=True, scale=0.125)
+jax.block_until_ready((dq, dk, dv))
+print(f"STAGE_OK compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+""",
+    3: r"""
+import time, jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.kernels.flash_attention import flash_attention
+fluid.set_flags({"FLAGS_flash_bwd": "pallas"})
+B, H, S, D = 2, 8, 512, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+
+def loss(q):
+    return flash_attention(q, q, q, causal=True).sum()
+
+t0 = time.perf_counter()
+g = jax.jit(jax.grad(loss))(q)
+jax.block_until_ready(g)
+print(f"STAGE_OK compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+""",
+}
+
+
+def run_stage(stage: int, timeout_s: float) -> dict:
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", STAGE_SRC[stage]],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ),
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        ok = out.returncode == 0 and "STAGE_OK" in out.stdout
+        tail = (out.stdout + out.stderr).strip().splitlines()
+        detail = tail[-1][:300] if tail else ""
+    except subprocess.TimeoutExpired:
+        ok, detail = False, f"timeout after {timeout_s:.0f}s"
+    return {"stage": stage, "ok": ok,
+            "wall_s": round(time.perf_counter() - t0, 1), "detail": detail}
+
+
+def main() -> None:
+    stages = ([int(sys.argv[1])] if len(sys.argv) > 1 else [1, 2, 3])
+    timeout_s = float(sys.argv[2]) if len(sys.argv) > 2 else 900.0
+    for s in stages:
+        r = run_stage(s, timeout_s)
+        print(json.dumps(r), flush=True)
+        if not r["ok"]:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,10 @@ print(f"PROBE_OK {time.perf_counter() - t0:.1f}s", flush=True)
 
 
 def probe(timeout_s: float = 600.0) -> bool:
-    env = dict(os.environ, JAX_PLATFORMS="axon")
+    # inherit the caller's backend selection: forcing axon here would
+    # wrongly abort benches on real-TPU hosts (JAX_PLATFORMS=tpu) or
+    # default-backend boxes
+    env = dict(os.environ)
     t0 = time.perf_counter()
     try:
         out = subprocess.run(
