@@ -21,7 +21,9 @@ def _conv_bn(input, num_filters, filter_size, stride=1, groups=1, act=None):
         groups=groups,
         bias_attr=False,
     )
-    return layers.batch_norm(input=conv, act=act)
+    # recompute-tagged fused BN(+act): numerics identical to batch_norm,
+    # backward rebuilds the chain instead of storing it (models/resnet.py)
+    return layers.fused_bn_add_act(conv, act=act)
 
 
 def _squeeze_excitation(input, num_channels, reduction_ratio):

@@ -1,0 +1,170 @@
+"""Chip-session orchestrator for round 4 (VERDICT items 1,3,4,6,8).
+
+When the axon relay is alive, run the measurement agenda in PRIORITY
+order, bank every result to disk as it lands, and keep risky compiles
+strictly after the safety numbers:
+
+  1. safety bench      BENCH_SAFE=1, resnet50+transformer+deepfm (tuned)
+  2. fuse_bn A/B       resnet50 with BENCH_FUSE_BN=0 (is the fused op a win?)
+  3. pyreader          lenet + resnet50 fed through the py_reader pipeline
+  4. longctx           transformer_longctx S=2048 (flash fwd, layer remat)
+  5. profiles          tools/tpu_profile.py resnet50 + deepfm
+  6. flash-bwd probe   tools/flash_bwd_probe.py stages 1..3 (risky: LAST)
+  7. flash-bwd bench   transformer with FLAGS_flash_bwd=pallas, ONLY if
+                       all three probe stages passed
+
+Every step is a clean subprocess with its own deadline; one step hanging
+cannot lose earlier banked results.  RISKY steps (6,7) are skipped when
+--no-risky is passed or when fewer than RISKY_MIN_S seconds remain before
+--stop-by (epoch seconds): protecting the relay near round end is round
+3's hard-learned lesson (its pallas compile crashed the relay hours
+before the driver's bench).
+
+Usage:
+  python tools/chip_session.py [--out DIR] [--stop-by EPOCH] [--no-risky]
+
+Results: one JSON file per step under --out (default bench_out/), plus a
+session log line per step on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RISKY_MIN_S = 2.5 * 3600  # leave 2.5h after any risky compile
+
+
+def run_step(name: str, cmd: list, env_extra: dict, timeout_s: float,
+             out_dir: str) -> dict:
+    env = dict(os.environ, **{k: str(v) for k, v in env_extra.items()})
+    t0 = time.perf_counter()
+    rec = {"step": name, "cmd": cmd, "env": env_extra, "t_start": time.time()}
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s, env=env, cwd=REPO)
+        rec["rc"] = out.returncode
+        rec["stderr_tail"] = out.stderr.strip()[-1500:]
+        lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        rec["stdout_tail"] = "\n".join(lines[-8:])[:3000]
+        parsed = []
+        for ln in lines:
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    parsed.append(json.loads(ln))
+                except ValueError:
+                    pass
+        rec["json"] = parsed
+    except subprocess.TimeoutExpired:
+        rec["rc"] = -1
+        rec["error"] = f"timeout after {timeout_s:.0f}s"
+    rec["wall_s"] = round(time.perf_counter() - t0, 1)
+    path = os.path.join(out_dir, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    ok = rec.get("rc") == 0
+    print(json.dumps({"step": name, "ok": ok, "wall_s": rec["wall_s"],
+                      "banked": path}), flush=True)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "bench_out"))
+    ap.add_argument("--stop-by", type=float, default=None,
+                    help="epoch seconds; risky steps need RISKY_MIN_S before this")
+    ap.add_argument("--no-risky", action="store_true")
+    ap.add_argument("--steps", default="",
+                    help="comma list to run a subset, e.g. safety,longctx")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    py = sys.executable
+
+    def risky_allowed() -> bool:
+        if args.no_risky:
+            return False
+        if args.stop_by is not None:
+            return (args.stop_by - time.time()) > RISKY_MIN_S
+        return True
+
+    # relay gate first: don't queue an hour of steps against a wedged relay
+    gate = run_step("relay_gate", [py, "tools/relay_probe.py", "600"],
+                    {}, 700, args.out)
+    if gate.get("rc") != 0:
+        print(json.dumps({"session": "aborted",
+                          "reason": "relay wedged at gate"}), flush=True)
+        sys.exit(1)
+
+    want = {s.strip() for s in args.steps.split(",") if s.strip()}
+
+    def wanted(name: str) -> bool:
+        return not want or name in want
+
+    if wanted("safety"):
+        run_step(
+            "safety",
+            [py, "bench.py"],
+            {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50,transformer,deepfm",
+             "BENCH_DEADLINE_S": "3300"},
+            3600, args.out)
+    if wanted("fuse_bn_ab"):
+        run_step(
+            "fuse_bn_ab",
+            [py, "bench.py"],
+            {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50",
+             "BENCH_FUSE_BN": "0", "BENCH_TUNE": "0", "BENCH_AMP": "keep",
+             "BENCH_LAYOUT": "NHWC", "BENCH_DEADLINE_S": "1500"},
+            1800, args.out)
+    if wanted("pyreader"):
+        run_step(
+            "pyreader",
+            [py, "bench.py"],
+            {"BENCH_SAFE": "1", "BENCH_MODELS": "lenet,resnet50",
+             "BENCH_DATA": "pyreader", "BENCH_TUNE": "0",
+             "BENCH_AMP": "keep", "BENCH_LAYOUT": "NHWC",
+             "BENCH_DEADLINE_S": "1500"},
+            1800, args.out)
+    if wanted("longctx"):
+        run_step(
+            "longctx",
+            [py, "bench.py"],
+            {"BENCH_SAFE": "1", "BENCH_MODELS": "transformer_longctx",
+             "BENCH_TUNE": "0", "BENCH_AMP": "keep",
+             "BENCH_DEADLINE_S": "1500"},
+            1800, args.out)
+    if wanted("profile_resnet"):
+        run_step("profile_resnet",
+                 [py, "tools/tpu_profile.py", "resnet50", "5"],
+                 {}, 1800, args.out)
+    if wanted("profile_deepfm"):
+        run_step("profile_deepfm",
+                 [py, "tools/tpu_profile.py", "deepfm", "5"],
+                 {}, 1800, args.out)
+
+    if wanted("flash_bwd"):
+        if not risky_allowed():
+            print(json.dumps({"step": "flash_bwd_probe", "skipped":
+                              "risky window closed"}), flush=True)
+            return
+        probe = run_step("flash_bwd_probe",
+                         [py, "tools/flash_bwd_probe.py"], {}, 3000,
+                         args.out)
+        stages = probe.get("json", [])
+        if probe.get("rc") == 0 and len(stages) == 3 and risky_allowed():
+            run_step(
+                "flash_bwd_bench",
+                [py, "bench.py"],
+                {"BENCH_MODELS": "transformer", "BENCH_TUNE": "0",
+                 "BENCH_AMP": "keep", "FLAGS_flash_bwd": "pallas",
+                 "BENCH_DEADLINE_S": "2700"},
+                3000, args.out)
+
+
+if __name__ == "__main__":
+    main()
