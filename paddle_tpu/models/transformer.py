@@ -68,6 +68,10 @@ class TransformerConfig:
     # with use_flash_attention; a chain of primitive ops keeps its
     # op-boundary activations resident either way.
     use_recompute: bool = False
+    # fold label smoothing into softmax_with_cross_entropy (smooth_eps):
+    # identical numbers, no [B, S, V] label tensors.  False restores the
+    # reference-shaped one_hot -> label_smooth -> soft-label chain
+    fuse_smooth_ce: bool = True
 
 
 def _sinusoid_table(max_len: int, d_model: int) -> np.ndarray:
@@ -294,15 +298,26 @@ def transformer(
     logits = b.linear(dec, cfg.d_model, cfg.trg_vocab_size, "project",
                       shard=[None, cfg.tp_axis], bias=False)
 
-    # label-smoothed CE, masked to non-pad target positions
-    one_hot = layers.one_hot(lbl_word, depth=cfg.trg_vocab_size)
-    if cfg.label_smooth_eps:
-        smooth = layers.label_smooth(one_hot, epsilon=cfg.label_smooth_eps)
+    # label-smoothed CE, masked to non-pad target positions.  The fused
+    # path folds the smoothing into softmax_with_cross_entropy analytically
+    # (smooth_eps attr, ops/loss_ops.py): no [B, S, V] one_hot/smooth
+    # tensors are ever materialized — at V=32k, bs=32 that chain moved
+    # ~1 GB/step of HBM.  fuse_smooth_ce=False keeps the reference-shaped
+    # one_hot -> label_smooth -> soft-label CE ops (parity-tested equal).
+    if cfg.fuse_smooth_ce:
+        cost = layers.softmax_with_cross_entropy(
+            logits=logits, label=lbl_word,
+            smooth_eps=cfg.label_smooth_eps,
+        )  # [B, S, 1]
     else:
-        smooth = one_hot
-    cost = layers.softmax_with_cross_entropy(
-        logits=logits, label=smooth, soft_label=True
-    )  # [B, S, 1]
+        one_hot = layers.one_hot(lbl_word, depth=cfg.trg_vocab_size)
+        if cfg.label_smooth_eps:
+            smooth = layers.label_smooth(one_hot, epsilon=cfg.label_smooth_eps)
+        else:
+            smooth = one_hot
+        cost = layers.softmax_with_cross_entropy(
+            logits=logits, label=smooth, soft_label=True
+        )  # [B, S, 1]
     cost = layers.squeeze(cost, axes=[2])
     pad = layers.fill_constant_batch_size_like(
         lbl_word, shape=[-1, S], dtype="int64", value=cfg.pad_idx

@@ -82,6 +82,14 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
             lab = jnp.squeeze(lab, axis=-1)
         picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=-1)
         loss = -picked
+        eps = attrs.get("smooth_eps", 0.0)
+        if eps:
+            # folded uniform label smoothing (layers.py smooth_eps): the
+            # smoothed target is (1-eps)*onehot + eps/V, so
+            # -sum(target*logp) = (1-eps)*picked_CE + eps*mean_V(-logp) —
+            # no [*, V] label tensor ever exists
+            loss = (1.0 - eps) * loss - eps * jnp.mean(
+                logp, axis=-1, keepdims=True)
         ignore = attrs.get("ignore_index", -100)
         loss = jnp.where((lab != ignore)[..., None], loss, 0.0)
     # outputs keep the logits' dtype (the fp32 math above is internal)

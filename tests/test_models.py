@@ -123,3 +123,32 @@ def test_transformer_masks_ignore_pad():
     (tc,) = exe.run(feed=batch, fetch_list=[spec.metrics["token_count"]])
     lbl = batch["lbl_word"]
     assert int(np.ravel(tc)[0]) == int((lbl != 0).sum())
+
+
+def test_transformer_fused_smooth_ce_parity():
+    """fuse_smooth_ce=True (smoothing folded into softmax_with_cross_entropy,
+    no [B,S,V] label tensors) must match the reference-shaped one_hot ->
+    label_smooth -> soft-label CE chain: same loss and same gradients,
+    checked over a short SGD trajectory with identical seeds."""
+    kw = dict(src_vocab_size=48, trg_vocab_size=48, max_length=8,
+              n_layer=1, n_head=2, d_model=16, d_inner=32, dropout=0.0,
+              label_smooth_eps=0.1)
+
+    def run(fused):
+        fluid.reset_default_env()
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        spec = models.transformer(
+            models.TransformerConfig(fuse_smooth_ce=fused, **kw))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        batch = spec.synthetic_batch(2, seed=3)
+        return [
+            float(np.ravel(np.asarray(exe.run(
+                feed=batch, fetch_list=[spec.loss])[0]))[0])
+            for _ in range(3)
+        ]
+
+    ref, fused = run(False), run(True)
+    np.testing.assert_allclose(ref, fused, rtol=1e-5, atol=1e-6)
