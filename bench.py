@@ -387,8 +387,12 @@ def run_model(model: str, steps: int, peak_flops: float,
         # latency is paid steps/K times
         steps = max(unroll, (steps // unroll) * unroll)
         feed_list = batches
+        # BENCH_UNROLL_MODE=flat: straight-line K-step jit (no lax.scan) —
+        # the relay serializes while-loop iterations (r3: scan form 100x
+        # slower through it), the flat form runs as one program
+        umode = os.environ.get("BENCH_UNROLL_MODE", "scan")
         (warm,) = exe.run_steps(feed_list=feed_list, fetch_list=[fetch_var],
-                                steps=unroll, return_numpy=False)
+                                steps=unroll, return_numpy=False, mode=umode)
         jax.block_until_ready(warm)
         with _maybe_trace(profile_logdir):
             t0 = time.perf_counter()
@@ -396,7 +400,7 @@ def run_model(model: str, steps: int, peak_flops: float,
             for _ in range(steps // unroll):
                 (loss_v,) = exe.run_steps(
                     feed_list=feed_list, fetch_list=[fetch_var],
-                    steps=unroll, return_numpy=False)
+                    steps=unroll, return_numpy=False, mode=umode)
             jax.block_until_ready(loss_v)
             dt = time.perf_counter() - t0
     else:

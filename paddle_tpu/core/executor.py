@@ -183,12 +183,34 @@ def _check_nan_inf(plan, fetches, new_states) -> None:
             )
 
 
-def scan_multi_fn(body, n_batches, steps):
+def scan_multi_fn(body, n_batches, steps, flat: bool = False):
     """Multi-step scan closure shared by Executor.run_steps and
     ParallelExecutor.run_steps: step i feeds batch i % n_batches; the
     LAST step's fetches ride in the carry (not scan ys — stacking
     steps x fetch would hold every step's outputs in HBM); fetch shapes
-    come from eval_shape, no extra compilation."""
+    come from eval_shape, no extra compilation.
+
+    flat=True replaces lax.scan with a Python-unrolled chain of `steps`
+    body calls in ONE jit: a straight-line program with no while loop.
+    Compile time grows with `steps`, but backends whose dispatch layer
+    serializes loop iterations (the axon relay ran the scan form ~100x
+    slower than per-step dispatch, CHANGES_r03) execute the flat form as
+    a single program — the amortization run_steps exists for.  Keep
+    `steps` modest (<= ~16) to bound compile time."""
+
+    def flat_multi(feeds_stack, state_vals, rng):
+        states, k = state_vals, rng
+        fetches = None
+        for i in range(steps):
+            batch = tuple(
+                jax.lax.index_in_dim(f, i % n_batches, keepdims=False)
+                for f in feeds_stack
+            )
+            fetches, states, k = body(batch, states, k)
+        return fetches, states, k
+
+    if flat:
+        return flat_multi
 
     def multi(feeds_stack, state_vals, rng):
         def take(i):
@@ -396,10 +418,12 @@ class Executor:
         steps: Optional[int] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        mode: str = "scan",
     ) -> List[Any]:
         with flags.tpu_trace_scope(device_is_tpu(self.place.jax_device())):
             return self._run_steps_scoped(
-                program, feed_list, fetch_list, steps, scope, return_numpy)
+                program, feed_list, fetch_list, steps, scope, return_numpy,
+                mode)
 
     def _run_steps_scoped(
         self,
@@ -409,6 +433,7 @@ class Executor:
         steps,
         scope,
         return_numpy,
+        mode="scan",
     ) -> List[Any]:
         """Run `steps` iterations in ONE device dispatch.
 
@@ -455,10 +480,13 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
         block0 = program.desc.block(0)
 
+        if mode not in ("scan", "flat"):
+            raise ValueError(f"run_steps mode must be 'scan' or 'flat', "
+                             f"got {mode!r}")
         fp = program.desc.fingerprint()
         key = ("run_steps", id(program), steps, len(feed_list),
                tuple(feed_names), tuple(fetch_names), amp.state_key(),
-               flags.trace_key())
+               flags.trace_key(), mode)
         entry = self._cache.get(key)
         if entry is not None and entry[0] != fp:
             entry = None
@@ -469,7 +497,8 @@ class Executor:
                 plan.state_names, donate_states=False,
             )
             fn = jax.jit(
-                scan_multi_fn(compiled.raw_fn, len(feed_list), steps),
+                scan_multi_fn(compiled.raw_fn, len(feed_list), steps,
+                              flat=(mode == "flat")),
                 donate_argnums=(1,) if self.donate_states else (),
             )
             entry = (fp, (compiled, fn), plan)

@@ -427,3 +427,52 @@ def test_seeded_training_is_deterministic():
                 for _ in range(4)]
 
     assert run_once() == run_once()
+
+
+def test_run_steps_flat_matches_scan():
+    """mode='flat' (straight-line K-step jit, no lax.scan — for dispatch
+    layers that serialize loop iterations) must give the identical
+    trajectory to the scan form: same final loss, params, and rng."""
+    fluid.reset_default_env()
+    x = fluid.layers.data("x", [4], dtype="float32")
+    label = fluid.layers.data("label", [1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="rf_w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(9)
+    feeds = [
+        {"x": rng.rand(8, 4).astype(np.float32),
+         "label": rng.rand(8, 1).astype(np.float32)}
+        for _ in range(3)
+    ]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    snapshot = {
+        n: np.asarray(scope.find_var(n)).copy()
+        for n in scope.local_var_names()
+        if scope.find_var(n) is not None
+    }
+    (lv_scan,) = exe.run_steps(feed_list=feeds, fetch_list=[loss], steps=7)
+    w_scan = np.asarray(scope.find_var("rf_w")).copy()
+    rng_scan = np.asarray(scope.find_var("@rng_key@")).copy()
+
+    for n in list(scope.local_var_names()):
+        if n in snapshot:
+            scope.set_var(n, snapshot[n])
+        else:
+            scope.erase(n)
+    (lv_flat,) = exe.run_steps(feed_list=feeds, fetch_list=[loss], steps=7,
+                               mode="flat")
+    np.testing.assert_allclose(np.ravel(lv_flat), np.ravel(lv_scan),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(scope.find_var("rf_w")), w_scan,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(scope.find_var("@rng_key@")), rng_scan)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="mode"):
+        exe.run_steps(feed_list=feeds, fetch_list=[loss], steps=2,
+                      mode="bogus")

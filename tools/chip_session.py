@@ -138,6 +138,18 @@ def main() -> None:
              "BENCH_TUNE": "0", "BENCH_AMP": "keep",
              "BENCH_DEADLINE_S": "1500"},
             1800, args.out)
+    if wanted("deepfm_unroll"):
+        # DeepFM at 62k ex/s = 8 ms/step is dispatch-latency shaped through
+        # the relay; flat unroll (straight-line 8-step jit, NO lax.scan —
+        # the relay serializes scan iterations) amortizes it 8x.  VERDICT
+        # r3 item 6's "obvious lever".
+        run_step(
+            "deepfm_unroll",
+            [py, "bench.py"],
+            {"BENCH_MODELS": "deepfm", "BENCH_TUNE": "0",
+             "BENCH_UNROLL": "8", "BENCH_UNROLL_MODE": "flat",
+             "BENCH_DEADLINE_S": "1500"},
+            1800, args.out)
     if wanted("profile_resnet"):
         run_step("profile_resnet",
                  [py, "tools/tpu_profile.py", "resnet50", "5"],
