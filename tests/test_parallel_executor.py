@@ -214,3 +214,64 @@ def test_pe_run_steps_with_tp_sharded_weight():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(w_got, w_want, rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_conv_fused_bn_matches_serial():
+    """The flagship conv path under SPMD: conv + fused_bn_add_act trained
+    data-parallel over the 8-device mesh must match the serial trajectory.
+    BN statistics reduce over the GLOBAL batch automatically (jnp.mean of
+    a dp-sharded tensor — XLA inserts the cross-shard reduction), i.e.
+    sync-BN semantics, so losses and weights agree with one-device runs."""
+    def build(seed=5):
+        fluid.default_main_program().random_seed = seed
+        fluid.default_startup_program().random_seed = seed
+        img = fluid.layers.data("img", [3, 8, 8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        conv = fluid.layers.conv2d(img, 4, 3, padding=1, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name="pc_w"))
+        h = fluid.layers.fused_bn_add_act(
+            conv, None, act="relu",
+            param_attr=fluid.ParamAttr(name="pc_scale"),
+            bias_attr=fluid.ParamAttr(name="pc_bias"),
+            moving_mean_name="pc_mean", moving_variance_name="pc_var")
+        pool = fluid.layers.pool2d(h, pool_size=8, pool_type="avg")
+        pred = fluid.layers.fc(pool, size=3, act="softmax",
+                               param_attr=fluid.ParamAttr(name="pc_fc"))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(2)
+    xv = rng.randn(16, 3, 8, 8).astype("float32")
+    yv = rng.randint(0, 3, size=(16, 1)).astype("int64")
+
+    fluid.reset_default_env()
+    loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    serial = [
+        float(np.ravel(exe.run(feed={"img": xv, "y": yv},
+                               fetch_list=[loss])[0])[0])
+        for _ in range(4)
+    ]
+    w_serial = np.asarray(fluid.global_scope().find_var("pc_w")).copy()
+    mean_serial = np.asarray(fluid.global_scope().find_var("pc_mean")).copy()
+
+    fluid.reset_default_env()
+    loss2 = build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(fluid.default_startup_program())
+    pe = fluid.ParallelExecutor(loss_name=loss2.name,
+                                mesh=make_mesh({"dp": 8}))
+    par = [
+        float(np.ravel(pe.run(fetch_list=[loss2],
+                              feed={"img": xv, "y": yv})[0])[0])
+        for _ in range(4)
+    ]
+    np.testing.assert_allclose(serial, par, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var("pc_w")), w_serial,
+        rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var("pc_mean")), mean_serial,
+        rtol=2e-4, atol=1e-6)
