@@ -638,6 +638,18 @@ def _relay_preprobe(state: dict) -> None:
 
 
 def main() -> None:
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        # pin through jax.config as well: a sitecustomize-registered
+        # accelerator plugin can hang backend discovery even when the env
+        # var selects cpu (tests/conftest.py uses the same pin; the CI
+        # bench smoke hung exactly here against a wedged relay)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plats)
+        except Exception:
+            pass
     if os.environ.get("BENCH_SAFE", "0") == "1":
         # only configs the relay has already survived this session: flash
         # forward stays on (it produced the r3 numbers); the pallas
