@@ -45,11 +45,14 @@ _DEFS: Dict[str, Any] = {
     # (VERDICT r3 item 5) while CPU keeps bit-parity with the reference
     "FLAGS_conv_layout": "auto",
     # flash-attention backward implementation: "jax" (recompute the
-    # reference formulation under jax.vjp — XLA fuses it well) or
-    # "pallas" (FlashAttention-2 dq/dkv kernels; O(S*D) HBM in backward).
-    # Default jax: the axon relay's remote-compile service has failed on
-    # full-model pallas-backward compiles (round 3); on a directly
-    # attached TPU host flip to "pallas" for long sequences
+    # reference formulation under jax.vjp — XLA fuses it well),
+    # "pallas" (this repo's FlashAttention-2 dq/dkv kernels; O(S*D) HBM
+    # in backward), or "jaxlib" (the jax-shipped TPU kernel pair, fwd AND
+    # bwd — independent compile behavior, tools/flash_bwd_probe.py
+    # compares).  Default jax: the axon relay's remote-compile service has
+    # failed on full-model pallas-backward compiles (round 3); on a
+    # directly attached TPU host flip to "pallas"/"jaxlib" for long
+    # sequences
     "FLAGS_flash_bwd": "jax",
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
@@ -105,7 +108,7 @@ def get_flags(names=None) -> Dict[str, Any]:
 # silently select the default branch at the use site)
 _CHOICES: Dict[str, tuple] = {
     "FLAGS_conv_layout": ("auto", "NCHW", "NHWC"),
-    "FLAGS_flash_bwd": ("jax", "pallas"),
+    "FLAGS_flash_bwd": ("jax", "pallas", "jaxlib"),
 }
 
 

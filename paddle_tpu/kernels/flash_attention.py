@@ -461,15 +461,49 @@ def _flash_bwd(causal, scale, force, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _jaxlib_flash(q, k, v, k_lengths, causal, scale):
+    """Route through the jax-shipped TPU pallas flash attention
+    (jax.experimental.pallas.ops.tpu.flash_attention) — a maintained
+    fwd+bwd kernel pair with its own custom_vjp.  Selected by
+    FLAGS_flash_bwd=jaxlib on TPU: an alternative to this module's
+    hand-written backward with independent compile behavior through the
+    relay (tools/flash_bwd_probe.py stage 4 compares them)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, SegmentIds, flash_attention as jx_flash)
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    seg = None
+    if k_lengths is not None:
+        kl = jnp.asarray(k_lengths, jnp.int32).reshape(-1)
+        # key-padding semantics: q rows all live (segment 1), padded key
+        # positions get segment 2 -> mismatch masks them, matching this
+        # module's klen contract
+        kvseg = jnp.where(
+            jnp.arange(Sk)[None, :] < kl[:, None], 1, 2
+        ).astype(jnp.int32)
+        seg = SegmentIds(q=jnp.ones((B, Sq), jnp.int32), kv=kvseg)
+    bs = BlockSizes.get_default(B, H, Sq, Sk, D)
+    return jx_flash(q, k, v, segment_ids=seg, causal=causal,
+                    sm_scale=float(scale), block_sizes=bs)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, k_lengths=None,
                     force="auto"):
     """q/k/v: [B, H, S, D].  k_lengths: optional [B] valid key counts
     (key-padding mask).
 
     force: "auto" (pallas on TPU, jax elsewhere), "pallas", "interpret"
-    (pallas interpreter — CPU testing), or "jax"."""
+    (pallas interpreter — CPU testing), or "jax".  Under force="auto" on
+    TPU, FLAGS_flash_bwd=jaxlib swaps in the jax-shipped kernel pair
+    (fwd AND bwd) instead of this module's kernels."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if force == "auto" and _on_tpu():
+        from .. import flags
+
+        if flags.flag("flash_bwd") == "jaxlib":
+            return _jaxlib_flash(q, k, v, k_lengths, causal, scale)
     if k_lengths is None:
         klen = jnp.full((q.shape[0],), k.shape[2], dtype=jnp.float32)
     else:

@@ -137,3 +137,23 @@ def test_resnet_fused_matches_unfused():
         return models.resnet.bottleneck(img, 8, 2, fuse_bn=fuse_bn)
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_flash_bwd_jaxlib_flag_accepted_cpu_fallback():
+    """FLAGS_flash_bwd=jaxlib routes to the jax-shipped TPU kernel pair on
+    TPU only; on CPU the flag is accepted and attention falls back to the
+    recompute-jax path with unchanged numerics."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    q = jnp.asarray(np.random.RandomState(0).randn(1, 2, 16, 8),
+                    jnp.float32)
+    base = flash_attention(q, q, q, causal=True)
+    fluid.set_flags({"FLAGS_flash_bwd": "jaxlib"})
+    try:
+        out = flash_attention(q, q, q, causal=True)
+    finally:
+        fluid.set_flags({"FLAGS_flash_bwd": "jax"})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               rtol=1e-6, atol=1e-7)

@@ -165,15 +165,21 @@ def main() -> None:
                               "risky window closed"}), flush=True)
             return
         probe = run_step("flash_bwd_probe",
-                         [py, "tools/flash_bwd_probe.py"], {}, 3000,
+                         [py, "tools/flash_bwd_probe.py"], {}, 4000,
                          args.out)
-        stages = probe.get("json", [])
-        if probe.get("rc") == 0 and len(stages) == 3 and risky_allowed():
+        stages = {r.get("stage"): r.get("ok")
+                  for r in probe.get("json", []) if isinstance(r, dict)}
+        impl = None
+        if stages.get(1) and stages.get(2) and stages.get(3):
+            impl = "pallas"          # in-repo kernels proven end to end
+        elif stages.get(4):
+            impl = "jaxlib"          # jax-shipped pair as the fallback
+        if impl and risky_allowed():
             run_step(
                 "flash_bwd_bench",
                 [py, "bench.py"],
                 {"BENCH_MODELS": "transformer", "BENCH_TUNE": "0",
-                 "BENCH_AMP": "keep", "FLAGS_flash_bwd": "pallas",
+                 "BENCH_AMP": "keep", "FLAGS_flash_bwd": impl,
                  "BENCH_DEADLINE_S": "2700"},
                 3000, args.out)
 
