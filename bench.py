@@ -432,7 +432,7 @@ def run_model(model: str, steps: int, peak_flops: float,
         f"# {model}: bs={bs} steps={steps} wall={dt:.2f}s "
         f"mfu={mfu:.3f} {tag}={float(np.ravel(np.asarray(loss_v))[0]):.4f}\n"
     )
-    return {
+    result = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
@@ -443,6 +443,20 @@ def run_model(model: str, steps: int, peak_flops: float,
         "data": "pyreader" if use_pyreader else "staged",
         "unroll": unroll if use_unroll else 1,
     }
+    # feature provenance, so a number is attributable to the config that
+    # produced it (fused BN / fused smoothed CE / flash backward impl)
+    feats = {}
+    if model == "resnet50":
+        feats["fuse_bn"] = os.environ.get("BENCH_FUSE_BN", "1") != "0"
+    if model in ("transformer", "transformer_longctx"):
+        feats["fuse_smooth_ce"] = cfg.fuse_smooth_ce
+        feats["flash_bwd"] = fluid.get_flags("flash_bwd")["FLAGS_flash_bwd"]
+        feats["recompute"] = cfg.use_recompute
+    if use_unroll:
+        feats["unroll_mode"] = os.environ.get("BENCH_UNROLL_MODE", "scan")
+    if feats:
+        result["features"] = feats
+    return result
 
 
 def _tune_and_run(model: str, steps: int, peak_flops: float,
