@@ -472,18 +472,44 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
     beats the banked number by >3% the timed run re-runs with it and the
     recorded result is replaced in place.  Every probe is recorded in the
     artifact's "tuned" field (VERDICT r2 task 1)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _env(overrides):
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _probe_name(amp, layout, env_over):
+        extra = "".join(f",{k}={v}" for k, v in sorted(env_over.items()))
+        return f"amp={amp},layout={layout}{extra}"
+
     # r3 chip result: keep-tier AMP + NHWC won every conv-model probe
     # (+8-17%) and compiled reliably through the relay, so the banked
-    # safety number now uses the winner directly (VERDICT r3 item 5)
+    # safety number uses that PROVEN shape — including BENCH_FUSE_BN=0
+    # for resnet50 (the r4 fused-BN op is numerics-identical but
+    # chip-unmeasured; it rides as a tuner candidate below and wins the
+    # timed slot only by measuring faster)
     primary = ("keep", "NHWC") if model in CONV_MODELS else ("keep", "NCHW")
+    prim_env = {}
+    if model == "resnet50" and "BENCH_FUSE_BN" not in os.environ:
+        prim_env = {"BENCH_FUSE_BN": "0"}
     probe_steps = int(os.environ.get("BENCH_TUNE_STEPS", "5"))
-    result = run_model(model, steps, peak_flops, amp=primary[0],
-                       layout=primary[1])
-    probes = {f"amp={primary[0]},layout={primary[1]} (timed)":
+    with _env(prim_env):
+        result = run_model(model, steps, peak_flops, amp=primary[0],
+                           layout=primary[1])
+    probes = {_probe_name(primary[0], primary[1], prim_env) + " (timed)":
               result["value"]}
     result["tuned"] = {
         "probes": dict(probes),
-        "picked": f"amp={primary[0]},layout={primary[1]}",
+        "picked": _probe_name(primary[0], primary[1], prim_env),
         "probe_steps": probe_steps,
     }
 
@@ -496,42 +522,51 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
     state["results"].append(bank(result))
     slot = len(state["results"]) - 1
 
-    if model in CONV_MODELS:
-        combos = [("keep", "NCHW"), ("1", "NHWC"), ("1", "NCHW")]
+    if model == "resnet50" and "BENCH_FUSE_BN" not in os.environ:
+        # the fused-BN candidate probes FIRST: it is the round's headline
+        # hypothesis and must be measured before lower-priority combos
+        combos = [("keep", "NHWC", {"BENCH_FUSE_BN": "1"}),
+                  ("keep", "NCHW", {}), ("1", "NHWC", {}), ("1", "NCHW", {})]
+    elif model in CONV_MODELS:
+        combos = [("keep", "NCHW", {}), ("1", "NHWC", {}), ("1", "NCHW", {})]
     else:
-        combos = [("1", "NCHW")]
+        combos = [("1", "NCHW", {})]
     budget = float(os.environ.get("BENCH_TUNE_BUDGET_S", "600"))
     t0 = time.perf_counter()
     # probe the primary too (executor cache makes this nearly free) so the
     # rerun decision compares probe-to-probe, not a 5-step probe against
     # the full-length run's throughput
-    r0 = run_model(model, probe_steps, peak_flops, amp=primary[0],
-                   layout=primary[1])
-    probes[f"amp={primary[0]},layout={primary[1]}"] = r0["value"]
-    best, best_v = primary, r0["value"]
-    for amp, layout in combos:
+    with _env(prim_env):
+        r0 = run_model(model, probe_steps, peak_flops, amp=primary[0],
+                       layout=primary[1])
+    probes[_probe_name(primary[0], primary[1], prim_env)] = r0["value"]
+    best, best_v = (primary[0], primary[1], prim_env), r0["value"]
+    for amp, layout, env_over in combos:
         if time.perf_counter() - t0 > budget:
             probes["(budget_exhausted)"] = round(
                 time.perf_counter() - t0, 1)
             break
-        r = run_model(model, probe_steps, peak_flops, amp=amp, layout=layout)
-        probes[f"amp={amp},layout={layout}"] = r["value"]
+        with _env(env_over):
+            r = run_model(model, probe_steps, peak_flops, amp=amp,
+                          layout=layout)
+        probes[_probe_name(amp, layout, env_over)] = r["value"]
         if r["value"] > best_v:
-            best, best_v = (amp, layout), r["value"]
+            best, best_v = (amp, layout, env_over), r["value"]
     result["tuned"]["probes"] = dict(probes)
     state["results"][slot] = bank(result)
-    if best != primary and best_v > r0["value"] * 1.03:
-        rerun = run_model(model, steps, peak_flops, amp=best[0],
-                          layout=best[1])
+    if best != (primary[0], primary[1], prim_env) and best_v > r0["value"] * 1.03:
+        with _env(best[2]):
+            rerun = run_model(model, steps, peak_flops, amp=best[0],
+                              layout=best[1])
         if rerun["value"] > result["value"]:
             rerun["tuned"] = dict(
                 result["tuned"],
-                picked=f"amp={best[0]},layout={best[1]}",
+                picked=_probe_name(best[0], best[1], best[2]),
             )
             result = rerun
         else:
-            probes[f"amp={best[0]},layout={best[1]} (timed, slower)"] = (
-                rerun["value"])
+            probes[_probe_name(best[0], best[1], best[2])
+                   + " (timed, slower)"] = rerun["value"]
             result["tuned"]["probes"] = dict(probes)
         state["results"][slot] = bank(result)
     return result
