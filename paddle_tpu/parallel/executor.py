@@ -236,10 +236,11 @@ class ParallelExecutor:
         fetch_list: Optional[Sequence] = None,
         steps: Optional[int] = None,
         return_numpy: bool = True,
+        mode: str = "scan",
     ) -> List[Any]:
         with flags.tpu_trace_scope(self._mesh_is_tpu()):
             return self._run_steps_scoped(
-                feed_list, fetch_list, steps, return_numpy)
+                feed_list, fetch_list, steps, return_numpy, mode)
 
     def _run_steps_scoped(
         self,
@@ -247,6 +248,7 @@ class ParallelExecutor:
         fetch_list=None,
         steps=None,
         return_numpy=True,
+        mode="scan",
     ) -> List[Any]:
         """Run `steps` SPMD iterations in ONE device dispatch: the compiled
         block body runs under `lax.scan` inside a single pjit over the mesh,
@@ -293,8 +295,11 @@ class ParallelExecutor:
         block0 = self.program.desc.block(0)
 
         fp = self.program.desc.fingerprint()
+        if mode not in ("scan", "flat"):
+            raise ValueError(f"run_steps mode must be 'scan' or 'flat', "
+                             f"got {mode!r}")
         key = ("pe_run_steps", steps, len(feed_list), tuple(feed_names),
-               tuple(fetch_names), amp.state_key(), flags.trace_key())
+               tuple(fetch_names), amp.state_key(), flags.trace_key(), mode)
         entry = self._cache.get(key)
         if entry is not None and entry[0] != fp:
             entry = None
@@ -304,7 +309,8 @@ class ParallelExecutor:
                 self.program, 0, plan.feed_names, plan.fetch_names,
                 plan.state_names, donate_states=False, mesh=self.mesh,
             )
-            multi = scan_multi_fn(compiled.raw_fn, len(feed_list), steps)
+            multi = scan_multi_fn(compiled.raw_fn, len(feed_list), steps,
+                                  flat=(mode == "flat"))
             state_sh = tuple(
                 self._state_sharding(n, block0) for n in plan.state_names
             )

@@ -275,3 +275,32 @@ def test_parallel_conv_fused_bn_matches_serial():
     np.testing.assert_allclose(
         np.asarray(fluid.global_scope().find_var("pc_mean")), mean_serial,
         rtol=2e-4, atol=1e-6)
+
+
+def test_parallel_run_steps_flat_matches_scan():
+    """ParallelExecutor.run_steps(mode='flat') gives the scan trajectory
+    exactly, SPMD over the 8-device mesh."""
+    x, y = _data(32)
+    feeds = [{"x": x[i * 8:(i + 1) * 8], "label": y[i * 8:(i + 1) * 8]}
+             for i in range(4)]
+
+    results = {}
+    for mode in ("scan", "flat"):
+        from paddle_tpu.core import framework, scope as scope_mod
+
+        framework.switch_main_program(fluid.Program())
+        framework.switch_startup_program(fluid.Program())
+        scope_mod._current_scope = scope_mod.Scope()
+        loss = _build_model(seed=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                    mesh=make_mesh({"dp": 8}))
+        (lv,) = pe.run_steps(feed_list=feeds, fetch_list=[loss], steps=6,
+                             mode=mode)
+        results[mode] = (np.ravel(lv)[0],
+                         np.asarray(fluid.global_scope().find_var("w1")))
+    np.testing.assert_allclose(results["scan"][0], results["flat"][0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results["scan"][1], results["flat"][1],
+                               rtol=1e-6)
