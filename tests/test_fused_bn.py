@@ -157,3 +157,57 @@ def test_flash_bwd_jaxlib_flag_accepted_cpu_fallback():
         fluid.set_flags({"FLAGS_flash_bwd": "jax"})
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_fused_bn_fuzz_parity_vs_composed_ops():
+    """Seeded fuzz: random shapes / eps / momentum / residual presence /
+    act, fwd + one SGD step, fused op vs the composed batch_norm +
+    elementwise_add + relu chain.  20 cases."""
+    rng = np.random.RandomState(123)
+    for case in range(20):
+        c = int(rng.choice([1, 3, 8]))
+        h = int(rng.choice([4, 7, 8]))
+        bs = int(rng.choice([2, 5, 8]))
+        eps = float(rng.choice([1e-5, 1e-3]))
+        momentum = float(rng.choice([0.9, 0.99]))
+        with_res = bool(rng.randint(2))
+        act = "relu" if rng.randint(2) else None
+        xv = rng.randn(bs, c, h, h).astype("float32")
+
+        outs = {}
+        for fused in (True, False):
+            fluid.reset_default_env()
+            fluid.default_main_program().random_seed = 10 + case
+            fluid.default_startup_program().random_seed = 10 + case
+            x = layers.data("x", [c, h, h], dtype="float32")
+            if fused:
+                y = layers.fused_bn_add_act(
+                    x, x if with_res else None, act=act,
+                    epsilon=eps, momentum=momentum,
+                    param_attr=fluid.ParamAttr(name="fz_s"),
+                    bias_attr=fluid.ParamAttr(name="fz_b"),
+                    moving_mean_name="fz_m", moving_variance_name="fz_v")
+            else:
+                b = layers.batch_norm(
+                    x, act=None, epsilon=eps, momentum=momentum,
+                    param_attr=fluid.ParamAttr(name="fz_s"),
+                    bias_attr=fluid.ParamAttr(name="fz_b"),
+                    moving_mean_name="fz_m", moving_variance_name="fz_v")
+                y = layers.elementwise_add(b, x) if with_res else b
+                if act:
+                    y = layers.relu(y)
+            loss = layers.reduce_mean(layers.square(y))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            (yv,) = exe.run(feed={"x": xv}, fetch_list=[y])
+            outs[fused] = (
+                np.asarray(yv),
+                np.array(np.asarray(fluid.global_scope().find_var("fz_s"))),
+                np.array(np.asarray(fluid.global_scope().find_var("fz_m"))),
+            )
+        tag = (f"case {case}: c={c} h={h} bs={bs} eps={eps} "
+               f"mom={momentum} res={with_res} act={act}")
+        for a, b in zip(outs[True], outs[False]):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                       err_msg=tag)
