@@ -234,3 +234,48 @@ def test_label_smooth():
     t.attrs = {"epsilon": eps}
     t.outputs = {"Out": want.astype("float32")}
     t.check_output(atol=2e-5, rtol=2e-5)
+
+
+def test_softmax_with_cross_entropy_smooth_eps():
+    """smooth_eps folds uniform label smoothing analytically: must equal
+    one_hot -> label_smooth -> soft-label CE bit-for-near-bit, including
+    zeroed loss at ignore_index positions, and reject soft_label+smooth."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(0)
+    B, V, eps_s = 6, 12, 0.1
+    logits_v = rng.randn(B, V).astype("float32")
+    label_v = rng.randint(0, V, size=(B, 1)).astype("int64")
+    label_v[2, 0] = -100  # ignore_index sentinel position
+
+    fluid.reset_default_env()
+    logits = layers.data("logits", [V], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    fused = layers.softmax_with_cross_entropy(
+        logits, label, smooth_eps=eps_s, ignore_index=-100)
+
+    # reference-shaped chain (clamp the sentinel to a valid id for one_hot;
+    # its loss row is checked as zero on the fused side separately)
+    lab_c = layers.elementwise_max(
+        label, layers.fill_constant([1], "int64", 0))
+    one_hot = layers.one_hot(layers.reshape(lab_c, [-1]), depth=V)
+    smooth = layers.label_smooth(one_hot, epsilon=eps_s)
+    soft = layers.softmax_with_cross_entropy(
+        logits, smooth, soft_label=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fv, sv = exe.run(feed={"logits": logits_v, "label": label_v},
+                     fetch_list=[fused, soft])
+    fv, sv = np.asarray(fv), np.asarray(sv)
+    keep = np.arange(B) != 2
+    np.testing.assert_allclose(fv[keep], sv[keep], rtol=1e-5, atol=1e-6)
+    assert fv[2] == 0.0  # ignored position contributes nothing
+
+    with pytest.raises(ValueError, match="smooth_eps"):
+        layers.softmax_with_cross_entropy(
+            logits, smooth, soft_label=True, smooth_eps=0.1)
