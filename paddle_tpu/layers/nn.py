@@ -574,12 +574,14 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     identical to one_hot -> label_smooth -> soft-label CE but WITHOUT
     materializing any [*, V] label tensor (at vocab 32k and bench batch
     that chain moves ~1 GB/step of HBM)."""
+    if smooth_eps and soft_label:
+        # validate BEFORE creating any program vars: a rejected call must
+        # not leave orphan Softmax/Loss descs behind
+        raise ValueError("smooth_eps folds smoothing over HARD labels; "
+                         "pre-smoothed soft labels must not smooth twice")
     helper = LayerHelper("softmax_with_cross_entropy", input=logits)
     softmax_out = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
-    if smooth_eps and soft_label:
-        raise ValueError("smooth_eps folds smoothing over HARD labels; "
-                         "pre-smoothed soft labels must not smooth twice")
     helper.append_op(
         type="softmax_with_cross_entropy",
         inputs={"Logits": [logits], "Label": [label]},

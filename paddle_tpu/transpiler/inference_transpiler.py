@@ -40,8 +40,19 @@ __all__ = ["InferenceTranspiler"]
 # reference sets is_test on every op that *declares* the attr (it reads the
 # registered proto); our descs only hold explicitly-set attrs, so the op
 # set is spelled out.
-_IS_TEST_OPS = ("batch_norm", "dropout", "lrn", "fake_quantize_abs_max",
-                "fake_quantize_range_abs_max")
+_IS_TEST_OPS = ("batch_norm", "fused_bn_add_act", "dropout", "lrn",
+                "fake_quantize_abs_max", "fake_quantize_range_abs_max")
+
+
+def _is_foldable_bn(op):
+    """batch_norm, or the fused twin WITHOUT a residual input (the Z-free
+    fused_bn_add_act the conv builders emit for plain conv->BN(+act)
+    stacks is the same conv+BN shape the fold handles; its activation is
+    re-emitted as a standalone relu after the folded add)."""
+    if op.type == "batch_norm":
+        return True
+    return (op.type == "fused_bn_add_act"
+            and not (op.desc.inputs.get("Z") or []))
 
 
 class InferenceTranspiler:
@@ -113,7 +124,7 @@ class InferenceTranspiler:
             if len(consumers) != 1 or consumers[0][0] is None:
                 continue
             j, nxt = consumers[0]
-            if nxt.type == "batch_norm" and nxt.input("X") == [conv_out]:
+            if _is_foldable_bn(nxt) and nxt.input("X") == [conv_out]:
                 self._fold(block, scope, op, bn_idx=j, bias_op=None)
                 continue
             if nxt.type == "elementwise_add" and nxt.attr("axis", -1) == 1:
@@ -125,7 +136,7 @@ class InferenceTranspiler:
                     continue
                 nxt2 = all_consumers(add_out)
                 if len(nxt2) == 1 and nxt2[0][0] is not None \
-                        and nxt2[0][1].type == "batch_norm" \
+                        and _is_foldable_bn(nxt2[0][1]) \
                         and nxt2[0][1].input("X") == [add_out]:
                     self._fold(block, scope, op, bn_idx=nxt2[0][0],
                                bias_op=nxt)
@@ -180,8 +191,35 @@ class InferenceTranspiler:
         scope.set_var(name, value)
         return name
 
+    @staticmethod
+    def _emit_act(block, idx, act, dst_name):
+        """Re-emit a fused op's activation as a standalone relu at `idx`
+        writing `dst_name` (the fold replaces fused_bn_add_act(act=relu)
+        with add -> relu).  Returns the new pre-activation var name the
+        producing add should write instead, or None when there is no
+        activation."""
+        import dataclasses
+
+        if not act:
+            return None
+        if act != "relu":
+            raise ValueError(
+                f"InferenceTranspiler: cannot re-emit activation {act!r}")
+        tmp = dst_name + "_prerelu"
+        n = 2
+        while block.desc.has_var(tmp):
+            tmp = f"{dst_name}_prerelu_{n}"
+            n += 1
+        block.desc.vars[tmp] = dataclasses.replace(
+            block.desc.vars[dst_name], name=tmp, persistable=False)
+        block._insert_op(idx, type="relu", inputs={"X": [tmp]},
+                         outputs={"Out": [dst_name]}, attrs={})
+        return tmp
+
     def _fold(self, block, scope, conv_op, bn_idx, bias_op):
         bn = block.ops[bn_idx]
+        act = (bn.attr("act", None)
+               if bn.type == "fused_bn_add_act" else None)
         w_name = conv_op.input("Filter")[0]
         w = self._scope_array(scope, w_name)
         scale = self._scope_array(scope, bn.input("Scale")[0]).astype(np.float64)
@@ -197,28 +235,30 @@ class InferenceTranspiler:
         conv_op.desc.inputs["Filter"] = [self._fused_copy(
             block, scope, w_name, w_new.astype(w.dtype), w.shape)]
 
+        bn_y = bn.output("Y")[0]
         if bias_op is not None:
             old_bias = self._scope_array(scope, bias_op.input("Y")[0])
             b_new = (old_bias.astype(np.float64) - mean) * alpha + beta
             bias_op.desc.inputs["Y"] = [self._fused_copy(
                 block, scope, bias_op.input("Y")[0],
                 b_new.astype(old_bias.dtype), old_bias.shape)]
-            # redirect the existing add's output to the bn output so
-            # downstream consumers are untouched
-            bias_op.desc.outputs["Out"] = [bn.output("Y")[0]]
             block._remove_op(bn_idx)
+            # redirect the existing add's output to the bn output (or,
+            # for a fused op with an activation, through a re-emitted act)
+            pre = self._emit_act(block, bn_idx, act, bn_y)
+            bias_op.desc.outputs["Out"] = [pre or bn_y]
         else:
             b_new = (0.0 - mean) * alpha + beta
             bias_name = self._fused_copy(
                 block, scope, bn.input("Bias")[0],
                 b_new.astype(beta_raw.dtype), beta.shape)
             conv_out = conv_op.output("Output")[0]
-            bn_y = bn.output("Y")[0]
             block._remove_op(bn_idx)
+            pre = self._emit_act(block, bn_idx, act, bn_y)
             block._insert_op(
                 bn_idx, type="elementwise_add",
                 inputs={"X": [conv_out], "Y": [bias_name]},
-                outputs={"Out": [bn_y]},
+                outputs={"Out": [pre or bn_y]},
                 attrs={"axis": 1})
 
     @staticmethod

@@ -211,3 +211,102 @@ def test_weight_shared_filter_folds_safely():
     (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bn_add_act_folds():
+    """The default-built conv stacks emit fused_bn_add_act (Z-free); the
+    transpiler must fold those exactly like batch_norm, re-emitting the
+    activation as a standalone relu after the folded bias add."""
+    fluid.reset_default_env()
+    x = layers.data("x", [3, 8, 8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    conv = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False)
+    h = layers.fused_bn_add_act(conv, None, act="relu")
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(4)
+    xv = rng.randn(4, 3, 8, 8).astype("float32")
+    yv = rng.randint(0, 3, size=(4, 1)).astype("int64")
+    for _ in range(3):
+        exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+    infer = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    assert sum(op.type == "fused_bn_add_act"
+               for op in infer.global_block().ops) == 1
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    ops = [op.type for op in infer.global_block().ops]
+    assert "fused_bn_add_act" not in ops and "batch_norm" not in ops
+    # folded shape: conv -> add(folded bias) -> relu
+    ci = ops.index("conv2d")
+    assert ops[ci + 1] == "elementwise_add" and ops[ci + 2] == "relu"
+    (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_bn_with_residual_not_folded_but_test_mode():
+    """A fused op WITH a residual input cannot fold (BN applies before the
+    add), but transpile must still flip it to test mode."""
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    conv = layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False)
+    h = layers.fused_bn_add_act(conv, x, act="relu")
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(6).randn(4, 4, 8, 8).astype("float32")
+    yv = np.zeros((4, 1), dtype="int64")
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+    infer = fluid.io.get_inference_program([pred])
+    (ref,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    fused = [op for op in infer.global_block().ops
+             if op.type == "fused_bn_add_act"]
+    assert len(fused) == 1 and fused[0].attr("is_test") is True
+    (out,) = exe.run(program=infer, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_default_build_transpiles_to_foldless_graph():
+    """models.resnet with the DEFAULT fuse_bn=True must still lose every
+    foldable BN under the transpiler (the round-4 regression: fused ops
+    were invisible to the fold)."""
+    from paddle_tpu import models
+
+    fluid.reset_default_env()
+    spec = models.resnet_cifar10(depth=8, class_num=4)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    b = spec.synthetic_batch(4, seed=0)
+    exe.run(feed=b, fetch_list=[spec.loss])
+
+    infer = fluid.io.get_inference_program([spec.extras["predict"]])
+    (ref,) = exe.run(program=infer, feed={"image": b["image"]},
+                     fetch_list=[spec.extras["predict"]])
+    before = sum(op.type == "fused_bn_add_act"
+                 for op in infer.global_block().ops)
+    assert before > 0
+    fluid.InferenceTranspiler().transpile(infer, fluid.CPUPlace())
+    after = [op for op in infer.global_block().ops
+             if op.type == "fused_bn_add_act"]
+    # only the residual-tail fused ops (Z present) remain
+    assert all(op.desc.inputs.get("Z") for op in after)
+    assert len(after) < before
+    (out,) = exe.run(program=infer, feed={"image": b["image"]},
+                     fetch_list=[spec.extras["predict"]])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

@@ -114,11 +114,15 @@ def main() -> None:
              "BENCH_DEADLINE_S": "3300"},
             3600, args.out)
     if wanted("fuse_bn_ab"):
+        # full-length timed FUSED arm of the A/B (the safety step's tuner
+        # banks the unfused primary and only probes the fused op for 5
+        # steps; this guarantees the round's headline hypothesis gets a
+        # real timed measurement either way)
         run_step(
             "fuse_bn_ab",
             [py, "bench.py"],
             {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50",
-             "BENCH_FUSE_BN": "0", "BENCH_TUNE": "0", "BENCH_AMP": "keep",
+             "BENCH_FUSE_BN": "1", "BENCH_TUNE": "0", "BENCH_AMP": "keep",
              "BENCH_LAYOUT": "NHWC", "BENCH_DEADLINE_S": "1500"},
             1800, args.out)
     if wanted("pyreader"):
