@@ -525,8 +525,14 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
     if model == "resnet50" and "BENCH_FUSE_BN" not in os.environ:
         # the fused-BN candidate probes FIRST: it is the round's headline
         # hypothesis and must be measured before lower-priority combos
+        # every resnet50 combo pins BENCH_FUSE_BN explicitly (ADVICE r4:
+        # an empty env here would silently default to fused while the
+        # probe name omitted it, misattributing which config produced
+        # the number); non-fused combos match the primary's unfused shape
         combos = [("keep", "NHWC", {"BENCH_FUSE_BN": "1"}),
-                  ("keep", "NCHW", {}), ("1", "NHWC", {}), ("1", "NCHW", {})]
+                  ("keep", "NCHW", {"BENCH_FUSE_BN": "0"}),
+                  ("1", "NHWC", {"BENCH_FUSE_BN": "0"}),
+                  ("1", "NCHW", {"BENCH_FUSE_BN": "0"})]
     elif model in CONV_MODELS:
         combos = [("keep", "NCHW", {}), ("1", "NHWC", {}), ("1", "NCHW", {})]
     else:

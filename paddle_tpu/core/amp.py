@@ -36,6 +36,7 @@ def _effective():
     from .. import flags
 
     if flags.tpu_trace_active():
+        flags.note_auto_resolution("amp", "keep-tier bf16")
         return jnp.dtype(jnp.bfloat16), True
     return None, False
 
@@ -63,7 +64,9 @@ def disable_amp() -> None:
 
 def reset_amp() -> None:
     """Back to the un-set default (TPU programs auto-select keep-tier bf16;
-    everything else fp32).  reset_default_env() calls this."""
+    everything else fp32).  Must be called explicitly:
+    framework.reset_default_env() deliberately does NOT call it — the AMP
+    policy is process-wide and survives program resets on purpose."""
     _POLICY["dtype"] = None
     _POLICY["keep"] = False
     _POLICY["explicit"] = False

@@ -135,11 +135,40 @@ def tpu_trace_scope(active: bool):
         _tls.tpu_active = prev
 
 
+# one-time notices when an "auto" flag / un-set policy silently resolves to
+# the TPU-tuned value (ADVICE r4: there was no runtime signal that a
+# TPU-traced program picked bf16/NHWC while paths compiling OUTSIDE the
+# trace scope — inference/aot.py export, the py_reader preprocessor —
+# resolve to fp32/NCHW reference parity; AOT-exported artifacts therefore
+# use reference-parity defaults regardless of target device unless the
+# policy is set explicitly)
+_auto_noted: set = set()
+_auto_noted_lock = threading.Lock()
+
+
+def note_auto_resolution(kind: str, resolved: str) -> None:
+    """Log once per process the first time an auto default engages."""
+    with _auto_noted_lock:
+        if kind in _auto_noted:
+            return
+        _auto_noted.add(kind)
+    import logging
+
+    logging.getLogger("paddle_tpu").info(
+        "auto-resolved %s -> %s for a TPU-traced program (explicit "
+        "enable_amp()/FLAGS_conv_layout overrides; programs compiled "
+        "outside the TPU trace scope, e.g. AOT export, keep "
+        "reference-parity fp32/NCHW)", kind, resolved)
+
+
 def conv_layout() -> str:
     """FLAGS_conv_layout with "auto" resolved for the active device."""
     v = _VALUES["FLAGS_conv_layout"]
     if v == "auto":
-        return "NHWC" if tpu_trace_active() else "NCHW"
+        if tpu_trace_active():
+            note_auto_resolution("conv_layout", "NHWC")
+            return "NHWC"
+        return "NCHW"
     return v
 
 

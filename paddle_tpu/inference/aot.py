@@ -18,6 +18,14 @@ meta.json; the loader then validates feed shapes up front.
     save_compiled_inference_model(dirname, feed_names, [pred], exe)
     predict = load_compiled_inference_model(dirname)
     out, = predict({"image": batch})
+
+Precision/layout note: export traces OUTSIDE the executor's TPU trace
+scope, so the "auto" defaults resolve to reference parity (fp32, NCHW)
+regardless of the eventual target device — an exported artifact's
+numerics match the Executor's CPU path, not a TPU run's auto keep-bf16
+path.  To export a bf16/NHWC artifact, set the policy explicitly
+(enable_amp(..., keep_output=True), FLAGS_conv_layout=NHWC) around the
+export call.
 """
 
 from __future__ import annotations

@@ -428,6 +428,14 @@ def _batch_norm(ctx, ins, attrs):
 
 
 def _fused_bn_add_act_infer(op, block):
+    # the residual Z must match X exactly: a broadcastable-but-wrong Z
+    # (e.g. [N,C,1,1]) would silently broadcast in the lowering's y + z
+    # instead of failing here (ADVICE r4)
+    x, z = in_desc(op, block, "X"), in_desc(op, block, "Z")
+    if x is not None and z is not None and list(z.shape) != list(x.shape):
+        raise ValueError(
+            f"fused_bn_add_act: residual Z shape {list(z.shape)} must equal "
+            f"X shape {list(x.shape)} (op {op.type})")
     _batch_norm_infer(op, block)
 
 

@@ -211,3 +211,16 @@ def test_fused_bn_fuzz_parity_vs_composed_ops():
         for a, b in zip(outs[True], outs[False]):
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
                                        err_msg=tag)
+
+
+def test_fused_bn_rejects_mismatched_residual_shape():
+    """ADVICE r4: a broadcastable-but-wrong Z (e.g. [N,C,1,1]) must fail
+    shape inference, not silently broadcast inside the lowering."""
+    import pytest
+
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    conv = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+    bad_z = layers.pool2d(x, pool_size=8, pool_type="avg")  # [N,4,1,1]
+    with pytest.raises(ValueError, match="residual Z shape"):
+        layers.fused_bn_add_act(conv, bad_z, act="relu")
