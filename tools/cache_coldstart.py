@@ -1,0 +1,168 @@
+"""Compile-cache cold-start drill (VERDICT r5 item 2: relay independence).
+
+Proves — or disproves, with the error documented — that a persisted XLA
+executable can be REUSED by a fresh process without recompiling.  On the
+TPU relay, first compiles cost minutes and a wedged remote-compile
+service has blocked every measurement since round 1; if a prewarmed
+cache lets a fresh process skip compilation, a wedged relay stops
+blocking benches whose programs were banked during any earlier healthy
+window.  (Reference analogue in spirit: the build/run split of
+paddle/scripts/paddle_build.sh:59 — compile once, execute many.)
+
+Two stages, each a clean subprocess sharing one cache directory:
+
+  warm  — compile + run a small conv+BN+fc training program with
+          FLAGS_compile_cache_dir set; record losses, wall time, and the
+          persistent-cache hit/miss counts from jax's monitoring events.
+  cold  — a FRESH process, same program, same cache dir; done =
+          cache_hits > 0, bit-identical losses, and a compile wall that
+          dropped.
+
+Usage:
+  python tools/cache_coldstart.py [--cache-dir DIR] [--keep]
+
+Prints one JSON line per stage plus a final verdict line
+{"coldstart_ok": bool, ...} (exit 0 iff ok).  The cache directory is
+left in place with --keep (or a non-tmp --cache-dir) so chip sessions
+can bank it as an artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGE_SRC = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["COLDSTART_REPO"])
+import jax
+# pin the platform through config BEFORE any backend init: with the axon
+# PJRT plugin registered by sitecustomize, the JAX_PLATFORMS env var alone
+# does not stop a wedged-relay client init from hanging (round-4 finding;
+# same pattern as tests/conftest.py and bench.py)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+counts = {"hits": 0, "misses": 0}
+from jax._src import monitoring
+
+def _listen(event, **kw):
+    if event.endswith("/cache_hits"):
+        counts["hits"] += 1
+    elif event.endswith("/cache_misses"):
+        counts["misses"] += 1
+
+monitoring.register_event_listener(_listen)
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+fluid.default_main_program().random_seed = 7
+fluid.default_startup_program().random_seed = 7
+x = layers.data("x", [4, 8, 8], dtype="float32")
+y = layers.data("y", [1], dtype="int64")
+conv = layers.conv2d(x, num_filters=8, filter_size=3, padding=1)
+h = layers.batch_norm(conv, act="relu")
+pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+pred = layers.fc(pool, size=3, act="softmax")
+loss = layers.mean(layers.cross_entropy(pred, y))
+fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+
+exe = fluid.Executor(fluid.CPUPlace() if jax.default_backend() == "cpu"
+                     else fluid.TPUPlace())
+t0 = time.perf_counter()
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(3)
+xv = rng.randn(8, 4, 8, 8).astype("float32")
+yv = rng.randint(0, 3, size=(8, 1)).astype("int64")
+losses = [float(np.ravel(np.asarray(
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])[0]))[0])
+    for _ in range(3)]
+print(json.dumps({
+    "stage": os.environ["COLDSTART_STAGE"],
+    "wall_s": round(time.perf_counter() - t0, 3),
+    "losses": losses,
+    "cache_hits": counts["hits"],
+    "cache_misses": counts["misses"],
+    "backend": jax.default_backend(),
+}), flush=True)
+"""
+
+
+def run_stage(name: str, cache_dir: str, timeout_s: float) -> dict:
+    env = dict(
+        os.environ,
+        COLDSTART_REPO=REPO,
+        COLDSTART_STAGE=name,
+        FLAGS_compile_cache_dir=cache_dir,
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+    )
+    try:
+        out = subprocess.run([sys.executable, "-c", STAGE_SRC],
+                             capture_output=True, text=True,
+                             timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return {"stage": name, "error": f"timeout after {timeout_s:.0f}s"}
+    rec = {"stage": name, "rc": out.returncode}
+    for ln in out.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                rec.update(json.loads(ln))
+            except ValueError:
+                pass
+    if out.returncode != 0:
+        rec["stderr_tail"] = out.stderr.strip()[-1200:]
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--keep", action="store_true")
+    ap.add_argument("--timeout-s", type=float, default=900.0)
+    args = ap.parse_args()
+
+    cache_dir = args.cache_dir or tempfile.mkdtemp(prefix="xla_cache_drill_")
+    cleanup = args.cache_dir is None and not args.keep
+    os.makedirs(cache_dir, exist_ok=True)
+
+    warm = run_stage("warm", cache_dir, args.timeout_s)
+    print(json.dumps(warm), flush=True)
+    n_entries = len(glob.glob(os.path.join(cache_dir, "*")))
+    cold = run_stage("cold", cache_dir, args.timeout_s)
+    print(json.dumps(cold), flush=True)
+
+    ok = (
+        warm.get("rc") == 0 and cold.get("rc") == 0
+        and n_entries > 0
+        and cold.get("cache_hits", 0) > 0
+        and cold.get("losses") == warm.get("losses")
+    )
+    verdict = {
+        "coldstart_ok": bool(ok),
+        "cache_dir": cache_dir,
+        "cache_entries_after_warm": n_entries,
+        "warm_wall_s": warm.get("wall_s"),
+        "cold_wall_s": cold.get("wall_s"),
+        "cold_cache_hits": cold.get("cache_hits"),
+        "cold_cache_misses": cold.get("cache_misses"),
+    }
+    print(json.dumps(verdict), flush=True)
+    if cleanup:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
