@@ -12,4 +12,5 @@ from .strategy import BuildStrategy, ExecutionStrategy, ShardingStrategy  # noqa
 from .executor import ParallelExecutor, CompiledProgram  # noqa: F401
 from .env import init_distributed, trainer_id, num_trainers  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
+from .pipeline_program import ProgramPipeline  # noqa: F401
 from .moe import switch_moe  # noqa: F401

