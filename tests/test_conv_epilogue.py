@@ -1,0 +1,90 @@
+"""Fused conv+BN-stats+epilogue pallas kernels (kernels/conv_epilogue.py;
+reference counterpart conv_fusion_op.cu.cc — cuDNN fused conv+bias+act).
+
+Interpret-mode parity against the XLA conv + BN + residual + relu chain;
+the on-chip compile path is gated by tools/conv_epilogue_probe.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.conv_epilogue import (
+    conv_bn_act,
+    conv_bn_act_reference,
+)
+
+
+def _case(K, stride, C, F, H=12, N=2, res=True, dtype="float32", seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(N, H, H, C).astype(dtype))
+    w = jnp.asarray((r.randn(K, K, C, F) * 0.2).astype(dtype))
+    g = jnp.asarray((r.rand(F) + 0.5).astype("float32"))
+    b = jnp.asarray((r.randn(F) * 0.1).astype("float32"))
+    Ho = -(-H // stride)
+    z = jnp.asarray(r.randn(N, Ho, Ho, F).astype(dtype)) if res else None
+    return x, w, g, b, z
+
+
+@pytest.mark.parametrize("K,stride,res", [
+    (3, 1, True), (3, 1, False), (1, 1, True), (1, 1, False),
+    (3, 2, True), (1, 2, False),
+])
+def test_parity_vs_xla_chain(K, stride, res):
+    x, w, g, b, z = _case(K, stride, C=8, F=16, res=res)
+    y, m, v = conv_bn_act(x, w, g, b, z, stride=stride, interpret=True)
+    yr, mr, vr = conv_bn_act_reference(x, w, g, b, z, stride=stride)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(vr),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_activations_fp32_stats():
+    """keep-bf16 mode: bf16 in/out, statistics still accumulate fp32."""
+    x, w, g, b, z = _case(3, 1, C=8, F=16, dtype="bfloat16")
+    y, m, v = conv_bn_act(x, w, g, b, z, interpret=True)
+    yr, mr, vr = conv_bn_act_reference(x, w, g, b, z)
+    assert y.dtype == jnp.bfloat16
+    assert m.dtype == jnp.float32 and v.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(
+        np.asarray(y, dtype="float32"), np.asarray(yr, dtype="float32"),
+        rtol=1e-1, atol=1e-1)
+
+
+def test_valid_padding():
+    x, w, g, b, _ = _case(3, 1, C=8, F=16, res=False)
+    y, m, v = conv_bn_act(x, w, g, b, None, padding="VALID", interpret=True)
+    yr, mr, vr = conv_bn_act_reference(x, w, g, b, None, padding="VALID")
+    assert y.shape == yr.shape == (2, 10, 10, 16)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_no_activation():
+    x, w, g, b, z = _case(3, 1, C=8, F=16)
+    y, _, _ = conv_bn_act(x, w, g, b, z, act="", interpret=True)
+    yr, _, _ = conv_bn_act_reference(x, w, g, b, z, act="")
+    assert float(np.asarray(y).min()) < 0  # activation really off
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bad_weight_shape_raises():
+    x, w, g, b, _ = _case(3, 1, C=8, F=16, res=False)
+    with pytest.raises(ValueError, match="incompatible"):
+        conv_bn_act(x, jnp.swapaxes(w, 2, 3)[:, :, :3], g, b,
+                    interpret=True)
+
+
+def test_unsupported_act_raises():
+    """review r5: an unknown act must raise up front, not silently skip
+    the activation (the reference raises too)."""
+    x, w, g, b, _ = _case(3, 1, C=8, F=16, res=False)
+    with pytest.raises(ValueError, match="unsupported act"):
+        conv_bn_act(x, w, g, b, act="gelu", interpret=True)

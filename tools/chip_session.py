@@ -11,15 +11,16 @@ strictly after the safety numbers:
   5. deepfm_unroll     flat 8-step jit A/B for the dispatch-bound model
   6. cache_coldstart   fresh-process reuse of the just-banked executables
   7. profiles          tools/tpu_profile.py resnet50 + deepfm
-  8. flash-bwd probe   tools/flash_bwd_probe.py stages 1..3 (risky: LAST)
-  9. flash-bwd bench   transformer with FLAGS_flash_bwd=pallas, ONLY if
+  8. conv-epilogue     staged pallas conv+BN-epilogue probe (risky)
+  9. flash-bwd probe   tools/flash_bwd_probe.py stages 1..3 (risky: LAST)
+ 10. flash-bwd bench   transformer with FLAGS_flash_bwd=pallas, ONLY if
                        all three probe stages passed
 
 Every step compiles through the persistent executable cache at
 xla_cache/ so a healthy window prewarms later (possibly wedged) runs.
 
 Every step is a clean subprocess with its own deadline; one step hanging
-cannot lose earlier banked results.  RISKY steps (8,9) are skipped when
+cannot lose earlier banked results.  RISKY steps (8-10) are skipped when
 --no-risky is passed or when fewer than RISKY_MIN_S seconds remain before
 --stop-by (epoch seconds): protecting the relay near round end is round
 3's hard-learned lesson (its pallas compile crashed the relay hours
@@ -192,6 +193,28 @@ def main() -> None:
         run_step("profile_deepfm",
                  [py, "tools/tpu_profile.py", "deepfm", "5"],
                  {}, 1800, args.out)
+
+    relay_suspect = False
+    if wanted("conv_epilogue"):
+        # staged pallas conv+BN-epilogue viability (the anti-MFU-ceiling
+        # kernel); risky: fresh pallas compiles through the relay
+        if risky_allowed():
+            ce = run_step("conv_epilogue",
+                          [py, "tools/conv_epilogue_probe.py"], {}, 2600,
+                          args.out)
+            # a failed/timed-out pallas compile is the round-3 relay-wedge
+            # signature: don't queue MORE risky compiles on that signal
+            relay_suspect = ce.get("rc") != 0
+        else:
+            print(json.dumps({"step": "conv_epilogue", "skipped":
+                              "risky window closed"}), flush=True)
+
+    if relay_suspect:
+        print(json.dumps({"step": "flash_bwd_probe", "skipped":
+                          "conv_epilogue failed - relay suspect"}),
+              flush=True)
+        finalize(args.out)
+        return
 
     if wanted("flash_bwd"):
         if not risky_allowed():
