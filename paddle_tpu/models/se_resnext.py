@@ -12,7 +12,7 @@ __all__ = ["se_resnext"]
 
 
 def _conv_bn(input, num_filters, filter_size, stride=1, groups=1, act=None,
-             fuse_bn=True):
+             fuse_bn=False):
     conv = layers.conv2d(
         input=input,
         num_filters=num_filters,
@@ -42,7 +42,7 @@ def _squeeze_excitation(input, num_channels, reduction_ratio):
     return layers.elementwise_mul(input, exc)
 
 
-def _shortcut(input, ch_out, stride, fuse_bn=True):
+def _shortcut(input, ch_out, stride, fuse_bn=False):
     ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
         return _conv_bn(input, ch_out, 1, stride, fuse_bn=fuse_bn)
@@ -50,7 +50,7 @@ def _shortcut(input, ch_out, stride, fuse_bn=True):
 
 
 def _bottleneck(input, num_filters, stride, cardinality, reduction_ratio,
-                fuse_bn=True):
+                fuse_bn=False):
     conv0 = _conv_bn(input, num_filters, 1, act="relu", fuse_bn=fuse_bn)
     conv1 = _conv_bn(
         conv0, num_filters, 3, stride=stride, groups=cardinality, act="relu",
@@ -68,7 +68,7 @@ def se_resnext(
     cardinality: int = 32,
     reduction_ratio: int = 16,
     img_shape=(3, 224, 224),
-    fuse_bn: bool = True,
+    fuse_bn: bool = False,
 ) -> ModelSpec:
     img = layers.data("image", list(img_shape), dtype="float32")
     label = layers.data("label", [1], dtype="int64")

@@ -88,3 +88,41 @@ def test_unsupported_act_raises():
     x, w, g, b, _ = _case(3, 1, C=8, F=16, res=False)
     with pytest.raises(ValueError, match="unsupported act"):
         conv_bn_act(x, w, g, b, act="gelu", interpret=True)
+
+
+@pytest.mark.parametrize("res", [True, False])
+def test_trainable_gradients_match_reference(res):
+    """make_conv_bn_act: pallas forward + recompute backward must produce
+    the same gradients as differentiating the XLA chain directly."""
+    from paddle_tpu.kernels.conv_epilogue import make_conv_bn_act
+
+    x, w, g, b, z = _case(3, 1, C=8, F=16, res=res)
+    f = make_conv_bn_act(has_residual=res, interpret=True)
+    args = (x, w, g, b) + ((z,) if res else ())
+
+    def loss_fused(*a):
+        y, m, v = f(*a)
+        return jnp.sum(y * y) + jnp.sum(m) + jnp.sum(v)
+
+    def loss_ref(*a):
+        y, m, v = conv_bn_act_reference(
+            a[0], a[1], a[2], a[3], a[4] if res else None)
+        return jnp.sum(y * y) + jnp.sum(m) + jnp.sum(v)
+
+    got = jax.grad(loss_fused, argnums=tuple(range(len(args))))(*args)
+    want = jax.grad(loss_ref, argnums=tuple(range(len(args))))(*args)
+    for gg, ww in zip(got, want):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(ww),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_trainable_forward_is_pallas_path():
+    """The trainable wrapper's primal must equal the pallas forward
+    (not the reference it differentiates)."""
+    from paddle_tpu.kernels.conv_epilogue import make_conv_bn_act
+
+    x, w, g, b, z = _case(3, 1, C=8, F=16)
+    f = make_conv_bn_act(interpret=True)
+    y1, m1, v1 = f(x, w, g, b, z)
+    y2, m2, v2 = conv_bn_act(x, w, g, b, z, interpret=True)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))

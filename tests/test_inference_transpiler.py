@@ -280,14 +280,16 @@ def test_fused_bn_with_residual_not_folded_but_test_mode():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_resnet_default_build_transpiles_to_foldless_graph():
-    """models.resnet with the DEFAULT fuse_bn=True must still lose every
+def test_resnet_fused_build_transpiles_to_foldless_graph():
+    """models.resnet built with fuse_bn=True must still lose every
     foldable BN under the transpiler (the round-4 regression: fused ops
-    were invisible to the fold)."""
+    were invisible to the fold).  fuse_bn defaults to False since round 5
+    (defaults follow measurements), so the fused graph is requested
+    explicitly here."""
     from paddle_tpu import models
 
     fluid.reset_default_env()
-    spec = models.resnet_cifar10(depth=8, class_num=4)
+    spec = models.resnet_cifar10(depth=8, class_num=4, fuse_bn=True)
     fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(spec.loss)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())

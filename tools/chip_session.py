@@ -129,7 +129,7 @@ def main() -> None:
             "safety",
             [py, "bench.py"],
             {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50,transformer,deepfm",
-             "BENCH_DEADLINE_S": "3300"},
+             "BENCH_COST": "1", "BENCH_DEADLINE_S": "3300"},
             3600, args.out)
     if wanted("fuse_bn_ab"):
         # full-length timed FUSED arm of the A/B (the safety step's tuner
@@ -141,7 +141,8 @@ def main() -> None:
             [py, "bench.py"],
             {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50",
              "BENCH_FUSE_BN": "1", "BENCH_TUNE": "0", "BENCH_AMP": "keep",
-             "BENCH_LAYOUT": "NHWC", "BENCH_DEADLINE_S": "1500"},
+             "BENCH_LAYOUT": "NHWC", "BENCH_COST": "1",
+             "BENCH_DEADLINE_S": "1500"},
             1800, args.out)
     if wanted("pyreader"):
         run_step(
@@ -157,7 +158,7 @@ def main() -> None:
             "longctx",
             [py, "bench.py"],
             {"BENCH_SAFE": "1", "BENCH_MODELS": "transformer_longctx",
-             "BENCH_TUNE": "0", "BENCH_AMP": "keep",
+             "BENCH_TUNE": "0", "BENCH_AMP": "keep", "BENCH_COST": "1",
              "BENCH_DEADLINE_S": "1500"},
             1800, args.out)
     if wanted("deepfm_unroll"):

@@ -37,6 +37,13 @@ sys.path.insert(0, os.environ["PROBE_REPO"])
 import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# bank the risky pallas compiles: this stage uses raw jax.jit/pallas_call
+# (never CompiledBlock), so the FLAGS_compile_cache_dir env var that
+# chip_session exports must be applied to jax directly — otherwise a
+# healthy window's multi-minute compiles are thrown away (round-3 lesson)
+if os.environ.get("FLAGS_compile_cache_dir"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["FLAGS_compile_cache_dir"])
 import jax.numpy as jnp
 import numpy as np
 

@@ -87,10 +87,17 @@ def main() -> None:
     amp = os.environ.get("BENCH_AMP", "keep")
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
 
+    # bytes/step from XLA's cost accounting of the exact compiled module
+    # (VERDICT r5 item 4: the 65 GB paper floor had never been checked
+    # against the compiled program)
+    os.environ.setdefault("BENCH_COST", "1")
     r = bench.run_model(model, steps, peak, amp=amp, layout=layout,
                         profile_logdir=logdir)
 
     sys.stderr.write(f"# measured: {json.dumps(r)}\n")
+    if r.get("bytes_per_step"):
+        print(f"bytes/step (XLA cost analysis): "
+              f"{r['bytes_per_step']/1e9:.2f} GB")
     totals = _device_op_times_from_logdir(logdir)
     if not totals:
         raise SystemExit("no device events captured (host-only trace?)")
