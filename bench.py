@@ -78,6 +78,13 @@ CONV_MODELS = {"resnet50", "lenet", "alexnet", "googlenet", "vgg19",
                "vgg19_infer", "vgg19_infer_int8", "se_resnext"}
 
 
+def _fuse_bn_mode():
+    """Resolved BENCH_FUSE_BN: False (unfused, default), True
+    (fused_bn_add_act), or "conv" (one-op conv_bn_add_act tier)."""
+    return {"1": True, "conv": "conv"}.get(
+        os.environ.get("BENCH_FUSE_BN", "0"), False)
+
+
 def _maybe_trace(logdir):
     if logdir:
         import jax
@@ -118,7 +125,7 @@ def run_model(model: str, steps: int, peak_flops: float,
         # bn/add/relu chain (A/B for the recompute-tagged fused op)
         spec = models.resnet_imagenet(
             depth=50, class_num=1000,
-            fuse_bn=os.environ.get("BENCH_FUSE_BN", "0") == "1")
+            fuse_bn=_fuse_bn_mode())
         unit = "images/sec"
         items_per_step = bs
         metric = "resnet50_train_images_per_sec_per_chip"
@@ -461,7 +468,12 @@ def run_model(model: str, steps: int, peak_flops: float,
     # produced it (fused BN / fused smoothed CE / flash backward impl)
     feats = {}
     if model == "resnet50":
-        feats["fuse_bn"] = os.environ.get("BENCH_FUSE_BN", "0") == "1"
+        # record the RESOLVED mode, not the raw env string: an
+        # unrecognized value builds unfused and must be attributed so
+        feats["fuse_bn"] = _fuse_bn_mode()
+        if feats["fuse_bn"] == "conv":
+            feats["conv_epilogue"] = fluid.get_flags(
+                "conv_epilogue")["FLAGS_conv_epilogue"]
     if model in ("transformer", "transformer_longctx"):
         feats["fuse_smooth_ce"] = cfg.fuse_smooth_ce
         feats["flash_bwd"] = fluid.get_flags("flash_bwd")["FLAGS_flash_bwd"]

@@ -54,6 +54,14 @@ _DEFS: Dict[str, Any] = {
     # directly attached TPU host flip to "pallas"/"jaxlib" for long
     # sequences
     "FLAGS_flash_bwd": "jax",
+    # conv_bn_add_act implementation: "reference" (XLA conv + BN chain —
+    # one op, XLA fuses the epilogue; the parity-safe default) or
+    # "pallas" (kernels/conv_epilogue.py: BN stats accumulate inside the
+    # conv pass, normalize/residual/act in one epilogue pass — ~4-5
+    # activation passes down to 3).  Pallas stays opt-in until the
+    # staged probe (tools/conv_epilogue_probe.py) banks a winning
+    # on-chip A/B: defaults follow measurements
+    "FLAGS_conv_epilogue": "reference",
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.
@@ -109,6 +117,7 @@ def get_flags(names=None) -> Dict[str, Any]:
 _CHOICES: Dict[str, tuple] = {
     "FLAGS_conv_layout": ("auto", "NCHW", "NHWC"),
     "FLAGS_flash_bwd": ("jax", "pallas", "jaxlib"),
+    "FLAGS_conv_epilogue": ("reference", "pallas"),
 }
 
 
@@ -177,7 +186,8 @@ def trace_key() -> tuple:
     executors include this (plus amp.state_key()) in compiled-program
     cache keys so a flag flip between runs recompiles instead of reusing
     a stale executable."""
-    return (conv_layout(), _VALUES["FLAGS_flash_bwd"])
+    return (conv_layout(), _VALUES["FLAGS_flash_bwd"],
+            _VALUES["FLAGS_conv_epilogue"])
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

@@ -52,8 +52,10 @@ def conv_bn_act_reference(x, w, gamma, beta, z=None, *, stride=1,
                           padding="SAME", eps=1e-5, act="relu"):
     """Pure-jax reference: XLA conv + batch-norm + residual + act.
     x: [N, H, W, C] NHWC; w: [K, K, C, F].  Returns (y, mean, var)."""
+    pad = ([(padding, padding)] * 2 if isinstance(padding, int)
+           else padding)
     out = jax.lax.conv_general_dilated(
-        x, w, window_strides=(stride, stride), padding=padding,
+        x, w, window_strides=(stride, stride), padding=pad,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     of = out.astype(jnp.float32)
@@ -149,8 +151,14 @@ def conv_bn_act(x, w, gamma, beta, z=None, *, stride=1, padding="SAME",
         Ho = (H - K) // stride + 1
         Wo = (W - K) // stride + 1
         pads = ((0, 0), (0, 0))
+    elif isinstance(padding, int):
+        # fluid-style explicit symmetric padding (conv2d's `padding` attr)
+        Ho = (H + 2 * padding - K) // stride + 1
+        Wo = (W + 2 * padding - K) // stride + 1
+        pads = ((padding, padding), (padding, padding))
     else:
-        raise ValueError(f"padding must be SAME or VALID, got {padding!r}")
+        raise ValueError(
+            f"padding must be SAME, VALID or an int, got {padding!r}")
     xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
     Hp, Wp = xp.shape[1], xp.shape[2]
 

@@ -28,6 +28,13 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
     also keeps the separate reference-shaped batch_norm op (transpilers
     that pattern-match conv+BN, e.g. the inference fold, want that
     shape)."""
+    if fuse_bn == "conv":
+        # whole-block one-op tier: the conv itself joins the fusion so
+        # FLAGS_conv_epilogue=pallas can accumulate BN stats inside the
+        # conv pass (kernels/conv_epilogue.py)
+        return layers.conv_bn_add_act(
+            input, ch_out, filter_size, stride=stride, padding=padding,
+            act=act)
     conv = layers.conv2d(
         input=input, num_filters=ch_out, filter_size=filter_size,
         stride=stride, padding=padding, act=None, bias_attr=False,
@@ -48,6 +55,9 @@ def _shortcut(input, ch_out, stride, fuse_bn=False):
 def basicblock(input, ch_out, stride, fuse_bn=False):
     s = _shortcut(input, ch_out, stride, fuse_bn=fuse_bn)
     conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, fuse_bn=fuse_bn)
+    if fuse_bn == "conv":
+        return layers.conv_bn_add_act(conv1, ch_out, 3, residual=s,
+                                      stride=1, padding=1, act="relu")
     conv2 = layers.conv2d(conv1, num_filters=ch_out, filter_size=3,
                           stride=1, padding=1, act=None, bias_attr=False)
     if fuse_bn:
@@ -61,6 +71,9 @@ def bottleneck(input, ch_out, stride, fuse_bn=False):
     s = _shortcut(input, ch_out * 4, stride, fuse_bn=fuse_bn)
     conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, fuse_bn=fuse_bn)
     conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, fuse_bn=fuse_bn)
+    if fuse_bn == "conv":
+        return layers.conv_bn_add_act(conv2, ch_out * 4, 1, residual=s,
+                                      stride=1, padding=0, act="relu")
     conv3 = layers.conv2d(conv2, num_filters=ch_out * 4, filter_size=1,
                           stride=1, padding=0, act=None, bias_attr=False)
     if fuse_bn:

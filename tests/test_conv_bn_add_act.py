@@ -1,0 +1,137 @@
+"""conv_bn_add_act: the whole-block one-op tier (conv2d + BN + residual +
+act; reference counterpart operators/conv_fusion_op.cu.cc).
+
+Contract: numerical identity with the conv2d -> batch_norm ->
+elementwise_add -> relu chain for BOTH implementations —
+FLAGS_conv_epilogue=reference (one lowering, XLA fuses) and =pallas
+(kernels/conv_epilogue.py, interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _train(mode, steps=4, seed=7, with_residual=True):
+    """mode: 'chain' | 'op-ref' | 'op-pallas'."""
+    fluid.reset_default_env()
+    fluid.set_flags({"FLAGS_conv_epilogue":
+                     "pallas" if mode == "op-pallas" else "reference"})
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    yv = layers.data("y", [1], dtype="int64")
+    res = x if with_residual else None
+    if mode == "chain":
+        conv = layers.conv2d(x, 4, 3, padding=1, bias_attr=False,
+                             param_attr=fluid.ParamAttr(name="w"))
+        b = layers.batch_norm(conv, act=None,
+                              param_attr=fluid.ParamAttr(name="s"),
+                              bias_attr=fluid.ParamAttr(name="b"),
+                              moving_mean_name="m", moving_variance_name="v")
+        h = layers.relu(layers.elementwise_add(b, res)
+                        if res is not None else b)
+    else:
+        h = layers.conv_bn_add_act(
+            x, 4, 3, residual=res, padding=1, act="relu",
+            param_attr=fluid.ParamAttr(name="w"),
+            bn_param_attr=fluid.ParamAttr(name="s"),
+            bn_bias_attr=fluid.ParamAttr(name="b"),
+            moving_mean_name="m", moving_variance_name="v")
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax",
+                     param_attr=fluid.ParamAttr(name="fc"))
+    loss = layers.mean(layers.cross_entropy(pred, yv))
+    fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r = np.random.RandomState(5)
+    xa = r.randn(8, 4, 8, 8).astype("float32")
+    ya = r.randint(0, 3, size=(8, 1)).astype("int64")
+    ls = [float(np.ravel(np.asarray(exe.run(feed={"x": xa, "y": ya},
+          fetch_list=[loss])[0]))[0]) for _ in range(steps)]
+    sc = fluid.global_scope()
+    st = {n: np.asarray(sc.find_var(n)).copy()
+          for n in ("w", "s", "b", "m", "v", "fc")}
+    fluid.set_flags({"FLAGS_conv_epilogue": "reference"})
+    return ls, st
+
+
+@pytest.mark.parametrize("with_residual", [True, False])
+def test_one_op_matches_chain_both_impls(with_residual):
+    l0, s0 = _train("chain", with_residual=with_residual)
+    l1, s1 = _train("op-ref", with_residual=with_residual)
+    l2, s2 = _train("op-pallas", with_residual=with_residual)
+    assert l0[-1] < l0[0]  # training moved
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(l0, l2, rtol=1e-4, atol=1e-5)
+    for n in s0:
+        np.testing.assert_allclose(s0[n], s1[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
+        np.testing.assert_allclose(s0[n], s2[n], rtol=1e-4, atol=1e-5,
+                                   err_msg=n)
+
+
+def test_test_mode_uses_moving_stats():
+    """clone(for_test=True): the op normalizes with MOVING stats and does
+    not update them (reference BN contract)."""
+    _l, _s = None, None
+    fluid.reset_default_env()
+    fluid.default_startup_program().random_seed = 3
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    h = layers.conv_bn_add_act(x, 4, 3, residual=x, padding=1, act="relu",
+                               moving_mean_name="tm",
+                               moving_variance_name="tv")
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r = np.random.RandomState(0)
+    xa = r.randn(2, 4, 8, 8).astype("float32")
+    m0 = np.asarray(fluid.global_scope().find_var("tm")).copy()
+    (y1,) = exe.run(program=test_prog, feed={"x": xa}, fetch_list=[h])
+    (y2,) = exe.run(program=test_prog, feed={"x": xa}, fetch_list=[h])
+    m1 = np.asarray(fluid.global_scope().find_var("tm"))
+    np.testing.assert_array_equal(m0, m1)  # stats untouched
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_resnet_conv_tier_matches_unfused():
+    from paddle_tpu import models
+
+    def run(fuse_bn):
+        fluid.reset_default_env()
+        fluid.default_main_program().random_seed = 3
+        fluid.default_startup_program().random_seed = 3
+        spec = models.resnet_cifar10(depth=8, class_num=4, fuse_bn=fuse_bn)
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        b = spec.synthetic_batch(8, seed=2)
+        return [float(np.ravel(np.asarray(
+            exe.run(feed=b, fetch_list=[spec.loss])[0]))[0])
+            for _ in range(3)]
+
+    base = run(False)
+    conv_tier = run("conv")
+    assert base[-1] < base[0]
+    np.testing.assert_allclose(base, conv_tier, rtol=1e-5, atol=1e-6)
+
+
+def test_mismatched_residual_raises():
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    bad = layers.pool2d(x, pool_size=8, pool_type="avg")  # [N,4,1,1]
+    with pytest.raises(ValueError, match="residual Z shape"):
+        layers.conv_bn_add_act(x, 4, 3, residual=bad, padding=1)
+
+
+def test_rectangular_stride_rejected():
+    fluid.reset_default_env()
+    x = layers.data("x", [4, 8, 8], dtype="float32")
+    with pytest.raises(NotImplementedError, match="square"):
+        h = layers.conv_bn_add_act(x, 4, 3, padding=1, stride=(1, 2))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        exe.run(feed={"x": np.zeros((2, 4, 8, 8), "float32")},
+                fetch_list=[h])

@@ -11,7 +11,9 @@ strictly after the safety numbers:
   5. deepfm_unroll     flat 8-step jit A/B for the dispatch-bound model
   6. cache_coldstart   fresh-process reuse of the just-banked executables
   7. profiles          tools/tpu_profile.py resnet50 + deepfm
-  8. conv-epilogue     staged pallas conv+BN-epilogue probe (risky)
+  8. conv-epilogue     staged pallas conv+BN-epilogue probe (risky);
+                       on success: conv_ep_model — resnet50 built as
+                       one-op conv_bn_add_act blocks, pallas impl
   9. flash-bwd probe   tools/flash_bwd_probe.py stages 1..3 (risky: LAST)
  10. flash-bwd bench   transformer with FLAGS_flash_bwd=pallas, ONLY if
                        all three probe stages passed
@@ -206,6 +208,20 @@ def main() -> None:
             # a failed/timed-out pallas compile is the round-3 relay-wedge
             # signature: don't queue MORE risky compiles on that signal
             relay_suspect = ce.get("rc") != 0
+            if not relay_suspect and risky_allowed():
+                # probe passed: the full-model A/B — resnet50 built as
+                # one-op conv_bn_add_act blocks with the pallas
+                # implementation vs the banked unfused number
+                run_step(
+                    "conv_ep_model",
+                    [py, "bench.py"],
+                    {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50",
+                     "BENCH_FUSE_BN": "conv",
+                     "FLAGS_conv_epilogue": "pallas",
+                     "BENCH_TUNE": "0", "BENCH_AMP": "keep",
+                     "BENCH_LAYOUT": "NHWC", "BENCH_COST": "1",
+                     "BENCH_DEADLINE_S": "1500"},
+                    1800, args.out)
         else:
             print(json.dumps({"step": "conv_epilogue", "skipped":
                               "risky window closed"}), flush=True)
