@@ -506,6 +506,13 @@ def conv_bn_add_act(input, num_filters, filter_size, residual=None,
     fsize = _pair(filter_size)
     if fsize[0] != fsize[1]:
         raise ValueError("conv_bn_add_act needs a square filter")
+    if _pair(stride)[0] != _pair(stride)[1] or \
+            _pair(padding)[0] != _pair(padding)[1]:
+        # fail at model-definition time, not first exe.run (the lowering
+        # would raise the same constraint much later)
+        raise NotImplementedError(
+            "conv_bn_add_act needs square stride/padding "
+            f"(got stride={stride}, padding={padding})")
     filter_shape = [num_filters, num_channels] + fsize
     fan_in = num_channels * fsize[0] * fsize[1]
     w = helper.create_parameter(

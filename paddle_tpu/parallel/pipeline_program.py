@@ -27,11 +27,13 @@ Contract:
 - segments must be parameter-pure (no random ops, no state writes):
   batch_norm in train mode or dropout inside a stage raises.
 
-Training: forward-only for now.  The backward GPipe schedule (stacked
-grads + reverse ppermute hops) composes with jax.grad over
-`pipeline_apply` mathematically, but the Program-path optimizer update
-on stage-sharded params is round-6 work; use dp/tp/sp for training
-today (ParallelExecutor) and pp for inference/serving of deep stacks.
+Training: `train_step` runs the full pipelined forward+backward (the
+backward GPipe schedule falls out of jax.grad over `pipeline_apply` —
+scan/ppermute transpose to the reverse hops) with an SGD(+momentum)
+update on the stacked per-stage params, written back to the scope.
+Gradient and updated-weight parity with serial per-microbatch execution
+is the test contract.  Full fluid-optimizer parity (Adam state on
+stage-sharded params) stays with ParallelExecutor's dp/tp/sp path.
 """
 
 from __future__ import annotations
@@ -119,6 +121,15 @@ class ProgramPipeline:
                 f"but boundaries define {self.num_stages} stages")
         self._segments = self._split()
         self._check_isomorphic()
+        seen: Dict[str, int] = {}
+        for s, seg in enumerate(self._segments):
+            for n in seg.params:
+                if n in seen:
+                    raise ValueError(
+                        f"parameter '{n}' is read by stages {seen[n]} and "
+                        f"{s}: tied weights cannot be stage-stacked (each "
+                        "stage needs its own parameter copy)")
+                seen[n] = s
         self._stage_fn = None
         self._stacked = None
 
@@ -257,10 +268,73 @@ class ProgramPipeline:
             for j in range(len(per_stage[0]))
         )
 
+    def train_step(self, x_microbatches, y_microbatches, loss_fn,
+                   lr: float = 0.01, momentum: float = 0.0) -> float:
+        """One pipelined GPipe TRAINING step through the Program-derived
+        stages: forward streams the micro-batches over the pp axis,
+        backward flows through the same schedule (jax.grad over
+        pipeline_apply — ppermute/scan transpose to the reverse hops;
+        gradient parity with serial execution is the test contract), and
+        the stacked per-stage parameters take an SGD(+momentum) update
+        held device-side (call sync_to_scope() to publish the trained
+        slices to the scope for Executor use / checkpoint io).
+
+        loss_fn(out_m, y_m) -> scalar per micro-batch; the step optimizes
+        mean over micro-batches.  Returns the step's mean loss.  This is
+        the pipeline sibling of Executor.run on a program whose optimizer
+        ops do the update; full fluid-optimizer parity on stage-sharded
+        params (Adam state etc.) stays with ParallelExecutor's dp/tp/sp
+        path."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._stage_fn is None:
+            self._stage_fn = self._make_stage_fn()
+        if self._stacked is None:
+            self._stacked = self._stacked_params()
+        x = jnp.asarray(x_microbatches)
+        y = jnp.asarray(y_microbatches)
+        stage_fn, mesh, pp_axis = self._stage_fn, self.mesh, self.pp_axis
+
+        def objective(params):
+            out = pipeline_apply(stage_fn, params, x, mesh,
+                                 pp_axis=pp_axis)
+            losses = jax.vmap(loss_fn)(out, y)
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(objective)(self._stacked)
+        if momentum:
+            if not hasattr(self, "_vel"):
+                self._vel = tuple(jnp.zeros_like(p) for p in self._stacked)
+            self._vel = tuple(momentum * v + g
+                              for v, g in zip(self._vel, grads))
+            upd = self._vel
+        else:
+            upd = grads
+        self._stacked = tuple(p - lr * u
+                              for p, u in zip(self._stacked, upd))
+        return float(loss)
+
+    def sync_to_scope(self) -> None:
+        """Write the trained per-stage parameter slices back to the
+        scope (device->host, one transfer per param per stage).  Deferred
+        out of train_step so a training loop pays it once before
+        Executor use / checkpoint io, not every step."""
+        if self._stacked is None:
+            return
+        for s, seg in enumerate(self._segments):
+            for j, name in enumerate(seg.params):
+                self.scope.set_var(name, np.asarray(self._stacked[j][s]))
+
     def refresh_params(self) -> None:
-        """Drop the cached stacked parameters; the next run() re-reads the
-        scope.  Call after overwriting weights (e.g. a checkpoint load)."""
+        """Drop the cached stacked parameters AND the momentum velocity;
+        the next run()/train_step re-reads the scope.  Call after
+        overwriting weights (e.g. a checkpoint load) — stale velocity
+        from the discarded trajectory must not steer the restored
+        weights."""
         self._stacked = None
+        if hasattr(self, "_vel"):
+            del self._vel
 
     def run(self, x_microbatches) -> np.ndarray:
         """Stream [M, ...]-shaped micro-batches through the stages; returns
