@@ -375,6 +375,13 @@ class Executor:
         # arrays it returns would compile a SECOND — device_put is a no-op
         # for values already on `device`
         state_vals = jax.device_put(state_vals, device)
+        # commit the PRNG key too: a fresh host key (first call) and the
+        # committed key a previous call wrote back lower to DIFFERENT
+        # executables (committed-ness is part of jax's lowering cache
+        # key), so without this every program compiled twice — trace
+        # cache hit, full XLA recompile (observed: 2x ~8 s flat-unroll
+        # compiles on CPU; through the relay that is minutes per bench)
+        rng = jax.device_put(rng, device)
 
         with jax.default_device(device):
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
@@ -454,6 +461,13 @@ class Executor:
             feed_vals = plan.feed_values(feed, block0)
             state_vals = plan.state_values(scope, block0)
             rng = plan.rng_value(scope, program)
+            # same device commit as run(): the analyzed executable must
+            # BE the one run() dispatches (an uncommitted key would
+            # lower a second, never-reused variant)
+            device = self.place.jax_device()
+            feed_vals = jax.device_put(feed_vals, device)
+            state_vals = jax.device_put(state_vals, device)
+            rng = jax.device_put(rng, device)
             return compiled.cost_analysis(feed_vals, state_vals, rng)
 
     def run_steps(
@@ -560,6 +574,8 @@ class Executor:
         rng = plan.rng_value(scope, program)
 
         state_vals = jax.device_put(state_vals, device)
+        rng = jax.device_put(rng, device)  # see run(): avoids a second
+        # full XLA compile when the committed written-back key returns
         with jax.default_device(device):
             fetches, new_states, new_rng = fn(feeds_stack, state_vals, rng)
 

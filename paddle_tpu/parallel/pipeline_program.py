@@ -121,17 +121,25 @@ class ProgramPipeline:
                 f"but boundaries define {self.num_stages} stages")
         self._segments = self._split()
         self._check_isomorphic()
+        self._stage_fn = None
+        self._stacked = None
+        self._train_cache: Dict = {}
+
+    def _check_untied(self) -> None:
+        """Training-only constraint: a parameter shared across stages
+        stacks the same value per stage — fine for forward/serving, but
+        per-slice updates would diverge the copies (grads are not
+        summed), so train_step rejects it."""
         seen: Dict[str, int] = {}
         for s, seg in enumerate(self._segments):
             for n in seg.params:
                 if n in seen:
                     raise ValueError(
                         f"parameter '{n}' is read by stages {seen[n]} and "
-                        f"{s}: tied weights cannot be stage-stacked (each "
-                        "stage needs its own parameter copy)")
+                        f"{s}: tied weights cannot be stage-stacked for "
+                        "TRAINING (each stage needs its own copy; forward "
+                        "run() supports them)")
                 seen[n] = s
-        self._stage_fn = None
-        self._stacked = None
 
     # ------------------------------------------------------------------
     def _split(self) -> List[_Segment]:
@@ -252,6 +260,8 @@ class ProgramPipeline:
         shape [S, *param_j.shape], sharded on pp by pipeline_apply."""
         import jax.numpy as jnp
 
+        from jax.sharding import NamedSharding, PartitionSpec
+
         per_stage = []
         for seg in self._segments:
             vals = []
@@ -262,10 +272,22 @@ class ProgramPipeline:
                                      "run the startup program first")
                 vals.append(np.asarray(v))
             per_stage.append(vals)
-        return tuple(
+        stacked = tuple(
             jnp.stack([np.asarray(per_stage[s][j])
                        for s in range(self.num_stages)])
             for j in range(len(per_stage[0]))
+        )
+        # commit each leaf with its pipeline sharding up front: fresh
+        # host arrays and the sharded arrays a previous train_step
+        # returned must present the SAME aval, or the second call pays a
+        # silent full recompile (committed-ness is part of jax's
+        # lowering cache key — the executor rng bug's sibling)
+        jmesh = self.mesh.mesh if hasattr(self.mesh, "mesh") else self.mesh
+        return tuple(
+            jax.device_put(s, NamedSharding(
+                jmesh,
+                PartitionSpec(self.pp_axis, *([None] * (s.ndim - 1)))))
+            for s in stacked
         )
 
     def train_step(self, x_microbatches, y_microbatches, loss_fn,
@@ -288,31 +310,52 @@ class ProgramPipeline:
         import jax
         import jax.numpy as jnp
 
+        self._check_untied()
         if self._stage_fn is None:
             self._stage_fn = self._make_stage_fn()
         if self._stacked is None:
             self._stacked = self._stacked_params()
         x = jnp.asarray(x_microbatches)
         y = jnp.asarray(y_microbatches)
-        stage_fn, mesh, pp_axis = self._stage_fn, self.mesh, self.pp_axis
+        if x.ndim < 2:
+            raise ValueError("x_microbatches must be [M, batch, ...]")
 
-        def objective(params):
-            out = pipeline_apply(stage_fn, params, x, mesh,
-                                 pp_axis=pp_axis)
-            losses = jax.vmap(loss_fn)(out, y)
-            return jnp.mean(losses)
+        use_momentum = bool(momentum)
+        # ONE jitted update per (loss_fn, momentum arity): a fresh
+        # closure per call would silently recompile the whole pipelined
+        # fwd+bwd every step (the executor rng-commit bug's sibling);
+        # lr/momentum ride as dynamic scalars so tuning them is free
+        cache_key = (id(loss_fn), use_momentum)
+        update = self._train_cache.get(cache_key)
+        if update is None:
+            stage_fn, mesh, pp_axis = self._stage_fn, self.mesh, self.pp_axis
 
-        loss, grads = jax.value_and_grad(objective)(self._stacked)
-        if momentum:
-            if not hasattr(self, "_vel"):
-                self._vel = tuple(jnp.zeros_like(p) for p in self._stacked)
-            self._vel = tuple(momentum * v + g
-                              for v, g in zip(self._vel, grads))
-            upd = self._vel
-        else:
-            upd = grads
-        self._stacked = tuple(p - lr * u
-                              for p, u in zip(self._stacked, upd))
+            def update_fn(params, vel, xs, ys, lr_, mom_):
+                def objective(p):
+                    out = pipeline_apply(stage_fn, p, xs, mesh,
+                                         pp_axis=pp_axis)
+                    return jnp.mean(jax.vmap(loss_fn)(out, ys))
+
+                loss, grads = jax.value_and_grad(objective)(params)
+                if use_momentum:
+                    vel = tuple(mom_ * v + g for v, g in zip(vel, grads))
+                    upd = vel
+                else:
+                    upd = grads
+                new_p = tuple(p - lr_ * u for p, u in zip(params, upd))
+                return loss, new_p, vel
+
+            update = jax.jit(update_fn)
+            self._train_cache[cache_key] = update
+
+        if use_momentum and not hasattr(self, "_vel"):
+            self._vel = tuple(jnp.zeros_like(p) for p in self._stacked)
+        vel = self._vel if use_momentum else ()
+        loss, self._stacked, vel = update(
+            self._stacked, vel, x, y, jnp.float32(lr),
+            jnp.float32(momentum))
+        if use_momentum:
+            self._vel = vel
         return float(loss)
 
     def sync_to_scope(self) -> None:

@@ -505,3 +505,49 @@ def test_cost_analysis_rejects_compiled_program():
     exe = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(TypeError, match="plain Program"):
         exe.cost_analysis(program=fluid.CompiledProgram(fluid.Program()))
+
+
+def test_no_recompile_on_second_run():
+    """The written-back (committed) PRNG key must not change the lowering
+    cache key: two identical exe.run calls = exactly ONE XLA compile
+    (review r5: the uncommitted fresh key vs committed written-back key
+    caused a silent full recompile on every program's second step —
+    minutes per bench through the TPU relay)."""
+    import os
+    import subprocess
+    import sys
+
+    src = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+compiles = {"n": 0}
+from jax._src import monitoring
+def lis(event, **kw):
+    if "backend_compile" in event:
+        compiles["n"] += 1
+monitoring.register_event_listener(lis)
+monitoring.register_event_duration_secs_listener(
+    lambda event, dur, **kw: lis(event))
+import numpy as np
+import paddle_tpu as fluid
+x = fluid.layers.data("x", [8], dtype="float32")
+h = fluid.layers.fc(x, size=8, act="tanh")
+loss = fluid.layers.mean(h)
+fluid.optimizer.SGD(0.1).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+base = compiles["n"]
+feed = {"x": np.ones((4, 8), "float32")}
+for _ in range(3):
+    exe.run(feed=feed, fetch_list=[loss])
+print("MAIN_COMPILES", compiles["n"] - base)
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", src],
+                         capture_output=True, text=True, timeout=300,
+                         env=dict(os.environ, REPO=repo))
+    assert out.returncode == 0, out.stderr[-1500:]
+    n = int(out.stdout.split("MAIN_COMPILES")[1].split()[0])
+    assert n == 1, f"expected exactly 1 XLA compile for 3 identical runs, got {n}"

@@ -236,9 +236,12 @@ def test_program_pipeline_train_step_matches_serial_sgd():
     assert l3 < l2 < got_loss
 
 
-def test_program_pipeline_rejects_tied_weights():
-    """A parameter shared across stages cannot be stage-stacked; must be
-    rejected at construction (review r5)."""
+def test_program_pipeline_tied_weights_serve_but_reject_training():
+    """Tied weights stack the same value per stage — fine for forward
+    serving (run parity vs serial), but train_step must reject them:
+    per-slice updates would silently diverge the copies (review r5)."""
+    import jax.numpy as jnp
+
     fluid.reset_default_env()
     x = layers.data("x", [8], dtype="float32")
     shared = fluid.ParamAttr(name="wshared")
@@ -246,12 +249,20 @@ def test_program_pipeline_rejects_tied_weights():
                    bias_attr=fluid.ParamAttr(name="b0"))
     h2 = layers.fc(h1, size=8, act="tanh", param_attr=shared,
                    bias_attr=fluid.ParamAttr(name="b1"))
-    _init()
+    exe = _init()
     test_prog = fluid.default_main_program().clone(for_test=True)
+    pp = ProgramPipeline([x, h1, h2],
+                         make_mesh({"pp": 2}, devices=jax.devices()[:2]),
+                         main_program=test_prog)
+    rng = np.random.RandomState(5)
+    xmb = rng.randn(4, 2, 8).astype("float32")
+    want = np.stack([
+        np.asarray(exe.run(program=test_prog, feed={"x": xmb[m]},
+                           fetch_list=[h2])[0]) for m in range(4)])
+    got = pp.run(xmb)   # forward with tied weights still works
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     with pytest.raises(ValueError, match="tied weights"):
-        ProgramPipeline([x, h1, h2],
-                        make_mesh({"pp": 2}, devices=jax.devices()[:2]),
-                        main_program=test_prog)
+        pp.train_step(xmb, xmb, lambda o, t: jnp.mean((o - t) ** 2))
 
 
 def test_refresh_params_clears_momentum():
