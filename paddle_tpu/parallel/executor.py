@@ -221,18 +221,9 @@ class ParallelExecutor:
                 for n, v in zip(plan.feed_names, feed_vals)
             )
 
-        # the serial Executor commits state/rng to ITS device (lowering-
-        # cache stability); explicitly reshard them to this mesh's
-        # shardings — pjit raises on committed single-device args that
-        # mismatch in_shardings (and once resharded, the arrays come
-        # back FROM pjit already in place, so this is a one-time copy)
         if not is_multiprocess(self.mesh):
-            state_vals = tuple(
-                jax.device_put(v, self._state_sharding(n, block0))
-                if isinstance(v, jax.Array) else v
-                for n, v in zip(plan.state_names, state_vals)
-            )
-            rng = jax.device_put(rng, self.mesh.replicated())
+            state_vals, rng = self._reshard_serial_state(
+                state_vals, rng, plan, block0)
         with self.mesh.mesh:
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 
@@ -359,13 +350,8 @@ class ParallelExecutor:
         state_vals = plan.state_values(self.scope, block0)
         rng = plan.rng_value(self.scope, self.program)
 
-        # see run(): explicit resharding of committed serial-side state
-        state_vals = tuple(
-            jax.device_put(v, sh) if isinstance(v, jax.Array) else v
-            for v, sh in zip(state_vals, (
-                self._state_sharding(n, block0) for n in plan.state_names))
-        )
-        rng = jax.device_put(rng, self.mesh.replicated())
+        state_vals, rng = self._reshard_serial_state(
+            state_vals, rng, plan, block0)
         with self.mesh.mesh:
             fetches, new_states, new_rng = fn(feeds_stack, state_vals, rng)
 
@@ -407,6 +393,20 @@ class ParallelExecutor:
                     f"the tail batch (e.g. paddle_tpu.reader decorators "
                     f"batch(..., drop_last=True))"
                 )
+
+    def _reshard_serial_state(self, state_vals, rng, plan, block0):
+        """The ONE copy of the serial->SPMD handoff: the serial Executor
+        commits state/rng to ITS device (lowering-cache stability), and
+        pjit raises on committed single-device args that mismatch
+        in_shardings — explicitly reshard them to this mesh's shardings.
+        One-time copy: arrays come back FROM pjit already in place."""
+        state_vals = tuple(
+            jax.device_put(v, self._state_sharding(n, block0))
+            if isinstance(v, jax.Array) else v
+            for n, v in zip(plan.state_names, state_vals)
+        )
+        rng = jax.device_put(rng, self.mesh.replicated())
+        return state_vals, rng
 
     def drop_local_exe_scopes(self):  # reference API; scopes are XLA-owned
         pass

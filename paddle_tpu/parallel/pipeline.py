@@ -12,6 +12,12 @@ micro-batches the loop runs M + S - 1 ticks; stage 0 injects micro-batch t
 at tick t, stage s processes what stage s-1 produced last tick, and the
 last stage emits finished micro-batches from tick S-1 on.  Bubble fraction
 (S-1)/(M+S-1) — callers pick M >> S for efficiency, exactly as in GPipe.
+
+The streamed activation is a PYTREE (a bare array is the trivial
+one-leaf tree).  Per-micro-batch side inputs every stage merely READS
+(attention masks, segment ids, encoder outputs) go in `aux`: they stay
+replicated and each stage indexes its current micro-batch locally —
+no ppermute hops or output psums are spent on data that never changes.
 """
 
 from __future__ import annotations
@@ -26,77 +32,127 @@ from jax.sharding import PartitionSpec as P
 __all__ = ["pipeline_apply"]
 
 
+def _bcast_from_last(o, stage, S, pp_axis):
+    """psum-broadcast stage S-1's copy, preserving the leaf dtype (a
+    float literal in jnp.where would silently promote int/bool leaves)."""
+    if o.dtype == jnp.bool_:
+        picked = jnp.where(stage == S - 1, o, False).astype(jnp.int32)
+        return jax.lax.psum(picked, pp_axis).astype(jnp.bool_)
+    picked = jnp.where(stage == S - 1, o, jnp.zeros((), o.dtype))
+    return jax.lax.psum(picked, pp_axis)
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
     x_microbatches,
     mesh,
     pp_axis: str = "pp",
+    aux=None,
 ):
     """Run `y = stage_{S-1}(... stage_0(x))` for every micro-batch, with
     stages laid out over the `pp_axis` of `mesh`.
 
-    stage_fn(params, x) -> y       same shape in and out (a layer block)
+    stage_fn(params, x[, aux_mb]) -> y   x and y are pytrees with the
+        SAME structure and leaf shapes (the streamed activation; a bare
+        array is fine)
     stage_params: pytree whose leaves have leading dim S (one slice per
         stage) — sharded onto the pp axis, so each device holds only its
         stage's parameters
-    x_microbatches: array [M, ...] of micro-batches (replicated across pp;
-        other mesh axes may shard the trailing dims through the caller's
-        own in_shardings)
-    returns [M, ...] outputs, replicated across pp.
+    x_microbatches: pytree of arrays [M, ...] of micro-batches
+        (replicated across pp; other mesh axes may shard the trailing
+        dims through the caller's own in_shardings)
+    aux: optional pytree of [M, ...] per-micro-batch side inputs
+        (attention masks, segment ids) that every stage READS but does
+        not transform.  Replicated on every device, so each stage
+        indexes its current micro-batch LOCALLY — no ppermute hops, no
+        output psum for them (streaming them through the ring would
+        all-reduce M mask-sized buffers for nothing).  When aux is
+        given, stage_fn takes a third argument: the aux slice for the
+        micro-batch that stage is processing this tick.
+    returns the x pytree of [M, ...] outputs, replicated across pp.
     """
     jmesh = mesh.mesh if hasattr(mesh, "mesh") else mesh
     S = jmesh.shape[pp_axis]
-    M = x_microbatches.shape[0]
+    leaves = jax.tree_util.tree_leaves(x_microbatches)
+    if not leaves:
+        raise ValueError("x_microbatches has no array leaves")
+    M = leaves[0].shape[0]
+    for leaf in jax.tree_util.tree_leaves((x_microbatches, aux)):
+        if leaf.shape[0] != M:
+            raise ValueError(
+                "every x_microbatches/aux leaf needs the same leading "
+                f"micro-batch dim: got {leaf.shape[0]} vs {M}")
     ticks = M + S - 1
+    tmap = jax.tree_util.tree_map
+    has_aux = aux is not None
 
-    def per_stage(params, xs):
-        # params: leaves [1, ...] (this stage's slice); xs: [M, ...] local
+    def per_stage(params, xs, auxs):
+        # params: leaves [1, ...] (this stage's slice); xs: leaves [M, ...]
         stage = jax.lax.axis_index(pp_axis)
-        local = jax.tree_util.tree_map(lambda p: p[0], params)
-        mb_shape = xs.shape[1:]
+        local = tmap(lambda p: p[0], params)
 
         def tick(carry, t):
             incoming, outputs = carry
             # stage 0 injects micro-batch t (zeros once the input drains)
-            inject = jnp.where(
-                t < M, xs[jnp.minimum(t, M - 1)], jnp.zeros(mb_shape, xs.dtype)
+            x_in = tmap(
+                lambda f, inc: jnp.where(
+                    stage == 0,
+                    jnp.where(t < M, f[jnp.minimum(t, M - 1)],
+                              jnp.zeros(f.shape[1:], f.dtype)),
+                    inc,
+                ),
+                xs, incoming,
             )
-            x_in = jnp.where(stage == 0, inject, incoming)
-            y = stage_fn(local, x_in)
+            if has_aux:
+                # stage s processes micro-batch t - s at tick t; the aux
+                # arrays are replicated, so index locally (out-of-range
+                # ticks read a clamped slice whose result is discarded)
+                mb = jnp.clip(t - stage, 0, M - 1)
+                aux_mb = tmap(lambda a: a[mb], auxs)
+                y = stage_fn(local, x_in, aux_mb)
+            else:
+                y = stage_fn(local, x_in)
             # the last stage finishes micro-batch t - (S - 1) at tick t
             done_idx = t - (S - 1)
-            outputs = jnp.where(
-                (stage == S - 1) & (done_idx >= 0),
-                outputs.at[jnp.maximum(done_idx, 0)].set(y),
-                outputs,
+            outputs = tmap(
+                lambda o, yl: jnp.where(
+                    (stage == S - 1) & (done_idx >= 0),
+                    o.at[jnp.maximum(done_idx, 0)].set(yl),
+                    o,
+                ),
+                outputs, y,
             )
             # hand the activation to the next stage (ring; stage S-1's
             # send wraps to stage 0, which ignores it)
-            incoming = jax.lax.ppermute(
-                y, pp_axis, [(i, (i + 1) % S) for i in range(S)]
+            incoming = tmap(
+                lambda yl: jax.lax.ppermute(
+                    yl, pp_axis, [(i, (i + 1) % S) for i in range(S)]
+                ),
+                y,
             )
             return (incoming, outputs), None
 
-        outputs0 = jnp.zeros((M,) + mb_shape, xs.dtype)
+        zeros_mb = tmap(lambda f: jnp.zeros(f.shape[1:], f.dtype), xs)
+        outputs0 = tmap(lambda f: jnp.zeros_like(f), xs)
         (_, outputs), _ = jax.lax.scan(
-            tick, (jnp.zeros(mb_shape, xs.dtype), outputs0),
-            jnp.arange(ticks),
+            tick, (zeros_mb, outputs0), jnp.arange(ticks),
         )
-        # every device returns [M, ...]; only stage S-1's copy is real —
-        # psum over pp broadcasts it (other stages contribute zeros)
-        outputs = jnp.where(stage == S - 1, outputs, 0.0)
-        return jax.lax.psum(outputs, pp_axis)
+        # every device returns [M, ...]; only stage S-1's copy is real
+        return tmap(
+            lambda o: _bcast_from_last(o, stage, S, pp_axis), outputs,
+        )
 
-    param_specs = jax.tree_util.tree_map(
+    param_specs = tmap(
         lambda p: P(pp_axis, *([None] * (p.ndim - 1))), stage_params
     )
-    x_spec = P(*([None] * x_microbatches.ndim))
+    x_specs = tmap(lambda f: P(*([None] * f.ndim)), x_microbatches)
+    aux_specs = tmap(lambda f: P(*([None] * f.ndim)), aux)
 
     fn = shard_map(
         per_stage, mesh=jmesh,
-        in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
+        in_specs=(param_specs, x_specs, aux_specs),
+        out_specs=x_specs,
         check_vma=False,
     )
-    return fn(stage_params, x_microbatches)
+    return fn(stage_params, x_microbatches, aux)

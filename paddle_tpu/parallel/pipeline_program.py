@@ -26,6 +26,11 @@ Contract:
   verified up front, mismatches raise before any compile.
 - segments must be parameter-pure (no random ops, no state writes):
   batch_norm in train mode or dropout inside a stage raises.
+- stages may read shared FEED vars besides the chain (attention masks,
+  segment ids): these "carried" inputs ride as replicated aux arrays —
+  each stage indexes its current micro-batch locally, no ppermute hops
+  (pass `carried={name: [M, ...]}` to run/train_step); every stage must
+  read the same carried names.
 
 Training: `train_step` runs the full pipelined forward+backward (the
 backward GPipe schedule falls out of jax.grad over `pipeline_apply` —
@@ -59,9 +64,11 @@ _IMPURE = {"dropout", "uniform_random", "gaussian_random",
 
 
 class _Segment:
-    def __init__(self, ops, params: List[str], in_name: str, out_name: str):
+    def __init__(self, ops, params: List[str], carried: List[str],
+                 in_name: str, out_name: str):
         self.ops = ops            # OpDesc list, program order
         self.params = params      # persistable input names, first-use order
+        self.carried = carried    # feed-var side inputs streamed alongside
         self.in_name = in_name
         self.out_name = out_name
 
@@ -182,6 +189,7 @@ class ProgramPipeline:
                        if op.type not in _SKIP]
             produced = set()
             params: List[str] = []
+            carried: List[str] = []
             in_name = names[s]
             for op in seg_ops:
                 if op.type in _IMPURE:
@@ -203,23 +211,41 @@ class ProgramPipeline:
                             "serial Executor would update it, the pipeline "
                             "would silently drop it")
                 for n in op.input_arg_names():
-                    if n in produced or n == in_name or n in params:
+                    if (n in produced or n == in_name or n in params
+                            or n in carried):
                         continue
                     v = bdesc.vars.get(n)
-                    if v is None or not v.persistable:
-                        raise ValueError(
-                            f"stage {s} reads '{n}' which is neither the "
-                            f"stage input '{in_name}', a stage-internal "
-                            "value, nor a parameter — stages must be "
-                            "self-contained chains")
-                    params.append(n)
+                    if v is not None and v.persistable:
+                        params.append(n)
+                        continue
+                    if v is not None and n not in producer:
+                        # a FEED var read inside the stage (attention
+                        # mask, segment ids): streamed alongside the
+                        # activation through the schedule — every stage
+                        # must read the same names (checked below)
+                        carried.append(n)
+                        continue
+                    raise ValueError(
+                        f"stage {s} reads '{n}' which is neither the "
+                        f"stage input '{in_name}', a stage-internal "
+                        "value, a parameter, nor a feed — stages must "
+                        "be self-contained chains")
                 produced.update(op.output_arg_names())
             if names[s + 1] not in produced:
                 raise ValueError(
                     f"stage {s} ops do not produce boundary "
                     f"'{names[s + 1]}'")
-            segments.append(_Segment(seg_ops, params, in_name, names[s + 1]))
+            segments.append(_Segment(seg_ops, params, carried, in_name,
+                                     names[s + 1]))
             start = end + 1
+
+        want_carried = segments[0].carried
+        for s, seg in enumerate(segments[1:], start=1):
+            if seg.carried != want_carried:
+                raise ValueError(
+                    f"stage {s} carried inputs {seg.carried} differ from "
+                    f"stage 0's {want_carried}; side inputs must be the "
+                    "same feed vars in every stage")
         return segments
 
     def _check_isomorphic(self) -> None:
@@ -244,8 +270,11 @@ class ProgramPipeline:
         param_names = list(seg0.params)
         program = self.program
 
-        def stage_fn(params, x):
+        carried_names = list(seg0.carried)
+
+        def stage_fn(params, x, carried_vals):
             env: Dict[str, Any] = {seg0.in_name: x}
+            env.update(zip(carried_names, carried_vals))
             env.update(zip(param_names, params))
             ctx = LoweringContext(
                 program, block, env, jax.random.PRNGKey(0), is_test=True)
@@ -291,7 +320,8 @@ class ProgramPipeline:
         )
 
     def train_step(self, x_microbatches, y_microbatches, loss_fn,
-                   lr: float = 0.01, momentum: float = 0.0) -> float:
+                   lr: float = 0.01, momentum: float = 0.0,
+                   carried=None) -> float:
         """One pipelined GPipe TRAINING step through the Program-derived
         stages: forward streams the micro-batches over the pp axis,
         backward flows through the same schedule (jax.grad over
@@ -319,21 +349,33 @@ class ProgramPipeline:
         y = jnp.asarray(y_microbatches)
         if x.ndim < 2:
             raise ValueError("x_microbatches must be [M, batch, ...]")
+        ctup = self._carried_tuple(carried, x.shape[0])
 
         use_momentum = bool(momentum)
         # ONE jitted update per (loss_fn, momentum arity): a fresh
         # closure per call would silently recompile the whole pipelined
         # fwd+bwd every step (the executor rng-commit bug's sibling);
-        # lr/momentum ride as dynamic scalars so tuning them is free
+        # lr/momentum ride as dynamic scalars so tuning them is free.
+        # REUSE THE SAME loss_fn OBJECT across steps — a lambda built
+        # inside the training loop defeats the cache (warned below)
         cache_key = (id(loss_fn), use_momentum)
+        if cache_key not in self._train_cache and len(self._train_cache) >= 4:
+            import logging
+
+            logging.getLogger("paddle_tpu").warning(
+                "ProgramPipeline.train_step has compiled %d distinct "
+                "loss_fn variants — if you are passing a fresh lambda "
+                "each step, hoist it out of the loop: every new object "
+                "retraces and recompiles the whole pipelined fwd+bwd",
+                len(self._train_cache) + 1)
         update = self._train_cache.get(cache_key)
         if update is None:
             stage_fn, mesh, pp_axis = self._stage_fn, self.mesh, self.pp_axis
 
-            def update_fn(params, vel, xs, ys, lr_, mom_):
+            def update_fn(params, vel, xs, cs, ys, lr_, mom_):
                 def objective(p):
                     out = pipeline_apply(stage_fn, p, xs, mesh,
-                                         pp_axis=pp_axis)
+                                         pp_axis=pp_axis, aux=cs)
                     return jnp.mean(jax.vmap(loss_fn)(out, ys))
 
                 loss, grads = jax.value_and_grad(objective)(params)
@@ -352,7 +394,7 @@ class ProgramPipeline:
             self._vel = tuple(jnp.zeros_like(p) for p in self._stacked)
         vel = self._vel if use_momentum else ()
         loss, self._stacked, vel = update(
-            self._stacked, vel, x, y, jnp.float32(lr),
+            self._stacked, vel, x, ctup, y, jnp.float32(lr),
             jnp.float32(momentum))
         if use_momentum:
             self._vel = vel
@@ -379,9 +421,39 @@ class ProgramPipeline:
         if hasattr(self, "_vel"):
             del self._vel
 
-    def run(self, x_microbatches) -> np.ndarray:
+    def _carried_tuple(self, carried, M: int) -> tuple:
+        """Validate/order the carried side inputs (dict name -> [M, ...]
+        arrays) against the segments' carried names."""
+        import jax.numpy as jnp
+
+        names = self._segments[0].carried
+        carried = carried or {}
+        missing = [n for n in names if n not in carried]
+        if missing:
+            raise ValueError(
+                f"stages read side inputs {names}; pass carried= with "
+                f"per-micro-batch arrays (missing {missing})")
+        unknown = sorted(set(carried) - set(names))
+        if unknown:
+            raise ValueError(
+                f"carried keys {unknown} are not read by any stage "
+                f"(stages read {names}) — a misnamed side input would "
+                "otherwise be silently dropped")
+        vals = []
+        for n in names:
+            v = jnp.asarray(carried[n])
+            if v.shape[0] != M:
+                raise ValueError(
+                    f"carried '{n}' leading dim {v.shape[0]} != micro-"
+                    f"batch count {M}")
+            vals.append(v)
+        return tuple(vals)
+
+    def run(self, x_microbatches, carried=None) -> np.ndarray:
         """Stream [M, ...]-shaped micro-batches through the stages; returns
-        [M, ...] outputs (replicated over pp).
+        [M, ...] outputs (replicated over pp).  `carried` maps each feed
+        var the stages read (masks, segment ids) to its own [M, ...]
+        micro-batched array — streamed alongside the activation.
 
         The stacked parameters are read from the scope ONCE and cached —
         a serving loop pays the host-side stack + device transfer only on
@@ -395,7 +467,8 @@ class ProgramPipeline:
         x = jnp.asarray(x_microbatches)
         if x.ndim < 2:
             raise ValueError("x_microbatches must be [M, batch, ...]")
+        ctup = self._carried_tuple(carried, x.shape[0])
         out = pipeline_apply(
             self._stage_fn, self._stacked, x, self.mesh,
-            pp_axis=self.pp_axis)
+            pp_axis=self.pp_axis, aux=ctup)
         return np.asarray(out)

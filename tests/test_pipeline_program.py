@@ -282,3 +282,98 @@ def test_refresh_params_clears_momentum():
     assert hasattr(pp, "_vel")
     pp.refresh_params()  # checkpoint-load contract: velocity must reset
     assert not hasattr(pp, "_vel")
+
+
+def test_program_pipeline_carried_mask_input():
+    """Attention-stack shape: every stage reads the SAME feed var (a
+    mask) besides the hidden chain — streamed alongside the activation
+    through the schedule, with serial-Executor parity for both serving
+    and a training step."""
+    import jax.numpy as jnp
+
+    fluid.reset_default_env()
+    x = layers.data("x", [8], dtype="float32")
+    mask = layers.data("mask", [8], dtype="float32")
+    h = x
+    bounds = [x]
+    for s in range(2):
+        fc = layers.fc(h, size=8, act="tanh",
+                       param_attr=fluid.ParamAttr(name=f"cw{s}"),
+                       bias_attr=fluid.ParamAttr(name=f"cb{s}"))
+        h = layers.elementwise_mul(fc, mask)   # stage reads the mask
+        bounds.append(h)
+    _init(seed=31)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    M, B, D = 4, 2, 8
+    rng = np.random.RandomState(7)
+    xmb = rng.randn(M, B, D).astype("float32")
+    mmb = (rng.rand(M, B, D) > 0.3).astype("float32")
+    want = np.stack([
+        np.asarray(exe.run(program=test_prog,
+                           feed={"x": xmb[m], "mask": mmb[m]},
+                           fetch_list=[bounds[-1]])[0])
+        for m in range(M)
+    ])
+    pp = ProgramPipeline(bounds,
+                         make_mesh({"pp": 2}, devices=jax.devices()[:2]),
+                         main_program=test_prog)
+    got = pp.run(xmb, carried={"mask": mmb})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # missing carried input is a clear error
+    with pytest.raises(ValueError, match="side inputs"):
+        pp.run(xmb)
+
+    # training with the mask carried: loss decreases
+    ymb = rng.randn(M, B, D).astype("float32")
+    lf = lambda o, t: jnp.mean((o - t) ** 2)
+    l1 = pp.train_step(xmb, ymb, lf, lr=0.1, carried={"mask": mmb})
+    l2 = pp.train_step(xmb, ymb, lf, lr=0.1, carried={"mask": mmb})
+    assert np.isfinite(l1) and l2 < l1
+
+
+def test_program_pipeline_rejects_unknown_carried_key():
+    fluid.reset_default_env()
+    x = layers.data("x", [8], dtype="float32")
+    mask = layers.data("mask", [8], dtype="float32")
+    h1 = layers.elementwise_mul(layers.fc(x, size=8, act="tanh"), mask)
+    h2 = layers.elementwise_mul(layers.fc(h1, size=8, act="tanh"), mask)
+    _init()
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    pp = ProgramPipeline([x, h1, h2],
+                         make_mesh({"pp": 2}, devices=jax.devices()[:2]),
+                         main_program=test_prog)
+    rng = np.random.RandomState(0)
+    xmb = rng.randn(4, 2, 8).astype("float32")
+    mmb = np.ones((4, 2, 8), "float32")
+    with pytest.raises(ValueError, match="not read by any stage"):
+        pp.run(xmb, carried={"mask": mmb, "pos_ids": mmb})
+
+
+def test_pipeline_apply_preserves_leaf_dtypes():
+    """int/bool leaves in the streamed pytree must come back with their
+    dtypes intact (review r5: a float literal in the final broadcast
+    silently promoted them)."""
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import pipeline_apply
+
+    r = np.random.RandomState(0)
+    S, M, B, D = 2, 4, 2, 8
+    ws = jnp.asarray(r.randn(S, D, D).astype("float32") * 0.3)
+    xmb = jnp.asarray(r.randn(M, B, D).astype("float32"))
+    imb = jnp.asarray(r.randint(0, 5, size=(M, B, D)).astype("int32"))
+    bmb = jnp.asarray(r.rand(M, B, D) > 0.5)
+
+    def stage(w, tree):
+        h, i, b = tree
+        return (jnp.tanh(h @ w), i, b)
+
+    got_h, got_i, got_b = pipeline_apply(
+        stage, ws, (xmb, imb, bmb),
+        make_mesh({"pp": S}, devices=jax.devices()[:S]))
+    assert got_i.dtype == jnp.int32
+    assert got_b.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(imb))
+    np.testing.assert_array_equal(np.asarray(got_b), np.asarray(bmb))
