@@ -272,8 +272,12 @@ def transformer(
     layer_scope = (recompute_scope if cfg.use_recompute
                    else contextlib.nullcontext)
 
-    # encoder
+    # encoder.  enc_boundaries = [embed out, layer1 out, ...] — the
+    # stage cut points parallel.ProgramPipeline uses to pipeline the
+    # encoder stack over a pp mesh axis (the embedding + bias ops form
+    # the pipeline prefix)
     enc = b.embed(src_word, cfg.src_vocab_size, "src")
+    enc_boundaries = [enc]
     for i in range(cfg.n_layer):
         with layer_scope():
             attn = b.mha(enc, enc, src_bias, f"enc_l{i}_attn",
@@ -281,6 +285,7 @@ def transformer(
             enc = b.sublayer(enc, attn, f"enc_l{i}_attn")
             ff = b.ffn(enc, f"enc_l{i}_ffn")
             enc = b.sublayer(enc, ff, f"enc_l{i}_ffn")
+            enc_boundaries.append(enc)
 
     # decoder
     dec = b.embed(trg_word, cfg.trg_vocab_size, "trg")
@@ -349,5 +354,6 @@ def transformer(
         loss=avg_cost,
         metrics={"token_count": token_count, "sum_cost": sum_cost},
         synthetic_batch=synthetic_batch,
-        extras={"logits": logits, "config": cfg},
+        extras={"logits": logits, "config": cfg,
+                "enc_boundaries": enc_boundaries},
     )

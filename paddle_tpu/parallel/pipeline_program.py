@@ -130,6 +130,8 @@ class ProgramPipeline:
         self._check_isomorphic()
         self._stage_fn = None
         self._stacked = None
+        self._prefix = None
+        self._serve_fn = None
         self._train_cache: Dict = {}
 
     def _check_untied(self) -> None:
@@ -170,6 +172,19 @@ class ProgramPipeline:
                 "boundary variables must appear in program order: "
                 f"{list(zip(names[1:], idxs))}")
 
+        # PREFIX: when boundaries[0] is itself produced by an op (an
+        # embedding output, a computed attention bias's sibling), the ops
+        # up to and including its producer run OUTSIDE the pipeline —
+        # vmapped over the micro-batches from raw feeds (see
+        # _make_prefix_fn); the isomorphic stages start after it
+        prefix_end = producer.get(names[0], -1)
+        if prefix_end >= idxs[0]:
+            raise ValueError(
+                f"boundary '{names[0]}' is produced after '{names[1]}' — "
+                "boundaries must be in program order")
+        self._prefix_ops = [op for op in ops[:prefix_end + 1]
+                            if op.type not in _SKIP]
+
         # shape/dtype uniformity (GPipe streams one activation shape)
         v0 = bdesc.vars[names[0]]
         want = (tuple(v0.shape), str(v0.dtype))
@@ -182,7 +197,7 @@ class ProgramPipeline:
                     "pipeline stages must map like to like")
 
         segments = []
-        start = 0
+        start = prefix_end + 1
         for s in range(self.num_stages):
             end = idxs[s]
             seg_ops = [op for op in ops[start:end + 1]
@@ -192,7 +207,10 @@ class ProgramPipeline:
             carried: List[str] = []
             in_name = names[s]
             for op in seg_ops:
-                if op.type in _IMPURE:
+                if (op.type in _IMPURE
+                        and op.attrs.get("is_test") is not True):
+                    # test-mode dropout is a deterministic pass-through;
+                    # anything random/stateful in train mode is rejected
                     raise ValueError(
                         f"op '{op.type}' in stage {s} breaks stage purity "
                         "(random/stateful ops are not pipelineable)")
@@ -218,18 +236,18 @@ class ProgramPipeline:
                     if v is not None and v.persistable:
                         params.append(n)
                         continue
-                    if v is not None and n not in producer:
-                        # a FEED var read inside the stage (attention
-                        # mask, segment ids): streamed alongside the
-                        # activation through the schedule — every stage
-                        # must read the same names (checked below)
+                    if v is not None and producer.get(n, -1) <= prefix_end:
+                        # a feed var, or a value the PREFIX computes
+                        # (attention bias, sequence lengths): a carried
+                        # side input — every stage must read the same
+                        # names (checked below)
                         carried.append(n)
                         continue
                     raise ValueError(
                         f"stage {s} reads '{n}' which is neither the "
                         f"stage input '{in_name}', a stage-internal "
-                        "value, a parameter, nor a feed — stages must "
-                        "be self-contained chains")
+                        "value, a parameter, a feed, nor a prefix "
+                        "output — stages must be self-contained chains")
                 produced.update(op.output_arg_names())
             if names[s + 1] not in produced:
                 raise ValueError(
@@ -283,6 +301,100 @@ class ProgramPipeline:
             return env[seg0.out_name]
 
         return stage_fn
+
+    def _make_prefix_fn(self):
+        """Lower the prefix ops into prefix_fn(feeds_dict) ->
+        (x0, carried_tuple) over ONE micro-batch; run()/train_step vmap
+        it over the micro-batch dim.  Prefix params (embedding tables,
+        bias tables) are read from the scope and closed over as
+        replicated constants."""
+        bdesc = self.program.desc.block(0)
+        block = self.program.global_block()
+        program = self.program
+        carried_names = list(self._segments[0].carried)
+        out_name = self.boundary_names[0]
+
+        # prune the prefix to the ops the pipeline actually needs: the
+        # program region before boundaries[0] can hold unrelated work
+        # (the transformer builds decoder-side biases before the encoder
+        # embedding) whose feeds run_feeds must not demand
+        needed = {out_name, *carried_names}
+        prefix_ops = []
+        for op in reversed(self._prefix_ops):
+            if any(n in needed for n in op.output_arg_names()):
+                prefix_ops.append(op)
+                needed.update(op.input_arg_names())
+        prefix_ops.reverse()
+
+        # feeds = non-persistable inputs with no producer
+        produced = set()
+        for op in prefix_ops:
+            produced.update(op.output_arg_names())
+        feed_names, param_names = [], []
+        for op in prefix_ops:
+            for n in op.input_arg_names():
+                if n in produced or n in feed_names or n in param_names:
+                    continue
+                v = bdesc.vars.get(n)
+                if v is not None and v.persistable:
+                    param_names.append(n)
+                else:
+                    feed_names.append(n)
+        # a carried var may be a raw feed the prefix never touches
+        for n in carried_names:
+            if n not in produced and n not in feed_names:
+                feed_names.append(n)
+        param_vals = []
+        for n in param_names:
+            v = self.scope.find_var(n)
+            if v is None:
+                raise ValueError(f"prefix parameter '{n}' not found in "
+                                 "scope — run the startup program first")
+            param_vals.append(np.asarray(v))
+
+        def prefix_fn(feed_dict):
+            env: Dict[str, Any] = dict(zip(param_names, param_vals))
+            env.update({n: feed_dict[n] for n in feed_names})
+            ctx = LoweringContext(
+                program, block, env, jax.random.PRNGKey(0), is_test=True)
+            for op in prefix_ops:
+                lower_op(ctx, op, set())
+            return env[out_name], tuple(env[n] for n in carried_names)
+
+        return prefix_fn, feed_names
+
+    def run_feeds(self, feeds) -> np.ndarray:
+        """Full path from RAW FEEDS: `feeds` maps each data var to a
+        micro-batched [M, batch, ...] array; the program's prefix
+        (embedding, attention-bias computation) is vmapped over the
+        micro-batch dim to produce the pipeline input and every carried
+        side input, then the stages stream as usual.  This is how an
+        embedding-fronted encoder stack serves without the caller
+        precomputing hidden states."""
+        import jax.numpy as jnp
+
+        if not self._prefix_ops:
+            raise ValueError(
+                "this pipeline has no prefix (boundaries[0] is a feed); "
+                "call run(x_microbatches, carried=...) directly")
+        if self._prefix is None:
+            prefix_fn, feed_names = self._make_prefix_fn()
+            # jit the vmapped prefix ONCE: a serving loop must not pay
+            # op-by-op dispatch + param-table re-upload per request
+            self._prefix = (jax.jit(jax.vmap(prefix_fn)), feed_names)
+        prefix_jit, feed_names = self._prefix
+        missing = [n for n in feed_names if n not in feeds]
+        if missing:
+            raise ValueError(f"run_feeds needs micro-batched arrays for "
+                             f"{feed_names}; missing {missing}")
+        fvals = {n: jnp.asarray(feeds[n]) for n in feed_names}
+        x0, ctup = prefix_jit(fvals)
+        if self._stage_fn is None:
+            self._stage_fn = self._make_stage_fn()
+        if self._stacked is None:
+            self._stacked = self._stacked_params()
+        out = self._serve()(self._stacked, x0, ctup)
+        return np.asarray(out)
 
     def _stacked_params(self):
         """Stack segment s's parameter values stage-major: leaf j has
@@ -368,7 +480,8 @@ class ProgramPipeline:
                 "each step, hoist it out of the loop: every new object "
                 "retraces and recompiles the whole pipelined fwd+bwd",
                 len(self._train_cache) + 1)
-        update = self._train_cache.get(cache_key)
+        entry = self._train_cache.get(cache_key)
+        update = entry[0] if entry else None
         if update is None:
             stage_fn, mesh, pp_axis = self._stage_fn, self.mesh, self.pp_axis
 
@@ -388,7 +501,10 @@ class ProgramPipeline:
                 return loss, new_p, vel
 
             update = jax.jit(update_fn)
-            self._train_cache[cache_key] = update
+            # store loss_fn alongside: the closure already pins it, but
+            # the explicit reference makes the id()-keying safe by
+            # construction (a dead object's id could otherwise recycle)
+            self._train_cache[cache_key] = (update, loss_fn)
 
         if use_momentum and not hasattr(self, "_vel"):
             self._vel = tuple(jnp.zeros_like(p) for p in self._stacked)
@@ -416,10 +532,29 @@ class ProgramPipeline:
         the next run()/train_step re-reads the scope.  Call after
         overwriting weights (e.g. a checkpoint load) — stale velocity
         from the discarded trajectory must not steer the restored
-        weights."""
+        weights (the prefix snapshots embedding tables at build time, so
+        it re-reads the scope too)."""
         self._stacked = None
+        self._prefix = None
         if hasattr(self, "_vel"):
             del self._vel
+
+    def _serve(self):
+        """ONE jitted serving closure: pipeline_apply builds a fresh
+        shard_map each call, so an unjitted serve would retrace and
+        recompile the whole schedule per request (the train_step cache's
+        sibling).  Params/activations ride as arguments; jax.jit caches
+        per argument shape."""
+        if self._serve_fn is None:
+            stage_fn, mesh, pp_axis = (self._stage_fn, self.mesh,
+                                       self.pp_axis)
+
+            def serve(stacked, x, ctup):
+                return pipeline_apply(stage_fn, stacked, x, mesh,
+                                      pp_axis=pp_axis, aux=ctup)
+
+            self._serve_fn = jax.jit(serve)
+        return self._serve_fn
 
     def _carried_tuple(self, carried, M: int) -> tuple:
         """Validate/order the carried side inputs (dict name -> [M, ...]
@@ -468,7 +603,5 @@ class ProgramPipeline:
         if x.ndim < 2:
             raise ValueError("x_microbatches must be [M, batch, ...]")
         ctup = self._carried_tuple(carried, x.shape[0])
-        out = pipeline_apply(
-            self._stage_fn, self._stacked, x, self.mesh,
-            pp_axis=self.pp_axis, aux=ctup)
+        out = self._serve()(self._stacked, x, ctup)
         return np.asarray(out)
