@@ -49,14 +49,16 @@ __all__ = ["conv_bn_act", "conv_bn_act_reference", "make_conv_bn_act"]
 
 
 def conv_bn_act_reference(x, w, gamma, beta, z=None, *, stride=1,
-                          padding="SAME", eps=1e-5, act="relu"):
+                          padding="SAME", eps=1e-5, act="relu", groups=1):
     """Pure-jax reference: XLA conv + batch-norm + residual + act.
-    x: [N, H, W, C] NHWC; w: [K, K, C, F].  Returns (y, mean, var)."""
+    x: [N, H, W, C] NHWC; w: [K, K, C//groups, F].
+    Returns (y, mean, var)."""
     pad = ([(padding, padding)] * 2 if isinstance(padding, int)
            else padding)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=pad,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
     )
     of = out.astype(jnp.float32)
     mean = jnp.mean(of, axis=(0, 1, 2))

@@ -483,11 +483,11 @@ def fused_bn_add_act(x, y=None, act="relu", is_test=False, momentum=0.9,
 
 
 def conv_bn_add_act(input, num_filters, filter_size, residual=None,
-                    stride=1, padding=0, act="relu", is_test=False,
-                    momentum=0.9, epsilon=1e-5, param_attr=None,
-                    bn_param_attr=None, bn_bias_attr=None,
-                    moving_mean_name=None, moving_variance_name=None,
-                    name=None):
+                    stride=1, padding=0, groups=1, act="relu",
+                    is_test=False, momentum=0.9, epsilon=1e-5,
+                    param_attr=None, bn_param_attr=None,
+                    bn_bias_attr=None, moving_mean_name=None,
+                    moving_variance_name=None, name=None):
     """conv2d (no bias) + batch_norm + residual + activation as ONE op —
     the whole ResNet block tail including the conv (reference
     counterpart: operators/conv_fusion_op.cu.cc).  Where
@@ -513,8 +513,8 @@ def conv_bn_add_act(input, num_filters, filter_size, residual=None,
         raise NotImplementedError(
             "conv_bn_add_act needs square stride/padding "
             f"(got stride={stride}, padding={padding})")
-    filter_shape = [num_filters, num_channels] + fsize
-    fan_in = num_channels * fsize[0] * fsize[1]
+    filter_shape = [num_filters, num_channels // groups] + fsize
+    fan_in = (num_channels // groups) * fsize[0] * fsize[1]
     w = helper.create_parameter(
         helper.param_attr, shape=filter_shape, dtype=dtype,
         default_initializer=NormalInitializer(0.0, (2.0 / fan_in) ** 0.5),
@@ -534,6 +534,7 @@ def conv_bn_add_act(input, num_filters, filter_size, residual=None,
                  "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
         attrs={
             "strides": _pair(stride), "paddings": _pair(padding),
+            "groups": groups,
             "momentum": momentum, "epsilon": epsilon, "is_test": is_test,
             "act": act,
             # NO @recompute@ tag: the pallas impl's custom_vjp already

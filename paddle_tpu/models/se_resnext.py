@@ -13,6 +13,13 @@ __all__ = ["se_resnext"]
 
 def _conv_bn(input, num_filters, filter_size, stride=1, groups=1, act=None,
              fuse_bn=False):
+    if fuse_bn == "conv":
+        # whole-block one-op tier (models/resnet.py conv_bn_layer); the
+        # grouped cardinality convs take the reference composition
+        # inside the op until a grouped pallas tier exists
+        return layers.conv_bn_add_act(
+            input, num_filters, filter_size, stride=stride,
+            padding=(filter_size - 1) // 2, groups=groups, act=act)
     conv = layers.conv2d(
         input=input,
         num_filters=num_filters,

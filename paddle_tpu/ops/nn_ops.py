@@ -477,7 +477,8 @@ def _conv_bn_add_act_infer(op, block):
     strides = op.attr("strides", [1, 1])
     paddings = op.attr("paddings", [0, 0])
     n, _, h, w = x.shape
-    oc, _, kh, kw = f.shape
+    oc = f.shape[0]
+    kh, kw = f.shape[2], f.shape[3]
     ho = _conv_out_dim(h, kh, paddings[0], strides[0], 1)
     wo = _conv_out_dim(w, kw, paddings[1], strides[1], 1)
     z = in_desc(op, block, "Z")
@@ -533,6 +534,7 @@ def _conv_bn_add_act(ctx, ins, attrs):
             "conv_bn_add_act needs square stride/padding "
             f"(got strides={strides}, paddings={paddings})")
     stride, padding = int(strides[0]), int(paddings[0])
+    groups = int(attrs.get("groups", 1) or 1)
 
     if is_test or attrs.get("use_global_stats", False):
         # moving-stats normalize: compose the standard conv lowering with
@@ -541,7 +543,7 @@ def _conv_bn_add_act(ctx, ins, attrs):
         out = data(_conv2d_lower(
             ctx, {"Input": ins["X"], "Filter": ins["Filter"]},
             {"strides": strides, "paddings": paddings,
-             "dilations": [1, 1]})["Output"][0])
+             "dilations": [1, 1], "groups": groups})["Output"][0])
         inv = jax.lax.rsqrt(var + eps)
         bshape = [1, -1, 1, 1]
         y = ((out.astype(inv.dtype) - mean.reshape(bshape))
@@ -570,6 +572,11 @@ def _conv_bn_add_act(ctx, ins, attrs):
     zn = (jnp.transpose(z.astype(xn.dtype), (0, 2, 3, 1))
           if z is not None else None)
     impl = _flags.flag("conv_epilogue")
+    if impl == "pallas" and groups != 1:
+        # the pallas kernels are single-group (per-tap [Ho*Wo,C]x[C,F]
+        # matmuls); grouped convs (ResNeXt cardinality) take the
+        # reference composition until a grouped kernel tier exists
+        impl = "reference"
     if impl == "pallas":
         fn = make_conv_bn_act(
             has_residual=z is not None, stride=stride, padding=padding,
@@ -585,7 +592,7 @@ def _conv_bn_add_act(ctx, ins, attrs):
         ref = jax.checkpoint(
             lambda a, b, c, d, e: conv_bn_act_reference(
                 a, b, c, d, e, stride=stride, padding=padding,
-                eps=eps, act=act))
+                eps=eps, act=act, groups=groups))
         yn, bmean, bvar = ref(xn, wn, scale, bias, zn)
     y = jnp.transpose(yn, (0, 3, 1, 2))
     y = amp.mxu_output(y, x, f)

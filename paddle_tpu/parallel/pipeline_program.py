@@ -184,6 +184,28 @@ class ProgramPipeline:
                 "boundaries must be in program order")
         self._prefix_ops = [op for op in ops[:prefix_end + 1]
                             if op.type not in _SKIP]
+        for op in self._prefix_ops:
+            # the prefix is lowered in test mode (run_feeds serves): the
+            # same purity rules as stages apply, or serial parity breaks
+            # silently (train-mode dropout disabled, moving-stat writes
+            # dropped)
+            if (op.type in _IMPURE
+                    and op.attrs.get("is_test") is not True):
+                raise ValueError(
+                    f"op '{op.type}' in the pipeline prefix breaks "
+                    "purity (random/stateful ops); build the program "
+                    "with is_test=True (clone(for_test=True))")
+            if op.attrs.get("is_test") is False:
+                raise ValueError(
+                    f"op '{op.type}' in the pipeline prefix runs in "
+                    "training mode; build the program with is_test=True")
+            for n in op.output_arg_names():
+                v = bdesc.vars.get(n)
+                if v is not None and v.persistable:
+                    raise ValueError(
+                        f"op '{op.type}' in the pipeline prefix writes "
+                        f"persistable variable '{n}' — state writes are "
+                        "not serveable")
 
         # shape/dtype uniformity (GPipe streams one activation shape)
         v0 = bdesc.vars[names[0]]
@@ -350,10 +372,13 @@ class ProgramPipeline:
             if v is None:
                 raise ValueError(f"prefix parameter '{n}' not found in "
                                  "scope — run the startup program first")
-            param_vals.append(np.asarray(v))
+            # device-resident ARGUMENTS, not jit constants: a numpy
+            # closure would bake the embedding table into the compiled
+            # HLO (duplicated memory, table-sized recompiles on refresh)
+            param_vals.append(jax.device_put(np.asarray(v)))
 
-        def prefix_fn(feed_dict):
-            env: Dict[str, Any] = dict(zip(param_names, param_vals))
+        def prefix_fn(params, feed_dict):
+            env: Dict[str, Any] = dict(zip(param_names, params))
             env.update({n: feed_dict[n] for n in feed_names})
             ctx = LoweringContext(
                 program, block, env, jax.random.PRNGKey(0), is_test=True)
@@ -361,7 +386,7 @@ class ProgramPipeline:
                 lower_op(ctx, op, set())
             return env[out_name], tuple(env[n] for n in carried_names)
 
-        return prefix_fn, feed_names
+        return prefix_fn, feed_names, tuple(param_vals)
 
     def run_feeds(self, feeds) -> np.ndarray:
         """Full path from RAW FEEDS: `feeds` maps each data var to a
@@ -378,17 +403,20 @@ class ProgramPipeline:
                 "this pipeline has no prefix (boundaries[0] is a feed); "
                 "call run(x_microbatches, carried=...) directly")
         if self._prefix is None:
-            prefix_fn, feed_names = self._make_prefix_fn()
-            # jit the vmapped prefix ONCE: a serving loop must not pay
-            # op-by-op dispatch + param-table re-upload per request
-            self._prefix = (jax.jit(jax.vmap(prefix_fn)), feed_names)
-        prefix_jit, feed_names = self._prefix
+            prefix_fn, feed_names, pvals = self._make_prefix_fn()
+            # jit the vmapped prefix ONCE (params replicated across the
+            # micro-batch vmap): a serving loop must not pay op-by-op
+            # dispatch or param-table re-upload per request
+            self._prefix = (
+                jax.jit(jax.vmap(prefix_fn, in_axes=(None, 0))),
+                feed_names, pvals)
+        prefix_jit, feed_names, pvals = self._prefix
         missing = [n for n in feed_names if n not in feeds]
         if missing:
             raise ValueError(f"run_feeds needs micro-batched arrays for "
                              f"{feed_names}; missing {missing}")
         fvals = {n: jnp.asarray(feeds[n]) for n in feed_names}
-        x0, ctup = prefix_jit(fvals)
+        x0, ctup = prefix_jit(pvals, fvals)
         if self._stage_fn is None:
             self._stage_fn = self._make_stage_fn()
         if self._stacked is None:

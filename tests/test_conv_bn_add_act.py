@@ -135,3 +135,72 @@ def test_rectangular_stride_rejected():
         exe.run(fluid.default_startup_program())
         exe.run(feed={"x": np.zeros((2, 4, 8, 8), "float32")},
                 fetch_list=[h])
+
+
+def test_grouped_conv_tier_matches_chain():
+    """ResNeXt-style cardinality: conv_bn_add_act with groups>1 must
+    match the grouped conv2d -> batch_norm chain (pallas impl falls back
+    to the reference composition for groups>1)."""
+    def run(mode):
+        fluid.reset_default_env()
+        fluid.set_flags({"FLAGS_conv_epilogue":
+                         "pallas" if mode == "op-pallas" else "reference"})
+        fluid.default_main_program().random_seed = 9
+        fluid.default_startup_program().random_seed = 9
+        x = layers.data("x", [8, 8, 8], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        if mode == "chain":
+            conv = layers.conv2d(x, 8, 3, padding=1, groups=4,
+                                 bias_attr=False,
+                                 param_attr=fluid.ParamAttr(name="gw"))
+            h = layers.batch_norm(conv, act="relu",
+                                  param_attr=fluid.ParamAttr(name="gs"),
+                                  bias_attr=fluid.ParamAttr(name="gb"),
+                                  moving_mean_name="gm",
+                                  moving_variance_name="gv")
+        else:
+            h = layers.conv_bn_add_act(
+                x, 8, 3, padding=1, groups=4, act="relu",
+                param_attr=fluid.ParamAttr(name="gw"),
+                bn_param_attr=fluid.ParamAttr(name="gs"),
+                bn_bias_attr=fluid.ParamAttr(name="gb"),
+                moving_mean_name="gm", moving_variance_name="gv")
+        pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+        pred = layers.fc(pool, size=3, act="softmax",
+                         param_attr=fluid.ParamAttr(name="gfc"))
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        r = np.random.RandomState(5)
+        xa = r.randn(8, 8, 8, 8).astype("float32")
+        ya = r.randint(0, 3, size=(8, 1)).astype("int64")
+        ls = [float(np.ravel(np.asarray(exe.run(
+            feed={"x": xa, "y": ya}, fetch_list=[loss])[0]))[0])
+            for _ in range(3)]
+        fluid.set_flags({"FLAGS_conv_epilogue": "reference"})
+        return ls
+
+    base = run("chain")
+    np.testing.assert_allclose(base, run("op-ref"), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(base, run("op-pallas"), rtol=1e-5, atol=1e-6)
+
+
+def test_se_resnext_conv_tier_builds_and_trains():
+    from paddle_tpu import models
+
+    fluid.reset_default_env()
+    fluid.default_main_program().random_seed = 3
+    fluid.default_startup_program().random_seed = 3
+    spec = models.se_resnext(class_num=4, layers_cfg=(1,), cardinality=4,
+                             reduction_ratio=4, img_shape=(3, 32, 32),
+                             fuse_bn="conv")
+    ops = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert "conv_bn_add_act" in ops
+    fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    b = spec.synthetic_batch(4, seed=2)
+    ls = [float(np.ravel(np.asarray(exe.run(feed=b,
+          fetch_list=[spec.loss])[0]))[0]) for _ in range(3)]
+    assert ls[-1] < ls[0], ls
