@@ -287,8 +287,11 @@ def transformer(
             enc = b.sublayer(enc, ff, f"enc_l{i}_ffn")
             enc_boundaries.append(enc)
 
-    # decoder
+    # decoder.  dec_boundaries: ProgramPipeline cut points — the whole
+    # encoder lands in the pipeline PREFIX and `enc` rides as a carried
+    # side input to every decoder stage (cross-attention)
     dec = b.embed(trg_word, cfg.trg_vocab_size, "trg")
+    dec_boundaries = [dec]
     for i in range(cfg.n_layer):
         with layer_scope():
             self_attn = b.mha(dec, dec, trg_bias, f"dec_l{i}_self",
@@ -299,6 +302,7 @@ def transformer(
             dec = b.sublayer(dec, cross, f"dec_l{i}_cross")
             ff = b.ffn(dec, f"dec_l{i}_ffn")
             dec = b.sublayer(dec, ff, f"dec_l{i}_ffn")
+            dec_boundaries.append(dec)
 
     logits = b.linear(dec, cfg.d_model, cfg.trg_vocab_size, "project",
                       shard=[None, cfg.tp_axis], bias=False)
@@ -355,5 +359,6 @@ def transformer(
         metrics={"token_count": token_count, "sum_cost": sum_cost},
         synthetic_batch=synthetic_batch,
         extras={"logits": logits, "config": cfg,
-                "enc_boundaries": enc_boundaries},
+                "enc_boundaries": enc_boundaries,
+                "dec_boundaries": dec_boundaries},
     )

@@ -380,11 +380,16 @@ def test_pipeline_apply_preserves_leaf_dtypes():
 
 
 @pytest.mark.parametrize("flash", [False, True])
-def test_transformer_encoder_pipeline(flash):
-    """The REAL transformer encoder (embedding+bias prefix, isomorphic
-    attention layers, carried bias/length side inputs) pipelines from
-    raw token feeds with serial-Executor parity — the Program-path pp
-    story on the flagship model family."""
+@pytest.mark.parametrize("which,feed_names", [
+    ("enc_boundaries", ["src_word"]),
+    ("dec_boundaries", ["src_word", "trg_word"]),
+])
+def test_transformer_stack_pipeline(flash, which, feed_names):
+    """The REAL transformer stacks pipeline from raw token feeds with
+    serial-Executor parity.  Encoder: embedding+bias prefix, carried
+    bias/length side inputs.  Decoder: the WHOLE encoder runs in the
+    vmapped prefix and `enc` rides as a carried side input into every
+    stage's cross-attention."""
     from paddle_tpu import models
 
     fluid.reset_default_env()
@@ -395,7 +400,7 @@ def test_transformer_encoder_pipeline(flash):
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     test_prog = fluid.default_main_program().clone(for_test=True)
-    bounds = spec.extras["enc_boundaries"]
+    bounds = spec.extras[which]
     M, B = 4, 2
     batches = [spec.synthetic_batch(B, seed=i) for i in range(M)]
     want = np.stack([
@@ -404,6 +409,6 @@ def test_transformer_encoder_pipeline(flash):
     pp = ProgramPipeline(bounds,
                          make_mesh({"pp": 2}, devices=jax.devices()[:2]),
                          main_program=test_prog)
-    feeds = {"src_word": np.stack([b["src_word"] for b in batches])}
+    feeds = {n: np.stack([b[n] for b in batches]) for n in feed_names}
     got = pp.run_feeds(feeds)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
