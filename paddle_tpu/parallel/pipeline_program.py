@@ -386,6 +386,8 @@ class ProgramPipeline:
                 lower_op(ctx, op, set())
             return env[out_name], tuple(env[n] for n in carried_names)
 
+        self._prefix_raw_fn = prefix_fn
+        self._prefix_param_names = list(param_names)
         return prefix_fn, feed_names, tuple(param_vals)
 
     def run_feeds(self, feeds) -> np.ndarray:
@@ -459,6 +461,28 @@ class ProgramPipeline:
             for s in stacked
         )
 
+    def _warn_cache_growth(self, cache_key) -> None:
+        if cache_key not in self._train_cache and len(self._train_cache) >= 4:
+            import logging
+
+            logging.getLogger("paddle_tpu").warning(
+                "ProgramPipeline has compiled %d distinct loss_fn "
+                "variants — if you are passing a fresh lambda each step, "
+                "hoist it out of the loop: every new object retraces and "
+                "recompiles the whole pipelined fwd+bwd",
+                len(self._train_cache) + 1)
+
+    @staticmethod
+    def _sgd_update(params, grads, vel, lr_, mom_, use_momentum):
+        """The ONE copy of the tuple SGD(+momentum) rule shared by both
+        training paths."""
+        if use_momentum:
+            vel = tuple(mom_ * v + g for v, g in zip(vel, grads))
+            upd = vel
+        else:
+            upd = grads
+        return tuple(p - lr_ * u for p, u in zip(params, upd)), vel
+
     def train_step(self, x_microbatches, y_microbatches, loss_fn,
                    lr: float = 0.01, momentum: float = 0.0,
                    carried=None) -> float:
@@ -499,15 +523,7 @@ class ProgramPipeline:
         # REUSE THE SAME loss_fn OBJECT across steps — a lambda built
         # inside the training loop defeats the cache (warned below)
         cache_key = (id(loss_fn), use_momentum)
-        if cache_key not in self._train_cache and len(self._train_cache) >= 4:
-            import logging
-
-            logging.getLogger("paddle_tpu").warning(
-                "ProgramPipeline.train_step has compiled %d distinct "
-                "loss_fn variants — if you are passing a fresh lambda "
-                "each step, hoist it out of the loop: every new object "
-                "retraces and recompiles the whole pipelined fwd+bwd",
-                len(self._train_cache) + 1)
+        self._warn_cache_growth(cache_key)
         entry = self._train_cache.get(cache_key)
         update = entry[0] if entry else None
         if update is None:
@@ -520,12 +536,8 @@ class ProgramPipeline:
                     return jnp.mean(jax.vmap(loss_fn)(out, ys))
 
                 loss, grads = jax.value_and_grad(objective)(params)
-                if use_momentum:
-                    vel = tuple(mom_ * v + g for v, g in zip(vel, grads))
-                    upd = vel
-                else:
-                    upd = grads
-                new_p = tuple(p - lr_ * u for p, u in zip(params, upd))
+                new_p, vel = ProgramPipeline._sgd_update(
+                    params, grads, vel, lr_, mom_, use_momentum)
                 return loss, new_p, vel
 
             update = jax.jit(update_fn)
@@ -549,11 +561,106 @@ class ProgramPipeline:
         scope (device->host, one transfer per param per stage).  Deferred
         out of train_step so a training loop pays it once before
         Executor use / checkpoint io, not every step."""
+        if self._stacked is not None:
+            for s, seg in enumerate(self._segments):
+                for j, name in enumerate(seg.params):
+                    self.scope.set_var(name,
+                                       np.asarray(self._stacked[j][s]))
+        # only TRAINED prefix params publish: the untrained snapshot must
+        # not clobber scope values someone updated after it was taken
+        if (getattr(self, "_prefix_trained", False)
+                and self._prefix is not None):
+            for name, val in zip(self._prefix_param_names,
+                                 self._prefix[2]):
+                self.scope.set_var(name, np.asarray(val))
+
+    def train_step_feeds(self, feeds, y_microbatches, loss_fn,
+                         lr: float = 0.01, momentum: float = 0.0) -> float:
+        """End-to-end pipelined training from RAW FEEDS: gradients flow
+        through the pipeline schedule AND the vmapped prefix, so the
+        embedding/bias tables train together with the stage-stacked
+        params (pretraining a pipelined encoder from tokens).  Same
+        SGD(+momentum) and caching contract as train_step;
+        sync_to_scope publishes both parameter sets."""
+        import jax
+        import jax.numpy as jnp
+
+        self._check_untied()
+        if not self._prefix_ops:
+            raise ValueError("this pipeline has no prefix; use train_step")
+        if self._stage_fn is None:
+            self._stage_fn = self._make_stage_fn()
         if self._stacked is None:
-            return
-        for s, seg in enumerate(self._segments):
-            for j, name in enumerate(seg.params):
-                self.scope.set_var(name, np.asarray(self._stacked[j][s]))
+            self._stacked = self._stacked_params()
+        if self._prefix is None:
+            prefix_fn, feed_names, pvals = self._make_prefix_fn()
+            self._prefix = (
+                jax.jit(jax.vmap(prefix_fn, in_axes=(None, 0))),
+                feed_names, pvals)
+        _, feed_names, pvals = self._prefix
+        missing = [n for n in feed_names if n not in feeds]
+        if missing:
+            raise ValueError(f"train_step_feeds needs micro-batched "
+                             f"arrays for {feed_names}; missing {missing}")
+        fvals = {n: jnp.asarray(feeds[n]) for n in feed_names}
+        y = jnp.asarray(y_microbatches)
+
+        # a param read by BOTH the prefix and a stage would train as two
+        # independent copies (split gradients, divergence): reject
+        stage_params = {n for seg in self._segments for n in seg.params}
+        tied = sorted(stage_params & set(self._prefix_param_names))
+        if tied:
+            raise ValueError(
+                f"parameters {tied} are read by both the prefix and a "
+                "stage: tied prefix/stage weights cannot be trained as "
+                "two copies (forward run_feeds supports them)")
+
+        use_momentum = bool(momentum)
+        cache_key = ("feeds", id(loss_fn), use_momentum)
+        self._warn_cache_growth(cache_key)
+        entry = self._train_cache.get(cache_key)
+        update = entry[0] if entry else None
+        if update is None:
+            stage_fn, mesh, pp_axis = (self._stage_fn, self.mesh,
+                                       self.pp_axis)
+            prefix_raw = self._prefix_raw_fn
+
+            def update_fn(stacked, pparams, vel, fv, ys, lr_, mom_):
+                def objective(both):
+                    st, pp_ = both
+                    x0, ctup = jax.vmap(
+                        prefix_raw, in_axes=(None, 0))(pp_, fv)
+                    out = pipeline_apply(stage_fn, st, x0, mesh,
+                                         pp_axis=pp_axis, aux=ctup)
+                    return jnp.mean(jax.vmap(loss_fn)(out, ys))
+
+                loss, grads = jax.value_and_grad(objective)(
+                    (stacked, pparams))
+                gs, gp = grads
+                vs, vp = vel if use_momentum else ((), ())
+                new_s, vs = ProgramPipeline._sgd_update(
+                    stacked, gs, vs, lr_, mom_, use_momentum)
+                new_p, vp = ProgramPipeline._sgd_update(
+                    pparams, gp, vp, lr_, mom_, use_momentum)
+                return loss, new_s, new_p, (vs, vp)
+
+            update = jax.jit(update_fn)
+            self._train_cache[cache_key] = (update, loss_fn)
+
+        if use_momentum and not hasattr(self, "_vel_feeds"):
+            self._vel_feeds = (
+                tuple(jnp.zeros_like(p) for p in self._stacked),
+                tuple(jnp.zeros_like(p) for p in pvals),
+            )
+        vel = self._vel_feeds if use_momentum else ((), ())
+        loss, self._stacked, new_pvals, vel = update(
+            self._stacked, pvals, vel, fvals, y, jnp.float32(lr),
+            jnp.float32(momentum))
+        self._prefix = (self._prefix[0], feed_names, tuple(new_pvals))
+        self._prefix_trained = True
+        if use_momentum:
+            self._vel_feeds = vel
+        return float(loss)
 
     def refresh_params(self) -> None:
         """Drop the cached stacked parameters AND the momentum velocity;
@@ -566,6 +673,8 @@ class ProgramPipeline:
         self._prefix = None
         if hasattr(self, "_vel"):
             del self._vel
+        if hasattr(self, "_vel_feeds"):
+            del self._vel_feeds
 
     def _serve(self):
         """ONE jitted serving closure: pipeline_apply builds a fresh
