@@ -199,3 +199,75 @@ class TestReduceMeanOp(OpTest):
     def test_grad(self):
         self.setup()
         self.check_grad(["X"], "Out")
+
+
+class TestConvBnAddActOp(OpTest):
+    """conv_bn_add_act: numpy reference for outputs + finite-difference
+    gradient check through the fused conv+BN+residual+relu backward
+    (the reference's OpTest pattern for conv_fusion-class ops)."""
+
+    op_type = "conv_bn_add_act"
+
+    def setup(self, act="relu"):
+        rng = np.random.RandomState(7)
+        N, C, H, F, K = 2, 4, 6, 5, 3
+        x = rng.uniform(-1, 1, (N, C, H, H)).astype("float32")
+        w = (rng.uniform(-1, 1, (F, C, K, K)) * 0.4).astype("float32")
+        scale = rng.uniform(0.6, 1.4, (F,)).astype("float32")
+        bias = (rng.uniform(-0.2, 0.2, (F,))).astype("float32")
+        # nonzero moving stats: an all-zero mean would let a wrong
+        # momentum blend of the old mean pass undetected
+        mean = rng.uniform(-0.5, 0.5, (F,)).astype("float32")
+        var = rng.uniform(0.5, 1.5, (F,)).astype("float32")
+        z = rng.uniform(-1, 1, (N, F, H, H)).astype("float32")
+        eps, momentum = 1e-5, 0.9
+
+        # numpy reference: NCHW conv (stride 1, pad 1) + batch stats BN
+        # + residual + relu
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((N, F, H, H), "float32")
+        for kh in range(K):
+            for kw in range(K):
+                patch = xp[:, :, kh:kh + H, kw:kw + H]
+                out += np.einsum("nchw,fc->nfhw", patch, w[:, :, kh, kw])
+        bm = out.mean(axis=(0, 2, 3))
+        bv = out.var(axis=(0, 2, 3))
+        inv = 1.0 / np.sqrt(bv + eps)
+        y = ((out - bm[None, :, None, None]) * inv[None, :, None, None]
+             * scale[None, :, None, None] + bias[None, :, None, None])
+        y = y + z
+        if act == "relu":
+            y = np.maximum(y, 0.0)
+
+        self.inputs = {"X": x, "Filter": w, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var, "Z": z}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "epsilon": eps, "momentum": momentum, "act": act}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": momentum * mean + (1 - momentum) * bm,
+            "VarianceOut": momentum * var + (1 - momentum) * bv,
+            "SavedMean": bm,
+            "SavedVariance": inv,
+        }
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("impl", ["reference", "pallas"])
+    def test_grad(self, impl):
+        # the smooth path (no relu kink): finite differences across the
+        # activation's corner dominate the error otherwise.  impl=pallas
+        # numerically validates the hand-written custom_vjp backward of
+        # kernels/conv_epilogue.py (interpret mode on CPU), not just the
+        # autodiff'd reference composition
+        import paddle_tpu as fluid
+
+        fluid.set_flags({"FLAGS_conv_epilogue": impl})
+        try:
+            self.setup(act="")
+            self.check_grad(["X", "Filter", "Scale", "Bias", "Z"], "Y",
+                            max_relative_error=0.02)
+        finally:
+            fluid.set_flags({"FLAGS_conv_epilogue": "reference"})
