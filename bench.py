@@ -556,6 +556,10 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
         # probe name omitted it, misattributing which config produced
         # the number); non-fused combos match the primary's unfused shape
         combos = [("keep", "NHWC", {"BENCH_FUSE_BN": "1"}),
+                  # the one-op conv_bn_add_act tier (reference impl —
+                  # plain XLA, relay-safe; the pallas impl stays behind
+                  # the staged probe + conv_ep_model step)
+                  ("keep", "NHWC", {"BENCH_FUSE_BN": "conv"}),
                   ("keep", "NCHW", {"BENCH_FUSE_BN": "0"}),
                   ("1", "NHWC", {"BENCH_FUSE_BN": "0"}),
                   ("1", "NCHW", {"BENCH_FUSE_BN": "0"})]
