@@ -175,7 +175,7 @@ def test_downpour_cross_process_convergence(tmp_path):
         eval0 = str(tmp_path / "eval0.json")
         r = subprocess.run(
             [sys.executable, eval_py, endpoint, eval0], env=env,
-            capture_output=True, text=True, timeout=240)
+            capture_output=True, text=True, timeout=480)
         assert r.returncode == 0, r.stdout + r.stderr
         first = json.loads(open(eval0).read())["loss"]
         assert abs(first - np.log(2.0)) < 0.05
@@ -190,14 +190,14 @@ def test_downpour_cross_process_convergence(tmp_path):
             for i in range(2)
         ]
         for t in trainers:
-            out, _ = t.communicate(timeout=300)
+            out, _ = t.communicate(timeout=600)
             assert t.returncode == 0, out
             assert "TRAINED" in out
 
         evalf = str(tmp_path / "evalf.json")
         r = subprocess.run(
             [sys.executable, eval_py, endpoint, evalf], env=env,
-            capture_output=True, text=True, timeout=240)
+            capture_output=True, text=True, timeout=480)
         assert r.returncode == 0, r.stdout + r.stderr
         result = json.loads(open(evalf).read())
         final = result["loss"]
