@@ -10,6 +10,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 
 import numpy as np
 import pytest
@@ -160,15 +161,30 @@ def test_downpour_cross_process_convergence(tmp_path):
     server = subprocess.Popen(
         [sys.executable, server_py, str(port)], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    trainers = []
     try:
-        # wait for SERVING line
+        # wait for SERVING with a hard deadline: readline() alone would
+        # block forever on a wedged-but-alive server (review r5) —
+        # a reader thread + join(timeout) bounds it
+        import queue as _queue
+        import threading as _threading
+
+        lines: "_queue.Queue[str]" = _queue.Queue()
+        _threading.Thread(
+            target=lambda: [lines.put(ln) for ln in server.stdout],
+            daemon=True).start()
         line = ""
-        for _ in range(600):
-            line = server.stdout.readline()
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            try:
+                line = lines.get(timeout=5)
+            except _queue.Empty:
+                assert server.poll() is None, "server died silently"
+                continue
             if "SERVING" in line:
                 break
             assert server.poll() is None, "server died: " + line
-        assert "SERVING" in line
+        assert "SERVING" in line, "server never reported SERVING in 240s"
         endpoint = line.split()[1]
 
         # cold-start loss ~ log(2)
@@ -181,7 +197,7 @@ def test_downpour_cross_process_convergence(tmp_path):
         assert abs(first - np.log(2.0)) < 0.05
 
         # two REAL trainer processes, different file shards
-        trainers = [
+        trainers += [
             subprocess.Popen(
                 [sys.executable, trainer_py, endpoint, data[i],
                  str(tmp_path / f"done{i}")],
@@ -206,5 +222,11 @@ def test_downpour_cross_process_convergence(tmp_path):
         assert final < first - 0.05, f"loss did not drop: {first} -> {final}"
         assert 0 < result["sparse_rows"] <= VOCAB
     finally:
+        # kill EVERYTHING: a hung/failed trainer must not outlive the
+        # test spinning against a dead PS endpoint (review r5)
+        for t in trainers:
+            if t.poll() is None:
+                t.kill()
+                t.wait()
         server.kill()
         server.wait()
