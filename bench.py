@@ -655,6 +655,8 @@ def _arm_deadline(state: dict) -> None:
     import threading
 
     deadline = float(os.environ.get("BENCH_DEADLINE_S", "3600"))
+    if deadline <= 0:
+        return  # explicit opt-out (in-process tests drive main() directly)
 
     def fire():
         if not _claim_print(state):
@@ -664,6 +666,8 @@ def _arm_deadline(state: dict) -> None:
             if len(state["results"]) > 1:
                 primary["extra_metrics"] = state["results"][1:]
             primary["deadline_exceeded"] = True
+            if state.get("model_errors"):
+                primary["model_errors"] = state["model_errors"]
             print(json.dumps(primary), flush=True)
             os._exit(0)
         print(json.dumps({
@@ -788,20 +792,45 @@ def main() -> None:
     tune = os.environ.get("BENCH_TUNE", "0" if pinned else "1") == "1"
     import threading
 
-    state = {"results": [], "printed": False, "lock": threading.Lock()}
+    state = {"results": [], "model_errors": [], "printed": False,
+             "lock": threading.Lock()}
     _arm_deadline(state)
     _relay_preprobe(state)
+    model_errors = state["model_errors"]
     try:
         for m in names:
-            if tune:
-                _tune_and_run(m, steps, peak_flops, state)  # self-records
-            else:
-                state["results"].append(
-                    run_model(m, steps, peak_flops, amp=amp, layout=layout))
+            n_before = len(state["results"])
+            try:
+                if tune:
+                    _tune_and_run(m, steps, peak_flops, state)  # self-records
+                else:
+                    state["results"].append(
+                        run_model(m, steps, peak_flops, amp=amp,
+                                  layout=layout))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — one model's failure
+                # (e.g. a kernel lowering error) must not abort the other
+                # models' measurements; the chip window is too precious
+                rec = {
+                    "model": m, "error": type(e).__name__,
+                    "detail": str(e)[:800],
+                }
+                if len(state["results"]) > n_before:
+                    # tune mode banks the timed number BEFORE later
+                    # probes: the measurement stands, the error is
+                    # post-measurement bookkeeping, not a failed model
+                    rec["post_measurement"] = True
+                model_errors.append(rec)
         results = state["results"]
+        if not results:
+            raise RuntimeError(
+                f"all models failed: {json.dumps(model_errors)[:1500]}")
         primary = dict(results[0])
         if len(results) > 1:
             primary["extra_metrics"] = results[1:]
+        if model_errors:
+            primary["model_errors"] = model_errors
         if _claim_print(state):
             print(json.dumps(primary))
     except BaseException as e:  # noqa: BLE001 — the contract is ONE JSON line
@@ -818,6 +847,8 @@ def main() -> None:
         if state["results"]:
             # some models DID finish: keep their numbers in the artifact
             err["partial_results"] = state["results"]
+        if state.get("model_errors"):
+            err["model_errors"] = state["model_errors"]
         if os.environ.get("BENCH_SMOKE") != "1":
             smoke = _cpu_smoke()
             if smoke is not None:

@@ -43,6 +43,12 @@ import numpy as np
 __all__ = ["flash_attention"]
 
 NEG_INF = -1e30
+# The per-row logsumexp/D residuals are carried with a broadcast 128-lane
+# trailing dim: TPU pallas rejects blocks whose last two dims are neither
+# (8k, 128k)-tiled nor equal to the array dims, so a [B*H, Sq]-shaped
+# residual with block (1, block_q) cannot lower (chip-only failure; the
+# interpret-mode tests never see the constraint).
+LSE_LANES = 128
 
 
 def _reference_attention(q, k, v, causal, scale, bias=None, k_lengths=None):
@@ -136,7 +142,11 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
             -NEG_INF,
         )
-        lse_ref[0] = lse[:, 0]
+        # lse rides lane-broadcast to [block_q, LSE_LANES]: TPU refuses
+        # 2-D output blocks narrower than the (8, 128) tile, so the
+        # per-row scalar is replicated across one 128-lane register
+        # (same layout as jax's shipped flash kernels)
+        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LSE_LANES))
 
 
 def _flash_bwd_dq_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -160,8 +170,8 @@ def _flash_bwd_dq_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]   # [block_q, 1]
-    dvec = dvec_ref[0][:, None]  # [block_q, 1]
+    lse = lse_ref[0][:, :1]   # [block_q, 1] (lane-broadcast residual)
+    dvec = dvec_ref[0][:, :1]  # [block_q, 1]
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     mask = _block_mask(klen_ref, bi, qi, ki, s.shape, block_q, block_k,
@@ -200,8 +210,8 @@ def _flash_bwd_dkv_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]
-    dvec = dvec_ref[0][:, None]
+    lse = lse_ref[0][:, :1]
+    dvec = dvec_ref[0][:, :1]
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     mask = _block_mask(klen_ref, bi, qi, ki, s.shape, block_q, block_k,
@@ -255,11 +265,11 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(dtype)),
-            jax.ShapeDtypeStruct((bh, sqp), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sqp, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -272,7 +282,9 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
 
 def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
                   interpret=False):
-    """Returns (out [B,H,Sq,D], lse [B*H, padded Sq] fp32)."""
+    """Returns (out [B,H,Sq,D], lse [B*H, padded Sq] fp32 per-row
+    logsumexp; the kernel emits it lane-broadcast for TPU tiling and
+    lane 0 is sliced out here)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, Sq)
@@ -292,7 +304,10 @@ def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
     out = out.reshape(B, H, out.shape[1], D)
     if out.shape[2] != Sq:
         out = out[:, :, :Sq]
-    return out, lse
+    # the kernel emits lse lane-broadcast ([B*H, Sqp, LSE_LANES], TPU
+    # tiling); keep only lane 0 as the residual — holding the broadcast
+    # through the backward would cost 128x the activation memory
+    return out, lse[..., 0]
 
 
 @functools.lru_cache(maxsize=128)
@@ -315,8 +330,8 @@ def _bwd_calls(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(q_dtype)),
@@ -333,8 +348,8 @@ def _bwd_calls(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -373,6 +388,11 @@ def _pallas_flash_bwd(q, k, v, klen, out, lse, g, causal, scale,
     klen_bh = jnp.repeat(klen, H)
     # D_i = rowsum(dO * O): one fused elementwise+reduce pass, fp32
     dvec = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    # re-broadcast the per-row residuals to the kernels' lane-tiled
+    # block layout (see LSE_LANES) just before the calls — XLA fuses the
+    # broadcast into the kernel operand materialization
+    dvec = jnp.broadcast_to(dvec[..., None], (*dvec.shape, LSE_LANES))
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LSE_LANES))
 
     dq_call, dkv_call = _bwd_calls(
         B * H, Sqp, Skp, D, bq, bk, causal, scale, Sk, Sk - Sq,

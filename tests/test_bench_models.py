@@ -104,3 +104,57 @@ def test_alexnet_googlenet_forward():
         assert pred.shape == (2, 10), spec.name
         np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-4,
                                    err_msg=spec.name)
+
+
+def test_bench_survives_single_model_failure(monkeypatch, capsys):
+    """One model crashing (e.g. a kernel lowering error, as the r5 chip
+    window's transformer pallas failure did) must not abort the other
+    models' measurements: bench records the error per model and still
+    prints a primary result line with rc=0 semantics."""
+    import json as _json
+
+    import bench
+
+    def fake_run_model(model, steps, peak_flops, amp="1", layout="NCHW",
+                       profile_logdir=None):
+        if model == "transformer":
+            raise ValueError("pallas lowering rejected block shape")
+        return {"metric": f"{model}_train_examples_per_sec_per_chip",
+                "value": 100.0, "unit": "examples/sec",
+                "vs_baseline": None}
+
+    monkeypatch.setattr(bench, "run_model", fake_run_model)
+    monkeypatch.setenv("BENCH_MODELS", "lenet,transformer,deepfm")
+    monkeypatch.setenv("BENCH_TUNE", "0")
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "0")
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = _json.loads(line)
+    assert rec["metric"] == "lenet_train_examples_per_sec_per_chip"
+    assert len(rec["extra_metrics"]) == 1
+    assert rec["model_errors"][0]["model"] == "transformer"
+    assert "block shape" in rec["model_errors"][0]["detail"]
+
+
+def test_bench_all_models_failing_exits_2(monkeypatch, capsys):
+    import bench
+
+    def fake_run_model(model, steps, peak_flops, amp="1", layout="NCHW",
+                       profile_logdir=None):
+        raise ValueError("boom")
+
+    monkeypatch.setattr(bench, "run_model", fake_run_model)
+    monkeypatch.setenv("BENCH_MODELS", "lenet,deepfm")
+    monkeypatch.setenv("BENCH_TUNE", "0")
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "0")
+    try:
+        bench.main()
+        raised = False
+    except SystemExit as e:
+        raised = e.code == 2
+    assert raised
+    rec = __import__("json").loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "error"
