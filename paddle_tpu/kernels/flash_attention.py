@@ -138,15 +138,16 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
         # logsumexp per row; fully-masked rows get +inf-ish so backward's
         # exp(S - L) underflows to zero instead of NaN
-        lse = jnp.where(
-            l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
-            -NEG_INF,
-        )
-        # lse rides lane-broadcast to [block_q, LSE_LANES]: TPU refuses
-        # 2-D output blocks narrower than the (8, 128) tile, so the
-        # per-row scalar is replicated across one 128-lane register
-        # (same layout as jax's shipped flash kernels)
-        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LSE_LANES))
+        if lse_ref is not None:  # static: absent on the fwd-only variant
+            lse = jnp.where(
+                l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
+                -NEG_INF,
+            )
+            # lse rides lane-broadcast to [block_q, LSE_LANES]: TPU
+            # refuses 2-D output blocks narrower than the (8, 128) tile,
+            # so the per-row scalar is replicated across one 128-lane
+            # register (same layout as jax's shipped flash kernels)
+            lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LSE_LANES))
 
 
 def _flash_bwd_dq_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -239,18 +240,37 @@ def _pad_seq(x, to):
     return x
 
 
+def _flash_kernel_fwd_only(klen_ref, q_ref, k_ref, v_ref, o_ref,
+                           m_scr, l_scr, acc_scr, **kw):
+    """Inference / recompute-backward variant: no lse output ref — the
+    lane-broadcast lse write is pure wasted HBM traffic when nothing
+    consumes it (the workloads sit at the HBM roofline)."""
+    _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, None,
+                  m_scr, l_scr, acc_scr, **kw)
+
+
 @functools.lru_cache(maxsize=128)
 def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
-              causal_offset, dtype, interpret):
+              causal_offset, dtype, interpret, emit_lse=True):
     """Memoized pallas_call: every attention site with the same static
     config reuses ONE traced callable, so XLA sees identical kernel
-    payloads (compile-cache friendly) instead of per-site clones."""
+    payloads (compile-cache friendly) instead of per-site clones.
+    emit_lse=False drops the lse output entirely (see
+    _flash_kernel_fwd_only)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    kernel = _flash_kernel if emit_lse else _flash_kernel_fwd_only
+    out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(dtype))]
+    if emit_lse:
+        out_specs.append(
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, sqp, LSE_LANES), jnp.float32))
     return pl.pallas_call(
         functools.partial(
-            _flash_kernel, causal=causal, scale=scale, block_q=bq,
+            kernel, causal=causal, scale=scale, block_q=bq,
             block_k=bk, seq_k=seq_k, causal_offset=causal_offset,
         ),
         grid=(bh, sqp // bq, skp // bk),
@@ -263,14 +283,8 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(dtype)),
-            jax.ShapeDtypeStruct((bh, sqp, LSE_LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -281,10 +295,13 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
 
 
 def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
-                  interpret=False):
+                  interpret=False, need_lse=True):
     """Returns (out [B,H,Sq,D], lse [B*H, padded Sq] fp32 per-row
     logsumexp; the kernel emits it lane-broadcast for TPU tiling and
-    lane 0 is sliced out here)."""
+    lane 0 is sliced out here).  need_lse=False (inference / the
+    recompute-jax backward) skips the lse output entirely — its HBM
+    write is pure waste when nothing consumes it — and returns
+    (out, None)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, Sq)
@@ -299,15 +316,18 @@ def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
     klen_bh = jnp.repeat(klen, H)  # [B*H] valid key counts
 
     call = _fwd_call(B * H, qf.shape[1], kf.shape[1], D, bq, bk, causal,
-                     scale, Sk, Sk - Sq, str(q.dtype), interpret)
-    out, lse = call(klen_bh, qf, kf, vf)
-    out = out.reshape(B, H, out.shape[1], D)
+                     scale, Sk, Sk - Sq, str(q.dtype), interpret,
+                     emit_lse=need_lse)
+    res = call(klen_bh, qf, kf, vf)  # list: [out] or [out, lse]
+    out = res[0].reshape(B, H, res[0].shape[1], D)
     if out.shape[2] != Sq:
         out = out[:, :, :Sq]
+    if not need_lse:
+        return out, None
     # the kernel emits lse lane-broadcast ([B*H, Sqp, LSE_LANES], TPU
     # tiling); keep only lane 0 as the residual — holding the broadcast
     # through the backward would cost 128x the activation memory
-    return out, lse[..., 0]
+    return out, res[1][..., 0]
 
 
 @functools.lru_cache(maxsize=128)
@@ -436,9 +456,11 @@ def _pallas_bwd_enabled(force: str) -> bool:
 def _flash(q, k, v, klen, causal, scale, force):
     # klen rides as float32 so custom_vjp treats it uniformly (zero grad)
     if _use_pallas(force):
-        return _pallas_flash(q, k, v, klen, causal, scale)[0]
+        return _pallas_flash(q, k, v, klen, causal, scale,
+                             need_lse=False)[0]
     if force == "interpret":
-        return _pallas_flash(q, k, v, klen, causal, scale, interpret=True)[0]
+        return _pallas_flash(q, k, v, klen, causal, scale, interpret=True,
+                             need_lse=False)[0]
     return _reference_attention(
         q, k, v, causal, scale, k_lengths=klen.astype(jnp.int32)
     )
@@ -447,11 +469,13 @@ def _flash(q, k, v, klen, causal, scale, force):
 def _flash_fwd(q, k, v, klen, causal, scale, force):
     if _use_pallas(force) or force == "interpret":
         interp = force == "interpret"
+        need = _pallas_bwd_enabled(force)
         out, lse = _pallas_flash(q, k, v, klen, causal, scale,
-                                 interpret=interp)
-        if _pallas_bwd_enabled(force):
+                                 interpret=interp, need_lse=need)
+        if need:
             return out, (q, k, v, klen, out, lse)
-        # recompute-jax backward: don't hold O/L as residuals
+        # recompute-jax backward: don't hold O/L as residuals (and the
+        # forward call above skipped the lse HBM write entirely)
         return out, (q, k, v, klen, None, None)
     out = _reference_attention(
         q, k, v, causal, scale, k_lengths=klen.astype(jnp.int32)

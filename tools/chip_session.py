@@ -130,7 +130,11 @@ def main() -> None:
         run_step(
             "safety",
             [py, "bench.py"],
-            {"BENCH_SAFE": "1", "BENCH_MODELS": "resnet50,transformer,deepfm",
+            # transformer first: window 1 (2026-08-02) banked resnet50 at
+            # 2246 img/s but died on the transformer's (since fixed)
+            # pallas lowering error — short recovery windows should spend
+            # their first minutes on the still-unmeasured models
+            {"BENCH_SAFE": "1", "BENCH_MODELS": "transformer,deepfm,resnet50",
              "BENCH_COST": "1", "BENCH_DEADLINE_S": "3300"},
             3600, args.out)
     if wanted("fuse_bn_ab"):
@@ -279,6 +283,23 @@ def bank_cache(out_dir: str) -> None:
     print(json.dumps({"cache_banked": rec}), flush=True)
 
 
+def _pin_primary(line: dict) -> dict:
+    """Every round's artifacts compare the ResNet-50 headline; pin it as
+    the builder artifact's primary even when BENCH_MODELS runs the
+    still-unmeasured models first (the bench embeds the other models'
+    records in the first model's extra_metrics)."""
+    subs = line.get("extra_metrics")
+    subs = list(subs) if isinstance(subs, list) else []
+    head = {k: v for k, v in line.items() if k != "extra_metrics"}
+    records = [head] + [dict(s, _step=line.get("_step", "safety"))
+                        for s in subs]
+    pick = next((r for r in records
+                 if str(r.get("metric", "")).startswith("resnet50")),
+                records[0])
+    rest = [r for r in records if r is not pick]
+    return dict(pick, extra_metrics=rest) if rest else pick
+
+
 def finalize(out_dir: str) -> None:
     """Collect every banked bench-step result into one BENCH-format
     builder artifact at the repo root (BENCH_builder_r05.json): the
@@ -306,7 +327,7 @@ def finalize(out_dir: str) -> None:
                 continue
             line = dict(line, _step=name)
             if name == "safety" and primary is None:
-                primary = line
+                primary = _pin_primary(line)
             else:
                 extra.append(line)
     if primary is None and extra:
