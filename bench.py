@@ -370,6 +370,17 @@ def run_model(model: str, steps: int, peak_flops: float,
     def step_feed(i):
         return None if use_pyreader else batches[i % len(batches)]
 
+    if os.environ.get("BENCH_LOWER_ONLY", "0") == "1":
+        # relay-independent gate: TPU-lower the exact step this config
+        # would time (chip trace scope forced) on the CPU host — catches
+        # chip-only Mosaic/pallas failures without spending a chip window
+        nbytes = exe.tpu_lowering_check(
+            program=run_program, feed=batches_np[0],
+            fetch_list=[fetch_var])
+        return {"metric": f"{model}_tpu_lowering", "value": 1,
+                "unit": "ok", "vs_baseline": None,
+                "module_bytes": nbytes}
+
     unroll = int(os.environ.get("BENCH_UNROLL", "0"))
     use_unroll = (
         unroll >= 2 and run_program is None and not use_pyreader

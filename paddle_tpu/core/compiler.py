@@ -486,6 +486,20 @@ class CompiledBlock:
         ca = compiled.cost_analysis()
         return ca if isinstance(ca, dict) else (ca[0] if ca else {})
 
+    def tpu_lowering_check(self, feed_vals, state_vals, key) -> int:
+        """Lower this block's step function for the TPU platform with NO
+        TPU attached (jax.export runs StableHLO + the Mosaic kernel
+        lowerings client-side) and return the module byte count.
+
+        The relay-independent lowering gate: the round-5 chip window
+        showed that pallas kernels can pass every interpret-mode test and
+        still fail the real TPU's Mosaic constraints (lse block tiling,
+        strided slices) — failures that burn scarce chip minutes but are
+        fully reproducible on a CPU host via cross-platform export."""
+        exp = jax.export.export(self.fn, platforms=["tpu"])(
+            tuple(feed_vals), tuple(state_vals), key)
+        return len(exp.mlir_module_serialized)
+
 
 def compile_block(*args, **kwargs) -> CompiledBlock:
     return CompiledBlock(*args, **kwargs)

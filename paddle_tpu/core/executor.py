@@ -470,6 +470,37 @@ class Executor:
             rng = jax.device_put(rng, device)
             return compiled.cost_analysis(feed_vals, state_vals, rng)
 
+    def tpu_lowering_check(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+    ) -> int:
+        """TPU-lower the step this executor would run for (program, feed,
+        fetches) on the CURRENT host — no TPU needed (see
+        CompiledBlock.tpu_lowering_check) — and return the exported
+        module's byte count.  The trace scope is forced to TPU so the
+        checked program is the CHIP program (keep-bf16 / NHWC auto
+        resolution included), whatever the host backend is."""
+        with flags.tpu_trace_scope(True):
+            program = program or default_main_program()
+            feed = feed or {}
+            fetch_list = list(fetch_list or [])
+            scope = scope or global_scope()
+            feed_names = sorted(feed)
+            fetch_names = [
+                v.name if isinstance(v, Variable) else str(v)
+                for v in fetch_list
+            ]
+            _, compiled, plan = self._cache_entry(
+                program, feed_names, fetch_names)
+            block0 = program.desc.block(0)
+            feed_vals = plan.feed_values(feed, block0)
+            state_vals = plan.state_values(scope, block0)
+            rng = plan.rng_value(scope, program)
+            return compiled.tpu_lowering_check(feed_vals, state_vals, rng)
+
     def run_steps(
         self,
         program: Optional[Program] = None,

@@ -48,6 +48,32 @@ import jax.numpy as jnp
 __all__ = ["conv_bn_act", "conv_bn_act_reference", "make_conv_bn_act"]
 
 
+def _phase_decompose(xp, stride, K, Ho, Wo):
+    """[N, Hp, Wp, C] padded input -> [N, s*s, Hd, Wd, C] stride-phase
+    planes: plane (ph, pw) holds xp[:, ph::s, pw::s, :], zero-padded to
+    the uniform (Hd, Wd).  Done OUTSIDE the pallas kernel (XLA lowers
+    strided slices fine; Mosaic does not), so every in-kernel tap read
+    is a contiguous window.  For s=1 this is just an expand_dims."""
+    s = stride
+    N, Hp, Wp, C = xp.shape
+    if s == 1:
+        return xp[:, None]
+    Hd = max(-(-(Hp - ph) // s) for ph in range(s))
+    Wd = max(-(-(Wp - pw) // s) for pw in range(s))
+    # every tap (kh, kw) reads [kh//s : kh//s + Ho] of its phase; make
+    # sure the uniform plane covers the deepest such window
+    Hd = max(Hd, (K - 1) // s + Ho)
+    Wd = max(Wd, (K - 1) // s + Wo)
+    planes = []
+    for ph in range(s):
+        for pw in range(s):
+            p = xp[:, ph::s, pw::s, :]
+            planes.append(jnp.pad(p, (
+                (0, 0), (0, Hd - p.shape[1]), (0, Wd - p.shape[2]),
+                (0, 0))))
+    return jnp.stack(planes, axis=1)
+
+
 def conv_bn_act_reference(x, w, gamma, beta, z=None, *, stride=1,
                           padding="SAME", eps=1e-5, act="relu", groups=1):
     """Pure-jax reference: XLA conv + batch-norm + residual + act.
@@ -79,22 +105,27 @@ def _conv_stats_kernel(x_ref, w_ref, out_ref, sum_ref, sumsq_ref,
                        *, K, stride, Ho, Wo):
     """Grid (N,): one padded image per step.  Accumulates per-channel
     sum/sumsq of the conv output in the [1, F] output refs across the
-    sequential batch grid (every step maps to the same stats block)."""
+    sequential batch grid (every step maps to the same stats block).
+
+    x_ref holds the input pre-decomposed into stride-phase planes
+    ([1, s*s, Hd, Wd, C], see _phase_decompose): Mosaic cannot lower
+    strided vector slices (chip-only 'extract_strided_slice' failure
+    caught by the TPU lowering gate), so tap (kh, kw) reads the
+    CONTIGUOUS window [kh//s : kh//s + Ho] of phase (kh%s, kw%s)."""
     import jax.experimental.pallas as pl
 
     n = pl.program_id(0)
-    x = x_ref[0]                     # [Hp, Wp, C]
+    s = stride
+    C = x_ref.shape[-1]
     acc = None
     for kh in range(K):
         for kw in range(K):
             xs = jax.lax.slice(
-                x,
-                (kh, kw, 0),
-                (kh + (Ho - 1) * stride + 1, kw + (Wo - 1) * stride + 1,
-                 x.shape[2]),
-                (stride, stride, 1),
-            )                         # [Ho, Wo, C]
-            xm = xs.reshape(Ho * Wo, x.shape[2])
+                x_ref[0, (kh % s) * s + (kw % s)],
+                (kh // s, kw // s, 0),
+                (kh // s + Ho, kw // s + Wo, C),
+            )                         # [Ho, Wo, C], stride-1 slice
+            xm = xs.reshape(Ho * Wo, C)
             tap = jnp.dot(xm, w_ref[kh, kw],
                           preferred_element_type=jnp.float32)
             acc = tap if acc is None else acc + tap
@@ -162,14 +193,16 @@ def conv_bn_act(x, w, gamma, beta, z=None, *, stride=1, padding="SAME",
         raise ValueError(
             f"padding must be SAME, VALID or an int, got {padding!r}")
     xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
-    Hp, Wp = xp.shape[1], xp.shape[2]
+    xd = _phase_decompose(xp, stride, K, Ho, Wo)
+    Hd, Wd = xd.shape[2], xd.shape[3]
 
     out, ssum, ssq = pl.pallas_call(
         functools.partial(_conv_stats_kernel, K=K, stride=stride,
                           Ho=Ho, Wo=Wo),
         grid=(N,),
         in_specs=[
-            pl.BlockSpec((1, Hp, Wp, C), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((1, stride * stride, Hd, Wd, C),
+                         lambda n: (n, 0, 0, 0, 0)),
             pl.BlockSpec((K, K, C, F), lambda n: (0, 0, 0, 0)),
         ],
         out_specs=[
@@ -183,7 +216,7 @@ def conv_bn_act(x, w, gamma, beta, z=None, *, stride=1, padding="SAME",
             jax.ShapeDtypeStruct((1, F), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, w)
+    )(xd, w)
 
     count = N * Ho * Wo
     mean = ssum[0] / count
