@@ -30,6 +30,14 @@ via Executor.run_steps' lax.scan driver, amortizing per-call host/relay
 latency — the AsyncExecutor whole-pass-per-call analogue; training
 models with dense feeds only).
 
+BENCH_LOWER_ONLY=1: per-model relay-independent TPU lowering gate (no
+backend touched, no timed run).  BENCH_COST_ONLY=1: per-model bytes/step
+table from the TPU compiler's own cost model via a chip-less AOT
+topology compile (BENCH_COST_PLATFORM=native for the host executable
+instead).  BENCH_FUSE_CONV_EPILOGUE=1 turns on the compile-time
+conv-epilogue fusion pass (FLAGS_fuse_conv_epilogue);
+BENCH_CONV_EPILOGUE=reference|pallas pins the fused op's implementation.
+
 BENCH_PREPROBE (default 1 on TPU backends): before any model runs, a
 clean subprocess compiles one tiny jit through the relay with a hard
 deadline (BENCH_PREPROBE_TIMEOUT_S, default 600).  A wedged relay is
@@ -102,7 +110,19 @@ def _apply_config(amp: str, layout: str) -> None:
         fluid.disable_amp()
     else:
         fluid.enable_amp("bfloat16", keep_output=(amp == "keep"))
-    fluid.set_flags({"FLAGS_conv_layout": layout})
+    # always (re)set BOTH epilogue flags: probes toggle them via env
+    # overrides and set_flags state persists across run_model calls, so
+    # an unset env must mean "back to this process's bootstrap value",
+    # not "whatever the previous probe left behind"
+    fluid.set_flags({
+        "FLAGS_conv_layout": layout,
+        "FLAGS_fuse_conv_epilogue":
+            os.environ.get("BENCH_FUSE_CONV_EPILOGUE")
+            or os.environ.get("FLAGS_fuse_conv_epilogue", "0"),
+        "FLAGS_conv_epilogue":
+            os.environ.get("BENCH_CONV_EPILOGUE")
+            or os.environ.get("FLAGS_conv_epilogue", "reference"),
+    })
 
 
 def run_model(model: str, steps: int, peak_flops: float,
@@ -294,6 +314,37 @@ def run_model(model: str, steps: int, peak_flops: float,
 
     batches_np = [spec.synthetic_batch(bs, seed=i) for i in range(4)]
 
+    if os.environ.get("BENCH_LOWER_ONLY", "0") == "1":
+        # relay-independent gate: TPU-lower the exact step this config
+        # would time (chip trace scope forced) on the CPU host — catches
+        # chip-only Mosaic/pallas failures without spending a chip
+        # window.  Hoisted ABOVE device staging and pyreader startup
+        # (it only needs exe/run_program/batches_np[0]/fetch_var): the
+        # gate must never touch a possibly-wedged backend, and must not
+        # return with a reader thread still running.
+        nbytes = exe.tpu_lowering_check(
+            program=run_program, feed=batches_np[0],
+            fetch_list=[fetch_var])
+        return {"metric": f"{model}_tpu_lowering", "value": 1,
+                "unit": "ok", "vs_baseline": None,
+                "module_bytes": nbytes}
+
+    if os.environ.get("BENCH_COST_ONLY", "0") == "1":
+        # chip-less bytes/step table: the TPU compiler's own cost model
+        # via an AOT topology compile (core/aot_tpu.py) — per-model HBM
+        # traffic without a relay window.  BENCH_COST_PLATFORM=native
+        # analyzes the host-compiled executable instead.
+        plat = os.environ.get("BENCH_COST_PLATFORM", "tpu")
+        ca = exe.cost_analysis(
+            program=run_program, feed=batches_np[0],
+            fetch_list=[fetch_var],
+            platform=None if plat in ("", "native") else plat)
+        return {"metric": f"{model}_bytes_per_step",
+                "value": ca.get("bytes accessed"), "unit": "bytes",
+                "vs_baseline": None,
+                "cost_flops_per_step": ca.get("flops"),
+                "cost_platform": plat}
+
     from paddle_tpu.core.lod import LoDValue
 
     data_mode = os.environ.get("BENCH_DATA", "staged")
@@ -369,17 +420,6 @@ def run_model(model: str, steps: int, peak_flops: float,
     # committed-state jit variant also compiles before timing starts
     def step_feed(i):
         return None if use_pyreader else batches[i % len(batches)]
-
-    if os.environ.get("BENCH_LOWER_ONLY", "0") == "1":
-        # relay-independent gate: TPU-lower the exact step this config
-        # would time (chip trace scope forced) on the CPU host — catches
-        # chip-only Mosaic/pallas failures without spending a chip window
-        nbytes = exe.tpu_lowering_check(
-            program=run_program, feed=batches_np[0],
-            fetch_list=[fetch_var])
-        return {"metric": f"{model}_tpu_lowering", "value": 1,
-                "unit": "ok", "vs_baseline": None,
-                "module_bytes": nbytes}
 
     unroll = int(os.environ.get("BENCH_UNROLL", "0"))
     use_unroll = (
@@ -485,6 +525,15 @@ def run_model(model: str, steps: int, peak_flops: float,
         if feats["fuse_bn"] == "conv":
             feats["conv_epilogue"] = fluid.get_flags(
                 "conv_epilogue")["FLAGS_conv_epilogue"]
+    if model in CONV_MODELS:
+        fce = fluid.get_flags(
+            "fuse_conv_epilogue")["FLAGS_fuse_conv_epilogue"]
+        if fce:
+            # the compile-time fusion pass rewrote conv->bn chains; the
+            # impl that actually ran is FLAGS_conv_epilogue's choice
+            feats["fuse_conv_epilogue"] = True
+            feats["conv_epilogue"] = fluid.get_flags(
+                "conv_epilogue")["FLAGS_conv_epilogue"]
     if model in ("transformer", "transformer_longctx"):
         feats["fuse_smooth_ce"] = cfg.fuse_smooth_ce
         feats["flash_bwd"] = fluid.get_flags("flash_bwd")["FLAGS_flash_bwd"]
@@ -571,6 +620,12 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
                   # plain XLA, relay-safe; the pallas impl stays behind
                   # the staged probe + conv_ep_model step)
                   ("keep", "NHWC", {"BENCH_FUSE_BN": "conv"}),
+                  # the compile-time fusion pass + pallas conv-epilogue
+                  # kernels (FLAGS_fuse_conv_epilogue): the unfused
+                  # reference-shaped program, fused at lowering time
+                  ("keep", "NHWC", {"BENCH_FUSE_BN": "0",
+                                    "BENCH_FUSE_CONV_EPILOGUE": "1",
+                                    "BENCH_CONV_EPILOGUE": "pallas"}),
                   ("keep", "NCHW", {"BENCH_FUSE_BN": "0"}),
                   ("1", "NHWC", {"BENCH_FUSE_BN": "0"}),
                   ("1", "NCHW", {"BENCH_FUSE_BN": "0"})]

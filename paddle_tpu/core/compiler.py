@@ -372,10 +372,10 @@ def lower_op(ctx: LoweringContext, op: OpDesc, need_vjp_uids) -> None:
         _lower_forward_op(ctx, op, need_vjp=uid in need_vjp_uids)
 
 
-def collect_needed_vjps(block: Block) -> set:
+def collect_needed_vjps(ops) -> set:
     return {
         op.attrs["__fwd_op_uid__"]
-        for op in block.desc.ops
+        for op in ops
         if "__fwd_op_uid__" in op.attrs
     }
 
@@ -446,14 +446,41 @@ class CompiledBlock:
         self.mesh = mesh
         _maybe_enable_compile_cache()
         block = self.block
-        need_vjps = collect_needed_vjps(block)
+        ops = list(block.desc.ops)
+        # FLAGS_fuse_conv_epilogue lowering pass: rewrite private
+        # conv2d -> batch_norm [-> add] [-> relu] chains (and their grad
+        # windows) onto the one-op conv_bn_add_act tier.  Compile-time
+        # only — the ProgramDesc is untouched; the executor cache keys on
+        # flags.trace_key(), so flipping the flag recompiles.  No match
+        # leaves `ops` as the identical list (byte-identical lowering).
+        self.fused_conv_epilogue = 0
+        from .. import flags as _flags
+
+        if _flags.flag("fuse_conv_epilogue") and block_idx == 0:
+            from .fusion import fuse_conv_epilogue_ops
+
+            # fetches must survive, and so must anything a control-flow
+            # sub-block reads from the outer scope by name (closure
+            # semantics: those reads don't appear in block-0 op inputs)
+            protected = set(self.fetch_names)
+            for sub in program.desc.blocks[1:]:
+                for sop in sub.ops:
+                    protected.update(sop.input_arg_names())
+            fused = fuse_conv_epilogue_ops(
+                ops, block.desc.vars, protected=protected)
+            if fused is not ops:
+                self.fused_conv_epilogue = sum(
+                    1 for op in fused if op.type == "conv_bn_add_act"
+                    and op.attrs.get("__fused_from__"))
+                ops = fused
+        need_vjps = collect_needed_vjps(ops)
 
         def fn(feed_vals, state_vals, key):
             env: Dict[str, Any] = {}
             env.update(zip(self.state_names, state_vals))
             env.update(zip(self.feed_names, feed_vals))
             ctx = LoweringContext(program, block, env, key, mesh=mesh)
-            for op in block.desc.ops:
+            for op in ops:
                 lower_op(ctx, op, need_vjps)
             fetches = tuple(ctx.lookup(n) for n in self.fetch_names)
             new_states = tuple(env.get(n) for n in self.state_names)
@@ -474,13 +501,23 @@ class CompiledBlock:
     def __call__(self, feed_vals, state_vals, key):
         return self.fn(tuple(feed_vals), tuple(state_vals), key)
 
-    def cost_analysis(self, feed_vals, state_vals, key) -> dict:
+    def cost_analysis(self, feed_vals, state_vals, key,
+                      platform: Optional[str] = None) -> dict:
         """XLA cost accounting of the COMPILED executable for these arg
         shapes: {'bytes accessed': HBM bytes per execution, 'flops': ...}.
         This is the compiled module's own traffic model — the instrument
         VERDICT r4 asked for to validate paper bytes/step floors (e.g. the
         65 GB ResNet-50 estimate).  Cheap after the first execution: the
-        trace/lower/compile pipeline hits jax's compilation cache."""
+        trace/lower/compile pipeline hits jax's compilation cache.
+
+        platform="tpu" AOT-compiles this block against a chip-less v5e
+        topology (core/aot_tpu.py) and returns the TPU compiler's own
+        cost model — real bytes/step on any host, no relay window."""
+        if platform == "tpu":
+            from .aot_tpu import tpu_cost_analysis
+
+            return tpu_cost_analysis(
+                self.raw_fn, tuple(feed_vals), tuple(state_vals), key)
         compiled = self.fn.trace(
             tuple(feed_vals), tuple(state_vals), key).lower().compile()
         ca = compiled.cost_analysis()

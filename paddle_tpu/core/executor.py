@@ -426,19 +426,34 @@ class Executor:
         feed: Optional[Dict[str, Any]] = None,
         fetch_list: Optional[Sequence] = None,
         scope: Optional[Scope] = None,
+        platform: Optional[str] = None,
     ) -> dict:
         """XLA cost accounting ({'bytes accessed', 'flops', ...}) of the
         executable this executor would run for (program, feed, fetches) —
         per single step.  Resolves the same trace-scope defaults and cache
         entry as run() (shared _cache_entry), so the analyzed module IS
         the one being timed.  The instrument for validating paper
-        HBM-traffic floors (VERDICT r4: nothing had measured bytes/step)."""
+        HBM-traffic floors (VERDICT r4: nothing had measured bytes/step).
+
+        platform="tpu" forces the CHIP program (TPU trace scope: keep-bf16
+        / NHWC auto resolution) and compiles it AOT against a chip-less
+        v5e topology (core/aot_tpu.py), returning the TPU compiler's own
+        bytes/step on any host — no relay window needed."""
         if program is not None and hasattr(program, "with_data_parallel"):
             raise TypeError(
                 "cost_analysis takes a plain Program; for a "
                 "CompiledProgram pass its .program and note the analysis "
                 "covers the serial executable, not the SPMD one")
-        with flags.tpu_trace_scope(device_is_tpu(self.place.jax_device())):
+        if platform not in (None, "tpu"):
+            # a typo'd platform must not silently bank host-executable
+            # bytes under a TPU-looking label
+            raise ValueError(
+                f"cost_analysis platform must be None or 'tpu', "
+                f"got {platform!r}")
+        want_tpu = platform == "tpu"
+        with flags.tpu_trace_scope(
+                True if want_tpu
+                else device_is_tpu(self.place.jax_device())):
             program = program or default_main_program()
             if feed is None and getattr(program, "_py_readers", None):
                 # mirror run()'s feed-less py_reader path: pull one batch
@@ -461,6 +476,11 @@ class Executor:
             feed_vals = plan.feed_values(feed, block0)
             state_vals = plan.state_values(scope, block0)
             rng = plan.rng_value(scope, program)
+            if want_tpu:
+                # AOT path: only shapes/dtypes are consumed, no device
+                # commit (there is no device)
+                return compiled.cost_analysis(
+                    feed_vals, state_vals, rng, platform="tpu")
             # same device commit as run(): the analyzed executable must
             # BE the one run() dispatches (an uncommitted key would
             # lower a second, never-reused variant)

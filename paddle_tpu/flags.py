@@ -62,6 +62,15 @@ _DEFS: Dict[str, Any] = {
     # staged probe (tools/conv_epilogue_probe.py) banks a winning
     # on-chip A/B: defaults follow measurements
     "FLAGS_conv_epilogue": "reference",
+    # compile-time fusion pass (core/fusion.py): pattern-match
+    # conv2d -> batch_norm [-> elementwise_add] -> relu chains in block 0
+    # and route them through the one-op conv_bn_add_act tier at lowering
+    # time — the program desc itself is untouched.  The op that runs is
+    # then picked by FLAGS_conv_epilogue (reference composition vs the
+    # pallas conv-epilogue kernel pair).  Default off until a chip A/B
+    # banks a win (defaults follow measurements); the bytes/step win is
+    # CPU-verifiable via Executor.cost_analysis (tests/test_conv_fusion_pass.py)
+    "FLAGS_fuse_conv_epilogue": False,
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.
@@ -187,7 +196,8 @@ def trace_key() -> tuple:
     cache keys so a flag flip between runs recompiles instead of reusing
     a stale executable."""
     return (conv_layout(), _VALUES["FLAGS_flash_bwd"],
-            _VALUES["FLAGS_conv_epilogue"])
+            _VALUES["FLAGS_conv_epilogue"],
+            _VALUES["FLAGS_fuse_conv_epilogue"])
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

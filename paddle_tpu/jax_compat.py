@@ -1,0 +1,52 @@
+"""jax version compatibility shims.
+
+The repo targets the modern jax surface (`jax.shard_map` as a top-level
+function, `jax.export` eagerly importable).  Older jaxlib builds (<= 0.4.x)
+ship the same functionality under different paths; alias them onto the
+`jax` module at import time so every call site (and the tests, which import
+`from jax import shard_map` directly) sees one spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    import functools
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in inspect.signature(_shard_map).parameters:
+        jax.shard_map = _shard_map
+    else:
+        # the modern kwarg is check_vma; 0.4.x spells it check_rep
+        @functools.wraps(_shard_map)
+        def _shard_map_compat(*args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+        jax.shard_map = _shard_map_compat
+
+# jax.export is a lazily-importable submodule on 0.4.x: attribute access on
+# the bare `jax` module fails until someone imports it.  Do that once here
+# so `jax.export.export(...)` works everywhere.
+import jax.export  # noqa: E402,F401
+
+# Lowered.as_text(debug_info=True) (location metadata in the printed
+# module) postdates 0.4.x; emulate it via the MLIR module's own printer.
+import inspect as _inspect  # noqa: E402
+
+_low_as_text = jax.stages.Lowered.as_text
+if "debug_info" not in _inspect.signature(_low_as_text).parameters:
+    def _as_text_compat(self, dialect=None, *, debug_info=False):
+        if debug_info:
+            try:
+                mod = self.compiler_ir(dialect or "stablehlo")
+                return mod.operation.get_asm(enable_debug_info=True)
+            except Exception:
+                pass
+        return _low_as_text(self, dialect)
+
+    jax.stages.Lowered.as_text = _as_text_compat

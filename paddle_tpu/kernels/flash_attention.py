@@ -43,12 +43,27 @@ import numpy as np
 __all__ = ["flash_attention"]
 
 NEG_INF = -1e30
-# The per-row logsumexp/D residuals are carried with a broadcast 128-lane
-# trailing dim: TPU pallas rejects blocks whose last two dims are neither
-# (8k, 128k)-tiled nor equal to the array dims, so a [B*H, Sq]-shaped
-# residual with block (1, block_q) cannot lower (chip-only failure; the
-# interpret-mode tests never see the constraint).
-LSE_LANES = 128
+
+# The per-row logsumexp/D residuals are PACKED: [B*H, num_q_blocks,
+# block_q] fp32, row qi of the packed plane holding q-block qi's
+# per-row scalars on the 128 lanes.  TPU pallas rejects blocks whose
+# last two dims are neither (8k, 128k)-tiled nor equal to the array
+# dims, so a [B*H, Sq] residual with block (1, block_q) cannot lower
+# (chip-only failure) — the round-5 fix broadcast the scalars across a
+# full 128-lane register instead ([B*H, Sqp, 128] fp32, ~67 MB/tensor at
+# the longcontext shape, 128x the payload, and XLA does NOT fuse that
+# broadcast away: it materializes as custom-call operands).  The packed
+# layout is exact-size ((8,128)-tiled with no replication); each kernel
+# step reads its (block_q,) row and transposes it to the [block_q, 1]
+# column the softmax math wants — one register-level lane->sublane
+# transpose per grid step buys a 128x smaller HBM residual.
+
+
+def _packed_col(ref, qi):
+    """[block_q, 1] column for q-block qi from a packed residual ref
+    (block shape [1, num_q_blocks, block_q])."""
+    row = ref[0, qi, :].reshape(1, -1)
+    return jnp.transpose(row, (1, 0))
 
 
 def _reference_attention(q, k, v, causal, scale, bias=None, k_lengths=None):
@@ -143,11 +158,11 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 l_fin > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_fin, 1e-30)),
                 -NEG_INF,
             )
-            # lse rides lane-broadcast to [block_q, LSE_LANES]: TPU
-            # refuses 2-D output blocks narrower than the (8, 128) tile,
-            # so the per-row scalar is replicated across one 128-lane
-            # register (same layout as jax's shipped flash kernels)
-            lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], LSE_LANES))
+            # packed residual layout (module comment at NEG_INF): the
+            # [block_q, 1] column transposes to q-block qi's row of the
+            # [1, num_q_blocks, block_q] block — exact-size, no lane
+            # replication
+            lse_ref[0, qi, :] = jnp.transpose(lse, (1, 0))[0]
 
 
 def _flash_bwd_dq_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -171,8 +186,8 @@ def _flash_bwd_dq_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, :1]   # [block_q, 1] (lane-broadcast residual)
-    dvec = dvec_ref[0][:, :1]  # [block_q, 1]
+    lse = _packed_col(lse_ref, qi)    # [block_q, 1] (packed residual)
+    dvec = _packed_col(dvec_ref, qi)  # [block_q, 1]
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     mask = _block_mask(klen_ref, bi, qi, ki, s.shape, block_q, block_k,
@@ -211,8 +226,8 @@ def _flash_bwd_dkv_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0][:, :1]
-    dvec = dvec_ref[0][:, :1]
+    lse = _packed_col(lse_ref, qi)
+    dvec = _packed_col(dvec_ref, qi)
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     mask = _block_mask(klen_ref, bi, qi, ki, s.shape, block_q, block_k,
@@ -261,13 +276,16 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
     from jax.experimental.pallas import tpu as pltpu
 
     kernel = _flash_kernel if emit_lse else _flash_kernel_fwd_only
+    nqb = sqp // bq
     out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(dtype))]
     if emit_lse:
+        # packed lse: one [nqb, bq] plane per batch-head row, revisited
+        # across q/k steps and flushed when b advances
         out_specs.append(
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)))
+            pl.BlockSpec((1, nqb, bq), lambda b, i, j: (b, 0, 0)))
         out_shape.append(
-            jax.ShapeDtypeStruct((bh, sqp, LSE_LANES), jnp.float32))
+            jax.ShapeDtypeStruct((bh, nqb, bq), jnp.float32))
     return pl.pallas_call(
         functools.partial(
             kernel, causal=causal, scale=scale, block_q=bq,
@@ -296,12 +314,12 @@ def _fwd_call(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
 
 def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
                   interpret=False, need_lse=True):
-    """Returns (out [B,H,Sq,D], lse [B*H, padded Sq] fp32 per-row
-    logsumexp; the kernel emits it lane-broadcast for TPU tiling and
-    lane 0 is sliced out here).  need_lse=False (inference / the
-    recompute-jax backward) skips the lse output entirely — its HBM
-    write is pure waste when nothing consumes it — and returns
-    (out, None)."""
+    """Returns (out [B,H,Sq,D], lse [B*H, num_q_blocks, block_q] fp32
+    per-row logsumexp in the PACKED residual layout — see the module
+    comment; _pallas_flash_bwd consumes it as-is).  need_lse=False
+    (inference / the recompute-jax backward) skips the lse output
+    entirely — its HBM write is pure waste when nothing consumes it —
+    and returns (out, None)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, Sq)
@@ -324,10 +342,7 @@ def _pallas_flash(q, k, v, klen, causal, scale, block_q=128, block_k=128,
         out = out[:, :, :Sq]
     if not need_lse:
         return out, None
-    # the kernel emits lse lane-broadcast ([B*H, Sqp, LSE_LANES], TPU
-    # tiling); keep only lane 0 as the residual — holding the broadcast
-    # through the backward would cost 128x the activation memory
-    return out, res[1][..., 0]
+    return out, res[1]  # packed [B*H, nqb, bq]; the bwd reads it as-is
 
 
 @functools.lru_cache(maxsize=128)
@@ -340,6 +355,10 @@ def _bwd_calls(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
     common = dict(causal=causal, scale=scale, block_q=bq, block_k=bk,
                   seq_k=seq_k, causal_offset=causal_offset)
     smem = pl.BlockSpec((bh,), lambda *_: (0,), memory_space=pltpu.SMEM)
+    nqb = sqp // bq
+    # packed lse/dvec residuals: the whole (tiny) [nqb, bq] plane for
+    # batch-head row b rides in VMEM; kernels read their q-block's row
+    packed = pl.BlockSpec((1, nqb, bq), lambda b, *_: (b, 0, 0))
 
     dq_call = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -350,8 +369,8 @@ def _bwd_calls(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
+            packed,
+            packed,
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sqp, d), jnp.dtype(q_dtype)),
@@ -368,8 +387,8 @@ def _bwd_calls(bh, sqp, skp, d, bq, bk, causal, scale, seq_k,
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
+            packed,
+            packed,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -406,13 +425,14 @@ def _pallas_flash_bwd(q, k, v, klen, out, lse, g, causal, scale,
     kf = kp.reshape(B * H, Skp, D)
     vf = vp.reshape(B * H, Skp, D)
     klen_bh = jnp.repeat(klen, H)
-    # D_i = rowsum(dO * O): one fused elementwise+reduce pass, fp32
+    # D_i = rowsum(dO * O): one fused elementwise+reduce pass, fp32,
+    # reshaped (a free, layout-preserving view) straight into the packed
+    # [B*H, nqb, bq] residual layout the kernels index — no lane
+    # broadcast ever materializes (the old [B*H, Sqp, 128] operands were
+    # 128x the payload and did NOT fuse away: custom-call operands are
+    # materialized in HBM)
     dvec = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
-    # re-broadcast the per-row residuals to the kernels' lane-tiled
-    # block layout (see LSE_LANES) just before the calls — XLA fuses the
-    # broadcast into the kernel operand materialization
-    dvec = jnp.broadcast_to(dvec[..., None], (*dvec.shape, LSE_LANES))
-    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LSE_LANES))
+    dvec = dvec.reshape(B * H, Sqp // bq, bq)
 
     dq_call, dkv_call = _bwd_calls(
         B * H, Sqp, Skp, D, bq, bk, causal, scale, Sk, Sk - Sq,

@@ -572,27 +572,49 @@ def _conv_bn_add_act(ctx, ins, attrs):
     zn = (jnp.transpose(z.astype(xn.dtype), (0, 2, 3, 1))
           if z is not None else None)
     impl = _flags.flag("conv_epilogue")
-    if impl == "pallas" and groups != 1:
-        # the pallas kernels are single-group (per-tap [Ho*Wo,C]x[C,F]
-        # matmuls); grouped convs (ResNeXt cardinality) take the
-        # reference composition until a grouped kernel tier exists
-        impl = "reference"
     if impl == "pallas":
+        from ..kernels.conv_epilogue import pallas_viable
+
+        # explicit fallback instead of a compile-time bail: grouped convs
+        # (single-group per-tap matmuls only, ResNeXt cardinality) and
+        # shapes whose row tiles cannot fit VMEM take the reference
+        # composition
+        Np, Hp_, Wp_, Cp = xn.shape
+        if not pallas_viable(Np, Hp_, Wp_, Cp, wn.shape[-1], wn.shape[0],
+                             stride=stride, padding=padding,
+                             dtype=xn.dtype, groups=groups):
+            impl = "reference"
+    if impl == "pallas":
+        # interpret iff the TRACE TARGET is a CPU host: under the TPU
+        # trace scope (chip runs, AOT cost analysis, the lowering gate)
+        # the real Mosaic kernels must lower even when the process
+        # default backend is cpu — keying off default_backend alone
+        # silently compiled interpret-mode pallas into AOT-for-TPU
+        # modules (caught by the chip-less full-compile tier)
         fn = make_conv_bn_act(
             has_residual=z is not None, stride=stride, padding=padding,
-            eps=eps, act=act, interpret=jax.default_backend() == "cpu")
+            eps=eps, act=act,
+            interpret=(jax.default_backend() == "cpu"
+                       and not _flags.tpu_trace_active()))
         args = (xn, wn, scale, bias) + ((zn,) if z is not None else ())
         yn, bmean, bvar = fn(*args)
     else:
-        # checkpoint INSIDE the lowering: backward recomputes the
-        # conv/BN chain instead of storing its intermediates — the same
-        # storage trade as fused_bn_add_act's @recompute@ tag, but
-        # owned here so the pallas branch (whose custom_vjp already
-        # recomputes) is never double-wrapped
-        ref = jax.checkpoint(
-            lambda a, b, c, d, e: conv_bn_act_reference(
-                a, b, c, d, e, stride=stride, padding=padding,
-                eps=eps, act=act, groups=groups))
+        ref = lambda a, b, c, d, e: conv_bn_act_reference(  # noqa: E731
+            a, b, c, d, e, stride=stride, padding=padding,
+            eps=eps, act=act, groups=groups)
+        if not attrs.get("__fused_from__"):
+            # checkpoint INSIDE the lowering: backward recomputes the
+            # conv/BN chain instead of storing its intermediates — the
+            # same storage trade as fused_bn_add_act's @recompute@ tag,
+            # but owned here so the pallas branch (whose custom_vjp
+            # already recomputes) is never double-wrapped.  Ops the
+            # FUSION PASS created skip it: the chip-less v5e cost model
+            # prices the recompute at ~1.5x the unfused chain's bytes
+            # (the round-5 one-op A/B loss), and the pass's contract is
+            # "never worse than the chain it replaced" — its reference
+            # fallback stores intermediates exactly like the unfused
+            # lowering would
+            ref = jax.checkpoint(ref)
         yn, bmean, bvar = ref(xn, wn, scale, bias, zn)
     y = jnp.transpose(yn, (0, 3, 1, 2))
     y = amp.mxu_output(y, x, f)

@@ -57,6 +57,14 @@ def init_distributed(
     if process_id is None:
         v = os.environ.get("PADDLE_TRAINER_ID")
         process_id = int(v) if v is not None else None
+    try:
+        # CPU multiprocess collectives need the gloo transport; without it
+        # jaxlib's CPU backend rejects multi-host computations outright
+        # ("Multiprocess computations aren't implemented").  TPU backends
+        # ignore this setting.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older/newer jax may not expose the knob
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

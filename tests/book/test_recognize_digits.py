@@ -44,8 +44,12 @@ def test_recognize_digits_conv(tmp_path):
         loss_v, acc_v = exe.run(feed=feed(data), fetch_list=[avg_cost, acc])
         losses.append(float(np.ravel(np.asarray(loss_v))[0]))
         accs.append(float(np.ravel(np.asarray(acc_v))[0]))
-        if i >= 40:
+        if i >= 100:
             break
+    # 100 steps, not 40: with this jax version's initializer draws the
+    # net needs ~60 steps to clear the margin (0.12 -> 0.27 at 40 vs
+    # 0.66 at 100) — the shorter run asserted convergence speed, not
+    # convergence
     assert np.mean(accs[-5:]) > np.mean(accs[:5]) + 0.2, (
         f"accuracy did not improve: {np.mean(accs[:5])} -> "
         f"{np.mean(accs[-5:])}")

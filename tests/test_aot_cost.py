@@ -1,0 +1,127 @@
+"""Chip-less TPU cost accounting (core/aot_tpu.py): AOT-compile against a
+v5e topology with no TPU attached and read the TPU compiler's own cost
+model.  This is the instrument behind the conv-epilogue bytes/step
+acceptance: the fused kernel pair must cut HBM traffic >= 25% vs the
+unfused XLA chain on ResNet-50 block shapes, verified WITHOUT a chip."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.aot_tpu import compile_tpu, tpu_cost_analysis
+
+
+def _skip_if_no_topology():
+    try:
+        from paddle_tpu.core.aot_tpu import tpu_topology
+
+        tpu_topology()
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"no chip-less TPU topology available: {e}")
+
+
+def test_tpu_topology_cost_analysis_basic():
+    """A trivial matmul compiles for v5e on the CPU host and reports the
+    TPU cost model's keys."""
+    _skip_if_no_topology()
+    x = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+    ca = tpu_cost_analysis(lambda a: jnp.sum(a @ a.T), x)
+    assert ca.get("bytes accessed", 0) > 0
+    assert ca.get("flops", 0) >= 2 * 512 * 512 * 512
+
+
+def test_conv_epilogue_bytes_reduction_on_resnet_block_shapes():
+    """The acceptance number: fused conv-epilogue kernels (pallas fwd +
+    analytic bwd) vs the unfused conv->bn->add->relu XLA chain, fwd+bwd
+    at ResNet-50 block shapes (56x56, C=F=64, 3x3), two chained residual
+    blocks so inter-block effects count.  TPU compiler cost model must
+    show >= 25% fewer bytes accessed for the fused lowering."""
+    _skip_if_no_topology()
+    from paddle_tpu.kernels.conv_epilogue import make_conv_bn_act
+
+    N, H, C, NBLK = 4, 56, 64, 2
+    x = jax.ShapeDtypeStruct((N, H, H, C), jnp.float32)
+    w = jax.ShapeDtypeStruct((3, 3, C, C), jnp.float32)
+    g = jax.ShapeDtypeStruct((C,), jnp.float32)
+
+    def chain_fused(x, ws, gs, bs):
+        f = make_conv_bn_act(has_residual=True, stride=1, padding=1)
+        h = x
+        for i in range(NBLK):
+            h, _, _ = f(h, ws[i], gs[i], bs[i], h)
+        return jnp.sum(h)
+
+    def chain_unfused(x, ws, gs, bs):
+        h = x
+        for i in range(NBLK):
+            out = jax.lax.conv_general_dilated(
+                h, ws[i], window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            mean = jnp.mean(out, axis=(0, 1, 2))
+            var = jnp.mean(out * out, axis=(0, 1, 2)) - mean * mean
+            inv = jax.lax.rsqrt(var + 1e-5)
+            h = jax.nn.relu((out - mean) * inv * gs[i] + bs[i] + h)
+        return jnp.sum(h)
+
+    def bytes_of(fn):
+        grad = jax.grad(fn, argnums=(0, 1, 2, 3))
+        ca = tpu_cost_analysis(grad, x, [w] * NBLK, [g] * NBLK, [g] * NBLK)
+        return ca["bytes accessed"]
+
+    unfused = bytes_of(chain_unfused)
+    fused = bytes_of(chain_fused)
+    assert fused <= 0.75 * unfused, (
+        f"fused conv epilogue bytes/step regressed: {fused:.3e} vs "
+        f"unfused {unfused:.3e} (ratio {fused / unfused:.3f} > 0.75)")
+
+
+def test_executor_cost_analysis_platform_tpu():
+    """Executor.cost_analysis(platform='tpu') returns the chip program's
+    bytes/step on a CPU host (TPU trace scope forced: NHWC/keep-bf16
+    auto-resolution included)."""
+    _skip_if_no_topology()
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    x = layers.data("x", [16, 16, 16], dtype="float32")
+    h = layers.fc(layers.pool2d(x, pool_size=16, pool_type="avg"), size=4)
+    loss = layers.mean(h)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xa = np.zeros((2, 16, 16, 16), "float32")
+    ca = exe.cost_analysis(feed={"x": xa}, fetch_list=[loss],
+                           platform="tpu")
+    assert ca.get("bytes accessed", 0) > 0
+
+
+def test_compile_tpu_full_pipeline_catches_more_than_export():
+    """compile_tpu runs the whole XLA TPU pipeline (layout, fusion,
+    memory budgeting) — the pallas conv kernel must survive it inside
+    its advertised envelope (pallas_viable), not just the jax.export
+    lowering gate.  This tier caught two real bugs export missed:
+    Mosaic's 'non-native tiling' on unaligned tap windows, and
+    interpret-mode pallas silently compiled into AOT-for-TPU modules."""
+    _skip_if_no_topology()
+    from paddle_tpu.kernels.conv_epilogue import conv_bn_act, pallas_viable
+
+    # in-envelope: fp32 3x3 at the ResNet stage-1 shape (in-VMEM pad
+    # path) and a bf16 1x1 (the keep-bf16 chip config's coverage)
+    cases = [((2, 56, 56, 64), (3, 3, 64, 64), jnp.float32),
+             ((2, 28, 28, 128), (1, 1, 128, 128), jnp.bfloat16)]
+    for xs, ws, dt in cases:
+        assert pallas_viable(xs[0], xs[1], xs[2], xs[3], ws[3], ws[0],
+                             dtype=dt)
+        args = (jax.ShapeDtypeStruct(xs, dt),
+                jax.ShapeDtypeStruct(ws, dt),
+                jax.ShapeDtypeStruct((ws[3],), jnp.float32),
+                jax.ShapeDtypeStruct((ws[3],), jnp.float32))
+        comp = compile_tpu(lambda *a: conv_bn_act(*a), *args)
+        ca = comp.cost_analysis()
+        ca = ca if isinstance(ca, dict) else ca[0]
+        assert ca.get("bytes accessed", 0) > 0
+    # out-of-envelope bf16 3x3 is reported non-viable, not a compile bomb
+    assert not pallas_viable(2, 28, 28, 64, 64, 3, dtype=jnp.bfloat16)

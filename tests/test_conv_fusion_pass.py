@@ -1,0 +1,230 @@
+"""FLAGS_fuse_conv_epilogue: the compile-time conv-epilogue fusion pass
+(core/fusion.py; reference counterpart ir/conv_bn_fuse_pass +
+conv_elementwise_add_act_fuse feeding conv_fusion_op.cu.cc).
+
+Contracts: exact numerical parity with the unfused chain (the rewrite
+targets the parity-tested conv_bn_add_act op), byte-identical lowering
+when nothing matches, fetch-protection, and grad-window collapse that
+preserves accumulation (`@RENAME@`) names."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.fusion import fuse_conv_epilogue_ops
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    fluid.set_flags({"FLAGS_fuse_conv_epilogue": False})
+
+
+def _block_ops():
+    return list(fluid.default_main_program().desc.block(0).ops)
+
+
+def _build_resnet_block(with_residual=True, bias=False, act="relu"):
+    x = layers.data("x", [8, 8, 8], dtype="float32")
+    yv = layers.data("y", [1], dtype="int64")
+    conv = layers.conv2d(x, 8, 3, padding=1,
+                         bias_attr=None if bias else False,
+                         param_attr=fluid.ParamAttr(name="w"))
+    b = layers.batch_norm(conv, act=None,
+                          param_attr=fluid.ParamAttr(name="s"),
+                          bias_attr=fluid.ParamAttr(name="b"),
+                          moving_mean_name="m", moving_variance_name="v")
+    h = layers.elementwise_add(b, x) if with_residual else b
+    if act:
+        h = layers.relu(h)
+    pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+    pred = layers.fc(pool, size=3, act="softmax",
+                     param_attr=fluid.ParamAttr(name="fc"))
+    loss = layers.mean(layers.cross_entropy(pred, yv))
+    fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return loss, h
+
+
+def _train(fuse, steps=4, **build_kw):
+    fluid.reset_default_env()
+    fluid.set_flags({"FLAGS_fuse_conv_epilogue": fuse})
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    loss, _ = _build_resnet_block(**build_kw)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r = np.random.RandomState(5)
+    xa = r.randn(4, 8, 8, 8).astype("float32")
+    ya = r.randint(0, 3, size=(4, 1)).astype("int64")
+    ls = [float(np.ravel(np.asarray(exe.run(
+        feed={"x": xa, "y": ya}, fetch_list=[loss])[0]))[0])
+        for _ in range(steps)]
+    sc = fluid.global_scope()
+    st = {n: np.asarray(sc.find_var(n)).copy()
+          for n in ("w", "s", "b", "m", "v", "fc")}
+    nfused = max(
+        getattr(e[1], "fused_conv_epilogue", 0) for e in exe._cache.values())
+    return ls, st, nfused
+
+
+@pytest.mark.parametrize("with_residual", [True, False])
+def test_fused_training_matches_unfused(with_residual):
+    """fwd + bwd + moving stats + optimizer states: exact parity (the
+    rewrite routes through the parity-tested conv_bn_add_act lowering)."""
+    l0, s0, n0 = _train(False, with_residual=with_residual)
+    l1, s1, n1 = _train(True, with_residual=with_residual)
+    assert n0 == 0 and n1 == 1
+    assert l0[-1] < l0[0]  # training moved
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+    for n in s0:
+        np.testing.assert_allclose(s0[n], s1[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
+
+
+def test_bare_conv_bn_fuses_without_act():
+    """conv -> bn with neither residual nor relu still fuses (act='')."""
+    l0, s0, _ = _train(False, with_residual=False, act="")
+    l1, s1, n1 = _train(True, with_residual=False, act="")
+    assert n1 == 1
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+    for n in s0:
+        np.testing.assert_allclose(s0[n], s1[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
+
+
+def test_resnet_model_parity_and_full_block_coverage():
+    """resnet_cifar10's unfused program: every conv+bn chain (main
+    branches AND act-less shortcuts) collapses, and training matches."""
+    from paddle_tpu import models
+
+    def run(fuse):
+        fluid.reset_default_env()
+        fluid.set_flags({"FLAGS_fuse_conv_epilogue": fuse})
+        fluid.default_main_program().random_seed = 3
+        fluid.default_startup_program().random_seed = 3
+        spec = models.resnet_cifar10(depth=8, class_num=4, fuse_bn=False)
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        b = spec.synthetic_batch(8, seed=2)
+        ls = [float(np.ravel(np.asarray(
+            exe.run(feed=b, fetch_list=[spec.loss])[0]))[0])
+            for _ in range(3)]
+        nfused = max(getattr(e[1], "fused_conv_epilogue", 0)
+                     for e in exe._cache.values())
+        return ls, nfused
+
+    l0, n0 = run(False)
+    l1, n1 = run(True)
+    blk = fluid.default_main_program().desc.block(0)
+    n_convs = sum(1 for op in blk.ops if op.type == "conv2d")
+    assert n0 == 0
+    assert n1 == n_convs  # reverse-order matching fuses every chain
+    assert l0[-1] < l0[0]
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+
+
+def test_no_match_is_identity():
+    """Programs without the pattern: the pass returns the SAME ops list
+    object (so the lowering is byte-identical with the flag on)."""
+    fluid.reset_default_env()
+    x = layers.data("x", [8, 8, 8], dtype="float32")
+    # conv with bias: conv2d -> elementwise_add(bias) breaks the pattern
+    conv = layers.conv2d(x, 8, 3, padding=1)
+    b = layers.batch_norm(conv, act="relu")
+    loss = layers.mean(b)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    blk = fluid.default_main_program().desc.block(0)
+    ops = list(blk.ops)
+    assert fuse_conv_epilogue_ops(ops, blk.vars, [loss.name]) is ops
+
+
+def test_no_match_lowers_byte_identically():
+    """Flag on + no pattern => the lowered StableHLO is identical."""
+    from paddle_tpu.core.compiler import CompiledBlock
+    from paddle_tpu.core.executor import _RunPlan
+
+    def lower_text(fuse):
+        fluid.reset_default_env()
+        fluid.set_flags({"FLAGS_fuse_conv_epilogue": fuse})
+        x = layers.data("x", [4], dtype="float32")
+        h = layers.fc(x, size=4, act="relu",
+                      param_attr=fluid.ParamAttr(name="fw"))
+        loss = layers.mean(h)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        prog = fluid.default_main_program()
+        plan = _RunPlan(prog, ["x"], [loss.name])
+        cb = CompiledBlock(prog, 0, plan.feed_names, plan.fetch_names,
+                           plan.state_names, donate_states=False)
+        blk = prog.desc.block(0)
+        sv = plan.state_values(fluid.global_scope(), blk)
+        xa = np.zeros((2, 4), "float32")
+        txt = jax.jit(cb.raw_fn).lower(
+            (xa,), sv, jax.random.PRNGKey(0)).as_text()
+        fluid.set_flags({"FLAGS_fuse_conv_epilogue": False})
+        return txt
+
+    assert lower_text(False) == lower_text(True)
+
+
+def test_fetched_intermediate_blocks_fusion():
+    """A chain whose bn output is fetched must NOT be rewritten."""
+    fluid.reset_default_env()
+    fluid.set_flags({"FLAGS_fuse_conv_epilogue": True})
+    fluid.default_startup_program().random_seed = 1
+    x = layers.data("x", [8, 8, 8], dtype="float32")
+    conv = layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+    b = layers.batch_norm(conv, act=None)
+    h = layers.relu(layers.elementwise_add(b, x))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xa = np.random.RandomState(0).randn(2, 8, 8, 8).astype("float32")
+    bn_v, h_v = exe.run(feed={"x": xa}, fetch_list=[b, h])
+    nfused = max(getattr(e[1], "fused_conv_epilogue", 0)
+                 for e in exe._cache.values())
+    assert nfused == 0  # bn output fetched -> chain protected
+    assert np.asarray(bn_v).shape == np.asarray(h_v).shape
+
+
+def test_test_mode_clone_not_fused():
+    """clone(for_test=True) sets is_test on batch_norm: the pass must
+    leave inference programs to the transpiler fold."""
+    fluid.reset_default_env()
+    x = layers.data("x", [8, 8, 8], dtype="float32")
+    conv = layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+    b = layers.batch_norm(conv, act="relu")
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    blk = test_prog.desc.block(0)
+    ops = list(blk.ops)
+    assert fuse_conv_epilogue_ops(ops, blk.vars, []) is ops
+
+
+def test_pass_preserves_grad_accumulation_names():
+    """x feeds the conv AND the residual add: the fused grad op must
+    scatter to the exact (possibly @RENAME@) names the original grad
+    window produced, so downstream sum ops still see both parts."""
+    fluid.reset_default_env()
+    x = layers.data("x", [8, 8, 8], dtype="float32")
+    conv = layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+    b = layers.batch_norm(conv, act=None)
+    h = layers.relu(layers.elementwise_add(b, x))
+    # second consumer of the chain output
+    loss = layers.mean(h) + layers.mean(h * h)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    blk = fluid.default_main_program().desc.block(0)
+    ops = list(blk.ops)
+    fused = fuse_conv_epilogue_ops(ops, blk.vars, [loss.name])
+    assert fused is not ops
+    fwd = [o for o in fused if o.type == "conv_bn_add_act"]
+    grad = [o for o in fused if o.type == "conv_bn_add_act_grad"]
+    assert len(fwd) == 1 and len(grad) == 1
+    produced = {n for o in fused for n in o.output_arg_names() if n}
+    consumed = {n for o in fused for n in o.input_arg_names() if n}
+    dangling = {n for n in consumed - produced
+                if "@GRAD" in n and not blk.vars.get(n, None)}
+    assert not dangling, dangling

@@ -76,6 +76,27 @@ class TestFlashLowering:
 
         _tpu_lowers(f, q)
 
+    def test_packed_residuals_no_lane_broadcast(self):
+        """lse/dvec ride the packed [B*H, nqb, bq] layout: the lowered
+        module must contain NO [B*H, Sqp, 128] fp32 operand (the round-5
+        layout broadcast every per-row scalar across 128 lanes —
+        ~67 MB/tensor at this longcontext shape, 128x the payload)."""
+        B, H, Sq, Sk, D = 4, 16, 2048, 2048, 64
+        q = jax.ShapeDtypeStruct((B, H, Sq, D), jnp.bfloat16)
+
+        def f(q):
+            klen = jnp.full((B,), Sk, jnp.float32)
+            out, lse = fa._pallas_flash(q, q, q, klen, causal=True,
+                                        scale=0.125)
+            return fa._pallas_flash_bwd(q, q, q, klen, out, lse, out,
+                                        causal=True, scale=0.125)
+
+        exp = jax.export.export(jax.jit(f), platforms=["tpu"])(q)
+        txt = exp.mlir_module()
+        assert f"tensor<{B * H}x{Sq}x128xf32>" not in txt
+        # the packed residual layout is what flows instead
+        assert f"tensor<{B * H}x{Sq // 128}x128xf32>" in txt
+
 
 class TestConvEpilogueLowering:
     # ResNet-50 block shapes (NHWC), incl. the stride-2 stage
