@@ -49,6 +49,17 @@ session — forces BENCH_UNROLL=0 and FLAGS_flash_bwd=jax (flash *forward*
 stays on; it produced the r3 numbers).  The experimental paths stay
 available to explicit runs but can never reach the driver's artifact.
 
+BENCH_CKPT_DIR=<dir>: opt-in resumable runs — before the timed region the
+model restores from the newest valid checkpoint under <dir>/<model>/
+(resilience.CheckpointManager, corrupt checkpoints skipped), every
+BENCH_CKPT_EVERY steps (default 50) an ASYNC verified checkpoint drains
+in the background, and a final synchronous one lands after the timed
+region, so a long run killed mid-way (relay preemption, deadline) resumes
+instead of restarting.  BENCH_CKPT_KEEP (default 2) bounds rotation.
+Checkpoint cadence rides inside the timed region (async write threads
+share the host), so resumable numbers carry "ckpt_every" in their result
+for attribution; leave BENCH_CKPT_DIR unset for clean measurements.
+
 On backend failure the output is STILL one parseable JSON line:
 {"metric": "error", "error": "backend_unavailable", ...} plus a CPU-smoke
 fallback result measured in a clean subprocess.
@@ -415,6 +426,59 @@ def run_model(model: str, steps: int, peak_flops: float,
                 3 * avg_tokens * (2 * 2 * 16 * 512 * 512 + 2 * 512 * 512)
             )
 
+    # opt-in resumable runs: restore params from the newest valid
+    # checkpoint, then drain async verified checkpoints on a cadence so a
+    # killed long run (relay preemption, driver deadline) resumes from
+    # its last checkpoint instead of from scratch
+    ckpt_mgr = None
+    ckpt_every = 0
+    ckpt_pending = [None]  # the one in-flight async save handle
+
+    def _ckpt_save(step_no, asynchronous):
+        # at most ONE async writer in flight: joining the previous save
+        # first bounds memory (each writer holds a host param snapshot)
+        # and is natural backpressure when the disk is slower than the
+        # cadence; a failed background write is WARNED, not swallowed —
+        # and never kills the timed run.  The join and the new save are
+        # independent failures: a transient error in the PREVIOUS write
+        # must not abort THIS save (the disk may have recovered)
+        if ckpt_pending[0] is not None:
+            try:
+                ckpt_pending[0].wait()
+            except Exception as e:
+                sys.stderr.write(
+                    f"# {model}: async checkpoint write FAILED "
+                    f"({type(e).__name__}: {e}) — run continues, resume "
+                    "point unchanged\n")
+            ckpt_pending[0] = None
+        try:
+            ckpt_pending[0] = ckpt_mgr.save(
+                step_no, asynchronous=asynchronous)
+        except Exception as e:
+            sys.stderr.write(
+                f"# {model}: checkpoint at step {step_no} FAILED "
+                f"({type(e).__name__}: {e}) — run continues, resume "
+                "point unchanged\n")
+
+    ckpt_base = 0
+    if os.environ.get("BENCH_CKPT_DIR") and run_program is None:
+        from paddle_tpu.resilience import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(
+            os.path.join(os.environ["BENCH_CKPT_DIR"], model),
+            keep_last=int(os.environ.get("BENCH_CKPT_KEEP", "2")),
+        )
+        ckpt_every = int(os.environ.get("BENCH_CKPT_EVERY", "50"))
+        restored = ckpt_mgr.restore_or_init()
+        if restored is not None:
+            # resumed runs keep numbering PAST the restored step: saving
+            # from 0 again would sit below the newest valid checkpoint
+            # and be GC'd on arrival (and LATEST would go stale)
+            ckpt_base = restored.step
+            sys.stderr.write(
+                f"# {model}: resumed params from checkpoint "
+                f"step_{restored.step}\n")
+
     # warmup: one pass over EVERY staged batch (variable-length batches
     # each have their own XLA shape) plus one extra step so the
     # committed-state jit variant also compiles before timing starts
@@ -455,10 +519,15 @@ def run_model(model: str, steps: int, peak_flops: float,
         with _maybe_trace(profile_logdir):
             t0 = time.perf_counter()
             loss_v = None
-            for _ in range(steps // unroll):
+            for k in range(steps // unroll):
                 (loss_v,) = exe.run_steps(
                     feed_list=feed_list, fetch_list=[fetch_var],
                     steps=unroll, return_numpy=False, mode=umode)
+                # cadence at dispatch granularity: every ~ckpt_every steps
+                if ckpt_mgr and ckpt_every and (
+                        (k + 1) % max(1, ckpt_every // unroll) == 0):
+                    _ckpt_save(ckpt_base + (k + 1) * unroll,
+                               asynchronous=True)
             jax.block_until_ready(loss_v)
             dt = time.perf_counter() - t0
     else:
@@ -474,8 +543,16 @@ def run_model(model: str, steps: int, peak_flops: float,
             for i in range(steps):
                 (loss_v,) = exe.run(program=run_program, feed=step_feed(i),
                                     fetch_list=[fetch_var], return_numpy=False)
+                if ckpt_mgr and ckpt_every and (i + 1) % ckpt_every == 0:
+                    # async: snapshot now, write in the background
+                    _ckpt_save(ckpt_base + i + 1, asynchronous=True)
             jax.block_until_ready(loss_v)
             dt = time.perf_counter() - t0
+    if ckpt_mgr:
+        # final synchronous checkpoint outside the timed region: the run
+        # is resumable from its end state (joins the in-flight async
+        # writer first, surfacing any background write failure)
+        _ckpt_save(ckpt_base + steps, asynchronous=False)
     if reader is not None:
         reader.reset()
 
@@ -501,6 +578,10 @@ def run_model(model: str, steps: int, peak_flops: float,
         "data": "pyreader" if use_pyreader else "staged",
         "unroll": unroll if use_unroll else 1,
     }
+    if ckpt_mgr:
+        # attribution: async checkpoint writers shared the host with the
+        # timed region, so resumable numbers are labeled as such
+        result["ckpt_every"] = ckpt_every
     if (os.environ.get("BENCH_COST", "0") == "1" and not use_unroll
             and not use_pyreader):
         # XLA cost accounting of the exact compiled step: bytes/step is
@@ -637,8 +718,11 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
     t0 = time.perf_counter()
     # probe the primary too (executor cache makes this nearly free) so the
     # rerun decision compares probe-to-probe, not a 5-step probe against
-    # the full-length run's throughput
-    with _env(prim_env):
+    # the full-length run's throughput.  BENCH_CKPT_DIR="" keeps the
+    # resumable-run cadence out of every short probe: only full timed
+    # runs bank/restore checkpoints, so probe configs never
+    # cross-pollinate params through the checkpoint dir
+    with _env({**prim_env, "BENCH_CKPT_DIR": ""}):
         r0 = run_model(model, probe_steps, peak_flops, amp=primary[0],
                        layout=primary[1])
     probes[_probe_name(primary[0], primary[1], prim_env)] = r0["value"]
@@ -648,7 +732,7 @@ def _tune_and_run(model: str, steps: int, peak_flops: float,
             probes["(budget_exhausted)"] = round(
                 time.perf_counter() - t0, 1)
             break
-        with _env(env_over):
+        with _env({**env_over, "BENCH_CKPT_DIR": ""}):
             r = run_model(model, probe_steps, peak_flops, amp=amp,
                           layout=layout)
         probes[_probe_name(amp, layout, env_over)] = r["value"]

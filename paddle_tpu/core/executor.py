@@ -295,6 +295,14 @@ class Executor:
         self.place = place if place is not None else CPUPlace()
         self.donate_states = donate_states
         self._cache: Dict[Tuple, CompiledBlock] = {}
+        self._sentinel = None  # FLAGS_check_numerics NaNSentinel, lazy
+
+    def _donate_states_now(self) -> bool:
+        # FLAGS_check_numerics skips bad steps by NOT writing state back —
+        # the pre-step buffers must stay alive, so donation is off while
+        # the sentinel is armed (flags.trace_key() carries the flag, so
+        # flipping it lands on a separate compiled entry)
+        return self.donate_states and not flags.flag("check_numerics")
 
     def close(self) -> None:
         self._cache.clear()
@@ -386,6 +394,26 @@ class Executor:
         with jax.default_device(device):
             fetches, new_states, new_rng = compiled(feed_vals, state_vals, rng)
 
+        from ..resilience import faultinject
+
+        fetches = faultinject.nan_fetches(plan.fetch_names, fetches)
+        if flags.flag("check_numerics"):
+            from ..resilience.sentinel import NaNSentinel
+
+            if self._sentinel is None:
+                self._sentinel = NaNSentinel()
+            bad = self._sentinel.first_nonfinite(
+                tuple(plan.fetch_names) + tuple(plan.state_names),
+                tuple(fetches) + tuple(new_states),
+            )
+            if bad is not None:
+                # skip the bad step AMP-loss-scaler style: nothing is
+                # written back, the previous params stay live (donation
+                # is off under this flag); record_trip raises
+                # NonFiniteStepError after N consecutive trips
+                self._sentinel.record_trip(bad)
+                return plan.convert_fetches(fetches, block0, return_numpy)
+            self._sentinel.record_clean()
         plan.write_back(scope, new_states, new_rng)
         _check_nan_inf(plan, fetches, new_states)
         return plan.convert_fetches(fetches, block0, return_numpy)
@@ -413,7 +441,7 @@ class Executor:
                 plan.feed_names,
                 plan.fetch_names,
                 plan.state_names,
-                donate_states=self.donate_states,
+                donate_states=self._donate_states_now(),
             )
             entry = (fp, compiled, plan)
             if use_program_cache:
@@ -563,7 +591,9 @@ class Executor:
         FLAGS_check_nan_inf runs once per CALL here (last step's fetches +
         final state), not once per step as Executor.run does: a transient
         mid-scan nan in a fetched value whose state recovers will not
-        raise.  Debug non-finite trajectories with per-step run().
+        raise.  The FLAGS_check_numerics skip-step sentinel likewise only
+        guards per-step run() — a K-step dispatch cannot un-apply one bad
+        inner step.  Debug non-finite trajectories with per-step run().
         """
         if program is not None and hasattr(program, "with_data_parallel"):
             raise TypeError(
@@ -610,6 +640,10 @@ class Executor:
             fn = jax.jit(
                 scan_multi_fn(compiled.raw_fn, len(feed_list), steps,
                               flat=(mode == "flat")),
+                # plain self.donate_states: the skip-step sentinel never
+                # guards the scan path (see docstring), and its carry
+                # always writes back — keeping pre-step buffers alive
+                # here would double state HBM for zero benefit
                 donate_argnums=(1,) if self.donate_states else (),
             )
             entry = (fp, (compiled, fn), plan)

@@ -114,17 +114,35 @@ def serve_master(service, host: str = "127.0.0.1", port: int = 0):
 
 
 class RemoteMaster:
-    """Client-side MasterService facade — same methods, same exceptions."""
+    """Client-side MasterService facade — same methods, same exceptions.
 
-    def __init__(self, endpoint: str, timeout: float = 120.0):
+    Transient transport failures (master restart, dropped connection,
+    connect refused while the master comes back up) are absorbed by
+    bounded exponential backoff + jitter around each call, reconnecting
+    each attempt — a master restart must not kill workers.  Master-side
+    protocol errors (PassBefore/After, NoMoreAvailable, ...) are NOT
+    retried; they re-raise by name as before.  A retried `get_task` whose
+    response was lost may double-lease a task; the orphaned lease times
+    out and re-queues — the queue's at-least-once contract already
+    covers it."""
+
+    def __init__(self, endpoint: str, timeout: float = 120.0,
+                 max_retries: int = 5, retry_base_delay: float = 0.05,
+                 retry_max_delay: float = 2.0):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
+        self._max_retries = max_retries
+        self._retry_base_delay = retry_base_delay
+        self._retry_max_delay = retry_max_delay
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._rfile = None
 
-    def _call(self, req: dict) -> dict:
+    def _call_once(self, req: dict) -> dict:
+        from ..resilience import faultinject
+
+        faultinject.rpc_drop(req.get("cmd"))  # no-op unless armed
         with self._lock:
             if self._sock is None:
                 self._sock = socket.create_connection(
@@ -147,6 +165,17 @@ class RemoteMaster:
             exc = _ERRORS.get(resp.get("error"), RuntimeError)
             raise exc(resp.get("message", ""))
         return resp
+
+    def _call(self, req: dict) -> dict:
+        from ..resilience.retry import retry_with_backoff
+
+        return retry_with_backoff(
+            lambda: self._call_once(req),
+            retries=self._max_retries,
+            base_delay=self._retry_base_delay,
+            max_delay=self._retry_max_delay,
+            retry_on=(ConnectionError, TimeoutError, OSError),
+        )
 
     def set_dataset(self, globs) -> None:
         self._call({"cmd": "set_dataset", "globs": list(globs)})

@@ -10,21 +10,29 @@ restarted worker recovers the params from the checkpoint and the queue
 from the master's snapshot — the leased task's timeout re-dispatches it.
 That is the whole elasticity contract: add/remove workers freely, each
 one runs this same loop.
+
+Checkpoints are crash-atomic: each one is a fresh verified
+`checkpoint_dir/step_N/` directory written through CheckpointManager
+(manifest digests + write-then-rename LATEST pointer), with the pass
+cursor riding in the manifest's `extra` — params and cursor commit
+together, so a crash mid-save can never leave the cursor pointing at
+half-new params (the old layout overwrote param files in place before
+renaming the meta cursor).  Resume walks newest -> oldest past corrupt
+or torn checkpoints.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import time
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
-from .. import io as fluid_io
 from ..core.framework import (
     Program,
     default_main_program,
     default_startup_program,
 )
+from ..resilience.manager import CheckpointManager
 from .master import (
     AllTasksFailedError,
     MasterService,
@@ -35,8 +43,6 @@ from .master import (
 
 __all__ = ["ElasticTrainer"]
 
-_META = "elastic_meta.json"
-
 
 class ElasticTrainer:
     """Pull tasks, train, checkpoint; resume transparently after a crash.
@@ -46,9 +52,15 @@ class ElasticTrainer:
         executor: a fluid Executor.
         feed_fn: chunk path -> iterable of feed dicts (one per batch).
         fetch_list: vars fetched every step (first is reported as loss).
-        checkpoint_dir: where params + the pass cursor persist.
+        checkpoint_dir: CheckpointManager run dir (params + pass cursor).
         num_passes: total passes over the dataset.
         program / startup_program: default to the global programs.
+        keep_last: checkpoints retained by rotation GC.
+        drain: optional resilience.PreemptionDrain; when its signal fires
+            the trainer finishes the in-flight step, drains an emergency
+            checkpoint, and returns cleanly WITHOUT reporting the leased
+            task done — the lease timeout re-dispatches it (same
+            at-least-once contract as a crash, minus the lost progress).
     """
 
     def __init__(self, master: MasterService, executor, feed_fn: Callable,
@@ -56,7 +68,9 @@ class ElasticTrainer:
                  program: Optional[Program] = None,
                  startup_program: Optional[Program] = None,
                  worker_id: str = "worker-0",
-                 idle_wait: float = 0.05):
+                 idle_wait: float = 0.05,
+                 keep_last: int = 3,
+                 drain=None):
         self.master = master
         self.exe = executor
         self.feed_fn = feed_fn
@@ -67,42 +81,67 @@ class ElasticTrainer:
         self.startup_program = startup_program or default_startup_program()
         self.worker_id = worker_id
         self.idle_wait = idle_wait
+        self.drain = drain
         self.pass_id = 0
         self.tasks_done = 0
         self.last_loss: Optional[float] = None
+        self.ckpt = CheckpointManager(
+            checkpoint_dir, keep_last=keep_last, program=self.program
+        )
+        # save-sequence counter, distinct from tasks_done: every save —
+        # including a preemption drain arriving MID-task, after the last
+        # completed task's checkpoint — gets a FRESH step dir, so the
+        # previous valid checkpoint stays intact until the new one is
+        # durable (a kill during the drain write must not tear it)
+        self._ckpt_seq = 0
 
     # -- persistence ---------------------------------------------------
-    def _meta_path(self) -> str:
-        return os.path.join(self.ckpt_dir, _META)
-
     def _checkpoint(self) -> None:
-        fluid_io.save_persistables(self.exe, self.ckpt_dir,
-                                   main_program=self.program)
-        tmp = self._meta_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"pass_id": self.pass_id,
-                       "tasks_done": self.tasks_done}, f)
-        os.replace(tmp, self._meta_path())
+        # params AND the pass cursor commit in one verified checkpoint
+        # (crash-atomic: a new step_N dir, LATEST flipped last)
+        self._ckpt_seq += 1
+        self.ckpt.save(
+            self._ckpt_seq,
+            extra={"pass_id": self.pass_id, "tasks_done": self.tasks_done},
+        )
 
     def _resume(self) -> bool:
-        if not os.path.exists(self._meta_path()):
+        res = self.ckpt.restore_or_init()
+        if res is None:
+            legacy = os.path.join(self.ckpt_dir, "elastic_meta.json")
+            if os.path.exists(legacy):
+                # a pre-resilience flat checkpoint (save_persistables
+                # files + meta cursor): refusing beats silently
+                # re-initializing trained params from scratch
+                raise RuntimeError(
+                    f"{self.ckpt_dir}: found a legacy flat checkpoint "
+                    "(elastic_meta.json); this layout is no longer read. "
+                    "Recover it explicitly with io.load_persistables + "
+                    "the cursor in elastic_meta.json, or point the "
+                    "trainer at a fresh checkpoint_dir."
+                )
             return False
-        with open(self._meta_path()) as f:
-            meta = json.load(f)
-        fluid_io.load_persistables(self.exe, self.ckpt_dir,
-                                   main_program=self.program)
-        self.pass_id = int(meta["pass_id"])
-        self.tasks_done = int(meta.get("tasks_done", 0))
+        extra = res.extra or {}
+        self.pass_id = int(extra.get("pass_id", 0))
+        self.tasks_done = int(extra.get("tasks_done", res.step))
+        self._ckpt_seq = res.step
         return True
+
+    def _drain_requested(self) -> bool:
+        return self.drain is not None and self.drain.requested
 
     # -- the loop ------------------------------------------------------
     def train(self) -> None:
         """Run until num_passes complete.  Safe to call on a fresh
         process after a crash: params and the pass cursor come back from
-        the checkpoint, unfinished work from the master's lease expiry."""
+        the newest VALID checkpoint (corrupt ones are skipped), unfinished
+        work from the master's lease expiry."""
         if not self._resume():
             self.exe.run(self.startup_program)
         while self.pass_id < self.num_passes:
+            if self._drain_requested():
+                self._checkpoint()
+                return
             try:
                 task = self.master.get_task(self.pass_id)
             except PassBeforeError:
@@ -129,6 +168,7 @@ class ElasticTrainer:
                     f"pass {self.pass_id}: every task failed "
                     f"{self.master.failure_max}+ times; giving up"
                 )
+            draining = False
             try:
                 for chunk in task.chunks:
                     for feed in self.feed_fn(chunk):
@@ -142,11 +182,29 @@ class ElasticTrainer:
                             self.last_loss = float(
                                 np.ravel(np.asarray(vals[0]))[0]
                             )
+                        if self._drain_requested():
+                            # preemption notice: the in-flight step just
+                            # finished; stop HERE and checkpoint below
+                            draining = True
+                            break
+                    if draining:
+                        break
             except Exception:
                 # report and surface: the master re-queues immediately
-                # instead of waiting for the lease to expire
+                # instead of waiting for the lease to expire.  This also
+                # covers the FLAGS_check_numerics NonFiniteStepError —
+                # the checkpoint below never runs, so the poisoned task's
+                # params (which the sentinel never wrote back anyway) are
+                # not published; the lease machinery re-dispatches.
                 self.master.task_failed(task.id, task.epoch)
                 raise
+            if draining:
+                # emergency checkpoint WITHOUT task_finished: the task's
+                # lease expires and a surviving worker re-runs it
+                # (at-least-once); params/cursor persist so the restart
+                # is cheap
+                self._checkpoint()
+                return
             # checkpoint BEFORE reporting: a crash between the two means the
             # lease expires and the task re-runs (at-least-once); the other
             # order would mark it done with its updates lost

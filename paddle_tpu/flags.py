@@ -27,6 +27,21 @@ _DEFS: Dict[str, Any] = {
     # debugging
     "FLAGS_check_nan_inf": False,
     "FLAGS_benchmark": False,
+    # resilience: NaN/Inf step sentinel (resilience/sentinel.py).  Where
+    # FLAGS_check_nan_inf raises the moment a non-finite value appears
+    # (post-write-back, debugging), check_numerics implements the
+    # AMP-loss-scaler recovery contract in Executor.run: the offending
+    # step is SKIPPED (persistable state is not written back — previous
+    # params stay live), consecutive trips are counted, and after
+    # check_numerics_max_consecutive trips the executor raises
+    # NonFiniteStepError naming the first offending fetch/var of the
+    # streak.  ElasticTrainer lets that raise report the task failed, so
+    # the lease machinery re-dispatches it instead of publishing poisoned
+    # params.  Turning it on disables state-buffer donation for affected
+    # programs (a skipped step must keep the pre-step params alive) and
+    # costs one scalar device sync per step for the jitted finite scan.
+    "FLAGS_check_numerics": False,
+    "FLAGS_check_numerics_max_consecutive": 3,
     # determinism
     "FLAGS_cpu_deterministic": False,
     # accepted for reference-script compatibility; memory/threads are
@@ -197,7 +212,12 @@ def trace_key() -> tuple:
     a stale executable."""
     return (conv_layout(), _VALUES["FLAGS_flash_bwd"],
             _VALUES["FLAGS_conv_epilogue"],
-            _VALUES["FLAGS_fuse_conv_epilogue"])
+            _VALUES["FLAGS_fuse_conv_epilogue"],
+            # not trace-affecting, but executable-affecting: the sentinel
+            # turns state-buffer donation off, so a flag flip must land on
+            # a different compiled entry instead of reusing one whose
+            # donated inputs a skipped step would have to keep alive
+            _VALUES["FLAGS_check_numerics"])
 
 
 def set_flags(flags: Dict[str, Any]) -> None:

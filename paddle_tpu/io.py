@@ -34,7 +34,17 @@ __all__ = [
     "save_inference_model", "load_inference_model",
     "get_inference_program",
     "save_sharded", "load_sharded", "AsyncCheckpoint",
+    "CheckpointCorruptError",
 ]
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A sharded checkpoint failed verification: a shard file is missing,
+    truncated, or digest-mismatched, or a tensor is not fully covered by
+    the index.  The message names the offending file/variable.  Raised
+    instead of ever loading garbage (the pre-manifest loader silently
+    zero-filled missing shards); CheckpointManager.restore_or_init walks
+    past checkpoints that raise this."""
 
 
 def is_persistable(var: Variable) -> bool:
@@ -327,19 +337,49 @@ def _ensure_save_atexit():
 class AsyncCheckpoint:
     """Handle for an in-flight save_sharded(asynchronous=True) write.  The
     device->host snapshot happened before the call returned; wait() joins
-    the disk write and re-raises any IO error."""
+    the disk write and re-raises any IO error.  With no thread the handle
+    is pre-completed (`AsyncCheckpoint.completed()`) — the multi-process
+    fallback writes synchronously and hands one back so caller code stays
+    uniform across scales."""
 
-    def __init__(self, thread, exc_box):
+    def __init__(self, thread=None, exc_box=None):
         self._thread = thread
-        self._exc_box = exc_box
+        self._exc_box = exc_box if exc_box is not None else []
+
+    @classmethod
+    def completed(cls) -> "AsyncCheckpoint":
+        return cls()
 
     def done(self) -> bool:
-        return not self._thread.is_alive()
+        return self._thread is None or not self._thread.is_alive()
 
     def wait(self) -> None:
-        self._thread.join()
+        if self._thread is not None:
+            self._thread.join()
         if self._exc_box:
             raise self._exc_box[0]
+
+
+def _file_digest(path: str):
+    """(byte size, crc32) of a file, streamed."""
+    import zlib
+
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return size, crc
+
+
+def _checkpoint_barrier(tag: str) -> None:
+    from .parallel.multihost import checkpoint_barrier
+
+    checkpoint_barrier(tag)
 
 
 def save_sharded(
@@ -348,6 +388,8 @@ def save_sharded(
     scope=None,
     predicate: Optional[Callable] = None,
     asynchronous: bool = False,
+    step: Optional[int] = None,
+    extra: Optional[dict] = None,
 ):
     """Per-process sharded checkpoint (reference analogue: the per-pserver
     parameter slices of distribute_transpiler.py:990; modern shape:
@@ -360,12 +402,21 @@ def save_sharded(
     tensor.  Works identically for single-process runs (every shard is
     addressable).
 
+    meta.json also carries a verification manifest under "__manifest__":
+    the expected process count and shard-file list with per-file byte
+    sizes + CRC32 digests, the global `step`, wall time, and the caller's
+    `extra` metadata dict (CheckpointManager stores its cursor there).
+    load_sharded verifies all of it — a truncated/corrupt/missing shard
+    raises CheckpointCorruptError instead of loading garbage.  Because
+    meta.json is written LAST (after the all-shards-durable barrier,
+    write-then-rename), its presence marks the checkpoint complete.
+
     asynchronous=True snapshots device state to host synchronously, then
     writes the files on a background thread and returns an AsyncCheckpoint
     — training continues (and may donate/overwrite the live buffers)
-    while the checkpoint persists.  Multi-process runs ignore the flag
-    and write synchronously: the completion barrier is a collective,
-    which must not run off the main thread."""
+    while the checkpoint persists.  Multi-process runs write synchronously
+    (the completion barrier is a collective, which must not run off the
+    main thread) and return a pre-completed handle."""
     import jax
 
     main_program = main_program or default_main_program()
@@ -440,13 +491,40 @@ def save_sharded(
         else:
             blobs[f"{n}@@0"] = _snap(arr)
             index[f"{n}@@0"] = {"var": n, "index": None}
+    proc_count = jax.process_count()
+
     def _write():
-        np.savez(os.path.join(dirname, f"shard_{pid}.npz"), **blobs)
+        from .resilience import faultinject
+
+        shard_path = os.path.join(dirname, f"shard_{pid}.npz")
+        np.savez(shard_path, **blobs)
+        faultinject.shard_write_kill(shard_path)  # no-op unless armed
         with open(os.path.join(dirname, f"index_{pid}.json"), "w") as f:
             json.dump(index, f)
 
     def _finish():
         if pid == 0:
+            # manifest: every process's shard files sized + digested, so
+            # the loader can prove completeness and integrity before a
+            # single byte lands in the scope.  All shard files are
+            # durable at this point (single writer, or post-barrier).
+            import time as _time
+
+            files = {}
+            for p in range(proc_count):
+                for fn in (f"shard_{p}.npz", f"index_{p}.json"):
+                    size, crc = _file_digest(os.path.join(dirname, fn))
+                    files[fn] = {"bytes": size, "crc32": crc}
+            manifest = {
+                "version": 1,
+                "process_count": proc_count,
+                "step": None if step is None else int(step),
+                "wall_time": _time.time(),
+                "files": files,
+            }
+            if extra is not None:
+                manifest["extra"] = extra
+            meta["__manifest__"] = manifest
             # write-then-rename: a crashed/killed writer never leaves a
             # meta.json marking a truncated checkpoint complete (and an
             # overwritten dir's STALE meta.json is replaced atomically)
@@ -454,6 +532,9 @@ def save_sharded(
             with open(tmp, "w") as f:
                 json.dump(meta, f)
             os.replace(tmp, os.path.join(dirname, "meta.json"))
+        from .resilience import faultinject
+
+        faultinject.maybe_corrupt_after_save(dirname)  # chaos hook
 
     if asynchronous and jax.process_count() == 1:
         import threading
@@ -483,24 +564,60 @@ def save_sharded(
         t.start()
         return AsyncCheckpoint(t, exc_box)
 
+    if pid == 0:
+        # overwriting an EXISTING checkpoint (e.g. a preemption drain
+        # re-saving the current step): invalidate it first — a kill
+        # mid-rewrite must leave "no meta.json" (skipped by restore), not
+        # the old manifest's digests over half-new shards masquerading as
+        # the old checkpoint (the async path below does the same)
+        try:
+            os.remove(os.path.join(dirname, "meta.json"))
+        except FileNotFoundError:
+            pass
     _write()
-    if jax.process_count() > 1:
-        # all shard files durable before meta.json marks the checkpoint
-        # complete (and before any process returns to its caller)
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("save_sharded")
+    # all shard files durable before meta.json marks the checkpoint
+    # complete (and before any process returns to its caller); no-op for
+    # single-process runs
+    _checkpoint_barrier("save_sharded")
     _finish()
     if asynchronous:
         # multi-process fallback wrote synchronously; hand back a
-        # completed handle so caller code stays uniform across scales
-        import threading
-
-        t = threading.Thread(target=lambda: None)
-        t.start()
-        t.join()
-        return AsyncCheckpoint(t, [])
+        # pre-completed handle so caller code stays uniform across scales
+        return AsyncCheckpoint.completed()
     return None
+
+
+def _verify_manifest(dirname: str, manifest: dict) -> List[str]:
+    """Check every manifest-listed file exists with the recorded byte
+    size and CRC32 digest; return the index-file list to assemble from.
+    Reading ONLY manifest-listed files also keeps stale shards from an
+    older save in the same directory out of the assembly."""
+    files = manifest.get("files", {})
+    for fn in sorted(files):
+        want = files[fn]
+        path = os.path.join(dirname, fn)
+        if not os.path.exists(path):
+            raise CheckpointCorruptError(
+                f"{path}: shard file missing (manifest expects "
+                f"{len(files)} files from "
+                f"{manifest.get('process_count')} processes)"
+            )
+        size = os.path.getsize(path)
+        if size != want["bytes"]:
+            raise CheckpointCorruptError(
+                f"{path}: truncated or overgrown ({size} bytes on disk, "
+                f"manifest recorded {want['bytes']})"
+            )
+        # streamed CRC (1 MB chunks): O(1 MB) extra memory even for
+        # pod-scale shards; np.load's subsequent read of the same file
+        # is page-cache warm, so the second pass is cheap
+        _, crc = _file_digest(path)
+        if crc != want["crc32"]:
+            raise CheckpointCorruptError(
+                f"{path}: digest mismatch (crc32 {crc:#010x} on disk, "
+                f"manifest recorded {want['crc32']:#010x})"
+            )
+    return sorted(fn for fn in files if fn.startswith("index_"))
 
 
 def load_sharded(
@@ -509,17 +626,47 @@ def load_sharded(
     scope=None,
     mesh=None,
     predicate: Optional[Callable] = None,
-) -> None:
+) -> Optional[dict]:
     """Restore a save_sharded checkpoint.  Every process reads all shard
     files (shared filesystem, as the reference's pserver checkpoints
     assume), reassembles each var, and — when `mesh` is given — places it
     sharded again via jax.device_put so no full copy stays live per device.
-    With main_program=None every var recorded in the checkpoint loads."""
+    With main_program=None every var recorded in the checkpoint loads.
+
+    Verification happens BEFORE anything lands in the scope: every
+    manifest-listed shard file must exist with the recorded size + CRC32
+    digest, and every tensor the checkpoint claims must be fully covered
+    by index slices — a missing, truncated, or corrupt shard raises
+    CheckpointCorruptError naming the offending file instead of silently
+    zero-filling (the pre-manifest behavior this replaces).  Checkpoints
+    written before the manifest existed still get the coverage check.
+
+    Returns the checkpoint's manifest dict (step / wall_time / extra
+    metadata), or None for a pre-manifest checkpoint."""
     import jax
+    import zipfile
+    import zlib
 
     scope = scope or global_scope()
-    with open(os.path.join(dirname, "meta.json")) as f:
-        meta = json.load(f)
+    meta_path = os.path.join(dirname, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{meta_path}: missing — the checkpoint never completed "
+            "(meta.json is written last)"
+        )
+    except ValueError as e:
+        raise CheckpointCorruptError(f"{meta_path}: unreadable ({e})")
+    manifest = meta.pop("__manifest__", None)
+    if manifest is not None:
+        index_files = _verify_manifest(dirname, manifest)
+    else:
+        index_files = sorted(
+            fn for fn in os.listdir(dirname)
+            if fn.startswith("index_") and fn.endswith(".json")
+        )
 
     if main_program is None:
         wanted = set(meta)
@@ -532,30 +679,69 @@ def load_sharded(
         }
 
     assembled = {}
-    for fn in sorted(os.listdir(dirname)):
-        if not fn.startswith("index_"):
-            continue
+    covered = {}  # var -> True (full) | bool mask of covered elements
+    for fn in index_files:
         pid = fn[len("index_"):-len(".json")]
-        with open(os.path.join(dirname, fn)) as f:
-            index = json.load(f)
-        with np.load(os.path.join(dirname, f"shard_{pid}.npz")) as z:
-            for slot, entry in index.items():
-                n = entry["var"]
-                if n not in wanted or n not in meta:
-                    continue
-                buf = assembled.get(n)
-                if buf is None:
-                    buf = np.zeros(
-                        meta[n]["shape"], dtype=meta[n]["dtype"]
-                    )
-                    assembled[n] = buf
-                if entry["index"] is None:
-                    assembled[n] = z[slot]
-                else:
-                    sl = tuple(
-                        slice(s[0], s[1], s[2]) for s in entry["index"]
-                    )
-                    buf[sl] = z[slot]
+        shard_fn = f"shard_{pid}.npz"
+        try:
+            with open(os.path.join(dirname, fn)) as f:
+                index = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{os.path.join(dirname, fn)}: unreadable index ({e})"
+            )
+        try:
+            with np.load(os.path.join(dirname, shard_fn)) as z:
+                for slot, entry in index.items():
+                    n = entry["var"]
+                    if n not in wanted or n not in meta:
+                        continue
+                    buf = assembled.get(n)
+                    if buf is None:
+                        buf = np.zeros(
+                            meta[n]["shape"], dtype=meta[n]["dtype"]
+                        )
+                        assembled[n] = buf
+                    if entry["index"] is None:
+                        assembled[n] = z[slot]
+                        covered[n] = True
+                    else:
+                        sl = tuple(
+                            slice(s[0], s[1], s[2]) for s in entry["index"]
+                        )
+                        buf[sl] = z[slot]
+                        if covered.get(n) is not True:
+                            mask = covered.get(n)
+                            if mask is None:
+                                mask = np.zeros(
+                                    meta[n]["shape"], dtype=bool
+                                )
+                                covered[n] = mask
+                            mask[sl] = True
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile,
+                zlib.error) as e:
+            raise CheckpointCorruptError(
+                f"{os.path.join(dirname, shard_fn)}: unreadable shard "
+                f"({type(e).__name__}: {e})"
+            )
+
+    # full-coverage assertion: every tensor the checkpoint CLAIMS (is in
+    # meta) and the caller wants must be entirely written by some shard —
+    # no silent zero-fill of absent/partial shards, ever
+    for n in sorted(set(meta) & wanted):
+        cov = covered.get(n)
+        if cov is None:
+            raise CheckpointCorruptError(
+                f"{dirname}: no shard covers variable '{n}' "
+                "(its index entries are missing entirely)"
+            )
+        if cov is not True and not cov.all():
+            missing = int(cov.size - np.count_nonzero(cov))
+            raise CheckpointCorruptError(
+                f"{dirname}: variable '{n}' is only partially covered by "
+                f"the shard index ({missing} of {cov.size} elements have "
+                "no shard)"
+            )
 
     block0 = (
         main_program.desc.block(0) if main_program is not None else None
@@ -570,3 +756,11 @@ def load_sharded(
             scope.set_var(n, jax.device_put(arr, sharding))
         else:
             scope.set_var(n, arr)
+    # deliberately NO collective barrier here: a process that raises
+    # CheckpointCorruptError (local read error, torn NFS view) would
+    # strand the others in the collective forever, and independent
+    # newest->oldest walks (restore_or_init) could pair barriers from
+    # DIFFERENT checkpoints — silently loading divergent params.
+    # Multi-host restore agreement is the caller's job: pick the
+    # checkpoint once (e.g. process 0 broadcasts the step), then load.
+    return manifest

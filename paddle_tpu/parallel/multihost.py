@@ -19,7 +19,22 @@ import numpy as np
 
 from ..core.lod import LoDValue
 
-__all__ = ["is_multiprocess", "global_feed_value"]
+__all__ = ["is_multiprocess", "global_feed_value", "checkpoint_barrier"]
+
+
+def checkpoint_barrier(tag: str) -> None:
+    """Pod-wide sync point for checkpoint manifests: on save, every
+    process's shard files must be durable before process 0's meta.json
+    (whose manifest digests them all) marks the checkpoint complete; on
+    load, every process must pass verification before any starts training
+    on the restored params.  No-op for single-process runs, so io.py can
+    call it unconditionally."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
 
 
 def is_multiprocess(mesh) -> bool:

@@ -1,0 +1,44 @@
+"""Resilience layer: survive-anything spine for pod-scale training.
+
+Pieces (each usable alone, wired together by io/executor/elastic/bench):
+
+- **Verified checkpoints** — io.save_sharded writes a manifest (per-shard
+  byte size + CRC32, process count, step, wall time) into meta.json;
+  io.load_sharded verifies digests and full index coverage of every
+  tensor and raises CheckpointCorruptError naming the offending file —
+  a truncated or corrupt shard can never load silently.
+- **CheckpointManager** (manager.py) — step_N/ rotation under a run dir,
+  keep-last-K GC that never deletes the newest valid checkpoint, a
+  crash-safe LATEST pointer, and restore_or_init() auto-resume that
+  walks newest -> oldest past corrupt checkpoints.
+- **NaNSentinel** (sentinel.py) — FLAGS_check_numerics: skip non-finite
+  steps AMP-loss-scaler style, raise NonFiniteStepError after N
+  consecutive trips with the first offending var named.
+- **PreemptionDrain** (preempt.py) — SIGTERM/SIGINT -> finish the
+  in-flight step, drain an emergency checkpoint, exit cleanly.
+- **retry_with_backoff** (retry.py) — bounded exponential backoff +
+  jitter; elastic/rpc.py wraps every master call in it so a master
+  restart doesn't kill workers.
+- **faultinject** — deterministic env-driven fault hooks
+  (FAULT_CKPT_KILL_AFTER_BYTES, FAULT_CKPT_CORRUPT_SHARD,
+  FAULT_RPC_DROP_ONCE, FAULT_NAN_AT_STEP) behind every failure mode the
+  chaos suite (tests/test_resilience.py) proves recoverable.
+"""
+
+from ..io import AsyncCheckpoint, CheckpointCorruptError  # noqa: F401
+from . import faultinject  # noqa: F401
+from .manager import CheckpointManager, RestoreResult
+from .preempt import PreemptionDrain
+from .retry import retry_with_backoff
+from .sentinel import NaNSentinel, NonFiniteStepError
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointManager",
+    "RestoreResult",
+    "NaNSentinel",
+    "NonFiniteStepError",
+    "PreemptionDrain",
+    "retry_with_backoff",
+    "faultinject",
+]

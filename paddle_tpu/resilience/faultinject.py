@@ -1,0 +1,122 @@
+"""Deterministic fault-injection hooks for the resilience chaos suite.
+
+Every hook is a no-op unless its `FAULT_*` env var is set, so production
+paths pay one dict lookup per call.  Hooks that model one-shot faults
+(process kill, dropped RPC) fire exactly once per process and record
+themselves in `fired`, which tests inspect; `reset()` re-arms everything.
+
+Knobs (all env-driven so subprocess chaos tests can arm them):
+    FAULT_CKPT_KILL_AFTER_BYTES=<n>   during a sharded-checkpoint write,
+        truncate the shard file to n bytes and os._exit(43) — models a
+        preempted/killed writer leaving a torn file and no manifest.
+    FAULT_CKPT_CORRUPT_SHARD=1        after a sharded save completes,
+        flip one byte in the middle of the first shard file — models
+        silent media/transfer corruption under an intact manifest.
+    FAULT_RPC_DROP_ONCE=<cmd>|*       RemoteMaster raises ConnectionError
+        once for the named command (or any command with "*") — models a
+        master restart / transient network drop; the client's backoff
+        retry must absorb it.
+    FAULT_NAN_AT_STEP=<k>|<k>+        Executor.run replaces its first
+        float fetch with NaN at step k (0-based, counted per process
+        while armed); "k+" injects at every step from k on — drives the
+        FLAGS_check_numerics sentinel without poisoning real data.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+__all__ = [
+    "reset", "fired", "shard_write_kill", "corrupt_shard",
+    "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
+]
+
+fired: set = set()
+_nan_step = [0]
+
+
+def reset() -> None:
+    """Re-arm every one-shot hook and zero the step counter (tests)."""
+    fired.clear()
+    _nan_step[0] = 0
+
+
+def shard_write_kill(path: str) -> None:
+    """FAULT_CKPT_KILL_AFTER_BYTES: torn-write + process death, once."""
+    raw = os.environ.get("FAULT_CKPT_KILL_AFTER_BYTES")
+    if not raw or "ckpt_kill" in fired:
+        return
+    fired.add("ckpt_kill")
+    with open(path, "r+b") as f:
+        f.truncate(int(raw))
+    os._exit(43)  # no atexit/finally: a SIGKILL'd writer runs nothing
+
+
+def corrupt_shard(dirname: str, filename: Optional[str] = None) -> str:
+    """Flip one byte in the middle of a shard file; returns the path.
+    Direct test helper (also the FAULT_CKPT_CORRUPT_SHARD payload)."""
+    if filename is None:
+        shards = sorted(
+            fn for fn in os.listdir(dirname) if fn.startswith("shard_")
+        )
+        if not shards:
+            raise FileNotFoundError(f"no shard files under {dirname}")
+        filename = shards[0]
+    path = os.path.join(dirname, filename)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+def maybe_corrupt_after_save(dirname: str) -> None:
+    """FAULT_CKPT_CORRUPT_SHARD: corrupt one shard post-save, once."""
+    if not os.environ.get("FAULT_CKPT_CORRUPT_SHARD"):
+        return
+    if "ckpt_corrupt" in fired:
+        return
+    fired.add("ckpt_corrupt")
+    corrupt_shard(dirname)
+
+
+def rpc_drop(cmd: Optional[str]) -> None:
+    """FAULT_RPC_DROP_ONCE: one transient ConnectionError for `cmd`."""
+    spec = os.environ.get("FAULT_RPC_DROP_ONCE")
+    if not spec or "rpc_drop" in fired:
+        return
+    if spec != "*" and spec != cmd:
+        return
+    fired.add("rpc_drop")
+    raise ConnectionError(f"faultinject: dropped rpc {cmd!r}")
+
+
+def nan_fetches(fetch_names: Sequence[str], fetches: tuple) -> tuple:
+    """FAULT_NAN_AT_STEP: poison the first float fetch at the armed
+    step(s).  The step counter only advances while the knob is set, so
+    tests count from the moment they arm it."""
+    spec = os.environ.get("FAULT_NAN_AT_STEP")
+    if not spec or not fetches:
+        return fetches
+    step = _nan_step[0]
+    _nan_step[0] += 1
+    if spec.endswith("+"):
+        hit = step >= int(spec[:-1])
+    else:
+        hit = step == int(spec)
+    if not hit:
+        return fetches
+    import numpy as np
+
+    out = list(fetches)
+    for i, v in enumerate(out):
+        if v is None:
+            continue
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[i] = np.full(arr.shape, np.nan, dtype=arr.dtype)
+            break
+    return tuple(out)

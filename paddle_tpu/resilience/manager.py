@@ -1,0 +1,213 @@
+"""CheckpointManager: rotation, crash-safe LATEST pointer, auto-resume.
+
+Layout under one run directory:
+
+    run_dir/
+        step_100/   shard_*.npz index_*.json meta.json   (save_sharded)
+        step_200/   ...
+        LATEST      json {"step": 200, "dir": "step_200"} (write-then-rename)
+
+Every checkpoint is a verified save_sharded directory (manifest digests in
+meta.json — io.py); a checkpoint without its meta.json is by definition
+incomplete, because meta.json is the LAST file written.  `restore_or_init`
+walks newest -> oldest past corrupt/incomplete checkpoints, so a writer
+killed mid-save (or a shard corrupted at rest) silently costs one
+checkpoint of progress instead of a poisoned resume.  GC keeps the last
+`keep_last` VALID checkpoints and never deletes the newest valid one —
+even `keep_last=1` with a torn newer directory leaves the good one alone.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from .. import io as fluid_io
+
+__all__ = ["CheckpointManager", "RestoreResult"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_LATEST = "LATEST"
+_log = logging.getLogger("paddle_tpu")
+
+
+@dataclass
+class RestoreResult:
+    """What restore_or_init recovered: the step, its directory, and the
+    caller metadata dict the save stored in the manifest (or None)."""
+
+    step: int
+    path: str
+    extra: Optional[dict]
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        run_dir: str,
+        keep_last: int = 3,
+        program=None,
+        scope=None,
+        mesh=None,
+    ):
+        self.run_dir = run_dir
+        self.keep_last = max(1, int(keep_last))
+        self.program = program
+        self.scope = scope
+        self.mesh = mesh
+        os.makedirs(run_dir, exist_ok=True)
+
+    # -- layout --------------------------------------------------------
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.run_dir, f"step_{int(step)}")
+
+    def _step_dirs(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            entries = os.listdir(self.run_dir)
+        except FileNotFoundError:
+            return out
+        for fn in entries:
+            m = _STEP_RE.match(fn)
+            path = os.path.join(self.run_dir, fn)
+            if m and os.path.isdir(path):
+                out.append((int(m.group(1)), path))
+        return sorted(out)
+
+    def valid_steps(self) -> List[int]:
+        """Steps whose checkpoint completed (meta.json is written last)."""
+        return [
+            s for s, p in self._step_dirs()
+            if os.path.exists(os.path.join(p, "meta.json"))
+        ]
+
+    def latest_step(self) -> Optional[int]:
+        """The LATEST pointer's step, falling back to a directory scan
+        (the pointer is a hint — a crash between save and pointer flip
+        leaves a valid checkpoint the scan still finds)."""
+        try:
+            with open(os.path.join(self.run_dir, _LATEST)) as f:
+                step = int(json.load(f)["step"])
+            if os.path.exists(os.path.join(self.step_dir(step), "meta.json")):
+                return step
+        except (OSError, ValueError, KeyError):
+            pass
+        valid = self.valid_steps()
+        return valid[-1] if valid else None
+
+    # -- save ----------------------------------------------------------
+    def save(
+        self,
+        step: int,
+        extra: Optional[dict] = None,
+        asynchronous: bool = False,
+        program=None,
+        scope=None,
+    ):
+        """Checkpoint into step_<step>/; on completion flip LATEST
+        (write-then-rename) and GC old checkpoints.  asynchronous=True
+        returns an AsyncCheckpoint whose wait() covers the shard write
+        AND the pointer flip + GC — the pointer never names a checkpoint
+        that is still being written."""
+        d = self.step_dir(step)
+        handle = fluid_io.save_sharded(
+            d,
+            program if program is not None else self.program,
+            scope if scope is not None else self.scope,
+            asynchronous=asynchronous,
+            step=int(step),
+            extra=extra,
+        )
+        if handle is not None:
+            exc_box: list = []
+
+            def _bg():
+                try:
+                    handle.wait()
+                    self._finalize(step)
+                except BaseException as e:  # surfaced by wait()
+                    exc_box.append(e)
+
+            t = threading.Thread(
+                target=_bg, name=f"ckpt_finalize_{step}", daemon=True
+            )
+            t.start()
+            return fluid_io.AsyncCheckpoint(t, exc_box)
+        self._finalize(step)
+        return None
+
+    def _finalize(self, step: int) -> None:
+        import jax
+
+        if jax.process_index() != 0:
+            return  # pointer + GC are single-writer concerns
+        tmp = os.path.join(self.run_dir, "." + _LATEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "dir": f"step_{int(step)}"}, f)
+        os.replace(tmp, os.path.join(self.run_dir, _LATEST))
+        self.gc()
+
+    def gc(self) -> None:
+        """Keep the newest `keep_last` valid checkpoints; drop everything
+        older (incomplete directories included).  Directories NEWER than
+        the newest valid one are left alone — they may be mid-write."""
+        dirs = self._step_dirs()
+        valid = [
+            s for s, p in dirs
+            if os.path.exists(os.path.join(p, "meta.json"))
+        ]
+        if not valid:
+            return
+        newest_valid = valid[-1]
+        keep = set(valid[-self.keep_last:]) | {newest_valid}
+        for s, p in dirs:
+            if s in keep or s > newest_valid:
+                continue
+            shutil.rmtree(p, ignore_errors=True)
+
+    # -- restore -------------------------------------------------------
+    def restore_or_init(
+        self,
+        init_fn: Optional[Callable[[], None]] = None,
+        program=None,
+        scope=None,
+        mesh=None,
+    ) -> Optional[RestoreResult]:
+        """Walk checkpoints newest -> oldest; the first one that loads AND
+        verifies (digests + full index coverage, io.load_sharded) wins.
+        Corrupt/incomplete ones are logged and skipped.  With nothing
+        restorable, call init_fn (e.g. run the startup program) and
+        return None.
+
+        The directory scan deliberately does NOT short-cut through the
+        LATEST pointer: a crash between a save completing and the pointer
+        flip leaves a valid checkpoint NEWER than the pointer, and the
+        scan (ordered by step, validity proven by the load itself)
+        subsumes everything the pointer knows.  LATEST exists for
+        operators and external tooling — `latest_step()` — not for the
+        restore path."""
+        for step, path in reversed(self._step_dirs()):
+            try:
+                manifest = fluid_io.load_sharded(
+                    path,
+                    program if program is not None else self.program,
+                    scope if scope is not None else self.scope,
+                    mesh=mesh if mesh is not None else self.mesh,
+                )
+            except (fluid_io.CheckpointCorruptError, OSError) as e:
+                _log.warning(
+                    "restore_or_init: skipping unusable checkpoint %s (%s)",
+                    path, e,
+                )
+                continue
+            extra = (manifest or {}).get("extra")
+            return RestoreResult(step=step, path=path, extra=extra)
+        if init_fn is not None:
+            init_fn()
+        return None

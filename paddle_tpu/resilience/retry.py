@@ -1,0 +1,47 @@
+"""Bounded exponential backoff with jitter for transient failures.
+
+The elastic RPC client wraps every call in this (elastic/rpc.py): a master
+restart or dropped connection costs a few retries instead of killing the
+worker — the reference's Go trainers get the same from net/rpc reconnects
+plus etcd watch re-registration."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["retry_with_backoff"]
+
+
+def retry_with_backoff(
+    fn: Callable,
+    retries: int = 5,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    retry_on: Tuple[Type[BaseException], ...] = (
+        ConnectionError, TimeoutError, OSError,
+    ),
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable] = None,
+):
+    """Call `fn()`; on an exception in `retry_on` sleep
+    min(max_delay, base_delay * 2**attempt) * (1 + U[0, jitter]) and try
+    again, up to `retries` extra attempts, then re-raise.  The jitter
+    de-synchronizes a worker fleet all retrying the same restarted master
+    (thundering-herd).  `on_retry(attempt, exc, delay)` observes each
+    retry (logging/tests); `sleep` is injectable for fast tests."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay *= 1.0 + random.uniform(0.0, jitter)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)

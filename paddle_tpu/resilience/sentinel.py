@@ -1,0 +1,112 @@
+"""NaN/Inf step sentinel (FLAGS_check_numerics).
+
+Unlike FLAGS_check_nan_inf — which raises the moment a non-finite value
+appears — the sentinel implements the AMP-loss-scaler recovery contract:
+the offending step is SKIPPED (persistable state is not written back, so
+the previous params stay live), consecutive trips are counted, and only
+after FLAGS_check_numerics_max_consecutive trips does the executor raise
+NonFiniteStepError naming the first offending fetch/var of the streak.
+A single bad batch (or an injected fault) costs one step; a genuinely
+diverged model still fails fast with a named culprit.
+
+The scan itself is one jitted all-finite reduction over every float
+fetch/state leaf — one scalar device sync per step, no per-op host
+round-trips (the reference's per-op check_nan_inf, operator.cc:777, would
+force a sync between every op)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+__all__ = ["NaNSentinel", "NonFiniteStepError"]
+
+
+class NonFiniteStepError(RuntimeError):
+    """Raised after N consecutive non-finite steps; `var_name` is the
+    first offending fetch/variable of the streak."""
+
+    def __init__(self, var_name: str, consecutive: int):
+        self.var_name = var_name
+        self.consecutive = consecutive
+        super().__init__(
+            f"FLAGS_check_numerics: {consecutive} consecutive steps "
+            f"produced non-finite values (first offending var: "
+            f"'{var_name}'); the skipped steps did not update params"
+        )
+
+
+_probe = None  # jitted lazily: sentinel import must not touch jax
+
+
+def _all_finite(values: tuple):
+    global _probe
+    if _probe is None:
+        import jax
+        import jax.numpy as jnp
+
+        _probe = jax.jit(
+            lambda xs: tuple(jnp.all(jnp.isfinite(x)) for x in xs)
+        )
+    return _probe(values)
+
+
+class NaNSentinel:
+    """Consecutive-trip counter around the jitted all-finite scan."""
+
+    def __init__(self, max_consecutive: Optional[int] = None):
+        # None: read FLAGS_check_numerics_max_consecutive at trip time,
+        # so set_flags between steps takes effect without a new Executor
+        self.max_consecutive = max_consecutive
+        self.consecutive = 0
+        self.first_var: Optional[str] = None
+
+    def _limit(self) -> int:
+        if self.max_consecutive is not None:
+            return int(self.max_consecutive)
+        from .. import flags
+
+        return int(flags.flag("check_numerics_max_consecutive"))
+
+    def first_nonfinite(self, names: Sequence[str], values) -> Optional[str]:
+        """Name of the first value holding a non-finite float, or None."""
+        import jax
+        import numpy as np
+
+        from ..core.lod import LoDValue
+
+        flat_names: List[str] = []
+        flat_vals: List = []
+        for n, v in zip(names, values):
+            if v is None:
+                continue
+            if isinstance(v, LoDValue):
+                v = v.data
+            for leaf in jax.tree_util.tree_leaves(v):
+                dt = getattr(leaf, "dtype", None)
+                if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+                    continue
+                flat_names.append(n)
+                flat_vals.append(leaf)
+        if not flat_vals:
+            return None
+        for n, ok in zip(flat_names, _all_finite(tuple(flat_vals))):
+            if not bool(ok):
+                return n
+        return None
+
+    def record_trip(self, var_name: str) -> None:
+        """Count a skipped step; raise once the streak reaches the limit."""
+        self.consecutive += 1
+        if self.first_var is None:
+            self.first_var = var_name
+        if self.consecutive >= self._limit():
+            first, count = self.first_var, self.consecutive
+            self.reset()  # a caught error must not instantly re-raise
+            raise NonFiniteStepError(first, count)
+
+    def record_clean(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.consecutive = 0
+        self.first_var = None

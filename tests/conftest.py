@@ -21,6 +21,23 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md): heavy multiprocess chaos /
+    # long-soak tests opt out with `slow`; `chaos` tags the
+    # fault-injection resilience suite so it can be run alone
+    # (`-m chaos`).  `timeout` is pytest-timeout's marker when that
+    # plugin is present; registering it here keeps the suite
+    # warning-clean when it isn't.
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multiprocess/long tests, excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection resilience tests")
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test timeout (pytest-timeout)")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test a fresh default main/startup program and scope."""

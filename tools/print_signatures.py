@@ -28,6 +28,7 @@ MODULES = [
     "paddle_tpu.timeline",
     "paddle_tpu.flags",
     "paddle_tpu.parallel",
+    "paddle_tpu.resilience",
     "paddle_tpu.inference",
     "paddle_tpu.transpiler",
     "paddle_tpu.reader",
