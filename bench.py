@@ -49,6 +49,19 @@ session — forces BENCH_UNROLL=0 and FLAGS_flash_bwd=jax (flash *forward*
 stays on; it produced the r3 numbers).  The experimental paths stay
 available to explicit runs but can never reach the driver's artifact.
 
+FLAGS_observability=1: the unified telemetry spine records the run —
+per-step executor metrics (wall-time histogram, compile-cache hit/miss),
+trace spans, and the StepStats p50/p99 ring buffer — and bench writes the
+artifacts into BENCH_OBS_DIR (default "obs_run"): metrics.prom
+(Prometheus text), metrics.json, trace.json (Perfetto-loadable, named
+threads), report.json (step-time summary + regression verdicts).  Render
+with `python tools/obsdump.py <dir>`.  BENCH_BASELINE=<path to a previous
+bench artifact or {metric: value} JSON> gates every measured model
+against its banked number and attaches pass/fail verdicts with deltas to
+the output ("regression"); BENCH_BASELINE_TOL (default 0.05) is the
+relative tolerance.  FLAGS_observability_cost=native|tpu additionally
+records each compiled program's bytes/step (the chip-free A/B loop).
+
 BENCH_CKPT_DIR=<dir>: opt-in resumable runs — before the timed region the
 model restores from the newest valid checkpoint under <dir>/<model>/
 (resilience.CheckpointManager, corrupt checkpoints skipped), every
@@ -786,6 +799,62 @@ def _cpu_smoke() -> dict | None:
     return None
 
 
+def _attach_observability(primary: dict, results: list) -> dict:
+    """BENCH_BASELINE regression verdicts + (FLAGS_observability)
+    telemetry artifacts.  Never fails the bench: every path — including
+    a malformed BENCH_BASELINE_TOL — degrades to an *_error field in
+    the artifact."""
+    try:
+        from paddle_tpu import observability as obs
+    except Exception:
+        return primary
+    baseline = os.environ.get("BENCH_BASELINE")
+    try:
+        tol = float(os.environ.get("BENCH_BASELINE_TOL", "0.05"))
+    except ValueError as e:
+        # keep gating with the default tolerance: a typo'd knob must not
+        # silently disable the regression gate CI relies on
+        primary["regression_error"] = (
+            f"BENCH_BASELINE_TOL: {e}; gated with default 0.05")[:200]
+        tol = 0.05
+    report = None
+    if obs.enabled():
+        obs_dir = os.environ.get("BENCH_OBS_DIR", "obs_run")
+        try:
+            report = obs.export_run(
+                obs_dir, results=results,
+                baseline_path=baseline or None, tolerance=tol)
+            st = report.get("step_time", {})
+            primary["observability"] = {
+                "dir": obs_dir,
+                "steps_recorded": st.get("count", 0),
+                "step_time_p50_s": st.get("p50_s"),
+                "step_time_p99_s": st.get("p99_s"),
+            }
+        except Exception as e:  # noqa: BLE001 — telemetry must not
+            # lose the timed numbers
+            primary["observability_error"] = str(e)[:200]
+    if baseline:
+        # gate ONCE: reuse the verdicts export_run just banked in
+        # report.json; compute directly only when no report was written
+        if report is not None and "regression" in report:
+            primary["regression"] = report["regression"] or [
+                {"verdict": "no_baseline",
+                 "detail": "no metric overlap with baseline"}]
+        elif report is not None and "regression_error" in report:
+            primary["regression_error"] = report["regression_error"][:200]
+        else:
+            try:
+                verdicts = obs.gate_results(results, baseline,
+                                            tolerance=tol)
+                primary["regression"] = verdicts or [
+                    {"verdict": "no_baseline",
+                     "detail": "no metric overlap with baseline"}]
+            except Exception as e:  # noqa: BLE001 — gate is bookkeeping
+                primary["regression_error"] = str(e)[:200]
+    return primary
+
+
 def _claim_print(state: dict) -> bool:
     """Atomic test-and-set on state['printed'] — the watchdog thread and
     the main thread race at the deadline boundary; exactly one may emit
@@ -948,15 +1017,25 @@ def main() -> None:
     _relay_preprobe(state)
     model_errors = state["model_errors"]
     try:
+        from paddle_tpu import observability as _obs_pkg
+
+        _span = _obs_pkg.span
+    except Exception:  # telemetry import failure must not fail models
+        import contextlib
+
+        def _span(name, **kw):
+            return contextlib.nullcontext()
+    try:
         for m in names:
             n_before = len(state["results"])
             try:
-                if tune:
-                    _tune_and_run(m, steps, peak_flops, state)  # self-records
-                else:
-                    state["results"].append(
-                        run_model(m, steps, peak_flops, amp=amp,
-                                  layout=layout))
+                with _span("bench.model", model=m):
+                    if tune:
+                        _tune_and_run(m, steps, peak_flops, state)
+                    else:
+                        state["results"].append(
+                            run_model(m, steps, peak_flops, amp=amp,
+                                      layout=layout))
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # noqa: BLE001 — one model's failure
@@ -981,6 +1060,7 @@ def main() -> None:
             primary["extra_metrics"] = results[1:]
         if model_errors:
             primary["model_errors"] = model_errors
+        primary = _attach_observability(primary, results)
         if _claim_print(state):
             print(json.dumps(primary))
     except BaseException as e:  # noqa: BLE001 — the contract is ONE JSON line

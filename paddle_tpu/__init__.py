@@ -83,6 +83,7 @@ from . import resilience  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import batch  # noqa: F401
 from . import metrics  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401

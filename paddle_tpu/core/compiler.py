@@ -27,6 +27,7 @@ from .framework import Block, Program
 from .lod import LoDValue
 from .proto import OpDesc, VarType, dtype_to_numpy
 from .registry import GRAD_OP_SUFFIX, GRAD_SUFFIX, OpRegistry
+from ..observability import span as _obs_span
 
 __all__ = ["LoweringContext", "compile_block", "CompiledBlock"]
 
@@ -466,8 +467,9 @@ class CompiledBlock:
             for sub in program.desc.blocks[1:]:
                 for sop in sub.ops:
                     protected.update(sop.input_arg_names())
-            fused = fuse_conv_epilogue_ops(
-                ops, block.desc.vars, protected=protected)
+            with _obs_span("compile.fuse_conv_epilogue"):
+                fused = fuse_conv_epilogue_ops(
+                    ops, block.desc.vars, protected=protected)
             if fused is not ops:
                 self.fused_conv_epilogue = sum(
                     1 for op in fused if op.type == "conv_bn_add_act"
@@ -516,11 +518,13 @@ class CompiledBlock:
         if platform == "tpu":
             from .aot_tpu import tpu_cost_analysis
 
-            return tpu_cost_analysis(
-                self.raw_fn, tuple(feed_vals), tuple(state_vals), key)
-        compiled = self.fn.trace(
-            tuple(feed_vals), tuple(state_vals), key).lower().compile()
-        ca = compiled.cost_analysis()
+            with _obs_span("compile.cost_analysis", platform="tpu"):
+                return tpu_cost_analysis(
+                    self.raw_fn, tuple(feed_vals), tuple(state_vals), key)
+        with _obs_span("compile.cost_analysis", platform="native"):
+            compiled = self.fn.trace(
+                tuple(feed_vals), tuple(state_vals), key).lower().compile()
+            ca = compiled.cost_analysis()
         return ca if isinstance(ca, dict) else (ca[0] if ca else {})
 
     def tpu_lowering_check(self, feed_vals, state_vals, key) -> int:
@@ -533,8 +537,9 @@ class CompiledBlock:
         still fail the real TPU's Mosaic constraints (lse block tiling,
         strided slices) — failures that burn scarce chip minutes but are
         fully reproducible on a CPU host via cross-platform export."""
-        exp = jax.export.export(self.fn, platforms=["tpu"])(
-            tuple(feed_vals), tuple(state_vals), key)
+        with _obs_span("compile.tpu_lowering_check"):
+            exp = jax.export.export(self.fn, platforms=["tpu"])(
+                tuple(feed_vals), tuple(state_vals), key)
         return len(exp.mlir_module_serialized)
 
 

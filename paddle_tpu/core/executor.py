@@ -13,6 +13,7 @@ param-update semantics of the reference's optimizer ops without mutation.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -20,6 +21,7 @@ import numpy as np
 
 from . import amp
 from .. import flags
+from .. import observability as _obs
 from .compiler import CompiledBlock
 from .framework import Program, Variable, default_main_program
 from .lod import LoDValue
@@ -31,6 +33,11 @@ from .scope import Scope, global_scope
 __all__ = ["Executor", "RNG_STATE_VAR"]
 
 RNG_STATE_VAR = "@rng_key@"
+
+# shared no-op context for the observability-off compile path
+import contextlib as _contextlib
+
+_NULL_CTX = _contextlib.nullcontext()
 
 
 def _as_feed_value(value, var_desc=None):
@@ -348,6 +355,12 @@ class Executor:
             pe = program._executor_for_scope(scope or global_scope())
             return pe.run(fetch_list=fetch_list, feed=feed, return_numpy=return_numpy)
 
+        # FLAGS_observability per-step telemetry: ONE flag check on the
+        # disabled path — no clock read, no allocation, no call into the
+        # observability package (tier-1 asserts this via tracemalloc)
+        obs_on = flags.flag("FLAGS_observability")
+        t0 = time.perf_counter() if obs_on else 0.0
+
         program = program or default_main_program()
         if feed is None and getattr(program, "_py_readers", None):
             # feed-less run: pull the next ready batch from the program's
@@ -363,7 +376,7 @@ class Executor:
         feed_names = sorted(feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
 
-        _, compiled, plan = self._cache_entry(
+        fp, compiled, plan = self._cache_entry(
             program, feed_names, fetch_names, use_program_cache)
 
         block0 = program.desc.block(0)
@@ -412,11 +425,54 @@ class Executor:
                 # is off under this flag); record_trip raises
                 # NonFiniteStepError after N consecutive trips
                 self._sentinel.record_trip(bad)
+                if obs_on:
+                    self._obs_step(t0, donated=False, skipped=True)
                 return plan.convert_fetches(fetches, block0, return_numpy)
             self._sentinel.record_clean()
         plan.write_back(scope, new_states, new_rng)
         _check_nan_inf(plan, fetches, new_states)
+        if obs_on:
+            # step time FIRST: the one-shot cost attribution below can
+            # take minutes (tpu AOT mode) and must not poison this
+            # step's histogram/StepStats sample
+            self._obs_step(t0, donated=self._donate_states_now())
+            _obs.record_device_memory(device)
+            self._maybe_record_cost(fp, compiled, feed_vals, state_vals, rng)
         return plan.convert_fetches(fetches, block0, return_numpy)
+
+    @staticmethod
+    def _obs_step(t0: float, donated: bool, skipped: bool = False) -> None:
+        t1 = time.perf_counter()
+        _obs.record_executor_step(t1 - t0, donated=donated, skipped=skipped)
+        # span via record(): no context-manager plumbing through the run
+        # body; same-thread time containment nests it under callers and
+        # over the compile span in the merged trace
+        _obs.default_tracer().record(
+            "executor.step", t0, t1,
+            **({"skipped": True} if skipped else {}))
+
+    def _maybe_record_cost(self, fp, compiled, feed_vals, state_vals,
+                           rng) -> None:
+        """FLAGS_observability_cost: once per fresh compiled entry,
+        record the XLA cost model's bytes/flops per step labeled by
+        program fingerprint + fused-region count — flag-flip A/Bs (e.g.
+        the conv-epilogue pass) land on separate series with no chip."""
+        mode = flags.flag("observability_cost")
+        if mode == "off" or getattr(compiled, "_obs_cost_done", False):
+            return
+        compiled._obs_cost_done = True  # one attempt, even on failure
+        try:
+            ca = compiled.cost_analysis(
+                feed_vals, state_vals, rng,
+                platform="tpu" if mode == "tpu" else None)
+            _obs.record_cost(
+                ca, program=fp.hex()[:12],
+                fused_regions=compiled.fused_conv_epilogue, platform=mode)
+        except Exception as e:  # costing must never fail the step
+            import logging
+
+            logging.getLogger("paddle_tpu").warning(
+                "observability_cost=%s attribution failed: %s", mode, e)
 
     def _cache_entry(self, program, feed_names, fetch_names,
                      use_program_cache: bool = True):
@@ -433,19 +489,31 @@ class Executor:
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None and entry[0] != fp:
             entry = None
+        obs_on = flags.flag("FLAGS_observability")
         if entry is None:
+            if obs_on:
+                _obs.record_compile_cache(hit=False)
             plan = _RunPlan(program, feed_names, fetch_names)
-            compiled = CompiledBlock(
-                program,
-                0,
-                plan.feed_names,
-                plan.fetch_names,
-                plan.state_names,
-                donate_states=self._donate_states_now(),
-            )
+            with _obs.span("compile", program=fp.hex()[:12]) if obs_on \
+                    else _NULL_CTX:
+                tc0 = time.perf_counter() if obs_on else 0.0
+                compiled = CompiledBlock(
+                    program,
+                    0,
+                    plan.feed_names,
+                    plan.fetch_names,
+                    plan.state_names,
+                    donate_states=self._donate_states_now(),
+                )
+                if obs_on:
+                    _obs.record_compile(
+                        time.perf_counter() - tc0,
+                        fused_regions=compiled.fused_conv_epilogue)
             entry = (fp, compiled, plan)
             if use_program_cache:
                 self._cache[key] = entry
+        elif obs_on:
+            _obs.record_compile_cache(hit=True)
         return entry
 
     def cost_analysis(
@@ -661,11 +729,22 @@ class Executor:
         state_vals = jax.device_put(state_vals, device)
         rng = jax.device_put(rng, device)  # see run(): avoids a second
         # full XLA compile when the committed written-back key returns
+        obs_on = flags.flag("FLAGS_observability")
+        t0 = time.perf_counter() if obs_on else 0.0
         with jax.default_device(device):
             fetches, new_states, new_rng = fn(feeds_stack, state_vals, rng)
 
         plan.write_back(scope, new_states, new_rng)
         _check_nan_inf(plan, fetches, new_states)
+        if obs_on:
+            t1 = time.perf_counter()
+            _obs.default_registry().histogram(
+                "paddle_tpu_executor_run_steps_seconds",
+                "Executor.run_steps wall time per K-step dispatch",
+            ).observe(t1 - t0, steps=str(steps))
+            _obs.default_tracer().record(
+                "executor.run_steps", t0, t1, steps=steps)
+            _obs.record_device_memory(device)
         return plan.convert_fetches(fetches, block0, return_numpy)
 
     @staticmethod

@@ -124,7 +124,13 @@ class RemoteMaster:
     retried; they re-raise by name as before.  A retried `get_task` whose
     response was lost may double-lease a task; the orphaned lease times
     out and re-queues — the queue's at-least-once contract already
-    covers it."""
+    covers it.
+
+    Retry accounting is surfaced instead of dropped: `retry_stats` holds
+    the running totals ({"calls", "retries", "backoff_s"}) and
+    `last_call_retries` the most recent call's retry count; with
+    FLAGS_observability on each transient failure also lands on the
+    `paddle_tpu_resilience_retries{label="elastic.rpc", ...}` counter."""
 
     def __init__(self, endpoint: str, timeout: float = 120.0,
                  max_retries: int = 5, retry_base_delay: float = 0.05,
@@ -138,6 +144,9 @@ class RemoteMaster:
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._rfile = None
+        self._stats_lock = threading.Lock()
+        self.retry_stats = {"calls": 0, "retries": 0, "backoff_s": 0.0}
+        self.last_call_retries = 0
 
     def _call_once(self, req: dict) -> dict:
         from ..resilience import faultinject
@@ -169,13 +178,25 @@ class RemoteMaster:
     def _call(self, req: dict) -> dict:
         from ..resilience.retry import retry_with_backoff
 
-        return retry_with_backoff(
-            lambda: self._call_once(req),
-            retries=self._max_retries,
-            base_delay=self._retry_base_delay,
-            max_delay=self._retry_max_delay,
-            retry_on=(ConnectionError, TimeoutError, OSError),
-        )
+        stats: dict = {}
+        try:
+            return retry_with_backoff(
+                lambda: self._call_once(req),
+                retries=self._max_retries,
+                base_delay=self._retry_base_delay,
+                max_delay=self._retry_max_delay,
+                retry_on=(ConnectionError, TimeoutError, OSError),
+                stats=stats,
+                label="elastic.rpc",
+            )
+        finally:
+            # accumulate even when retries are exhausted: the raised
+            # call's attempts are part of the proxy's story
+            with self._stats_lock:
+                self.retry_stats["calls"] += 1
+                self.retry_stats["retries"] += stats.get("retries", 0)
+                self.retry_stats["backoff_s"] += stats.get("backoff_s", 0.0)
+                self.last_call_retries = stats.get("retries", 0)
 
     def set_dataset(self, globs) -> None:
         self._call({"cmd": "set_dataset", "globs": list(globs)})

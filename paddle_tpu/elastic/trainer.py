@@ -27,6 +27,7 @@ import os
 import time
 from typing import Callable, Optional
 
+from .. import observability as _obs
 from ..core.framework import (
     Program,
     default_main_program,
@@ -85,6 +86,8 @@ class ElasticTrainer:
         self.pass_id = 0
         self.tasks_done = 0
         self.last_loss: Optional[float] = None
+        self.last_save = None  # AsyncCheckpoint of the newest _checkpoint
+        # (its .stats carries the save/GC durations)
         self.ckpt = CheckpointManager(
             checkpoint_dir, keep_last=keep_last, program=self.program
         )
@@ -98,9 +101,11 @@ class ElasticTrainer:
     # -- persistence ---------------------------------------------------
     def _checkpoint(self) -> None:
         # params AND the pass cursor commit in one verified checkpoint
-        # (crash-atomic: a new step_N dir, LATEST flipped last)
+        # (crash-atomic: a new step_N dir, LATEST flipped last); the
+        # save/GC durations ride on the handle and the checkpoint metrics
+        # (CheckpointManager._record_save)
         self._ckpt_seq += 1
-        self.ckpt.save(
+        self.last_save = self.ckpt.save(
             self._ckpt_seq,
             extra={"pass_id": self.pass_id, "tasks_done": self.tasks_done},
         )
@@ -170,25 +175,28 @@ class ElasticTrainer:
                 )
             draining = False
             try:
-                for chunk in task.chunks:
-                    for feed in self.feed_fn(chunk):
-                        vals = self.exe.run(
-                            program=self.program, feed=feed,
-                            fetch_list=self.fetch_list,
-                        )
-                        if vals:
-                            import numpy as np
-
-                            self.last_loss = float(
-                                np.ravel(np.asarray(vals[0]))[0]
+                with _obs.span("elastic.task", task=task.id,
+                               pass_id=self.pass_id):
+                    for chunk in task.chunks:
+                        for feed in self.feed_fn(chunk):
+                            vals = self.exe.run(
+                                program=self.program, feed=feed,
+                                fetch_list=self.fetch_list,
                             )
-                        if self._drain_requested():
-                            # preemption notice: the in-flight step just
-                            # finished; stop HERE and checkpoint below
-                            draining = True
+                            if vals:
+                                import numpy as np
+
+                                self.last_loss = float(
+                                    np.ravel(np.asarray(vals[0]))[0]
+                                )
+                            if self._drain_requested():
+                                # preemption notice: the in-flight step
+                                # just finished; stop HERE and
+                                # checkpoint below
+                                draining = True
+                                break
+                        if draining:
                             break
-                    if draining:
-                        break
             except Exception:
                 # report and surface: the master re-queues immediately
                 # instead of waiting for the lease to expire.  This also
@@ -196,6 +204,10 @@ class ElasticTrainer:
                 # the checkpoint below never runs, so the poisoned task's
                 # params (which the sentinel never wrote back anyway) are
                 # not published; the lease machinery re-dispatches.
+                _obs.default_registry().counter(
+                    "paddle_tpu_elastic_tasks",
+                    "elastic tasks by outcome",
+                ).inc(outcome="failed")
                 self.master.task_failed(task.id, task.epoch)
                 raise
             if draining:
@@ -203,6 +215,10 @@ class ElasticTrainer:
                 # lease expires and a surviving worker re-runs it
                 # (at-least-once); params/cursor persist so the restart
                 # is cheap
+                _obs.default_registry().counter(
+                    "paddle_tpu_elastic_drains",
+                    "preemption drains that checkpointed and returned",
+                ).inc()
                 self._checkpoint()
                 return
             # checkpoint BEFORE reporting: a crash between the two means the
@@ -212,6 +228,15 @@ class ElasticTrainer:
             self._checkpoint()
             self.master.task_finished(task.id)
             self.master.heartbeat(self.worker_id)
+            reg = _obs.default_registry()
+            reg.counter(
+                "paddle_tpu_elastic_tasks", "elastic tasks by outcome",
+            ).inc(outcome="finished")
+            if self.last_loss is not None:
+                reg.gauge(
+                    "paddle_tpu_elastic_last_loss",
+                    "most recent fetched loss",
+                ).set(self.last_loss, worker=self.worker_id)
             # master may have rolled the pass on our report
             cur = self.master.counts()["cur_pass"]
             if cur > self.pass_id:

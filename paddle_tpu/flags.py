@@ -42,6 +42,22 @@ _DEFS: Dict[str, Any] = {
     # costs one scalar device sync per step for the jitted finite scan.
     "FLAGS_check_numerics": False,
     "FLAGS_check_numerics_max_consecutive": 3,
+    # observability (paddle_tpu/observability/): master switch for the
+    # unified telemetry spine — per-step executor metrics (wall-time
+    # histogram, compile-cache hit/miss, donation status, sentinel
+    # skips), trace spans (compile/step/ckpt, exported as one merged
+    # Chrome/Perfetto trace), resilience/elastic counters, and the
+    # StepStats p50/p99 ring buffer.  Off (default): every instrument
+    # returns after a single dict lookup — no locks, allocations, or
+    # clock reads on the hot path (tier-1 asserts this).
+    "FLAGS_observability": False,
+    # per-program bytes/step cost attribution, recorded once per fresh
+    # compiled entry when observability is on: "native" prices the
+    # executable the host actually runs (cheap — the re-lower hits jax's
+    # compile cache), "tpu" prices the CHIP program via the chip-less
+    # AOT topology tier (core/aot_tpu.py — minutes for big models, the
+    # relay-free conv-epilogue measurement loop), "off" skips costing
+    "FLAGS_observability_cost": "off",
     # determinism
     "FLAGS_cpu_deterministic": False,
     # accepted for reference-script compatibility; memory/threads are
@@ -142,6 +158,7 @@ _CHOICES: Dict[str, tuple] = {
     "FLAGS_conv_layout": ("auto", "NCHW", "NHWC"),
     "FLAGS_flash_bwd": ("jax", "pallas", "jaxlib"),
     "FLAGS_conv_epilogue": ("reference", "pallas"),
+    "FLAGS_observability_cost": ("off", "native", "tpu"),
 }
 
 

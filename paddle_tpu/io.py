@@ -340,11 +340,17 @@ class AsyncCheckpoint:
     the disk write and re-raises any IO error.  With no thread the handle
     is pre-completed (`AsyncCheckpoint.completed()`) — the multi-process
     fallback writes synchronously and hands one back so caller code stays
-    uniform across scales."""
+    uniform across scales.
 
-    def __init__(self, thread=None, exc_box=None):
+    `stats` is a caller-shared dict of save accounting
+    (CheckpointManager fills save_seconds / gc_seconds / step there —
+    previously measured nowhere and dropped); for async saves it is
+    complete once wait() returns."""
+
+    def __init__(self, thread=None, exc_box=None, stats=None):
         self._thread = thread
         self._exc_box = exc_box if exc_box is not None else []
+        self.stats = {} if stats is None else stats
 
     @classmethod
     def completed(cls) -> "AsyncCheckpoint":

@@ -1,0 +1,310 @@
+"""Unified telemetry spine: metrics registry, trace spans, step stats,
+and perf-regression gates — one place every layer reports into.
+
+Before this subsystem each layer reported on itself ad hoc: bench.py
+hand-rolled timing dicts, resilience/ counted retries and sentinel trips
+in private state, core/aot_tpu.py printed cost tables, and timeline.py
+was a chrome-trace stub with no hot-path consumers.  Now:
+
+- **Metrics** (`metrics.py`): Counter / Gauge / Histogram with labels in
+  a process-wide registry; JSON snapshots, Prometheus text exposition,
+  atomic per-process dumps with cross-process merge (`aggregate_dir`).
+- **Spans** (`tracing.py`): `span("compile")` / `span("step", step=n)` /
+  `span("ckpt.save")` nest per-thread, attach to an active jax.profiler
+  device trace, and export one merged Chrome/Perfetto trace per run with
+  named threads and stable tids (timeline.py is rebased onto this
+  writer).
+- **Step stats** (`stepstats.py`): ring buffer of Executor.run wall
+  times with rolling p50/p99, plus the BENCH_BASELINE regression gate
+  bench.py uses to emit pass/fail deltas.
+
+Everything is gated on **FLAGS_observability** (env `FLAGS_observability=1`
+or `fluid.set_flags({"FLAGS_observability": True})`).  Disabled, every
+instrument returns after one dict lookup — no locks, no allocation, no
+clock reads (tier-1 asserts the executor's disabled path allocates
+nothing from this package).  `FLAGS_observability_cost=native|tpu`
+additionally records each compiled program's bytes/step from XLA's cost
+model (the `tpu` mode prices the CHIP program via the chip-less AOT
+tier, core/aot_tpu.py — the conv-epilogue layout-tax measurement loop
+with no relay window).
+
+Artifacts: `export_run(dirname)` writes `metrics.prom`, `metrics.json`,
+`trace.json` (Perfetto-loadable) and `report.json` (step-time summary +
+regression verdicts); `tools/obsdump.py` renders a run directory into a
+human-readable report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+from .. import flags as _flags
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .stepstats import (  # noqa: F401
+    StepStats,
+    gate_results,
+    load_baseline_metrics,
+    regression_verdict,
+)
+from .tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    default_tracer,
+    span,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "StepStats",
+    "Span",
+    "Tracer",
+    "default_tracer",
+    "span",
+    "write_chrome_trace",
+    "enabled",
+    "enable",
+    "disable",
+    "step_stats",
+    "record_executor_step",
+    "record_compile",
+    "record_cost",
+    "record_device_memory",
+    "export_run",
+    "regression_verdict",
+    "load_baseline_metrics",
+    "gate_results",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """Whether FLAGS_observability is on (the one gate every instrument
+    checks first)."""
+    return _flags._VALUES["FLAGS_observability"]
+
+
+def enable() -> None:
+    _flags.set_flags({"FLAGS_observability": True})
+
+
+def disable() -> None:
+    _flags.set_flags({"FLAGS_observability": False})
+
+
+_step_stats = StepStats()
+
+
+def step_stats() -> StepStats:
+    """The process-wide step-time ring buffer Executor.run records into."""
+    return _step_stats
+
+
+def reset() -> None:
+    """Clear the default registry, tracer, and step stats (fresh run in
+    the same process; tests)."""
+    default_registry().reset()
+    default_tracer().clear()
+    _step_stats.reset()
+
+
+# -- executor instruments ---------------------------------------------------
+# Called from Executor hot paths ONLY when FLAGS_observability is on (the
+# executor performs the flag check so its disabled path never enters this
+# module); each emits into the default registry.
+
+def record_executor_step(seconds: float, donated: bool,
+                         skipped: bool = False) -> None:
+    """One Executor.run dispatch: host-side wall time (async dispatch —
+    device time shows up via block_until_ready at the caller's sync
+    points), donation status, and whether the sentinel skipped the
+    write-back."""
+    reg = default_registry()
+    reg.histogram(
+        "paddle_tpu_executor_step_seconds",
+        "Executor.run wall time per step (host-side dispatch)",
+    ).observe(seconds)
+    reg.counter(
+        "paddle_tpu_executor_steps",
+        "Executor.run calls by state-donation status",
+    ).inc(donated="1" if donated else "0")
+    if skipped:
+        reg.counter(
+            "paddle_tpu_executor_skipped_steps",
+            "steps skipped by the FLAGS_check_numerics sentinel",
+        ).inc()
+    _step_stats.record(seconds)
+
+
+def record_compile_cache(hit: bool) -> None:
+    reg = default_registry()
+    reg.counter(
+        "paddle_tpu_compile_cache",
+        "Executor compiled-program cache lookups",
+    ).inc(result="hit" if hit else "miss")
+
+
+def record_compile(seconds: float, fused_regions: int = 0) -> None:
+    """One CompiledBlock build (trace-time lowering setup; the XLA
+    compile itself lands in the first step's wall time)."""
+    reg = default_registry()
+    reg.histogram(
+        "paddle_tpu_compile_seconds",
+        "CompiledBlock construction (lowering setup) wall time",
+    ).observe(seconds)
+    if fused_regions:
+        reg.gauge(
+            "paddle_tpu_fused_conv_epilogue_regions",
+            "conv->bn[->add][->act] chains fused by the lowering pass "
+            "in the most recent compile",
+        ).set(fused_regions)
+
+
+def record_cost(cost: dict, program: str, fused_regions: int = 0,
+                platform: str = "native") -> None:
+    """XLA cost-model attribution for one compiled program: bytes/step
+    and flops/step, labeled by program fingerprint + fused-region count
+    so flag flips (e.g. FLAGS_fuse_conv_epilogue) land on separate series
+    — the chip-free A/B loop for the conv-epilogue layout tax."""
+    reg = default_registry()
+    labels = {"program": program, "fused_regions": str(fused_regions),
+              "platform": platform}
+    b = cost.get("bytes accessed")
+    if b is not None:
+        reg.gauge(
+            "paddle_tpu_cost_bytes_per_step",
+            "XLA cost model: HBM bytes accessed per step",
+        ).set(float(b), **labels)
+    fl = cost.get("flops")
+    if fl is not None:
+        reg.gauge(
+            "paddle_tpu_cost_flops_per_step",
+            "XLA cost model: flops per step",
+        ).set(float(fl), **labels)
+
+
+def record_device_memory(device) -> None:
+    """Device-memory watermarks, sampled per step from the device's PJRT
+    allocator stats: current bytes in use plus the high-water mark.
+    Backends that expose `peak_bytes_in_use` (TPU) report the
+    allocator's own watermark; otherwise the gauge keeps a monotonic max
+    of the sampled `bytes_in_use`.  Backends without memory_stats (or
+    returning nothing — CPU jax) are silently skipped."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return
+    if not stats:
+        return
+    reg = default_registry()
+    dev = str(getattr(device, "id", device))
+    in_use = stats.get("bytes_in_use")
+    if in_use is not None:
+        reg.gauge(
+            "paddle_tpu_device_bytes_in_use",
+            "device allocator bytes currently in use",
+        ).set(float(in_use), device=dev)
+    peak_gauge = reg.gauge(
+        "paddle_tpu_device_peak_bytes_in_use",
+        "device-memory high-water mark (allocator peak, or the running "
+        "max of sampled bytes_in_use when the backend reports no peak)",
+    )
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        peak_gauge.set(float(peak), device=dev)
+    elif in_use is not None:
+        # no allocator peak: monotonic max under the metric lock
+        # (hogwild threads racing a read-then-set could move the
+        # watermark backwards)
+        peak_gauge.set_max(float(in_use), device=dev)
+
+
+# -- run artifacts ----------------------------------------------------------
+
+def merged_spans(include_tracer: bool = True) -> List[Span]:
+    """Profiler.record_event spans (+ the observability tracer's spans
+    unless include_tracer=False), one list — the single source for the
+    'one merged trace per run' export (timeline.export_chrome_trace
+    draws from here too, so the _trace tuple-shape knowledge lives in
+    exactly one place)."""
+    spans = default_tracer().spans() if include_tracer else []
+    try:
+        from .. import profiler as _profiler
+
+        for rec in _profiler._trace:
+            # (name, t0, t1, ident[, thread_name]) — older 4-tuples from
+            # in-flight processes still export, just unnamed
+            name, t0, t1, ident = rec[0], rec[1], rec[2], rec[3]
+            tname = rec[4] if len(rec) > 4 else f"thread-{ident}"
+            spans.append(Span(name, t0, t1, ident, tname, cat="host"))
+    except Exception:
+        pass
+    return spans
+
+
+def export_run(dirname: str, results: Optional[List[dict]] = None,
+               baseline_path: Optional[str] = None,
+               tolerance: float = 0.05) -> dict:
+    """Write the run's telemetry artifacts into `dirname`:
+
+    - metrics.prom  — Prometheus text exposition of the default registry
+    - metrics.json  — the same registry as a merge-able JSON snapshot
+    - trace.json    — merged Chrome/Perfetto trace (spans + profiler
+      events, named threads, stable tids)
+    - report.json   — step-time summary (p50/p99), optional bench
+      results, and regression verdicts vs `baseline_path`
+
+    On multi-process runs EVERY artifact is namespaced `*_<pid>.*` for
+    process index > 0 (a shared run dir must never have two processes
+    racing non-atomic writes to one file); aggregate the metrics
+    snapshots with MetricsRegistry.aggregate_dir.
+
+    Returns the report dict."""
+    os.makedirs(dirname, exist_ok=True)
+    reg = default_registry()
+    pid = 0
+    try:
+        import jax
+
+        pid = int(jax.process_index())
+    except Exception:
+        pass
+    sfx = "" if pid == 0 else f"_{pid}"
+    with open(os.path.join(dirname, f"metrics{sfx}.prom"), "w") as f:
+        f.write(reg.to_prometheus())
+    reg.dump(os.path.join(dirname, f"metrics{sfx}.json"))
+    n_spans = write_chrome_trace(
+        os.path.join(dirname, f"trace{sfx}.json"), merged_spans(), pid=pid)
+    report = {
+        "version": 1,
+        "wall_time": time.time(),
+        "step_time": _step_stats.summary(),
+        "span_count": n_spans,
+    }
+    if results:
+        report["results"] = results
+    if baseline_path:
+        try:
+            report["regression"] = gate_results(
+                results or [], baseline_path, tolerance=tolerance)
+            report["baseline_path"] = baseline_path
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            report["regression_error"] = f"{type(e).__name__}: {e}"
+    tmp = os.path.join(dirname, f".report{sfx}.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2)
+    os.replace(tmp, os.path.join(dirname, f"report{sfx}.json"))
+    return report

@@ -29,7 +29,9 @@ __all__ = [
 _state: Dict[str, object] = {"on": False, "dir": None}
 # host-side event aggregation (reference prints calls/total/min/max/ave)
 _events: Dict[str, List[float]] = defaultdict(list)
-# (name, start_s, end_s, thread_id) spans for the chrome-trace timeline
+# (name, start_s, end_s, thread_id, thread_name) spans for the
+# chrome-trace timeline — the thread name rides along so the export can
+# label Perfetto rows even for threads that died before export time
 _trace: List[tuple] = []
 
 
@@ -48,7 +50,8 @@ def record_event(name: str):
     t1 = time.perf_counter()
     _events[name].append(t1 - t0)
     if _state["on"]:  # span collection only while profiling (bounded)
-        _trace.append((name, t0, t1, threading.get_ident()))
+        _trace.append((name, t0, t1, threading.get_ident(),
+                       threading.current_thread().name))
 
 
 def reset_profiler():

@@ -25,10 +25,12 @@ import os
 import re
 import shutil
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
 from .. import io as fluid_io
+from .. import observability as _obs
 
 __all__ = ["CheckpointManager", "RestoreResult"]
 
@@ -114,8 +116,18 @@ class CheckpointManager:
         (write-then-rename) and GC old checkpoints.  asynchronous=True
         returns an AsyncCheckpoint whose wait() covers the shard write
         AND the pointer flip + GC — the pointer never names a checkpoint
-        that is still being written."""
+        that is still being written.
+
+        Always returns an AsyncCheckpoint (pre-completed for synchronous
+        saves) whose `stats` dict carries the durations that used to be
+        dropped: {"step", "save_seconds" (snapshot + shard write),
+        "gc_seconds", "total_seconds"}.  For async saves the dict is
+        complete once wait() returns.  The same numbers land on the
+        `paddle_tpu_checkpoint_*` metrics when FLAGS_observability is
+        on, with the whole save wrapped in a `ckpt.save` span."""
         d = self.step_dir(step)
+        stats = {"step": int(step), "asynchronous": bool(asynchronous)}
+        t0 = time.perf_counter()
         handle = fluid_io.save_sharded(
             d,
             program if program is not None else self.program,
@@ -130,28 +142,66 @@ class CheckpointManager:
             def _bg():
                 try:
                     handle.wait()
-                    self._finalize(step)
+                    stats["save_seconds"] = time.perf_counter() - t0
+                    stats["gc_seconds"] = self._finalize(step)
+                    stats["total_seconds"] = time.perf_counter() - t0
                 except BaseException as e:  # surfaced by wait()
+                    # box FIRST: telemetry must never swallow a real
+                    # checkpoint failure (or fabricate one on success —
+                    # _record_save itself never raises)
                     exc_box.append(e)
+                    self._record_save(stats, t0, ok=False)
+                else:
+                    self._record_save(stats, t0)
 
             t = threading.Thread(
                 target=_bg, name=f"ckpt_finalize_{step}", daemon=True
             )
             t.start()
-            return fluid_io.AsyncCheckpoint(t, exc_box)
-        self._finalize(step)
-        return None
+            return fluid_io.AsyncCheckpoint(t, exc_box, stats=stats)
+        stats["save_seconds"] = time.perf_counter() - t0
+        stats["gc_seconds"] = self._finalize(step)
+        stats["total_seconds"] = time.perf_counter() - t0
+        self._record_save(stats, t0)
+        return fluid_io.AsyncCheckpoint(stats=stats)
 
-    def _finalize(self, step: int) -> None:
+    @staticmethod
+    def _record_save(stats: dict, t0: float, ok: bool = True) -> None:
+        try:
+            reg = _obs.default_registry()
+            reg.counter(
+                "paddle_tpu_checkpoint_saves",
+                "CheckpointManager.save calls",
+            ).inc(result="ok" if ok else "error")
+            if ok:
+                reg.histogram(
+                    "paddle_tpu_checkpoint_save_seconds",
+                    "verified checkpoint write (snapshot + shards + "
+                    "manifest)",
+                ).observe(stats["save_seconds"])
+                reg.histogram(
+                    "paddle_tpu_checkpoint_gc_seconds",
+                    "rotation GC after a completed checkpoint",
+                ).observe(stats["gc_seconds"])
+            _obs.default_tracer().record(
+                "ckpt.save", t0, time.perf_counter(),
+                step=stats.get("step"), ok=ok)
+        except Exception:  # telemetry must never change a save's outcome
+            _log.warning("checkpoint telemetry failed", exc_info=True)
+
+    def _finalize(self, step: int) -> float:
+        """Flip LATEST + GC; returns the GC duration in seconds."""
         import jax
 
         if jax.process_index() != 0:
-            return  # pointer + GC are single-writer concerns
+            return 0.0  # pointer + GC are single-writer concerns
         tmp = os.path.join(self.run_dir, "." + _LATEST + ".tmp")
         with open(tmp, "w") as f:
             json.dump({"step": int(step), "dir": f"step_{int(step)}"}, f)
         os.replace(tmp, os.path.join(self.run_dir, _LATEST))
+        g0 = time.perf_counter()
         self.gc()
+        return time.perf_counter() - g0
 
     def gc(self) -> None:
         """Keep the newest `keep_last` valid checkpoints; drop everything

@@ -25,23 +25,50 @@ def retry_with_backoff(
     ),
     sleep: Callable[[float], None] = time.sleep,
     on_retry: Optional[Callable] = None,
+    stats: Optional[dict] = None,
+    label: str = "",
 ):
     """Call `fn()`; on an exception in `retry_on` sleep
     min(max_delay, base_delay * 2**attempt) * (1 + U[0, jitter]) and try
     again, up to `retries` extra attempts, then re-raise.  The jitter
     de-synchronizes a worker fleet all retrying the same restarted master
     (thundering-herd).  `on_retry(attempt, exc, delay)` observes each
-    retry (logging/tests); `sleep` is injectable for fast tests."""
-    attempt = 0
-    while True:
-        try:
-            return fn()
-        except retry_on as e:
-            attempt += 1
-            if attempt > retries:
-                raise
-            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
-            delay *= 1.0 + random.uniform(0.0, jitter)
-            if on_retry is not None:
-                on_retry(attempt, e, delay)
-            sleep(delay)
+    retry (logging/tests); `sleep` is injectable for fast tests.
+
+    `stats` (a caller-owned dict) is filled in place with the call's
+    attempt accounting — {"attempts": total calls made, "retries":
+    attempts - 1, "backoff_s": summed sleep time} — on EVERY exit
+    (success, exhausted retries, or a non-retryable exception after
+    transient retries); callers that hold a long-lived proxy
+    (elastic.rpc.RemoteMaster) accumulate it onto the object instead of
+    dropping it.  Each transient failure also increments the
+    `paddle_tpu_resilience_retries` counter (labeled by `label` and the
+    exception type) when FLAGS_observability is on."""
+    from .. import observability as _obs
+
+    calls = 0
+    backoff_total = 0.0
+    try:
+        while True:
+            calls += 1
+            try:
+                return fn()
+            except retry_on as e:
+                _obs.default_registry().counter(
+                    "paddle_tpu_resilience_retries",
+                    "transient failures observed by retry_with_backoff "
+                    "(retried or exhausted)",
+                ).inc(label=label, error=type(e).__name__)
+                if calls > retries:
+                    raise
+                delay = min(max_delay, base_delay * (2 ** (calls - 1)))
+                delay *= 1.0 + random.uniform(0.0, jitter)
+                backoff_total += delay
+                if on_retry is not None:
+                    on_retry(calls, e, delay)
+                sleep(delay)
+    finally:
+        if stats is not None:
+            stats["attempts"] = calls
+            stats["retries"] = calls - 1
+            stats["backoff_s"] = backoff_total

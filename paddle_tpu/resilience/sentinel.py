@@ -96,12 +96,22 @@ class NaNSentinel:
 
     def record_trip(self, var_name: str) -> None:
         """Count a skipped step; raise once the streak reaches the limit."""
+        from .. import observability as _obs
+
         self.consecutive += 1
         if self.first_var is None:
             self.first_var = var_name
+        _obs.default_registry().counter(
+            "paddle_tpu_sentinel_trips",
+            "non-finite steps skipped by FLAGS_check_numerics",
+        ).inc(var=var_name)
         if self.consecutive >= self._limit():
             first, count = self.first_var, self.consecutive
             self.reset()  # a caught error must not instantly re-raise
+            _obs.default_registry().counter(
+                "paddle_tpu_sentinel_failures",
+                "NonFiniteStepError raises (consecutive-trip limit hit)",
+            ).inc(var=first)
             raise NonFiniteStepError(first, count)
 
     def record_clean(self) -> None:

@@ -1,42 +1,33 @@
 """Chrome-trace timeline export (reference: tools/timeline.py, which parses
 profiler protobufs into chrome://tracing JSON; here record_event spans are
-captured directly and written in the same trace-event format, and the
-device-side timeline comes from jax.profiler's TensorBoard trace)."""
+captured directly).
+
+Rebased onto the observability span writer
+(paddle_tpu/observability/tracing.py): one merged Perfetto-loadable trace
+per run — profiler.record_event spans (cat "host") plus any
+observability spans (cat "obs": executor step/compile, checkpoint saves)
+— with `thread_name` metadata events and stable per-thread tids (main
+thread is tid 0, other threads ordered by first span; the old export's
+insertion-order ints left Perfetto rows unlabeled).  The device-side
+timeline still comes from jax.profiler's TensorBoard trace."""
 
 from __future__ import annotations
 
-import json
-from typing import Optional
-
-from . import profiler as _profiler
+from .observability import merged_spans
+from .observability.tracing import write_chrome_trace
 
 __all__ = ["Timeline", "export_chrome_trace"]
 
 
-def export_chrome_trace(path: str, pid: int = 0) -> int:
-    """Write the record_event spans collected since reset_profiler() as a
-    chrome://tracing / Perfetto-loadable JSON file.  Returns the number of
-    events written."""
-    events = []
-    tids = {}
-    for name, t0, t1, tid in _profiler._trace:
-        tids.setdefault(tid, len(tids))
-        events.append({
-            "name": name,
-            "ph": "X",                       # complete event
-            "ts": t0 * 1e6,                  # microseconds
-            "dur": (t1 - t0) * 1e6,
-            "pid": pid,
-            "tid": tids[tid],
-            "cat": "host",
-        })
-    doc = {
-        "traceEvents": events,
-        "displayTimeUnit": "ms",
-    }
-    with open(path, "w") as f:
-        json.dump(doc, f)
-    return len(events)
+def export_chrome_trace(path: str, pid: int = 0,
+                        include_observability: bool = True) -> int:
+    """Write the record_event spans collected since reset_profiler() —
+    merged with the observability tracer's spans unless
+    include_observability=False — as a chrome://tracing / Perfetto JSON
+    file with named threads.  Returns the number of span events written
+    (metadata events excluded)."""
+    return write_chrome_trace(
+        path, merged_spans(include_tracer=include_observability), pid=pid)
 
 
 class Timeline:

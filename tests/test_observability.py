@@ -1,0 +1,698 @@
+"""Unified telemetry subsystem (paddle_tpu/observability/): metrics
+registry, trace spans, step stats, regression gates, executor wiring, and
+the zero-overhead-when-disabled contract."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability as obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_on():
+    """FLAGS_observability on with clean registry/tracer/stats, restored
+    after the test."""
+    fluid.set_flags({"FLAGS_observability": True})
+    obs.reset()
+    yield
+    obs.reset()
+    fluid.set_flags({"FLAGS_observability": False})
+
+
+def _build_step(name="obs_w"):
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.fc(x, size=2, param_attr=fluid.ParamAttr(name=name))
+    loss = layers.reduce_mean(y)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _feed(seed=0, bad=False):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(2, 4).astype("float32")
+    if bad:
+        x[0, 0] = np.nan
+    return {"x": x}
+
+
+# -----------------------------------------------------------------------
+# metrics registry
+# -----------------------------------------------------------------------
+def test_counter_gauge_histogram_with_labels(obs_on):
+    reg = obs.MetricsRegistry()
+    c = reg.counter("requests", "requests served")
+    c.inc(model="resnet50")
+    c.inc(2.0, model="resnet50")
+    c.inc(model="transformer")
+    assert c.value(model="resnet50") == 3.0
+    assert c.value(model="transformer") == 1.0
+    assert c.value(model="absent") == 0.0
+
+    g = reg.gauge("capacity", "")
+    g.set(5.0, host="a")
+    g.inc(2.0, host="a")
+    g.dec(1.0, host="a")
+    assert g.value(host="a") == 6.0
+    assert g.value(host="b") is None
+    # monotonic watermark: set_max never moves backwards
+    g.set_max(10.0, host="a")
+    g.set_max(3.0, host="a")
+    assert g.value(host="a") == 10.0
+
+    h = reg.histogram("lat", "", buckets=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    s = h.series_summary()
+    assert s["count"] == 4
+    assert s["min"] == 0.005 and s["max"] == 5.0
+    # non-cumulative per-bucket counts: one obs each in 0.01/0.1/1.0/+Inf
+    assert [c for _, c in s["buckets"]] == [1, 1, 1, 1]
+
+
+def test_metric_type_conflict_raises(obs_on):
+    reg = obs.MetricsRegistry()
+    reg.counter("m", "")
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("m", "")
+
+
+def test_prometheus_text_format(obs_on):
+    reg = obs.MetricsRegistry()
+    reg.counter("steps", "steps run").inc(3, model="lenet")
+    reg.gauge("hbm_bytes", "").set(1024)
+    reg.histogram("step_s", "", buckets=[0.1, 1.0]).observe(0.05)
+    text = reg.to_prometheus()
+    assert "# TYPE steps_total counter" in text
+    assert 'steps_total{model="lenet"} 3' in text
+    assert "# TYPE hbm_bytes gauge" in text
+    assert "hbm_bytes 1024" in text
+    # histogram: cumulative buckets + sum + count
+    assert 'step_s_bucket{le="0.1"} 1' in text
+    assert 'step_s_bucket{le="1"} 1' in text
+    assert 'step_s_bucket{le="+Inf"} 1' in text
+    assert "step_s_count 1" in text
+
+
+def test_snapshot_merge_adds_counters_and_histograms(obs_on):
+    a, b = obs.MetricsRegistry(), obs.MetricsRegistry()
+    a.counter("c", "").inc(2, k="x")
+    b.counter("c", "").inc(3, k="x")
+    a.histogram("h", "", buckets=[1.0]).observe(0.5)
+    b.histogram("h", "", buckets=[1.0]).observe(2.0)
+    a.gauge("g", "").set(1.0)
+    time.sleep(0.01)
+    b.gauge("g", "").set(9.0)  # newer write wins on merge
+
+    merged = obs.MetricsRegistry()
+    merged.merge(a.snapshot())
+    merged.merge(b.snapshot())
+    assert merged.counter("c", "").value(k="x") == 5.0
+    hs = merged.histogram("h", "").series_summary()
+    assert hs["count"] == 2 and hs["min"] == 0.5 and hs["max"] == 2.0
+    assert merged.gauge("g", "").value() == 9.0
+
+
+def test_process_dump_and_aggregate_dir(obs_on, tmp_path):
+    """The multi-host story: one atomic snapshot file per process, any
+    host merges the directory."""
+    for p in (0, 1):
+        reg = obs.MetricsRegistry()
+        reg.counter("paddle_tpu_steps", "").inc(10, process=str(p))
+        reg.counter("shared", "").inc(1)
+        reg.dump(str(tmp_path / f"metrics_{p}.json"))
+    agg = obs.MetricsRegistry.aggregate_dir(str(tmp_path))
+    assert agg.counter("shared", "").value() == 2.0
+    assert agg.counter("paddle_tpu_steps", "").value(process="0") == 10.0
+    assert agg.counter("paddle_tpu_steps", "").value(process="1") == 10.0
+
+
+def test_metrics_noop_when_disabled():
+    assert not obs.enabled()
+    reg = obs.MetricsRegistry()
+    reg.counter("dead", "").inc(5)
+    reg.gauge("dead_g", "").set(1)
+    reg.histogram("dead_h", "").observe(1)
+    assert reg.counter("dead", "").value() == 0.0
+    assert reg.gauge("dead_g", "").value() is None
+    assert reg.histogram("dead_h", "").series_summary() is None
+
+
+# -----------------------------------------------------------------------
+# spans + chrome trace
+# -----------------------------------------------------------------------
+def test_spans_nest_on_one_thread(obs_on):
+    with obs.span("step", step=7):
+        with obs.span("forward"):
+            pass
+        with obs.span("backward"):
+            pass
+    spans = {s.name: s for s in obs.default_tracer().spans()}
+    assert set(spans) == {"step", "forward", "backward"}
+    assert spans["forward"].parent == "step"
+    assert spans["backward"].parent == "step"
+    assert spans["step"].parent is None
+    assert spans["step"].args == {"step": 7}
+    # time containment
+    assert spans["step"].t0 <= spans["forward"].t0
+    assert spans["forward"].t1 <= spans["step"].t1
+
+
+def test_spans_nest_independently_across_threads(obs_on):
+    """A worker thread's spans must not adopt the main thread's open span
+    as parent (per-thread stacks)."""
+    def worker():
+        with obs.span("io.write"):
+            time.sleep(0.002)
+
+    with obs.span("step"):
+        t = threading.Thread(target=worker, name="ckpt-writer")
+        t.start()
+        t.join()
+    spans = {s.name: s for s in obs.default_tracer().spans()}
+    assert spans["io.write"].parent is None
+    assert spans["io.write"].thread_name == "ckpt-writer"
+    assert spans["io.write"].tid != spans["step"].tid
+
+
+def test_chrome_trace_named_threads_stable_tids(obs_on, tmp_path):
+    def worker(i):
+        with obs.span(f"w{i}"):
+            time.sleep(0.002)
+
+    with obs.span("main_span"):
+        ts = [threading.Thread(target=worker, args=(i,), name=f"worker-{i}")
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    path = str(tmp_path / "trace.json")
+    n = obs.write_chrome_trace(path, obs.default_tracer().spans())
+    assert n == 3
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert len(xs) == 3
+    for e in xs:
+        assert e["dur"] >= 0
+    # main thread pinned to tid 0; workers named
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["main_span"]["tid"] == 0
+    tid_names = {e["tid"]: e["args"]["name"] for e in metas}
+    assert tid_names[0] == threading.main_thread().name
+    assert {"worker-0", "worker-1"} <= set(tid_names.values())
+    assert by_name["w0"]["tid"] != by_name["w1"]["tid"] != 0
+
+
+def test_chrome_trace_separates_reused_thread_idents(obs_on, tmp_path):
+    """CPython reuses thread idents after join; rows are keyed on
+    (ident, name) so a stream of short-lived writer threads doesn't
+    collapse onto one mislabeled row."""
+    spans = [obs.Span("save1", 0.0, 1.0, 12345, "ckpt_finalize_1"),
+             obs.Span("save2", 2.0, 3.0, 12345, "ckpt_finalize_2")]
+    path = str(tmp_path / "t.json")
+    obs.write_chrome_trace(path, spans)
+    doc = json.load(open(path))
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    metas = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert xs["save1"]["tid"] != xs["save2"]["tid"]
+    assert metas[xs["save1"]["tid"]] == "ckpt_finalize_1"
+    assert metas[xs["save2"]["tid"]] == "ckpt_finalize_2"
+
+
+def test_histogram_merge_rejects_mismatched_buckets(obs_on):
+    a, b = obs.MetricsRegistry(), obs.MetricsRegistry()
+    a.histogram("h", "", buckets=[0.1, 1.0]).observe(0.05)
+    b.histogram("h", "", buckets=[1.0, 10.0]).observe(5.0)
+    merged = obs.MetricsRegistry()
+    merged.merge(a.snapshot())
+    with pytest.raises(ValueError, match="buckets"):
+        merged.merge(b.snapshot())
+
+
+def test_span_disabled_records_nothing():
+    assert not obs.enabled()
+    with obs.span("ghost"):
+        pass
+    assert obs.default_tracer().spans() == []
+
+
+# -----------------------------------------------------------------------
+# step stats + regression gate
+# -----------------------------------------------------------------------
+def test_stepstats_ring_and_percentiles():
+    st = obs.StepStats(capacity=100)
+    for v in range(1, 101):
+        st.record(v / 1000.0)
+    assert st.count == 100
+    assert st.p50() == pytest.approx(0.050)
+    assert st.p99() == pytest.approx(0.099)
+    # rollover: 50 more samples push the window past capacity
+    for v in range(101, 151):
+        st.record(v / 1000.0)
+    w = st.window()
+    assert len(w) == 100 and st.count == 150
+    assert min(w) == pytest.approx(0.051)  # oldest 50 rotated out
+    s = st.summary()
+    assert s["count"] == 150 and s["window"] == 100
+    assert s["max_s"] == pytest.approx(0.150)
+    assert s["last_s"] == pytest.approx(0.150)
+
+
+def test_regression_verdicts():
+    v = obs.regression_verdict("m", baseline=100.0, current=99.0)
+    assert v["verdict"] == "pass"  # within 5%
+    v = obs.regression_verdict("m", baseline=100.0, current=90.0)
+    assert v["verdict"] == "fail" and v["delta_pct"] == pytest.approx(-10.0)
+    # lower-is-better (step time): +10% is a fail
+    v = obs.regression_verdict("t", 1.0, 1.1, higher_is_better=False,
+                               tolerance=0.05)
+    assert v["verdict"] == "fail"
+    v = obs.regression_verdict("t", 1.0, 1.02, higher_is_better=False)
+    assert v["verdict"] == "pass"
+    assert obs.regression_verdict("m", None, 1.0)["verdict"] == "no_baseline"
+
+
+def test_gate_results_direction_follows_metric_name(tmp_path):
+    """bytes/step (BENCH_COST_ONLY) and duration metrics gate on RISING
+    above baseline, not falling below it."""
+    p = str(tmp_path / "base.json")
+    json.dump({"resnet50_bytes_per_step": 100.0}, open(p, "w"))
+    worse = obs.gate_results(
+        [{"metric": "resnet50_bytes_per_step", "value": 120.0}], p)
+    better = obs.gate_results(
+        [{"metric": "resnet50_bytes_per_step", "value": 80.0}], p)
+    assert worse[0]["verdict"] == "fail"
+    assert better[0]["verdict"] == "pass"
+
+
+def test_tracer_is_bounded(obs_on):
+    t = obs.Tracer(capacity=4)
+    for i in range(6):
+        with t.span(f"s{i}"):
+            pass
+    spans = t.spans()
+    assert len(spans) == 4 and t.dropped == 2
+    assert [s.name for s in spans] == ["s2", "s3", "s4", "s5"]  # newest kept
+    t.clear()
+    assert t.spans() == [] and t.dropped == 0
+
+
+def test_gate_results_against_bench_artifact(tmp_path):
+    baseline = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 2000.0, "unit": "images/sec",
+        "extra_metrics": [
+            {"metric": "transformer_train_tokens_per_sec_per_chip",
+             "value": 100000.0}],
+    }
+    p = str(tmp_path / "base.json")
+    json.dump(baseline, open(p, "w"))
+    results = [
+        {"metric": "resnet50_train_images_per_sec_per_chip", "value": 2100.0},
+        {"metric": "transformer_train_tokens_per_sec_per_chip",
+         "value": 80000.0},
+        {"metric": "unbaselined_metric", "value": 1.0},
+    ]
+    verdicts = obs.gate_results(results, p)
+    by = {v["metric"]: v for v in verdicts}
+    assert len(verdicts) == 2
+    assert by["resnet50_train_images_per_sec_per_chip"]["verdict"] == "pass"
+    assert by["transformer_train_tokens_per_sec_per_chip"]["verdict"] == "fail"
+
+
+# -----------------------------------------------------------------------
+# executor wiring
+# -----------------------------------------------------------------------
+def test_executor_step_telemetry(obs_on):
+    exe, loss = _build_step()
+    obs.reset()  # drop the startup-program run's records
+    for i in range(3):
+        exe.run(feed=_feed(i), fetch_list=[loss])
+    reg = obs.default_registry()
+    h = reg.histogram("paddle_tpu_executor_step_seconds", "")
+    assert h.series_summary()["count"] == 3
+    # first post-reset run compiled fresh (miss), then cache hits
+    cc = reg.counter("paddle_tpu_compile_cache", "")
+    assert cc.value(result="miss") == 1
+    assert cc.value(result="hit") == 2
+    # donation is the serial executor default
+    assert reg.counter("paddle_tpu_executor_steps", "").value(
+        donated="1") == 3
+    assert obs.step_stats().count == 3
+    assert obs.step_stats().p50() > 0
+    names = [s.name for s in obs.default_tracer().spans()]
+    assert names.count("executor.step") == 3
+    assert "compile" in names  # the fresh compile rode in a span
+
+
+def test_executor_sentinel_skip_metrics(obs_on):
+    exe, loss = _build_step(name="obs_nan_w")
+    fluid.set_flags({"FLAGS_check_numerics": True,
+                     "FLAGS_check_numerics_max_consecutive": 5})
+    try:
+        obs.reset()
+        exe.run(feed=_feed(0), fetch_list=[loss])
+        exe.run(feed=_feed(1, bad=True), fetch_list=[loss])  # skipped
+        exe.run(feed=_feed(2), fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_numerics": False,
+                         "FLAGS_check_numerics_max_consecutive": 3})
+    reg = obs.default_registry()
+    assert reg.counter("paddle_tpu_executor_skipped_steps", "").value() == 1
+    assert reg.counter("paddle_tpu_sentinel_trips", "").value(
+        var="loss_mean") >= 0  # labeled by offending var; total below
+    total = sum(
+        s["value"] for s in reg.counter(
+            "paddle_tpu_sentinel_trips", "").snapshot()["series"])
+    assert total == 1
+    # the skipped step still landed in the step histogram
+    assert reg.histogram("paddle_tpu_executor_step_seconds",
+                         "").series_summary()["count"] == 3
+
+
+def test_executor_cost_attribution_native(obs_on):
+    exe, loss = _build_step(name="obs_cost_w")
+    fluid.set_flags({"FLAGS_observability_cost": "native"})
+    try:
+        obs.reset()
+        exe.run(feed=_feed(0), fetch_list=[loss])
+        exe.run(feed=_feed(1), fetch_list=[loss])  # same entry: no re-cost
+    finally:
+        fluid.set_flags({"FLAGS_observability_cost": "off"})
+    g = obs.default_registry().gauge("paddle_tpu_cost_bytes_per_step", "")
+    series = g.snapshot()["series"]
+    assert len(series) == 1  # once per compiled entry
+    assert series[0]["value"] > 0
+    assert series[0]["labels"]["platform"] == "native"
+    assert series[0]["labels"]["fused_regions"] == "0"
+
+
+def test_device_memory_watermarks(obs_on):
+    class FakeDev:
+        id = 3
+
+        def __init__(self):
+            self.stats = {"bytes_in_use": 100.0}
+
+        def memory_stats(self):
+            return self.stats
+
+    dev = FakeDev()
+    obs.record_device_memory(dev)
+    reg = obs.default_registry()
+    in_use = reg.gauge("paddle_tpu_device_bytes_in_use", "")
+    peak = reg.gauge("paddle_tpu_device_peak_bytes_in_use", "")
+    assert in_use.value(device="3") == 100.0
+    # no allocator peak -> monotonic max of samples
+    assert peak.value(device="3") == 100.0
+    dev.stats = {"bytes_in_use": 60.0}
+    obs.record_device_memory(dev)
+    assert in_use.value(device="3") == 60.0
+    assert peak.value(device="3") == 100.0  # watermark holds
+    # allocator-reported peak wins when present (TPU backends)
+    dev.stats = {"bytes_in_use": 80.0, "peak_bytes_in_use": 500.0}
+    obs.record_device_memory(dev)
+    assert peak.value(device="3") == 500.0
+    # stats-less backends (CPU jax) are silently skipped
+    class NoStats:
+        def memory_stats(self):
+            return None
+
+    obs.record_device_memory(NoStats())
+
+
+def test_histogram_rejects_conflicting_buckets(obs_on):
+    reg = obs.MetricsRegistry()
+    reg.histogram("h", "", buckets=[1.0, 10.0]).observe(5.0)
+    reg.histogram("h", "")  # no buckets requested: fine
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("h", "", buckets=[0.1, 1.0])
+
+
+def test_disabled_path_zero_observability_overhead(monkeypatch):
+    """Acceptance: with the flag off the per-step path is one flag check
+    — no observability calls, and NO allocations attributed to the
+    observability package (tracemalloc filename filter)."""
+    import tracemalloc
+
+    assert not obs.enabled()
+    exe, loss = _build_step(name="obs_cold_w")
+    for i in range(2):  # warm the compile + caches
+        exe.run(feed=_feed(i), fetch_list=[loss])
+
+    calls = []
+    monkeypatch.setattr(obs, "record_executor_step",
+                        lambda *a, **k: calls.append(1))
+    monkeypatch.setattr(obs, "record_compile_cache",
+                        lambda *a, **k: calls.append(1))
+    obs_pkg_dir = os.path.dirname(os.path.abspath(obs.__file__))
+    tracemalloc.start()
+    try:
+        for i in range(3):
+            exe.run(feed=_feed(i), fetch_list=[loss])
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    assert calls == []  # no instrument reached
+    hits = snap.filter_traces(
+        [tracemalloc.Filter(True, os.path.join(obs_pkg_dir, "*"))]
+    ).statistics("filename")
+    assert hits == [], f"observability allocated while disabled: {hits}"
+    # control: the SAME steps with the flag on do reach the instruments
+    fluid.set_flags({"FLAGS_observability": True})
+    try:
+        exe.run(feed=_feed(0), fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+    assert calls
+
+
+# -----------------------------------------------------------------------
+# resilience / elastic accounting (satellite: surfaced, not dropped)
+# -----------------------------------------------------------------------
+def test_retry_stats_filled_on_success_and_exhaustion(obs_on):
+    from paddle_tpu.resilience import retry_with_backoff
+
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("down")
+        return "ok"
+
+    stats = {}
+    out = retry_with_backoff(flaky, retries=5, base_delay=0.001,
+                             sleep=lambda s: None, stats=stats,
+                             label="test")
+    assert out == "ok"
+    assert stats["attempts"] == 3 and stats["retries"] == 2
+    assert stats["backoff_s"] > 0
+    assert obs.default_registry().counter(
+        "paddle_tpu_resilience_retries", "").value(
+            label="test", error="ConnectionError") == 2
+
+    stats2 = {}
+    with pytest.raises(TimeoutError):
+        retry_with_backoff(lambda: (_ for _ in ()).throw(TimeoutError()),
+                           retries=2, base_delay=0.001,
+                           sleep=lambda s: None, stats=stats2)
+    assert stats2["attempts"] == 3 and stats2["retries"] == 2
+
+    # third path: a NON-retryable error after transient retries still
+    # fills stats (the retried attempts must not be undercounted)
+    attempts3 = []
+
+    def then_fatal():
+        attempts3.append(1)
+        if len(attempts3) < 3:
+            raise ConnectionError("transient")
+        raise ValueError("application error")
+
+    stats3 = {}
+    with pytest.raises(ValueError):
+        retry_with_backoff(then_fatal, retries=5, base_delay=0.001,
+                           sleep=lambda s: None, stats=stats3)
+    assert stats3["attempts"] == 3 and stats3["retries"] == 2
+    assert stats3["backoff_s"] > 0
+
+
+def test_checkpoint_manager_save_durations(obs_on, tmp_path):
+    from paddle_tpu.resilience import CheckpointManager
+
+    exe, loss = _build_step(name="obs_ck_w")
+    exe.run(feed=_feed(0), fetch_list=[loss])
+    mgr = CheckpointManager(str(tmp_path / "run"), keep_last=2)
+    h = mgr.save(1)
+    assert h is not None and h.done()
+    assert h.stats["step"] == 1
+    assert h.stats["save_seconds"] > 0
+    assert h.stats["gc_seconds"] >= 0
+    assert h.stats["total_seconds"] >= h.stats["save_seconds"]
+    # async: stats complete after wait()
+    h2 = mgr.save(2, asynchronous=True)
+    h2.wait()
+    assert h2.stats["save_seconds"] > 0
+    reg = obs.default_registry()
+    assert reg.counter("paddle_tpu_checkpoint_saves", "").value(
+        result="ok") == 2
+    assert reg.histogram("paddle_tpu_checkpoint_save_seconds",
+                         "").series_summary()["count"] == 2
+    assert "ckpt.save" in [s.name for s in obs.default_tracer().spans()]
+
+
+def test_remote_master_retry_stats_accumulate(obs_on, monkeypatch):
+    from paddle_tpu.elastic.rpc import RemoteMaster
+
+    rm = RemoteMaster("127.0.0.1:1")  # nothing listens; no connect yet
+    calls = []
+
+    def call_once(req):
+        calls.append(1)
+        if len(calls) < 2:
+            raise ConnectionError("transient")
+        return {"ok": True, "counts": {"cur_pass": 0}}
+
+    monkeypatch.setattr(rm, "_call_once", call_once)
+    monkeypatch.setattr(rm, "_retry_base_delay", 0.0)
+    assert rm.counts() == {"cur_pass": 0}
+    assert rm.retry_stats["calls"] == 1
+    assert rm.retry_stats["retries"] == 1
+    assert rm.last_call_retries == 1
+
+
+# -----------------------------------------------------------------------
+# run artifacts + obsdump + bench integration
+# -----------------------------------------------------------------------
+def test_export_run_artifacts_and_obsdump(obs_on, tmp_path):
+    exe, loss = _build_step(name="obs_art_w")
+    obs.reset()
+    for i in range(4):
+        exe.run(feed=_feed(i), fetch_list=[loss])
+    base = str(tmp_path / "base.json")
+    json.dump({"toy_metric": 100.0}, open(base, "w"))
+    d = str(tmp_path / "run")
+    report = obs.export_run(
+        d, results=[{"metric": "toy_metric", "value": 99.0}],
+        baseline_path=base)
+    assert sorted(os.listdir(d)) == [
+        "metrics.json", "metrics.prom", "report.json", "trace.json"]
+    assert report["step_time"]["count"] == 4
+    assert report["regression"][0]["verdict"] == "pass"
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    assert "paddle_tpu_executor_step_seconds_bucket" in prom
+    assert "paddle_tpu_compile_cache_total" in prom
+    with open(os.path.join(d, "trace.json")) as f:
+        doc = json.load(f)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in doc["traceEvents"])
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsdump.py"), d],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "p50" in out.stdout
+    assert "paddle_tpu_executor_step_seconds" in out.stdout
+    assert "[PASS]" in out.stdout
+    # --gate turns a fail verdict into a nonzero exit
+    json.dump({"toy_metric": 1000.0}, open(base, "w"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsdump.py"), d,
+         "--baseline", base, "--gate"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 3
+    assert "[FAIL]" in out.stdout
+
+
+def _bench_obs_env(monkeypatch, tmp_path, model, bs):
+    monkeypatch.setenv("BENCH_MODELS", model)
+    monkeypatch.setenv("BENCH_BS", bs)
+    monkeypatch.setenv("BENCH_STEPS", "2")
+    monkeypatch.setenv("BENCH_TUNE", "0")
+    monkeypatch.setenv("BENCH_AMP", "0")
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "0")
+    monkeypatch.setenv("BENCH_PREPROBE", "0")
+    monkeypatch.setenv("BENCH_CKPT_DIR", "")
+    monkeypatch.setenv("BENCH_OBS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("BENCH_BASELINE", str(tmp_path / "base.json"))
+
+
+def _assert_bench_obs_artifacts(rec, tmp_path, metric):
+    # (c) report with p50/p99 + baseline delta verdict
+    assert rec["observability"]["steps_recorded"] >= 2
+    assert rec["observability"]["step_time_p50_s"] > 0
+    assert rec["regression"][0]["metric"] == metric
+    assert rec["regression"][0]["verdict"] == "pass"
+    d = str(tmp_path / "obs")
+    report = json.load(open(os.path.join(d, "report.json")))
+    assert report["step_time"]["p99_s"] > 0
+    assert report["regression"][0]["verdict"] == "pass"
+    # (a) Prometheus snapshot with step-time histogram + compile-cache
+    # counters
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    assert "paddle_tpu_executor_step_seconds_bucket" in prom
+    assert 'paddle_tpu_compile_cache_total{result="miss"}' in prom
+    # (b) merged chrome trace with named threads
+    doc = json.load(open(os.path.join(d, "trace.json")))
+    metas = [e for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert metas
+    xs = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "executor.step" in xs and "bench.model" in xs
+
+
+def _run_bench_obs(monkeypatch, capsys, tmp_path, model, bs, metric):
+    import bench
+
+    _bench_obs_env(monkeypatch, tmp_path, model, bs)
+    json.dump({metric: 0.001}, open(str(tmp_path / "base.json"), "w"))
+    fluid.set_flags({"FLAGS_observability": True})
+    obs.reset()
+    try:
+        bench.main()
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+        fluid.disable_amp()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        obs.reset()  # artifacts are on disk; keep later tests clean
+    rec = json.loads(line)
+    assert rec["metric"] == metric, rec
+    _assert_bench_obs_artifacts(rec, tmp_path, metric)
+
+
+def test_bench_observability_smoke_lenet(monkeypatch, capsys, tmp_path):
+    """Tier-1 shape of the acceptance run: FLAGS_observability on, a
+    bench smoke produces (a) Prometheus metrics with the step-time
+    histogram + compile-cache counters, (b) a merged named-thread chrome
+    trace, (c) a report with p50/p99 + baseline verdict."""
+    _run_bench_obs(monkeypatch, capsys, tmp_path, "lenet", "4",
+                   "mnist_train_images_per_sec_per_chip")
+
+
+@pytest.mark.slow
+def test_bench_observability_smoke_resnet50(monkeypatch, capsys, tmp_path):
+    """The literal acceptance criterion (ResNet-50), CPU-sized; slow —
+    tier-1 proves the same path on lenet."""
+    _run_bench_obs(monkeypatch, capsys, tmp_path, "resnet50", "2",
+                   "resnet50_train_images_per_sec_per_chip")

@@ -1,12 +1,42 @@
-"""Timeline export (reference: tools/timeline.py chrome-trace generation)."""
+"""Timeline export (reference: tools/timeline.py chrome-trace generation).
+
+Round-trip coverage for the profiler/timeline export rebased onto the
+observability span writer: the JSON loads, spans nest, durations are
+non-negative, Perfetto rows are labeled (thread_name metadata events),
+and per-thread tids are stable (main thread pinned to 0)."""
 
 import json
 import os
 import tempfile
+import threading
 import time
+
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import profiler, timeline
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """The observability tracer is process-global; a span another test
+    left behind must not leak into the merged export counts."""
+    from paddle_tpu import observability as obs
+
+    obs.default_tracer().clear()
+    yield
+    obs.default_tracer().clear()
+
+
+def _export(path):
+    n = timeline.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    return n, doc
+
+
+def _xs(doc):
+    return [e for e in doc["traceEvents"] if e["ph"] == "X"]
 
 
 def test_chrome_trace_export():
@@ -19,20 +49,109 @@ def test_chrome_trace_export():
             time.sleep(0.001)
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "trace.json")
-        n = timeline.export_chrome_trace(path)
+        n, doc = _export(path)
         assert n == 3
-        with open(path) as f:
-            doc = json.load(f)
-        names = {e["name"] for e in doc["traceEvents"]}
+        xs = _xs(doc)
+        names = {e["name"] for e in xs}
         assert names == {"step", "forward", "backward"}
-        for e in doc["traceEvents"]:
-            assert e["ph"] == "X" and e["dur"] > 0
+        for e in xs:
+            assert e["dur"] > 0
         # nesting: forward is contained within step
-        by = {e["name"]: e for e in doc["traceEvents"]}
+        by = {e["name"]: e for e in xs}
         assert by["step"]["ts"] <= by["forward"]["ts"]
         assert (by["forward"]["ts"] + by["forward"]["dur"]
                 <= by["step"]["ts"] + by["step"]["dur"] + 1)
     profiler.stop_profiler()
+    profiler.reset_profiler()
+
+
+def test_chrome_trace_thread_names_and_stable_tids():
+    """Satellite: thread_name metadata events + stable per-thread tids
+    (the old export emitted insertion-order ints with no names, leaving
+    Perfetto rows unlabeled)."""
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+
+    def worker():
+        with profiler.record_event("io"):
+            time.sleep(0.002)
+
+    with profiler.record_event("main_work"):
+        t = threading.Thread(target=worker, name="reader-0")
+        t.start()
+        t.join()
+    profiler.stop_profiler()
+    with tempfile.TemporaryDirectory() as d:
+        n, doc = _export(os.path.join(d, "t.json"))
+        assert n == 2
+        xs = {e["name"]: e for e in _xs(doc)}
+        metas = {e["tid"]: e["args"]["name"]
+                 for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        # main thread is pinned to tid 0 and both rows are labeled
+        assert xs["main_work"]["tid"] == 0
+        assert metas[0] == threading.main_thread().name
+        assert metas[xs["io"]["tid"]] == "reader-0"
+        assert xs["io"]["tid"] != 0
+        # stable across exports: the same spans map to the same tids
+        n2, doc2 = _export(os.path.join(d, "t2.json"))
+        assert {e["name"]: e["tid"] for e in _xs(doc2)} == {
+            e["name"]: e["tid"] for e in _xs(doc)}
+    profiler.reset_profiler()
+
+
+def test_chrome_trace_merges_observability_spans():
+    """One merged trace per run: profiler record_event spans (cat host)
+    and observability spans (cat obs) land in the same file."""
+    from paddle_tpu import observability as obs
+
+    profiler.reset_profiler()
+    obs.default_tracer().clear()
+    fluid.set_flags({"FLAGS_observability": True})
+    try:
+        profiler.start_profiler("All")
+        with profiler.record_event("host_evt"):
+            with obs.span("obs_evt"):
+                pass
+        profiler.stop_profiler()
+        with tempfile.TemporaryDirectory() as d:
+            n, doc = _export(os.path.join(d, "m.json"))
+            assert n == 2
+            by = {e["name"]: e for e in _xs(doc)}
+            assert by["host_evt"]["cat"] == "host"
+            assert by["obs_evt"]["cat"] == "obs"
+            # same thread -> same row; obs span nested inside host event
+            assert by["obs_evt"]["tid"] == by["host_evt"]["tid"]
+            assert by["host_evt"]["ts"] <= by["obs_evt"]["ts"]
+        # include_observability=False keeps the profiler-only view
+        with tempfile.TemporaryDirectory() as d:
+            n = timeline.export_chrome_trace(
+                os.path.join(d, "p.json"), include_observability=False)
+            assert n == 1
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+        obs.default_tracer().clear()
+        profiler.reset_profiler()
+
+
+def test_timeline_class_roundtrip():
+    """Timeline(...).generate_chrome_trace_file round-trip: loads as
+    JSON, every complete event has non-negative duration."""
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    for i in range(3):
+        with profiler.record_event(f"evt_{i}"):
+            pass
+    profiler.stop_profiler()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "tl.json")
+        n = timeline.Timeline(None).generate_chrome_trace_file(path)
+        assert n == 3
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["displayTimeUnit"] == "ms"
+        for e in _xs(doc):
+            assert e["dur"] >= 0 and e["ts"] >= 0
     profiler.reset_profiler()
 
 

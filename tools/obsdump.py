@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Render an observability run directory into a human-readable report.
+
+A run directory is what `observability.export_run(dir)` (or a
+FLAGS_observability=1 bench.py run with BENCH_OBS_DIR) leaves behind:
+
+    metrics.prom     Prometheus text exposition (scrape-ready)
+    metrics.json     registry snapshot (metrics_<pid>.json per process on
+                     multi-host runs; this CLI aggregates them all)
+    trace.json       merged Chrome/Perfetto trace (load in ui.perfetto.dev)
+    report.json      step-time summary + regression verdicts
+
+Usage:
+    python tools/obsdump.py <run_dir> [--baseline BENCH.json] [--tol 0.05]
+           [--gate]
+
+--baseline re-gates the run's results against a banked bench artifact (a
+previous bench.py JSON line or a plain {metric: value} mapping), printing
+pass/fail deltas; --gate makes a fail verdict exit nonzero (CI wiring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v:.3f}s"
+
+
+def _load_report(run_dir: str) -> dict:
+    path = os.path.join(run_dir, "report.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def _aggregate_metrics(run_dir: str):
+    from paddle_tpu.observability import MetricsRegistry
+
+    has_snap = any(
+        fn.startswith("metrics") and fn.endswith(".json")
+        for fn in os.listdir(run_dir))
+    if not has_snap:
+        return None
+    reg = MetricsRegistry()
+    for fn in sorted(os.listdir(run_dir)):
+        if fn.startswith("metrics") and fn.endswith(".json"):
+            with open(os.path.join(run_dir, fn)) as f:
+                reg.merge(json.load(f))
+    return reg
+
+
+def _print_step_time(report: dict, out) -> None:
+    st = report.get("step_time") or {}
+    out.write("== step time ==\n")
+    if not st.get("count"):
+        out.write("  (no steps recorded)\n")
+        return
+    out.write(f"  steps recorded : {st['count']} "
+              f"(window {st['window']})\n")
+    for k, label in (("p50_s", "p50"), ("p90_s", "p90"), ("p99_s", "p99"),
+                     ("mean_s", "mean"), ("min_s", "min"),
+                     ("max_s", "max")):
+        out.write(f"  {label:<5}: {_fmt_s(st.get(k))}\n")
+
+
+def _print_metrics(reg, out) -> None:
+    out.write("== metrics ==\n")
+    snap = reg.snapshot()
+    for m in snap["metrics"]:
+        if m["type"] == "histogram":
+            for s in m["series"]:
+                lbl = _labels(s)
+                out.write(
+                    f"  {m['name']}{lbl}: count={s['count']} "
+                    f"mean={_fmt_s(s['sum'] / s['count']) if s['count'] else '-'} "
+                    f"min={_fmt_s(s.get('min'))} max={_fmt_s(s.get('max'))}\n")
+        else:
+            for s in m["series"]:
+                out.write(f"  {m['name']}{_labels(s)} = {s['value']:g}\n")
+
+
+def _labels(series: dict) -> str:
+    lab = series.get("labels") or {}
+    if not lab:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(lab.items())) + "}"
+
+
+def _print_regression(verdicts, out) -> bool:
+    """Returns True when any verdict failed."""
+    out.write("== regression gate ==\n")
+    if not verdicts:
+        out.write("  (no baseline)\n")
+        return False
+    failed = False
+    for v in verdicts:
+        verdict = v.get("verdict", "?")
+        failed = failed or verdict == "fail"
+        if "delta_pct" in v:
+            sign = "+" if v["delta_pct"] >= 0 else ""
+            out.write(
+                f"  [{verdict.upper():4}] {v.get('metric')}: "
+                f"{v.get('current')} vs baseline {v.get('baseline')} "
+                f"({sign}{v['delta_pct']:.2f}%, tol "
+                f"{v.get('tolerance_pct')}%)\n")
+        else:
+            out.write(f"  [{verdict.upper():4}] {v.get('metric', '?')}\n")
+    return failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir")
+    ap.add_argument("--baseline", default=None,
+                    help="bench artifact / {metric: value} JSON to re-gate "
+                         "against (defaults to the verdicts banked in "
+                         "report.json)")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="relative tolerance for --baseline (default 0.05)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when a regression verdict fails")
+    args = ap.parse_args(argv)
+    out = sys.stdout
+
+    if not os.path.isdir(args.run_dir):
+        sys.stderr.write(f"obsdump: {args.run_dir} is not a directory\n")
+        return 2
+    report = _load_report(args.run_dir)
+    out.write(f"observability run: {os.path.abspath(args.run_dir)}\n")
+    _print_step_time(report, out)
+
+    reg = _aggregate_metrics(args.run_dir)
+    if reg is not None:
+        _print_metrics(reg, out)
+
+    verdicts = report.get("regression") or []
+    if args.baseline:
+        from paddle_tpu.observability import gate_results
+
+        verdicts = gate_results(
+            report.get("results") or [], args.baseline, tolerance=args.tol)
+    failed = _print_regression(verdicts, out)
+
+    trace = os.path.join(args.run_dir, "trace.json")
+    if os.path.exists(trace):
+        with open(trace) as f:
+            n = sum(1 for e in json.load(f).get("traceEvents", [])
+                    if e.get("ph") == "X")
+        out.write(f"== trace ==\n  {trace}: {n} spans "
+                  "(load in ui.perfetto.dev)\n")
+    return 3 if (args.gate and failed) else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        os._exit(0)

@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.clip",
     "paddle_tpu.metrics",
+    "paddle_tpu.observability",
     "paddle_tpu.profiler",
     "paddle_tpu.timeline",
     "paddle_tpu.flags",
