@@ -80,6 +80,7 @@ from . import io  # noqa: F401
 from . import reader  # noqa: F401
 from . import recordio  # noqa: F401
 from . import resilience  # noqa: F401
+from . import serving  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import batch  # noqa: F401
 from . import metrics  # noqa: F401

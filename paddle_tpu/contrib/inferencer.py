@@ -5,6 +5,7 @@ private scope)."""
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 from .. import io as fluid_io
@@ -25,7 +26,14 @@ class Inferencer:
         param_path: directory save_params/save_persistables wrote.
         place: CPUPlace/TPUPlace; defaults to TPU when available.
         parallel: accepted for API parity; XLA owns intra-chip parallelism.
-    """
+
+    infer() routes through a serving.Engine in pass-through mode (one
+    request per dispatch, feed forwarded verbatim — LoD feeds included):
+    every call shares the executor's compiled-program cache through ONE
+    ExecutorBackend and gains the engine's deadline/metrics story for
+    free (serving.RequestTimeoutError on expiry; queue-depth/latency
+    instruments under FLAGS_observability).  The public signature is
+    unchanged."""
 
     def __init__(self, infer_func: Callable, param_path: str, place=None,
                  parallel: bool = False):
@@ -48,17 +56,39 @@ class Inferencer:
             self.exe.run(self.startup_program)
             fluid_io.load_persistables(
                 self.exe, param_path, main_program=self.inference_program)
+        self._engine = None  # built lazily on the first infer()
+        self._engine_lock = threading.Lock()
 
-    def infer(self, inputs: dict, return_numpy: bool = True):
+    def _get_engine(self):
+        # double-checked under a lock: concurrent first infer() calls
+        # must not each build (and half-leak) a dispatcher thread
+        if self._engine is None:
+            with self._engine_lock:
+                if self._engine is None:
+                    from ..serving import Engine, EngineConfig
+
+                    # buckets=() selects pass-through mode: no
+                    # concat/pad/split, so arbitrary feed shapes (and
+                    # LoD values) ride untouched
+                    self._engine = Engine.from_program(
+                        self.exe, self.inference_program, self.predict_vars,
+                        scope=self.scope, feed_names=None,
+                        config=EngineConfig(buckets=()), name="inferencer")
+        return self._engine
+
+    def infer(self, inputs: dict, return_numpy: bool = True,
+              timeout: Optional[float] = None):
         """inputs: {var name: numpy array} (reference: inferencer.py:80)."""
         if not isinstance(inputs, dict):
             raise ValueError(
                 "inputs should be a map of {'input_name': input_var}"
             )
-        with scope_guard(self.scope):
-            results = self.exe.run(
-                program=self.inference_program, feed=inputs,
-                fetch_list=self.predict_vars,
-                return_numpy=return_numpy,
-            )
-        return results
+        return self._get_engine().infer(
+            inputs, timeout=timeout,
+            call_kwargs={"return_numpy": return_numpy})
+
+    def close(self) -> None:
+        """Drain and stop the serving engine (idempotent)."""
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None

@@ -102,6 +102,15 @@ _DEFS: Dict[str, Any] = {
     # banks a win (defaults follow measurements); the bytes/step win is
     # CPU-verifiable via Executor.cost_analysis (tests/test_conv_fusion_pass.py)
     "FLAGS_fuse_conv_epilogue": False,
+    # serving (paddle_tpu/serving/): the dynamic batcher's batch-size
+    # bucket ladder.  Queued requests coalesce into micro-batches padded
+    # UP to the smallest bucket that fits, so a polymorphic-batch AOT
+    # artifact (or an executor program) compiles at most once per bucket
+    # and never again — arbitrary-size batching would compile every
+    # batch size traffic ever produces.  Engine-level knobs (max wait,
+    # queue depth, deadlines) live on serving.EngineConfig; this flag
+    # only sets the process default ladder
+    "FLAGS_serving_buckets": "1,2,4,8,16",
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.

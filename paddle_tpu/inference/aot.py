@@ -172,9 +172,14 @@ def load_compiled_inference_model(
 
     with open(os.path.join(dirname, _META)) as f:
         meta = json.load(f)
+    # pre-symbolic_error artifacts (older exports) still expose the key:
+    # the serving engine's bucket planner reads meta["symbolic_error"] to
+    # explain a collapsed ladder
+    meta.setdefault("symbolic_error", None)
     with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
         exported = jexport.deserialize(f.read())
     feed_names = [fm["name"] for fm in meta["feeds"]]
+    feed_name_set = set(feed_names)
     dtypes = {fm["name"]: np.dtype(fm["dtype"]) for fm in meta["feeds"]}
 
     exported_shapes = meta.get("exported_shapes")
@@ -183,6 +188,13 @@ def load_compiled_inference_model(
         missing = [n for n in feed_names if n not in feed]
         if missing:
             raise KeyError(f"feed is missing {missing}")
+        unknown = [n for n in sorted(feed) if n not in feed_name_set]
+        if unknown:
+            # symmetric with the missing-keys check: a silently ignored
+            # extra feed is almost always a caller-side typo of a real one
+            raise KeyError(
+                f"feed has unknown keys {unknown}; this artifact serves "
+                f"feeds {feed_names}")
         args = [np.ascontiguousarray(feed[n], dtype=dtypes[n])
                 for n in feed_names]
         if exported_shapes is not None:  # static artifact: validate early

@@ -1,0 +1,71 @@
+"""Decode attention over a paged KV cache (serving/kvcache.py pool).
+
+The decode-step contract: one query token per sequence (Sq=1) attends to
+that sequence's cached keys/values, which live scattered across
+fixed-size pages of a shared pool.  Two implementations sit behind ONE
+call signature so the serving loop never changes when the fast path
+lands:
+
+- ``impl="reference"`` (default, any backend): gather the sequence's
+  pages into a contiguous [B, H, S, D] view (S = max pages * page_size
+  over the batch) and run the existing flash_attention ragged
+  ``k_lengths`` tier — the exact masking contract
+  tests/test_serving.py's decode-parity suite pins down.  The gather
+  materializes O(B*S*D) bytes per step; fine for CPU correctness and
+  small batches.
+
+- ``impl="pallas"`` — the explicit follow-up seam (arxiv 2604.15464,
+  Ragged Paged Attention): a kernel whose grid walks each sequence's
+  page table in SMEM and streams K/V pages straight from HBM into the
+  online-softmax recurrence, so no contiguous copy ever exists.  Raises
+  NotImplementedError until that kernel lands; callers select it
+  explicitly, nothing falls back silently.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention
+
+__all__ = ["gather_kv_pages", "paged_decode_attention"]
+
+
+def gather_kv_pages(pages, page_tables):
+    """Reference page gather: pages [P, page_size, H, D] +
+    page_tables [B, max_pages] int32 -> contiguous [B, H, S, D] with
+    S = max_pages * page_size.  Rows past a sequence's length are
+    whatever the padding pages hold — callers MUST mask via k_lengths."""
+    g = jnp.take(pages, page_tables, axis=0)  # [B, max_pages, page, H, D]
+    b, n_pages, page, h, d = g.shape
+    return jnp.transpose(g.reshape(b, n_pages * page, h, d), (0, 2, 1, 3))
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
+                           scale=None, impl: str = "reference",
+                           force: str = "auto"):
+    """q: [B, H, 1, D] decode queries; k_pages/v_pages: [P, page_size,
+    H, D] one layer of the pool; page_tables: [B, max_pages] int32;
+    lengths: [B] valid token counts (the new token already appended).
+
+    Returns [B, H, 1, D].  Causality is implied: the single query IS the
+    last valid position, so masking keys at >= lengths is exactly the
+    causal frontier — the kernel runs with causal=False and the ragged
+    k_lengths mask doing the work.
+
+    `force` forwards to flash_attention (reference impl only): "auto"
+    picks pallas on TPU / jax elsewhere, "interpret" runs the pallas
+    kernel in interpreter mode for CPU testing."""
+    if impl == "pallas":
+        raise NotImplementedError(
+            "pallas paged-attention (in-place page reads, no gather) is "
+            "the planned fast path — see serving/kvcache.py; use "
+            "impl='reference' meanwhile")
+    if impl != "reference":
+        raise ValueError(f"impl must be 'reference' or 'pallas', got {impl!r}")
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(f"decode query must be [B, H, 1, D], got {q.shape}")
+    k = gather_kv_pages(k_pages, page_tables)
+    v = gather_kv_pages(v_pages, page_tables)
+    return flash_attention(q, k, v, causal=False, scale=scale,
+                           k_lengths=lengths, force=force)

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import signal
 import threading
-from typing import Dict, Tuple
+from typing import Callable, Dict, List, Tuple
 
 __all__ = ["PreemptionDrain"]
 
@@ -31,18 +31,62 @@ class PreemptionDrain:
         self._event = threading.Event()
         self._prev: Dict[int, object] = {}
         self._installed = False
+        self._listeners: List[Callable[[], None]] = []
 
     @property
     def requested(self) -> bool:
         return self._event.is_set()
 
+    def on_request(self, fn: Callable[[], None]) -> None:
+        """Register a callback fired when the drain is requested —
+        components with their own event loops (serving.Engine's
+        dispatcher) react to the notice immediately instead of polling
+        `requested` between steps.  Callbacks may run from SIGNAL
+        context: they must be non-blocking and async-signal-tolerant
+        (set a flag, notify a condition — no I/O, no joins).  A callback
+        registered after the notice fires immediately.
+
+        Deliberately LOCK-FREE: the signal handler runs on the main
+        thread between bytecodes, so taking a lock here that _notify
+        also takes would deadlock the process the moment a SIGTERM lands
+        inside the critical section.  The append/swap race is closed by
+        re-checking the event after the append (callbacks must tolerate
+        a rare duplicate fire — begin_drain-style idempotent setters)."""
+        if self._event.is_set():
+            fn()
+            return
+        self._listeners.append(fn)
+        if self._event.is_set():
+            # the notice raced our append.  Three interleavings: the
+            # handler's swap caught fn (it fired; remove on the NEW list
+            # raises), the swap happened BEFORE the append so fn sits in
+            # the abandoned old list (remove on the new list ALSO
+            # raises, and fn never fired), or the handler hasn't swapped
+            # yet (remove succeeds, we fire).  The two ValueError cases
+            # are indistinguishable here, so fire fn in both — callbacks
+            # are documented duplicate-tolerant, and a duplicate beats a
+            # lost drain notice.
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+            fn()
+
     def request(self) -> None:
         """Programmatic trigger (tests; external orchestrators)."""
+        self._notify()
+
+    def _notify(self) -> None:
         self._event.set()
+        listeners = self._listeners
+        self._listeners = []
+        for fn in listeners:
+            fn()
 
     def _handler(self, signum, frame) -> None:
         # idempotent: repeated notices during the drain are absorbed
-        self._event.set()
+        # (listeners were drained on the first one)
+        self._notify()
 
     def install(self) -> "PreemptionDrain":
         if not self._installed:

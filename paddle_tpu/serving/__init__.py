@@ -1,0 +1,79 @@
+"""Serving tier: a batching inference server with a paged KV-cache
+decode path — the fourth pillar next to train-perf (kernels/fusion),
+resilience, and observability.
+
+Pieces (each usable alone, wired together by the Engine and the decode
+loop):
+
+- **Engine** (engine.py) — wraps a loaded AOT artifact
+  (inference/aot.py) or an Executor-compiled Program behind a
+  thread-safe ``submit(feed) -> Future`` API: a single dispatcher
+  coalesces queued requests into micro-batches padded to a fixed bucket
+  ladder (``FLAGS_serving_buckets``), so the backend compiles at most
+  once per bucket regardless of the request mix.  Bounded-queue
+  backpressure (QueueFullError), per-request deadlines
+  (RequestTimeoutError), and graceful drain wired to
+  resilience.PreemptionDrain.
+- **Dynamic batcher** (batching.py) — the bucket ladder, row coalescing
+  (replicated-last-row padding, sliced off before completion: per-row
+  outputs stay bit-identical to unbatched calls), and request records.
+- **Paged KV cache** (kvcache.py) — fixed-size page blocks in one
+  preallocated device array per model, per-sequence page tables,
+  alloc/free/defrag accounting; attention reads it through
+  kernels/paged_attention.py (reference gather -> flash_attention
+  ragged ``k_lengths``; in-place Pallas page reads are the explicit
+  follow-up seam).
+- **Continuous batching** (generate.py) — greedy decode that admits
+  waiting sequences the moment finished ones retire, holding batch
+  occupancy (the serving throughput lever) across mixed-length
+  workloads; ``full_decode`` is the full-recompute parity oracle.
+
+Observability (serving/metrics.py): queue-depth/batch-occupancy gauges,
+TTFT and per-token latency histograms, page-pool utilization, and
+admission/reject counters — all behind FLAGS_observability with the
+established one-dict-lookup disabled path.  tools/serve_bench.py is the
+closed-loop load generator + regression gate.
+"""
+
+from .batching import BucketLadder, parse_buckets
+from .engine import (
+    AotBackend,
+    Engine,
+    EngineClosedError,
+    EngineConfig,
+    ExecutorBackend,
+    QueueFullError,
+    RequestTimeoutError,
+)
+from .generate import (
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    GeneratedSequence,
+    full_decode,
+    full_forward,
+    init_decode_params,
+)
+from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
+
+__all__ = [
+    "AotBackend",
+    "BucketLadder",
+    "ContinuousBatchingLoop",
+    "DecodeConfig",
+    "DecodeRequest",
+    "Engine",
+    "EngineClosedError",
+    "EngineConfig",
+    "ExecutorBackend",
+    "GeneratedSequence",
+    "KVCachePool",
+    "PagePoolExhausted",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "SequenceHandle",
+    "full_decode",
+    "full_forward",
+    "init_decode_params",
+    "parse_buckets",
+]

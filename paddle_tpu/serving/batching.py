@@ -1,0 +1,149 @@
+"""Dynamic batcher primitives: bucket ladder, request records, padding.
+
+The throughput lever at serving time is batch occupancy, not kernel speed
+(arxiv 2605.25645: the Gemma-on-TPU serving comparison): a request served
+alone leaves most of the chip idle, so queued requests are coalesced into
+micro-batches.  But XLA compiles per shape — batching at ARBITRARY sizes
+would compile every batch size traffic ever produces.  The ladder fixes
+that: batches are padded up to a small fixed set of bucket sizes (default
+``FLAGS_serving_buckets`` = 1/2/4/8/16), so the polymorphic-batch StableHLO
+artifact compiles once per bucket and never again, regardless of the
+request mix.  Padding replicates the last real row (a zeros pad can push
+exotic models through log/divide domain errors; a replicated row is always
+in-distribution) and the pad rows are sliced off before completion.
+
+Numerics contract: coalesce/pad/slice itself is EXACT — a request's rows
+come back bit-identical to running the model once at the bucket's batch
+size with those rows in it.  Whether that also equals an unbatched
+predict() bit-for-bit depends on the model: rows are independent in
+inference-mode programs, but XLA specializes kernels per batch size, and
+a large matmul may pick a different reduction tiling at batch 1 vs batch
+8 (observed: lenet5 rows differ by ~1 ulp across buckets; the small-conv
+tier-1 model is bit-stable, and that exact equality is asserted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+
+__all__ = ["BucketLadder", "Request", "pad_rows", "parse_buckets"]
+
+
+def parse_buckets(spec: Optional[str] = None) -> Tuple[int, ...]:
+    """Parse a ladder spec ("1,2,4,8,16") into sorted unique bucket sizes;
+    `None` reads FLAGS_serving_buckets."""
+    if spec is None:
+        spec = _flags.flag("serving_buckets")
+    if isinstance(spec, (tuple, list)):
+        vals = [int(v) for v in spec]
+    else:
+        vals = [int(p) for p in str(spec).split(",") if p.strip()]
+    if not vals:
+        return ()
+    if any(v <= 0 for v in vals):
+        raise ValueError(f"bucket sizes must be positive, got {vals}")
+    return tuple(sorted(set(vals)))
+
+
+class BucketLadder:
+    """Smallest-bucket-that-fits lookup over a fixed sorted ladder."""
+
+    def __init__(self, buckets: Sequence[int]):
+        self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
+        if any(b <= 0 for b in self.buckets):
+            raise ValueError(f"bucket sizes must be positive: {self.buckets}")
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1] if self.buckets else 0
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest bucket >= rows (rows must fit the ladder)."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        raise ValueError(
+            f"{rows} rows exceed the largest bucket {self.max_bucket} "
+            f"(ladder {self.buckets})")
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    def __repr__(self) -> str:
+        return f"BucketLadder{self.buckets}"
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued inference request: a feed dict with a shared leading
+    batch dim (`rows`; 0 for pass-through mode where the engine never
+    splits), its completion future, and deadline bookkeeping."""
+
+    feed: Dict[str, Any]
+    future: Future
+    rows: int
+    enqueued_at: float
+    deadline: Optional[float] = None  # absolute perf_counter time
+    call_kwargs: Optional[Dict[str, Any]] = None  # pass-through mode only
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) >= self.deadline
+
+
+def request_rows(feed: Dict[str, Any], feed_names: Sequence[str]) -> int:
+    """Validate that every feed shares one leading batch dim; return it."""
+    rows = None
+    for n in feed_names:
+        a = feed[n]
+        shape = getattr(a, "shape", None)
+        if not shape:
+            raise ValueError(
+                f"feed '{n}' has no leading batch dimension (shape {shape})")
+        if rows is None:
+            rows = int(shape[0])
+        elif int(shape[0]) != rows:
+            raise ValueError(
+                f"feed '{n}' has {int(shape[0])} rows but other feeds in "
+                f"this request have {rows}; one request = one batch")
+    return int(rows or 0)
+
+
+def pad_rows(stacked: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad [rows, ...] up to [bucket, ...] by replicating the last row."""
+    rows = stacked.shape[0]
+    if rows == bucket:
+        return stacked
+    reps = (bucket - rows,) + (1,) * (stacked.ndim - 1)
+    return np.concatenate([stacked, np.tile(stacked[-1:], reps)], axis=0)
+
+
+def coalesce(requests: List[Request], feed_names: Sequence[str],
+             bucket: int) -> Dict[str, np.ndarray]:
+    """Concatenate the requests' feeds row-wise and pad to `bucket`."""
+    feed: Dict[str, np.ndarray] = {}
+    for n in feed_names:
+        parts = [np.asarray(r.feed[n]) for r in requests]
+        stacked = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+        feed[n] = pad_rows(stacked, bucket)
+    return feed
+
+
+def scatter(requests: List[Request], outputs: Sequence[np.ndarray]) -> None:
+    """Slice each request's rows back out of the batched outputs and
+    complete its future."""
+    row = 0
+    for r in requests:
+        sliced = [np.asarray(o[row:row + r.rows]) for o in outputs]
+        row += r.rows
+        if not r.future.set_running_or_notify_cancel():
+            continue  # caller cancelled while queued
+        r.future.set_result(sliced)

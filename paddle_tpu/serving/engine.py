@@ -1,0 +1,598 @@
+"""Batching inference engine: thread-safe submit(feed) -> Future.
+
+One Engine wraps one loaded model — an AOT StableHLO artifact
+(inference/aot.py) or an Executor-compiled Program — behind a bounded
+request queue and a single dispatcher thread:
+
+- **submit() is thread-safe and non-blocking**: callers get a
+  concurrent.futures.Future; the dispatcher coalesces queued requests
+  into micro-batches padded to the bucket ladder (batching.py), runs the
+  backend once per batch, and slices per-request rows back out.
+- **Backpressure** is a bounded queue: submit raises QueueFullError once
+  `queue_depth` requests are pending — callers shed load explicitly
+  instead of the engine buffering unboundedly.
+- **Deadlines**: submit(feed, timeout=...) arms an absolute deadline; a
+  request still queued when it expires fails with RequestTimeoutError
+  (requests already inside a dispatched batch always complete — an XLA
+  dispatch cannot be recalled).
+- **Drain** mirrors resilience.PreemptionDrain semantics: begin_drain()
+  stops admissions (submit raises EngineClosedError), the dispatcher
+  finishes the in-flight batch and every queued request that still has
+  deadline headroom, then parks.  attach_drain(PreemptionDrain) wires
+  SIGTERM straight to begin_drain via the drain's listener hook.
+- **Compile discipline**: every dispatch is padded to a ladder bucket, so
+  the backend sees at most len(buckets) distinct batch shapes for the
+  life of the engine.  The engine counts first-seen shapes
+  (`compile_counters()`) — the serving analogue of the executor's
+  compile-cache hit/miss counters — and tests assert the ladder bound.
+
+Observability (queue depth, batch occupancy, latency histograms,
+admission/reject/timeout counters) gates on FLAGS_observability with the
+established zero-work disabled path: one dict lookup, no allocation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from . import metrics as _smetrics
+from .batching import (
+    BucketLadder,
+    Request,
+    coalesce,
+    parse_buckets,
+    request_rows,
+    scatter,
+)
+
+__all__ = [
+    "Engine",
+    "EngineConfig",
+    "EngineClosedError",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "AotBackend",
+    "ExecutorBackend",
+]
+
+
+class RequestTimeoutError(TimeoutError):
+    """A request's deadline expired before its batch was dispatched."""
+
+
+class QueueFullError(RuntimeError):
+    """The engine's bounded request queue is at queue_depth (backpressure:
+    the caller must shed or retry, the engine will not buffer more)."""
+
+
+class EngineClosedError(RuntimeError):
+    """submit() after begin_drain()/close(): the engine no longer admits
+    new requests (in-flight and queued work still completes)."""
+
+
+class EngineConfig:
+    """Knobs for the dynamic batcher.
+
+    buckets: batch-size ladder (default: FLAGS_serving_buckets).  An
+        EMPTY ladder selects pass-through mode: no concat/pad/split —
+        each request dispatches alone with its feed forwarded verbatim
+        (the Inferencer path; also the only mode that can carry ragged
+        LoD feeds).
+    max_batch: admission cap on rows per request (default: the largest
+        bucket).
+    max_wait_s: how long the oldest queued request may wait for the
+        batch to fill before dispatching anyway.
+    queue_depth: bounded-queue capacity in requests (backpressure).
+    default_timeout_s: deadline applied when submit() passes none.
+    """
+
+    def __init__(self, buckets: Optional[Sequence[int]] = None,
+                 max_batch: Optional[int] = None,
+                 max_wait_s: float = 0.002,
+                 queue_depth: int = 256,
+                 default_timeout_s: Optional[float] = None):
+        self.buckets = (parse_buckets() if buckets is None
+                        else parse_buckets(buckets))
+        self.max_batch = (int(max_batch) if max_batch is not None
+                          else (self.buckets[-1] if self.buckets else 0))
+        self.max_wait_s = float(max_wait_s)
+        self.queue_depth = int(queue_depth)
+        self.default_timeout_s = default_timeout_s
+
+
+class AotBackend:
+    """Adapter over the predict callable load_compiled_inference_model
+    returns (or an artifact directory)."""
+
+    def __init__(self, predict_or_dir):
+        if isinstance(predict_or_dir, str):
+            from ..inference import load_compiled_inference_model
+
+            predict_or_dir = load_compiled_inference_model(predict_or_dir)
+        self.predict = predict_or_dir
+        self.feed_names = list(self.predict.feed_names)
+        self.fetch_names = list(getattr(self.predict, "fetch_names", []))
+        self.meta = dict(getattr(self.predict, "meta", {}) or {})
+
+    def __call__(self, feed: Dict[str, Any]) -> List[np.ndarray]:
+        return self.predict(feed)
+
+
+class ExecutorBackend:
+    """Adapter over a live Executor + Program (+ Scope): every dispatch
+    goes through the executor's compiled-program cache, so the engine and
+    any direct exe.run callers share one compile per program signature."""
+
+    def __init__(self, executor, program, fetch_list,
+                 scope=None, feed_names: Optional[Sequence[str]] = None):
+        self.executor = executor
+        self.program = program
+        self.fetch_list = list(fetch_list)
+        self.scope = scope
+        # feed_names=None skips engine-side feed validation (the executor
+        # keys its cache on whatever names arrive)
+        self.feed_names = list(feed_names) if feed_names is not None else None
+        from ..core.framework import Variable
+
+        self.fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in self.fetch_list
+        ]
+        self.meta: Dict[str, Any] = {}
+
+    def __call__(self, feed: Dict[str, Any], return_numpy: bool = True):
+        from ..core.scope import scope_guard
+
+        if self.scope is not None:
+            with scope_guard(self.scope):
+                return self.executor.run(
+                    self.program, feed=feed, fetch_list=self.fetch_list,
+                    return_numpy=return_numpy)
+        return self.executor.run(
+            self.program, feed=feed, fetch_list=self.fetch_list,
+            return_numpy=return_numpy)
+
+
+def _plan_buckets(backend, requested: Tuple[int, ...]) -> Tuple[Tuple[int, ...], Optional[str]]:
+    """The bucket planner: a static-batch artifact (shape polymorphism
+    failed at export — meta['symbolic_error'] records why) can only run
+    its one exported batch size, so the ladder collapses to it and the
+    reason rides on the engine for debuggability."""
+    meta = getattr(backend, "meta", None) or {}
+    if meta.get("batch") == "static" and requested:
+        shapes = meta.get("exported_shapes") or []
+        static_b = int(shapes[0][0]) if shapes and shapes[0] else 1
+        reason = (
+            f"artifact exported with a STATIC batch of {static_b} "
+            f"(symbolic batch unavailable: {meta.get('symbolic_error')}); "
+            f"ladder {requested} collapsed to ({static_b},)")
+        return (static_b,), reason
+    return requested, None
+
+
+class Engine:
+    """Thread-safe batching front end over one loaded model."""
+
+    def __init__(self, backend, config: Optional[EngineConfig] = None,
+                 name: str = "engine"):
+        self.backend = backend
+        self.config = config or EngineConfig()
+        self.name = name
+        buckets, self.bucket_reason = _plan_buckets(
+            backend, self.config.buckets)
+        self.ladder = BucketLadder(buckets)
+        if self.ladder.buckets:
+            self.max_batch = min(self.config.max_batch or
+                                 self.ladder.max_bucket,
+                                 self.ladder.max_bucket)
+        else:
+            self.max_batch = 0  # pass-through mode
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Request] = []
+        self._closed = False      # no new admissions
+        self._stopped = False     # dispatcher exited
+        self._inflight = 0        # requests inside the current dispatch
+        # first-seen dispatch shapes — the serving compile counters: a
+        # "miss" is a batch shape the backend has never seen (a fresh
+        # XLA specialization for a symbolic artifact / a fresh jit trace
+        # for an executor program), a "hit" reuses one
+        self._shapes_seen: set = set()
+        self._shape_hits = 0
+        self._shape_misses = 0
+        self._dispatched_batches = 0
+        self._dispatched_rows = 0
+        self._occupancy_sum = 0.0
+
+        # trailing feed shapes (everything past the batch dim) each
+        # request must match — seeded from the AOT meta when available,
+        # learned from the first request otherwise.  Validating at
+        # submit() keeps one client's mis-shaped request from failing
+        # the innocent requests coalesced into the same micro-batch.
+        self._trailing: Dict[str, Tuple[int, ...]] = {}
+        for fm in (getattr(backend, "meta", None) or {}).get("feeds", []):
+            self._trailing[fm["name"]] = tuple(int(d) for d in fm["shape"][1:])
+
+        # The dispatcher holds only a WEAKREF to the engine between
+        # cycles (and parks in bounded waits), so an Engine that is
+        # dropped without close() is garbage-collected and its thread
+        # exits within ~_IDLE_PARK_S instead of leaking both forever.
+        self._thread = threading.Thread(
+            target=_dispatch_entry, args=(weakref.ref(self),),
+            name=f"serving-{name}", daemon=True)
+        self._thread.start()
+
+    # -- submission ----------------------------------------------------
+
+    @classmethod
+    def from_artifact(cls, dirname_or_predict,
+                      config: Optional[EngineConfig] = None,
+                      name: str = "engine") -> "Engine":
+        return cls(AotBackend(dirname_or_predict), config=config, name=name)
+
+    @classmethod
+    def from_program(cls, executor, program, fetch_list, scope=None,
+                     feed_names: Optional[Sequence[str]] = None,
+                     config: Optional[EngineConfig] = None,
+                     name: str = "engine") -> "Engine":
+        return cls(
+            ExecutorBackend(executor, program, fetch_list, scope=scope,
+                            feed_names=feed_names),
+            config=config, name=name)
+
+    def submit(self, feed: Dict[str, Any],
+               timeout: Optional[float] = None,
+               call_kwargs: Optional[Dict[str, Any]] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the list of
+        per-fetch numpy arrays (this request's rows only).
+
+        timeout: seconds until the request's deadline; None uses
+        config.default_timeout_s.  call_kwargs forwards extra backend
+        keyword args and is only legal in pass-through mode (a padded
+        batch serves many requests — per-request backend options cannot
+        apply)."""
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        fut: Future = Future()
+        feed_names = self.backend.feed_names
+        if feed_names is not None:
+            missing = [n for n in feed_names if n not in feed]
+            if missing:
+                raise KeyError(f"feed is missing {missing}")
+            unknown = [n for n in sorted(feed) if n not in set(feed_names)]
+            if unknown:
+                raise KeyError(
+                    f"feed has unknown keys {unknown}; this engine serves "
+                    f"feeds {feed_names}")
+        if self.ladder.buckets:
+            if call_kwargs:
+                raise ValueError(
+                    "call_kwargs requires pass-through mode (empty bucket "
+                    "ladder): a padded batch cannot carry per-request "
+                    "backend options")
+            rows = request_rows(feed, feed_names or sorted(feed))
+            if rows < 1:
+                raise ValueError("request must carry at least one row")
+            if rows > self.max_batch:
+                raise ValueError(
+                    f"request has {rows} rows but max_batch={self.max_batch} "
+                    f"(ladder {self.ladder.buckets}); split it client-side")
+            self._check_trailing(feed, feed_names or sorted(feed))
+        else:
+            rows = 0  # pass-through: never split
+        if timeout is None:
+            timeout = self.config.default_timeout_s
+        now = time.perf_counter()
+        req = Request(
+            feed=feed, future=fut, rows=rows, enqueued_at=now,
+            deadline=(now + timeout) if timeout is not None else None,
+            call_kwargs=dict(call_kwargs) if call_kwargs else None,
+        )
+        with self._cond:
+            if self._closed:
+                if obs_on:
+                    _smetrics.record_reject("closed")
+                raise EngineClosedError(
+                    f"engine '{self.name}' is draining/closed")
+            if len(self._queue) >= self.config.queue_depth:
+                if obs_on:
+                    _smetrics.record_reject("queue_full")
+                raise QueueFullError(
+                    f"engine '{self.name}' queue is at "
+                    f"{self.config.queue_depth} requests")
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        if obs_on:
+            _smetrics.record_submit(depth)
+        return fut
+
+    def _check_trailing(self, feed: Dict[str, Any],
+                        feed_names: Sequence[str]) -> None:
+        """Reject a request whose trailing dims disagree with the model
+        (AOT meta) or with previously admitted traffic — BEFORE it can
+        be coalesced with (and fail) innocent batch-mates."""
+        for n in feed_names:
+            shape = tuple(int(d) for d in getattr(feed[n], "shape", ())[1:])
+            with self._lock:
+                want = self._trailing.get(n)
+                if want is None:
+                    self._trailing[n] = shape
+                    continue
+            if shape != want:
+                raise ValueError(
+                    f"feed '{n}' has trailing shape {list(shape)} but this "
+                    f"engine serves {list(want)} (batches coalesce "
+                    "row-wise; trailing dims must match)")
+
+    def infer(self, feed: Dict[str, Any], timeout: Optional[float] = None,
+              call_kwargs: Optional[Dict[str, Any]] = None):
+        """Blocking submit: returns the fetch list directly."""
+        return self.submit(feed, timeout=timeout,
+                           call_kwargs=call_kwargs).result()
+
+    # -- drain / close -------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admissions; the dispatcher finishes in-flight + queued
+        work then parks.  SIGNAL-SAFE — it is the PreemptionDrain
+        listener, and the handler runs on the main thread, possibly
+        while that very thread holds the engine lock inside submit():
+        the flag write is a plain GIL-atomic store and the wake-up is a
+        best-effort NON-BLOCKING acquire (skipping it only costs the
+        dispatcher's bounded park, <= _IDLE_PARK_S, before it sees the
+        flag)."""
+        self._closed = True
+        if self._cond.acquire(blocking=False):
+            try:
+                self._cond.notify_all()
+            finally:
+                self._cond.release()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """begin_drain() then wait for the queue and in-flight batch to
+        finish.  Returns True when fully drained (timeout=0 polls)."""
+        self.begin_drain()
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while self._queue or self._inflight:
+                wait = None
+                if deadline is not None:
+                    wait = deadline - time.perf_counter()
+                    if wait <= 0:
+                        return False
+                self._cond.wait(wait)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain, stop the dispatcher thread, and join it.  If the
+        drain timed out, whatever is still queued fails with
+        EngineClosedError — a stopped dispatcher must never leave a
+        future unresolved (callers block in .result())."""
+        self.drain(timeout)
+        with self._cond:
+            self._stopped = True
+            leftovers, self._queue = self._queue, []
+            self._cond.notify_all()
+        for r in leftovers:  # outside the lock: done-callbacks may reenter
+            self._fail(r, EngineClosedError(
+                f"engine '{self.name}' closed before this request was "
+                "dispatched (drain timed out)"))
+        self._thread.join(timeout=5.0)
+
+    def attach_drain(self, drain) -> "Engine":
+        """Wire a resilience.PreemptionDrain: its SIGTERM/SIGINT notice
+        triggers begin_drain(), so a preemption stops admissions while
+        queued and in-flight batches complete."""
+        drain.on_request(self.begin_drain)
+        return self
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def draining(self) -> bool:
+        return self._closed
+
+    # -- introspection -------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _counters_locked(self) -> Dict[str, int]:
+        return {
+            "distinct_shapes": len(self._shapes_seen),
+            "miss": self._shape_misses,
+            "hit": self._shape_hits,
+        }
+
+    def compile_counters(self) -> Dict[str, int]:
+        """Serving-side compile accounting: distinct batch shapes ever
+        dispatched ('miss' = first sight), bounded by len(buckets) for a
+        bucketed engine no matter the request mix."""
+        with self._lock:
+            return self._counters_locked()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            batches = self._dispatched_batches
+            return {
+                "batches": batches,
+                "rows": self._dispatched_rows,
+                "mean_occupancy": (self._occupancy_sum / batches
+                                   if batches else 0.0),
+                "queue_depth": len(self._queue),
+                "buckets": self.ladder.buckets,
+                "bucket_reason": self.bucket_reason,
+                **self._counters_locked(),
+            }
+
+    # -- dispatcher ----------------------------------------------------
+
+    # longest a truly idle dispatcher parks before re-checking the
+    # engine weakref in _dispatch_entry — bounds both abandoned-engine
+    # thread lifetime and how long close() can lag an empty engine
+    _IDLE_PARK_S = 0.5
+
+    def _take_batch(self) -> Tuple[Optional[List[Request]], List[Request]]:
+        """Called under the lock.  Pop the next dispatchable batch (or
+        None to keep waiting) and the expired requests removed from the
+        queue.  Expired futures are completed by the CALLER outside the
+        lock: Future.set_exception runs done-callbacks synchronously,
+        and a callback touching the engine from under its own lock
+        would deadlock the dispatcher."""
+        now = time.perf_counter()
+        expired = [r for r in self._queue if r.expired(now)]
+        if expired:
+            self._queue = [r for r in self._queue if not r.expired(now)]
+        if not self._queue:
+            return None, expired
+        if not self.ladder.buckets:
+            return [self._queue.pop(0)], expired  # pass-through: 1 at a time
+        # greedy FIFO pack up to the largest bucket
+        batch: List[Request] = []
+        rows = 0
+        for r in self._queue:
+            if rows + r.rows > self.ladder.max_bucket:
+                break
+            batch.append(r)
+            rows += r.rows
+        full = rows >= self.ladder.max_bucket or len(batch) < len(self._queue)
+        oldest_wait = now - batch[0].enqueued_at
+        if full or oldest_wait >= self.config.max_wait_s or self._closed:
+            del self._queue[:len(batch)]
+            return batch, expired
+        return None, expired
+
+    def _wait_time(self) -> Optional[float]:
+        """Called under the lock: how long the dispatcher may sleep —
+        until the oldest request's batch-fill window or the earliest
+        deadline, whichever is sooner."""
+        if not self._queue:
+            return None  # idle: park (bounded by _IDLE_PARK_S)
+        now = time.perf_counter()
+        oldest = self._queue[0].enqueued_at
+        wait = max(0.0, self.config.max_wait_s - (now - oldest))
+        for r in self._queue:
+            if r.deadline is not None:
+                wait = min(wait, max(0.0, r.deadline - now))
+        return wait
+
+    def _fail(self, req: Request, exc: Exception) -> None:
+        """Complete a future exceptionally; never call under the lock."""
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)
+        if _flags._VALUES["FLAGS_observability"] and isinstance(
+                exc, RequestTimeoutError):
+            _smetrics.record_timeout()
+
+    def _dispatch_cycle(self) -> bool:
+        """One dispatcher iteration: take (or wait for) a batch, fail
+        whatever expired, run the batch.  Returns False once stopped."""
+        with self._cond:
+            if self._stopped:
+                self._cond.notify_all()
+                return False
+            batch, expired = self._take_batch()
+            if batch is None:
+                if self._closed and not self._queue:
+                    self._cond.notify_all()  # wake drain() waiters
+                if not expired:
+                    wait = self._wait_time()
+                    self._cond.wait(self._IDLE_PARK_S if wait is None
+                                    else min(wait, self._IDLE_PARK_S))
+            else:
+                self._inflight = len(batch)
+        now = time.perf_counter()
+        for r in expired:
+            self._fail(r, RequestTimeoutError(
+                f"request expired after {now - r.enqueued_at:.3f}s in "
+                f"queue (deadline {r.deadline - r.enqueued_at:.3f}s)"))
+        if batch is None:
+            return True
+        try:
+            self._dispatch(batch)
+        finally:
+            with self._cond:
+                self._inflight = 0
+                self._cond.notify_all()
+        return True
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        t0 = time.perf_counter() if obs_on else 0.0
+        try:
+            if not self.ladder.buckets:
+                req = batch[0]
+                outs = self.backend(req.feed, **(req.call_kwargs or {}))
+                # real feed shapes, not a constant: an executor backend
+                # re-traces per shape, and compile_counters must say so
+                self._note_shape(tuple(sorted(
+                    (n, tuple(getattr(v, "shape", ()) or ()))
+                    for n, v in req.feed.items())))
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_result(outs)
+                rows = bucket = 1
+            else:
+                rows = sum(r.rows for r in batch)
+                bucket = self.ladder.bucket_for(rows)
+                feed_names = self.backend.feed_names or sorted(batch[0].feed)
+                feed = coalesce(batch, feed_names, bucket)
+                self._note_shape(
+                    tuple((n,) + tuple(feed[n].shape) for n in feed_names))
+                outs = self.backend(feed)
+                scatter(batch, outs)
+        except Exception as e:  # noqa: BLE001 — backend failure fails the batch
+            for r in batch:
+                if r.future.done():
+                    continue  # scatter resolved it before the raise
+                try:
+                    r.future.set_exception(e)
+                except Exception:  # cancelled between check and set
+                    pass
+            if obs_on:
+                _smetrics.record_batch_error()
+            return
+        now = time.perf_counter()
+        with self._lock:
+            self._dispatched_batches += 1
+            self._dispatched_rows += rows
+            self._occupancy_sum += rows / float(bucket)
+        if obs_on:
+            _smetrics.record_batch(
+                bucket=bucket, rows=rows, latency_s=now - t0)
+            for r in batch:
+                _smetrics.record_request_latency(now - r.enqueued_at)
+
+    def _note_shape(self, key: Tuple) -> None:
+        with self._lock:
+            if key in self._shapes_seen:
+                self._shape_hits += 1
+            else:
+                self._shapes_seen.add(key)
+                self._shape_misses += 1
+
+
+def _dispatch_entry(ref: "weakref.ref") -> None:
+    """Dispatcher thread body.  Holds the engine STRONGLY only while
+    running one cycle; between cycles only the weakref survives, so an
+    engine dropped without close() becomes collectable and this thread
+    exits on the next _IDLE_PARK_S heartbeat instead of pinning the
+    engine (and its backend/executor/scope) forever."""
+    while True:
+        eng = ref()
+        if eng is None or not eng._dispatch_cycle():
+            return
+        del eng

@@ -1,0 +1,339 @@
+"""Continuous-batching autoregressive decode over the paged KV cache.
+
+Serving an autoregressive transformer one request at a time recomputes
+full-sequence attention every token (O(S^2) per generated token) and —
+worse for TPU throughput — runs at batch 1.  This module fixes both:
+
+- **KV caching**: each generated token's per-layer K/V lands in the
+  KVCachePool (kvcache.py); decode attention is one Sq=1 query against
+  the cached keys through kernels/paged_attention.py, which routes to
+  the existing flash_attention ragged ``k_lengths`` tier.
+- **Continuous batching**: the loop keeps up to ``max_batch`` sequences
+  in flight and admits a waiting sequence the moment a finished one
+  retires (its pages return to the free pool) — batch occupancy stays
+  high across mixed-length workloads instead of draining to 1 while the
+  longest straggler finishes (the occupancy-dominates-throughput result
+  of arxiv 2605.25645).
+
+The model is the decoder half of models/transformer.py as a jax-level
+step function: post-norm residual blocks (LayerNorm(x + sublayer(x)),
+matching _Builder.sublayer), scaled embedding + sinusoid positions
+(matching _Builder.embed; the table is literally
+models.transformer._sinusoid_table), tied input/output embeddings, no
+cross-attention.  Every step feeds ONE token per active sequence —
+prefill is token-by-token through the same path (a batched prefill pass
+is a follow-up; it changes arithmetic order, so the parity oracle would
+need its own batched reference).
+
+``full_decode`` is the correctness oracle: per-sequence greedy decode
+that recomputes the whole prefix each token with ordinary causal
+attention and no cache.  tests/test_serving.py holds the paged loop to
+it within fp32 tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from ..kernels.flash_attention import _reference_attention
+from ..kernels.paged_attention import paged_decode_attention
+from ..models.transformer import _sinusoid_table
+from . import metrics as _smetrics
+from .kvcache import KVCachePool
+
+__all__ = [
+    "DecodeConfig",
+    "DecodeRequest",
+    "GeneratedSequence",
+    "ContinuousBatchingLoop",
+    "init_decode_params",
+    "full_forward",
+    "full_decode",
+]
+
+
+@dataclasses.dataclass
+class DecodeConfig:
+    """Decoder-only slice of models.transformer.TransformerConfig."""
+
+    vocab_size: int = 128
+    d_model: int = 32
+    n_head: int = 4
+    n_layer: int = 2
+    d_inner: int = 64
+    max_length: int = 96
+    eos_id: Optional[int] = None  # None: sequences retire on max_new only
+
+    @property
+    def head_dim(self) -> int:
+        if self.d_model % self.n_head:
+            raise ValueError("d_model must divide by n_head")
+        return self.d_model // self.n_head
+
+
+def init_decode_params(cfg: DecodeConfig, seed: int = 0) -> Dict:
+    """Deterministic fp32 params; weights at 1/sqrt(fan_in) scale."""
+    rng = np.random.RandomState(seed)
+
+    def mat(d_in, d_out):
+        return (rng.standard_normal((d_in, d_out)) / np.sqrt(d_in)).astype(
+            np.float32)
+
+    d, f = cfg.d_model, cfg.d_inner
+    layers = []
+    for _ in range(cfg.n_layer):
+        layers.append({
+            "wq": mat(d, d), "wk": mat(d, d), "wv": mat(d, d),
+            "wo": mat(d, d),
+            "ln1_g": np.ones(d, np.float32), "ln1_b": np.zeros(d, np.float32),
+            "w1": mat(d, f), "b1": np.zeros(f, np.float32),
+            "w2": mat(f, d), "b2": np.zeros(d, np.float32),
+            "ln2_g": np.ones(d, np.float32), "ln2_b": np.zeros(d, np.float32),
+        })
+    return {
+        "embed": (rng.standard_normal((cfg.vocab_size, d)) / np.sqrt(d)
+                  ).astype(np.float32),
+        "pos": _sinusoid_table(cfg.max_length, d),
+        "layers": layers,
+    }
+
+
+def _layernorm(x, g, b, eps: float = 1e-5):
+    import jax.numpy as jnp
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * g + b
+
+
+def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
+    """Oracle forward: full-sequence causal attention, no cache.
+    tokens [S] int -> logits [S, V]."""
+    import jax.numpy as jnp
+
+    tokens = np.asarray(tokens, np.int32)
+    S = tokens.shape[0]
+    if S > cfg.max_length:
+        raise ValueError(f"sequence length {S} > max_length {cfg.max_length}")
+    d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+        + jnp.asarray(params["pos"])[:S]
+    for lp in params["layers"]:
+        q = (h @ lp["wq"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
+        k = (h @ lp["wk"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
+        v = (h @ lp["wv"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
+        attn = _reference_attention(q, k, v, causal=True, scale=Dh ** -0.5)
+        attn = attn[0].transpose(1, 0, 2).reshape(S, d)
+        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
+        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+    return np.asarray(h @ jnp.asarray(params["embed"]).T)
+
+
+def full_decode(params: Dict, cfg: DecodeConfig, prompt: Sequence[int],
+                max_new_tokens: int) -> Tuple[List[int], List[np.ndarray]]:
+    """Greedy per-sequence decode, recomputing the full prefix each token
+    (the O(S^2)-per-token baseline the paged path must match).  Returns
+    (generated tokens, the [V] logits row behind each of them)."""
+    tokens = [int(t) for t in prompt]
+    out: List[int] = []
+    rows: List[np.ndarray] = []
+    for _ in range(max_new_tokens):
+        row = full_forward(params, cfg, tokens)[-1]
+        nxt = int(row.argmax())
+        rows.append(row)
+        out.append(nxt)
+        tokens.append(nxt)
+        if cfg.eos_id is not None and nxt == cfg.eos_id:
+            break
+    return out, rows
+
+
+def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
+                seq_ids: Sequence[int], tokens, positions,
+                force: str = "auto") -> np.ndarray:
+    """One continuous-batching step: feed token[i] at position[i] for
+    every active sequence, append its K/V to the pool, and return the
+    next-token logits [B, V].  All sequences share the batch regardless
+    of phase — a prefilling sequence and a deep-decode sequence differ
+    only in k_lengths."""
+    import jax.numpy as jnp
+
+    tokens = np.asarray(tokens, np.int32)
+    positions = np.asarray(positions, np.int32)
+    B = tokens.shape[0]
+    d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+        + jnp.asarray(params["pos"])[positions]
+    pages, slots = pool.append_token(seq_ids)
+    tables, lengths = pool.page_table_batch(seq_ids)
+    for li, lp in enumerate(params["layers"]):
+        q = (h @ lp["wq"]).reshape(B, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, H, Dh)
+        v = (h @ lp["wv"]).reshape(B, H, Dh)
+        pool.write_kv(li, pages, slots, k, v)
+        attn = paged_decode_attention(
+            q[:, :, None, :], pool.k_pages[li], pool.v_pages[li],
+            tables, lengths, scale=Dh ** -0.5, force=force,
+        )  # [B, H, 1, Dh]
+        attn = attn[:, :, 0, :].reshape(B, d)
+        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
+        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+    return np.asarray(h @ jnp.asarray(params["embed"]).T)
+
+
+@dataclasses.dataclass
+class DecodeRequest:
+    prompt: Sequence[int]
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class GeneratedSequence:
+    """One finished sequence: generated tokens + the logits row behind
+    each (the parity surface vs full_decode), and latency accounting."""
+
+    seq_id: int
+    prompt: List[int]
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    logits: List[np.ndarray] = dataclasses.field(default_factory=list)
+    admitted_at: float = 0.0
+    ttft_s: Optional[float] = None
+    finished_at: float = 0.0
+
+
+class _Active:
+    __slots__ = ("req", "seq_id", "pos", "result")
+
+    def __init__(self, req: DecodeRequest, seq_id: int, result: GeneratedSequence):
+        self.req = req
+        self.seq_id = seq_id
+        self.pos = 0  # next position to feed
+        self.result = result
+
+
+class ContinuousBatchingLoop:
+    """Admit-as-they-retire greedy decode over one KVCachePool.
+
+    Admission control is reservation-based: a request is admitted only
+    when the pool can cover EVERY admitted sequence's worst-case
+    footprint (ceil((len(prompt)+max_new)/page_size) pages), so
+    append_token can never raise mid-decode — a sequence, once admitted,
+    always runs to completion.  Waiting requests admit in FIFO order the
+    moment retirements free enough pages."""
+
+    def __init__(self, params: Dict, cfg: DecodeConfig, pool: KVCachePool,
+                 max_batch: int = 4, force: str = "auto"):
+        self.params = params
+        self.cfg = cfg
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.force = force
+        self._next_seq_id = 0
+        self.steps = 0
+        self._occupancy_sum = 0.0
+
+    def _footprint(self, req: DecodeRequest) -> int:
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.cfg.max_length:
+            raise ValueError(
+                f"prompt+max_new={total} exceeds max_length "
+                f"{self.cfg.max_length}")
+        return KVCachePool.pages_needed(total, self.pool.page_size)
+
+    def run(self, requests: Sequence[DecodeRequest]) -> List[GeneratedSequence]:
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        waiting: List[Tuple[DecodeRequest, GeneratedSequence]] = []
+        results: List[GeneratedSequence] = []
+        for req in requests:
+            if not len(req.prompt):
+                raise ValueError("empty prompt")
+            # validate EVERY request (max_length AND whole-pool fit)
+            # before any work: a mid-run raise would strand allocated
+            # pages and throw away already-finished sequences' results
+            need = self._footprint(req)
+            if need > self.pool.num_pages:
+                from .kvcache import PagePoolExhausted
+
+                raise PagePoolExhausted(
+                    f"request needs {need} pages worst-case but the pool "
+                    f"has {self.pool.num_pages} total")
+            seq = GeneratedSequence(seq_id=-1, prompt=[int(t) for t in req.prompt])
+            results.append(seq)
+            waiting.append((req, seq))
+        active: List[_Active] = []
+        reserved_pages = 0
+
+        while waiting or active:
+            # admit (FIFO) while a slot and a full worst-case reservation fit
+            while waiting and len(active) < self.max_batch:
+                req, seq = waiting[0]
+                need = self._footprint(req)
+                if reserved_pages + need > self.pool.num_pages:
+                    break  # wait for retirements
+                waiting.pop(0)
+                seq.seq_id = self._next_seq_id
+                self._next_seq_id += 1
+                self.pool.allocate(seq.seq_id)
+                seq.admitted_at = time.perf_counter()
+                active.append(_Active(req, seq.seq_id, seq))
+                reserved_pages += need
+                if obs_on:
+                    _smetrics.record_sequence("admitted")
+            # NOTE: waiting-but-nothing-active cannot happen — the
+            # up-front validation guarantees the head request fits an
+            # empty pool, so admission always progresses
+
+            # one token per active sequence (mixed prefill/decode batch)
+            t0 = time.perf_counter()
+            seq_ids = [a.seq_id for a in active]
+            tokens = [
+                (a.result.prompt[a.pos] if a.pos < len(a.result.prompt)
+                 else a.result.tokens[-1])
+                for a in active
+            ]
+            positions = [a.pos for a in active]
+            logits = decode_step(
+                self.params, self.cfg, self.pool, seq_ids, tokens,
+                positions, force=self.force)
+            self.steps += 1
+            self._occupancy_sum += len(active) / float(self.max_batch)
+            now = time.perf_counter()
+
+            retired: List[_Active] = []
+            for i, a in enumerate(active):
+                a.pos += 1
+                if a.pos < len(a.result.prompt):
+                    continue  # still prefilling; logits unused
+                row = np.asarray(logits[i])
+                nxt = int(row.argmax())
+                a.result.tokens.append(nxt)
+                a.result.logits.append(row)
+                if a.result.ttft_s is None:
+                    a.result.ttft_s = now - a.result.admitted_at
+                    if obs_on:
+                        _smetrics.record_ttft(a.result.ttft_s)
+                if obs_on:
+                    _smetrics.record_token(now - t0)
+                done = (len(a.result.tokens) >= a.req.max_new_tokens
+                        or (self.cfg.eos_id is not None
+                            and nxt == self.cfg.eos_id))
+                if done:
+                    retired.append(a)
+            for a in retired:
+                active.remove(a)
+                a.result.finished_at = now
+                self.pool.free_seq(a.seq_id)
+                reserved_pages -= self._footprint(a.req)
+                if obs_on:
+                    _smetrics.record_sequence("retired")
+        return results
+
+    def mean_occupancy(self) -> float:
+        return self._occupancy_sum / self.steps if self.steps else 0.0

@@ -1,0 +1,250 @@
+"""Paged KV-cache pool: fixed-size page blocks in one preallocated device
+array, per-sequence page tables, alloc/free/defrag accounting.
+
+The shape follows Ragged Paged Attention (arxiv 2604.15464): instead of
+one contiguous [B, H, max_len, D] cache per sequence (whose worst-case
+max_len reservation strands HBM the moment sequence lengths vary), the
+cache is a pool of PAGES — [num_pages, page_size, H, D] per layer, all
+layers stacked in one array so one allocation covers the model.  A
+sequence owns an ordered list of page ids (its page table) and a length;
+appending a token claims the next slot in its last page, allocating a
+fresh page only every `page_size` tokens.  Fragmentation is impossible at
+page granularity (any free page serves any sequence) and retiring a
+sequence returns its pages to the free list in O(pages).
+
+Attention consumes the pool through kernels/paged_attention.py: the
+reference implementation gathers the sequence's pages into a contiguous
+[B, H, S, D] view and runs the existing flash_attention ragged
+`k_lengths` tier; a Pallas kernel that reads pages in place (no gather
+materialization) is the explicit follow-up seam (`impl="pallas"`).
+
+Writes use jax functional updates (`.at[...].set`), so the pool works on
+any backend; on TPU XLA performs them as in-place dynamic-update-slices
+when the buffer is donated (the arrays are never aliased here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from . import metrics as _smetrics
+
+__all__ = ["KVCachePool", "PagePoolExhausted", "SequenceHandle"]
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free page to satisfy an append — the admission controller must
+    retire or refuse sequences before this fires mid-decode."""
+
+
+@dataclasses.dataclass
+class SequenceHandle:
+    """Per-sequence page table: ordered page ids + token count."""
+
+    seq_id: int
+    pages: List[int] = dataclasses.field(default_factory=list)
+    length: int = 0
+
+    def capacity(self, page_size: int) -> int:
+        return len(self.pages) * page_size
+
+
+class KVCachePool:
+    """Preallocated paged K/V storage for every layer of one model.
+
+    k_pages / v_pages: [num_layers, num_pages, page_size, num_heads,
+    head_dim] jax arrays.  All mutation (allocate/append/free/defrag) is
+    serialized under one lock — the continuous-batching loop drives the
+    pool from its own thread while metrics/introspection may read from
+    others."""
+
+    def __init__(self, num_pages: int, page_size: int, num_layers: int,
+                 num_heads: int, head_dim: int, dtype="float32",
+                 name: str = "kv"):
+        if num_pages < 1 or page_size < 1:
+            raise ValueError("num_pages and page_size must be >= 1")
+        import jax.numpy as jnp
+
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.name = name
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        self.v_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed pages are reused first (their
+        # tiles are warm in whatever cache hierarchy the backend has)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._tables: Dict[int, SequenceHandle] = {}
+        self._stats = {
+            "page_allocs": 0, "page_frees": 0, "token_appends": 0,
+            "defrag_moves": 0, "used_pages_high_water": 0,
+        }
+
+    # -- sizing math (documented in README "Serving") -------------------
+
+    @classmethod
+    def pages_needed(cls, tokens: int, page_size: int) -> int:
+        """ceil(tokens / page_size) — the admission controller's unit."""
+        return -(-int(tokens) // int(page_size))
+
+    def bytes_per_page(self) -> int:
+        itemsize = np.dtype(self.k_pages.dtype).itemsize
+        return (2 * self.num_layers * self.page_size * self.num_heads
+                * self.head_dim * itemsize)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def allocate(self, seq_id: int) -> SequenceHandle:
+        """Register a sequence with an empty page table (pages are
+        claimed lazily by append_token)."""
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already allocated")
+            h = SequenceHandle(seq_id)
+            self._tables[seq_id] = h
+            return h
+
+    def free_seq(self, seq_id: int) -> int:
+        """Retire a sequence: its pages return to the free list.
+        Returns the number of pages released."""
+        with self._lock:
+            h = self._tables.pop(seq_id)
+            for p in reversed(h.pages):
+                self._free.append(p)
+            self._stats["page_frees"] += len(h.pages)
+            n = len(h.pages)
+        self._note_pool()
+        return n
+
+    def append_token(self, seq_ids: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Claim the next (page, slot) for one new token on every
+        sequence; advances lengths.  Returns (pages [B], slots [B])
+        int32 arrays for write_kv.  Raises PagePoolExhausted (before
+        mutating ANY table) if the claim cannot be satisfied."""
+        with self._lock:
+            need = sum(
+                1 for s in seq_ids
+                if self._tables[s].length == self._tables[s].capacity(self.page_size)
+            )
+            if need > len(self._free):
+                raise PagePoolExhausted(
+                    f"pool '{self.name}': need {need} fresh pages for "
+                    f"{len(seq_ids)} appends but only {len(self._free)} "
+                    f"free of {self.num_pages}")
+            pages = np.empty(len(seq_ids), np.int32)
+            slots = np.empty(len(seq_ids), np.int32)
+            for i, s in enumerate(seq_ids):
+                h = self._tables[s]
+                if h.length == h.capacity(self.page_size):
+                    h.pages.append(self._free.pop())
+                    self._stats["page_allocs"] += 1
+                pages[i] = h.pages[-1]
+                slots[i] = h.length % self.page_size
+                h.length += 1
+            self._stats["token_appends"] += len(seq_ids)
+            used = self.num_pages - len(self._free)
+            if used > self._stats["used_pages_high_water"]:
+                self._stats["used_pages_high_water"] = used
+        self._note_pool()
+        return pages, slots
+
+    def write_kv(self, layer: int, pages: np.ndarray, slots: np.ndarray,
+                 k, v) -> None:
+        """Write one token's K/V for `layer` on each sequence:
+        k/v [B, num_heads, head_dim] into the claimed (page, slot)s.
+        Locked like every other mutation: an unlocked read-modify-write
+        of the arrays would race defrag()'s permutation and silently
+        drop one side's update."""
+        with self._lock:
+            self.k_pages = self.k_pages.at[layer, pages, slots].set(k)
+            self.v_pages = self.v_pages.at[layer, pages, slots].set(v)
+
+    # -- read side ------------------------------------------------------
+
+    def page_table_batch(self, seq_ids: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch view for attention: (tables [B, max_pages] int32 padded
+        with page 0 — the ragged k_lengths mask hides the tail — and
+        lengths [B] int32 valid token counts)."""
+        with self._lock:
+            handles = [self._tables[s] for s in seq_ids]
+            maxp = max((len(h.pages) for h in handles), default=1) or 1
+            tables = np.zeros((len(handles), maxp), np.int32)
+            lengths = np.empty(len(handles), np.int32)
+            for i, h in enumerate(handles):
+                tables[i, :len(h.pages)] = h.pages
+                lengths[i] = h.length
+        return tables, lengths
+
+    def length(self, seq_id: int) -> int:
+        with self._lock:
+            return self._tables[seq_id].length
+
+    # -- accounting -----------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_pages / float(self.num_pages)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            live = {s: h.length for s, h in self._tables.items()}
+            return dict(self._stats,
+                        used_pages=self.num_pages - len(self._free),
+                        free_pages=len(self._free),
+                        num_pages=self.num_pages,
+                        live_sequences=len(live))
+
+    def _note_pool(self) -> None:
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_page_pool(
+                self.used_pages, self.num_pages, pool=self.name)
+
+    # -- defrag ---------------------------------------------------------
+
+    def defrag(self) -> int:
+        """Compact used pages to the lowest indices (one permutation
+        gather per K/V array) and rebuild the free list as the dense
+        tail.  Page-granular allocation never NEEDS this for correctness
+        — any free page serves any sequence — but a compacted pool lets
+        an operator shrink `num_pages` between runs and keeps gather
+        indices dense for the follow-up Pallas page reader.  Returns the
+        number of pages moved."""
+        with self._lock:
+            used: List[int] = []
+            for h in self._tables.values():
+                used.extend(h.pages)
+            remap = {old: new for new, old in enumerate(sorted(used))}
+            moves = sum(1 for old, new in remap.items() if old != new)
+            if moves:
+                perm = np.arange(self.num_pages, dtype=np.int32)
+                for old, new in remap.items():
+                    perm[new] = old
+                # unused tail keeps a stable order: remaining page ids
+                leftover = [p for p in range(self.num_pages)
+                            if p not in remap]
+                perm[len(remap):] = leftover
+                self.k_pages = self.k_pages[:, perm]
+                self.v_pages = self.v_pages[:, perm]
+                for h in self._tables.values():
+                    h.pages = [remap[p] for p in h.pages]
+            self._free = list(range(self.num_pages - 1, len(remap) - 1, -1))
+            self._stats["defrag_moves"] += moves
+        return moves

@@ -1,0 +1,139 @@
+"""Serving instruments, emitted into the observability default registry.
+
+Callers (engine.py, generate.py, kvcache.py) check FLAGS_observability
+THEMSELVES before calling in — the established executor pattern: the
+disabled hot path performs one dict lookup and never enters this module,
+so serving adds zero allocation/locking to a run with telemetry off.
+
+Metrics:
+- paddle_tpu_serving_queue_depth            gauge    requests waiting
+- paddle_tpu_serving_requests_total         counter  {outcome=admitted|
+                                                      rejected_closed|
+                                                      rejected_queue_full|
+                                                      timeout}
+- paddle_tpu_serving_batches_total          counter  {bucket=N}
+- paddle_tpu_serving_batch_errors_total     counter  backend raised
+- paddle_tpu_serving_batch_occupancy        histogram rows/bucket (0..1]
+- paddle_tpu_serving_batch_latency_seconds  histogram dispatch wall time
+- paddle_tpu_serving_request_latency_seconds histogram submit->complete
+- paddle_tpu_serving_ttft_seconds           histogram admit->first token
+- paddle_tpu_serving_token_seconds          histogram per generated token
+- paddle_tpu_serving_page_pool_used_pages   gauge    {pool=} pages in use
+- paddle_tpu_serving_page_pool_utilization  gauge    {pool=} used/total
+- paddle_tpu_serving_sequences_total        counter  {event=admitted|
+                                                      retired}
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..observability import default_registry
+
+__all__ = [
+    "record_submit",
+    "record_reject",
+    "record_timeout",
+    "record_batch",
+    "record_batch_error",
+    "record_request_latency",
+    "record_ttft",
+    "record_token",
+    "record_page_pool",
+    "record_sequence",
+]
+
+# occupancy lives in (0, 1]; the default step-time buckets would collapse
+# it into two bins
+_OCCUPANCY_BUCKETS: Tuple[float, ...] = (
+    0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def record_submit(queue_depth: int) -> None:
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_queue_depth",
+        "requests waiting in the engine's bounded queue",
+    ).set(queue_depth)
+    reg.counter(
+        "paddle_tpu_serving_requests",
+        "engine submissions by outcome",
+    ).inc(outcome="admitted")
+
+
+def record_reject(reason: str) -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_requests",
+        "engine submissions by outcome",
+    ).inc(outcome=f"rejected_{reason}")
+
+
+def record_timeout() -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_requests",
+        "engine submissions by outcome",
+    ).inc(outcome="timeout")
+
+
+def record_batch(bucket: int, rows: int, latency_s: float) -> None:
+    reg = default_registry()
+    reg.counter(
+        "paddle_tpu_serving_batches",
+        "dispatched micro-batches by bucket size",
+    ).inc(bucket=str(bucket))
+    reg.histogram(
+        "paddle_tpu_serving_batch_occupancy",
+        "real rows / bucket size per dispatched batch (1.0 = no padding)",
+        buckets=_OCCUPANCY_BUCKETS,
+    ).observe(rows / float(bucket))
+    reg.histogram(
+        "paddle_tpu_serving_batch_latency_seconds",
+        "backend dispatch wall time per micro-batch",
+    ).observe(latency_s)
+
+
+def record_batch_error() -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_batch_errors",
+        "micro-batches whose backend dispatch raised",
+    ).inc()
+
+
+def record_request_latency(seconds: float) -> None:
+    default_registry().histogram(
+        "paddle_tpu_serving_request_latency_seconds",
+        "submit-to-complete wall time per request",
+    ).observe(seconds)
+
+
+def record_ttft(seconds: float) -> None:
+    default_registry().histogram(
+        "paddle_tpu_serving_ttft_seconds",
+        "decode admit-to-first-token wall time per sequence",
+    ).observe(seconds)
+
+
+def record_token(seconds: float) -> None:
+    default_registry().histogram(
+        "paddle_tpu_serving_token_seconds",
+        "wall time per generated token (per sequence-step)",
+    ).observe(seconds)
+
+
+def record_page_pool(used: int, total: int, pool: str = "kv") -> None:
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_page_pool_used_pages",
+        "KV-cache pages currently allocated",
+    ).set(used, pool=pool)
+    reg.gauge(
+        "paddle_tpu_serving_page_pool_utilization",
+        "KV-cache page-pool utilization (used/total)",
+    ).set(used / float(total) if total else 0.0, pool=pool)
+
+
+def record_sequence(event: str) -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_sequences",
+        "continuous-batching sequence lifecycle events",
+    ).inc(event=event)

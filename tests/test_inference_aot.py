@@ -64,6 +64,21 @@ def test_aot_rejects_missing_feed(tmp_path):
         predict({})
 
 
+def test_aot_rejects_unknown_feed(tmp_path):
+    """Extra keys were silently IGNORED — an unknown feed is almost
+    always a typo of a real one, so it must raise (symmetric with the
+    missing-keys check), naming both the strays and the real feeds."""
+    img, pred = _build_small_cnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    save_compiled_inference_model(str(tmp_path), ["image"], [pred], exe)
+    predict = load_compiled_inference_model(str(tmp_path))
+    assert "symbolic_error" in predict.meta  # the bucket planner's input
+    with pytest.raises(KeyError, match="imagee"):
+        predict({"image": np.zeros((1, 1, 8, 8), np.float32),
+                 "imagee": np.zeros((1, 1, 8, 8), np.float32)})
+
+
 def test_aot_multi_feed_symbolic_batch(tmp_path):
     """Two dynamic-batch feeds must share ONE symbolic scope — per-feed
     scopes made every multi-input model silently fall back to static."""

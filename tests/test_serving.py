@@ -1,0 +1,673 @@
+"""Serving tier (paddle_tpu/serving/): batching engine acceptance,
+paged KV-cache decode parity, drain/timeout semantics, serve_bench gate.
+
+Acceptance criteria pinned here (ISSUE 4):
+(a) concurrent mixed-shape submit()s == sequential predict(), bit-exact;
+(b) a bucket-ladder engine dispatches at most len(buckets) distinct
+    batch shapes across 100 mixed-size requests (compile counters);
+(c) continuous-batching decode of overlapping sequences through the
+    paged KV cache == per-sequence full-recompute decode (fp32 tol),
+    and retired sequences' pages return to the free pool;
+(d) deadline-expired requests fail with the named timeout error while
+    in-flight batches complete during drain.
+Plus the decode-shaped ragged-attention contract the KV loop relies on:
+flash_attention at Sq=1 with growing k_lengths == _reference_attention
+token-for-token.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, serving
+from paddle_tpu.core.framework import unique_name_guard
+from paddle_tpu.inference import (
+    load_compiled_inference_model,
+    save_compiled_inference_model,
+)
+from paddle_tpu.kernels.flash_attention import (
+    _reference_attention,
+    flash_attention,
+)
+from paddle_tpu.kernels.paged_attention import gather_kv_pages
+from paddle_tpu.resilience import PreemptionDrain
+from paddle_tpu.serving import (
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    Engine,
+    EngineClosedError,
+    EngineConfig,
+    KVCachePool,
+    PagePoolExhausted,
+    QueueFullError,
+    RequestTimeoutError,
+    full_decode,
+    init_decode_params,
+)
+
+
+def _export_small_cnn(dirname: str):
+    """Conv->bn->pool->fc artifact in private programs/scope (reusable
+    across tests regardless of the autouse fresh-program fixture)."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), unique_name_guard():
+        img = layers.data("image", [1, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        b = layers.batch_norm(c, act="relu")
+        p = layers.pool2d(b, pool_size=8, pool_type="avg")
+        pred = layers.fc(p, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        save_compiled_inference_model(
+            dirname, ["image"], [pred], exe, main_program=main, scope=scope)
+    return load_compiled_inference_model(dirname)
+
+
+@pytest.fixture(scope="module")
+def cnn_predict(tmp_path_factory):
+    return _export_small_cnn(str(tmp_path_factory.mktemp("serving_cnn")))
+
+
+def _wait_until(pred, timeout=5.0):
+    t0 = time.perf_counter()
+    while not pred():
+        if time.perf_counter() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+class _GatedBackend:
+    """Backend whose dispatch blocks until released — stages the
+    in-flight-during-drain scenarios deterministically."""
+
+    feed_names = ["x"]
+    fetch_names = ["y"]
+    meta: dict = {}
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.calls = 0
+
+    def __call__(self, feed):
+        self.calls += 1
+        assert self.gate.wait(10.0), "test gate never released"
+        return [np.asarray(feed["x"]) * 2.0]
+
+
+# -- (a) concurrent mixed shapes, bit-identical -------------------------
+
+def test_concurrent_mixed_shapes_bit_identical(cnn_predict):
+    eng = Engine.from_artifact(
+        cnn_predict,
+        config=EngineConfig(buckets=(1, 2, 4, 8), max_wait_s=0.002))
+    rng = np.random.RandomState(7)
+    feeds = [
+        {"image": rng.rand(int(rng.randint(1, 5)), 1, 8, 8).astype(np.float32)}
+        for _ in range(24)
+    ]
+    with ThreadPoolExecutor(max_workers=6) as tp:
+        futs = list(tp.map(eng.submit, feeds))
+    outs = [f.result(timeout=30) for f in futs]
+    eng.close()
+    for feed, got in zip(feeds, outs):
+        (want,) = cnn_predict(feed)
+        assert got[0].shape == want.shape
+        np.testing.assert_array_equal(got[0], want)
+
+
+# -- (b) bucket ladder bounds compiled shapes ---------------------------
+
+def test_bucket_ladder_bounds_compiled_shapes(cnn_predict):
+    buckets = (1, 2, 4, 8)
+    eng = Engine.from_artifact(
+        cnn_predict, config=EngineConfig(buckets=buckets, max_wait_s=0.001))
+    rng = np.random.RandomState(3)
+    futs = [
+        eng.submit({"image": rng.rand(
+            int(rng.randint(1, 9)), 1, 8, 8).astype(np.float32)})
+        for _ in range(100)
+    ]
+    for f in futs:
+        f.result(timeout=60)
+    counters = eng.compile_counters()
+    stats = eng.stats()
+    eng.close()
+    # 100 mixed-size requests, at most one first-seen shape per bucket
+    assert counters["miss"] == counters["distinct_shapes"]
+    assert counters["distinct_shapes"] <= len(buckets)
+    assert counters["hit"] + counters["miss"] == stats["batches"]
+    assert stats["rows"] == sum(int(f.result()[0].shape[0]) for f in futs)
+
+
+def test_static_artifact_collapses_ladder(tmp_path, monkeypatch):
+    """A static-batch artifact can only serve its exported size: the
+    bucket planner collapses the ladder and records the export's
+    symbolic_error as the reason."""
+    import paddle_tpu.inference.aot  # noqa: F401 — jexport target below
+    from jax import export as jexport
+
+    real = jexport.export
+    calls = {"n": 0}
+
+    def flaky_export(fn, **kw):
+        wrapped = real(fn, **kw)
+
+        def call(*specs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("synthetic: polymorphism unsupported")
+            return wrapped(*specs)
+
+        return call
+
+    monkeypatch.setattr(jexport, "export", flaky_export)
+    predict = _export_small_cnn(str(tmp_path))
+    assert predict.meta["batch"] == "static"
+    eng = Engine.from_artifact(
+        predict, config=EngineConfig(buckets=(1, 2, 4), max_wait_s=0.0))
+    assert eng.ladder.buckets == (1,)
+    assert "synthetic" in eng.bucket_reason
+    (out,) = eng.infer({"image": np.zeros((1, 1, 8, 8), np.float32)})
+    assert out.shape == (1, 3)
+    with pytest.raises(ValueError, match="max_batch"):
+        eng.submit({"image": np.zeros((2, 1, 8, 8), np.float32)})
+    eng.close()
+
+
+def test_engine_rejects_bad_feeds(cnn_predict):
+    eng = Engine.from_artifact(
+        cnn_predict, config=EngineConfig(buckets=(1, 2)))
+    with pytest.raises(KeyError, match="missing"):
+        eng.submit({})
+    with pytest.raises(KeyError, match="unknown"):
+        eng.submit({"image": np.zeros((1, 1, 8, 8), np.float32),
+                    "oops": np.zeros((1,), np.float32)})
+    eng.close()
+
+
+# -- (d) deadlines, drain, backpressure ---------------------------------
+
+def test_deadline_timeout_and_drain_semantics():
+    backend = _GatedBackend()
+    eng = Engine(backend, config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    f_inflight = eng.submit({"x": np.ones((1, 2), np.float32)})
+    _wait_until(lambda: backend.calls == 1)  # A is in-flight, queue empty
+    f_b = eng.submit({"x": np.full((1, 2), 3.0, np.float32)}, timeout=0.01)
+    f_c = eng.submit({"x": np.full((1, 2), 4.0, np.float32)}, timeout=0.01)
+    time.sleep(0.05)  # let both deadlines lapse while A blocks the engine
+    eng.begin_drain()
+    with pytest.raises(EngineClosedError):
+        eng.submit({"x": np.ones((1, 2), np.float32)})
+    backend.gate.set()
+    assert eng.drain(timeout=10.0)
+    # the in-flight batch completed during drain...
+    np.testing.assert_array_equal(
+        f_inflight.result(timeout=1.0)[0], np.full((1, 2), 2.0, np.float32))
+    # ...and the expired queued requests failed with the NAMED error
+    for f in (f_b, f_c):
+        with pytest.raises(RequestTimeoutError, match="expired"):
+            f.result(timeout=1.0)
+    eng.close()
+
+
+def test_deadline_fires_without_traffic():
+    """An expired request fails promptly even when nothing else arrives
+    to tickle the dispatcher: a 1-row request under a batch-fill window
+    of 5s must NOT wait the window out — the dispatcher's sleep tracks
+    the earliest deadline."""
+    backend = _GatedBackend()
+    backend.gate.set()
+    eng = Engine(backend, config=EngineConfig(buckets=(2,), max_wait_s=5.0))
+    t0 = time.perf_counter()
+    f = eng.submit({"x": np.ones((1, 2), np.float32)}, timeout=0.05)
+    with pytest.raises(RequestTimeoutError):
+        f.result(timeout=2.0)
+    assert time.perf_counter() - t0 < 2.0  # not the 5s fill window
+    eng.close()
+
+
+def test_queue_backpressure():
+    backend = _GatedBackend()
+    eng = Engine(backend, config=EngineConfig(
+        buckets=(1,), max_wait_s=0.0, queue_depth=2))
+    f_a = eng.submit({"x": np.ones((1, 2), np.float32)})
+    _wait_until(lambda: backend.calls == 1)
+    eng.submit({"x": np.ones((1, 2), np.float32)})
+    eng.submit({"x": np.ones((1, 2), np.float32)})
+    with pytest.raises(QueueFullError):
+        eng.submit({"x": np.ones((1, 2), np.float32)})
+    backend.gate.set()
+    eng.close()
+    assert f_a.result(timeout=1.0)
+
+
+def test_preemption_drain_wiring():
+    """SIGTERM-path: PreemptionDrain.request() stops admissions via the
+    listener hook while admitted work completes."""
+    backend = _GatedBackend()
+    backend.gate.set()  # fast backend
+    eng = Engine(backend, config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    drain = PreemptionDrain()
+    eng.attach_drain(drain)
+    f = eng.submit({"x": np.ones((1, 2), np.float32)})
+    drain.request()
+    assert eng.draining
+    np.testing.assert_array_equal(
+        f.result(timeout=5.0)[0], np.full((1, 2), 2.0, np.float32))
+    with pytest.raises(EngineClosedError):
+        eng.submit({"x": np.ones((1, 2), np.float32)})
+    eng.close()
+    # a listener attached AFTER the notice fires immediately
+    late = Engine(backend, config=EngineConfig(buckets=(1,)))
+    late.attach_drain(drain)
+    assert late.draining
+    late.close()
+
+
+def test_begin_drain_is_nonblocking_under_contention():
+    """begin_drain runs from SIGNAL context on the main thread — it must
+    never block on the engine lock (a SIGTERM landing while that thread
+    is inside submit() would self-deadlock), and the drain must still
+    proceed via the dispatcher's bounded park."""
+    backend = _GatedBackend()
+    backend.gate.set()
+    eng = Engine(backend, config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    with eng._cond:  # simulate the interrupted thread holding the lock
+        t0 = time.perf_counter()
+        eng.begin_drain()  # must return immediately, no notify possible
+        assert time.perf_counter() - t0 < 0.1
+    assert eng.draining
+    assert eng.drain(timeout=2 * Engine._IDLE_PARK_S + 1.0)
+    eng.close()
+
+
+def test_close_timeout_fails_stranded_requests():
+    """A close() whose drain times out must FAIL whatever is still
+    queued — a stopped dispatcher leaving futures pending would hang
+    every caller blocked in .result()."""
+    backend = _GatedBackend()  # gate closed: first dispatch blocks
+    eng = Engine(backend, config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    f_inflight = eng.submit({"x": np.ones((1, 2), np.float32)})
+    _wait_until(lambda: backend.calls == 1)
+    f_queued = eng.submit({"x": np.ones((1, 2), np.float32)})
+    eng.close(timeout=0.1)  # cannot drain: the backend is blocked
+    with pytest.raises(EngineClosedError, match="drain timed out"):
+        f_queued.result(timeout=1.0)
+    backend.gate.set()  # release the in-flight batch: it still completes
+    np.testing.assert_array_equal(
+        f_inflight.result(timeout=5.0)[0], np.full((1, 2), 2.0, np.float32))
+
+
+def test_done_callback_touching_engine_does_not_deadlock():
+    """Future.set_exception runs done-callbacks synchronously on the
+    dispatcher thread; a callback that calls back into the engine must
+    not deadlock it (expired futures complete OUTSIDE the lock)."""
+    backend = _GatedBackend()
+    backend.gate.set()
+    eng = Engine(backend, config=EngineConfig(buckets=(2,), max_wait_s=5.0))
+    seen = []
+    f = eng.submit({"x": np.ones((1, 2), np.float32)}, timeout=0.05)
+    f.add_done_callback(lambda fut: seen.append(eng.queue_depth()))
+    with pytest.raises(RequestTimeoutError):
+        f.result(timeout=2.0)
+    _wait_until(lambda: len(seen) == 1)
+    # the dispatcher survived the reentrant callback: it still serves
+    ok = eng.submit({"x": np.ones((2, 2), np.float32)})
+    np.testing.assert_array_equal(
+        ok.result(timeout=5.0)[0], np.full((2, 2), 2.0, np.float32))
+    eng.close()
+
+
+def test_trailing_shape_mismatch_rejected_at_submit(cnn_predict):
+    """One client's mis-shaped request must fail at submit(), not poison
+    the batch-mates it would have coalesced with."""
+    eng = Engine.from_artifact(
+        cnn_predict, config=EngineConfig(buckets=(1, 2, 4)))
+    with pytest.raises(ValueError, match="trailing shape"):
+        eng.submit({"image": np.zeros((1, 1, 32, 32), np.float32)})
+    (out,) = eng.infer({"image": np.zeros((1, 1, 8, 8), np.float32)})
+    assert out.shape == (1, 3)
+    eng.close()
+
+
+def test_abandoned_engine_is_collected():
+    """An Engine dropped without close() must be garbage-collectable
+    (the dispatcher holds it via weakref between cycles) — otherwise
+    every forgotten Inferencer leaks a thread + executor forever."""
+    import gc
+    import weakref
+
+    backend = _GatedBackend()
+    backend.gate.set()
+    eng = Engine(backend, config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    eng.infer({"x": np.ones((1, 2), np.float32)})
+    thread = eng._thread
+    ref = weakref.ref(eng)
+    del eng
+    t0 = time.perf_counter()
+    while ref() is not None and time.perf_counter() - t0 < 5.0:
+        gc.collect()
+        time.sleep(0.05)
+    assert ref() is None
+    thread.join(timeout=2 * Engine._IDLE_PARK_S + 1.0)
+    assert not thread.is_alive()
+
+
+def test_backend_failure_fails_the_batch():
+    class Boom:
+        feed_names = ["x"]
+        fetch_names = ["y"]
+        meta: dict = {}
+
+        def __call__(self, feed):
+            raise RuntimeError("backend exploded")
+
+    eng = Engine(Boom(), config=EngineConfig(buckets=(1, 2), max_wait_s=0.0))
+    f = eng.submit({"x": np.ones((1, 2), np.float32)})
+    with pytest.raises(RuntimeError, match="exploded"):
+        f.result(timeout=5.0)
+    eng.close()
+
+
+# -- Inferencer rides the engine ---------------------------------------
+
+def test_inferencer_routes_through_engine(tmp_path):
+    from paddle_tpu.contrib.inferencer import Inferencer
+
+    def net():
+        x = layers.data("x", [4], dtype="float32")
+        return layers.fc(x, size=2,
+                         param_attr=fluid.ParamAttr(name="infer_w"),
+                         bias_attr=fluid.ParamAttr(name="infer_b"))
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), unique_name_guard():
+        net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_persistables(exe, str(tmp_path), main_program=main)
+
+    inf = Inferencer(net, str(tmp_path), place=fluid.CPUPlace())
+    x = np.ones((3, 4), np.float32)
+    (out1,) = inf.infer({"x": x})
+    (out2,) = inf.infer({"x": x})
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.shape == (3, 2)
+    # both calls went through ONE engine sharing one executor cache
+    stats = inf._engine.stats()
+    assert stats["batches"] == 2
+    assert stats["distinct_shapes"] == 1  # same feed shape counts once
+    # a new feed shape is a fresh executor trace — the counter says so
+    inf.infer({"x": np.ones((5, 4), np.float32)})
+    assert inf._engine.stats()["distinct_shapes"] == 2
+    inf.close()
+
+
+# -- KV-cache pool ------------------------------------------------------
+
+def test_kvcache_alloc_append_free_accounting():
+    pool = KVCachePool(num_pages=4, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    for step in range(4):  # 4 tokens -> 2 pages
+        pages, slots = pool.append_token([0])
+        pool.write_kv(0, pages, slots,
+                      np.full((1, 1, 4), step, np.float32),
+                      np.full((1, 1, 4), -step, np.float32))
+    assert pool.used_pages == 2 and pool.length(0) == 4
+    tables, lengths = pool.page_table_batch([0])
+    k = np.asarray(gather_kv_pages(pool.k_pages[0], tables))  # [1,H,S,D]
+    np.testing.assert_array_equal(k[0, 0, :, 0], [0, 1, 2, 3])
+    assert pool.free_seq(0) == 2
+    assert pool.free_pages == pool.num_pages
+    st = pool.stats()
+    assert st["page_allocs"] == 2 and st["page_frees"] == 2
+    assert st["used_pages_high_water"] == 2
+
+
+def test_kvcache_exhaustion_is_atomic():
+    pool = KVCachePool(num_pages=2, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.allocate(1)
+    pool.append_token([0])
+    pool.append_token([1])  # both pages claimed
+    pool.append_token([0])  # slot 1 of page A, no fresh page needed
+    with pytest.raises(PagePoolExhausted):
+        # 0 needs a fresh page (full) and 1 has a slot: the claim must
+        # fail BEFORE advancing either sequence
+        pool.append_token([0, 1])
+    assert pool.length(0) == 2 and pool.length(1) == 1
+
+
+def test_kvcache_defrag_preserves_contents():
+    pool = KVCachePool(num_pages=6, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=2)
+    for s in range(3):
+        pool.allocate(s)
+    for step in range(4):
+        pages, slots = pool.append_token([0, 1, 2])
+        k = np.stack([np.full((1, 2), 100 * s + step, np.float32)
+                      for s in range(3)])
+        pool.write_kv(0, pages, slots, k, k)
+    pool.free_seq(1)  # punch a hole mid-pool
+    before_tables, lengths = pool.page_table_batch([0, 2])
+    before = np.asarray(gather_kv_pages(pool.k_pages[0], before_tables))
+    moves = pool.defrag()
+    assert moves > 0
+    after_tables, lengths2 = pool.page_table_batch([0, 2])
+    after = np.asarray(gather_kv_pages(pool.k_pages[0], after_tables))
+    np.testing.assert_array_equal(before, after)
+    np.testing.assert_array_equal(lengths, lengths2)
+    # compacted: live pages occupy the lowest indices
+    assert int(np.asarray(after_tables).max()) == pool.used_pages - 1
+
+
+# -- decode-shaped ragged attention (the KV-loop contract) --------------
+
+def test_flash_decode_ragged_matches_reference_token_for_token():
+    """Sq=1 queries against a fixed K/V buffer with growing k_lengths —
+    exactly what the paged decode loop issues — must match dense
+    reference attention over the true prefix at every step, through the
+    REAL pallas kernel (interpret mode) and the jax path."""
+    B, H, S, D = 2, 2, 32, 8
+    rng = np.random.RandomState(11)
+    q_all = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    k_buf = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    v_buf = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    scale = D ** -0.5
+    for force in ("interpret", "jax"):
+        for t in range(1, S + 1):
+            q = q_all[:, :, t - 1:t, :]
+            got = np.asarray(flash_attention(
+                q, k_buf, v_buf, causal=False, scale=scale,
+                k_lengths=np.full((B,), t, np.int32), force=force))
+            want = np.asarray(_reference_attention(
+                q, k_buf[:, :, :t], v_buf[:, :, :t], causal=False,
+                scale=scale))
+            np.testing.assert_allclose(
+                got, want, rtol=2e-5, atol=2e-6,
+                err_msg=f"step {t} force={force}")
+
+
+# -- (c) continuous-batching decode parity ------------------------------
+
+def test_continuous_batching_decode_matches_full_recompute():
+    cfg = DecodeConfig(vocab_size=61, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=48)
+    params = init_decode_params(cfg, seed=5)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (3, 5, 2, 4)]
+    max_new = 6
+    reqs = [DecodeRequest(p, max_new) for p in prompts]
+
+    # pool sized for 3 concurrent worst-case sequences but not 4: the
+    # 4th admits only when a retirement frees pages (admit-as-retire)
+    page_size = 4
+    per_seq = KVCachePool.pages_needed(max(len(p) for p in prompts) + max_new,
+                                       page_size)
+    pool = KVCachePool(num_pages=3 * per_seq, page_size=page_size,
+                       num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                       head_dim=cfg.head_dim)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3)
+    results = loop.run(reqs)
+
+    # ≥3 sequences genuinely overlapped: strictly fewer steps than
+    # serial execution, and mean occupancy shows real batching
+    serial_steps = sum(len(p) + max_new - 1 for p in prompts)
+    assert loop.steps < serial_steps
+    assert loop.mean_occupancy() > 0.5
+
+    for req, res in zip(reqs, results):
+        want_tokens, want_logits = full_decode(
+            params, cfg, req.prompt, req.max_new_tokens)
+        assert res.tokens == want_tokens
+        assert len(res.logits) == len(want_logits)
+        for got, want in zip(res.logits, want_logits):
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        assert res.ttft_s is not None
+
+    # every retired sequence's pages are back in the free pool
+    assert pool.free_pages == pool.num_pages
+    assert pool.stats()["live_sequences"] == 0
+
+
+def test_decode_pool_too_small_raises():
+    cfg = DecodeConfig(vocab_size=31, d_model=16, n_head=2, n_layer=1,
+                       d_inner=16, max_length=32)
+    params = init_decode_params(cfg, seed=1)
+    pool = KVCachePool(num_pages=1, page_size=2, num_layers=1,
+                       num_heads=2, head_dim=8)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1)
+    with pytest.raises(PagePoolExhausted):
+        loop.run([DecodeRequest([1, 2, 3], 4)])
+
+
+# -- observability wiring ----------------------------------------------
+
+def test_serving_metrics_emitted_when_enabled(cnn_predict):
+    from paddle_tpu import observability as obs
+
+    obs.reset()
+    fluid.set_flags({"FLAGS_observability": True})
+    try:
+        eng = Engine.from_artifact(
+            cnn_predict, config=EngineConfig(buckets=(1, 2), max_wait_s=0.0))
+        eng.infer({"image": np.zeros((1, 1, 8, 8), np.float32)})
+        eng.close()
+
+        cfg = DecodeConfig(vocab_size=17, d_model=8, n_head=2, n_layer=1,
+                           d_inner=16, max_length=16)
+        pool = KVCachePool(num_pages=4, page_size=4, num_layers=1,
+                           num_heads=2, head_dim=4)
+        ContinuousBatchingLoop(
+            init_decode_params(cfg, seed=0), cfg, pool, max_batch=2,
+        ).run([DecodeRequest([1, 2], 2)])
+
+        names = {m["name"] for m in obs.default_registry().snapshot()["metrics"]}
+        for want in (
+            "paddle_tpu_serving_queue_depth",
+            "paddle_tpu_serving_requests",
+            "paddle_tpu_serving_batches",
+            "paddle_tpu_serving_batch_occupancy",
+            "paddle_tpu_serving_request_latency_seconds",
+            "paddle_tpu_serving_ttft_seconds",
+            "paddle_tpu_serving_token_seconds",
+            "paddle_tpu_serving_page_pool_utilization",
+            "paddle_tpu_serving_sequences",
+        ):
+            assert want in names, f"missing {want} in {sorted(names)}"
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+        obs.reset()
+
+
+def test_serving_metrics_silent_when_disabled(cnn_predict):
+    from paddle_tpu import observability as obs
+
+    obs.reset()
+    assert not obs.enabled()
+    eng = Engine.from_artifact(
+        cnn_predict, config=EngineConfig(buckets=(1, 2), max_wait_s=0.0))
+    eng.infer({"image": np.zeros((1, 1, 8, 8), np.float32)})
+    eng.close()
+    assert obs.default_registry().snapshot()["metrics"] == []
+
+
+# -- serve_bench --------------------------------------------------------
+
+def test_serve_bench_engine_smoke_and_gate(tmp_path, capsys):
+    import json
+
+    from tools.serve_bench import main as bench_main
+
+    out = tmp_path / "bench.json"
+    rc = bench_main([
+        "--model", "mnist", "--requests", "8", "--rate", "400",
+        "--buckets", "1,2,4", "--batch-range", "1,4",
+        "--json", str(out),
+    ])
+    assert rc == 0
+    result = json.loads(out.read_text())
+    assert result["mode"] == "engine"
+    assert result["distinct_shapes"] <= 3
+    assert result["throughput_rps"] > 0
+    # bank this run, re-gate against itself: must pass
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps(
+        {"p99_ms": result["p99_ms"] * 10, "distinct_shapes": 3}))
+    rc = bench_main([
+        "--model", "mnist", "--requests", "8", "--rate", "400",
+        "--buckets", "1,2,4", "--batch-range", "1,4",
+        "--baseline", str(bank), "--tol", "0.5", "--gate",
+    ])
+    assert rc == 0
+    # an impossible baseline must fail the gate with exit 3
+    bank.write_text(json.dumps({"p99_ms": 1e-9}))
+    rc = bench_main([
+        "--model", "tiny", "--requests", "4", "--rate", "400",
+        "--buckets", "1,2", "--batch-range", "1,2",
+        "--baseline", str(bank), "--gate",
+    ])
+    assert rc == 3
+    capsys.readouterr()  # swallow the report text
+
+
+def test_serve_bench_decode_smoke(capsys):
+    from tools.serve_bench import main as bench_main
+
+    rc = bench_main([
+        "--mode", "decode", "--sequences", "3", "--max-new", "4",
+        "--d-model", "16", "--vocab", "31", "--max-len", "32",
+        "--pages", "32", "--page-size", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"pages_leaked": 0' in out
+
+
+@pytest.mark.slow
+def test_serve_bench_decode_transformer_scale(capsys):
+    """Transformer-shaped decode config (d_model 128, 4 layers) through
+    the paged loop — the load-generator run banked for trend tracking."""
+    from tools.serve_bench import main as bench_main
+
+    rc = bench_main([
+        "--mode", "decode", "--sequences", "8", "--max-new", "16",
+        "--d-model", "128", "--n-head", "8", "--n-layer", "4",
+        "--vocab", "512", "--max-len", "96", "--max-batch", "4",
+        "--pages", "128", "--page-size", "8",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"pages_leaked": 0' in out

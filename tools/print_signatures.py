@@ -30,6 +30,7 @@ MODULES = [
     "paddle_tpu.flags",
     "paddle_tpu.parallel",
     "paddle_tpu.resilience",
+    "paddle_tpu.serving",
     "paddle_tpu.inference",
     "paddle_tpu.transpiler",
     "paddle_tpu.reader",

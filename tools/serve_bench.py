@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Closed-loop serving load generator + regression gate.
+
+Drives the serving tier end to end and prints the numbers that matter for
+a batching server — latency percentiles, throughput, and batch occupancy
+(the lever that dominates served throughput on TPU):
+
+  engine mode (default): builds a model in-process, exports it as an AOT
+  StableHLO artifact, wraps it in a serving.Engine, and replays a Poisson
+  arrival process of mixed-size requests against submit().  Reports
+  p50/p99 request latency, requests/s, rows/s, mean batch occupancy, and
+  the engine's compile counters (distinct dispatched shapes must stay
+  <= len(buckets)).
+
+  decode mode (--mode decode): continuous-batching greedy decode of
+  mixed-length prompts through the paged KV cache (serving/generate.py).
+  Reports tokens/s, time-to-first-token percentiles, mean decode batch
+  occupancy, and page-pool stats.
+
+Gating mirrors tools/obsdump.py: --baseline BANKED.json re-checks this
+run against a banked artifact ({metric: value}; lower_is_better inferred
+from the metric name), --gate exits 3 on any fail — CI wiring.
+
+Usage:
+    python tools/serve_bench.py --model mnist --requests 50 --rate 200
+    python tools/serve_bench.py --mode decode --sequences 8 --max-new 16
+    python tools/serve_bench.py ... --json out.json
+    python tools/serve_bench.py ... --baseline BANK.json --tol 0.15 --gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _percentile(vals, q):
+    return float(np.percentile(np.asarray(vals), q)) if len(vals) else None
+
+
+def _build_artifact(model: str, out_dir: str):
+    """Build + AOT-export the requested model; returns (predict, feed
+    builder(batch_size) -> feed dict)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.inference import (
+        load_compiled_inference_model,
+        save_compiled_inference_model,
+    )
+
+    if model == "mnist":
+        from paddle_tpu.models.mnist import lenet5
+
+        spec = lenet5()
+        img_name = spec.feed_names[0]
+        predict_var = spec.extras["predict"]
+        shape = (1, 28, 28)
+    elif model == "tiny":
+        img = layers.data("image", [1, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        b = layers.batch_norm(c, act="relu")
+        p = layers.pool2d(b, pool_size=8, pool_type="avg")
+        predict_var = layers.fc(p, size=3, act="softmax")
+        img_name = "image"
+        shape = (1, 8, 8)
+    else:
+        raise SystemExit(f"unknown --model {model!r} (mnist|tiny)")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    save_compiled_inference_model(out_dir, [img_name], [predict_var], exe)
+    predict = load_compiled_inference_model(out_dir)
+
+    rng = np.random.RandomState(0)
+
+    def feed(batch: int):
+        return {img_name: rng.rand(batch, *shape).astype(np.float32)}
+
+    return predict, feed
+
+
+def run_engine_bench(args) -> dict:
+    from paddle_tpu import serving
+
+    with tempfile.TemporaryDirectory() as d:
+        predict, feed = _build_artifact(args.model, d)
+        buckets = serving.parse_buckets(args.buckets)
+        cfg = serving.EngineConfig(
+            buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
+            queue_depth=args.queue_depth)
+        engine = serving.Engine.from_artifact(predict, config=cfg,
+                                              name="serve_bench")
+        rng = np.random.RandomState(args.seed)
+        lo, hi = (int(p) for p in args.batch_range.split(","))
+        # pre-generate the workload so generation cost stays off the clock
+        reqs = [feed(int(rng.randint(lo, hi + 1)))
+                for _ in range(args.requests)]
+        # warmup compiles every bucket once — steady-state numbers, not
+        # first-compile spikes (compile time is banked separately)
+        if args.warmup:
+            # the ENGINE's ladder, not the requested one: a static-batch
+            # artifact collapses it, and feed(b) past max_batch would
+            # be rejected at submit
+            for b in engine.ladder.buckets:
+                engine.infer(feed(b))  # b rows land exactly in bucket b
+
+        gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+        t_start = time.perf_counter()
+        pending = []
+        for i, f in enumerate(reqs):
+            # closed-loop pacing: sleep to the Poisson schedule, but
+            # never ahead of it
+            target = t_start + float(gaps[: i + 1].sum())
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            pending.append((time.perf_counter(), engine.submit(f)))
+        lat = []
+        rows = 0
+        for i, (t0, fut) in enumerate(pending):
+            fut.result(timeout=60)
+            lat.append(time.perf_counter() - t0)
+            rows += reqs[i][predict.feed_names[0]].shape[0]
+        elapsed = time.perf_counter() - t_start
+        stats = engine.stats()
+        engine.close()
+    return {
+        "mode": "engine",
+        "model": args.model,
+        "requests": args.requests,
+        "buckets": list(stats["buckets"]),
+        "p50_ms": _percentile(lat, 50) * 1e3,
+        "p99_ms": _percentile(lat, 99) * 1e3,
+        "throughput_rps": args.requests / elapsed,
+        "throughput_rows_s": rows / elapsed,
+        "mean_occupancy": stats["mean_occupancy"],
+        "batches": stats["batches"],
+        "distinct_shapes": stats["distinct_shapes"],
+    }
+
+
+def run_decode_bench(args) -> dict:
+    from paddle_tpu import serving
+
+    cfg = serving.DecodeConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_head=args.n_head,
+        n_layer=args.n_layer, d_inner=args.d_model * 2,
+        max_length=args.max_len)
+    params = serving.init_decode_params(cfg, seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    pool = serving.KVCachePool(
+        num_pages=args.pages, page_size=args.page_size,
+        num_layers=cfg.n_layer, num_heads=cfg.n_head,
+        head_dim=cfg.head_dim)
+    plo, phi = (int(p) for p in args.prompt_range.split(","))
+    phi = min(phi, args.max_len - args.max_new)
+    reqs = []
+    for _ in range(args.sequences):
+        plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
+        reqs.append(serving.DecodeRequest(
+            prompt=rng.randint(1, cfg.vocab_size, size=plen).tolist(),
+            max_new_tokens=args.max_new))
+    loop = serving.ContinuousBatchingLoop(
+        params, cfg, pool, max_batch=args.max_batch)
+    t0 = time.perf_counter()
+    results = loop.run(reqs)
+    elapsed = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) for r in results)
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    st = pool.stats()
+    return {
+        "mode": "decode",
+        "sequences": args.sequences,
+        "steps": loop.steps,
+        "tokens": tokens,
+        "tokens_per_s": tokens / elapsed,
+        "ttft_p50_ms": _percentile(ttfts, 50) * 1e3,
+        "ttft_p99_ms": _percentile(ttfts, 99) * 1e3,
+        "mean_occupancy": loop.mean_occupancy(),
+        "pages_high_water": st["used_pages_high_water"],
+        "page_allocs": st["page_allocs"],
+        "pages_leaked": st["used_pages"],  # must be 0 after a full run
+    }
+
+
+# metrics where bigger is better; everything else (latencies, leak
+# counters) gates as lower-is-better
+_HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy")
+
+
+def gate(result: dict, baseline_path: str, tol: float):
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    verdicts = []
+    for metric, want in baseline.items():
+        have = result.get(metric)
+        if not isinstance(want, (int, float)) or have is None:
+            continue
+        higher_better = any(k in metric for k in _HIGHER_IS_BETTER)
+        if want == 0:
+            ok = have <= 0 if not higher_better else have >= 0
+            delta_pct = 0.0 if have == want else float("inf")
+        else:
+            delta = (have - want) / abs(want)
+            delta_pct = delta * 100.0
+            ok = delta >= -tol if higher_better else delta <= tol
+        verdicts.append({
+            "metric": metric, "current": have, "baseline": want,
+            "delta_pct": delta_pct, "tolerance_pct": tol * 100.0,
+            "verdict": "pass" if ok else "fail",
+        })
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("engine", "decode"), default="engine")
+    ap.add_argument("--model", default="mnist",
+                    help="engine mode: mnist|tiny (default mnist)")
+    ap.add_argument("--requests", type=int, default=50)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--batch-range", default="1,4",
+                    help="engine mode: per-request rows drawn uniformly "
+                         "from lo,hi")
+    ap.add_argument("--buckets", default=None,
+                    help="bucket ladder (default FLAGS_serving_buckets)")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--no-warmup", dest="warmup", action="store_false")
+    # decode mode
+    ap.add_argument("--sequences", type=int, default=8)
+    ap.add_argument("--prompt-range", default="2,16",
+                    help="decode mode: prompt lengths drawn uniformly "
+                         "from lo,hi")
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--pages", type=int, default=64)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--max-len", type=int, default=96)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None, help="write the result dict here")
+    ap.add_argument("--baseline", default=None,
+                    help="banked {metric: value} JSON to gate against")
+    ap.add_argument("--tol", type=float, default=0.15)
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when a baseline verdict fails")
+    args = ap.parse_args(argv)
+
+    result = (run_engine_bench(args) if args.mode == "engine"
+              else run_decode_bench(args))
+    print(json.dumps(result, indent=1, sort_keys=True))
+
+    failed = False
+    if args.baseline:
+        verdicts = gate(result, args.baseline, args.tol)
+        result["regression"] = verdicts
+        for v in verdicts:
+            sign = "+" if v["delta_pct"] >= 0 else ""
+            print(f"[{v['verdict'].upper():4}] {v['metric']}: "
+                  f"{v['current']:.4g} vs baseline {v['baseline']:.4g} "
+                  f"({sign}{v['delta_pct']:.2f}%, tol "
+                  f"{v['tolerance_pct']:.0f}%)")
+            failed = failed or v["verdict"] == "fail"
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 3 if (args.gate and failed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
