@@ -111,6 +111,16 @@ _DEFS: Dict[str, Any] = {
     # queue depth, deadlines) live on serving.EngineConfig; this flag
     # only sets the process default ladder
     "FLAGS_serving_buckets": "1,2,4,8,16",
+    # paged-attention decode implementation (kernels/paged_attention.py):
+    # "auto" (default) streams pages through the pallas ragged
+    # paged-attention kernel on TPU whenever pallas_paged_viable accepts
+    # the pool geometry (head_dim%128==0, page_size sublane-aligned) and
+    # takes the reference gather everywhere else; "reference" forces the
+    # gather + flash ragged k_lengths tier; "pallas" forces the kernel
+    # (falling back to reference OUTSIDE the envelope, with a one-time
+    # log — never a Mosaic compile failure); "interpret" runs the pallas
+    # kernel under the interpreter (CPU parity testing)
+    "FLAGS_serving_paged_impl": "auto",
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.
@@ -168,6 +178,7 @@ _CHOICES: Dict[str, tuple] = {
     "FLAGS_flash_bwd": ("jax", "pallas", "jaxlib"),
     "FLAGS_conv_epilogue": ("reference", "pallas"),
     "FLAGS_observability_cost": ("off", "native", "tpu"),
+    "FLAGS_serving_paged_impl": ("auto", "reference", "pallas", "interpret"),
 }
 
 

@@ -2,69 +2,292 @@
 
 The decode-step contract: one query token per sequence (Sq=1) attends to
 that sequence's cached keys/values, which live scattered across
-fixed-size pages of a shared pool.  Two implementations sit behind ONE
-call signature so the serving loop never changes when the fast path
-lands:
+fixed-size pages of a shared pool.  Three implementations sit behind ONE
+call signature so the serving loop never changes when the selection
+flips:
 
-- ``impl="reference"`` (default, any backend): gather the sequence's
-  pages into a contiguous [B, H, S, D] view (S = max pages * page_size
-  over the batch) and run the existing flash_attention ragged
-  ``k_lengths`` tier — the exact masking contract
-  tests/test_serving.py's decode-parity suite pins down.  The gather
-  materializes O(B*S*D) bytes per step; fine for CPU correctness and
-  small batches.
+- ``impl="reference"``: gather the sequence's pages into a contiguous
+  [B, H, S, D] view (S = max pages * page_size over the batch) and run
+  the existing flash_attention ragged ``k_lengths`` tier — the exact
+  masking contract tests/test_serving.py's decode-parity suite pins
+  down.  The gather materializes O(B*S*D) bytes per layer per token
+  (pages read + contiguous copy written + copy read back by attention
+  = ~3x the pallas path's traffic), which dominates decode bytes/step
+  as contexts grow; fine for CPU correctness and small batches.
 
-- ``impl="pallas"`` — the explicit follow-up seam (arxiv 2604.15464,
-  Ragged Paged Attention): a kernel whose grid walks each sequence's
-  page table in SMEM and streams K/V pages straight from HBM into the
-  online-softmax recurrence, so no contiguous copy ever exists.  Raises
-  NotImplementedError until that kernel lands; callers select it
-  explicitly, nothing falls back silently.
+- ``impl="pallas"`` (Ragged Paged Attention, arxiv 2604.15464): a
+  kernel whose grid walks each sequence's page table — prefetched to
+  SMEM via ``PrefetchScalarGridSpec``, so the table entry indexes the
+  DMA of the NEXT page while the current one computes — and streams
+  K/V pages straight from the pool arrays in HBM into the
+  online-softmax recurrence proven in flash_attention._flash_kernel
+  (VMEM-scratch m/l/acc, running-max floor NEG_INF/2).  No contiguous
+  KV copy ever exists: per layer per token the path reads each live
+  page exactly once.  Ragged tails (and the zero-padded tail of short
+  sequences' page tables) are masked by position against ``lengths``.
+
+- ``impl="interpret"``: the same pallas kernel under the Pallas
+  interpreter — CPU-testable parity against reference, the tier-1
+  contract suite.
+
+Selection (the kernels/conv_epilogue.py precedent — measured Mosaic
+envelope, explicit fallback, flag-driven): ``FLAGS_serving_paged_impl``
+(auto|reference|pallas|interpret) supplies the default; ``auto`` picks
+pallas on TPU when ``pallas_paged_viable`` accepts the pool geometry
+and reference everywhere else; an explicit ``pallas`` outside the
+envelope falls back to reference with a one-time log, never a Mosaic
+compile bomb.  The envelope: head_dim a lane multiple (128) and
+page_size a sublane multiple (8 fp32 / 16 bf16), so every K/V page
+block is natively (sublane, lane)-tiled — the constraint class that
+produced the flash residual-layout and conv-epilogue 'non-native
+tiling' chip failures.
+
+Pool layout is KERNEL-NATIVE: [H, P, page_size, D] per layer (heads
+outermost), so a (1, 1, page_size, D) page block's last two dims are
+exactly (page_size, head_dim) — Mosaic-tileable without relayout.  The
+decode query rides as a [B, H, 8, D] block (the single row zero-padded
+to one fp32 sublane; rows 1..7 compute discarded lanes) for the same
+reason.
 """
 
 from __future__ import annotations
 
+import functools
+import logging
+import math
+
+import jax
 import jax.numpy as jnp
 
-from .flash_attention import flash_attention
+from .flash_attention import NEG_INF, _on_tpu, flash_attention
 
-__all__ = ["gather_kv_pages", "paged_decode_attention"]
+__all__ = [
+    "attention_bytes_per_step",
+    "gather_kv_pages",
+    "paged_decode_attention",
+    "pallas_paged_viable",
+    "resolve_paged_impl",
+]
+
+_IMPLS = ("auto", "reference", "pallas", "interpret")
+
+# the query block is one fp32 sublane: row 0 is the real decode query,
+# rows 1..7 are zero padding whose outputs are sliced off host-side
+_SQ_PAD = 8
 
 
 def gather_kv_pages(pages, page_tables):
-    """Reference page gather: pages [P, page_size, H, D] +
-    page_tables [B, max_pages] int32 -> contiguous [B, H, S, D] with
-    S = max_pages * page_size.  Rows past a sequence's length are
-    whatever the padding pages hold — callers MUST mask via k_lengths."""
-    g = jnp.take(pages, page_tables, axis=0)  # [B, max_pages, page, H, D]
-    b, n_pages, page, h, d = g.shape
-    return jnp.transpose(g.reshape(b, n_pages * page, h, d), (0, 2, 1, 3))
+    """Reference page gather: pages [H, P, page_size, D] (one layer of
+    the pool) + page_tables [B, max_pages] int32 -> contiguous
+    [B, H, S, D] with S = max_pages * page_size.  Rows past a sequence's
+    length are whatever the padding pages hold — callers MUST mask via
+    k_lengths."""
+    tables = jnp.asarray(page_tables, jnp.int32)
+    b, n_pages = tables.shape
+    g = jnp.take(pages, tables.reshape(-1), axis=1)  # [H, B*maxp, page, D]
+    h, _, page, d = g.shape
+    return jnp.transpose(
+        g.reshape(h, b, n_pages * page, d), (1, 0, 2, 3))
+
+
+def pallas_paged_viable(page_size: int, head_dim: int,
+                        dtype="float32") -> bool:
+    """True when the pallas page reader supports this pool geometry on
+    TPU — the measured Mosaic envelope: K/V page blocks must be natively
+    (sublane, lane)-tiled, i.e. head_dim a 128-lane multiple and
+    page_size a sublane multiple (8 for fp32, 16 for bf16).  Out of
+    envelope the selection falls back to the reference gather —
+    explicitly, not at compile time."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.float32):
+        sublane = 8
+    elif dt == jnp.dtype(jnp.bfloat16):
+        sublane = 16
+    else:
+        return False
+    return head_dim % 128 == 0 and page_size % sublane == 0 and \
+        page_size >= sublane
+
+
+_fallback_noted = False
+
+
+def resolve_paged_impl(impl, page_size: int, head_dim: int,
+                       dtype="float32") -> str:
+    """Resolve the requested impl (None -> FLAGS_serving_paged_impl) to
+    the one that will actually run: 'auto' takes pallas on TPU inside
+    the envelope and reference otherwise; an explicit 'pallas' outside
+    the envelope falls back to 'reference' with a one-time log (the
+    conv-epilogue fallback contract — never a Mosaic compile failure)."""
+    global _fallback_noted
+    if impl is None:
+        from .. import flags
+
+        impl = flags.flag("serving_paged_impl")
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"paged-attention impl must be one of {_IMPLS}, got {impl!r}")
+    if impl == "auto":
+        return ("pallas" if _on_tpu() and
+                pallas_paged_viable(page_size, head_dim, dtype)
+                else "reference")
+    if impl == "pallas" and not pallas_paged_viable(
+            page_size, head_dim, dtype):
+        if not _fallback_noted:
+            _fallback_noted = True
+            logging.getLogger("paddle_tpu").info(
+                "pallas paged attention outside the Mosaic envelope "
+                "(page_size=%d head_dim=%d dtype=%s) — reference gather "
+                "fallback", page_size, head_dim, jnp.dtype(dtype).name)
+        return "reference"
+    return impl
+
+
+def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
+                             page_size: int, num_heads: int, head_dim: int,
+                             itemsize: int = 4, num_layers: int = 1) -> int:
+    """Analytic HBM bytes one decode step moves through the attention
+    KV path (the serving metrics gauge; the chip-less cost tier banks
+    the compiler-measured counterpart in AOT_COST_PAGED.json).  Per
+    layer, with S_kv = batch * max_pages * page_size * num_heads *
+    head_dim * itemsize for ONE of K or V:
+
+    - reference: pages read + contiguous [B,H,S,D] copy written +
+      copy read back by attention, for K and V -> 6 * S_kv;
+    - pallas/interpret: each page streamed exactly once, K and V
+      -> 2 * S_kv.
+
+    Query/output terms (batch*heads*head_dim) are negligible at decode
+    shapes and excluded."""
+    s_kv = batch * max_pages * page_size * num_heads * head_dim * itemsize
+    per_layer = (2 if impl in ("pallas", "interpret") else 6) * s_kv
+    return per_layer * int(num_layers)
+
+
+def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, page_size):
+    """Grid (B, H, max_pages); pages innermost so the online-softmax
+    state for one (sequence, head) lives in VMEM scratch across the
+    page walk.  tables_ref/lengths_ref are SMEM scalar-prefetch refs:
+    tables drives the K/V BlockSpec index maps (the page DMA), lengths
+    masks the ragged tail in-kernel.  Page table rows are zero-padded —
+    the dummy page-0 reads those DMAs issue are fully masked by
+    position >= length, exactly the flash fully-masked-block contract
+    (m floor NEG_INF/2, p underflows to 0, l stays 0)."""
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF / 2)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]  # [_SQ_PAD, D]
+    k = k_ref[0, 0]  # [page_size, D]
+    v = v_ref[0, 0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < lengths_ref[b], s, NEG_INF)
+
+    m_prev = m_scr[:]  # [_SQ_PAD, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p_w = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_scr[:] = correction * l_scr[:] + jnp.sum(p_w, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * correction + jnp.dot(
+        p_w.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=128)
+def _paged_call(batch, heads, max_pages, page_size, head_dim, scale,
+                kv_dtype, interpret):
+    """Memoized pallas_call — one traced callable per static config, so
+    every decode layer/step of a model reuses ONE kernel payload (the
+    flash_attention._fwd_call compile-cache contract)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dt = jnp.dtype(kv_dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page tables + lengths land in SMEM
+        grid=(batch, heads, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, _SQ_PAD, head_dim),
+                         lambda b, h, p, tables, lengths: (b, h, 0, 0)),
+            # the page walk: the SMEM table entry picks which pool page
+            # the next grid step DMAs — no gather ever materializes
+            pl.BlockSpec((1, 1, page_size, head_dim),
+                         lambda b, h, p, tables, lengths:
+                         (h, tables[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, head_dim),
+                         lambda b, h, p, tables, lengths:
+                         (h, tables[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, _SQ_PAD, head_dim),
+                               lambda b, h, p, tables, lengths: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((_SQ_PAD, 1), jnp.float32),
+            pltpu.VMEM((_SQ_PAD, 1), jnp.float32),
+            pltpu.VMEM((_SQ_PAD, head_dim), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, heads, _SQ_PAD, head_dim), dt),
+        interpret=interpret,
+    )
+
+
+def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
+                  interpret=False):
+    B, H, _, D = q.shape
+    _, _, page_size, _ = k_pages.shape
+    tables = jnp.asarray(page_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    qp = jnp.pad(q.astype(k_pages.dtype),
+                 ((0, 0), (0, 0), (0, _SQ_PAD - q.shape[2]), (0, 0)))
+    call = _paged_call(B, H, tables.shape[1], page_size, D, float(scale),
+                       str(k_pages.dtype), interpret)
+    out = call(tables, lengths, qp, k_pages, v_pages)
+    return out[:, :, :1, :].astype(q.dtype)
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
-                           scale=None, impl: str = "reference",
+                           scale=None, impl: str | None = None,
                            force: str = "auto"):
-    """q: [B, H, 1, D] decode queries; k_pages/v_pages: [P, page_size,
-    H, D] one layer of the pool; page_tables: [B, max_pages] int32;
-    lengths: [B] valid token counts (the new token already appended).
+    """q: [B, H, 1, D] decode queries; k_pages/v_pages: [H, P,
+    page_size, D] one layer of the pool; page_tables: [B, max_pages]
+    int32; lengths: [B] valid token counts (the new token already
+    appended).
 
     Returns [B, H, 1, D].  Causality is implied: the single query IS the
     last valid position, so masking keys at >= lengths is exactly the
-    causal frontier — the kernel runs with causal=False and the ragged
-    k_lengths mask doing the work.
+    causal frontier.
 
-    `force` forwards to flash_attention (reference impl only): "auto"
-    picks pallas on TPU / jax elsewhere, "interpret" runs the pallas
-    kernel in interpreter mode for CPU testing."""
-    if impl == "pallas":
-        raise NotImplementedError(
-            "pallas paged-attention (in-place page reads, no gather) is "
-            "the planned fast path — see serving/kvcache.py; use "
-            "impl='reference' meanwhile")
-    if impl != "reference":
-        raise ValueError(f"impl must be 'reference' or 'pallas', got {impl!r}")
+    `impl`: None reads FLAGS_serving_paged_impl; see resolve_paged_impl
+    for the auto/envelope/fallback contract.  `force` forwards to
+    flash_attention (reference impl only)."""
     if q.ndim != 4 or q.shape[2] != 1:
         raise ValueError(f"decode query must be [B, H, 1, D], got {q.shape}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    impl = resolve_paged_impl(impl, k_pages.shape[2], q.shape[3],
+                              k_pages.dtype)
+    if impl in ("pallas", "interpret"):
+        return _pallas_paged(q, k_pages, v_pages, page_tables, lengths,
+                             scale, interpret=(impl == "interpret"))
     k = gather_kv_pages(k_pages, page_tables)
     v = gather_kv_pages(v_pages, page_tables)
     return flash_attention(q, k, v, causal=False, scale=scale,

@@ -18,15 +18,19 @@ loop):
   (replicated-last-row padding, sliced off before completion: per-row
   outputs stay bit-identical to unbatched calls), and request records.
 - **Paged KV cache** (kvcache.py) — fixed-size page blocks in one
-  preallocated device array per model, per-sequence page tables,
+  preallocated device array per model (kernel-native [H, pages,
+  page_size, head_dim] layout per layer), per-sequence page tables,
   alloc/free/defrag accounting; attention reads it through
-  kernels/paged_attention.py (reference gather -> flash_attention
-  ragged ``k_lengths``; in-place Pallas page reads are the explicit
-  follow-up seam).
+  kernels/paged_attention.py — FLAGS_serving_paged_impl selects the
+  pallas ragged page-streaming kernel (no gather ever materializes) vs
+  the reference gather + flash ragged ``k_lengths`` tier, with a
+  measured-envelope fallback.
 - **Continuous batching** (generate.py) — greedy decode that admits
   waiting sequences the moment finished ones retire, holding batch
   occupancy (the serving throughput lever) across mixed-length
-  workloads; ``full_decode`` is the full-recompute parity oracle.
+  workloads; admitted prompts prefill in ONE whole-prompt causal pass
+  (``prefill_step``; ``prefill="token"`` keeps the step-per-token arm);
+  ``full_decode`` is the full-recompute parity oracle.
 
 Observability (serving/metrics.py): queue-depth/batch-occupancy gauges,
 TTFT and per-token latency histograms, page-pool utilization, and
@@ -53,6 +57,7 @@ from .generate import (
     full_decode,
     full_forward,
     init_decode_params,
+    prefill_step,
 )
 from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
 
@@ -76,4 +81,5 @@ __all__ = [
     "full_forward",
     "init_decode_params",
     "parse_buckets",
+    "prefill_step",
 ]

@@ -6,8 +6,16 @@ worse for TPU throughput — runs at batch 1.  This module fixes both:
 
 - **KV caching**: each generated token's per-layer K/V lands in the
   KVCachePool (kvcache.py); decode attention is one Sq=1 query against
-  the cached keys through kernels/paged_attention.py, which routes to
-  the existing flash_attention ragged ``k_lengths`` tier.
+  the cached keys through kernels/paged_attention.py —
+  FLAGS_serving_paged_impl (or the loop's ``paged_impl``) selects the
+  pallas ragged page-streaming kernel vs the reference gather, with the
+  envelope/fallback contract documented there.
+- **Batched prefill**: an admitted prompt's K/V is written by ONE
+  whole-prompt causal pass (``prefill_step`` — O(1) model steps per
+  prompt instead of one step per prompt token), ragged prompts padded
+  to the co-admitted max and masked via the flash ``k_lengths`` tier.
+  ``prefill="token"`` keeps the old token-by-token path as the A/B arm
+  and parity oracle.
 - **Continuous batching**: the loop keeps up to ``max_batch`` sequences
   in flight and admits a waiting sequence the moment a finished one
   retires (its pages return to the free pool) — batch occupancy stays
@@ -20,15 +28,16 @@ step function: post-norm residual blocks (LayerNorm(x + sublayer(x)),
 matching _Builder.sublayer), scaled embedding + sinusoid positions
 (matching _Builder.embed; the table is literally
 models.transformer._sinusoid_table), tied input/output embeddings, no
-cross-attention.  Every step feeds ONE token per active sequence —
-prefill is token-by-token through the same path (a batched prefill pass
-is a follow-up; it changes arithmetic order, so the parity oracle would
-need its own batched reference).
+cross-attention.
 
 ``full_decode`` is the correctness oracle: per-sequence greedy decode
 that recomputes the whole prefix each token with ordinary causal
 attention and no cache.  tests/test_serving.py holds the paged loop to
-it within fp32 tolerance.
+it within fp32 tolerance — and, because batched prefill changes
+arithmetic order (one padded causal pass vs Sq=1 steps), the prefill
+parity suite additionally pins ``prefill_step`` to ``full_forward``
+(the batched-reference oracle) and batched-vs-token generations to
+token identity.
 """
 
 from __future__ import annotations
@@ -40,8 +49,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import flags as _flags
-from ..kernels.flash_attention import _reference_attention
-from ..kernels.paged_attention import paged_decode_attention
+from ..kernels.flash_attention import _reference_attention, flash_attention
+from ..kernels.paged_attention import (
+    attention_bytes_per_step,
+    paged_decode_attention,
+    resolve_paged_impl,
+)
 from ..models.transformer import _sinusoid_table
 from . import metrics as _smetrics
 from .kvcache import KVCachePool
@@ -54,6 +67,7 @@ __all__ = [
     "init_decode_params",
     "full_forward",
     "full_decode",
+    "prefill_step",
 ]
 
 
@@ -156,12 +170,13 @@ def full_decode(params: Dict, cfg: DecodeConfig, prompt: Sequence[int],
 
 def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                 seq_ids: Sequence[int], tokens, positions,
-                force: str = "auto") -> np.ndarray:
+                force: str = "auto", impl: Optional[str] = None) -> np.ndarray:
     """One continuous-batching step: feed token[i] at position[i] for
     every active sequence, append its K/V to the pool, and return the
     next-token logits [B, V].  All sequences share the batch regardless
     of phase — a prefilling sequence and a deep-decode sequence differ
-    only in k_lengths."""
+    only in k_lengths.  `impl` selects the paged-attention path (None:
+    FLAGS_serving_paged_impl)."""
     import jax.numpy as jnp
 
     tokens = np.asarray(tokens, np.int32)
@@ -179,13 +194,65 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         pool.write_kv(li, pages, slots, k, v)
         attn = paged_decode_attention(
             q[:, :, None, :], pool.k_pages[li], pool.v_pages[li],
-            tables, lengths, scale=Dh ** -0.5, force=force,
+            tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
         )  # [B, H, 1, Dh]
         attn = attn[:, :, 0, :].reshape(B, d)
         h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
         ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
         h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
     return np.asarray(h @ jnp.asarray(params["embed"]).T)
+
+
+def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
+                 seq_ids: Sequence[int], prompts: Sequence[Sequence[int]],
+                 force: str = "auto") -> np.ndarray:
+    """Batched whole-prompt prefill: ONE causal pass over every prompt
+    (ragged lengths padded to the co-admitted max, masked through the
+    flash ``k_lengths`` tier) writes each prompt token's per-layer K/V
+    into the pool and returns the next-token logits [B, V] after each
+    prompt — the logits token-by-token prefill would only reach after
+    len(prompt) model steps.  Padded rows compute garbage that is never
+    read: attention masks them as keys, their K/V is never written
+    (only the claimed (page, slot)s are), and the returned row is
+    gathered at each sequence's true last position."""
+    import jax.numpy as jnp
+
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    if not len(lens) or lens.min() < 1:
+        raise ValueError("prefill needs non-empty prompts")
+    B, Smax = len(prompts), int(lens.max())
+    if Smax > cfg.max_length:
+        # before append_tokens: a failed prefill must not leave claimed
+        # slots with no K/V behind (the pool's atomicity contract)
+        raise ValueError(
+            f"prompt length {Smax} > max_length {cfg.max_length}")
+    d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    tokens = np.zeros((B, Smax), np.int32)
+    for i, p in enumerate(prompts):
+        tokens[i, :lens[i]] = p
+    # flat (sequence order, token order) claim — matches append_tokens
+    pages, slots = pool.append_tokens(seq_ids, lens)
+    b_idx = np.repeat(np.arange(B), lens)
+    t_idx = np.concatenate([np.arange(n) for n in lens])
+
+    h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+        + jnp.asarray(params["pos"])[None, :Smax]  # [B, Smax, d]
+    for li, lp in enumerate(params["layers"]):
+        q = (h @ lp["wq"]).reshape(B, Smax, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, Smax, H, Dh)
+        v = (h @ lp["wv"]).reshape(B, Smax, H, Dh)
+        # valid tokens only ([T, H, Dh] rows in claim order) reach the pool
+        pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
+        attn = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True, scale=Dh ** -0.5,
+            k_lengths=lens, force=force)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, Smax, d)
+        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
+        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+    h_last = h[jnp.arange(B), lens - 1]  # [B, d] true last positions
+    return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
 
 
 @dataclasses.dataclass
@@ -226,17 +293,37 @@ class ContinuousBatchingLoop:
     footprint (ceil((len(prompt)+max_new)/page_size) pages), so
     append_token can never raise mid-decode — a sequence, once admitted,
     always runs to completion.  Waiting requests admit in FIFO order the
-    moment retirements free enough pages."""
+    moment retirements free enough pages.
+
+    ``prefill="batched"`` (default) runs each co-admitted group's
+    prompts through ONE whole-prompt ``prefill_step`` — prefill model
+    steps per admission group are O(1) instead of O(max prompt len),
+    counted separately in ``prefill_steps``/``decode_steps``.
+    ``prefill="token"`` is the original token-by-token arm (the parity
+    oracle and A/B baseline).  ``paged_impl`` selects the decode
+    attention path (None: FLAGS_serving_paged_impl; resolved against
+    the pool geometry once, so metrics are labeled with the impl that
+    actually runs)."""
 
     def __init__(self, params: Dict, cfg: DecodeConfig, pool: KVCachePool,
-                 max_batch: int = 4, force: str = "auto"):
+                 max_batch: int = 4, force: str = "auto",
+                 paged_impl: Optional[str] = None,
+                 prefill: str = "batched"):
+        if prefill not in ("batched", "token"):
+            raise ValueError(
+                f"prefill must be 'batched' or 'token', got {prefill!r}")
         self.params = params
         self.cfg = cfg
         self.pool = pool
         self.max_batch = int(max_batch)
         self.force = force
+        self.prefill = prefill
+        self.paged_impl = resolve_paged_impl(
+            paged_impl, pool.page_size, cfg.head_dim, pool.k_pages.dtype)
         self._next_seq_id = 0
         self.steps = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
         self._occupancy_sum = 0.0
 
     def _footprint(self, req: DecodeRequest) -> int:
@@ -270,8 +357,34 @@ class ContinuousBatchingLoop:
         active: List[_Active] = []
         reserved_pages = 0
 
+        def emit(a: _Active, row: np.ndarray, t0: float, now: float) -> bool:
+            """Record one generated token; True when the sequence is done."""
+            nxt = int(row.argmax())
+            a.result.tokens.append(nxt)
+            a.result.logits.append(row)
+            if a.result.ttft_s is None:
+                a.result.ttft_s = now - a.result.admitted_at
+                if obs_on:
+                    _smetrics.record_ttft(a.result.ttft_s)
+            if obs_on:
+                _smetrics.record_token(now - t0, impl=self.paged_impl)
+            return (len(a.result.tokens) >= a.req.max_new_tokens
+                    or (self.cfg.eos_id is not None
+                        and nxt == self.cfg.eos_id))
+
+        def retire(batch: List[_Active], now: float) -> None:
+            nonlocal reserved_pages
+            for a in batch:
+                active.remove(a)
+                a.result.finished_at = now
+                self.pool.free_seq(a.seq_id)
+                reserved_pages -= self._footprint(a.req)
+                if obs_on:
+                    _smetrics.record_sequence("retired")
+
         while waiting or active:
             # admit (FIFO) while a slot and a full worst-case reservation fit
+            newly: List[_Active] = []
             while waiting and len(active) < self.max_batch:
                 req, seq = waiting[0]
                 need = self._footprint(req)
@@ -282,7 +395,9 @@ class ContinuousBatchingLoop:
                 self._next_seq_id += 1
                 self.pool.allocate(seq.seq_id)
                 seq.admitted_at = time.perf_counter()
-                active.append(_Active(req, seq.seq_id, seq))
+                a = _Active(req, seq.seq_id, seq)
+                active.append(a)
+                newly.append(a)
                 reserved_pages += need
                 if obs_on:
                     _smetrics.record_sequence("admitted")
@@ -290,7 +405,35 @@ class ContinuousBatchingLoop:
             # up-front validation guarantees the head request fits an
             # empty pool, so admission always progresses
 
-            # one token per active sequence (mixed prefill/decode batch)
+            if self.prefill == "batched" and newly:
+                # ONE whole-prompt pass for the co-admitted group: every
+                # prompt token's K/V lands in the pool and each sequence
+                # gets its first generated token — O(1) model steps per
+                # admission group vs O(max prompt len) token-by-token
+                t0 = time.perf_counter()
+                logits = prefill_step(
+                    self.params, self.cfg, self.pool,
+                    [a.seq_id for a in newly],
+                    [a.result.prompt for a in newly], force=self.force)
+                self.steps += 1
+                self.prefill_steps += 1
+                self._occupancy_sum += len(newly) / float(self.max_batch)
+                now = time.perf_counter()
+                done_now: List[_Active] = []
+                for i, a in enumerate(newly):
+                    a.pos = len(a.result.prompt)
+                    if emit(a, np.asarray(logits[i]), t0, now):
+                        done_now.append(a)
+                retire(done_now, now)
+                if obs_on:
+                    self._note_attention_bytes()
+                continue  # re-admit into freed slots before decoding
+
+            if not active:
+                continue
+            # one token per active sequence; under prefill="token" a
+            # still-prefilling sequence and a deep-decode sequence share
+            # the batch and differ only in k_lengths
             t0 = time.perf_counter()
             seq_ids = [a.seq_id for a in active]
             tokens = [
@@ -301,8 +444,9 @@ class ContinuousBatchingLoop:
             positions = [a.pos for a in active]
             logits = decode_step(
                 self.params, self.cfg, self.pool, seq_ids, tokens,
-                positions, force=self.force)
+                positions, force=self.force, impl=self.paged_impl)
             self.steps += 1
+            self.decode_steps += 1
             self._occupancy_sum += len(active) / float(self.max_batch)
             now = time.perf_counter()
 
@@ -311,29 +455,29 @@ class ContinuousBatchingLoop:
                 a.pos += 1
                 if a.pos < len(a.result.prompt):
                     continue  # still prefilling; logits unused
-                row = np.asarray(logits[i])
-                nxt = int(row.argmax())
-                a.result.tokens.append(nxt)
-                a.result.logits.append(row)
-                if a.result.ttft_s is None:
-                    a.result.ttft_s = now - a.result.admitted_at
-                    if obs_on:
-                        _smetrics.record_ttft(a.result.ttft_s)
-                if obs_on:
-                    _smetrics.record_token(now - t0)
-                done = (len(a.result.tokens) >= a.req.max_new_tokens
-                        or (self.cfg.eos_id is not None
-                            and nxt == self.cfg.eos_id))
-                if done:
+                if emit(a, np.asarray(logits[i]), t0, now):
                     retired.append(a)
-            for a in retired:
-                active.remove(a)
-                a.result.finished_at = now
-                self.pool.free_seq(a.seq_id)
-                reserved_pages -= self._footprint(a.req)
-                if obs_on:
-                    _smetrics.record_sequence("retired")
+            retire(retired, now)
+            if obs_on:
+                self._note_attention_bytes()
         return results
+
+    def _note_attention_bytes(self) -> None:
+        """Attention-bytes-per-step gauge for the CURRENT pool contents,
+        labeled with the impl that runs — callers gate on the
+        observability flag (zero-work disabled path)."""
+        st = self.pool.stats()
+        if not st["live_sequences"]:
+            return
+        maxp = self.pool.max_live_pages()
+        _smetrics.record_attention_bytes(
+            attention_bytes_per_step(
+                self.paged_impl, st["live_sequences"], maxp,
+                self.pool.page_size, self.pool.num_heads,
+                self.pool.head_dim,
+                itemsize=np.dtype(self.pool.k_pages.dtype).itemsize,
+                num_layers=self.pool.num_layers),
+            impl=self.paged_impl)
 
     def mean_occupancy(self) -> float:
         return self._occupancy_sum / self.steps if self.steps else 0.0

@@ -4,7 +4,7 @@ array, per-sequence page tables, alloc/free/defrag accounting.
 The shape follows Ragged Paged Attention (arxiv 2604.15464): instead of
 one contiguous [B, H, max_len, D] cache per sequence (whose worst-case
 max_len reservation strands HBM the moment sequence lengths vary), the
-cache is a pool of PAGES — [num_pages, page_size, H, D] per layer, all
+cache is a pool of PAGES — [H, num_pages, page_size, D] per layer, all
 layers stacked in one array so one allocation covers the model.  A
 sequence owns an ordered list of page ids (its page table) and a length;
 appending a token claims the next slot in its last page, allocating a
@@ -12,11 +12,15 @@ fresh page only every `page_size` tokens.  Fragmentation is impossible at
 page granularity (any free page serves any sequence) and retiring a
 sequence returns its pages to the free list in O(pages).
 
-Attention consumes the pool through kernels/paged_attention.py: the
-reference implementation gathers the sequence's pages into a contiguous
-[B, H, S, D] view and runs the existing flash_attention ragged
-`k_lengths` tier; a Pallas kernel that reads pages in place (no gather
-materialization) is the explicit follow-up seam (`impl="pallas"`).
+The layout is KERNEL-NATIVE: heads sit OUTSIDE the page dim so one
+(page, head) block of the pallas page reader
+(kernels/paged_attention.py) is a contiguous [page_size, head_dim]
+plane — natively (sublane, lane)-tiled on TPU, streamed from HBM
+without relayout.  Attention consumes the pool through
+paged_decode_attention: `impl="pallas"` walks each sequence's page
+table in SMEM and reads pages in place (no gather materialization);
+`impl="reference"` gathers the pages into a contiguous [B, H, S, D]
+view for the flash_attention ragged `k_lengths` tier.
 
 Writes use jax functional updates (`.at[...].set`), so the pool works on
 any backend; on TPU XLA performs them as in-place dynamic-update-slices
@@ -57,8 +61,9 @@ class SequenceHandle:
 class KVCachePool:
     """Preallocated paged K/V storage for every layer of one model.
 
-    k_pages / v_pages: [num_layers, num_pages, page_size, num_heads,
-    head_dim] jax arrays.  All mutation (allocate/append/free/defrag) is
+    k_pages / v_pages: [num_layers, num_heads, num_pages, page_size,
+    head_dim] jax arrays (heads outermost — the pallas page reader's
+    native block layout).  All mutation (allocate/append/free/defrag) is
     serialized under one lock — the continuous-batching loop drives the
     pool from its own thread while metrics/introspection may read from
     others."""
@@ -76,7 +81,7 @@ class KVCachePool:
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.name = name
-        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        shape = (num_layers, num_heads, num_pages, page_size, head_dim)
         self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self.v_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self._lock = threading.Lock()
@@ -130,27 +135,46 @@ class KVCachePool:
         sequence; advances lengths.  Returns (pages [B], slots [B])
         int32 arrays for write_kv.  Raises PagePoolExhausted (before
         mutating ANY table) if the claim cannot be satisfied."""
+        return self.append_tokens(seq_ids, [1] * len(seq_ids))
+
+    def append_tokens(self, seq_ids: Sequence[int],
+                      counts: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Claim (page, slot)s for counts[i] new tokens on sequence i in
+        ONE atomic step — the batched-prefill path (a whole prompt's
+        worth of slots per sequence, one pool transaction instead of one
+        per token).  Returns (pages [T], slots [T]) int32 flattened in
+        (sequence order, token order) — exactly the row order of
+        k[b_idx, :, t_idx] at the write_kv call site.  Raises
+        PagePoolExhausted before mutating ANY table."""
+        counts = [int(c) for c in counts]
+        if len(counts) != len(seq_ids) or any(c < 0 for c in counts):
+            raise ValueError("counts must align with seq_ids and be >= 0")
         with self._lock:
-            need = sum(
-                1 for s in seq_ids
-                if self._tables[s].length == self._tables[s].capacity(self.page_size)
-            )
+            need = 0
+            for s, c in zip(seq_ids, counts):
+                h = self._tables[s]
+                free_slots = h.capacity(self.page_size) - h.length
+                if c > free_slots:
+                    need += self.pages_needed(c - free_slots, self.page_size)
             if need > len(self._free):
                 raise PagePoolExhausted(
                     f"pool '{self.name}': need {need} fresh pages for "
-                    f"{len(seq_ids)} appends but only {len(self._free)} "
+                    f"{sum(counts)} appends but only {len(self._free)} "
                     f"free of {self.num_pages}")
-            pages = np.empty(len(seq_ids), np.int32)
-            slots = np.empty(len(seq_ids), np.int32)
-            for i, s in enumerate(seq_ids):
+            pages = np.empty(sum(counts), np.int32)
+            slots = np.empty(sum(counts), np.int32)
+            i = 0
+            for s, c in zip(seq_ids, counts):
                 h = self._tables[s]
-                if h.length == h.capacity(self.page_size):
-                    h.pages.append(self._free.pop())
-                    self._stats["page_allocs"] += 1
-                pages[i] = h.pages[-1]
-                slots[i] = h.length % self.page_size
-                h.length += 1
-            self._stats["token_appends"] += len(seq_ids)
+                for _ in range(c):
+                    if h.length == h.capacity(self.page_size):
+                        h.pages.append(self._free.pop())
+                        self._stats["page_allocs"] += 1
+                    pages[i] = h.pages[-1]
+                    slots[i] = h.length % self.page_size
+                    h.length += 1
+                    i += 1
+            self._stats["token_appends"] += sum(counts)
             used = self.num_pages - len(self._free)
             if used > self._stats["used_pages_high_water"]:
                 self._stats["used_pages_high_water"] = used
@@ -159,14 +183,18 @@ class KVCachePool:
 
     def write_kv(self, layer: int, pages: np.ndarray, slots: np.ndarray,
                  k, v) -> None:
-        """Write one token's K/V for `layer` on each sequence:
-        k/v [B, num_heads, head_dim] into the claimed (page, slot)s.
-        Locked like every other mutation: an unlocked read-modify-write
-        of the arrays would race defrag()'s permutation and silently
-        drop one side's update."""
+        """Write token K/V for `layer`: k/v [T, num_heads, head_dim]
+        into the claimed (page, slot)s (T = batch rows for one decode
+        step, or a whole prompt batch's flattened tokens for prefill).
+        (page, slot) pairs must be distinct — append_token/append_tokens
+        guarantee it.  Locked like every other mutation: an unlocked
+        read-modify-write of the arrays would race defrag()'s
+        permutation and silently drop one side's update."""
         with self._lock:
-            self.k_pages = self.k_pages.at[layer, pages, slots].set(k)
-            self.v_pages = self.v_pages.at[layer, pages, slots].set(v)
+            # non-contiguous advanced indices (slice over H between
+            # them): the indexed view is [T, H, D] — k/v land as-is
+            self.k_pages = self.k_pages.at[layer, :, pages, slots].set(k)
+            self.v_pages = self.v_pages.at[layer, :, pages, slots].set(v)
 
     # -- read side ------------------------------------------------------
 
@@ -187,6 +215,13 @@ class KVCachePool:
     def length(self, seq_id: int) -> int:
         with self._lock:
             return self._tables[seq_id].length
+
+    def max_live_pages(self) -> int:
+        """Longest live sequence's page count (0 when idle) — the width
+        of the decode attention batch's page table."""
+        with self._lock:
+            return max((len(h.pages) for h in self._tables.values()),
+                       default=0)
 
     # -- accounting -----------------------------------------------------
 
@@ -223,9 +258,9 @@ class KVCachePool:
         """Compact used pages to the lowest indices (one permutation
         gather per K/V array) and rebuild the free list as the dense
         tail.  Page-granular allocation never NEEDS this for correctness
-        — any free page serves any sequence — but a compacted pool lets
-        an operator shrink `num_pages` between runs and keeps gather
-        indices dense for the follow-up Pallas page reader.  Returns the
+        — any free page serves any sequence, and the Pallas page reader
+        follows the page table wherever it points — but a compacted pool
+        lets an operator shrink `num_pages` between runs.  Returns the
         number of pages moved."""
         with self._lock:
             used: List[int] = []
@@ -241,8 +276,8 @@ class KVCachePool:
                 leftover = [p for p in range(self.num_pages)
                             if p not in remap]
                 perm[len(remap):] = leftover
-                self.k_pages = self.k_pages[:, perm]
-                self.v_pages = self.v_pages[:, perm]
+                self.k_pages = self.k_pages[:, :, perm]
+                self.v_pages = self.v_pages[:, :, perm]
                 for h in self._tables.values():
                     h.pages = [remap[p] for p in h.pages]
             self._free = list(range(self.num_pages - 1, len(remap) - 1, -1))

@@ -17,7 +17,14 @@ Metrics:
 - paddle_tpu_serving_batch_latency_seconds  histogram dispatch wall time
 - paddle_tpu_serving_request_latency_seconds histogram submit->complete
 - paddle_tpu_serving_ttft_seconds           histogram admit->first token
-- paddle_tpu_serving_token_seconds          histogram per generated token
+- paddle_tpu_serving_token_seconds          histogram {impl=} per generated
+                                                      token (labeled with
+                                                      the active paged-
+                                                      attention impl)
+- paddle_tpu_serving_attention_bytes_per_step gauge  {impl=} analytic HBM
+                                                      bytes the decode
+                                                      attention KV path
+                                                      moves per step
 - paddle_tpu_serving_page_pool_used_pages   gauge    {pool=} pages in use
 - paddle_tpu_serving_page_pool_utilization  gauge    {pool=} used/total
 - paddle_tpu_serving_sequences_total        counter  {event=admitted|
@@ -113,11 +120,21 @@ def record_ttft(seconds: float) -> None:
     ).observe(seconds)
 
 
-def record_token(seconds: float) -> None:
+def record_token(seconds: float, impl: str = "reference") -> None:
     default_registry().histogram(
         "paddle_tpu_serving_token_seconds",
         "wall time per generated token (per sequence-step)",
-    ).observe(seconds)
+    ).observe(seconds, impl=impl)
+
+
+def record_attention_bytes(nbytes: int, impl: str) -> None:
+    """Analytic decode-attention KV bytes per step for the current
+    batch/pool geometry (kernels.paged_attention.attention_bytes_per_step)
+    — the live counterpart of the banked AOT_COST_PAGED.json A/B."""
+    default_registry().gauge(
+        "paddle_tpu_serving_attention_bytes_per_step",
+        "analytic HBM bytes the decode attention KV path moves per step",
+    ).set(float(nbytes), impl=impl)
 
 
 def record_page_pool(used: int, total: int, pool: str = "kv") -> None:

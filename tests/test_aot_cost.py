@@ -98,6 +98,66 @@ def test_executor_cost_analysis_platform_tpu():
     assert ca.get("bytes accessed", 0) > 0
 
 
+def test_paged_attention_pallas_kills_gather_bytes():
+    """ISSUE 5 acceptance: at transformer decode shapes the pallas
+    ragged paged-attention path must eliminate the reference gather's
+    O(B*S*D) bytes/step.  Both arms AOT-compile for v5e through the REAL
+    TPU pipeline (so Mosaic must accept the page-walk kernel, not just
+    the interpreter) and are priced by the TPU compiler's cost model.
+    The pallas kernel's page-stream DMAs are driven by the SMEM page
+    table and invisible to the XLA-level cost model, so the honest A/B
+    charges the kernel its full analytic streaming traffic
+    (attention_bytes_per_step) ON TOP of the measured custom-call bytes
+    — and still must clear the floor.  The measured table is banked as
+    AOT_COST_PAGED.json."""
+    _skip_if_no_topology()
+    import json
+    import os
+
+    from paddle_tpu.kernels.paged_attention import (
+        attention_bytes_per_step,
+        paged_decode_attention,
+        pallas_paged_viable,
+    )
+
+    B, H, D, ps, maxp = 4, 8, 128, 16, 32  # 512 cached tokens/sequence
+    assert pallas_paged_viable(ps, D)
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, H, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((H, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+
+    def arm(impl):
+        return tpu_cost_analysis(
+            lambda q, kp, vp, tb, ln: paged_decode_attention(
+                q, kp, vp, tb, ln, impl=impl),
+            q, kp, kp, tb, ln)["bytes accessed"]
+
+    ref = arm("reference")
+    pal = arm("pallas")
+    stream = attention_bytes_per_step("pallas", B, maxp, ps, H, D)
+    # the contiguous [B, H, S, D] gather copy is gone from the XLA
+    # program entirely: the paged custom call's XLA-visible traffic is
+    # q/tables/output noise, not O(B*S*D)
+    assert pal <= 0.05 * ref, (
+        f"pallas paged XLA-visible bytes did not collapse: {pal:.3e} vs "
+        f"reference {ref:.3e}")
+    # charging the kernel's FULL analytic page-stream traffic on top,
+    # the paged path still clears a >=2.5x bytes/step win
+    assert pal + stream <= 0.4 * ref, (
+        f"paged path bytes/step floor missed: {pal + stream:.3e} vs "
+        f"reference {ref:.3e} (ratio {(pal + stream) / ref:.3f} > 0.4)")
+    # the banked artifact stays consistent with what this tier measures
+    banked_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                               "AOT_COST_PAGED.json")
+    with open(banked_path) as f:
+        banked = json.load(f)
+    ab = banked["decode_shape_ab"]
+    assert ab["floor"] == 0.4
+    assert ab["ratio_with_analytic_stream"] <= ab["floor"]
+
+
 def test_compile_tpu_full_pipeline_catches_more_than_export():
     """compile_tpu runs the whole XLA TPU pipeline (layout, fusion,
     memory budgeting) — the pallas conv kernel must survive it inside

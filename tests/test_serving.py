@@ -13,6 +13,21 @@ Acceptance criteria pinned here (ISSUE 4):
 Plus the decode-shaped ragged-attention contract the KV loop relies on:
 flash_attention at Sq=1 with growing k_lengths == _reference_attention
 token-for-token.
+
+ISSUE 5 additions (pallas ragged paged attention + batched prefill):
+(e) interpret-mode pallas paged decode == the reference gather path
+    token-for-token over a multi-step simulated decode with ragged
+    lengths, mixed page counts, and >=3 overlapping sequences — and the
+    whole continuous-batching loop under paged_impl="interpret" matches
+    full_decode;
+(f) batched whole-prompt prefill: prefill_step == full_forward's last
+    row per sequence (the batched-reference oracle), batched-vs-token
+    loops produce token-identical generations, and prefill model-steps
+    drop from O(prompt_len) to O(1) per admission group (step counters);
+(g) envelope/flag selection: pallas_paged_viable encodes the Mosaic
+    tiling envelope, explicit pallas outside it falls back to reference
+    (same numbers, no compile bomb), FLAGS_serving_paged_impl validates
+    its choices.
 """
 
 import threading
@@ -33,7 +48,13 @@ from paddle_tpu.kernels.flash_attention import (
     _reference_attention,
     flash_attention,
 )
-from paddle_tpu.kernels.paged_attention import gather_kv_pages
+from paddle_tpu.kernels.paged_attention import (
+    attention_bytes_per_step,
+    gather_kv_pages,
+    paged_decode_attention,
+    pallas_paged_viable,
+    resolve_paged_impl,
+)
 from paddle_tpu.resilience import PreemptionDrain
 from paddle_tpu.serving import (
     ContinuousBatchingLoop,
@@ -47,7 +68,9 @@ from paddle_tpu.serving import (
     QueueFullError,
     RequestTimeoutError,
     full_decode,
+    full_forward,
     init_decode_params,
+    prefill_step,
 )
 
 
@@ -499,6 +522,193 @@ def test_flash_decode_ragged_matches_reference_token_for_token():
                 err_msg=f"step {t} force={force}")
 
 
+# -- (e) pallas ragged paged attention: interpret-mode parity ----------
+
+def test_paged_pallas_interpret_matches_reference_multistep():
+    """The REAL pallas page-walk kernel (interpret mode) vs the
+    reference gather, token-for-token over a simulated multi-step decode:
+    >=3 overlapping sequences, ragged lengths, mixed page counts — the
+    pool-level mirror of the flash Sq=1 contract test."""
+    H, Dh, page_size = 2, 8, 3  # odd page size: deliberately unaligned
+    pool = KVCachePool(num_pages=32, page_size=page_size, num_layers=1,
+                       num_heads=H, head_dim=Dh)
+    rng = np.random.RandomState(23)
+    seq_ids = [0, 1, 2, 3]
+    for s in seq_ids:
+        pool.allocate(s)
+    # stagger the prefixes so lengths (and page counts) stay ragged
+    for s, prefix in zip(seq_ids, (5, 1, 9, 3)):
+        for _ in range(prefix):
+            pages, slots = pool.append_token([s])
+            pool.write_kv(0, pages, slots,
+                          rng.standard_normal((1, H, Dh)).astype(np.float32),
+                          rng.standard_normal((1, H, Dh)).astype(np.float32))
+    for step in range(12):
+        pages, slots = pool.append_token(seq_ids)
+        B = len(seq_ids)
+        pool.write_kv(0, pages, slots,
+                      rng.standard_normal((B, H, Dh)).astype(np.float32),
+                      rng.standard_normal((B, H, Dh)).astype(np.float32))
+        tables, lengths = pool.page_table_batch(seq_ids)
+        assert len(set(tables.shape[1] - (lengths - 1) // page_size)) > 1, \
+            "page counts must stay mixed for the test to bite"
+        q = rng.standard_normal((B, H, 1, Dh)).astype(np.float32)
+        want = np.asarray(paged_decode_attention(
+            q, pool.k_pages[0], pool.v_pages[0], tables, lengths,
+            impl="reference"))
+        got = np.asarray(paged_decode_attention(
+            q, pool.k_pages[0], pool.v_pages[0], tables, lengths,
+            impl="interpret"))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                   err_msg=f"step {step}")
+
+
+def test_paged_envelope_and_flag_selection():
+    """pallas_paged_viable encodes the Mosaic tiling envelope; explicit
+    pallas OUTSIDE it falls back to the reference gather (identical
+    numbers, never a compile failure); the flag validates its choices."""
+    # in-envelope: lane-multiple head_dim, sublane-multiple page size
+    assert pallas_paged_viable(16, 128)
+    assert pallas_paged_viable(8, 256)
+    assert pallas_paged_viable(16, 128, "bfloat16")
+    # out: unaligned page size / head_dim / dtype
+    assert not pallas_paged_viable(3, 128)
+    assert not pallas_paged_viable(16, 64)
+    assert not pallas_paged_viable(8, 128, "bfloat16")  # bf16 sublane=16
+    assert not pallas_paged_viable(16, 128, "float64")
+    # resolution: auto on CPU -> reference; explicit pallas out of
+    # envelope -> reference fallback; interpret passes through
+    assert resolve_paged_impl(None, 16, 128) == "reference"
+    assert resolve_paged_impl("pallas", 3, 8) == "reference"
+    assert resolve_paged_impl("interpret", 3, 8) == "interpret"
+    with pytest.raises(ValueError, match="impl"):
+        resolve_paged_impl("mosaic", 16, 128)
+    with pytest.raises(ValueError):
+        fluid.set_flags({"FLAGS_serving_paged_impl": "gather"})
+    # the loop resolves the impl it will actually run (and labels
+    # metrics with it)
+    cfg = DecodeConfig(vocab_size=17, d_model=16, n_head=2, n_layer=1,
+                       d_inner=16, max_length=16)
+    pool = KVCachePool(num_pages=4, page_size=4, num_layers=1,
+                       num_heads=2, head_dim=8)
+    loop = ContinuousBatchingLoop(init_decode_params(cfg, seed=0), cfg,
+                                  pool, paged_impl="pallas")
+    assert loop.paged_impl == "reference"  # head_dim 8: out of envelope
+    with pytest.raises(ValueError, match="prefill"):
+        ContinuousBatchingLoop(init_decode_params(cfg, seed=0), cfg,
+                               pool, prefill="speculative")
+
+
+def test_attention_bytes_per_step_model():
+    """The metrics gauge's analytic model: reference moves 3x the KV
+    bytes of the pallas stream (pages + contiguous copy written + copy
+    read back), scaled by layers."""
+    kw = dict(batch=4, max_pages=32, page_size=16, num_heads=8,
+              head_dim=128, itemsize=4, num_layers=2)
+    s_kv = 4 * 32 * 16 * 8 * 128 * 4
+    assert attention_bytes_per_step("pallas", **kw) == 2 * s_kv * 2
+    assert attention_bytes_per_step("interpret", **kw) == 2 * s_kv * 2
+    assert attention_bytes_per_step("reference", **kw) == 6 * s_kv * 2
+
+
+# -- (f) batched whole-prompt prefill ----------------------------------
+
+def test_prefill_step_matches_full_forward_oracle():
+    """ONE batched causal pass == the whole-sequence oracle: last-row
+    logits per sequence at fp32 tolerance, the pool holding exactly the
+    K/V token-by-token prefill would have written."""
+    cfg = DecodeConfig(vocab_size=37, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=32)
+    params = init_decode_params(cfg, seed=9)
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (6, 2, 4)]
+    pool = KVCachePool(num_pages=16, page_size=4, num_layers=cfg.n_layer,
+                       num_heads=cfg.n_head, head_dim=cfg.head_dim)
+    for s in range(len(prompts)):
+        pool.allocate(s)
+    logits = prefill_step(params, cfg, pool, list(range(len(prompts))),
+                          prompts)
+    for i, p in enumerate(prompts):
+        want = full_forward(params, cfg, p)[-1]
+        np.testing.assert_allclose(logits[i], want, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"sequence {i}")
+        assert pool.length(i) == len(p)
+    # the cached K/V is the same content token-by-token would have
+    # produced: a decode step on top must match full_decode's next token
+    tokens = [int(row.argmax()) for row in logits]
+    from paddle_tpu.serving.generate import decode_step
+
+    step_logits = decode_step(params, cfg, pool, list(range(len(prompts))),
+                              tokens, [len(p) for p in prompts])
+    for i, p in enumerate(prompts):
+        want_tokens, want_logits = full_decode(params, cfg, p, 2)
+        assert tokens[i] == want_tokens[0]
+        np.testing.assert_allclose(step_logits[i], want_logits[1],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_batched_prefill_token_identical_and_o1_steps():
+    """prefill='batched' vs prefill='token': token-identical
+    generations, logits at fp32 tolerance — and prefill model-steps are
+    O(1) per admission group instead of O(prompt_len)."""
+    cfg = DecodeConfig(vocab_size=53, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=48)
+    params = init_decode_params(cfg, seed=3)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (7, 3, 5)]
+    max_new = 5
+
+    def run(prefill):
+        pool = KVCachePool(num_pages=24, page_size=4,
+                           num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                           head_dim=cfg.head_dim)
+        loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3,
+                                      prefill=prefill)
+        return loop, loop.run(
+            [DecodeRequest(p, max_new) for p in prompts])
+
+    tok_loop, tok_res = run("token")
+    bat_loop, bat_res = run("batched")
+    for t, b in zip(tok_res, bat_res):
+        assert t.tokens == b.tokens
+        for lt, lb in zip(t.logits, b.logits):
+            np.testing.assert_allclose(lb, lt, rtol=1e-4, atol=1e-4)
+    # token-by-token burns one model step per prompt token; batched
+    # prefill is ONE step for the whole co-admitted group
+    assert tok_loop.prefill_steps == 0
+    assert bat_loop.prefill_steps == 1  # all 3 admit together
+    assert bat_loop.steps == 1 + bat_loop.decode_steps
+    assert bat_loop.steps <= tok_loop.steps - (max(len(p) for p in prompts) - 1)
+    # both loops retire cleanly
+    assert tok_loop.pool.free_pages == tok_loop.pool.num_pages
+    assert bat_loop.pool.free_pages == bat_loop.pool.num_pages
+
+
+def test_continuous_batching_pallas_interpret_end_to_end():
+    """The whole loop — batched prefill + pallas (interpret) paged
+    decode — against the full-recompute oracle."""
+    cfg = DecodeConfig(vocab_size=41, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=32)
+    params = init_decode_params(cfg, seed=7)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (4, 2, 3)]
+    pool = KVCachePool(num_pages=18, page_size=4, num_layers=cfg.n_layer,
+                       num_heads=cfg.n_head, head_dim=cfg.head_dim)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3,
+                                  paged_impl="interpret")
+    assert loop.paged_impl == "interpret"
+    results = loop.run([DecodeRequest(p, 4) for p in prompts])
+    for p, res in zip(prompts, results):
+        want_tokens, want_logits = full_decode(params, cfg, p, 4)
+        assert res.tokens == want_tokens
+        for got, want in zip(res.logits, want_logits):
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    assert pool.free_pages == pool.num_pages
+
+
 # -- (c) continuous-batching decode parity ------------------------------
 
 def test_continuous_batching_decode_matches_full_recompute():
@@ -574,7 +784,8 @@ def test_serving_metrics_emitted_when_enabled(cnn_predict):
             init_decode_params(cfg, seed=0), cfg, pool, max_batch=2,
         ).run([DecodeRequest([1, 2], 2)])
 
-        names = {m["name"] for m in obs.default_registry().snapshot()["metrics"]}
+        snap = obs.default_registry().snapshot()["metrics"]
+        names = {m["name"] for m in snap}
         for want in (
             "paddle_tpu_serving_queue_depth",
             "paddle_tpu_serving_requests",
@@ -583,10 +794,22 @@ def test_serving_metrics_emitted_when_enabled(cnn_predict):
             "paddle_tpu_serving_request_latency_seconds",
             "paddle_tpu_serving_ttft_seconds",
             "paddle_tpu_serving_token_seconds",
+            "paddle_tpu_serving_attention_bytes_per_step",
             "paddle_tpu_serving_page_pool_utilization",
             "paddle_tpu_serving_sequences",
         ):
             assert want in names, f"missing {want} in {sorted(names)}"
+        # decode-step instruments are labeled with the active impl
+        by_name = {m["name"]: m for m in snap}
+        tok_labels = {s["labels"].get("impl")
+                      for s in by_name["paddle_tpu_serving_token_seconds"]
+                      ["series"]}
+        assert tok_labels == {"reference"}  # CPU auto-resolves reference
+        bytes_series = by_name[
+            "paddle_tpu_serving_attention_bytes_per_step"]["series"]
+        assert bytes_series and all(
+            s["labels"]["impl"] == "reference" and s["value"] > 0
+            for s in bytes_series)
     finally:
         fluid.set_flags({"FLAGS_observability": False})
         obs.reset()

@@ -31,6 +31,9 @@ MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.resilience",
     "paddle_tpu.serving",
+    # the serving hot path's kernel entry points are public surface:
+    # serve_bench / operators select impls through them
+    "paddle_tpu.kernels.paged_attention",
     "paddle_tpu.inference",
     "paddle_tpu.transpiler",
     "paddle_tpu.reader",

@@ -15,7 +15,12 @@ a batching server — latency percentiles, throughput, and batch occupancy
   decode mode (--mode decode): continuous-batching greedy decode of
   mixed-length prompts through the paged KV cache (serving/generate.py).
   Reports tokens/s, time-to-first-token percentiles, mean decode batch
-  occupancy, and page-pool stats.
+  occupancy, page-pool stats, and prefill/decode step counters.
+  --paged-impl {reference,pallas,interpret} pins the paged-attention
+  path (default: FLAGS_serving_paged_impl, i.e. auto) and --prefill
+  {batched,token} picks the prefill arm; both land in the result dict,
+  so a reference-vs-pallas A/B rides the --baseline/--gate machinery
+  like any other regression check.
 
 Gating mirrors tools/obsdump.py: --baseline BANKED.json re-checks this
 run against a banked artifact ({metric: value}; lower_is_better inferred
@@ -170,7 +175,8 @@ def run_decode_bench(args) -> dict:
             prompt=rng.randint(1, cfg.vocab_size, size=plen).tolist(),
             max_new_tokens=args.max_new))
     loop = serving.ContinuousBatchingLoop(
-        params, cfg, pool, max_batch=args.max_batch)
+        params, cfg, pool, max_batch=args.max_batch,
+        paged_impl=args.paged_impl, prefill=args.prefill)
     t0 = time.perf_counter()
     results = loop.run(reqs)
     elapsed = time.perf_counter() - t0
@@ -179,8 +185,12 @@ def run_decode_bench(args) -> dict:
     st = pool.stats()
     return {
         "mode": "decode",
+        "paged_impl": loop.paged_impl,  # the impl that actually ran
+        "prefill": loop.prefill,
         "sequences": args.sequences,
         "steps": loop.steps,
+        "prefill_steps": loop.prefill_steps,
+        "decode_steps": loop.decode_steps,
         "tokens": tokens,
         "tokens_per_s": tokens / elapsed,
         "ttft_p50_ms": _percentile(ttfts, 50) * 1e3,
@@ -244,6 +254,14 @@ def main(argv=None) -> int:
                          "from lo,hi")
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--paged-impl", default=None,
+                    choices=("reference", "pallas", "interpret"),
+                    help="decode mode: paged-attention impl (default: "
+                         "FLAGS_serving_paged_impl, i.e. auto-select)")
+    ap.add_argument("--prefill", default="batched",
+                    choices=("batched", "token"),
+                    help="decode mode: whole-prompt vs token-by-token "
+                         "prefill")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
