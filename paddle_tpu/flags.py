@@ -121,6 +121,15 @@ _DEFS: Dict[str, Any] = {
     # log — never a Mosaic compile failure); "interpret" runs the pallas
     # kernel under the interpreter (CPU parity testing)
     "FLAGS_serving_paged_impl": "auto",
+    # serving circuit breaker (serving/engine.py): after
+    # serving_breaker_threshold CONSECUTIVE batch-dispatch failures the
+    # engine opens its breaker — submit() fails fast with
+    # EngineUnhealthyError for serving_breaker_cooldown_s seconds, then
+    # half-opens (requests probe the backend; one successful dispatch
+    # closes it).  Process defaults only; per-engine overrides live on
+    # serving.EngineConfig(breaker_threshold=, breaker_cooldown_s=)
+    "FLAGS_serving_breaker_threshold": 3,
+    "FLAGS_serving_breaker_cooldown_s": 5.0,
     # persistent XLA executable cache directory ("" = disabled): repeated
     # runs of the same program skip compilation entirely — first compiles
     # through the TPU relay cost minutes, so benches/drivers set this.

@@ -20,6 +20,29 @@ Knobs (all env-driven so subprocess chaos tests can arm them):
         float fetch with NaN at step k (0-based, counted per process
         while armed); "k+" injects at every step from k on — drives the
         FLAGS_check_numerics sentinel without poisoning real data.
+
+Serving knobs (tests/test_serving_resilience.py chaos suite):
+    FAULT_SERVE_DISPATCH_RAISE=<n>|thread   serving.Engine dispatcher
+        faults: an integer raises inside the protected batch-dispatch
+        region n times (each raise fails ONLY that batch's futures with
+        EngineInternalError — the dispatcher must survive, and n >=
+        breaker_threshold trips the circuit breaker); "thread" raises
+        OUTSIDE the protected region once, killing the dispatcher
+        thread itself — the supervisor must restart it with the queue
+        preserved.
+    FAULT_SERVE_NAN_SEQ=<seq>@<step>  continuous-batching decode:
+        poison sequence <seq>'s logits row with NaN at loop step <step>
+        (0-based over prefill+decode steps, counted per run via the
+        loop's step counter), once — the per-sequence quarantine must
+        evict exactly that sequence while survivors decode on.
+    FAULT_SERVE_LEAK_PAGES=<n>        KVCachePool: drop n pages from
+        the free list with no owner on the next append, once — models a
+        page leak; check_invariants() must flag them as orphaned and
+        reclaim_orphans() must repair.
+    FAULT_SERVE_SLOW_STEP_MS=<ms>     sleep ms inside every engine
+        batch dispatch while armed (NOT one-shot) — inflates observed
+        batch latency so overload tests can saturate the queue and
+        exercise deadline-aware shedding deterministically.
 """
 
 from __future__ import annotations
@@ -30,16 +53,20 @@ from typing import Optional, Sequence
 __all__ = [
     "reset", "fired", "shard_write_kill", "corrupt_shard",
     "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
+    "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
+    "serve_slow_step",
 ]
 
 fired: set = set()
 _nan_step = [0]
+_dispatch_raised = [0]
 
 
 def reset() -> None:
     """Re-arm every one-shot hook and zero the step counter (tests)."""
     fired.clear()
     _nan_step[0] = 0
+    _dispatch_raised[0] = 0
 
 
 def shard_write_kill(path: str) -> None:
@@ -120,3 +147,72 @@ def nan_fetches(fetch_names: Sequence[str], fetches: tuple) -> tuple:
             out[i] = np.full(arr.shape, np.nan, dtype=arr.dtype)
             break
     return tuple(out)
+
+
+# -- serving faults ----------------------------------------------------------
+
+def serve_dispatch_raise(point: str) -> None:
+    """FAULT_SERVE_DISPATCH_RAISE: raise inside the engine dispatcher.
+
+    `point` is where the caller placed this hook: "batch" sits inside
+    the protected dispatch region (an integer spec raises there n
+    times — each one fails only its batch), "thread" sits outside it
+    (spec "thread" raises there once — the dispatcher thread dies and
+    the supervisor must restart it)."""
+    spec = os.environ.get("FAULT_SERVE_DISPATCH_RAISE")
+    if not spec:
+        return
+    if spec == "thread":
+        if point != "thread" or "serve_thread_kill" in fired:
+            return
+        fired.add("serve_thread_kill")
+        raise RuntimeError("faultinject: dispatcher thread killed")
+    if point != "batch" or _dispatch_raised[0] >= int(spec):
+        return
+    _dispatch_raised[0] += 1
+    raise RuntimeError(
+        f"faultinject: dispatch raise {_dispatch_raised[0]}/{spec}")
+
+
+def serve_nan_rows(seq_ids: Sequence[int], step: int, logits):
+    """FAULT_SERVE_NAN_SEQ=<seq>@<step>: poison one sequence's logits
+    row at one loop step, once.  `logits` is the [B, V] numpy array in
+    `seq_ids` order; returns it (copied+poisoned when the fault fires,
+    untouched otherwise)."""
+    spec = os.environ.get("FAULT_SERVE_NAN_SEQ")
+    if not spec or "serve_nan_seq" in fired:
+        return logits
+    seq_s, _, step_s = spec.partition("@")
+    if step != int(step_s):
+        return logits
+    try:
+        idx = list(seq_ids).index(int(seq_s))
+    except ValueError:
+        return logits  # the target sequence is not in this batch
+    fired.add("serve_nan_seq")
+    import numpy as np
+
+    out = np.array(logits, copy=True)
+    out[idx] = np.nan
+    return out
+
+
+def serve_leak_pages() -> int:
+    """FAULT_SERVE_LEAK_PAGES: number of pages the pool should orphan
+    on the next append (once); 0 when unarmed."""
+    raw = os.environ.get("FAULT_SERVE_LEAK_PAGES")
+    if not raw or "serve_leak" in fired:
+        return 0
+    fired.add("serve_leak")
+    return int(raw)
+
+
+def serve_slow_step() -> None:
+    """FAULT_SERVE_SLOW_STEP_MS: sleep inside every engine dispatch
+    while armed (not one-shot — overload tests need sustained latency)."""
+    raw = os.environ.get("FAULT_SERVE_SLOW_STEP_MS")
+    if not raw:
+        return
+    import time
+
+    time.sleep(float(raw) / 1e3)

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-__all__ = ["NaNSentinel", "NonFiniteStepError"]
+__all__ = ["NaNSentinel", "NonFiniteStepError", "rows_finite"]
 
 
 class NonFiniteStepError(RuntimeError):
@@ -48,6 +48,26 @@ def _all_finite(values: tuple):
             lambda xs: tuple(jnp.all(jnp.isfinite(x)) for x in xs)
         )
     return _probe(values)
+
+
+_rows_probe = None
+
+
+def rows_finite(x):
+    """Per-ROW all-finite scan: [B, ...] -> [B] bool in ONE fused jit
+    call — the serving quarantine's batch-granular counterpart of the
+    step sentinel.  The whole batch syncs to the host as one boolean
+    vector; there is never a per-sequence device round-trip."""
+    global _rows_probe
+    if _rows_probe is None:
+        import jax
+        import jax.numpy as jnp
+
+        _rows_probe = jax.jit(
+            lambda a: jnp.all(jnp.isfinite(a),
+                              axis=tuple(range(1, a.ndim)))
+        )
+    return _rows_probe(x)
 
 
 class NaNSentinel:

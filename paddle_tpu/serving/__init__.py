@@ -32,6 +32,19 @@ loop):
   (``prefill_step``; ``prefill="token"`` keeps the step-per-token arm);
   ``full_decode`` is the full-recompute parity oracle.
 
+Fault isolation (ISSUE 6 — the resilience pillar's serving half): a
+backend raise fails only its batch's futures (typed EngineInternalError)
+while the dispatcher survives; a dispatcher thread that dies anyway is
+restarted by a supervisor with the queue preserved; repeated failures
+trip a circuit breaker (EngineUnhealthyError fast-fail + half-open
+probe); decode sequences whose logits go non-finite are QUARANTINED
+individually (NonFiniteSequenceError; pages freed; batch-mates decode
+on); KVCachePool.check_invariants()/reclaim_orphans() detect and repair
+page leaks; deadline-aware admission sheds requests that cannot dispatch
+in time; engine.health() snapshots
+SERVING/DEGRADED/DRAINING/BROKEN.  FAULT_SERVE_* chaos knobs
+(resilience/faultinject.py) drive tests/test_serving_resilience.py.
+
 Observability (serving/metrics.py): queue-depth/batch-occupancy gauges,
 TTFT and per-token latency histograms, page-pool utilization, and
 admission/reject counters — all behind FLAGS_observability with the
@@ -45,6 +58,8 @@ from .engine import (
     Engine,
     EngineClosedError,
     EngineConfig,
+    EngineInternalError,
+    EngineUnhealthyError,
     ExecutorBackend,
     QueueFullError,
     RequestTimeoutError,
@@ -54,6 +69,7 @@ from .generate import (
     DecodeConfig,
     DecodeRequest,
     GeneratedSequence,
+    NonFiniteSequenceError,
     full_decode,
     full_forward,
     init_decode_params,
@@ -70,9 +86,12 @@ __all__ = [
     "Engine",
     "EngineClosedError",
     "EngineConfig",
+    "EngineInternalError",
+    "EngineUnhealthyError",
     "ExecutorBackend",
     "GeneratedSequence",
     "KVCachePool",
+    "NonFiniteSequenceError",
     "PagePoolExhausted",
     "QueueFullError",
     "RequestTimeoutError",

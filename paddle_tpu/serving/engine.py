@@ -26,6 +26,31 @@ request queue and a single dispatcher thread:
   (`compile_counters()`) — the serving analogue of the executor's
   compile-cache hit/miss counters — and tests assert the ladder bound.
 
+Fault isolation (the serving half of the resilience pillar):
+
+- **Batch-level blast radius**: a backend raise inside one dispatch
+  fails ONLY that batch's futures — each gets a typed
+  EngineInternalError naming the cause — and the dispatcher moves on to
+  the next batch.
+- **Dispatcher supervision**: an exception that escapes the dispatch
+  cycle anyway (a bug outside the protected region) kills the thread;
+  the supervisor hook restarts it with the queue preserved, so queued
+  futures never strand behind a dead thread.
+- **Circuit breaker**: `breaker_threshold` CONSECUTIVE internal errors
+  open the breaker — submit() fails fast with EngineUnhealthyError for
+  `breaker_cooldown_s`, then half-opens (requests probe the backend);
+  one successful dispatch closes it.  Callers shed to a replica instead
+  of queueing onto a backend that fails every batch.
+- **Overload shedding**: a request whose deadline is already unmeetable
+  at submit time — queue depth x the observed per-batch latency p50
+  (an engine-local StepStats ring) says it cannot dispatch before it
+  expires — is rejected immediately with RequestTimeoutError instead of
+  rotting in the queue and timing out after burning its wait.
+- **health()**: one snapshot — SERVING/DEGRADED/DRAINING/BROKEN, queue
+  depth, breaker state, last-dispatch age, dispatcher liveness, shed
+  and restart counts, optional attached KV-pool utilization — exported
+  through observability gauges when the flag is on.
+
 Observability (queue depth, batch occupancy, latency histograms,
 admission/reject/timeout counters) gates on FLAGS_observability with the
 established zero-work disabled path: one dict lookup, no allocation.
@@ -33,6 +58,7 @@ established zero-work disabled path: one dict lookup, no allocation.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import weakref
@@ -42,6 +68,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import flags as _flags
+from ..observability.stepstats import StepStats
+from ..resilience import faultinject as _finject
 from . import metrics as _smetrics
 from .batching import (
     BucketLadder,
@@ -56,15 +84,21 @@ __all__ = [
     "Engine",
     "EngineConfig",
     "EngineClosedError",
+    "EngineInternalError",
+    "EngineUnhealthyError",
     "QueueFullError",
     "RequestTimeoutError",
     "AotBackend",
     "ExecutorBackend",
 ]
 
+_log = logging.getLogger("paddle_tpu.serving")
+
 
 class RequestTimeoutError(TimeoutError):
-    """A request's deadline expired before its batch was dispatched."""
+    """A request's deadline expired before its batch was dispatched —
+    either in the queue, or at submit() when deadline-aware admission
+    predicts the queue cannot dispatch it in time (shed)."""
 
 
 class QueueFullError(RuntimeError):
@@ -75,6 +109,27 @@ class QueueFullError(RuntimeError):
 class EngineClosedError(RuntimeError):
     """submit() after begin_drain()/close(): the engine no longer admits
     new requests (in-flight and queued work still completes)."""
+
+
+class EngineInternalError(RuntimeError):
+    """A micro-batch's dispatch failed inside the engine (backend raise,
+    scatter bug): every future in THAT batch gets this error — naming
+    the underlying cause — and the dispatcher survives to serve the next
+    batch.  The original exception rides on `cause` / `__cause__`."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(
+            f"batch dispatch failed: {type(cause).__name__}: {cause}")
+        self.__cause__ = cause
+
+
+class EngineUnhealthyError(RuntimeError):
+    """The circuit breaker is open: `breaker_threshold` consecutive
+    batches failed, so submit() fails fast for `breaker_cooldown_s`
+    instead of queueing onto a backend that fails everything.  After the
+    cool-down the breaker half-opens and requests probe the backend;
+    one successful dispatch closes it."""
 
 
 class EngineConfig:
@@ -91,13 +146,25 @@ class EngineConfig:
         batch to fill before dispatching anyway.
     queue_depth: bounded-queue capacity in requests (backpressure).
     default_timeout_s: deadline applied when submit() passes none.
+    breaker_threshold: consecutive internal (batch-dispatch) errors that
+        open the circuit breaker (default FLAGS_serving_breaker_threshold).
+    breaker_cooldown_s: how long an open breaker fails submit() fast
+        before half-opening a probe (default
+        FLAGS_serving_breaker_cooldown_s).
+    shed_deadlines: deadline-aware admission — reject a request at
+        submit() when queue depth x observed per-batch latency p50 says
+        it cannot dispatch before its deadline (default True; requests
+        without a deadline are never shed).
     """
 
     def __init__(self, buckets: Optional[Sequence[int]] = None,
                  max_batch: Optional[int] = None,
                  max_wait_s: float = 0.002,
                  queue_depth: int = 256,
-                 default_timeout_s: Optional[float] = None):
+                 default_timeout_s: Optional[float] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
+                 shed_deadlines: bool = True):
         self.buckets = (parse_buckets() if buckets is None
                         else parse_buckets(buckets))
         self.max_batch = (int(max_batch) if max_batch is not None
@@ -105,6 +172,13 @@ class EngineConfig:
         self.max_wait_s = float(max_wait_s)
         self.queue_depth = int(queue_depth)
         self.default_timeout_s = default_timeout_s
+        self.breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else _flags.flag("serving_breaker_threshold"))
+        self.breaker_cooldown_s = float(
+            breaker_cooldown_s if breaker_cooldown_s is not None
+            else _flags.flag("serving_breaker_cooldown_s"))
+        self.shed_deadlines = bool(shed_deadlines)
 
 
 class AotBackend:
@@ -212,6 +286,26 @@ class Engine:
         self._dispatched_rows = 0
         self._occupancy_sum = 0.0
 
+        # fault isolation / supervision state (all under self._lock)
+        self._internal_errors = 0         # total failed dispatches
+        self._consecutive_errors = 0      # streak feeding the breaker
+        self._last_error: Optional[str] = None
+        self._breaker_open_until = 0.0    # 0.0: closed; <=now: half-open
+        self._breaker_trips = 0
+        self._dispatcher_restarts = 0
+        self._last_dispatch_ok: Optional[float] = None
+        self._shed = 0                    # deadline-aware rejections
+        self._close_timed_out = False
+        # observed per-batch dispatch latency — the shedding estimator's
+        # input (engine-local ring: admission control is functional, not
+        # telemetry, so it runs regardless of FLAGS_observability)
+        self._batch_lat = StepStats(capacity=128)
+        # p50 cache keyed by the ring's monotonic count: the submit fast
+        # path must not re-sort the 128-sample window under self._cond
+        # on every deadline-carrying request
+        self._batch_lat_p50: Tuple[int, Optional[float]] = (0, None)
+        self._pool = None                 # optional attach_pool target
+
         # trailing feed shapes (everything past the batch dim) each
         # request must match — seeded from the AOT meta when available,
         # learned from the first request otherwise.  Validating at
@@ -225,9 +319,12 @@ class Engine:
         # cycles (and parks in bounded waits), so an Engine that is
         # dropped without close() is garbage-collected and its thread
         # exits within ~_IDLE_PARK_S instead of leaking both forever.
+        self._spawn_dispatcher()
+
+    def _spawn_dispatcher(self) -> None:
         self._thread = threading.Thread(
             target=_dispatch_entry, args=(weakref.ref(self),),
-            name=f"serving-{name}", daemon=True)
+            name=f"serving-{self.name}", daemon=True)
         self._thread.start()
 
     # -- submission ----------------------------------------------------
@@ -301,18 +398,74 @@ class Engine:
                     _smetrics.record_reject("closed")
                 raise EngineClosedError(
                     f"engine '{self.name}' is draining/closed")
+            if self._breaker_open_until > now:
+                if obs_on:
+                    _smetrics.record_reject("breaker_open")
+                raise EngineUnhealthyError(
+                    f"engine '{self.name}' circuit breaker is open "
+                    f"({self._consecutive_errors} consecutive dispatch "
+                    f"failures, last: {self._last_error}); retry in "
+                    f"{self._breaker_open_until - now:.2f}s")
             if len(self._queue) >= self.config.queue_depth:
                 if obs_on:
                     _smetrics.record_reject("queue_full")
                 raise QueueFullError(
                     f"engine '{self.name}' queue is at "
                     f"{self.config.queue_depth} requests")
+            if req.deadline is not None and self.config.shed_deadlines:
+                est = self._estimate_dispatch_wait_locked()
+                if est is not None and now + est >= req.deadline:
+                    self._shed += 1
+                    if obs_on:
+                        _smetrics.record_reject("deadline_shed")
+                    raise RequestTimeoutError(
+                        f"shed: ~{est:.3f}s of queued work ahead "
+                        f"(observed batch p50 x queue depth) already "
+                        f"violates this request's {timeout:.3f}s "
+                        f"deadline — rejecting at submit instead of "
+                        f"expiring in queue")
+            # a dispatcher that died without its supervisor running
+            # (never under normal faults) must not strand the queue
+            if not self._stopped and not self._thread.is_alive():
+                self._dispatcher_restarts += 1
+                self._spawn_dispatcher()
             self._queue.append(req)
             depth = len(self._queue)
             self._cond.notify_all()
         if obs_on:
             _smetrics.record_submit(depth)
         return fut
+
+    def _estimate_dispatch_wait_locked(self) -> Optional[float]:
+        """Earliest-possible-dispatch estimate for a NEW request, from
+        the work already ahead of it: whole batches the queue holds
+        (plus the in-flight one) x the observed per-batch latency p50.
+        None when there is nothing ahead or no latency observed yet —
+        shedding needs evidence, never a guess."""
+        if not self._queue and not self._inflight:
+            return None
+        p50 = self._batch_lat_p50_cached()
+        if p50 is None:
+            return None
+        if self.ladder.buckets:
+            rows_ahead = sum(r.rows for r in self._queue)
+            batches_ahead = -(-rows_ahead // self.ladder.max_bucket)
+        else:
+            batches_ahead = len(self._queue)
+        if self._inflight:
+            batches_ahead += 1
+        return batches_ahead * p50
+
+    def _batch_lat_p50_cached(self) -> Optional[float]:
+        """Observed batch-latency p50, re-sorted only when the ring has
+        new samples — the steady-state submit path pays one int compare,
+        not an O(K log K) window sort under self._cond."""
+        count = self._batch_lat.count
+        cached_at, p50 = self._batch_lat_p50
+        if count != cached_at:
+            p50 = self._batch_lat.percentile(50)
+            self._batch_lat_p50 = (count, p50)
+        return p50
 
     def _check_trailing(self, feed: Dict[str, Any],
                         feed_names: Sequence[str]) -> None:
@@ -372,11 +525,18 @@ class Engine:
                 self._cond.wait(wait)
         return True
 
+    # how long close() waits for the dispatcher thread to exit; a join
+    # that outlasts this surfaces as stats()["close_timed_out"]
+    _JOIN_TIMEOUT_S = 5.0
+
     def close(self, timeout: Optional[float] = None) -> None:
         """Drain, stop the dispatcher thread, and join it.  If the
         drain timed out, whatever is still queued fails with
         EngineClosedError — a stopped dispatcher must never leave a
-        future unresolved (callers block in .result())."""
+        future unresolved (callers block in .result()).  A dispatcher
+        that outlives the join (a backend call that never returns) is
+        logged and surfaced as stats()['close_timed_out'] instead of
+        close() returning as if the shutdown completed cleanly."""
         self.drain(timeout)
         with self._cond:
             self._stopped = True
@@ -386,7 +546,14 @@ class Engine:
             self._fail(r, EngineClosedError(
                 f"engine '{self.name}' closed before this request was "
                 "dispatched (drain timed out)"))
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=self._JOIN_TIMEOUT_S)
+        if self._thread.is_alive():
+            with self._lock:
+                self._close_timed_out = True
+            _log.warning(
+                "engine '%s': dispatcher thread still alive %.1fs after "
+                "close() — a backend dispatch is stuck; its batch's "
+                "futures remain pending", self.name, self._JOIN_TIMEOUT_S)
 
     def attach_drain(self, drain) -> "Engine":
         """Wire a resilience.PreemptionDrain: its SIGTERM/SIGINT notice
@@ -436,6 +603,11 @@ class Engine:
                 "queue_depth": len(self._queue),
                 "buckets": self.ladder.buckets,
                 "bucket_reason": self.bucket_reason,
+                "internal_errors": self._internal_errors,
+                "breaker_trips": self._breaker_trips,
+                "dispatcher_restarts": self._dispatcher_restarts,
+                "shed": self._shed,
+                "close_timed_out": self._close_timed_out,
                 **self._counters_locked(),
             }
 
@@ -501,6 +673,9 @@ class Engine:
     def _dispatch_cycle(self) -> bool:
         """One dispatcher iteration: take (or wait for) a batch, fail
         whatever expired, run the batch.  Returns False once stopped."""
+        # chaos: a raise HERE is outside every protected region — the
+        # dispatcher thread dies and the supervisor must restart it
+        _finject.serve_dispatch_raise("thread")
         with self._cond:
             if self._stopped:
                 self._cond.notify_all()
@@ -532,8 +707,11 @@ class Engine:
 
     def _dispatch(self, batch: List[Request]) -> None:
         obs_on = _flags._VALUES["FLAGS_observability"]
-        t0 = time.perf_counter() if obs_on else 0.0
+        # t0 always: the batch-latency ring feeds deadline shedding
+        t0 = time.perf_counter()
         try:
+            _finject.serve_slow_step()
+            _finject.serve_dispatch_raise("batch")
             if not self.ladder.buckets:
                 req = batch[0]
                 outs = self.backend(req.feed, **(req.call_kwargs or {}))
@@ -555,11 +733,29 @@ class Engine:
                 outs = self.backend(feed)
                 scatter(batch, outs)
         except Exception as e:  # noqa: BLE001 — backend failure fails the batch
+            # pass-through mode forwards ONE request's own feed/kwargs
+            # verbatim, so a raise there is that request's error: the
+            # future gets the ORIGINAL exception and the breaker is not
+            # advanced — one bad client must not open the breaker on
+            # everyone (the request-level blast radius).  A bucketed
+            # dispatch serves many requests: the failure is the
+            # engine's, wrapped as EngineInternalError and counted
+            # toward the breaker.
+            batched = bool(self.ladder.buckets)
+            err = EngineInternalError(e) if batched else e
+            # count BEFORE resolving futures: a caller that catches the
+            # batch error and immediately checks health()/submits must
+            # see the breaker already advanced
+            self._note_internal_error(e, trip=batched)
+            # failed dispatches are service-time evidence too: without
+            # them a slow-failing outage would leave the shed estimator
+            # trusting a stale fast-success p50
+            self._batch_lat.record(time.perf_counter() - t0)
             for r in batch:
                 if r.future.done():
                     continue  # scatter resolved it before the raise
                 try:
-                    r.future.set_exception(e)
+                    r.future.set_exception(err)
                 except Exception:  # cancelled between check and set
                     pass
             if obs_on:
@@ -570,6 +766,11 @@ class Engine:
             self._dispatched_batches += 1
             self._dispatched_rows += rows
             self._occupancy_sum += rows / float(bucket)
+            # a successful dispatch is the breaker's close/probe signal
+            self._consecutive_errors = 0
+            self._breaker_open_until = 0.0
+            self._last_dispatch_ok = now
+        self._batch_lat.record(now - t0)
         if obs_on:
             _smetrics.record_batch(
                 bucket=bucket, rows=rows, latency_s=now - t0)
@@ -584,15 +785,160 @@ class Engine:
                 self._shapes_seen.add(key)
                 self._shape_misses += 1
 
+    # -- supervision / breaker -----------------------------------------
+
+    def _note_internal_error(self, exc: BaseException,
+                             trip: bool = True) -> None:
+        """Count one failed dispatch; trip the breaker after
+        breaker_threshold consecutive failures.  trip=False (the
+        pass-through request-error path) counts the total but leaves the
+        breaker streak alone — a per-request failure is not an engine
+        health signal."""
+        now = time.perf_counter()
+        with self._lock:
+            self._internal_errors += 1
+            self._last_error = f"{type(exc).__name__}: {exc}"
+            if not trip:
+                return
+            self._consecutive_errors += 1
+            if (self._consecutive_errors >= self.config.breaker_threshold
+                    and self._breaker_open_until <= now):
+                # closed/half-open -> open (a re-failed probe re-trips)
+                self._breaker_open_until = (
+                    now + self.config.breaker_cooldown_s)
+                self._breaker_trips += 1
+                tripped = True
+            else:
+                tripped = False
+        if tripped:
+            _log.warning(
+                "engine '%s': circuit breaker OPEN after %d consecutive "
+                "dispatch failures (last: %s); fast-failing submits for "
+                "%.2fs", self.name, self.config.breaker_threshold,
+                self._last_error, self.config.breaker_cooldown_s)
+            if _flags._VALUES["FLAGS_observability"]:
+                _smetrics.record_breaker_trip()
+
+    def _on_dispatcher_death(self, exc: BaseException) -> None:
+        """Supervisor: the dispatcher thread died outside every
+        protected region.  Restart it with the queue preserved (the
+        queue lives on the engine, not the thread) unless the engine is
+        already stopped."""
+        self._note_internal_error(exc)
+        with self._cond:
+            if self._stopped:
+                self._cond.notify_all()
+                return
+            self._dispatcher_restarts += 1
+        _log.warning(
+            "engine '%s': dispatcher thread died (%s: %s); restarting "
+            "with %d queued requests preserved", self.name,
+            type(exc).__name__, exc, self.queue_depth())
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_dispatcher_restart()
+        self._spawn_dispatcher()
+
+    # -- health ---------------------------------------------------------
+
+    def attach_pool(self, pool) -> "Engine":
+        """Report a KVCachePool's utilization in health() — for engines
+        fronting a decode loop."""
+        self._pool = pool
+        return self
+
+    def health(self) -> Dict[str, Any]:
+        """One operator-facing snapshot of engine liveness:
+
+        - state: SERVING (healthy), DEGRADED (failing dispatches or a
+          near-full queue, still admitting), DRAINING (no admissions,
+          finishing queued work), BROKEN (breaker open, or the
+          dispatcher is dead)
+        - queue/breaker/dispatcher/shed/last-dispatch detail backing it
+
+        Exported through observability gauges when FLAGS_observability
+        is on."""
+        now = time.perf_counter()
+        with self._lock:
+            depth = len(self._queue)
+            cap = self.config.queue_depth
+            breaker_open = self._breaker_open_until > now
+            half_open = (self._breaker_open_until != 0.0
+                         and not breaker_open)
+            alive = self._thread.is_alive()
+            last_ok = self._last_dispatch_ok
+            snap = {
+                "queue_depth": depth,
+                "queue_capacity": cap,
+                "inflight": self._inflight,
+                "breaker": {
+                    "state": ("open" if breaker_open
+                              else "half_open" if half_open else "closed"),
+                    "consecutive_errors": self._consecutive_errors,
+                    "threshold": self.config.breaker_threshold,
+                    "trips": self._breaker_trips,
+                    "cooldown_remaining_s": max(
+                        0.0, self._breaker_open_until - now),
+                    "last_error": self._last_error,
+                },
+                "internal_errors": self._internal_errors,
+                "last_dispatch_age_s": (
+                    now - last_ok if last_ok is not None else None),
+                "dispatcher_alive": alive,
+                "dispatcher_restarts": self._dispatcher_restarts,
+                "shed": self._shed,
+                "close_timed_out": self._close_timed_out,
+                "batch_latency_p50_s": self._batch_lat_p50_cached(),
+            }
+            draining = self._closed
+            degraded = (self._consecutive_errors > 0
+                        or depth >= 0.8 * cap)
+            stopped = self._stopped
+        if breaker_open or (not alive and not stopped):
+            state = "BROKEN"
+        elif draining:
+            state = "DRAINING"
+        elif degraded:
+            state = "DEGRADED"
+        else:
+            state = "SERVING"
+        snap["state"] = state
+        if self._pool is not None:
+            st = self._pool.stats()
+            snap["pool"] = {
+                "used_pages": st["used_pages"],
+                "num_pages": st["num_pages"],
+                "utilization": st["used_pages"] / float(st["num_pages"]),
+            }
+        else:
+            snap["pool"] = None
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_health(
+                state, depth,
+                breaker_open=breaker_open,
+                pool_utilization=(snap["pool"] or {}).get("utilization"),
+                pool=getattr(self._pool, "name", "kv"))
+        return snap
+
 
 def _dispatch_entry(ref: "weakref.ref") -> None:
     """Dispatcher thread body.  Holds the engine STRONGLY only while
     running one cycle; between cycles only the weakref survives, so an
     engine dropped without close() becomes collectable and this thread
     exits on the next _IDLE_PARK_S heartbeat instead of pinning the
-    engine (and its backend/executor/scope) forever."""
+    engine (and its backend/executor/scope) forever.
+
+    A raise escaping the cycle (batch failures never do — _dispatch
+    contains them) hands off to the engine's supervisor hook, which
+    restarts the dispatcher with the queue preserved."""
     while True:
         eng = ref()
-        if eng is None or not eng._dispatch_cycle():
+        if eng is None:
+            return
+        try:
+            alive = eng._dispatch_cycle()
+        except BaseException as e:  # noqa: BLE001 — supervisor restarts
+            eng._on_dispatcher_death(e)
+            return
+        if not alive:
             return
         del eng

@@ -43,6 +43,7 @@ token identity.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -56,19 +57,40 @@ from ..kernels.paged_attention import (
     resolve_paged_impl,
 )
 from ..models.transformer import _sinusoid_table
+from ..resilience import faultinject as _finject
+from ..resilience.sentinel import rows_finite
 from . import metrics as _smetrics
 from .kvcache import KVCachePool
+
+_log = logging.getLogger("paddle_tpu.serving")
 
 __all__ = [
     "DecodeConfig",
     "DecodeRequest",
     "GeneratedSequence",
     "ContinuousBatchingLoop",
+    "NonFiniteSequenceError",
     "init_decode_params",
     "full_forward",
     "full_decode",
     "prefill_step",
 ]
+
+
+class NonFiniteSequenceError(RuntimeError):
+    """One sequence's decode logits went non-finite: that sequence was
+    QUARANTINED — evicted from the continuous batch, its pages returned
+    to the pool — while its batch-mates decode on.  The batch-granular
+    counterpart of resilience.NonFiniteStepError: a poisoned sequence
+    costs one request, never the batch (and never the engine)."""
+
+    def __init__(self, seq_id: int, step: int):
+        self.seq_id = seq_id
+        self.step = step
+        super().__init__(
+            f"sequence {seq_id} produced non-finite logits at loop step "
+            f"{step}; it was evicted from the batch (pages freed) and "
+            "its batch-mates decoded on")
 
 
 @dataclasses.dataclass
@@ -264,7 +286,10 @@ class DecodeRequest:
 @dataclasses.dataclass
 class GeneratedSequence:
     """One finished sequence: generated tokens + the logits row behind
-    each (the parity surface vs full_decode), and latency accounting."""
+    each (the parity surface vs full_decode), and latency accounting.
+    `error` is set (NonFiniteSequenceError) when the sequence was
+    quarantined instead of retiring cleanly — its tokens/logits stop at
+    the last finite step."""
 
     seq_id: int
     prompt: List[int]
@@ -273,6 +298,7 @@ class GeneratedSequence:
     admitted_at: float = 0.0
     ttft_s: Optional[float] = None
     finished_at: float = 0.0
+    error: Optional[Exception] = None
 
 
 class _Active:
@@ -303,12 +329,23 @@ class ContinuousBatchingLoop:
     oracle and A/B baseline).  ``paged_impl`` selects the decode
     attention path (None: FLAGS_serving_paged_impl; resolved against
     the pool geometry once, so metrics are labeled with the impl that
-    actually runs)."""
+    actually runs).
+
+    Fault isolation: every step's logits pass a per-ROW jitted
+    finite-check (resilience.sentinel.rows_finite — ONE fused jit call
+    per step, no per-sequence host sync); a non-finite row QUARANTINES
+    only that sequence (its result carries NonFiniteSequenceError, its
+    pages return to the pool) while batch-mates decode on.  Any
+    exception escaping a prefill/decode step frees every stepping
+    sequence's pages before propagating — a raise can cost the run,
+    never pool pages.  ``check_every=N`` additionally audits the pool
+    (KVCachePool.check_invariants) every N steps and repairs detected
+    leaks via reclaim_orphans."""
 
     def __init__(self, params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                  max_batch: int = 4, force: str = "auto",
                  paged_impl: Optional[str] = None,
-                 prefill: str = "batched"):
+                 prefill: str = "batched", check_every: int = 0):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
@@ -318,12 +355,16 @@ class ContinuousBatchingLoop:
         self.max_batch = int(max_batch)
         self.force = force
         self.prefill = prefill
+        self.check_every = int(check_every)
         self.paged_impl = resolve_paged_impl(
             paged_impl, pool.page_size, cfg.head_dim, pool.k_pages.dtype)
         self._next_seq_id = 0
         self.steps = 0
         self.prefill_steps = 0
         self.decode_steps = 0
+        self.quarantined = 0
+        self.reclaimed_pages = 0
+        self.invariant_violations = 0
         self._occupancy_sum = 0.0
 
     def _footprint(self, req: DecodeRequest) -> int:
@@ -357,6 +398,37 @@ class ContinuousBatchingLoop:
         active: List[_Active] = []
         reserved_pages = 0
 
+        def quarantine(batch: List[_Active], logits,
+                       step_idx: int) -> Tuple[np.ndarray, set, float]:
+            """Evict every non-finite row of this step's logits; returns
+            (logits materialized on host — a poisoned copy when the
+            chaos knob fired — the surviving row indices, and the
+            post-sync step-end timestamp).  `logits` arrives as the
+            step's DEVICE output: the ONE fused jitted [B]-bool scan
+            runs on it before the single host materialization, so the
+            scan never re-uploads a host array and the whole batch
+            syncs as one vector, never per row."""
+            nonlocal reserved_pages
+            logits = _finject.serve_nan_rows(
+                [a.seq_id for a in batch], step_idx, logits)
+            finite = np.asarray(rows_finite(logits))
+            logits = np.asarray(logits)
+            now = time.perf_counter()  # after the sync: true step end
+            if finite.all():
+                return logits, set(range(len(batch))), now
+            for i, a in enumerate(batch):
+                if finite[i]:
+                    continue
+                active.remove(a)
+                a.result.error = NonFiniteSequenceError(a.seq_id, step_idx)
+                a.result.finished_at = now
+                self.pool.free_seq(a.seq_id)
+                reserved_pages -= self._footprint(a.req)
+                self.quarantined += 1
+                if obs_on:
+                    _smetrics.record_sequence("quarantined")
+            return logits, {i for i in range(len(batch)) if finite[i]}, now
+
         def emit(a: _Active, row: np.ndarray, t0: float, now: float) -> bool:
             """Record one generated token; True when the sequence is done."""
             nxt = int(row.argmax())
@@ -382,85 +454,126 @@ class ContinuousBatchingLoop:
                 if obs_on:
                     _smetrics.record_sequence("retired")
 
-        while waiting or active:
-            # admit (FIFO) while a slot and a full worst-case reservation fit
-            newly: List[_Active] = []
-            while waiting and len(active) < self.max_batch:
-                req, seq = waiting[0]
-                need = self._footprint(req)
-                if reserved_pages + need > self.pool.num_pages:
-                    break  # wait for retirements
-                waiting.pop(0)
-                seq.seq_id = self._next_seq_id
-                self._next_seq_id += 1
-                self.pool.allocate(seq.seq_id)
-                seq.admitted_at = time.perf_counter()
-                a = _Active(req, seq.seq_id, seq)
-                active.append(a)
-                newly.append(a)
-                reserved_pages += need
-                if obs_on:
-                    _smetrics.record_sequence("admitted")
-            # NOTE: waiting-but-nothing-active cannot happen — the
-            # up-front validation guarantees the head request fits an
-            # empty pool, so admission always progresses
+        try:
+            while waiting or active:
+                # admit (FIFO) while a slot and a full worst-case
+                # reservation fit
+                newly: List[_Active] = []
+                while waiting and len(active) < self.max_batch:
+                    req, seq = waiting[0]
+                    need = self._footprint(req)
+                    if reserved_pages + need > self.pool.num_pages:
+                        break  # wait for retirements
+                    waiting.pop(0)
+                    seq.seq_id = self._next_seq_id
+                    self._next_seq_id += 1
+                    self.pool.allocate(seq.seq_id)
+                    seq.admitted_at = time.perf_counter()
+                    a = _Active(req, seq.seq_id, seq)
+                    active.append(a)
+                    newly.append(a)
+                    reserved_pages += need
+                    if obs_on:
+                        _smetrics.record_sequence("admitted")
+                # NOTE: waiting-but-nothing-active cannot happen — the
+                # up-front validation guarantees the head request fits an
+                # empty pool, so admission always progresses
 
-            if self.prefill == "batched" and newly:
-                # ONE whole-prompt pass for the co-admitted group: every
-                # prompt token's K/V lands in the pool and each sequence
-                # gets its first generated token — O(1) model steps per
-                # admission group vs O(max prompt len) token-by-token
+                if self.prefill == "batched" and newly:
+                    # ONE whole-prompt pass for the co-admitted group:
+                    # every prompt token's K/V lands in the pool and each
+                    # sequence gets its first generated token — O(1)
+                    # model steps per admission group vs O(max prompt
+                    # len) token-by-token
+                    t0 = time.perf_counter()
+                    step_idx = self.steps
+                    logits = prefill_step(
+                        self.params, self.cfg, self.pool,
+                        [a.seq_id for a in newly],
+                        [a.result.prompt for a in newly], force=self.force)
+                    self.steps += 1
+                    self.prefill_steps += 1
+                    self._occupancy_sum += len(newly) / float(self.max_batch)
+                    logits, ok, now = quarantine(newly, logits, step_idx)
+                    done_now: List[_Active] = []
+                    for i, a in enumerate(newly):
+                        a.pos = len(a.result.prompt)
+                        if i not in ok:
+                            continue  # quarantined at prefill
+                        if emit(a, np.asarray(logits[i]), t0, now):
+                            done_now.append(a)
+                    retire(done_now, now)
+                    if obs_on:
+                        self._note_attention_bytes()
+                    self._watchdog()
+                    continue  # re-admit into freed slots before decoding
+
+                if not active:
+                    continue
+                # one token per active sequence; under prefill="token" a
+                # still-prefilling sequence and a deep-decode sequence
+                # share the batch and differ only in k_lengths
                 t0 = time.perf_counter()
-                logits = prefill_step(
-                    self.params, self.cfg, self.pool,
-                    [a.seq_id for a in newly],
-                    [a.result.prompt for a in newly], force=self.force)
+                step_idx = self.steps
+                batch = list(active)
+                seq_ids = [a.seq_id for a in batch]
+                tokens = [
+                    (a.result.prompt[a.pos] if a.pos < len(a.result.prompt)
+                     else a.result.tokens[-1])
+                    for a in batch
+                ]
+                positions = [a.pos for a in batch]
+                logits = decode_step(
+                    self.params, self.cfg, self.pool, seq_ids, tokens,
+                    positions, force=self.force, impl=self.paged_impl)
                 self.steps += 1
-                self.prefill_steps += 1
-                self._occupancy_sum += len(newly) / float(self.max_batch)
-                now = time.perf_counter()
-                done_now: List[_Active] = []
-                for i, a in enumerate(newly):
-                    a.pos = len(a.result.prompt)
+                self.decode_steps += 1
+                self._occupancy_sum += len(batch) / float(self.max_batch)
+                logits, ok, now = quarantine(batch, logits, step_idx)
+
+                retired: List[_Active] = []
+                for i, a in enumerate(batch):
+                    a.pos += 1
+                    if i not in ok:
+                        continue  # quarantined this step
+                    if a.pos < len(a.result.prompt):
+                        continue  # still prefilling; logits unused
                     if emit(a, np.asarray(logits[i]), t0, now):
-                        done_now.append(a)
-                retire(done_now, now)
+                        retired.append(a)
+                retire(retired, now)
                 if obs_on:
                     self._note_attention_bytes()
-                continue  # re-admit into freed slots before decoding
-
-            if not active:
-                continue
-            # one token per active sequence; under prefill="token" a
-            # still-prefilling sequence and a deep-decode sequence share
-            # the batch and differ only in k_lengths
-            t0 = time.perf_counter()
-            seq_ids = [a.seq_id for a in active]
-            tokens = [
-                (a.result.prompt[a.pos] if a.pos < len(a.result.prompt)
-                 else a.result.tokens[-1])
-                for a in active
-            ]
-            positions = [a.pos for a in active]
-            logits = decode_step(
-                self.params, self.cfg, self.pool, seq_ids, tokens,
-                positions, force=self.force, impl=self.paged_impl)
-            self.steps += 1
-            self.decode_steps += 1
-            self._occupancy_sum += len(active) / float(self.max_batch)
-            now = time.perf_counter()
-
-            retired: List[_Active] = []
-            for i, a in enumerate(active):
-                a.pos += 1
-                if a.pos < len(a.result.prompt):
-                    continue  # still prefilling; logits unused
-                if emit(a, np.asarray(logits[i]), t0, now):
-                    retired.append(a)
-            retire(retired, now)
-            if obs_on:
-                self._note_attention_bytes()
+                self._watchdog()
+        except BaseException:
+            # ANY raise out of a prefill/decode step (or admission): the
+            # stepping sequences' pages go back to the pool BEFORE the
+            # error propagates — a failed run must never strand pages
+            # (the acknowledged hazard this loop previously carried)
+            for a in active:
+                self.pool.free_seq(a.seq_id)
+            active.clear()
+            raise
         return results
+
+    def _watchdog(self) -> None:
+        """Every check_every steps: audit pool integrity and repair
+        detected leaks (orphaned pages return to the free list)."""
+        if not self.check_every or self.steps % self.check_every:
+            return
+        report = self.pool.check_invariants()
+        if report["ok"]:
+            return
+        self.invariant_violations += 1
+        reclaimed = self.pool.reclaim_orphans()
+        self.reclaimed_pages += reclaimed
+        _log.warning(
+            "KV pool '%s' failed its invariant audit at step %d "
+            "(orphaned=%s double_owned=%s free_errors=%s); reclaimed %d "
+            "orphaned pages", self.pool.name, self.steps,
+            report["orphaned_pages"], report["double_owned_pages"],
+            report["free_list_errors"], reclaimed)
+        if _flags._VALUES["FLAGS_observability"] and reclaimed:
+            _smetrics.record_pool_reclaim(reclaimed, pool=self.pool.name)
 
     def _note_attention_bytes(self) -> None:
         """Attention-bytes-per-step gauge for the CURRENT pool contents,

@@ -36,6 +36,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from .. import flags as _flags
+from ..resilience import faultinject as _finject
 from . import metrics as _smetrics
 
 __all__ = ["KVCachePool", "PagePoolExhausted", "SequenceHandle"]
@@ -92,6 +93,7 @@ class KVCachePool:
         self._stats = {
             "page_allocs": 0, "page_frees": 0, "token_appends": 0,
             "defrag_moves": 0, "used_pages_high_water": 0,
+            "orphans_reclaimed": 0,
         }
 
     # -- sizing math (documented in README "Serving") -------------------
@@ -175,6 +177,9 @@ class KVCachePool:
                     h.length += 1
                     i += 1
             self._stats["token_appends"] += sum(counts)
+            leak = _finject.serve_leak_pages()
+            if leak:  # chaos: orphan pages (owned by nobody, not free)
+                del self._free[-min(leak, len(self._free)):]
             used = self.num_pages - len(self._free)
             if used > self._stats["used_pages_high_water"]:
                 self._stats["used_pages_high_water"] = used
@@ -251,6 +256,77 @@ class KVCachePool:
         if _flags._VALUES["FLAGS_observability"]:
             _smetrics.record_page_pool(
                 self.used_pages, self.num_pages, pool=self.name)
+
+    # -- integrity watchdog ---------------------------------------------
+
+    def check_invariants(self) -> Dict:
+        """Audit page ownership: every page id must appear EXACTLY once
+        across the union of live page tables and the free list.  Returns
+        a report dict — `ok` plus the violating page/sequence ids:
+
+        - orphaned_pages: owned by no table and not free (a leak — the
+          pool shrinks until exhaustion; reclaim_orphans repairs)
+        - double_owned_pages: in two tables, twice in one table, or in
+          a table AND the free list (corruption — two sequences would
+          overwrite each other's K/V)
+        - free_list_errors: duplicate or out-of-range free entries
+        - length_mismatches: sequences whose token count disagrees with
+          their page count (length > capacity, or an entire spare page)
+
+        Cost is O(pages + live tokens/page_size) under the pool lock —
+        cheap enough for the continuous-batching loop to run every N
+        steps (ContinuousBatchingLoop(check_every=N))."""
+        with self._lock:
+            owned: Dict[int, int] = {}
+            double: List[int] = []
+            mismatches: List[int] = []
+            for h in self._tables.values():
+                for p in h.pages:
+                    if p in owned:
+                        double.append(p)
+                    owned[p] = h.seq_id
+                cap = h.capacity(self.page_size)
+                if h.length > cap or cap - h.length >= self.page_size:
+                    mismatches.append(h.seq_id)
+            free_errors: List[int] = []
+            seen_free: set = set()
+            for p in self._free:
+                if p in seen_free or not 0 <= p < self.num_pages:
+                    free_errors.append(p)
+                seen_free.add(p)
+                if p in owned:
+                    double.append(p)
+            orphaned = [p for p in range(self.num_pages)
+                        if p not in owned and p not in seen_free]
+            report = {
+                "ok": not (orphaned or double or free_errors or mismatches),
+                "orphaned_pages": orphaned,
+                "double_owned_pages": sorted(set(double)),
+                "free_list_errors": free_errors,
+                "length_mismatches": mismatches,
+                "used_pages": self.num_pages - len(self._free),
+                "live_sequences": len(self._tables),
+            }
+        if _flags._VALUES["FLAGS_observability"] and not report["ok"]:
+            _smetrics.record_pool_invariant_violation(pool=self.name)
+        return report
+
+    def reclaim_orphans(self) -> int:
+        """Return every orphaned page (owned by no table, absent from
+        the free list) to the free pool; returns how many were
+        reclaimed.  The repair arm of check_invariants — a detected leak
+        costs pages until this runs, never the pool's integrity (page
+        tables are untouched)."""
+        with self._lock:
+            owned = {p for h in self._tables.values() for p in h.pages}
+            free = set(self._free)
+            orphans = [p for p in range(self.num_pages)
+                       if p not in owned and p not in free]
+            self._free.extend(reversed(orphans))
+            self._stats["orphans_reclaimed"] += len(orphans)
+        if orphans:
+            self._note_pool()
+        return len(orphans)
 
     # -- defrag ---------------------------------------------------------
 

@@ -28,12 +28,25 @@ Metrics:
 - paddle_tpu_serving_page_pool_used_pages   gauge    {pool=} pages in use
 - paddle_tpu_serving_page_pool_utilization  gauge    {pool=} used/total
 - paddle_tpu_serving_sequences_total        counter  {event=admitted|
-                                                      retired}
+                                                      retired|quarantined}
+
+Fault-isolation instruments (ISSUE 6):
+- paddle_tpu_serving_breaker_trips_total    counter  circuit-breaker opens
+- paddle_tpu_serving_dispatcher_restarts_total counter supervisor restarts
+- paddle_tpu_serving_health_state           gauge    0 SERVING / 1 DEGRADED
+                                                     / 2 DRAINING / 3 BROKEN
+- paddle_tpu_serving_pool_invariant_violations_total counter {pool=}
+                                                     check_invariants fails
+- paddle_tpu_serving_pool_orphans_reclaimed_total counter {pool=} pages
+                                                     repaired by
+                                                     reclaim_orphans
+(rejected_breaker_open / rejected_deadline_shed ride the existing
+requests{outcome=} counter.)
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 from ..observability import default_registry
 
@@ -48,6 +61,11 @@ __all__ = [
     "record_token",
     "record_page_pool",
     "record_sequence",
+    "record_breaker_trip",
+    "record_dispatcher_restart",
+    "record_health",
+    "record_pool_invariant_violation",
+    "record_pool_reclaim",
 ]
 
 # occupancy lives in (0, 1]; the default step-time buckets would collapse
@@ -154,3 +172,63 @@ def record_sequence(event: str) -> None:
         "paddle_tpu_serving_sequences",
         "continuous-batching sequence lifecycle events",
     ).inc(event=event)
+
+
+def record_breaker_trip() -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_breaker_trips",
+        "engine circuit-breaker opens (consecutive-failure limit hit)",
+    ).inc()
+
+
+def record_dispatcher_restart() -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_dispatcher_restarts",
+        "dispatcher threads restarted by the engine supervisor",
+    ).inc()
+
+
+_HEALTH_CODES = {"SERVING": 0, "DEGRADED": 1, "DRAINING": 2, "BROKEN": 3}
+
+
+def record_health(state: str, queue_depth: int,
+                  breaker_open: bool = False,
+                  pool_utilization: Optional[float] = None,
+                  pool: str = "kv") -> None:
+    """engine.health() snapshot gauges: numeric state (0 SERVING /
+    1 DEGRADED / 2 DRAINING / 3 BROKEN) plus the queue/breaker/pool
+    levels an alerting rule would page on.  `pool` labels the
+    utilization gauge so it lands on the SAME series the pool's own
+    _note_pool() publishes."""
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_health_state",
+        "engine health: 0 SERVING, 1 DEGRADED, 2 DRAINING, 3 BROKEN",
+    ).set(_HEALTH_CODES.get(state, 3))
+    reg.gauge(
+        "paddle_tpu_serving_queue_depth",
+        "requests waiting in the engine's bounded queue",
+    ).set(queue_depth)
+    reg.gauge(
+        "paddle_tpu_serving_breaker_open",
+        "1 while the engine circuit breaker is open",
+    ).set(1 if breaker_open else 0)
+    if pool_utilization is not None:
+        reg.gauge(
+            "paddle_tpu_serving_page_pool_utilization",
+            "KV-cache page-pool utilization (used/total)",
+        ).set(pool_utilization, pool=pool)
+
+
+def record_pool_invariant_violation(pool: str = "kv") -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_pool_invariant_violations",
+        "KVCachePool.check_invariants audits that found a violation",
+    ).inc(pool=pool)
+
+
+def record_pool_reclaim(pages: int, pool: str = "kv") -> None:
+    default_registry().counter(
+        "paddle_tpu_serving_pool_orphans_reclaimed",
+        "orphaned KV pages returned to the free list by reclaim_orphans",
+    ).inc(pages, pool=pool)

@@ -26,11 +26,25 @@ Gating mirrors tools/obsdump.py: --baseline BANKED.json re-checks this
 run against a banked artifact ({metric: value}; lower_is_better inferred
 from the metric name), --gate exits 3 on any fail — CI wiring.
 
+  --chaos arms the FAULT_SERVE_* knobs (resilience/faultinject.py)
+  MID-RUN and reports how the serving tier recovered: engine mode arms a
+  one-shot dispatcher raise (plus a slow-step to make latency
+  observable) a third of the way through the replay and gives a slice of
+  the remaining requests unmeetable deadlines — the result gains
+  recovered/poisoned/timeout/shed counts plus breaker/restart totals;
+  decode mode arms a NaN-poisoned sequence and a page leak under a
+  check_every=1 integrity watchdog — the result gains quarantined /
+  reclaimed_pages / invariants_ok, and pages_leaked must still end 0.
+  Bank {"pages_leaked": 0, "invariants_ok": 1} and --gate asserts chaos
+  runs finish with zero leaked pages.
+
 Usage:
     python tools/serve_bench.py --model mnist --requests 50 --rate 200
     python tools/serve_bench.py --mode decode --sequences 8 --max-new 16
     python tools/serve_bench.py ... --json out.json
     python tools/serve_bench.py ... --baseline BANK.json --tol 0.15 --gate
+    python tools/serve_bench.py --mode decode --chaos --gate \
+        --baseline CHAOS_BANK.json
 """
 
 from __future__ import annotations
@@ -95,62 +109,121 @@ def _build_artifact(model: str, out_dir: str):
 
 def run_engine_bench(args) -> dict:
     from paddle_tpu import serving
+    from paddle_tpu.resilience import faultinject
 
-    with tempfile.TemporaryDirectory() as d:
-        predict, feed = _build_artifact(args.model, d)
-        buckets = serving.parse_buckets(args.buckets)
-        cfg = serving.EngineConfig(
-            buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
-            queue_depth=args.queue_depth)
-        engine = serving.Engine.from_artifact(predict, config=cfg,
-                                              name="serve_bench")
-        rng = np.random.RandomState(args.seed)
-        lo, hi = (int(p) for p in args.batch_range.split(","))
-        # pre-generate the workload so generation cost stays off the clock
-        reqs = [feed(int(rng.randint(lo, hi + 1)))
-                for _ in range(args.requests)]
-        # warmup compiles every bucket once — steady-state numbers, not
-        # first-compile spikes (compile time is banked separately)
-        if args.warmup:
-            # the ENGINE's ladder, not the requested one: a static-batch
-            # artifact collapses it, and feed(b) past max_batch would
-            # be rejected at submit
-            for b in engine.ladder.buckets:
-                engine.infer(feed(b))  # b rows land exactly in bucket b
+    chaos = bool(args.chaos)
+    arm_at = max(1, args.requests // 3) if chaos else None
+    recovered = poisoned = timeouts = 0
+    # the arm step setdefault()s FAULT_SERVE_SLOW_STEP_MS so an
+    # operator-exported value wins — cleanup must restore it, not pop it
+    prior_slow = os.environ.get("FAULT_SERVE_SLOW_STEP_MS")
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            predict, feed = _build_artifact(args.model, d)
+            buckets = serving.parse_buckets(args.buckets)
+            cfg = serving.EngineConfig(
+                buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
+                queue_depth=args.queue_depth)
+            engine = serving.Engine.from_artifact(predict, config=cfg,
+                                                  name="serve_bench")
+            rng = np.random.RandomState(args.seed)
+            lo, hi = (int(p) for p in args.batch_range.split(","))
+            # pre-generate the workload so generation cost stays off the
+            # clock
+            reqs = [feed(int(rng.randint(lo, hi + 1)))
+                    for _ in range(args.requests)]
+            # warmup compiles every bucket once — steady-state numbers,
+            # not first-compile spikes (compile time is banked separately)
+            if args.warmup:
+                # the ENGINE's ladder, not the requested one: a
+                # static-batch artifact collapses it, and feed(b) past
+                # max_batch would be rejected at submit
+                for b in engine.ladder.buckets:
+                    engine.infer(feed(b))  # b rows land exactly in bucket b
 
-        gaps = rng.exponential(1.0 / args.rate, size=args.requests)
-        t_start = time.perf_counter()
-        pending = []
-        for i, f in enumerate(reqs):
-            # closed-loop pacing: sleep to the Poisson schedule, but
-            # never ahead of it
-            target = t_start + float(gaps[: i + 1].sum())
-            now = time.perf_counter()
-            if target > now:
-                time.sleep(target - now)
-            pending.append((time.perf_counter(), engine.submit(f)))
-        lat = []
-        rows = 0
-        for i, (t0, fut) in enumerate(pending):
-            fut.result(timeout=60)
-            lat.append(time.perf_counter() - t0)
-            rows += reqs[i][predict.feed_names[0]].shape[0]
-        elapsed = time.perf_counter() - t_start
-        stats = engine.stats()
-        engine.close()
-    return {
+            gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+            t_start = time.perf_counter()
+            pending = []
+            for i, f in enumerate(reqs):
+                if chaos and i == arm_at:
+                    # mid-run chaos: one poisoned batch + sustained
+                    # dispatch latency (makes shedding observable)
+                    os.environ["FAULT_SERVE_DISPATCH_RAISE"] = "1"
+                    os.environ.setdefault("FAULT_SERVE_SLOW_STEP_MS", "2")
+                # closed-loop pacing: sleep to the Poisson schedule, but
+                # never ahead of it
+                target = t_start + float(gaps[: i + 1].sum())
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                timeout = None
+                if chaos and i > arm_at and i % 4 == 3:
+                    timeout = 1e-4  # unmeetable: exercises shed/timeout
+                try:
+                    pending.append(
+                        (time.perf_counter(), engine.submit(f, timeout=timeout), i))
+                except serving.RequestTimeoutError:
+                    # deadline-shed at submit: the engine counts these
+                    # itself — reported below as "shed_requests"
+                    pass
+            lat = []
+            rows = 0
+            for t0, fut, i in pending:
+                try:
+                    fut.result(timeout=60)
+                except serving.RequestTimeoutError:
+                    if not chaos:  # only chaos runs expect casualties —
+                        raise      # a clean run must fail loudly
+                    timeouts += 1
+                    continue
+                except Exception:
+                    # bucketed dispatches fail as EngineInternalError;
+                    # a pass-through (empty-ladder) dispatch delivers
+                    # the request's ORIGINAL exception — chaos counts
+                    # either as poisoned
+                    if not chaos:
+                        raise
+                    poisoned += 1
+                    continue
+                recovered += 1
+                lat.append(time.perf_counter() - t0)
+                rows += reqs[i][predict.feed_names[0]].shape[0]
+            elapsed = time.perf_counter() - t_start
+            stats = engine.stats()
+            engine.close()
+    finally:
+        if chaos:
+            os.environ.pop("FAULT_SERVE_DISPATCH_RAISE", None)
+            if prior_slow is None:
+                os.environ.pop("FAULT_SERVE_SLOW_STEP_MS", None)
+            else:
+                os.environ["FAULT_SERVE_SLOW_STEP_MS"] = prior_slow
+            faultinject.reset()
+    p50, p99 = _percentile(lat, 50), _percentile(lat, 99)
+    result = {
         "mode": "engine",
         "model": args.model,
         "requests": args.requests,
         "buckets": list(stats["buckets"]),
-        "p50_ms": _percentile(lat, 50) * 1e3,
-        "p99_ms": _percentile(lat, 99) * 1e3,
+        "p50_ms": p50 * 1e3 if p50 is not None else None,
+        "p99_ms": p99 * 1e3 if p99 is not None else None,
         "throughput_rps": args.requests / elapsed,
         "throughput_rows_s": rows / elapsed,
         "mean_occupancy": stats["mean_occupancy"],
         "batches": stats["batches"],
         "distinct_shapes": stats["distinct_shapes"],
     }
+    if chaos:
+        result.update({
+            "recovered_requests": recovered,
+            "poisoned_requests": poisoned,
+            "timeout_requests": timeouts,
+            "shed_requests": stats["shed"],
+            "internal_errors": stats["internal_errors"],
+            "breaker_trips": stats["breaker_trips"],
+            "dispatcher_restarts": stats["dispatcher_restarts"],
+        })
+    return result
 
 
 def run_decode_bench(args) -> dict:
@@ -174,16 +247,34 @@ def run_decode_bench(args) -> dict:
         reqs.append(serving.DecodeRequest(
             prompt=rng.randint(1, cfg.vocab_size, size=plen).tolist(),
             max_new_tokens=args.max_new))
+    chaos = bool(args.chaos)
     loop = serving.ContinuousBatchingLoop(
         params, cfg, pool, max_batch=args.max_batch,
-        paged_impl=args.paged_impl, prefill=args.prefill)
+        paged_impl=args.paged_impl, prefill=args.prefill,
+        check_every=1 if chaos else 0)
+    if chaos:
+        from paddle_tpu.resilience import faultinject  # noqa: F401
+
+        # poison one sequence's logits on the first decode step and leak
+        # pages on the next append — the quarantine + integrity watchdog
+        # must absorb both with zero pages leaked at the end
+        os.environ["FAULT_SERVE_NAN_SEQ"] = "1@1"
+        os.environ["FAULT_SERVE_LEAK_PAGES"] = "2"
     t0 = time.perf_counter()
-    results = loop.run(reqs)
+    try:
+        results = loop.run(reqs)
+    finally:
+        if chaos:
+            from paddle_tpu.resilience import faultinject
+
+            os.environ.pop("FAULT_SERVE_NAN_SEQ", None)
+            os.environ.pop("FAULT_SERVE_LEAK_PAGES", None)
+            faultinject.reset()
     elapsed = time.perf_counter() - t0
     tokens = sum(len(r.tokens) for r in results)
     ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
     st = pool.stats()
-    return {
+    result = {
         "mode": "decode",
         "paged_impl": loop.paged_impl,  # the impl that actually ran
         "prefill": loop.prefill,
@@ -200,11 +291,19 @@ def run_decode_bench(args) -> dict:
         "page_allocs": st["page_allocs"],
         "pages_leaked": st["used_pages"],  # must be 0 after a full run
     }
+    if chaos:
+        result.update({
+            "quarantined": loop.quarantined,
+            "reclaimed_pages": loop.reclaimed_pages,
+            "invariants_ok": int(pool.check_invariants()["ok"]),
+        })
+    return result
 
 
 # metrics where bigger is better; everything else (latencies, leak
 # counters) gates as lower-is-better
-_HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy")
+_HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
+                     "recovered", "invariants_ok")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -270,6 +369,11 @@ def main(argv=None) -> int:
     ap.add_argument("--n-layer", type=int, default=2)
     ap.add_argument("--max-len", type=int, default=96)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm FAULT_SERVE_* knobs mid-run and report "
+                         "recovery counts (engine: dispatcher raise + "
+                         "shed deadlines; decode: NaN sequence + page "
+                         "leak under a check_every=1 watchdog)")
     ap.add_argument("--json", default=None, help="write the result dict here")
     ap.add_argument("--baseline", default=None,
                     help="banked {metric: value} JSON to gate against")
