@@ -1,0 +1,54 @@
+"""Chip-less program linter: static analysis over jaxprs, TPU-lowered
+StableHLO, and AOT-compiled v5e HLO — no execution, no chip.
+
+Three of this repo's worst bug classes were invisible until a chip (or
+the AOT tier) caught them late: broadcast-materialized custom-call
+operands (the PR-1 lse/dvec 67 MB residuals), the relayout copy-pairs
+XLA inserts around pallas custom calls (the ROADMAP "layout tax"), and
+silent recompiles from weak types / python scalars leaking into trace
+keys.  All are statically detectable from the compiled chip program,
+which core/aot_tpu.py produces on any CPU host.
+
+    from paddle_tpu import analysis
+
+    art = analysis.capture_executor(exe, feed=..., fetch_list=[loss])
+    for f in analysis.run_detectors(art):
+        print(f.format())
+
+``tools/lint_programs.py`` runs the detectors over the model zoo
+(analysis.zoo), banks per-program baselines in AOT_COST_ZOO.json, and
+``--gate`` exits 3 on any new finding or bytes/step regression — the
+per-PR perf gate that runs with no chip attached.
+"""
+
+from .findings import Finding, SEVERITIES  # noqa: F401
+from .capture import (  # noqa: F401
+    ProgramArtifacts,
+    capture_executor,
+    capture_fn,
+)
+from .detectors import DETECTORS, run_detectors  # noqa: F401
+from .zoo import (  # noqa: F401
+    ZOO,
+    ZooResult,
+    bank,
+    default_baseline_path,
+    gate,
+    run_zoo,
+)
+
+__all__ = [
+    "DETECTORS",
+    "Finding",
+    "ProgramArtifacts",
+    "SEVERITIES",
+    "ZOO",
+    "ZooResult",
+    "bank",
+    "capture_executor",
+    "capture_fn",
+    "default_baseline_path",
+    "gate",
+    "run_detectors",
+    "run_zoo",
+]

@@ -1,0 +1,212 @@
+"""Capture one program's analyzable artifacts — no execution, no chip.
+
+A ProgramArtifacts bundles the three views every detector family needs,
+all produced from ONE trace against the chip-less v5e topology
+(core/aot_tpu.py):
+
+  jaxpr      jax-level dataflow (recompile hazards, dtype promotions,
+             host callbacks)
+  stablehlo  the TPU-lowered module BEFORE the XLA pipeline (custom-call
+             operands still show their defining broadcast/convert ops)
+  hlo        the optimized chip executable's text (relayout copies,
+             input/output aliasing — what actually hits HBM)
+  cost       the TPU compiler's own cost model for the executable
+             ({'bytes accessed', 'flops', ...} per step)
+
+Entry points: ``capture_fn`` for a bare jax callable, and
+``capture_executor`` for the exact program an Executor would run
+(resolved through the executor's own compiled-program cache under the
+TPU trace scope, so keep-bf16/NHWC auto-resolution is included and the
+analyzed program IS the chip program).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from .findings import Finding
+
+__all__ = ["ProgramArtifacts", "capture_fn", "capture_executor"]
+
+
+@dataclass
+class ProgramArtifacts:
+    name: str
+    jaxpr: Any                      # jax.core.ClosedJaxpr
+    stablehlo: str
+    hlo: str
+    cost: dict
+    fingerprint: str = ""
+    # flat parameter indices the caller marked donatable (the
+    # missed-donation detector only audits these — feeds/keys are not
+    # donatable by the executor contract)
+    donatable: frozenset = frozenset()
+    num_flat_args: int = 0
+    # capture-time hazards that are not visible in any IR (python-scalar
+    # feeds, non-hashable statics); the recompile-hazard detector merges
+    # them into its findings
+    extra_hazards: List[Finding] = field(default_factory=list)
+    # non-empty when the XLA TPU pipeline refused the program (e.g. host
+    # callbacks with a compile-only client); jaxpr/stablehlo detectors
+    # still run, hlo/cost views are empty
+    compile_error: str = ""
+
+    @property
+    def bytes_per_step(self) -> float:
+        return float(self.cost.get("bytes accessed", 0.0))
+
+    @property
+    def flops_per_step(self) -> float:
+        return float(self.cost.get("flops", 0.0))
+
+
+def _normalize_cost(ca) -> dict:
+    return ca if isinstance(ca, dict) else (ca[0] if ca else {})
+
+
+def _flat_donatable(args: Tuple, donate_argnums) -> frozenset:
+    """Flat parameter indices covered by the donated argnums — jax
+    flattens jit arguments in order, so each top-level arg owns one
+    contiguous run of entry parameters."""
+    donate = set(donate_argnums or ())
+    idx = 0
+    out = set()
+    for i, a in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(a))
+        if i in donate:
+            out.update(range(idx, idx + n))
+        idx += n
+    return frozenset(out)
+
+
+def capture_fn(fn, *args, name: str = "fn", donate_argnums=(),
+               donatable_argnums=None, topology=None, fingerprint: str = "",
+               extra_hazards: Optional[List[Finding]] = None,
+               ) -> ProgramArtifacts:
+    """Trace/lower/AOT-compile ``fn(*args)`` for the v5e topology and
+    return its artifact bundle.  Args may be concrete values or
+    ShapeDtypeStructs — only shapes/dtypes are consumed.
+
+    donate_argnums is what the executable ACTUALLY donates;
+    donatable_argnums (default: same) is what is ELIGIBLE for donation —
+    the missed-donation detector flags eligible-but-unaliased buffers, so
+    passing donatable_argnums without donate_argnums models a caller that
+    forgot to donate."""
+    from .. import flags
+    from ..core.aot_tpu import trace_tpu
+
+    if donatable_argnums is None:
+        donatable_argnums = donate_argnums
+    # trace with the TPU trace scope ACTIVE: op lowering reads it lazily
+    # at trace time (keep-bf16, NHWC, pallas-vs-interpret selection), so
+    # without it an executor raw_fn would trace its CPU reference-parity
+    # program and the linter would analyze the wrong executable — same
+    # forcing cost_analysis(platform="tpu") does
+    with flags.tpu_trace_scope(True):
+        traced = trace_tpu(fn, *args, topology=topology,
+                           donate_argnums=tuple(donate_argnums))
+        jaxpr = traced.jaxpr
+        lowered = traced.lower()
+        stablehlo = lowered.as_text()
+        hlo, cost, compile_error = "", {}, ""
+        try:
+            compiled = lowered.compile()
+            hlo = compiled.as_text()
+            cost = _normalize_cost(compiled.cost_analysis())
+        except Exception as e:
+            # a program the chip pipeline REJECTS (host callbacks under
+            # the compile-only client, Mosaic envelope violations) still
+            # gets its jaxpr/StableHLO detectors — and the rejection
+            # itself is worth surfacing to the caller
+            compile_error = str(e)
+    fp = fingerprint or hashlib.sha1(stablehlo.encode()).hexdigest()[:12]
+    return ProgramArtifacts(
+        name=name,
+        jaxpr=jaxpr,
+        stablehlo=stablehlo,
+        hlo=hlo,
+        cost=cost,
+        fingerprint=fp,
+        donatable=_flat_donatable(args, donatable_argnums),
+        num_flat_args=sum(
+            len(jax.tree_util.tree_leaves(a)) for a in args),
+        extra_hazards=list(extra_hazards or []),
+        compile_error=compile_error,
+    )
+
+
+def _capture_time_hazards(name: str, feed: dict, fingerprint: str
+                          ) -> List[Finding]:
+    """Hazards only visible at the call boundary: python scalars in the
+    feed (weak-typed trace entries — the same feed with a numpy array
+    silently recompiles) and non-hashable statics reaching the
+    compiled-program cache key (every run would miss the cache)."""
+    from .. import flags
+    from ..core import amp
+
+    hazards: List[Finding] = []
+    for fname, v in sorted((feed or {}).items()):
+        if isinstance(v, (bool, int, float)) and not hasattr(v, "dtype"):
+            hazards.append(Finding(
+                detector="recompile-hazard", severity="warning",
+                program=name, fingerprint=fingerprint,
+                where=f"feed:{fname}",
+                message=(f"feed '{fname}' is a python scalar "
+                         f"({type(v).__name__}): it traces weak-typed, so "
+                         "feeding an array later recompiles silently"),
+            ))
+    for label, key in (("flags.trace_key", flags.trace_key()),
+                       ("amp.state_key", amp.state_key())):
+        try:
+            hash(key)
+        except TypeError:
+            hazards.append(Finding(
+                detector="recompile-hazard", severity="error",
+                program=name, fingerprint=fingerprint, where=label,
+                message=(f"{label}() is not hashable — every executor run "
+                         "misses the compiled-program cache and recompiles"),
+            ))
+    return hazards
+
+
+def capture_executor(exe, program=None, feed=None, fetch_list=None,
+                     scope=None, name: str = "program",
+                     ) -> ProgramArtifacts:
+    """Capture the CHIP program this executor would run for (program,
+    feed, fetch_list) — same cache entry, same state donation, TPU trace
+    scope forced (keep-bf16 / NHWC auto-resolution included)."""
+    from ..core.framework import default_main_program
+
+    prog = program or default_main_program()
+    fp = prog.desc.fingerprint().hex()[:12]
+    hazards = _capture_time_hazards(name, feed, fp)
+    try:
+        compiled, feed_vals, state_vals, rng = exe.capture_program(
+            program, feed, fetch_list, scope)
+    except TypeError:
+        # the executor's own cache-key hash dies on the exact hazard the
+        # non-hashable-statics check exists to report — surface the
+        # finding rather than crashing the linter
+        if any(h.where in ("flags.trace_key", "amp.state_key")
+               for h in hazards):
+            return ProgramArtifacts(
+                name=name, jaxpr=None, stablehlo="", hlo="", cost={},
+                fingerprint=fp, extra_hazards=hazards,
+                compile_error="compiled-program cache key not hashable")
+        raise
+    donate = compiled.donates_states
+    args = (tuple(feed_vals), tuple(state_vals), rng)
+    # the state tuple is ALWAYS donation-eligible (run() aliases it unless
+    # the numerics sentinel turned donation off) — so an executor whose
+    # donation is off shows up as missed-donation findings, by design
+    return capture_fn(
+        compiled.raw_fn, *args, name=name,
+        donate_argnums=(1,) if donate else (),
+        donatable_argnums=(1,),
+        fingerprint=fp,
+        extra_hazards=hazards,
+    )

@@ -1,0 +1,146 @@
+"""Known-bad regression corpus: each builder re-creates one hazard class
+this repo actually shipped (or nearly shipped) and returns the captured
+ProgramArtifacts.  tests/test_analysis.py asserts the linter flags each
+with the right detector id, and ``lint_programs.py --inject <name>``
+splices them into a zoo run so the CI gate's nonzero exit is provable
+end-to-end.
+
+These are small on purpose — every builder AOT-compiles chip-less in
+seconds, so the corpus runs in tier-1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .capture import capture_fn, ProgramArtifacts
+
+__all__ = ["CORPUS", "build_corpus_program"]
+
+
+def _broadcast_lse_operand() -> ProgramArtifacts:
+    """The pre-PR-1 flash-attention residual bug: an lse-shaped [N]
+    vector broadcast-materialized to [N, 128] as a pallas custom-call
+    operand.  'XLA fuses it' was false — custom-call operands materialize
+    at full size (67 MB/tensor at longcontext)."""
+    import jax.experimental.pallas as pl
+
+    def _add_kernel(x_ref, b_ref, o_ref):
+        o_ref[...] = x_ref[...] + b_ref[...]
+
+    def fn(x, lse):
+        # the bug shape: per-row scalar state padded to the 128-lane width
+        b = jnp.broadcast_to(lse[:, None], (x.shape[0], 128))
+        return pl.pallas_call(
+            _add_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x, b)
+
+    return capture_fn(
+        fn,
+        jax.ShapeDtypeStruct((512, 128), jnp.float32),
+        jax.ShapeDtypeStruct((512,), jnp.float32),
+        name="corpus_broadcast_lse")
+
+
+def _conv_relayout_sandwich() -> ProgramArtifacts:
+    """The ROADMAP 'layout tax': an unfused conv feeding the pallas
+    conv-epilogue custom call and another conv consuming it.  XLA prefers
+    {3,0,2,1} for conv activations while the custom call pins row-major,
+    so the compiled module brackets the call with relayout copies."""
+    from ..kernels.conv_epilogue import conv_bn_act
+
+    N, H, C = 2, 56, 64
+
+    def fn(x, w0, w, g, b, w2):
+        h = jax.lax.conv_general_dilated(
+            x, w0, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h, _, _ = conv_bn_act(h, w, g, b)
+        return jax.lax.conv_general_dilated(
+            h, w2, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    wsd = jax.ShapeDtypeStruct((3, 3, C, C), jnp.float32)
+    gsd = jax.ShapeDtypeStruct((C,), jnp.float32)
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((N, H, H, C), jnp.float32),
+        wsd, wsd, gsd, gsd, wsd,
+        name="corpus_relayout_sandwich")
+
+
+def _missed_donation() -> ProgramArtifacts:
+    """A train-step-shaped fn whose state is eligible for aliasing but
+    never donated: the executable keeps input AND output buffers
+    resident — at real model scale, double the param memory."""
+    def fn(state, x):
+        return [s + x for s in state], jnp.sum(x)
+
+    a = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    return capture_fn(
+        fn, [a, a, a], a,
+        donate_argnums=(), donatable_argnums=(0,),
+        name="corpus_missed_donation")
+
+
+def _weak_type_scalar() -> ProgramArtifacts:
+    """A python scalar leaked into the trace: the lr rides as a
+    weak-typed f32 scalar, so the same step called with a numpy/jax
+    array lr silently lands on a different trace key and recompiles."""
+    def fn(x, lr):
+        return x - lr * x
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((128, 128), jnp.float32), 0.1,
+        name="corpus_weak_type")
+
+
+def _bf16_promotion_escape() -> ProgramArtifacts:
+    """A silent bf16->fp32 promotion whose full-width result escapes to
+    the program output: keep-tier bf16 is defeated — the activation hits
+    HBM at 2x the bytes."""
+    def fn(x):
+        # the hazard: a strongly-typed fp32 constant promotes the whole
+        # activation, and nothing narrows it back before the HBM write
+        return x.astype(jnp.float32) * 2.0 + 1.0
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((1024, 512), jnp.bfloat16),
+        name="corpus_bf16_escape")
+
+
+def _host_callback() -> ProgramArtifacts:
+    """A host callback inside the step body: every execution round-trips
+    the host, draining the device pipeline."""
+    import numpy as np
+
+    def fn(x):
+        s = jax.pure_callback(
+            lambda v: np.asarray(v).sum(),
+            jax.ShapeDtypeStruct((), jnp.float32), x)
+        return x * s
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((64, 128), jnp.float32),
+        name="corpus_host_callback")
+
+
+# name -> (builder, detector id the linter must flag it with)
+CORPUS = {
+    "broadcast_lse": (_broadcast_lse_operand, "broadcast-operand"),
+    "relayout_sandwich": (_conv_relayout_sandwich, "relayout-copy-pair"),
+    "missed_donation": (_missed_donation, "missed-donation"),
+    "weak_type": (_weak_type_scalar, "recompile-hazard"),
+    "bf16_escape": (_bf16_promotion_escape, "dtype-promotion"),
+    "host_callback": (_host_callback, "host-sync"),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def build_corpus_program(name: str) -> ProgramArtifacts:
+    """Build (and memoize — corpus programs are immutable) one known-bad
+    program by name."""
+    builder, _expected = CORPUS[name]
+    return builder()

@@ -1,0 +1,454 @@
+"""The six detectors of the chip-less program linter.
+
+Each detector is ``fn(ProgramArtifacts) -> List[Finding]`` over the
+captured jaxpr / TPU StableHLO / optimized chip HLO — no execution.  The
+detector ids are stable API (the known-bad corpus tests and banked
+AOT_COST_ZOO.json baselines key on them):
+
+  relayout-copy-pair   layout-changing copies XLA inserted to feed or
+                       drain a custom call (the ROADMAP "layout tax":
+                       custom calls pin row-major while XLA prefers e.g.
+                       {3,0,2,1} for conv tensors) — quantified in bytes
+  broadcast-operand    a custom-call operand materialized by
+                       broadcast_in_dim (the PR-1 lse/dvec bug class:
+                       "XLA fuses it" is false for custom-call operands)
+  missed-donation      a donatable input buffer with a shape/dtype-
+                       matching output that the compiled executable did
+                       NOT alias — one resident copy of the buffer wasted
+  recompile-hazard     weak types / python scalars / non-hashable statics
+                       reaching trace or cache keys — silent recompiles
+  dtype-promotion      silent widening (fp32->fp64 anywhere; bf16/fp16->
+                       fp32 whose result ESCAPES to HBM — program output
+                       or custom-call operand — above a size floor;
+                       fusion-internal fp32 math that narrows back before
+                       the HBM write is the intended stats idiom, not a
+                       finding)
+  host-sync            host callbacks / infeed / outfeed inside the
+                       program body — every step round-trips the host
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .capture import ProgramArtifacts
+from .findings import Finding
+from . import hlo as H
+
+__all__ = ["DETECTORS", "run_detectors"]
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        return int(aval.size) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# relayout-copy-pair
+
+
+def _resolve(name: str, by_name: dict, depth: int = 4) -> Optional[object]:
+    """Follow bitcast / get-tuple-element / copy-done indirections to the
+    instruction that actually produced a value."""
+    while depth:
+        instr = by_name.get(name)
+        if instr is None:
+            return None
+        if instr.opcode in ("bitcast", "get-tuple-element", "copy-done"):
+            if not instr.operand_names:
+                return instr
+            name = instr.operand_names[0]
+            if instr.opcode == "copy-done":
+                src = by_name.get(name)
+                if src is not None and src.opcode == "copy-start" \
+                        and src.operand_names:
+                    name = src.operand_names[0]
+            depth -= 1
+            continue
+        return instr
+    return by_name.get(name)
+
+
+def _is_relayout_copy(instr) -> bool:
+    if instr.opcode != "copy" or not instr.shapes or not instr.operands:
+        return False
+    res = instr.shapes[0]
+    op = instr.operands[0][0]
+    if op is None or not res.perm or not op.perm:
+        return False
+    return res.perm != op.perm
+
+
+def _pins_layout(instr) -> bool:
+    """Only custom calls that PIN operand/result layouts levy the
+    relayout tax.  The TPU backend also emits internal custom calls
+    (ConcatBitcast, GatherScatterIndicesBitpacked, ...) as part of its
+    own lowering — copies around those are XLA's choice, not a kernel
+    forcing a layout on XLA."""
+    return ('custom_call_target="tpu_custom_call"' in instr.line
+            or "operand_layout_constraints=" in instr.line)
+
+
+def detect_relayout_copies(art: ProgramArtifacts) -> List[Finding]:
+    instrs = H.entry_instructions(art.hlo)
+    by_name = {i.name: i for i in instrs}
+    findings: List[Finding] = []
+    custom_calls = [i for i in instrs
+                    if i.opcode == "custom-call" and _pins_layout(i)]
+    cc_names = {i.name for i in custom_calls}
+    # copies INTO a custom call: an operand (through bitcast/gte/async
+    # copy indirections) produced by a layout-changing copy
+    for cc in custom_calls:
+        for opname in cc.operand_names:
+            producer = _resolve(opname, by_name)
+            if producer is not None and _is_relayout_copy(producer):
+                b = producer.shapes[0].bytes
+                findings.append(Finding(
+                    detector="relayout-copy-pair", severity="warning",
+                    program=art.name, fingerprint=art.fingerprint,
+                    bytes=b, where=f"{producer.name}->{cc.name}",
+                    message=(f"relayout copy {{{producer.operands[0][0].perm}}}"
+                             f"->{{{producer.shapes[0].perm}}} feeds custom "
+                             f"call {cc.name} ({b} bytes): the custom call "
+                             "pins a layout XLA does not prefer here"),
+                ))
+    # copies OUT of a custom call: a layout-changing copy whose operand
+    # resolves back to a custom-call result
+    for instr in instrs:
+        if not _is_relayout_copy(instr) or not instr.operand_names:
+            continue
+        producer = _resolve(instr.operand_names[0], by_name)
+        if producer is not None and producer.name in cc_names:
+            b = instr.shapes[0].bytes
+            findings.append(Finding(
+                detector="relayout-copy-pair", severity="warning",
+                program=art.name, fingerprint=art.fingerprint,
+                bytes=b, where=f"{producer.name}->{instr.name}",
+                message=(f"relayout copy {{{instr.operands[0][0].perm}}}"
+                         f"->{{{instr.shapes[0].perm}}} drains custom call "
+                         f"{producer.name} ({b} bytes)"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# broadcast-operand
+
+_BROADCAST_MIN_BYTES = 64 * 1024
+
+
+def detect_broadcast_operands(art: ProgramArtifacts) -> List[Finding]:
+    findings = []
+    for target, ssa, dst_b, src_b in H.stablehlo_broadcast_operands(
+            art.stablehlo):
+        if dst_b < _BROADCAST_MIN_BYTES:
+            continue  # scalar scales etc. — not the materialization class
+        findings.append(Finding(
+            detector="broadcast-operand", severity="error",
+            program=art.name, fingerprint=art.fingerprint,
+            bytes=dst_b, where=f"%{ssa}->@{target or 'custom_call'}",
+            message=(f"custom-call operand %{ssa} is a materialized "
+                     f"broadcast ({src_b} -> {dst_b} bytes): custom-call "
+                     "operands are NOT fused away — this buffer hits HBM "
+                     "at full size every step (the PR-1 lse/dvec class)"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# missed-donation
+
+
+def detect_missed_donation(art: ProgramArtifacts) -> List[Finding]:
+    if not art.donatable:
+        return []
+    params, outs = H.parse_entry_layout(art.hlo)
+    alias = H.parse_input_output_alias(art.hlo)
+    aliased_params = set(alias.values())
+    aliased_outs = set(alias.keys())
+    findings: List[Finding] = []
+    free_outs = [
+        (i, o) for i, o in enumerate(outs) if i not in aliased_outs]
+    for p_idx in sorted(art.donatable):
+        if p_idx in aliased_params or p_idx >= len(params):
+            continue
+        p = params[p_idx]
+        match = next(
+            ((i, o) for i, o in free_outs
+             if o.dtype == p.dtype and o.dims == p.dims), None)
+        if match is None:
+            continue
+        free_outs.remove(match)
+        findings.append(Finding(
+            detector="missed-donation", severity="warning",
+            program=art.name, fingerprint=art.fingerprint,
+            bytes=p.bytes, where=f"param {p_idx} -> output {match[0]}",
+            message=(f"donatable input {p_idx} "
+                     f"({p.dtype}{list(p.dims)}, {p.bytes} bytes) has a "
+                     f"shape-matched unaliased output {match[0]} but the "
+                     "executable holds both buffers — donation was "
+                     "requested but not realized (layout/sharding "
+                     "mismatch) or never requested"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+
+
+def detect_recompile_hazards(art: ProgramArtifacts) -> List[Finding]:
+    findings = list(art.extra_hazards)
+    jaxpr = getattr(art.jaxpr, "jaxpr", art.jaxpr)
+    if jaxpr is None:
+        return findings
+    for i, var in enumerate(jaxpr.invars):
+        aval = getattr(var, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                detector="recompile-hazard", severity="warning",
+                program=art.name, fingerprint=art.fingerprint,
+                bytes=_aval_bytes(aval), where=f"arg {i}",
+                message=(f"argument {i} traces WEAK-typed ({aval.dtype}): a "
+                         "python scalar reached the trace — calling with a "
+                         "strongly-typed array later lands on a different "
+                         "trace key and silently recompiles"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion
+
+_PROMOTION_MIN_BYTES = 1 << 20
+_WIDENING = {
+    ("bfloat16", "float32"), ("float16", "float32"),
+    ("float32", "float64"), ("bfloat16", "float64"),
+    ("float16", "float64"),
+}
+# ops a widened value flows THROUGH at full size; anything not listed is
+# an accumulate/shrink sink (reductions, dots, convs, scatters) or an
+# unknown op, both of which stop propagation
+_TRANSPARENT_PRIMS = {
+    "add", "sub", "mul", "div", "max", "min", "neg", "abs", "exp", "log",
+    "tanh", "logistic", "sqrt", "rsqrt", "pow", "integer_pow", "select_n",
+    "reshape", "transpose", "broadcast_in_dim", "slice", "dynamic_slice",
+    "concatenate", "pad", "rev", "squeeze", "copy", "expand_dims",
+    "where", "clamp", "sign",
+}
+_CUSTOM_CALL_PRIMS = {"pallas_call", "custom_call", "tpu_custom_call"}
+
+
+def _iter_subjaxprs(jaxpr):
+    """(jaxpr, depth) over the open jaxpr and everything nested in eqn
+    params (pjit bodies, cond branches, scan/while bodies, remat...)."""
+    stack = [(jaxpr, 0)]
+    while stack:
+        j, d = stack.pop()
+        yield j, d
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for cj in _closed_jaxprs(v):
+                    stack.append((cj, d + 1))
+
+
+def _closed_jaxprs(v):
+    out = []
+    seen_types = (list, tuple)
+    vals = v if isinstance(v, seen_types) else [v]
+    for item in vals:
+        inner = getattr(item, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            out.append(inner)
+        elif hasattr(item, "eqns"):
+            out.append(item)
+    return out
+
+
+_MIXING_PRIMS = {"add", "sub", "mul", "div", "max", "min", "select_n",
+                 "where", "clamp"}
+
+
+def _absorbed_by_wide_sibling(var, user) -> bool:
+    """A widened value merging into an equally-large tensor that is
+    ALREADY the wide dtype is a deliberate precision join (the AMP
+    master-weight / fp32-stats idiom: bf16 grads cast up to update f32
+    params) — the f32 HBM write is attributable to that tensor, not to
+    the promotion.  Scalar/broadcast siblings (a f32 constant promoting
+    a whole activation) do not absorb."""
+    va = getattr(var, "aval", None)
+    if va is None:
+        return False
+    for sib in user.invars:
+        if sib is var:
+            continue
+        sa = getattr(sib, "aval", None)
+        if sa is not None and sa.dtype == va.dtype \
+                and getattr(sa, "size", 0) >= va.size:
+            return True
+    return False
+
+
+def _escapes(eqn, jaxpr, top_level: bool) -> Optional[str]:
+    """Does the widened value produced by `eqn` reach HBM at full width —
+    a program output (top level only) or a custom-call operand?  Walks
+    forward through transparent elementwise/movement ops; reductions,
+    contractions, unknown ops, and full-width joins with already-wide
+    tensors absorb it (the accumulate-in-fp32 / master-weight idioms)."""
+    outvars = {id(v) for v in jaxpr.outvars}
+    uses: Dict[int, list] = {}
+    for e in jaxpr.eqns:
+        for v in e.invars:
+            uses.setdefault(id(v), []).append(e)
+    frontier = list(eqn.outvars)
+    seen = set()
+    while frontier:
+        var = frontier.pop()
+        if id(var) in seen:
+            continue
+        seen.add(id(var))
+        if top_level and id(var) in outvars:
+            return "program output"
+        for user in uses.get(id(var), []):
+            prim = user.primitive.name
+            if prim in _CUSTOM_CALL_PRIMS:
+                return f"custom call ({prim})"
+            if prim == "convert_element_type":
+                # narrowing back down ends the hazard on that path
+                continue
+            if prim in _MIXING_PRIMS \
+                    and _absorbed_by_wide_sibling(var, user):
+                continue
+            if prim in _TRANSPARENT_PRIMS:
+                frontier.extend(user.outvars)
+    return None
+
+
+def detect_dtype_promotions(art: ProgramArtifacts) -> List[Finding]:
+    closed = art.jaxpr
+    jaxpr = getattr(closed, "jaxpr", closed)
+    if jaxpr is None:
+        return []
+    findings: List[Finding] = []
+    for sub, depth in _iter_subjaxprs(jaxpr):
+        for eqn in sub.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src = getattr(eqn.invars[0], "aval", None)
+            dst = getattr(eqn.outvars[0], "aval", None)
+            if src is None or dst is None:
+                continue
+            pair = (str(src.dtype), str(dst.dtype))
+            if pair not in _WIDENING:
+                continue
+            b = _aval_bytes(dst)
+            if pair[1] == "float64":
+                findings.append(Finding(
+                    detector="dtype-promotion", severity="error",
+                    program=art.name, fingerprint=art.fingerprint,
+                    bytes=b, where=f"{pair[0]}->{pair[1]}",
+                    message=(f"silent {pair[0]}->float64 promotion "
+                             f"({b} bytes): an x64 leak — TPUs have no "
+                             "f64 units, this deoptimizes the whole "
+                             "fusion it lands in"),
+                ))
+                continue
+            if b < _PROMOTION_MIN_BYTES:
+                continue
+            sink = _escapes(eqn, sub, top_level=(depth == 0))
+            if sink is None:
+                continue
+            findings.append(Finding(
+                detector="dtype-promotion", severity="warning",
+                program=art.name, fingerprint=art.fingerprint,
+                bytes=b, where=f"{pair[0]}->{pair[1]} -> {sink}",
+                message=(f"{pair[0]}->{pair[1]} promotion escapes to "
+                         f"{sink} at full width ({b} bytes): the widened "
+                         "activation hits HBM — keep-tier bf16 is "
+                         "defeated on this path"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+
+_HOST_SYNC_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call", "infeed", "outfeed",
+}
+_HOST_SYNC_CC_MARKERS = ("xla_python_cpu_callback", "xla_ffi_python",
+                         "callback")
+
+
+def detect_host_sync(art: ProgramArtifacts) -> List[Finding]:
+    closed = art.jaxpr
+    jaxpr = getattr(closed, "jaxpr", closed)
+    findings: List[Finding] = []
+    if jaxpr is not None:
+        for sub, _ in _iter_subjaxprs(jaxpr):
+            for eqn in sub.eqns:
+                if eqn.primitive.name in _HOST_SYNC_PRIMS:
+                    b = sum(_aval_bytes(getattr(v, "aval", None)) or 0
+                            for v in eqn.invars
+                            if getattr(v, "aval", None) is not None)
+                    findings.append(Finding(
+                        detector="host-sync", severity="error",
+                        program=art.name, fingerprint=art.fingerprint,
+                        bytes=b, where=eqn.primitive.name,
+                        message=(f"{eqn.primitive.name} inside the program "
+                                 "body: every step synchronizes with the "
+                                 "host — the device pipeline drains and "
+                                 "serving latency inherits host jitter"),
+                    ))
+    # callbacks that arrived pre-packaged as custom calls (libraries):
+    # each jaxpr-level CALLBACK lowers to one such custom call, so only
+    # marker lines BEYOND the callback-prim findings are additional
+    # hazards — without this a single pure_callback would bank a count
+    # of 2.  infeed/outfeed prims lower to stablehlo.infeed/outfeed,
+    # never to callback custom calls, so they must not offset the slice
+    n_from_jaxpr = sum(
+        1 for f in findings if f.where not in ("infeed", "outfeed"))
+    cc_lines = []
+    for line in art.stablehlo.splitlines():
+        if "custom_call" not in line:
+            continue
+        low = line.lower()
+        if any(m in low for m in _HOST_SYNC_CC_MARKERS) \
+                and "tpu_custom_call" not in low:
+            cc_lines.append(line)
+    for line in cc_lines[n_from_jaxpr:]:
+        findings.append(Finding(
+            detector="host-sync", severity="error",
+            program=art.name, fingerprint=art.fingerprint,
+            where="custom_call",
+            message=("host-callback custom call in lowered module: "
+                     + line.strip()[:120]),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+
+DETECTORS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
+    "relayout-copy-pair": detect_relayout_copies,
+    "broadcast-operand": detect_broadcast_operands,
+    "missed-donation": detect_missed_donation,
+    "recompile-hazard": detect_recompile_hazards,
+    "dtype-promotion": detect_dtype_promotions,
+    "host-sync": detect_host_sync,
+}
+
+
+def run_detectors(art: ProgramArtifacts,
+                  detectors: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    """Run the named detectors (default: all, in registry order) over one
+    captured program."""
+    names = list(detectors) if detectors else list(DETECTORS)
+    out: List[Finding] = []
+    for n in names:
+        out.extend(DETECTORS[n](art))
+    return out

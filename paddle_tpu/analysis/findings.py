@@ -1,0 +1,79 @@
+"""Typed findings for the chip-less program linter.
+
+A Finding is one statically-detected hazard in one compiled program:
+which detector fired, how bad it is, where, and how many HBM bytes the
+hazard costs per step (0 when the cost is a recompile/stall rather than
+traffic).  Findings are JSON-stable so lint_programs.py can bank counts
+into AOT_COST_ZOO.json and diff them in CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+__all__ = ["Finding", "SEVERITIES"]
+
+# ordered weakest -> strongest; gate policy treats every severity as
+# gating (a new `info` finding is still a new hazard), severity exists
+# for human triage
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclass
+class Finding:
+    """One statically-detected hazard.
+
+    detector : stable detector id (``relayout-copy-pair``, ...) — the
+               corpus tests assert on these, so they are API
+    severity : one of SEVERITIES
+    program  : zoo/program name the finding was raised against
+    message  : human-readable one-liner
+    bytes    : HBM bytes per step this hazard costs (0 = non-traffic
+               hazard, e.g. a recompile trigger)
+    where    : instruction / variable the finding anchors to ("" when
+               the hazard is program-wide)
+    fingerprint : program fingerprint (sha1 of the TPU StableHLO, or the
+               ProgramDesc fingerprint for executor programs)
+    """
+
+    detector: str
+    severity: str
+    program: str
+    message: str
+    bytes: int = 0
+    where: str = ""
+    fingerprint: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got {self.severity!r}")
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {
+            "detector": self.detector,
+            "severity": self.severity,
+            "program": self.program,
+            "message": self.message,
+            "bytes": int(self.bytes),
+            "where": self.where,
+            "fingerprint": self.fingerprint,
+        }
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def format(self) -> str:
+        cost = f" [{_fmt_bytes(self.bytes)}]" if self.bytes else ""
+        loc = f" @ {self.where}" if self.where else ""
+        return (f"{self.severity.upper():7} {self.detector:24} "
+                f"{self.program}{loc}{cost}: {self.message}")
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"

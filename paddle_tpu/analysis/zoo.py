@@ -1,0 +1,324 @@
+"""The model zoo the chip-less linter gates on, and the gate itself.
+
+Three programs cover the repo's three hot paths at CI scale (small
+batch/sequence — the AOT v5e pipeline prices the same per-op structure
+the banked full-scale artifacts measured, in ~2 min total on a CPU
+host):
+
+  resnet50_train     full ResNet-50 train step (Momentum), bs=2, 64x64
+                     — the conv/BN pillar (AOT_COST_AB.json's program at
+                     bench scale)
+  transformer_train  2-layer flash-attention transformer train step
+                     (Adam, fused qkv), bs=4, S=32 — the attention
+                     pillar, pallas custom calls included
+  paged_decode       the serving decode attention step at the banked
+                     AOT_COST_PAGED shape (B=4 H=8 D=128, 512 cached
+                     tokens), pallas page-streaming impl — bytes/step
+                     counts the analytic page-stream traffic on top of
+                     the XLA-visible bytes, same methodology as the
+                     banked artifact
+
+Baselines live in AOT_COST_ZOO.json: per-program finding counts by
+detector plus AOT bytes/step + flops/step (extending AOT_COST_AB /
+AOT_COST_PAGED into one gated table).  ``gate()`` fails on any new
+finding (count above baseline, or a program with no banked entry) and on
+a bytes/step regression past tolerance — the per-PR perf-regression CI
+gate that runs with no chip attached.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .capture import ProgramArtifacts, capture_executor, capture_fn
+from .detectors import run_detectors
+from .findings import Finding
+
+__all__ = ["ZOO", "ZooResult", "run_zoo", "bank", "gate",
+           "default_baseline_path"]
+
+DEFAULT_TOLERANCE = 0.02  # the AOT cost model is deterministic per
+                          # jax/libtpu version; 2% absorbs pipeline noise
+
+
+@dataclass
+class ZooResult:
+    name: str
+    artifacts: ProgramArtifacts
+    findings: List[Finding]
+    bytes_per_step: float   # cost-model bytes + any analytic correction
+    flops_per_step: float
+    config: Dict = field(default_factory=dict)
+
+    def finding_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.detector] = counts.get(f.detector, 0) + 1
+        return counts
+
+
+@contextlib.contextmanager
+def _fresh_env():
+    """Build a zoo model in a guarded program/scope/name-counter sandbox:
+    run_zoo() is public API, so a caller's live default program and
+    global scope must survive it untouched (fresh name counters keep the
+    banked ProgramDesc fingerprints stable across process histories)."""
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()), \
+            fluid.scope_guard(fluid.Scope()), \
+            fluid.unique_name.guard():
+        yield fluid
+
+
+def _build_resnet50() -> Tuple[ProgramArtifacts, float, Dict]:
+    from paddle_tpu import models
+
+    cfg = {"depth": 50, "batch": 2, "img": 64, "optimizer": "momentum"}
+    with _fresh_env() as fluid:
+        spec = models.resnet_imagenet(
+            depth=50, class_num=100, img_shape=(3, cfg["img"], cfg["img"]))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        batch = spec.synthetic_batch(cfg["batch"])
+        art = capture_executor(exe, feed=batch, fetch_list=[spec.loss],
+                               name="resnet50_train")
+    return art, 0.0, cfg
+
+
+def _build_transformer() -> Tuple[ProgramArtifacts, float, Dict]:
+    from paddle_tpu import models
+
+    cfg = {"n_layer": 2, "n_head": 4, "d_model": 128, "d_inner": 256,
+           "max_length": 32, "vocab": 512, "batch": 4, "flash": True,
+           "fuse_qkv": True, "optimizer": "adam"}
+    mcfg = models.TransformerConfig(
+        src_vocab_size=cfg["vocab"], trg_vocab_size=cfg["vocab"],
+        max_length=cfg["max_length"], n_layer=cfg["n_layer"],
+        n_head=cfg["n_head"], d_model=cfg["d_model"],
+        d_inner=cfg["d_inner"], use_flash_attention=cfg["flash"],
+        fuse_qkv=cfg["fuse_qkv"], shard_weights=False)
+    with _fresh_env() as fluid:
+        spec = models.transformer(mcfg)
+        fluid.optimizer.AdamOptimizer(1e-4).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        batch = spec.synthetic_batch(cfg["batch"])
+        art = capture_executor(exe, feed=batch, fetch_list=[spec.loss],
+                               name="transformer_train")
+    return art, 0.0, cfg
+
+
+def _build_paged_decode() -> Tuple[ProgramArtifacts, float, Dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.paged_attention import (
+        attention_bytes_per_step, paged_decode_attention)
+
+    # the banked AOT_COST_PAGED decode shape: 512 cached tokens/sequence
+    B, H, D, ps, maxp = 4, 8, 128, 16, 32
+    cfg = {"batch": B, "heads": H, "head_dim": D, "page_size": ps,
+           "max_pages": maxp, "impl": "pallas"}
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, H, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((H, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    art = capture_fn(
+        lambda q, k, v, t, l: paged_decode_attention(
+            q, k, v, t, l, impl="pallas"),
+        q, kp, kp, tb, ln, name="paged_decode")
+    # the SMEM-table-driven page DMAs are invisible to the XLA cost model
+    # (AOT_COST_PAGED.json "method") — charge the full analytic stream so
+    # the gated number is the honest one
+    extra = float(attention_bytes_per_step("pallas", B, maxp, ps, H, D))
+    return art, extra, cfg
+
+
+ZOO = {
+    "resnet50_train": _build_resnet50,
+    "transformer_train": _build_transformer,
+    "paged_decode": _build_paged_decode,
+}
+
+
+def _corpus_builder(name: str):
+    def build() -> Tuple[ProgramArtifacts, float, Dict]:
+        from .corpus import build_corpus_program
+
+        return build_corpus_program(name), 0.0, {"corpus": name}
+    return build
+
+
+def run_zoo(programs: Optional[Sequence[str]] = None,
+            inject: Sequence[str] = (),
+            detectors: Optional[Sequence[str]] = None,
+            progress=None) -> List[ZooResult]:
+    """Capture + lint every requested zoo program (default: all), plus
+    any injected known-bad corpus programs (their results carry the
+    corpus program's name, e.g. ``corpus_broadcast_lse``)."""
+    from .corpus import CORPUS
+
+    from .detectors import DETECTORS
+
+    names = list(programs) if programs else list(ZOO)
+    # validate EVERYTHING before the first expensive capture
+    for d in detectors or ():
+        if d not in DETECTORS:
+            raise KeyError(
+                f"unknown detector {d!r}; have {sorted(DETECTORS)}")
+    builders = []
+    for n in names:
+        if n not in ZOO:
+            raise KeyError(
+                f"unknown zoo program {n!r}; have {sorted(ZOO)}")
+        builders.append(ZOO[n])
+    for n in inject:
+        if n not in CORPUS:
+            raise KeyError(
+                f"unknown corpus program {n!r}; have {sorted(CORPUS)}")
+        builders.append(_corpus_builder(n))
+    results: List[ZooResult] = []
+    for build in builders:
+        art, extra_bytes, cfg = build()
+        if progress:
+            progress(f"captured {art.name} "
+                     f"({art.bytes_per_step / 1e6:.1f} MB/step xla-visible)")
+        findings = run_detectors(art, detectors)
+        results.append(ZooResult(
+            name=art.name,
+            artifacts=art,
+            findings=findings,
+            bytes_per_step=art.bytes_per_step + extra_bytes,
+            flops_per_step=art.flops_per_step,
+            config=cfg,
+        ))
+    return results
+
+
+def default_baseline_path() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "AOT_COST_ZOO.json")
+
+
+def bank(results: List[ZooResult], path: str,
+         tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Write the zoo baseline artifact (the banked counterpart of
+    AOT_COST_AB/AOT_COST_PAGED, now one gated table).  Refuses results
+    whose AOT compile failed: banking bytes_per_step=0 would make every
+    later healthy run look like a regression (and the broken one pass)."""
+    broken = [r.name for r in results if r.artifacts.compile_error]
+    if broken:
+        raise ValueError(
+            f"refusing to bank programs whose AOT compile failed: {broken}")
+    doc = {
+        "what": ("chip-less linter zoo baselines (paddle_tpu.analysis): "
+                 "per-program finding counts by detector + the AOT v5e "
+                 "cost model's bytes/step and flops/step, captured by "
+                 "tools/lint_programs.py --bank on a CPU-only host. "
+                 "lint_programs --gate fails PRs on any NEW finding or a "
+                 "bytes/step regression past tolerance. paged_decode "
+                 "bytes include the analytic page-stream traffic on top "
+                 "of the XLA-visible bytes (AOT_COST_PAGED.json method)."),
+        "tolerance": tolerance,
+        "programs": {
+            r.name: {
+                "config": r.config,
+                "bytes_per_step": r.bytes_per_step,
+                "flops_per_step": r.flops_per_step,
+                "findings": r.finding_counts(),
+                "fingerprint": r.artifacts.fingerprint,
+            }
+            for r in results
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def gate(results: List[ZooResult], baseline_path: str,
+         tolerance: Optional[float] = None,
+         require_all: bool = False) -> Tuple[List[dict], bool]:
+    """Verdicts vs the banked baseline.  Returns (verdicts, failed).
+
+    Fails on: a program with no banked entry (bank deliberately, don't
+    drift), any detector whose finding count EXCEEDS the banked count
+    (new finding), and a bytes/step rise past tolerance (the existing
+    BENCH_BASELINE verdict machinery prices the regression).  With
+    require_all (an unfiltered run), a BANKED program absent from the
+    run also fails — deleting or renaming a zoo entry must not silently
+    shrink CI coverage."""
+    from ..observability import regression_verdict
+
+    with open(baseline_path) as f:
+        base = json.load(f)
+    tol = tolerance if tolerance is not None else float(
+        base.get("tolerance", DEFAULT_TOLERANCE))
+    banked = base.get("programs", {})
+    verdicts: List[dict] = []
+    failed = False
+    for r in results:
+        # a program the pipeline REJECTED analyzed nothing HLO-side:
+        # bytes collapse to 0 (lower-is-better would PASS) and the HLO
+        # detectors go blind — that is a gate failure, never a pass
+        if r.artifacts.compile_error:
+            verdicts.append({
+                "metric": f"{r.name}_compile", "verdict": "fail",
+                "reason": ("AOT compile failed — nothing was analyzed: "
+                           + r.artifacts.compile_error[:200]),
+            })
+            failed = True
+            continue
+        entry = banked.get(r.name)
+        if entry is None:
+            verdicts.append({
+                "metric": f"{r.name}_findings", "verdict": "fail",
+                "reason": "program has no banked baseline "
+                          "(run --bank to add it deliberately)",
+            })
+            failed = True
+            continue
+        base_counts = entry.get("findings", {}) or {}
+        cur_counts = r.finding_counts()
+        for det in sorted(set(base_counts) | set(cur_counts)):
+            cur, prev = cur_counts.get(det, 0), base_counts.get(det, 0)
+            if cur > prev:
+                verdicts.append({
+                    "metric": f"{r.name}_findings[{det}]",
+                    "baseline": prev, "current": cur, "verdict": "fail",
+                    "reason": f"{cur - prev} new {det} finding(s)",
+                })
+                failed = True
+            elif cur < prev:
+                # strictly better — report so the baseline gets re-banked
+                verdicts.append({
+                    "metric": f"{r.name}_findings[{det}]",
+                    "baseline": prev, "current": cur, "verdict": "pass",
+                    "reason": "fewer findings than banked — re-bank",
+                })
+        bv = regression_verdict(
+            f"{r.name}_aot_bytes_per_step",
+            float(entry.get("bytes_per_step", 0.0)),
+            r.bytes_per_step, tolerance=tol, higher_is_better=False)
+        verdicts.append(bv)
+        failed = failed or bv["verdict"] == "fail"
+    if require_all:
+        ran = {r.name for r in results}
+        for name in sorted(set(banked) - ran):
+            verdicts.append({
+                "metric": f"{name}_coverage", "verdict": "fail",
+                "reason": ("banked program missing from the run — "
+                           "coverage shrank (re-bank deliberately if the "
+                           "zoo entry was removed)"),
+            })
+            failed = True
+    return verdicts, failed

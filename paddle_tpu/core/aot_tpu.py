@@ -30,7 +30,7 @@ import numpy as np
 
 import jax
 
-__all__ = ["tpu_topology", "compile_tpu", "tpu_cost_analysis"]
+__all__ = ["tpu_topology", "trace_tpu", "compile_tpu", "tpu_cost_analysis"]
 
 _DEFAULT_TOPOLOGY = "v5e:1x1"
 
@@ -63,21 +63,44 @@ def _abstract(v):
         return v
     dt = getattr(v, "dtype", None)
     if dt is None:
+        # python scalars stay concrete: abstracting through np.asarray
+        # would strengthen their dtype, hiding the weak-typed trace entry
+        # the recompile-hazard detector exists to catch
+        if isinstance(v, (bool, int, float, complex)):
+            return v
         arr = np.asarray(v)
         return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
     return jax.ShapeDtypeStruct(np.shape(v), dt)
 
 
-def compile_tpu(fn, *args, topology=None):
+def trace_tpu(fn, *args, topology=None, donate_argnums=()):
+    """Trace `fn(*args)` against the TPU topology and return the
+    jax.stages.Traced — `.jaxpr` for static analysis, `.lower()` for the
+    TPU StableHLO / compiled executable.  One trace serves all three
+    (paddle_tpu.analysis reads jaxpr + lowered + compiled from it).
+
+    donate_argnums marks buffers for input/output aliasing exactly as a
+    real jit would — the compiled module's `input_output_alias` then
+    reflects what Executor.run's donation produces on chip, which the
+    missed-donation detector audits.  keep_unused pins entry parameters
+    1:1 to the flat args: without it jit prunes unused args from the
+    executable, shifting every parameter index the analyzer computed
+    from the python signature."""
+    topo = topology or tpu_topology()
+    s = _replicated_sharding(topo)
+    fj = jax.jit(fn, in_shardings=s, out_shardings=s,
+                 donate_argnums=donate_argnums, keep_unused=True)
+    absargs = jax.tree_util.tree_map(_abstract, args)
+    return fj.trace(*absargs)
+
+
+def compile_tpu(fn, *args, topology=None, donate_argnums=()):
     """AOT-compile `fn(*args)` for the TPU topology; returns the
     jax.stages.Compiled (cost_analysis(), memory_analysis(), as_text(),
     serializable executable).  Args may be concrete values or
     ShapeDtypeStructs — only shapes/dtypes are used."""
-    topo = topology or tpu_topology()
-    s = _replicated_sharding(topo)
-    fj = jax.jit(fn, in_shardings=s, out_shardings=s)
-    absargs = jax.tree_util.tree_map(_abstract, args)
-    return fj.trace(*absargs).lower().compile()
+    return trace_tpu(fn, *args, topology=topology,
+                     donate_argnums=donate_argnums).lower().compile()
 
 
 def tpu_cost_analysis(fn, *args, topology=None) -> dict:

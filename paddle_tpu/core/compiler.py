@@ -445,6 +445,10 @@ class CompiledBlock:
         self.fetch_names = list(fetch_names)
         self.state_names = list(state_names)
         self.mesh = mesh
+        # recorded for the static analyzer: whether the jitted executable
+        # donates the state tuple (analysis.capture re-creates the same
+        # aliasing when it AOT-compiles this block for the chip)
+        self.donates_states = bool(donate_states)
         _maybe_enable_compile_cache()
         block = self.block
         ops = list(block.desc.ops)

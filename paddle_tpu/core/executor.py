@@ -550,28 +550,8 @@ class Executor:
         with flags.tpu_trace_scope(
                 True if want_tpu
                 else device_is_tpu(self.place.jax_device())):
-            program = program or default_main_program()
-            if feed is None and getattr(program, "_py_readers", None):
-                # mirror run()'s feed-less py_reader path: pull one batch
-                # so the analyzed module has the same feed signature as
-                # the one being timed
-                feed = {}
-                for r in program._py_readers:
-                    feed.update(r._next_batch())
-            feed = feed or {}
-            fetch_list = list(fetch_list or [])
-            scope = scope or global_scope()
-            feed_names = sorted(feed)
-            fetch_names = [
-                v.name if isinstance(v, Variable) else str(v)
-                for v in fetch_list
-            ]
-            _, compiled, plan = self._cache_entry(
-                program, feed_names, fetch_names)
-            block0 = program.desc.block(0)
-            feed_vals = plan.feed_values(feed, block0)
-            state_vals = plan.state_values(scope, block0)
-            rng = plan.rng_value(scope, program)
+            compiled, feed_vals, state_vals, rng = self._resolve_entry(
+                program, feed, fetch_list, scope)
             if want_tpu:
                 # AOT path: only shapes/dtypes are consumed, no device
                 # commit (there is no device)
@@ -585,6 +565,57 @@ class Executor:
             state_vals = jax.device_put(state_vals, device)
             rng = jax.device_put(rng, device)
             return compiled.cost_analysis(feed_vals, state_vals, rng)
+
+    def _resolve_entry(
+        self,
+        program: Optional[Program],
+        feed: Optional[Dict[str, Any]],
+        fetch_list: Optional[Sequence],
+        scope: Optional[Scope],
+    ):
+        """Resolve (program, feed, fetches) to the SAME cache entry and
+        flat values run() would use — shared by cost_analysis() and
+        capture_program() so their view can never drift from run()'s."""
+        program = program or default_main_program()
+        if feed is None and getattr(program, "_py_readers", None):
+            # mirror run()'s feed-less py_reader path: pull one batch so
+            # the analyzed module has the same feed signature as the one
+            # being timed
+            feed = {}
+            for r in program._py_readers:
+                feed.update(r._next_batch())
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope or global_scope()
+        feed_names = sorted(feed)
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v)
+            for v in fetch_list
+        ]
+        _, compiled, plan = self._cache_entry(
+            program, feed_names, fetch_names)
+        block0 = program.desc.block(0)
+        feed_vals = plan.feed_values(feed, block0)
+        state_vals = plan.state_values(scope, block0)
+        rng = plan.rng_value(scope, program)
+        return compiled, feed_vals, state_vals, rng
+
+    def capture_program(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+    ):
+        """Static-analysis seam: resolve (program, feed, fetches) through
+        the SAME cache entry run() would use — TPU trace scope forced, so
+        the captured program is the CHIP program (keep-bf16 / NHWC auto
+        resolution included) — and return (compiled: CompiledBlock,
+        feed_vals, state_vals, rng) without executing anything.
+        paddle_tpu.analysis.capture_executor builds its artifact bundle
+        from this."""
+        with flags.tpu_trace_scope(True):
+            return self._resolve_entry(program, feed, fetch_list, scope)
 
     def tpu_lowering_check(
         self,

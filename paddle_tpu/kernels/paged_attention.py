@@ -63,6 +63,7 @@ from .flash_attention import NEG_INF, _on_tpu, flash_attention
 
 __all__ = [
     "attention_bytes_per_step",
+    "fallback_count",
     "gather_kv_pages",
     "paged_decode_attention",
     "pallas_paged_viable",
@@ -110,6 +111,28 @@ def pallas_paged_viable(page_size: int, head_dim: int,
 
 
 _fallback_noted = False
+# every out-of-envelope fallback resolution, counted (the one-time log
+# above is human-visible but was invisible to gates — serve_bench banks
+# {"paged_fallbacks": 0} and asserts no unexpected fallbacks)
+_fallback_total = 0
+
+
+def fallback_count() -> int:
+    """Process-wide count of resolve_paged_impl calls that fell back off
+    an explicit 'pallas' request (serving gates assert this stays 0 for
+    in-envelope pool geometries)."""
+    return _fallback_total
+
+
+def _record_fallback() -> None:
+    global _fallback_total
+    _fallback_total += 1
+    from .. import flags
+
+    if flags.flag("FLAGS_observability"):
+        from ..serving.metrics import record_fallback
+
+        record_fallback(kernel="paged_attention")
 
 
 def resolve_paged_impl(impl, page_size: int, head_dim: int,
@@ -128,9 +151,15 @@ def resolve_paged_impl(impl, page_size: int, head_dim: int,
         raise ValueError(
             f"paged-attention impl must be one of {_IMPLS}, got {impl!r}")
     if impl == "auto":
-        return ("pallas" if _on_tpu() and
-                pallas_paged_viable(page_size, head_dim, dtype)
-                else "reference")
+        if _on_tpu() and not pallas_paged_viable(page_size, head_dim,
+                                                 dtype):
+            # auto on a TPU host WANTED pallas; an out-of-envelope pool
+            # geometry silently degrading to the reference gather is the
+            # drift the fallback gate exists to catch (a CPU host's
+            # auto->reference is expected and stays uncounted)
+            _record_fallback()
+            return "reference"
+        return ("pallas" if _on_tpu() else "reference")
     if impl == "pallas" and not pallas_paged_viable(
             page_size, head_dim, dtype):
         if not _fallback_noted:
@@ -139,6 +168,7 @@ def resolve_paged_impl(impl, page_size: int, head_dim: int,
                 "pallas paged attention outside the Mosaic envelope "
                 "(page_size=%d head_dim=%d dtype=%s) — reference gather "
                 "fallback", page_size, head_dim, jnp.dtype(dtype).name)
+        _record_fallback()
         return "reference"
     return impl
 

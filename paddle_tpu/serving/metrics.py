@@ -25,6 +25,11 @@ Metrics:
                                                       bytes the decode
                                                       attention KV path
                                                       moves per step
+- paddle_tpu_serving_fallback_total         counter  {kernel=} kernel
+                                                      selections that fell
+                                                      back off the
+                                                      requested impl (CI
+                                                      gates assert zero)
 - paddle_tpu_serving_page_pool_used_pages   gauge    {pool=} pages in use
 - paddle_tpu_serving_page_pool_utilization  gauge    {pool=} used/total
 - paddle_tpu_serving_sequences_total        counter  {event=admitted|
@@ -59,6 +64,7 @@ __all__ = [
     "record_request_latency",
     "record_ttft",
     "record_token",
+    "record_fallback",
     "record_page_pool",
     "record_sequence",
     "record_breaker_trip",
@@ -143,6 +149,17 @@ def record_token(seconds: float, impl: str = "reference") -> None:
         "paddle_tpu_serving_token_seconds",
         "wall time per generated token (per sequence-step)",
     ).observe(seconds, impl=impl)
+
+
+def record_fallback(kernel: str) -> None:
+    """A kernel selection fell back off its requested implementation
+    (e.g. an explicit pallas paged-attention outside the Mosaic
+    envelope resolving to the reference gather).  The one-time log is
+    human-visible; this counter is what CI gates assert zero on."""
+    default_registry().counter(
+        "paddle_tpu_serving_fallback",
+        "kernel-selection fallbacks off the requested implementation",
+    ).inc(kernel=kernel)
 
 
 def record_attention_bytes(nbytes: int, impl: str) -> None:

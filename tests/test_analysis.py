@@ -1,0 +1,594 @@
+"""Chip-less program linter (paddle_tpu.analysis): detectors over jaxpr /
+TPU StableHLO / AOT v5e HLO, the known-bad regression corpus, and the
+model-zoo CI gate (tools/lint_programs.py).
+
+The corpus tests are the regression teeth: each corpus program re-creates
+a hazard class this repo actually shipped (the PR-1 lse/dvec broadcast,
+the ROADMAP relayout sandwich, ...) and the linter must flag it with the
+RIGHT detector id — so a detector that silently stops firing fails here,
+not on a chip three PRs later.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis import hlo as H
+from paddle_tpu.analysis.corpus import CORPUS, build_corpus_program
+from paddle_tpu.analysis.findings import Finding
+
+
+def _skip_if_no_topology():
+    try:
+        from paddle_tpu.core.aot_tpu import tpu_topology
+
+        tpu_topology()
+    except Exception as e:  # pragma: no cover - environment-dependent
+        pytest.skip(f"no chip-less TPU topology available: {e}")
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+def test_finding_severity_validated_and_json_stable():
+    f = Finding(detector="host-sync", severity="error", program="p",
+                message="m", bytes=3, where="w", fingerprint="abc")
+    d = f.as_dict()
+    assert d == {"detector": "host-sync", "severity": "error",
+                 "program": "p", "message": "m", "bytes": 3,
+                 "where": "w", "fingerprint": "abc"}
+    assert "host-sync" in f.format() and "ERROR" in f.format()
+    with pytest.raises(ValueError):
+        Finding(detector="x", severity="fatal", program="p", message="m")
+
+
+# ---------------------------------------------------------------------------
+# HLO / StableHLO text parsers
+
+
+_HLO_SNIPPET = """\
+HloModule jit_fn, entry_computation_layout={(f32[2,8,8,4]{3,0,2,1:T(8,128)}, f32[4]{0:T(256)})->(f32[2,8,8,4]{3,2,1,0:T(8,128)}, f32[]{:T(128)})}, input_output_alias={ {0}: (0, {}, may-alias) }
+
+ENTRY %main (p0: f32[2,8,8,4], p1: f32[4]) -> (f32[2,8,8,4], f32[]) {
+  %p0 = f32[2,8,8,4]{3,0,2,1:T(8,128)} parameter(0)
+  %p1 = f32[4]{0:T(256)} parameter(1)
+  %copy.1 = f32[2,8,8,4]{3,2,1,0:T(8,128)} copy(f32[2,8,8,4]{3,0,2,1:T(8,128)} %p0)
+  %cc = f32[2,8,8,4]{3,2,1,0:T(8,128)} custom-call(f32[2,8,8,4]{3,2,1,0:T(8,128)} %copy.1), custom_call_target="tpu_custom_call", metadata={op_name="x"}
+  %copy.2 = f32[2,8,8,4]{3,0,2,1:T(8,128)} copy(f32[2,8,8,4]{3,2,1,0:T(8,128)} %cc)
+  %copy.3 = f32[2,8,8,4]{3,2,1,0:T(8,128)} copy(f32[2,8,8,4]{3,0,2,1:T(8,128)} %copy.2)
+  %sum = f32[]{:T(128)} constant(0)
+  ROOT %tup = (f32[2,8,8,4]{3,2,1,0:T(8,128)}, f32[]{:T(128)}) tuple(%copy.3, %sum)
+}
+"""
+
+
+def test_hlo_parse_shapes_layouts_and_operands():
+    s = H.parse_shape("f32[2,56,56,64]{3,0,2,1:T(8,128)S(1)}")
+    assert (s.dtype, s.dims, s.perm) == ("f32", (2, 56, 56, 64), "3,0,2,1")
+    assert s.bytes == 2 * 56 * 56 * 64 * 4
+    assert H.parse_shape("bf16[8]").perm == ""
+    instrs = H.entry_instructions(_HLO_SNIPPET)
+    by = {i.name: i for i in instrs}
+    assert by["cc"].opcode == "custom-call"
+    assert by["cc"].operand_names == ["copy.1"]
+    # metadata attrs after the close paren must not contribute operands
+    assert "x" not in by["cc"].operand_names
+    assert by["copy.1"].operands[0][0].perm == "3,0,2,1"
+    assert by["tup"].is_root
+
+
+def test_hlo_parse_entry_layout_and_alias():
+    params, outs = H.parse_entry_layout(_HLO_SNIPPET)
+    assert [p.dims for p in params] == [(2, 8, 8, 4), (4,)]
+    assert [o.dims for o in outs] == [(2, 8, 8, 4), ()]
+    assert H.parse_input_output_alias(_HLO_SNIPPET) == {0: 0}
+    assert H.parse_input_output_alias("HloModule x") == {}
+
+
+def test_relayout_detector_on_synthetic_hlo():
+    """The copy-pair bracketing the pinned custom call is found on both
+    sides; the downstream same-destination copy.3 (a plain memory-space
+    move in real dumps) is not double-counted as draining the call."""
+    from paddle_tpu.analysis.capture import ProgramArtifacts
+    from paddle_tpu.analysis.detectors import detect_relayout_copies
+
+    art = ProgramArtifacts(name="synthetic", jaxpr=None, stablehlo="",
+                           hlo=_HLO_SNIPPET, cost={})
+    found = detect_relayout_copies(art)
+    wheres = sorted(f.where for f in found)
+    assert wheres == ["cc->copy.2", "copy.1->cc"]
+    assert all(f.detector == "relayout-copy-pair" for f in found)
+    assert all(f.bytes == 2 * 8 * 8 * 4 * 4 for f in found)
+
+
+# ---------------------------------------------------------------------------
+# the known-bad regression corpus: each program must trip its detector
+
+
+@pytest.mark.parametrize("name", sorted(CORPUS))
+def test_corpus_program_trips_its_detector(name):
+    _skip_if_no_topology()
+    builder, expected_detector = CORPUS[name]
+    art = build_corpus_program(name)
+    findings = analysis.run_detectors(art)
+    hit = [f for f in findings if f.detector == expected_detector]
+    assert hit, (
+        f"corpus program {name!r} must be flagged by {expected_detector}; "
+        f"got {[f.detector for f in findings]}")
+    assert all(f.program == art.name and f.fingerprint == art.fingerprint
+               for f in hit)
+
+
+def test_corpus_broadcast_lse_reports_materialized_bytes():
+    """The PR-1 bug class: the [512] lse vector broadcast to [512,128]
+    as a custom-call operand is charged at its full materialized size."""
+    _skip_if_no_topology()
+    art = build_corpus_program("broadcast_lse")
+    hit = [f for f in analysis.run_detectors(art)
+           if f.detector == "broadcast-operand"]
+    assert hit[0].bytes == 512 * 128 * 4
+    assert hit[0].severity == "error"
+
+
+def test_corpus_missed_donation_sized_and_donated_arm_clean():
+    """The un-donated state shows one finding per eligible buffer at the
+    buffer's byte size; actually donating the same state clears them."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+
+    art = build_corpus_program("missed_donation")
+    hit = [f for f in analysis.run_detectors(art)
+           if f.detector == "missed-donation"]
+    assert len(hit) == 3  # three eligible state buffers, none aliased
+    assert all(f.bytes == 256 * 256 * 4 for f in hit)
+
+    def fn(state, x):
+        return [s + x for s in state], jnp.sum(x)
+
+    a = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    donated = capture_fn(fn, [a, a, a], a, donate_argnums=(0,),
+                         name="donated")
+    assert not [f for f in analysis.run_detectors(donated)
+                if f.detector == "missed-donation"]
+
+
+def test_master_weight_update_idiom_not_flagged():
+    """AMP master weights: a bf16 grad cast to f32 to update f32 params
+    joins an equally-sized already-f32 tensor — the f32 write-back is the
+    params' own dtype, not a promotion leak (the resnet50_train zoo
+    program relies on this staying clean)."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+
+    def step(p, v, g_bf16):
+        g = g_bf16.astype(jnp.float32)
+        v2 = 0.9 * v + g
+        return p - 0.1 * v2, v2
+
+    f32 = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+    bf = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+    art = capture_fn(step, f32, f32, bf, name="master_weight")
+    assert not [f for f in analysis.run_detectors(art)
+                if f.detector == "dtype-promotion"]
+
+
+def test_missed_donation_indices_survive_unused_arg():
+    """jit would normally PRUNE an unused arg from the executable's
+    entry parameters, shifting every index the analyzer computed from
+    the python signature (trace_tpu pins them with keep_unused).  The
+    detector must anchor the findings on the state leaves, not drift
+    onto the feed."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+
+    def fn(unused, state, x):
+        return [s + x for s in state], jnp.sum(x)
+
+    u = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    a = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    art = capture_fn(fn, u, [a, a], a, donatable_argnums=(1,),
+                     name="unused_arg")
+    hit = [f for f in analysis.run_detectors(art)
+           if f.detector == "missed-donation"]
+    assert len(hit) == 2  # both state leaves, nothing anchored elsewhere
+    assert all(f.bytes == 256 * 256 * 4 for f in hit)
+    assert {f.where.split(" ")[1] for f in hit} == {"1", "2"}
+
+
+def test_own_kernels_clean_of_corpus_bug_classes():
+    """The tentpole's 'asserted dead in our own kernels' clause: the
+    flash-attention and paged-decode custom calls must show zero
+    broadcast-materialized operands and zero relayout copy-pairs."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.kernels.paged_attention import paged_decode_attention
+
+    B, H_, S, D = 2, 4, 256, 128
+    qkv = jax.ShapeDtypeStruct((B, H_, S, D), jnp.float32)
+    art = capture_fn(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                     qkv, qkv, qkv, name="flash_fwd")
+    bad = [f for f in analysis.run_detectors(art)
+           if f.detector in ("broadcast-operand", "relayout-copy-pair")]
+    assert not bad, [f.format() for f in bad]
+
+    ps, maxp = 16, 8
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, H_, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((H_, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    art = capture_fn(
+        lambda q, k, v, t, l: paged_decode_attention(
+            q, k, v, t, l, impl="pallas"),
+        q, kp, kp, tb, ln, name="paged")
+    bad = [f for f in analysis.run_detectors(art)
+           if f.detector in ("broadcast-operand", "relayout-copy-pair")]
+    assert not bad, [f.format() for f in bad]
+
+
+def test_corpus_host_callback_counted_once():
+    """One pure_callback is ONE hazard: the jaxpr prim scan and the
+    StableHLO custom-call marker scan must not both report the same
+    callback — a double count would bank 2x and make the gate's
+    new-finding comparison jax-version-sensitive."""
+    _skip_if_no_topology()
+    art = build_corpus_program("host_callback")
+    hit = [f for f in analysis.run_detectors(art)
+           if f.detector == "host-sync"]
+    assert len(hit) == 1
+    assert hit[0].where == "pure_callback"
+
+
+def test_capture_time_hazards_python_scalar_feed_and_unhashable_key(
+        monkeypatch):
+    from paddle_tpu import flags as fl
+    from paddle_tpu.analysis.capture import _capture_time_hazards
+
+    hz = _capture_time_hazards("p", {"lr": 0.1, "x": np.zeros(3)}, "fp")
+    assert [f.where for f in hz] == ["feed:lr"]
+    assert hz[0].detector == "recompile-hazard"
+    monkeypatch.setattr(fl, "trace_key", lambda: ["not", "hashable"])
+    hz = _capture_time_hazards("p", {}, "fp")
+    assert [f.where for f in hz] == ["flags.trace_key"]
+    assert hz[0].severity == "error"
+
+
+def test_capture_executor_unhashable_key_reports_not_crashes(monkeypatch):
+    """The executor's own cache lookup hashes flags.trace_key() before
+    anything else — a non-hashable key must come back as the
+    recompile-hazard finding the detector advertises, not a TypeError."""
+    _skip_if_no_topology()
+    import paddle_tpu as fluid
+    from paddle_tpu import flags as fl, layers
+
+    fluid.reset_default_env()
+    x = layers.data("x", [8, 8], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=4))
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    monkeypatch.setattr(fl, "trace_key", lambda: ["not", "hashable"])
+    art = analysis.capture_executor(
+        exe, feed={"x": np.zeros((2, 8, 8), "float32")},
+        fetch_list=[loss], name="unhashable")
+    assert art.compile_error  # nothing was compiled
+    findings = analysis.run_detectors(art)
+    assert any(f.detector == "recompile-hazard"
+               and f.where == "flags.trace_key" for f in findings)
+
+
+def test_capture_executor_current_tree_is_clean():
+    """The executor seam: the exact chip program a small train step runs
+    (same cache entry, state donation included) lints clean — donation is
+    realized, no weak types, no host syncs."""
+    _skip_if_no_topology()
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    fluid.reset_default_env()
+    x = layers.data("x", [16, 16], dtype="float32")
+    loss = layers.mean(layers.fc(x, size=8))
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    art = analysis.capture_executor(
+        exe, feed={"x": np.zeros((4, 16, 16), "float32")},
+        fetch_list=[loss], name="fc_train")
+    assert art.hlo and art.bytes_per_step > 0
+    assert art.compile_error == ""
+    findings = analysis.run_detectors(art)
+    assert not findings, [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# gate logic (pure — fabricated results, no compiles)
+
+
+def _zr(name, counts, bytes_per_step, flops=0.0):
+    from paddle_tpu.analysis.capture import ProgramArtifacts
+    from paddle_tpu.analysis.zoo import ZooResult
+
+    art = ProgramArtifacts(name=name, jaxpr=None, stablehlo="", hlo="",
+                           cost={}, fingerprint="f" * 12)
+    findings = [
+        Finding(detector=det, severity="warning", program=name, message="x")
+        for det, n in counts.items() for _ in range(n)
+    ]
+    return ZooResult(name=name, artifacts=art, findings=findings,
+                     bytes_per_step=bytes_per_step, flops_per_step=flops)
+
+
+def _bank_doc(tmp_path, programs, tolerance=0.02):
+    p = tmp_path / "base.json"
+    p.write_text(json.dumps(
+        {"tolerance": tolerance, "programs": programs}))
+    return str(p)
+
+
+def test_gate_new_finding_fails(tmp_path):
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {}, "bytes_per_step": 100.0}})
+    verdicts, failed = analysis.gate(
+        [_zr("a", {"host-sync": 1}, 100.0)], base)
+    assert failed
+    assert any(v["metric"] == "a_findings[host-sync]"
+               and v["verdict"] == "fail" for v in verdicts)
+
+
+def test_gate_bytes_regression_fails_and_within_tol_passes(tmp_path):
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {}, "bytes_per_step": 100.0}})
+    _, failed = analysis.gate([_zr("a", {}, 101.0)], base)
+    assert not failed  # +1% within the 2% tolerance
+    verdicts, failed = analysis.gate([_zr("a", {}, 110.0)], base)
+    assert failed
+    assert any("bytes_per_step" in v["metric"] and v["verdict"] == "fail"
+               for v in verdicts)
+
+
+def test_gate_unbanked_program_fails_and_fewer_findings_pass(tmp_path):
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {"host-sync": 2}, "bytes_per_step": 100.0}})
+    verdicts, failed = analysis.gate(
+        [_zr("a", {"host-sync": 1}, 100.0), _zr("new", {}, 1.0)], base)
+    assert failed  # 'new' has no banked entry
+    assert any(v["metric"] == "new_findings" and v["verdict"] == "fail"
+               for v in verdicts)
+    # strictly-fewer findings is a pass that nudges a re-bank
+    better = [v for v in verdicts if v["metric"] == "a_findings[host-sync]"]
+    assert better and better[0]["verdict"] == "pass"
+    assert "re-bank" in better[0]["reason"]
+
+
+def test_gate_fails_and_bank_refuses_on_compile_error(tmp_path):
+    """A program the v5e pipeline rejects analyzed NOTHING HLO-side —
+    bytes collapse to 0, which lower-is-better would wave through.  The
+    gate must fail it and bank must refuse to freeze it."""
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {}, "bytes_per_step": 100.0}})
+    r = _zr("a", {}, 0.0)
+    r.artifacts.compile_error = "Mosaic rejected the kernel"
+    verdicts, failed = analysis.gate([r], base)
+    assert failed
+    assert any(v["metric"] == "a_compile" and v["verdict"] == "fail"
+               for v in verdicts)
+    with pytest.raises(ValueError, match="compile failed"):
+        analysis.bank([r], str(tmp_path / "out.json"))
+
+
+def test_run_zoo_validates_detector_names_before_capturing():
+    with pytest.raises(KeyError, match="unknown detector"):
+        analysis.run_zoo(["paged_decode"], detectors=["host-synk"])
+
+
+def test_gate_require_all_fails_on_vanished_banked_program(tmp_path):
+    """Deleting/renaming a zoo entry must not silently shrink CI
+    coverage: an unfiltered run gates banked-but-not-run programs."""
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {}, "bytes_per_step": 100.0},
+        "b": {"findings": {}, "bytes_per_step": 50.0}})
+    results = [_zr("a", {}, 100.0)]
+    _, failed = analysis.gate(results, base)  # filtered run: fine
+    assert not failed
+    verdicts, failed = analysis.gate(results, base, require_all=True)
+    assert failed
+    assert any(v["metric"] == "b_coverage" and v["verdict"] == "fail"
+               for v in verdicts)
+
+
+def test_zoo_builder_sandbox_preserves_caller_env():
+    """run_zoo is public API: building a zoo model must not clobber the
+    caller's default program, scope, or name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis.zoo import _fresh_env
+
+    fluid.reset_default_env()
+    fluid.layers.data("keepme", [4], dtype="float32")
+    main_before = fluid.default_main_program()
+    scope_before = fluid.global_scope()
+    with _fresh_env() as fl:
+        assert fl.default_main_program() is not main_before
+        assert fl.global_scope() is not scope_before
+        fl.layers.data("inner", [2], dtype="float32")
+    assert fluid.default_main_program() is main_before
+    assert fluid.global_scope() is scope_before
+    names = list(main_before.desc.block(0).vars)
+    assert "keepme" in names and "inner" not in names
+
+
+def test_gate_injected_corpus_programs_each_fail(tmp_path):
+    """ISSUE acceptance: every known-bad corpus program splices into a
+    zoo run as an UNBANKED program carrying findings — the gate must fail
+    for each one."""
+    base = _bank_doc(tmp_path, {
+        "a": {"findings": {}, "bytes_per_step": 100.0}})
+    clean = _zr("a", {}, 100.0)
+    for name, (_, det) in sorted(CORPUS.items()):
+        bad = _zr(f"corpus_{name}", {det: 1}, 5.0)
+        _, failed = analysis.gate([clean, bad], base)
+        assert failed, f"gate must trip on injected corpus {name!r}"
+
+
+# ---------------------------------------------------------------------------
+# the CLI end-to-end (cheapest zoo program only; the full-zoo gate runs
+# as tools/lint_programs.py --gate in CI and in the slow tier below)
+
+
+def _lint_main(argv):
+    sys.path.insert(0, os.path.abspath(REPO))
+    try:
+        from tools.lint_programs import main
+
+        return main(argv)
+    finally:
+        sys.path.pop(0)
+
+
+def test_lint_cli_list_and_bank_refusal(tmp_path, capsys):
+    assert _lint_main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "paged_decode" in out and "broadcast_lse" in out
+    # banking a filtered or injected run must refuse (exit 2), not
+    # silently narrow the baseline — a --detectors subset would bank
+    # under-counted findings that the next full run reports as "new"
+    assert _lint_main(["--programs", "paged_decode", "--bank",
+                       "--baseline", str(tmp_path / "b.json")]) == 2
+    assert _lint_main(["--detectors", "host-sync", "--bank",
+                       "--baseline", str(tmp_path / "b.json")]) == 2
+    # --gate with a detector subset would let the OTHER detectors'
+    # regressions gate green — refuse, same as --bank
+    assert _lint_main(["--detectors", "host-sync", "--gate"]) == 2
+    capsys.readouterr()
+
+
+def test_lint_cli_gate_round_trip_and_regression(tmp_path, capsys):
+    """bank -> re-gate passes; injected corpus program exits 3; a banked
+    baseline with smaller bytes/step (i.e. the tree regressed) exits 3."""
+    _skip_if_no_topology()
+    base = str(tmp_path / "zoo.json")
+    rc = _lint_main(["--programs", "paged_decode", "--json",
+                     str(tmp_path / "r.json")])
+    assert rc == 0
+    run = json.loads((tmp_path / "r.json").read_text())
+    prog = run["programs"]["paged_decode"]
+    assert prog["finding_counts"] == {}  # current tree lints clean
+    assert prog["bytes_per_step"] > 0
+
+    doc = {"tolerance": 0.02, "programs": {"paged_decode": {
+        "findings": {}, "bytes_per_step": prog["bytes_per_step"],
+        "flops_per_step": prog["flops_per_step"]}}}
+    (tmp_path / "zoo.json").write_text(json.dumps(doc))
+    assert _lint_main(["--programs", "paged_decode",
+                       "--baseline", base, "--gate"]) == 0
+
+    # an injected known-bad program trips the gate end-to-end
+    assert _lint_main(["--programs", "paged_decode", "--inject",
+                       "weak_type", "--baseline", base, "--gate"]) == 3
+
+    # a bytes/step rise past tolerance trips the gate
+    doc["programs"]["paged_decode"]["bytes_per_step"] = (
+        prog["bytes_per_step"] * 0.5)
+    (tmp_path / "zoo.json").write_text(json.dumps(doc))
+    assert _lint_main(["--programs", "paged_decode",
+                       "--baseline", base, "--gate"]) == 3
+    capsys.readouterr()
+
+
+def test_lint_cli_gate_missing_baseline_is_usage_error(tmp_path, capsys):
+    _skip_if_no_topology()
+    rc = _lint_main(["--programs", "paged_decode", "--gate",
+                     "--baseline", str(tmp_path / "nope.json")])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_ci_gate_exit_code_contract_shared_with_serve_bench(
+        tmp_path, capsys):
+    """README 'CI gates': all three gate tools exit 2 on usage errors
+    (not 0, not a traceback) so CI wiring can tell 'gate broken' from
+    'tree regressed' (exit 3)."""
+    sys.path.insert(0, os.path.abspath(REPO))
+    try:
+        from tools.obsdump import main as obsdump_main
+        from tools.serve_bench import main as bench_main
+    finally:
+        sys.path.pop(0)
+    assert bench_main(["--gate"]) == 2  # --gate without --baseline
+    assert bench_main(["--baseline", str(tmp_path / "nope.json")]) == 2
+    assert obsdump_main([str(tmp_path), "--baseline",
+                         str(tmp_path / "nope.json"), "--gate"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# the full zoo vs the committed baseline (the per-PR CI gate itself):
+# resnet50+transformer AOT compiles make this the one heavy test here
+
+
+@pytest.mark.slow
+def test_full_zoo_gate_green_against_committed_baseline(capsys):
+    _skip_if_no_topology()
+    rc = _lint_main(["--gate"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# satellite: resolve_paged_impl fallbacks are counted, not just logged
+
+
+def test_paged_fallback_counted_and_metered(monkeypatch):
+    from paddle_tpu import flags as fl
+    from paddle_tpu import observability as obs
+    from paddle_tpu.kernels import paged_attention as pa
+
+    before = pa.fallback_count()
+    # in-envelope explicit pallas resolves without counting
+    assert pa.resolve_paged_impl("interpret", 16, 128, jnp.float32) \
+        == "interpret"
+    assert pa.fallback_count() == before
+    # a CPU host's auto->reference is expected, not a fallback
+    assert pa.resolve_paged_impl("auto", 16, 128, jnp.float32) \
+        == "reference"
+    assert pa.fallback_count() == before
+    # auto on a TPU host wanted pallas: out-of-envelope degradation to
+    # the reference gather must count (in-envelope must not)
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    assert pa.resolve_paged_impl("auto", 16, 96, jnp.float32) \
+        == "reference"
+    assert pa.fallback_count() == before + 1
+    assert pa.resolve_paged_impl("auto", 16, 128, jnp.float32) == "pallas"
+    assert pa.fallback_count() == before + 1
+    monkeypatch.setattr(pa, "_on_tpu", lambda: False)
+    before = pa.fallback_count()
+    # out-of-envelope explicit pallas falls back AND counts
+    assert pa.resolve_paged_impl("pallas", 16, 96, jnp.float32) \
+        == "reference"
+    assert pa.fallback_count() == before + 1
+    # with observability on, the labeled counter records it too
+    obs.default_registry().reset()
+    old = fl.flag("FLAGS_observability")
+    fl.set_flags({"FLAGS_observability": True})
+    try:
+        pa.resolve_paged_impl("pallas", 16, 96, jnp.float32)
+        snap = obs.default_registry().snapshot()["metrics"]
+        fb = [m for m in snap
+              if m["name"] == "paddle_tpu_serving_fallback"]
+        assert fb and fb[0]["series"][0]["labels"] == {
+            "kernel": "paged_attention"}
+        assert fb[0]["series"][0]["value"] == 1
+    finally:
+        fl.set_flags({"FLAGS_observability": old})
+        obs.default_registry().reset()
+    assert pa.fallback_count() == before + 2

@@ -16,7 +16,9 @@ Usage:
 
 --baseline re-gates the run's results against a banked bench artifact (a
 previous bench.py JSON line or a plain {metric: value} mapping), printing
-pass/fail deltas; --gate makes a fail verdict exit nonzero (CI wiring).
+pass/fail deltas.  Exit codes follow the shared CI-gate contract with
+tools/lint_programs.py and tools/serve_bench.py (README "CI gates"):
+0 clean · 2 usage/environment error · 3 when --gate finds a regression.
 """
 
 from __future__ import annotations
@@ -149,6 +151,9 @@ def main(argv=None) -> int:
         _print_metrics(reg, out)
 
     verdicts = report.get("regression") or []
+    if args.baseline and not os.path.exists(args.baseline):
+        sys.stderr.write(f"obsdump: baseline {args.baseline} missing\n")
+        return 2
     if args.baseline:
         from paddle_tpu.observability import gate_results
 

@@ -25,6 +25,7 @@ MODULES = [
     "paddle_tpu.clip",
     "paddle_tpu.metrics",
     "paddle_tpu.observability",
+    "paddle_tpu.analysis",
     "paddle_tpu.profiler",
     "paddle_tpu.timeline",
     "paddle_tpu.flags",

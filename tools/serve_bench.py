@@ -22,9 +22,12 @@ a batching server — latency percentiles, throughput, and batch occupancy
   so a reference-vs-pallas A/B rides the --baseline/--gate machinery
   like any other regression check.
 
-Gating mirrors tools/obsdump.py: --baseline BANKED.json re-checks this
-run against a banked artifact ({metric: value}; lower_is_better inferred
-from the metric name), --gate exits 3 on any fail — CI wiring.
+Gating mirrors tools/obsdump.py and tools/lint_programs.py — the shared
+CI-gate exit-code contract (README "CI gates"): --baseline BANKED.json
+re-checks this run against a banked artifact ({metric: value};
+lower_is_better inferred from the metric name); exit 0 clean, 2 on
+usage/environment errors (missing baseline file, --gate without
+--baseline, unknown model), 3 when --gate finds a regression.
 
   --chaos arms the FAULT_SERVE_* knobs (resilience/faultinject.py)
   MID-RUN and reports how the serving tier recovered: engine mode arms a
@@ -92,7 +95,8 @@ def _build_artifact(model: str, out_dir: str):
         img_name = "image"
         shape = (1, 8, 8)
     else:
-        raise SystemExit(f"unknown --model {model!r} (mnist|tiny)")
+        sys.stderr.write(f"unknown --model {model!r} (mnist|tiny)\n")
+        raise SystemExit(2)
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
@@ -248,6 +252,9 @@ def run_decode_bench(args) -> dict:
             prompt=rng.randint(1, cfg.vocab_size, size=plen).tolist(),
             max_new_tokens=args.max_new))
     chaos = bool(args.chaos)
+    from paddle_tpu.kernels.paged_attention import fallback_count
+
+    fallbacks_before = fallback_count()
     loop = serving.ContinuousBatchingLoop(
         params, cfg, pool, max_batch=args.max_batch,
         paged_impl=args.paged_impl, prefill=args.prefill,
@@ -290,6 +297,10 @@ def run_decode_bench(args) -> dict:
         "pages_high_water": st["used_pages_high_water"],
         "page_allocs": st["page_allocs"],
         "pages_leaked": st["used_pages"],  # must be 0 after a full run
+        # resolve_paged_impl fallbacks during the run: bank 0 so a pool
+        # geometry drifting out of the Mosaic envelope fails the gate
+        # instead of silently running the reference gather
+        "paged_fallbacks": fallback_count() - fallbacks_before,
     }
     if chaos:
         result.update({
@@ -381,6 +392,17 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", action="store_true",
                     help="exit 3 when a baseline verdict fails")
     args = ap.parse_args(argv)
+
+    # shared CI-gate contract (README "CI gates"): usage/environment
+    # errors exit 2 so wiring can tell "gate broken" from "regressed"
+    if args.gate and not args.baseline:
+        sys.stderr.write(
+            "serve_bench: --gate needs --baseline BANKED.json\n")
+        return 2
+    if args.baseline and not os.path.exists(args.baseline):
+        sys.stderr.write(
+            f"serve_bench: baseline {args.baseline} missing\n")
+        return 2
 
     result = (run_engine_bench(args) if args.mode == "engine"
               else run_decode_bench(args))
