@@ -58,6 +58,21 @@ _DEFS: Dict[str, Any] = {
     # AOT topology tier (core/aot_tpu.py — minutes for big models, the
     # relay-free conv-epilogue measurement loop), "off" skips costing
     "FLAGS_observability_cost": "off",
+    # request-scoped tracing (observability/requesttrace.py): hard
+    # per-run cap on how many requests keep FULL span detail in the
+    # merged trace.  Tail-based sampling keeps slow (>= rolling p99),
+    # errored, shed, timed-out, and quarantined requests; everything
+    # else contributes only to metrics.  Once the budget is spent even
+    # keep-worthy requests are dropped (counted on
+    # paddle_tpu_request_traces{decision="budget_dropped"}) — a
+    # long-lived server must not grow host memory one span tree per
+    # slow request forever
+    "FLAGS_request_trace_budget": 256,
+    # flight-recorder dump directory (observability/flight.py): where
+    # the black-box JSONL lands when the serving circuit breaker trips
+    # or engine.health() enters BROKEN.  "" (default) resolves to
+    # <tempdir>/paddle_tpu_flight
+    "FLAGS_flight_dir": "",
     # determinism
     "FLAGS_cpu_deterministic": False,
     # accepted for reference-script compatibility; memory/threads are

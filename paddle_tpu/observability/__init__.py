@@ -17,6 +17,17 @@ was a chrome-trace stub with no hot-path consumers.  Now:
 - **Step stats** (`stepstats.py`): ring buffer of Executor.run wall
   times with rolling p50/p99, plus the BENCH_BASELINE regression gate
   bench.py uses to emit pass/fail deltas.
+- **Request traces** (`requesttrace.py`): per-request trace ids minted
+  at Engine.submit(), cross-thread span trees (submit thread ->
+  dispatcher -> completion) folded into the same merged trace, kept by
+  TAIL-based sampling — slow (>= rolling p99), errored, shed, timed-out
+  and quarantined requests keep full detail under
+  FLAGS_request_trace_budget.  Latency/TTFT histograms carry
+  OpenMetrics exemplars referencing kept trace ids.
+- **Flight recorder** (`flight.py`): bounded ring of structured serving
+  lifecycle events that auto-dumps JSONL (FLAGS_flight_dir) when the
+  circuit breaker trips or engine health enters BROKEN — the black box
+  every chaos failure leaves behind.
 
 Everything is gated on **FLAGS_observability** (env `FLAGS_observability=1`
 or `fluid.set_flags({"FLAGS_observability": True})`).  Disabled, every
@@ -42,12 +53,23 @@ import time
 from typing import List, Optional
 
 from .. import flags as _flags
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    default_flight,
+    flight_dir,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     default_registry,
+)
+from .requesttrace import (  # noqa: F401
+    RequestTrace,
+    RequestTracer,
+    default_request_tracer,
+    mint_trace_id,
 )
 from .stepstats import (  # noqa: F401
     StepStats,
@@ -65,10 +87,17 @@ from .tracing import (  # noqa: F401
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "RequestTrace",
+    "RequestTracer",
+    "default_flight",
     "default_registry",
+    "default_request_tracer",
+    "flight_dir",
+    "mint_trace_id",
     "StepStats",
     "Span",
     "Tracer",
@@ -114,10 +143,12 @@ def step_stats() -> StepStats:
 
 
 def reset() -> None:
-    """Clear the default registry, tracer, and step stats (fresh run in
-    the same process; tests)."""
+    """Clear the default registry, tracer, request tracer, flight
+    recorder, and step stats (fresh run in the same process; tests)."""
     default_registry().reset()
     default_tracer().clear()
+    default_request_tracer().reset()
+    default_flight().reset()
     _step_stats.reset()
 
 
@@ -284,7 +315,9 @@ def export_run(dirname: str, results: Optional[List[dict]] = None,
         pass
     sfx = "" if pid == 0 else f"_{pid}"
     with open(os.path.join(dirname, f"metrics{sfx}.prom"), "w") as f:
-        f.write(reg.to_prometheus())
+        # OpenMetrics flavor: classic sample lines plus histogram
+        # exemplars, so the p99 bucket links to its trace_id
+        f.write(reg.to_openmetrics())
     reg.dump(os.path.join(dirname, f"metrics{sfx}.json"))
     n_spans = write_chrome_trace(
         os.path.join(dirname, f"trace{sfx}.json"), merged_spans(), pid=pid)
@@ -293,6 +326,8 @@ def export_run(dirname: str, results: Optional[List[dict]] = None,
         "wall_time": time.time(),
         "step_time": _step_stats.summary(),
         "span_count": n_spans,
+        "request_traces": default_request_tracer().stats(),
+        "flight_dumps": list(default_flight().dump_paths),
     }
     if results:
         report["results"] = results

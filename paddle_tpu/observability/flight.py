@@ -1,0 +1,143 @@
+"""Crash flight recorder: a fixed-size ring of structured serving events
+that auto-dumps to JSONL when something breaks.
+
+Metrics answer "how many"; the merged trace answers "how long"; neither
+answers "what was the engine DOING in the two seconds before the breaker
+tripped".  The flight recorder does: every serving lifecycle event
+(submit/reject/dispatch/batch-fail/breaker transition/health change/
+quarantine/page-reclaim) lands in a bounded ring — one lock, one dict,
+one deque append per event, nothing touches the filesystem on the hot
+path — and when the circuit breaker trips or ``engine.health()`` enters
+BROKEN the engine dumps the last N events as a JSONL artifact.  Every
+chaos failure then has a black box: the dump is the post-incident
+forensic record tests and operators read, not a log grep.
+
+Dump format (one JSON object per line):
+
+    {"version": 1, "reason": "breaker_trip", "dumped_at": ..., "pid": ...,
+     "events": 37, "dropped": 0}          <- header line
+    {"seq": 1, "t": <wall>, "mono": <perf_counter>, "thread": "MainThread",
+     "kind": "submit", "engine": "e", "trace_id": "...", ...}
+    ...
+
+Events carry the request's ``trace_id`` where one exists, so a flight
+dump cross-references the merged Perfetto trace and the metric
+exemplars.  Dumps land under ``FLAGS_flight_dir`` (default:
+``<tempdir>/paddle_tpu_flight``).
+
+Recording is the caller's responsibility to gate on FLAGS_observability
+(the established serving pattern: the disabled hot path never enters
+this module).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import flags as _flags
+
+__all__ = ["FlightRecorder", "default_flight", "flight_dir"]
+
+
+def flight_dir() -> str:
+    """Resolved dump directory: FLAGS_flight_dir, or the tempdir
+    fallback when unset."""
+    d = _flags._VALUES["FLAGS_flight_dir"]
+    return d or os.path.join(tempfile.gettempdir(), "paddle_tpu_flight")
+
+
+class FlightRecorder:
+    """Bounded event ring + JSONL dumper.
+
+    ``record()`` is the hot call: one lock acquisition, one dict build,
+    one deque append — the ring evicts oldest-first at capacity (an
+    incident needs the LAST N events, not the first).  ``dump()`` is
+    the cold path: it snapshots the ring under the lock and writes the
+    artifact outside it."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=int(capacity))
+        self._seq = 0
+        self._dump_idx = 0
+        self.dropped = 0
+        self.dump_paths: List[str] = []
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured event (kind + arbitrary JSON-able
+        fields).  Callers gate on FLAGS_observability."""
+        evt = {
+            "t": time.time(),
+            "mono": time.perf_counter(),
+            "thread": threading.current_thread().name,
+            "kind": kind,
+        }
+        evt.update(fields)
+        with self._lock:
+            self._seq += 1
+            evt["seq"] = self._seq
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(evt)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, reason: str, dirname: Optional[str] = None) -> str:
+        """Write the ring as a JSONL artifact (header line + one line
+        per event, oldest first); returns the path.  Called by the
+        engine on breaker trips and BROKEN health transitions — and by
+        anything else that wants a black box of the last N events."""
+        dirname = dirname or flight_dir()
+        os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+            self._dump_idx += 1
+            n_dump = self._dump_idx
+        path = os.path.join(
+            dirname,
+            f"flight_{os.getpid()}_{n_dump:03d}_{reason}.jsonl")
+        header = {
+            "version": 1,
+            "reason": reason,
+            "dumped_at": time.time(),
+            "pid": os.getpid(),
+            "events": len(events),
+            "dropped": dropped,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for evt in events:
+                f.write(json.dumps(evt) + "\n")
+        os.replace(tmp, path)
+        with self._lock:
+            self.dump_paths.append(path)
+        return path
+
+    def reset(self) -> None:
+        """Drop buffered events and forget dump paths (files stay on
+        disk; a fresh run in the same process starts a clean ring)."""
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self._dump_idx = 0
+            self.dropped = 0
+            self.dump_paths = []
+
+
+_default = FlightRecorder()
+
+
+def default_flight() -> FlightRecorder:
+    """The process-wide recorder the serving tier records into."""
+    return _default

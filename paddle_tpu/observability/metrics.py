@@ -65,8 +65,18 @@ def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _escape_value(v: str) -> str:
+    """Escape a label VALUE per the Prometheus text-format spec:
+    backslash, double-quote, and newline.  Trace-id and error-class
+    labels flow through here — an unescaped quote in an error message
+    would corrupt every sample after it on a scrape."""
+    return (v.replace("\\", "\\\\")
+             .replace('"', '\\"')
+             .replace("\n", "\\n"))
+
+
 def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -195,7 +205,7 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "sum", "count", "min", "max")
+    __slots__ = ("counts", "sum", "count", "min", "max", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
@@ -203,6 +213,9 @@ class _HistSeries:
         self.count = 0
         self.min = math.inf
         self.max = -math.inf
+        # per-bucket OpenMetrics exemplars, allocated lazily on first
+        # exemplar-carrying observation: [{labels, value, ts} | None]
+        self.exemplars = None
 
 
 class Histogram(_Metric):
@@ -217,7 +230,14 @@ class Histogram(_Metric):
         self.buckets: Tuple[float, ...] = bs
         self._n = len(bs) + 1  # +Inf bucket
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[dict] = None,
+                **labels) -> None:
+        """Record one observation.  `exemplar` optionally attaches
+        OpenMetrics exemplar labels (e.g. {"trace_id": ...}) to the
+        bucket this value lands in — last writer wins per bucket, so
+        the p99 bucket always links to a RECENT trace that put a sample
+        there (`to_openmetrics()` renders them; the classic
+        `to_prometheus()` exposition ignores them)."""
         if not _on():
             return
         value = float(value)
@@ -234,6 +254,14 @@ class Histogram(_Metric):
                 s.min = value
             if value > s.max:
                 s.max = value
+            if exemplar:
+                if s.exemplars is None:
+                    s.exemplars = [None] * self._n
+                s.exemplars[idx] = {
+                    "labels": {k: str(v) for k, v in exemplar.items()},
+                    "value": value,
+                    "ts": time.time(),
+                }
 
     def series_summary(self, **labels) -> Optional[dict]:
         with self._lock:
@@ -243,13 +271,19 @@ class Histogram(_Metric):
             return self._summarize(s)
 
     def _summarize(self, s: _HistSeries) -> dict:
-        return {
+        out = {
             "count": s.count, "sum": s.sum,
             "min": None if s.count == 0 else s.min,
             "max": None if s.count == 0 else s.max,
             "buckets": [[le, c] for le, c in
                         zip(list(self.buckets) + ["+Inf"], s.counts)],
         }
+        if s.exemplars is not None:
+            # process-local debugging aid: merge()/aggregate_dir ignore
+            # them (a cross-process "last exemplar" has no meaning)
+            out["exemplars"] = [
+                None if e is None else dict(e) for e in s.exemplars]
+        return out
 
     def _snapshot_series(self) -> List[dict]:
         return [dict(labels=dict(k), **self._summarize(s))
@@ -279,18 +313,27 @@ class Histogram(_Metric):
                 if rec.get("max") is not None:
                     s.max = max(s.max, float(rec["max"]))
 
-    def _prom(self, out: List[str]) -> None:
+    def _prom(self, out: List[str], exemplars: bool = False) -> None:
         with self._lock:
             items = [(k, self._summarize(s))
                      for k, s in sorted(self._series.items())]
         for key, s in items:
             cum = 0
-            for le, c in s["buckets"]:
+            ex = s.get("exemplars") if exemplars else None
+            for i, (le, c) in enumerate(s["buckets"]):
                 cum += c
                 le_s = "+Inf" if le == "+Inf" else _num(le)
                 extra = 'le="%s"' % le_s
-                out.append(
-                    f"{self.name}_bucket{_fmt_labels(key, extra)} {cum}")
+                line = f"{self.name}_bucket{_fmt_labels(key, extra)} {cum}"
+                e = ex[i] if ex else None
+                if e is not None:
+                    # OpenMetrics exemplar: `# {labels} value timestamp`
+                    elab = ",".join(
+                        f'{k}="{_escape_value(v)}"'
+                        for k, v in sorted(e["labels"].items()))
+                    line += (f" # {{{elab}}} {_num(e['value'])} "
+                             f"{e['ts']:.3f}")
+                out.append(line)
             out.append(f"{self.name}_sum{_fmt_labels(key)} {_num(s['sum'])}")
             out.append(f"{self.name}_count{_fmt_labels(key)} {s['count']}")
 
@@ -364,6 +407,25 @@ class MetricsRegistry:
             out.append(f"# TYPE {m._prom_name()} {m.kind}")
             m._prom(out)
         return "\n".join(out) + ("\n" if out else "")
+
+    def to_openmetrics(self) -> str:
+        """OpenMetrics text exposition: same sample lines as
+        `to_prometheus()` but with metric-family names on the TYPE/HELP
+        lines (`steps` not `steps_total`), histogram-bucket exemplars
+        (`... # {trace_id="..."} value ts` — the p99 bucket links to
+        the trace that landed there), and the mandatory `# EOF`
+        terminator.  `export_run` writes this flavor as metrics.prom."""
+        out: List[str] = []
+        for m in self.metrics():
+            out.append(f"# TYPE {m.name} {m.kind}")
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            if isinstance(m, Histogram):
+                m._prom(out, exemplars=True)
+            else:
+                m._prom(out)
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
 
     # -- cross-process aggregation ------------------------------------
     def dump(self, path: str) -> str:

@@ -170,6 +170,15 @@ class Tracer:
         self._append(Span(name, t0, t1, threading.get_ident(), th.name,
                           args=args))
 
+    def add(self, span: Span) -> None:
+        """Append an already-built Span verbatim — the request tracer
+        emits kept cross-thread span trees through here, with each
+        span's ORIGINAL thread identity preserved (record() would stamp
+        the calling thread's)."""
+        if not _on():
+            return
+        self._append(span)
+
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)

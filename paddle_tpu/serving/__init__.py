@@ -48,8 +48,17 @@ SERVING/DEGRADED/DRAINING/BROKEN.  FAULT_SERVE_* chaos knobs
 Observability (serving/metrics.py): queue-depth/batch-occupancy gauges,
 TTFT and per-token latency histograms, page-pool utilization, and
 admission/reject counters — all behind FLAGS_observability with the
-established one-dict-lookup disabled path.  tools/serve_bench.py is the
-closed-loop load generator + regression gate.
+established one-dict-lookup disabled path.  ISSUE 8 adds request-scoped
+tracing end to end: Engine.submit() mints a `trace_id` carried on the
+returned Future, on typed errors, and on GeneratedSequence; the request/
+sequence lifecycle is recorded as cross-thread span trees, tail-sampled
+(slow/errored/shed/quarantined keep full detail under
+FLAGS_request_trace_budget) into the merged Perfetto trace; latency/TTFT
+histograms carry OpenMetrics exemplars; and a flight recorder
+(observability/flight.py) auto-dumps the last N lifecycle events as
+JSONL whenever the breaker trips or health() enters BROKEN.
+tools/serve_bench.py is the closed-loop load generator + regression
+gate.
 """
 
 from .batching import BucketLadder, parse_buckets

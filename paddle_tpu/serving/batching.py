@@ -92,6 +92,11 @@ class Request:
     enqueued_at: float
     deadline: Optional[float] = None  # absolute perf_counter time
     call_kwargs: Optional[Dict[str, Any]] = None  # pass-through mode only
+    # request-scoped tracing (observability/requesttrace.py): both None
+    # whenever FLAGS_observability is off — submit() mints them only on
+    # the enabled path (the zero-allocation contract)
+    trace_id: Optional[str] = None
+    trace: Optional[Any] = None  # the live RequestTrace
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

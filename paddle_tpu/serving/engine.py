@@ -53,7 +53,18 @@ Fault isolation (the serving half of the resilience pillar):
 
 Observability (queue depth, batch occupancy, latency histograms,
 admission/reject/timeout counters) gates on FLAGS_observability with the
-established zero-work disabled path: one dict lookup, no allocation.
+established zero-work disabled path: one dict lookup, no allocation —
+tier-1 extends the tracemalloc assertion to submit().  With the flag ON
+every request is traced end to end (ISSUE 8): submit() mints a
+`trace_id` (on the returned Future and on every typed error), the
+request's life is recorded as a cross-thread span tree
+(submit -> queued -> dispatch, each span on the thread that ran it) and
+tail-sampled into the merged Perfetto trace
+(observability/requesttrace.py), latency histograms carry OpenMetrics
+exemplars linking their p99 bucket to the trace behind it, and every
+lifecycle event lands in the flight recorder (observability/flight.py)
+— which auto-dumps a JSONL black box when the breaker trips or health()
+enters BROKEN.
 """
 
 from __future__ import annotations
@@ -68,6 +79,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import flags as _flags
+from ..observability import flight as _flight
+from ..observability import requesttrace as _rtrace
 from ..observability.stepstats import StepStats
 from ..resilience import faultinject as _finject
 from . import metrics as _smetrics
@@ -300,11 +313,16 @@ class Engine:
         # input (engine-local ring: admission control is functional, not
         # telemetry, so it runs regardless of FLAGS_observability)
         self._batch_lat = StepStats(capacity=128)
-        # p50 cache keyed by the ring's monotonic count: the submit fast
-        # path must not re-sort the 128-sample window under self._cond
-        # on every deadline-carrying request
+        # percentile caches keyed by the ring's monotonic count: the
+        # submit fast path (p50, deadline shedding) and continuously
+        # polled health() (p99) must not re-sort the 128-sample window
+        # when nothing new landed
         self._batch_lat_p50: Tuple[int, Optional[float]] = (0, None)
+        self._batch_lat_p99: Tuple[int, Optional[float]] = (0, None)
         self._pool = None                 # optional attach_pool target
+        # last health() verdict — the flight recorder logs state EDGES
+        # (SERVING->BROKEN), not every poll
+        self._last_health_state: Optional[str] = None
 
         # trailing feed shapes (everything past the batch dim) each
         # request must match — seeded from the AOT meta when available,
@@ -355,9 +373,19 @@ class Engine:
         config.default_timeout_s.  call_kwargs forwards extra backend
         keyword args and is only legal in pass-through mode (a padded
         batch serves many requests — per-request backend options cannot
-        apply)."""
+        apply).
+
+        With FLAGS_observability on, the returned Future carries a
+        fresh `trace_id` (also attached to every typed error this
+        request can fail with) and the request's life is traced
+        submit -> dispatch -> completion as a cross-thread span tree —
+        kept in the merged Perfetto trace when tail sampling elects it
+        (slow / errored / shed / timed out, under
+        FLAGS_request_trace_budget).  Off, `fut.trace_id` is None and
+        nothing from the observability package runs or allocates."""
         obs_on = _flags._VALUES["FLAGS_observability"]
         fut: Future = Future()
+        fut.trace_id = None
         feed_names = self.backend.feed_names
         if feed_names is not None:
             missing = [n for n in feed_names if n not in feed]
@@ -386,44 +414,45 @@ class Engine:
             rows = 0  # pass-through: never split
         if timeout is None:
             timeout = self.config.default_timeout_s
+        rt = None
+        if obs_on:
+            rt = _rtrace.default_request_tracer().start()
+            fut.trace_id = rt.trace_id
         now = time.perf_counter()
         req = Request(
             feed=feed, future=fut, rows=rows, enqueued_at=now,
             deadline=(now + timeout) if timeout is not None else None,
             call_kwargs=dict(call_kwargs) if call_kwargs else None,
+            trace_id=fut.trace_id, trace=rt,
         )
         with self._cond:
             if self._closed:
-                if obs_on:
-                    _smetrics.record_reject("closed")
-                raise EngineClosedError(
-                    f"engine '{self.name}' is draining/closed")
+                self._reject(rt, EngineClosedError(
+                    f"engine '{self.name}' is draining/closed"),
+                    "closed", obs_on)
             if self._breaker_open_until > now:
-                if obs_on:
-                    _smetrics.record_reject("breaker_open")
-                raise EngineUnhealthyError(
+                self._reject(rt, EngineUnhealthyError(
                     f"engine '{self.name}' circuit breaker is open "
                     f"({self._consecutive_errors} consecutive dispatch "
                     f"failures, last: {self._last_error}); retry in "
-                    f"{self._breaker_open_until - now:.2f}s")
+                    f"{self._breaker_open_until - now:.2f}s"),
+                    "breaker_open", obs_on)
             if len(self._queue) >= self.config.queue_depth:
-                if obs_on:
-                    _smetrics.record_reject("queue_full")
-                raise QueueFullError(
+                self._reject(rt, QueueFullError(
                     f"engine '{self.name}' queue is at "
-                    f"{self.config.queue_depth} requests")
+                    f"{self.config.queue_depth} requests"),
+                    "queue_full", obs_on)
             if req.deadline is not None and self.config.shed_deadlines:
                 est = self._estimate_dispatch_wait_locked()
                 if est is not None and now + est >= req.deadline:
                     self._shed += 1
-                    if obs_on:
-                        _smetrics.record_reject("deadline_shed")
-                    raise RequestTimeoutError(
+                    self._reject(rt, RequestTimeoutError(
                         f"shed: ~{est:.3f}s of queued work ahead "
                         f"(observed batch p50 x queue depth) already "
                         f"violates this request's {timeout:.3f}s "
                         f"deadline — rejecting at submit instead of "
-                        f"expiring in queue")
+                        f"expiring in queue"),
+                        "deadline_shed", obs_on)
             # a dispatcher that died without its supervisor running
             # (never under normal faults) must not strand the queue
             if not self._stopped and not self._thread.is_alive():
@@ -431,10 +460,36 @@ class Engine:
                 self._spawn_dispatcher()
             self._queue.append(req)
             depth = len(self._queue)
+            if obs_on:
+                # still under the cond: the dispatcher cannot take the
+                # batch (it needs this lock) until the submit span and
+                # flight event are recorded — otherwise a fast dispatch
+                # could finish() the trace before its submit span lands
+                rt.event("request.submit", rt.t0, time.perf_counter())
+                _flight.default_flight().record(
+                    "submit", engine=self.name, trace_id=fut.trace_id,
+                    depth=depth)
             self._cond.notify_all()
         if obs_on:
             _smetrics.record_submit(depth)
         return fut
+
+    def _reject(self, rt, exc: Exception, reason: str,
+                obs_on: bool) -> None:
+        """Account one rejected submission and raise `exc` (with the
+        request's trace_id attached).  Rejections are forced-keep in
+        tail sampling — a shed or fast-failed request is exactly the
+        kind an operator wants the span tree for."""
+        if obs_on:
+            _smetrics.record_reject(reason)
+            _flight.default_flight().record(
+                "reject", engine=self.name, reason=reason,
+                trace_id=rt.trace_id)
+            exc.trace_id = rt.trace_id
+            _rtrace.default_request_tracer().finish(
+                rt, outcome=("shed" if reason == "deadline_shed"
+                             else f"rejected_{reason}"))
+        raise exc
 
     def _estimate_dispatch_wait_locked(self) -> Optional[float]:
         """Earliest-possible-dispatch estimate for a NEW request, from
@@ -466,6 +521,15 @@ class Engine:
             p50 = self._batch_lat.percentile(50)
             self._batch_lat_p50 = (count, p50)
         return p50
+
+    def _batch_lat_p99_cached(self) -> Optional[float]:
+        """Same one-sort-per-change scheme for the p99 health() polls."""
+        count = self._batch_lat.count
+        cached_at, p99 = self._batch_lat_p99
+        if count != cached_at:
+            p99 = self._batch_lat.percentile(99)
+            self._batch_lat_p99 = (count, p99)
+        return p99
 
     def _check_trailing(self, feed: Dict[str, Any],
                         feed_names: Sequence[str]) -> None:
@@ -662,8 +726,36 @@ class Engine:
                 wait = min(wait, max(0.0, r.deadline - now))
         return wait
 
+    def _finish_trace(self, req: Request, outcome: str, t_end: float,
+                      dispatch: Optional[Tuple[float, float, dict]] = None,
+                      ) -> bool:
+        """Close one request's span tree: the queue-wait span on the
+        SUBMITTING thread, an optional (t0, t1, args) dispatch span on
+        the calling thread, then the tail-sampling decision.  Returns
+        whether the trace was kept — the one shape every completion
+        path (success, batch failure, timeout, close) shares."""
+        rt = req.trace
+        if rt is None:
+            return False
+        q_end = dispatch[0] if dispatch is not None else t_end
+        rt.event("request.queued", req.enqueued_at, q_end,
+                 tid=rt.tid, thread_name=rt.thread_name)
+        if dispatch is not None:
+            rt.event("request.dispatch", dispatch[0], dispatch[1],
+                     **dispatch[2])
+        return _rtrace.default_request_tracer().finish(
+            rt, outcome=outcome, t_end=t_end)
+
     def _fail(self, req: Request, exc: Exception) -> None:
         """Complete a future exceptionally; never call under the lock."""
+        if req.trace is not None and _flags._VALUES["FLAGS_observability"]:
+            exc.trace_id = req.trace_id
+            outcome = ("timeout" if isinstance(exc, RequestTimeoutError)
+                       else "closed")
+            _flight.default_flight().record(
+                "request_fail", engine=self.name, outcome=outcome,
+                trace_id=req.trace_id, error=type(exc).__name__)
+            self._finish_trace(req, outcome, time.perf_counter())
         if req.future.set_running_or_notify_cancel():
             req.future.set_exception(exc)
         if _flags._VALUES["FLAGS_observability"] and isinstance(
@@ -709,6 +801,10 @@ class Engine:
         obs_on = _flags._VALUES["FLAGS_observability"]
         # t0 always: the batch-latency ring feeds deadline shedding
         t0 = time.perf_counter()
+        if obs_on:
+            _flight.default_flight().record(
+                "dispatch", engine=self.name, n_requests=len(batch),
+                trace_ids=[r.trace_id for r in batch])
         try:
             _finject.serve_slow_step()
             _finject.serve_dispatch_raise("batch")
@@ -743,6 +839,22 @@ class Engine:
             # toward the breaker.
             batched = bool(self.ladder.buckets)
             err = EngineInternalError(e) if batched else e
+            if obs_on:
+                # typed errors carry the trace ids they failed:
+                # EngineInternalError serves a whole batch, so it gets
+                # the list (and the first id on .trace_id for the
+                # common single-request case); a pass-through error is
+                # one request's own and gets its id directly
+                try:
+                    err.trace_ids = [r.trace_id for r in batch]
+                    err.trace_id = batch[0].trace_id
+                except AttributeError:
+                    pass  # a __slots__ exception from a backend:
+                    # losing the annotation must not kill the dispatcher
+                _flight.default_flight().record(
+                    "batch_fail", engine=self.name,
+                    error=f"{type(e).__name__}: {e}",
+                    trace_ids=[r.trace_id for r in batch])
             # count BEFORE resolving futures: a caller that catches the
             # batch error and immediately checks health()/submits must
             # see the breaker already advanced
@@ -750,7 +862,30 @@ class Engine:
             # failed dispatches are service-time evidence too: without
             # them a slow-failing outage would leave the shed estimator
             # trusting a stale fast-success p50
-            self._batch_lat.record(time.perf_counter() - t0)
+            now = time.perf_counter()
+            self._batch_lat.record(now - t0)
+            if obs_on:
+                for r in batch:
+                    if r.trace is None:
+                        continue
+                    # scatter() may have resolved the first futures
+                    # before the raise: those requests SUCCEEDED from
+                    # their callers' view and must not be error-labeled
+                    # (or force-kept) in the trace
+                    ok = False
+                    if r.future.done():
+                        try:
+                            ok = r.future.exception() is None
+                        except Exception:  # cancelled
+                            ok = False
+                    kept = self._finish_trace(
+                        r, "ok" if ok else "error", now,
+                        dispatch=(t0, now, {} if ok else
+                                  {"error": type(e).__name__}))
+                    if ok:
+                        _smetrics.record_request_latency(
+                            now - r.enqueued_at,
+                            trace_id=r.trace_id if kept else None)
             for r in batch:
                 if r.future.done():
                     continue  # scatter resolved it before the raise
@@ -767,15 +902,27 @@ class Engine:
             self._dispatched_rows += rows
             self._occupancy_sum += rows / float(bucket)
             # a successful dispatch is the breaker's close/probe signal
+            breaker_was_open = self._breaker_open_until != 0.0
             self._consecutive_errors = 0
             self._breaker_open_until = 0.0
             self._last_dispatch_ok = now
         self._batch_lat.record(now - t0)
         if obs_on:
+            if breaker_was_open:
+                _flight.default_flight().record(
+                    "breaker_close", engine=self.name)
             _smetrics.record_batch(
                 bucket=bucket, rows=rows, latency_s=now - t0)
             for r in batch:
-                _smetrics.record_request_latency(now - r.enqueued_at)
+                if r.trace is not None:
+                    r.trace.annotate(rows=r.rows, bucket=bucket)
+                kept = self._finish_trace(
+                    r, "ok", now, dispatch=(t0, now, {"bucket": bucket}))
+                # exemplars only reference KEPT traces — a link into
+                # the merged trace must resolve
+                _smetrics.record_request_latency(
+                    now - r.enqueued_at,
+                    trace_id=r.trace_id if kept else None)
 
     def _note_shape(self, key: Tuple) -> None:
         with self._lock:
@@ -818,6 +965,18 @@ class Engine:
                 self._last_error, self.config.breaker_cooldown_s)
             if _flags._VALUES["FLAGS_observability"]:
                 _smetrics.record_breaker_trip()
+                # the black box: a breaker trip IS the incident — dump
+                # the last N lifecycle events as a JSONL artifact
+                fl = _flight.default_flight()
+                fl.record("breaker_open", engine=self.name,
+                          consecutive_errors=self.config.breaker_threshold,
+                          last_error=self._last_error,
+                          cooldown_s=self.config.breaker_cooldown_s)
+                try:
+                    fl.dump("breaker_trip")
+                except OSError as e:  # an unwritable dir must not
+                    _log.warning(     # poison the dispatch path
+                        "flight-recorder dump failed: %s", e)
 
     def _on_dispatcher_death(self, exc: BaseException) -> None:
         """Supervisor: the dispatcher thread died outside every
@@ -836,6 +995,10 @@ class Engine:
             type(exc).__name__, exc, self.queue_depth())
         if _flags._VALUES["FLAGS_observability"]:
             _smetrics.record_dispatcher_restart()
+            _flight.default_flight().record(
+                "dispatcher_restart", engine=self.name,
+                error=f"{type(exc).__name__}: {exc}",
+                queued=self.queue_depth())
         self._spawn_dispatcher()
 
     # -- health ---------------------------------------------------------
@@ -887,7 +1050,12 @@ class Engine:
                 "dispatcher_restarts": self._dispatcher_restarts,
                 "shed": self._shed,
                 "close_timed_out": self._close_timed_out,
+                # the admission latency ring the shed estimator reads —
+                # operators see the same numbers shedding decides from
                 "batch_latency_p50_s": self._batch_lat_p50_cached(),
+                "batch_latency_p99_s": self._batch_lat_p99_cached(),
+                "batch_latency_window": min(self._batch_lat.count,
+                                            self._batch_lat.capacity),
             }
             draining = self._closed
             degraded = (self._consecutive_errors > 0
@@ -902,6 +1070,24 @@ class Engine:
         else:
             state = "SERVING"
         snap["state"] = state
+        # atomic read-and-swap: concurrent health() pollers must see
+        # each state edge exactly once (one BROKEN transition = one
+        # flight dump, not one per poller)
+        with self._lock:
+            prev = self._last_health_state
+            self._last_health_state = state
+        if _flags._VALUES["FLAGS_observability"] and state != prev:
+            fl = _flight.default_flight()
+            fl.record("health", engine=self.name,
+                      state=state, previous=prev)
+            if state == "BROKEN":
+                # entering BROKEN is the other dump trigger (a dead
+                # dispatcher reaches here without a breaker trip)
+                try:
+                    fl.dump("health_broken")
+                except OSError as e:
+                    _log.warning(
+                        "flight-recorder dump failed: %s", e)
         if self._pool is not None:
             st = self._pool.stats()
             snap["pool"] = {

@@ -56,6 +56,8 @@ from ..kernels.paged_attention import (
     paged_decode_attention,
     resolve_paged_impl,
 )
+from ..observability import flight as _flight
+from ..observability import requesttrace as _rtrace
 from ..models.transformer import _sinusoid_table
 from ..resilience import faultinject as _finject
 from ..resilience.sentinel import rows_finite
@@ -281,6 +283,10 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
 class DecodeRequest:
     prompt: Sequence[int]
     max_new_tokens: int
+    # carried through from Engine.submit when the decode loop fronts an
+    # engine; None (the default) mints a fresh id at run() when
+    # FLAGS_observability is on
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -299,16 +305,21 @@ class GeneratedSequence:
     ttft_s: Optional[float] = None
     finished_at: float = 0.0
     error: Optional[Exception] = None
+    # request trace id (None when FLAGS_observability was off): the join
+    # key into the merged trace, metric exemplars, and flight events
+    trace_id: Optional[str] = None
 
 
 class _Active:
-    __slots__ = ("req", "seq_id", "pos", "result")
+    __slots__ = ("req", "seq_id", "pos", "result", "rt")
 
-    def __init__(self, req: DecodeRequest, seq_id: int, result: GeneratedSequence):
+    def __init__(self, req: DecodeRequest, seq_id: int,
+                 result: GeneratedSequence, rt=None):
         self.req = req
         self.seq_id = seq_id
         self.pos = 0  # next position to feed
         self.result = result
+        self.rt = rt  # RequestTrace (None with observability off)
 
 
 class ContinuousBatchingLoop:
@@ -377,7 +388,7 @@ class ContinuousBatchingLoop:
 
     def run(self, requests: Sequence[DecodeRequest]) -> List[GeneratedSequence]:
         obs_on = _flags._VALUES["FLAGS_observability"]
-        waiting: List[Tuple[DecodeRequest, GeneratedSequence]] = []
+        waiting: List[Tuple[DecodeRequest, GeneratedSequence, object]] = []
         results: List[GeneratedSequence] = []
         for req in requests:
             if not len(req.prompt):
@@ -393,8 +404,15 @@ class ContinuousBatchingLoop:
                     f"request needs {need} pages worst-case but the pool "
                     f"has {self.pool.num_pages} total")
             seq = GeneratedSequence(seq_id=-1, prompt=[int(t) for t in req.prompt])
+            rt = None
+            if obs_on:
+                # sequence lifecycle trace: queued (here) -> admitted ->
+                # prefill -> decode -> retired/quarantined
+                rt = _rtrace.default_request_tracer().start(
+                    name="sequence", trace_id=req.trace_id)
+                seq.trace_id = rt.trace_id
             results.append(seq)
-            waiting.append((req, seq))
+            waiting.append((req, seq, rt))
         active: List[_Active] = []
         reserved_pages = 0
 
@@ -420,13 +438,31 @@ class ContinuousBatchingLoop:
                 if finite[i]:
                     continue
                 active.remove(a)
-                a.result.error = NonFiniteSequenceError(a.seq_id, step_idx)
+                err = NonFiniteSequenceError(a.seq_id, step_idx)
+                err.trace_id = a.result.trace_id
+                a.result.error = err
                 a.result.finished_at = now
                 self.pool.free_seq(a.seq_id)
                 reserved_pages -= self._footprint(a.req)
                 self.quarantined += 1
                 if obs_on:
                     _smetrics.record_sequence("quarantined")
+                    _flight.default_flight().record(
+                        "quarantine", seq_id=a.seq_id, step=step_idx,
+                        trace_id=a.result.trace_id)
+                    kept = False
+                    if a.rt is not None:
+                        # quarantined sequences are forced-keep: the
+                        # poisoned request is the one worth reading
+                        a.rt.annotate(tokens=len(a.result.tokens),
+                                      quarantined_step=step_idx)
+                        kept = _rtrace.default_request_tracer().finish(
+                            a.rt, outcome="quarantined", t_end=now)
+                    if a.result.ttft_s is not None:
+                        _smetrics.record_ttft(
+                            a.result.ttft_s,
+                            trace_id=(a.result.trace_id if kept
+                                      else None))
             return logits, {i for i in range(len(batch)) if finite[i]}, now
 
         def emit(a: _Active, row: np.ndarray, t0: float, now: float) -> bool:
@@ -436,8 +472,9 @@ class ContinuousBatchingLoop:
             a.result.logits.append(row)
             if a.result.ttft_s is None:
                 a.result.ttft_s = now - a.result.admitted_at
-                if obs_on:
-                    _smetrics.record_ttft(a.result.ttft_s)
+                if obs_on and a.rt is not None:
+                    a.rt.event("sequence.prefill",
+                               a.result.admitted_at, now)
             if obs_on:
                 _smetrics.record_token(now - t0, impl=self.paged_impl)
             return (len(a.result.tokens) >= a.req.max_new_tokens
@@ -453,6 +490,24 @@ class ContinuousBatchingLoop:
                 reserved_pages -= self._footprint(a.req)
                 if obs_on:
                     _smetrics.record_sequence("retired")
+                    kept = False
+                    if a.rt is not None:
+                        if a.result.ttft_s is not None:
+                            a.rt.event(
+                                "sequence.decode",
+                                a.result.admitted_at + a.result.ttft_s,
+                                now, tokens=len(a.result.tokens))
+                        a.rt.annotate(tokens=len(a.result.tokens))
+                        kept = _rtrace.default_request_tracer().finish(
+                            a.rt, outcome="ok", t_end=now)
+                    if a.result.ttft_s is not None:
+                        # observed at retirement, where the sampling
+                        # verdict is known: the exemplar must reference
+                        # a trace that exists in the merged trace
+                        _smetrics.record_ttft(
+                            a.result.ttft_s,
+                            trace_id=(a.result.trace_id if kept
+                                      else None))
 
         try:
             while waiting or active:
@@ -460,7 +515,7 @@ class ContinuousBatchingLoop:
                 # reservation fit
                 newly: List[_Active] = []
                 while waiting and len(active) < self.max_batch:
-                    req, seq = waiting[0]
+                    req, seq, rt = waiting[0]
                     need = self._footprint(req)
                     if reserved_pages + need > self.pool.num_pages:
                         break  # wait for retirements
@@ -469,12 +524,22 @@ class ContinuousBatchingLoop:
                     self._next_seq_id += 1
                     self.pool.allocate(seq.seq_id)
                     seq.admitted_at = time.perf_counter()
-                    a = _Active(req, seq.seq_id, seq)
+                    a = _Active(req, seq.seq_id, seq, rt=rt)
                     active.append(a)
                     newly.append(a)
                     reserved_pages += need
                     if obs_on:
                         _smetrics.record_sequence("admitted")
+                        _flight.default_flight().record(
+                            "admit", seq_id=seq.seq_id,
+                            trace_id=seq.trace_id,
+                            prompt_len=len(seq.prompt),
+                            reserved_pages=reserved_pages)
+                        if rt is not None:
+                            rt.event("sequence.queued", rt.t0,
+                                     seq.admitted_at)
+                            rt.annotate(seq_id=seq.seq_id,
+                                        prompt_len=len(seq.prompt))
                 # NOTE: waiting-but-nothing-active cannot happen — the
                 # up-front validation guarantees the head request fits an
                 # empty pool, so admission always progresses
@@ -574,6 +639,9 @@ class ContinuousBatchingLoop:
             report["free_list_errors"], reclaimed)
         if _flags._VALUES["FLAGS_observability"] and reclaimed:
             _smetrics.record_pool_reclaim(reclaimed, pool=self.pool.name)
+            _flight.default_flight().record(
+                "page_reclaim", pool=self.pool.name, pages=reclaimed,
+                step=self.steps)
 
     def _note_attention_bytes(self) -> None:
         """Attention-bytes-per-step gauge for the CURRENT pool contents,

@@ -130,18 +130,25 @@ def record_batch_error() -> None:
     ).inc()
 
 
-def record_request_latency(seconds: float) -> None:
+def record_request_latency(seconds: float,
+                           trace_id: Optional[str] = None) -> None:
+    """`trace_id` attaches an OpenMetrics exemplar to the bucket this
+    latency lands in — callers pass it only for requests whose trace
+    survived tail sampling, so the exemplar always points at a span
+    tree that actually exists in the merged trace."""
     default_registry().histogram(
         "paddle_tpu_serving_request_latency_seconds",
         "submit-to-complete wall time per request",
-    ).observe(seconds)
+    ).observe(seconds,
+              exemplar={"trace_id": trace_id} if trace_id else None)
 
 
-def record_ttft(seconds: float) -> None:
+def record_ttft(seconds: float, trace_id: Optional[str] = None) -> None:
     default_registry().histogram(
         "paddle_tpu_serving_ttft_seconds",
         "decode admit-to-first-token wall time per sequence",
-    ).observe(seconds)
+    ).observe(seconds,
+              exemplar={"trace_id": trace_id} if trace_id else None)
 
 
 def record_token(seconds: float, impl: str = "reference") -> None:

@@ -1,0 +1,485 @@
+"""ISSUE 8: request-scoped tracing, OpenMetrics exemplars, and the
+crash flight recorder across the serving tier.
+
+Acceptance (tier-1): a request driven through the engine with
+FLAGS_observability=1 shows (a) its trace_id on the returned result,
+(b) its spans across submit and dispatcher threads in the merged
+Perfetto trace, (c) an exemplar referencing that trace_id in the
+latency histogram's OpenMetrics output, and (d) a FAULT_SERVE-induced
+breaker trip writing a flight-recorder JSONL dump containing the
+breaker-transition event; with FLAGS_observability=0 a tracemalloc
+filter proves submit() allocates nothing from the observability
+package."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observability as obs
+from paddle_tpu import serving
+from paddle_tpu.resilience import faultinject
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_on(tmp_path):
+    """Observability on with a clean spine and a tmp flight dir."""
+    fluid.set_flags({"FLAGS_observability": True,
+                     "FLAGS_flight_dir": str(tmp_path / "flight")})
+    obs.reset()
+    yield
+    obs.reset()
+    fluid.set_flags({"FLAGS_observability": False,
+                     "FLAGS_flight_dir": ""})
+
+
+def _build_engine(buckets=(1, 2), max_wait_s=0.0, **cfg_kwargs):
+    x = layers.data("x", [4], dtype="float32")
+    y = layers.fc(x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return serving.Engine.from_program(
+        exe, fluid.default_main_program(), [y], feed_names=["x"],
+        config=serving.EngineConfig(buckets=buckets, max_wait_s=max_wait_s,
+                                    **cfg_kwargs))
+
+
+def _feed(rows=1):
+    return {"x": np.zeros((rows, 4), np.float32)}
+
+
+# -----------------------------------------------------------------------
+# acceptance: end-to-end request trace through the engine
+# -----------------------------------------------------------------------
+def test_engine_request_trace_end_to_end(obs_on, tmp_path):
+    with _build_engine() as eng:
+        fut = eng.submit(_feed())
+        fut.result(timeout=30)
+        trace_id = fut.trace_id
+    assert trace_id  # (a) the result carries its trace id
+
+    run_dir = str(tmp_path / "run")
+    obs.export_run(run_dir)
+
+    # (b) the merged Perfetto trace holds this request's spans across
+    # the submit and dispatcher threads, parented under one root
+    with open(os.path.join(run_dir, "trace.json")) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+             and (e.get("args") or {}).get("trace_id") == trace_id]
+    names = {e["name"] for e in spans}
+    assert {"request", "request.submit", "request.queued",
+            "request.dispatch"} <= names
+    assert len({e["tid"] for e in spans}) >= 2  # cross-thread
+    for e in spans:
+        assert e["dur"] >= 0
+        if e["name"] != "request":
+            assert e["args"]["parent"] == "request"
+    root = next(e for e in spans if e["name"] == "request")
+    assert root["args"]["outcome"] == "ok"
+
+    # (c) the latency histogram's OpenMetrics exposition carries an
+    # exemplar referencing the trace
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    assert prom.rstrip().endswith("# EOF")
+    exemplar_lines = [
+        ln for ln in prom.splitlines()
+        if ln.startswith("paddle_tpu_serving_request_latency_seconds_bucket")
+        and f'# {{trace_id="{trace_id}"}}' in ln]
+    assert exemplar_lines, prom
+
+
+def test_breaker_trip_writes_flight_dump(obs_on, tmp_path):
+    # (d) FAULT_SERVE-induced breaker trip -> flight-recorder JSONL dump
+    # with the breaker transition event (and the failing dispatches
+    # leading up to it)
+    eng = _build_engine(breaker_threshold=2, breaker_cooldown_s=0.05)
+    os.environ["FAULT_SERVE_DISPATCH_RAISE"] = "2"
+    try:
+        for _ in range(2):
+            with pytest.raises(serving.EngineInternalError) as ei:
+                eng.submit(_feed()).result(timeout=30)
+            assert ei.value.trace_id  # typed errors carry trace ids
+    finally:
+        os.environ.pop("FAULT_SERVE_DISPATCH_RAISE", None)
+        faultinject.reset()
+    dumps = obs.default_flight().dump_paths
+    assert len(dumps) == 1
+    assert os.path.dirname(dumps[0]) == str(tmp_path / "flight")
+    with open(dumps[0]) as f:
+        lines = [json.loads(ln) for ln in f]
+    header, events = lines[0], lines[1:]
+    assert header["reason"] == "breaker_trip"
+    assert header["events"] == len(events)
+    kinds = [e["kind"] for e in events]
+    assert "breaker_open" in kinds
+    assert "batch_fail" in kinds and "submit" in kinds
+    trip = next(e for e in events if e["kind"] == "breaker_open")
+    assert trip["consecutive_errors"] == 2
+    # recovery: after cooldown a successful probe closes the breaker
+    # and the transition lands in the ring
+    time.sleep(0.06)
+    eng.infer(_feed())
+    assert "breaker_close" in [e["kind"] for e in
+                               obs.default_flight().events()]
+    eng.close()
+
+
+def test_submit_disabled_path_zero_observability_alloc():
+    """The PR-3 zero-allocation contract extended to submit(): with the
+    flag off, submitting allocates NOTHING from the observability
+    package (and the Future still exposes trace_id=None)."""
+    import tracemalloc
+
+    assert not obs.enabled()
+    # a large bucket + long fill window parks the dispatcher while we
+    # measure, so only submit() itself runs inside the tracemalloc
+    # window (the dispatch path is measured by PR-3's executor test)
+    eng = _build_engine(buckets=(1, 2, 8), max_wait_s=5.0)
+    eng.infer(_feed())  # warm caches/trailing-shape state end to end
+    feeds = [_feed() for _ in range(3)]
+
+    obs_pkg_dir = os.path.dirname(os.path.abspath(obs.__file__))
+    tracemalloc.start()
+    try:
+        futs = [eng.submit(f) for f in feeds]
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    hits = snap.filter_traces(
+        [tracemalloc.Filter(True, os.path.join(obs_pkg_dir, "*"))]
+    ).statistics("filename")
+    assert hits == [], f"observability allocated in submit(): {hits}"
+    assert all(f.trace_id is None for f in futs)
+    eng.close()
+    for f in futs:
+        f.result(timeout=30)
+    # control: the same submit with the flag on mints a trace
+    fluid.set_flags({"FLAGS_observability": True})
+    try:
+        eng2 = _build_engine(buckets=(1, 2))
+        fut = eng2.submit(_feed())
+        assert fut.trace_id is not None
+        fut.result(timeout=30)
+        eng2.close()
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+        obs.reset()
+
+
+# -----------------------------------------------------------------------
+# cross-thread span parenting round-trip (satellite)
+# -----------------------------------------------------------------------
+def test_cross_thread_span_parenting_roundtrip(obs_on, tmp_path):
+    """A submit->dispatch->complete request round-trips through
+    Chrome-trace export with its spans under ONE trace_id, correct
+    parenting, and non-negative durations across threads."""
+    with _build_engine() as eng:
+        futs = [eng.submit(_feed()) for _ in range(3)]
+        for f in futs:
+            f.result(timeout=30)
+    ids = {f.trace_id for f in futs}
+    assert len(ids) == 3  # distinct ids per request
+
+    path = str(tmp_path / "t.json")
+    obs.write_chrome_trace(path, obs.default_tracer().spans())
+    with open(path) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    tid_names = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    # every request that was kept round-trips as a well-formed tree
+    kept = {t for t in ids if any(
+        (e.get("args") or {}).get("trace_id") == t and e["name"] == "request"
+        for e in xs)}
+    assert kept  # at least the first (no-evidence) request is kept
+    for t in kept:
+        spans = [e for e in xs if (e.get("args") or {}).get("trace_id") == t]
+        root = next(e for e in spans if e["name"] == "request")
+        threads = {tid_names[e["tid"]] for e in spans}
+        assert threading.main_thread().name in threads
+        assert any(n.startswith("serving-") for n in threads)
+        for e in spans:
+            assert e["dur"] >= 0
+            if e is not root:
+                assert e["args"]["parent"] == "request"
+            # children start within the root's envelope
+            assert e["ts"] >= root["ts"] - 1e-3
+
+
+# -----------------------------------------------------------------------
+# tail sampling
+# -----------------------------------------------------------------------
+def test_tail_sampling_keeps_slow_and_errored(obs_on):
+    tr = obs.RequestTracer()
+    # no evidence yet: first request is kept
+    assert tr.finish(tr.start(t0=0.0), outcome="ok", t_end=0.010)
+    # seed the ring: 60 fast successes establish a ~10ms p99
+    for _ in range(60):
+        tr.finish(tr.start(t0=0.0), outcome="ok", t_end=0.010)
+    before = tr.stats()
+    # fast + ok -> sampled out
+    assert not tr.finish(tr.start(t0=0.0), outcome="ok", t_end=0.001)
+    # slow (>= p99) -> kept
+    assert tr.finish(tr.start(t0=0.0), outcome="ok", t_end=0.050)
+    # errored -> forced keep regardless of speed
+    assert tr.finish(tr.start(t0=0.0), outcome="error", t_end=0.0001)
+    after = tr.stats()
+    assert after["sampled_out"] == before["sampled_out"] + 1
+    assert after["kept"] == before["kept"] + 2
+    # decisions land on the counter
+    c = obs.default_registry().counter("paddle_tpu_request_traces", "")
+    assert c.value(decision="kept") == after["kept"]
+    assert c.value(decision="sampled_out") == after["sampled_out"]
+
+
+def test_trace_budget_is_a_hard_cap(obs_on):
+    fluid.set_flags({"FLAGS_request_trace_budget": 2})
+    try:
+        tr = obs.RequestTracer()
+        kept = [tr.finish(tr.start(t0=0.0), outcome="error", t_end=1.0)
+                for _ in range(5)]
+        assert kept == [True, True, False, False, False]
+        assert tr.stats()["budget_dropped"] == 3
+        # budget-dropped traces emit NO spans
+        assert len([s for s in obs.default_tracer().spans()
+                    if s.cat == "request"]) == 2
+    finally:
+        fluid.set_flags({"FLAGS_request_trace_budget": 256})
+
+
+def test_rejected_submits_carry_trace_ids_and_are_kept(obs_on):
+    eng = _build_engine(queue_depth=1, max_wait_s=5.0, buckets=(1, 2, 8))
+    try:
+        fut = eng.submit(_feed())  # parks in the fill window
+        with pytest.raises(serving.QueueFullError) as ei:
+            eng.submit(_feed())
+        assert ei.value.trace_id
+        # the rejection is forced-keep: its root span is in the tracer
+        roots = [s for s in obs.default_tracer().spans()
+                 if s.cat == "request" and s.name == "request"
+                 and s.args.get("trace_id") == ei.value.trace_id]
+        assert len(roots) == 1
+        assert roots[0].args["outcome"] == "rejected_queue_full"
+        assert any(e["kind"] == "reject"
+                   for e in obs.default_flight().events())
+    finally:
+        eng.close()
+        fut.result(timeout=30)
+
+
+# -----------------------------------------------------------------------
+# flight recorder unit behavior
+# -----------------------------------------------------------------------
+def test_flight_recorder_ring_and_dump(obs_on, tmp_path):
+    fr = obs.FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("step", i=i)
+    evts = fr.events()
+    assert len(evts) == 4 and fr.dropped == 2
+    assert [e["i"] for e in evts] == [2, 3, 4, 5]  # newest kept
+    assert [e["seq"] for e in evts] == [3, 4, 5, 6]
+    p = fr.dump("unit_test", dirname=str(tmp_path))
+    lines = [json.loads(ln) for ln in open(p)]
+    assert lines[0]["reason"] == "unit_test"
+    assert lines[0]["events"] == 4 and lines[0]["dropped"] == 2
+    assert [ln["i"] for ln in lines[1:]] == [2, 3, 4, 5]
+    assert fr.dump_paths == [p]
+    fr.reset()
+    assert fr.events() == [] and fr.dump_paths == []
+
+
+def test_health_broken_transition_dumps_once(obs_on):
+    """Entering BROKEN via health() is the second dump trigger — and it
+    fires on the EDGE, not on every poll."""
+    eng = _build_engine(breaker_threshold=1, breaker_cooldown_s=30.0)
+    assert eng.health()["state"] == "SERVING"
+    os.environ["FAULT_SERVE_DISPATCH_RAISE"] = "1"
+    try:
+        with pytest.raises(serving.EngineInternalError):
+            eng.submit(_feed()).result(timeout=30)
+    finally:
+        os.environ.pop("FAULT_SERVE_DISPATCH_RAISE", None)
+        faultinject.reset()
+    n_after_trip = len(obs.default_flight().dump_paths)
+    assert n_after_trip == 1  # the breaker trip dumped
+    assert eng.health()["state"] == "BROKEN"
+    assert len(obs.default_flight().dump_paths) == 2  # BROKEN edge
+    eng.health()  # still BROKEN: no new dump
+    assert len(obs.default_flight().dump_paths) == 2
+    healths = [e for e in obs.default_flight().events()
+               if e["kind"] == "health"]
+    assert [h["state"] for h in healths] == ["SERVING", "BROKEN"]
+    eng.close()
+
+
+# -----------------------------------------------------------------------
+# engine.health() surfaces the admission-latency ring (satellite)
+# -----------------------------------------------------------------------
+def test_health_surfaces_batch_latency_percentiles():
+    eng = _build_engine()
+    try:
+        h = eng.health()
+        assert h["batch_latency_p50_s"] is None
+        assert h["batch_latency_p99_s"] is None
+        assert h["batch_latency_window"] == 0
+        for _ in range(3):
+            eng.infer(_feed())
+        h = eng.health()
+        assert h["batch_latency_p50_s"] > 0
+        assert h["batch_latency_p99_s"] >= h["batch_latency_p50_s"]
+        assert h["batch_latency_window"] == 3
+    finally:
+        eng.close()
+
+
+# -----------------------------------------------------------------------
+# Prometheus exposition escaping (satellite)
+# -----------------------------------------------------------------------
+def test_prometheus_label_values_escaped(obs_on):
+    reg = obs.MetricsRegistry()
+    reg.counter("errs", "by class").inc(
+        error='said "no"\nand \\ left', trace_id="t-1")
+    text = reg.to_prometheus()
+    assert ('errs_total{error="said \\"no\\"\\nand \\\\ left",'
+            'trace_id="t-1"} 1') in text
+    # one logical line per sample: the newline must NOT split the line
+    assert all(ln.count('"') % 2 == 0 for ln in text.splitlines()
+               if ln.startswith("errs_total"))
+    # openmetrics flavor escapes the same way and terminates with EOF
+    om = reg.to_openmetrics()
+    assert 'error="said \\"no\\"\\nand \\\\ left"' in om
+    assert om.rstrip().endswith("# EOF")
+
+
+def test_openmetrics_exemplars_render_and_merge_ignores_them(obs_on):
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("lat_seconds", "", buckets=[0.01, 0.1, 1.0])
+    h.observe(0.005)  # no exemplar
+    h.observe(0.05, exemplar={"trace_id": "abc"})
+    om = reg.to_openmetrics()
+    line = next(ln for ln in om.splitlines()
+                if ln.startswith('lat_seconds_bucket{le="0.1"}'))
+    assert '# {trace_id="abc"} 0.05' in line
+    # the classic exposition stays exemplar-free (Prometheus text
+    # format predates them)
+    assert "# {" not in reg.to_prometheus()
+    # snapshots round-trip through merge with exemplars ignored
+    reg2 = obs.MetricsRegistry()
+    reg2.merge(reg.snapshot())
+    assert reg2.histogram("lat_seconds", "").series_summary()["count"] == 2
+
+
+# -----------------------------------------------------------------------
+# decode-loop sequence tracing
+# -----------------------------------------------------------------------
+def _decode_fixture():
+    cfg = serving.DecodeConfig(vocab_size=31, d_model=16, n_head=4,
+                               n_layer=1, d_inner=32, max_length=32)
+    params = serving.init_decode_params(cfg)
+    pool = serving.KVCachePool(num_pages=32, page_size=4, num_layers=1,
+                               num_heads=4, head_dim=4)
+    return cfg, params, pool
+
+
+def test_decode_sequences_carry_trace_ids_and_spans(obs_on):
+    cfg, params, pool = _decode_fixture()
+    loop = serving.ContinuousBatchingLoop(params, cfg, pool, max_batch=2)
+    results = loop.run([
+        serving.DecodeRequest(prompt=[1, 2, 3], max_new_tokens=3),
+        serving.DecodeRequest(prompt=[4, 5], max_new_tokens=2,
+                              trace_id="engine-minted-id"),
+    ])
+    assert results[0].trace_id and results[0].trace_id != "engine-minted-id"
+    assert results[1].trace_id == "engine-minted-id"  # carried through
+    spans = [s for s in obs.default_tracer().spans() if s.cat == "request"]
+    for r in results:
+        mine = [s for s in spans if s.args.get("trace_id") == r.trace_id]
+        names = {s.name for s in mine}
+        assert {"sequence", "sequence.queued", "sequence.prefill",
+                "sequence.decode"} <= names
+        root = next(s for s in mine if s.name == "sequence")
+        assert root.args["outcome"] == "ok"
+        assert root.args["tokens"] == len(r.tokens)
+    # TTFT histogram carries a trace-id exemplar
+    om = obs.default_registry().to_openmetrics()
+    assert any("paddle_tpu_serving_ttft_seconds_bucket" in ln
+               and "trace_id=" in ln for ln in om.splitlines())
+
+
+def test_quarantined_sequence_trace_kept_and_flight_logged(obs_on):
+    cfg, params, pool = _decode_fixture()
+    loop = serving.ContinuousBatchingLoop(params, cfg, pool, max_batch=2)
+    os.environ["FAULT_SERVE_NAN_SEQ"] = "1@1"
+    try:
+        results = loop.run([
+            serving.DecodeRequest(prompt=[1, 2, 3], max_new_tokens=3),
+            serving.DecodeRequest(prompt=[4, 5], max_new_tokens=3),
+        ])
+    finally:
+        os.environ.pop("FAULT_SERVE_NAN_SEQ", None)
+        faultinject.reset()
+    bad = next(r for r in results if r.error is not None)
+    assert bad.error.trace_id == bad.trace_id
+    root = next(s for s in obs.default_tracer().spans()
+                if s.cat == "request" and s.name == "sequence"
+                and s.args.get("trace_id") == bad.trace_id)
+    assert root.args["outcome"] == "quarantined"
+    q = [e for e in obs.default_flight().events()
+         if e["kind"] == "quarantine"]
+    assert len(q) == 1 and q[0]["trace_id"] == bad.trace_id
+    assert pool.stats()["used_pages"] == 0  # still no leaked pages
+
+
+# -----------------------------------------------------------------------
+# obsdump + serve_bench artifacts (satellites)
+# -----------------------------------------------------------------------
+def test_obsdump_renders_request_timeline_and_flight(obs_on, tmp_path,
+                                                     capsys):
+    from tools.obsdump import main as obsdump_main
+
+    with _build_engine() as eng:
+        fut = eng.submit(_feed())
+        fut.result(timeout=30)
+    obs.default_flight().dump("unit_test",
+                              dirname=str(tmp_path / "run"))
+    run_dir = str(tmp_path / "run")
+    obs.export_run(run_dir)
+    assert obsdump_main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "== requests ==" in out
+    assert fut.trace_id in out
+    assert "request.dispatch" in out
+    assert "tail sampling:" in out
+    assert "== flight recorder ==" in out
+    assert "reason=unit_test" in out
+
+
+def test_serve_bench_reports_timestamps_and_artifacts(tmp_path, capsys):
+    from tools.serve_bench import main as bench_main
+
+    out = tmp_path / "r.json"
+    obs_dir = tmp_path / "obs"
+    rc = bench_main([
+        "--model", "tiny", "--requests", "4", "--rate", "400",
+        "--buckets", "1,2", "--batch-range", "1,2",
+        "--json", str(out), "--obs-dir", str(obs_dir),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    result = json.loads(out.read_text())
+    assert result["started_at"] <= result["finished_at"]
+    assert abs(result["finished_at"] - time.time()) < 600
+    art = result["artifacts"]
+    assert os.path.exists(art["trace"])
+    assert os.path.exists(art["metrics"])
+    assert art["flight_dumps"] == []  # clean run: no incident, no dump
+    # the flag was restored
+    assert not obs.enabled()
+    obs.reset()

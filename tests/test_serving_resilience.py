@@ -608,22 +608,48 @@ def test_serve_bench_chaos_decode_gate(tmp_path, capsys):
     capsys.readouterr()
 
 
-def test_serve_bench_chaos_engine_smoke(capsys):
+def test_serve_bench_chaos_engine_smoke(tmp_path, capsys):
     import json
 
+    from paddle_tpu import observability as obs
     from tools.serve_bench import main as bench_main
 
     rc = bench_main([
         "--model", "tiny", "--requests", "18", "--rate", "400",
         "--buckets", "1,2", "--batch-range", "1,2", "--chaos",
+        "--obs-dir", str(tmp_path / "obs"),
     ])
     assert rc == 0
     result = json.loads(capsys.readouterr().out)
-    # exactly ONE batch was poisoned (1-2 requests if they coalesced)
-    assert result["internal_errors"] == 1
-    assert 1 <= result["poisoned_requests"] <= 2
+    # breaker_threshold consecutive batches were poisoned — enough to
+    # TRIP the breaker (ISSUE 8: the flight recorder's dump trigger)
+    assert result["internal_errors"] == 3
+    assert result["breaker_trips"] == 1
+    assert 3 <= result["poisoned_requests"] <= 6
     assert result["recovered_requests"] >= 1
     assert (result["recovered_requests"] + result["poisoned_requests"]
             + result["timeout_requests"] + result["shed_requests"]
+            + result["breaker_rejected_requests"]
             == result["requests"])
     assert result["dispatcher_restarts"] == 0
+    # the induced trip left a black box, and it holds the transition
+    assert result["flight_dumps"] >= 1
+    dump = result["artifacts"]["flight_dumps"][0]
+    with open(dump) as f:
+        events = [json.loads(ln) for ln in f][1:]
+    assert "breaker_open" in {e["kind"] for e in events}
+    # banking {"flight_dumps": 1} gates future chaos runs on the
+    # artifact existing (same 0/2/3 contract as pages_leaked)
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps({"flight_dumps": 1,
+                                "dispatcher_restarts": 0}))
+    rc = bench_main([
+        "--model", "tiny", "--requests", "18", "--rate", "400",
+        "--buckets", "1,2", "--batch-range", "1,2", "--chaos",
+        "--baseline", str(bank), "--gate",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    # serve_bench restored the observability flag it flipped on
+    assert not obs.enabled()
+    obs.reset()

@@ -2,17 +2,27 @@
 """Render an observability run directory into a human-readable report.
 
 A run directory is what `observability.export_run(dir)` (or a
-FLAGS_observability=1 bench.py run with BENCH_OBS_DIR) leaves behind:
+FLAGS_observability=1 bench.py run with BENCH_OBS_DIR, or a serve_bench
+--obs-dir run) leaves behind:
 
-    metrics.prom     Prometheus text exposition (scrape-ready)
+    metrics.prom     OpenMetrics text exposition (scrape-ready; histogram
+                     buckets carry trace-id exemplars)
     metrics.json     registry snapshot (metrics_<pid>.json per process on
                      multi-host runs; this CLI aggregates them all)
     trace.json       merged Chrome/Perfetto trace (load in ui.perfetto.dev)
-    report.json      step-time summary + regression verdicts
+    report.json      step-time summary + regression verdicts + request
+                     trace sampling stats
+    flight_*.jsonl   flight-recorder dumps (breaker trips / BROKEN health)
+
+Besides metrics and step times this renders a PER-REQUEST timeline for
+every request trace that survived tail sampling (slowest first; each
+span with its thread and offset from the request's start) and the tail
+of every flight-recorder dump — the post-incident reading order is
+"which request was slow" then "what was the engine doing when it broke".
 
 Usage:
     python tools/obsdump.py <run_dir> [--baseline BENCH.json] [--tol 0.05]
-           [--gate]
+           [--gate] [--requests N] [--flight DUMP.jsonl]
 
 --baseline re-gates the run's results against a banked bench artifact (a
 previous bench.py JSON line or a plain {metric: value} mapping), printing
@@ -103,6 +113,104 @@ def _labels(series: dict) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(lab.items())) + "}"
 
 
+def _print_requests(run_dir: str, report: dict, out, limit: int) -> None:
+    """Per-request timelines from the merged trace: spans grouped by
+    their args.trace_id (cat == "request"), slowest root first."""
+    path = os.path.join(run_dir, "trace.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", [])
+    tid_names = {e["tid"]: e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    by_trace = {}
+    for e in evs:
+        if e.get("ph") != "X" or e.get("cat") != "request":
+            continue
+        trace_id = (e.get("args") or {}).get("trace_id")
+        if trace_id:
+            by_trace.setdefault(trace_id, []).append(e)
+    stats = report.get("request_traces") or {}
+    if not by_trace and not stats:
+        return
+    out.write("== requests ==\n")
+    if stats:
+        out.write(
+            f"  tail sampling: {stats.get('kept', 0)} kept, "
+            f"{stats.get('sampled_out', 0)} sampled out, "
+            f"{stats.get('budget_dropped', 0)} over budget "
+            f"(rolling p99 {_fmt_s(stats.get('rolling_p99_s'))})\n")
+
+    def root_of(spans):
+        # the root carries the outcome; children carry a parent
+        for e in spans:
+            if "outcome" in (e.get("args") or {}):
+                return e
+        return spans[0]
+
+    groups = sorted(by_trace.items(),
+                    key=lambda kv: -root_of(kv[1]).get("dur", 0.0))
+    for trace_id, spans in groups[:limit]:
+        root = root_of(spans)
+        args = root.get("args") or {}
+        out.write(f"  {trace_id} [{args.get('outcome', '?')}] "
+                  f"{_fmt_s(root.get('dur', 0.0) / 1e6)} "
+                  f"({len(spans)} spans)\n")
+        t0 = min(e["ts"] for e in spans)
+        for e in sorted(spans, key=lambda e: (e["ts"], e["name"])):
+            th = tid_names.get(e["tid"], f"tid {e['tid']}")
+            out.write(
+                f"    +{(e['ts'] - t0) / 1e3:7.2f}ms "
+                f"{_fmt_s(e.get('dur', 0.0) / 1e6):>9}  "
+                f"{e['name']:<20} @{th}\n")
+    if len(groups) > limit:
+        out.write(f"  ... {len(groups) - limit} more "
+                  f"(--requests {len(groups)} to see all)\n")
+
+
+def _print_flight(run_dir: str, report: dict, out, extra: str = None,
+                  tail: int = 8) -> None:
+    """Render the tail of every flight-recorder dump in the run dir
+    (plus any paths report.json recorded and an explicit --flight
+    path): the black box of what the engine was doing when the breaker
+    tripped / health went BROKEN."""
+    paths = sorted(
+        os.path.join(run_dir, fn) for fn in os.listdir(run_dir)
+        if fn.startswith("flight") and fn.endswith(".jsonl"))
+    seen = {os.path.abspath(p) for p in paths}
+    for p in list(report.get("flight_dumps") or []) + (
+            [extra] if extra else []):
+        ap = os.path.abspath(p)
+        if ap not in seen and os.path.exists(p):
+            seen.add(ap)
+            paths.append(p)
+    if not paths:
+        return
+    out.write("== flight recorder ==\n")
+    for p in paths:
+        try:
+            with open(p) as f:
+                lines = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, json.JSONDecodeError) as e:
+            out.write(f"  {p}: unreadable ({e})\n")
+            continue
+        if not lines:
+            out.write(f"  {p}: empty\n")
+            continue
+        header, events = lines[0], lines[1:]
+        out.write(f"  {p}\n    reason={header.get('reason')} "
+                  f"events={header.get('events')} "
+                  f"dropped={header.get('dropped')} "
+                  f"(last {min(tail, len(events))}):\n")
+        for evt in events[-tail:]:
+            detail = {k: v for k, v in evt.items()
+                      if k not in ("seq", "t", "mono", "thread", "kind")}
+            out.write(f"    #{str(evt.get('seq', '?')):<4} "
+                      f"[{evt.get('thread')}] {evt.get('kind')}: "
+                      f"{json.dumps(detail, sort_keys=True)}\n")
+
+
 def _print_regression(verdicts, out) -> bool:
     """Returns True when any verdict failed."""
     out.write("== regression gate ==\n")
@@ -136,11 +244,21 @@ def main(argv=None) -> int:
                     help="relative tolerance for --baseline (default 0.05)")
     ap.add_argument("--gate", action="store_true",
                     help="exit 3 when a regression verdict fails")
+    ap.add_argument("--requests", type=int, default=5,
+                    help="max per-request timelines to render "
+                         "(slowest first; default 5)")
+    ap.add_argument("--flight", default=None,
+                    help="render this flight-recorder dump too (dumps "
+                         "inside the run dir are picked up "
+                         "automatically)")
     args = ap.parse_args(argv)
     out = sys.stdout
 
     if not os.path.isdir(args.run_dir):
         sys.stderr.write(f"obsdump: {args.run_dir} is not a directory\n")
+        return 2
+    if args.flight and not os.path.exists(args.flight):
+        sys.stderr.write(f"obsdump: flight dump {args.flight} missing\n")
         return 2
     report = _load_report(args.run_dir)
     out.write(f"observability run: {os.path.abspath(args.run_dir)}\n")
@@ -149,6 +267,8 @@ def main(argv=None) -> int:
     reg = _aggregate_metrics(args.run_dir)
     if reg is not None:
         _print_metrics(reg, out)
+    _print_requests(args.run_dir, report, out, limit=max(0, args.requests))
+    _print_flight(args.run_dir, report, out, extra=args.flight)
 
     verdicts = report.get("regression") or []
     if args.baseline and not os.path.exists(args.baseline):

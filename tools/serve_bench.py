@@ -30,21 +30,32 @@ usage/environment errors (missing baseline file, --gate without
 --baseline, unknown model), 3 when --gate finds a regression.
 
   --chaos arms the FAULT_SERVE_* knobs (resilience/faultinject.py)
-  MID-RUN and reports how the serving tier recovered: engine mode arms a
-  one-shot dispatcher raise (plus a slow-step to make latency
-  observable) a third of the way through the replay and gives a slice of
-  the remaining requests unmeetable deadlines — the result gains
-  recovered/poisoned/timeout/shed counts plus breaker/restart totals;
-  decode mode arms a NaN-poisoned sequence and a page leak under a
-  check_every=1 integrity watchdog — the result gains quarantined /
-  reclaimed_pages / invariants_ok, and pages_leaked must still end 0.
-  Bank {"pages_leaked": 0, "invariants_ok": 1} and --gate asserts chaos
-  runs finish with zero leaked pages.
+  MID-RUN and reports how the serving tier recovered: engine mode turns
+  FLAGS_observability on, arms breaker_threshold dispatcher raises
+  (plus a slow-step to make latency observable) a third of the way
+  through the replay — enough consecutive failures to TRIP the circuit
+  breaker, which must leave a flight-recorder JSONL dump behind (the
+  run exits 2 if it does not) — and gives a slice of the remaining
+  requests unmeetable deadlines; the result gains recovered/poisoned/
+  timeout/shed/breaker_rejected counts plus breaker/restart totals and
+  flight_dumps.  Decode mode arms a NaN-poisoned sequence and a page
+  leak under a check_every=1 integrity watchdog — the result gains
+  quarantined / reclaimed_pages / invariants_ok, and pages_leaked must
+  still end 0.  Bank {"pages_leaked": 0, "invariants_ok": 1} (decode)
+  or {"flight_dumps": 1} (engine) and --gate asserts chaos runs finish
+  with zero leaked pages and a black-box artifact.
+
+Every report carries `started_at`/`finished_at` wall-clock timestamps;
+with --obs-dir (or an engine chaos run, which picks a temp dir) the
+run's observability artifacts (metrics.prom with exemplars, merged
+trace.json, flight dumps) are exported there and their paths land in
+the report's `artifacts`, so a banked gate result correlates back to
+the traces behind it.
 
 Usage:
     python tools/serve_bench.py --model mnist --requests 50 --rate 200
     python tools/serve_bench.py --mode decode --sequences 8 --max-new 16
-    python tools/serve_bench.py ... --json out.json
+    python tools/serve_bench.py ... --json out.json --obs-dir obs_run
     python tools/serve_bench.py ... --baseline BANK.json --tol 0.15 --gate
     python tools/serve_bench.py --mode decode --chaos --gate \
         --baseline CHAOS_BANK.json
@@ -112,12 +123,16 @@ def _build_artifact(model: str, out_dir: str):
 
 
 def run_engine_bench(args) -> dict:
+    from paddle_tpu import flags as pflags
     from paddle_tpu import serving
     from paddle_tpu.resilience import faultinject
 
     chaos = bool(args.chaos)
     arm_at = max(1, args.requests // 3) if chaos else None
-    recovered = poisoned = timeouts = 0
+    recovered = poisoned = timeouts = breaker_rejected = 0
+    # enough consecutive raises to TRIP the breaker (the flight
+    # recorder's dump trigger), not just poison one batch
+    breaker_threshold = int(pflags.flag("serving_breaker_threshold"))
     # the arm step setdefault()s FAULT_SERVE_SLOW_STEP_MS so an
     # operator-exported value wins — cleanup must restore it, not pop it
     prior_slow = os.environ.get("FAULT_SERVE_SLOW_STEP_MS")
@@ -127,7 +142,9 @@ def run_engine_bench(args) -> dict:
             buckets = serving.parse_buckets(args.buckets)
             cfg = serving.EngineConfig(
                 buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
-                queue_depth=args.queue_depth)
+                queue_depth=args.queue_depth,
+                # a chaos run must outlive its own induced outage
+                breaker_cooldown_s=0.25 if chaos else None)
             engine = serving.Engine.from_artifact(predict, config=cfg,
                                                   name="serve_bench")
             rng = np.random.RandomState(args.seed)
@@ -150,9 +167,11 @@ def run_engine_bench(args) -> dict:
             pending = []
             for i, f in enumerate(reqs):
                 if chaos and i == arm_at:
-                    # mid-run chaos: one poisoned batch + sustained
+                    # mid-run chaos: breaker_threshold poisoned batches
+                    # (tripping the breaker -> flight dump) + sustained
                     # dispatch latency (makes shedding observable)
-                    os.environ["FAULT_SERVE_DISPATCH_RAISE"] = "1"
+                    os.environ["FAULT_SERVE_DISPATCH_RAISE"] = str(
+                        breaker_threshold)
                     os.environ.setdefault("FAULT_SERVE_SLOW_STEP_MS", "2")
                 # closed-loop pacing: sleep to the Poisson schedule, but
                 # never ahead of it
@@ -170,6 +189,10 @@ def run_engine_bench(args) -> dict:
                     # deadline-shed at submit: the engine counts these
                     # itself — reported below as "shed_requests"
                     pass
+                except serving.EngineUnhealthyError:
+                    # breaker open (chaos): submit fails fast — the
+                    # replica-shedding signal a real router acts on
+                    breaker_rejected += 1
             lat = []
             rows = 0
             for t0, fut, i in pending:
@@ -223,6 +246,7 @@ def run_engine_bench(args) -> dict:
             "poisoned_requests": poisoned,
             "timeout_requests": timeouts,
             "shed_requests": stats["shed"],
+            "breaker_rejected_requests": breaker_rejected,
             "internal_errors": stats["internal_errors"],
             "breaker_trips": stats["breaker_trips"],
             "dispatcher_restarts": stats["dispatcher_restarts"],
@@ -312,9 +336,11 @@ def run_decode_bench(args) -> dict:
 
 
 # metrics where bigger is better; everything else (latencies, leak
-# counters) gates as lower-is-better
+# counters) gates as lower-is-better.  flight_dumps is higher-is-better
+# so banking {"flight_dumps": 1} asserts the chaos breaker trip left a
+# black-box artifact behind
 _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
-                     "recovered", "invariants_ok")
+                     "recovered", "invariants_ok", "flight_dumps")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -386,6 +412,13 @@ def main(argv=None) -> int:
                          "shed deadlines; decode: NaN sequence + page "
                          "leak under a check_every=1 watchdog)")
     ap.add_argument("--json", default=None, help="write the result dict here")
+    ap.add_argument("--obs-dir", default=None,
+                    help="enable FLAGS_observability for the run and "
+                         "export its artifacts (metrics.prom with "
+                         "exemplars, merged trace.json, flight dumps) "
+                         "into this directory; their paths land in the "
+                         "report (engine chaos runs default to a temp "
+                         "dir — the flight recorder needs a home)")
     ap.add_argument("--baseline", default=None,
                     help="banked {metric: value} JSON to gate against")
     ap.add_argument("--tol", type=float, default=0.15)
@@ -404,9 +437,52 @@ def main(argv=None) -> int:
             f"serve_bench: baseline {args.baseline} missing\n")
         return 2
 
-    result = (run_engine_bench(args) if args.mode == "engine"
-              else run_decode_bench(args))
+    # observability for the run: --obs-dir opts in explicitly; an engine
+    # chaos run opts in implicitly (its contract is "the induced breaker
+    # trip leaves a flight-recorder dump", and the flight recorder — like
+    # every instrument — only runs with FLAGS_observability on)
+    obs_dir = args.obs_dir
+    chaos_engine = bool(args.chaos) and args.mode == "engine"
+    if chaos_engine and not obs_dir:
+        obs_dir = tempfile.mkdtemp(prefix="serve_bench_obs_")
+    prev_flags = None
+    started_at = time.time()
+    if obs_dir:
+        from paddle_tpu import flags as pflags
+        from paddle_tpu import observability as obs
+
+        prev_flags = {k: pflags.flag(k)
+                      for k in ("FLAGS_observability", "FLAGS_flight_dir")}
+        pflags.set_flags({"FLAGS_observability": True,
+                          "FLAGS_flight_dir": obs_dir})
+        obs.reset()  # run-scoped artifacts, not whatever came before
+    try:
+        result = (run_engine_bench(args) if args.mode == "engine"
+                  else run_decode_bench(args))
+    finally:
+        if prev_flags is not None:
+            pflags.set_flags(prev_flags)
+    result["started_at"] = started_at
+    result["finished_at"] = time.time()
+    if obs_dir:
+        obs.export_run(obs_dir)
+        dumps = list(obs.default_flight().dump_paths)
+        result["flight_dumps"] = len(dumps)
+        result["artifacts"] = {
+            "obs_dir": os.path.abspath(obs_dir),
+            "trace": os.path.join(os.path.abspath(obs_dir), "trace.json"),
+            "metrics": os.path.join(os.path.abspath(obs_dir),
+                                    "metrics.prom"),
+            "flight_dumps": dumps,
+        }
     print(json.dumps(result, indent=1, sort_keys=True))
+    if chaos_engine and not result.get("flight_dumps"):
+        # the chaos harness itself failed to produce its black box —
+        # an environment error (exit 2), not a regression verdict
+        sys.stderr.write(
+            "serve_bench: chaos induced a breaker trip but no "
+            "flight-recorder dump was written\n")
+        return 2
 
     failed = False
     if args.baseline:
