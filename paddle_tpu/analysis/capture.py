@@ -86,6 +86,7 @@ def _flat_donatable(args: Tuple, donate_argnums) -> frozenset:
 def capture_fn(fn, *args, name: str = "fn", donate_argnums=(),
                donatable_argnums=None, topology=None, fingerprint: str = "",
                extra_hazards: Optional[List[Finding]] = None,
+               in_shardings=None, out_shardings=None,
                ) -> ProgramArtifacts:
     """Trace/lower/AOT-compile ``fn(*args)`` for the v5e topology and
     return its artifact bundle.  Args may be concrete values or
@@ -95,7 +96,13 @@ def capture_fn(fn, *args, name: str = "fn", donate_argnums=(),
     donatable_argnums (default: same) is what is ELIGIBLE for donation —
     the missed-donation detector flags eligible-but-unaliased buffers, so
     passing donatable_argnums without donate_argnums models a caller that
-    forgot to donate."""
+    forgot to donate.
+
+    in_shardings/out_shardings capture SPMD programs (shard_map over a
+    mesh of the topology's devices): the analyzed HLO is then the
+    per-chip partitioned module — its cost model prices per-chip
+    bytes/step, and collectives (all-gather/all-reduce) are visible to
+    the collective-placement detector."""
     from .. import flags
     from ..core.aot_tpu import trace_tpu
 
@@ -108,7 +115,9 @@ def capture_fn(fn, *args, name: str = "fn", donate_argnums=(),
     # forcing cost_analysis(platform="tpu") does
     with flags.tpu_trace_scope(True):
         traced = trace_tpu(fn, *args, topology=topology,
-                           donate_argnums=tuple(donate_argnums))
+                           donate_argnums=tuple(donate_argnums),
+                           in_shardings=in_shardings,
+                           out_shardings=out_shardings)
         jaxpr = traced.jaxpr
         lowered = traced.lower()
         stablehlo = lowered.as_text()

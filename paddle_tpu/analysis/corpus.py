@@ -111,6 +111,37 @@ def _bf16_promotion_escape() -> ProgramArtifacts:
         name="corpus_bf16_escape")
 
 
+def _all_gather_replicated() -> ProgramArtifacts:
+    """The SPMD placement hazard (ISSUE 10): a shard_map body
+    all-gathers a >=1MB sharded activation onto EVERY chip and then
+    consumes it with a plain reduction — the gather moves and
+    materializes n_shards x the bytes a psum/psum_scatter placement
+    would have (each chip only needed its shard's contribution)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..core.aot_tpu import tpu_topology
+
+    topo = tpu_topology("v5e:2x2", chips_per_host=(2, 2, 1))
+    mesh = Mesh(np.array(topo.devices), ("tp",))
+
+    def body(xl):
+        g = jax.lax.all_gather(xl, "tp", axis=0, tiled=True)  # full [S, D]
+        return jnp.sum(g * g, axis=0)
+
+    def fn(x):
+        # check_vma off: the checker cannot infer that a gathered-then-
+        # reduced value is replicated — which is part of the smell
+        return jax.shard_map(body, mesh=mesh, in_specs=P("tp", None),
+                             out_specs=P(), check_vma=False)(x)
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((4096, 128), jnp.float32),
+        name="corpus_all_gather", topology=topo,
+        in_shardings=(NamedSharding(mesh, P("tp", None)),),
+        out_shardings=NamedSharding(mesh, P()))
+
+
 def _host_callback() -> ProgramArtifacts:
     """A host callback inside the step body: every execution round-trips
     the host, draining the device pipeline."""
@@ -135,6 +166,8 @@ CORPUS = {
     "weak_type": (_weak_type_scalar, "recompile-hazard"),
     "bf16_escape": (_bf16_promotion_escape, "dtype-promotion"),
     "host_callback": (_host_callback, "host-sync"),
+    "all_gather_replicated": (_all_gather_replicated,
+                              "collective-placement"),
 }
 
 

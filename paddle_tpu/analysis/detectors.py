@@ -1,4 +1,4 @@
-"""The six detectors of the chip-less program linter.
+"""The detectors of the chip-less program linter.
 
 Each detector is ``fn(ProgramArtifacts) -> List[Finding]`` over the
 captured jaxpr / TPU StableHLO / optimized chip HLO — no execution.  The
@@ -25,6 +25,12 @@ AOT_COST_ZOO.json baselines key on them):
                        finding)
   host-sync            host callbacks / infeed / outfeed inside the
                        program body — every step round-trips the host
+  collective-placement all-gather / all-reduce collectives in the SPMD
+                       module materializing a full-replicated tensor
+                       >= 1MB on every chip — where a psum_scatter /
+                       reduce-scatter would keep shards, the collective
+                       moves (and each device then holds) n_shards x
+                       the bytes the consumer needed
 """
 
 from __future__ import annotations
@@ -431,6 +437,69 @@ def detect_host_sync(art: ProgramArtifacts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# collective-placement
+
+_COLLECTIVE_MIN_BYTES = 1 << 20
+# opcodes that MATERIALIZE a replicated/enlarged result on every
+# participating chip; reduce-scatter/psum_scatter (results stay
+# shard-sized) are the fix, not a finding
+_MATERIALIZING_COLLECTIVES = ("all-gather", "all-reduce")
+_SH_COLLECTIVE_RE = None  # compiled lazily below
+
+
+def detect_collective_placement(art: ProgramArtifacts) -> List[Finding]:
+    """All-gather / all-reduce in the SPMD module whose result is a
+    >=1MB tensor: every chip receives (and holds) the FULL tensor even
+    though a shard-local consumer only needed 1/n of it — the
+    psum_scatter / reduce-scatter placement keeps shards instead.
+    Inspected on the optimized per-chip HLO (what actually ships);
+    falls back to the lowered StableHLO when the chip compile was
+    rejected, so the detector never goes blind on a broken program."""
+    findings: List[Finding] = []
+
+    def note(opcode: str, where: str, b: int) -> None:
+        findings.append(Finding(
+            detector="collective-placement", severity="warning",
+            program=art.name, fingerprint=art.fingerprint,
+            bytes=b, where=where,
+            message=(f"{opcode} materializes a full-replicated "
+                     f"{b}-byte tensor on every chip: if the consumer "
+                     "is shard-local (elementwise, a reduction, the "
+                     "next row-parallel matmul), a psum_scatter/"
+                     "reduce-scatter keeps per-chip traffic and "
+                     "residency at 1/n_shards"),
+        ))
+
+    if art.hlo:
+        for instr in H.entry_instructions(art.hlo):
+            if instr.opcode not in _MATERIALIZING_COLLECTIVES:
+                continue
+            b = sum(s.bytes for s in instr.shapes)
+            if b >= _COLLECTIVE_MIN_BYTES:
+                note(instr.opcode, instr.name, b)
+        return findings
+    # StableHLO fallback (compile_error path): same opcode family in
+    # the lowered module's text, result type last on the line
+    import re
+
+    global _SH_COLLECTIVE_RE
+    if _SH_COLLECTIVE_RE is None:
+        _SH_COLLECTIVE_RE = re.compile(
+            r"stablehlo\.(all_gather|all_reduce)\b")
+    for line in art.stablehlo.splitlines():
+        m = _SH_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        types = H._SH_TENSOR_RE.findall(line)
+        if not types:
+            continue
+        b = H._tensor_elems_bytes(types[-1])
+        if b >= _COLLECTIVE_MIN_BYTES:
+            note(m.group(1).replace("_", "-"), m.group(1), b)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 DETECTORS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "relayout-copy-pair": detect_relayout_copies,
@@ -439,6 +508,7 @@ DETECTORS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "recompile-hazard": detect_recompile_hazards,
     "dtype-promotion": detect_dtype_promotions,
     "host-sync": detect_host_sync,
+    "collective-placement": detect_collective_placement,
 }
 
 

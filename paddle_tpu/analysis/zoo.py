@@ -17,6 +17,15 @@ host):
                      counts the analytic page-stream traffic on top of
                      the XLA-visible bytes, same methodology as the
                      banked artifact
+  sharded_decode     the tensor-parallel serving decode step
+                     (serving/distributed/sharded.py) under shard_map
+                     over a 4-chip v5e 2x2 mesh — full transformer
+                     step with head-sharded QKV/pool, psum joins, and
+                     the per-shard pallas page walk; the analyzed HLO
+                     is the PER-CHIP partitioned module, so its banked
+                     bytes/step is per-chip (plus each chip's analytic
+                     page-stream share), and the SPMD collectives are
+                     in scope for collective-placement
 
 Baselines live in AOT_COST_ZOO.json: per-program finding counts by
 detector plus AOT bytes/step + flops/step (extending AOT_COST_AB /
@@ -141,10 +150,69 @@ def _build_paged_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, extra, cfg
 
 
+def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from ..core.aot_tpu import tpu_topology
+    from ..kernels.paged_attention import attention_bytes_per_step
+    from ..serving.distributed import sharded as _sh
+    from ..serving.generate import DecodeConfig
+
+    # the paged_decode attention geometry (H=8, D=128, ps=16), grown to
+    # the full decode step and split 4 ways
+    n, B, num_pages, maxp, ps = 4, 4, 64, 8, 16
+    dcfg = DecodeConfig(vocab_size=256, d_model=1024, n_head=8,
+                        n_layer=1, d_inner=2048, max_length=maxp * ps)
+    cfg = {"n_shards": n, "batch": B, "heads": dcfg.n_head,
+           "head_dim": dcfg.head_dim, "d_model": dcfg.d_model,
+           "n_layer": dcfg.n_layer, "vocab": dcfg.vocab_size,
+           "num_pages": num_pages, "max_pages": maxp, "page_size": ps,
+           "impl": "pallas", "topology": "v5e:2x2"}
+    topo = tpu_topology("v5e:2x2", chips_per_host=(2, 2, 1))
+    mesh = Mesh(np.array(topo.devices), (_sh.AXIS_TP,))
+    kv_spec = PartitionSpec(None, _sh.AXIS_TP, None, None, None)
+    body = _sh.decode_step_fn(dcfg, n, impl=cfg["impl"])
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(_sh.param_partition_specs(dcfg),)
+        + (PartitionSpec(),) * 6 + (kv_spec, kv_spec),
+        out_specs=(PartitionSpec(), kv_spec, kv_spec),
+        check_vma=False)  # no replication rule for pallas_call
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    kv = jax.ShapeDtypeStruct(
+        (dcfg.n_layer, dcfg.n_head, num_pages, ps, dcfg.head_dim),
+        jnp.float32)
+    rep = NamedSharding(mesh, PartitionSpec())
+    kv_sh = NamedSharding(mesh, kv_spec)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), _sh.param_partition_specs(dcfg),
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    art = capture_fn(
+        fn, _sh.param_shape_dtypes(dcfg), i32(B), i32(B), i32(B), i32(B),
+        i32(B, maxp), i32(B), kv, kv,
+        name="sharded_decode",
+        topology=topo,
+        # the pool shards alias in->out (the on-chip in-place append)
+        donate_argnums=(7, 8),
+        in_shardings=(param_sh,) + (rep,) * 6 + (kv_sh, kv_sh),
+        out_shardings=(rep, kv_sh, kv_sh))
+    # per-chip analytic page-stream share: each chip walks its OWN
+    # heads' pages (H/n of the batch's KV traffic), invisible to the
+    # XLA cost model like the single-device paged_decode entry
+    extra = float(attention_bytes_per_step(
+        cfg["impl"], B, maxp, ps, dcfg.n_head // n, dcfg.head_dim,
+        num_layers=dcfg.n_layer))
+    return art, extra, cfg
+
+
 ZOO = {
     "resnet50_train": _build_resnet50,
     "transformer_train": _build_transformer,
     "paged_decode": _build_paged_decode,
+    "sharded_decode": _build_sharded_decode,
 }
 
 

@@ -36,19 +36,26 @@ _DEFAULT_TOPOLOGY = "v5e:1x1"
 
 
 @functools.lru_cache(maxsize=4)
-def tpu_topology(name: str | None = None):
-    """PJRT TopologyDescription for a TPU slice, no hardware needed."""
+def tpu_topology(name: str | None = None,
+                 chips_per_host: tuple | None = None):
+    """PJRT TopologyDescription for a TPU slice, no hardware needed.
+
+    `chips_per_host` overrides the host layout for multi-chip slices
+    (e.g. ``tpu_topology("v5e:2x2", chips_per_host=(2, 2, 1))`` — one
+    4-chip host, the mesh the SPMD serving programs compile against);
+    default: PADDLE_TPU_CHIPS_PER_HOST, else one chip per host."""
     # libtpu probes GCP instance metadata unless told not to; on a
     # non-GCP host that is 30 retries of a dead URL per variable
     os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
     from jax.experimental import topologies
 
     name = name or os.environ.get("PADDLE_TPU_TOPOLOGY", _DEFAULT_TOPOLOGY)
-    cphb = tuple(
+    cphb = chips_per_host or tuple(
         int(v) for v in os.environ.get(
             "PADDLE_TPU_CHIPS_PER_HOST", "1,1,1").split(","))
     return topologies.get_topology_desc(
-        platform="tpu", topology_name=name, chips_per_host_bounds=cphb)
+        platform="tpu", topology_name=name,
+        chips_per_host_bounds=tuple(cphb))
 
 
 def _replicated_sharding(topology):
@@ -73,7 +80,8 @@ def _abstract(v):
     return jax.ShapeDtypeStruct(np.shape(v), dt)
 
 
-def trace_tpu(fn, *args, topology=None, donate_argnums=()):
+def trace_tpu(fn, *args, topology=None, donate_argnums=(),
+              in_shardings=None, out_shardings=None):
     """Trace `fn(*args)` against the TPU topology and return the
     jax.stages.Traced — `.jaxpr` for static analysis, `.lower()` for the
     TPU StableHLO / compiled executable.  One trace serves all three
@@ -85,10 +93,17 @@ def trace_tpu(fn, *args, topology=None, donate_argnums=()):
     missed-donation detector audits.  keep_unused pins entry parameters
     1:1 to the flat args: without it jit prunes unused args from the
     executable, shifting every parameter index the analyzer computed
-    from the python signature."""
+    from the python signature.
+
+    in_shardings/out_shardings: NamedShardings over a mesh of the
+    topology's devices, for SPMD programs (shard_map serving steps,
+    collective corpus entries); default replicates everything over the
+    whole slice — the single-program case."""
     topo = topology or tpu_topology()
     s = _replicated_sharding(topo)
-    fj = jax.jit(fn, in_shardings=s, out_shardings=s,
+    fj = jax.jit(fn,
+                 in_shardings=s if in_shardings is None else in_shardings,
+                 out_shardings=s if out_shardings is None else out_shardings,
                  donate_argnums=donate_argnums, keep_unused=True)
     absargs = jax.tree_util.tree_map(_abstract, args)
     return fj.trace(*absargs)

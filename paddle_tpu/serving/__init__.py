@@ -59,6 +59,14 @@ histograms carry OpenMetrics exemplars; and a flight recorder
 JSONL whenever the breaker trips or health() enters BROKEN.
 tools/serve_bench.py is the closed-loop load generator + regression
 gate.
+
+Scaling past one chip (ISSUE 10) lives in ``serving/distributed/``:
+tensor-parallel decode under shard_map (ShardedDecodeProgram +
+head-sharded ShardedKVCachePool — the ContinuousBatchingLoop takes it
+via ``program=``) and data-parallel Engine replicas behind one
+admission Router with health/lease-aware dispatch and drain-based
+replica handoff.  ``serve_bench --replicas N`` / ``--mesh N`` bench
+both axes chip-less.
 """
 
 from .batching import BucketLadder, parse_buckets
@@ -85,6 +93,7 @@ from .generate import (
     prefill_step,
 )
 from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
+from . import distributed  # noqa: F401 — serving.distributed is API
 
 __all__ = [
     "AotBackend",

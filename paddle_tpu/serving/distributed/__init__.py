@@ -1,0 +1,48 @@
+"""Mesh-sharded serving: tensor-parallel decode + replicated engines.
+
+The serving tier's two single-device ceilings fall here, composably:
+
+- **Tensor parallelism** (`sharded.py`): :class:`ShardedDecodeProgram`
+  runs the transformer decode step under ``jax.shard_map`` over a
+  device mesh — attention and MLP weights column/row-sharded across the
+  ``tp`` axis, partial products combined with ``psum`` over ICI — and
+  :class:`ShardedKVCachePool` gives the paged KV cache a per-shard view
+  (``[L, H/n_shards, P, page_size, D]`` per device), so every device
+  owns its heads' pages and both the K/V append and the paged-attention
+  page walk stay device-local.  One model, ``n_shards`` chips, no
+  resharding on the decode hot path.
+- **Data parallelism** (`router.py`): :class:`Router` fronts N
+  ``Engine`` replicas behind one ``submit(feed) -> Future`` API —
+  health-aware least-queue-depth dispatch (skipping DEGRADED/BROKEN
+  replicas via ``engine.health()``), replica membership on the elastic
+  master's heartbeat/lease seam (:class:`ReplicaDirectory`), and
+  drain-based handoff: a draining replica finishes its in-flight
+  sequences while the router routes new traffic elsewhere.
+
+Everything is proven chip-less: ``XLA_FLAGS=--xla_force_host_platform_
+device_count=N`` gives an N-device CPU mesh on which the SPMD decode
+step is token-identical to the single-device oracle
+(tests/test_distributed_serving.py), and the AOT v5e cost tier prices
+the sharded program's per-chip bytes/step (the ``sharded_decode``
+entry of the analysis model zoo, gated in AOT_COST_ZOO.json).
+"""
+
+from .router import (
+    ReplicaDirectory,
+    ReplicaUnavailableError,
+    Router,
+)
+from .sharded import (
+    ShardedDecodeProgram,
+    ShardedKVCachePool,
+    host_mesh_devices,
+)
+
+__all__ = [
+    "ReplicaDirectory",
+    "ReplicaUnavailableError",
+    "Router",
+    "ShardedDecodeProgram",
+    "ShardedKVCachePool",
+    "host_mesh_devices",
+]

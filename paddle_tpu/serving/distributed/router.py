@@ -1,0 +1,349 @@
+"""Admission router: N Engine replicas behind one submit/Future API.
+
+Tensor parallelism (sharded.py) makes one model instance faster; this
+makes MANY instances one service.  The Router owns a set of named
+Engine replicas and routes each submit() to the healthiest one:
+
+- **Health-aware dispatch**: candidates are ranked by
+  ``engine.health()`` — SERVING replicas first, then (optionally)
+  DEGRADED ones, least queue depth within a rank; BROKEN and DRAINING
+  replicas are skipped outright.  A submit that still bounces
+  (queue-full race, breaker opening between the health poll and the
+  enqueue) falls through to the next candidate, so one sick replica
+  costs a skip counter, never a request.
+- **Lease-based membership**: an optional :class:`ReplicaDirectory`
+  rides the elastic master's heartbeat/lease seam (elastic/master.py
+  ``heartbeat``/``dead_workers`` — in-process or over the RPC plane's
+  :class:`~paddle_tpu.elastic.rpc.RemoteMaster`): each replica process
+  heartbeats ``replica/<name>``; a replica whose lease went silent past
+  ``max_silence_s`` stops receiving traffic before its first failed
+  dispatch.
+- **Drain-based handoff**: ``drain_replica(name)`` atomically stops
+  routing to a replica, then triggers the engine's own drain — queued
+  and in-flight requests complete on the draining replica while new
+  traffic lands on the survivors.  Zero requests are lost or duplicated
+  in the handoff (tests/test_distributed_serving.py pins this).
+
+Observability follows the serving pattern (callers gate on
+FLAGS_observability): routing decisions land on the
+``paddle_tpu_serving_router_decisions{decision=,replica=}`` counter,
+per-replica health on ``paddle_tpu_serving_replica_health_state
+{replica=}``, and every engine flight-recorder / request-trace event
+carries the ``replica`` field once an engine joins a router — so after
+``MetricsRegistry.aggregate_dir()`` merges per-process dumps, a BROKEN
+replica's black box and kept traces are still attributable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ... import flags as _flags
+from .. import metrics as _smetrics
+from ..engine import (
+    Engine,
+    EngineClosedError,
+    EngineUnhealthyError,
+    QueueFullError,
+)
+
+__all__ = ["ReplicaDirectory", "ReplicaUnavailableError", "Router"]
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """No replica could admit the request: every member was BROKEN,
+    DRAINING, lease-expired, or rejected the submit.  Carries the
+    per-replica reasons on ``.skipped``."""
+
+    def __init__(self, skipped: Dict[str, str]):
+        self.skipped = dict(skipped)
+        detail = ", ".join(f"{n}: {r}" for n, r in sorted(skipped.items()))
+        super().__init__(
+            f"no replica available ({detail or 'router has no replicas'})")
+
+
+class ReplicaDirectory:
+    """Replica membership on the elastic master's heartbeat/lease seam.
+
+    ``master`` is anything speaking the MasterService liveness protocol
+    — the in-process :class:`~paddle_tpu.elastic.master.MasterService`
+    or a :class:`~paddle_tpu.elastic.rpc.RemoteMaster` over the TCP
+    plane (cross-process replicas heartbeat the same master the elastic
+    trainers use).  A replica registers once, beats periodically, and
+    is considered lease-expired after ``max_silence_s`` of silence —
+    the router stops routing to it without waiting for a failed
+    dispatch."""
+
+    _PREFIX = "replica/"
+
+    def __init__(self, master, max_silence_s: float = 2.0):
+        self.master = master
+        self.max_silence_s = float(max_silence_s)
+
+    def register(self, name: str) -> None:
+        self.beat(name)
+
+    def beat(self, name: str) -> None:
+        self.master.heartbeat(self._PREFIX + name)
+
+    def expired(self) -> List[str]:
+        """Replica names whose lease lapsed (never-registered names are
+        not listed — an unknown replica is the router's call)."""
+        dead = self.master.dead_workers(self.max_silence_s)
+        return [w[len(self._PREFIX):] for w in dead
+                if w.startswith(self._PREFIX)]
+
+
+class _Replica:
+    __slots__ = ("name", "engine", "routing", "routed", "skipped",
+                 "health_at", "health")
+
+    def __init__(self, name: str, engine: Engine):
+        self.name = name
+        self.engine = engine
+        self.routing = True   # False once drain_replica claimed it
+        self.routed = 0
+        self.skipped = 0
+        self.health_at = -1.0   # perf_counter of the cached snapshot
+        self.health: Optional[Dict[str, Any]] = None
+
+
+# health states that may receive traffic, in preference order
+_RANK = {"SERVING": 0, "DEGRADED": 1}
+
+
+class Router:
+    """Front N Engine replicas behind one thread-safe submit()."""
+
+    def __init__(self, replicas: Optional[Sequence[Engine]] = None,
+                 directory: Optional[ReplicaDirectory] = None,
+                 allow_degraded: bool = True, name: str = "router",
+                 health_cache_s: float = 0.05):
+        self.name = name
+        self.directory = directory
+        self.allow_degraded = bool(allow_degraded)
+        # routing reads health/lease state through a short-TTL cache so
+        # per-submit cost does not scale with fleet size (engine.health()
+        # takes engine locks + writes gauges; directory.expired() can be
+        # an RPC).  0 disables — every submit polls fresh.  Stale reads
+        # are bounded and safe: a submit that lands on a replica the
+        # cache thought healthy falls over on the raced rejection.
+        self.health_cache_s = float(health_cache_s)
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._handoffs = 0
+        self._expired_at = -1.0
+        self._expired_cache: frozenset = frozenset()
+        for eng in replicas or ():
+            self.add_replica(eng)
+
+    # -- membership -----------------------------------------------------
+
+    def add_replica(self, engine: Engine,
+                    name: Optional[str] = None) -> str:
+        """Join a replica (default name: the engine's own).  The engine
+        is labeled so its flight-recorder events, request traces, and
+        health gauges carry ``replica=<name>`` from here on."""
+        name = name or engine.name
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already joined")
+            self._replicas[name] = _Replica(name, engine)
+        engine.replica = name
+        if self.directory is not None:
+            self.directory.register(name)
+        return name
+
+    def remove_replica(self, name: str) -> Engine:
+        """Forget a replica (it should be drained first — the router
+        stops routing but does NOT close the engine)."""
+        with self._lock:
+            rep = self._replicas.pop(name)
+        return rep.engine
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def engine(self, name: str) -> Engine:
+        with self._lock:
+            return self._replicas[name].engine
+
+    # -- routing --------------------------------------------------------
+
+    def _note_skip(self, rep: _Replica, reason: str,
+                   skipped: Dict[str, str], obs_on: bool) -> None:
+        skipped.setdefault(rep.name, reason)
+        with self._lock:
+            rep.skipped += 1
+        if obs_on:
+            _smetrics.record_router_decision("skipped_unhealthy", rep.name)
+
+    def _expired(self) -> frozenset:
+        """Lease-expired replica names, through the routing cache."""
+        if self.directory is None:
+            return frozenset()
+        now = time.perf_counter()
+        with self._lock:
+            if now - self._expired_at <= self.health_cache_s \
+                    and self._expired_at >= 0:
+                return self._expired_cache
+        expired = frozenset(self.directory.expired())  # outside the lock
+        with self._lock:
+            self._expired_at = time.perf_counter()
+            self._expired_cache = expired
+        return expired
+
+    def _health_of(self, rep: _Replica) -> Dict[str, Any]:
+        """rep.engine.health(), through the routing cache."""
+        now = time.perf_counter()
+        with self._lock:
+            if rep.health is not None \
+                    and now - rep.health_at <= self.health_cache_s:
+                return rep.health
+        h = rep.engine.health()  # outside the lock: takes engine locks
+        with self._lock:
+            rep.health_at = time.perf_counter()
+            rep.health = h
+        return h
+
+    def _candidates(self, skipped: Dict[str, str],
+                    obs_on: bool) -> List[Tuple[int, int, _Replica]]:
+        """(rank, queue_depth, replica) for every routable replica;
+        unroutable ones land in `skipped` with their reason AND on the
+        skip counters — a request served elsewhere still passed this
+        replica over, which is the signal an operator alerts on."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        expired = self._expired()
+        out: List[Tuple[int, int, _Replica]] = []
+        for rep in reps:
+            if not rep.routing:
+                skipped.setdefault(rep.name, "draining")
+                continue  # a claimed handoff is expected, not a skip
+            if rep.name in expired:
+                self._note_skip(rep, "lease_expired", skipped, obs_on)
+                continue
+            h = self._health_of(rep)
+            rank = _RANK.get(h["state"])
+            if rank is None or (rank and not self.allow_degraded):
+                self._note_skip(rep, h["state"].lower(), skipped, obs_on)
+                continue
+            out.append((rank, h["queue_depth"], rep))
+        out.sort(key=lambda t: (t[0], t[1], t[2].name))
+        return out
+
+    def submit(self, feed: Dict[str, Any],
+               timeout: Optional[float] = None,
+               call_kwargs: Optional[Dict[str, Any]] = None) -> Future:
+        """Route one request to the healthiest replica; the returned
+        Future carries ``.replica`` (the serving replica's name) next to
+        the engine's usual ``.trace_id``.  Raises
+        ReplicaUnavailableError when nothing can admit."""
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        skipped: Dict[str, str] = {}
+        for _, _, rep in self._candidates(skipped, obs_on):
+            try:
+                fut = rep.engine.submit(feed, timeout=timeout,
+                                        call_kwargs=call_kwargs)
+            except (QueueFullError, EngineUnhealthyError,
+                    EngineClosedError) as e:
+                # the health poll raced the rejection — skip and try the
+                # next candidate instead of failing the request
+                self._note_skip(rep, type(e).__name__, skipped, obs_on)
+                continue
+            fut.replica = rep.name
+            with self._lock:
+                rep.routed += 1
+                if rep.health is not None:
+                    # keep least-queue ranking live INSIDE the cache
+                    # TTL: the routed request deepens this replica's
+                    # cached queue (copy — the snapshot was handed out)
+                    rep.health = dict(
+                        rep.health,
+                        queue_depth=rep.health["queue_depth"] + 1)
+            if obs_on:
+                _smetrics.record_router_decision("routed", rep.name)
+            return fut
+        raise ReplicaUnavailableError(skipped)
+
+    def infer(self, feed: Dict[str, Any],
+              timeout: Optional[float] = None,
+              call_kwargs: Optional[Dict[str, Any]] = None):
+        return self.submit(feed, timeout=timeout,
+                           call_kwargs=call_kwargs).result()
+
+    # -- drain-based handoff ---------------------------------------------
+
+    def drain_replica(self, name: str,
+                      timeout: Optional[float] = None) -> bool:
+        """Hand a replica's traffic off to the survivors: atomically
+        stop routing to it, then drain its engine (queued + in-flight
+        requests complete there).  Returns True when fully drained;
+        False leaves the replica claimed but still finishing (poll
+        again with another drain_replica call).  The replica stays a
+        member until remove_replica — its health remains visible while
+        it finishes."""
+        with self._lock:
+            rep = self._replicas[name]
+            first = rep.routing
+            rep.routing = False
+            if first:
+                self._handoffs += 1
+        if first and _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_router_decision("handoff", name)
+        return rep.engine.drain(timeout)
+
+    # -- introspection ---------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Per-replica engine.health() snapshots plus routing state —
+        and, with observability on, the per-replica gauges the merged
+        (aggregate_dir) view keys on."""
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        with self._lock:
+            reps = list(self._replicas.values())
+        expired = set(self.directory.expired()) if self.directory else ()
+        out: Dict[str, Any] = {"replicas": {}, "handoffs": self._handoffs}
+        for rep in reps:
+            h = rep.engine.health()
+            h["routing"] = rep.routing and rep.name not in expired
+            h["lease_expired"] = rep.name in expired
+            out["replicas"][rep.name] = h
+            if obs_on:
+                _smetrics.record_replica_health(
+                    rep.name, h["state"], h["queue_depth"])
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replicas": {
+                    r.name: {"routed": r.routed, "skipped": r.skipped,
+                             "routing": r.routing}
+                    for r in self._replicas.values()
+                },
+                "routed": sum(r.routed for r in self._replicas.values()),
+                "skipped": sum(r.skipped for r in self._replicas.values()),
+                "handoffs": self._handoffs,
+            }
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain and close every replica engine."""
+        with self._lock:
+            reps = list(self._replicas.values())
+            for rep in reps:
+                rep.routing = False
+        for rep in reps:
+            rep.engine.close(timeout)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,442 @@
+"""Tensor-parallel decode: the transformer decode step under shard_map.
+
+The single-device decode step (serving/generate.py) tops out at one
+chip's HBM bandwidth and one chip's page pool.  This module shards the
+SAME model across a mesh axis (``tp``) the classic Megatron way, mapped
+onto jax:
+
+- **Column-parallel QKV**: ``wq/wk/wv [d, d]`` split on the OUTPUT dim,
+  so shard ``i`` computes heads ``[i*H/n, (i+1)*H/n)`` — no collective,
+  each shard's Q/K/V are exactly its own heads'.
+- **Local paged KV**: :class:`ShardedKVCachePool` shards the pool
+  arrays on the HEAD axis (``[L, H/n, P, page_size, D]`` per device).
+  Page tables and the free list stay host-side and global (one
+  admission decision covers all shards); the K/V write and the
+  paged-attention page walk are per-shard local — the pallas kernel
+  runs unchanged, its grid was already per-head.
+- **Row-parallel joins**: ``wo [d, d]`` splits on the INPUT dim; each
+  shard contributes ``attn_local @ wo_local`` and one ``psum`` over ICI
+  joins the partials (same for the MLP's ``w1``/``w2`` pair).  ``psum``
+  rather than ``psum_scatter``: the joined activation immediately feeds
+  the next layer's column-parallel matmuls on EVERY shard, so a
+  scattered result would force an all-gather right back — the linter's
+  ``collective-placement`` detector exists to catch that shape.
+- **Replicated everything else**: embeddings, positions, layernorm
+  scales, and the logits matmul (V is small next to the KV stream; the
+  returned ``[B, V]`` logits are bit-identical on every shard, which is
+  also shard_map's replication check on the output spec).
+
+Chip-less verification: an N-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``) runs the real
+SPMD program; tests/test_distributed_serving.py holds continuous-
+batching decode over it token-identical to the single-device oracle.
+The AOT v5e tier (core/aot_tpu.py) compiles the same program for a
+2x2 slice and banks its per-chip bytes/step (analysis zoo entry
+``sharded_decode``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...kernels.flash_attention import flash_attention
+from ...kernels.paged_attention import paged_decode_attention, resolve_paged_impl
+from ..generate import DecodeConfig, _layernorm
+from ..kvcache import KVCachePool
+
+__all__ = [
+    "ShardedDecodeProgram",
+    "ShardedKVCachePool",
+    "decode_step_fn",
+    "host_mesh_devices",
+    "param_partition_specs",
+    "param_shape_dtypes",
+    "prefill_step_fn",
+]
+
+AXIS_TP = "tp"
+
+
+def host_mesh_devices(n: int):
+    """The first `n` local devices for a chip-less tensor-parallel mesh.
+    Raises with the XLA_FLAGS recipe when the initialized platform has
+    fewer — the flag only works BEFORE the backend initializes, so this
+    cannot respawn, it can only tell the caller how to."""
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} devices for the mesh but the initialized platform "
+            f"has {len(devs)}; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before jax "
+            "initializes (tests: the conftest host_devices fixture)")
+    return devs[:n]
+
+
+# ---------------------------------------------------------------------------
+# parameter sharding vocabulary
+
+
+def param_partition_specs(cfg: DecodeConfig, axis: str = AXIS_TP) -> Dict:
+    """PartitionSpec pytree matching init_decode_params' structure:
+    QKV column-parallel (output dim -> heads), wo/w2 row-parallel
+    (input dim), w1/b1 column-parallel, everything else replicated."""
+    layer = {
+        "wq": P(None, axis), "wk": P(None, axis), "wv": P(None, axis),
+        "wo": P(axis, None),
+        "ln1_g": P(), "ln1_b": P(),
+        "w1": P(None, axis), "b1": P(axis),
+        "w2": P(axis, None), "b2": P(),
+        "ln2_g": P(), "ln2_b": P(),
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layer)],
+    }
+
+
+def param_shape_dtypes(cfg: DecodeConfig) -> Dict:
+    """ShapeDtypeStruct pytree of init_decode_params(cfg) — the AOT
+    capture path's abstract arguments (no host weights materialized)."""
+    d, f = cfg.d_model, cfg.d_inner
+    sds = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.float32)
+    layer = {
+        "wq": sds(d, d), "wk": sds(d, d), "wv": sds(d, d), "wo": sds(d, d),
+        "ln1_g": sds(d), "ln1_b": sds(d),
+        "w1": sds(d, f), "b1": sds(f), "w2": sds(f, d), "b2": sds(d),
+        "ln2_g": sds(d), "ln2_b": sds(d),
+    }
+    return {
+        "embed": sds(cfg.vocab_size, d),
+        "pos": sds(cfg.max_length, d),
+        "layers": [dict(layer) for _ in range(cfg.n_layer)],
+    }
+
+
+def _kv_spec(axis: str = AXIS_TP) -> P:
+    """Pool arrays [L, H, P, page_size, D]: heads sharded, rest local."""
+    return P(None, axis, None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# the SPMD step bodies (pure; every array a shard_map gives them is the
+# LOCAL shard — H_local = n_head / n_shards heads per device)
+
+
+def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
+                   impl: str = "reference", force: str = "auto"):
+    """Build the shard_map body for one continuous-batching decode step.
+
+    fn(params, tokens [B], positions [B], pages [B], slots [B],
+       tables [B, maxp], lengths [B], k_pages, v_pages)
+      -> (logits [B, V] replicated, new k_pages, new v_pages)
+
+    The K/V append is the write_kv contract on the LOCAL head shard;
+    the paged attention walks the (global, replicated) page tables over
+    the LOCAL pool arrays — every byte the hot path touches lives on
+    the device that computes with it."""
+    if cfg.n_head % n_shards:
+        raise ValueError(
+            f"n_head={cfg.n_head} must divide by n_shards={n_shards}")
+    H_local = cfg.n_head // n_shards
+    d, Dh = cfg.d_model, cfg.head_dim
+
+    def step(params, tokens, positions, pages, slots, tables, lengths,
+             k_pages, v_pages):
+        B = tokens.shape[0]
+        h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+            + jnp.asarray(params["pos"])[positions]
+        for li, lp in enumerate(params["layers"]):
+            q = (h @ lp["wq"]).reshape(B, H_local, Dh)
+            k = (h @ lp["wk"]).reshape(B, H_local, Dh)
+            v = (h @ lp["wv"]).reshape(B, H_local, Dh)
+            k_pages = k_pages.at[li, :, pages, slots].set(k)
+            v_pages = v_pages.at[li, :, pages, slots].set(v)
+            attn = paged_decode_attention(
+                q[:, :, None, :], k_pages[li], v_pages[li],
+                tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
+            )  # [B, H_local, 1, Dh]
+            attn = attn[:, :, 0, :].reshape(B, H_local * Dh)
+            # row-parallel wo: each shard's heads contribute a [B, d]
+            # partial; one psum over ICI joins them
+            attn_out = jax.lax.psum(attn @ lp["wo"], axis)
+            h = _layernorm(h + attn_out, lp["ln1_g"], lp["ln1_b"])
+            ff = jax.lax.psum(
+                jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"],
+                axis) + lp["b2"]
+            h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+        return h @ jnp.asarray(params["embed"]).T, k_pages, v_pages
+
+    return step
+
+
+def prefill_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
+                    force: str = "auto"):
+    """Build the shard_map body for one batched whole-prompt prefill.
+
+    fn(params, tokens [B, Smax], lens [B], pages [T], slots [T],
+       b_idx [T], t_idx [T], k_pages, v_pages)
+      -> (last-position logits [B, V] replicated, new k_pages, new
+          v_pages)
+
+    Same sharding as the decode step; the causal pass runs through the
+    flash ``k_lengths`` tier over the LOCAL heads."""
+    if cfg.n_head % n_shards:
+        raise ValueError(
+            f"n_head={cfg.n_head} must divide by n_shards={n_shards}")
+    H_local = cfg.n_head // n_shards
+    d, Dh = cfg.d_model, cfg.head_dim
+
+    def step(params, tokens, lens, pages, slots, b_idx, t_idx,
+             k_pages, v_pages):
+        B, Smax = tokens.shape
+        h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+            + jnp.asarray(params["pos"])[None, :Smax]
+        for li, lp in enumerate(params["layers"]):
+            q = (h @ lp["wq"]).reshape(B, Smax, H_local, Dh)
+            k = (h @ lp["wk"]).reshape(B, Smax, H_local, Dh)
+            v = (h @ lp["wv"]).reshape(B, Smax, H_local, Dh)
+            k_pages = k_pages.at[li, :, pages, slots].set(k[b_idx, t_idx])
+            v_pages = v_pages.at[li, :, pages, slots].set(v[b_idx, t_idx])
+            attn = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True, scale=Dh ** -0.5,
+                k_lengths=lens, force=force)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, Smax, H_local * Dh)
+            attn_out = jax.lax.psum(attn @ lp["wo"], axis)
+            h = _layernorm(h + attn_out, lp["ln1_g"], lp["ln1_b"])
+            ff = jax.lax.psum(
+                jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"],
+                axis) + lp["b2"]
+            h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+        h_last = h[jnp.arange(B), lens - 1]
+        return h_last @ jnp.asarray(params["embed"]).T, k_pages, v_pages
+
+    return step
+
+
+def _shard_param(leaf, spec: P, mesh: Mesh):
+    """Place one host weight onto the mesh under its PartitionSpec —
+    column/row shards land distributed, replicated leaves everywhere."""
+    return jax.device_put(jnp.asarray(leaf, jnp.float32),
+                          NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# the sharded pool
+
+
+class ShardedKVCachePool(KVCachePool):
+    """KVCachePool whose pages live head-sharded across a mesh axis.
+
+    The HOST side — per-sequence page tables, the free list, admission
+    accounting, check_invariants/reclaim_orphans — is inherited
+    unchanged and stays global: one page id means the same (per-shard)
+    page on every device, so one admission decision reserves capacity
+    for the whole mesh.  The DEVICE side shards axis 1 (heads): each
+    device holds ``[L, H/n_shards, num_pages, page_size, D]`` — exactly
+    1/n_shards of the single-device pool's HBM footprint, which is the
+    capacity play: n chips hold n× the concurrent sequences.
+
+    K/V writes on the sharded path happen INSIDE the shard-mapped step
+    (each device writes its own heads); the program hands the updated
+    arrays back through :meth:`store`."""
+
+    def __init__(self, num_pages: int, page_size: int, num_layers: int,
+                 num_heads: int, head_dim: int, dtype="float32",
+                 name: str = "kv", mesh: Optional[Mesh] = None,
+                 n_shards: Optional[int] = None, axis: str = AXIS_TP):
+        if mesh is None:
+            n = int(n_shards or 1)
+            mesh = Mesh(np.asarray(host_mesh_devices(n)), (axis,))
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = int(mesh.shape[axis])
+        if num_heads % self.n_shards:
+            raise ValueError(
+                f"num_heads={num_heads} must divide by the mesh's "
+                f"{axis} axis ({self.n_shards})")
+        super().__init__(num_pages, page_size, num_layers, num_heads,
+                         head_dim, dtype=dtype, name=name)
+        self.sharding = NamedSharding(mesh, _kv_spec(axis))
+        self.k_pages = jax.device_put(self.k_pages, self.sharding)
+        self.v_pages = jax.device_put(self.v_pages, self.sharding)
+
+    @property
+    def heads_per_shard(self) -> int:
+        return self.num_heads // self.n_shards
+
+    def bytes_per_page_per_shard(self) -> int:
+        """One page's K+V bytes on ONE device (the admission math a
+        per-chip HBM budget divides by)."""
+        return self.bytes_per_page() // self.n_shards
+
+    def store(self, k_pages, v_pages) -> None:
+        """Adopt the step's functionally-updated pool arrays (under the
+        pool lock, like every other mutation)."""
+        with self._lock:
+            self.k_pages = k_pages
+            self.v_pages = v_pages
+
+
+# ---------------------------------------------------------------------------
+# the program
+
+
+class ShardedDecodeProgram:
+    """The decode/prefill step pair, jitted once over a tp mesh.
+
+    Drives the same host-side protocol as serving/generate.py's module
+    functions — claim (page, slot)s from the pool, run the step, adopt
+    the updated pool arrays — so ``ContinuousBatchingLoop(...,
+    program=...)`` swaps the single-device math for the SPMD program
+    with no loop changes: admission, quarantine, retirement, and the
+    page-leak invariants all run unmodified.
+
+    ``paged_impl``: like the loop's — None reads FLAGS_serving_paged_impl
+    and resolves against the pool geometry on first use ('auto' is the
+    reference gather on CPU meshes; the pallas page reader runs
+    per-shard unchanged on TPU, its grid was already per-head).
+    """
+
+    def __init__(self, params: Dict, cfg: DecodeConfig,
+                 n_shards: Optional[int] = None,
+                 devices: Optional[Sequence] = None, axis: str = AXIS_TP,
+                 force: str = "auto", paged_impl: Optional[str] = None):
+        if devices is None:
+            devices = host_mesh_devices(int(n_shards or 1))
+        elif n_shards is not None:
+            if len(devices) < int(n_shards):
+                raise ValueError(
+                    f"n_shards={n_shards} but only {len(devices)} devices "
+                    "were supplied — a silently smaller mesh would change "
+                    "per-chip pool capacity and cost")
+            devices = list(devices)[: int(n_shards)]
+        self.cfg = cfg
+        self.axis = axis
+        self.n_shards = len(devices)
+        if cfg.n_head % self.n_shards:
+            raise ValueError(
+                f"n_head={cfg.n_head} must divide by n_shards="
+                f"{self.n_shards}")
+        self.force = force
+        self._requested_impl = paged_impl
+        self.paged_impl: Optional[str] = None  # resolved on first pool use
+        self.mesh = Mesh(np.asarray(devices), (axis,))
+        self._pspecs = param_partition_specs(cfg, axis)
+        # PartitionSpec is a tuple subclass, so a naive two-tree
+        # tree_map would flatten INTO the specs; flatten_up_to stops at
+        # the params treedef's leaves instead
+        leaves, treedef = jax.tree_util.tree_flatten(dict(params))
+        spec_leaves = treedef.flatten_up_to(self._pspecs)
+        self.params = jax.tree_util.tree_unflatten(treedef, [
+            _shard_param(leaf, spec, self.mesh)
+            for leaf, spec in zip(leaves, spec_leaves)])
+        self._decode_jit = None
+        self._prefill_jit = None
+
+    # -- pool ----------------------------------------------------------
+
+    def make_pool(self, num_pages: int, page_size: int,
+                  dtype="float32", name: str = "kv") -> ShardedKVCachePool:
+        """A pool shaped for this program's model, head-sharded over the
+        program's mesh."""
+        return ShardedKVCachePool(
+            num_pages, page_size, self.cfg.n_layer, self.cfg.n_head,
+            self.cfg.head_dim, dtype=dtype, name=name, mesh=self.mesh,
+            axis=self.axis)
+
+    def resolve_impl(self, pool: KVCachePool) -> str:
+        """Resolve (once) the paged-attention impl against this pool's
+        geometry — the label every metric carries."""
+        if self.paged_impl is None:
+            self.paged_impl = resolve_paged_impl(
+                self._requested_impl, pool.page_size, self.cfg.head_dim,
+                pool.k_pages.dtype)
+        return self.paged_impl
+
+    def _check_pool(self, pool) -> None:
+        if getattr(pool, "mesh", None) is not self.mesh:
+            raise ValueError(
+                "pool is not sharded over this program's mesh — build it "
+                "with program.make_pool(...) (a replicated or "
+                "foreign-mesh pool would reshard every step)")
+
+    # -- jit construction ----------------------------------------------
+
+    def _build(self, body):
+        kv = _kv_spec(self.axis)
+        rep = P()
+        # check_vma off: pallas_call has no replication rule, and the
+        # logits ARE replicated by construction (every shard holds the
+        # same psum-joined activations) — tests pin bit-identity
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(self._pspecs,) + (rep,) * 6 + (kv, kv),
+            out_specs=(rep, kv, kv), check_vma=False))
+
+    def _decode(self):
+        if self._decode_jit is None:
+            self._decode_jit = self._build(decode_step_fn(
+                self.cfg, self.n_shards, self.axis,
+                impl=self.paged_impl or "reference", force=self.force))
+        return self._decode_jit
+
+    def _prefill(self):
+        if self._prefill_jit is None:
+            self._prefill_jit = self._build(prefill_step_fn(
+                self.cfg, self.n_shards, self.axis, force=self.force))
+        return self._prefill_jit
+
+    # -- the ContinuousBatchingLoop program protocol --------------------
+
+    def decode_step(self, pool: ShardedKVCachePool,
+                    seq_ids: Sequence[int], tokens, positions
+                    ) -> np.ndarray:
+        """One continuous-batching decode step (generate.decode_step's
+        contract): claim one (page, slot) per sequence, run the SPMD
+        step, adopt the updated pool shards; returns logits [B, V]."""
+        self._check_pool(pool)
+        self.resolve_impl(pool)
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        pages, slots = pool.append_token(seq_ids)
+        tables, lengths = pool.page_table_batch(seq_ids)
+        logits, k_pages, v_pages = self._decode()(
+            self.params, tokens, positions, pages, slots,
+            tables, lengths, pool.k_pages, pool.v_pages)
+        pool.store(k_pages, v_pages)
+        return np.asarray(logits)
+
+    def prefill_step(self, pool: ShardedKVCachePool,
+                     seq_ids: Sequence[int],
+                     prompts: Sequence[Sequence[int]]) -> np.ndarray:
+        """Batched whole-prompt prefill (generate.prefill_step's
+        contract) under the SPMD program; returns last-position logits
+        [B, V]."""
+        self._check_pool(pool)
+        self.resolve_impl(pool)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        if not len(lens) or lens.min() < 1:
+            raise ValueError("prefill needs non-empty prompts")
+        B, Smax = len(prompts), int(lens.max())
+        if Smax > self.cfg.max_length:
+            raise ValueError(
+                f"prompt length {Smax} > max_length {self.cfg.max_length}")
+        tokens = np.zeros((B, Smax), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :lens[i]] = p
+        pages, slots = pool.append_tokens(seq_ids, lens)
+        b_idx = np.repeat(np.arange(B), lens).astype(np.int32)
+        t_idx = np.concatenate([np.arange(n) for n in lens]).astype(np.int32)
+        logits, k_pages, v_pages = self._prefill()(
+            self.params, tokens, lens, pages, slots, b_idx, t_idx,
+            pool.k_pages, pool.v_pages)
+        pool.store(k_pages, v_pages)
+        return np.asarray(logits)

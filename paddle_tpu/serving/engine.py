@@ -272,6 +272,11 @@ class Engine:
         self.backend = backend
         self.config = config or EngineConfig()
         self.name = name
+        # replica label (set by distributed.Router.add_replica): rides
+        # on every flight-recorder event, request trace, and health
+        # gauge this engine emits, so per-replica telemetry stays
+        # attributable after aggregate_dir() merges process dumps
+        self.replica: Optional[str] = None
         buckets, self.bucket_reason = _plan_buckets(
             backend, self.config.buckets)
         self.ladder = BucketLadder(buckets)
@@ -338,6 +343,13 @@ class Engine:
         # dropped without close() is garbage-collected and its thread
         # exits within ~_IDLE_PARK_S instead of leaking both forever.
         self._spawn_dispatcher()
+
+    def _flight_record(self, kind: str, **fields) -> None:
+        """One engine lifecycle event into the flight recorder, labeled
+        with the replica name when this engine serves behind a Router."""
+        if self.replica is not None:
+            fields.setdefault("replica", self.replica)
+        _flight.default_flight().record(kind, engine=self.name, **fields)
 
     def _spawn_dispatcher(self) -> None:
         self._thread = threading.Thread(
@@ -418,6 +430,10 @@ class Engine:
         if obs_on:
             rt = _rtrace.default_request_tracer().start()
             fut.trace_id = rt.trace_id
+            if self.replica is not None:
+                # the replica attribute is the join key a merged
+                # (aggregate_dir) view filters kept traces by
+                rt.annotate(replica=self.replica)
         now = time.perf_counter()
         req = Request(
             feed=feed, future=fut, rows=rows, enqueued_at=now,
@@ -466,8 +482,8 @@ class Engine:
                 # flight event are recorded — otherwise a fast dispatch
                 # could finish() the trace before its submit span lands
                 rt.event("request.submit", rt.t0, time.perf_counter())
-                _flight.default_flight().record(
-                    "submit", engine=self.name, trace_id=fut.trace_id,
+                self._flight_record(
+                    "submit", trace_id=fut.trace_id,
                     depth=depth)
             self._cond.notify_all()
         if obs_on:
@@ -482,8 +498,8 @@ class Engine:
         kind an operator wants the span tree for."""
         if obs_on:
             _smetrics.record_reject(reason)
-            _flight.default_flight().record(
-                "reject", engine=self.name, reason=reason,
+            self._flight_record(
+                "reject", reason=reason,
                 trace_id=rt.trace_id)
             exc.trace_id = rt.trace_id
             _rtrace.default_request_tracer().finish(
@@ -752,8 +768,8 @@ class Engine:
             exc.trace_id = req.trace_id
             outcome = ("timeout" if isinstance(exc, RequestTimeoutError)
                        else "closed")
-            _flight.default_flight().record(
-                "request_fail", engine=self.name, outcome=outcome,
+            self._flight_record(
+                "request_fail", outcome=outcome,
                 trace_id=req.trace_id, error=type(exc).__name__)
             self._finish_trace(req, outcome, time.perf_counter())
         if req.future.set_running_or_notify_cancel():
@@ -802,8 +818,8 @@ class Engine:
         # t0 always: the batch-latency ring feeds deadline shedding
         t0 = time.perf_counter()
         if obs_on:
-            _flight.default_flight().record(
-                "dispatch", engine=self.name, n_requests=len(batch),
+            self._flight_record(
+                "dispatch", n_requests=len(batch),
                 trace_ids=[r.trace_id for r in batch])
         try:
             _finject.serve_slow_step()
@@ -851,8 +867,8 @@ class Engine:
                 except AttributeError:
                     pass  # a __slots__ exception from a backend:
                     # losing the annotation must not kill the dispatcher
-                _flight.default_flight().record(
-                    "batch_fail", engine=self.name,
+                self._flight_record(
+                    "batch_fail",
                     error=f"{type(e).__name__}: {e}",
                     trace_ids=[r.trace_id for r in batch])
             # count BEFORE resolving futures: a caller that catches the
@@ -909,8 +925,7 @@ class Engine:
         self._batch_lat.record(now - t0)
         if obs_on:
             if breaker_was_open:
-                _flight.default_flight().record(
-                    "breaker_close", engine=self.name)
+                self._flight_record("breaker_close")
             _smetrics.record_batch(
                 bucket=bucket, rows=rows, latency_s=now - t0)
             for r in batch:
@@ -995,8 +1010,8 @@ class Engine:
             type(exc).__name__, exc, self.queue_depth())
         if _flags._VALUES["FLAGS_observability"]:
             _smetrics.record_dispatcher_restart()
-            _flight.default_flight().record(
-                "dispatcher_restart", engine=self.name,
+            self._flight_record(
+                "dispatcher_restart",
                 error=f"{type(exc).__name__}: {exc}",
                 queued=self.queue_depth())
         self._spawn_dispatcher()
@@ -1102,7 +1117,8 @@ class Engine:
                 state, depth,
                 breaker_open=breaker_open,
                 pool_utilization=(snap["pool"] or {}).get("utilization"),
-                pool=getattr(self._pool, "name", "kv"))
+                pool=getattr(self._pool, "name", "kv"),
+                replica=self.replica)
         return snap
 
 

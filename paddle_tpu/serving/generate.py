@@ -356,19 +356,33 @@ class ContinuousBatchingLoop:
     def __init__(self, params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                  max_batch: int = 4, force: str = "auto",
                  paged_impl: Optional[str] = None,
-                 prefill: str = "batched", check_every: int = 0):
+                 prefill: str = "batched", check_every: int = 0,
+                 program=None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
         self.params = params
-        self.cfg = cfg
+        self.cfg = cfg if cfg is not None else getattr(program, "cfg", None)
+        if self.cfg is None:
+            raise ValueError("pass cfg (or a program that carries one)")
         self.pool = pool
         self.max_batch = int(max_batch)
         self.force = force
         self.prefill = prefill
         self.check_every = int(check_every)
-        self.paged_impl = resolve_paged_impl(
-            paged_impl, pool.page_size, cfg.head_dim, pool.k_pages.dtype)
+        # program: an object exposing decode_step(pool, seq_ids, tokens,
+        # positions) and prefill_step(pool, seq_ids, prompts) — e.g.
+        # serving.distributed.ShardedDecodeProgram.  The loop's
+        # admission / quarantine / retirement / watchdog machinery is
+        # step-implementation-agnostic, so the SPMD program rides it
+        # unchanged; None keeps this module's single-device math.
+        self.program = program
+        if program is not None:
+            self.paged_impl = program.resolve_impl(pool)
+        else:
+            self.paged_impl = resolve_paged_impl(
+                paged_impl, pool.page_size, self.cfg.head_dim,
+                pool.k_pages.dtype)
         self._next_seq_id = 0
         self.steps = 0
         self.prefill_steps = 0
@@ -552,10 +566,16 @@ class ContinuousBatchingLoop:
                     # len) token-by-token
                     t0 = time.perf_counter()
                     step_idx = self.steps
-                    logits = prefill_step(
-                        self.params, self.cfg, self.pool,
-                        [a.seq_id for a in newly],
-                        [a.result.prompt for a in newly], force=self.force)
+                    if self.program is not None:
+                        logits = self.program.prefill_step(
+                            self.pool, [a.seq_id for a in newly],
+                            [a.result.prompt for a in newly])
+                    else:
+                        logits = prefill_step(
+                            self.params, self.cfg, self.pool,
+                            [a.seq_id for a in newly],
+                            [a.result.prompt for a in newly],
+                            force=self.force)
                     self.steps += 1
                     self.prefill_steps += 1
                     self._occupancy_sum += len(newly) / float(self.max_batch)
@@ -588,9 +608,13 @@ class ContinuousBatchingLoop:
                     for a in batch
                 ]
                 positions = [a.pos for a in batch]
-                logits = decode_step(
-                    self.params, self.cfg, self.pool, seq_ids, tokens,
-                    positions, force=self.force, impl=self.paged_impl)
+                if self.program is not None:
+                    logits = self.program.decode_step(
+                        self.pool, seq_ids, tokens, positions)
+                else:
+                    logits = decode_step(
+                        self.params, self.cfg, self.pool, seq_ids, tokens,
+                        positions, force=self.force, impl=self.paged_impl)
                 self.steps += 1
                 self.decode_steps += 1
                 self._occupancy_sum += len(batch) / float(self.max_batch)

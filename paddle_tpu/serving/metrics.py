@@ -72,6 +72,8 @@ __all__ = [
     "record_health",
     "record_pool_invariant_violation",
     "record_pool_reclaim",
+    "record_replica_health",
+    "record_router_decision",
 ]
 
 # occupancy lives in (0, 1]; the default step-time buckets would collapse
@@ -218,30 +220,61 @@ _HEALTH_CODES = {"SERVING": 0, "DEGRADED": 1, "DRAINING": 2, "BROKEN": 3}
 def record_health(state: str, queue_depth: int,
                   breaker_open: bool = False,
                   pool_utilization: Optional[float] = None,
-                  pool: str = "kv") -> None:
+                  pool: str = "kv",
+                  replica: Optional[str] = None) -> None:
     """engine.health() snapshot gauges: numeric state (0 SERVING /
     1 DEGRADED / 2 DRAINING / 3 BROKEN) plus the queue/breaker/pool
     levels an alerting rule would page on.  `pool` labels the
     utilization gauge so it lands on the SAME series the pool's own
-    _note_pool() publishes."""
+    _note_pool() publishes.  `replica` (engines serving behind a
+    distributed.Router) labels the state/queue/breaker gauges so
+    per-replica series survive an aggregate_dir() merge distinct."""
     reg = default_registry()
+    labels = {"replica": replica} if replica is not None else {}
     reg.gauge(
         "paddle_tpu_serving_health_state",
         "engine health: 0 SERVING, 1 DEGRADED, 2 DRAINING, 3 BROKEN",
-    ).set(_HEALTH_CODES.get(state, 3))
+    ).set(_HEALTH_CODES.get(state, 3), **labels)
     reg.gauge(
         "paddle_tpu_serving_queue_depth",
         "requests waiting in the engine's bounded queue",
-    ).set(queue_depth)
+    ).set(queue_depth, **labels)
     reg.gauge(
         "paddle_tpu_serving_breaker_open",
         "1 while the engine circuit breaker is open",
-    ).set(1 if breaker_open else 0)
+    ).set(1 if breaker_open else 0, **labels)
     if pool_utilization is not None:
         reg.gauge(
             "paddle_tpu_serving_page_pool_utilization",
             "KV-cache page-pool utilization (used/total)",
         ).set(pool_utilization, pool=pool)
+
+
+def record_router_decision(decision: str, replica: str) -> None:
+    """One Router routing decision: ``routed`` (the request landed
+    here), ``skipped_unhealthy`` (a candidate was passed over — lease
+    expired, BROKEN/DRAINING health, or a raced rejection), or
+    ``handoff`` (drain_replica claimed the replica's traffic)."""
+    default_registry().counter(
+        "paddle_tpu_serving_router_decisions",
+        "admission-router routing decisions by replica",
+    ).inc(decision=decision, replica=replica)
+
+
+def record_replica_health(replica: str, state: str,
+                          queue_depth: int) -> None:
+    """Router-side per-replica health gauges (the aggregate_dir-merged
+    fleet view: one series per replica name)."""
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_replica_health_state",
+        "replica health as seen by the router: 0 SERVING, 1 DEGRADED, "
+        "2 DRAINING, 3 BROKEN",
+    ).set(_HEALTH_CODES.get(state, 3), replica=replica)
+    reg.gauge(
+        "paddle_tpu_serving_replica_queue_depth",
+        "replica engine queue depth as seen by the router",
+    ).set(queue_depth, replica=replica)
 
 
 def record_pool_invariant_violation(pool: str = "kv") -> None:

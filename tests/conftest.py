@@ -38,6 +38,43 @@ def pytest_configure(config):
         "markers", "timeout(seconds): per-test timeout (pytest-timeout)")
 
 
+@pytest.fixture
+def host_devices():
+    """Factory fixture for chip-less SPMD tests: ``host_devices(n)``
+    returns `n` virtual CPU devices for a device mesh.
+
+    ``--xla_force_host_platform_device_count`` only takes effect BEFORE
+    the jax backend initializes, so this conftest already forces 8
+    devices at import time (above).  The fixture configures the flag
+    itself in the one window where that is still possible (jax not yet
+    imported — e.g. a test subprocess importing this conftest fresh)
+    and otherwise validates the initialized platform, SKIPPING when it
+    came up with fewer devices than the test needs (a real accelerator
+    platform pinned first, or a host that overrode XLA_FLAGS) — a mesh
+    test must never hard-fail an environment it cannot reconfigure."""
+    import sys
+
+    def _get(n):
+        if "jax" not in sys.modules:  # pragma: no cover — conftest
+            flags = os.environ.get("XLA_FLAGS", "")  # imports jax above
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={n}")
+        import jax as _jax
+
+        devs = _jax.devices()
+        if len(devs) < n:
+            pytest.skip(
+                f"needs {n} devices but the platform already "
+                f"initialized with {len(devs)} — "
+                "xla_force_host_platform_device_count cannot be "
+                "re-applied after backend init")
+        return devs[:n]
+
+    return _get
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test a fresh default main/startup program and scope."""

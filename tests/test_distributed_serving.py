@@ -1,0 +1,430 @@
+"""Mesh-sharded serving (paddle_tpu/serving/distributed/): chip-less
+SPMD parity, router dispatch, and drain-based replica handoff.
+
+Acceptance criteria pinned here (ISSUE 10):
+(a) on a 4-device CPU mesh, ShardedDecodeProgram continuous-batching
+    decode is TOKEN-IDENTICAL to the single-device oracle across >= 3
+    overlapping ragged sequences (batched AND token prefill arms), with
+    zero leaked pages and a clean pool invariant audit;
+(b) the sharded pool's device view is genuinely per-shard: each device
+    holds [L, H/n_shards, P, page_size, D] — 1/n of the pool bytes;
+(c) the Router serves mixed traffic across 2 replicas with one replica
+    drained mid-run: zero lost/duplicated requests, nothing routed to
+    the drained replica after the handoff, and the drained engine
+    finishes its queued work;
+(d) health-aware dispatch skips BROKEN/DRAINING/lease-expired replicas
+    (elastic-master heartbeat seam) and falls over between replicas on
+    raced rejections;
+(e) with observability on, flight events / request traces / health
+    gauges / router decision counters all carry the replica label and
+    survive a MetricsRegistry.aggregate_dir merge attributable.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags as pflags
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.elastic.master import InMemStore, MasterService
+from paddle_tpu.serving import (
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    Engine,
+    EngineConfig,
+    KVCachePool,
+)
+from paddle_tpu.serving.distributed import (
+    ReplicaDirectory,
+    ReplicaUnavailableError,
+    Router,
+    ShardedDecodeProgram,
+    ShardedKVCachePool,
+    host_mesh_devices,
+)
+
+N_SHARDS = 4
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_head=4, n_layer=2,
+                d_inner=64, max_length=48)
+    base.update(kw)
+    return DecodeConfig(**base)
+
+
+def _ragged_requests(cfg, n=4, seed=0, max_new=8):
+    rng = np.random.RandomState(seed)
+    lens = [3, 7, 5, 2, 9, 4][:n]
+    return [
+        DecodeRequest(
+            prompt=rng.randint(1, cfg.vocab_size, size=ln).tolist(),
+            max_new_tokens=max_new)
+        for ln in lens
+    ]
+
+
+# ---------------------------------------------------------------------------
+# (a) SPMD parity: sharded continuous batching == single-device oracle
+
+
+@pytest.mark.parametrize("prefill", ["batched", "token"])
+def test_sharded_decode_token_identical_to_oracle(host_devices, prefill):
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg()
+    params = serving.init_decode_params(cfg, seed=3)
+    reqs = _ragged_requests(cfg, n=4)
+
+    oracle_pool = KVCachePool(num_pages=64, page_size=4,
+                              num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                              head_dim=cfg.head_dim)
+    oracle = ContinuousBatchingLoop(params, cfg, oracle_pool,
+                                    max_batch=3, prefill=prefill)
+    want = oracle.run([DecodeRequest(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+
+    prog = ShardedDecodeProgram(params, cfg, devices=devs)
+    pool = prog.make_pool(num_pages=64, page_size=4)
+    loop = ContinuousBatchingLoop(None, None, pool, max_batch=3,
+                                  prefill=prefill, program=prog)
+    got = loop.run(reqs)
+
+    # >= 3 sequences overlapped (max_batch=3 over 4 requests)
+    assert len(got) == 4
+    for w, g in zip(want, got):
+        assert g.error is None
+        assert g.tokens == w.tokens  # token-identical to the oracle
+        np.testing.assert_allclose(
+            np.stack(g.logits), np.stack(w.logits), atol=2e-4)
+    # zero leaked pages, clean audit — retirement freed everything
+    assert pool.stats()["used_pages"] == 0
+    assert pool.check_invariants()["ok"]
+    assert oracle_pool.stats()["used_pages"] == 0
+
+
+def test_sharded_prefill_matches_full_forward(host_devices):
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg()
+    params = serving.init_decode_params(cfg, seed=5)
+    prog = ShardedDecodeProgram(params, cfg, devices=devs)
+    pool = prog.make_pool(num_pages=32, page_size=4)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (5, 2, 8)]
+    for i in range(len(prompts)):
+        pool.allocate(i)
+    logits = prog.prefill_step(pool, list(range(len(prompts))), prompts)
+    for i, p in enumerate(prompts):
+        want = serving.full_forward(params, cfg, p)[-1]
+        np.testing.assert_allclose(logits[i], want, atol=2e-4)
+
+
+def test_sharded_decode_quarantine_keeps_pool_leak_free(host_devices):
+    """A NaN-poisoned sequence under the SPMD program quarantines alone
+    — batch-mates finish, pages all return (the loop's fault isolation
+    is step-implementation-agnostic)."""
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg()
+    params = serving.init_decode_params(cfg, seed=3)
+    prog = ShardedDecodeProgram(params, cfg, devices=devs)
+    pool = prog.make_pool(num_pages=64, page_size=4)
+    loop = ContinuousBatchingLoop(None, None, pool, max_batch=3,
+                                  program=prog, check_every=1)
+    os.environ["FAULT_SERVE_NAN_SEQ"] = "1@1"
+    try:
+        results = loop.run(_ragged_requests(cfg, n=3))
+    finally:
+        os.environ.pop("FAULT_SERVE_NAN_SEQ", None)
+        from paddle_tpu.resilience import faultinject
+
+        faultinject.reset()
+    errs = [r for r in results if r.error is not None]
+    assert len(errs) == 1 and loop.quarantined == 1
+    ok = [r for r in results if r.error is None]
+    assert all(len(r.tokens) == 8 for r in ok)
+    assert pool.stats()["used_pages"] == 0
+    assert pool.check_invariants()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# (b) the per-shard pool view
+
+
+def test_sharded_pool_head_shard_view(host_devices):
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg()
+    prog = ShardedDecodeProgram(
+        serving.init_decode_params(cfg, seed=0), cfg, devices=devs)
+    pool = prog.make_pool(num_pages=16, page_size=4)
+    assert isinstance(pool, ShardedKVCachePool)
+    assert pool.n_shards == N_SHARDS
+    assert pool.heads_per_shard == cfg.n_head // N_SHARDS
+    # each device holds exactly its heads' pages: [L, H/n, P, ps, D]
+    shards = pool.k_pages.addressable_shards
+    assert len(shards) == N_SHARDS
+    local = shards[0].data.shape
+    assert local == (cfg.n_layer, cfg.n_head // N_SHARDS, 16, 4,
+                     cfg.head_dim)
+    assert pool.bytes_per_page_per_shard() * N_SHARDS \
+        == pool.bytes_per_page()
+    # host-side bookkeeping is the inherited single-pool protocol
+    pool.allocate(0)
+    pages, slots = pool.append_tokens([0], [5])
+    assert len(pages) == 5
+    pool.free_seq(0)
+    assert pool.check_invariants()["ok"]
+
+
+def test_sharded_validation_errors(host_devices):
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg(n_head=3)  # 3 heads don't divide over 4 shards
+    with pytest.raises(ValueError, match="divide"):
+        ShardedDecodeProgram(serving.init_decode_params(cfg, seed=0),
+                             cfg, devices=devs)
+    with pytest.raises(RuntimeError, match="xla_force_host_platform"):
+        host_mesh_devices(4096)
+    cfg4 = _cfg()
+    prog = ShardedDecodeProgram(serving.init_decode_params(cfg4, seed=0),
+                                cfg4, devices=devs)
+    plain = KVCachePool(num_pages=8, page_size=4, num_layers=cfg4.n_layer,
+                        num_heads=cfg4.n_head, head_dim=cfg4.head_dim)
+    plain.allocate(0)
+    with pytest.raises(ValueError, match="mesh"):
+        prog.decode_step(plain, [0], [1], [0])
+
+
+# ---------------------------------------------------------------------------
+# (c)+(d) router: mixed traffic, drain handoff, health/lease skipping
+
+
+class _SleepyBackend:
+    feed_names = ["x"]
+    fetch_names = ["y"]
+    meta: dict = {}
+
+    def __init__(self, delay=0.0015):
+        self.delay = delay
+        self.calls = 0
+
+    def __call__(self, feed):
+        self.calls += 1
+        time.sleep(self.delay)
+        return [np.asarray(feed["x"]) * 2.0]
+
+
+def _engine(name, **kw):
+    cfg = EngineConfig(buckets=(1, 2, 4), max_wait_s=0.001, **kw)
+    return Engine(_SleepyBackend(), config=cfg, name=name)
+
+
+def test_router_drain_handoff_zero_lost():
+    e0, e1 = _engine("r0"), _engine("r1")
+    router = Router([e0, e1])
+    rng = np.random.RandomState(0)
+    futs = []
+    drained_at = 24
+    for i in range(48):
+        if i == drained_at:
+            done = router.drain_replica("r0", timeout=0)  # claim, no wait
+            assert done in (False, True)
+        futs.append(router.submit(
+            {"x": rng.rand(1, 4).astype(np.float32)}))
+    outs = [f.result(timeout=30) for f in futs]
+    # zero lost, zero duplicated: every request resolved exactly once,
+    # with its own payload (x * 2 round-trips bit-exact)
+    assert len(outs) == 48
+    for f, out in zip(futs, outs):
+        assert out[0].shape == (1, 4)
+    # nothing routed to the drained replica after the handoff
+    assert all(f.replica == "r1" for f in futs[drained_at:])
+    # both replicas actually served before it
+    served = {f.replica for f in futs[:drained_at]}
+    assert served == {"r0", "r1"}
+    # the drained replica finished its queued work
+    assert router.drain_replica("r0", timeout=10.0) is True
+    assert e0.queue_depth() == 0
+    st = router.stats()
+    assert st["handoffs"] == 1
+    assert st["routed"] == 48
+    router.close()
+
+
+def test_router_skips_draining_and_broken_and_falls_over():
+    e0, e1 = _engine("r0"), _engine("r1")
+    router = Router([e0, e1])
+    # DRAINING: engine drained outside the router (e.g. SIGTERM) — the
+    # health poll must skip it without a drain_replica claim
+    e0.begin_drain()
+    fut = router.submit({"x": np.ones((1, 4), np.float32)})
+    assert fut.replica == "r1"
+    fut.result(10)
+    assert router.stats()["replicas"]["r0"]["skipped"] >= 1
+    # nothing admitting -> typed unavailable error naming reasons
+    e1.begin_drain()
+    with pytest.raises(ReplicaUnavailableError) as ei:
+        router.submit({"x": np.ones((1, 4), np.float32)})
+    assert "r1" in ei.value.skipped
+    router.close()
+
+
+def test_router_lease_expiry_via_elastic_master_seam():
+    master = MasterService(InMemStore(), timeout_dur=5.0)
+    directory = ReplicaDirectory(master, max_silence_s=0.15)
+    e0, e1 = _engine("r0"), _engine("r1")
+    router = Router([e0, e1], directory=directory)
+    # both leased: traffic may land anywhere
+    directory.beat("r0")
+    directory.beat("r1")
+    router.submit({"x": np.ones((1, 4), np.float32)}).result(10)
+    # r0's lease lapses; r1 keeps beating — all traffic moves to r1
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline:
+        directory.beat("r1")
+        if "r0" in directory.expired():
+            break
+        time.sleep(0.02)
+    assert "r0" in directory.expired()
+    futs = [router.submit({"x": np.ones((1, 4), np.float32)})
+            for _ in range(4)]
+    assert all(f.replica == "r1" for f in futs)
+    [f.result(10) for f in futs]
+    h = router.health()
+    assert h["replicas"]["r0"]["lease_expired"] is True
+    assert h["replicas"]["r0"]["routing"] is False
+    assert h["replicas"]["r1"]["routing"] is True
+    router.close()
+
+
+def test_router_concurrent_submit_thread_safe():
+    e0, e1 = _engine("r0", queue_depth=512), _engine("r1", queue_depth=512)
+    router = Router([e0, e1])
+    results = []
+    lock = threading.Lock()
+    rng = np.random.RandomState(2)
+    feeds = [rng.rand(1, 4).astype(np.float32) for _ in range(40)]
+
+    def worker(lo, hi):
+        for i in range(lo, hi):
+            out = router.infer({"x": feeds[i]})
+            with lock:
+                results.append((i, out[0]))
+
+    threads = [threading.Thread(target=worker, args=(i * 10, (i + 1) * 10))
+               for i in range(4)]
+    [t.start() for t in threads]
+    [t.join(30) for t in threads]
+    assert len(results) == 40
+    for i, out in results:
+        np.testing.assert_array_equal(out, feeds[i] * 2.0)
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# (e) replica-labeled observability, attributable after aggregate_dir
+
+
+def test_replica_labels_flow_through_observability(tmp_path):
+    pflags.set_flags({"FLAGS_observability": True})
+    obs.reset()
+    try:
+        e0, e1 = _engine("r0"), _engine("r1")
+        router = Router([e0, e1])
+        for _ in range(6):
+            router.submit({"x": np.ones((1, 4), np.float32)}).result(10)
+        router.health()  # records per-replica gauges
+        e0.health()      # engine-side gauges carry the replica label too
+
+        # flight events are replica-attributable
+        evs = obs.default_flight().events()
+        assert any(e.get("replica") in ("r0", "r1") for e in evs
+                   if e["kind"] == "submit")
+
+        # kept request traces annotate the replica on the root span
+        spans = obs.default_tracer().spans()
+        roots = [s for s in spans if s.name == "request"]
+        assert roots and any(
+            s.args.get("replica") in ("r0", "r1") for s in roots)
+
+        # counters/gauges keep the replica label through a dump ->
+        # aggregate_dir merge (the multi-process fleet view)
+        reg = obs.default_registry()
+        reg.dump(str(tmp_path / "metrics_0.json"))
+        merged = obs.MetricsRegistry.aggregate_dir(str(tmp_path))
+        routed = merged.counter(
+            "paddle_tpu_serving_router_decisions",
+            "admission-router routing decisions by replica")
+        total = sum(
+            routed.value(decision="routed", replica=r)
+            for r in ("r0", "r1"))
+        assert total == 6
+        health = merged.gauge(
+            "paddle_tpu_serving_replica_health_state", "")
+        assert health.value(replica="r0") is not None
+        router.close()
+    finally:
+        pflags.set_flags({"FLAGS_observability": False})
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# serve_bench wiring (--replicas / --mesh on the 0/2/3 exit contract)
+
+
+def test_serve_bench_router_mode_gate(tmp_path, capsys):
+    import json
+
+    from tools.serve_bench import main as bench_main
+
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps({
+        "lost_requests": 0, "post_drain_misroutes": 0,
+        "drain_completed": 1,
+    }))
+    out_json = tmp_path / "out.json"
+    rc = bench_main([
+        "--replicas", "2", "--model", "tiny", "--requests", "16",
+        "--rate", "800", "--no-warmup", "--json", str(out_json),
+        "--baseline", str(bank), "--gate",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    result = json.loads(out_json.read_text())
+    assert result["mode"] == "router" and result["replicas"] == 2
+    assert result["lost_requests"] == 0
+    assert result["post_drain_misroutes"] == 0
+    assert set(result["per_replica"]) == {"replica0", "replica1"}
+
+
+def test_serve_bench_mesh_mode(tmp_path, capsys, host_devices):
+    import json
+
+    host_devices(4)  # skip early if the platform cannot provide a mesh
+    from tools.serve_bench import main as bench_main
+
+    out_json = tmp_path / "out.json"
+    rc = bench_main([
+        "--mode", "decode", "--mesh", "4", "--sequences", "4",
+        "--max-new", "6", "--pages", "64", "--page-size", "4",
+        "--d-model", "32", "--max-len", "48", "--json", str(out_json),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    result = json.loads(out_json.read_text())
+    assert result["mesh"] == 4
+    assert result["pages_leaked"] == 0
+    assert result["tokens"] == 4 * 6
+
+
+def test_serve_bench_usage_errors(capsys):
+    from tools.serve_bench import main as bench_main
+
+    assert bench_main(["--mode", "decode", "--replicas", "2"]) == 2
+    assert bench_main(["--mesh", "4"]) == 2  # mesh needs decode mode
+    assert bench_main(["--replicas", "2", "--chaos"]) == 2
+    capsys.readouterr()

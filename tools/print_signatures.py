@@ -32,6 +32,9 @@ MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.resilience",
     "paddle_tpu.serving",
+    # mesh-sharded serving (ISSUE 10): the tensor-parallel decode
+    # program, head-sharded pool, and replica router are serving API
+    "paddle_tpu.serving.distributed",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

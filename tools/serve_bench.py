@@ -22,6 +22,22 @@ a batching server — latency percentiles, throughput, and batch occupancy
   so a reference-vs-pallas A/B rides the --baseline/--gate machinery
   like any other regression check.
 
+  router mode (--replicas N, engine-mode option): N Engine replicas of
+  the same artifact behind one distributed.Router; the Poisson replay
+  goes through router.submit().  Reports per-replica request counts /
+  latency percentiles / rps, routing-decision counters
+  (routed/skipped), and — with N >= 2 — a drain-handoff smoke: one
+  replica is drained mid-run and the result must show
+  post_drain_misroutes == 0 and lost_requests == 0 (bank those zeros
+  and --gate holds them).
+
+  mesh mode (--mesh N, decode-mode option): the same decode replay
+  through the tensor-parallel ShardedDecodeProgram over an N-device
+  mesh (chip-less: N virtual CPU devices are forced via XLA_FLAGS when
+  jax is not yet initialized; exit 2 if the platform came up smaller).
+  Reports the usual decode numbers plus the mesh size, so single- vs
+  sharded-decode tokens/s rides the same gate.
+
 Gating mirrors tools/obsdump.py and tools/lint_programs.py — the shared
 CI-gate exit-code contract (README "CI gates"): --baseline BANKED.json
 re-checks this run against a banked artifact ({metric: value};
@@ -254,6 +270,106 @@ def run_engine_bench(args) -> dict:
     return result
 
 
+def run_router_bench(args) -> dict:
+    """--replicas N: the engine-mode replay through a Router fronting N
+    replicas of the same artifact, with a mid-run drain handoff when
+    N >= 2.  Zero lost requests and zero post-drain misroutes are the
+    bankable contract."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.distributed import Router
+
+    with tempfile.TemporaryDirectory() as d:
+        predict, feed = _build_artifact(args.model, d)
+        buckets = serving.parse_buckets(args.buckets)
+        engines = [
+            serving.Engine.from_artifact(
+                predict,
+                config=serving.EngineConfig(
+                    buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
+                    queue_depth=args.queue_depth),
+                name=f"replica{i}")
+            for i in range(args.replicas)
+        ]
+        router = Router(engines)
+        if args.warmup:
+            for eng in engines:
+                for b in eng.ladder.buckets:
+                    eng.infer(feed(b))
+        rng = np.random.RandomState(args.seed)
+        lo, hi = (int(p) for p in args.batch_range.split(","))
+        reqs = [feed(int(rng.randint(lo, hi + 1)))
+                for _ in range(args.requests)]
+        gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+        # drain-handoff smoke: hand the first replica's traffic off
+        # halfway through (needs a survivor)
+        drain_at = args.requests // 2 if args.replicas > 1 else None
+        drained = router.replica_names()[0] if drain_at else None
+        t_start = time.perf_counter()
+        pending = []
+        for i, f in enumerate(reqs):
+            if drain_at is not None and i == drain_at:
+                # claim the replica NOW (timeout=0 polls: routing stops
+                # atomically, the engine drains in the background while
+                # the replay keeps landing on the survivors)
+                router.drain_replica(drained, timeout=0)
+            target = t_start + float(gaps[: i + 1].sum())
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            pending.append((time.perf_counter(), router.submit(f), i))
+        lat = []
+        rows = 0
+        per_replica = {}
+        misroutes = 0
+        for t0, fut, i in pending:
+            fut.result(timeout=60)
+            l = time.perf_counter() - t0
+            lat.append(l)
+            rows += reqs[i][predict.feed_names[0]].shape[0]
+            per_replica.setdefault(fut.replica, []).append(l)
+            if drain_at is not None and i >= drain_at \
+                    and fut.replica == drained:
+                misroutes += 1
+        elapsed = time.perf_counter() - t_start
+        drain_done = (router.drain_replica(drained, timeout=60.0)
+                      if drain_at is not None else None)
+        st = router.stats()
+        router.close()
+    result = {
+        "mode": "router",
+        "model": args.model,
+        "replicas": args.replicas,
+        "requests": args.requests,
+        "p50_ms": _percentile(lat, 50) * 1e3,
+        "p99_ms": _percentile(lat, 99) * 1e3,
+        "throughput_rps": args.requests / elapsed,
+        "throughput_rows_s": rows / elapsed,
+        "routed": st["routed"],
+        "skipped_unhealthy": st["skipped"],
+        "handoffs": st["handoffs"],
+        # every submit returned a future and every future resolved —
+        # anything else raised above, so this banks as a hard zero
+        "lost_requests": args.requests - len(lat),
+        "per_replica": {
+            name: {
+                "requests": len(ls),
+                "rps": len(ls) / elapsed,
+                "p50_ms": _percentile(ls, 50) * 1e3,
+                "p99_ms": _percentile(ls, 99) * 1e3,
+            } for name, ls in sorted(per_replica.items())
+        },
+    }
+    if drain_at is not None:
+        result.update({
+            "drained_replica": drained,
+            "drain_completed": int(bool(drain_done)),
+            # requests submitted at/after the drain point must not have
+            # landed on the drained replica
+            "post_drain_misroutes": misroutes,
+        })
+    return result
+
+
 def run_decode_bench(args) -> dict:
     from paddle_tpu import serving
 
@@ -263,10 +379,19 @@ def run_decode_bench(args) -> dict:
         max_length=args.max_len)
     params = serving.init_decode_params(cfg, seed=args.seed)
     rng = np.random.RandomState(args.seed)
-    pool = serving.KVCachePool(
-        num_pages=args.pages, page_size=args.page_size,
-        num_layers=cfg.n_layer, num_heads=cfg.n_head,
-        head_dim=cfg.head_dim)
+    program = None
+    if args.mesh > 1:
+        from paddle_tpu.serving.distributed import ShardedDecodeProgram
+
+        program = ShardedDecodeProgram(
+            params, cfg, n_shards=args.mesh, paged_impl=args.paged_impl)
+        pool = program.make_pool(num_pages=args.pages,
+                                 page_size=args.page_size)
+    else:
+        pool = serving.KVCachePool(
+            num_pages=args.pages, page_size=args.page_size,
+            num_layers=cfg.n_layer, num_heads=cfg.n_head,
+            head_dim=cfg.head_dim)
     plo, phi = (int(p) for p in args.prompt_range.split(","))
     phi = min(phi, args.max_len - args.max_new)
     reqs = []
@@ -282,7 +407,7 @@ def run_decode_bench(args) -> dict:
     loop = serving.ContinuousBatchingLoop(
         params, cfg, pool, max_batch=args.max_batch,
         paged_impl=args.paged_impl, prefill=args.prefill,
-        check_every=1 if chaos else 0)
+        check_every=1 if chaos else 0, program=program)
     if chaos:
         from paddle_tpu.resilience import faultinject  # noqa: F401
 
@@ -307,6 +432,7 @@ def run_decode_bench(args) -> dict:
     st = pool.stats()
     result = {
         "mode": "decode",
+        "mesh": args.mesh,
         "paged_impl": loop.paged_impl,  # the impl that actually ran
         "prefill": loop.prefill,
         "sequences": args.sequences,
@@ -340,7 +466,8 @@ def run_decode_bench(args) -> dict:
 # so banking {"flight_dumps": 1} asserts the chaos breaker trip left a
 # black-box artifact behind
 _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
-                     "recovered", "invariants_ok", "flight_dumps")
+                     "recovered", "invariants_ok", "flight_dumps",
+                     "drain_completed")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -380,6 +507,12 @@ def main(argv=None) -> int:
                          "from lo,hi")
     ap.add_argument("--buckets", default=None,
                     help="bucket ladder (default FLAGS_serving_buckets)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine mode: front N replica engines with a "
+                         "distributed.Router (N >= 2 adds the drain-"
+                         "handoff smoke: one replica drained mid-run, "
+                         "post_drain_misroutes and lost_requests must "
+                         "bank 0)")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--queue-depth", type=int, default=1024)
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
@@ -390,6 +523,10 @@ def main(argv=None) -> int:
                          "from lo,hi")
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--mesh", type=int, default=1,
+                    help="decode mode: run the tensor-parallel "
+                         "ShardedDecodeProgram over an N-device mesh "
+                         "(chip-less via virtual CPU devices)")
     ap.add_argument("--paged-impl", default=None,
                     choices=("reference", "pallas", "interpret"),
                     help="decode mode: paged-attention impl (default: "
@@ -426,6 +563,41 @@ def main(argv=None) -> int:
                     help="exit 3 when a baseline verdict fails")
     args = ap.parse_args(argv)
 
+    # usage validation FIRST: a usage error must exit 2 before --mesh
+    # mutates the process environment or forces a jax backend
+    if args.replicas < 1 or (args.replicas > 1 and args.mode != "engine"):
+        sys.stderr.write(
+            "serve_bench: --replicas needs engine mode and N >= 1\n")
+        return 2
+    if args.mesh > 1 and args.mode != "decode":
+        sys.stderr.write("serve_bench: --mesh needs --mode decode\n")
+        return 2
+    if args.chaos and args.replicas > 1:
+        sys.stderr.write(
+            "serve_bench: --chaos drives the single-engine FAULT_SERVE_* "
+            "knobs; run it without --replicas (router-mode resilience is "
+            "the drain-handoff smoke)\n")
+        return 2
+    if args.mesh > 1:
+        # the sharded decode program needs a mesh: force virtual CPU
+        # devices while that is still possible (the flag only works
+        # before the jax backend initializes)
+        if "jax" not in sys.modules:
+            fl = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in fl:
+                os.environ["XLA_FLAGS"] = (
+                    fl + " --xla_force_host_platform_device_count="
+                    f"{args.mesh}")
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        if len(jax.devices()) < args.mesh:
+            sys.stderr.write(
+                f"serve_bench: --mesh {args.mesh} needs {args.mesh} "
+                f"devices but the platform initialized with "
+                f"{len(jax.devices())}\n")
+            return 2
+
     # shared CI-gate contract (README "CI gates"): usage/environment
     # errors exit 2 so wiring can tell "gate broken" from "regressed"
     if args.gate and not args.baseline:
@@ -457,8 +629,12 @@ def main(argv=None) -> int:
                           "FLAGS_flight_dir": obs_dir})
         obs.reset()  # run-scoped artifacts, not whatever came before
     try:
-        result = (run_engine_bench(args) if args.mode == "engine"
-                  else run_decode_bench(args))
+        if args.mode == "engine" and args.replicas > 1:
+            result = run_router_bench(args)
+        elif args.mode == "engine":
+            result = run_engine_bench(args)
+        else:
+            result = run_decode_bench(args)
     finally:
         if prev_flags is not None:
             pflags.set_flags(prev_flags)
