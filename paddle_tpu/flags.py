@@ -136,6 +136,14 @@ _DEFS: Dict[str, Any] = {
     # log — never a Mosaic compile failure); "interpret" runs the pallas
     # kernel under the interpreter (CPU parity testing)
     "FLAGS_serving_paged_impl": "auto",
+    # chunked prefill (serving/generate.py): cap on PREFILL tokens one
+    # engine step may process across the batch.  0 (default) is
+    # uncapped — whole prompts prefill in one pass.  With a cap, long
+    # prompts split into <=N-token chunks and the scheduler interleaves
+    # decode steps between chunks, bounding how long an in-flight
+    # sequence's next token can stall behind someone else's prefill
+    # (the TTFT/inter-token-jitter knob for bursty shared-prefix load)
+    "FLAGS_serving_prefill_chunk": 0,
     # serving circuit breaker (serving/engine.py): after
     # serving_breaker_threshold CONSECUTIVE batch-dispatch failures the
     # engine opens its breaker — submit() fails fast with

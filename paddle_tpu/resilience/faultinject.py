@@ -43,6 +43,11 @@ Serving knobs (tests/test_serving_resilience.py chaos suite):
         batch dispatch while armed (NOT one-shot) — inflates observed
         batch latency so overload tests can saturate the queue and
         exercise deadline-aware shedding deterministically.
+    FAULT_SERVE_PREFIX_CORRUPT=1      prefix cache: poison a cached KV
+        page (NaN K content — flipped exponent bytes surfacing as
+        non-finite activations) at its next reuse, once — the sequence
+        served the poisoned prefix must be quarantined and the cached
+        chain invalidated while batch-mates decode on unharmed.
 """
 
 from __future__ import annotations
@@ -54,7 +59,7 @@ __all__ = [
     "reset", "fired", "shard_write_kill", "corrupt_shard",
     "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
     "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
-    "serve_slow_step",
+    "serve_slow_step", "serve_prefix_corrupt",
 ]
 
 fired: set = set()
@@ -205,6 +210,18 @@ def serve_leak_pages() -> int:
         return 0
     fired.add("serve_leak")
     return int(raw)
+
+
+def serve_prefix_corrupt() -> bool:
+    """FAULT_SERVE_PREFIX_CORRUPT: True exactly once while armed — the
+    prefix cache poisons the first page of the next attached match
+    (KVCachePool.corrupt_page: NaN K content, the detectable face of a
+    flipped-byte page)."""
+    if not os.environ.get("FAULT_SERVE_PREFIX_CORRUPT") \
+            or "serve_prefix_corrupt" in fired:
+        return False
+    fired.add("serve_prefix_corrupt")
+    return True
 
 
 def serve_slow_step() -> None:

@@ -31,6 +31,15 @@ loop):
   workloads; admitted prompts prefill in ONE whole-prompt causal pass
   (``prefill_step``; ``prefill="token"`` keeps the step-per-token arm);
   ``full_decode`` is the full-recompute parity oracle.
+- **Prefix cache** (prefixcache.py, ISSUE 11) — refcounted
+  copy-on-write page sharing over the pool: prompts are trie-keyed by
+  a rolling prefix hash at page granularity, a hit attaches cached
+  pages read-only (refcount++; ``free_seq`` frees only refcount-zero
+  pages) and prefills ONLY the unshared tail via
+  ``chunk_prefill_step``; the first divergent append copy-on-writes a
+  shared partial tail page; LRU eviction under pool pressure;
+  ``FLAGS_serving_prefill_chunk`` caps prefill tokens per engine step
+  with chunk/decode interleaving (chunked prefill).
 
 Fault isolation (ISSUE 6 — the resilience pillar's serving half): a
 backend raise fails only its batch's futures (typed EngineInternalError)
@@ -93,6 +102,7 @@ from .generate import (
     prefill_step,
 )
 from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
+from .prefixcache import PrefixCache, PrefixMatch
 from . import distributed  # noqa: F401 — serving.distributed is API
 
 __all__ = [
@@ -111,6 +121,8 @@ __all__ = [
     "KVCachePool",
     "NonFiniteSequenceError",
     "PagePoolExhausted",
+    "PrefixCache",
+    "PrefixMatch",
     "QueueFullError",
     "RequestTimeoutError",
     "SequenceHandle",

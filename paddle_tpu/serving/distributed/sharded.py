@@ -246,7 +246,18 @@ class ShardedKVCachePool(KVCachePool):
 
     K/V writes on the sharded path happen INSIDE the shard-mapped step
     (each device writes its own heads); the program hands the updated
-    arrays back through :meth:`store`."""
+    arrays back through :meth:`store`.
+
+    Prefix caching (ISSUE 11) rides the host-global bookkeeping for
+    free: page refcounts, ``attach_prefix``, LRU eviction, and the
+    invariant audit are pure table/free-list state — inherited
+    unchanged — and the copy-on-write page copy is a functional update
+    along the (unsharded) page axis, so one ``_cow_tail`` executes as
+    a per-shard local copy on every device.  A
+    ``serving.PrefixCache(pool)`` over this pool therefore shares an
+    N-way prefix at 1/n_shards bytes per device with no SPMD-side
+    changes; the loop feeds cached-prefix tails through the program's
+    decode step (its prefill body starts at position 0)."""
 
     def __init__(self, num_pages: int, page_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype="float32",

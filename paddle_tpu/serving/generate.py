@@ -50,9 +50,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import flags as _flags
-from ..kernels.flash_attention import _reference_attention, flash_attention
+from ..kernels.flash_attention import (
+    NEG_INF,
+    _reference_attention,
+    flash_attention,
+)
 from ..kernels.paged_attention import (
     attention_bytes_per_step,
+    gather_kv_pages,
     paged_decode_attention,
     resolve_paged_impl,
 )
@@ -76,6 +81,7 @@ __all__ = [
     "full_forward",
     "full_decode",
     "prefill_step",
+    "chunk_prefill_step",
 ]
 
 
@@ -279,6 +285,80 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
 
 
+def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
+                       seq_ids: Sequence[int],
+                       chunks: Sequence[Sequence[int]],
+                       start_positions: Sequence[int]) -> np.ndarray:
+    """Suffix/chunk prefill: process ``chunks[i]`` consecutive prompt
+    tokens for sequence i starting at absolute position
+    ``start_positions[i]`` — which need NOT be 0.  The chunk's queries
+    attend over everything the sequence's page table already holds (a
+    prefix-cache-attached shared prefix, earlier chunks of a long
+    prompt) PLUS the chunk itself causally, so prefill can resume
+    mid-prompt: the prefix-cache hit path pays model compute only for
+    the unshared tail, and the chunked-prefill scheduler splits a long
+    prompt across engine steps.
+
+    The chunk's per-layer K/V lands in the pool through the same atomic
+    ``append_tokens`` claim as every other write — a shared
+    partially-filled tail page copy-on-writes right there.  Attention
+    is the explicit reference tier: gather the sequence's pages and
+    mask by absolute position (key j visible to query at position p
+    iff j <= p — cached prefix fully visible, in-chunk causal, padding
+    and unwritten slots masked).  A pallas chunk kernel is future work;
+    decode steps keep the paged impl selection.
+
+    Returns the logits [B, V] at each sequence's LAST chunk token —
+    meaningful only for chunks that complete their prompt."""
+    import jax
+    import jax.numpy as jnp
+
+    lens = np.asarray([len(c) for c in chunks], np.int32)
+    if not len(lens) or lens.min() < 1:
+        raise ValueError("chunk prefill needs non-empty chunks")
+    starts = np.asarray(start_positions, np.int32)
+    B, Cmax = len(chunks), int(lens.max())
+    if int((starts + lens).max()) > cfg.max_length:
+        # before append_tokens: a failed chunk must not leave claimed
+        # slots with no K/V behind (the pool's atomicity contract)
+        raise ValueError(
+            f"chunk reaches position {int((starts + lens).max())} > "
+            f"max_length {cfg.max_length}")
+    d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    tokens = np.zeros((B, Cmax), np.int32)
+    for i, c in enumerate(chunks):
+        tokens[i, :lens[i]] = c
+    pages, slots = pool.append_tokens(seq_ids, lens)
+    tables, _total = pool.page_table_batch(seq_ids)
+    b_idx = np.repeat(np.arange(B), lens)
+    t_idx = np.concatenate([np.arange(n) for n in lens])
+    S = tables.shape[1] * pool.page_size
+    pos = starts[:, None] + np.arange(Cmax)[None, :]  # absolute positions
+    pos_c = np.minimum(pos, cfg.max_length - 1)  # padded rows: clamp only
+    # key j visible to query (b, i) iff j <= pos[b, i]; the jnp.where
+    # also neutralizes NaN scores from masked garbage (padding pages)
+    mask = jnp.asarray(np.arange(S)[None, None, :] <= pos[:, :, None])
+    h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+        + jnp.asarray(params["pos"])[pos_c]  # [B, Cmax, d]
+    scale = Dh ** -0.5
+    for li, lp in enumerate(params["layers"]):
+        q = (h @ lp["wq"]).reshape(B, Cmax, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, Cmax, H, Dh)
+        v = (h @ lp["wv"]).reshape(B, Cmax, H, Dh)
+        pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
+        k_full = gather_kv_pages(pool.k_pages[li], tables)  # [B, H, S, Dh]
+        v_full = gather_kv_pages(pool.v_pages[li], tables)
+        scores = jnp.einsum("bihd,bhjd->bhij", q, k_full) * scale
+        scores = jnp.where(mask[:, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhij,bhjd->bihd", w, v_full).reshape(B, Cmax, d)
+        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
+        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+    h_last = h[jnp.arange(B), lens - 1]  # [B, d] true last chunk tokens
+    return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
+
+
 @dataclasses.dataclass
 class DecodeRequest:
     prompt: Sequence[int]
@@ -311,7 +391,8 @@ class GeneratedSequence:
 
 
 class _Active:
-    __slots__ = ("req", "seq_id", "pos", "result", "rt")
+    __slots__ = ("req", "seq_id", "pos", "result", "rt", "matched",
+                 "charged", "whole", "chunk_mode", "inserted")
 
     def __init__(self, req: DecodeRequest, seq_id: int,
                  result: GeneratedSequence, rt=None):
@@ -320,6 +401,11 @@ class _Active:
         self.pos = 0  # next position to feed
         self.result = result
         self.rt = rt  # RequestTrace (None with observability off)
+        self.matched = 0   # prompt tokens served from the prefix cache
+        self.charged = 0   # pages this admission reserved (prefix-aware)
+        self.whole = False       # whole-prompt prefill_step at admission
+        self.chunk_mode = False  # tail/capped prefill via chunk steps
+        self.inserted = False    # prompt pages offered to the cache
 
 
 class ContinuousBatchingLoop:
@@ -342,6 +428,23 @@ class ContinuousBatchingLoop:
     the pool geometry once, so metrics are labeled with the impl that
     actually runs).
 
+    ``prefix_cache`` (a serving.PrefixCache over the same pool) turns
+    shared-prefix prompts into page reuse: admission matches the
+    longest cached prefix, attaches its pages read-only (refcount++,
+    charged ZERO fresh pages for matched full pages), and prefill
+    covers only the unshared tail via ``chunk_prefill_step`` (the
+    token arm and SPMD programs resume at the matched position
+    instead).  Completed prefills insert their prompt pages back into
+    the cache; retirement frees only refcount-zero pages; a
+    quarantined hit invalidates its cached chain.  ``prefill_chunk``
+    (None: FLAGS_serving_prefill_chunk; 0 = uncapped) bounds the
+    PREFILL tokens any single engine step may process, and the
+    scheduler alternates chunk and decode steps when both kinds of
+    work exist — long prompts stop stalling in-flight sequences'
+    per-token latency.  Counters: ``prefix_hits``/``prefix_misses``,
+    ``cached_prefill_tokens``, ``prefill_tokens``,
+    ``max_prefill_tokens_step``.
+
     Fault isolation: every step's logits pass a per-ROW jitted
     finite-check (resilience.sentinel.rows_finite — ONE fused jit call
     per step, no per-sequence host sync); a non-finite row QUARANTINES
@@ -357,10 +460,16 @@ class ContinuousBatchingLoop:
                  max_batch: int = 4, force: str = "auto",
                  paged_impl: Optional[str] = None,
                  prefill: str = "batched", check_every: int = 0,
-                 program=None):
+                 program=None, prefix_cache=None,
+                 prefill_chunk: Optional[int] = None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
+        if prefix_cache is not None and prefix_cache.pool is not pool:
+            raise ValueError(
+                "prefix_cache is wired to a different pool — shared "
+                "pages and refcounts must live in the pool this loop "
+                "appends to")
         self.params = params
         self.cfg = cfg if cfg is not None else getattr(program, "cfg", None)
         if self.cfg is None:
@@ -383,6 +492,14 @@ class ContinuousBatchingLoop:
             self.paged_impl = resolve_paged_impl(
                 paged_impl, pool.page_size, self.cfg.head_dim,
                 pool.k_pages.dtype)
+        self.prefix_cache = prefix_cache
+        # prefill-token cap per engine step (0 = uncapped); None reads
+        # FLAGS_serving_prefill_chunk
+        self._prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else _flags._VALUES["FLAGS_serving_prefill_chunk"])
+        if self._prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
         self._next_seq_id = 0
         self.steps = 0
         self.prefill_steps = 0
@@ -391,14 +508,32 @@ class ContinuousBatchingLoop:
         self.reclaimed_pages = 0
         self.invariant_violations = 0
         self._occupancy_sum = 0.0
+        # prefix-cache / chunked-prefill accounting (serve_bench banks
+        # hit rate + cached tokens; tests counter-assert the chunk cap)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cached_prefill_tokens = 0
+        self.prefill_tokens = 0
+        self.max_prefill_tokens_step = 0
+        self._prefer_prefill = True
 
-    def _footprint(self, req: DecodeRequest) -> int:
+    def _footprint(self, req: DecodeRequest, matched: int = 0) -> int:
+        """Worst-case pages a request pulls from the FREE list.  With
+        `matched` prompt tokens served by the prefix cache, only the
+        unshared region is charged: the matched FULL pages attach
+        refcounted (no free-list pressure), and the pages for
+        everything past them — including the copy-on-write replacement
+        of a shared partial tail page — are exactly
+        ceil((total - matched_full) / page_size)."""
         total = len(req.prompt) + req.max_new_tokens
         if total > self.cfg.max_length:
             raise ValueError(
                 f"prompt+max_new={total} exceeds max_length "
                 f"{self.cfg.max_length}")
-        return KVCachePool.pages_needed(total, self.pool.page_size)
+        matched_full = (int(matched) // self.pool.page_size) \
+            * self.pool.page_size
+        return KVCachePool.pages_needed(total - matched_full,
+                                        self.pool.page_size)
 
     def run(self, requests: Sequence[DecodeRequest]) -> List[GeneratedSequence]:
         obs_on = _flags._VALUES["FLAGS_observability"]
@@ -456,8 +591,22 @@ class ContinuousBatchingLoop:
                 err.trace_id = a.result.trace_id
                 a.result.error = err
                 a.result.finished_at = now
+                # poison containment: the quarantined sequence may have
+                # written non-finite K/V — zero its private pages so
+                # the free list never recycles NaN content (0 * NaN
+                # would poison a later reader through masked weights)
+                self.pool.scrub_seq_pages(a.seq_id)
                 self.pool.free_seq(a.seq_id)
-                reserved_pages -= self._footprint(a.req)
+                reserved_pages -= a.charged
+                if self.prefix_cache is not None:
+                    if a.matched:
+                        # the sequence read cached pages: presume the
+                        # chain poisoned and drop it (chaos:
+                        # FAULT_SERVE_PREFIX_CORRUPT) so the corruption
+                        # cannot be served to the next hit
+                        self.prefix_cache.quarantine_seq(a.seq_id)
+                    else:
+                        self.prefix_cache.forget_seq(a.seq_id)
                 self.quarantined += 1
                 if obs_on:
                     _smetrics.record_sequence("quarantined")
@@ -501,7 +650,9 @@ class ContinuousBatchingLoop:
                 active.remove(a)
                 a.result.finished_at = now
                 self.pool.free_seq(a.seq_id)
-                reserved_pages -= self._footprint(a.req)
+                reserved_pages -= a.charged
+                if self.prefix_cache is not None:
+                    self.prefix_cache.forget_seq(a.seq_id)
                 if obs_on:
                     _smetrics.record_sequence("retired")
                     kept = False
@@ -525,20 +676,53 @@ class ContinuousBatchingLoop:
 
         try:
             while waiting or active:
-                # admit (FIFO) while a slot and a full worst-case
-                # reservation fit
+                # admit (FIFO) while a slot and a worst-case reservation
+                # fit.  The reservation is PREFIX-AWARE: a cached-prefix
+                # hit charges only the unshared tail, and the bound
+                # additionally sets aside every live attached page no
+                # admission charge covers (pool.uncharged_live_pages —
+                # ground truth off the allocator map, so a cache entry
+                # being dropped cannot hide a still-attached page;
+                # slightly conservative, never over-committed)
                 newly: List[_Active] = []
                 while waiting and len(active) < self.max_batch:
                     req, seq, rt = waiting[0]
-                    need = self._footprint(req)
-                    if reserved_pages + need > self.pool.num_pages:
+                    m = None
+                    matched = 0
+                    if self.prefix_cache is not None:
+                        m = self.prefix_cache.match(req.prompt)
+                        matched = m.tokens
+                    need = self._footprint(req, matched)
+                    locked = (self.pool.uncharged_live_pages()
+                              if self.prefix_cache is not None else 0)
+                    if reserved_pages + need > self.pool.num_pages - locked:
                         break  # wait for retirements
                     waiting.pop(0)
                     seq.seq_id = self._next_seq_id
                     self._next_seq_id += 1
                     self.pool.allocate(seq.seq_id)
+                    if m is not None:
+                        matched = self.prefix_cache.attach(seq.seq_id, m)
+                        if matched:
+                            self.prefix_hits += 1
+                            self.cached_prefill_tokens += matched
+                        else:
+                            self.prefix_misses += 1
                     seq.admitted_at = time.perf_counter()
                     a = _Active(req, seq.seq_id, seq, rt=rt)
+                    a.pos = matched
+                    a.matched = matched
+                    a.charged = need
+                    # whole-prompt prefill keeps its one-pass fast path
+                    # when nothing is cached and no chunk cap binds;
+                    # everything else goes through chunk steps (or, for
+                    # an SPMD program, token-fed decode steps — the
+                    # program's prefill starts at position 0)
+                    a.whole = (self.prefill == "batched" and matched == 0
+                               and not self._prefill_chunk)
+                    a.chunk_mode = (self.prefill == "batched"
+                                    and not a.whole
+                                    and self.program is None)
                     active.append(a)
                     newly.append(a)
                     reserved_pages += need
@@ -548,17 +732,25 @@ class ContinuousBatchingLoop:
                             "admit", seq_id=seq.seq_id,
                             trace_id=seq.trace_id,
                             prompt_len=len(seq.prompt),
+                            cached_tokens=matched,
                             reserved_pages=reserved_pages)
+                        if matched:
+                            _flight.default_flight().record(
+                                "prefix_hit", seq_id=seq.seq_id,
+                                trace_id=seq.trace_id, tokens=matched)
                         if rt is not None:
                             rt.event("sequence.queued", rt.t0,
                                      seq.admitted_at)
                             rt.annotate(seq_id=seq.seq_id,
-                                        prompt_len=len(seq.prompt))
+                                        prompt_len=len(seq.prompt),
+                                        cached_tokens=matched)
                 # NOTE: waiting-but-nothing-active cannot happen — the
                 # up-front validation guarantees the head request fits an
-                # empty pool, so admission always progresses
+                # empty pool (locked pages are 0 with no live readers),
+                # so admission always progresses
 
-                if self.prefill == "batched" and newly:
+                whole_group = [a for a in newly if a.whole]
+                if whole_group:
                     # ONE whole-prompt pass for the co-admitted group:
                     # every prompt token's K/V lands in the pool and each
                     # sequence gets its first generated token — O(1)
@@ -568,23 +760,30 @@ class ContinuousBatchingLoop:
                     step_idx = self.steps
                     if self.program is not None:
                         logits = self.program.prefill_step(
-                            self.pool, [a.seq_id for a in newly],
-                            [a.result.prompt for a in newly])
+                            self.pool, [a.seq_id for a in whole_group],
+                            [a.result.prompt for a in whole_group])
                     else:
                         logits = prefill_step(
                             self.params, self.cfg, self.pool,
-                            [a.seq_id for a in newly],
-                            [a.result.prompt for a in newly],
+                            [a.seq_id for a in whole_group],
+                            [a.result.prompt for a in whole_group],
                             force=self.force)
                     self.steps += 1
                     self.prefill_steps += 1
-                    self._occupancy_sum += len(newly) / float(self.max_batch)
-                    logits, ok, now = quarantine(newly, logits, step_idx)
+                    ntok = sum(len(a.result.prompt) for a in whole_group)
+                    self.prefill_tokens += ntok
+                    self.max_prefill_tokens_step = max(
+                        self.max_prefill_tokens_step, ntok)
+                    self._occupancy_sum += \
+                        len(whole_group) / float(self.max_batch)
+                    logits, ok, now = quarantine(whole_group, logits,
+                                                 step_idx)
                     done_now: List[_Active] = []
-                    for i, a in enumerate(newly):
+                    for i, a in enumerate(whole_group):
                         a.pos = len(a.result.prompt)
                         if i not in ok:
                             continue  # quarantined at prefill
+                        self._cache_insert(a)
                         if emit(a, np.asarray(logits[i]), t0, now):
                             done_now.append(a)
                     retire(done_now, now)
@@ -595,12 +794,81 @@ class ContinuousBatchingLoop:
 
                 if not active:
                     continue
-                # one token per active sequence; under prefill="token" a
+
+                # chunk-mode sequences (cached-prefix tails, capped long
+                # prompts) prefill through chunk steps; everyone else —
+                # generating sequences and token-arm/program prefillers —
+                # steps through the decode path.  When both kinds of
+                # work exist the scheduler ALTERNATES, so a long
+                # prompt's chunks interleave with in-flight sequences'
+                # decode steps instead of stalling them
+                chunkers = [a for a in active if a.chunk_mode
+                            and a.pos < len(a.result.prompt)]
+                decodable = [a for a in active if not (
+                    a.chunk_mode and a.pos < len(a.result.prompt))]
+                if chunkers and (not decodable or self._prefer_prefill):
+                    t0 = time.perf_counter()
+                    step_idx = self.steps
+                    budget = self._prefill_chunk or sum(
+                        len(a.result.prompt) - a.pos for a in chunkers)
+                    sel: List[_Active] = []
+                    chunks: List[List[int]] = []
+                    starts: List[int] = []
+                    for a in chunkers:
+                        if budget <= 0:
+                            break
+                        n = min(len(a.result.prompt) - a.pos, budget)
+                        sel.append(a)
+                        chunks.append(a.result.prompt[a.pos:a.pos + n])
+                        starts.append(a.pos)
+                        budget -= n
+                    logits = chunk_prefill_step(
+                        self.params, self.cfg, self.pool,
+                        [a.seq_id for a in sel], chunks, starts)
+                    self.steps += 1
+                    self.prefill_steps += 1
+                    ntok = sum(len(c) for c in chunks)
+                    self.prefill_tokens += ntok
+                    self.max_prefill_tokens_step = max(
+                        self.max_prefill_tokens_step, ntok)
+                    self._occupancy_sum += len(sel) / float(self.max_batch)
+                    logits, ok, now = quarantine(sel, logits, step_idx)
+                    done_now = []
+                    for i, a in enumerate(sel):
+                        if i not in ok:
+                            continue  # quarantined at this chunk
+                        a.pos += len(chunks[i])
+                        if a.pos >= len(a.result.prompt):
+                            self._cache_insert(a)
+                            if emit(a, np.asarray(logits[i]), t0, now):
+                                done_now.append(a)
+                    retire(done_now, now)
+                    if obs_on:
+                        self._note_attention_bytes()
+                    self._watchdog()
+                    self._prefer_prefill = False
+                    continue
+
+                # one token per stepping sequence; under prefill="token"
+                # (and program-driven cached-prefix tails) a
                 # still-prefilling sequence and a deep-decode sequence
-                # share the batch and differ only in k_lengths
+                # share the batch and differ only in k_lengths.  The
+                # chunk cap bounds how many prefill tokens (one per
+                # prefilling sequence here) ride one step
+                batch = list(decodable)
+                if self._prefill_chunk:
+                    pre = [a for a in batch
+                           if a.pos < len(a.result.prompt)]
+                    if len(pre) > self._prefill_chunk:
+                        keep = set(
+                            id(a) for a in pre[:self._prefill_chunk])
+                        batch = [a for a in batch
+                                 if a.pos >= len(a.result.prompt)
+                                 or id(a) in keep]
+                if not batch:
+                    continue
                 t0 = time.perf_counter()
                 step_idx = self.steps
-                batch = list(active)
                 seq_ids = [a.seq_id for a in batch]
                 tokens = [
                     (a.result.prompt[a.pos] if a.pos < len(a.result.prompt)
@@ -617,6 +885,12 @@ class ContinuousBatchingLoop:
                         positions, force=self.force, impl=self.paged_impl)
                 self.steps += 1
                 self.decode_steps += 1
+                ntok = sum(1 for a in batch
+                           if a.pos < len(a.result.prompt))
+                if ntok:
+                    self.prefill_tokens += ntok
+                    self.max_prefill_tokens_step = max(
+                        self.max_prefill_tokens_step, ntok)
                 self._occupancy_sum += len(batch) / float(self.max_batch)
                 logits, ok, now = quarantine(batch, logits, step_idx)
 
@@ -627,12 +901,17 @@ class ContinuousBatchingLoop:
                         continue  # quarantined this step
                     if a.pos < len(a.result.prompt):
                         continue  # still prefilling; logits unused
+                    if a.pos == len(a.result.prompt):
+                        # the fed token completed the prompt's K/V:
+                        # offer its pages to the prefix cache
+                        self._cache_insert(a)
                     if emit(a, np.asarray(logits[i]), t0, now):
                         retired.append(a)
                 retire(retired, now)
                 if obs_on:
                     self._note_attention_bytes()
                 self._watchdog()
+                self._prefer_prefill = True
         except BaseException:
             # ANY raise out of a prefill/decode step (or admission): the
             # stepping sequences' pages go back to the pool BEFORE the
@@ -640,9 +919,20 @@ class ContinuousBatchingLoop:
             # (the acknowledged hazard this loop previously carried)
             for a in active:
                 self.pool.free_seq(a.seq_id)
+                if self.prefix_cache is not None:
+                    self.prefix_cache.forget_seq(a.seq_id)
             active.clear()
             raise
         return results
+
+    def _cache_insert(self, a: _Active) -> None:
+        """Offer a fully-prefilled prompt's pages to the prefix cache
+        (once per sequence): future prompts sharing the prefix attach
+        them instead of re-prefilling."""
+        if self.prefix_cache is None or a.inserted:
+            return
+        a.inserted = True
+        self.prefix_cache.insert(a.seq_id, a.result.prompt)
 
     def _watchdog(self) -> None:
         """Every check_every steps: audit pool integrity and repair

@@ -25,6 +25,19 @@ view for the flash_attention ragged `k_lengths` tier.
 Writes use jax functional updates (`.at[...].set`), so the pool works on
 any backend; on TPU XLA performs them as in-place dynamic-update-slices
 when the buffer is donated (the arrays are never aliased here).
+
+ISSUE 11 adds REFCOUNTED pages — the substrate of the prefix cache
+(serving/prefixcache.py).  Every allocated page carries a refcount:
+ordinarily 1 (its owning sequence), >1 when a prefix-cache entry and/or
+additional sequences share it read-only (``attach_prefix`` /
+``retain_pages``).  ``free_seq`` only returns pages whose refcount hits
+zero, so an N-way-shared system prompt costs ONE page-set.  A shared
+page is immutable: the first divergent append into a partially-filled
+shared tail page triggers COPY-ON-WRITE inside ``append_tokens`` (fresh
+page, device-side content copy, table tail swap) — accounted for in the
+same atomic claim, so exhaustion still raises before any table mutates.
+Under pressure the pool calls registered reclaimers (the prefix cache's
+LRU eviction) to release cache-only pages before giving up.
 """
 
 from __future__ import annotations
@@ -85,15 +98,36 @@ class KVCachePool:
         shape = (num_layers, num_heads, num_pages, page_size, head_dim)
         self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self.v_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
-        self._lock = threading.Lock()
+        # RLock: pressure reclaimers (prefix-cache LRU eviction) run
+        # INSIDE append_tokens' critical section and call back into
+        # release_pages on the same thread
+        self._lock = threading.RLock()
         # LIFO free list: recently-freed pages are reused first (their
         # tiles are warm in whatever cache hierarchy the backend has)
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._tables: Dict[int, SequenceHandle] = {}
+        # per-page refcount: 0 = free, 1 = single owner, >1 = shared
+        # read-only (prefix cache and/or attached sequences)
+        self._ref: List[int] = [0] * self.num_pages
+        # page -> the LIVE sequence whose admission charge covers it
+        # (set at allocation, cleared when that sequence retires while
+        # the page lives on, or when the page frees).  Admission's
+        # uncharged_live_pages() is exact off this map — it cannot be
+        # fooled by prefix-cache entry bookkeeping
+        self._allocator: Dict[int, int] = {}
+        # pressure reclaimers: fn(pages_short) -> pages freed (the
+        # prefix cache's LRU eviction registers here)
+        self._reclaim_hooks: List = []
+        # external owners: fn() -> Dict[page, holds] (refcounts a table
+        # does not explain — the prefix cache's entry holds)
+        self._owner_hooks: List = []
+        # defrag listeners: fn(remap Dict[old_page, new_page])
+        self._remap_hooks: List = []
         self._stats = {
             "page_allocs": 0, "page_frees": 0, "token_appends": 0,
             "defrag_moves": 0, "used_pages_high_water": 0,
-            "orphans_reclaimed": 0,
+            "orphans_reclaimed": 0, "cow_copies": 0,
+            "shared_attach_pages": 0,
         }
 
     # -- sizing math (documented in README "Serving") -------------------
@@ -121,16 +155,162 @@ class KVCachePool:
             return h
 
     def free_seq(self, seq_id: int) -> int:
-        """Retire a sequence: its pages return to the free list.
-        Returns the number of pages released."""
+        """Retire a sequence: each of its pages drops one refcount, and
+        ONLY pages whose refcount hits zero return to the free list —
+        pages shared with the prefix cache or other sequences stay
+        live.  Returns the number of pages actually released."""
         with self._lock:
             h = self._tables.pop(seq_id)
+            n = 0
             for p in reversed(h.pages):
-                self._free.append(p)
-            self._stats["page_frees"] += len(h.pages)
-            n = len(h.pages)
+                self._ref[p] -= 1
+                if self._ref[p] <= 0:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                    self._allocator.pop(p, None)
+                    n += 1
+                elif self._allocator.get(p) == seq_id:
+                    # the charging sequence is gone but readers keep
+                    # the page alive: it is now UNCHARGED (admission's
+                    # uncharged_live_pages sets it aside)
+                    del self._allocator[p]
+            self._stats["page_frees"] += n
         self._note_pool()
         return n
+
+    # -- refcount / sharing API (the prefix-cache substrate) -----------
+
+    def attach_prefix(self, seq_id: int, pages: Sequence[int],
+                      length: int) -> None:
+        """Attach already-written pages READ-ONLY to a sequence with an
+        EMPTY page table: each page's refcount increments and the
+        sequence starts at `length` tokens without touching the free
+        list — the prefix-cache hit path.  `length` must land inside
+        the last attached page (the pages exactly cover it)."""
+        pages = [int(p) for p in pages]
+        if length < 1 or not pages:
+            raise ValueError("attach_prefix needs pages covering >= 1 token")
+        cap = len(pages) * self.page_size
+        if not cap - self.page_size < length <= cap:
+            raise ValueError(
+                f"length {length} does not land in the last of "
+                f"{len(pages)} pages (page_size {self.page_size})")
+        with self._lock:
+            h = self._tables[seq_id]
+            if h.pages or h.length:
+                raise ValueError(
+                    f"sequence {seq_id} already holds pages — prefixes "
+                    "attach only at admission")
+            for p in pages:
+                if not 0 <= p < self.num_pages or self._ref[p] < 1:
+                    raise ValueError(
+                        f"page {p} is not live — cannot share a free or "
+                        "out-of-range page")
+            for p in pages:
+                self._ref[p] += 1
+            h.pages = list(pages)
+            h.length = int(length)
+            self._stats["shared_attach_pages"] += len(pages)
+        self._note_pool()
+
+    def retain_pages(self, pages: Sequence[int]) -> None:
+        """Add one refcount hold per page (the prefix cache pinning a
+        prompt's pages when an entry is inserted).  Pages must be live."""
+        with self._lock:
+            for p in pages:
+                if not 0 <= int(p) < self.num_pages or self._ref[int(p)] < 1:
+                    raise ValueError(f"page {p} is not live")
+            for p in pages:
+                self._ref[int(p)] += 1
+
+    def release_pages(self, pages: Sequence[int],
+                      scrub: bool = False) -> int:
+        """Drop one refcount hold per page; pages hitting zero return
+        to the free list.  With `scrub`, freed pages' K/V content is
+        zeroed first — the poison-containment arm: masked attention
+        multiplies a recycled page's unwritten slots by exactly-zero
+        weights, and 0 * NaN is NaN, so non-finite garbage must never
+        ride the free list.  Returns how many pages were freed."""
+        with self._lock:
+            n = 0
+            freed: List[int] = []
+            for p in pages:
+                p = int(p)
+                self._ref[p] -= 1
+                if self._ref[p] <= 0:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                    self._allocator.pop(p, None)
+                    freed.append(p)
+                    n += 1
+            if scrub and freed:
+                self._scrub(freed)
+            self._stats["page_frees"] += n
+        if n:
+            self._note_pool()
+        return n
+
+    def _scrub(self, pages: Sequence[int]) -> None:
+        """Zero the K/V content of `pages` (caller holds the lock)."""
+        idx = np.asarray(pages, np.int32)
+        self.k_pages = self.k_pages.at[:, :, idx].set(0.0)
+        self.v_pages = self.v_pages.at[:, :, idx].set(0.0)
+
+    def scrub_seq_pages(self, seq_id: int) -> int:
+        """Zero the content of a live sequence's EXCLUSIVELY-owned
+        pages (refcount 1) — the quarantine path calls this before
+        free_seq so a poisoned sequence's non-finite K/V cannot leak
+        into later reuse through masked-weight propagation (0 * NaN).
+        Shared pages are left alone: other readers still need them.
+        Returns how many pages were scrubbed."""
+        with self._lock:
+            h = self._tables[seq_id]
+            own = [p for p in h.pages if self._ref[p] == 1]
+            if own:
+                self._scrub(own)
+            return len(own)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._ref[int(page)]
+
+    def table_snapshot(self, seq_id: int) -> Tuple[List[int], int]:
+        """(pages, length) copy of one sequence's table — the prefix
+        cache reads it when inserting a finished prompt's pages."""
+        with self._lock:
+            h = self._tables[seq_id]
+            return list(h.pages), h.length
+
+    def uncharged_live_pages(self) -> int:
+        """Distinct pages referenced by >= 1 live page table whose
+        charging sequence has retired (attached shared prefixes whose
+        allocator is gone).  No live admission charge covers them and
+        they cannot be evicted under pressure while their readers
+        live, so the admission controller sets exactly this many pages
+        aside.  Ground truth from the pool's own allocator map — a
+        prefix cache dropping an ENTRY (capacity cap, quarantine
+        invalidation) cannot make an attached page invisible here."""
+        with self._lock:
+            table_pages = {p for h in self._tables.values()
+                           for p in h.pages}
+            return sum(1 for p in table_pages
+                       if p not in self._allocator)
+
+    def register_reclaimer(self, fn) -> None:
+        """`fn(pages_short) -> freed` is called (under the pool lock)
+        when an append cannot find enough free pages — the prefix
+        cache's LRU eviction.  Hooks run before PagePoolExhausted."""
+        self._reclaim_hooks.append(fn)
+
+    def register_owner(self, fn) -> None:
+        """`fn() -> Dict[page, holds]` explains refcounts that no page
+        table covers (prefix-cache entry holds) to check_invariants."""
+        self._owner_hooks.append(fn)
+
+    def register_remap_hook(self, fn) -> None:
+        """`fn(remap: Dict[old, new])` fires inside defrag() so external
+        page holders (the prefix cache) follow the compaction."""
+        self._remap_hooks.append(fn)
 
     def append_token(self, seq_ids: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         """Claim the next (page, slot) for one new token on every
@@ -156,8 +336,19 @@ class KVCachePool:
             for s, c in zip(seq_ids, counts):
                 h = self._tables[s]
                 free_slots = h.capacity(self.page_size) - h.length
+                if c > 0 and free_slots and self._ref[h.pages[-1]] > 1:
+                    # shared partially-filled tail: the divergent append
+                    # will copy-on-write it onto a fresh page
+                    need += 1
                 if c > free_slots:
                     need += self.pages_needed(c - free_slots, self.page_size)
+            if need > len(self._free):
+                # pressure: ask reclaimers (prefix-cache LRU eviction)
+                # to release cache-only pages before giving up
+                for cb in self._reclaim_hooks:
+                    if need <= len(self._free):
+                        break
+                    cb(need - len(self._free))
             if need > len(self._free):
                 raise PagePoolExhausted(
                     f"pool '{self.name}': need {need} fresh pages for "
@@ -168,9 +359,15 @@ class KVCachePool:
             i = 0
             for s, c in zip(seq_ids, counts):
                 h = self._tables[s]
+                if (c > 0 and h.length < h.capacity(self.page_size)
+                        and self._ref[h.pages[-1]] > 1):
+                    self._cow_tail(h)
                 for _ in range(c):
                     if h.length == h.capacity(self.page_size):
-                        h.pages.append(self._free.pop())
+                        p = self._free.pop()
+                        self._ref[p] = 1
+                        self._allocator[p] = h.seq_id
+                        h.pages.append(p)
                         self._stats["page_allocs"] += 1
                     pages[i] = h.pages[-1]
                     slots[i] = h.length % self.page_size
@@ -185,6 +382,39 @@ class KVCachePool:
                 self._stats["used_pages_high_water"] = used
         self._note_pool()
         return pages, slots
+
+    def _cow_tail(self, h: SequenceHandle) -> None:
+        """Copy-on-write the sequence's shared, partially-filled tail
+        page: claim a fresh page, copy the shared page's K/V content
+        (every layer, both arrays — one functional update each), drop
+        one refcount on the original, and swap the table tail.  Called
+        under the pool lock from append_tokens AFTER the atomic claim
+        check counted the extra page."""
+        old = h.pages[-1]
+        new = self._free.pop()
+        self._ref[new] = 1
+        self._allocator[new] = h.seq_id
+        self._ref[old] -= 1
+        # device-side page copy: the page dim is unsharded on the mesh
+        # pool, so the same functional update works per-shard there
+        self.k_pages = self.k_pages.at[:, :, new].set(
+            self.k_pages[:, :, old])
+        self.v_pages = self.v_pages.at[:, :, new].set(
+            self.v_pages[:, :, old])
+        h.pages[-1] = new
+        self._stats["page_allocs"] += 1
+        self._stats["cow_copies"] += 1
+
+    def corrupt_page(self, page: int) -> None:
+        """Chaos helper (FAULT_SERVE_PREFIX_CORRUPT): poison one page's
+        K content with NaN — flipped exponent bytes surfacing as
+        non-finite activations, the detectable face of silent page
+        corruption.  K only: a NaN key is masked out (jnp.where) for
+        sequences that do not read the page, while any sequence whose
+        valid prefix includes it goes non-finite and quarantines."""
+        with self._lock:
+            self.k_pages = self.k_pages.at[:, :, int(page)].set(
+                float("nan"))
 
     def write_kv(self, layer: int, pages: np.ndarray, slots: np.ndarray,
                  k, v) -> None:
@@ -259,16 +489,39 @@ class KVCachePool:
 
     # -- integrity watchdog ---------------------------------------------
 
-    def check_invariants(self) -> Dict:
-        """Audit page ownership: every page id must appear EXACTLY once
-        across the union of live page tables and the free list.  Returns
-        a report dict — `ok` plus the violating page/sequence ids:
+    def _true_refs(self) -> List[int]:
+        """Ground-truth per-page ownership: table occurrences plus the
+        registered external owners' holds (prefix-cache entries).
+        Callers hold the pool lock."""
+        refs = [0] * self.num_pages
+        for h in self._tables.values():
+            for p in h.pages:
+                if 0 <= p < self.num_pages:
+                    refs[p] += 1
+        for fn in self._owner_hooks:
+            for p, holds in fn().items():
+                if 0 <= int(p) < self.num_pages:
+                    refs[int(p)] += int(holds)
+        return refs
 
-        - orphaned_pages: owned by no table and not free (a leak — the
-          pool shrinks until exhaustion; reclaim_orphans repairs)
-        - double_owned_pages: in two tables, twice in one table, or in
-          a table AND the free list (corruption — two sequences would
+    def check_invariants(self) -> Dict:
+        """Audit page ownership AGAINST REFCOUNTS: every page id must be
+        either free (refcount 0, exactly once on the free list) or live
+        with a refcount equal to its table occurrences plus registered
+        external holds (prefix-cache entries) — a page legitimately
+        shared by N sequences and the cache is N+1-owned and FINE, not
+        "double-owned" corruption.  Returns a report dict — `ok` plus
+        the violating page/sequence ids:
+
+        - orphaned_pages: held by no table and no external owner yet not
+          free (a leak — the pool shrinks until exhaustion;
+          reclaim_orphans repairs)
+        - double_owned_pages: more owners than the refcount covers (two
+          tables claiming an unshared page, a duplicate within one
+          table, or a table AND the free list — two sequences would
           overwrite each other's K/V)
+        - refcount_mismatches: refcount disagrees with the audited
+          ownership in either direction (stale hold or lost hold)
         - free_list_errors: duplicate or out-of-range free entries
         - length_mismatches: sequences whose token count disagrees with
           their page count (length > capacity, or an entire spare page)
@@ -277,14 +530,16 @@ class KVCachePool:
         cheap enough for the continuous-batching loop to run every N
         steps (ContinuousBatchingLoop(check_every=N))."""
         with self._lock:
-            owned: Dict[int, int] = {}
+            true_refs = self._true_refs()
             double: List[int] = []
             mismatches: List[int] = []
+            ref_bad: List[int] = []
             for h in self._tables.values():
+                seen_in_table: set = set()
                 for p in h.pages:
-                    if p in owned:
+                    if p in seen_in_table:
                         double.append(p)
-                    owned[p] = h.seq_id
+                    seen_in_table.add(p)
                 cap = h.capacity(self.page_size)
                 if h.length > cap or cap - h.length >= self.page_size:
                     mismatches.append(h.seq_id)
@@ -293,18 +548,30 @@ class KVCachePool:
             for p in self._free:
                 if p in seen_free or not 0 <= p < self.num_pages:
                     free_errors.append(p)
+                    continue
                 seen_free.add(p)
-                if p in owned:
-                    double.append(p)
-            orphaned = [p for p in range(self.num_pages)
-                        if p not in owned and p not in seen_free]
+                if true_refs[p] > 0:
+                    double.append(p)  # free AND owned: corruption
+            orphaned: List[int] = []
+            for p in range(self.num_pages):
+                if true_refs[p] == 0 and p not in seen_free:
+                    orphaned.append(p)
+                if self._ref[p] != true_refs[p]:
+                    ref_bad.append(p)
+                    if true_refs[p] > self._ref[p]:
+                        # more owners than the refcount covers: a free
+                        # would return a still-referenced page
+                        double.append(p)
             report = {
-                "ok": not (orphaned or double or free_errors or mismatches),
+                "ok": not (orphaned or double or free_errors
+                           or mismatches or ref_bad),
                 "orphaned_pages": orphaned,
                 "double_owned_pages": sorted(set(double)),
+                "refcount_mismatches": sorted(set(ref_bad)),
                 "free_list_errors": free_errors,
                 "length_mismatches": mismatches,
                 "used_pages": self.num_pages - len(self._free),
+                "shared_pages": sum(1 for r in true_refs if r > 1),
                 "live_sequences": len(self._tables),
             }
         if _flags._VALUES["FLAGS_observability"] and not report["ok"]:
@@ -312,17 +579,23 @@ class KVCachePool:
         return report
 
     def reclaim_orphans(self) -> int:
-        """Return every orphaned page (owned by no table, absent from
-        the free list) to the free pool; returns how many were
-        reclaimed.  The repair arm of check_invariants — a detected leak
-        costs pages until this runs, never the pool's integrity (page
-        tables are untouched)."""
+        """Return every orphaned page (no table occurrence, no external
+        hold, absent from the free list) to the free pool and re-true
+        every refcount to the audited ownership; returns how many pages
+        were reclaimed.  The repair arm of check_invariants — a detected
+        leak costs pages until this runs, never the pool's integrity
+        (page tables are untouched), and the repair is refcount-correct:
+        a page still shared by live sequences or the prefix cache is
+        never freed, its refcount is only re-trued."""
         with self._lock:
-            owned = {p for h in self._tables.values() for p in h.pages}
+            true_refs = self._true_refs()
             free = set(self._free)
             orphans = [p for p in range(self.num_pages)
-                       if p not in owned and p not in free]
+                       if true_refs[p] == 0 and p not in free]
             self._free.extend(reversed(orphans))
+            self._ref = true_refs
+            for p in orphans:
+                self._allocator.pop(p, None)
             self._stats["orphans_reclaimed"] += len(orphans)
         if orphans:
             self._note_pool()
@@ -339,10 +612,11 @@ class KVCachePool:
         lets an operator shrink `num_pages` between runs.  Returns the
         number of pages moved."""
         with self._lock:
-            used: List[int] = []
-            for h in self._tables.values():
-                used.extend(h.pages)
-            remap = {old: new for new, old in enumerate(sorted(used))}
+            # live = any page with a refcount (tables AND cache-held
+            # pages move together; a shared page moves ONCE)
+            used = sorted(p for p in range(self.num_pages)
+                          if self._ref[p] > 0)
+            remap = {old: new for new, old in enumerate(used)}
             moves = sum(1 for old, new in remap.items() if old != new)
             if moves:
                 perm = np.arange(self.num_pages, dtype=np.int32)
@@ -354,8 +628,17 @@ class KVCachePool:
                 perm[len(remap):] = leftover
                 self.k_pages = self.k_pages[:, :, perm]
                 self.v_pages = self.v_pages[:, :, perm]
+                new_ref = [0] * self.num_pages
+                for old, new in remap.items():
+                    new_ref[new] = self._ref[old]
+                self._ref = new_ref
+                self._allocator = {remap[p]: s for p, s
+                                   in self._allocator.items()
+                                   if p in remap}
                 for h in self._tables.values():
                     h.pages = [remap[p] for p in h.pages]
+                for fn in self._remap_hooks:
+                    fn(remap)
             self._free = list(range(self.num_pages - 1, len(remap) - 1, -1))
             self._stats["defrag_moves"] += moves
         return moves

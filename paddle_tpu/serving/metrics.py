@@ -34,6 +34,14 @@ Metrics:
 - paddle_tpu_serving_page_pool_utilization  gauge    {pool=} used/total
 - paddle_tpu_serving_sequences_total        counter  {event=admitted|
                                                       retired|quarantined}
+- paddle_tpu_serving_prefix_events_total    counter  {event=hit|miss|
+                                                      insert|evict|
+                                                      invalidate}
+- paddle_tpu_serving_prefix_cached_tokens_total counter prompt tokens
+                                                      served from cached
+                                                      prefix pages
+- paddle_tpu_serving_prefix_cache_pages     gauge    pages pinned by
+                                                      prefix-cache entries
 
 Fault-isolation instruments (ISSUE 6):
 - paddle_tpu_serving_breaker_trips_total    counter  circuit-breaker opens
@@ -72,6 +80,9 @@ __all__ = [
     "record_health",
     "record_pool_invariant_violation",
     "record_pool_reclaim",
+    "record_prefix_cache_pages",
+    "record_prefix_cached_tokens",
+    "record_prefix_event",
     "record_replica_health",
     "record_router_decision",
 ]
@@ -275,6 +286,32 @@ def record_replica_health(replica: str, state: str,
         "paddle_tpu_serving_replica_queue_depth",
         "replica engine queue depth as seen by the router",
     ).set(queue_depth, replica=replica)
+
+
+def record_prefix_event(event: str, n: int = 1) -> None:
+    """Prefix-cache lifecycle counter: ``hit`` / ``miss`` (admission
+    matches), ``insert`` (new trie entries), ``evict`` (LRU pressure
+    releases), ``invalidate`` (poisoned-chain quarantine drops)."""
+    default_registry().counter(
+        "paddle_tpu_serving_prefix_events",
+        "prefix-cache lifecycle events",
+    ).inc(n, event=event)
+
+
+def record_prefix_cached_tokens(tokens: int) -> None:
+    """Prompt tokens served straight from cached K/V pages — prefill
+    compute the shared prefix did NOT cost."""
+    default_registry().counter(
+        "paddle_tpu_serving_prefix_cached_tokens",
+        "prompt tokens served from cached prefix pages (prefill skipped)",
+    ).inc(tokens)
+
+
+def record_prefix_cache_pages(entries: int) -> None:
+    default_registry().gauge(
+        "paddle_tpu_serving_prefix_cache_pages",
+        "KV pages currently pinned by prefix-cache entries",
+    ).set(entries)
 
 
 def record_pool_invariant_violation(pool: str = "kv") -> None:

@@ -1,0 +1,379 @@
+"""Refcounted prefix cache over the paged KV pool: shared-prefix
+prompts reuse cached K/V pages instead of re-prefilling them.
+
+The millions-of-users serving workload is dominated by shared-prefix
+traffic — system prompts, few-shot headers, multi-turn chat histories.
+Without this module an N-way-shared prefix costs N full page-sets in
+KVCachePool and N full prefill passes.  With it:
+
+- **Page-granular rolling-hash trie.**  A prompt is split into
+  page_size-token runs; each run is a trie node keyed by a rolling
+  hash (sha1 of the parent key + this run's tokens) and carrying ONE
+  pool page that holds the run's K/V for every layer.  Matching walks
+  the trie (longest-cached-prefix match), verifying each hop against
+  the literal token run — the hash names the entry, the tokens decide
+  it, so a hash collision can never splice the wrong K/V into a
+  sequence.  The final node of an inserted prompt may be PARTIAL (the
+  prompt tail that doesn't fill a page); partial nodes are leaves.
+- **Attach, don't copy.**  A hit attaches the matched pages READ-ONLY
+  to the new sequence's page table (``KVCachePool.attach_prefix`` —
+  refcount++ per page, zero free-list pressure, zero prefill compute
+  for the matched tokens).  The first divergent append into a shared
+  partially-filled tail page triggers the pool's copy-on-write
+  (kvcache.py), so cached content is immutable by construction.
+- **Refcounted lifetime.**  ``free_seq`` only returns pages whose
+  refcount hits zero; an entry's hold keeps a popular prefix alive
+  across the sequences that used it.  Matching always leaves at least
+  ONE prompt token uncached — the model must still run the final
+  prompt token to produce the first generated token's logits.
+- **LRU eviction under pressure.**  The cache registers as the pool's
+  reclaimer: when an append cannot find enough free pages, cache-only
+  pages (refcount 1 — no live sequence attached) are released leaf-
+  first in least-recently-used order before PagePoolExhausted can
+  fire.  ``max_pages`` optionally caps the cache's footprint the same
+  way at insert time.
+- **Poison containment.**  A quarantined sequence that was served a
+  cached prefix invalidates the matched chain (``quarantine_seq``) —
+  a corrupted cached page (chaos: FAULT_SERVE_PREFIX_CORRUPT) costs
+  the sequences that read it, never the cache's future correctness or
+  the batch-mates that didn't.
+
+Thread-safety: all cache state is guarded by the POOL's lock (an
+RLock) — the pool's pressure reclaimer calls back into the cache from
+inside ``append_tokens``'s critical section, and a single shared lock
+makes that re-entrant instead of an ordering hazard.
+
+Observability rides the established pattern: every instrument call is
+gated on FLAGS_observability at the call site, and eviction/corrupt
+events land in the flight recorder ring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import flags as _flags
+from ..resilience import faultinject as _finject
+from . import metrics as _smetrics
+from .kvcache import KVCachePool
+
+__all__ = ["PrefixCache", "PrefixMatch"]
+
+
+def _chain_key(parent: Optional[str], tokens: Tuple[int, ...]) -> str:
+    """Rolling prompt-prefix hash: the entry's name folds its parent's
+    name with this page's token run."""
+    h = hashlib.sha1()
+    h.update((parent or "").encode())
+    h.update((",".join(str(t) for t in tokens)).encode())
+    return h.hexdigest()[:20]
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Longest-cached-prefix match for one prompt: the trie keys walked,
+    the pool pages they carry (in prompt order), and the number of
+    prompt tokens they cover (page-granular except a partial leaf;
+    always <= len(prompt) - 1)."""
+
+    keys: List[str] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    tokens: int = 0
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: str
+    parent: Optional[str]
+    tokens: Tuple[int, ...]   # this page's literal token run
+    page: int                 # pool page holding the run's K/V
+    last_used: int
+    children: Dict[Tuple[int, ...], str] = dataclasses.field(
+        default_factory=dict)
+
+
+class PrefixCache:
+    """Prefix-to-page trie over one :class:`KVCachePool`.
+
+    Wire it to a pool and hand it to the decode loop::
+
+        pool = KVCachePool(...)
+        cache = PrefixCache(pool)
+        loop = ContinuousBatchingLoop(params, cfg, pool,
+                                      prefix_cache=cache)
+
+    The constructor registers the cache as the pool's pressure
+    reclaimer, external owner (so ``check_invariants`` audits entry
+    holds as legitimate refcounts), and defrag remap listener."""
+
+    def __init__(self, pool: KVCachePool,
+                 max_pages: Optional[int] = None):
+        self.pool = pool
+        self.max_pages = int(max_pages) if max_pages else 0
+        self._lock = pool._lock  # ONE lock: see module docstring
+        self._entries: Dict[str, _Entry] = {}
+        self._roots: Dict[Tuple[int, ...], str] = {}
+        self._seq_keys: Dict[int, List[str]] = {}
+        self._tick = 0
+        self._stats = {
+            "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
+            "cached_tokens_served": 0, "invalidations": 0,
+        }
+        pool.register_reclaimer(self._reclaim)
+        pool.register_owner(self._holds)
+        pool.register_remap_hook(self._remap)
+
+    # -- the admission path --------------------------------------------
+
+    def match(self, prompt: Sequence[int]) -> PrefixMatch:
+        """Longest cached prefix of `prompt`, page by page, verifying
+        every hop against the literal tokens.  Caps the match at
+        len(prompt) - 1 so at least one token still runs through the
+        model (the logits source for the first generated token).
+        Touches matched entries' LRU clocks; counts nothing — stats
+        land at attach/note_miss so a retried admission probe doesn't
+        double-count."""
+        prompt = [int(t) for t in prompt]
+        limit = len(prompt) - 1
+        m = PrefixMatch()
+        with self._lock:
+            children = self._roots
+            pos = 0
+            while pos < limit:
+                best: Optional[_Entry] = None
+                for toks, key in children.items():
+                    if pos + len(toks) > limit:
+                        continue
+                    if tuple(prompt[pos:pos + len(toks)]) != toks:
+                        continue
+                    if best is None or len(toks) > len(best.tokens):
+                        best = self._entries[key]
+                if best is None:
+                    break
+                m.keys.append(best.key)
+                m.pages.append(best.page)
+                pos += len(best.tokens)
+                best.last_used = self._tick
+                self._tick += 1
+                children = best.children
+                if len(best.tokens) < self.pool.page_size:
+                    break  # partial nodes are leaves
+            m.tokens = pos
+        return m
+
+    def attach(self, seq_id: int, m: PrefixMatch) -> int:
+        """Attach a match to a freshly-allocated sequence: the pages
+        join its table read-only (refcount++ each) and the sequence
+        starts at ``m.tokens`` — the prefill then covers only the
+        unshared tail.  Returns the cached token count."""
+        if not m.tokens:
+            self.note_miss()
+            return 0
+        with self._lock:
+            self.pool.attach_prefix(seq_id, m.pages, m.tokens)
+            self._seq_keys[seq_id] = list(m.keys)
+            self._stats["hits"] += 1
+            self._stats["cached_tokens_served"] += m.tokens
+            if _finject.serve_prefix_corrupt():
+                # chaos: a cached page goes bad exactly at reuse time
+                self.pool.corrupt_page(m.pages[0])
+                if _flags._VALUES["FLAGS_observability"]:
+                    from ..observability import flight as _flight
+
+                    _flight.default_flight().record(
+                        "prefix_corrupt", page=m.pages[0], seq_id=seq_id)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_event("hit")
+            _smetrics.record_prefix_cached_tokens(m.tokens)
+            _smetrics.record_prefix_cache_pages(len(self._entries))
+        return m.tokens
+
+    def note_miss(self) -> None:
+        with self._lock:
+            self._stats["misses"] += 1
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_event("miss")
+
+    # -- the retirement/insert path ------------------------------------
+
+    def insert(self, seq_id: int, prompt: Sequence[int]) -> int:
+        """Cache a finished prefill's prompt pages: walk/extend the trie
+        page by page, pinning (refcount++) each NEW entry's pool page.
+        The sequence keeps decoding — its next append into a pinned
+        partial tail page copy-on-writes, leaving the cached content
+        frozen.  Returns the number of entries created."""
+        prompt = [int(t) for t in prompt]
+        ps = self.pool.page_size
+        created = 0
+        with self._lock:
+            pages, length = self.pool.table_snapshot(seq_id)
+            if length < len(prompt):
+                raise ValueError(
+                    f"sequence {seq_id} holds {length} tokens < prompt "
+                    f"{len(prompt)} — insert only after prefill completes")
+            children = self._roots
+            parent: Optional[str] = None
+            pos = idx = 0
+            while pos < len(prompt):
+                n = min(ps, len(prompt) - pos)
+                toks = tuple(prompt[pos:pos + n])
+                key = children.get(toks)
+                if key is not None:
+                    e = self._entries[key]
+                else:
+                    page = pages[idx]
+                    self.pool.retain_pages([page])
+                    key = _chain_key(parent, toks)
+                    e = _Entry(key=key, parent=parent, tokens=toks,
+                               page=page, last_used=self._tick)
+                    self._entries[key] = e
+                    children[toks] = key
+                    created += 1
+                    self._stats["inserts"] += 1
+                e.last_used = self._tick
+                self._tick += 1
+                parent, children = key, e.children
+                pos += n
+                idx += 1
+                if n < ps:
+                    break  # the partial tail is this prompt's leaf
+            if self.max_pages:
+                while len(self._entries) > self.max_pages:
+                    # -1 = nothing evictable; 0 = entry dropped but its
+                    # page stays live (attached elsewhere) — keep going
+                    if self._evict_one(require_free=False) < 0:
+                        break
+        if created and _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_event("insert", created)
+            _smetrics.record_prefix_cache_pages(len(self._entries))
+        return created
+
+    # -- eviction / invalidation ---------------------------------------
+
+    def _evict_one(self, require_free: bool) -> int:
+        """Evict the least-recently-used leaf entry; with require_free,
+        only entries whose page the cache alone holds (refcount 1 —
+        releasing it actually frees a page).  Returns pages freed (0
+        also when an entry was dropped but its page stays live).
+        Caller holds the lock."""
+        best: Optional[_Entry] = None
+        for e in self._entries.values():
+            if e.children:
+                continue
+            if require_free and self.pool._ref[e.page] != 1:
+                continue
+            if best is None or e.last_used < best.last_used:
+                best = e
+        if best is None:
+            return -1  # nothing evictable
+        self._drop_entry(best)
+        freed = self.pool.release_pages([best.page])
+        self._stats["evictions"] += 1
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_event("evict")
+            from ..observability import flight as _flight
+
+            _flight.default_flight().record(
+                "prefix_evict", page=best.page,
+                pool=self.pool.name, freed=freed)
+        return freed
+
+    def _drop_entry(self, e: _Entry) -> None:
+        self._entries.pop(e.key, None)
+        siblings = (self._entries[e.parent].children
+                    if e.parent in self._entries else self._roots)
+        if siblings.get(e.tokens) == e.key:
+            siblings.pop(e.tokens, None)
+
+    def _reclaim(self, short: int) -> int:
+        """Pool pressure hook: release LRU cache-only pages until
+        `short` pages came free or nothing evictable remains.  Runs
+        under the pool lock (same RLock — re-entrant)."""
+        freed = 0
+        while freed < short:
+            got = self._evict_one(require_free=True)
+            if got < 0:
+                break
+            freed += got
+        if freed and _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_cache_pages(len(self._entries))
+        return freed
+
+    def _invalidate_tree(self, key: str) -> int:
+        e = self._entries.get(key)
+        if e is None:
+            return 0
+        n = 0
+        for ck in list(e.children.values()):
+            n += self._invalidate_tree(ck)
+        self._drop_entry(e)
+        # scrub on free: the chain is being dropped on poison suspicion
+        self.pool.release_pages([e.page], scrub=True)
+        self._stats["invalidations"] += 1
+        return n + 1
+
+    def quarantine_seq(self, seq_id: int) -> int:
+        """A sequence served from this cache went non-finite: presume
+        the matched chain poisoned and invalidate it (with every
+        descendant) so the corruption cannot be served again.  Pages
+        still attached to live sequences stay alive via their table
+        refcounts; only the cache's holds drop.  Returns entries
+        invalidated."""
+        with self._lock:
+            keys = self._seq_keys.pop(seq_id, [])
+            n = self._invalidate_tree(keys[0]) if keys else 0
+        if n and _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_prefix_event("invalidate", n)
+            _smetrics.record_prefix_cache_pages(len(self._entries))
+        return n
+
+    def forget_seq(self, seq_id: int) -> None:
+        """Drop the seq -> matched-chain bookkeeping at retirement."""
+        with self._lock:
+            self._seq_keys.pop(seq_id, None)
+
+    def clear(self) -> int:
+        """Release every entry (the leak-audit epilogue: after clear(),
+        a healthy run's pool must be fully free again)."""
+        with self._lock:
+            n = 0
+            for key in list(self._roots.values()):
+                n += self._invalidate_tree(key)
+            self._seq_keys.clear()
+        return n
+
+    # -- pool integration ----------------------------------------------
+
+    def _holds(self) -> Dict[int, int]:
+        """External-owner hook for KVCachePool.check_invariants: one
+        refcount hold per entry page."""
+        holds: Dict[int, int] = {}
+        for e in self._entries.values():
+            holds[e.page] = holds.get(e.page, 0) + 1
+        return holds
+
+    def _remap(self, remap: Dict[int, int]) -> None:
+        for e in self._entries.values():
+            e.page = remap.get(e.page, e.page)
+
+    def locked_pages(self) -> int:
+        """Distinct cached ENTRY pages currently attached to >= 1 live
+        sequence (refcount > 1) — introspection/stats.  Admission uses
+        the pool's own ``uncharged_live_pages()`` instead: this count
+        goes blind when an entry is dropped (capacity cap, quarantine
+        invalidation) while its page stays attached."""
+        with self._lock:
+            return sum(1 for e in self._entries.values()
+                       if self.pool._ref[e.page] > 1)
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats,
+                        entries=len(self._entries),
+                        locked_pages=sum(
+                            1 for e in self._entries.values()
+                            if self.pool._ref[e.page] > 1))

@@ -152,6 +152,53 @@ def test_sharded_decode_quarantine_keeps_pool_leak_free(host_devices):
     assert pool.check_invariants()["ok"]
 
 
+@pytest.mark.parametrize("prefill", ["batched", "token"])
+def test_sharded_prefix_cache_cow_token_identical(host_devices, prefill):
+    """ISSUE 11 (mesh CoW): overlapping shared-prefix sequences through
+    the ShardedKVCachePool — the host-global page tables mean the
+    prefix cache's refcount/CoW bookkeeping lands once and works on
+    the mesh unchanged.  Both prefill arms stay token-identical to the
+    full_decode oracle, with zero leaked pages and refcount invariants
+    green (and the per-shard device view intact after CoW copies)."""
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg()
+    params = serving.init_decode_params(cfg, seed=7)
+    rng = np.random.RandomState(7)
+    # 14 shared tokens, NON-page-aligned (page_size 4): hits attach
+    # mid-page and the first divergent append copy-on-writes the
+    # shared tail page on the sharded arrays
+    shared = rng.randint(1, cfg.vocab_size, size=14).tolist()
+    prompts = [shared + rng.randint(1, cfg.vocab_size, size=3).tolist()
+               for _ in range(4)]
+    oracles = [serving.full_decode(params, cfg, p, 6)[0]
+               for p in prompts]
+
+    prog = ShardedDecodeProgram(params, cfg, devices=devs)
+    pool = prog.make_pool(num_pages=64, page_size=4)
+    cache = serving.PrefixCache(pool)
+    loop = ContinuousBatchingLoop(None, None, pool, max_batch=2,
+                                  prefill=prefill, program=prog,
+                                  prefix_cache=cache)
+    got = loop.run([DecodeRequest(prompt=list(p), max_new_tokens=6)
+                    for p in prompts])
+    for want, g in zip(oracles, got):
+        assert g.error is None
+        assert g.tokens == want  # token-identical to the oracle
+    # sharing + CoW actually happened on the mesh pool
+    assert loop.prefix_hits >= 1
+    assert loop.cached_prefill_tokens > 0
+    assert pool.stats()["cow_copies"] >= 1
+    # refcount invariants green; per-shard view intact after CoW
+    assert pool.check_invariants()["ok"]
+    shards = pool.k_pages.addressable_shards
+    assert len(shards) == N_SHARDS
+    assert shards[0].data.shape[1] == cfg.n_head // N_SHARDS
+    # zero leaked pages once the cache releases its holds
+    cache.clear()
+    assert pool.stats()["used_pages"] == 0
+    assert pool.check_invariants()["ok"]
+
+
 # ---------------------------------------------------------------------------
 # (b) the per-shard pool view
 

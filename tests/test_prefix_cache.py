@@ -1,0 +1,492 @@
+"""Prefix-cache subsystem (ISSUE 11): refcounted copy-on-write KV
+pages + chunked prefill scheduling.
+
+Acceptance pinned here:
+(a) 8 sequences sharing a ~90%-length prefix: the pool allocates ONE
+    page-set for the shared region (~1/8 of the unshared run's), the
+    prefill model-steps charge only the unshared tails, and every
+    generated sequence is token-identical to the ``full_decode`` oracle
+    on BOTH prefill arms and BOTH paged impls (reference + interpret),
+    with zero leaked pages after the cache releases its holds;
+(b) a shared partially-filled tail page copy-on-writes on the first
+    divergent append — the cached content stays frozen while the
+    writer gets a private copy;
+(c) refcount invariants (satellite): a refcounted shared page is NOT
+    "double-owned" corruption, a forged share without a refcount IS,
+    and orphan repair is refcount-correct (shared pages never freed);
+(d) LRU eviction under pool pressure keeps admission alive with a
+    cache bigger than the pool's spare capacity;
+(e) chunked prefill: no engine step processes more prefill tokens than
+    FLAGS_serving_prefill_chunk (counter-asserted) and decode steps
+    interleave between a long prompt's chunks (a short sequence
+    finishes generating BEFORE the long prompt's first token);
+(f) serve_bench --prefix-share banks prefix_hit_rate /
+    cached_prefill_tokens / TTFT through the 0/2/3 gate contract.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.serving import (
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    KVCachePool,
+    PrefixCache,
+    full_decode,
+    full_forward,
+    init_decode_params,
+)
+from paddle_tpu.serving.generate import chunk_prefill_step
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=61, d_model=16, n_head=2, n_layer=2,
+                d_inner=32, max_length=64)
+    base.update(kw)
+    return DecodeConfig(**base)
+
+
+def _pool(cfg, num_pages=64, page_size=4):
+    return KVCachePool(num_pages=num_pages, page_size=page_size,
+                       num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                       head_dim=cfg.head_dim)
+
+
+# -- (a) the headline acceptance: 8-way shared prefix -------------------
+
+@pytest.mark.parametrize("prefill", ["batched", "token"])
+@pytest.mark.parametrize("impl", ["reference", "interpret"])
+def test_eight_way_shared_prefix_acceptance(prefill, impl):
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=5)
+    rng = np.random.RandomState(5)
+    ps, max_new = 4, 4
+    # 18 shared tokens of a 20-token prompt: 90% shared
+    shared = rng.randint(1, cfg.vocab_size, size=18).tolist()
+    prompts = [shared + rng.randint(1, cfg.vocab_size, size=2).tolist()
+               for _ in range(8)]
+    oracles = [full_decode(params, cfg, p, max_new)[0] for p in prompts]
+
+    def run(with_cache):
+        pool = _pool(cfg, num_pages=96, page_size=ps)
+        cache = PrefixCache(pool) if with_cache else None
+        # max_batch=1: admissions are strictly staggered, so every
+        # sequence after the first sees a warm cache
+        loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                      prefill=prefill, paged_impl=impl,
+                                      prefix_cache=cache)
+        results = loop.run([DecodeRequest(list(p), max_new)
+                            for p in prompts])
+        return pool, cache, loop, results
+
+    pool_nc, _, loop_nc, res_nc = run(False)
+    pool_c, cache, loop_c, res_c = run(True)
+
+    # token-identical to the full-recompute oracle on both arms/impls
+    for res in (res_nc, res_c):
+        for r, want in zip(res, oracles):
+            assert r.error is None
+            assert r.tokens == want
+
+    # the shared region costs ONE page-set: 7 of the 8 sequences
+    # attach the 4 shared full pages instead of allocating them
+    shared_full_pages = (18 // ps)  # 4
+    assert loop_c.prefix_hits == 7 and loop_c.prefix_misses == 1
+    assert loop_c.cached_prefill_tokens == 7 * shared_full_pages * ps
+    saved = pool_nc.stats()["page_allocs"] - pool_c.stats()["page_allocs"]
+    # each hit saved its shared pages, minus at most one CoW copy each
+    assert saved >= 7 * (shared_full_pages - 1)
+    # prefill model-steps charge only the unshared tails
+    total_prompt = sum(len(p) for p in prompts)
+    assert loop_nc.prefill_tokens == total_prompt
+    assert loop_c.prefill_tokens == \
+        total_prompt - loop_c.cached_prefill_tokens
+
+    # zero leaked pages: the cache's holds are the ONLY pages left,
+    # and releasing them returns the pool to fully free
+    assert pool_c.check_invariants()["ok"]
+    assert pool_nc.used_pages == 0
+    cache.clear()
+    assert pool_c.used_pages == 0
+    assert pool_c.check_invariants()["ok"]
+
+
+# -- (b) copy-on-write of the shared partial tail -----------------------
+
+def test_partial_tail_cow_preserves_cached_content():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=11)
+    rng = np.random.RandomState(11)
+    # 18 tokens at page_size 8: 2 full pages + a 2-token partial tail
+    shared = rng.randint(1, cfg.vocab_size, size=18).tolist()
+    pA = list(shared)                                     # insert arm
+    pB = shared + rng.randint(1, cfg.vocab_size, size=5).tolist()
+    pC = shared + rng.randint(1, cfg.vocab_size, size=2).tolist()
+    pool = _pool(cfg, num_pages=40, page_size=8)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                  prefix_cache=cache)
+    res = loop.run([DecodeRequest(p, 4) for p in (pA, pB, pC)])
+    for p, r in zip((pA, pB, pC), res):
+        want, _ = full_decode(params, cfg, p, 4)
+        assert r.error is None and r.tokens == want
+    # B and C matched INTO the partial page (18 tokens, mid-page) and
+    # their first divergent append copy-on-wrote it — plus A itself
+    # CoW'd its pinned tail when decoding past the prompt
+    assert loop.cached_prefill_tokens == 2 * 18
+    assert pool.stats()["cow_copies"] >= 3
+    assert pool.check_invariants()["ok"]
+    cache.clear()
+    assert pool.used_pages == 0
+
+
+def test_cow_accounting_is_atomic_under_exhaustion():
+    """A claim whose CoW page cannot be satisfied must raise BEFORE any
+    table mutates (the append_tokens atomicity contract extends to the
+    copy-on-write page)."""
+    from paddle_tpu.serving import PagePoolExhausted
+
+    pool = KVCachePool(num_pages=2, page_size=4, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.append_tokens([0], [2])  # page 0: 2 of 4 slots used
+    pool.allocate(1)
+    pool.append_tokens([1], [4])  # page 1: full — pool exhausted
+    # share 0's partial tail with a cache-style hold (registered as an
+    # external owner so the audit can explain the refcount)
+    held = pool.table_snapshot(0)[0][0]
+    pool.retain_pages([held])
+    pool.register_owner(lambda: {held: 1})
+    with pytest.raises(PagePoolExhausted):
+        pool.append_token([0])  # CoW needs a page; none free
+    assert pool.length(0) == 2  # nothing advanced
+    assert pool.check_invariants()["ok"]
+
+
+# -- (c) refcount invariants (satellite) --------------------------------
+
+def test_refcounted_share_is_not_double_owned():
+    pool = KVCachePool(num_pages=8, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.append_tokens([0], [4])  # 2 full pages
+    pages, _ = pool.table_snapshot(0)
+    # a legitimate refcounted share: attach both pages to sequence 1
+    pool.allocate(1)
+    pool.attach_prefix(1, pages, 3)
+    rep = pool.check_invariants()
+    assert rep["ok"], rep
+    assert rep["shared_pages"] == 2
+    assert rep["double_owned_pages"] == []
+    # retiring one owner keeps the pages live for the other
+    assert pool.free_seq(0) == 0
+    assert pool.free_seq(1) == 2
+    assert pool.free_pages == pool.num_pages
+
+
+def test_forged_share_without_refcount_still_flagged():
+    pool = KVCachePool(num_pages=8, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.allocate(1)
+    pool.append_token([0])
+    pool.append_token([1])
+    shared = pool._tables[0].pages[0]
+    pool._tables[1].pages.append(shared)  # corruption: no refcount
+    rep = pool.check_invariants()
+    assert not rep["ok"]
+    assert shared in rep["double_owned_pages"]
+    assert shared in rep["refcount_mismatches"]
+
+
+def test_orphan_repair_is_refcount_correct():
+    pool = KVCachePool(num_pages=8, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.append_tokens([0], [4])
+    pages, _ = pool.table_snapshot(0)
+    pool.retain_pages(pages)  # cache-style hold on both pages...
+    holds = {p: 1 for p in pages}
+    pool.register_owner(lambda: holds)  # ...as a REGISTERED owner
+    leaked = pool._free.pop()  # a genuine orphan
+    rep = pool.check_invariants()
+    assert not rep["ok"] and rep["orphaned_pages"] == [leaked]
+    assert pool.reclaim_orphans() == 1  # repairs ONLY the orphan
+    rep = pool.check_invariants()
+    assert rep["ok"], rep
+    # the shared pages kept their holds: freeing the sequence alone
+    # does not release them
+    assert pool.free_seq(0) == 0
+    holds.clear()  # the "cache" lets go
+    assert pool.release_pages(pages) == 2
+    assert pool.free_pages == pool.num_pages
+
+
+def test_defrag_remaps_cached_pages_and_refcounts():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=13)
+    rng = np.random.RandomState(13)
+    shared = rng.randint(1, cfg.vocab_size, size=12).tolist()
+    pool = _pool(cfg, num_pages=32, page_size=4)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                  prefix_cache=cache)
+    # a placeholder sequence pins the LOW page ids first, so the warm
+    # run's cached pages land higher; freeing it leaves a hole defrag
+    # must close by MOVING the cached pages down
+    pool.allocate(1000)
+    pool.append_tokens([1000], [8])
+    warm = shared + rng.randint(1, cfg.vocab_size, size=2).tolist()
+    loop.run([DecodeRequest(warm, 2)])
+    assert cache.stats()["entries"] > 0
+    pool.free_seq(1000)
+    moves = pool.defrag()
+    assert moves > 0  # cached pages moved into the hole
+    assert pool.check_invariants()["ok"]
+    # the cache followed the remap: a hit through the compacted pages
+    # still decodes token-identically
+    probe = shared + rng.randint(1, cfg.vocab_size, size=3).tolist()
+    res = loop.run([DecodeRequest(probe, 3)])
+    want, _ = full_decode(params, cfg, probe, 3)
+    assert res[0].tokens == want
+    assert loop.prefix_hits == 1
+    cache.clear()
+    assert pool.used_pages == 0
+
+
+def test_uncharged_live_pages_survives_entry_drop():
+    """Admission's set-aside bound comes from the POOL's allocator map,
+    not cache entries: a page attached to a live reader stays counted
+    after its charging sequence retires — even if every cache entry
+    naming it is dropped (capacity cap / quarantine invalidation),
+    which would blind an entry-based count and over-commit the pool."""
+    pool = KVCachePool(num_pages=8, page_size=2, num_layers=1,
+                       num_heads=1, head_dim=4)
+    pool.allocate(0)
+    pool.append_tokens([0], [4])  # 2 pages, charged by seq 0
+    pages, _ = pool.table_snapshot(0)
+    pool.allocate(1)
+    pool.attach_prefix(1, pages, 3)  # reader, charged only its tail
+    assert pool.uncharged_live_pages() == 0  # allocator still live
+    assert pool.free_seq(0) == 0  # pages live on under the reader...
+    assert pool.uncharged_live_pages() == 2  # ...now uncharged
+    assert pool.free_seq(1) == 2
+    assert pool.uncharged_live_pages() == 0
+
+
+# -- (d) LRU eviction under pressure ------------------------------------
+
+def test_lru_eviction_keeps_admission_alive():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=17)
+    rng = np.random.RandomState(17)
+    # pool far too small to cache every distinct prompt: eviction must
+    # shed cold entries so fresh admissions keep fitting
+    pool = _pool(cfg, num_pages=8, page_size=8)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                  prefix_cache=cache)
+    reqs = [DecodeRequest(
+        rng.randint(1, cfg.vocab_size, size=20).tolist(), 4)
+        for _ in range(5)]
+    res = loop.run(reqs)
+    for q, r in zip(reqs, res):
+        want, _ = full_decode(params, cfg, list(q.prompt), 4)
+        assert r.error is None and r.tokens == want
+    assert cache.stats()["evictions"] > 0
+    assert pool.check_invariants()["ok"]
+    cache.clear()
+    assert pool.used_pages == 0
+
+
+def test_max_pages_caps_cache_footprint():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=19)
+    rng = np.random.RandomState(19)
+    pool = _pool(cfg, num_pages=64, page_size=4)
+    cache = PrefixCache(pool, max_pages=4)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                  prefix_cache=cache)
+    reqs = [DecodeRequest(
+        rng.randint(1, cfg.vocab_size, size=14).tolist(), 2)
+        for _ in range(4)]
+    loop.run(reqs)
+    assert cache.stats()["entries"] <= 4
+    assert pool.check_invariants()["ok"]
+
+
+# -- (e) chunked prefill ------------------------------------------------
+
+def test_chunk_prefill_step_matches_full_forward():
+    """Splitting a prompt into arbitrary chunks through
+    chunk_prefill_step reproduces full_forward's last-row logits and
+    the same cached K/V a whole-prompt prefill writes."""
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=23)
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(1, cfg.vocab_size, size=13).tolist()
+    pool = _pool(cfg, num_pages=16, page_size=4)
+    pool.allocate(0)
+    logits = None
+    for lo, hi in ((0, 5), (5, 6), (6, 13)):
+        logits = chunk_prefill_step(params, cfg, pool, [0],
+                                    [prompt[lo:hi]], [lo])
+    want = full_forward(params, cfg, prompt)[-1]
+    np.testing.assert_allclose(logits[0], want, rtol=1e-4, atol=1e-4)
+    assert pool.length(0) == len(prompt)
+
+
+def test_chunk_cap_counter_asserted_and_decode_interleaves():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=9)
+    rng = np.random.RandomState(9)
+    p_long = rng.randint(1, cfg.vocab_size, size=40).tolist()
+    p_short = rng.randint(1, cfg.vocab_size, size=4).tolist()
+    cap = 8
+
+    pool = _pool(cfg, num_pages=48, page_size=8)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=2,
+                                  prefill_chunk=cap)
+    # the short sequence needs fewer decode steps (3) than the long
+    # prompt needs chunk steps (>= 5), so under alternation it must
+    # finish generating strictly before the long prompt's first token
+    res_short, res_long = loop.run([
+        DecodeRequest(p_short, 3), DecodeRequest(p_long, 4)])
+    for p, r in zip((p_short, p_long), (res_short, res_long)):
+        want, _ = full_decode(params, cfg, p, len(r.tokens))
+        assert r.tokens == want
+    # no engine step processed more prefill tokens than the cap
+    assert 0 < loop.max_prefill_tokens_step <= cap
+    # the long prompt took multiple chunk steps...
+    assert loop.prefill_steps >= 3
+    # ...and decode steps interleaved between them: the short sequence
+    # finished ALL its tokens before the long prompt's first token
+    assert res_long.ttft_s is not None
+    long_first_token_at = res_long.admitted_at + res_long.ttft_s
+    assert res_short.finished_at < long_first_token_at
+    assert pool.used_pages == 0
+
+
+def test_chunk_flag_default_and_validation():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=1)
+    pool = _pool(cfg)
+    fluid.set_flags({"FLAGS_serving_prefill_chunk": 6})
+    try:
+        loop = ContinuousBatchingLoop(params, cfg, pool)
+        assert loop._prefill_chunk == 6
+    finally:
+        fluid.set_flags({"FLAGS_serving_prefill_chunk": 0})
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatchingLoop(params, cfg, pool, prefill_chunk=-1)
+    other = _pool(cfg)
+    with pytest.raises(ValueError, match="different pool"):
+        ContinuousBatchingLoop(params, cfg, pool,
+                               prefix_cache=PrefixCache(other))
+
+
+def test_token_arm_respects_chunk_cap():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=29)
+    rng = np.random.RandomState(29)
+    prompts = [rng.randint(1, cfg.vocab_size, size=10).tolist()
+               for _ in range(3)]
+    pool = _pool(cfg, num_pages=64, page_size=4)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3,
+                                  prefill="token", prefill_chunk=2)
+    res = loop.run([DecodeRequest(p, 3) for p in prompts])
+    for p, r in zip(prompts, res):
+        want, _ = full_decode(params, cfg, p, 3)
+        assert r.tokens == want
+    assert 0 < loop.max_prefill_tokens_step <= 2
+    assert pool.used_pages == 0
+
+
+# -- observability ------------------------------------------------------
+
+def test_prefix_metrics_and_flight_events_emitted():
+    from paddle_tpu import observability as obs
+
+    obs.reset()
+    fluid.set_flags({"FLAGS_observability": True})
+    try:
+        cfg = _cfg()
+        params = init_decode_params(cfg, seed=31)
+        rng = np.random.RandomState(31)
+        shared = rng.randint(1, cfg.vocab_size, size=12).tolist()
+        pool = _pool(cfg, num_pages=48, page_size=4)
+        cache = PrefixCache(pool)
+        loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                      prefix_cache=cache)
+        prompts = [shared + rng.randint(1, cfg.vocab_size,
+                                        size=2).tolist()
+                   for _ in range(2)]
+        loop.run([DecodeRequest(p, 2) for p in prompts])
+        snap = obs.default_registry().snapshot()["metrics"]
+        by_name = {m["name"]: m for m in snap}
+        events = by_name["paddle_tpu_serving_prefix_events"]["series"]
+        got = {s["labels"]["event"] for s in events}
+        assert {"hit", "miss", "insert"} <= got
+        assert "paddle_tpu_serving_prefix_cached_tokens" in by_name
+        assert "paddle_tpu_serving_prefix_cache_pages" in by_name
+        evs = obs.default_flight().events()
+        assert any(e["kind"] == "prefix_hit" for e in evs)
+    finally:
+        fluid.set_flags({"FLAGS_observability": False})
+        obs.reset()
+
+
+# -- (f) serve_bench wiring ---------------------------------------------
+
+def test_serve_bench_prefix_share_banks_and_gates(tmp_path, capsys):
+    import json
+
+    from tools.serve_bench import main as bench_main
+
+    out = tmp_path / "out.json"
+    argv = [
+        "--mode", "decode", "--sequences", "6", "--max-new", "4",
+        "--prefix-share", "0.9", "--prefill-chunk", "8",
+        "--max-batch", "2", "--pages", "64", "--page-size", "8",
+        "--d-model", "16", "--vocab", "61", "--max-len", "64",
+    ]
+    rc = bench_main(argv + ["--json", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    result = json.loads(out.read_text())
+    assert result["pages_leaked"] == 0
+    assert result["prefix_hit_rate"] > 0
+    assert result["cached_prefill_tokens"] > 0
+    assert result["max_prefill_tokens_step"] <= 8
+    assert result["ttft_p99_ms"] is not None
+    # bank this run's capacity numbers + a generous TTFT ceiling and
+    # re-gate: the 0/2/3 contract holds them (TTFT tolerance is wide —
+    # CI wall clocks are noisy; the HIT-RATE floor is the sharp edge)
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps({
+        "prefix_hit_rate": result["prefix_hit_rate"],
+        "cached_prefill_tokens": result["cached_prefill_tokens"],
+        "max_prefill_tokens_step": 8,
+        "pages_leaked": 0,
+        "ttft_p99_ms": result["ttft_p99_ms"] * 50,
+    }))
+    rc = bench_main(argv + ["--baseline", str(bank), "--gate"])
+    capsys.readouterr()
+    assert rc == 0
+    # an impossible hit-rate baseline fails the gate with exit 3
+    bank.write_text(json.dumps({"prefix_hit_rate": 1000.0}))
+    rc = bench_main(argv + ["--baseline", str(bank), "--gate"])
+    capsys.readouterr()
+    assert rc == 3
+
+
+def test_serve_bench_prefix_usage_errors(capsys):
+    from tools.serve_bench import main as bench_main
+
+    assert bench_main(["--prefix-share", "0.5"]) == 2  # needs decode
+    assert bench_main(["--mode", "decode",
+                       "--prefix-share", "1.5"]) == 2  # out of range
+    assert bench_main(["--prefill-chunk", "4"]) == 2   # needs decode
+    capsys.readouterr()

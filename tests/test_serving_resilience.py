@@ -59,7 +59,8 @@ def _disarm_faults():
     faultinject.reset()
     yield
     for k in ("FAULT_SERVE_DISPATCH_RAISE", "FAULT_SERVE_NAN_SEQ",
-              "FAULT_SERVE_LEAK_PAGES", "FAULT_SERVE_SLOW_STEP_MS"):
+              "FAULT_SERVE_LEAK_PAGES", "FAULT_SERVE_SLOW_STEP_MS",
+              "FAULT_SERVE_PREFIX_CORRUPT"):
         os.environ.pop(k, None)
     faultinject.reset()
 
@@ -369,6 +370,56 @@ def test_nan_seq_quarantine_evicts_one_survivors_match_oracle():
     # the evicted sequence's pages returned to the pool
     assert pool.free_pages == pool.num_pages
     assert pool.check_invariants()["ok"]
+
+
+def test_prefix_corrupt_quarantined_evicted_batchmates_survive():
+    """FAULT_SERVE_PREFIX_CORRUPT (ISSUE 11): a cached prefix page goes
+    bad at reuse — the sequence served the poisoned prefix quarantines
+    (NonFiniteSequenceError), the poisoned chain is INVALIDATED so it
+    can never be served again, batch-mates decode on oracle-identical,
+    and a later same-prefix request re-prefills clean."""
+    from paddle_tpu.serving import PrefixCache
+
+    cfg = DecodeConfig(vocab_size=41, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=48)
+    params = init_decode_params(cfg, seed=21)
+    rng = np.random.RandomState(21)
+    shared = rng.randint(1, cfg.vocab_size, size=12).tolist()
+    owner = shared + rng.randint(1, cfg.vocab_size, size=2).tolist()
+    victim = shared + rng.randint(1, cfg.vocab_size, size=3).tolist()
+    # bystander: 5 prompt + 3 new = exactly 2 pages, all claimed at its
+    # prefill — it never allocates after the quarantine frees pages
+    bystander = rng.randint(1, cfg.vocab_size, size=5).tolist()
+    pool = KVCachePool(num_pages=48, page_size=4, num_layers=cfg.n_layer,
+                       num_heads=cfg.n_head, head_dim=cfg.head_dim)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=2,
+                                  prefix_cache=cache, check_every=1)
+    # warm the cache
+    r0 = loop.run([DecodeRequest(owner, 3)])
+    assert r0[0].error is None
+    # arm: the victim's attach poisons the first matched page
+    os.environ["FAULT_SERVE_PREFIX_CORRUPT"] = "1"
+    res = loop.run([DecodeRequest(victim, 3),
+                    DecodeRequest(bystander, 3)])
+    assert loop.quarantined == 1
+    assert isinstance(res[0].error, NonFiniteSequenceError)
+    want_b, _ = full_decode(params, cfg, bystander, 3)
+    assert res[1].error is None and res[1].tokens == want_b
+    # the poisoned chain was evicted from the cache...
+    assert cache.stats()["invalidations"] >= 1
+    # ...so a fresh same-prefix request MISSES and re-prefills clean,
+    # matching the oracle (the corruption is gone, not resident)
+    hits_before = loop.prefix_hits
+    res3 = loop.run([DecodeRequest(list(victim), 3)])
+    assert loop.prefix_hits == hits_before
+    want_v, _ = full_decode(params, cfg, victim, 3)
+    assert res3[0].error is None and res3[0].tokens == want_v
+    # zero leaked pages, refcount invariants green
+    cache.clear()
+    assert pool.used_pages == 0
+    assert pool.check_invariants()["ok"]
+    assert loop.invariant_violations == 0
 
 
 def test_nan_at_prefill_quarantines_only_offender():

@@ -35,6 +35,9 @@ MODULES = [
     # mesh-sharded serving (ISSUE 10): the tensor-parallel decode
     # program, head-sharded pool, and replica router are serving API
     "paddle_tpu.serving.distributed",
+    # prefix cache (ISSUE 11): refcounted CoW page sharing over the
+    # KV pool — operators wire PrefixCache to pools/loops directly
+    "paddle_tpu.serving.prefixcache",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

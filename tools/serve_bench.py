@@ -20,7 +20,15 @@ a batching server — latency percentiles, throughput, and batch occupancy
   path (default: FLAGS_serving_paged_impl, i.e. auto) and --prefill
   {batched,token} picks the prefill arm; both land in the result dict,
   so a reference-vs-pallas A/B rides the --baseline/--gate machinery
-  like any other regression check.
+  like any other regression check.  --prefix-share P gives fraction P
+  of the requests one common system-prompt prefix and enables the
+  refcounted prefix cache (serving/prefixcache.py): the report gains
+  prefix_hit_rate, cached_prefill_tokens, cow_copies, and TTFT
+  p50/p99 still bank through the same 0/2/3 gate contract —
+  shared-prefix capacity regressions fail CI like latency ones.
+  --prefill-chunk N caps prefill tokens per engine step (chunked
+  prefill); max_prefill_tokens_step in the report counter-asserts the
+  cap, so banking it holds the TTFT-jitter discipline.
 
   router mode (--replicas N, engine-mode option): N Engine replicas of
   the same artifact behind one distributed.Router; the Poisson replay
@@ -394,20 +402,36 @@ def run_decode_bench(args) -> dict:
             head_dim=cfg.head_dim)
     plo, phi = (int(p) for p in args.prompt_range.split(","))
     phi = min(phi, args.max_len - args.max_new)
+    # --prefix-share P: that fraction of requests opens with one common
+    # system-prompt prefix (~3/4 of the max prompt length) — the
+    # shared-prefix traffic shape the prefix cache exists for.  The
+    # first such request warms the cache; the rest should hit.
+    share = float(args.prefix_share)
+    sys_prompt = rng.randint(
+        1, cfg.vocab_size,
+        size=max(1, int(phi * 0.75))).tolist() if share > 0 else []
     reqs = []
     for _ in range(args.sequences):
-        plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
+        if share > 0 and rng.rand() < share:
+            tail = int(rng.randint(1, max(2, phi - len(sys_prompt) + 1)))
+            prompt = sys_prompt + rng.randint(
+                1, cfg.vocab_size, size=tail).tolist()
+        else:
+            plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
+            prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
         reqs.append(serving.DecodeRequest(
-            prompt=rng.randint(1, cfg.vocab_size, size=plen).tolist(),
-            max_new_tokens=args.max_new))
+            prompt=prompt, max_new_tokens=args.max_new))
     chaos = bool(args.chaos)
     from paddle_tpu.kernels.paged_attention import fallback_count
 
     fallbacks_before = fallback_count()
+    cache = (serving.PrefixCache(pool)
+             if (share > 0 or args.prefix_cache) else None)
     loop = serving.ContinuousBatchingLoop(
         params, cfg, pool, max_batch=args.max_batch,
         paged_impl=args.paged_impl, prefill=args.prefill,
-        check_every=1 if chaos else 0, program=program)
+        check_every=1 if chaos else 0, program=program,
+        prefix_cache=cache, prefill_chunk=args.prefill_chunk)
     if chaos:
         from paddle_tpu.resilience import faultinject  # noqa: F401
 
@@ -429,12 +453,17 @@ def run_decode_bench(args) -> dict:
     elapsed = time.perf_counter() - t0
     tokens = sum(len(r.tokens) for r in results)
     ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    if cache is not None:
+        # release the cache's page holds BEFORE the leak audit: pinned
+        # prefix pages are a feature, pages nobody owns are a leak
+        cache.clear()
     st = pool.stats()
     result = {
         "mode": "decode",
         "mesh": args.mesh,
         "paged_impl": loop.paged_impl,  # the impl that actually ran
         "prefill": loop.prefill,
+        "prefill_chunk": args.prefill_chunk,
         "sequences": args.sequences,
         "steps": loop.steps,
         "prefill_steps": loop.prefill_steps,
@@ -451,7 +480,19 @@ def run_decode_bench(args) -> dict:
         # geometry drifting out of the Mosaic envelope fails the gate
         # instead of silently running the reference gather
         "paged_fallbacks": fallback_count() - fallbacks_before,
+        # chunked-prefill contract: no engine step processed more
+        # prefill tokens than the cap (bank the cap, gate holds it)
+        "prefill_tokens": loop.prefill_tokens,
+        "max_prefill_tokens_step": loop.max_prefill_tokens_step,
     }
+    if cache is not None:
+        result.update({
+            "prefix_share": share,
+            "prefix_hit_rate": loop.prefix_hits / float(args.sequences),
+            "cached_prefill_tokens": loop.cached_prefill_tokens,
+            "prefix_evictions": cache.stats()["evictions"],
+            "cow_copies": st["cow_copies"],
+        })
     if chaos:
         result.update({
             "quarantined": loop.quarantined,
@@ -467,7 +508,8 @@ def run_decode_bench(args) -> dict:
 # black-box artifact behind
 _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "recovered", "invariants_ok", "flight_dumps",
-                     "drain_completed")
+                     "drain_completed", "prefix_hit_rate",
+                     "cached_prefill_tokens")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -535,6 +577,19 @@ def main(argv=None) -> int:
                     choices=("batched", "token"),
                     help="decode mode: whole-prompt vs token-by-token "
                          "prefill")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="decode mode: fraction of requests opening "
+                         "with one common system-prompt prefix; > 0 "
+                         "enables the prefix cache and banks "
+                         "prefix_hit_rate / cached_prefill_tokens")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="decode mode: enable the prefix cache even "
+                         "with --prefix-share 0")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="decode mode: cap prefill tokens per engine "
+                         "step (FLAGS_serving_prefill_chunk; 0 = "
+                         "uncapped); max_prefill_tokens_step in the "
+                         "report counter-asserts it")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
@@ -571,6 +626,15 @@ def main(argv=None) -> int:
         return 2
     if args.mesh > 1 and args.mode != "decode":
         sys.stderr.write("serve_bench: --mesh needs --mode decode\n")
+        return 2
+    if (args.prefix_share or args.prefix_cache or args.prefill_chunk) \
+            and args.mode != "decode":
+        sys.stderr.write(
+            "serve_bench: --prefix-share/--prefix-cache/--prefill-chunk "
+            "need --mode decode\n")
+        return 2
+    if not 0.0 <= args.prefix_share <= 1.0:
+        sys.stderr.write("serve_bench: --prefix-share must be in [0, 1]\n")
         return 2
     if args.chaos and args.replicas > 1:
         sys.stderr.write(
