@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from .capture import capture_fn, ProgramArtifacts
 
-__all__ = ["CORPUS", "build_corpus_program"]
+__all__ = ["CORPUS", "build_corpus_program", "corpus_extra_bytes"]
 
 
 def _broadcast_lse_operand() -> ProgramArtifacts:
@@ -158,7 +158,34 @@ def _host_callback() -> ProgramArtifacts:
         name="corpus_host_callback")
 
 
-# name -> (builder, detector id the linter must flag it with)
+def _gqa_full_pool() -> ProgramArtifacts:
+    """The GQA regression the gqa_decode zoo entry gates on: a model
+    configured for grouped KV heads served from a FULL H_q pool (the
+    grouping dropped somewhere between config and pool construction, so
+    every page stores and streams H_q/H_kv x the bytes).  No detector
+    flags it — the program is structurally healthy — which is exactly
+    why it must trip the BYTES tolerance instead: the artifact shares
+    the zoo entry's capture (and name) via ``zoo.capture_gqa_decode``,
+    just with H_q pool heads, so ``lint_programs --inject gqa_full_pool
+    --gate`` prices it against the banked grouped baseline and exits 3
+    rather than silently passing — and retuning the zoo geometry
+    retunes this check with it."""
+    from .zoo import GQA_DECODE_GEOM, capture_gqa_decode
+
+    return capture_gqa_decode(GQA_DECODE_GEOM["heads"])  # full H_q!
+
+
+def _gqa_full_pool_extra_bytes() -> float:
+    """The full-H_q analytic page stream the known-bad pool pays —
+    without it the corpus program's XLA-visible bytes alone would gate
+    BELOW the banked grouped baseline and pass."""
+    from .zoo import GQA_DECODE_GEOM, gqa_decode_stream_bytes
+
+    return gqa_decode_stream_bytes(GQA_DECODE_GEOM["heads"])
+
+
+# name -> (builder, detector id the linter must flag it with; None for
+# programs that trip the zoo BYTES gate instead of a detector)
 CORPUS = {
     "broadcast_lse": (_broadcast_lse_operand, "broadcast-operand"),
     "relayout_sandwich": (_conv_relayout_sandwich, "relayout-copy-pair"),
@@ -168,7 +195,22 @@ CORPUS = {
     "host_callback": (_host_callback, "host-sync"),
     "all_gather_replicated": (_all_gather_replicated,
                               "collective-placement"),
+    "gqa_full_pool": (_gqa_full_pool, None),
 }
+
+# corpus programs whose hazard prices in the analytic page-stream
+# correction (zoo._corpus_builder adds it to the XLA-visible bytes,
+# mirroring the real zoo entries' methodology); default 0
+_EXTRA_BYTES = {
+    "gqa_full_pool": _gqa_full_pool_extra_bytes,
+}
+
+
+def corpus_extra_bytes(name: str) -> float:
+    """Analytic bytes/step correction for one corpus program (0 for
+    programs whose hazard is fully XLA-visible)."""
+    fn = _EXTRA_BYTES.get(name)
+    return float(fn()) if fn else 0.0
 
 
 @functools.lru_cache(maxsize=None)

@@ -17,6 +17,15 @@ host):
                      counts the analytic page-stream traffic on top of
                      the XLA-visible bytes, same methodology as the
                      banked artifact
+  gqa_decode         the paged_decode geometry with GROUPED-QUERY heads
+                     (ISSUE 12): H_q=8 query heads over an H_kv=2 pool,
+                     so the pallas grid walks (B, H_kv, pages) and each
+                     KV page streams ONCE per sequence while its 4-head
+                     query group shares it in VMEM — the banked KV
+                     page-stream bytes/step must sit at ~H_kv/H_q x the
+                     paged_decode baseline (tests assert within 10%),
+                     and int8 pages halve it again (priced analytically
+                     in the same test)
   prefix_decode      the same decode step under 8-way prefix sharing
                      (ISSUE 11): every sequence's page table walks ONE
                      refcounted shared 28-page prefix plus a private
@@ -156,6 +165,58 @@ def _build_paged_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, extra, cfg
 
 
+# the gqa_decode geometry: the paged_decode shape with an H_kv=2 GQA
+# pool — query heads stay at 8, the pool (and its page stream) shrink
+# 4x.  ONE source of truth: the known-bad corpus arm (gqa_full_pool)
+# captures the SAME geometry over a full-H_q pool, so retuning these
+# numbers retunes the regression check with them.
+GQA_DECODE_GEOM = {"batch": 4, "heads": 8, "kv_heads": 2,
+                   "head_dim": 128, "page_size": 16, "max_pages": 32}
+
+
+def capture_gqa_decode(pool_heads: int) -> ProgramArtifacts:
+    """Capture the gqa_decode program over a pool holding `pool_heads`
+    KV heads — the zoo entry passes H_kv (the win), the known-bad
+    corpus arm passes H_q (the regression).  Both artifacts carry the
+    zoo entry's name so they gate against the same banked baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.paged_attention import paged_decode_attention
+
+    g = GQA_DECODE_GEOM
+    B, Hq, D, ps, maxp = (g["batch"], g["heads"], g["head_dim"],
+                          g["page_size"], g["max_pages"])
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((pool_heads, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    return capture_fn(
+        lambda q, k, v, t, l: paged_decode_attention(
+            q, k, v, t, l, impl="pallas"),
+        q, kp, kp, tb, ln, name="gqa_decode")
+
+
+def gqa_decode_stream_bytes(pool_heads: int) -> float:
+    """The analytic page-stream correction for `capture_gqa_decode` —
+    scales with the POOL's head count, same methodology as
+    paged_decode."""
+    from ..kernels.paged_attention import attention_bytes_per_step
+
+    g = GQA_DECODE_GEOM
+    return float(attention_bytes_per_step(
+        "pallas", g["batch"], g["max_pages"], g["page_size"],
+        g["heads"], g["head_dim"], num_kv_heads=pool_heads))
+
+
+def _build_gqa_decode() -> Tuple[ProgramArtifacts, float, Dict]:
+    g = GQA_DECODE_GEOM
+    art = capture_gqa_decode(g["kv_heads"])
+    cfg = dict(g, impl="pallas")
+    return art, gqa_decode_stream_bytes(g["kv_heads"]), cfg
+
+
 def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     import jax
     import jax.numpy as jnp
@@ -255,6 +316,7 @@ ZOO = {
     "resnet50_train": _build_resnet50,
     "transformer_train": _build_transformer,
     "paged_decode": _build_paged_decode,
+    "gqa_decode": _build_gqa_decode,
     "prefix_decode": _build_prefix_decode,
     "sharded_decode": _build_sharded_decode,
 }
@@ -262,9 +324,10 @@ ZOO = {
 
 def _corpus_builder(name: str):
     def build() -> Tuple[ProgramArtifacts, float, Dict]:
-        from .corpus import build_corpus_program
+        from .corpus import build_corpus_program, corpus_extra_bytes
 
-        return build_corpus_program(name), 0.0, {"corpus": name}
+        return (build_corpus_program(name), corpus_extra_bytes(name),
+                {"corpus": name})
     return build
 
 

@@ -30,6 +30,28 @@ flips:
   interpreter — CPU-testable parity against reference, the tier-1
   contract suite.
 
+GROUPED-QUERY ATTENTION (ISSUE 12).  The pool may hold H_kv < H_q
+heads (GQA/MQA): query head ``h`` reads KV head ``h // (H_q/H_kv)``.
+The kernel grid is (B, H_kv, pages) — each KV page block is streamed
+from HBM ONCE per sequence while ALL H_q/H_kv query heads of the group
+score against it in VMEM: the group rides the padded query-row dim
+(one fp32 sublane holds up to 8 group members; larger groups pad to
+the next sublane multiple), and the online-softmax scratch state is
+per ROW, i.e. per query head — the rows never mix.  Decode KV traffic
+and pool storage both shrink H_q/H_kv x.  ``H_q % H_kv != 0`` raises
+the typed :class:`GroupedHeadsError` — it is a config error, not an
+envelope miss, so it never silently falls back.
+
+INT8 KV PAGES.  An int8 pool carries one fp32 scale per (layer, page)
+for each of K and V (amax quantization — serving/kvcache.py owns the
+write-side math).  The kernel takes the layer's ``[P]`` scale rows as
+two more scalar-prefetch operands and fuses dequantization into the
+page-stream inner loop: the SMEM page-table entry that indexes the
+page's DMA also indexes its scale, so ``k_f32 = k_i8 * scale`` costs
+one VPU multiply per streamed block and HBM still only ever sees the
+1-byte elements — KV bytes halve again vs bf16.  The reference gather
+dequantizes the same way (``gather_kv_pages(..., scales=)``).
+
 Selection (the kernels/conv_epilogue.py precedent — measured Mosaic
 envelope, explicit fallback, flag-driven): ``FLAGS_serving_paged_impl``
 (auto|reference|pallas|interpret) supplies the default; ``auto`` picks
@@ -37,17 +59,17 @@ pallas on TPU when ``pallas_paged_viable`` accepts the pool geometry
 and reference everywhere else; an explicit ``pallas`` outside the
 envelope falls back to reference with a one-time log, never a Mosaic
 compile bomb.  The envelope: head_dim a lane multiple (128) and
-page_size a sublane multiple (8 fp32 / 16 bf16), so every K/V page
-block is natively (sublane, lane)-tiled — the constraint class that
-produced the flash residual-layout and conv-epilogue 'non-native
-tiling' chip failures.
+page_size a sublane multiple (8 fp32 / 16 bf16 / 32 int8), so every
+K/V page block is natively (sublane, lane)-tiled — the constraint
+class that produced the flash residual-layout and conv-epilogue
+'non-native tiling' chip failures.
 
-Pool layout is KERNEL-NATIVE: [H, P, page_size, D] per layer (heads
+Pool layout is KERNEL-NATIVE: [H_kv, P, page_size, D] per layer (heads
 outermost), so a (1, 1, page_size, D) page block's last two dims are
 exactly (page_size, head_dim) — Mosaic-tileable without relayout.  The
-decode query rides as a [B, H, 8, D] block (the single row zero-padded
-to one fp32 sublane; rows 1..7 compute discarded lanes) for the same
-reason.
+decode query rides as a [B, H_kv, G_pad, D] block (the group's rows
+zero-padded to a whole fp32 sublane; padded rows compute discarded
+lanes) for the same reason.
 """
 
 from __future__ import annotations
@@ -62,30 +84,69 @@ import jax.numpy as jnp
 from .flash_attention import NEG_INF, _on_tpu, flash_attention
 
 __all__ = [
+    "GroupedHeadsError",
     "attention_bytes_per_step",
     "fallback_count",
     "gather_kv_pages",
     "paged_decode_attention",
     "pallas_paged_viable",
+    "repeat_kv",
     "resolve_paged_impl",
 ]
 
 _IMPLS = ("auto", "reference", "pallas", "interpret")
 
-# the query block is one fp32 sublane: row 0 is the real decode query,
-# rows 1..7 are zero padding whose outputs are sliced off host-side
+# the query block is one fp32 sublane: a query group of G <= 8 heads
+# (G = 1 without GQA) occupies rows 0..G-1, the rest are zero padding
+# whose outputs are sliced off host-side; groups larger than 8 pad to
+# the next sublane multiple
 _SQ_PAD = 8
 
 
-def gather_kv_pages(pages, page_tables):
-    """Reference page gather: pages [H, P, page_size, D] (one layer of
-    the pool) + page_tables [B, max_pages] int32 -> contiguous
-    [B, H, S, D] with S = max_pages * page_size.  Rows past a sequence's
-    length are whatever the padding pages hold — callers MUST mask via
-    k_lengths."""
+class GroupedHeadsError(ValueError):
+    """H_q is not a multiple of H_kv: no query-head group maps cleanly
+    onto a KV head.  A config error — raised typed so callers cannot
+    confuse it with an envelope miss (which falls back instead)."""
+
+
+def _group_size(num_q_heads: int, num_kv_heads: int) -> int:
+    """Query heads per KV head, or GroupedHeadsError — the ONE
+    divisibility check every GQA entry point (kernel, pool, config)
+    funnels through."""
+    if num_kv_heads < 1 or num_q_heads % num_kv_heads:
+        raise GroupedHeadsError(
+            f"{num_q_heads} query heads do not group over {num_kv_heads} "
+            "KV heads — H_q must be a positive multiple of H_kv")
+    return num_q_heads // num_kv_heads
+
+
+def repeat_kv(k, v, group: int):
+    """Broadcast KV heads over their query groups for a NON-grouped
+    attention compute: [.., H_kv, ..] -> [.., H_q, ..] on axis 1, with
+    query head h reading KV head h // group.  ``jnp.repeat`` — NOT tile
+    — is load-bearing: it keeps each group's heads adjacent, the same
+    order the grouped kernel's fold/unfold uses.  No-op when group is
+    1, so callers can apply it unconditionally."""
+    if group == 1:
+        return k, v
+    return jnp.repeat(k, group, axis=1), jnp.repeat(v, group, axis=1)
+
+
+def gather_kv_pages(pages, page_tables, scales=None):
+    """Reference page gather: pages [H_kv, P, page_size, D] (one layer
+    of the pool) + page_tables [B, max_pages] int32 -> contiguous
+    [B, H_kv, S, D] with S = max_pages * page_size.  With ``scales``
+    (the layer's [P] per-page fp32 quantization scales) the gathered
+    int8 content is dequantized to fp32: row blocks multiply by their
+    OWN page's scale, gathered through the same table.  Rows past a
+    sequence's length are whatever the padding pages hold — callers
+    MUST mask via k_lengths."""
     tables = jnp.asarray(page_tables, jnp.int32)
     b, n_pages = tables.shape
     g = jnp.take(pages, tables.reshape(-1), axis=1)  # [H, B*maxp, page, D]
+    if scales is not None:
+        s = jnp.take(jnp.asarray(scales, jnp.float32), tables.reshape(-1))
+        g = g.astype(jnp.float32) * s[None, :, None, None]
     h, _, page, d = g.shape
     return jnp.transpose(
         g.reshape(h, b, n_pages * page, d), (1, 0, 2, 3))
@@ -96,14 +157,16 @@ def pallas_paged_viable(page_size: int, head_dim: int,
     """True when the pallas page reader supports this pool geometry on
     TPU — the measured Mosaic envelope: K/V page blocks must be natively
     (sublane, lane)-tiled, i.e. head_dim a 128-lane multiple and
-    page_size a sublane multiple (8 for fp32, 16 for bf16).  Out of
-    envelope the selection falls back to the reference gather —
-    explicitly, not at compile time."""
+    page_size a sublane multiple (8 for fp32, 16 for bf16, 32 for int8
+    pages).  Out of envelope the selection falls back to the reference
+    gather — explicitly, not at compile time."""
     dt = jnp.dtype(dtype)
     if dt == jnp.dtype(jnp.float32):
         sublane = 8
     elif dt == jnp.dtype(jnp.bfloat16):
         sublane = 16
+    elif dt == jnp.dtype(jnp.int8):
+        sublane = 32
     else:
         return False
     return head_dim % 128 == 0 and page_size % sublane == 0 and \
@@ -175,36 +238,86 @@ def resolve_paged_impl(impl, page_size: int, head_dim: int,
 
 def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
                              page_size: int, num_heads: int, head_dim: int,
-                             itemsize: int = 4, num_layers: int = 1) -> int:
+                             itemsize: int = 4, num_layers: int = 1,
+                             num_kv_heads: int | None = None,
+                             dtype=None) -> int:
     """Analytic HBM bytes one decode step moves through the attention
     KV path (the serving metrics gauge; the chip-less cost tier banks
-    the compiler-measured counterpart in AOT_COST_PAGED.json).  Per
-    layer, with S_kv = batch * max_pages * page_size * num_heads *
-    head_dim * itemsize for ONE of K or V:
+    the compiler-measured counterpart in AOT_COST_ZOO.json).
 
-    - reference: pages read + contiguous [B,H,S,D] copy written +
-      copy read back by attention, for K and V -> 6 * S_kv;
-    - pallas/interpret: each page streamed exactly once, K and V
-      -> 2 * S_kv.
+    ``num_kv_heads`` (None: num_heads) is the POOL's head count — the
+    GQA win is exactly this arm: KV traffic scales with H_kv, never
+    H_q, because the grouped kernel streams each KV page once per
+    group.  ``dtype`` (None: use ``itemsize`` as given) pins the pool
+    element size explicitly — pass the pool's real dtype instead of
+    assuming the fp32 default; int8 pools additionally charge the two
+    fp32 per-page scales each walked page reads.
+
+    Per layer, with E_kv = batch * max_pages * page_size * num_kv_heads
+    * head_dim elements for ONE of K or V (E_q the same at num_heads):
+
+    - reference: pages read at the pool itemsize + contiguous
+      [B,H_kv,S,D] gather copy written at the COMPUTE itemsize (fp32
+      for dequantized int8, the pool dtype otherwise) + — GQA only —
+      the jnp.repeat group broadcast materialized at H_q (written) +
+      the H_q-sized copy read back by attention, for K and V.  With
+      H_kv == H_q this collapses to the classic pages + copy-written +
+      copy-read 3x; under grouping the reference arm genuinely pays
+      the E_q-sized broadcast the grouped kernel never materializes,
+      and the model says so;
+    - pallas/interpret: each page streamed exactly once at the pool
+      itemsize, K and V — E_kv always, that IS the win.
 
     Query/output terms (batch*heads*head_dim) are negligible at decode
     shapes and excluded."""
-    s_kv = batch * max_pages * page_size * num_heads * head_dim * itemsize
-    per_layer = (2 if impl in ("pallas", "interpret") else 6) * s_kv
+    import numpy as np
+
+    h_kv = num_kv_heads if num_kv_heads is not None else num_heads
+    group = _group_size(int(num_heads), int(h_kv))
+    if dtype is not None:
+        itemsize = np.dtype(dtype).itemsize
+    quantized = dtype is not None and np.dtype(dtype) == np.dtype(np.int8)
+    elems = batch * max_pages * page_size * h_kv * head_dim
+    compute_itemsize = 4 if quantized else itemsize
+    if impl in ("pallas", "interpret"):
+        per_layer = 2 * elems * itemsize
+    else:
+        elems_q = elems * group
+        # pages read + gather copy written (H_kv) + [G>1: repeat
+        # broadcast written at H_q] + attention reads the H_q copy
+        per_layer = 2 * (elems * itemsize + elems * compute_itemsize
+                         + (elems_q * compute_itemsize if group > 1
+                            else 0)
+                         + elems_q * compute_itemsize)
+    if quantized:
+        # one fp32 K scale + one fp32 V scale per page walked
+        per_layer += 2 * batch * max_pages * 4
     return per_layer * int(num_layers)
 
 
-def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, page_size):
-    """Grid (B, H, max_pages); pages innermost so the online-softmax
-    state for one (sequence, head) lives in VMEM scratch across the
+def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
+                  quantized):
+    """Grid (B, H_kv, max_pages); pages innermost so the online-softmax
+    state for one (sequence, KV head) lives in VMEM scratch across the
     page walk.  tables_ref/lengths_ref are SMEM scalar-prefetch refs:
     tables drives the K/V BlockSpec index maps (the page DMA), lengths
-    masks the ragged tail in-kernel.  Page table rows are zero-padded —
-    the dummy page-0 reads those DMAs issue are fully masked by
-    position >= length, exactly the flash fully-masked-block contract
-    (m floor NEG_INF/2, p underflows to 0, l stays 0)."""
+    masks the ragged tail in-kernel.  Quantized pools prefetch two more
+    SMEM operands — the layer's per-page K/V scales — and the same
+    table entry that picked the page picks its scale (dequant fused
+    into the stream).  The query block rows are the KV head's QUERY
+    GROUP (G heads + padding): the m/l/acc recurrence is per row, so
+    every group member keeps its own softmax state while sharing the
+    one streamed page.  Page table rows are zero-padded — the dummy
+    page-0 reads those DMAs issue are fully masked by position >=
+    length, exactly the flash fully-masked-block contract (m floor
+    NEG_INF/2, p underflows to 0, l stays 0)."""
     import jax.experimental.pallas as pl
+
+    if quantized:
+        k_scales_ref, v_scales_ref, q_ref, k_ref, v_ref, o_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
 
     b = pl.program_id(0)
     p = pl.program_id(2)
@@ -216,14 +329,18 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]  # [_SQ_PAD, D]
+    q = q_ref[0, 0]  # [G_pad, D] — the KV head's query group
     k = k_ref[0, 0]  # [page_size, D]
     v = v_ref[0, 0]
+    if quantized:
+        page = tables_ref[b, p]
+        k = k.astype(jnp.float32) * k_scales_ref[page]
+        v = v.astype(jnp.float32) * v_scales_ref[page]
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(pos < lengths_ref[b], s, NEG_INF)
 
-    m_prev = m_scr[:]  # [_SQ_PAD, 1]
+    m_prev = m_scr[:]  # [G_pad, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p_w = jnp.exp(s - m_new)
     correction = jnp.exp(m_prev - m_new)
@@ -239,8 +356,8 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 @functools.lru_cache(maxsize=128)
-def _paged_call(batch, heads, max_pages, page_size, head_dim, scale,
-                kv_dtype, interpret):
+def _paged_call(batch, kv_heads, g_pad, max_pages, page_size, head_dim,
+                scale, kv_dtype, interpret, quantized):
     """Memoized pallas_call — one traced callable per static config, so
     every decode layer/step of a model reuses ONE kernel payload (the
     flash_attention._fwd_call compile-cache contract)."""
@@ -248,77 +365,119 @@ def _paged_call(batch, heads, max_pages, page_size, head_dim, scale,
     from jax.experimental.pallas import tpu as pltpu
 
     dt = jnp.dtype(kv_dtype)
+    # the dequantized (and padded-query) compute runs in fp32; an
+    # unquantized pool computes/outputs in its own dtype as before
+    out_dt = jnp.float32 if quantized else dt
+    n_prefetch = 4 if quantized else 2
+    # index maps see every scalar-prefetch operand after the grid ids
+    pad = (lambda f: (lambda b, h, p, t, l, ks, vs: f(b, h, p, t, l))) \
+        if quantized else (lambda f: f)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # page tables + lengths land in SMEM
-        grid=(batch, heads, max_pages),
+        num_scalar_prefetch=n_prefetch,
+        grid=(batch, kv_heads, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, _SQ_PAD, head_dim),
-                         lambda b, h, p, tables, lengths: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, g_pad, head_dim),
+                         pad(lambda b, h, p, tables, lengths: (b, h, 0, 0))),
             # the page walk: the SMEM table entry picks which pool page
             # the next grid step DMAs — no gather ever materializes
             pl.BlockSpec((1, 1, page_size, head_dim),
-                         lambda b, h, p, tables, lengths:
-                         (h, tables[b, p], 0, 0)),
+                         pad(lambda b, h, p, tables, lengths:
+                             (h, tables[b, p], 0, 0))),
             pl.BlockSpec((1, 1, page_size, head_dim),
-                         lambda b, h, p, tables, lengths:
-                         (h, tables[b, p], 0, 0)),
+                         pad(lambda b, h, p, tables, lengths:
+                             (h, tables[b, p], 0, 0))),
         ],
-        out_specs=pl.BlockSpec((1, 1, _SQ_PAD, head_dim),
-                               lambda b, h, p, tables, lengths: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, g_pad, head_dim),
+            pad(lambda b, h, p, tables, lengths: (b, h, 0, 0))),
         scratch_shapes=[
-            pltpu.VMEM((_SQ_PAD, 1), jnp.float32),
-            pltpu.VMEM((_SQ_PAD, 1), jnp.float32),
-            pltpu.VMEM((_SQ_PAD, head_dim), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, head_dim), jnp.float32),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, page_size=page_size),
+        functools.partial(_paged_kernel, scale=scale, page_size=page_size,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (batch, heads, _SQ_PAD, head_dim), dt),
+            (batch, kv_heads, g_pad, head_dim), out_dt),
         interpret=interpret,
     )
 
 
 def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
-                  interpret=False):
-    B, H, _, D = q.shape
-    _, _, page_size, _ = k_pages.shape
+                  interpret=False, k_scales=None, v_scales=None):
+    B, Hq, _, D = q.shape
+    Hkv, _, page_size, _ = k_pages.shape
+    G = Hq // Hkv
+    g_pad = -(-G // _SQ_PAD) * _SQ_PAD
+    quantized = k_scales is not None
     tables = jnp.asarray(page_tables, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
-    qp = jnp.pad(q.astype(k_pages.dtype),
-                 ((0, 0), (0, 0), (0, _SQ_PAD - q.shape[2]), (0, 0)))
-    call = _paged_call(B, H, tables.shape[1], page_size, D, float(scale),
-                       str(k_pages.dtype), interpret)
-    out = call(tables, lengths, qp, k_pages, v_pages)
-    return out[:, :, :1, :].astype(q.dtype)
+    # fold query heads onto their KV head: row g of group h_kv is query
+    # head h_kv * G + g — the same order the output unfolds below
+    qg = q[:, :, 0, :].reshape(B, Hkv, G, D)
+    qg = qg.astype(jnp.float32 if quantized else k_pages.dtype)
+    qp = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - G), (0, 0)))
+    call = _paged_call(B, Hkv, g_pad, tables.shape[1], page_size, D,
+                       float(scale), str(k_pages.dtype), interpret,
+                       quantized)
+    if quantized:
+        out = call(tables, lengths,
+                   jnp.asarray(k_scales, jnp.float32),
+                   jnp.asarray(v_scales, jnp.float32),
+                   qp, k_pages, v_pages)
+    else:
+        out = call(tables, lengths, qp, k_pages, v_pages)
+    return out[:, :, :G, :].reshape(B, Hq, 1, D).astype(q.dtype)
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
                            scale=None, impl: str | None = None,
-                           force: str = "auto"):
-    """q: [B, H, 1, D] decode queries; k_pages/v_pages: [H, P,
-    page_size, D] one layer of the pool; page_tables: [B, max_pages]
-    int32; lengths: [B] valid token counts (the new token already
-    appended).
+                           force: str = "auto", k_scales=None,
+                           v_scales=None):
+    """q: [B, H_q, 1, D] decode queries; k_pages/v_pages: [H_kv, P,
+    page_size, D] one layer of the pool (H_kv <= H_q for GQA/MQA —
+    query head h reads KV head h // (H_q/H_kv); H_q % H_kv != 0 raises
+    :class:`GroupedHeadsError`); page_tables: [B, max_pages] int32;
+    lengths: [B] valid token counts (the new token already appended).
 
-    Returns [B, H, 1, D].  Causality is implied: the single query IS the
-    last valid position, so masking keys at >= lengths is exactly the
-    causal frontier.
+    ``k_scales``/``v_scales`` ([P] fp32, required together): the
+    layer's per-page quantization scales for an int8 pool — dequant is
+    fused into the pallas page stream and into the reference gather.
+
+    Returns [B, H_q, 1, D].  Causality is implied: the single query IS
+    the last valid position, so masking keys at >= lengths is exactly
+    the causal frontier.
 
     `impl`: None reads FLAGS_serving_paged_impl; see resolve_paged_impl
     for the auto/envelope/fallback contract.  `force` forwards to
     flash_attention (reference impl only)."""
     if q.ndim != 4 or q.shape[2] != 1:
         raise ValueError(f"decode query must be [B, H, 1, D], got {q.shape}")
+    G = _group_size(q.shape[1], k_pages.shape[0])
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    if k_scales is None and jnp.dtype(k_pages.dtype) == jnp.dtype(jnp.int8):
+        raise ValueError(
+            "an int8 KV pool needs its per-page k_scales/v_scales — "
+            "raw int8 content is meaningless without them")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     impl = resolve_paged_impl(impl, k_pages.shape[2], q.shape[3],
                               k_pages.dtype)
     if impl in ("pallas", "interpret"):
         return _pallas_paged(q, k_pages, v_pages, page_tables, lengths,
-                             scale, interpret=(impl == "interpret"))
-    k = gather_kv_pages(k_pages, page_tables)
-    v = gather_kv_pages(v_pages, page_tables)
+                             scale, interpret=(impl == "interpret"),
+                             k_scales=k_scales, v_scales=v_scales)
+    # dequantized pools gather straight to fp32; bf16/fp32 pools pass
+    # through at the POOL dtype (no widening copy — the byte model
+    # prices the copy terms at the pool itemsize)
+    k = gather_kv_pages(k_pages, page_tables, scales=k_scales)
+    v = gather_kv_pages(v_pages, page_tables, scales=v_scales)
+    # the reference arm materializes the group broadcast the pallas
+    # kernel never pays for (attention_bytes_per_step charges it)
+    k, v = repeat_kv(k, v, G)
     return flash_attention(q, k, v, causal=False, scale=scale,
                            k_lengths=lengths, force=force)

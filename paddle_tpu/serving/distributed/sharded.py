@@ -5,15 +5,26 @@ chip's HBM bandwidth and one chip's page pool.  This module shards the
 SAME model across a mesh axis (``tp``) the classic Megatron way, mapped
 onto jax:
 
-- **Column-parallel QKV**: ``wq/wk/wv [d, d]`` split on the OUTPUT dim,
-  so shard ``i`` computes heads ``[i*H/n, (i+1)*H/n)`` — no collective,
-  each shard's Q/K/V are exactly its own heads'.
+- **Column-parallel QKV**: ``wq [d, d]`` / ``wk/wv [d, H_kv*Dh]``
+  split on the OUTPUT dim, so shard ``i`` computes query heads
+  ``[i*H/n, (i+1)*H/n)`` and KV heads ``[i*H_kv/n, (i+1)*H_kv/n)`` —
+  no collective, each shard's Q/K/V are exactly its own heads', and
+  under GQA (``cfg.n_kv_head < n_head``) the query-group alignment is
+  automatic: H/n local query heads are exactly (H/H_kv) groups over
+  H_kv/n local KV heads, so the grouped paged kernel runs per-shard
+  unchanged.  Both head counts must divide by the mesh axis.
 - **Local paged KV**: :class:`ShardedKVCachePool` shards the pool
-  arrays on the HEAD axis (``[L, H/n, P, page_size, D]`` per device).
-  Page tables and the free list stay host-side and global (one
-  admission decision covers all shards); the K/V write and the
-  paged-attention page walk are per-shard local — the pallas kernel
-  runs unchanged, its grid was already per-head.
+  arrays on the KV-HEAD axis (``[L, H_kv/n, P, page_size, D]`` per
+  device — the GQA shrink compounds with the mesh split: each device
+  holds H_kv/(H*n) of a full-head single-device pool).  Page tables
+  and the free list stay host-side and global (one admission decision
+  covers all shards); the K/V write and the paged-attention page walk
+  are per-shard local — the pallas kernel runs unchanged, its grid was
+  already per-(KV-)head.  int8 pages are NOT yet supported here: the
+  sharded step writes K/V inside the shard_map body, where the
+  host-side amax scale bookkeeping cannot reach (a device-side scale
+  table is the follow-up); the constructor rejects ``dtype="int8"``
+  loudly rather than storing garbage.
 - **Row-parallel joins**: ``wo [d, d]`` splits on the INPUT dim; each
   shard contributes ``attn_local @ wo_local`` and one ``psum`` over ICI
   joins the partials (same for the MLP's ``w1``/``w2`` pair).  ``psum``
@@ -37,7 +48,7 @@ The AOT v5e tier (core/aot_tpu.py) compiles the same program for a
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,7 +57,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...kernels.flash_attention import flash_attention
-from ...kernels.paged_attention import paged_decode_attention, resolve_paged_impl
+from ...kernels.paged_attention import (
+    paged_decode_attention,
+    repeat_kv,
+    resolve_paged_impl,
+)
 from ..generate import DecodeConfig, _layernorm
 from ..kvcache import KVCachePool
 
@@ -105,9 +120,11 @@ def param_shape_dtypes(cfg: DecodeConfig) -> Dict:
     """ShapeDtypeStruct pytree of init_decode_params(cfg) — the AOT
     capture path's abstract arguments (no host weights materialized)."""
     d, f = cfg.d_model, cfg.d_inner
+    d_kv = cfg.num_kv_heads * cfg.head_dim
     sds = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.float32)
     layer = {
-        "wq": sds(d, d), "wk": sds(d, d), "wv": sds(d, d), "wo": sds(d, d),
+        "wq": sds(d, d), "wk": sds(d, d_kv), "wv": sds(d, d_kv),
+        "wo": sds(d, d),
         "ln1_g": sds(d), "ln1_b": sds(d),
         "w1": sds(d, f), "b1": sds(f), "w2": sds(f, d), "b2": sds(d),
         "ln2_g": sds(d), "ln2_b": sds(d),
@@ -129,6 +146,21 @@ def _kv_spec(axis: str = AXIS_TP) -> P:
 # LOCAL shard — H_local = n_head / n_shards heads per device)
 
 
+def _local_heads(cfg: DecodeConfig, n_shards: int) -> Tuple[int, int]:
+    """(query, KV) heads per shard — BOTH head counts must divide by
+    the mesh axis.  Under GQA the local query heads are then exactly
+    H/H_kv whole groups over the local KV heads (H/n = (H/H_kv) *
+    H_kv/n), so shard-local grouping matches the global mapping."""
+    if cfg.n_head % n_shards:
+        raise ValueError(
+            f"n_head={cfg.n_head} must divide by n_shards={n_shards}")
+    if cfg.num_kv_heads % n_shards:
+        raise ValueError(
+            f"n_kv_head={cfg.num_kv_heads} must divide by n_shards="
+            f"{n_shards} — the pool shards over the KV-head axis")
+    return cfg.n_head // n_shards, cfg.num_kv_heads // n_shards
+
+
 def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
                    impl: str = "reference", force: str = "auto"):
     """Build the shard_map body for one continuous-batching decode step.
@@ -137,14 +169,11 @@ def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
        tables [B, maxp], lengths [B], k_pages, v_pages)
       -> (logits [B, V] replicated, new k_pages, new v_pages)
 
-    The K/V append is the write_kv contract on the LOCAL head shard;
+    The K/V append is the write_kv contract on the LOCAL KV-head shard;
     the paged attention walks the (global, replicated) page tables over
     the LOCAL pool arrays — every byte the hot path touches lives on
     the device that computes with it."""
-    if cfg.n_head % n_shards:
-        raise ValueError(
-            f"n_head={cfg.n_head} must divide by n_shards={n_shards}")
-    H_local = cfg.n_head // n_shards
+    H_local, Hkv_local = _local_heads(cfg, n_shards)
     d, Dh = cfg.d_model, cfg.head_dim
 
     def step(params, tokens, positions, pages, slots, tables, lengths,
@@ -154,8 +183,8 @@ def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
             + jnp.asarray(params["pos"])[positions]
         for li, lp in enumerate(params["layers"]):
             q = (h @ lp["wq"]).reshape(B, H_local, Dh)
-            k = (h @ lp["wk"]).reshape(B, H_local, Dh)
-            v = (h @ lp["wv"]).reshape(B, H_local, Dh)
+            k = (h @ lp["wk"]).reshape(B, Hkv_local, Dh)
+            v = (h @ lp["wv"]).reshape(B, Hkv_local, Dh)
             k_pages = k_pages.at[li, :, pages, slots].set(k)
             v_pages = v_pages.at[li, :, pages, slots].set(v)
             attn = paged_decode_attention(
@@ -186,11 +215,11 @@ def prefill_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
           v_pages)
 
     Same sharding as the decode step; the causal pass runs through the
-    flash ``k_lengths`` tier over the LOCAL heads."""
-    if cfg.n_head % n_shards:
-        raise ValueError(
-            f"n_head={cfg.n_head} must divide by n_shards={n_shards}")
-    H_local = cfg.n_head // n_shards
+    flash ``k_lengths`` tier over the LOCAL heads (GQA repeats each
+    local KV head over its query group for the compute — the pool
+    write stays at H_kv/n heads)."""
+    H_local, Hkv_local = _local_heads(cfg, n_shards)
+    G = cfg.group_size
     d, Dh = cfg.d_model, cfg.head_dim
 
     def step(params, tokens, lens, pages, slots, b_idx, t_idx,
@@ -200,14 +229,15 @@ def prefill_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
             + jnp.asarray(params["pos"])[None, :Smax]
         for li, lp in enumerate(params["layers"]):
             q = (h @ lp["wq"]).reshape(B, Smax, H_local, Dh)
-            k = (h @ lp["wk"]).reshape(B, Smax, H_local, Dh)
-            v = (h @ lp["wv"]).reshape(B, Smax, H_local, Dh)
+            k = (h @ lp["wk"]).reshape(B, Smax, Hkv_local, Dh)
+            v = (h @ lp["wv"]).reshape(B, Smax, Hkv_local, Dh)
             k_pages = k_pages.at[li, :, pages, slots].set(k[b_idx, t_idx])
             v_pages = v_pages.at[li, :, pages, slots].set(v[b_idx, t_idx])
+            kh, vh = repeat_kv(k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3), G)
             attn = flash_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), causal=True, scale=Dh ** -0.5,
-                k_lengths=lens, force=force)
+                q.transpose(0, 2, 1, 3), kh, vh, causal=True,
+                scale=Dh ** -0.5, k_lengths=lens, force=force)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, Smax, H_local * Dh)
             attn_out = jax.lax.psum(attn @ lp["wo"], axis)
             h = _layernorm(h + attn_out, lp["ln1_g"], lp["ln1_b"])
@@ -262,26 +292,39 @@ class ShardedKVCachePool(KVCachePool):
     def __init__(self, num_pages: int, page_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype="float32",
                  name: str = "kv", mesh: Optional[Mesh] = None,
-                 n_shards: Optional[int] = None, axis: str = AXIS_TP):
+                 n_shards: Optional[int] = None, axis: str = AXIS_TP,
+                 num_kv_heads: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+            raise ValueError(
+                "int8 KV pages are not supported on the mesh-sharded "
+                "pool yet: the SPMD step writes K/V inside shard_map "
+                "where the host-side per-page scale bookkeeping cannot "
+                "reach — use a replicated single-device pool for int8, "
+                "or fp32/bf16 on the mesh")
         if mesh is None:
             n = int(n_shards or 1)
             mesh = Mesh(np.asarray(host_mesh_devices(n)), (axis,))
         self.mesh = mesh
         self.axis = axis
         self.n_shards = int(mesh.shape[axis])
-        if num_heads % self.n_shards:
+        h_kv = int(num_kv_heads if num_kv_heads is not None else num_heads)
+        if h_kv % self.n_shards:
             raise ValueError(
-                f"num_heads={num_heads} must divide by the mesh's "
-                f"{axis} axis ({self.n_shards})")
+                f"num_kv_heads={h_kv} must divide by the mesh's "
+                f"{axis} axis ({self.n_shards}) — the pool shards over "
+                "the KV-head dim")
         super().__init__(num_pages, page_size, num_layers, num_heads,
-                         head_dim, dtype=dtype, name=name)
+                         head_dim, dtype=dtype, name=name,
+                         num_kv_heads=num_kv_heads)
         self.sharding = NamedSharding(mesh, _kv_spec(axis))
         self.k_pages = jax.device_put(self.k_pages, self.sharding)
         self.v_pages = jax.device_put(self.v_pages, self.sharding)
 
     @property
     def heads_per_shard(self) -> int:
-        return self.num_heads // self.n_shards
+        return self.num_kv_heads // self.n_shards
 
     def bytes_per_page_per_shard(self) -> int:
         """One page's K+V bytes on ONE device (the admission math a
@@ -332,10 +375,7 @@ class ShardedDecodeProgram:
         self.cfg = cfg
         self.axis = axis
         self.n_shards = len(devices)
-        if cfg.n_head % self.n_shards:
-            raise ValueError(
-                f"n_head={cfg.n_head} must divide by n_shards="
-                f"{self.n_shards}")
+        _local_heads(cfg, self.n_shards)  # both head counts must split
         self.force = force
         self._requested_impl = paged_impl
         self.paged_impl: Optional[str] = None  # resolved on first pool use
@@ -356,12 +396,12 @@ class ShardedDecodeProgram:
 
     def make_pool(self, num_pages: int, page_size: int,
                   dtype="float32", name: str = "kv") -> ShardedKVCachePool:
-        """A pool shaped for this program's model, head-sharded over the
-        program's mesh."""
+        """A pool shaped for this program's model (H_kv heads for a GQA
+        config), KV-head-sharded over the program's mesh."""
         return ShardedKVCachePool(
             num_pages, page_size, self.cfg.n_layer, self.cfg.n_head,
             self.cfg.head_dim, dtype=dtype, name=name, mesh=self.mesh,
-            axis=self.axis)
+            axis=self.axis, num_kv_heads=self.cfg.num_kv_heads)
 
     def resolve_impl(self, pool: KVCachePool) -> str:
         """Resolve (once) the paged-attention impl against this pool's

@@ -59,6 +59,7 @@ from ..kernels.paged_attention import (
     attention_bytes_per_step,
     gather_kv_pages,
     paged_decode_attention,
+    repeat_kv,
     resolve_paged_impl,
 )
 from ..observability import flight as _flight
@@ -103,7 +104,12 @@ class NonFiniteSequenceError(RuntimeError):
 
 @dataclasses.dataclass
 class DecodeConfig:
-    """Decoder-only slice of models.transformer.TransformerConfig."""
+    """Decoder-only slice of models.transformer.TransformerConfig.
+
+    ``n_kv_head`` (None: n_head — classic MHA) enables grouped-query /
+    multi-query attention: K/V project to n_kv_head heads, the KV pool
+    stores and streams H_q/H_kv x less, and query head h reads KV head
+    ``h // (n_head/n_kv_head)``."""
 
     vocab_size: int = 128
     d_model: int = 32
@@ -112,12 +118,26 @@ class DecodeConfig:
     d_inner: int = 64
     max_length: int = 96
     eos_id: Optional[int] = None  # None: sequences retire on max_new only
+    n_kv_head: Optional[int] = None  # None: n_head (no grouping)
 
     @property
     def head_dim(self) -> int:
         if self.d_model % self.n_head:
             raise ValueError("d_model must divide by n_head")
         return self.d_model // self.n_head
+
+    @property
+    def num_kv_heads(self) -> int:
+        h_kv = self.n_kv_head if self.n_kv_head is not None else self.n_head
+        from ..kernels.paged_attention import _group_size
+
+        _group_size(self.n_head, h_kv)  # typed GroupedHeadsError raise
+        return h_kv
+
+    @property
+    def group_size(self) -> int:
+        """Query heads per KV head (1 without grouping)."""
+        return self.n_head // self.num_kv_heads
 
 
 def init_decode_params(cfg: DecodeConfig, seed: int = 0) -> Dict:
@@ -129,10 +149,11 @@ def init_decode_params(cfg: DecodeConfig, seed: int = 0) -> Dict:
             np.float32)
 
     d, f = cfg.d_model, cfg.d_inner
+    d_kv = cfg.num_kv_heads * cfg.head_dim  # K/V project to H_kv heads
     layers = []
     for _ in range(cfg.n_layer):
         layers.append({
-            "wq": mat(d, d), "wk": mat(d, d), "wv": mat(d, d),
+            "wq": mat(d, d), "wk": mat(d, d_kv), "wv": mat(d, d_kv),
             "wo": mat(d, d),
             "ln1_g": np.ones(d, np.float32), "ln1_b": np.zeros(d, np.float32),
             "w1": mat(d, f), "b1": np.zeros(f, np.float32),
@@ -165,12 +186,14 @@ def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
     if S > cfg.max_length:
         raise ValueError(f"sequence length {S} > max_length {cfg.max_length}")
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    Hkv, G = cfg.num_kv_heads, cfg.group_size
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[:S]
     for lp in params["layers"]:
         q = (h @ lp["wq"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
-        k = (h @ lp["wk"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
-        v = (h @ lp["wv"]).reshape(S, H, Dh).transpose(1, 0, 2)[None]
+        k = (h @ lp["wk"]).reshape(S, Hkv, Dh).transpose(1, 0, 2)[None]
+        v = (h @ lp["wv"]).reshape(S, Hkv, Dh).transpose(1, 0, 2)[None]
+        k, v = repeat_kv(k, v, G)  # GQA: query head h reads KV head h//G
         attn = _reference_attention(q, k, v, causal=True, scale=Dh ** -0.5)
         attn = attn[0].transpose(1, 0, 2).reshape(S, d)
         h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
@@ -213,18 +236,21 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     positions = np.asarray(positions, np.int32)
     B = tokens.shape[0]
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    Hkv = cfg.num_kv_heads
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[positions]
     pages, slots = pool.append_token(seq_ids)
     tables, lengths = pool.page_table_batch(seq_ids)
     for li, lp in enumerate(params["layers"]):
         q = (h @ lp["wq"]).reshape(B, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, H, Dh)
-        v = (h @ lp["wv"]).reshape(B, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, Hkv, Dh)
+        v = (h @ lp["wv"]).reshape(B, Hkv, Dh)
         pool.write_kv(li, pages, slots, k, v)
+        k_scales, v_scales = pool.layer_scales(li)
         attn = paged_decode_attention(
             q[:, :, None, :], pool.k_pages[li], pool.v_pages[li],
             tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
+            k_scales=k_scales, v_scales=v_scales,
         )  # [B, H, 1, Dh]
         attn = attn[:, :, 0, :].reshape(B, d)
         h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
@@ -257,6 +283,7 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         raise ValueError(
             f"prompt length {Smax} > max_length {cfg.max_length}")
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    Hkv, G = cfg.num_kv_heads, cfg.group_size
     tokens = np.zeros((B, Smax), np.int32)
     for i, p in enumerate(prompts):
         tokens[i, :lens[i]] = p
@@ -269,14 +296,16 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         + jnp.asarray(params["pos"])[None, :Smax]  # [B, Smax, d]
     for li, lp in enumerate(params["layers"]):
         q = (h @ lp["wq"]).reshape(B, Smax, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Smax, H, Dh)
-        v = (h @ lp["wv"]).reshape(B, Smax, H, Dh)
-        # valid tokens only ([T, H, Dh] rows in claim order) reach the pool
+        k = (h @ lp["wk"]).reshape(B, Smax, Hkv, Dh)
+        v = (h @ lp["wv"]).reshape(B, Smax, Hkv, Dh)
+        # valid tokens only ([T, H_kv, Dh] rows in claim order) reach
+        # the pool (an int8 pool quantizes them on the way in)
         pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
+        kh, vh = repeat_kv(k.transpose(0, 2, 1, 3),
+                           v.transpose(0, 2, 1, 3), G)
         attn = flash_attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), causal=True, scale=Dh ** -0.5,
-            k_lengths=lens, force=force)
+            q.transpose(0, 2, 1, 3), kh, vh, causal=True,
+            scale=Dh ** -0.5, k_lengths=lens, force=force)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Smax, d)
         h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
         ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
@@ -325,6 +354,7 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
             f"chunk reaches position {int((starts + lens).max())} > "
             f"max_length {cfg.max_length}")
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    Hkv, G = cfg.num_kv_heads, cfg.group_size
     tokens = np.zeros((B, Cmax), np.int32)
     for i, c in enumerate(chunks):
         tokens[i, :lens[i]] = c
@@ -343,11 +373,15 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     scale = Dh ** -0.5
     for li, lp in enumerate(params["layers"]):
         q = (h @ lp["wq"]).reshape(B, Cmax, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Cmax, H, Dh)
-        v = (h @ lp["wv"]).reshape(B, Cmax, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, Cmax, Hkv, Dh)
+        v = (h @ lp["wv"]).reshape(B, Cmax, Hkv, Dh)
         pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
-        k_full = gather_kv_pages(pool.k_pages[li], tables)  # [B, H, S, Dh]
-        v_full = gather_kv_pages(pool.v_pages[li], tables)
+        k_scales, v_scales = pool.layer_scales(li)
+        k_full = gather_kv_pages(pool.k_pages[li], tables,
+                                 scales=k_scales)  # [B, H_kv, S, Dh]
+        v_full = gather_kv_pages(pool.v_pages[li], tables,
+                                 scales=v_scales)
+        k_full, v_full = repeat_kv(k_full, v_full, G)
         scores = jnp.einsum("bihd,bhjd->bhij", q, k_full) * scale
         scores = jnp.where(mask[:, None], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
@@ -474,6 +508,12 @@ class ContinuousBatchingLoop:
         self.cfg = cfg if cfg is not None else getattr(program, "cfg", None)
         if self.cfg is None:
             raise ValueError("pass cfg (or a program that carries one)")
+        if getattr(pool, "num_kv_heads", None) not in (
+                None, self.cfg.num_kv_heads):
+            raise ValueError(
+                f"pool holds {pool.num_kv_heads} KV heads but the model "
+                f"projects {self.cfg.num_kv_heads} (cfg.n_kv_head) — a "
+                "mismatched pool would scatter K/V across wrong heads")
         self.pool = pool
         self.max_batch = int(max_batch)
         self.force = force
@@ -959,20 +999,26 @@ class ContinuousBatchingLoop:
 
     def _note_attention_bytes(self) -> None:
         """Attention-bytes-per-step gauge for the CURRENT pool contents,
-        labeled with the impl that runs — callers gate on the
-        observability flag (zero-work disabled path)."""
+        labeled with the impl that runs AND the pool's kv_dtype —
+        callers gate on the observability flag (zero-work disabled
+        path).  The byte model takes the pool's explicit dtype and KV
+        head count: GQA and int8 pools price H_q/H_kv x and itemsize/4 x
+        below the fp32 full-head default, which is the win the gauge
+        exists to make visible."""
         st = self.pool.stats()
         if not st["live_sequences"]:
             return
         maxp = self.pool.max_live_pages()
+        kv_dtype = np.dtype(self.pool.k_pages.dtype).name
         _smetrics.record_attention_bytes(
             attention_bytes_per_step(
                 self.paged_impl, st["live_sequences"], maxp,
                 self.pool.page_size, self.pool.num_heads,
                 self.pool.head_dim,
-                itemsize=np.dtype(self.pool.k_pages.dtype).itemsize,
-                num_layers=self.pool.num_layers),
-            impl=self.paged_impl)
+                num_layers=self.pool.num_layers,
+                num_kv_heads=self.pool.num_kv_heads,
+                dtype=self.pool.k_pages.dtype),
+            impl=self.paged_impl, kv_dtype=kv_dtype)
 
     def mean_occupancy(self) -> float:
         return self._occupancy_sum / self.steps if self.steps else 0.0

@@ -38,13 +38,36 @@ page, device-side content copy, table tail swap) — accounted for in the
 same atomic claim, so exhaustion still raises before any table mutates.
 Under pressure the pool calls registered reclaimers (the prefix cache's
 LRU eviction) to release cache-only pages before giving up.
+
+ISSUE 12 makes the pool HEAD-GROUPED and QUANTIZABLE:
+
+- ``num_kv_heads`` (GQA/MQA): the pool stores ``[L, H_kv, P,
+  page_size, D]`` — KV storage shrinks H_q/H_kv x, and the grouped
+  paged-attention kernel streams each page once per KV head while the
+  group's query heads share it.  ``num_heads`` keeps meaning the
+  model's QUERY heads (the attention-bytes accounting needs both).
+- ``dtype="int8"``: pages hold amax-quantized int8 K/V with one fp32
+  scale per (layer, page) for each of K and V, kept host-side in
+  ``k_scales``/``v_scales`` ([L, P] float32, 0 = no content) —
+  "alongside the page table", exactly like the table itself.
+  ``write_kv`` quantizes: per touched page the scale is the running
+  amax/127 (an amax that GROWS re-quantizes the page's existing int8
+  content under the new scale — one small functional update over the
+  touched pages only), so a page's dequantized error stays bounded by
+  half an LSB of its own largest value.  Scales travel with pages
+  through copy-on-write, defrag, and scrub; freeing a page clears its
+  scale entries (check_invariants audits exactly that: live written
+  pages have scales, freed pages must not).  ``corrupt_page`` poisons
+  the K SCALE with NaN on an int8 pool — int8 content cannot encode
+  non-finite, but a NaN scale dequantizes the whole page non-finite,
+  which is the same detectable corruption face.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -84,7 +107,7 @@ class KVCachePool:
 
     def __init__(self, num_pages: int, page_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype="float32",
-                 name: str = "kv"):
+                 name: str = "kv", num_kv_heads: Optional[int] = None):
         if num_pages < 1 or page_size < 1:
             raise ValueError("num_pages and page_size must be >= 1")
         import jax.numpy as jnp
@@ -93,11 +116,28 @@ class KVCachePool:
         self.page_size = int(page_size)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads
+                                if num_kv_heads is not None else num_heads)
+        from ..kernels.paged_attention import _group_size
+
+        _group_size(self.num_heads, self.num_kv_heads)  # typed raise
         self.head_dim = int(head_dim)
         self.name = name
-        shape = (num_layers, num_heads, num_pages, page_size, head_dim)
+        shape = (num_layers, self.num_kv_heads, num_pages, page_size,
+                 head_dim)
         self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
         self.v_pages = jnp.zeros(shape, dtype=jnp.dtype(dtype))
+        # int8 pages: one fp32 amax scale per (layer, page) for each of
+        # K and V, host-side next to the page tables (0 = no content).
+        # fp32/bf16 pools carry no scale state at all.
+        self.quantized = jnp.dtype(dtype) == jnp.dtype(jnp.int8)
+        if self.quantized:
+            self.k_scales = np.zeros((self.num_layers, self.num_pages),
+                                     np.float32)
+            self.v_scales = np.zeros((self.num_layers, self.num_pages),
+                                     np.float32)
+        else:
+            self.k_scales = self.v_scales = None
         # RLock: pressure reclaimers (prefix-cache LRU eviction) run
         # INSIDE append_tokens' critical section and call back into
         # release_pages on the same thread
@@ -138,9 +178,33 @@ class KVCachePool:
         return -(-int(tokens) // int(page_size))
 
     def bytes_per_page(self) -> int:
+        """One page's K+V bytes — the admission controller's divisor.
+        KV storage scales with num_KV_heads (the GQA shrink) at the
+        pool's REAL element size; an int8 pool adds its two fp32
+        per-layer scale entries (README "Serving" sizing math)."""
         itemsize = np.dtype(self.k_pages.dtype).itemsize
-        return (2 * self.num_layers * self.page_size * self.num_heads
-                * self.head_dim * itemsize)
+        nbytes = (2 * self.num_layers * self.page_size * self.num_kv_heads
+                  * self.head_dim * itemsize)
+        if self.quantized:
+            nbytes += 2 * self.num_layers * 4  # fp32 K + V scale / layer
+        return nbytes
+
+    def layer_scales(self, layer: int):
+        """(k_scales [P], v_scales [P]) fp32 rows for one layer of an
+        int8 pool — the dequant operands paged_decode_attention and
+        gather_kv_pages take; (None, None) for unquantized pools."""
+        if not self.quantized:
+            return None, None
+        with self._lock:
+            return self.k_scales[layer].copy(), self.v_scales[layer].copy()
+
+    def _clear_scales(self, pages: Sequence[int]) -> None:
+        """Drop freed pages' scale entries (caller holds the lock) — a
+        page on the free list must not keep a stale scale (audited)."""
+        if self.quantized and len(pages):
+            idx = np.asarray(pages, np.int32)
+            self.k_scales[:, idx] = 0.0
+            self.v_scales[:, idx] = 0.0
 
     # -- lifecycle ------------------------------------------------------
 
@@ -162,18 +226,21 @@ class KVCachePool:
         with self._lock:
             h = self._tables.pop(seq_id)
             n = 0
+            released: List[int] = []
             for p in reversed(h.pages):
                 self._ref[p] -= 1
                 if self._ref[p] <= 0:
                     self._ref[p] = 0
                     self._free.append(p)
                     self._allocator.pop(p, None)
+                    released.append(p)
                     n += 1
                 elif self._allocator.get(p) == seq_id:
                     # the charging sequence is gone but readers keep
                     # the page alive: it is now UNCHARGED (admission's
                     # uncharged_live_pages sets it aside)
                     del self._allocator[p]
+            self._clear_scales(released)
             self._stats["page_frees"] += n
         self._note_pool()
         return n
@@ -245,16 +312,20 @@ class KVCachePool:
                     n += 1
             if scrub and freed:
                 self._scrub(freed)
+            self._clear_scales(freed)
             self._stats["page_frees"] += n
         if n:
             self._note_pool()
         return n
 
     def _scrub(self, pages: Sequence[int]) -> None:
-        """Zero the K/V content of `pages` (caller holds the lock)."""
+        """Zero the K/V content of `pages` — and their quantization
+        scales, so a scrubbed page dequantizes to exactly zero (caller
+        holds the lock)."""
         idx = np.asarray(pages, np.int32)
-        self.k_pages = self.k_pages.at[:, :, idx].set(0.0)
-        self.v_pages = self.v_pages.at[:, :, idx].set(0.0)
+        self.k_pages = self.k_pages.at[:, :, idx].set(0)
+        self.v_pages = self.v_pages.at[:, :, idx].set(0)
+        self._clear_scales(pages)
 
     def scrub_seq_pages(self, seq_id: int) -> int:
         """Zero the content of a live sequence's EXCLUSIVELY-owned
@@ -401,6 +472,10 @@ class KVCachePool:
             self.k_pages[:, :, old])
         self.v_pages = self.v_pages.at[:, :, new].set(
             self.v_pages[:, :, old])
+        if self.quantized:
+            # int8 content copies verbatim, so the scales travel with it
+            self.k_scales[:, new] = self.k_scales[:, old]
+            self.v_scales[:, new] = self.v_scales[:, old]
         h.pages[-1] = new
         self._stats["page_allocs"] += 1
         self._stats["cow_copies"] += 1
@@ -411,25 +486,79 @@ class KVCachePool:
         non-finite activations, the detectable face of silent page
         corruption.  K only: a NaN key is masked out (jnp.where) for
         sequences that do not read the page, while any sequence whose
-        valid prefix includes it goes non-finite and quarantines."""
+        valid prefix includes it goes non-finite and quarantines.  An
+        int8 page cannot encode non-finite content, so the poison lands
+        on its K SCALE instead — dequantization spreads the NaN over
+        the whole page, the same detectable face."""
         with self._lock:
-            self.k_pages = self.k_pages.at[:, :, int(page)].set(
-                float("nan"))
+            if self.quantized:
+                self.k_scales[:, int(page)] = float("nan")
+            else:
+                self.k_pages = self.k_pages.at[:, :, int(page)].set(
+                    float("nan"))
 
     def write_kv(self, layer: int, pages: np.ndarray, slots: np.ndarray,
                  k, v) -> None:
-        """Write token K/V for `layer`: k/v [T, num_heads, head_dim]
+        """Write token K/V for `layer`: k/v [T, num_kv_heads, head_dim]
         into the claimed (page, slot)s (T = batch rows for one decode
         step, or a whole prompt batch's flattened tokens for prefill).
         (page, slot) pairs must be distinct — append_token/append_tokens
-        guarantee it.  Locked like every other mutation: an unlocked
-        read-modify-write of the arrays would race defrag()'s
+        guarantee it.  An int8 pool amax-quantizes on the way in (see
+        the class docstring).  Locked like every other mutation: an
+        unlocked read-modify-write of the arrays would race defrag()'s
         permutation and silently drop one side's update."""
         with self._lock:
+            if self.quantized:
+                self.k_pages = self._quantized_write(
+                    self.k_pages, self.k_scales, layer, pages, slots, k)
+                self.v_pages = self._quantized_write(
+                    self.v_pages, self.v_scales, layer, pages, slots, v)
+                return
             # non-contiguous advanced indices (slice over H between
             # them): the indexed view is [T, H, D] — k/v land as-is
             self.k_pages = self.k_pages.at[layer, :, pages, slots].set(k)
             self.v_pages = self.v_pages.at[layer, :, pages, slots].set(v)
+
+    def _quantized_write(self, arr, scales, layer, pages, slots, x):
+        """amax-quantize rows x [T, H_kv, D] into int8 page slots.  Per
+        touched page the scale is the running amax / 127: a scale that
+        GROWS re-quantizes that page's existing int8 content under the
+        new scale (one functional update over the touched pages only —
+        factor <= 1, and factor == 1 round-trips exactly), so every
+        value in a page stays within half an int8 LSB of ITS page's
+        largest magnitude.  Caller holds the lock."""
+        import jax.numpy as jnp
+
+        xh = np.asarray(x, np.float32)
+        row_amax = np.max(np.abs(xh), axis=(1, 2)) if xh.size else \
+            np.zeros((0,), np.float32)
+        upages, inv = np.unique(pages, return_inverse=True)
+        page_amax = np.zeros(len(upages), np.float32)
+        with np.errstate(invalid="ignore"):
+            # a poisoned sequence writes NaN rows: the NaN propagates
+            # into that page's scale (kept — the quarantine path scrubs
+            # the page) without warning-spamming healthy batch-mates
+            np.maximum.at(page_amax, inv, row_amax)
+            old_scale = scales[layer, upages]
+            new_scale = np.maximum(old_scale, page_amax / 127.0)
+        grow = new_scale > old_scale
+        requant = grow & (old_scale > 0)
+        if np.any(requant):
+            idx = upages[requant].astype(np.int32)
+            factor = (old_scale[requant] / new_scale[requant]).astype(
+                np.float32)
+            # [layer, :, idx] puts the advanced page index FIRST:
+            # the touched-page block is [U, H_kv, page_size, D]
+            block = arr[layer, :, idx].astype(jnp.float32)
+            arr = arr.at[layer, :, idx].set(
+                jnp.clip(jnp.round(block * factor[:, None, None, None]),
+                         -127, 127).astype(jnp.int8))
+        row_scale = new_scale[inv]
+        safe = np.where(row_scale > 0, row_scale, 1.0).astype(np.float32)
+        q = jnp.clip(jnp.round(jnp.asarray(xh) / safe[:, None, None]),
+                     -127, 127).astype(jnp.int8)
+        scales[layer, upages] = new_scale
+        return arr.at[layer, :, pages, slots].set(q)
 
     # -- read side ------------------------------------------------------
 
@@ -525,6 +654,14 @@ class KVCachePool:
         - free_list_errors: duplicate or out-of-range free entries
         - length_mismatches: sequences whose token count disagrees with
           their page count (length > capacity, or an entire spare page)
+        - scale_errors (int8 pools): a LIVE written page whose K or V
+          scale entries are INCONSISTENT across layers — some layers
+          carry one, others lost theirs, so part of the content would
+          dequantize garbage-as-zero (all-zero is legitimate: a
+          scrub_seq_pages'd live page holds zeros that dequantize to
+          exactly zero) — or a FREED page still carrying any entry (a
+          stale scale would survive onto the next owner) — always []
+          for unquantized pools
 
         Cost is O(pages + live tokens/page_size) under the pool lock —
         cheap enough for the continuous-batching loop to run every N
@@ -562,14 +699,40 @@ class KVCachePool:
                         # more owners than the refcount covers: a free
                         # would return a still-referenced page
                         double.append(p)
+            scale_bad: List[int] = []
+            if self.quantized:
+                # pages whose content was actually written: table pages
+                # the sequence's length covers, plus every externally
+                # held page (cache entries only ever pin written pages)
+                written: set = set()
+                for h in self._tables.values():
+                    covered = self.pages_needed(h.length, self.page_size)
+                    written.update(h.pages[:covered])
+                for fn in self._owner_hooks:
+                    written.update(int(p) for p in fn())
+                k_has = np.all(self.k_scales != 0, axis=0)  # [P]
+                v_has = np.all(self.v_scales != 0, axis=0)
+                k_none = np.all(self.k_scales == 0, axis=0)
+                v_none = np.all(self.v_scales == 0, axis=0)
+                for p in range(self.num_pages):
+                    if true_refs[p] == 0:
+                        if not (k_none[p] and v_none[p]):
+                            scale_bad.append(p)  # freed but scaled
+                    elif p in written and not (
+                            (k_has[p] or k_none[p])
+                            and (v_has[p] or v_none[p])):
+                        # live written, entries LOST in some layers but
+                        # not others (all-zero = scrubbed, legitimate)
+                        scale_bad.append(p)
             report = {
                 "ok": not (orphaned or double or free_errors
-                           or mismatches or ref_bad),
+                           or mismatches or ref_bad or scale_bad),
                 "orphaned_pages": orphaned,
                 "double_owned_pages": sorted(set(double)),
                 "refcount_mismatches": sorted(set(ref_bad)),
                 "free_list_errors": free_errors,
                 "length_mismatches": mismatches,
+                "scale_errors": sorted(set(scale_bad)),
                 "used_pages": self.num_pages - len(self._free),
                 "shared_pages": sum(1 for r in true_refs if r > 1),
                 "live_sequences": len(self._tables),
@@ -596,6 +759,13 @@ class KVCachePool:
             self._ref = true_refs
             for p in orphans:
                 self._allocator.pop(p, None)
+            # a reclaimed page re-enters the free list scale-less (and
+            # any freed page whose stale scale slipped through is
+            # re-trued the same way the refcounts are)
+            if self.quantized:
+                self._clear_scales(
+                    [p for p in range(self.num_pages)
+                     if true_refs[p] == 0])
             self._stats["orphans_reclaimed"] += len(orphans)
         if orphans:
             self._note_pool()
@@ -628,6 +798,10 @@ class KVCachePool:
                 perm[len(remap):] = leftover
                 self.k_pages = self.k_pages[:, :, perm]
                 self.v_pages = self.v_pages[:, :, perm]
+                if self.quantized:
+                    # scales follow their pages through the compaction
+                    self.k_scales = self.k_scales[:, perm]
+                    self.v_scales = self.v_scales[:, perm]
                 new_ref = [0] * self.num_pages
                 for old, new in remap.items():
                     new_ref[new] = self._ref[old]

@@ -21,10 +21,10 @@ Metrics:
                                                       token (labeled with
                                                       the active paged-
                                                       attention impl)
-- paddle_tpu_serving_attention_bytes_per_step gauge  {impl=} analytic HBM
-                                                      bytes the decode
-                                                      attention KV path
-                                                      moves per step
+- paddle_tpu_serving_attention_bytes_per_step gauge  {impl=,kv_dtype=}
+                                                      analytic HBM bytes the
+                                                      decode attention KV
+                                                      path moves per step
 - paddle_tpu_serving_fallback_total         counter  {kernel=} kernel
                                                       selections that fell
                                                       back off the
@@ -182,14 +182,18 @@ def record_fallback(kernel: str) -> None:
     ).inc(kernel=kernel)
 
 
-def record_attention_bytes(nbytes: int, impl: str) -> None:
+def record_attention_bytes(nbytes: int, impl: str,
+                           kv_dtype: str = "float32") -> None:
     """Analytic decode-attention KV bytes per step for the current
     batch/pool geometry (kernels.paged_attention.attention_bytes_per_step)
-    — the live counterpart of the banked AOT_COST_PAGED.json A/B."""
+    — the live counterpart of the banked AOT_COST_PAGED.json A/B.
+    ``kv_dtype`` labels the series with the POOL's element type, so an
+    int8 pool's halved stream and an fp32 pool's land on distinct
+    series instead of silently overwriting each other."""
     default_registry().gauge(
         "paddle_tpu_serving_attention_bytes_per_step",
         "analytic HBM bytes the decode attention KV path moves per step",
-    ).set(float(nbytes), impl=impl)
+    ).set(float(nbytes), impl=impl, kv_dtype=kv_dtype)
 
 
 def record_page_pool(used: int, total: int, pool: str = "kv") -> None:

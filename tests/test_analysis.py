@@ -120,6 +120,12 @@ def test_corpus_program_trips_its_detector(name):
     _skip_if_no_topology()
     builder, expected_detector = CORPUS[name]
     art = build_corpus_program(name)
+    if expected_detector is None:
+        # bytes-gated corpus entries (gqa_full_pool) are structurally
+        # healthy by design — the dedicated bytes-gate test below is
+        # their teeth; here just pin that they compile and analyze
+        assert not art.compile_error
+        return
     findings = analysis.run_detectors(art)
     hit = [f for f in findings if f.detector == expected_detector]
     assert hit, (
@@ -127,6 +133,33 @@ def test_corpus_program_trips_its_detector(name):
         f"got {[f.detector for f in findings]}")
     assert all(f.program == art.name and f.fingerprint == art.fingerprint
                for f in hit)
+
+
+def test_corpus_gqa_full_pool_trips_bytes_gate():
+    """ISSUE 12 satellite: a full-H_q pool on a GQA config must FAIL the
+    gqa_decode bytes/step tolerance rather than silently passing — the
+    corpus program carries the zoo entry's name, so the verdict lands on
+    the banked grouped baseline (the page stream is H_q/H_kv = 4x it).
+    No detector arm exists for this hazard: the bytes gate IS the
+    check."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.corpus import corpus_extra_bytes
+
+    art = build_corpus_program("gqa_full_pool")
+    assert art.name == "gqa_decode"  # deliberately the zoo entry's slot
+    extra = corpus_extra_bytes("gqa_full_pool")
+    assert extra > 0  # the analytic stream is what busts the budget
+    bad = analysis.ZooResult(
+        name=art.name, artifacts=art, findings=[],
+        bytes_per_step=art.bytes_per_step + extra, flops_per_step=0.0)
+    verdicts, failed = analysis.gate(
+        [bad], analysis.default_baseline_path())
+    assert failed
+    v = [x for x in verdicts
+         if x["metric"] == "gqa_decode_aot_bytes_per_step"]
+    assert v and v[0]["verdict"] == "fail"
+    # ~4x the banked grouped bytes: the full-head pool pays H_q/H_kv x
+    assert v[0]["current"] > 3.0 * v[0]["baseline"]
 
 
 def test_corpus_broadcast_lse_reports_materialized_bytes():
@@ -432,10 +465,17 @@ def test_gate_injected_corpus_programs_each_fail(tmp_path):
     zoo run as an UNBANKED program carrying findings — the gate must fail
     for each one."""
     base = _bank_doc(tmp_path, {
-        "a": {"findings": {}, "bytes_per_step": 100.0}})
+        "a": {"findings": {}, "bytes_per_step": 100.0},
+        "gqa_decode": {"findings": {}, "bytes_per_step": 100.0}})
     clean = _zr("a", {}, 100.0)
     for name, (_, det) in sorted(CORPUS.items()):
-        bad = _zr(f"corpus_{name}", {det: 1}, 5.0)
+        if det is None:
+            # bytes-gated corpus entry: splices in UNDER the banked zoo
+            # entry's own name and busts its bytes tolerance instead of
+            # carrying a finding (the full-H_q-pool hazard has none)
+            bad = _zr("gqa_decode", {}, 400.0)
+        else:
+            bad = _zr(f"corpus_{name}", {det: 1}, 5.0)
         _, failed = analysis.gate([clean, bad], base)
         assert failed, f"gate must trip on injected corpus {name!r}"
 
@@ -542,6 +582,45 @@ def test_full_zoo_gate_green_against_committed_baseline(capsys):
     rc = _lint_main(["--gate"])
     assert rc == 0
     capsys.readouterr()
+
+
+def test_gqa_decode_banked_ratio_and_coverage():
+    """ISSUE 12 acceptance: the banked gqa_decode entry's KV bytes/step
+    sits within 10% of H_kv/H_q x the paged_decode baseline (the
+    grouped kernel streams each page once per KV head, not per query
+    head), int8 pages price ~1/4 of that again (fp32 -> int8 elements;
+    '2x on top of bf16'), and the entry is under require_all coverage —
+    deleting it from the zoo fails the gate instead of shrinking CI."""
+    with open(analysis.default_baseline_path()) as f:
+        progs = json.load(f)["programs"]
+    assert "gqa_decode" in progs
+    cfg = progs["gqa_decode"]["config"]
+    want = cfg["kv_heads"] / cfg["heads"]  # H_kv / H_q
+    ratio = (progs["gqa_decode"]["bytes_per_step"]
+             / progs["paged_decode"]["bytes_per_step"])
+    assert abs(ratio - want) / want < 0.10, ratio
+    # the further dtype arms of the same analytic model: int8 at 1/4
+    # the fp32 stream (+ per-page scale reads), i.e. half of bf16 again
+    from paddle_tpu.kernels.paged_attention import attention_bytes_per_step
+
+    args = (4, cfg["max_pages"], cfg["page_size"], cfg["heads"],
+            cfg["head_dim"])
+    fp32 = attention_bytes_per_step("pallas", *args, num_kv_heads=2,
+                                    dtype="float32")
+    bf16 = attention_bytes_per_step("pallas", *args, num_kv_heads=2,
+                                    dtype="bfloat16")
+    i8 = attention_bytes_per_step("pallas", *args, num_kv_heads=2,
+                                  dtype="int8")
+    assert 0.24 <= i8 / fp32 <= 0.27
+    assert 0.49 <= i8 / bf16 <= 0.52
+    # require_all: a run missing the banked gqa_decode fails coverage
+    others = [_zr(n, e.get("findings", {}), e["bytes_per_step"])
+              for n, e in progs.items() if n != "gqa_decode"]
+    verdicts, failed = analysis.gate(
+        others, analysis.default_baseline_path(), require_all=True)
+    assert failed
+    assert any(v["metric"] == "gqa_decode_coverage"
+               and v["verdict"] == "fail" for v in verdicts)
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,61 @@ def test_sharded_prefill_matches_full_forward(host_devices):
         np.testing.assert_allclose(logits[i], want, atol=2e-4)
 
 
+def test_sharded_gqa_decode_token_identical_and_pool_shrinks(host_devices):
+    """ISSUE 12 on the mesh: a GQA config (H_q=8 over H_kv=4) shards
+    the pool over the KV-head axis — each device holds H_kv/n heads of
+    an already-H_kv/H_q-smaller pool — and continuous-batching decode
+    stays token-identical to the single-device oracle."""
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg(n_head=8, n_kv_head=4)
+    params = serving.init_decode_params(cfg, seed=11)
+    reqs = _ragged_requests(cfg, n=4, seed=11)
+
+    oracle_pool = KVCachePool(num_pages=64, page_size=4,
+                              num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                              head_dim=cfg.head_dim,
+                              num_kv_heads=cfg.num_kv_heads)
+    oracle = ContinuousBatchingLoop(params, cfg, oracle_pool, max_batch=3)
+    want = oracle.run([DecodeRequest(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+
+    prog = ShardedDecodeProgram(params, cfg, devices=devs)
+    pool = prog.make_pool(num_pages=64, page_size=4)
+    # the GQA shrink shows in the pool shape: H_kv heads, not H_q
+    assert pool.k_pages.shape[1] == cfg.num_kv_heads
+    assert pool.heads_per_shard == cfg.num_kv_heads // N_SHARDS
+    half = KVCachePool(num_pages=64, page_size=4,
+                       num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                       head_dim=cfg.head_dim)
+    assert pool.bytes_per_page() == half.bytes_per_page() // 2
+    loop = ContinuousBatchingLoop(None, None, pool, max_batch=3,
+                                  program=prog)
+    got = loop.run(reqs)
+    for w, g in zip(want, got):
+        assert g.error is None and g.tokens == w.tokens
+        np.testing.assert_allclose(
+            np.stack(g.logits), np.stack(w.logits), atol=2e-4)
+    assert pool.stats()["used_pages"] == 0
+    assert pool.check_invariants()["ok"]
+
+
+def test_sharded_gqa_and_int8_validation(host_devices):
+    """KV-head divisibility is validated loudly, and int8 pages are
+    rejected on the mesh (the SPMD step writes K/V device-side where
+    the host scale bookkeeping cannot reach)."""
+    devs = host_devices(N_SHARDS)
+    cfg = _cfg(n_head=8, n_kv_head=2)  # 2 KV heads cannot split 4 ways
+    params = serving.init_decode_params(cfg, seed=0)
+    with pytest.raises(ValueError, match="n_kv_head"):
+        ShardedDecodeProgram(params, cfg, devices=devs)
+    ok = _cfg(n_head=8, n_kv_head=4)
+    prog = ShardedDecodeProgram(serving.init_decode_params(ok, seed=0),
+                                ok, devices=devs)
+    with pytest.raises(ValueError, match="int8"):
+        prog.make_pool(num_pages=8, page_size=4, dtype="int8")
+
+
 def test_sharded_decode_quarantine_keeps_pool_leak_free(host_devices):
     """A NaN-poisoned sequence under the SPMD program quarantines alone
     — batch-mates finish, pages all return (the loop's fault isolation

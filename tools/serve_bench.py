@@ -28,7 +28,13 @@ a batching server — latency percentiles, throughput, and batch occupancy
   shared-prefix capacity regressions fail CI like latency ones.
   --prefill-chunk N caps prefill tokens per engine step (chunked
   prefill); max_prefill_tokens_step in the report counter-asserts the
-  cap, so banking it holds the TTFT-jitter discipline.
+  cap, so banking it holds the TTFT-jitter discipline.  --kv-heads K
+  serves a grouped-query (GQA/MQA) model from a K-head pool and
+  --kv-dtype {fp32,bf16,int8} picks the page element type (int8 =
+  amax-quantized pages with per-page fp32 scales); both land in the
+  result next to kv_bytes_per_token (bytes one token's K/V occupies,
+  scale overhead amortized in), so the H_q/H_kv x and 2x capacity wins
+  bank and gate like every other metric.
 
   router mode (--replicas N, engine-mode option): N Engine replicas of
   the same artifact behind one distributed.Router; the Poisson replay
@@ -378,13 +384,18 @@ def run_router_bench(args) -> dict:
     return result
 
 
+_KV_DTYPES = {"fp32": "float32", "bf16": "bfloat16", "int8": "int8"}
+
+
 def run_decode_bench(args) -> dict:
     from paddle_tpu import serving
 
+    kv_dtype = _KV_DTYPES[args.kv_dtype]
     cfg = serving.DecodeConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_head=args.n_head,
         n_layer=args.n_layer, d_inner=args.d_model * 2,
-        max_length=args.max_len)
+        max_length=args.max_len,
+        n_kv_head=args.kv_heads or None)
     params = serving.init_decode_params(cfg, seed=args.seed)
     rng = np.random.RandomState(args.seed)
     program = None
@@ -394,12 +405,14 @@ def run_decode_bench(args) -> dict:
         program = ShardedDecodeProgram(
             params, cfg, n_shards=args.mesh, paged_impl=args.paged_impl)
         pool = program.make_pool(num_pages=args.pages,
-                                 page_size=args.page_size)
+                                 page_size=args.page_size,
+                                 dtype=kv_dtype)
     else:
         pool = serving.KVCachePool(
             num_pages=args.pages, page_size=args.page_size,
             num_layers=cfg.n_layer, num_heads=cfg.n_head,
-            head_dim=cfg.head_dim)
+            head_dim=cfg.head_dim, num_kv_heads=cfg.num_kv_heads,
+            dtype=kv_dtype)
     plo, phi = (int(p) for p in args.prompt_range.split(","))
     phi = min(phi, args.max_len - args.max_new)
     # --prefix-share P: that fraction of requests opens with one common
@@ -464,6 +477,13 @@ def run_decode_bench(args) -> dict:
         "paged_impl": loop.paged_impl,  # the impl that actually ran
         "prefill": loop.prefill,
         "prefill_chunk": args.prefill_chunk,
+        # the KV capacity knobs (ISSUE 12) and their banked win:
+        # bytes ONE token's K/V occupies across all layers — H_kv
+        # heads at the pool dtype plus the amortized per-page scale
+        # overhead, i.e. bytes_per_page / page_size
+        "kv_heads": cfg.num_kv_heads,
+        "kv_dtype": args.kv_dtype,
+        "kv_bytes_per_token": pool.bytes_per_page() / pool.page_size,
         "sequences": args.sequences,
         "steps": loop.steps,
         "prefill_steps": loop.prefill_steps,
@@ -590,6 +610,16 @@ def main(argv=None) -> int:
                          "step (FLAGS_serving_prefill_chunk; 0 = "
                          "uncapped); max_prefill_tokens_step in the "
                          "report counter-asserts it")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="decode mode: KV heads for a grouped-query "
+                         "(GQA/MQA) pool — must divide --n-head; 0 = "
+                         "n-head (no grouping).  Lands in the result as "
+                         "kv_heads next to kv_bytes_per_token")
+    ap.add_argument("--kv-dtype", default="fp32",
+                    choices=tuple(_KV_DTYPES),
+                    help="decode mode: KV page element type; int8 "
+                         "stores amax-quantized pages with per-page "
+                         "fp32 scales (single-device pools only)")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
@@ -632,6 +662,28 @@ def main(argv=None) -> int:
         sys.stderr.write(
             "serve_bench: --prefix-share/--prefix-cache/--prefill-chunk "
             "need --mode decode\n")
+        return 2
+    if (args.kv_heads or args.kv_dtype != "fp32") \
+            and args.mode != "decode":
+        sys.stderr.write(
+            "serve_bench: --kv-heads/--kv-dtype need --mode decode\n")
+        return 2
+    if args.kv_heads and (args.kv_heads < 1
+                          or args.n_head % args.kv_heads):
+        sys.stderr.write(
+            f"serve_bench: --kv-heads {args.kv_heads} must be a "
+            f"positive divisor of --n-head {args.n_head}\n")
+        return 2
+    if args.kv_dtype == "int8" and args.mesh > 1:
+        sys.stderr.write(
+            "serve_bench: int8 KV pages are single-device only (the "
+            "sharded pool rejects them) — drop --mesh or --kv-dtype\n")
+        return 2
+    if args.mesh > 1 and (args.kv_heads or args.n_head) % args.mesh:
+        sys.stderr.write(
+            f"serve_bench: --kv-heads {args.kv_heads or args.n_head} "
+            f"must divide by --mesh {args.mesh} — the sharded pool "
+            "splits over the KV-head axis\n")
         return 2
     if not 0.0 <= args.prefix_share <= 1.0:
         sys.stderr.write("serve_bench: --prefix-share must be in [0, 1]\n")
