@@ -158,6 +158,23 @@ def _host_callback() -> ProgramArtifacts:
         name="corpus_host_callback")
 
 
+def _spec_verify_gather() -> ProgramArtifacts:
+    """The speculative-verify regression the spec_verify zoo entry
+    gates on: a multi-token verify step that re-materializes the full
+    contiguous [B, H, S, D] KV gather (reference tier — gather + group
+    broadcast + dense attention) instead of streaming pages through
+    the q_lengths kernel.  Structurally healthy, so no detector flags
+    it — it must trip the BYTES tolerance: the artifact shares the zoo
+    entry's capture (and name) via ``zoo.capture_spec_verify``, so
+    ``lint_programs --inject spec_verify_gather --gate`` prices it
+    against the banked page-stream baseline and exits 3.  Its traffic
+    is fully XLA-visible (that IS the hazard), so it carries no
+    analytic correction."""
+    from .zoo import capture_spec_verify
+
+    return capture_spec_verify(gather=True)
+
+
 def _gqa_full_pool() -> ProgramArtifacts:
     """The GQA regression the gqa_decode zoo entry gates on: a model
     configured for grouped KV heads served from a FULL H_q pool (the
@@ -196,6 +213,7 @@ CORPUS = {
     "all_gather_replicated": (_all_gather_replicated,
                               "collective-placement"),
     "gqa_full_pool": (_gqa_full_pool, None),
+    "spec_verify_gather": (_spec_verify_gather, None),
 }
 
 # corpus programs whose hazard prices in the analytic page-stream

@@ -26,6 +26,16 @@ host):
                      paged_decode baseline (tests assert within 10%),
                      and int8 pages halve it again (priced analytically
                      in the same test)
+  spec_verify        the gqa_decode geometry fed Sq = 1+4 query rows
+                     per sequence (ISSUE 13 speculative multi-token
+                     verify, ragged q_lengths scalar-prefetched): the
+                     page walk is UNCHANGED, so banked bytes/step at
+                     d=4 must stay well under 2x the d=0 gqa_decode
+                     step — >= 2x effective bytes-per-token reduction
+                     at full acceptance (tests assert it), with a
+                     known-bad corpus arm (spec_verify_gather) proving
+                     the full-gather re-materialization trips the
+                     bytes gate
   prefix_decode      the same decode step under 8-way prefix sharing
                      (ISSUE 11): every sequence's page table walks ONE
                      refcounted shared 28-page prefix plus a private
@@ -217,6 +227,72 @@ def _build_gqa_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, gqa_decode_stream_bytes(g["kv_heads"]), cfg
 
 
+# the spec_verify geometry: the gqa_decode shape fed Sq = 1+d query
+# rows per sequence (the speculative multi-token verify step, ISSUE
+# 13) with ragged q_lengths.  The whole point of banking it: the KV
+# page stream is INVARIANT in d — verify bytes/step at d=4 must stay
+# well under 2x the d=0 gqa_decode step (tests assert it), i.e. >= 2x
+# effective bytes-per-token reduction at full acceptance.  ONE source
+# of truth with the known-bad corpus arm (spec_verify_gather): the
+# same geometry through the full [B,H,S,D] gather re-materialization
+# prices far above the banked stream and must trip the bytes gate.
+SPEC_VERIFY_Q_TOKENS = 5  # 1 + d at the banked draft depth d=4
+
+
+def capture_spec_verify(gather: bool) -> ProgramArtifacts:
+    """Capture the spec_verify program — ``gather=False`` is the zoo
+    entry (pallas multi-token page walk, q_lengths scalar-prefetched);
+    ``gather=True`` is the known-bad arm: the SAME verify contract
+    re-materializing the contiguous [B, H, S, D] gather (the reference
+    tier) instead of streaming pages.  Both artifacts carry the zoo
+    entry's name so they gate against the same banked baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.paged_attention import paged_decode_attention
+
+    g = GQA_DECODE_GEOM
+    B, Hq, Hkv, D, ps, maxp = (g["batch"], g["heads"], g["kv_heads"],
+                               g["head_dim"], g["page_size"],
+                               g["max_pages"])
+    Sq = SPEC_VERIFY_Q_TOKENS
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, Hq, Sq, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((Hkv, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    impl = "reference" if gather else "pallas"
+    # the serving step immediately folds the attention output into the
+    # [rows, d_model] matmul operand; capturing that consumer shape
+    # keeps the program boundary honest — a bare [B,H,Sq,D] output
+    # would add an entry-layout relayout copy no real caller pays
+    return capture_fn(
+        lambda q, k, v, t, l, ql: paged_decode_attention(
+            q, k, v, t, l, impl=impl,
+            q_lengths=ql).reshape(B * Hq * Sq, D),
+        q, kp, kp, tb, ln, ln, name="spec_verify")
+
+
+def spec_verify_stream_bytes() -> float:
+    """The analytic page-stream correction for the pallas spec_verify
+    arm — the gqa_decode stream plus the q_tokens query/output term,
+    the ONLY part that grows with d."""
+    from ..kernels.paged_attention import attention_bytes_per_step
+
+    g = GQA_DECODE_GEOM
+    return float(attention_bytes_per_step(
+        "pallas", g["batch"], g["max_pages"], g["page_size"],
+        g["heads"], g["head_dim"], num_kv_heads=g["kv_heads"],
+        q_tokens=SPEC_VERIFY_Q_TOKENS))
+
+
+def _build_spec_verify() -> Tuple[ProgramArtifacts, float, Dict]:
+    art = capture_spec_verify(gather=False)
+    cfg = dict(GQA_DECODE_GEOM, q_tokens=SPEC_VERIFY_Q_TOKENS,
+               impl="pallas")
+    return art, spec_verify_stream_bytes(), cfg
+
+
 def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     import jax
     import jax.numpy as jnp
@@ -317,6 +393,7 @@ ZOO = {
     "transformer_train": _build_transformer,
     "paged_decode": _build_paged_decode,
     "gqa_decode": _build_gqa_decode,
+    "spec_verify": _build_spec_verify,
     "prefix_decode": _build_prefix_decode,
     "sharded_decode": _build_sharded_decode,
 }

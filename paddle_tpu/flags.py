@@ -144,6 +144,15 @@ _DEFS: Dict[str, Any] = {
     # sequence's next token can stall behind someone else's prefill
     # (the TTFT/inter-token-jitter knob for bursty shared-prefix load)
     "FLAGS_serving_prefill_chunk": 0,
+    # speculative decoding (serving/generate.py + serving/speculative.py):
+    # draft tokens per generating sequence per decode step, proposed by
+    # the prompt-lookup drafter (n-gram match against prompt +
+    # generation history — no draft model) and verified in ONE
+    # multi-token model step through the paged kernel; rejected tokens
+    # roll back via KVCachePool.truncate_seq.  0 (default) disables.
+    # Greedy output stays token-identical to full_decode; sequences
+    # with non-greedy SamplingParams degrade to 0 per-sequence
+    "FLAGS_serving_speculate": 0,
     # serving circuit breaker (serving/engine.py): after
     # serving_breaker_threshold CONSECUTIVE batch-dispatch failures the
     # engine opens its breaker — submit() fails fast with

@@ -70,6 +70,27 @@ exactly (page_size, head_dim) — Mosaic-tileable without relayout.  The
 decode query rides as a [B, H_kv, G_pad, D] block (the group's rows
 zero-padded to a whole fp32 sublane; padded rows compute discarded
 lanes) for the same reason.
+
+MULTI-TOKEN VERIFY (ISSUE 13 — speculative decoding).  The decode
+query generalizes to ``Sq = 1 + d`` rows per sequence: the last
+committed token plus ``d`` drafted continuation tokens, verified in ONE
+step.  ``q_lengths`` ([B] int32, ragged — sequences in the same batch
+may carry different draft depths) joins ``lengths`` as one more
+scalar-prefetch operand, and query row ``t`` of sequence ``b`` sits at
+absolute position ``lengths[b] - q_lengths[b] + t`` — the causal
+frontier INSIDE the draft block, masked in-kernel exactly like the
+ragged tail.  The payoff is the whole point of speculation: the page
+walk is UNCHANGED — each live KV page still streams from HBM exactly
+once per (sequence, KV head) regardless of d — so verify-step KV bytes
+are flat in d while the step commits up to d+1 tokens
+(``attention_bytes_per_step(q_tokens=)`` prices it; the only term that
+grows is the query/output block).  Query rows ride the same padded
+sublane block as the GQA group, GROUP-MAJOR: row ``g * Sq + t`` is
+(group member g, draft token t) — the layout that folds and unfolds as
+pure reshapes, so no relayout copy brackets the custom call — padded
+to a whole sublane, per-row online-softmax state, sliced off
+host-side.  ``Sq == 1`` keeps the exact pre-ISSUE-13 kernel (no
+q_lengths operand), so the banked zoo entries are byte-identical.
 """
 
 from __future__ import annotations
@@ -240,7 +261,7 @@ def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
                              page_size: int, num_heads: int, head_dim: int,
                              itemsize: int = 4, num_layers: int = 1,
                              num_kv_heads: int | None = None,
-                             dtype=None) -> int:
+                             dtype=None, q_tokens: int = 1) -> int:
     """Analytic HBM bytes one decode step moves through the attention
     KV path (the serving metrics gauge; the chip-less cost tier banks
     the compiler-measured counterpart in AOT_COST_ZOO.json).
@@ -269,7 +290,13 @@ def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
       itemsize, K and V — E_kv always, that IS the win.
 
     Query/output terms (batch*heads*head_dim) are negligible at decode
-    shapes and excluded."""
+    shapes and excluded — EXCEPT for a multi-token verify step
+    (``q_tokens = 1 + d`` > 1, ISSUE 13), where they are the ONLY term
+    that grows with the draft depth and are priced explicitly: the KV
+    page stream is INVARIANT in q_tokens (each live page reads once per
+    sequence either way), which is exactly the amortization speculative
+    decoding banks — bytes/step at d=4 stays ~1x the d=0 step while the
+    step can commit 5 tokens."""
     import numpy as np
 
     h_kv = num_kv_heads if num_kv_heads is not None else num_heads
@@ -292,11 +319,17 @@ def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
     if quantized:
         # one fp32 K scale + one fp32 V scale per page walked
         per_layer += 2 * batch * max_pages * 4
+    if int(q_tokens) > 1:
+        # the verify step's query read + output write — the only term
+        # scaling with the draft depth (kept at 0 extra for q_tokens=1
+        # so the banked single-token entries stay byte-identical)
+        per_layer += (2 * batch * int(q_tokens) * num_heads * head_dim
+                      * compute_itemsize)
     return per_layer * int(num_layers)
 
 
 def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
-                  quantized):
+                  quantized, sq, group):
     """Grid (B, H_kv, max_pages); pages innermost so the online-softmax
     state for one (sequence, KV head) lives in VMEM scratch across the
     page walk.  tables_ref/lengths_ref are SMEM scalar-prefetch refs:
@@ -307,12 +340,20 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
     into the stream).  The query block rows are the KV head's QUERY
     GROUP (G heads + padding): the m/l/acc recurrence is per row, so
     every group member keeps its own softmax state while sharing the
-    one streamed page.  Page table rows are zero-padded — the dummy
+    one streamed page.  With ``sq > 1`` (multi-token speculative
+    verify) the rows are the whole draft block — row ``g * sq + t``
+    is (group member g, draft token t), group-major — and one more
+    prefetched SMEM operand, the ragged per-sequence ``q_lengths``,
+    sets each row's causal frontier: query token t sits at absolute
+    position ``lengths[b] - q_lengths[b] + t``, so keys past it mask
+    exactly like the ragged tail.  Page table rows are zero-padded — the dummy
     page-0 reads those DMAs issue are fully masked by position >=
     length, exactly the flash fully-masked-block contract (m floor
     NEG_INF/2, p underflows to 0, l stays 0)."""
     import jax.experimental.pallas as pl
 
+    refs = list(refs)
+    q_lens_ref = refs.pop(0) if sq > 1 else None
     if quantized:
         k_scales_ref, v_scales_ref, q_ref, k_ref, v_ref, o_ref, \
             m_scr, l_scr, acc_scr = refs
@@ -329,7 +370,7 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]  # [G_pad, D] — the KV head's query group
+    q = q_ref[0, 0]  # [rows_pad, D] — the KV head's query group/block
     k = k_ref[0, 0]  # [page_size, D]
     v = v_ref[0, 0]
     if quantized:
@@ -338,7 +379,19 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
         v = v.astype(jnp.float32) * v_scales_ref[page]
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(pos < lengths_ref[b], s, NEG_INF)
+    if sq > 1:
+        # per-row causal frontier: rows are GROUP-MAJOR (row g*sq + t
+        # is group member g, draft token t — the layout that makes the
+        # host fold/unfold pure reshapes), so row r verifies token
+        # r % sq at absolute position q_start + r % sq (padding rows
+        # mask conservatively and are sliced off host-side); the
+        # < lengths term still hides the table tail
+        t_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % sq
+        q_start = lengths_ref[b] - q_lens_ref[b]
+        s = jnp.where((pos <= q_start + t_row) & (pos < lengths_ref[b]),
+                      s, NEG_INF)
+    else:
+        s = jnp.where(pos < lengths_ref[b], s, NEG_INF)
 
     m_prev = m_scr[:]  # [G_pad, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -356,11 +409,15 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
 
 
 @functools.lru_cache(maxsize=128)
-def _paged_call(batch, kv_heads, g_pad, max_pages, page_size, head_dim,
-                scale, kv_dtype, interpret, quantized):
+def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
+                scale, kv_dtype, interpret, quantized, sq, group):
     """Memoized pallas_call — one traced callable per static config, so
     every decode layer/step of a model reuses ONE kernel payload (the
-    flash_attention._fwd_call compile-cache contract)."""
+    flash_attention._fwd_call compile-cache contract).  ``sq`` is the
+    (padded-max) query tokens per sequence — 1 for plain decode, 1+d
+    for a speculative verify step, which adds the ragged ``q_lengths``
+    scalar-prefetch operand; ``rows_pad`` is sq*group rounded up to a
+    whole sublane."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -368,15 +425,19 @@ def _paged_call(batch, kv_heads, g_pad, max_pages, page_size, head_dim,
     # the dequantized (and padded-query) compute runs in fp32; an
     # unquantized pool computes/outputs in its own dtype as before
     out_dt = jnp.float32 if quantized else dt
-    n_prefetch = 4 if quantized else 2
-    # index maps see every scalar-prefetch operand after the grid ids
-    pad = (lambda f: (lambda b, h, p, t, l, ks, vs: f(b, h, p, t, l))) \
-        if quantized else (lambda f: f)
+    multi = sq > 1
+    n_prefetch = 2 + (1 if multi else 0) + (2 if quantized else 0)
+    # index maps see every scalar-prefetch operand after the grid ids;
+    # only tables/lengths matter to them — swallow the rest
+    if n_prefetch == 2:
+        pad = lambda f: f
+    else:
+        pad = lambda f: (lambda b, h, p, t, l, *rest: f(b, h, p, t, l))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_prefetch,
         grid=(batch, kv_heads, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, g_pad, head_dim),
+            pl.BlockSpec((1, 1, rows_pad, head_dim),
                          pad(lambda b, h, p, tables, lengths: (b, h, 0, 0))),
             # the page walk: the SMEM table entry picks which pool page
             # the next grid step DMAs — no gather ever materializes
@@ -388,74 +449,103 @@ def _paged_call(batch, kv_heads, g_pad, max_pages, page_size, head_dim,
                              (h, tables[b, p], 0, 0))),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, g_pad, head_dim),
+            (1, 1, rows_pad, head_dim),
             pad(lambda b, h, p, tables, lengths: (b, h, 0, 0))),
         scratch_shapes=[
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, 1), jnp.float32),
-            pltpu.VMEM((g_pad, head_dim), jnp.float32),
+            pltpu.VMEM((rows_pad, 1), jnp.float32),
+            pltpu.VMEM((rows_pad, 1), jnp.float32),
+            pltpu.VMEM((rows_pad, head_dim), jnp.float32),
         ],
     )
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, page_size=page_size,
-                          quantized=quantized),
+                          quantized=quantized, sq=sq, group=group),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (batch, kv_heads, g_pad, head_dim), out_dt),
+            (batch, kv_heads, rows_pad, head_dim), out_dt),
         interpret=interpret,
     )
 
 
 def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
-                  interpret=False, k_scales=None, v_scales=None):
-    B, Hq, _, D = q.shape
+                  interpret=False, k_scales=None, v_scales=None,
+                  q_lengths=None):
+    B, Hq, Sq, D = q.shape
     Hkv, _, page_size, _ = k_pages.shape
     G = Hq // Hkv
-    g_pad = -(-G // _SQ_PAD) * _SQ_PAD
+    rows = Sq * G
+    rows_pad = -(-rows // _SQ_PAD) * _SQ_PAD
     quantized = k_scales is not None
     tables = jnp.asarray(page_tables, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
-    # fold query heads onto their KV head: row g of group h_kv is query
-    # head h_kv * G + g — the same order the output unfolds below
-    qg = q[:, :, 0, :].reshape(B, Hkv, G, D)
-    qg = qg.astype(jnp.float32 if quantized else k_pages.dtype)
-    qp = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - G), (0, 0)))
-    call = _paged_call(B, Hkv, g_pad, tables.shape[1], page_size, D,
-                       float(scale), str(k_pages.dtype), interpret,
-                       quantized)
-    if quantized:
-        out = call(tables, lengths,
-                   jnp.asarray(k_scales, jnp.float32),
-                   jnp.asarray(v_scales, jnp.float32),
-                   qp, k_pages, v_pages)
+    if Sq > 1:
+        # fold (group member, token) onto the KV head GROUP-MAJOR: row
+        # g*Sq + t is (query head h_kv*G + g, draft token t) — a pure
+        # reshape both ways (no transpose, no relayout copy around the
+        # custom call), matching the kernel's r % sq frontier
+        qg = q.reshape(B, Hkv, rows, D)
     else:
-        out = call(tables, lengths, qp, k_pages, v_pages)
-    return out[:, :, :G, :].reshape(B, Hq, 1, D).astype(q.dtype)
+        # row g of group h_kv is query head h_kv * G + g
+        qg = q[:, :, 0, :].reshape(B, Hkv, G, D)
+    qg = qg.astype(jnp.float32 if quantized else k_pages.dtype)
+    qp = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_pad - rows), (0, 0)))
+    call = _paged_call(B, Hkv, rows_pad, tables.shape[1], page_size, D,
+                       float(scale), str(k_pages.dtype), interpret,
+                       quantized, Sq, G)
+    args = [tables, lengths]
+    if Sq > 1:
+        ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
+              else jnp.asarray(q_lengths, jnp.int32))
+        args.append(ql)
+    if quantized:
+        args += [jnp.asarray(k_scales, jnp.float32),
+                 jnp.asarray(v_scales, jnp.float32)]
+    out = call(*args, qp, k_pages, v_pages)
+    out = out[:, :, :rows, :].reshape(B, Hq, Sq, D)
+    return out.astype(q.dtype)
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
                            scale=None, impl: str | None = None,
                            force: str = "auto", k_scales=None,
-                           v_scales=None):
-    """q: [B, H_q, 1, D] decode queries; k_pages/v_pages: [H_kv, P,
-    page_size, D] one layer of the pool (H_kv <= H_q for GQA/MQA —
-    query head h reads KV head h // (H_q/H_kv); H_q % H_kv != 0 raises
-    :class:`GroupedHeadsError`); page_tables: [B, max_pages] int32;
-    lengths: [B] valid token counts (the new token already appended).
+                           v_scales=None, q_lengths=None):
+    """q: [B, H_q, Sq, D] decode queries — Sq=1 for plain decode, Sq =
+    1+d for a speculative multi-token verify step (the last committed
+    token plus d drafted continuations, ISSUE 13); k_pages/v_pages:
+    [H_kv, P, page_size, D] one layer of the pool (H_kv <= H_q for
+    GQA/MQA — query head h reads KV head h // (H_q/H_kv); H_q % H_kv
+    != 0 raises :class:`GroupedHeadsError`); page_tables: [B,
+    max_pages] int32; lengths: [B] valid token counts (the fed block
+    already appended).
+
+    ``q_lengths`` ([B] int32, Sq > 1 only; None means every sequence
+    fed the full Sq rows): ragged valid query rows per sequence —
+    query row t of sequence b sits at absolute position ``lengths[b] -
+    q_lengths[b] + t`` and is causal-masked there, INSIDE the draft
+    block.  Rows past ``q_lengths[b]`` compute garbage the caller must
+    ignore (the serving loop pads ragged draft blocks to the batch
+    max).
 
     ``k_scales``/``v_scales`` ([P] fp32, required together): the
     layer's per-page quantization scales for an int8 pool — dequant is
     fused into the pallas page stream and into the reference gather.
 
-    Returns [B, H_q, 1, D].  Causality is implied: the single query IS
-    the last valid position, so masking keys at >= lengths is exactly
-    the causal frontier.
+    Returns [B, H_q, Sq, D].  For Sq=1 causality is implied: the
+    single query IS the last valid position, so masking keys at >=
+    lengths is exactly the causal frontier.
 
     `impl`: None reads FLAGS_serving_paged_impl; see resolve_paged_impl
     for the auto/envelope/fallback contract.  `force` forwards to
-    flash_attention (reference impl only)."""
-    if q.ndim != 4 or q.shape[2] != 1:
-        raise ValueError(f"decode query must be [B, H, 1, D], got {q.shape}")
+    flash_attention (single-token reference impl only)."""
+    if q.ndim != 4:
+        raise ValueError(f"decode query must be [B, H, Sq, D], got {q.shape}")
+    Sq = q.shape[2]
+    if Sq < 1:
+        raise ValueError(f"decode query must carry >= 1 token, got {q.shape}")
+    if Sq == 1 and q_lengths is not None:
+        raise ValueError(
+            "q_lengths is the multi-token verify contract — a single-"
+            "token decode step has nothing ragged to mask")
     G = _group_size(q.shape[1], k_pages.shape[0])
     if (k_scales is None) != (v_scales is None):
         raise ValueError("k_scales and v_scales must be passed together")
@@ -470,7 +560,8 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
     if impl in ("pallas", "interpret"):
         return _pallas_paged(q, k_pages, v_pages, page_tables, lengths,
                              scale, interpret=(impl == "interpret"),
-                             k_scales=k_scales, v_scales=v_scales)
+                             k_scales=k_scales, v_scales=v_scales,
+                             q_lengths=q_lengths)
     # dequantized pools gather straight to fp32; bf16/fp32 pools pass
     # through at the POOL dtype (no widening copy — the byte model
     # prices the copy terms at the pool itemsize)
@@ -479,5 +570,42 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
     # the reference arm materializes the group broadcast the pallas
     # kernel never pays for (attention_bytes_per_step charges it)
     k, v = repeat_kv(k, v, G)
-    return flash_attention(q, k, v, causal=False, scale=scale,
-                           k_lengths=lengths, force=force)
+    if Sq == 1:
+        return flash_attention(q, k, v, causal=False, scale=scale,
+                               k_lengths=lengths, force=force)
+    return _reference_verify(q, k, v, lengths, q_lengths, scale)
+
+
+@functools.lru_cache(maxsize=1)
+def _verify_jit():
+    """One jitted dense-verify body (compiled per input-shape set, like
+    every other step kernel) — the eager op-by-op chain recompiled its
+    tiny executables every step, which dominated verify wall time."""
+    def body(q, k, v, ln, ql, *, scale):
+        Sq, S = q.shape[2], k.shape[2]
+        pos_q = (ln - ql)[:, None] \
+            + jnp.arange(Sq, dtype=jnp.int32)[None, :]
+        key_j = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+        mask = (key_j <= pos_q[:, :, None]) & (key_j < ln[:, None, None])
+        scores = jnp.einsum("bhtd,bhjd->bhtj", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        scores = jnp.where(mask[:, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhtj,bhjd->bhtd", w, v.astype(jnp.float32))
+
+    return jax.jit(body, static_argnames=("scale",))
+
+
+def _reference_verify(q, k, v, lengths, q_lengths, scale):
+    """Multi-token reference arm: dense attention over the gathered
+    [B, H_q, S, D] view with the per-row draft-block causal mask — key
+    j visible to query row t of sequence b iff ``j <= lengths[b] -
+    q_lengths[b] + t`` and ``j < lengths[b]`` (the jnp.where also
+    neutralizes NaN scores from padding pages, the chunk_prefill_step
+    contract)."""
+    B, _, Sq, _ = q.shape
+    ln = jnp.asarray(lengths, jnp.int32)
+    ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
+          else jnp.asarray(q_lengths, jnp.int32))
+    out = _verify_jit()(q, k, v, ln, ql, scale=float(scale))
+    return out.astype(q.dtype)

@@ -31,6 +31,24 @@ loop):
   workloads; admitted prompts prefill in ONE whole-prompt causal pass
   (``prefill_step``; ``prefill="token"`` keeps the step-per-token arm);
   ``full_decode`` is the full-recompute parity oracle.
+- **Speculative decoding** (speculative.py + generate.verify_step,
+  ISSUE 13) — draft-model-free speculation:
+  ``ContinuousBatchingLoop(speculate=d)`` has a prompt-lookup drafter
+  (n-gram match over prompt + generation history; no second model, no
+  extra HBM) propose up to d continuation tokens per greedy sequence,
+  verified in ONE Sq=1+d model step through the paged kernel's ragged
+  ``q_lengths`` arm (each live KV page still streams once — bytes/step
+  is flat in d); acceptance is longest-prefix-match against the
+  model's own argmax (greedy output stays token-identical to
+  ``full_decode``), rejected tokens roll back via the atomic
+  ``KVCachePool.truncate_seq`` (refcount/CoW/int8-scale aware).
+- **Sampling contract** (sampling.py, ISSUE 13) —
+  ``DecodeRequest.sampling = SamplingParams(...)`` (threaded from
+  ``Engine.submit(sampling=)`` in pass-through mode):
+  temperature/top-k/top-p through one jitted epilogue per step, logit
+  bias (greedy included), stop sequences, per-request max_new;
+  non-greedy sequences auto-degrade speculation to d=0 while greedy
+  batch-mates keep drafting.
 - **Prefix cache** (prefixcache.py, ISSUE 11) — refcounted
   copy-on-write page sharing over the pool: prompts are trie-keyed by
   a rolling prefix hash at page granularity, a hit attaches cached
@@ -100,9 +118,12 @@ from .generate import (
     full_forward,
     init_decode_params,
     prefill_step,
+    verify_step,
 )
 from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
 from .prefixcache import PrefixCache, PrefixMatch
+from .sampling import SamplingParams
+from .speculative import PromptLookupDrafter
 from . import distributed  # noqa: F401 — serving.distributed is API
 
 __all__ = [
@@ -123,12 +144,15 @@ __all__ = [
     "PagePoolExhausted",
     "PrefixCache",
     "PrefixMatch",
+    "PromptLookupDrafter",
     "QueueFullError",
     "RequestTimeoutError",
+    "SamplingParams",
     "SequenceHandle",
     "full_decode",
     "full_forward",
     "init_decode_params",
     "parse_buckets",
     "prefill_step",
+    "verify_step",
 ]

@@ -377,7 +377,8 @@ class Engine:
 
     def submit(self, feed: Dict[str, Any],
                timeout: Optional[float] = None,
-               call_kwargs: Optional[Dict[str, Any]] = None) -> Future:
+               call_kwargs: Optional[Dict[str, Any]] = None,
+               sampling=None) -> Future:
         """Enqueue one request; returns a Future resolving to the list of
         per-fetch numpy arrays (this request's rows only).
 
@@ -385,7 +386,10 @@ class Engine:
         config.default_timeout_s.  call_kwargs forwards extra backend
         keyword args and is only legal in pass-through mode (a padded
         batch serves many requests — per-request backend options cannot
-        apply).
+        apply).  sampling: a serving.SamplingParams threaded to the
+        backend the same way (pass-through only — it is a PER-REQUEST
+        contract; a decode-style backend receives it as the `sampling`
+        call kwarg and hands it to DecodeRequest.sampling).
 
         With FLAGS_observability on, the returned Future carries a
         fresh `trace_id` (also attached to every typed error this
@@ -396,6 +400,14 @@ class Engine:
         FLAGS_request_trace_budget).  Off, `fut.trace_id` is None and
         nothing from the observability package runs or allocates."""
         obs_on = _flags._VALUES["FLAGS_observability"]
+        if sampling is not None:
+            from .sampling import SamplingParams
+
+            if not isinstance(sampling, SamplingParams):
+                raise TypeError(
+                    f"sampling must be a serving.SamplingParams, got "
+                    f"{type(sampling).__name__}")
+            call_kwargs = dict(call_kwargs or {}, sampling=sampling)
         fut: Future = Future()
         fut.trace_id = None
         feed_names = self.backend.feed_names

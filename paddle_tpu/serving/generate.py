@@ -38,6 +38,38 @@ arithmetic order (one padded causal pass vs Sq=1 steps), the prefill
 parity suite additionally pins ``prefill_step`` to ``full_forward``
 (the batched-reference oracle) and batched-vs-token generations to
 token identity.
+
+ISSUE 13 adds SPECULATIVE DECODING and the per-request SAMPLING
+contract:
+
+- ``ContinuousBatchingLoop(speculate=d)`` (default
+  ``FLAGS_serving_speculate``) arms draft-model-free speculation: a
+  prompt-lookup drafter (serving/speculative.py — pure host n-gram
+  matching over prompt + generation history, no second model, no
+  extra HBM) proposes up to ``d`` continuation tokens per generating
+  sequence, and ``verify_step`` feeds the last committed token plus
+  the draft block through ONE model step — Sq = 1+d ragged query rows
+  per sequence through ``paged_decode_attention(q_lengths=)``, the
+  page stream still reading each live KV page once.  Acceptance is
+  longest-prefix-match against the model's own (biased) argmax, so
+  every emitted token is argmax given an exactly-correct prefix:
+  greedy speculative decode is TOKEN-IDENTICAL to ``full_decode`` by
+  construction, and the existing oracle keeps pinning correctness.
+  Rejected draft tokens roll back as pure host bookkeeping —
+  ``KVCachePool.truncate_seq`` shrinks the page table atomically
+  (refcount/CoW-aware, int8 scales cleared with freed pages) — which
+  continuous batching already tolerates as ragged per-sequence
+  progress.  EOS / stop sequences / max_new are checked after EVERY
+  emitted token, so a stop landing inside an accepted draft block
+  retires the sequence at that position with the surplus fed tokens
+  truncated from the page table.
+- ``DecodeRequest.sampling`` (serving/sampling.py SamplingParams)
+  widens the decode contract: temperature/top-k/top-p through ONE
+  jitted sampling epilogue per step, logit bias (greedy included),
+  stop sequences, per-request max_new.  Speculation auto-disables
+  PER-SEQUENCE when sampling makes verify non-deterministic —
+  greedy/temp=0 requests keep it on, sampled batch-mates ride the
+  same verify step at d=0.
 """
 
 from __future__ import annotations
@@ -69,6 +101,8 @@ from ..resilience import faultinject as _finject
 from ..resilience.sentinel import rows_finite
 from . import metrics as _smetrics
 from .kvcache import KVCachePool
+from .sampling import SamplingParams, apply_bias, sample_rows, stop_hit
+from .speculative import PromptLookupDrafter
 
 _log = logging.getLogger("paddle_tpu.serving")
 
@@ -83,6 +117,7 @@ __all__ = [
     "full_decode",
     "prefill_step",
     "chunk_prefill_step",
+    "verify_step",
 ]
 
 
@@ -393,6 +428,104 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
 
 
+def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
+                seq_ids: Sequence[int], blocks: Sequence[Sequence[int]],
+                start_positions: Sequence[int], force: str = "auto",
+                impl: Optional[str] = None,
+                pad_to: Optional[int] = None) -> np.ndarray:
+    """One speculative verify step: sequence i feeds ``blocks[i]`` —
+    its last committed token plus d_i drafted continuations — starting
+    at absolute position ``start_positions[i]``, appends every fed
+    token's per-layer K/V to the pool (ONE atomic ``append_tokens``
+    claim), and returns the logits [B, Sq_max, V] at every fed
+    position: row t predicts the token at position start+t+1, which is
+    exactly what draft token t+1 claims to be.  Ragged draft depths
+    ride the ``q_lengths`` arm of ``paged_decode_attention`` — the KV
+    page stream is the SAME as a single-token step's (each live page
+    reads once per sequence), which is the amortization speculation
+    banks.  Rows past ``len(blocks[i])`` are padding garbage the
+    caller must ignore.  A block of length 1 is exactly ``decode_step``
+    for that sequence, so mixed draft/no-draft batches share the step.
+
+    The caller owns acceptance and ROLLBACK: rejected tokens' K/V
+    stays claimed until ``pool.truncate_seq`` undoes it (the loop does
+    both in the same scheduler turn)."""
+    import jax.numpy as jnp
+
+    lens = np.asarray([len(b) for b in blocks], np.int32)
+    if not len(lens) or lens.min() < 1:
+        raise ValueError("verify needs >= 1 fed token per sequence")
+    starts = np.asarray(start_positions, np.int32)
+    # pad_to pins the query width to one static shape (the loop passes
+    # speculate+1) so the jitted finite scan and the memoized pallas
+    # kernel compile ONCE per batch size instead of once per distinct
+    # ragged draft mix — the padded rows are q_lengths-masked garbage
+    # either way
+    B, Sqm = len(blocks), int(lens.max())
+    if pad_to is not None:
+        if pad_to < Sqm:
+            raise ValueError(f"pad_to {pad_to} < longest block {Sqm}")
+        Sqm = int(pad_to)
+    if int((starts + lens).max()) > cfg.max_length:
+        # before append_tokens: a failed verify must not leave claimed
+        # slots with no K/V behind (the pool's atomicity contract)
+        raise ValueError(
+            f"verify block reaches position {int((starts + lens).max())} "
+            f"> max_length {cfg.max_length}")
+    d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
+    Hkv = cfg.num_kv_heads
+    tokens = np.zeros((B, Sqm), np.int32)
+    for i, b in enumerate(blocks):
+        tokens[i, :lens[i]] = b
+    pages, slots = pool.append_tokens(seq_ids, lens)
+    tables, lengths = pool.page_table_batch(seq_ids)
+    if tables.shape[1] % 8:
+        # bucket the table width to multiples of 8 pages: decode compile
+        # shapes change once per 8 pages of growth instead of every
+        # page, so the verify kernels reach steady state quickly (the
+        # padded entries are dummy page-0 walks fully masked by
+        # ``lengths`` — the existing zero-padded-table contract)
+        padded = -(-tables.shape[1] // 8) * 8
+        tables = np.pad(tables, ((0, 0), (0, padded - tables.shape[1])))
+    b_idx = np.repeat(np.arange(B), lens)
+    t_idx = np.concatenate([np.arange(n) for n in lens])
+    # stable-shape writes: pad the scatter to B*Sqm rows by REPEATING
+    # the last claimed (page, slot) and its row — duplicate scatter
+    # indices carrying identical values are a no-op, and the fixed row
+    # count means the write kernels compile once per (B, Sqm) instead
+    # of once per distinct ragged draft mix
+    T = len(b_idx)
+    pad_rows = B * Sqm - T
+    if pad_rows:
+        b_idx = np.concatenate([b_idx, np.full(pad_rows, b_idx[-1])])
+        t_idx = np.concatenate([t_idx, np.full(pad_rows, t_idx[-1])])
+        pages = np.concatenate([pages, np.full(pad_rows, pages[-1],
+                                                pages.dtype)])
+        slots = np.concatenate([slots, np.full(pad_rows, slots[-1],
+                                                slots.dtype)])
+    pos = starts[:, None] + np.arange(Sqm)[None, :]
+    pos_c = np.minimum(pos, cfg.max_length - 1)  # padded rows: clamp only
+    h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+        + jnp.asarray(params["pos"])[pos_c]  # [B, Sqm, d]
+    for li, lp in enumerate(params["layers"]):
+        q = (h @ lp["wq"]).reshape(B, Sqm, H, Dh)
+        k = (h @ lp["wk"]).reshape(B, Sqm, Hkv, Dh)
+        v = (h @ lp["wv"]).reshape(B, Sqm, Hkv, Dh)
+        # valid rows (plus the identical-value padding) in claim order
+        pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
+        k_scales, v_scales = pool.layer_scales(li)
+        attn = paged_decode_attention(
+            q.transpose(0, 2, 1, 3), pool.k_pages[li], pool.v_pages[li],
+            tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
+            k_scales=k_scales, v_scales=v_scales, q_lengths=lens,
+        )  # [B, H, Sqm, Dh]
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, Sqm, d)
+        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
+        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+    return np.asarray(h @ jnp.asarray(params["embed"]).T)  # [B, Sqm, V]
+
+
 @dataclasses.dataclass
 class DecodeRequest:
     prompt: Sequence[int]
@@ -401,6 +534,10 @@ class DecodeRequest:
     # engine; None (the default) mints a fresh id at run() when
     # FLAGS_observability is on
     trace_id: Optional[str] = None
+    # per-request sampling contract (serving/sampling.py) — None is
+    # exact greedy, the full_decode-oracle arm; non-greedy params
+    # auto-disable speculation for THIS sequence only
+    sampling: Optional[SamplingParams] = None
 
 
 @dataclasses.dataclass
@@ -426,7 +563,8 @@ class GeneratedSequence:
 
 class _Active:
     __slots__ = ("req", "seq_id", "pos", "result", "rt", "matched",
-                 "charged", "whole", "chunk_mode", "inserted")
+                 "charged", "whole", "chunk_mode", "inserted",
+                 "drafted", "accepted")
 
     def __init__(self, req: DecodeRequest, seq_id: int,
                  result: GeneratedSequence, rt=None):
@@ -440,6 +578,8 @@ class _Active:
         self.whole = False       # whole-prompt prefill_step at admission
         self.chunk_mode = False  # tail/capped prefill via chunk steps
         self.inserted = False    # prompt pages offered to the cache
+        self.drafted = 0   # speculative tokens proposed for this seq
+        self.accepted = 0  # ... of which the verifier accepted
 
 
 class ContinuousBatchingLoop:
@@ -495,7 +635,8 @@ class ContinuousBatchingLoop:
                  paged_impl: Optional[str] = None,
                  prefill: str = "batched", check_every: int = 0,
                  program=None, prefix_cache=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 speculate: Optional[int] = None, drafter=None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
@@ -540,6 +681,25 @@ class ContinuousBatchingLoop:
             else _flags._VALUES["FLAGS_serving_prefill_chunk"])
         if self._prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
+        # speculative decoding (ISSUE 13): d draft tokens per generating
+        # sequence per step, verified in one multi-token model step.
+        # None reads FLAGS_serving_speculate; 0 disables.  An SPMD
+        # program's step functions are compiled for Sq=1, so
+        # program-driven loops degrade to d=0 with a one-time log —
+        # the same per-sequence degradation non-greedy sampling gets
+        self._speculate = int(
+            speculate if speculate is not None
+            else _flags._VALUES["FLAGS_serving_speculate"])
+        if self._speculate < 0:
+            raise ValueError("speculate must be >= 0")
+        if self._speculate and program is not None:
+            _log.info(
+                "speculative decoding is single-device-loop only for "
+                "now — program-driven (SPMD) decode degrades to d=0")
+            self._speculate = 0
+        self.drafter = drafter if drafter is not None else (
+            PromptLookupDrafter(max_draft=self._speculate)
+            if self._speculate else None)
         self._next_seq_id = 0
         self.steps = 0
         self.prefill_steps = 0
@@ -556,6 +716,42 @@ class ContinuousBatchingLoop:
         self.prefill_tokens = 0
         self.max_prefill_tokens_step = 0
         self._prefer_prefill = True
+        # speculation accounting (serve_bench banks acceptance_rate and
+        # tokens/step; traces/flight carry the per-sequence split)
+        self.spec_steps = 0
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        self.rolled_back_tokens = 0
+
+    def acceptance_rate(self) -> float:
+        """Accepted / drafted speculative tokens (0.0 before any
+        draft) — the number that decides whether speculation paid."""
+        return (self.accepted_tokens / self.drafted_tokens
+                if self.drafted_tokens else 0.0)
+
+    def _max_new(self, a: "_Active") -> int:
+        """Effective generation cap: the request's max_new_tokens,
+        tightened by SamplingParams.max_new when present."""
+        p = a.req.sampling
+        if p is not None and p.max_new is not None:
+            return min(a.req.max_new_tokens, p.max_new)
+        return a.req.max_new_tokens
+
+    def _spec_room(self, a: "_Active") -> int:
+        """Draft tokens sequence `a` may carry THIS step: capped by the
+        loop's d, by the sequence's remaining generation headroom (the
+        worst-case admission reservation must still cover the
+        transiently-fed block — ceil((prompt+max_new)/page_size) pages
+        bound pos+1+d), and zeroed when sampling makes verify
+        non-deterministic (only greedy/temp=0 argmax is reproducible
+        against the verify row) or while the prompt still prefills."""
+        if not self._speculate or a.pos < len(a.result.prompt):
+            return 0
+        p = a.req.sampling
+        if p is not None and not p.greedy:
+            return 0
+        return min(self._speculate,
+                   self._max_new(a) - len(a.result.tokens))
 
     def _footprint(self, req: DecodeRequest, matched: int = 0) -> int:
         """Worst-case pages a request pulls from the FREE list.  With
@@ -582,6 +778,14 @@ class ContinuousBatchingLoop:
         for req in requests:
             if not len(req.prompt):
                 raise ValueError("empty prompt")
+            if req.sampling is not None \
+                    and req.sampling.max_bias_token() >= self.cfg.vocab_size:
+                # part of the same validate-before-any-work pass: an
+                # out-of-vocab bias id would IndexError mid-step and
+                # cost the whole batch instead of this one request
+                raise ValueError(
+                    f"logit_bias token {req.sampling.max_bias_token()} "
+                    f">= vocab_size {self.cfg.vocab_size}")
             # validate EVERY request (max_length AND whole-pool fit)
             # before any work: a mid-run raise would strand allocated
             # pages and throw away already-finished sequences' results
@@ -668,10 +872,19 @@ class ContinuousBatchingLoop:
                                       else None))
             return logits, {i for i in range(len(batch)) if finite[i]}, now
 
-        def emit(a: _Active, row: np.ndarray, t0: float, now: float) -> bool:
-            """Record one generated token; True when the sequence is done."""
-            nxt = int(row.argmax())
-            a.result.tokens.append(nxt)
+        def emit(a: _Active, row: np.ndarray, t0: float, now: float,
+                 tok: Optional[int] = None) -> bool:
+            """Record one generated token; True when the sequence is
+            done (effective max_new, EOS, or a stop sequence — checked
+            after EVERY token, so a stop emitted from inside an
+            accepted draft block retires the sequence right there).
+            `tok` is the already-chosen token for sampled sequences and
+            the speculative walk; None takes the (bias-shifted) greedy
+            argmax — exactly full_decode's choice when no bias."""
+            params = a.req.sampling
+            if tok is None:
+                tok = int(apply_bias(row, params).argmax())
+            a.result.tokens.append(tok)
             a.result.logits.append(row)
             if a.result.ttft_s is None:
                 a.result.ttft_s = now - a.result.admitted_at
@@ -680,9 +893,33 @@ class ContinuousBatchingLoop:
                                a.result.admitted_at, now)
             if obs_on:
                 _smetrics.record_token(now - t0, impl=self.paged_impl)
-            return (len(a.result.tokens) >= a.req.max_new_tokens
+            return (len(a.result.tokens) >= self._max_new(a)
                     or (self.cfg.eos_id is not None
-                        and nxt == self.cfg.eos_id))
+                        and tok == self.cfg.eos_id)
+                    or stop_hit(a.result.tokens, params))
+
+        def emit_batch(pairs, t0: float, now: float) -> List[_Active]:
+            """Emit one token for every (sequence, logits-row) pair —
+            non-greedy rows resolved by the ONE jitted sampling
+            epilogue call this step, greedy rows by host argmax (the
+            oracle's arithmetic).  Returns the finished sequences."""
+            toks: List[Optional[int]] = [None] * len(pairs)
+            sampled = [(j, a, row) for j, (a, row) in enumerate(pairs)
+                       if a.req.sampling is not None
+                       and not a.req.sampling.greedy]
+            if sampled:
+                rows = np.stack([apply_bias(r, a.req.sampling)
+                                 for _, a, r in sampled])
+                chosen = sample_rows(
+                    rows, [a.req.sampling for _, a, _ in sampled],
+                    [len(a.result.tokens) for _, a, _ in sampled])
+                for (j, _, _), tk in zip(sampled, chosen):
+                    toks[j] = int(tk)
+            done: List[_Active] = []
+            for (a, row), tk in zip(pairs, toks):
+                if emit(a, row, t0, now, tok=tk):
+                    done.append(a)
+            return done
 
         def retire(batch: List[_Active], now: float) -> None:
             nonlocal reserved_pages
@@ -703,6 +940,12 @@ class ContinuousBatchingLoop:
                                 a.result.admitted_at + a.result.ttft_s,
                                 now, tokens=len(a.result.tokens))
                         a.rt.annotate(tokens=len(a.result.tokens))
+                        if a.drafted:
+                            # where speculation paid or thrashed for
+                            # THIS request — tail-kept traces carry it
+                            a.rt.annotate(
+                                drafted=a.drafted, accepted=a.accepted,
+                                rejected=a.drafted - a.accepted)
                         kept = _rtrace.default_request_tracer().finish(
                             a.rt, outcome="ok", t_end=now)
                     if a.result.ttft_s is not None:
@@ -818,15 +1061,14 @@ class ContinuousBatchingLoop:
                         len(whole_group) / float(self.max_batch)
                     logits, ok, now = quarantine(whole_group, logits,
                                                  step_idx)
-                    done_now: List[_Active] = []
+                    pairs = []
                     for i, a in enumerate(whole_group):
                         a.pos = len(a.result.prompt)
                         if i not in ok:
                             continue  # quarantined at prefill
                         self._cache_insert(a)
-                        if emit(a, np.asarray(logits[i]), t0, now):
-                            done_now.append(a)
-                    retire(done_now, now)
+                        pairs.append((a, np.asarray(logits[i])))
+                    retire(emit_batch(pairs, t0, now), now)
                     if obs_on:
                         self._note_attention_bytes()
                     self._watchdog()
@@ -873,28 +1115,30 @@ class ContinuousBatchingLoop:
                         self.max_prefill_tokens_step, ntok)
                     self._occupancy_sum += len(sel) / float(self.max_batch)
                     logits, ok, now = quarantine(sel, logits, step_idx)
-                    done_now = []
+                    pairs = []
                     for i, a in enumerate(sel):
                         if i not in ok:
                             continue  # quarantined at this chunk
                         a.pos += len(chunks[i])
                         if a.pos >= len(a.result.prompt):
                             self._cache_insert(a)
-                            if emit(a, np.asarray(logits[i]), t0, now):
-                                done_now.append(a)
-                    retire(done_now, now)
+                            pairs.append((a, np.asarray(logits[i])))
+                    retire(emit_batch(pairs, t0, now), now)
                     if obs_on:
                         self._note_attention_bytes()
                     self._watchdog()
                     self._prefer_prefill = False
                     continue
 
-                # one token per stepping sequence; under prefill="token"
-                # (and program-driven cached-prefix tails) a
-                # still-prefilling sequence and a deep-decode sequence
-                # share the batch and differ only in k_lengths.  The
-                # chunk cap bounds how many prefill tokens (one per
-                # prefilling sequence here) ride one step
+                # one token per stepping sequence — or, with speculation
+                # armed, 1+d_i tokens for generating greedy sequences
+                # (DRAFT phase: prompt-lookup proposals, pure host).
+                # Under prefill="token" (and program-driven
+                # cached-prefix tails) a still-prefilling sequence and a
+                # deep-decode sequence share the batch and differ only
+                # in k_lengths / q_lengths.  The chunk cap bounds how
+                # many prefill tokens (one per prefilling sequence
+                # here) ride one step
                 batch = list(decodable)
                 if self._prefill_chunk:
                     pre = [a for a in batch
@@ -907,14 +1151,131 @@ class ContinuousBatchingLoop:
                                  or id(a) in keep]
                 if not batch:
                     continue
+                blocks: List[List[int]] = []
+                for a in batch:
+                    if a.pos < len(a.result.prompt):
+                        blocks.append([a.result.prompt[a.pos]])
+                        continue
+                    blk = [a.result.tokens[-1]]
+                    room = self._spec_room(a)
+                    if room > 0 and self.drafter is not None:
+                        # clamp to room: a custom drafter ignoring its
+                        # max_draft must not breach the pad_to width or
+                        # the admission page reservation
+                        blk += list(self.drafter.draft(
+                            list(a.result.prompt) + a.result.tokens,
+                            room))[:room]
+                    blocks.append(blk)
                 t0 = time.perf_counter()
                 step_idx = self.steps
                 seq_ids = [a.seq_id for a in batch]
-                tokens = [
-                    (a.result.prompt[a.pos] if a.pos < len(a.result.prompt)
-                     else a.result.tokens[-1])
-                    for a in batch
-                ]
+
+                if max(len(b) for b in blocks) > 1:
+                    # VERIFY phase: one multi-token model step feeds
+                    # every sequence's block (ragged q_lengths); each
+                    # emitted token is the model's own argmax given an
+                    # exactly-verified prefix, so greedy output is
+                    # token-identical to full_decode with up to d_i+1
+                    # tokens committed per step
+                    drafted_now = sum(len(b) - 1 for b in blocks)
+                    if obs_on:
+                        for a, b in zip(batch, blocks):
+                            if len(b) > 1:
+                                _flight.default_flight().record(
+                                    "draft", seq_id=a.seq_id,
+                                    step=step_idx, tokens=len(b) - 1,
+                                    trace_id=a.result.trace_id)
+                    logits3 = verify_step(
+                        self.params, self.cfg, self.pool, seq_ids,
+                        blocks, [a.pos for a in batch],
+                        force=self.force, impl=self.paged_impl,
+                        pad_to=self._speculate + 1)
+                    self.steps += 1
+                    self.decode_steps += 1
+                    self.spec_steps += 1
+                    self.drafted_tokens += drafted_now
+                    ntok = sum(1 for a in batch
+                               if a.pos < len(a.result.prompt))
+                    if ntok:
+                        self.prefill_tokens += ntok
+                        self.max_prefill_tokens_step = max(
+                            self.max_prefill_tokens_step, ntok)
+                    self._occupancy_sum += \
+                        len(batch) / float(self.max_batch)
+                    logits3, ok, now = quarantine(batch, logits3,
+                                                  step_idx)
+                    pairs = []
+                    retired: List[_Active] = []
+                    for i, a in enumerate(batch):
+                        blk = blocks[i]
+                        start = a.pos
+                        if i not in ok:
+                            continue  # quarantined (pages already freed)
+                        if a.pos < len(a.result.prompt):
+                            a.pos += 1
+                            if a.pos == len(a.result.prompt):
+                                self._cache_insert(a)
+                                pairs.append(
+                                    (a, np.asarray(logits3[i, 0])))
+                            continue
+                        params_i = a.req.sampling
+                        if params_i is not None and not params_i.greedy:
+                            # sampled batch-mate riding the step at d=0
+                            a.pos += 1
+                            pairs.append((a, np.asarray(logits3[i, 0])))
+                            continue
+                        # ACCEPTANCE walk (longest prefix match): row t
+                        # predicts position start+t+1 — emit its argmax
+                        # and keep walking only while it matches the
+                        # draft (whose K/V is then already committed)
+                        accepted = 0
+                        done = False
+                        for t in range(len(blk)):
+                            row = np.asarray(logits3[i, t])
+                            tok = int(apply_bias(row, params_i).argmax())
+                            fed = t + 1 < len(blk) and tok == blk[t + 1]
+                            if fed:
+                                accepted += 1
+                            done = emit(a, row, t0, now, tok=tok)
+                            if done or not fed:
+                                break
+                        drafted = len(blk) - 1
+                        a.drafted += drafted
+                        a.accepted += accepted
+                        self.accepted_tokens += accepted
+                        # ROLLBACK: rejected draft tokens (and fed
+                        # tokens past an in-block EOS/stop) leave the
+                        # page table atomically — pure host bookkeeping
+                        new_len = start + 1 + accepted
+                        rolled = start + len(blk) - new_len
+                        if rolled:
+                            self.pool.truncate_seq(a.seq_id, new_len)
+                            self.rolled_back_tokens += rolled
+                        a.pos = new_len
+                        if obs_on and drafted:
+                            _smetrics.record_spec(drafted, accepted)
+                            _flight.default_flight().record(
+                                "verify", seq_id=a.seq_id,
+                                step=step_idx, accepted=accepted,
+                                rejected=drafted - accepted,
+                                trace_id=a.result.trace_id)
+                            if rolled:
+                                _flight.default_flight().record(
+                                    "rollback", seq_id=a.seq_id,
+                                    step=step_idx, tokens=rolled,
+                                    length=new_len,
+                                    trace_id=a.result.trace_id)
+                        if done:
+                            retired.append(a)
+                    retired.extend(emit_batch(pairs, t0, now))
+                    retire(retired, now)
+                    if obs_on:
+                        self._note_attention_bytes()
+                    self._watchdog()
+                    self._prefer_prefill = True
+                    continue
+
+                tokens = [b[0] for b in blocks]
                 positions = [a.pos for a in batch]
                 if self.program is not None:
                     logits = self.program.decode_step(
@@ -934,7 +1295,7 @@ class ContinuousBatchingLoop:
                 self._occupancy_sum += len(batch) / float(self.max_batch)
                 logits, ok, now = quarantine(batch, logits, step_idx)
 
-                retired: List[_Active] = []
+                pairs = []
                 for i, a in enumerate(batch):
                     a.pos += 1
                     if i not in ok:
@@ -945,9 +1306,8 @@ class ContinuousBatchingLoop:
                         # the fed token completed the prompt's K/V:
                         # offer its pages to the prefix cache
                         self._cache_insert(a)
-                    if emit(a, np.asarray(logits[i]), t0, now):
-                        retired.append(a)
-                retire(retired, now)
+                    pairs.append((a, np.asarray(logits[i])))
+                retire(emit_batch(pairs, t0, now), now)
                 if obs_on:
                     self._note_attention_bytes()
                 self._watchdog()

@@ -167,7 +167,7 @@ class KVCachePool:
             "page_allocs": 0, "page_frees": 0, "token_appends": 0,
             "defrag_moves": 0, "used_pages_high_water": 0,
             "orphans_reclaimed": 0, "cow_copies": 0,
-            "shared_attach_pages": 0,
+            "shared_attach_pages": 0, "tokens_truncated": 0,
         }
 
     # -- sizing math (documented in README "Serving") -------------------
@@ -244,6 +244,56 @@ class KVCachePool:
             self._stats["page_frees"] += n
         self._note_pool()
         return n
+
+    def truncate_seq(self, seq_id: int, length: int) -> int:
+        """Atomically shrink a sequence's table to `length` tokens —
+        the speculative-decode ROLLBACK (ISSUE 13): rejected draft
+        tokens' claims are undone in one locked step.  Pages past
+        ``ceil(length / page_size)`` leave the table, each dropping ONE
+        refcount hold — only pages hitting zero return to the free
+        list, so a truncation through a prefix-cache share or a page
+        other sequences still read releases this sequence's hold and
+        nothing else (never strands or frees a shared prefix).  Freed
+        pages' int8 quantization scales clear with them (the audited
+        freed-pages-carry-no-scale invariant); the kept tail page's
+        surplus slots hold stale-but-finite content that the length
+        masks and the next append overwrites — exactly the state a
+        shorter sequence would be in.  Returns the number of pages
+        actually freed.  `length` must not exceed the current token
+        count (growth is append_tokens' job)."""
+        with self._lock:
+            h = self._tables[seq_id]
+            n = int(length)
+            if n < 0 or n > h.length:
+                raise ValueError(
+                    f"cannot truncate sequence {seq_id} from {h.length} "
+                    f"to {n} tokens — length must shrink into [0, "
+                    f"{h.length}]")
+            if n == h.length:
+                return 0
+            keep = self.pages_needed(n, self.page_size)
+            dropped = h.pages[keep:]
+            h.pages = h.pages[:keep]
+            self._stats["tokens_truncated"] += h.length - n
+            h.length = n
+            freed: List[int] = []
+            for p in reversed(dropped):
+                self._ref[p] -= 1
+                if self._ref[p] <= 0:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                    self._allocator.pop(p, None)
+                    freed.append(p)
+                elif self._allocator.get(p) == seq_id:
+                    # readers (prefix cache, attached sequences) keep
+                    # the page alive past its charging sequence's
+                    # rollback: it is now UNCHARGED, like free_seq
+                    del self._allocator[p]
+            self._clear_scales(freed)
+            self._stats["page_frees"] += len(freed)
+        if freed:
+            self._note_pool()
+        return len(freed)
 
     # -- refcount / sharing API (the prefix-cache substrate) -----------
 
@@ -503,7 +553,10 @@ class KVCachePool:
         into the claimed (page, slot)s (T = batch rows for one decode
         step, or a whole prompt batch's flattened tokens for prefill).
         (page, slot) pairs must be distinct — append_token/append_tokens
-        guarantee it.  An int8 pool amax-quantizes on the way in (see
+        guarantee it — EXCEPT that a pair may repeat when its rows are
+        value-identical (a duplicate scatter of the same content is a
+        no-op; verify_step pads its writes that way to keep scatter
+        shapes compile-stable).  An int8 pool amax-quantizes on the way in (see
         the class docstring).  Locked like every other mutation: an
         unlocked read-modify-write of the arrays would race defrag()'s
         permutation and silently drop one side's update."""

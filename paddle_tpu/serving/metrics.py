@@ -25,6 +25,12 @@ Metrics:
                                                       analytic HBM bytes the
                                                       decode attention KV
                                                       path moves per step
+- paddle_tpu_serving_spec_tokens_total      counter  {outcome=accepted|
+                                                      rejected} speculative
+                                                      draft tokens by verify
+                                                      outcome (rejected ones
+                                                      rolled back from the
+                                                      page table)
 - paddle_tpu_serving_fallback_total         counter  {kernel=} kernel
                                                       selections that fell
                                                       back off the
@@ -169,6 +175,22 @@ def record_token(seconds: float, impl: str = "reference") -> None:
         "paddle_tpu_serving_token_seconds",
         "wall time per generated token (per sequence-step)",
     ).observe(seconds, impl=impl)
+
+
+def record_spec(drafted: int, accepted: int) -> None:
+    """One sequence's speculative verify outcome: `drafted` proposed
+    tokens, `accepted` of them committed (acceptance_rate is the
+    counter ratio; rejected = drafted - accepted rolled back)."""
+    default_registry().counter(
+        "paddle_tpu_serving_spec_tokens_total",
+        "speculative draft tokens by verify outcome",
+    ).inc(accepted, outcome="accepted")
+    rejected = drafted - accepted
+    if rejected:
+        default_registry().counter(
+            "paddle_tpu_serving_spec_tokens_total",
+            "speculative draft tokens by verify outcome",
+        ).inc(rejected, outcome="rejected")
 
 
 def record_fallback(kernel: str) -> None:

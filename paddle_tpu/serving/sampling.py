@@ -1,0 +1,224 @@
+"""Per-request sampling contract for the serving decode loop (ISSUE 13).
+
+The decode tier was greedy-only: every caller got ``argmax`` and the
+oracle parity suite pinned it.  Real traffic wants temperature /
+top-k / top-p sampling, stop sequences, logit bias, and a per-request
+generation cap — each a distinct serving scenario (serve_bench
+``--sampling``) — WITHOUT forking the step function per request.  So
+the contract is:
+
+- :class:`SamplingParams` is an immutable per-request value object
+  carried on ``DecodeRequest.sampling`` (and threaded from
+  ``Engine.submit(sampling=)`` in pass-through mode).  ``temperature
+  == 0`` (the default) is EXACT greedy — bit-identical to the
+  pre-ISSUE-13 loop and to ``full_decode``, which is also the
+  determinism condition speculative decoding verifies against, so
+  greedy/temp=0 requests keep speculation ON and everything else
+  degrades per-sequence to d=0 (see generate.py).
+- :func:`sample_rows` is the ONE jitted sampling epilogue: the whole
+  batch's next-token choice in a single fused call — per-row
+  temperature scaling, top-k / top-p filtering, and a Gumbel-max draw
+  keyed by (per-request seed, per-sequence token index) — the RNG
+  stream never depends on batch composition, so an identical replay
+  regenerates identical tokens (fp32 attention reduction order can
+  still perturb a near-tied draw between DIFFERENT step shapes; the
+  keys themselves cannot).  Greedy rows short-circuit host-side (the
+  loop never pays a device round trip for pure-greedy batches,
+  preserving the oracle's host-argmax arithmetic exactly).
+- Logit bias applies BEFORE everything (greedy included): a biased
+  greedy request is still deterministic, so its argmax surface is just
+  shifted — ``apply_bias`` is the shared host helper.
+- Stop sequences are a host-side suffix check (:func:`stop_hit`)
+  applied after EVERY emitted token — including tokens emitted from
+  inside an accepted draft block, the same contract as EOS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SamplingParams", "sample_rows", "apply_bias", "stop_hit"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Immutable per-request sampling knobs.
+
+    temperature: 0.0 (default) = EXACT greedy (argmax; deterministic —
+        keeps speculative verify on); > 0 samples from the scaled
+        distribution.
+    top_k: keep only the k highest-logit tokens before sampling
+        (0 = off).  Ignored for greedy rows (argmax already is top-1).
+    top_p: nucleus sampling — keep the smallest prefix of the
+        probability-sorted vocab whose cumulative mass reaches p
+        (1.0 = off; the top-1 token is always kept).
+    stop: stop token sequences (any iterable of token iterables) — a
+        sequence retires the moment its generated tokens END with one
+        of them; the stop tokens stay in the output (the EOS
+        convention).
+    logit_bias: {token_id: additive bias} applied to every step's
+        logits before argmax/sampling — greedy rows included.
+    max_new: per-request generation cap; the effective cap is
+        ``min(DecodeRequest.max_new_tokens, max_new)`` (None: the
+        request's own cap stands).
+    seed: per-request RNG stream for the Gumbel draw; the g-th
+        generated token folds in g, so a retried request replays
+        identically and batch composition cannot perturb it.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop: Tuple[Tuple[int, ...], ...] = ()
+    logit_bias: Optional[Tuple[Tuple[int, float], ...]] = None
+    max_new: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}")
+        if self.max_new is not None and self.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {self.max_new}")
+        if not 0 <= int(self.seed) < 2 ** 32:
+            # the RNG key is a uint32: a negative seed would crash the
+            # epilogue MID-BATCH (killing batch-mates) instead of
+            # failing this one request's construction
+            raise ValueError(
+                f"seed must be a uint32 (0 <= seed < 2**32), got "
+                f"{self.seed}")
+        # normalize the container fields so the frozen instance is
+        # hashable and order-stable (dicts/lists accepted at call sites)
+        object.__setattr__(self, "stop", tuple(
+            tuple(int(t) for t in s) for s in (self.stop or ())))
+        if any(not s for s in self.stop):
+            raise ValueError("stop sequences must be non-empty")
+        bias = self.logit_bias
+        if bias is not None:
+            if isinstance(bias, dict):
+                bias = bias.items()
+            norm = tuple(sorted((int(t), float(b)) for t, b in bias))
+            if norm and norm[0][0] < 0:
+                raise ValueError(
+                    f"logit_bias token ids must be >= 0, got "
+                    f"{norm[0][0]}")
+            object.__setattr__(self, "logit_bias", norm or None)
+
+    def max_bias_token(self) -> int:
+        """Largest biased token id (-1 when no bias) — the decode loop
+        validates it against the model's vocab at admission, so an
+        out-of-range id fails THAT request up front instead of
+        crashing the shared batch mid-step."""
+        return self.logit_bias[-1][0] if self.logit_bias else -1
+
+    @property
+    def greedy(self) -> bool:
+        """True when this request's choice is deterministic argmax —
+        the condition under which speculative verify stays enabled."""
+        return self.temperature == 0.0
+
+
+def apply_bias(row: np.ndarray,
+               params: Optional[SamplingParams]) -> np.ndarray:
+    """Host-side logit bias for one [V] row (a copy when bias applies;
+    the input row otherwise) — shared by the greedy argmax path and the
+    draft-acceptance walk so both see the same decision surface."""
+    if params is None or not params.logit_bias:
+        return row
+    out = np.asarray(row, np.float32).copy()
+    for tok, b in params.logit_bias:
+        out[tok] += b
+    return out
+
+
+def stop_hit(tokens: Sequence[int],
+             params: Optional[SamplingParams]) -> bool:
+    """True when `tokens` (the generated tokens so far) ends with one of
+    the request's stop sequences."""
+    if params is None or not params.stop:
+        return False
+    for s in params.stop:
+        n = len(s)
+        if n <= len(tokens) and tuple(tokens[-n:]) == s:
+            return True
+    return False
+
+
+@functools.lru_cache(maxsize=32)
+def _sample_jit(vocab: int):
+    """The jitted epilogue body, one compile per vocab width: [B, V]
+    biased logits + per-row (temperature, top_k, top_p, key-fold data)
+    -> [B] sampled token ids.  All three filters fuse into one call."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(logits, temps, top_ks, top_ps, seeds, steps):
+        x = logits / jnp.maximum(temps, 1e-6)[:, None]
+        # top-k: mask everything below the k-th largest logit (k=0/V
+        # disables); ties at the threshold stay in, which only widens
+        # the kept set — standard top-k semantics
+        sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+        k = jnp.clip(jnp.where(top_ks > 0, top_ks, vocab), 1, vocab)
+        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None],
+                                  axis=-1)  # [B, 1]
+        x = jnp.where(x >= kth, x, -jnp.inf)
+        # top-p over the filtered distribution: keep every token whose
+        # PRECEDING cumulative mass is < p (the smallest prefix
+        # reaching p; the top-1 always stays because its preceding
+        # mass is 0).  Comparing the preceding mass — not the
+        # inclusive cumsum — keeps top_p=1.0 a true no-op even when
+        # the fp32 cumsum tops out at 0.9999999 and never reaches 1
+        probs = jax.nn.softmax(x, axis=-1)
+        p_desc = jnp.sort(probs, axis=-1)[:, ::-1]
+        preceding = jnp.cumsum(p_desc, axis=-1) - p_desc
+        kept = preceding < top_ps[:, None]
+        p_min = jnp.min(jnp.where(kept, p_desc, jnp.inf), axis=-1,
+                        keepdims=True)
+        x = jnp.where(probs >= p_min, x, -jnp.inf)
+        # Gumbel-max draw keyed (request seed, per-sequence token
+        # index): batch composition cannot perturb a request's stream
+        keys = jax.vmap(lambda s, g: jax.random.fold_in(
+            jax.random.PRNGKey(s), g))(seeds, steps)
+        gumbel = jax.vmap(
+            lambda kk: jax.random.gumbel(kk, (vocab,)))(keys)
+        return jnp.argmax(x + gumbel, axis=-1).astype(jnp.int32)
+
+    return jax.jit(body)
+
+
+def sample_rows(logits: np.ndarray, params: Sequence[SamplingParams],
+                steps: Sequence[int]) -> np.ndarray:
+    """The ONE jitted sampling epilogue: sample a next token for every
+    row of `logits` [B, V] under its request's (non-greedy)
+    SamplingParams; ``steps[i]`` is row i's per-sequence generated-token
+    index (the RNG fold key).  Logit bias must already be applied
+    (``apply_bias`` — the loop biases rows before both the greedy and
+    sampled arms).  Greedy rows do NOT belong here — the loop resolves
+    them host-side so the oracle argmax arithmetic is untouched."""
+    logits = np.ascontiguousarray(np.asarray(logits, np.float32))
+    if logits.ndim != 2:
+        raise ValueError(f"sample_rows wants [B, V] rows, got "
+                         f"{logits.shape}")
+    B, V = logits.shape
+    if len(params) != B or len(steps) != B:
+        raise ValueError("params/steps must align with the logit rows")
+    temps = np.asarray([p.temperature for p in params], np.float32)
+    if (temps <= 0).any():
+        raise ValueError(
+            "greedy rows (temperature 0) must take the host argmax "
+            "path, not the sampling epilogue")
+    top_ks = np.asarray([p.top_k for p in params], np.int32)
+    top_ps = np.asarray([p.top_p for p in params], np.float32)
+    seeds = np.asarray([p.seed for p in params], np.uint32)
+    steps = np.asarray(steps, np.uint32)
+    return np.asarray(_sample_jit(V)(
+        logits, temps, top_ks, top_ps, seeds, steps))

@@ -1,0 +1,90 @@
+"""Draft-model-free speculative drafting: prompt-lookup n-grams.
+
+Decode is bandwidth-bound — every model step streams the full weight
+set plus the live KV pages to produce ONE token per sequence.  The
+multi-token verify step (kernels/paged_attention.py ``q_lengths`` arm)
+can commit up to d+1 tokens for nearly the same HBM traffic, IF
+something proposes plausible continuations.  A second "draft model" is
+the classic proposer, but it costs HBM for its own weights and a second
+compiled program; PROMPT LOOKUP gets surprisingly far for free on the
+traffic this tier actually serves — templated prompts, code, retrieval
+contexts, and multi-turn chat all repeat themselves, and a greedy
+decode that enters a repeating span is a self-match: match the last
+``n`` committed tokens against the prompt + generation history, and
+propose the tokens that followed the most recent earlier occurrence.
+
+The drafter is pure host bookkeeping — no device memory, no extra
+model step, no speculative weights — so a miss costs only the wasted
+query rows of the verify step (KV bytes are flat in d), and acceptance
+is decided by the verifier, never trusted.
+
+``PromptLookupDrafter`` is deliberately stateless across calls: the
+loop hands it each sequence's full visible context (prompt + generated
+tokens) every step, so quarantine/rollback can never desynchronize a
+cached index.  Contexts at serving scale are a few thousand tokens and
+the scan is a reversed O(n * len) suffix walk from the longest n-gram
+down — cheap next to a model step; an incremental hash index is the
+obvious upgrade if profiles ever say otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["PromptLookupDrafter"]
+
+
+class PromptLookupDrafter:
+    """Propose up to ``max_draft`` continuation tokens by n-gram lookup.
+
+    For ``n`` from ``max_ngram`` down to ``min_ngram``: take the last
+    ``n`` context tokens as the probe, find its most RECENT earlier
+    occurrence in the context, and propose the tokens that followed it.
+    Longer probes win (they are more specific); among equal-length
+    matches the most recent wins (local structure beats distant
+    structure in chat/code traffic).  Returns [] when nothing matches —
+    the loop then runs a plain d=0 decode step for that sequence, so a
+    drafter can never make a step WORSE than unspeculated decode."""
+
+    def __init__(self, max_draft: int = 4, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        if max_draft < 1:
+            raise ValueError(f"max_draft must be >= 1, got {max_draft}")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}..{max_ngram}")
+        self.max_draft = int(max_draft)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def draft(self, context: Sequence[int],
+              max_draft: int = None) -> List[int]:
+        """Propose continuation tokens for `context` (prompt + generated
+        history, oldest first).  `max_draft` caps the proposal below
+        the drafter's own limit (the loop passes the sequence's
+        remaining max_new headroom)."""
+        limit = self.max_draft if max_draft is None else \
+            min(self.max_draft, int(max_draft))
+        if limit < 1:
+            return []
+        ctx = [int(t) for t in context]
+        L = len(ctx)
+        best: List[int] = []
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            probe = ctx[L - n:]
+            # most recent earlier occurrence: scan right-to-left over
+            # start positions whose match ends before the suffix
+            # itself.  A match too close to the end truncates its
+            # continuation (the self-repetition case — a decode cycle's
+            # freshest match is always near the end), so a full-length
+            # continuation wins outright and the LONGEST partial one is
+            # kept as the cross-n fallback
+            for i in range(L - n - 1, -1, -1):
+                if ctx[i:i + n] == probe:
+                    out = ctx[i + n:i + n + limit]
+                    if len(out) == limit:
+                        return out
+                    if len(out) > len(best):
+                        best = out
+        return best

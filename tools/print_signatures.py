@@ -38,6 +38,11 @@ MODULES = [
     # prefix cache (ISSUE 11): refcounted CoW page sharing over the
     # KV pool — operators wire PrefixCache to pools/loops directly
     "paddle_tpu.serving.prefixcache",
+    # speculative decoding + sampling contract (ISSUE 13): the
+    # per-request SamplingParams surface and the prompt-lookup drafter
+    # are operator-facing API
+    "paddle_tpu.serving.sampling",
+    "paddle_tpu.serving.speculative",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

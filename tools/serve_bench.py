@@ -34,7 +34,19 @@ a batching server — latency percentiles, throughput, and batch occupancy
   amax-quantized pages with per-page fp32 scales); both land in the
   result next to kv_bytes_per_token (bytes one token's K/V occupies,
   scale overhead amortized in), so the H_q/H_kv x and 2x capacity wins
-  bank and gate like every other metric.
+  bank and gate like every other metric.  --speculate N arms
+  prompt-lookup speculative decoding (d=N draft tokens verified per
+  step; greedy-only — a non-greedy --sampling scenario is a usage
+  error, exit 2) on a REPEATED-STRUCTURE prompt workload (motif-tiled
+  prompts, the traffic shape prompt lookup exists for) and runs the
+  SAME replay once more at d=0 in the same invocation: the report
+  banks acceptance_rate, tokens_per_step, drafted/accepted counts,
+  tokens_per_s alongside tokens_per_s_d0, and spec_speedup (their
+  ratio — bank it >= 1 and --gate holds the win).  --sampling
+  {greedy,temp,topk,topp} attaches the matching SamplingParams
+  scenario to every request (temp/topk/topp load-test the jitted
+  sampling epilogue; tokens no longer match the greedy oracle, so only
+  throughput/latency metrics are meaningful to bank).
 
   router mode (--replicas N, engine-mode option): N Engine replicas of
   the same artifact behind one distributed.Router; the Poisson replay
@@ -387,6 +399,16 @@ def run_router_bench(args) -> dict:
 _KV_DTYPES = {"fp32": "float32", "bf16": "bfloat16", "int8": "int8"}
 
 
+_SAMPLING_SCENARIOS = {
+    # named load scenarios for the per-request sampling contract; the
+    # non-greedy ones exercise the jitted sampling epilogue
+    "greedy": None,
+    "temp": {"temperature": 0.8},
+    "topk": {"temperature": 0.8, "top_k": 20},
+    "topp": {"temperature": 0.8, "top_p": 0.9},
+}
+
+
 def run_decode_bench(args) -> dict:
     from paddle_tpu import serving
 
@@ -423,6 +445,15 @@ def run_decode_bench(args) -> dict:
     sys_prompt = rng.randint(
         1, cfg.vocab_size,
         size=max(1, int(phi * 0.75))).tolist() if share > 0 else []
+    # --speculate: repeated-structure prompts (a short motif tiled to
+    # the drawn length) — templated/self-similar traffic, the shape
+    # prompt-lookup drafting exists for
+    motif = rng.randint(
+        1, cfg.vocab_size,
+        size=max(2, min(6, plo))).tolist() if args.speculate else []
+    spec_kw = _SAMPLING_SCENARIOS[args.sampling]
+    sampling = (serving.SamplingParams(seed=args.seed, **spec_kw)
+                if spec_kw is not None else None)
     reqs = []
     for _ in range(args.sequences):
         if share > 0 and rng.rand() < share:
@@ -431,20 +462,52 @@ def run_decode_bench(args) -> dict:
                 1, cfg.vocab_size, size=tail).tolist()
         else:
             plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
-            prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
+            if args.speculate:
+                reps = -(-plen // len(motif))
+                prompt = (motif * reps)[:plen]
+            else:
+                prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
         reqs.append(serving.DecodeRequest(
-            prompt=prompt, max_new_tokens=args.max_new))
+            prompt=prompt, max_new_tokens=args.max_new,
+            sampling=sampling))
     chaos = bool(args.chaos)
     from paddle_tpu.kernels.paged_attention import fallback_count
 
     fallbacks_before = fallback_count()
+
+    def _fresh_pool():
+        return serving.KVCachePool(
+            num_pages=args.pages, page_size=args.page_size,
+            num_layers=cfg.n_layer, num_heads=cfg.n_head,
+            head_dim=cfg.head_dim, num_kv_heads=cfg.num_kv_heads,
+            dtype=kv_dtype)
+
+    def _warm_replay(speculate):
+        # the engine mode warms every bucket before timing; the
+        # speculate A/B needs the same discipline — one untimed replay
+        # per arm compiles each arm's step shapes so the timed numbers
+        # compare steady-state decode, not XLA compile queues
+        wpool = _fresh_pool()
+        wcache = (serving.PrefixCache(wpool)
+                  if (share > 0 or args.prefix_cache) else None)
+        serving.ContinuousBatchingLoop(
+            params, cfg, wpool, max_batch=args.max_batch,
+            paged_impl=args.paged_impl, prefill=args.prefill,
+            prefix_cache=wcache, prefill_chunk=args.prefill_chunk,
+            speculate=speculate).run(reqs)
+        if wcache is not None:
+            wcache.clear()
+
+    if args.speculate and args.warmup:
+        _warm_replay(args.speculate)
     cache = (serving.PrefixCache(pool)
              if (share > 0 or args.prefix_cache) else None)
     loop = serving.ContinuousBatchingLoop(
         params, cfg, pool, max_batch=args.max_batch,
         paged_impl=args.paged_impl, prefill=args.prefill,
         check_every=1 if chaos else 0, program=program,
-        prefix_cache=cache, prefill_chunk=args.prefill_chunk)
+        prefix_cache=cache, prefill_chunk=args.prefill_chunk,
+        speculate=args.speculate)
     if chaos:
         from paddle_tpu.resilience import faultinject  # noqa: F401
 
@@ -466,6 +529,38 @@ def run_decode_bench(args) -> dict:
     elapsed = time.perf_counter() - t0
     tokens = sum(len(r.tokens) for r in results)
     ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    d0 = None
+    if args.speculate:
+        # the SAME replay at d=0 in the same invocation: the speedup
+        # claim gates against its own contemporaneous baseline, not a
+        # banked number from a different machine/day
+        if args.warmup:
+            _warm_replay(0)
+        pool_d0 = _fresh_pool()
+        cache_d0 = (serving.PrefixCache(pool_d0)
+                    if (share > 0 or args.prefix_cache) else None)
+        loop_d0 = serving.ContinuousBatchingLoop(
+            params, cfg, pool_d0, max_batch=args.max_batch,
+            paged_impl=args.paged_impl, prefill=args.prefill,
+            prefix_cache=cache_d0, prefill_chunk=args.prefill_chunk,
+            speculate=0)
+        t0_d0 = time.perf_counter()
+        results_d0 = loop_d0.run(reqs)
+        elapsed_d0 = time.perf_counter() - t0_d0
+        tokens_d0 = sum(len(r.tokens) for r in results_d0)
+        # greedy speculation is token-identical to d=0 — anything else
+        # is a correctness bug, not a perf result
+        for a, b in zip(results, results_d0):
+            if a.tokens != b.tokens:
+                sys.stderr.write(
+                    "serve_bench: speculative tokens diverged from the "
+                    "d=0 run — refusing to report throughput for "
+                    "wrong output\n")
+                raise SystemExit(2)
+        d0 = {"tokens": tokens_d0, "elapsed": elapsed_d0,
+              "steps": loop_d0.steps}
+        if cache_d0 is not None:
+            cache_d0.clear()
     if cache is not None:
         # release the cache's page holds BEFORE the leak audit: pinned
         # prefix pages are a feature, pages nobody owns are a leak
@@ -504,7 +599,27 @@ def run_decode_bench(args) -> dict:
         # prefill tokens than the cap (bank the cap, gate holds it)
         "prefill_tokens": loop.prefill_tokens,
         "max_prefill_tokens_step": loop.max_prefill_tokens_step,
+        # the sampling scenario the replay ran (greedy keeps the
+        # oracle-identical contract; temp/topk/topp exercise the
+        # jitted epilogue)
+        "sampling": args.sampling,
+        "tokens_per_step": tokens / loop.steps if loop.steps else 0.0,
     }
+    if args.speculate:
+        result.update({
+            "speculate": args.speculate,
+            "spec_steps": loop.spec_steps,
+            "drafted_tokens": loop.drafted_tokens,
+            "accepted_tokens": loop.accepted_tokens,
+            "rolled_back_tokens": loop.rolled_back_tokens,
+            "acceptance_rate": loop.acceptance_rate(),
+            # the contemporaneous d=0 arm and the headline ratio —
+            # bank spec_speedup >= 1 and --gate holds the win
+            "steps_d0": d0["steps"],
+            "tokens_per_s_d0": d0["tokens"] / d0["elapsed"],
+            "spec_speedup": (tokens / elapsed)
+            / (d0["tokens"] / d0["elapsed"]),
+        })
     if cache is not None:
         result.update({
             "prefix_share": share,
@@ -529,7 +644,9 @@ def run_decode_bench(args) -> dict:
 _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "recovered", "invariants_ok", "flight_dumps",
                      "drain_completed", "prefix_hit_rate",
-                     "cached_prefill_tokens")
+                     "cached_prefill_tokens", "acceptance_rate",
+                     "tokens_per_step", "spec_speedup",
+                     "accepted_tokens")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -620,6 +737,20 @@ def main(argv=None) -> int:
                     help="decode mode: KV page element type; int8 "
                          "stores amax-quantized pages with per-page "
                          "fp32 scales (single-device pools only)")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="decode mode: prompt-lookup speculative "
+                         "decoding with N draft tokens per step over a "
+                         "repeated-structure prompt workload; runs a "
+                         "d=0 arm of the same replay in the same "
+                         "invocation and banks acceptance_rate / "
+                         "tokens_per_step / spec_speedup (greedy "
+                         "sampling only)")
+    ap.add_argument("--sampling", default="greedy",
+                    choices=tuple(_SAMPLING_SCENARIOS),
+                    help="decode mode: per-request SamplingParams "
+                         "scenario attached to every request (greedy = "
+                         "none, the oracle-identical arm; temp/topk/"
+                         "topp exercise the jitted sampling epilogue)")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
@@ -687,6 +818,31 @@ def main(argv=None) -> int:
         return 2
     if not 0.0 <= args.prefix_share <= 1.0:
         sys.stderr.write("serve_bench: --prefix-share must be in [0, 1]\n")
+        return 2
+    if (args.speculate or args.sampling != "greedy") \
+            and args.mode != "decode":
+        sys.stderr.write(
+            "serve_bench: --speculate/--sampling need --mode decode\n")
+        return 2
+    if args.speculate < 0:
+        sys.stderr.write("serve_bench: --speculate must be >= 0\n")
+        return 2
+    if args.speculate and args.sampling != "greedy":
+        sys.stderr.write(
+            f"serve_bench: --speculate verifies against the greedy "
+            f"argmax — the {args.sampling!r} sampling scenario makes "
+            "verify non-deterministic; drop one of them\n")
+        return 2
+    if args.speculate and args.mesh > 1:
+        sys.stderr.write(
+            "serve_bench: speculative decoding is single-device-loop "
+            "only (the SPMD program's steps are compiled for Sq=1) — "
+            "drop --mesh or --speculate\n")
+        return 2
+    if args.speculate and args.chaos:
+        sys.stderr.write(
+            "serve_bench: --chaos is a single-replay contract (its "
+            "knobs fire once); run it without --speculate\n")
         return 2
     if args.chaos and args.replicas > 1:
         sys.stderr.write(
