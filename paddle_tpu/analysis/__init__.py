@@ -19,15 +19,28 @@ which core/aot_tpu.py produces on any CPU host.
 (analysis.zoo), banks per-program baselines in AOT_COST_ZOO.json, and
 ``--gate`` exits 3 on any new finding or bytes/step regression — the
 per-PR perf gate that runs with no chip attached.
+
+The KERNEL-INTERIOR tier (analysis.pallas) looks inside pallas_call:
+``kernel_vmem_bytes()`` statically prices a kernel invocation's VMEM
+working set from its BlockSpecs, scratch shapes and scalar-prefetch
+SMEM operands, and the ``vmem-overflow`` / ``scan-widening`` detectors
+catch the chip-only failure classes (out-of-envelope block specs,
+loop carries that silently run wide) before any compile.
 """
 
-from .findings import Finding, SEVERITIES  # noqa: F401
+from .findings import Finding, SEVERITIES, sort_findings  # noqa: F401
 from .capture import (  # noqa: F401
     ProgramArtifacts,
     capture_executor,
     capture_fn,
 )
 from .detectors import DETECTORS, run_detectors  # noqa: F401
+from .pallas import (  # noqa: F401
+    V5E_VMEM_BYTES,
+    kernel_cost,
+    kernel_vmem_bytes,
+)
+from . import pallas  # noqa: F401
 from .zoo import (  # noqa: F401
     ZOO,
     ZooResult,
@@ -42,6 +55,7 @@ __all__ = [
     "Finding",
     "ProgramArtifacts",
     "SEVERITIES",
+    "V5E_VMEM_BYTES",
     "ZOO",
     "ZooResult",
     "bank",
@@ -49,6 +63,9 @@ __all__ = [
     "capture_fn",
     "default_baseline_path",
     "gate",
+    "kernel_cost",
+    "kernel_vmem_bytes",
     "run_detectors",
     "run_zoo",
+    "sort_findings",
 ]

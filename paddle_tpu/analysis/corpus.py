@@ -158,6 +158,55 @@ def _host_callback() -> ProgramArtifacts:
         name="corpus_host_callback")
 
 
+def _vmem_overflow() -> ProgramArtifacts:
+    """The kernel-interior hazard class (ISSUE 14): a BlockSpec working
+    set no v5e core can hold — here a whole-array 64 MB block, double-
+    buffered to 256 MB against a 16 MB VMEM.  Today this class either
+    silently falls back off the fast path or dies in a chip-only Mosaic
+    RESOURCE_EXHAUSTED; the vmem-overflow detector prices it from the
+    traced jaxpr before any compile (the AOT pipeline may well reject
+    the program too — the gate fails either way, which is the point)."""
+    import jax.experimental.pallas as pl
+
+    def _scale_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    N = 4096  # one f32 [N, N] block = 64 MB
+
+    def fn(x):
+        return pl.pallas_call(
+            _scale_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((1, N, N), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, N, N), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((2, N, N), jnp.float32))(x)
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((2, N, N), jnp.float32),
+        name="corpus_vmem_overflow")
+
+
+def _scan_widened_carry() -> ProgramArtifacts:
+    """The scan-carry widening class the ROADMAP names for new hot
+    paths: bf16 rows accumulated into a carry whose init silently
+    traced fp32 (a forgotten dtype= in zeros), so jax forces the whole
+    loop wide — every iteration rewrites the loop-resident buffer at 2x
+    the bytes and the stacked fp32 history escapes to the program
+    output unnarrowed."""
+    def fn(x):  # x: [T, N] bf16 activations
+        def body(c, row):
+            c = c + row  # bf16 row joins the f32 carry -> widens
+            return c, c
+
+        c0 = jnp.zeros((x.shape[1],))  # the bug: traced fp32, not bf16
+        _, history = jax.lax.scan(body, c0, x)
+        return history  # [T, N] fp32 — 2x the bf16 bytes, every step
+
+    return capture_fn(
+        fn, jax.ShapeDtypeStruct((512, 1024), jnp.bfloat16),
+        name="corpus_scan_widening")
+
+
 def _spec_verify_gather() -> ProgramArtifacts:
     """The speculative-verify regression the spec_verify zoo entry
     gates on: a multi-token verify step that re-materializes the full
@@ -210,6 +259,8 @@ CORPUS = {
     "weak_type": (_weak_type_scalar, "recompile-hazard"),
     "bf16_escape": (_bf16_promotion_escape, "dtype-promotion"),
     "host_callback": (_host_callback, "host-sync"),
+    "vmem_overflow": (_vmem_overflow, "vmem-overflow"),
+    "scan_widening": (_scan_widened_carry, "scan-widening"),
     "all_gather_replicated": (_all_gather_replicated,
                               "collective-placement"),
     "gqa_full_pool": (_gqa_full_pool, None),

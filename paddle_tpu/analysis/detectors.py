@@ -31,6 +31,18 @@ AOT_COST_ZOO.json baselines key on them):
                        reduce-scatter would keep shards, the collective
                        moves (and each device then holds) n_shards x
                        the bytes the consumer needed
+  vmem-overflow        a pallas_call whose statically-priced VMEM
+                       working set (double-buffered padded blocks +
+                       scratch — analysis/pallas.py kernel_vmem_bytes)
+                       exceeds the v5e budget: the kernel compiles
+                       nowhere on chip, a failure class that used to be
+                       chip-only
+  scan-widening        a scan/while carry or stacked output that runs
+                       WIDER than the narrow (bf16/fp16) data feeding
+                       it — the init silently traced wide, every
+                       iteration rewrites the loop-resident HBM buffer
+                       at 2x the bytes — where the widened result then
+                       escapes to HBM unnarrowed
 """
 
 from __future__ import annotations
@@ -40,6 +52,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .capture import ProgramArtifacts
 from .findings import Finding
 from . import hlo as H
+from .pallas import detect_vmem_overflow, iter_subjaxprs as _iter_subjaxprs
 
 __all__ = ["DETECTORS", "run_detectors"]
 
@@ -247,32 +260,6 @@ _TRANSPARENT_PRIMS = {
 _CUSTOM_CALL_PRIMS = {"pallas_call", "custom_call", "tpu_custom_call"}
 
 
-def _iter_subjaxprs(jaxpr):
-    """(jaxpr, depth) over the open jaxpr and everything nested in eqn
-    params (pjit bodies, cond branches, scan/while bodies, remat...)."""
-    stack = [(jaxpr, 0)]
-    while stack:
-        j, d = stack.pop()
-        yield j, d
-        for eqn in j.eqns:
-            for v in eqn.params.values():
-                for cj in _closed_jaxprs(v):
-                    stack.append((cj, d + 1))
-
-
-def _closed_jaxprs(v):
-    out = []
-    seen_types = (list, tuple)
-    vals = v if isinstance(v, seen_types) else [v]
-    for item in vals:
-        inner = getattr(item, "jaxpr", None)
-        if inner is not None and hasattr(inner, "eqns"):
-            out.append(inner)
-        elif hasattr(item, "eqns"):
-            out.append(item)
-    return out
-
-
 _MIXING_PRIMS = {"add", "sub", "mul", "div", "max", "min", "select_n",
                  "where", "clamp"}
 
@@ -297,18 +284,19 @@ def _absorbed_by_wide_sibling(var, user) -> bool:
     return False
 
 
-def _escapes(eqn, jaxpr, top_level: bool) -> Optional[str]:
-    """Does the widened value produced by `eqn` reach HBM at full width —
-    a program output (top level only) or a custom-call operand?  Walks
-    forward through transparent elementwise/movement ops; reductions,
-    contractions, unknown ops, and full-width joins with already-wide
-    tensors absorb it (the accumulate-in-fp32 / master-weight idioms)."""
+def _escapes(start_vars, jaxpr, top_level: bool) -> Optional[str]:
+    """Does a widened value (any of `start_vars`) reach HBM at full
+    width — a program output (top level only) or a custom-call operand?
+    Walks forward through transparent elementwise/movement ops;
+    reductions, contractions, unknown ops, and full-width joins with
+    already-wide tensors absorb it (the accumulate-in-fp32 /
+    master-weight idioms)."""
     outvars = {id(v) for v in jaxpr.outvars}
     uses: Dict[int, list] = {}
     for e in jaxpr.eqns:
         for v in e.invars:
             uses.setdefault(id(v), []).append(e)
-    frontier = list(eqn.outvars)
+    frontier = list(start_vars)
     seen = set()
     while frontier:
         var = frontier.pop()
@@ -363,7 +351,7 @@ def detect_dtype_promotions(art: ProgramArtifacts) -> List[Finding]:
                 continue
             if b < _PROMOTION_MIN_BYTES:
                 continue
-            sink = _escapes(eqn, sub, top_level=(depth == 0))
+            sink = _escapes(eqn.outvars, sub, top_level=(depth == 0))
             if sink is None:
                 continue
             findings.append(Finding(
@@ -375,6 +363,122 @@ def detect_dtype_promotions(art: ProgramArtifacts) -> List[Finding]:
                          "activation hits HBM — keep-tier bf16 is "
                          "defeated on this path"),
             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# scan-widening
+
+
+def _loop_body_and_carries(eqn):
+    """(body_jaxpr, num_carry_outvars, label) for a scan/while equation,
+    else None.  A scan body's outvars are [carries..., ys...]; a while
+    body's outvars are all carries."""
+    name = eqn.primitive.name
+    if name == "scan":
+        body = eqn.params["jaxpr"].jaxpr
+        return body, int(eqn.params["num_carry"]), "scan"
+    if name == "while":
+        body = eqn.params["body_jaxpr"].jaxpr
+        return body, len(body.outvars), "while"
+    return None
+
+
+def _body_outvars_reached(conv_eqn, body):
+    """Outvar slots of `body` the widening convert's result reaches
+    through transparent ops (the same propagation rules as _escapes,
+    minus the custom-call/output sinks — here the loop boundary IS the
+    sink)."""
+    uses: Dict[int, list] = {}
+    for e in body.eqns:
+        for v in e.invars:
+            uses.setdefault(id(v), []).append(e)
+    # one var may fill SEVERAL outvar slots (`return c, c` — the carry
+    # also emitted as a stacked output), so every slot must be kept: a
+    # last-wins dict would hide the carry behind a possibly-dead ys
+    out_slots: Dict[int, list] = {}
+    for i, v in enumerate(body.outvars):
+        out_slots.setdefault(id(v), []).append(i)
+    reached = set()
+    frontier = list(conv_eqn.outvars)
+    seen = set()
+    while frontier:
+        var = frontier.pop()
+        if id(var) in seen:
+            continue
+        seen.add(id(var))
+        reached.update(out_slots.get(id(var), ()))
+        for user in uses.get(id(var), []):
+            prim = user.primitive.name
+            if prim == "convert_element_type":
+                continue  # narrowed (or re-widened) — a different value
+            if prim in _TRANSPARENT_PRIMS or prim in _MIXING_PRIMS:
+                frontier.extend(user.outvars)
+    return reached
+
+
+def detect_scan_widening(art: ProgramArtifacts) -> List[Finding]:
+    """Scan/while carries (and scan's stacked ys) that run WIDER than
+    the narrow data feeding them: a bf16/fp16 value widened inside the
+    loop body reaches the body's outvars, so every iteration rewrites
+    the loop-resident HBM buffer — and the stacked history — at the
+    wide dtype (an init that silently traced fp32 is how the carry got
+    wide in the first place; jax then forces the whole loop to follow).
+    Flagged only when the loop's widened RESULT also escapes to HBM
+    unnarrowed (program output / custom-call operand) above the size
+    floor — a deliberate fp32 accumulator that narrows or reduces
+    before the write stays clean, the dtype-promotion contract."""
+    closed = art.jaxpr
+    jaxpr = getattr(closed, "jaxpr", closed)
+    if jaxpr is None:
+        return []
+    findings: List[Finding] = []
+    for sub, depth in _iter_subjaxprs(jaxpr):
+        for eqn in sub.eqns:
+            parts = _loop_body_and_carries(eqn)
+            if parts is None:
+                continue
+            body, num_carry, label = parts
+            flagged = set()
+            for beqn in body.eqns:
+                if beqn.primitive.name != "convert_element_type":
+                    continue
+                src = getattr(beqn.invars[0], "aval", None)
+                dst = getattr(beqn.outvars[0], "aval", None)
+                if src is None or dst is None:
+                    continue
+                if (str(src.dtype), str(dst.dtype)) not in _WIDENING:
+                    continue
+                for slot in sorted(_body_outvars_reached(beqn, body)):
+                    if slot in flagged or slot >= len(eqn.outvars):
+                        continue
+                    out = eqn.outvars[slot]
+                    aval = getattr(out, "aval", None)
+                    if aval is None or str(aval.dtype) != str(dst.dtype):
+                        continue
+                    b = _aval_bytes(aval)
+                    if b < _PROMOTION_MIN_BYTES:
+                        continue
+                    sink = _escapes([out], sub, top_level=(depth == 0))
+                    if sink is None:
+                        continue
+                    flagged.add(slot)
+                    kind = ("carry" if slot < num_carry
+                            else "stacked output")
+                    findings.append(Finding(
+                        detector="scan-widening", severity="warning",
+                        program=art.name, fingerprint=art.fingerprint,
+                        bytes=b, where=f"{label} {kind} {slot}",
+                        message=(f"{label} {kind} {slot} runs "
+                                 f"{dst.dtype} over {src.dtype} data "
+                                 f"joined inside the body: the loop "
+                                 f"rewrites it wide every iteration and "
+                                 f"the widened result escapes to {sink} "
+                                 f"({b} bytes) — the carry's init traced "
+                                 "wide (a forgotten dtype=), defeating "
+                                 "the keep-narrow tier on the whole "
+                                 "loop"),
+                    ))
     return findings
 
 
@@ -507,8 +611,11 @@ DETECTORS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "missed-donation": detect_missed_donation,
     "recompile-hazard": detect_recompile_hazards,
     "dtype-promotion": detect_dtype_promotions,
+    "scan-widening": detect_scan_widening,
     "host-sync": detect_host_sync,
     "collective-placement": detect_collective_placement,
+    # kernel-interior tier (analysis/pallas.py): inside the custom call
+    "vmem-overflow": detect_vmem_overflow,
 }
 
 

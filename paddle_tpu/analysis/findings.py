@@ -10,9 +10,9 @@ into AOT_COST_ZOO.json and diff them in CI.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
-__all__ = ["Finding", "SEVERITIES"]
+__all__ = ["Finding", "SEVERITIES", "sort_findings"]
 
 # ordered weakest -> strongest; gate policy treats every severity as
 # gating (a new `info` finding is still a new hazard), severity exists
@@ -35,6 +35,10 @@ class Finding:
                the hazard is program-wide)
     fingerprint : program fingerprint (sha1 of the TPU StableHLO, or the
                ProgramDesc fingerprint for executor programs)
+    vmem_bytes / budget : kernel-interior findings only (vmem-overflow):
+               the statically-priced VMEM working set and the budget it
+               busted — on-chip residency, not HBM traffic, hence
+               separate from ``bytes``
     """
 
     detector: str
@@ -44,6 +48,8 @@ class Finding:
     bytes: int = 0
     where: str = ""
     fingerprint: str = ""
+    vmem_bytes: Optional[int] = None
+    budget: Optional[int] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -61,15 +67,34 @@ class Finding:
             "where": self.where,
             "fingerprint": self.fingerprint,
         }
+        if self.vmem_bytes is not None:
+            d["vmem_bytes"] = int(self.vmem_bytes)
+        if self.budget is not None:
+            d["budget"] = int(self.budget)
         if self.extra:
             d["extra"] = self.extra
         return d
 
     def format(self) -> str:
         cost = f" [{_fmt_bytes(self.bytes)}]" if self.bytes else ""
+        if self.vmem_bytes is not None:
+            cost += (f" [vmem {_fmt_bytes(self.vmem_bytes)}"
+                     + (f" / budget {_fmt_bytes(self.budget)}"
+                        if self.budget is not None else "") + "]")
         loc = f" @ {self.where}" if self.where else ""
         return (f"{self.severity.upper():7} {self.detector:24} "
                 f"{self.program}{loc}{cost}: {self.message}")
+
+
+def sort_findings(findings):
+    """Severity-then-bytes ordering (strongest severity first, biggest
+    cost first, then stable lexical keys) — the one order every report
+    and banked JSON uses, so gate diffs never churn on dict/detector
+    iteration order."""
+    return sorted(findings, key=lambda f: (
+        -SEVERITIES.index(f.severity),
+        -max(int(f.bytes), int(f.vmem_bytes or 0)),
+        f.detector, f.where, f.message))
 
 
 def _fmt_bytes(n: int) -> str:

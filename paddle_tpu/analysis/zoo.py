@@ -70,7 +70,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .capture import ProgramArtifacts, capture_executor, capture_fn
 from .detectors import run_detectors
-from .findings import Finding
+from .findings import Finding, sort_findings
 
 __all__ = ["ZOO", "ZooResult", "run_zoo", "bank", "gate",
            "default_baseline_path"]
@@ -329,7 +329,11 @@ def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
         (dcfg.n_layer, dcfg.n_head, num_pages, ps, dcfg.head_dim),
         jnp.float32)
     rep = NamedSharding(mesh, PartitionSpec())
-    kv_sh = NamedSharding(mesh, kv_spec)
+    # the layout-consumption contract (ISSUE 14): the pool args carry
+    # the XLA-preferred {3,0,2,1}-major shard layout the paged kernel's
+    # pool_layout="xla" arm consumes — banked relayout-copy-pair count
+    # is 0 BY CONSTRUCTION, and the gate holds it there
+    kv_io = _sh.kv_pool_layout(NamedSharding(mesh, kv_spec))
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), _sh.param_partition_specs(dcfg),
         is_leaf=lambda x: isinstance(x, PartitionSpec))
@@ -340,8 +344,8 @@ def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
         topology=topo,
         # the pool shards alias in->out (the on-chip in-place append)
         donate_argnums=(7, 8),
-        in_shardings=(param_sh,) + (rep,) * 6 + (kv_sh, kv_sh),
-        out_shardings=(rep, kv_sh, kv_sh))
+        in_shardings=(param_sh,) + (rep,) * 6 + (kv_io, kv_io),
+        out_shardings=(rep, kv_io, kv_io))
     # per-chip analytic page-stream share: each chip walks its OWN
     # heads' pages (H/n of the batch's KV traffic), invisible to the
     # XLA cost model like the single-device paged_decode entry
@@ -442,7 +446,10 @@ def run_zoo(programs: Optional[Sequence[str]] = None,
         if progress:
             progress(f"captured {art.name} "
                      f"({art.bytes_per_step / 1e6:.1f} MB/step xla-visible)")
-        findings = run_detectors(art, detectors)
+        # severity-then-bytes order everywhere findings surface (report
+        # text and --json alike) so gate diffs never churn on detector
+        # iteration order
+        findings = sort_findings(run_detectors(art, detectors))
         results.append(ZooResult(
             name=art.name,
             artifacts=art,

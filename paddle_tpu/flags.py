@@ -136,6 +136,13 @@ _DEFS: Dict[str, Any] = {
     # log — never a Mosaic compile failure); "interpret" runs the pallas
     # kernel under the interpreter (CPU parity testing)
     "FLAGS_serving_paged_impl": "auto",
+    # chip-less linter (paddle_tpu/analysis/pallas.py): the v5e VMEM
+    # budget the vmem-overflow detector prices every pallas_call's
+    # statically-estimated working set (double-buffered padded blocks +
+    # scratch) against.  Default: the full 16 MiB/core
+    # (analysis.pallas.V5E_VMEM_BYTES); lower it to lint with headroom
+    # for compiler spills, raise it only for a different chip
+    "FLAGS_analysis_vmem_budget": 16 * 1024 * 1024,
     # chunked prefill (serving/generate.py): cap on PREFILL tokens one
     # engine step may process across the batch.  0 (default) is
     # uncapped — whole prompts prefill in one pass.  With a cap, long

@@ -40,9 +40,37 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fwd_vmem_bytes"]
 
 NEG_INF = -1e30
+
+
+def fwd_vmem_bytes(block_q: int = 128, block_k: int = 128,
+                   head_dim: int = 128, num_q_blocks: int = 1,
+                   dtype="float32", emit_lse: bool = True) -> int:
+    """Analytic VMEM working set of ONE forward pallas invocation — the
+    kernel's own statement of the linter's pricing model
+    (paddle_tpu.analysis.pallas.kernel_vmem_bytes; tests hold the two
+    equal on the traced call): the double-buffered padded q/k/v/o
+    blocks (+ the packed lse plane when emitted) plus the fp32
+    online-softmax scratch.  The SMEM klen vector is outside VMEM.
+    Default blocks at d=128 sit near 0.5 MB — an order of magnitude
+    under the v5e budget, which is why this kernel never needed a tile
+    planner (conv_epilogue._plan is the shape that does)."""
+    from ..analysis.pallas import tile_padded_bytes
+
+    blocks = [
+        ((1, block_q, head_dim), dtype),   # q
+        ((1, block_k, head_dim), dtype),   # k
+        ((1, block_k, head_dim), dtype),   # v
+        ((1, block_q, head_dim), dtype),   # o
+    ]
+    if emit_lse:
+        blocks.append(((1, num_q_blocks, block_q), "float32"))
+    scratch = [((block_q, 1), "float32"), ((block_q, 1), "float32"),
+               ((block_q, head_dim), "float32")]
+    return (2 * sum(tile_padded_bytes(s, d) for s, d in blocks)
+            + sum(tile_padded_bytes(s, d) for s, d in scratch))
 
 # The per-row logsumexp/D residuals are PACKED: [B*H, num_q_blocks,
 # block_q] fp32, row qi of the packed plane holding q-block qi's

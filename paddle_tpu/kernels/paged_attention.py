@@ -64,12 +64,29 @@ K/V page block is natively (sublane, lane)-tiled — the constraint
 class that produced the flash residual-layout and conv-epilogue
 'non-native tiling' chip failures.
 
-Pool layout is KERNEL-NATIVE: [H_kv, P, page_size, D] per layer (heads
-outermost), so a (1, 1, page_size, D) page block's last two dims are
-exactly (page_size, head_dim) — Mosaic-tileable without relayout.  The
-decode query rides as a [B, H_kv, G_pad, D] block (the group's rows
-zero-padded to a whole fp32 sublane; padded rows compute discarded
-lanes) for the same reason.
+Pool layout is KERNEL-NATIVE by default: [H_kv, P, page_size, D] per
+layer (heads outermost), so a (1, 1, page_size, D) page block's last
+two dims are exactly (page_size, head_dim) — Mosaic-tileable without
+relayout.  The decode query rides as a [B, H_kv, G_pad, D] block (the
+group's rows zero-padded to a whole fp32 sublane; padded rows compute
+discarded lanes) for the same reason.
+
+LAYOUT CONSUMPTION (ISSUE 14 — the ROADMAP "layout tax" erased).  When
+the pool is scatter-updated INSIDE the same program (the SPMD decode
+step's in-place K/V append), XLA prefers the {3,0,2,1}-major layout on
+the [H_kv, P, ps, D] slice — physical [P, ps, H_kv, D], the order the
+one-row-per-token append writes — and a kernel pinning row-major
+forces a relayout copy-pair around the custom call.
+``pool_layout="xla"`` makes the lowering CONSUME the preferred layout
+instead: the K/V operands are re-viewed as [P, ps, H_kv*D] (a
+transpose+reshape that is physically the identity on the preferred
+layout, so XLA folds it to a bitcast), the page block becomes
+(1, ps, D) — still natively (sublane, lane)-tiled — and the index map
+picks the head's D-column window on the packed feature dim.
+serving/distributed/sharded.py pins the same layout at the program
+boundary (``kv_pool_layout``), so the donated pool lives relayout-free
+across its serving life; the banked ``sharded_decode`` zoo entry holds
+relayout-copy-pair at 0 and the ~20% bytes/step win.
 
 MULTI-TOKEN VERIFY (ISSUE 13 — speculative decoding).  The decode
 query generalizes to ``Sq = 1 + d`` rows per sequence: the last
@@ -329,7 +346,7 @@ def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
 
 
 def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
-                  quantized, sq, group):
+                  quantized, sq, group, slot_major):
     """Grid (B, H_kv, max_pages); pages innermost so the online-softmax
     state for one (sequence, KV head) lives in VMEM scratch across the
     page walk.  tables_ref/lengths_ref are SMEM scalar-prefetch refs:
@@ -371,8 +388,15 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     q = q_ref[0, 0]  # [rows_pad, D] — the KV head's query group/block
-    k = k_ref[0, 0]  # [page_size, D]
-    v = v_ref[0, 0]
+    if slot_major:
+        # layout-consuming K/V view (pool_layout="xla"): the operand is
+        # [P, ps, H_kv*D] — page outermost, this head's D-column block
+        # picked by the index map — so the block is already [ps, D]
+        k = k_ref[0]
+        v = v_ref[0]
+    else:
+        k = k_ref[0, 0]  # [page_size, D]
+        v = v_ref[0, 0]
     if quantized:
         page = tables_ref[b, p]
         k = k.astype(jnp.float32) * k_scales_ref[page]
@@ -410,14 +434,18 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
 
 @functools.lru_cache(maxsize=128)
 def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
-                scale, kv_dtype, interpret, quantized, sq, group):
+                scale, kv_dtype, interpret, quantized, sq, group,
+                slot_major=False):
     """Memoized pallas_call — one traced callable per static config, so
     every decode layer/step of a model reuses ONE kernel payload (the
     flash_attention._fwd_call compile-cache contract).  ``sq`` is the
     (padded-max) query tokens per sequence — 1 for plain decode, 1+d
     for a speculative verify step, which adds the ragged ``q_lengths``
     scalar-prefetch operand; ``rows_pad`` is sq*group rounded up to a
-    whole sublane."""
+    whole sublane.  ``slot_major`` switches the K/V operands to the
+    layout-consuming [P, ps, H_kv*D] view (pool_layout="xla"): the page
+    block is then (1, ps, D) — still natively (sublane, lane)-tiled —
+    with this head's columns picked on the packed feature dim."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -433,20 +461,24 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
         pad = lambda f: f
     else:
         pad = lambda f: (lambda b, h, p, t, l, *rest: f(b, h, p, t, l))
+    if slot_major:
+        kv_spec = pl.BlockSpec(
+            (1, page_size, head_dim),
+            pad(lambda b, h, p, tables, lengths: (tables[b, p], 0, h)))
+    else:
+        # the page walk: the SMEM table entry picks which pool page
+        # the next grid step DMAs — no gather ever materializes
+        kv_spec = pl.BlockSpec(
+            (1, 1, page_size, head_dim),
+            pad(lambda b, h, p, tables, lengths: (h, tables[b, p], 0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_prefetch,
         grid=(batch, kv_heads, max_pages),
         in_specs=[
             pl.BlockSpec((1, 1, rows_pad, head_dim),
                          pad(lambda b, h, p, tables, lengths: (b, h, 0, 0))),
-            # the page walk: the SMEM table entry picks which pool page
-            # the next grid step DMAs — no gather ever materializes
-            pl.BlockSpec((1, 1, page_size, head_dim),
-                         pad(lambda b, h, p, tables, lengths:
-                             (h, tables[b, p], 0, 0))),
-            pl.BlockSpec((1, 1, page_size, head_dim),
-                         pad(lambda b, h, p, tables, lengths:
-                             (h, tables[b, p], 0, 0))),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=pl.BlockSpec(
             (1, 1, rows_pad, head_dim),
@@ -459,7 +491,8 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
     )
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, page_size=page_size,
-                          quantized=quantized, sq=sq, group=group),
+                          quantized=quantized, sq=sq, group=group,
+                          slot_major=slot_major),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             (batch, kv_heads, rows_pad, head_dim), out_dt),
@@ -469,9 +502,9 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
 
 def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
                   interpret=False, k_scales=None, v_scales=None,
-                  q_lengths=None):
+                  q_lengths=None, slot_major=False):
     B, Hq, Sq, D = q.shape
-    Hkv, _, page_size, _ = k_pages.shape
+    Hkv, P, page_size, _ = k_pages.shape
     G = Hq // Hkv
     rows = Sq * G
     rows_pad = -(-rows // _SQ_PAD) * _SQ_PAD
@@ -489,9 +522,22 @@ def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
         qg = q[:, :, 0, :].reshape(B, Hkv, G, D)
     qg = qg.astype(jnp.float32 if quantized else k_pages.dtype)
     qp = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_pad - rows), (0, 0)))
+    if slot_major:
+        # the layout-consuming view (pool_layout="xla"): re-express the
+        # kernel-native [H_kv, P, ps, D] pool slice as [P, ps, H_kv*D].
+        # Logically a transpose+reshape; physically it is EXACTLY the
+        # {3,0,2,1} layout XLA prefers for a scatter-updated pool (the
+        # in-place K/V append writes one [H, D] row per token, so XLA
+        # wants D, then H, innermost) — layout assignment folds both
+        # ops into a bitcast and the custom call consumes the preferred
+        # layout instead of forcing a row-major relayout copy-pair
+        k_pages = k_pages.transpose(1, 2, 0, 3).reshape(P, page_size,
+                                                        Hkv * D)
+        v_pages = v_pages.transpose(1, 2, 0, 3).reshape(P, page_size,
+                                                        Hkv * D)
     call = _paged_call(B, Hkv, rows_pad, tables.shape[1], page_size, D,
                        float(scale), str(k_pages.dtype), interpret,
-                       quantized, Sq, G)
+                       quantized, Sq, G, slot_major=slot_major)
     args = [tables, lengths]
     if Sq > 1:
         ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
@@ -505,10 +551,14 @@ def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
     return out.astype(q.dtype)
 
 
+_POOL_LAYOUTS = ("head", "xla")
+
+
 def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
                            scale=None, impl: str | None = None,
                            force: str = "auto", k_scales=None,
-                           v_scales=None, q_lengths=None):
+                           v_scales=None, q_lengths=None,
+                           pool_layout: str = "head"):
     """q: [B, H_q, Sq, D] decode queries — Sq=1 for plain decode, Sq =
     1+d for a speculative multi-token verify step (the last committed
     token plus d drafted continuations, ISSUE 13); k_pages/v_pages:
@@ -536,7 +586,21 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
 
     `impl`: None reads FLAGS_serving_paged_impl; see resolve_paged_impl
     for the auto/envelope/fallback contract.  `force` forwards to
-    flash_attention (single-token reference impl only)."""
+    flash_attention (single-token reference impl only).
+
+    ``pool_layout`` is the layout-consumption contract (the ROADMAP
+    "layout tax" fix): ``"head"`` (default) pins the kernel-native
+    row-major [H_kv, P, ps, D] operand — right when the pool is a plain
+    program parameter (nothing upstream prefers another layout);
+    ``"xla"`` has the pallas lowering consume XLA's preferred layout
+    for a pool that is scatter-updated INSIDE the same program (the
+    SPMD decode step's in-place append): the K/V operands are re-viewed
+    as [P, ps, H_kv*D] — physically identical to the {3,0,2,1} layout
+    XLA assigns the scatter result, so the transpose+reshape folds to a
+    bitcast and no relayout copy-pair brackets the custom call.  The
+    arguments are ALWAYS passed head-major; the view lives entirely in
+    the lowering, and the reference/interpret tiers compute identically
+    under either contract (parity-tested)."""
     if q.ndim != 4:
         raise ValueError(f"decode query must be [B, H, Sq, D], got {q.shape}")
     Sq = q.shape[2]
@@ -546,6 +610,10 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
         raise ValueError(
             "q_lengths is the multi-token verify contract — a single-"
             "token decode step has nothing ragged to mask")
+    if pool_layout not in _POOL_LAYOUTS:
+        raise ValueError(
+            f"pool_layout must be one of {_POOL_LAYOUTS}, got "
+            f"{pool_layout!r}")
     G = _group_size(q.shape[1], k_pages.shape[0])
     if (k_scales is None) != (v_scales is None):
         raise ValueError("k_scales and v_scales must be passed together")
@@ -561,7 +629,8 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
         return _pallas_paged(q, k_pages, v_pages, page_tables, lengths,
                              scale, interpret=(impl == "interpret"),
                              k_scales=k_scales, v_scales=v_scales,
-                             q_lengths=q_lengths)
+                             q_lengths=q_lengths,
+                             slot_major=(pool_layout == "xla"))
     # dequantized pools gather straight to fp32; bf16/fp32 pools pass
     # through at the POOL dtype (no widening copy — the byte model
     # prices the copy terms at the pool itemsize)

@@ -66,10 +66,12 @@ from ..generate import DecodeConfig, _layernorm
 from ..kvcache import KVCachePool
 
 __all__ = [
+    "KV_POOL_MAJOR_TO_MINOR",
     "ShardedDecodeProgram",
     "ShardedKVCachePool",
     "decode_step_fn",
     "host_mesh_devices",
+    "kv_pool_layout",
     "param_partition_specs",
     "param_shape_dtypes",
     "prefill_step_fn",
@@ -141,6 +143,31 @@ def _kv_spec(axis: str = AXIS_TP) -> P:
     return P(None, axis, None, None, None)
 
 
+# The pool-shard LAYOUT contract (the ROADMAP "layout tax" fix, ISSUE
+# 14).  The SPMD step scatter-updates the pool in place (one [H, D] row
+# per appended token), so XLA prefers D, then H, innermost — physical
+# [L, P, ps, H, D], i.e. major_to_minor (0, 2, 3, 1, 4) on the logical
+# [L, H, P, ps, D] arrays — and the paged kernel's pool_layout="xla"
+# arm consumes exactly that view.  Requesting it at the program
+# boundary (entry params AND outputs — the donated pool aliases, so
+# they must agree) erases every relayout copy: the banked
+# sharded_decode zoo entry pins relayout-copy-pair at 0 and the
+# bytes/step win.  Verified against DeviceLocalLayout.AUTO: XLA picks
+# this same layout when left free.
+KV_POOL_MAJOR_TO_MINOR = (0, 2, 3, 1, 4)
+
+
+def kv_pool_layout(sharding: NamedSharding):
+    """The XLA-preferred pool-shard layout wrapped over `sharding` — the
+    in/out sharding entry the kv pool args carry on TPU compiles (the
+    AOT zoo capture and the real TPU program use the same one)."""
+    from jax.experimental.layout import DeviceLocalLayout, Layout
+
+    return Layout(
+        DeviceLocalLayout(major_to_minor=KV_POOL_MAJOR_TO_MINOR),
+        sharding)
+
+
 # ---------------------------------------------------------------------------
 # the SPMD step bodies (pure; every array a shard_map gives them is the
 # LOCAL shard — H_local = n_head / n_shards heads per device)
@@ -190,6 +217,12 @@ def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
             attn = paged_decode_attention(
                 q[:, :, None, :], k_pages[li], v_pages[li],
                 tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
+                # the pool was scatter-updated two lines up, INSIDE this
+                # program: consume the layout XLA prefers for that
+                # scatter instead of pinning kernel-native row-major —
+                # this is what drives the banked sharded_decode
+                # relayout-copy-pair count to zero
+                pool_layout="xla",
             )  # [B, H_local, 1, Dh]
             attn = attn[:, :, 0, :].reshape(B, H_local * Dh)
             # row-parallel wo: each shard's heads contribute a [B, d]
@@ -319,8 +352,14 @@ class ShardedKVCachePool(KVCachePool):
                          head_dim, dtype=dtype, name=name,
                          num_kv_heads=num_kv_heads)
         self.sharding = NamedSharding(mesh, _kv_spec(axis))
-        self.k_pages = jax.device_put(self.k_pages, self.sharding)
-        self.v_pages = jax.device_put(self.v_pages, self.sharding)
+        # TPU: place the pool in the XLA-preferred layout from birth
+        # (kv_pool_layout) so the first step never reshards; CPU has no
+        # layout choice
+        placement = (kv_pool_layout(self.sharding)
+                     if mesh.devices.flat[0].platform == "tpu"
+                     else self.sharding)
+        self.k_pages = jax.device_put(self.k_pages, placement)
+        self.v_pages = jax.device_put(self.v_pages, placement)
 
     @property
     def heads_per_shard(self) -> int:
@@ -427,10 +466,25 @@ class ShardedDecodeProgram:
         # check_vma off: pallas_call has no replication rule, and the
         # logits ARE replicated by construction (every shard holds the
         # same psum-joined activations) — tests pin bit-identity
-        return jax.jit(jax.shard_map(
+        fn = jax.shard_map(
             body, mesh=self.mesh,
             in_specs=(self._pspecs,) + (rep,) * 6 + (kv, kv),
-            out_specs=(rep, kv, kv), check_vma=False))
+            out_specs=(rep, kv, kv), check_vma=False)
+        if self.mesh.devices.flat[0].platform != "tpu":
+            # CPU meshes have no layout choice to make — and no tax
+            return jax.jit(fn)
+        # TPU: pin the pool args/results (aliased across steps via
+        # store()) to the XLA-preferred layout the kernel consumes, so
+        # the pool lives relayout-free across the whole serving life
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+        kv_io = kv_pool_layout(ns(kv))
+        param_sh = jax.tree_util.tree_map(
+            ns, self._pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(
+            fn,
+            in_shardings=(param_sh,) + (ns(rep),) * 6 + (kv_io, kv_io),
+            out_shardings=(ns(rep), kv_io, kv_io))
 
     def _decode(self):
         if self._decode_jit is None:

@@ -841,6 +841,8 @@ class ContinuousBatchingLoop:
                 # would poison a later reader through masked weights)
                 self.pool.scrub_seq_pages(a.seq_id)
                 self.pool.free_seq(a.seq_id)
+                if getattr(self.drafter, "stateful", False):
+                    self.drafter.release(a.seq_id)
                 reserved_pages -= a.charged
                 if self.prefix_cache is not None:
                     if a.matched:
@@ -930,6 +932,8 @@ class ContinuousBatchingLoop:
                 reserved_pages -= a.charged
                 if self.prefix_cache is not None:
                     self.prefix_cache.forget_seq(a.seq_id)
+                if getattr(self.drafter, "stateful", False):
+                    self.drafter.release(a.seq_id)
                 if obs_on:
                     _smetrics.record_sequence("retired")
                     kept = False
@@ -1161,10 +1165,18 @@ class ContinuousBatchingLoop:
                     if room > 0 and self.drafter is not None:
                         # clamp to room: a custom drafter ignoring its
                         # max_draft must not breach the pad_to width or
-                        # the admission page reservation
-                        blk += list(self.drafter.draft(
-                            list(a.result.prompt) + a.result.tokens,
-                            room))[:room]
+                        # the admission page reservation.  A stateful
+                        # drafter (PromptLookupDrafter) gets the seq_id
+                        # so its incremental suffix index answers the
+                        # probe in O(d) instead of re-scanning the
+                        # whole context every step
+                        ctx = list(a.result.prompt) + a.result.tokens
+                        if getattr(self.drafter, "stateful", False):
+                            proposal = self.drafter.draft(
+                                ctx, room, seq_id=a.seq_id)
+                        else:
+                            proposal = self.drafter.draft(ctx, room)
+                        blk += list(proposal)[:room]
                     blocks.append(blk)
                 t0 = time.perf_counter()
                 step_idx = self.steps
@@ -1321,6 +1333,8 @@ class ContinuousBatchingLoop:
                 self.pool.free_seq(a.seq_id)
                 if self.prefix_cache is not None:
                     self.prefix_cache.forget_seq(a.seq_id)
+                if getattr(self.drafter, "stateful", False):
+                    self.drafter.release(a.seq_id)
             active.clear()
             raise
         return results

@@ -18,20 +18,81 @@ model step, no speculative weights — so a miss costs only the wasted
 query rows of the verify step (KV bytes are flat in d), and acceptance
 is decided by the verifier, never trusted.
 
-``PromptLookupDrafter`` is deliberately stateless across calls: the
-loop hands it each sequence's full visible context (prompt + generated
-tokens) every step, so quarantine/rollback can never desynchronize a
-cached index.  Contexts at serving scale are a few thousand tokens and
-the scan is a reversed O(n * len) suffix walk from the longest n-gram
-down — cheap next to a model step; an incremental hash index is the
-obvious upgrade if profiles ever say otherwise.
+INCREMENTAL INDEX (ROADMAP speculative item 3).  The original lookup
+was a reversed O(len) suffix scan per step — fine at test scale, not
+at 32k contexts where every decode step would re-walk the whole
+history.  With a ``seq_id`` the drafter now maintains a per-sequence
+suffix map (n-gram -> ascending occurrence positions) updated as
+tokens COMMIT: each call diffs the handed context against the cached
+one at the longest common prefix, rewinds the index over rolled-back
+tokens (``truncate_seq`` rejections land here — the next call's
+context is shorter/diverged, and every n-gram the dead tokens
+registered pops back off), then extends it over the new commits.  Per
+step that is O(d * max_ngram) map maintenance plus an O(occurrences)
+probe lookup — the per-step n-gram SCAN no longer grows with context
+length.  (A linear residual remains: the loop still hands the FULL
+visible context every call, so each call pays one O(len) list
+copy + common-prefix compare.  That is a cheap branch-free pass next
+to the old per-n-gram pattern scan, and it is what keeps the context
+the source of truth: the index is only an accelerator, a
+desynchronized cache is impossible by construction, and a stateless
+call (``seq_id=None``) still works and must agree exactly — the
+parity tests hold the two paths identical over random commit/rollback
+histories.  Passing deltas instead of contexts would shave the copy
+but put correctness at the mercy of every caller's bookkeeping.)
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["PromptLookupDrafter"]
+
+
+class _SeqIndex:
+    """One sequence's committed tokens + suffix map.
+
+    ``occ`` maps each n-gram (min_ngram..max_ngram) to the ASCENDING
+    list of its occurrence start positions; ``added[j]`` records the
+    n-gram keys registered when token j committed (the ones ENDING at
+    j), so a rollback pops exactly what the dead tokens added."""
+
+    __slots__ = ("tokens", "occ", "added")
+
+    def __init__(self) -> None:
+        self.tokens: List[int] = []
+        self.occ: Dict[Tuple[int, ...], List[int]] = {}
+        self.added: List[List[Tuple[int, ...]]] = []
+
+    def sync(self, ctx: List[int], min_ngram: int, max_ngram: int) -> None:
+        """Re-sync to `ctx`: rewind past the longest common prefix,
+        then extend over the new commits."""
+        old = self.tokens
+        common = 0
+        limit = min(len(old), len(ctx))
+        while common < limit and old[common] == ctx[common]:
+            common += 1
+        for j in range(len(old) - 1, common - 1, -1):
+            for key in self.added[j]:
+                stack = self.occ[key]
+                stack.pop()  # occurrences end-ordered: the tail is j's
+                if not stack:
+                    del self.occ[key]
+        del self.tokens[common:]
+        del self.added[common:]
+        for j in range(common, len(ctx)):
+            tok = ctx[j]
+            self.tokens.append(tok)
+            keys: List[Tuple[int, ...]] = []
+            for n in range(min_ngram, max_ngram + 1):
+                i = j - n + 1
+                if i < 0:
+                    break
+                key = tuple(self.tokens[i:j + 1])
+                self.occ.setdefault(key, []).append(i)
+                keys.append(key)
+            self.added.append(keys)
 
 
 class PromptLookupDrafter:
@@ -44,31 +105,92 @@ class PromptLookupDrafter:
     matches the most recent wins (local structure beats distant
     structure in chat/code traffic).  Returns [] when nothing matches —
     the loop then runs a plain d=0 decode step for that sequence, so a
-    drafter can never make a step WORSE than unspeculated decode."""
+    drafter can never make a step WORSE than unspeculated decode.
+
+    ``seq_id`` routes the call through the incremental per-sequence
+    suffix index (module docstring) — the serving loop passes it (the
+    ``stateful`` attribute advertises support) and calls
+    :meth:`release` when a sequence retires; an LRU cap
+    (``max_sequences``) bounds host memory regardless."""
+
+    stateful = True  # the loop may pass seq_id= and call release()
 
     def __init__(self, max_draft: int = 4, max_ngram: int = 3,
-                 min_ngram: int = 1):
+                 min_ngram: int = 1, max_sequences: int = 1024):
         if max_draft < 1:
             raise ValueError(f"max_draft must be >= 1, got {max_draft}")
         if not 1 <= min_ngram <= max_ngram:
             raise ValueError(
                 f"need 1 <= min_ngram <= max_ngram, got "
                 f"{min_ngram}..{max_ngram}")
+        if max_sequences < 1:
+            raise ValueError(
+                f"max_sequences must be >= 1, got {max_sequences}")
         self.max_draft = int(max_draft)
         self.max_ngram = int(max_ngram)
         self.min_ngram = int(min_ngram)
+        self.max_sequences = int(max_sequences)
+        self._index: "OrderedDict[int, _SeqIndex]" = OrderedDict()
 
-    def draft(self, context: Sequence[int],
-              max_draft: int = None) -> List[int]:
+    def release(self, seq_id: int) -> None:
+        """Drop a retired sequence's index (the loop calls this on
+        retirement; the LRU cap covers anyone who forgets)."""
+        self._index.pop(seq_id, None)
+
+    def tracked_sequences(self) -> int:
+        return len(self._index)
+
+    def draft(self, context: Sequence[int], max_draft: int = None,
+              seq_id: Optional[int] = None) -> List[int]:
         """Propose continuation tokens for `context` (prompt + generated
         history, oldest first).  `max_draft` caps the proposal below
         the drafter's own limit (the loop passes the sequence's
-        remaining max_new headroom)."""
+        remaining max_new headroom).  With `seq_id` the incremental
+        index answers the probe; without it a one-shot reversed scan
+        does (identical output, O(len) per call)."""
         limit = self.max_draft if max_draft is None else \
             min(self.max_draft, int(max_draft))
         if limit < 1:
             return []
         ctx = [int(t) for t in context]
+        if seq_id is None:
+            return self._scan_draft(ctx, limit)
+        idx = self._index.get(seq_id)
+        if idx is None:
+            idx = _SeqIndex()
+            self._index[seq_id] = idx
+            while len(self._index) > self.max_sequences:
+                self._index.popitem(last=False)
+        else:
+            self._index.move_to_end(seq_id)
+        idx.sync(ctx, self.min_ngram, self.max_ngram)
+        return self._indexed_draft(idx, ctx, limit)
+
+    def _indexed_draft(self, idx: _SeqIndex, ctx: List[int],
+                       limit: int) -> List[int]:
+        """The scan's exact decision rule answered from the suffix map:
+        walk the probe's occurrences newest-first; a full-length
+        continuation wins outright, the longest partial is the cross-n
+        fallback (matches near the end truncate — the self-repetition
+        case)."""
+        L = len(ctx)
+        best: List[int] = []
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            probe = tuple(ctx[L - n:])
+            for i in reversed(idx.occ.get(probe, ())):
+                if i >= L - n:
+                    continue  # the suffix itself is not a match
+                out = ctx[i + n:i + n + limit]
+                if len(out) == limit:
+                    return out
+                if len(out) > len(best):
+                    best = out
+        return best
+
+    def _scan_draft(self, ctx: List[int], limit: int) -> List[int]:
+        """Stateless reversed suffix scan — the original O(len) rule,
+        kept as the seq_id-free path and the parity oracle the index is
+        tested against."""
         L = len(ctx)
         best: List[int] = []
         for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):

@@ -671,3 +671,237 @@ def test_paged_fallback_counted_and_metered(monkeypatch):
         fl.set_flags({"FLAGS_observability": old})
         obs.default_registry().reset()
     assert pa.fallback_count() == before + 2
+
+
+# ---------------------------------------------------------------------------
+# kernel-interior tier (ISSUE 14): VMEM pricing + the two new detectors
+
+
+def test_tile_padded_bytes_pads_to_whole_tiles():
+    """The estimator prices buffers the way Mosaic stores them: last two
+    dims padded to whole (sublane, lane) tiles per dtype."""
+    from paddle_tpu.analysis.pallas import tile_padded_bytes
+
+    assert tile_padded_bytes((8, 128), "float32") == 8 * 128 * 4
+    assert tile_padded_bytes((8, 1), "float32") == 8 * 128 * 4
+    assert tile_padded_bytes((1, 1, 3, 130), "float32") == 8 * 256 * 4
+    assert tile_padded_bytes((9, 128), "bfloat16") == 16 * 128 * 2
+    assert tile_padded_bytes((1, 128), "int8") == 32 * 128
+    assert tile_padded_bytes((128,), "float32") == 8 * 128 * 4
+
+
+def _traced_pallas_eqns(fn, *args):
+    from paddle_tpu import flags as fl
+    from paddle_tpu.analysis import pallas as AP
+
+    with fl.tpu_trace_scope(True):
+        jx = jax.make_jaxpr(fn)(*args)
+    return list(AP.iter_pallas_calls(jx))
+
+
+def test_kernel_vmem_bytes_prices_the_paged_kernel():
+    """The traced paged-decode pallas_call prices exactly as the kernel
+    allocates: double-buffered padded q/k/v/o blocks + fp32 softmax
+    scratch in VMEM, the scalar-prefetched page table/lengths in SMEM."""
+    from paddle_tpu.analysis import pallas as AP
+    from paddle_tpu.kernels.paged_attention import paged_decode_attention
+
+    B, H, D, ps, maxp = 4, 8, 128, 16, 32
+    P = B * maxp
+    q = jax.ShapeDtypeStruct((B, H, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((H, P, ps, D), jnp.float32)
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    eqns = _traced_pallas_eqns(
+        lambda q, k, v, t, l: paged_decode_attention(
+            q, k, v, t, l, impl="pallas"), q, kp, kp, tb, ln)
+    assert len(eqns) == 1
+    cost = AP.kernel_cost(eqns[0])
+    # blocks: q/o (1,1,8,128) fp32 = 4 KB each, k/v (1,1,16,128) = 8 KB
+    # each, double-buffered; scratch: two (8,1)->one tile each + (8,128)
+    want_vmem = 2 * (4096 + 8192 + 8192 + 4096) + 3 * 4096
+    assert cost.vmem_bytes == want_vmem
+    assert AP.kernel_vmem_bytes(eqns[0]) == want_vmem
+    assert cost.smem_bytes == B * maxp * 4 + B * 4  # tables + lengths
+    assert cost.double_buffered and cost.grid == (B, H, maxp)
+    assert cost.vmem_bytes < AP.default_vmem_budget()
+    assert cost.name == "_paged_kernel"
+
+
+def test_flash_fwd_vmem_estimate_matches_linter_price():
+    """kernels/flash_attention.fwd_vmem_bytes is the kernel's own
+    statement of its working set — it must equal what the linter prices
+    off the traced call (blocks + packed-lse plane + scratch; the SMEM
+    klen vector excluded from both)."""
+    from paddle_tpu.analysis import pallas as AP
+    from paddle_tpu.kernels.flash_attention import (
+        flash_attention, fwd_vmem_bytes)
+
+    B, H_, S, D = 2, 2, 256, 128
+    qkv = jax.ShapeDtypeStruct((B, H_, S, D), jnp.float32)
+    eqns = _traced_pallas_eqns(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        force="interpret"), qkv, qkv, qkv)
+    assert len(eqns) == 1
+    priced = AP.kernel_vmem_bytes(eqns[0])
+    # the primal (inference) path drops the lse output entirely —
+    # fwd_vmem_bytes(emit_lse=False) is its exact working set
+    assert priced == fwd_vmem_bytes(
+        block_q=128, block_k=128, head_dim=D, num_q_blocks=S // 128,
+        emit_lse=False)
+    # the training forward adds (only) the packed per-row lse plane
+    with_lse = fwd_vmem_bytes(
+        block_q=128, block_k=128, head_dim=D, num_q_blocks=S // 128,
+        emit_lse=True)
+    assert with_lse > priced
+    assert with_lse < AP.default_vmem_budget()
+
+
+def test_corpus_vmem_overflow_exactly_its_detector_with_fields():
+    """ISSUE acceptance: the VMEM-busting BlockSpec trips EXACTLY
+    vmem-overflow, carries the per-finding vmem_bytes/budget fields
+    into JSON, and the budget is configurable (a raised budget clears
+    it)."""
+    _skip_if_no_topology()
+    from paddle_tpu import flags as fl
+
+    art = build_corpus_program("vmem_overflow")
+    findings = analysis.run_detectors(art)
+    assert {f.detector for f in findings} == {"vmem-overflow"}
+    f = findings[0]
+    assert f.severity == "error"
+    assert f.vmem_bytes > f.budget
+    assert f.vmem_bytes == 2 * 2 * 4096 * 4096 * 4  # in+out, 2x buffered
+    d = f.as_dict()
+    assert d["vmem_bytes"] == f.vmem_bytes and d["budget"] == f.budget
+    assert "vmem" in f.format()
+    # the chip pipeline rejects the same program (RESOURCE_EXHAUSTED) —
+    # the detector sees it BEFORE any compile, which is the point
+    assert "vmem" in art.compile_error.lower()
+    old = fl.flag("FLAGS_analysis_vmem_budget")
+    fl.set_flags({"FLAGS_analysis_vmem_budget": 1 << 30})
+    try:
+        assert not [x for x in analysis.run_detectors(art)
+                    if x.detector == "vmem-overflow"]
+    finally:
+        fl.set_flags({"FLAGS_analysis_vmem_budget": old})
+
+
+def test_corpus_scan_widening_exactly_its_detector():
+    """The bf16->f32 scan-carry escape trips EXACTLY scan-widening: the
+    stacked fp32 history (2x the bf16 bytes) escapes to the program
+    output; the small carry itself sits under the size floor."""
+    _skip_if_no_topology()
+    art = build_corpus_program("scan_widening")
+    findings = analysis.run_detectors(art)
+    assert {f.detector for f in findings} == {"scan-widening"}
+    assert len(findings) == 1
+    f = findings[0]
+    assert "stacked output" in f.where
+    assert f.bytes == 512 * 1024 * 4  # the [T, N] fp32 history
+    assert f.severity == "warning"
+
+
+def test_scan_widening_narrowed_accumulator_stays_clean():
+    """The dtype-promotion contract carries over: a DELIBERATE fp32
+    accumulator over bf16 rows that narrows back before the HBM write
+    is the stats idiom, not a finding."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+
+    N = 1 << 19  # the f32 carry alone is 2 MB — above the floor
+
+    def fn(x):  # [8, N] bf16
+        def body(c, row):
+            return c + row, ()
+
+        c0 = jnp.zeros((N,), jnp.float32)
+        c, _ = jax.lax.scan(body, c0, x)
+        return c.astype(jnp.bfloat16)  # narrowed before the write
+
+    art = capture_fn(fn, jax.ShapeDtypeStruct((8, N), jnp.bfloat16),
+                     name="narrowed_accumulator")
+    assert not [f for f in analysis.run_detectors(art)
+                if f.detector == "scan-widening"]
+
+
+def test_lint_inject_new_corpus_entries_exit_3(tmp_path, capsys):
+    """Both new known-bad entries must fail `--inject <name> --gate`
+    end-to-end (the ISSUE acceptance wording): scan_widening carries a
+    finding, vmem_overflow additionally fails its AOT compile — exit 3
+    either way."""
+    _skip_if_no_topology()
+    assert _lint_main(["--programs", "paged_decode",
+                       "--inject", "scan_widening", "--gate"]) == 3
+    assert _lint_main(["--programs", "paged_decode",
+                       "--inject", "vmem_overflow", "--gate"]) == 3
+    capsys.readouterr()
+
+
+def test_sharded_decode_layout_tax_banked_at_zero():
+    """ISSUE 14 acceptance: the banked sharded_decode entry holds
+    relayout-copy-pair at ZERO (the oldest open finding count in the
+    bank) — the kernel consumes XLA's preferred pool-shard layout
+    (pool_layout="xla" + the kv_pool_layout program-boundary pin) — and
+    the bytes/step win is banked (the taxed program priced 51.3 MB/chip
+    per step; relayout-free must stay well under 45 MB)."""
+    with open(analysis.default_baseline_path()) as f:
+        progs = json.load(f)["programs"]
+    entry = progs["sharded_decode"]
+    assert entry["findings"].get("relayout-copy-pair", 0) == 0
+    assert entry["findings"] == {}  # clean across ALL detectors
+    assert entry["bytes_per_step"] < 45e6
+    # every banked program is clean on the two new detectors (they are
+    # gated from day one, the ROADMAP clause)
+    for name, e in progs.items():
+        assert e["findings"].get("vmem-overflow", 0) == 0, name
+        assert e["findings"].get("scan-widening", 0) == 0, name
+
+
+def test_findings_sorted_severity_then_bytes():
+    """The one report order (stable gate diffs): strongest severity
+    first, then biggest cost — vmem_bytes counts as the cost for
+    non-traffic kernel findings."""
+    from paddle_tpu.analysis import sort_findings
+
+    fs = [
+        Finding(detector="a", severity="warning", program="p",
+                message="m", bytes=10),
+        Finding(detector="b", severity="error", program="p",
+                message="m", bytes=1),
+        Finding(detector="c", severity="info", program="p",
+                message="m", bytes=99),
+        Finding(detector="d", severity="error", program="p",
+                message="m", vmem_bytes=500, budget=100),
+        Finding(detector="e", severity="warning", program="p",
+                message="m", bytes=20),
+    ]
+    got = [f.detector for f in sort_findings(fs)]
+    assert got == ["d", "b", "e", "a", "c"]
+
+
+def test_scan_widening_catches_carry_aliased_with_dead_ys():
+    """A body `return c, c` (the carry also emitted as a stacked output)
+    whose caller keeps only the FINAL carry: the shared body var fills
+    two outvar slots, and the carry slot must still be examined even
+    though the ys slot is dead — a last-wins slot map would silently
+    drop the exact hazard class the detector exists for."""
+    _skip_if_no_topology()
+    from paddle_tpu.analysis.capture import capture_fn
+
+    N = 1 << 18  # the f32 carry alone is 1 MB — at the size floor
+
+    def fn(x):  # [8, N] bf16
+        def body(c, row):
+            c = c + row  # widens: bf16 row joins the f32 carry
+            return c, c  # carry AND stacked output are the same var
+
+        c0 = jnp.zeros((N,))  # silently fp32
+        c, _ = jax.lax.scan(body, c0, x)
+        return c  # only the widened final carry escapes
+
+    art = capture_fn(fn, jax.ShapeDtypeStruct((8, N), jnp.bfloat16),
+                     name="carry_aliased_ys")
+    hit = [f for f in analysis.run_detectors(art)
+           if f.detector == "scan-widening"]
+    assert hit and any(f.where == "scan carry 0" for f in hit)

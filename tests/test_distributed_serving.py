@@ -164,6 +164,47 @@ def test_sharded_gqa_decode_token_identical_and_pool_shrinks(host_devices):
     assert pool.check_invariants()["ok"]
 
 
+def test_sharded_layout_consuming_pallas_path_token_identical(
+        host_devices):
+    """ISSUE 14 layout fix: decode_step_fn feeds the paged kernel the
+    pool_layout="xla" view (the [P, ps, H*D] slot-major re-view of the
+    scatter-updated pool shard — what drives the banked sharded_decode
+    relayout-copy-pair count to 0).  The interpret tier runs that exact
+    lowering on the 4-device CPU mesh: continuous-batching decode must
+    stay TOKEN-IDENTICAL to the single-device reference oracle, so the
+    relayout-free program the zoo banks is the same math the serving
+    loop ships."""
+    devs = host_devices(N_SHARDS)
+    # an in-envelope pool geometry (head_dim 128, page_size 8) — the
+    # shape class the pallas path actually serves
+    cfg = _cfg(d_model=512, n_head=4, n_layer=1, max_length=32)
+    params = serving.init_decode_params(cfg, seed=7)
+    reqs = _ragged_requests(cfg, n=3, seed=7, max_new=5)
+
+    oracle_pool = KVCachePool(num_pages=32, page_size=8,
+                              num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                              head_dim=cfg.head_dim)
+    oracle = ContinuousBatchingLoop(params, cfg, oracle_pool, max_batch=3)
+    want = oracle.run([DecodeRequest(prompt=list(r.prompt),
+                                     max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+
+    prog = ShardedDecodeProgram(params, cfg, devices=devs,
+                                paged_impl="interpret")
+    pool = prog.make_pool(num_pages=32, page_size=8)
+    loop = ContinuousBatchingLoop(None, None, pool, max_batch=3,
+                                  program=prog)
+    got = loop.run(reqs)
+    assert prog.paged_impl == "interpret"  # resolved — no fallback
+    for w, g in zip(want, got):
+        assert g.error is None
+        assert g.tokens == w.tokens  # token-identical to the oracle
+        np.testing.assert_allclose(
+            np.stack(g.logits), np.stack(w.logits), atol=5e-4)
+    assert pool.stats()["used_pages"] == 0
+    assert pool.check_invariants()["ok"]
+
+
 def test_sharded_gqa_and_int8_validation(host_devices):
     """KV-head divisibility is validated loudly, and int8 pages are
     rejected on the mesh (the SPMD step writes K/V device-side where

@@ -814,3 +814,97 @@ def test_spec_verify_gather_corpus_trips_bytes_gate():
     v = [x for x in verdicts
          if x["metric"] == "spec_verify_aot_bytes_per_step"]
     assert v and v[0]["verdict"] == "fail"
+
+
+# ---------------------------------------------------------------------------
+# (f) the incremental n-gram index (ROADMAP speculative item 3)
+
+
+def test_drafter_incremental_index_parity_over_random_histories():
+    """The per-sequence suffix index must answer EXACTLY like the
+    stateless reversed scan at every point of a random commit/rollback
+    history — the index is an accelerator, never a different oracle."""
+    rng = np.random.RandomState(7)
+    for trial in range(8):
+        d = PromptLookupDrafter(max_draft=4, max_ngram=3)
+        oracle = PromptLookupDrafter(max_draft=4, max_ngram=3)
+        ctx = rng.randint(0, 5, size=rng.randint(2, 8)).tolist()
+        for step in range(60):
+            op = rng.rand()
+            if op < 0.2 and len(ctx) > 3:
+                # rollback: a verify step rejected some draft tokens
+                ctx = ctx[:rng.randint(2, len(ctx))]
+            else:
+                ctx = ctx + rng.randint(0, 5,
+                                        size=rng.randint(1, 4)).tolist()
+            limit = int(rng.randint(1, 5))
+            got = d.draft(ctx, limit, seq_id=trial)
+            want = oracle.draft(ctx, limit)  # stateless scan
+            assert got == want, (trial, step, ctx, limit, got, want)
+            # the index re-synced to exactly the visible context
+            assert d._index[trial].tokens == ctx
+
+
+def test_drafter_rollback_rewinds_index_exactly():
+    """truncate_seq rollbacks reach the drafter as a shorter/diverged
+    context: the index must pop every n-gram the dead tokens registered
+    (a stale occurrence would propose continuations from rolled-back
+    text)."""
+    d = PromptLookupDrafter(max_draft=4, max_ngram=3)
+    # commit a history whose tail will be rolled back
+    full = [1, 2, 3, 9, 9, 9, 1, 2, 3]
+    assert d.draft(full, 4, seq_id=0) == [9, 9, 9, 1]
+    idx = d._index[0]
+    n_keys_full = len(idx.occ)
+    # the verifier rejected everything after position 4, then committed
+    # a different token — the next call's context diverges at 4
+    rolled = full[:4] + [7]
+    assert d.draft(rolled, 4, seq_id=0) == \
+        PromptLookupDrafter(max_draft=4, max_ngram=3).draft(rolled, 4)
+    assert idx.tokens == rolled
+    assert len(idx.occ) < n_keys_full
+    # no surviving occurrence may end past the new length
+    for key, positions in idx.occ.items():
+        for i in positions:
+            assert i + len(key) <= len(rolled)
+    # growing again after the rewind stays consistent
+    grown = rolled + [1, 2, 3]
+    assert d.draft(grown, 4, seq_id=0) == \
+        PromptLookupDrafter(max_draft=4, max_ngram=3).draft(grown, 4)
+
+
+def test_drafter_release_and_lru_cap_bound_host_memory():
+    d = PromptLookupDrafter(max_draft=2, max_sequences=2)
+    assert d.stateful  # the loop's seq_id/release protocol marker
+    for sid in (10, 11, 12):
+        d.draft([1, 2, 1, 2], 2, seq_id=sid)
+    assert d.tracked_sequences() == 2  # LRU evicted the oldest
+    assert 10 not in d._index and 12 in d._index
+    d.release(11)
+    assert d.tracked_sequences() == 1
+    d.release(99)  # releasing an untracked id is a no-op
+    # stateless calls never touch the index
+    d.draft([1, 2, 1, 2], 2)
+    assert d.tracked_sequences() == 1
+
+
+def test_loop_releases_drafter_index_on_retirement():
+    """The serving loop passes seq_id (the incremental path) and drops
+    the index when a sequence retires — a long-lived engine must not
+    grow one suffix map per request forever."""
+    cfg = DecodeConfig(vocab_size=61, d_model=16, n_head=2, n_layer=1,
+                       d_inner=32, max_length=64)
+    params = init_decode_params(cfg, seed=2)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).tolist()
+               for n in (6, 9, 4)]
+    pool = KVCachePool(num_pages=80, page_size=4, num_layers=cfg.n_layer,
+                       num_heads=cfg.n_head, head_dim=cfg.head_dim)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3,
+                                  speculate=3, check_every=1)
+    assert loop.drafter.stateful
+    results = loop.run([DecodeRequest(p, 10) for p in prompts])
+    for p, res in zip(prompts, results):
+        assert res.tokens == full_decode(params, cfg, p, 10)[0]
+    assert loop.drafted_tokens > 0  # the indexed path actually drafted
+    assert loop.drafter.tracked_sequences() == 0  # released on retire

@@ -6,6 +6,9 @@
 #   test     - full pytest suite on an 8-device virtual CPU mesh
 #   api      - API.spec freeze gate (tools/diff_api.py)
 #   bench    - one smoke bench step (tiny shapes, CPU)
+#   lint     - chip-less program-linter gate over the model zoo
+#              (tools/lint_programs.py --gate vs AOT_COST_ZOO.json),
+#              plus an --inject smoke proving the gate's exit-3 teeth
 # Run all stages:  tools/ci.sh        One stage:  tools/ci.sh test
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -43,6 +46,22 @@ run_api() {
   python tools/op_census.py
 }
 
+run_lint() {
+  echo "== chip-less lint gate (model zoo vs AOT_COST_ZOO.json) =="
+  python tools/lint_programs.py --gate
+  echo "== lint gate teeth: an injected known-bad corpus program must exit 3 =="
+  set +e
+  python tools/lint_programs.py --programs paged_decode \
+    --inject weak_type --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "lint --inject smoke: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "inject smoke OK (exit 3)"
+}
+
 run_bench() {
   echo "== bench smoke =="
   BENCH_BS=8 BENCH_STEPS=3 BENCH_TRANSFORMER_BS=2 BENCH_DEEPFM_BS=32 \
@@ -53,8 +72,9 @@ case "$stage" in
   native) run_native ;;
   test)   run_test ;;
   api)    run_api ;;
+  lint)   run_lint ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

@@ -6,9 +6,14 @@ TPU-lowered StableHLO, and the AOT-compiled v5e executable
 (core/aot_tpu.py; no TPU attached) — and reports typed findings:
 relayout copy-pairs around custom calls, broadcast-materialized
 custom-call operands, missed buffer donation, recompile hazards, silent
-dtype promotions, and host-sync points.  Per-program AOT bytes/step and
-finding counts are banked in AOT_COST_ZOO.json (the successor table to
-AOT_COST_AB.json / AOT_COST_PAGED.json) and gated per PR.
+dtype promotions, scan/while carry widenings, host-sync points, SPMD
+collective placement, and (the kernel-interior tier, analysis/pallas.py)
+pallas_call VMEM working sets priced against the v5e budget.
+Per-program AOT bytes/step and finding counts are banked in
+AOT_COST_ZOO.json (the successor table to AOT_COST_AB.json /
+AOT_COST_PAGED.json) and gated per PR.  Findings are ordered
+severity-then-bytes (and vmem-overflow findings carry per-finding
+vmem_bytes/budget in --json) so gate diffs are stable.
 
 Usage:
     python tools/lint_programs.py                       # lint the zoo

@@ -26,6 +26,10 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
+    # kernel-interior static analysis (ISSUE 14): kernel_vmem_bytes()
+    # and the pallas_call cost model are the seam kernels + tests
+    # price VMEM working sets through
+    "paddle_tpu.analysis.pallas",
     "paddle_tpu.profiler",
     "paddle_tpu.timeline",
     "paddle_tpu.flags",
