@@ -138,6 +138,10 @@ class MasterService:
         self._init_done = False
         self._timers: List[threading.Timer] = []
         self._heartbeats: Dict[str, float] = {}
+        # optional per-worker status dict delivered with the beat — the
+        # serving fleet's control-plane signals (queue depth, shed
+        # counts, health state) ride the same liveness RPC
+        self._payloads: Dict[str, dict] = {}
         if self._recover():
             self._init_done = True
 
@@ -268,17 +272,42 @@ class MasterService:
         st.failed = []
 
     # -- liveness ------------------------------------------------------
-    def heartbeat(self, worker_id: str) -> None:
+    def heartbeat(self, worker_id: str,
+                  payload: Optional[dict] = None) -> None:
         """Optional fast failure detection on top of lease expiry
-        (the pserver etcd-registration role)."""
+        (the pserver etcd-registration role).  ``payload`` piggybacks a
+        small status dict on the beat (the serving fleet reports queue
+        depth / shed rate / health state this way); omitted payloads
+        leave the previous one in place."""
         with self._mu:
             self._heartbeats[worker_id] = time.monotonic()
+            if payload is not None:
+                self._payloads[worker_id] = dict(payload)
 
     def dead_workers(self, max_silence: float) -> List[str]:
         now = time.monotonic()
         with self._mu:
             return [w for w, t in self._heartbeats.items()
                     if now - t > max_silence]
+
+    def forget_worker(self, worker_id: str) -> None:
+        """Drop a worker from the liveness registry — the deregister
+        half of heartbeat().  Without it a deliberately-removed worker
+        (a drained serving replica) reports lease-expired in every
+        later dead_workers() poll forever (the ghost-lease bug)."""
+        with self._mu:
+            self._heartbeats.pop(worker_id, None)
+            self._payloads.pop(worker_id, None)
+
+    def worker_status(self) -> Dict[str, dict]:
+        """Every registered worker's beat age and latest payload —
+        the fleet controller's signal read, one call for the whole
+        fleet (works identically over the RPC plane)."""
+        now = time.monotonic()
+        with self._mu:
+            return {w: {"age_s": now - t,
+                        "payload": self._payloads.get(w)}
+                    for w, t in self._heartbeats.items()}
 
     # -- introspection -------------------------------------------------
     def counts(self) -> dict:

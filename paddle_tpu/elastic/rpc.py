@@ -56,8 +56,14 @@ class _Handler(socketserver.StreamRequestHandler):
                     svc.task_failed(int(req["task_id"]), int(req["epoch"]))
                     resp = {"ok": True}
                 elif cmd == "heartbeat":
-                    svc.heartbeat(str(req["worker_id"]))
+                    svc.heartbeat(str(req["worker_id"]),
+                                  req.get("payload"))
                     resp = {"ok": True}
+                elif cmd == "forget_worker":
+                    svc.forget_worker(str(req["worker_id"]))
+                    resp = {"ok": True}
+                elif cmd == "worker_status":
+                    resp = {"ok": True, "workers": svc.worker_status()}
                 elif cmd == "set_dataset":
                     svc.set_dataset(list(req["globs"]))
                     resp = {"ok": True}
@@ -212,8 +218,18 @@ class RemoteMaster:
         self._call({"cmd": "task_failed", "task_id": task_id,
                     "epoch": epoch})
 
-    def heartbeat(self, worker_id: str) -> None:
-        self._call({"cmd": "heartbeat", "worker_id": worker_id})
+    def heartbeat(self, worker_id: str,
+                  payload: Optional[dict] = None) -> None:
+        req = {"cmd": "heartbeat", "worker_id": worker_id}
+        if payload is not None:  # wire-compatible with older masters
+            req["payload"] = payload
+        self._call(req)
+
+    def forget_worker(self, worker_id: str) -> None:
+        self._call({"cmd": "forget_worker", "worker_id": worker_id})
+
+    def worker_status(self) -> dict:
+        return self._call({"cmd": "worker_status"})["workers"]
 
     def dead_workers(self, max_silence: float):
         return self._call({"cmd": "dead_workers",

@@ -48,6 +48,16 @@ Serving knobs (tests/test_serving_resilience.py chaos suite):
         non-finite activations) at its next reuse, once — the sequence
         served the poisoned prefix must be quarantined and the cached
         chain invalidated while batch-mates decode on unharmed.
+    FAULT_SERVE_REPLICA_KILL=<name>|* serving replica death, once: a
+        fleet replica worker (serving/fleet) or Engine dispatcher whose
+        replica name matches dies WITHOUT supervisor restart — models a
+        killed replica process.  Its queued requests fail typed so the
+        router/fleet can fail them over; the fleet must finish with
+        lost_requests=0 and the dead replica quarantined, not crashed.
+    FAULT_SERVE_HANDOFF_DROP=1        disaggregated serving: the
+        prefill→decode KV handoff payload is dropped in transit, once
+        — the fleet must requeue the request for a fresh prefill
+        (counted as handoff_drops/re_prefills), never lose it.
 """
 
 from __future__ import annotations
@@ -59,7 +69,8 @@ __all__ = [
     "reset", "fired", "shard_write_kill", "corrupt_shard",
     "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
     "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
-    "serve_slow_step", "serve_prefix_corrupt",
+    "serve_slow_step", "serve_prefix_corrupt", "serve_replica_kill",
+    "serve_handoff_drop",
 ]
 
 fired: set = set()
@@ -221,6 +232,31 @@ def serve_prefix_corrupt() -> bool:
             or "serve_prefix_corrupt" in fired:
         return False
     fired.add("serve_prefix_corrupt")
+    return True
+
+
+def serve_replica_kill(name: str) -> bool:
+    """FAULT_SERVE_REPLICA_KILL=<name>|*: True exactly once when `name`
+    matches — the caller (a fleet replica worker thread or an Engine
+    dispatcher) must die WITHOUT restart, modeling a killed replica
+    process whose queued work fails over to survivors."""
+    spec = os.environ.get("FAULT_SERVE_REPLICA_KILL")
+    if not spec or "serve_replica_kill" in fired:
+        return False
+    if spec != "*" and spec != name:
+        return False
+    fired.add("serve_replica_kill")
+    return True
+
+
+def serve_handoff_drop() -> bool:
+    """FAULT_SERVE_HANDOFF_DROP: True exactly once while armed — the
+    fleet's prefill→decode KV handoff payload is lost in transit and
+    the request must be requeued for a fresh prefill."""
+    if not os.environ.get("FAULT_SERVE_HANDOFF_DROP") \
+            or "serve_handoff_drop" in fired:
+        return False
+    fired.add("serve_handoff_drop")
     return True
 
 

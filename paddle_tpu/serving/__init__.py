@@ -120,11 +120,17 @@ from .generate import (
     prefill_step,
     verify_step,
 )
-from .kvcache import KVCachePool, PagePoolExhausted, SequenceHandle
+from .kvcache import (
+    KVCachePool,
+    PagePoolExhausted,
+    SeqExport,
+    SequenceHandle,
+)
 from .prefixcache import PrefixCache, PrefixMatch
 from .sampling import SamplingParams
 from .speculative import PromptLookupDrafter
 from . import distributed  # noqa: F401 — serving.distributed is API
+from . import fleet  # noqa: F401 — serving.fleet is API (ISSUE 15)
 
 __all__ = [
     "AotBackend",
@@ -148,6 +154,7 @@ __all__ = [
     "QueueFullError",
     "RequestTimeoutError",
     "SamplingParams",
+    "SeqExport",
     "SequenceHandle",
     "full_decode",
     "full_forward",

@@ -83,11 +83,35 @@ class ReplicaDirectory:
         self.master = master
         self.max_silence_s = float(max_silence_s)
 
-    def register(self, name: str) -> None:
-        self.beat(name)
+    def register(self, name: str, payload: Optional[dict] = None) -> None:
+        self.beat(name, payload)
 
-    def beat(self, name: str) -> None:
-        self.master.heartbeat(self._PREFIX + name)
+    def beat(self, name: str, payload: Optional[dict] = None) -> None:
+        """One lease renewal; ``payload`` piggybacks the replica's
+        status dict (queue depth, shed counts, health state) — the
+        fleet controller's autoscaling signals ride the liveness RPC."""
+        if payload is None:
+            self.master.heartbeat(self._PREFIX + name)
+        else:
+            self.master.heartbeat(self._PREFIX + name, payload)
+
+    def deregister(self, name: str) -> None:
+        """Forget a deliberately-removed replica's lease.  Without
+        this, a drained-and-removed replica stays in the master's
+        heartbeat registry forever and reports lease-expired in every
+        later expired() poll (the ghost-lease bug)."""
+        forget = getattr(self.master, "forget_worker", None)
+        if forget is not None:
+            forget(self._PREFIX + name)
+
+    def status(self) -> Dict[str, dict]:
+        """Per-replica beat age + latest payload (worker_status through
+        the replica/ prefix) — {} when the master predates payloads."""
+        ws = getattr(self.master, "worker_status", None)
+        if ws is None:
+            return {}
+        return {w[len(self._PREFIX):]: st for w, st in ws().items()
+                if w.startswith(self._PREFIX)}
 
     def expired(self) -> List[str]:
         """Replica names whose lease lapsed (never-registered names are
@@ -159,9 +183,13 @@ class Router:
 
     def remove_replica(self, name: str) -> Engine:
         """Forget a replica (it should be drained first — the router
-        stops routing but does NOT close the engine)."""
+        stops routing but does NOT close the engine).  Its lease is
+        deregistered from the directory too: a removed replica must
+        not haunt every later expired() poll as a ghost lease."""
         with self._lock:
             rep = self._replicas.pop(name)
+        if self.directory is not None:
+            self.directory.deregister(name)
         return rep.engine
 
     def replica_names(self) -> List[str]:

@@ -314,6 +314,9 @@ class Engine:
         self._last_dispatch_ok: Optional[float] = None
         self._shed = 0                    # deadline-aware rejections
         self._close_timed_out = False
+        # chaos (FAULT_SERVE_REPLICA_KILL): a killed replica's
+        # dispatcher dies WITHOUT restart — models a dead process
+        self._replica_killed = False
         # observed per-batch dispatch latency — the shedding estimator's
         # input (engine-local ring: admission control is functional, not
         # telemetry, so it runs regardless of FLAGS_observability)
@@ -457,6 +460,14 @@ class Engine:
             if self._closed:
                 self._reject(rt, EngineClosedError(
                     f"engine '{self.name}' is draining/closed"),
+                    "closed", obs_on)
+            if self._replica_killed:
+                # a chaos-killed replica has no dispatcher and never
+                # will — admitting would strand the request in a queue
+                # nothing drains; reject typed so the router's raced
+                # health cache falls over to a survivor instead
+                self._reject(rt, EngineClosedError(
+                    f"engine '{self.name}': replica was killed"),
                     "closed", obs_on)
             if self._breaker_open_until > now:
                 self._reject(rt, EngineUnhealthyError(
@@ -700,6 +711,7 @@ class Engine:
                 "dispatcher_restarts": self._dispatcher_restarts,
                 "shed": self._shed,
                 "close_timed_out": self._close_timed_out,
+                "replica_killed": self._replica_killed,
                 **self._counters_locked(),
             }
 
@@ -796,6 +808,16 @@ class Engine:
         # chaos: a raise HERE is outside every protected region — the
         # dispatcher thread dies and the supervisor must restart it
         _finject.serve_dispatch_raise("thread")
+        # chaos: replica kill — the dispatcher dies and the supervisor
+        # must NOT restart it (a dead process has no supervisor); fires
+        # between batches so no in-flight work is lost, only queued
+        # requests fail over
+        if _finject.serve_replica_kill(self.replica or self.name):
+            with self._lock:
+                self._replica_killed = True
+            raise RuntimeError(
+                f"faultinject: replica {self.replica or self.name} "
+                "killed")
         with self._cond:
             if self._stopped:
                 self._cond.notify_all()
@@ -1009,13 +1031,34 @@ class Engine:
         """Supervisor: the dispatcher thread died outside every
         protected region.  Restart it with the queue preserved (the
         queue lives on the engine, not the thread) unless the engine is
-        already stopped."""
+        already stopped — or chaos-killed (FAULT_SERVE_REPLICA_KILL):
+        a killed replica process has no supervisor, so the engine goes
+        BROKEN and its queued requests fail typed for callers (the
+        router, serve_bench --chaos --replicas) to fail over."""
         self._note_internal_error(exc)
         with self._cond:
-            if self._stopped:
+            if self._replica_killed:
+                self._stopped = True
+                leftovers, self._queue = self._queue, []
+                self._cond.notify_all()
+            elif self._stopped:
                 self._cond.notify_all()
                 return
-            self._dispatcher_restarts += 1
+            else:
+                leftovers = None
+                self._dispatcher_restarts += 1
+        if leftovers is not None:
+            _log.warning(
+                "engine '%s': replica killed by chaos; failing %d "
+                "queued requests over to survivors", self.name,
+                len(leftovers))
+            if _flags._VALUES["FLAGS_observability"]:
+                self._flight_record(
+                    "replica_kill", queued=len(leftovers),
+                    error=f"{type(exc).__name__}: {exc}")
+            for r in leftovers:  # outside the lock: done-callbacks
+                self._fail(r, EngineInternalError(exc))
+            return
         _log.warning(
             "engine '%s': dispatcher thread died (%s: %s); restarting "
             "with %d queued requests preserved", self.name,
@@ -1088,7 +1131,8 @@ class Engine:
             degraded = (self._consecutive_errors > 0
                         or depth >= 0.8 * cap)
             stopped = self._stopped
-        if breaker_open or (not alive and not stopped):
+            killed = self._replica_killed
+        if breaker_open or killed or (not alive and not stopped):
             state = "BROKEN"
         elif draining:
             state = "DRAINING"

@@ -1,0 +1,61 @@
+"""Disaggregated prefill/decode serving behind an elastic fleet.
+
+Prefill is compute-bound and decode is bandwidth-bound; one replica
+class serves both badly.  This package splits them (ISSUE 15):
+
+- **Disaggregation** — :class:`PrefillReplica` runs chunked
+  whole-prompt prefill only, :class:`DecodeReplica` runs the
+  continuous-batching loop only, and the KV **handoff** moves a
+  prefilled sequence between their pools:
+  ``KVCachePool.export_seq``/``import_seq`` stage the sequence's pages
+  + lengths + int8 scales through host numpy buffers (the same path a
+  cross-process data plane will use; on-mesh pools keep the page
+  writes device-side), the decode-side admission charges the imported
+  footprint atomically, and prefix-cache composition ships only the
+  unshared tail — the destination re-attaches shared pages from its
+  own cache by hash, refcount-pinned for the transfer
+  (:class:`~paddle_tpu.serving.fleet.handoff.PrefixReservation`).
+  Disaggregated output is token-identical to the monolithic
+  ``ContinuousBatchingLoop`` (tests/test_fleet.py pins the
+  GQA × int8 × prefix-hit matrix).
+- **Elasticity** — :class:`Fleet` fronts both classes behind one
+  ``submit()`` with fail-over-never-lose brokering, and
+  :class:`FleetController` rides the elastic master's heartbeat/lease
+  plane (replicas publish queue depth / shed rate / health in their
+  beat payloads; the controller reads them in-process or over
+  ``RemoteMaster``): scale-up on sustained queue growth or shedding,
+  scale-down and **rolling weight upgrades** through the zero-loss
+  drain handoff, dead replicas quarantined (not crashed into) and
+  replaced.  Chaos knobs ``FAULT_SERVE_REPLICA_KILL`` /
+  ``FAULT_SERVE_HANDOFF_DROP`` drive the degradation tests;
+  ``serve_bench --disagg`` / ``--fleet`` bank handoff bytes/seq, TTFT
+  under bursty load, and ``lost_requests=0`` on the 0/2/3 gate.
+"""
+
+from .controller import AutoscalePolicy, FleetController
+from .fleet import Fleet, NoReplicaAvailableError
+from .handoff import Handoff, HandoffDropError, PrefixReservation
+from .replica import (
+    DecodeReplica,
+    FleetQueueFullError,
+    FleetReplica,
+    PrefillReplica,
+    ReplicaDrainingError,
+    ReplicaKilledError,
+)
+
+__all__ = [
+    "AutoscalePolicy",
+    "DecodeReplica",
+    "Fleet",
+    "FleetController",
+    "FleetQueueFullError",
+    "FleetReplica",
+    "Handoff",
+    "HandoffDropError",
+    "NoReplicaAvailableError",
+    "PrefillReplica",
+    "PrefixReservation",
+    "ReplicaDrainingError",
+    "ReplicaKilledError",
+]

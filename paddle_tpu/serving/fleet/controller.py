@@ -1,0 +1,246 @@
+"""Elastic fleet control: autoscaling on heartbeat signals + rolling
+weight upgrades over the zero-loss drain handoff.
+
+The controller is deliberately dumb-and-deterministic: each ``step()``
+reads one signal snapshot per replica class — queue depth, shed count,
+health state, lease liveness — and applies a threshold policy with
+sustain counters and a cooldown.  Signals come from the heartbeat
+PAYLOADS the replicas already publish on the elastic master's liveness
+plane (``ReplicaDirectory.status()`` — works identically over
+``RemoteMaster``, so the control plane is cross-process even while the
+data plane stays in-process threads), falling back to direct replica
+reads when no directory is wired.
+
+Decisions:
+
+- **scale_up** — sustained queue growth (mean queued items per live
+  replica >= ``queue_high`` for ``sustain`` consecutive steps) or any
+  shedding since the last step, while below ``max_replicas``.
+- **scale_down** — sustained idleness (zero queued work for
+  ``idle_sustain`` steps) while above ``min_replicas``; the victim is
+  drained through the zero-loss handoff (queued + in-flight work
+  completes there) before removal.
+- **replica_dead** — a lease-expired or dead replica is quarantined
+  (routing stops, lease deregistered — no ghost leases) and replaced
+  when the class would drop below ``min_replicas``.
+
+``rolling_upgrade(new_params)`` walks every replica: drain (zero lost
+or duplicated requests — traffic keeps flowing to the others), swap
+weights (prefix caches invalidated, pool asserted empty), rejoin.
+Every decision lands in the flight recorder
+(scale_up/scale_down/upgrade/replica_dead events) and on the
+``paddle_tpu_serving_fleet_events`` counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ... import flags as _flags
+from ...observability import flight as _flight
+from .. import metrics as _smetrics
+from .fleet import Fleet
+
+_log = logging.getLogger("paddle_tpu.serving.fleet")
+
+__all__ = ["AutoscalePolicy", "FleetController"]
+
+_ROLES = ("prefill", "decode")
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Threshold policy — all counts are per controller ``step()``."""
+
+    queue_high: int = 4      # mean queued items/replica that = pressure
+    sustain: int = 2         # pressured steps before scale-up
+    idle_sustain: int = 3    # idle steps before scale-down
+    cooldown: int = 1        # steps to hold off after any scale action
+
+
+class FleetController:
+    """Scales a :class:`Fleet`'s replica classes on heartbeat signals."""
+
+    def __init__(self, fleet: Fleet,
+                 policy: Optional[AutoscalePolicy] = None,
+                 min_replicas: Optional[Dict[str, int]] = None,
+                 max_replicas: Optional[Dict[str, int]] = None):
+        self.fleet = fleet
+        self.policy = policy or AutoscalePolicy()
+        self.min_replicas = {r: 1 for r in _ROLES}
+        self.min_replicas.update(min_replicas or {})
+        self.max_replicas = {r: 4 for r in _ROLES}
+        self.max_replicas.update(max_replicas or {})
+        self._pressure = {r: 0 for r in _ROLES}
+        self._idle = {r: 0 for r in _ROLES}
+        self._cooldown = {r: 0 for r in _ROLES}
+        self._last_shed = {r: 0 for r in _ROLES}
+        self.steps = 0
+        self.decisions: List[Dict] = []
+
+    # -- signals --------------------------------------------------------
+
+    def signals(self) -> Dict[str, Dict]:
+        """One snapshot per replica class: live replica count, total
+        queue depth, total shed count, and dead replica names.  Read
+        from the heartbeat-payload plane when the fleet has a
+        directory (the cross-process path), from the replicas
+        directly otherwise."""
+        directory = self.fleet.directory
+        status = directory.status() if directory is not None else {}
+        expired = set(directory.expired()) if directory is not None \
+            else set()
+        out = {r: {"replicas": 0, "queue_depth": 0, "shed": 0,
+                   "dead": []} for r in _ROLES}
+        for name, rep in self.fleet.replicas().items():
+            if not rep.routing and not rep.alive:
+                continue  # already-quarantined corpse
+            sig = out.get(rep.role)
+            if sig is None:
+                continue
+            st = status.get(name)
+            payload = (st or {}).get("payload") or {}
+            dead = not rep.alive or name in expired
+            if dead:
+                sig["dead"].append(name)
+                continue
+            sig["replicas"] += 1
+            # the heartbeat payload is the truth when present (it is
+            # what a cross-process controller would see); direct reads
+            # back-fill for directory-less fleets
+            if payload:
+                sig["queue_depth"] += int(payload.get("queue_depth", 0))
+                sig["shed"] += int(payload.get("shed", 0))
+            else:
+                sig["queue_depth"] += rep.queue_depth()
+                sig["shed"] += rep._shed
+        return out
+
+    # -- the control loop -----------------------------------------------
+
+    def _note(self, action: str, role: str, **detail) -> None:
+        d = dict(action=action, role=role, step=self.steps, **detail)
+        self.decisions.append(d)
+        _log.info("fleet controller: %s %s (%s)", action, role, detail)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_event(action, role=role)
+            _flight.default_flight().record(
+                action, fleet=self.fleet.name, role=role, **detail)
+
+    def _decide(self, role: str, sig: Dict) -> Optional[str]:
+        """Pure policy: fold one signal snapshot into the streak
+        counters and return 'scale_up' / 'scale_down' / None.  Split
+        out so the thresholds are unit-testable without a fleet."""
+        p = self.policy
+        live = max(sig["replicas"], 1)
+        shed_delta = sig["shed"] - self._last_shed[role]
+        self._last_shed[role] = sig["shed"]
+        pressured = (sig["queue_depth"] >= p.queue_high * live
+                     or shed_delta > 0)
+        idle = sig["queue_depth"] == 0 and shed_delta == 0
+        self._pressure[role] = self._pressure[role] + 1 if pressured \
+            else 0
+        self._idle[role] = self._idle[role] + 1 if idle else 0
+        if self._cooldown[role] > 0:
+            self._cooldown[role] -= 1
+            return None
+        if self._pressure[role] >= p.sustain \
+                and sig["replicas"] < self.max_replicas[role]:
+            self._pressure[role] = 0
+            self._cooldown[role] = p.cooldown
+            return "scale_up"
+        if self._idle[role] >= p.idle_sustain \
+                and sig["replicas"] > self.min_replicas[role]:
+            self._idle[role] = 0
+            self._cooldown[role] = p.cooldown
+            return "scale_down"
+        return None
+
+    def step(self) -> List[Dict]:
+        """One control iteration; returns the decisions it acted on."""
+        self.steps += 1
+        acted: List[Dict] = []
+        sigs = self.signals()
+        for role in _ROLES:
+            sig = sigs[role]
+            for name in sig["dead"]:
+                self.fleet.quarantine_replica(name)
+                self._note("replica_dead", role, replica=name)
+                acted.append(self.decisions[-1])
+            # replace casualties that dropped the class below min
+            while sig["replicas"] < self.min_replicas[role]:
+                name = getattr(self.fleet, f"add_{role}")()
+                sig["replicas"] += 1
+                self.fleet._count("scale_ups")
+                self._note("scale_up", role, replica=name,
+                           reason="below_min")
+                acted.append(self.decisions[-1])
+            verdict = self._decide(role, sig)
+            if verdict == "scale_up":
+                name = getattr(self.fleet, f"add_{role}")()
+                self.fleet._count("scale_ups")
+                self._note("scale_up", role, replica=name,
+                           queue_depth=sig["queue_depth"])
+                acted.append(self.decisions[-1])
+            elif verdict == "scale_down":
+                victim = self._pick_victim(role)
+                if victim is not None:
+                    drained = self.fleet.drain_replica(victim,
+                                                       timeout=30.0)
+                    self.fleet.remove_replica(victim)
+                    self.fleet._count("scale_downs")
+                    self._note("scale_down", role, replica=victim,
+                               drained=bool(drained))
+                    acted.append(self.decisions[-1])
+        return acted
+
+    def _pick_victim(self, role: str) -> Optional[str]:
+        """Scale-down victim: the live replica with the shallowest
+        queue (least work to drain; name tiebreak)."""
+        reps = self.fleet.replicas(role)
+        live = sorted((rep.queue_depth(), name)
+                      for name, rep in reps.items()
+                      if rep.alive and rep.routing)
+        if len(live) <= self.min_replicas[role]:
+            return None
+        return live[0][1]
+
+    # -- rolling upgrade -------------------------------------------------
+
+    def rolling_upgrade(self, new_params: Dict,
+                        timeout: float = 30.0) -> List[str]:
+        """Swap every replica's weights under live traffic: drain one
+        replica (its queued + in-flight work completes; new traffic
+        routes to the others), swap params (prefix caches cleared,
+        pool asserted empty), rejoin, repeat.  Zero requests lost or
+        duplicated — the drain handoff guarantees it.  Returns the
+        upgraded replica names in order."""
+        upgraded: List[str] = []
+        for role in _ROLES:
+            for name in sorted(self.fleet.replicas(role)):
+                rep = self.fleet.replicas(role).get(name)
+                if rep is None or not rep.alive:
+                    continue
+                if not self.fleet.drain_replica(name, timeout=timeout):
+                    raise RuntimeError(
+                        f"replica {name} did not drain within "
+                        f"{timeout}s — aborting the rolling upgrade")
+                rep.swap_params(new_params, timeout=timeout)
+                self.fleet.resume_replica(name)
+                self.fleet._count("upgrades")
+                self._note("upgrade", role, replica=name)
+                upgraded.append(name)
+        return upgraded
+
+
+def run_controller(controller: FleetController, every_s: float = 0.1,
+                   stop=None) -> None:  # pragma: no cover — helper
+    """Drive step() on an interval until `stop` (a threading.Event) is
+    set — the long-running deployment shape; tests call step()
+    directly for determinism."""
+    while stop is None or not stop.is_set():
+        controller.step()
+        time.sleep(every_s)

@@ -1,0 +1,477 @@
+"""The disaggregated-serving frontend: one submit() over two replica
+classes, with the KV handoff brokered in between.
+
+Request life: ``submit(DecodeRequest)`` routes to the least-loaded
+live :class:`PrefillReplica`; when its prefill completes, the broker
+(running on the prefill worker via ``plan_handoff`` + a future
+callback) has already reserved the destination
+:class:`DecodeReplica`'s cached prefix and shipped only the unshared
+tail; the decode replica imports the pages and decodes to completion;
+the fleet future resolves with the finished ``GeneratedSequence``.
+
+Failure is fail-over, never loss: a killed replica's queued work
+returns typed (:class:`ReplicaKilledError`) and is resubmitted to
+survivors; a dropped handoff payload (chaos
+``FAULT_SERVE_HANDOFF_DROP``) requeues the request for a fresh prefill
+(a payload exported against a destination reservation cannot be
+rerouted — its prefix content never shipped); a poisoned prefill
+quarantines one request (its result carries the
+``NonFiniteSequenceError``, matching the monolithic loop's contract).
+Every submit's future resolves exactly once — ``lost_requests == 0``
+is the bankable invariant.
+
+Scaling: ``add_prefill``/``add_decode`` (the autoscaler's actuators)
+spawn replicas through caller-supplied factories;
+``drain_replica``/``resume_replica``/``remove_replica`` implement
+zero-loss scale-down and the rolling-upgrade drain→swap→rejoin cycle
+(:meth:`FleetController.rolling_upgrade` drives it).  With a
+``ReplicaDirectory`` the replicas heartbeat the elastic master with
+status payloads and the controller reads its signals over the same
+plane — in-process or through ``RemoteMaster``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ... import flags as _flags
+from ...resilience import faultinject as _finject
+from ...observability import flight as _flight
+from .. import metrics as _smetrics
+from ..generate import (
+    DecodeRequest,
+    GeneratedSequence,
+    NonFiniteSequenceError,
+)
+from .handoff import Handoff, HandoffDropError
+from .replica import (
+    DecodeReplica,
+    FleetQueueFullError,
+    FleetReplica,
+    PrefillReplica,
+    ReplicaDrainingError,
+    ReplicaKilledError,
+)
+
+_log = logging.getLogger("paddle_tpu.serving.fleet")
+
+__all__ = ["Fleet", "NoReplicaAvailableError"]
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """No live replica of the needed class could admit the request
+    (after the retry budget) — the fleet-level fast failure."""
+
+
+class Fleet:
+    """Prefill and decode replica classes behind one submit()."""
+
+    def __init__(self,
+                 spawn_prefill: Callable[[str], PrefillReplica],
+                 spawn_decode: Callable[[str], DecodeReplica],
+                 n_prefill: int = 1, n_decode: int = 1,
+                 directory=None, max_retries: int = 3,
+                 place_timeout_s: float = 10.0, name: str = "fleet"):
+        self.name = name
+        self.directory = directory
+        self.max_retries = int(max_retries)
+        # how long a request may WAIT for a placeable replica (drain
+        # windows during rolling upgrades, queue-full backpressure,
+        # the gap while the autoscaler replaces a casualty) before the
+        # fleet fails it typed — waiting is not a failover
+        self.place_timeout_s = float(place_timeout_s)
+        self._spawn = {"prefill": spawn_prefill, "decode": spawn_decode}
+        self._lock = threading.Lock()
+        self._prefill: Dict[str, PrefillReplica] = {}
+        self._decode: Dict[str, DecodeReplica] = {}
+        self._next_id = {"prefill": 0, "decode": 0}
+        self._stats = {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "handoffs": 0, "handoff_bytes": 0, "skipped_tokens": 0,
+            "handoff_drops": 0, "failovers": 0, "re_prefills": 0,
+            "replica_deaths": 0, "scale_ups": 0, "scale_downs": 0,
+            "upgrades": 0,
+        }
+        self.ttfts: List[float] = []   # fleet-level submit→first-token
+        for _ in range(int(n_prefill)):
+            self.add_prefill()
+        for _ in range(int(n_decode)):
+            self.add_decode()
+
+    # -- membership / scaling -------------------------------------------
+
+    def _add(self, role: str) -> str:
+        with self._lock:
+            name = f"{role}{self._next_id[role]}"
+            self._next_id[role] += 1
+        rep = self._spawn[role](name)
+        if rep.role != role:
+            raise ValueError(
+                f"spawn_{role} returned a {rep.role!r} replica")
+        if role == "prefill":
+            rep.plan_handoff = self._plan_handoff
+        if self.directory is not None:
+            rep.join_directory(self.directory)
+        with self._lock:
+            getattr(self, f"_{role}")[name] = rep
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_replicas(role, len(self.replicas(role)))
+        return name
+
+    def add_prefill(self) -> str:
+        """Scale up the prefill class by one replica; returns its name."""
+        return self._add("prefill")
+
+    def add_decode(self) -> str:
+        """Scale up the decode class by one replica; returns its name."""
+        return self._add("decode")
+
+    def replicas(self, role: Optional[str] = None) -> Dict[str, FleetReplica]:
+        with self._lock:
+            if role == "prefill":
+                return dict(self._prefill)
+            if role == "decode":
+                return dict(self._decode)
+            out: Dict[str, FleetReplica] = dict(self._prefill)
+            out.update(self._decode)
+            return out
+
+    def _find(self, name: str) -> FleetReplica:
+        with self._lock:
+            rep = self._prefill.get(name) or self._decode.get(name)
+        if rep is None:
+            raise KeyError(f"no replica {name!r}")
+        return rep
+
+    def drain_replica(self, name: str,
+                      timeout: Optional[float] = None) -> bool:
+        """Zero-loss drain: stop routing to the replica, then wait for
+        its queued + in-flight work to finish there."""
+        rep = self._find(name)
+        rep.routing = False
+        return rep.drain(timeout)
+
+    def resume_replica(self, name: str) -> None:
+        rep = self._find(name)
+        rep.resume()
+        rep.routing = True
+
+    def remove_replica(self, name: str) -> FleetReplica:
+        """Decommission a (drained) replica: stop its worker, then
+        deregister its lease — closing FIRST, so a beat in flight
+        cannot re-register the ghost the deregistration just
+        removed."""
+        with self._lock:
+            rep = self._prefill.pop(name, None) \
+                or self._decode.pop(name, None)
+        if rep is None:
+            raise KeyError(f"no replica {name!r}")
+        rep.routing = False
+        rep.close(timeout=10.0)
+        if self.directory is not None:
+            self.directory.deregister(name)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_replicas(
+                rep.role, len(self.replicas(rep.role)))
+        return rep
+
+    def quarantine_replica(self, name: str) -> None:
+        """A dead/silent replica: silence it for good (routing stops,
+        heartbeats stop, queued work fails over typed — an
+        alive-but-flapping replica must not beat its ghost lease back
+        to life), then deregister the lease.  The object stays visible
+        for post-mortems."""
+        rep = self._find(name)
+        rep.quarantine()
+        with self._lock:
+            self._stats["replica_deaths"] += 1
+        if self.directory is not None:
+            self.directory.deregister(name)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_event("replica_dead", role=rep.role)
+            _flight.default_flight().record(
+                "replica_dead", fleet=self.name, replica=name,
+                role=rep.role)
+
+    # -- routing --------------------------------------------------------
+
+    def _pick(self, reps: Dict[str, FleetReplica]) -> Optional[FleetReplica]:
+        """Least-queue-depth live routable replica (name tiebreak)."""
+        best = None
+        best_key = None
+        for name in sorted(reps):
+            rep = reps[name]
+            if not (rep.alive and rep.routing and not rep.draining):
+                continue
+            key = (rep.queue_depth(), name)
+            if best_key is None or key < best_key:
+                best, best_key = rep, key
+        return best
+
+    def _plan_handoff(self, req: DecodeRequest):
+        """Called by the prefill worker right before export: pick the
+        destination decode replica and reserve its cached prefix (the
+        payload then ships only the unshared tail)."""
+        with self._lock:
+            reps = dict(self._decode)
+        rep = self._pick(reps)
+        if rep is None:
+            return None
+        return rep.name, rep.reserve_prefix(req.prompt)
+
+    # -- the request path -----------------------------------------------
+
+    def submit(self, req: DecodeRequest) -> Future:
+        """One request through prefill → handoff → decode; the returned
+        Future resolves to the finished GeneratedSequence (with
+        ``.error`` set for a quarantined sequence, the monolithic
+        loop's contract) or raises typed when the fleet could not place
+        it within the retry budget."""
+        fut: Future = Future()
+        with self._lock:
+            self._stats["submitted"] += 1
+        self._dispatch_prefill(req, fut, retries=0,
+                               t_submit=time.perf_counter())
+        return fut
+
+    def infer(self, req: DecodeRequest,
+              timeout: Optional[float] = None) -> GeneratedSequence:
+        return self.submit(req).result(timeout)
+
+    def _resolve(self, fut: Future, result=None, error=None) -> None:
+        with self._lock:
+            self._stats["completed" if error is None else "failed"] += 1
+        if not fut.set_running_or_notify_cancel():
+            return
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set_result(result)
+
+    def _dispatch_prefill(self, req: DecodeRequest, fut: Future,
+                          retries: int, t_submit: float) -> None:
+        with self._lock:
+            reps = dict(self._prefill)
+        rep = self._pick(reps)
+        if rep is not None:
+            try:
+                pfut = rep.submit(req)
+            except ValueError as e:
+                # request-shape validation: retrying cannot fix it
+                self._resolve(fut, error=e)
+                return
+            except (ReplicaKilledError, ReplicaDrainingError,
+                    FleetQueueFullError):
+                rep = None  # raced a kill/drain/full — wait and re-place
+        if rep is not None:
+            pfut.add_done_callback(
+                lambda f: self._on_prefilled(f, req, fut, retries,
+                                             t_submit))
+            return
+        # nothing placeable RIGHT NOW (a rolling upgrade draining the
+        # only replica, queue-full backpressure, a casualty awaiting
+        # its replacement): wait within the placement budget instead
+        # of failing the request — waiting is not a failover
+        if time.perf_counter() - t_submit < self.place_timeout_s:
+            t = threading.Timer(
+                0.05, self._dispatch_prefill,
+                args=(req, fut, retries, t_submit))
+            t.daemon = True
+            t.start()
+        else:
+            self._resolve(fut, error=NoReplicaAvailableError(
+                f"no prefill replica admitted the request within "
+                f"{self.place_timeout_s}s"))
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def _on_prefilled(self, pfut: Future, req: DecodeRequest,
+                      fut: Future, retries: int,
+                      t_submit: float) -> None:
+        exc = pfut.exception()
+        if exc is not None:
+            if isinstance(exc, NonFiniteSequenceError):
+                # quarantine-not-crash: the request's result carries
+                # the error, exactly as the monolithic loop reports it
+                self._resolve(fut, result=GeneratedSequence(
+                    seq_id=getattr(exc, "seq_id", -1),
+                    prompt=[int(t) for t in req.prompt], error=exc))
+            elif isinstance(exc, ReplicaKilledError) \
+                    and retries < self.max_retries:
+                self._count("failovers")
+                self._dispatch_prefill(req, fut, retries + 1, t_submit)
+            else:
+                self._resolve(fut, error=exc)
+            return
+        hd: Handoff = pfut.result()
+        if _finject.serve_handoff_drop():
+            # chaos: the payload is lost in transit — release the
+            # destination's reservation and requeue for a fresh prefill
+            self._count("handoff_drops")
+            self._release_on_dest(hd)
+            if _flags._VALUES["FLAGS_observability"]:
+                _smetrics.record_fleet_event("handoff_drop")
+                _flight.default_flight().record(
+                    "handoff_drop", fleet=self.name, src=hd.src,
+                    dest=hd.dest, trace_id=req.trace_id)
+            if retries < self.max_retries:
+                self._count("re_prefills")
+                self._dispatch_prefill(req, fut, retries + 1, t_submit)
+            else:
+                self._resolve(fut, error=HandoffDropError(
+                    "handoff dropped and retry budget exhausted"))
+            return
+        self._dispatch_decode(hd, req, fut, retries, t_submit)
+
+    def _release_on_dest(self, hd: Handoff) -> None:
+        if hd.dest is None:
+            return
+        with self._lock:
+            dest = self._decode.get(hd.dest)
+        if dest is not None:
+            hd.release(dest.pool)
+
+    def _dispatch_decode(self, hd: Handoff, req: DecodeRequest,
+                         fut: Future, retries: int,
+                         t_submit: float) -> None:
+        with self._lock:
+            dest = self._decode.get(hd.dest) if hd.dest else None
+        if dest is None or not (dest.alive and dest.routing
+                                and not dest.draining):
+            self._release_on_dest(hd)
+            self._failover_handoff(hd, req, fut, retries, t_submit,
+                                   why="destination unavailable")
+            return
+        try:
+            dfut = dest.submit(hd)
+        except (ReplicaKilledError, ReplicaDrainingError,
+                FleetQueueFullError, ValueError) as e:
+            self._release_on_dest(hd)
+            if isinstance(e, ValueError) or retries >= self.max_retries:
+                self._resolve(fut, error=e)
+            else:
+                self._failover_handoff(hd, req, fut, retries, t_submit,
+                                       why=type(e).__name__)
+            return
+        # ONE TTFT sample per request, and only for a first token whose
+        # payload actually reached a decode replica — a dropped handoff
+        # re-prefills, and counting its never-delivered first token
+        # would skew the banked percentiles low
+        if not getattr(fut, "_ttft_banked", False):
+            fut._ttft_banked = True
+            self.ttfts.append(hd.first_token_at - t_submit)
+        self._count("handoffs")
+        self._count("handoff_bytes", hd.nbytes())
+        self._count("skipped_tokens", hd.payload.skip_tokens)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_event("handoff")
+            _smetrics.record_handoff_bytes(hd.nbytes())
+            _flight.default_flight().record(
+                "handoff", fleet=self.name, src=hd.src, dest=hd.dest,
+                bytes=hd.nbytes(),
+                skipped_tokens=hd.payload.skip_tokens,
+                trace_id=req.trace_id)
+        dfut.add_done_callback(
+            lambda f: self._on_decoded(f, hd, req, fut, retries,
+                                       t_submit))
+
+    def _failover_handoff(self, hd: Handoff, req: DecodeRequest,
+                          fut: Future, retries: int, t_submit: float,
+                          why: str, count: bool = True) -> None:
+        """The planned destination cannot take the handoff.  A payload
+        that shipped everything reroutes to any other decode replica
+        (waiting out a drain window if none is up right now); one
+        exported against a prefix reservation is missing content and
+        must re-prefill."""
+        if count:
+            self._count("failovers")
+            if _flags._VALUES["FLAGS_observability"]:
+                _smetrics.record_fleet_event("failover", role="decode")
+        if hd.reroutable():
+            with self._lock:
+                reps = dict(self._decode)
+            rep = self._pick(reps)
+            if rep is not None:
+                hd.dest = rep.name
+                self._dispatch_decode(hd, req, fut, retries + 1,
+                                      t_submit)
+                return
+            if time.perf_counter() - t_submit < self.place_timeout_s:
+                # every decode replica is draining/replacing right now
+                # — the payload is host-resident, waiting costs nothing
+                t = threading.Timer(
+                    0.05, self._failover_handoff,
+                    args=(hd, req, fut, retries, t_submit, why, False))
+                t.daemon = True
+                t.start()
+                return
+        if retries < self.max_retries:
+            self._count("re_prefills")
+            self._dispatch_prefill(req, fut, retries + 1, t_submit)
+        else:
+            self._resolve(fut, error=NoReplicaAvailableError(
+                f"no decode replica could take the handoff ({why})"))
+
+    def _on_decoded(self, dfut: Future, hd: Handoff,
+                    req: DecodeRequest, fut: Future, retries: int,
+                    t_submit: float) -> None:
+        exc = dfut.exception()
+        if exc is None:
+            self._resolve(fut, result=dfut.result())
+            return
+        if isinstance(exc, ReplicaKilledError) \
+                and retries < self.max_retries:
+            self._release_on_dest(hd)
+            self._failover_handoff(hd, req, fut, retries, t_submit,
+                                   why="replica killed")
+            return
+        self._resolve(fut, error=exc)
+
+    # -- introspection / lifecycle --------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            st = dict(self._stats)
+            st["prefill_replicas"] = len(self._prefill)
+            st["decode_replicas"] = len(self._decode)
+            st["lost_requests"] = (st["submitted"] - st["completed"]
+                                   - st["failed"])
+        return st
+
+    def health(self) -> Dict:
+        return {name: rep.health()
+                for name, rep in sorted(self.replicas().items())}
+
+    def audit(self) -> Dict:
+        """Leak/integrity epilogue over every replica pool: clear the
+        prefix caches (pinned cache pages are a feature; pages nobody
+        owns are a leak), then audit.  Returns aggregate
+        ``pages_leaked`` and ``invariants_ok``."""
+        leaked = 0
+        ok = True
+        for rep in self.replicas().values():
+            if not rep.alive:
+                continue  # a chaos-killed replica's pool died with it
+            if rep.cache is not None:
+                rep.cache.clear()
+            leaked += rep.pool.used_pages
+            ok = ok and rep.pool.check_invariants()["ok"]
+        return {"pages_leaked": leaked, "invariants_ok": int(ok)}
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        for rep in self.replicas().values():
+            rep.routing = False
+        for rep in self.replicas().values():
+            rep.close(timeout)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

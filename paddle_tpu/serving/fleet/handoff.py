@@ -1,0 +1,134 @@
+"""The prefill→decode handoff contract.
+
+A :class:`Handoff` is everything a decode replica needs to continue a
+sequence another replica prefilled: the original request, the first
+generated token (chosen by the prefill side against its own logits —
+greedy argmax, biased argmax, or the seeded sampling epilogue, so the
+choice is exactly what a monolithic loop would have made), the logits
+row behind it, and the sequence's KV pages staged to host buffers
+(:class:`~paddle_tpu.serving.kvcache.SeqExport` — numpy, so the same
+payload crosses a process boundary unchanged).
+
+Prefix-cache composition: before exporting, the handoff broker asks
+the DESTINATION replica to reserve the longest prefix of the prompt
+its own cache already holds (:class:`PrefixReservation` — the matched
+FULL pages, refcount-pinned so eviction cannot race the transfer), and
+the export then ships only the unshared tail.  At admission the
+destination re-attaches the reserved pages read-only and imports the
+tail in one atomic claim — the imported footprint is charged exactly
+like a locally-prefilled sequence's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..generate import DecodeRequest
+from ..kvcache import SeqExport
+
+__all__ = ["Handoff", "HandoffDropError", "PrefixReservation"]
+
+
+class HandoffDropError(RuntimeError):
+    """The handoff payload was lost in transit (chaos:
+    FAULT_SERVE_HANDOFF_DROP) — the fleet requeues the request for a
+    fresh prefill instead of losing it."""
+
+
+@dataclasses.dataclass
+class PrefixReservation:
+    """Matched FULL prefix pages on the DESTINATION pool, refcount-
+    pinned for the duration of the transfer so LRU eviction cannot
+    invalidate them between the reserve and the import.  Registered as
+    an external owner on the destination pool (DecodeReplica keeps the
+    registry), so a mid-transfer ``check_invariants`` audit counts the
+    holds as legitimate."""
+
+    keys: List[str]
+    pages: List[int]
+    tokens: int                 # page-aligned prompt tokens covered
+    released: bool = False
+    # id(self) -> self in the owning DecodeReplica's registry (a dict,
+    # not a set: dataclass equality must not conflate two reservations
+    # over the same pages)
+    _registry: Optional[dict] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def release(self, pool) -> int:
+        """Drop the reservation holds (idempotent).  Called either by
+        :meth:`Handoff.admit` once the pages joined the sequence's
+        table, or by the failover path when the transfer died."""
+        if self.released:
+            return 0
+        self.released = True
+        if self._registry is not None:
+            self._registry.pop(id(self), None)
+            self._registry = None
+        return pool.release_pages(self.pages)
+
+
+class Handoff:
+    """One prefilled sequence in flight between replicas."""
+
+    def __init__(self, request: DecodeRequest, first_token: int,
+                 first_logits: np.ndarray, payload: SeqExport,
+                 reservation: Optional[PrefixReservation] = None,
+                 src: Optional[str] = None, dest: Optional[str] = None):
+        self.request = request
+        self.first_token = int(first_token)
+        self.first_logits = first_logits
+        self.payload = payload
+        self.reservation = reservation
+        self.src = src
+        self.dest = dest
+        self.first_token_at = time.perf_counter()
+        self.admitted = False
+
+    @property
+    def matched_tokens(self) -> int:
+        """Prefix tokens the destination re-attaches from its own
+        cache (== payload.skip_tokens) — the decode loop's admission
+        reads this for its prefix-aware footprint charge."""
+        res = self.reservation
+        return res.tokens if res is not None else 0
+
+    def nbytes(self) -> int:
+        return self.payload.nbytes()
+
+    def reroutable(self) -> bool:
+        """A payload that skipped nothing can go to ANY decode replica;
+        one exported against a reservation is missing its prefix
+        content and only fits the replica that reserved it — failover
+        must re-prefill instead."""
+        return self.payload.skip_tokens == 0
+
+    def admit(self, pool, prefix_cache, seq_id: int) -> None:
+        """Materialize the sequence on the destination: re-attach the
+        reserved prefix read-only (through the cache, so quarantine
+        invalidation knows the chain), import the shipped tail in one
+        atomic claim, then drop the reservation's transfer holds."""
+        res = self.reservation
+        if res is not None and res.tokens:
+            if prefix_cache is None:
+                raise RuntimeError(
+                    "handoff carries a prefix reservation but the "
+                    "destination loop has no prefix cache")
+            from ..prefixcache import PrefixMatch
+
+            prefix_cache.attach(seq_id, PrefixMatch(
+                keys=list(res.keys), pages=list(res.pages),
+                tokens=res.tokens))
+        pool.import_seq(self.payload, seq_id)
+        if res is not None:
+            res.release(pool)
+        self.admitted = True
+
+    def release(self, pool) -> None:
+        """Failover cleanup: drop the reservation holds of a handoff
+        that will never be admitted on this pool."""
+        if self.reservation is not None and not self.admitted:
+            self.reservation.release(pool)

@@ -1,0 +1,715 @@
+"""Disaggregated serving replicas: prefill-only and decode-only.
+
+Prefill is compute-bound (one big causal pass per prompt) and decode is
+bandwidth-bound (one KV page stream per token), so one replica class
+serves both badly: co-scheduling them couples a long prompt's compute
+burst to every in-flight sequence's per-token latency, and capacity
+planning has to size one pool for two very different residencies.  The
+fleet splits them:
+
+- :class:`PrefillReplica` runs chunked whole-prompt prefill ONLY: admit
+  a group of prompts, write their K/V into its own (transient) pool —
+  whole-prompt ``prefill_step`` when nothing is cached and no chunk cap
+  binds, ``chunk_prefill_step`` otherwise, exactly the monolithic
+  loop's arithmetic — choose each sequence's first token against the
+  final logits (greedy/biased argmax or the seeded sampling epilogue,
+  so the choice is what a monolithic loop would have made), then
+  EXPORT the sequence (``KVCachePool.export_seq``) and free it.  Its
+  prefix cache makes repeated prefixes cost one prefill; its pool is
+  sized for prompts in flight, not sessions.
+- :class:`DecodeReplica` runs the continuous-batching loop ONLY:
+  submitted :class:`~paddle_tpu.serving.fleet.handoff.Handoff`\\ s are
+  admitted straight into decode — the loop imports the shipped pages
+  (one atomic claim), re-attaches reserved shared-prefix pages from its
+  OWN cache, emits the already-chosen first token, and the sequence
+  decodes like any locally-prefilled one.  Its pool is sized for
+  concurrent sessions' KV residency.
+
+Both classes ride one worker thread (:class:`FleetReplica` — the
+in-process stand-in for a replica process this PR; the payloads and
+the control plane are already cross-process-shaped), heartbeat the
+elastic master through a ``ReplicaDirectory`` with a status payload
+(queue depth, shed count, health state — the autoscaler's signals),
+and degrade quarantine-not-crash: a poisoned prefill evicts one
+request, a chaos replica kill (FAULT_SERVE_REPLICA_KILL) fails queued
+work typed so the fleet fails it over, never silently loses it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import flags as _flags
+from ...resilience import faultinject as _finject
+from ...resilience.sentinel import rows_finite
+from .. import metrics as _smetrics
+from ..generate import (
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    NonFiniteSequenceError,
+    chunk_prefill_step,
+    prefill_step,
+)
+from ..kvcache import KVCachePool
+from ..prefixcache import PrefixCache
+from ..sampling import apply_bias, sample_rows
+from .handoff import Handoff, PrefixReservation
+
+_log = logging.getLogger("paddle_tpu.serving.fleet")
+
+__all__ = [
+    "DecodeReplica",
+    "FleetQueueFullError",
+    "FleetReplica",
+    "PrefillReplica",
+    "ReplicaDrainingError",
+    "ReplicaKilledError",
+]
+
+
+class ReplicaKilledError(RuntimeError):
+    """The replica died (chaos FAULT_SERVE_REPLICA_KILL or a real
+    worker-thread death): its queued work fails with this so the fleet
+    can fail it over to survivors — zero requests lost."""
+
+
+class ReplicaDrainingError(RuntimeError):
+    """The replica is draining (scale-down or rolling upgrade) and no
+    longer admits work; the fleet routes elsewhere."""
+
+
+class FleetQueueFullError(RuntimeError):
+    """The replica's bounded queue is full — counted as shed, which is
+    one of the autoscaler's scale-up signals."""
+
+
+class FleetReplica:
+    """One worker-thread replica: bounded queue, drain/resume, chaos
+    kill, and heartbeat-with-payload on the elastic master's plane."""
+
+    role = "?"
+
+    def __init__(self, name: str, max_batch: int = 4,
+                 queue_cap: int = 256, beat_every_s: float = 0.05):
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.queue_cap = int(queue_cap)
+        self.routing = True          # fleet-level routing claim
+        self.directory = None        # ReplicaDirectory once joined
+        self._beat_every_s = float(beat_every_s)
+        self._cond = threading.Condition()
+        self._queue: List[Tuple[object, Future]] = []
+        self._draining = False
+        self._stopped = False
+        self._busy = False
+        self._alive = True
+        self._shed = 0
+        self._processed = 0
+        self._errors = 0
+        self._beat_thread: Optional[threading.Thread] = None
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name=f"fleet-{name}")
+        self._thread.start()
+
+    # -- membership / liveness -----------------------------------------
+
+    def join_directory(self, directory) -> None:
+        """Start heartbeating ``replica/<name>`` with a status payload.
+        Beats run on their OWN thread, independent of the worker: a
+        long decode batch must not go lease-silent and get a
+        healthy-but-busy replica quarantined exactly when it is
+        busiest."""
+        self.directory = directory
+        directory.register(self.name, payload=self._payload())
+        if self._beat_thread is None:
+            self._beat_thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name=f"fleet-{self.name}-beat")
+            self._beat_thread.start()
+
+    def _beat_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped or not self._alive:
+                    return
+                self._cond.wait(self._beat_every_s)
+                if self._stopped or not self._alive:
+                    return
+            self._beat()  # outside the lock: _payload() re-takes it
+
+    def _payload(self) -> Dict:
+        h = self.health()
+        return {"role": self.role, "state": h["state"],
+                "queue_depth": h["queue_depth"], "shed": self._shed,
+                "processed": self._processed}
+
+    def _beat(self) -> None:
+        d = self.directory
+        if d is None or self._stopped or not self._alive:
+            # a quarantined/stopped replica must go SILENT: one more
+            # beat would re-register the ghost lease the controller
+            # just deregistered
+            return
+        try:
+            d.beat(self.name, payload=self._payload())
+        except Exception as e:  # noqa: BLE001 — a flapping master must
+            # not kill the replica; the lease lapses and the controller
+            # notices through expired() instead
+            _log.warning("replica %s heartbeat failed: %s", self.name, e)
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue) + (1 if self._busy else 0)
+
+    def health(self) -> Dict:
+        with self._cond:
+            q = len(self._queue) + (1 if self._busy else 0)
+        state = ("BROKEN" if not self._alive
+                 else "DRAINING" if self._draining else "SERVING")
+        return {"state": state, "role": self.role, "queue_depth": q,
+                "alive": self._alive, "shed": self._shed,
+                "processed": self._processed, "errors": self._errors}
+
+    # -- admission ------------------------------------------------------
+
+    def _submit_item(self, item) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if not self._alive:
+                raise ReplicaKilledError(
+                    f"replica {self.name} is dead")
+            if self._draining or self._stopped or not self.routing:
+                raise ReplicaDrainingError(
+                    f"replica {self.name} is draining")
+            if len(self._queue) >= self.queue_cap:
+                self._shed += 1
+                raise FleetQueueFullError(
+                    f"replica {self.name} queue full "
+                    f"({self.queue_cap})")
+            self._queue.append((item, fut))
+            self._cond.notify_all()
+        return fut
+
+    # -- drain / upgrade / stop ----------------------------------------
+
+    def begin_drain(self) -> None:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admissions, then wait for queued + in-flight work to
+        finish.  Returns True when fully drained (timeout=0 polls)."""
+        self.begin_drain()
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while self._queue or self._busy:
+                wait = 0.1
+                if deadline is not None:
+                    wait = deadline - time.perf_counter()
+                    if wait <= 0:
+                        return False
+                    wait = min(wait, 0.1)
+                self._cond.wait(wait)
+        return True
+
+    def resume(self) -> None:
+        """Re-admit work after a drain (the rolling-upgrade rejoin)."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self.drain(timeout)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(5.0)
+        if self._beat_thread is not None:
+            self._beat_thread.join(1.0)
+
+    def quarantine(self) -> None:
+        """Permanently silence a quarantined replica: stop admissions
+        AND heartbeats (an alive-but-flapping replica would otherwise
+        keep beating and re-register the lease the controller just
+        deregistered — counted live forever with routing off, so the
+        class never gets its replacement), and fail queued work over
+        typed.  An in-flight batch still finishes and resolves its
+        futures; the worker thread then exits on its own."""
+        self.routing = False
+        with self._cond:
+            self._alive = False
+            self._stopped = True
+            leftovers, self._queue = self._queue, []
+            self._cond.notify_all()
+        if leftovers:
+            _log.warning(
+                "replica %s quarantined; failing %d queued items over",
+                self.name, len(leftovers))
+        err = ReplicaKilledError(f"replica {self.name} quarantined")
+        for item, fut in leftovers:
+            self._cleanup_item(item)
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(err)
+
+    # -- worker ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            if _finject.serve_replica_kill(self.name):
+                self._die()
+                return
+            batch = None
+            with self._cond:
+                if self._queue:
+                    batch = self._take_locked()
+                    self._busy = bool(batch)
+                elif self._stopped:
+                    self._cond.notify_all()
+                    return
+                else:
+                    self._cond.notify_all()  # wake drain()/close()
+                    self._cond.wait(self._beat_every_s)
+            if batch:
+                try:
+                    self._process(batch)
+                except BaseException as e:  # noqa: BLE001 — a raise
+                    # costs this batch, never the replica (the loop /
+                    # prefill steps already freed their pages)
+                    self._errors += 1
+                    _log.warning(
+                        "replica %s batch failed (%s: %s)", self.name,
+                        type(e).__name__, e)
+                    for item, fut in batch:
+                        self._cleanup_item(item)
+                        if fut.set_running_or_notify_cancel():
+                            fut.set_exception(e)
+                finally:
+                    with self._cond:
+                        self._busy = False
+                        self._cond.notify_all()
+            # no beat here: the beat thread owns the lease cadence
+
+    def _die(self) -> None:
+        """Chaos replica kill: the worker thread exits WITHOUT restart
+        (a dead process has no supervisor).  Queued work fails typed so
+        the fleet fails it over — quarantine-not-crash."""
+        with self._cond:
+            self._alive = False
+            self._stopped = True
+            leftovers, self._queue = self._queue, []
+            self._cond.notify_all()
+        _log.warning(
+            "replica %s killed (chaos); failing %d queued items over",
+            self.name, len(leftovers))
+        err = ReplicaKilledError(f"replica {self.name} killed")
+        for item, fut in leftovers:
+            self._cleanup_item(item)
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(err)
+
+    # subclass hooks
+    def _take_locked(self) -> List:
+        n = min(len(self._queue), self.max_batch)
+        batch, self._queue = self._queue[:n], self._queue[n:]
+        return batch
+
+    def _process(self, batch: List) -> None:
+        raise NotImplementedError
+
+    def _cleanup_item(self, item) -> None:
+        """Undo any cross-replica state a failed/killed item holds."""
+
+
+@dataclasses.dataclass
+class _Job:
+    req: DecodeRequest
+    fut: Future
+    seq_id: int
+    pos: int = 0          # prompt tokens already covered (cache hits)
+    matched: int = 0      # of which served by the prefix cache
+    row: Optional[np.ndarray] = None
+
+
+def _choose_first(req: DecodeRequest, row: np.ndarray) -> int:
+    """The first generated token, chosen exactly as the monolithic
+    loop's emit path would: (bias-shifted) greedy argmax, or the
+    seeded sampling epilogue at token index 0 for non-greedy params —
+    so a handoff sequence's stream is replay-identical."""
+    p = req.sampling
+    if p is None or p.greedy:
+        return int(apply_bias(row, p).argmax())
+    return int(sample_rows(
+        np.asarray([apply_bias(row, p)]), [p], [0])[0])
+
+
+class PrefillReplica(FleetReplica):
+    """Chunked whole-prompt prefill only; emits Handoffs."""
+
+    role = "prefill"
+
+    def __init__(self, name: str, params: Dict, cfg: DecodeConfig,
+                 num_pages: int = 64, page_size: int = 8,
+                 dtype: str = "float32", max_batch: int = 4,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True, plan_handoff=None,
+                 queue_cap: int = 256, beat_every_s: float = 0.05):
+        self.params = params
+        self.cfg = cfg
+        self.pool = KVCachePool(
+            num_pages, page_size, cfg.n_layer, cfg.n_head, cfg.head_dim,
+            dtype=dtype, name=f"{name}-pool",
+            num_kv_heads=cfg.num_kv_heads)
+        self.cache = PrefixCache(self.pool) if prefix_cache else None
+        self._chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else _flags._VALUES["FLAGS_serving_prefill_chunk"])
+        # plan_handoff(req) -> (dest_name, PrefixReservation|None) |
+        # None — the fleet's broker picks the destination replica and
+        # reserves its cached prefix RIGHT BEFORE export, so the
+        # payload ships only the unshared tail
+        self.plan_handoff = plan_handoff
+        self._next_seq = 0
+        self.steps = 0
+        self.prefills = 0
+        self.quarantined = 0
+        self.exported_bytes = 0
+        self.skipped_tokens = 0
+        super().__init__(name, max_batch=max_batch, queue_cap=queue_cap,
+                         beat_every_s=beat_every_s)
+
+    def submit(self, req: DecodeRequest) -> Future:
+        """Enqueue one request; the Future resolves to a Handoff (or a
+        typed error).  Request-shape validation happens HERE so one bad
+        request never fails a co-prefilled group."""
+        if not len(req.prompt):
+            raise ValueError("empty prompt")
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.cfg.max_length:
+            raise ValueError(
+                f"prompt+max_new={total} exceeds max_length "
+                f"{self.cfg.max_length}")
+        if req.sampling is not None \
+                and req.sampling.max_bias_token() >= self.cfg.vocab_size:
+            raise ValueError(
+                f"logit_bias token {req.sampling.max_bias_token()} >= "
+                f"vocab_size {self.cfg.vocab_size}")
+        need = KVCachePool.pages_needed(len(req.prompt),
+                                        self.pool.page_size)
+        if need > self.pool.num_pages:
+            raise ValueError(
+                f"prompt needs {need} pages worst-case but replica "
+                f"{self.name}'s pool has {self.pool.num_pages}")
+        return self._submit_item(req)
+
+    def swap_params(self, new_params: Dict,
+                    timeout: float = 5.0) -> None:
+        """Rolling-upgrade arm: replace the weights of a DRAINED
+        replica.  The prefix cache is invalidated (its K/V was computed
+        with the old weights) and the pool must come up empty."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before swap_params")
+        if self.cache is not None:
+            self.cache.clear()
+        deadline = time.perf_counter() + timeout
+        while self.pool.used_pages and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        if self.pool.used_pages:
+            raise RuntimeError(
+                f"replica {self.name}: {self.pool.used_pages} pages "
+                "still live after drain — cannot swap params")
+        self.params = new_params
+
+    def _take_locked(self) -> List:
+        """Build one co-admitted group that conservatively fits the
+        pool (the head request is always taken: the cache's pressure
+        reclaimer may still make room, and an impossible request must
+        fail loudly rather than deadlock the queue)."""
+        group: List = []
+        free = self.pool.free_pages
+        while self._queue and len(group) < self.max_batch:
+            req, fut = self._queue[0]
+            need = KVCachePool.pages_needed(
+                len(req.prompt), self.pool.page_size)
+            if group and need > free:
+                break
+            self._queue.pop(0)
+            group.append((req, fut))
+            free -= need
+        return group
+
+    def _process(self, group: List) -> None:
+        jobs: List[_Job] = []
+        try:
+            self._prefill_jobs(group, jobs)
+        except BaseException:
+            # a mid-group raise (pool exhaustion under pressure, a
+            # model-step failure) costs this batch, never the pool:
+            # quarantined jobs freed their pages and left the list,
+            # exported jobs were freed and popped — release whatever
+            # is still allocated BEFORE the worker's handler fails the
+            # futures, or the pages leak forever and swap_params can
+            # never see an empty pool again
+            for j in jobs:
+                self.pool.free_seq(j.seq_id)
+                if self.cache is not None:
+                    self.cache.forget_seq(j.seq_id)
+            raise
+
+    def _prefill_jobs(self, group: List, jobs: List[_Job]) -> None:
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        for req, fut in group:
+            seq_id = self._next_seq
+            self._next_seq += 1
+            self.pool.allocate(seq_id)
+            matched = 0
+            if self.cache is not None:
+                m = self.cache.match(req.prompt)
+                matched = self.cache.attach(seq_id, m)
+            jobs.append(_Job(req, fut, seq_id, pos=matched,
+                             matched=matched))
+
+        def quarantine(sel: Sequence[_Job], logits, step_idx: int):
+            """Evict non-finite rows — same per-sequence blast radius
+            as the monolithic loop's."""
+            logits = _finject.serve_nan_rows(
+                [j.seq_id for j in sel], step_idx, logits)
+            finite = np.asarray(rows_finite(logits))
+            logits = np.asarray(logits)
+            for i, j in enumerate(sel):
+                if finite[i]:
+                    continue
+                err = NonFiniteSequenceError(j.seq_id, step_idx)
+                self.pool.scrub_seq_pages(j.seq_id)
+                self.pool.free_seq(j.seq_id)
+                if self.cache is not None:
+                    if j.matched:
+                        # the poisoned sequence read cached pages:
+                        # presume the chain bad and invalidate it
+                        self.cache.quarantine_seq(j.seq_id)
+                    else:
+                        self.cache.forget_seq(j.seq_id)
+                self.quarantined += 1
+                jobs.remove(j)
+                if obs_on:
+                    _smetrics.record_sequence("quarantined")
+                if j.fut.set_running_or_notify_cancel():
+                    j.fut.set_exception(err)
+            return logits, finite
+
+        # whole-prompt fast path for uncached prompts with no chunk
+        # cap; chunk steps for cache-hit tails and capped prompts —
+        # the monolithic loop's exact split, so logits match it
+        whole = [j for j in jobs if j.pos == 0 and not self._chunk]
+        if whole:
+            step_idx = self.steps
+            logits = prefill_step(
+                self.params, self.cfg, self.pool,
+                [j.seq_id for j in whole],
+                [list(j.req.prompt) for j in whole])
+            self.steps += 1
+            logits, finite = quarantine(whole, logits, step_idx)
+            for i, j in enumerate(whole):
+                if finite[i]:
+                    j.pos = len(j.req.prompt)
+                    j.row = np.asarray(logits[i])
+        while True:
+            sel = [j for j in jobs if j.pos < len(j.req.prompt)]
+            if not sel:
+                break
+            budget = self._chunk or sum(
+                len(j.req.prompt) - j.pos for j in sel)
+            use: List[_Job] = []
+            chunks: List[List[int]] = []
+            starts: List[int] = []
+            for j in sel:
+                if budget <= 0:
+                    break
+                n = min(len(j.req.prompt) - j.pos, budget)
+                use.append(j)
+                chunks.append(list(j.req.prompt[j.pos:j.pos + n]))
+                starts.append(j.pos)
+                budget -= n
+            step_idx = self.steps
+            logits = chunk_prefill_step(
+                self.params, self.cfg, self.pool,
+                [j.seq_id for j in use], chunks, starts)
+            self.steps += 1
+            logits, finite = quarantine(use, logits, step_idx)
+            for i, j in enumerate(use):
+                if not finite[i]:
+                    continue
+                j.pos += len(chunks[i])
+                if j.pos >= len(j.req.prompt):
+                    j.row = np.asarray(logits[i])
+
+        while jobs:  # pop as exported: a raise frees only the rest
+            j = jobs[0]
+            if self.cache is not None:
+                self.cache.insert(j.seq_id, j.req.prompt)
+            tok = _choose_first(j.req, j.row)
+            dest = res = None
+            if self.plan_handoff is not None:
+                plan = self.plan_handoff(j.req)
+                if plan is not None:
+                    dest, res = plan
+            skip = res.tokens if res is not None else 0
+            payload = self.pool.export_seq(j.seq_id, skip_tokens=skip)
+            self.pool.free_seq(j.seq_id)
+            jobs.pop(0)
+            hd = Handoff(j.req, tok, j.row, payload, reservation=res,
+                         src=self.name, dest=dest)
+            self.prefills += 1
+            self._processed += 1
+            self.exported_bytes += payload.nbytes()
+            self.skipped_tokens += skip
+            if j.fut.set_running_or_notify_cancel():
+                j.fut.set_result(hd)
+
+
+class DecodeReplica(FleetReplica):
+    """Continuous-batching decode only; consumes Handoffs."""
+
+    role = "decode"
+
+    def __init__(self, name: str, params: Dict, cfg: DecodeConfig,
+                 num_pages: int = 64, page_size: int = 8,
+                 dtype: str = "float32", max_batch: int = 4,
+                 prefix_cache: bool = True,
+                 paged_impl: Optional[str] = None, check_every: int = 0,
+                 speculate: Optional[int] = None, queue_cap: int = 256,
+                 beat_every_s: float = 0.05):
+        self.cfg = cfg
+        self.pool = KVCachePool(
+            num_pages, page_size, cfg.n_layer, cfg.n_head, cfg.head_dim,
+            dtype=dtype, name=f"{name}-pool",
+            num_kv_heads=cfg.num_kv_heads)
+        self.cache = PrefixCache(self.pool) if prefix_cache else None
+        # outstanding transfer reservations, registered as an external
+        # owner so a mid-transfer invariant audit stays green
+        self._reserved: Dict[int, PrefixReservation] = {}
+        self.pool.register_owner(self._reservation_holds)
+        self.loop = ContinuousBatchingLoop(
+            params, cfg, self.pool, max_batch=max_batch,
+            paged_impl=paged_impl, prefix_cache=self.cache,
+            check_every=check_every,
+            speculate=0 if speculate is None else speculate)
+        self.decoded = 0
+        super().__init__(name, max_batch=max_batch, queue_cap=queue_cap,
+                         beat_every_s=beat_every_s)
+
+    @property
+    def params(self) -> Dict:
+        return self.loop.params
+
+    def _reservation_holds(self) -> Dict[int, int]:
+        holds: Dict[int, int] = {}
+        for r in list(self._reserved.values()):
+            for p in r.pages:
+                holds[p] = holds.get(p, 0) + 1
+        return holds
+
+    def reserve_prefix(self, prompt) -> Optional[PrefixReservation]:
+        """Pin the longest FULL-page cached prefix of `prompt` for an
+        incoming transfer: the matched pages gain one refcount hold
+        each, so LRU eviction cannot invalidate them between the
+        export decision and the import.  None when nothing usable is
+        cached (the payload then ships everything)."""
+        if self.cache is None or not self._alive or self._draining:
+            return None
+        with self.pool._lock:
+            m = self.cache.match(prompt)
+            full = m.tokens - m.tokens % self.pool.page_size
+            if not full:
+                return None
+            n = full // self.pool.page_size
+            pages, keys = list(m.pages[:n]), list(m.keys[:n])
+            self.pool.retain_pages(pages)
+            res = PrefixReservation(keys=keys, pages=pages, tokens=full)
+            res._registry = self._reserved
+            self._reserved[id(res)] = res
+        return res
+
+    def submit(self, hd: Handoff) -> Future:
+        """Enqueue one handoff; the Future resolves to the finished
+        GeneratedSequence.  Whole-pool fit is validated HERE so one
+        impossible request never fails a co-decoded batch."""
+        req = hd.request
+        need = KVCachePool.pages_needed(
+            len(req.prompt) + req.max_new_tokens - hd.matched_tokens,
+            self.pool.page_size)
+        if need > self.pool.num_pages:
+            raise ValueError(
+                f"request needs {need} pages worst-case but replica "
+                f"{self.name}'s pool has {self.pool.num_pages}")
+        return self._submit_item(hd)
+
+    def swap_params(self, new_params: Dict,
+                    timeout: float = 5.0) -> None:
+        """Rolling-upgrade arm: replace the weights of a DRAINED
+        replica.  The prefix cache is invalidated and the pool must
+        come up empty (in-flight transfer reservations get `timeout`
+        to fail over and release)."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before swap_params")
+        if self.cache is not None:
+            self.cache.clear()
+        deadline = time.perf_counter() + timeout
+        while self.pool.used_pages and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        if self.pool.used_pages:
+            raise RuntimeError(
+                f"replica {self.name}: {self.pool.used_pages} pages "
+                "still live after drain — cannot swap params")
+        self.loop.params = new_params
+
+    def _take_locked(self) -> List:
+        # the loop's own admission controller handles batching; hand it
+        # a generous slice so continuous batching keeps occupancy high
+        n = min(len(self._queue), max(4 * self.max_batch, 16))
+        batch, self._queue = self._queue[:n], self._queue[n:]
+        return batch
+
+    def _process(self, batch: List) -> None:
+        reqs = []
+        for hd, _ in batch:
+            r = hd.request
+            reqs.append(DecodeRequest(
+                prompt=list(r.prompt),
+                max_new_tokens=r.max_new_tokens, trace_id=r.trace_id,
+                sampling=r.sampling, handoff=hd))
+        results = self.loop.run(reqs)
+        for (hd, fut), res in zip(batch, results):
+            self.decoded += 1
+            self._processed += 1
+            if fut.set_running_or_notify_cancel():
+                fut.set_result(res)
+
+    def _cleanup_item(self, hd) -> None:
+        # a killed/failed handoff's transfer reservation must not pin
+        # cache pages forever
+        try:
+            hd.release(self.pool)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass

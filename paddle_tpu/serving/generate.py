@@ -538,6 +538,15 @@ class DecodeRequest:
     # exact greedy, the full_decode-oracle arm; non-greedy params
     # auto-disable speculation for THIS sequence only
     sampling: Optional[SamplingParams] = None
+    # disaggregated serving (serving/fleet): a prefilled-elsewhere
+    # payload.  The carrier must expose ``matched_tokens`` (prefix
+    # tokens the destination re-attaches from its own cache),
+    # ``admit(pool, prefix_cache, seq_id)`` (attach + import the
+    # shipped pages), and ``first_token``/``first_logits`` (the token
+    # the prefill side already chose and the row behind it).  The loop
+    # then skips prefill entirely: admission imports the pages, emits
+    # the first token, and the sequence decodes like any other
+    handoff: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -788,8 +797,13 @@ class ContinuousBatchingLoop:
                     f">= vocab_size {self.cfg.vocab_size}")
             # validate EVERY request (max_length AND whole-pool fit)
             # before any work: a mid-run raise would strand allocated
-            # pages and throw away already-finished sequences' results
-            need = self._footprint(req)
+            # pages and throw away already-finished sequences' results.
+            # A handoff's reserved prefix pages are refcount-pinned on
+            # THIS pool, so (unlike a mere cache match, which eviction
+            # could still void) they are safe to subtract here
+            need = self._footprint(
+                req, int(getattr(req.handoff, "matched_tokens", 0))
+                if req.handoff is not None else 0)
             if need > self.pool.num_pages:
                 from .kvcache import PagePoolExhausted
 
@@ -974,9 +988,15 @@ class ContinuousBatchingLoop:
                 newly: List[_Active] = []
                 while waiting and len(active) < self.max_batch:
                     req, seq, rt = waiting[0]
+                    hd = req.handoff
                     m = None
                     matched = 0
-                    if self.prefix_cache is not None:
+                    if hd is not None:
+                        # disaggregated handoff: the destination-side
+                        # cache match was reserved by the handoff
+                        # broker; the payload ships only the tail
+                        matched = int(getattr(hd, "matched_tokens", 0))
+                    elif self.prefix_cache is not None:
                         m = self.prefix_cache.match(req.prompt)
                         matched = m.tokens
                     need = self._footprint(req, matched)
@@ -988,7 +1008,18 @@ class ContinuousBatchingLoop:
                     seq.seq_id = self._next_seq_id
                     self._next_seq_id += 1
                     self.pool.allocate(seq.seq_id)
-                    if m is not None:
+                    if hd is not None:
+                        # attach the reserved shared prefix (if any)
+                        # and import the shipped pages — ONE atomic
+                        # claim charges the imported footprint
+                        hd.admit(self.pool, self.prefix_cache,
+                                 seq.seq_id)
+                        if matched:
+                            self.prefix_hits += 1
+                            self.cached_prefill_tokens += matched
+                        elif self.prefix_cache is not None:
+                            self.prefix_misses += 1
+                    elif m is not None:
                         matched = self.prefix_cache.attach(seq.seq_id, m)
                         if matched:
                             self.prefix_hits += 1
@@ -1005,9 +1036,11 @@ class ContinuousBatchingLoop:
                     # everything else goes through chunk steps (or, for
                     # an SPMD program, token-fed decode steps — the
                     # program's prefill starts at position 0)
-                    a.whole = (self.prefill == "batched" and matched == 0
+                    a.whole = (hd is None and self.prefill == "batched"
+                               and matched == 0
                                and not self._prefill_chunk)
-                    a.chunk_mode = (self.prefill == "batched"
+                    a.chunk_mode = (hd is None
+                                    and self.prefill == "batched"
                                     and not a.whole
                                     and self.program is None)
                     active.append(a)
@@ -1031,6 +1064,19 @@ class ContinuousBatchingLoop:
                             rt.annotate(seq_id=seq.seq_id,
                                         prompt_len=len(seq.prompt),
                                         cached_tokens=matched)
+                    if hd is not None:
+                        # the prompt's K/V is fully present (imported +
+                        # re-attached) and the prefill side already
+                        # chose the first token against its own logits
+                        # — emit it here and let the sequence join the
+                        # decode batch at position len(prompt)
+                        a.pos = len(seq.prompt)
+                        self._cache_insert(a)
+                        now0 = time.perf_counter()
+                        if emit(a, np.asarray(hd.first_logits),
+                                seq.admitted_at, now0,
+                                tok=int(hd.first_token)):
+                            retire([a], now0)
                 # NOTE: waiting-but-nothing-active cannot happen — the
                 # up-front validation guarantees the head request fits an
                 # empty pool (locked pages are 0 with no live readers),

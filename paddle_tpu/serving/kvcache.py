@@ -75,7 +75,8 @@ from .. import flags as _flags
 from ..resilience import faultinject as _finject
 from . import metrics as _smetrics
 
-__all__ = ["KVCachePool", "PagePoolExhausted", "SequenceHandle"]
+__all__ = ["KVCachePool", "PagePoolExhausted", "SeqExport",
+           "SequenceHandle"]
 
 
 class PagePoolExhausted(RuntimeError):
@@ -93,6 +94,42 @@ class SequenceHandle:
 
     def capacity(self, page_size: int) -> int:
         return len(self.pages) * page_size
+
+
+@dataclasses.dataclass
+class SeqExport:
+    """One sequence's KV pages serialized to HOST buffers — the
+    disaggregated prefill→decode handoff payload (serving/fleet), and
+    the natural unit a future host-RAM spill tier would stage.
+
+    The staging is numpy on purpose: the same payload works when the
+    source and destination pools live in different processes (pickle a
+    SeqExport over any transport); when the pools share devices the
+    functional page writes in ``import_seq`` stay device-side.
+    ``skip_tokens`` leading tokens are NOT shipped — the destination
+    re-attaches that shared prefix from its own prefix cache by hash,
+    so only the unshared tail crosses the wire."""
+
+    seq_id: int
+    length: int                      # total tokens the sequence holds
+    skip_tokens: int                 # leading tokens not shipped
+    k: np.ndarray                    # [L, H_kv, n_pages, page_size, D]
+    v: np.ndarray
+    k_scales: Optional[np.ndarray]   # [L, n_pages] fp32 (int8 pools)
+    v_scales: Optional[np.ndarray]
+    page_size: int = 0
+    num_layers: int = 0
+    num_kv_heads: int = 0
+    head_dim: int = 0
+    dtype: str = "float32"
+    pool: str = "kv"                 # source pool name
+
+    def nbytes(self) -> int:
+        """Payload bytes on the wire — serve_bench banks this per seq."""
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scales is not None:
+            n += self.k_scales.nbytes + self.v_scales.nbytes
+        return n
 
 
 class KVCachePool:
@@ -168,6 +205,7 @@ class KVCachePool:
             "defrag_moves": 0, "used_pages_high_water": 0,
             "orphans_reclaimed": 0, "cow_copies": 0,
             "shared_attach_pages": 0, "tokens_truncated": 0,
+            "seqs_exported": 0, "seqs_imported": 0,
         }
 
     # -- sizing math (documented in README "Serving") -------------------
@@ -294,6 +332,102 @@ class KVCachePool:
         if freed:
             self._note_pool()
         return len(freed)
+
+    # -- cross-pool handoff (the disaggregation substrate) --------------
+
+    def export_seq(self, seq_id: int, skip_tokens: int = 0) -> SeqExport:
+        """Serialize one sequence's pages + lengths (+ int8 scales) into
+        host buffers — the prefill→decode handoff payload
+        (serving/fleet).  The source sequence is left UNTOUCHED (the
+        caller frees it once the payload is safely handed off, so a
+        dropped handoff costs a re-prefill, never corruption).
+
+        ``skip_tokens`` (a multiple of page_size) leading tokens are
+        omitted from the payload: the destination re-attaches that
+        shared prefix from its OWN prefix cache (the caller reserved it
+        there first), so only the unshared tail ships.  Works on the
+        mesh pool too — indexing the sharded arrays gathers each
+        device's head shard into the full host view."""
+        with self._lock:
+            h = self._tables[seq_id]
+            skip = int(skip_tokens)
+            if skip % self.page_size or not 0 <= skip < h.length:
+                raise ValueError(
+                    f"skip_tokens {skip} must be a multiple of page_size "
+                    f"{self.page_size} in [0, {h.length}) — the shipped "
+                    "tail must start on a page boundary with >= 1 token")
+            ship = h.pages[skip // self.page_size:]
+            idx = np.asarray(ship, np.int32)
+            k = np.asarray(self.k_pages[:, :, idx])
+            v = np.asarray(self.v_pages[:, :, idx])
+            ks = vs = None
+            if self.quantized:
+                ks = self.k_scales[:, idx].copy()
+                vs = self.v_scales[:, idx].copy()
+            self._stats["seqs_exported"] += 1
+            return SeqExport(
+                seq_id=seq_id, length=h.length, skip_tokens=skip,
+                k=k, v=v, k_scales=ks, v_scales=vs,
+                page_size=self.page_size, num_layers=self.num_layers,
+                num_kv_heads=self.num_kv_heads, head_dim=self.head_dim,
+                dtype=np.dtype(self.k_pages.dtype).name, pool=self.name)
+
+    def import_seq(self, export: SeqExport,
+                   seq_id: int) -> Tuple[int, int]:
+        """Materialize an exported sequence into THIS pool: claim pages
+        for the shipped tail in ONE atomic ``append_tokens`` step (the
+        admission charge — PagePoolExhausted fires before any table
+        mutates, and pressure reclaimers run first, like every other
+        claim) and write the payload's page content (+ int8 scales)
+        into them.  ``seq_id`` must be freshly allocated and hold
+        EXACTLY ``export.skip_tokens`` tokens of full attached pages —
+        the shared prefix the destination re-attached from its own
+        prefix cache before importing.  Returns (pages_claimed,
+        tokens_imported)."""
+        import jax.numpy as jnp
+
+        for attr in ("page_size", "num_layers", "num_kv_heads",
+                     "head_dim"):
+            if getattr(export, attr) != getattr(self, attr):
+                raise ValueError(
+                    f"pool geometry mismatch on {attr}: payload from "
+                    f"'{export.pool}' has {getattr(export, attr)}, pool "
+                    f"'{self.name}' has {getattr(self, attr)}")
+        if export.dtype != np.dtype(self.k_pages.dtype).name:
+            raise ValueError(
+                f"pool dtype mismatch: payload is {export.dtype}, pool "
+                f"'{self.name}' is {np.dtype(self.k_pages.dtype).name}")
+        with self._lock:
+            h = self._tables[seq_id]
+            if h.length != export.skip_tokens:
+                raise ValueError(
+                    f"sequence {seq_id} holds {h.length} tokens but the "
+                    f"payload skips {export.skip_tokens} — re-attach "
+                    "exactly the skipped shared prefix before importing")
+            if h.length % self.page_size:
+                raise ValueError(
+                    "the re-attached prefix must be FULL pages — the "
+                    "shipped tail starts on a page boundary")
+            tail = export.length - export.skip_tokens
+            want = self.pages_needed(tail, self.page_size)
+            if export.k.shape[2] != want:
+                raise ValueError(
+                    f"payload ships {export.k.shape[2]} pages but "
+                    f"{tail} tokens need {want}")
+            before = len(h.pages)
+            self.append_tokens([seq_id], [tail])  # atomic claim
+            new = h.pages[before:]
+            idx = np.asarray(new, np.int32)
+            self.k_pages = self.k_pages.at[:, :, idx].set(
+                jnp.asarray(export.k))
+            self.v_pages = self.v_pages.at[:, :, idx].set(
+                jnp.asarray(export.v))
+            if self.quantized:
+                self.k_scales[:, idx] = export.k_scales
+                self.v_scales[:, idx] = export.v_scales
+            self._stats["seqs_imported"] += 1
+        self._note_pool()
+        return len(new), tail
 
     # -- refcount / sharing API (the prefix-cache substrate) -----------
 

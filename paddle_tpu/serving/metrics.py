@@ -49,6 +49,19 @@ Metrics:
 - paddle_tpu_serving_prefix_cache_pages     gauge    pages pinned by
                                                       prefix-cache entries
 
+Fleet instruments (ISSUE 15 — disaggregated prefill/decode + elastic
+autoscaling, serving/fleet/):
+- paddle_tpu_serving_fleet_events_total     counter  {event=scale_up|
+                                                      scale_down|handoff|
+                                                      handoff_drop|upgrade|
+                                                      replica_dead|failover,
+                                                      role=prefill|decode|-}
+- paddle_tpu_serving_fleet_handoff_bytes_total counter KV bytes staged
+                                                      through prefill→decode
+                                                      handoffs
+- paddle_tpu_serving_fleet_replicas         gauge    {role=} live replicas
+                                                      per class
+
 Fault-isolation instruments (ISSUE 6):
 - paddle_tpu_serving_breaker_trips_total    counter  circuit-breaker opens
 - paddle_tpu_serving_dispatcher_restarts_total counter supervisor restarts
@@ -83,6 +96,9 @@ __all__ = [
     "record_sequence",
     "record_breaker_trip",
     "record_dispatcher_restart",
+    "record_fleet_event",
+    "record_fleet_replicas",
+    "record_handoff_bytes",
     "record_health",
     "record_pool_invariant_violation",
     "record_pool_reclaim",
@@ -312,6 +328,34 @@ def record_replica_health(replica: str, state: str,
         "paddle_tpu_serving_replica_queue_depth",
         "replica engine queue depth as seen by the router",
     ).set(queue_depth, replica=replica)
+
+
+def record_fleet_event(event: str, role: str = "-", n: int = 1) -> None:
+    """One fleet lifecycle event: ``scale_up`` / ``scale_down`` (the
+    autoscaler acted), ``handoff`` (a prefilled sequence moved to a
+    decode replica), ``handoff_drop`` (lost in transit, requeued),
+    ``upgrade`` (a replica's weights were swapped under drain),
+    ``replica_dead`` (a silent/killed replica was quarantined), or
+    ``failover`` (a request rerouted off a dead replica)."""
+    default_registry().counter(
+        "paddle_tpu_serving_fleet_events",
+        "disaggregated-fleet lifecycle events by replica class",
+    ).inc(n, event=event, role=role)
+
+
+def record_handoff_bytes(nbytes: int) -> None:
+    """KV bytes staged host-side through one prefill→decode handoff."""
+    default_registry().counter(
+        "paddle_tpu_serving_fleet_handoff_bytes",
+        "KV bytes staged through prefill-to-decode handoffs",
+    ).inc(nbytes)
+
+
+def record_fleet_replicas(role: str, n: int) -> None:
+    default_registry().gauge(
+        "paddle_tpu_serving_fleet_replicas",
+        "live fleet replicas per class",
+    ).set(n, role=role)
 
 
 def record_prefix_event(event: str, n: int = 1) -> None:

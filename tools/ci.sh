@@ -9,6 +9,9 @@
 #   lint     - chip-less program-linter gate over the model zoo
 #              (tools/lint_programs.py --gate vs AOT_COST_ZOO.json),
 #              plus an --inject smoke proving the gate's exit-3 teeth
+#   fleet    - disaggregated prefill/decode fleet smoke: an elastic
+#              --fleet run, a serve_bench --disagg --gate round-trip,
+#              and a handoff-drop chaos inject that must exit 3
 # Run all stages:  tools/ci.sh        One stage:  tools/ci.sh test
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -62,6 +65,36 @@ run_lint() {
   echo "inject smoke OK (exit 3)"
 }
 
+run_fleet() {
+  echo "== fleet smoke (elastic scale-up/down under bursty load) =="
+  tmp="$(mktemp -d)"
+  cat > "$tmp/bank.json" <<'JSON'
+{"lost_requests": 0, "pages_leaked": 0, "invariants_ok": 1,
+ "handoff_drops": 0}
+JSON
+  python tools/serve_bench.py --mode decode --fleet --sequences 8 \
+    --max-new 5 --pages 64 --page-size 4 --d-model 32 --max-len 48 \
+    --json "$tmp/fleet.json"
+  echo "== serve_bench --disagg --gate round-trip =="
+  python tools/serve_bench.py --mode decode --disagg --sequences 5 \
+    --max-new 5 --pages 64 --page-size 4 --d-model 32 --max-len 48 \
+    --json "$tmp/disagg.json" --baseline "$tmp/bank.json" --gate
+  echo "== fleet gate teeth: an armed handoff-drop chaos must exit 3 =="
+  set +e
+  FAULT_SERVE_HANDOFF_DROP=1 python tools/serve_bench.py \
+    --mode decode --disagg --sequences 4 --max-new 4 --pages 64 \
+    --page-size 4 --d-model 32 --max-len 48 \
+    --baseline "$tmp/bank.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "fleet chaos smoke: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "chaos inject smoke OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_bench() {
   echo "== bench smoke =="
   BENCH_BS=8 BENCH_STEPS=3 BENCH_TRANSFORMER_BS=2 BENCH_DEEPFM_BS=32 \
@@ -73,8 +106,9 @@ case "$stage" in
   test)   run_test ;;
   api)    run_api ;;
   lint)   run_lint ;;
+  fleet)  run_fleet ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

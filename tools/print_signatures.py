@@ -47,6 +47,10 @@ MODULES = [
     # are operator-facing API
     "paddle_tpu.serving.sampling",
     "paddle_tpu.serving.speculative",
+    # disaggregated prefill/decode + elastic fleet (ISSUE 15): the
+    # replica classes, handoff contract, and autoscaling controller
+    # are the operator-facing serving deployment surface
+    "paddle_tpu.serving.fleet",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

@@ -57,6 +57,19 @@ a batching server — latency percentiles, throughput, and batch occupancy
   post_drain_misroutes == 0 and lost_requests == 0 (bank those zeros
   and --gate holds them).
 
+  fleet modes (--disagg / --fleet, decode-mode options): the replay
+  through a disaggregated prefill/decode Fleet (serving/fleet) — one
+  PrefillReplica chunk-prefills prompts and hands the KV pages off to
+  a DecodeReplica (host-staged export_seq/import_seq; prefix-cache
+  hits ship only the unshared tail).  Banks handoff_bytes_per_seq,
+  fleet-level TTFT p50/p99, lost_requests=0 and zero leaked pages /
+  green invariants on BOTH pools.  --fleet adds the elastic
+  FleetController under a bursty load: scale_ups/scale_downs bank
+  >= 1 on the same contract.  Arm FAULT_SERVE_HANDOFF_DROP /
+  FAULT_SERVE_REPLICA_KILL in the environment to chaos a fleet run —
+  the report's handoff_drops/failovers/re_prefills count the
+  absorbed faults and lost_requests must still bank 0.
+
   mesh mode (--mesh N, decode-mode option): the same decode replay
   through the tensor-parallel ShardedDecodeProgram over an N-device
   mesh (chip-less: N virtual CPU devices are forced via XLA_FLAGS when
@@ -300,67 +313,104 @@ def run_router_bench(args) -> dict:
     """--replicas N: the engine-mode replay through a Router fronting N
     replicas of the same artifact, with a mid-run drain handoff when
     N >= 2.  Zero lost requests and zero post-drain misroutes are the
-    bankable contract."""
+    bankable contract.  With --chaos one replica is KILLED mid-run via
+    FAULT_SERVE_REPLICA_KILL (its dispatcher dies without restart —
+    a dead process): its queued requests fail typed and are FAILED
+    OVER through the router to the survivors, so lost_requests still
+    banks 0 next to the failover count (the drain smoke is skipped —
+    the kill is the handoff under test)."""
     from paddle_tpu import serving
+    from paddle_tpu.resilience import faultinject
     from paddle_tpu.serving.distributed import Router
 
-    with tempfile.TemporaryDirectory() as d:
-        predict, feed = _build_artifact(args.model, d)
-        buckets = serving.parse_buckets(args.buckets)
-        engines = [
-            serving.Engine.from_artifact(
-                predict,
-                config=serving.EngineConfig(
-                    buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
-                    queue_depth=args.queue_depth),
-                name=f"replica{i}")
-            for i in range(args.replicas)
-        ]
-        router = Router(engines)
-        if args.warmup:
-            for eng in engines:
-                for b in eng.ladder.buckets:
-                    eng.infer(feed(b))
-        rng = np.random.RandomState(args.seed)
-        lo, hi = (int(p) for p in args.batch_range.split(","))
-        reqs = [feed(int(rng.randint(lo, hi + 1)))
-                for _ in range(args.requests)]
-        gaps = rng.exponential(1.0 / args.rate, size=args.requests)
-        # drain-handoff smoke: hand the first replica's traffic off
-        # halfway through (needs a survivor)
-        drain_at = args.requests // 2 if args.replicas > 1 else None
-        drained = router.replica_names()[0] if drain_at else None
-        t_start = time.perf_counter()
-        pending = []
-        for i, f in enumerate(reqs):
-            if drain_at is not None and i == drain_at:
-                # claim the replica NOW (timeout=0 polls: routing stops
-                # atomically, the engine drains in the background while
-                # the replay keeps landing on the survivors)
-                router.drain_replica(drained, timeout=0)
-            target = t_start + float(gaps[: i + 1].sum())
-            now = time.perf_counter()
-            if target > now:
-                time.sleep(target - now)
-            pending.append((time.perf_counter(), router.submit(f), i))
-        lat = []
-        rows = 0
-        per_replica = {}
-        misroutes = 0
-        for t0, fut, i in pending:
-            fut.result(timeout=60)
-            l = time.perf_counter() - t0
-            lat.append(l)
-            rows += reqs[i][predict.feed_names[0]].shape[0]
-            per_replica.setdefault(fut.replica, []).append(l)
-            if drain_at is not None and i >= drain_at \
-                    and fut.replica == drained:
-                misroutes += 1
-        elapsed = time.perf_counter() - t_start
-        drain_done = (router.drain_replica(drained, timeout=60.0)
-                      if drain_at is not None else None)
-        st = router.stats()
-        router.close()
+    chaos = bool(args.chaos)
+    failovers = 0
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            predict, feed = _build_artifact(args.model, d)
+            buckets = serving.parse_buckets(args.buckets)
+            engines = [
+                serving.Engine.from_artifact(
+                    predict,
+                    config=serving.EngineConfig(
+                        buckets=buckets, max_wait_s=args.max_wait_ms / 1e3,
+                        queue_depth=args.queue_depth),
+                    name=f"replica{i}")
+                for i in range(args.replicas)
+            ]
+            router = Router(engines)
+            if args.warmup:
+                for eng in engines:
+                    for b in eng.ladder.buckets:
+                        eng.infer(feed(b))
+            rng = np.random.RandomState(args.seed)
+            lo, hi = (int(p) for p in args.batch_range.split(","))
+            reqs = [feed(int(rng.randint(lo, hi + 1)))
+                    for _ in range(args.requests)]
+            gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+            # drain-handoff smoke: hand the first replica's traffic off
+            # halfway through (needs a survivor).  A chaos run replaces
+            # it with the replica KILL (killing one replica AND
+            # draining another would leave a 2-replica fleet empty)
+            drain_at = (args.requests // 2
+                        if args.replicas > 1 and not chaos else None)
+            drained = router.replica_names()[0] if drain_at else None
+            kill_at = max(1, args.requests // 3) if chaos else None
+            victim = router.replica_names()[-1] if chaos else None
+            t_start = time.perf_counter()
+            pending = []
+            for i, f in enumerate(reqs):
+                if drain_at is not None and i == drain_at:
+                    # claim the replica NOW (timeout=0 polls: routing
+                    # stops atomically, the engine drains in the
+                    # background while the replay keeps landing on the
+                    # survivors)
+                    router.drain_replica(drained, timeout=0)
+                if kill_at is not None and i == kill_at:
+                    # mid-run kill: the victim's dispatcher dies on its
+                    # next cycle, queued requests fail typed, health
+                    # goes BROKEN and the router skips it
+                    os.environ["FAULT_SERVE_REPLICA_KILL"] = victim
+                target = t_start + float(gaps[: i + 1].sum())
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                pending.append((time.perf_counter(), router.submit(f), i))
+            lat = []
+            rows = 0
+            per_replica = {}
+            misroutes = 0
+            for t0, fut, i in pending:
+                try:
+                    fut.result(timeout=60)
+                except Exception:
+                    # the killed replica failed this queued request
+                    # typed — fail it over through the router (which
+                    # now skips the BROKEN victim); a clean run must
+                    # fail loudly instead
+                    if not chaos:
+                        raise
+                    fut = router.submit(reqs[i])
+                    fut.result(timeout=60)
+                    failovers += 1
+                l = time.perf_counter() - t0
+                lat.append(l)
+                rows += reqs[i][predict.feed_names[0]].shape[0]
+                per_replica.setdefault(fut.replica, []).append(l)
+                if drain_at is not None and i >= drain_at \
+                        and fut.replica == drained:
+                    misroutes += 1
+            elapsed = time.perf_counter() - t_start
+            drain_done = (router.drain_replica(drained, timeout=60.0)
+                          if drain_at is not None else None)
+            st = router.stats()
+            killed = (router.engine(victim).stats()["replica_killed"]
+                      if chaos else False)
+            router.close()
+    finally:
+        if chaos:
+            os.environ.pop("FAULT_SERVE_REPLICA_KILL", None)
+            faultinject.reset()
     result = {
         "mode": "router",
         "model": args.model,
@@ -393,6 +443,12 @@ def run_router_bench(args) -> dict:
             # landed on the drained replica
             "post_drain_misroutes": misroutes,
         })
+    if chaos:
+        result.update({
+            "killed_replica": victim,
+            "replica_kills": int(bool(killed)),
+            "failovers": failovers,
+        })
     return result
 
 
@@ -407,6 +463,47 @@ _SAMPLING_SCENARIOS = {
     "topk": {"temperature": 0.8, "top_k": 20},
     "topp": {"temperature": 0.8, "top_p": 0.9},
 }
+
+
+def _decode_requests(args, cfg, rng, sampling=None) -> list:
+    """The decode-mode traffic shape, shared by --mode decode and the
+    fleet modes so their banked numbers stay comparable.
+    --prefix-share P of requests open with ONE common system-prompt
+    prefix (~3/4 of the max prompt length) — the shared-prefix traffic
+    the prefix cache exists for; the first such request warms the
+    cache, the rest should hit.  The remainder draw uniform random
+    prompts, or, under --speculate, a short motif tiled to the drawn
+    length — the templated/self-similar traffic prompt-lookup drafting
+    exists for."""
+    from paddle_tpu import serving
+
+    plo, phi = (int(p) for p in args.prompt_range.split(","))
+    phi = min(phi, args.max_len - args.max_new)
+    share = float(args.prefix_share)
+    sys_prompt = rng.randint(
+        1, cfg.vocab_size,
+        size=max(1, int(phi * 0.75))).tolist() if share > 0 else []
+    motif = rng.randint(
+        1, cfg.vocab_size,
+        size=max(2, min(6, plo))).tolist() if args.speculate else []
+    reqs = []
+    for _ in range(args.sequences):
+        if share > 0 and rng.rand() < share:
+            tail = int(rng.randint(1, max(2, phi - len(sys_prompt) + 1)))
+            prompt = sys_prompt + rng.randint(
+                1, cfg.vocab_size, size=tail).tolist()
+        else:
+            plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
+            if motif:
+                reps = -(-plen // len(motif))
+                prompt = (motif * reps)[:plen]
+            else:
+                prompt = rng.randint(
+                    1, cfg.vocab_size, size=plen).tolist()
+        reqs.append(serving.DecodeRequest(
+            prompt=prompt, max_new_tokens=args.max_new,
+            sampling=sampling))
+    return reqs
 
 
 def run_decode_bench(args) -> dict:
@@ -435,41 +532,11 @@ def run_decode_bench(args) -> dict:
             num_layers=cfg.n_layer, num_heads=cfg.n_head,
             head_dim=cfg.head_dim, num_kv_heads=cfg.num_kv_heads,
             dtype=kv_dtype)
-    plo, phi = (int(p) for p in args.prompt_range.split(","))
-    phi = min(phi, args.max_len - args.max_new)
-    # --prefix-share P: that fraction of requests opens with one common
-    # system-prompt prefix (~3/4 of the max prompt length) — the
-    # shared-prefix traffic shape the prefix cache exists for.  The
-    # first such request warms the cache; the rest should hit.
     share = float(args.prefix_share)
-    sys_prompt = rng.randint(
-        1, cfg.vocab_size,
-        size=max(1, int(phi * 0.75))).tolist() if share > 0 else []
-    # --speculate: repeated-structure prompts (a short motif tiled to
-    # the drawn length) — templated/self-similar traffic, the shape
-    # prompt-lookup drafting exists for
-    motif = rng.randint(
-        1, cfg.vocab_size,
-        size=max(2, min(6, plo))).tolist() if args.speculate else []
     spec_kw = _SAMPLING_SCENARIOS[args.sampling]
     sampling = (serving.SamplingParams(seed=args.seed, **spec_kw)
                 if spec_kw is not None else None)
-    reqs = []
-    for _ in range(args.sequences):
-        if share > 0 and rng.rand() < share:
-            tail = int(rng.randint(1, max(2, phi - len(sys_prompt) + 1)))
-            prompt = sys_prompt + rng.randint(
-                1, cfg.vocab_size, size=tail).tolist()
-        else:
-            plen = int(rng.randint(plo, max(plo + 1, phi + 1)))
-            if args.speculate:
-                reps = -(-plen // len(motif))
-                prompt = (motif * reps)[:plen]
-            else:
-                prompt = rng.randint(1, cfg.vocab_size, size=plen).tolist()
-        reqs.append(serving.DecodeRequest(
-            prompt=prompt, max_new_tokens=args.max_new,
-            sampling=sampling))
+    reqs = _decode_requests(args, cfg, rng, sampling=sampling)
     chaos = bool(args.chaos)
     from paddle_tpu.kernels.paged_attention import fallback_count
 
@@ -637,6 +704,124 @@ def run_decode_bench(args) -> dict:
     return result
 
 
+def run_fleet_bench(args, elastic: bool) -> dict:
+    """--disagg / --fleet (decode-mode options): the decode replay
+    through a disaggregated prefill/decode Fleet (serving/fleet).
+
+    --disagg runs a fixed 1-prefill + 1-decode fleet under the Poisson
+    replay and banks the handoff contract: handoff_bytes_per_seq, TTFT
+    percentiles (fleet-level submit→first-token), lost_requests=0, and
+    zero leaked pages / green invariants on BOTH pools.  --fleet adds
+    the elastic controller under a BURSTY load (the whole request set
+    submitted at once, then a quiet tail): sustained queue growth must
+    scale a class up and the idle tail must scale it back down —
+    scale_ups/scale_downs bank >= 1 on the same 0/2/3 gate."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.fleet import (
+        AutoscalePolicy,
+        DecodeReplica,
+        Fleet,
+        FleetController,
+        PrefillReplica,
+    )
+
+    kv_dtype = _KV_DTYPES[args.kv_dtype]
+    cfg = serving.DecodeConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_head=args.n_head,
+        n_layer=args.n_layer, d_inner=args.d_model * 2,
+        max_length=args.max_len,
+        n_kv_head=args.kv_heads or None)
+    params = serving.init_decode_params(cfg, seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    share = float(args.prefix_share)
+    reqs = _decode_requests(args, cfg, rng)
+
+    def spawn_prefill(name):
+        return PrefillReplica(
+            name, params, cfg, num_pages=args.pages,
+            page_size=args.page_size, dtype=kv_dtype,
+            max_batch=args.max_batch,
+            prefill_chunk=args.prefill_chunk or None)
+
+    def spawn_decode(name):
+        return DecodeReplica(
+            name, params, cfg, num_pages=args.pages,
+            page_size=args.page_size, dtype=kv_dtype,
+            max_batch=args.max_batch, paged_impl=args.paged_impl)
+
+    fleet = Fleet(spawn_prefill, spawn_decode)
+    controller = None
+    if elastic:
+        controller = FleetController(
+            fleet,
+            policy=AutoscalePolicy(queue_high=2, sustain=2,
+                                   idle_sustain=2, cooldown=0),
+            max_replicas={"prefill": 3, "decode": 3})
+    t_start = time.perf_counter()
+    futs = []
+    if elastic:
+        # bursty load: everything lands at once — the queue-growth
+        # signal the autoscaler scales up on — then a quiet tail
+        for r in reqs:
+            futs.append(fleet.submit(r))
+        controller.step()
+        controller.step()  # sustain=2: the second pressured step acts
+    else:
+        gaps = rng.exponential(1.0 / args.rate, size=len(reqs))
+        for i, r in enumerate(reqs):
+            target = t_start + float(gaps[: i + 1].sum())
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            futs.append(fleet.submit(r))
+    results = [f.result(timeout=120) for f in futs]
+    elapsed = time.perf_counter() - t_start
+    if elastic:
+        # the idle tail: queues are empty, the controller scales back
+        # down through the zero-loss drain
+        for _ in range(controller.policy.idle_sustain + 1):
+            controller.step()
+    errored = sum(1 for r in results if r.error is not None)
+    tokens = sum(len(r.tokens) for r in results)
+    st = fleet.stats()
+    audit = fleet.audit()
+    ttfts = list(fleet.ttfts)
+    result = {
+        "mode": "fleet" if elastic else "disagg",
+        "sequences": args.sequences,
+        "prefill_replicas": st["prefill_replicas"],
+        "decode_replicas": st["decode_replicas"],
+        "kv_heads": cfg.num_kv_heads,
+        "kv_dtype": args.kv_dtype,
+        "tokens": tokens,
+        "tokens_per_s": tokens / elapsed,
+        "ttft_p50_ms": _percentile(ttfts, 50) * 1e3,
+        "ttft_p99_ms": _percentile(ttfts, 99) * 1e3,
+        "handoffs": st["handoffs"],
+        "handoff_bytes_per_seq": (st["handoff_bytes"] / st["handoffs"]
+                                  if st["handoffs"] else 0.0),
+        "skipped_tokens": st["skipped_tokens"],
+        "handoff_drops": st["handoff_drops"],
+        "failovers": st["failovers"],
+        "re_prefills": st["re_prefills"],
+        "errored_sequences": errored,
+        # every submit's future resolved — the bankable hard zero
+        "lost_requests": st["lost_requests"],
+        "pages_leaked": audit["pages_leaked"],
+        "invariants_ok": audit["invariants_ok"],
+    }
+    if share > 0:
+        result["prefix_share"] = share
+    if elastic:
+        result.update({
+            "scale_ups": st["scale_ups"],
+            "scale_downs": st["scale_downs"],
+            "controller_steps": controller.steps,
+        })
+    fleet.close()
+    return result
+
+
 # metrics where bigger is better; everything else (latencies, leak
 # counters) gates as lower-is-better.  flight_dumps is higher-is-better
 # so banking {"flight_dumps": 1} asserts the chaos breaker trip left a
@@ -646,7 +831,8 @@ _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "drain_completed", "prefix_hit_rate",
                      "cached_prefill_tokens", "acceptance_rate",
                      "tokens_per_step", "spec_speedup",
-                     "accepted_tokens")
+                     "accepted_tokens", "scale_ups", "scale_downs",
+                     "handoffs", "replica_kills")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -751,6 +937,18 @@ def main(argv=None) -> int:
                          "scenario attached to every request (greedy = "
                          "none, the oracle-identical arm; temp/topk/"
                          "topp exercise the jitted sampling epilogue)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="decode mode: run the replay through a "
+                         "disaggregated prefill/decode Fleet "
+                         "(serving/fleet, 1 prefill + 1 decode "
+                         "replica) and bank handoff_bytes_per_seq, "
+                         "fleet-level TTFT, lost_requests=0 and zero "
+                         "leaked pages on both pools")
+    ap.add_argument("--fleet", action="store_true",
+                    help="decode mode: --disagg plus the elastic "
+                         "FleetController under a bursty load — "
+                         "scale_ups/scale_downs bank >= 1 next to "
+                         "lost_requests=0")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
@@ -763,7 +961,11 @@ def main(argv=None) -> int:
                     help="arm FAULT_SERVE_* knobs mid-run and report "
                          "recovery counts (engine: dispatcher raise + "
                          "shed deadlines; decode: NaN sequence + page "
-                         "leak under a check_every=1 watchdog)")
+                         "leak under a check_every=1 watchdog; with "
+                         "--replicas N>=2: one replica KILLED mid-run "
+                         "via FAULT_SERVE_REPLICA_KILL — its queued "
+                         "requests fail over through the router and "
+                         "lost_requests still banks 0)")
     ap.add_argument("--json", default=None, help="write the result dict here")
     ap.add_argument("--obs-dir", default=None,
                     help="enable FLAGS_observability for the run and "
@@ -844,12 +1046,24 @@ def main(argv=None) -> int:
             "serve_bench: --chaos is a single-replay contract (its "
             "knobs fire once); run it without --speculate\n")
         return 2
-    if args.chaos and args.replicas > 1:
-        sys.stderr.write(
-            "serve_bench: --chaos drives the single-engine FAULT_SERVE_* "
-            "knobs; run it without --replicas (router-mode resilience is "
-            "the drain-handoff smoke)\n")
-        return 2
+    if args.disagg or args.fleet:
+        if args.mode != "decode":
+            sys.stderr.write(
+                "serve_bench: --disagg/--fleet need --mode decode\n")
+            return 2
+        if args.mesh > 1 or args.speculate or args.chaos:
+            sys.stderr.write(
+                "serve_bench: --disagg/--fleet run their own replica "
+                "topology — drop --mesh/--speculate/--chaos (fleet "
+                "chaos is driven by the FAULT_SERVE_REPLICA_KILL / "
+                "FAULT_SERVE_HANDOFF_DROP env knobs, which the fleet "
+                "absorbs and reports as handoff_drops/failovers)\n")
+            return 2
+        if args.sampling != "greedy":
+            sys.stderr.write(
+                "serve_bench: --disagg/--fleet bank the greedy "
+                "oracle-identical arm; drop --sampling\n")
+            return 2
     if args.mesh > 1:
         # the sharded decode program needs a mesh: force virtual CPU
         # devices while that is still possible (the flag only works
@@ -905,6 +1119,8 @@ def main(argv=None) -> int:
             result = run_router_bench(args)
         elif args.mode == "engine":
             result = run_engine_bench(args)
+        elif args.disagg or args.fleet:
+            result = run_fleet_bench(args, elastic=args.fleet)
         else:
             result = run_decode_bench(args)
     finally:
