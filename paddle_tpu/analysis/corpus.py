@@ -224,6 +224,26 @@ def _spec_verify_gather() -> ProgramArtifacts:
     return capture_spec_verify(gather=True)
 
 
+def _spec_verify_spmd_gather() -> ProgramArtifacts:
+    """The mesh twin of spec_verify_gather (ISSUE 16): the shard-mapped
+    Sq=1+d verify step whose per-shard attention re-materializes the
+    contiguous [B, H_local, S, D] gather (reference tier — gather +
+    group broadcast + dense attention) instead of walking pages.  On a
+    GQA pool the gather also re-expands K/V over the query group, so
+    the per-chip traffic prices far above the banked stream.  The
+    artifact shares the zoo entry's capture (and name) via
+    ``zoo.capture_spec_verify_spmd``, so ``lint_programs --inject
+    spec_verify_spmd_gather --gate`` prices it against the banked
+    per-chip page-stream baseline and exits 3 on the BYTES tolerance
+    (at this scale the group-broadcast re-expansion is also big enough
+    for the broadcast-operand detector to flag — belt and braces, the
+    gate fails either way).  Its traffic is fully XLA-visible (that IS
+    the hazard), so it carries no analytic correction."""
+    from .zoo import capture_spec_verify_spmd
+
+    return capture_spec_verify_spmd(gather=True)
+
+
 def _gqa_full_pool() -> ProgramArtifacts:
     """The GQA regression the gqa_decode zoo entry gates on: a model
     configured for grouped KV heads served from a FULL H_q pool (the
@@ -265,6 +285,7 @@ CORPUS = {
                               "collective-placement"),
     "gqa_full_pool": (_gqa_full_pool, None),
     "spec_verify_gather": (_spec_verify_gather, None),
+    "spec_verify_spmd_gather": (_spec_verify_spmd_gather, None),
 }
 
 # corpus programs whose hazard prices in the analytic page-stream

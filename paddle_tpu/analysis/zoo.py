@@ -36,6 +36,15 @@ host):
                      known-bad corpus arm (spec_verify_gather) proving
                      the full-gather re-materialization trips the
                      bytes gate
+  spec_verify_spmd   the sharded_decode step fed Sq = 1+4 query rows
+                     per sequence (ISSUE 16 mesh speculation): the
+                     shard-mapped verify body over an H_kv=4 GQA pool,
+                     one KV head per chip — banked per-chip bytes/step
+                     (plus each chip's analytic page-stream share)
+                     proves mesh verify pays the decode step's page
+                     walk, with a known-bad corpus arm
+                     (spec_verify_spmd_gather) re-materializing each
+                     shard's full gather and tripping the bytes gate
   prefix_decode      the same decode step under 8-way prefix sharing
                      (ISSUE 11): every sequence's page table walks ONE
                      refcounted shared 28-page prefix plus a private
@@ -355,6 +364,105 @@ def _build_sharded_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, extra, cfg
 
 
+# the spec_verify_spmd geometry: the sharded_decode step fed Sq = 1+d
+# query rows per sequence (ISSUE 16 — mesh speculation), with an
+# H_kv=4 GQA pool so each chip holds ONE KV head and the query group
+# shares its page stream.  ONE source of truth with the known-bad
+# corpus arm (spec_verify_spmd_gather): the same mesh program through
+# the reference full-gather tier (which also re-expands K/V over the
+# query group) prices far above the banked per-chip page stream and
+# must trip the bytes gate.
+SPEC_VERIFY_SPMD_GEOM = {
+    "n_shards": 4, "batch": 4, "heads": 8, "kv_heads": 4,
+    "num_pages": 256, "max_pages": 64, "page_size": 16,
+    "d_model": 1024, "n_layer": 1, "vocab": 256,
+    "q_tokens": SPEC_VERIFY_Q_TOKENS, "topology": "v5e:2x2",
+}
+
+
+def capture_spec_verify_spmd(gather: bool) -> ProgramArtifacts:
+    """Capture the spec_verify_spmd program — ``gather=False`` is the
+    zoo entry (per-shard pallas multi-token page walk under shard_map,
+    pool args pinned to the XLA-preferred layout like sharded_decode);
+    ``gather=True`` is the known-bad arm: the SAME mesh verify contract
+    re-materializing each shard's contiguous [B, H, S, D] gather (the
+    reference tier) instead of streaming pages.  Both artifacts carry
+    the zoo entry's name so they gate against the same banked
+    baseline."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from ..core.aot_tpu import tpu_topology
+    from ..serving.distributed import sharded as _sh
+    from ..serving.generate import DecodeConfig
+
+    g = SPEC_VERIFY_SPMD_GEOM
+    n, B = g["n_shards"], g["batch"]
+    num_pages, maxp, ps = g["num_pages"], g["max_pages"], g["page_size"]
+    Sq = g["q_tokens"]
+    dcfg = DecodeConfig(
+        vocab_size=g["vocab"], d_model=g["d_model"], n_head=g["heads"],
+        n_kv_head=g["kv_heads"], n_layer=g["n_layer"],
+        d_inner=2 * g["d_model"], max_length=maxp * ps)
+    topo = tpu_topology(g["topology"], chips_per_host=(2, 2, 1))
+    mesh = Mesh(np.array(topo.devices), (_sh.AXIS_TP,))
+    kv_spec = PartitionSpec(None, _sh.AXIS_TP, None, None, None)
+    impl = "reference" if gather else "pallas"
+    body = _sh.verify_step_fn(dcfg, n, impl=impl)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(_sh.param_partition_specs(dcfg),)
+        + (PartitionSpec(),) * 9 + (kv_spec, kv_spec),
+        out_specs=(PartitionSpec(), kv_spec, kv_spec),
+        check_vma=False)
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    kv = jax.ShapeDtypeStruct(
+        (dcfg.n_layer, dcfg.num_kv_heads, num_pages, ps, dcfg.head_dim),
+        jnp.float32)
+    rep = NamedSharding(mesh, PartitionSpec())
+    # the zoo arm pins the pool layout contract sharded_decode banks
+    # (relayout-copy-pair 0 by construction); the gather arm leaves the
+    # layout free — the regression it models never made that promise
+    kv_sh = NamedSharding(mesh, kv_spec)
+    kv_io = kv_sh if gather else _sh.kv_pool_layout(kv_sh)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), _sh.param_partition_specs(dcfg),
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    return capture_fn(
+        fn, _sh.param_shape_dtypes(dcfg),
+        i32(B, Sq), i32(B, Sq), i32(B), i32(B, maxp), i32(B),
+        i32(B * Sq), i32(B * Sq), i32(B * Sq), i32(B * Sq), kv, kv,
+        name="spec_verify_spmd",
+        topology=topo,
+        donate_argnums=(10, 11),
+        in_shardings=(param_sh,) + (rep,) * 9 + (kv_io, kv_io),
+        out_shardings=(rep, kv_io, kv_io))
+
+
+def spec_verify_spmd_stream_bytes() -> float:
+    """Per-chip analytic page-stream share for the pallas
+    spec_verify_spmd arm: each chip walks its OWN KV head's pages
+    (H_kv/n of the batch's KV traffic) plus the q_tokens query/output
+    term — the only part that grows with d."""
+    from ..kernels.paged_attention import attention_bytes_per_step
+
+    g = SPEC_VERIFY_SPMD_GEOM
+    n = g["n_shards"]
+    return float(attention_bytes_per_step(
+        "pallas", g["batch"], g["max_pages"], g["page_size"],
+        g["heads"] // n, g["d_model"] // g["heads"],
+        num_layers=g["n_layer"],
+        num_kv_heads=g["kv_heads"] // n, q_tokens=g["q_tokens"]))
+
+
+def _build_spec_verify_spmd() -> Tuple[ProgramArtifacts, float, Dict]:
+    art = capture_spec_verify_spmd(gather=False)
+    cfg = dict(SPEC_VERIFY_SPMD_GEOM, impl="pallas")
+    return art, spec_verify_spmd_stream_bytes(), cfg
+
+
 def _build_prefix_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     import jax
     import jax.numpy as jnp
@@ -398,6 +506,7 @@ ZOO = {
     "paged_decode": _build_paged_decode,
     "gqa_decode": _build_gqa_decode,
     "spec_verify": _build_spec_verify,
+    "spec_verify_spmd": _build_spec_verify_spmd,
     "prefix_decode": _build_prefix_decode,
     "sharded_decode": _build_sharded_decode,
 }

@@ -37,6 +37,13 @@ onto jax:
   returned ``[B, V]`` logits are bit-identical on every shard, which is
   also shard_map's replication check on the output spec).
 
+Speculation (ISSUE 16): ``verify_step_fn`` compiles the same sharded
+model for Sq = 1+d ragged query rows (``q_lengths`` is a first-class
+operand of the paged kernel), and ``ShardedDecodeProgram.verify_step``
+drives ``generate.verify_step``'s exact host protocol — so a
+program-driven ``ContinuousBatchingLoop(speculate=d)`` commits up to
+d+1 tokens per mesh step instead of degrading to d=0.
+
 Chip-less verification: an N-device CPU mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=N``) runs the real
 SPMD program; tests/test_distributed_serving.py holds continuous-
@@ -75,6 +82,7 @@ __all__ = [
     "param_partition_specs",
     "param_shape_dtypes",
     "prefill_step_fn",
+    "verify_step_fn",
 ]
 
 AXIS_TP = "tp"
@@ -227,6 +235,57 @@ def decode_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
             attn = attn[:, :, 0, :].reshape(B, H_local * Dh)
             # row-parallel wo: each shard's heads contribute a [B, d]
             # partial; one psum over ICI joins them
+            attn_out = jax.lax.psum(attn @ lp["wo"], axis)
+            h = _layernorm(h + attn_out, lp["ln1_g"], lp["ln1_b"])
+            ff = jax.lax.psum(
+                jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"],
+                axis) + lp["b2"]
+            h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
+        return h @ jnp.asarray(params["embed"]).T, k_pages, v_pages
+
+    return step
+
+
+def verify_step_fn(cfg: DecodeConfig, n_shards: int, axis: str = AXIS_TP,
+                   impl: str = "reference", force: str = "auto"):
+    """Build the shard_map body for one speculative VERIFY step — the
+    mesh twin of ``generate.verify_step`` (ISSUE 16): Sq = 1+d ragged
+    query rows per sequence through ``paged_decode_attention``'s
+    ``q_lengths`` arm, over the LOCAL KV-head pool shard.
+
+    fn(params, tokens [B, Sqm], pos_c [B, Sqm], q_lens [B],
+       tables [B, maxp], lengths [B], pages [B*Sqm], slots [B*Sqm],
+       b_idx [B*Sqm], t_idx [B*Sqm], k_pages, v_pages)
+      -> (logits [B, Sqm, V] replicated, new k_pages, new v_pages)
+
+    The K/V append reuses the prefill body's stable-shape scatter (the
+    host pads the claim to B*Sqm rows by repeating the last one —
+    duplicate indices with identical values are a no-op); the page
+    stream is the SAME as the decode step's (each live page reads once
+    per sequence), which is the amortization mesh speculation banks.
+    Rows past ``q_lens[i]`` are padding garbage the caller ignores."""
+    H_local, Hkv_local = _local_heads(cfg, n_shards)
+    d, Dh = cfg.d_model, cfg.head_dim
+
+    def step(params, tokens, pos_c, q_lens, tables, lengths,
+             pages, slots, b_idx, t_idx, k_pages, v_pages):
+        B, Sqm = tokens.shape
+        h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
+            + jnp.asarray(params["pos"])[pos_c]  # [B, Sqm, d]
+        for li, lp in enumerate(params["layers"]):
+            q = (h @ lp["wq"]).reshape(B, Sqm, H_local, Dh)
+            k = (h @ lp["wk"]).reshape(B, Sqm, Hkv_local, Dh)
+            v = (h @ lp["wv"]).reshape(B, Sqm, Hkv_local, Dh)
+            k_pages = k_pages.at[li, :, pages, slots].set(k[b_idx, t_idx])
+            v_pages = v_pages.at[li, :, pages, slots].set(v[b_idx, t_idx])
+            attn = paged_decode_attention(
+                q.transpose(0, 2, 1, 3), k_pages[li], v_pages[li],
+                tables, lengths, scale=Dh ** -0.5, impl=impl,
+                force=force, q_lengths=q_lens,
+                pool_layout="xla",
+            )  # [B, H_local, Sqm, Dh]
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, Sqm,
+                                                      H_local * Dh)
             attn_out = jax.lax.psum(attn @ lp["wo"], axis)
             h = _layernorm(h + attn_out, lp["ln1_g"], lp["ln1_b"])
             ff = jax.lax.psum(
@@ -430,6 +489,7 @@ class ShardedDecodeProgram:
             for leaf, spec in zip(leaves, spec_leaves)])
         self._decode_jit = None
         self._prefill_jit = None
+        self._verify_jit = None
 
     # -- pool ----------------------------------------------------------
 
@@ -460,7 +520,10 @@ class ShardedDecodeProgram:
 
     # -- jit construction ----------------------------------------------
 
-    def _build(self, body):
+    def _build(self, body, n_rep: int = 6):
+        """Jit one shard-mapped step body: `n_rep` replicated operands
+        ride between the params pytree and the two kv pool shards (6
+        for decode/prefill, 9 for the wider verify signature)."""
         kv = _kv_spec(self.axis)
         rep = P()
         # check_vma off: pallas_call has no replication rule, and the
@@ -468,7 +531,7 @@ class ShardedDecodeProgram:
         # same psum-joined activations) — tests pin bit-identity
         fn = jax.shard_map(
             body, mesh=self.mesh,
-            in_specs=(self._pspecs,) + (rep,) * 6 + (kv, kv),
+            in_specs=(self._pspecs,) + (rep,) * n_rep + (kv, kv),
             out_specs=(rep, kv, kv), check_vma=False)
         if self.mesh.devices.flat[0].platform != "tpu":
             # CPU meshes have no layout choice to make — and no tax
@@ -483,7 +546,8 @@ class ShardedDecodeProgram:
             is_leaf=lambda x: isinstance(x, P))
         return jax.jit(
             fn,
-            in_shardings=(param_sh,) + (ns(rep),) * 6 + (kv_io, kv_io),
+            in_shardings=(param_sh,) + (ns(rep),) * n_rep
+            + (kv_io, kv_io),
             out_shardings=(ns(rep), kv_io, kv_io))
 
     def _decode(self):
@@ -498,6 +562,14 @@ class ShardedDecodeProgram:
             self._prefill_jit = self._build(prefill_step_fn(
                 self.cfg, self.n_shards, self.axis, force=self.force))
         return self._prefill_jit
+
+    def _verify(self):
+        if self._verify_jit is None:
+            self._verify_jit = self._build(verify_step_fn(
+                self.cfg, self.n_shards, self.axis,
+                impl=self.paged_impl or "reference", force=self.force),
+                n_rep=9)
+        return self._verify_jit
 
     # -- the ContinuousBatchingLoop program protocol --------------------
 
@@ -516,6 +588,74 @@ class ShardedDecodeProgram:
         logits, k_pages, v_pages = self._decode()(
             self.params, tokens, positions, pages, slots,
             tables, lengths, pool.k_pages, pool.v_pages)
+        pool.store(k_pages, v_pages)
+        return np.asarray(logits)
+
+    def verify_step(self, pool: ShardedKVCachePool,
+                    seq_ids: Sequence[int],
+                    blocks: Sequence[Sequence[int]],
+                    start_positions: Sequence[int],
+                    pad_to: Optional[int] = None) -> np.ndarray:
+        """One speculative verify step under the SPMD program —
+        ``generate.verify_step``'s exact host protocol (ONE atomic
+        ``append_tokens`` claim, 8-bucketed page tables, stable-shape
+        scatter padding, rows past ``len(blocks[i])`` are garbage) so
+        ``ContinuousBatchingLoop(..., program=...)`` speculates with no
+        loop changes; returns logits [B, Sq_max, V].  The caller owns
+        acceptance and rollback (``pool.truncate_seq``)."""
+        self._check_pool(pool)
+        self.resolve_impl(pool)
+        lens = np.asarray([len(b) for b in blocks], np.int32)
+        if not len(lens) or lens.min() < 1:
+            raise ValueError("verify needs >= 1 fed token per sequence")
+        starts = np.asarray(start_positions, np.int32)
+        B, Sqm = len(blocks), int(lens.max())
+        if pad_to is not None:
+            if pad_to < Sqm:
+                raise ValueError(
+                    f"pad_to {pad_to} < longest block {Sqm}")
+            Sqm = int(pad_to)
+        if int((starts + lens).max()) > self.cfg.max_length:
+            # before append_tokens: a failed verify must not leave
+            # claimed slots with no K/V behind (the pool's atomicity
+            # contract)
+            raise ValueError(
+                f"verify block reaches position "
+                f"{int((starts + lens).max())} > max_length "
+                f"{self.cfg.max_length}")
+        tokens = np.zeros((B, Sqm), np.int32)
+        for i, b in enumerate(blocks):
+            tokens[i, :lens[i]] = b
+        pages, slots = pool.append_tokens(seq_ids, lens)
+        tables, lengths = pool.page_table_batch(seq_ids)
+        if tables.shape[1] % 8:
+            # 8-bucketed table width: one compile shape per 8 pages of
+            # growth (padded entries are length-masked page-0 walks)
+            padded = -(-tables.shape[1] // 8) * 8
+            tables = np.pad(tables,
+                            ((0, 0), (0, padded - tables.shape[1])))
+        b_idx = np.repeat(np.arange(B), lens)
+        t_idx = np.concatenate([np.arange(n) for n in lens])
+        # stable-shape scatter: pad the claim to B*Sqm rows by
+        # repeating the last (page, slot) and its source row —
+        # duplicate indices with identical values are a no-op
+        pad_rows = B * Sqm - len(b_idx)
+        if pad_rows:
+            b_idx = np.concatenate([b_idx,
+                                    np.full(pad_rows, b_idx[-1])])
+            t_idx = np.concatenate([t_idx,
+                                    np.full(pad_rows, t_idx[-1])])
+            pages = np.concatenate([pages, np.full(pad_rows, pages[-1],
+                                                   pages.dtype)])
+            slots = np.concatenate([slots, np.full(pad_rows, slots[-1],
+                                                   slots.dtype)])
+        pos = starts[:, None] + np.arange(Sqm)[None, :]
+        pos_c = np.minimum(pos, self.cfg.max_length - 1)
+        logits, k_pages, v_pages = self._verify()(
+            self.params, tokens, pos_c.astype(np.int32), lens, tables,
+            lengths, np.asarray(pages), np.asarray(slots),
+            b_idx.astype(np.int32), t_idx.astype(np.int32),
+            pool.k_pages, pool.v_pages)
         pool.store(k_pages, v_pages)
         return np.asarray(logits)
 

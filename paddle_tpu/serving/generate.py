@@ -50,26 +50,51 @@ contract:
   sequence, and ``verify_step`` feeds the last committed token plus
   the draft block through ONE model step — Sq = 1+d ragged query rows
   per sequence through ``paged_decode_attention(q_lengths=)``, the
-  page stream still reading each live KV page once.  Acceptance is
-  longest-prefix-match against the model's own (biased) argmax, so
-  every emitted token is argmax given an exactly-correct prefix:
-  greedy speculative decode is TOKEN-IDENTICAL to ``full_decode`` by
-  construction, and the existing oracle keeps pinning correctness.
-  Rejected draft tokens roll back as pure host bookkeeping —
-  ``KVCachePool.truncate_seq`` shrinks the page table atomically
-  (refcount/CoW-aware, int8 scales cleared with freed pages) — which
-  continuous batching already tolerates as ragged per-sequence
-  progress.  EOS / stop sequences / max_new are checked after EVERY
-  emitted token, so a stop landing inside an accepted draft block
-  retires the sequence at that position with the surplus fed tokens
-  truncated from the page table.
+  page stream still reading each live KV page once.  For GREEDY rows
+  acceptance is longest-prefix-match against the model's own (biased)
+  argmax, so every emitted token is argmax given an exactly-correct
+  prefix: greedy speculative decode is TOKEN-IDENTICAL to
+  ``full_decode`` by construction, and the existing oracle keeps
+  pinning correctness.  Rejected draft tokens roll back as pure host
+  bookkeeping — ``KVCachePool.truncate_seq`` shrinks the page table
+  atomically (refcount/CoW-aware, int8 scales cleared with freed
+  pages) — which continuous batching already tolerates as ragged
+  per-sequence progress.  EOS / stop sequences / max_new are checked
+  after EVERY emitted token, so a stop landing inside an accepted
+  draft block retires the sequence at that position with the surplus
+  fed tokens truncated from the page table.
 - ``DecodeRequest.sampling`` (serving/sampling.py SamplingParams)
   widens the decode contract: temperature/top-k/top-p through ONE
   jitted sampling epilogue per step, logit bias (greedy included),
-  stop sequences, per-request max_new.  Speculation auto-disables
-  PER-SEQUENCE when sampling makes verify non-deterministic —
-  greedy/temp=0 requests keep it on, sampled batch-mates ride the
-  same verify step at d=0.
+  stop sequences, per-request max_new.
+
+ISSUE 16 makes speculation distribution-exact and UNIVERSAL:
+
+- SAMPLED (temp>0) rows draft too.  Their verify outcome goes through
+  the exact accept/resample epilogue (``sampling.spec_sample_rows``,
+  one fused jitted call for every drafted sampled row of the batch):
+  draft token t accepts with probability ``min(1, p_target(t) /
+  p_draft(t))`` — the target probability itself under the
+  prompt-lookup drafter's point-mass proposal — and a rejection
+  resamples the residual ``max(0, p_target - p_draft)`` renormalized,
+  so emitted tokens are DISTRIBUTION-IDENTICAL to unspeculated
+  sampling while the (seed, token-index)-keyed Gumbel stream stays
+  replayable (bonus/no-draft draws use the plain epilogue's unsalted
+  key, so a never-drafting sequence keeps its old stream byte for
+  byte).  Per-row accepted counts come back from the same fused call
+  — no per-sequence host sync.
+- SPMD programs speculate.  A program exposing ``verify_step(pool,
+  seq_ids, blocks, start_positions, pad_to=)`` (e.g.
+  ``serving.distributed.ShardedDecodeProgram``) runs the multi-token
+  verify under its own mesh; only a custom program WITHOUT one
+  degrades the loop to d=0 — surfaced as a
+  ``paddle_tpu_serving_spec_disabled_total{reason=}`` counter and a
+  flight event, never just a log line.
+- The default drafter rides the prefix cache's trie as a shared
+  CORPUS (``PromptLookupDrafter(corpus=prefix_cache)``):
+  shared-prefix fleet traffic drafts from continuations other
+  sequences already decoded, with per-request fallback to
+  own-history matching.
 """
 
 from __future__ import annotations
@@ -101,7 +126,13 @@ from ..resilience import faultinject as _finject
 from ..resilience.sentinel import rows_finite
 from . import metrics as _smetrics
 from .kvcache import KVCachePool
-from .sampling import SamplingParams, apply_bias, sample_rows, stop_hit
+from .sampling import (
+    SamplingParams,
+    apply_bias,
+    sample_rows,
+    spec_sample_rows,
+    stop_hit,
+)
 from .speculative import PromptLookupDrafter
 
 _log = logging.getLogger("paddle_tpu.serving")
@@ -690,24 +721,36 @@ class ContinuousBatchingLoop:
             else _flags._VALUES["FLAGS_serving_prefill_chunk"])
         if self._prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
-        # speculative decoding (ISSUE 13): d draft tokens per generating
-        # sequence per step, verified in one multi-token model step.
-        # None reads FLAGS_serving_speculate; 0 disables.  An SPMD
-        # program's step functions are compiled for Sq=1, so
-        # program-driven loops degrade to d=0 with a one-time log —
-        # the same per-sequence degradation non-greedy sampling gets
+        # speculative decoding (ISSUE 13/16): d draft tokens per
+        # generating sequence per step, verified in one multi-token
+        # model step.  None reads FLAGS_serving_speculate; 0 disables.
+        # Program-driven (SPMD) loops speculate through the program's
+        # own verify_step; only a custom program WITHOUT one degrades
+        # to d=0 — surfaced as a spec_disabled counter + flight event
+        # so a fleet where speculation quietly stopped paying stays
+        # diagnosable (ISSUE 16 bugfix: this used to be a log line)
         self._speculate = int(
             speculate if speculate is not None
             else _flags._VALUES["FLAGS_serving_speculate"])
         if self._speculate < 0:
             raise ValueError("speculate must be >= 0")
-        if self._speculate and program is not None:
+        if self._speculate and program is not None \
+                and not hasattr(program, "verify_step"):
             _log.info(
-                "speculative decoding is single-device-loop only for "
-                "now — program-driven (SPMD) decode degrades to d=0")
+                "program %s exposes no verify_step — speculative "
+                "decoding degrades to d=0 for this loop",
+                type(program).__name__)
+            if _flags._VALUES["FLAGS_observability"]:
+                _smetrics.record_spec_disabled("program_no_verify")
+                _flight.default_flight().record(
+                    "spec_disabled", reason="program_no_verify",
+                    program=type(program).__name__)
             self._speculate = 0
         self.drafter = drafter if drafter is not None else (
-            PromptLookupDrafter(max_draft=self._speculate)
+            PromptLookupDrafter(
+                max_draft=self._speculate,
+                corpus=(prefix_cache if hasattr(
+                    prefix_cache, "ngram_continuation") else None))
             if self._speculate else None)
         self._next_seq_id = 0
         self.steps = 0
@@ -748,16 +791,14 @@ class ContinuousBatchingLoop:
 
     def _spec_room(self, a: "_Active") -> int:
         """Draft tokens sequence `a` may carry THIS step: capped by the
-        loop's d, by the sequence's remaining generation headroom (the
-        worst-case admission reservation must still cover the
+        loop's d and by the sequence's remaining generation headroom
+        (the worst-case admission reservation must still cover the
         transiently-fed block — ceil((prompt+max_new)/page_size) pages
-        bound pos+1+d), and zeroed when sampling makes verify
-        non-deterministic (only greedy/temp=0 argmax is reproducible
-        against the verify row) or while the prompt still prefills."""
+        bound pos+1+d), and zero while the prompt still prefills.
+        Sampled (temp>0) rows draft too — their verify outcome goes
+        through the exact accept/resample epilogue instead of the
+        greedy longest-prefix walk (ISSUE 16)."""
         if not self._speculate or a.pos < len(a.result.prompt):
-            return 0
-        p = a.req.sampling
-        if p is not None and not p.greedy:
             return 0
         return min(self._speculate,
                    self._max_new(a) - len(a.result.tokens))
@@ -1243,11 +1284,17 @@ class ContinuousBatchingLoop:
                                     "draft", seq_id=a.seq_id,
                                     step=step_idx, tokens=len(b) - 1,
                                     trace_id=a.result.trace_id)
-                    logits3 = verify_step(
-                        self.params, self.cfg, self.pool, seq_ids,
-                        blocks, [a.pos for a in batch],
-                        force=self.force, impl=self.paged_impl,
-                        pad_to=self._speculate + 1)
+                    if self.program is not None:
+                        logits3 = self.program.verify_step(
+                            self.pool, seq_ids, blocks,
+                            [a.pos for a in batch],
+                            pad_to=self._speculate + 1)
+                    else:
+                        logits3 = verify_step(
+                            self.params, self.cfg, self.pool, seq_ids,
+                            blocks, [a.pos for a in batch],
+                            force=self.force, impl=self.paged_impl,
+                            pad_to=self._speculate + 1)
                     self.steps += 1
                     self.decode_steps += 1
                     self.spec_steps += 1
@@ -1263,6 +1310,7 @@ class ContinuousBatchingLoop:
                     logits3, ok, now = quarantine(batch, logits3,
                                                   step_idx)
                     pairs = []
+                    spec_rows: List[Tuple[int, _Active]] = []
                     retired: List[_Active] = []
                     for i, a in enumerate(batch):
                         blk = blocks[i]
@@ -1278,9 +1326,18 @@ class ContinuousBatchingLoop:
                             continue
                         params_i = a.req.sampling
                         if params_i is not None and not params_i.greedy:
-                            # sampled batch-mate riding the step at d=0
-                            a.pos += 1
-                            pairs.append((a, np.asarray(logits3[i, 0])))
+                            if len(blk) == 1:
+                                # un-drafted sampled row riding the
+                                # step at d=0 — the PLAIN epilogue
+                                # (unsalted key) keeps its stream
+                                # byte-identical to an unspeculated run
+                                a.pos += 1
+                                pairs.append(
+                                    (a, np.asarray(logits3[i, 0])))
+                                continue
+                            # drafted sampled row: the fused
+                            # accept/resample epilogue decides it below
+                            spec_rows.append((i, a))
                             continue
                         # ACCEPTANCE walk (longest prefix match): row t
                         # predicts position start+t+1 — emit its argmax
@@ -1325,6 +1382,69 @@ class ContinuousBatchingLoop:
                                     trace_id=a.result.trace_id)
                         if done:
                             retired.append(a)
+                    if spec_rows:
+                        # EXACT SPECULATIVE SAMPLING (ISSUE 16): one
+                        # fused accept/resample call decides every
+                        # drafted sampled row — per-row accepted counts
+                        # come back device-side (no per-sequence host
+                        # sync), then the host walk mirrors the greedy
+                        # walk's emit/rollback bookkeeping exactly
+                        # (EOS/stop inside an accepted prefix retires
+                        # at that position and truncates the surplus)
+                        sqw = self._speculate + 1
+                        sub = np.stack([
+                            np.stack([
+                                apply_bias(np.asarray(logits3[i, t]),
+                                           a.req.sampling)
+                                for t in range(sqw)])
+                            for i, a in spec_rows])
+                        acc, spec_toks = spec_sample_rows(
+                            sub,
+                            [a.req.sampling for _, a in spec_rows],
+                            [len(a.result.tokens)
+                             for _, a in spec_rows],
+                            [blocks[i][1:] for i, _ in spec_rows])
+                        for r, (i, a) in enumerate(spec_rows):
+                            blk = blocks[i]
+                            start = a.pos
+                            n_acc = int(acc[r])
+                            accepted = 0
+                            done = False
+                            for t in range(n_acc + 1):
+                                row = np.asarray(logits3[i, t])
+                                fed = t < n_acc
+                                if fed:
+                                    accepted += 1
+                                done = emit(a, row, t0, now,
+                                            tok=int(spec_toks[r, t]))
+                                if done or not fed:
+                                    break
+                            drafted = len(blk) - 1
+                            a.drafted += drafted
+                            a.accepted += accepted
+                            self.accepted_tokens += accepted
+                            new_len = start + 1 + accepted
+                            rolled = start + len(blk) - new_len
+                            if rolled:
+                                self.pool.truncate_seq(a.seq_id,
+                                                       new_len)
+                                self.rolled_back_tokens += rolled
+                            a.pos = new_len
+                            if obs_on and drafted:
+                                _smetrics.record_spec(drafted, accepted)
+                                _flight.default_flight().record(
+                                    "verify", seq_id=a.seq_id,
+                                    step=step_idx, accepted=accepted,
+                                    rejected=drafted - accepted,
+                                    trace_id=a.result.trace_id)
+                                if rolled:
+                                    _flight.default_flight().record(
+                                        "rollback", seq_id=a.seq_id,
+                                        step=step_idx, tokens=rolled,
+                                        length=new_len,
+                                        trace_id=a.result.trace_id)
+                            if done:
+                                retired.append(a)
                     retired.extend(emit_batch(pairs, t0, now))
                     retire(retired, now)
                     if obs_on:

@@ -31,6 +31,13 @@ Metrics:
                                                       outcome (rejected ones
                                                       rolled back from the
                                                       page table)
+- paddle_tpu_serving_spec_disabled_total    counter  {reason=} speculation
+                                                      silently degraded to
+                                                      d=0 (e.g. a program
+                                                      without verify_step)
+                                                      — a fleet where
+                                                      speculation stopped
+                                                      paying is diagnosable
 - paddle_tpu_serving_fallback_total         counter  {kernel=} kernel
                                                       selections that fell
                                                       back off the
@@ -94,6 +101,7 @@ __all__ = [
     "record_fallback",
     "record_page_pool",
     "record_sequence",
+    "record_spec_disabled",
     "record_breaker_trip",
     "record_dispatcher_restart",
     "record_fleet_event",
@@ -207,6 +215,17 @@ def record_spec(drafted: int, accepted: int) -> None:
             "paddle_tpu_serving_spec_tokens_total",
             "speculative draft tokens by verify outcome",
         ).inc(rejected, outcome="rejected")
+
+
+def record_spec_disabled(reason: str) -> None:
+    """Speculation was requested but degraded to d=0 — `reason` names
+    why (e.g. ``program_no_verify``: a custom SPMD program exposes no
+    ``verify_step``).  ISSUE 16 bugfix: this used to be only a one-time
+    log line, invisible to a fleet dashboard."""
+    default_registry().counter(
+        "paddle_tpu_serving_spec_disabled_total",
+        "speculative decoding disables (degrades to d=0) by reason",
+    ).inc(reason=reason)
 
 
 def record_fallback(kernel: str) -> None:

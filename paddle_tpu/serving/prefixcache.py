@@ -344,6 +344,64 @@ class PrefixCache:
             self._seq_keys.clear()
         return n
 
+    # -- corpus drafting (ISSUE 16) ------------------------------------
+
+    def ngram_continuation(self, probe: Sequence[int],
+                           limit: int) -> List[int]:
+        """Cross-request n-gram lookup over the trie's cached token
+        chains — the CORPUS arm of ``PromptLookupDrafter``: shared-
+        prefix fleet traffic (system prompts, few-shot headers,
+        multi-turn histories) drafts from continuations OTHER sequences
+        already inserted, not just its own history.
+
+        Finds `probe` inside any root-to-leaf token chain and returns
+        up to `limit` tokens that followed it.  Within a chain the scan
+        runs newest-position-first and a full-length continuation wins
+        outright; across chains a longer continuation wins and ties go
+        to the more recently used leaf (popular prefixes beat stale
+        ones).  Returns [] on no match — the drafter then falls back to
+        own-history matching, so the corpus can never make a draft
+        WORSE.  Pure host bookkeeping under the pool lock; chains here
+        are verified literal tokens (the trie's collision rule), so a
+        wrong-content proposal is impossible — and harmless anyway,
+        since the verifier decides acceptance."""
+        probe = tuple(int(t) for t in probe)
+        n = len(probe)
+        limit = int(limit)
+        if not n or limit < 1:
+            return []
+        best: List[int] = []
+        best_used = -1
+
+        def scan(chain: List[int], last_used: int) -> None:
+            nonlocal best, best_used
+            L = len(chain)
+            for i in range(L - n, -1, -1):
+                if tuple(chain[i:i + n]) != probe:
+                    continue
+                out = chain[i + n:i + n + limit]
+                if (len(out), last_used) > (len(best), best_used):
+                    best, best_used = out, last_used
+                if len(out) == limit:
+                    return  # full-length: newest such wins this chain
+
+        def visit(key: str, prefix: List[int]) -> None:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            chain = prefix + list(e.tokens)
+            if e.children:
+                # interior chains are covered by their leaves' scans
+                for ck in list(e.children.values()):
+                    visit(ck, chain)
+            else:
+                scan(chain, e.last_used)
+
+        with self._lock:
+            for key in list(self._roots.values()):
+                visit(key, [])
+        return best
+
     # -- pool integration ----------------------------------------------
 
     def _holds(self) -> Dict[int, int]:

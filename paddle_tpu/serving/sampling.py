@@ -12,9 +12,8 @@ the contract is:
   ``Engine.submit(sampling=)`` in pass-through mode).  ``temperature
   == 0`` (the default) is EXACT greedy — bit-identical to the
   pre-ISSUE-13 loop and to ``full_decode``, which is also the
-  determinism condition speculative decoding verifies against, so
-  greedy/temp=0 requests keep speculation ON and everything else
-  degrades per-sequence to d=0 (see generate.py).
+  token-identity condition the speculative verify walk preserves for
+  greedy rows.
 - :func:`sample_rows` is the ONE jitted sampling epilogue: the whole
   batch's next-token choice in a single fused call — per-row
   temperature scaling, top-k / top-p filtering, and a Gumbel-max draw
@@ -25,6 +24,24 @@ the contract is:
   keys themselves cannot).  Greedy rows short-circuit host-side (the
   loop never pays a device round trip for pure-greedy batches,
   preserving the oracle's host-argmax arithmetic exactly).
+- :func:`spec_sample_rows` extends the same contract to DRAFTED
+  non-greedy rows (ISSUE 16): acceptance-rejection over the verify
+  step's [B, Sq, V] logits — draft token d accepts with probability
+  ``min(1, p_target(d) / p_draft(d))``, which for the prompt-lookup
+  drafter's point-mass proposal is ``p_target(d)`` itself, and a
+  rejection resamples the residual ``max(0, p_target - p_draft)``
+  renormalized (p with d's mass zeroed).  Both arms marginalize to
+  ``p_target`` token by token, so speculative sampled output is
+  DISTRIBUTION-IDENTICAL to the plain epilogue (the tests hold a
+  TV-distance bound over replayed draws), while per-row accepted
+  counts come back from the one fused call — no per-sequence host
+  sync.  The replay contract survives: the g-th generated token still
+  owns ``fold_in(PRNGKey(seed), g)``; acceptance uniforms salt it
+  with 1, residual Gumbels with 2, and bonus/no-draft rows use the
+  UNSALTED Gumbel — byte-identical to ``sample_rows``'s draw, so a
+  sequence that never drafts keeps its pre-speculation stream.
+  Rolled-back rows never consume an index: g advances only with
+  emitted tokens.
 - Logit bias applies BEFORE everything (greedy included): a biased
   greedy request is still deterministic, so its argmax surface is just
   shifted — ``apply_bias`` is the shared host helper.
@@ -41,7 +58,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["SamplingParams", "sample_rows", "apply_bias", "stop_hit"]
+__all__ = ["SamplingParams", "sample_rows", "spec_sample_rows",
+           "apply_bias", "stop_hit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,8 +67,9 @@ class SamplingParams:
     """Immutable per-request sampling knobs.
 
     temperature: 0.0 (default) = EXACT greedy (argmax; deterministic —
-        keeps speculative verify on); > 0 samples from the scaled
-        distribution.
+        verified by the byte-identical longest-prefix walk); > 0
+        samples from the scaled distribution (verified by the exact
+        accept/resample epilogue — speculation stays ON either way).
     top_k: keep only the k highest-logit tokens before sampling
         (0 = off).  Ignored for greedy rows (argmax already is top-1).
     top_p: nucleus sampling — keep the smallest prefix of the
@@ -123,7 +142,8 @@ class SamplingParams:
     @property
     def greedy(self) -> bool:
         """True when this request's choice is deterministic argmax —
-        the condition under which speculative verify stays enabled."""
+        verified by the longest-prefix walk; non-greedy rows verify
+        through the exact accept/resample epilogue instead."""
         return self.temperature == 0.0
 
 
@@ -153,6 +173,41 @@ def stop_hit(tokens: Sequence[int],
     return False
 
 
+def _filter_scaled(logits, temps, top_ks, top_ps, vocab: int):
+    """The shared filter pipeline (traced under jit): per-row
+    temperature scaling, top-k, top-p over [R, V] rows -> filtered
+    logits with excluded tokens at -inf.  Both the plain epilogue
+    (``_sample_jit``) and the speculative accept/resample epilogue
+    (``_spec_jit``) trace THIS function, so the two samplers share one
+    decision surface by construction — the distributional-parity tests
+    lean on that."""
+    import jax
+    import jax.numpy as jnp
+
+    x = logits / jnp.maximum(temps, 1e-6)[:, None]
+    # top-k: mask everything below the k-th largest logit (k=0/V
+    # disables); ties at the threshold stay in, which only widens
+    # the kept set — standard top-k semantics
+    sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    k = jnp.clip(jnp.where(top_ks > 0, top_ks, vocab), 1, vocab)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None],
+                              axis=-1)  # [R, 1]
+    x = jnp.where(x >= kth, x, -jnp.inf)
+    # top-p over the filtered distribution: keep every token whose
+    # PRECEDING cumulative mass is < p (the smallest prefix
+    # reaching p; the top-1 always stays because its preceding
+    # mass is 0).  Comparing the preceding mass — not the
+    # inclusive cumsum — keeps top_p=1.0 a true no-op even when
+    # the fp32 cumsum tops out at 0.9999999 and never reaches 1
+    probs = jax.nn.softmax(x, axis=-1)
+    p_desc = jnp.sort(probs, axis=-1)[:, ::-1]
+    preceding = jnp.cumsum(p_desc, axis=-1) - p_desc
+    kept = preceding < top_ps[:, None]
+    p_min = jnp.min(jnp.where(kept, p_desc, jnp.inf), axis=-1,
+                    keepdims=True)
+    return jnp.where(probs >= p_min, x, -jnp.inf)
+
+
 @functools.lru_cache(maxsize=32)
 def _sample_jit(vocab: int):
     """The jitted epilogue body, one compile per vocab width: [B, V]
@@ -162,28 +217,7 @@ def _sample_jit(vocab: int):
     import jax.numpy as jnp
 
     def body(logits, temps, top_ks, top_ps, seeds, steps):
-        x = logits / jnp.maximum(temps, 1e-6)[:, None]
-        # top-k: mask everything below the k-th largest logit (k=0/V
-        # disables); ties at the threshold stay in, which only widens
-        # the kept set — standard top-k semantics
-        sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
-        k = jnp.clip(jnp.where(top_ks > 0, top_ks, vocab), 1, vocab)
-        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None],
-                                  axis=-1)  # [B, 1]
-        x = jnp.where(x >= kth, x, -jnp.inf)
-        # top-p over the filtered distribution: keep every token whose
-        # PRECEDING cumulative mass is < p (the smallest prefix
-        # reaching p; the top-1 always stays because its preceding
-        # mass is 0).  Comparing the preceding mass — not the
-        # inclusive cumsum — keeps top_p=1.0 a true no-op even when
-        # the fp32 cumsum tops out at 0.9999999 and never reaches 1
-        probs = jax.nn.softmax(x, axis=-1)
-        p_desc = jnp.sort(probs, axis=-1)[:, ::-1]
-        preceding = jnp.cumsum(p_desc, axis=-1) - p_desc
-        kept = preceding < top_ps[:, None]
-        p_min = jnp.min(jnp.where(kept, p_desc, jnp.inf), axis=-1,
-                        keepdims=True)
-        x = jnp.where(probs >= p_min, x, -jnp.inf)
+        x = _filter_scaled(logits, temps, top_ks, top_ps, vocab)
         # Gumbel-max draw keyed (request seed, per-sequence token
         # index): batch composition cannot perturb a request's stream
         keys = jax.vmap(lambda s, g: jax.random.fold_in(
@@ -191,6 +225,79 @@ def _sample_jit(vocab: int):
         gumbel = jax.vmap(
             lambda kk: jax.random.gumbel(kk, (vocab,)))(keys)
         return jnp.argmax(x + gumbel, axis=-1).astype(jnp.int32)
+
+    return jax.jit(body)
+
+
+@functools.lru_cache(maxsize=32)
+def _spec_jit(vocab: int, sq: int):
+    """The jitted speculative accept/resample epilogue, one compile per
+    (vocab, padded block width): [B, Sq, V] biased verify logits + the
+    per-row sampling knobs + the draft block -> (accepted counts [B],
+    chosen tokens [B, Sq]) in ONE fused call — the per-row accepted
+    count is computed device-side (sum of the accept cumprod), never by
+    a per-sequence host walk.
+
+    Exactness (acceptance-rejection under a DETERMINISTIC proposal):
+    the drafter proposes a point mass at d, so ``min(1, p(d)/q(d))``
+    collapses to accepting d with probability p(d) — the
+    filtered/temperature target probability itself — and the residual
+    ``max(0, p - q)`` renormalized is exactly p with d's mass zeroed,
+    drawn here as Gumbel-argmax over the filtered logits with d masked
+    to -inf.  Both arms marginalize to p:
+    ``P(emit s) = p(d)·[s=d] + (1-p(d)) · p(s)·[s≠d]/(1-p(d)) = p(s)``.
+
+    RNG replay schedule: the g-th generated token owns
+    ``key_g = fold_in(PRNGKey(seed), g)`` — the plain epilogue's key.
+    Accept uniforms draw from ``fold_in(key_g, 1)``, residual Gumbels
+    from ``fold_in(key_g, 2)``, and the bonus row (every draft landed)
+    uses key_g's unsalted Gumbel — byte-identical to ``sample_rows``.
+    Row t's token owns index ``steps + t``; rejected rows never consume
+    an index (the loop advances g only with emitted tokens)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(logits, temps, top_ks, top_ps, seeds, steps, drafts,
+             q_lens):
+        B = logits.shape[0]
+        rep = lambda a: jnp.repeat(a, sq)
+        x = _filter_scaled(
+            logits.reshape(B * sq, vocab), rep(temps), rep(top_ks),
+            rep(top_ps), vocab).reshape(B, sq, vocab)
+        probs = jax.nn.softmax(x, axis=-1)
+        # per-(row, position) key: the g-th generated token's key_g
+        g = steps[:, None] + jnp.arange(sq, dtype=jnp.uint32)[None, :]
+        key_g = jax.vmap(jax.vmap(
+            lambda s, gg: jax.random.fold_in(jax.random.PRNGKey(s),
+                                             gg)))(
+            jnp.broadcast_to(seeds[:, None], g.shape), g)
+        u = jax.vmap(jax.vmap(lambda kk: jax.random.uniform(
+            jax.random.fold_in(kk, 1), ())))(key_g)           # [B, Sq]
+        g_resid = jax.vmap(jax.vmap(lambda kk: jax.random.gumbel(
+            jax.random.fold_in(kk, 2), (vocab,))))(key_g)     # [B,Sq,V]
+        g_plain = jax.vmap(jax.vmap(lambda kk: jax.random.gumbel(
+            kk, (vocab,))))(key_g)                            # [B,Sq,V]
+        # accept draft d_t iff u_t < p(d_t); rows past the draft depth
+        # can never accept, and the cumprod keeps acceptance prefix-
+        # contiguous (the first rejection ends the row's walk)
+        p_draft = jnp.take_along_axis(
+            probs, drafts[..., None], axis=-1)[..., 0]        # [B, Sq]
+        t_iota = jnp.arange(sq)[None, :]
+        has_draft = t_iota < (q_lens[:, None] - 1)
+        accept = (u < p_draft) & has_draft
+        acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                      axis=1)                                 # [B]
+        # residual: the draft's mass zeroed, renormalized (= masked
+        # Gumbel-argmax); bonus (last fed row): the plain draw
+        v_iota = jnp.arange(vocab)[None, None, :]
+        x_masked = jnp.where(v_iota == drafts[..., None], -jnp.inf, x)
+        resid_tok = jnp.argmax(x_masked + g_resid, axis=-1)
+        plain_tok = jnp.argmax(x + g_plain, axis=-1)
+        is_bonus = t_iota == (q_lens[:, None] - 1)
+        tokens = jnp.where(
+            t_iota < acc[:, None], drafts,
+            jnp.where(is_bonus, plain_tok, resid_tok))
+        return acc.astype(jnp.int32), tokens.astype(jnp.int32)
 
     return jax.jit(body)
 
@@ -222,3 +329,51 @@ def sample_rows(logits: np.ndarray, params: Sequence[SamplingParams],
     steps = np.asarray(steps, np.uint32)
     return np.asarray(_sample_jit(V)(
         logits, temps, top_ks, top_ps, seeds, steps))
+
+
+def spec_sample_rows(
+        logits: np.ndarray, params: Sequence[SamplingParams],
+        steps: Sequence[int], drafts: Sequence[Sequence[int]],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The speculative counterpart of :func:`sample_rows`: decide every
+    drafted non-greedy row's accept/resample outcome in ONE jitted
+    call.  ``logits`` is the verify step's [B, Sq, V] (bias already
+    applied per [Sq, V] slice); ``drafts[i]`` holds row i's proposed
+    tokens (its block minus the committed head — may be empty, in
+    which case row i reduces exactly to ``sample_rows`` at row 0);
+    ``steps[i]`` is the generated-token index of row i's FIRST emitted
+    token.  Returns ``(accepted [B] int32, tokens [B, Sq] int32)``:
+    position t of row i holds the accepted draft for ``t <
+    accepted[i]``, the residual resample at ``t == accepted[i]`` (or
+    the bonus draw when every draft landed) — entries past each row's
+    walk are garbage the caller must ignore."""
+    logits = np.ascontiguousarray(np.asarray(logits, np.float32))
+    if logits.ndim != 3:
+        raise ValueError(f"spec_sample_rows wants [B, Sq, V] verify "
+                         f"logits, got {logits.shape}")
+    B, Sq, V = logits.shape
+    if len(params) != B or len(steps) != B or len(drafts) != B:
+        raise ValueError(
+            "params/steps/drafts must align with the logit rows")
+    temps = np.asarray([p.temperature for p in params], np.float32)
+    if (temps <= 0).any():
+        raise ValueError(
+            "greedy rows (temperature 0) must take the host "
+            "longest-prefix walk, not the accept/resample epilogue")
+    draft_arr = np.zeros((B, Sq), np.int32)
+    q_lens = np.empty(B, np.int32)
+    for i, d in enumerate(drafts):
+        d = [int(t) for t in d]
+        if len(d) >= Sq:
+            raise ValueError(
+                f"row {i} proposes {len(d)} drafts but the verify "
+                f"width holds at most {Sq - 1} (1 committed + drafts)")
+        draft_arr[i, :len(d)] = d
+        q_lens[i] = len(d) + 1
+    top_ks = np.asarray([p.top_k for p in params], np.int32)
+    top_ps = np.asarray([p.top_p for p in params], np.float32)
+    seeds = np.asarray([p.seed for p in params], np.uint32)
+    steps = np.asarray(steps, np.uint32)
+    acc, toks = _spec_jit(V, Sq)(
+        logits, temps, top_ks, top_ps, seeds, steps, draft_arr, q_lens)
+    return np.asarray(acc), np.asarray(toks)

@@ -111,12 +111,24 @@ class PromptLookupDrafter:
     suffix index (module docstring) — the serving loop passes it (the
     ``stateful`` attribute advertises support) and calls
     :meth:`release` when a sequence retires; an LRU cap
-    (``max_sequences``) bounds host memory regardless."""
+    (``max_sequences``) bounds host memory regardless.
+
+    ``corpus`` (ISSUE 16) plugs in a SHARED n-gram source — any object
+    exposing ``ngram_continuation(probe, limit) -> List[int]``, in
+    practice the serving loop's ``PrefixCache`` riding its
+    prompt-prefix trie.  Own-history matching runs first and a
+    full-length own match wins outright (self-structure is the most
+    specific signal); otherwise the corpus is probed longest-n-gram
+    first and the LONGER of the two proposals is drafted (ties keep
+    own-history).  Shared-prefix fleet traffic thus drafts from
+    continuations OTHER sequences already inserted — a cold sequence
+    entering a popular template speculates from step one."""
 
     stateful = True  # the loop may pass seq_id= and call release()
 
     def __init__(self, max_draft: int = 4, max_ngram: int = 3,
-                 min_ngram: int = 1, max_sequences: int = 1024):
+                 min_ngram: int = 1, max_sequences: int = 1024,
+                 corpus=None):
         if max_draft < 1:
             raise ValueError(f"max_draft must be >= 1, got {max_draft}")
         if not 1 <= min_ngram <= max_ngram:
@@ -130,6 +142,11 @@ class PromptLookupDrafter:
         self.max_ngram = int(max_ngram)
         self.min_ngram = int(min_ngram)
         self.max_sequences = int(max_sequences)
+        if corpus is not None and not hasattr(corpus,
+                                              "ngram_continuation"):
+            raise TypeError(
+                "corpus must expose ngram_continuation(probe, limit)")
+        self.corpus = corpus
         self._index: "OrderedDict[int, _SeqIndex]" = OrderedDict()
 
     def release(self, seq_id: int) -> None:
@@ -154,17 +171,43 @@ class PromptLookupDrafter:
             return []
         ctx = [int(t) for t in context]
         if seq_id is None:
-            return self._scan_draft(ctx, limit)
-        idx = self._index.get(seq_id)
-        if idx is None:
-            idx = _SeqIndex()
-            self._index[seq_id] = idx
-            while len(self._index) > self.max_sequences:
-                self._index.popitem(last=False)
+            own = self._scan_draft(ctx, limit)
         else:
-            self._index.move_to_end(seq_id)
-        idx.sync(ctx, self.min_ngram, self.max_ngram)
-        return self._indexed_draft(idx, ctx, limit)
+            idx = self._index.get(seq_id)
+            if idx is None:
+                idx = _SeqIndex()
+                self._index[seq_id] = idx
+                while len(self._index) > self.max_sequences:
+                    self._index.popitem(last=False)
+            else:
+                self._index.move_to_end(seq_id)
+            idx.sync(ctx, self.min_ngram, self.max_ngram)
+            own = self._indexed_draft(idx, ctx, limit)
+        if len(own) < limit and self.corpus is not None:
+            corp = self._corpus_draft(ctx, limit)
+            if len(corp) > len(own):
+                return corp
+        return own
+
+    def _corpus_draft(self, ctx: List[int], limit: int) -> List[int]:
+        """Probe the shared corpus longest-n-gram first (more specific
+        probes win); a full-length continuation returns outright, the
+        longest partial one is the cross-n fallback — the same decision
+        rule as own-history matching.  Unlike the self-match scan the
+        corpus probe may use the FULL suffix (n up to max_ngram, not
+        max_ngram capped at len-1): occurrences there are other
+        sequences' chains, so there is no suffix-matches-itself case to
+        exclude."""
+        L = len(ctx)
+        best: List[int] = []
+        for n in range(min(self.max_ngram, L), self.min_ngram - 1, -1):
+            got = [int(t) for t in
+                   self.corpus.ngram_continuation(ctx[L - n:], limit)]
+            if len(got) == limit:
+                return got
+            if len(got) > len(best):
+                best = got
+        return best
 
     def _indexed_draft(self, idx: _SeqIndex, ctx: List[int],
                        limit: int) -> List[int]:

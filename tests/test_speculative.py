@@ -23,15 +23,29 @@ Acceptance pinned here:
     table;
 (e) SamplingParams: temperature/top-k/top-p through the one jitted
     epilogue (deterministic per (seed, token-index), independent of
-    batch composition), logit bias shifting greedy argmax, speculation
-    auto-disabling per-sequence for non-greedy requests, and
-    Engine.submit threading the params in pass-through mode;
+    batch composition), logit bias shifting greedy argmax, sampled
+    rows drafting through the exact accept/resample epilogue
+    (ISSUE 16), and Engine.submit threading the params in
+    pass-through mode;
+(i) ISSUE 16 exactness: the accept/resample epilogue's emitted-token
+    distribution matches the plain sampler's over thousands of
+    replayed draws (TV-distance bound across temp/top-k/top-p arms,
+    chi-square sanity vs the exact filtered distribution), its
+    accept/resample stream replays bit-identically per (seed, step),
+    the spec_disabled counter surfaces a program without verify_step,
+    and the corpus drafter (``PrefixCache.ngram_continuation``) follows
+    the own-history-first decision rule — a corpus continuation only
+    displaces the sequence's own draft when STRICTLY longer;
 (f) serve_bench --speculate/--sampling scenarios on the 0/2/3 gate
     contract (usage errors exit 2) with acceptance_rate > 0 and
-    tokens/s above the same invocation's d=0 arm;
+    tokens/s above the same invocation's d=0 arm — ISSUE 16 extends
+    the matrix with sampled (topk), --mesh, and corpus-drafted
+    --prefix-share speculation arms;
 (g) the spec_verify zoo entry is banked under require_all coverage at
     < 2x the d=0 gqa_decode bytes/step, and the known-bad
-    spec_verify_gather corpus arm trips the bytes gate;
+    spec_verify_gather corpus arm trips the bytes gate; the SPMD
+    mirror (spec_verify_spmd / spec_verify_spmd_gather) holds the
+    same contract for the mesh verify step;
 (h) observability: draft/verify/rollback flight events and the
     per-sequence accepted/rejected span annotation.
 """
@@ -61,7 +75,12 @@ from paddle_tpu.serving import (
     init_decode_params,
     verify_step,
 )
-from paddle_tpu.serving.sampling import apply_bias, sample_rows, stop_hit
+from paddle_tpu.serving.sampling import (
+    apply_bias,
+    sample_rows,
+    spec_sample_rows,
+    stop_hit,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -555,13 +574,247 @@ def test_sample_rows_epilogue_semantics():
         sample_rows(logits, [SamplingParams()] * 4, [0] * 4)
 
 
+# ---------------------------------------------------------------------------
+# (i) ISSUE 16: the exact accept/resample epilogue — distribution,
+# replay, degrade surfacing, and the corpus drafter decision rule
+
+
+def _exact_filtered_probs(row, p):
+    """Host-side exact target: the SAME ``_filter_scaled`` both jitted
+    epilogues trace, applied eagerly to one row, then softmax."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import sampling as _sampling
+
+    x = np.asarray(_sampling._filter_scaled(
+        jnp.asarray(row[None], jnp.float32),
+        jnp.asarray([p.temperature], jnp.float32),
+        jnp.asarray([p.top_k], jnp.int32),
+        jnp.asarray([p.top_p], jnp.float32), row.shape[0]))[0]
+    x = x - x[np.isfinite(x)].max()
+    e = np.where(np.isfinite(x), np.exp(x), 0.0)
+    return e / e.sum()
+
+
+@pytest.mark.parametrize("kw", [
+    dict(temperature=0.8),
+    dict(temperature=0.9, top_k=8),
+    dict(temperature=1.0, top_p=0.85),
+], ids=["temp", "topk", "topp"])
+def test_spec_epilogue_emitted_distribution_is_exact(kw):
+    """The exactness theorem, empirically: with a fixed drafted token,
+    the FIRST emitted token of the accept/resample walk (the draft when
+    accepted, the masked residual resample otherwise) must be
+    distributed exactly as the plain filtered sampler.  Checked three
+    ways over thousands of independent seeds: TV distance against
+    ``sample_rows``'s empirical histogram, chi-square against the exact
+    filtered softmax, and the acceptance frequency against p(draft)
+    itself — with both the accept and resample arms firing."""
+    V, B = 32, 8192
+    rng = np.random.RandomState(5)
+    row = rng.standard_normal(V).astype(np.float32)
+    ps = [SamplingParams(seed=i, **kw) for i in range(B)]
+    steps = [0] * B
+    p_exact = _exact_filtered_probs(row, ps[0])
+    draft = int(np.argsort(p_exact)[-2])  # in-support, not the mode
+    spec_logits = np.broadcast_to(row, (B, 2, V)).copy()
+    acc, toks = spec_sample_rows(spec_logits, ps, steps, [[draft]] * B)
+    emitted = toks[:, 0]
+    accepted = acc >= 1
+    assert 0 < accepted.sum() < B              # both arms exercised
+    assert (emitted[accepted] == draft).all()  # accepts emit the draft
+    assert (emitted[~accepted] != draft).all()  # residual masks it out
+    # TV distance vs the plain epilogue's empirical distribution
+    plain = sample_rows(np.broadcast_to(row, (B, V)).copy(), ps, steps)
+    h_spec = np.bincount(emitted, minlength=V) / B
+    h_plain = np.bincount(plain, minlength=V) / B
+    assert 0.5 * np.abs(h_spec - h_plain).sum() < 0.05
+    # chi-square vs the exact filtered softmax (loose bound — a wrong
+    # residual, e.g. forgetting to mask the draft, misses it by miles)
+    exp = p_exact * B
+    keep = exp >= 5
+    chi2 = float((((np.bincount(emitted, minlength=V) - exp) ** 2
+                   / np.maximum(exp, 1e-9))[keep]).sum())
+    dof = int(keep.sum()) - 1
+    assert chi2 < dof + 6 * np.sqrt(2 * dof), (chi2, dof)
+    # acceptance itself is a Bernoulli(p(draft)) draw per row
+    p_d = float(p_exact[draft])
+    assert abs(float(accepted.mean()) - p_d) \
+        < 5 * np.sqrt(p_d * (1 - p_d) / B)
+    # exact replay: the (seed, token-index) stream is bit-identical
+    acc2, toks2 = spec_sample_rows(spec_logits, ps, steps,
+                                   [[draft]] * B)
+    assert (acc2 == acc).all() and (toks2 == toks).all()
+
+
+def test_spec_epilogue_no_draft_row_is_exactly_sample_rows():
+    """A row with an empty draft walks zero accepts and lands on the
+    bonus draw — the UNSALTED Gumbel at key_g — so it must be
+    byte-identical to the plain epilogue at the same (seed, step)."""
+    rng = np.random.RandomState(7)
+    B, V = 64, 32
+    logits = rng.standard_normal((B, V)).astype(np.float32)
+    ps = [SamplingParams(temperature=0.7 + 0.01 * i, seed=i)
+          for i in range(B)]
+    steps = list(range(B))
+    acc, toks = spec_sample_rows(logits[:, None, :], ps, steps,
+                                 [[]] * B)
+    assert (acc == 0).all()
+    assert (toks[:, 0] == sample_rows(logits, ps, steps)).all()
+
+
+def test_spec_epilogue_rejects_greedy_rows_and_overfull_drafts():
+    logits = np.zeros((2, 3, 8), np.float32)
+    sp = SamplingParams(temperature=0.8, seed=0)
+    with pytest.raises(ValueError, match="greedy"):
+        spec_sample_rows(logits, [SamplingParams(), sp], [0, 0],
+                         [[1], [1]])
+    with pytest.raises(ValueError, match="at most"):
+        spec_sample_rows(logits, [sp, sp], [0, 0], [[1, 2, 3], [1]])
+
+
+def test_sampled_spec_arms_roll_back_and_leak_nothing():
+    """Every sampling scenario speculates now: the epilogue rejects
+    (rollbacks occur), the pool comes back fully free with invariants
+    audited every step, and the replayed stream is identical."""
+    cfg0, params, prompt, _ = _oracle_setup()
+    prompt = prompt[:3] * 2  # a repeating prompt: drafting fires early
+    for arm in (dict(temperature=1.0), dict(temperature=0.9, top_k=12),
+                dict(temperature=0.9, top_p=0.9)):
+
+        def run():
+            pool = KVCachePool(num_pages=64, page_size=4,
+                               num_layers=cfg0.n_layer,
+                               num_heads=cfg0.n_head,
+                               head_dim=cfg0.head_dim)
+            loop = ContinuousBatchingLoop(params, cfg0, pool,
+                                          max_batch=4, speculate=3,
+                                          check_every=1)
+            reqs = [DecodeRequest(prompt, 10,
+                                  sampling=SamplingParams(seed=s,
+                                                          **arm))
+                    for s in range(3)]
+            out = loop.run(reqs)
+            assert pool.free_pages == pool.num_pages
+            assert loop.invariant_violations == 0
+            return loop, [o.tokens for o in out]
+
+        loop, toks = run()
+        assert loop.drafted_tokens > 0, arm
+        assert loop.rolled_back_tokens > 0, arm  # rejections happened
+        _, toks2 = run()
+        assert toks2 == toks, arm
+
+
+def test_program_without_verify_step_surfaces_spec_disabled(obs_on):
+    """ISSUE 16 bugfix: a program that cannot verify used to degrade
+    speculation to d=0 with only a log line — now it lands a
+    {reason=}-labelled counter and a flight event."""
+    cfg = DecodeConfig(vocab_size=17, d_model=16, n_head=2, n_layer=1,
+                       d_inner=16, max_length=16)
+    pool = KVCachePool(num_pages=4, page_size=4, num_layers=1,
+                       num_heads=2, head_dim=8)
+
+    class _NoVerify:
+        def __init__(self, cfg):
+            self.cfg = cfg
+
+        def resolve_impl(self, pool):
+            return "reference"
+
+    loop = ContinuousBatchingLoop(None, None, pool,
+                                  program=_NoVerify(cfg), speculate=2)
+    assert loop._speculate == 0 and loop.drafter is None
+    snap = obs.default_registry().to_prometheus()
+    assert "paddle_tpu_serving_spec_disabled_total" in snap
+    assert 'reason="program_no_verify"' in snap
+    ev = [e for e in obs.default_flight().events()
+          if e["kind"] == "spec_disabled"]
+    assert ev and ev[0]["reason"] == "program_no_verify"
+    assert ev[0]["program"] == "_NoVerify"
+
+
+def _corpus_cache(chains):
+    """A PrefixCache primed the production way: each chain is a
+    finished prefill whose prompt pages were inserted into the trie."""
+    pool = KVCachePool(num_pages=64, page_size=4, num_layers=1,
+                       num_heads=2, head_dim=8)
+    cache = PrefixCache(pool)
+    for sid, chain in enumerate(chains):
+        pool.allocate(sid)
+        pool.append_tokens([sid], [len(chain)])
+        cache.insert(sid, chain)
+    return pool, cache
+
+
+def test_ngram_continuation_decision_rule():
+    pool, cache = _corpus_cache([
+        [1, 2, 3, 4, 5, 6, 7, 8],   # older chain, longer follow-up
+        [9, 9, 1, 2, 3, 7, 7, 7],   # newer chain, shorter follow-up
+    ])
+    # the longer continuation wins across chains
+    assert cache.ngram_continuation([1, 2, 3], 4) == [4, 5, 6, 7]
+    # at equal (full) length the more recently used chain wins the tie
+    assert cache.ngram_continuation([1, 2, 3], 3) == [7, 7, 7]
+    # a miss returns [] — the drafter falls back to own history
+    assert cache.ngram_continuation([5, 9], 4) == []
+    assert cache.ngram_continuation([], 4) == []
+    assert cache.ngram_continuation([1, 2, 3], 0) == []
+    # the corpus walk is pure host bookkeeping: no pool state moved
+    assert pool.check_invariants()["ok"]
+
+
+def test_ngram_continuation_newest_position_wins_within_chain():
+    _, cache = _corpus_cache([[1, 2, 5, 1, 2, 6, 1, 2]])
+    # [1, 2] occurs at 0, 3 and 6; the newest occurrence with a
+    # full-length continuation (position 3) wins over the older one
+    assert cache.ngram_continuation([1, 2], 2) == [6, 1]
+
+
+def test_drafter_corpus_decision_rule_and_type_check():
+    _, cache = _corpus_cache([[3, 4, 50, 51, 52, 53, 54, 55]])
+    d = PromptLookupDrafter(max_draft=4, max_ngram=3, corpus=cache)
+    ctx = [3, 4, 8, 3, 4]
+    # own history fills the limit → the corpus is never consulted
+    assert d.draft(ctx, 3) == [8, 3, 4]
+    # own comes up short → a STRICTLY longer corpus continuation wins
+    assert d.draft(ctx, 4) == [50, 51, 52, 53]
+    # an equal-length corpus match does NOT displace own history
+    d2 = PromptLookupDrafter(
+        max_draft=4, max_ngram=3,
+        corpus=_corpus_cache([[3, 4, 60, 61, 62]])[1])
+    assert d2.draft(ctx, 4) == [8, 3, 4]
+    with pytest.raises(TypeError, match="ngram_continuation"):
+        PromptLookupDrafter(corpus=object())
+
+
+def test_loop_wires_prefix_cache_as_drafter_corpus():
+    cfg0, params, _, _ = _oracle_setup()
+
+    def pool():
+        return KVCachePool(num_pages=64, page_size=4,
+                           num_layers=cfg0.n_layer,
+                           num_heads=cfg0.n_head,
+                           head_dim=cfg0.head_dim)
+
+    p1 = pool()
+    cache = PrefixCache(p1)
+    loop = ContinuousBatchingLoop(params, cfg0, p1, speculate=3,
+                                  prefix_cache=cache)
+    assert loop.drafter is not None and loop.drafter.corpus is cache
+    # no prefix cache → no corpus, plain own-history drafting
+    loop2 = ContinuousBatchingLoop(params, cfg0, pool(), speculate=3)
+    assert loop2.drafter is not None and loop2.drafter.corpus is None
+
+
 def test_sampled_request_rides_spec_batch_and_replays_identically():
     """A non-greedy request decodes alongside speculating batch-mates
-    (at d=0 — per-sequence auto-disable) without breaking the greedy
-    mate's oracle parity, and an identical replay regenerates the
-    identical stream (the (seed, token-index) RNG key contract; exact
-    cross-composition identity is NOT promised — fp32 reduction order
-    differs between step shapes)."""
+    without breaking the greedy mate's oracle parity, and an identical
+    replay regenerates the identical stream (the (seed, token-index)
+    RNG key contract; exact cross-composition identity is NOT promised
+    — fp32 reduction order differs between step shapes).  ISSUE 16:
+    the sampled row itself DRAFTS now — the accept/resample epilogue
+    verifies it — so a purely sampled run speculates too."""
     cfg0, params, prompt, want = _oracle_setup()
     sp = SamplingParams(temperature=0.9, seed=3)
 
@@ -590,12 +843,18 @@ def test_sampled_request_rides_spec_batch_and_replays_identically():
                                   sampling=SamplingParams(
                                       temperature=0.9, seed=4))])
     assert other[1].tokens != mixed[1].tokens
-    # a purely sampled run never drafts (per-sequence auto-disable)
-    loop2, _ = run([DecodeRequest(prompt, 6, sampling=sp),
-                    DecodeRequest(prompt, 6,
-                                  sampling=SamplingParams(
-                                      temperature=0.5, seed=1))])
-    assert loop2.drafted_tokens == 0 and loop2.spec_steps == 0
+    # a purely sampled run drafts too (ISSUE 16 — no per-sequence
+    # auto-disable anymore) and its replay is still exact
+    loop2, out2 = run([DecodeRequest(prompt, 6, sampling=sp),
+                       DecodeRequest(prompt, 6,
+                                     sampling=SamplingParams(
+                                         temperature=0.5, seed=1))])
+    assert loop2.drafted_tokens > 0 and loop2.spec_steps > 0
+    _, out3 = run([DecodeRequest(prompt, 6, sampling=sp),
+                   DecodeRequest(prompt, 6,
+                                 sampling=SamplingParams(
+                                     temperature=0.5, seed=1))])
+    assert [o.tokens for o in out3] == [o.tokens for o in out2]
 
 
 def test_logit_bias_shifts_greedy_argmax_and_keeps_speculation():
@@ -749,6 +1008,62 @@ def test_serve_bench_speculate_smoke_and_gate(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_serve_bench_sampled_speculation_smoke(tmp_path, capsys):
+    """ISSUE 16: --speculate composes with a non-greedy --sampling —
+    the exit-2 refusal is gone, rollbacks occur, nothing leaks, and
+    the d=0 comparison arm still runs (the in-process replay-identity
+    check already passed or the run would have exited 2)."""
+    rc = _bench_main([
+        "--mode", "decode", "--sequences", "6", "--max-new", "16",
+        "--speculate", "3", "--sampling", "topk", "--pages", "96",
+        "--page-size", "8", "--max-len", "96",
+        "--json", str(tmp_path / "out.json")])
+    capsys.readouterr()
+    assert rc == 0
+    out = json.loads((tmp_path / "out.json").read_text())
+    assert out["sampling"] == "topk" and out["speculate"] == 3
+    assert out["acceptance_rate"] > 0
+    assert out["rolled_back_tokens"] > 0   # the epilogue rejected
+    assert out["pages_leaked"] == 0
+    assert out["spec_speedup"] > 0 and out["tokens_per_s_d0"] > 0
+
+
+def test_serve_bench_mesh_speculation_smoke(tmp_path, capsys):
+    """--speculate composes with --mesh: the SPMD program's multi-token
+    verify runs the draft blocks and the d=0 arm compares mesh against
+    mesh (greedy, so the token-identity check held in-process)."""
+    rc = _bench_main([
+        "--mode", "decode", "--sequences", "4", "--max-new", "10",
+        "--mesh", "2", "--speculate", "2", "--pages", "64",
+        "--page-size", "4", "--max-len", "48",
+        "--json", str(tmp_path / "out.json")])
+    capsys.readouterr()
+    assert rc == 0
+    out = json.loads((tmp_path / "out.json").read_text())
+    assert out["mesh"] == 2 and out["speculate"] == 2
+    assert out["acceptance_rate"] > 0
+    assert out["pages_leaked"] == 0
+    assert out["tokens_per_s_d0"] > 0
+
+
+def test_serve_bench_corpus_drafted_prefix_share_smoke(tmp_path,
+                                                      capsys):
+    """Shared-prefix traffic drafts from the prefix cache's corpus: the
+    acceptance rate on a --prefix-share arm sits far above what own-
+    history lookup alone reaches on random prompts."""
+    rc = _bench_main([
+        "--mode", "decode", "--sequences", "6", "--max-new", "12",
+        "--speculate", "3", "--prefix-share", "0.6", "--pages", "128",
+        "--page-size", "8", "--max-len", "96",
+        "--json", str(tmp_path / "out.json")])
+    capsys.readouterr()
+    assert rc == 0
+    out = json.loads((tmp_path / "out.json").read_text())
+    assert out["prefix_hit_rate"] > 0
+    assert out["acceptance_rate"] > 0.5   # corpus-fed drafts land
+    assert out["pages_leaked"] == 0
+
+
 def test_serve_bench_sampling_scenario_smoke(tmp_path, capsys):
     rc = _bench_main([
         "--mode", "decode", "--sequences", "4", "--max-new", "8",
@@ -762,7 +1077,6 @@ def test_serve_bench_sampling_scenario_smoke(tmp_path, capsys):
 def test_serve_bench_speculate_usage_errors_exit_2(capsys):
     cases = [
         ["--mode", "engine", "--speculate", "2"],
-        ["--mode", "decode", "--speculate", "2", "--sampling", "temp"],
         ["--mode", "decode", "--speculate", "-1"],
         ["--mode", "decode", "--speculate", "2", "--chaos"],
         ["--mode", "engine", "--sampling", "topk"],
@@ -813,6 +1127,48 @@ def test_spec_verify_gather_corpus_trips_bytes_gate():
     assert failed
     v = [x for x in verdicts
          if x["metric"] == "spec_verify_aot_bytes_per_step"]
+    assert v and v[0]["verdict"] == "fail"
+
+
+def test_spec_verify_spmd_banked_under_require_all():
+    """The mesh mirror of the spec_verify entry: the SPMD multi-token
+    verify step is banked (require_all coverage — dropping it fails
+    the lint gate) at the same q_tokens = 1 + d width, findings
+    clean, on the 4-shard v5e topology."""
+    from paddle_tpu import analysis
+
+    with open(analysis.default_baseline_path()) as f:
+        progs = json.load(f)["programs"]
+    assert "spec_verify_spmd" in progs
+    e = progs["spec_verify_spmd"]
+    assert e["config"]["q_tokens"] == 5       # d = 4, Sq = 1 + d
+    assert e["config"]["n_shards"] == 4
+    assert e["config"]["impl"] == "pallas"
+    assert e["findings"] == {}
+    assert e["bytes_per_step"] > 0 and e["flops_per_step"] > 0
+
+
+def test_spec_verify_spmd_gather_corpus_trips_bytes_gate():
+    """The known-bad mesh arm: swapping the verify step's paged kernel
+    for the reference gather re-materializes [B, H, S, D] per chip —
+    at the banked 1024-token context that prices above the tolerance
+    band and the bytes gate fails it in spec_verify_spmd's slot."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.corpus import build_corpus_program
+
+    pytest.importorskip("jax")
+    art = build_corpus_program("spec_verify_spmd_gather")
+    if art.compile_error:
+        pytest.skip(f"AOT topology unavailable: {art.compile_error}")
+    assert art.name == "spec_verify_spmd"  # the zoo entry's slot
+    bad = analysis.ZooResult(
+        name=art.name, artifacts=art, findings=[],
+        bytes_per_step=art.bytes_per_step, flops_per_step=0.0)
+    verdicts, failed = analysis.gate(
+        [bad], analysis.default_baseline_path())
+    assert failed
+    v = [x for x in verdicts
+         if x["metric"] == "spec_verify_spmd_aot_bytes_per_step"]
     assert v and v[0]["verdict"] == "fail"
 
 

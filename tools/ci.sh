@@ -12,6 +12,11 @@
 #   fleet    - disaggregated prefill/decode fleet smoke: an elastic
 #              --fleet run, a serve_bench --disagg --gate round-trip,
 #              and a handoff-drop chaos inject that must exit 3
+#   spec     - speculative-decoding smoke (ISSUE 16): a sampled
+#              serve_bench --speculate --sampling topk --gate
+#              round-trip (acceptance/speedup banked, replay
+#              determinism checked in-process) and a gate-teeth arm
+#              banking an unreachable spec_speedup that must exit 3
 # Run all stages:  tools/ci.sh        One stage:  tools/ci.sh test
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -95,6 +100,38 @@ JSON
   rm -rf "$tmp"
 }
 
+run_spec() {
+  echo "== speculative decoding smoke (sampled arm, gate round-trip) =="
+  tmp="$(mktemp -d)"
+  # the banked contract: rollbacks happen, nothing leaks, the sampled
+  # arm still clears break-even (in-process checks already held the
+  # replay bit-identical or serve_bench would have exited 2)
+  cat > "$tmp/bank.json" <<'JSON'
+{"pages_leaked": 0, "acceptance_rate": 0.05, "spec_speedup": 0.9}
+JSON
+  python tools/serve_bench.py --mode decode --sequences 8 --max-new 24 \
+    --speculate 3 --sampling topk --pages 96 --page-size 8 \
+    --max-len 96 --json "$tmp/spec.json" \
+    --baseline "$tmp/bank.json" --gate
+  echo "== spec gate teeth: an unreachable speedup baseline must exit 3 =="
+  cat > "$tmp/bank_bad.json" <<'JSON'
+{"spec_speedup": 1000.0}
+JSON
+  set +e
+  python tools/serve_bench.py --mode decode --sequences 4 --max-new 8 \
+    --speculate 2 --sampling temp --pages 64 --page-size 4 \
+    --d-model 32 --max-len 48 \
+    --baseline "$tmp/bank_bad.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "spec gate smoke: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "spec gate smoke OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_bench() {
   echo "== bench smoke =="
   BENCH_BS=8 BENCH_STEPS=3 BENCH_TRANSFORMER_BS=2 BENCH_DEEPFM_BS=32 \
@@ -107,8 +144,9 @@ case "$stage" in
   api)    run_api ;;
   lint)   run_lint ;;
   fleet)  run_fleet ;;
+  spec)   run_spec ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_fleet; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

@@ -36,8 +36,7 @@ a batching server — latency percentiles, throughput, and batch occupancy
   scale overhead amortized in), so the H_q/H_kv x and 2x capacity wins
   bank and gate like every other metric.  --speculate N arms
   prompt-lookup speculative decoding (d=N draft tokens verified per
-  step; greedy-only — a non-greedy --sampling scenario is a usage
-  error, exit 2) on a REPEATED-STRUCTURE prompt workload (motif-tiled
+  step) on a REPEATED-STRUCTURE prompt workload (motif-tiled
   prompts, the traffic shape prompt lookup exists for) and runs the
   SAME replay once more at d=0 in the same invocation: the report
   banks acceptance_rate, tokens_per_step, drafted/accepted counts,
@@ -45,8 +44,15 @@ a batching server — latency percentiles, throughput, and batch occupancy
   ratio — bank it >= 1 and --gate holds the win).  --sampling
   {greedy,temp,topk,topp} attaches the matching SamplingParams
   scenario to every request (temp/topk/topp load-test the jitted
-  sampling epilogue; tokens no longer match the greedy oracle, so only
-  throughput/latency metrics are meaningful to bank).
+  sampling epilogue).  Speculation composes with ALL of them (ISSUE
+  16): a greedy spec arm must stay token-identical to its d=0 run
+  (checked in-process, exit 2 on divergence), a sampled spec arm
+  instead replays itself once more and must be bit-identical (the
+  (seed, token-index)-keyed stream is the contract — d=0 tokens
+  legitimately differ because drafted rows consume salted keys), and
+  --speculate together with --mesh N drives the SPMD program's
+  multi-token verify step (d+1 tokens per mesh step, d=0 arm on the
+  same mesh).
 
   router mode (--replicas N, engine-mode option): N Engine replicas of
   the same artifact behind one distributed.Router; the Poisson replay
@@ -543,6 +549,13 @@ def run_decode_bench(args) -> dict:
     fallbacks_before = fallback_count()
 
     def _fresh_pool():
+        # the A/B and replay arms must ride the SAME pool kind as the
+        # timed arm — mesh runs compare mesh-vs-mesh, never
+        # mesh-vs-single-device
+        if program is not None:
+            return program.make_pool(num_pages=args.pages,
+                                     page_size=args.page_size,
+                                     dtype=kv_dtype)
         return serving.KVCachePool(
             num_pages=args.pages, page_size=args.page_size,
             num_layers=cfg.n_layer, num_heads=cfg.n_head,
@@ -560,7 +573,8 @@ def run_decode_bench(args) -> dict:
         serving.ContinuousBatchingLoop(
             params, cfg, wpool, max_batch=args.max_batch,
             paged_impl=args.paged_impl, prefill=args.prefill,
-            prefix_cache=wcache, prefill_chunk=args.prefill_chunk,
+            program=program, prefix_cache=wcache,
+            prefill_chunk=args.prefill_chunk,
             speculate=speculate).run(reqs)
         if wcache is not None:
             wcache.clear()
@@ -609,21 +623,47 @@ def run_decode_bench(args) -> dict:
         loop_d0 = serving.ContinuousBatchingLoop(
             params, cfg, pool_d0, max_batch=args.max_batch,
             paged_impl=args.paged_impl, prefill=args.prefill,
-            prefix_cache=cache_d0, prefill_chunk=args.prefill_chunk,
+            program=program, prefix_cache=cache_d0,
+            prefill_chunk=args.prefill_chunk,
             speculate=0)
         t0_d0 = time.perf_counter()
         results_d0 = loop_d0.run(reqs)
         elapsed_d0 = time.perf_counter() - t0_d0
         tokens_d0 = sum(len(r.tokens) for r in results_d0)
-        # greedy speculation is token-identical to d=0 — anything else
-        # is a correctness bug, not a perf result
-        for a, b in zip(results, results_d0):
-            if a.tokens != b.tokens:
-                sys.stderr.write(
-                    "serve_bench: speculative tokens diverged from the "
-                    "d=0 run — refusing to report throughput for "
-                    "wrong output\n")
-                raise SystemExit(2)
+        if args.sampling == "greedy":
+            # greedy speculation is token-identical to d=0 — anything
+            # else is a correctness bug, not a perf result
+            for a, b in zip(results, results_d0):
+                if a.tokens != b.tokens:
+                    sys.stderr.write(
+                        "serve_bench: speculative tokens diverged from "
+                        "the d=0 run — refusing to report throughput "
+                        "for wrong output\n")
+                    raise SystemExit(2)
+        else:
+            # sampled speculation is distribution-exact, not token-
+            # identical to d=0 (drafted rows consume salted replay
+            # keys); the checkable contract is DETERMINISM — the same
+            # seeded replay must reproduce the stream bit-identically
+            pool_rp = _fresh_pool()
+            cache_rp = (serving.PrefixCache(pool_rp)
+                        if (share > 0 or args.prefix_cache) else None)
+            loop_rp = serving.ContinuousBatchingLoop(
+                params, cfg, pool_rp, max_batch=args.max_batch,
+                paged_impl=args.paged_impl, prefill=args.prefill,
+                program=program, prefix_cache=cache_rp,
+                prefill_chunk=args.prefill_chunk,
+                speculate=args.speculate)
+            results_rp = loop_rp.run(reqs)
+            for a, b in zip(results, results_rp):
+                if a.tokens != b.tokens:
+                    sys.stderr.write(
+                        "serve_bench: sampled speculative replay is "
+                        "non-deterministic — refusing to report "
+                        "throughput for an unreproducible stream\n")
+                    raise SystemExit(2)
+            if cache_rp is not None:
+                cache_rp.clear()
         d0 = {"tokens": tokens_d0, "elapsed": elapsed_d0,
               "steps": loop_d0.steps}
         if cache_d0 is not None:
@@ -929,8 +969,12 @@ def main(argv=None) -> int:
                          "repeated-structure prompt workload; runs a "
                          "d=0 arm of the same replay in the same "
                          "invocation and banks acceptance_rate / "
-                         "tokens_per_step / spec_speedup (greedy "
-                         "sampling only)")
+                         "tokens_per_step / spec_speedup.  Composes "
+                         "with every --sampling scenario (sampled rows "
+                         "verify through the exact accept/resample "
+                         "epilogue; greedy stays oracle-identical) and "
+                         "with --mesh N (the SPMD program's multi-"
+                         "token verify step)")
     ap.add_argument("--sampling", default="greedy",
                     choices=tuple(_SAMPLING_SCENARIOS),
                     help="decode mode: per-request SamplingParams "
@@ -1028,18 +1072,6 @@ def main(argv=None) -> int:
         return 2
     if args.speculate < 0:
         sys.stderr.write("serve_bench: --speculate must be >= 0\n")
-        return 2
-    if args.speculate and args.sampling != "greedy":
-        sys.stderr.write(
-            f"serve_bench: --speculate verifies against the greedy "
-            f"argmax — the {args.sampling!r} sampling scenario makes "
-            "verify non-deterministic; drop one of them\n")
-        return 2
-    if args.speculate and args.mesh > 1:
-        sys.stderr.write(
-            "serve_bench: speculative decoding is single-device-loop "
-            "only (the SPMD program's steps are compiled for Sq=1) — "
-            "drop --mesh or --speculate\n")
         return 2
     if args.speculate and args.chaos:
         sys.stderr.write(
