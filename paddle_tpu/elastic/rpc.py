@@ -1,4 +1,4 @@
-"""Cross-process transport for the elastic master.
+"""Cross-process transport for the elastic master and the fleet.
 
 The reference's Go master serves trainers over net/rpc with etcd state
 (go/master/service.go:89; trainers call GetTask/TaskFinished/TaskFailed
@@ -6,15 +6,29 @@ remotely).  This is the same plane for `elastic.MasterService`: a
 line-delimited JSON protocol over TCP (tasks are plain id/chunks/epoch
 records — no arrays, no pickle), with master-side exceptions re-raised by
 name on the client so worker code is identical in- and cross-process.
+
+The fleet's DATA plane (serving/fleet/proc.py) rides a second,
+length-prefixed sub-protocol on the same TCP machinery: line-JSON cannot
+carry numpy, but a `SeqExport` handoff payload pickles, so frames are
+``b"PTF1" + !Q length + pickle``.  `FrameServer` dispatches
+``{"verb", "args"}`` request frames; `FrameClient` wraps every verb in
+per-call timeouts plus `resilience.retry` bounded backoff.  A short read
+anywhere — a peer SIGKILLed mid-write — surfaces as `FrameError`, a
+`ConnectionError` subclass, so one `retry_on` tuple covers refused
+connects, resets, timeouts, and half-written frames alike.  Server-side
+exceptions re-raise by NAME on the client via `register_error`, the
+frame plane's extensible `_ERRORS` map.
 """
 
 from __future__ import annotations
 
 import json
+import pickle
 import socket
 import socketserver
+import struct
 import threading
-from typing import Optional
+from typing import Callable, Dict, Optional, Type
 
 from .master import (
     AllTasksFailedError,
@@ -24,7 +38,11 @@ from .master import (
     Task,
 )
 
-__all__ = ["MasterServer", "RemoteMaster", "serve_master"]
+__all__ = [
+    "MasterServer", "RemoteMaster", "serve_master",
+    "FrameError", "FrameClient", "FrameServer", "serve_frames",
+    "read_frame", "write_frame", "register_error",
+]
 
 _ERRORS = {
     "PassBeforeError": PassBeforeError,
@@ -32,6 +50,21 @@ _ERRORS = {
     "NoMoreAvailableError": NoMoreAvailableError,
     "AllTasksFailedError": AllTasksFailedError,
 }
+
+
+def _send_line(wfile, resp: dict) -> bool:
+    """Write one JSON response line; False when the armed mid-write
+    truncate fault fired (the handler must then drop the connection so
+    the client sees a half-written line, not a clean close)."""
+    from ..resilience import faultinject
+
+    data = (json.dumps(resp) + "\n").encode()
+    if faultinject.rpc_truncate():
+        wfile.write(data[: max(1, len(data) // 2)])
+        wfile.flush()
+        return False
+    wfile.write(data)
+    return True
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -78,8 +111,7 @@ class _Handler(socketserver.StreamRequestHandler):
                         float(req["max_silence"]))}
                 elif cmd == "shutdown":
                     resp = {"ok": True}
-                    self.wfile.write(
-                        (json.dumps(resp) + "\n").encode())
+                    _send_line(self.wfile, resp)
 
                     def _stop(srv=self.server):
                         srv.shutdown()
@@ -96,7 +128,8 @@ class _Handler(socketserver.StreamRequestHandler):
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 resp = {"ok": False, "error": "RuntimeError",
                         "message": f"{type(e).__name__}: {e}"}
-            self.wfile.write((json.dumps(resp) + "\n").encode())
+            if not _send_line(self.wfile, resp):
+                return
 
 
 class MasterServer(socketserver.ThreadingTCPServer):
@@ -168,6 +201,12 @@ class RemoteMaster:
                 line = self._rfile.readline()
                 if not line:
                     raise ConnectionError("master closed the connection")
+                if not line.endswith(b"\n"):
+                    # A peer killed mid-write leaves a half line; it must
+                    # surface typed+retryable, never as json's ValueError.
+                    raise FrameError(
+                        f"partial response from master ({len(line)} bytes,"
+                        " no terminator) — peer died mid-write")
             except BaseException:
                 try:
                     self._sock.close()
@@ -258,3 +297,239 @@ class RemoteMaster:
                 finally:
                     self._sock = None
                     self._rfile = None
+
+
+# -- framed binary sub-protocol (the fleet's data plane) ---------------------
+#
+# Frame layout:  b"PTF1" | !Q payload length | pickle(payload)
+# Request:       {"verb": str, "args": dict}
+# Response:      {"ok": True, "result": ...}
+#            or  {"ok": False, "error": <class name>, "message": str}
+
+FRAME_MAGIC = b"PTF1"
+_FRAME_HEADER = struct.Struct("!Q")
+MAX_FRAME_BYTES = 1 << 31  # 2 GiB — far above any handoff payload
+
+
+class FrameError(ConnectionError):
+    """A frame could not be read or written whole (short read, bad
+    magic, oversized length): the peer died mid-frame or the stream is
+    desynchronized.  Subclasses ConnectionError so the standard
+    `retry_on=(ConnectionError, TimeoutError, OSError)` tuple retries
+    it after a reconnect."""
+
+
+class _FrameTruncated(Exception):
+    """Internal: the armed truncate fault cut a response mid-write; the
+    server handler must drop the connection without a traceback."""
+
+
+# Frame-plane error registry: server-side exceptions cross the socket as
+# (class name, message) and re-raise by NAME here, exactly like the
+# line-JSON `_ERRORS` map — but extensible, so layers above elastic/
+# (serving.fleet's typed replica errors) can register theirs without an
+# import inversion.
+_FRAME_ERRORS: Dict[str, Type[BaseException]] = {
+    **_ERRORS,
+    "ValueError": ValueError,
+    "KeyError": KeyError,
+    "RuntimeError": RuntimeError,
+    "TimeoutError": TimeoutError,
+    "NotImplementedError": NotImplementedError,
+}
+
+
+def register_error(cls: Type[BaseException]) -> Type[BaseException]:
+    """Register an exception class for by-name re-raise on FrameClient.
+    Returns the class, so it works as a decorator."""
+    _FRAME_ERRORS[cls.__name__] = cls
+    return cls
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = rfile.read(n - len(buf))
+        if not chunk:
+            raise FrameError(
+                f"short read: wanted {n} bytes, got {len(buf)} before EOF"
+                " — peer died mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(rfile):
+    """Read one length-prefixed pickle frame; raises FrameError on any
+    torn/garbled stream (including EOF mid-frame)."""
+    header = _read_exact(rfile, len(FRAME_MAGIC) + _FRAME_HEADER.size)
+    if header[: len(FRAME_MAGIC)] != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {header[:4]!r}")
+    (length,) = _FRAME_HEADER.unpack(header[len(FRAME_MAGIC):])
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds cap")
+    payload = _read_exact(rfile, length)
+    try:
+        return pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001 — a torn pickle is a torn frame
+        raise FrameError(f"undecodable frame payload: {e}") from e
+
+
+def write_frame(wfile, obj, _allow_truncate_fault: bool = False) -> None:
+    """Write one frame.  With `_allow_truncate_fault` (server response
+    path only) an armed FAULT_RPC_TRUNCATE_ONCE cuts the write in half
+    and raises `_FrameTruncated` so the handler drops the connection —
+    the client must see a typed, retryable half-frame."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = FRAME_MAGIC + _FRAME_HEADER.pack(len(payload)) + payload
+    if _allow_truncate_fault:
+        from ..resilience import faultinject
+
+        if faultinject.rpc_truncate():
+            wfile.write(data[: max(1, len(data) // 2)])
+            wfile.flush()
+            raise _FrameTruncated()
+    wfile.write(data)
+    wfile.flush()
+
+
+class _FrameHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        dispatch = self.server.dispatch
+        while True:
+            try:
+                req = read_frame(self.rfile)
+            except FrameError:
+                return  # peer gone or stream torn — drop the connection
+            try:
+                result = dispatch(req.get("verb"), **(req.get("args") or {}))
+                resp = {"ok": True, "result": result}
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                resp = {"ok": False, "error": type(e).__name__,
+                        "message": str(e)}
+            try:
+                write_frame(self.wfile, resp, _allow_truncate_fault=True)
+            except _FrameTruncated:
+                return
+            except OSError:
+                return
+            if resp.get("ok") and isinstance(resp.get("result"), dict) \
+                    and resp["result"].get("__close__"):
+                return
+
+
+class FrameServer(socketserver.ThreadingTCPServer):
+    """Threaded frame-protocol server around a `dispatch(verb, **kwargs)`
+    callable.  Each connection is a long-lived request/response stream;
+    dispatch exceptions cross the socket typed by name."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, dispatch: Callable, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__((host, port), _FrameHandler)
+        self.dispatch = dispatch
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self.server_address
+        return f"{h}:{p}"
+
+
+def serve_frames(dispatch: Callable, host: str = "127.0.0.1",
+                 port: int = 0) -> FrameServer:
+    srv = FrameServer(dispatch, host, port)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="frame-server").start()
+    return srv
+
+
+class FrameClient:
+    """One persistent frame-protocol connection with the same transport
+    contract as `RemoteMaster`: lazy connect, per-verb timeout override,
+    close-and-reconnect on ANY failure, bounded backoff around transient
+    transport errors, and retry accounting in `retry_stats`.  Retrying a
+    verb whose response was lost re-sends the request, so verbs must be
+    idempotent (the fleet's submit dedups on a client-minted request id;
+    collect is ack-based)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 max_retries: int = 3, retry_base_delay: float = 0.05,
+                 retry_max_delay: float = 0.5):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._max_retries = max_retries
+        self._retry_base_delay = retry_base_delay
+        self._retry_max_delay = retry_max_delay
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._wfile = None
+        self._stats_lock = threading.Lock()
+        self.retry_stats = {"calls": 0, "retries": 0, "backoff_s": 0.0}
+        self.last_call_retries = 0
+
+    def _call_once(self, verb: str, args: dict, timeout: float):
+        from ..resilience import faultinject
+
+        faultinject.rpc_drop(verb)  # no-op unless armed
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=timeout)
+                self._rfile = self._sock.makefile("rb")
+                self._wfile = self._sock.makefile("wb")
+            try:
+                self._sock.settimeout(timeout)
+                write_frame(self._wfile, {"verb": verb, "args": args})
+                resp = read_frame(self._rfile)
+            except BaseException:
+                self._close_locked()
+                raise
+        if not resp.get("ok"):
+            exc = _FRAME_ERRORS.get(resp.get("error"), RuntimeError)
+            raise exc(resp.get("message", ""))
+        return resp.get("result")
+
+    def call(self, verb: str, timeout: Optional[float] = None,
+             retry: bool = True, **args):
+        """Invoke `verb` on the peer.  `timeout` overrides the client
+        default for this verb only (slow verbs: drain, swap_params);
+        `retry=False` makes exactly one attempt (fire-and-forget verbs
+        like shutdown)."""
+        from ..resilience.retry import retry_with_backoff
+
+        t = self._timeout if timeout is None else timeout
+        if not retry:
+            return self._call_once(verb, args, t)
+        stats: dict = {}
+        try:
+            return retry_with_backoff(
+                lambda: self._call_once(verb, args, t),
+                retries=self._max_retries,
+                base_delay=self._retry_base_delay,
+                max_delay=self._retry_max_delay,
+                retry_on=(ConnectionError, TimeoutError, OSError),
+                stats=stats,
+                label="fleet.rpc",
+            )
+        finally:
+            with self._stats_lock:
+                self.retry_stats["calls"] += 1
+                self.retry_stats["retries"] += stats.get("retries", 0)
+                self.retry_stats["backoff_s"] += stats.get("backoff_s", 0.0)
+                self.last_call_retries = stats.get("retries", 0)
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._rfile = None
+                self._wfile = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

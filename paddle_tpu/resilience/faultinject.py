@@ -16,6 +16,12 @@ Knobs (all env-driven so subprocess chaos tests can arm them):
         once for the named command (or any command with "*") — models a
         master restart / transient network drop; the client's backoff
         retry must absorb it.
+    FAULT_RPC_TRUNCATE_ONCE=1         the RPC server (line-JSON master
+        AND the fleet's frame plane) writes only HALF of one response,
+        then drops the connection — models a peer killed mid-write.
+        The client must see a typed retryable error (FrameError, a
+        ConnectionError), never a partial-JSON/partial-pickle decode
+        error, and absorb it via reconnect + retry.
     FAULT_NAN_AT_STEP=<k>|<k>+        Executor.run replaces its first
         float fetch with NaN at step k (0-based, counted per process
         while armed); "k+" injects at every step from k on — drives the
@@ -58,6 +64,14 @@ Serving knobs (tests/test_serving_resilience.py chaos suite):
         prefill→decode KV handoff payload is dropped in transit, once
         — the fleet must requeue the request for a fresh prefill
         (counted as handoff_drops/re_prefills), never lose it.
+    FAULT_SERVE_PROC_KILL=<name>|*    process fleet (serving/fleet/proc):
+        the named replica PROCESS SIGKILLs itself at its next batch
+        start, once per process — the hard upgrade of
+        FAULT_SERVE_REPLICA_KILL from cooperative thread death to a
+        vanished PID (no cleanup, no atexit).  Socket peers must see a
+        typed ReplicaKilledError, queued work must fail over, and the
+        controller must quarantine + respawn.  Prefer a NAME over "*":
+        children inherit the env, so "*" would also kill every respawn.
 """
 
 from __future__ import annotations
@@ -70,7 +84,7 @@ __all__ = [
     "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
     "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
     "serve_slow_step", "serve_prefix_corrupt", "serve_replica_kill",
-    "serve_handoff_drop",
+    "serve_handoff_drop", "serve_proc_kill", "rpc_truncate",
 ]
 
 fired: set = set()
@@ -135,6 +149,18 @@ def rpc_drop(cmd: Optional[str]) -> None:
         return
     fired.add("rpc_drop")
     raise ConnectionError(f"faultinject: dropped rpc {cmd!r}")
+
+
+def rpc_truncate() -> bool:
+    """FAULT_RPC_TRUNCATE_ONCE: True exactly once while armed — the RPC
+    server writes half of one response then drops the connection,
+    modeling a peer killed mid-write.  The client's typed retryable
+    error + reconnect/backoff must absorb it."""
+    if not os.environ.get("FAULT_RPC_TRUNCATE_ONCE") \
+            or "rpc_truncate" in fired:
+        return False
+    fired.add("rpc_truncate")
+    return True
 
 
 def nan_fetches(fetch_names: Sequence[str], fetches: tuple) -> tuple:
@@ -246,6 +272,21 @@ def serve_replica_kill(name: str) -> bool:
     if spec != "*" and spec != name:
         return False
     fired.add("serve_replica_kill")
+    return True
+
+
+def serve_proc_kill(name: str) -> bool:
+    """FAULT_SERVE_PROC_KILL=<name>|*: True exactly once per process
+    when the named replica process should SIGKILL itself at its next
+    batch start — the process-fleet upgrade of serve_replica_kill: no
+    cleanup runs, the PID vanishes, and every socket peer must surface
+    a typed ReplicaKilledError instead of hanging."""
+    spec = os.environ.get("FAULT_SERVE_PROC_KILL")
+    if not spec or "serve_proc_kill" in fired:
+        return False
+    if spec != "*" and spec != name:
+        return False
+    fired.add("serve_proc_kill")
     return True
 
 

@@ -35,6 +35,7 @@ class serves both badly.  This package splits them (ISSUE 15):
 from .controller import AutoscalePolicy, FleetController
 from .fleet import Fleet, NoReplicaAvailableError
 from .handoff import Handoff, HandoffDropError, PrefixReservation
+from .proc import ProcReplica, ProcSpawner
 from .replica import (
     DecodeReplica,
     FleetQueueFullError,
@@ -56,6 +57,8 @@ __all__ = [
     "NoReplicaAvailableError",
     "PrefillReplica",
     "PrefixReservation",
+    "ProcReplica",
+    "ProcSpawner",
     "ReplicaDrainingError",
     "ReplicaKilledError",
 ]

@@ -175,6 +175,7 @@ class FleetController:
                 name = getattr(self.fleet, f"add_{role}")()
                 sig["replicas"] += 1
                 self.fleet._count("scale_ups")
+                self.fleet._count("respawns")
                 self._note("scale_up", role, replica=name,
                            reason="below_min")
                 acted.append(self.decisions[-1])

@@ -92,11 +92,15 @@ class Fleet:
         self._stats = {
             "submitted": 0, "completed": 0, "failed": 0,
             "handoffs": 0, "handoff_bytes": 0, "skipped_tokens": 0,
-            "handoff_drops": 0, "failovers": 0, "re_prefills": 0,
+            "handoff_drops": 0, "handoff_drops_recovered": 0,
+            "failovers": 0, "re_prefills": 0,
             "replica_deaths": 0, "scale_ups": 0, "scale_downs": 0,
-            "upgrades": 0,
+            "upgrades": 0, "respawns": 0,
         }
         self.ttfts: List[float] = []   # fleet-level submit→first-token
+        # first-failover → final-resolution, per disturbed request:
+        # the price of a casualty as the CALLER experiences it
+        self.failover_latencies: List[float] = []
         for _ in range(int(n_prefill)):
             self.add_prefill()
         for _ in range(int(n_decode)):
@@ -234,9 +238,33 @@ class Fleet:
         fut: Future = Future()
         with self._lock:
             self._stats["submitted"] += 1
+        fut.add_done_callback(self._bank_outcome)
         self._dispatch_prefill(req, fut, retries=0,
                                t_submit=time.perf_counter())
         return fut
+
+    def _bank_outcome(self, fut: Future) -> None:
+        """Per-request post-resolution accounting: the caller-visible
+        first-failover→resolution latency, and whether a dropped
+        handoff's request was recovered (resolved clean) rather than
+        failed."""
+        t0 = getattr(fut, "_failover_t0", None)
+        if t0 is not None:
+            with self._lock:
+                self.failover_latencies.append(time.perf_counter() - t0)
+        if getattr(fut, "_dropped", False):
+            try:
+                recovered = fut.exception() is None
+            except Exception:  # noqa: BLE001 — cancelled counts as lost
+                recovered = False
+            if recovered:
+                self._count("handoff_drops_recovered")
+
+    def _mark_failover(self, fut: Future) -> None:
+        # first disturbance only: the latency is failover→resolution as
+        # the caller experiences it, not per-hop
+        if not hasattr(fut, "_failover_t0"):
+            fut._failover_t0 = time.perf_counter()
 
     def infer(self, req: DecodeRequest,
               timeout: Optional[float] = None) -> GeneratedSequence:
@@ -305,6 +333,7 @@ class Fleet:
             elif isinstance(exc, ReplicaKilledError) \
                     and retries < self.max_retries:
                 self._count("failovers")
+                self._mark_failover(fut)
                 self._dispatch_prefill(req, fut, retries + 1, t_submit)
             else:
                 self._resolve(fut, error=exc)
@@ -314,6 +343,7 @@ class Fleet:
             # chaos: the payload is lost in transit — release the
             # destination's reservation and requeue for a fresh prefill
             self._count("handoff_drops")
+            fut._dropped = True
             self._release_on_dest(hd)
             if _flags._VALUES["FLAGS_observability"]:
                 _smetrics.record_fleet_event("handoff_drop")
@@ -340,6 +370,16 @@ class Fleet:
     def _dispatch_decode(self, hd: Handoff, req: DecodeRequest,
                          fut: Future, retries: int,
                          t_submit: float) -> None:
+        if hd.dest is None and hd.reroutable():
+            # an UNPLANNED handoff: no decode replica was up at export
+            # time, or a process-fleet prefill that plans no destination
+            # (the payload ships whole either way, skip_tokens == 0) —
+            # route it now.  This is placement, not a failover
+            with self._lock:
+                reps = dict(self._decode)
+            rep = self._pick(reps)
+            if rep is not None:
+                hd.dest = rep.name
         with self._lock:
             dest = self._decode.get(hd.dest) if hd.dest else None
         if dest is None or not (dest.alive and dest.routing
@@ -391,6 +431,7 @@ class Fleet:
         must re-prefill."""
         if count:
             self._count("failovers")
+            self._mark_failover(fut)
             if _flags._VALUES["FLAGS_observability"]:
                 _smetrics.record_fleet_event("failover", role="decode")
         if hd.reroutable():

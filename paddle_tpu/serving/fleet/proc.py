@@ -1,0 +1,679 @@
+"""Replicas as real OS processes behind the same `FleetReplica` seam.
+
+PR 15 split serving into prefill/decode replica classes but every
+replica stayed an in-process thread, so the banked ``lost_requests=0``
+invariant had only ever been proven against cooperative thread death.
+This module closes the gap:
+
+- **Entrypoint** — ``python -m paddle_tpu.serving.fleet.proc --role
+  {prefill,decode} --name N --artifact F [--master host:port]`` loads a
+  pickled artifact (params + DecodeConfig + per-role kwargs), builds
+  the real thread replica inside the child, joins the
+  ``ReplicaDirectory`` over ``RemoteMaster`` (heartbeats die WITH the
+  process — lease expiry is the second death detector), serves the
+  frame protocol, and prints ``SERVING <endpoint> <pid>``.
+- **Data plane** — every fleet verb (submit/collect, drain/resume,
+  swap_params, audit, shutdown) crosses the length-prefixed frame
+  sub-protocol (`elastic.rpc.FrameClient`/`FrameServer`): pickle
+  frames carry numpy, so a `Handoff`'s `SeqExport` payload and a
+  `GeneratedSequence`'s logits cross sockets byte-identical.  Replica-
+  side typed errors re-raise by NAME on the broker via the frame
+  plane's error registry.  ``submit`` is idempotent (client-minted
+  request id, server-side dedup) and ``collect`` is ack-based, so the
+  client's bounded-backoff retry can re-send either after a torn
+  response without duplicating or dropping work.
+- **`ProcReplica`** — the broker-side proxy implementing the
+  `FleetReplica` surface (`submit`→local Future, queue_depth, drain /
+  resume / quarantine / close / swap_params, health, a pool facade
+  backed by the ``audit`` verb), so `Fleet`/`FleetController`/
+  serve_bench run UNCHANGED over processes.  One collector thread per
+  replica drains finished futures; ANY transport failure marks the
+  replica dead and fails every in-flight future with
+  `ReplicaKilledError` — socket peers degrade typed, never hang.
+
+Chaos is now SIGKILL-grade: ``FAULT_SERVE_PROC_KILL=<name>`` makes the
+named child SIGKILL itself at its next batch start (no cleanup, no
+atexit — a vanished PID), and `ProcReplica.quarantine` SIGKILLs a live
+pid outright.  Cross-process handoffs ship the FULL payload
+(``skip_tokens == 0`` — prefix reservations stay an in-process
+optimization), which keeps them reroutable to any surviving decode
+replica; the fleet routes the unplanned destination at dispatch time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from ... import flags as _flags
+from ...elastic.rpc import FrameClient, FrameError, register_error, serve_frames
+from ...observability import flight as _flight
+from ...resilience import faultinject as _finject
+from .. import metrics as _smetrics
+from .handoff import Handoff, HandoffDropError
+from .replica import (
+    FleetQueueFullError,
+    ReplicaDrainingError,
+    ReplicaKilledError,
+)
+
+_log = logging.getLogger("paddle_tpu.serving.fleet")
+
+__all__ = ["ProcReplica", "ProcSpawner", "main"]
+
+# fleet-typed errors cross the frame plane by name (the registry lives
+# in elastic.rpc; registering here avoids an elastic→serving layering
+# inversion)
+for _cls in (ReplicaKilledError, ReplicaDrainingError,
+             FleetQueueFullError, HandoffDropError):
+    register_error(_cls)
+
+_TRANSPORT_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+# -- child side: the verb service -------------------------------------------
+
+class _ReplicaService:
+    """Frame-verb dispatcher wrapped around a real (thread) replica,
+    running INSIDE the replica process."""
+
+    def __init__(self, rep):
+        self.rep = rep
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Future] = {}
+        # rid -> ("ok", result) | ("err", exception): held until the
+        # broker ACKs, so a collect response lost mid-write re-delivers
+        self._done: Dict[str, Tuple] = {}
+
+    def dispatch(self, verb: str, **kwargs):
+        fn = getattr(self, f"v_{verb}", None)
+        if fn is None:
+            raise ValueError(f"unknown verb {verb!r}")
+        return fn(**kwargs)
+
+    def v_ping(self) -> Dict:
+        return {"pid": os.getpid(), "name": self.rep.name,
+                "role": self.rep.role}
+
+    def v_health(self) -> Dict:
+        h = dict(self.rep.health())
+        h["pid"] = os.getpid()
+        return h
+
+    def v_submit(self, rid: str, item) -> Dict:
+        with self._lock:
+            if rid in self._pending or rid in self._done:
+                return {"dup": True}  # idempotent retry after torn resp
+        fut = self.rep.submit(item)  # typed errors re-raise by name
+        with self._lock:
+            self._pending[rid] = fut
+        fut.add_done_callback(lambda f, rid=rid: self._finish(rid, f))
+        return {"queued": True}
+
+    def _finish(self, rid: str, fut: Future) -> None:
+        exc = fut.exception()
+        if exc is None:
+            entry = ("ok", fut.result())
+        else:
+            try:  # probe: an unpicklable exception must not tear collect
+                pickle.dumps(exc)
+            except Exception:  # noqa: BLE001 — degrade to name+message
+                exc = RuntimeError(f"{type(exc).__name__}: {exc}")
+            entry = ("err", exc)
+        with self._lock:
+            self._pending.pop(rid, None)
+            self._done[rid] = entry
+
+    def v_collect(self, ack=(), wait_s: float = 0.0) -> Dict:
+        """Ack-then-poll: drop the rids the broker safely resolved,
+        then return every finished-unacked entry (briefly blocking up
+        to `wait_s` when none are ready).  Piggybacks the health
+        snapshot so the broker's cached queue_depth/shed stay fresh
+        without extra round-trips."""
+        with self._lock:
+            for rid in ack:
+                self._done.pop(rid, None)
+        deadline = time.perf_counter() + max(0.0, float(wait_s))
+        while True:
+            with self._lock:
+                done = dict(self._done)
+            if done or time.perf_counter() >= deadline:
+                break
+            time.sleep(0.005)
+        return {"done": done, "health": self.rep.health()}
+
+    def v_begin_drain(self) -> Dict:
+        self.rep.begin_drain()
+        return {}
+
+    def v_drain(self, timeout_s: Optional[float] = None) -> Dict:
+        return {"drained": bool(self.rep.drain(timeout_s))}
+
+    def v_resume(self) -> Dict:
+        self.rep.resume()
+        return {}
+
+    def v_swap_params(self, params, timeout_s: float = 5.0) -> Dict:
+        self.rep.swap_params(params, timeout=timeout_s)
+        return {}
+
+    def v_audit(self) -> Dict:
+        """The fleet audit, server-side: clear the prefix cache (pinned
+        cache pages are a feature; pages nobody owns are a leak), then
+        report pool residency + invariants."""
+        rep = self.rep
+        if rep.cache is not None:
+            rep.cache.clear()
+        inv = rep.pool.check_invariants()
+        return {"used_pages": int(rep.pool.used_pages),
+                "ok": bool(inv["ok"])}
+
+    def v_shutdown(self, timeout_s: float = 10.0) -> Dict:
+        def _exit():
+            try:
+                self.rep.close(timeout_s)
+            finally:
+                os._exit(0)
+
+        threading.Thread(target=_exit, daemon=True).start()
+        return {"__close__": True}
+
+
+def _arm_proc_kill(rep) -> None:
+    """FAULT_SERVE_PROC_KILL: SIGKILL ourselves at the next batch start
+    — mid-prefill/mid-decode from the broker's perspective, since the
+    submits that built this batch already ACKed."""
+    if not os.environ.get("FAULT_SERVE_PROC_KILL"):
+        return
+    orig = rep._process
+
+    def chaos_process(batch):
+        if _finject.serve_proc_kill(rep.name):
+            _log.warning("replica %s: chaos SIGKILL (pid %d)",
+                         rep.name, os.getpid())
+            # let the submit responses that built this batch finish
+            # writing first: the kill must land mid-WORK (queued items
+            # ACKed, results never coming), not mid-handshake
+            time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGKILL)
+        orig(batch)
+
+    rep._process = chaos_process
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.fleet.proc",
+        description="one fleet replica as an OS process")
+    ap.add_argument("--role", required=True,
+                    choices=("prefill", "decode"))
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--artifact", required=True,
+                    help="pickle: {params, cfg, prefill: kwargs, "
+                         "decode: kwargs}")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--master", default=None,
+                    help="elastic master endpoint (host:port) to "
+                         "heartbeat through; omit for directory-less "
+                         "fleets")
+    ap.add_argument("--max-silence", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    with open(args.artifact, "rb") as f:
+        art = pickle.load(f)
+    from .replica import DecodeReplica, PrefillReplica
+
+    cls = PrefillReplica if args.role == "prefill" else DecodeReplica
+    rep = cls(args.name, art["params"], art["cfg"],
+              **art.get(args.role, {}))
+    _arm_proc_kill(rep)
+    service = _ReplicaService(rep)
+    srv = serve_frames(service.dispatch, host=args.host, port=args.port)
+    if args.master:
+        from ...elastic.rpc import RemoteMaster
+        from ..distributed import ReplicaDirectory
+
+        rep.join_directory(ReplicaDirectory(
+            RemoteMaster(args.master), max_silence_s=args.max_silence))
+    # the handshake line the spawner waits for — everything above
+    # (imports, pool allocation, directory join) already succeeded
+    print(f"SERVING {srv.endpoint} {os.getpid()}", flush=True)
+    try:
+        while rep.alive:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# -- broker side: spawner + proxy -------------------------------------------
+
+class _RemotePoolView:
+    """The `rep.pool` facade the fleet audit reads (`used_pages`,
+    `check_invariants`), backed by one `audit` verb per snapshot (the
+    cache-clear happens server-side).  A dead process's pool died with
+    it: the view reports empty/ok, matching the audit's thread-fleet
+    convention of skipping corpses."""
+
+    def __init__(self, rep: "ProcReplica"):
+        self._rep = rep
+
+    @property
+    def used_pages(self) -> int:
+        return self._rep._audit()["used_pages"]
+
+    def check_invariants(self) -> Dict:
+        return {"ok": self._rep._audit()["ok"]}
+
+
+class ProcReplica:
+    """Broker-side proxy for one replica process — the `FleetReplica`
+    seam over the frame plane.  `submit` mints a request id, registers
+    a local Future, and sends the item; ONE collector thread per
+    replica drains finished results back into those futures.  Any
+    transport-level failure (refused connect after retries, reset,
+    torn frame, timeout) marks the replica dead and fails every
+    pending future with `ReplicaKilledError` — the exact degradation
+    contract the thread fleet's chaos kill established, now proven
+    against a vanished PID."""
+
+    def __init__(self, name: str, role: str, proc: subprocess.Popen,
+                 endpoint: str, pid: int, spawner=None,
+                 call_timeout_s: float = 30.0,
+                 max_retries: int = 3):
+        self.name = name
+        self.role = role
+        self.proc = proc
+        self.endpoint = endpoint
+        self.pid = int(pid)
+        self.routing = True
+        self.directory = None
+        self.plan_handoff = None   # set by Fleet on prefill; unused —
+        # process prefills export unplanned (dest=None, full payload)
+        # and the fleet routes the handoff at dispatch time
+        self.cache = None          # audit clears the cache server-side
+        self.pool = _RemotePoolView(self)
+        self._spawner = spawner
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Future] = {}
+        self._acks: List[str] = []
+        self._next_rid = 0
+        self._alive = True
+        self._closed = False
+        self._draining = False
+        self._shed = 0
+        self._processed = 0
+        self._qdepth_remote = 0
+        self._audit_cache: Optional[Tuple[float, Dict]] = None
+        # separate connections: collect long-polls server-side, and a
+        # submit must never queue behind that wait
+        self._ctl = FrameClient(endpoint, timeout=call_timeout_s,
+                                max_retries=max_retries)
+        self._col = FrameClient(endpoint, timeout=call_timeout_s,
+                                max_retries=max_retries)
+        self._collector = threading.Thread(
+            target=self._collect_loop, daemon=True,
+            name=f"procfleet-{name}-collect")
+        self._collector.start()
+
+    # -- liveness surface ----------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queue_depth(self) -> int:
+        # outstanding = submitted-not-collected on that process; the
+        # local view IS the broker's routing signal, no RPC needed
+        with self._lock:
+            return len(self._pending)
+
+    def health(self) -> Dict:
+        if not self._alive:
+            return {"state": "BROKEN", "role": self.role,
+                    "queue_depth": 0, "alive": False,
+                    "shed": self._shed, "processed": self._processed,
+                    "errors": 0, "pid": self.pid}
+        try:
+            return self._ctl.call("health", timeout=5.0)
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"health probe failed: {e}")
+            return self.health()
+
+    def join_directory(self, directory) -> None:
+        # the process registered ITSELF at startup (--master): its
+        # heartbeats must die with the pid, not with the broker.  Keep
+        # the handle so fleet-side deregistration works
+        self.directory = directory
+
+    # -- request path ---------------------------------------------------
+
+    def submit(self, item) -> Future:
+        with self._lock:
+            if not self._alive:
+                raise ReplicaKilledError(
+                    f"replica {self.name} (pid {self.pid}) is dead")
+            if self._draining or self._closed or not self.routing:
+                raise ReplicaDrainingError(
+                    f"replica {self.name} is draining")
+            rid = f"{self.name}-{self._next_rid}"
+            self._next_rid += 1
+            fut: Future = Future()
+            self._pending[rid] = fut
+        try:
+            self._ctl.call("submit", rid=rid, item=item)
+        except _TRANSPORT_ERRORS as e:
+            with self._lock:
+                self._pending.pop(rid, None)
+            self._mark_dead(f"submit transport failure: {e}")
+            raise ReplicaKilledError(
+                f"replica {self.name} (pid {self.pid}) died during "
+                f"submit: {e}") from e
+        except Exception as e:
+            # replica-side typed rejection (draining/full/ValueError),
+            # re-raised by name: the item never queued there
+            with self._lock:
+                self._pending.pop(rid, None)
+                if isinstance(e, FleetQueueFullError):
+                    self._shed += 1
+            raise
+        return fut
+
+    def _collect_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed or not self._alive:
+                    return
+                ack, self._acks = self._acks, []
+            try:
+                resp = self._col.call("collect", ack=ack, wait_s=0.2,
+                                      timeout=15.0)
+            except _TRANSPORT_ERRORS as e:
+                self._mark_dead(f"collect transport failure: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 — a verb-level error
+                # here means a protocol bug, not a death; log and retry
+                _log.warning("replica %s collect error: %s",
+                             self.name, e)
+                time.sleep(0.05)
+                continue
+            h = resp.get("health") or {}
+            with self._lock:
+                self._shed = int(h.get("shed", self._shed))
+                self._processed = int(h.get("processed",
+                                            self._processed))
+                self._qdepth_remote = int(h.get("queue_depth", 0))
+            for rid, entry in (resp.get("done") or {}).items():
+                with self._lock:
+                    fut = self._pending.pop(rid, None)
+                    self._acks.append(rid)
+                if fut is None:
+                    continue
+                if fut.set_running_or_notify_cancel():
+                    if entry[0] == "ok":
+                        fut.set_result(entry[1])
+                    else:
+                        fut.set_exception(entry[1])
+
+    def _mark_dead(self, reason: str) -> None:
+        with self._lock:
+            if not self._alive:
+                return
+            self._alive = False
+            leftovers, self._pending = self._pending, {}
+        # routing stays ON, matching the thread replica's _die: the
+        # controller reads alive=False + routing=True as a fresh corpse
+        # and quarantines it (which is what turns routing off).  The
+        # dispatch path never places on a dead replica regardless.
+        level = logging.INFO if reason == "closed" and not leftovers \
+            else logging.WARNING
+        _log.log(
+            level,
+            "replica %s (pid %d) dead: %s; failing %d in-flight items "
+            "over", self.name, self.pid, reason, len(leftovers))
+        err = ReplicaKilledError(
+            f"replica {self.name} (pid {self.pid}) died: {reason}")
+        for fut in leftovers.values():
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(err)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_event("proc_exit", role=self.role,
+                                         pid=self.pid)
+            _flight.default_flight().record(
+                "proc_exit", replica=self.name, role=self.role,
+                pid=self.pid, reason=reason)
+
+    # -- drain / upgrade / stop ----------------------------------------
+
+    def begin_drain(self) -> None:
+        self._draining = True
+        try:
+            self._ctl.call("begin_drain")
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"begin_drain transport failure: {e}")
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        self.begin_drain()
+        if not self._alive:
+            return True  # nothing queued survives a dead process
+        t = 30.0 if timeout is None else float(timeout)
+        try:
+            resp = self._ctl.call("drain", timeout=t + 10.0,
+                                  timeout_s=t)
+            drained = bool(resp["drained"])
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"drain transport failure: {e}")
+            return True
+        if not drained:
+            return False
+        # drained server-side; wait for the collector to deliver the
+        # last results so the caller sees resolved futures
+        deadline = time.perf_counter() + t
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if not self._pending or not self._alive:
+                    return True
+            time.sleep(0.01)
+        return not self._pending
+
+    def resume(self) -> None:
+        try:
+            self._ctl.call("resume")
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"resume transport failure: {e}")
+            return
+        self._draining = False
+
+    def swap_params(self, new_params, timeout: float = 5.0) -> None:
+        self._ctl.call("swap_params", params=new_params,
+                       timeout=float(timeout) + 30.0,
+                       timeout_s=timeout)
+
+    def _audit(self) -> Dict:
+        with self._lock:
+            cached = self._audit_cache
+            if cached is not None \
+                    and time.perf_counter() - cached[0] < 0.2:
+                return cached[1]
+        if not self._alive:
+            return {"used_pages": 0, "ok": True}
+        try:
+            out = self._ctl.call("audit", timeout=10.0)
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"audit transport failure: {e}")
+            return {"used_pages": 0, "ok": True}
+        with self._lock:
+            self._audit_cache = (time.perf_counter(), out)
+        return out
+
+    def reserve_prefix(self, prompt):
+        # no cross-process prefix reservation: the payload ships whole,
+        # which is exactly what keeps process handoffs reroutable
+        return None
+
+    def quarantine(self) -> None:
+        """SIGKILL-grade quarantine: fail in-flight work typed, then
+        make sure the pid is actually gone (a flapping process must
+        not beat its ghost lease back to life)."""
+        self.routing = False
+        self._mark_dead("quarantined")
+        if self.proc is not None and self.proc.poll() is None:
+            if _flags._VALUES["FLAGS_observability"]:
+                _smetrics.record_fleet_event("proc_kill", role=self.role,
+                                             pid=self.pid)
+                _flight.default_flight().record(
+                    "proc_kill", replica=self.name, role=self.role,
+                    pid=self.pid)
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+            self.proc.wait(timeout=10.0)
+        self._ctl.close()
+        self._col.close()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self.routing = False
+        t = 10.0 if timeout is None else float(timeout)
+        deadline = time.perf_counter() + t
+        # let queued work finish and its results flow back first
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if not self._pending or not self._alive:
+                    break
+            time.sleep(0.02)
+        if self._alive:
+            try:
+                self._ctl.call("shutdown", retry=False, timeout_s=t)
+            except Exception:  # noqa: BLE001 — already gone is fine
+                pass
+        with self._lock:
+            self._closed = True
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=max(1.0, t))
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+        self._mark_dead("closed")
+        self._ctl.close()
+        self._col.close()
+
+
+class ProcSpawner:
+    """Factory for replica processes, pluggable straight into
+    ``Fleet(spawner.prefill, spawner.decode, ...)``.  Writes the model
+    artifact (params + config + per-role kwargs) once; each spawn
+    launches the entrypoint, waits for the ``SERVING <endpoint> <pid>``
+    handshake (child stderr goes to a per-replica log file for
+    post-mortems), and wraps the process in a `ProcReplica`."""
+
+    def __init__(self, params, cfg, prefill_kwargs: Optional[Dict] = None,
+                 decode_kwargs: Optional[Dict] = None,
+                 master_endpoint: Optional[str] = None,
+                 startup_timeout_s: float = 120.0,
+                 call_timeout_s: float = 30.0, max_retries: int = 3,
+                 workdir: Optional[str] = None):
+        self.dir = workdir or tempfile.mkdtemp(prefix="paddle_procfleet_")
+        self.master_endpoint = master_endpoint
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.call_timeout_s = float(call_timeout_s)
+        self.max_retries = int(max_retries)
+        self.artifact_path = os.path.join(self.dir, "artifact.pkl")
+        with open(self.artifact_path, "wb") as f:
+            pickle.dump({"params": params, "cfg": cfg,
+                         "prefill": dict(prefill_kwargs or {}),
+                         "decode": dict(decode_kwargs or {})}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        self.replicas: List[ProcReplica] = []
+
+    def prefill(self, name: str) -> ProcReplica:
+        return self._spawn("prefill", name)
+
+    def decode(self, name: str) -> ProcReplica:
+        return self._spawn("decode", name)
+
+    def _spawn(self, role: str, name: str) -> ProcReplica:
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.fleet.proc",
+               "--role", role, "--name", name,
+               "--artifact", self.artifact_path]
+        if self.master_endpoint:
+            cmd += ["--master", self.master_endpoint]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log_path = os.path.join(self.dir, f"{name}.log")
+        logf = open(log_path, "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=logf, text=True)
+        logf.close()  # the child holds the fd
+        line_box: List[str] = []
+        done = threading.Event()
+
+        def _read():
+            for line in proc.stdout:
+                if line.startswith("SERVING "):
+                    line_box.append(line.strip())
+                    done.set()
+                    break
+            done.set()
+            # keep draining so the child never blocks on a full pipe
+            for _ in proc.stdout:
+                pass
+
+        threading.Thread(target=_read, daemon=True,
+                         name=f"procfleet-{name}-stdout").start()
+        if not done.wait(self.startup_timeout_s) or not line_box:
+            proc.kill()
+            tail = ""
+            try:
+                with open(log_path) as f:
+                    tail = "".join(f.readlines()[-20:])
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"replica process {name} failed to start "
+                f"(no SERVING handshake within "
+                f"{self.startup_timeout_s}s)\n{tail}")
+        _, endpoint, pid = line_box[0].split()
+        rep = ProcReplica(name, role, proc, endpoint, int(pid),
+                          spawner=self,
+                          call_timeout_s=self.call_timeout_s,
+                          max_retries=self.max_retries)
+        self.replicas.append(rep)
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_fleet_event("proc_spawn", role=role,
+                                         pid=int(pid))
+            _flight.default_flight().record(
+                "proc_spawn", replica=name, role=role, pid=int(pid),
+                endpoint=endpoint)
+        return rep
+
+    def close(self) -> None:
+        """Kill any replica process still running (normal shutdown goes
+        through `ProcReplica.close`; this is the safety net)."""
+        for rep in self.replicas:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.kill()
+                try:
+                    rep.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+
+
+if __name__ == "__main__":  # pragma: no cover — subprocess entrypoint
+    sys.exit(main())

@@ -48,13 +48,11 @@ import numpy as np
 
 from ... import flags as _flags
 from ...resilience import faultinject as _finject
-from ...resilience.sentinel import rows_finite
-from .. import metrics as _smetrics
+from .. import prefill_sched as _psched
 from ..generate import (
     ContinuousBatchingLoop,
     DecodeConfig,
     DecodeRequest,
-    NonFiniteSequenceError,
     chunk_prefill_step,
     prefill_step,
 )
@@ -476,7 +474,6 @@ class PrefillReplica(FleetReplica):
             raise
 
     def _prefill_jobs(self, group: List, jobs: List[_Job]) -> None:
-        obs_on = _flags._VALUES["FLAGS_observability"]
         for req, fut in group:
             seq_id = self._next_seq
             self._next_seq += 1
@@ -489,37 +486,28 @@ class PrefillReplica(FleetReplica):
                              matched=matched))
 
         def quarantine(sel: Sequence[_Job], logits, step_idx: int):
-            """Evict non-finite rows — same per-sequence blast radius
-            as the monolithic loop's."""
-            logits = _finject.serve_nan_rows(
-                [j.seq_id for j in sel], step_idx, logits)
-            finite = np.asarray(rows_finite(logits))
-            logits = np.asarray(logits)
-            for i, j in enumerate(sel):
-                if finite[i]:
-                    continue
-                err = NonFiniteSequenceError(j.seq_id, step_idx)
-                self.pool.scrub_seq_pages(j.seq_id)
-                self.pool.free_seq(j.seq_id)
-                if self.cache is not None:
-                    if j.matched:
-                        # the poisoned sequence read cached pages:
-                        # presume the chain bad and invalidate it
-                        self.cache.quarantine_seq(j.seq_id)
-                    else:
-                        self.cache.forget_seq(j.seq_id)
+            """Evict non-finite rows through the shared blast radius
+            (prefill_sched.evict_nonfinite — the monolithic loop runs
+            the SAME code, so the split cannot drift); failing the
+            job's future typed is this replica's own bookkeeping."""
+
+            def on_evict(i: int, err: BaseException, _now: float) -> None:
+                j = sel[i]
                 self.quarantined += 1
                 jobs.remove(j)
-                if obs_on:
-                    _smetrics.record_sequence("quarantined")
                 if j.fut.set_running_or_notify_cancel():
                     j.fut.set_exception(err)
+
+            logits, finite, _ = _psched.evict_nonfinite(
+                self.pool, self.cache, [j.seq_id for j in sel],
+                [j.matched for j in sel], logits, step_idx, on_evict)
             return logits, finite
 
         # whole-prompt fast path for uncached prompts with no chunk
         # cap; chunk steps for cache-hit tails and capped prompts —
         # the monolithic loop's exact split, so logits match it
-        whole = [j for j in jobs if j.pos == 0 and not self._chunk]
+        whole = [j for j in jobs
+                 if _psched.whole_eligible(j.pos, self._chunk)]
         if whole:
             step_idx = self.steps
             logits = prefill_step(
@@ -536,19 +524,10 @@ class PrefillReplica(FleetReplica):
             sel = [j for j in jobs if j.pos < len(j.req.prompt)]
             if not sel:
                 break
-            budget = self._chunk or sum(
-                len(j.req.prompt) - j.pos for j in sel)
-            use: List[_Job] = []
-            chunks: List[List[int]] = []
-            starts: List[int] = []
-            for j in sel:
-                if budget <= 0:
-                    break
-                n = min(len(j.req.prompt) - j.pos, budget)
-                use.append(j)
-                chunks.append(list(j.req.prompt[j.pos:j.pos + n]))
-                starts.append(j.pos)
-                budget -= n
+            idx, chunks, starts = _psched.plan_chunks(
+                [j.req.prompt for j in sel], [j.pos for j in sel],
+                self._chunk)
+            use = [sel[i] for i in idx]
             step_idx = self.steps
             logits = chunk_prefill_step(
                 self.params, self.cfg, self.pool,

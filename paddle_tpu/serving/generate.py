@@ -122,9 +122,8 @@ from ..kernels.paged_attention import (
 from ..observability import flight as _flight
 from ..observability import requesttrace as _rtrace
 from ..models.transformer import _sinusoid_table
-from ..resilience import faultinject as _finject
-from ..resilience.sentinel import rows_finite
 from . import metrics as _smetrics
+from . import prefill_sched as _psched
 from .kvcache import KVCachePool
 from .sampling import (
     SamplingParams,
@@ -166,6 +165,12 @@ class NonFiniteSequenceError(RuntimeError):
             f"sequence {seq_id} produced non-finite logits at loop step "
             f"{step}; it was evicted from the batch (pages freed) and "
             "its batch-mates decoded on")
+
+    def __reduce__(self):
+        # default Exception pickling replays args=(message,), which does
+        # not match this two-arg __init__; the process fleet ships these
+        # across sockets inside GeneratedSequence.error
+        return (type(self), (self.seq_id, self.step))
 
 
 @dataclasses.dataclass
@@ -866,51 +871,29 @@ class ContinuousBatchingLoop:
 
         def quarantine(batch: List[_Active], logits,
                        step_idx: int) -> Tuple[np.ndarray, set, float]:
-            """Evict every non-finite row of this step's logits; returns
-            (logits materialized on host — a poisoned copy when the
-            chaos knob fired — the surviving row indices, and the
-            post-sync step-end timestamp).  `logits` arrives as the
-            step's DEVICE output: the ONE fused jitted [B]-bool scan
-            runs on it before the single host materialization, so the
-            scan never re-uploads a host array and the whole batch
-            syncs as one vector, never per row."""
+            """Evict every non-finite row of this step's logits through
+            the shared blast radius (prefill_sched.evict_nonfinite:
+            chaos poisoning, the ONE fused [B]-bool scan before the
+            single host materialization, page scrub+free, prefix-chain
+            quarantine, the quarantined-sequence metric); what is THIS
+            loop's alone — batch removal, the result's error/timestamps,
+            drafter release, reservation accounting, trace finish —
+            rides the on_evict callback.  Returns (host logits, the
+            surviving row indices, the post-sync step-end timestamp)."""
             nonlocal reserved_pages
-            logits = _finject.serve_nan_rows(
-                [a.seq_id for a in batch], step_idx, logits)
-            finite = np.asarray(rows_finite(logits))
-            logits = np.asarray(logits)
-            now = time.perf_counter()  # after the sync: true step end
-            if finite.all():
-                return logits, set(range(len(batch))), now
-            for i, a in enumerate(batch):
-                if finite[i]:
-                    continue
+
+            def on_evict(i: int, err: BaseException, now: float) -> None:
+                nonlocal reserved_pages
+                a = batch[i]
                 active.remove(a)
-                err = NonFiniteSequenceError(a.seq_id, step_idx)
                 err.trace_id = a.result.trace_id
                 a.result.error = err
                 a.result.finished_at = now
-                # poison containment: the quarantined sequence may have
-                # written non-finite K/V — zero its private pages so
-                # the free list never recycles NaN content (0 * NaN
-                # would poison a later reader through masked weights)
-                self.pool.scrub_seq_pages(a.seq_id)
-                self.pool.free_seq(a.seq_id)
                 if getattr(self.drafter, "stateful", False):
                     self.drafter.release(a.seq_id)
                 reserved_pages -= a.charged
-                if self.prefix_cache is not None:
-                    if a.matched:
-                        # the sequence read cached pages: presume the
-                        # chain poisoned and drop it (chaos:
-                        # FAULT_SERVE_PREFIX_CORRUPT) so the corruption
-                        # cannot be served to the next hit
-                        self.prefix_cache.quarantine_seq(a.seq_id)
-                    else:
-                        self.prefix_cache.forget_seq(a.seq_id)
                 self.quarantined += 1
                 if obs_on:
-                    _smetrics.record_sequence("quarantined")
                     _flight.default_flight().record(
                         "quarantine", seq_id=a.seq_id, step=step_idx,
                         trace_id=a.result.trace_id)
@@ -927,6 +910,11 @@ class ContinuousBatchingLoop:
                             a.result.ttft_s,
                             trace_id=(a.result.trace_id if kept
                                       else None))
+
+            logits, finite, now = _psched.evict_nonfinite(
+                self.pool, self.prefix_cache,
+                [a.seq_id for a in batch], [a.matched for a in batch],
+                logits, step_idx, on_evict)
             return logits, {i for i in range(len(batch)) if finite[i]}, now
 
         def emit(a: _Active, row: np.ndarray, t0: float, now: float,
@@ -1078,8 +1066,8 @@ class ContinuousBatchingLoop:
                     # an SPMD program, token-fed decode steps — the
                     # program's prefill starts at position 0)
                     a.whole = (hd is None and self.prefill == "batched"
-                               and matched == 0
-                               and not self._prefill_chunk)
+                               and _psched.whole_eligible(
+                                   matched, self._prefill_chunk))
                     a.chunk_mode = (hd is None
                                     and self.prefill == "batched"
                                     and not a.whole
@@ -1182,19 +1170,10 @@ class ContinuousBatchingLoop:
                 if chunkers and (not decodable or self._prefer_prefill):
                     t0 = time.perf_counter()
                     step_idx = self.steps
-                    budget = self._prefill_chunk or sum(
-                        len(a.result.prompt) - a.pos for a in chunkers)
-                    sel: List[_Active] = []
-                    chunks: List[List[int]] = []
-                    starts: List[int] = []
-                    for a in chunkers:
-                        if budget <= 0:
-                            break
-                        n = min(len(a.result.prompt) - a.pos, budget)
-                        sel.append(a)
-                        chunks.append(a.result.prompt[a.pos:a.pos + n])
-                        starts.append(a.pos)
-                        budget -= n
+                    idx, chunks, starts = _psched.plan_chunks(
+                        [a.result.prompt for a in chunkers],
+                        [a.pos for a in chunkers], self._prefill_chunk)
+                    sel = [chunkers[i] for i in idx]
                     logits = chunk_prefill_step(
                         self.params, self.cfg, self.pool,
                         [a.seq_id for a in sel], chunks, starts)

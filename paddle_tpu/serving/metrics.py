@@ -349,17 +349,24 @@ def record_replica_health(replica: str, state: str,
     ).set(queue_depth, replica=replica)
 
 
-def record_fleet_event(event: str, role: str = "-", n: int = 1) -> None:
+def record_fleet_event(event: str, role: str = "-", n: int = 1,
+                       pid: Optional[int] = None) -> None:
     """One fleet lifecycle event: ``scale_up`` / ``scale_down`` (the
     autoscaler acted), ``handoff`` (a prefilled sequence moved to a
     decode replica), ``handoff_drop`` (lost in transit, requeued),
     ``upgrade`` (a replica's weights were swapped under drain),
-    ``replica_dead`` (a silent/killed replica was quarantined), or
-    ``failover`` (a request rerouted off a dead replica)."""
+    ``replica_dead`` (a silent/killed replica was quarantined),
+    ``failover`` (a request rerouted off a dead replica), or the
+    process-fleet trio ``proc_spawn`` / ``proc_exit`` / ``proc_kill``
+    (which carry the replica's OS ``pid`` label — the post-mortem key
+    that joins fleet metrics to kernel/oom logs)."""
+    labels = {"event": event, "role": role}
+    if pid is not None:
+        labels["pid"] = str(int(pid))
     default_registry().counter(
         "paddle_tpu_serving_fleet_events",
         "disaggregated-fleet lifecycle events by replica class",
-    ).inc(n, event=event, role=role)
+    ).inc(n, **labels)
 
 
 def record_handoff_bytes(nbytes: int) -> None:

@@ -1,0 +1,107 @@
+"""Shared prefill scheduler: one arithmetic for both prefill consumers.
+
+`ContinuousBatchingLoop.run` (the monolithic loop) and
+`PrefillReplica._prefill_jobs` (the disaggregated fleet's prefill side)
+each re-implemented the same three decisions — when a prompt takes the
+one-pass whole-prompt fast path, how a chunk step's token budget packs
+over still-prefilling sequences, and the per-sequence blast radius when
+a step's logits come back non-finite.  ~90 lines of drift that the
+parity matrix could only detect after the fact; extracting them here
+makes the split impossible to diverge.  The callers keep what is
+genuinely theirs: step invocation (program/force arms), counters, and
+what "evict" means for their bookkeeping (an `_Active` leaving the
+batch vs a `_Job`'s future failing typed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from ..resilience import faultinject as _finject
+from ..resilience.sentinel import rows_finite
+from . import metrics as _smetrics
+
+__all__ = ["whole_eligible", "plan_chunks", "evict_nonfinite"]
+
+
+def whole_eligible(matched: int, chunk_cap: int) -> bool:
+    """True when a prompt takes the one-pass whole-prompt prefill fast
+    path: nothing is cached (a cached-prefix tail must chunk from its
+    match offset) and no chunk cap binds."""
+    return matched == 0 and not chunk_cap
+
+
+def plan_chunks(prompts: Sequence[Sequence[int]],
+                positions: Sequence[int], chunk_cap: int,
+                ) -> Tuple[List[int], List[List[int]], List[int]]:
+    """Pack one chunk step's token budget over still-prefilling
+    sequences, FIFO, clamped per sequence.  A zero/None cap means one
+    uncapped step that finishes every prompt.  Returns ``(idx, chunks,
+    starts)`` where ``idx`` indexes into the caller's selection so it
+    can map rows back to its own records."""
+    budget = chunk_cap or sum(
+        len(p) - pos for p, pos in zip(prompts, positions))
+    idx: List[int] = []
+    chunks: List[List[int]] = []
+    starts: List[int] = []
+    for i, (prompt, pos) in enumerate(zip(prompts, positions)):
+        if budget <= 0:
+            break
+        n = min(len(prompt) - pos, budget)
+        idx.append(i)
+        chunks.append(list(prompt[pos:pos + n]))
+        starts.append(pos)
+        budget -= n
+    return idx, chunks, starts
+
+
+def evict_nonfinite(pool, cache, seq_ids: Sequence[int],
+                    matched: Sequence[int], logits, step_idx: int,
+                    on_evict: Callable[[int, BaseException, float], None],
+                    ) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Evict every non-finite row of one step's logits — the shared
+    per-sequence quarantine blast radius.  `logits` arrives as the
+    step's DEVICE output: the chaos knob (FAULT_SERVE_NAN_SEQ) poisons
+    it first, then the ONE fused jitted [B]-bool scan runs before the
+    single host materialization, so the scan never re-uploads a host
+    array and the whole batch syncs as one vector, never per row.
+
+    For each poisoned row i: the sequence's private pages are scrubbed
+    (zeroed — the free list must never recycle NaN content) and freed,
+    its prefix-cache chain is quarantined when it READ cached pages
+    (``matched[i]``, presume the chain poisoned) or merely forgotten
+    otherwise, the quarantined-sequence metric lands, and the caller's
+    ``on_evict(i, err, now)`` does its own bookkeeping (remove from
+    batch / fail the future).
+
+    Returns ``(host logits, finite [B] bool mask, post-sync step-end
+    timestamp)``.
+    """
+    logits = _finject.serve_nan_rows(list(seq_ids), step_idx, logits)
+    finite = np.asarray(rows_finite(logits))
+    logits = np.asarray(logits)
+    now = time.perf_counter()  # after the sync: true step end
+    if finite.all():
+        return logits, finite, now
+    from .generate import NonFiniteSequenceError  # circular at import time
+
+    obs_on = _flags._VALUES["FLAGS_observability"]
+    for i, sid in enumerate(seq_ids):
+        if finite[i]:
+            continue
+        err = NonFiniteSequenceError(int(sid), step_idx)
+        pool.scrub_seq_pages(sid)
+        pool.free_seq(sid)
+        if cache is not None:
+            if matched[i]:
+                cache.quarantine_seq(sid)
+            else:
+                cache.forget_seq(sid)
+        if obs_on:
+            _smetrics.record_sequence("quarantined")
+        on_evict(i, err, now)
+    return logits, finite, now

@@ -1,0 +1,392 @@
+"""Process-level fleet (ISSUE 17): replicas as real OS processes.
+
+Three layers of contract, cheapest first:
+
+- **Framed data plane** — a `SeqExport` (fp32 and int8-with-scales)
+  survives the `FrameServer`/`FrameClient` pickle round-trip
+  byte-identical; pool-geometry errors re-raise BY NAME across the
+  socket; a response torn mid-frame (FAULT_RPC_TRUNCATE_ONCE) or a
+  dropped call (FAULT_RPC_DROP_ONCE) surfaces as a typed retryable
+  `ConnectionError` that the bounded-backoff retry absorbs — never a
+  hang, never a partial-pickle ValueError.
+- **SIGKILL e2e (tier-1, small shapes)** — a 2+2 process fleet loses
+  one replica to a real SIGKILL on a live pid mid-work and another to
+  an external `os.kill`; every request completes token-identical to a
+  thread-fleet oracle, `lost_requests=0`, the casualty is quarantined
+  and respawned by the controller, both audits come back clean.
+- **Full storm (slow/chaos)** — kills x handoff drops x a rolling
+  upgrade under sustained load.
+"""
+
+import os
+import pickle
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.elastic.rpc import (
+    FrameClient,
+    FrameError,
+    RemoteMaster,
+    serve_frames,
+    serve_master,
+)
+from paddle_tpu.elastic.master import InMemStore, MasterService
+from paddle_tpu.resilience import faultinject
+from paddle_tpu.serving import DecodeConfig, DecodeRequest, init_decode_params
+from paddle_tpu.serving.distributed import ReplicaDirectory
+from paddle_tpu.serving.fleet import (
+    DecodeReplica,
+    Fleet,
+    FleetController,
+    PrefillReplica,
+    ProcSpawner,
+)
+from paddle_tpu.serving.kvcache import KVCachePool
+
+
+# -- the framed data plane -------------------------------------------------
+
+def _filled_pool(dtype: str, num_pages: int = 8, page_size: int = 4):
+    """A tiny pool with one 10-token sequence whose pages hold known
+    content (written straight into the page arrays — the round-trip
+    contract is about bytes on the wire, not the prefill math)."""
+    import jax.numpy as jnp
+
+    pool = KVCachePool(num_pages=num_pages, page_size=page_size,
+                       num_layers=2, num_heads=2, head_dim=4,
+                       dtype=dtype)
+    pool.allocate(7)
+    pool.append_tokens([7], [10])
+    rng = np.random.RandomState(0)
+    shape = pool.k_pages.shape
+    if dtype == "int8":
+        k = rng.randint(-128, 128, size=shape).astype(np.int8)
+        v = rng.randint(-128, 128, size=shape).astype(np.int8)
+        pool.k_scales[:] = rng.rand(*pool.k_scales.shape)
+        pool.v_scales[:] = rng.rand(*pool.v_scales.shape)
+    else:
+        k = rng.standard_normal(shape).astype(np.float32)
+        v = rng.standard_normal(shape).astype(np.float32)
+    pool.k_pages = jnp.asarray(k)
+    pool.v_pages = jnp.asarray(v)
+    return pool
+
+
+def _echo_dispatch(verb, **kw):
+    if verb == "echo":
+        return kw["payload"]
+    raise ValueError(f"unknown verb {verb!r}")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_seqexport_survives_frame_roundtrip_byte_identical(dtype):
+    """The handoff payload crosses a REAL socket unchanged: every page
+    byte, every dtype, every int8 scale."""
+    pool = _filled_pool(dtype)
+    exp = pool.export_seq(7)
+    srv = serve_frames(_echo_dispatch)
+    try:
+        cli = FrameClient(srv.endpoint)
+        back = cli.call("echo", payload=exp)
+        cli.close()
+    finally:
+        srv.shutdown()
+    assert back.seq_id == exp.seq_id and back.length == exp.length
+    assert back.k.dtype == exp.k.dtype and back.v.dtype == exp.v.dtype
+    assert back.k.tobytes() == exp.k.tobytes()
+    assert back.v.tobytes() == exp.v.tobytes()
+    if dtype == "int8":
+        assert back.k_scales is not None
+        assert back.k_scales.tobytes() == exp.k_scales.tobytes()
+        assert back.v_scales.tobytes() == exp.v_scales.tobytes()
+    else:
+        assert back.k_scales is None and back.v_scales is None
+    # and the round-tripped payload is admissible: import into a
+    # geometry-matched pool reproduces the content
+    dst = KVCachePool(num_pages=8, page_size=4, num_layers=2,
+                      num_heads=2, head_dim=4, dtype=dtype)
+    dst.allocate(7)
+    dst.import_seq(back, seq_id=7)
+    assert dst._tables[7].length == exp.length
+
+
+def test_geometry_mismatch_reraises_by_name_across_socket():
+    """A destination pool with the wrong page_size must reject the
+    import with the SAME typed ValueError the in-process path raises —
+    re-raised by name on the client side of the socket."""
+    pool = _filled_pool("float32", page_size=4)
+    exp = pool.export_seq(7)
+    dst = KVCachePool(num_pages=8, page_size=8, num_layers=2,
+                      num_heads=2, head_dim=4, dtype="float32")
+
+    dst.allocate(7)
+
+    def dispatch(verb, **kw):
+        if verb == "imp":
+            dst.import_seq(kw["payload"], seq_id=7)
+            return {}
+        raise ValueError(f"unknown verb {verb!r}")
+
+    srv = serve_frames(dispatch)
+    try:
+        cli = FrameClient(srv.endpoint)
+        with pytest.raises(ValueError, match="pool geometry mismatch"):
+            cli.call("imp", payload=exp, retry=False)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_frame_truncate_mid_response_is_typed_and_retried(monkeypatch):
+    """FAULT_RPC_TRUNCATE_ONCE tears one response mid-frame: the
+    client must see a typed retryable ConnectionError (FrameError) —
+    not a partial-pickle crash, not a hang — and the bounded-backoff
+    retry must complete the call."""
+    monkeypatch.setenv("FAULT_RPC_TRUNCATE_ONCE", "1")
+    faultinject.reset()
+    pool = _filled_pool("float32")
+    exp = pool.export_seq(7)
+    srv = serve_frames(_echo_dispatch)
+    try:
+        cli = FrameClient(srv.endpoint)
+        back = cli.call("echo", payload=exp)
+        assert back.k.tobytes() == exp.k.tobytes()
+        assert "rpc_truncate" in faultinject.fired
+        assert cli.last_call_retries >= 1
+        assert cli.retry_stats["retries"] >= 1
+        cli.close()
+    finally:
+        srv.shutdown()
+        faultinject.reset()
+
+
+def test_frame_drop_once_absorbed_by_retry(monkeypatch):
+    monkeypatch.setenv("FAULT_RPC_DROP_ONCE", "echo")
+    faultinject.reset()
+    srv = serve_frames(_echo_dispatch)
+    try:
+        cli = FrameClient(srv.endpoint)
+        assert cli.call("echo", payload=41) == 41
+        assert cli.last_call_retries >= 1
+        cli.close()
+    finally:
+        srv.shutdown()
+        faultinject.reset()
+
+
+def test_frame_truncate_without_retry_raises_frame_error(monkeypatch):
+    monkeypatch.setenv("FAULT_RPC_TRUNCATE_ONCE", "1")
+    faultinject.reset()
+    srv = serve_frames(_echo_dispatch)
+    try:
+        cli = FrameClient(srv.endpoint)
+        with pytest.raises(FrameError):
+            cli.call("echo", payload=1, retry=False)
+        cli.close()
+    finally:
+        srv.shutdown()
+        faultinject.reset()
+
+
+def test_master_line_protocol_truncate_is_typed_and_retried(monkeypatch):
+    """The SAME torn-write fault against the line-JSON master plane: a
+    half-written response must surface as a typed retryable error (no
+    partial-JSON ValueError) and RemoteMaster's retry must absorb it."""
+    monkeypatch.setenv("FAULT_RPC_TRUNCATE_ONCE", "1")
+    faultinject.reset()
+    svc = MasterService(InMemStore(), failure_max=7)
+    srv = serve_master(svc, port=0)
+    try:
+        m = RemoteMaster(srv.endpoint)
+        assert m.failure_max == 7
+        assert "rpc_truncate" in faultinject.fired
+        assert m.last_call_retries >= 1
+    finally:
+        srv.shutdown()
+        faultinject.reset()
+
+
+def test_exceptions_survive_pickling():
+    """Process fleets ship typed errors inside results — every custom
+    __init__ signature must round-trip (NonFiniteSequenceError's
+    two-arg constructor broke default exception pickling)."""
+    from paddle_tpu.serving.generate import NonFiniteSequenceError
+
+    err = NonFiniteSequenceError(3, 17)
+    back = pickle.loads(pickle.dumps(err))
+    assert isinstance(back, NonFiniteSequenceError)
+    assert back.seq_id == 3 and back.step == 17
+
+
+# -- the process fleet -----------------------------------------------------
+
+_POOL = dict(num_pages=32, page_size=4)
+
+
+def _thread_fleet(params, cfg):
+    return Fleet(
+        lambda n: PrefillReplica(n, params, cfg, **_POOL),
+        lambda n: DecodeReplica(n, params, cfg, **_POOL),
+        n_prefill=1, n_decode=1)
+
+
+def _run(fleet, prompts, max_new=5, timeout=180):
+    futs = [fleet.submit(DecodeRequest(prompt=p, max_new_tokens=max_new))
+            for p in prompts]
+    return [f.result(timeout=timeout).tokens for f in futs]
+
+
+def test_proc_fleet_sigkill_failover_token_identical(monkeypatch):
+    """The tentpole contract end to end: a 2+2 fleet of real processes
+    takes a chaos SIGKILL on prefill0 mid-work (phase A) and an
+    external SIGKILL on decode0's live pid (phase B); every request
+    completes token-identical to the thread-fleet oracle,
+    lost_requests banks 0, the controller quarantines the corpse and
+    respawns below min, and both audits come back clean."""
+    cfg = DecodeConfig()
+    params = init_decode_params(cfg, seed=0)
+    prompts_a = [[i + 1, i + 2, i + 3] for i in range(6)]
+    prompts_b = [[9, 8, 7, i + 1] for i in range(4)]
+
+    oracle = _thread_fleet(params, cfg)
+    want_a = _run(oracle, prompts_a)
+    want_b = _run(oracle, prompts_b)
+    oracle.close()
+
+    monkeypatch.setenv("FAULT_SERVE_PROC_KILL", "prefill0")
+    faultinject.reset()
+    srv = serve_master(MasterService(InMemStore()))
+    directory = ReplicaDirectory(RemoteMaster(srv.endpoint),
+                                 max_silence_s=2.0)
+    spawner = ProcSpawner(params, cfg, prefill_kwargs=_POOL,
+                          decode_kwargs=_POOL,
+                          master_endpoint=srv.endpoint)
+    fleet = Fleet(spawner.prefill, spawner.decode, n_prefill=2,
+                  n_decode=2, directory=directory)
+    ctl = FleetController(fleet,
+                          min_replicas={"prefill": 2, "decode": 2},
+                          max_replicas={"prefill": 3, "decode": 3})
+    try:
+        # phase A: prefill0 SIGKILLs itself at its first batch start —
+        # its ACKed work fails over and still completes correctly
+        got_a = _run(fleet, prompts_a)
+        assert got_a == want_a
+        st = fleet.stats()
+        assert st["lost_requests"] == 0 and st["failed"] == 0
+
+        # the corpse: quarantined (deregistered, pid confirmed dead)
+        # and replaced because the class dropped below min
+        p0 = fleet.replicas("prefill").get("prefill0")
+        deadline = time.time() + 15
+        while time.time() < deadline and p0 is not None and p0.alive:
+            time.sleep(0.1)
+        for _ in range(4):
+            ctl.step()
+            time.sleep(0.2)
+        st = fleet.stats()
+        assert st["respawns"] >= 1
+        assert st["replica_deaths"] >= 1
+        # the corpse is off the routing plane; its replacement is live
+        p0 = fleet.replicas("prefill").get("prefill0")
+        assert p0 is None or not p0.routing
+        assert any(r.alive and r.routing and n != "prefill0"
+                   for n, r in fleet.replicas("prefill").items())
+
+        # phase B: an EXTERNAL SIGKILL on decode0's live pid while its
+        # handoffs are in flight
+        d0 = fleet.replicas("decode").get("decode0")
+        futs = [fleet.submit(DecodeRequest(prompt=p, max_new_tokens=5))
+                for p in prompts_b]
+        time.sleep(0.3)  # let handoffs land on decode replicas
+        if d0 is not None and d0.proc.poll() is None:
+            os.kill(d0.pid, signal.SIGKILL)
+        got_b = [f.result(timeout=180).tokens for f in futs]
+        assert got_b == want_b
+
+        st = fleet.stats()
+        assert st["lost_requests"] == 0
+        assert st["completed"] == len(prompts_a) + len(prompts_b)
+        audit = fleet.audit()
+        assert audit["pages_leaked"] == 0
+        assert audit["invariants_ok"] == 1
+    finally:
+        fleet.close()
+        spawner.close()
+        srv.shutdown()
+        faultinject.reset()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_proc_fleet_storm(monkeypatch):
+    """Kills x handoff drops x a rolling upgrade under load: the
+    worst hour of a deployment's life, compressed.  Everything still
+    completes, nothing is lost, nothing leaks."""
+    cfg = DecodeConfig()
+    params = init_decode_params(cfg, seed=0)
+
+    monkeypatch.setenv("FAULT_SERVE_PROC_KILL", "decode0")
+    monkeypatch.setenv("FAULT_SERVE_HANDOFF_DROP", "1")
+    faultinject.reset()
+    srv = serve_master(MasterService(InMemStore()))
+    directory = ReplicaDirectory(RemoteMaster(srv.endpoint),
+                                 max_silence_s=2.0)
+    spawner = ProcSpawner(params, cfg, prefill_kwargs=_POOL,
+                          decode_kwargs=_POOL,
+                          master_endpoint=srv.endpoint)
+    fleet = Fleet(spawner.prefill, spawner.decode, n_prefill=2,
+                  n_decode=2, directory=directory)
+    ctl = FleetController(fleet,
+                          min_replicas={"prefill": 2, "decode": 2},
+                          max_replicas={"prefill": 3, "decode": 3})
+    try:
+        # wave 1: traffic into the armed knobs — one handoff payload
+        # vanishes in transit (re-prefilled), decode0 SIGKILLs itself
+        futs = [fleet.submit(DecodeRequest(
+            prompt=[i + 1, i + 2, (i % 5) + 1], max_new_tokens=5))
+            for i in range(8)]
+        res = [f.result(timeout=240) for f in futs]
+        assert all(r.error is None for r in res)
+        st = fleet.stats()
+        assert st["handoff_drops"] >= 1
+        assert st["handoff_drops_recovered"] >= 1
+        assert st["lost_requests"] == 0
+
+        # let the controller clear the casualty and respawn
+        for _ in range(4):
+            ctl.step()
+            time.sleep(0.2)
+        assert fleet.stats()["respawns"] >= 1
+
+        # wave 2: a rolling weight upgrade under fresh traffic — every
+        # surviving replica drains, swaps, rejoins; traffic completes
+        params2 = init_decode_params(cfg, seed=1)
+        futs = [fleet.submit(DecodeRequest(
+            prompt=[5, 4, i + 1], max_new_tokens=4)) for i in range(4)]
+        upgraded = ctl.rolling_upgrade(params2, timeout=60.0)
+        assert len(upgraded) >= 4
+        res = [f.result(timeout=240) for f in futs]
+        assert all(r.error is None for r in res)
+
+        # wave 3: post-upgrade traffic decodes with the NEW weights —
+        # token-identical to a thread oracle carrying params2
+        oracle = _thread_fleet(params2, cfg)
+        prompts = [[2, 4, 6, i + 1] for i in range(4)]
+        want = _run(oracle, prompts, max_new=4)
+        oracle.close()
+        got = _run(fleet, prompts, max_new=4)
+        assert got == want
+
+        st = fleet.stats()
+        assert st["lost_requests"] == 0 and st["failed"] == 0
+        audit = fleet.audit()
+        assert audit["pages_leaked"] == 0
+        assert audit["invariants_ok"] == 1
+    finally:
+        fleet.close()
+        spawner.close()
+        srv.shutdown()
+        faultinject.reset()

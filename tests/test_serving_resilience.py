@@ -439,23 +439,25 @@ def test_nan_at_prefill_quarantines_only_offender():
 
 def test_finite_check_is_one_fused_call_per_step():
     """The quarantine scan must be ONE batched rows_finite call per loop
-    step ([B, V] in, [B] bool out) — never a per-sequence check."""
-    import paddle_tpu.serving.generate as gen
+    step ([B, V] in, [B] bool out) — never a per-sequence check.  The
+    scan lives in the shared prefill scheduler (prefill_sched) since the
+    fleet's prefill replica runs the same blast radius."""
+    import paddle_tpu.serving.prefill_sched as psched
 
     cfg, params, prompts, pool = _decode_setup(seed=5)
     calls = []
-    real = gen.rows_finite
+    real = psched.rows_finite
 
     def counting(x):
         calls.append(np.asarray(x).shape)
         return real(x)
 
-    gen.rows_finite, orig = counting, gen.rows_finite
+    psched.rows_finite, orig = counting, psched.rows_finite
     try:
         loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=3)
         loop.run([DecodeRequest(p, 3) for p in prompts])
     finally:
-        gen.rows_finite = orig
+        psched.rows_finite = orig
     assert len(calls) == loop.steps  # exactly one scan per step
     assert all(len(s) == 2 and s[1] == cfg.vocab_size for s in calls), \
         "scan must see the whole [B, V] logits batch at once"

@@ -17,6 +17,12 @@
 #              round-trip (acceptance/speedup banked, replay
 #              determinism checked in-process) and a gate-teeth arm
 #              banking an unreachable spec_speedup that must exit 3
+#   procfleet - process-level fleet smoke (ISSUE 17): serve_bench
+#              --fleet --procs 2 with FAULT_SERVE_PROC_KILL armed —
+#              a live replica pid is SIGKILLed mid-run and the gate
+#              banks lost_requests=0 + respawns>=1; the teeth arm
+#              re-runs with --fleet-retries 0 so the kill's work
+#              fails typed un-recovered, which must exit 3
 # Run all stages:  tools/ci.sh        One stage:  tools/ci.sh test
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -132,6 +138,36 @@ JSON
   rm -rf "$tmp"
 }
 
+run_procfleet() {
+  echo "== process fleet smoke (SIGKILL a live pid; nothing lost) =="
+  tmp="$(mktemp -d)"
+  # the banked contract: a SIGKILLed replica process costs NOTHING the
+  # caller can see — every request completes (failed=0, lost=0), the
+  # casualty is respawned, both surviving pools audit clean
+  cat > "$tmp/bank.json" <<'JSON'
+{"lost_requests": 0, "failed_requests": 0, "pages_leaked": 0,
+ "invariants_ok": 1, "respawns": 1}
+JSON
+  FAULT_SERVE_PROC_KILL=decode0 python tools/serve_bench.py \
+    --mode decode --fleet --procs 2 --sequences 6 --max-new 4 \
+    --pages 48 --page-size 4 --d-model 32 --max-len 48 \
+    --json "$tmp/procfleet.json" --baseline "$tmp/bank.json" --gate
+  echo "== procfleet teeth: retries=0 leaves the kill un-recovered, must exit 3 =="
+  set +e
+  FAULT_SERVE_PROC_KILL=decode0 python tools/serve_bench.py \
+    --mode decode --fleet --procs 2 --fleet-retries 0 --sequences 6 \
+    --max-new 4 --pages 48 --page-size 4 --d-model 32 --max-len 48 \
+    --baseline "$tmp/bank.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "procfleet teeth: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "procfleet teeth OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_bench() {
   echo "== bench smoke =="
   BENCH_BS=8 BENCH_STEPS=3 BENCH_TRANSFORMER_BS=2 BENCH_DEEPFM_BS=32 \
@@ -145,8 +181,9 @@ case "$stage" in
   lint)   run_lint ;;
   fleet)  run_fleet ;;
   spec)   run_spec ;;
+  procfleet) run_procfleet ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_procfleet; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|procfleet|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

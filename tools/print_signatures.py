@@ -51,6 +51,13 @@ MODULES = [
     # replica classes, handoff contract, and autoscaling controller
     # are the operator-facing serving deployment surface
     "paddle_tpu.serving.fleet",
+    # process-level fleet (ISSUE 17): the replica-process entrypoint,
+    # spawner, and socket-backed replica proxy are deployment surface
+    "paddle_tpu.serving.fleet.proc",
+    # the shared prefill scheduler: whole-vs-chunk planning and
+    # non-finite eviction used by BOTH the monolithic loop and the
+    # prefill replica
+    "paddle_tpu.serving.prefill_sched",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

@@ -755,7 +755,16 @@ def run_fleet_bench(args, elastic: bool) -> dict:
     the elastic controller under a BURSTY load (the whole request set
     submitted at once, then a quiet tail): sustained queue growth must
     scale a class up and the idle tail must scale it back down —
-    scale_ups/scale_downs bank >= 1 on the same 0/2/3 gate."""
+    scale_ups/scale_downs bank >= 1 on the same 0/2/3 gate.
+
+    --procs N upgrades --fleet to real OS processes: N prefill + N
+    decode replica processes behind ProcSpawner, every handoff and
+    result crossing the framed socket plane, the directory served over
+    a real RemoteMaster.  The banked contract hardens accordingly —
+    lost_requests=0 and clean audits must now survive
+    FAULT_SERVE_PROC_KILL (a SIGKILLed pid, not a cooperative thread
+    death), and respawns / handoff_drops_recovered / failover_p99_ms
+    join the gate."""
     from paddle_tpu import serving
     from paddle_tpu.serving.fleet import (
         AutoscalePolicy,
@@ -763,6 +772,7 @@ def run_fleet_bench(args, elastic: bool) -> dict:
         Fleet,
         FleetController,
         PrefillReplica,
+        ProcSpawner,
     )
 
     kv_dtype = _KV_DTYPES[args.kv_dtype]
@@ -789,14 +799,44 @@ def run_fleet_bench(args, elastic: bool) -> dict:
             page_size=args.page_size, dtype=kv_dtype,
             max_batch=args.max_batch, paged_impl=args.paged_impl)
 
-    fleet = Fleet(spawn_prefill, spawn_decode)
+    spawner = master_srv = None
+    procs = int(getattr(args, "procs", 0) or 0)
+    if procs:
+        from paddle_tpu.elastic.master import InMemStore, MasterService
+        from paddle_tpu.elastic.rpc import RemoteMaster, serve_master
+        from paddle_tpu.serving.distributed import ReplicaDirectory
+
+        master_srv = serve_master(MasterService(InMemStore()))
+        directory = ReplicaDirectory(
+            RemoteMaster(master_srv.endpoint), max_silence_s=2.0)
+        spawner = ProcSpawner(
+            params, cfg,
+            prefill_kwargs=dict(
+                num_pages=args.pages, page_size=args.page_size,
+                dtype=kv_dtype, max_batch=args.max_batch,
+                prefill_chunk=args.prefill_chunk or None),
+            decode_kwargs=dict(
+                num_pages=args.pages, page_size=args.page_size,
+                dtype=kv_dtype, max_batch=args.max_batch,
+                paged_impl=args.paged_impl),
+            master_endpoint=master_srv.endpoint)
+        fleet = Fleet(spawner.prefill, spawner.decode,
+                      n_prefill=procs, n_decode=procs,
+                      directory=directory,
+                      max_retries=args.fleet_retries)
+    else:
+        fleet = Fleet(spawn_prefill, spawn_decode,
+                      max_retries=args.fleet_retries)
     controller = None
     if elastic:
+        n_min = {r: max(1, procs) for r in ("prefill", "decode")}
+        n_max = {r: max(3, procs + 1) for r in ("prefill", "decode")}
         controller = FleetController(
             fleet,
             policy=AutoscalePolicy(queue_high=2, sustain=2,
                                    idle_sustain=2, cooldown=0),
-            max_replicas={"prefill": 3, "decode": 3})
+            min_replicas=n_min if procs else None,
+            max_replicas=n_max)
     t_start = time.perf_counter()
     futs = []
     if elastic:
@@ -814,14 +854,23 @@ def run_fleet_bench(args, elastic: bool) -> dict:
             if target > now:
                 time.sleep(target - now)
             futs.append(fleet.submit(r))
-    results = [f.result(timeout=120) for f in futs]
+    results, hard_failures = [], 0
+    for f in futs:
+        try:
+            results.append(f.result(timeout=180 if procs else 120))
+        except Exception:  # noqa: BLE001 — a typed fleet failure is a
+            hard_failures += 1  # banked metric, not a bench crash
     elapsed = time.perf_counter() - t_start
     if elastic:
         # the idle tail: queues are empty, the controller scales back
-        # down through the zero-loss drain
+        # down through the zero-loss drain — and, in process mode,
+        # quarantines any SIGKILL casualty and respawns below min
         for _ in range(controller.policy.idle_sustain + 1):
             controller.step()
-    errored = sum(1 for r in results if r.error is not None)
+            if procs:
+                time.sleep(0.3)
+    errored = hard_failures + sum(
+        1 for r in results if r.error is not None)
     tokens = sum(len(r.tokens) for r in results)
     st = fleet.stats()
     audit = fleet.audit()
@@ -847,6 +896,7 @@ def run_fleet_bench(args, elastic: bool) -> dict:
         "errored_sequences": errored,
         # every submit's future resolved — the bankable hard zero
         "lost_requests": st["lost_requests"],
+        "failed_requests": st["failed"],
         "pages_leaked": audit["pages_leaked"],
         "invariants_ok": audit["invariants_ok"],
     }
@@ -858,7 +908,20 @@ def run_fleet_bench(args, elastic: bool) -> dict:
             "scale_downs": st["scale_downs"],
             "controller_steps": controller.steps,
         })
+    if procs:
+        fl = list(fleet.failover_latencies)
+        result.update({
+            "procs": procs,
+            "respawns": st["respawns"],
+            "handoff_drops_recovered": st["handoff_drops_recovered"],
+            "failover_p99_ms": (_percentile(fl, 99) * 1e3
+                                if fl else 0.0),
+        })
     fleet.close()
+    if spawner is not None:
+        spawner.close()
+    if master_srv is not None:
+        master_srv.shutdown()
     return result
 
 
@@ -872,7 +935,7 @@ _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "cached_prefill_tokens", "acceptance_rate",
                      "tokens_per_step", "spec_speedup",
                      "accepted_tokens", "scale_ups", "scale_downs",
-                     "handoffs", "replica_kills")
+                     "handoffs", "replica_kills", "respawns")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -993,6 +1056,19 @@ def main(argv=None) -> int:
                          "FleetController under a bursty load — "
                          "scale_ups/scale_downs bank >= 1 next to "
                          "lost_requests=0")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="with --fleet: run N prefill + N decode "
+                         "replicas as real OS processes (ProcSpawner "
+                         "over the framed socket plane) instead of "
+                         "threads; banks lost_requests=0, "
+                         "handoff_drops_recovered, respawns, and "
+                         "failover_p99_ms — arm FAULT_SERVE_PROC_KILL "
+                         "to SIGKILL a named replica mid-run")
+    ap.add_argument("--fleet-retries", type=int, default=3,
+                    help="fleet failover retry budget per request "
+                         "(0 = a killed replica's work fails typed "
+                         "instead of failing over — the chaos-teeth "
+                         "arm)")
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
@@ -1096,6 +1172,15 @@ def main(argv=None) -> int:
                 "serve_bench: --disagg/--fleet bank the greedy "
                 "oracle-identical arm; drop --sampling\n")
             return 2
+    if args.procs and not args.fleet:
+        sys.stderr.write(
+            "serve_bench: --procs needs --fleet (the process topology "
+            "rides the elastic controller)\n")
+        return 2
+    if args.procs < 0 or args.fleet_retries < 0:
+        sys.stderr.write(
+            "serve_bench: --procs/--fleet-retries must be >= 0\n")
+        return 2
     if args.mesh > 1:
         # the sharded decode program needs a mesh: force virtual CPU
         # devices while that is still possible (the flag only works
