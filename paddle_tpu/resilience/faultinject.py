@@ -72,6 +72,16 @@ Serving knobs (tests/test_serving_resilience.py chaos suite):
         typed ReplicaKilledError, queued work must fail over, and the
         controller must quarantine + respawn.  Prefer a NAME over "*":
         children inherit the env, so "*" would also kill every respawn.
+    FAULT_SERVE_SPILL_CORRUPT=1       tiered KV cache: the next payload
+        parked in the host tier is poisoned AFTER its CRC is recorded
+        (one flipped byte — silent host-memory corruption), once — the
+        resume must reject it typed (SpillCorruptError), count a
+        re_prefill, and recompute the turn from the prompt; garbage is
+        never imported into a sequence.
+    FAULT_SERVE_SPILL_DROP=1          tiered KV cache: the next parked
+        payload fetched for a resume is LOST (SpillMissingError), once
+        — the session must fall back to a fresh prefill (counted as
+        re_prefills), never hang or fail the request.
 """
 
 from __future__ import annotations
@@ -84,7 +94,8 @@ __all__ = [
     "maybe_corrupt_after_save", "rpc_drop", "nan_fetches",
     "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
     "serve_slow_step", "serve_prefix_corrupt", "serve_replica_kill",
-    "serve_handoff_drop", "serve_proc_kill", "rpc_truncate",
+    "serve_handoff_drop", "serve_proc_kill", "serve_spill_corrupt",
+    "serve_spill_drop", "rpc_truncate",
 ]
 
 fired: set = set()
@@ -298,6 +309,30 @@ def serve_handoff_drop() -> bool:
             or "serve_handoff_drop" in fired:
         return False
     fired.add("serve_handoff_drop")
+    return True
+
+
+def serve_spill_corrupt() -> bool:
+    """FAULT_SERVE_SPILL_CORRUPT: True exactly once while armed — the
+    host KV tier poisons the payload it just parked (after recording
+    its CRC), so the fetch-side verify must catch the corruption and
+    the session re-prefills instead of importing garbage."""
+    if not os.environ.get("FAULT_SERVE_SPILL_CORRUPT") \
+            or "serve_spill_corrupt" in fired:
+        return False
+    fired.add("serve_spill_corrupt")
+    return True
+
+
+def serve_spill_drop() -> bool:
+    """FAULT_SERVE_SPILL_DROP: True exactly once while armed — the
+    parked payload a resume fetches is lost (models an evicted or
+    discarded host buffer); the session must fall back to a fresh
+    prefill."""
+    if not os.environ.get("FAULT_SERVE_SPILL_DROP") \
+            or "serve_spill_drop" in fired:
+        return False
+    fired.add("serve_spill_drop")
     return True
 
 

@@ -87,6 +87,20 @@ JSONL whenever the breaker trips or health() enters BROKEN.
 tools/serve_bench.py is the closed-loop load generator + regression
 gate.
 
+Tiered KV cache (kvtier.py, ISSUE 18): multi-turn sessions stop dying
+with HBM — a ``TieredSessionManager`` keeps retired sequences' pages
+RESIDENT between turns (``DecodeRequest.session`` resumes them with
+zero prefill), spills LRU/idle sessions' KV to a checksummed
+``HostKVTier`` in host RAM (``export_seq`` payloads parked by a
+spill-writer thread overlapped with decode, or inline under the pool's
+pressure-reclaimer hook), and resumes parked sessions by re-attaching
+their pinned prefix-cache pages and importing only the unshared tail.
+Admission reserves against the COMBINED tier (``make_room`` spills on
+demand, so session capacity is HBM + host while active decode stays
+HBM-bounded); a spilled-and-resumed session is token-identical to a
+never-spilled one; FAULT_SERVE_SPILL_CORRUPT/_DROP chaos verifies a
+damaged payload re-prefills typed instead of importing garbage.
+
 Scaling past one chip (ISSUE 10) lives in ``serving/distributed/``:
 tensor-parallel decode under shard_map (ShardedDecodeProgram +
 head-sharded ShardedKVCachePool — the ContinuousBatchingLoop takes it
@@ -126,6 +140,14 @@ from .kvcache import (
     SeqExport,
     SequenceHandle,
 )
+from .kvtier import (
+    HostKVTier,
+    HostTierFullError,
+    SpillCorruptError,
+    SpillMissingError,
+    TierSession,
+    TieredSessionManager,
+)
 from .prefixcache import PrefixCache, PrefixMatch
 from .sampling import SamplingParams
 from .speculative import PromptLookupDrafter
@@ -145,6 +167,8 @@ __all__ = [
     "EngineUnhealthyError",
     "ExecutorBackend",
     "GeneratedSequence",
+    "HostKVTier",
+    "HostTierFullError",
     "KVCachePool",
     "NonFiniteSequenceError",
     "PagePoolExhausted",
@@ -156,6 +180,10 @@ __all__ = [
     "SamplingParams",
     "SeqExport",
     "SequenceHandle",
+    "SpillCorruptError",
+    "SpillMissingError",
+    "TierSession",
+    "TieredSessionManager",
     "full_decode",
     "full_forward",
     "init_decode_params",

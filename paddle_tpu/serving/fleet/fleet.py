@@ -372,9 +372,8 @@ class Fleet:
                          t_submit: float) -> None:
         if hd.dest is None and hd.reroutable():
             # an UNPLANNED handoff: no decode replica was up at export
-            # time, or a process-fleet prefill that plans no destination
-            # (the payload ships whole either way, skip_tokens == 0) —
-            # route it now.  This is placement, not a failover
+            # time (the payload ships whole, skip_tokens == 0) — route
+            # it now.  This is placement, not a failover
             with self._lock:
                 reps = dict(self._decode)
             rep = self._pick(reps)
@@ -391,7 +390,7 @@ class Fleet:
         try:
             dfut = dest.submit(hd)
         except (ReplicaKilledError, ReplicaDrainingError,
-                FleetQueueFullError, ValueError) as e:
+                FleetQueueFullError, HandoffDropError, ValueError) as e:
             self._release_on_dest(hd)
             if isinstance(e, ValueError) or retries >= self.max_retries:
                 self._resolve(fut, error=e)

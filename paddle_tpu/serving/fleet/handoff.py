@@ -30,7 +30,8 @@ import numpy as np
 from ..generate import DecodeRequest
 from ..kvcache import SeqExport
 
-__all__ = ["Handoff", "HandoffDropError", "PrefixReservation"]
+__all__ = ["Handoff", "HandoffDropError", "PrefixReservation",
+           "RidReservation"]
 
 
 class HandoffDropError(RuntimeError):
@@ -69,6 +70,29 @@ class PrefixReservation:
             self._registry.pop(id(self), None)
             self._registry = None
         return pool.release_pages(self.pages)
+
+
+class RidReservation:
+    """Picklable stand-in for a `PrefixReservation` pinned in another
+    PROCESS (the process fleet, serving/fleet/proc.py): carries only
+    the owner-side registry id and the token count the export was
+    planned against, so ``res.tokens`` drives ``skip_tokens`` on the
+    prefill side without the pages ever leaving the owner.  `release`
+    here is a local no-op — the real pages are unwound by the
+    ``release_prefix`` verb against the owner or consumed when the
+    handoff lands there and the owner's service swaps the real
+    reservation back in.  Lives HERE (not in proc.py) because the
+    replica entrypoint runs proc.py as ``__main__``: a stub minted
+    there would pickle as ``__main__.RidReservation`` and fail to
+    resolve on the broker."""
+
+    def __init__(self, rid: str, tokens: int):
+        self.rid = rid
+        self.tokens = int(tokens)
+        self.released = False
+
+    def release(self, pool) -> None:  # noqa: ARG002 — seam parity
+        self.released = True
 
 
 class Handoff:

@@ -34,10 +34,22 @@ This module closes the gap:
 Chaos is now SIGKILL-grade: ``FAULT_SERVE_PROC_KILL=<name>`` makes the
 named child SIGKILL itself at its next batch start (no cleanup, no
 atexit — a vanished PID), and `ProcReplica.quarantine` SIGKILLs a live
-pid outright.  Cross-process handoffs ship the FULL payload
-(``skip_tokens == 0`` — prefix reservations stay an in-process
-optimization), which keeps them reroutable to any surviving decode
-replica; the fleet routes the unplanned destination at dispatch time.
+pid outright.
+
+**Prefix reservations cross processes** (ISSUE 18 bugfix — PR 17
+shipped the full payload on every cross-process handoff): the broker's
+``reserve_prefix`` is now a real ``reserve_prefix`` verb against the
+destination decode process (the real `PrefixReservation` stays pinned
+in the CHILD's registry; a picklable `RidReservation` stub carries
+only its rid+tokens over the wire), PLANNED handoffs ship
+``skip_tokens > 0`` again (the broker attaches the plan to the request
+before submit; the child's prefill exports only the unshared tail and
+returns the stub on the Handoff, which the broker swaps back for the
+original reservation handle), and ``release_prefix`` unwinds a
+reservation whose payload was dropped or failed over.  UNPLANNED
+failover is unchanged: a payload exported against a reservation is
+missing content, so it re-prefills, while full payloads stay
+reroutable to any surviving decode replica.
 """
 
 from __future__ import annotations
@@ -60,7 +72,7 @@ from ...elastic.rpc import FrameClient, FrameError, register_error, serve_frames
 from ...observability import flight as _flight
 from ...resilience import faultinject as _finject
 from .. import metrics as _smetrics
-from .handoff import Handoff, HandoffDropError
+from .handoff import Handoff, HandoffDropError, RidReservation
 from .replica import (
     FleetQueueFullError,
     ReplicaDrainingError,
@@ -69,7 +81,8 @@ from .replica import (
 
 _log = logging.getLogger("paddle_tpu.serving.fleet")
 
-__all__ = ["ProcReplica", "ProcSpawner", "main"]
+__all__ = ["ProcReplica", "ProcSpawner", "RemotePrefixReservation",
+           "main"]
 
 # fleet-typed errors cross the frame plane by name (the registry lives
 # in elastic.rpc; registering here avoids an elastic→serving layering
@@ -79,6 +92,60 @@ for _cls in (ReplicaKilledError, ReplicaDrainingError,
     register_error(_cls)
 
 _TRANSPORT_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+# -- cross-process prefix reservations (ISSUE 18) ---------------------------
+# The picklable wire stub (RidReservation) lives in handoff.py: this
+# module runs as __main__ inside replica children, which would break
+# its pickle identity.
+
+class RemotePrefixReservation:
+    """Broker-side handle for a prefix reservation pinned inside a
+    decode PROCESS.  Mirrors the release seam the fleet exercises on
+    failure paths (`Handoff.release(pool)` — the pool argument is
+    ignored; the real pool lives with the owner): releasing sends the
+    ``release_prefix`` verb to the owning replica, best-effort and
+    idempotent, because a dead owner's pin died with its pool and a
+    handoff can be released once by chaos and again by failover."""
+
+    def __init__(self, owner: "ProcReplica", rid: str, tokens: int):
+        self.owner = owner
+        self.rid = rid
+        self.tokens = int(tokens)
+        self.released = False
+
+    def release(self, pool=None) -> None:  # noqa: ARG002 — remote pool
+        if self.released:
+            return
+        self.released = True
+        self.owner._release_prefix(self.rid)
+
+
+def _release_reservation(res) -> None:
+    """Release a reservation handle without knowing the owning pool:
+    thread `PrefixReservation`s learn their pool at creation time
+    (``_owner_pool``), `RemotePrefixReservation`s ignore the argument
+    and cross the frame plane instead."""
+    try:
+        res.release(getattr(res, "_owner_pool", None))
+    except Exception:  # noqa: BLE001 — unwind is best-effort
+        _log.warning("failed to release a planned prefix reservation",
+                     exc_info=True)
+
+
+def _plan_from_req(req):
+    """Child-side handoff planner for a prefill process: the BROKER
+    already planned against the destination's prefix trie (it owns the
+    ``reserve_prefix`` verbs) and attached the result to the request
+    before submit; re-hydrate it so `_prefill_jobs` exports with
+    ``skip_tokens == res.tokens`` and stamps the stub on the Handoff."""
+    plan = getattr(req, "_proc_plan", None)
+    if not plan:
+        return None
+    res = None
+    if plan.get("prid") is not None:
+        res = RidReservation(plan["prid"], plan.get("tokens", 0))
+    return plan["dest"], res
 
 
 # -- child side: the verb service -------------------------------------------
@@ -94,6 +161,11 @@ class _ReplicaService:
         # rid -> ("ok", result) | ("err", exception): held until the
         # broker ACKs, so a collect response lost mid-write re-delivers
         self._done: Dict[str, Tuple] = {}
+        # rid -> real PrefixReservation pinned by `reserve_prefix`;
+        # consumed when the planned handoff's submit swaps it back onto
+        # the Handoff, or unwound by the `release_prefix` verb
+        self._reservations: Dict[str, object] = {}
+        self._next_res = 0
 
     def dispatch(self, verb: str, **kwargs):
         fn = getattr(self, f"v_{verb}", None)
@@ -114,7 +186,28 @@ class _ReplicaService:
         with self._lock:
             if rid in self._pending or rid in self._done:
                 return {"dup": True}  # idempotent retry after torn resp
-        fut = self.rep.submit(item)  # typed errors re-raise by name
+        stub = getattr(item, "reservation", None)
+        real = None
+        if isinstance(stub, RidReservation):
+            # a planned handoff landing on its reserving replica: swap
+            # the wire stub for the real pinned reservation so admit
+            # re-attaches the reserved prefix pages
+            with self._lock:
+                real = self._reservations.pop(stub.rid, None)
+            if real is None:
+                raise HandoffDropError(
+                    f"prefix reservation {stub.rid} is gone on "
+                    f"{self.rep.name}; the planned payload is missing "
+                    f"its reserved prefix")
+            item.reservation = real
+        try:
+            fut = self.rep.submit(item)  # typed errors re-raise by name
+        except BaseException:
+            if real is not None:  # the pin survives a typed rejection
+                with self._lock:
+                    self._reservations[stub.rid] = real
+                item.reservation = stub
+            raise
         with self._lock:
             self._pending[rid] = fut
         fut.add_done_callback(lambda f, rid=rid: self._finish(rid, f))
@@ -178,6 +271,29 @@ class _ReplicaService:
         return {"used_pages": int(rep.pool.used_pages),
                 "ok": bool(inv["ok"])}
 
+    def v_reserve_prefix(self, prompt) -> Dict:
+        """Pin the longest cached full-page prefix in THIS process and
+        keep the real reservation here; only its rid + token count
+        cross the wire.  The pin is consumed by the planned handoff's
+        `v_submit` or unwound by `v_release_prefix`."""
+        fn = getattr(self.rep, "reserve_prefix", None)
+        res = fn(list(prompt)) if fn is not None else None
+        if res is None:
+            return {"rid": None, "tokens": 0}
+        with self._lock:
+            rid = f"res-{self._next_res}"
+            self._next_res += 1
+            self._reservations[rid] = res
+        return {"rid": rid, "tokens": int(res.tokens)}
+
+    def v_release_prefix(self, rid: str) -> Dict:
+        with self._lock:
+            res = self._reservations.pop(rid, None)
+        if res is None:
+            return {"released": False}  # consumed or already unwound
+        res.release(self.rep.pool)
+        return {"released": True}
+
     def v_shutdown(self, timeout_s: float = 10.0) -> Dict:
         def _exit():
             try:
@@ -237,6 +353,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     cls = PrefillReplica if args.role == "prefill" else DecodeReplica
     rep = cls(args.name, art["params"], art["cfg"],
               **art.get(args.role, {}))
+    if args.role == "prefill":
+        # the broker plans the handoff (it can reach every decode
+        # replica's trie) and ships the plan on the request
+        rep.plan_handoff = _plan_from_req
     _arm_proc_kill(rep)
     service = _ReplicaService(rep)
     srv = serve_frames(service.dispatch, host=args.host, port=args.port)
@@ -299,9 +419,10 @@ class ProcReplica:
         self.pid = int(pid)
         self.routing = True
         self.directory = None
-        self.plan_handoff = None   # set by Fleet on prefill; unused —
-        # process prefills export unplanned (dest=None, full payload)
-        # and the fleet routes the handoff at dispatch time
+        self.plan_handoff = None   # set by Fleet on prefill replicas;
+        # the broker runs it at submit time (it owns the dest-side
+        # reserve_prefix verbs) and ships the plan on the request, so
+        # the child's export skips the reserved prefix (ISSUE 18)
         self.cache = None          # audit clears the cache server-side
         self.pool = _RemotePoolView(self)
         self._spawner = spawner
@@ -309,6 +430,11 @@ class ProcReplica:
         self._pending: Dict[str, Future] = {}
         self._acks: List[str] = []
         self._next_rid = 0
+        # submit rid -> real dest reservation handle for a PLANNED
+        # prefill in flight through the child; swapped back onto the
+        # returned Handoff at collect, released if the prefill errors
+        # or the process dies first
+        self._planned: Dict[str, object] = {}
         self._alive = True
         self._closed = False
         self._draining = False
@@ -375,11 +501,14 @@ class ProcReplica:
             self._next_rid += 1
             fut: Future = Future()
             self._pending[rid] = fut
+        orig_res = None
         try:
+            orig_res = self._plan_for(rid, item)
             self._ctl.call("submit", rid=rid, item=item)
         except _TRANSPORT_ERRORS as e:
             with self._lock:
                 self._pending.pop(rid, None)
+            self._unplan(rid)
             self._mark_dead(f"submit transport failure: {e}")
             raise ReplicaKilledError(
                 f"replica {self.name} (pid {self.pid}) died during "
@@ -391,8 +520,65 @@ class ProcReplica:
                 self._pending.pop(rid, None)
                 if isinstance(e, FleetQueueFullError):
                     self._shed += 1
+            self._unplan(rid)
             raise
+        finally:
+            if orig_res is not None:
+                # broker-side handoff keeps the REAL handle: the fleet's
+                # failure paths release through it, and the stub only
+                # ever existed for the wire
+                item.reservation = orig_res
         return fut
+
+    def _plan_for(self, rid: str, item):
+        """Role-dependent reservation plumbing around one submit.
+
+        Prefill: run the fleet's handoff planner HERE (the destination
+        tries are reachable broker-side through `reserve_prefix`) and
+        attach the plan to the request; the child reads it back through
+        its own ``plan_handoff`` and exports with ``skip_tokens``.  The
+        real dest reservation parks in ``_planned[rid]`` until the
+        Handoff comes back (or the attempt dies).
+
+        Decode: a planned `Handoff` arrives carrying the broker's
+        `RemotePrefixReservation` handle; swap in the picklable rid
+        stub for the wire (the real reservation is already pinned in
+        the child) and return the original for the caller to restore."""
+        if self.role == "prefill" and self.plan_handoff is not None \
+                and hasattr(item, "prompt"):
+            item._proc_plan = None  # never reuse a stale retry plan
+            try:
+                plan = self.plan_handoff(item)
+            except Exception:  # noqa: BLE001 — planning is best-effort
+                plan = None
+            if plan is not None:
+                dest, res = plan
+                prid = None
+                if res is not None:
+                    prid = rid
+                    with self._lock:
+                        self._planned[rid] = res
+                item._proc_plan = {
+                    "dest": dest, "prid": prid,
+                    "tokens": int(res.tokens) if res is not None else 0}
+            return None
+        res = getattr(item, "reservation", None)
+        if isinstance(res, RemotePrefixReservation):
+            if res.owner is not self:
+                # a reservation only fits the replica that pinned it;
+                # the fleet's failover turns this into a re-prefill
+                raise HandoffDropError(
+                    f"handoff reservation is pinned on "
+                    f"{res.owner.name}, not {self.name}")
+            item.reservation = RidReservation(res.rid, res.tokens)
+            return res
+        return None
+
+    def _unplan(self, rid: str) -> None:
+        with self._lock:
+            res = self._planned.pop(rid, None)
+        if res is not None:
+            _release_reservation(res)
 
     def _collect_loop(self) -> None:
         while True:
@@ -424,11 +610,33 @@ class ProcReplica:
                     self._acks.append(rid)
                 if fut is None:
                     continue
+                with self._lock:
+                    planned = self._planned.pop(rid, None)
                 if fut.set_running_or_notify_cancel():
                     if entry[0] == "ok":
+                        if planned is not None:
+                            self._attach_planned(entry[1], planned)
                         fut.set_result(entry[1])
                     else:
+                        if planned is not None:
+                            # the prefill died before forming the
+                            # handoff; unwind the dest's pin
+                            _release_reservation(planned)
                         fut.set_exception(entry[1])
+
+    @staticmethod
+    def _attach_planned(result, res) -> None:
+        """Swap the returned Handoff's wire stub back for the REAL
+        dest reservation handle the broker parked at submit time, so
+        downstream dispatch/admit/release see the same object the plan
+        minted — uniform across thread and process destinations."""
+        stub = getattr(result, "reservation", None)
+        if isinstance(stub, RidReservation):
+            result.reservation = res
+        else:
+            # the child prefilled without consuming the plan (stale
+            # request state); the dest pin would otherwise leak
+            _release_reservation(res)
 
     def _mark_dead(self, reason: str) -> None:
         with self._lock:
@@ -436,6 +644,11 @@ class ProcReplica:
                 return
             self._alive = False
             leftovers, self._pending = self._pending, {}
+            planned, self._planned = self._planned, {}
+        for res in planned.values():
+            # planned handoffs died with the prefill process, but their
+            # reservations pin pages on (likely alive) DEST replicas
+            _release_reservation(res)
         # routing stays ON, matching the thread replica's _die: the
         # controller reads alive=False + routing=True as a fresh corpse
         # and quarantines it (which is what turns routing off).  The
@@ -522,9 +735,37 @@ class ProcReplica:
         return out
 
     def reserve_prefix(self, prompt):
-        # no cross-process prefix reservation: the payload ships whole,
-        # which is exactly what keeps process handoffs reroutable
-        return None
+        """Pin the longest cached full-page prefix in the remote decode
+        process (ISSUE 18): the real reservation stays in the child's
+        registry, the broker holds a `RemotePrefixReservation` handle
+        whose release crosses back as a verb, and the planned handoff
+        ships only the unshared tail (``skip_tokens = res.tokens``)."""
+        if not self._alive or self._draining or not self.routing:
+            return None
+        try:
+            resp = self._ctl.call("reserve_prefix",
+                                  prompt=[int(t) for t in prompt],
+                                  timeout=10.0)
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"reserve_prefix transport failure: {e}")
+            return None
+        except Exception:  # noqa: BLE001 — planning is best-effort;
+            return None    # an unplanned handoff ships whole
+        rid = resp.get("rid")
+        if rid is None:
+            return None
+        return RemotePrefixReservation(self, rid,
+                                       int(resp.get("tokens", 0)))
+
+    def _release_prefix(self, rid: str) -> None:
+        if not self._alive:
+            return  # the pin died with the process's pool
+        try:
+            self._ctl.call("release_prefix", rid=rid, timeout=10.0)
+        except _TRANSPORT_ERRORS as e:
+            self._mark_dead(f"release_prefix transport failure: {e}")
+        except Exception:  # noqa: BLE001 — already consumed is fine
+            pass
 
     def quarantine(self) -> None:
         """SIGKILL-grade quarantine: fail in-flight work typed, then

@@ -625,6 +625,8 @@ class DecodeReplica(FleetReplica):
             self.pool.retain_pages(pages)
             res = PrefixReservation(keys=keys, pages=pages, tokens=full)
             res._registry = self._reserved
+            res._owner_pool = self.pool  # lets a broker holding this
+            # handle release it without knowing which replica pinned it
             self._reserved[id(res)] = res
         return res
 

@@ -583,6 +583,13 @@ class DecodeRequest:
     # then skips prefill entirely: admission imports the pages, emits
     # the first token, and the sequence decodes like any other
     handoff: Optional[object] = None
+    # tiered KV cache (serving/kvtier): the multi-turn session this
+    # request continues.  When the loop carries a session_manager,
+    # admission asks it to resume the session's retained KV (resident
+    # in the pool, or parked in the host tier) and retirement keeps the
+    # sequence's pages resident for the next turn instead of freeing
+    # them.  None (the default) is the ordinary one-shot request
+    session: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -681,7 +688,8 @@ class ContinuousBatchingLoop:
                  prefill: str = "batched", check_every: int = 0,
                  program=None, prefix_cache=None,
                  prefill_chunk: Optional[int] = None,
-                 speculate: Optional[int] = None, drafter=None):
+                 speculate: Optional[int] = None, drafter=None,
+                 session_manager=None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
@@ -690,6 +698,18 @@ class ContinuousBatchingLoop:
                 "prefix_cache is wired to a different pool — shared "
                 "pages and refcounts must live in the pool this loop "
                 "appends to")
+        if session_manager is not None:
+            if session_manager.pool is not pool:
+                raise ValueError(
+                    "session_manager is wired to a different pool — "
+                    "sessions spill from and resume into the pool this "
+                    "loop appends to")
+            if session_manager.cache is not None \
+                    and session_manager.cache is not prefix_cache:
+                raise ValueError(
+                    "session_manager carries a different prefix cache "
+                    "than the loop — spill-time pins and resume-time "
+                    "attaches must agree on one trie")
         self.params = params
         self.cfg = cfg if cfg is not None else getattr(program, "cfg", None)
         if self.cfg is None:
@@ -719,6 +739,10 @@ class ContinuousBatchingLoop:
                 paged_impl, pool.page_size, self.cfg.head_dim,
                 pool.k_pages.dtype)
         self.prefix_cache = prefix_cache
+        # tiered KV cache (serving/kvtier.TieredSessionManager):
+        # requests carrying a .session resume retained KV at admission
+        # and keep their pages resident at retirement
+        self.session_manager = session_manager
         # prefill-token cap per engine step (0 = uncapped); None reads
         # FLAGS_serving_prefill_chunk
         self._prefill_chunk = int(
@@ -779,6 +803,11 @@ class ContinuousBatchingLoop:
         self.drafted_tokens = 0
         self.accepted_tokens = 0
         self.rolled_back_tokens = 0
+        # tiered-session accounting (serve_bench banks the resume hit
+        # rate of the multi-turn workload off these)
+        self.session_resumes = 0
+        self.session_resumed_tokens = 0
+        self.session_fresh = 0
 
     def acceptance_rate(self) -> float:
         """Accepted / drafted speculative tokens (0.0 before any
@@ -891,6 +920,12 @@ class ContinuousBatchingLoop:
                 a.result.finished_at = now
                 if getattr(self.drafter, "stateful", False):
                     self.drafter.release(a.seq_id)
+                if self.session_manager is not None \
+                        and a.req.session is not None:
+                    # the evictor already scrubbed + freed the pool
+                    # side — reset the session so its next turn
+                    # prefills fresh instead of resuming poisoned KV
+                    self.session_manager.on_quarantine(a.req.session)
                 reserved_pages -= a.charged
                 self.quarantined += 1
                 if obs_on:
@@ -971,7 +1006,20 @@ class ContinuousBatchingLoop:
             for a in batch:
                 active.remove(a)
                 a.result.finished_at = now
-                self.pool.free_seq(a.seq_id)
+                resident = False
+                if self.session_manager is not None \
+                        and a.req.session is not None:
+                    # tiered session: the manager adopts the retired
+                    # sequence's pages (they stay resident for the
+                    # next turn, spillable to the host tier under
+                    # pressure) — the reservation charge still drops,
+                    # the pages move into the manager-locked set the
+                    # admission bound sets aside
+                    resident = self.session_manager.on_retire(
+                        a.req.session, a.seq_id, a.result.prompt,
+                        a.result.tokens, trace_id=a.result.trace_id)
+                if not resident:
+                    self.pool.free_seq(a.seq_id)
                 reserved_pages -= a.charged
                 if self.prefix_cache is not None:
                     self.prefix_cache.forget_seq(a.seq_id)
@@ -1018,6 +1066,8 @@ class ContinuousBatchingLoop:
                 while waiting and len(active) < self.max_batch:
                     req, seq, rt = waiting[0]
                     hd = req.handoff
+                    mgr = self.session_manager
+                    plan = None
                     m = None
                     matched = 0
                     if hd is not None:
@@ -1025,18 +1075,55 @@ class ContinuousBatchingLoop:
                         # cache match was reserved by the handoff
                         # broker; the payload ships only the tail
                         matched = int(getattr(hd, "matched_tokens", 0))
-                    elif self.prefix_cache is not None:
-                        m = self.prefix_cache.match(req.prompt)
-                        matched = m.tokens
+                    else:
+                        if mgr is not None and req.session is not None:
+                            # tiered session: can retained KV (pool-
+                            # resident or host-parked) serve this turn?
+                            # Planning pins the session against the
+                            # spill writer until admit/abort
+                            plan = mgr.plan_resume(req.session,
+                                                   seq.prompt)
+                        if plan is not None:
+                            # parked resumes discount only the prefix
+                            # pages pinned across the park (they attach
+                            # without free-list pressure — the handoff
+                            # reservation argument); a RESIDENT resume
+                            # charges its full footprint, conservative
+                            # but sound once its pages stop being
+                            # manager-locked
+                            matched = plan.charge_matched
+                        elif self.prefix_cache is not None:
+                            m = self.prefix_cache.match(req.prompt)
+                            matched = m.tokens
                     need = self._footprint(req, matched)
                     locked = (self.pool.uncharged_live_pages()
-                              if self.prefix_cache is not None else 0)
+                              if (self.prefix_cache is not None
+                                  or mgr is not None) else 0)
+                    if mgr is not None:
+                        # idle sessions' resident pages are set aside
+                        # like live attached pages — no admission
+                        # charge covers them, but make_room below can
+                        # spill them to the host tier on demand
+                        locked += mgr.locked_pages()
                     if reserved_pages + need > self.pool.num_pages - locked:
+                        if plan is not None:
+                            mgr.abort_resume(plan)
+                        if mgr is not None:
+                            short = (reserved_pages + need
+                                     - (self.pool.num_pages - locked))
+                            if mgr.make_room(short) > 0:
+                                continue  # re-plan against freed pages
                         break  # wait for retirements
                     waiting.pop(0)
-                    seq.seq_id = self._next_seq_id
-                    self._next_seq_id += 1
-                    self.pool.allocate(seq.seq_id)
+                    if plan is not None and plan.kind == "resident":
+                        # the session's sequence (and its pages) are
+                        # still in the pool — continue it instead of
+                        # allocating a fresh table
+                        seq.seq_id = plan.session.seq_id
+                    else:
+                        seq.seq_id = self._next_seq_id
+                        self._next_seq_id += 1
+                        self.pool.allocate(seq.seq_id)
                     if hd is not None:
                         # attach the reserved shared prefix (if any)
                         # and import the shipped pages — ONE atomic
@@ -1048,6 +1135,23 @@ class ContinuousBatchingLoop:
                             self.cached_prefill_tokens += matched
                         elif self.prefix_cache is not None:
                             self.prefix_misses += 1
+                    elif plan is not None:
+                        # resume the session's KV: resident tables
+                        # continue in place (truncated where the new
+                        # prompt diverges); parked payloads re-attach
+                        # their pinned prefix and import the tail — a
+                        # corrupt/lost payload degrades to the prefix
+                        # alone (typed, counted), never garbage
+                        matched = mgr.resume(plan, seq.seq_id,
+                                             trace_id=seq.trace_id)
+                        self.session_resumes += 1
+                        self.session_resumed_tokens += matched
+                        if self.prefix_cache is not None:
+                            if matched:
+                                self.prefix_hits += 1
+                                self.cached_prefill_tokens += matched
+                            else:
+                                self.prefix_misses += 1
                     elif m is not None:
                         matched = self.prefix_cache.attach(seq.seq_id, m)
                         if matched:
@@ -1055,6 +1159,9 @@ class ContinuousBatchingLoop:
                             self.cached_prefill_tokens += matched
                         else:
                             self.prefix_misses += 1
+                    if mgr is not None and req.session is not None \
+                            and hd is None and plan is None:
+                        self.session_fresh += 1
                     seq.admitted_at = time.perf_counter()
                     a = _Active(req, seq.seq_id, seq, rt=rt)
                     a.pos = matched
@@ -1108,8 +1215,10 @@ class ContinuousBatchingLoop:
                             retire([a], now0)
                 # NOTE: waiting-but-nothing-active cannot happen — the
                 # up-front validation guarantees the head request fits an
-                # empty pool (locked pages are 0 with no live readers),
-                # so admission always progresses
+                # empty pool (locked pages are 0 with no live readers,
+                # and manager-locked sessions spill to the host tier via
+                # make_room before admission gives up), so admission
+                # always progresses
 
                 whole_group = [a for a in newly if a.whole]
                 if whole_group:
@@ -1480,6 +1589,11 @@ class ContinuousBatchingLoop:
                     self.prefix_cache.forget_seq(a.seq_id)
                 if getattr(self.drafter, "stateful", False):
                     self.drafter.release(a.seq_id)
+                if self.session_manager is not None \
+                        and a.req.session is not None:
+                    # the pool side is freed above: the session must
+                    # not believe it still owns a resident sequence
+                    self.session_manager.on_quarantine(a.req.session)
             active.clear()
             raise
         return results

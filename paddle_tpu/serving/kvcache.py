@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -130,6 +131,20 @@ class SeqExport:
         if self.k_scales is not None:
             n += self.k_scales.nbytes + self.v_scales.nbytes
         return n
+
+    def checksum(self) -> int:
+        """CRC32 over the payload body (k, v, and any int8 scales) —
+        the host KV tier records this at park and verifies at fetch so
+        a corrupted parked payload is a typed rejection, never an
+        imported-garbage sequence."""
+        crc = zlib.crc32(np.ascontiguousarray(self.k).view(np.uint8))
+        crc = zlib.crc32(np.ascontiguousarray(self.v).view(np.uint8), crc)
+        if self.k_scales is not None:
+            crc = zlib.crc32(
+                np.ascontiguousarray(self.k_scales).view(np.uint8), crc)
+            crc = zlib.crc32(
+                np.ascontiguousarray(self.v_scales).view(np.uint8), crc)
+        return crc & 0xFFFFFFFF
 
 
 class KVCachePool:

@@ -1,0 +1,953 @@
+"""Tiered KV cache: park idle sessions' KV pages in host RAM.
+
+One chip's HBM caps concurrent sessions — every idle multi-turn
+conversation holds its KV pages hostage between turns.  This module
+adds the host tier that removes that bound:
+
+- :class:`HostKVTier` — a checksummed parking lot for
+  :class:`~paddle_tpu.serving.kvcache.SeqExport` payloads in host
+  buffers, with byte-capacity accounting, LRU order, and its own
+  ``check_invariants`` (a parked payload must still match the CRC it
+  parked with — a corrupted or lost payload is a typed rejection at
+  resume time, never imported garbage).
+- :class:`TieredSessionManager` — decides WHEN.  Sessions retire
+  RESIDENT (their pool pages stay live between turns); an LRU/idle
+  victim policy spills them (``export_seq`` → park → ``free_seq``,
+  pages freed only after the park lands — the fleet collector's ack
+  discipline) either asynchronously on a spill-writer thread
+  (overlapped with decode) or inline under pool-pressure via the
+  pool's reclaimer hook.  A resume re-attaches the spill-time
+  prefix-cache match (pinned across the park exactly like a fleet
+  ``PrefixReservation``) and imports only the unshared tail through
+  the atomic ``append_tokens`` claim.
+- :class:`TierSession` — the per-conversation carrier a caller puts on
+  ``DecodeRequest.session``; the decode loop's admission consults the
+  manager through it.
+
+Lock discipline mirrors :mod:`~paddle_tpu.serving.prefixcache`: the
+manager shares the POOL's RLock (so the pressure reclaimer, which runs
+inside ``append_tokens``' critical section, can spill inline on the
+same thread), and the tier keeps a private host-side lock that never
+takes the pool lock — pool→tier is the only acquisition order.
+
+Sizing math (README "Tiered KV cache"): the admission controller
+reserves against the COMBINED tier.  HBM admits
+``reserved_pages + need <= num_pages - locked`` where ``locked`` sets
+aside idle-resident sessions' pages and live attached pages no charge
+covers; when the bound fails, ``make_room`` moves idle sessions to the
+host tier — so session capacity is
+``num_pages + host_capacity_bytes / pool.bytes_per_page()`` pages,
+while ACTIVE decode is still bounded by HBM alone.  An admitted resume
+charged ``ceil((prompt+max_new - pinned_full)/page_size)`` pages can
+therefore never die mid-decode.
+
+Chaos: ``FAULT_SERVE_SPILL_CORRUPT`` poisons a payload after its CRC
+is recorded (resume sees :class:`SpillCorruptError` and re-prefills);
+``FAULT_SERVE_SPILL_DROP`` loses one parked payload at fetch
+(:class:`SpillMissingError`, same re-prefill fallback).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from ..observability import flight as _flight
+from ..resilience import faultinject as _finject
+from . import metrics as _smetrics
+from .kvcache import KVCachePool, SeqExport
+
+_log = logging.getLogger("paddle_tpu.serving.kvtier")
+
+__all__ = [
+    "HostKVTier",
+    "HostTierFullError",
+    "SpillCorruptError",
+    "SpillMissingError",
+    "TierSession",
+    "TieredSessionManager",
+]
+
+
+class HostTierFullError(RuntimeError):
+    """The host tier cannot hold this payload within its byte capacity
+    — the manager evicts LRU parked sessions and retries, and an
+    eviction's session falls back to a fresh prefill at resume."""
+
+
+class SpillCorruptError(RuntimeError):
+    """A parked payload failed its CRC at fetch — the resume must
+    reject it typed (never import garbage) and re-prefill."""
+
+
+class SpillMissingError(RuntimeError):
+    """The parked payload is gone (chaos drop or an eviction raced the
+    resume) — the resume falls back to a fresh prefill."""
+
+
+class _Parked:
+    __slots__ = ("key", "export", "crc", "nbytes")
+
+    def __init__(self, key, export: SeqExport, crc: int, nbytes: int):
+        self.key = key
+        self.export = export
+        self.crc = crc
+        self.nbytes = nbytes
+
+
+class HostKVTier:
+    """Pinned host buffers for exported sequences, CRC-verified.
+
+    ``capacity_bytes=0`` means unbounded (tests and single-tenant
+    tools); a bounded tier raises :class:`HostTierFullError` at park
+    and the manager decides who to evict.  Entries keep insertion
+    order = LRU order (a parked session is touched exactly twice:
+    park and fetch)."""
+
+    def __init__(self, capacity_bytes: int = 0, name: str = "host"):
+        if capacity_bytes < 0:
+            raise ValueError("capacity_bytes must be >= 0 (0 = unbounded)")
+        self.capacity_bytes = int(capacity_bytes)
+        self.name = name
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[object, _Parked]" = \
+            collections.OrderedDict()
+        self.bytes_used = 0
+        self._stats = {
+            "parks": 0, "fetches": 0, "discards": 0,
+            "corrupt_rejected": 0, "lost": 0,
+            "bytes_parked_total": 0, "bytes_fetched_total": 0,
+            "bytes_high_water": 0,
+        }
+
+    # -- capacity -------------------------------------------------------
+
+    def free_bytes(self) -> int:
+        if not self.capacity_bytes:
+            return 1 << 62  # unbounded
+        with self._lock:
+            return max(0, self.capacity_bytes - self.bytes_used)
+
+    def utilization(self) -> float:
+        if not self.capacity_bytes:
+            return 0.0
+        with self._lock:
+            return self.bytes_used / float(self.capacity_bytes)
+
+    # -- park / fetch / discard ----------------------------------------
+
+    def park(self, key, export: SeqExport) -> int:
+        """Take ownership of `export` under `key`; returns its bytes.
+        The CRC is recorded BEFORE the chaos hook runs, so a poisoned
+        payload is detectable at fetch — the never-import-garbage bar."""
+        n = export.nbytes()
+        with self._lock:
+            if key in self._entries:
+                raise ValueError(f"key {key!r} is already parked")
+            if self.capacity_bytes \
+                    and self.bytes_used + n > self.capacity_bytes:
+                raise HostTierFullError(
+                    f"host tier '{self.name}' holds {self.bytes_used} of "
+                    f"{self.capacity_bytes} bytes; payload needs {n}")
+            crc = export.checksum()
+            if _finject.serve_spill_corrupt():
+                # chaos: silent host-memory corruption after the park —
+                # flip one byte of the payload body so the fetch-side
+                # CRC verify must catch it (exports of a jax-backed
+                # pool are read-only views, hence the copy)
+                bad = export.k.copy()
+                bad.reshape(-1).view(np.uint8)[0] ^= 0xFF
+                export.k = bad
+            self._entries[key] = _Parked(key, export, crc, n)
+            self.bytes_used += n
+            self._stats["parks"] += 1
+            self._stats["bytes_parked_total"] += n
+            self._stats["bytes_high_water"] = max(
+                self._stats["bytes_high_water"], self.bytes_used)
+        return n
+
+    def fetch(self, key) -> SeqExport:
+        """Unpark: the entry leaves the tier whether or not the payload
+        verifies — a rejected payload must not be retried into a
+        session forever.  Raises typed on loss or corruption."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self.bytes_used -= e.nbytes
+            if e is None:
+                self._stats["lost"] += 1
+            elif _finject.serve_spill_drop():
+                self._stats["lost"] += 1
+                e = None
+        if e is None:
+            raise SpillMissingError(
+                f"no parked payload under key {key!r} in host tier "
+                f"'{self.name}' (evicted, dropped, or never parked)")
+        if e.export.checksum() != e.crc:
+            with self._lock:
+                self._stats["corrupt_rejected"] += 1
+            raise SpillCorruptError(
+                f"parked payload {key!r} failed its CRC — rejecting "
+                "instead of importing garbage")
+        with self._lock:
+            self._stats["fetches"] += 1
+            self._stats["bytes_fetched_total"] += e.nbytes
+        return e.export
+
+    def discard(self, key) -> int:
+        """Drop a parked payload (eviction / session close); returns
+        the bytes freed (0 when the key was not parked)."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return 0
+            self.bytes_used -= e.nbytes
+            self._stats["discards"] += 1
+            return e.nbytes
+
+    def lru_key(self):
+        """Oldest parked key (eviction candidate), or None."""
+        with self._lock:
+            return next(iter(self._entries), None)
+
+    def keys(self) -> List:
+        with self._lock:
+            return list(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> int:
+        with self._lock:
+            freed = self.bytes_used
+            self._entries.clear()
+            self.bytes_used = 0
+            return freed
+
+    # -- audit ----------------------------------------------------------
+
+    def check_invariants(self) -> Dict:
+        """Tier-side audit: byte accounting must match the entries, and
+        every parked payload must still verify against its park-time
+        CRC (a parked page is owned and INTACT, not orphaned)."""
+        with self._lock:
+            errors: List[str] = []
+            total = sum(e.nbytes for e in self._entries.values())
+            if total != self.bytes_used:
+                errors.append(
+                    f"bytes_used {self.bytes_used} != sum of entries "
+                    f"{total}")
+            for key, e in self._entries.items():
+                if e.export.checksum() != e.crc:
+                    errors.append(f"entry {key!r} fails its CRC")
+            return {"ok": not errors, "entries": len(self._entries),
+                    "bytes_used": self.bytes_used, "errors": errors}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            st = dict(self._stats)
+            st["entries"] = len(self._entries)
+            st["bytes_used"] = self.bytes_used
+            st["capacity_bytes"] = self.capacity_bytes
+            return st
+
+
+# session lifecycle: fresh -> active -> idle -> (spilling -> parked ->
+# resuming -> active)* -> closed; quarantine resets any state to fresh
+_SPILLABLE = ("idle",)
+
+
+class TierSession:
+    """One multi-turn conversation's KV residency state.  Created by
+    :meth:`TieredSessionManager.open_session` and carried on
+    ``DecodeRequest.session``; all transitions run inside the manager
+    (under the pool lock)."""
+
+    __slots__ = ("manager", "session_id", "state", "seq_id", "history",
+                 "pinned_keys", "pinned_pages", "pinned_tokens",
+                 "parked_bytes", "last_used", "last_trace_id",
+                 "last_freed", "spills", "resumes", "_spilled_ev")
+
+    def __init__(self, manager: "TieredSessionManager", session_id: int):
+        self.manager = manager
+        self.session_id = session_id
+        self.state = "fresh"
+        self.seq_id: Optional[int] = None
+        # tokens whose K/V the session retains (pool-resident or
+        # parked) — the strict prefix the next turn's prompt must carry
+        self.history: List[int] = []
+        # spill-time prefix-cache match, refcount-pinned across the
+        # park so resume can always re-attach (the PrefixReservation
+        # idiom) — export ships only the tail past pinned_tokens
+        self.pinned_keys: List[str] = []
+        self.pinned_pages: List[int] = []
+        self.pinned_tokens = 0
+        self.parked_bytes = 0
+        self.last_used = 0
+        self.last_trace_id: Optional[str] = None
+        self.last_freed = 0
+        self.spills = 0
+        self.resumes = 0
+        self._spilled_ev = threading.Event()
+
+    def resumable(self) -> bool:
+        return self.state in ("idle", "spilling", "parked")
+
+    def tokens_retained(self) -> int:
+        return len(self.history)
+
+    def close(self) -> None:
+        self.manager.close_session(self)
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (f"TierSession(id={self.session_id}, state={self.state}, "
+                f"seq={self.seq_id}, tokens={len(self.history)})")
+
+
+class _ResumePlan:
+    """Admission-time resume decision, held while the loop checks its
+    reservation bound.  Planning CASes the session to ``resuming`` so
+    the spill writer / pressure reclaimer cannot steal it between the
+    plan and the acquire; an admission that breaks instead calls
+    :meth:`TieredSessionManager.abort_resume`."""
+
+    __slots__ = ("session", "kind", "present", "charge_matched")
+
+    def __init__(self, session: TierSession, kind: str, present: int,
+                 charge_matched: int):
+        self.session = session
+        self.kind = kind                    # "resident" | "parked"
+        self.present = present              # KV tokens after acquire
+        self.charge_matched = charge_matched  # footprint discount
+
+
+class TieredSessionManager:
+    """Decides when sessions spill to the host tier and how they come
+    back.  Wire it to the pool (and the pool's prefix cache) and hand
+    it to the decode loop::
+
+        pool = KVCachePool(...)
+        cache = PrefixCache(pool)
+        mgr = TieredSessionManager(pool, prefix_cache=cache,
+                                   host_bytes=1 << 30)
+        loop = ContinuousBatchingLoop(params, cfg, pool,
+                                      prefix_cache=cache,
+                                      session_manager=mgr)
+        sess = mgr.open_session()
+        loop.run([DecodeRequest(prompt, n, session=sess)])
+
+    The constructor registers the manager as the pool's pressure
+    reclaimer (idle sessions spill INLINE when ``append_tokens`` runs
+    short — the fleet's queue-depth pressure arrives through exactly
+    this hook), external owner (a parked session's pinned prefix pages
+    are owned, not orphaned, to ``check_invariants``), and defrag
+    remap listener."""
+
+    def __init__(self, pool: KVCachePool, prefix_cache=None,
+                 host_bytes: int = 0, tier: Optional[HostKVTier] = None,
+                 spill_after_s: float = 0.0, name: str = "kvtier"):
+        if prefix_cache is not None and prefix_cache.pool is not pool:
+            raise ValueError(
+                "prefix_cache is wired to a different pool — the "
+                "spill-time match must pin pages in the pool sessions "
+                "spill from")
+        self.pool = pool
+        self.cache = prefix_cache
+        self.tier = tier if tier is not None else HostKVTier(host_bytes)
+        self.name = name
+        # idle-age threshold for spill_idle() (0 = any idle session)
+        self.spill_after_s = float(spill_after_s)
+        self._lock = pool._lock  # ONE lock: see module docstring
+        self._sessions: Dict[int, TierSession] = {}
+        self._next_session = 0
+        # page -> transfer holds this manager has taken (spill-time
+        # pins, live from retain to resume-attach/discard) — the owner
+        # hook's ground truth, covering the mid-spill window too
+        self._pin_holds: Dict[int, int] = {}
+        self._stats = {
+            "spills": 0, "resumes": 0, "resumed_resident": 0,
+            "resumed_host": 0, "re_prefills": 0, "evictions": 0,
+            "mismatch_resets": 0, "pressure_spills": 0,
+            "spill_aborts": 0,
+        }
+        self._closing = False
+        pool.register_reclaimer(self._reclaim)
+        pool.register_owner(self._holds)
+        pool.register_remap_hook(self._remap)
+        self._spill_q: "queue.Queue[Optional[TierSession]]" = queue.Queue()
+        self._writer = threading.Thread(
+            target=self._spill_loop, daemon=True,
+            name=f"{name}-spill-writer")
+        self._writer.start()
+
+    # -- session lifecycle ---------------------------------------------
+
+    def open_session(self) -> TierSession:
+        with self._lock:
+            if self._closing:
+                raise RuntimeError(f"manager {self.name} is closed")
+            sid = self._next_session
+            self._next_session += 1
+            s = TierSession(self, sid)
+            self._sessions[sid] = s
+            return s
+
+    def close_session(self, s: TierSession) -> None:
+        """Release everything the session holds in either tier."""
+        with self._lock:
+            in_flight = s.state == "spilling"
+        if in_flight:
+            s._spilled_ev.wait(10.0)  # let the writer land its park
+        with self._lock:
+            if s.state == "idle" and s.seq_id is not None:
+                self.pool.free_seq(s.seq_id)
+            if s.state == "parked":
+                self.tier.discard(s.session_id)
+                self._unpin(s)
+            s.state = "closed"
+            s.seq_id = None
+            s.history = []
+            self._sessions.pop(s.session_id, None)
+
+    def close(self) -> None:
+        """Drain the writer and release every session — after this,
+        zero pages in the pool and zero bytes in the tier belong to
+        sessions (the leak bar both tiers are audited against)."""
+        with self._lock:
+            self._closing = True
+            sessions = list(self._sessions.values())
+        self._spill_q.put(None)
+        self._writer.join(timeout=10.0)
+        for s in sessions:
+            self.close_session(s)
+
+    # -- the decode loop's admission surface ---------------------------
+
+    def plan_resume(self, s: TierSession,
+                    prompt: Sequence[int]) -> Optional[_ResumePlan]:
+        """Admission probe: can this request resume `s`?  Returns a
+        plan (session CASed to ``resuming``) or None for the fresh
+        path.  A diverged history resets the session (its retained KV
+        is useless for this prompt)."""
+        while True:
+            with self._lock:
+                if s.manager is not self:
+                    raise ValueError("session belongs to another manager")
+                st = s.state
+                if st == "idle":
+                    c = self._common_prefix(s.history, prompt)
+                    if c <= 0:
+                        self._reset_resident(s, why="mismatch")
+                        return None
+                    s.state = "resuming"
+                    return _ResumePlan(s, "resident", present=c,
+                                      charge_matched=0)
+                if st == "parked":
+                    kv = len(s.history)
+                    if kv > len(prompt) - 1 \
+                            or list(prompt[:kv]) != s.history:
+                        self._discard_parked(s, why="mismatch")
+                        return None
+                    s.state = "resuming"
+                    return _ResumePlan(s, "parked", present=kv,
+                                      charge_matched=s.pinned_tokens)
+                if st != "spilling":
+                    return None  # fresh/active/closed: normal path
+                ev = s._spilled_ev
+            # a spill is in flight on the writer — wait for it to land
+            # (pages freed + payload parked), then re-plan as parked
+            if not ev.wait(10.0):
+                return None
+
+    def abort_resume(self, plan: _ResumePlan) -> None:
+        """The admission bound broke after planning: put the session
+        back where the plan found it."""
+        with self._lock:
+            if plan.session.state == "resuming":
+                plan.session.state = (
+                    "idle" if plan.kind == "resident" else "parked")
+
+    def resume(self, plan: _ResumePlan, seq_id: int,
+               trace_id: Optional[str] = None) -> int:
+        """Acquire the planned KV for `seq_id`; returns the tokens now
+        present (``a.pos`` starts there).  Resident: the session's own
+        table continues (truncated when the new prompt diverges inside
+        it).  Parked: re-attach the pinned prefix, then import the
+        parked tail — a corrupt/lost payload degrades to the pinned
+        prefix alone (typed, counted, re-prefilled), never garbage."""
+        s = plan.session
+        obs_on = _flags._VALUES["FLAGS_observability"]
+        if plan.kind == "resident":
+            with self._lock:
+                if s.seq_id != seq_id:
+                    raise ValueError(
+                        f"resident resume must reuse seq {s.seq_id}, "
+                        f"got {seq_id}")
+                if plan.present < self.pool.length(seq_id):
+                    self.pool.truncate_seq(seq_id, plan.present)
+                s.history = s.history[:plan.present]
+                s.state = "active"
+                s.resumes += 1
+                self._stats["resumes"] += 1
+                self._stats["resumed_resident"] += 1
+            if obs_on:
+                _smetrics.record_tier_event("resume_resident")
+                _flight.default_flight().record(
+                    "resume", session=s.session_id, seq_id=seq_id,
+                    tier="hbm", tokens=plan.present, bytes=0,
+                    trace_id=trace_id)
+                self._note_tier()
+            return plan.present
+        # parked
+        present = 0
+        nbytes = 0
+        fell_back = False
+        with self._lock:
+            if s.pinned_tokens:
+                if self.cache is not None:
+                    from .prefixcache import PrefixMatch
+
+                    self.cache.attach(seq_id, PrefixMatch(
+                        keys=list(s.pinned_keys),
+                        pages=list(s.pinned_pages),
+                        tokens=s.pinned_tokens))
+                else:
+                    self.pool.attach_prefix(
+                        seq_id, list(s.pinned_pages), s.pinned_tokens)
+                present = s.pinned_tokens
+                self._unpin(s)
+        try:
+            export = self.tier.fetch(s.session_id)
+            with self._lock:
+                self.pool.import_seq(export, seq_id)
+            present = export.length
+            nbytes = export.nbytes()
+        except (SpillCorruptError, SpillMissingError) as e:
+            fell_back = True
+            with self._lock:
+                self._stats["re_prefills"] += 1
+            _log.warning(
+                "session %d resume fell back to re-prefill at %d "
+                "tokens: %s", s.session_id, present, e)
+            if obs_on:
+                _smetrics.record_tier_event("re_prefill")
+                _flight.default_flight().record(
+                    "spill_reject", session=s.session_id, seq_id=seq_id,
+                    reason=type(e).__name__, tokens_kept=present,
+                    trace_id=trace_id)
+        with self._lock:
+            s.state = "active"
+            s.seq_id = seq_id
+            s.history = s.history[:present]
+            s.parked_bytes = 0
+            s.resumes += 1
+            self._stats["resumes"] += 1
+            if not fell_back:
+                self._stats["resumed_host"] += 1
+        if obs_on:
+            if not fell_back:
+                _smetrics.record_tier_event("resume_host")
+                _smetrics.record_tier_transfer(nbytes, "resume")
+            _flight.default_flight().record(
+                "resume", session=s.session_id, seq_id=seq_id,
+                tier="host", tokens=present, bytes=nbytes,
+                trace_id=trace_id)
+            self._note_tier()
+        return present
+
+    def on_retire(self, s: TierSession, seq_id: int,
+                  prompt: Sequence[int], generated: Sequence[int],
+                  trace_id: Optional[str] = None) -> bool:
+        """A sequence carrying this session retired cleanly: adopt its
+        pool pages (the loop skips ``free_seq``) and go idle.  Returns
+        False when the session cannot keep residency (closed/stale) —
+        the loop then frees the pages as usual."""
+        with self._lock:
+            if self._closing or s.state not in ("fresh", "active"):
+                return False
+            kv = self.pool.length(seq_id)
+            s.seq_id = seq_id
+            s.history = ([int(t) for t in prompt]
+                         + [int(t) for t in generated])[:kv]
+            s.state = "idle"
+            s.last_used = self._now()
+            s.last_trace_id = trace_id
+            s._spilled_ev.clear()
+            return True
+
+    def on_quarantine(self, s: TierSession) -> None:
+        """The carrying sequence was quarantined (or the run died): the
+        pool side is already freed by the evictor — reset the session
+        so its next turn prefills fresh."""
+        with self._lock:
+            if s.state == "parked":
+                self.tier.discard(s.session_id)
+                self._unpin(s)
+            s.state = "fresh"
+            s.seq_id = None
+            s.history = []
+            s.parked_bytes = 0
+
+    def locked_pages(self) -> int:
+        """Pool pages held by IDLE (or mid-spill) sessions that no
+        active admission reservation covers — the admission bound sets
+        exactly these aside (and ``make_room`` can free them).  Pages
+        an idle session merely shares with a live charged sequence are
+        that charge's problem, not ours."""
+        with self._lock:
+            n = 0
+            seen = set()
+            for s in self._sessions.values():
+                if s.state not in ("idle", "spilling", "resuming") \
+                        or s.seq_id is None:
+                    continue
+                h = self.pool._tables.get(s.seq_id)
+                if h is None:
+                    continue
+                for p in h.pages:
+                    if p not in seen \
+                            and self.pool._allocator.get(p) == s.seq_id:
+                        seen.add(p)
+                        n += 1
+            return n
+
+    def make_room(self, pages_short: int, wait_s: float = 5.0) -> int:
+        """Admission pressure (waiting requests that do not fit): spill
+        idle sessions — and, if still short, evict parked sessions'
+        pinned pages — until `pages_short` pool pages came free.
+        Returns pages actually freed; the caller re-checks its bound."""
+        freed = self._free_pages(int(pages_short))
+        if freed >= pages_short:
+            return freed
+        # async spills already in flight may land momentarily
+        with self._lock:
+            pending = [s for s in self._sessions.values()
+                       if s.state == "spilling"]
+        for s in pending:
+            if s._spilled_ev.wait(wait_s):
+                freed += s.last_freed
+        return freed
+
+    # -- spill machinery ------------------------------------------------
+
+    def spill(self, s: TierSession, wait: bool = False) -> bool:
+        """Queue one idle session for the spill writer (async device→
+        host copy overlapped with decode).  ``wait=True`` blocks until
+        the payload is parked and the pages are freed.  Returns False
+        when the session was not spillable."""
+        with self._lock:
+            if s.state not in _SPILLABLE:
+                return False
+        self._spill_q.put(s)
+        if wait:
+            s._spilled_ev.wait(30.0)
+        return True
+
+    def spill_idle(self, older_than_s: Optional[float] = None,
+                   wait: bool = False) -> int:
+        """Proactive spill: queue every session idle longer than the
+        threshold (None reads ``spill_after_s``; 0 = all idle).  The
+        fleet's load signals call this when queue depth climbs."""
+        cutoff = self.spill_after_s if older_than_s is None \
+            else float(older_than_s)
+        now = self._now()
+        with self._lock:
+            victims = [s for s in self._sessions.values()
+                       if s.state in _SPILLABLE
+                       and now - s.last_used >= cutoff]
+        n = 0
+        for s in victims:
+            if self.spill(s, wait=wait):
+                n += 1
+        return n
+
+    def _spill_loop(self) -> None:
+        while True:
+            s = self._spill_q.get()
+            if s is None:
+                return
+            if not self._begin_spill(s):
+                continue
+            try:
+                self._spill_one(s, why="writer")
+            except Exception:  # noqa: BLE001 — writer must survive
+                _log.exception("spill writer: session %d spill failed",
+                               s.session_id)
+                with self._lock:
+                    if s.state == "spilling":
+                        s.state = "idle"
+                s._spilled_ev.set()
+
+    def _begin_spill(self, s: TierSession) -> bool:
+        with self._lock:
+            if s.state not in _SPILLABLE:
+                return False
+            s.state = "spilling"
+            s._spilled_ev.clear()
+            return True
+
+    def _spill_one(self, s: TierSession, why: str) -> int:
+        """Export → park → free, in that order (ack discipline: device
+        pages are freed only after the park returned).  The caller has
+        CASed the session to ``spilling``.  Returns pool pages freed."""
+        pool = self.pool
+        with self._lock:
+            seq = s.seq_id
+            skip = 0
+            keys: List[str] = []
+            pages: List[int] = []
+            if self.cache is not None and len(s.history) > 1:
+                m = self.cache.match(s.history)
+                full_pages = m.tokens // pool.page_size
+                if full_pages:
+                    pages = [int(p) for p in m.pages[:full_pages]]
+                    keys = list(m.keys[:full_pages])
+                    skip = full_pages * pool.page_size
+                    pool.retain_pages(pages)
+                    for p in pages:
+                        self._pin_holds[p] = self._pin_holds.get(p, 0) + 1
+            try:
+                export = pool.export_seq(seq, skip_tokens=skip)
+            except BaseException:
+                self._release_pins(pages)
+                s.state = "idle"
+                s._spilled_ev.set()
+                raise
+        # park OUTSIDE the pool lock: the CRC pass + host copy must not
+        # stall decode (the writer-thread overlap this tier exists for)
+        nbytes = export.nbytes()
+        try:
+            self.tier.park(s.session_id, export)
+        except HostTierFullError:
+            if not self._evict_for(nbytes):
+                with self._lock:
+                    self._release_pins(pages)
+                    s.state = "idle"
+                    self._stats["spill_aborts"] += 1
+                s._spilled_ev.set()
+                _log.warning(
+                    "session %d spill aborted: host tier cannot fit "
+                    "%d bytes even after eviction", s.session_id, nbytes)
+                return 0
+            self.tier.park(s.session_id, export)
+        with self._lock:
+            freed = pool.free_seq(seq)
+            s.seq_id = None
+            s.pinned_keys, s.pinned_pages = keys, pages
+            s.pinned_tokens = skip
+            s.parked_bytes = nbytes
+            s.last_freed = freed
+            s.state = "parked"
+            s.spills += 1
+            self._stats["spills"] += 1
+            if why == "pressure":
+                self._stats["pressure_spills"] += 1
+        s._spilled_ev.set()
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_tier_event("spill")
+            _smetrics.record_tier_transfer(nbytes, "spill")
+            _flight.default_flight().record(
+                "spill", session=s.session_id, seq_id=seq, why=why,
+                bytes=nbytes, skip_tokens=skip, pages_freed=freed,
+                trace_id=s.last_trace_id)
+            self._note_tier()
+        return freed
+
+    def _evict_for(self, nbytes: int) -> bool:
+        """LRU-evict parked sessions until `nbytes` fit the tier (their
+        next resume re-prefills — counted, never lost)."""
+        while self.tier.capacity_bytes \
+                and self.tier.capacity_bytes - self.tier.bytes_used \
+                < nbytes:
+            key = self.tier.lru_key()
+            if key is None:
+                return False
+            with self._lock:
+                victim = self._sessions.get(key)
+                if victim is not None and victim.state == "parked":
+                    self._discard_parked(victim, why="capacity")
+                else:
+                    self.tier.discard(key)
+        return True
+
+    # -- pressure / eviction helpers -----------------------------------
+
+    def _free_pages(self, short: int) -> int:
+        """Free >= `short` pool pages if the tiers allow: spill idle
+        sessions LRU-first (inline — safe under the pool RLock), then
+        evict parked sessions' pinned prefix pages."""
+        freed = 0
+        with self._lock:
+            victims = sorted(
+                (s for s in self._sessions.values()
+                 if s.state in _SPILLABLE),
+                key=lambda s: s.last_used)
+        for s in victims:
+            if freed >= short:
+                return freed
+            if self._begin_spill(s):
+                freed += self._spill_one(s, why="pressure")
+        if freed < short:
+            with self._lock:
+                parked = sorted(
+                    (s for s in self._sessions.values()
+                     if s.state == "parked" and s.pinned_pages),
+                    key=lambda s: s.last_used)
+                for s in parked:
+                    if freed >= short:
+                        break
+                    freed += self._discard_parked(s, why="pressure")
+        return freed
+
+    def _reclaim(self, short: int) -> int:
+        """The pool's pressure-reclaimer hook: ``append_tokens`` ran
+        short mid-claim.  Runs UNDER the pool RLock on the claiming
+        thread — the inline-spill arm (the reason the manager shares
+        the pool's lock)."""
+        return self._free_pages(int(short))
+
+    def _discard_parked(self, s: TierSession, why: str) -> int:
+        """Drop a parked session's payload + pinned pages (caller holds
+        the lock); the session resets to fresh and its next turn
+        re-prefills.  Returns pool pages freed by unpinning."""
+        self.tier.discard(s.session_id)
+        freed = self._unpin(s)
+        s.state = "fresh"
+        s.history = []
+        s.parked_bytes = 0
+        s.seq_id = None
+        self._stats["evictions"] += 1
+        if why == "mismatch":
+            self._stats["mismatch_resets"] += 1
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_tier_event("evict")
+            _flight.default_flight().record(
+                "tier_evict", session=s.session_id, why=why,
+                trace_id=s.last_trace_id)
+        return freed
+
+    def _reset_resident(self, s: TierSession, why: str) -> None:
+        """Drop an idle session's residency (caller holds the lock)."""
+        if s.seq_id is not None:
+            self.pool.free_seq(s.seq_id)
+        s.state = "fresh"
+        s.seq_id = None
+        s.history = []
+        self._stats["mismatch_resets"] += 1
+
+    def _unpin(self, s: TierSession) -> int:
+        """Release the session's pinned prefix holds (caller holds the
+        lock); returns pages that actually came free."""
+        freed = self._release_pins(s.pinned_pages)
+        s.pinned_keys, s.pinned_pages, s.pinned_tokens = [], [], 0
+        return freed
+
+    def _release_pins(self, pages: Sequence[int]) -> int:
+        if not pages:
+            return 0
+        for p in pages:
+            n = self._pin_holds.get(p, 0) - 1
+            if n <= 0:
+                self._pin_holds.pop(p, None)
+            else:
+                self._pin_holds[p] = n
+        return self.pool.release_pages(pages)
+
+    # -- pool audit hooks ----------------------------------------------
+
+    def _holds(self) -> Dict[int, int]:
+        """External-owner hook: refcount holds the manager explains —
+        pinned prefix pages of parked (and mid-spill) sessions.  To
+        ``check_invariants`` a parked page is owned, not orphaned."""
+        return dict(self._pin_holds)
+
+    def _remap(self, remap: Dict[int, int]) -> None:
+        """Defrag moved pages: pins follow."""
+        self._pin_holds = {remap.get(p, p): n
+                           for p, n in self._pin_holds.items()}
+        for s in self._sessions.values():
+            if s.pinned_pages:
+                s.pinned_pages = [remap.get(p, p)
+                                  for p in s.pinned_pages]
+
+    # -- introspection --------------------------------------------------
+
+    def combined_capacity_pages(self) -> int:
+        """Total session-holding capacity in pages across both tiers —
+        the COMBINED reservation ceiling (README sizing math)."""
+        if not self.tier.capacity_bytes:
+            return 1 << 62
+        return self.pool.num_pages \
+            + self.tier.capacity_bytes // self.pool.bytes_per_page()
+
+    def parked_sessions(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._sessions.values()
+                       if s.state == "parked")
+
+    def idle_sessions(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._sessions.values()
+                       if s.state in ("idle", "spilling"))
+
+    def check_invariants(self) -> Dict:
+        """Both tiers' audit in one report: the pool's page invariants
+        (with the manager's pins explained through the owner hook) and
+        the host tier's byte/CRC bookkeeping."""
+        pool_report = self.pool.check_invariants()
+        tier_report = self.tier.check_invariants()
+        return {"ok": pool_report["ok"] and tier_report["ok"],
+                "pool": pool_report, "tier": tier_report}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            st = dict(self._stats)
+            st["sessions"] = len(self._sessions)
+            st["idle_sessions"] = sum(
+                1 for s in self._sessions.values()
+                if s.state in ("idle", "spilling"))
+            st["parked_sessions"] = sum(
+                1 for s in self._sessions.values()
+                if s.state == "parked")
+        st["tier"] = self.tier.stats()
+        return st
+
+    # -- internals ------------------------------------------------------
+
+    def _now(self) -> float:
+        import time
+
+        return time.monotonic()
+
+    def _note_tier(self) -> None:
+        """Tier gauges (callers gate on FLAGS_observability)."""
+        pool = self.pool
+        used = pool.used_pages
+        _smetrics.record_tier_gauges(
+            host_bytes=self.tier.bytes_used,
+            host_utilization=self.tier.utilization(),
+            parked_sessions=self.parked_sessions(),
+            hbm_utilization=used / float(pool.num_pages)
+            if pool.num_pages else 0.0)
+
+    @staticmethod
+    def _common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
+        """Longest common prefix of retained history `a` and the new
+        prompt `b`, capped at len(b)-1 so at least one prompt token
+        still runs through the model (the first-token logits source)."""
+        limit = min(len(a), len(b) - 1)
+        c = 0
+        while c < limit and int(a[c]) == int(b[c]):
+            c += 1
+        return c

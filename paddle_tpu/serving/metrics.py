@@ -69,6 +69,26 @@ autoscaling, serving/fleet/):
 - paddle_tpu_serving_fleet_replicas         gauge    {role=} live replicas
                                                       per class
 
+Tiered-KV-cache instruments (ISSUE 18 — host-RAM session parking,
+serving/kvtier.py):
+- paddle_tpu_serving_kvtier_events_total    counter  {event=spill|
+                                                      resume_resident|
+                                                      resume_host|evict|
+                                                      re_prefill} session
+                                                      spill/resume outcomes
+- paddle_tpu_serving_kvtier_transfer_bytes_total counter {direction=
+                                                      spill|resume} KV bytes
+                                                      moved device<->host
+- paddle_tpu_serving_host_tier_bytes        gauge    payload bytes parked
+                                                      in the host tier
+- paddle_tpu_serving_host_tier_utilization  gauge    parked/capacity (0
+                                                      when unbounded)
+- paddle_tpu_serving_parked_sessions        gauge    sessions whose KV
+                                                      lives host-side
+- paddle_tpu_serving_hbm_tier_utilization   gauge    pool used/total as
+                                                      seen by the tier
+                                                      manager
+
 Fault-isolation instruments (ISSUE 6):
 - paddle_tpu_serving_breaker_trips_total    counter  circuit-breaker opens
 - paddle_tpu_serving_dispatcher_restarts_total counter supervisor restarts
@@ -115,6 +135,9 @@ __all__ = [
     "record_prefix_event",
     "record_replica_health",
     "record_router_decision",
+    "record_tier_event",
+    "record_tier_gauges",
+    "record_tier_transfer",
 ]
 
 # occupancy lives in (0, 1]; the default step-time buckets would collapse
@@ -415,6 +438,51 @@ def record_pool_invariant_violation(pool: str = "kv") -> None:
         "paddle_tpu_serving_pool_invariant_violations",
         "KVCachePool.check_invariants audits that found a violation",
     ).inc(pool=pool)
+
+
+def record_tier_event(event: str, n: int = 1) -> None:
+    """One tiered-KV-cache outcome: ``spill`` (a session's KV parked
+    host-side), ``resume_resident`` (next turn found its KV still in
+    HBM), ``resume_host`` (parked payload imported back), ``evict``
+    (a parked payload dropped for capacity/pressure/mismatch — its
+    session re-prefills), ``re_prefill`` (a corrupt/lost payload was
+    rejected typed and the turn recomputed from the prompt)."""
+    default_registry().counter(
+        "paddle_tpu_serving_kvtier_events",
+        "tiered KV cache session spill/resume outcomes",
+    ).inc(n, event=event)
+
+
+def record_tier_transfer(nbytes: int, direction: str) -> None:
+    """KV payload bytes moved across the device<->host boundary by the
+    tier (``direction`` = spill | resume)."""
+    default_registry().counter(
+        "paddle_tpu_serving_kvtier_transfer_bytes",
+        "KV bytes moved between HBM and the host tier",
+    ).inc(nbytes, direction=direction)
+
+
+def record_tier_gauges(host_bytes: int, host_utilization: float,
+                       parked_sessions: int,
+                       hbm_utilization: float) -> None:
+    """Point-in-time tier occupancy (both tiers in one call)."""
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_host_tier_bytes",
+        "payload bytes parked in the host KV tier",
+    ).set(host_bytes)
+    reg.gauge(
+        "paddle_tpu_serving_host_tier_utilization",
+        "host KV tier utilization (0 when unbounded)",
+    ).set(host_utilization)
+    reg.gauge(
+        "paddle_tpu_serving_parked_sessions",
+        "sessions whose KV currently lives host-side",
+    ).set(parked_sessions)
+    reg.gauge(
+        "paddle_tpu_serving_hbm_tier_utilization",
+        "KV page-pool utilization as seen by the tier manager",
+    ).set(hbm_utilization)
 
 
 def record_pool_reclaim(pages: int, pool: str = "kv") -> None:

@@ -239,6 +239,50 @@ def _run(fleet, prompts, max_new=5, timeout=180):
     return [f.result(timeout=timeout).tokens for f in futs]
 
 
+def test_proc_fleet_prefix_reservation_skips_tokens(monkeypatch):
+    """Cross-process prefix reservations (ISSUE 18 bugfix): repeated
+    prompts through a 1+1 PROCESS fleet must reserve the decode
+    child's cached prefix over the `reserve_prefix` verb and ship only
+    the unshared tail (`skipped_tokens > 0`), matching the thread
+    fleet's planned-handoff numbers exactly — tokens, skipped tokens,
+    and handoff bytes."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    cfg = DecodeConfig()
+    params = init_decode_params(cfg, seed=0)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]  # 11 tokens, 2 full pages
+
+    def run_seq(fleet):
+        # sequential submits: each request retires (and its pages join
+        # the decode child's prefix cache) before the next one plans
+        return [fleet.submit(DecodeRequest(prompt=list(prompt),
+                                           max_new_tokens=4))
+                .result(timeout=180).tokens for _ in range(3)]
+
+    oracle = _thread_fleet(params, cfg)
+    want = run_seq(oracle)
+    ost = oracle.stats()
+    oracle.close()
+    assert ost["skipped_tokens"] > 0  # the oracle itself planned
+
+    spawner = ProcSpawner(params, cfg, prefill_kwargs=_POOL,
+                          decode_kwargs=_POOL)
+    fleet = Fleet(spawner.prefill, spawner.decode,
+                  n_prefill=1, n_decode=1)
+    try:
+        got = run_seq(fleet)
+        st = fleet.stats()
+        audit = fleet.audit()
+    finally:
+        fleet.close()
+        spawner.close()
+    assert got == want
+    assert st["skipped_tokens"] == ost["skipped_tokens"]
+    assert st["handoff_bytes"] == ost["handoff_bytes"]
+    assert st["lost_requests"] == 0 and st["failed"] == 0
+    assert st["re_prefills"] == 0  # no reservation was dropped
+    assert audit["pages_leaked"] == 0 and audit["invariants_ok"] == 1
+
+
 def test_proc_fleet_sigkill_failover_token_identical(monkeypatch):
     """The tentpole contract end to end: a 2+2 fleet of real processes
     takes a chaos SIGKILL on prefill0 mid-work (phase A) and an

@@ -60,7 +60,8 @@ def _disarm_faults():
     yield
     for k in ("FAULT_SERVE_DISPATCH_RAISE", "FAULT_SERVE_NAN_SEQ",
               "FAULT_SERVE_LEAK_PAGES", "FAULT_SERVE_SLOW_STEP_MS",
-              "FAULT_SERVE_PREFIX_CORRUPT"):
+              "FAULT_SERVE_PREFIX_CORRUPT", "FAULT_SERVE_SPILL_CORRUPT",
+              "FAULT_SERVE_SPILL_DROP"):
         os.environ.pop(k, None)
     faultinject.reset()
 
@@ -706,3 +707,76 @@ def test_serve_bench_chaos_engine_smoke(tmp_path, capsys):
     # serve_bench restored the observability flag it flipped on
     assert not obs.enabled()
     obs.reset()
+
+
+# -- host KV tier chaos (ISSUE 18) ---------------------------------------
+
+def _tiered_two_turns(fault=None, arm_before_turn=None):
+    """One session, two turns, spilled to host between them.  `fault`
+    is armed before turn `arm_before_turn` (1 = before the spill's
+    park, 2 = before the resume's fetch).  Returns (outputs, oracle
+    outputs, manager) with the manager already closed and leak-audited."""
+    from paddle_tpu.serving import TieredSessionManager
+
+    cfg = DecodeConfig(vocab_size=61, d_model=16, n_head=2, n_layer=2,
+                       d_inner=32, max_length=64)
+    params = init_decode_params(cfg, seed=12)
+    pool = KVCachePool(num_pages=32, page_size=4, num_layers=cfg.n_layer,
+                       num_heads=cfg.n_head, head_dim=cfg.head_dim)
+    mgr = TieredSessionManager(pool, host_bytes=1 << 26)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=1,
+                                  session_manager=mgr)
+    s = mgr.open_session()
+    p1 = [5, 1, 2, 3, 4, 5, 6, 7, 8]
+    outs, want = [], []
+    for turn, extra in enumerate(([], [9, 10, 11]), start=1):
+        if fault and arm_before_turn == turn:
+            os.environ[fault] = "1"
+            faultinject.reset()
+        p = p1 if turn == 1 else p1 + outs[0] + extra
+        (r,) = loop.run([DecodeRequest(prompt=list(p), max_new_tokens=4,
+                                       session=s)])
+        assert r.error is None, r.error
+        outs.append(r.tokens)
+        want.append(full_decode(params, cfg, p, 4)[0])
+        if turn == 1:
+            assert mgr.spill(s, wait=True) and s.state == "parked"
+    st = mgr.stats()
+    mgr.close()
+    assert pool.used_pages == 0
+    assert pool.check_invariants()["ok"]
+    assert len(mgr.tier) == 0
+    return outs, want, st
+
+
+def test_spill_corrupt_rejected_session_reprefills_correctly():
+    """FAULT_SERVE_SPILL_CORRUPT: the parked payload rots in host RAM.
+    The resume's CRC verify rejects it (never imports garbage), the
+    session re-prefills, and turn 2 is still token-identical."""
+    outs, want, st = _tiered_two_turns(
+        fault="FAULT_SERVE_SPILL_CORRUPT", arm_before_turn=1)
+    assert outs == want
+    assert st["re_prefills"] == 1
+    assert st["tier"]["corrupt_rejected"] == 1
+    assert st["resumed_host"] == 0  # the one resume fell back
+
+
+def test_spill_drop_lost_payload_session_reprefills_correctly():
+    """FAULT_SERVE_SPILL_DROP: the parked payload vanishes before the
+    resume fetches it — typed SpillMissingError fallback, counted,
+    and turn 2 still matches the oracle."""
+    outs, want, st = _tiered_two_turns(
+        fault="FAULT_SERVE_SPILL_DROP", arm_before_turn=2)
+    assert outs == want
+    assert st["re_prefills"] == 1
+    assert st["tier"]["lost"] == 1
+    assert st["resumed_host"] == 0
+
+
+def test_tiered_turns_clean_baseline_no_reprefill():
+    """The same scenario unarmed: the resume comes back from host with
+    no fallback — the teeth arms above fail without their knobs."""
+    outs, want, st = _tiered_two_turns()
+    assert outs == want
+    assert st["re_prefills"] == 0 and st["resumed_host"] == 1
+    assert st["tier"]["corrupt_rejected"] == 0 and st["tier"]["lost"] == 0

@@ -17,6 +17,13 @@
 #              round-trip (acceptance/speedup banked, replay
 #              determinism checked in-process) and a gate-teeth arm
 #              banking an unreachable spec_speedup that must exit 3
+#   kvtier   - tiered KV cache smoke (ISSUE 18): a multi-turn chat
+#              replay (serve_bench --turns) with idle sessions parked
+#              to host RAM between turns — the gate banks
+#              resume_hit_rate=1, re_prefills=0, retention_ratio>1
+#              and zero leaks; the teeth arm re-runs --no-tier
+#              (every turn re-prefills) against the tiered bank,
+#              which must exit 3
 #   procfleet - process-level fleet smoke (ISSUE 17): serve_bench
 #              --fleet --procs 2 with FAULT_SERVE_PROC_KILL armed —
 #              a live replica pid is SIGKILLed mid-run and the gate
@@ -138,6 +145,36 @@ JSON
   rm -rf "$tmp"
 }
 
+run_kvtier() {
+  echo "== tiered KV smoke (multi-turn chat, host-RAM spill/resume) =="
+  tmp="$(mktemp -d)"
+  # the banked contract: every resumable turn resumes (no fallback
+  # re-prefill), the retained conversation state exceeds what HBM
+  # alone holds, and both tiers audit leak-free
+  cat > "$tmp/bank.json" <<'JSON'
+{"resume_hit_rate": 1.0, "re_prefills": 0, "retention_ratio": 1.0,
+ "pages_leaked": 0, "invariants_ok": 1, "errored_sequences": 0}
+JSON
+  python tools/serve_bench.py --mode decode --turns 3 --sequences 8 \
+    --max-new 6 --prompt-range 8,12 --d-model 16 --vocab 61 \
+    --max-len 64 --pages 64 --page-size 4 --max-batch 2 \
+    --json "$tmp/kvtier.json" --baseline "$tmp/bank.json" --gate
+  echo "== kvtier teeth: --no-tier re-prefills every turn, must exit 3 =="
+  set +e
+  python tools/serve_bench.py --mode decode --turns 3 --sequences 8 \
+    --max-new 6 --prompt-range 8,12 --d-model 16 --vocab 61 \
+    --max-len 64 --pages 64 --page-size 4 --max-batch 2 --no-tier \
+    --baseline "$tmp/bank.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "kvtier teeth: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "kvtier teeth OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_procfleet() {
   echo "== process fleet smoke (SIGKILL a live pid; nothing lost) =="
   tmp="$(mktemp -d)"
@@ -181,9 +218,10 @@ case "$stage" in
   lint)   run_lint ;;
   fleet)  run_fleet ;;
   spec)   run_spec ;;
+  kvtier) run_kvtier ;;
   procfleet) run_procfleet ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_procfleet; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|procfleet|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_kvtier; run_procfleet; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|kvtier|procfleet|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

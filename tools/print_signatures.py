@@ -58,6 +58,10 @@ MODULES = [
     # non-finite eviction used by BOTH the monolithic loop and the
     # prefill replica
     "paddle_tpu.serving.prefill_sched",
+    # tiered KV cache (ISSUE 18): the host-RAM spill tier and the
+    # session manager operators wire between pool and loop for
+    # multi-turn chat are serving API
+    "paddle_tpu.serving.kvtier",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

@@ -744,6 +744,141 @@ def run_decode_bench(args) -> dict:
     return result
 
 
+def run_multiturn_bench(args) -> dict:
+    """--turns N (decode mode): the multi-turn chat replay the tiered
+    KV cache (ISSUE 18) exists for.  --sequences sessions each hold a
+    conversation of N turns; between turns every session idles for
+    --think-time-s and is parked to host RAM (``spill_idle`` — the
+    proactive policy a deployment runs on think time), so turn k+1
+    must resume from the host tier instead of re-prefilling its whole
+    transcript.
+
+    Banked contract (0/2/3 gate): resume_hit_rate == resumed turns /
+    resumable turns (1.0 when the tier does its job), re_prefills == 0
+    (no resume fell back to recompute), host_transfer_bytes (the
+    deterministic spill+resume traffic), first-turn vs resumed-turn
+    TTFT percentiles, and retention_ratio — conversation tokens still
+    resumable across all sessions over the HBM pool's token capacity;
+    > 1.0 is the headline: the tier retains more concurrent chat state
+    than HBM alone could hold.  --no-tier replays the same workload
+    with no session manager (every turn re-prefills from scratch) —
+    the CI teeth arm gates that against the tiered baseline and must
+    fail."""
+    from paddle_tpu import serving
+
+    kv_dtype = _KV_DTYPES[args.kv_dtype]
+    cfg = serving.DecodeConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_head=args.n_head,
+        n_layer=args.n_layer, d_inner=args.d_model * 2,
+        max_length=args.max_len,
+        n_kv_head=args.kv_heads or None)
+    params = serving.init_decode_params(cfg, seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    pool = serving.KVCachePool(
+        num_pages=args.pages, page_size=args.page_size,
+        num_layers=cfg.n_layer, num_heads=cfg.n_head,
+        head_dim=cfg.head_dim, num_kv_heads=cfg.num_kv_heads,
+        dtype=kv_dtype)
+    cache = serving.PrefixCache(pool) if args.prefix_cache else None
+    mgr = None
+    if not args.no_tier:
+        mgr = serving.TieredSessionManager(
+            pool, prefix_cache=cache,
+            host_bytes=int(args.host_mb) << 20)
+    loop = serving.ContinuousBatchingLoop(
+        params, cfg, pool, max_batch=args.max_batch,
+        paged_impl=args.paged_impl, prefill=args.prefill,
+        prefix_cache=cache, prefill_chunk=args.prefill_chunk,
+        session_manager=mgr)
+    sessions = ([mgr.open_session() for _ in range(args.sequences)]
+                if mgr is not None else [None] * args.sequences)
+    plo, phi = (int(p) for p in args.prompt_range.split(","))
+    transcripts = [
+        rng.randint(1, cfg.vocab_size,
+                    size=int(rng.randint(plo, max(plo + 1,
+                                                  phi + 1)))).tolist()
+        for _ in range(args.sequences)]
+    followup = 3  # tokens the "user" adds each turn
+    ttft_first, ttft_resumed = [], []
+    errored = 0
+    tokens = 0
+    t0 = time.perf_counter()
+    for turn in range(args.turns):
+        reqs = [serving.DecodeRequest(prompt=list(t),
+                                      max_new_tokens=args.max_new,
+                                      session=s)
+                for t, s in zip(transcripts, sessions)]
+        for i, r in enumerate(loop.run(reqs)):
+            if r.error is not None:
+                errored += 1
+                continue
+            tokens += len(r.tokens)
+            if r.ttft_s is not None:
+                (ttft_first if turn == 0 else
+                 ttft_resumed).append(r.ttft_s)
+            transcripts[i] = (transcripts[i] + r.tokens + rng.randint(
+                1, cfg.vocab_size, size=followup).tolist())
+        if turn < args.turns - 1:
+            # think time: the conversation goes quiet, the tier parks
+            # every idle session — turn k+1 resumes from host RAM
+            if args.think_time_s > 0:
+                time.sleep(args.think_time_s)
+            if mgr is not None:
+                mgr.spill_idle(older_than_s=0.0, wait=True)
+    elapsed = time.perf_counter() - t0
+    resumable = args.sequences * (args.turns - 1)
+    if mgr is not None:
+        mst = mgr.stats()
+        retained = sum(s.tokens_retained() for s in sessions)
+        tier = mst["tier"]
+        host_transfer = (tier["bytes_parked_total"]
+                         + tier["bytes_fetched_total"])
+        invariants = mgr.check_invariants()
+        mgr.close()
+    else:
+        mst = {"resumes": 0, "resumed_host": 0, "re_prefills": 0,
+               "spills": 0, "evictions": 0}
+        retained = 0
+        host_transfer = 0
+        invariants = pool.check_invariants()
+        invariants = {"ok": invariants["ok"]}
+    if cache is not None:
+        cache.clear()
+    st = pool.stats()
+    return {
+        "mode": "multiturn",
+        "sequences": args.sequences,
+        "turns": args.turns,
+        "think_time_s": args.think_time_s,
+        "tiered": int(mgr is not None),
+        "kv_heads": cfg.num_kv_heads,
+        "kv_dtype": args.kv_dtype,
+        "tokens": tokens,
+        "tokens_per_s": tokens / elapsed,
+        "errored_sequences": errored,
+        # the headline: every resumable turn resumed (none fell back
+        # to a full-transcript re-prefill)
+        "resume_hit_rate": (mst["resumes"] / resumable
+                            if resumable else 0.0),
+        "resumed_host": mst["resumed_host"],
+        "re_prefills": mst["re_prefills"],
+        "spills": mst["spills"],
+        "tier_evictions": mst["evictions"],
+        "host_transfer_bytes": host_transfer,
+        # conversation state still resumable at the end vs what HBM
+        # alone could hold — > 1.0 is the capacity win
+        "retained_tokens": retained,
+        "retention_ratio": retained / float(args.pages
+                                            * args.page_size),
+        "ttft_turn1_p50_ms": _percentile(ttft_first, 50) * 1e3,
+        "ttft_turn1_p99_ms": _percentile(ttft_first, 99) * 1e3,
+        "ttft_resumed_p50_ms": _percentile(ttft_resumed, 50) * 1e3,
+        "ttft_resumed_p99_ms": _percentile(ttft_resumed, 99) * 1e3,
+        "pages_leaked": st["used_pages"],
+        "invariants_ok": int(invariants["ok"]),
+    }
+
+
 def run_fleet_bench(args, elastic: bool) -> dict:
     """--disagg / --fleet (decode-mode options): the decode replay
     through a disaggregated prefill/decode Fleet (serving/fleet).
@@ -935,7 +1070,10 @@ _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "cached_prefill_tokens", "acceptance_rate",
                      "tokens_per_step", "spec_speedup",
                      "accepted_tokens", "scale_ups", "scale_downs",
-                     "handoffs", "replica_kills", "respawns")
+                     "handoffs", "replica_kills", "respawns",
+                     "skipped_tokens", "resume_hit_rate",
+                     "retained_tokens", "retention_ratio",
+                     "resumed_host")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -1044,6 +1182,21 @@ def main(argv=None) -> int:
                          "scenario attached to every request (greedy = "
                          "none, the oracle-identical arm; temp/topk/"
                          "topp exercise the jitted sampling epilogue)")
+    ap.add_argument("--turns", type=int, default=1,
+                    help="decode mode: > 1 runs the multi-turn chat "
+                         "replay — each of --sequences sessions holds "
+                         "a conversation of N turns through the "
+                         "tiered KV cache (host-RAM spill between "
+                         "turns, resume on the next one)")
+    ap.add_argument("--think-time-s", type=float, default=0.0,
+                    help="idle gap between turns before sessions are "
+                         "parked to the host tier")
+    ap.add_argument("--no-tier", action="store_true",
+                    help="multi-turn replay WITHOUT the tiered KV "
+                         "cache (every turn re-prefills its full "
+                         "transcript) — the CI teeth arm")
+    ap.add_argument("--host-mb", type=int, default=256,
+                    help="host KV tier capacity for --turns, in MiB")
     ap.add_argument("--disagg", action="store_true",
                     help="decode mode: run the replay through a "
                          "disaggregated prefill/decode Fleet "
@@ -1172,6 +1325,31 @@ def main(argv=None) -> int:
                 "serve_bench: --disagg/--fleet bank the greedy "
                 "oracle-identical arm; drop --sampling\n")
             return 2
+    if args.turns < 1:
+        sys.stderr.write("serve_bench: --turns must be >= 1\n")
+        return 2
+    if args.turns > 1:
+        if args.mode != "decode" or args.mesh > 1 or args.speculate \
+                or args.chaos or args.disagg or args.fleet \
+                or args.sampling != "greedy":
+            sys.stderr.write(
+                "serve_bench: --turns needs plain --mode decode "
+                "(no --mesh/--speculate/--chaos/--disagg/--fleet/"
+                "--sampling)\n")
+            return 2
+        plo, phi = (int(p) for p in args.prompt_range.split(","))
+        worst = phi + args.turns * (args.max_new + 3)
+        if worst > args.max_len:
+            sys.stderr.write(
+                f"serve_bench: --turns {args.turns} can grow a "
+                f"transcript to ~{worst} tokens > --max-len "
+                f"{args.max_len}; shrink --prompt-range/--max-new or "
+                "raise --max-len\n")
+            return 2
+    if (args.no_tier or args.think_time_s) and args.turns <= 1:
+        sys.stderr.write(
+            "serve_bench: --no-tier/--think-time-s need --turns > 1\n")
+        return 2
     if args.procs and not args.fleet:
         sys.stderr.write(
             "serve_bench: --procs needs --fleet (the process topology "
@@ -1238,6 +1416,8 @@ def main(argv=None) -> int:
             result = run_engine_bench(args)
         elif args.disagg or args.fleet:
             result = run_fleet_bench(args, elastic=args.fleet)
+        elif args.turns > 1:
+            result = run_multiturn_bench(args)
         else:
             result = run_decode_bench(args)
     finally:
