@@ -45,6 +45,15 @@ host):
                      walk, with a known-bad corpus arm
                      (spec_verify_spmd_gather) re-materializing each
                      shard's full gather and tripping the bytes gate
+  lora_decode        the batched per-row LoRA apply at the multi-tenant
+                     serving shape (ISSUE 19): each batch row gathers
+                     its OWN adapter's packed A/B factors by slot index
+                     (slot 0 = the zero identity for base-model rows)
+                     and adds ``(x @ A) @ B`` on top of the dense
+                     matmul, per layer — the banked bytes/step prices
+                     the slot-gather traffic (rows x layers x
+                     rank-factor bytes), holding the "adapters cost
+                     gathers, not dense copies" property under the gate
   prefix_decode      the same decode step under 8-way prefix sharing
                      (ISSUE 11): every sequence's page table walks ONE
                      refcounted shared 28-page prefix plus a private
@@ -463,6 +472,47 @@ def _build_spec_verify_spmd() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, spec_verify_spmd_stream_bytes(), cfg
 
 
+# the lora_decode geometry: the batched per-row adapter apply from the
+# multi-tenant serving step (serving/adapters.py + generate.py's
+# _apply_adapters seam, ISSUE 19) at CI scale — a 4-row batch over an
+# 8-slot pool, 2 layers, rank-8 factors.  The program IS the seam's
+# math: gather each row's packed A/B by slot index, add the low-rank
+# product on top of the dense matmul.  The gather traffic is
+# XLA-visible, so no analytic correction — the banked bytes/step is the
+# honest per-step adapter cost the gate holds.
+LORA_DECODE_GEOM = {"batch": 4, "slots": 8, "n_layer": 2,
+                    "d_model": 128, "rank": 8}
+
+
+def _build_lora_decode() -> Tuple[ProgramArtifacts, float, Dict]:
+    import jax
+    import jax.numpy as jnp
+
+    g = LORA_DECODE_GEOM
+    B, S, L = g["batch"], g["slots"], g["n_layer"]
+    d, r = g["d_model"], g["rank"]
+    cfg = dict(g)
+    # packs carry slots+1 rows: row 0 is the permanent zero identity
+    # base-model rows index (AdapterPool.device_arrays layout)
+    a_pack = jax.ShapeDtypeStruct((S + 1, L, d, r), jnp.float32)
+    b_pack = jax.ShapeDtypeStruct((S + 1, L, r, d), jnp.float32)
+    w = jax.ShapeDtypeStruct((L, d, d), jnp.float32)
+    x = jax.ShapeDtypeStruct((B, d), jnp.float32)
+    idx = jax.ShapeDtypeStruct((B,), jnp.int32)
+
+    def fn(a_pack, b_pack, w, x, idx):
+        h = x
+        for li in range(L):
+            al = a_pack[idx, li]           # [B, d, r] slot gather
+            bl = b_pack[idx, li]           # [B, r, d]
+            low = jnp.einsum("bd,bdr->br", h, al)
+            h = h @ w[li] + jnp.einsum("br,bro->bo", low, bl)
+        return h
+
+    art = capture_fn(fn, a_pack, b_pack, w, x, idx, name="lora_decode")
+    return art, 0.0, cfg
+
+
 def _build_prefix_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     import jax
     import jax.numpy as jnp
@@ -507,6 +557,7 @@ ZOO = {
     "gqa_decode": _build_gqa_decode,
     "spec_verify": _build_spec_verify,
     "spec_verify_spmd": _build_spec_verify_spmd,
+    "lora_decode": _build_lora_decode,
     "prefix_decode": _build_prefix_decode,
     "sharded_decode": _build_sharded_decode,
 }

@@ -82,6 +82,12 @@ Serving knobs (tests/test_serving_resilience.py chaos suite):
         payload fetched for a resume is LOST (SpillMissingError), once
         — the session must fall back to a fresh prefill (counted as
         re_prefills), never hang or fail the request.
+    FAULT_SERVE_ADAPTER_CORRUPT=1     adapter pool: the next adapter
+        registered has one byte of its host payload flipped AFTER its
+        CRC is recorded (silent host-memory corruption of a tenant's
+        LoRA weights), once — the first fault-in must reject it typed
+        (AdapterCorruptError) and drop the registration; garbage
+        weights are never loaded into a device slot.
 """
 
 from __future__ import annotations
@@ -95,7 +101,7 @@ __all__ = [
     "serve_dispatch_raise", "serve_nan_rows", "serve_leak_pages",
     "serve_slow_step", "serve_prefix_corrupt", "serve_replica_kill",
     "serve_handoff_drop", "serve_proc_kill", "serve_spill_corrupt",
-    "serve_spill_drop", "rpc_truncate",
+    "serve_spill_drop", "serve_adapter_corrupt", "rpc_truncate",
 ]
 
 fired: set = set()
@@ -333,6 +339,19 @@ def serve_spill_drop() -> bool:
             or "serve_spill_drop" in fired:
         return False
     fired.add("serve_spill_drop")
+    return True
+
+
+def serve_adapter_corrupt() -> bool:
+    """FAULT_SERVE_ADAPTER_CORRUPT: True exactly once while armed — the
+    adapter pool poisons the payload it just registered (after
+    recording its CRC), so the fault-in-side verify must reject it
+    typed and drop the registration instead of loading garbage
+    weights."""
+    if not os.environ.get("FAULT_SERVE_ADAPTER_CORRUPT") \
+            or "serve_adapter_corrupt" in fired:
+        return False
+    fired.add("serve_adapter_corrupt")
     return True
 
 

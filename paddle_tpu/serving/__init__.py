@@ -101,6 +101,24 @@ HBM-bounded); a spilled-and-resumed session is token-identical to a
 never-spilled one; FAULT_SERVE_SPILL_CORRUPT/_DROP chaos verifies a
 damaged payload re-prefills typed instead of importing garbage.
 
+Multi-tenant serving (adapters.py, ISSUE 19): thousands of LoRA
+fine-tunes of one base checkpoint served side by side — an
+``AdapterPool`` of paged, refcounted, LRU-evicted low-rank A/B deltas
+(per-layer attention QKV/wo + MLP projections; geometry/rank/dtype
+validated typed at ``register_adapter``; cold adapters live in a
+bounded CRC-verified host tier and fault in on first request,
+kvtier-style) with a BATCHED per-row apply: each live row carries an
+adapter slot index, every decode/prefill/verify step gathers that
+row's A/B from device packs and applies ``y += (x @ A) @ B`` per
+projection (slot 0 is an all-zero identity, so base rows ride the
+same einsum at zero extra cost), token-identical to a per-tenant
+dense weight merge.  The contract threads
+``Engine.submit(adapter_id=)`` → ``DecodeRequest.adapter_id`` →
+typed admission (an unloadable adapter rejects before any KV page is
+claimed) → adapter-namespaced prefix cache and corpus drafter →
+``SeqExport.adapter_id`` mismatch resets on the kvtier and fleet
+planes → hot ``publish``/``retire`` under live traffic.
+
 Scaling past one chip (ISSUE 10) lives in ``serving/distributed/``:
 tensor-parallel decode under shard_map (ShardedDecodeProgram +
 head-sharded ShardedKVCachePool — the ContinuousBatchingLoop takes it
@@ -110,6 +128,19 @@ replica handoff.  ``serve_bench --replicas N`` / ``--mesh N`` bench
 both axes chip-less.
 """
 
+from .adapters import (
+    AdapterCorruptError,
+    AdapterError,
+    AdapterGeometryError,
+    AdapterHostFullError,
+    AdapterInUseError,
+    AdapterMismatchError,
+    AdapterNotRegisteredError,
+    AdapterPool,
+    AdapterPoolFullError,
+    make_adapter,
+    merge_adapter_params,
+)
 from .batching import BucketLadder, parse_buckets
 from .engine import (
     AotBackend,
@@ -155,6 +186,15 @@ from . import distributed  # noqa: F401 — serving.distributed is API
 from . import fleet  # noqa: F401 — serving.fleet is API (ISSUE 15)
 
 __all__ = [
+    "AdapterCorruptError",
+    "AdapterError",
+    "AdapterGeometryError",
+    "AdapterHostFullError",
+    "AdapterInUseError",
+    "AdapterMismatchError",
+    "AdapterNotRegisteredError",
+    "AdapterPool",
+    "AdapterPoolFullError",
     "AotBackend",
     "BucketLadder",
     "ContinuousBatchingLoop",
@@ -187,6 +227,8 @@ __all__ = [
     "full_decode",
     "full_forward",
     "init_decode_params",
+    "make_adapter",
+    "merge_adapter_params",
     "parse_buckets",
     "prefill_step",
     "verify_step",

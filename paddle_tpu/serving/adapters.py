@@ -1,0 +1,663 @@
+"""Multi-tenant serving: a paged, refcounted pool of batched LoRA
+adapters — per-request model variants over ONE base checkpoint.
+
+Millions of users means thousands of cheap fine-tuned variants of one
+base model (the Gemma fine-tune-and-serve lifecycle), not N full
+checkpoints or N fleets.  A LoRA adapter is a per-layer low-rank delta
+``W' = W + A @ B`` on the attention (wq/wk/wv/wo) and MLP (w1/w2)
+projections; serving it means applying ``y += (x @ A) @ B`` per row —
+cheap enough that one continuous-batching step can MIX tenants.
+
+This module supplies the weight-side machinery; the decode step
+(serving/generate.py) supplies the batched apply:
+
+- :class:`AdapterPool` — the KV-page discipline generalized to weight
+  pages.  Registered adapters live host-side as the tier of record
+  (numpy, CRC-stamped at registration — the kvtier park/fetch bar:
+  a corrupted payload is a typed rejection at fault-in, never garbage
+  weights).  A bounded set of DEVICE slots holds the hot adapters as
+  zero-padded packed arrays ``A_pack[slot, layer, d_in, max_rank]`` /
+  ``B_pack[slot, layer, max_rank, d_out]`` per projection; slot 0 is
+  permanently all-zero (the base-model identity — a base row gathers
+  exact-zero deltas, so the mixed batch needs no masking).  Cold
+  adapters FAULT IN on first acquire, LRU-evicting a refcount-zero
+  resident ("spill" — the host copy remains); an in-flight adapter
+  (refcount > 0) is never evicted, and a pool with no evictable slot
+  rejects typed (:class:`AdapterPoolFullError`).
+- The decode loop acquires at admission (refcount++, BEFORE any KV
+  page is claimed — an unloadable adapter costs nothing) and releases
+  at retirement/quarantine.  Each live row carries its slot index;
+  the step gathers ``A_pack[slots, layer]`` per projection (the same
+  scalar-prefetch page-table idiom as paged attention — the packed
+  shapes are FIXED, so one compile serves every tenant mix) and the
+  zoo's ``lora_decode`` entry prices the gather bytes chip-lessly.
+- ``publish`` / ``retire`` are the hot-update seam
+  (``FleetController.rolling_adapter_update`` drains each replica,
+  swaps, and rejoins — the rolling_upgrade recipe): both refuse while
+  the adapter is in flight, so a variant can never change under a
+  decoding sequence.
+- :func:`merge_adapter_params` is the correctness oracle: dense
+  per-request weight merge, which the tenant-mixed batched apply must
+  match token-for-token (tests/test_adapters.py holds it there across
+  GQA x int8 x prefix-cache x speculation arms).
+
+Chaos: ``FAULT_SERVE_ADAPTER_CORRUPT`` flips one byte of the next
+registered adapter's host payload AFTER its CRC is recorded; the first
+fault-in must reject it typed (:class:`AdapterCorruptError`) and drop
+the registration.
+
+Sizing math (README "Multi-tenant serving"): device bytes are
+``(slots+1) * n_layer * sum(d_in*max_rank + max_rank*d_out) * 4`` over
+the adapted projections — at rank r << d this is ~``2*r/d`` of one
+extra checkpoint per slot, which is why thousands of registered
+tenants fit one chip with a handful of resident slots.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from ..resilience import faultinject as _finject
+from . import metrics as _smetrics
+
+__all__ = [
+    "ADAPTER_PROJECTIONS",
+    "AdapterCorruptError",
+    "AdapterError",
+    "AdapterGeometryError",
+    "AdapterHostFullError",
+    "AdapterInUseError",
+    "AdapterMismatchError",
+    "AdapterNotRegisteredError",
+    "AdapterPool",
+    "AdapterPoolFullError",
+    "adapter_gather_bytes_per_step",
+    "adapter_proj_dims",
+    "make_adapter",
+    "merge_adapter_params",
+]
+
+# the projections a LoRA delta may target, in apply order.  K/V deltas
+# change cached KV content — the reason the prefix cache namespaces by
+# adapter id (a base-model cached prefix must never serve a tenant).
+ADAPTER_PROJECTIONS = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+
+class AdapterError(RuntimeError):
+    """Base of every typed adapter failure — the decode loop catches
+    exactly this at admission and rejects the one request (its result
+    carries the error; no KV page was claimed)."""
+
+
+class AdapterNotRegisteredError(AdapterError):
+    """The request names an adapter_id the pool has never seen (or one
+    already retired) — a per-request typed rejection."""
+
+
+class AdapterGeometryError(AdapterError):
+    """Registration-time validation: wrong projection name, rank,
+    dtype, or A/B shape for this model geometry."""
+
+
+class AdapterInUseError(AdapterError):
+    """publish/retire refused: the adapter is acquired by >= 1 live
+    sequence — a variant must never change under a decoding row."""
+
+
+class AdapterPoolFullError(AdapterError):
+    """Fault-in found no free device slot and every resident adapter
+    is in flight (refcount > 0) — the pool is sized too small for the
+    concurrent tenant mix."""
+
+
+class AdapterHostFullError(AdapterError):
+    """The bounded host tier cannot hold this registration within
+    ``host_bytes`` — retire cold tenants or raise the bound."""
+
+
+class AdapterCorruptError(AdapterError):
+    """The host payload failed its registration-time CRC at fault-in —
+    the registration is dropped (never loaded as garbage weights) and
+    the tenant must re-register."""
+
+
+class AdapterMismatchError(AdapterError):
+    """A KV payload (parked session, cross-process handoff) was
+    produced under a DIFFERENT adapter than the resuming request's —
+    adapter deltas on wq/wk/wv change the cached K/V itself, so the
+    resume must reset/re-prefill instead of silently decoding a wrong
+    variant."""
+
+
+def adapter_proj_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(d_in, d_out) per adaptable projection for one DecodeConfig —
+    the geometry registrations validate against (K/V project to the
+    cfg's KV heads, so a GQA model's wk/wv adapters are narrower)."""
+    d = int(cfg.d_model)
+    d_kv = int(cfg.num_kv_heads) * int(cfg.head_dim)
+    return {
+        "wq": (d, d), "wk": (d, d_kv), "wv": (d, d_kv), "wo": (d, d),
+        "w1": (d, int(cfg.d_inner)), "w2": (int(cfg.d_inner), d),
+    }
+
+
+def adapter_gather_bytes_per_step(cfg, rank: int, rows: int,
+                                  projections: Sequence[str]
+                                  = ADAPTER_PROJECTIONS) -> float:
+    """Analytic bytes one step's per-row adapter gather moves: every
+    adapter-bearing row reads its A/B slices for each layer and
+    projection (fp32 packed width = the pool's max_rank).  The zoo's
+    ``lora_decode`` entry prices the same pattern chip-lessly; the
+    serve_bench --tenants gate banks this per step."""
+    dims = adapter_proj_dims(cfg)
+    per_row = sum(d_in * rank + rank * d_out
+                  for d_in, d_out in (dims[p] for p in projections))
+    return float(rows) * cfg.n_layer * per_row * 4.0
+
+
+def make_adapter(cfg, rank: int, seed: int = 0, scale: float = 0.05,
+                 projections: Sequence[str] = ADAPTER_PROJECTIONS,
+                 ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic test/bench adapter: per-layer A at 1/sqrt(fan_in)
+    scale, B shrunk by `scale` so the delta perturbs logits without
+    swamping the base model (rank-r LoRA init convention, except B is
+    nonzero so the variant actually diverges)."""
+    rng = np.random.RandomState(seed)
+    dims = adapter_proj_dims(cfg)
+    L = int(cfg.n_layer)
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for p in projections:
+        d_in, d_out = dims[p]
+        A = (rng.standard_normal((L, d_in, rank))
+             / np.sqrt(d_in)).astype(np.float32)
+        B = (rng.standard_normal((L, rank, d_out))
+             * scale / np.sqrt(rank)).astype(np.float32)
+        out[p] = (A, B)
+    return out
+
+
+def merge_adapter_params(params: Dict, weights: Dict) -> Dict:
+    """Dense per-tenant weight merge ``W' = W + A @ B`` — the
+    sequential-oracle arm the batched per-row apply is held
+    token-identical to.  Returns a new params dict (layer dicts copied;
+    unadapted tensors shared)."""
+    merged = dict(params)
+    layers = []
+    for li, lp in enumerate(params["layers"]):
+        lp2 = dict(lp)
+        for proj, (A, B) in weights.items():
+            lp2[proj] = (np.asarray(lp[proj], np.float32)
+                         + np.asarray(A[li], np.float32)
+                         @ np.asarray(B[li], np.float32))
+        layers.append(lp2)
+    merged["layers"] = layers
+    return merged
+
+
+class _HostAdapter:
+    """One registered adapter's host-tier state (the tier of record)."""
+
+    __slots__ = ("adapter_id", "rank", "weights", "nbytes", "crc",
+                 "refcount", "slot", "tick", "fault_ins")
+
+    def __init__(self, adapter_id: str, rank: int,
+                 weights: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                 nbytes: int, crc: int):
+        self.adapter_id = adapter_id
+        self.rank = rank
+        self.weights = weights       # proj -> (A [L,din,r], B [L,r,dout])
+        self.nbytes = nbytes
+        self.crc = crc
+        self.refcount = 0            # live sequences decoding with it
+        self.slot: Optional[int] = None  # device slot when resident
+        self.tick = 0                # LRU clock
+        self.fault_ins = 0
+
+
+def _crc_weights(weights: Dict[str, Tuple[np.ndarray, np.ndarray]]) -> int:
+    crc = 0
+    for proj in sorted(weights):
+        A, B = weights[proj]
+        crc = zlib.crc32(np.ascontiguousarray(A).view(np.uint8), crc)
+        crc = zlib.crc32(np.ascontiguousarray(B).view(np.uint8), crc)
+    return crc & 0xFFFFFFFF
+
+
+class AdapterPool:
+    """Paged batched-LoRA adapter pool over one model geometry.
+
+    Wire it to the decode loop (or a fleet replica) and submit
+    requests carrying ``adapter_id``::
+
+        pool = AdapterPool(cfg, slots=4, max_rank=8)
+        pool.register_adapter("tenant-a", make_adapter(cfg, rank=4,
+                                                       seed=1))
+        loop = ContinuousBatchingLoop(params, cfg, kv_pool,
+                                      adapter_pool=pool)
+        loop.run([DecodeRequest(prompt, n, adapter_id="tenant-a"),
+                  DecodeRequest(prompt, n)])   # mixed-tenant batch
+
+    ``slots`` device slots hold resident adapters (slot 0 is extra and
+    permanently the all-zero identity); ``max_rank`` is the packed
+    width lower-rank adapters zero-pad into (zero pad columns/rows
+    contribute exact zeros, so padding never changes the math);
+    ``host_bytes`` bounds the registration tier (0 = unbounded)."""
+
+    def __init__(self, cfg, slots: int = 4, max_rank: int = 4,
+                 host_bytes: int = 0, name: str = "adapters"):
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        if max_rank < 1:
+            raise ValueError("max_rank must be >= 1")
+        if host_bytes < 0:
+            raise ValueError("host_bytes must be >= 0 (0 = unbounded)")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_rank = int(max_rank)
+        self.host_bytes = int(host_bytes)
+        self.name = name
+        self.dims = adapter_proj_dims(cfg)
+        self._lock = threading.RLock()
+        self._reg: Dict[str, _HostAdapter] = {}
+        self._slot_of: Dict[int, str] = {}   # device slot -> adapter_id
+        self._free_slots: List[int] = list(range(self.slots, 0, -1))
+        self._packs = None  # proj -> [A_pack, B_pack] (built lazily)
+        self._tick = 0
+        self._stats = {
+            "registered_total": 0, "hits": 0, "fault_ins": 0,
+            "spills": 0, "evictions": 0, "corrupt_drops": 0,
+            "acquires": 0, "releases": 0, "host_bytes": 0,
+        }
+
+    # -- registration (the host tier of record) -------------------------
+
+    def register_adapter(self, adapter_id: str, weights: Dict,
+                         ) -> int:
+        """Validate + CRC-stamp a tenant's low-rank weights into the
+        host tier.  ``weights`` maps projection name -> (A, B) with
+        A [n_layer, d_in, rank] and B [n_layer, rank, d_out]; a missing
+        projection is an exact-zero delta.  Returns the payload bytes.
+        Typed raises: :class:`AdapterGeometryError` (shape/rank/dtype),
+        :class:`AdapterHostFullError` (bounded tier), ValueError on a
+        duplicate id (``publish`` replaces, registration never
+        silently overwrites)."""
+        if not isinstance(adapter_id, str) or not adapter_id:
+            raise AdapterGeometryError(
+                f"adapter_id must be a non-empty str, got {adapter_id!r}")
+        canon, rank, nbytes = self._validate(adapter_id, weights)
+        with self._lock:
+            if adapter_id in self._reg:
+                raise ValueError(
+                    f"adapter {adapter_id!r} is already registered — "
+                    "publish() is the replace seam")
+            if self.host_bytes and \
+                    self._stats["host_bytes"] + nbytes > self.host_bytes:
+                raise AdapterHostFullError(
+                    f"adapter pool '{self.name}' host tier holds "
+                    f"{self._stats['host_bytes']} of {self.host_bytes} "
+                    f"bytes; {adapter_id!r} needs {nbytes}")
+            e = _HostAdapter(adapter_id, rank, canon, nbytes,
+                             _crc_weights(canon))
+            if _finject.serve_adapter_corrupt():
+                # chaos: silent host corruption AFTER the CRC stamp —
+                # the first fault-in must reject typed, never load
+                # garbage weights
+                first = canon[sorted(canon)[0]][0]
+                first.reshape(-1).view(np.uint8)[0] ^= 0xFF
+            self._reg[adapter_id] = e
+            self._stats["registered_total"] += 1
+            self._stats["host_bytes"] += nbytes
+        self._note_event("load")
+        self._note_gauges()
+        return nbytes
+
+    def _validate(self, adapter_id: str, weights: Dict):
+        if not isinstance(weights, dict) or not weights:
+            raise AdapterGeometryError(
+                f"adapter {adapter_id!r}: weights must be a non-empty "
+                "dict of projection -> (A, B)")
+        L = int(self.cfg.n_layer)
+        canon: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        rank = None
+        nbytes = 0
+        for proj in sorted(weights):
+            if proj not in self.dims:
+                raise AdapterGeometryError(
+                    f"adapter {adapter_id!r}: unknown projection "
+                    f"{proj!r} (adaptable: {ADAPTER_PROJECTIONS})")
+            try:
+                A, B = weights[proj]
+            except (TypeError, ValueError):
+                raise AdapterGeometryError(
+                    f"adapter {adapter_id!r}: weights[{proj!r}] must "
+                    "be an (A, B) pair")
+            A, B = np.asarray(A), np.asarray(B)
+            for nm, arr in (("A", A), ("B", B)):
+                if not np.issubdtype(arr.dtype, np.floating):
+                    raise AdapterGeometryError(
+                        f"adapter {adapter_id!r}: {proj}.{nm} dtype "
+                        f"{arr.dtype} is not floating")
+            A = np.ascontiguousarray(A, np.float32)
+            B = np.ascontiguousarray(B, np.float32)
+            d_in, d_out = self.dims[proj]
+            r = A.shape[-1] if A.ndim == 3 else -1
+            if A.shape != (L, d_in, r) or B.shape != (L, r, d_out):
+                raise AdapterGeometryError(
+                    f"adapter {adapter_id!r}: {proj} wants A "
+                    f"[{L}, {d_in}, r] / B [{L}, r, {d_out}], got "
+                    f"A {A.shape} / B {B.shape}")
+            if rank is None:
+                rank = int(r)
+            elif int(r) != rank:
+                raise AdapterGeometryError(
+                    f"adapter {adapter_id!r}: mixed ranks ({rank} vs "
+                    f"{r} on {proj}) — one rank per adapter")
+            canon[proj] = (A, B)
+            nbytes += A.nbytes + B.nbytes
+        if not 1 <= rank <= self.max_rank:
+            raise AdapterGeometryError(
+                f"adapter {adapter_id!r}: rank {rank} outside "
+                f"[1, max_rank={self.max_rank}]")
+        return canon, rank, nbytes
+
+    def loadable(self, adapter_id: str) -> bool:
+        """Admission probe: is this id registered?  (Fault-in may
+        still reject a corrupted payload typed — the probe keeps the
+        cheap unknown-tenant case from reaching allocation.)"""
+        with self._lock:
+            return adapter_id in self._reg
+
+    def registered_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._reg)
+
+    def resident_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(aid for aid, e in self._reg.items()
+                          if e.slot is not None)
+
+    # -- acquire / release (the decode loop's admission surface) --------
+
+    def acquire(self, adapter_id: str) -> int:
+        """Pin the adapter for one live sequence and return its device
+        slot (faulting it in first if cold).  Every typed failure
+        leaves the pool untouched — the loop rejects the one request
+        before any KV page is claimed."""
+        with self._lock:
+            e = self._reg.get(adapter_id)
+            if e is None:
+                raise AdapterNotRegisteredError(
+                    f"adapter {adapter_id!r} is not registered in pool "
+                    f"'{self.name}'")
+            if e.slot is None:
+                self._fault_in(e)
+            else:
+                self._stats["hits"] += 1
+            e.refcount += 1
+            e.tick = self._tick
+            self._tick += 1
+            self._stats["acquires"] += 1
+            slot = e.slot
+        self._note_gauges()
+        return slot
+
+    def release(self, adapter_id: str) -> None:
+        """Drop one sequence's pin (retirement/quarantine).  The
+        adapter stays resident — eviction is lazy, at the next
+        fault-in that needs its slot."""
+        with self._lock:
+            e = self._reg.get(adapter_id)
+            if e is None or e.refcount <= 0:
+                raise ValueError(
+                    f"release without acquire for adapter "
+                    f"{adapter_id!r} in pool '{self.name}'")
+            e.refcount -= 1
+            self._stats["releases"] += 1
+
+    def _fault_in(self, e: _HostAdapter) -> None:
+        """Host -> device load (caller holds the lock): CRC-verify the
+        payload, find a slot (LRU-spilling a refcount-zero resident),
+        and write the zero-padded pack rows."""
+        if _crc_weights(e.weights) != e.crc:
+            # drop the registration: a corrupt payload must never be
+            # retried into a tenant forever
+            self._reg.pop(e.adapter_id, None)
+            self._stats["host_bytes"] -= e.nbytes
+            self._stats["corrupt_drops"] += 1
+            self._stats["evictions"] += 1
+            self._note_event("evict")
+            raise AdapterCorruptError(
+                f"adapter {e.adapter_id!r} failed its registration CRC "
+                "at fault-in — registration dropped, never loaded as "
+                "garbage weights")
+        if self._free_slots:
+            slot = self._free_slots.pop()
+        else:
+            victim = min(
+                (v for v in self._reg.values()
+                 if v.slot is not None and v.refcount == 0),
+                key=lambda v: v.tick, default=None)
+            if victim is None:
+                raise AdapterPoolFullError(
+                    f"adapter pool '{self.name}' has no evictable slot "
+                    f"({self.slots} slots, all in flight) for "
+                    f"{e.adapter_id!r}")
+            slot = victim.slot
+            victim.slot = None
+            self._slot_of.pop(slot, None)
+            self._stats["spills"] += 1
+            self._note_event("spill")
+        self._write_slot(slot, e.weights)
+        e.slot = slot
+        e.fault_ins += 1
+        self._slot_of[slot] = e.adapter_id
+        self._stats["fault_ins"] += 1
+        self._note_event("fault_in")
+
+    # -- the device packs (what the decode step gathers) ----------------
+
+    def _ensure_packs(self):
+        if self._packs is None:
+            import jax.numpy as jnp
+
+            L, r = int(self.cfg.n_layer), self.max_rank
+            self._packs = {
+                proj: [jnp.zeros((self.slots + 1, L, d_in, r),
+                                 jnp.float32),
+                       jnp.zeros((self.slots + 1, L, r, d_out),
+                                 jnp.float32)]
+                for proj, (d_in, d_out) in self.dims.items()
+            }
+        return self._packs
+
+    def _write_slot(self, slot: int, weights: Dict) -> None:
+        """Overwrite pack row `slot` with zero-padded A/B (padding the
+        FULL row, so a lower-rank tenant reusing a wider predecessor's
+        slot leaves no stale columns behind)."""
+        packs = self._ensure_packs()
+        L, r = int(self.cfg.n_layer), self.max_rank
+        for proj, (d_in, d_out) in self.dims.items():
+            A = np.zeros((L, d_in, r), np.float32)
+            B = np.zeros((L, r, d_out), np.float32)
+            w = weights.get(proj)
+            if w is not None:
+                rk = w[0].shape[-1]
+                A[:, :, :rk] = w[0]
+                B[:, :rk, :] = w[1]
+            packs[proj][0] = packs[proj][0].at[slot].set(A)
+            packs[proj][1] = packs[proj][1].at[slot].set(B)
+
+    def _clear_slot(self, slot: int) -> None:
+        packs = self._ensure_packs()
+        for proj in self.dims:
+            packs[proj][0] = packs[proj][0].at[slot].set(0.0)
+            packs[proj][1] = packs[proj][1].at[slot].set(0.0)
+
+    def device_arrays(self) -> Dict[str, Tuple]:
+        """proj -> (A_pack, B_pack) for the step's per-row gather.
+        Fixed [slots+1, ...] shapes — one compile per batch geometry
+        regardless of which tenants are resident."""
+        packs = self._ensure_packs()
+        with self._lock:
+            return {proj: (p[0], p[1]) for proj, p in packs.items()}
+
+    def gather_bytes_per_step(self, rows: int) -> float:
+        """Analytic adapter-gather bytes for `rows` adapter-bearing
+        rows in one step (packed width = max_rank)."""
+        return adapter_gather_bytes_per_step(self.cfg, self.max_rank,
+                                             rows)
+
+    # -- hot publish / retire (the rolling-upgrade seam) ----------------
+
+    def publish(self, adapter_id: str, weights: Dict) -> int:
+        """Register-or-replace: the hot-update seam
+        ``rolling_adapter_update`` drives replica by replica.  Refuses
+        (:class:`AdapterInUseError`) while the adapter is in flight —
+        a drained replica never is."""
+        with self._lock:
+            e = self._reg.get(adapter_id)
+            if e is not None:
+                if e.refcount > 0:
+                    raise AdapterInUseError(
+                        f"adapter {adapter_id!r} is pinned by "
+                        f"{e.refcount} live sequence(s) — drain before "
+                        "publishing")
+                self._drop(e)
+        return self.register_adapter(adapter_id, weights)
+
+    def retire(self, adapter_id: str) -> None:
+        """Unregister a tenant: host payload dropped, device slot
+        freed (zeroed).  Typed raises on unknown or in-flight ids."""
+        with self._lock:
+            e = self._reg.get(adapter_id)
+            if e is None:
+                raise AdapterNotRegisteredError(
+                    f"adapter {adapter_id!r} is not registered in pool "
+                    f"'{self.name}'")
+            if e.refcount > 0:
+                raise AdapterInUseError(
+                    f"adapter {adapter_id!r} is pinned by {e.refcount} "
+                    "live sequence(s) — drain before retiring")
+            self._drop(e)
+        self._note_event("evict")
+        self._note_gauges()
+
+    def _drop(self, e: _HostAdapter) -> None:
+        """Remove a registration entirely (caller holds the lock)."""
+        self._reg.pop(e.adapter_id, None)
+        self._stats["host_bytes"] -= e.nbytes
+        self._stats["evictions"] += 1
+        if e.slot is not None:
+            self._clear_slot(e.slot)
+            self._slot_of.pop(e.slot, None)
+            self._free_slots.append(e.slot)
+            e.slot = None
+
+    # -- oracle / introspection -----------------------------------------
+
+    def merged_params(self, params: Dict, adapter_id: Optional[str]
+                      ) -> Dict:
+        """Dense-merge oracle: params with this tenant's deltas folded
+        in (None = the base model, unchanged)."""
+        if adapter_id is None:
+            return params
+        with self._lock:
+            e = self._reg.get(adapter_id)
+            if e is None:
+                raise AdapterNotRegisteredError(
+                    f"adapter {adapter_id!r} is not registered in pool "
+                    f"'{self.name}'")
+            weights = e.weights
+        return merge_adapter_params(params, weights)
+
+    def device_bytes(self) -> int:
+        """Bytes the packed device arrays hold (allocated lazily at
+        the first fault-in; 0 before)."""
+        if self._packs is None:
+            return 0
+        L, r = int(self.cfg.n_layer), self.max_rank
+        per_slot = sum(d_in * r + r * d_out
+                       for d_in, d_out in self.dims.values())
+        return (self.slots + 1) * L * per_slot * 4
+
+    def check_invariants(self) -> Dict:
+        """Pool audit (the KVCachePool.check_invariants discipline):
+        slot bijection, refcount sanity, in-flight-implies-resident,
+        host byte accounting, and every registration's CRC (the
+        host-tier teeth — silent corruption is caught here even before
+        a fault-in trips over it)."""
+        with self._lock:
+            problems: List[str] = []
+            seen_slots: Dict[int, str] = {}
+            host = 0
+            for aid, e in self._reg.items():
+                host += e.nbytes
+                if e.refcount < 0:
+                    problems.append(f"{aid!r}: negative refcount "
+                                    f"{e.refcount}")
+                if e.refcount > 0 and e.slot is None:
+                    problems.append(f"{aid!r}: in flight but not "
+                                    "resident")
+                if e.slot is not None:
+                    if not 1 <= e.slot <= self.slots:
+                        problems.append(f"{aid!r}: slot {e.slot} out "
+                                        "of range")
+                    if e.slot in seen_slots:
+                        problems.append(
+                            f"slot {e.slot} double-owned by "
+                            f"{seen_slots[e.slot]!r} and {aid!r}")
+                    seen_slots[e.slot] = aid
+                    if self._slot_of.get(e.slot) != aid:
+                        problems.append(f"{aid!r}: slot map disagrees "
+                                        f"on slot {e.slot}")
+                if _crc_weights(e.weights) != e.crc:
+                    problems.append(f"{aid!r}: host payload fails its "
+                                    "registration CRC")
+            if host != self._stats["host_bytes"]:
+                problems.append(
+                    f"host_bytes {self._stats['host_bytes']} != sum of "
+                    f"registrations {host}")
+            if len(self._free_slots) + len(seen_slots) != self.slots:
+                problems.append(
+                    f"slot accounting: {len(self._free_slots)} free + "
+                    f"{len(seen_slots)} resident != {self.slots}")
+            return {"ok": not problems, "problems": problems,
+                    "registered": len(self._reg),
+                    "resident": len(seen_slots)}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            st = dict(self._stats)
+            st["registered"] = len(self._reg)
+            st["resident"] = len(self._slot_of)
+            st["slots"] = self.slots
+            st["utilization"] = len(self._slot_of) / float(self.slots)
+            st["device_bytes"] = self.device_bytes()
+            probes = st["hits"] + st["fault_ins"]
+            st["hit_rate"] = st["hits"] / probes if probes else 0.0
+            st["in_flight"] = sum(e.refcount
+                                  for e in self._reg.values())
+            return st
+
+    # -- observability (callers pay one flag read when off) -------------
+
+    def _note_event(self, event: str, n: int = 1) -> None:
+        if _flags._VALUES["FLAGS_observability"]:
+            _smetrics.record_adapter_event(event, n)
+
+    def _note_gauges(self) -> None:
+        if _flags._VALUES["FLAGS_observability"]:
+            with self._lock:
+                resident = len(self._slot_of)
+                host = self._stats["host_bytes"]
+                registered = len(self._reg)
+            _smetrics.record_adapter_gauges(
+                device_bytes=self.device_bytes(),
+                device_utilization=resident / float(self.slots),
+                host_bytes=host, resident=resident,
+                registered=registered)

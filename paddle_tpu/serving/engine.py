@@ -381,7 +381,7 @@ class Engine:
     def submit(self, feed: Dict[str, Any],
                timeout: Optional[float] = None,
                call_kwargs: Optional[Dict[str, Any]] = None,
-               sampling=None) -> Future:
+               sampling=None, adapter_id: Optional[str] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the list of
         per-fetch numpy arrays (this request's rows only).
 
@@ -393,6 +393,10 @@ class Engine:
         backend the same way (pass-through only — it is a PER-REQUEST
         contract; a decode-style backend receives it as the `sampling`
         call kwarg and hands it to DecodeRequest.sampling).
+        adapter_id: the model variant to serve this request under
+        (ISSUE 19) — same pass-through-only threading; a decode-style
+        backend hands it to ``DecodeRequest.adapter_id`` and the
+        loop's AdapterPool resolves or typed-rejects it.
 
         With FLAGS_observability on, the returned Future carries a
         fresh `trace_id` (also attached to every typed error this
@@ -411,6 +415,12 @@ class Engine:
                     f"sampling must be a serving.SamplingParams, got "
                     f"{type(sampling).__name__}")
             call_kwargs = dict(call_kwargs or {}, sampling=sampling)
+        if adapter_id is not None:
+            if not isinstance(adapter_id, str):
+                raise TypeError(
+                    f"adapter_id must be a str, got "
+                    f"{type(adapter_id).__name__}")
+            call_kwargs = dict(call_kwargs or {}, adapter_id=adapter_id)
         fut: Future = Future()
         fut.trace_id = None
         feed_names = self.backend.feed_names

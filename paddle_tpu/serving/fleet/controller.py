@@ -37,7 +37,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from ... import flags as _flags
 from ...observability import flight as _flight
@@ -235,6 +235,41 @@ class FleetController:
                 self._note("upgrade", role, replica=name)
                 upgraded.append(name)
         return upgraded
+
+    def rolling_adapter_update(self, publish: Optional[Dict] = None,
+                               retire: Sequence[str] = (),
+                               timeout: float = 30.0) -> List[str]:
+        """Hot adapter publish/retire under live traffic (ISSUE 19) —
+        the ``rolling_upgrade`` cycle scoped to LoRA variants: drain
+        one replica, publish each ``{adapter_id: weights}`` entry
+        (register-or-replace) and retire the named ids on its adapter
+        pool, rejoin, repeat.  Replicas without an adapter pool are
+        skipped — a mixed fleet upgrades the tenanted members only.
+        Returns the updated replica names in order."""
+        publish = publish or {}
+        updated: List[str] = []
+        for role in _ROLES:
+            for name in sorted(self.fleet.replicas(role)):
+                rep = self.fleet.replicas(role).get(name)
+                if rep is None or not rep.alive:
+                    continue
+                if getattr(rep, "adapter_pool", None) is None:
+                    continue
+                if not self.fleet.drain_replica(name, timeout=timeout):
+                    raise RuntimeError(
+                        f"replica {name} did not drain within "
+                        f"{timeout}s — aborting the adapter update")
+                for aid, weights in publish.items():
+                    rep.publish_adapter(aid, weights)
+                for aid in retire:
+                    rep.retire_adapter(aid)
+                self.fleet.resume_replica(name)
+                self.fleet._count("upgrades")
+                self._note("adapter_update", role, replica=name,
+                           published=sorted(publish),
+                           retired=sorted(retire))
+                updated.append(name)
+        return updated
 
 
 def run_controller(controller: FleetController, every_s: float = 0.1,

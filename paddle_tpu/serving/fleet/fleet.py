@@ -42,6 +42,7 @@ from ... import flags as _flags
 from ...resilience import faultinject as _finject
 from ...observability import flight as _flight
 from .. import metrics as _smetrics
+from ..adapters import AdapterError
 from ..generate import (
     DecodeRequest,
     GeneratedSequence,
@@ -225,7 +226,8 @@ class Fleet:
         rep = self._pick(reps)
         if rep is None:
             return None
-        return rep.name, rep.reserve_prefix(req.prompt)
+        return rep.name, rep.reserve_prefix(
+            req.prompt, adapter_id=getattr(req, "adapter_id", None))
 
     # -- the request path -----------------------------------------------
 
@@ -288,8 +290,9 @@ class Fleet:
         if rep is not None:
             try:
                 pfut = rep.submit(req)
-            except ValueError as e:
-                # request-shape validation: retrying cannot fix it
+            except (ValueError, AdapterError) as e:
+                # request-shape / unknown-adapter validation: retrying
+                # cannot fix it
                 self._resolve(fut, error=e)
                 return
             except (ReplicaKilledError, ReplicaDrainingError,
@@ -390,9 +393,11 @@ class Fleet:
         try:
             dfut = dest.submit(hd)
         except (ReplicaKilledError, ReplicaDrainingError,
-                FleetQueueFullError, HandoffDropError, ValueError) as e:
+                FleetQueueFullError, HandoffDropError, ValueError,
+                AdapterError) as e:
             self._release_on_dest(hd)
-            if isinstance(e, ValueError) or retries >= self.max_retries:
+            if isinstance(e, (ValueError, AdapterError)) \
+                    or retries >= self.max_retries:
                 self._resolve(fut, error=e)
             else:
                 self._failover_handoff(hd, req, fut, retries, t_submit,

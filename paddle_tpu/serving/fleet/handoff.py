@@ -27,6 +27,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..adapters import AdapterMismatchError
 from ..generate import DecodeRequest
 from ..kvcache import SeqExport
 
@@ -134,7 +135,19 @@ class Handoff:
         """Materialize the sequence on the destination: re-attach the
         reserved prefix read-only (through the cache, so quarantine
         invalidation knows the chain), import the shipped tail in one
-        atomic claim, then drop the reservation's transfer holds."""
+        atomic claim, then drop the reservation's transfer holds.
+
+        The payload's ``adapter_id`` stamp must match the request's
+        (ISSUE 19) — a mixed-up broker or a stale requeue must never
+        decode one tenant's K/V under another tenant's weights; the
+        typed reject sends the request back for a fresh prefill."""
+        payload_aid = getattr(self.payload, "adapter_id", None)
+        request_aid = getattr(self.request, "adapter_id", None)
+        if payload_aid != request_aid:
+            raise AdapterMismatchError(
+                f"handoff payload for seq {self.payload.seq_id} was "
+                f"prefilled under adapter {payload_aid!r} but the "
+                f"request wants {request_aid!r} — refusing to admit")
         res = self.reservation
         if res is not None and res.tokens:
             if prefix_cache is None:

@@ -72,6 +72,16 @@ from ...elastic.rpc import FrameClient, FrameError, register_error, serve_frames
 from ...observability import flight as _flight
 from ...resilience import faultinject as _finject
 from .. import metrics as _smetrics
+from ..adapters import (
+    AdapterCorruptError,
+    AdapterError,
+    AdapterGeometryError,
+    AdapterHostFullError,
+    AdapterInUseError,
+    AdapterMismatchError,
+    AdapterNotRegisteredError,
+    AdapterPoolFullError,
+)
 from .handoff import Handoff, HandoffDropError, RidReservation
 from .replica import (
     FleetQueueFullError,
@@ -88,7 +98,11 @@ __all__ = ["ProcReplica", "ProcSpawner", "RemotePrefixReservation",
 # in elastic.rpc; registering here avoids an elastic→serving layering
 # inversion)
 for _cls in (ReplicaKilledError, ReplicaDrainingError,
-             FleetQueueFullError, HandoffDropError):
+             FleetQueueFullError, HandoffDropError,
+             AdapterError, AdapterNotRegisteredError,
+             AdapterGeometryError, AdapterInUseError,
+             AdapterPoolFullError, AdapterHostFullError,
+             AdapterCorruptError, AdapterMismatchError):
     register_error(_cls)
 
 _TRANSPORT_ERRORS = (ConnectionError, TimeoutError, OSError)
@@ -271,13 +285,15 @@ class _ReplicaService:
         return {"used_pages": int(rep.pool.used_pages),
                 "ok": bool(inv["ok"])}
 
-    def v_reserve_prefix(self, prompt) -> Dict:
+    def v_reserve_prefix(self, prompt, adapter_id=None) -> Dict:
         """Pin the longest cached full-page prefix in THIS process and
         keep the real reservation here; only its rid + token count
         cross the wire.  The pin is consumed by the planned handoff's
-        `v_submit` or unwound by `v_release_prefix`."""
+        `v_submit` or unwound by `v_release_prefix`.  The match runs
+        in `adapter_id`'s cache namespace (ISSUE 19)."""
         fn = getattr(self.rep, "reserve_prefix", None)
-        res = fn(list(prompt)) if fn is not None else None
+        res = fn(list(prompt), adapter_id=adapter_id) \
+            if fn is not None else None
         if res is None:
             return {"rid": None, "tokens": 0}
         with self._lock:
@@ -734,17 +750,19 @@ class ProcReplica:
             self._audit_cache = (time.perf_counter(), out)
         return out
 
-    def reserve_prefix(self, prompt):
+    def reserve_prefix(self, prompt, adapter_id=None):
         """Pin the longest cached full-page prefix in the remote decode
         process (ISSUE 18): the real reservation stays in the child's
         registry, the broker holds a `RemotePrefixReservation` handle
         whose release crosses back as a verb, and the planned handoff
-        ships only the unshared tail (``skip_tokens = res.tokens``)."""
+        ships only the unshared tail (``skip_tokens = res.tokens``).
+        The match runs in `adapter_id`'s cache namespace (ISSUE 19)."""
         if not self._alive or self._draining or not self.routing:
             return None
         try:
             resp = self._ctl.call("reserve_prefix",
                                   prompt=[int(t) for t in prompt],
+                                  adapter_id=adapter_id,
                                   timeout=10.0)
         except _TRANSPORT_ERRORS as e:
             self._mark_dead(f"reserve_prefix transport failure: {e}")

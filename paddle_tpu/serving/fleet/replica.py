@@ -49,6 +49,7 @@ import numpy as np
 from ... import flags as _flags
 from ...resilience import faultinject as _finject
 from .. import prefill_sched as _psched
+from ..adapters import AdapterError, AdapterNotRegisteredError
 from ..generate import (
     ContinuousBatchingLoop,
     DecodeConfig,
@@ -344,6 +345,7 @@ class _Job:
     pos: int = 0          # prompt tokens already covered (cache hits)
     matched: int = 0      # of which served by the prefix cache
     row: Optional[np.ndarray] = None
+    aslot: int = 0        # adapter pool slot (0 = base model)
 
 
 def _choose_first(req: DecodeRequest, row: np.ndarray) -> int:
@@ -368,9 +370,11 @@ class PrefillReplica(FleetReplica):
                  dtype: str = "float32", max_batch: int = 4,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True, plan_handoff=None,
-                 queue_cap: int = 256, beat_every_s: float = 0.05):
+                 queue_cap: int = 256, beat_every_s: float = 0.05,
+                 adapter_pool=None):
         self.params = params
         self.cfg = cfg
+        self.adapter_pool = adapter_pool
         self.pool = KVCachePool(
             num_pages, page_size, cfg.n_layer, cfg.n_head, cfg.head_dim,
             dtype=dtype, name=f"{name}-pool",
@@ -415,6 +419,16 @@ class PrefillReplica(FleetReplica):
             raise ValueError(
                 f"prompt needs {need} pages worst-case but replica "
                 f"{self.name}'s pool has {self.pool.num_pages}")
+        aid = getattr(req, "adapter_id", None)
+        if aid is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"request wants adapter {aid!r} but replica "
+                    f"{self.name} has no adapter_pool")
+            if not self.adapter_pool.loadable(aid):
+                raise AdapterNotRegisteredError(
+                    f"adapter {aid!r} is not loadable on replica "
+                    f"{self.name} — register/publish it first")
         return self._submit_item(req)
 
     def swap_params(self, new_params: Dict,
@@ -436,6 +450,36 @@ class PrefillReplica(FleetReplica):
                 f"replica {self.name}: {self.pool.used_pages} pages "
                 "still live after drain — cannot swap params")
         self.params = new_params
+
+    def publish_adapter(self, adapter_id: str, weights: Dict) -> None:
+        """Rolling-upgrade arm for ONE adapter: register-or-replace it
+        on a DRAINED replica (the in-flight guard is the pool's own
+        ``AdapterInUseError``).  The prefix cache is cleared — cached
+        K/V under the old adapter version is content-stale."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before publish_adapter")
+        if self.adapter_pool is None:
+            raise ValueError(
+                f"replica {self.name} has no adapter_pool")
+        self.adapter_pool.publish(adapter_id, weights)
+        if self.cache is not None:
+            self.cache.clear()
+
+    def retire_adapter(self, adapter_id: str) -> None:
+        """Drop one adapter from a DRAINED replica; its namespace's
+        cached prefixes go with it."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before retire_adapter")
+        if self.adapter_pool is None:
+            raise ValueError(
+                f"replica {self.name} has no adapter_pool")
+        self.adapter_pool.retire(adapter_id)
+        if self.cache is not None:
+            self.cache.clear()
 
     def _take_locked(self) -> List:
         """Build one co-admitted group that conservatively fits the
@@ -471,19 +515,46 @@ class PrefillReplica(FleetReplica):
                 self.pool.free_seq(j.seq_id)
                 if self.cache is not None:
                     self.cache.forget_seq(j.seq_id)
+                self._release_adapter(j)
             raise
+
+    def _release_adapter(self, j: _Job) -> None:
+        if j.aslot and self.adapter_pool is not None:
+            self.adapter_pool.release(j.req.adapter_id)
+            j.aslot = 0
+
+    def _adapter_args(self, sel: Sequence[_Job]):
+        """(adapters, adapter_slots) for one step group — (None, None)
+        when every row is base model, so the no-tenant path stays the
+        pre-adapter arithmetic exactly."""
+        if self.adapter_pool is None or not any(j.aslot for j in sel):
+            return None, None
+        return (self.adapter_pool.device_arrays(),
+                [j.aslot for j in sel])
 
     def _prefill_jobs(self, group: List, jobs: List[_Job]) -> None:
         for req, fut in group:
+            aid = getattr(req, "adapter_id", None)
+            aslot = 0
+            if aid is not None:
+                # acquire BEFORE any page is claimed: an adapter that
+                # went corrupt/unloadable since submit rejects typed
+                # with zero pool footprint
+                try:
+                    aslot = self.adapter_pool.acquire(aid)
+                except AdapterError as err:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_exception(err)
+                    continue
             seq_id = self._next_seq
             self._next_seq += 1
             self.pool.allocate(seq_id)
             matched = 0
             if self.cache is not None:
-                m = self.cache.match(req.prompt)
+                m = self.cache.match(req.prompt, adapter_id=aid)
                 matched = self.cache.attach(seq_id, m)
             jobs.append(_Job(req, fut, seq_id, pos=matched,
-                             matched=matched))
+                             matched=matched, aslot=aslot))
 
         def quarantine(sel: Sequence[_Job], logits, step_idx: int):
             """Evict non-finite rows through the shared blast radius
@@ -495,6 +566,7 @@ class PrefillReplica(FleetReplica):
                 j = sel[i]
                 self.quarantined += 1
                 jobs.remove(j)
+                self._release_adapter(j)
                 if j.fut.set_running_or_notify_cancel():
                     j.fut.set_exception(err)
 
@@ -510,10 +582,12 @@ class PrefillReplica(FleetReplica):
                  if _psched.whole_eligible(j.pos, self._chunk)]
         if whole:
             step_idx = self.steps
+            ad, asl = self._adapter_args(whole)
             logits = prefill_step(
                 self.params, self.cfg, self.pool,
                 [j.seq_id for j in whole],
-                [list(j.req.prompt) for j in whole])
+                [list(j.req.prompt) for j in whole],
+                adapters=ad, adapter_slots=asl)
             self.steps += 1
             logits, finite = quarantine(whole, logits, step_idx)
             for i, j in enumerate(whole):
@@ -529,9 +603,11 @@ class PrefillReplica(FleetReplica):
                 self._chunk)
             use = [sel[i] for i in idx]
             step_idx = self.steps
+            ad, asl = self._adapter_args(use)
             logits = chunk_prefill_step(
                 self.params, self.cfg, self.pool,
-                [j.seq_id for j in use], chunks, starts)
+                [j.seq_id for j in use], chunks, starts,
+                adapters=ad, adapter_slots=asl)
             self.steps += 1
             logits, finite = quarantine(use, logits, step_idx)
             for i, j in enumerate(use):
@@ -543,8 +619,10 @@ class PrefillReplica(FleetReplica):
 
         while jobs:  # pop as exported: a raise frees only the rest
             j = jobs[0]
+            aid = getattr(j.req, "adapter_id", None)
             if self.cache is not None:
-                self.cache.insert(j.seq_id, j.req.prompt)
+                self.cache.insert(j.seq_id, j.req.prompt,
+                                  adapter_id=aid)
             tok = _choose_first(j.req, j.row)
             dest = res = None
             if self.plan_handoff is not None:
@@ -552,8 +630,10 @@ class PrefillReplica(FleetReplica):
                 if plan is not None:
                     dest, res = plan
             skip = res.tokens if res is not None else 0
-            payload = self.pool.export_seq(j.seq_id, skip_tokens=skip)
+            payload = self.pool.export_seq(j.seq_id, skip_tokens=skip,
+                                           adapter_id=aid)
             self.pool.free_seq(j.seq_id)
+            self._release_adapter(j)
             jobs.pop(0)
             hd = Handoff(j.req, tok, j.row, payload, reservation=res,
                          src=self.name, dest=dest)
@@ -576,8 +656,9 @@ class DecodeReplica(FleetReplica):
                  prefix_cache: bool = True,
                  paged_impl: Optional[str] = None, check_every: int = 0,
                  speculate: Optional[int] = None, queue_cap: int = 256,
-                 beat_every_s: float = 0.05):
+                 beat_every_s: float = 0.05, adapter_pool=None):
         self.cfg = cfg
+        self.adapter_pool = adapter_pool
         self.pool = KVCachePool(
             num_pages, page_size, cfg.n_layer, cfg.n_head, cfg.head_dim,
             dtype=dtype, name=f"{name}-pool",
@@ -591,7 +672,8 @@ class DecodeReplica(FleetReplica):
             params, cfg, self.pool, max_batch=max_batch,
             paged_impl=paged_impl, prefix_cache=self.cache,
             check_every=check_every,
-            speculate=0 if speculate is None else speculate)
+            speculate=0 if speculate is None else speculate,
+            adapter_pool=adapter_pool)
         self.decoded = 0
         super().__init__(name, max_batch=max_batch, queue_cap=queue_cap,
                          beat_every_s=beat_every_s)
@@ -607,16 +689,18 @@ class DecodeReplica(FleetReplica):
                 holds[p] = holds.get(p, 0) + 1
         return holds
 
-    def reserve_prefix(self, prompt) -> Optional[PrefixReservation]:
+    def reserve_prefix(self, prompt, adapter_id: Optional[str] = None
+                       ) -> Optional[PrefixReservation]:
         """Pin the longest FULL-page cached prefix of `prompt` for an
         incoming transfer: the matched pages gain one refcount hold
         each, so LRU eviction cannot invalidate them between the
         export decision and the import.  None when nothing usable is
-        cached (the payload then ships everything)."""
+        cached (the payload then ships everything).  The match runs in
+        `adapter_id`'s namespace — cached K/V is variant-specific."""
         if self.cache is None or not self._alive or self._draining:
             return None
         with self.pool._lock:
-            m = self.cache.match(prompt)
+            m = self.cache.match(prompt, adapter_id=adapter_id)
             full = m.tokens - m.tokens % self.pool.page_size
             if not full:
                 return None
@@ -642,6 +726,16 @@ class DecodeReplica(FleetReplica):
             raise ValueError(
                 f"request needs {need} pages worst-case but replica "
                 f"{self.name}'s pool has {self.pool.num_pages}")
+        aid = getattr(req, "adapter_id", None)
+        if aid is not None:
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"handoff wants adapter {aid!r} but replica "
+                    f"{self.name} has no adapter_pool")
+            if not self.adapter_pool.loadable(aid):
+                raise AdapterNotRegisteredError(
+                    f"adapter {aid!r} is not loadable on replica "
+                    f"{self.name} — register/publish it first")
         return self._submit_item(hd)
 
     def swap_params(self, new_params: Dict,
@@ -665,6 +759,32 @@ class DecodeReplica(FleetReplica):
                 "still live after drain — cannot swap params")
         self.loop.params = new_params
 
+    def publish_adapter(self, adapter_id: str, weights: Dict) -> None:
+        """Rolling-upgrade arm for ONE adapter (see PrefillReplica)."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before publish_adapter")
+        if self.adapter_pool is None:
+            raise ValueError(
+                f"replica {self.name} has no adapter_pool")
+        self.adapter_pool.publish(adapter_id, weights)
+        if self.cache is not None:
+            self.cache.clear()
+
+    def retire_adapter(self, adapter_id: str) -> None:
+        """Drop one adapter from a DRAINED replica."""
+        with self._cond:
+            if not self._draining or self._queue or self._busy:
+                raise RuntimeError(
+                    f"replica {self.name}: drain before retire_adapter")
+        if self.adapter_pool is None:
+            raise ValueError(
+                f"replica {self.name} has no adapter_pool")
+        self.adapter_pool.retire(adapter_id)
+        if self.cache is not None:
+            self.cache.clear()
+
     def _take_locked(self) -> List:
         # the loop's own admission controller handles batching; hand it
         # a generous slice so continuous batching keeps occupancy high
@@ -679,7 +799,8 @@ class DecodeReplica(FleetReplica):
             reqs.append(DecodeRequest(
                 prompt=list(r.prompt),
                 max_new_tokens=r.max_new_tokens, trace_id=r.trace_id,
-                sampling=r.sampling, handoff=hd))
+                sampling=r.sampling, handoff=hd,
+                adapter_id=getattr(r, "adapter_id", None)))
         results = self.loop.run(reqs)
         for (hd, fut), res in zip(batch, results):
             self.decoded += 1

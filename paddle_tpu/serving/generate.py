@@ -124,6 +124,7 @@ from ..observability import requesttrace as _rtrace
 from ..models.transformer import _sinusoid_table
 from . import metrics as _smetrics
 from . import prefill_sched as _psched
+from .adapters import AdapterError
 from .kvcache import KVCachePool
 from .sampling import (
     SamplingParams,
@@ -292,15 +293,53 @@ def full_decode(params: Dict, cfg: DecodeConfig, prompt: Sequence[int],
     return out, rows
 
 
+def _apply_adapters(y, x, name, li, adapters, slots):
+    """Per-row batched-LoRA delta (ISSUE 19): add each row's
+    ``(x @ A) @ B`` for projection `name` at layer `li`, gathering the
+    row's A/B from the packed pool arrays by its adapter slot — the
+    same scalar-prefetch page-table idiom as paged attention, so ONE
+    step mixes tenants.  Slot 0 is the pool's permanent all-zero
+    identity: base-model rows ride the same einsum and add exact fp32
+    zeros (no masking, no divergent compile shape).  ``adapters=None``
+    is the guaranteed zero-cost path — today's code byte for byte."""
+    if adapters is None:
+        return y
+    import jax.numpy as jnp
+
+    A, B = adapters[name]
+    Al = A[slots, li]  # [B, d_in, r] per-row gather
+    Bl = B[slots, li]  # [B, r, d_out]
+    if x.ndim == 2:
+        return y + jnp.einsum("br,bro->bo",
+                              jnp.einsum("bd,bdr->br", x, Al), Bl)
+    return y + jnp.einsum("bsr,bro->bso",
+                          jnp.einsum("bsd,bdr->bsr", x, Al), Bl)
+
+
+def _adapter_slot_array(adapters, adapter_slots):
+    """Validate + stage the per-row slot vector for one step call."""
+    if adapters is None:
+        return None
+    import jax.numpy as jnp
+
+    if adapter_slots is None:
+        raise ValueError("adapters without adapter_slots")
+    return jnp.asarray(np.asarray(adapter_slots, np.int32))
+
+
 def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                 seq_ids: Sequence[int], tokens, positions,
-                force: str = "auto", impl: Optional[str] = None) -> np.ndarray:
+                force: str = "auto", impl: Optional[str] = None,
+                adapters=None, adapter_slots=None) -> np.ndarray:
     """One continuous-batching step: feed token[i] at position[i] for
     every active sequence, append its K/V to the pool, and return the
     next-token logits [B, V].  All sequences share the batch regardless
     of phase — a prefilling sequence and a deep-decode sequence differ
     only in k_lengths.  `impl` selects the paged-attention path (None:
-    FLAGS_serving_paged_impl)."""
+    FLAGS_serving_paged_impl).  ``adapters``/``adapter_slots`` (an
+    AdapterPool's ``device_arrays()`` + row i's slot index) apply each
+    row's low-rank tenant deltas per projection — None is the base
+    model, unchanged."""
     import jax.numpy as jnp
 
     tokens = np.asarray(tokens, np.int32)
@@ -308,14 +347,18 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     B = tokens.shape[0]
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
     Hkv = cfg.num_kv_heads
+    aslots = _adapter_slot_array(adapters, adapter_slots)
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[positions]
     pages, slots = pool.append_token(seq_ids)
     tables, lengths = pool.page_table_batch(seq_ids)
     for li, lp in enumerate(params["layers"]):
-        q = (h @ lp["wq"]).reshape(B, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Hkv, Dh)
-        v = (h @ lp["wv"]).reshape(B, Hkv, Dh)
+        q = _apply_adapters(h @ lp["wq"], h, "wq", li, adapters,
+                            aslots).reshape(B, H, Dh)
+        k = _apply_adapters(h @ lp["wk"], h, "wk", li, adapters,
+                            aslots).reshape(B, Hkv, Dh)
+        v = _apply_adapters(h @ lp["wv"], h, "wv", li, adapters,
+                            aslots).reshape(B, Hkv, Dh)
         pool.write_kv(li, pages, slots, k, v)
         k_scales, v_scales = pool.layer_scales(li)
         attn = paged_decode_attention(
@@ -324,15 +367,22 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
             k_scales=k_scales, v_scales=v_scales,
         )  # [B, H, 1, Dh]
         attn = attn[:, :, 0, :].reshape(B, d)
-        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
-        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
+                                           li, adapters, aslots),
+                       lp["ln1_g"], lp["ln1_b"])
+        u = jnp.maximum(_apply_adapters(h @ lp["w1"], h, "w1", li,
+                                        adapters, aslots) + lp["b1"],
+                        0.0)
+        ff = _apply_adapters(u @ lp["w2"], u, "w2", li, adapters,
+                             aslots) + lp["b2"]
         h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
     return np.asarray(h @ jnp.asarray(params["embed"]).T)
 
 
 def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                  seq_ids: Sequence[int], prompts: Sequence[Sequence[int]],
-                 force: str = "auto") -> np.ndarray:
+                 force: str = "auto", adapters=None,
+                 adapter_slots=None) -> np.ndarray:
     """Batched whole-prompt prefill: ONE causal pass over every prompt
     (ragged lengths padded to the co-admitted max, masked through the
     flash ``k_lengths`` tier) writes each prompt token's per-layer K/V
@@ -362,13 +412,17 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     pages, slots = pool.append_tokens(seq_ids, lens)
     b_idx = np.repeat(np.arange(B), lens)
     t_idx = np.concatenate([np.arange(n) for n in lens])
+    aslots = _adapter_slot_array(adapters, adapter_slots)
 
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[None, :Smax]  # [B, Smax, d]
     for li, lp in enumerate(params["layers"]):
-        q = (h @ lp["wq"]).reshape(B, Smax, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Smax, Hkv, Dh)
-        v = (h @ lp["wv"]).reshape(B, Smax, Hkv, Dh)
+        q = _apply_adapters(h @ lp["wq"], h, "wq", li, adapters,
+                            aslots).reshape(B, Smax, H, Dh)
+        k = _apply_adapters(h @ lp["wk"], h, "wk", li, adapters,
+                            aslots).reshape(B, Smax, Hkv, Dh)
+        v = _apply_adapters(h @ lp["wv"], h, "wv", li, adapters,
+                            aslots).reshape(B, Smax, Hkv, Dh)
         # valid tokens only ([T, H_kv, Dh] rows in claim order) reach
         # the pool (an int8 pool quantizes them on the way in)
         pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
@@ -378,8 +432,14 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
             q.transpose(0, 2, 1, 3), kh, vh, causal=True,
             scale=Dh ** -0.5, k_lengths=lens, force=force)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Smax, d)
-        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
-        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
+                                           li, adapters, aslots),
+                       lp["ln1_g"], lp["ln1_b"])
+        u = jnp.maximum(_apply_adapters(h @ lp["w1"], h, "w1", li,
+                                        adapters, aslots) + lp["b1"],
+                        0.0)
+        ff = _apply_adapters(u @ lp["w2"], u, "w2", li, adapters,
+                             aslots) + lp["b2"]
         h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
     h_last = h[jnp.arange(B), lens - 1]  # [B, d] true last positions
     return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
@@ -388,7 +448,8 @@ def prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
 def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                        seq_ids: Sequence[int],
                        chunks: Sequence[Sequence[int]],
-                       start_positions: Sequence[int]) -> np.ndarray:
+                       start_positions: Sequence[int],
+                       adapters=None, adapter_slots=None) -> np.ndarray:
     """Suffix/chunk prefill: process ``chunks[i]`` consecutive prompt
     tokens for sequence i starting at absolute position
     ``start_positions[i]`` — which need NOT be 0.  The chunk's queries
@@ -439,13 +500,17 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     # key j visible to query (b, i) iff j <= pos[b, i]; the jnp.where
     # also neutralizes NaN scores from masked garbage (padding pages)
     mask = jnp.asarray(np.arange(S)[None, None, :] <= pos[:, :, None])
+    aslots = _adapter_slot_array(adapters, adapter_slots)
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[pos_c]  # [B, Cmax, d]
     scale = Dh ** -0.5
     for li, lp in enumerate(params["layers"]):
-        q = (h @ lp["wq"]).reshape(B, Cmax, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Cmax, Hkv, Dh)
-        v = (h @ lp["wv"]).reshape(B, Cmax, Hkv, Dh)
+        q = _apply_adapters(h @ lp["wq"], h, "wq", li, adapters,
+                            aslots).reshape(B, Cmax, H, Dh)
+        k = _apply_adapters(h @ lp["wk"], h, "wk", li, adapters,
+                            aslots).reshape(B, Cmax, Hkv, Dh)
+        v = _apply_adapters(h @ lp["wv"], h, "wv", li, adapters,
+                            aslots).reshape(B, Cmax, Hkv, Dh)
         pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
         k_scales, v_scales = pool.layer_scales(li)
         k_full = gather_kv_pages(pool.k_pages[li], tables,
@@ -457,8 +522,14 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         scores = jnp.where(mask[:, None], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bhij,bhjd->bihd", w, v_full).reshape(B, Cmax, d)
-        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
-        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
+                                           li, adapters, aslots),
+                       lp["ln1_g"], lp["ln1_b"])
+        u = jnp.maximum(_apply_adapters(h @ lp["w1"], h, "w1", li,
+                                        adapters, aslots) + lp["b1"],
+                        0.0)
+        ff = _apply_adapters(u @ lp["w2"], u, "w2", li, adapters,
+                             aslots) + lp["b2"]
         h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
     h_last = h[jnp.arange(B), lens - 1]  # [B, d] true last chunk tokens
     return np.asarray(h_last @ jnp.asarray(params["embed"]).T)
@@ -468,7 +539,8 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                 seq_ids: Sequence[int], blocks: Sequence[Sequence[int]],
                 start_positions: Sequence[int], force: str = "auto",
                 impl: Optional[str] = None,
-                pad_to: Optional[int] = None) -> np.ndarray:
+                pad_to: Optional[int] = None,
+                adapters=None, adapter_slots=None) -> np.ndarray:
     """One speculative verify step: sequence i feeds ``blocks[i]`` —
     its last committed token plus d_i drafted continuations — starting
     at absolute position ``start_positions[i]``, appends every fed
@@ -541,12 +613,16 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                                                 slots.dtype)])
     pos = starts[:, None] + np.arange(Sqm)[None, :]
     pos_c = np.minimum(pos, cfg.max_length - 1)  # padded rows: clamp only
+    aslots = _adapter_slot_array(adapters, adapter_slots)
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[pos_c]  # [B, Sqm, d]
     for li, lp in enumerate(params["layers"]):
-        q = (h @ lp["wq"]).reshape(B, Sqm, H, Dh)
-        k = (h @ lp["wk"]).reshape(B, Sqm, Hkv, Dh)
-        v = (h @ lp["wv"]).reshape(B, Sqm, Hkv, Dh)
+        q = _apply_adapters(h @ lp["wq"], h, "wq", li, adapters,
+                            aslots).reshape(B, Sqm, H, Dh)
+        k = _apply_adapters(h @ lp["wk"], h, "wk", li, adapters,
+                            aslots).reshape(B, Sqm, Hkv, Dh)
+        v = _apply_adapters(h @ lp["wv"], h, "wv", li, adapters,
+                            aslots).reshape(B, Sqm, Hkv, Dh)
         # valid rows (plus the identical-value padding) in claim order
         pool.write_kv(li, pages, slots, k[b_idx, t_idx], v[b_idx, t_idx])
         k_scales, v_scales = pool.layer_scales(li)
@@ -556,8 +632,14 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
             k_scales=k_scales, v_scales=v_scales, q_lengths=lens,
         )  # [B, H, Sqm, Dh]
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Sqm, d)
-        h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
-        ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
+        h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
+                                           li, adapters, aslots),
+                       lp["ln1_g"], lp["ln1_b"])
+        u = jnp.maximum(_apply_adapters(h @ lp["w1"], h, "w1", li,
+                                        adapters, aslots) + lp["b1"],
+                        0.0)
+        ff = _apply_adapters(u @ lp["w2"], u, "w2", li, adapters,
+                             aslots) + lp["b2"]
         h = _layernorm(h + ff, lp["ln2_g"], lp["ln2_b"])
     return np.asarray(h @ jnp.asarray(params["embed"]).T)  # [B, Sqm, V]
 
@@ -590,6 +672,13 @@ class DecodeRequest:
     # sequence's pages resident for the next turn instead of freeing
     # them.  None (the default) is the ordinary one-shot request
     session: Optional[object] = None
+    # multi-tenant serving (serving/adapters): the model VARIANT this
+    # request decodes under.  The loop acquires it from its
+    # AdapterPool at admission (an unloadable/corrupt adapter rejects
+    # typed BEFORE any KV page is claimed) and every step applies the
+    # variant's low-rank deltas to just this request's rows.  None
+    # (the default) is the base model — the guaranteed zero-cost path
+    adapter_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -616,7 +705,7 @@ class GeneratedSequence:
 class _Active:
     __slots__ = ("req", "seq_id", "pos", "result", "rt", "matched",
                  "charged", "whole", "chunk_mode", "inserted",
-                 "drafted", "accepted")
+                 "drafted", "accepted", "aslot")
 
     def __init__(self, req: DecodeRequest, seq_id: int,
                  result: GeneratedSequence, rt=None):
@@ -632,6 +721,7 @@ class _Active:
         self.inserted = False    # prompt pages offered to the cache
         self.drafted = 0   # speculative tokens proposed for this seq
         self.accepted = 0  # ... of which the verifier accepted
+        self.aslot = 0     # adapter device slot (0 = base-model identity)
 
 
 class ContinuousBatchingLoop:
@@ -689,7 +779,7 @@ class ContinuousBatchingLoop:
                  program=None, prefix_cache=None,
                  prefill_chunk: Optional[int] = None,
                  speculate: Optional[int] = None, drafter=None,
-                 session_manager=None):
+                 session_manager=None, adapter_pool=None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
@@ -710,6 +800,11 @@ class ContinuousBatchingLoop:
                     "session_manager carries a different prefix cache "
                     "than the loop — spill-time pins and resume-time "
                     "attaches must agree on one trie")
+        if adapter_pool is not None and program is not None:
+            raise ValueError(
+                "SPMD program loops do not support adapter_pool — the "
+                "per-row adapter gather lives in this module's step "
+                "functions, not in custom programs (yet)")
         self.params = params
         self.cfg = cfg if cfg is not None else getattr(program, "cfg", None)
         if self.cfg is None:
@@ -743,6 +838,10 @@ class ContinuousBatchingLoop:
         # requests carrying a .session resume retained KV at admission
         # and keep their pages resident at retirement
         self.session_manager = session_manager
+        # multi-tenant adapters (serving/adapters.AdapterPool):
+        # requests carrying an adapter_id acquire their variant at
+        # admission and decode through per-row low-rank deltas
+        self.adapter_pool = adapter_pool
         # prefill-token cap per engine step (0 = uncapped); None reads
         # FLAGS_serving_prefill_chunk
         self._prefill_chunk = int(
@@ -808,6 +907,11 @@ class ContinuousBatchingLoop:
         self.session_resumes = 0
         self.session_resumed_tokens = 0
         self.session_fresh = 0
+        # multi-tenant adapter accounting (serve_bench --tenants banks
+        # hit rate and gather bytes/step off these + the pool's stats)
+        self.adapter_rejects = 0
+        self.adapter_rows = 0
+        self.adapter_gather_bytes = 0.0
 
     def acceptance_rate(self) -> float:
         """Accepted / drafted speculative tokens (0.0 before any
@@ -870,6 +974,13 @@ class ContinuousBatchingLoop:
                 raise ValueError(
                     f"logit_bias token {req.sampling.max_bias_token()} "
                     f">= vocab_size {self.cfg.vocab_size}")
+            if req.adapter_id is not None and self.adapter_pool is None:
+                # operator config error, not a per-request one: a loop
+                # with no pool can never serve ANY adapter request, so
+                # fail the run up front like every other validate check
+                raise ValueError(
+                    f"request names adapter {req.adapter_id!r} but the "
+                    "loop carries no adapter_pool")
             # validate EVERY request (max_length AND whole-pool fit)
             # before any work: a mid-run raise would strand allocated
             # pages and throw away already-finished sequences' results.
@@ -926,6 +1037,8 @@ class ContinuousBatchingLoop:
                     # side — reset the session so its next turn
                     # prefills fresh instead of resuming poisoned KV
                     self.session_manager.on_quarantine(a.req.session)
+                if a.aslot and self.adapter_pool is not None:
+                    self.adapter_pool.release(a.req.adapter_id)
                 reserved_pages -= a.charged
                 self.quarantined += 1
                 if obs_on:
@@ -1017,9 +1130,12 @@ class ContinuousBatchingLoop:
                     # admission bound sets aside
                     resident = self.session_manager.on_retire(
                         a.req.session, a.seq_id, a.result.prompt,
-                        a.result.tokens, trace_id=a.result.trace_id)
+                        a.result.tokens, trace_id=a.result.trace_id,
+                        adapter_id=a.req.adapter_id)
                 if not resident:
                     self.pool.free_seq(a.seq_id)
+                if a.aslot and self.adapter_pool is not None:
+                    self.adapter_pool.release(a.req.adapter_id)
                 reserved_pages -= a.charged
                 if self.prefix_cache is not None:
                     self.prefix_cache.forget_seq(a.seq_id)
@@ -1052,6 +1168,24 @@ class ContinuousBatchingLoop:
                             trace_id=(a.result.trace_id if kept
                                       else None))
 
+        def adapter_args(group: List[_Active]):
+            """Per-step adapter inputs for one stepping group: (the
+            pool's packed device arrays, row i's slot index) — or
+            (None, None), the guaranteed zero-cost identity path, when
+            no row carries an adapter.  Also banks the analytic
+            gather-bytes accounting serve_bench --tenants reports."""
+            if self.adapter_pool is None \
+                    or not any(a.aslot for a in group):
+                return None, None
+            asl = np.asarray([a.aslot for a in group], np.int32)
+            rows = int((asl > 0).sum())
+            self.adapter_rows += rows
+            gb = self.adapter_pool.gather_bytes_per_step(rows)
+            self.adapter_gather_bytes += gb
+            if obs_on:
+                _smetrics.record_adapter_gather_bytes(gb)
+            return self.adapter_pool.device_arrays(), asl
+
         try:
             while waiting or active:
                 # admit (FIFO) while a slot and a worst-case reservation
@@ -1081,8 +1215,9 @@ class ContinuousBatchingLoop:
                             # resident or host-parked) serve this turn?
                             # Planning pins the session against the
                             # spill writer until admit/abort
-                            plan = mgr.plan_resume(req.session,
-                                                   seq.prompt)
+                            plan = mgr.plan_resume(
+                                req.session, seq.prompt,
+                                adapter_id=req.adapter_id)
                         if plan is not None:
                             # parked resumes discount only the prefix
                             # pages pinned across the park (they attach
@@ -1093,7 +1228,11 @@ class ContinuousBatchingLoop:
                             # manager-locked
                             matched = plan.charge_matched
                         elif self.prefix_cache is not None:
-                            m = self.prefix_cache.match(req.prompt)
+                            # namespaced by adapter: LoRA on wq/wk/wv
+                            # changes K/V content, so a base-model
+                            # cached prefix must never serve a tenant
+                            m = self.prefix_cache.match(
+                                req.prompt, adapter_id=req.adapter_id)
                             matched = m.tokens
                     need = self._footprint(req, matched)
                     locked = (self.pool.uncharged_live_pages()
@@ -1115,6 +1254,34 @@ class ContinuousBatchingLoop:
                                 continue  # re-plan against freed pages
                         break  # wait for retirements
                     waiting.pop(0)
+                    aslot = 0
+                    if req.adapter_id is not None:
+                        try:
+                            # pin the variant (faulting it in if cold)
+                            # BEFORE any page is claimed: an unloadable
+                            # / corrupt / pool-full adapter is a typed
+                            # per-request rejection that costs nothing
+                            aslot = self.adapter_pool.acquire(
+                                req.adapter_id)
+                        except AdapterError as err:
+                            if plan is not None:
+                                mgr.abort_resume(plan)
+                            now_r = time.perf_counter()
+                            err.trace_id = seq.trace_id
+                            seq.error = err
+                            seq.finished_at = now_r
+                            self.adapter_rejects += 1
+                            if obs_on:
+                                _smetrics.record_adapter_event("reject")
+                                _flight.default_flight().record(
+                                    "adapter_reject",
+                                    adapter=req.adapter_id,
+                                    trace_id=seq.trace_id)
+                                if rt is not None:
+                                    _rtrace.default_request_tracer() \
+                                        .finish(rt, outcome="rejected",
+                                                t_end=now_r)
+                            continue
                     if plan is not None and plan.kind == "resident":
                         # the session's sequence (and its pages) are
                         # still in the pool — continue it instead of
@@ -1127,9 +1294,34 @@ class ContinuousBatchingLoop:
                     if hd is not None:
                         # attach the reserved shared prefix (if any)
                         # and import the shipped pages — ONE atomic
-                        # claim charges the imported footprint
-                        hd.admit(self.pool, self.prefix_cache,
-                                 seq.seq_id)
+                        # claim charges the imported footprint.  A
+                        # payload stamped with another adapter rejects
+                        # typed here (AdapterMismatchError) — one
+                        # request's problem, never the batch's
+                        try:
+                            hd.admit(self.pool, self.prefix_cache,
+                                     seq.seq_id)
+                        except AdapterError as err:
+                            self.pool.free_seq(seq.seq_id)
+                            hd.release(self.pool)
+                            if aslot and self.adapter_pool is not None:
+                                self.adapter_pool.release(req.adapter_id)
+                            now_r = time.perf_counter()
+                            err.trace_id = seq.trace_id
+                            seq.error = err
+                            seq.finished_at = now_r
+                            self.adapter_rejects += 1
+                            if obs_on:
+                                _smetrics.record_adapter_event("reject")
+                                _flight.default_flight().record(
+                                    "adapter_reject",
+                                    adapter=req.adapter_id,
+                                    trace_id=seq.trace_id)
+                                if rt is not None:
+                                    _rtrace.default_request_tracer() \
+                                        .finish(rt, outcome="rejected",
+                                                t_end=now_r)
+                            continue
                         if matched:
                             self.prefix_hits += 1
                             self.cached_prefill_tokens += matched
@@ -1167,6 +1359,7 @@ class ContinuousBatchingLoop:
                     a.pos = matched
                     a.matched = matched
                     a.charged = need
+                    a.aslot = aslot
                     # whole-prompt prefill keeps its one-pass fast path
                     # when nothing is cached and no chunk cap binds;
                     # everything else goes through chunk steps (or, for
@@ -1184,12 +1377,14 @@ class ContinuousBatchingLoop:
                     reserved_pages += need
                     if obs_on:
                         _smetrics.record_sequence("admitted")
+                        extra = ({"adapter": req.adapter_id}
+                                 if req.adapter_id is not None else {})
                         _flight.default_flight().record(
                             "admit", seq_id=seq.seq_id,
                             trace_id=seq.trace_id,
                             prompt_len=len(seq.prompt),
                             cached_tokens=matched,
-                            reserved_pages=reserved_pages)
+                            reserved_pages=reserved_pages, **extra)
                         if matched:
                             _flight.default_flight().record(
                                 "prefix_hit", seq_id=seq.seq_id,
@@ -1234,11 +1429,13 @@ class ContinuousBatchingLoop:
                             self.pool, [a.seq_id for a in whole_group],
                             [a.result.prompt for a in whole_group])
                     else:
+                        ad, asl = adapter_args(whole_group)
                         logits = prefill_step(
                             self.params, self.cfg, self.pool,
                             [a.seq_id for a in whole_group],
                             [a.result.prompt for a in whole_group],
-                            force=self.force)
+                            force=self.force, adapters=ad,
+                            adapter_slots=asl)
                     self.steps += 1
                     self.prefill_steps += 1
                     ntok = sum(len(a.result.prompt) for a in whole_group)
@@ -1283,9 +1480,11 @@ class ContinuousBatchingLoop:
                         [a.result.prompt for a in chunkers],
                         [a.pos for a in chunkers], self._prefill_chunk)
                     sel = [chunkers[i] for i in idx]
+                    ad, asl = adapter_args(sel)
                     logits = chunk_prefill_step(
                         self.params, self.cfg, self.pool,
-                        [a.seq_id for a in sel], chunks, starts)
+                        [a.seq_id for a in sel], chunks, starts,
+                        adapters=ad, adapter_slots=asl)
                     self.steps += 1
                     self.prefill_steps += 1
                     ntok = sum(len(c) for c in chunks)
@@ -1347,8 +1546,18 @@ class ContinuousBatchingLoop:
                         # whole context every step
                         ctx = list(a.result.prompt) + a.result.tokens
                         if getattr(self.drafter, "stateful", False):
-                            proposal = self.drafter.draft(
-                                ctx, room, seq_id=a.seq_id)
+                            # adapter-aware drafters probe the corpus
+                            # trie within the request's namespace only
+                            # — cross-tenant continuations must not
+                            # leak through draft proposals
+                            if getattr(self.drafter, "adapter_aware",
+                                       False):
+                                proposal = self.drafter.draft(
+                                    ctx, room, seq_id=a.seq_id,
+                                    adapter_id=a.req.adapter_id)
+                            else:
+                                proposal = self.drafter.draft(
+                                    ctx, room, seq_id=a.seq_id)
                         else:
                             proposal = self.drafter.draft(ctx, room)
                         blk += list(proposal)[:room]
@@ -1378,11 +1587,13 @@ class ContinuousBatchingLoop:
                             [a.pos for a in batch],
                             pad_to=self._speculate + 1)
                     else:
+                        ad, asl = adapter_args(batch)
                         logits3 = verify_step(
                             self.params, self.cfg, self.pool, seq_ids,
                             blocks, [a.pos for a in batch],
                             force=self.force, impl=self.paged_impl,
-                            pad_to=self._speculate + 1)
+                            pad_to=self._speculate + 1,
+                            adapters=ad, adapter_slots=asl)
                     self.steps += 1
                     self.decode_steps += 1
                     self.spec_steps += 1
@@ -1547,9 +1758,11 @@ class ContinuousBatchingLoop:
                     logits = self.program.decode_step(
                         self.pool, seq_ids, tokens, positions)
                 else:
+                    ad, asl = adapter_args(batch)
                     logits = decode_step(
                         self.params, self.cfg, self.pool, seq_ids, tokens,
-                        positions, force=self.force, impl=self.paged_impl)
+                        positions, force=self.force, impl=self.paged_impl,
+                        adapters=ad, adapter_slots=asl)
                 self.steps += 1
                 self.decode_steps += 1
                 ntok = sum(1 for a in batch
@@ -1594,6 +1807,8 @@ class ContinuousBatchingLoop:
                     # the pool side is freed above: the session must
                     # not believe it still owns a resident sequence
                     self.session_manager.on_quarantine(a.req.session)
+                if a.aslot and self.adapter_pool is not None:
+                    self.adapter_pool.release(a.req.adapter_id)
             active.clear()
             raise
         return results
@@ -1605,7 +1820,8 @@ class ContinuousBatchingLoop:
         if self.prefix_cache is None or a.inserted:
             return
         a.inserted = True
-        self.prefix_cache.insert(a.seq_id, a.result.prompt)
+        self.prefix_cache.insert(a.seq_id, a.result.prompt,
+                                 adapter_id=a.req.adapter_id)
 
     def _watchdog(self) -> None:
         """Every check_every steps: audit pool integrity and repair

@@ -124,6 +124,10 @@ class SeqExport:
     head_dim: int = 0
     dtype: str = "float32"
     pool: str = "kv"                 # source pool name
+    # model variant the K/V was produced under (ISSUE 19; None = base).
+    # kvtier / fleet handoff verify this at resume/admit so a payload
+    # never decodes under a different adapter's weights.
+    adapter_id: Optional[str] = None
 
     def nbytes(self) -> int:
         """Payload bytes on the wire — serve_bench banks this per seq."""
@@ -350,12 +354,15 @@ class KVCachePool:
 
     # -- cross-pool handoff (the disaggregation substrate) --------------
 
-    def export_seq(self, seq_id: int, skip_tokens: int = 0) -> SeqExport:
+    def export_seq(self, seq_id: int, skip_tokens: int = 0,
+                   adapter_id: Optional[str] = None) -> SeqExport:
         """Serialize one sequence's pages + lengths (+ int8 scales) into
         host buffers — the prefill→decode handoff payload
         (serving/fleet).  The source sequence is left UNTOUCHED (the
         caller frees it once the payload is safely handed off, so a
         dropped handoff costs a re-prefill, never corruption).
+        ``adapter_id`` stamps the payload with the model variant its
+        K/V was produced under (None = base model).
 
         ``skip_tokens`` (a multiple of page_size) leading tokens are
         omitted from the payload: the destination re-attaches that
@@ -385,7 +392,8 @@ class KVCachePool:
                 k=k, v=v, k_scales=ks, v_scales=vs,
                 page_size=self.page_size, num_layers=self.num_layers,
                 num_kv_heads=self.num_kv_heads, head_dim=self.head_dim,
-                dtype=np.dtype(self.k_pages.dtype).name, pool=self.name)
+                dtype=np.dtype(self.k_pages.dtype).name, pool=self.name,
+                adapter_id=adapter_id)
 
     def import_seq(self, export: SeqExport,
                    seq_id: int) -> Tuple[int, int]:

@@ -61,6 +61,7 @@ from .. import flags as _flags
 from ..observability import flight as _flight
 from ..resilience import faultinject as _finject
 from . import metrics as _smetrics
+from .adapters import AdapterMismatchError
 from .kvcache import KVCachePool, SeqExport
 
 _log = logging.getLogger("paddle_tpu.serving.kvtier")
@@ -277,7 +278,8 @@ class TierSession:
     __slots__ = ("manager", "session_id", "state", "seq_id", "history",
                  "pinned_keys", "pinned_pages", "pinned_tokens",
                  "parked_bytes", "last_used", "last_trace_id",
-                 "last_freed", "spills", "resumes", "_spilled_ev")
+                 "last_freed", "spills", "resumes", "adapter_id",
+                 "_spilled_ev")
 
     def __init__(self, manager: "TieredSessionManager", session_id: int):
         self.manager = manager
@@ -299,6 +301,10 @@ class TierSession:
         self.last_freed = 0
         self.spills = 0
         self.resumes = 0
+        # model variant the retained K/V was produced under (ISSUE 19):
+        # None = base model.  LoRA on QKV changes K/V content, so a
+        # resume under a DIFFERENT adapter must reset, never reuse.
+        self.adapter_id: Optional[str] = None
         self._spilled_ev = threading.Event()
 
     def resumable(self) -> bool:
@@ -378,8 +384,8 @@ class TieredSessionManager:
         self._stats = {
             "spills": 0, "resumes": 0, "resumed_resident": 0,
             "resumed_host": 0, "re_prefills": 0, "evictions": 0,
-            "mismatch_resets": 0, "pressure_spills": 0,
-            "spill_aborts": 0,
+            "mismatch_resets": 0, "adapter_mismatch_resets": 0,
+            "pressure_spills": 0, "spill_aborts": 0,
         }
         self._closing = False
         pool.register_reclaimer(self._reclaim)
@@ -434,18 +440,26 @@ class TieredSessionManager:
 
     # -- the decode loop's admission surface ---------------------------
 
-    def plan_resume(self, s: TierSession,
-                    prompt: Sequence[int]) -> Optional[_ResumePlan]:
+    def plan_resume(self, s: TierSession, prompt: Sequence[int],
+                    adapter_id: Optional[str] = None
+                    ) -> Optional[_ResumePlan]:
         """Admission probe: can this request resume `s`?  Returns a
         plan (session CASed to ``resuming``) or None for the fresh
         path.  A diverged history resets the session (its retained KV
-        is useless for this prompt)."""
+        is useless for this prompt), and so does a DIFFERENT adapter
+        id: the retained K/V was produced under the session's variant
+        and is content-wrong for any other — the typed
+        ``adapter_mismatch`` reset, never a silent wrong-variant
+        decode (ISSUE 19)."""
         while True:
             with self._lock:
                 if s.manager is not self:
                     raise ValueError("session belongs to another manager")
                 st = s.state
                 if st == "idle":
+                    if adapter_id != s.adapter_id:
+                        self._reset_resident(s, why="adapter_mismatch")
+                        return None
                     c = self._common_prefix(s.history, prompt)
                     if c <= 0:
                         self._reset_resident(s, why="mismatch")
@@ -454,6 +468,9 @@ class TieredSessionManager:
                     return _ResumePlan(s, "resident", present=c,
                                       charge_matched=0)
                 if st == "parked":
+                    if adapter_id != s.adapter_id:
+                        self._discard_parked(s, why="adapter_mismatch")
+                        return None
                     kv = len(s.history)
                     if kv > len(prompt) - 1 \
                             or list(prompt[:kv]) != s.history:
@@ -529,11 +546,21 @@ class TieredSessionManager:
                 self._unpin(s)
         try:
             export = self.tier.fetch(s.session_id)
+            if getattr(export, "adapter_id", None) != s.adapter_id:
+                # the payload travelled (proc plane / stale park) and
+                # carries another variant's K/V — typed reject, then
+                # re-prefill under the session's own adapter
+                raise AdapterMismatchError(
+                    f"parked payload for session {s.session_id} was "
+                    f"exported under adapter "
+                    f"{getattr(export, 'adapter_id', None)!r} but the "
+                    f"session resumes under {s.adapter_id!r}")
             with self._lock:
                 self.pool.import_seq(export, seq_id)
             present = export.length
             nbytes = export.nbytes()
-        except (SpillCorruptError, SpillMissingError) as e:
+        except (SpillCorruptError, SpillMissingError,
+                AdapterMismatchError) as e:
             fell_back = True
             with self._lock:
                 self._stats["re_prefills"] += 1
@@ -568,11 +595,13 @@ class TieredSessionManager:
 
     def on_retire(self, s: TierSession, seq_id: int,
                   prompt: Sequence[int], generated: Sequence[int],
-                  trace_id: Optional[str] = None) -> bool:
+                  trace_id: Optional[str] = None,
+                  adapter_id: Optional[str] = None) -> bool:
         """A sequence carrying this session retired cleanly: adopt its
-        pool pages (the loop skips ``free_seq``) and go idle.  Returns
-        False when the session cannot keep residency (closed/stale) —
-        the loop then frees the pages as usual."""
+        pool pages (the loop skips ``free_seq``) and go idle, recording
+        the adapter the K/V was produced under.  Returns False when the
+        session cannot keep residency (closed/stale) — the loop then
+        frees the pages as usual."""
         with self._lock:
             if self._closing or s.state not in ("fresh", "active"):
                 return False
@@ -583,6 +612,7 @@ class TieredSessionManager:
             s.state = "idle"
             s.last_used = self._now()
             s.last_trace_id = trace_id
+            s.adapter_id = adapter_id
             s._spilled_ev.clear()
             return True
 
@@ -598,6 +628,7 @@ class TieredSessionManager:
             s.seq_id = None
             s.history = []
             s.parked_bytes = 0
+            s.adapter_id = None
 
     def locked_pages(self) -> int:
         """Pool pages held by IDLE (or mid-spill) sessions that no
@@ -708,7 +739,8 @@ class TieredSessionManager:
             keys: List[str] = []
             pages: List[int] = []
             if self.cache is not None and len(s.history) > 1:
-                m = self.cache.match(s.history)
+                m = self.cache.match(s.history,
+                                     adapter_id=s.adapter_id)
                 full_pages = m.tokens // pool.page_size
                 if full_pages:
                     pages = [int(p) for p in m.pages[:full_pages]]
@@ -718,7 +750,8 @@ class TieredSessionManager:
                     for p in pages:
                         self._pin_holds[p] = self._pin_holds.get(p, 0) + 1
             try:
-                export = pool.export_seq(seq, skip_tokens=skip)
+                export = pool.export_seq(seq, skip_tokens=skip,
+                                         adapter_id=s.adapter_id)
             except BaseException:
                 self._release_pins(pages)
                 s.state = "idle"
@@ -827,9 +860,12 @@ class TieredSessionManager:
         s.history = []
         s.parked_bytes = 0
         s.seq_id = None
+        s.adapter_id = None
         self._stats["evictions"] += 1
         if why == "mismatch":
             self._stats["mismatch_resets"] += 1
+        elif why == "adapter_mismatch":
+            self._stats["adapter_mismatch_resets"] += 1
         if _flags._VALUES["FLAGS_observability"]:
             _smetrics.record_tier_event("evict")
             _flight.default_flight().record(
@@ -844,7 +880,11 @@ class TieredSessionManager:
         s.state = "fresh"
         s.seq_id = None
         s.history = []
-        self._stats["mismatch_resets"] += 1
+        s.adapter_id = None
+        if why == "adapter_mismatch":
+            self._stats["adapter_mismatch_resets"] += 1
+        else:
+            self._stats["mismatch_resets"] += 1
 
     def _unpin(self, s: TierSession) -> int:
         """Release the session's pinned prefix holds (caller holds the

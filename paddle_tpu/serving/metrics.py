@@ -89,6 +89,30 @@ serving/kvtier.py):
                                                       seen by the tier
                                                       manager
 
+Multi-tenant adapter instruments (ISSUE 19 — paged batched-LoRA
+adapters, serving/adapters.py):
+- paddle_tpu_serving_adapter_events_total   counter  {event=load|evict|
+                                                      spill|fault_in|
+                                                      reject} adapter
+                                                      lifecycle: register /
+                                                      retire-or-corrupt-drop /
+                                                      device-slot LRU spill /
+                                                      host→device load /
+                                                      typed admission
+                                                      rejection
+- paddle_tpu_serving_adapter_pool_bytes     gauge    {tier=device|host}
+                                                      packed slot bytes vs
+                                                      registered payload
+                                                      bytes
+- paddle_tpu_serving_adapter_pool_utilization gauge  resident/slots
+- paddle_tpu_serving_adapter_pool_resident  gauge    adapters in device
+                                                      slots
+- paddle_tpu_serving_adapter_pool_registered gauge   adapters in the host
+                                                      tier of record
+- paddle_tpu_serving_adapter_gather_bytes_per_step gauge analytic bytes
+                                                      one step's per-row
+                                                      A/B gather moves
+
 Fault-isolation instruments (ISSUE 6):
 - paddle_tpu_serving_breaker_trips_total    counter  circuit-breaker opens
 - paddle_tpu_serving_dispatcher_restarts_total counter supervisor restarts
@@ -110,6 +134,9 @@ from typing import Optional, Tuple
 from ..observability import default_registry
 
 __all__ = [
+    "record_adapter_event",
+    "record_adapter_gather_bytes",
+    "record_adapter_gauges",
     "record_submit",
     "record_reject",
     "record_timeout",
@@ -483,6 +510,59 @@ def record_tier_gauges(host_bytes: int, host_utilization: float,
         "paddle_tpu_serving_hbm_tier_utilization",
         "KV page-pool utilization as seen by the tier manager",
     ).set(hbm_utilization)
+
+
+def record_adapter_event(event: str, n: int = 1) -> None:
+    """One adapter-pool lifecycle event: ``load`` (a tenant's LoRA
+    weights registered host-side), ``fault_in`` (host → device slot),
+    ``spill`` (a refcount-zero resident LRU-evicted from its device
+    slot; the host copy remains), ``evict`` (a registration dropped —
+    retire, publish-replace, or a corrupt payload), ``reject`` (a
+    request named an unloadable adapter and was rejected typed at
+    admission, before any KV page was claimed)."""
+    default_registry().counter(
+        "paddle_tpu_serving_adapter_events",
+        "multi-tenant adapter-pool lifecycle events",
+    ).inc(n, event=event)
+
+
+def record_adapter_gauges(device_bytes: int, device_utilization: float,
+                          host_bytes: int, resident: int,
+                          registered: int) -> None:
+    """Point-in-time adapter-pool occupancy (both tiers in one call)."""
+    reg = default_registry()
+    reg.gauge(
+        "paddle_tpu_serving_adapter_pool_bytes",
+        "adapter-pool bytes by tier (packed device slots vs registered "
+        "host payloads)",
+    ).set(device_bytes, tier="device")
+    reg.gauge(
+        "paddle_tpu_serving_adapter_pool_bytes",
+        "adapter-pool bytes by tier (packed device slots vs registered "
+        "host payloads)",
+    ).set(host_bytes, tier="host")
+    reg.gauge(
+        "paddle_tpu_serving_adapter_pool_utilization",
+        "adapter device-slot utilization (resident/slots)",
+    ).set(device_utilization)
+    reg.gauge(
+        "paddle_tpu_serving_adapter_pool_resident",
+        "adapters currently resident in device slots",
+    ).set(resident)
+    reg.gauge(
+        "paddle_tpu_serving_adapter_pool_registered",
+        "adapters registered in the host tier of record",
+    ).set(registered)
+
+
+def record_adapter_gather_bytes(nbytes: float) -> None:
+    """Analytic bytes the last step's per-row adapter gather moved —
+    the live counterpart of the banked ``lora_decode`` zoo entry."""
+    default_registry().gauge(
+        "paddle_tpu_serving_adapter_gather_bytes_per_step",
+        "analytic bytes one decode step's per-row adapter A/B gather "
+        "moves",
+    ).set(float(nbytes))
 
 
 def record_pool_reclaim(pages: int, pool: str = "kv") -> None:

@@ -32,6 +32,13 @@ KVCachePool and N full prefill passes.  With it:
   first in least-recently-used order before PagePoolExhausted can
   fire.  ``max_pages`` optionally caps the cache's footprint the same
   way at insert time.
+- **Adapter namespacing (ISSUE 19).**  The trie is partitioned by
+  adapter id: LoRA deltas on the QKV projections change the K/V a
+  prompt produces, so a prefix cached under one model variant is
+  content-wrong for every other.  ``match``/``insert``/
+  ``ngram_continuation`` take ``adapter_id`` (None = base model) and
+  confine themselves to that namespace — cross-tenant attachment is
+  structurally impossible, not merely unlikely.
 - **Poison containment.**  A quarantined sequence that was served a
   cached prefix invalidates the matched chain (``quarantine_seq``) —
   a corrupted cached page (chaos: FAULT_SERVE_PREFIX_CORRUPT) costs
@@ -62,10 +69,15 @@ from .kvcache import KVCachePool
 __all__ = ["PrefixCache", "PrefixMatch"]
 
 
-def _chain_key(parent: Optional[str], tokens: Tuple[int, ...]) -> str:
+def _chain_key(parent: Optional[str], tokens: Tuple[int, ...],
+               ns: str = "") -> str:
     """Rolling prompt-prefix hash: the entry's name folds its parent's
-    name with this page's token run."""
+    name with this page's token run, salted by the namespace (adapter
+    id) so identical prompts under different model variants can never
+    share an entry key in the flat ``_entries`` map."""
     h = hashlib.sha1()
+    h.update(ns.encode())
+    h.update(b"\x00")
     h.update((parent or "").encode())
     h.update((",".join(str(t) for t in tokens)).encode())
     return h.hexdigest()[:20]
@@ -92,6 +104,7 @@ class _Entry:
     last_used: int
     children: Dict[Tuple[int, ...], str] = dataclasses.field(
         default_factory=dict)
+    ns: str = ""              # adapter namespace ("" = base model)
 
 
 class PrefixCache:
@@ -114,7 +127,10 @@ class PrefixCache:
         self.max_pages = int(max_pages) if max_pages else 0
         self._lock = pool._lock  # ONE lock: see module docstring
         self._entries: Dict[str, _Entry] = {}
-        self._roots: Dict[Tuple[int, ...], str] = {}
+        # root tries keyed by namespace (adapter id; "" = base model).
+        # LoRA on QKV changes the cached K/V content, so a prefix cached
+        # under one variant must never be attached to another (ISSUE 19).
+        self._roots: Dict[str, Dict[Tuple[int, ...], str]] = {}
         self._seq_keys: Dict[int, List[str]] = {}
         self._tick = 0
         self._stats = {
@@ -127,19 +143,21 @@ class PrefixCache:
 
     # -- the admission path --------------------------------------------
 
-    def match(self, prompt: Sequence[int]) -> PrefixMatch:
+    def match(self, prompt: Sequence[int],
+              adapter_id: Optional[str] = None) -> PrefixMatch:
         """Longest cached prefix of `prompt`, page by page, verifying
         every hop against the literal tokens.  Caps the match at
         len(prompt) - 1 so at least one token still runs through the
         model (the logits source for the first generated token).
         Touches matched entries' LRU clocks; counts nothing — stats
         land at attach/note_miss so a retried admission probe doesn't
-        double-count."""
+        double-count.  Matching is confined to `adapter_id`'s namespace
+        (None = base model): cached K/V is variant-specific."""
         prompt = [int(t) for t in prompt]
         limit = len(prompt) - 1
         m = PrefixMatch()
         with self._lock:
-            children = self._roots
+            children = self._roots.get(adapter_id or "", {})
             pos = 0
             while pos < limit:
                 best: Optional[_Entry] = None
@@ -198,14 +216,17 @@ class PrefixCache:
 
     # -- the retirement/insert path ------------------------------------
 
-    def insert(self, seq_id: int, prompt: Sequence[int]) -> int:
+    def insert(self, seq_id: int, prompt: Sequence[int],
+               adapter_id: Optional[str] = None) -> int:
         """Cache a finished prefill's prompt pages: walk/extend the trie
         page by page, pinning (refcount++) each NEW entry's pool page.
         The sequence keeps decoding — its next append into a pinned
         partial tail page copy-on-writes, leaving the cached content
-        frozen.  Returns the number of entries created."""
+        frozen.  Entries land in `adapter_id`'s namespace (None = base
+        model).  Returns the number of entries created."""
         prompt = [int(t) for t in prompt]
         ps = self.pool.page_size
+        ns = adapter_id or ""
         created = 0
         with self._lock:
             pages, length = self.pool.table_snapshot(seq_id)
@@ -213,7 +234,7 @@ class PrefixCache:
                 raise ValueError(
                     f"sequence {seq_id} holds {length} tokens < prompt "
                     f"{len(prompt)} — insert only after prefill completes")
-            children = self._roots
+            children = self._roots.setdefault(ns, {})
             parent: Optional[str] = None
             pos = idx = 0
             while pos < len(prompt):
@@ -225,9 +246,9 @@ class PrefixCache:
                 else:
                     page = pages[idx]
                     self.pool.retain_pages([page])
-                    key = _chain_key(parent, toks)
+                    key = _chain_key(parent, toks, ns)
                     e = _Entry(key=key, parent=parent, tokens=toks,
-                               page=page, last_used=self._tick)
+                               page=page, last_used=self._tick, ns=ns)
                     self._entries[key] = e
                     children[toks] = key
                     created += 1
@@ -283,7 +304,8 @@ class PrefixCache:
     def _drop_entry(self, e: _Entry) -> None:
         self._entries.pop(e.key, None)
         siblings = (self._entries[e.parent].children
-                    if e.parent in self._entries else self._roots)
+                    if e.parent in self._entries
+                    else self._roots.get(e.ns, {}))
         if siblings.get(e.tokens) == e.key:
             siblings.pop(e.tokens, None)
 
@@ -339,15 +361,17 @@ class PrefixCache:
         a healthy run's pool must be fully free again)."""
         with self._lock:
             n = 0
-            for key in list(self._roots.values()):
-                n += self._invalidate_tree(key)
+            for roots in list(self._roots.values()):
+                for key in list(roots.values()):
+                    n += self._invalidate_tree(key)
             self._seq_keys.clear()
+            self._roots.clear()
         return n
 
     # -- corpus drafting (ISSUE 16) ------------------------------------
 
-    def ngram_continuation(self, probe: Sequence[int],
-                           limit: int) -> List[int]:
+    def ngram_continuation(self, probe: Sequence[int], limit: int,
+                           adapter_id: Optional[str] = None) -> List[int]:
         """Cross-request n-gram lookup over the trie's cached token
         chains — the CORPUS arm of ``PromptLookupDrafter``: shared-
         prefix fleet traffic (system prompts, few-shot headers,
@@ -364,7 +388,13 @@ class PrefixCache:
         WORSE.  Pure host bookkeeping under the pool lock; chains here
         are verified literal tokens (the trie's collision rule), so a
         wrong-content proposal is impossible — and harmless anyway,
-        since the verifier decides acceptance."""
+        since the verifier decides acceptance.
+
+        Drafting is confined to `adapter_id`'s namespace (None = base
+        model): a tenant's cached continuations never leak into another
+        tenant's drafts — cross-tenant speculation would both reveal a
+        neighbour's traffic shape and waste verify slots on systematic
+        misses."""
         probe = tuple(int(t) for t in probe)
         n = len(probe)
         limit = int(limit)
@@ -398,7 +428,8 @@ class PrefixCache:
                 scan(chain, e.last_used)
 
         with self._lock:
-            for key in list(self._roots.values()):
+            roots = self._roots.get(adapter_id or "", {})
+            for key in list(roots.values()):
                 visit(key, [])
         return best
 

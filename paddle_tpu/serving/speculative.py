@@ -125,6 +125,9 @@ class PromptLookupDrafter:
     entering a popular template speculates from step one."""
 
     stateful = True  # the loop may pass seq_id= and call release()
+    # the loop may pass adapter_id= to confine corpus drafting to one
+    # tenant's namespace (ISSUE 19) — see draft()
+    adapter_aware = True
 
     def __init__(self, max_draft: int = 4, max_ngram: int = 3,
                  min_ngram: int = 1, max_sequences: int = 1024,
@@ -158,13 +161,18 @@ class PromptLookupDrafter:
         return len(self._index)
 
     def draft(self, context: Sequence[int], max_draft: int = None,
-              seq_id: Optional[int] = None) -> List[int]:
+              seq_id: Optional[int] = None,
+              adapter_id: Optional[str] = None) -> List[int]:
         """Propose continuation tokens for `context` (prompt + generated
         history, oldest first).  `max_draft` caps the proposal below
         the drafter's own limit (the loop passes the sequence's
         remaining max_new headroom).  With `seq_id` the incremental
         index answers the probe; without it a one-shot reversed scan
-        does (identical output, O(len) per call)."""
+        does (identical output, O(len) per call).  `adapter_id`
+        confines the CORPUS probe to that tenant's namespace (ISSUE
+        19): own-history matching is per-sequence and needs no
+        scoping, but the shared trie must not draft one tenant's
+        continuations into another's verify slots."""
         limit = self.max_draft if max_draft is None else \
             min(self.max_draft, int(max_draft))
         if limit < 1:
@@ -184,12 +192,13 @@ class PromptLookupDrafter:
             idx.sync(ctx, self.min_ngram, self.max_ngram)
             own = self._indexed_draft(idx, ctx, limit)
         if len(own) < limit and self.corpus is not None:
-            corp = self._corpus_draft(ctx, limit)
+            corp = self._corpus_draft(ctx, limit, adapter_id)
             if len(corp) > len(own):
                 return corp
         return own
 
-    def _corpus_draft(self, ctx: List[int], limit: int) -> List[int]:
+    def _corpus_draft(self, ctx: List[int], limit: int,
+                      adapter_id: Optional[str] = None) -> List[int]:
         """Probe the shared corpus longest-n-gram first (more specific
         probes win); a full-length continuation returns outright, the
         longest partial one is the cross-n fallback — the same decision
@@ -201,8 +210,14 @@ class PromptLookupDrafter:
         L = len(ctx)
         best: List[int] = []
         for n in range(min(self.max_ngram, L), self.min_ngram - 1, -1):
-            got = [int(t) for t in
-                   self.corpus.ngram_continuation(ctx[L - n:], limit)]
+            if adapter_id is None:
+                # base namespace — the two-arg shape keeps custom
+                # corpora without the adapter_id kwarg working
+                raw = self.corpus.ngram_continuation(ctx[L - n:], limit)
+            else:
+                raw = self.corpus.ngram_continuation(
+                    ctx[L - n:], limit, adapter_id=adapter_id)
+            got = [int(t) for t in raw]
             if len(got) == limit:
                 return got
             if len(got) > len(best):

@@ -1,0 +1,628 @@
+"""Multi-tenant serving (ISSUE 19): paged batched-LoRA adapter pool.
+
+Acceptance pinned here:
+(a) one continuous-batching step serving >= 3 adapters + base rows is
+    TOKEN-IDENTICAL to a per-tenant sequential oracle decoding each
+    request alone under densely-merged weights (W' = W + A@B), across
+    H_kv ∈ {4, 2} × {fp32, int8} KV pools × speculation on/off ×
+    prefix-cache on, with zero leaked pages and zero in-flight
+    adapters after every run;
+(b) the prefix cache and the drafter corpus are adapter-NAMESPACED:
+    one tenant's cached K/V chains and n-gram continuations are never
+    served to another tenant (or to base traffic) for the same
+    prompt bytes;
+(c) pool mechanics audit: typed geometry/registration validation,
+    refcounted acquire/release with LRU spill of cold residents only
+    (an in-flight adapter is NEVER evicted — a full pack rejects
+    typed instead), a CRC-failed fault-in drops the registration
+    (chaos knob FAULT_SERVE_ADAPTER_CORRUPT), a bounded host tier
+    rejects typed, and publish/retire refuse in-flight tenants;
+(d) an unloadable adapter is a typed PER-REQUEST admission reject —
+    before any KV page is claimed; the rest of the batch decodes on;
+(e) tiered-KV sessions carry the adapter stamp: resuming a session
+    under a different adapter_id RESETS it (idle and parked arms,
+    counted in adapter_mismatch_resets) instead of resuming the wrong
+    K/V; SeqExport pickles the stamp across process boundaries and
+    Handoff.admit rejects a payload/request mismatch typed;
+(f) the disaggregated fleet serves mixed tenants end to end
+    (prefill acquires before allocating, the handoff carries the
+    stamp) and FleetController.rolling_adapter_update hot-publishes /
+    retires variants under the drain seam on every pooled replica;
+(g) Engine.submit(adapter_id=...) validates the type and threads the
+    id pass-through-only, like sampling;
+(h) adapter observability is gated: FLAGS_observability off mints NO
+    adapter metrics; on, the lifecycle events (load / fault_in /
+    reject) and pool gauges appear.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.serving import (
+    AdapterCorruptError,
+    AdapterGeometryError,
+    AdapterInUseError,
+    AdapterMismatchError,
+    AdapterNotRegisteredError,
+    AdapterPool,
+    AdapterPoolFullError,
+    ContinuousBatchingLoop,
+    DecodeConfig,
+    DecodeRequest,
+    Engine,
+    EngineConfig,
+    KVCachePool,
+    PrefixCache,
+    TieredSessionManager,
+    full_decode,
+    init_decode_params,
+    make_adapter,
+)
+from paddle_tpu.serving.adapters import (
+    AdapterHostFullError,
+    adapter_gather_bytes_per_step,
+)
+from paddle_tpu.serving.fleet import (
+    DecodeReplica,
+    Fleet,
+    FleetController,
+    PrefillReplica,
+)
+from paddle_tpu.serving.fleet.handoff import Handoff
+from paddle_tpu.serving.kvcache import SeqExport
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=61, d_model=32, n_head=4, n_layer=2,
+                d_inner=64, max_length=64)
+    base.update(kw)
+    return DecodeConfig(**base)
+
+
+def _pool(cfg, num_pages=64, page_size=4, dtype="float32"):
+    return KVCachePool(num_pages=num_pages, page_size=page_size,
+                       num_layers=cfg.n_layer, num_heads=cfg.n_head,
+                       head_dim=cfg.head_dim,
+                       num_kv_heads=cfg.num_kv_heads, dtype=dtype)
+
+
+def _adapters(cfg, names, rank=2, slots=4, **kw):
+    ap = AdapterPool(cfg, slots=slots, max_rank=rank, **kw)
+    for k, n in enumerate(names, start=1):
+        ap.register_adapter(n, make_adapter(cfg, rank=rank, seed=10 + k))
+    return ap
+
+
+def _mixed_requests(cfg, rng, tenants, max_new=4, n_base=1):
+    """One request per tenant plus `n_base` base-model requests, all
+    with distinct prompts — the mixed batch under test."""
+    reqs = []
+    for aid in list(tenants) + [None] * n_base:
+        prompt = rng.randint(1, cfg.vocab_size,
+                             size=int(rng.randint(5, 12))).tolist()
+        reqs.append(DecodeRequest(prompt=prompt, max_new_tokens=max_new,
+                                  adapter_id=aid))
+    return reqs
+
+
+def _oracle_tokens(params, cfg, ap, req, dtype="float32", speculate=0):
+    """The sequential dense-merge oracle: this request decoded ALONE
+    through the same machinery under W' = W + A@B (base params when
+    the request carries no adapter)."""
+    merged = (ap.merged_params(params, req.adapter_id)
+              if req.adapter_id is not None else params)
+    pool = _pool(cfg, dtype=dtype)
+    loop = ContinuousBatchingLoop(merged, cfg, pool, max_batch=1,
+                                  speculate=speculate)
+    (res,) = loop.run([DecodeRequest(prompt=list(req.prompt),
+                                     max_new_tokens=req.max_new_tokens)])
+    assert res.error is None, res.error
+    assert pool.used_pages == 0
+    return res.tokens
+
+
+# -- (a) the headline parity matrix --------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+@pytest.mark.parametrize("n_kv", [None, 2])
+def test_mixed_tenant_batch_token_identical(dtype, n_kv):
+    cfg = _cfg(n_kv_head=n_kv)
+    params = init_decode_params(cfg, seed=3)
+    rng = np.random.RandomState(3)
+    tenants = ["t1", "t2", "t3"]
+    ap = _adapters(cfg, tenants, slots=4)
+    pool = _pool(cfg, dtype=dtype)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=4,
+                                  prefix_cache=cache, adapter_pool=ap)
+    reqs = _mixed_requests(cfg, rng, tenants)
+    results = loop.run(reqs)
+    for req, res in zip(reqs, results):
+        assert res.error is None, res.error
+        assert res.tokens == _oracle_tokens(params, cfg, ap, req,
+                                            dtype=dtype), req.adapter_id
+        if dtype == "float32" and req.adapter_id is None:
+            want, _ = full_decode(params, cfg, req.prompt,
+                                  req.max_new_tokens)
+            assert res.tokens == want
+    cache.clear()
+    assert pool.used_pages == 0
+    assert ap.stats()["in_flight"] == 0
+    assert ap.check_invariants()["ok"]
+    assert pool.check_invariants()["ok"]
+    assert loop.adapter_rows > 0
+    assert loop.adapter_gather_bytes > 0
+
+
+def test_mixed_tenant_batch_with_speculation_token_identical():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=7)
+    rng = np.random.RandomState(7)
+    tenants = ["t1", "t2", "t3"]
+    ap = _adapters(cfg, tenants, slots=4)
+    pool = _pool(cfg, num_pages=96)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=4,
+                                  adapter_pool=ap, speculate=2)
+    # motif-tiled prompts: the traffic shape prompt-lookup drafting
+    # actually accepts on — otherwise d=2 degenerates to d=0
+    reqs = []
+    for aid in tenants + [None]:
+        motif = rng.randint(1, cfg.vocab_size, size=3).tolist()
+        reqs.append(DecodeRequest(prompt=(motif * 4)[:10],
+                                  max_new_tokens=6, adapter_id=aid))
+    results = loop.run(reqs)
+    for req, res in zip(reqs, results):
+        assert res.error is None, res.error
+        # greedy speculation must be token-identical to the d=0
+        # sequential dense-merge oracle — acceptance is a perf knob,
+        # never a correctness one, per tenant
+        assert res.tokens == _oracle_tokens(params, cfg, ap, req), \
+            req.adapter_id
+    assert pool.used_pages == 0
+    assert ap.stats()["in_flight"] == 0
+
+
+# -- (b) cross-tenant isolation ------------------------------------------
+
+def test_prefix_cache_is_adapter_namespaced():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=5)
+    rng = np.random.RandomState(5)
+    ap = _adapters(cfg, ["t1", "t2"], slots=4)
+    pool = _pool(cfg)
+    cache = PrefixCache(pool)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=2,
+                                  prefix_cache=cache, adapter_pool=ap)
+    prompt = rng.randint(1, cfg.vocab_size, size=13).tolist()
+    (base_res,) = loop.run([DecodeRequest(prompt=list(prompt),
+                                          max_new_tokens=3)])
+    assert base_res.error is None
+    # the base run cached full pages for ITS namespace only: the same
+    # prompt bytes match nothing for a tenant (or vice versa)
+    assert cache.match(prompt).tokens > 0
+    assert cache.match(prompt, adapter_id="t1").tokens == 0
+    served_before = cache.stats()["cached_tokens_served"]
+    (t1_res,) = loop.run([DecodeRequest(prompt=list(prompt),
+                                        max_new_tokens=3,
+                                        adapter_id="t1")])
+    assert t1_res.error is None
+    # the tenant request prefilled from scratch — zero cached tokens
+    # crossed the namespace boundary — and its output is the merged-
+    # weights oracle's, not a replay of base K/V
+    assert cache.stats()["cached_tokens_served"] == served_before
+    assert t1_res.tokens == _oracle_tokens(
+        params, cfg, ap,
+        DecodeRequest(prompt=list(prompt), max_new_tokens=3,
+                      adapter_id="t1"))
+    # now BOTH namespaces hold the chain; each matches only its own,
+    # and the drafter's n-gram probe honors the same boundary
+    assert cache.match(prompt, adapter_id="t1").tokens > 0
+    assert cache.match(prompt, adapter_id="t2").tokens == 0
+    probe = list(prompt[:4])
+    if cache.ngram_continuation(probe, 4):
+        assert not cache.ngram_continuation(probe, 4, adapter_id="t2")
+    cache.clear()
+    assert pool.used_pages == 0
+
+
+# -- (c) pool mechanics ---------------------------------------------------
+
+def test_register_validates_geometry_typed():
+    cfg = _cfg()
+    ap = AdapterPool(cfg, slots=2, max_rank=2)
+    good = make_adapter(cfg, rank=2, seed=1)
+    with pytest.raises(AdapterGeometryError):
+        ap.register_adapter("r", {**good, "wq": (
+            good["wq"][0][:, :1], good["wq"][1])})  # rank mismatch A vs B
+    with pytest.raises(AdapterGeometryError):
+        bad_a = np.zeros((cfg.d_model + 1, 2), np.float32)
+        ap.register_adapter("shape", {**good, "wq": (bad_a,
+                                                     good["wq"][1])})
+    with pytest.raises(AdapterGeometryError):
+        ap.register_adapter("rank", make_adapter(cfg, rank=4, seed=2))
+    ap.register_adapter("ok", good)
+    with pytest.raises(ValueError, match="publish"):
+        ap.register_adapter("ok", good)
+
+
+def test_lru_spills_cold_never_in_flight():
+    cfg = _cfg()
+    ap = _adapters(cfg, ["t1", "t2"], slots=1)
+    s1 = ap.acquire("t1")
+    assert s1 == 1
+    # t1 is IN FLIGHT in the only slot: t2 must reject typed, not
+    # evict the tenant mid-decode
+    with pytest.raises(AdapterPoolFullError):
+        ap.acquire("t2")
+    ap.release("t1")
+    # cold now — t2's fault-in spills it
+    assert ap.acquire("t2") == 1
+    st = ap.stats()
+    assert st["spills"] == 1
+    assert st["fault_ins"] == 2
+    ap.release("t2")
+    assert ap.check_invariants()["ok"]
+    # refcount audit: double-acquire needs double-release
+    ap.acquire("t1"); ap.acquire("t1")
+    assert ap.stats()["in_flight"] == 2
+    ap.release("t1")
+    assert ap.stats()["in_flight"] == 1
+    ap.release("t1")
+    assert ap.stats()["in_flight"] == 0
+    assert ap.check_invariants()["ok"]
+
+
+def test_corrupt_host_payload_fails_crc_and_drops(monkeypatch):
+    cfg = _cfg()
+    ap = AdapterPool(cfg, slots=2, max_rank=2)
+    monkeypatch.setenv("FAULT_SERVE_ADAPTER_CORRUPT", "1")
+    ap.register_adapter("bad", make_adapter(cfg, rank=2, seed=1))
+    with pytest.raises(AdapterCorruptError):
+        ap.acquire("bad")
+    # the registration is GONE — a bit-rotted payload must not be
+    # retried into a tenant forever
+    assert not ap.loadable("bad")
+    st = ap.stats()
+    assert st["corrupt_drops"] == 1
+    assert ap.check_invariants()["ok"]
+
+
+def test_bounded_host_tier_rejects_typed():
+    cfg = _cfg()
+    w = make_adapter(cfg, rank=2, seed=1)
+    nbytes = sum(a.nbytes + b.nbytes for a, b in w.values())
+    ap = AdapterPool(cfg, slots=2, max_rank=2, host_bytes=nbytes)
+    ap.register_adapter("fits", w)
+    with pytest.raises(AdapterHostFullError):
+        ap.register_adapter("over", make_adapter(cfg, rank=2, seed=2))
+    ap.retire("fits")
+    ap.register_adapter("over", make_adapter(cfg, rank=2, seed=2))
+    assert ap.check_invariants()["ok"]
+
+
+def test_publish_retire_refuse_in_flight():
+    cfg = _cfg()
+    ap = _adapters(cfg, ["t1"], slots=2)
+    w2 = make_adapter(cfg, rank=2, seed=99)
+    ap.acquire("t1")
+    with pytest.raises(AdapterInUseError):
+        ap.publish("t1", w2)
+    with pytest.raises(AdapterInUseError):
+        ap.retire("t1")
+    ap.release("t1")
+    old = ap.merged_params(init_decode_params(cfg, seed=0), "t1")
+    ap.publish("t1", w2)  # register-or-replace once cold
+    new = ap.merged_params(init_decode_params(cfg, seed=0), "t1")
+    assert not np.allclose(old["layers"][0]["wq"],
+                           new["layers"][0]["wq"])
+    ap.publish("t9", w2)  # register arm of the same seam
+    assert ap.loadable("t9")
+    ap.retire("t1")
+    assert not ap.loadable("t1")
+    with pytest.raises(AdapterNotRegisteredError):
+        ap.acquire("t1")
+    assert ap.check_invariants()["ok"]
+
+
+def test_gather_bytes_scale_with_rows_not_weights():
+    cfg = _cfg()
+    one = adapter_gather_bytes_per_step(cfg, 2, 1)
+    assert one > 0
+    assert adapter_gather_bytes_per_step(cfg, 2, 4) == 4 * one
+    # base-only traffic gathers nothing
+    assert adapter_gather_bytes_per_step(cfg, 2, 0) == 0
+
+
+# -- (d) typed admission reject ------------------------------------------
+
+def test_unloadable_adapter_rejects_before_pages_rest_decodes():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=2)
+    rng = np.random.RandomState(2)
+    ap = _adapters(cfg, ["t1"], slots=2)
+    pool = _pool(cfg)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=2,
+                                  adapter_pool=ap)
+    good = DecodeRequest(
+        prompt=rng.randint(1, cfg.vocab_size, size=6).tolist(),
+        max_new_tokens=4, adapter_id="t1")
+    bad = DecodeRequest(
+        prompt=rng.randint(1, cfg.vocab_size, size=6).tolist(),
+        max_new_tokens=4, adapter_id="ghost")
+    res_good, res_bad = loop.run([good, bad])
+    assert isinstance(res_bad.error, AdapterNotRegisteredError)
+    assert res_bad.tokens == []
+    assert res_good.error is None
+    assert res_good.tokens == _oracle_tokens(params, cfg, ap, good)
+    assert loop.adapter_rejects == 1
+    assert pool.used_pages == 0  # the reject claimed nothing
+    assert ap.stats()["in_flight"] == 0
+
+
+def test_adapter_request_without_pool_is_config_error():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=2)
+    loop = ContinuousBatchingLoop(params, cfg, _pool(cfg))
+    with pytest.raises(ValueError, match="adapter_pool"):
+        loop.run([DecodeRequest(prompt=[1, 2, 3], max_new_tokens=2,
+                                adapter_id="t1")])
+
+
+# -- (e) the tiered-KV / handoff adapter stamp ---------------------------
+
+def test_session_resume_under_other_adapter_resets():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=9)
+    rng = np.random.RandomState(9)
+    ap = _adapters(cfg, ["t1"], slots=2)
+    pool = _pool(cfg)
+    mgr = TieredSessionManager(pool, host_bytes=1 << 26)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=2,
+                                  session_manager=mgr, adapter_pool=ap)
+    prompt = rng.randint(1, cfg.vocab_size, size=9).tolist()
+
+    # idle-resident arm: turn 1 under t1, turn 2 under base
+    sess = mgr.open_session()
+    (r1,) = loop.run([DecodeRequest(prompt=list(prompt),
+                                    max_new_tokens=4, session=sess,
+                                    adapter_id="t1")])
+    assert r1.error is None
+    p2 = prompt + r1.tokens + [5, 7]
+    (r2,) = loop.run([DecodeRequest(prompt=list(p2), max_new_tokens=4,
+                                    session=sess)])
+    assert r2.error is None
+    assert mgr.stats()["adapter_mismatch_resets"] == 1
+    # the reset re-prefilled from scratch under BASE weights — exactly
+    # what a fresh sessionless decode of the transcript produces
+    want, _ = full_decode(params, cfg, p2, 4)
+    assert r2.tokens == want
+
+    # parked arm: spill between the mismatched turns
+    sess2 = mgr.open_session()
+    (r3,) = loop.run([DecodeRequest(prompt=list(prompt),
+                                    max_new_tokens=4, session=sess2,
+                                    adapter_id="t1")])
+    assert r3.error is None
+    assert mgr.spill(sess2, wait=True)
+    p4 = prompt + r3.tokens + [5, 7]
+    (r4,) = loop.run([DecodeRequest(prompt=list(p4), max_new_tokens=4,
+                                    session=sess2)])
+    assert r4.error is None
+    assert mgr.stats()["adapter_mismatch_resets"] == 2
+    want4, _ = full_decode(params, cfg, p4, 4)
+    assert r4.tokens == want4
+
+    # matching stamps DO resume: one more t1 turn on a t1 session
+    sess3 = mgr.open_session()
+    (r5,) = loop.run([DecodeRequest(prompt=list(prompt),
+                                    max_new_tokens=4, session=sess3,
+                                    adapter_id="t1")])
+    p6 = prompt + r5.tokens + [5, 7]
+    resumes = mgr.stats()["resumes"]
+    (r6,) = loop.run([DecodeRequest(prompt=list(p6), max_new_tokens=4,
+                                    session=sess3, adapter_id="t1")])
+    assert r6.error is None
+    assert mgr.stats()["resumes"] == resumes + 1
+    assert mgr.stats()["adapter_mismatch_resets"] == 2
+    mgr.close()
+    assert ap.stats()["in_flight"] == 0
+
+
+def test_seq_export_pickles_adapter_stamp_and_handoff_rejects():
+    cfg = _cfg()
+    pool = _pool(cfg)
+    pool.allocate(1)
+    pages, slots = pool.append_tokens([1], [6])
+    rng = np.random.RandomState(0)
+    for li in range(pool.num_layers):
+        kv = rng.rand(6, pool.num_kv_heads,
+                      pool.head_dim).astype(np.float32)
+        pool.write_kv(li, pages, slots, kv, kv)
+    export = pool.export_seq(1, adapter_id="t1")
+    assert export.adapter_id == "t1"
+    wire = pickle.loads(pickle.dumps(export))
+    assert wire.adapter_id == "t1"  # the stamp crosses the proc plane
+    # a broker mix-up: payload prefilled under t1, request wants t2 —
+    # admit must reject typed BEFORE touching any pool state
+    hd = Handoff(
+        request=DecodeRequest(prompt=[1, 2], max_new_tokens=2,
+                              adapter_id="t2"),
+        first_token=3, first_logits=np.zeros(cfg.vocab_size,
+                                             np.float32),
+        payload=wire)
+    with pytest.raises(AdapterMismatchError):
+        hd.admit(None, None, 7)
+    assert not hd.admitted
+    pool.free_seq(1)
+    assert pool.used_pages == 0
+
+
+# -- (f) fleet: mixed tenants end to end + hot publish/retire ------------
+
+def _mk_adapter_fleet(params, cfg, weights):
+    pools = []
+
+    def _ap():
+        ap = AdapterPool(cfg, slots=4, max_rank=2)
+        for aid, w in weights.items():
+            ap.register_adapter(aid, w)
+        pools.append(ap)
+        return ap
+
+    fleet = Fleet(
+        lambda n: PrefillReplica(
+            n, params, cfg, num_pages=64, page_size=4, max_batch=4,
+            adapter_pool=_ap()),
+        lambda n: DecodeReplica(
+            n, params, cfg, num_pages=64, page_size=4, max_batch=4,
+            adapter_pool=_ap()))
+    return fleet, pools
+
+
+def test_fleet_serves_mixed_tenants_and_hot_updates():
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=11)
+    rng = np.random.RandomState(11)
+    weights = {f"t{k}": make_adapter(cfg, rank=2, seed=20 + k)
+               for k in (1, 2)}
+    fleet, pools = _mk_adapter_fleet(params, cfg, weights)
+    try:
+        oracle_ap = _adapters(cfg, [])  # geometry holder for merges
+        for aid, w in weights.items():
+            oracle_ap.register_adapter(aid, w)
+        reqs = _mixed_requests(cfg, rng, ["t1", "t2"], max_new=4)
+        results = [f.result(timeout=60)
+                   for f in [fleet.submit(r) for r in reqs]]
+        for req, res in zip(reqs, results):
+            assert res.error is None, res.error
+            assert res.tokens == _oracle_tokens(params, cfg, oracle_ap,
+                                                req), req.adapter_id
+        audit = fleet.audit()
+        assert audit["pages_leaked"] == 0
+        assert audit["invariants_ok"] == 1
+
+        # hot adapter update under the drain seam: publish t3
+        # everywhere, retire t1 everywhere
+        w3 = make_adapter(cfg, rank=2, seed=33)
+        ctl = FleetController(fleet)
+        updated = ctl.rolling_adapter_update(publish={"t3": w3},
+                                             retire=["t1"])
+        assert len(updated) == 2  # one prefill + one decode replica
+        for ap in pools:
+            assert ap.loadable("t3")
+            assert not ap.loadable("t1")
+
+        # the retired tenant fails typed; the published one serves
+        with pytest.raises((AdapterNotRegisteredError, ValueError)):
+            fleet.submit(DecodeRequest(
+                prompt=[1, 2, 3], max_new_tokens=2,
+                adapter_id="t1")).result(timeout=60)
+        oracle_ap.register_adapter("t3", w3)
+        req3 = DecodeRequest(
+            prompt=rng.randint(1, cfg.vocab_size, size=7).tolist(),
+            max_new_tokens=4, adapter_id="t3")
+        res3 = fleet.submit(req3).result(timeout=60)
+        assert res3.error is None
+        assert res3.tokens == _oracle_tokens(params, cfg, oracle_ap,
+                                             req3)
+        audit = fleet.audit()
+        assert audit["pages_leaked"] == 0
+        assert audit["invariants_ok"] == 1
+        for ap in pools:
+            assert ap.stats()["in_flight"] == 0
+    finally:
+        fleet.close()
+
+
+# -- (g) Engine.submit threading -----------------------------------------
+
+class _AdapterEchoBackend:
+    """Pass-through backend recording the adapter_id call kwarg — the
+    decode-style seam Engine.submit threads per-request variants to."""
+
+    feed_names = ["x"]
+    fetch_names = ["y"]
+    meta: dict = {}
+
+    def __init__(self):
+        self.seen = []
+
+    def __call__(self, feed, adapter_id=None):
+        self.seen.append(adapter_id)
+        return [np.asarray(feed["x"])]
+
+
+def test_engine_submit_threads_adapter_id_pass_through_only():
+    backend = _AdapterEchoBackend()
+    eng = Engine(backend, config=EngineConfig(buckets=()))
+    try:
+        with pytest.raises(TypeError, match="adapter_id"):
+            eng.submit({"x": np.ones((1, 2), np.float32)}, adapter_id=5)
+        eng.submit({"x": np.ones((1, 2), np.float32)},
+                   adapter_id="tenant-a").result(timeout=10)
+        eng.submit({"x": np.ones((1, 2), np.float32)}).result(timeout=10)
+        assert backend.seen == ["tenant-a", None]
+    finally:
+        eng.close()
+    # a bucketed ladder pads many requests into one batch — per-request
+    # variants cannot apply, same contract as sampling/call_kwargs
+    bucketed = Engine(_AdapterEchoBackend(),
+                      config=EngineConfig(buckets=(1,), max_wait_s=0.0))
+    try:
+        with pytest.raises(ValueError, match="pass-through"):
+            bucketed.submit({"x": np.ones((1, 2), np.float32)},
+                            adapter_id="tenant-a")
+    finally:
+        bucketed.close()
+
+
+# -- (h) gated observability ---------------------------------------------
+
+def _tenanted_run(include_reject=False):
+    cfg = _cfg()
+    params = init_decode_params(cfg, seed=4)
+    rng = np.random.RandomState(4)
+    ap = _adapters(cfg, ["t1", "t2"], slots=4)
+    pool = _pool(cfg)
+    loop = ContinuousBatchingLoop(params, cfg, pool, max_batch=4,
+                                  adapter_pool=ap)
+    reqs = _mixed_requests(cfg, rng, ["t1", "t2"], max_new=3)
+    if include_reject:
+        reqs.append(DecodeRequest(prompt=[1, 2, 3], max_new_tokens=3,
+                                  adapter_id="ghost"))
+    loop.run(reqs)
+    assert pool.used_pages == 0
+
+
+def test_adapter_metrics_disabled_path_mints_nothing():
+    obs.reset()
+    try:
+        _tenanted_run()  # FLAGS_observability defaults off
+        names = {m.name for m in obs.default_registry().metrics()}
+        assert not any("adapter" in n for n in names), names
+    finally:
+        obs.reset()
+
+
+def test_adapter_metrics_enabled_records_events_and_gauges():
+    fluid.set_flags({"FLAGS_observability": True})
+    obs.reset()
+    try:
+        _tenanted_run(include_reject=True)
+        reg = obs.default_registry()
+        ev = reg.counter("paddle_tpu_serving_adapter_events", "")
+        assert ev.value(event="load") == 2
+        assert ev.value(event="fault_in") == 2
+        assert ev.value(event="reject") == 1
+        names = {m.name for m in reg.metrics()}
+        assert "paddle_tpu_serving_adapter_pool_bytes" in names
+        assert "paddle_tpu_serving_adapter_pool_utilization" in names
+        assert ("paddle_tpu_serving_adapter_gather_bytes_per_step"
+                in names)
+    finally:
+        obs.reset()
+        fluid.set_flags({"FLAGS_observability": False})

@@ -24,6 +24,13 @@
 #              and zero leaks; the teeth arm re-runs --no-tier
 #              (every turn re-prefills) against the tiered bank,
 #              which must exit 3
+#   tenants  - multi-tenant adapter smoke (ISSUE 19): a Zipf-workload
+#              serve_bench --tenants replay through the paged
+#              batched-LoRA pool — the gate banks adapter_hit_rate,
+#              errored_sequences=0 and zero leaks / green invariants
+#              on both pools; the teeth arm squeezes 16 tenants
+#              through a one-slot pack (thrash + admission rejects),
+#              which must exit 3
 #   procfleet - process-level fleet smoke (ISSUE 17): serve_bench
 #              --fleet --procs 2 with FAULT_SERVE_PROC_KILL armed —
 #              a live replica pid is SIGKILLed mid-run and the gate
@@ -175,6 +182,38 @@ JSON
   rm -rf "$tmp"
 }
 
+run_tenants() {
+  echo "== multi-tenant adapter smoke (Zipf workload, paged LoRA pool) =="
+  tmp="$(mktemp -d)"
+  # the banked contract: a working set that fits the pack stays hot
+  # (head tenants resident, the tail faults in once each), nothing is
+  # rejected on the happy path, and both pools audit leak-free
+  cat > "$tmp/bank.json" <<'JSON'
+{"adapter_hit_rate": 0.8, "errored_sequences": 0, "pages_leaked": 0,
+ "invariants_ok": 1}
+JSON
+  python tools/serve_bench.py --mode decode --tenants 4 \
+    --adapter-slots 8 --adapter-rank 2 --sequences 40 --max-new 6 \
+    --prompt-range 2,12 --d-model 32 --max-len 48 --pages 64 \
+    --page-size 4 --no-warmup \
+    --json "$tmp/tenants.json" --baseline "$tmp/bank.json" --gate
+  echo "== tenants teeth: 16 tenants through a 1-slot pack must exit 3 =="
+  set +e
+  python tools/serve_bench.py --mode decode --tenants 16 \
+    --adapter-slots 1 --adapter-rank 2 --sequences 40 --max-new 6 \
+    --prompt-range 2,12 --d-model 32 --max-len 48 --pages 64 \
+    --page-size 4 --no-warmup \
+    --baseline "$tmp/bank.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "tenants teeth: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "tenants teeth OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_procfleet() {
   echo "== process fleet smoke (SIGKILL a live pid; nothing lost) =="
   tmp="$(mktemp -d)"
@@ -219,9 +258,10 @@ case "$stage" in
   fleet)  run_fleet ;;
   spec)   run_spec ;;
   kvtier) run_kvtier ;;
+  tenants) run_tenants ;;
   procfleet) run_procfleet ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_kvtier; run_procfleet; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|kvtier|procfleet|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_kvtier; run_tenants; run_procfleet; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|kvtier|tenants|procfleet|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

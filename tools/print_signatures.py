@@ -62,6 +62,9 @@ MODULES = [
     # session manager operators wire between pool and loop for
     # multi-turn chat are serving API
     "paddle_tpu.serving.kvtier",
+    # multi-tenant adapters (ISSUE 19): the paged LoRA pool, its typed
+    # error taxonomy, and the gather cost model are serving API
+    "paddle_tpu.serving.adapters",
     # the serving hot path's kernel entry points are public surface:
     # serve_bench / operators select impls through them
     "paddle_tpu.kernels.paged_attention",

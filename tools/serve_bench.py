@@ -83,6 +83,16 @@ a batching server — latency percentiles, throughput, and batch occupancy
   Reports the usual decode numbers plus the mesh size, so single- vs
   sharded-decode tokens/s rides the same gate.
 
+  tenants mode (--tenants N, decode-mode option): the multi-tenant
+  replay through the paged batched-LoRA adapter pool
+  (serving/adapters.py) — N registered adapters, each request's tenant
+  drawn from a Zipf(1.1) popularity curve, every continuous-batching
+  step serving all resident tenants at once via per-row slot gathers.
+  Banks adapter_hit_rate, adapter_gather_bytes_per_step, per-tenant
+  TTFT percentiles, errored_sequences=0 and zero leaked pages / green
+  invariants on the KV AND adapter pools; --adapter-slots under the
+  working set (the CI teeth arm) thrashes the pack and fails the gate.
+
 Gating mirrors tools/obsdump.py and tools/lint_programs.py — the shared
 CI-gate exit-code contract (README "CI gates"): --baseline BANKED.json
 re-checks this run against a banked artifact ({metric: value};
@@ -879,6 +889,136 @@ def run_multiturn_bench(args) -> dict:
     }
 
 
+def run_tenants_bench(args) -> dict:
+    """--tenants N (decode mode): the multi-tenant replay the paged
+    adapter pool (ISSUE 19) exists for.  N LoRA adapters are registered
+    up front (``tenant1`` .. ``tenantN``) and every request draws its
+    tenant from a Zipf(s=1.1) popularity curve — the head tenants stay
+    hot in the --adapter-slots device pack, the tail faults in from the
+    host tier on demand, and one continuous-batching step serves every
+    resident tenant at once (each row gathers its own slot's factors).
+
+    Banked contract (0/2/3 gate): adapter_hit_rate == warm-slot
+    acquires / all acquires (high when the working set fits the pack;
+    a one-slot pool under a 16-tenant Zipf THRASHES — the CI teeth
+    arm), adapter_gather_bytes_per_step (the analytic per-step adapter
+    traffic — gathers, not dense weight copies), errored_sequences ==
+    0 (no admission rejects on the happy path), zero leaked pages and
+    green invariants on BOTH pools, plus a per-tenant TTFT p50/p99
+    breakdown (report-only: the gate walks top-level scalars)."""
+    from paddle_tpu import serving
+
+    kv_dtype = _KV_DTYPES[args.kv_dtype]
+    cfg = serving.DecodeConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_head=args.n_head,
+        n_layer=args.n_layer, d_inner=args.d_model * 2,
+        max_length=args.max_len,
+        n_kv_head=args.kv_heads or None)
+    params = serving.init_decode_params(cfg, seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    tenants = [f"tenant{k}" for k in range(1, args.tenants + 1)]
+    weights = {t: serving.make_adapter(cfg, rank=args.adapter_rank,
+                                       seed=args.seed + k)
+               for k, t in enumerate(tenants, start=1)}
+
+    def _fresh_adapters():
+        ap = serving.AdapterPool(cfg, slots=args.adapter_slots,
+                                 max_rank=args.adapter_rank)
+        for t in tenants:
+            ap.register_adapter(t, weights[t])
+        return ap
+
+    # Zipf(s=1.1) tenant popularity: rank-k tenant drawn w.p. ~ 1/k^s
+    zipf = np.array([1.0 / k ** 1.1
+                     for k in range(1, args.tenants + 1)])
+    zipf /= zipf.sum()
+    draws = rng.choice(args.tenants, size=args.sequences, p=zipf)
+    plo, phi = (int(p) for p in args.prompt_range.split(","))
+    phi = min(phi, args.max_len - args.max_new)
+    reqs = [serving.DecodeRequest(
+        prompt=rng.randint(
+            1, cfg.vocab_size,
+            size=int(rng.randint(plo, max(plo + 1, phi + 1)))).tolist(),
+        max_new_tokens=args.max_new,
+        adapter_id=tenants[d])
+        for d in draws]
+
+    def _fresh_pool():
+        return serving.KVCachePool(
+            num_pages=args.pages, page_size=args.page_size,
+            num_layers=cfg.n_layer, num_heads=cfg.n_head,
+            head_dim=cfg.head_dim, num_kv_heads=cfg.num_kv_heads,
+            dtype=kv_dtype)
+
+    if args.warmup:
+        # untimed replay on throwaway pools: compiles the adapter-armed
+        # step shapes so the timed numbers compare steady-state decode
+        serving.ContinuousBatchingLoop(
+            params, cfg, _fresh_pool(), max_batch=args.max_batch,
+            paged_impl=args.paged_impl, prefill=args.prefill,
+            prefill_chunk=args.prefill_chunk,
+            adapter_pool=_fresh_adapters()).run(reqs)
+    pool = _fresh_pool()
+    adapters = _fresh_adapters()
+    cache = serving.PrefixCache(pool) if args.prefix_cache else None
+    loop = serving.ContinuousBatchingLoop(
+        params, cfg, pool, max_batch=args.max_batch,
+        paged_impl=args.paged_impl, prefill=args.prefill,
+        prefix_cache=cache, prefill_chunk=args.prefill_chunk,
+        adapter_pool=adapters)
+    t0 = time.perf_counter()
+    results = loop.run(reqs)
+    elapsed = time.perf_counter() - t0
+    errored = sum(1 for r in results if r.error is not None)
+    tokens = sum(len(r.tokens) for r in results)
+    per_tenant = {}
+    for d, r in zip(draws, results):
+        if r.error is None and r.ttft_s is not None:
+            per_tenant.setdefault(tenants[d], []).append(r.ttft_s)
+    if cache is not None:
+        cache.clear()
+    ast = adapters.stats()
+    st = pool.stats()
+    kv_ok = pool.check_invariants()["ok"]
+    ad_ok = adapters.check_invariants()["ok"]
+    return {
+        "mode": "tenants",
+        "tenants": args.tenants,
+        "adapter_slots": args.adapter_slots,
+        "adapter_rank": args.adapter_rank,
+        "sequences": args.sequences,
+        "kv_heads": cfg.num_kv_heads,
+        "kv_dtype": args.kv_dtype,
+        "tokens": tokens,
+        "tokens_per_s": tokens / elapsed,
+        "steps": loop.steps,
+        "errored_sequences": errored,
+        "adapter_rejects": loop.adapter_rejects,
+        # the headline: acquires served from a warm device slot vs
+        # faulted in from the host tier — a working set that fits
+        # --adapter-slots stays ~1, a thrashing pool collapses
+        "adapter_hit_rate": ast["hit_rate"],
+        "adapter_fault_ins": ast["fault_ins"],
+        "adapter_spills": ast["spills"],
+        "adapter_device_bytes": ast["device_bytes"],
+        "adapter_utilization": ast["utilization"],
+        # analytic per-step adapter traffic: slot GATHERS, priced like
+        # the banked lora_decode zoo entry — not dense weight copies
+        "adapter_gather_bytes_per_step":
+            loop.adapter_gather_bytes / max(1, loop.steps),
+        "adapter_in_flight": ast["in_flight"],  # must end 0
+        "per_tenant": {
+            t: {
+                "requests": len(ls),
+                "ttft_p50_ms": _percentile(ls, 50) * 1e3,
+                "ttft_p99_ms": _percentile(ls, 99) * 1e3,
+            } for t, ls in sorted(per_tenant.items())
+        },
+        "pages_leaked": st["used_pages"],
+        "invariants_ok": int(kv_ok and ad_ok),
+    }
+
+
 def run_fleet_bench(args, elastic: bool) -> dict:
     """--disagg / --fleet (decode-mode options): the decode replay
     through a disaggregated prefill/decode Fleet (serving/fleet).
@@ -1073,7 +1213,8 @@ _HIGHER_IS_BETTER = ("throughput", "tokens_per_s", "occupancy",
                      "handoffs", "replica_kills", "respawns",
                      "skipped_tokens", "resume_hit_rate",
                      "retained_tokens", "retention_ratio",
-                     "resumed_host")
+                     "resumed_host", "adapter_hit_rate",
+                     "adapter_utilization")
 
 
 def gate(result: dict, baseline_path: str, tol: float):
@@ -1197,6 +1338,26 @@ def main(argv=None) -> int:
                          "transcript) — the CI teeth arm")
     ap.add_argument("--host-mb", type=int, default=256,
                     help="host KV tier capacity for --turns, in MiB")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="decode mode: multi-tenant replay — register N "
+                         "LoRA adapters (paged AdapterPool, ISSUE 19) "
+                         "and draw each request's tenant from a "
+                         "Zipf(1.1) popularity curve; banks "
+                         "adapter_hit_rate, "
+                         "adapter_gather_bytes_per_step, per-tenant "
+                         "TTFT percentiles, errored_sequences=0 and "
+                         "zero leaked pages / green invariants on both "
+                         "pools.  A pool sized under the working set "
+                         "(--adapter-slots 1 vs 16 tenants) thrashes "
+                         "— the CI teeth arm")
+    ap.add_argument("--adapter-slots", type=int, default=4,
+                    help="with --tenants: device-resident adapter "
+                         "slots in the batched A/B pack (the paged "
+                         "tier; cold tenants fault in from host)")
+    ap.add_argument("--adapter-rank", type=int, default=4,
+                    help="with --tenants: LoRA rank of every "
+                         "registered adapter (= the pack's padded "
+                         "max_rank)")
     ap.add_argument("--disagg", action="store_true",
                     help="decode mode: run the replay through a "
                          "disaggregated prefill/decode Fleet "
@@ -1350,6 +1511,21 @@ def main(argv=None) -> int:
         sys.stderr.write(
             "serve_bench: --no-tier/--think-time-s need --turns > 1\n")
         return 2
+    if args.tenants < 0 or args.adapter_slots < 1 \
+            or args.adapter_rank < 1:
+        sys.stderr.write(
+            "serve_bench: --tenants must be >= 0 and "
+            "--adapter-slots/--adapter-rank >= 1\n")
+        return 2
+    if args.tenants:
+        if args.mode != "decode" or args.mesh > 1 or args.speculate \
+                or args.chaos or args.disagg or args.fleet \
+                or args.turns > 1 or args.sampling != "greedy":
+            sys.stderr.write(
+                "serve_bench: --tenants needs plain --mode decode "
+                "(no --mesh/--speculate/--chaos/--disagg/--fleet/"
+                "--turns/--sampling)\n")
+            return 2
     if args.procs and not args.fleet:
         sys.stderr.write(
             "serve_bench: --procs needs --fleet (the process topology "
@@ -1416,6 +1592,8 @@ def main(argv=None) -> int:
             result = run_engine_bench(args)
         elif args.disagg or args.fleet:
             result = run_fleet_bench(args, elastic=args.fleet)
+        elif args.tenants:
+            result = run_tenants_bench(args)
         elif args.turns > 1:
             result = run_multiturn_bench(args)
         else:
