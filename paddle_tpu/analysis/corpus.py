@@ -244,6 +244,34 @@ def _spec_verify_spmd_gather() -> ProgramArtifacts:
     return capture_spec_verify_spmd(gather=True)
 
 
+def _longctx_flat_pool() -> ProgramArtifacts:
+    """The long-context SMEM regression the longctx_decode zoo entry
+    gates on (ISSUE 20): the SAME windowed GQA int8 decode geometry
+    (~1k pages/seq, 16k-page pool) walked through the FLAT page-table
+    contract — the scalar-prefetch operands ([B, max_pages] table +
+    starts rows plus two POOL-sized [P] fp32 scale rows) total ~160 KB
+    against the ~128 KB v5e SMEM envelope.  The smem-overflow detector
+    prices it straight from the traced jaxpr (the AOT pipeline may
+    reject the kernel too — the gate fails either way), so
+    ``lint_programs --inject longctx_flat_pool --gate`` exits 3 against
+    the banked two-level baseline.  The artifact shares the zoo entry's
+    capture (and name) via ``zoo.capture_longctx_decode``, so retuning
+    the zoo geometry retunes this check with it."""
+    from .zoo import capture_longctx_decode
+
+    return capture_longctx_decode(two_level=False)
+
+
+def _longctx_flat_pool_extra_bytes() -> float:
+    """The flat arm streams the same analytic int8 page walk as the
+    banked two-level entry — the hazard is SMEM, not HBM, and charging
+    the honest stream keeps the bytes verdict quiet so the gate failure
+    is unambiguously the detector's."""
+    from .zoo import longctx_decode_stream_bytes
+
+    return longctx_decode_stream_bytes()
+
+
 def _gqa_full_pool() -> ProgramArtifacts:
     """The GQA regression the gqa_decode zoo entry gates on: a model
     configured for grouped KV heads served from a FULL H_q pool (the
@@ -284,6 +312,7 @@ CORPUS = {
     "all_gather_replicated": (_all_gather_replicated,
                               "collective-placement"),
     "gqa_full_pool": (_gqa_full_pool, None),
+    "longctx_flat_pool": (_longctx_flat_pool, "smem-overflow"),
     "spec_verify_gather": (_spec_verify_gather, None),
     "spec_verify_spmd_gather": (_spec_verify_spmd_gather, None),
 }
@@ -293,6 +322,7 @@ CORPUS = {
 # mirroring the real zoo entries' methodology); default 0
 _EXTRA_BYTES = {
     "gqa_full_pool": _gqa_full_pool_extra_bytes,
+    "longctx_flat_pool": _longctx_flat_pool_extra_bytes,
 }
 
 

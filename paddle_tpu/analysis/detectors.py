@@ -43,6 +43,13 @@ AOT_COST_ZOO.json baselines key on them):
                        iteration rewrites the loop-resident HBM buffer
                        at 2x the bytes — where the widened result then
                        escapes to HBM unnarrowed
+  smem-overflow        a pallas_call whose scalar-prefetch operands +
+                       SMEM scratch exceed the scalar-memory budget
+                       (analysis/pallas.py kernel_smem_bytes) — the
+                       long-context class: flat page tables and
+                       pool-sized [P] scale rows grow with total
+                       pages; the two-level table view keeps SMEM on
+                       the walked blocks
 """
 
 from __future__ import annotations
@@ -52,7 +59,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .capture import ProgramArtifacts
 from .findings import Finding
 from . import hlo as H
-from .pallas import detect_vmem_overflow, iter_subjaxprs as _iter_subjaxprs
+from .pallas import (detect_smem_overflow, detect_vmem_overflow,
+                     iter_subjaxprs as _iter_subjaxprs)
 
 __all__ = ["DETECTORS", "run_detectors"]
 
@@ -616,6 +624,7 @@ DETECTORS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "collective-placement": detect_collective_placement,
     # kernel-interior tier (analysis/pallas.py): inside the custom call
     "vmem-overflow": detect_vmem_overflow,
+    "smem-overflow": detect_smem_overflow,
 }
 
 

@@ -36,12 +36,16 @@ from .findings import Finding
 
 __all__ = [
     "KernelCost",
+    "V5E_SMEM_BYTES",
     "V5E_VMEM_BYTES",
+    "default_smem_budget",
     "default_vmem_budget",
+    "detect_smem_overflow",
     "detect_vmem_overflow",
     "iter_pallas_calls",
     "iter_subjaxprs",
     "kernel_cost",
+    "kernel_smem_bytes",
     "kernel_vmem_bytes",
     "tile_padded_bytes",
 ]
@@ -52,6 +56,15 @@ __all__ = [
 # derive their headroomed budgets from
 V5E_VMEM_BYTES = 16 * 1024 * 1024
 
+# the modeled scalar-memory envelope per core: where scalar-prefetch
+# operands live — grid indices, the paged-attention page tables, the
+# per-page int8 scales.  Orders of magnitude smaller than VMEM, which
+# is exactly why long contexts hit it FIRST: a flat [B, ~1k] page
+# table plus two pool-sized [P] fp32 scale rows is already past this
+# at 128k, while the two-level view (L1 directory + walked L2 blocks,
+# kernels/paged_attention.TwoLevelTables) stays bounded by live blocks
+V5E_SMEM_BYTES = 128 * 1024
+
 _LANE = 128
 
 
@@ -61,6 +74,14 @@ def default_vmem_budget() -> int:
     from .. import flags
 
     return int(flags.flag("analysis_vmem_budget"))
+
+
+def default_smem_budget() -> int:
+    """The smem-overflow detector's budget: FLAGS_analysis_smem_budget
+    (default the modeled V5E_SMEM_BYTES envelope)."""
+    from .. import flags
+
+    return int(flags.flag("analysis_smem_budget"))
 
 
 def tile_padded_bytes(shape, dtype) -> int:
@@ -214,6 +235,13 @@ def kernel_vmem_bytes(eqn) -> int:
     return kernel_cost(eqn).vmem_bytes
 
 
+def kernel_smem_bytes(eqn) -> int:
+    """The SMEM working set of one pallas_call equation: every
+    scalar-prefetch operand + SMEM-space blocks/scratch, flat bytes
+    (scalars are not tiled)."""
+    return kernel_cost(eqn).smem_bytes
+
+
 def detect_vmem_overflow(art) -> List[Finding]:
     """Flag every pallas_call whose statically-priced VMEM working set
     exceeds the v5e budget.  Today such a kernel either falls back off
@@ -240,5 +268,40 @@ def detect_vmem_overflow(art) -> List[Finding]:
                      f" its blocks — biggest: {worst}; this shape "
                      "compiles nowhere on a v5e core — shrink the "
                      "BlockSpecs or tile the grid finer"),
+        ))
+    return findings
+
+
+def detect_smem_overflow(art) -> List[Finding]:
+    """Flag every pallas_call whose scalar-prefetch operands + SMEM
+    scratch exceed the scalar-memory budget — the LONG-CONTEXT failure
+    class (ISSUE 20): a flat [B, max_pages] page table plus two
+    pool-sized [P] int8 scale rows grows with total pages and blows
+    SMEM near ~1k pages/seq, where the two-level table view's L1
+    directory + walked L2 blocks (with block-gathered scales) stays
+    bounded by live blocks.  Like vmem-overflow, the linter prices it
+    from the traced jaxpr — no Mosaic compile, no chip."""
+    budget = default_smem_budget()
+    findings: List[Finding] = []
+    for eqn in iter_pallas_calls(art.jaxpr):
+        cost = kernel_cost(eqn)
+        if cost.smem_bytes <= budget:
+            continue
+        smem_bufs = [b for b in cost.buffers if b[0] == "smem"]
+        top = sorted(smem_bufs, key=lambda b: -b[3])[:3]
+        worst = ", ".join(
+            f"{dtype}{list(shape)}={b} B" for _, shape, dtype, b in top)
+        findings.append(Finding(
+            detector="smem-overflow", severity="error",
+            program=art.name, fingerprint=art.fingerprint,
+            where=f"pallas_call:{cost.name}",
+            vmem_bytes=cost.smem_bytes, budget=budget,
+            message=(f"kernel {cost.name} prefetches {cost.smem_bytes} "
+                     f"bytes of scalars into SMEM (budget {budget}) — "
+                     f"biggest: {worst}; scalar operands growing with "
+                     "total pool pages (flat page tables, [P] scale "
+                     "rows) are the long-context killer — use the "
+                     "two-level table view so SMEM rides the walked "
+                     "blocks"),
         ))
     return findings

@@ -54,6 +54,15 @@ host):
                      the slot-gather traffic (rows x layers x
                      rank-factor bytes), holding the "adapters cost
                      gathers, not dense copies" property under the gate
+  longctx_decode     the long-context serving decode step (ISSUE 20):
+                     GQA int8 decode at ~1k pages/seq over a 16k-page
+                     pool, sliding-window + attention-sink operands,
+                     walked through the TWO-LEVEL page-table view so
+                     the scalar-prefetch SMEM rides the walked L2
+                     blocks — the flat contract at this shape
+                     overflows the ~128 KB SMEM envelope (the
+                     longctx_flat_pool corpus arm proves the
+                     smem-overflow detector trips the gate there)
   prefix_decode      the same decode step under 8-way prefix sharing
                      (ISSUE 11): every sequence's page table walks ONE
                      refcounted shared 28-page prefix plus a private
@@ -513,6 +522,91 @@ def _build_lora_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     return art, 0.0, cfg
 
 
+# the longctx_decode geometry (ISSUE 20): the GQA int8 decode step at
+# the 32k-context serving shape — ~1k pages per sequence over a
+# 16k-page pool — walked through the TWO-LEVEL page-table view with the
+# sliding-window + attention-sink operands the long-context tier
+# serves.  The whole point of banking it: at this scale the FLAT table
+# contract's scalar-prefetch operands ([B, maxp] table + starts + two
+# POOL-sized [P] fp32 scale rows) overflow the ~128 KB SMEM envelope,
+# while the two-level view's SMEM rides the walked L2 blocks.  ONE
+# source of truth with the known-bad corpus arm (longctx_flat_pool):
+# the SAME geometry through the flat contract, flagged by the
+# smem-overflow detector and priced against this entry's banked
+# baseline — retuning this geometry retunes the regression check.
+LONGCTX_DECODE_GEOM = {"batch": 4, "heads": 8, "kv_heads": 2,
+                       "head_dim": 128, "page_size": 32,
+                       "max_pages": 1024, "pool_pages": 16384,
+                       "table_block": 128, "dtype": "int8"}
+
+
+def capture_longctx_decode(two_level: bool) -> ProgramArtifacts:
+    """Capture the longctx_decode program — ``two_level=True`` is the
+    zoo entry (L1 directory + L2 block walk, block-gathered scale
+    blocks); ``two_level=False`` is the known-bad arm: the SAME
+    windowed int8 decode through the flat-table contract, whose
+    scalar operands are pool-sized.  Both artifacts carry the zoo
+    entry's name so they gate against the same banked baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.paged_attention import (
+        TwoLevelTables, paged_decode_attention)
+
+    g = LONGCTX_DECODE_GEOM
+    B, Hq, Hkv, D = g["batch"], g["heads"], g["kv_heads"], g["head_dim"]
+    ps, maxp, P, bs = (g["page_size"], g["max_pages"], g["pool_pages"],
+                       g["table_block"])
+    q = jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32)
+    kp = jax.ShapeDtypeStruct((Hkv, P, ps, D), jnp.int8)
+    ln = jax.ShapeDtypeStruct((B,), jnp.int32)
+    sc = jax.ShapeDtypeStruct((P,), jnp.float32)
+    if two_level:
+        n_l1 = maxp // bs
+        n_blocks = B * n_l1 + 1  # + the shared all-padding block
+        l1 = jax.ShapeDtypeStruct((B, n_l1), jnp.int32)
+        blk = jax.ShapeDtypeStruct((n_blocks, bs), jnp.int32)
+        return capture_fn(
+            lambda q, k, v, l1, l2, st, l, w, s, ks, vs:
+                paged_decode_attention(
+                    q, k, v, TwoLevelTables(l1, l2, st, bs), l,
+                    impl="pallas", windows=w, sinks=s,
+                    k_scales=ks, v_scales=vs),
+            q, kp, kp, l1, blk, blk, ln, ln, ln, sc, sc,
+            name="longctx_decode")
+    tb = jax.ShapeDtypeStruct((B, maxp), jnp.int32)
+    return capture_fn(
+        lambda q, k, v, t, st, l, w, s, ks, vs: paged_decode_attention(
+            q, k, v, t, l, impl="pallas", page_starts=st,
+            windows=w, sinks=s, k_scales=ks, v_scales=vs),
+        q, kp, kp, tb, tb, ln, ln, ln, sc, sc,
+        name="longctx_decode")
+
+
+def longctx_decode_stream_bytes() -> float:
+    """The analytic page-stream correction for longctx_decode — the
+    int8 page walk over the full table width (each walked page also
+    reads its two fp32 scales; ``attention_bytes_per_step`` charges
+    them under ``dtype=int8``).  Identical for both table contracts:
+    the two-level view changes what SMEM holds, never what HBM
+    streams."""
+    import jax.numpy as jnp
+
+    from ..kernels.paged_attention import attention_bytes_per_step
+
+    g = LONGCTX_DECODE_GEOM
+    return float(attention_bytes_per_step(
+        "pallas", g["batch"], g["max_pages"], g["page_size"],
+        g["heads"], g["head_dim"], num_kv_heads=g["kv_heads"],
+        dtype=jnp.int8))
+
+
+def _build_longctx_decode() -> Tuple[ProgramArtifacts, float, Dict]:
+    art = capture_longctx_decode(two_level=True)
+    cfg = dict(LONGCTX_DECODE_GEOM, impl="pallas")
+    return art, longctx_decode_stream_bytes(), cfg
+
+
 def _build_prefix_decode() -> Tuple[ProgramArtifacts, float, Dict]:
     import jax
     import jax.numpy as jnp
@@ -558,6 +652,7 @@ ZOO = {
     "spec_verify": _build_spec_verify,
     "spec_verify_spmd": _build_spec_verify_spmd,
     "lora_decode": _build_lora_decode,
+    "longctx_decode": _build_longctx_decode,
     "prefix_decode": _build_prefix_decode,
     "sharded_decode": _build_sharded_decode,
 }

@@ -143,6 +143,16 @@ _DEFS: Dict[str, Any] = {
     # (analysis.pallas.V5E_VMEM_BYTES); lower it to lint with headroom
     # for compiler spills, raise it only for a different chip
     "FLAGS_analysis_vmem_budget": 16 * 1024 * 1024,
+    # chip-less linter (paddle_tpu/analysis/pallas.py): the scalar-
+    # memory budget the smem-overflow detector prices every
+    # pallas_call's scalar-prefetch operands + SMEM scratch against.
+    # SMEM is where the paged-attention page tables and per-page int8
+    # scales live — at 128k contexts (~1k pages/seq) FLAT tables and
+    # pool-sized scale rows blow through it, the failure the two-level
+    # table view (kernels/paged_attention.TwoLevelTables) exists to
+    # avoid.  Default: the modeled 128 KiB/core envelope
+    # (analysis.pallas.V5E_SMEM_BYTES)
+    "FLAGS_analysis_smem_budget": 128 * 1024,
     # chunked prefill (serving/generate.py): cap on PREFILL tokens one
     # engine step may process across the batch.  0 (default) is
     # uncapped — whole prompts prefill in one pass.  With a cap, long

@@ -108,10 +108,39 @@ pure reshapes, so no relayout copy brackets the custom call — padded
 to a whole sublane, per-row online-softmax state, sliced off
 host-side.  ``Sq == 1`` keeps the exact pre-ISSUE-13 kernel (no
 q_lengths operand), so the banked zoo entries are byte-identical.
+
+LONG CONTEXT (ISSUE 20).  Past ~8k tokens the SCALAR operands start to
+hurt: a 128k sequence is ~1k pages, so the flat [B, max_pages] table is
+kilobytes of SMEM per call and an int8 pool adds two POOL-sized [P]
+fp32 scale rows on top.  Two extensions keep the envelope flat:
+
+- **Two-level page tables** (:class:`TwoLevelTables`): the prefetch
+  operand becomes a compact L1 directory [B, n_l1] over shared L2
+  table blocks [n_blocks, bs] — the kernel's index map does the nested
+  SMEM read ``l2[l1[b, p//bs], p%bs]`` — plus a parallel [n_blocks,
+  bs] block of absolute page START positions.  int8 scales ride as
+  [n_blocks, bs] blocks gathered through ``l2`` outside the kernel, so
+  SMEM grows with the blocks the batch actually WALKS, never with pool
+  size.  Explicit starts (``PAD_START`` sentinel in padding slots) are
+  what let an evicted sequence walk a compacted table: position masking
+  reads the page's true start from SMEM instead of assuming
+  ``p * page_size``.
+
+- **Sliding-window + attention-sink masking** (``windows``/``sinks``,
+  [B] int32 per-request): key page with start ``s_p`` is visible to the
+  query at absolute position ``p`` iff ``s_p < sinks[b]`` (an
+  attention-sink page) or ``s_p + page_size > p + 1 - windows[b]``
+  (page overlaps the recent window) — PAGE-granular, exactly the rule
+  serving/kvcache.py uses to DROP interior pages, so the kernel mask
+  and the pool's eviction are the same contract and the walk shrinks
+  to sinks + window regardless of context length.  Non-windowed rows
+  pass ``windows = PAD_START`` (everything visible).  All of it is
+  opt-in: absent operands keep the banked entries byte-identical.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 import math
@@ -123,6 +152,8 @@ from .flash_attention import NEG_INF, _on_tpu, flash_attention
 
 __all__ = [
     "GroupedHeadsError",
+    "PAD_START",
+    "TwoLevelTables",
     "attention_bytes_per_step",
     "fallback_count",
     "gather_kv_pages",
@@ -133,6 +164,61 @@ __all__ = [
 ]
 
 _IMPLS = ("auto", "reference", "pallas", "interpret")
+
+# sentinel start position for padding slots of an explicit-starts
+# operand (two-level L2 blocks, or a flat page_starts row past the
+# sequence's live pages): far past any real length, so the position
+# mask hides the dummy page-0 DMA exactly like the zero-padded flat
+# table tail
+PAD_START = 0x3FFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoLevelTables:
+    """Two-level page-table view for long contexts (ISSUE 20).
+
+    A flat [B, max_pages] table prefetches B*max_pages SMEM words per
+    call and an int8 pool adds two POOL-sized [P] fp32 scale rows — at
+    128k (~1k pages/seq) the scalar operands themselves strain SMEM.
+    This view prefetches a compact L1 directory over shared L2 table
+    BLOCKS instead, so SMEM grows with the blocks the batch walks:
+
+    - ``l1`` [B, n_l1] int32: entry j of row b names the L2 block
+      holding that sequence's table entries [j*bs, (j+1)*bs)
+    - ``l2`` [n_blocks, bs] int32: page ids (dummy page 0 in padding
+      slots — fully masked by position)
+    - ``starts`` [n_blocks, bs] int32: absolute token position of each
+      walked page's slot 0 (:data:`PAD_START` in padding slots).
+      Explicit starts — not ``p * page_size`` — are what let an
+      EVICTED sequence walk a compacted table: live pages keep their
+      true positions for the mask.
+    - ``block_size``: bs, the L2 block width.
+
+    The kernel grid walks ``n_l1 * bs`` page slots; its index maps do
+    the nested SMEM read ``l2[l1[b, p // bs], p % bs]``.  Per-page int8
+    scales ride as [n_blocks, bs] blocks gathered through ``l2``
+    OUTSIDE the kernel (``scales[l2]``) — block-sized SMEM, never
+    pool-sized.  serving/kvcache.py builds the view host-side
+    (``KVCachePool.two_level_tables``)."""
+
+    l1: object
+    l2: object
+    starts: object
+    block_size: int
+
+    @property
+    def max_pages(self) -> int:
+        return self.l1.shape[1] * self.block_size
+
+    def flatten(self):
+        """(tables [B, max_pages], starts [B, max_pages]) flat views —
+        what the reference gather arm consumes."""
+        l1 = jnp.asarray(self.l1, jnp.int32)
+        l2 = jnp.asarray(self.l2, jnp.int32)
+        st = jnp.asarray(self.starts, jnp.int32)
+        b, n_l1 = l1.shape
+        return (l2[l1].reshape(b, n_l1 * self.block_size),
+                st[l1].reshape(b, n_l1 * self.block_size))
 
 # the query block is one fp32 sublane: a query group of G <= 8 heads
 # (G = 1 without GQA) occupies rows 0..G-1, the rest are zero padding
@@ -346,7 +432,8 @@ def attention_bytes_per_step(impl: str, batch: int, max_pages: int,
 
 
 def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
-                  quantized, sq, group, slot_major):
+                  quantized, sq, group, slot_major, block_size=0,
+                  has_starts=False, windowed=False):
     """Grid (B, H_kv, max_pages); pages innermost so the online-softmax
     state for one (sequence, KV head) lives in VMEM scratch across the
     page walk.  tables_ref/lengths_ref are SMEM scalar-prefetch refs:
@@ -366,10 +453,35 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
     exactly like the ragged tail.  Page table rows are zero-padded — the dummy
     page-0 reads those DMAs issue are fully masked by position >=
     length, exactly the flash fully-masked-block contract (m floor
-    NEG_INF/2, p underflows to 0, l stays 0)."""
+    NEG_INF/2, p underflows to 0, l stays 0).
+
+    LONG-CONTEXT OPERANDS (ISSUE 20), all opt-in: with ``block_size``
+    the table operand is the two-level L1 directory and two more SMEM
+    operands follow — the L2 page blocks and their per-page absolute
+    START positions (the index map already resolved the page DMA; the
+    body re-reads l1/l2 only for the start and the block-indexed
+    scales).  ``has_starts`` is the flat counterpart (one [B,
+    max_pages] starts operand).  Either way ``pos`` comes from the
+    prefetched start instead of ``p * page_size`` — the compacted
+    table of an evicted sequence masks by TRUE position.  ``windowed``
+    adds per-request [B] ``windows``/``sinks`` operands and the
+    page-granular visibility rule ``start < sinks or start + page_size
+    > q_pos + 1 - window`` on top of the causal/ragged mask — the same
+    rule serving/kvcache.py evicts by, so mask and eviction agree."""
     import jax.experimental.pallas as pl
 
     refs = list(refs)
+    if block_size:
+        l2_ref = refs.pop(0)
+        starts_ref = refs.pop(0)
+    elif has_starts:
+        l2_ref = None
+        starts_ref = refs.pop(0)
+    else:
+        l2_ref = starts_ref = None
+    if windowed:
+        win_ref = refs.pop(0)
+        sink_ref = refs.pop(0)
     q_lens_ref = refs.pop(0) if sq > 1 else None
     if quantized:
         k_scales_ref, v_scales_ref, q_ref, k_ref, v_ref, o_ref, \
@@ -397,12 +509,26 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
     else:
         k = k_ref[0, 0]  # [page_size, D]
         v = v_ref[0, 0]
+    if block_size:
+        blk = tables_ref[b, p // block_size]
+        slot = p % block_size
+        start = starts_ref[blk, slot]
+    elif has_starts:
+        start = starts_ref[b, p]
+    else:
+        start = p * page_size
     if quantized:
-        page = tables_ref[b, p]
-        k = k.astype(jnp.float32) * k_scales_ref[page]
-        v = v.astype(jnp.float32) * v_scales_ref[page]
+        if block_size:
+            # block-indexed scales: the [n_blocks, bs] gather already
+            # aligned scale slots with l2 slots, so (blk, slot) is it
+            k = k.astype(jnp.float32) * k_scales_ref[blk, slot]
+            v = v.astype(jnp.float32) * v_scales_ref[blk, slot]
+        else:
+            page = tables_ref[b, p]
+            k = k.astype(jnp.float32) * k_scales_ref[page]
+            v = v.astype(jnp.float32) * v_scales_ref[page]
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if sq > 1:
         # per-row causal frontier: rows are GROUP-MAJOR (row g*sq + t
         # is group member g, draft token t — the layout that makes the
@@ -412,10 +538,20 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
         # < lengths term still hides the table tail
         t_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % sq
         q_start = lengths_ref[b] - q_lens_ref[b]
-        s = jnp.where((pos <= q_start + t_row) & (pos < lengths_ref[b]),
-                      s, NEG_INF)
+        q_pos = q_start + t_row
+        visible = (pos <= q_pos) & (pos < lengths_ref[b])
     else:
-        s = jnp.where(pos < lengths_ref[b], s, NEG_INF)
+        q_pos = lengths_ref[b] - 1
+        visible = pos < lengths_ref[b]
+    if windowed:
+        # page-granular window + sink rule, per request: a sink page
+        # (start < sinks[b]) or a page overlapping the recent window
+        # stays visible; everything else masks — kvcache eviction drops
+        # exactly the pages this term hides for ALL future q_pos
+        visible = visible & (
+            (start < sink_ref[b])
+            | (start + page_size > q_pos + 1 - win_ref[b]))
+    s = jnp.where(visible, s, NEG_INF)
 
     m_prev = m_scr[:]  # [G_pad, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -435,7 +571,8 @@ def _paged_kernel(tables_ref, lengths_ref, *refs, scale, page_size,
 @functools.lru_cache(maxsize=128)
 def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
                 scale, kv_dtype, interpret, quantized, sq, group,
-                slot_major=False):
+                slot_major=False, block_size=0, has_starts=False,
+                windowed=False):
     """Memoized pallas_call — one traced callable per static config, so
     every decode layer/step of a model reuses ONE kernel payload (the
     flash_attention._fwd_call compile-cache contract).  ``sq`` is the
@@ -454,14 +591,30 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
     # unquantized pool computes/outputs in its own dtype as before
     out_dt = jnp.float32 if quantized else dt
     multi = sq > 1
-    n_prefetch = 2 + (1 if multi else 0) + (2 if quantized else 0)
+    n_prefetch = (2 + (2 if block_size else (1 if has_starts else 0))
+                  + (2 if windowed else 0) + (1 if multi else 0)
+                  + (2 if quantized else 0))
     # index maps see every scalar-prefetch operand after the grid ids;
-    # only tables/lengths matter to them — swallow the rest
+    # only the table operands matter to them — swallow the rest
     if n_prefetch == 2:
         pad = lambda f: f
     else:
         pad = lambda f: (lambda b, h, p, t, l, *rest: f(b, h, p, t, l))
-    if slot_major:
+    if block_size:
+        # two-level walk: the L1 directory names the L2 block, the L2
+        # slot names the pool page — two nested SMEM reads per step
+        bs = block_size
+        if slot_major:
+            kv_spec = pl.BlockSpec(
+                (1, page_size, head_dim),
+                lambda b, h, p, l1, lengths, l2, *rest: (
+                    l2[l1[b, p // bs], p % bs], 0, h))
+        else:
+            kv_spec = pl.BlockSpec(
+                (1, 1, page_size, head_dim),
+                lambda b, h, p, l1, lengths, l2, *rest: (
+                    h, l2[l1[b, p // bs], p % bs], 0, 0))
+    elif slot_major:
         kv_spec = pl.BlockSpec(
             (1, page_size, head_dim),
             pad(lambda b, h, p, tables, lengths: (tables[b, p], 0, h)))
@@ -492,7 +645,8 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, page_size=page_size,
                           quantized=quantized, sq=sq, group=group,
-                          slot_major=slot_major),
+                          slot_major=slot_major, block_size=block_size,
+                          has_starts=has_starts, windowed=windowed),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             (batch, kv_heads, rows_pad, head_dim), out_dt),
@@ -502,14 +656,29 @@ def _paged_call(batch, kv_heads, rows_pad, max_pages, page_size, head_dim,
 
 def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
                   interpret=False, k_scales=None, v_scales=None,
-                  q_lengths=None, slot_major=False):
+                  q_lengths=None, slot_major=False, page_starts=None,
+                  windows=None, sinks=None):
     B, Hq, Sq, D = q.shape
     Hkv, P, page_size, _ = k_pages.shape
     G = Hq // Hkv
     rows = Sq * G
     rows_pad = -(-rows // _SQ_PAD) * _SQ_PAD
     quantized = k_scales is not None
-    tables = jnp.asarray(page_tables, jnp.int32)
+    two = isinstance(page_tables, TwoLevelTables)
+    if two:
+        tl = page_tables
+        tables = jnp.asarray(tl.l1, jnp.int32)
+        l2 = jnp.asarray(tl.l2, jnp.int32)
+        starts = jnp.asarray(tl.starts, jnp.int32)
+        block_size = int(tl.block_size)
+        max_pages = tables.shape[1] * block_size
+        has_starts = False
+    else:
+        tables = jnp.asarray(page_tables, jnp.int32)
+        block_size = 0
+        max_pages = tables.shape[1]
+        has_starts = page_starts is not None
+    windowed = windows is not None
     lengths = jnp.asarray(lengths, jnp.int32)
     if Sq > 1:
         # fold (group member, token) onto the KV head GROUP-MAJOR: row
@@ -535,17 +704,34 @@ def _pallas_paged(q, k_pages, v_pages, page_tables, lengths, scale,
                                                         Hkv * D)
         v_pages = v_pages.transpose(1, 2, 0, 3).reshape(P, page_size,
                                                         Hkv * D)
-    call = _paged_call(B, Hkv, rows_pad, tables.shape[1], page_size, D,
+    call = _paged_call(B, Hkv, rows_pad, max_pages, page_size, D,
                        float(scale), str(k_pages.dtype), interpret,
-                       quantized, Sq, G, slot_major=slot_major)
+                       quantized, Sq, G, slot_major=slot_major,
+                       block_size=block_size, has_starts=has_starts,
+                       windowed=windowed)
     args = [tables, lengths]
+    if two:
+        args += [l2, starts]
+    elif has_starts:
+        args.append(jnp.asarray(page_starts, jnp.int32))
+    if windowed:
+        args.append(jnp.asarray(windows, jnp.int32))
+        args.append(jnp.zeros((B,), jnp.int32) if sinks is None
+                    else jnp.asarray(sinks, jnp.int32))
     if Sq > 1:
         ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
               else jnp.asarray(q_lengths, jnp.int32))
         args.append(ql)
     if quantized:
-        args += [jnp.asarray(k_scales, jnp.float32),
-                 jnp.asarray(v_scales, jnp.float32)]
+        ksc = jnp.asarray(k_scales, jnp.float32)
+        vsc = jnp.asarray(v_scales, jnp.float32)
+        if two:
+            # per-block scale blocks: gather the pool-sized [P] rows
+            # through the L2 page ids OUTSIDE the kernel, so the SMEM
+            # operands ride the walked blocks — the scale half of the
+            # two-level SMEM win
+            ksc, vsc = ksc[l2], vsc[l2]
+        args += [ksc, vsc]
     out = call(*args, qp, k_pages, v_pages)
     out = out[:, :, :rows, :].reshape(B, Hq, Sq, D)
     return out.astype(q.dtype)
@@ -558,7 +744,8 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
                            scale=None, impl: str | None = None,
                            force: str = "auto", k_scales=None,
                            v_scales=None, q_lengths=None,
-                           pool_layout: str = "head"):
+                           pool_layout: str = "head", page_starts=None,
+                           windows=None, sinks=None):
     """q: [B, H_q, Sq, D] decode queries — Sq=1 for plain decode, Sq =
     1+d for a speculative multi-token verify step (the last committed
     token plus d drafted continuations, ISSUE 13); k_pages/v_pages:
@@ -600,7 +787,22 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
     bitcast and no relayout copy-pair brackets the custom call.  The
     arguments are ALWAYS passed head-major; the view lives entirely in
     the lowering, and the reference/interpret tiers compute identically
-    under either contract (parity-tested)."""
+    under either contract (parity-tested).
+
+    LONG-CONTEXT SURFACES (ISSUE 20).  ``page_tables`` may be a
+    :class:`TwoLevelTables` (compact L1 directory + L2 blocks + starts
+    — SMEM rides walked blocks, not pool pages); a flat table may carry
+    ``page_starts`` ([B, max_pages] int32, :data:`PAD_START`-padded) —
+    the absolute slot-0 position of each table entry, REQUIRED once
+    eviction has compacted a table so the position mask stays true.
+    ``windows``/``sinks`` ([B] int32; sinks needs windows) apply the
+    page-granular sliding-window + attention-sink visibility rule per
+    request: key page start ``s_p`` visible to the query at position
+    ``p`` iff ``s_p < sinks[b]`` or ``s_p + page_size > p + 1 -
+    windows[b]`` — exactly the rule the pool evicts by, so a windowed
+    request computes identically before and after its interior pages
+    are dropped.  Non-windowed rows in a windowed batch pass
+    ``windows[b] = PAD_START``."""
     if q.ndim != 4:
         raise ValueError(f"decode query must be [B, H, Sq, D], got {q.shape}")
     Sq = q.shape[2]
@@ -621,6 +823,15 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
         raise ValueError(
             "an int8 KV pool needs its per-page k_scales/v_scales — "
             "raw int8 content is meaningless without them")
+    two = isinstance(page_tables, TwoLevelTables)
+    if two and page_starts is not None:
+        raise ValueError(
+            "a TwoLevelTables walk carries its own per-block starts — "
+            "page_starts is the flat-table contract")
+    if sinks is not None and windows is None:
+        raise ValueError(
+            "sinks only pin attention-sink pages against a sliding "
+            "window — pass windows with them")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     impl = resolve_paged_impl(impl, k_pages.shape[2], q.shape[3],
@@ -630,19 +841,30 @@ def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths,
                              scale, interpret=(impl == "interpret"),
                              k_scales=k_scales, v_scales=v_scales,
                              q_lengths=q_lengths,
-                             slot_major=(pool_layout == "xla"))
+                             slot_major=(pool_layout == "xla"),
+                             page_starts=page_starts, windows=windows,
+                             sinks=sinks)
+    if two:
+        tables_flat, starts_flat = page_tables.flatten()
+    else:
+        tables_flat = page_tables
+        starts_flat = (None if page_starts is None
+                       else jnp.asarray(page_starts, jnp.int32))
     # dequantized pools gather straight to fp32; bf16/fp32 pools pass
     # through at the POOL dtype (no widening copy — the byte model
     # prices the copy terms at the pool itemsize)
-    k = gather_kv_pages(k_pages, page_tables, scales=k_scales)
-    v = gather_kv_pages(v_pages, page_tables, scales=v_scales)
+    k = gather_kv_pages(k_pages, tables_flat, scales=k_scales)
+    v = gather_kv_pages(v_pages, tables_flat, scales=v_scales)
     # the reference arm materializes the group broadcast the pallas
     # kernel never pays for (attention_bytes_per_step charges it)
     k, v = repeat_kv(k, v, G)
-    if Sq == 1:
-        return flash_attention(q, k, v, causal=False, scale=scale,
-                               k_lengths=lengths, force=force)
-    return _reference_verify(q, k, v, lengths, q_lengths, scale)
+    if starts_flat is None and windows is None:
+        if Sq == 1:
+            return flash_attention(q, k, v, causal=False, scale=scale,
+                                   k_lengths=lengths, force=force)
+        return _reference_verify(q, k, v, lengths, q_lengths, scale)
+    return _reference_windowed(q, k, v, lengths, q_lengths, starts_flat,
+                               windows, sinks, scale, k_pages.shape[2])
 
 
 @functools.lru_cache(maxsize=1)
@@ -677,4 +899,68 @@ def _reference_verify(q, k, v, lengths, q_lengths, scale):
     ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
           else jnp.asarray(q_lengths, jnp.int32))
     out = _verify_jit()(q, k, v, ln, ql, scale=float(scale))
+    return out.astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=1)
+def _windowed_ref_jit():
+    """One jitted body for every explicit-starts / windowed reference
+    arm (Sq >= 1): key positions come from the per-page starts instead
+    of arange(S), and the page-granular window+sink rule joins the
+    causal/ragged mask — the _verify_jit compile-cache contract."""
+    def body(q, k, v, ln, ql, st, win, snk, *, scale, page_size):
+        Sq, S = q.shape[2], k.shape[2]
+        # per-key page start and absolute position, from the [B,
+        # n_pages] starts row (PAD_START pads mask themselves out)
+        pstart = jnp.repeat(st, page_size, axis=1)  # [B, S]
+        kpos = pstart + jnp.tile(
+            jnp.arange(page_size, dtype=jnp.int32), S // page_size)[None]
+        pos_q = (ln - ql)[:, None] \
+            + jnp.arange(Sq, dtype=jnp.int32)[None, :]
+        kp = kpos[:, None, :]       # [B, 1, S]
+        sp = pstart[:, None, :]
+        pq = pos_q[:, :, None]      # [B, Sq, 1]
+        mask = (kp <= pq) & (kp < ln[:, None, None]) & (
+            (sp < snk[:, None, None])
+            | (sp + page_size > pq + 1 - win[:, None, None]))
+        scores = jnp.einsum("bhtd,bhjd->bhtj", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        scores = jnp.where(mask[:, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhtj,bhjd->bhtd", w, v.astype(jnp.float32))
+
+    return jax.jit(body, static_argnames=("scale", "page_size"))
+
+
+def _reference_windowed(q, k, v, lengths, q_lengths, starts, windows,
+                        sinks, scale, page_size):
+    """Reference arm for the long-context surfaces (ISSUE 20): dense
+    attention over the gathered view where key j's position comes from
+    its page's explicit start (an evicted sequence's compacted table,
+    or a TwoLevelTables flatten) and the page-granular window+sink
+    visibility rule masks on top of the causal frontier — key page
+    start ``s_p`` visible to the query at absolute position ``p`` iff
+    ``s_p < sinks`` or ``s_p + page_size > p + 1 - window``.  ``starts
+    = None`` (windowed but unevicted) falls back to the implicit
+    ``page * page_size`` positions; ``windows = None`` (starts without
+    a window) masks nothing beyond causality via the PAD_START
+    window."""
+    B, _, Sq, _ = q.shape
+    n_pages = k.shape[2] // page_size
+    ln = jnp.asarray(lengths, jnp.int32)
+    ql = (jnp.full((B,), Sq, jnp.int32) if q_lengths is None
+          else jnp.asarray(q_lengths, jnp.int32))
+    if starts is None:
+        st = jnp.broadcast_to(
+            jnp.arange(n_pages, dtype=jnp.int32)[None] * page_size,
+            (B, n_pages))
+    else:
+        st = jnp.asarray(starts, jnp.int32)
+    win = (jnp.full((B,), PAD_START, jnp.int32) if windows is None
+           else jnp.asarray(windows, jnp.int32))
+    snk = (jnp.zeros((B,), jnp.int32) if sinks is None
+           else jnp.asarray(sinks, jnp.int32))
+    out = _windowed_ref_jit()(q, k, v, ln, ql, st, win, snk,
+                              scale=float(scale),
+                              page_size=int(page_size))
     return out.astype(q.dtype)

@@ -113,6 +113,7 @@ from ..kernels.flash_attention import (
     flash_attention,
 )
 from ..kernels.paged_attention import (
+    PAD_START,
     attention_bytes_per_step,
     gather_kv_pages,
     paged_decode_attention,
@@ -146,6 +147,7 @@ __all__ = [
     "init_decode_params",
     "full_forward",
     "full_decode",
+    "window_mask",
     "prefill_step",
     "chunk_prefill_step",
     "verify_step",
@@ -248,9 +250,13 @@ def _layernorm(x, g, b, eps: float = 1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * g + b
 
 
-def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
+def full_forward(params: Dict, cfg: DecodeConfig, tokens,
+                 mask=None) -> np.ndarray:
     """Oracle forward: full-sequence causal attention, no cache.
-    tokens [S] int -> logits [S, V]."""
+    tokens [S] int -> logits [S, V].  ``mask`` (optional [S, S] bool,
+    query x key) REPLACES the causal mask — the windowed-decode oracle
+    passes ``window_mask`` so sliding-window + attention-sink parity
+    checks against dense arithmetic, not against another paged path."""
     import jax.numpy as jnp
 
     tokens = np.asarray(tokens, np.int32)
@@ -259,6 +265,8 @@ def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
         raise ValueError(f"sequence length {S} > max_length {cfg.max_length}")
     d, H, Dh = cfg.d_model, cfg.n_head, cfg.head_dim
     Hkv, G = cfg.num_kv_heads, cfg.group_size
+    if mask is not None:
+        mask = jnp.asarray(np.asarray(mask, bool))[None, None]  # [1,1,S,S]
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[:S]
     for lp in params["layers"]:
@@ -266,7 +274,16 @@ def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
         k = (h @ lp["wk"]).reshape(S, Hkv, Dh).transpose(1, 0, 2)[None]
         v = (h @ lp["wv"]).reshape(S, Hkv, Dh).transpose(1, 0, 2)[None]
         k, v = repeat_kv(k, v, G)  # GQA: query head h reads KV head h//G
-        attn = _reference_attention(q, k, v, causal=True, scale=Dh ** -0.5)
+        if mask is None:
+            attn = _reference_attention(q, k, v, causal=True,
+                                        scale=Dh ** -0.5)
+        else:
+            import jax
+
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (Dh ** -0.5)
+            scores = jnp.where(mask, scores, NEG_INF)
+            attn = jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(scores, axis=-1), v)
         attn = attn[0].transpose(1, 0, 2).reshape(S, d)
         h = _layernorm(h + attn @ lp["wo"], lp["ln1_g"], lp["ln1_b"])
         ff = jnp.maximum(h @ lp["w1"] + lp["b1"], 0.0) @ lp["w2"] + lp["b2"]
@@ -274,16 +291,55 @@ def full_forward(params: Dict, cfg: DecodeConfig, tokens) -> np.ndarray:
     return np.asarray(h @ jnp.asarray(params["embed"]).T)
 
 
+def window_mask(S: int, prompt_len: int, window: int, sinks: int,
+                page_size: int) -> np.ndarray:
+    """The [S, S] query x key visibility the long-context serving path
+    implements (ISSUE 20) — THE contract shared by the kernel's
+    per-page mask, the pool's eviction rule, and the oracle:
+
+    - prompt queries (position < prompt_len) attend fully causal:
+      window/sinks shape DECODE attention only, so prefill K/V content
+      is identical to the unwindowed model's;
+    - a decode query at position p sees key j iff ``j <= p`` AND j's
+      PAGE is a sink page (``(j // page_size) * page_size < sinks``) or
+      overlaps the trailing window
+      (``page_start + page_size > p + 1 - window``).
+
+    Page-granular on purpose: the paged kernel decides visibility per
+    page start (one scalar compare per DMA'd page), and the pool drops
+    exactly the pages this mask can never light again — which is what
+    makes windowed paged decode token-identical to ``full_decode`` of
+    the same mask rather than merely close."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1 token, got {window}")
+    j = np.arange(S)
+    p = np.arange(S)[:, None]
+    page_start = (j // page_size) * page_size
+    vis = (j[None, :] <= p) & (
+        (p < prompt_len)
+        | (page_start[None, :] < sinks)
+        | (page_start[None, :] + page_size > p + 1 - window))
+    return vis
+
+
 def full_decode(params: Dict, cfg: DecodeConfig, prompt: Sequence[int],
-                max_new_tokens: int) -> Tuple[List[int], List[np.ndarray]]:
+                max_new_tokens: int, window: Optional[int] = None,
+                sinks: int = 0, page_size: int = 1,
+                ) -> Tuple[List[int], List[np.ndarray]]:
     """Greedy per-sequence decode, recomputing the full prefix each token
     (the O(S^2)-per-token baseline the paged path must match).  Returns
-    (generated tokens, the [V] logits row behind each of them)."""
+    (generated tokens, the [V] logits row behind each of them).
+    ``window``/``sinks``/``page_size`` (ISSUE 20) apply the
+    page-granular sliding-window + attention-sink decode mask — the
+    oracle the windowed paged loop must be token-identical to."""
     tokens = [int(t) for t in prompt]
     out: List[int] = []
     rows: List[np.ndarray] = []
     for _ in range(max_new_tokens):
-        row = full_forward(params, cfg, tokens)[-1]
+        mask = (window_mask(len(tokens), len(prompt), window, sinks,
+                            page_size)
+                if window is not None else None)
+        row = full_forward(params, cfg, tokens, mask=mask)[-1]
         nxt = int(row.argmax())
         rows.append(row)
         out.append(nxt)
@@ -327,10 +383,38 @@ def _adapter_slot_array(adapters, adapter_slots):
     return jnp.asarray(np.asarray(adapter_slots, np.int32))
 
 
+def _step_tables(pool: KVCachePool, seq_ids: Sequence[int],
+                 windows, sinks, table_block: Optional[int]):
+    """One step's page-table view + windowing operands (ISSUE 20).
+    Returns ``(tables, lengths, kw)`` where ``tables`` is a flat
+    [B, max_pages] array or a TwoLevelTables and ``kw`` is the extra
+    kwargs dict for ``paged_decode_attention``.  Flat tables ship
+    explicit per-page starts whenever a row is windowed OR any table
+    was evicted (implicit ``i * page_size`` positions stop being true
+    then); a TwoLevelTables always carries its starts."""
+    windowed = windows is not None
+    kw = {}
+    if windowed:
+        kw["windows"] = np.asarray(windows, np.int32)
+        kw["sinks"] = (np.asarray(sinks, np.int32)
+                       if sinks is not None
+                       else np.zeros(len(seq_ids), np.int32))
+    if table_block:
+        tables, lengths = pool.two_level_tables(seq_ids, table_block)
+    elif windowed:
+        tables, starts, lengths = pool.page_tables_with_starts(seq_ids)
+        kw["page_starts"] = starts
+    else:
+        tables, lengths = pool.page_table_batch(seq_ids)
+    return tables, lengths, kw
+
+
 def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                 seq_ids: Sequence[int], tokens, positions,
                 force: str = "auto", impl: Optional[str] = None,
-                adapters=None, adapter_slots=None) -> np.ndarray:
+                adapters=None, adapter_slots=None,
+                windows=None, sinks=None,
+                table_block: Optional[int] = None) -> np.ndarray:
     """One continuous-batching step: feed token[i] at position[i] for
     every active sequence, append its K/V to the pool, and return the
     next-token logits [B, V].  All sequences share the batch regardless
@@ -339,7 +423,10 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     FLAGS_serving_paged_impl).  ``adapters``/``adapter_slots`` (an
     AdapterPool's ``device_arrays()`` + row i's slot index) apply each
     row's low-rank tenant deltas per projection — None is the base
-    model, unchanged."""
+    model, unchanged.  ``windows``/``sinks`` ([B] int arrays; a
+    non-windowed row passes ``PAD_START``/0) apply the per-row
+    sliding-window + attention-sink decode mask; ``table_block`` routes
+    the page tables through the two-level SMEM layout (ISSUE 20)."""
     import jax.numpy as jnp
 
     tokens = np.asarray(tokens, np.int32)
@@ -351,7 +438,8 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     h = jnp.asarray(params["embed"])[tokens] * np.sqrt(d) \
         + jnp.asarray(params["pos"])[positions]
     pages, slots = pool.append_token(seq_ids)
-    tables, lengths = pool.page_table_batch(seq_ids)
+    tables, lengths, wkw = _step_tables(pool, seq_ids, windows, sinks,
+                                        table_block)
     for li, lp in enumerate(params["layers"]):
         q = _apply_adapters(h @ lp["wq"], h, "wq", li, adapters,
                             aslots).reshape(B, H, Dh)
@@ -364,7 +452,7 @@ def decode_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         attn = paged_decode_attention(
             q[:, :, None, :], pool.k_pages[li], pool.v_pages[li],
             tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
-            k_scales=k_scales, v_scales=v_scales,
+            k_scales=k_scales, v_scales=v_scales, **wkw,
         )  # [B, H, 1, Dh]
         attn = attn[:, :, 0, :].reshape(B, d)
         h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
@@ -490,6 +578,15 @@ def chunk_prefill_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     tokens = np.zeros((B, Cmax), np.int32)
     for i, c in enumerate(chunks):
         tokens[i, :lens[i]] = c
+    for s in seq_ids:
+        if getattr(pool._tables[s], "starts", None) is not None:
+            # the gather below places key j at implicit position j —
+            # an evicted (compacted) table's pages no longer sit there,
+            # so the mask would light the wrong keys silently
+            raise ValueError(
+                f"sequence {s} is window-evicted — chunk prefill over "
+                "a compacted page table is unsupported (windows shape "
+                "decode only; prefill before evicting)")
     pages, slots = pool.append_tokens(seq_ids, lens)
     tables, _total = pool.page_table_batch(seq_ids)
     b_idx = np.repeat(np.arange(B), lens)
@@ -540,7 +637,9 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
                 start_positions: Sequence[int], force: str = "auto",
                 impl: Optional[str] = None,
                 pad_to: Optional[int] = None,
-                adapters=None, adapter_slots=None) -> np.ndarray:
+                adapters=None, adapter_slots=None,
+                windows=None, sinks=None,
+                table_block: Optional[int] = None) -> np.ndarray:
     """One speculative verify step: sequence i feeds ``blocks[i]`` —
     its last committed token plus d_i drafted continuations — starting
     at absolute position ``start_positions[i]``, appends every fed
@@ -586,15 +685,24 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
     for i, b in enumerate(blocks):
         tokens[i, :lens[i]] = b
     pages, slots = pool.append_tokens(seq_ids, lens)
-    tables, lengths = pool.page_table_batch(seq_ids)
-    if tables.shape[1] % 8:
+    tables, lengths, wkw = _step_tables(pool, seq_ids, windows, sinks,
+                                        table_block)
+    if not table_block and tables.shape[1] % 8:
         # bucket the table width to multiples of 8 pages: decode compile
         # shapes change once per 8 pages of growth instead of every
         # page, so the verify kernels reach steady state quickly (the
         # padded entries are dummy page-0 walks fully masked by
-        # ``lengths`` — the existing zero-padded-table contract)
+        # ``lengths`` — the existing zero-padded-table contract).  A
+        # two-level table buckets at block granularity already, and its
+        # explicit-starts arm pads with PAD_START (the position mask
+        # kills the dummy walks when implicit positions no longer hold)
         padded = -(-tables.shape[1] // 8) * 8
-        tables = np.pad(tables, ((0, 0), (0, padded - tables.shape[1])))
+        grow = padded - tables.shape[1]
+        tables = np.pad(tables, ((0, 0), (0, grow)))
+        if "page_starts" in wkw:
+            wkw["page_starts"] = np.pad(
+                wkw["page_starts"], ((0, 0), (0, grow)),
+                constant_values=PAD_START)
     b_idx = np.repeat(np.arange(B), lens)
     t_idx = np.concatenate([np.arange(n) for n in lens])
     # stable-shape writes: pad the scatter to B*Sqm rows by REPEATING
@@ -629,7 +737,7 @@ def verify_step(params: Dict, cfg: DecodeConfig, pool: KVCachePool,
         attn = paged_decode_attention(
             q.transpose(0, 2, 1, 3), pool.k_pages[li], pool.v_pages[li],
             tables, lengths, scale=Dh ** -0.5, impl=impl, force=force,
-            k_scales=k_scales, v_scales=v_scales, q_lengths=lens,
+            k_scales=k_scales, v_scales=v_scales, q_lengths=lens, **wkw,
         )  # [B, H, Sqm, Dh]
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Sqm, d)
         h = _layernorm(h + _apply_adapters(attn @ lp["wo"], attn, "wo",
@@ -679,6 +787,17 @@ class DecodeRequest:
     # variant's low-rank deltas to just this request's rows.  None
     # (the default) is the base model — the guaranteed zero-cost path
     adapter_id: Optional[str] = None
+    # long-context serving (ISSUE 20): sliding-window decode attention.
+    # A decode query sees the last `window` tokens (page-granular: any
+    # page overlapping the window) plus the first `sinks` tokens' pages
+    # (attention sinks); prefill stays full attention.  The loop evicts
+    # pages the mask can never light again before each decode step, so
+    # a 128k-context sequence's per-step KV traffic and page residency
+    # are bounded by window + sinks, not context length.  None (the
+    # default) is full attention — exactly today's path.  Output is
+    # token-identical to full_decode under the SAME window_mask.
+    window: Optional[int] = None
+    sinks: int = 0
 
 
 @dataclasses.dataclass
@@ -705,7 +824,7 @@ class GeneratedSequence:
 class _Active:
     __slots__ = ("req", "seq_id", "pos", "result", "rt", "matched",
                  "charged", "whole", "chunk_mode", "inserted",
-                 "drafted", "accepted", "aslot")
+                 "drafted", "accepted", "aslot", "spec_source")
 
     def __init__(self, req: DecodeRequest, seq_id: int,
                  result: GeneratedSequence, rt=None):
@@ -722,6 +841,7 @@ class _Active:
         self.drafted = 0   # speculative tokens proposed for this seq
         self.accepted = 0  # ... of which the verifier accepted
         self.aslot = 0     # adapter device slot (0 = base-model identity)
+        self.spec_source = "own"  # n-gram source of the LAST proposal
 
 
 class ContinuousBatchingLoop:
@@ -779,7 +899,9 @@ class ContinuousBatchingLoop:
                  program=None, prefix_cache=None,
                  prefill_chunk: Optional[int] = None,
                  speculate: Optional[int] = None, drafter=None,
-                 session_manager=None, adapter_pool=None):
+                 session_manager=None, adapter_pool=None,
+                 table_block: Optional[int] = None,
+                 prefill_flops: Optional[float] = None):
         if prefill not in ("batched", "token"):
             raise ValueError(
                 f"prefill must be 'batched' or 'token', got {prefill!r}")
@@ -849,6 +971,36 @@ class ContinuousBatchingLoop:
             else _flags._VALUES["FLAGS_serving_prefill_chunk"])
         if self._prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
+        # compute-budgeted chunked prefill (ISSUE 20): bound each chunk
+        # step's ESTIMATED ATTENTION WORK (token·resident-position
+        # units — prefill_sched.plan_chunks) instead of / on top of its
+        # token count, so a 100-token chunk at a 100k-token resident
+        # prefix stops costing 1000x a cold one under the same cap.
+        # None keeps the pure token budget
+        self._prefill_flops = (float(prefill_flops)
+                               if prefill_flops is not None else None)
+        if self._prefill_flops is not None and self._prefill_flops <= 0:
+            raise ValueError("prefill_flops must be > 0 (or None)")
+        if self._prefill_flops is not None and not self._prefill_chunk:
+            # the FLOP budget rides the chunk-step scheduler; without a
+            # token cap, whole-prompt prefill bypasses plan_chunks
+            # entirely and the budget would silently never apply
+            raise ValueError(
+                "prefill_flops needs chunked prefill — also pass a "
+                "nonzero prefill_chunk (it still clamps tokens; the "
+                "FLOP budget binds where it is tighter)")
+        # two-level page tables (ISSUE 20): route decode/verify steps'
+        # scalar-prefetch tables through the [B, ceil(P/block)] L1 +
+        # per-block L2 layout, bounding SMEM by LIVE table blocks.
+        # None keeps flat tables — mandatory for SPMD programs (their
+        # step functions own their table plumbing)
+        self._table_block = int(table_block) if table_block else None
+        if table_block is not None and int(table_block) < 1:
+            raise ValueError("table_block must be >= 1 (or None)")
+        if self._table_block and program is not None:
+            raise ValueError(
+                "table_block is not supported with a custom program — "
+                "the program's decode_step owns its page-table layout")
         # speculative decoding (ISSUE 13/16): d draft tokens per
         # generating sequence per step, verified in one multi-token
         # model step.  None reads FLAGS_serving_speculate; 0 disables.
@@ -912,6 +1064,26 @@ class ContinuousBatchingLoop:
         self.adapter_rejects = 0
         self.adapter_rows = 0
         self.adapter_gather_bytes = 0.0
+        # long-context accounting (ISSUE 20): window/sink eviction
+        # volume, and decode-step wall times taken WHILE chunked
+        # prefill work was still pending — the per-step latency hit a
+        # long prefill inflicts on in-flight sequences, the number the
+        # compute budget exists to bound (serve_bench banks its p99)
+        self.pages_evicted = 0
+        self._decode_durs_during_prefill: List[float] = []
+        # widest page-table walk any decode/verify step paid (max over
+        # steps of the batch's max live-page count) — post-eviction,
+        # so serve_bench can price the analytic decode bytes/step a
+        # windowed long context actually streams
+        self.max_decode_table_pages = 0
+
+    def decode_step_p99_during_prefill_s(self) -> float:
+        """p99 decode-step wall time over steps that ran while chunked
+        prefill was pending (0.0 when no such step ran)."""
+        durs = self._decode_durs_during_prefill
+        if not durs:
+            return 0.0
+        return float(np.percentile(np.asarray(durs), 99))
 
     def acceptance_rate(self) -> float:
         """Accepted / drafted speculative tokens (0.0 before any
@@ -981,6 +1153,21 @@ class ContinuousBatchingLoop:
                 raise ValueError(
                     f"request names adapter {req.adapter_id!r} but the "
                     "loop carries no adapter_pool")
+            if req.window is not None:
+                if req.window < 1:
+                    raise ValueError(
+                        f"window must be >= 1 token, got {req.window}")
+                if self.program is not None:
+                    raise ValueError(
+                        "windowed decode is not supported with a "
+                        "custom program — its step functions own the "
+                        "attention mask")
+            if req.sinks < 0:
+                raise ValueError(f"sinks must be >= 0, got {req.sinks}")
+            if req.sinks and req.window is None:
+                raise ValueError(
+                    "sinks without a window has no meaning — sink "
+                    "pages are the exception to a window's eviction")
             # validate EVERY request (max_length AND whole-pool fit)
             # before any work: a mid-run raise would strand allocated
             # pages and throw away already-finished sequences' results.
@@ -1185,6 +1372,38 @@ class ContinuousBatchingLoop:
             if obs_on:
                 _smetrics.record_adapter_gather_bytes(gb)
             return self.adapter_pool.device_arrays(), asl
+
+        def window_args(group: List[_Active]):
+            """Per-step (windows, sinks) [B] int32 operands — or (None,
+            None), the zero-cost full-attention path, when no row in
+            the group is a GENERATING windowed sequence.  A windowed
+            sequence still prefilling (token arm) rides full attention
+            this step (PAD_START row), exactly the prefill-is-full
+            contract."""
+            if not any(a.req.window is not None
+                       and a.pos >= len(a.result.prompt) for a in group):
+                return None, None
+            win = np.full(len(group), PAD_START, np.int32)
+            snk = np.zeros(len(group), np.int32)
+            for i, a in enumerate(group):
+                if a.req.window is not None \
+                        and a.pos >= len(a.result.prompt):
+                    win[i] = a.req.window
+                    snk[i] = a.req.sinks
+            return win, snk
+
+        def evict_windowed(group: List[_Active]) -> None:
+            """Drop every GENERATING windowed sequence's dead interior
+            pages before the step's appends: a page entirely past the
+            sinks and entirely outside every future query's window can
+            never be read again (window_mask is monotone in the query
+            position), so the paged walk shrinks to sinks + window
+            pages no matter how deep the context runs."""
+            for a in group:
+                w = a.req.window
+                if w is not None and a.pos >= len(a.result.prompt):
+                    self.pages_evicted += self.pool.evict_interior(
+                        a.seq_id, w, a.req.sinks)
 
         try:
             while waiting or active:
@@ -1478,7 +1697,8 @@ class ContinuousBatchingLoop:
                     step_idx = self.steps
                     idx, chunks, starts = _psched.plan_chunks(
                         [a.result.prompt for a in chunkers],
-                        [a.pos for a in chunkers], self._prefill_chunk)
+                        [a.pos for a in chunkers], self._prefill_chunk,
+                        flop_budget=self._prefill_flops)
                     sel = [chunkers[i] for i in idx]
                     ad, asl = adapter_args(sel)
                     logits = chunk_prefill_step(
@@ -1529,6 +1749,7 @@ class ContinuousBatchingLoop:
                                  or id(a) in keep]
                 if not batch:
                     continue
+                evict_windowed(batch)
                 blocks: List[List[int]] = []
                 for a in batch:
                     if a.pos < len(a.result.prompt):
@@ -1560,6 +1781,13 @@ class ContinuousBatchingLoop:
                                     ctx, room, seq_id=a.seq_id)
                         else:
                             proposal = self.drafter.draft(ctx, room)
+                        if len(proposal):
+                            # draft-source attribution (ISSUE 20): who
+                            # proposed THIS block — labels the verify
+                            # outcome so own-vs-corpus acceptance is a
+                            # dashboard ratio
+                            a.spec_source = getattr(
+                                self.drafter, "last_source", "own")
                         blk += list(proposal)[:room]
                     blocks.append(blk)
                 t0 = time.perf_counter()
@@ -1580,6 +1808,7 @@ class ContinuousBatchingLoop:
                                 _flight.default_flight().record(
                                     "draft", seq_id=a.seq_id,
                                     step=step_idx, tokens=len(b) - 1,
+                                    source=a.spec_source,
                                     trace_id=a.result.trace_id)
                     if self.program is not None:
                         logits3 = self.program.verify_step(
@@ -1588,12 +1817,19 @@ class ContinuousBatchingLoop:
                             pad_to=self._speculate + 1)
                     else:
                         ad, asl = adapter_args(batch)
+                        win, snk = window_args(batch)
                         logits3 = verify_step(
                             self.params, self.cfg, self.pool, seq_ids,
                             blocks, [a.pos for a in batch],
                             force=self.force, impl=self.paged_impl,
                             pad_to=self._speculate + 1,
-                            adapters=ad, adapter_slots=asl)
+                            adapters=ad, adapter_slots=asl,
+                            windows=win, sinks=snk,
+                            table_block=self._table_block)
+                        self.max_decode_table_pages = max(
+                            self.max_decode_table_pages,
+                            max(len(self.pool._tables[a.seq_id].pages)
+                                for a in batch))
                     self.steps += 1
                     self.decode_steps += 1
                     self.spec_steps += 1
@@ -1608,6 +1844,8 @@ class ContinuousBatchingLoop:
                         len(batch) / float(self.max_batch)
                     logits3, ok, now = quarantine(batch, logits3,
                                                   step_idx)
+                    if chunkers:
+                        self._decode_durs_during_prefill.append(now - t0)
                     pairs = []
                     spec_rows: List[Tuple[int, _Active]] = []
                     retired: List[_Active] = []
@@ -1667,11 +1905,13 @@ class ContinuousBatchingLoop:
                             self.rolled_back_tokens += rolled
                         a.pos = new_len
                         if obs_on and drafted:
-                            _smetrics.record_spec(drafted, accepted)
+                            _smetrics.record_spec(drafted, accepted,
+                                                  source=a.spec_source)
                             _flight.default_flight().record(
                                 "verify", seq_id=a.seq_id,
                                 step=step_idx, accepted=accepted,
                                 rejected=drafted - accepted,
+                                source=a.spec_source,
                                 trace_id=a.result.trace_id)
                             if rolled:
                                 _flight.default_flight().record(
@@ -1730,11 +1970,14 @@ class ContinuousBatchingLoop:
                                 self.rolled_back_tokens += rolled
                             a.pos = new_len
                             if obs_on and drafted:
-                                _smetrics.record_spec(drafted, accepted)
+                                _smetrics.record_spec(
+                                    drafted, accepted,
+                                    source=a.spec_source)
                                 _flight.default_flight().record(
                                     "verify", seq_id=a.seq_id,
                                     step=step_idx, accepted=accepted,
                                     rejected=drafted - accepted,
+                                    source=a.spec_source,
                                     trace_id=a.result.trace_id)
                                 if rolled:
                                     _flight.default_flight().record(
@@ -1759,10 +2002,17 @@ class ContinuousBatchingLoop:
                         self.pool, seq_ids, tokens, positions)
                 else:
                     ad, asl = adapter_args(batch)
+                    win, snk = window_args(batch)
                     logits = decode_step(
                         self.params, self.cfg, self.pool, seq_ids, tokens,
                         positions, force=self.force, impl=self.paged_impl,
-                        adapters=ad, adapter_slots=asl)
+                        adapters=ad, adapter_slots=asl,
+                        windows=win, sinks=snk,
+                        table_block=self._table_block)
+                    self.max_decode_table_pages = max(
+                        self.max_decode_table_pages,
+                        max(len(self.pool._tables[a.seq_id].pages)
+                            for a in batch))
                 self.steps += 1
                 self.decode_steps += 1
                 ntok = sum(1 for a in batch
@@ -1773,6 +2023,8 @@ class ContinuousBatchingLoop:
                         self.max_prefill_tokens_step, ntok)
                 self._occupancy_sum += len(batch) / float(self.max_batch)
                 logits, ok, now = quarantine(batch, logits, step_idx)
+                if chunkers:
+                    self._decode_durs_during_prefill.append(now - t0)
 
                 pairs = []
                 for i, a in enumerate(batch):

@@ -87,14 +87,42 @@ class PagePoolExhausted(RuntimeError):
 
 @dataclasses.dataclass
 class SequenceHandle:
-    """Per-sequence page table: ordered page ids + token count."""
+    """Per-sequence page table: ordered page ids + token count.
+
+    ``starts`` (ISSUE 20) is the absolute token position of each
+    page's slot 0.  ``None`` — the common case — means the implicit
+    contiguous layout ``i * page_size``; it becomes explicit the first
+    time sliding-window + sink EVICTION drops interior pages, after
+    which the table is compacted (live pages only) and position
+    masking must read the TRUE starts.  Every start is a multiple of
+    page_size, strictly increasing, and the tail page is never
+    evicted, so append slot math (``length % page_size``) is unchanged
+    either way."""
 
     seq_id: int
     pages: List[int] = dataclasses.field(default_factory=list)
     length: int = 0
+    starts: Optional[List[int]] = None
 
     def capacity(self, page_size: int) -> int:
         return len(self.pages) * page_size
+
+    def page_starts(self, page_size: int) -> List[int]:
+        """Absolute slot-0 positions, explicit or implicit."""
+        if self.starts is not None:
+            return self.starts
+        return [i * page_size for i in range(len(self.pages))]
+
+    def tail_free_slots(self, page_size: int) -> int:
+        """Unclaimed slots in the tail page — the append-side capacity
+        check that stays correct after eviction (capacity() counts
+        RESIDENT pages, which undercounts an evicted sequence's logical
+        extent)."""
+        if not self.pages:
+            return 0
+        last = (self.starts[-1] if self.starts is not None
+                else (len(self.pages) - 1) * page_size)
+        return last + page_size - self.length
 
 
 @dataclasses.dataclass
@@ -128,6 +156,10 @@ class SeqExport:
     # kvtier / fleet handoff verify this at resume/admit so a payload
     # never decodes under a different adapter's weights.
     adapter_id: Optional[str] = None
+    # ISSUE 20: absolute slot-0 positions of the shipped pages when the
+    # source sequence was window/sink EVICTED (compacted table — pages
+    # are no longer contiguous); None for the ordinary contiguous case
+    starts: Optional[List[int]] = None
 
     def nbytes(self) -> int:
         """Payload bytes on the wire — serve_bench banks this per seq."""
@@ -225,6 +257,7 @@ class KVCachePool:
             "orphans_reclaimed": 0, "cow_copies": 0,
             "shared_attach_pages": 0, "tokens_truncated": 0,
             "seqs_exported": 0, "seqs_imported": 0,
+            "pages_evicted": 0,
         }
 
     # -- sizing math (documented in README "Serving") -------------------
@@ -328,7 +361,22 @@ class KVCachePool:
                     f"{h.length}]")
             if n == h.length:
                 return 0
-            keep = self.pages_needed(n, self.page_size)
+            if h.starts is None:
+                keep = self.pages_needed(n, self.page_size)
+            else:
+                # evicted table: keep exactly the pages whose content
+                # starts below the new length.  A rollback only ever
+                # removes just-appended TAIL tokens, so the new length
+                # must still land inside the kept tail page — shrinking
+                # into a dropped interior gap has no page to hold it
+                keep = sum(1 for st in h.starts if st < n)
+                if n and (not keep or n > h.starts[keep - 1]
+                          + self.page_size):
+                    raise ValueError(
+                        f"cannot truncate evicted sequence {seq_id} to "
+                        f"{n} tokens — that position falls in a dropped "
+                        "interior gap")
+                h.starts = h.starts[:keep]
             dropped = h.pages[keep:]
             h.pages = h.pages[:keep]
             self._stats["tokens_truncated"] += h.length - n
@@ -352,6 +400,63 @@ class KVCachePool:
             self._note_pool()
         return len(freed)
 
+    def evict_interior(self, seq_id: int, window: int,
+                       sinks: int = 0) -> int:
+        """Sliding-window + attention-sink eviction (ISSUE 20): drop
+        the pages a windowed decode can never attend again.  A page
+        starting at token ``st`` is dropped iff it is past the sinks
+        (``st >= sinks``) AND entirely outside every FUTURE query's
+        window (``st + page_size <= length - window`` — window >= 1
+        keeps the tail page, and the window's trailing edge only moves
+        forward, so a page invisible now stays invisible).  The kept
+        pages' token positions move into the handle's explicit
+        ``starts`` list; the kernel's per-page start operand and the
+        masked oracle read the SAME rule, which is what makes windowed
+        decode token-identical to full attention under that mask.
+
+        Refcount semantics match truncate_seq exactly: each dropped
+        page RELEASES this sequence's one hold — a page the prefix
+        cache pins or another sequence reads stays live (never freed
+        out from under a reader), and a reader-kept page whose charge
+        this sequence carried becomes uncharged.  Freed pages' int8
+        scales clear with them.  Returns the number of pages dropped
+        from THIS table (freed count lands in stats["page_frees"])."""
+        window = int(window)
+        sinks = int(sinks)
+        if window < 1:
+            raise ValueError(f"window must be >= 1 token, got {window}")
+        if sinks < 0:
+            raise ValueError(f"sinks must be >= 0 tokens, got {sinks}")
+        with self._lock:
+            h = self._tables[seq_id]
+            starts = h.page_starts(self.page_size)
+            keep = [i for i, st in enumerate(starts)
+                    if st < sinks or st + self.page_size > h.length - window]
+            if len(keep) == len(h.pages):
+                return 0
+            dropped = [h.pages[i] for i in range(len(h.pages))
+                       if i not in set(keep)]
+            h.starts = [starts[i] for i in keep]
+            h.pages = [h.pages[i] for i in keep]
+            freed: List[int] = []
+            for p in reversed(dropped):
+                self._ref[p] -= 1
+                if self._ref[p] <= 0:
+                    self._ref[p] = 0
+                    self._free.append(p)
+                    self._allocator.pop(p, None)
+                    freed.append(p)
+                elif self._allocator.get(p) == seq_id:
+                    # a reader (prefix cache, attached sequence) keeps
+                    # the dropped page alive: it is now UNCHARGED
+                    del self._allocator[p]
+            self._clear_scales(freed)
+            self._stats["pages_evicted"] += len(dropped)
+            self._stats["page_frees"] += len(freed)
+        if freed:
+            self._note_pool()
+        return len(dropped)
+
     # -- cross-pool handoff (the disaggregation substrate) --------------
 
     def export_seq(self, seq_id: int, skip_tokens: int = 0,
@@ -369,7 +474,24 @@ class KVCachePool:
         shared prefix from its OWN prefix cache (the caller reserved it
         there first), so only the unshared tail ships.  Works on the
         mesh pool too — indexing the sharded arrays gathers each
-        device's head shard into the full host view."""
+        device's head shard into the full host view.
+
+        The D2H copy is staged OUTSIDE the pool lock (ISSUE 20
+        satellite — the ROADMAP off-lock-spill note): under the lock
+        the pages are pinned (one refcount hold each) and the
+        IMMUTABLE jax array references snapshotted; the copy itself —
+        the milliseconds-long part that used to serialize every
+        concurrent ``append_tokens`` behind a kvtier park — then runs
+        lock-free against the snapshot (functional updates by
+        concurrent writers build NEW arrays, so the snapshot stays
+        consistent), and the pins drop after.  The exported sequence
+        itself must be quiescent (kvtier parks idle sessions; fleet
+        hands off after prefill) — concurrent appends to OTHER
+        sequences are exactly what the staging no longer blocks.
+
+        A window/sink-evicted sequence (compacted table) exports with
+        its page ``starts`` in the payload and requires skip_tokens=0
+        (its leading pages are sinks, not a contiguous prefix)."""
         with self._lock:
             h = self._tables[seq_id]
             skip = int(skip_tokens)
@@ -378,22 +500,47 @@ class KVCachePool:
                     f"skip_tokens {skip} must be a multiple of page_size "
                     f"{self.page_size} in [0, {h.length}) — the shipped "
                     "tail must start on a page boundary with >= 1 token")
-            ship = h.pages[skip // self.page_size:]
+            if h.starts is not None and skip:
+                raise ValueError(
+                    f"sequence {seq_id} is window-evicted — its resident "
+                    "pages are not a contiguous prefix, export it whole "
+                    "(skip_tokens=0)")
+            ship = list(h.pages[skip // self.page_size:])
+            starts = (list(h.starts[skip // self.page_size:])
+                      if h.starts is not None else None)
             idx = np.asarray(ship, np.int32)
-            k = np.asarray(self.k_pages[:, :, idx])
-            v = np.asarray(self.v_pages[:, :, idx])
+            length = h.length
+            # pin the shipped pages, snapshot the immutable arrays
+            for p in ship:
+                self._ref[p] += 1
+            k_src, v_src = self.k_pages, self.v_pages
             ks = vs = None
             if self.quantized:
                 ks = self.k_scales[:, idx].copy()
                 vs = self.v_scales[:, idx].copy()
+        try:
+            k, v = self._stage_d2h(k_src, v_src, idx)
+        finally:
+            self.release_pages(ship)
+        with self._lock:
             self._stats["seqs_exported"] += 1
-            return SeqExport(
-                seq_id=seq_id, length=h.length, skip_tokens=skip,
-                k=k, v=v, k_scales=ks, v_scales=vs,
-                page_size=self.page_size, num_layers=self.num_layers,
-                num_kv_heads=self.num_kv_heads, head_dim=self.head_dim,
-                dtype=np.dtype(self.k_pages.dtype).name, pool=self.name,
-                adapter_id=adapter_id)
+        return SeqExport(
+            seq_id=seq_id, length=length, skip_tokens=skip,
+            k=k, v=v, k_scales=ks, v_scales=vs,
+            page_size=self.page_size, num_layers=self.num_layers,
+            num_kv_heads=self.num_kv_heads, head_dim=self.head_dim,
+            dtype=np.dtype(self.k_pages.dtype).name, pool=self.name,
+            adapter_id=adapter_id, starts=starts)
+
+    def _stage_d2h(self, k_src, v_src, idx: np.ndarray):
+        """The export's device→host staging, OUTSIDE the pool lock and
+        double-buffered: both device-side page gathers dispatch first
+        (jax async dispatch — the second gather runs while the first
+        drains to the host), each landing in its own host buffer.
+        Split out so tests can instrument the off-lock window."""
+        k_dev = k_src[:, :, idx]
+        v_dev = v_src[:, :, idx]
+        return np.asarray(k_dev), np.asarray(v_dev)
 
     def import_seq(self, export: SeqExport,
                    seq_id: int) -> Tuple[int, int]:
@@ -431,15 +578,55 @@ class KVCachePool:
                 raise ValueError(
                     "the re-attached prefix must be FULL pages — the "
                     "shipped tail starts on a page boundary")
-            tail = export.length - export.skip_tokens
-            want = self.pages_needed(tail, self.page_size)
-            if export.k.shape[2] != want:
-                raise ValueError(
-                    f"payload ships {export.k.shape[2]} pages but "
-                    f"{tail} tokens need {want}")
-            before = len(h.pages)
-            self.append_tokens([seq_id], [tail])  # atomic claim
-            new = h.pages[before:]
+            if export.starts is not None:
+                # window-evicted payload: the shipped pages are NOT a
+                # contiguous run, so the claim is manual (same atomic
+                # shape as append_tokens: reclaimers, then exhaustion
+                # check, then table mutation) and the start positions
+                # travel with the table
+                if export.skip_tokens or h.pages:
+                    raise ValueError(
+                        "an evicted payload imports whole into an empty "
+                        "sequence — its pages are not a prefix to skip "
+                        "into")
+                tail = export.length
+                want = export.k.shape[2]
+                if len(export.starts) != want:
+                    raise ValueError(
+                        f"payload ships {want} pages but "
+                        f"{len(export.starts)} start positions")
+                if want > len(self._free):
+                    for cb in self._reclaim_hooks:
+                        if want <= len(self._free):
+                            break
+                        cb(want - len(self._free))
+                if want > len(self._free):
+                    raise PagePoolExhausted(
+                        f"pool '{self.name}': need {want} fresh pages "
+                        f"to import sequence {seq_id} but only "
+                        f"{len(self._free)} free of {self.num_pages}")
+                new = [self._free.pop() for _ in range(want)]
+                for p in new:
+                    self._ref[p] = 1
+                    self._allocator[p] = h.seq_id
+                h.pages = list(new)
+                h.starts = list(export.starts)
+                h.length = export.length
+                self._stats["page_allocs"] += want
+                self._stats["token_appends"] += tail
+                used = self.num_pages - len(self._free)
+                if used > self._stats["used_pages_high_water"]:
+                    self._stats["used_pages_high_water"] = used
+            else:
+                tail = export.length - export.skip_tokens
+                want = self.pages_needed(tail, self.page_size)
+                if export.k.shape[2] != want:
+                    raise ValueError(
+                        f"payload ships {export.k.shape[2]} pages but "
+                        f"{tail} tokens need {want}")
+                before = len(h.pages)
+                self.append_tokens([seq_id], [tail])  # atomic claim
+                new = h.pages[before:]
             idx = np.asarray(new, np.int32)
             self.k_pages = self.k_pages.at[:, :, idx].set(
                 jnp.asarray(export.k))
@@ -613,7 +800,7 @@ class KVCachePool:
             need = 0
             for s, c in zip(seq_ids, counts):
                 h = self._tables[s]
-                free_slots = h.capacity(self.page_size) - h.length
+                free_slots = h.tail_free_slots(self.page_size)
                 if c > 0 and free_slots and self._ref[h.pages[-1]] > 1:
                     # shared partially-filled tail: the divergent append
                     # will copy-on-write it onto a fresh page
@@ -637,15 +824,20 @@ class KVCachePool:
             i = 0
             for s, c in zip(seq_ids, counts):
                 h = self._tables[s]
-                if (c > 0 and h.length < h.capacity(self.page_size)
+                if (c > 0 and h.tail_free_slots(self.page_size)
                         and self._ref[h.pages[-1]] > 1):
                     self._cow_tail(h)
                 for _ in range(c):
-                    if h.length == h.capacity(self.page_size):
+                    if h.tail_free_slots(self.page_size) == 0:
                         p = self._free.pop()
                         self._ref[p] = 1
                         self._allocator[p] = h.seq_id
                         h.pages.append(p)
+                        if h.starts is not None:
+                            # evicted table: the fresh tail page's
+                            # content starts at the CURRENT length (a
+                            # page multiple — the tail was full)
+                            h.starts.append(h.length)
                         self._stats["page_allocs"] += 1
                     pages[i] = h.pages[-1]
                     slots[i] = h.length % self.page_size
@@ -786,6 +978,68 @@ class KVCachePool:
                 lengths[i] = h.length
         return tables, lengths
 
+    def page_tables_with_starts(self, seq_ids: Sequence[int]):
+        """Batch view for WINDOWED attention (ISSUE 20): like
+        page_table_batch plus a [B, max_pages] int32 array of each
+        page's token start position — PAD_START in the padded tail, so
+        the kernel's position mask (pos >= length) hides pad slots even
+        when an evicted table's real pages no longer sit at implicit
+        i*page_size positions.  Returns (tables, starts, lengths)."""
+        from ..kernels.paged_attention import PAD_START
+
+        with self._lock:
+            handles = [self._tables[s] for s in seq_ids]
+            maxp = max((len(h.pages) for h in handles), default=1) or 1
+            tables = np.zeros((len(handles), maxp), np.int32)
+            starts = np.full((len(handles), maxp), PAD_START, np.int32)
+            lengths = np.empty(len(handles), np.int32)
+            for i, h in enumerate(handles):
+                n = len(h.pages)
+                tables[i, :n] = h.pages
+                starts[i, :n] = h.page_starts(self.page_size)
+                lengths[i] = h.length
+        return tables, starts, lengths
+
+    def two_level_tables(self, seq_ids: Sequence[int], block_size: int):
+        """Batch view as a TWO-LEVEL page table (ISSUE 20 tentpole):
+        the kernel's scalar-prefetch operand becomes a compact [B,
+        ceil(max_pages/block_size)] L1 directory over [n_blocks,
+        block_size] L2 page-id and start-position blocks, so SMEM
+        grows with LIVE table blocks instead of B * max_pages — the
+        difference between a ~1k-page long-context batch fitting the
+        scalar core's memory and not.  Block 0 is the shared pad block
+        (page 0, starts PAD_START); every L1 row pads with it, so a
+        short sequence prices one directory row, not a full-width
+        table row.  Returns (TwoLevelTables, lengths [B])."""
+        from ..kernels.paged_attention import PAD_START, TwoLevelTables
+
+        bs = int(block_size)
+        if bs < 1:
+            raise ValueError(f"block_size must be >= 1, got {bs}")
+        with self._lock:
+            handles = [self._tables[s] for s in seq_ids]
+            maxp = max((len(h.pages) for h in handles), default=1) or 1
+            n_l1 = self.pages_needed(maxp, bs)
+            l2_blocks = [np.zeros(bs, np.int32)]  # shared pad block
+            st_blocks = [np.full(bs, PAD_START, np.int32)]
+            l1 = np.zeros((len(handles), n_l1), np.int32)
+            lengths = np.empty(len(handles), np.int32)
+            for i, h in enumerate(handles):
+                sts = h.page_starts(self.page_size)
+                for j in range(self.pages_needed(len(h.pages), bs)):
+                    chunk = h.pages[j * bs:(j + 1) * bs]
+                    l2b = np.zeros(bs, np.int32)
+                    stb = np.full(bs, PAD_START, np.int32)
+                    l2b[:len(chunk)] = chunk
+                    stb[:len(chunk)] = sts[j * bs:(j + 1) * bs]
+                    l1[i, j] = len(l2_blocks)
+                    l2_blocks.append(l2b)
+                    st_blocks.append(stb)
+                lengths[i] = h.length
+        return TwoLevelTables(
+            l1=l1, l2=np.stack(l2_blocks), starts=np.stack(st_blocks),
+            block_size=bs), lengths
+
     def length(self, seq_id: int) -> int:
         with self._lock:
             return self._tables[seq_id].length
@@ -887,9 +1141,29 @@ class KVCachePool:
                     if p in seen_in_table:
                         double.append(p)
                     seen_in_table.add(p)
-                cap = h.capacity(self.page_size)
-                if h.length > cap or cap - h.length >= self.page_size:
-                    mismatches.append(h.seq_id)
+                if h.starts is None:
+                    cap = h.capacity(self.page_size)
+                    if h.length > cap or cap - h.length >= self.page_size:
+                        mismatches.append(h.seq_id)
+                else:
+                    # window-evicted table: one start per page, each a
+                    # page multiple, strictly increasing, and the TAIL
+                    # page must be the one covering the current length
+                    # (eviction never drops the tail — the window's >= 1
+                    # newest token always lives there)
+                    st = h.starts
+                    ps = self.page_size
+                    if not st:
+                        if h.length or h.pages:
+                            mismatches.append(h.seq_id)
+                    elif not (
+                            len(st) == len(h.pages)
+                            and all(s % ps == 0 for s in st)
+                            and all(a < b for a, b in zip(st, st[1:]))
+                            and st[-1] < h.length <= st[-1] + ps
+                            and st[-1] == (self.pages_needed(
+                                h.length, ps) - 1) * ps):
+                        mismatches.append(h.seq_id)
             free_errors: List[int] = []
             seen_free: set = set()
             for p in self._free:

@@ -749,14 +749,20 @@ class TieredSessionManager:
                     pool.retain_pages(pages)
                     for p in pages:
                         self._pin_holds[p] = self._pin_holds.get(p, 0) + 1
-            try:
-                export = pool.export_seq(seq, skip_tokens=skip,
-                                         adapter_id=s.adapter_id)
-            except BaseException:
+        # export OUTSIDE the tier lock (ISSUE 20): the sequence is
+        # quiescent (the caller CASed it to ``spilling``), its pin
+        # bookkeeping is done, and the pool itself stages the D2H copy
+        # off its own lock — so neither lock serializes concurrent
+        # append_tokens (decode) or session admission behind the copy
+        try:
+            export = pool.export_seq(seq, skip_tokens=skip,
+                                     adapter_id=s.adapter_id)
+        except BaseException:
+            with self._lock:
                 self._release_pins(pages)
                 s.state = "idle"
-                s._spilled_ev.set()
-                raise
+            s._spilled_ev.set()
+            raise
         # park OUTSIDE the pool lock: the CRC pass + host copy must not
         # stall decode (the writer-thread overlap this tier exists for)
         nbytes = export.nbytes()

@@ -26,9 +26,12 @@ Metrics:
                                                       decode attention KV
                                                       path moves per step
 - paddle_tpu_serving_spec_tokens_total      counter  {outcome=accepted|
-                                                      rejected} speculative
+                                                      rejected, source=own|
+                                                      corpus} speculative
                                                       draft tokens by verify
-                                                      outcome (rejected ones
+                                                      outcome and the n-gram
+                                                      source that proposed
+                                                      them (rejected ones
                                                       rolled back from the
                                                       page table)
 - paddle_tpu_serving_spec_disabled_total    counter  {reason=} speculation
@@ -251,20 +254,24 @@ def record_token(seconds: float, impl: str = "reference") -> None:
     ).observe(seconds, impl=impl)
 
 
-def record_spec(drafted: int, accepted: int) -> None:
+def record_spec(drafted: int, accepted: int,
+                source: str = "own") -> None:
     """One sequence's speculative verify outcome: `drafted` proposed
     tokens, `accepted` of them committed (acceptance_rate is the
-    counter ratio; rejected = drafted - accepted rolled back)."""
+    counter ratio; rejected = drafted - accepted rolled back).
+    `source` attributes the proposal to the n-gram source that won it
+    (``own`` history vs the shared ``corpus`` trie — ISSUE 20), so the
+    acceptance split per source is a dashboard ratio, not a guess."""
     default_registry().counter(
         "paddle_tpu_serving_spec_tokens_total",
         "speculative draft tokens by verify outcome",
-    ).inc(accepted, outcome="accepted")
+    ).inc(accepted, outcome="accepted", source=source)
     rejected = drafted - accepted
     if rejected:
         default_registry().counter(
             "paddle_tpu_serving_spec_tokens_total",
             "speculative draft tokens by verify outcome",
-        ).inc(rejected, outcome="rejected")
+        ).inc(rejected, outcome="rejected", source=source)
 
 
 def record_spec_disabled(reason: str) -> None:

@@ -16,7 +16,7 @@ batch vs a `_Job`'s future failing typed).
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -37,25 +37,55 @@ def whole_eligible(matched: int, chunk_cap: int) -> bool:
 
 def plan_chunks(prompts: Sequence[Sequence[int]],
                 positions: Sequence[int], chunk_cap: int,
+                flop_budget: Optional[float] = None,
                 ) -> Tuple[List[int], List[List[int]], List[int]]:
-    """Pack one chunk step's token budget over still-prefilling
-    sequences, FIFO, clamped per sequence.  A zero/None cap means one
-    uncapped step that finishes every prompt.  Returns ``(idx, chunks,
-    starts)`` where ``idx`` indexes into the caller's selection so it
-    can map rows back to its own records."""
-    budget = chunk_cap or sum(
-        len(p) - pos for p, pos in zip(prompts, positions))
+    """Pack one chunk step's budget over still-prefilling sequences,
+    FIFO, clamped per sequence.  A zero/None cap means one uncapped
+    step that finishes every prompt.  Returns ``(idx, chunks, starts)``
+    where ``idx`` indexes into the caller's selection so it can map
+    rows back to its own records.
+
+    ``flop_budget`` (ISSUE 20) switches the budget unit from tokens to
+    ESTIMATED ATTENTION WORK: a chunk of ``n`` tokens starting at
+    resident position ``pos`` attends over roughly ``n * (pos + n/2)``
+    query·key pairs (per head·dim — the d_model factor is constant
+    across candidates, so it cancels).  A token cap charges a 100-token
+    chunk the same whether the sequence holds 100 or 100k resident
+    tokens; at 32k+ contexts that quadratic term is the whole cost, and
+    budgeting by it is what bounds the per-step decode-latency hit of a
+    long prefill.  Per sequence the largest ``n`` with
+    ``n * (pos + n/2) <= remaining budget`` is
+    ``-pos + sqrt(pos^2 + 2*budget)`` (the positive root); the HEAD
+    sequence always gets >= 1 token so deep-context prefill can never
+    starve (the same no-starvation rule as a 1-token token cap).  A
+    nonzero ``chunk_cap`` still clamps tokens on top — the two budgets
+    compose, each binding where it is the tighter one."""
     idx: List[int] = []
     chunks: List[List[int]] = []
     starts: List[int] = []
+    if flop_budget is not None and flop_budget <= 0:
+        raise ValueError(
+            f"flop_budget must be > 0 (or None), got {flop_budget}")
+    budget = chunk_cap or sum(
+        len(p) - pos for p, pos in zip(prompts, positions))
+    flops = float(flop_budget) if flop_budget is not None else None
     for i, (prompt, pos) in enumerate(zip(prompts, positions)):
-        if budget <= 0:
+        if budget <= 0 or (flops is not None and flops <= 0 and idx):
             break
         n = min(len(prompt) - pos, budget)
+        if flops is not None:
+            n_flop = int(-pos + (pos * pos + 2.0 * flops) ** 0.5)
+            if not idx:
+                n_flop = max(n_flop, 1)  # head never starves
+            n = min(n, n_flop)
+        if n <= 0:
+            break
         idx.append(i)
         chunks.append(list(prompt[pos:pos + n]))
         starts.append(pos)
         budget -= n
+        if flops is not None:
+            flops -= n * (pos + n / 2.0)
     return idx, chunks, starts
 
 

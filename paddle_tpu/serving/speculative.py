@@ -125,6 +125,9 @@ class PromptLookupDrafter:
     entering a popular template speculates from step one."""
 
     stateful = True  # the loop may pass seq_id= and call release()
+    # source of the most recent proposal ("own" | "corpus") — set by
+    # every draft() call, read by the loop for verify attribution
+    last_source = "own"
     # the loop may pass adapter_id= to confine corpus drafting to one
     # tenant's namespace (ISSUE 19) — see draft()
     adapter_aware = True
@@ -175,6 +178,11 @@ class PromptLookupDrafter:
         continuations into another's verify slots."""
         limit = self.max_draft if max_draft is None else \
             min(self.max_draft, int(max_draft))
+        # draft-source attribution (ISSUE 20): which n-gram source won
+        # THIS proposal — the loop reads it right after draft() to
+        # label the verify outcome, so an operator can see whether the
+        # corpus trie or own-history is earning the acceptance rate
+        self.last_source = "own"
         if limit < 1:
             return []
         ctx = [int(t) for t in context]
@@ -194,6 +202,7 @@ class PromptLookupDrafter:
         if len(own) < limit and self.corpus is not None:
             corp = self._corpus_draft(ctx, limit, adapter_id)
             if len(corp) > len(own):
+                self.last_source = "corpus"
                 return corp
         return own
 

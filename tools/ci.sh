@@ -31,6 +31,15 @@
 #              on both pools; the teeth arm squeezes 16 tenants
 #              through a one-slot pack (thrash + admission rejects),
 #              which must exit 3
+#   longctx  - long-context smoke (ISSUE 20): a windowed decode replay
+#              (serve_bench --context-len --window --sinks with a
+#              FLOP-budgeted chunked prefill) — the gate banks the
+#              analytic decode bytes/step a sink+window eviction
+#              actually streams plus zero leaks; the teeth arm re-runs
+#              the SAME context with no window (nothing evicts, the
+#              table walk doubles), which must exit 3; a second teeth
+#              arm injects the flat-table SMEM-overflow corpus program
+#              against the two-level zoo bank, which must also exit 3
 #   procfleet - process-level fleet smoke (ISSUE 17): serve_bench
 #              --fleet --procs 2 with FAULT_SERVE_PROC_KILL armed —
 #              a live replica pid is SIGKILLed mid-run and the gate
@@ -214,6 +223,50 @@ JSON
   rm -rf "$tmp"
 }
 
+run_longctx() {
+  echo "== long-context smoke (window+sink eviction, budgeted prefill) =="
+  tmp="$(mktemp -d)"
+  # the banked contract: with a 16-token window + 8 sinks over a
+  # 48-token context the decode step walks ~7 live pages, not 12 —
+  # the analytic bytes/step is the eviction's whole point, so it is
+  # the metric with teeth; nothing leaks and the pool audits green
+  cat > "$tmp/bank.json" <<'JSON'
+{"decode_bytes_per_step": 344064.0, "pages_leaked": 0,
+ "invariants_ok": 1}
+JSON
+  python tools/serve_bench.py --mode decode --sequences 4 \
+    --max-batch 4 --context-len 48 --window 16 --sinks 8 --max-new 8 \
+    --max-len 64 --pages 64 --page-size 4 --prefill-chunk 16 \
+    --prefill-flops 2000 --json "$tmp/longctx.json" \
+    --baseline "$tmp/bank.json" --gate
+  echo "== longctx teeth: same context, no window — walk doubles, must exit 3 =="
+  set +e
+  python tools/serve_bench.py --mode decode --sequences 4 \
+    --max-batch 4 --context-len 48 --max-new 8 \
+    --max-len 64 --pages 64 --page-size 4 --prefill-chunk 16 \
+    --prefill-flops 2000 \
+    --baseline "$tmp/bank.json" --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "longctx teeth: expected exit 3 (gate regression), got $rc"
+    exit 1
+  fi
+  echo "longctx teeth OK (exit 3)"
+  echo "== longctx lint teeth: flat-table SMEM overflow must exit 3 =="
+  set +e
+  python tools/lint_programs.py --programs longctx_decode \
+    --inject longctx_flat_pool --gate >/dev/null
+  rc=$?
+  set -e
+  if [ "$rc" -ne 3 ]; then
+    echo "longctx lint teeth: expected exit 3 (smem overflow), got $rc"
+    exit 1
+  fi
+  echo "longctx lint teeth OK (exit 3)"
+  rm -rf "$tmp"
+}
+
 run_procfleet() {
   echo "== process fleet smoke (SIGKILL a live pid; nothing lost) =="
   tmp="$(mktemp -d)"
@@ -259,9 +312,10 @@ case "$stage" in
   spec)   run_spec ;;
   kvtier) run_kvtier ;;
   tenants) run_tenants ;;
+  longctx) run_longctx ;;
   procfleet) run_procfleet ;;
   bench)  run_bench ;;
-  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_kvtier; run_tenants; run_procfleet; run_bench ;;
-  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|kvtier|tenants|procfleet|bench|all)"; exit 2 ;;
+  all)    run_native; run_api; run_test; run_lint; run_fleet; run_spec; run_kvtier; run_tenants; run_longctx; run_procfleet; run_bench ;;
+  *) echo "unknown stage '$stage' (native|test|api|lint|fleet|spec|kvtier|tenants|longctx|procfleet|bench|all)"; exit 2 ;;
 esac
 echo "CI OK ($stage)"

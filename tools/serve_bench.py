@@ -495,6 +495,12 @@ def _decode_requests(args, cfg, rng, sampling=None) -> list:
 
     plo, phi = (int(p) for p in args.prompt_range.split(","))
     phi = min(phi, args.max_len - args.max_new)
+    if args.context_len:
+        # the long-context replay: every request carries exactly
+        # --context-len resident tokens into decode
+        plo = phi = min(args.context_len, args.max_len - args.max_new)
+    win = int(args.window) or None
+    snk = int(args.sinks) if win else 0
     share = float(args.prefix_share)
     sys_prompt = rng.randint(
         1, cfg.vocab_size,
@@ -518,7 +524,7 @@ def _decode_requests(args, cfg, rng, sampling=None) -> list:
                     1, cfg.vocab_size, size=plen).tolist()
         reqs.append(serving.DecodeRequest(
             prompt=prompt, max_new_tokens=args.max_new,
-            sampling=sampling))
+            sampling=sampling, window=win, sinks=snk))
     return reqs
 
 
@@ -585,6 +591,8 @@ def run_decode_bench(args) -> dict:
             paged_impl=args.paged_impl, prefill=args.prefill,
             program=program, prefix_cache=wcache,
             prefill_chunk=args.prefill_chunk,
+            prefill_flops=args.prefill_flops or None,
+            table_block=args.table_block or None,
             speculate=speculate).run(reqs)
         if wcache is not None:
             wcache.clear()
@@ -598,6 +606,8 @@ def run_decode_bench(args) -> dict:
         paged_impl=args.paged_impl, prefill=args.prefill,
         check_every=1 if chaos else 0, program=program,
         prefix_cache=cache, prefill_chunk=args.prefill_chunk,
+        prefill_flops=args.prefill_flops or None,
+        table_block=args.table_block or None,
         speculate=args.speculate)
     if chaos:
         from paddle_tpu.resilience import faultinject  # noqa: F401
@@ -635,6 +645,8 @@ def run_decode_bench(args) -> dict:
             paged_impl=args.paged_impl, prefill=args.prefill,
             program=program, prefix_cache=cache_d0,
             prefill_chunk=args.prefill_chunk,
+            prefill_flops=args.prefill_flops or None,
+            table_block=args.table_block or None,
             speculate=0)
         t0_d0 = time.perf_counter()
         results_d0 = loop_d0.run(reqs)
@@ -663,6 +675,8 @@ def run_decode_bench(args) -> dict:
                 paged_impl=args.paged_impl, prefill=args.prefill,
                 program=program, prefix_cache=cache_rp,
                 prefill_chunk=args.prefill_chunk,
+                prefill_flops=args.prefill_flops or None,
+                table_block=args.table_block or None,
                 speculate=args.speculate)
             results_rp = loop_rp.run(reqs)
             for a, b in zip(results, results_rp):
@@ -722,6 +736,35 @@ def run_decode_bench(args) -> dict:
         "sampling": args.sampling,
         "tokens_per_step": tokens / loop.steps if loop.steps else 0.0,
     }
+    if args.context_len or args.window or args.prefill_flops \
+            or args.table_block:
+        from paddle_tpu.kernels.paged_attention import (
+            attention_bytes_per_step)
+
+        # the long-context contract (ISSUE 20): decode_bytes_per_step
+        # is the analytic attention stream of the WIDEST page-table
+        # walk any decode step paid — post-eviction, so a windowed
+        # 128k replay banks near its 8k number while the no-window
+        # teeth arm walks the full context and trips the (lower-is-
+        # better) gate; decode_step_p99_during_prefill_ms is the
+        # per-step latency hit in-flight sequences took while chunked
+        # prefill was pending, the number --prefill-flops bounds
+        result.update({
+            "context_len": args.context_len,
+            "window": args.window,
+            "sinks": args.sinks,
+            "prefill_flops": args.prefill_flops,
+            "table_block": args.table_block,
+            "pages_evicted": loop.pages_evicted,
+            "max_decode_table_pages": loop.max_decode_table_pages,
+            "decode_bytes_per_step": float(attention_bytes_per_step(
+                loop.paged_impl, args.max_batch,
+                loop.max_decode_table_pages, pool.page_size,
+                cfg.n_head, cfg.head_dim, num_layers=cfg.n_layer,
+                num_kv_heads=cfg.num_kv_heads, dtype=kv_dtype)),
+            "decode_step_p99_during_prefill_ms":
+                loop.decode_step_p99_during_prefill_s() * 1e3,
+        })
     if args.speculate:
         result.update({
             "speculate": args.speculate,
@@ -1295,6 +1338,34 @@ def main(argv=None) -> int:
                          "step (FLAGS_serving_prefill_chunk; 0 = "
                          "uncapped); max_prefill_tokens_step in the "
                          "report counter-asserts it")
+    ap.add_argument("--context-len", type=int, default=0,
+                    help="decode mode: serve FIXED-length prompts of N "
+                         "tokens (overrides --prompt-range) — the "
+                         "long-context replay (ISSUE 20); needs "
+                         "--max-len >= N + --max-new and a --pages "
+                         "pool that holds them")
+    ap.add_argument("--window", type=int, default=0,
+                    help="decode mode: sliding-window attention of W "
+                         "tokens per request — the pool drops interior "
+                         "pages past the window each step and "
+                         "pages_evicted / decode_bytes_per_step bank "
+                         "the capacity win (the no-window replay at "
+                         "the same --context-len is the CI teeth arm)")
+    ap.add_argument("--sinks", type=int, default=0,
+                    help="with --window: keep the first K tokens' "
+                         "(attention-sink) pages visible forever")
+    ap.add_argument("--prefill-flops", type=float, default=0.0,
+                    help="decode mode: budget each chunked-prefill "
+                         "step by estimated attention FLOPs instead of "
+                         "tokens alone (needs --prefill-chunk); bounds "
+                         "decode_step_p99_during_prefill_ms at deep "
+                         "contexts where a token cap misprices "
+                         "quadratic attention work")
+    ap.add_argument("--table-block", type=int, default=0,
+                    help="decode mode: walk decode page tables through "
+                         "the two-level view with N-entry L2 blocks "
+                         "(ISSUE 20 — SMEM rides live blocks, not "
+                         "total pages); 0 = flat tables")
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="decode mode: KV heads for a grouped-query "
                          "(GQA/MQA) pool — must divide --n-head; 0 = "
@@ -1454,6 +1525,39 @@ def main(argv=None) -> int:
         return 2
     if not 0.0 <= args.prefix_share <= 1.0:
         sys.stderr.write("serve_bench: --prefix-share must be in [0, 1]\n")
+        return 2
+    # the long-context knobs (ISSUE 20) ride the monolithic decode loop
+    if args.context_len < 0 or args.window < 0 or args.sinks < 0 \
+            or args.prefill_flops < 0 or args.table_block < 0:
+        sys.stderr.write(
+            "serve_bench: --context-len/--window/--sinks/"
+            "--prefill-flops/--table-block must be >= 0\n")
+        return 2
+    if args.context_len or args.window or args.sinks \
+            or args.prefill_flops or args.table_block:
+        if args.mode != "decode" or args.mesh > 1 or args.chaos \
+                or args.disagg or args.fleet or args.turns > 1 \
+                or args.tenants:
+            sys.stderr.write(
+                "serve_bench: --context-len/--window/--sinks/"
+                "--prefill-flops/--table-block need plain --mode decode "
+                "(no --mesh/--chaos/--disagg/--fleet/--turns/"
+                "--tenants)\n")
+            return 2
+    if args.sinks and not args.window:
+        sys.stderr.write(
+            "serve_bench: --sinks pins pages against a sliding window "
+            "— pass --window with it\n")
+        return 2
+    if args.prefill_flops and not args.prefill_chunk:
+        sys.stderr.write(
+            "serve_bench: --prefill-flops budgets CHUNKED prefill — "
+            "pass a nonzero --prefill-chunk with it\n")
+        return 2
+    if args.context_len and args.context_len + args.max_new > args.max_len:
+        sys.stderr.write(
+            f"serve_bench: --context-len {args.context_len} + --max-new "
+            f"{args.max_new} exceeds --max-len {args.max_len}\n")
         return 2
     if (args.speculate or args.sampling != "greedy") \
             and args.mode != "decode":
